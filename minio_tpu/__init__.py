@@ -1,0 +1,11 @@
+"""minio_tpu — a TPU-native object-storage framework.
+
+A from-scratch re-design of MinIO's capabilities (S3 API, erasure-coded
+distributed object store, healing, bitrot protection) with the compute hot
+path — GF(2^8) Reed-Solomon coding and hash verification — executed as
+batched JAX/XLA kernels on TPU, and host orchestration in Python/C++.
+
+Reference behavior map: /root/repo/SURVEY.md (citations into zonshy/minio).
+"""
+
+__version__ = "0.1.0"

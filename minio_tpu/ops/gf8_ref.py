@@ -1,0 +1,86 @@
+"""Pure-numpy Reed-Solomon reference codec (conformance oracle).
+
+Mirrors the behavior of klauspost/reedsolomon as used by MinIO's
+cmd/erasure-coding.go: systematic Vandermonde matrix, Encode computes parity,
+ReconstructData/Reconstruct rebuild missing shards from any k survivors.
+The TPU kernels (rs_kernels.py) are validated bit-for-bit against this.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import gf8
+
+
+class ReconstructError(ValueError):
+    """Too few shards to reconstruct (reedsolomon.ErrTooFewShards)."""
+
+
+def encode_parity(data_shards: np.ndarray, parity: int,
+                  matrix: np.ndarray | None = None) -> np.ndarray:
+    """(k, n) data -> (m, n) parity via the bottom m rows of the RS matrix."""
+    k, _ = data_shards.shape
+    if matrix is None:
+        matrix = gf8.rs_matrix(k, k + parity)
+    return gf8.gf_matmul(matrix[k:], data_shards)
+
+
+def encode(data_shards: np.ndarray, parity: int) -> np.ndarray:
+    """(k, n) -> (k+m, n) full shard set."""
+    return np.concatenate(
+        [data_shards, encode_parity(data_shards, parity)], axis=0)
+
+
+def reconstruct(shards: list[np.ndarray | None], data_blocks: int,
+                parity_blocks: int, data_only: bool = False,
+                matrix: np.ndarray | None = None) -> list[np.ndarray]:
+    """Rebuild missing (None) shards in-place semantics of ReconstructData /
+    Reconstruct (cmd/erasure-coding.go:89,106).
+
+    ``shards`` is a length k+m list; present shards are (n,) uint8 arrays.
+    Returns a new list with missing entries filled (all of them, or data only).
+    """
+    total = data_blocks + parity_blocks
+    if len(shards) != total:
+        raise ValueError("wrong shard count")
+    present = [i for i, s in enumerate(shards) if s is not None and len(s) > 0]
+    if len(present) < data_blocks:
+        raise ReconstructError(
+            f"need {data_blocks} shards, have {len(present)}")
+    if matrix is None:
+        matrix = gf8.rs_matrix(data_blocks, total)
+
+    n = len(shards[present[0]])
+    rows = present[:data_blocks]
+    sub = matrix[rows]  # (k, k)
+    # decode matrix: inv(sub) maps the k surviving shards back to data shards
+    dec = gf8.gf_mat_inv(sub)
+    stack = np.stack([np.asarray(shards[i], dtype=np.uint8) for i in rows])
+    out = list(shards)
+    missing_data = [i for i in range(data_blocks)
+                    if out[i] is None or len(out[i]) == 0]
+    if missing_data:
+        dec_rows = dec[missing_data]  # (md, k)
+        rebuilt = gf8.gf_matmul(dec_rows, stack)
+        for j, i in enumerate(missing_data):
+            out[i] = rebuilt[j]
+    if not data_only:
+        missing_par = [i for i in range(data_blocks, total)
+                       if out[i] is None or len(out[i]) == 0]
+        if missing_par:
+            # parity row applied to (possibly rebuilt) data shards
+            data_stack = np.stack([np.asarray(out[i], dtype=np.uint8)
+                                   for i in range(data_blocks)])
+            par = gf8.gf_matmul(matrix[missing_par], data_stack)
+            for j, i in enumerate(missing_par):
+                out[i] = par[j]
+    assert all(len(s) == n for s in out if s is not None and len(s) > 0)
+    return out
+
+
+def verify(shards: np.ndarray, data_blocks: int) -> bool:
+    """reedsolomon Verify: recompute parity and compare."""
+    parity = shards.shape[0] - data_blocks
+    want = encode_parity(shards[:data_blocks], parity)
+    return bool(np.array_equal(want, shards[data_blocks:]))

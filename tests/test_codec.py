@@ -1,0 +1,157 @@
+"""Erasure codec conformance tests.
+
+Mirrors cmd/erasure_test.go TestErasureEncodeDecode (the bit-identical
+conformance target, SURVEY.md §4) across both backends, and checks the TPU
+kernel path agrees byte-for-byte with the numpy reference oracle.
+"""
+
+import numpy as np
+import pytest
+
+from minio_tpu.ops import gf8, gf8_ref
+from minio_tpu.ops.codec import Erasure, ErasureError
+
+BLOCK_SIZE_V1 = 10 * 1024 * 1024
+
+# mirrors erasureEncodeDecodeTests (cmd/erasure_test.go:28-44)
+CASES = [
+    # (k, m, missing_data, missing_parity, reconstruct_parity, should_fail)
+    (2, 2, 0, 0, True, False),
+    (3, 3, 1, 0, True, False),
+    (4, 4, 2, 0, False, False),
+    (5, 5, 0, 1, True, False),
+    (6, 6, 0, 2, True, False),
+    (7, 7, 1, 1, False, False),
+    (8, 8, 3, 2, False, False),
+    (2, 2, 2, 1, True, True),
+    (4, 2, 2, 2, False, True),
+    (8, 4, 2, 2, False, False),
+]
+
+
+@pytest.mark.parametrize("backend", ["numpy", "tpu"])
+@pytest.mark.parametrize("case", CASES)
+def test_encode_decode(backend, case):
+    k, m, missing_data, missing_parity, reconstruct_parity, should_fail = case
+    rng = np.random.default_rng(hash(case) & 0xFFFF)
+    data = rng.integers(0, 256, 256).astype(np.uint8).tobytes()
+
+    er = Erasure(k, m, BLOCK_SIZE_V1, backend=backend)
+    encoded = er.encode_data(data)
+    assert len(encoded) == k + m
+
+    shards = list(encoded)
+    for j in range(missing_data):
+        shards[j] = None
+    for j in range(k, k + missing_parity):
+        shards[j] = None
+
+    try:
+        if reconstruct_parity:
+            decoded = er.decode_data_and_parity_blocks(shards)
+        else:
+            decoded = er.decode_data_blocks(shards)
+        failed = False
+    except gf8_ref.ReconstructError:
+        failed = True
+        decoded = None
+
+    assert failed == should_fail
+    if failed:
+        return
+    limit = (k + m) if reconstruct_parity else k
+    for j in range(limit):
+        assert decoded[j] is not None and len(decoded[j]) > 0, f"shard {j}"
+        assert np.array_equal(decoded[j], encoded[j]), f"shard {j} mismatch"
+    # reassembled data matches original
+    got = np.concatenate(decoded[:k]).tobytes()[: len(data)]
+    assert got == data
+
+
+def test_backends_bit_identical():
+    rng = np.random.default_rng(7)
+    for k, m, n in [(2, 2, 64), (4, 2, 1000), (8, 4, 4096), (12, 4, 65536),
+                    (16, 4, 1024), (12, 4, 1)]:
+        data = rng.integers(0, 256, n).astype(np.uint8).tobytes()
+        a = Erasure(k, m, 1024 * 1024, backend="numpy").encode_data(data)
+        b = Erasure(k, m, 1024 * 1024, backend="tpu").encode_data(data)
+        for sa, sb in zip(a, b):
+            assert np.array_equal(sa, sb)
+
+
+def test_reconstruct_every_pattern_8_4():
+    """Every <=4-erasure pattern over 8+4 reconstructs bit-identically."""
+    import itertools
+    k, m = 8, 4
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, 512).astype(np.uint8).tobytes()
+    er_np = Erasure(k, m, 1 << 20, backend="numpy")
+    er_tpu = Erasure(k, m, 1 << 20, backend="tpu")
+    encoded = er_np.encode_data(data)
+    patterns = list(itertools.combinations(range(k + m), 4))
+    rng.shuffle(patterns)
+    for pat in patterns[:40]:
+        shards = [None if i in pat else encoded[i] for i in range(k + m)]
+        out_np = er_np.decode_data_and_parity_blocks(list(shards))
+        out_tpu = er_tpu.decode_data_and_parity_blocks(list(shards))
+        for i in range(k + m):
+            assert np.array_equal(out_np[i], encoded[i])
+            assert np.array_equal(out_tpu[i], encoded[i])
+
+
+def test_empty_and_zero_payload():
+    er = Erasure(4, 2, 1 << 20)
+    shards = er.encode_data(b"")
+    assert len(shards) == 6 and all(len(s) == 0 for s in shards)
+    # no shard missing -> no-op (cmd/erasure-coding.go:97-100)
+    out = er.decode_data_blocks(list(shards := er.encode_data(b"abcdef")))
+    assert all(np.array_equal(a, b) for a, b in zip(out, shards))
+    # ALL shards empty must error (total data loss), matching the reference's
+    # ReconstructData -> ErrTooFewShards, not silently no-op
+    with pytest.raises(gf8_ref.ReconstructError):
+        er.decode_data_blocks([np.zeros(0, np.uint8)] * 6)
+
+
+def test_invalid_params():
+    with pytest.raises(ErasureError):
+        Erasure(0, 2, 1024)
+    with pytest.raises(ErasureError):
+        Erasure(2, 0, 1024)
+    with pytest.raises(ErasureError):
+        Erasure(200, 100, 1024)
+
+
+def test_encode_object_matches_blockwise():
+    """Batched whole-object path == per-block EncodeData concatenation."""
+    rng = np.random.default_rng(13)
+    # include bs % k != 0 (k=3, k=12): exercises the per-block zero-padding
+    # branch where k*shard_size > block_size
+    for k, m, bs in [(4, 2, 1024), (3, 2, 1000), (12, 4, 1 << 20)]:
+        er = Erasure(k, m, bs, backend="tpu")
+        ref = Erasure(k, m, bs, backend="numpy")
+        for total in [bs * 3, bs * 3 + 7, 100, bs, bs - 1]:
+            data = rng.integers(0, 256, total).astype(np.uint8).tobytes()
+            got = er.encode_object(data)
+            want_chunks = [[] for _ in range(k + m)]
+            for off in range(0, total, bs):
+                for i, s in enumerate(ref.encode_data(data[off:off + bs])):
+                    want_chunks[i].append(s)
+            for i in range(k + m):
+                want = np.concatenate(want_chunks[i])
+                assert np.array_equal(got[i], want), \
+                    f"shard file {i}, len {total}, k={k}"
+                assert len(got[i]) == er.shard_file_size(total)
+
+
+def test_reconstruct_batch():
+    from minio_tpu.ops import rs_kernels
+    rng = np.random.default_rng(17)
+    k, m, n, B = 12, 4, 256, 5
+    blocks = rng.integers(0, 256, (B, k, n)).astype(np.uint8)
+    par = rs_kernels.encode_parity(blocks, m)
+    full = np.concatenate([blocks, par], axis=1)  # (B, k+m, n)
+    present = [0, 2, 3, 4, 5, 6, 7, 8, 9, 10, 13, 15]  # drop 1, 11, 12, 14
+    wanted = [1, 11, 12, 14]
+    surv = full[:, present, :]
+    rebuilt = rs_kernels.reconstruct_batch(surv, present, wanted, k, m)
+    assert np.array_equal(rebuilt, full[:, wanted, :])

@@ -1,0 +1,150 @@
+"""GF(2^8) field / matrix / shard-math unit tests.
+
+Mirrors the codec-level tier of the reference test strategy (SURVEY.md §4;
+cmd/erasure_test.go, cmd/erasure-coding.go shard math).
+"""
+
+import numpy as np
+import pytest
+
+from minio_tpu.ops import gf8
+
+
+def test_exp_log_tables():
+    # generator walk: exp[0]=1, exp[1]=2, exp[8]=0x1d (x^8 reduced by 0x11d)
+    assert gf8.GF_EXP[0] == 1
+    assert gf8.GF_EXP[1] == 2
+    assert gf8.GF_EXP[8] == 0x1D
+    assert gf8.GF_LOG[1] == 0
+    assert gf8.GF_LOG[2] == 1
+    # log/exp inverses
+    for a in range(1, 256):
+        assert gf8.GF_EXP[gf8.GF_LOG[a]] == a
+
+
+def test_mul_table_properties():
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 256, 1000).astype(np.uint8)
+    b = rng.integers(0, 256, 1000).astype(np.uint8)
+    c = rng.integers(0, 256, 1000).astype(np.uint8)
+    # commutative, zero, one
+    assert np.array_equal(gf8.gf_mul(a, b), gf8.gf_mul(b, a))
+    assert np.all(gf8.gf_mul(a, 0) == 0)
+    assert np.array_equal(gf8.gf_mul(a, 1), a)
+    # distributive over XOR
+    assert np.array_equal(
+        gf8.gf_mul(a, b ^ c), gf8.gf_mul(a, b) ^ gf8.gf_mul(a, c))
+    # known value in this field: 0x80 * 2 = 0x11d & 0xff ^ 0x100 -> 0x1d
+    assert gf8.gf_mul(0x80, 2) == 0x1D
+
+
+def test_inverse_table():
+    for a in range(1, 256):
+        assert gf8.gf_mul(a, gf8.GF_INV[a]) == 1
+
+
+def test_matrix_systematic():
+    for k, m in [(2, 2), (4, 2), (8, 4), (12, 4), (16, 4), (5, 5)]:
+        M = gf8.rs_matrix(k, k + m)
+        assert M.shape == (k + m, k)
+        assert np.array_equal(M[:k], np.eye(k, dtype=np.uint8))
+        # any k rows must be invertible (MDS property of Vandermonde-derived)
+        rng = np.random.default_rng(k * 31 + m)
+        for _ in range(5):
+            rows = sorted(rng.choice(k + m, size=k, replace=False))
+            gf8.gf_mat_inv(M[rows])  # must not raise
+
+
+def test_cauchy_mds():
+    for k, m in [(4, 4), (12, 4)]:
+        M = gf8.cauchy_matrix(k, k + m)
+        assert np.array_equal(M[:k], np.eye(k, dtype=np.uint8))
+        rng = np.random.default_rng(1)
+        for _ in range(5):
+            rows = sorted(rng.choice(k + m, size=k, replace=False))
+            gf8.gf_mat_inv(M[rows])
+
+
+def test_mat_inv_roundtrip():
+    rng = np.random.default_rng(2)
+    for n in (1, 2, 5, 16):
+        while True:
+            M = rng.integers(0, 256, (n, n)).astype(np.uint8)
+            try:
+                Mi = gf8.gf_mat_inv(M)
+                break
+            except ValueError:
+                continue
+        assert np.array_equal(gf8.gf_matmul(M, Mi), np.eye(n, dtype=np.uint8))
+
+
+def test_singular_raises():
+    M = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+    with pytest.raises(ValueError):
+        gf8.gf_mat_inv(M)
+
+
+def test_gf2_expand_matches_gf_mul():
+    rng = np.random.default_rng(3)
+    M = rng.integers(0, 256, (4, 12)).astype(np.uint8)
+    d = rng.integers(0, 256, (12, 33)).astype(np.uint8)
+    want = gf8.gf_matmul(M, d)
+    # bit-domain: expand, unpack, binary matmul mod 2, pack
+    M2 = gf8.gf2_expand(M)
+    bits = ((d[:, None, :] >> np.arange(8)[None, :, None]) & 1).reshape(96, 33)
+    out_bits = (M2.astype(np.int32) @ bits.astype(np.int32)) & 1
+    out = np.zeros((4, 33), dtype=np.uint8)
+    for b in range(8):
+        out |= (out_bits.reshape(4, 8, 33)[:, b] << b).astype(np.uint8)
+    assert np.array_equal(out, want)
+
+
+# -- shard math: bit-identical with cmd/erasure-coding.go:115-143 ----------
+
+def test_shard_size():
+    assert gf8.shard_size(10 * 1024 * 1024, 10) == 1024 * 1024
+    assert gf8.shard_size(1, 10) == 1
+    assert gf8.shard_size(10, 3) == 4
+
+
+@pytest.mark.parametrize("k,bs,total,want", [
+    # mirrors ShardFileSize: numShards*ShardSize + ceil(lastBlock/k)
+    (10, 10 * 1024 * 1024, 0, 0),
+    (10, 10 * 1024 * 1024, -1, -1),
+    (10, 10 * 1024 * 1024, 10 * 1024 * 1024, 1024 * 1024),
+    (10, 10 * 1024 * 1024, 10 * 1024 * 1024 + 1, 1024 * 1024 + 1),
+    (4, 1024, 4096 + 100, 4 * 256 + 25),
+])
+def test_shard_file_size(k, bs, total, want):
+    assert gf8.shard_file_size(bs, k, total) == want
+
+
+def test_shard_file_offset_clamps():
+    bs, k, total = 1024, 4, 10000
+    sfs = gf8.shard_file_size(bs, k, total)
+    assert gf8.shard_file_offset(bs, k, 0, total, total) == sfs
+    # mid-range read covers only the blocks it touches
+    off = gf8.shard_file_offset(bs, k, 0, 1, total)
+    assert off == gf8.shard_size(bs, k)
+
+
+def test_split_padding():
+    data = bytes(range(10))
+    shards = gf8.split(data, 3)
+    assert shards.shape == (3, 4)
+    assert bytes(shards[0]) == b"\x00\x01\x02\x03"
+    assert bytes(shards[2]) == b"\x08\x09\x00\x00"  # zero-padded tail
+    with pytest.raises(ValueError):
+        gf8.split(b"", 3)
+
+
+def test_ceil_frac_negatives():
+    # bit-identical with cmd/utils.go:613 (truncate toward zero, bump only
+    # positive inexact quotients, zero denominator -> 0)
+    assert gf8.ceil_frac(7, 2) == 4
+    assert gf8.ceil_frac(-7, 2) == -3
+    assert gf8.ceil_frac(7, -2) == -3
+    assert gf8.ceil_frac(-7, -2) == 4
+    assert gf8.ceil_frac(0, 5) == 0
+    assert gf8.ceil_frac(10, 0) == 0
+    assert gf8.ceil_frac(6, 2) == 3
