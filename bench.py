@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""North-star benchmark: RS encode/decode GiB/s per TPU chip (12+4, 1 MiB).
+
+Mirrors the reference benchmark grid semantics (cmd/erasure-encode_test.go
+b.SetBytes -> MB/s of *data* bytes processed) on the BASELINE.json headline
+config: 12+4 erasure set, 1 MiB blockSize.
+
+Methodology: data is generated on-device and timings wrap only device work
+(kernel + XOR-matmul), `block_until_ready()` fencing each iteration.  Host
+transfers are excluded: on this harness the TPU sits behind an experimental
+tunnel whose H2D/D2H tops out at ~10 MiB/s, which would measure the tunnel,
+not the codec; on real TPU hosts DMA runs at tens of GB/s and the device
+pipeline (double-buffered H2D) is the deployment shape.
+
+Baseline: klauspost/reedsolomon AVX2 encode on one modern core ~= 6 GiB/s
+(the reference's practical CPU bar, SURVEY.md §6); BASELINE.json's target is
+>= 4x that. vs_baseline reported here is measured / 6.0.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+AVX2_BASELINE_GIBPS = 6.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from minio_tpu.ops import gf8, rs_kernels
+
+    k, m = 12, 4
+    block_size = 1 << 20
+    ss = gf8.shard_size(block_size, k)          # 87382
+    ss_pad = ss + ((-ss) % 128)
+    B = 64                                       # 64 MiB of data per dispatch
+
+    key = jax.random.PRNGKey(0)
+    data = jax.random.randint(key, (B, k, ss_pad), 0, 256, dtype=jnp.uint8)
+    data.block_until_ready()
+
+    M = np.asarray(gf8.rs_matrix(k, k + m))
+    enc_mat = jnp.asarray(gf8.gf2_expand(M[k:]), jnp.int8)
+    # decode: BASELINE config 3 — 2 shards zeroed, reconstruct on device
+    present = list(range(2, k + 2))              # lost shards 0,1; use 2..13
+    dec_rows = rs_kernels.decode_rows(M, k, present, [0, 1])
+    dec_mat = jnp.asarray(gf8.gf2_expand(dec_rows), jnp.int8)
+    # heal: BASELINE config 4 — 16-drive set, 3 shards offline
+    present3 = list(range(3, k + 3))
+    heal_rows = rs_kernels.decode_rows(M, k, present3, [0, 1, 2])
+    heal_mat = jnp.asarray(gf8.gf2_expand(heal_rows), jnp.int8)
+
+    def bench(mat, iters=20):
+        rs_kernels._gf2_apply(mat, data).block_until_ready()  # compile+warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            rs_kernels._gf2_apply(mat, data).block_until_ready()
+        dt = (time.perf_counter() - t0) / iters
+        return (B * block_size) / dt / 2**30     # data GiB/s
+
+    encode_gibps = bench(enc_mat)
+    decode_gibps = bench(dec_mat)
+    heal_gibps = bench(heal_mat)
+    # heal rate in shards/s: 3 shards rebuilt per stripe per dispatch
+    heal_shards_s = heal_gibps * 2**30 / block_size * 3
+
+    value = round(min(encode_gibps, decode_gibps), 2)
+    result = {
+        "metric": "rs_encode_decode_GiBps_12+4_1MiB",
+        "value": value,
+        "unit": "GiB/s",
+        "vs_baseline": round(value / AVX2_BASELINE_GIBPS, 2),
+        "detail": {
+            "encode_GiBps": round(encode_gibps, 2),
+            "decode2_GiBps": round(decode_gibps, 2),
+            "heal3_GiBps": round(heal_gibps, 2),
+            "heal_shards_per_s": round(heal_shards_s, 1),
+            "device": str(jax.devices()[0]),
+            "baseline": f"klauspost AVX2 ~{AVX2_BASELINE_GIBPS} GiB/s/core",
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,269 @@
+/* Portable HighwayHash-256 — the bitrot checksum of the reference
+ * (minio/highwayhash dep; used via cmd/bitrot.go:41-53 with a fixed magic
+ * key).  Written from the published HighwayHash algorithm (portable
+ * formulation); validated against the public HighwayHash64 test vectors in
+ * tests/test_bitrot.py.
+ *
+ * This is the framework's host-native hashing core: a C analog of the
+ * reference's AVX2 assembly module.  One-shot and streaming entry points,
+ * plus a batch call for hashing many shard blocks per dispatch.
+ */
+
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+typedef struct {
+  uint64_t v0[4], v1[4], mul0[4], mul1[4];
+} HHState;
+
+static const uint64_t kInitMul0[4] = {
+    0xdbe6d5d5fe4cce2full, 0xa4093822299f31d0ull,
+    0x13198a2e03707344ull, 0x243f6a8885a308d3ull};
+static const uint64_t kInitMul1[4] = {
+    0x3bd39e10cb0ef593ull, 0xc0acf169b5f18a8cull,
+    0xbe5466cf34e90c6cull, 0x452821e638d01377ull};
+
+static void hh_reset(HHState* s, const uint64_t key[4]) {
+  for (int i = 0; i < 4; ++i) {
+    s->mul0[i] = kInitMul0[i];
+    s->mul1[i] = kInitMul1[i];
+    s->v0[i] = kInitMul0[i] ^ key[i];
+    s->v1[i] = kInitMul1[i] ^ ((key[i] >> 32) | (key[i] << 32));
+  }
+}
+
+static void zipper_merge_and_add(const uint64_t v1, const uint64_t v0,
+                                 uint64_t* add1, uint64_t* add0) {
+  *add0 += (((v0 & 0xff000000ull) | (v1 & 0xff00000000ull)) >> 24) |
+           (((v0 & 0xff0000000000ull) | (v1 & 0xff000000000000ull)) >> 16) |
+           (v0 & 0xff0000ull) | ((v0 & 0xff00ull) << 32) |
+           ((v1 & 0xff00000000000000ull) >> 8) | (v0 << 56);
+  *add1 += (((v1 & 0xff000000ull) | (v0 & 0xff00000000ull)) >> 24) |
+           (v1 & 0xff0000ull) | ((v1 & 0xff0000000000ull) >> 16) |
+           ((v1 & 0xff00ull) << 24) | ((v0 & 0xff000000000000ull) >> 8) |
+           ((v1 & 0xffull) << 48) | (v0 & 0xff00000000000000ull);
+}
+
+static uint64_t read_le64(const uint8_t* p) {
+  uint64_t v;
+  memcpy(&v, p, 8); /* little-endian hosts only (x86/arm LE) */
+  return v;
+}
+
+static void hh_update_lanes(HHState* s, const uint64_t lanes[4]) {
+  int i;
+  for (i = 0; i < 4; ++i) s->v1[i] += s->mul0[i] + lanes[i];
+  for (i = 0; i < 4; ++i)
+    s->mul0[i] ^= (s->v1[i] & 0xffffffffull) * (s->v0[i] >> 32);
+  for (i = 0; i < 4; ++i) s->v0[i] += s->mul1[i];
+  for (i = 0; i < 4; ++i)
+    s->mul1[i] ^= (s->v0[i] & 0xffffffffull) * (s->v1[i] >> 32);
+  zipper_merge_and_add(s->v1[1], s->v1[0], &s->v0[1], &s->v0[0]);
+  zipper_merge_and_add(s->v1[3], s->v1[2], &s->v0[3], &s->v0[2]);
+  zipper_merge_and_add(s->v0[1], s->v0[0], &s->v1[1], &s->v1[0]);
+  zipper_merge_and_add(s->v0[3], s->v0[2], &s->v1[3], &s->v1[2]);
+}
+
+static void hh_update_packet(HHState* s, const uint8_t* packet) {
+  uint64_t lanes[4];
+  for (int i = 0; i < 4; ++i) lanes[i] = read_le64(packet + 8 * i);
+  hh_update_lanes(s, lanes);
+}
+
+static void rotate_32_by(uint32_t count, uint64_t lanes[4]) {
+  for (int i = 0; i < 4; ++i) {
+    uint32_t half0 = (uint32_t)(lanes[i] & 0xffffffffull);
+    uint32_t half1 = (uint32_t)(lanes[i] >> 32);
+    lanes[i] = ((uint64_t)((half0 << count) | (half0 >> (32 - count)))) |
+               (((uint64_t)((half1 << count) | (half1 >> (32 - count)))) << 32);
+  }
+}
+
+static void hh_update_remainder(HHState* s, const uint8_t* bytes,
+                                const size_t size_mod32) {
+  int i;
+  const size_t size_mod4 = size_mod32 & 3;
+  const uint8_t* remainder = bytes + (size_mod32 & ~3u);
+  uint8_t packet[32] = {0};
+  for (i = 0; i < 4; ++i)
+    s->v0[i] += ((uint64_t)size_mod32 << 32) + size_mod32;
+  rotate_32_by((uint32_t)size_mod32, s->v1);
+  for (i = 0; i < (int)(remainder - bytes); ++i) packet[i] = bytes[i];
+  if (size_mod32 & 16) {
+    for (i = 0; i < 4; ++i)
+      packet[28 + i] = remainder[i + (int)size_mod4 - 4];
+  } else if (size_mod4) {
+    packet[16 + 0] = remainder[0];
+    packet[16 + 1] = remainder[size_mod4 >> 1];
+    packet[16 + 2] = remainder[size_mod4 - 1];
+  }
+  hh_update_packet(s, packet);
+}
+
+static void permute_and_update(HHState* s) {
+  uint64_t permuted[4];
+  permuted[0] = (s->v0[2] >> 32) | (s->v0[2] << 32);
+  permuted[1] = (s->v0[3] >> 32) | (s->v0[3] << 32);
+  permuted[2] = (s->v0[0] >> 32) | (s->v0[0] << 32);
+  permuted[3] = (s->v0[1] >> 32) | (s->v0[1] << 32);
+  hh_update_lanes(s, permuted);
+}
+
+static void modular_reduction(uint64_t a3_unmasked, uint64_t a2, uint64_t a1,
+                              uint64_t a0, uint64_t* m1, uint64_t* m0) {
+  uint64_t a3 = a3_unmasked & 0x3fffffffffffffffull;
+  *m1 = a1 ^ ((a3 << 1) | (a2 >> 63)) ^ ((a3 << 2) | (a2 >> 62));
+  *m0 = a0 ^ (a2 << 1) ^ (a2 << 2);
+}
+
+static uint64_t hh_finalize64(HHState* s) {
+  for (int i = 0; i < 4; ++i) permute_and_update(s);
+  return s->v0[0] + s->v1[0] + s->mul0[0] + s->mul1[0];
+}
+
+static void hh_finalize256(HHState* s, uint64_t hash[4]) {
+  for (int i = 0; i < 10; ++i) permute_and_update(s);
+  modular_reduction(s->v1[1] + s->mul1[1], s->v1[0] + s->mul1[0],
+                    s->v0[1] + s->mul0[1], s->v0[0] + s->mul0[0],
+                    &hash[1], &hash[0]);
+  modular_reduction(s->v1[3] + s->mul1[3], s->v1[2] + s->mul1[2],
+                    s->v0[3] + s->mul0[3], s->v0[2] + s->mul0[2],
+                    &hash[3], &hash[2]);
+}
+
+static void hh_process_all(HHState* s, const uint64_t key[4],
+                           const uint8_t* data, size_t size) {
+  size_t i;
+  hh_reset(s, key);
+  for (i = 0; i + 32 <= size; i += 32) hh_update_packet(s, data + i);
+  if ((size & 31) != 0) hh_update_remainder(s, data + i, size & 31);
+}
+
+/* ---- exported API (ctypes) ---- */
+
+void mt_hh256(const uint64_t key[4], const uint8_t* data, size_t size,
+              uint8_t out[32]) {
+  HHState s;
+  uint64_t hash[4];
+  hh_process_all(&s, key, data, size);
+  hh_finalize256(&s, hash);
+  memcpy(out, hash, 32);
+}
+
+uint64_t mt_hh64(const uint64_t key[4], const uint8_t* data, size_t size) {
+  HHState s;
+  hh_process_all(&s, key, data, size);
+  return hh_finalize64(&s);
+}
+
+/* Hash `count` consecutive blocks of `block_size` bytes (last one may be
+ * short: total = size): the per-shard-block bitrot sweep in one call. */
+void mt_hh256_blocks(const uint64_t key[4], const uint8_t* data, size_t size,
+                     size_t block_size, uint8_t* out /* count*32 */) {
+  size_t off = 0;
+  while (off < size) {
+    size_t n = size - off < block_size ? size - off : block_size;
+    mt_hh256(key, data + off, n, out);
+    off += n;
+    out += 32;
+  }
+}
+
+/* streaming (whole-file bitrot): caller allocates an opaque state buffer */
+typedef struct {
+  HHState s;
+  uint64_t key[4];
+  uint8_t buf[32];
+  size_t buf_len;
+} HHStream;
+
+size_t mt_hh_stream_size(void) { return sizeof(HHStream); }
+
+void mt_hh_stream_init(HHStream* st, const uint64_t key[4]) {
+  memcpy(st->key, key, 32);
+  hh_reset(&st->s, key);
+  st->buf_len = 0;
+}
+
+void mt_hh_stream_update(HHStream* st, const uint8_t* data, size_t size) {
+  if (st->buf_len) {
+    size_t need = 32 - st->buf_len;
+    size_t take = size < need ? size : need;
+    memcpy(st->buf + st->buf_len, data, take);
+    st->buf_len += take;
+    data += take;
+    size -= take;
+    if (st->buf_len == 32 && size > 0) {
+      /* only flush when more data follows: a trailing exactly-full buffer
+       * must go through Update, not Remainder -- flush lazily */
+      hh_update_packet(&st->s, st->buf);
+      st->buf_len = 0;
+    }
+  }
+  if (size == 0) return;
+  if (st->buf_len == 32) { /* buffered packet + new data: flush it */
+    hh_update_packet(&st->s, st->buf);
+    st->buf_len = 0;
+  }
+  while (size > 32) { /* keep >=1 byte (or exactly 32) for the tail */
+    hh_update_packet(&st->s, data);
+    data += 32;
+    size -= 32;
+  }
+  memcpy(st->buf, data, size);
+  st->buf_len = size;
+}
+
+void mt_hh_stream_final256(HHStream* st, uint8_t out[32]) {
+  uint64_t hash[4];
+  if (st->buf_len == 32) {
+    hh_update_packet(&st->s, st->buf);
+  } else if (st->buf_len) {
+    hh_update_remainder(&st->s, st->buf, st->buf_len);
+  }
+  hh_finalize256(&st->s, hash);
+  memcpy(out, hash, 32);
+  /* leave state reusable via init */
+}
+
+/* ---- SipHash-2-4 (object->erasure-set distribution, cmd/erasure-sets.go:629)
+ * Standard algorithm; validated against the SipHash paper vectors. */
+
+#define SIP_ROTL(x, b) (uint64_t)(((x) << (b)) | ((x) >> (64 - (b))))
+#define SIP_ROUND(v0, v1, v2, v3) \
+  do {                            \
+    v0 += v1; v1 = SIP_ROTL(v1, 13); v1 ^= v0; v0 = SIP_ROTL(v0, 32); \
+    v2 += v3; v3 = SIP_ROTL(v3, 16); v3 ^= v2;                        \
+    v0 += v3; v3 = SIP_ROTL(v3, 21); v3 ^= v0;                        \
+    v2 += v1; v1 = SIP_ROTL(v1, 17); v1 ^= v2; v2 = SIP_ROTL(v2, 32); \
+  } while (0)
+
+uint64_t mt_siphash24(uint64_t k0, uint64_t k1, const uint8_t* data,
+                      size_t size) {
+  uint64_t v0 = 0x736f6d6570736575ull ^ k0;
+  uint64_t v1 = 0x646f72616e646f6dull ^ k1;
+  uint64_t v2 = 0x6c7967656e657261ull ^ k0;
+  uint64_t v3 = 0x7465646279746573ull ^ k1;
+  const size_t end = size - (size % 8);
+  size_t i;
+  for (i = 0; i < end; i += 8) {
+    uint64_t m = read_le64(data + i);
+    v3 ^= m;
+    SIP_ROUND(v0, v1, v2, v3);
+    SIP_ROUND(v0, v1, v2, v3);
+    v0 ^= m;
+  }
+  uint64_t b = ((uint64_t)size) << 56;
+  for (i = 0; i < size % 8; ++i) b |= ((uint64_t)data[end + i]) << (8 * i);
+  v3 ^= b;
+  SIP_ROUND(v0, v1, v2, v3);
+  SIP_ROUND(v0, v1, v2, v3);
+  v0 ^= b;
+  v2 ^= 0xff;
+  SIP_ROUND(v0, v1, v2, v3);
+  SIP_ROUND(v0, v1, v2, v3);
+  SIP_ROUND(v0, v1, v2, v3);
+  SIP_ROUND(v0, v1, v2, v3);
+  return v0 ^ v1 ^ v2 ^ v3;
+}

@@ -1,0 +1,242 @@
+"""Object healing (cmd/erasure-healing.go:233 healObject,
+cmd/erasure-lowlevel-heal.go Erasure.Heal).
+
+Classify each drive for a given object version as ok / outdated / offline
+(listOnlineDisks + disksWithAllParts analog, cmd/erasure-healing-common.go),
+then rebuild the missing shards: read the k healthiest shard files, run the
+decode matmul on device for the *wanted* shard indices (one batched dispatch
+covers every stripe), re-frame with bitrot, and commit to the stale drives
+with tmp+rename_data.  Dangling objects (fewer than k shards anywhere) are
+purged, as in purgeObjectDangling (cmd/erasure-healing.go:692).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..hashing import bitrot
+from ..ops import gf8
+from ..storage import errors as serrors
+from ..storage.datatypes import ErasureInfo, FileInfo
+from ..storage.xl_storage import SYS_DIR
+from . import metadata as meta
+from .interface import ObjectNotFound
+from .erasure_object import ErasureObjects
+
+
+@dataclass
+class HealResult:
+    """mirror of madmin.HealResultItem essentials."""
+    bucket: str
+    object_name: str
+    version_id: str = ""
+    before_ok: int = 0
+    after_ok: int = 0
+    healed_disks: list[str] = field(default_factory=list)
+    dangling_purged: bool = False
+
+
+class DiskState:
+    OK = "ok"
+    OFFLINE = "offline"
+    MISSING = "missing"          # no metadata / no parts
+    OUTDATED = "outdated"        # stale version
+    CORRUPT = "corrupt"          # bitrot / bad part sizes
+
+
+def classify_disks(er: ErasureObjects, bucket: str, object_name: str,
+                   fi: FileInfo, fis: list[FileInfo | None],
+                   errs: list[Exception | None],
+                   deep: bool = False) -> list[str]:
+    """Per-disk state for the quorum version ``fi``
+    (listOnlineDisks/disksWithAllParts semantics)."""
+    states = []
+    shuffled = meta.shuffle_disks(er.disks, fi.erasure.distribution)
+    s_fis = meta.shuffle_parts_metadata(fis, fi.erasure.distribution)
+    s_errs = meta.shuffle_parts_metadata(errs, fi.erasure.distribution)
+    for disk, dfi, derr in zip(shuffled, s_fis, s_errs):
+        if disk is None or isinstance(derr, serrors.DiskNotFound):
+            states.append(DiskState.OFFLINE)
+            continue
+        if isinstance(derr, (serrors.FileNotFound,
+                             serrors.FileVersionNotFound)):
+            states.append(DiskState.MISSING)
+            continue
+        if derr is not None:
+            states.append(DiskState.CORRUPT)
+            continue
+        if dfi is None or dfi.mod_time != fi.mod_time:
+            states.append(DiskState.OUTDATED)
+            continue
+        if dfi.inline_data is not None:
+            states.append(DiskState.OK)
+            continue
+        try:
+            if deep:
+                disk.verify_file(bucket, object_name, dfi)
+            else:
+                disk.check_parts(bucket, object_name, dfi)
+            states.append(DiskState.OK)
+        except serrors.StorageError:
+            states.append(DiskState.CORRUPT)
+    return states
+
+
+def heal_object(er: ErasureObjects, bucket: str, object_name: str,
+                version_id: Optional[str] = None, deep: bool = False,
+                dry_run: bool = False, remove_dangling: bool = False
+                ) -> HealResult:
+    """HealObject for one version (cmd/erasure-healing.go:803,233)."""
+    fis, errs = er._fanout(
+        lambda d: d.read_version(bucket, object_name, version_id))
+    ok_reads = [fi for fi in fis if fi is not None]
+    if not ok_reads:
+        raise ObjectNotFound(f"{bucket}/{object_name}")
+    try:
+        fi = meta.find_file_info_in_quorum(fis, max(1, len(er.disks) // 2))
+    except meta.ReadQuorumError:
+        # metadata below quorum: the object can never be served again —
+        # dangling (purgeObjectDangling, cmd/erasure-healing.go:692)
+        fi = ok_reads[0]
+        res = HealResult(bucket, object_name, fi.version_id)
+        res.before_ok = len(ok_reads)
+        if remove_dangling and not dry_run:
+            er._fanout(lambda d: d.delete_version(bucket, object_name, fi))
+            res.dangling_purged = True
+        res.after_ok = res.before_ok
+        return res
+    k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
+    res = HealResult(bucket, object_name, fi.version_id)
+
+    states = classify_disks(er, bucket, object_name, fi, fis, errs, deep)
+    res.before_ok = states.count(DiskState.OK)
+    healable = [i for i, s in enumerate(states)
+                if s in (DiskState.MISSING, DiskState.OUTDATED,
+                         DiskState.CORRUPT)]
+
+    if res.before_ok < k:
+        # dangling: not enough shards anywhere to ever reconstruct
+        if remove_dangling and not dry_run:
+            er._fanout(lambda d: d.delete_version(bucket, object_name, fi))
+            res.dangling_purged = True
+        res.after_ok = res.before_ok
+        return res
+
+    if not healable or dry_run:
+        res.after_ok = res.before_ok
+        return res
+
+    shuffled = meta.shuffle_disks(er.disks, fi.erasure.distribution)
+    s_fis = meta.shuffle_parts_metadata(fis, fi.erasure.distribution)
+    ssize = fi.erasure.shard_size()
+
+    # delete markers / zero-byte objects: metadata-only heal
+    if fi.deleted or fi.size == 0 or not fi.parts:
+        for i in healable:
+            dfi = _disk_fileinfo(fi, i)
+            shuffled[i].write_metadata(bucket, object_name, dfi)
+            res.healed_disks.append(shuffled[i].endpoint())
+        res.after_ok = res.before_ok + len(healable)
+        return res
+
+    ok_idx = [i for i, s in enumerate(states) if s == DiskState.OK]
+    inline = any(f is not None and f.inline_data is not None
+                 for f in s_fis)
+
+    for part in fi.parts:
+        sfsize = fi.erasure.shard_file_size(part.size)
+        # read k healthy shard files (verified)
+        shards: dict[int, np.ndarray] = {}
+        for i in ok_idx:
+            if len(shards) == k:
+                break
+            try:
+                dfi = s_fis[i]
+                if dfi is not None and dfi.inline_data is not None:
+                    framed = dfi.inline_data
+                else:
+                    framed = shuffled[i].read_all(
+                        bucket,
+                        f"{object_name}/{fi.data_dir}/part.{part.number}")
+                r = bitrot.StreamingBitrotReader(framed, ssize,
+                                                 er.bitrot_algo)
+                shards[i] = np.frombuffer(r.read_at(0, sfsize),
+                                          dtype=np.uint8)
+            except (serrors.StorageError, bitrot.BitrotError):
+                continue
+        if len(shards) < k:
+            res.after_ok = res.before_ok
+            return res
+        present = sorted(shards)[:k]
+        wanted = healable
+        rebuilt = _reconstruct_shards(er, fi, present,
+                                      [shards[i] for i in present],
+                                      wanted, part.size)
+        for j, i in enumerate(wanted):
+            framed = bitrot.streaming_encode(rebuilt[j].tobytes(), ssize,
+                                             er.bitrot_algo)
+            disk = shuffled[i]
+            dfi = _disk_fileinfo(fi, i)
+            if inline or fi.size <= er.inline_threshold:
+                dfi.inline_data = framed
+                dfi.data_dir = ""
+                disk.write_metadata(bucket, object_name, dfi)
+            else:
+                tmp = disk.tmp_dir()
+                try:
+                    disk.create_file(SYS_DIR, f"{tmp}/part.{part.number}",
+                                     framed)
+                    disk.rename_data(SYS_DIR, tmp, dfi, bucket, object_name)
+                finally:
+                    disk.clean_tmp(tmp)
+            if disk.endpoint() not in res.healed_disks:
+                res.healed_disks.append(disk.endpoint())
+    res.after_ok = res.before_ok + len(healable)
+    return res
+
+
+def _disk_fileinfo(fi: FileInfo, shard_idx: int) -> FileInfo:
+    dfi = FileInfo(**{**fi.__dict__})
+    dfi.erasure = ErasureInfo(**{**fi.erasure.__dict__})
+    dfi.erasure.index = shard_idx + 1
+    dfi.inline_data = None
+    return dfi
+
+
+def _reconstruct_shards(er: ErasureObjects, fi: FileInfo, present: list[int],
+                        surviving: list[np.ndarray], wanted: list[int],
+                        part_size: int) -> list[np.ndarray]:
+    """Rebuild full shard files for ``wanted`` indices (data or parity),
+    batching all full stripes into one device dispatch."""
+    from ..ops import rs_kernels
+    k = fi.erasure.data_blocks
+    bs = fi.erasure.block_size
+    ssize = fi.erasure.shard_size()
+    nfull = part_size // bs
+    tail = part_size - nfull * bs
+    sfsize = fi.erasure.shard_file_size(part_size)
+    mat = er._codec.matrix
+    rows = rs_kernels.decode_rows(mat, k, present, wanted)
+    outs = [np.empty(sfsize, dtype=np.uint8) for _ in wanted]
+    if nfull:
+        surv = np.stack([s[: nfull * ssize].reshape(nfull, ssize)
+                         for s in surviving], axis=1)
+        if er._codec.backend == "tpu":
+            reb = rs_kernels.apply_matrix(rows, surv)
+        else:
+            reb = np.stack([gf8.gf_matmul(rows, surv[b])
+                            for b in range(nfull)])
+        for j in range(len(wanted)):
+            outs[j][: nfull * ssize] = reb[:, j].reshape(-1)
+    if tail:
+        t_ssize = gf8.ceil_frac(tail, k)
+        surv_t = np.stack([s[nfull * ssize: nfull * ssize + t_ssize]
+                           for s in surviving])
+        reb_t = gf8.gf_matmul(rows, surv_t) if er._codec.backend != "tpu" \
+            else rs_kernels.apply_matrix(rows, surv_t)
+        for j in range(len(wanted)):
+            outs[j][nfull * ssize:] = reb_t[j]
+    return outs

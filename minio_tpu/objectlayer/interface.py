@@ -1,0 +1,174 @@
+"""ObjectLayer facade types and errors (cmd/object-api-interface.go:84,
+cmd/object-api-errors.go).
+
+ObjectInfo is the S3-facing view of a stored object; the typed errors map
+1:1 onto S3 error codes in the API layer.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+
+class ObjectLayerError(Exception):
+    pass
+
+
+class BucketNotFound(ObjectLayerError):
+    pass
+
+
+class BucketExists(ObjectLayerError):
+    pass
+
+
+class BucketNotEmpty(ObjectLayerError):
+    pass
+
+
+class BucketNameInvalid(ObjectLayerError):
+    pass
+
+
+class ObjectNotFound(ObjectLayerError):
+    pass
+
+
+class VersionNotFound(ObjectLayerError):
+    pass
+
+
+class MethodNotAllowed(ObjectLayerError):
+    """e.g. GET on a delete marker."""
+
+
+class ObjectNameInvalid(ObjectLayerError):
+    pass
+
+
+class InvalidRange(ObjectLayerError):
+    pass
+
+
+class ReadQuorumError(ObjectLayerError):
+    """errErasureReadQuorum: not enough disks agree to read."""
+
+
+class WriteQuorumError(ObjectLayerError):
+    """errErasureWriteQuorum: not enough successful writes."""
+
+
+class InvalidUploadID(ObjectLayerError):
+    pass
+
+
+class InvalidPart(ObjectLayerError):
+    pass
+
+
+class PreconditionFailed(ObjectLayerError):
+    pass
+
+
+@dataclass
+class ObjectInfo:
+    """cmd/object-api-datatypes.go ObjectInfo equivalent."""
+    bucket: str = ""
+    name: str = ""
+    mod_time: int = 0            # unix ns
+    size: int = 0
+    etag: str = ""
+    version_id: str = ""
+    is_latest: bool = True
+    delete_marker: bool = False
+    content_type: str = ""
+    user_defined: dict[str, str] = field(default_factory=dict)
+    parity: int = 0
+    data_blocks: int = 0
+    num_versions: int = 0
+    is_dir: bool = False
+
+
+@dataclass
+class BucketInfo:
+    name: str
+    created: int = 0
+
+
+@dataclass
+class ListObjectsInfo:
+    """ListObjects result page (cmd/object-api-datatypes.go)."""
+    objects: list[ObjectInfo] = field(default_factory=list)
+    prefixes: list[str] = field(default_factory=list)
+    is_truncated: bool = False
+    next_marker: str = ""
+    next_continuation_token: str = ""
+
+
+@dataclass
+class ListObjectVersionsInfo:
+    objects: list[ObjectInfo] = field(default_factory=list)
+    prefixes: list[str] = field(default_factory=list)
+    is_truncated: bool = False
+    next_key_marker: str = ""
+    next_version_id_marker: str = ""
+
+
+@dataclass
+class PutObjectOptions:
+    user_defined: dict[str, str] = field(default_factory=dict)
+    versioned: bool = False
+    version_id: str = ""
+    mod_time: int = 0
+
+
+@dataclass
+class ObjectOptions:
+    version_id: Optional[str] = None
+    versioned: bool = False
+    version_suspended: bool = False
+    delete_marker: bool = False
+    mod_time: int = 0
+
+
+class ObjectLayer(abc.ABC):
+    """The namespace facade every topology implements
+    (cmd/object-api-interface.go:84): single set, sets, server pools."""
+
+    @abc.abstractmethod
+    def make_bucket(self, bucket: str) -> None: ...
+
+    @abc.abstractmethod
+    def get_bucket_info(self, bucket: str) -> BucketInfo: ...
+
+    @abc.abstractmethod
+    def list_buckets(self) -> list[BucketInfo]: ...
+
+    @abc.abstractmethod
+    def delete_bucket(self, bucket: str, force: bool = False) -> None: ...
+
+    @abc.abstractmethod
+    def put_object(self, bucket: str, object_name: str, data: bytes,
+                   opts: Optional[PutObjectOptions] = None) -> ObjectInfo: ...
+
+    @abc.abstractmethod
+    def get_object(self, bucket: str, object_name: str, offset: int = 0,
+                   length: int = -1,
+                   opts: Optional[ObjectOptions] = None
+                   ) -> tuple[ObjectInfo, bytes]: ...
+
+    @abc.abstractmethod
+    def get_object_info(self, bucket: str, object_name: str,
+                        opts: Optional[ObjectOptions] = None
+                        ) -> ObjectInfo: ...
+
+    @abc.abstractmethod
+    def delete_object(self, bucket: str, object_name: str,
+                      opts: Optional[ObjectOptions] = None) -> ObjectInfo: ...
+
+    @abc.abstractmethod
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000
+                     ) -> ListObjectsInfo: ...

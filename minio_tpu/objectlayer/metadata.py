@@ -1,0 +1,128 @@
+"""Quorum metadata logic (cmd/erasure-metadata.go, erasure-metadata-utils.go).
+
+Given per-disk FileInfo reads (some failed), agree on the authoritative
+version: latest common mod-time, then majority vote over a content hash of
+(parts, distribution), requiring >= read quorum, exactly as
+findFileInfoInQuorum (cmd/erasure-metadata.go:229-270).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import zlib
+from collections import Counter
+
+from ..storage import errors as serrors
+from ..storage.datatypes import FileInfo
+from .interface import ReadQuorumError, WriteQuorumError
+
+
+def hash_order(key: str, cardinality: int) -> list[int]:
+    """Deterministic disk ordering for an object
+    (cmd/erasure-metadata-utils.go:100-114, CRC32-IEEE based)."""
+    if cardinality <= 0:
+        return []
+    key_crc = zlib.crc32(key.encode()) & 0xFFFFFFFF
+    start = key_crc % cardinality
+    return [1 + ((start + i) % cardinality) for i in range(1, cardinality + 1)]
+
+
+def object_quorum_from_meta(fi: FileInfo) -> tuple[int, int]:
+    """(readQuorum, writeQuorum) per cmd/erasure-metadata.go:337-359."""
+    data, parity = fi.erasure.data_blocks, fi.erasure.parity_blocks
+    write = data
+    if data == parity:
+        write += 1
+    return data, write
+
+
+def _meta_hash(fi: FileInfo) -> str:
+    h = hashlib.sha256()
+    for part in fi.parts:
+        h.update(f"part.{part.number}".encode())
+    h.update(str(fi.erasure.distribution).encode())
+    h.update(fi.data_dir.encode())
+    h.update(b"1" if fi.deleted else b"0")
+    return h.hexdigest()
+
+
+def find_latest_mod_time(fis: list[FileInfo | None]) -> int:
+    """commonTime: the mod-time shared by the most disks
+    (cmd/erasure-metadata.go commonTime)."""
+    times = Counter(fi.mod_time for fi in fis if fi is not None)
+    if not times:
+        return 0
+    # max count wins; ties break to the later time
+    best = max(times.items(), key=lambda kv: (kv[1], kv[0]))
+    return best[0]
+
+
+def find_file_info_in_quorum(fis: list[FileInfo | None],
+                             quorum: int) -> FileInfo:
+    """Pick the FileInfo agreed by >= quorum disks
+    (cmd/erasure-metadata.go:229)."""
+    mod_time = find_latest_mod_time(fis)
+    hashes: list[str | None] = []
+    for fi in fis:
+        if fi is not None and fi.mod_time == mod_time:
+            hashes.append(_meta_hash(fi))
+        else:
+            hashes.append(None)
+    counts = Counter(h for h in hashes if h)
+    if not counts:
+        raise ReadQuorumError("no valid metadata")
+    best_hash, best_count = counts.most_common(1)[0]
+    if best_count < quorum:
+        raise ReadQuorumError(
+            f"metadata agreement {best_count} < quorum {quorum}")
+    for fi, h in zip(fis, hashes):
+        if h == best_hash:
+            return fi
+    raise ReadQuorumError("unreachable")  # pragma: no cover
+
+
+def reduce_errs(errs: list[Exception | None], quorum: int,
+                quorum_error: type[Exception]) -> None:
+    """reduceQuorumErrs (cmd/erasure-metadata-utils.go): raise the majority
+    error if >= quorum disks failed identically; raise quorum_error if
+    successes fall short of quorum."""
+    ok = sum(1 for e in errs if e is None)
+    if ok >= quorum:
+        return
+    kinds = Counter(type(e).__name__ for e in errs if e is not None)
+    if kinds:
+        name, count = kinds.most_common(1)[0]
+        if count >= quorum:
+            for e in errs:
+                if e is not None and type(e).__name__ == name:
+                    raise e
+    raise quorum_error(f"{ok} successes < quorum {quorum}: "
+                       f"{[str(e) for e in errs if e]}")
+
+
+def shuffle_disks(disks: list, distribution: list[int]) -> list:
+    """Place disks into distribution order (shuffleDisks,
+    cmd/erasure-metadata-utils.go): shuffled[dist[i]-1] = disks[i]."""
+    if not distribution:
+        return list(disks)
+    shuffled = [None] * len(disks)
+    for i, d in enumerate(disks):
+        shuffled[distribution[i] - 1] = d
+    return shuffled
+
+
+def shuffle_parts_metadata(parts_meta: list, distribution: list[int]) -> list:
+    if not distribution:
+        return list(parts_meta)
+    shuffled = [None] * len(parts_meta)
+    for i, p in enumerate(parts_meta):
+        shuffled[distribution[i] - 1] = p
+    return shuffled
+
+
+__all__ = [
+    "hash_order", "object_quorum_from_meta", "find_file_info_in_quorum",
+    "find_latest_mod_time", "reduce_errs", "shuffle_disks",
+    "shuffle_parts_metadata", "ReadQuorumError", "WriteQuorumError",
+    "serrors",
+]

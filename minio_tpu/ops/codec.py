@@ -139,7 +139,8 @@ class Erasure:
         concatenated per-block layout matches block-by-block encode_data.
         """
         buf = np.frombuffer(bytes(data), dtype=np.uint8) \
-            if not isinstance(data, np.ndarray) else np.asarray(data, np.uint8)
+            if not isinstance(data, np.ndarray) \
+            else np.asarray(data, np.uint8).ravel()
         total = buf.size
         k, m = self.data_blocks, self.parity_blocks
         if total == 0:

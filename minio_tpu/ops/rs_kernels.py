@@ -88,23 +88,29 @@ def apply_matrix(M: np.ndarray, shards: np.ndarray | jax.Array) -> np.ndarray:
     squeeze = getattr(shards, "ndim", 3) == 2
     if squeeze:
         shards = shards[None]
-    shards = np.asarray(shards, dtype=np.uint8)
+    on_device = isinstance(shards, jax.Array)
+    if not on_device:
+        shards = np.asarray(shards, dtype=np.uint8)
     B, k, n = shards.shape
     # Bucket both variable axes so the jit cache stays small and tiles stay
     # full: byte axis padded to a lane multiple, batch axis chunked to
-    # _MAX_BATCH and padded to the next power of two.
+    # _MAX_BATCH and padded to the next power of two.  Device-resident
+    # input stays on device (no host round trip); all chunks are
+    # dispatched before any result is pulled back, so XLA overlaps MXU
+    # work with D2H transfer.
+    xp = jnp if on_device else np
     pad_n = (-n) % _LANES
     if pad_n:
-        shards = np.pad(shards, ((0, 0), (0, 0), (0, pad_n)))
-    chunks = []
+        shards = xp.pad(shards, ((0, 0), (0, 0), (0, pad_n)))
+    handles = []
     for off in range(0, B, _MAX_BATCH):
         chunk = shards[off: off + _MAX_BATCH]
         b = chunk.shape[0]
         bb = 1 << (b - 1).bit_length()  # next power of two
         if bb != b:
-            chunk = np.pad(chunk, ((0, bb - b), (0, 0), (0, 0)))
-        out = _gf2_apply(mb, jnp.asarray(chunk))
-        chunks.append(np.asarray(out[:b]))
+            chunk = xp.pad(chunk, ((0, bb - b), (0, 0), (0, 0)))
+        handles.append((_gf2_apply(mb, jnp.asarray(chunk)), b))
+    chunks = [np.asarray(out[:b]) for out, b in handles]
     res = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
     if pad_n:
         res = res[..., :n]
@@ -150,6 +156,8 @@ def reconstruct(shards: list[np.ndarray | None], data_blocks: int,
                 matrix: np.ndarray | None = None) -> list[np.ndarray]:
     """TPU-backed equivalent of gf8_ref.reconstruct (one stripe)."""
     total = data_blocks + parity_blocks
+    if len(shards) != total:
+        raise ValueError("wrong shard count")
     present = [i for i, s in enumerate(shards)
                if s is not None and len(s) > 0]
     if len(present) < data_blocks:

@@ -1,0 +1,105 @@
+"""Multi-chip erasure coding over a jax.sharding.Mesh.
+
+MinIO's parallelism axes (SURVEY.md §2.3) mapped onto a TPU device mesh:
+
+  * ``stripe`` axis — object/stripe batch parallelism (the DP analog; the
+    reference hashes objects across erasure sets, cmd/erasure-sets.go:629)
+  * ``shard`` axis  — shard parallelism (the TP analog; the reference writes
+    k+m shards concurrently, goroutine-per-drive, cmd/erasure-encode.go:36)
+
+Within the ``shard`` axis each device holds a contiguous slice of the k data
+shards and the matching columns of the GF(2) coefficient matrix.  It computes
+a partial integer matmul; a ``psum`` over the shard axis then XOR-combines
+partials (sum mod 2 == XOR for bit operands), so the collective rides ICI as
+one int32 all-reduce.  This is the device-native equivalent of the
+reference's fan-out/fan-in over drive goroutines.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from minio_tpu.ops import gf8
+
+
+def make_mesh(devices=None, stripe: int | None = None,
+              shard: int | None = None) -> Mesh:
+    """Build a ('stripe', 'shard') mesh over the given (or all) devices."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if shard is None:
+        shard = 1 if stripe is None else n // stripe
+    if stripe is None:
+        stripe = n // shard
+    assert stripe * shard == n, (stripe, shard, n)
+    dev = np.array(devices).reshape(stripe, shard)
+    return Mesh(dev, axis_names=("stripe", "shard"))
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_apply(mesh: Mesh, n_rows: int, k: int):
+    """Compiled sharded kernel: (8r, 8k) matrix x (B, k, n) shards.
+
+    Matrix columns and data shards are split over the ``shard`` mesh axis,
+    stripes over ``stripe``; partial products XOR-reduce via psum.
+    """
+
+    def local(mat, data):
+        # mat: (8r, 8k/S) int8;  data: (B/T, k/S, n) uint8
+        b, kl, n = data.shape
+        shifts = jnp.arange(8, dtype=jnp.uint8)
+        bits = ((data[:, :, None, :] >> shifts[None, None, :, None]) & 1)
+        bits = bits.reshape(b, 8 * kl, n).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            mat, bits, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)          # (8r, B/T, n)
+        acc = jax.lax.psum(acc, "shard")               # XOR fan-in over ICI
+        par = (acc & 1).astype(jnp.uint8)
+        par = par.reshape(n_rows // 8, 8, b, n)
+        weights = (jnp.uint8(1) << shifts)[None, :, None, None]
+        packed = (par * weights).sum(axis=1, dtype=jnp.uint8)
+        return packed.transpose(1, 0, 2)               # (B/T, r, n)
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(None, "shard"), P("stripe", "shard", None)),
+        out_specs=P("stripe", None, None))
+    return jax.jit(fn)
+
+
+def distributed_apply(mesh: Mesh, M: np.ndarray,
+                      shards: np.ndarray) -> jax.Array:
+    """out[b] = M (GF) @ shards[b], sharded over the mesh.
+
+    M: (r, k) GF coefficients;  shards: (B, k, n) uint8 with B divisible by
+    the stripe axis and k by the shard axis.  Returns device array (B, r, n).
+    """
+    M2 = jnp.asarray(gf8.gf2_expand(np.asarray(M, dtype=np.uint8)), jnp.int8)
+    fn = _sharded_apply(mesh, M2.shape[0], shards.shape[1])
+    return fn(M2, jnp.asarray(shards, dtype=jnp.uint8))
+
+
+def distributed_encode(mesh: Mesh, data_blocks: int, parity_blocks: int,
+                       shards: np.ndarray) -> jax.Array:
+    """Parity for a batch of stripes, sharded over ('stripe', 'shard')."""
+    M = gf8.rs_matrix(data_blocks, data_blocks + parity_blocks)
+    return distributed_apply(mesh, np.asarray(M)[data_blocks:], shards)
+
+
+def distributed_reconstruct(mesh: Mesh, data_blocks: int, parity_blocks: int,
+                            surviving: np.ndarray, present: list[int],
+                            wanted: list[int]) -> jax.Array:
+    """Rebuild ``wanted`` shards from k survivors, sharded over the mesh.
+
+    surviving: (B, k, n) rows ordered by ``present``.  The tiny GF solve runs
+    on host (gf8.gf_mat_inv); the heavy matmul is device-sharded.
+    """
+    from minio_tpu.ops import rs_kernels
+    M = gf8.rs_matrix(data_blocks, data_blocks + parity_blocks)
+    rows = rs_kernels.decode_rows(M, data_blocks, list(present), list(wanted))
+    return distributed_apply(mesh, rows, surviving)

@@ -1,0 +1,162 @@
+"""StorageAPI — the per-drive contract (cmd/storage-interface.go:25).
+
+Every drive (local posix dir today, remote RPC later) implements this
+surface.  The object layer only talks to drives through it, which is what
+makes fault injection (FaultyDisk), the disk-id check decorator, and the
+remote storage client drop-in replacements, as in the reference.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from .datatypes import FileInfo
+
+
+@dataclass
+class DiskInfo:
+    """cmd/storage-datatypes.go DiskInfo."""
+    total: int = 0
+    free: int = 0
+    used: int = 0
+    free_inodes: int = 0
+    fs_type: str = ""
+    root_disk: bool = False
+    healing: bool = False
+    endpoint: str = ""
+    mount_path: str = ""
+    disk_id: str = ""
+    error: str = ""
+
+
+@dataclass
+class VolInfo:
+    name: str
+    created: int = 0  # unix ns
+
+
+@dataclass
+class FilesInfo:
+    files: list[FileInfo] = field(default_factory=list)
+    is_truncated: bool = False
+
+
+class StorageAPI(abc.ABC):
+    """Abstract drive (cmd/storage-interface.go:25-92)."""
+
+    # -- identity / health -------------------------------------------------
+
+    @abc.abstractmethod
+    def is_online(self) -> bool: ...
+
+    @abc.abstractmethod
+    def endpoint(self) -> str: ...
+
+    @abc.abstractmethod
+    def is_local(self) -> bool: ...
+
+    @abc.abstractmethod
+    def get_disk_id(self) -> str: ...
+
+    @abc.abstractmethod
+    def set_disk_id(self, disk_id: str) -> None: ...
+
+    @abc.abstractmethod
+    def disk_info(self) -> DiskInfo: ...
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+    # -- volume ops --------------------------------------------------------
+
+    @abc.abstractmethod
+    def make_vol(self, volume: str) -> None: ...
+
+    @abc.abstractmethod
+    def list_vols(self) -> list[VolInfo]: ...
+
+    @abc.abstractmethod
+    def stat_vol(self, volume: str) -> VolInfo: ...
+
+    @abc.abstractmethod
+    def delete_vol(self, volume: str, force: bool = False) -> None: ...
+
+    # -- file ops ----------------------------------------------------------
+
+    @abc.abstractmethod
+    def list_dir(self, volume: str, dir_path: str,
+                 count: int = -1) -> list[str]: ...
+
+    @abc.abstractmethod
+    def read_all(self, volume: str, path: str) -> bytes: ...
+
+    @abc.abstractmethod
+    def write_all(self, volume: str, path: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def create_file(self, volume: str, path: str, data: bytes,
+                    file_size: int = -1) -> None: ...
+
+    @abc.abstractmethod
+    def append_file(self, volume: str, path: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def read_file_stream(self, volume: str, path: str, offset: int,
+                         length: int) -> bytes: ...
+
+    @abc.abstractmethod
+    def rename_file(self, src_volume: str, src_path: str,
+                    dst_volume: str, dst_path: str) -> None: ...
+
+    @abc.abstractmethod
+    def delete(self, volume: str, path: str, recursive: bool = False) -> None: ...
+
+    @abc.abstractmethod
+    def stat_info_file(self, volume: str, path: str) -> int:
+        """Size of a file; FileNotFound if missing."""
+
+    # -- metadata (xl.meta journal) ops ------------------------------------
+
+    @abc.abstractmethod
+    def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
+                    dst_volume: str, dst_path: str) -> None:
+        """Atomic commit: move tmp data dir + merge version into xl.meta
+        (cmd/xl-storage.go:1965 RenameData)."""
+
+    @abc.abstractmethod
+    def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None: ...
+
+    @abc.abstractmethod
+    def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None: ...
+
+    @abc.abstractmethod
+    def read_version(self, volume: str, path: str,
+                     version_id: str | None = None,
+                     read_data: bool = False) -> FileInfo: ...
+
+    @abc.abstractmethod
+    def list_versions(self, volume: str, path: str) -> list[FileInfo]: ...
+
+    @abc.abstractmethod
+    def delete_version(self, volume: str, path: str, fi: FileInfo,
+                       force_del_marker: bool = False) -> None: ...
+
+    # -- integrity ---------------------------------------------------------
+
+    @abc.abstractmethod
+    def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
+        """Full bitrot verification of all parts
+        (cmd/xl-storage.go:2305 VerifyFile); raises FileCorrupt."""
+
+    @abc.abstractmethod
+    def check_parts(self, volume: str, path: str, fi: FileInfo) -> None:
+        """Part files exist with expected sizes (CheckParts)."""
+
+    # -- walking (listing support) ----------------------------------------
+
+    @abc.abstractmethod
+    def walk_dir(self, volume: str, base_dir: str = "",
+                 recursive: bool = True) -> Iterable[str]:
+        """Yield object meta paths under a prefix (cmd/metacache-walk.go)."""

@@ -1,0 +1,116 @@
+"""Fault-injection drive wrappers (test + chaos tooling).
+
+Mirrors the reference's deterministic fault injection:
+  * naughtyDisk (cmd/naughty-disk_test.go:29-44): programmed error on the
+    Nth StorageAPI call, pass-through otherwise;
+  * badDisk: every call fails (cmd/erasure-heal_test.go badDisk).
+Lives in the main package (not tests/) so the heal/chaos CLIs can use it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from . import errors
+from .api import StorageAPI
+
+_METHODS = [
+    "disk_info", "make_vol", "list_vols", "stat_vol", "delete_vol",
+    "list_dir", "read_all", "write_all", "create_file", "append_file",
+    "read_file_stream", "rename_file", "delete", "stat_info_file",
+    "rename_data", "write_metadata", "update_metadata", "read_version",
+    "list_versions", "delete_version", "verify_file", "check_parts",
+    "walk_dir",
+]
+
+
+class NaughtyDisk(StorageAPI):
+    """Returns programmed errors per call number (1-based), then a default
+    error once past the program (or passes through if default is None)."""
+
+    def __init__(self, disk: StorageAPI,
+                 errs: Optional[dict[int, Exception]] = None,
+                 default_err: Optional[Exception] = None):
+        self._disk = disk
+        self._errs = errs or {}
+        self._default = default_err
+        self._call_nr = 0
+        self._mu = threading.Lock()
+
+    def _maybe_fail(self):
+        with self._mu:
+            self._call_nr += 1
+            n = self._call_nr
+        if n in self._errs:
+            raise self._errs[n]
+        if self._default is not None and self._errs \
+                and n > max(self._errs):
+            raise self._default
+
+    def is_online(self) -> bool:
+        return self._disk.is_online()
+
+    def endpoint(self) -> str:
+        return self._disk.endpoint()
+
+    def is_local(self) -> bool:
+        return self._disk.is_local()
+
+    def get_disk_id(self) -> str:
+        return self._disk.get_disk_id()
+
+    def set_disk_id(self, disk_id: str) -> None:
+        self._disk.set_disk_id(disk_id)
+
+    def close(self) -> None:
+        self._disk.close()
+
+
+class BadDisk(StorageAPI):
+    """Every call raises FaultyDisk (badDisk in cmd/erasure-heal_test.go)."""
+
+    def __init__(self, disk: Optional[StorageAPI] = None):
+        self._disk = disk
+
+    def is_online(self) -> bool:
+        return False
+
+    def endpoint(self) -> str:
+        return self._disk.endpoint() if self._disk else "bad-disk"
+
+    def is_local(self) -> bool:
+        return True
+
+    def get_disk_id(self) -> str:
+        return ""
+
+    def set_disk_id(self, disk_id: str) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+def _passthrough(name):
+    def call(self, *a, **kw):
+        self._maybe_fail()
+        return getattr(self._disk, name)(*a, **kw)
+    call.__name__ = name
+    return call
+
+
+def _alwaysfail(name):
+    def call(self, *a, **kw):
+        raise errors.FaultyDisk(name)
+    call.__name__ = name
+    return call
+
+
+for _m in _METHODS:
+    setattr(NaughtyDisk, _m, _passthrough(_m))
+    setattr(BadDisk, _m, _alwaysfail(_m))
+del _m
+# generated methods satisfy the ABC contract; clear the frozen abstract set
+NaughtyDisk.__abstractmethods__ = frozenset()
+BadDisk.__abstractmethods__ = frozenset()

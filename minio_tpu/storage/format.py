@@ -1,0 +1,106 @@
+"""Drive format & identity — format.json (cmd/format-erasure.go:109-122).
+
+Each drive stores a format.json naming the deployment, its erasure-set
+topology (sets x drives grid of disk UUIDs) and this drive's own UUID; at
+startup the set layer verifies every connected drive is where the format
+says it should be (waitForFormatErasure, cmd/prepare-storage.go:348).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid
+from dataclasses import dataclass, field
+
+from . import errors
+from .xl_storage import SYS_DIR, XLStorage
+
+FORMAT_FILE = "format.json"
+FORMAT_BACKEND = "erasure-tpu"
+FORMAT_VERSION = "1"
+DISTRIBUTION_ALGO_V3 = "SIPMOD+PARITY"  # sipHashMod (cmd/erasure-sets.go:629)
+
+
+@dataclass
+class FormatErasure:
+    """formatErasureV3 equivalent."""
+    version: str = FORMAT_VERSION
+    backend: str = FORMAT_BACKEND
+    id: str = ""                    # deployment id
+    this: str = ""                  # this drive's uuid
+    sets: list[list[str]] = field(default_factory=list)
+    distribution_algo: str = DISTRIBUTION_ALGO_V3
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "version": self.version, "format": self.backend, "id": self.id,
+            "erasure": {"this": self.this, "sets": self.sets,
+                        "distributionAlgo": self.distribution_algo}},
+            indent=1)
+
+    @classmethod
+    def from_json(cls, s: str | bytes) -> "FormatErasure":
+        try:
+            d = json.loads(s)
+            ec = d["erasure"]
+            return cls(version=d["version"], backend=d["format"],
+                       id=d.get("id", ""), this=ec["this"],
+                       sets=ec["sets"],
+                       distribution_algo=ec.get("distributionAlgo",
+                                                DISTRIBUTION_ALGO_V3))
+        except (json.JSONDecodeError, KeyError, TypeError) as e:
+            raise errors.CorruptedFormat(str(e)) from e
+
+
+def read_format(disk: XLStorage) -> FormatErasure:
+    try:
+        buf = disk.read_all(SYS_DIR, FORMAT_FILE)
+    except errors.FileNotFound:
+        raise errors.UnformattedDisk(disk.endpoint()) from None
+    return FormatErasure.from_json(buf)
+
+
+def save_format(disk: XLStorage, fmt: FormatErasure) -> None:
+    disk.write_all(SYS_DIR, FORMAT_FILE, fmt.to_json().encode())
+
+
+def init_format_erasure(disks: list[XLStorage], set_count: int,
+                        set_drive_count: int,
+                        deployment_id: str | None = None) -> FormatErasure:
+    """Format a fresh layout: mint drive UUIDs, write per-drive format.json
+    (initFormatErasure, cmd/format-erasure.go:770)."""
+    deployment_id = deployment_id or str(uuid.uuid4())
+    sets = [[str(uuid.uuid4()) for _ in range(set_drive_count)]
+            for _ in range(set_count)]
+    ref = FormatErasure(id=deployment_id, sets=sets)
+    assert len(disks) == set_count * set_drive_count
+    for i, disk in enumerate(disks):
+        fmt = FormatErasure(id=deployment_id, sets=sets,
+                            this=sets[i // set_drive_count][i % set_drive_count])
+        save_format(disk, fmt)
+        disk.set_disk_id(fmt.this)
+    return ref
+
+
+def load_or_init_format(disks: list[XLStorage], set_count: int,
+                        set_drive_count: int) -> FormatErasure:
+    """waitForFormatErasure single-node analog: load when formatted,
+    initialize when all drives are fresh, error on mixed/corrupt."""
+    fmts: list[FormatErasure | None] = []
+    for d in disks:
+        try:
+            fmts.append(read_format(d))
+        except errors.UnformattedDisk:
+            fmts.append(None)
+    if all(f is None for f in fmts):
+        return init_format_erasure(disks, set_count, set_drive_count)
+    ref = next(f for f in fmts if f is not None)
+    for d, f in zip(disks, fmts):
+        if f is None:
+            continue  # fresh replacement drive: healed later
+        if f.id != ref.id:
+            raise errors.CorruptedFormat(
+                f"deployment id mismatch on {d.endpoint()}")
+        d.set_disk_id(f.this)
+    return ref

@@ -1,0 +1,433 @@
+"""xlStorage — local posix drive backend (cmd/xl-storage.go).
+
+Layout per drive root:
+
+    <root>/.mt.sys/format.json          drive identity (cmd/format-erasure.go)
+    <root>/.mt.sys/tmp/<uuid>/...       staging area for in-flight writes
+    <root>/<bucket>/<object>/xl.meta    version journal (xl_meta.py)
+    <root>/<bucket>/<object>/<ddir>/part.N   erasure shard files (bitrot framed)
+
+Write path is stage-then-commit: shard files land in tmp, ``rename_data``
+atomically renames the data dir into place and rewrites xl.meta via
+tmp+rename (the reference's CreateFile + RenameData contract,
+cmd/xl-storage.go:1568,1965).  Durability uses fsync on commit instead of
+the reference's O_DIRECT; the batched TPU pipeline writes whole shard files
+at once so page-cache writeback, not alignment, is the governing factor.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import stat as stat_mod
+import time
+import uuid
+from typing import Iterable
+
+from . import errors
+from .api import DiskInfo, FilesInfo, StorageAPI, VolInfo
+from .datatypes import FileInfo
+from .xl_meta import XLMeta
+
+SYS_DIR = ".mt.sys"
+TMP_DIR = os.path.join(SYS_DIR, "tmp")
+META_FILE = "xl.meta"
+_RESERVED = {SYS_DIR}
+
+
+def _is_valid_volname(volume: str) -> bool:
+    return (len(volume) >= 3 if not volume.startswith(".mt.sys")
+            else True) and "/" not in volume and volume not in ("", ".", "..")
+
+
+class XLStorage(StorageAPI):
+    """One local drive."""
+
+    def __init__(self, root: str, endpoint: str | None = None):
+        self.root = os.path.abspath(root)
+        self._endpoint = endpoint or self.root
+        self._disk_id = ""
+        if not os.path.isdir(self.root):
+            raise errors.DiskNotFound(self.root)
+        os.makedirs(os.path.join(self.root, TMP_DIR), exist_ok=True)
+
+    # -- identity / health -------------------------------------------------
+
+    def is_online(self) -> bool:
+        return os.path.isdir(self.root)
+
+    def endpoint(self) -> str:
+        return self._endpoint
+
+    def is_local(self) -> bool:
+        return True
+
+    def get_disk_id(self) -> str:
+        return self._disk_id
+
+    def set_disk_id(self, disk_id: str) -> None:
+        self._disk_id = disk_id
+
+    def disk_info(self) -> DiskInfo:
+        st = os.statvfs(self.root)
+        total = st.f_blocks * st.f_frsize
+        free = st.f_bavail * st.f_frsize
+        return DiskInfo(total=total, free=free, used=total - free,
+                        free_inodes=st.f_favail, endpoint=self._endpoint,
+                        mount_path=self.root, disk_id=self._disk_id)
+
+    def close(self) -> None:
+        pass
+
+    # -- path helpers ------------------------------------------------------
+
+    def _vol_path(self, volume: str) -> str:
+        if not _is_valid_volname(volume):
+            raise errors.VolumeNotFound(volume)
+        return os.path.join(self.root, volume)
+
+    def _file_path(self, volume: str, path: str) -> str:
+        vol = self._vol_path(volume)
+        full = os.path.normpath(os.path.join(vol, path))
+        if not full.startswith(vol + os.sep) and full != vol:
+            raise errors.FileAccessDenied(path)  # path traversal guard
+        return full
+
+    def _check_vol(self, volume: str) -> str:
+        p = self._vol_path(volume)
+        if not os.path.isdir(p):
+            raise errors.VolumeNotFound(volume)
+        return p
+
+    # -- volume ops --------------------------------------------------------
+
+    def make_vol(self, volume: str) -> None:
+        p = self._vol_path(volume)
+        if os.path.isdir(p):
+            raise errors.VolumeExists(volume)
+        try:
+            os.makedirs(p)
+        except PermissionError as e:
+            raise errors.DiskAccessDenied(str(e)) from e
+
+    def list_vols(self) -> list[VolInfo]:
+        out = []
+        for name in sorted(os.listdir(self.root)):
+            if name in _RESERVED or not os.path.isdir(
+                    os.path.join(self.root, name)):
+                continue
+            st = os.stat(os.path.join(self.root, name))
+            out.append(VolInfo(name, int(st.st_ctime * 1e9)))
+        return out
+
+    def stat_vol(self, volume: str) -> VolInfo:
+        p = self._check_vol(volume)
+        st = os.stat(p)
+        return VolInfo(volume, int(st.st_ctime * 1e9))
+
+    def delete_vol(self, volume: str, force: bool = False) -> None:
+        p = self._check_vol(volume)
+        if force:
+            shutil.rmtree(p)
+            return
+        try:
+            os.rmdir(p)
+        except OSError as e:
+            raise errors.VolumeNotEmpty(volume) from e
+
+    # -- plain file ops ----------------------------------------------------
+
+    def list_dir(self, volume: str, dir_path: str, count: int = -1) -> list[str]:
+        base = self._file_path(volume, dir_path)
+        self._check_vol(volume)
+        try:
+            names = []
+            with os.scandir(base) as it:
+                for e in it:
+                    names.append(e.name + "/" if e.is_dir() else e.name)
+                    if 0 < count <= len(names):
+                        break
+            return sorted(names)
+        except FileNotFoundError:
+            raise errors.FileNotFound(dir_path) from None
+        except NotADirectoryError:
+            raise errors.FileNotFound(dir_path) from None
+
+    def read_all(self, volume: str, path: str) -> bytes:
+        full = self._file_path(volume, path)
+        self._check_vol(volume)
+        try:
+            with open(full, "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise errors.FileNotFound(path) from None
+        except IsADirectoryError:
+            raise errors.FileNotFound(path) from None
+        except PermissionError as e:
+            raise errors.FileAccessDenied(path) from e
+
+    def write_all(self, volume: str, path: str, data: bytes) -> None:
+        full = self._file_path(volume, path)
+        self._check_vol(volume)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        tmp = full + f".tmp.{uuid.uuid4().hex[:8]}"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, full)
+
+    def create_file(self, volume: str, path: str, data: bytes,
+                    file_size: int = -1) -> None:
+        """Whole shard-file write (batched pipeline hands us the complete
+        framed file; the reference streams through O_DIRECT,
+        cmd/xl-storage.go:1568)."""
+        if file_size >= 0 and len(data) != file_size:
+            raise errors.FileCorrupt(
+                f"size mismatch: {len(data)} != {file_size}")
+        self.write_all(volume, path, data)
+
+    def append_file(self, volume: str, path: str, data: bytes) -> None:
+        full = self._file_path(volume, path)
+        self._check_vol(volume)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        with open(full, "ab") as f:
+            f.write(data)
+
+    def read_file_stream(self, volume: str, path: str, offset: int,
+                         length: int) -> bytes:
+        full = self._file_path(volume, path)
+        try:
+            with open(full, "rb") as f:
+                f.seek(offset)
+                data = f.read(length)
+        except FileNotFoundError:
+            raise errors.FileNotFound(path) from None
+        except PermissionError as e:
+            raise errors.FileAccessDenied(path) from e
+        if len(data) < length:
+            raise errors.FileCorrupt(
+                f"short read {len(data)} < {length} at {path}")
+        return data
+
+    def rename_file(self, src_volume: str, src_path: str,
+                    dst_volume: str, dst_path: str) -> None:
+        src = self._file_path(src_volume, src_path)
+        dst = self._file_path(dst_volume, dst_path)
+        self._check_vol(src_volume)
+        self._check_vol(dst_volume)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        try:
+            os.replace(src, dst)
+        except FileNotFoundError:
+            raise errors.FileNotFound(src_path) from None
+
+    def delete(self, volume: str, path: str, recursive: bool = False) -> None:
+        full = self._file_path(volume, path)
+        self._check_vol(volume)
+        try:
+            if os.path.isdir(full):
+                if recursive:
+                    shutil.rmtree(full)
+                else:
+                    os.rmdir(full)
+            else:
+                os.remove(full)
+        except FileNotFoundError:
+            raise errors.FileNotFound(path) from None
+        except OSError as e:
+            raise errors.PathNotEmpty(path) from e
+        # prune now-empty parent dirs up to the volume root (deleteFile)
+        parent = os.path.dirname(full)
+        vol = self._vol_path(volume)
+        while parent != vol:
+            try:
+                os.rmdir(parent)
+            except OSError:
+                break
+            parent = os.path.dirname(parent)
+
+    def stat_info_file(self, volume: str, path: str) -> int:
+        full = self._file_path(volume, path)
+        try:
+            st = os.stat(full)
+        except FileNotFoundError:
+            raise errors.FileNotFound(path) from None
+        if not stat_mod.S_ISREG(st.st_mode):
+            raise errors.IsNotRegular(path)
+        return st.st_size
+
+    # -- xl.meta ops -------------------------------------------------------
+
+    def _meta_path(self, volume: str, path: str) -> str:
+        return self._file_path(volume, os.path.join(path, META_FILE))
+
+    def _read_meta(self, volume: str, path: str) -> XLMeta:
+        try:
+            buf = self.read_all(volume, os.path.join(path, META_FILE))
+        except errors.FileNotFound:
+            raise errors.FileNotFound(f"{volume}/{path}") from None
+        return XLMeta.load(buf)
+
+    def _write_meta(self, volume: str, path: str, meta: XLMeta) -> None:
+        self.write_all(volume, os.path.join(path, META_FILE), meta.dump())
+
+    def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
+                    dst_volume: str, dst_path: str) -> None:
+        """Atomic commit (cmd/xl-storage.go:1965): move staged data dir from
+        tmp into the object path and merge the new version into xl.meta."""
+        src_dir = self._file_path(src_volume, src_path)
+        self._check_vol(src_volume)
+        self._check_vol(dst_volume)
+        dst_obj_dir = self._file_path(dst_volume, dst_path)
+        try:
+            meta = self._read_meta(dst_volume, dst_path)
+        except (errors.FileNotFound, errors.FileCorrupt):
+            meta = XLMeta()
+        # replaced version with an unshared data dir gets purged
+        old_ddir = ""
+        try:
+            old = meta.find(fi.version_id)
+            old_ddir = old.get("ddir", "")
+        except errors.FileVersionNotFound:
+            pass
+        meta.add_version(fi)
+        if fi.data_dir:
+            dst_data_dir = os.path.join(dst_obj_dir, fi.data_dir)
+            if not os.path.isdir(src_dir):
+                raise errors.FileNotFound(src_path)
+            os.makedirs(dst_obj_dir, exist_ok=True)
+            if os.path.isdir(dst_data_dir):
+                shutil.rmtree(dst_data_dir)
+            os.replace(src_dir, dst_data_dir)
+        else:
+            os.makedirs(dst_obj_dir, exist_ok=True)
+        self._write_meta(dst_volume, dst_path, meta)
+        if old_ddir and old_ddir != fi.data_dir \
+                and meta.shared_data_dir_count(fi.version_id, old_ddir) == 0:
+            shutil.rmtree(os.path.join(dst_obj_dir, old_ddir),
+                          ignore_errors=True)
+
+    def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        try:
+            meta = self._read_meta(volume, path)
+        except errors.FileNotFound:
+            meta = XLMeta()
+        meta.add_version(fi)
+        os.makedirs(self._file_path(volume, path), exist_ok=True)
+        self._write_meta(volume, path, meta)
+
+    def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        meta = self._read_meta(volume, path)
+        meta.find(fi.version_id)  # must exist
+        meta.add_version(fi)
+        self._write_meta(volume, path, meta)
+
+    def read_version(self, volume: str, path: str,
+                     version_id: str | None = None,
+                     read_data: bool = False) -> FileInfo:
+        meta = self._read_meta(volume, path)
+        fi = meta.to_fileinfo(volume, path, version_id)
+        return fi
+
+    def list_versions(self, volume: str, path: str) -> list[FileInfo]:
+        meta = self._read_meta(volume, path)
+        return meta.list_versions(volume, path)
+
+    def delete_version(self, volume: str, path: str, fi: FileInfo,
+                       force_del_marker: bool = False) -> None:
+        """Remove one version; delete markers write a new version instead
+        (cmd/xl-storage.go DeleteVersion semantics)."""
+        try:
+            meta = self._read_meta(volume, path)
+        except errors.FileNotFound:
+            if fi.deleted and force_del_marker:
+                self.write_metadata(volume, path, fi)
+                return
+            raise
+        if fi.deleted:
+            meta.add_version(fi)
+            self._write_meta(volume, path, meta)
+            return
+        ddir = meta.delete_version(fi.version_id)
+        obj_dir = self._file_path(volume, path)
+        if ddir and meta.shared_data_dir_count(fi.version_id, ddir) == 0:
+            shutil.rmtree(os.path.join(obj_dir, ddir), ignore_errors=True)
+        if meta.versions:
+            self._write_meta(volume, path, meta)
+        else:
+            # last version gone: remove xl.meta and prune the object path
+            self.delete(volume, os.path.join(path, META_FILE))
+
+    # -- integrity ---------------------------------------------------------
+
+    def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
+        from ..hashing import bitrot
+        ec = fi.erasure
+        for part in fi.parts:
+            pf = os.path.join(path, fi.data_dir, f"part.{part.number}")
+            ck = ec.get_checksum_info(part.number)
+            data = self.read_all(volume, pf)
+            shard_size = ec.shard_size()
+            if bitrot.is_streaming(ck.algorithm):
+                want = bitrot.bitrot_shard_file_size(
+                    ec.shard_file_size(part.size), shard_size, ck.algorithm)
+                if len(data) != want:
+                    raise errors.FileCorrupt(
+                        f"{pf}: size {len(data)} != {want}")
+                r = bitrot.StreamingBitrotReader(data, shard_size,
+                                                 ck.algorithm)
+                try:
+                    r.read_at(0, ec.shard_file_size(part.size))
+                except bitrot.BitrotError as e:
+                    raise errors.FileCorrupt(f"{pf}: {e}") from e
+            else:
+                if not bitrot.BitrotVerifier(ck.algorithm, ck.hash).verify(data):
+                    raise errors.FileCorrupt(pf)
+
+    def check_parts(self, volume: str, path: str, fi: FileInfo) -> None:
+        from ..hashing import bitrot
+        ec = fi.erasure
+        for part in fi.parts:
+            pf = os.path.join(path, fi.data_dir, f"part.{part.number}")
+            size = self.stat_info_file(volume, pf)
+            ck = ec.get_checksum_info(part.number)
+            want = bitrot.bitrot_shard_file_size(
+                ec.shard_file_size(part.size), ec.shard_size(), ck.algorithm)
+            if size != want:
+                raise errors.FileCorrupt(f"{pf}: size {size} != {want}")
+
+    # -- walking -----------------------------------------------------------
+
+    def walk_dir(self, volume: str, base_dir: str = "",
+                 recursive: bool = True) -> Iterable[str]:
+        """Yield object paths (dirs containing xl.meta) under base_dir,
+        lexically sorted (cmd/metacache-walk.go WalkDir)."""
+        vol = self._check_vol(volume)
+        base = self._file_path(volume, base_dir) if base_dir else vol
+
+        def walk(d: str):
+            try:
+                entries = sorted(os.scandir(d), key=lambda e: e.name)
+            except (FileNotFoundError, NotADirectoryError):
+                return
+            names = {e.name for e in entries}
+            if META_FILE in names:
+                yield os.path.relpath(d, vol).replace(os.sep, "/")
+                return
+            for e in entries:
+                if e.is_dir() and recursive:
+                    yield from walk(e.path)
+
+        yield from walk(base)
+
+    # -- staging helpers (used by the erasure object layer) ---------------
+
+    def tmp_dir(self) -> str:
+        """New unique staging dir; returned path is relative to the SYS_DIR
+        volume (use with volume=SYS_DIR in create_file/rename_data)."""
+        d = os.path.join("tmp", uuid.uuid4().hex)
+        os.makedirs(os.path.join(self.root, SYS_DIR, d), exist_ok=True)
+        return d
+
+    def clean_tmp(self, rel_dir: str) -> None:
+        shutil.rmtree(os.path.join(self.root, SYS_DIR, rel_dir),
+                      ignore_errors=True)

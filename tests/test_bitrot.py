@@ -1,0 +1,157 @@
+"""Bitrot layer tests: HighwayHash vectors, framing, verification.
+
+Mirrors cmd/bitrot_test.go (all algorithms round-trip) plus corruption
+detection semantics of cmd/bitrot-streaming.go:115-158.
+"""
+
+import io
+import struct
+
+import pytest
+
+from minio_tpu.hashing import bitrot, highwayhash as hh, siphash
+
+
+# -- HighwayHash: published test vectors (google/highwayhash), key
+#    0x0706...00, data bytes 0..n-1 --------------------------------------
+
+HH64_VECTORS = {
+    0: 0x907A56DE22C26E53,
+    1: 0x7EAB43AAC7CDDD78,
+    2: 0xB8D0569AB0B53D62,
+}
+HH_TEST_KEY = struct.pack("<4Q", 0x0706050403020100, 0x0F0E0D0C0B0A0908,
+                          0x1716151413121110, 0x1F1E1D1C1B1A1918)
+
+
+@pytest.mark.parametrize("n,want", sorted(HH64_VECTORS.items()))
+def test_hh64_vectors(n, want):
+    assert hh.hh64(bytes(range(n)), HH_TEST_KEY) == want
+
+
+def test_hh_c_matches_python():
+    import random
+    random.seed(1)
+    for n in [0, 1, 3, 15, 16, 17, 31, 32, 33, 63, 64, 65, 100, 4096]:
+        data = bytes(random.randrange(256) for _ in range(n))
+        assert hh.hh256(data) == hh._py_process(
+            hh.MAGIC_KEY, data).finalize256(), f"len {n}"
+        assert hh.hh64(data) == hh._py_process(
+            hh.MAGIC_KEY, data).finalize64(), f"len {n}"
+
+
+def test_hh_streaming_matches_oneshot():
+    data = bytes(range(256)) * 5
+    for splits in [(0,), (1,), (32,), (31, 33), (7, 40, 64, 100)]:
+        s = hh.HighwayHash256()
+        prev = 0
+        for cut in splits:
+            s.update(data[prev:cut])
+            prev = cut
+        s.update(data[prev:])
+        assert s.digest() == hh.hh256(data), splits
+
+
+def test_hh_blocks():
+    data = bytes(range(256)) * 10
+    got = hh.hh256_blocks(data, 100)
+    want = [hh.hh256(data[i:i + 100]) for i in range(0, len(data), 100)]
+    assert got == want
+
+
+# -- SipHash (paper vectors: key 000102..0f, data 00,01,..n-1) -----------
+
+SIP_KEY = bytes(range(16))
+SIP_VECTORS = {
+    0: 0x726FDB47DD0E0E31,
+    1: 0x74F839C593DC67FD,
+    8: 0x93F5F5799A932462,
+    15: 0xA129CA6149BE45E5,
+}
+
+
+@pytest.mark.parametrize("n,want", sorted(SIP_VECTORS.items()))
+def test_siphash_vectors(n, want):
+    assert siphash.siphash24(bytes(range(n)), SIP_KEY) == want
+    assert siphash._py_siphash24(
+        *struct.unpack("<2Q", SIP_KEY), bytes(range(n))) == want
+
+
+def test_sip_hash_mod():
+    idx = siphash.sip_hash_mod("bucket/object", 16, b"0123456789abcdef")
+    assert 0 <= idx < 16
+    # deterministic
+    assert idx == siphash.sip_hash_mod("bucket/object", 16,
+                                       b"0123456789abcdef")
+    assert siphash.sip_hash_mod("x", 0, b"0123456789abcdef") == -1
+
+
+# -- bitrot framing ------------------------------------------------------
+
+ALGOS = [bitrot.SHA256, bitrot.BLAKE2B512, bitrot.HIGHWAYHASH256,
+         bitrot.HIGHWAYHASH256S]
+
+
+@pytest.mark.parametrize("algo", ALGOS)
+def test_bitrot_roundtrip(algo):
+    data = bytes(range(256)) * 40  # 10240 bytes
+    shard_size = 1024
+    if bitrot.is_streaming(algo):
+        framed = bitrot.streaming_encode(data, shard_size, algo)
+        assert len(framed) == bitrot.bitrot_shard_file_size(
+            len(data), shard_size, algo)
+        r = bitrot.StreamingBitrotReader(framed, shard_size, algo)
+        assert r.read_at(0, len(data)) == data
+        assert r.read_at(2048, 1024) == data[2048:3072]
+    else:
+        sink = io.BytesIO()
+        w = bitrot.WholeBitrotWriter(sink, algo)
+        w.write(data)
+        assert sink.getvalue() == data
+        v = bitrot.BitrotVerifier(algo, w.sum())
+        assert v.verify(data)
+        assert not v.verify(data[:-1] + b"\x00")
+
+
+def test_streaming_corruption_detected():
+    data = bytes(range(256)) * 8
+    framed = bytearray(bitrot.streaming_encode(data, 512))
+    framed[40] ^= 0xFF  # corrupt a byte inside block 0's payload
+    r = bitrot.StreamingBitrotReader(bytes(framed), 512)
+    with pytest.raises(bitrot.BitrotError):
+        r.read_at(0, 512)
+    # other blocks still verify
+    assert r.read_at(512, 512) == data[512:1024]
+
+
+def test_streaming_truncation_detected():
+    data = b"x" * 1000
+    framed = bitrot.streaming_encode(data, 512)
+    r = bitrot.StreamingBitrotReader(framed[:-5], 512)
+    with pytest.raises(bitrot.BitrotError):
+        r.read_at(512, 488)
+
+
+def test_shard_file_size_math():
+    # ceil(size/shard)*32 + size (cmd/bitrot.go:140-145)
+    assert bitrot.bitrot_shard_file_size(1000, 512, bitrot.HIGHWAYHASH256S) \
+        == 2 * 32 + 1000
+    assert bitrot.bitrot_shard_file_size(1024, 512, bitrot.HIGHWAYHASH256S) \
+        == 2 * 32 + 1024
+    assert bitrot.bitrot_shard_file_size(0, 512, bitrot.HIGHWAYHASH256S) == 0
+    assert bitrot.bitrot_shard_file_size(1000, 512, bitrot.SHA256) == 1000
+
+
+def test_writer_framing_matches_encode():
+    data = bytes(range(200)) * 3
+    sink = io.BytesIO()
+    w = bitrot.StreamingBitrotWriter(sink)
+    for off in range(0, len(data), 128):
+        w.write(data[off:off + 128])
+    assert sink.getvalue() == bitrot.streaming_encode(data, 128)
+
+
+def test_magic_key_value():
+    # cmd/bitrot.go:31 — first bytes of the magic key
+    assert hh.MAGIC_KEY[:4] == b"\x4b\xe7\x34\xfa"
+    assert len(hh.MAGIC_KEY) == 32

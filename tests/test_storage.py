@@ -1,0 +1,247 @@
+"""Storage layer tests — posix drive, xl.meta journal, format, faults.
+
+Mirrors the storage tier of the reference test strategy (SURVEY.md §4:
+cmd/xl-storage_test.go, cmd/xl-storage-format_test.go,
+cmd/naughty-disk_test.go).
+"""
+
+import os
+
+import pytest
+
+from minio_tpu.storage import errors, format as fmt
+from minio_tpu.storage.datatypes import (ChecksumInfo, ErasureInfo, FileInfo,
+                                         ObjectPartInfo, now_ns)
+from minio_tpu.storage.faulty import BadDisk, NaughtyDisk
+from minio_tpu.storage.xl_meta import XLMeta
+from minio_tpu.storage.xl_storage import SYS_DIR, XLStorage
+
+
+@pytest.fixture
+def disk(tmp_path):
+    return XLStorage(str(tmp_path))
+
+
+def _fi(vid="", mod=None, ddir="d1", deleted=False):
+    return FileInfo(volume="b", name="o", version_id=vid, deleted=deleted,
+                    data_dir=ddir, mod_time=mod or now_ns(), size=100,
+                    erasure=ErasureInfo(data_blocks=2, parity_blocks=1,
+                                        block_size=1024, index=1,
+                                        distribution=[1, 2, 3]))
+
+
+# -- volumes ---------------------------------------------------------------
+
+def test_vol_lifecycle(disk):
+    disk.make_vol("bucket1")
+    with pytest.raises(errors.VolumeExists):
+        disk.make_vol("bucket1")
+    assert [v.name for v in disk.list_vols()] == ["bucket1"]
+    disk.stat_vol("bucket1")
+    with pytest.raises(errors.VolumeNotFound):
+        disk.stat_vol("nope")
+    disk.write_all("bucket1", "x/y", b"data")
+    with pytest.raises(errors.VolumeNotEmpty):
+        disk.delete_vol("bucket1")
+    disk.delete_vol("bucket1", force=True)
+    with pytest.raises(errors.VolumeNotFound):
+        disk.stat_vol("bucket1")
+
+
+def test_path_traversal_blocked(disk):
+    disk.make_vol("bkt")
+    with pytest.raises(errors.FileAccessDenied):
+        disk.read_all("bkt", "../../../etc/passwd")
+
+
+# -- plain files -----------------------------------------------------------
+
+def test_file_ops(disk):
+    disk.make_vol("bkt")
+    disk.write_all("bkt", "a/b/c.bin", b"hello")
+    assert disk.read_all("bkt", "a/b/c.bin") == b"hello"
+    assert disk.read_file_stream("bkt", "a/b/c.bin", 1, 3) == b"ell"
+    with pytest.raises(errors.FileCorrupt):
+        disk.read_file_stream("bkt", "a/b/c.bin", 0, 100)  # short read
+    with pytest.raises(errors.FileNotFound):
+        disk.read_all("bkt", "missing")
+    assert disk.stat_info_file("bkt", "a/b/c.bin") == 5
+    disk.append_file("bkt", "a/b/c.bin", b" world")
+    assert disk.read_all("bkt", "a/b/c.bin") == b"hello world"
+    disk.delete("bkt", "a/b/c.bin")
+    # parent dirs pruned back to the volume root
+    assert not os.path.exists(os.path.join(disk.root, "bkt", "a"))
+
+
+def test_create_file_size_check(disk):
+    disk.make_vol("bkt")
+    disk.create_file("bkt", "f", b"12345", file_size=5)
+    with pytest.raises(errors.FileCorrupt):
+        disk.create_file("bkt", "g", b"123", file_size=5)
+
+
+# -- xl.meta journal -------------------------------------------------------
+
+def test_xlmeta_roundtrip():
+    m = XLMeta()
+    f1 = _fi("v1", mod=100)
+    f2 = _fi("v2", mod=200, ddir="d2")
+    m.add_version(f1)
+    m.add_version(f2)
+    m2 = XLMeta.load(m.dump())
+    top = m2.to_fileinfo("b", "o")
+    assert top.version_id == "v2" and top.is_latest
+    old = m2.to_fileinfo("b", "o", "v1")
+    assert old.version_id == "v1" and not old.is_latest
+    assert old.num_versions == 2
+    with pytest.raises(errors.FileVersionNotFound):
+        m2.to_fileinfo("b", "o", "nope")
+
+
+def test_xlmeta_bad_magic():
+    with pytest.raises(errors.FileCorrupt):
+        XLMeta.load(b"garbage-not-xlmeta")
+
+
+def test_metadata_ops(disk):
+    disk.make_vol("bkt")
+    fi = _fi("v1")
+    disk.write_metadata("bkt", "obj", fi)
+    got = disk.read_version("bkt", "obj")
+    assert got.version_id == "v1"
+    assert got.erasure.data_blocks == 2
+    assert got.erasure.distribution == [1, 2, 3]
+    with pytest.raises(errors.FileNotFound):
+        disk.read_version("bkt", "missing")
+
+    # second version becomes latest
+    fi2 = _fi("v2", mod=fi.mod_time + 10, ddir="d2")
+    disk.write_metadata("bkt", "obj", fi2)
+    assert disk.read_version("bkt", "obj").version_id == "v2"
+    assert [f.version_id for f in disk.list_versions("bkt", "obj")] == \
+        ["v2", "v1"]
+
+    # delete specific version
+    disk.delete_version("bkt", "obj", fi)
+    assert [f.version_id for f in disk.list_versions("bkt", "obj")] == ["v2"]
+    # deleting the last version removes xl.meta entirely
+    disk.delete_version("bkt", "obj", fi2)
+    with pytest.raises(errors.FileNotFound):
+        disk.read_version("bkt", "obj")
+
+
+def test_rename_data_commit(disk):
+    disk.make_vol("bkt")
+    tmp = disk.tmp_dir()
+    disk.create_file(SYS_DIR, f"{tmp}/part.1", b"shard-bytes")
+    fi = _fi("v1", ddir="datadir1")
+    disk.rename_data(SYS_DIR, tmp, fi, "bkt", "obj")
+    assert disk.read_version("bkt", "obj").data_dir == "datadir1"
+    assert disk.read_all("bkt", "obj/datadir1/part.1") == b"shard-bytes"
+    # overwrite same version with new data dir purges the old one
+    tmp2 = disk.tmp_dir()
+    disk.create_file(SYS_DIR, f"{tmp2}/part.1", b"new-bytes")
+    fi2 = _fi("v1", mod=fi.mod_time + 5, ddir="datadir2")
+    disk.rename_data(SYS_DIR, tmp2, fi2, "bkt", "obj")
+    assert disk.read_all("bkt", "obj/datadir2/part.1") == b"new-bytes"
+    with pytest.raises(errors.FileNotFound):
+        disk.read_all("bkt", "obj/datadir1/part.1")
+
+
+def test_delete_marker(disk):
+    disk.make_vol("bkt")
+    fi = _fi("v1")
+    disk.write_metadata("bkt", "obj", fi)
+    dm = _fi("v2", mod=fi.mod_time + 10, ddir="", deleted=True)
+    disk.delete_version("bkt", "obj", dm)
+    top = disk.read_version("bkt", "obj")
+    assert top.deleted and top.version_id == "v2"
+    assert len(disk.list_versions("bkt", "obj")) == 2
+
+
+def test_walk_dir(disk):
+    disk.make_vol("bkt")
+    for name in ["a/obj1", "a/obj2", "b/c/obj3"]:
+        disk.write_metadata("bkt", name, _fi("v1"))
+    got = list(disk.walk_dir("bkt"))
+    assert got == ["a/obj1", "a/obj2", "b/c/obj3"]
+    assert list(disk.walk_dir("bkt", "b")) == ["b/c/obj3"]
+
+
+# -- bitrot-integrated verify ---------------------------------------------
+
+def test_verify_file(disk):
+    from minio_tpu.hashing import bitrot
+    disk.make_vol("bkt")
+    shard = bytes(range(256)) * 8  # 2048 bytes
+    ec = ErasureInfo(data_blocks=2, parity_blocks=1, block_size=4096,
+                     index=1, distribution=[1, 2, 3],
+                     checksums=[ChecksumInfo(1, bitrot.HIGHWAYHASH256S)])
+    # shard_size = ceil(4096/2) = 2048; one block
+    framed = bitrot.streaming_encode(shard, 2048)
+    fi = FileInfo(version_id="v1", data_dir="dd", mod_time=now_ns(),
+                  size=4096, erasure=ec,
+                  parts=[ObjectPartInfo(1, 4096, 4096)])
+    disk.write_all("bkt", "obj/dd/part.1", framed)
+    disk.write_metadata("bkt", "obj", fi)
+    disk.verify_file("bkt", "obj", fi)
+    disk.check_parts("bkt", "obj", fi)
+
+    # corrupt one byte -> FileCorrupt
+    bad = bytearray(framed)
+    bad[40] ^= 1
+    disk.write_all("bkt", "obj/dd/part.1", bytes(bad))
+    with pytest.raises(errors.FileCorrupt):
+        disk.verify_file("bkt", "obj", fi)
+    # truncation -> CheckParts fails
+    disk.write_all("bkt", "obj/dd/part.1", framed[:-3])
+    with pytest.raises(errors.FileCorrupt):
+        disk.check_parts("bkt", "obj", fi)
+
+
+# -- format ----------------------------------------------------------------
+
+def test_format_init_and_load(tmp_path):
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"disk{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    ref = fmt.load_or_init_format(disks, set_count=1, set_drive_count=4)
+    assert len(ref.sets) == 1 and len(ref.sets[0]) == 4
+    ids = [d.get_disk_id() for d in disks]
+    assert ids == ref.sets[0]
+    # reload keeps identity
+    disks2 = [XLStorage(str(tmp_path / f"disk{i}")) for i in range(4)]
+    ref2 = fmt.load_or_init_format(disks2, 1, 4)
+    assert ref2.id == ref.id
+    assert [d.get_disk_id() for d in disks2] == ids
+
+
+def test_format_mismatch(tmp_path):
+    (tmp_path / "d0").mkdir()
+    (tmp_path / "d1").mkdir()
+    a, b = XLStorage(str(tmp_path / "d0")), XLStorage(str(tmp_path / "d1"))
+    fmt.load_or_init_format([a], 1, 1)
+    fmt.load_or_init_format([b], 1, 1)  # different deployment
+    with pytest.raises(errors.CorruptedFormat):
+        fmt.load_or_init_format([a, b], 1, 2)
+
+
+# -- fault injection -------------------------------------------------------
+
+def test_naughty_disk(disk):
+    disk.make_vol("bkt")
+    disk.write_all("bkt", "f", b"x")
+    nd = NaughtyDisk(disk, errs={2: errors.FaultyDisk("boom")})
+    assert nd.read_all("bkt", "f") == b"x"        # call 1 passes
+    with pytest.raises(errors.FaultyDisk):
+        nd.read_all("bkt", "f")                   # call 2 programmed error
+    assert nd.read_all("bkt", "f") == b"x"        # call 3 passes (no default)
+
+
+def test_bad_disk():
+    bd = BadDisk()
+    assert not bd.is_online()
+    with pytest.raises(errors.FaultyDisk):
+        bd.read_all("b", "f")
