@@ -1,0 +1,79 @@
+"""Per-bucket metadata/config store (cmd/bucket-metadata-sys.go).
+
+The reference persists one msgp blob per bucket under
+``.minio.sys/buckets/<bucket>/.metadata.bin`` caching versioning, policy,
+lifecycle, replication, ... configs.  Here: a JSON blob written to every
+drive's system volume with quorum, cached in memory, holding the config
+sub-documents as they land (versioning first; policy/lifecycle/etc. attach
+to the same document).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Optional
+
+from ..storage import errors as serrors
+from ..storage.xl_storage import SYS_DIR
+from .interface import BucketNotFound
+
+
+class BucketMetadataSys:
+    def __init__(self, er):
+        self._er = er            # ErasureObjects (or sets facade)
+        self._cache: dict[str, dict] = {}
+        self._mu = threading.Lock()
+
+    def _path(self, bucket: str) -> str:
+        return f"buckets/{bucket}/bucket-meta.json"
+
+    def get(self, bucket: str) -> dict:
+        with self._mu:
+            if bucket in self._cache:
+                return self._cache[bucket]
+        res, _ = self._er._fanout(
+            lambda d: d.read_all(SYS_DIR, self._path(bucket)))
+        doc = {}
+        for r in res:
+            if r is not None:
+                try:
+                    doc = json.loads(r)
+                    break
+                except json.JSONDecodeError:
+                    continue
+        with self._mu:
+            self._cache[bucket] = doc
+        return doc
+
+    def update(self, bucket: str, key: str, value: Any) -> None:
+        doc = dict(self.get(bucket))
+        if value is None:
+            doc.pop(key, None)
+        else:
+            doc[key] = value
+        blob = json.dumps(doc).encode()
+        _, errs = self._er._fanout(
+            lambda d: d.write_all(SYS_DIR, self._path(bucket), blob))
+        if all(e is not None for e in errs):
+            raise serrors.FaultyDisk("bucket metadata write failed "
+                                     "on all drives")
+        with self._mu:
+            self._cache[bucket] = doc
+
+    def drop(self, bucket: str) -> None:
+        self._er._fanout(
+            lambda d: d.delete(SYS_DIR, f"buckets/{bucket}",
+                               recursive=True))
+        with self._mu:
+            self._cache.pop(bucket, None)
+
+    # -- typed accessors ---------------------------------------------------
+
+    def versioning_enabled(self, bucket: str) -> bool:
+        return self.get(bucket).get("versioning", {}).get(
+            "status") == "Enabled"
+
+    def set_versioning(self, bucket: str, enabled: bool) -> None:
+        self.update(bucket, "versioning",
+                    {"status": "Enabled" if enabled else "Suspended"})

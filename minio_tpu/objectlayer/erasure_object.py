@@ -42,6 +42,7 @@ from .interface import (BucketExists, BucketInfo, BucketNotEmpty,
                         ObjectInfo, ObjectLayer, ObjectNotFound,
                         ObjectOptions, PutObjectOptions, ReadQuorumError,
                         VersionNotFound, WriteQuorumError)
+from .multipart import MultipartOps
 
 DEFAULT_BLOCK_SIZE = 10 * 1024 * 1024   # blockSizeV1 (cmd/object-api-common.go:32)
 INLINE_THRESHOLD = 128 * 1024           # small-object inline into xl.meta
@@ -61,7 +62,7 @@ def default_parity_count(drive_count: int) -> int:
     return 4
 
 
-class ErasureObjects(ObjectLayer):
+class ErasureObjects(MultipartOps, ObjectLayer):
     """One erasure set over `len(disks)` drives (cmd/erasure.go:48)."""
 
     def __init__(self, disks: list[Optional[StorageAPI]],
@@ -69,7 +70,8 @@ class ErasureObjects(ObjectLayer):
                  block_size: int = DEFAULT_BLOCK_SIZE,
                  backend: str = "auto",
                  bitrot_algo: str = bitrot.DEFAULT_BITROT_ALGORITHM,
-                 inline_threshold: int = INLINE_THRESHOLD):
+                 inline_threshold: int = INLINE_THRESHOLD,
+                 enforce_min_part_size: bool = True):
         if not disks:
             raise ValueError("no disks")
         self.disks = list(disks)
@@ -82,6 +84,7 @@ class ErasureObjects(ObjectLayer):
         self.backend = backend
         self.bitrot_algo = bitrot_algo
         self.inline_threshold = inline_threshold
+        self.enforce_min_part_size = enforce_min_part_size
         self._pool = ThreadPoolExecutor(max_workers=max(4, n))
         self._codec = Erasure(self.data_blocks, self.parity, block_size,
                               backend=backend) if self.parity > 0 else None
@@ -102,6 +105,21 @@ class ErasureObjects(ObjectLayer):
                 return None, e
 
         out = list(self._pool.map(run, disks))
+        return [r for r, _ in out], [e for _, e in out]
+
+    def _fanout_indexed(self, fn, shuffled_disks):
+        """fn((shard_idx, disk)) per drive, aligned errors; offline drives
+        report DiskNotFound."""
+
+        def run(pair):
+            if pair[1] is None:
+                return None, serrors.DiskNotFound("offline")
+            try:
+                return fn(pair), None
+            except Exception as e:  # noqa: BLE001
+                return None, e
+
+        out = list(self._pool.map(run, enumerate(shuffled_disks)))
         return [r for r, _ in out], [e for _, e in out]
 
     def _write_quorum(self, fi: FileInfo | None = None) -> int:
@@ -193,8 +211,6 @@ class ErasureObjects(ObjectLayer):
 
         def write_one(idx_disk):
             idx, disk = idx_disk
-            if disk is None:
-                raise serrors.DiskNotFound("offline")
             dfi = FileInfo(**{**fi.__dict__})
             dfi.erasure = ErasureInfo(**{**fi.erasure.__dict__})
             dfi.erasure.index = idx + 1
@@ -211,18 +227,7 @@ class ErasureObjects(ObjectLayer):
                     disk.clean_tmp(tmp)
             return idx
 
-        def run(pair):
-            try:
-                return None if pair[1] is None else write_one(pair), None
-            except Exception as e:  # noqa: BLE001
-                return None, e
-
-        results = list(self._pool.map(
-            lambda p: run(p), enumerate(shuffled)))
-        errs = [e for _, e in results]
-        # offline disks count as errors
-        errs = [serrors.DiskNotFound("offline") if shuffled[i] is None else e
-                for i, e in enumerate(errs)]
+        _, errs = self._fanout_indexed(write_one, shuffled)
         try:
             meta.reduce_errs(errs, self._write_quorum(fi), WriteQuorumError)
         except serrors.StorageError as e:

@@ -68,6 +68,10 @@ class InvalidPart(ObjectLayerError):
     pass
 
 
+class InvalidPartOrder(ObjectLayerError):
+    pass
+
+
 class PreconditionFailed(ObjectLayerError):
     pass
 

@@ -1,0 +1,275 @@
+"""Multipart uploads (cmd/erasure-multipart.go).
+
+Uploads stage under the system volume at
+``multipart/<sha256(bucket/object)>/<uploadID>/`` on every drive
+(reference: .minio.sys/multipart, :36-44).  Each part is erasure-encoded
+and bitrot-framed at PutObjectPart time (:342) — on TPU this is the same
+single batched dispatch as whole-object PUT, so a 1 GiB multipart upload
+streams through the device part by part.  CompleteMultipartUpload merges
+the parts into the final version journal (:678) by renaming staged shard
+files into the object's data dir and committing xl.meta with the part
+table; the multipart ETag is md5(concat(part-md5s))-N.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..hashing import bitrot
+from ..storage import errors as serrors
+from ..storage.datatypes import (ChecksumInfo, ErasureInfo, FileInfo,
+                                 ObjectPartInfo, now_ns)
+from ..storage.xl_storage import SYS_DIR
+from . import metadata as meta
+from .interface import (InvalidPart, InvalidPartOrder, InvalidUploadID,
+                        ObjectInfo, PutObjectOptions, WriteQuorumError)
+
+MIN_PART_SIZE = 5 * 1024 * 1024     # S3 limit (last part exempt)
+MAX_PARTS = 10_000                  # docs/minio-limits.md:28-33
+
+
+@dataclass
+class PartInfo:
+    part_number: int
+    etag: str
+    size: int
+    actual_size: int
+    mod_time: int = 0
+
+
+@dataclass
+class MultipartInfo:
+    bucket: str
+    object_name: str
+    upload_id: str
+    user_defined: dict[str, str] = field(default_factory=dict)
+
+
+class MultipartOps:
+    """Mixin for ErasureObjects: the multipart side of the ObjectLayer."""
+
+    def _mp_dir(self, bucket: str, object_name: str, upload_id: str) -> str:
+        h = hashlib.sha256(f"{bucket}/{object_name}".encode()).hexdigest()
+        return f"multipart/{h}/{upload_id}"
+
+    def new_multipart_upload(self, bucket: str, object_name: str,
+                             opts: Optional[PutObjectOptions] = None) -> str:
+        opts = opts or PutObjectOptions()
+        self._check_bucket(bucket)
+        upload_id = uuid.uuid4().hex
+        mp = self._mp_dir(bucket, object_name, upload_id)
+        distribution = meta.hash_order(f"{bucket}/{object_name}",
+                                       len(self.disks))
+        fi = FileInfo(
+            volume=bucket, name=object_name, version_id="",
+            data_dir=str(uuid.uuid4()), mod_time=now_ns(),
+            metadata={**opts.user_defined,
+                      "__versioned": "1" if opts.versioned else "0",
+                      "__bucket": bucket, "__object": object_name},
+            erasure=ErasureInfo(
+                data_blocks=self.data_blocks, parity_blocks=self.parity,
+                block_size=self.block_size, distribution=distribution))
+
+        def init_one(idx_disk):
+            idx, disk = idx_disk
+            dfi = FileInfo(**{**fi.__dict__})
+            dfi.erasure = ErasureInfo(**{**fi.erasure.__dict__})
+            dfi.erasure.index = idx + 1
+            disk.write_metadata(SYS_DIR, mp, dfi)
+
+        shuffled = meta.shuffle_disks(self.disks, distribution)
+        _, errs = self._fanout_indexed(init_one, shuffled)
+        try:
+            meta.reduce_errs(errs, self._write_quorum(fi), WriteQuorumError)
+        except serrors.StorageError as e:
+            raise WriteQuorumError(str(e)) from e
+        return upload_id
+
+    def _mp_fileinfo(self, bucket: str, object_name: str,
+                     upload_id: str) -> tuple[FileInfo, list]:
+        mp = self._mp_dir(bucket, object_name, upload_id)
+        fis, errs = self._fanout(lambda d: d.read_version(SYS_DIR, mp))
+        ok = [fi for fi in fis if fi is not None]
+        if len(ok) < max(1, len(self.disks) // 2):
+            raise InvalidUploadID(upload_id)
+        fi = meta.find_file_info_in_quorum(fis, max(1, len(self.disks) // 2))
+        return fi, fis
+
+    def put_object_part(self, bucket: str, object_name: str, upload_id: str,
+                        part_number: int, data: bytes) -> PartInfo:
+        if not 1 <= part_number <= MAX_PARTS:
+            raise InvalidPart(f"part number {part_number}")
+        self._check_bucket(bucket)
+        fi, _ = self._mp_fileinfo(bucket, object_name, upload_id)
+        mp = self._mp_dir(bucket, object_name, upload_id)
+        etag = hashlib.md5(data).hexdigest()
+        size = len(data)
+
+        if self.parity > 0:
+            shards = self._codec.encode_object(data)
+        else:
+            import numpy as np
+            shards = [np.frombuffer(data, dtype=np.uint8)]
+        ssize = fi.erasure.shard_size()
+        framed = [bitrot.streaming_encode(s.tobytes(), ssize,
+                                          self.bitrot_algo) for s in shards]
+        shuffled = meta.shuffle_disks(self.disks, fi.erasure.distribution)
+
+        def write_one(idx_disk):
+            idx, disk = idx_disk
+            disk.create_file(SYS_DIR, f"{mp}/part.{part_number}",
+                             framed[idx])
+            # per-part sidecar so complete() can verify etag/size
+            disk.write_all(SYS_DIR, f"{mp}/part.{part_number}.meta",
+                           f"{etag}:{size}".encode())
+
+        _, errs = self._fanout_indexed(write_one, shuffled)
+        try:
+            meta.reduce_errs(errs, self._write_quorum(fi), WriteQuorumError)
+        except serrors.StorageError as e:
+            raise WriteQuorumError(str(e)) from e
+        return PartInfo(part_number, etag, size, size, now_ns())
+
+    def list_object_parts(self, bucket: str, object_name: str,
+                          upload_id: str) -> list[PartInfo]:
+        self._check_bucket(bucket)
+        fi, _ = self._mp_fileinfo(bucket, object_name, upload_id)
+        mp = self._mp_dir(bucket, object_name, upload_id)
+        # merge sidecars across ALL drives: a part that met write quorum may
+        # be absent from any single drive (transient per-drive failure)
+        parts: dict[int, PartInfo] = {}
+        found_any = False
+        for disk in self.disks:
+            if disk is None:
+                continue
+            try:
+                names = disk.list_dir(SYS_DIR, mp)
+                found_any = True
+            except serrors.StorageError:
+                continue
+            for n in names:
+                if not (n.startswith("part.") and n.endswith(".meta")):
+                    continue
+                num = int(n[5:-5])
+                if num in parts:
+                    continue
+                try:
+                    etag, size = disk.read_all(
+                        SYS_DIR, f"{mp}/{n}").decode().split(":")
+                except (serrors.StorageError, ValueError):
+                    continue
+                parts[num] = PartInfo(num, etag, int(size), int(size))
+        if not found_any:
+            raise InvalidUploadID(upload_id)
+        return sorted(parts.values(), key=lambda p: p.part_number)
+
+    def abort_multipart_upload(self, bucket: str, object_name: str,
+                               upload_id: str) -> None:
+        self._check_bucket(bucket)
+        self._mp_fileinfo(bucket, object_name, upload_id)  # validates
+        mp = self._mp_dir(bucket, object_name, upload_id)
+        self._fanout(lambda d: d.delete(SYS_DIR, mp, recursive=True))
+
+    def list_multipart_uploads(self, bucket: str,
+                               prefix: str = "") -> list[MultipartInfo]:
+        self._check_bucket(bucket)
+        out: dict[str, MultipartInfo] = {}
+        for disk in self.disks:
+            if disk is None:
+                continue
+            try:
+                hashes = disk.list_dir(SYS_DIR, "multipart")
+            except serrors.StorageError:
+                continue
+            for h in hashes:
+                try:
+                    uploads = disk.list_dir(SYS_DIR, f"multipart/{h.strip('/')}")
+                except serrors.StorageError:
+                    continue
+                for u in uploads:
+                    uid = u.strip("/")
+                    if uid in out:
+                        continue
+                    try:
+                        fi = disk.read_version(
+                            SYS_DIR, f"multipart/{h.strip('/')}/{uid}")
+                    except serrors.StorageError:
+                        continue
+                    obj = fi.metadata.get("__object", "")
+                    if obj.startswith(prefix) and \
+                            fi.metadata.get("__bucket") == bucket:
+                        md = {k: v for k, v in fi.metadata.items()
+                              if not k.startswith("__")}
+                        out[uid] = MultipartInfo(bucket, obj, uid, md)
+            break
+        return sorted(out.values(), key=lambda m: m.object_name)
+
+    def complete_multipart_upload(self, bucket: str, object_name: str,
+                                  upload_id: str,
+                                  parts: list[tuple[int, str]]) -> ObjectInfo:
+        """parts: [(part_number, etag)] in client order; must be ascending
+        (CompleteMultipartUpload, cmd/erasure-multipart.go:678)."""
+        self._check_bucket(bucket)
+        fi, _ = self._mp_fileinfo(bucket, object_name, upload_id)
+        mp = self._mp_dir(bucket, object_name, upload_id)
+        if [p[0] for p in parts] != sorted({p[0] for p in parts}):
+            raise InvalidPartOrder("parts not in ascending order")
+        uploaded = {p.part_number: p
+                    for p in self.list_object_parts(bucket, object_name,
+                                                    upload_id)}
+        part_infos: list[ObjectPartInfo] = []
+        md5s = b""
+        total = 0
+        for i, (num, etag) in enumerate(parts):
+            got = uploaded.get(num)
+            if got is None or got.etag != etag.strip('"'):
+                raise InvalidPart(f"part {num}")
+            if got.size < MIN_PART_SIZE and i != len(parts) - 1 \
+                    and self.enforce_min_part_size:
+                raise InvalidPart(f"part {num} too small")
+            part_infos.append(ObjectPartInfo(num, got.size, got.size,
+                                             got.etag, now_ns()))
+            md5s += bytes.fromhex(got.etag)
+            total += got.size
+        etag = hashlib.md5(md5s).hexdigest() + f"-{len(parts)}"
+
+        versioned = fi.metadata.pop("__versioned", "0") == "1"
+        version_id = str(uuid.uuid4()) if versioned else ""
+        mod_time = now_ns()
+        fi.volume, fi.name = bucket, object_name
+        fi.version_id = version_id
+        fi.mod_time = mod_time
+        fi.size = total
+        fi.parts = part_infos
+        fi.metadata = {k: v for k, v in fi.metadata.items()
+                       if not k.startswith("__")}
+        fi.metadata["etag"] = etag
+        fi.erasure.checksums = [ChecksumInfo(p.number, self.bitrot_algo)
+                                for p in part_infos]
+        shuffled = meta.shuffle_disks(self.disks, fi.erasure.distribution)
+
+        def commit_one(idx_disk):
+            idx, disk = idx_disk
+            dfi = FileInfo(**{**fi.__dict__})
+            dfi.erasure = ErasureInfo(**{**fi.erasure.__dict__})
+            dfi.erasure.index = idx + 1
+            tmp = disk.tmp_dir()
+            try:
+                for p in part_infos:
+                    disk.rename_file(SYS_DIR, f"{mp}/part.{p.number}",
+                                     SYS_DIR, f"{tmp}/part.{p.number}")
+                disk.rename_data(SYS_DIR, tmp, dfi, bucket, object_name)
+            finally:
+                disk.clean_tmp(tmp)
+            disk.delete(SYS_DIR, mp, recursive=True)
+
+        _, errs = self._fanout_indexed(commit_one, shuffled)
+        try:
+            meta.reduce_errs(errs, self._write_quorum(fi), WriteQuorumError)
+        except serrors.StorageError as e:
+            raise WriteQuorumError(str(e)) from e
+        fi.is_latest = True
+        return self._to_object_info(fi)

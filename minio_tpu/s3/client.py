@@ -1,0 +1,171 @@
+"""Minimal S3 client (SigV4) — used by the test suite, the replication
+worker, and as the `mc`-style round-trip tool (the reference tests against
+minio-go/mc; we carry our own client since the image has no boto3).
+"""
+
+from __future__ import annotations
+
+import http.client
+import urllib.parse
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+
+from .sigv4 import Credentials, presign_url, sign_request
+
+S3_NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+class S3ClientError(Exception):
+    def __init__(self, status: int, code: str, message: str = ""):
+        super().__init__(f"{status} {code}: {message}")
+        self.status = status
+        self.code = code
+
+
+@dataclass
+class S3Response:
+    status: int
+    headers: dict[str, str]
+    body: bytes
+
+    def xml(self) -> ET.Element:
+        return ET.fromstring(self.body)
+
+
+@dataclass
+class S3Client:
+    endpoint: str                       # http://host:port
+    access_key: str
+    secret_key: str
+    region: str = "us-east-1"
+
+    @property
+    def _creds(self) -> Credentials:
+        return Credentials(self.access_key, self.secret_key)
+
+    def request(self, method: str, path: str, query: str = "",
+                body: bytes = b"", headers: dict | None = None,
+                sign: bool = True, expect=(200, 204, 206)) -> S3Response:
+        url = self.endpoint + path + (f"?{query}" if query else "")
+        hdrs = dict(headers or {})
+        if sign:
+            hdrs = sign_request(self._creds, method, url, hdrs, body,
+                                self.region)
+        u = urllib.parse.urlsplit(url)
+        conn = http.client.HTTPConnection(u.hostname, u.port, timeout=60)
+        try:
+            conn.request(method, u.path + (f"?{u.query}" if u.query else ""),
+                         body=body, headers=hdrs)
+            resp = conn.getresponse()
+            data = resp.read()
+            out = S3Response(resp.status, dict(resp.getheaders()), data)
+        finally:
+            conn.close()
+        if expect and out.status not in expect:
+            code, msg = "Unknown", ""
+            try:
+                e = out.xml()
+                code = e.findtext("Code") or code
+                msg = e.findtext("Message") or ""
+            except ET.ParseError:
+                pass
+            raise S3ClientError(out.status, code, msg)
+        return out
+
+    # -- buckets -----------------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        self.request("PUT", f"/{bucket}")
+
+    def delete_bucket(self, bucket: str) -> None:
+        self.request("DELETE", f"/{bucket}")
+
+    def head_bucket(self, bucket: str) -> bool:
+        try:
+            self.request("HEAD", f"/{bucket}")
+            return True
+        except S3ClientError:
+            return False
+
+    def list_buckets(self) -> list[str]:
+        r = self.request("GET", "/")
+        return [b.findtext(f"{S3_NS}Name")
+                for b in r.xml().iter(f"{S3_NS}Bucket")]
+
+    def set_versioning(self, bucket: str, enabled: bool = True) -> None:
+        status = "Enabled" if enabled else "Suspended"
+        body = (f'<VersioningConfiguration xmlns='
+                f'"http://s3.amazonaws.com/doc/2006-03-01/">'
+                f"<Status>{status}</Status>"
+                f"</VersioningConfiguration>").encode()
+        self.request("PUT", f"/{bucket}", "versioning", body)
+
+    # -- objects -----------------------------------------------------------
+
+    def put_object(self, bucket: str, key: str, data: bytes,
+                   content_type: str | None = None,
+                   metadata: dict | None = None) -> S3Response:
+        hdrs = {}
+        if content_type:
+            hdrs["Content-Type"] = content_type
+        for k, v in (metadata or {}).items():
+            hdrs[f"x-amz-meta-{k}"] = v
+        return self.request("PUT", f"/{bucket}/{key}", body=data,
+                            headers=hdrs)
+
+    def get_object(self, bucket: str, key: str,
+                   version_id: str | None = None,
+                   byte_range: tuple[int, int] | None = None) -> S3Response:
+        q = f"versionId={version_id}" if version_id else ""
+        hdrs = {}
+        if byte_range:
+            hdrs["Range"] = f"bytes={byte_range[0]}-{byte_range[1]}"
+        return self.request("GET", f"/{bucket}/{key}", q, headers=hdrs)
+
+    def head_object(self, bucket: str, key: str,
+                    version_id: str | None = None) -> S3Response:
+        q = f"versionId={version_id}" if version_id else ""
+        return self.request("HEAD", f"/{bucket}/{key}", q)
+
+    def delete_object(self, bucket: str, key: str,
+                      version_id: str | None = None) -> S3Response:
+        q = f"versionId={version_id}" if version_id else ""
+        return self.request("DELETE", f"/{bucket}/{key}", q)
+
+    def list_objects(self, bucket: str, prefix: str = "",
+                     delimiter: str = "", v2: bool = True
+                     ) -> tuple[list[dict], list[str]]:
+        q = []
+        if v2:
+            q.append("list-type=2")
+        if prefix:
+            q.append(f"prefix={urllib.parse.quote(prefix)}")
+        if delimiter:
+            q.append(f"delimiter={urllib.parse.quote(delimiter)}")
+        r = self.request("GET", f"/{bucket}", "&".join(q))
+        root = r.xml()
+        objs = [{
+            "key": c.findtext(f"{S3_NS}Key"),
+            "size": int(c.findtext(f"{S3_NS}Size")),
+            "etag": (c.findtext(f"{S3_NS}ETag") or "").strip('"'),
+        } for c in root.iter(f"{S3_NS}Contents")]
+        prefixes = [p.findtext(f"{S3_NS}Prefix")
+                    for p in root.iter(f"{S3_NS}CommonPrefixes")]
+        return objs, prefixes
+
+    def list_object_versions(self, bucket: str, prefix: str = "") -> ET.Element:
+        q = "versions" + (f"&prefix={urllib.parse.quote(prefix)}"
+                          if prefix else "")
+        return self.request("GET", f"/{bucket}", q).xml()
+
+    def delete_objects(self, bucket: str, keys: list[str]) -> ET.Element:
+        parts = "".join(f"<Object><Key>{k}</Key></Object>" for k in keys)
+        body = (f'<Delete xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+                f"{parts}</Delete>").encode()
+        return self.request("POST", f"/{bucket}", "delete", body).xml()
+
+    def presign(self, method: str, bucket: str, key: str,
+                expires: int = 3600) -> str:
+        return presign_url(self._creds, method,
+                           f"{self.endpoint}/{bucket}/{key}", expires,
+                           self.region)

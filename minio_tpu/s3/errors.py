@@ -1,0 +1,140 @@
+"""S3 API error taxonomy + XML rendering (cmd/api-errors.go, ~300 codes in
+the reference; here the subset the implemented APIs can produce, extended as
+handlers land).
+"""
+
+from __future__ import annotations
+
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass
+
+from ..objectlayer import interface as ol
+
+
+@dataclass(frozen=True)
+class APIError:
+    code: str
+    description: str
+    http_status: int
+
+
+_ERRORS = {
+    "AccessDenied": APIError("AccessDenied", "Access Denied.", 403),
+    "BadDigest": APIError(
+        "BadDigest", "The Content-Md5 you specified did not match what we "
+        "received.", 400),
+    "BucketAlreadyOwnedByYou": APIError(
+        "BucketAlreadyOwnedByYou",
+        "Your previous request to create the named bucket succeeded and you "
+        "already own it.", 409),
+    "BucketNotEmpty": APIError(
+        "BucketNotEmpty", "The bucket you tried to delete is not empty.",
+        409),
+    "EntityTooLarge": APIError(
+        "EntityTooLarge", "Your proposed upload exceeds the maximum allowed "
+        "object size.", 400),
+    "ExpiredToken": APIError(
+        "ExpiredToken", "The provided token has expired.", 400),
+    "InternalError": APIError(
+        "InternalError", "We encountered an internal error, please try "
+        "again.", 500),
+    "InvalidAccessKeyId": APIError(
+        "InvalidAccessKeyId", "The Access Key Id you provided does not "
+        "exist in our records.", 403),
+    "InvalidArgument": APIError(
+        "InvalidArgument", "Invalid Argument", 400),
+    "InvalidBucketName": APIError(
+        "InvalidBucketName", "The specified bucket is not valid.", 400),
+    "InvalidDigest": APIError(
+        "InvalidDigest", "The Content-Md5 you specified is not valid.", 400),
+    "InvalidPart": APIError(
+        "InvalidPart", "One or more of the specified parts could not be "
+        "found.", 400),
+    "InvalidPartOrder": APIError(
+        "InvalidPartOrder", "The list of parts was not in ascending order.",
+        400),
+    "InvalidRange": APIError(
+        "InvalidRange", "The requested range is not satisfiable", 416),
+    "InvalidRequest": APIError("InvalidRequest", "Invalid Request", 400),
+    "MalformedXML": APIError(
+        "MalformedXML", "The XML you provided was not well-formed or did "
+        "not validate against our published schema.", 400),
+    "MethodNotAllowed": APIError(
+        "MethodNotAllowed", "The specified method is not allowed against "
+        "this resource.", 405),
+    "MissingContentLength": APIError(
+        "MissingContentLength", "You must provide the Content-Length HTTP "
+        "header.", 411),
+    "NoSuchBucket": APIError(
+        "NoSuchBucket", "The specified bucket does not exist", 404),
+    "NoSuchKey": APIError(
+        "NoSuchKey", "The specified key does not exist.", 404),
+    "NoSuchUpload": APIError(
+        "NoSuchUpload", "The specified multipart upload does not exist. "
+        "The upload ID may be invalid, or the upload may have been aborted "
+        "or completed.", 404),
+    "NoSuchVersion": APIError(
+        "NoSuchVersion", "The specified version does not exist.", 404),
+    "NotImplemented": APIError(
+        "NotImplemented", "A header you provided implies functionality "
+        "that is not implemented", 501),
+    "PreconditionFailed": APIError(
+        "PreconditionFailed", "At least one of the pre-conditions you "
+        "specified did not hold", 412),
+    "RequestTimeTooSkewed": APIError(
+        "RequestTimeTooSkewed", "The difference between the request time "
+        "and the server's time is too large.", 403),
+    "SignatureDoesNotMatch": APIError(
+        "SignatureDoesNotMatch", "The request signature we calculated does "
+        "not match the signature you provided. Check your key and signing "
+        "method.", 403),
+    "AuthorizationHeaderMalformed": APIError(
+        "AuthorizationHeaderMalformed",
+        "The authorization header is malformed.", 400),
+    "AuthorizationQueryParametersError": APIError(
+        "AuthorizationQueryParametersError",
+        "Error parsing the X-Amz-Credential parameter.", 400),
+    "SlowDown": APIError(
+        "SlowDown", "Resource requested is unreadable, please reduce your "
+        "request rate", 503),
+    "XMinioServerNotInitialized": APIError(
+        "XMinioServerNotInitialized", "Server not initialized yet, please "
+        "try again.", 503),
+}
+
+
+def get(code: str) -> APIError:
+    return _ERRORS.get(code, _ERRORS["InternalError"])
+
+
+def from_object_error(e: Exception) -> APIError:
+    """Map object layer errors to S3 codes
+    (toAPIErrorCode, cmd/api-errors.go)."""
+    mapping = {
+        ol.BucketNotFound: "NoSuchBucket",
+        ol.BucketExists: "BucketAlreadyOwnedByYou",
+        ol.BucketNotEmpty: "BucketNotEmpty",
+        ol.BucketNameInvalid: "InvalidBucketName",
+        ol.ObjectNotFound: "NoSuchKey",
+        ol.VersionNotFound: "NoSuchVersion",
+        ol.MethodNotAllowed: "MethodNotAllowed",
+        ol.ObjectNameInvalid: "InvalidArgument",
+        ol.InvalidRange: "InvalidRange",
+        ol.ReadQuorumError: "SlowDown",
+        ol.WriteQuorumError: "SlowDown",
+        ol.InvalidUploadID: "NoSuchUpload",
+        ol.InvalidPart: "InvalidPart",
+        ol.InvalidPartOrder: "InvalidPartOrder",
+        ol.PreconditionFailed: "PreconditionFailed",
+    }
+    return get(mapping.get(type(e), "InternalError"))
+
+
+def to_xml(err: APIError, resource: str = "", request_id: str = "") -> bytes:
+    root = ET.Element("Error")
+    ET.SubElement(root, "Code").text = err.code
+    ET.SubElement(root, "Message").text = err.description
+    ET.SubElement(root, "Resource").text = resource
+    ET.SubElement(root, "RequestId").text = request_id
+    return (b'<?xml version="1.0" encoding="UTF-8"?>' +
+            ET.tostring(root))

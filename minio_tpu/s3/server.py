@@ -1,0 +1,583 @@
+"""S3 API HTTP server (cmd/api-router.go:82 + cmd/object-handlers.go /
+cmd/bucket-handlers.go).
+
+Path-style S3 over a threading HTTP server: the L1/L3 frontend of the
+framework.  Handlers authenticate (SigV4 header or presigned), map the
+route to an ObjectLayer call, and render S3 XML.  The compute-heavy body
+(erasure encode/decode) happens inside the object layer on TPU.
+"""
+
+from __future__ import annotations
+
+import datetime
+import email.utils
+import hashlib
+import re
+import socket
+import threading
+import urllib.parse
+import uuid
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..objectlayer import interface as ol
+from ..objectlayer.bucket_meta import BucketMetadataSys
+from . import errors as s3err
+from . import sigv4
+
+MAX_OBJECT_SIZE = 5 * 1024 * 1024 * 1024 * 1024  # 5 TiB (docs/minio-limits.md)
+S3_NS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+_BUCKET_RE = re.compile(r"^[a-z0-9][a-z0-9.\-]{1,61}[a-z0-9]$")
+
+
+class S3Error(Exception):
+    def __init__(self, code: str):
+        super().__init__(code)
+        self.api = s3err.get(code)
+
+
+def _http_date(ns: int) -> str:
+    return email.utils.formatdate(ns / 1e9, usegmt=True)
+
+
+def _iso_date(ns: int) -> str:
+    return datetime.datetime.fromtimestamp(
+        ns / 1e9, datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+
+
+def _xml(root: ET.Element) -> bytes:
+    return (b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root))
+
+
+class S3Server:
+    """Wires an ObjectLayer + credentials into an HTTP server."""
+
+    def __init__(self, object_layer, access_key: str = "minioadmin",
+                 secret_key: str = "minioadmin", region: str = "us-east-1",
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_body_size: int = 1024 ** 3):
+        self.layer = object_layer
+        self.creds = {access_key: secret_key}
+        self.region = region
+        self.max_body_size = max_body_size
+        self.bucket_meta = BucketMetadataSys(object_layer)
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+
+def _make_handler(srv: S3Server):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = "MinioTPU"
+
+        # -- plumbing ------------------------------------------------------
+
+        def log_message(self, fmt, *args):  # quiet; tracing hooks later
+            pass
+
+        def _split(self):
+            u = urllib.parse.urlsplit(self.path)
+            path = urllib.parse.unquote(u.path)
+            query = urllib.parse.parse_qs(u.query, keep_blank_values=True)
+            parts = path.lstrip("/").split("/", 1)
+            bucket = parts[0]
+            key = parts[1] if len(parts) > 1 else ""
+            return path, bucket, key, query
+
+        def _body(self) -> bytes:
+            n = int(self.headers.get("Content-Length") or 0)
+            if n > srv.max_body_size:
+                # reject before buffering: unauthenticated clients must not
+                # be able to force huge allocations
+                raise S3Error("EntityTooLarge")
+            return self.rfile.read(n) if n else b""
+
+        def _auth(self, path, query, payload: bytes) -> bytes:
+            """Authenticate; returns the effective payload (aws-chunked
+            bodies are signature-verified per chunk and de-framed)."""
+            lookup = srv.creds.get
+            hdrs = {k: v for k, v in self.headers.items()}
+            try:
+                if "X-Amz-Signature" in query:
+                    sigv4.verify_presigned(lookup, self.command, path, query,
+                                           hdrs, region=srv.region)
+                    return payload
+                sha = self.headers.get("x-amz-content-sha256",
+                                       sigv4.UNSIGNED_PAYLOAD)
+                if sha == sigv4.STREAMING_PAYLOAD:
+                    key, seed, amz_date, scope = \
+                        sigv4.verify_request_streaming(
+                            lookup, self.command, path, query, hdrs,
+                            region=srv.region)
+                    return sigv4.decode_chunked_payload(
+                        payload, key, seed, amz_date, scope)
+                if sha != sigv4.UNSIGNED_PAYLOAD:
+                    got = hashlib.sha256(payload).hexdigest()
+                    if got != sha:
+                        raise S3Error("BadDigest")
+                sigv4.verify_request(lookup, self.command, path, query, hdrs,
+                                     sha, region=srv.region)
+                return payload
+            except sigv4.SigV4Error as e:
+                raise S3Error(e.code) from e
+
+        def _send(self, status: int, body: bytes = b"",
+                  content_type: str = "application/xml",
+                  headers: dict | None = None,
+                  content_length: int | None = None):
+            """content_length: explicit value for HEAD responses (body is
+            not sent but the header must describe the entity)."""
+            self.send_response(status)
+            self.send_header("x-amz-request-id", uuid.uuid4().hex[:16])
+            self.send_header("Server", "MinioTPU")
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.send_header("Content-Type", content_type)
+            if content_length is not None:
+                self.send_header("Content-Length", str(content_length))
+            else:
+                self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if body and self.command != "HEAD":
+                self.wfile.write(body)
+
+        def _fail(self, e: Exception, resource: str = ""):
+            if isinstance(e, S3Error):
+                api = e.api
+            elif isinstance(e, ol.ObjectLayerError):
+                api = s3err.from_object_error(e)
+            else:
+                api = s3err.get("InternalError")
+            self._send(api.http_status, s3err.to_xml(api, resource))
+
+        def _dispatch(self):
+            path, bucket, key, query = self._split()
+            try:
+                payload = self._body()
+                payload = self._auth(path, query, payload)
+                if not bucket:
+                    return self._list_buckets()
+                if not _BUCKET_RE.match(bucket):
+                    raise S3Error("InvalidBucketName")
+                if key:
+                    return self._object_api(bucket, key, query, payload)
+                return self._bucket_api(bucket, query, payload)
+            except Exception as e:  # noqa: BLE001 — every error becomes XML
+                self._fail(e, path)
+
+        do_GET = do_PUT = do_HEAD = do_DELETE = do_POST = \
+            lambda self: self._dispatch()
+
+        # -- service / bucket APIs ----------------------------------------
+
+        def _list_buckets(self):
+            if self.command != "GET":
+                raise S3Error("MethodNotAllowed")
+            root = ET.Element("ListAllMyBucketsResult", xmlns=S3_NS)
+            owner = ET.SubElement(root, "Owner")
+            ET.SubElement(owner, "ID").text = "minio-tpu"
+            ET.SubElement(owner, "DisplayName").text = "minio-tpu"
+            buckets = ET.SubElement(root, "Buckets")
+            for b in srv.layer.list_buckets():
+                be = ET.SubElement(buckets, "Bucket")
+                ET.SubElement(be, "Name").text = b.name
+                ET.SubElement(be, "CreationDate").text = _iso_date(b.created)
+            self._send(200, _xml(root))
+
+        def _bucket_api(self, bucket, query, payload):
+            cmd = self.command
+            if cmd == "PUT" and "versioning" in query:
+                return self._put_versioning(bucket, payload)
+            if cmd == "GET" and "versioning" in query:
+                return self._get_versioning(bucket)
+            if cmd == "GET" and "location" in query:
+                root = ET.Element("LocationConstraint", xmlns=S3_NS)
+                root.text = srv.region
+                srv.layer.get_bucket_info(bucket)
+                return self._send(200, _xml(root))
+            if cmd == "GET" and "versions" in query:
+                return self._list_object_versions(bucket, query)
+            if cmd == "POST" and "delete" in query:
+                return self._delete_objects(bucket, payload)
+            if cmd == "GET" and "uploads" in query:
+                return self._list_uploads(bucket, query)
+            if cmd == "PUT":
+                srv.layer.make_bucket(bucket)
+                return self._send(200, headers={"Location": f"/{bucket}"})
+            if cmd == "HEAD":
+                srv.layer.get_bucket_info(bucket)
+                return self._send(200)
+            if cmd == "DELETE":
+                srv.layer.delete_bucket(bucket)
+                srv.bucket_meta.drop(bucket)
+                return self._send(204)
+            if cmd == "GET":
+                return self._list_objects(bucket, query)
+            raise S3Error("MethodNotAllowed")
+
+        def _put_versioning(self, bucket, payload):
+            srv.layer.get_bucket_info(bucket)
+            try:
+                root = ET.fromstring(payload)
+                status = root.findtext(f"{{{S3_NS}}}Status") or \
+                    root.findtext("Status") or ""
+            except ET.ParseError as e:
+                raise S3Error("MalformedXML") from e
+            srv.bucket_meta.set_versioning(bucket, status == "Enabled")
+            self._send(200)
+
+        def _get_versioning(self, bucket):
+            srv.layer.get_bucket_info(bucket)
+            root = ET.Element("VersioningConfiguration", xmlns=S3_NS)
+            doc = srv.bucket_meta.get(bucket).get("versioning")
+            if doc:
+                ET.SubElement(root, "Status").text = doc["status"]
+            self._send(200, _xml(root))
+
+        def _list_objects(self, bucket, query):
+            q1 = {k: v[0] for k, v in query.items()}
+            v2 = q1.get("list-type") == "2"
+            prefix = q1.get("prefix", "")
+            delimiter = q1.get("delimiter", "")
+            max_keys = min(int(q1.get("max-keys", 1000) or 1000), 1000)
+            marker = q1.get("continuation-token" if v2 else "marker", "") \
+                or q1.get("start-after", "")
+            res = srv.layer.list_objects(bucket, prefix, marker, delimiter,
+                                         max_keys)
+            name = "ListBucketResult"
+            root = ET.Element(name, xmlns=S3_NS)
+            ET.SubElement(root, "Name").text = bucket
+            ET.SubElement(root, "Prefix").text = prefix
+            if delimiter:
+                ET.SubElement(root, "Delimiter").text = delimiter
+            ET.SubElement(root, "MaxKeys").text = str(max_keys)
+            ET.SubElement(root, "IsTruncated").text = \
+                "true" if res.is_truncated else "false"
+            if v2:
+                ET.SubElement(root, "KeyCount").text = \
+                    str(len(res.objects) + len(res.prefixes))
+                if res.is_truncated:
+                    ET.SubElement(root, "NextContinuationToken").text = \
+                        res.next_marker
+            elif res.is_truncated:
+                ET.SubElement(root, "NextMarker").text = res.next_marker
+            for o in res.objects:
+                c = ET.SubElement(root, "Contents")
+                ET.SubElement(c, "Key").text = o.name
+                ET.SubElement(c, "LastModified").text = _iso_date(o.mod_time)
+                ET.SubElement(c, "ETag").text = f'"{o.etag}"'
+                ET.SubElement(c, "Size").text = str(o.size)
+                ET.SubElement(c, "StorageClass").text = "STANDARD"
+            for p in res.prefixes:
+                cp = ET.SubElement(root, "CommonPrefixes")
+                ET.SubElement(cp, "Prefix").text = p
+            self._send(200, _xml(root))
+
+        def _list_object_versions(self, bucket, query):
+            q1 = {k: v[0] for k, v in query.items()}
+            prefix = q1.get("prefix", "")
+            versions = srv.layer.list_object_versions(bucket, prefix)
+            root = ET.Element("ListVersionsResult", xmlns=S3_NS)
+            ET.SubElement(root, "Name").text = bucket
+            ET.SubElement(root, "Prefix").text = prefix
+            ET.SubElement(root, "IsTruncated").text = "false"
+            for o in versions:
+                tag = "DeleteMarker" if o.delete_marker else "Version"
+                v = ET.SubElement(root, tag)
+                ET.SubElement(v, "Key").text = o.name
+                ET.SubElement(v, "VersionId").text = o.version_id or "null"
+                ET.SubElement(v, "IsLatest").text = \
+                    "true" if o.is_latest else "false"
+                ET.SubElement(v, "LastModified").text = _iso_date(o.mod_time)
+                if not o.delete_marker:
+                    ET.SubElement(v, "ETag").text = f'"{o.etag}"'
+                    ET.SubElement(v, "Size").text = str(o.size)
+                    ET.SubElement(v, "StorageClass").text = "STANDARD"
+            self._send(200, _xml(root))
+
+        def _list_uploads(self, bucket, query):
+            q1 = {k: v[0] for k, v in query.items()}
+            uploads = srv.layer.list_multipart_uploads(
+                bucket, q1.get("prefix", ""))
+            root = ET.Element("ListMultipartUploadsResult", xmlns=S3_NS)
+            ET.SubElement(root, "Bucket").text = bucket
+            ET.SubElement(root, "IsTruncated").text = "false"
+            for u in uploads:
+                ue = ET.SubElement(root, "Upload")
+                ET.SubElement(ue, "Key").text = u.object_name
+                ET.SubElement(ue, "UploadId").text = u.upload_id
+            self._send(200, _xml(root))
+
+        def _delete_objects(self, bucket, payload):
+            try:
+                root = ET.fromstring(payload)
+            except ET.ParseError as e:
+                raise S3Error("MalformedXML") from e
+            ns = f"{{{S3_NS}}}"
+            quiet = (root.findtext(f"{ns}Quiet") or
+                     root.findtext("Quiet") or "") == "true"
+            out = ET.Element("DeleteResult", xmlns=S3_NS)
+            versioned = srv.bucket_meta.versioning_enabled(bucket)
+            for obj in (root.findall(f"{ns}Object") +
+                        root.findall("Object")):
+                key = obj.findtext(f"{ns}Key") or obj.findtext("Key")
+                vid = obj.findtext(f"{ns}VersionId") or \
+                    obj.findtext("VersionId")
+                try:
+                    res = srv.layer.delete_object(
+                        bucket, key,
+                        ol.ObjectOptions(version_id=vid,
+                                         versioned=versioned))
+                    if not quiet:
+                        d = ET.SubElement(out, "Deleted")
+                        ET.SubElement(d, "Key").text = key
+                        if res.delete_marker:
+                            ET.SubElement(d, "DeleteMarker").text = "true"
+                            ET.SubElement(d,
+                                          "DeleteMarkerVersionId").text = \
+                                res.version_id
+                except Exception as e:  # noqa: BLE001
+                    api = s3err.from_object_error(e) \
+                        if isinstance(e, ol.ObjectLayerError) \
+                        else s3err.get("InternalError")
+                    err = ET.SubElement(out, "Error")
+                    ET.SubElement(err, "Key").text = key
+                    ET.SubElement(err, "Code").text = api.code
+                    ET.SubElement(err, "Message").text = api.description
+            self._send(200, _xml(out))
+
+        # -- object APIs ---------------------------------------------------
+
+        def _object_api(self, bucket, key, query, payload):
+            cmd = self.command
+            if cmd == "POST" and "uploads" in query:
+                return self._create_multipart(bucket, key)
+            if cmd == "POST" and "uploadId" in query:
+                return self._complete_multipart(bucket, key, query, payload)
+            if cmd == "PUT" and "uploadId" in query:
+                return self._upload_part(bucket, key, query, payload)
+            if cmd == "DELETE" and "uploadId" in query:
+                srv.layer.abort_multipart_upload(bucket, key,
+                                                 query["uploadId"][0])
+                return self._send(204)
+            if cmd == "GET" and "uploadId" in query:
+                return self._list_parts(bucket, key, query)
+            if cmd == "PUT":
+                return self._put_object(bucket, key, query, payload)
+            if cmd in ("GET", "HEAD"):
+                return self._get_object(bucket, key, query,
+                                        head=(cmd == "HEAD"))
+            if cmd == "DELETE":
+                return self._delete_object(bucket, key, query)
+            raise S3Error("MethodNotAllowed")
+
+        def _create_multipart(self, bucket, key):
+            user_defined = {}
+            ct = self.headers.get("Content-Type")
+            if ct:
+                user_defined["content-type"] = ct
+            for h, v in self.headers.items():
+                if h.lower().startswith("x-amz-meta-"):
+                    user_defined[h.lower()] = v
+            versioned = srv.bucket_meta.versioning_enabled(bucket)
+            uid = srv.layer.new_multipart_upload(
+                bucket, key, ol.PutObjectOptions(
+                    user_defined=user_defined, versioned=versioned))
+            root = ET.Element("InitiateMultipartUploadResult", xmlns=S3_NS)
+            ET.SubElement(root, "Bucket").text = bucket
+            ET.SubElement(root, "Key").text = key
+            ET.SubElement(root, "UploadId").text = uid
+            self._send(200, _xml(root))
+
+        def _upload_part(self, bucket, key, query, payload):
+            uid = query["uploadId"][0]
+            try:
+                part_num = int(query["partNumber"][0])
+            except (KeyError, ValueError) as e:
+                raise S3Error("InvalidArgument") from e
+            pi = srv.layer.put_object_part(bucket, key, uid, part_num,
+                                           payload)
+            self._send(200, headers={"ETag": f'"{pi.etag}"'})
+
+        def _complete_multipart(self, bucket, key, query, payload):
+            uid = query["uploadId"][0]
+            try:
+                root = ET.fromstring(payload)
+            except ET.ParseError as e:
+                raise S3Error("MalformedXML") from e
+            ns = f"{{{S3_NS}}}"
+            parts = []
+            for p in root.findall(f"{ns}Part") + root.findall("Part"):
+                num = p.findtext(f"{ns}PartNumber") or \
+                    p.findtext("PartNumber")
+                etag = p.findtext(f"{ns}ETag") or p.findtext("ETag") or ""
+                if num is None or not num.isdigit():
+                    raise S3Error("MalformedXML")
+                parts.append((int(num), etag.strip('"')))
+            oi = srv.layer.complete_multipart_upload(bucket, key, uid, parts)
+            out = ET.Element("CompleteMultipartUploadResult", xmlns=S3_NS)
+            ET.SubElement(out, "Location").text = \
+                f"{srv.endpoint}/{bucket}/{key}"
+            ET.SubElement(out, "Bucket").text = bucket
+            ET.SubElement(out, "Key").text = key
+            ET.SubElement(out, "ETag").text = f'"{oi.etag}"'
+            hdrs = {}
+            if oi.version_id:
+                hdrs["x-amz-version-id"] = oi.version_id
+            self._send(200, _xml(out), headers=hdrs)
+
+        def _list_parts(self, bucket, key, query):
+            uid = query["uploadId"][0]
+            parts = srv.layer.list_object_parts(bucket, key, uid)
+            root = ET.Element("ListPartsResult", xmlns=S3_NS)
+            ET.SubElement(root, "Bucket").text = bucket
+            ET.SubElement(root, "Key").text = key
+            ET.SubElement(root, "UploadId").text = uid
+            ET.SubElement(root, "IsTruncated").text = "false"
+            for p in parts:
+                pe = ET.SubElement(root, "Part")
+                ET.SubElement(pe, "PartNumber").text = str(p.part_number)
+                ET.SubElement(pe, "ETag").text = f'"{p.etag}"'
+                ET.SubElement(pe, "Size").text = str(p.size)
+            self._send(200, _xml(root))
+
+        def _put_object(self, bucket, key, query, payload):
+            if "Content-Length" not in self.headers:
+                raise S3Error("MissingContentLength")
+            if len(payload) > MAX_OBJECT_SIZE:
+                raise S3Error("EntityTooLarge")
+            md5_hdr = self.headers.get("Content-MD5")
+            if md5_hdr:
+                import base64
+                try:
+                    want = base64.b64decode(md5_hdr)
+                except Exception as e:
+                    raise S3Error("InvalidDigest") from e
+                if hashlib.md5(payload).digest() != want:
+                    raise S3Error("BadDigest")
+            user_defined = {}
+            ct = self.headers.get("Content-Type")
+            if ct:
+                user_defined["content-type"] = ct
+            for h, v in self.headers.items():
+                if h.lower().startswith("x-amz-meta-"):
+                    user_defined[h.lower()] = v
+            versioned = srv.bucket_meta.versioning_enabled(bucket)
+            oi = srv.layer.put_object(
+                bucket, key, payload,
+                ol.PutObjectOptions(user_defined=user_defined,
+                                    versioned=versioned))
+            hdrs = {"ETag": f'"{oi.etag}"'}
+            if oi.version_id:
+                hdrs["x-amz-version-id"] = oi.version_id
+            self._send(200, headers=hdrs)
+
+        def _get_object(self, bucket, key, query, head: bool):
+            q1 = {k: v[0] for k, v in query.items()}
+            vid = q1.get("versionId")
+            if vid == "null":
+                vid = ""
+            opts = ol.ObjectOptions(version_id=vid)
+            rng = self.headers.get("Range")
+            try:
+                if head:
+                    oi = srv.layer.get_object_info(bucket, key, opts)
+                    data = None
+                else:
+                    offset, length = 0, -1
+                    if rng:
+                        oi0 = srv.layer.get_object_info(bucket, key, opts)
+                        offset, length = _parse_range(rng, oi0.size)
+                    oi, data = srv.layer.get_object(bucket, key, offset,
+                                                    length, opts)
+            except ol.MethodNotAllowed:
+                # delete marker (cmd/object-handlers.go: 405 + header)
+                return self._send(
+                    405, s3err.to_xml(s3err.get("MethodNotAllowed")),
+                    headers={"x-amz-delete-marker": "true"})
+            hdrs = {
+                "ETag": f'"{oi.etag}"',
+                "Last-Modified": _http_date(oi.mod_time),
+                "Accept-Ranges": "bytes",
+            }
+            if oi.version_id:
+                hdrs["x-amz-version-id"] = oi.version_id
+            for k2, v in oi.user_defined.items():
+                if k2.startswith("x-amz-meta-"):
+                    hdrs[k2] = v
+            ct = oi.content_type or "binary/octet-stream"
+            if head:
+                if oi.delete_marker:
+                    hdrs = {"x-amz-delete-marker": "true"}
+                    if oi.version_id:
+                        hdrs["x-amz-version-id"] = oi.version_id
+                    return self._send(405, b"", headers=hdrs,
+                                      content_length=0)
+                return self._send(200, b"", content_type=ct, headers=hdrs,
+                                  content_length=oi.size)
+            if rng:
+                start = _parse_range(rng, oi.size)[0]
+                hdrs["Content-Range"] = \
+                    f"bytes {start}-{start + len(data) - 1}/{oi.size}"
+                return self._send(206, data, content_type=ct, headers=hdrs)
+            return self._send(200, data, content_type=ct, headers=hdrs)
+
+        def _delete_object(self, bucket, key, query):
+            q1 = {k: v[0] for k, v in query.items()}
+            vid = q1.get("versionId")
+            if vid == "null":
+                vid = ""
+            versioned = srv.bucket_meta.versioning_enabled(bucket)
+            res = srv.layer.delete_object(
+                bucket, key, ol.ObjectOptions(version_id=vid,
+                                              versioned=versioned))
+            hdrs = {}
+            if res.delete_marker:
+                hdrs["x-amz-delete-marker"] = "true"
+            if res.version_id:
+                hdrs["x-amz-version-id"] = res.version_id
+            self._send(204, headers=hdrs)
+
+    return Handler
+
+
+def _parse_range(spec: str, size: int) -> tuple[int, int]:
+    """HTTP Range -> (offset, length) (cmd/httprange.go)."""
+    m = re.match(r"^bytes=(\d*)-(\d*)$", spec.strip())
+    if not m:
+        raise S3Error("InvalidRange")
+    first, last = m.group(1), m.group(2)
+    if first == "" and last == "":
+        raise S3Error("InvalidRange")
+    if first == "":  # suffix range: last N bytes
+        n = int(last)
+        if n == 0:
+            raise S3Error("InvalidRange")
+        start = max(0, size - n)
+        return start, size - start
+    start = int(first)
+    if start >= size:
+        raise S3Error("InvalidRange")
+    if last == "":
+        return start, size - start
+    end = min(int(last), size - 1)
+    if end < start:
+        raise S3Error("InvalidRange")
+    return start, end - start + 1

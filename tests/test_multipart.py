@@ -1,0 +1,144 @@
+"""Multipart upload tests — object layer + S3 API
+(mirrors cmd/erasure-multipart.go behavior and the reference's
+object-handlers multipart suites)."""
+
+import numpy as np
+import pytest
+
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.objectlayer.interface import (InvalidPart, InvalidPartOrder,
+                                             InvalidUploadID,
+                                             PutObjectOptions)
+from minio_tpu.s3.client import S3Client, S3ClientError
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl_storage import XLStorage
+
+BS = 128 * 1024
+
+
+def make_layer(tmp_path, n=4, parity=2):
+    disks = []
+    for i in range(n):
+        d = tmp_path / f"disk{i}"
+        d.mkdir(exist_ok=True)
+        disks.append(XLStorage(str(d)))
+    return ErasureObjects(disks, parity=parity, block_size=BS,
+                          backend="numpy", enforce_min_part_size=False)
+
+
+@pytest.fixture
+def er(tmp_path):
+    layer = make_layer(tmp_path)
+    layer.make_bucket("bkt")
+    return layer
+
+
+def _data(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+def test_multipart_roundtrip(er):
+    uid = er.new_multipart_upload("bkt", "big.bin")
+    p1 = _data(BS + 100, 1)
+    p2 = _data(2 * BS, 2)
+    p3 = _data(777, 3)
+    e1 = er.put_object_part("bkt", "big.bin", uid, 1, p1)
+    e2 = er.put_object_part("bkt", "big.bin", uid, 2, p2)
+    e3 = er.put_object_part("bkt", "big.bin", uid, 3, p3)
+    parts = er.list_object_parts("bkt", "big.bin", uid)
+    assert [p.part_number for p in parts] == [1, 2, 3]
+    oi = er.complete_multipart_upload(
+        "bkt", "big.bin", uid, [(1, e1.etag), (2, e2.etag), (3, e3.etag)])
+    assert oi.etag.endswith("-3")
+    assert oi.size == len(p1) + len(p2) + len(p3)
+    _, got = er.get_object("bkt", "big.bin")
+    assert got == p1 + p2 + p3
+    # upload dir cleaned up
+    with pytest.raises(InvalidUploadID):
+        er.list_object_parts("bkt", "big.bin", uid)
+
+
+def test_multipart_part_overwrite(er):
+    uid = er.new_multipart_upload("bkt", "obj")
+    er.put_object_part("bkt", "obj", uid, 1, b"old-part-content")
+    e1b = er.put_object_part("bkt", "obj", uid, 1, b"new")
+    oi = er.complete_multipart_upload("bkt", "obj", uid, [(1, e1b.etag)])
+    _, got = er.get_object("bkt", "obj")
+    assert got == b"new"
+    assert oi.size == 3
+
+
+def test_multipart_bad_etag_and_order(er):
+    uid = er.new_multipart_upload("bkt", "obj")
+    e1 = er.put_object_part("bkt", "obj", uid, 1, b"a" * 100)
+    e2 = er.put_object_part("bkt", "obj", uid, 2, b"b" * 100)
+    with pytest.raises(InvalidPart):
+        er.complete_multipart_upload("bkt", "obj", uid, [(1, "deadbeef" * 4)])
+    with pytest.raises(InvalidPartOrder):
+        er.complete_multipart_upload("bkt", "obj", uid,
+                                     [(2, e2.etag), (1, e1.etag)])
+    with pytest.raises(InvalidPart):
+        er.put_object_part("bkt", "obj", uid, 0, b"x")
+
+
+def test_multipart_abort(er):
+    uid = er.new_multipart_upload("bkt", "obj")
+    er.put_object_part("bkt", "obj", uid, 1, b"data")
+    assert len(er.list_multipart_uploads("bkt")) == 1
+    er.abort_multipart_upload("bkt", "obj", uid)
+    assert er.list_multipart_uploads("bkt") == []
+    with pytest.raises(InvalidUploadID):
+        er.put_object_part("bkt", "obj", uid, 2, b"more")
+
+
+def test_unknown_upload_id(er):
+    with pytest.raises(InvalidUploadID):
+        er.put_object_part("bkt", "obj", "nope", 1, b"x")
+    with pytest.raises(InvalidUploadID):
+        er.complete_multipart_upload("bkt", "obj", "nope", [])
+
+
+def test_multipart_metadata_preserved(er):
+    uid = er.new_multipart_upload(
+        "bkt", "obj", PutObjectOptions(
+            user_defined={"content-type": "text/x-part",
+                          "x-amz-meta-tag": "v"}))
+    e1 = er.put_object_part("bkt", "obj", uid, 1, b"payload")
+    er.complete_multipart_upload("bkt", "obj", uid, [(1, e1.etag)])
+    oi = er.get_object_info("bkt", "obj")
+    assert oi.content_type == "text/x-part"
+    assert oi.user_defined.get("x-amz-meta-tag") == "v"
+
+
+def test_multipart_over_http(tmp_path):
+    layer = make_layer(tmp_path, n=4, parity=2)
+    srv = S3Server(layer, access_key="k", secret_key="s")
+    srv.start()
+    try:
+        c = S3Client(srv.endpoint, "k", "s")
+        c.make_bucket("mpb")
+        # initiate
+        r = c.request("POST", "/mpb/file.bin", "uploads")
+        uid = r.xml().findtext(
+            "{http://s3.amazonaws.com/doc/2006-03-01/}UploadId")
+        assert uid
+        data1, data2 = _data(BS, 7), _data(100, 8)
+        r1 = c.request("PUT", "/mpb/file.bin",
+                       f"partNumber=1&uploadId={uid}", data1)
+        r2 = c.request("PUT", "/mpb/file.bin",
+                       f"partNumber=2&uploadId={uid}", data2)
+        body = (
+            '<CompleteMultipartUpload>'
+            f'<Part><PartNumber>1</PartNumber><ETag>{r1.headers["ETag"]}'
+            '</ETag></Part>'
+            f'<Part><PartNumber>2</PartNumber><ETag>{r2.headers["ETag"]}'
+            '</ETag></Part>'
+            '</CompleteMultipartUpload>').encode()
+        r = c.request("POST", "/mpb/file.bin", f"uploadId={uid}", body)
+        assert b"CompleteMultipartUploadResult" in r.body
+        g = c.get_object("mpb", "file.bin")
+        assert g.body == data1 + data2
+        assert g.headers["ETag"].strip('"').endswith("-2")
+    finally:
+        srv.stop()

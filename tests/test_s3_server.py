@@ -1,0 +1,238 @@
+"""S3 API conformance tests — full HTTP round trips with SigV4.
+
+Mirrors the handler-test tier of the reference (SURVEY.md §4:
+ExecObjectLayerAPITest / TestServer with signed requests,
+cmd/object-handlers_test.go, cmd/signature-v4_test.go).
+"""
+
+import urllib.error
+import urllib.request
+
+import pytest
+
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.s3.client import S3Client, S3ClientError
+from minio_tpu.s3.server import S3Server, _parse_range, S3Error
+from minio_tpu.storage.xl_storage import XLStorage
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("s3drives")
+    disks = []
+    for i in range(4):
+        d = tmp / f"disk{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=256 * 1024,
+                           backend="numpy")
+    srv = S3Server(layer, access_key="testkey", secret_key="testsecret")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def client(server):
+    return S3Client(server.endpoint, "testkey", "testsecret")
+
+
+def test_bucket_lifecycle(client):
+    client.make_bucket("buck1")
+    assert "buck1" in client.list_buckets()
+    assert client.head_bucket("buck1")
+    with pytest.raises(S3ClientError) as ei:
+        client.make_bucket("buck1")
+    assert ei.value.code == "BucketAlreadyOwnedByYou"
+    client.delete_bucket("buck1")
+    assert not client.head_bucket("buck1")
+
+
+def test_object_roundtrip(client):
+    client.make_bucket("objs")
+    data = bytes(range(256)) * 2000  # 512000 bytes, multi-stripe
+    r = client.put_object("objs", "dir/file.bin", data,
+                          content_type="application/x-test",
+                          metadata={"color": "blue"})
+    etag = r.headers["ETag"].strip('"')
+    g = client.get_object("objs", "dir/file.bin")
+    assert g.body == data
+    assert g.headers["ETag"].strip('"') == etag
+    assert g.headers["Content-Type"] == "application/x-test"
+    assert g.headers["x-amz-meta-color"] == "blue"
+    h = client.head_object("objs", "dir/file.bin")
+    assert h.body == b""
+    assert int(h.headers["Content-Length"]) == len(data)
+    client.delete_object("objs", "dir/file.bin")
+    with pytest.raises(S3ClientError) as ei:
+        client.get_object("objs", "dir/file.bin")
+    assert ei.value.code == "NoSuchKey"
+
+
+def test_range_requests(client):
+    client.make_bucket("ranges")
+    data = bytes(range(256)) * 100
+    client.put_object("ranges", "r.bin", data)
+    g = client.get_object("ranges", "r.bin", byte_range=(100, 199))
+    assert g.status == 206
+    assert g.body == data[100:200]
+    assert g.headers["Content-Range"] == f"bytes 100-199/{len(data)}"
+    # suffix + open-ended via raw request
+    g = client.request("GET", "/ranges/r.bin",
+                       headers={"Range": "bytes=-10"})
+    assert g.body == data[-10:]
+    g = client.request("GET", "/ranges/r.bin",
+                       headers={"Range": f"bytes={len(data)-5}-"})
+    assert g.body == data[-5:]
+    with pytest.raises(S3ClientError) as ei:
+        client.request("GET", "/ranges/r.bin",
+                       headers={"Range": f"bytes={len(data)}-"})
+    assert ei.value.code == "InvalidRange"
+
+
+def test_listing(client):
+    client.make_bucket("lists")
+    for k in ["a/1", "a/2", "b/1", "top"]:
+        client.put_object("lists", k, b"x")
+    objs, prefixes = client.list_objects("lists")
+    assert [o["key"] for o in objs] == ["a/1", "a/2", "b/1", "top"]
+    objs, prefixes = client.list_objects("lists", delimiter="/")
+    assert prefixes == ["a/", "b/"]
+    assert [o["key"] for o in objs] == ["top"]
+    objs, _ = client.list_objects("lists", prefix="a/")
+    assert [o["key"] for o in objs] == ["a/1", "a/2"]
+    # v1 listing
+    objs, _ = client.list_objects("lists", v2=False)
+    assert len(objs) == 4
+
+
+def test_delete_objects_batch(client):
+    client.make_bucket("batch")
+    for k in ["x", "y", "z"]:
+        client.put_object("batch", k, b"1")
+    res = client.delete_objects("batch", ["x", "y", "z"])
+    assert len(list(res)) == 3
+    objs, _ = client.list_objects("batch")
+    assert objs == []
+
+
+def test_versioning_flow(client):
+    client.make_bucket("vers")
+    client.set_versioning("vers", True)
+    r1 = client.put_object("vers", "doc", b"version-1")
+    r2 = client.put_object("vers", "doc", b"version-2")
+    v1 = r1.headers["x-amz-version-id"]
+    v2 = r2.headers["x-amz-version-id"]
+    assert v1 != v2
+    assert client.get_object("vers", "doc").body == b"version-2"
+    assert client.get_object("vers", "doc", version_id=v1).body == \
+        b"version-1"
+    # unversioned delete writes a delete marker
+    d = client.delete_object("vers", "doc")
+    assert d.headers.get("x-amz-delete-marker") == "true"
+    with pytest.raises(S3ClientError) as ei:
+        client.get_object("vers", "doc")
+    assert ei.value.status == 405
+    # versions listing shows 3 entries incl. marker
+    root = client.list_object_versions("vers", "doc")
+    tags = [e.tag.split("}")[1] for e in root
+            if e.tag.endswith("Version") or e.tag.endswith("DeleteMarker")]
+    assert sorted(tags) == ["DeleteMarker", "Version", "Version"]
+    # delete the marker -> object readable again
+    marker_vid = d.headers["x-amz-version-id"]
+    client.delete_object("vers", "doc", version_id=marker_vid)
+    assert client.get_object("vers", "doc").body == b"version-2"
+
+
+def test_auth_failures(server, client):
+    client.make_bucket("auth")
+    bad = S3Client(server.endpoint, "testkey", "wrongsecret")
+    with pytest.raises(S3ClientError) as ei:
+        bad.list_buckets()
+    assert ei.value.code == "SignatureDoesNotMatch"
+    unknown = S3Client(server.endpoint, "nokey", "x")
+    with pytest.raises(S3ClientError) as ei:
+        unknown.list_buckets()
+    assert ei.value.code == "InvalidAccessKeyId"
+    # unsigned request
+    r = client.request("GET", "/", sign=False, expect=())
+    assert r.status == 403
+
+
+def test_presigned_url(server, client):
+    client.make_bucket("presign")
+    client.put_object("presign", "file", b"presigned-content")
+    url = client.presign("GET", "presign", "file", expires=300)
+    with urllib.request.urlopen(url) as resp:
+        assert resp.read() == b"presigned-content"
+    # tampered signature fails
+    bad_url = url.replace("X-Amz-Signature=", "X-Amz-Signature=0")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(bad_url)
+    assert ei.value.code == 403
+
+
+def test_invalid_bucket_names(client):
+    for name in ["AB", "a", "has_underscore~x"]:
+        with pytest.raises(S3ClientError) as ei:
+            client.request("PUT", f"/{name}")
+        assert ei.value.code == "InvalidBucketName"
+
+
+def test_streaming_chunked_upload(server, client):
+    """aws-chunked (STREAMING-AWS4-HMAC-SHA256-PAYLOAD) is de-framed and
+    per-chunk verified (cmd/streaming-signature-v4.go semantics)."""
+    import http.client
+    from minio_tpu.s3 import sigv4
+    client.make_bucket("chunked")
+    data = bytes(range(256)) * 700  # multiple 64KiB chunks
+    url = f"{server.endpoint}/chunked/streamed.bin"
+    hdrs, body = sigv4.sign_request_streaming(
+        sigv4.Credentials("testkey", "testsecret"), "PUT", url, {}, data,
+        chunk_size=64 * 1024)
+    conn = http.client.HTTPConnection("127.0.0.1", server.port)
+    conn.request("PUT", "/chunked/streamed.bin", body=body, headers=hdrs)
+    resp = conn.getresponse()
+    assert resp.status == 200, resp.read()
+    resp.read()
+    conn.close()
+    g = client.get_object("chunked", "streamed.bin")
+    assert g.body == data  # de-framed, not raw chunk framing
+
+    # tampered chunk payload -> signature mismatch
+    bad = bytearray(body)
+    bad[len(bad) // 2] ^= 1
+    conn = http.client.HTTPConnection("127.0.0.1", server.port)
+    conn.request("PUT", "/chunked/streamed2.bin", body=bytes(bad),
+                 headers=hdrs)
+    resp = conn.getresponse()
+    out = resp.read()
+    conn.close()
+    assert resp.status in (400, 403)
+
+
+def test_head_delete_marker(server, client):
+    client.make_bucket("hdm")
+    client.set_versioning("hdm", True)
+    client.put_object("hdm", "obj", b"x")
+    client.delete_object("hdm", "obj")
+    with pytest.raises(S3ClientError) as ei:
+        client.head_object("hdm", "obj")
+    assert ei.value.status == 405
+
+
+def test_oversized_content_length_rejected(server, client):
+    r = client.request("PUT", "/hdm/too-big", sign=False,
+                       headers={"Content-Length": str(10 * 1024 ** 3)},
+                       expect=())
+    assert r.status == 400
+
+
+def test_parse_range_unit():
+    assert _parse_range("bytes=0-9", 100) == (0, 10)
+    assert _parse_range("bytes=50-", 100) == (50, 50)
+    assert _parse_range("bytes=-20", 100) == (80, 20)
+    assert _parse_range("bytes=0-1000", 100) == (0, 100)
+    for bad in ["bytes=-", "bytes=5-2", "bytes=100-", "junk"]:
+        with pytest.raises(S3Error):
+            _parse_range(bad, 100)
