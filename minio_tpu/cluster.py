@@ -1,0 +1,97 @@
+"""Multi-node cluster assembly — the distributed deployment path
+(cmd/server-main.go:389 serverMain + cmd/endpoint*.go topology, rebuilt
+for host-RPC + device-compute).
+
+Each node runs: an RPC server exporting its local drives (storage service)
+and lock table (lock service), plus the S3 frontend over an object layer
+whose drive list mixes local XLStorage and RemoteStorage clients in the
+SAME global order on every node — so quorum, distribution, and healing
+agree cluster-wide.  Namespace locks are dsync DRWMutexes over every
+node's locker.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .objectlayer.sets import ErasureSets
+from .parallel.dsync import (LocalLocker, NamespaceLock, RemoteLocker,
+                             register_lock_service)
+from .parallel.rpc import RPCClient, RPCServer
+from .storage.format import load_or_init_format
+from .storage.remote import RemoteStorage, register_storage_service
+from .storage.xl_storage import XLStorage
+
+
+@dataclass
+class NodeSpec:
+    """One host in the cluster layout: (endpoint filled at runtime)."""
+    node_id: str
+    drive_dirs: list[str]
+    endpoint: str = ""
+
+
+class Node:
+    """A running cluster member: RPC services + its view of the object
+    layer (every node can serve any request, cmd/routers.go:30-38)."""
+
+    def __init__(self, spec: NodeSpec, all_specs: list[NodeSpec],
+                 secret: str, set_drive_count: int | None = None,
+                 host: str = "127.0.0.1", port: int = 0, **set_kwargs):
+        self.spec = spec
+        self.secret = secret
+        self.drives = {f"drive{i}": XLStorage(d)
+                       for i, d in enumerate(spec.drive_dirs)}
+        self.locker = LocalLocker()
+        self.rpc = RPCServer(secret, host=host, port=port)
+        register_storage_service(self.rpc, self.drives)
+        register_lock_service(self.rpc, self.locker)
+        self.rpc.start()
+        spec.endpoint = self.rpc.endpoint
+        self._all_specs = all_specs
+        self._set_kwargs = set_kwargs
+        self._set_drive_count = set_drive_count
+        self.layer: ErasureSets | None = None
+
+    def assemble(self) -> ErasureSets:
+        """Build this node's object layer once every peer endpoint is
+        known (bootstrap rendezvous, cmd/bootstrap-peer-server.go:162)."""
+        disks = []
+        lockers = []
+        for spec in self._all_specs:
+            local = spec.node_id == self.spec.node_id
+            if local:
+                lockers.append(self.locker)
+            else:
+                client = RPCClient(spec.endpoint, self.secret)
+                lockers.append(RemoteLocker(client))
+            for i in range(len(spec.drive_dirs)):
+                if local:
+                    disks.append(self.drives[f"drive{i}"])
+                else:
+                    disks.append(RemoteStorage(
+                        RPCClient(spec.endpoint, self.secret), f"drive{i}"))
+        n = len(disks)
+        sdc = self._set_drive_count or n
+        assert n % sdc == 0
+        fmt = load_or_init_format(disks, n // sdc, sdc)
+        self.layer = ErasureSets(
+            disks, n // sdc, sdc, deployment_id=fmt.id,
+            distribution_algo=fmt.distribution_algo,
+            ns_lock=NamespaceLock(lockers), **self._set_kwargs)
+        return self.layer
+
+    def stop(self) -> None:
+        self.rpc.stop()
+
+
+def start_cluster(specs: list[NodeSpec], secret: str,
+                  set_drive_count: int | None = None,
+                  **set_kwargs) -> list[Node]:
+    """Boot all nodes, then assemble each node's layer (first node formats,
+    the rest adopt — waitForFormatErasure analog)."""
+    nodes = [Node(s, specs, secret, set_drive_count, **set_kwargs)
+             for s in specs]
+    for node in nodes:
+        node.assemble()
+    return nodes

@@ -34,14 +34,18 @@ class BucketMetadataSys:
                 return self._cache[bucket]
         res, _ = self._er._fanout(
             lambda d: d.read_all(SYS_DIR, self._path(bucket)))
+        # newest revision wins: a drive that missed the last quorum write
+        # must not roll the config back (e.g. silently disable versioning)
         doc = {}
         for r in res:
-            if r is not None:
-                try:
-                    doc = json.loads(r)
-                    break
-                except json.JSONDecodeError:
-                    continue
+            if r is None:
+                continue
+            try:
+                cand = json.loads(r)
+            except json.JSONDecodeError:
+                continue
+            if cand.get("_rev", 0) >= doc.get("_rev", 0):
+                doc = cand
         with self._mu:
             self._cache[bucket] = doc
         return doc
@@ -52,12 +56,14 @@ class BucketMetadataSys:
             doc.pop(key, None)
         else:
             doc[key] = value
+        doc["_rev"] = doc.get("_rev", 0) + 1
         blob = json.dumps(doc).encode()
         _, errs = self._er._fanout(
             lambda d: d.write_all(SYS_DIR, self._path(bucket), blob))
-        if all(e is not None for e in errs):
-            raise serrors.FaultyDisk("bucket metadata write failed "
-                                     "on all drives")
+        ok = sum(1 for e in errs if e is None)
+        if ok < len(errs) // 2 + 1:
+            raise serrors.FaultyDisk(
+                f"bucket metadata write reached only {ok} drives")
         with self._mu:
             self._cache[bucket] = doc
 

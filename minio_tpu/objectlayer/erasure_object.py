@@ -71,7 +71,8 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                  backend: str = "auto",
                  bitrot_algo: str = bitrot.DEFAULT_BITROT_ALGORITHM,
                  inline_threshold: int = INLINE_THRESHOLD,
-                 enforce_min_part_size: bool = True):
+                 enforce_min_part_size: bool = True,
+                 ns_lock=None):
         if not disks:
             raise ValueError("no disks")
         self.disks = list(disks)
@@ -85,6 +86,10 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         self.bitrot_algo = bitrot_algo
         self.inline_threshold = inline_threshold
         self.enforce_min_part_size = enforce_min_part_size
+        if ns_lock is None:
+            from ..parallel.dsync import NamespaceLock
+            ns_lock = NamespaceLock()
+        self.ns_lock = ns_lock
         self._pool = ThreadPoolExecutor(max_workers=max(4, n))
         self._codec = Erasure(self.data_blocks, self.parity, block_size,
                               backend=backend) if self.parity > 0 else None
@@ -208,6 +213,16 @@ class ErasureObjects(MultipartOps, ObjectLayer):
 
         inline = size <= self.inline_threshold
         shuffled = meta.shuffle_disks(self.disks, distribution)
+        lk = self.ns_lock.new_lock(bucket, object_name)
+        lk.lock(write=True)  # cmd/erasure-object.go:729-735 nsLock
+        try:
+            return self._commit_put(bucket, object_name, fi, framed, inline,
+                                    shuffled)
+        finally:
+            lk.unlock()
+
+    def _commit_put(self, bucket, object_name, fi, framed, inline,
+                    shuffled) -> ObjectInfo:
 
         def write_one(idx_disk):
             idx, disk = idx_disk
@@ -274,11 +289,18 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         if fi.deleted:
             raise MethodNotAllowed(f"{bucket}/{object_name} is a delete "
                                    "marker")
+        # HTTP range semantics in one pass (cmd/httprange.go): negative
+        # offset = suffix (last -offset bytes); length < 0 = to end;
+        # overlong ranges clamp; start past EOF is invalid
+        size = fi.size
+        if offset < 0:
+            offset = max(0, size + offset)
         if length < 0:
-            length = fi.size - offset
-        if offset < 0 or offset + length > fi.size:
+            length = size - offset
+        if offset > size or (size > 0 and offset == size):
             from .interface import InvalidRange
-            raise InvalidRange(f"{offset}+{length} vs {fi.size}")
+            raise InvalidRange(f"{offset}+{length} vs {size}")
+        length = min(length, size - offset)
         info = self._to_object_info(fi)
         if fi.size == 0:
             return info, b""

@@ -62,7 +62,8 @@ def classify_disks(er: ErasureObjects, bucket: str, object_name: str,
             states.append(DiskState.OFFLINE)
             continue
         if isinstance(derr, (serrors.FileNotFound,
-                             serrors.FileVersionNotFound)):
+                             serrors.FileVersionNotFound,
+                             serrors.VolumeNotFound)):
             states.append(DiskState.MISSING)
             continue
         if derr is not None:
@@ -132,6 +133,18 @@ def heal_object(er: ErasureObjects, bucket: str, object_name: str,
     shuffled = meta.shuffle_disks(er.disks, fi.erasure.distribution)
     s_fis = meta.shuffle_parts_metadata(fis, fi.erasure.distribution)
     ssize = fi.erasure.shard_size()
+
+    # heal the bucket volume first (healBucket, cmd/erasure-healing.go:56)
+    for i in healable:
+        try:
+            shuffled[i].stat_vol(bucket)
+        except serrors.VolumeNotFound:
+            try:
+                shuffled[i].make_vol(bucket)
+            except serrors.StorageError:
+                pass
+        except serrors.StorageError:
+            pass
 
     # delete markers / zero-byte objects: metadata-only heal
     if fi.deleted or fi.size == 0 or not fi.parts:

@@ -176,6 +176,8 @@ class MultipartOps:
     def list_multipart_uploads(self, bucket: str,
                                prefix: str = "") -> list[MultipartInfo]:
         self._check_bucket(bucket)
+        # merge across ALL drives: an upload that met write quorum may be
+        # missing from any single drive
         out: dict[str, MultipartInfo] = {}
         for disk in self.disks:
             if disk is None:
@@ -186,7 +188,8 @@ class MultipartOps:
                 continue
             for h in hashes:
                 try:
-                    uploads = disk.list_dir(SYS_DIR, f"multipart/{h.strip('/')}")
+                    uploads = disk.list_dir(SYS_DIR,
+                                            f"multipart/{h.strip('/')}")
                 except serrors.StorageError:
                     continue
                 for u in uploads:
@@ -204,7 +207,6 @@ class MultipartOps:
                         md = {k: v for k, v in fi.metadata.items()
                               if not k.startswith("__")}
                         out[uid] = MultipartInfo(bucket, obj, uid, md)
-            break
         return sorted(out.values(), key=lambda m: m.object_name)
 
     def complete_multipart_upload(self, bucket: str, object_name: str,
@@ -215,6 +217,8 @@ class MultipartOps:
         self._check_bucket(bucket)
         fi, _ = self._mp_fileinfo(bucket, object_name, upload_id)
         mp = self._mp_dir(bucket, object_name, upload_id)
+        if not parts:
+            raise InvalidPart("no parts specified")
         if [p[0] for p in parts] != sorted({p[0] for p in parts}):
             raise InvalidPartOrder("parts not in ascending order")
         uploaded = {p.part_number: p

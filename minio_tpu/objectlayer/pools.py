@@ -1,0 +1,198 @@
+"""erasureServerPools — capacity-expansion topology
+(cmd/erasure-server-pool.go:41).
+
+Multiple pools (each an ErasureSets); placement: an object goes to the pool
+that already holds it, else the pool with the most free space
+(getPoolIdx :255, getAvailablePoolIdx :182).  Reads/deletes search pools in
+order; lists/heals fan out and merge.
+"""
+
+from __future__ import annotations
+
+from .interface import (BucketInfo, ListObjectsInfo, ObjectInfo,
+                        ObjectLayer, ObjectNotFound, ReadQuorumError,
+                        VersionNotFound)
+from .sets import ErasureSets
+
+
+class ErasureServerPools(ObjectLayer):
+    def __init__(self, pools: list[ErasureSets]):
+        assert pools
+        self.pools = pools
+
+    # -- placement ---------------------------------------------------------
+
+    def _free_space(self, pool: ErasureSets) -> int:
+        total = 0
+        for s in pool.sets:
+            for d in s.disks:
+                if d is not None:
+                    try:
+                        total += d.disk_info().free
+                    except Exception:  # noqa: BLE001
+                        pass
+        return total
+
+    def get_pool_idx(self, bucket: str, object_name: str) -> int:
+        """Existing location wins; else most free space
+        (cmd/erasure-server-pool.go:255,182)."""
+        for i, p in enumerate(self.pools):
+            try:
+                p.get_object_info(bucket, object_name)
+                return i
+            except (ObjectNotFound, VersionNotFound):
+                continue
+            # quorum/transport errors propagate: routing a PUT of an
+            # existing object elsewhere would shadow it with stale data
+            # once the pool recovers (getPoolIdx semantics)
+        if len(self.pools) == 1:
+            return 0
+        frees = [self._free_space(p) for p in self.pools]
+        return max(range(len(frees)), key=frees.__getitem__)
+
+    def _find_pool(self, bucket: str, object_name: str,
+                   opts=None) -> ErasureSets:
+        last: Exception = ObjectNotFound(f"{bucket}/{object_name}")
+        for p in self.pools:
+            try:
+                p.get_object_info(bucket, object_name, opts)
+                return p
+            except (ObjectNotFound, VersionNotFound, ReadQuorumError) as e:
+                last = e
+        raise last
+
+    # -- bucket ops --------------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        self.pools[0].make_bucket(bucket)
+        for p in self.pools[1:]:
+            try:
+                p.make_bucket(bucket)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def get_bucket_info(self, bucket: str) -> BucketInfo:
+        return self.pools[0].get_bucket_info(bucket)
+
+    def list_buckets(self) -> list[BucketInfo]:
+        return self.pools[0].list_buckets()
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        for p in self.pools:
+            p.delete_bucket(bucket, force)
+
+    # -- object ops --------------------------------------------------------
+
+    def put_object(self, bucket, object_name, data, opts=None) -> ObjectInfo:
+        idx = self.get_pool_idx(bucket, object_name)
+        return self.pools[idx].put_object(bucket, object_name, data, opts)
+
+    def get_object(self, bucket, object_name, offset=0, length=-1,
+                   opts=None):
+        self.get_bucket_info(bucket)
+        return self._find_pool(bucket, object_name, opts).get_object(
+            bucket, object_name, offset, length, opts)
+
+    def get_object_info(self, bucket, object_name, opts=None) -> ObjectInfo:
+        self.get_bucket_info(bucket)
+        return self._find_pool(bucket, object_name, opts).get_object_info(
+            bucket, object_name, opts)
+
+    def delete_object(self, bucket, object_name, opts=None) -> ObjectInfo:
+        self.get_bucket_info(bucket)
+        try:
+            pool = self._find_pool(bucket, object_name)
+        except ObjectNotFound:
+            pool = self.pools[0]
+        return pool.delete_object(bucket, object_name, opts)
+
+    def list_objects(self, bucket, prefix="", marker="", delimiter="",
+                     max_keys=1000) -> ListObjectsInfo:
+        out = ListObjectsInfo()
+        objs: dict[str, ObjectInfo] = {}
+        prefixes: set[str] = set()
+        truncated = False
+        for p in self.pools:
+            res = p.list_objects(bucket, prefix, marker, delimiter, max_keys)
+            truncated = truncated or res.is_truncated
+            for o in res.objects:
+                objs.setdefault(o.name, o)
+            prefixes.update(res.prefixes)
+        names = sorted(objs)
+        for name in names:
+            out.objects.append(objs[name])
+            if len(out.objects) + len(prefixes) >= max_keys:
+                if name != names[-1] or truncated:
+                    out.is_truncated = True
+                    out.next_marker = name
+                break
+        out.prefixes = sorted(prefixes)
+        return out
+
+    def list_object_versions(self, bucket: str, prefix: str = ""):
+        out = []
+        for p in self.pools:
+            out.extend(p.list_object_versions(bucket, prefix))
+        return sorted(out, key=lambda o: o.name)
+
+    # -- multipart (upload routed to placement pool; the upload id is
+    #    looked up on every pool for the follow-up calls) ------------------
+
+    def new_multipart_upload(self, bucket, object_name, opts=None):
+        idx = self.get_pool_idx(bucket, object_name)
+        uid = self.pools[idx].new_multipart_upload(bucket, object_name, opts)
+        return uid
+
+    def _upload_pool(self, bucket, object_name, upload_id) -> ErasureSets:
+        from .interface import InvalidUploadID
+        for p in self.pools:
+            try:
+                p.list_object_parts(bucket, object_name, upload_id)
+                return p
+            except InvalidUploadID:
+                continue
+        raise InvalidUploadID(upload_id)
+
+    def put_object_part(self, bucket, object_name, upload_id, part_number,
+                        data):
+        return self._upload_pool(bucket, object_name, upload_id) \
+            .put_object_part(bucket, object_name, upload_id, part_number,
+                             data)
+
+    def list_object_parts(self, bucket, object_name, upload_id):
+        return self._upload_pool(bucket, object_name, upload_id) \
+            .list_object_parts(bucket, object_name, upload_id)
+
+    def complete_multipart_upload(self, bucket, object_name, upload_id,
+                                  parts):
+        return self._upload_pool(bucket, object_name, upload_id) \
+            .complete_multipart_upload(bucket, object_name, upload_id, parts)
+
+    def abort_multipart_upload(self, bucket, object_name, upload_id):
+        return self._upload_pool(bucket, object_name, upload_id) \
+            .abort_multipart_upload(bucket, object_name, upload_id)
+
+    def list_multipart_uploads(self, bucket, prefix=""):
+        out = []
+        for p in self.pools:
+            out.extend(p.list_multipart_uploads(bucket, prefix))
+        return sorted(out, key=lambda m: m.object_name)
+
+    # -- healing -----------------------------------------------------------
+
+    def heal_object(self, bucket, object_name, version_id=None, deep=False,
+                    dry_run=False, remove_dangling=False):
+        last = None
+        for p in self.pools:
+            try:
+                return p.heal_object(bucket, object_name, version_id, deep,
+                                     dry_run, remove_dangling)
+            except ObjectNotFound as e:
+                last = e
+        raise last
+
+    def heal_bucket(self, bucket: str) -> int:
+        return sum(p.heal_bucket(bucket) for p in self.pools)
+
+    def _fanout(self, fn):
+        return self.pools[0]._fanout(fn)

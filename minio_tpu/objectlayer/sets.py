@@ -1,0 +1,194 @@
+"""erasureSets — set-of-sets topology (cmd/erasure-sets.go:54).
+
+Objects distribute across ``set_count`` independent erasure sets by a
+deployment-id-keyed SipHash of the object name (sipHashMod,
+cmd/erasure-sets.go:629; legacy CRC mode crcHashMod :638).  Every bucket
+exists on every set; object APIs route to the hashed set; listings and
+heals fan out across sets and merge.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Optional
+
+from ..hashing.siphash import sip_hash_mod
+from ..storage import errors as serrors
+from ..storage.api import StorageAPI
+from ..storage.format import (DISTRIBUTION_ALGO_V3, FormatErasure,
+                              load_or_init_format)
+from ..storage.xl_storage import XLStorage
+from . import healing
+from .erasure_object import DEFAULT_BLOCK_SIZE, ErasureObjects
+from .interface import (BucketInfo, BucketNotFound, ListObjectsInfo,
+                        ObjectInfo, ObjectLayer, ObjectNotFound,
+                        ObjectOptions, PutObjectOptions)
+
+DISTRIBUTION_ALGO_CRC = "CRCMOD"
+
+
+class ErasureSets(ObjectLayer):
+    """cmd/erasure-sets.go erasureSets."""
+
+    def __init__(self, disks: list[Optional[StorageAPI]], set_count: int,
+                 set_drive_count: int, deployment_id: str = "",
+                 distribution_algo: str = DISTRIBUTION_ALGO_V3,
+                 **set_kwargs):
+        assert len(disks) == set_count * set_drive_count
+        self.set_count = set_count
+        self.set_drive_count = set_drive_count
+        self.deployment_id = deployment_id
+        self.distribution_algo = distribution_algo
+        self.sets = [
+            ErasureObjects(disks[i * set_drive_count:(i + 1) *
+                                 set_drive_count], **set_kwargs)
+            for i in range(set_count)]
+
+    @classmethod
+    def from_dirs(cls, dirs: list[str], set_count: int,
+                  set_drive_count: int, **set_kwargs) -> "ErasureSets":
+        """Format-aware constructor (waitForFormatErasure analog)."""
+        disks = [XLStorage(d) for d in dirs]
+        fmt = load_or_init_format(disks, set_count, set_drive_count)
+        return cls(disks, set_count, set_drive_count,
+                   deployment_id=fmt.id,
+                   distribution_algo=fmt.distribution_algo, **set_kwargs)
+
+    # -- distribution (cmd/erasure-sets.go:629-661) ------------------------
+
+    def get_hashed_set_index(self, object_name: str) -> int:
+        if self.distribution_algo == DISTRIBUTION_ALGO_CRC:
+            crc = zlib.crc32(object_name.encode()) & 0xFFFFFFFF
+            return crc % self.set_count
+        key = self.deployment_id.replace("-", "")[:32].ljust(32, "0")
+        return sip_hash_mod(object_name, self.set_count,
+                            bytes.fromhex(key))
+
+    def get_hashed_set(self, object_name: str) -> ErasureObjects:
+        return self.sets[self.get_hashed_set_index(object_name)]
+
+    # -- bucket ops: fan out to every set ---------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        self.sets[0].make_bucket(bucket)
+        for s in self.sets[1:]:
+            try:
+                s.make_bucket(bucket)
+            except Exception:  # noqa: BLE001 — partial create healed later
+                pass
+
+    def get_bucket_info(self, bucket: str) -> BucketInfo:
+        return self.sets[0].get_bucket_info(bucket)
+
+    def list_buckets(self) -> list[BucketInfo]:
+        return self.sets[0].list_buckets()
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        for s in self.sets:
+            s.delete_bucket(bucket, force)
+
+    # -- object ops: route to the hashed set ------------------------------
+
+    def put_object(self, bucket, object_name, data, opts=None) -> ObjectInfo:
+        return self.get_hashed_set(object_name).put_object(
+            bucket, object_name, data, opts)
+
+    def get_object(self, bucket, object_name, offset=0, length=-1,
+                   opts=None):
+        return self.get_hashed_set(object_name).get_object(
+            bucket, object_name, offset, length, opts)
+
+    def get_object_info(self, bucket, object_name, opts=None) -> ObjectInfo:
+        return self.get_hashed_set(object_name).get_object_info(
+            bucket, object_name, opts)
+
+    def delete_object(self, bucket, object_name, opts=None) -> ObjectInfo:
+        return self.get_hashed_set(object_name).delete_object(
+            bucket, object_name, opts)
+
+    def list_objects(self, bucket, prefix="", marker="", delimiter="",
+                     max_keys=1000) -> ListObjectsInfo:
+        """Merge per-set listings (cmd/metacache-server-pool.go analog)."""
+        self.get_bucket_info(bucket)
+        out = ListObjectsInfo()
+        per_set = [s.list_objects(bucket, prefix, marker, delimiter,
+                                  max_keys) for s in self.sets]
+        objs: dict[str, ObjectInfo] = {}
+        prefixes: set[str] = set()
+        for res in per_set:
+            for o in res.objects:
+                objs.setdefault(o.name, o)
+            prefixes.update(res.prefixes)
+        names = sorted(objs)
+        for name in names:
+            out.objects.append(objs[name])
+            if len(out.objects) + len(prefixes) >= max_keys:
+                if name != names[-1] or any(r.is_truncated for r in per_set):
+                    out.is_truncated = True
+                    out.next_marker = name
+                break
+        out.prefixes = sorted(prefixes)
+        return out
+
+    def list_object_versions(self, bucket: str, prefix: str = ""):
+        out = []
+        for s in self.sets:
+            out.extend(s.list_object_versions(bucket, prefix))
+        return sorted(out, key=lambda o: o.name)
+
+    # -- multipart: route to hashed set -----------------------------------
+
+    def new_multipart_upload(self, bucket, object_name, opts=None):
+        return self.get_hashed_set(object_name).new_multipart_upload(
+            bucket, object_name, opts)
+
+    def put_object_part(self, bucket, object_name, upload_id, part_number,
+                        data):
+        return self.get_hashed_set(object_name).put_object_part(
+            bucket, object_name, upload_id, part_number, data)
+
+    def list_object_parts(self, bucket, object_name, upload_id):
+        return self.get_hashed_set(object_name).list_object_parts(
+            bucket, object_name, upload_id)
+
+    def complete_multipart_upload(self, bucket, object_name, upload_id,
+                                  parts):
+        return self.get_hashed_set(object_name).complete_multipart_upload(
+            bucket, object_name, upload_id, parts)
+
+    def abort_multipart_upload(self, bucket, object_name, upload_id):
+        return self.get_hashed_set(object_name).abort_multipart_upload(
+            bucket, object_name, upload_id)
+
+    def list_multipart_uploads(self, bucket, prefix=""):
+        out = []
+        for s in self.sets:
+            out.extend(s.list_multipart_uploads(bucket, prefix))
+        return sorted(out, key=lambda m: m.object_name)
+
+    # -- healing -----------------------------------------------------------
+
+    def heal_object(self, bucket, object_name, version_id=None, deep=False,
+                    dry_run=False, remove_dangling=False):
+        return healing.heal_object(
+            self.get_hashed_set(object_name), bucket, object_name,
+            version_id, deep, dry_run, remove_dangling)
+
+    def heal_bucket(self, bucket: str) -> int:
+        """Recreate the bucket on any set missing it (healBucket,
+        cmd/erasure-healing.go:56); returns sets touched."""
+        healed = 0
+        for s in self.sets:
+            try:
+                s.get_bucket_info(bucket)
+            except BucketNotFound:
+                try:
+                    s.make_bucket(bucket)
+                    healed += 1
+                except Exception:  # noqa: BLE001
+                    pass
+        return healed
+
+    # internal fan-out used by BucketMetadataSys
+    def _fanout(self, fn):
+        return self.sets[0]._fanout(fn)

@@ -1,0 +1,182 @@
+"""Distributed namespace locks — dsync (pkg/dsync/drwmutex.go) +
+local locker (cmd/local-locker.go:50) + namespace map
+(cmd/namespace-lock.go:67).
+
+A DRWMutex acquires a named resource on ALL locker nodes concurrently;
+the lock is held when >= quorum grants arrive (write: n/2+1, read: n/2);
+on a failed round every grant is released and the acquire retries with
+jitter until timeout (drwmutex.go:143-321).  Lockers are in-process
+(LocalLocker) or remote over the internode RPC (RemoteLocker) — any mix.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from .rpc import RPCClient, RPCError, RPCServer
+
+
+class LockTimeout(Exception):
+    pass
+
+
+@dataclass
+class _LockEntry:
+    writer: bool
+    owners: dict[str, int] = field(default_factory=dict)  # uid -> refcount
+
+
+class LocalLocker:
+    """In-process lock table for one node (cmd/local-locker.go)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._map: dict[str, _LockEntry] = {}
+
+    def lock(self, resource: str, uid: str, write: bool) -> bool:
+        with self._mu:
+            e = self._map.get(resource)
+            if e is None:
+                self._map[resource] = _LockEntry(
+                    writer=write, owners={uid: 1})
+                return True
+            if write or e.writer:
+                return False                      # exclusive conflict
+            e.owners[uid] = e.owners.get(uid, 0) + 1
+            return True
+
+    def unlock(self, resource: str, uid: str) -> bool:
+        with self._mu:
+            e = self._map.get(resource)
+            if e is None or uid not in e.owners:
+                return False
+            e.owners[uid] -= 1
+            if e.owners[uid] <= 0:
+                del e.owners[uid]
+            if not e.owners:
+                del self._map[resource]
+            return True
+
+    def force_unlock(self, resource: str) -> bool:
+        with self._mu:
+            return self._map.pop(resource, None) is not None
+
+    def is_locked(self, resource: str) -> bool:
+        with self._mu:
+            return resource in self._map
+
+
+def register_lock_service(rpc: RPCServer, locker: LocalLocker) -> None:
+    """Expose a node's locker over RPC (cmd/lock-rest-server.go:383)."""
+    rpc.register("lock", {
+        "lock": lambda resource, uid, write:
+            locker.lock(resource, uid, write),
+        "unlock": lambda resource, uid: locker.unlock(resource, uid),
+        "force_unlock": lambda resource: locker.force_unlock(resource),
+    })
+
+
+class RemoteLocker:
+    def __init__(self, client: RPCClient):
+        self._c = client
+
+    def lock(self, resource: str, uid: str, write: bool) -> bool:
+        try:
+            return bool(self._c.call("lock", "lock", resource=resource,
+                                     uid=uid, write=write))
+        except RPCError:
+            return False
+
+    def unlock(self, resource: str, uid: str) -> bool:
+        try:
+            return bool(self._c.call("lock", "unlock", resource=resource,
+                                     uid=uid))
+        except RPCError:
+            return False
+
+    def force_unlock(self, resource: str) -> bool:
+        try:
+            return bool(self._c.call("lock", "force_unlock",
+                                     resource=resource))
+        except RPCError:
+            return False
+
+
+class DRWMutex:
+    """Quorum read-write lock over n lockers (pkg/dsync/drwmutex.go)."""
+
+    def __init__(self, lockers: list, resource: str):
+        self.lockers = lockers
+        self.resource = resource
+        self.uid = str(uuid.uuid4())
+        self._granted: list[bool] = [False] * len(lockers)
+
+    def _quorum(self, write: bool) -> int:
+        n = len(self.lockers)
+        tolerance = n // 2
+        q = n - tolerance
+        if write and q == tolerance:
+            q += 1                                 # drwmutex.go:164-175
+        return q
+
+    def _try_acquire(self, write: bool) -> bool:
+        granted = []
+        for i, lk in enumerate(self.lockers):
+            ok = False
+            try:
+                ok = lk.lock(self.resource, self.uid, write)
+            except Exception:  # noqa: BLE001 — locker down == not granted
+                ok = False
+            self._granted[i] = ok
+            granted.append(ok)
+        if sum(granted) >= self._quorum(write):
+            return True
+        self._release_all()
+        return False
+
+    def _release_all(self) -> None:
+        for i, lk in enumerate(self.lockers):
+            if self._granted[i]:
+                try:
+                    lk.unlock(self.resource, self.uid)
+                except Exception:  # noqa: BLE001
+                    pass
+                self._granted[i] = False
+
+    def lock(self, write: bool = True, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout
+        while True:
+            if self._try_acquire(write):
+                return
+            if time.monotonic() >= deadline:
+                raise LockTimeout(self.resource)
+            time.sleep(random.uniform(0.002, 0.02))   # retry jitter :299-321
+
+    def unlock(self) -> None:
+        self._release_all()
+
+    def __enter__(self):
+        self.lock(write=True)
+        return self
+
+    def __exit__(self, *exc):
+        self.unlock()
+
+
+class NamespaceLock:
+    """Per-object lock factory (cmd/namespace-lock.go NewNSLock).
+
+    Standalone mode uses one in-process locker; distributed mode hands in
+    every node's locker (local + remote).
+    """
+
+    def __init__(self, lockers: list | None = None):
+        self.lockers = lockers if lockers is not None else [LocalLocker()]
+
+    def new_lock(self, bucket: str, *objects: str) -> DRWMutex:
+        resource = bucket + "/" + ",".join(objects)
+        return DRWMutex(self.lockers, resource)

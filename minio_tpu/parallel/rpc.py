@@ -1,0 +1,178 @@
+"""Internode RPC — the DCN control plane (cmd/rest/client.go:174,
+cmd/storage-rest-server.go).
+
+The reference runs three internal REST services (storage, lock, peer) on
+the main listener with per-request JWT auth and msgpack payloads.  Here:
+one RPC endpoint ``POST /rpc/<service>/<method>`` with msgpack bodies and
+an HMAC bearer token minted per request (cmd/jwt.go:161 analog).  Device
+data never rides this path — erasure compute stays on the accelerator;
+this carries shard files, metadata, and lock traffic between hosts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import threading
+import time
+import urllib.parse
+import http.client
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import msgpack
+
+TOKEN_WINDOW_S = 15 * 60
+
+
+class RPCError(Exception):
+    def __init__(self, error_type: str, message: str):
+        super().__init__(f"{error_type}: {message}")
+        self.error_type = error_type
+        self.message = message
+
+
+def mint_token(secret: str, path: str, now: float | None = None) -> str:
+    ts = str(int(now if now is not None else time.time()))
+    mac = hmac.new(secret.encode(), f"{ts}:{path}".encode(),
+                   hashlib.sha256).hexdigest()
+    return f"{ts}.{mac}"
+
+
+def check_token(secret: str, path: str, token: str,
+                now: float | None = None) -> bool:
+    try:
+        ts, mac = token.split(".", 1)
+        age = abs((now if now is not None else time.time()) - int(ts))
+    except ValueError:
+        return False
+    if age > TOKEN_WINDOW_S:
+        return False
+    want = hmac.new(secret.encode(), f"{ts}:{path}".encode(),
+                    hashlib.sha256).hexdigest()
+    return hmac.compare_digest(want, mac)
+
+
+class RPCServer:
+    """Registry + HTTP server for node-local services."""
+
+    def __init__(self, secret: str, host: str = "127.0.0.1", port: int = 0):
+        self.secret = secret
+        self._services: dict[str, dict[str, callable]] = {}
+        handler = self._make_handler()
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self.host = host
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def register(self, service: str, methods: dict[str, callable]) -> None:
+        self._services.setdefault(service, {}).update(methods)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+    def _make_handler(srv_self):
+        services = srv_self._services
+        secret = srv_self.secret
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, status: int, doc: dict):
+                body = msgpack.packb(doc, use_bin_type=True)
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Content-Type", "application/msgpack")
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                path = urllib.parse.urlsplit(self.path).path
+                auth = self.headers.get("Authorization", "")
+                if not (auth.startswith("Bearer ") and
+                        check_token(secret, path, auth[7:])):
+                    return self._reply(403, {"ok": False,
+                                             "error_type": "AuthError",
+                                             "message": "bad token"})
+                parts = path.strip("/").split("/")
+                if len(parts) != 3 or parts[0] != "rpc":
+                    return self._reply(404, {"ok": False,
+                                             "error_type": "NotFound",
+                                             "message": path})
+                fn = services.get(parts[1], {}).get(parts[2])
+                if fn is None:
+                    return self._reply(404, {"ok": False,
+                                             "error_type": "NoSuchMethod",
+                                             "message": path})
+                n = int(self.headers.get("Content-Length") or 0)
+                try:
+                    kwargs = msgpack.unpackb(self.rfile.read(n), raw=False) \
+                        if n else {}
+                    result = fn(**kwargs)
+                    self._reply(200, {"ok": True, "result": result})
+                except Exception as e:  # noqa: BLE001 — typed over the wire
+                    self._reply(200, {
+                        "ok": False,
+                        "error_type": type(e).__name__,
+                        "message": str(e)})
+
+        return Handler
+
+
+class RPCClient:
+    """Health-checked client to one peer node
+    (cmd/storage-rest-client.go:651 pattern: a failed call marks the peer
+    offline; a background or next-use probe brings it back)."""
+
+    def __init__(self, endpoint: str, secret: str, timeout: float = 30.0):
+        u = urllib.parse.urlsplit(endpoint)
+        self.host, self.port = u.hostname, u.port
+        self.endpoint = endpoint
+        self.secret = secret
+        self.timeout = timeout
+        self._online = True
+        self._last_failure = 0.0
+        self._retry_after = 3.0
+
+    def is_online(self) -> bool:
+        if not self._online and \
+                time.time() - self._last_failure > self._retry_after:
+            self._online = True  # optimistic reconnect on next call
+        return self._online
+
+    def call(self, service: str, method: str, **kwargs):
+        if not self.is_online():
+            raise RPCError("PeerOffline", self.endpoint)
+        path = f"/rpc/{service}/{method}"
+        body = msgpack.packb(kwargs, use_bin_type=True)
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+        try:
+            conn.request("POST", path, body=body, headers={
+                "Authorization": f"Bearer {mint_token(self.secret, path)}",
+                "Content-Type": "application/msgpack"})
+            resp = conn.getresponse()
+            doc = msgpack.unpackb(resp.read(), raw=False)
+        except (OSError, http.client.HTTPException) as e:
+            self._online = False
+            self._last_failure = time.time()
+            raise RPCError("ConnectionError", str(e)) from e
+        finally:
+            conn.close()
+        if not doc.get("ok"):
+            raise RPCError(doc.get("error_type", "Unknown"),
+                           doc.get("message", ""))
+        return doc.get("result")

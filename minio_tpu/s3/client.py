@@ -46,6 +46,7 @@ class S3Client:
     def request(self, method: str, path: str, query: str = "",
                 body: bytes = b"", headers: dict | None = None,
                 sign: bool = True, expect=(200, 204, 206)) -> S3Response:
+        path = urllib.parse.quote(path, safe="/~-._")  # keys may have spaces
         url = self.endpoint + path + (f"?{query}" if query else "")
         hdrs = dict(headers or {})
         if sign:

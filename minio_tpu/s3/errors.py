@@ -35,6 +35,9 @@ _ERRORS = {
         "object size.", 400),
     "ExpiredToken": APIError(
         "ExpiredToken", "The provided token has expired.", 400),
+    "IncompleteBody": APIError(
+        "IncompleteBody", "You did not provide the number of bytes "
+        "specified by the Content-Length HTTP header.", 400),
     "InternalError": APIError(
         "InternalError", "We encountered an internal error, please try "
         "again.", 500),

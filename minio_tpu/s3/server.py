@@ -496,15 +496,14 @@ def _make_handler(srv: S3Server):
                 vid = ""
             opts = ol.ObjectOptions(version_id=vid)
             rng = self.headers.get("Range")
+            offset, length = 0, -1
             try:
                 if head:
                     oi = srv.layer.get_object_info(bucket, key, opts)
                     data = None
                 else:
-                    offset, length = 0, -1
                     if rng:
-                        oi0 = srv.layer.get_object_info(bucket, key, opts)
-                        offset, length = _parse_range(rng, oi0.size)
+                        offset, length = _parse_range(rng)
                     oi, data = srv.layer.get_object(bucket, key, offset,
                                                     length, opts)
             except ol.MethodNotAllowed:
@@ -533,7 +532,7 @@ def _make_handler(srv: S3Server):
                 return self._send(200, b"", content_type=ct, headers=hdrs,
                                   content_length=oi.size)
             if rng:
-                start = _parse_range(rng, oi.size)[0]
+                start = oi.size - len(data) if offset < 0 else offset
                 hdrs["Content-Range"] = \
                     f"bytes {start}-{start + len(data) - 1}/{oi.size}"
                 return self._send(206, data, content_type=ct, headers=hdrs)
@@ -558,8 +557,11 @@ def _make_handler(srv: S3Server):
     return Handler
 
 
-def _parse_range(spec: str, size: int) -> tuple[int, int]:
-    """HTTP Range -> (offset, length) (cmd/httprange.go)."""
+def _parse_range(spec: str) -> tuple[int, int]:
+    """HTTP Range -> (offset, length) without knowing the size
+    (cmd/httprange.go); negative offset = suffix, length -1 = to-end.
+    Size-dependent validation/clamping happens in the object layer, so a
+    ranged GET costs a single quorum metadata read."""
     m = re.match(r"^bytes=(\d*)-(\d*)$", spec.strip())
     if not m:
         raise S3Error("InvalidRange")
@@ -570,14 +572,11 @@ def _parse_range(spec: str, size: int) -> tuple[int, int]:
         n = int(last)
         if n == 0:
             raise S3Error("InvalidRange")
-        start = max(0, size - n)
-        return start, size - start
+        return -n, -1
     start = int(first)
-    if start >= size:
-        raise S3Error("InvalidRange")
     if last == "":
-        return start, size - start
-    end = min(int(last), size - 1)
+        return start, -1
+    end = int(last)
     if end < start:
         raise S3Error("InvalidRange")
     return start, end - start + 1

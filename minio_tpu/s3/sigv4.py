@@ -94,6 +94,9 @@ def sign_request(creds: Credentials, method: str, url: str,
                  ) -> dict[str, str]:
     """Sign; returns the full header set to send (signed-payload mode)."""
     u = urllib.parse.urlsplit(url)
+    # the wire path may be %-encoded; canonicalize from the DECODED path
+    # (matching the server, which unquotes before re-encoding)
+    path = urllib.parse.unquote(u.path)
     query = urllib.parse.parse_qs(u.query, keep_blank_values=True)
     ts = timestamp or datetime.datetime.now(datetime.timezone.utc)
     amz_date = ts.strftime(ISO8601)
@@ -105,7 +108,7 @@ def sign_request(creds: Credentials, method: str, url: str,
     out["x-amz-content-sha256"] = payload_hash
     signed = sorted(h.lower() for h in out)
     scope = f"{date}/{region}/{service}/aws4_request"
-    canon = canonical_request(method, u.path or "/", query,
+    canon = canonical_request(method, path or "/", query,
                               {k.lower(): v for k, v in out.items()},
                               signed, payload_hash)
     sts = string_to_sign(amz_date, scope, canon)
@@ -126,6 +129,7 @@ def sign_request_streaming(creds: Credentials, method: str, url: str,
     """Client-side aws-chunked upload: returns (headers, framed_body).
     Mirrors what aws SDKs send for STREAMING-AWS4-HMAC-SHA256-PAYLOAD."""
     u = urllib.parse.urlsplit(url)
+    upath = urllib.parse.unquote(u.path)
     query = urllib.parse.parse_qs(u.query, keep_blank_values=True)
     ts = timestamp or datetime.datetime.now(datetime.timezone.utc)
     amz_date = ts.strftime(ISO8601)
@@ -138,7 +142,7 @@ def sign_request_streaming(creds: Credentials, method: str, url: str,
     out["content-encoding"] = "aws-chunked"
     out["x-amz-decoded-content-length"] = str(len(payload))
     signed = sorted(h.lower() for h in out)
-    canon = canonical_request(method, u.path or "/", query,
+    canon = canonical_request(method, upath or "/", query,
                               {k.lower(): v for k, v in out.items()},
                               signed, STREAMING_PAYLOAD)
     sts = string_to_sign(amz_date, scope, canon)
@@ -167,6 +171,7 @@ def presign_url(creds: Credentials, method: str, url: str,
                 timestamp: datetime.datetime | None = None) -> str:
     """Generate a presigned URL (query-string auth)."""
     u = urllib.parse.urlsplit(url)
+    path = urllib.parse.unquote(u.path)
     query = urllib.parse.parse_qs(u.query, keep_blank_values=True)
     ts = timestamp or datetime.datetime.now(datetime.timezone.utc)
     amz_date = ts.strftime(ISO8601)
@@ -179,7 +184,7 @@ def presign_url(creds: Credentials, method: str, url: str,
         "X-Amz-Expires": [str(expires)],
         "X-Amz-SignedHeaders": ["host"],
     })
-    canon = canonical_request(method, u.path or "/", query,
+    canon = canonical_request(method, path or "/", query,
                               {"host": u.netloc}, ["host"],
                               UNSIGNED_PAYLOAD)
     sts = string_to_sign(amz_date, scope, canon)
@@ -350,8 +355,12 @@ def verify_presigned(lookup_secret, method: str, path: str,
     secret = lookup_secret(access_key)
     if secret is None:
         raise SigV4Error("InvalidAccessKeyId", access_key)
-    req_time = datetime.datetime.strptime(amz_date, ISO8601).replace(
-        tzinfo=datetime.timezone.utc)
+    try:
+        req_time = datetime.datetime.strptime(amz_date, ISO8601).replace(
+            tzinfo=datetime.timezone.utc)
+    except ValueError as e:
+        raise SigV4Error("AuthorizationQueryParametersError",
+                         "malformed X-Amz-Date") from e
     now = now or datetime.datetime.now(datetime.timezone.utc)
     if now < req_time - datetime.timedelta(minutes=15):
         raise SigV4Error("RequestTimeTooSkewed", amz_date)

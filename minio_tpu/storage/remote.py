@@ -1,0 +1,245 @@
+"""Remote drive access over internode RPC
+(cmd/storage-rest-{client,server}.go).
+
+Every StorageAPI method of a local drive is exported as an RPC method; the
+client side is a full StorageAPI so erasure sets treat remote drives
+exactly like local ones.  Errors are re-raised as their typed storage
+exceptions so quorum reduction works unchanged across the node boundary.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from ..parallel.rpc import RPCClient, RPCError, RPCServer
+from . import errors as serrors
+from .api import DiskInfo, StorageAPI, VolInfo
+from .datatypes import FileInfo
+from .xl_storage import XLStorage
+
+_ERR_TYPES = {cls.__name__: cls for cls in [
+    serrors.DiskNotFound, serrors.UnformattedDisk, serrors.CorruptedFormat,
+    serrors.DiskFull, serrors.VolumeNotFound, serrors.VolumeExists,
+    serrors.VolumeNotEmpty, serrors.FileNotFound,
+    serrors.FileVersionNotFound, serrors.FileNameTooLong,
+    serrors.FileAccessDenied, serrors.FileCorrupt, serrors.IsNotRegular,
+    serrors.PathNotEmpty, serrors.DiskAccessDenied, serrors.FaultyDisk,
+    serrors.MethodNotAllowed,
+]}
+
+
+def register_storage_service(rpc: RPCServer,
+                             drives: dict[str, XLStorage]) -> None:
+    """Export local drives (keyed by drive id/path) on a node's RPC server
+    (storage-rest-server.go handler table)."""
+
+    def drive(drive_id: str) -> XLStorage:
+        d = drives.get(drive_id)
+        if d is None:
+            raise serrors.DiskNotFound(drive_id)
+        return d
+
+    methods = {
+        "disk_info": lambda drive_id: vars(drive(drive_id).disk_info()),
+        "make_vol": lambda drive_id, volume:
+            drive(drive_id).make_vol(volume),
+        "list_vols": lambda drive_id: [
+            {"name": v.name, "created": v.created}
+            for v in drive(drive_id).list_vols()],
+        "stat_vol": lambda drive_id, volume:
+            (lambda v: {"name": v.name, "created": v.created})(
+                drive(drive_id).stat_vol(volume)),
+        "delete_vol": lambda drive_id, volume, force:
+            drive(drive_id).delete_vol(volume, force),
+        "list_dir": lambda drive_id, volume, dir_path, count:
+            drive(drive_id).list_dir(volume, dir_path, count),
+        "read_all": lambda drive_id, volume, path:
+            drive(drive_id).read_all(volume, path),
+        "write_all": lambda drive_id, volume, path, data:
+            drive(drive_id).write_all(volume, path, data),
+        "create_file": lambda drive_id, volume, path, data, file_size:
+            drive(drive_id).create_file(volume, path, data, file_size),
+        "append_file": lambda drive_id, volume, path, data:
+            drive(drive_id).append_file(volume, path, data),
+        "read_file_stream": lambda drive_id, volume, path, offset, length:
+            drive(drive_id).read_file_stream(volume, path, offset, length),
+        "rename_file": lambda drive_id, src_volume, src_path, dst_volume,
+            dst_path: drive(drive_id).rename_file(
+                src_volume, src_path, dst_volume, dst_path),
+        "delete": lambda drive_id, volume, path, recursive:
+            drive(drive_id).delete(volume, path, recursive),
+        "stat_info_file": lambda drive_id, volume, path:
+            drive(drive_id).stat_info_file(volume, path),
+        "rename_data": lambda drive_id, src_volume, src_path, fi,
+            dst_volume, dst_path: drive(drive_id).rename_data(
+                src_volume, src_path, FileInfo.from_dict(fi), dst_volume,
+                dst_path),
+        "write_metadata": lambda drive_id, volume, path, fi:
+            drive(drive_id).write_metadata(volume, path,
+                                           FileInfo.from_dict(fi)),
+        "update_metadata": lambda drive_id, volume, path, fi:
+            drive(drive_id).update_metadata(volume, path,
+                                            FileInfo.from_dict(fi)),
+        "read_version": lambda drive_id, volume, path, version_id,
+            read_data: drive(drive_id).read_version(
+                volume, path, version_id, read_data).to_dict(),
+        "list_versions": lambda drive_id, volume, path: [
+            fi.to_dict()
+            for fi in drive(drive_id).list_versions(volume, path)],
+        "delete_version": lambda drive_id, volume, path, fi,
+            force_del_marker: drive(drive_id).delete_version(
+                volume, path, FileInfo.from_dict(fi), force_del_marker),
+        "verify_file": lambda drive_id, volume, path, fi:
+            drive(drive_id).verify_file(volume, path,
+                                        FileInfo.from_dict(fi)),
+        "check_parts": lambda drive_id, volume, path, fi:
+            drive(drive_id).check_parts(volume, path,
+                                        FileInfo.from_dict(fi)),
+        "walk_dir": lambda drive_id, volume, base_dir, recursive:
+            list(drive(drive_id).walk_dir(volume, base_dir, recursive)),
+        "tmp_dir": lambda drive_id: drive(drive_id).tmp_dir(),
+        "clean_tmp": lambda drive_id, rel_dir:
+            drive(drive_id).clean_tmp(rel_dir),
+        "get_disk_id": lambda drive_id: drive(drive_id).get_disk_id(),
+        "set_disk_id": lambda drive_id, disk_id:
+            drive(drive_id).set_disk_id(disk_id),
+    }
+    rpc.register("storage", methods)
+
+
+class RemoteStorage(StorageAPI):
+    """StorageAPI over RPC to a peer node's drive
+    (cmd/storage-rest-client.go)."""
+
+    def __init__(self, client: RPCClient, drive_id: str):
+        self._c = client
+        self.drive_id = drive_id
+
+    def _call(self, method: str, **kwargs):
+        try:
+            return self._c.call("storage", method, drive_id=self.drive_id,
+                                **kwargs)
+        except RPCError as e:
+            cls = _ERR_TYPES.get(e.error_type)
+            if cls is not None:
+                raise cls(e.message) from e
+            raise serrors.DiskNotFound(
+                f"{self._c.endpoint}/{self.drive_id}: {e}") from e
+
+    # identity / health
+    def is_online(self) -> bool:
+        return self._c.is_online()
+
+    def endpoint(self) -> str:
+        return f"{self._c.endpoint}/{self.drive_id}"
+
+    def is_local(self) -> bool:
+        return False
+
+    def get_disk_id(self) -> str:
+        return self._call("get_disk_id")
+
+    def set_disk_id(self, disk_id: str) -> None:
+        self._call("set_disk_id", disk_id=disk_id)
+
+    def disk_info(self) -> DiskInfo:
+        return DiskInfo(**self._call("disk_info"))
+
+    def close(self) -> None:
+        pass
+
+    # volumes
+    def make_vol(self, volume):
+        self._call("make_vol", volume=volume)
+
+    def list_vols(self):
+        return [VolInfo(v["name"], v["created"])
+                for v in self._call("list_vols")]
+
+    def stat_vol(self, volume):
+        v = self._call("stat_vol", volume=volume)
+        return VolInfo(v["name"], v["created"])
+
+    def delete_vol(self, volume, force=False):
+        self._call("delete_vol", volume=volume, force=force)
+
+    # files
+    def list_dir(self, volume, dir_path, count=-1):
+        return self._call("list_dir", volume=volume, dir_path=dir_path,
+                          count=count)
+
+    def read_all(self, volume, path):
+        return self._call("read_all", volume=volume, path=path)
+
+    def write_all(self, volume, path, data):
+        self._call("write_all", volume=volume, path=path, data=bytes(data))
+
+    def create_file(self, volume, path, data, file_size=-1):
+        self._call("create_file", volume=volume, path=path,
+                   data=bytes(data), file_size=file_size)
+
+    def append_file(self, volume, path, data):
+        self._call("append_file", volume=volume, path=path,
+                   data=bytes(data))
+
+    def read_file_stream(self, volume, path, offset, length):
+        return self._call("read_file_stream", volume=volume, path=path,
+                          offset=offset, length=length)
+
+    def rename_file(self, src_volume, src_path, dst_volume, dst_path):
+        self._call("rename_file", src_volume=src_volume, src_path=src_path,
+                   dst_volume=dst_volume, dst_path=dst_path)
+
+    def delete(self, volume, path, recursive=False):
+        self._call("delete", volume=volume, path=path, recursive=recursive)
+
+    def stat_info_file(self, volume, path):
+        return self._call("stat_info_file", volume=volume, path=path)
+
+    # metadata
+    def rename_data(self, src_volume, src_path, fi, dst_volume, dst_path):
+        self._call("rename_data", src_volume=src_volume, src_path=src_path,
+                   fi=fi.to_dict(), dst_volume=dst_volume,
+                   dst_path=dst_path)
+
+    def write_metadata(self, volume, path, fi):
+        self._call("write_metadata", volume=volume, path=path,
+                   fi=fi.to_dict())
+
+    def update_metadata(self, volume, path, fi):
+        self._call("update_metadata", volume=volume, path=path,
+                   fi=fi.to_dict())
+
+    def read_version(self, volume, path, version_id=None, read_data=False):
+        return FileInfo.from_dict(self._call(
+            "read_version", volume=volume, path=path, version_id=version_id,
+            read_data=read_data))
+
+    def list_versions(self, volume, path):
+        return [FileInfo.from_dict(d)
+                for d in self._call("list_versions", volume=volume,
+                                    path=path)]
+
+    def delete_version(self, volume, path, fi, force_del_marker=False):
+        self._call("delete_version", volume=volume, path=path,
+                   fi=fi.to_dict(), force_del_marker=force_del_marker)
+
+    # integrity
+    def verify_file(self, volume, path, fi):
+        self._call("verify_file", volume=volume, path=path, fi=fi.to_dict())
+
+    def check_parts(self, volume, path, fi):
+        self._call("check_parts", volume=volume, path=path,
+                   fi=fi.to_dict())
+
+    # walking
+    def walk_dir(self, volume, base_dir="", recursive=True) -> Iterable[str]:
+        return iter(self._call("walk_dir", volume=volume, base_dir=base_dir,
+                               recursive=recursive))
+
+    # staging
+    def tmp_dir(self) -> str:
+        return self._call("tmp_dir")
+
+    def clean_tmp(self, rel_dir: str) -> None:
+        self._call("clean_tmp", rel_dir=rel_dir)

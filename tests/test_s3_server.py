@@ -229,10 +229,21 @@ def test_oversized_content_length_rejected(server, client):
 
 
 def test_parse_range_unit():
-    assert _parse_range("bytes=0-9", 100) == (0, 10)
-    assert _parse_range("bytes=50-", 100) == (50, 50)
-    assert _parse_range("bytes=-20", 100) == (80, 20)
-    assert _parse_range("bytes=0-1000", 100) == (0, 100)
-    for bad in ["bytes=-", "bytes=5-2", "bytes=100-", "junk"]:
+    # size-independent form: suffix = negative offset, -1 length = to-end
+    assert _parse_range("bytes=0-9") == (0, 10)
+    assert _parse_range("bytes=50-") == (50, -1)
+    assert _parse_range("bytes=-20") == (-20, -1)
+    assert _parse_range("bytes=0-1000") == (0, 1001)
+    for bad in ["bytes=-", "bytes=5-2", "bytes=-0", "junk"]:
         with pytest.raises(S3Error):
-            _parse_range(bad, 100)
+            _parse_range(bad)
+
+
+def test_key_with_spaces_and_unicode(client):
+    client.make_bucket("specialkeys")
+    for key in ["my file.txt", "päth/ünïcode obj", "a+b&c=d.txt"]:
+        client.put_object("specialkeys", key, key.encode())
+        got = client.get_object("specialkeys", key)
+        assert got.body == key.encode(), key
+    objs, _ = client.list_objects("specialkeys")
+    assert len(objs) == 3
