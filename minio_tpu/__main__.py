@@ -1,0 +1,3 @@
+from .server_main import main
+
+raise SystemExit(main())

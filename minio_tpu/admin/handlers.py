@@ -1,0 +1,192 @@
+"""Admin API (cmd/admin-router.go:38, cmd/admin-handlers*.go — the
+operations surface: server info, config KV, heal, user/policy management,
+Prometheus metrics).
+
+Routes live under ``/minio-tpu/admin/v1/`` on the same listener as S3
+(mirroring the reference's /minio/admin/v3).  All admin calls require a
+SigV4-authenticated identity allowed for ``admin:*`` actions; the metrics
+endpoint is Prometheus text and public by default (configurable upstream).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from ..iam import policy as iampol
+from ..iam.sys import IAMError, NoSuchPolicy, NoSuchUser
+from ..objectlayer import healing
+from . import metrics
+
+ADMIN_PREFIX = "/minio-tpu/admin/v1"
+METRICS_PATH = "/minio-tpu/metrics"
+
+_START = time.time()
+
+
+def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
+    """Dispatch admin/metrics routes; returns True when handled.
+
+    ``h`` is the HTTP handler (gives _send/_fail/command/access_key),
+    ``srv`` the S3Server (gives layer/iam/config).
+    """
+    if path == METRICS_PATH:
+        body = metrics.render(srv.layer).encode()
+        h._send(200, body, content_type="text/plain; version=0.0.4")
+        return True
+    if not path.startswith(ADMIN_PREFIX + "/"):
+        return False
+    # every admin route requires an admin-capable identity
+    if not srv.iam.is_allowed(h.access_key, iampol.ADMIN_ALL):
+        from ..s3.server import S3Error
+        raise S3Error("AccessDenied")
+    route = path[len(ADMIN_PREFIX) + 1:]
+    q1 = {k: v[0] for k, v in query.items()}
+
+    def send_json(doc, status=200):
+        h._send(status, json.dumps(doc).encode(),
+                content_type="application/json")
+
+    try:
+        if route == "info" and h.command == "GET":
+            return send_json(_server_info(srv)) or True
+        if route.startswith("config"):
+            return _config(h, srv, route, q1, payload, send_json)
+        if route.startswith("heal") and h.command == "POST":
+            return _heal(h, srv, route, q1, send_json)
+        if route == "add-user" and h.command == "POST":
+            doc = json.loads(payload)
+            srv.iam.add_user(doc["accessKey"], doc["secretKey"],
+                             doc.get("policies", []))
+            return send_json({"status": "ok"}) or True
+        if route == "list-users" and h.command == "GET":
+            return send_json({
+                u.access_key: {"status": u.status, "policies": u.policies}
+                for u in srv.iam.list_users()}) or True
+        if route == "remove-user" and h.command == "POST":
+            srv.iam.remove_user(q1["accessKey"])
+            return send_json({"status": "ok"}) or True
+        if route == "set-user-status" and h.command == "POST":
+            status = q1.get("status")
+            if status not in ("enabled", "disabled"):
+                return send_json(
+                    {"error": "status must be enabled|disabled"}, 400) \
+                    or True
+            srv.iam.set_user_status(q1["accessKey"], status == "enabled")
+            return send_json({"status": "ok"}) or True
+        if route == "set-user-policy" and h.command == "POST":
+            srv.iam.attach_policy(
+                q1["accessKey"],
+                [p for p in q1.get("policies", "").split(",") if p])
+            return send_json({"status": "ok"}) or True
+        if route == "add-service-account" and h.command == "POST":
+            doc = json.loads(payload) if payload else {}
+            sa = srv.iam.new_service_account(
+                doc.get("parent", h.access_key),
+                doc.get("accessKey"), doc.get("secretKey"))
+            return send_json({"accessKey": sa.access_key,
+                              "secretKey": sa.secret_key}) or True
+        if route.startswith("policy"):
+            return _policy(h, srv, route, payload, send_json)
+    except (KeyError, json.JSONDecodeError) as e:
+        return send_json({"error": f"bad request: {e}"}, 400) or True
+    except (NoSuchUser, NoSuchPolicy) as e:
+        return send_json({"error": str(e)}, 404) or True
+    except IAMError as e:
+        return send_json({"error": str(e)}, 400) or True
+    from ..s3.server import S3Error
+    raise S3Error("MethodNotAllowed")
+
+
+def _server_info(srv) -> dict:
+    """madmin ServerInfo analog (cmd/admin-handlers.go ServerInfoHandler)."""
+    disks = metrics._collect_disks(srv.layer)
+    dinfo = []
+    for d in disks:
+        if d is None:
+            dinfo.append({"state": "offline"})
+            continue
+        try:
+            info = d.disk_info()
+            dinfo.append({
+                "state": "ok", "endpoint": info.endpoint,
+                "total": info.total, "free": info.free,
+                "disk_id": info.disk_id})
+        except Exception as e:  # noqa: BLE001
+            dinfo.append({"state": "faulty", "error": str(e)})
+    buckets = []
+    try:
+        buckets = [b.name for b in srv.layer.list_buckets()]
+    except Exception:  # noqa: BLE001
+        pass
+    return {
+        "mode": "distributed-erasure-tpu",
+        "region": srv.region,
+        "uptime_seconds": round(time.time() - _START, 1),
+        "drives": dinfo,
+        "buckets": buckets,
+        "backend_version": 1,
+    }
+
+
+def _config(h, srv, route, q1, payload, send_json) -> bool:
+    parts = route.split("/")
+    cfg = srv.config
+    if h.command == "GET" and len(parts) == 1:
+        return send_json({s: cfg.get_subsys(s)
+                          for s in cfg.subsystems()}) or True
+    if h.command == "GET" and len(parts) == 2:
+        return send_json(cfg.get_subsys(parts[1])) or True
+    if h.command == "PUT" and len(parts) == 3:
+        cfg.set(parts[1], parts[2], payload.decode())
+        return send_json({"status": "ok"}) or True
+    from ..s3.server import S3Error
+    raise S3Error("MethodNotAllowed")
+
+
+def _heal(h, srv, route, q1, send_json) -> bool:
+    """Synchronous heal trigger (admin-heal-ops sequence, simplified):
+    POST heal/<bucket>[/<prefix>] heals the bucket and every matching
+    object; returns per-object results."""
+    parts = route.split("/", 2)
+    bucket = parts[1] if len(parts) > 1 else ""
+    prefix = parts[2] if len(parts) > 2 else ""
+    deep = q1.get("scan") == "deep"
+    remove = q1.get("remove") == "true"
+    results = []
+    layer = srv.layer
+    if not bucket:
+        return send_json({"error": "bucket required"}, 400) or True
+    healed_sets = layer.heal_bucket(bucket) \
+        if hasattr(layer, "heal_bucket") else 0
+    out = layer.list_objects(bucket, prefix=prefix, max_keys=10000)
+    for oi in out.objects:
+        try:
+            r = layer.heal_object(bucket, oi.name, deep=deep,
+                                  remove_dangling=remove)
+            results.append({
+                "object": oi.name, "before_ok": r.before_ok,
+                "after_ok": r.after_ok, "healed": r.healed_disks,
+                "dangling_purged": r.dangling_purged})
+        except Exception as e:  # noqa: BLE001
+            results.append({"object": oi.name, "error": str(e)})
+    return send_json({"bucket": bucket, "bucket_sets_healed": healed_sets,
+                      "objects": results}) or True
+
+
+def _policy(h, srv, route, payload, send_json) -> bool:
+    parts = route.split("/", 1)
+    if h.command == "GET" and len(parts) == 1:
+        return send_json({"policies": srv.iam.list_policies()}) or True
+    name = parts[1]
+    if h.command == "GET":
+        return send_json(json.loads(srv.iam.get_policy(name).to_json())) \
+            or True
+    if h.command == "PUT":
+        srv.iam.set_policy(name, iampol.Policy.from_json(payload))
+        return send_json({"status": "ok"}) or True
+    if h.command == "DELETE":
+        srv.iam.delete_policy(name)
+        return send_json({"status": "ok"}) or True
+    from ..s3.server import S3Error
+    raise S3Error("MethodNotAllowed")

@@ -1,0 +1,97 @@
+"""Prometheus metrics (cmd/metrics-v2.go namespaces minio_{s3,node,cluster}).
+
+A process-wide registry of counters/gauges rendered in Prometheus text
+exposition format at /minio-tpu/metrics.  The S3 frontend increments
+request/byte counters per API; the object layer contributes capacity and
+healing gauges on scrape.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+_START = time.time()
+
+
+class Metrics:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._counters: dict[tuple, float] = defaultdict(float)
+
+    def inc(self, name: str, labels: dict[str, str] | None = None,
+            value: float = 1.0) -> None:
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._mu:
+            self._counters[key] += value
+
+    def snapshot(self) -> dict[tuple, float]:
+        with self._mu:
+            return dict(self._counters)
+
+
+GLOBAL = Metrics()
+
+
+def _fmt_labels(labels: tuple) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+def render(layer=None) -> str:
+    """Prometheus text format: counters + live gauges from the layer."""
+    lines = [
+        "# HELP mt_up Server is up.",
+        "# TYPE mt_up gauge",
+        "mt_up 1",
+        "# HELP mt_uptime_seconds Process uptime.",
+        "# TYPE mt_uptime_seconds gauge",
+        f"mt_uptime_seconds {time.time() - _START:.1f}",
+    ]
+    counters = GLOBAL.snapshot()
+    seen_names = set()
+    for (name, labels), value in sorted(counters.items()):
+        if name not in seen_names:
+            lines.append(f"# TYPE {name} counter")
+            seen_names.add(name)
+        lines.append(f"{name}{_fmt_labels(labels)} {value:g}")
+    if layer is not None:
+        try:
+            disks = _collect_disks(layer)
+            online = sum(1 for d in disks if d is not None)
+            lines += [
+                "# TYPE mt_cluster_disk_online_total gauge",
+                f"mt_cluster_disk_online_total {online}",
+                "# TYPE mt_cluster_disk_offline_total gauge",
+                f"mt_cluster_disk_offline_total {len(disks) - online}",
+            ]
+            total = free = 0
+            for d in disks:
+                if d is None:
+                    continue
+                try:
+                    info = d.disk_info()
+                    total += info.total
+                    free += info.free
+                except Exception:  # noqa: BLE001
+                    continue
+            lines += [
+                "# TYPE mt_cluster_capacity_raw_total_bytes gauge",
+                f"mt_cluster_capacity_raw_total_bytes {total}",
+                "# TYPE mt_cluster_capacity_raw_free_bytes gauge",
+                f"mt_cluster_capacity_raw_free_bytes {free}",
+            ]
+        except Exception:  # noqa: BLE001 — metrics must never fail a scrape
+            pass
+    return "\n".join(lines) + "\n"
+
+
+def _collect_disks(layer):
+    if hasattr(layer, "pools"):
+        return [d for p in layer.pools for s in p.sets for d in s.disks]
+    if hasattr(layer, "sets"):
+        return [d for s in layer.sets for d in s.disks]
+    return list(layer.disks)

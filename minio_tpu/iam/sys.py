@@ -1,0 +1,254 @@
+"""IAMSys — identity & access management (cmd/iam.go:204).
+
+Users, groups, service accounts, and named policies, persisted in the
+object namespace under the system volume (the reference's
+IAMObjectStore, cmd/iam-object-store.go) with in-memory caching and
+quorum writes.  The S3 frontend consults ``lookup_secret`` for SigV4 and
+``is_allowed`` for authorization on every request
+(cmd/auth-handler.go -> IAMSys.IsAllowed).
+"""
+
+from __future__ import annotations
+
+import json
+import secrets as pysecrets
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..storage.xl_storage import SYS_DIR
+from . import policy as iampolicy
+
+
+class IAMError(Exception):
+    pass
+
+
+class NoSuchUser(IAMError):
+    pass
+
+
+class NoSuchPolicy(IAMError):
+    pass
+
+
+@dataclass
+class UserIdentity:
+    access_key: str
+    secret_key: str
+    status: str = "enabled"             # enabled | disabled
+    policies: list[str] = field(default_factory=list)
+    groups: list[str] = field(default_factory=list)
+    parent_user: str = ""               # set for service accounts
+
+    def to_dict(self) -> dict:
+        return {"ak": self.access_key, "sk": self.secret_key,
+                "status": self.status, "policies": self.policies,
+                "groups": self.groups, "parent": self.parent_user}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "UserIdentity":
+        return cls(d["ak"], d["sk"], d.get("status", "enabled"),
+                   list(d.get("policies", [])), list(d.get("groups", [])),
+                   d.get("parent", ""))
+
+
+class IAMSys:
+    """In-memory maps + persisted store (IAMSys + IAMStorageAPI)."""
+
+    def __init__(self, layer, root_access_key: str, root_secret_key: str):
+        self._layer = layer             # object layer for persistence
+        self.root = UserIdentity(root_access_key, root_secret_key,
+                                 policies=["consoleAdmin"])
+        self._users: dict[str, UserIdentity] = {}
+        self._policies: dict[str, iampolicy.Policy] = dict(iampolicy.CANNED)
+        self._group_policies: dict[str, list[str]] = {}
+        self._mu = threading.RLock()
+        self._save_mu = threading.Lock()  # serializes snapshot+write pairs
+        self._loaded = False
+
+    # -- persistence (IAMObjectStore analog) -------------------------------
+
+    def _save(self) -> None:
+        # snapshot AND write under one lock so an older snapshot can never
+        # be persisted after a newer one (lost-update on restart)
+        with self._save_mu:
+            with self._mu:
+                doc = {
+                    "users": {k: u.to_dict()
+                              for k, u in self._users.items()},
+                    "policies": {
+                        name: json.loads(p.to_json())
+                        for name, p in self._policies.items()
+                        if name not in iampolicy.CANNED},
+                    "groups": self._group_policies,
+                }
+            blob = json.dumps(doc).encode()
+            self._layer._fanout(
+                lambda d: d.write_all(SYS_DIR, "config/iam.json", blob))
+
+    def load(self) -> None:
+        res, _ = self._layer._fanout(
+            lambda d: d.read_all(SYS_DIR, "config/iam.json"))
+        doc = None
+        for r in res:
+            if r is not None:
+                try:
+                    doc = json.loads(r)
+                    break
+                except json.JSONDecodeError:
+                    continue
+        with self._mu:
+            if doc:
+                self._users = {k: UserIdentity.from_dict(u)
+                               for k, u in doc.get("users", {}).items()}
+                for name, pd in doc.get("policies", {}).items():
+                    self._policies[name] = iampolicy.Policy.from_json(
+                        json.dumps(pd))
+                self._group_policies = doc.get("groups", {})
+            self._loaded = True
+
+    # -- users -------------------------------------------------------------
+
+    def _check_policies(self, names: list[str]) -> None:
+        unknown = [n for n in names if n not in self._policies]
+        if unknown:
+            raise NoSuchPolicy(", ".join(unknown))
+
+    def add_user(self, access_key: str, secret_key: str,
+                 policies: list[str] | None = None) -> None:
+        with self._mu:
+            self._check_policies(policies or [])
+            self._users[access_key] = UserIdentity(
+                access_key, secret_key, policies=policies or [])
+        self._save()
+
+    def remove_user(self, access_key: str) -> None:
+        with self._mu:
+            if access_key not in self._users:
+                raise NoSuchUser(access_key)
+            del self._users[access_key]
+            # cascade: drop service accounts of this user
+            self._users = {k: u for k, u in self._users.items()
+                           if u.parent_user != access_key}
+        self._save()
+
+    def set_user_status(self, access_key: str, enabled: bool) -> None:
+        with self._mu:
+            u = self._users.get(access_key)
+            if u is None:
+                raise NoSuchUser(access_key)
+            u.status = "enabled" if enabled else "disabled"
+        self._save()
+
+    def list_users(self) -> list[UserIdentity]:
+        with self._mu:
+            return [u for u in self._users.values() if not u.parent_user]
+
+    def get_user(self, access_key: str) -> UserIdentity:
+        with self._mu:
+            if access_key == self.root.access_key:
+                return self.root
+            u = self._users.get(access_key)
+            if u is None:
+                raise NoSuchUser(access_key)
+            return u
+
+    # -- service accounts (cmd/iam.go NewServiceAccount) -------------------
+
+    def new_service_account(self, parent_access_key: str,
+                            access_key: str | None = None,
+                            secret_key: str | None = None) -> UserIdentity:
+        parent = self.get_user(parent_access_key)
+        sa = UserIdentity(
+            access_key or "SA" + pysecrets.token_hex(8).upper(),
+            secret_key or pysecrets.token_urlsafe(24),
+            policies=list(parent.policies),
+            parent_user=parent.access_key)
+        with self._mu:
+            self._users[sa.access_key] = sa
+        self._save()
+        return sa
+
+    # -- policies ----------------------------------------------------------
+
+    def set_policy(self, name: str, pol: iampolicy.Policy) -> None:
+        with self._mu:
+            self._policies[name] = pol
+        self._save()
+
+    def delete_policy(self, name: str) -> None:
+        with self._mu:
+            if name not in self._policies or name in iampolicy.CANNED:
+                raise NoSuchPolicy(name)
+            del self._policies[name]
+        self._save()
+
+    def get_policy(self, name: str) -> iampolicy.Policy:
+        with self._mu:
+            p = self._policies.get(name)
+            if p is None:
+                raise NoSuchPolicy(name)
+            return p
+
+    def list_policies(self) -> list[str]:
+        with self._mu:
+            return sorted(self._policies)
+
+    def attach_policy(self, access_key: str, policy_names: list[str]) -> None:
+        with self._mu:
+            self._check_policies(policy_names)
+            u = self._users.get(access_key)
+            if u is None:
+                raise NoSuchUser(access_key)
+            u.policies = list(policy_names)
+        self._save()
+
+    # -- group policy mapping ---------------------------------------------
+
+    def set_group_policy(self, group: str, policy_names: list[str]) -> None:
+        with self._mu:
+            self._group_policies[group] = list(policy_names)
+        self._save()
+
+    def add_user_to_group(self, access_key: str, group: str) -> None:
+        with self._mu:
+            u = self._users.get(access_key)
+            if u is None:
+                raise NoSuchUser(access_key)
+            if group not in u.groups:
+                u.groups.append(group)
+        self._save()
+
+    # -- auth surface (cmd/auth-handler.go) --------------------------------
+
+    def lookup_secret(self, access_key: str) -> Optional[str]:
+        """SigV4 credential lookup; disabled users don't authenticate."""
+        with self._mu:
+            if access_key == self.root.access_key:
+                return self.root.secret_key
+            u = self._users.get(access_key)
+            if u is None or u.status != "enabled":
+                return None
+            return u.secret_key
+
+    def is_allowed(self, access_key: str, action: str,
+                   resource: str = "", context: dict | None = None) -> bool:
+        """Policy evaluation over the user's + groups' attached policies
+        (IAMSys.IsAllowed, cmd/iam.go)."""
+        with self._mu:
+            if access_key == self.root.access_key:
+                return True             # root bypasses policy
+            u = self._users.get(access_key)
+            if u is None or u.status != "enabled":
+                return False
+            names = list(u.policies)
+            for g in u.groups:
+                names.extend(self._group_policies.get(g, []))
+            pols = [self._policies[n] for n in names if n in self._policies]
+        if not pols:
+            return False
+        # deny anywhere wins across all attached policies
+        merged = iampolicy.Policy(
+            statements=[s for p in pols for s in p.statements])
+        return merged.is_allowed(action, resource, context)

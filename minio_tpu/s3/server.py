@@ -20,6 +20,7 @@ import uuid
 import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..iam import policy as iampol
 from ..objectlayer import interface as ol
 from ..objectlayer.bucket_meta import BucketMetadataSys
 from . import errors as s3err
@@ -56,12 +57,17 @@ class S3Server:
     def __init__(self, object_layer, access_key: str = "minioadmin",
                  secret_key: str = "minioadmin", region: str = "us-east-1",
                  host: str = "127.0.0.1", port: int = 0,
-                 max_body_size: int = 1024 ** 3):
+                 max_body_size: int = 1024 ** 3, iam=None):
         self.layer = object_layer
-        self.creds = {access_key: secret_key}
+        if iam is None:
+            from ..iam.sys import IAMSys
+            iam = IAMSys(object_layer, access_key, secret_key)
+        self.iam = iam
         self.region = region
         self.max_body_size = max_body_size
         self.bucket_meta = BucketMetadataSys(object_layer)
+        from ..utils.kvconfig import Config
+        self.config = Config(object_layer)
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
@@ -111,18 +117,20 @@ def _make_handler(srv: S3Server):
 
         def _auth(self, path, query, payload: bytes) -> bytes:
             """Authenticate; returns the effective payload (aws-chunked
-            bodies are signature-verified per chunk and de-framed)."""
-            lookup = srv.creds.get
+            bodies are signature-verified per chunk and de-framed).  Sets
+            self.access_key for authorization."""
+            lookup = srv.iam.lookup_secret
             hdrs = {k: v for k, v in self.headers.items()}
             try:
                 if "X-Amz-Signature" in query:
-                    sigv4.verify_presigned(lookup, self.command, path, query,
-                                           hdrs, region=srv.region)
+                    self.access_key = sigv4.verify_presigned(
+                        lookup, self.command, path, query, hdrs,
+                        region=srv.region)
                     return payload
                 sha = self.headers.get("x-amz-content-sha256",
                                        sigv4.UNSIGNED_PAYLOAD)
                 if sha == sigv4.STREAMING_PAYLOAD:
-                    key, seed, amz_date, scope = \
+                    self.access_key, key, seed, amz_date, scope = \
                         sigv4.verify_request_streaming(
                             lookup, self.command, path, query, hdrs,
                             region=srv.region)
@@ -132,11 +140,18 @@ def _make_handler(srv: S3Server):
                     got = hashlib.sha256(payload).hexdigest()
                     if got != sha:
                         raise S3Error("BadDigest")
-                sigv4.verify_request(lookup, self.command, path, query, hdrs,
-                                     sha, region=srv.region)
+                self.access_key = sigv4.verify_request(
+                    lookup, self.command, path, query, hdrs, sha,
+                    region=srv.region)
                 return payload
             except sigv4.SigV4Error as e:
                 raise S3Error(e.code) from e
+
+        def _allow(self, action: str, resource: str = "") -> None:
+            """Authorize the authenticated key for an S3 action
+            (checkRequestAuthType -> IAMSys.IsAllowed)."""
+            if not srv.iam.is_allowed(self.access_key, action, resource):
+                raise S3Error("AccessDenied")
 
         def _send(self, status: int, body: bytes = b"",
                   content_type: str = "application/xml",
@@ -144,6 +159,10 @@ def _make_handler(srv: S3Server):
                   content_length: int | None = None):
             """content_length: explicit value for HEAD responses (body is
             not sent but the header must describe the entity)."""
+            from ..admin.metrics import GLOBAL as mtr
+            mtr.inc("mt_s3_requests_total",
+                    {"method": self.command, "status": str(status)})
+            mtr.inc("mt_s3_tx_bytes_total", value=len(body))
             self.send_response(status)
             self.send_header("x-amz-request-id", uuid.uuid4().hex[:16])
             self.send_header("Server", "MinioTPU")
@@ -169,9 +188,25 @@ def _make_handler(srv: S3Server):
 
         def _dispatch(self):
             path, bucket, key, query = self._split()
+            from ..admin import handlers as admin_handlers
+            from ..admin.metrics import GLOBAL as mtr
             try:
+                if path == admin_handlers.METRICS_PATH:
+                    self._body()  # drain keep-alive body before replying
+                    if self.command != "GET":
+                        raise S3Error("MethodNotAllowed")
+                    return admin_handlers.handle(self, srv, path, query, b"")
                 payload = self._body()
+                mtr.inc("mt_s3_rx_bytes_total", value=len(payload))
                 payload = self._auth(path, query, payload)
+                if path.startswith("/minio-tpu/"):
+                    if admin_handlers.handle(self, srv, path, query,
+                                             payload):
+                        return
+                if bucket == "minio-tpu":
+                    # reserved namespace (isMinioReservedBucket analog):
+                    # admin/metrics own this prefix; never an S3 bucket
+                    raise S3Error("AccessDenied")
                 if not bucket:
                     return self._list_buckets()
                 if not _BUCKET_RE.match(bucket):
@@ -190,6 +225,7 @@ def _make_handler(srv: S3Server):
         def _list_buckets(self):
             if self.command != "GET":
                 raise S3Error("MethodNotAllowed")
+            self._allow(iampol.LIST_ALL_MY_BUCKETS)
             root = ET.Element("ListAllMyBucketsResult", xmlns=S3_NS)
             owner = ET.SubElement(root, "Owner")
             ET.SubElement(owner, "ID").text = "minio-tpu"
@@ -204,31 +240,40 @@ def _make_handler(srv: S3Server):
         def _bucket_api(self, bucket, query, payload):
             cmd = self.command
             if cmd == "PUT" and "versioning" in query:
+                self._allow(iampol.PUT_BUCKET_VERSIONING, bucket)
                 return self._put_versioning(bucket, payload)
             if cmd == "GET" and "versioning" in query:
+                self._allow(iampol.GET_BUCKET_VERSIONING, bucket)
                 return self._get_versioning(bucket)
             if cmd == "GET" and "location" in query:
+                self._allow(iampol.GET_BUCKET_LOCATION, bucket)
                 root = ET.Element("LocationConstraint", xmlns=S3_NS)
                 root.text = srv.region
                 srv.layer.get_bucket_info(bucket)
                 return self._send(200, _xml(root))
             if cmd == "GET" and "versions" in query:
+                self._allow(iampol.LIST_BUCKET_VERSIONS, bucket)
                 return self._list_object_versions(bucket, query)
             if cmd == "POST" and "delete" in query:
                 return self._delete_objects(bucket, payload)
             if cmd == "GET" and "uploads" in query:
+                self._allow(iampol.LIST_MULTIPART_UPLOADS, bucket)
                 return self._list_uploads(bucket, query)
             if cmd == "PUT":
+                self._allow(iampol.CREATE_BUCKET, bucket)
                 srv.layer.make_bucket(bucket)
                 return self._send(200, headers={"Location": f"/{bucket}"})
             if cmd == "HEAD":
+                self._allow(iampol.LIST_BUCKET, bucket)
                 srv.layer.get_bucket_info(bucket)
                 return self._send(200)
             if cmd == "DELETE":
+                self._allow(iampol.DELETE_BUCKET, bucket)
                 srv.layer.delete_bucket(bucket)
                 srv.bucket_meta.drop(bucket)
                 return self._send(204)
             if cmd == "GET":
+                self._allow(iampol.LIST_BUCKET, bucket)
                 return self._list_objects(bucket, query)
             raise S3Error("MethodNotAllowed")
 
@@ -341,6 +386,7 @@ def _make_handler(srv: S3Server):
                 vid = obj.findtext(f"{ns}VersionId") or \
                     obj.findtext("VersionId")
                 try:
+                    self._allow(iampol.DELETE_OBJECT, f"{bucket}/{key}")
                     res = srv.layer.delete_object(
                         bucket, key,
                         ol.ObjectOptions(version_id=vid,
@@ -354,9 +400,12 @@ def _make_handler(srv: S3Server):
                                           "DeleteMarkerVersionId").text = \
                                 res.version_id
                 except Exception as e:  # noqa: BLE001
-                    api = s3err.from_object_error(e) \
-                        if isinstance(e, ol.ObjectLayerError) \
-                        else s3err.get("InternalError")
+                    if isinstance(e, S3Error):
+                        api = e.api
+                    elif isinstance(e, ol.ObjectLayerError):
+                        api = s3err.from_object_error(e)
+                    else:
+                        api = s3err.get("InternalError")
                     err = ET.SubElement(out, "Error")
                     ET.SubElement(err, "Key").text = key
                     ET.SubElement(err, "Code").text = api.code
@@ -367,24 +416,37 @@ def _make_handler(srv: S3Server):
 
         def _object_api(self, bucket, key, query, payload):
             cmd = self.command
+            resource = f"{bucket}/{key}"
             if cmd == "POST" and "uploads" in query:
+                self._allow(iampol.PUT_OBJECT, resource)
                 return self._create_multipart(bucket, key)
             if cmd == "POST" and "uploadId" in query:
+                self._allow(iampol.PUT_OBJECT, resource)
                 return self._complete_multipart(bucket, key, query, payload)
             if cmd == "PUT" and "uploadId" in query:
+                self._allow(iampol.PUT_OBJECT, resource)
                 return self._upload_part(bucket, key, query, payload)
             if cmd == "DELETE" and "uploadId" in query:
+                self._allow(iampol.ABORT_MULTIPART, resource)
                 srv.layer.abort_multipart_upload(bucket, key,
                                                  query["uploadId"][0])
                 return self._send(204)
             if cmd == "GET" and "uploadId" in query:
+                self._allow(iampol.LIST_PARTS, resource)
                 return self._list_parts(bucket, key, query)
             if cmd == "PUT":
+                self._allow(iampol.PUT_OBJECT, resource)
                 return self._put_object(bucket, key, query, payload)
             if cmd in ("GET", "HEAD"):
+                self._allow(
+                    iampol.GET_OBJECT_VERSION if query.get("versionId")
+                    else iampol.GET_OBJECT, resource)
                 return self._get_object(bucket, key, query,
                                         head=(cmd == "HEAD"))
             if cmd == "DELETE":
+                self._allow(
+                    iampol.DELETE_OBJECT_VERSION if query.get("versionId")
+                    else iampol.DELETE_OBJECT, resource)
                 return self._delete_object(bucket, key, query)
             raise S3Error("MethodNotAllowed")
 

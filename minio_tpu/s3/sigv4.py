@@ -216,6 +216,8 @@ def _parse_auth_header(auth: str) -> tuple[str, str, list[str], str]:
         sig = fields["Signature"]
     except KeyError as e:
         raise SigV4Error("AuthorizationHeaderMalformed", str(e)) from e
+    if "/" not in cred:
+        raise SigV4Error("AuthorizationHeaderMalformed", cred)
     access_key, scope = cred.split("/", 1)
     return access_key, scope, signed, sig
 
@@ -274,17 +276,17 @@ def verify_request_streaming(lookup_secret, method: str, path: str,
                              headers: dict[str, str],
                              region: str = "us-east-1",
                              now: datetime.datetime | None = None
-                             ) -> tuple[bytes, str, str, str]:
+                             ) -> tuple[str, bytes, str, str, str]:
     """Verify the seed request of an aws-chunked upload; returns
-    (signing_key, seed_signature, amz_date, scope) for the per-chunk
-    chain (cmd/streaming-signature-v4.go:40)."""
+    (access_key, signing_key, seed_signature, amz_date, scope) for the
+    per-chunk chain (cmd/streaming-signature-v4.go:40)."""
     access_key = verify_request(lookup_secret, method, path, query, headers,
                                 STREAMING_PAYLOAD, region, now)
     hl = {k.lower(): v for k, v in headers.items()}
     _, scope, _, seed_sig = _parse_auth_header(hl["authorization"])
     date = scope.split("/")[0]
     key = signing_key(lookup_secret(access_key), date, region, "s3")
-    return key, seed_sig, hl.get("x-amz-date", ""), scope
+    return access_key, key, seed_sig, hl.get("x-amz-date", ""), scope
 
 
 def decode_chunked_payload(body: bytes, key: bytes, seed_signature: str,

@@ -1,0 +1,105 @@
+"""Server bootstrap + CLI (cmd/server-main.go:389 serverMain, L0).
+
+``python -m minio_tpu server /data1 /data2 ...`` boots a single-node
+server: drive init + format, set sizing, object layer assembly, IAM load,
+S3 + admin frontend.  Distributed deployments assemble via
+minio_tpu.cluster (each host lists every node's drives in the same
+order, as the reference does with ellipses endpoints).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .objectlayer.sets import ErasureSets
+from .s3.server import S3Server
+
+# set sizing (cmd/endpoint-ellipses.go:44 setSizes{4..16})
+SET_SIZES = list(range(16, 3, -1))
+
+
+def choose_set_drive_count(n: int, override: int | None = None) -> int:
+    """Largest valid set size dividing the drive count (getSetIndexes,
+    cmd/endpoint-ellipses.go:132); small counts (1-3) form one set."""
+    if override:
+        if n % override != 0:
+            raise ValueError(f"drive count {n} not divisible by "
+                             f"set size {override}")
+        return override
+    if n < 4:
+        return n
+    for size in SET_SIZES:
+        if n % size == 0:
+            return size
+    raise ValueError(f"no valid erasure set size for {n} drives "
+                     f"(need a divisor in 4..16)")
+
+
+def build_server(dirs: list[str], address: str = "127.0.0.1:9000",
+                 access_key: str | None = None,
+                 secret_key: str | None = None,
+                 set_drive_count: int | None = None,
+                 backend: str = "auto", block_size: int | None = None,
+                 region: str = "us-east-1") -> S3Server:
+    access_key = access_key or os.environ.get("MT_ROOT_USER", "minioadmin")
+    secret_key = secret_key or os.environ.get("MT_ROOT_PASSWORD",
+                                              "minioadmin")
+    for d in dirs:
+        os.makedirs(d, exist_ok=True)
+    sdc = choose_set_drive_count(len(dirs),
+                                 set_drive_count or
+                                 int(os.environ.get(
+                                     "MT_ERASURE_SET_DRIVE_COUNT", 0))
+                                 or None)
+    kwargs = {"backend": backend}
+    if block_size:
+        kwargs["block_size"] = block_size
+    layer = ErasureSets.from_dirs(dirs, len(dirs) // sdc, sdc, **kwargs)
+    host, _, port = address.rpartition(":")
+    srv = S3Server(layer, access_key=access_key, secret_key=secret_key,
+                   region=region, host=host or "0.0.0.0", port=int(port))
+    srv.iam.load()
+    return srv
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="minio_tpu", description="TPU-native S3 object storage server")
+    sub = parser.add_subparsers(dest="command", required=True)
+    ps = sub.add_parser("server", help="start the object storage server")
+    ps.add_argument("dirs", nargs="+", help="drive directories")
+    ps.add_argument("--address", default="0.0.0.0:9000")
+    ps.add_argument("--access-key", default=None)
+    ps.add_argument("--secret-key", default=None)
+    ps.add_argument("--set-drive-count", type=int, default=None)
+    ps.add_argument("--backend", default="auto",
+                    choices=["auto", "tpu", "numpy"],
+                    help="erasure compute backend")
+    ps.add_argument("--block-size", type=int, default=None)
+    ps.add_argument("--region", default="us-east-1")
+    args = parser.parse_args(argv)
+
+    srv = build_server(args.dirs, args.address, args.access_key,
+                       args.secret_key, args.set_drive_count,
+                       args.backend, args.block_size, args.region)
+    n = len(args.dirs)
+    sdc = srv.layer.set_drive_count
+    print(f"minio-tpu server: {n} drives, "
+          f"{n // sdc} set(s) x {sdc} drives, "
+          f"backend={args.backend}", flush=True)
+    print(f"S3 endpoint: http://{args.address}", flush=True)
+    print(f"admin:       http://{args.address}/minio-tpu/admin/v1/info",
+          flush=True)
+    print(f"metrics:     http://{args.address}/minio-tpu/metrics",
+          flush=True)
+    try:
+        srv.httpd.serve_forever()
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

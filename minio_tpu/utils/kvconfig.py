@@ -1,0 +1,157 @@
+"""Config subsystem — KVS registry with env overrides
+(cmd/config/config.go:209,302; pkg/env).
+
+``Config`` is {subsystem: {key: value}} with registered defaults + help.
+Every key is overridable by environment variable ``MT_<SUBSYS>_<KEY>``
+(the reference's MINIO_<SUBSYS>_<KEY>).  Dynamic updates go through
+``set``/``get`` (admin SetConfigKV analog) and persist as JSON in the
+system volume when bound to an object layer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+
+ENV_PREFIX = "MT"
+
+
+@dataclass
+class HelpKV:
+    key: str
+    description: str = ""
+    optional: bool = True
+    type: str = "string"
+
+
+@dataclass
+class SubsysSpec:
+    name: str
+    defaults: dict[str, str] = field(default_factory=dict)
+    help: list[HelpKV] = field(default_factory=list)
+
+
+_REGISTRY: dict[str, SubsysSpec] = {}
+
+
+def register_subsys(name: str, defaults: dict[str, str],
+                    help_kvs: list[HelpKV] | None = None) -> None:
+    _REGISTRY[name] = SubsysSpec(name, dict(defaults), help_kvs or [])
+
+
+# built-in subsystems (subset of the reference's 15+, grows with features)
+register_subsys("api", {
+    "requests_max": "0",            # 0 = auto
+    "requests_deadline": "10s",
+    "cors_allow_origin": "*",
+})
+register_subsys("storage_class", {
+    "standard": "",                 # e.g. EC:4
+    "rrs": "EC:2",
+})
+register_subsys("heal", {
+    "bitrotscan": "off",
+    "max_sleep": "1s",
+    "max_io": "10",
+})
+register_subsys("scanner", {
+    "delay": "10",
+    "max_wait": "15s",
+})
+register_subsys("compression", {
+    "enable": "off",
+    "extensions": ".txt,.log,.csv,.json,.tar,.xml,.bin",
+    "mime_types": "text/*,application/json,application/xml",
+})
+register_subsys("logger_webhook", {"enable": "off", "endpoint": ""})
+register_subsys("audit_webhook", {"enable": "off", "endpoint": ""})
+register_subsys("notify_webhook", {"enable": "off", "endpoint": ""})
+
+
+class Config:
+    """Layered lookup: env > dynamic set > defaults."""
+
+    def __init__(self, layer=None):
+        self._layer = layer
+        self._dynamic: dict[str, dict[str, str]] = {}
+        self._mu = threading.Lock()
+        self._persist_mu = threading.Lock()
+        if layer is not None:
+            self._load()
+
+    def _env_key(self, subsys: str, key: str) -> str:
+        return f"{ENV_PREFIX}_{subsys.upper()}_{key.upper()}"
+
+    def get(self, subsys: str, key: str) -> str:
+        env = os.environ.get(self._env_key(subsys, key))
+        if env is not None:
+            return env
+        with self._mu:
+            dyn = self._dynamic.get(subsys, {}).get(key)
+        if dyn is not None:
+            return dyn
+        spec = _REGISTRY.get(subsys)
+        if spec is None or key not in spec.defaults:
+            raise KeyError(f"{subsys}.{key}")
+        return spec.defaults[key]
+
+    def set(self, subsys: str, key: str, value: str) -> None:
+        spec = _REGISTRY.get(subsys)
+        if spec is None:
+            raise KeyError(subsys)
+        if key not in spec.defaults:
+            raise KeyError(f"{subsys}.{key}")
+        with self._mu:
+            self._dynamic.setdefault(subsys, {})[key] = value
+        self._persist()
+
+    def get_subsys(self, subsys: str) -> dict[str, str]:
+        spec = _REGISTRY.get(subsys)
+        if spec is None:
+            raise KeyError(subsys)
+        return {k: self.get(subsys, k) for k in spec.defaults}
+
+    def subsystems(self) -> list[str]:
+        return sorted(_REGISTRY)
+
+    def help(self, subsys: str) -> list[HelpKV]:
+        return _REGISTRY[subsys].help
+
+    # -- persistence (cmd/config-current.go analog) ------------------------
+
+    def _persist(self) -> None:
+        if self._layer is None:
+            return
+        from ..storage.xl_storage import SYS_DIR
+        with self._persist_mu:  # snapshot+write atomic wrt other persists
+            with self._mu:
+                blob = json.dumps(self._dynamic).encode()
+            self._layer._fanout(
+                lambda d: d.write_all(SYS_DIR, "config/config.json", blob))
+
+    def _load(self) -> None:
+        from ..storage.xl_storage import SYS_DIR
+        res, _ = self._layer._fanout(
+            lambda d: d.read_all(SYS_DIR, "config/config.json"))
+        for r in res:
+            if r is not None:
+                try:
+                    with self._mu:
+                        self._dynamic = json.loads(r)
+                    return
+                except json.JSONDecodeError:
+                    continue
+
+
+def parse_storage_class(value: str, drive_count: int) -> int | None:
+    """'EC:4' -> parity 4 (cmd/config/storageclass/storage-class.go)."""
+    if not value:
+        return None
+    if not value.startswith("EC:"):
+        raise ValueError(f"invalid storage class {value!r}")
+    parity = int(value[3:])
+    if parity < 0 or parity > drive_count // 2:
+        raise ValueError(f"parity {parity} out of range")
+    return parity

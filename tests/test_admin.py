@@ -1,0 +1,153 @@
+"""Admin API + metrics + config + CLI bootstrap tests
+(mirrors cmd/admin-handlers_test.go tier)."""
+
+import json
+
+import pytest
+
+from minio_tpu.s3.client import S3Client, S3ClientError
+from minio_tpu.server_main import build_server, choose_set_drive_count
+from minio_tpu.utils.kvconfig import Config, parse_storage_class
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("admindrives")
+    dirs = [str(tmp / f"d{i}") for i in range(4)]
+    srv = build_server(dirs, address="127.0.0.1:0", access_key="admin",
+                       secret_key="adminpw", backend="numpy",
+                       block_size=64 * 1024)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def client(server):
+    return S3Client(server.endpoint, "admin", "adminpw")
+
+
+def _admin(client, method, route, query="", body=b"", expect=(200,)):
+    return client.request(method, f"/minio-tpu/admin/v1/{route}", query,
+                          body, expect=expect)
+
+
+def test_set_sizing():
+    assert choose_set_drive_count(16) == 16
+    assert choose_set_drive_count(32) == 16
+    assert choose_set_drive_count(12) == 12
+    assert choose_set_drive_count(20) == 10
+    assert choose_set_drive_count(2) == 2
+    assert choose_set_drive_count(8, override=4) == 4
+    with pytest.raises(ValueError):
+        choose_set_drive_count(8, override=3)
+    with pytest.raises(ValueError):
+        choose_set_drive_count(17)
+
+
+def test_server_info(client):
+    r = _admin(client, "GET", "info")
+    doc = json.loads(r.body)
+    assert doc["mode"] == "distributed-erasure-tpu"
+    assert len(doc["drives"]) == 4
+    assert all(d["state"] == "ok" for d in doc["drives"])
+
+
+def test_admin_requires_admin_identity(server, client):
+    server.iam.add_user("plain", "plainpw", policies=["readwrite"])
+    plain = S3Client(server.endpoint, "plain", "plainpw")
+    with pytest.raises(S3ClientError) as ei:
+        _admin(plain, "GET", "info")
+    assert ei.value.code == "AccessDenied"
+
+
+def test_config_kv(client):
+    r = _admin(client, "GET", "config/heal")
+    assert json.loads(r.body)["bitrotscan"] == "off"
+    _admin(client, "PUT", "config/heal/bitrotscan", body=b"on")
+    r = _admin(client, "GET", "config/heal")
+    assert json.loads(r.body)["bitrotscan"] == "on"
+    r = _admin(client, "GET", "config")
+    assert "heal" in json.loads(r.body)
+
+
+def test_user_management_api(server, client):
+    _admin(client, "POST", "add-user", body=json.dumps({
+        "accessKey": "dave", "secretKey": "davesecret",
+        "policies": ["readonly"]}).encode())
+    r = _admin(client, "GET", "list-users")
+    users = json.loads(r.body)
+    assert users["dave"]["policies"] == ["readonly"]
+    # new user works via S3
+    dave = S3Client(server.endpoint, "dave", "davesecret")
+    client.make_bucket("adminbkt")
+    client.put_object("adminbkt", "o", b"x")
+    assert dave.get_object("adminbkt", "o").body == b"x"
+    # service account for dave
+    r = _admin(client, "POST", "add-service-account",
+               body=json.dumps({"parent": "dave"}).encode())
+    sa = json.loads(r.body)
+    sacli = S3Client(server.endpoint, sa["accessKey"], sa["secretKey"])
+    assert sacli.get_object("adminbkt", "o").body == b"x"
+    _admin(client, "POST", "remove-user", "accessKey=dave")
+    with pytest.raises(S3ClientError):
+        dave.get_object("adminbkt", "o")
+
+
+def test_policy_api(client):
+    pol = {"Version": "2012-10-17", "Statement": [
+        {"Effect": "Allow", "Action": ["s3:GetObject"],
+         "Resource": ["arn:aws:s3:::pub/*"]}]}
+    _admin(client, "PUT", "policy/pub-read",
+           body=json.dumps(pol).encode())
+    r = _admin(client, "GET", "policy")
+    assert "pub-read" in json.loads(r.body)["policies"]
+    r = _admin(client, "GET", "policy/pub-read")
+    assert json.loads(r.body)["Statement"][0]["Action"] == ["s3:GetObject"]
+    _admin(client, "DELETE", "policy/pub-read")
+    r = _admin(client, "GET", "policy")
+    assert "pub-read" not in json.loads(r.body)["policies"]
+
+
+def test_heal_api(server, client):
+    import os
+    import shutil
+    client.make_bucket("healbkt")
+    client.put_object("healbkt", "obj", b"y" * 100000)
+    # wipe the object from one drive
+    disk = server.layer.sets[0].disks[0]
+    shutil.rmtree(os.path.join(disk.root, "healbkt", "obj"),
+                  ignore_errors=True)
+    r = _admin(client, "POST", "heal/healbkt")
+    doc = json.loads(r.body)
+    objs = {o["object"]: o for o in doc["objects"]}
+    assert objs["obj"]["after_ok"] == 4
+
+
+def test_metrics_endpoint(server, client):
+    client.make_bucket("mtr")
+    client.put_object("mtr", "o", b"z")
+    r = client.request("GET", "/minio-tpu/metrics", sign=False)
+    text = r.body.decode()
+    assert "mt_up 1" in text
+    assert "mt_s3_requests_total" in text
+    assert "mt_cluster_disk_online_total 4" in text
+    assert "mt_cluster_capacity_raw_total_bytes" in text
+
+
+def test_config_env_override(tmp_path, monkeypatch):
+    monkeypatch.setenv("MT_HEAL_MAX_IO", "99")
+    cfg = Config()
+    assert cfg.get("heal", "max_io") == "99"
+    assert cfg.get("heal", "bitrotscan") == "off"
+    with pytest.raises(KeyError):
+        cfg.get("nope", "x")
+
+
+def test_parse_storage_class():
+    assert parse_storage_class("EC:4", 16) == 4
+    assert parse_storage_class("", 16) is None
+    with pytest.raises(ValueError):
+        parse_storage_class("EC:9", 16)
+    with pytest.raises(ValueError):
+        parse_storage_class("junk", 16)

@@ -1,0 +1,166 @@
+"""IAM policy engine + IAMSys + S3 authorization tests
+(mirrors pkg/iam/policy tests and cmd/iam.go behavior)."""
+
+import json
+
+import pytest
+
+from minio_tpu.iam import policy as pol
+from minio_tpu.iam.sys import IAMSys, NoSuchUser
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.s3.client import S3Client, S3ClientError
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl_storage import XLStorage
+
+
+# -- policy engine ---------------------------------------------------------
+
+def test_wildcard_matching():
+    p = pol.Policy(statements=[pol.Statement(
+        actions=["s3:Get*"], resources=["arn:aws:s3:::photos/*"])])
+    assert p.is_allowed("s3:GetObject", "photos/cat.jpg")
+    assert p.is_allowed("s3:GetObjectVersion", "photos/a/b")
+    assert not p.is_allowed("s3:PutObject", "photos/cat.jpg")
+    assert not p.is_allowed("s3:GetObject", "private/cat.jpg")
+
+
+def test_deny_wins():
+    p = pol.Policy(statements=[
+        pol.Statement(actions=["s3:*"], resources=["*"]),
+        pol.Statement(effect="Deny", actions=["s3:DeleteObject"],
+                      resources=["arn:aws:s3:::critical/*"]),
+    ])
+    assert p.is_allowed("s3:DeleteObject", "other/x")
+    assert not p.is_allowed("s3:DeleteObject", "critical/x")
+    assert p.is_allowed("s3:GetObject", "critical/x")
+
+
+def test_policy_json_roundtrip():
+    doc = {
+        "Version": "2012-10-17",
+        "Statement": {"Effect": "Allow", "Action": "s3:GetObject",
+                      "Resource": "arn:aws:s3:::b/*"},
+    }
+    p = pol.Policy.from_json(json.dumps(doc))
+    assert p.is_allowed("s3:GetObject", "b/k")
+    p2 = pol.Policy.from_json(p.to_json())
+    assert p2.is_allowed("s3:GetObject", "b/k")
+
+
+def test_conditions():
+    p = pol.Policy(statements=[pol.Statement(
+        actions=["s3:GetObject"], resources=["*"],
+        conditions={"StringEquals": {"s3:prefix": "public"}})])
+    assert p.is_allowed("s3:GetObject", "b/k", {"s3:prefix": "public"})
+    assert not p.is_allowed("s3:GetObject", "b/k", {"s3:prefix": "priv"})
+
+
+def test_canned_policies():
+    assert pol.READ_ONLY.is_allowed("s3:GetObject", "any/obj")
+    assert not pol.READ_ONLY.is_allowed("s3:PutObject", "any/obj")
+    assert pol.READ_WRITE.is_allowed("s3:DeleteObject", "x/y")
+    assert pol.CONSOLE_ADMIN.is_allowed("admin:ServerInfo")
+
+
+# -- IAMSys ----------------------------------------------------------------
+
+def make_layer(tmp_path, n=4):
+    disks = []
+    for i in range(n):
+        d = tmp_path / f"disk{i}"
+        d.mkdir(exist_ok=True)
+        disks.append(XLStorage(str(d)))
+    return ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                          backend="numpy")
+
+
+def test_iam_users_and_persistence(tmp_path):
+    layer = make_layer(tmp_path)
+    iam = IAMSys(layer, "root", "rootsecret")
+    iam.add_user("alice", "alicesecret", policies=["readonly"])
+    iam.add_user("bob", "bobsecret", policies=["readwrite"])
+    assert iam.lookup_secret("alice") == "alicesecret"
+    assert iam.lookup_secret("root") == "rootsecret"
+    assert iam.lookup_secret("mallory") is None
+    assert iam.is_allowed("alice", "s3:GetObject", "b/k")
+    assert not iam.is_allowed("alice", "s3:PutObject", "b/k")
+    assert iam.is_allowed("bob", "s3:PutObject", "b/k")
+    assert iam.is_allowed("root", "admin:Anything")
+
+    # disabled users can't authenticate or act
+    iam.set_user_status("alice", enabled=False)
+    assert iam.lookup_secret("alice") is None
+    assert not iam.is_allowed("alice", "s3:GetObject", "b/k")
+
+    # persistence across restart
+    iam2 = IAMSys(layer, "root", "rootsecret")
+    iam2.load()
+    assert iam2.lookup_secret("bob") == "bobsecret"
+    assert not iam2.is_allowed("alice", "s3:GetObject", "b/k")
+
+
+def test_service_accounts(tmp_path):
+    layer = make_layer(tmp_path)
+    iam = IAMSys(layer, "root", "rs")
+    iam.add_user("parent", "ps", policies=["readwrite"])
+    sa = iam.new_service_account("parent")
+    assert sa.parent_user == "parent"
+    assert iam.lookup_secret(sa.access_key) == sa.secret_key
+    assert iam.is_allowed(sa.access_key, "s3:PutObject", "b/k")
+    # removing the parent cascades
+    iam.remove_user("parent")
+    assert iam.lookup_secret(sa.access_key) is None
+
+
+def test_custom_policy_and_groups(tmp_path):
+    layer = make_layer(tmp_path)
+    iam = IAMSys(layer, "root", "rs")
+    iam.set_policy("photos-only", pol.Policy(statements=[pol.Statement(
+        actions=["s3:GetObject", "s3:ListBucket"],
+        resources=["arn:aws:s3:::photos", "arn:aws:s3:::photos/*"])]))
+    iam.add_user("carol", "cs")
+    iam.add_user_to_group("carol", "viewers")
+    iam.set_group_policy("viewers", ["photos-only"])
+    assert iam.is_allowed("carol", "s3:GetObject", "photos/x")
+    assert not iam.is_allowed("carol", "s3:GetObject", "secret/x")
+    with pytest.raises(NoSuchUser):
+        iam.attach_policy("nobody", ["readonly"])
+
+
+# -- S3 integration --------------------------------------------------------
+
+def test_s3_authorization_enforced(tmp_path):
+    layer = make_layer(tmp_path)
+    srv = S3Server(layer, access_key="root", secret_key="rootpw")
+    srv.iam.add_user("reader", "readerpw", policies=["readonly"])
+    srv.iam.add_user("writer", "writerpw", policies=["readwrite"])
+    srv.start()
+    try:
+        root = S3Client(srv.endpoint, "root", "rootpw")
+        reader = S3Client(srv.endpoint, "reader", "readerpw")
+        writer = S3Client(srv.endpoint, "writer", "writerpw")
+        root.make_bucket("authz")
+        writer.put_object("authz", "obj", b"data")
+        # reader can GET but not PUT or DELETE
+        assert reader.get_object("authz", "obj").body == b"data"
+        with pytest.raises(S3ClientError) as ei:
+            reader.put_object("authz", "nope", b"x")
+        assert ei.value.code == "AccessDenied"
+        with pytest.raises(S3ClientError) as ei:
+            reader.delete_object("authz", "obj")
+        assert ei.value.code == "AccessDenied"
+        with pytest.raises(S3ClientError) as ei:
+            reader.make_bucket("reader-bucket")
+        assert ei.value.code == "AccessDenied"
+        # readonly cannot list buckets (no ListAllMyBuckets in canned RO)
+        with pytest.raises(S3ClientError):
+            reader.list_buckets()
+        # batch delete: reader gets per-key AccessDenied errors
+        res = reader.delete_objects("authz", ["obj"])
+        ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+        codes = [e.findtext(f"{ns}Code") for e in res
+                 if e.tag.endswith("Error")]
+        assert codes == ["AccessDenied"]
+        assert root.get_object("authz", "obj").body == b"data"
+    finally:
+        srv.stop()
