@@ -1,0 +1,17 @@
+"""Bucket feature configuration models (pkg/bucket/* in the reference).
+
+Each module parses/validates/serializes one S3 bucket-level configuration
+document (XML unless noted) and exposes the evaluation logic the data path
+needs (lifecycle ComputeAction, replication decisions, notification rule
+matching, object-lock retention checks).
+"""
+
+import xml.etree.ElementTree as ET
+
+
+def strip_ns(root: ET.Element) -> None:
+    """Drop XML namespaces in-place so configs parse uniformly whether or
+    not the client set xmlns (S3 accepts both)."""
+    for el in root.iter():
+        if "}" in el.tag:
+            el.tag = el.tag.split("}", 1)[1]

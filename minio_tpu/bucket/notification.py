@@ -1,0 +1,143 @@
+"""Bucket notification configuration — pkg/event/config.go + rules.go.
+
+NotificationConfiguration XML holding Queue/Topic/CloudFunction
+configurations; each maps a set of event names + prefix/suffix filter
+rules to a target ARN.  `match()` implements the rules-map lookup the
+event system uses to route an event to targets
+(pkg/event/rulesmap.go, pkg/event/targetidset.go).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+
+from . import strip_ns
+
+
+class NotificationError(ValueError):
+    pass
+
+
+# pkg/event/name.go — supported event names (wildcard forms expand)
+EVENT_NAMES = {
+    "s3:ObjectCreated:*", "s3:ObjectCreated:Put", "s3:ObjectCreated:Post",
+    "s3:ObjectCreated:Copy", "s3:ObjectCreated:CompleteMultipartUpload",
+    "s3:ObjectCreated:PutRetention", "s3:ObjectCreated:PutLegalHold",
+    "s3:ObjectCreated:PutTagging", "s3:ObjectCreated:DeleteTagging",
+    "s3:ObjectRemoved:*", "s3:ObjectRemoved:Delete",
+    "s3:ObjectRemoved:DeleteMarkerCreated",
+    "s3:ObjectAccessed:*", "s3:ObjectAccessed:Get",
+    "s3:ObjectAccessed:Head",
+    "s3:Replication:*", "s3:Replication:OperationFailedReplication",
+    "s3:Replication:OperationCompletedReplication",
+    "s3:ObjectRestore:Post", "s3:ObjectRestore:Completed",
+}
+
+
+def _expand(name: str) -> set[str]:
+    if name.endswith(":*"):
+        prefix = name[:-1]
+        return {n for n in EVENT_NAMES
+                if n.startswith(prefix) and not n.endswith("*")}
+    return {name}
+
+
+@dataclass
+class TargetConfig:
+    arn: str = ""
+    events: set[str] = field(default_factory=set)  # expanded names
+    prefix: str = ""
+    suffix: str = ""
+
+    def matches(self, event_name: str, key: str) -> bool:
+        if event_name not in self.events:
+            return False
+        if self.prefix and not key.startswith(self.prefix):
+            return False
+        if self.suffix and not key.endswith(self.suffix):
+            return False
+        return True
+
+
+@dataclass
+class Config:
+    targets: list[TargetConfig] = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, data: bytes,
+              valid_arns: set[str] | None = None) -> "Config":
+        try:
+            root = ET.fromstring(data)
+        except ET.ParseError as e:
+            raise NotificationError("malformed notification XML") from e
+        strip_ns(root)
+        if root.tag != "NotificationConfiguration":
+            raise NotificationError("malformed notification XML")
+        cfg = cls()
+        for kind, arn_tag in (("QueueConfiguration", "Queue"),
+                              ("TopicConfiguration", "Topic"),
+                              ("CloudFunctionConfiguration",
+                               "CloudFunction")):
+            for qel in root.findall(kind):
+                t = TargetConfig(arn=qel.findtext(arn_tag) or "")
+                if not t.arn:
+                    raise NotificationError(f"missing {arn_tag} ARN")
+                if valid_arns is not None and t.arn not in valid_arns:
+                    raise NotificationError(f"unknown ARN {t.arn}")
+                for ev in qel.findall("Event"):
+                    name = ev.text or ""
+                    if name not in EVENT_NAMES:
+                        raise NotificationError(f"unknown event {name}")
+                    t.events |= _expand(name)
+                if not t.events:
+                    raise NotificationError("no events configured")
+                filt = qel.find("Filter")
+                if filt is not None:
+                    key = filt.find("S3Key")
+                    for rule in (key.findall("FilterRule")
+                                 if key is not None else []):
+                        n = (rule.findtext("Name") or "").lower()
+                        v = rule.findtext("Value") or ""
+                        if n == "prefix":
+                            t.prefix = v
+                        elif n == "suffix":
+                            t.suffix = v
+                        else:
+                            raise NotificationError(
+                                f"bad filter rule name {n}")
+                cfg.targets.append(t)
+        return cfg
+
+    def to_xml(self) -> bytes:
+        root = ET.Element(
+            "NotificationConfiguration",
+            xmlns="http://s3.amazonaws.com/doc/2006-03-01/")
+        for t in self.targets:
+            qel = ET.SubElement(root, "QueueConfiguration")
+            ET.SubElement(qel, "Queue").text = t.arn
+            for name in sorted(t.events):
+                ET.SubElement(qel, "Event").text = name
+            if t.prefix or t.suffix:
+                filt = ET.SubElement(qel, "Filter")
+                key = ET.SubElement(filt, "S3Key")
+                if t.prefix:
+                    r = ET.SubElement(key, "FilterRule")
+                    ET.SubElement(r, "Name").text = "prefix"
+                    ET.SubElement(r, "Value").text = t.prefix
+                if t.suffix:
+                    r = ET.SubElement(key, "FilterRule")
+                    ET.SubElement(r, "Name").text = "suffix"
+                    ET.SubElement(r, "Value").text = t.suffix
+        return (b'<?xml version="1.0" encoding="UTF-8"?>' +
+                ET.tostring(root))
+
+    def match(self, event_name: str, key: str) -> set[str]:
+        """ARNs to deliver this event to."""
+        return {t.arn for t in self.targets if t.matches(event_name, key)}
+
+
+def match_pattern(pattern: str, value: str) -> bool:
+    """Event-pattern glob used by ListenNotification prefixes."""
+    return fnmatch.fnmatchcase(value, pattern) if pattern else True

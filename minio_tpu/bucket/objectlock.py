@@ -1,0 +1,199 @@
+"""Object lock (WORM) configuration, retention and legal hold.
+
+Mirrors pkg/bucket/object/lock/lock.go: bucket ObjectLockConfiguration
+XML, per-object retention (GOVERNANCE/COMPLIANCE + retain-until-date) and
+legal hold, plus the enforcement predicate used on deletes
+(cmd/bucket-object-lock.go enforceRetentionForDeletion).
+"""
+
+from __future__ import annotations
+
+import datetime
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass
+from typing import Optional
+
+GOVERNANCE = "GOVERNANCE"
+COMPLIANCE = "COMPLIANCE"
+
+# metadata keys on the object (x-amz-* headers are persisted verbatim)
+AMZ_OBJECT_LOCK_MODE = "x-amz-object-lock-mode"
+AMZ_OBJECT_LOCK_RETAIN_UNTIL = "x-amz-object-lock-retain-until-date"
+AMZ_OBJECT_LOCK_LEGAL_HOLD = "x-amz-object-lock-legal-hold"
+
+
+class ObjectLockError(ValueError):
+    pass
+
+
+from . import strip_ns as _strip_ns  # noqa: E402 — shared XML helper
+
+
+
+
+@dataclass
+class LockConfig:
+    """Bucket-level default retention (ObjectLockConfiguration)."""
+    enabled: bool = False
+    mode: str = ""               # "" | GOVERNANCE | COMPLIANCE
+    days: Optional[int] = None
+    years: Optional[int] = None
+
+    @classmethod
+    def parse(cls, data: bytes) -> "LockConfig":
+        try:
+            root = ET.fromstring(data)
+        except ET.ParseError as e:
+            raise ObjectLockError("malformed object-lock XML") from e
+        _strip_ns(root)
+        if root.tag != "ObjectLockConfiguration":
+            raise ObjectLockError("malformed object-lock XML")
+        cfg = cls()
+        cfg.enabled = (root.findtext("ObjectLockEnabled") or "") == "Enabled"
+        rule = root.find("Rule")
+        if rule is not None:
+            ret = rule.find("DefaultRetention")
+            if ret is None:
+                raise ObjectLockError("Rule requires DefaultRetention")
+            cfg.mode = ret.findtext("Mode") or ""
+            if cfg.mode not in (GOVERNANCE, COMPLIANCE):
+                raise ObjectLockError("invalid retention Mode")
+            days, years = ret.findtext("Days"), ret.findtext("Years")
+            if (days is None) == (years is None):
+                raise ObjectLockError(
+                    "exactly one of Days or Years required")
+            if days is not None:
+                cfg.days = int(days)
+                if cfg.days <= 0:
+                    raise ObjectLockError("Days must be positive")
+            if years is not None:
+                cfg.years = int(years)
+                if cfg.years <= 0:
+                    raise ObjectLockError("Years must be positive")
+        return cfg
+
+    def to_xml(self) -> bytes:
+        root = ET.Element(
+            "ObjectLockConfiguration",
+            xmlns="http://s3.amazonaws.com/doc/2006-03-01/")
+        ET.SubElement(root, "ObjectLockEnabled").text = "Enabled"
+        if self.mode:
+            rule = ET.SubElement(root, "Rule")
+            ret = ET.SubElement(rule, "DefaultRetention")
+            ET.SubElement(ret, "Mode").text = self.mode
+            if self.days is not None:
+                ET.SubElement(ret, "Days").text = str(self.days)
+            if self.years is not None:
+                ET.SubElement(ret, "Years").text = str(self.years)
+        return (b'<?xml version="1.0" encoding="UTF-8"?>' +
+                ET.tostring(root))
+
+    def default_retention_headers(self, now: Optional[datetime.datetime]
+                                  = None) -> dict[str, str]:
+        """Metadata to stamp on new objects when a default rule exists."""
+        if not self.mode:
+            return {}
+        now = now or datetime.datetime.now(datetime.timezone.utc)
+        days = (self.days or 0) + 365 * (self.years or 0)
+        until = now + datetime.timedelta(days=days)
+        return {
+            AMZ_OBJECT_LOCK_MODE: self.mode,
+            AMZ_OBJECT_LOCK_RETAIN_UNTIL:
+                until.strftime("%Y-%m-%dT%H:%M:%SZ"),
+        }
+
+
+@dataclass
+class Retention:
+    mode: str = ""
+    retain_until: Optional[datetime.datetime] = None
+
+    @classmethod
+    def parse(cls, data: bytes) -> "Retention":
+        try:
+            root = ET.fromstring(data)
+        except ET.ParseError as e:
+            raise ObjectLockError("malformed retention XML") from e
+        _strip_ns(root)
+        if root.tag != "Retention":
+            raise ObjectLockError("malformed retention XML")
+        mode = root.findtext("Mode") or ""
+        if mode not in (GOVERNANCE, COMPLIANCE):
+            raise ObjectLockError("invalid retention Mode")
+        until = root.findtext("RetainUntilDate") or ""
+        try:
+            dt = datetime.datetime.fromisoformat(
+                until.replace("Z", "+00:00"))
+        except ValueError as e:
+            raise ObjectLockError("invalid RetainUntilDate") from e
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=datetime.timezone.utc)
+        if dt <= datetime.datetime.now(datetime.timezone.utc):
+            raise ObjectLockError("RetainUntilDate must be in the future")
+        return cls(mode=mode, retain_until=dt)
+
+    @classmethod
+    def from_metadata(cls, meta: dict[str, str]) -> "Retention":
+        mode = meta.get(AMZ_OBJECT_LOCK_MODE, "")
+        until_s = meta.get(AMZ_OBJECT_LOCK_RETAIN_UNTIL, "")
+        until = None
+        if until_s:
+            try:
+                until = datetime.datetime.fromisoformat(
+                    until_s.replace("Z", "+00:00"))
+                if until.tzinfo is None:
+                    until = until.replace(tzinfo=datetime.timezone.utc)
+            except ValueError:
+                until = None
+        return cls(mode=mode, retain_until=until)
+
+    def to_xml(self) -> bytes:
+        root = ET.Element(
+            "Retention", xmlns="http://s3.amazonaws.com/doc/2006-03-01/")
+        ET.SubElement(root, "Mode").text = self.mode
+        if self.retain_until:
+            ET.SubElement(root, "RetainUntilDate").text = \
+                self.retain_until.astimezone(
+                    datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+        return (b'<?xml version="1.0" encoding="UTF-8"?>' +
+                ET.tostring(root))
+
+    def active(self, now: Optional[datetime.datetime] = None) -> bool:
+        if not self.mode or self.retain_until is None:
+            return False
+        now = now or datetime.datetime.now(datetime.timezone.utc)
+        return now < self.retain_until
+
+
+def legal_hold_from_xml(data: bytes) -> str:
+    try:
+        root = ET.fromstring(data)
+    except ET.ParseError as e:
+        raise ObjectLockError("malformed legal hold XML") from e
+    _strip_ns(root)
+    status = root.findtext("Status") or ""
+    if status not in ("ON", "OFF"):
+        raise ObjectLockError("invalid legal hold Status")
+    return status
+
+
+def legal_hold_to_xml(status: str) -> bytes:
+    root = ET.Element(
+        "LegalHold", xmlns="http://s3.amazonaws.com/doc/2006-03-01/")
+    ET.SubElement(root, "Status").text = status or "OFF"
+    return b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root)
+
+
+def check_delete_allowed(meta: dict[str, str],
+                         governance_bypass: bool = False,
+                         now: Optional[datetime.datetime] = None) -> bool:
+    """enforceRetentionForDeletion (cmd/bucket-object-lock.go): True iff
+    deleting this exact version is permitted."""
+    if meta.get(AMZ_OBJECT_LOCK_LEGAL_HOLD, "").upper() == "ON":
+        return False
+    ret = Retention.from_metadata(meta)
+    if not ret.active(now):
+        return True
+    if ret.mode == COMPLIANCE:
+        return False
+    return governance_bypass

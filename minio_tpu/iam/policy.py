@@ -37,6 +37,27 @@ PUT_BUCKET_TAGGING = "s3:PutBucketTagging"
 GET_OBJECT_TAGGING = "s3:GetObjectTagging"
 PUT_OBJECT_TAGGING = "s3:PutObjectTagging"
 DELETE_OBJECT_TAGGING = "s3:DeleteObjectTagging"
+GET_LIFECYCLE = "s3:GetLifecycleConfiguration"
+PUT_LIFECYCLE = "s3:PutLifecycleConfiguration"
+GET_REPLICATION = "s3:GetReplicationConfiguration"
+PUT_REPLICATION = "s3:PutReplicationConfiguration"
+GET_BUCKET_NOTIFICATION = "s3:GetBucketNotification"
+PUT_BUCKET_NOTIFICATION = "s3:PutBucketNotification"
+LISTEN_NOTIFICATION = "s3:ListenNotification"
+GET_BUCKET_ENCRYPTION = "s3:GetEncryptionConfiguration"
+PUT_BUCKET_ENCRYPTION = "s3:PutEncryptionConfiguration"
+GET_BUCKET_OBJECT_LOCK = "s3:GetBucketObjectLockConfiguration"
+PUT_BUCKET_OBJECT_LOCK = "s3:PutBucketObjectLockConfiguration"
+GET_OBJECT_RETENTION = "s3:GetObjectRetention"
+PUT_OBJECT_RETENTION = "s3:PutObjectRetention"
+GET_OBJECT_LEGAL_HOLD = "s3:GetObjectLegalHold"
+PUT_OBJECT_LEGAL_HOLD = "s3:PutObjectLegalHold"
+BYPASS_GOVERNANCE = "s3:BypassGovernanceRetention"
+GET_BUCKET_ACL = "s3:GetBucketAcl"
+PUT_BUCKET_ACL = "s3:PutBucketAcl"
+GET_OBJECT_ACL = "s3:GetObjectAcl"
+PUT_OBJECT_ACL = "s3:PutObjectAcl"
+SELECT_OBJECT_CONTENT = "s3:GetObject"  # Select authorizes as GetObject
 ADMIN_ALL = "admin:*"
 
 

@@ -23,6 +23,7 @@ class BucketMetadataSys:
     def __init__(self, er):
         self._er = er            # ErasureObjects (or sets facade)
         self._cache: dict[str, dict] = {}
+        self._policy_cache: dict[str, tuple[str, Any]] = {}
         self._mu = threading.Lock()
 
     def _path(self, bucket: str) -> str:
@@ -46,8 +47,11 @@ class BucketMetadataSys:
                 continue
             if cand.get("_rev", 0) >= doc.get("_rev", 0):
                 doc = cand
-        with self._mu:
-            self._cache[bucket] = doc
+        if doc:
+            # never cache empty docs: anonymous probes of random bucket
+            # names must not grow the cache without bound
+            with self._mu:
+                self._cache[bucket] = doc
         return doc
 
     def update(self, bucket: str, key: str, value: Any) -> None:
@@ -75,6 +79,33 @@ class BucketMetadataSys:
             self._cache.pop(bucket, None)
 
     # -- typed accessors ---------------------------------------------------
+
+    def get_config(self, bucket: str, name: str) -> Optional[str]:
+        """Raw stored config document (XML/JSON string) or None."""
+        v = self.get(bucket).get(name)
+        if isinstance(v, dict):
+            return v.get("raw")
+        return v
+
+    def get_bucket_policy(self, bucket: str):
+        """Parsed bucket policy, cached per raw document (requests must
+        not re-parse JSON on every authorization)."""
+        raw = self.get_config(bucket, "policy")
+        if raw is None:
+            return None
+        with self._mu:
+            cached = self._policy_cache.get(bucket)
+            if cached is not None and cached[0] == raw:
+                return cached[1]
+        from ..bucket.policy import BucketPolicy
+        pol = BucketPolicy.parse(raw.encode())
+        with self._mu:
+            self._policy_cache[bucket] = (raw, pol)
+        return pol
+
+    def set_config(self, bucket: str, name: str,
+                   raw: Optional[str]) -> None:
+        self.update(bucket, name, raw)
 
     def versioning_enabled(self, bucket: str) -> bool:
         return self.get(bucket).get("versioning", {}).get(

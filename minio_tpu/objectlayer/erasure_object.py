@@ -471,6 +471,53 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             raise WriteQuorumError(str(e)) from e
         return ObjectInfo(bucket=bucket, name=object_name, version_id=vid)
 
+    def put_object_metadata(self, bucket: str, object_name: str,
+                            version_id: Optional[str],
+                            updates: dict[str, str],
+                            removes: tuple[str, ...] = ()) -> ObjectInfo:
+        """Update user metadata on an existing version in place
+        (cmd/erasure-object.go PutObjectTags / PutObjectMetadata).
+
+        Each drive rewrites its own xl.meta entry so per-shard erasure
+        indices and inline data are preserved; write quorum applies.
+        """
+        self._check_bucket(bucket)
+        lk = self.ns_lock.new_lock(bucket, object_name)
+        lk.lock(write=True)
+        try:
+            fi, _ = self._read_quorum_fileinfo(bucket, object_name,
+                                               version_id)
+            if fi.deleted:
+                raise MethodNotAllowed(
+                    f"{bucket}/{object_name} is a delete marker")
+            # an explicit version_id (including "" = the null version) must
+            # be honored as-is; only an unqualified request resolves to the
+            # latest version's id
+            vid = version_id if version_id is not None else \
+                (fi.version_id or None)
+
+            def update_one(disk):
+                dfi = disk.read_version(bucket, object_name, vid)
+                md = dict(dfi.metadata)
+                for k in removes:
+                    md.pop(k, None)
+                md.update(updates)
+                dfi.metadata = md
+                disk.write_metadata(bucket, object_name, dfi)
+
+            _, errs = self._fanout(update_one)
+            try:
+                meta.reduce_errs(errs, self._write_quorum(fi),
+                                 WriteQuorumError)
+            except serrors.StorageError as e:
+                raise WriteQuorumError(str(e)) from e
+            for k in removes:
+                fi.metadata.pop(k, None)
+            fi.metadata.update(updates)
+            return self._to_object_info(fi)
+        finally:
+            lk.unlock()
+
     # -- LIST (walk-merge; cmd/metacache-set.go simplified) ----------------
 
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",

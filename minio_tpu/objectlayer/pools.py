@@ -106,6 +106,12 @@ class ErasureServerPools(ObjectLayer):
             pool = self.pools[0]
         return pool.delete_object(bucket, object_name, opts)
 
+    def put_object_metadata(self, bucket, object_name, version_id, updates,
+                            removes=()) -> ObjectInfo:
+        self.get_bucket_info(bucket)
+        return self._find_pool(bucket, object_name).put_object_metadata(
+            bucket, object_name, version_id, updates, removes)
+
     def list_objects(self, bucket, prefix="", marker="", delimiter="",
                      max_keys=1000) -> ListObjectsInfo:
         out = ListObjectsInfo()

@@ -106,6 +106,11 @@ class ErasureSets(ObjectLayer):
         return self.get_hashed_set(object_name).delete_object(
             bucket, object_name, opts)
 
+    def put_object_metadata(self, bucket, object_name, version_id, updates,
+                            removes=()) -> ObjectInfo:
+        return self.get_hashed_set(object_name).put_object_metadata(
+            bucket, object_name, version_id, updates, removes)
+
     def list_objects(self, bucket, prefix="", marker="", delimiter="",
                      max_keys=1000) -> ListObjectsInfo:
         """Merge per-set listings (cmd/metacache-server-pool.go analog)."""

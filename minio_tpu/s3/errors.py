@@ -103,6 +103,50 @@ _ERRORS = {
     "XMinioServerNotInitialized": APIError(
         "XMinioServerNotInitialized", "Server not initialized yet, please "
         "try again.", 503),
+    "NoSuchBucketPolicy": APIError(
+        "NoSuchBucketPolicy", "The bucket policy does not exist", 404),
+    "NoSuchLifecycleConfiguration": APIError(
+        "NoSuchLifecycleConfiguration",
+        "The lifecycle configuration does not exist", 404),
+    "ReplicationConfigurationNotFoundError": APIError(
+        "ReplicationConfigurationNotFoundError",
+        "The replication configuration was not found", 404),
+    "ServerSideEncryptionConfigurationNotFoundError": APIError(
+        "ServerSideEncryptionConfigurationNotFoundError",
+        "The server side encryption configuration was not found", 404),
+    "ObjectLockConfigurationNotFoundError": APIError(
+        "ObjectLockConfigurationNotFoundError",
+        "Object Lock configuration does not exist for this bucket", 404),
+    "InvalidBucketObjectLockConfiguration": APIError(
+        "InvalidBucketObjectLockConfiguration",
+        "Bucket is missing ObjectLockConfiguration", 400),
+    "NoSuchObjectLockConfiguration": APIError(
+        "NoSuchObjectLockConfiguration",
+        "The specified object does not have an ObjectLock configuration",
+        404),
+    "ObjectLocked": APIError(
+        "ObjectLocked", "Object is WORM protected and cannot be "
+        "overwritten or deleted", 400),
+    "NoSuchTagSet": APIError(
+        "NoSuchTagSet", "The TagSet does not exist", 404),
+    "InvalidTag": APIError(
+        "InvalidTag", "The tag provided was not a valid tag. A provided "
+        "tag key or value was invalid.", 400),
+    "MalformedPolicy": APIError(
+        "MalformedPolicy", "Policy has invalid resource.", 400),
+    "NoSuchCORSConfiguration": APIError(
+        "NoSuchCORSConfiguration",
+        "The CORS configuration does not exist", 404),
+    "BadRequest": APIError("BadRequest", "400 BadRequest", 400),
+    "InvalidBucketState": APIError(
+        "InvalidBucketState", "The request is not valid with the current "
+        "state of the bucket.", 409),
+    "AdminBucketQuotaExceeded": APIError(
+        "XMinioAdminBucketQuotaExceeded",
+        "Bucket quota may be exceeded with this request.", 400),
+    "ReplicationDestinationNotFoundError": APIError(
+        "ReplicationDestinationNotFoundError",
+        "The replication destination bucket does not exist", 404),
 }
 
 

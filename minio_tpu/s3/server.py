@@ -51,6 +51,32 @@ def _xml(root: ET.Element) -> bytes:
     return (b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root))
 
 
+def _try(fn):
+    """Run a config parser, translating its ValueError into an S3Error
+    (carrying the parser's .code when present)."""
+    try:
+        return fn()
+    except ValueError as e:
+        raise S3Error(getattr(e, "code", "MalformedXML")) from e
+
+
+def _canned_acl_xml() -> bytes:
+    """The fixed FULL_CONTROL owner ACL MinIO reports
+    (cmd/bucket-handlers.go GetBucketACLHandler)."""
+    root = ET.Element("AccessControlPolicy", xmlns=S3_NS)
+    owner = ET.SubElement(root, "Owner")
+    ET.SubElement(owner, "ID").text = "minio-tpu"
+    acl = ET.SubElement(root, "AccessControlList")
+    grant = ET.SubElement(acl, "Grant")
+    grantee = ET.SubElement(
+        grant, "Grantee",
+        {"xmlns:xsi": "http://www.w3.org/2001/XMLSchema-instance",
+         "xsi:type": "CanonicalUser"})
+    ET.SubElement(grantee, "ID").text = "minio-tpu"
+    ET.SubElement(grant, "Permission").text = "FULL_CONTROL"
+    return _xml(root)
+
+
 class S3Server:
     """Wires an ObjectLayer + credentials into an HTTP server."""
 
@@ -68,6 +94,10 @@ class S3Server:
         self.bucket_meta = BucketMetadataSys(object_layer)
         from ..utils.kvconfig import Config
         self.config = Config(object_layer)
+        # wired in by server_main / tests when those subsystems are enabled
+        self.events = None       # NotificationSys (minio_tpu/events)
+        self.replication = None  # ReplicationSys (minio_tpu/replication)
+        self.usage = None        # data-usage cache (crawler)
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
@@ -86,6 +116,19 @@ class S3Server:
     @property
     def endpoint(self) -> str:
         return f"http://127.0.0.1:{self.port}"
+
+    def notify(self, event_name: str, bucket: str, oi,
+               req_params: dict | None = None) -> None:
+        """Fire a bucket event into the notification system (no-op until
+        NotificationSys is attached)."""
+        if self.events is not None:
+            self.events.send(event_name, bucket, oi, req_params or {})
+
+    def replicate(self, bucket: str, oi, delete: bool = False) -> None:
+        """Queue async replication if the bucket's config asks for it
+        (no-op until ReplicationSys is attached)."""
+        if self.replication is not None:
+            self.replication.queue(bucket, oi, delete=delete)
 
 
 def _make_handler(srv: S3Server):
@@ -122,6 +165,16 @@ def _make_handler(srv: S3Server):
             lookup = srv.iam.lookup_secret
             hdrs = {k: v for k, v in self.headers.items()}
             try:
+                if "Authorization" not in hdrs and \
+                        "X-Amz-Signature" not in query:
+                    # anonymous request: authorization happens against the
+                    # bucket policy alone (cmd/auth-handler.go authTypeAnonymous)
+                    self.access_key = ""
+                    sha = self.headers.get("x-amz-content-sha256")
+                    if sha and sha != sigv4.UNSIGNED_PAYLOAD:
+                        if hashlib.sha256(payload).hexdigest() != sha:
+                            raise S3Error("BadDigest")
+                    return payload
                 if "X-Amz-Signature" in query:
                     self.access_key = sigv4.verify_presigned(
                         lookup, self.command, path, query, hdrs,
@@ -148,9 +201,28 @@ def _make_handler(srv: S3Server):
                 raise S3Error(e.code) from e
 
         def _allow(self, action: str, resource: str = "") -> None:
-            """Authorize the authenticated key for an S3 action
-            (checkRequestAuthType -> IAMSys.IsAllowed)."""
-            if not srv.iam.is_allowed(self.access_key, action, resource):
+            """Authorize the authenticated key for an S3 action: bucket
+            policy first (explicit Deny wins, Allow grants even anonymous),
+            then IAM (checkRequestAuthType -> IAMSys.IsAllowed)."""
+            bucket = resource.split("/", 1)[0]
+            # bucket policy can only speak for s3: actions — admin:* must
+            # never be grantable by a bucket document
+            if bucket and action.startswith("s3:"):
+                try:
+                    pol = srv.bucket_meta.get_bucket_policy(bucket)
+                    verdict = pol.is_allowed(
+                        self.access_key, action, resource) \
+                        if pol is not None else None
+                except Exception as e:  # noqa: BLE001 — fail CLOSED: an
+                    # unevaluable policy must not silently drop its Denies
+                    raise S3Error("AccessDenied") from e
+                if verdict is False:
+                    raise S3Error("AccessDenied")
+                if verdict is True:
+                    return
+            if not self.access_key or \
+                    not srv.iam.is_allowed(self.access_key, action,
+                                           resource):
                 raise S3Error("AccessDenied")
 
         def _send(self, status: int, body: bytes = b"",
@@ -237,8 +309,206 @@ def _make_handler(srv: S3Server):
                 ET.SubElement(be, "CreationDate").text = _iso_date(b.created)
             self._send(200, _xml(root))
 
+        # config subresources: query-param -> (module handler); each stores
+        # the raw document in BucketMetadataSys and round-trips it on GET
+        # (cmd/bucket-handlers.go, cmd/bucket-lifecycle-handlers.go, ...)
+
+        def _config_api(self, bucket, query, payload) -> bool:
+            from ..bucket import (encryption, lifecycle, notification,
+                                  objectlock, replication, tags)
+            from ..bucket import policy as bpolicy
+            cmd = self.command
+            if not ({"policy", "lifecycle", "encryption", "replication",
+                     "notification", "object-lock", "tagging", "quota",
+                     "acl", "cors"} & set(query)):
+                return False
+
+            def exists():
+                # authorization happens BEFORE the existence check so an
+                # unauthenticated caller cannot enumerate bucket names by
+                # distinguishing 404 from 403 (cmd/auth-handler.go order)
+                srv.layer.get_bucket_info(bucket)
+
+            def crud(param, get_act, put_act, parse, not_found,
+                     store_key=None, deletable=True, parse_err="MalformedXML"):
+                if param not in query:
+                    return False
+                store_key = store_key or param
+                if cmd == "PUT":
+                    self._allow(put_act, bucket)
+                    exists()
+                    try:
+                        doc = parse(payload)
+                    except (ValueError, KeyError) as e:
+                        code = getattr(e, "code", parse_err)
+                        raise S3Error(code) from e
+                    srv.bucket_meta.set_config(bucket, store_key, doc)
+                    self._send(200)
+                elif cmd == "GET":
+                    self._allow(get_act, bucket)
+                    exists()
+                    raw = srv.bucket_meta.get_config(bucket, store_key)
+                    if raw is None:
+                        raise S3Error(not_found)
+                    ctype = "application/json" \
+                        if store_key == "policy" else "application/xml"
+                    self._send(200, raw.encode(), content_type=ctype)
+                elif cmd == "DELETE" and deletable:
+                    self._allow(put_act, bucket)
+                    exists()
+                    srv.bucket_meta.set_config(bucket, store_key, None)
+                    self._send(204)
+                else:
+                    raise S3Error("MethodNotAllowed")
+                return True
+
+            if crud("policy", iampol.GET_BUCKET_POLICY,
+                    iampol.PUT_BUCKET_POLICY,
+                    lambda p: bpolicy.BucketPolicy.parse(p, bucket)
+                    .to_json().decode(),
+                    "NoSuchBucketPolicy", parse_err="MalformedPolicy"):
+                return True
+            if crud("lifecycle", iampol.GET_LIFECYCLE, iampol.PUT_LIFECYCLE,
+                    lambda p: lifecycle.Lifecycle.parse(p).to_xml().decode(),
+                    "NoSuchLifecycleConfiguration"):
+                return True
+            if crud("encryption", iampol.GET_BUCKET_ENCRYPTION,
+                    iampol.PUT_BUCKET_ENCRYPTION,
+                    lambda p: encryption.SSEConfig.parse(p)
+                    .to_xml().decode(),
+                    "ServerSideEncryptionConfigurationNotFoundError"):
+                return True
+            if "replication" in query and cmd == "PUT":
+                # destination ARN must name a registered remote target
+                self._allow(iampol.PUT_REPLICATION, bucket)
+                exists()
+                cfg = _try(lambda: replication.Config.parse(payload))
+                if not srv.bucket_meta.versioning_enabled(bucket):
+                    raise S3Error("InvalidRequest")
+                if srv.replication is not None:
+                    for r in cfg.rules:
+                        if not srv.replication.arn_exists(
+                                r.destination_arn):
+                            raise S3Error(
+                                "ReplicationDestinationNotFoundError")
+                srv.bucket_meta.set_config(bucket, "replication",
+                                           cfg.to_xml().decode())
+                return self._send(200) or True
+            if crud("replication", iampol.GET_REPLICATION,
+                    iampol.PUT_REPLICATION,
+                    lambda p: replication.Config.parse(p).to_xml().decode(),
+                    "ReplicationConfigurationNotFoundError"):
+                return True
+            if "notification" in query:
+                if cmd == "PUT":
+                    self._allow(iampol.PUT_BUCKET_NOTIFICATION, bucket)
+                    exists()
+                    cfg = _try(lambda: notification.Config.parse(
+                        payload,
+                        valid_arns=(srv.events.valid_arns()
+                                    if srv.events is not None else None)))
+                    srv.bucket_meta.set_config(
+                        bucket, "notification",
+                        cfg.to_xml().decode() if cfg.targets else None)
+                    return self._send(200) or True
+                if cmd == "GET":
+                    self._allow(iampol.GET_BUCKET_NOTIFICATION, bucket)
+                    exists()
+                    raw = srv.bucket_meta.get_config(bucket, "notification")
+                    if raw is None:
+                        raw = notification.Config().to_xml().decode()
+                    return self._send(200, raw.encode()) or True
+                raise S3Error("MethodNotAllowed")
+            if "object-lock" in query:
+                if cmd == "PUT":
+                    self._allow(iampol.PUT_BUCKET_OBJECT_LOCK, bucket)
+                    exists()
+                    cfg = _try(lambda: objectlock.LockConfig.parse(payload))
+                    if srv.bucket_meta.get_config(bucket,
+                                                  "object-lock") is None:
+                        # can only be set at creation in S3; MinIO allows
+                        # updating the default rule iff lock was enabled
+                        raise S3Error(
+                            "InvalidBucketObjectLockConfiguration")
+                    srv.bucket_meta.set_config(bucket, "object-lock",
+                                               cfg.to_xml().decode())
+                    return self._send(200) or True
+                if cmd == "GET":
+                    self._allow(iampol.GET_BUCKET_OBJECT_LOCK, bucket)
+                    exists()
+                    raw = srv.bucket_meta.get_config(bucket, "object-lock")
+                    if raw is None:
+                        raise S3Error(
+                            "ObjectLockConfigurationNotFoundError")
+                    return self._send(200, raw.encode()) or True
+                raise S3Error("MethodNotAllowed")
+            if "tagging" in query:
+                if cmd == "PUT":
+                    self._allow(iampol.PUT_BUCKET_TAGGING, bucket)
+                    exists()
+                    t = _try(lambda: tags.parse_xml(payload,
+                                                    is_object=False))
+                    srv.bucket_meta.set_config(bucket, "tagging",
+                                               tags.to_xml(t).decode())
+                    return self._send(200) or True
+                if cmd == "GET":
+                    self._allow(iampol.GET_BUCKET_TAGGING, bucket)
+                    exists()
+                    raw = srv.bucket_meta.get_config(bucket, "tagging")
+                    if raw is None:
+                        raise S3Error("NoSuchTagSet")
+                    return self._send(200, raw.encode()) or True
+                if cmd == "DELETE":
+                    self._allow(iampol.PUT_BUCKET_TAGGING, bucket)
+                    exists()
+                    srv.bucket_meta.set_config(bucket, "tagging", None)
+                    return self._send(204) or True
+                raise S3Error("MethodNotAllowed")
+            if "quota" in query:  # admin-style; also exposed here
+                from ..bucket.quota import Quota
+                if cmd == "PUT":
+                    self._allow(iampol.ADMIN_ALL, bucket)
+                    exists()
+                    q = _try(lambda: Quota.parse(payload))
+                    srv.bucket_meta.set_config(bucket, "quota",
+                                               q.to_json().decode())
+                    return self._send(200) or True
+                if cmd == "GET":
+                    self._allow(iampol.ADMIN_ALL, bucket)
+                    exists()
+                    raw = srv.bucket_meta.get_config(bucket, "quota") \
+                        or '{"quota": 0, "quotatype": "hard"}'
+                    return self._send(200, raw.encode(),
+                                      content_type="application/json") \
+                        or True
+                raise S3Error("MethodNotAllowed")
+            if "acl" in query:
+                if cmd == "GET":
+                    self._allow(iampol.GET_BUCKET_ACL, bucket)
+                    exists()
+                    return self._send(200, _canned_acl_xml()) or True
+                if cmd == "PUT":
+                    # only the private canned ACL is accepted
+                    self._allow(iampol.PUT_BUCKET_ACL, bucket)
+                    exists()
+                    acl = self.headers.get("x-amz-acl", "private")
+                    if acl != "private" or (payload and
+                                            b"FULL_CONTROL" not in payload):
+                        raise S3Error("NotImplemented")
+                    return self._send(200) or True
+                raise S3Error("MethodNotAllowed")
+            if "cors" in query:
+                self._allow(iampol.GET_BUCKET_LOCATION, bucket)
+                exists()
+                if cmd == "GET":
+                    raise S3Error("NoSuchCORSConfiguration")
+                raise S3Error("NotImplemented")
+            return False
+
         def _bucket_api(self, bucket, query, payload):
             cmd = self.command
+            if self._config_api(bucket, query, payload):
+                return
             if cmd == "PUT" and "versioning" in query:
                 self._allow(iampol.PUT_BUCKET_VERSIONING, bucket)
                 return self._put_versioning(bucket, payload)
@@ -262,6 +532,15 @@ def _make_handler(srv: S3Server):
             if cmd == "PUT":
                 self._allow(iampol.CREATE_BUCKET, bucket)
                 srv.layer.make_bucket(bucket)
+                if self.headers.get("x-amz-bucket-object-lock-enabled",
+                                    "").lower() == "true":
+                    # lock implies versioning (cmd/bucket-handlers.go
+                    # PutBucketHandler: object-lock buckets are versioned)
+                    from ..bucket.objectlock import LockConfig
+                    srv.bucket_meta.set_versioning(bucket, True)
+                    srv.bucket_meta.set_config(
+                        bucket, "object-lock",
+                        LockConfig(enabled=True).to_xml().decode())
                 return self._send(200, headers={"Location": f"/{bucket}"})
             if cmd == "HEAD":
                 self._allow(iampol.LIST_BUCKET, bucket)
@@ -285,6 +564,12 @@ def _make_handler(srv: S3Server):
                     root.findtext("Status") or ""
             except ET.ParseError as e:
                 raise S3Error("MalformedXML") from e
+            if status != "Enabled" and \
+                    srv.bucket_meta.get_config(bucket,
+                                               "object-lock") is not None:
+                # object-lock buckets must stay versioned (AWS
+                # InvalidBucketState)
+                raise S3Error("InvalidBucketState")
             srv.bucket_meta.set_versioning(bucket, status == "Enabled")
             self._send(200)
 
@@ -387,6 +672,7 @@ def _make_handler(srv: S3Server):
                     obj.findtext("VersionId")
                 try:
                     self._allow(iampol.DELETE_OBJECT, f"{bucket}/{key}")
+                    self._check_retention(bucket, key, vid)
                     res = srv.layer.delete_object(
                         bucket, key,
                         ol.ObjectOptions(version_id=vid,
@@ -417,6 +703,27 @@ def _make_handler(srv: S3Server):
         def _object_api(self, bucket, key, query, payload):
             cmd = self.command
             resource = f"{bucket}/{key}"
+            if "tagging" in query:
+                return self._object_tagging(bucket, key, query, payload)
+            if "retention" in query:
+                return self._object_retention(bucket, key, query, payload)
+            if "legal-hold" in query:
+                return self._object_legal_hold(bucket, key, query, payload)
+            if "acl" in query:
+                if cmd == "GET":
+                    self._allow(iampol.GET_OBJECT_ACL, resource)
+                    srv.layer.get_object_info(bucket, key)
+                    return self._send(200, _canned_acl_xml())
+                if cmd == "PUT":
+                    self._allow(iampol.PUT_OBJECT_ACL, resource)
+                    if self.headers.get("x-amz-acl", "private") != "private":
+                        raise S3Error("NotImplemented")
+                    return self._send(200)
+                raise S3Error("MethodNotAllowed")
+            if cmd == "POST" and "select" in query and \
+                    query.get("select-type") == ["2"]:
+                self._allow(iampol.GET_OBJECT, resource)
+                return self._select_object(bucket, key, payload)
             if cmd == "POST" and "uploads" in query:
                 self._allow(iampol.PUT_OBJECT, resource)
                 return self._create_multipart(bucket, key)
@@ -450,6 +757,148 @@ def _make_handler(srv: S3Server):
                 return self._delete_object(bucket, key, query)
             raise S3Error("MethodNotAllowed")
 
+        # -- object subresources (tagging/retention/legal-hold) ------------
+
+        TAG_KEY = "x-amz-tagging"  # metadata key holding url-encoded tags
+
+        def _vid(self, query) -> str | None:
+            vid = query.get("versionId", [None])[0]
+            return "" if vid == "null" else vid
+
+        def _object_tagging(self, bucket, key, query, payload):
+            from ..bucket import tags as btags
+            resource = f"{bucket}/{key}"
+            vid = self._vid(query)
+            if self.command == "PUT":
+                self._allow(iampol.PUT_OBJECT_TAGGING, resource)
+                t = _try(lambda: btags.parse_xml(payload))
+                oi = srv.layer.put_object_metadata(
+                    bucket, key, vid, {self.TAG_KEY: btags.to_header(t)})
+                srv.notify("s3:ObjectCreated:PutTagging", bucket, oi)
+                return self._send(200)
+            if self.command == "GET":
+                self._allow(iampol.GET_OBJECT_TAGGING, resource)
+                oi = srv.layer.get_object_info(
+                    bucket, key, ol.ObjectOptions(version_id=vid))
+                t = btags.parse_header(
+                    oi.user_defined.get(self.TAG_KEY, ""))
+                return self._send(200, btags.to_xml(t))
+            if self.command == "DELETE":
+                self._allow(iampol.DELETE_OBJECT_TAGGING, resource)
+                oi = srv.layer.put_object_metadata(
+                    bucket, key, vid, {}, removes=(self.TAG_KEY,))
+                srv.notify("s3:ObjectCreated:DeleteTagging", bucket, oi)
+                return self._send(204)
+            raise S3Error("MethodNotAllowed")
+
+        def _object_retention(self, bucket, key, query, payload):
+            from ..bucket import objectlock as olock
+            resource = f"{bucket}/{key}"
+            vid = self._vid(query)
+            if self.command == "PUT":
+                self._allow(iampol.PUT_OBJECT_RETENTION, resource)
+                if srv.bucket_meta.get_config(bucket, "object-lock") is None:
+                    raise S3Error("InvalidRequest")
+                ret = _try(lambda: olock.Retention.parse(payload))
+                # tightening is always allowed; loosening COMPLIANCE is not
+                oi = srv.layer.get_object_info(
+                    bucket, key, ol.ObjectOptions(version_id=vid))
+                cur = olock.Retention.from_metadata(oi.user_defined)
+                if cur.active() and cur.mode == olock.COMPLIANCE and (
+                        ret.retain_until < cur.retain_until or
+                        ret.mode != olock.COMPLIANCE):
+                    raise S3Error("ObjectLocked")
+                if cur.active() and cur.mode == olock.GOVERNANCE and \
+                        not self._governance_bypass(resource):
+                    if ret.retain_until < cur.retain_until or \
+                            ret.mode != cur.mode:
+                        raise S3Error("ObjectLocked")
+                oi = srv.layer.put_object_metadata(bucket, key, vid, {
+                    olock.AMZ_OBJECT_LOCK_MODE: ret.mode,
+                    olock.AMZ_OBJECT_LOCK_RETAIN_UNTIL:
+                        ret.retain_until.astimezone(
+                            datetime.timezone.utc).strftime(
+                                "%Y-%m-%dT%H:%M:%SZ"),
+                })
+                srv.notify("s3:ObjectCreated:PutRetention", bucket, oi)
+                return self._send(200)
+            if self.command == "GET":
+                self._allow(iampol.GET_OBJECT_RETENTION, resource)
+                oi = srv.layer.get_object_info(
+                    bucket, key, ol.ObjectOptions(version_id=vid))
+                ret = olock.Retention.from_metadata(oi.user_defined)
+                if not ret.mode:
+                    raise S3Error("NoSuchObjectLockConfiguration")
+                return self._send(200, ret.to_xml())
+            raise S3Error("MethodNotAllowed")
+
+        def _object_legal_hold(self, bucket, key, query, payload):
+            from ..bucket import objectlock as olock
+            resource = f"{bucket}/{key}"
+            vid = self._vid(query)
+            if self.command == "PUT":
+                self._allow(iampol.PUT_OBJECT_LEGAL_HOLD, resource)
+                if srv.bucket_meta.get_config(bucket, "object-lock") is None:
+                    raise S3Error("InvalidRequest")
+                status = _try(lambda: olock.legal_hold_from_xml(payload))
+                oi = srv.layer.put_object_metadata(
+                    bucket, key, vid,
+                    {olock.AMZ_OBJECT_LOCK_LEGAL_HOLD: status})
+                srv.notify("s3:ObjectCreated:PutLegalHold", bucket, oi)
+                return self._send(200)
+            if self.command == "GET":
+                self._allow(iampol.GET_OBJECT_LEGAL_HOLD, resource)
+                oi = srv.layer.get_object_info(
+                    bucket, key, ol.ObjectOptions(version_id=vid))
+                status = oi.user_defined.get(
+                    olock.AMZ_OBJECT_LOCK_LEGAL_HOLD, "OFF")
+                return self._send(200, olock.legal_hold_to_xml(status))
+            raise S3Error("MethodNotAllowed")
+
+        def _governance_bypass(self, resource: str) -> bool:
+            if self.headers.get("x-amz-bypass-governance-retention",
+                                "").lower() != "true":
+                return False
+            try:
+                self._allow(iampol.BYPASS_GOVERNANCE, resource)
+                return True
+            except S3Error:
+                return False
+
+        def _select_object(self, bucket, key, payload):
+            try:
+                from . import select as s3select
+            except ImportError as e:
+                raise S3Error("NotImplemented") from e
+            oi, data = srv.layer.get_object(bucket, key)
+            try:
+                out = s3select.run(payload, data,
+                                   content_type=oi.content_type)
+            except s3select.SelectError as e:
+                raise S3Error(e.code) from e
+            self._send(200, out,
+                       content_type="application/octet-stream")
+
+        def _check_quota(self, bucket: str, nbytes: int) -> None:
+            """Hard-quota admission (cmd/bucket-quota.go); needs the
+            crawler's usage cache to be attached."""
+            if srv.usage is None:
+                return
+            from ..bucket.quota import Quota
+            raw = srv.bucket_meta.get_config(bucket, "quota")
+            if raw and not Quota.parse(raw.encode()).allows(
+                    srv.usage.bucket_size(bucket), nbytes):
+                raise S3Error("AdminBucketQuotaExceeded")
+
+        def _tagging_header_meta(self) -> dict[str, str]:
+            """Validated x-amz-tagging header as metadata entries."""
+            tag_hdr = self.headers.get("x-amz-tagging")
+            if not tag_hdr:
+                return {}
+            from ..bucket import tags as btags
+            _try(lambda: btags.parse_header(tag_hdr))
+            return {self.TAG_KEY: tag_hdr}
+
         def _create_multipart(self, bucket, key):
             user_defined = {}
             ct = self.headers.get("Content-Type")
@@ -458,6 +907,10 @@ def _make_handler(srv: S3Server):
             for h, v in self.headers.items():
                 if h.lower().startswith("x-amz-meta-"):
                     user_defined[h.lower()] = v
+            # same admission rules as PutObject: tagging header + object
+            # lock defaults (a multipart upload must not dodge WORM)
+            user_defined.update(self._tagging_header_meta())
+            user_defined.update(self._lock_headers(bucket, key))
             versioned = srv.bucket_meta.versioning_enabled(bucket)
             uid = srv.layer.new_multipart_upload(
                 bucket, key, ol.PutObjectOptions(
@@ -474,6 +927,7 @@ def _make_handler(srv: S3Server):
                 part_num = int(query["partNumber"][0])
             except (KeyError, ValueError) as e:
                 raise S3Error("InvalidArgument") from e
+            self._check_quota(bucket, len(payload))
             pi = srv.layer.put_object_part(bucket, key, uid, part_num,
                                            payload)
             self._send(200, headers={"ETag": f'"{pi.etag}"'})
@@ -503,6 +957,9 @@ def _make_handler(srv: S3Server):
             hdrs = {}
             if oi.version_id:
                 hdrs["x-amz-version-id"] = oi.version_id
+            srv.notify("s3:ObjectCreated:CompleteMultipartUpload", bucket,
+                       oi)
+            srv.replicate(bucket, oi)
             self._send(200, _xml(out), headers=hdrs)
 
         def _list_parts(self, bucket, key, query):
@@ -541,6 +998,9 @@ def _make_handler(srv: S3Server):
             for h, v in self.headers.items():
                 if h.lower().startswith("x-amz-meta-"):
                     user_defined[h.lower()] = v
+            user_defined.update(self._tagging_header_meta())
+            user_defined.update(self._lock_headers(bucket, key))
+            self._check_quota(bucket, len(payload))
             versioned = srv.bucket_meta.versioning_enabled(bucket)
             oi = srv.layer.put_object(
                 bucket, key, payload,
@@ -549,7 +1009,52 @@ def _make_handler(srv: S3Server):
             hdrs = {"ETag": f'"{oi.etag}"'}
             if oi.version_id:
                 hdrs["x-amz-version-id"] = oi.version_id
+            srv.notify("s3:ObjectCreated:Put", bucket, oi)
+            srv.replicate(bucket, oi)
             self._send(200, headers=hdrs)
+
+        def _lock_headers(self, bucket: str, key: str) -> dict[str, str]:
+            """Explicit x-amz-object-lock-* headers, else the bucket's
+            default retention (cmd/bucket-object-lock.go)."""
+            from ..bucket import objectlock as olock
+            raw = srv.bucket_meta.get_config(bucket, "object-lock")
+            out: dict[str, str] = {}
+            mode = self.headers.get(olock.AMZ_OBJECT_LOCK_MODE)
+            until = self.headers.get(olock.AMZ_OBJECT_LOCK_RETAIN_UNTIL)
+            hold = self.headers.get(olock.AMZ_OBJECT_LOCK_LEGAL_HOLD)
+            if mode or until or hold:
+                if raw is None:
+                    raise S3Error("InvalidRequest")
+                if (mode is None) != (until is None):
+                    raise S3Error("InvalidRequest")
+                if mode:
+                    if mode not in (olock.GOVERNANCE, olock.COMPLIANCE):
+                        raise S3Error("InvalidRequest")
+                    # the retain-until header must be a valid, future
+                    # timestamp — storing garbage would mint an object the
+                    # client believes is WORM but that active() never locks
+                    try:
+                        dt = datetime.datetime.fromisoformat(
+                            until.replace("Z", "+00:00"))
+                        if dt.tzinfo is None:
+                            dt = dt.replace(tzinfo=datetime.timezone.utc)
+                    except ValueError as e:
+                        raise S3Error("InvalidRequest") from e
+                    if dt <= datetime.datetime.now(datetime.timezone.utc):
+                        raise S3Error("InvalidRequest")
+                    out[olock.AMZ_OBJECT_LOCK_MODE] = mode
+                    out[olock.AMZ_OBJECT_LOCK_RETAIN_UNTIL] = \
+                        dt.astimezone(datetime.timezone.utc).strftime(
+                            "%Y-%m-%dT%H:%M:%SZ")
+                if hold:
+                    if hold not in ("ON", "OFF"):
+                        raise S3Error("InvalidRequest")
+                    out[olock.AMZ_OBJECT_LOCK_LEGAL_HOLD] = hold
+                return out
+            if raw is not None:
+                cfg = _try(lambda: olock.LockConfig.parse(raw.encode()))
+                out.update(cfg.default_retention_headers())
+            return out
 
         def _get_object(self, bucket, key, query, head: bool):
             q1 = {k: v[0] for k, v in query.items()}
@@ -584,6 +1089,13 @@ def _make_handler(srv: S3Server):
                 if k2.startswith("x-amz-meta-"):
                     hdrs[k2] = v
             ct = oi.content_type or "binary/octet-stream"
+            tag_hdr = oi.user_defined.get(self.TAG_KEY)
+            if tag_hdr:
+                hdrs["x-amz-tagging-count"] = str(
+                    len(urllib.parse.parse_qsl(tag_hdr,
+                                               keep_blank_values=True)))
+            srv.notify("s3:ObjectAccessed:Head" if head
+                       else "s3:ObjectAccessed:Get", bucket, oi)
             if head:
                 if oi.delete_marker:
                     hdrs = {"x-amz-delete-marker": "true"}
@@ -605,6 +1117,7 @@ def _make_handler(srv: S3Server):
             vid = q1.get("versionId")
             if vid == "null":
                 vid = ""
+            self._check_retention(bucket, key, vid)
             versioned = srv.bucket_meta.versioning_enabled(bucket)
             res = srv.layer.delete_object(
                 bucket, key, ol.ObjectOptions(version_id=vid,
@@ -614,7 +1127,31 @@ def _make_handler(srv: S3Server):
                 hdrs["x-amz-delete-marker"] = "true"
             if res.version_id:
                 hdrs["x-amz-version-id"] = res.version_id
+            srv.notify("s3:ObjectRemoved:DeleteMarkerCreated"
+                       if res.delete_marker else "s3:ObjectRemoved:Delete",
+                       bucket, res)
+            srv.replicate(bucket, res, delete=True)
             self._send(204, headers=hdrs)
+
+        def _check_retention(self, bucket, key, vid) -> None:
+            """WORM enforcement: deleting a *specific version* under
+            retention/legal hold is refused (a versioned delete that only
+            writes a delete marker is always allowed)."""
+            from ..bucket import objectlock as olock
+            if vid is None:
+                if srv.bucket_meta.versioning_enabled(bucket):
+                    return      # becomes a delete marker, data retained
+            if srv.bucket_meta.get_config(bucket, "object-lock") is None:
+                return
+            try:
+                oi = srv.layer.get_object_info(
+                    bucket, key, ol.ObjectOptions(version_id=vid))
+            except ol.ObjectLayerError:
+                return
+            bypass = self._governance_bypass(f"{bucket}/{key}")
+            if not olock.check_delete_allowed(oi.user_defined,
+                                              governance_bypass=bypass):
+                raise S3Error("ObjectLocked")
 
     return Handler
 

@@ -3,12 +3,20 @@
 Real-TPU execution is exercised by bench.py and the driver's graft entry;
 the test suite must be runnable anywhere, with enough virtual devices to
 test the multi-chip sharding paths (SURVEY.md section 7).
+
+The env vars alone are not enough on hosts whose sitecustomize registers
+an accelerator PJRT plugin (the axon tunnel re-selects its platform over
+JAX_PLATFORMS); jax.config.update pins the platform authoritatively.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

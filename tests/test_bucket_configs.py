@@ -1,0 +1,367 @@
+"""Bucket feature config tests: lifecycle, object lock, tagging, policy,
+quota, replication, notification, encryption — PUT/GET/DELETE round trips
+and enforcement (mirrors cmd/bucket-*-handlers_test.go tiers).
+"""
+
+import datetime
+import urllib.request
+
+import pytest
+
+from minio_tpu.bucket import lifecycle as lc
+from minio_tpu.bucket import objectlock as olock
+from minio_tpu.bucket.quota import Quota
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.s3.client import S3Client, S3ClientError
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl_storage import XLStorage
+
+S3NS = 'xmlns="http://s3.amazonaws.com/doc/2006-03-01/"'
+DAY_NS = int(24 * 3600 * 1e9)
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("cfgdrives")
+    disks = []
+    for i in range(4):
+        d = tmp / f"disk{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                           backend="numpy")
+    srv = S3Server(layer, access_key="testkey", secret_key="testsecret")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def client(server):
+    return S3Client(server.endpoint, "testkey", "testsecret")
+
+
+# -- lifecycle ------------------------------------------------------------
+
+LC_XML = f"""<LifecycleConfiguration {S3NS}>
+  <Rule>
+    <ID>expire-logs</ID>
+    <Status>Enabled</Status>
+    <Filter><Prefix>logs/</Prefix></Filter>
+    <Expiration><Days>30</Days></Expiration>
+  </Rule>
+</LifecycleConfiguration>"""
+
+
+def test_lifecycle_roundtrip(client):
+    client.make_bucket("lcb")
+    with pytest.raises(S3ClientError) as ei:
+        client.request("GET", "/lcb", "lifecycle")
+    assert ei.value.code == "NoSuchLifecycleConfiguration"
+    client.request("PUT", "/lcb", "lifecycle", LC_XML.encode())
+    got = client.request("GET", "/lcb", "lifecycle").body
+    cfg = lc.Lifecycle.parse(got)
+    assert cfg.rules[0].rule_id == "expire-logs"
+    assert cfg.rules[0].expiration_days == 30
+    assert cfg.rules[0].filter.prefix == "logs/"
+    client.request("DELETE", "/lcb", "lifecycle")
+    with pytest.raises(S3ClientError):
+        client.request("GET", "/lcb", "lifecycle")
+
+
+def test_lifecycle_rejects_malformed(client):
+    client.make_bucket("lcbad")
+    for bad in (b"<LifecycleConfiguration/>", b"not xml",
+                b"<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+                b"<Expiration><Days>-3</Days></Expiration></Rule>"
+                b"</LifecycleConfiguration>"):
+        with pytest.raises(S3ClientError) as ei:
+            client.request("PUT", "/lcbad", "lifecycle", bad)
+        assert ei.value.status == 400
+
+
+def test_compute_action_expiry():
+    cfg = lc.Lifecycle.parse(LC_XML.encode())
+    now = int(1e18)
+    fresh = lc.ObjectOpts(name="logs/a.log", mod_time_ns=now - 5 * DAY_NS)
+    old = lc.ObjectOpts(name="logs/a.log", mod_time_ns=now - 45 * DAY_NS)
+    other = lc.ObjectOpts(name="data/a.log", mod_time_ns=now - 45 * DAY_NS)
+    assert cfg.compute_action(fresh, now) is lc.Action.NONE
+    assert cfg.compute_action(old, now) is lc.Action.DELETE
+    assert cfg.compute_action(other, now) is lc.Action.NONE
+
+
+def test_compute_action_noncurrent_and_tags():
+    xml = f"""<LifecycleConfiguration {S3NS}>
+      <Rule><Status>Enabled</Status>
+        <Filter><And><Prefix>x/</Prefix>
+          <Tag><Key>tier</Key><Value>tmp</Value></Tag></And></Filter>
+        <NoncurrentVersionExpiration><NoncurrentDays>7</NoncurrentDays>
+        </NoncurrentVersionExpiration>
+      </Rule></LifecycleConfiguration>"""
+    cfg = lc.Lifecycle.parse(xml.encode())
+    now = int(1e18)
+    nc = lc.ObjectOpts(name="x/f", is_latest=False,
+                       user_tags={"tier": "tmp"},
+                       successor_mod_time_ns=now - 8 * DAY_NS)
+    assert cfg.compute_action(nc, now) is lc.Action.DELETE_VERSION
+    nc_untagged = lc.ObjectOpts(name="x/f", is_latest=False,
+                                successor_mod_time_ns=now - 8 * DAY_NS)
+    assert cfg.compute_action(nc_untagged, now) is lc.Action.NONE
+
+
+# -- bucket policy + anonymous access -------------------------------------
+
+POLICY = """{
+  "Version": "2012-10-17",
+  "Statement": [{
+    "Effect": "Allow", "Principal": "*",
+    "Action": ["s3:GetObject"],
+    "Resource": ["arn:aws:s3:::pub/*"]
+  }]
+}"""
+
+
+def test_bucket_policy_roundtrip_and_anonymous(client, server):
+    client.make_bucket("pub")
+    client.put_object("pub", "hello.txt", b"world")
+    # anonymous GET denied before policy exists
+    req = urllib.request.Request(server.endpoint + "/pub/hello.txt")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 403
+    client.request("PUT", "/pub", "policy", POLICY.encode())
+    got = client.request("GET", "/pub", "policy").body
+    assert b"s3:GetObject" in got
+    with urllib.request.urlopen(server.endpoint + "/pub/hello.txt") as r:
+        assert r.read() == b"world"
+    # anonymous PUT still denied
+    req = urllib.request.Request(server.endpoint + "/pub/x.txt",
+                                 data=b"nope", method="PUT")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 403
+    client.request("DELETE", "/pub", "policy")
+    with pytest.raises(S3ClientError) as ei:
+        client.request("GET", "/pub", "policy")
+    assert ei.value.code == "NoSuchBucketPolicy"
+
+
+def test_bucket_policy_rejects_foreign_resource(client):
+    client.make_bucket("polbad")
+    bad = POLICY.replace("pub/*", "otherbucket/*")
+    with pytest.raises(S3ClientError) as ei:
+        client.request("PUT", "/polbad", "policy", bad.encode())
+    assert ei.value.code == "MalformedPolicy"
+
+
+# -- tagging ---------------------------------------------------------------
+
+TAGS_XML = (f'<Tagging {S3NS}><TagSet>'
+            '<Tag><Key>env</Key><Value>prod</Value></Tag>'
+            '<Tag><Key>team</Key><Value>io</Value></Tag>'
+            '</TagSet></Tagging>').encode()
+
+
+def test_bucket_tagging(client):
+    client.make_bucket("btags")
+    with pytest.raises(S3ClientError) as ei:
+        client.request("GET", "/btags", "tagging")
+    assert ei.value.code == "NoSuchTagSet"
+    client.request("PUT", "/btags", "tagging", TAGS_XML)
+    got = client.request("GET", "/btags", "tagging").body
+    assert b"env" in got and b"prod" in got
+    client.request("DELETE", "/btags", "tagging")
+    with pytest.raises(S3ClientError):
+        client.request("GET", "/btags", "tagging")
+
+
+def test_object_tagging(client):
+    client.make_bucket("otags")
+    client.put_object("otags", "f.txt", b"data")
+    client.request("PUT", "/otags/f.txt", "tagging", TAGS_XML)
+    got = client.request("GET", "/otags/f.txt", "tagging").body
+    assert b"team" in got and b"io" in got
+    # tag count surfaces on GET
+    g = client.get_object("otags", "f.txt")
+    assert g.headers.get("x-amz-tagging-count") == "2"
+    client.request("DELETE", "/otags/f.txt", "tagging")
+    got = client.request("GET", "/otags/f.txt", "tagging").body
+    assert b"<Tag>" not in got
+
+
+def test_put_object_tagging_header(client):
+    client.make_bucket("htags")
+    client.request("PUT", "/htags/h.txt", body=b"x",
+                   headers={"x-amz-tagging": "a=1&b=2"})
+    got = client.request("GET", "/htags/h.txt", "tagging").body
+    assert b"<Key>a</Key>" in got
+
+
+# -- object lock / retention ----------------------------------------------
+
+def test_object_lock_flow(client):
+    client.request("PUT", "/lockbkt",
+                   headers={"x-amz-bucket-object-lock-enabled": "true"})
+    raw = client.request("GET", "/lockbkt", "object-lock").body
+    assert b"Enabled" in raw
+    # versioning got auto-enabled
+    v = client.request("GET", "/lockbkt", "versioning").body
+    assert b"Enabled" in v
+    until = (datetime.datetime.now(datetime.timezone.utc) +
+             datetime.timedelta(days=1)).strftime("%Y-%m-%dT%H:%M:%SZ")
+    r = client.put_object("lockbkt", "w.bin", b"worm")
+    vid = r.headers["x-amz-version-id"]
+    ret = (f'<Retention {S3NS}><Mode>COMPLIANCE</Mode>'
+           f'<RetainUntilDate>{until}</RetainUntilDate>'
+           f'</Retention>').encode()
+    client.request("PUT", f"/lockbkt/w.bin", f"retention&versionId={vid}",
+                   ret)
+    got = client.request("GET", f"/lockbkt/w.bin",
+                         f"retention&versionId={vid}").body
+    assert b"COMPLIANCE" in got
+    # deleting the locked version is refused
+    with pytest.raises(S3ClientError) as ei:
+        client.request("DELETE", "/lockbkt/w.bin", f"versionId={vid}")
+    assert ei.value.code == "ObjectLocked"
+    # an unversioned delete (delete marker) is fine
+    client.delete_object("lockbkt", "w.bin")
+
+
+def test_legal_hold(client):
+    client.request("PUT", "/holdbkt",
+                   headers={"x-amz-bucket-object-lock-enabled": "true"})
+    r = client.put_object("holdbkt", "h.bin", b"held")
+    vid = r.headers["x-amz-version-id"]
+    on = (f'<LegalHold {S3NS}><Status>ON</Status></LegalHold>').encode()
+    client.request("PUT", "/holdbkt/h.bin", f"legal-hold&versionId={vid}",
+                   on)
+    got = client.request("GET", "/holdbkt/h.bin",
+                         f"legal-hold&versionId={vid}").body
+    assert b"ON" in got
+    with pytest.raises(S3ClientError) as ei:
+        client.request("DELETE", "/holdbkt/h.bin", f"versionId={vid}")
+    assert ei.value.code == "ObjectLocked"
+    off = (f'<LegalHold {S3NS}><Status>OFF</Status></LegalHold>').encode()
+    client.request("PUT", "/holdbkt/h.bin", f"legal-hold&versionId={vid}",
+                   off)
+    client.request("DELETE", "/holdbkt/h.bin", f"versionId={vid}")
+
+
+def test_default_retention_applies(client):
+    client.request("PUT", "/defret",
+                   headers={"x-amz-bucket-object-lock-enabled": "true"})
+    cfg = (f'<ObjectLockConfiguration {S3NS}>'
+           '<ObjectLockEnabled>Enabled</ObjectLockEnabled>'
+           '<Rule><DefaultRetention><Mode>GOVERNANCE</Mode>'
+           '<Days>1</Days></DefaultRetention></Rule>'
+           '</ObjectLockConfiguration>').encode()
+    client.request("PUT", "/defret", "object-lock", cfg)
+    r = client.put_object("defret", "d.bin", b"data")
+    vid = r.headers["x-amz-version-id"]
+    got = client.request("GET", "/defret/d.bin",
+                         f"retention&versionId={vid}").body
+    assert b"GOVERNANCE" in got
+    # governance bypass allows the delete (testkey has s3:* via root)
+    with pytest.raises(S3ClientError):
+        client.request("DELETE", "/defret/d.bin", f"versionId={vid}")
+    client.request("DELETE", "/defret/d.bin", f"versionId={vid}",
+                   headers={"x-amz-bypass-governance-retention": "true"})
+
+
+def test_lock_on_plain_bucket_refused(client):
+    client.make_bucket("nolock")
+    client.put_object("nolock", "f", b"x")
+    until = (datetime.datetime.now(datetime.timezone.utc) +
+             datetime.timedelta(days=1)).strftime("%Y-%m-%dT%H:%M:%SZ")
+    ret = (f'<Retention {S3NS}><Mode>GOVERNANCE</Mode>'
+           f'<RetainUntilDate>{until}</RetainUntilDate>'
+           f'</Retention>').encode()
+    with pytest.raises(S3ClientError):
+        client.request("PUT", "/nolock/f", "retention", ret)
+
+
+# -- encryption / replication / notification / quota / acl ----------------
+
+def test_bucket_encryption_config(client):
+    client.make_bucket("ssecfg")
+    with pytest.raises(S3ClientError) as ei:
+        client.request("GET", "/ssecfg", "encryption")
+    assert ei.value.code == \
+        "ServerSideEncryptionConfigurationNotFoundError"
+    cfg = (f'<ServerSideEncryptionConfiguration {S3NS}><Rule>'
+           '<ApplyServerSideEncryptionByDefault>'
+           '<SSEAlgorithm>AES256</SSEAlgorithm>'
+           '</ApplyServerSideEncryptionByDefault></Rule>'
+           '</ServerSideEncryptionConfiguration>').encode()
+    client.request("PUT", "/ssecfg", "encryption", cfg)
+    got = client.request("GET", "/ssecfg", "encryption").body
+    assert b"AES256" in got
+    client.request("DELETE", "/ssecfg", "encryption")
+
+
+def test_replication_config_requires_versioning(client):
+    client.make_bucket("repl")
+    cfg = (f'<ReplicationConfiguration {S3NS}>'
+           '<Rule><Status>Enabled</Status><Priority>1</Priority>'
+           '<Destination><Bucket>arn:minio:replication::x:dst</Bucket>'
+           '</Destination></Rule></ReplicationConfiguration>').encode()
+    with pytest.raises(S3ClientError):  # versioning off
+        client.request("PUT", "/repl", "replication", cfg)
+    client.set_versioning("repl", True)
+    client.request("PUT", "/repl", "replication", cfg)
+    got = client.request("GET", "/repl", "replication").body
+    assert b"arn:minio:replication::x:dst" in got
+
+
+def test_notification_config(client):
+    client.make_bucket("ncfg")
+    # GET with nothing configured returns an empty document, not 404
+    got = client.request("GET", "/ncfg", "notification").body
+    assert b"NotificationConfiguration" in got
+    cfg = (f'<NotificationConfiguration {S3NS}>'
+           '<QueueConfiguration>'
+           '<Queue>arn:minio:sqs::primary:webhook</Queue>'
+           '<Event>s3:ObjectCreated:*</Event>'
+           '<Filter><S3Key><FilterRule><Name>suffix</Name>'
+           '<Value>.jpg</Value></FilterRule></S3Key></Filter>'
+           '</QueueConfiguration></NotificationConfiguration>').encode()
+    client.request("PUT", "/ncfg", "notification", cfg)
+    got = client.request("GET", "/ncfg", "notification").body
+    assert b"s3:ObjectCreated:Put" in got  # wildcard expanded
+    assert b".jpg" in got
+
+
+def test_quota_parse_and_enforcement_model():
+    q = Quota.parse(b'{"quota": 100, "quotatype": "hard"}')
+    assert q.allows(50, 50) and not q.allows(50, 51)
+    assert Quota.parse(b'{"quota": 0}').allows(10**12, 1)
+    with pytest.raises(ValueError):
+        Quota.parse(b'{"quota": 5, "quotatype": "soft"}')
+
+
+def test_acl_handlers(client):
+    client.make_bucket("aclb")
+    got = client.request("GET", "/aclb", "acl").body
+    assert b"FULL_CONTROL" in got
+    client.put_object("aclb", "o", b"x")
+    got = client.request("GET", "/aclb/o", "acl").body
+    assert b"FULL_CONTROL" in got
+    with pytest.raises(S3ClientError) as ei:
+        client.request("PUT", "/aclb", "acl",
+                       headers={"x-amz-acl": "public-read"})
+    assert ei.value.code == "NotImplemented"
+
+
+def test_retention_check_helpers():
+    meta = {olock.AMZ_OBJECT_LOCK_MODE: "GOVERNANCE",
+            olock.AMZ_OBJECT_LOCK_RETAIN_UNTIL: "2099-01-01T00:00:00Z"}
+    assert not olock.check_delete_allowed(meta)
+    assert olock.check_delete_allowed(meta, governance_bypass=True)
+    meta[olock.AMZ_OBJECT_LOCK_MODE] = "COMPLIANCE"
+    assert not olock.check_delete_allowed(meta, governance_bypass=True)
+    held = {olock.AMZ_OBJECT_LOCK_LEGAL_HOLD: "ON"}
+    assert not olock.check_delete_allowed(held, governance_bypass=True)
+    expired = {olock.AMZ_OBJECT_LOCK_MODE: "COMPLIANCE",
+               olock.AMZ_OBJECT_LOCK_RETAIN_UNTIL: "2001-01-01T00:00:00Z"}
+    assert olock.check_delete_allowed(expired)
