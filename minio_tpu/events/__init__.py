@@ -1,0 +1,12 @@
+"""Bucket event notification system (pkg/event + cmd/notification.go).
+
+Events fire on object operations, route through per-bucket notification
+configs (minio_tpu/bucket/notification.py) to registered targets
+(webhook, store-and-forward queue), and publish to an in-memory pubsub
+for live ListenNotification streams.
+"""
+
+from .event import Event, new_event          # noqa: F401
+from .notifier import NotificationSys        # noqa: F401
+from .targets import (                       # noqa: F401
+    MemoryTarget, QueueStore, Target, WebhookTarget)

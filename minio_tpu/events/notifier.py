@@ -1,0 +1,86 @@
+"""NotificationSys (cmd/notification.go + pkg/event/rulesmap.go glue).
+
+Routes fired events through each bucket's notification config to the
+registered targets, asynchronously (delivery must never sit on the data
+path), and publishes every event to the in-process pubsub so
+ListenNotification clients can stream them live.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+from ..bucket.notification import Config as NotificationConfig
+from ..utils.pubsub import PubSub
+from .event import new_event
+from .targets import Target
+
+
+class NotificationSys:
+    def __init__(self, bucket_meta, region: str = "", workers: int = 4):
+        self._bucket_meta = bucket_meta
+        self._region = region
+        self._targets: dict[str, Target] = {}
+        self._mu = threading.Lock()
+        self._pool = ThreadPoolExecutor(max_workers=workers,
+                                        thread_name_prefix="event-send")
+        self.pubsub = PubSub()
+
+    # -- target registry (cmd/config/notify + bucket-targets analog) ------
+
+    def register_target(self, target: Target) -> None:
+        with self._mu:
+            self._targets[target.arn] = target
+
+    def remove_target(self, arn: str) -> None:
+        with self._mu:
+            self._targets.pop(arn, None)
+
+    def valid_arns(self) -> set[str]:
+        with self._mu:
+            return set(self._targets)
+
+    def target(self, arn: str) -> Optional[Target]:
+        with self._mu:
+            return self._targets.get(arn)
+
+    # -- firing -----------------------------------------------------------
+
+    def _config(self, bucket: str) -> Optional[NotificationConfig]:
+        try:
+            return self._bucket_meta.get_parsed(
+                bucket, "notification", NotificationConfig.parse)
+        except ValueError:
+            return None
+
+    def send(self, event_name: str, bucket: str, oi,
+             req_params: dict | None = None, user: str = "") -> None:
+        ev = new_event(event_name, bucket, oi, region=self._region,
+                       user=user, req_params=req_params)
+        record = ev.to_record()
+        # live listeners always see every event (ListenNotification
+        # filters client-side by prefix/suffix/name)
+        self.pubsub.publish({"name": event_name, "bucket": bucket,
+                             "key": ev.key, "record": record})
+        cfg = self._config(bucket)
+        if cfg is None:
+            return
+        arns = cfg.match(event_name, ev.key)
+        if not arns:
+            return
+        with self._mu:
+            targets = [self._targets[a] for a in arns if a in self._targets]
+        for t in targets:
+            self._pool.submit(self._deliver, t, record)
+
+    @staticmethod
+    def _deliver(target: Target, record: dict) -> None:
+        try:
+            target.send(record)
+        except Exception:  # noqa: BLE001 — delivery failures must not
+            pass           # propagate; store-and-forward handles retry
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)

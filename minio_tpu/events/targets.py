@@ -1,0 +1,148 @@
+"""Notification targets (pkg/event/target/*).
+
+A Target delivers event records to an external system.  Implemented:
+webhook (HTTP POST, pkg/event/target/webhook.go) with a store-and-forward
+QueueStore (pkg/event/target/queuestore.go) that persists undeliverable
+events to disk and replays them, and an in-memory target for tests and
+the admin API.  Other reference targets (kafka/amqp/mqtt/nats/redis/
+postgres/mysql/nsq/elasticsearch) follow the same Target interface; their
+client libraries are not in this image, so they are registry-gated.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.request
+import uuid
+from typing import Optional
+
+
+class TargetError(Exception):
+    pass
+
+
+class Target:
+    """pkg/event/target interface: ID + Save/Send semantics."""
+
+    arn: str = ""
+
+    def send(self, record: dict) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class QueueStore:
+    """Disk-backed event queue (pkg/event/target/queuestore.go): one JSON
+    file per undelivered event, replayed in order, bounded count."""
+
+    def __init__(self, directory: str, limit: int = 10000):
+        self.dir = directory
+        self.limit = limit
+        self._mu = threading.Lock()
+        os.makedirs(directory, exist_ok=True)
+
+    def put(self, record: dict) -> str:
+        with self._mu:
+            names = sorted(os.listdir(self.dir))
+            if len(names) >= self.limit:
+                raise TargetError("queue store full")
+            key = f"{time.time_ns():020d}-{uuid.uuid4().hex[:8]}.json"
+            tmp = os.path.join(self.dir, f".{key}.tmp")
+            with open(tmp, "w") as f:
+                json.dump(record, f)
+            os.replace(tmp, os.path.join(self.dir, key))
+            return key
+
+    def list(self) -> list[str]:
+        with self._mu:
+            return sorted(n for n in os.listdir(self.dir)
+                          if not n.startswith("."))
+
+    def get(self, key: str) -> dict:
+        with open(os.path.join(self.dir, key)) as f:
+            return json.load(f)
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(os.path.join(self.dir, key))
+        except FileNotFoundError:
+            pass
+
+    def __len__(self) -> int:
+        return len(self.list())
+
+
+class WebhookTarget(Target):
+    """POST each record as {"EventName","Key","Records":[...]} JSON
+    (pkg/event/target/webhook.go sendEvent)."""
+
+    def __init__(self, arn: str, endpoint: str,
+                 auth_token: str = "",
+                 store_dir: Optional[str] = None,
+                 timeout: float = 5.0):
+        self.arn = arn
+        self.endpoint = endpoint
+        self.auth_token = auth_token
+        self.timeout = timeout
+        self.store = QueueStore(store_dir) if store_dir else None
+
+    def _post(self, record: dict) -> None:
+        body = json.dumps({
+            "EventName": "s3:" + record.get("eventName", ""),
+            "Key": f"{record['s3']['bucket']['name']}/"
+                   f"{record['s3']['object']['key']}",
+            "Records": [record],
+        }).encode()
+        req = urllib.request.Request(
+            self.endpoint, data=body,
+            headers={"Content-Type": "application/json",
+                     **({"Authorization": self.auth_token}
+                        if self.auth_token else {})})
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            if resp.status // 100 != 2:
+                raise TargetError(f"webhook returned {resp.status}")
+
+    def send(self, record: dict) -> None:
+        try:
+            self._post(record)
+        except Exception as e:
+            if self.store is not None:
+                self.store.put(record)      # retry later via replay()
+            else:
+                raise TargetError(str(e)) from e
+
+    def replay(self) -> int:
+        """Redeliver queued events; returns how many got through."""
+        if self.store is None:
+            return 0
+        ok = 0
+        for key in self.store.list():
+            try:
+                self._post(self.store.get(key))
+            except Exception:
+                break                       # endpoint still down: stop
+            self.store.delete(key)
+            ok += 1
+        return ok
+
+
+class MemoryTarget(Target):
+    """Collects records in memory — tests + admin target diagnostics."""
+
+    def __init__(self, arn: str):
+        self.arn = arn
+        self.records: list[dict] = []
+        self._mu = threading.Lock()
+
+    def send(self, record: dict) -> None:
+        with self._mu:
+            self.records.append(record)
+
+    def events(self) -> list[dict]:
+        with self._mu:
+            return list(self.records)

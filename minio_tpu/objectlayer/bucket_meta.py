@@ -23,7 +23,7 @@ class BucketMetadataSys:
     def __init__(self, er):
         self._er = er            # ErasureObjects (or sets facade)
         self._cache: dict[str, dict] = {}
-        self._policy_cache: dict[str, tuple[str, Any]] = {}
+        self._parsed_cache: dict[tuple[str, str], tuple[str, Any]] = {}
         self._mu = threading.Lock()
 
     def _path(self, bucket: str) -> str:
@@ -87,21 +87,25 @@ class BucketMetadataSys:
             return v.get("raw")
         return v
 
-    def get_bucket_policy(self, bucket: str):
-        """Parsed bucket policy, cached per raw document (requests must
-        not re-parse JSON on every authorization)."""
-        raw = self.get_config(bucket, "policy")
+    def get_parsed(self, bucket: str, name: str, parser):
+        """Parsed form of a stored config, cached keyed on the raw
+        document — request paths must not re-parse XML/JSON per call."""
+        raw = self.get_config(bucket, name)
         if raw is None:
             return None
+        key = (bucket, name)
         with self._mu:
-            cached = self._policy_cache.get(bucket)
+            cached = self._parsed_cache.get(key)
             if cached is not None and cached[0] == raw:
                 return cached[1]
-        from ..bucket.policy import BucketPolicy
-        pol = BucketPolicy.parse(raw.encode())
+        parsed = parser(raw.encode())
         with self._mu:
-            self._policy_cache[bucket] = (raw, pol)
-        return pol
+            self._parsed_cache[key] = (raw, parsed)
+        return parsed
+
+    def get_bucket_policy(self, bucket: str):
+        from ..bucket.policy import BucketPolicy
+        return self.get_parsed(bucket, "policy", BucketPolicy.parse)
 
     def set_config(self, bucket: str, name: str,
                    raw: Optional[str]) -> None:

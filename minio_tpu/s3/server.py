@@ -94,8 +94,18 @@ class S3Server:
         self.bucket_meta = BucketMetadataSys(object_layer)
         from ..utils.kvconfig import Config
         self.config = Config(object_layer)
+        from ..events import NotificationSys, WebhookTarget
+        self.events = NotificationSys(self.bucket_meta, region=region)
+        if self.config.get("notify_webhook", "enable") == "on":
+            # config-driven target registration (cmd/config/notify): the
+            # ARN a PUT-notification config may reference
+            self.events.register_target(WebhookTarget(
+                "arn:minio:sqs::1:webhook",
+                self.config.get("notify_webhook", "endpoint"),
+                auth_token=self.config.get("notify_webhook", "auth_token"),
+                store_dir=self.config.get("notify_webhook", "queue_dir")
+                or None))
         # wired in by server_main / tests when those subsystems are enabled
-        self.events = None       # NotificationSys (minio_tpu/events)
         self.replication = None  # ReplicationSys (minio_tpu/replication)
         self.usage = None        # data-usage cache (crawler)
         handler = _make_handler(self)
@@ -112,6 +122,7 @@ class S3Server:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+        self.events.close()
 
     @property
     def endpoint(self) -> str:
@@ -119,10 +130,8 @@ class S3Server:
 
     def notify(self, event_name: str, bucket: str, oi,
                req_params: dict | None = None) -> None:
-        """Fire a bucket event into the notification system (no-op until
-        NotificationSys is attached)."""
-        if self.events is not None:
-            self.events.send(event_name, bucket, oi, req_params or {})
+        """Fire a bucket event into the notification system."""
+        self.events.send(event_name, bucket, oi, req_params or {})
 
     def replicate(self, bucket: str, oi, delete: bool = False) -> None:
         """Queue async replication if the bucket's config asks for it
@@ -404,9 +413,7 @@ def _make_handler(srv: S3Server):
                     self._allow(iampol.PUT_BUCKET_NOTIFICATION, bucket)
                     exists()
                     cfg = _try(lambda: notification.Config.parse(
-                        payload,
-                        valid_arns=(srv.events.valid_arns()
-                                    if srv.events is not None else None)))
+                        payload, valid_arns=srv.events.valid_arns()))
                     srv.bucket_meta.set_config(
                         bucket, "notification",
                         cfg.to_xml().decode() if cfg.targets else None)
@@ -524,6 +531,9 @@ def _make_handler(srv: S3Server):
             if cmd == "GET" and "versions" in query:
                 self._allow(iampol.LIST_BUCKET_VERSIONS, bucket)
                 return self._list_object_versions(bucket, query)
+            if cmd == "GET" and "events" in query:
+                self._allow(iampol.LISTEN_NOTIFICATION, bucket)
+                return self._listen_notification(bucket, query)
             if cmd == "POST" and "delete" in query:
                 return self._delete_objects(bucket, payload)
             if cmd == "GET" and "uploads" in query:
@@ -580,6 +590,59 @@ def _make_handler(srv: S3Server):
             if doc:
                 ET.SubElement(root, "Status").text = doc["status"]
             self._send(200, _xml(root))
+
+        def _listen_notification(self, bucket, query):
+            """Live event stream (cmd/listen-notification-handlers.go):
+            newline-delimited JSON records, chunked; filters by prefix/
+            suffix/event-name glob.  `timeout` bounds the stream so HTTP
+            clients without explicit cancel (and tests) can use it."""
+            import json as _json
+
+            from ..bucket.notification import match_pattern
+            srv.layer.get_bucket_info(bucket)
+            q1 = {k: v[0] for k, v in query.items()}
+            prefix = q1.get("prefix", "")
+            suffix = q1.get("suffix", "")
+            names = query.get("events", []) or ["*"]
+            try:
+                timeout = min(float(q1.get("timeout", 10) or 10), 300.0)
+                max_events = int(q1.get("max-events", 1000) or 1000)
+            except ValueError as e:
+                raise S3Error("InvalidArgument") from e
+
+            def want(item):
+                if item["bucket"] != bucket:
+                    return False
+                key = item["key"]
+                if prefix and not key.startswith(prefix):
+                    return False
+                if suffix and not key.endswith(suffix):
+                    return False
+                return any(n == "*" or match_pattern(n, item["name"])
+                           for n in names)
+
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def write_chunk(data: bytes):
+                self.wfile.write(f"{len(data):x}\r\n".encode())
+                self.wfile.write(data + b"\r\n")
+                self.wfile.flush()
+
+            with srv.events.pubsub.subscribe(want) as sub:
+                try:
+                    for item in sub.drain(max_events, timeout):
+                        line = _json.dumps(
+                            {"Records": [item["record"]]}).encode() + b"\n"
+                        write_chunk(line)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
 
         def _list_objects(self, bucket, query):
             q1 = {k: v[0] for k, v in query.items()}

@@ -67,7 +67,8 @@ register_subsys("compression", {
 })
 register_subsys("logger_webhook", {"enable": "off", "endpoint": ""})
 register_subsys("audit_webhook", {"enable": "off", "endpoint": ""})
-register_subsys("notify_webhook", {"enable": "off", "endpoint": ""})
+register_subsys("notify_webhook", {"enable": "off", "endpoint": "",
+                                   "auth_token": "", "queue_dir": ""})
 
 
 class Config:

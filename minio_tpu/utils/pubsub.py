@@ -1,0 +1,87 @@
+"""In-memory non-blocking pub/sub (pkg/pubsub/pubsub.go).
+
+Publish never blocks: a subscriber that cannot keep up drops messages
+(pkg/pubsub/pubsub.go:37-39 writes into a select with default).  Used by
+event notification (ListenNotification), HTTP tracing, and the console
+log ring.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterator, Optional
+
+
+class PubSub:
+    def __init__(self, max_queue: int = 1000):
+        self._subs: list[tuple[queue.Queue, Optional[Callable]]] = []
+        self._mu = threading.Lock()
+        self._max_queue = max_queue
+
+    def publish(self, item: Any) -> None:
+        with self._mu:
+            subs = list(self._subs)
+        for q, flt in subs:
+            if flt is not None and not flt(item):
+                continue
+            try:
+                q.put_nowait(item)
+            except queue.Full:
+                pass                      # slow subscriber: drop, not block
+
+    def subscribe(self, filter_fn: Optional[Callable] = None
+                  ) -> "Subscription":
+        q: queue.Queue = queue.Queue(self._max_queue)
+        sub = Subscription(self, q)
+        with self._mu:
+            self._subs.append((q, filter_fn))
+        return sub
+
+    def _unsubscribe(self, q: queue.Queue) -> None:
+        with self._mu:
+            self._subs = [(qq, f) for qq, f in self._subs if qq is not q]
+
+    @property
+    def num_subscribers(self) -> int:
+        with self._mu:
+            return len(self._subs)
+
+
+class Subscription:
+    def __init__(self, ps: PubSub, q: queue.Queue):
+        self._ps = ps
+        self._q = q
+        self.closed = False
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        """Next item or None on timeout."""
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def drain(self, max_items: int, timeout: float) -> Iterator[Any]:
+        import time
+        deadline = time.monotonic() + timeout
+        n = 0
+        while n < max_items:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            item = self.get(timeout=remaining)
+            if item is None:
+                return
+            yield item
+            n += 1
+
+    def close(self) -> None:
+        if not self.closed:
+            self._ps._unsubscribe(self._q)
+            self.closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -314,7 +314,10 @@ def test_replication_config_requires_versioning(client):
     assert b"arn:minio:replication::x:dst" in got
 
 
-def test_notification_config(client):
+def test_notification_config(client, server):
+    from minio_tpu.events import MemoryTarget
+    server.events.register_target(
+        MemoryTarget("arn:minio:sqs::primary:webhook"))
     client.make_bucket("ncfg")
     # GET with nothing configured returns an empty document, not 404
     got = client.request("GET", "/ncfg", "notification").body
