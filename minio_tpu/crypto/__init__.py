@@ -1,0 +1,11 @@
+"""Server-side encryption (reference: cmd/crypto/, cmd/encryption-v1.go).
+
+DARE-style authenticated streaming encryption (minio/sio v0.2.1 analog),
+a local KMS (cmd/crypto/kms.go), and SSE-C/SSE-S3/SSE-KMS request
+handling.  The data path is host-side C (via the `cryptography` AES-GCM
+backend, AES-NI accelerated) — the TPU plane never sees plaintext keys.
+"""
+
+from . import dare, kms, sse  # noqa: F401
+
+__all__ = ["dare", "kms", "sse"]

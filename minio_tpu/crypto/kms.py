@@ -1,0 +1,72 @@
+"""Local KMS: per-object data keys sealed by a master key.
+
+Reference: cmd/crypto/kms.go (`kmsContext`, `GenerateKey`, `UnsealKey`)
+with Vault/KES backends (cmd/crypto/vault.go, kes.go).  This in-process
+backend derives the key-encryption key from a 256-bit master secret and
+binds every sealed key to its (bucket, object) context so a sealed blob
+replayed onto another object path fails to unseal.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import os
+
+from . import dare
+
+MASTER_KEY_ENV = "MINIO_TPU_KMS_SECRET_KEY"   # "<key-id>:<base64-32-bytes>"
+
+
+class KMSError(Exception):
+    pass
+
+
+class LocalKMS:
+    """Single-master-key KMS (cmd/crypto/kms.go masterKeyKMS analog)."""
+
+    def __init__(self, key_id: str = "minio-tpu-default-key",
+                 master_key: bytes | None = None):
+        if master_key is None:
+            spec = os.environ.get(MASTER_KEY_ENV, "")
+            if ":" in spec:
+                key_id, b64 = spec.split(":", 1)
+                master_key = base64.b64decode(b64)
+            else:
+                # deterministic dev default (NOT for production), mirrors
+                # minio's behaviour of running SSE-S3 with an auto key
+                master_key = hashlib.sha256(b"minio-tpu-dev-master").digest()
+        if len(master_key) != 32:
+            raise KMSError("master key must be 32 bytes")
+        self.key_id = key_id
+        self._master = master_key
+
+    def _kek(self, key_id: str, context: dict[str, str]) -> bytes:
+        ctx = json.dumps(context, sort_keys=True,
+                         separators=(",", ":")).encode()
+        return hmac.new(self._master, key_id.encode() + b"\x00" + ctx,
+                        hashlib.sha256).digest()
+
+    def generate_key(self, context: dict[str, str]
+                     ) -> tuple[bytes, str]:
+        """Fresh 256-bit data key; returns (plaintext, sealed-b64)."""
+        plain = os.urandom(32)
+        sealed = dare.encrypt(self._kek(self.key_id, context), plain)
+        blob = base64.b64encode(
+            self.key_id.encode() + b"\x00" + sealed).decode()
+        return plain, blob
+
+    def unseal_key(self, sealed_b64: str, context: dict[str, str]) -> bytes:
+        try:
+            raw = base64.b64decode(sealed_b64)
+            key_id, sealed = raw.split(b"\x00", 1)
+        except Exception as e:
+            raise KMSError("malformed sealed key") from e
+        if key_id.decode() != self.key_id:
+            raise KMSError(f"unknown KMS key id {key_id!r}")
+        try:
+            return dare.decrypt(self._kek(self.key_id, context), sealed)
+        except dare.DAREError as e:
+            raise KMSError("failed to unseal data key") from e

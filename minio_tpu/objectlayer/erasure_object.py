@@ -588,4 +588,5 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             content_type=md.get("content-type", ""),
             user_defined=md, parity=fi.erasure.parity_blocks,
             data_blocks=fi.erasure.data_blocks,
-            num_versions=fi.num_versions)
+            num_versions=fi.num_versions,
+            parts=[(p.number, p.size) for p in fi.parts])

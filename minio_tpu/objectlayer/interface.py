@@ -93,6 +93,9 @@ class ObjectInfo:
     data_blocks: int = 0
     num_versions: int = 0
     is_dir: bool = False
+    # multipart part table [(part_number, size), ...] — drives SSE ranged
+    # decrypt across per-part DARE streams (ObjectInfo.Parts in reference)
+    parts: list[tuple[int, int]] = field(default_factory=list)
 
 
 @dataclass

@@ -133,6 +133,16 @@ class MultipartOps:
             raise WriteQuorumError(str(e)) from e
         return PartInfo(part_number, etag, size, size, now_ns())
 
+    def get_multipart_info(self, bucket: str, object_name: str,
+                           upload_id: str) -> MultipartInfo:
+        """Upload metadata (cmd/erasure-multipart.go GetMultipartInfo) —
+        the SSE path needs the sealed object key stored at initiation."""
+        self._check_bucket(bucket)
+        fi, _ = self._mp_fileinfo(bucket, object_name, upload_id)
+        md = {k: v for k, v in fi.metadata.items()
+              if not k.startswith("__")}
+        return MultipartInfo(bucket, object_name, upload_id, md)
+
     def list_object_parts(self, bucket: str, object_name: str,
                           upload_id: str) -> list[PartInfo]:
         self._check_bucket(bucket)

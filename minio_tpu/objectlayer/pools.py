@@ -165,6 +165,11 @@ class ErasureServerPools(ObjectLayer):
             .put_object_part(bucket, object_name, upload_id, part_number,
                              data)
 
+    def get_multipart_info(self, bucket, object_name, upload_id):
+        return self._upload_pool(
+            bucket, object_name, upload_id).get_multipart_info(
+                bucket, object_name, upload_id)
+
     def list_object_parts(self, bucket, object_name, upload_id):
         return self._upload_pool(bucket, object_name, upload_id) \
             .list_object_parts(bucket, object_name, upload_id)

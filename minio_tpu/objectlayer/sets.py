@@ -152,6 +152,10 @@ class ErasureSets(ObjectLayer):
         return self.get_hashed_set(object_name).put_object_part(
             bucket, object_name, upload_id, part_number, data)
 
+    def get_multipart_info(self, bucket, object_name, upload_id):
+        return self.get_hashed_set(object_name).get_multipart_info(
+            bucket, object_name, upload_id)
+
     def list_object_parts(self, bucket, object_name, upload_id):
         return self.get_hashed_set(object_name).list_object_parts(
             bucket, object_name, upload_id)

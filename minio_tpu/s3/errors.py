@@ -147,6 +147,30 @@ _ERRORS = {
     "ReplicationDestinationNotFoundError": APIError(
         "ReplicationDestinationNotFoundError",
         "The replication destination bucket does not exist", 404),
+    # SSE (cmd/api-errors.go crypto section)
+    "InvalidEncryptionAlgorithmError": APIError(
+        "InvalidEncryptionAlgorithmError",
+        "The Encryption request you specified is not valid. Supported "
+        "value: AES256.", 400),
+    "SSECustomerKeyMD5Mismatch": APIError(
+        "InvalidArgument",
+        "The calculated MD5 hash of the key did not match the hash that "
+        "was provided.", 400),
+    "SSEEncryptedObject": APIError(
+        "InvalidRequest", "The object was stored using a form of Server "
+        "Side Encryption. The correct parameters must be provided to "
+        "retrieve the object.", 400),
+    "KMSNotConfigured": APIError(
+        "KMSNotConfigured", "Server side encryption specified but KMS is "
+        "not configured", 400),
+    "InvalidCopySource": APIError(
+        "InvalidArgument", "Copy Source must mention the source bucket "
+        "and key: sourcebucket/sourcekey.", 400),
+    "InvalidCopyDest": APIError(
+        "InvalidRequest", "This copy request is illegal because it is "
+        "trying to copy an object to itself without changing the "
+        "object's metadata, storage class, website redirect location or "
+        "encryption attributes.", 400),
 }
 
 

@@ -108,6 +108,8 @@ class S3Server:
         # wired in by server_main / tests when those subsystems are enabled
         self.replication = None  # ReplicationSys (minio_tpu/replication)
         self.usage = None        # data-usage cache (crawler)
+        from ..crypto.kms import LocalKMS
+        self.kms = LocalKMS()
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
@@ -259,8 +261,11 @@ def _make_handler(srv: S3Server):
                 self.wfile.write(body)
 
         def _fail(self, e: Exception, resource: str = ""):
+            from ..crypto.sse import SSEError
             if isinstance(e, S3Error):
                 api = e.api
+            elif isinstance(e, SSEError):
+                api = s3err.get(e.code)
             elif isinstance(e, ol.ObjectLayerError):
                 api = s3err.from_object_error(e)
             else:
@@ -793,9 +798,16 @@ def _make_handler(srv: S3Server):
             if cmd == "POST" and "uploadId" in query:
                 self._allow(iampol.PUT_OBJECT, resource)
                 return self._complete_multipart(bucket, key, query, payload)
+            if cmd == "PUT" and "uploadId" in query and \
+                    "x-amz-copy-source" in self.headers:
+                self._allow(iampol.PUT_OBJECT, resource)
+                return self._upload_part_copy(bucket, key, query)
             if cmd == "PUT" and "uploadId" in query:
                 self._allow(iampol.PUT_OBJECT, resource)
                 return self._upload_part(bucket, key, query, payload)
+            if cmd == "PUT" and "x-amz-copy-source" in self.headers:
+                self._allow(iampol.PUT_OBJECT, resource)
+                return self._copy_object(bucket, key, query)
             if cmd == "DELETE" and "uploadId" in query:
                 self._allow(iampol.ABORT_MULTIPART, resource)
                 srv.layer.abort_multipart_upload(bucket, key,
@@ -953,6 +965,33 @@ def _make_handler(srv: S3Server):
                     srv.usage.bucket_size(bucket), nbytes):
                 raise S3Error("AdminBucketQuotaExceeded")
 
+        # -- SSE helpers (cmd/encryption-v1.go) ----------------------------
+
+        def _bucket_sse_algo(self, bucket: str) -> str:
+            """Bucket default-encryption algorithm, '' when unset."""
+            from ..bucket.encryption import SSEConfig
+            raw = srv.bucket_meta.get_config(bucket, "encryption")
+            if not raw:
+                return ""
+            try:
+                return SSEConfig.parse(raw.encode()).algorithm
+            except ValueError:
+                return ""
+
+        def _sse_for_put(self, bucket: str, key: str,
+                         user_defined: dict) -> "object | None":
+            """EncryptRequest analog: decide whether this PUT is SSE and
+            mint the sealed object key into user_defined."""
+            from ..crypto import sse as csse
+            kind = csse.requested_sse(self.headers,
+                                      self._bucket_sse_algo(bucket))
+            if not kind:
+                return None
+            enc = csse.ObjectEncryption.new(kind, bucket, key,
+                                            self.headers, srv.kms)
+            user_defined.update(enc.meta)
+            return enc
+
         def _tagging_header_meta(self) -> dict[str, str]:
             """Validated x-amz-tagging header as metadata entries."""
             tag_hdr = self.headers.get("x-amz-tagging")
@@ -974,6 +1013,8 @@ def _make_handler(srv: S3Server):
             # lock defaults (a multipart upload must not dodge WORM)
             user_defined.update(self._tagging_header_meta())
             user_defined.update(self._lock_headers(bucket, key))
+            from ..crypto import sse as csse
+            self._sse_for_put(bucket, key, user_defined)
             versioned = srv.bucket_meta.versioning_enabled(bucket)
             uid = srv.layer.new_multipart_upload(
                 bucket, key, ol.PutObjectOptions(
@@ -982,7 +1023,8 @@ def _make_handler(srv: S3Server):
             ET.SubElement(root, "Bucket").text = bucket
             ET.SubElement(root, "Key").text = key
             ET.SubElement(root, "UploadId").text = uid
-            self._send(200, _xml(root))
+            self._send(200, _xml(root),
+                       headers=csse.response_headers(user_defined))
 
         def _upload_part(self, bucket, key, query, payload):
             uid = query["uploadId"][0]
@@ -991,9 +1033,24 @@ def _make_handler(srv: S3Server):
             except (KeyError, ValueError) as e:
                 raise S3Error("InvalidArgument") from e
             self._check_quota(bucket, len(payload))
+            payload, sse_hdrs = self._encrypt_part(bucket, key, uid,
+                                                   payload)
             pi = srv.layer.put_object_part(bucket, key, uid, part_num,
                                            payload)
-            self._send(200, headers={"ETag": f'"{pi.etag}"'})
+            self._send(200, headers={"ETag": f'"{pi.etag}"', **sse_hdrs})
+
+        def _encrypt_part(self, bucket, key, uid,
+                          payload) -> tuple[bytes, dict]:
+            """Encrypt one part under the upload's sealed OEK as its own
+            DARE stream (SSE-C requires the key headers on every part)."""
+            from ..crypto import sse as csse
+            mp = srv.layer.get_multipart_info(bucket, key, uid)
+            if not csse.is_encrypted(mp.user_defined):
+                return payload, {}
+            enc = csse.ObjectEncryption.open(mp.user_defined, bucket, key,
+                                             self.headers, srv.kms)
+            return enc.encrypt(payload), \
+                csse.response_headers(mp.user_defined)
 
         def _complete_multipart(self, bucket, key, query, payload):
             uid = query["uploadId"][0]
@@ -1010,6 +1067,9 @@ def _make_handler(srv: S3Server):
                 if num is None or not num.isdigit():
                     raise S3Error("MalformedXML")
                 parts.append((int(num), etag.strip('"')))
+            # SSE needs no extra bookkeeping here: the part table committed
+            # atomically with xl.meta carries per-part ciphertext sizes
+            # (each part is its own DARE stream; ObjectInfo.parts)
             oi = srv.layer.complete_multipart_upload(bucket, key, uid, parts)
             out = ET.Element("CompleteMultipartUploadResult", xmlns=S3_NS)
             ET.SubElement(out, "Location").text = \
@@ -1064,17 +1124,153 @@ def _make_handler(srv: S3Server):
             user_defined.update(self._tagging_header_meta())
             user_defined.update(self._lock_headers(bucket, key))
             self._check_quota(bucket, len(payload))
+            from ..crypto import sse as csse
+            enc = self._sse_for_put(bucket, key, user_defined)
+            if enc is not None:
+                user_defined[csse.META_ACTUAL_SIZE] = str(len(payload))
+                payload = enc.encrypt(payload)
             versioned = srv.bucket_meta.versioning_enabled(bucket)
             oi = srv.layer.put_object(
                 bucket, key, payload,
                 ol.PutObjectOptions(user_defined=user_defined,
                                     versioned=versioned))
             hdrs = {"ETag": f'"{oi.etag}"'}
+            hdrs.update(csse.response_headers(user_defined))
             if oi.version_id:
                 hdrs["x-amz-version-id"] = oi.version_id
             srv.notify("s3:ObjectCreated:Put", bucket, oi)
             srv.replicate(bucket, oi)
             self._send(200, headers=hdrs)
+
+        # -- CopyObject / UploadPartCopy (cmd/object-handlers.go:886,
+        # cmd/object-multipart-handlers.go CopyObjectPartHandler) ----------
+
+        def _parse_copy_source(self) -> tuple[str, str, str | None]:
+            """x-amz-copy-source -> (bucket, key, version_id).  The
+            versionId qualifier is split off the RAW header first — a
+            percent-encoded '?' inside the key must stay part of the key."""
+            raw = self.headers.get("x-amz-copy-source", "")
+            vid = None
+            if "?versionId=" in raw:
+                raw, vid = raw.split("?versionId=", 1)
+                if vid == "null":
+                    vid = ""
+            src = urllib.parse.unquote(raw).lstrip("/")
+            if "/" not in src:
+                raise S3Error("InvalidCopySource")
+            sbucket, skey = src.split("/", 1)
+            if not sbucket or not skey:
+                raise S3Error("InvalidCopySource")
+            return sbucket, skey, vid
+
+        def _read_copy_source(self, offset: int = 0, length: int = -1
+                              ) -> tuple["ol.ObjectInfo", bytes, int]:
+            """Fetch (and decrypt, honoring copy-source SSE-C headers) the
+            copy source; returns (info, plaintext, plaintext_size)."""
+            from ..crypto import sse as csse
+            sbucket, skey, svid = self._parse_copy_source()
+            self._allow(iampol.GET_OBJECT, f"{sbucket}/{skey}")
+            opts = ol.ObjectOptions(version_id=svid)
+            soi = srv.layer.get_object_info(sbucket, skey, opts)
+            # conditional copy headers (checkCopyObjectPreconditions) —
+            # checked on metadata alone, BEFORE any data is read
+            if_match = self.headers.get("x-amz-copy-source-if-match")
+            if_none = self.headers.get("x-amz-copy-source-if-none-match")
+            if if_match and if_match.strip('"') != soi.etag:
+                raise S3Error("PreconditionFailed")
+            if if_none and if_none.strip('"') == soi.etag:
+                raise S3Error("PreconditionFailed")
+            if csse.is_encrypted(soi.user_defined):
+                enc = csse.ObjectEncryption.open(
+                    soi.user_defined, sbucket, skey, self.headers,
+                    srv.kms, copy_source=True)
+                size = csse.decrypted_size(soi.user_defined, soi.size,
+                                           soi.parts)
+                data = csse.decrypt_object_range(
+                    enc, soi.user_defined, soi.size,
+                    lambda o, n: srv.layer.get_object(
+                        sbucket, skey, o, n, opts)[1], offset, length,
+                    soi.parts)
+            else:
+                size = soi.size
+                _, data = srv.layer.get_object(sbucket, skey, offset,
+                                               length, opts)
+            return soi, data, size
+
+        def _copy_object(self, bucket, key, query):
+            from ..crypto import sse as csse
+            sbucket, skey, svid = self._parse_copy_source()
+            soi, data, _ = self._read_copy_source()
+            directive = self.headers.get("x-amz-metadata-directive",
+                                         "COPY")
+            user_defined: dict[str, str] = {}
+            if directive == "REPLACE":
+                ct = self.headers.get("Content-Type")
+                if ct:
+                    user_defined["content-type"] = ct
+                for h, v in self.headers.items():
+                    if h.lower().startswith("x-amz-meta-"):
+                        user_defined[h.lower()] = v
+            else:
+                user_defined = {
+                    k: v for k, v in soi.user_defined.items()
+                    if k.startswith("x-amz-meta-") or k == "content-type"}
+            tag_directive = self.headers.get("x-amz-tagging-directive",
+                                             "COPY")
+            if tag_directive == "REPLACE":
+                user_defined.update(self._tagging_header_meta())
+            elif soi.user_defined.get(self.TAG_KEY):
+                user_defined[self.TAG_KEY] = soi.user_defined[self.TAG_KEY]
+            user_defined.update(self._lock_headers(bucket, key))
+            enc = self._sse_for_put(bucket, key, user_defined)
+            sse_changed = enc is not None or \
+                csse.is_encrypted(soi.user_defined)
+            if sbucket == bucket and skey == key and svid is None and \
+                    directive != "REPLACE" and not sse_changed:
+                raise S3Error("InvalidCopyDest")
+            self._check_quota(bucket, len(data))
+            if enc is not None:
+                user_defined[csse.META_ACTUAL_SIZE] = str(len(data))
+                data = enc.encrypt(data)
+            versioned = srv.bucket_meta.versioning_enabled(bucket)
+            oi = srv.layer.put_object(
+                bucket, key, data,
+                ol.PutObjectOptions(user_defined=user_defined,
+                                    versioned=versioned))
+            root = ET.Element("CopyObjectResult", xmlns=S3_NS)
+            ET.SubElement(root, "ETag").text = f'"{oi.etag}"'
+            ET.SubElement(root, "LastModified").text = _iso_date(oi.mod_time)
+            hdrs = dict(csse.response_headers(user_defined))
+            if oi.version_id:
+                hdrs["x-amz-version-id"] = oi.version_id
+            if svid is not None:
+                hdrs["x-amz-copy-source-version-id"] = svid or "null"
+            srv.notify("s3:ObjectCreated:Copy", bucket, oi)
+            srv.replicate(bucket, oi)
+            self._send(200, _xml(root), headers=hdrs)
+
+        def _upload_part_copy(self, bucket, key, query):
+            uid = query["uploadId"][0]
+            try:
+                part_num = int(query["partNumber"][0])
+            except (KeyError, ValueError) as e:
+                raise S3Error("InvalidArgument") from e
+            offset, length = 0, -1
+            crng = self.headers.get("x-amz-copy-source-range")
+            if crng:
+                offset, length = _parse_range(crng)
+                if offset < 0:
+                    raise S3Error("InvalidRange")
+            _, data, _ = self._read_copy_source(offset, length)
+            self._check_quota(bucket, len(data))
+            data, _ = self._encrypt_part(bucket, key, uid, data)
+            pi = srv.layer.put_object_part(bucket, key, uid, part_num,
+                                           data)
+            root = ET.Element("CopyPartResult", xmlns=S3_NS)
+            ET.SubElement(root, "ETag").text = f'"{pi.etag}"'
+            ET.SubElement(root, "LastModified").text = \
+                _iso_date(pi.mod_time or 0)
+            self._send(200, _xml(root))
 
         def _lock_headers(self, bucket: str, key: str) -> dict[str, str]:
             """Explicit x-amz-object-lock-* headers, else the bucket's
@@ -1125,27 +1321,61 @@ def _make_handler(srv: S3Server):
             if vid == "null":
                 vid = ""
             opts = ol.ObjectOptions(version_id=vid)
+            from ..crypto import sse as csse
             rng = self.headers.get("Range")
             offset, length = 0, -1
+            sse_hdrs: dict[str, str] = {}
+            plain_size: int | None = None
             try:
+                if rng:
+                    offset, length = _parse_range(rng)
                 if head:
                     oi = srv.layer.get_object_info(bucket, key, opts)
                     data = None
                 else:
-                    if rng:
-                        offset, length = _parse_range(rng)
+                    # single quorum metadata read for the unencrypted hot
+                    # path; a plaintext-space range is always inside the
+                    # (larger) ciphertext, so this read also serves as the
+                    # encrypted branch's metadata fetch
                     oi, data = srv.layer.get_object(bucket, key, offset,
                                                     length, opts)
+                if csse.is_encrypted(oi.user_defined) and \
+                        not oi.delete_marker:
+                    # DecryptObjectInfo: report plaintext size; the data
+                    # path reads only covering DARE packages
+                    enc = csse.ObjectEncryption.open(
+                        oi.user_defined, bucket, key, self.headers,
+                        srv.kms)
+                    plain_size = csse.decrypted_size(
+                        oi.user_defined, oi.size, oi.parts)
+                    sse_hdrs = csse.response_headers(oi.user_defined)
+                    if rng and offset >= plain_size:
+                        raise S3Error("InvalidRange")
+                    if not head:
+                        if not rng and len(data) == oi.size:
+                            # full GET: ciphertext already in hand
+                            blob = data
+                            def read(o, n, _b=blob):
+                                return _b[o:o + n]
+                        else:
+                            def read(o, n):
+                                return srv.layer.get_object(
+                                    bucket, key, o, n, opts)[1]
+                        data = csse.decrypt_object_range(
+                            enc, oi.user_defined, oi.size, read,
+                            offset, length, oi.parts)
             except ol.MethodNotAllowed:
                 # delete marker (cmd/object-handlers.go: 405 + header)
                 return self._send(
                     405, s3err.to_xml(s3err.get("MethodNotAllowed")),
                     headers={"x-amz-delete-marker": "true"})
+            entity_size = plain_size if plain_size is not None else oi.size
             hdrs = {
                 "ETag": f'"{oi.etag}"',
                 "Last-Modified": _http_date(oi.mod_time),
                 "Accept-Ranges": "bytes",
             }
+            hdrs.update(sse_hdrs)
             if oi.version_id:
                 hdrs["x-amz-version-id"] = oi.version_id
             for k2, v in oi.user_defined.items():
@@ -1167,11 +1397,11 @@ def _make_handler(srv: S3Server):
                     return self._send(405, b"", headers=hdrs,
                                       content_length=0)
                 return self._send(200, b"", content_type=ct, headers=hdrs,
-                                  content_length=oi.size)
+                                  content_length=entity_size)
             if rng:
-                start = oi.size - len(data) if offset < 0 else offset
+                start = entity_size - len(data) if offset < 0 else offset
                 hdrs["Content-Range"] = \
-                    f"bytes {start}-{start + len(data) - 1}/{oi.size}"
+                    f"bytes {start}-{start + len(data) - 1}/{entity_size}"
                 return self._send(206, data, content_type=ct, headers=hdrs)
             return self._send(200, data, content_type=ct, headers=hdrs)
 

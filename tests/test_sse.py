@@ -1,0 +1,363 @@
+"""SSE tests: DARE format, KMS sealing, SSE-C/SSE-S3 over the S3 API.
+
+Mirrors the reference's crypto test tiers (cmd/encryption-v1_test.go,
+cmd/crypto/*_test.go): format round-trips, tamper detection, ranged
+decryption math, and full HTTP round trips with customer keys.
+"""
+
+import base64
+import hashlib
+
+import pytest
+
+from minio_tpu.crypto import dare, kms, sse
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.s3.client import S3Client, S3ClientError
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl_storage import XLStorage
+
+KEY = bytes(range(32))
+
+
+# -- DARE format ------------------------------------------------------------
+
+@pytest.mark.parametrize("size", [0, 1, 100, 64 * 1024 - 1, 64 * 1024,
+                                  64 * 1024 + 1, 200_000, 3 * 64 * 1024])
+def test_dare_roundtrip(size):
+    plain = bytes(i % 251 for i in range(size))
+    ct = dare.encrypt(KEY, plain)
+    assert len(ct) == dare.ciphertext_size(size)
+    assert dare.plaintext_size(len(ct)) == size
+    assert dare.decrypt(KEY, ct) == plain
+
+
+def test_dare_tamper_detected():
+    ct = bytearray(dare.encrypt(KEY, b"x" * 100_000))
+    ct[len(ct) // 2] ^= 1
+    with pytest.raises(dare.DAREError):
+        dare.decrypt(KEY, bytes(ct))
+
+
+def test_dare_truncation_detected():
+    ct = dare.encrypt(KEY, b"x" * 200_000)
+    # drop the final package entirely: remaining stream is valid packages
+    # but the final marker is missing
+    first_two = 2 * dare.MAX_PACKAGE
+    with pytest.raises(dare.DAREError):
+        dare.decrypt(KEY, ct[:first_two])
+
+
+def test_dare_reorder_detected():
+    ct = dare.encrypt(KEY, b"x" * (2 * dare.MAX_PAYLOAD))
+    p0, p1 = ct[:dare.MAX_PACKAGE], ct[dare.MAX_PACKAGE:]
+    with pytest.raises(dare.DAREError):
+        dare.decrypt(KEY, p1 + p0)
+
+
+def test_dare_mid_stream_reorder_detected():
+    # swap packages 0 and 1 of a 3-package stream: both GCM tags still
+    # verify under their own headers, but the recovered stream nonces
+    # disagree with package 2's (ref-nonce check)
+    ct = dare.encrypt(KEY, b"y" * (2 * dare.MAX_PAYLOAD + 100))
+    p0 = ct[:dare.MAX_PACKAGE]
+    p1 = ct[dare.MAX_PACKAGE:2 * dare.MAX_PACKAGE]
+    p2 = ct[2 * dare.MAX_PACKAGE:]
+    with pytest.raises(dare.DAREError):
+        dare.decrypt(KEY, p1 + p0 + p2)
+
+
+def test_dare_wrong_key():
+    ct = dare.encrypt(KEY, b"secret")
+    with pytest.raises(dare.DAREError):
+        dare.decrypt(bytes(32), ct)
+
+
+@pytest.mark.parametrize("offset,length", [
+    (0, 10), (0, -1), (100, 200), (64 * 1024 - 5, 10),
+    (64 * 1024, 64 * 1024), (150_000, 49_999), (199_999, 1), (200_000, 0),
+])
+def test_dare_range(offset, length):
+    plain = bytes(i % 249 for i in range(200_000))
+    ct = dare.encrypt(KEY, plain)
+    reads = []
+
+    def read(o, n):
+        reads.append((o, n))
+        return ct[o:o + n]
+
+    got = dare.decrypt_range(KEY, read, len(ct), offset, length)
+    want = plain[offset:] if length < 0 else plain[offset:offset + length]
+    assert got == want
+    # only covering packages are fetched
+    if length > 0:
+        spans = sum(n for _, n in reads)
+        needed_pkgs = (offset + length - 1) // dare.MAX_PAYLOAD - \
+            offset // dare.MAX_PAYLOAD + 1
+        assert spans <= needed_pkgs * dare.MAX_PACKAGE
+
+
+# -- KMS --------------------------------------------------------------------
+
+def test_kms_roundtrip_and_context_binding():
+    k = kms.LocalKMS()
+    ctx = {"bucket": "b", "object": "o"}
+    plain, sealed = k.generate_key(ctx)
+    assert k.unseal_key(sealed, ctx) == plain
+    with pytest.raises(kms.KMSError):
+        k.unseal_key(sealed, {"bucket": "b", "object": "other"})
+
+
+def test_object_encryption_seal_unseal_ssec():
+    client_key = bytes(32)
+    headers = {
+        sse.SSEC_ALGO: "AES256",
+        sse.SSEC_KEY: base64.b64encode(client_key).decode(),
+        sse.SSEC_KEY_MD5: base64.b64encode(
+            hashlib.md5(client_key).digest()).decode(),
+    }
+    enc = sse.ObjectEncryption.new("SSE-C", "b", "o", headers)
+    opened = sse.ObjectEncryption.open(enc.meta, "b", "o", headers)
+    assert opened.oek == enc.oek
+    # wrong path -> seal fails
+    with pytest.raises(sse.SSEError):
+        sse.ObjectEncryption.open(enc.meta, "b", "other", headers)
+
+
+# -- HTTP round trips -------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("ssedrives")
+    disks = []
+    for i in range(4):
+        d = tmp / f"disk{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=256 * 1024,
+                           backend="numpy")
+    srv = S3Server(layer, access_key="testkey", secret_key="testsecret")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def client(server):
+    c = S3Client(server.endpoint, "testkey", "testsecret")
+    if not c.head_bucket("enc"):
+        c.make_bucket("enc")
+    return c
+
+
+def _ssec_headers(key: bytes, copy: bool = False) -> dict:
+    prefix = "x-amz-copy-source-server-side-encryption-customer" if copy \
+        else "x-amz-server-side-encryption-customer"
+    return {
+        f"{prefix}-algorithm": "AES256",
+        f"{prefix}-key": base64.b64encode(key).decode(),
+        f"{prefix}-key-md5":
+            base64.b64encode(hashlib.md5(key).digest()).decode(),
+    }
+
+
+def test_ssec_roundtrip(client, server):
+    key = hashlib.sha256(b"clientkey").digest()
+    data = bytes(i % 255 for i in range(300_000))
+    client.request("PUT", "/enc/ssec.bin", body=data,
+                   headers=_ssec_headers(key))
+    # ciphertext at rest differs from plaintext and carries sealed-key meta
+    oi, raw = server.layer.get_object("enc", "ssec.bin")
+    assert raw[:300] != data[:300]
+    assert sse.META_SEALED_KEY in oi.user_defined
+    # GET with the key round-trips
+    r = client.request("GET", "/enc/ssec.bin", headers=_ssec_headers(key))
+    assert r.body == data
+    assert r.headers.get(
+        "x-amz-server-side-encryption-customer-algorithm") == "AES256"
+    # HEAD reports plaintext size
+    h = client.request("HEAD", "/enc/ssec.bin",
+                       headers=_ssec_headers(key))
+    assert int(h.headers["Content-Length"]) == len(data)
+
+
+def test_ssec_get_without_key_fails(client):
+    key = hashlib.sha256(b"clientkey2").digest()
+    client.request("PUT", "/enc/locked.bin", body=b"top-secret",
+                   headers=_ssec_headers(key))
+    with pytest.raises(S3ClientError) as ei:
+        client.request("GET", "/enc/locked.bin")
+    assert ei.value.status == 400
+    # wrong key also fails
+    with pytest.raises(S3ClientError):
+        client.request("GET", "/enc/locked.bin",
+                       headers=_ssec_headers(bytes(32)))
+
+
+def test_ssec_ranged_get(client):
+    key = hashlib.sha256(b"rangedkey").digest()
+    data = bytes((i * 7) % 256 for i in range(200_000))
+    client.request("PUT", "/enc/ranged.bin", body=data,
+                   headers=_ssec_headers(key))
+    r = client.request("GET", "/enc/ranged.bin",
+                       headers={"Range": "bytes=65000-131999",
+                                **_ssec_headers(key)}, expect=(206,))
+    assert r.body == data[65000:132000]
+    assert r.headers["Content-Range"] == \
+        f"bytes 65000-131999/{len(data)}"
+
+
+def test_sse_s3_roundtrip(client, server):
+    data = b"sse-s3 payload " * 5000
+    client.request("PUT", "/enc/sses3.bin", body=data,
+                   headers={"x-amz-server-side-encryption": "AES256"})
+    _, raw = server.layer.get_object("enc", "sses3.bin")
+    assert data[:64] not in raw
+    # no key material needed on GET; response advertises AES256
+    r = client.request("GET", "/enc/sses3.bin")
+    assert r.body == data
+    assert r.headers.get("x-amz-server-side-encryption") == "AES256"
+
+
+def test_sse_kms_reported_as_kms(client, server):
+    client.request("PUT", "/enc/kms.bin", body=b"kms-mode data",
+                   headers={"x-amz-server-side-encryption": "aws:kms"})
+    r = client.request("GET", "/enc/kms.bin")
+    assert r.body == b"kms-mode data"
+    assert r.headers.get("x-amz-server-side-encryption") == "aws:kms"
+    assert r.headers.get(
+        "x-amz-server-side-encryption-aws-kms-key-id")
+
+
+def test_encrypted_range_past_end_is_416(client):
+    key = hashlib.sha256(b"rngkey").digest()
+    client.request("PUT", "/enc/small.bin", body=b"0123456789",
+                   headers=_ssec_headers(key))
+    with pytest.raises(S3ClientError) as ei:
+        client.request("GET", "/enc/small.bin",
+                       headers={"Range": "bytes=10-20",
+                                **_ssec_headers(key)})
+    assert ei.value.status == 416
+
+
+def test_bucket_default_encryption(client, server):
+    body = (b'<ServerSideEncryptionConfiguration '
+            b'xmlns="http://s3.amazonaws.com/doc/2006-03-01/"><Rule>'
+            b'<ApplyServerSideEncryptionByDefault>'
+            b'<SSEAlgorithm>AES256</SSEAlgorithm>'
+            b'</ApplyServerSideEncryptionByDefault></Rule>'
+            b'</ServerSideEncryptionConfiguration>')
+    client.request("PUT", "/enc", "encryption", body)
+    client.request("PUT", "/enc/auto.bin", body=b"auto-encrypted")
+    oi, raw = server.layer.get_object("enc", "auto.bin")
+    assert sse.META_SEALED_KEY in oi.user_defined
+    r = client.request("GET", "/enc/auto.bin")
+    assert r.body == b"auto-encrypted"
+    client.request("DELETE", "/enc", "encryption", expect=(200, 204))
+
+
+def test_ssec_multipart(client, server):
+    key = hashlib.sha256(b"mpkey").digest()
+    part = bytes(i % 256 for i in range(5 * 1024 * 1024))
+    part2 = bytes((i * 3) % 256 for i in range(1024 * 1024))
+    r = client.request("POST", "/enc/mp.bin", "uploads",
+                       headers=_ssec_headers(key))
+    uid = r.xml().findtext(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}UploadId")
+    e1 = client.request("PUT", "/enc/mp.bin",
+                        f"partNumber=1&uploadId={uid}", part,
+                        headers=_ssec_headers(key)).headers["ETag"]
+    e2 = client.request("PUT", "/enc/mp.bin",
+                        f"partNumber=2&uploadId={uid}", part2,
+                        headers=_ssec_headers(key)).headers["ETag"]
+    body = (f'<CompleteMultipartUpload>'
+            f'<Part><PartNumber>1</PartNumber><ETag>{e1}</ETag></Part>'
+            f'<Part><PartNumber>2</PartNumber><ETag>{e2}</ETag></Part>'
+            f'</CompleteMultipartUpload>').encode()
+    client.request("POST", "/enc/mp.bin", f"uploadId={uid}", body)
+    full = part + part2
+    r = client.request("GET", "/enc/mp.bin", headers=_ssec_headers(key))
+    assert r.body == full
+    # cross-part range
+    lo, hi = len(part) - 1000, len(part) + 1000
+    r = client.request("GET", "/enc/mp.bin",
+                       headers={"Range": f"bytes={lo}-{hi - 1}",
+                                **_ssec_headers(key)}, expect=(206,))
+    assert r.body == full[lo:hi]
+    # per-part ciphertext sizes come from the atomically-committed part
+    # table, not a second metadata write
+    oi = server.layer.get_object_info("enc", "mp.bin")
+    assert len(oi.parts) == 2
+    assert sum(s for _, s in oi.parts) == oi.size
+
+
+def test_copy_object_encrypt_decrypt(client, server):
+    key = hashlib.sha256(b"copykey").digest()
+    data = b"copy me " * 1000
+    client.request("PUT", "/enc/src.bin", body=data)
+    # plaintext -> SSE-C
+    client.request("PUT", "/enc/dst-enc.bin",
+                   headers={"x-amz-copy-source": "/enc/src.bin",
+                            **_ssec_headers(key)})
+    r = client.request("GET", "/enc/dst-enc.bin",
+                       headers=_ssec_headers(key))
+    assert r.body == data
+    # SSE-C -> plaintext (copy-source key headers)
+    client.request("PUT", "/enc/dst-plain.bin",
+                   headers={"x-amz-copy-source": "/enc/dst-enc.bin",
+                            **_ssec_headers(key, copy=True)})
+    r = client.request("GET", "/enc/dst-plain.bin")
+    assert r.body == data
+    _, raw = server.layer.get_object("enc", "dst-plain.bin")
+    assert raw == data
+
+
+def test_copy_object_self_copy_rejected(client):
+    client.request("PUT", "/enc/selfc.bin", body=b"data")
+    with pytest.raises(S3ClientError) as ei:
+        client.request("PUT", "/enc/selfc.bin",
+                       headers={"x-amz-copy-source": "/enc/selfc.bin"})
+    assert ei.value.status == 400
+
+
+def test_copy_object_replace_metadata(client):
+    client.request("PUT", "/enc/m1.bin", body=b"meta",
+                   headers={"x-amz-meta-color": "blue"})
+    client.request("PUT", "/enc/m2.bin",
+                   headers={"x-amz-copy-source": "/enc/m1.bin",
+                            "x-amz-metadata-directive": "REPLACE",
+                            "x-amz-meta-color": "red"})
+    r = client.request("HEAD", "/enc/m2.bin")
+    assert r.headers.get("x-amz-meta-color") == "red"
+    # COPY directive carries source metadata
+    client.request("PUT", "/enc/m3.bin",
+                   headers={"x-amz-copy-source": "/enc/m1.bin"})
+    r = client.request("HEAD", "/enc/m3.bin")
+    assert r.headers.get("x-amz-meta-color") == "blue"
+
+
+def test_upload_part_copy(client):
+    src = bytes(i % 256 for i in range(6 * 1024 * 1024))
+    client.request("PUT", "/enc/pcsrc.bin", body=src)
+    r = client.request("POST", "/enc/pc.bin", "uploads")
+    uid = r.xml().findtext(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}UploadId")
+    r1 = client.request(
+        "PUT", "/enc/pc.bin", f"partNumber=1&uploadId={uid}",
+        headers={"x-amz-copy-source": "/enc/pcsrc.bin",
+                 "x-amz-copy-source-range":
+                     f"bytes=0-{5 * 1024 * 1024 - 1}"})
+    e1 = r1.xml().findtext(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}ETag").strip('"')
+    r2 = client.request(
+        "PUT", "/enc/pc.bin", f"partNumber=2&uploadId={uid}",
+        headers={"x-amz-copy-source": "/enc/pcsrc.bin",
+                 "x-amz-copy-source-range":
+                     f"bytes={5 * 1024 * 1024}-{len(src) - 1}"})
+    e2 = r2.xml().findtext(
+        "{http://s3.amazonaws.com/doc/2006-03-01/}ETag").strip('"')
+    body = (f'<CompleteMultipartUpload>'
+            f'<Part><PartNumber>1</PartNumber><ETag>"{e1}"</ETag></Part>'
+            f'<Part><PartNumber>2</PartNumber><ETag>"{e2}"</ETag></Part>'
+            f'</CompleteMultipartUpload>').encode()
+    client.request("POST", "/enc/pc.bin", f"uploadId={uid}", body)
+    assert client.get_object("enc", "pc.bin").body == src
