@@ -1,0 +1,236 @@
+"""Transparent object compression (cmd/object-api-utils.go:436-449,916).
+
+Snappy block format + snappy/S2 framing format, with two engines:
+
+* native C++ (`native/snappy.cc`), built on demand with g++ into
+  `native/build/libmtsnappy.so` and bound via ctypes — the role the
+  assembly-accelerated klauspost/compress S2 module plays in the
+  reference (go.mod:37);
+* a pure-Python mirror used when no compiler is available.
+
+The stored stream is the snappy *framing* format (stream identifier +
+per-chunk masked CRC32C), so every 64 KiB chunk is independently
+verifiable — the compression analog of the bitrot layer's per-block
+hashes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+
+from .snappy_py import (compress_block_py, crc32c_py,
+                        decompress_block_py, uncompressed_length_py)
+
+_NATIVE_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native", "snappy.cc")
+_NATIVE_SO = os.path.join(os.path.dirname(_NATIVE_SRC), "build",
+                          "libmtsnappy.so")
+
+_lock = threading.Lock()
+_lib = None
+_lib_tried = False
+
+
+class CompressionError(Exception):
+    pass
+
+
+def _load_native():
+    """Build (once) and load the native codec; None when unavailable."""
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    with _lock:
+        if _lib_tried:
+            return _lib
+        _lib_tried = True
+        if os.environ.get("MT_NATIVE", "1") == "0":
+            return None
+        try:
+            if not os.path.exists(_NATIVE_SO) or (
+                    os.path.getmtime(_NATIVE_SO) <
+                    os.path.getmtime(_NATIVE_SRC)):
+                os.makedirs(os.path.dirname(_NATIVE_SO), exist_ok=True)
+                tmp = _NATIVE_SO + f".tmp{os.getpid()}"
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-o", tmp,
+                     _NATIVE_SRC],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, _NATIVE_SO)
+            lib = ctypes.CDLL(_NATIVE_SO)
+            lib.mt_snappy_max_compressed.restype = ctypes.c_size_t
+            lib.mt_snappy_max_compressed.argtypes = [ctypes.c_size_t]
+            lib.mt_snappy_compress.restype = ctypes.c_size_t
+            lib.mt_snappy_compress.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
+            lib.mt_snappy_uncompress.restype = ctypes.c_longlong
+            lib.mt_snappy_uncompress.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+                ctypes.c_size_t]
+            lib.mt_snappy_uncompressed_length.restype = ctypes.c_longlong
+            lib.mt_snappy_uncompressed_length.argtypes = [
+                ctypes.c_char_p, ctypes.c_size_t]
+            lib.mt_crc32c.restype = ctypes.c_uint32
+            lib.mt_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def native_available() -> bool:
+    return _load_native() is not None
+
+
+# -- block codec ------------------------------------------------------------
+
+def compress_block(data: bytes) -> bytes:
+    lib = _load_native()
+    if lib is None:
+        return compress_block_py(data)
+    cap = lib.mt_snappy_max_compressed(len(data))
+    out = ctypes.create_string_buffer(cap)
+    n = lib.mt_snappy_compress(data, len(data), out)
+    return out.raw[:n]
+
+
+def decompress_block(data: bytes) -> bytes:
+    lib = _load_native()
+    if lib is None:
+        return decompress_block_py(data)
+    want = lib.mt_snappy_uncompressed_length(data, len(data))
+    if want < 0:
+        raise CompressionError("corrupt snappy block")
+    out = ctypes.create_string_buffer(max(int(want), 1))
+    n = lib.mt_snappy_uncompress(data, len(data), out, int(want))
+    if n < 0:
+        raise CompressionError("corrupt snappy block")
+    return out.raw[:n]
+
+
+def crc32c(data: bytes) -> int:
+    lib = _load_native()
+    if lib is None:
+        return crc32c_py(data)
+    return lib.mt_crc32c(data, len(data))
+
+
+# -- framing format (snappy framing / S2-compatible container) --------------
+
+_STREAM_IDENT = b"\xff\x06\x00\x00sNaPpY"
+_CHUNK_COMPRESSED = 0x00
+_CHUNK_UNCOMPRESSED = 0x01
+_FRAME_MAX = 65536                  # max uncompressed bytes per chunk
+
+
+def _masked_crc(data: bytes) -> int:
+    c = crc32c(data)
+    return ((c >> 15) | (c << 17)) + 0xA282EAD8 & 0xFFFFFFFF
+
+
+def compress_stream(data: bytes) -> bytes:
+    """Frame `data` as a snappy-framing stream; chunks that don't shrink
+    are stored uncompressed (the >2 GiB/s skip path for pre-compressed
+    input, docs/compression/README.md:86)."""
+    out = bytearray(_STREAM_IDENT)
+    for off in range(0, len(data), _FRAME_MAX) or [0]:
+        chunk = data[off:off + _FRAME_MAX]
+        crc = _masked_crc(chunk)
+        comp = compress_block(chunk)
+        if len(comp) < len(chunk):
+            body = struct.pack("<I", crc)[:4] + comp
+            out += bytes([_CHUNK_COMPRESSED]) + \
+                struct.pack("<I", len(body))[:3] + body
+        else:
+            body = struct.pack("<I", crc)[:4] + chunk
+            out += bytes([_CHUNK_UNCOMPRESSED]) + \
+                struct.pack("<I", len(body))[:3] + body
+    return bytes(out)
+
+
+def decompress_stream(data: bytes) -> bytes:
+    if not data.startswith(_STREAM_IDENT):
+        raise CompressionError("missing snappy stream identifier")
+    out = bytearray()
+    i = len(_STREAM_IDENT)
+    while i < len(data):
+        if i + 4 > len(data):
+            raise CompressionError("truncated chunk header")
+        kind = data[i]
+        ln = data[i + 1] | (data[i + 2] << 8) | (data[i + 3] << 16)
+        i += 4
+        if i + ln > len(data):
+            raise CompressionError("truncated chunk")
+        body = data[i:i + ln]
+        i += ln
+        if kind in (_CHUNK_COMPRESSED, _CHUNK_UNCOMPRESSED):
+            if ln < 4:
+                raise CompressionError("short chunk")
+            crc = struct.unpack("<I", body[:4])[0]
+            payload = body[4:]
+            plain = decompress_block(payload) \
+                if kind == _CHUNK_COMPRESSED else payload
+            if _masked_crc(plain) != crc:
+                raise CompressionError("chunk CRC mismatch")
+            out += plain
+        elif kind == 0xFF:
+            continue                         # repeated stream identifier
+        elif 0x80 <= kind <= 0xFD:
+            continue                         # skippable chunk
+        else:
+            raise CompressionError(f"unknown chunk type {kind:#x}")
+    return bytes(out)
+
+
+# -- eligibility (cmd/object-api-utils.go:436-449) --------------------------
+
+# already-compressed content that must bypass compression
+DEFAULT_EXCLUDE_EXTENSIONS = [
+    ".gz", ".bz2", ".zst", ".zip", ".7z", ".rar", ".xz", ".lz4", ".snappy",
+    ".mp4", ".mkv", ".mov", ".jpg", ".jpeg", ".png", ".gif", ".webp",
+    ".mp3", ".aac", ".ogg",
+]
+DEFAULT_EXCLUDE_TYPES = [
+    "video/", "audio/", "image/",
+    "application/zip", "application/x-gzip", "application/x-bzip2",
+    "application/x-compress", "application/x-xz", "application/zstd",
+]
+MIN_COMPRESSIBLE_SIZE = 4096   # small objects gain nothing
+
+META_COMPRESSION = "x-minio-internal-compression"
+COMPRESSION_ALGO = "klauspost/compress/s2"   # reference's marker value
+
+
+def is_compressible(object_name: str, content_type: str, size: int,
+                    include_extensions: list[str] | None = None,
+                    include_types: list[str] | None = None) -> bool:
+    """Eligibility: explicit include lists win; otherwise everything not
+    excluded by extension/MIME and not tiny (isCompressible analog).
+
+    include lists mirror MINIO_COMPRESS_EXTENSIONS / MIME_TYPES config —
+    when set, ONLY matching objects compress.
+    """
+    if 0 <= size < MIN_COMPRESSIBLE_SIZE:
+        return False
+    name = object_name.lower()
+    ctype = (content_type or "").lower()
+    if include_extensions or include_types:
+        ok = False
+        for ext in include_extensions or []:
+            if ext and name.endswith(ext.lower()):
+                ok = True
+        for t in include_types or []:
+            if t and ctype.startswith(t.lower().rstrip("*")):
+                ok = True
+        return ok
+    for ext in DEFAULT_EXCLUDE_EXTENSIONS:
+        if name.endswith(ext):
+            return False
+    for t in DEFAULT_EXCLUDE_TYPES:
+        if ctype.startswith(t):
+            return False
+    return True

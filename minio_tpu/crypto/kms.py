@@ -24,6 +24,18 @@ class KMSError(Exception):
     pass
 
 
+_default: "LocalKMS | None" = None
+
+
+def default_kms() -> "LocalKMS":
+    """Process-wide fallback instance (library use without a server).
+    A server must use LocalKMS.from_env_or_store for persistence."""
+    global _default
+    if _default is None:
+        _default = LocalKMS()
+    return _default
+
+
 class LocalKMS:
     """Single-master-key KMS (cmd/crypto/kms.go masterKeyKMS analog)."""
 
@@ -31,17 +43,63 @@ class LocalKMS:
                  master_key: bytes | None = None):
         if master_key is None:
             spec = os.environ.get(MASTER_KEY_ENV, "")
-            if ":" in spec:
-                key_id, b64 = spec.split(":", 1)
-                master_key = base64.b64decode(b64)
+            if spec:
+                key_id, master_key = self._parse_spec(spec)
             else:
-                # deterministic dev default (NOT for production), mirrors
-                # minio's behaviour of running SSE-S3 with an auto key
-                master_key = hashlib.sha256(b"minio-tpu-dev-master").digest()
+                # fresh random master key (process-scoped); servers use
+                # from_env_or_store() so the key survives restarts
+                master_key = os.urandom(32)
         if len(master_key) != 32:
             raise KMSError("master key must be 32 bytes")
         self.key_id = key_id
         self._master = master_key
+
+    @staticmethod
+    def _parse_spec(spec: str) -> tuple[str, bytes]:
+        """'<key-id>:<base64-32-bytes>' — malformed input fails LOUDLY: a
+        typo must never silently downgrade to a different key."""
+        if ":" not in spec:
+            raise KMSError(
+                f"malformed {MASTER_KEY_ENV}: want '<key-id>:<base64-key>'")
+        key_id, b64 = spec.split(":", 1)
+        try:
+            key = base64.b64decode(b64, validate=True)
+        except Exception as e:
+            raise KMSError(
+                f"malformed {MASTER_KEY_ENV}: bad base64 key") from e
+        if len(key) != 32 or not key_id:
+            raise KMSError(
+                f"malformed {MASTER_KEY_ENV}: key must be 32 bytes")
+        return key_id, key
+
+    _STORE_PATH = "config/kms-master.key"
+
+    @classmethod
+    def from_env_or_store(cls, layer) -> "LocalKMS":
+        """Server bootstrap: env var wins; else load the master key
+        persisted in the system volume; else mint one and persist it so
+        SSE-S3/SSE-KMS objects survive restarts (the reference requires
+        an external KMS — this is its in-process equivalent)."""
+        spec = os.environ.get(MASTER_KEY_ENV, "")
+        if spec:
+            key_id, key = cls._parse_spec(spec)
+            return cls(key_id, key)
+        from ..storage.xl_storage import SYS_DIR
+        try:
+            blobs, _ = layer._fanout(
+                lambda d: d.read_all(SYS_DIR, cls._STORE_PATH))
+            for b in blobs:
+                if b:
+                    key_id, key = cls._parse_spec(b.decode())
+                    return cls(key_id, key)
+        except Exception:  # noqa: BLE001 — no stored key yet
+            pass
+        kms = cls("minio-tpu-auto-key", os.urandom(32))
+        stored = (kms.key_id + ":" +
+                  base64.b64encode(kms._master).decode()).encode()
+        layer._fanout(lambda d: d.write_all(SYS_DIR, cls._STORE_PATH,
+                                            stored))
+        return kms
 
     def _kek(self, key_id: str, context: dict[str, str]) -> bytes:
         ctx = json.dumps(context, sort_keys=True,

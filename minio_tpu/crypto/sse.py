@@ -24,7 +24,7 @@ import json
 from typing import Callable, Optional
 
 from . import dare
-from .kms import KMSError, LocalKMS
+from .kms import KMSError, LocalKMS, default_kms
 
 # --- request headers (cmd/crypto/header.go) --------------------------------
 SSE_HEADER = "x-amz-server-side-encryption"
@@ -158,7 +158,7 @@ class ObjectEncryption:
             }
             return ObjectEncryption(oek, meta)
         if kind in ("SSE-S3", "SSE-KMS"):
-            kms = kms or LocalKMS()
+            kms = kms or default_kms()
             context = {"bucket": bucket, "object": obj}
             data_key, sealed_blob = kms.generate_key(context)
             sealed = dare.encrypt(_derive_kek(data_key, bucket, obj), oek)
@@ -205,7 +205,7 @@ class ObjectEncryption:
                 raise SSEError("AccessDenied", "SSE-C key mismatch")
             kek = _derive_kek(client_key, bucket, obj)
         else:
-            kms = kms or LocalKMS()
+            kms = kms or default_kms()
             blob = meta.get(META_KMSV_SEALED) or meta[META_KMS_SEALED]
             try:
                 data_key = kms.unseal_key(blob,
@@ -238,9 +238,9 @@ def is_encrypted(meta: dict[str, str]) -> bool:
 
 def decrypted_size(meta: dict[str, str], cipher_size: int,
                    parts: list[tuple[int, int]] | None = None) -> int:
-    """Plaintext size of a stored encrypted object."""
-    if META_ACTUAL_SIZE in meta:
-        return int(meta[META_ACTUAL_SIZE])
+    """DARE-plaintext size of a stored encrypted object, computed from the
+    package math.  (META_ACTUAL_SIZE is the pre-compression size and may
+    differ when the object is compressed-then-encrypted.)"""
     sizes = part_cipher_sizes(meta, cipher_size, parts)
     return sum(dare.plaintext_size(s) for s in sizes)
 

@@ -109,7 +109,16 @@ class S3Server:
         self.replication = None  # ReplicationSys (minio_tpu/replication)
         self.usage = None        # data-usage cache (crawler)
         from ..crypto.kms import LocalKMS
-        self.kms = LocalKMS()
+        self.kms = LocalKMS.from_env_or_store(object_layer)
+        if self.config.get("compression", "enable") == "on":
+            # build/load the native codec BEFORE serving so the first
+            # request never blocks on a compile, and say which engine runs
+            from .. import compress as mtc
+            import logging
+            if not mtc.native_available():
+                logging.getLogger("minio_tpu").warning(
+                    "native snappy codec unavailable; using the pure-"
+                    "Python fallback (slow)")
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
@@ -681,7 +690,7 @@ def _make_handler(srv: S3Server):
                 ET.SubElement(c, "Key").text = o.name
                 ET.SubElement(c, "LastModified").text = _iso_date(o.mod_time)
                 ET.SubElement(c, "ETag").text = f'"{o.etag}"'
-                ET.SubElement(c, "Size").text = str(o.size)
+                ET.SubElement(c, "Size").text = str(_actual_size(o))
                 ET.SubElement(c, "StorageClass").text = "STANDARD"
             for p in res.prefixes:
                 cp = ET.SubElement(root, "CommonPrefixes")
@@ -706,7 +715,7 @@ def _make_handler(srv: S3Server):
                 ET.SubElement(v, "LastModified").text = _iso_date(o.mod_time)
                 if not o.delete_marker:
                     ET.SubElement(v, "ETag").text = f'"{o.etag}"'
-                    ET.SubElement(v, "Size").text = str(o.size)
+                    ET.SubElement(v, "Size").text = str(_actual_size(o))
                     ET.SubElement(v, "StorageClass").text = "STANDARD"
             self._send(200, _xml(root))
 
@@ -992,6 +1001,26 @@ def _make_handler(srv: S3Server):
             user_defined.update(enc.meta)
             return enc
 
+        def _compress_for_put(self, key: str, user_defined: dict,
+                              payload: bytes) -> bytes:
+            """Transparent compression (newS2CompressReader analog):
+            applied BEFORE encryption, recorded via internal metadata with
+            the original size for listings/HEAD."""
+            from .. import compress as mtc
+            from ..crypto import sse as csse
+            if srv.config.get("compression", "enable") != "on":
+                return payload
+            exts = [e for e in srv.config.get(
+                "compression", "extensions").split(",") if e]
+            types = [t for t in srv.config.get(
+                "compression", "mime_types").split(",") if t]
+            ct = user_defined.get("content-type", "")
+            if not mtc.is_compressible(key, ct, len(payload), exts, types):
+                return payload
+            user_defined[mtc.META_COMPRESSION] = mtc.COMPRESSION_ALGO
+            user_defined[csse.META_ACTUAL_SIZE] = str(len(payload))
+            return mtc.compress_stream(payload)
+
         def _tagging_header_meta(self) -> dict[str, str]:
             """Validated x-amz-tagging header as metadata entries."""
             tag_hdr = self.headers.get("x-amz-tagging")
@@ -1125,9 +1154,9 @@ def _make_handler(srv: S3Server):
             user_defined.update(self._lock_headers(bucket, key))
             self._check_quota(bucket, len(payload))
             from ..crypto import sse as csse
+            payload = self._compress_for_put(key, user_defined, payload)
             enc = self._sse_for_put(bucket, key, user_defined)
             if enc is not None:
-                user_defined[csse.META_ACTUAL_SIZE] = str(len(payload))
                 payload = enc.encrypt(payload)
             versioned = srv.bucket_meta.versioning_enabled(bucket)
             oi = srv.layer.put_object(
@@ -1180,22 +1209,37 @@ def _make_handler(srv: S3Server):
                 raise S3Error("PreconditionFailed")
             if if_none and if_none.strip('"') == soi.etag:
                 raise S3Error("PreconditionFailed")
+            from .. import compress as mtc
+            compressed = mtc.META_COMPRESSION in soi.user_defined
             if csse.is_encrypted(soi.user_defined):
                 enc = csse.ObjectEncryption.open(
                     soi.user_defined, sbucket, skey, self.headers,
                     srv.kms, copy_source=True)
-                size = csse.decrypted_size(soi.user_defined, soi.size,
-                                           soi.parts)
-                data = csse.decrypt_object_range(
+                if not compressed:
+                    size = csse.decrypted_size(soi.user_defined, soi.size,
+                                               soi.parts)
+                    data = csse.decrypt_object_range(
+                        enc, soi.user_defined, soi.size,
+                        lambda o, n: srv.layer.get_object(
+                            sbucket, skey, o, n, opts)[1], offset, length,
+                        soi.parts)
+                    return soi, data, size
+                inner = csse.decrypt_object_range(
                     enc, soi.user_defined, soi.size,
                     lambda o, n: srv.layer.get_object(
-                        sbucket, skey, o, n, opts)[1], offset, length,
-                    soi.parts)
-            else:
+                        sbucket, skey, o, n, opts)[1], 0, -1, soi.parts)
+            elif not compressed:
                 size = soi.size
                 _, data = srv.layer.get_object(sbucket, skey, offset,
                                                length, opts)
-            return soi, data, size
+                return soi, data, size
+            else:
+                _, inner = srv.layer.get_object(sbucket, skey, 0, -1,
+                                                opts)
+            full = mtc.decompress_stream(inner)
+            data = full[offset:] if length < 0 \
+                else full[offset:offset + length]
+            return soi, data, len(full)
 
         def _copy_object(self, bucket, key, query):
             from ..crypto import sse as csse
@@ -1222,6 +1266,7 @@ def _make_handler(srv: S3Server):
             elif soi.user_defined.get(self.TAG_KEY):
                 user_defined[self.TAG_KEY] = soi.user_defined[self.TAG_KEY]
             user_defined.update(self._lock_headers(bucket, key))
+            data = self._compress_for_put(key, user_defined, data)
             enc = self._sse_for_put(bucket, key, user_defined)
             sse_changed = enc is not None or \
                 csse.is_encrypted(soi.user_defined)
@@ -1230,7 +1275,6 @@ def _make_handler(srv: S3Server):
                 raise S3Error("InvalidCopyDest")
             self._check_quota(bucket, len(data))
             if enc is not None:
-                user_defined[csse.META_ACTUAL_SIZE] = str(len(data))
                 data = enc.encrypt(data)
             versioned = srv.bucket_meta.versioning_enabled(bucket)
             oi = srv.layer.put_object(
@@ -1326,44 +1370,83 @@ def _make_handler(srv: S3Server):
             offset, length = 0, -1
             sse_hdrs: dict[str, str] = {}
             plain_size: int | None = None
+            from .. import compress as mtc
             try:
                 if rng:
                     offset, length = _parse_range(rng)
-                if head:
+                if head or rng:
+                    # metadata first: a range is in client (decompressed/
+                    # decrypted) space — fetching stored bytes at those
+                    # offsets would decode data that gets thrown away
                     oi = srv.layer.get_object_info(bucket, key, opts)
                     data = None
+                    if rng and not oi.delete_marker and \
+                            mtc.META_COMPRESSION not in oi.user_defined \
+                            and not csse.is_encrypted(oi.user_defined):
+                        oi, data = srv.layer.get_object(
+                            bucket, key, offset, length, opts)
                 else:
-                    # single quorum metadata read for the unencrypted hot
-                    # path; a plaintext-space range is always inside the
-                    # (larger) ciphertext, so this read also serves as the
-                    # encrypted branch's metadata fetch
-                    oi, data = srv.layer.get_object(bucket, key, offset,
-                                                    length, opts)
-                if csse.is_encrypted(oi.user_defined) and \
-                        not oi.delete_marker:
-                    # DecryptObjectInfo: report plaintext size; the data
-                    # path reads only covering DARE packages
+                    # full GET: one read returns metadata + data for every
+                    # object class (the stored stream is decoded below)
+                    oi, data = srv.layer.get_object(bucket, key, 0, -1,
+                                                    opts)
+                if not head and oi.delete_marker:
+                    raise ol.MethodNotAllowed(key)
+                encrypted = csse.is_encrypted(oi.user_defined) and \
+                    not oi.delete_marker
+                compressed = mtc.META_COMPRESSION in oi.user_defined and \
+                    not oi.delete_marker
+                inner: bytes | None = None
+                if encrypted:
+                    # DecryptObjectInfo: the data path reads only covering
+                    # DARE packages (full stream when also compressed)
                     enc = csse.ObjectEncryption.open(
                         oi.user_defined, bucket, key, self.headers,
                         srv.kms)
-                    plain_size = csse.decrypted_size(
+                    inner_size = csse.decrypted_size(
                         oi.user_defined, oi.size, oi.parts)
                     sse_hdrs = csse.response_headers(oi.user_defined)
-                    if rng and offset >= plain_size:
-                        raise S3Error("InvalidRange")
+                    if not compressed:
+                        plain_size = inner_size
+                        if rng and offset >= plain_size:
+                            raise S3Error("InvalidRange")
                     if not head:
-                        if not rng and len(data) == oi.size:
-                            # full GET: ciphertext already in hand
-                            blob = data
+                        if data is not None and not rng and \
+                                len(data) == oi.size:
+                            blob = data       # full ciphertext in hand
+
                             def read(o, n, _b=blob):
                                 return _b[o:o + n]
                         else:
                             def read(o, n):
                                 return srv.layer.get_object(
                                     bucket, key, o, n, opts)[1]
-                        data = csse.decrypt_object_range(
-                            enc, oi.user_defined, oi.size, read,
-                            offset, length, oi.parts)
+                        if compressed:
+                            inner = csse.decrypt_object_range(
+                                enc, oi.user_defined, oi.size, read,
+                                0, -1, oi.parts)
+                        else:
+                            data = csse.decrypt_object_range(
+                                enc, oi.user_defined, oi.size, read,
+                                offset, length, oi.parts)
+                if compressed:
+                    if head:
+                        plain_size = int(
+                            oi.user_defined[csse.META_ACTUAL_SIZE])
+                    else:
+                        if inner is None:
+                            if data is not None and not rng and \
+                                    len(data) == oi.size:
+                                inner = data
+                            else:
+                                _, inner = srv.layer.get_object(
+                                    bucket, key, 0, -1, opts)
+                        full = mtc.decompress_stream(inner)
+                        plain_size = len(full)
+                        if rng and offset >= plain_size:
+                            raise S3Error("InvalidRange")
+                        data = full[offset:] if length < 0 \
+                            else full[offset:offset + length]
             except ol.MethodNotAllowed:
                 # delete marker (cmd/object-handlers.go: 405 + header)
                 return self._send(
@@ -1447,6 +1530,25 @@ def _make_handler(srv: S3Server):
                 raise S3Error("ObjectLocked")
 
     return Handler
+
+
+def _actual_size(oi) -> int:
+    """Client-visible size (GetActualSize, cmd/object-api-utils.go): the
+    pre-compression size for compressed objects, the DARE-plaintext size
+    for encrypted-only objects, else the stored size."""
+    raw = oi.user_defined.get("x-minio-internal-actual-size")
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    from ..crypto import sse as csse
+    if csse.is_encrypted(oi.user_defined):
+        try:
+            return csse.decrypted_size(oi.user_defined, oi.size, oi.parts)
+        except Exception:  # noqa: BLE001 — corrupt meta: report stored size
+            pass
+    return oi.size
 
 
 def _parse_range(spec: str) -> tuple[int, int]:

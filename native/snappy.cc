@@ -1,0 +1,229 @@
+// Snappy block-format codec + CRC32C — the native compression path.
+//
+// Reference: klauspost/compress v1.11.7 S2 (go.mod:37) provides MinIO's
+// transparent object compression (cmd/object-api-utils.go:436,916); its wire
+// format is snappy-compatible.  This implements the snappy block format
+// (https://github.com/google/snappy/blob/main/format_description.txt):
+//   preamble: uncompressed length, little-endian varint
+//   elements: tag byte — 00 literal, 01 copy(1-byte offset),
+//             10 copy(2-byte LE offset), 11 copy(4-byte LE offset)
+// Compression is greedy hash-table LZ77 over 64 KiB fragments (fresh table
+// per fragment, offsets within the window), mirroring snappy/S2 structure.
+//
+// C ABI for ctypes; no dependencies beyond libc.
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+extern "C" {
+
+// ---------------------------------------------------------------- crc32c --
+static uint32_t crc32c_table[256];
+static bool crc32c_init_done = false;
+
+static void crc32c_init() {
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+        crc32c_table[i] = c;
+    }
+    crc32c_init_done = true;
+}
+
+uint32_t mt_crc32c(const uint8_t* data, size_t n) {
+    if (!crc32c_init_done) crc32c_init();
+    uint32_t c = 0xFFFFFFFFu;
+    for (size_t i = 0; i < n; i++)
+        c = crc32c_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+    return c ^ 0xFFFFFFFFu;
+}
+
+// ------------------------------------------------------------- compressor --
+size_t mt_snappy_max_compressed(size_t n) {
+    // snappy's MaxCompressedLength bound
+    return 32 + n + n / 6;
+}
+
+static uint8_t* emit_uvarint(uint8_t* dst, uint64_t v) {
+    while (v >= 0x80) { *dst++ = (uint8_t)(v | 0x80); v >>= 7; }
+    *dst++ = (uint8_t)v;
+    return dst;
+}
+
+static uint8_t* emit_literal(uint8_t* dst, const uint8_t* src, size_t n) {
+    size_t m = n - 1;
+    if (m < 60) {
+        *dst++ = (uint8_t)(m << 2);
+    } else if (m < (1u << 8)) {
+        *dst++ = 60 << 2; *dst++ = (uint8_t)m;
+    } else if (m < (1u << 16)) {
+        *dst++ = 61 << 2; *dst++ = (uint8_t)m; *dst++ = (uint8_t)(m >> 8);
+    } else if (m < (1u << 24)) {
+        *dst++ = 62 << 2; *dst++ = (uint8_t)m; *dst++ = (uint8_t)(m >> 8);
+        *dst++ = (uint8_t)(m >> 16);
+    } else {
+        *dst++ = 63 << 2; *dst++ = (uint8_t)m; *dst++ = (uint8_t)(m >> 8);
+        *dst++ = (uint8_t)(m >> 16); *dst++ = (uint8_t)(m >> 24);
+    }
+    memcpy(dst, src, n);
+    return dst + n;
+}
+
+static uint8_t* emit_copy(uint8_t* dst, size_t offset, size_t length) {
+    // lengths > 64 split into 64-byte copies (2-byte-offset tag)
+    while (length >= 68) {
+        *dst++ = (uint8_t)((63 << 2) | 2);  // len 64
+        *dst++ = (uint8_t)offset; *dst++ = (uint8_t)(offset >> 8);
+        length -= 64;
+    }
+    if (length > 64) {
+        *dst++ = (uint8_t)((59 << 2) | 2);  // len 60
+        *dst++ = (uint8_t)offset; *dst++ = (uint8_t)(offset >> 8);
+        length -= 60;
+    }
+    if (length >= 12 || offset >= 2048) {
+        *dst++ = (uint8_t)(((length - 1) << 2) | 2);
+        *dst++ = (uint8_t)offset; *dst++ = (uint8_t)(offset >> 8);
+    } else {
+        *dst++ = (uint8_t)(((offset >> 8) << 5) | ((length - 4) << 2) | 1);
+        *dst++ = (uint8_t)offset;
+    }
+    return dst;
+}
+
+static inline uint32_t load32(const uint8_t* p) {
+    uint32_t v; memcpy(&v, p, 4); return v;
+}
+
+#define HASH_BITS 14
+#define HASH_SIZE (1 << HASH_BITS)
+
+static inline uint32_t hash4(uint32_t v) {
+    return (v * 0x1E35A7BDu) >> (32 - HASH_BITS);
+}
+
+// compress one fragment (<= 65536 bytes); returns bytes written
+static size_t compress_fragment(const uint8_t* src, size_t n, uint8_t* dst) {
+    uint8_t* d = dst;
+    int32_t table[HASH_SIZE];
+    memset(table, -1, sizeof(table));
+    size_t lit_start = 0, i = 0;
+    if (n >= 15) {
+        size_t limit = n - 4;
+        i = 1;
+        table[hash4(load32(src))] = 0;
+        while (i <= limit) {
+            uint32_t h = hash4(load32(src + i));
+            int32_t cand = table[h];
+            table[h] = (int32_t)i;
+            if (cand >= 0 && load32(src + cand) == load32(src + i)) {
+                // extend match
+                size_t len = 4;
+                while (i + len < n && src[cand + len] == src[i + len]) len++;
+                if (lit_start < i)
+                    d = emit_literal(d, src + lit_start, i - lit_start);
+                d = emit_copy(d, i - (size_t)cand, len);
+                i += len;
+                lit_start = i;
+                if (i <= limit) table[hash4(load32(src + i - 1))] =
+                    (int32_t)(i - 1);
+            } else {
+                i++;
+            }
+        }
+    }
+    if (lit_start < n)
+        d = emit_literal(d, src + lit_start, n - lit_start);
+    return (size_t)(d - dst);
+}
+
+size_t mt_snappy_compress(const uint8_t* src, size_t n, uint8_t* dst) {
+    uint8_t* d = emit_uvarint(dst, n);
+    const size_t FRAG = 65536;
+    for (size_t off = 0; off < n; off += FRAG) {
+        size_t m = (n - off < FRAG) ? (n - off) : FRAG;
+        d += compress_fragment(src + off, m, d);
+    }
+    if (n == 0) {} // preamble alone encodes the empty block
+    return (size_t)(d - dst);
+}
+
+// ----------------------------------------------------------- decompressor --
+// returns decompressed size, or (size_t)-1 on corrupt input, or required
+// size if dst_cap too small (call with dst=NULL to query via preamble).
+
+long long mt_snappy_uncompressed_length(const uint8_t* src, size_t n) {
+    uint64_t v = 0; int shift = 0; size_t i = 0;
+    while (i < n && shift < 64) {
+        uint8_t b = src[i++];
+        v |= (uint64_t)(b & 0x7F) << shift;
+        if (!(b & 0x80)) return (long long)v;
+        shift += 7;
+    }
+    return -1;
+}
+
+long long mt_snappy_uncompress(const uint8_t* src, size_t n,
+                               uint8_t* dst, size_t dst_cap) {
+    // parse preamble
+    uint64_t want = 0; int shift = 0; size_t i = 0;
+    for (;;) {
+        if (i >= n || shift >= 64) return -1;
+        uint8_t b = src[i++];
+        want |= (uint64_t)(b & 0x7F) << shift;
+        if (!(b & 0x80)) break;
+        shift += 7;
+    }
+    if (want > dst_cap) return -1;
+    size_t o = 0;
+    while (i < n) {
+        uint8_t tag = src[i++];
+        uint32_t kind = tag & 3;
+        if (kind == 0) {                       // literal
+            size_t len = (tag >> 2) + 1;
+            if (len > 60) {
+                size_t nb = len - 60;          // 1..4 extra length bytes
+                if (i + nb > n) return -1;
+                len = 0;
+                for (size_t k = 0; k < nb; k++)
+                    len |= (size_t)src[i + k] << (8 * k);
+                len += 1;
+                i += nb;
+            }
+            if (i + len > n || o + len > want) return -1;
+            memcpy(dst + o, src + i, len);
+            i += len; o += len;
+        } else {
+            size_t len, offset;
+            if (kind == 1) {
+                len = ((tag >> 2) & 7) + 4;
+                if (i >= n) return -1;
+                offset = ((size_t)(tag >> 5) << 8) | src[i++];
+            } else if (kind == 2) {
+                len = (tag >> 2) + 1;
+                if (i + 2 > n) return -1;
+                offset = (size_t)src[i] | ((size_t)src[i + 1] << 8);
+                i += 2;
+            } else {
+                len = (tag >> 2) + 1;
+                if (i + 4 > n) return -1;
+                offset = (size_t)src[i] | ((size_t)src[i + 1] << 8) |
+                         ((size_t)src[i + 2] << 16) |
+                         ((size_t)src[i + 3] << 24);
+                i += 4;
+            }
+            if (offset == 0 || offset > o || o + len > want) return -1;
+            // overlapping copies must run byte-by-byte
+            for (size_t k = 0; k < len; k++) {
+                dst[o] = dst[o - offset];
+                o++;
+            }
+        }
+    }
+    if (o != want) return -1;
+    return (long long)o;
+}
+
+}  // extern "C"

@@ -107,6 +107,32 @@ def test_kms_roundtrip_and_context_binding():
         k.unseal_key(sealed, {"bucket": "b", "object": "other"})
 
 
+def test_kms_malformed_env_fails_loudly(monkeypatch):
+    monkeypatch.setenv(kms.MASTER_KEY_ENV, "not-a-valid-spec")
+    with pytest.raises(kms.KMSError):
+        kms.LocalKMS()
+    monkeypatch.setenv(kms.MASTER_KEY_ENV, "mykey:short-base64")
+    with pytest.raises(kms.KMSError):
+        kms.LocalKMS()
+
+
+def test_kms_master_key_persists_across_restart(tmp_path):
+    from minio_tpu.objectlayer.erasure_object import ErasureObjects as EO
+    from minio_tpu.storage.xl_storage import XLStorage as XS
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"kd{i}"
+        d.mkdir()
+        disks.append(XS(str(d)))
+    layer = EO(disks, parity=2, block_size=64 * 1024, backend="numpy")
+    k1 = kms.LocalKMS.from_env_or_store(layer)
+    ctx = {"bucket": "b", "object": "o"}
+    plain, sealed = k1.generate_key(ctx)
+    # "restart": a fresh instance reads the same persisted master key
+    k2 = kms.LocalKMS.from_env_or_store(layer)
+    assert k2.unseal_key(sealed, ctx) == plain
+
+
 def test_object_encryption_seal_unseal_ssec():
     client_key = bytes(32)
     headers = {
