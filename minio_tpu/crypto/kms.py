@@ -84,16 +84,25 @@ class LocalKMS:
         if spec:
             key_id, key = cls._parse_spec(spec)
             return cls(key_id, key)
+        from ..storage import errors as serrors
         from ..storage.xl_storage import SYS_DIR
-        try:
-            blobs, _ = layer._fanout(
-                lambda d: d.read_all(SYS_DIR, cls._STORE_PATH))
-            for b in blobs:
-                if b:
-                    key_id, key = cls._parse_spec(b.decode())
-                    return cls(key_id, key)
-        except Exception:  # noqa: BLE001 — no stored key yet
-            pass
+        blobs, errs = layer._fanout(
+            lambda d: d.read_all(SYS_DIR, cls._STORE_PATH))
+        for b in blobs:
+            if b:
+                # a stored-but-corrupt key must FAIL the boot, not be
+                # silently replaced — replacement orphans every existing
+                # SSE-S3/KMS object (KMSError propagates from _parse_spec)
+                key_id, key = cls._parse_spec(b.decode())
+                return cls(key_id, key)
+        hard = [e for e in errs
+                if e is not None and not isinstance(
+                    e, (serrors.FileNotFound, serrors.VolumeNotFound))]
+        if hard:
+            # could not READ the store: the key may exist on unreachable
+            # drives; minting a fresh one here would shadow it
+            raise KMSError(
+                f"cannot read KMS master key store: {hard[0]}")
         kms = cls("minio-tpu-auto-key", os.urandom(32))
         stored = (kms.key_id + ":" +
                   base64.b64encode(kms._master).decode()).encode()

@@ -171,6 +171,31 @@ _ERRORS = {
         "trying to copy an object to itself without changing the "
         "object's metadata, storage class, website redirect location or "
         "encryption attributes.", 400),
+    # S3 Select (cmd/api-errors.go select section)
+    "ParseSelectFailure": APIError(
+        "ParseSelectFailure", "The SQL expression contains an invalid "
+        "token or is otherwise not parseable.", 400),
+    "EvaluatorInvalidArguments": APIError(
+        "EvaluatorInvalidArguments", "Incorrect number of arguments in "
+        "the function call or invalid evaluation.", 400),
+    "InvalidExpressionType": APIError(
+        "InvalidExpressionType", "The ExpressionType is invalid. Only "
+        "SQL expressions are supported.", 400),
+    "InvalidDataSource": APIError(
+        "InvalidDataSource", "Invalid data source type. Only CSV and "
+        "JSON are supported.", 400),
+    "InvalidCompressionFormat": APIError(
+        "InvalidCompressionFormat", "The file is not in a supported "
+        "compression format. Only GZIP is supported.", 400),
+    "InvalidRequestParameter": APIError(
+        "InvalidRequestParameter", "The value of a parameter in "
+        "SelectRequest element is invalid.", 400),
+    "CSVParsingError": APIError(
+        "CSVParsingError", "Encountered an error parsing the CSV file. "
+        "Check the file and try again.", 400),
+    "JSONParsingError": APIError(
+        "JSONParsingError", "Encountered an error parsing the JSON file. "
+        "Check the file and try again.", 400),
 }
 
 

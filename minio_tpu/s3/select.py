@@ -1,0 +1,12 @@
+"""SelectObjectContent glue: the S3 handler's entry into minio_tpu.s3select
+(pkg/s3select.NewS3Select + Evaluate in the reference)."""
+
+from __future__ import annotations
+
+from ..s3select import SelectError, run_select  # noqa: F401
+
+
+def run(payload: bytes, data: bytes, content_type: str = "") -> bytes:
+    """Execute the SelectObjectContentRequest in `payload` against object
+    bytes `data`; returns the framed event-stream body."""
+    return run_select(payload, data)

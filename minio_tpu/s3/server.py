@@ -950,18 +950,34 @@ def _make_handler(srv: S3Server):
                 return False
 
         def _select_object(self, bucket, key, payload):
+            from . import select as s3select
+            _, data = self._fetch_plain(bucket, key)
             try:
-                from . import select as s3select
-            except ImportError as e:
-                raise S3Error("NotImplemented") from e
-            oi, data = srv.layer.get_object(bucket, key)
-            try:
-                out = s3select.run(payload, data,
-                                   content_type=oi.content_type)
+                out = s3select.run(payload, data)
             except s3select.SelectError as e:
                 raise S3Error(e.code) from e
             self._send(200, out,
                        content_type="application/octet-stream")
+
+        def _fetch_plain(self, bucket, key):
+            """Full object bytes after decryption (honoring SSE-C request
+            headers) and decompression — the decoded-object fetch shared
+            by Select and other whole-object consumers."""
+            from .. import compress as mtc
+            from ..crypto import sse as csse
+            oi = srv.layer.get_object_info(bucket, key)
+            if csse.is_encrypted(oi.user_defined):
+                enc = csse.ObjectEncryption.open(
+                    oi.user_defined, bucket, key, self.headers, srv.kms)
+                data = csse.decrypt_object_range(
+                    enc, oi.user_defined, oi.size,
+                    lambda o, n: srv.layer.get_object(
+                        bucket, key, o, n)[1], 0, -1, oi.parts)
+            else:
+                _, data = srv.layer.get_object(bucket, key)
+            if mtc.META_COMPRESSION in oi.user_defined:
+                data = mtc.decompress_stream(data)
+            return oi, data
 
         def _check_quota(self, bucket: str, nbytes: int) -> None:
             """Hard-quota admission (cmd/bucket-quota.go); needs the
@@ -1536,13 +1552,13 @@ def _actual_size(oi) -> int:
     """Client-visible size (GetActualSize, cmd/object-api-utils.go): the
     pre-compression size for compressed objects, the DARE-plaintext size
     for encrypted-only objects, else the stored size."""
-    raw = oi.user_defined.get("x-minio-internal-actual-size")
+    from ..crypto import sse as csse
+    raw = oi.user_defined.get(csse.META_ACTUAL_SIZE)
     if raw:
         try:
             return int(raw)
         except ValueError:
             pass
-    from ..crypto import sse as csse
     if csse.is_encrypted(oi.user_defined):
         try:
             return csse.decrypted_size(oi.user_defined, oi.size, oi.parts)

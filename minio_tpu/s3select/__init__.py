@@ -1,0 +1,147 @@
+"""S3 Select — SQL over CSV/JSON objects (pkg/s3select).
+
+Reference: `pkg/s3select/select.go:541` (NewS3Select), `:398` (Evaluate
+record loop), SQL engine under `pkg/s3select/sql/` (participle parser,
+aggregation, functions), response framing `pkg/s3select/message.go`.
+
+This package is the TPU build's equivalent: a hand-written SQL
+lexer/parser/evaluator (`sql.py`), CSV/JSON record readers (`records.py`),
+and AWS event-stream response framing (`message.py`).  `run_select` glues
+them: parse the SelectObjectContentRequest XML, stream records through
+the compiled query, frame the output.
+"""
+
+from __future__ import annotations
+
+import gzip
+import xml.etree.ElementTree as ET
+
+from . import message, records, sql
+
+
+class SelectError(Exception):
+    """Carries an S3 error code for the handler."""
+
+    def __init__(self, code: str, msg: str = ""):
+        super().__init__(msg or code)
+        self.code = code
+
+
+def _text(el, name: str, default: str = "") -> str:
+    if el is None:
+        return default
+    v = el.findtext(name)
+    return default if v is None else v
+
+
+class SelectRequest:
+    """Parsed SelectObjectContentRequest (pkg/s3select/select.go:114)."""
+
+    def __init__(self, expression: str, input_format: str,
+                 input_opts: dict, output_format: str, output_opts: dict,
+                 compression: str):
+        self.expression = expression
+        self.input_format = input_format      # "CSV" | "JSON"
+        self.input_opts = input_opts
+        self.output_format = output_format    # "CSV" | "JSON"
+        self.output_opts = output_opts
+        self.compression = compression        # "NONE" | "GZIP"
+
+    @classmethod
+    def parse(cls, payload: bytes) -> "SelectRequest":
+        try:
+            root = ET.fromstring(payload)
+        except ET.ParseError as e:
+            raise SelectError("MalformedXML") from e
+        # strip namespaces
+        for el in root.iter():
+            if "}" in el.tag:
+                el.tag = el.tag.split("}", 1)[1]
+        expr = root.findtext("Expression") or ""
+        etype = root.findtext("ExpressionType") or "SQL"
+        if etype.upper() != "SQL" or not expr.strip():
+            raise SelectError("InvalidExpressionType")
+        inser = root.find("InputSerialization")
+        if inser is None:
+            raise SelectError("InvalidRequestParameter",
+                              "InputSerialization required")
+        compression = _text(inser, "CompressionType", "NONE").upper()
+        if compression not in ("NONE", "GZIP"):
+            raise SelectError("InvalidCompressionFormat")
+        csv_el, json_el = inser.find("CSV"), inser.find("JSON")
+        if csv_el is not None:
+            fmt = "CSV"
+            opts = {
+                "header": _text(csv_el, "FileHeaderInfo", "NONE").upper(),
+                "field_delim": _text(csv_el, "FieldDelimiter", ","),
+                "record_delim": _text(csv_el, "RecordDelimiter", "\n"),
+                "quote": _text(csv_el, "QuoteCharacter", '"'),
+                "comment": _text(csv_el, "Comments", ""),
+            }
+        elif json_el is not None:
+            fmt = "JSON"
+            opts = {"type": _text(json_el, "Type", "LINES").upper()}
+        else:
+            raise SelectError("InvalidDataSource")
+        outser = root.find("OutputSerialization")
+        ocsv = outser.find("CSV") if outser is not None else None
+        ojson = outser.find("JSON") if outser is not None else None
+        if ojson is not None:
+            ofmt, oopts = "JSON", {
+                "record_delim": _text(ojson, "RecordDelimiter", "\n")}
+        else:
+            ofmt, oopts = "CSV", {
+                "field_delim": _text(ocsv, "FieldDelimiter", ","),
+                "record_delim": _text(ocsv, "RecordDelimiter", "\n"),
+                "quote": _text(ocsv, "QuoteCharacter", '"'),
+            }
+        return cls(expr, fmt, opts, ofmt, oopts, compression)
+
+
+def run_select(payload: bytes, data: bytes) -> bytes:
+    """Execute a SelectObjectContentRequest against object bytes; returns
+    the framed event-stream response body."""
+    req = SelectRequest.parse(payload)
+    if req.compression == "GZIP":
+        try:
+            data = gzip.decompress(data)
+        except (OSError, EOFError) as e:   # EOFError: truncated stream
+            raise SelectError("InvalidCompressionFormat") from e
+    try:
+        query = sql.parse_query(req.expression)
+    except sql.SQLError as e:
+        raise SelectError("ParseSelectFailure", str(e)) from e
+    if req.input_format == "CSV":
+        reader = records.csv_records(data, req.input_opts)
+    else:
+        reader = records.json_records(data, req.input_opts)
+
+    bytes_scanned = len(data)
+    out_payload = bytearray()
+    returned = 0
+    try:
+        rows = sql.execute(query, reader)
+        for row in rows:
+            if req.output_format == "JSON":
+                rec = records.to_json_record(row, req.output_opts)
+            else:
+                rec = records.to_csv_record(row, req.output_opts)
+            out_payload += rec
+            returned += len(rec)
+    except sql.SQLError as e:
+        raise SelectError("EvaluatorInvalidArguments", str(e)) from e
+    except (ValueError, TypeError, KeyError) as e:
+        # reader parse failures surface mid-iteration (generators):
+        # malformed input is a 400 parse error, never a 500
+        code = "JSONParsingError" if req.input_format == "JSON" \
+            else "CSVParsingError"
+        raise SelectError(code, str(e)) from e
+
+    frames = bytearray()
+    # chunk Records payload into <=1 MiB events (message.go maxRecordSize)
+    CHUNK = 1 << 20
+    for off in range(0, len(out_payload), CHUNK):
+        frames += message.records_event(bytes(out_payload[off:off + CHUNK]))
+    frames += message.stats_event(bytes_scanned, bytes_scanned, returned)
+    frames += message.end_event()
+    return bytes(frames)

@@ -1,0 +1,109 @@
+"""Record readers/writers for S3 Select (pkg/s3select/csv/, json/).
+
+CSV input honors FileHeaderInfo USE/IGNORE/NONE, custom delimiters and
+quotes (pkg/s3select/csv/args.go); JSON input handles LINES and DOCUMENT
+types (pkg/s3select/json/args.go).  Positional columns are always
+available as _1.._N, matching the reference's column addressing.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import io
+import json as _json
+from typing import Iterator
+
+
+def csv_records(data: bytes, opts: dict) -> Iterator[dict]:
+    text = data.decode("utf-8", errors="replace")
+    rd = opts.get("record_delim", "\n")
+    if rd not in ("\n", "\r\n"):
+        text = text.replace(rd, "\n")
+    comment = opts.get("comment") or None
+    reader = _csv.reader(
+        io.StringIO(text),
+        delimiter=opts.get("field_delim", ",") or ",",
+        quotechar=opts.get("quote", '"') or '"')
+    header_mode = opts.get("header", "NONE")
+    headers: list[str] | None = None
+    for i, fields in enumerate(reader):
+        if not fields:
+            continue
+        if comment and fields[0].startswith(comment):
+            continue
+        if i == 0 and header_mode == "USE":
+            headers = [h.strip() for h in fields]
+            continue
+        if i == 0 and header_mode == "IGNORE":
+            continue
+        # named keys only when headers exist — SELECT * must not emit
+        # columns twice; _N positional addressing is resolved by the SQL
+        # evaluator's index fallback
+        row: dict = {}
+        for j, v in enumerate(fields):
+            if headers and j < len(headers):
+                row[headers[j]] = v
+            else:
+                row[f"_{j + 1}"] = v
+        yield row
+
+
+def json_records(data: bytes, opts: dict) -> Iterator[dict]:
+    jtype = opts.get("type", "LINES")
+    text = data.decode("utf-8", errors="replace")
+    if jtype == "LINES":
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            yield _wrap(_json.loads(line))
+    else:  # DOCUMENT: one value, or concatenated values
+        dec = _json.JSONDecoder()
+        i, n = 0, len(text)
+        while i < n:
+            while i < n and text[i].isspace():
+                i += 1
+            if i >= n:
+                break
+            obj, end = dec.raw_decode(text, i)
+            i = end
+            if isinstance(obj, list):
+                for item in obj:
+                    yield _wrap(item)
+            else:
+                yield _wrap(obj)
+
+
+def _wrap(obj) -> dict:
+    if isinstance(obj, dict):
+        return obj
+    return {"_1": obj}
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float) and v.is_integer():
+        return str(int(v))
+    return str(v)
+
+
+def to_csv_record(row: dict, opts: dict) -> bytes:
+    delim = opts.get("field_delim", ",") or ","
+    quote = opts.get("quote", '"') or '"'
+    rd = opts.get("record_delim", "\n")
+    fields = []
+    for v in row.values():
+        s = _fmt(v)
+        if delim in s or quote in s or "\n" in s or "\r" in s:
+            s = quote + s.replace(quote, quote + quote) + quote
+        fields.append(s)
+    return (delim.join(fields) + rd).encode()
+
+
+def to_json_record(row: dict, opts: dict) -> bytes:
+    rd = opts.get("record_delim", "\n")
+    clean = {k: v for k, v in row.items()}
+    return (_json.dumps(clean, default=str) + rd).encode()
