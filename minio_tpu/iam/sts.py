@@ -1,0 +1,91 @@
+"""STS — temporary, expiring credentials (cmd/sts-handlers.go).
+
+AssumeRole mints a (access key, secret key, session token) triple bound
+to the authenticated parent user; the session token is an HS256 JWT
+signed with the root secret carrying the temp access key, parent, expiry
+and an optional inline session policy (cmd/sts-handlers.go
+AssumeRoleHandler; token minting cmd/auth-handler.go getSessionToken).
+Requests made with temp credentials carry the token in
+``x-amz-security-token`` and are authorized as the parent, intersected
+with the session policy when present.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+import secrets as pysecrets
+from dataclasses import dataclass
+
+MIN_DURATION_S = 900                  # AWS bounds (sts-handlers.go)
+MAX_DURATION_S = 7 * 24 * 3600
+DEFAULT_DURATION_S = 3600
+
+
+class STSError(Exception):
+    def __init__(self, code: str, msg: str = ""):
+        super().__init__(msg or code)
+        self.code = code
+
+
+@dataclass
+class TempCredentials:
+    access_key: str
+    secret_key: str
+    session_token: str
+    expiration: int                    # unix seconds
+    parent_user: str
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _b64url_dec(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def sign_token(claims: dict, secret: str) -> str:
+    header = _b64url(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    body = _b64url(json.dumps(claims, separators=(",", ":")).encode())
+    mac = hmac.new(secret.encode(), f"{header}.{body}".encode(),
+                   hashlib.sha256).digest()
+    return f"{header}.{body}.{_b64url(mac)}"
+
+
+def verify_token(token: str, secret: str) -> dict:
+    try:
+        header, body, sig = token.split(".")
+    except ValueError as e:
+        raise STSError("InvalidToken", "malformed session token") from e
+    mac = hmac.new(secret.encode(), f"{header}.{body}".encode(),
+                   hashlib.sha256).digest()
+    if not hmac.compare_digest(_b64url(mac), sig):
+        raise STSError("InvalidToken", "bad token signature")
+    claims = json.loads(_b64url_dec(body))
+    if claims.get("exp", 0) < time.time():
+        raise STSError("ExpiredToken")
+    return claims
+
+
+def mint(parent_access_key: str, root_secret: str,
+         duration_s: int = DEFAULT_DURATION_S,
+         session_policy: str | None = None) -> TempCredentials:
+    """Create the credential triple (cmd/auth-handler.go GetNewCredentials
+    analog: access keys are 20 chars, secrets 40)."""
+    if not MIN_DURATION_S <= duration_s <= MAX_DURATION_S:
+        raise STSError("InvalidParameterValue",
+                       f"DurationSeconds must be in "
+                       f"[{MIN_DURATION_S}, {MAX_DURATION_S}]")
+    ak = "STS" + pysecrets.token_hex(9).upper()[:17]
+    sk = pysecrets.token_urlsafe(30)[:40]
+    exp = int(time.time()) + duration_s
+    claims = {"accessKey": ak, "parent": parent_access_key, "exp": exp}
+    if session_policy:
+        # policy documents can be large; token stays opaque to clients
+        claims["sessionPolicy"] = _b64url(session_policy.encode())
+    token = sign_token(claims, root_secret)
+    return TempCredentials(ak, sk, token, exp, parent_access_key)

@@ -39,18 +39,25 @@ class UserIdentity:
     status: str = "enabled"             # enabled | disabled
     policies: list[str] = field(default_factory=list)
     groups: list[str] = field(default_factory=list)
-    parent_user: str = ""               # set for service accounts
+    parent_user: str = ""               # set for service accounts + STS
+    expiration: int = 0                 # unix s; 0 = permanent (STS temp)
+    session_policy: str = ""            # inline policy JSON (STS temp)
+
+    def expired(self) -> bool:
+        import time
+        return self.expiration != 0 and self.expiration < time.time()
 
     def to_dict(self) -> dict:
         return {"ak": self.access_key, "sk": self.secret_key,
                 "status": self.status, "policies": self.policies,
-                "groups": self.groups, "parent": self.parent_user}
+                "groups": self.groups, "parent": self.parent_user,
+                "exp": self.expiration, "spol": self.session_policy}
 
     @classmethod
     def from_dict(cls, d: dict) -> "UserIdentity":
         return cls(d["ak"], d["sk"], d.get("status", "enabled"),
                    list(d.get("policies", [])), list(d.get("groups", [])),
-                   d.get("parent", ""))
+                   d.get("parent", ""), d.get("exp", 0), d.get("spol", ""))
 
 
 class IAMSys:
@@ -170,6 +177,51 @@ class IAMSys:
         self._save()
         return sa
 
+    # -- STS temp credentials (cmd/sts-handlers.go) ------------------------
+
+    def assume_role(self, parent_access_key: str,
+                    duration_s: int | None = None,
+                    session_policy: str | None = None):
+        """Mint expiring credentials authorized as the parent, optionally
+        restricted by an inline session policy."""
+        from . import sts
+        parent = self.get_user(parent_access_key)   # NoSuchUser on miss
+        if parent.parent_user and parent.expiration:
+            # chaining STS from STS creds is refused (AWS does the same)
+            raise sts.STSError("AccessDenied",
+                               "cannot AssumeRole with temporary "
+                               "credentials")
+        if session_policy:
+            # must be a parseable policy document
+            try:
+                iampolicy.Policy.from_json(session_policy)
+            except Exception as e:  # noqa: BLE001
+                raise sts.STSError("MalformedPolicyDocument",
+                                   str(e)) from e
+        creds = sts.mint(
+            parent.access_key, self.root.secret_key,
+            sts.DEFAULT_DURATION_S if duration_s is None else duration_s,
+            session_policy)
+        self.purge_expired()        # each mint sweeps dead temp creds
+        with self._mu:
+            self._users[creds.access_key] = UserIdentity(
+                creds.access_key, creds.secret_key,
+                parent_user=parent.access_key,
+                expiration=creds.expiration,
+                session_policy=session_policy or "")
+        self._save()
+        return creds
+
+    def purge_expired(self) -> int:
+        """Drop expired temp credentials; returns the number removed."""
+        with self._mu:
+            dead = [k for k, u in self._users.items() if u.expired()]
+            for k in dead:
+                del self._users[k]
+        if dead:
+            self._save()
+        return len(dead)
+
     # -- policies ----------------------------------------------------------
 
     def set_policy(self, name: str, pol: iampolicy.Policy) -> None:
@@ -223,12 +275,13 @@ class IAMSys:
     # -- auth surface (cmd/auth-handler.go) --------------------------------
 
     def lookup_secret(self, access_key: str) -> Optional[str]:
-        """SigV4 credential lookup; disabled users don't authenticate."""
+        """SigV4 credential lookup; disabled users and expired temp
+        credentials don't authenticate."""
         with self._mu:
             if access_key == self.root.access_key:
                 return self.root.secret_key
             u = self._users.get(access_key)
-            if u is None or u.status != "enabled":
+            if u is None or u.status != "enabled" or u.expired():
                 return None
             return u.secret_key
 
@@ -240,12 +293,39 @@ class IAMSys:
             if access_key == self.root.access_key:
                 return True             # root bypasses policy
             u = self._users.get(access_key)
-            if u is None or u.status != "enabled":
+            if u is None or u.status != "enabled" or u.expired():
                 return False
-            names = list(u.policies)
-            for g in u.groups:
-                names.extend(self._group_policies.get(g, []))
-            pols = [self._policies[n] for n in names if n in self._policies]
+            session_pol = None
+            if u.session_policy:
+                # parse once per credential, not per request
+                session_pol = getattr(u, "_spol_cache", None)
+                if session_pol is None:
+                    session_pol = iampolicy.Policy.from_json(
+                        u.session_policy)
+                    u._spol_cache = session_pol
+            if u.parent_user and u.expiration:
+                # STS temp credential: authorized as the parent,
+                # intersected with the session policy below
+                if u.parent_user == self.root.access_key:
+                    names = None        # parent is root: allow-all base
+                else:
+                    p = self._users.get(u.parent_user)
+                    if p is None or p.status != "enabled":
+                        return False
+                    names = list(p.policies)
+                    for g in p.groups:
+                        names.extend(self._group_policies.get(g, []))
+            else:
+                names = list(u.policies)
+                for g in u.groups:
+                    names.extend(self._group_policies.get(g, []))
+            pols = [] if names is None else \
+                [self._policies[n] for n in names if n in self._policies]
+        if session_pol is not None and \
+                not session_pol.is_allowed(action, resource, context):
+            return False
+        if names is None:               # root-parented temp credential
+            return True
         if not pols:
             return False
         # deny anywhere wins across all attached policies

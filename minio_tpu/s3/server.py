@@ -179,6 +179,12 @@ def _make_handler(srv: S3Server):
             return self.rfile.read(n) if n else b""
 
         def _auth(self, path, query, payload: bytes) -> bytes:
+            self._query_token = query.get("X-Amz-Security-Token", [""])[0]
+            out = self._auth_inner(path, query, payload)
+            self._check_session_token()
+            return out
+
+        def _auth_inner(self, path, query, payload: bytes) -> bytes:
             """Authenticate; returns the effective payload (aws-chunked
             bodies are signature-verified per chunk and de-framed).  Sets
             self.access_key for authorization."""
@@ -303,6 +309,8 @@ def _make_handler(srv: S3Server):
                     # admin/metrics own this prefix; never an S3 bucket
                     raise S3Error("AccessDenied")
                 if not bucket:
+                    if self.command == "POST":
+                        return self._sts_api(payload)
                     return self._list_buckets()
                 if not _BUCKET_RE.match(bucket):
                     raise S3Error("InvalidBucketName")
@@ -314,6 +322,87 @@ def _make_handler(srv: S3Server):
 
         do_GET = do_PUT = do_HEAD = do_DELETE = do_POST = \
             lambda self: self._dispatch()
+
+        # -- STS (cmd/sts-handlers.go) -------------------------------------
+
+        STS_NS = "https://sts.amazonaws.com/doc/2011-06-15/"
+
+        def _sts_fail(self, code: str, msg: str = ""):
+            root = ET.Element("ErrorResponse", xmlns=self.STS_NS)
+            err = ET.SubElement(root, "Error")
+            ET.SubElement(err, "Type").text = "Sender"
+            ET.SubElement(err, "Code").text = code
+            ET.SubElement(err, "Message").text = msg or code
+            status = 403 if code in ("AccessDenied", "ExpiredToken") \
+                else 400
+            self._send(status, _xml(root))
+
+        def _sts_api(self, payload: bytes):
+            from ..iam import sts as _sts
+            form = {k: v[0] for k, v in urllib.parse.parse_qs(
+                payload.decode("utf-8", "replace"),
+                keep_blank_values=True).items()}
+            action = form.get("Action", "")
+            if action != "AssumeRole":
+                if action in ("AssumeRoleWithWebIdentity",
+                              "AssumeRoleWithLDAPIdentity",
+                              "AssumeRoleWithClientGrants"):
+                    return self._sts_fail(
+                        "NotImplemented",
+                        f"{action} requires an identity provider")
+                return self._sts_fail("InvalidAction", action)
+            if not self.access_key:
+                return self._sts_fail("AccessDenied",
+                                      "request must be signed")
+            try:
+                duration = int(form.get("DurationSeconds",
+                                        str(_sts.DEFAULT_DURATION_S)))
+            except ValueError:
+                return self._sts_fail("InvalidParameterValue",
+                                      "DurationSeconds")
+            policy = form.get("Policy") or None
+            try:
+                creds = srv.iam.assume_role(self.access_key, duration,
+                                            policy)
+            except _sts.STSError as e:
+                return self._sts_fail(e.code, str(e))
+            root = ET.Element("AssumeRoleResponse", xmlns=self.STS_NS)
+            result = ET.SubElement(root, "AssumeRoleResult")
+            ce = ET.SubElement(result, "Credentials")
+            ET.SubElement(ce, "AccessKeyId").text = creds.access_key
+            ET.SubElement(ce, "SecretAccessKey").text = creds.secret_key
+            ET.SubElement(ce, "SessionToken").text = creds.session_token
+            ET.SubElement(ce, "Expiration").text = \
+                datetime.datetime.fromtimestamp(
+                    creds.expiration, datetime.timezone.utc).strftime(
+                        "%Y-%m-%dT%H:%M:%SZ")
+            meta = ET.SubElement(root, "ResponseMetadata")
+            ET.SubElement(meta, "RequestId").text = uuid.uuid4().hex[:16]
+            self._send(200, _xml(root))
+
+        def _check_session_token(self):
+            """Temp credentials must present their session token on every
+            request (checkClaimsFromToken, cmd/auth-handler.go)."""
+            from ..iam import sts as _sts
+            if not self.access_key:
+                return
+            try:
+                u = srv.iam.get_user(self.access_key)
+            except Exception:  # noqa: BLE001 — root or unknown: no claims
+                return
+            if not (u.parent_user and u.expiration):
+                return
+            tok = self.headers.get("x-amz-security-token", "") or \
+                self._query_token
+            if not tok:
+                raise S3Error("AccessDenied")
+            try:
+                claims = _sts.verify_token(tok, srv.iam.root.secret_key)
+            except _sts.STSError as e:
+                raise S3Error("ExpiredToken" if e.code == "ExpiredToken"
+                              else "AccessDenied") from e
+            if claims.get("accessKey") != self.access_key:
+                raise S3Error("AccessDenied")
 
         # -- service / bucket APIs ----------------------------------------
 

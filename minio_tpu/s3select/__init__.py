@@ -102,6 +102,7 @@ def run_select(payload: bytes, data: bytes) -> bytes:
     """Execute a SelectObjectContentRequest against object bytes; returns
     the framed event-stream response body."""
     req = SelectRequest.parse(payload)
+    bytes_scanned = len(data)        # compressed bytes read from storage
     if req.compression == "GZIP":
         try:
             data = gzip.decompress(data)
@@ -116,7 +117,7 @@ def run_select(payload: bytes, data: bytes) -> bytes:
     else:
         reader = records.json_records(data, req.input_opts)
 
-    bytes_scanned = len(data)
+    bytes_processed = len(data)      # bytes after decompression
     out_payload = bytearray()
     returned = 0
     try:
@@ -142,6 +143,6 @@ def run_select(payload: bytes, data: bytes) -> bytes:
     CHUNK = 1 << 20
     for off in range(0, len(out_payload), CHUNK):
         frames += message.records_event(bytes(out_payload[off:off + CHUNK]))
-    frames += message.stats_event(bytes_scanned, bytes_scanned, returned)
+    frames += message.stats_event(bytes_scanned, bytes_processed, returned)
     frames += message.end_event()
     return bytes(frames)

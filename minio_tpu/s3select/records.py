@@ -26,16 +26,19 @@ def csv_records(data: bytes, opts: dict) -> Iterator[dict]:
         quotechar=opts.get("quote", '"') or '"')
     header_mode = opts.get("header", "NONE")
     headers: list[str] | None = None
-    for i, fields in enumerate(reader):
+    saw_first = False                # first NON-skipped row is the header
+    for fields in reader:
         if not fields:
             continue
         if comment and fields[0].startswith(comment):
             continue
-        if i == 0 and header_mode == "USE":
-            headers = [h.strip() for h in fields]
-            continue
-        if i == 0 and header_mode == "IGNORE":
-            continue
+        if not saw_first:
+            saw_first = True
+            if header_mode == "USE":
+                headers = [h.strip() for h in fields]
+                continue
+            if header_mode == "IGNORE":
+                continue
         # named keys only when headers exist — SELECT * must not emit
         # columns twice; _N positional addressing is resolved by the SQL
         # evaluator's index fallback

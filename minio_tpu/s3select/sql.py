@@ -469,10 +469,10 @@ def _compare(op: str, a, b) -> Optional[bool]:
     if a is None or b is None or a is _MISSING or b is _MISSING:
         return None
     na, nb = _num(a), _num(b)
-    if na is not None and nb is not None and not (
-            isinstance(a, str) and isinstance(b, str) and
-            na is None):
-        a, b = na, nb
+    if isinstance(a, str) and isinstance(b, str):
+        pass                      # string-vs-string stays textual
+    elif na is not None and nb is not None:
+        a, b = na, nb             # mixed string/number: numeric coercion
     elif isinstance(a, str) or isinstance(b, str):
         a, b = str(a), str(b)
     try:

@@ -176,6 +176,25 @@ def test_quoted_identifiers_and_strings():
 
 # -- record readers ---------------------------------------------------------
 
+def test_string_comparison_stays_textual():
+    # '0123' and '123' are different strings even though they coerce to
+    # the same number; mixed string/number still compares numerically
+    rows = [{"zip": "0123"}]
+    assert run_sql("SELECT zip FROM S3Object WHERE zip = '123'",
+                   rows) == []
+    assert run_sql("SELECT zip FROM S3Object WHERE zip = '0123'",
+                   rows) == [{"zip": "0123"}]
+    assert run_sql("SELECT zip FROM S3Object WHERE zip = 123",
+                   rows) == [{"zip": "0123"}]
+
+
+def test_csv_header_after_comment():
+    data = b"#generated by tool\nname,age\nalice,30\n"
+    rows = list(records.csv_records(
+        data, {"header": "USE", "comment": "#"}))
+    assert rows == [{"name": "alice", "age": "30"}]
+
+
 def test_csv_header_modes():
     rows = list(records.csv_records(CSV, {"header": "NONE"}))
     assert rows[0]["_1"] == "name"          # header row is data

@@ -1,0 +1,196 @@
+"""STS tests: token minting/verification and AssumeRole over the S3 API.
+
+Mirrors cmd/sts-handlers.go semantics: signed AssumeRole POST to the
+service root, temp credentials bound to a session token, session-policy
+intersection, expiry enforcement.
+"""
+
+import json
+import time
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from minio_tpu.iam import sts
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.s3.client import S3Client, S3ClientError
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl_storage import XLStorage
+
+STS_NS = "{https://sts.amazonaws.com/doc/2011-06-15/}"
+
+
+# -- token layer ------------------------------------------------------------
+
+def test_token_roundtrip():
+    claims = {"accessKey": "AK", "parent": "root", "exp":
+              int(time.time()) + 100}
+    tok = sts.sign_token(claims, "secret")
+    assert sts.verify_token(tok, "secret")["accessKey"] == "AK"
+
+
+def test_token_tamper_and_expiry():
+    claims = {"accessKey": "AK", "exp": int(time.time()) + 100}
+    tok = sts.sign_token(claims, "secret")
+    with pytest.raises(sts.STSError):
+        sts.verify_token(tok, "wrong-secret")
+    with pytest.raises(sts.STSError):
+        sts.verify_token(tok[:-2] + "zz", "secret")
+    old = sts.sign_token({"accessKey": "AK",
+                          "exp": int(time.time()) - 1}, "secret")
+    with pytest.raises(sts.STSError) as ei:
+        sts.verify_token(old, "secret")
+    assert ei.value.code == "ExpiredToken"
+
+
+def test_mint_duration_bounds():
+    with pytest.raises(sts.STSError):
+        sts.mint("u", "s", duration_s=10)
+    with pytest.raises(sts.STSError):
+        sts.mint("u", "s", duration_s=10**9)
+
+
+# -- HTTP layer -------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("stsdrives")
+    disks = []
+    for i in range(4):
+        d = tmp / f"disk{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=256 * 1024,
+                           backend="numpy")
+    srv = S3Server(layer, access_key="rootkey", secret_key="rootsecret")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def root(server):
+    c = S3Client(server.endpoint, "rootkey", "rootsecret")
+    if not c.head_bucket("stsb"):
+        c.make_bucket("stsb")
+    return c
+
+
+def _assume_role(client, duration=3600, policy=None,
+                 expect=(200,)) -> dict:
+    body = f"Action=AssumeRole&Version=2011-06-15&DurationSeconds={duration}"
+    if policy:
+        import urllib.parse
+        body += "&Policy=" + urllib.parse.quote(policy)
+    r = client.request("POST", "/", body=body.encode(),
+                       headers={"Content-Type":
+                                "application/x-www-form-urlencoded"},
+                       expect=expect)
+    if r.status != 200:
+        return {}
+    root = ET.fromstring(r.body)
+    creds = root.find(f"{STS_NS}AssumeRoleResult/{STS_NS}Credentials")
+    return {
+        "ak": creds.findtext(f"{STS_NS}AccessKeyId"),
+        "sk": creds.findtext(f"{STS_NS}SecretAccessKey"),
+        "token": creds.findtext(f"{STS_NS}SessionToken"),
+        "exp": creds.findtext(f"{STS_NS}Expiration"),
+    }
+
+
+def test_assume_role_and_use(server, root):
+    creds = _assume_role(root)
+    assert creds["ak"].startswith("STS")
+    temp = S3Client(server.endpoint, creds["ak"], creds["sk"])
+    hdr = {"x-amz-security-token": creds["token"]}
+    temp.request("PUT", "/stsb/via-sts.txt", body=b"sts data",
+                 headers=hdr)
+    r = temp.request("GET", "/stsb/via-sts.txt", headers=hdr)
+    assert r.body == b"sts data"
+
+
+def test_temp_creds_require_token(server, root):
+    creds = _assume_role(root)
+    temp = S3Client(server.endpoint, creds["ak"], creds["sk"])
+    with pytest.raises(S3ClientError) as ei:
+        temp.request("PUT", "/stsb/no-token.txt", body=b"x")
+    assert ei.value.status == 403
+    # token for a DIFFERENT temp credential is rejected
+    other = _assume_role(root)
+    with pytest.raises(S3ClientError):
+        temp.request("PUT", "/stsb/wrong-token.txt", body=b"x",
+                     headers={"x-amz-security-token": other["token"]})
+
+
+def test_session_policy_restricts(server, root):
+    root.put_object("stsb", "readable.txt", b"read me")
+    policy = json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [{"Effect": "Allow",
+                       "Action": ["s3:GetObject"],
+                       "Resource": ["arn:aws:s3:::stsb/*"]}]})
+    creds = _assume_role(root, policy=policy)
+    temp = S3Client(server.endpoint, creds["ak"], creds["sk"])
+    hdr = {"x-amz-security-token": creds["token"]}
+    r = temp.request("GET", "/stsb/readable.txt", headers=hdr)
+    assert r.body == b"read me"
+    with pytest.raises(S3ClientError) as ei:
+        temp.request("PUT", "/stsb/denied.txt", body=b"x", headers=hdr)
+    assert ei.value.code == "AccessDenied"
+
+
+def test_sts_chaining_refused(server, root):
+    creds = _assume_role(root)
+    temp = S3Client(server.endpoint, creds["ak"], creds["sk"])
+    body = b"Action=AssumeRole&Version=2011-06-15"
+    r = temp.request("POST", "/", body=body,
+                     headers={"x-amz-security-token": creds["token"]},
+                     expect=(400, 403))
+    assert b"AccessDenied" in r.body
+
+
+def test_bad_duration_rejected(root):
+    for dur in (b"10", b"0"):
+        r = root.request(
+            "POST", "/", body=b"Action=AssumeRole&DurationSeconds=" + dur,
+            expect=(400,))
+        assert b"InvalidParameterValue" in r.body
+
+
+def test_unknown_action(root):
+    r = root.request("POST", "/", body=b"Action=GetFederationToken",
+                     expect=(400,))
+    assert b"InvalidAction" in r.body
+
+
+def test_web_identity_not_implemented(root):
+    r = root.request("POST", "/",
+                     body=b"Action=AssumeRoleWithWebIdentity",
+                     expect=(400,))
+    assert b"NotImplemented" in r.body
+
+
+def test_non_root_parent_scoping(server, root):
+    """Temp creds from a non-root user carry the parent's policy scope."""
+    server.iam.add_user("alice", "alicesecret123", policies=["readonly"])
+    alice = S3Client(server.endpoint, "alice", "alicesecret123")
+    creds = _assume_role(alice)
+    temp = S3Client(server.endpoint, creds["ak"], creds["sk"])
+    hdr = {"x-amz-security-token": creds["token"]}
+    root.put_object("stsb", "shared.txt", b"shared")
+    r = temp.request("GET", "/stsb/shared.txt", headers=hdr)
+    assert r.body == b"shared"
+    with pytest.raises(S3ClientError):   # readonly parent: PUT denied
+        temp.request("PUT", "/stsb/nope.txt", body=b"x", headers=hdr)
+
+
+def test_expired_temp_creds_rejected(server, root):
+    creds = _assume_role(root, duration=900)
+    u = server.iam.get_user(creds["ak"])
+    u.expiration = int(time.time()) - 10      # force-expire
+    temp = S3Client(server.endpoint, creds["ak"], creds["sk"])
+    with pytest.raises(S3ClientError) as ei:
+        temp.request("GET", "/stsb/via-sts.txt",
+                     headers={"x-amz-security-token": creds["token"]})
+    assert ei.value.status == 403
+    assert server.iam.purge_expired() >= 1
