@@ -83,9 +83,9 @@ def mint(parent_access_key: str, root_secret: str,
     ak = "STS" + pysecrets.token_hex(9).upper()[:17]
     sk = pysecrets.token_urlsafe(30)[:40]
     exp = int(time.time()) + duration_s
+    # the session policy is stored server-side (UserIdentity.session_policy)
+    # and is deliberately NOT a token claim: clients resend the token on
+    # every request, so the token carries only identity + expiry
     claims = {"accessKey": ak, "parent": parent_access_key, "exp": exp}
-    if session_policy:
-        # policy documents can be large; token stays opaque to clients
-        claims["sessionPolicy"] = _b64url(session_policy.encode())
     token = sign_token(claims, root_secret)
     return TempCredentials(ak, sk, token, exp, parent_access_key)

@@ -202,8 +202,10 @@ class IAMSys:
             parent.access_key, self.root.secret_key,
             sts.DEFAULT_DURATION_S if duration_s is None else duration_s,
             session_policy)
-        self.purge_expired()        # each mint sweeps dead temp creds
         with self._mu:
+            # each mint sweeps dead temp creds; one lock, one persist
+            for k in [k for k, u in self._users.items() if u.expired()]:
+                del self._users[k]
             self._users[creds.access_key] = UserIdentity(
                 creds.access_key, creds.secret_key,
                 parent_user=parent.access_key,
@@ -284,6 +286,26 @@ class IAMSys:
             if u is None or u.status != "enabled" or u.expired():
                 return None
             return u.secret_key
+
+    def session_policy_allows(self, access_key: str, action: str,
+                              resource: str = "",
+                              context: dict | None = None) -> bool:
+        """The session-policy *intersection* alone: True unless access_key
+        is an STS credential whose session policy does not grant the
+        action.  Used when another grant source (e.g. a bucket policy
+        Allow) would authorize the request — temp credentials must still
+        be bounded by their session policy."""
+        with self._mu:
+            u = self._users.get(access_key)
+            if u is None or not u.session_policy:
+                return True
+            if u.status != "enabled" or u.expired():
+                return False
+            session_pol = getattr(u, "_spol_cache", None)
+            if session_pol is None:
+                session_pol = iampolicy.Policy.from_json(u.session_policy)
+                u._spol_cache = session_pol
+        return session_pol.is_allowed(action, resource, context)
 
     def is_allowed(self, access_key: str, action: str,
                    resource: str = "", context: dict | None = None) -> bool:

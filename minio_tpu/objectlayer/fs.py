@@ -1,0 +1,431 @@
+"""Standalone single-drive FS backend (cmd/fs-v1.go:53 FSObjects).
+
+The reference's non-erasure mode: objects live as plain files at
+``<root>/<bucket>/<key>``; per-object metadata (etag, content-type, user
+metadata, multipart part table) lives in an ``fs.json`` sidecar under
+``<root>/.minio.sys/buckets/<bucket>/<key>/fs.json``
+(cmd/fs-v1-metadata.go), and multipart uploads stage under
+``<root>/.minio.sys/multipart/<sha256(bucket/object)>/<uploadID>/``
+(cmd/fs-v1-multipart.go).  Writes go to ``.minio.sys/tmp`` first and
+commit with an atomic rename, mirroring the reference's fsCreateFile +
+fsRenameFile commit discipline.
+
+Versioning is not supported in FS mode (as in the reference, which
+returns NotImplemented); version ids are always the null version.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import threading
+import uuid
+from typing import Optional
+
+from ..storage.datatypes import now_ns
+from .interface import (BucketExists, BucketInfo, BucketNameInvalid,
+                        BucketNotEmpty, BucketNotFound, InvalidPart,
+                        InvalidPartOrder, InvalidRange, InvalidUploadID,
+                        ListObjectsInfo, ObjectInfo, ObjectLayer,
+                        ObjectNotFound, ObjectOptions, PutObjectOptions)
+from .multipart import (MAX_PARTS, MIN_PART_SIZE, MultipartInfo, PartInfo)
+
+SYS = ".minio.sys"
+
+
+class _FSSysDisk:
+    """Single-drive stand-in for StorageAPI's read_all/write_all, scoped
+    to system volumes (config/IAM/KMS persistence)."""
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def _p(self, volume: str, path: str) -> str:
+        return os.path.join(self.root, volume, path)
+
+    def read_all(self, volume: str, path: str) -> bytes:
+        from ..storage import errors as serrors
+        try:
+            with open(self._p(volume, path), "rb") as f:
+                return f.read()
+        except OSError:
+            raise serrors.FileNotFound(path) from None
+
+    def write_all(self, volume: str, path: str, data: bytes) -> None:
+        p = self._p(volume, path)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, p)
+
+
+def _valid_bucket(name: str) -> bool:
+    return (3 <= len(name) <= 63 and name != SYS
+            and all(c.islower() or c.isdigit() or c in "-." for c in name)
+            and not name.startswith("-"))
+
+
+class FSObjects(ObjectLayer):
+    """Single-drive, non-erasure ObjectLayer (cmd/fs-v1.go:53)."""
+
+    enforce_min_part_size = True
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(os.path.join(self.root, SYS, "tmp"), exist_ok=True)
+        os.makedirs(os.path.join(self.root, SYS, "buckets"), exist_ok=True)
+        os.makedirs(os.path.join(self.root, SYS, "multipart"), exist_ok=True)
+        self._lock = threading.RLock()
+
+    # -- path helpers -------------------------------------------------------
+
+    def _bucket_path(self, bucket: str) -> str:
+        return os.path.join(self.root, bucket)
+
+    def _obj_path(self, bucket: str, key: str) -> str:
+        p = os.path.normpath(os.path.join(self.root, bucket, key))
+        if not p.startswith(self._bucket_path(bucket)):
+            raise ObjectNotFound(key)
+        return p
+
+    def _meta_path(self, bucket: str, key: str) -> str:
+        return os.path.join(self.root, SYS, "buckets", bucket, key,
+                            "fs.json")
+
+    def _tmp_path(self) -> str:
+        return os.path.join(self.root, SYS, "tmp", uuid.uuid4().hex)
+
+    def _check_bucket(self, bucket: str) -> None:
+        if not os.path.isdir(self._bucket_path(bucket)):
+            raise BucketNotFound(bucket)
+
+    # -- buckets ------------------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        if not _valid_bucket(bucket):
+            raise BucketNameInvalid(bucket)
+        with self._lock:
+            if os.path.isdir(self._bucket_path(bucket)):
+                raise BucketExists(bucket)
+            os.makedirs(self._bucket_path(bucket))
+
+    def get_bucket_info(self, bucket: str) -> BucketInfo:
+        self._check_bucket(bucket)
+        st = os.stat(self._bucket_path(bucket))
+        return BucketInfo(bucket, int(st.st_ctime * 1e9))
+
+    def list_buckets(self) -> list[BucketInfo]:
+        out = []
+        for n in sorted(os.listdir(self.root)):
+            if n == SYS or not os.path.isdir(self._bucket_path(n)):
+                continue
+            out.append(self.get_bucket_info(n))
+        return out
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        self._check_bucket(bucket)
+        bp = self._bucket_path(bucket)
+        if not force and any(os.scandir(bp)):
+            raise BucketNotEmpty(bucket)
+        shutil.rmtree(bp)
+        shutil.rmtree(os.path.join(self.root, SYS, "buckets", bucket),
+                      ignore_errors=True)
+
+    # -- objects ------------------------------------------------------------
+
+    def put_object(self, bucket: str, object_name: str, data: bytes,
+                   opts: Optional[PutObjectOptions] = None) -> ObjectInfo:
+        opts = opts or PutObjectOptions()
+        self._check_bucket(bucket)
+        etag = hashlib.md5(data).hexdigest()
+        mod_time = opts.mod_time or now_ns()
+        tmp = self._tmp_path()
+        with open(tmp, "wb") as f:
+            f.write(data)
+        dst = self._obj_path(bucket, object_name)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        os.replace(tmp, dst)
+        meta = {"etag": etag, "mod_time": mod_time, "size": len(data),
+                "user_defined": dict(opts.user_defined), "parts": []}
+        self._write_meta(bucket, object_name, meta)
+        return self._info(bucket, object_name, meta)
+
+    def _write_meta(self, bucket: str, key: str, meta: dict) -> None:
+        mp = self._meta_path(bucket, key)
+        os.makedirs(os.path.dirname(mp), exist_ok=True)
+        tmp = self._tmp_path()
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, mp)
+
+    def _read_meta(self, bucket: str, key: str) -> dict:
+        try:
+            with open(self._meta_path(bucket, key)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            # object written out-of-band: synthesize metadata (the
+            # reference serves bare files with defaultFsJSON)
+            p = self._obj_path(bucket, key)
+            st = os.stat(p)
+            return {"etag": "", "mod_time": int(st.st_mtime * 1e9),
+                    "size": st.st_size, "user_defined": {}, "parts": []}
+
+    def _info(self, bucket: str, key: str, meta: dict) -> ObjectInfo:
+        ud = dict(meta.get("user_defined", {}))
+        return ObjectInfo(
+            bucket=bucket, name=key, mod_time=meta["mod_time"],
+            size=meta["size"], etag=meta.get("etag", ""),
+            version_id="", is_latest=True,
+            content_type=ud.get("content-type", ""),
+            user_defined=ud,
+            parts=[tuple(p) for p in meta.get("parts", [])])
+
+    def get_object_info(self, bucket: str, object_name: str,
+                        opts: Optional[ObjectOptions] = None) -> ObjectInfo:
+        self._check_bucket(bucket)
+        p = self._obj_path(bucket, object_name)
+        if not os.path.isfile(p):
+            raise ObjectNotFound(f"{bucket}/{object_name}")
+        return self._info(bucket, object_name,
+                          self._read_meta(bucket, object_name))
+
+    def get_object(self, bucket: str, object_name: str, offset: int = 0,
+                   length: int = -1,
+                   opts: Optional[ObjectOptions] = None
+                   ) -> tuple[ObjectInfo, bytes]:
+        oi = self.get_object_info(bucket, object_name, opts)
+        if offset < 0 or offset > oi.size:
+            raise InvalidRange(f"offset {offset}")
+        with open(self._obj_path(bucket, object_name), "rb") as f:
+            f.seek(offset)
+            data = f.read() if length < 0 else f.read(length)
+        return oi, data
+
+    def delete_object(self, bucket: str, object_name: str,
+                      opts: Optional[ObjectOptions] = None) -> ObjectInfo:
+        self._check_bucket(bucket)
+        p = self._obj_path(bucket, object_name)
+        try:
+            os.remove(p)
+        except FileNotFoundError:
+            pass  # S3 DELETE is idempotent
+        shutil.rmtree(os.path.dirname(self._meta_path(bucket, object_name)),
+                      ignore_errors=True)
+        # prune now-empty parent dirs up to the bucket root
+        d = os.path.dirname(p)
+        while d != self._bucket_path(bucket):
+            try:
+                os.rmdir(d)
+            except OSError:
+                break
+            d = os.path.dirname(d)
+        return ObjectInfo(bucket=bucket, name=object_name)
+
+    def put_object_metadata(self, bucket: str, object_name: str,
+                            version_id: Optional[str],
+                            updates: dict[str, str],
+                            removes: tuple[str, ...] = ()) -> ObjectInfo:
+        self.get_object_info(bucket, object_name)
+        meta = self._read_meta(bucket, object_name)
+        ud = meta.setdefault("user_defined", {})
+        for k in removes:
+            ud.pop(k, None)
+        ud.update(updates)
+        self._write_meta(bucket, object_name, meta)
+        return self._info(bucket, object_name, meta)
+
+    # -- listing ------------------------------------------------------------
+
+    def _walk(self, bucket: str) -> list[str]:
+        bp = self._bucket_path(bucket)
+        out = []
+        for dirpath, _dirs, files in os.walk(bp):
+            rel = os.path.relpath(dirpath, bp)
+            for f in files:
+                out.append(f if rel == "." else f"{rel}/{f}".replace(
+                    os.sep, "/"))
+        return sorted(out)
+
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000
+                     ) -> ListObjectsInfo:
+        self._check_bucket(bucket)
+        out = ListObjectsInfo()
+        prefixes: set[str] = set()
+        for name in self._walk(bucket):
+            if prefix and not name.startswith(prefix):
+                continue
+            if marker and name <= marker:
+                continue
+            if delimiter:
+                rest = name[len(prefix):]
+                if delimiter in rest:
+                    prefixes.add(prefix + rest.split(delimiter, 1)[0]
+                                 + delimiter)
+                    continue
+            out.objects.append(self._info(bucket, name,
+                                          self._read_meta(bucket, name)))
+            if len(out.objects) + len(prefixes) >= max_keys:
+                out.is_truncated = True
+                out.next_marker = name
+                break
+        out.prefixes = sorted(prefixes)
+        return out
+
+    def list_object_versions(self, bucket: str, prefix: str = ""):
+        """FS mode has no versions; each object is its own null version."""
+        return self.list_objects(bucket, prefix, max_keys=10**9).objects
+
+    # -- multipart (cmd/fs-v1-multipart.go) ----------------------------------
+
+    def _mp_dir(self, bucket: str, object_name: str, upload_id: str) -> str:
+        h = hashlib.sha256(f"{bucket}/{object_name}".encode()).hexdigest()
+        return os.path.join(self.root, SYS, "multipart", h, upload_id)
+
+    def new_multipart_upload(self, bucket: str, object_name: str,
+                             opts: Optional[PutObjectOptions] = None) -> str:
+        opts = opts or PutObjectOptions()
+        self._check_bucket(bucket)
+        upload_id = uuid.uuid4().hex
+        d = self._mp_dir(bucket, object_name, upload_id)
+        os.makedirs(d)
+        with open(os.path.join(d, "fs.json"), "w") as f:
+            json.dump({"bucket": bucket, "object": object_name,
+                       "user_defined": dict(opts.user_defined)}, f)
+        return upload_id
+
+    def _mp_meta(self, bucket: str, object_name: str, upload_id: str) -> dict:
+        d = self._mp_dir(bucket, object_name, upload_id)
+        try:
+            with open(os.path.join(d, "fs.json")) as f:
+                return json.load(f)
+        except OSError:
+            raise InvalidUploadID(upload_id) from None
+
+    def put_object_part(self, bucket: str, object_name: str, upload_id: str,
+                        part_number: int, data: bytes) -> PartInfo:
+        if not 1 <= part_number <= MAX_PARTS:
+            raise InvalidPart(f"part number {part_number}")
+        self._check_bucket(bucket)
+        self._mp_meta(bucket, object_name, upload_id)
+        d = self._mp_dir(bucket, object_name, upload_id)
+        etag = hashlib.md5(data).hexdigest()
+        tmp = self._tmp_path()
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, os.path.join(d, f"part.{part_number}"))
+        with open(os.path.join(d, f"part.{part_number}.meta"), "w") as f:
+            f.write(f"{etag}:{len(data)}")
+        return PartInfo(part_number, etag, len(data), len(data), now_ns())
+
+    def get_multipart_info(self, bucket: str, object_name: str,
+                           upload_id: str) -> MultipartInfo:
+        self._check_bucket(bucket)
+        meta = self._mp_meta(bucket, object_name, upload_id)
+        return MultipartInfo(bucket, object_name, upload_id,
+                             meta.get("user_defined", {}))
+
+    def list_object_parts(self, bucket: str, object_name: str,
+                          upload_id: str) -> list[PartInfo]:
+        self._check_bucket(bucket)
+        self._mp_meta(bucket, object_name, upload_id)
+        d = self._mp_dir(bucket, object_name, upload_id)
+        parts = []
+        for n in os.listdir(d):
+            if n.startswith("part.") and n.endswith(".meta"):
+                num = int(n[5:-5])
+                with open(os.path.join(d, n)) as f:
+                    etag, size = f.read().split(":")
+                parts.append(PartInfo(num, etag, int(size), int(size)))
+        return sorted(parts, key=lambda p: p.part_number)
+
+    def abort_multipart_upload(self, bucket: str, object_name: str,
+                               upload_id: str) -> None:
+        self._check_bucket(bucket)
+        self._mp_meta(bucket, object_name, upload_id)
+        shutil.rmtree(self._mp_dir(bucket, object_name, upload_id))
+
+    def list_multipart_uploads(self, bucket: str,
+                               prefix: str = "") -> list[MultipartInfo]:
+        self._check_bucket(bucket)
+        mproot = os.path.join(self.root, SYS, "multipart")
+        out = []
+        for h in os.listdir(mproot):
+            for uid in os.listdir(os.path.join(mproot, h)):
+                try:
+                    with open(os.path.join(mproot, h, uid, "fs.json")) as f:
+                        meta = json.load(f)
+                except OSError:
+                    continue
+                if meta.get("bucket") == bucket and \
+                        meta.get("object", "").startswith(prefix):
+                    out.append(MultipartInfo(bucket, meta["object"], uid,
+                                             meta.get("user_defined", {})))
+        return sorted(out, key=lambda m: m.object_name)
+
+    def complete_multipart_upload(self, bucket: str, object_name: str,
+                                  upload_id: str,
+                                  parts: list[tuple[int, str]]) -> ObjectInfo:
+        self._check_bucket(bucket)
+        self._mp_meta(bucket, object_name, upload_id)
+        if not parts:
+            raise InvalidPart("no parts specified")
+        if [p[0] for p in parts] != sorted({p[0] for p in parts}):
+            raise InvalidPartOrder("parts not in ascending order")
+        uploaded = {p.part_number: p
+                    for p in self.list_object_parts(bucket, object_name,
+                                                    upload_id)}
+        d = self._mp_dir(bucket, object_name, upload_id)
+        md5s = b""
+        total = 0
+        part_table = []
+        tmp = self._tmp_path()
+        with open(tmp, "wb") as out:
+            for i, (num, etag) in enumerate(parts):
+                got = uploaded.get(num)
+                if got is None or got.etag != etag.strip('"'):
+                    raise InvalidPart(f"part {num}")
+                if got.size < MIN_PART_SIZE and i != len(parts) - 1 \
+                        and self.enforce_min_part_size:
+                    raise InvalidPart(f"part {num} too small")
+                with open(os.path.join(d, f"part.{num}"), "rb") as f:
+                    out.write(f.read())
+                md5s += bytes.fromhex(got.etag)
+                total += got.size
+                part_table.append((num, got.size))
+        etag = hashlib.md5(md5s).hexdigest() + f"-{len(parts)}"
+        meta = self._mp_meta(bucket, object_name, upload_id)
+        dst = self._obj_path(bucket, object_name)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        os.replace(tmp, dst)
+        mod_time = now_ns()
+        doc = {"etag": etag, "mod_time": mod_time, "size": total,
+               "user_defined": meta.get("user_defined", {}),
+               "parts": part_table}
+        self._write_meta(bucket, object_name, doc)
+        shutil.rmtree(d)
+        return self._info(bucket, object_name, doc)
+
+    # -- system-volume shim --------------------------------------------------
+    # Subsystems (config, IAM, KMS) persist state through the object layer
+    # via `_fanout(lambda d: d.read_all/write_all(SYS_DIR, path))`; in FS
+    # mode there is exactly one "drive": the root directory itself.
+
+    def _fanout(self, fn):
+        try:
+            return [fn(_FSSysDisk(self.root))], [None]
+        except Exception as e:  # mirrored from ErasureObjects._fanout
+            return [None], [e]
+
+    # -- heal (no-op in FS mode, as in the reference) ------------------------
+
+    def heal_object(self, bucket, object_name, version_id=None, deep=False,
+                    dry_run=False):
+        return None
+
+    def heal_bucket(self, bucket: str) -> int:
+        self._check_bucket(bucket)
+        return 0

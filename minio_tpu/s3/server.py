@@ -245,7 +245,12 @@ def _make_handler(srv: S3Server):
                 if verdict is False:
                     raise S3Error("AccessDenied")
                 if verdict is True:
-                    return
+                    # a bucket-policy Allow still intersects with an STS
+                    # session policy — temp creds never exceed their bound
+                    if srv.iam.session_policy_allows(self.access_key,
+                                                     action, resource):
+                        return
+                    raise S3Error("AccessDenied")
             if not self.access_key or \
                     not srv.iam.is_allowed(self.access_key, action,
                                            resource):

@@ -1,0 +1,147 @@
+"""FS standalone backend tests (mirrors cmd/fs-v1_test.go and the
+backend-generic suite semantics of cmd/object_api_suite_test.go)."""
+
+import pytest
+
+from minio_tpu.objectlayer import interface as ol
+from minio_tpu.objectlayer.fs import FSObjects
+
+
+@pytest.fixture
+def fs(tmp_path):
+    return FSObjects(str(tmp_path))
+
+
+def test_bucket_lifecycle(fs):
+    fs.make_bucket("bkt")
+    with pytest.raises(ol.BucketExists):
+        fs.make_bucket("bkt")
+    with pytest.raises(ol.BucketNameInvalid):
+        fs.make_bucket("UPPER")
+    assert [b.name for b in fs.list_buckets()] == ["bkt"]
+    fs.put_object("bkt", "x", b"1")
+    with pytest.raises(ol.BucketNotEmpty):
+        fs.delete_bucket("bkt")
+    fs.delete_bucket("bkt", force=True)
+    with pytest.raises(ol.BucketNotFound):
+        fs.get_bucket_info("bkt")
+
+
+def test_put_get_roundtrip(fs):
+    fs.make_bucket("bbb")
+    payload = b"hello fs world" * 100
+    oi = fs.put_object("bbb", "dir/key.txt", payload,
+                       ol.PutObjectOptions(user_defined={"x-amz-meta-a": "1"}))
+    assert oi.size == len(payload)
+    got, data = fs.get_object("bbb", "dir/key.txt")
+    assert data == payload
+    assert got.etag == oi.etag
+    assert got.user_defined["x-amz-meta-a"] == "1"
+    # range read
+    _, part = fs.get_object("bbb", "dir/key.txt", offset=5, length=10)
+    assert part == payload[5:15]
+    with pytest.raises(ol.ObjectNotFound):
+        fs.get_object("bbb", "nope")
+
+
+def test_delete_prunes_dirs(fs):
+    fs.make_bucket("bbb")
+    fs.put_object("bbb", "a/b/c/k", b"x")
+    fs.delete_object("bbb", "a/b/c/k")
+    assert fs.list_objects("bbb").objects == []
+    # idempotent
+    fs.delete_object("bbb", "a/b/c/k")
+
+
+def test_list_objects_delimiter(fs):
+    fs.make_bucket("bbb")
+    for k in ["a/1", "a/2", "b/1", "top"]:
+        fs.put_object("bbb", k, b"d")
+    res = fs.list_objects("bbb", delimiter="/")
+    assert res.prefixes == ["a/", "b/"]
+    assert [o.name for o in res.objects] == ["top"]
+    res = fs.list_objects("bbb", prefix="a/")
+    assert [o.name for o in res.objects] == ["a/1", "a/2"]
+    # pagination
+    res = fs.list_objects("bbb", max_keys=2)
+    assert res.is_truncated
+    res2 = fs.list_objects("bbb", marker=res.next_marker)
+    assert [o.name for o in res2.objects] == ["b/1", "top"]
+
+
+def test_metadata_update(fs):
+    fs.make_bucket("bbb")
+    fs.put_object("bbb", "k", b"z",
+                  ol.PutObjectOptions(user_defined={"old": "1", "keep": "2"}))
+    oi = fs.put_object_metadata("bbb", "k", None, {"new": "3"}, removes=("old",))
+    assert oi.user_defined == {"keep": "2", "new": "3"}
+
+
+def test_multipart_roundtrip(fs):
+    fs.make_bucket("bbb")
+    uid = fs.new_multipart_upload("bbb", "big",
+                                  ol.PutObjectOptions(user_defined={"m": "v"}))
+    assert fs.get_multipart_info("bbb", "big", uid).user_defined == {"m": "v"}
+    p1 = fs.put_object_part("bbb", "big", uid, 1, b"A" * (5 << 20))
+    p2 = fs.put_object_part("bbb", "big", uid, 2, b"B" * 100)
+    assert [p.part_number for p in
+            fs.list_object_parts("bbb", "big", uid)] == [1, 2]
+    assert len(fs.list_multipart_uploads("bbb")) == 1
+    oi = fs.complete_multipart_upload("bbb", "big",
+                                      uid, [(1, p1.etag), (2, p2.etag)])
+    assert oi.etag.endswith("-2")
+    assert oi.parts == [(1, 5 << 20), (2, 100)]
+    _, data = fs.get_object("bbb", "big")
+    assert data == b"A" * (5 << 20) + b"B" * 100
+    with pytest.raises(ol.InvalidUploadID):
+        fs.list_object_parts("bbb", "big", uid)
+
+
+def test_multipart_errors(fs):
+    fs.make_bucket("bbb")
+    uid = fs.new_multipart_upload("bbb", "k")
+    p1 = fs.put_object_part("bbb", "k", uid, 1, b"x")
+    with pytest.raises(ol.InvalidPartOrder):
+        fs.complete_multipart_upload("bbb", "k", uid,
+                                     [(2, p1.etag), (1, p1.etag)])
+    with pytest.raises(ol.InvalidPart):
+        fs.complete_multipart_upload("bbb", "k", uid, [(1, "badetag")])
+    fs.abort_multipart_upload("bbb", "k", uid)
+    with pytest.raises(ol.InvalidUploadID):
+        fs.put_object_part("bbb", "k", uid, 2, b"y")
+
+
+def test_bare_file_served(fs, tmp_path):
+    """Objects written out-of-band get synthesized metadata
+    (defaultFsJSON behavior)."""
+    fs.make_bucket("bbb")
+    (tmp_path / "bbb" / "raw.bin").write_bytes(b"raw")
+    oi, data = fs.get_object("bbb", "raw.bin")
+    assert data == b"raw"
+    assert oi.size == 3
+
+
+def test_path_traversal_blocked(fs):
+    fs.make_bucket("bbb")
+    with pytest.raises(ol.ObjectLayerError):
+        fs.get_object("bbb", "../../etc/passwd")
+
+
+def test_s3_server_on_fs(fs, tmp_path):
+    """The S3 front end runs unchanged on the FS backend
+    (ExecObjectLayerTest's both-backends discipline)."""
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+
+    srv = S3Server(fs, port=0)
+    srv.start()
+    try:
+        c = S3Client(srv.endpoint, "minioadmin", "minioadmin")
+        c.make_bucket("fsb")
+        c.put_object("fsb", "k", b"via-s3")
+        assert c.get_object("fsb", "k").body == b"via-s3"
+        objs, _prefixes = c.list_objects("fsb")
+        assert [o["key"] for o in objs] == ["k"]
+        c.delete_object("fsb", "k")
+    finally:
+        srv.stop()

@@ -139,6 +139,31 @@ def test_session_policy_restricts(server, root):
     assert ei.value.code == "AccessDenied"
 
 
+def test_session_policy_not_bypassed_by_bucket_policy(server, root):
+    """A bucket-policy Allow must not lift a temp credential above its
+    session policy (intersection semantics)."""
+    root.put_object("stsb", "sp.txt", b"data")
+    bucket_policy = json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [{"Effect": "Allow", "Principal": {"AWS": ["*"]},
+                       "Action": ["s3:PutObject", "s3:GetObject"],
+                       "Resource": ["arn:aws:s3:::stsb/*"]}]})
+    root.request("PUT", "/stsb", "policy", bucket_policy.encode())
+    session_policy = json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [{"Effect": "Allow",
+                       "Action": ["s3:GetObject"],
+                       "Resource": ["arn:aws:s3:::stsb/*"]}]})
+    creds = _assume_role(root, policy=session_policy)
+    temp = S3Client(server.endpoint, creds["ak"], creds["sk"])
+    hdr = {"x-amz-security-token": creds["token"]}
+    assert temp.request("GET", "/stsb/sp.txt", headers=hdr).body == b"data"
+    with pytest.raises(S3ClientError) as ei:
+        temp.request("PUT", "/stsb/sp-write.txt", body=b"x", headers=hdr)
+    assert ei.value.code == "AccessDenied"
+    root.request("DELETE", "/stsb", "policy")
+
+
 def test_sts_chaining_refused(server, root):
     creds = _assume_role(root)
     temp = S3Client(server.endpoint, creds["ak"], creds["sk"])
