@@ -88,6 +88,53 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
                               "secretKey": sa.secret_key}) or True
         if route.startswith("policy"):
             return _policy(h, srv, route, payload, send_json)
+        if route == "datausageinfo" and h.command == "GET":
+            # cmd/admin-handlers.go DataUsageInfoHandler: serve the
+            # crawler's last persisted scan
+            from ..background.crawler import load_usage
+            info = load_usage(srv.layer)
+            if info is None:
+                return send_json({"error": "no usage data yet"}, 404) \
+                    or True
+            return send_json(json.loads(info.to_json())) or True
+        if route == "heal-status" and h.command == "GET":
+            # madmin BackgroundHealStatus analog
+            healer = getattr(srv, "healer", None)
+            mrf = getattr(srv, "mrf", None)
+            return send_json({
+                "sweep": healer.stats.to_dict() if healer else None,
+                "mrf": mrf.stats.to_dict() if mrf else None}) or True
+        if route == "replication-stats" and h.command == "GET":
+            repl = srv.replication
+            return send_json(
+                repl.stats.to_dict() if repl else {}) or True
+        if route == "set-remote-target" and h.command == "POST":
+            from ..background.replication import (ReplicationSys,
+                                                  ReplicationTarget)
+            if srv.replication is None:
+                srv.replication = ReplicationSys(srv.layer, srv.bucket_meta)
+                srv.replication.start()
+            doc = json.loads(payload)
+            bucket = doc.pop("sourceBucket")
+            srv.replication.set_target(bucket, ReplicationTarget(**doc))
+            return send_json({"status": "ok"}) or True
+        if route == "list-remote-targets" and h.command == "GET":
+            repl = srv.replication
+            return send_json(
+                {b: t.to_dict() for b, t in repl._targets.items()}
+                if repl else {}) or True
+        if route == "bandwidth" and h.command == "GET":
+            repl = srv.replication
+            return send_json(
+                repl.monitor.report() if repl else {}) or True
+        if route == "set-bandwidth-limit" and h.command == "POST":
+            repl = srv.replication
+            if repl is None:
+                return send_json({"error": "replication not enabled"},
+                                 400) or True
+            repl.monitor.set_limit(q1["bucket"],
+                                   int(q1.get("limit", "0")))
+            return send_json({"status": "ok"}) or True
     except (KeyError, json.JSONDecodeError) as e:
         return send_json({"error": f"bad request: {e}"}, 400) or True
     except (NoSuchUser, NoSuchPolicy) as e:

@@ -1,0 +1,15 @@
+"""Background services (SURVEY.md L8): data crawler/usage accounting,
+update tracking, background healing (MRF + sweep), ILM enforcement, and
+async bucket replication (cmd/data-crawler.go, cmd/global-heal.go,
+cmd/bucket-lifecycle.go, cmd/bucket-replication.go)."""
+
+from .crawler import Crawler, DataUsageInfo, load_usage, scan_usage
+from .heal import BackgroundHealer, MRFQueue
+from .replication import BandwidthMonitor, ReplicationSys
+from .tracker import DataUpdateTracker
+
+__all__ = [
+    "BackgroundHealer", "BandwidthMonitor", "Crawler", "DataUpdateTracker",
+    "DataUsageInfo", "MRFQueue", "ReplicationSys", "load_usage",
+    "scan_usage",
+]

@@ -1,0 +1,247 @@
+"""Data crawler: usage accounting + ILM enforcement (cmd/data-crawler.go,
+cmd/data-usage-cache.go, cmd/bucket-lifecycle.go enforcement side).
+
+Each cycle walks every bucket through the ObjectLayer, accumulates a
+DataUsageInfo (per-bucket object counts/sizes + a size histogram, as in
+cmd/data-usage-cache.go sizeHistogram), applies lifecycle actions the
+bucket's ILM config demands (expiry of current/noncurrent versions and
+expired delete markers; transition is delegated to a tier callback), and
+persists the result through the object layer so the admin DataUsageInfo
+API serves it (cmd/admin-handlers.go DataUsageInfoHandler).  The
+DataUpdateTracker bloom filter lets later cycles skip buckets with no
+recorded change (cmd/data-crawler.go dataUsageUpdateDirCycles skip).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..bucket.lifecycle import Action, Lifecycle, ObjectOpts
+from ..objectlayer import interface as ol
+from ..storage.datatypes import now_ns
+from .tracker import DataUpdateTracker
+
+USAGE_PATH = "datausage/usage.json"
+# cmd/data-usage-cache.go sizeHistogram intervals
+HISTOGRAM = [
+    ("LESS_THAN_1024_B", 0, 1024),
+    ("BETWEEN_1024_B_AND_1_MB", 1024, 1 << 20),
+    ("BETWEEN_1_MB_AND_10_MB", 1 << 20, 10 << 20),
+    ("BETWEEN_10_MB_AND_64_MB", 10 << 20, 64 << 20),
+    ("BETWEEN_64_MB_AND_128_MB", 64 << 20, 128 << 20),
+    ("BETWEEN_128_MB_AND_512_MB", 128 << 20, 512 << 20),
+    ("GREATER_THAN_512_MB", 512 << 20, 1 << 62),
+]
+
+
+@dataclass
+class BucketUsage:
+    objects_count: int = 0
+    versions_count: int = 0
+    size: int = 0
+    histogram: dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class DataUsageInfo:
+    """cmd/data-usage-utils.go DataUsageInfo equivalent."""
+    last_update_ns: int = 0
+    buckets_count: int = 0
+    objects_total_count: int = 0
+    objects_total_size: int = 0
+    bucket_usage: dict[str, BucketUsage] = field(default_factory=dict)
+
+    def to_json(self) -> bytes:
+        return json.dumps({
+            "lastUpdate": self.last_update_ns,
+            "bucketsCount": self.buckets_count,
+            "objectsCount": self.objects_total_count,
+            "objectsTotalSize": self.objects_total_size,
+            "bucketsUsageInfo": {
+                b: {"objectsCount": u.objects_count,
+                    "versionsCount": u.versions_count,
+                    "size": u.size,
+                    "objectsSizesHistogram": u.histogram}
+                for b, u in self.bucket_usage.items()},
+        }).encode()
+
+    @classmethod
+    def from_json(cls, blob: bytes) -> "DataUsageInfo":
+        doc = json.loads(blob)
+        out = cls(last_update_ns=doc.get("lastUpdate", 0),
+                  buckets_count=doc.get("bucketsCount", 0),
+                  objects_total_count=doc.get("objectsCount", 0),
+                  objects_total_size=doc.get("objectsTotalSize", 0))
+        for b, u in doc.get("bucketsUsageInfo", {}).items():
+            out.bucket_usage[b] = BucketUsage(
+                u.get("objectsCount", 0), u.get("versionsCount", 0),
+                u.get("size", 0), u.get("objectsSizesHistogram", {}))
+        return out
+
+
+def _histogram_bucket(size: int) -> str:
+    for name, lo, hi in HISTOGRAM:
+        if lo <= size < hi:
+            return name
+    return HISTOGRAM[-1][0]
+
+
+@dataclass
+class ScanResult:
+    usage: DataUsageInfo
+    expired: list[tuple[str, str, str]] = field(default_factory=list)
+    transitioned: list[tuple[str, str]] = field(default_factory=list)
+
+
+def scan_usage(layer, bucket_meta=None, apply_lifecycle: bool = True,
+               transition_fn=None, tracker: DataUpdateTracker | None = None,
+               since_cycle: int | None = None) -> ScanResult:
+    """One full scan cycle: usage accounting + ILM enforcement.
+
+    With a tracker and since_cycle, buckets with no recorded change since
+    that cycle reuse nothing but are skipped for ILM work (usage is still
+    recomputed — listing is the source of truth, as in the reference's
+    shouldUpdate logic)."""
+    res = ScanResult(DataUsageInfo(last_update_ns=now_ns()))
+    info = res.usage
+    for b in layer.list_buckets():
+        bu = BucketUsage()
+        info.bucket_usage[b.name] = bu
+        lc = None
+        if apply_lifecycle and bucket_meta is not None:
+            try:
+                lc = bucket_meta.get_parsed(b.name, "lifecycle",
+                                            Lifecycle.parse)
+            except Exception:  # noqa: BLE001 — unparseable config: skip ILM
+                lc = None
+        skip_ilm = (tracker is not None and since_cycle is not None
+                    and not tracker.changed_since(since_cycle, b.name))
+        versions = layer.list_object_versions(b.name)
+        latest_mod: dict[str, int] = {}
+        for oi in versions:
+            if oi.is_latest:
+                latest_mod[oi.name] = oi.mod_time
+        for oi in versions:
+            if not oi.delete_marker:
+                bu.versions_count += 1
+                bu.size += oi.size
+                h = _histogram_bucket(oi.size)
+                bu.histogram[h] = bu.histogram.get(h, 0) + 1
+                if oi.is_latest:
+                    bu.objects_count += 1
+            if lc is None or skip_ilm:
+                continue
+            action = lc.compute_action(ObjectOpts(
+                name=oi.name, mod_time_ns=oi.mod_time,
+                user_tags=_tags_of(oi), is_latest=oi.is_latest,
+                delete_marker=oi.delete_marker,
+                num_versions=oi.num_versions or 1,
+                successor_mod_time_ns=0 if oi.is_latest
+                else latest_mod.get(oi.name, 0)))
+            if action in (Action.DELETE, Action.DELETE_VERSION,
+                          Action.DELETE_MARKER_DELETE):
+                _expire(layer, b.name, oi, action, res)
+            elif action in (Action.TRANSITION, Action.TRANSITION_VERSION) \
+                    and transition_fn is not None:
+                try:
+                    transition_fn(b.name, oi)
+                    res.transitioned.append((b.name, oi.name))
+                except Exception:  # noqa: BLE001 — retried next cycle
+                    pass
+        info.buckets_count += 1
+        info.objects_total_count += bu.objects_count
+        info.objects_total_size += bu.size
+    return res
+
+
+def _tags_of(oi) -> dict[str, str]:
+    raw = oi.user_defined.get("x-amz-tagging", "")
+    if not raw:
+        return {}
+    out = {}
+    for pair in raw.split("&"):
+        if "=" in pair:
+            k, v = pair.split("=", 1)
+            out[k] = v
+    return out
+
+
+def _expire(layer, bucket: str, oi, action: Action, res: ScanResult) -> None:
+    try:
+        if action is Action.DELETE:
+            # expire the current version: versioned buckets get a delete
+            # marker; unversioned delete outright
+            layer.delete_object(bucket, oi.name)
+        else:
+            layer.delete_object(
+                bucket, oi.name,
+                ol.ObjectOptions(version_id=oi.version_id or ""))
+        res.expired.append((bucket, oi.name, oi.version_id))
+    except ol.ObjectLayerError:
+        pass  # raced with a client delete; next cycle reconciles
+
+
+def persist_usage(layer, info: DataUsageInfo) -> None:
+    from ..storage.xl_storage import SYS_DIR
+    blob = info.to_json()
+    layer._fanout(lambda d: d.write_all(SYS_DIR, USAGE_PATH, blob))
+
+
+def load_usage(layer) -> DataUsageInfo | None:
+    from ..storage.xl_storage import SYS_DIR
+    res, _ = layer._fanout(lambda d: d.read_all(SYS_DIR, USAGE_PATH))
+    for r in res:
+        if r is not None:
+            try:
+                return DataUsageInfo.from_json(r)
+            except (ValueError, KeyError):
+                continue
+    return None
+
+
+class Crawler:
+    """Periodic scan loop (initDataCrawler, cmd/server-main.go:499).
+
+    Runs scan_usage every `interval_s`, persists usage, and advances the
+    update-tracker cycle so the next scan can skip unchanged buckets."""
+
+    def __init__(self, layer, bucket_meta=None, interval_s: float = 60.0,
+                 transition_fn=None, tracker: DataUpdateTracker | None = None):
+        self.layer = layer
+        self.bucket_meta = bucket_meta
+        self.interval_s = interval_s
+        self.transition_fn = transition_fn
+        self.tracker = tracker or DataUpdateTracker()
+        self.last: ScanResult | None = None
+        self.cycles = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def run_cycle(self) -> ScanResult:
+        since = self.tracker.cycle - 1 if self.cycles else None
+        res = scan_usage(self.layer, self.bucket_meta,
+                         transition_fn=self.transition_fn,
+                         tracker=self.tracker, since_cycle=since)
+        persist_usage(self.layer, res.usage)
+        self.tracker.advance()
+        self.last = res
+        self.cycles += 1
+        return res
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.run_cycle()
+                except Exception:  # noqa: BLE001 — crawler must survive
+                    time.sleep(1)
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

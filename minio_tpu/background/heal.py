@@ -1,0 +1,150 @@
+"""Background healing: MRF queue + continuous sweep
+(cmd/erasure-object.go:1141 addPartial, cmd/erasure-sets.go:96-98 MRF,
+cmd/global-heal.go:123 healErasureSet, cmd/background-newdisks-heal-ops.go).
+
+MRFQueue holds most-recently-failed writes — objects that met write
+quorum but missed some drives — and a worker re-heals them promptly so
+degraded objects don't wait for the slow sweep.  BackgroundHealer is the
+continuous whole-namespace sweep with progress accounting matching the
+admin heal-status API shape (cmd/admin-heal-ops.go:75).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class HealStats:
+    """Progress counters surfaced by the admin API
+    (madmin.BgHealState equivalent)."""
+    objects_scanned: int = 0
+    objects_healed: int = 0
+    objects_failed: int = 0
+    mrf_queued: int = 0
+    mrf_healed: int = 0
+    last_cycle_ns: int = 0
+    cycles: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "objectsScanned": self.objects_scanned,
+            "objectsHealed": self.objects_healed,
+            "objectsFailed": self.objects_failed,
+            "mrfQueued": self.mrf_queued,
+            "mrfHealed": self.mrf_healed,
+            "lastCycle": self.last_cycle_ns,
+            "cycles": self.cycles,
+        }
+
+
+class MRFQueue:
+    """Most-recently-failed write repair queue.  PutObject paths call
+    add() when a drive write fails post-quorum; the worker heals each
+    entry as soon as it lands."""
+
+    def __init__(self, layer, maxsize: int = 10_000):
+        self.layer = layer
+        self.stats = HealStats()
+        self._q: queue.Queue = queue.Queue(maxsize)
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def add(self, bucket: str, object_name: str,
+            version_id: str = "") -> None:
+        try:
+            self._q.put_nowait((bucket, object_name, version_id))
+            self.stats.mrf_queued += 1
+        except queue.Full:
+            pass  # sweep picks it up (reference drops too; heal is lossy-ok)
+
+    def start(self) -> None:
+        def worker():
+            while not self._stop.is_set():
+                try:
+                    bucket, obj, vid = self._q.get(timeout=0.2)
+                except queue.Empty:
+                    continue
+                try:
+                    self.layer.heal_object(bucket, obj,
+                                           version_id=vid or None)
+                    self.stats.mrf_healed += 1
+                except Exception:  # noqa: BLE001 — sweep retries later
+                    pass
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def drain(self, timeout: float = 5.0) -> None:
+        """Block until queued entries are processed (tests/shutdown)."""
+        deadline = time.monotonic() + timeout
+        while not self._q.empty() and time.monotonic() < deadline:
+            time.sleep(0.01)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+@dataclass
+class BackgroundHealer:
+    """Continuous namespace heal sweep (healErasureSet,
+    cmd/global-heal.go:123): every interval, walk all buckets + objects
+    and run heal_object on each; deep (bitrot-verify) scans every
+    `deep_every` cycles."""
+
+    layer: object
+    interval_s: float = 3600.0
+    deep_every: int = 0          # 0: never deep-scan in the sweep
+    stats: HealStats = field(default_factory=HealStats)
+
+    def __post_init__(self):
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def sweep(self) -> HealStats:
+        deep = bool(self.deep_every) and \
+            (self.stats.cycles + 1) % self.deep_every == 0
+        for b in self.layer.list_buckets():
+            if hasattr(self.layer, "heal_bucket"):
+                try:
+                    self.layer.heal_bucket(b.name)
+                except Exception:  # noqa: BLE001
+                    pass
+            marker = ""
+            while True:
+                out = self.layer.list_objects(b.name, marker=marker,
+                                              max_keys=1000)
+                for oi in out.objects:
+                    self.stats.objects_scanned += 1
+                    try:
+                        r = self.layer.heal_object(b.name, oi.name,
+                                                   deep=deep)
+                        if r is not None and getattr(r, "healed_disks", 0):
+                            self.stats.objects_healed += 1
+                    except Exception:  # noqa: BLE001
+                        self.stats.objects_failed += 1
+                if not out.is_truncated:
+                    break
+                marker = out.next_marker
+        self.stats.cycles += 1
+        self.stats.last_cycle_ns = time.time_ns()
+        return self.stats
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.sweep()
+                except Exception:  # noqa: BLE001 — healer must survive
+                    time.sleep(1)
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

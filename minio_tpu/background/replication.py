@@ -1,0 +1,251 @@
+"""Async bucket replication + per-bucket bandwidth throttling
+(cmd/bucket-replication.go:456 replicateObject, cmd/bucket-targets.go,
+pkg/bucket/bandwidth/monitor.go:63 + throttle.go).
+
+ReplicationSys owns the remote-target registry (persisted through the
+object layer, like the reference's .minio.sys bucket targets config) and
+a worker pool draining a replication queue: each task GETs the object
+locally, PUTs it to the remote target over S3 (the replica carries
+x-amz-replication-status: REPLICA, the source version is flipped
+PENDING -> COMPLETED/FAILED), honoring the bucket's bandwidth cap via a
+token-bucket throttle.  Deletes (and delete markers) replicate when the
+bucket's replication rules opt in.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..bucket.replication import Config as ReplConfig
+
+STATUS_KEY = "x-amz-replication-status"   # xhttp.AmzBucketReplicationStatus
+TARGETS_PATH = "replication/targets.json"
+
+
+class BandwidthMonitor:
+    """Per-bucket token-bucket throttle + rate accounting
+    (pkg/bucket/bandwidth: monitor measures, throttle enforces)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._limits: dict[str, int] = {}       # bucket -> bytes/sec
+        self._tokens: dict[str, tuple[float, float]] = {}  # (tokens, ts)
+        self._moved: dict[str, int] = {}        # bucket -> total bytes
+
+    def set_limit(self, bucket: str, bytes_per_s: int) -> None:
+        with self._mu:
+            if bytes_per_s <= 0:
+                self._limits.pop(bucket, None)
+            else:
+                self._limits[bucket] = bytes_per_s
+
+    def throttle(self, bucket: str, nbytes: int) -> float:
+        """Account nbytes; sleeps to keep the bucket under its cap.
+        Returns seconds slept."""
+        with self._mu:
+            self._moved[bucket] = self._moved.get(bucket, 0) + nbytes
+            limit = self._limits.get(bucket)
+            if not limit:
+                return 0.0
+            now = time.monotonic()
+            tokens, ts = self._tokens.get(bucket, (float(limit), now))
+            tokens = min(float(limit), tokens + (now - ts) * limit)
+            tokens -= nbytes
+            self._tokens[bucket] = (tokens, now)
+            wait = -tokens / limit if tokens < 0 else 0.0
+        if wait > 0:
+            time.sleep(wait)
+        return wait
+
+    def report(self) -> dict:
+        """madmin.BucketBandwidthReport shape."""
+        with self._mu:
+            return {b: {"limitInBytesPerSecond": self._limits.get(b, 0),
+                        "totalBytesMoved": self._moved.get(b, 0)}
+                    for b in set(self._limits) | set(self._moved)}
+
+
+@dataclass
+class ReplicationTarget:
+    """A remote bucket endpoint (cmd/bucket-targets.go TargetClient)."""
+    arn: str
+    endpoint: str
+    target_bucket: str
+    access_key: str = ""
+    secret_key: str = ""
+    region: str = "us-east-1"
+
+    def to_dict(self) -> dict:
+        return self.__dict__.copy()
+
+
+@dataclass
+class ReplStats:
+    queued: int = 0
+    replicated: int = 0
+    replica_bytes: int = 0
+    failed: int = 0
+    deletes_replicated: int = 0
+
+    def to_dict(self) -> dict:
+        return {"queued": self.queued, "replicated": self.replicated,
+                "replicaBytes": self.replica_bytes, "failed": self.failed,
+                "deletesReplicated": self.deletes_replicated}
+
+
+class ReplicationSys:
+    """Queue + worker pool; attach as S3Server.replication."""
+
+    def __init__(self, layer, bucket_meta, workers: int = 2,
+                 monitor: BandwidthMonitor | None = None):
+        self.layer = layer
+        self.bucket_meta = bucket_meta
+        self.monitor = monitor or BandwidthMonitor()
+        self.stats = ReplStats()
+        self._targets: dict[str, ReplicationTarget] = {}   # bucket -> tgt
+        self._q: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._nworkers = workers
+        self._load_targets()
+
+    # -- target registry ----------------------------------------------------
+
+    def set_target(self, bucket: str, target: ReplicationTarget) -> None:
+        self._targets[bucket] = target
+        self._persist_targets()
+
+    def remove_target(self, bucket: str) -> None:
+        self._targets.pop(bucket, None)
+        self._persist_targets()
+
+    def get_target(self, bucket: str) -> ReplicationTarget | None:
+        return self._targets.get(bucket)
+
+    def _persist_targets(self) -> None:
+        from ..storage.xl_storage import SYS_DIR
+        blob = json.dumps({b: t.to_dict()
+                           for b, t in self._targets.items()}).encode()
+        self.layer._fanout(
+            lambda d: d.write_all(SYS_DIR, TARGETS_PATH, blob))
+
+    def _load_targets(self) -> None:
+        from ..storage.xl_storage import SYS_DIR
+        res, _ = self.layer._fanout(
+            lambda d: d.read_all(SYS_DIR, TARGETS_PATH))
+        for r in res:
+            if r is None:
+                continue
+            try:
+                self._targets = {b: ReplicationTarget(**t)
+                                 for b, t in json.loads(r).items()}
+                return
+            except (ValueError, TypeError):
+                continue
+
+    # -- decision + queue (mustReplicate -> queueReplicaTask) ---------------
+
+    def _config(self, bucket: str) -> ReplConfig | None:
+        try:
+            return self.bucket_meta.get_parsed(bucket, "replication",
+                                               ReplConfig.parse)
+        except Exception:  # noqa: BLE001
+            return None
+
+    def queue(self, bucket: str, oi, delete: bool = False) -> bool:
+        cfg = self._config(bucket)
+        if cfg is None or self._targets.get(bucket) is None:
+            return False
+        tags = {}
+        raw = oi.user_defined.get("x-amz-tagging", "") \
+            if getattr(oi, "user_defined", None) else ""
+        for pair in raw.split("&"):
+            if "=" in pair:
+                k, v = pair.split("=", 1)
+                tags[k] = v
+        rule = cfg.replicate(oi.name, tags,
+                             delete_marker=delete and oi.delete_marker,
+                             versioned_delete=delete and not oi.delete_marker)
+        if rule is None:
+            return False
+        if not delete:
+            # flip source to PENDING before queueing (replicateObject does
+            # the same so a crash leaves a visibly-pending version)
+            try:
+                self.layer.put_object_metadata(
+                    bucket, oi.name, None, {STATUS_KEY: "PENDING"})
+            except Exception:  # noqa: BLE001
+                pass
+        self._q.put((bucket, oi.name, oi.version_id, delete))
+        self.stats.queued += 1
+        return True
+
+    # -- worker -------------------------------------------------------------
+
+    def _replicate_one(self, bucket: str, name: str, version_id: str,
+                       delete: bool) -> None:
+        from ..s3.client import S3Client
+        tgt = self._targets.get(bucket)
+        if tgt is None:
+            return
+        client = S3Client(tgt.endpoint, tgt.access_key, tgt.secret_key,
+                          region=tgt.region)
+        if delete:
+            client.delete_object(tgt.target_bucket, name)
+            self.stats.deletes_replicated += 1
+            return
+        oi, data = self.layer.get_object(bucket, name)
+        self.monitor.throttle(bucket, len(data))
+        headers = {STATUS_KEY: "REPLICA"}
+        for k, v in oi.user_defined.items():
+            if k.startswith("x-amz-meta-"):
+                headers[k] = v
+        ct = oi.user_defined.get("content-type", "")
+        if ct:
+            headers["Content-Type"] = ct
+        client.request("PUT", f"/{tgt.target_bucket}/{name}", body=data,
+                       headers=headers)
+        self.layer.put_object_metadata(bucket, name, None,
+                                       {STATUS_KEY: "COMPLETED"})
+        self.stats.replicated += 1
+        self.stats.replica_bytes += len(data)
+
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                bucket, name, vid, delete = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self._replicate_one(bucket, name, vid, delete)
+            except Exception:  # noqa: BLE001
+                self.stats.failed += 1
+                if not delete:
+                    try:
+                        self.layer.put_object_metadata(
+                            bucket, name, None, {STATUS_KEY: "FAILED"})
+                    except Exception:  # noqa: BLE001
+                        pass
+
+    def start(self) -> None:
+        for _ in range(self._nworkers):
+            t = threading.Thread(target=self._worker, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def drain(self, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout
+        while not self._q.empty() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # let in-flight tasks finish
+        time.sleep(0.05)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads.clear()

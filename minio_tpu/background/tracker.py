@@ -1,0 +1,139 @@
+"""Data update tracker (cmd/data-update-tracker.go).
+
+A bloom filter over changed object paths, advanced in cycles: the crawler
+asks "did anything under this prefix change since cycle N?" to skip
+unchanged subtrees.  The reference keeps a history of per-cycle filters
+(dataUpdateTrackerHistory) and ORs the filters newer than the asked
+cycle; hashing is xxhash64 (dataUpdateTrackerEstItems/bloom via bloom
+filter lib seeded with xxhash, go.mod:16).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from ..hashing.xxhash import xxh64
+
+DEFAULT_BITS = 1 << 16      # 64 Kib filter (reference sizes for ~1M keys)
+DEFAULT_HASHES = 4
+MAX_HISTORY = 16            # dataUpdateTrackerKeepCycles
+
+
+class _Bloom:
+    def __init__(self, bits: int = DEFAULT_BITS,
+                 hashes: int = DEFAULT_HASHES):
+        self.bits = bits
+        self.hashes = hashes
+        self.data = bytearray(bits // 8)
+
+    def _positions(self, key: bytes):
+        for seed in range(self.hashes):
+            yield xxh64(key, seed) % self.bits
+
+    def add(self, key: bytes) -> None:
+        for p in self._positions(key):
+            self.data[p >> 3] |= 1 << (p & 7)
+
+    def contains(self, key: bytes) -> bool:
+        return all(self.data[p >> 3] & (1 << (p & 7))
+                   for p in self._positions(key))
+
+    def or_with(self, other: "_Bloom") -> None:
+        for i, b in enumerate(other.data):
+            self.data[i] |= b
+
+
+class DataUpdateTracker:
+    """Cycle-based change tracker; persisted through the object layer's
+    system volume so a restart resumes with history intact."""
+
+    _STORE_PATH = "tracker/update-tracker.json"
+
+    def __init__(self, layer=None, bits: int = DEFAULT_BITS):
+        self._mu = threading.Lock()
+        self._layer = layer
+        self._bits = bits
+        self.cycle = 1
+        self._current = _Bloom(bits)
+        self._history: list[tuple[int, _Bloom]] = []
+        if layer is not None:
+            self._load()
+
+    def mark(self, bucket: str, object_name: str) -> None:
+        """Record that bucket/object changed this cycle (the PUT/DELETE
+        paths call this; reference hooks ObjectLayer mutations)."""
+        with self._mu:
+            # bucket-level key too: the crawler's skip check asks per
+            # bucket (dataUpdateTracker path-prefix marking)
+            self._current.add(bucket.encode())
+            self._current.add(f"{bucket}/{object_name}".encode())
+
+    def changed_since(self, cycle: int, bucket: str,
+                      object_name: str = "") -> bool:
+        """True if the path may have changed since `cycle` (bloom filters
+        can false-positive, never false-negative).  An unknown (too-old)
+        cycle conservatively reports changed."""
+        key = f"{bucket}/{object_name}".encode() if object_name \
+            else bucket.encode()
+        with self._mu:
+            if cycle >= self.cycle:
+                return self._current.contains(key)
+            oldest = self._history[0][0] if self._history else self.cycle
+            if cycle < oldest:
+                return True
+            hit = self._current.contains(key)
+            for c, bloom in self._history:
+                if c >= cycle:
+                    hit = hit or bloom.contains(key)
+            return hit
+
+    def advance(self) -> int:
+        """Close the current cycle into history and start the next
+        (the crawler calls this once per scan cycle)."""
+        with self._mu:
+            self._history.append((self.cycle, self._current))
+            self._history = self._history[-MAX_HISTORY:]
+            self.cycle += 1
+            self._current = _Bloom(self._bits)
+            cyc = self.cycle
+        self._persist()
+        return cyc
+
+    # -- persistence --------------------------------------------------------
+
+    def _persist(self) -> None:
+        if self._layer is None:
+            return
+        from ..storage.xl_storage import SYS_DIR
+        with self._mu:
+            doc = {
+                "cycle": self.cycle, "bits": self._bits,
+                "current": self._current.data.hex(),
+                "history": [(c, b.data.hex()) for c, b in self._history],
+            }
+        blob = json.dumps(doc).encode()
+        self._layer._fanout(
+            lambda d: d.write_all(SYS_DIR, self._STORE_PATH, blob))
+
+    def _load(self) -> None:
+        from ..storage.xl_storage import SYS_DIR
+        res, _ = self._layer._fanout(
+            lambda d: d.read_all(SYS_DIR, self._STORE_PATH))
+        for r in res:
+            if r is None:
+                continue
+            try:
+                doc = json.loads(r)
+                self.cycle = doc["cycle"]
+                self._bits = doc["bits"]
+                self._current = _Bloom(self._bits)
+                self._current.data = bytearray.fromhex(doc["current"])
+                self._history = []
+                for c, hexdata in doc["history"]:
+                    b = _Bloom(self._bits)
+                    b.data = bytearray.fromhex(hexdata)
+                    self._history.append((c, b))
+                return
+            except (KeyError, ValueError):
+                continue

@@ -93,6 +93,9 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         self._pool = ThreadPoolExecutor(max_workers=max(4, n))
         self._codec = Erasure(self.data_blocks, self.parity, block_size,
                               backend=backend) if self.parity > 0 else None
+        # MRF hook (cmd/erasure-object.go:1141 addPartial): a background
+        # MRFQueue attaches here; post-quorum partial writes are enqueued
+        self.mrf = None
 
     # -- drive fan-out helpers --------------------------------------------
 
@@ -248,7 +251,10 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         except serrors.StorageError as e:
             raise WriteQuorumError(str(e)) from e
         # failed writes become heal candidates (MRF analog,
-        # cmd/erasure-object.go:783-789) — handled by heal sweeps
+        # cmd/erasure-object.go:783-789): quorum met but some drive
+        # missed the write — queue a prompt re-heal
+        if self.mrf is not None and any(e is not None for e in errs):
+            self.mrf.add(bucket, object_name, fi.version_id)
         return self._to_object_info(fi)
 
     # -- GET (cmd/erasure-object.go:242 getObjectWithFileInfo) -------------
@@ -541,6 +547,11 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 if delimiter in rest:
                     prefixes.add(prefix + rest.split(delimiter, 1)[0]
                                  + delimiter)
+                    # prefixes count toward max-keys too (S3 semantics)
+                    if len(out.objects) + len(prefixes) >= max_keys:
+                        out.is_truncated = True
+                        out.next_marker = name
+                        break
                     continue
             try:
                 oi = self.get_object_info(bucket, name)
@@ -575,6 +586,31 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                     out.extend(self._to_object_info(fi) for fi in vlist)
                     break
         return out
+
+    # -- healing (delegates to objectlayer.healing) -------------------------
+
+    def heal_object(self, bucket, object_name, version_id=None, deep=False,
+                    dry_run=False, remove_dangling=False):
+        from . import healing
+        return healing.heal_object(self, bucket, object_name, version_id,
+                                   deep, dry_run, remove_dangling)
+
+    def heal_bucket(self, bucket: str) -> int:
+        """Recreate the bucket on any drive missing it
+        (healBucket, cmd/erasure-healing.go:56); returns drives touched."""
+        healed = 0
+        for disk in self.disks:
+            if disk is None:
+                continue
+            try:
+                disk.stat_vol(bucket)
+            except serrors.StorageError:
+                try:
+                    disk.make_vol(bucket)
+                    healed += 1
+                except serrors.StorageError:
+                    pass
+        return healed
 
     # -- helpers -----------------------------------------------------------
 

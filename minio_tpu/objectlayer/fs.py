@@ -86,8 +86,12 @@ class FSObjects(ObjectLayer):
         return os.path.join(self.root, bucket)
 
     def _obj_path(self, bucket: str, key: str) -> str:
-        p = os.path.normpath(os.path.join(self.root, bucket, key))
-        if not p.startswith(self._bucket_path(bucket)):
+        bp = self._bucket_path(bucket)
+        p = os.path.normpath(os.path.join(bp, key))
+        # containment must be separator-aware: "<root>/data-private"
+        # startswith "<root>/data" — a bare prefix check lets keys escape
+        # into sibling buckets (or, with a ".." bucket, out of the root)
+        if not p.startswith(bp + os.sep) or p == bp:
             raise ObjectNotFound(key)
         return p
 
@@ -99,6 +103,10 @@ class FSObjects(ObjectLayer):
         return os.path.join(self.root, SYS, "tmp", uuid.uuid4().hex)
 
     def _check_bucket(self, bucket: str) -> None:
+        # every entry point revalidates the name: "..", "a/b" or "" must
+        # never reach the filesystem as a path segment
+        if not _valid_bucket(bucket):
+            raise BucketNotFound(bucket)
         if not os.path.isdir(self._bucket_path(bucket)):
             raise BucketNotFound(bucket)
 
@@ -265,6 +273,11 @@ class FSObjects(ObjectLayer):
                 if delimiter in rest:
                     prefixes.add(prefix + rest.split(delimiter, 1)[0]
                                  + delimiter)
+                    # prefixes count toward max-keys too (S3 semantics)
+                    if len(out.objects) + len(prefixes) >= max_keys:
+                        out.is_truncated = True
+                        out.next_marker = name
+                        break
                     continue
             out.objects.append(self._info(bucket, name,
                                           self._read_meta(bucket, name)))
@@ -423,8 +436,10 @@ class FSObjects(ObjectLayer):
     # -- heal (no-op in FS mode, as in the reference) ------------------------
 
     def heal_object(self, bucket, object_name, version_id=None, deep=False,
-                    dry_run=False):
-        return None
+                    dry_run=False, remove_dangling=False):
+        from .healing import HealResult
+        self.get_object_info(bucket, object_name)
+        return HealResult(bucket, object_name, before_ok=1, after_ok=1)
 
     def heal_bucket(self, bucket: str) -> int:
         self._check_bucket(bucket)

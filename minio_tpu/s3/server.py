@@ -106,8 +106,11 @@ class S3Server:
                 store_dir=self.config.get("notify_webhook", "queue_dir")
                 or None))
         # wired in by server_main / tests when those subsystems are enabled
-        self.replication = None  # ReplicationSys (minio_tpu/replication)
+        self.replication = None  # ReplicationSys (minio_tpu/background)
         self.usage = None        # data-usage cache (crawler)
+        self.healer = None       # BackgroundHealer sweep
+        self.mrf = None          # MRFQueue
+        self.tracker = None      # DataUpdateTracker (crawler bloom filter)
         from ..crypto.kms import LocalKMS
         self.kms = LocalKMS.from_env_or_store(object_layer)
         if self.config.get("compression", "enable") == "on":
@@ -142,6 +145,9 @@ class S3Server:
     def notify(self, event_name: str, bucket: str, oi,
                req_params: dict | None = None) -> None:
         """Fire a bucket event into the notification system."""
+        if self.tracker is not None and oi is not None:
+            # feed the crawler's change bloom filter on every mutation
+            self.tracker.mark(bucket, getattr(oi, "name", ""))
         self.events.send(event_name, bucket, oi, req_params or {})
 
     def replicate(self, bucket: str, oi, delete: bool = False) -> None:

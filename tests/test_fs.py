@@ -127,6 +127,42 @@ def test_path_traversal_blocked(fs):
         fs.get_object("bbb", "../../etc/passwd")
 
 
+def test_cross_bucket_traversal_blocked(fs):
+    """A key must not escape into a sibling bucket whose name shares a
+    prefix, and '..' must never resolve as a bucket."""
+    fs.make_bucket("data")
+    fs.make_bucket("data-private")
+    fs.put_object("data-private", "secret.txt", b"secret")
+    with pytest.raises(ol.ObjectLayerError):
+        fs.get_object("data", "../data-private/secret.txt")
+    with pytest.raises(ol.BucketNotFound):
+        fs.get_object("..", "anything")
+    with pytest.raises(ol.BucketNotFound):
+        fs.delete_bucket("..", force=True)
+
+
+def test_prefix_rollup_respects_max_keys(fs):
+    fs.make_bucket("bbb")
+    for i in range(30):
+        fs.put_object("bbb", f"p{i:03d}/x", b"d")
+    res = fs.list_objects("bbb", delimiter="/", max_keys=10)
+    assert len(res.prefixes) == 10
+    assert res.is_truncated
+    # pagination continues from the marker
+    res2 = fs.list_objects("bbb", delimiter="/", marker=res.next_marker,
+                           max_keys=25)
+    assert len(res2.prefixes) == 20
+    assert not res2.is_truncated
+
+
+def test_fs_heal_is_clean_noop(fs):
+    fs.make_bucket("bbb")
+    fs.put_object("bbb", "k", b"x")
+    r = fs.heal_object("bbb", "k", remove_dangling=True)
+    assert r.before_ok == r.after_ok == 1
+    assert r.healed_disks == []
+
+
 def test_s3_server_on_fs(fs, tmp_path):
     """The S3 front end runs unchanged on the FS backend
     (ExecObjectLayerTest's both-backends discipline)."""
