@@ -135,6 +135,34 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
             repl.monitor.set_limit(q1["bucket"],
                                    int(q1.get("limit", "0")))
             return send_json({"status": "ok"}) or True
+        if route == "trace" and h.command == "GET":
+            return _stream(h, srv.trace_hub, q1)
+        if route == "log" and h.command == "GET":
+            if q1.get("follow") == "true":
+                return _stream(h, srv.logger.pubsub, q1)
+            return send_json(srv.logger.recent(
+                int(q1.get("n", "100")))) or True
+        if route == "audit-recent" and h.command == "GET":
+            return send_json(
+                srv.audit.recent[-int(q1.get("n", "50")):]) or True
+        if route == "profile" and h.command == "POST":
+            from ..obs import profiling
+            try:
+                kinds = profiling.start(q1.get("profilerType", "cpu"))
+            except ValueError as e:
+                return send_json({"error": str(e)}, 400) or True
+            return send_json({"started": kinds}) or True
+        if route == "profile-download" and h.command == "GET":
+            from ..obs import profiling
+            data = profiling.stop_zip()
+            h._send(200, data, content_type="application/zip",
+                    headers={"Content-Disposition":
+                             "attachment; filename=profile.zip"})
+            return True
+        if route == "healthinfo" and h.command == "GET":
+            from ..obs import healthinfo
+            return send_json(healthinfo.collect(
+                _drive_paths(srv), perf=q1.get("perf") == "true")) or True
     except (KeyError, json.JSONDecodeError) as e:
         return send_json({"error": f"bad request: {e}"}, 400) or True
     except (NoSuchUser, NoSuchPolicy) as e:
@@ -143,6 +171,61 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
         return send_json({"error": str(e)}, 400) or True
     from ..s3.server import S3Error
     raise S3Error("MethodNotAllowed")
+
+
+def _drive_paths(srv) -> list:
+    """Local drive roots across pools/sets (for healthinfo probes)."""
+    paths = []
+    layer = srv.layer
+
+    def walk(node):
+        for pool in getattr(node, "pools", []) or []:
+            walk(pool)
+        for s in getattr(node, "sets", []) or []:
+            walk(s)
+        for d in getattr(node, "disks", []) or []:
+            root = getattr(d, "root", None)
+            if root:
+                paths.append(root)
+        root = getattr(node, "root", None)      # FS backend / bare drive
+        if root and not getattr(node, "disks", None):
+            paths.append(root)
+
+    walk(layer)
+    return paths
+
+
+def _stream(h, hub, q1) -> bool:
+    """Chunked newline-JSON live stream from a PubSub hub — serves
+    `mc admin trace` / `mc admin logs --follow`
+    (cmd/admin-handlers.go:1082 TraceHandler)."""
+    import json as _json
+    try:
+        timeout = min(float(q1.get("timeout", 10) or 10), 300.0)
+        max_items = int(q1.get("max-items", 10000) or 10000)
+    except ValueError:
+        timeout, max_items = 10.0, 10000
+    h.send_response(200)
+    h.send_header("Content-Type", "application/json")
+    h.send_header("Transfer-Encoding", "chunked")
+    h.end_headers()
+
+    def write_chunk(data: bytes):
+        h.wfile.write(f"{len(data):x}\r\n".encode())
+        h.wfile.write(data + b"\r\n")
+        h.wfile.flush()
+
+    with hub.subscribe() as sub:
+        try:
+            for item in sub.drain(max_items, timeout):
+                write_chunk(_json.dumps(item).encode() + b"\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        try:
+            h.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+    return True
 
 
 def _server_info(srv) -> dict:

@@ -120,10 +120,18 @@ def scan_usage(layer, bucket_meta=None, apply_lifecycle: bool = True,
         skip_ilm = (tracker is not None and since_cycle is not None
                     and not tracker.changed_since(since_cycle, b.name))
         versions = layer.list_object_versions(b.name)
-        latest_mod: dict[str, int] = {}
+        # a noncurrent version "became noncurrent" when the version that
+        # directly superseded it was written — NOT when the latest version
+        # was (cmd/bucket-lifecycle NoncurrentVersion* uses successor
+        # modtime); map each version to its immediate successor's mod_time
+        succ_mod: dict[tuple, int] = {}
+        by_name: dict[str, list] = {}
         for oi in versions:
-            if oi.is_latest:
-                latest_mod[oi.name] = oi.mod_time
+            by_name.setdefault(oi.name, []).append(oi)
+        for name, vs in by_name.items():
+            vs.sort(key=lambda o: o.mod_time, reverse=True)
+            for newer, older in zip(vs, vs[1:]):
+                succ_mod[(name, older.version_id)] = newer.mod_time
         for oi in versions:
             if not oi.delete_marker:
                 bu.versions_count += 1
@@ -140,7 +148,7 @@ def scan_usage(layer, bucket_meta=None, apply_lifecycle: bool = True,
                 delete_marker=oi.delete_marker,
                 num_versions=oi.num_versions or 1,
                 successor_mod_time_ns=0 if oi.is_latest
-                else latest_mod.get(oi.name, 0)))
+                else succ_mod.get((oi.name, oi.version_id), 0)))
             if action in (Action.DELETE, Action.DELETE_VERSION,
                           Action.DELETE_MARKER_DELETE):
                 _expire(layer, b.name, oi, action, res)
@@ -158,15 +166,17 @@ def scan_usage(layer, bucket_meta=None, apply_lifecycle: bool = True,
 
 
 def _tags_of(oi) -> dict[str, str]:
-    raw = oi.user_defined.get("x-amz-tagging", "")
+    """Stored object tags, shared by ILM filters and replication rule
+    matching (one parser so the two subsystems can't diverge)."""
+    raw = oi.user_defined.get("x-amz-tagging", "") \
+        if getattr(oi, "user_defined", None) else ""
     if not raw:
         return {}
-    out = {}
-    for pair in raw.split("&"):
-        if "=" in pair:
-            k, v = pair.split("=", 1)
-            out[k] = v
-    return out
+    from ..bucket.tags import TagError, parse_header
+    try:
+        return parse_header(raw)
+    except TagError:
+        return {}
 
 
 def _expire(layer, bucket: str, oi, action: Action, res: ScanResult) -> None:

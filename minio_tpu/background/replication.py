@@ -160,14 +160,8 @@ class ReplicationSys:
         cfg = self._config(bucket)
         if cfg is None or self._targets.get(bucket) is None:
             return False
-        tags = {}
-        raw = oi.user_defined.get("x-amz-tagging", "") \
-            if getattr(oi, "user_defined", None) else ""
-        for pair in raw.split("&"):
-            if "=" in pair:
-                k, v = pair.split("=", 1)
-                tags[k] = v
-        rule = cfg.replicate(oi.name, tags,
+        from .crawler import _tags_of
+        rule = cfg.replicate(oi.name, _tags_of(oi),
                              delete_marker=delete and oi.delete_marker,
                              versioned_delete=delete and not oi.delete_marker)
         if rule is None:

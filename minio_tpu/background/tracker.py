@@ -15,7 +15,10 @@ import threading
 
 from ..hashing.xxhash import xxh64
 
-DEFAULT_BITS = 1 << 16      # 64 Kib filter (reference sizes for ~1M keys)
+# 8 Mib filter: with k=4 hashes, ~1M marked paths (2 keys per mutation)
+# gives a ~2% false-positive rate ((1-e^{-kn/m})^k); the previous 64 Kib
+# filter saturated around 50k paths and disabled the skip optimization
+DEFAULT_BITS = 1 << 23
 DEFAULT_HASHES = 4
 MAX_HISTORY = 16            # dataUpdateTrackerKeepCycles
 

@@ -266,19 +266,24 @@ class FSObjects(ObjectLayer):
         for name in self._walk(bucket):
             if prefix and not name.startswith(prefix):
                 continue
-            if marker and name <= marker:
+            rest = name[len(prefix):]
+            item = prefix + rest.split(delimiter, 1)[0] + delimiter \
+                if delimiter and delimiter in rest else None
+            # marker compares against the rolled-up item so that resuming
+            # from a CommonPrefix NextMarker skips the whole prefix instead
+            # of re-emitting it every page
+            if marker and (item or name) <= marker:
                 continue
-            if delimiter:
-                rest = name[len(prefix):]
-                if delimiter in rest:
-                    prefixes.add(prefix + rest.split(delimiter, 1)[0]
-                                 + delimiter)
-                    # prefixes count toward max-keys too (S3 semantics)
-                    if len(out.objects) + len(prefixes) >= max_keys:
-                        out.is_truncated = True
-                        out.next_marker = name
-                        break
+            if item is not None:
+                if item in prefixes:
                     continue
+                prefixes.add(item)
+                # prefixes count toward max-keys too (S3 semantics)
+                if len(out.objects) + len(prefixes) >= max_keys:
+                    out.is_truncated = True
+                    out.next_marker = item
+                    break
+                continue
             out.objects.append(self._info(bucket, name,
                                           self._read_meta(bucket, name)))
             if len(out.objects) + len(prefixes) >= max_keys:

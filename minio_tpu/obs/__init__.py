@@ -1,0 +1,5 @@
+"""Observability: HTTP tracing, structured/audit logging, profiling,
+health diagnostics (reference: pkg/trace, cmd/http-tracer.go, cmd/logger/,
+cmd/utils.go:286 profilers, cmd/healthinfo.go)."""
+
+from . import audit, healthinfo, logger, profiling, trace  # noqa: F401

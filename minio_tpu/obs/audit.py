@@ -1,0 +1,79 @@
+"""Audit logging (cmd/logger/audit.go).
+
+One audit entry per completed API request, containing full request/response
+metadata (but never payloads or credentials), delivered to configured
+webhook targets.  Shape mirrors cmd/logger/message/audit.Entry: version,
+deploymentid, time, trigger, api{name,bucket,object,status,statusCode,
+rx,tx,timeToResponse}, remotehost, requestID, userAgent, requestQuery,
+requestHeader, responseHeader.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List
+
+from .logger import HTTPLogTarget
+from .trace import redact_headers
+
+VERSION = "1"
+
+
+class AuditLog:
+    def __init__(self, deployment_id: str = ""):
+        self.deployment_id = deployment_id
+        self.targets: List[HTTPLogTarget] = []
+        self._mu = threading.Lock()
+        # in-memory tail so tests and the admin API can inspect entries
+        # without an HTTP target
+        self.recent: List[Dict[str, Any]] = []
+        self._recent_max = 256
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.targets) or self._recent_max > 0
+
+    def entry(self, *, api_name: str, bucket: str, obj: str,
+              status_code: int, rx: int, tx: int, duration_ns: int,
+              remote_host: str, request_id: str, user_agent: str,
+              access_key: str, query: Dict[str, str],
+              req_headers: Dict[str, str],
+              resp_headers: Dict[str, str]) -> Dict[str, Any]:
+        return {
+            "version": VERSION,
+            "deploymentid": self.deployment_id,
+            "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "trigger": "incoming",
+            "api": {
+                "name": api_name,
+                "bucket": bucket,
+                "object": obj,
+                "status": "OK" if status_code < 300 else "Failed",
+                "statusCode": status_code,
+                "rx": rx,
+                "tx": tx,
+                "timeToResponse": f"{duration_ns}ns",
+            },
+            "remotehost": remote_host,
+            "requestID": request_id,
+            "userAgent": user_agent,
+            "accessKey": access_key,
+            "requestQuery": dict(query),
+            "requestHeader": redact_headers(req_headers),
+            "responseHeader": dict(resp_headers),
+        }
+
+    def publish(self, entry: Dict[str, Any]) -> None:
+        with self._mu:
+            self.recent.append(entry)
+            if len(self.recent) > self._recent_max:
+                del self.recent[: len(self.recent) - self._recent_max]
+        for t in list(self.targets):
+            try:
+                t.send(entry)
+            except Exception:   # noqa: BLE001 — audit delivery is best-effort
+                pass
+
+
+GLOBAL = AuditLog()

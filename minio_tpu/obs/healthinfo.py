@@ -1,0 +1,107 @@
+"""Health/hardware diagnostics (cmd/healthinfo.go, cmd/admin-handlers.go:1301
+HealthInfoHandler; drive probes mirror peerRESTMethodDriveInfo).
+
+Collects OS, CPU, memory, per-drive capacity/latency and accelerator info
+into one JSON document for `mc admin obd`-style support bundles.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import shutil
+import time
+from typing import Any, Dict, List
+
+
+def _meminfo() -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                parts = line.split()
+                if parts[0].rstrip(":") in ("MemTotal", "MemFree",
+                                            "MemAvailable"):
+                    out[parts[0].rstrip(":")] = int(parts[1]) * 1024
+    except OSError:
+        pass
+    return out
+
+
+def _loadavg() -> List[float]:
+    try:
+        return list(os.getloadavg())
+    except OSError:
+        return []
+
+
+def drive_perf(path: str, probe_bytes: int = 1 << 20) -> Dict[str, Any]:
+    """Tiny write+read latency/throughput probe on one drive root
+    (peerRESTMethodDriveInfo / pkg/disk perf analog)."""
+    fn = os.path.join(path, ".healthprobe.tmp")
+    blob = os.urandom(probe_bytes)
+    t0 = time.perf_counter()
+    with open(fn, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    t1 = time.perf_counter()
+    with open(fn, "rb") as f:
+        f.read()
+    t2 = time.perf_counter()
+    os.remove(fn)
+    return {
+        "path": path,
+        "writeThroughputBps": int(probe_bytes / max(t1 - t0, 1e-9)),
+        "readThroughputBps": int(probe_bytes / max(t2 - t1, 1e-9)),
+        "writeLatencyMs": round((t1 - t0) * 1000, 3),
+    }
+
+
+def drive_usage(path: str) -> Dict[str, Any]:
+    try:
+        u = shutil.disk_usage(path)
+        return {"path": path, "totalBytes": u.total, "usedBytes": u.used,
+                "freeBytes": u.free}
+    except OSError as e:
+        return {"path": path, "error": str(e)}
+
+
+def accelerators() -> List[Dict[str, Any]]:
+    """TPU/accelerator inventory — the build's analog of SMART/NVMe info."""
+    try:
+        import jax
+        return [{"id": d.id, "platform": d.platform,
+                 "kind": getattr(d, "device_kind", "")}
+                for d in jax.devices()]
+    except Exception as e:  # noqa: BLE001 — diagnostics must never fail
+        return [{"error": str(e)}]
+
+
+def collect(drive_paths: List[str] | None = None,
+            perf: bool = False) -> Dict[str, Any]:
+    info: Dict[str, Any] = {
+        "version": "1",
+        "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "os": {
+            "platform": platform.platform(),
+            "kernel": platform.release(),
+            "python": platform.python_version(),
+        },
+        "cpu": {
+            "count": os.cpu_count(),
+            "loadavg": _loadavg(),
+        },
+        "mem": _meminfo(),
+        "accelerators": accelerators(),
+    }
+    if drive_paths:
+        info["drives"] = [drive_usage(p) for p in drive_paths]
+        if perf:
+            info["drivePerf"] = []
+            for p in drive_paths:
+                try:
+                    info["drivePerf"].append(drive_perf(p))
+                except OSError as e:
+                    info["drivePerf"].append({"path": p, "error": str(e)})
+    return info

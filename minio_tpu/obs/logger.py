@@ -1,0 +1,127 @@
+"""Structured logging (cmd/logger/logger.go, cmd/logger/message/log,
+cmd/consolelogger.go, cmd/logger/logonce.go).
+
+A process-global :class:`Logger` fans structured entries out to targets:
+
+* console (stderr, text or JSON mode);
+* an in-memory ring buffer serving the console-UI / ``mc admin logs``
+  stream (cmd/consolelogger.go keeps the last N entries and doubles as a
+  pub/sub for live log streaming);
+* HTTP webhook targets (cmd/logger/target/http) delivering each entry as
+  one JSON document.
+
+``log_once`` deduplicates repeated errors per (message, dedup-key), the
+way cmd/logger/logonce.go rate-limits identical drive errors.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+import urllib.request
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..utils.pubsub import PubSub
+
+FATAL = "FATAL"
+ERROR = "ERROR"
+WARNING = "WARNING"
+INFO = "INFO"
+
+
+class HTTPLogTarget:
+    """cmd/logger/target/http: POST each entry as JSON; drop on failure
+    (the reference buffers 10000 entries in a channel and drops beyond)."""
+
+    def __init__(self, endpoint: str, auth_token: str = "",
+                 timeout: float = 3.0):
+        self.endpoint = endpoint
+        self.auth_token = auth_token
+        self.timeout = timeout
+
+    def send(self, entry: Dict[str, Any]) -> None:
+        req = urllib.request.Request(
+            self.endpoint, data=json.dumps(entry).encode(),
+            headers={"Content-Type": "application/json",
+                     **({"Authorization": self.auth_token}
+                        if self.auth_token else {})})
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            resp.read()
+
+
+class Logger:
+    def __init__(self, node_name: str = "", ring_size: int = 1000,
+                 json_console: bool = False, quiet: bool = False):
+        self.node_name = node_name
+        self.json_console = json_console
+        self.quiet = quiet
+        self.ring: deque = deque(maxlen=ring_size)
+        self.pubsub = PubSub(max_queue=2000)   # live `mc admin logs` stream
+        self.targets: List[HTTPLogTarget] = []
+        self._once: Dict[str, float] = {}
+        self._mu = threading.Lock()
+
+    # -- emit ----------------------------------------------------------
+
+    def _entry(self, level: str, message: str,
+               source: str = "", **kv) -> Dict[str, Any]:
+        return {
+            "level": level,
+            "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "node": self.node_name,
+            "source": source,
+            "message": message,
+            **({"kv": kv} if kv else {}),
+        }
+
+    def log(self, level: str, message: str, source: str = "", **kv) -> None:
+        entry = self._entry(level, message, source, **kv)
+        with self._mu:
+            self.ring.append(entry)
+        self.pubsub.publish(entry)
+        if not self.quiet:
+            if self.json_console:
+                print(json.dumps(entry), file=sys.stderr)
+            else:
+                print(f"{entry['time']} {level}: {message}",
+                      file=sys.stderr)
+        for t in list(self.targets):
+            try:
+                t.send(entry)
+            except Exception:       # noqa: BLE001 — logging never throws
+                pass
+
+    def info(self, message: str, **kv) -> None:
+        self.log(INFO, message, **kv)
+
+    def error(self, message: str, **kv) -> None:
+        self.log(ERROR, message, **kv)
+
+    def warning(self, message: str, **kv) -> None:
+        self.log(WARNING, message, **kv)
+
+    def log_once(self, level: str, message: str, dedup_key: str = "",
+                 interval_s: float = 30.0, **kv) -> bool:
+        """Emit unless the same (key) fired within interval_s
+        (cmd/logger/logonce.go).  Returns True when emitted."""
+        key = dedup_key or message
+        now = time.monotonic()
+        with self._mu:
+            last = self._once.get(key, 0.0)
+            if now - last < interval_s:
+                return False
+            self._once[key] = now
+        self.log(level, message, **kv)
+        return True
+
+    # -- read back -----------------------------------------------------
+
+    def recent(self, n: int = 100) -> List[Dict[str, Any]]:
+        with self._mu:
+            return list(self.ring)[-n:]
+
+
+GLOBAL = Logger(quiet=True)

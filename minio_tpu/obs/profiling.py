@@ -1,0 +1,119 @@
+"""Cluster profiling (cmd/admin-handlers.go:496 StartProfilingHandler,
+cmd/utils.go:286-340 getProfileData).
+
+The reference starts pprof CPU/heap/block/mutex/goroutine profilers on
+every node via peer RPC and later downloads a zip of the dumps.  The
+Python-host equivalents:
+
+* ``cpu``    -> cProfile (pstats dump)
+* ``mem``    -> tracemalloc snapshot (top allocations, text)
+* ``threads``-> live stack dump of all threads (goroutine-profile analog)
+
+A profile session is process-global, like the reference's globalProfiler
+map; starting a new session stops the previous one.
+"""
+
+from __future__ import annotations
+
+import cProfile
+import io
+import pstats
+import sys
+import threading
+import traceback
+import zipfile
+from typing import Dict, Optional
+
+PROFILER_TYPES = ("cpu", "mem", "threads")
+
+
+class _Session:
+    def __init__(self, kinds):
+        self.kinds = kinds
+        self.cpu: Optional[cProfile.Profile] = None
+        self.mem_started = False
+
+
+_current: Optional[_Session] = None
+_mu = threading.Lock()
+
+
+def start(kinds_csv: str = "cpu") -> list:
+    """Start profilers; returns the list of started kinds."""
+    global _current
+    kinds = [k.strip() for k in kinds_csv.split(",") if k.strip()]
+    bad = [k for k in kinds if k not in PROFILER_TYPES]
+    if bad:
+        raise ValueError(f"unknown profiler type(s): {','.join(bad)}")
+    with _mu:
+        if _current is not None:
+            _stop_locked()
+        sess = _Session(kinds)
+        if "cpu" in kinds:
+            sess.cpu = cProfile.Profile()
+            sess.cpu.enable()
+        if "mem" in kinds:
+            import tracemalloc
+            if not tracemalloc.is_tracing():
+                tracemalloc.start()
+            sess.mem_started = True
+        _current = sess
+    return kinds
+
+
+def _threads_dump() -> bytes:
+    out = io.StringIO()
+    frames = sys._current_frames()
+    for t in threading.enumerate():
+        out.write(f"--- thread {t.name} (daemon={t.daemon}) ---\n")
+        frame = frames.get(t.ident or -1)
+        if frame is not None:
+            traceback.print_stack(frame, file=out)
+        out.write("\n")
+    return out.getvalue().encode()
+
+
+def _stop_locked() -> Dict[str, bytes]:
+    global _current
+    sess, _current = _current, None
+    dumps: Dict[str, bytes] = {}
+    if sess is None:
+        return dumps
+    if sess.cpu is not None:
+        sess.cpu.disable()
+        buf = io.StringIO()
+        pstats.Stats(sess.cpu, stream=buf).sort_stats(
+            "cumulative").print_stats(100)
+        dumps["profile-cpu.txt"] = buf.getvalue().encode()
+        raw = io.BytesIO()
+        # marshaled stats for offline tooling (pstats.Stats can reload it)
+        sess.cpu.create_stats()
+        import marshal
+        marshal.dump(sess.cpu.stats, raw)
+        dumps["profile-cpu.pstats"] = raw.getvalue()
+    if sess.mem_started:
+        import tracemalloc
+        snap = tracemalloc.take_snapshot()
+        lines = [str(s) for s in snap.statistics("lineno")[:100]]
+        dumps["profile-mem.txt"] = "\n".join(lines).encode()
+        tracemalloc.stop()
+    if "threads" in sess.kinds:
+        dumps["profile-threads.txt"] = _threads_dump()
+    return dumps
+
+
+def stop_zip() -> bytes:
+    """Stop the session, return a zip of all dumps (cmd/utils.go:318
+    builds the same shape: one file per node per profiler type)."""
+    with _mu:
+        dumps = _stop_locked()
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        for name, data in dumps.items():
+            z.writestr(name, data)
+    return buf.getvalue()
+
+
+def running() -> list:
+    with _mu:
+        return list(_current.kinds) if _current else []

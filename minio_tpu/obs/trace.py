@@ -1,0 +1,78 @@
+"""HTTP request tracing (pkg/trace/trace.go:26-40, cmd/http-tracer.go:164).
+
+Every S3/admin request is summarised as a ``trace.Info``-shaped dict and
+published to the global :data:`HTTP_TRACE` pub/sub.  ``mc admin trace``
+equivalents subscribe via the admin ``trace`` route and stream JSON lines;
+on a cluster the admin node aggregates peer streams over the internode RPC
+(peerRESTMethodTrace, cmd/peer-rest-common.go:54).
+
+Publishing is skipped entirely when nobody is subscribed, mirroring the
+reference's ``globalHTTPTrace.NumSubscribers() > 0`` guard.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict
+
+from ..utils.pubsub import PubSub
+
+# global trace hub (reference: globalHTTPTrace)
+HTTP_TRACE = PubSub(max_queue=4000)
+
+# headers never to leak into traces (cmd/http-tracer.go redacts these)
+_REDACTED_HEADERS = {"authorization", "x-amz-security-token",
+                     "x-amz-server-side-encryption-customer-key",
+                     "x-amz-copy-source-server-side-encryption-customer-key"}
+
+
+def redact_headers(headers: Dict[str, str]) -> Dict[str, str]:
+    return {k: ("*REDACTED*" if k.lower() in _REDACTED_HEADERS else v)
+            for k, v in headers.items()}
+
+
+def make_trace(node_name: str, func_name: str, *, method: str, path: str,
+               raw_query: str, client: str, req_headers: Dict[str, str],
+               status_code: int, resp_headers: Dict[str, str],
+               input_bytes: int, output_bytes: int,
+               start_ns: int, ttfb_ns: int, duration_ns: int,
+               trace_type: str = "http", error: str = "") -> Dict[str, Any]:
+    """Build a trace.Info-shaped record (pkg/trace/trace.go:26-40)."""
+    return {
+        "type": trace_type,
+        "nodeName": node_name,
+        "funcName": func_name,
+        "time": start_ns,
+        "reqInfo": {
+            "time": start_ns,
+            "method": method,
+            "path": path,
+            "rawQuery": raw_query,
+            "client": client,
+            "headers": redact_headers(req_headers),
+        },
+        "respInfo": {
+            "time": start_ns + duration_ns,
+            "statusCode": status_code,
+            "headers": dict(resp_headers),
+        },
+        "callStats": {
+            "inputBytes": input_bytes,
+            "outputBytes": output_bytes,
+            "latency_ns": duration_ns,
+            "timeToFirstByte_ns": ttfb_ns,
+        },
+        **({"error": error} if error else {}),
+    }
+
+
+def publish(info: Dict[str, Any]) -> None:
+    HTTP_TRACE.publish(info)
+
+
+def subscribers() -> int:
+    return HTTP_TRACE.num_subscribers
+
+
+def now_ns() -> int:
+    return time.time_ns()

@@ -113,6 +113,24 @@ class S3Server:
         self.tracker = None      # DataUpdateTracker (crawler bloom filter)
         from ..crypto.kms import LocalKMS
         self.kms = LocalKMS.from_env_or_store(object_layer)
+        # observability (cmd/http-tracer.go, cmd/logger/audit.go):
+        # trace hub is process-global (mirrors globalHTTPTrace); audit
+        # log is per-server so deployments keep entries separate
+        from ..obs import audit as _obs_audit
+        from ..obs import logger as _obs_logger
+        from ..obs import trace as _obs_trace
+        self.trace_hub = _obs_trace.HTTP_TRACE
+        self.audit = _obs_audit.AuditLog()
+        self.logger = _obs_logger.GLOBAL
+        self.node_name = f"{host}:{port}"
+        if self.config.get("audit_webhook", "enable") == "on":
+            self.audit.targets.append(_obs_logger.HTTPLogTarget(
+                self.config.get("audit_webhook", "endpoint"),
+                self.config.get("audit_webhook", "auth_token")))
+        if self.config.get("logger_webhook", "enable") == "on":
+            self.logger.targets.append(_obs_logger.HTTPLogTarget(
+                self.config.get("logger_webhook", "endpoint"),
+                self.config.get("logger_webhook", "auth_token")))
         if self.config.get("compression", "enable") == "on":
             # build/load the native codec BEFORE serving so the first
             # request never blocks on a compile, and say which engine runs
@@ -155,6 +173,49 @@ class S3Server:
         (no-op until ReplicationSys is attached)."""
         if self.replication is not None:
             self.replication.queue(bucket, oi, delete=delete)
+
+
+def _api_name(method: str, bucket: str, key: str, q1: dict) -> str:
+    """Best-effort S3 API name for traces/audit (the reference names come
+    from mux route registration, cmd/api-router.go)."""
+    if bucket.startswith("minio-tpu") or not bucket:
+        if method == "POST" and not bucket:
+            return "STS"
+        return "AdminAPI" if bucket else "ListBuckets"
+    sub = {"uploads": "MultipartUpload", "uploadId": "MultipartUpload",
+           "tagging": "Tagging", "retention": "Retention",
+           "legal-hold": "LegalHold", "select": "SelectObjectContent",
+           "versioning": "Versioning", "policy": "BucketPolicy",
+           "lifecycle": "BucketLifecycle", "encryption": "BucketEncryption",
+           "replication": "BucketReplication", "notification":
+           "BucketNotification", "object-lock": "ObjectLockConfig",
+           "versions": "ListObjectVersions", "delete": "DeleteObjects"}
+    feature = next((v for k, v in sub.items() if k in q1), "")
+    if key:
+        base = {"GET": "GetObject", "HEAD": "HeadObject",
+                "PUT": "PutObject", "DELETE": "DeleteObject",
+                "POST": "PostObject"}.get(method, method)
+        if feature and feature != "MultipartUpload":
+            return {"GET": "Get", "PUT": "Put",
+                    "DELETE": "Delete"}.get(method, "") + feature \
+                if feature in ("Tagging", "Retention", "LegalHold") \
+                else feature
+        if feature == "MultipartUpload":
+            return {"POST": "CompleteMultipartUpload"
+                    if "uploadId" in q1 else "CreateMultipartUpload",
+                    "PUT": "UploadPart", "GET": "ListParts",
+                    "DELETE": "AbortMultipartUpload"}.get(method, base)
+        return base
+    base = {"GET": "ListObjectsV2" if q1.get("list-type") == "2"
+            else "ListObjectsV1",
+            "HEAD": "HeadBucket", "PUT": "MakeBucket",
+            "DELETE": "DeleteBucket", "POST": "PostPolicyBucket"}
+    if feature:
+        return ({"GET": "Get", "PUT": "Put", "DELETE": "Delete"}
+                .get(method, "") + feature) \
+            if feature.startswith("Bucket") or feature == "Versioning" \
+            else feature
+    return base.get(method, method)
 
 
 def _make_handler(srv: S3Server):
@@ -272,8 +333,17 @@ def _make_handler(srv: S3Server):
             mtr.inc("mt_s3_requests_total",
                     {"method": self.command, "status": str(status)})
             mtr.inc("mt_s3_tx_bytes_total", value=len(body))
+            self._resp_status = status
+            self._resp_headers = dict(headers or {})
+            self._resp_bytes = getattr(self, "_resp_bytes", 0) + len(body)
+            if not getattr(self, "_ttfb_ns", 0) and \
+                    getattr(self, "_t0_ns", 0):
+                import time as _time
+                self._ttfb_ns = _time.time_ns() - self._t0_ns
             self.send_response(status)
-            self.send_header("x-amz-request-id", uuid.uuid4().hex[:16])
+            self.send_header("x-amz-request-id",
+                             getattr(self, "_req_id", None)
+                             or uuid.uuid4().hex[:16])
             self.send_header("Server", "MinioTPU")
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
@@ -299,6 +369,57 @@ def _make_handler(srv: S3Server):
             self._send(api.http_status, s3err.to_xml(api, resource))
 
         def _dispatch(self):
+            """Trace/audit wrapper around the real dispatcher
+            (cmd/http-tracer.go httpTraceAll + cmd/logger/audit.go)."""
+            from ..obs import trace as _trace
+            self._t0_ns = _trace.now_ns()
+            self._req_id = uuid.uuid4().hex[:16]
+            self._resp_status = 0
+            self._resp_headers = {}
+            self._resp_bytes = 0
+            self._ttfb_ns = 0
+            self._rx_bytes = 0
+            try:
+                self._dispatch_inner()
+            finally:
+                try:
+                    self._record_request()
+                except Exception:   # noqa: BLE001 — never fail a request
+                    pass            # on account of observability
+
+        def _record_request(self):
+            from ..obs import trace as _trace
+            dur = _trace.now_ns() - self._t0_ns
+            path, bucket, key, query = self._split()
+            q1 = {k: v[0] for k, v in query.items()}
+            api_name = _api_name(self.command, bucket, key, q1)
+            if srv.trace_hub.num_subscribers > 0:
+                srv.trace_hub.publish(_trace.make_trace(
+                    srv.node_name, api_name,
+                    method=self.command, path=path,
+                    raw_query="&".join(f"{k}={v}" for k, v in q1.items()),
+                    client=self.client_address[0],
+                    req_headers=dict(self.headers.items()),
+                    status_code=self._resp_status,
+                    resp_headers=self._resp_headers,
+                    input_bytes=self._rx_bytes,
+                    output_bytes=self._resp_bytes,
+                    start_ns=self._t0_ns, ttfb_ns=self._ttfb_ns,
+                    duration_ns=dur))
+            if srv.audit.targets or srv.audit.recent is not None:
+                srv.audit.publish(srv.audit.entry(
+                    api_name=api_name, bucket=bucket, obj=key,
+                    status_code=self._resp_status, rx=self._rx_bytes,
+                    tx=self._resp_bytes, duration_ns=dur,
+                    remote_host=self.client_address[0],
+                    request_id=self._req_id,
+                    user_agent=self.headers.get("User-Agent", ""),
+                    access_key=getattr(self, "access_key", ""),
+                    query=q1,
+                    req_headers=dict(self.headers.items()),
+                    resp_headers=self._resp_headers))
+
+        def _dispatch_inner(self):
             path, bucket, key, query = self._split()
             from ..admin import handlers as admin_handlers
             from ..admin.metrics import GLOBAL as mtr
@@ -309,6 +430,7 @@ def _make_handler(srv: S3Server):
                         raise S3Error("MethodNotAllowed")
                     return admin_handlers.handle(self, srv, path, query, b"")
                 payload = self._body()
+                self._rx_bytes = len(payload)
                 mtr.inc("mt_s3_rx_bytes_total", value=len(payload))
                 payload = self._auth(path, query, payload)
                 if path.startswith("/minio-tpu/"):

@@ -65,8 +65,10 @@ register_subsys("compression", {
     "extensions": ".txt,.log,.csv,.json,.tar,.xml,.bin",
     "mime_types": "text/*,application/json,application/xml",
 })
-register_subsys("logger_webhook", {"enable": "off", "endpoint": ""})
-register_subsys("audit_webhook", {"enable": "off", "endpoint": ""})
+register_subsys("logger_webhook", {"enable": "off", "endpoint": "",
+                                   "auth_token": ""})
+register_subsys("audit_webhook", {"enable": "off", "endpoint": "",
+                                  "auth_token": ""})
 register_subsys("notify_webhook", {"enable": "off", "endpoint": "",
                                    "auth_token": "", "queue_dir": ""})
 

@@ -155,6 +155,27 @@ def test_prefix_rollup_respects_max_keys(fs):
     assert not res2.is_truncated
 
 
+def test_delimiter_pagination_no_duplicate_prefixes(fs):
+    """Truncating mid-prefix must not re-emit the same CommonPrefix on the
+    next page (S3 aggregation semantics)."""
+    fs.make_bucket("bbb")
+    for k in ["a/1", "a/2", "a/3", "b/1", "c", "d/9"]:
+        fs.put_object("bbb", k, b"d")
+    seen_prefixes, seen_keys, marker, pages = [], [], "", 0
+    while True:
+        res = fs.list_objects("bbb", delimiter="/", marker=marker,
+                              max_keys=1)
+        seen_prefixes += res.prefixes
+        seen_keys += [o.name for o in res.objects]
+        pages += 1
+        assert pages < 20
+        if not res.is_truncated:
+            break
+        marker = res.next_marker
+    assert seen_prefixes == ["a/", "b/", "d/"]
+    assert seen_keys == ["c"]
+
+
 def test_fs_heal_is_clean_noop(fs):
     fs.make_bucket("bbb")
     fs.put_object("bbb", "k", b"x")
