@@ -17,6 +17,7 @@ way cmd/logger/logonce.go rate-limits identical drive errors.
 from __future__ import annotations
 
 import json
+import queue
 import sys
 import threading
 import time
@@ -33,16 +34,25 @@ INFO = "INFO"
 
 
 class HTTPLogTarget:
-    """cmd/logger/target/http: POST each entry as JSON; drop on failure
-    (the reference buffers 10000 entries in a channel and drops beyond)."""
+    """cmd/logger/target/http: entries go into a bounded in-memory queue
+    drained by one background sender thread (the reference buffers 10000
+    entries in a channel); a full queue or failed POST drops the entry —
+    log/audit delivery must never add latency to the request path."""
+
+    QUEUE_SIZE = 10000
 
     def __init__(self, endpoint: str, auth_token: str = "",
-                 timeout: float = 3.0):
+                 timeout: float = 3.0, sync: bool = False):
         self.endpoint = endpoint
         self.auth_token = auth_token
         self.timeout = timeout
+        self.dropped = 0
+        self._sync = sync            # tests: deliver inline
+        self._q: "queue.Queue[Dict[str, Any]]" = queue.Queue(
+            self.QUEUE_SIZE)
+        self._worker: threading.Thread | None = None
 
-    def send(self, entry: Dict[str, Any]) -> None:
+    def _post(self, entry: Dict[str, Any]) -> None:
         req = urllib.request.Request(
             self.endpoint, data=json.dumps(entry).encode(),
             headers={"Content-Type": "application/json",
@@ -50,6 +60,36 @@ class HTTPLogTarget:
                         if self.auth_token else {})})
         with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             resp.read()
+
+    def _drain(self) -> None:
+        while True:
+            entry = self._q.get()
+            try:
+                self._post(entry)
+            except Exception:   # noqa: BLE001 — drop, never propagate
+                self.dropped += 1
+
+    def send(self, entry: Dict[str, Any]) -> None:
+        if self._sync:
+            self._post(entry)
+            return
+        if self._worker is None:
+            self._worker = threading.Thread(target=self._drain,
+                                            daemon=True)
+            self._worker.start()
+        try:
+            self._q.put_nowait(entry)
+        except queue.Full:
+            self.dropped += 1
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Best-effort wait for the queue to empty (tests/shutdown)."""
+        import time as _time
+        deadline = _time.monotonic() + timeout
+        while not self._q.empty() and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        _time.sleep(0.05)   # let the in-flight POST (already dequeued)
+        # finish; flush is best-effort by contract
 
 
 class Logger:

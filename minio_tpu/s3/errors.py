@@ -196,11 +196,21 @@ _ERRORS = {
     "JSONParsingError": APIError(
         "JSONParsingError", "Encountered an error parsing the JSON file. "
         "Check the file and try again.", 400),
+    "MalformedPOSTRequest": APIError(
+        "MalformedPOSTRequest", "The body of your POST request is not "
+        "well-formed multipart/form-data.", 400),
+    "EntityTooSmall": APIError(
+        "EntityTooSmall", "Your proposed upload is smaller than the "
+        "minimum allowed object size.", 400),
 }
 
 
 def get(code: str) -> APIError:
     return _ERRORS.get(code, _ERRORS["InternalError"])
+
+
+def has(code: str) -> bool:
+    return code in _ERRORS
 
 
 def from_object_error(e: Exception) -> APIError:

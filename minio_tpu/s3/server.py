@@ -178,7 +178,7 @@ class S3Server:
 def _api_name(method: str, bucket: str, key: str, q1: dict) -> str:
     """Best-effort S3 API name for traces/audit (the reference names come
     from mux route registration, cmd/api-router.go)."""
-    if bucket.startswith("minio-tpu") or not bucket:
+    if bucket == "minio-tpu" or not bucket:
         if method == "POST" and not bucket:
             return "STS"
         return "AdminAPI" if bucket else "ListBuckets"
@@ -259,7 +259,9 @@ def _make_handler(srv: S3Server):
             hdrs = {k: v for k, v in self.headers.items()}
             try:
                 if "Authorization" not in hdrs and \
-                        "X-Amz-Signature" not in query:
+                        "X-Amz-Signature" not in query and \
+                        not ("Signature" in query and
+                             "AWSAccessKeyId" in query):
                     # anonymous request: authorization happens against the
                     # bucket policy alone (cmd/auth-handler.go authTypeAnonymous)
                     self.access_key = ""
@@ -267,6 +269,19 @@ def _make_handler(srv: S3Server):
                     if sha and sha != sigv4.UNSIGNED_PAYLOAD:
                         if hashlib.sha256(payload).hexdigest() != sha:
                             raise S3Error("BadDigest")
+                    return payload
+                auth_hdr = hdrs.get("Authorization", "")
+                if auth_hdr.startswith("AWS "):
+                    # Signature V2 header auth (cmd/signature-v2.go)
+                    from . import sigv2
+                    self.access_key = sigv2.verify_request(
+                        lookup, self.command, path, query, hdrs)
+                    return payload
+                if "Signature" in query and "AWSAccessKeyId" in query:
+                    # presigned V2
+                    from . import sigv2
+                    self.access_key = sigv2.verify_presigned(
+                        lookup, self.command, path, query, hdrs)
                     return payload
                 if "X-Amz-Signature" in query:
                     self.access_key = sigv4.verify_presigned(
@@ -772,6 +787,9 @@ def _make_handler(srv: S3Server):
                 return self._listen_notification(bucket, query)
             if cmd == "POST" and "delete" in query:
                 return self._delete_objects(bucket, payload)
+            if cmd == "POST" and (self.headers.get("Content-Type") or ""
+                                  ).startswith("multipart/form-data"):
+                return self._post_policy_upload(bucket, payload)
             if cmd == "GET" and "uploads" in query:
                 self._allow(iampol.LIST_MULTIPART_UPLOADS, bucket)
                 return self._list_uploads(bucket, query)
@@ -801,6 +819,65 @@ def _make_handler(srv: S3Server):
                 self._allow(iampol.LIST_BUCKET, bucket)
                 return self._list_objects(bucket, query)
             raise S3Error("MethodNotAllowed")
+
+        def _post_policy_upload(self, bucket, payload):
+            """Browser POST upload (cmd/object-handlers.go
+            PostPolicyBucketHandler): authenticate via the policy
+            signature in the form, validate conditions, store the file
+            field as the object."""
+            from . import postpolicy
+            try:
+                fields, file_data, filename = postpolicy.parse_form(
+                    payload, self.headers.get("Content-Type", ""))
+                key = fields.get("key", "")
+                if not key:
+                    raise S3Error("InvalidArgument")
+                key = key.replace("${filename}", filename)
+                self.access_key = postpolicy.verify_signature(
+                    srv.iam.lookup_secret, fields, srv.region)
+                postpolicy.check_policy(
+                    fields.get("policy", ""),
+                    {**fields, "key": key, "bucket": bucket},
+                    len(file_data))
+            except sigv4.SigV4Error as e:
+                raise S3Error(e.code if s3err.has(e.code)
+                              else "AccessDenied") from e
+            self._allow(iampol.PUT_OBJECT, f"{bucket}/{key}")
+            if len(file_data) > MAX_OBJECT_SIZE:
+                raise S3Error("EntityTooLarge")
+            user_defined = {}
+            if fields.get("content-type"):
+                user_defined["content-type"] = fields["content-type"]
+            for k, v in fields.items():
+                if k.startswith("x-amz-meta-"):
+                    user_defined[k] = v
+            if fields.get("tagging"):
+                from ..bucket import tags as btags
+                try:
+                    user_defined["x-amz-tagging"] = btags.to_header(
+                        btags.parse_xml(fields["tagging"].encode()))
+                except btags.TagError as e:
+                    raise S3Error("InvalidTag") from e
+            oi, hdrs = self._store_object(bucket, key, file_data,
+                                          user_defined,
+                                          "s3:ObjectCreated:Post")
+            hdrs["Location"] = f"/{bucket}/{urllib.parse.quote(key)}"
+            redirect = fields.get("success_action_redirect", "")
+            if redirect:
+                sep = "&" if "?" in redirect else "?"
+                hdrs["Location"] = redirect + sep + urllib.parse.urlencode(
+                    {"bucket": bucket, "key": key, "etag": f'"{oi.etag}"'})
+                return self._send(303, headers=hdrs)
+            status = fields.get("success_action_status", "204")
+            if status == "201":
+                root = ET.Element("PostResponse")
+                ET.SubElement(root, "Location").text = hdrs["Location"]
+                ET.SubElement(root, "Bucket").text = bucket
+                ET.SubElement(root, "Key").text = key
+                ET.SubElement(root, "ETag").text = hdrs["ETag"]
+                return self._send(201, _xml(root), headers=hdrs)
+            return self._send(200 if status == "200" else 204,
+                              headers=hdrs)
 
         def _put_versioning(self, bucket, payload):
             srv.layer.get_bucket_info(bucket)
@@ -1389,6 +1466,16 @@ def _make_handler(srv: S3Server):
                 if h.lower().startswith("x-amz-meta-"):
                     user_defined[h.lower()] = v
             user_defined.update(self._tagging_header_meta())
+            oi, hdrs = self._store_object(bucket, key, payload,
+                                          user_defined,
+                                          "s3:ObjectCreated:Put")
+            self._send(200, headers=hdrs)
+
+        def _store_object(self, bucket, key, payload, user_defined,
+                          event_name):
+            """Shared tail of every simple write path (PUT and POST
+            policy): quota, compression, SSE, lock defaults, store,
+            notify, replicate.  Returns (oi, response_headers)."""
             user_defined.update(self._lock_headers(bucket, key))
             self._check_quota(bucket, len(payload))
             from ..crypto import sse as csse
@@ -1405,9 +1492,9 @@ def _make_handler(srv: S3Server):
             hdrs.update(csse.response_headers(user_defined))
             if oi.version_id:
                 hdrs["x-amz-version-id"] = oi.version_id
-            srv.notify("s3:ObjectCreated:Put", bucket, oi)
+            srv.notify(event_name, bucket, oi)
             srv.replicate(bucket, oi)
-            self._send(200, headers=hdrs)
+            return oi, hdrs
 
         # -- CopyObject / UploadPartCopy (cmd/object-handlers.go:886,
         # cmd/object-multipart-handlers.go CopyObjectPartHandler) ----------

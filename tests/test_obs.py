@@ -59,9 +59,16 @@ def test_audit_entries(server, client):
     if not client.head_bucket("tracebkt"):
         client.make_bucket("tracebkt")
     client.put_object("tracebkt", "o3", b"abc")
-    entries = [e for e in server.audit.recent
-               if e["api"]["name"] == "PutObject"
-               and e["api"]["object"] == "o3"]
+    # the audit entry lands after the response is written; poll briefly
+    import time
+    entries = []
+    for _ in range(100):
+        entries = [e for e in server.audit.recent
+                   if e["api"]["name"] == "PutObject"
+                   and e["api"]["object"] == "o3"]
+        if entries:
+            break
+        time.sleep(0.02)
     assert entries
     e = entries[-1]
     assert e["api"]["bucket"] == "tracebkt"
@@ -124,6 +131,7 @@ def test_logger_once_and_webhook():
     assert lg.log_once(logger.ERROR, "disk offline", dedup_key="d1")
     assert not lg.log_once(logger.ERROR, "disk offline", dedup_key="d1")
     assert lg.log_once(logger.ERROR, "disk offline", dedup_key="d2")
+    lg.targets[0].flush()
     httpd.shutdown()
     assert len(received) == 2
     assert received[0]["message"] == "disk offline"
@@ -155,6 +163,7 @@ def test_audit_webhook_delivery():
         request_id="rid", user_agent="ua", access_key="ak",
         query={}, req_headers={"Authorization": "secret"},
         resp_headers={}))
+    alog.targets[0].flush()
     httpd.shutdown()
     assert received[0]["api"]["name"] == "GetObject"
     assert received[0]["deploymentid"] == "dep-1"

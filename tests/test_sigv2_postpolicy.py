@@ -1,0 +1,298 @@
+"""Signature V2 and POST-policy upload tests
+(cmd/signature-v2_test.go, cmd/postpolicyform_test.go,
+cmd/post-policy_test.go tiers)."""
+
+import base64
+import datetime
+import email.utils
+import http.client
+import json
+import time
+import urllib.parse
+
+import pytest
+
+from minio_tpu.s3 import postpolicy, sigv2
+from minio_tpu.s3.client import S3Client, S3ClientError
+from minio_tpu.s3.sigv4 import SigV4Error
+from minio_tpu.server_main import build_server
+
+AK, SK = "v2key", "v2secret12345"
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("v2drives")
+    dirs = [str(tmp / f"d{i}") for i in range(4)]
+    srv = build_server(dirs, address="127.0.0.1:0", access_key=AK,
+                       secret_key=SK, backend="numpy")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    c = S3Client(server.endpoint, AK, SK)
+    c.make_bucket("v2bkt")
+    return c
+
+
+def _raw(server, method, path, headers=None, body=b"", query=""):
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    conn.request(method, path + (f"?{query}" if query else ""),
+                 body=body, headers=headers or {})
+    r = conn.getresponse()
+    out = r.status, dict(r.getheaders()), r.read()
+    conn.close()
+    return out
+
+
+# -- unit: V2 signatures ---------------------------------------------------
+
+def _lookup(ak):
+    return SK if ak == AK else None
+
+
+def test_v2_header_roundtrip():
+    headers = {"Date": email.utils.formatdate(usegmt=True),
+               "Content-Type": "text/plain",
+               "x-amz-meta-a": "1"}
+    auth = sigv2.sign_header(AK, SK, "PUT", "/bkt/obj",
+                             {"uploads": [""]}, headers)
+    headers["Authorization"] = auth
+    got = sigv2.verify_request(_lookup, "PUT", "/bkt/obj",
+                               {"uploads": [""]}, headers)
+    assert got == AK
+
+
+def test_v2_header_tamper_fails():
+    headers = {"Date": email.utils.formatdate(usegmt=True)}
+    headers["Authorization"] = sigv2.sign_header(
+        AK, SK, "GET", "/bkt/obj", {}, headers)
+    with pytest.raises(SigV4Error) as ei:
+        sigv2.verify_request(_lookup, "GET", "/bkt/other", {}, headers)
+    assert ei.value.code == "SignatureDoesNotMatch"
+
+
+def test_v2_subresource_affects_signature():
+    headers = {"Date": email.utils.formatdate(usegmt=True)}
+    auth = sigv2.sign_header(AK, SK, "GET", "/bkt/obj",
+                             {"acl": [""]}, headers)
+    headers["Authorization"] = auth
+    # same path without the subresource must not verify
+    with pytest.raises(SigV4Error):
+        sigv2.verify_request(_lookup, "GET", "/bkt/obj", {}, headers)
+    # non-whitelisted query params are NOT part of the resource
+    assert sigv2.canonicalized_resource("/b/o", {"foo": ["1"]}) == "/b/o"
+
+
+def test_v2_presign_roundtrip_and_expiry():
+    exp = int(time.time()) + 60
+    qs = sigv2.presign(AK, SK, "GET", "/bkt/obj", exp)
+    query = urllib.parse.parse_qs(qs, keep_blank_values=True)
+    assert sigv2.verify_presigned(_lookup, "GET", "/bkt/obj", query) == AK
+    with pytest.raises(SigV4Error) as ei:
+        sigv2.verify_presigned(_lookup, "GET", "/bkt/obj", query,
+                               now=exp + 1)
+    assert ei.value.code == "AccessDenied"
+
+
+# -- server: V2 round trips ------------------------------------------------
+
+def test_server_v2_header_put_get(server, client):
+    path = "/v2bkt/v2-object.txt"
+    headers = {"Date": email.utils.formatdate(usegmt=True),
+               "Content-Type": "text/plain",
+               "Content-Length": "9"}
+    headers["Authorization"] = sigv2.sign_header(
+        AK, SK, "PUT", path, {}, headers)
+    status, _, _ = _raw(server, "PUT", path, headers, b"v2 bytes!")
+    assert status == 200
+    headers = {"Date": email.utils.formatdate(usegmt=True)}
+    headers["Authorization"] = sigv2.sign_header(
+        AK, SK, "GET", path, {}, headers)
+    status, _, body = _raw(server, "GET", path, headers)
+    assert status == 200 and body == b"v2 bytes!"
+
+
+def test_server_v2_presigned_get(server, client):
+    client.put_object("v2bkt", "presv2.bin", b"presigned-v2")
+    qs = sigv2.presign(AK, SK, "GET", "/v2bkt/presv2.bin",
+                       int(time.time()) + 120)
+    status, _, body = _raw(server, "GET", "/v2bkt/presv2.bin", query=qs)
+    assert status == 200 and body == b"presigned-v2"
+
+
+def test_server_v2_bad_signature_rejected(server):
+    headers = {"Date": email.utils.formatdate(usegmt=True),
+               "Authorization": f"AWS {AK}:AAAAAAAAAAAAAAAAAAAAAAAAAAA="}
+    status, _, body = _raw(server, "GET", "/v2bkt/presv2.bin", headers)
+    assert status == 403
+
+
+# -- POST policy -----------------------------------------------------------
+
+def _form_body(fields, file_data, filename="upload.bin"):
+    b = "xxxxboundary7351"
+    parts = []
+    for k, v in fields.items():
+        parts.append(f"--{b}\r\nContent-Disposition: form-data; "
+                     f"name=\"{k}\"\r\n\r\n{v}\r\n")
+    parts.append(f"--{b}\r\nContent-Disposition: form-data; "
+                 f"name=\"file\"; filename=\"{filename}\"\r\n"
+                 f"Content-Type: application/octet-stream\r\n\r\n")
+    body = "".join(parts).encode() + file_data + f"\r\n--{b}--\r\n".encode()
+    return body, f"multipart/form-data; boundary={b}"
+
+
+def _policy_doc(bucket, prefix, max_size=1 << 20):
+    exp = (datetime.datetime.now(datetime.timezone.utc)
+           + datetime.timedelta(minutes=5))
+    return {
+        "expiration": exp.strftime("%Y-%m-%dT%H:%M:%S.000Z"),
+        "conditions": [
+            {"bucket": bucket},
+            ["starts-with", "$key", prefix],
+            ["content-length-range", 1, max_size],
+        ],
+    }
+
+
+def test_post_policy_upload_v4(server, client):
+    fields = postpolicy.sign_policy_v4(
+        AK, SK, _policy_doc("v2bkt", "posted/"), "us-east-1")
+    fields["key"] = "posted/${filename}"
+    fields["success_action_status"] = "201"
+    body, ct = _form_body(fields, b"posted payload", filename="note.txt")
+    status, hdrs, resp = _raw(server, "POST", "/v2bkt",
+                              {"Content-Type": ct,
+                               "Content-Length": str(len(body))}, body)
+    assert status == 201, resp
+    assert b"<Key>posted/note.txt</Key>" in resp
+    assert client.get_object("v2bkt", "posted/note.txt").body == \
+        b"posted payload"
+
+
+def test_post_policy_key_condition_enforced(server):
+    fields = postpolicy.sign_policy_v4(
+        AK, SK, _policy_doc("v2bkt", "allowed/"), "us-east-1")
+    fields["key"] = "forbidden/esc.txt"
+    body, ct = _form_body(fields, b"x")
+    status, _, resp = _raw(server, "POST", "/v2bkt",
+                           {"Content-Type": ct,
+                            "Content-Length": str(len(body))}, body)
+    assert status == 403, resp
+
+
+def test_post_policy_size_range_enforced(server):
+    fields = postpolicy.sign_policy_v4(
+        AK, SK, _policy_doc("v2bkt", "sized/", max_size=4), "us-east-1")
+    fields["key"] = "sized/too-big.bin"
+    body, ct = _form_body(fields, b"five5")
+    status, _, resp = _raw(server, "POST", "/v2bkt",
+                           {"Content-Type": ct,
+                            "Content-Length": str(len(body))}, body)
+    assert status == 400 and b"EntityTooLarge" in resp
+
+
+def test_post_policy_expired(server):
+    doc = _policy_doc("v2bkt", "late/")
+    doc["expiration"] = "2001-01-01T00:00:00.000Z"
+    fields = postpolicy.sign_policy_v4(AK, SK, doc, "us-east-1")
+    fields["key"] = "late/x"
+    body, ct = _form_body(fields, b"y")
+    status, _, _ = _raw(server, "POST", "/v2bkt",
+                        {"Content-Type": ct,
+                         "Content-Length": str(len(body))}, body)
+    assert status == 403
+
+
+def test_post_policy_v2_signature(server, client):
+    doc = _policy_doc("v2bkt", "v2post/")
+    policy_b64 = base64.b64encode(json.dumps(doc).encode()).decode()
+    import hashlib
+    import hmac as hmac_mod
+    sig = base64.b64encode(hmac_mod.new(
+        SK.encode(), policy_b64.encode(), hashlib.sha1).digest()).decode()
+    fields = {"policy": policy_b64, "AWSAccessKeyId": AK,
+              "signature": sig, "key": "v2post/k.bin"}
+    body, ct = _form_body(fields, b"v2 posted")
+    status, _, resp = _raw(server, "POST", "/v2bkt",
+                           {"Content-Type": ct,
+                            "Content-Length": str(len(body))}, body)
+    assert status == 204, resp
+    assert client.get_object("v2bkt", "v2post/k.bin").body == b"v2 posted"
+
+
+def test_post_policy_bad_signature(server):
+    fields = postpolicy.sign_policy_v4(
+        AK, SK, _policy_doc("v2bkt", "sig/"), "us-east-1")
+    fields["key"] = "sig/x"
+    fields["x-amz-signature"] = "0" * 64
+    body, ct = _form_body(fields, b"z")
+    status, _, _ = _raw(server, "POST", "/v2bkt",
+                        {"Content-Type": ct,
+                         "Content-Length": str(len(body))}, body)
+    assert status == 403
+
+
+def test_post_policy_success_redirect(server, client):
+    fields = postpolicy.sign_policy_v4(
+        AK, SK, _policy_doc("v2bkt", "redir/"), "us-east-1")
+    fields["key"] = "redir/r.bin"
+    fields["success_action_redirect"] = "http://example.com/done"
+    body, ct = _form_body(fields, b"redirected")
+    status, hdrs, _ = _raw(server, "POST", "/v2bkt",
+                           {"Content-Type": ct,
+                            "Content-Length": str(len(body))}, body)
+    assert status == 303
+    loc = hdrs.get("Location", "")
+    assert loc.startswith("http://example.com/done?")
+    assert "bucket=v2bkt" in loc and "key=redir%2Fr.bin" in loc
+    assert client.get_object("v2bkt", "redir/r.bin").body == b"redirected"
+
+
+def test_post_policy_malformed_range_is_400(server):
+    doc = _policy_doc("v2bkt", "bad/")
+    doc["conditions"][-1] = ["content-length-range", "abc", "100"]
+    fields = postpolicy.sign_policy_v4(AK, SK, doc, "us-east-1")
+    fields["key"] = "bad/x"
+    body, ct = _form_body(fields, b"y")
+    status, _, resp = _raw(server, "POST", "/v2bkt",
+                           {"Content-Type": ct,
+                            "Content-Length": str(len(body))}, body)
+    assert status == 400 and b"MalformedPOSTRequest" in resp
+
+
+def test_presigned_v2_signed_content_type(server, client):
+    # a presigned V2 PUT whose Content-Type was signed into the URL must
+    # verify when the request carries that header
+    path = "/v2bkt/ct-signed.bin"
+    exp = int(time.time()) + 60
+    sts = sigv2.string_to_sign("PUT", path, {},
+                               {"Content-Type": "text/csv"}, str(exp))
+    import hashlib
+    import hmac as hmac_mod
+    sig = base64.b64encode(hmac_mod.new(
+        SK.encode(), sts.encode(), hashlib.sha1).digest()).decode()
+    qs = urllib.parse.urlencode({"AWSAccessKeyId": AK, "Expires": exp,
+                                 "Signature": sig})
+    status, _, _ = _raw(server, "PUT", path,
+                        {"Content-Type": "text/csv",
+                         "Content-Length": "3"}, b"a,b", query=qs)
+    assert status == 200
+    g = client.get_object("v2bkt", "ct-signed.bin")
+    assert g.body == b"a,b" and g.headers["Content-Type"] == "text/csv"
+
+
+def test_post_policy_anonymous_denied_without_grant(server):
+    # no signature fields at all -> AccessDenied
+    doc = _policy_doc("v2bkt", "anon/")
+    policy_b64 = base64.b64encode(json.dumps(doc).encode()).decode()
+    fields = {"policy": policy_b64, "key": "anon/x"}
+    body, ct = _form_body(fields, b"q")
+    status, _, _ = _raw(server, "POST", "/v2bkt",
+                        {"Content-Type": ct,
+                         "Content-Length": str(len(body))}, body)
+    assert status == 403
