@@ -96,6 +96,12 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         # MRF hook (cmd/erasure-object.go:1141 addPartial): a background
         # MRFQueue attaches here; post-quorum partial writes are enqueued
         self.mrf = None
+        # listing cache (cmd/metacache-manager.go): snapshots persist
+        # through the drives' system volume; local writes invalidate
+        from .metacache import MetacacheManager
+        self.metacache = MetacacheManager(
+            disks=[d for d in self.disks if d is not None],
+            sys_volume=SYS_DIR)
 
     # -- drive fan-out helpers --------------------------------------------
 
@@ -255,6 +261,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         # missed the write — queue a prompt re-heal
         if self.mrf is not None and any(e is not None for e in errs):
             self.mrf.add(bucket, object_name, fi.version_id)
+        self.metacache.invalidate(bucket)
         return self._to_object_info(fi)
 
     # -- GET (cmd/erasure-object.go:242 getObjectWithFileInfo) -------------
@@ -457,6 +464,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             oi = ObjectInfo(bucket=bucket, name=object_name,
                             version_id=dm.version_id, delete_marker=True,
                             mod_time=mod_time)
+            self.metacache.invalidate(bucket)
             return oi
         # delete a concrete version (or the null version)
         vid = opts.version_id or ""
@@ -475,6 +483,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             meta.reduce_errs(errs, self._write_quorum(), WriteQuorumError)
         except serrors.StorageError as e:
             raise WriteQuorumError(str(e)) from e
+        self.metacache.invalidate(bucket)
         return ObjectInfo(bucket=bucket, name=object_name, version_id=vid)
 
     def put_object_metadata(self, bucket: str, object_name: str,
@@ -520,6 +529,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             for k in removes:
                 fi.metadata.pop(k, None)
             fi.metadata.update(updates)
+            self.metacache.invalidate(bucket)
             return self._to_object_info(fi)
         finally:
             lk.unlock()
@@ -529,34 +539,27 @@ class ErasureObjects(MultipartOps, ObjectLayer):
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
                      delimiter: str = "", max_keys: int = 1000
                      ) -> ListObjectsInfo:
+        """Serve from the metacache snapshot; the walk+resolve runs once
+        per (bucket, prefix) and continuation pages reuse it
+        (cmd/metacache-server-pool.go listPath)."""
         self._check_bucket(bucket)
+        mc = self.metacache.list_path(
+            bucket, prefix, lambda: self._gather_listing(bucket, prefix))
+        from .metacache import paginate
+        return paginate(mc.entries, prefix, marker, delimiter, max_keys)
+
+    def _gather_listing(self, bucket: str, prefix: str
+                        ) -> list[ObjectInfo]:
+        """Walk all drives, union names, resolve each through quorum
+        metadata (cmd/metacache-set.go listPath + entries resolve)."""
         names: set[str] = set()
         res, _ = self._fanout(lambda d: list(d.walk_dir(bucket)))
         for lst in res:
             if lst:
                 names.update(lst)
-        out = ListObjectsInfo()
-        prefixes: set[str] = set()
+        entries: list[ObjectInfo] = []
         for name in sorted(names):
             if prefix and not name.startswith(prefix):
-                continue
-            rest = name[len(prefix):]
-            item = prefix + rest.split(delimiter, 1)[0] + delimiter \
-                if delimiter and delimiter in rest else None
-            # marker compares against the rolled-up item so that resuming
-            # from a CommonPrefix NextMarker skips the whole prefix instead
-            # of re-emitting it every page
-            if marker and (item or name) <= marker:
-                continue
-            if item is not None:
-                if item in prefixes:
-                    continue
-                prefixes.add(item)
-                # prefixes count toward max-keys too (S3 semantics)
-                if len(out.objects) + len(prefixes) >= max_keys:
-                    out.is_truncated = True
-                    out.next_marker = item
-                    break
                 continue
             try:
                 oi = self.get_object_info(bucket, name)
@@ -564,13 +567,8 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 continue
             if oi.delete_marker:
                 continue
-            out.objects.append(oi)
-            if len(out.objects) + len(prefixes) >= max_keys:
-                out.is_truncated = True
-                out.next_marker = name
-                break
-        out.prefixes = sorted(prefixes)
-        return out
+            entries.append(oi)
+        return entries
 
     def list_object_versions(self, bucket: str, prefix: str = ""):
         """All versions of all objects (ListObjectVersions core)."""

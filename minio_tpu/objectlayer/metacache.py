@@ -1,0 +1,215 @@
+"""Metacache — listing cache (cmd/metacache.go, cmd/metacache-manager.go,
+cmd/metacache-bucket.go, cmd/metacache-set.go, cmd/metacache-entries.go).
+
+The reference executes each listing once per erasure set (disks walked in
+agreement, entries resolved across drives), streams the result as msgp
+"metacache blocks" persisted as objects under ``.minio.sys``, and serves
+continuation requests from the cache instead of re-walking.  This build
+keeps the same shape, host-side:
+
+* a listing snapshot (sorted resolved ``ObjectInfo`` entries for one
+  (bucket, prefix)) is gathered once, paginated from memory for
+  continuation requests;
+* snapshots persist through the per-drive ``StorageAPI`` into the system
+  volume so a restarted process (or another process sharing the drives)
+  reuses a fresh listing instead of re-walking;
+* local mutations invalidate the bucket's caches immediately; everything
+  expires after a TTL (the reference bounds cache life the same way and
+  additionally consults the update-tracker bloom filter).
+
+Pagination/delimiter roll-up lives here too (``paginate``), shared by the
+erasure object layer so set/pool merges stay consistent.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+import uuid
+from dataclasses import asdict, dataclass, field
+from typing import Callable, List, Optional
+
+from .interface import ListObjectsInfo, ObjectInfo
+
+# cache validity (seconds).  The reference keeps a metacache alive while
+# clients page through it and retires it after ~2 minutes idle; writes
+# here invalidate eagerly so a short-ish TTL only bounds cross-process
+# staleness.
+DEFAULT_TTL = 15.0
+_SYS_PREFIX = "metacache"       # under the drive SYS volume
+
+
+@dataclass
+class Metacache:
+    """One cached listing (cmd/metacache.go metacache struct)."""
+    id: str
+    bucket: str
+    prefix: str
+    created: float
+    entries: List[ObjectInfo] = field(default_factory=list)
+
+    def expired(self, ttl: float, now: float | None = None) -> bool:
+        return ((now if now is not None else time.time())
+                - self.created) > ttl
+
+
+def paginate(entries: List[ObjectInfo], prefix: str, marker: str,
+             delimiter: str, max_keys: int) -> ListObjectsInfo:
+    """Delimiter roll-up + marker pagination over a sorted entry
+    snapshot (cmd/metacache-entries.go filterPrefix/forwardTo).  The
+    marker compares against the rolled-up item so resuming from a
+    CommonPrefix NextMarker skips the whole prefix."""
+    out = ListObjectsInfo()
+    prefixes: set[str] = set()
+    for oi in entries:
+        name = oi.name
+        if prefix and not name.startswith(prefix):
+            continue
+        rest = name[len(prefix):]
+        item = prefix + rest.split(delimiter, 1)[0] + delimiter \
+            if delimiter and delimiter in rest else None
+        if marker and (item or name) <= marker:
+            continue
+        if item is not None:
+            if item in prefixes:
+                continue
+            prefixes.add(item)
+            if len(out.objects) + len(prefixes) >= max_keys:
+                out.is_truncated = True
+                out.next_marker = item
+                break
+            continue
+        out.objects.append(oi)
+        if len(out.objects) + len(prefixes) >= max_keys:
+            out.is_truncated = True
+            out.next_marker = name
+            break
+    out.prefixes = sorted(prefixes)
+    return out
+
+
+def _cache_path(bucket: str, prefix: str) -> str:
+    h = hashlib.sha256(f"{bucket}\x00{prefix}".encode()).hexdigest()[:24]
+    return f"{_SYS_PREFIX}/{bucket}/{h}.json"
+
+
+def _serialize(mc: Metacache) -> bytes:
+    doc = {"id": mc.id, "bucket": mc.bucket, "prefix": mc.prefix,
+           "created": mc.created,
+           "entries": [asdict(e) for e in mc.entries]}
+    return json.dumps(doc).encode()
+
+
+def _deserialize(data: bytes) -> Metacache:
+    doc = json.loads(data)
+    entries = []
+    for e in doc["entries"]:
+        e["parts"] = [tuple(p) for p in e.get("parts", [])]
+        entries.append(ObjectInfo(**e))
+    return Metacache(id=doc["id"], bucket=doc["bucket"],
+                     prefix=doc["prefix"], created=doc["created"],
+                     entries=entries)
+
+
+class MetacacheManager:
+    """Per-object-layer cache registry (cmd/metacache-manager.go).
+
+    ``disks`` (optional) enables persistence: snapshots are written to
+    the first healthy drive's system volume and loaded from any drive on
+    a cold lookup, giving restart/cross-process reuse the way the
+    reference persists metacache blocks as objects.
+    """
+
+    def __init__(self, disks: Optional[list] = None,
+                 ttl: float = DEFAULT_TTL, max_caches: int = 128,
+                 sys_volume: str = ""):
+        self._caches: dict[tuple, Metacache] = {}
+        self._mu = threading.Lock()
+        self._disks = disks or []
+        self._ttl = ttl
+        self._max = max_caches
+        self._sys_volume = sys_volume
+        self.hits = 0
+        self.misses = 0
+
+    # -- persistence -----------------------------------------------------
+
+    def _persist(self, mc: Metacache) -> None:
+        if not self._disks or not self._sys_volume:
+            return
+        blob = _serialize(mc)
+        for d in self._disks:
+            try:
+                d.write_all(self._sys_volume,
+                            _cache_path(mc.bucket, mc.prefix), blob)
+                return              # one copy is enough; it's a cache
+            except Exception:       # noqa: BLE001 — next drive
+                continue
+
+    def _load(self, bucket: str, prefix: str) -> Optional[Metacache]:
+        for d in self._disks:
+            try:
+                blob = d.read_all(self._sys_volume,
+                                  _cache_path(bucket, prefix))
+                mc = _deserialize(blob)
+                if not mc.expired(self._ttl):
+                    return mc
+                return None
+            except Exception:       # noqa: BLE001 — missing/corrupt: miss
+                continue
+        return None
+
+    def _drop_persisted(self, bucket: str) -> None:
+        for d in self._disks:
+            try:
+                d.delete(self._sys_volume, f"{_SYS_PREFIX}/{bucket}",
+                         recursive=True)
+            except Exception:       # noqa: BLE001 — best effort
+                pass
+
+    # -- lookup / fill ---------------------------------------------------
+
+    def list_path(self, bucket: str, prefix: str,
+                  loader: Callable[[], List[ObjectInfo]]) -> Metacache:
+        """Cached entries for (bucket, prefix); ``loader`` walks+resolves
+        on miss (cmd/metacache-server-pool.go listPath)."""
+        key = (bucket, prefix)
+        now = time.time()
+        with self._mu:
+            mc = self._caches.get(key)
+            if mc is not None and not mc.expired(self._ttl, now):
+                self.hits += 1
+                return mc
+        mc = self._load(bucket, prefix)
+        if mc is not None:
+            self.hits += 1
+            with self._mu:
+                self._caches[key] = mc
+            return mc
+        self.misses += 1
+        entries = sorted(loader(), key=lambda o: o.name)
+        mc = Metacache(id=uuid.uuid4().hex, bucket=bucket, prefix=prefix,
+                       created=now, entries=entries)
+        with self._mu:
+            if len(self._caches) >= self._max:
+                # evict oldest (manager keeps a bounded registry)
+                oldest = min(self._caches, key=lambda k:
+                             self._caches[k].created)
+                del self._caches[oldest]
+            self._caches[key] = mc
+        self._persist(mc)
+        return mc
+
+    def invalidate(self, bucket: str) -> None:
+        """Drop every cache for the bucket (local mutation hook)."""
+        with self._mu:
+            for key in [k for k in self._caches if k[0] == bucket]:
+                del self._caches[key]
+        self._drop_persisted(bucket)
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"caches": len(self._caches), "hits": self.hits,
+                    "misses": self.misses}

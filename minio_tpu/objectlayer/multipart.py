@@ -286,4 +286,5 @@ class MultipartOps:
         except serrors.StorageError as e:
             raise WriteQuorumError(str(e)) from e
         fi.is_latest = True
+        self.metacache.invalidate(bucket)
         return self._to_object_info(fi)

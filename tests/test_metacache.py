@@ -1,0 +1,137 @@
+"""Metacache listing-cache tests (cmd/metacache-*_test.go tier:
+cache reuse, invalidation on writes, persistence, pagination)."""
+
+import pytest
+
+from minio_tpu.objectlayer import metacache as mcache
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.objectlayer.interface import ObjectInfo
+from minio_tpu.storage.xl_storage import XLStorage
+
+
+@pytest.fixture
+def er(tmp_path):
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"disk{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    er = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                        backend="numpy")
+    er.make_bucket("bkt")
+    return er
+
+
+def test_listing_cached_and_invalidated(er):
+    for k in ["a/1", "a/2", "b/1"]:
+        er.put_object("bkt", k, b"x")
+    base = er.metacache.misses
+    out = er.list_objects("bkt")
+    assert [o.name for o in out.objects] == ["a/1", "a/2", "b/1"]
+    assert er.metacache.misses == base + 1
+    # second listing (continuation-style) hits the cache
+    out = er.list_objects("bkt", max_keys=2)
+    assert er.metacache.hits >= 1
+    assert er.metacache.misses == base + 1
+    # a write invalidates: the new object must appear immediately
+    er.put_object("bkt", "c/9", b"y")
+    out = er.list_objects("bkt")
+    assert [o.name for o in out.objects] == ["a/1", "a/2", "b/1", "c/9"]
+    assert er.metacache.misses == base + 2
+    # delete invalidates too
+    er.delete_object("bkt", "a/1")
+    out = er.list_objects("bkt")
+    assert [o.name for o in out.objects] == ["a/2", "b/1", "c/9"]
+
+
+def test_pagination_served_from_one_snapshot(er):
+    for i in range(10):
+        er.put_object("bkt", f"k{i:02d}", b"d")
+    base = er.metacache.misses
+    marker, got, pages = "", [], 0
+    while True:
+        res = er.list_objects("bkt", marker=marker, max_keys=3)
+        got += [o.name for o in res.objects]
+        pages += 1
+        if not res.is_truncated:
+            break
+        marker = res.next_marker
+    assert got == [f"k{i:02d}" for i in range(10)]
+    assert pages == 4
+    assert er.metacache.misses == base + 1, \
+        "all pages must come from one walk"
+
+
+def test_cache_persisted_across_instances(tmp_path):
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"pd{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    er1 = ErasureObjects(disks, parity=2, backend="numpy")
+    er1.make_bucket("pbkt")
+    er1.put_object("pbkt", "x/1", b"1")
+    er1.list_objects("pbkt")          # fills + persists
+    # a fresh instance over the same drives reuses the persisted snapshot
+    er2 = ErasureObjects(disks, parity=2, backend="numpy")
+    out = er2.list_objects("pbkt")
+    assert [o.name for o in out.objects] == ["x/1"]
+    assert er2.metacache.misses == 0 and er2.metacache.hits == 1
+
+
+def test_cache_ttl_expiry():
+    calls = {"n": 0}
+
+    def loader():
+        calls["n"] += 1
+        return [ObjectInfo(name="k")]
+
+    mgr = mcache.MetacacheManager()          # no persistence
+    mgr.list_path("bkt", "", loader)
+    mgr.list_path("bkt", "", loader)
+    assert calls["n"] == 1
+    mgr._caches[("bkt", "")].created -= mcache.DEFAULT_TTL + 1
+    mgr.list_path("bkt", "", loader)
+    assert calls["n"] == 2
+
+
+def test_delimiter_pagination_no_duplicate_prefixes(er):
+    for k in ["a/1", "a/2", "a/3", "b/1", "c", "d/9"]:
+        er.put_object("bkt", k, b"d")
+    seen_prefixes, seen_keys, marker, pages = [], [], "", 0
+    while True:
+        res = er.list_objects("bkt", delimiter="/", marker=marker,
+                              max_keys=1)
+        seen_prefixes += res.prefixes
+        seen_keys += [o.name for o in res.objects]
+        pages += 1
+        assert pages < 20
+        if not res.is_truncated:
+            break
+        marker = res.next_marker
+    assert seen_prefixes == ["a/", "b/", "d/"]
+    assert seen_keys == ["c"]
+
+
+def test_paginate_unit():
+    entries = [ObjectInfo(name=n) for n in
+               ["a/x", "a/y", "b", "c/z", "d"]]
+    out = mcache.paginate(entries, "", "", "/", 100)
+    assert out.prefixes == ["a/", "c/"]
+    assert [o.name for o in out.objects] == ["b", "d"]
+    out = mcache.paginate(entries, "a/", "", "", 100)
+    assert [o.name for o in out.objects] == ["a/x", "a/y"]
+    out = mcache.paginate(entries, "", "b", "", 2)
+    assert [o.name for o in out.objects] == ["c/z", "d"]
+
+
+def test_serialize_roundtrip():
+    mc = mcache.Metacache(
+        id="i1", bucket="b", prefix="p/", created=123.0,
+        entries=[ObjectInfo(bucket="b", name="p/k", size=5, etag="e",
+                            parts=[(1, 5)],
+                            user_defined={"content-type": "x/y"})])
+    got = mcache._deserialize(mcache._serialize(mc))
+    assert got.id == "i1" and got.bucket == "b"
+    assert got.entries[0].parts == [(1, 5)]
+    assert got.entries[0].user_defined == {"content-type": "x/y"}
