@@ -1,0 +1,368 @@
+"""Disk cache — SSD cache layer in front of a (remote/slow) ObjectLayer
+(cmd/disk-cache.go:88 cacheObjects, cmd/disk-cache-backend.go).
+
+The reference deploys this for gateway/remote backends: GETs fill local
+cache drives, subsequent reads are served locally with ETag validation
+against the backend, an atime-based GC keeps usage between watermarks,
+and an optional writeback mode commits PUTs to the backend
+asynchronously (cmd/disk-cache.go:95 CacheCommitWriteBack).
+
+This build keeps the same behavior: ``CacheObjects`` wraps any
+ObjectLayer; cache drives are plain directories (one entry dir per
+object holding ``data`` + ``cache.json``), objects map to a drive by
+deterministic hash (crcHashMod analog, cmd/disk-cache.go cacheDrives).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import queue
+import shutil
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..hashing.siphash import sip_hash_mod
+from .interface import ObjectInfo, ObjectNotFound, ObjectOptions
+
+DEFAULT_HIGH_WATERMARK = 0.90   # start GC (config cache quota, reference
+DEFAULT_LOW_WATERMARK = 0.70    # default watermarks cmd/config/cache)
+
+
+@dataclass
+class CacheStats:
+    hits: int = 0
+    misses: int = 0
+    filled: int = 0
+    evicted: int = 0
+    writeback_pending: int = 0
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+
+@dataclass
+class CacheEntry:
+    object_info: dict = field(default_factory=dict)
+    etag: str = ""
+    atime: float = 0.0
+    size: int = 0
+    # writeback: object is dirty until committed to the backend
+    dirty: bool = False
+
+
+class CacheDrive:
+    """One cache directory (cmd/disk-cache-backend.go diskCache)."""
+
+    def __init__(self, root: str, max_bytes: int = 0,
+                 high_watermark: float = DEFAULT_HIGH_WATERMARK,
+                 low_watermark: float = DEFAULT_LOW_WATERMARK):
+        self.root = root
+        self.max_bytes = max_bytes      # 0 = derive from fs capacity
+        self.high = high_watermark
+        self.low = low_watermark
+        os.makedirs(root, exist_ok=True)
+        self._mu = threading.Lock()
+
+    def _entry_dir(self, bucket: str, key: str) -> str:
+        h = hashlib.sha256(f"{bucket}/{key}".encode()).hexdigest()
+        return os.path.join(self.root, h[:2], h)
+
+    # -- read/write ------------------------------------------------------
+
+    def get(self, bucket: str, key: str
+            ) -> Optional[tuple[CacheEntry, bytes]]:
+        d = self._entry_dir(bucket, key)
+        try:
+            with open(os.path.join(d, "cache.json")) as f:
+                meta = CacheEntry(**json.load(f))
+            with open(os.path.join(d, "data"), "rb") as f:
+                data = f.read()
+        except (OSError, ValueError, TypeError):
+            return None
+        meta.atime = time.time()
+        try:        # persist atime for GC ordering across restarts
+            with open(os.path.join(d, "cache.json"), "w") as f:
+                json.dump(meta.__dict__, f)
+        except OSError:
+            pass
+        return meta, data
+
+    def peek(self, bucket: str, key: str) -> Optional[CacheEntry]:
+        d = self._entry_dir(bucket, key)
+        try:
+            with open(os.path.join(d, "cache.json")) as f:
+                return CacheEntry(**json.load(f))
+        except (OSError, ValueError, TypeError):
+            return None
+
+    def put(self, bucket: str, key: str, data: bytes, oi_doc: dict,
+            dirty: bool = False) -> None:
+        d = self._entry_dir(bucket, key)
+        os.makedirs(d, exist_ok=True)
+        entry = CacheEntry(object_info=oi_doc,
+                           etag=oi_doc.get("etag", ""),
+                           atime=time.time(), size=len(data),
+                           dirty=dirty)
+        tmp = os.path.join(d, ".data.tmp")
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, os.path.join(d, "data"))
+        with open(os.path.join(d, "cache.json"), "w") as f:
+            json.dump(entry.__dict__, f)
+
+    def mark_clean(self, bucket: str, key: str) -> None:
+        e = self.peek(bucket, key)
+        if e is not None and e.dirty:
+            e.dirty = False
+            d = self._entry_dir(bucket, key)
+            try:
+                with open(os.path.join(d, "cache.json"), "w") as f:
+                    json.dump(e.__dict__, f)
+            except OSError:
+                pass
+
+    def delete(self, bucket: str, key: str) -> None:
+        shutil.rmtree(self._entry_dir(bucket, key), ignore_errors=True)
+
+    # -- GC --------------------------------------------------------------
+
+    def usage_bytes(self) -> int:
+        total = 0
+        for dirpath, _dirs, files in os.walk(self.root):
+            for fn in files:
+                try:
+                    total += os.path.getsize(os.path.join(dirpath, fn))
+                except OSError:
+                    pass
+        return total
+
+    def capacity_bytes(self) -> int:
+        if self.max_bytes:
+            return self.max_bytes
+        try:
+            return shutil.disk_usage(self.root).total
+        except OSError:
+            return 1 << 40
+
+    def entries_by_atime(self) -> list[tuple[float, str, int, bool]]:
+        """[(atime, entry_dir, size, dirty)] oldest first."""
+        out = []
+        for sub in os.listdir(self.root):
+            subp = os.path.join(self.root, sub)
+            if not os.path.isdir(subp):
+                continue
+            for ent in os.listdir(subp):
+                d = os.path.join(subp, ent)
+                try:
+                    with open(os.path.join(d, "cache.json")) as f:
+                        meta = json.load(f)
+                    # full on-disk footprint (data + metadata), so GC's
+                    # usage arithmetic matches usage_bytes()
+                    size = sum(os.path.getsize(os.path.join(d, fn))
+                               for fn in os.listdir(d))
+                except (OSError, ValueError):
+                    continue
+                out.append((meta.get("atime", 0.0), d, size,
+                            meta.get("dirty", False)))
+        out.sort()
+        return out
+
+    def gc(self, stats: Optional[CacheStats] = None) -> int:
+        """Evict least-recently-used clean entries until usage falls
+        below the low watermark (cmd/disk-cache-backend.go purge)."""
+        cap = self.capacity_bytes()
+        used = self.usage_bytes()
+        if used <= cap * self.high:
+            return 0
+        target = cap * self.low
+        evicted = 0
+        for _atime, d, size, dirty in self.entries_by_atime():
+            if used <= target:
+                break
+            if dirty:
+                continue        # never drop uncommitted writeback data
+            shutil.rmtree(d, ignore_errors=True)
+            used -= size
+            evicted += 1
+            if stats is not None:
+                stats.evicted += 1
+        return evicted
+
+
+class CacheObjects:
+    """ObjectLayer wrapper adding the cache (cmd/disk-cache.go:88).
+
+    Every method not overridden passes straight through to the inner
+    layer; GET/PUT/DELETE consult the cache.  ``writeback=True`` makes
+    PUT commit to the backend asynchronously (CacheCommitWriteBack).
+    """
+
+    def __init__(self, inner, cache_dirs: list[str],
+                 writeback: bool = False, max_object_size: int = 1 << 30,
+                 exclude: tuple[str, ...] = (), max_bytes_per_drive: int = 0):
+        self.inner = inner
+        self.drives = [CacheDrive(d, max_bytes=max_bytes_per_drive)
+                       for d in cache_dirs]
+        if not self.drives:
+            raise ValueError("disk cache needs at least one cache dir")
+        self.writeback = writeback
+        self.max_object_size = max_object_size
+        self.exclude = exclude
+        self.stats = CacheStats()
+        self._wb_q: "queue.Queue[tuple[str, str]]" = queue.Queue()
+        self._wb_thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # -- plumbing --------------------------------------------------------
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def _drive(self, bucket: str, key: str) -> CacheDrive:
+        idx = sip_hash_mod(f"{bucket}/{key}", len(self.drives), b"\0" * 16)
+        return self.drives[idx]
+
+    def _excluded(self, bucket: str, key: str) -> bool:
+        import fnmatch
+        return any(fnmatch.fnmatch(f"{bucket}/{key}", pat)
+                   for pat in self.exclude)
+
+    @staticmethod
+    def _oi_doc(oi: ObjectInfo) -> dict:
+        doc = dict(oi.__dict__)
+        doc["parts"] = [list(p) for p in doc.get("parts", [])]
+        return doc
+
+    @staticmethod
+    def _oi_from_doc(doc: dict) -> ObjectInfo:
+        doc = dict(doc)
+        doc["parts"] = [tuple(p) for p in doc.get("parts", [])]
+        return ObjectInfo(**doc)
+
+    # -- GET (cmd/disk-cache.go GetObjectNInfo) --------------------------
+
+    def get_object(self, bucket: str, object_name: str, offset: int = 0,
+                   length: int = -1, opts: Optional[ObjectOptions] = None):
+        opts = opts or ObjectOptions()
+        if opts.version_id or self._excluded(bucket, object_name):
+            return self.inner.get_object(bucket, object_name, offset,
+                                         length, opts)
+        drive = self._drive(bucket, object_name)
+        cached = drive.get(bucket, object_name)
+        if cached is not None:
+            entry, data = cached
+            # validate against the backend's current ETag; if the backend
+            # is unreachable the cache serves anyway (reference behavior:
+            # backend down -> cached data is better than an error)
+            try:
+                bi = self.inner.get_object_info(bucket, object_name)
+                fresh = bi.etag == entry.etag
+            except ObjectNotFound:
+                if entry.dirty:         # not yet committed: still valid
+                    fresh = True
+                else:
+                    drive.delete(bucket, object_name)
+                    raise
+            except Exception:   # noqa: BLE001 — backend down: serve cache
+                fresh = True
+            if fresh:
+                self.stats.hits += 1
+                oi = self._oi_from_doc(entry.object_info)
+                if offset or length != -1:
+                    end = len(data) if length == -1 else offset + length
+                    return oi, data[offset:end]
+                return oi, data
+            drive.delete(bucket, object_name)
+        self.stats.misses += 1
+        oi, data = self.inner.get_object(bucket, object_name, 0, -1, opts)
+        if len(data) <= self.max_object_size:
+            drive.put(bucket, object_name, data, self._oi_doc(oi))
+            self.stats.filled += 1
+            drive.gc(self.stats)
+        if offset or length != -1:
+            end = len(data) if length == -1 else offset + length
+            return oi, data[offset:end]
+        return oi, data
+
+    def get_object_info(self, bucket: str, object_name: str,
+                        opts: Optional[ObjectOptions] = None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        if not opts.version_id:
+            entry = self._drive(bucket, object_name).peek(
+                bucket, object_name)
+            if entry is not None and entry.dirty:
+                # writeback: the cache is the source of truth until commit
+                return self._oi_from_doc(entry.object_info)
+        return self.inner.get_object_info(bucket, object_name, opts)
+
+    # -- PUT -------------------------------------------------------------
+
+    def put_object(self, bucket: str, object_name: str, data: bytes,
+                   opts=None) -> ObjectInfo:
+        if self._excluded(bucket, object_name) or \
+                len(data) > self.max_object_size:
+            return self.inner.put_object(bucket, object_name, data, opts)
+        drive = self._drive(bucket, object_name)
+        if self.writeback:
+            # commit locally, acknowledge, upload in the background
+            import hashlib as _h
+            oi = ObjectInfo(bucket=bucket, name=object_name,
+                            size=len(data),
+                            etag=_h.md5(data).hexdigest(),
+                            mod_time=time.time_ns())
+            drive.put(bucket, object_name, data, self._oi_doc(oi),
+                      dirty=True)
+            self.stats.writeback_pending += 1
+            self._start_writeback()
+            self._wb_q.put((bucket, object_name))
+            return oi
+        oi = self.inner.put_object(bucket, object_name, data, opts)
+        drive.put(bucket, object_name, data, self._oi_doc(oi))
+        self.stats.filled += 1
+        drive.gc(self.stats)
+        return oi
+
+    def _start_writeback(self) -> None:
+        if self._wb_thread is None or not self._wb_thread.is_alive():
+            self._wb_thread = threading.Thread(target=self._wb_loop,
+                                               daemon=True)
+            self._wb_thread.start()
+
+    def _wb_loop(self) -> None:
+        while not self._closed:
+            try:
+                bucket, key = self._wb_q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            drive = self._drive(bucket, key)
+            cached = drive.get(bucket, key)
+            if cached is None:
+                continue
+            entry, data = cached
+            try:
+                oi = self.inner.put_object(bucket, key, data, None)
+                drive.put(bucket, key, data, self._oi_doc(oi),
+                          dirty=False)
+                self.stats.writeback_pending -= 1
+            except Exception:   # noqa: BLE001 — retry later
+                time.sleep(0.2)
+                self._wb_q.put((bucket, key))
+
+    def flush_writeback(self, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout
+        while self.stats.writeback_pending > 0 and \
+                time.monotonic() < deadline:
+            time.sleep(0.02)
+
+    # -- DELETE ----------------------------------------------------------
+
+    def delete_object(self, bucket: str, object_name: str, opts=None):
+        self._drive(bucket, object_name).delete(bucket, object_name)
+        return self.inner.delete_object(bucket, object_name, opts)
+
+    def close(self) -> None:
+        self._closed = True

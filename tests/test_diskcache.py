@@ -1,0 +1,170 @@
+"""Disk cache tests (cmd/disk-cache_test.go tier: hit/miss, ETag
+validation, backend-down serving, writeback commit, GC eviction)."""
+
+import pytest
+
+from minio_tpu.objectlayer.diskcache import CacheDrive, CacheObjects
+from minio_tpu.objectlayer.fs import FSObjects
+from minio_tpu.objectlayer.interface import ObjectNotFound
+
+
+class FlakyLayer:
+    """Wraps an inner layer; can be switched 'down' to raise."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.down = False
+        self.get_calls = 0
+
+    def __getattr__(self, name):
+        if self.down and name in ("get_object", "get_object_info",
+                                  "put_object"):
+            def boom(*a, **k):
+                raise ConnectionError("backend down")
+            return boom
+        if name == "get_object":
+            def counted(*a, **k):
+                self.get_calls += 1
+                return self.inner.get_object(*a, **k)
+            return counted
+        return getattr(self.inner, name)
+
+
+@pytest.fixture
+def stack(tmp_path):
+    backend = FSObjects(str(tmp_path / "backend"))
+    backend.make_bucket("cbkt")
+    flaky = FlakyLayer(backend)
+    cache = CacheObjects(flaky, [str(tmp_path / "cache0"),
+                                 str(tmp_path / "cache1")])
+    return backend, flaky, cache
+
+
+def test_get_fills_and_hits(stack):
+    backend, flaky, cache = stack
+    backend.put_object("cbkt", "a.bin", b"cached payload")
+    oi, data = cache.get_object("cbkt", "a.bin")
+    assert data == b"cached payload"
+    assert cache.stats.misses == 1 and cache.stats.filled == 1
+    oi, data = cache.get_object("cbkt", "a.bin")
+    assert data == b"cached payload"
+    assert cache.stats.hits == 1
+    assert flaky.get_calls == 1, "second read must not hit the backend"
+    # range requests served from the cached full object
+    _, part = cache.get_object("cbkt", "a.bin", offset=7, length=4)
+    assert part == b"payl"
+
+
+def test_stale_etag_invalidates(stack):
+    backend, _flaky, cache = stack
+    backend.put_object("cbkt", "s.bin", b"v1")
+    cache.get_object("cbkt", "s.bin")
+    backend.put_object("cbkt", "s.bin", b"v2-updated")   # behind our back
+    oi, data = cache.get_object("cbkt", "s.bin")
+    assert data == b"v2-updated"
+    assert cache.stats.misses == 2
+
+
+def test_backend_down_serves_cache(stack):
+    backend, flaky, cache = stack
+    backend.put_object("cbkt", "d.bin", b"survives outage")
+    cache.get_object("cbkt", "d.bin")
+    flaky.down = True
+    oi, data = cache.get_object("cbkt", "d.bin")
+    assert data == b"survives outage"
+
+
+def test_deleted_on_backend_propagates(stack):
+    backend, _flaky, cache = stack
+    backend.put_object("cbkt", "gone.bin", b"x")
+    cache.get_object("cbkt", "gone.bin")
+    backend.delete_object("cbkt", "gone.bin")
+    with pytest.raises(ObjectNotFound):
+        cache.get_object("cbkt", "gone.bin")
+
+
+def test_put_writethrough(stack):
+    backend, _flaky, cache = stack
+    cache.put_object("cbkt", "w.bin", b"through")
+    # in the backend
+    _, data = backend.get_object("cbkt", "w.bin")
+    assert data == b"through"
+    # and a read is a cache hit without touching the backend data path
+    cache.get_object("cbkt", "w.bin")
+    assert cache.stats.hits == 1
+
+
+def test_delete_clears_cache(stack):
+    backend, flaky, cache = stack
+    cache.put_object("cbkt", "del.bin", b"z")
+    cache.delete_object("cbkt", "del.bin")
+    with pytest.raises(ObjectNotFound):
+        cache.get_object("cbkt", "del.bin")
+
+
+def test_writeback_commits_async(tmp_path):
+    backend = FSObjects(str(tmp_path / "wb-backend"))
+    backend.make_bucket("wbkt")
+    cache = CacheObjects(backend, [str(tmp_path / "wb-cache")],
+                         writeback=True)
+    oi = cache.put_object("wbkt", "wb.bin", b"writeback data")
+    assert oi.etag
+    # served from cache immediately even before commit
+    _, data = cache.get_object("wbkt", "wb.bin")
+    assert data == b"writeback data"
+    cache.flush_writeback()
+    assert cache.stats.writeback_pending == 0
+    _, data = backend.get_object("wbkt", "wb.bin")
+    assert data == b"writeback data"
+    cache.close()
+
+
+def test_gc_evicts_lru(tmp_path):
+    drive = CacheDrive(str(tmp_path / "gc"), max_bytes=10_000,
+                       high_watermark=0.5, low_watermark=0.2)
+    import time
+    for i in range(10):
+        drive.put("bkt", f"k{i}", b"x" * 1000, {"etag": f"e{i}"})
+        time.sleep(0.01)
+    # touch k9..k5 so k0..k4 are LRU
+    for i in range(9, 4, -1):
+        drive.get("bkt", f"k{i}")
+    evicted = drive.gc()
+    assert evicted >= 1
+    assert drive.usage_bytes() <= 10_000 * 0.5
+    # most recently used survives (k5 was touched last)
+    assert drive.peek("bkt", "k5") is not None
+    # least recently used went first
+    assert drive.peek("bkt", "k0") is None
+
+
+def test_gc_never_drops_dirty(tmp_path):
+    drive = CacheDrive(str(tmp_path / "gcd"), max_bytes=3000,
+                       high_watermark=0.5, low_watermark=0.1)
+    for i in range(5):
+        drive.put("bkt", f"d{i}", b"y" * 1000, {"etag": f"e{i}"},
+                  dirty=True)
+    drive.gc()
+    for i in range(5):
+        assert drive.peek("bkt", f"d{i}") is not None, \
+            "dirty (uncommitted) entries must survive GC"
+
+
+def test_exclude_patterns(tmp_path):
+    backend = FSObjects(str(tmp_path / "ex-backend"))
+    backend.make_bucket("ebkt")
+    cache = CacheObjects(backend, [str(tmp_path / "ex-cache")],
+                         exclude=("ebkt/raw/*",))
+    backend.put_object("ebkt", "raw/skip.bin", b"not cached")
+    cache.get_object("ebkt", "raw/skip.bin")
+    cache.get_object("ebkt", "raw/skip.bin")
+    assert cache.stats.hits == 0 and cache.stats.misses == 0
+
+
+def test_passthrough_other_methods(stack):
+    backend, _flaky, cache = stack
+    # non-overridden ObjectLayer methods reach the inner layer
+    assert [b.name for b in cache.list_buckets()] == ["cbkt"]
+    cache.put_object("cbkt", "lst.bin", b"1")
+    assert "lst.bin" in [o.name for o in
+                         cache.list_objects("cbkt").objects]
