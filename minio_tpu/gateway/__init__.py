@@ -1,0 +1,118 @@
+"""Gateway mode — serve the S3 frontend over a foreign backend.
+
+Reference: cmd/gateway-interface.go:34-43 (`Gateway` returns an
+`ObjectLayer`), cmd/gateway-unsupported.go (default stub base),
+cmd/gateway/{azure,gcs,hdfs,nas,s3} implementations, started by
+`minio gateway <kind>` (cmd/gateway-main.go).
+
+Here a gateway is a factory producing an ObjectLayer; the S3Server,
+IAM, and admin frontend run unchanged on top of it, and the disk cache
+(objectlayer/diskcache.py) can wrap it exactly as the reference deploys
+cacheObjects in front of gateway backends (cmd/disk-cache.go:88).
+
+Backends whose client SDKs are not in this image (azure, gcs) register
+as *gated*: constructing them raises GatewayNotAvailable with the
+reason, mirroring how the reference compiles them in but fails at
+startup without credentials/connectivity.
+"""
+
+from __future__ import annotations
+
+import abc
+
+from ..objectlayer.interface import ObjectLayer
+
+
+class GatewayError(Exception):
+    pass
+
+
+class GatewayNotAvailable(GatewayError):
+    """Backend's client SDK / service is not reachable in this build."""
+
+
+class Gateway(abc.ABC):
+    """cmd/gateway-interface.go:34 Gateway: Name + NewGatewayLayer."""
+
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+    @abc.abstractmethod
+    def new_gateway_layer(self) -> ObjectLayer: ...
+
+    def production(self) -> bool:
+        """cmd/gateway-interface.go Production() readiness marker."""
+        return True
+
+
+class _MemSysDisk:
+    """In-memory stand-in for the sys-volume shim (config/IAM/KMS
+    persistence): gateway mode keeps subsystem state per-process, as the
+    reference gateway keeps IAM/config in memory unless etcd is set."""
+
+    def __init__(self):
+        self._store: dict[tuple[str, str], bytes] = {}
+
+    def read_all(self, volume: str, path: str) -> bytes:
+        try:
+            return self._store[(volume, path)]
+        except KeyError:
+            from ..storage import errors as serrors
+            raise serrors.FileNotFound(path) from None
+
+    def write_all(self, volume: str, path: str, data: bytes) -> None:
+        self._store[(volume, path)] = data
+
+
+class GatewayUnsupported:
+    """Mixin supplying NotImplemented defaults for optional ObjectLayer
+    surface a backend may lack (cmd/gateway-unsupported.go
+    GatewayUnsupported), so gateway layers only implement what the
+    backend natively supports."""
+
+    def _fanout(self, fn):
+        if not hasattr(self, "_sys_disk"):
+            self._sys_disk = _MemSysDisk()
+        try:
+            return [fn(self._sys_disk)], [None]
+        except Exception as e:
+            return [None], [e]
+
+    def list_object_versions(self, bucket: str, prefix: str = ""):
+        raise NotImplementedError("gateway backend: no versioning")
+
+    def put_object_metadata(self, bucket: str, object_name: str,
+                            user_defined: dict, version_id=None):
+        raise NotImplementedError("gateway backend: no metadata update")
+
+    def heal_object(self, *a, **kw):
+        raise NotImplementedError("gateway backend: no healing")
+
+    def heal_bucket(self, *a, **kw):
+        raise NotImplementedError("gateway backend: no healing")
+
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register(kind: str):
+    def deco(cls):
+        _REGISTRY[kind] = cls
+        return cls
+    return deco
+
+
+def lookup(kind: str) -> type:
+    """Gateway class for `minio gateway <kind>`; KeyError lists kinds."""
+    try:
+        return _REGISTRY[kind]
+    except KeyError:
+        raise GatewayError(
+            f"unknown gateway {kind!r}; available: "
+            f"{', '.join(sorted(_REGISTRY))}") from None
+
+
+from . import nas, s3, cloud  # noqa: E402  (populate the registry)
+
+__all__ = ["Gateway", "GatewayError", "GatewayNotAvailable",
+           "GatewayUnsupported", "register", "lookup", "nas", "s3", "cloud"]

@@ -1,0 +1,67 @@
+"""Gated cloud gateways — azure / gcs / hdfs.
+
+Reference implementations: cmd/gateway/azure/gateway-azure.go,
+cmd/gateway/gcs/gateway-gcs.go, cmd/gateway/hdfs/gateway-hdfs.go.
+Their client SDKs (azure-storage-blob, google-cloud-storage, pyarrow
+HDFS) are not in this image and the environment has zero egress, so
+these register as gated: `new_gateway_layer` probes for the SDK and
+raises GatewayNotAvailable with the requirement, keeping the CLI
+surface (`minio gateway azure ...`) and registry parity with the
+reference while failing loudly instead of pretending.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+from . import Gateway, GatewayNotAvailable, register
+
+
+class _GatedGateway(Gateway):
+    KIND = ""
+    SDK_MODULE = ""          # import that must succeed
+    SDK_HINT = ""
+
+    def __init__(self, *args, **kwargs):
+        self.args = args
+        self.kwargs = kwargs
+
+    def name(self) -> str:
+        return self.KIND
+
+    def production(self) -> bool:
+        return False
+
+    def _sdk(self):
+        try:
+            return importlib.import_module(self.SDK_MODULE)
+        except ImportError:
+            raise GatewayNotAvailable(
+                f"{self.KIND} gateway requires {self.SDK_HINT} "
+                f"(module {self.SDK_MODULE!r} not installed)") from None
+
+    def new_gateway_layer(self):
+        self._sdk()
+        raise GatewayNotAvailable(
+            f"{self.KIND} gateway backend not implemented in this build")
+
+
+@register("azure")
+class AzureGateway(_GatedGateway):
+    KIND = "azure"
+    SDK_MODULE = "azure.storage.blob"
+    SDK_HINT = "the azure-storage-blob SDK"
+
+
+@register("gcs")
+class GCSGateway(_GatedGateway):
+    KIND = "gcs"
+    SDK_MODULE = "google.cloud.storage"
+    SDK_HINT = "the google-cloud-storage SDK"
+
+
+@register("hdfs")
+class HDFSGateway(_GatedGateway):
+    KIND = "hdfs"
+    SDK_MODULE = "pyarrow.fs"
+    SDK_HINT = "pyarrow with HDFS support"

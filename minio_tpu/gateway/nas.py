@@ -1,0 +1,25 @@
+"""NAS gateway — S3 frontend over a shared POSIX mount.
+
+Reference: cmd/gateway/nas/gateway-nas.go, which returns the standalone
+FS ObjectLayer over the mount path ("the NAS gateway is the FS backend
+pointed at a network drive").  Multiple gateway instances may share the
+same mount; correctness relies on the NAS providing POSIX rename
+atomicity, as in the reference.
+"""
+
+from __future__ import annotations
+
+from ..objectlayer.fs import FSObjects
+from . import Gateway, register
+
+
+@register("nas")
+class NASGateway(Gateway):
+    def __init__(self, path: str):
+        self.path = path
+
+    def name(self) -> str:
+        return "nas"
+
+    def new_gateway_layer(self) -> FSObjects:
+        return FSObjects(self.path)

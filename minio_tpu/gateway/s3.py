@@ -1,0 +1,323 @@
+"""S3 gateway — proxy the S3 API onto a remote S3-compatible store.
+
+Reference: cmd/gateway/s3/gateway-s3.go (s3Objects wraps a minio-go
+client; every ObjectLayer call becomes the corresponding remote S3
+call, errors translated back to ObjectLayer errors via ErrorRespToObjectError).
+Here the remote client is minio_tpu.s3.client.S3Client and the
+translation table is `_translate`.
+"""
+
+from __future__ import annotations
+
+from email.utils import parsedate_to_datetime
+from typing import Optional
+
+from ..objectlayer.interface import (BucketExists, BucketInfo,
+                                     BucketNotFound, InvalidUploadID,
+                                     ListObjectsInfo, ObjectInfo,
+                                     ObjectLayer, ObjectNotFound,
+                                     ObjectOptions, PutObjectOptions)
+from ..objectlayer.multipart import MultipartInfo, PartInfo
+from ..s3.client import S3Client, S3ClientError
+from . import Gateway, GatewayUnsupported, register
+
+_ERR_MAP = {
+    "NoSuchBucket": BucketNotFound,
+    "NoSuchKey": ObjectNotFound,
+    "NoSuchVersion": ObjectNotFound,
+    "BucketAlreadyOwnedByYou": BucketExists,
+    "BucketAlreadyExists": BucketExists,
+    "NoSuchUpload": InvalidUploadID,
+}
+
+
+def _translate(e: S3ClientError, *args):
+    """cmd/gateway/s3/gateway-s3.go ErrorRespToObjectError analog."""
+    exc = _ERR_MAP.get(e.code)
+    if exc is not None:
+        raise exc(*args) from e
+    if e.status == 404:
+        raise ObjectNotFound(*args) from e
+    raise
+
+
+def _http_date_ns(value: str) -> int:
+    if not value:
+        return 0
+    try:
+        return int(parsedate_to_datetime(value).timestamp() * 1e9)
+    except (TypeError, ValueError):
+        return 0
+
+
+# Frontend-internal metadata (SSE sealed keys x-minio-internal-*, tags,
+# compression markers) must survive the remote hop even though remote S3
+# only persists x-amz-meta-* headers: encode them under the meta prefix
+# on PUT and strip it back on GET/HEAD (the reference s3 gateway keeps
+# its encryption metadata in .minio.sys on the remote for the same
+# reason — here header-tunneling keeps the gateway stateless).
+_META = "x-amz-meta-"
+_TUNNELED = ("x-minio-internal-", "x-amz-tagging")
+
+
+def _encode_meta(user_defined: dict) -> dict:
+    """user_defined -> headers for the remote PUT / initiate."""
+    hdrs = {}
+    for k, v in user_defined.items():
+        lk = k.lower()
+        if lk == "content-type":
+            hdrs["Content-Type"] = v
+        elif lk.startswith(_META):
+            hdrs[k] = v
+        elif lk.startswith(_TUNNELED[0]) or lk == _TUNNELED[1]:
+            hdrs[_META + k] = v
+        # anything else (transport headers) is not object metadata
+    return hdrs
+
+
+def _decode_meta(user_defined: dict) -> dict:
+    """Reverse _encode_meta on headers read back from the remote."""
+    out = {}
+    for k, v in user_defined.items():
+        lk = k.lower()
+        if lk.startswith(_META):
+            inner = lk[len(_META):]
+            if inner.startswith(_TUNNELED[0]) or inner == _TUNNELED[1]:
+                out[inner] = v
+                continue
+        out[k] = v
+    return out
+
+
+def _info_from_headers(bucket: str, key: str, headers: dict) -> ObjectInfo:
+    h = {k.lower(): v for k, v in headers.items()}
+    user_defined = _decode_meta({k: v for k, v in h.items()
+                                 if k.startswith(_META)})
+    if "content-type" in h:
+        user_defined["content-type"] = h["content-type"]
+    return ObjectInfo(
+        bucket=bucket, name=key,
+        size=int(h.get("content-length", 0) or 0),
+        etag=h.get("etag", "").strip('"'),
+        mod_time=_http_date_ns(h.get("last-modified", "")),
+        content_type=h.get("content-type", ""),
+        version_id=h.get("x-amz-version-id", ""),
+        user_defined=user_defined)
+
+
+class S3GatewayLayer(GatewayUnsupported, ObjectLayer):
+    """ObjectLayer proxying to a remote S3 endpoint (s3Objects)."""
+
+    enforce_min_part_size = True
+
+    def __init__(self, client: S3Client):
+        self.client = client
+        # initiate-time metadata per upload id: the frontend re-reads it
+        # via get_multipart_info to drive SSE/compression of later parts
+        self._uploads: dict[str, dict] = {}
+
+    # -- buckets -----------------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        try:
+            self.client.make_bucket(bucket)
+        except S3ClientError as e:
+            _translate(e, bucket)
+
+    def get_bucket_info(self, bucket: str) -> BucketInfo:
+        # direct HEAD so auth/availability errors are not conflated with
+        # 404 (head_bucket's bool swallows the distinction)
+        try:
+            self.client.request("HEAD", f"/{bucket}")
+        except S3ClientError as e:
+            if e.status == 404 or e.code == "NoSuchBucket":
+                raise BucketNotFound(bucket) from e
+            raise
+        return BucketInfo(bucket, 0)
+
+    def list_buckets(self) -> list[BucketInfo]:
+        return [BucketInfo(b, 0) for b in self.client.list_buckets()]
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        try:
+            self.client.delete_bucket(bucket)
+        except S3ClientError as e:
+            _translate(e, bucket)
+
+    # -- objects -----------------------------------------------------------
+
+    def put_object(self, bucket: str, object_name: str, data: bytes,
+                   opts: Optional[PutObjectOptions] = None) -> ObjectInfo:
+        opts = opts or PutObjectOptions()
+        try:
+            r = self.client.request("PUT", f"/{bucket}/{object_name}",
+                                    body=data,
+                                    headers=_encode_meta(opts.user_defined))
+        except S3ClientError as e:
+            _translate(e, bucket, object_name)
+        info = _info_from_headers(bucket, object_name, r.headers)
+        info.size = len(data)
+        info.user_defined = dict(opts.user_defined)
+        return info
+
+    def get_object(self, bucket: str, object_name: str, offset: int = 0,
+                   length: int = -1,
+                   opts: Optional[ObjectOptions] = None
+                   ) -> tuple[ObjectInfo, bytes]:
+        opts = opts or ObjectOptions()
+        if length == 0:
+            return self.get_object_info(bucket, object_name, opts), b""
+        rng = None
+        if offset < 0:                       # suffix range (bytes=-N)
+            rng = f"bytes={offset}"
+        elif offset and length < 0:          # open-ended tail
+            rng = f"bytes={offset}-"
+        elif length > 0:
+            rng = f"bytes={offset}-{offset + length - 1}"
+        try:
+            r = self.client.get_object(bucket, object_name,
+                                       version_id=opts.version_id or None,
+                                       range_header=rng)
+        except S3ClientError as e:
+            _translate(e, bucket, object_name)
+        info = _info_from_headers(bucket, object_name, r.headers)
+        # a ranged GET reports the range's length; recover full size
+        cr = {k.lower(): v for k, v in r.headers.items()}.get(
+            "content-range", "")
+        if cr and "/" in cr:
+            info.size = int(cr.rpartition("/")[2])
+        return info, r.body
+
+    def get_object_info(self, bucket: str, object_name: str,
+                        opts: Optional[ObjectOptions] = None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        try:
+            r = self.client.head_object(bucket, object_name,
+                                        version_id=opts.version_id or None)
+        except S3ClientError as e:
+            _translate(e, bucket, object_name)
+        return _info_from_headers(bucket, object_name, r.headers)
+
+    def delete_object(self, bucket: str, object_name: str,
+                      opts: Optional[ObjectOptions] = None) -> ObjectInfo:
+        opts = opts or ObjectOptions()
+        try:
+            self.client.delete_object(bucket, object_name,
+                                      version_id=opts.version_id or None)
+        except S3ClientError as e:
+            _translate(e, bucket, object_name)
+        return ObjectInfo(bucket=bucket, name=object_name)
+
+    def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000
+                     ) -> ListObjectsInfo:
+        try:
+            page = self.client.list_objects_page(
+                bucket, prefix=prefix, delimiter=delimiter,
+                marker=marker, max_keys=max_keys)
+        except S3ClientError as e:
+            _translate(e, bucket)
+        out = ListObjectsInfo(
+            prefixes=page["prefixes"],
+            is_truncated=page["is_truncated"],
+            next_marker=page["next_marker"],
+            next_continuation_token=page["next_marker"])
+        for o in page["objects"]:
+            out.objects.append(ObjectInfo(
+                bucket=bucket, name=o["key"], size=o["size"],
+                etag=o["etag"]))
+        return out
+
+    # -- multipart passthrough ---------------------------------------------
+
+    def new_multipart_upload(self, bucket: str, object_name: str,
+                             opts: Optional[PutObjectOptions] = None) -> str:
+        opts = opts or PutObjectOptions()
+        try:
+            uid = self.client.create_multipart_upload(
+                bucket, object_name, headers=_encode_meta(opts.user_defined))
+        except S3ClientError as e:
+            _translate(e, bucket, object_name)
+        self._uploads[uid] = dict(opts.user_defined)
+        return uid
+
+    def put_object_part(self, bucket: str, object_name: str, upload_id: str,
+                        part_number: int, data: bytes) -> PartInfo:
+        try:
+            etag = self.client.upload_part(bucket, object_name, upload_id,
+                                           part_number, data)
+        except S3ClientError as e:
+            _translate(e, upload_id)
+        return PartInfo(part_number, etag, len(data), len(data))
+
+    def get_multipart_info(self, bucket: str, object_name: str,
+                           upload_id: str) -> MultipartInfo:
+        try:
+            self.client.list_parts(bucket, object_name, upload_id)
+        except S3ClientError as e:
+            _translate(e, upload_id)
+        return MultipartInfo(bucket, object_name, upload_id,
+                             self._uploads.get(upload_id, {}))
+
+    def list_object_parts(self, bucket: str, object_name: str,
+                          upload_id: str) -> list[PartInfo]:
+        try:
+            parts = self.client.list_parts(bucket, object_name, upload_id)
+        except S3ClientError as e:
+            _translate(e, upload_id)
+        return [PartInfo(p["part_number"], p["etag"], p["size"], p["size"])
+                for p in parts]
+
+    def abort_multipart_upload(self, bucket: str, object_name: str,
+                               upload_id: str) -> None:
+        try:
+            self.client.abort_multipart_upload(bucket, object_name,
+                                               upload_id)
+        except S3ClientError as e:
+            _translate(e, upload_id)
+        self._uploads.pop(upload_id, None)
+
+    def list_multipart_uploads(self, bucket: str,
+                               prefix: str = "") -> list[MultipartInfo]:
+        try:
+            ups = self.client.list_multipart_uploads(bucket)
+        except S3ClientError as e:
+            _translate(e, bucket)
+        return [MultipartInfo(bucket, u["key"], u["upload_id"], {})
+                for u in ups if (u["key"] or "").startswith(prefix)]
+
+    def complete_multipart_upload(self, bucket: str, object_name: str,
+                                  upload_id: str,
+                                  parts: list[tuple[int, str]]) -> ObjectInfo:
+        try:
+            root = self.client.complete_multipart_upload(
+                bucket, object_name, upload_id, parts)
+        except S3ClientError as e:
+            _translate(e, upload_id)
+        self._uploads.pop(upload_id, None)
+        ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+        etag = (root.findtext(f"{ns}ETag") or
+                root.findtext("ETag") or "").strip('"')
+        return self._completed_info(bucket, object_name, etag)
+
+    def _completed_info(self, bucket, object_name, etag):
+        try:
+            info = self.get_object_info(bucket, object_name)
+        except ObjectNotFound:
+            info = ObjectInfo(bucket=bucket, name=object_name)
+        if etag:
+            info.etag = etag
+        return info
+
+
+@register("s3")
+class S3Gateway(Gateway):
+    def __init__(self, endpoint: str, access_key: str, secret_key: str,
+                 region: str = "us-east-1"):
+        self.client = S3Client(endpoint, access_key, secret_key, region)
+
+    def name(self) -> str:
+        return "s3"
+
+    def new_gateway_layer(self) -> S3GatewayLayer:
+        return S3GatewayLayer(self.client)

@@ -116,10 +116,13 @@ class S3Client:
 
     def get_object(self, bucket: str, key: str,
                    version_id: str | None = None,
-                   byte_range: tuple[int, int] | None = None) -> S3Response:
+                   byte_range: tuple[int, int] | None = None,
+                   range_header: str | None = None) -> S3Response:
         q = f"versionId={version_id}" if version_id else ""
         hdrs = {}
-        if byte_range:
+        if range_header:
+            hdrs["Range"] = range_header
+        elif byte_range:
             hdrs["Range"] = f"bytes={byte_range[0]}-{byte_range[1]}"
         return self.request("GET", f"/{bucket}/{key}", q, headers=hdrs)
 
@@ -134,15 +137,32 @@ class S3Client:
         return self.request("DELETE", f"/{bucket}/{key}", q)
 
     def list_objects(self, bucket: str, prefix: str = "",
-                     delimiter: str = "", v2: bool = True
+                     delimiter: str = "", v2: bool = True,
+                     marker: str = "", max_keys: int = 0
                      ) -> tuple[list[dict], list[str]]:
+        page = self.list_objects_page(bucket, prefix, delimiter, v2,
+                                      marker, max_keys)
+        return page["objects"], page["prefixes"]
+
+    def list_objects_page(self, bucket: str, prefix: str = "",
+                          delimiter: str = "", v2: bool = True,
+                          marker: str = "", max_keys: int = 0) -> dict:
+        """One remote listing page with continuation state (the shape the
+        S3 gateway needs to forward pagination faithfully)."""
         q = []
         if v2:
             q.append("list-type=2")
+            if marker:
+                q.append("continuation-token="
+                         f"{urllib.parse.quote(marker)}")
+        elif marker:
+            q.append(f"marker={urllib.parse.quote(marker)}")
         if prefix:
             q.append(f"prefix={urllib.parse.quote(prefix)}")
         if delimiter:
             q.append(f"delimiter={urllib.parse.quote(delimiter)}")
+        if max_keys:
+            q.append(f"max-keys={max_keys}")
         r = self.request("GET", f"/{bucket}", "&".join(q))
         root = r.xml()
         objs = [{
@@ -152,7 +172,14 @@ class S3Client:
         } for c in root.iter(f"{S3_NS}Contents")]
         prefixes = [p.findtext(f"{S3_NS}Prefix")
                     for p in root.iter(f"{S3_NS}CommonPrefixes")]
-        return objs, prefixes
+        return {
+            "objects": objs, "prefixes": prefixes,
+            "is_truncated":
+                (root.findtext(f"{S3_NS}IsTruncated") or "") == "true",
+            "next_marker":
+                root.findtext(f"{S3_NS}NextContinuationToken") or
+                root.findtext(f"{S3_NS}NextMarker") or "",
+        }
 
     def list_object_versions(self, bucket: str, prefix: str = "") -> ET.Element:
         q = "versions" + (f"&prefix={urllib.parse.quote(prefix)}"
@@ -170,3 +197,52 @@ class S3Client:
         return presign_url(self._creds, method,
                            f"{self.endpoint}/{bucket}/{key}", expires,
                            self.region)
+
+    # -- multipart (used by the S3 gateway passthrough) ---------------------
+
+    def create_multipart_upload(self, bucket: str, key: str,
+                                headers: dict | None = None) -> str:
+        r = self.request("POST", f"/{bucket}/{key}", "uploads",
+                         headers=headers)
+        root = r.xml()
+        return root.findtext(f"{S3_NS}UploadId") or \
+            root.findtext("UploadId") or ""
+
+    def upload_part(self, bucket: str, key: str, upload_id: str,
+                    part_number: int, data: bytes) -> str:
+        r = self.request(
+            "PUT", f"/{bucket}/{key}",
+            f"partNumber={part_number}&uploadId={upload_id}", body=data)
+        return r.headers.get("ETag", r.headers.get("Etag", "")).strip('"')
+
+    def complete_multipart_upload(self, bucket: str, key: str,
+                                  upload_id: str,
+                                  parts: list[tuple[int, str]]) -> ET.Element:
+        body = "".join(
+            f"<Part><PartNumber>{n}</PartNumber><ETag>{etag}</ETag></Part>"
+            for n, etag in parts)
+        xml = (f'<CompleteMultipartUpload xmlns='
+               f'"http://s3.amazonaws.com/doc/2006-03-01/">{body}'
+               f"</CompleteMultipartUpload>").encode()
+        return self.request("POST", f"/{bucket}/{key}",
+                            f"uploadId={upload_id}", xml).xml()
+
+    def abort_multipart_upload(self, bucket: str, key: str,
+                               upload_id: str) -> None:
+        self.request("DELETE", f"/{bucket}/{key}", f"uploadId={upload_id}")
+
+    def list_parts(self, bucket: str, key: str,
+                   upload_id: str) -> list[dict]:
+        r = self.request("GET", f"/{bucket}/{key}", f"uploadId={upload_id}")
+        return [{
+            "part_number": int(p.findtext(f"{S3_NS}PartNumber") or 0),
+            "etag": (p.findtext(f"{S3_NS}ETag") or "").strip('"'),
+            "size": int(p.findtext(f"{S3_NS}Size") or 0),
+        } for p in r.xml().iter(f"{S3_NS}Part")]
+
+    def list_multipart_uploads(self, bucket: str) -> list[dict]:
+        r = self.request("GET", f"/{bucket}", "uploads")
+        return [{
+            "key": u.findtext(f"{S3_NS}Key"),
+            "upload_id": u.findtext(f"{S3_NS}UploadId"),
+        } for u in r.xml().iter(f"{S3_NS}Upload")]

@@ -64,10 +64,52 @@ def build_server(dirs: list[str], address: str = "127.0.0.1:9000",
     return srv
 
 
+def build_gateway_server(kind: str, target: str,
+                         address: str = "127.0.0.1:9000",
+                         access_key: str | None = None,
+                         secret_key: str | None = None,
+                         cache_dirs: list[str] | None = None,
+                         region: str = "us-east-1") -> S3Server:
+    """`minio gateway <kind>` analog (cmd/gateway-main.go): the same S3
+    frontend over a foreign backend, optionally fronted by the disk
+    cache (cmd/disk-cache.go:88 deploys cacheObjects for gateways)."""
+    from . import gateway as gw
+
+    access_key = access_key or os.environ.get("MT_ROOT_USER", "minioadmin")
+    secret_key = secret_key or os.environ.get("MT_ROOT_PASSWORD",
+                                              "minioadmin")
+    cls = gw.lookup(kind)
+    if kind == "s3":
+        g = cls(target,
+                os.environ.get("MT_GATEWAY_ACCESS_KEY", access_key),
+                os.environ.get("MT_GATEWAY_SECRET_KEY", secret_key),
+                region)
+    else:
+        g = cls(target)
+    layer = g.new_gateway_layer()
+    if cache_dirs:
+        from .objectlayer.diskcache import CacheObjects
+        layer = CacheObjects(layer, cache_dirs)
+    host, _, port = address.rpartition(":")
+    srv = S3Server(layer, access_key=access_key, secret_key=secret_key,
+                   region=region, host=host or "0.0.0.0", port=int(port))
+    srv.iam.load()
+    return srv
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="minio_tpu", description="TPU-native S3 object storage server")
     sub = parser.add_subparsers(dest="command", required=True)
+    pg = sub.add_parser("gateway", help="serve S3 over a foreign backend")
+    pg.add_argument("kind", help="nas | s3 | azure | gcs | hdfs")
+    pg.add_argument("target", help="mount path (nas) or endpoint URL (s3)")
+    pg.add_argument("--address", default="0.0.0.0:9000")
+    pg.add_argument("--access-key", default=None)
+    pg.add_argument("--secret-key", default=None)
+    pg.add_argument("--cache-dir", action="append", default=None,
+                    help="disk cache drive (repeatable)")
+    pg.add_argument("--region", default="us-east-1")
     ps = sub.add_parser("server", help="start the object storage server")
     ps.add_argument("dirs", nargs="+", help="drive directories")
     ps.add_argument("--address", default="0.0.0.0:9000")
@@ -80,6 +122,19 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("--block-size", type=int, default=None)
     ps.add_argument("--region", default="us-east-1")
     args = parser.parse_args(argv)
+
+    if args.command == "gateway":
+        srv = build_gateway_server(args.kind, args.target, args.address,
+                                   args.access_key, args.secret_key,
+                                   args.cache_dir, args.region)
+        print(f"minio-tpu gateway [{args.kind}] -> {args.target}",
+              flush=True)
+        print(f"S3 endpoint: http://{args.address}", flush=True)
+        try:
+            srv.httpd.serve_forever()
+        except KeyboardInterrupt:
+            srv.stop()
+        return 0
 
     srv = build_server(args.dirs, args.address, args.access_key,
                        args.secret_key, args.set_drive_count,
