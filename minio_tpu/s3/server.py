@@ -105,6 +105,11 @@ class S3Server:
                 auth_token=self.config.get("notify_webhook", "auth_token"),
                 store_dir=self.config.get("notify_webhook", "queue_dir")
                 or None))
+        from ..events.brokers import BROKER_KINDS, target_from_config
+        for kind in BROKER_KINDS:
+            t = target_from_config(kind, self.config)
+            if t is not None:
+                self.events.register_target(t)
         # wired in by server_main / tests when those subsystems are enabled
         self.replication = None  # ReplicationSys (minio_tpu/background)
         self.usage = None        # data-usage cache (crawler)
@@ -444,6 +449,15 @@ def _make_handler(srv: S3Server):
                     if self.command != "GET":
                         raise S3Error("MethodNotAllowed")
                     return admin_handlers.handle(self, srv, path, query, b"")
+                from . import web as web_handlers
+                if path == web_handlers.WEBRPC_PATH or \
+                        path == web_handlers.ZIP_PATH or \
+                        path.startswith((web_handlers.UPLOAD_PREFIX,
+                                         web_handlers.DOWNLOAD_PREFIX)):
+                    # web endpoints authenticate with their own JWT
+                    if web_handlers.handle(self, srv, path, query,
+                                           self._body):
+                        return
                 payload = self._body()
                 self._rx_bytes = len(payload)
                 mtr.inc("mt_s3_rx_bytes_total", value=len(payload))

@@ -1,0 +1,387 @@
+"""Browser web backend — JSON-RPC service + upload/download endpoints.
+
+Reference: cmd/web-router.go:77-97 registers the JSON-RPC service
+`web.*` (cmd/web-handlers.go, ~2.3k LoC) used by the React SPA:
+Login issues a JWT, and the RPCs (ServerInfo, StorageInfo, MakeBucket,
+DeleteBucket, ListBuckets, ListObjects, RemoveObject, PresignedGet,
+CreateURLToken, GetAuth/GenerateAuth/SetAuth) plus raw upload/download/
+zip endpoints drive the browser UI.  Routes here:
+
+  POST /minio-tpu/webrpc                      JSON-RPC 2.0 envelope
+  PUT  /minio-tpu/upload/<bucket>/<key>       Bearer JWT
+  GET  /minio-tpu/download/<bucket>/<key>?token=JWT
+  POST /minio-tpu/zip?token=JWT               {"bucketName","prefix","objects"}
+
+Authorization mirrors the reference: Login validates credentials via
+IAM, the JWT (HS256, signed with the root secret, cmd/jwt.go) carries
+the access key, and each RPC re-checks the mapped S3 action through
+IAMSys.IsAllowed (web-handlers.go authenticateRequest + IsAllowed).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+import zipfile
+
+from ..iam.sts import STSError, sign_token, verify_token
+from ..objectlayer import interface as oli
+
+WEBRPC_PATH = "/minio-tpu/webrpc"
+UPLOAD_PREFIX = "/minio-tpu/upload/"
+DOWNLOAD_PREFIX = "/minio-tpu/download/"
+ZIP_PATH = "/minio-tpu/zip"
+TOKEN_TTL_S = 24 * 3600            # cmd/jwt.go defaultJWTExpiry
+UI_VERSION = "minio-tpu-web/1"
+
+
+class WebError(Exception):
+    def __init__(self, message: str, code: int = -32000):
+        super().__init__(message)
+        self.code = code
+
+
+class AuthError(WebError):
+    def __init__(self, message: str = "Authentication failed"):
+        super().__init__(message, -32001)
+
+
+def _mint(srv, access_key: str) -> str:
+    return sign_token({"accessKey": access_key, "sub": access_key,
+                       "iss": "web", "exp": int(time.time()) + TOKEN_TTL_S},
+                      srv.iam.root.secret_key)
+
+
+def _verify(srv, token: str) -> str:
+    """Token -> authenticated access key."""
+    if not token:
+        raise AuthError("missing token")
+    try:
+        claims = verify_token(token, srv.iam.root.secret_key)
+    except STSError as e:
+        raise AuthError(str(e)) from e
+    if claims.get("iss") != "web":
+        raise AuthError("not a web token")
+    ak = claims.get("accessKey") or claims.get("sub") or ""
+    if srv.iam.lookup_secret(ak) is None:
+        raise AuthError("unknown access key")
+    return ak
+
+
+def _allowed(srv, access_key: str, action: str, bucket: str,
+             obj: str = "") -> None:
+    if not srv.iam.is_allowed(access_key, action, bucket, obj):
+        raise AuthError("access denied")
+
+
+class WebRPC:
+    """The `web.*` method table (cmd/web-handlers.go webAPIHandlers)."""
+
+    def __init__(self, srv):
+        self.srv = srv
+        self.started = time.time()
+
+    # every method takes (access_key | None, params) and returns a dict
+    def dispatch(self, method: str, params: dict, token: str) -> dict:
+        name = method.split(".", 1)[-1]
+        fn = getattr(self, f"rpc_{name}", None)
+        if fn is None:
+            raise WebError(f"unknown method {method}", -32601)
+        if name == "Login":
+            return fn(None, params)
+        return fn(_verify(self.srv, token), params)
+
+    # -- session -----------------------------------------------------------
+
+    def rpc_Login(self, _ak, p: dict) -> dict:
+        user = p.get("username", "")
+        password = p.get("password", "")
+        secret = self.srv.iam.lookup_secret(user)
+        if secret is None or secret != password:
+            raise AuthError("Invalid credentials")
+        return {"token": _mint(self.srv, user), "uiVersion": UI_VERSION}
+
+    def rpc_CreateURLToken(self, ak, _p) -> dict:
+        return {"token": _mint(self.srv, ak), "uiVersion": UI_VERSION}
+
+    # -- server ------------------------------------------------------------
+
+    def rpc_ServerInfo(self, ak, _p) -> dict:
+        import platform
+        return {
+            "MinioVersion": "minio-tpu-dev",
+            "MinioPlatform": f"{platform.system()} {platform.machine()}",
+            "MinioRuntime": f"python {platform.python_version()}",
+            "MinioGlobalInfo": {"isDistErasure": False,
+                                "uptime_s": int(time.time() - self.started)},
+            "uiVersion": UI_VERSION,
+        }
+
+    def rpc_StorageInfo(self, ak, _p) -> dict:
+        used = 0
+        if self.srv.usage is not None:
+            try:
+                used = getattr(self.srv.usage, 'objects_total_size', 0)
+            except Exception:
+                used = 0
+        return {"used": used, "uiVersion": UI_VERSION}
+
+    # -- buckets -----------------------------------------------------------
+
+    def rpc_MakeBucket(self, ak, p: dict) -> dict:
+        bucket = p.get("bucketName", "")
+        _allowed(self.srv, ak, "s3:CreateBucket", bucket)
+        self.srv.layer.make_bucket(bucket)
+        return {"uiVersion": UI_VERSION}
+
+    def rpc_DeleteBucket(self, ak, p: dict) -> dict:
+        bucket = p.get("bucketName", "")
+        _allowed(self.srv, ak, "s3:DeleteBucket", bucket)
+        self.srv.layer.delete_bucket(bucket)
+        self.srv.bucket_meta.drop(bucket)
+        return {"uiVersion": UI_VERSION}
+
+    def rpc_ListBuckets(self, ak, _p) -> dict:
+        out = []
+        for b in self.srv.layer.list_buckets():
+            if self.srv.iam.is_allowed(ak, "s3:ListBucket", b.name):
+                out.append({"name": b.name,
+                            "creationDate": _iso(b.created)})
+        return {"buckets": out, "uiVersion": UI_VERSION}
+
+    def rpc_ListObjects(self, ak, p: dict) -> dict:
+        bucket = p.get("bucketName", "")
+        prefix = p.get("prefix", "")
+        marker = p.get("marker", "")
+        _allowed(self.srv, ak, "s3:ListBucket", bucket)
+        res = self.srv.layer.list_objects(bucket, prefix=prefix,
+                                          marker=marker, delimiter="/",
+                                          max_keys=1000)
+        objects = [{"name": o.name, "size": o.size, "etag": o.etag,
+                    "lastModified": _iso(o.mod_time),
+                    "contentType": o.content_type} for o in res.objects]
+        objects += [{"name": d, "size": 0, "lastModified": "",
+                     "contentType": ""} for d in res.prefixes]
+        return {"objects": objects, "istruncated": res.is_truncated,
+                "nextmarker": res.next_marker, "writable": True,
+                "uiVersion": UI_VERSION}
+
+    def rpc_RemoveObject(self, ak, p: dict) -> dict:
+        bucket = p.get("bucketName", "")
+        removed = []
+        for obj in p.get("objects", []):
+            _allowed(self.srv, ak, "s3:DeleteObject", bucket, obj)
+            if obj.endswith("/"):      # prefix delete, as the UI offers
+                # expanding a prefix is a listing: require ListBucket so
+                # delete-only grants can't enumerate bucket contents
+                _allowed(self.srv, ak, "s3:ListBucket", bucket)
+                res = self.srv.layer.list_objects(bucket, prefix=obj,
+                                                  max_keys=10 ** 6)
+                for oi in res.objects:
+                    self.srv.layer.delete_object(bucket, oi.name)
+                    removed.append(oi.name)
+            else:
+                self.srv.layer.delete_object(bucket, obj)
+                removed.append(obj)
+        return {"removed": removed, "uiVersion": UI_VERSION}
+
+    # -- sharing -----------------------------------------------------------
+
+    def rpc_PresignedGet(self, ak, p: dict) -> dict:
+        from .sigv4 import Credentials, presign_url
+        bucket = p.get("bucketName", "")
+        obj = p.get("objectName", "")
+        expiry = int(p.get("expiry", 604800) or 604800)
+        _allowed(self.srv, ak, "s3:GetObject", bucket, obj)
+        secret = self.srv.iam.lookup_secret(ak)
+        host = p.get("host") or f"127.0.0.1:{self.srv.port}"
+        url = presign_url(
+            Credentials(ak, secret), "GET",
+            f"http://{host}/{bucket}/{urllib.parse.quote(obj)}",
+            expiry, self.srv.region)
+        return {"url": url, "uiVersion": UI_VERSION}
+
+    # -- credentials -------------------------------------------------------
+
+    def rpc_GetAuth(self, ak, _p) -> dict:
+        return {"accessKey": ak,
+                "secretKey": self.srv.iam.lookup_secret(ak),
+                "uiVersion": UI_VERSION}
+
+    def rpc_GenerateAuth(self, ak, _p) -> dict:
+        import secrets as pysecrets
+        return {"accessKey": pysecrets.token_hex(10).upper(),
+                "secretKey": pysecrets.token_urlsafe(30)[:40],
+                "uiVersion": UI_VERSION}
+
+
+def _iso(ns: int) -> str:
+    if not ns:
+        return ""
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ns / 1e9))
+
+
+# ---------------------------------------------------------------------------
+# HTTP glue — called from the server's dispatch before SigV4 auth
+# ---------------------------------------------------------------------------
+
+def handle(h, srv, path: str, query: dict, read_body) -> bool:
+    """Route web endpoints; True when handled.  `read_body` is a thunk so
+    the RPC path can bound the read while uploads stream."""
+    if path == WEBRPC_PATH and h.command == "POST":
+        _handle_rpc(h, srv, read_body())
+        return True
+    if path.startswith(UPLOAD_PREFIX) and h.command == "PUT":
+        _handle_upload(h, srv, path, read_body())
+        return True
+    if path.startswith(DOWNLOAD_PREFIX) and h.command == "GET":
+        _handle_download(h, srv, path, query)
+        return True
+    if path == ZIP_PATH and h.command == "POST":
+        _handle_zip(h, srv, query, read_body())
+        return True
+    return False
+
+
+def _reply_json(h, status: int, doc: dict) -> None:
+    body = json.dumps(doc).encode()
+    h.send_response(status)
+    h.send_header("Content-Type", "application/json")
+    h.send_header("Content-Length", str(len(body)))
+    h.end_headers()
+    h.wfile.write(body)
+
+
+def _handle_rpc(h, srv, payload: bytes) -> None:
+    if not hasattr(srv, "_webrpc"):
+        srv._webrpc = WebRPC(srv)
+    try:
+        req = json.loads(payload or b"{}")
+    except json.JSONDecodeError:
+        return _reply_json(h, 400, {"jsonrpc": "2.0", "id": None,
+                                    "error": {"code": -32700,
+                                              "message": "parse error"}})
+    rid = req.get("id")
+    token = ""
+    auth = h.headers.get("Authorization", "")
+    if auth.startswith("Bearer "):
+        token = auth[len("Bearer "):]
+    try:
+        result = srv._webrpc.dispatch(req.get("method", ""),
+                                      req.get("params") or {}, token)
+        _reply_json(h, 200, {"jsonrpc": "2.0", "id": rid, "result": result})
+    except WebError as e:
+        _reply_json(h, 401 if isinstance(e, AuthError) else 200,
+                    {"jsonrpc": "2.0", "id": rid,
+                     "error": {"code": e.code, "message": str(e)}})
+    except oli.ObjectLayerError as e:
+        _reply_json(h, 200, {"jsonrpc": "2.0", "id": rid,
+                             "error": {"code": -32000,
+                                       "message": f"{type(e).__name__}: "
+                                                  f"{e}"}})
+
+
+def _token_of(h, query: dict) -> str:
+    auth = h.headers.get("Authorization", "")
+    if auth.startswith("Bearer "):
+        return auth[len("Bearer "):]
+    return query.get("token", [""])[0]
+
+
+def _handle_upload(h, srv, path: str, payload: bytes) -> None:
+    rest = path[len(UPLOAD_PREFIX):]
+    bucket, _, key = rest.partition("/")
+    try:
+        ak = _verify(srv, _token_of(h, {}))
+        _allowed(srv, ak, "s3:PutObject", bucket, key)
+        opts = oli.PutObjectOptions(user_defined={
+            "content-type": h.headers.get("Content-Type",
+                                          "application/octet-stream")})
+        srv.layer.put_object(bucket, key, payload, opts)
+        _reply_json(h, 200, {"ok": True})
+    except (WebError, oli.ObjectLayerError) as e:
+        _reply_json(h, 401 if isinstance(e, AuthError) else 400,
+                    {"ok": False, "error": str(e)})
+
+
+def _handle_download(h, srv, path: str, query: dict) -> None:
+    rest = path[len(DOWNLOAD_PREFIX):]
+    bucket, _, key = rest.partition("/")
+    try:
+        ak = _verify(srv, _token_of(h, query))
+        _allowed(srv, ak, "s3:GetObject", bucket, key)
+        info, data = srv.layer.get_object(bucket, key)
+        # header values must never carry CR/LF/quotes from an attacker-
+        # chosen object key (response-splitting via percent-encoded keys)
+        fname = "".join(c for c in key.rpartition("/")[2]
+                        if c.isprintable() and c not in '"\\;')
+        h.send_response(200)
+        h.send_header("Content-Type",
+                      info.content_type or "application/octet-stream")
+        h.send_header("Content-Length", str(len(data)))
+        h.send_header("Content-Disposition",
+                      f'attachment; filename="{fname or "download"}"')
+        h.end_headers()
+        h.wfile.write(data)
+    except (WebError, oli.ObjectLayerError) as e:
+        _reply_json(h, 401 if isinstance(e, AuthError) else 404,
+                    {"ok": False, "error": str(e)})
+
+
+class _CountingWriter:
+    """Unseekable sink for zipfile: write + tell only, so the archive
+    streams to the socket instead of building in memory."""
+
+    def __init__(self, raw):
+        self._raw = raw
+        self._pos = 0
+
+    def write(self, data):
+        self._raw.write(data)
+        self._pos += len(data)
+        return len(data)
+
+    def tell(self):
+        return self._pos
+
+    def flush(self):
+        self._raw.flush()
+
+
+def _handle_zip(h, srv, query: dict, payload: bytes) -> None:
+    """DownloadZip (web-handlers.go DownloadZipHandler): stream the
+    requested objects/prefixes as one zip archive — one object resident
+    at a time, archive bytes written straight to the socket."""
+    try:
+        ak = _verify(srv, _token_of(h, query))
+        req = json.loads(payload or b"{}")
+        bucket = req.get("bucketName", "")
+        prefix = req.get("prefix", "")
+        names: list[str] = []
+        for obj in req.get("objects", []):
+            full = prefix + obj
+            if full.endswith("/"):
+                # prefix expansion is a listing; require ListBucket
+                _allowed(srv, ak, "s3:ListBucket", bucket)
+                res = srv.layer.list_objects(bucket, prefix=full,
+                                             max_keys=10 ** 6)
+                names += [o.name for o in res.objects]
+            else:
+                names.append(full)
+        for name in names:                  # authorize all before byte 1
+            _allowed(srv, ak, "s3:GetObject", bucket, name)
+        h.send_response(200)
+        h.send_header("Content-Type", "application/zip")
+        # length unknown up front: delimit by closing the connection
+        h.send_header("Connection", "close")
+        h.end_headers()
+        with zipfile.ZipFile(_CountingWriter(h.wfile), "w",
+                             zipfile.ZIP_DEFLATED) as zf:
+            for name in names:
+                _, data = srv.layer.get_object(bucket, name)
+                zf.writestr(name[len(prefix):] or name, data)
+        h.close_connection = True
+    except (WebError, oli.ObjectLayerError) as e:
+        _reply_json(h, 401 if isinstance(e, AuthError) else 400,
+                    {"ok": False, "error": str(e)})
