@@ -77,7 +77,43 @@ class QueueStore:
         return len(self.list())
 
 
-class WebhookTarget(Target):
+class StoreForwardTarget(Target):
+    """Deliver-or-queue base shared by webhook and every broker target:
+    failed sends persist to the QueueStore and drain via replay()
+    (pkg/event/target/queuestore.go semantics)."""
+
+    def __init__(self, arn: str, store_dir: Optional[str] = None):
+        self.arn = arn
+        self.store = QueueStore(store_dir) if store_dir else None
+
+    def _deliver(self, record: dict) -> None:  # pragma: no cover - iface
+        raise NotImplementedError
+
+    def send(self, record: dict) -> None:
+        try:
+            self._deliver(record)
+        except Exception as e:
+            if self.store is not None:
+                self.store.put(record)      # retry later via replay()
+            else:
+                raise TargetError(str(e)) from e
+
+    def replay(self) -> int:
+        """Redeliver queued events; returns how many got through."""
+        if self.store is None:
+            return 0
+        ok = 0
+        for key in self.store.list():
+            try:
+                self._deliver(self.store.get(key))
+            except Exception:
+                break                       # endpoint still down: stop
+            self.store.delete(key)
+            ok += 1
+        return ok
+
+
+class WebhookTarget(StoreForwardTarget):
     """POST each record as {"EventName","Key","Records":[...]} JSON
     (pkg/event/target/webhook.go sendEvent)."""
 
@@ -85,13 +121,12 @@ class WebhookTarget(Target):
                  auth_token: str = "",
                  store_dir: Optional[str] = None,
                  timeout: float = 5.0):
-        self.arn = arn
+        super().__init__(arn, store_dir)
         self.endpoint = endpoint
         self.auth_token = auth_token
         self.timeout = timeout
-        self.store = QueueStore(store_dir) if store_dir else None
 
-    def _post(self, record: dict) -> None:
+    def _deliver(self, record: dict) -> None:
         body = json.dumps({
             "EventName": "s3:" + record.get("eventName", ""),
             "Key": f"{record['s3']['bucket']['name']}/"
@@ -107,28 +142,8 @@ class WebhookTarget(Target):
             if resp.status // 100 != 2:
                 raise TargetError(f"webhook returned {resp.status}")
 
-    def send(self, record: dict) -> None:
-        try:
-            self._post(record)
-        except Exception as e:
-            if self.store is not None:
-                self.store.put(record)      # retry later via replay()
-            else:
-                raise TargetError(str(e)) from e
-
-    def replay(self) -> int:
-        """Redeliver queued events; returns how many got through."""
-        if self.store is None:
-            return 0
-        ok = 0
-        for key in self.store.list():
-            try:
-                self._post(self.store.get(key))
-            except Exception:
-                break                       # endpoint still down: stop
-            self.store.delete(key)
-            ok += 1
-        return ok
+    # backwards-compatible name used by older callers/tests
+    _post = _deliver
 
 
 class MemoryTarget(Target):

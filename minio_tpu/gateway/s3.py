@@ -50,6 +50,20 @@ def _http_date_ns(value: str) -> int:
         return 0
 
 
+def _iso_date_ns(value: str) -> int:
+    """ListObjects LastModified is ISO8601 (2006-01-02T15:04:05.000Z)."""
+    if not value:
+        return 0
+    try:
+        from datetime import datetime, timezone
+        dt = datetime.fromisoformat(value.replace("Z", "+00:00"))
+        if dt.tzinfo is None:
+            dt = dt.replace(tzinfo=timezone.utc)
+        return int(dt.timestamp() * 1e9)
+    except ValueError:
+        return 0
+
+
 # Frontend-internal metadata (SSE sealed keys x-minio-internal-*, tags,
 # compression markers) must survive the remote hop even though remote S3
 # only persists x-amz-meta-* headers: encode them under the meta prefix
@@ -110,11 +124,50 @@ class S3GatewayLayer(GatewayUnsupported, ObjectLayer):
 
     enforce_min_part_size = True
 
+    # remote scratch bucket holding initiate-time multipart metadata,
+    # so any gateway instance (or a restarted one) recovers the SSE/
+    # compression markers that drive later parts — the role the
+    # reference's minio.sys.tmp bucket plays for gateway SSE state
+    SYS_BUCKET = "minio-tpu-sys-tmp"
+
     def __init__(self, client: S3Client):
         self.client = client
-        # initiate-time metadata per upload id: the frontend re-reads it
-        # via get_multipart_info to drive SSE/compression of later parts
-        self._uploads: dict[str, dict] = {}
+        self._uploads: dict[str, dict] = {}      # warm cache of sidecars
+
+    def _upload_meta_key(self, upload_id: str) -> str:
+        return f"multipart/{upload_id}.json"
+
+    def _save_upload_meta(self, upload_id: str, user_defined: dict) -> None:
+        import json
+        try:
+            self.client.make_bucket(self.SYS_BUCKET)
+        except S3ClientError:
+            pass                                 # already exists
+        self.client.put_object(self.SYS_BUCKET,
+                               self._upload_meta_key(upload_id),
+                               json.dumps(user_defined).encode())
+        self._uploads[upload_id] = dict(user_defined)
+
+    def _load_upload_meta(self, upload_id: str) -> dict:
+        if upload_id in self._uploads:
+            return self._uploads[upload_id]
+        import json
+        try:
+            r = self.client.get_object(self.SYS_BUCKET,
+                                       self._upload_meta_key(upload_id))
+            meta = json.loads(r.body)
+        except (S3ClientError, ValueError):
+            meta = {}
+        self._uploads[upload_id] = meta
+        return meta
+
+    def _drop_upload_meta(self, upload_id: str) -> None:
+        self._uploads.pop(upload_id, None)
+        try:
+            self.client.delete_object(self.SYS_BUCKET,
+                                      self._upload_meta_key(upload_id))
+        except S3ClientError:
+            pass
 
     # -- buckets -----------------------------------------------------------
 
@@ -136,7 +189,8 @@ class S3GatewayLayer(GatewayUnsupported, ObjectLayer):
         return BucketInfo(bucket, 0)
 
     def list_buckets(self) -> list[BucketInfo]:
-        return [BucketInfo(b, 0) for b in self.client.list_buckets()]
+        return [BucketInfo(b, 0) for b in self.client.list_buckets()
+                if b != self.SYS_BUCKET]
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
         try:
@@ -212,8 +266,11 @@ class S3GatewayLayer(GatewayUnsupported, ObjectLayer):
                      delimiter: str = "", max_keys: int = 1000
                      ) -> ListObjectsInfo:
         try:
+            # V1 listing: the ObjectLayer marker contract is a key name,
+            # which V1 forwards verbatim; V2 continuation tokens are
+            # opaque and cannot carry a key-name marker
             page = self.client.list_objects_page(
-                bucket, prefix=prefix, delimiter=delimiter,
+                bucket, prefix=prefix, delimiter=delimiter, v2=False,
                 marker=marker, max_keys=max_keys)
         except S3ClientError as e:
             _translate(e, bucket)
@@ -225,7 +282,8 @@ class S3GatewayLayer(GatewayUnsupported, ObjectLayer):
         for o in page["objects"]:
             out.objects.append(ObjectInfo(
                 bucket=bucket, name=o["key"], size=o["size"],
-                etag=o["etag"]))
+                etag=o["etag"],
+                mod_time=_iso_date_ns(o.get("last_modified", ""))))
         return out
 
     # -- multipart passthrough ---------------------------------------------
@@ -238,7 +296,7 @@ class S3GatewayLayer(GatewayUnsupported, ObjectLayer):
                 bucket, object_name, headers=_encode_meta(opts.user_defined))
         except S3ClientError as e:
             _translate(e, bucket, object_name)
-        self._uploads[uid] = dict(opts.user_defined)
+        self._save_upload_meta(uid, opts.user_defined)
         return uid
 
     def put_object_part(self, bucket: str, object_name: str, upload_id: str,
@@ -257,7 +315,7 @@ class S3GatewayLayer(GatewayUnsupported, ObjectLayer):
         except S3ClientError as e:
             _translate(e, upload_id)
         return MultipartInfo(bucket, object_name, upload_id,
-                             self._uploads.get(upload_id, {}))
+                             self._load_upload_meta(upload_id))
 
     def list_object_parts(self, bucket: str, object_name: str,
                           upload_id: str) -> list[PartInfo]:
@@ -275,7 +333,7 @@ class S3GatewayLayer(GatewayUnsupported, ObjectLayer):
                                                upload_id)
         except S3ClientError as e:
             _translate(e, upload_id)
-        self._uploads.pop(upload_id, None)
+        self._drop_upload_meta(upload_id)
 
     def list_multipart_uploads(self, bucket: str,
                                prefix: str = "") -> list[MultipartInfo]:
@@ -294,7 +352,7 @@ class S3GatewayLayer(GatewayUnsupported, ObjectLayer):
                 bucket, object_name, upload_id, parts)
         except S3ClientError as e:
             _translate(e, upload_id)
-        self._uploads.pop(upload_id, None)
+        self._drop_upload_meta(upload_id)
         ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
         etag = (root.findtext(f"{ns}ETag") or
                 root.findtext("ETag") or "").strip('"')

@@ -169,16 +169,20 @@ class S3Client:
             "key": c.findtext(f"{S3_NS}Key"),
             "size": int(c.findtext(f"{S3_NS}Size")),
             "etag": (c.findtext(f"{S3_NS}ETag") or "").strip('"'),
+            "last_modified": c.findtext(f"{S3_NS}LastModified") or "",
         } for c in root.iter(f"{S3_NS}Contents")]
         prefixes = [p.findtext(f"{S3_NS}Prefix")
                     for p in root.iter(f"{S3_NS}CommonPrefixes")]
+        truncated = (root.findtext(f"{S3_NS}IsTruncated") or "") == "true"
+        next_marker = (root.findtext(f"{S3_NS}NextContinuationToken") or
+                       root.findtext(f"{S3_NS}NextMarker") or "")
+        if truncated and not next_marker and objs:
+            # V1 without a delimiter omits NextMarker: last key continues
+            next_marker = objs[-1]["key"]
         return {
             "objects": objs, "prefixes": prefixes,
-            "is_truncated":
-                (root.findtext(f"{S3_NS}IsTruncated") or "") == "true",
-            "next_marker":
-                root.findtext(f"{S3_NS}NextContinuationToken") or
-                root.findtext(f"{S3_NS}NextMarker") or "",
+            "is_truncated": truncated,
+            "next_marker": next_marker,
         }
 
     def list_object_versions(self, bucket: str, prefix: str = "") -> ET.Element:
@@ -213,7 +217,8 @@ class S3Client:
         r = self.request(
             "PUT", f"/{bucket}/{key}",
             f"partNumber={part_number}&uploadId={upload_id}", body=data)
-        return r.headers.get("ETag", r.headers.get("Etag", "")).strip('"')
+        hdrs = {k.lower(): v for k, v in r.headers.items()}
+        return hdrs.get("etag", "").strip('"')
 
     def complete_multipart_upload(self, bucket: str, key: str,
                                   upload_id: str,

@@ -71,6 +71,33 @@ register_subsys("audit_webhook", {"enable": "off", "endpoint": "",
                                   "auth_token": ""})
 register_subsys("notify_webhook", {"enable": "off", "endpoint": "",
                                    "auth_token": "", "queue_dir": ""})
+# broker notification subsystems (cmd/config/notify): keys mirror the
+# reference's per-target config structs
+register_subsys("notify_amqp", {"enable": "off", "url": "",
+                                "exchange": "", "routing_key": "",
+                                "queue_dir": ""})
+register_subsys("notify_kafka", {"enable": "off", "brokers": "",
+                                 "topic": "", "queue_dir": ""})
+register_subsys("notify_mqtt", {"enable": "off", "broker": "",
+                                "topic": "", "qos": "0", "queue_dir": ""})
+register_subsys("notify_nats", {"enable": "off", "address": "",
+                                "subject": "", "queue_dir": ""})
+register_subsys("notify_nsq", {"enable": "off", "nsqd_address": "",
+                               "topic": "", "queue_dir": ""})
+register_subsys("notify_redis", {"enable": "off", "address": "",
+                                 "key": "", "format": "namespace",
+                                 "queue_dir": ""})
+register_subsys("notify_mysql", {"enable": "off", "dsn_string": "",
+                                 "table": "", "format": "namespace",
+                                 "queue_dir": ""})
+register_subsys("notify_postgresql", {"enable": "off",
+                                      "connection_string": "",
+                                      "table": "", "format": "namespace",
+                                      "queue_dir": ""})
+register_subsys("notify_elasticsearch", {"enable": "off", "url": "",
+                                         "index": "",
+                                         "format": "namespace",
+                                         "queue_dir": ""})
 
 
 class Config:

@@ -1,0 +1,155 @@
+"""Broker notification target tests (pkg/event/target/*).
+
+No broker SDK exists in this image (by design — zero egress), so these
+tests pin: payload formats (the part the reference unit-tests), the
+client-library gate, store-and-forward queueing + replay, and config-
+driven construction.
+"""
+
+import json
+
+import pytest
+
+from minio_tpu.events import brokers
+from minio_tpu.events.targets import TargetError
+from minio_tpu.utils.kvconfig import Config
+
+
+RECORD = {
+    "eventVersion": "2.0",
+    "eventName": "ObjectCreated:Put",
+    "eventTime": "2026-07-30T00:00:00.000Z",
+    "s3": {"bucket": {"name": "bkt"},
+           "object": {"key": "dir/obj.txt", "size": 3}},
+}
+DELETE_RECORD = dict(RECORD, eventName="ObjectRemoved:Delete")
+
+
+def test_event_payload_envelope():
+    p = brokers.event_payload(RECORD)
+    assert p["EventName"] == "s3:ObjectCreated:Put"
+    assert p["Key"] == "bkt/dir/obj.txt"
+    assert p["Records"] == [RECORD]
+
+
+def test_redis_namespace_commands():
+    t = brokers.RedisTarget("arn:t", "localhost:6379", "minio_events")
+    cmd = t.format_command(RECORD)
+    assert cmd[:3] == ("HSET", "minio_events", "bkt/dir/obj.txt")
+    assert json.loads(cmd[3]) == {"Records": [RECORD]}
+    assert t.format_command(DELETE_RECORD) == (
+        "HDEL", "minio_events", "bkt/dir/obj.txt")
+
+
+def test_redis_access_append():
+    t = brokers.RedisTarget("arn:t", "h:1", "log", fmt="access")
+    cmd = t.format_command(RECORD)
+    assert cmd[0] == "RPUSH" and cmd[1] == "log"
+    assert json.loads(cmd[2])["EventTime"] == RECORD["eventTime"]
+    # delete events still append in access mode
+    assert t.format_command(DELETE_RECORD)[0] == "RPUSH"
+
+
+def test_sql_statements():
+    my = brokers.MySQLTarget("arn:t", "dsn", "minio_images")
+    sql, params = my.format_statement(RECORD)
+    assert sql.startswith("REPLACE INTO minio_images")
+    assert params[0] == "bkt/dir/obj.txt"
+    sql_d, params_d = my.format_statement(DELETE_RECORD)
+    assert sql_d.startswith("DELETE FROM")
+
+    pg = brokers.PostgreSQLTarget("arn:t", "conn", "minio_images")
+    sql_pg, _ = pg.format_statement(RECORD)
+    assert "ON CONFLICT (key_name)" in sql_pg
+
+    acc = brokers.MySQLTarget("arn:t", "dsn", "log", fmt="access")
+    sql_a, params_a = acc.format_statement(DELETE_RECORD)
+    assert sql_a.startswith("INSERT INTO log")
+
+
+def test_elasticsearch_documents():
+    ns = brokers.ElasticsearchTarget("arn:t", "http://es", "idx")
+    doc_id, body = ns.format_document(RECORD)
+    assert doc_id == "bkt/dir/obj.txt" and body == {"Records": [RECORD]}
+    acc = brokers.ElasticsearchTarget("arn:t", "http://es", "idx",
+                                      fmt="access")
+    doc_id2, body2 = acc.format_document(RECORD)
+    assert doc_id2 is None and body2["timestamp"] == RECORD["eventTime"]
+
+
+def test_kafka_key_value():
+    t = brokers.KafkaTarget("arn:t", ["b1:9092"], "events")
+    key, value = t.format_payload(RECORD)
+    assert key == b"bkt/dir/obj.txt"
+    assert json.loads(value)["EventName"] == "s3:ObjectCreated:Put"
+
+
+def test_invalid_formats_rejected():
+    with pytest.raises(ValueError):
+        brokers.RedisTarget("a", "h", "k", fmt="bogus")
+    with pytest.raises(ValueError):
+        brokers.MySQLTarget("a", "d", "t", fmt="bogus")
+    with pytest.raises(ValueError):
+        brokers.ElasticsearchTarget("a", "u", "i", fmt="bogus")
+
+
+def test_client_gate_without_store_raises():
+    t = brokers.KafkaTarget("arn:t", ["b1:9092"], "events")
+    with pytest.raises(TargetError, match="kafka-python"):
+        t.send(RECORD)
+
+
+def test_store_and_forward_queue_and_replay(tmp_path, monkeypatch):
+    t = brokers.NATSTarget("arn:t", "nats://h:4222", "subj",
+                           store_dir=str(tmp_path / "q"))
+    t.send(RECORD)
+    t.send(DELETE_RECORD)
+    assert len(t.store) == 2                # queued while broker is gone
+    assert t.replay() == 0                  # still gone: nothing drains
+
+    delivered = []
+    monkeypatch.setattr(t, "_deliver", delivered.append)
+    assert t.replay() == 2                  # broker "back": queue drains
+    assert len(t.store) == 0
+    assert delivered[0]["eventName"] == "ObjectCreated:Put"
+    assert delivered[1]["eventName"] == "ObjectRemoved:Delete"
+
+
+def test_target_from_config(tmp_path, monkeypatch):
+    monkeypatch.setenv("MT_NOTIFY_KAFKA_ENABLE", "on")
+    monkeypatch.setenv("MT_NOTIFY_KAFKA_BROKERS", "k1:9092,k2:9092")
+    monkeypatch.setenv("MT_NOTIFY_KAFKA_TOPIC", "bucket-events")
+    monkeypatch.setenv("MT_NOTIFY_KAFKA_QUEUE_DIR", str(tmp_path / "kq"))
+    cfg = Config()
+    t = brokers.target_from_config("kafka", cfg)
+    assert isinstance(t, brokers.KafkaTarget)
+    assert t.brokers == ["k1:9092", "k2:9092"]
+    assert t.topic == "bucket-events"
+    assert t.arn == "arn:minio:sqs::1:kafka"
+    assert t.store is not None
+    # disabled kinds return None
+    assert brokers.target_from_config("redis", cfg) is None
+
+
+def test_all_kinds_constructible_from_config(monkeypatch):
+    settings = {
+        "amqp": {"url": "amqp://h"}, "kafka": {"brokers": "b", "topic": "t"},
+        "mqtt": {"broker": "tcp://h", "topic": "t"},
+        "nats": {"address": "h", "subject": "s"},
+        "nsq": {"nsqd_address": "h", "topic": "t"},
+        "redis": {"address": "h", "key": "k"},
+        "mysql": {"dsn_string": "d", "table": "t"},
+        "postgresql": {"connection_string": "c", "table": "t"},
+        "elasticsearch": {"url": "u", "index": "i"},
+    }
+    for kind, kv in settings.items():
+        monkeypatch.setenv(f"MT_NOTIFY_{kind.upper()}_ENABLE", "on")
+        for k, v in kv.items():
+            monkeypatch.setenv(f"MT_NOTIFY_{kind.upper()}_{k.upper()}", v)
+    cfg = Config()
+    for kind in brokers.BROKER_KINDS:
+        t = brokers.target_from_config(kind, cfg)
+        assert t is not None, kind
+        assert t.arn.endswith(f":{kind}")
+        with pytest.raises(TargetError):     # gated: no SDK in the image
+            t._deliver(RECORD)
