@@ -74,13 +74,16 @@ class MRFQueue:
                     self.stats.mrf_healed += 1
                 except Exception:  # noqa: BLE001 — sweep retries later
                     pass
+                finally:
+                    self._q.task_done()
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
 
     def drain(self, timeout: float = 5.0) -> None:
-        """Block until queued entries are processed (tests/shutdown)."""
+        """Block until queued entries are fully processed — including
+        the heal of the popped entry, not just an empty queue."""
         deadline = time.monotonic() + timeout
-        while not self._q.empty() and time.monotonic() < deadline:
+        while self._q.unfinished_tasks and time.monotonic() < deadline:
             time.sleep(0.01)
 
     def stop(self) -> None:

@@ -23,20 +23,10 @@ import importlib
 import json
 from typing import Optional
 
-from .targets import StoreForwardTarget, TargetError
+from .targets import StoreForwardTarget, TargetError, event_payload
 
 FORMAT_NAMESPACE = "namespace"
 FORMAT_ACCESS = "access"
-
-
-def event_payload(record: dict) -> dict:
-    """The common event-list envelope (pkg/event/target sendEvent)."""
-    return {
-        "EventName": "s3:" + record.get("eventName", ""),
-        "Key": f"{record['s3']['bucket']['name']}/"
-               f"{record['s3']['object']['key']}",
-        "Records": [record],
-    }
 
 
 def entry_key(record: dict) -> str:
@@ -302,7 +292,8 @@ def target_from_config(kind: str, cfg, target_id: str = "1"):
                           cfg.get(sub, "routing_key"),
                           store_dir=store)
     if kind == "kafka":
-        brokers = [b for b in cfg.get(sub, "brokers").split(",") if b]
+        brokers = [b.strip() for b in cfg.get(sub, "brokers").split(",")
+                   if b.strip()]
         return KafkaTarget(arn, brokers, cfg.get(sub, "topic"),
                            store_dir=store)
     if kind == "mqtt":

@@ -77,6 +77,17 @@ class QueueStore:
         return len(self.list())
 
 
+def event_payload(record: dict) -> dict:
+    """The event-list envelope shared by webhook and broker targets
+    (pkg/event/target sendEvent): {"EventName","Key","Records"}."""
+    return {
+        "EventName": "s3:" + record.get("eventName", ""),
+        "Key": f"{record['s3']['bucket']['name']}/"
+               f"{record['s3']['object']['key']}",
+        "Records": [record],
+    }
+
+
 class StoreForwardTarget(Target):
     """Deliver-or-queue base shared by webhook and every broker target:
     failed sends persist to the QueueStore and drain via replay()
@@ -127,12 +138,7 @@ class WebhookTarget(StoreForwardTarget):
         self.timeout = timeout
 
     def _deliver(self, record: dict) -> None:
-        body = json.dumps({
-            "EventName": "s3:" + record.get("eventName", ""),
-            "Key": f"{record['s3']['bucket']['name']}/"
-                   f"{record['s3']['object']['key']}",
-            "Records": [record],
-        }).encode()
+        body = json.dumps(event_payload(record)).encode()
         req = urllib.request.Request(
             self.endpoint, data=body,
             headers={"Content-Type": "application/json",
@@ -141,9 +147,6 @@ class WebhookTarget(StoreForwardTarget):
         with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             if resp.status // 100 != 2:
                 raise TargetError(f"webhook returned {resp.status}")
-
-    # backwards-compatible name used by older callers/tests
-    _post = _deliver
 
 
 class MemoryTarget(Target):

@@ -141,11 +141,16 @@ class S3GatewayLayer(GatewayUnsupported, ObjectLayer):
         import json
         try:
             self.client.make_bucket(self.SYS_BUCKET)
-        except S3ClientError:
-            pass                                 # already exists
-        self.client.put_object(self.SYS_BUCKET,
-                               self._upload_meta_key(upload_id),
-                               json.dumps(user_defined).encode())
+        except S3ClientError as e:
+            if e.code not in ("BucketAlreadyOwnedByYou",
+                              "BucketAlreadyExists"):
+                _translate(e, self.SYS_BUCKET)
+        try:
+            self.client.put_object(self.SYS_BUCKET,
+                                   self._upload_meta_key(upload_id),
+                                   json.dumps(user_defined).encode())
+        except S3ClientError as e:
+            _translate(e, self.SYS_BUCKET, upload_id)
         self._uploads[upload_id] = dict(user_defined)
 
     def _load_upload_meta(self, upload_id: str) -> dict:
@@ -156,8 +161,13 @@ class S3GatewayLayer(GatewayUnsupported, ObjectLayer):
             r = self.client.get_object(self.SYS_BUCKET,
                                        self._upload_meta_key(upload_id))
             meta = json.loads(r.body)
-        except (S3ClientError, ValueError):
-            meta = {}
+        except S3ClientError as e:
+            if e.code in ("NoSuchKey", "NoSuchBucket") or e.status == 404:
+                meta = {}                # genuinely absent: cacheable
+            else:
+                _translate(e, upload_id)  # transient: do NOT poison cache
+        except ValueError:
+            meta = {}                    # unparseable sidecar: treat absent
         self._uploads[upload_id] = meta
         return meta
 

@@ -69,7 +69,14 @@ class SelectRequest:
         if compression not in ("NONE", "GZIP"):
             raise SelectError("InvalidCompressionFormat")
         csv_el, json_el = inser.find("CSV"), inser.find("JSON")
-        if csv_el is not None:
+        parquet_el = inser.find("Parquet")
+        if parquet_el is not None:
+            # parquet pages carry their own codec; object-level
+            # compression is invalid (select.go parquet input rules)
+            if compression != "NONE":
+                raise SelectError("InvalidCompressionFormat")
+            fmt, opts = "PARQUET", {}
+        elif csv_el is not None:
             fmt = "CSV"
             opts = {
                 "header": _text(csv_el, "FileHeaderInfo", "NONE").upper(),
@@ -114,6 +121,12 @@ def run_select(payload: bytes, data: bytes) -> bytes:
         raise SelectError("ParseSelectFailure", str(e)) from e
     if req.input_format == "CSV":
         reader = records.csv_records(data, req.input_opts)
+    elif req.input_format == "PARQUET":
+        from . import parquet as pq
+        try:
+            reader = pq.parquet_records(data)
+        except pq.ParquetError as e:
+            raise SelectError("InvalidDataSource", str(e)) from e
     else:
         reader = records.json_records(data, req.input_opts)
 
@@ -134,8 +147,9 @@ def run_select(payload: bytes, data: bytes) -> bytes:
     except (ValueError, TypeError, KeyError) as e:
         # reader parse failures surface mid-iteration (generators):
         # malformed input is a 400 parse error, never a 500
-        code = "JSONParsingError" if req.input_format == "JSON" \
-            else "CSVParsingError"
+        code = {"JSON": "JSONParsingError",
+                "PARQUET": "InvalidDataSource"}.get(
+            req.input_format, "CSVParsingError")
         raise SelectError(code, str(e)) from e
 
     frames = bytearray()

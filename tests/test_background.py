@@ -133,7 +133,6 @@ def test_mrf_queue_heals_partial_write(er, tmp_path):
     er.make_bucket("mrfb")
     mrf = MRFQueue(er)
     er.mrf = mrf
-    mrf.start()
     try:
         # knock out one drive: write meets quorum (3/4) and queues MRF
         dead = er.disks[3]
@@ -141,8 +140,10 @@ def test_mrf_queue_heals_partial_write(er, tmp_path):
         er.put_object("mrfb", "partial", b"p" * 4096)
         assert mrf.stats.mrf_queued == 1
         er.disks[3] = dead   # drive comes back; MRF heals onto it
+        # start the worker only now: entries queue while stopped, and the
+        # heal must not race the drive's return
+        mrf.start()
         mrf.drain()
-        time.sleep(0.1)
         assert mrf.stats.mrf_healed == 1
         r = er.heal_object("mrfb", "partial", dry_run=True)
         assert r.before_ok == 4  # already fully healed
