@@ -97,6 +97,31 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
                 return send_json({"error": "no usage data yet"}, 404) \
                     or True
             return send_json(json.loads(info.to_json())) or True
+        if route == "tier" and h.command == "GET":
+            # madmin ListTiers analog — credentials never leave the server
+            return send_json(
+                json.loads(srv.transition.to_json(redact=True))) or True
+        if route == "tier" and h.command == "PUT":
+            # madmin AddTier analog: {"type":"dir"|"s3", "name", ...}
+            from ..objectlayer import tiering as _tr
+            from ..storage.xl_storage import SYS_DIR
+            doc = json.loads(payload)
+            if doc.get("type") == "dir":
+                srv.transition.add_tier(_tr.DirTier(doc["name"],
+                                                    doc["path"]))
+            elif doc.get("type") == "s3":
+                srv.transition.add_tier(_tr.S3Tier(
+                    doc["name"], doc["endpoint"], doc["bucket"],
+                    doc["access_key"], doc["secret_key"],
+                    doc.get("prefix", ""),
+                    doc.get("region", "us-east-1")))
+            else:
+                return send_json({"error": "unknown tier type"},
+                                 400) or True
+            blob = srv.transition.to_json()
+            srv.layer._fanout(
+                lambda d: d.write_all(SYS_DIR, "tiers/tiers.json", blob))
+            return send_json({"status": "ok"}) or True
         if route == "heal-status" and h.command == "GET":
             # madmin BackgroundHealStatus analog
             healer = getattr(srv, "healer", None)

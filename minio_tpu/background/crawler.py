@@ -142,20 +142,22 @@ def scan_usage(layer, bucket_meta=None, apply_lifecycle: bool = True,
                     bu.objects_count += 1
             if lc is None or skip_ilm:
                 continue
-            action = lc.compute_action(ObjectOpts(
+            oopts = ObjectOpts(
                 name=oi.name, mod_time_ns=oi.mod_time,
                 user_tags=_tags_of(oi), is_latest=oi.is_latest,
                 delete_marker=oi.delete_marker,
                 num_versions=oi.num_versions or 1,
                 successor_mod_time_ns=0 if oi.is_latest
-                else succ_mod.get((oi.name, oi.version_id), 0)))
+                else succ_mod.get((oi.name, oi.version_id), 0))
+            action = lc.compute_action(oopts)
             if action in (Action.DELETE, Action.DELETE_VERSION,
                           Action.DELETE_MARKER_DELETE):
                 _expire(layer, b.name, oi, action, res)
             elif action in (Action.TRANSITION, Action.TRANSITION_VERSION) \
                     and transition_fn is not None:
                 try:
-                    transition_fn(b.name, oi)
+                    transition_fn(b.name, oi,
+                                  lc.transition_storage_class(oopts))
                     res.transitioned.append((b.name, oi.name))
                 except Exception:  # noqa: BLE001 — retried next cycle
                     pass

@@ -333,6 +333,34 @@ class Lifecycle:
                 return Action.TRANSITION
         return Action.NONE
 
+    def transition_storage_class(self, obj: ObjectOpts,
+                                 now_ns: Optional[int] = None) -> str:
+        """Destination storage class of the transition rule that is
+        actually DUE — the same rule compute_action returns TRANSITION
+        for, not merely the first matching rule."""
+        if now_ns is None:
+            now_ns = int(datetime.datetime.now(
+                datetime.timezone.utc).timestamp() * 1e9)
+        day_ns = 24 * 3600 * 1e9
+        for r in self._filtered(obj):
+            if not obj.is_latest:
+                if r.noncurrent_transition_days is not None and \
+                        obj.successor_mod_time_ns and \
+                        now_ns >= obj.successor_mod_time_ns + \
+                        r.noncurrent_transition_days * day_ns:
+                    return r.noncurrent_transition_storage_class
+                continue
+            if r.transition_date is not None and \
+                    now_ns >= r.transition_date.timestamp() * 1e9 and \
+                    r.transition_storage_class:
+                return r.transition_storage_class
+            if r.transition_days is not None and \
+                    now_ns >= obj.mod_time_ns + \
+                    r.transition_days * day_ns and \
+                    r.transition_storage_class:
+                return r.transition_storage_class
+        return ""
+
     def has_active_rules(self, prefix: str = "") -> bool:
         return any(
             r.status == "Enabled" and (

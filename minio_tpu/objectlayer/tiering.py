@@ -1,0 +1,309 @@
+"""ILM transition (tiering) + RestoreObject.
+
+Reference: cmd/bucket-lifecycle.go:315 `transitionObject` moves a
+version's data to the configured remote target, leaving a metadata stub
+whose GET returns InvalidObjectState until `RestoreObject` (POST
+?restore, cmd/object-handlers.go PostRestoreObjectHandler) copies the
+data back for N days; HEAD reports `x-amz-storage-class` and
+`x-amz-restore` (cmd/bucket-lifecycle.go restoreTransitionedObject).
+
+The stored stream moves to the tier *verbatim* — SSE/compression
+markers stay on the stub, so a restore yields bit-identical stored
+bytes and the normal decode pipeline applies unchanged.
+
+Tier backends: S3 (a remote bucket via our own client) and Dir (a local
+path — the test tier, and the NAS analog).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Optional
+
+from ..storage.datatypes import now_ns
+from .interface import (ObjectLayerError, ObjectOptions, PutObjectOptions)
+
+# stub markers (x-minio-internal-transition* in the reference)
+META_STATUS = "x-minio-internal-transition-status"      # "complete"
+META_TIER = "x-minio-internal-transition-tier"          # tier name
+META_KEY = "x-minio-internal-transitioned-object"       # key inside tier
+META_SIZE = "x-minio-internal-transition-size"          # original size
+META_ETAG = "x-minio-internal-transition-etag"          # original etag
+META_RESTORE_EXPIRY = "x-minio-internal-restore-expiry"  # unix seconds
+
+RESTORE_HDR = "x-amz-restore"
+STORAGE_CLASS_HDR = "x-amz-storage-class"
+
+TRANSITION_MARKERS = (META_STATUS, META_TIER, META_KEY, META_SIZE,
+                      META_ETAG, META_RESTORE_EXPIRY)
+
+
+class TierError(ObjectLayerError):
+    pass
+
+
+class Tier:
+    """Remote tier backend (the reference's transition remote target)."""
+
+    name = ""
+
+    def put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def remove(self, key: str) -> None:
+        raise NotImplementedError
+
+
+class DirTier(Tier):
+    """Local-directory tier: the test backend and the NAS-style target."""
+
+    def __init__(self, name: str, path: str):
+        self.name = name
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def _p(self, key: str) -> str:
+        return os.path.join(self.path, key.replace("/", "_"))
+
+    def put(self, key: str, data: bytes) -> None:
+        tmp = self._p(key) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, self._p(key))
+
+    def get(self, key: str) -> bytes:
+        try:
+            with open(self._p(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise TierError(f"tier object {key} missing") from None
+
+    def remove(self, key: str) -> None:
+        try:
+            os.remove(self._p(key))
+        except FileNotFoundError:
+            pass
+
+
+class S3Tier(Tier):
+    """Remote S3 bucket tier (the reference's minio-go remote target)."""
+
+    def __init__(self, name: str, endpoint: str, bucket: str,
+                 access_key: str, secret_key: str, prefix: str = "",
+                 region: str = "us-east-1"):
+        from ..s3.client import S3Client
+        self.name = name
+        self.client = S3Client(endpoint, access_key, secret_key, region)
+        self.bucket = bucket
+        self.prefix = prefix
+
+    def _k(self, key: str) -> str:
+        return f"{self.prefix}{key}"
+
+    def put(self, key: str, data: bytes) -> None:
+        self.client.put_object(self.bucket, self._k(key), data)
+
+    def get(self, key: str) -> bytes:
+        from ..s3.client import S3ClientError
+        try:
+            return self.client.get_object(self.bucket, self._k(key)).body
+        except S3ClientError as e:
+            raise TierError(f"tier fetch failed: {e}") from e
+
+    def remove(self, key: str) -> None:
+        from ..s3.client import S3ClientError
+        try:
+            self.client.delete_object(self.bucket, self._k(key))
+        except S3ClientError:
+            pass
+
+
+# -- stub state helpers ------------------------------------------------------
+
+def _client_size(info) -> int:
+    """The client-visible size of a stored object: compressed objects
+    report actual size, SSE objects the decrypted size (the number HEAD
+    advertises before transition and after restore)."""
+    from .. import compress as mtc
+    from ..crypto import sse as csse
+    ud = info.user_defined
+    if mtc.META_COMPRESSION in ud and csse.META_ACTUAL_SIZE in ud:
+        return int(ud[csse.META_ACTUAL_SIZE])
+    if csse.is_encrypted(ud):
+        return csse.decrypted_size(ud, info.size, info.parts)
+    return info.size
+
+
+def is_transitioned(user_defined: dict) -> bool:
+    return user_defined.get(META_STATUS) == "complete"
+
+
+def restore_expiry(user_defined: dict) -> int:
+    try:
+        return int(user_defined.get(META_RESTORE_EXPIRY, "0"))
+    except ValueError:
+        return 0
+
+
+def restore_valid(user_defined: dict) -> bool:
+    return restore_expiry(user_defined) > time.time()
+
+
+def restore_header(user_defined: dict) -> str:
+    """x-amz-restore header value for HEAD/GET responses."""
+    exp = restore_expiry(user_defined)
+    if not exp:
+        return ""
+    if exp > time.time():
+        date = time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime(exp))
+        return f'ongoing-request="false", expiry-date="{date}"'
+    return ""
+
+
+class TransitionSys:
+    """Transition + restore driver bound to an object layer
+    (globalTransitionState analog)."""
+
+    def __init__(self, layer):
+        self.layer = layer
+        self.tiers: dict[str, Tier] = {}
+
+    def add_tier(self, tier: Tier) -> None:
+        self.tiers[tier.name] = tier
+
+    def tier_of(self, user_defined: dict) -> Optional[Tier]:
+        return self.tiers.get(user_defined.get(META_TIER, ""))
+
+    # -- transition --------------------------------------------------------
+
+    def transition(self, bucket: str, oi) -> None:
+        """Move a version's stored bytes to its rule's tier, leave a
+        stub (transitionObject, cmd/bucket-lifecycle.go:315).  The
+        version id threads through so noncurrent-version transitions
+        never touch the live head object."""
+        tier_name = getattr(oi, "transition_tier", "") or \
+            oi.user_defined.get(STORAGE_CLASS_HDR, "")
+        tier = self.tiers.get(tier_name)
+        if tier is None:
+            raise TierError(f"no tier named {tier_name!r}")
+        if is_transitioned(oi.user_defined):
+            return                              # already moved
+        vid = getattr(oi, "version_id", "") or ""
+        opts = ObjectOptions(version_id=vid or None)
+        info, data = self.layer.get_object(bucket, oi.name, 0, -1, opts)
+        remote_key = f"{bucket}/{oi.name}/{vid or 'null'}/" \
+                     f"{uuid.uuid4().hex}"
+        tier.put(remote_key, data)
+        ud = dict(info.user_defined)
+        ud.update({
+            META_STATUS: "complete",
+            META_TIER: tier.name,
+            META_KEY: remote_key,
+            META_SIZE: str(_client_size(info)),
+            META_ETAG: info.etag,
+            STORAGE_CLASS_HDR: tier.name,
+        })
+        ud.pop(META_RESTORE_EXPIRY, None)
+        # the stub replaces the data in place; quorum commit as a write
+        self.layer.put_object(bucket, oi.name, b"",
+                              PutObjectOptions(user_defined=ud,
+                                               version_id=vid,
+                                               mod_time=info.mod_time
+                                               or now_ns()))
+
+    # -- restore -----------------------------------------------------------
+
+    def restore(self, bucket: str, key: str, days: int,
+                version_id: str = "") -> bool:
+        """Copy tiered bytes back for `days`; returns False if the
+        object already holds a valid restored copy."""
+        opts = ObjectOptions(version_id=version_id or None)
+        oi = self.layer.get_object_info(bucket, key, opts)
+        if not is_transitioned(oi.user_defined):
+            raise TierError("object is not in an archived state")
+        if restore_valid(oi.user_defined):
+            return False
+        tier = self.tier_of(oi.user_defined)
+        if tier is None:
+            raise TierError(
+                f"tier {oi.user_defined.get(META_TIER)!r} not configured")
+        data = tier.get(oi.user_defined[META_KEY])
+        ud = dict(oi.user_defined)
+        ud[META_RESTORE_EXPIRY] = str(
+            int(time.time()) + days * 24 * 3600)
+        # keep the original mod_time: version recency (is_latest) is
+        # ordered by mod_time and a restore must not reorder versions
+        self.layer.put_object(
+            bucket, key, data,
+            PutObjectOptions(user_defined=ud, version_id=version_id,
+                             mod_time=oi.mod_time))
+        return True
+
+    def sweep_expired_restores(self, bucket: str) -> int:
+        """Re-stub restored copies whose window lapsed (the crawler's
+        restore-expiry pass).  Returns how many were re-stubbed."""
+        n = 0
+        res = self.layer.list_objects(bucket, max_keys=10 ** 6)
+        for oi in res.objects:
+            full = self.layer.get_object_info(bucket, oi.name)
+            ud = full.user_defined
+            if is_transitioned(ud) and restore_expiry(ud) and \
+                    not restore_valid(ud):
+                stub = dict(ud)
+                stub.pop(META_RESTORE_EXPIRY, None)
+                self.layer.put_object(
+                    bucket, oi.name, b"",
+                    PutObjectOptions(user_defined=stub,
+                                     version_id=full.version_id or "",
+                                     mod_time=full.mod_time))
+                n += 1
+        return n
+
+    # -- persistence of tier configs (admin API) ---------------------------
+
+    def to_json(self, redact: bool = False) -> bytes:
+        """Tier configs; `redact=True` hides remote credentials (madmin
+        ListTiers never returns secrets) — persistence uses the full form."""
+        out = []
+        for t in self.tiers.values():
+            if isinstance(t, DirTier):
+                out.append({"type": "dir", "name": t.name, "path": t.path})
+            elif isinstance(t, S3Tier):
+                out.append({"type": "s3", "name": t.name,
+                            "endpoint": t.client.endpoint,
+                            "bucket": t.bucket, "prefix": t.prefix,
+                            "access_key": "REDACTED" if redact
+                            else t.client.access_key,
+                            "secret_key": "REDACTED" if redact
+                            else t.client.secret_key,
+                            "region": t.client.region})
+        return json.dumps(out).encode()
+
+    @classmethod
+    def from_json(cls, layer, blob: bytes) -> "TransitionSys":
+        sys = cls(layer)
+        for d in json.loads(blob or b"[]"):
+            if d.get("type") == "dir":
+                sys.add_tier(DirTier(d["name"], d["path"]))
+            elif d.get("type") == "s3":
+                sys.add_tier(S3Tier(d["name"], d["endpoint"], d["bucket"],
+                                    d["access_key"], d["secret_key"],
+                                    d.get("prefix", ""),
+                                    d.get("region", "us-east-1")))
+        return sys
+
+
+def transition_fn(tsys: TransitionSys):
+    """Adapter for the crawler's transition callback: the lifecycle rule
+    names the destination storage class; pass it through."""
+    def fn(bucket: str, oi, storage_class: str = "") -> None:
+        if storage_class:
+            oi.transition_tier = storage_class
+        tsys.transition(bucket, oi)
+    return fn

@@ -78,6 +78,9 @@ _ERRORS = {
         "or completed.", 404),
     "NoSuchVersion": APIError(
         "NoSuchVersion", "The specified version does not exist.", 404),
+    "InvalidObjectState": APIError(
+        "InvalidObjectState", "The operation is not valid for the "
+        "object's storage class", 403),
     "NotImplemented": APIError(
         "NotImplemented", "A header you provided implies functionality "
         "that is not implemented", 501),

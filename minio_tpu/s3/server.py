@@ -118,6 +118,15 @@ class S3Server:
         self.tracker = None      # DataUpdateTracker (crawler bloom filter)
         from ..crypto.kms import LocalKMS
         self.kms = LocalKMS.from_env_or_store(object_layer)
+        # ILM tiering (cmd/bucket-lifecycle.go transitionObject): tier
+        # registry persisted in the system volume
+        from ..objectlayer.tiering import TransitionSys
+        from ..storage.xl_storage import SYS_DIR
+        blobs, _ = object_layer._fanout(
+            lambda d: d.read_all(SYS_DIR, "tiers/tiers.json"))
+        blob = next((b for b in blobs if b), None)
+        self.transition = TransitionSys.from_json(object_layer, blob) \
+            if blob else TransitionSys(object_layer)
         # observability (cmd/http-tracer.go, cmd/logger/audit.go):
         # trace hub is process-global (mirrors globalHTTPTrace); audit
         # log is per-server so deployments keep entries separate
@@ -1138,6 +1147,9 @@ def _make_handler(srv: S3Server):
             if cmd == "GET" and "uploadId" in query:
                 self._allow(iampol.LIST_PARTS, resource)
                 return self._list_parts(bucket, key, query)
+            if cmd == "POST" and "restore" in query:
+                self._allow("s3:RestoreObject", resource)
+                return self._restore_object(bucket, key, query, payload)
             if cmd == "PUT":
                 self._allow(iampol.PUT_OBJECT, resource)
                 return self._put_object(bucket, key, query, payload)
@@ -1719,6 +1731,13 @@ def _make_handler(srv: S3Server):
                     # offsets would decode data that gets thrown away
                     oi = srv.layer.get_object_info(bucket, key, opts)
                     data = None
+                    from ..objectlayer import tiering as _tchk
+                    if rng and not head and \
+                            _tchk.is_transitioned(oi.user_defined) and \
+                            not _tchk.restore_valid(oi.user_defined):
+                        # archived stub: 403 before the size-0 range
+                        # fetch can 416
+                        raise S3Error("InvalidObjectState")
                     if rng and not oi.delete_marker and \
                             mtc.META_COMPRESSION not in oi.user_defined \
                             and not csse.is_encrypted(oi.user_defined):
@@ -1731,10 +1750,22 @@ def _make_handler(srv: S3Server):
                                                     opts)
                 if not head and oi.delete_marker:
                     raise ol.MethodNotAllowed(key)
+                from ..objectlayer import tiering
+                archived = tiering.is_transitioned(oi.user_defined)
+                stubbed = archived and \
+                    not tiering.restore_valid(oi.user_defined)
+                if stubbed and not head:
+                    # data lives in the tier: GET needs a restore first
+                    # (cmd/object-handlers.go InvalidObjectState)
+                    raise S3Error("InvalidObjectState")
                 encrypted = csse.is_encrypted(oi.user_defined) and \
-                    not oi.delete_marker
+                    not oi.delete_marker and not stubbed
                 compressed = mtc.META_COMPRESSION in oi.user_defined and \
-                    not oi.delete_marker
+                    not oi.delete_marker and not stubbed
+                if stubbed:
+                    # HEAD of the stub reports the archived identity
+                    plain_size = int(oi.user_defined.get(
+                        tiering.META_SIZE, "0"))
                 inner: bytes | None = None
                 if encrypted:
                     # DecryptObjectInfo: the data path reads only covering
@@ -1797,6 +1828,15 @@ def _make_handler(srv: S3Server):
                 "Last-Modified": _http_date(oi.mod_time),
                 "Accept-Ranges": "bytes",
             }
+            if archived:
+                from ..objectlayer import tiering as _tr
+                hdrs["ETag"] = \
+                    f'"{oi.user_defined.get(_tr.META_ETAG, oi.etag)}"'
+                hdrs[_tr.STORAGE_CLASS_HDR] = oi.user_defined.get(
+                    _tr.STORAGE_CLASS_HDR, "")
+                rh = _tr.restore_header(oi.user_defined)
+                if rh:
+                    hdrs[_tr.RESTORE_HDR] = rh
             hdrs.update(sse_hdrs)
             if oi.version_id:
                 hdrs["x-amz-version-id"] = oi.version_id
@@ -1826,6 +1866,36 @@ def _make_handler(srv: S3Server):
                     f"bytes {start}-{start + len(data) - 1}/{entity_size}"
                 return self._send(206, data, content_type=ct, headers=hdrs)
             return self._send(200, data, content_type=ct, headers=hdrs)
+
+        def _restore_object(self, bucket, key, query, payload):
+            """PostRestoreObjectHandler: <RestoreRequest><Days>N</Days>
+            </RestoreRequest> copies tiered bytes back for N days."""
+            from ..objectlayer import tiering
+            days = 1
+            if payload:
+                try:
+                    root = ET.fromstring(payload)
+                    for el in root.iter():
+                        if el.tag.split("}")[-1] == "Days":
+                            days = int(el.text or 1)
+                except (ET.ParseError, ValueError) as e:
+                    raise S3Error("MalformedXML") from e
+            if days < 1:
+                raise S3Error("InvalidArgument")
+            vid = query.get("versionId", [""])[0]
+            if vid == "null":
+                vid = ""
+            ts = srv.transition
+            try:
+                fresh = ts.restore(bucket, key, days, version_id=vid)
+            except tiering.TierError as e:
+                raise S3Error("InvalidObjectState") from e
+            oi = srv.layer.get_object_info(
+                bucket, key, ol.ObjectOptions(version_id=vid or None))
+            srv.notify("s3:ObjectRestore:Completed", bucket, oi)
+            # 202 while "in progress" (fresh copy), 200 when it already
+            # held a valid restored copy (object-handlers.go semantics)
+            return self._send(202 if fresh else 200, b"")
 
         def _delete_object(self, bucket, key, query):
             q1 = {k: v[0] for k, v in query.items()}

@@ -1,0 +1,311 @@
+"""ILM transition/tiering + RestoreObject tests
+(cmd/bucket-lifecycle.go:315 transitionObject, restore handler,
+x-amz-restore/x-amz-storage-class response semantics).
+"""
+
+import time
+
+import pytest
+
+from minio_tpu.objectlayer import tiering as tr
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.s3.client import S3Client, S3ClientError
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl_storage import XLStorage
+
+
+def make_layer(tmp, name):
+    disks = []
+    for i in range(4):
+        d = tmp / f"{name}{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    return ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                          backend="numpy")
+
+
+@pytest.fixture
+def layer(tmp_path):
+    return make_layer(tmp_path, "tierdisk")
+
+
+def test_transition_and_restore_layer_level(layer, tmp_path):
+    layer.make_bucket("arch")
+    body = b"cold data " * 500
+    layer.put_object("arch", "cold.bin", body)
+    orig = layer.get_object_info("arch", "cold.bin")
+
+    ts = tr.TransitionSys(layer)
+    ts.add_tier(tr.DirTier("GLACIER", str(tmp_path / "tier")))
+    oi = layer.get_object_info("arch", "cold.bin")
+    oi.transition_tier = "GLACIER"
+    ts.transition("arch", oi)
+
+    stub = layer.get_object_info("arch", "cold.bin")
+    assert tr.is_transitioned(stub.user_defined)
+    assert stub.user_defined[tr.META_SIZE] == str(len(body))
+    assert stub.user_defined[tr.META_ETAG] == orig.etag
+    assert stub.size == 0                        # data moved off
+
+    # transition is idempotent
+    ts.transition("arch", layer.get_object_info("arch", "cold.bin"))
+
+    assert ts.restore("arch", "cold.bin", days=1) is True
+    back = layer.get_object("arch", "cold.bin")
+    assert back[1] == body
+    assert tr.restore_valid(back[0].user_defined)
+    # second restore is a no-op on a valid copy
+    assert ts.restore("arch", "cold.bin", days=1) is False
+
+
+def test_restore_nontransitioned_rejected(layer, tmp_path):
+    layer.make_bucket("warm")
+    layer.put_object("warm", "hot", b"hot")
+    ts = tr.TransitionSys(layer)
+    with pytest.raises(tr.TierError, match="not in an archived state"):
+        ts.restore("warm", "hot", 1)
+
+
+def test_sweep_expired_restores(layer, tmp_path, monkeypatch):
+    layer.make_bucket("swp")
+    layer.put_object("swp", "o", b"z" * 4096)
+    ts = tr.TransitionSys(layer)
+    ts.add_tier(tr.DirTier("COLD", str(tmp_path / "t2")))
+    oi = layer.get_object_info("swp", "o")
+    oi.transition_tier = "COLD"
+    ts.transition("swp", oi)
+    ts.restore("swp", "o", days=1)
+    assert layer.get_object("swp", "o")[1] == b"z" * 4096
+    # jump past the restore window
+    real_time = time.time
+    monkeypatch.setattr(time, "time", lambda: real_time() + 2 * 86400)
+    assert ts.sweep_expired_restores("swp") == 1
+    stub = layer.get_object_info("swp", "o")
+    assert stub.size == 0 and tr.is_transitioned(stub.user_defined)
+    assert tr.META_RESTORE_EXPIRY not in stub.user_defined
+
+
+def test_crawler_drives_transition(layer, tmp_path):
+    from minio_tpu.background.crawler import scan_usage
+    from minio_tpu.objectlayer.bucket_meta import BucketMetadataSys
+    from minio_tpu.storage.datatypes import now_ns
+
+    bm = BucketMetadataSys(layer)
+    layer.make_bucket("ilmb")
+    lc_xml = (b'<LifecycleConfiguration><Rule><ID>t</ID>'
+              b'<Status>Enabled</Status><Filter><Prefix></Prefix></Filter>'
+              b'<Transition><Days>1</Days>'
+              b'<StorageClass>ICE</StorageClass></Transition>'
+              b'</Rule></LifecycleConfiguration>')
+    bm.set_config("ilmb", "lifecycle", lc_xml.decode())
+    old = now_ns() - 3 * 24 * 3600 * 10 ** 9
+    from minio_tpu.objectlayer.interface import PutObjectOptions
+    layer.put_object("ilmb", "aging", b"a" * 2048,
+                     PutObjectOptions(mod_time=old))
+
+    ts = tr.TransitionSys(layer)
+    ts.add_tier(tr.DirTier("ICE", str(tmp_path / "ice")))
+    res = scan_usage(layer, bm, transition_fn=tr.transition_fn(ts))
+    assert ("ilmb", "aging") in res.transitioned
+    stub = layer.get_object_info("ilmb", "aging")
+    assert stub.user_defined[tr.META_TIER] == "ICE"
+
+
+def test_noncurrent_version_transition_preserves_head(layer, tmp_path):
+    """TRANSITION_VERSION must stub the noncurrent version, never the
+    live head object."""
+    from minio_tpu.objectlayer.interface import PutObjectOptions
+    layer.make_bucket("verb")
+    v1 = layer.put_object("verb", "doc", b"old version",
+                          PutObjectOptions(versioned=True))
+    v2 = layer.put_object("verb", "doc", b"new version",
+                          PutObjectOptions(versioned=True))
+    ts = tr.TransitionSys(layer)
+    ts.add_tier(tr.DirTier("NC", str(tmp_path / "nc")))
+    from minio_tpu.objectlayer.interface import ObjectOptions
+    oi = layer.get_object_info("verb", "doc",
+                               ObjectOptions(version_id=v1.version_id))
+    oi.transition_tier = "NC"
+    ts.transition("verb", oi)
+    # head untouched, noncurrent stubbed
+    head = layer.get_object("verb", "doc")
+    assert head[1] == b"new version"
+    assert not tr.is_transitioned(head[0].user_defined)
+    old = layer.get_object_info("verb", "doc",
+                                ObjectOptions(version_id=v1.version_id))
+    assert tr.is_transitioned(old.user_defined)
+    # restore that specific version
+    ts.restore("verb", "doc", 1, version_id=v1.version_id)
+    got = layer.get_object("verb", "doc", 0, -1,
+                           ObjectOptions(version_id=v1.version_id))
+    assert got[1] == b"old version"
+    assert layer.get_object("verb", "doc")[1] == b"new version"
+
+
+def test_transition_storage_class_picks_due_rule():
+    from minio_tpu.bucket.lifecycle import Lifecycle, ObjectOpts
+    from minio_tpu.storage.datatypes import now_ns
+    lc = Lifecycle.parse(
+        b'<LifecycleConfiguration>'
+        b'<Rule><ID>far</ID><Status>Enabled</Status>'
+        b'<Filter><Prefix></Prefix></Filter>'
+        b'<Transition><Days>365</Days><StorageClass>FAR</StorageClass>'
+        b'</Transition></Rule>'
+        b'<Rule><ID>near</ID><Status>Enabled</Status>'
+        b'<Filter><Prefix></Prefix></Filter>'
+        b'<Transition><Days>1</Days><StorageClass>NEAR</StorageClass>'
+        b'</Transition></Rule>'
+        b'</LifecycleConfiguration>')
+    obj = ObjectOpts(name="o", user_tags={},
+                     mod_time_ns=now_ns() - 3 * 24 * 3600 * 10 ** 9,
+                     is_latest=True)
+    # only the 1-day rule is due: its class must win, not rule order
+    assert lc.transition_storage_class(obj) == "NEAR"
+
+
+# -- server level -------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("tiersrv")
+    layer = make_layer(tmp, "srvd")
+    srv = S3Server(layer, access_key="tk", secret_key="ts")
+    srv.transition.add_tier(
+        tr.DirTier("DEEP", str(tmp / "deeptier")))
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def client(server):
+    c = S3Client(server.endpoint, "tk", "ts")
+    if not c.head_bucket("tierb"):
+        c.make_bucket("tierb")
+    return c
+
+
+def _archive(server, bucket, key):
+    oi = server.layer.get_object_info(bucket, key)
+    oi.transition_tier = "DEEP"
+    server.transition.transition(bucket, oi)
+
+
+def test_archived_get_head_restore_over_api(server, client):
+    body = b"archival content " * 100
+    client.put_object("tierb", "doc", body, content_type="text/plain")
+    orig_etag = client.head_object("tierb", "doc").headers["ETag"]
+    _archive(server, "tierb", "doc")
+
+    # GET is rejected until restored
+    with pytest.raises(S3ClientError) as ei:
+        client.get_object("tierb", "doc")
+    assert ei.value.code == "InvalidObjectState" and ei.value.status == 403
+
+    # HEAD reports archived identity
+    h = client.head_object("tierb", "doc")
+    hl = {k.lower(): v for k, v in h.headers.items()}
+    assert hl["x-amz-storage-class"] == "DEEP"
+    assert hl["content-length"] == str(len(body))
+    assert h.headers["ETag"] == orig_etag
+    assert "x-amz-restore" not in hl
+
+    # restore, then read
+    r = client.request("POST", "/tierb/doc", "restore",
+                       b"<RestoreRequest><Days>2</Days></RestoreRequest>",
+                       expect=(200, 202))
+    assert r.status == 202
+    g = client.get_object("tierb", "doc")
+    assert g.body == body
+    gl = {k.lower(): v for k, v in g.headers.items()}
+    assert 'ongoing-request="false"' in gl["x-amz-restore"]
+    assert gl["x-amz-storage-class"] == "DEEP"
+    assert g.headers["ETag"] == orig_etag
+
+    # restoring again on a valid copy: 200, not 202
+    r2 = client.request("POST", "/tierb/doc", "restore",
+                        b"<RestoreRequest><Days>1</Days></RestoreRequest>",
+                        expect=(200, 202))
+    assert r2.status == 200
+
+
+def test_archived_range_get_is_403(server, client):
+    client.put_object("tierb", "rngdoc", b"r" * 4096)
+    _archive(server, "tierb", "rngdoc")
+    with pytest.raises(S3ClientError) as ei:
+        client.get_object("tierb", "rngdoc", byte_range=(100, 200))
+    assert ei.value.code == "InvalidObjectState" and ei.value.status == 403
+
+
+def test_admin_tier_list_redacts_secrets(server, tmp_path):
+    server.transition.add_tier(
+        tr.S3Tier("SECRETTIER", "http://h:9", "b", "AKIAX", "supersecret"))
+    import json
+    listed = json.loads(server.transition.to_json(redact=True))
+    ent = next(t for t in listed if t["name"] == "SECRETTIER")
+    assert ent["secret_key"] == "REDACTED"
+    assert ent["access_key"] == "REDACTED"
+    # persistence form keeps them (needed to reconnect after restart)
+    full = json.loads(server.transition.to_json())
+    ent = next(t for t in full if t["name"] == "SECRETTIER")
+    assert ent["secret_key"] == "supersecret"
+
+
+def test_restore_of_live_object_rejected(client):
+    client.put_object("tierb", "live", b"live")
+    with pytest.raises(S3ClientError) as ei:
+        client.request("POST", "/tierb/live", "restore",
+                       b"<RestoreRequest><Days>1</Days></RestoreRequest>")
+    assert ei.value.code == "InvalidObjectState"
+
+
+def test_admin_tier_add_and_list(server, client, tmp_path):
+    import json
+    import urllib.request
+    from minio_tpu.s3.sigv4 import Credentials, sign_request
+    url = f"{server.endpoint}/minio-tpu/admin/v1/tier"
+    body = json.dumps({"type": "dir", "name": "NEWTIER",
+                       "path": str(tmp_path / "nt")}).encode()
+    hdrs = sign_request(Credentials("tk", "ts"), "PUT", url, {}, body)
+    req = urllib.request.Request(url, data=body, method="PUT",
+                                 headers=hdrs)
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 200
+    hdrs = sign_request(Credentials("tk", "ts"), "GET", url, {}, b"")
+    req = urllib.request.Request(url, headers=hdrs)
+    with urllib.request.urlopen(req) as resp:
+        tiers = json.loads(resp.read())
+    assert {"NEWTIER", "DEEP"} <= {t["name"] for t in tiers}
+
+
+def test_s3_tier_backend(layer, tmp_path):
+    """Tier into another S3 endpoint (our own server as remote)."""
+    remote_layer = make_layer(tmp_path, "remote")
+    remote = S3Server(remote_layer, access_key="rk", secret_key="rs")
+    remote.start()
+    try:
+        rc = S3Client(remote.endpoint, "rk", "rs")
+        rc.make_bucket("tierbkt")
+        layer.make_bucket("src")
+        layer.put_object("src", "x", b"offload me" * 100)
+        ts = tr.TransitionSys(layer)
+        ts.add_tier(tr.S3Tier("S3COLD", remote.endpoint, "tierbkt",
+                              "rk", "rs", prefix="tiered/"))
+        oi = layer.get_object_info("src", "x")
+        oi.transition_tier = "S3COLD"
+        ts.transition("src", oi)
+        objs, _ = rc.list_objects("tierbkt", prefix="tiered/")
+        assert len(objs) == 1 and objs[0]["size"] == 1000
+        assert ts.restore("src", "x", 1)
+        assert layer.get_object("src", "x")[1] == b"offload me" * 100
+    finally:
+        remote.stop()
+
+
+def test_tier_config_round_trip(layer, tmp_path):
+    ts = tr.TransitionSys(layer)
+    ts.add_tier(tr.DirTier("A", str(tmp_path / "a")))
+    ts.add_tier(tr.S3Tier("B", "http://h:9", "b", "ak", "sk", "p/"))
+    ts2 = tr.TransitionSys.from_json(layer, ts.to_json())
+    assert set(ts2.tiers) == {"A", "B"}
+    assert ts2.tiers["B"].prefix == "p/"
