@@ -106,18 +106,33 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
             from ..objectlayer import tiering as _tr
             from ..storage.xl_storage import SYS_DIR
             doc = json.loads(payload)
-            if doc.get("type") == "dir":
-                srv.transition.add_tier(_tr.DirTier(doc["name"],
-                                                    doc["path"]))
-            elif doc.get("type") == "s3":
-                srv.transition.add_tier(_tr.S3Tier(
-                    doc["name"], doc["endpoint"], doc["bucket"],
-                    doc["access_key"], doc["secret_key"],
-                    doc.get("prefix", ""),
-                    doc.get("region", "us-east-1")))
-            else:
-                return send_json({"error": "unknown tier type"},
+            name = doc.get("name", "")
+            if not name:
+                return send_json({"error": "tier name required"},
                                  400) or True
+            if name in srv.transition.tiers:
+                # replacing a tier would strand every stub whose
+                # META_KEY resolves against the old backend
+                return send_json(
+                    {"error": f"tier {name!r} already exists"},
+                    409) or True
+            try:
+                if doc.get("type") == "dir":
+                    srv.transition.add_tier(_tr.DirTier(name,
+                                                        doc["path"]))
+                elif doc.get("type") == "s3":
+                    srv.transition.add_tier(_tr.S3Tier(
+                        name, doc["endpoint"], doc["bucket"],
+                        doc["access_key"], doc["secret_key"],
+                        doc.get("prefix", ""),
+                        doc.get("region", "us-east-1")))
+                else:
+                    return send_json({"error": "unknown tier type"},
+                                     400) or True
+            except KeyError as e:
+                return send_json(
+                    {"error": f"missing tier config field {e}"},
+                    400) or True
             blob = srv.transition.to_json()
             srv.layer._fanout(
                 lambda d: d.write_all(SYS_DIR, "tiers/tiers.json", blob))

@@ -1,0 +1,129 @@
+"""OpenID Connect provider for STS web-identity federation.
+
+Reference: cmd/sts-handlers.go AssumeRoleWithWebIdentityHandler +
+cmd/config/identity/openid (JWT validation against the provider's JWKS,
+policy picked from a configurable claim).  This environment has zero
+egress, so discovery is not fetched: the JWKS comes from config
+(`jwks_file` or inline `jwks`) for RS256, or a shared `hs256_secret`
+(tests / symmetric deployments).  Validation enforces signature, `exp`,
+`iss`, and `aud`/`azp`.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+from dataclasses import dataclass, field
+
+
+class OpenIDError(Exception):
+    pass
+
+
+def _b64url_dec(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def _rs256_verify(jwk: dict, signing_input: bytes, sig: bytes) -> bool:
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+    try:
+        n = int.from_bytes(_b64url_dec(jwk["n"]), "big")
+        e = int.from_bytes(_b64url_dec(jwk["e"]), "big")
+        key = rsa.RSAPublicNumbers(e, n).public_key()
+        key.verify(sig, signing_input, padding.PKCS1v15(),
+                   hashes.SHA256())
+        return True
+    except Exception:  # noqa: BLE001 — any failure is a bad signature
+        return False
+
+
+@dataclass
+class OpenIDProvider:
+    issuer: str
+    client_id: str                       # expected audience
+    claim_name: str = "policy"           # claim carrying policy name(s)
+    jwks: dict = field(default_factory=dict)     # {"keys": [...]}
+    hs256_secret: str = ""
+
+    @classmethod
+    def from_config(cls, cfg) -> "OpenIDProvider | None":
+        """Build from the identity_openid config subsystem; None when
+        disabled."""
+        if cfg.get("identity_openid", "enable") != "on":
+            return None
+        jwks = {}
+        path = cfg.get("identity_openid", "jwks_file")
+        if path:
+            with open(path) as f:
+                jwks = json.load(f)
+        inline = cfg.get("identity_openid", "jwks")
+        if inline:
+            jwks = json.loads(inline)
+        return cls(issuer=cfg.get("identity_openid", "issuer"),
+                   client_id=cfg.get("identity_openid", "client_id"),
+                   claim_name=cfg.get("identity_openid", "claim_name")
+                   or "policy",
+                   jwks=jwks,
+                   hs256_secret=cfg.get("identity_openid",
+                                        "hs256_secret"))
+
+    # -- validation --------------------------------------------------------
+
+    def _verify_signature(self, header: dict, signing_input: bytes,
+                          sig: bytes) -> None:
+        alg = header.get("alg", "")
+        if alg == "HS256":
+            if not self.hs256_secret:
+                raise OpenIDError("HS256 token but no shared secret "
+                                  "configured")
+            want = hmac.new(self.hs256_secret.encode(), signing_input,
+                            hashlib.sha256).digest()
+            if not hmac.compare_digest(want, sig):
+                raise OpenIDError("bad token signature")
+            return
+        if alg == "RS256":
+            kid = header.get("kid")
+            keys = [k for k in self.jwks.get("keys", [])
+                    if k.get("kty") == "RSA" and
+                    (kid is None or k.get("kid") == kid)]
+            if not keys:
+                raise OpenIDError(f"no RSA key for kid={kid!r}")
+            if any(_rs256_verify(k, signing_input, sig) for k in keys):
+                return
+            raise OpenIDError("bad token signature")
+        raise OpenIDError(f"unsupported alg {alg!r}")
+
+    def authenticate(self, token: str) -> dict:
+        """Validate a web-identity JWT; returns its claims."""
+        try:
+            h64, c64, s64 = token.split(".")
+            header = json.loads(_b64url_dec(h64))
+            claims = json.loads(_b64url_dec(c64))
+            sig = _b64url_dec(s64)
+        except (ValueError, json.JSONDecodeError) as e:
+            raise OpenIDError("malformed JWT") from e
+        self._verify_signature(header, f"{h64}.{c64}".encode(), sig)
+        if claims.get("exp", 0) < time.time():
+            raise OpenIDError("token expired")
+        if self.issuer and claims.get("iss") != self.issuer:
+            raise OpenIDError(f"issuer mismatch: {claims.get('iss')!r}")
+        aud = claims.get("aud", "")
+        auds = aud if isinstance(aud, list) else [aud]
+        if self.client_id and self.client_id not in auds and \
+                claims.get("azp") != self.client_id:
+            raise OpenIDError("audience mismatch")
+        if not claims.get("sub"):
+            raise OpenIDError("token has no sub")
+        return claims
+
+    def policies_of(self, claims: dict) -> list[str]:
+        """Policy names from the configured claim (comma list or JSON
+        array, as the reference accepts)."""
+        v = claims.get(self.claim_name, "")
+        if isinstance(v, list):
+            return [str(p) for p in v]
+        return [p.strip() for p in str(v).split(",") if p.strip()]

@@ -214,6 +214,28 @@ class IAMSys:
         self._save()
         return creds
 
+    def assume_role_web_identity(self, subject: str,
+                                 policy_names: list[str],
+                                 duration_s: int | None = None):
+        """Temp credentials for a federated (OIDC/LDAP) identity: no
+        parent user exists in IAM, so the credential carries its own
+        policy attachment (cmd/sts-handlers.go web-identity path)."""
+        from . import sts
+        self._check_policies(policy_names)
+        creds = sts.mint(
+            f"oidc:{subject}", self.root.secret_key,
+            sts.DEFAULT_DURATION_S if duration_s is None else duration_s)
+        with self._mu:
+            for k in [k for k, u in self._users.items() if u.expired()]:
+                del self._users[k]
+            self._users[creds.access_key] = UserIdentity(
+                creds.access_key, creds.secret_key,
+                policies=list(policy_names),
+                parent_user=f"oidc:{subject}",
+                expiration=creds.expiration)
+        self._save()
+        return creds
+
     def purge_expired(self) -> int:
         """Drop expired temp credentials; returns the number removed."""
         with self._mu:
@@ -330,6 +352,10 @@ class IAMSys:
                 # intersected with the session policy below
                 if u.parent_user == self.root.access_key:
                     names = None        # parent is root: allow-all base
+                elif u.parent_user.startswith(("oidc:", "ldap:")):
+                    # federated identity: the credential carries its own
+                    # claim-derived policy attachment
+                    names = list(u.policies)
                 else:
                     p = self._users.get(u.parent_user)
                     if p is None or p.status != "enabled":

@@ -225,6 +225,10 @@ class TransitionSys:
         object already holds a valid restored copy."""
         opts = ObjectOptions(version_id=version_id or None)
         oi = self.layer.get_object_info(bucket, key, opts)
+        # write back to the version we resolved: an omitted versionId on
+        # a versioned bucket must restore the latest version, not mint a
+        # spurious null version
+        version_id = version_id or oi.version_id or ""
         if not is_transitioned(oi.user_defined):
             raise TierError("object is not in an archived state")
         if restore_valid(oi.user_defined):
@@ -247,11 +251,20 @@ class TransitionSys:
 
     def sweep_expired_restores(self, bucket: str) -> int:
         """Re-stub restored copies whose window lapsed (the crawler's
-        restore-expiry pass).  Returns how many were re-stubbed."""
+        restore-expiry pass), across ALL versions.  Returns how many
+        were re-stubbed."""
         n = 0
-        res = self.layer.list_objects(bucket, max_keys=10 ** 6)
-        for oi in res.objects:
-            full = self.layer.get_object_info(bucket, oi.name)
+        if hasattr(self.layer, "list_object_versions"):
+            versions = list(self.layer.list_object_versions(bucket))
+        else:
+            versions = self.layer.list_objects(
+                bucket, max_keys=10 ** 6).objects
+        for oi in versions:
+            if getattr(oi, "delete_marker", False):
+                continue
+            full = self.layer.get_object_info(
+                bucket, oi.name,
+                ObjectOptions(version_id=oi.version_id or None))
             ud = full.user_defined
             if is_transitioned(ud) and restore_expiry(ud) and \
                     not restore_valid(ud):
@@ -264,6 +277,20 @@ class TransitionSys:
                                      mod_time=full.mod_time))
                 n += 1
         return n
+
+    def delete_tiered(self, user_defined: dict) -> None:
+        """Free the remote bytes of a transitioned version being deleted
+        or overwritten — otherwise the uuid-keyed tier object leaks
+        forever (the reference deletes tier data on version deletion)."""
+        if not is_transitioned(user_defined):
+            return
+        tier = self.tier_of(user_defined)
+        key = user_defined.get(META_KEY, "")
+        if tier is not None and key:
+            try:
+                tier.remove(key)
+            except Exception:  # noqa: BLE001 — lossy ok; GC tolerates
+                pass
 
     # -- persistence of tier configs (admin API) ---------------------------
 

@@ -23,6 +23,10 @@ _ERRORS = {
     "BadDigest": APIError(
         "BadDigest", "The Content-Md5 you specified did not match what we "
         "received.", 400),
+    "BucketAlreadyExists": APIError(
+        "BucketAlreadyExists", "The requested bucket name is not "
+        "available. The bucket namespace is shared by all users of the "
+        "system.", 409),
     "BucketAlreadyOwnedByYou": APIError(
         "BucketAlreadyOwnedByYou",
         "Your previous request to create the named bucket succeeded and you "

@@ -118,6 +118,8 @@ class S3Server:
         self.tracker = None      # DataUpdateTracker (crawler bloom filter)
         from ..crypto.kms import LocalKMS
         self.kms = LocalKMS.from_env_or_store(object_layer)
+        from ..iam.openid import OpenIDProvider
+        self.openid = OpenIDProvider.from_config(self.config)
         # ILM tiering (cmd/bucket-lifecycle.go transitionObject): tier
         # registry persisted in the system volume
         from ..objectlayer.tiering import TransitionSys
@@ -158,6 +160,11 @@ class S3Server:
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
         self.port = self.httpd.server_address[1]
+        # federation binds the *actual* port (ephemeral binds resolve
+        # only once the listener exists)
+        from ..utils.fed_dns import FederationSys
+        self.federation = FederationSys.from_config(
+            self.config, host or "127.0.0.1", self.port)
         self._thread: threading.Thread | None = None
 
     def start(self) -> None:
@@ -485,9 +492,24 @@ def _make_handler(srv: S3Server):
                     return self._list_buckets()
                 if not _BUCKET_RE.match(bucket):
                     raise S3Error("InvalidBucketName")
-                if key:
-                    return self._object_api(bucket, key, query, payload)
-                return self._bucket_api(bucket, query, payload)
+                try:
+                    if key:
+                        return self._object_api(bucket, key, query,
+                                                payload)
+                    return self._bucket_api(bucket, query, payload)
+                except ol.BucketNotFound:
+                    # federated bucket homed on another cluster: 307 to
+                    # its owner (cmd/handler-utils.go redirect path)
+                    if srv.federation is not None:
+                        rec = srv.federation.lookup_other(bucket)
+                        if rec is not None:
+                            u = urllib.parse.urlsplit(self.path)
+                            loc = (f"http://{rec.host}:{rec.port}"
+                                   f"{u.path}"
+                                   + (f"?{u.query}" if u.query else ""))
+                            return self._send(
+                                307, b"", headers={"Location": loc})
+                    raise
             except Exception as e:  # noqa: BLE001 — every error becomes XML
                 self._fail(e, path)
 
@@ -514,13 +536,16 @@ def _make_handler(srv: S3Server):
                 payload.decode("utf-8", "replace"),
                 keep_blank_values=True).items()}
             action = form.get("Action", "")
+            if action in ("AssumeRoleWithWebIdentity",
+                          "AssumeRoleWithClientGrants"):
+                return self._sts_web_identity(form, action)
             if action != "AssumeRole":
-                if action in ("AssumeRoleWithWebIdentity",
-                              "AssumeRoleWithLDAPIdentity",
-                              "AssumeRoleWithClientGrants"):
+                if action == "AssumeRoleWithLDAPIdentity":
+                    # LDAP client library not in this build (cmd/iam.go
+                    # LDAP mode): gated, never silently accepted
                     return self._sts_fail(
                         "NotImplemented",
-                        f"{action} requires an identity provider")
+                        f"{action} requires an LDAP identity provider")
                 return self._sts_fail("InvalidAction", action)
             if not self.access_key:
                 return self._sts_fail("AccessDenied",
@@ -547,6 +572,60 @@ def _make_handler(srv: S3Server):
                 datetime.datetime.fromtimestamp(
                     creds.expiration, datetime.timezone.utc).strftime(
                         "%Y-%m-%dT%H:%M:%SZ")
+            meta = ET.SubElement(root, "ResponseMetadata")
+            ET.SubElement(meta, "RequestId").text = uuid.uuid4().hex[:16]
+            self._send(200, _xml(root))
+
+        def _sts_web_identity(self, form: dict, action: str):
+            """AssumeRoleWithWebIdentity (cmd/sts-handlers.go): validate
+            the provider-issued JWT, map the policy claim, mint creds.
+            Unsigned by design — the JWT is the credential."""
+            from ..iam import openid as _oidc
+            from ..iam import sts as _sts
+            if srv.openid is None:
+                return self._sts_fail(
+                    "NotImplemented",
+                    "no OpenID provider configured (identity_openid)")
+            token = form.get("WebIdentityToken") or form.get("Token", "")
+            if not token:
+                return self._sts_fail("InvalidParameterValue",
+                                      "WebIdentityToken required")
+            try:
+                duration = int(form.get("DurationSeconds",
+                                        str(_sts.DEFAULT_DURATION_S)))
+            except ValueError:
+                return self._sts_fail("InvalidParameterValue",
+                                      "DurationSeconds")
+            try:
+                claims = srv.openid.authenticate(token)
+            except _oidc.OpenIDError as e:
+                return self._sts_fail("AccessDenied", str(e))
+            policies = srv.openid.policies_of(claims)
+            if not policies:
+                return self._sts_fail(
+                    "AccessDenied",
+                    f"token carries no {srv.openid.claim_name!r} claim")
+            from ..iam.sys import NoSuchPolicy
+            try:
+                creds = srv.iam.assume_role_web_identity(
+                    claims["sub"], policies, duration)
+            except NoSuchPolicy as e:
+                return self._sts_fail("AccessDenied",
+                                      f"unknown policy: {e}")
+            except _sts.STSError as e:
+                return self._sts_fail(e.code, str(e))
+            root = ET.Element(f"{action}Response", xmlns=self.STS_NS)
+            result = ET.SubElement(root, f"{action}Result")
+            ce = ET.SubElement(result, "Credentials")
+            ET.SubElement(ce, "AccessKeyId").text = creds.access_key
+            ET.SubElement(ce, "SecretAccessKey").text = creds.secret_key
+            ET.SubElement(ce, "SessionToken").text = creds.session_token
+            ET.SubElement(ce, "Expiration").text = \
+                datetime.datetime.fromtimestamp(
+                    creds.expiration, datetime.timezone.utc).strftime(
+                        "%Y-%m-%dT%H:%M:%SZ")
+            ET.SubElement(result, "SubjectFromWebIdentityToken").text = \
+                claims["sub"]
             meta = ET.SubElement(root, "ResponseMetadata")
             ET.SubElement(meta, "RequestId").text = uuid.uuid4().hex[:16]
             self._send(200, _xml(root))
@@ -818,7 +897,19 @@ def _make_handler(srv: S3Server):
                 return self._list_uploads(bucket, query)
             if cmd == "PUT":
                 self._allow(iampol.CREATE_BUCKET, bucket)
-                srv.layer.make_bucket(bucket)
+                fresh_rec = False
+                if srv.federation is not None:
+                    from ..utils.fed_dns import BucketTaken
+                    try:
+                        fresh_rec = srv.federation.register(bucket)
+                    except BucketTaken:
+                        raise S3Error("BucketAlreadyExists") from None
+                try:
+                    srv.layer.make_bucket(bucket)
+                except Exception:
+                    if srv.federation is not None and fresh_rec:
+                        srv.federation.unregister(bucket)
+                    raise
                 if self.headers.get("x-amz-bucket-object-lock-enabled",
                                     "").lower() == "true":
                     # lock implies versioning (cmd/bucket-handlers.go
@@ -837,6 +928,8 @@ def _make_handler(srv: S3Server):
                 self._allow(iampol.DELETE_BUCKET, bucket)
                 srv.layer.delete_bucket(bucket)
                 srv.bucket_meta.drop(bucket)
+                if srv.federation is not None:
+                    srv.federation.unregister(bucket)
                 return self._send(204)
             if cmd == "GET":
                 self._allow(iampol.LIST_BUCKET, bucket)
@@ -1072,6 +1165,7 @@ def _make_handler(srv: S3Server):
                 try:
                     self._allow(iampol.DELETE_OBJECT, f"{bucket}/{key}")
                     self._check_retention(bucket, key, vid)
+                    self._free_tier_bytes(bucket, key, vid, versioned)
                     res = srv.layer.delete_object(
                         bucket, key,
                         ol.ObjectOptions(version_id=vid,
@@ -1504,6 +1598,10 @@ def _make_handler(srv: S3Server):
             notify, replicate.  Returns (oi, response_headers)."""
             user_defined.update(self._lock_headers(bucket, key))
             self._check_quota(bucket, len(payload))
+            if not srv.bucket_meta.versioning_enabled(bucket):
+                # unversioned overwrite replaces the null version: free
+                # any tiered bytes the old copy holds
+                self._free_tier_bytes(bucket, key, "", False)
             from ..crypto import sse as csse
             payload = self._compress_for_put(key, user_defined, payload)
             enc = self._sse_for_put(bucket, key, user_defined)
@@ -1552,6 +1650,12 @@ def _make_handler(srv: S3Server):
             self._allow(iampol.GET_OBJECT, f"{sbucket}/{skey}")
             opts = ol.ObjectOptions(version_id=svid)
             soi = srv.layer.get_object_info(sbucket, skey, opts)
+            from ..objectlayer import tiering as _tr
+            if _tr.is_transitioned(soi.user_defined) and \
+                    not _tr.restore_valid(soi.user_defined):
+                # archived source: copying the stub would silently write
+                # a 0-byte destination
+                raise S3Error("InvalidObjectState")
             # conditional copy headers (checkCopyObjectPreconditions) —
             # checked on metadata alone, BEFORE any data is read
             if_match = self.headers.get("x-amz-copy-source-if-match")
@@ -1889,13 +1993,32 @@ def _make_handler(srv: S3Server):
             try:
                 fresh = ts.restore(bucket, key, days, version_id=vid)
             except tiering.TierError as e:
-                raise S3Error("InvalidObjectState") from e
+                # only "not archived" is the client's mistake; a tier
+                # backend failure is a server-side problem, not a 403
+                if "archived state" in str(e):
+                    raise S3Error("InvalidObjectState") from e
+                raise S3Error("InternalError") from e
             oi = srv.layer.get_object_info(
                 bucket, key, ol.ObjectOptions(version_id=vid or None))
             srv.notify("s3:ObjectRestore:Completed", bucket, oi)
             # 202 while "in progress" (fresh copy), 200 when it already
             # held a valid restored copy (object-handlers.go semantics)
             return self._send(202 if fresh else 200, b"")
+
+        def _free_tier_bytes(self, bucket, key, vid, versioned) -> None:
+            """When a version is actually being removed or replaced,
+            free its remote tier bytes (only does work when tiers are
+            configured — a plain deployment pays nothing)."""
+            if not srv.transition.tiers:
+                return
+            if versioned and vid is None:
+                return              # delete-marker write keeps the data
+            try:
+                old = srv.layer.get_object_info(
+                    bucket, key, ol.ObjectOptions(version_id=vid or None))
+            except ol.ObjectLayerError:
+                return
+            srv.transition.delete_tiered(old.user_defined)
 
         def _delete_object(self, bucket, key, query):
             q1 = {k: v[0] for k, v in query.items()}
@@ -1904,6 +2027,7 @@ def _make_handler(srv: S3Server):
                 vid = ""
             self._check_retention(bucket, key, vid)
             versioned = srv.bucket_meta.versioning_enabled(bucket)
+            self._free_tier_bytes(bucket, key, vid, versioned)
             res = srv.layer.delete_object(
                 bucket, key, ol.ObjectOptions(version_id=vid,
                                               versioned=versioned))

@@ -1,0 +1,188 @@
+"""Bucket DNS federation (cmd/etcd.go + pkg/dns/etcd_dns.go).
+
+The reference federates multiple clusters under one domain by writing
+CoreDNS SRV records into etcd on MakeBucket and deleting them on
+DeleteBucket; a request for a bucket homed on another cluster resolves
+through DNS.  Here the record store is pluggable:
+
+  * FileDNSStore — a shared JSON file with advisory locking: the
+    zero-egress stand-in for etcd that still coordinates multiple
+    server processes on one host/NFS mount (tests and local
+    federations use this);
+  * EtcdDNSStore — gated on the etcd3 client library, which is not in
+    this image.
+
+FederationSys wires a store to a server: register/unregister on bucket
+create/delete, and `lookup_other` drives a 307 redirect for buckets
+homed elsewhere (the reference proxies or redirects the same way).
+"""
+
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+
+class DNSError(Exception):
+    pass
+
+
+class BucketTaken(DNSError):
+    """Bucket already registered by another cluster in the federation."""
+
+
+@dataclass
+class DNSRecord:
+    bucket: str
+    host: str
+    port: int
+    created_ns: int = 0
+
+    def to_dict(self) -> dict:
+        return {"bucket": self.bucket, "host": self.host,
+                "port": self.port, "created_ns": self.created_ns}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DNSRecord":
+        return cls(d["bucket"], d["host"], int(d["port"]),
+                   int(d.get("created_ns", 0)))
+
+
+class FileDNSStore:
+    """Shared-file record store with fcntl locking (etcd stand-in)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)) or ".",
+                    exist_ok=True)
+
+    def _with_lock(self, fn):
+        lock = self.path + ".lock"
+        with open(lock, "w") as lf:
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            try:
+                try:
+                    with open(self.path) as f:
+                        table = json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    table = {}
+                out, table2 = fn(table)
+                if table2 is not None:
+                    tmp = self.path + f".tmp{os.getpid()}"
+                    with open(tmp, "w") as f:
+                        json.dump(table2, f)
+                    os.replace(tmp, self.path)
+                return out
+            finally:
+                fcntl.flock(lf, fcntl.LOCK_UN)
+
+    def put(self, rec: DNSRecord, replace: bool = False) -> None:
+        def fn(table):
+            cur = table.get(rec.bucket)
+            if cur and not replace and \
+                    (cur["host"], cur["port"]) != (rec.host, rec.port):
+                raise BucketTaken(
+                    f"bucket {rec.bucket!r} is owned by "
+                    f"{cur['host']}:{cur['port']}")
+            table[rec.bucket] = rec.to_dict()
+            return None, table
+        self._with_lock(fn)
+
+    def get(self, bucket: str) -> Optional[DNSRecord]:
+        def fn(table):
+            d = table.get(bucket)
+            return (DNSRecord.from_dict(d) if d else None), None
+        return self._with_lock(fn)
+
+    def delete(self, bucket: str) -> None:
+        def fn(table):
+            table.pop(bucket, None)
+            return None, table
+        self._with_lock(fn)
+
+    def list(self) -> list[DNSRecord]:
+        def fn(table):
+            return [DNSRecord.from_dict(d) for d in table.values()], None
+        return self._with_lock(fn)
+
+
+class EtcdDNSStore:
+    """etcd-backed store (pkg/dns/etcd_dns.go) — gated: the etcd3
+    client library is not in this image."""
+
+    def __init__(self, endpoints: list[str], domain: str):
+        try:
+            import etcd3  # noqa: F401
+        except ImportError:
+            raise DNSError(
+                "etcd federation requires the etcd3 client library "
+                "(not installed in this build)") from None
+        raise DNSError("etcd federation backend not implemented "
+                       "in this build")
+
+
+class FederationSys:
+    """Per-server federation driver (globalDNSConfig usage)."""
+
+    def __init__(self, store, domain: str, self_host: str,
+                 self_port: int):
+        self.store = store
+        self.domain = domain
+        self.self_host = self_host
+        self.self_port = self_port
+
+    @classmethod
+    def from_config(cls, cfg, host: str,
+                    port: int) -> "FederationSys | None":
+        if cfg.get("federation", "enable") != "on":
+            return None
+        path = cfg.get("federation", "dns_file")
+        if not path:
+            raise DNSError("federation.dns_file required")
+        # DNS records must carry a ROUTABLE owner address: a wildcard
+        # bind would make every cluster look like the owner of every
+        # bucket and emit http://0.0.0.0 redirects
+        adv = cfg.get("federation", "advertise")
+        if adv:
+            ahost, _, aport = adv.rpartition(":")
+            host, port = ahost or adv, int(aport) if aport else port
+        elif host in ("0.0.0.0", "::", ""):
+            raise DNSError(
+                "federation with a wildcard bind requires "
+                "federation.advertise=<host:port>")
+        return cls(FileDNSStore(path), cfg.get("federation", "domain"),
+                   host, port)
+
+    def _is_self(self, rec: DNSRecord) -> bool:
+        return (rec.host, rec.port) == (self.self_host, self.self_port)
+
+    def register(self, bucket: str) -> bool:
+        """MakeBucket hook; BucketTaken when owned elsewhere.  Returns
+        True when this call created the record (False when the bucket
+        was already ours) — a failed local create must roll back only a
+        fresh registration."""
+        existing = self.store.get(bucket)
+        if existing is not None and self._is_self(existing):
+            return False
+        self.store.put(DNSRecord(bucket, self.self_host, self.self_port,
+                                 time.time_ns()))
+        return True
+
+    def unregister(self, bucket: str) -> None:
+        rec = self.store.get(bucket)
+        if rec is not None and self._is_self(rec):
+            self.store.delete(bucket)
+
+    def lookup_other(self, bucket: str) -> Optional[DNSRecord]:
+        """Record for a bucket homed on ANOTHER cluster, else None."""
+        rec = self.store.get(bucket)
+        if rec is None or self._is_self(rec):
+            return None
+        return rec
+
+    def federated_buckets(self) -> list[DNSRecord]:
+        return self.store.list()

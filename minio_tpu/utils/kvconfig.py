@@ -71,6 +71,21 @@ register_subsys("audit_webhook", {"enable": "off", "endpoint": "",
                                   "auth_token": ""})
 register_subsys("notify_webhook", {"enable": "off", "endpoint": "",
                                    "auth_token": "", "queue_dir": ""})
+register_subsys("federation", {
+    "enable": "off",
+    "domain": "",                   # bucket.<domain> DNS zone
+    "dns_file": "",                 # FileDNSStore path (etcd stand-in)
+    "advertise": "",                # routable host:port in DNS records
+})
+register_subsys("identity_openid", {
+    "enable": "off",
+    "issuer": "",                   # expected iss claim
+    "client_id": "",                # expected aud/azp
+    "claim_name": "policy",         # claim carrying IAM policy names
+    "jwks_file": "",                # path to a JWKS document (RS256)
+    "jwks": "",                     # inline JWKS JSON (overrides file)
+    "hs256_secret": "",             # shared-secret mode
+})
 # broker notification subsystems (cmd/config/notify): keys mirror the
 # reference's per-target config structs
 register_subsys("notify_amqp", {"enable": "off", "url": "",

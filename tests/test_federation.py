@@ -1,0 +1,107 @@
+"""Bucket DNS federation tests (cmd/etcd.go, pkg/dns/etcd_dns.go):
+two in-process clusters share one DNS store; bucket ownership is
+exclusive, cross-cluster requests redirect to the owner.
+"""
+
+import os
+
+import pytest
+
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.s3.client import S3Client, S3ClientError
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl_storage import XLStorage
+from minio_tpu.utils import fed_dns
+
+
+def make_layer(tmp, name):
+    disks = []
+    for i in range(4):
+        d = tmp / f"{name}{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    return ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                          backend="numpy")
+
+
+def test_file_dns_store(tmp_path):
+    store = fed_dns.FileDNSStore(str(tmp_path / "dns.json"))
+    store.put(fed_dns.DNSRecord("b1", "h1", 9000))
+    assert store.get("b1").host == "h1"
+    # same owner re-registers fine; other owner is refused
+    store.put(fed_dns.DNSRecord("b1", "h1", 9000))
+    with pytest.raises(fed_dns.BucketTaken):
+        store.put(fed_dns.DNSRecord("b1", "h2", 9000))
+    store.put(fed_dns.DNSRecord("b2", "h2", 9001))
+    assert {r.bucket for r in store.list()} == {"b1", "b2"}
+    store.delete("b1")
+    assert store.get("b1") is None
+
+
+def test_etcd_store_gated():
+    with pytest.raises(fed_dns.DNSError, match="etcd3"):
+        fed_dns.EtcdDNSStore(["http://e:2379"], "fed.test")
+
+
+@pytest.fixture
+def federation(tmp_path, monkeypatch):
+    monkeypatch.setenv("MT_FEDERATION_ENABLE", "on")
+    monkeypatch.setenv("MT_FEDERATION_DOMAIN", "fed.test")
+    monkeypatch.setenv("MT_FEDERATION_DNS_FILE",
+                       str(tmp_path / "shared-dns.json"))
+    a = S3Server(make_layer(tmp_path, "fa"), access_key="k",
+                 secret_key="s")
+    b = S3Server(make_layer(tmp_path, "fb"), access_key="k",
+                 secret_key="s")
+    a.start()
+    b.start()
+    yield a, b
+    a.stop()
+    b.stop()
+
+
+def test_federated_ownership_and_redirect(federation):
+    a, b = federation
+    ca = S3Client(a.endpoint, "k", "s")
+    cb = S3Client(b.endpoint, "k", "s")
+    ca.make_bucket("fedbkt")
+    ca.put_object("fedbkt", "obj", b"cluster A data")
+
+    # the other cluster cannot claim the name
+    with pytest.raises(S3ClientError) as ei:
+        cb.make_bucket("fedbkt")
+    assert ei.value.code == "BucketAlreadyExists"
+
+    # a GET against cluster B redirects to the owner; following the
+    # redirect serves the object (urllib in our client doesn't follow,
+    # so check the Location explicitly)
+    r = cb.request("GET", "/fedbkt/obj", expect=(307,))
+    loc = r.headers.get("Location")
+    assert loc and str(a.port) in loc and loc.endswith("/fedbkt/obj")
+
+    # DeleteBucket releases the name for the other cluster
+    ca.delete_object("fedbkt", "obj")
+    ca.delete_bucket("fedbkt")
+    cb.make_bucket("fedbkt")
+    assert b.federation.store.get("fedbkt").port == b.port
+
+
+def test_unfederated_bucket_not_found_unchanged(federation):
+    a, _ = federation
+    ca = S3Client(a.endpoint, "k", "s")
+    with pytest.raises(S3ClientError) as ei:
+        ca.get_object("missing-bkt", "x")
+    assert ei.value.code == "NoSuchBucket"
+
+
+def test_make_bucket_rolls_back_dns_on_local_failure(federation):
+    a, b = federation
+    ca = S3Client(a.endpoint, "k", "s")
+    # invalid per layer rules but passes the server regex? use a name the
+    # layer accepts; instead simulate failure via duplicate local create
+    ca.make_bucket("rollb")
+    # second create on same cluster: layer raises BucketExists; DNS entry
+    # must survive as ours (registered once, still owned by A)
+    with pytest.raises(S3ClientError):
+        ca.make_bucket("rollb")
+    assert a.federation.store.get("rollb").port == a.port

@@ -1,0 +1,197 @@
+"""OIDC web-identity STS tests (cmd/sts-handlers.go
+AssumeRoleWithWebIdentity + cmd/config/identity/openid).
+"""
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+import urllib.parse
+import urllib.request
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from minio_tpu.iam.openid import OpenIDError, OpenIDProvider
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.s3.client import S3Client, S3ClientError
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl_storage import XLStorage
+
+ISSUER = "https://idp.example.test"
+CLIENT = "minio-tpu-app"
+SECRET = "oidc-shared-secret"
+
+
+def _b64(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+
+def hs256_token(claims: dict, secret: str = SECRET) -> str:
+    h = _b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    c = _b64(json.dumps(claims).encode())
+    sig = hmac.new(secret.encode(), f"{h}.{c}".encode(),
+                   hashlib.sha256).digest()
+    return f"{h}.{c}.{_b64(sig)}"
+
+
+def claims(**over) -> dict:
+    base = {"iss": ISSUER, "aud": CLIENT, "sub": "user-42",
+            "exp": int(time.time()) + 600, "policy": "readwrite"}
+    base.update(over)
+    return base
+
+
+@pytest.fixture
+def provider():
+    return OpenIDProvider(issuer=ISSUER, client_id=CLIENT,
+                          hs256_secret=SECRET)
+
+
+def test_hs256_validation(provider):
+    got = provider.authenticate(hs256_token(claims()))
+    assert got["sub"] == "user-42"
+    assert provider.policies_of(got) == ["readwrite"]
+
+
+def test_rejections(provider):
+    with pytest.raises(OpenIDError, match="expired"):
+        provider.authenticate(hs256_token(
+            claims(exp=int(time.time()) - 10)))
+    with pytest.raises(OpenIDError, match="issuer"):
+        provider.authenticate(hs256_token(claims(iss="https://evil")))
+    with pytest.raises(OpenIDError, match="audience"):
+        provider.authenticate(hs256_token(claims(aud="other-app")))
+    with pytest.raises(OpenIDError, match="signature"):
+        provider.authenticate(hs256_token(claims(), secret="wrong"))
+    with pytest.raises(OpenIDError, match="malformed"):
+        provider.authenticate("garbage")
+
+
+def test_policy_claim_forms(provider):
+    assert provider.policies_of({"policy": "a, b,c"}) == ["a", "b", "c"]
+    assert provider.policies_of({"policy": ["x", "y"]}) == ["x", "y"]
+    assert provider.policies_of({}) == []
+
+
+def test_rs256_validation():
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+    from cryptography.hazmat.primitives import hashes
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pub = key.public_key().public_numbers()
+
+    def b64i(n, length):
+        return _b64(n.to_bytes(length, "big"))
+
+    jwks = {"keys": [{"kty": "RSA", "kid": "k1", "alg": "RS256",
+                      "n": b64i(pub.n, 256), "e": b64i(pub.e, 3)}]}
+    p = OpenIDProvider(issuer=ISSUER, client_id=CLIENT, jwks=jwks)
+    h = _b64(json.dumps({"alg": "RS256", "kid": "k1"}).encode())
+    c = _b64(json.dumps(claims()).encode())
+    sig = key.sign(f"{h}.{c}".encode(), padding.PKCS1v15(),
+                   hashes.SHA256())
+    assert p.authenticate(f"{h}.{c}.{_b64(sig)}")["sub"] == "user-42"
+    # tampered payload fails
+    c2 = _b64(json.dumps(claims(sub="attacker")).encode())
+    with pytest.raises(OpenIDError, match="signature"):
+        p.authenticate(f"{h}.{c2}.{_b64(sig)}")
+
+
+# -- over the API -------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory, monkeypatch_module=None):
+    import os
+    os.environ["MT_IDENTITY_OPENID_ENABLE"] = "on"
+    os.environ["MT_IDENTITY_OPENID_ISSUER"] = ISSUER
+    os.environ["MT_IDENTITY_OPENID_CLIENT_ID"] = CLIENT
+    os.environ["MT_IDENTITY_OPENID_HS256_SECRET"] = SECRET
+    tmp = tmp_path_factory.mktemp("oidcsrv")
+    disks = []
+    for i in range(4):
+        d = tmp / f"d{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                           backend="numpy")
+    srv = S3Server(layer, access_key="rk", secret_key="rs")
+    srv.start()
+    yield srv
+    srv.stop()
+    for k in list(os.environ):
+        if k.startswith("MT_IDENTITY_OPENID"):
+            del os.environ[k]
+
+
+def _sts(server, form: dict) -> tuple[int, ET.Element]:
+    body = urllib.parse.urlencode(form).encode()
+    req = urllib.request.Request(server.endpoint + "/", data=body)
+    try:
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, ET.fromstring(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, ET.fromstring(e.read())
+
+
+def test_web_identity_full_flow(server):
+    status, root = _sts(server, {
+        "Action": "AssumeRoleWithWebIdentity", "Version": "2011-06-15",
+        "WebIdentityToken": hs256_token(claims(policy="readwrite"))})
+    assert status == 200
+    ns = "{https://sts.amazonaws.com/doc/2011-06-15/}"
+    ak = root.findtext(f".//{ns}AccessKeyId")
+    sk = root.findtext(f".//{ns}SecretAccessKey")
+    tok = root.findtext(f".//{ns}SessionToken")
+    assert root.findtext(f".//{ns}SubjectFromWebIdentityToken") == \
+        "user-42"
+    # the minted credentials work, bounded by the readwrite policy
+    c = S3Client(server.endpoint, "rk", "rs")
+    c.make_bucket("oidcb")
+    fed = S3Client(server.endpoint, ak, sk)
+    r = fed.request("PUT", "/oidcb/obj", body=b"federated write",
+                    headers={"x-amz-security-token": tok})
+    assert r.status == 200
+    r = fed.request("GET", "/oidcb/obj",
+                    headers={"x-amz-security-token": tok})
+    assert r.body == b"federated write"
+
+
+def test_web_identity_readonly_policy_enforced(server):
+    status, root = _sts(server, {
+        "Action": "AssumeRoleWithWebIdentity", "Version": "2011-06-15",
+        "WebIdentityToken": hs256_token(claims(policy="readonly",
+                                               sub="reader-1"))})
+    assert status == 200
+    ns = "{https://sts.amazonaws.com/doc/2011-06-15/}"
+    ak = root.findtext(f".//{ns}AccessKeyId")
+    sk = root.findtext(f".//{ns}SecretAccessKey")
+    tok = root.findtext(f".//{ns}SessionToken")
+    fed = S3Client(server.endpoint, ak, sk)
+    with pytest.raises(S3ClientError) as ei:
+        fed.request("PUT", "/oidcb/deny", body=b"x",
+                    headers={"x-amz-security-token": tok})
+    assert ei.value.code == "AccessDenied"
+
+
+def test_web_identity_bad_token_rejected(server):
+    status, root = _sts(server, {
+        "Action": "AssumeRoleWithWebIdentity", "Version": "2011-06-15",
+        "WebIdentityToken": hs256_token(claims(), secret="forged")})
+    assert status == 403
+    assert "AccessDenied" in ET.tostring(root).decode()
+
+
+def test_web_identity_unknown_policy_rejected(server):
+    status, _ = _sts(server, {
+        "Action": "AssumeRoleWithWebIdentity", "Version": "2011-06-15",
+        "WebIdentityToken": hs256_token(claims(policy="no-such-pol"))})
+    assert status == 403
+
+
+def test_ldap_sts_gated(server):
+    status, root = _sts(server, {
+        "Action": "AssumeRoleWithLDAPIdentity", "Version": "2011-06-15",
+        "LDAPUsername": "u", "LDAPPassword": "p"})
+    assert status == 400
+    assert "NotImplemented" in ET.tostring(root).decode()

@@ -251,6 +251,60 @@ def test_admin_tier_list_redacts_secrets(server, tmp_path):
     assert ent["secret_key"] == "supersecret"
 
 
+def test_restore_versioned_latest_without_versionid(layer, tmp_path):
+    """POST ?restore without versionId on a versioned bucket must
+    restore the transitioned latest version, not mint a null version."""
+    from minio_tpu.objectlayer.interface import (ObjectOptions,
+                                                 PutObjectOptions)
+    layer.make_bucket("vrb")
+    v = layer.put_object("vrb", "doc", b"versioned cold",
+                         PutObjectOptions(versioned=True))
+    ts = tr.TransitionSys(layer)
+    ts.add_tier(tr.DirTier("VT", str(tmp_path / "vt")))
+    oi = layer.get_object_info("vrb", "doc")
+    oi.transition_tier = "VT"
+    ts.transition("vrb", oi)
+    assert ts.restore("vrb", "doc", 1) is True
+    got = layer.get_object("vrb", "doc", 0, -1,
+                           ObjectOptions(version_id=v.version_id))
+    assert got[1] == b"versioned cold"
+    # no spurious null version appeared
+    vers = layer.list_object_versions("vrb")
+    assert {o.version_id for o in vers if o.name == "doc"} == \
+        {v.version_id}
+
+
+def test_delete_frees_tier_bytes(server, client, tmp_path):
+    import os
+    client.put_object("tierb", "gcme", b"G" * 2048)
+    _archive(server, "tierb", "gcme")
+    tier_dir = server.transition.tiers["DEEP"].path
+    assert len(os.listdir(tier_dir)) >= 1
+    before = len(os.listdir(tier_dir))
+    client.delete_object("tierb", "gcme")
+    assert len(os.listdir(tier_dir)) == before - 1
+
+
+def test_overwrite_frees_tier_bytes(server, client):
+    import os
+    client.put_object("tierb", "owme", b"O" * 2048)
+    _archive(server, "tierb", "owme")
+    tier_dir = server.transition.tiers["DEEP"].path
+    before = len(os.listdir(tier_dir))
+    client.put_object("tierb", "owme", b"fresh bytes")
+    assert len(os.listdir(tier_dir)) == before - 1
+    assert client.get_object("tierb", "owme").body == b"fresh bytes"
+
+
+def test_copy_from_archived_source_rejected(server, client):
+    client.put_object("tierb", "cpsrc", b"C" * 1024)
+    _archive(server, "tierb", "cpsrc")
+    with pytest.raises(S3ClientError) as ei:
+        client.request("PUT", "/tierb/cpdst",
+                       headers={"x-amz-copy-source": "/tierb/cpsrc"})
+    assert ei.value.code == "InvalidObjectState"
+
+
 def test_restore_of_live_object_rejected(client):
     client.put_object("tierb", "live", b"live")
     with pytest.raises(S3ClientError) as ei:
