@@ -90,11 +90,65 @@ def streaming_encode(data: bytes, shard_size: int,
     if len(data) == 0:
         return b""
     hashes = hh256_blocks(data, shard_size)
+    return _interleave(data, shard_size, hashes)
+
+
+def _interleave(data: bytes, shard_size: int, hashes) -> bytes:
     out = bytearray()
     for i, h in enumerate(hashes):
-        out += h
+        out += bytes(h)
         out += data[i * shard_size:(i + 1) * shard_size]
     return bytes(out)
+
+
+def streaming_encode_batch(shards, shard_size: int,
+                           algo: str = DEFAULT_BITROT_ALGORITHM,
+                           use_device: bool = False) -> list[bytes]:
+    """Frame a full stripe of equal-length shard files at once.
+
+    With use_device, the per-block HighwayHash runs ON the TPU
+    (ops/hh_kernels), fused after the erasure encode so parity AND
+    bitrot digests come out of one device pipeline (BASELINE config 5).
+    Falls back to the host C path on any device failure."""
+    if not is_streaming(algo):
+        return [bytes(bytearray(s)) for s in shards]
+    if use_device and algo == HIGHWAYHASH256S and shards:
+        try:
+            return _streaming_encode_batch_device(shards, shard_size)
+        except Exception:  # noqa: BLE001 — host path is always correct
+            pass
+    return [streaming_encode(bytes(bytearray(s)), shard_size, algo)
+            for s in shards]
+
+
+def _streaming_encode_batch_device(shards, shard_size: int) -> list[bytes]:
+    import numpy as np
+
+    from ..ops import hh_kernels
+    arrs = [np.asarray(bytearray(s), dtype=np.uint8) for s in shards]
+    L = len(arrs[0])
+    if L == 0:
+        return [b"" for _ in arrs]
+    if any(len(a) != L for a in arrs):
+        raise ValueError("shard lengths differ")
+    nblocks = ceil_frac(L, shard_size)
+    full, rem = divmod(L, shard_size)
+    stacked = np.stack(arrs)                       # (S, L)
+    digests: list[list[bytes]] = [[] for _ in arrs]
+    if full:
+        blocks = stacked[:, :full * shard_size].reshape(-1, shard_size)
+        hs = np.asarray(hh_kernels.hh256_batch(blocks))
+        hs = hs.reshape(len(arrs), full, 32)
+        for si in range(len(arrs)):
+            digests[si] = [hs[si, b].tobytes() for b in range(full)]
+    if rem:
+        tails = stacked[:, full * shard_size:]
+        hs = np.asarray(hh_kernels.hh256_batch(tails))
+        for si in range(len(arrs)):
+            digests[si].append(hs[si].tobytes())
+    assert all(len(d) == nblocks for d in digests)
+    return [_interleave(arrs[si].tobytes(), shard_size, digests[si])
+            for si in range(len(arrs))]
 
 
 class StreamingBitrotWriter:

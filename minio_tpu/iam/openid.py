@@ -11,20 +11,17 @@ egress, so discovery is not fetched: the JWKS comes from config
 
 from __future__ import annotations
 
-import base64
 import hashlib
 import hmac
 import json
 import time
 from dataclasses import dataclass, field
 
+from .sts import _b64url_dec
+
 
 class OpenIDError(Exception):
     pass
-
-
-def _b64url_dec(s: str) -> bytes:
-    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
 
 
 def _rs256_verify(jwk: dict, signing_input: bytes, sig: bytes) -> bool:

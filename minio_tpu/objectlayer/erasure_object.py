@@ -217,8 +217,11 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             shards = self._codec.encode_object(data)  # ONE device dispatch
         else:
             shards = [np.frombuffer(data, dtype=np.uint8)]
-        framed = [bitrot.streaming_encode(s.tobytes(), fi.erasure.shard_size(),
-                                          self.bitrot_algo) for s in shards]
+        # bitrot digests fuse onto the device when the codec runs there:
+        # parity + per-block HighwayHash from one pipeline (ops/hh_kernels)
+        framed = bitrot.streaming_encode_batch(
+            shards, fi.erasure.shard_size(), self.bitrot_algo,
+            use_device=(m > 0 and self._codec.backend == "tpu"))
 
         inline = size <= self.inline_threshold
         shuffled = meta.shuffle_disks(self.disks, distribution)

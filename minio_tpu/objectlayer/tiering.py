@@ -220,15 +220,17 @@ class TransitionSys:
     # -- restore -----------------------------------------------------------
 
     def restore(self, bucket: str, key: str, days: int,
-                version_id: str = "") -> bool:
+                version_id: Optional[str] = None) -> bool:
         """Copy tiered bytes back for `days`; returns False if the
-        object already holds a valid restored copy."""
-        opts = ObjectOptions(version_id=version_id or None)
-        oi = self.layer.get_object_info(bucket, key, opts)
+        object already holds a valid restored copy.  version_id follows
+        the layer contract: None = latest, "" = the null version."""
+        oi = self.layer.get_object_info(
+            bucket, key, ObjectOptions(version_id=version_id))
         # write back to the version we resolved: an omitted versionId on
         # a versioned bucket must restore the latest version, not mint a
         # spurious null version
-        version_id = version_id or oi.version_id or ""
+        if version_id is None:
+            version_id = oi.version_id or ""
         if not is_transitioned(oi.user_defined):
             raise TierError("object is not in an archived state")
         if restore_valid(oi.user_defined):
@@ -262,9 +264,11 @@ class TransitionSys:
         for oi in versions:
             if getattr(oi, "delete_marker", False):
                 continue
+            # "" IS the null version here — `or None` would resolve the
+            # latest version instead and skip expired null versions
             full = self.layer.get_object_info(
                 bucket, oi.name,
-                ObjectOptions(version_id=oi.version_id or None))
+                ObjectOptions(version_id=oi.version_id))
             ud = full.user_defined
             if is_transitioned(ud) and restore_expiry(ud) and \
                     not restore_valid(ud):

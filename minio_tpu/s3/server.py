@@ -1165,11 +1165,14 @@ def _make_handler(srv: S3Server):
                 try:
                     self._allow(iampol.DELETE_OBJECT, f"{bucket}/{key}")
                     self._check_retention(bucket, key, vid)
-                    self._free_tier_bytes(bucket, key, vid, versioned)
+                    tiered_ud = self._tiered_meta_of(bucket, key, vid,
+                                                     versioned)
                     res = srv.layer.delete_object(
                         bucket, key,
                         ol.ObjectOptions(version_id=vid,
                                          versioned=versioned))
+                    if tiered_ud is not None:
+                        srv.transition.delete_tiered(tiered_ud)
                     if not quiet:
                         d = ET.SubElement(out, "Deleted")
                         ET.SubElement(d, "Key").text = key
@@ -1598,20 +1601,23 @@ def _make_handler(srv: S3Server):
             notify, replicate.  Returns (oi, response_headers)."""
             user_defined.update(self._lock_headers(bucket, key))
             self._check_quota(bucket, len(payload))
-            if not srv.bucket_meta.versioning_enabled(bucket):
-                # unversioned overwrite replaces the null version: free
-                # any tiered bytes the old copy holds
-                self._free_tier_bytes(bucket, key, "", False)
+            versioned = srv.bucket_meta.versioning_enabled(bucket)
+            # unversioned overwrite replaces the null version: remember
+            # its tiered bytes, freed only AFTER the new write commits
+            # (an early free would destroy data if this PUT fails)
+            tiered_ud = None if versioned else \
+                self._tiered_meta_of(bucket, key, "", False)
             from ..crypto import sse as csse
             payload = self._compress_for_put(key, user_defined, payload)
             enc = self._sse_for_put(bucket, key, user_defined)
             if enc is not None:
                 payload = enc.encrypt(payload)
-            versioned = srv.bucket_meta.versioning_enabled(bucket)
             oi = srv.layer.put_object(
                 bucket, key, payload,
                 ol.PutObjectOptions(user_defined=user_defined,
                                     versioned=versioned))
+            if tiered_ud is not None:
+                srv.transition.delete_tiered(tiered_ud)
             hdrs = {"ETag": f'"{oi.etag}"'}
             hdrs.update(csse.response_headers(user_defined))
             if oi.version_id:
@@ -1986,9 +1992,9 @@ def _make_handler(srv: S3Server):
                     raise S3Error("MalformedXML") from e
             if days < 1:
                 raise S3Error("InvalidArgument")
-            vid = query.get("versionId", [""])[0]
+            vid = query.get("versionId", [None])[0]
             if vid == "null":
-                vid = ""
+                vid = ""                # explicit null version
             ts = srv.transition
             try:
                 fresh = ts.restore(bucket, key, days, version_id=vid)
@@ -1999,26 +2005,29 @@ def _make_handler(srv: S3Server):
                     raise S3Error("InvalidObjectState") from e
                 raise S3Error("InternalError") from e
             oi = srv.layer.get_object_info(
-                bucket, key, ol.ObjectOptions(version_id=vid or None))
+                bucket, key, ol.ObjectOptions(version_id=vid))
             srv.notify("s3:ObjectRestore:Completed", bucket, oi)
             # 202 while "in progress" (fresh copy), 200 when it already
             # held a valid restored copy (object-handlers.go semantics)
             return self._send(202 if fresh else 200, b"")
 
-        def _free_tier_bytes(self, bucket, key, vid, versioned) -> None:
-            """When a version is actually being removed or replaced,
-            free its remote tier bytes (only does work when tiers are
-            configured — a plain deployment pays nothing)."""
+        def _tiered_meta_of(self, bucket, key, vid, versioned):
+            """Metadata of the version about to be removed/replaced, for
+            freeing its tier bytes AFTER the destructive op commits.
+            None when nothing tiered is at stake.  vid semantics follow
+            the layer: None = latest, "" = null version."""
             if not srv.transition.tiers:
-                return
+                return None
             if versioned and vid is None:
-                return              # delete-marker write keeps the data
+                return None         # delete-marker write keeps the data
             try:
                 old = srv.layer.get_object_info(
-                    bucket, key, ol.ObjectOptions(version_id=vid or None))
+                    bucket, key, ol.ObjectOptions(version_id=vid))
             except ol.ObjectLayerError:
-                return
-            srv.transition.delete_tiered(old.user_defined)
+                return None
+            from ..objectlayer import tiering as _tr
+            return old.user_defined \
+                if _tr.is_transitioned(old.user_defined) else None
 
         def _delete_object(self, bucket, key, query):
             q1 = {k: v[0] for k, v in query.items()}
@@ -2027,10 +2036,12 @@ def _make_handler(srv: S3Server):
                 vid = ""
             self._check_retention(bucket, key, vid)
             versioned = srv.bucket_meta.versioning_enabled(bucket)
-            self._free_tier_bytes(bucket, key, vid, versioned)
+            tiered_ud = self._tiered_meta_of(bucket, key, vid, versioned)
             res = srv.layer.delete_object(
                 bucket, key, ol.ObjectOptions(version_id=vid,
                                               versioned=versioned))
+            if tiered_ud is not None:   # freed only after the commit
+                srv.transition.delete_tiered(tiered_ud)
             hdrs = {}
             if res.delete_marker:
                 hdrs["x-amz-delete-marker"] = "true"
