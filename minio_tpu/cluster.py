@@ -95,3 +95,95 @@ def start_cluster(specs: list[NodeSpec], secret: str,
     for node in nodes:
         node.assemble()
     return nodes
+
+
+def wait_for_peers(specs: list[NodeSpec], secret: str, self_id: str,
+                   timeout: float = 60.0) -> None:
+    """Poll every peer's RPC ping until the whole topology answers
+    (verifyServerSystemConfig / bootstrap rendezvous,
+    cmd/bootstrap-peer-server.go:162) — multi-process nodes start in any
+    order and must not assemble before their peers listen."""
+    import time
+
+    from .parallel.rpc import RPCError
+
+    deadline = time.monotonic() + timeout
+    pending = [s for s in specs if s.node_id != self_id]
+    while pending:
+        still = []
+        for spec in pending:
+            try:
+                c = RPCClient(spec.endpoint, secret, timeout=2.0)
+                if c.call("sys", "ping") != "pong":
+                    still.append(spec)
+            except RPCError as e:
+                if e.error_type == "AuthError":
+                    # a secret mismatch never resolves by waiting —
+                    # surface the misconfiguration immediately
+                    raise
+                still.append(spec)
+            except Exception:  # noqa: BLE001 — not up yet
+                still.append(spec)
+        pending = still
+        if pending:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    "peers never came up: "
+                    + ", ".join(s.node_id for s in pending))
+            time.sleep(0.25)
+
+
+def _wait_for_leader_format(leader: NodeSpec, secret: str,
+                            timeout: float = 60.0) -> None:
+    """Poll the leader's first drive until format.json exists."""
+    import time
+
+    from .storage.format import FORMAT_FILE
+    from .storage.xl_storage import SYS_DIR
+
+    client = RPCClient(leader.endpoint, secret)
+    remote = RemoteStorage(client, "drive0")
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            remote.read_all(SYS_DIR, FORMAT_FILE)
+            return
+        except Exception:  # noqa: BLE001 — leader hasn't formatted yet
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"leader {leader.node_id} never wrote format.json")
+            time.sleep(0.25)
+
+
+def run_node(self_id: str, specs: list[NodeSpec], secret: str,
+             s3_address: str = "127.0.0.1:0",
+             set_drive_count: int | None = None,
+             access_key: str = "minioadmin",
+             secret_key: str = "minioadmin", **set_kwargs):
+    """One real cluster member process: RPC services on the DECLARED
+    endpoint (so peers can dial before rendezvous), wait for the
+    topology, assemble, serve S3.  Returns (node, s3_server)."""
+    from .s3.server import S3Server
+
+    spec = next(s for s in specs if s.node_id == self_id)
+    if not spec.endpoint:
+        raise ValueError(f"node {self_id} needs a declared endpoint")
+    u = spec.endpoint.removeprefix("http://")
+    rhost, _, rport = u.rpartition(":")
+    node = Node(spec, specs, secret, set_drive_count,
+                host=rhost or "127.0.0.1", port=int(rport), **set_kwargs)
+    # Node re-derives spec.endpoint from the bound socket; with a fixed
+    # port they agree with what peers dialed
+    wait_for_peers(specs, secret, self_id)
+    # first-boot formatting is leader-only (waitForFormatErasure: "first
+    # node creates format, others wait") — concurrent init on multiple
+    # nodes would mint divergent deployment ids
+    if specs[0].node_id != self_id:
+        _wait_for_leader_format(specs[0], secret)
+    layer = node.assemble()
+    shost, _, sport = s3_address.rpartition(":")
+    srv = S3Server(layer, access_key=access_key, secret_key=secret_key,
+                   host=shost or "127.0.0.1", port=int(sport))
+    srv.iam.load()
+    srv.start()
+    return node, srv

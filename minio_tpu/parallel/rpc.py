@@ -64,6 +64,8 @@ class RPCServer:
         self.host = host
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
+        # bootstrap liveness probe (cmd/bootstrap-peer-server.go role)
+        self.register("sys", {"ping": lambda: "pong"})
 
     @property
     def endpoint(self) -> str:

@@ -12,6 +12,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+import threading
 
 from .objectlayer.sets import ErasureSets
 from .s3.server import S3Server
@@ -110,6 +111,18 @@ def main(argv: list[str] | None = None) -> int:
     pg.add_argument("--cache-dir", action="append", default=None,
                     help="disk cache drive (repeatable)")
     pg.add_argument("--region", default="us-east-1")
+    pn = sub.add_parser("node", help="start one distributed cluster node")
+    pn.add_argument("--node-id", required=True)
+    pn.add_argument("--secret", default=None,
+                    help="internode RPC secret (MT_CLUSTER_SECRET)")
+    pn.add_argument("--address", default="127.0.0.1:0",
+                    help="S3 frontend address")
+    pn.add_argument("--set-drive-count", type=int, default=None)
+    pn.add_argument("--backend", default="auto",
+                    choices=["auto", "tpu", "numpy"])
+    pn.add_argument("peers", nargs="+",
+                    help="topology: id=host:rpcport=dir1,dir2 per node, "
+                         "SAME order on every node")
     ps = sub.add_parser("server", help="start the object storage server")
     ps.add_argument("dirs", nargs="+", help="drive directories")
     ps.add_argument("--address", default="0.0.0.0:9000")
@@ -122,6 +135,40 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("--block-size", type=int, default=None)
     ps.add_argument("--region", default="us-east-1")
     args = parser.parse_args(argv)
+
+    if args.command == "node":
+        from .cluster import NodeSpec, run_node
+        secret = args.secret or os.environ.get("MT_CLUSTER_SECRET", "")
+        specs = []
+        for p in args.peers:
+            nid, endpoint, dirs = p.split("=", 2)
+            drive_dirs = [d for d in dirs.split(",") if d]
+            if nid == args.node_id:
+                for d in drive_dirs:
+                    os.makedirs(d, exist_ok=True)
+            specs.append(NodeSpec(nid, drive_dirs,
+                                  endpoint=f"http://{endpoint}"))
+        if not secret:
+            # the RPC plane grants full shard read/write: a well-known
+            # default secret is acceptable only on loopback topologies
+            if any(not s.endpoint.startswith(("http://127.", "http://localhost"))
+                   for s in specs):
+                parser.error("distributed nodes require --secret or "
+                             "MT_CLUSTER_SECRET (refusing a default "
+                             "secret on non-loopback endpoints)")
+            secret = "cluster-secret"
+        node, srv = run_node(args.node_id, specs, secret, args.address,
+                             args.set_drive_count, backend=args.backend)
+        shost = args.address.rpartition(":")[0] or "127.0.0.1"
+        print(f"minio-tpu node {args.node_id}: rpc={node.rpc.endpoint} "
+              f"s3=http://{shost}:{srv.port}", flush=True)
+        try:
+            threading.Event().wait()          # serve until interrupted
+        except KeyboardInterrupt:
+            pass
+        srv.stop()
+        node.stop()
+        return 0
 
     if args.command == "gateway":
         srv = build_gateway_server(args.kind, args.target, args.address,
