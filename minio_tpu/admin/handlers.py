@@ -137,6 +137,125 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
             srv.layer._fanout(
                 lambda d: d.write_all(SYS_DIR, "tiers/tiers.json", blob))
             return send_json({"status": "ok"}) or True
+        if route == "service" and h.command == "POST":
+            # madmin ServiceAction: stop | restart (cmd/admin-handlers.go
+            # ServiceHandler).  The reply goes out before the action.
+            action = q1.get("action", "")
+            if action not in ("stop", "restart"):
+                return send_json({"error": f"unknown action {action!r}"},
+                                 400) or True
+            import threading
+
+            def later():
+                time.sleep(0.2)
+                if action == "restart":
+                    import os
+                    import sys
+                    # re-exec through -m: sys.argv[0] is __main__.py,
+                    # which cannot be run as a plain script (relative
+                    # imports need the package context)
+                    os.execv(sys.executable,
+                             [sys.executable, "-m", "minio_tpu",
+                              *sys.argv[1:]])
+                srv.stop()
+                srv.shutdown.set()      # node-mode main thread waits here
+            threading.Thread(target=later, daemon=True).start()
+            return send_json({"status": "ok", "action": action}) or True
+        if route == "storageinfo" and h.command == "GET":
+            # madmin StorageInfo: per-drive capacity + online state
+            disks = []
+            layer = srv.layer
+            sets = getattr(layer, "sets", None) or [layer]
+            for si, s in enumerate(sets):
+                for d in getattr(s, "disks", []):
+                    if d is None:
+                        disks.append({"set": si, "state": "offline"})
+                        continue
+                    try:
+                        info = d.disk_info()
+                        disks.append({
+                            "set": si, "endpoint": d.endpoint(),
+                            "state": "ok", "total": info.total,
+                            "used": info.used, "free": info.free})
+                    except Exception as e:  # noqa: BLE001
+                        disks.append({"set": si,
+                                      "endpoint": d.endpoint(),
+                                      "state": "offline",
+                                      "error": str(e)})
+            return send_json({"disks": disks,
+                              "backend": "erasure-tpu"}) or True
+        if route == "top-locks" and h.command == "GET":
+            # madmin TopLocks: currently-held namespace locks
+            out = []
+            ns = getattr(srv.layer, "ns_lock", None)
+            sets = getattr(srv.layer, "sets", None)
+            lockers = []
+            if ns is not None:
+                lockers = ns.lockers
+            elif sets:
+                for s in sets:
+                    lk = getattr(s, "ns_lock", None)
+                    if lk is not None:
+                        lockers.extend(lk.lockers)
+            for lk in lockers:
+                if hasattr(lk, "held"):
+                    out.extend(lk.held())
+            return send_json({"locks": out}) or True
+        if route == "list-groups" and h.command == "GET":
+            return send_json(srv.iam.list_groups()) or True
+        if route == "add-user-to-group" and h.command == "POST":
+            srv.iam.add_user_to_group(q1["accessKey"], q1["group"])
+            return send_json({"status": "ok"}) or True
+        if route == "set-group-policy" and h.command == "POST":
+            doc = json.loads(payload)
+            srv.iam.set_group_policy(doc["group"], doc["policies"])
+            return send_json({"status": "ok"}) or True
+        if route == "get-bucket-quota" and h.command == "GET":
+            raw = srv.bucket_meta.get_config(q1["bucket"], "quota")
+            return send_json(json.loads(raw) if raw else {}) or True
+        if route == "set-bucket-quota" and h.command == "POST":
+            # madmin SetBucketQuota: {"quota": bytes, "quotatype": "hard"}
+            from ..bucket.quota import Quota
+            bucket = q1.get("bucket", "")
+            try:
+                srv.layer.get_bucket_info(bucket)
+                Quota.parse(payload)        # reject malformed docs now,
+            except Exception as e:          # not on every later PUT
+                return send_json({"error": str(e)}, 400) or True
+            srv.bucket_meta.set_config(bucket, "quota", payload.decode())
+            return send_json({"status": "ok"}) or True
+        if route == "kms-key-status" and h.command == "GET":
+            # madmin KMSKeyStatus: round-trip an encryption probe
+            try:
+                key, sealed = srv.kms.generate_key(
+                    {"probe": "admin"})
+                ok = srv.kms.unseal_key(sealed, {"probe": "admin"}) == key
+                return send_json({"key_id": srv.kms.key_id,
+                                  "encryption_ok": ok,
+                                  "decryption_ok": ok}) or True
+            except Exception as e:  # noqa: BLE001
+                return send_json({"key_id": srv.kms.key_id,
+                                  "error": str(e)}, 500) or True
+        if route == "list-service-accounts" and h.command == "GET":
+            return send_json({
+                u.access_key: {"parent": u.parent_user}
+                for u in srv.iam.list_service_accounts(
+                    q1.get("parent"))}) or True
+        if route == "delete-service-account" and h.command == "POST":
+            ak = q1.get("accessKey", "")
+            try:
+                u = srv.iam.get_user(ak)
+            except NoSuchUser:
+                return send_json({"error": "no such account"},
+                                 404) or True
+            if not u.parent_user or u.expiration:
+                # a plain user here would cascade-delete all of its
+                # service accounts — refuse non-SA targets
+                return send_json(
+                    {"error": f"{ak!r} is not a service account"},
+                    400) or True
+            srv.iam.remove_user(ak)
+            return send_json({"status": "ok"}) or True
         if route == "heal-status" and h.command == "GET":
             # madmin BackgroundHealStatus analog
             healer = getattr(srv, "healer", None)

@@ -152,6 +152,15 @@ class IAMSys:
         with self._mu:
             return [u for u in self._users.values() if not u.parent_user]
 
+    def list_service_accounts(self,
+                              parent: str | None = None
+                              ) -> list[UserIdentity]:
+        """Permanent parented credentials (not expiring STS ones)."""
+        with self._mu:
+            return [u for u in self._users.values()
+                    if u.parent_user and not u.expiration
+                    and (parent is None or u.parent_user == parent)]
+
     def get_user(self, access_key: str) -> UserIdentity:
         with self._mu:
             if access_key == self.root.access_key:
@@ -282,7 +291,12 @@ class IAMSys:
 
     # -- group policy mapping ---------------------------------------------
 
+    def list_groups(self) -> dict[str, list[str]]:
+        with self._mu:
+            return {g: list(p) for g, p in self._group_policies.items()}
+
     def set_group_policy(self, group: str, policy_names: list[str]) -> None:
+        self._check_policies(policy_names)
         with self._mu:
             self._group_policies[group] = list(policy_names)
         self._save()

@@ -69,6 +69,13 @@ class LocalLocker:
         with self._mu:
             return resource in self._map
 
+    def held(self) -> list[dict]:
+        """Currently-held locks (madmin TopLocks introspection)."""
+        with self._mu:
+            return [{"resource": r, "writer": e.writer,
+                     "owners": dict(e.owners)}
+                    for r, e in self._map.items()]
+
 
 def register_lock_service(rpc: RPCServer, locker: LocalLocker) -> None:
     """Expose a node's locker over RPC (cmd/lock-rest-server.go:383)."""

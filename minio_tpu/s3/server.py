@@ -166,6 +166,9 @@ class S3Server:
         self.federation = FederationSys.from_config(
             self.config, host or "127.0.0.1", self.port)
         self._thread: threading.Thread | None = None
+        # set by admin service?action=stop so a node-mode main thread
+        # parked on it can finish shutdown (RPC plane + process exit)
+        self.shutdown = threading.Event()
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self.httpd.serve_forever,

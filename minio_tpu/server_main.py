@@ -163,10 +163,9 @@ def main(argv: list[str] | None = None) -> int:
         print(f"minio-tpu node {args.node_id}: rpc={node.rpc.endpoint} "
               f"s3=http://{shost}:{srv.port}", flush=True)
         try:
-            threading.Event().wait()          # serve until interrupted
+            srv.shutdown.wait()       # admin stop or Ctrl-C ends the node
         except KeyboardInterrupt:
-            pass
-        srv.stop()
+            srv.stop()
         node.stop()
         return 0
 

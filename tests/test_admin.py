@@ -151,3 +151,71 @@ def test_parse_storage_class():
         parse_storage_class("EC:9", 16)
     with pytest.raises(ValueError):
         parse_storage_class("junk", 16)
+
+
+def test_storageinfo(client):
+    doc = json.loads(_admin(client, "GET", "storageinfo").body)
+    assert doc["backend"] == "erasure-tpu"
+    assert len(doc["disks"]) == 4
+    for d in doc["disks"]:
+        assert d["state"] == "ok" and d["total"] > 0
+
+
+def test_top_locks(server, client):
+    lk = server.layer.sets[0].ns_lock.new_lock("lockedb", "obj") \
+        if hasattr(server.layer, "sets") else \
+        server.layer.ns_lock.new_lock("lockedb", "obj")
+    lk.lock(write=True)
+    try:
+        doc = json.loads(_admin(client, "GET", "top-locks").body)
+        assert any(e["resource"] == "lockedb/obj" and e["writer"]
+                   for e in doc["locks"])
+    finally:
+        lk.unlock()
+    doc = json.loads(_admin(client, "GET", "top-locks").body)
+    assert all(e["resource"] != "lockedb/obj" for e in doc["locks"])
+
+
+def test_groups_admin(client):
+    _admin(client, "POST", "add-user", body=json.dumps(
+        {"accessKey": "grpuser", "secretKey": "grpsecret1"}).encode())
+    _admin(client, "POST", "set-group-policy", body=json.dumps(
+        {"group": "readers", "policies": ["readonly"]}).encode())
+    _admin(client, "POST", "add-user-to-group",
+           "accessKey=grpuser&group=readers")
+    doc = json.loads(_admin(client, "GET", "list-groups").body)
+    assert doc["readers"] == ["readonly"]
+
+
+def test_bucket_quota_admin(client):
+    client.make_bucket("quotab")
+    _admin(client, "POST", "set-bucket-quota", "bucket=quotab",
+           json.dumps({"quota": 1048576, "quotatype": "hard"}).encode())
+    doc = json.loads(_admin(client, "GET", "get-bucket-quota",
+                            "bucket=quotab").body)
+    assert doc["quota"] == 1048576
+
+
+def test_kms_key_status(client):
+    doc = json.loads(_admin(client, "GET", "kms-key-status").body)
+    assert doc["encryption_ok"] and doc["decryption_ok"]
+    assert doc["key_id"]
+
+
+def test_service_accounts_admin(client):
+    _admin(client, "POST", "add-user", body=json.dumps(
+        {"accessKey": "saparent", "secretKey": "saparentpw1"}).encode())
+    r = _admin(client, "POST", "add-service-account",
+               body=json.dumps({"parent": "saparent"}).encode())
+    sa = json.loads(r.body)
+    doc = json.loads(_admin(client, "GET", "list-service-accounts").body)
+    assert doc[sa["accessKey"]]["parent"] == "saparent"
+    _admin(client, "POST", "delete-service-account",
+           f"accessKey={sa['accessKey']}")
+    doc = json.loads(_admin(client, "GET", "list-service-accounts").body)
+    assert sa["accessKey"] not in doc
+
+
+def test_service_action_validation(client):
+    r = _admin(client, "POST", "service", "action=bogus", expect=(400,))
+    assert b"unknown action" in r.body
