@@ -162,26 +162,22 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
             threading.Thread(target=later, daemon=True).start()
             return send_json({"status": "ok", "action": action}) or True
         if route == "storageinfo" and h.command == "GET":
-            # madmin StorageInfo: per-drive capacity + online state
+            # madmin StorageInfo: per-drive capacity + online state —
+            # same topology traversal as the metrics scrape
             disks = []
-            layer = srv.layer
-            sets = getattr(layer, "sets", None) or [layer]
-            for si, s in enumerate(sets):
-                for d in getattr(s, "disks", []):
-                    if d is None:
-                        disks.append({"set": si, "state": "offline"})
-                        continue
-                    try:
-                        info = d.disk_info()
-                        disks.append({
-                            "set": si, "endpoint": d.endpoint(),
-                            "state": "ok", "total": info.total,
-                            "used": info.used, "free": info.free})
-                    except Exception as e:  # noqa: BLE001
-                        disks.append({"set": si,
-                                      "endpoint": d.endpoint(),
-                                      "state": "offline",
-                                      "error": str(e)})
+            for d in metrics._collect_disks(srv.layer):
+                if d is None:
+                    disks.append({"state": "offline"})
+                    continue
+                try:
+                    info = d.disk_info()
+                    disks.append({
+                        "endpoint": d.endpoint(), "state": "ok",
+                        "total": info.total, "used": info.used,
+                        "free": info.free})
+                except Exception as e:  # noqa: BLE001
+                    disks.append({"endpoint": d.endpoint(),
+                                  "state": "offline", "error": str(e)})
             return send_json({"disks": disks,
                               "backend": "erasure-tpu"}) or True
         if route == "top-locks" and h.command == "GET":

@@ -41,13 +41,10 @@ def make_mesh(devices=None, stripe: int | None = None,
     return Mesh(dev, axis_names=("stripe", "shard"))
 
 
-@functools.lru_cache(maxsize=32)
-def _sharded_apply(mesh: Mesh, n_rows: int, k: int):
-    """Compiled sharded kernel: (8r, 8k) matrix x (B, k, n) shards.
-
-    Matrix columns and data shards are split over the ``shard`` mesh axis,
-    stripes over ``stripe``; partial products XOR-reduce via psum.
-    """
+def _local_gf2_kernel(n_rows: int, reduce_fn):
+    """Per-device GF(2) bitplane kernel shared by the psum and ring
+    paths; `reduce_fn` folds the (8r, B/T, n) int32 partial products
+    across the ``shard`` axis."""
 
     def local(mat, data):
         # mat: (8r, 8k/S) int8;  data: (B/T, k/S, n) uint8
@@ -58,18 +55,29 @@ def _sharded_apply(mesh: Mesh, n_rows: int, k: int):
         acc = jax.lax.dot_general(
             mat, bits, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.int32)          # (8r, B/T, n)
-        acc = jax.lax.psum(acc, "shard")               # XOR fan-in over ICI
+        acc = reduce_fn(acc)
         par = (acc & 1).astype(jnp.uint8)
         par = par.reshape(n_rows // 8, 8, b, n)
         weights = (jnp.uint8(1) << shifts)[None, :, None, None]
         packed = (par * weights).sum(axis=1, dtype=jnp.uint8)
         return packed.transpose(1, 0, 2)               # (B/T, r, n)
 
-    fn = jax.shard_map(
-        local, mesh=mesh,
-        in_specs=(P(None, "shard"), P("stripe", "shard", None)),
-        out_specs=P("stripe", None, None))
-    return jax.jit(fn)
+    return local
+
+
+_SPECS = dict(in_specs=(P(None, "shard"), P("stripe", "shard", None)),
+              out_specs=P("stripe", None, None))
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_apply(mesh: Mesh, n_rows: int, k: int):
+    """Compiled sharded kernel: (8r, 8k) matrix x (B, k, n) shards.
+
+    Matrix columns and data shards are split over the ``shard`` mesh axis,
+    stripes over ``stripe``; partial products XOR-reduce via psum."""
+    local = _local_gf2_kernel(
+        n_rows, lambda acc: jax.lax.psum(acc, "shard"))
+    return jax.jit(jax.shard_map(local, mesh=mesh, **_SPECS))
 
 
 def distributed_apply(mesh: Mesh, M: np.ndarray,
@@ -99,7 +107,58 @@ def distributed_reconstruct(mesh: Mesh, data_blocks: int, parity_blocks: int,
     surviving: (B, k, n) rows ordered by ``present``.  The tiny GF solve runs
     on host (gf8.gf_mat_inv); the heavy matmul is device-sharded.
     """
+    rows = _reconstruct_rows(data_blocks, parity_blocks, present, wanted)
+    return distributed_apply(mesh, rows, surviving)
+
+
+# -- ring formulation (neighbor-hop ICI) ------------------------------------
+
+@functools.lru_cache(maxsize=32)
+def _ring_apply(mesh: Mesh, n_rows: int, k: int):
+    """Same XOR fan-in as _sharded_apply but as an explicit ppermute
+    ring all-reduce over the ``shard`` axis: each step passes the
+    accumulator to the next neighbor and folds the local partial in —
+    S-1 single-hop ICI transfers instead of one tree all-reduce.  This
+    is the ring layout SURVEY.md §5 maps long-sequence reconstruction
+    onto: neighbors stream partial XOR state around the ring, which
+    composes with compute overlap when stripes pipeline."""
+    S = mesh.shape["shard"]
+    perm = [(j, (j + 1) % S) for j in range(S)]
+
+    def ring_reduce(partial):
+        def step(_, acc):
+            acc = jax.lax.ppermute(acc, "shard", perm)
+            return acc + partial
+
+        # after S-1 hops every device holds the full ring-reduced sum
+        return jax.lax.fori_loop(0, S - 1, step, partial)
+
+    local = _local_gf2_kernel(n_rows, ring_reduce)
+    # ring replication over 'shard' is real (every device ends with the
+    # full sum) but not statically inferable through ppermute/fori_loop,
+    # so replication checking is disabled for this kernel
+    try:
+        fn = jax.shard_map(local, mesh=mesh, check_vma=False, **_SPECS)
+    except TypeError:                      # older JAX spells it check_rep
+        fn = jax.shard_map(local, mesh=mesh, check_rep=False, **_SPECS)
+    return jax.jit(fn)
+
+
+def _reconstruct_rows(data_blocks: int, parity_blocks: int,
+                      present: list[int], wanted: list[int]) -> np.ndarray:
+    """Host-side GF solve shared by the psum and ring reconstructs."""
     from minio_tpu.ops import rs_kernels
     M = gf8.rs_matrix(data_blocks, data_blocks + parity_blocks)
-    rows = rs_kernels.decode_rows(M, data_blocks, list(present), list(wanted))
-    return distributed_apply(mesh, rows, surviving)
+    return rs_kernels.decode_rows(M, data_blocks, list(present),
+                                  list(wanted))
+
+
+def ring_reconstruct(mesh: Mesh, data_blocks: int, parity_blocks: int,
+                     surviving: np.ndarray, present: list[int],
+                     wanted: list[int]) -> jax.Array:
+    """distributed_reconstruct via the ppermute ring instead of psum."""
+    rows = _reconstruct_rows(data_blocks, parity_blocks, present, wanted)
+    M2 = jnp.asarray(gf8.gf2_expand(np.asarray(rows, dtype=np.uint8)),
+                     jnp.int8)
+    fn = _ring_apply(mesh, M2.shape[0], surviving.shape[1])
+    return fn(M2, jnp.asarray(surviving, dtype=jnp.uint8))

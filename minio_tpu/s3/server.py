@@ -1836,6 +1836,21 @@ def _make_handler(srv: S3Server):
             plain_size: int | None = None
             from .. import compress as mtc
             try:
+                if any(h in self.headers for h in
+                       ("If-Match", "If-None-Match", "If-Modified-Since",
+                        "If-Unmodified-Since")):
+                    # preconditions run on metadata BEFORE any data read
+                    # — a 304 revalidation must not decode the object
+                    oi_pre = srv.layer.get_object_info(bucket, key, opts)
+                    if not oi_pre.delete_marker and \
+                            self._preconditions_304(oi_pre):
+                        return self._send(
+                            304, b"",
+                            headers={"ETag":
+                                     f'"{self._display_etag(oi_pre)}"',
+                                     "Last-Modified":
+                                     _http_date(oi_pre.mod_time)},
+                            content_length=0)
                 if rng:
                     offset, length = _parse_range(rng)
                 if head or rng:
@@ -1979,6 +1994,52 @@ def _make_handler(srv: S3Server):
                     f"bytes {start}-{start + len(data) - 1}/{entity_size}"
                 return self._send(206, data, content_type=ct, headers=hdrs)
             return self._send(200, data, content_type=ct, headers=hdrs)
+
+        def _display_etag(self, oi) -> str:
+            """The etag clients see: archived stubs advertise the
+            original object's etag (META_ETAG), not the stub's."""
+            from ..objectlayer import tiering as _tr
+            if _tr.is_transitioned(oi.user_defined):
+                return oi.user_defined.get(_tr.META_ETAG, oi.etag)
+            return oi.etag
+
+        def _preconditions_304(self, oi) -> bool:
+            """Evaluate GET/HEAD preconditions (checkPreconditions,
+            cmd/object-handlers-common.go).  Raises 412 for failed
+            If-Match/If-Unmodified-Since; returns True when the response
+            must be 304 Not Modified."""
+            if_match = self.headers.get("If-Match")
+            if_none = self.headers.get("If-None-Match")
+            if_mod = self.headers.get("If-Modified-Since")
+            if_unmod = self.headers.get("If-Unmodified-Since")
+            etag = self._display_etag(oi)
+            # Last-Modified is second-granularity: compare truncated
+            # seconds or an echoed header spuriously fails
+            mod_s = oi.mod_time // 10 ** 9
+
+            def etag_in(header: str) -> bool:
+                tags = [t.strip().strip('"') for t in header.split(",")]
+                return "*" in tags or etag in tags
+
+            def parse_date(v: str) -> float | None:
+                try:
+                    return email.utils.parsedate_to_datetime(v).timestamp()
+                except (TypeError, ValueError):
+                    return None         # invalid dates are ignored
+
+            if if_match is not None and not etag_in(if_match):
+                raise S3Error("PreconditionFailed")
+            if if_match is None and if_unmod is not None:
+                t = parse_date(if_unmod)
+                if t is not None and mod_s > t:
+                    raise S3Error("PreconditionFailed")
+            if if_none is not None and etag_in(if_none):
+                return True
+            if if_none is None and if_mod is not None:
+                t = parse_date(if_mod)
+                if t is not None and mod_s <= t:
+                    return True
+            return False
 
         def _restore_object(self, bucket, key, query, payload):
             """PostRestoreObjectHandler: <RestoreRequest><Days>N</Days>

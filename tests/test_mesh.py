@@ -49,3 +49,23 @@ def test_distributed_reconstruct(devices):
     out = np.asarray(pmesh.distributed_reconstruct(
         m8, k, m, full[:, present, :], present, wanted))
     assert np.array_equal(out, full[:, wanted, :])
+
+
+def test_ring_reconstruct_matches_psum(devices):
+    mesh8 = pmesh.make_mesh(devices, stripe=2, shard=4)
+    # The ppermute ring all-reduce must agree with the psum path and
+    # the numpy oracle (SURVEY.md §5 ring layout).
+    k, m = 4, 2
+    rng = np.random.default_rng(11)
+    B, n = 4, 256
+    data = rng.integers(0, 256, (B, k, n)).astype(np.uint8)
+    parity = np.stack([gf8_ref.encode_parity(b, m) for b in data])
+    full = np.concatenate([data, parity], axis=1)
+    present = [1, 2, 4, 5]
+    wanted = [0, 3]
+    psum_out = np.asarray(pmesh.distributed_reconstruct(
+        mesh8, k, m, full[:, present, :], present, wanted))
+    ring_out = np.asarray(pmesh.ring_reconstruct(
+        mesh8, k, m, full[:, present, :], present, wanted))
+    assert np.array_equal(ring_out, psum_out)
+    assert np.array_equal(ring_out, full[:, wanted, :])

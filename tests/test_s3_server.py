@@ -247,3 +247,51 @@ def test_key_with_spaces_and_unicode(client):
         assert got.body == key.encode(), key
     objs, _ = client.list_objects("specialkeys")
     assert len(objs) == 3
+
+
+def test_conditional_get_preconditions(client):
+    """RFC 7232 preconditions (checkPreconditions,
+    cmd/object-handlers-common.go)."""
+    client.make_bucket("condb")
+    r = client.put_object("condb", "o", b"conditional body")
+    etag = r.headers["ETag"]
+
+    # If-None-Match hit -> 304 with no body
+    r = client.request("GET", "/condb/o", headers={"If-None-Match": etag},
+                       expect=(304,))
+    assert r.body == b"" and r.headers["ETag"] == etag
+
+    # If-None-Match miss -> 200
+    r = client.request("GET", "/condb/o",
+                       headers={"If-None-Match": '"deadbeef"'})
+    assert r.body == b"conditional body"
+
+    # If-Match hit -> 200; miss -> 412
+    client.request("GET", "/condb/o", headers={"If-Match": etag})
+    with pytest.raises(S3ClientError) as ei:
+        client.request("GET", "/condb/o",
+                       headers={"If-Match": '"deadbeef"'})
+    assert ei.value.status == 412
+
+    # If-Modified-Since in the future -> 304; in the past -> 200
+    r = client.request(
+        "GET", "/condb/o",
+        headers={"If-Modified-Since": "Fri, 01 Jan 2100 00:00:00 GMT"},
+        expect=(304,))
+    client.request(
+        "GET", "/condb/o",
+        headers={"If-Modified-Since": "Mon, 01 Jan 2001 00:00:00 GMT"})
+
+    # If-Unmodified-Since in the past -> 412
+    with pytest.raises(S3ClientError) as ei:
+        client.request(
+            "GET", "/condb/o",
+            headers={"If-Unmodified-Since": "Mon, 01 Jan 2001 00:00:00 GMT"})
+    assert ei.value.status == 412
+
+    # HEAD honors the same rules
+    client.request("HEAD", "/condb/o", headers={"If-None-Match": etag},
+                   expect=(304,))
+    # invalid dates are ignored (RFC: a recipient MUST ignore them)
+    client.request("GET", "/condb/o",
+                   headers={"If-Modified-Since": "not-a-date"})
