@@ -165,18 +165,18 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
             # madmin StorageInfo: per-drive capacity + online state —
             # same topology traversal as the metrics scrape
             disks = []
-            for d in metrics._collect_disks(srv.layer):
+            for si, d in metrics._collect_disks_with_set(srv.layer):
                 if d is None:
-                    disks.append({"state": "offline"})
+                    disks.append({"set": si, "state": "offline"})
                     continue
                 try:
                     info = d.disk_info()
                     disks.append({
-                        "endpoint": d.endpoint(), "state": "ok",
-                        "total": info.total, "used": info.used,
-                        "free": info.free})
+                        "set": si, "endpoint": d.endpoint(),
+                        "state": "ok", "total": info.total,
+                        "used": info.used, "free": info.free})
                 except Exception as e:  # noqa: BLE001
-                    disks.append({"endpoint": d.endpoint(),
+                    disks.append({"set": si, "endpoint": d.endpoint(),
                                   "state": "offline", "error": str(e)})
             return send_json({"disks": disks,
                               "backend": "erasure-tpu"}) or True

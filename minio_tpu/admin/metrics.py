@@ -89,9 +89,21 @@ def render(layer=None) -> str:
     return "\n".join(lines) + "\n"
 
 
-def _collect_disks(layer):
+def _collect_disks_with_set(layer):
+    """(set_index, disk) pairs across every topology shape; the set
+    index is global across pools."""
     if hasattr(layer, "pools"):
-        return [d for p in layer.pools for s in p.sets for d in s.disks]
+        out, si = [], 0
+        for p in layer.pools:
+            for s in p.sets:
+                out += [(si, d) for d in s.disks]
+                si += 1
+        return out
     if hasattr(layer, "sets"):
-        return [d for s in layer.sets for d in s.disks]
-    return list(layer.disks)
+        return [(si, d) for si, s in enumerate(layer.sets)
+                for d in s.disks]
+    return [(0, d) for d in layer.disks]
+
+
+def _collect_disks(layer):
+    return [d for _, d in _collect_disks_with_set(layer)]

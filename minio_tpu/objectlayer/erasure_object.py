@@ -93,6 +93,9 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         self._pool = ThreadPoolExecutor(max_workers=max(4, n))
         self._codec = Erasure(self.data_blocks, self.parity, block_size,
                               backend=backend) if self.parity > 0 else None
+        # per-storage-class codecs (x-amz-storage-class picks parity per
+        # object; geometry persists in each version's ErasureInfo)
+        self._codecs: dict[int, "Erasure"] = {}
         # MRF hook (cmd/erasure-object.go:1141 addPartial): a background
         # MRFQueue attaches here; post-quorum partial writes are enqueued
         self.mrf = None
@@ -135,6 +138,29 @@ class ErasureObjects(MultipartOps, ObjectLayer):
 
         out = list(self._pool.map(run, enumerate(shuffled_disks)))
         return [r for r, _ in out], [e for _, e in out]
+
+    def _geometry(self, parity_override: int | None) -> tuple[int, int]:
+        """(k, m) for a write: the layer default or a per-request parity
+        from the storage class (cmd/erasure-object.go:631-642)."""
+        n = len(self.disks)
+        if parity_override is None:
+            return self.data_blocks, self.parity
+        m = parity_override
+        if not 0 < m <= n // 2:
+            raise ValueError(f"parity {m} out of range for {n} drives")
+        return n - m, m
+
+    def _codec_for(self, parity: int) -> "Erasure":
+        """Codec for a parity count (cached; default reuses the layer's)."""
+        if parity == self.parity and self._codec is not None:
+            return self._codec
+        codec = self._codecs.get(parity)
+        if codec is None:
+            n = len(self.disks)
+            codec = Erasure(n - parity, parity, self.block_size,
+                            backend=self.backend)
+            self._codecs[parity] = codec
+        return codec
 
     def _write_quorum(self, fi: FileInfo | None = None) -> int:
         if fi is not None:
@@ -194,7 +220,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         opts = opts or PutObjectOptions()
         self._check_bucket(bucket)
         n = len(self.disks)
-        k, m = self.data_blocks, self.parity
+        k, m = self._geometry(opts.parity)
         etag = hashlib.md5(data).hexdigest()
         mod_time = opts.mod_time or now_ns()
         version_id = opts.version_id or (
@@ -214,14 +240,15 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             fresh=True)
 
         if m > 0:
-            shards = self._codec.encode_object(data)  # ONE device dispatch
+            codec = self._codec_for(m)
+            shards = codec.encode_object(data)      # ONE device dispatch
         else:
             shards = [np.frombuffer(data, dtype=np.uint8)]
         # bitrot digests fuse onto the device when the codec runs there:
         # parity + per-block HighwayHash from one pipeline (ops/hh_kernels)
         framed = bitrot.streaming_encode_batch(
             shards, fi.erasure.shard_size(), self.bitrot_algo,
-            use_device=(m > 0 and self._codec.backend == "tpu"))
+            use_device=(m > 0 and codec.backend == "tpu"))
 
         inline = size <= self.inline_threshold
         shuffled = meta.shuffle_disks(self.disks, distribution)
@@ -393,11 +420,14 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         tail = part_size - nfull * bs
         missing_data = [i for i in range(k) if shards[i] is None]
         if missing_data:
-            if self._codec is None:
+            if m <= 0:
                 raise ReadQuorumError("no parity to reconstruct from")
+            # the OBJECT's persisted geometry picks the matrix — a
+            # storage-class parity differs from the layer default
+            codec = self._codec_for(m)
             present = [i for i in range(k + m) if shards[i] is not None][:k]
             sfsize = fi.erasure.shard_file_size(part_size)
-            mat = self._codec.matrix
+            mat = codec.matrix
             from ..ops import rs_kernels
             rows = rs_kernels.decode_rows(mat, k, present, missing_data)
             rebuilt_full = None
@@ -407,7 +437,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 surv = np.stack([shards[i][: nfull * ssize]
                                  .reshape(nfull, ssize) for i in present],
                                 axis=1)  # (nfull, k, ssize)
-                if self._codec.backend == "tpu":
+                if codec.backend == "tpu":
                     rebuilt_full = rs_kernels.apply_matrix(rows, surv)
                 else:
                     rebuilt_full = np.stack(
@@ -418,7 +448,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 surv_t = np.stack(
                     [shards[i][nfull * ssize: nfull * ssize + t_ssize]
                      for i in present])  # (k, t_ssize)
-                if self._codec.backend == "tpu":
+                if codec.backend == "tpu":
                     rebuilt_tail = rs_kernels.apply_matrix(rows, surv_t)
                 else:
                     rebuilt_tail = gf8.gf_matmul(rows, surv_t)

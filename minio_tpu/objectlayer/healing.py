@@ -231,13 +231,15 @@ def _reconstruct_shards(er: ErasureObjects, fi: FileInfo, present: list[int],
     nfull = part_size // bs
     tail = part_size - nfull * bs
     sfsize = fi.erasure.shard_file_size(part_size)
-    mat = er._codec.matrix
-    rows = rs_kernels.decode_rows(mat, k, present, wanted)
+    # matrix for the OBJECT's geometry: storage-class parity may differ
+    # from the layer default
+    codec = er._codec_for(fi.erasure.parity_blocks)
+    rows = rs_kernels.decode_rows(codec.matrix, k, present, wanted)
     outs = [np.empty(sfsize, dtype=np.uint8) for _ in wanted]
     if nfull:
         surv = np.stack([s[: nfull * ssize].reshape(nfull, ssize)
                          for s in surviving], axis=1)
-        if er._codec.backend == "tpu":
+        if codec.backend == "tpu":
             reb = rs_kernels.apply_matrix(rows, surv)
         else:
             reb = np.stack([gf8.gf_matmul(rows, surv[b])
@@ -248,7 +250,7 @@ def _reconstruct_shards(er: ErasureObjects, fi: FileInfo, present: list[int],
         t_ssize = gf8.ceil_frac(tail, k)
         surv_t = np.stack([s[nfull * ssize: nfull * ssize + t_ssize]
                            for s in surviving])
-        reb_t = gf8.gf_matmul(rows, surv_t) if er._codec.backend != "tpu" \
+        reb_t = gf8.gf_matmul(rows, surv_t) if codec.backend != "tpu" \
             else rs_kernels.apply_matrix(rows, surv_t)
         for j in range(len(wanted)):
             outs[j][nfull * ssize:] = reb_t[j]

@@ -129,6 +129,9 @@ class PutObjectOptions:
     versioned: bool = False
     version_id: str = ""
     mod_time: int = 0
+    # per-request parity from x-amz-storage-class (cmd/erasure-object.go:631
+    # applying cmd/config/storageclass); None = the layer's default
+    parity: Optional[int] = None
 
 
 @dataclass

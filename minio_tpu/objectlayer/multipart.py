@@ -63,6 +63,7 @@ class MultipartOps:
         mp = self._mp_dir(bucket, object_name, upload_id)
         distribution = meta.hash_order(f"{bucket}/{object_name}",
                                        len(self.disks))
+        k, m = self._geometry(opts.parity)
         fi = FileInfo(
             volume=bucket, name=object_name, version_id="",
             data_dir=str(uuid.uuid4()), mod_time=now_ns(),
@@ -70,7 +71,7 @@ class MultipartOps:
                       "__versioned": "1" if opts.versioned else "0",
                       "__bucket": bucket, "__object": object_name},
             erasure=ErasureInfo(
-                data_blocks=self.data_blocks, parity_blocks=self.parity,
+                data_blocks=k, parity_blocks=m,
                 block_size=self.block_size, distribution=distribution))
 
         def init_one(idx_disk):
@@ -108,8 +109,11 @@ class MultipartOps:
         etag = hashlib.md5(data).hexdigest()
         size = len(data)
 
-        if self.parity > 0:
-            shards = self._codec.encode_object(data)
+        # the upload's persisted geometry wins: a storage-class parity
+        # chosen at initiate applies to every part
+        if fi.erasure.parity_blocks > 0:
+            shards = self._codec_for(
+                fi.erasure.parity_blocks).encode_object(data)
         else:
             import numpy as np
             shards = [np.frombuffer(data, dtype=np.uint8)]

@@ -82,6 +82,9 @@ _ERRORS = {
         "or completed.", 404),
     "NoSuchVersion": APIError(
         "NoSuchVersion", "The specified version does not exist.", 404),
+    "InvalidStorageClass": APIError(
+        "InvalidStorageClass", "The storage class you specified is not "
+        "valid", 400),
     "InvalidObjectState": APIError(
         "InvalidObjectState", "The operation is not valid for the "
         "object's storage class", 403),

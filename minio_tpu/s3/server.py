@@ -1488,7 +1488,8 @@ def _make_handler(srv: S3Server):
             versioned = srv.bucket_meta.versioning_enabled(bucket)
             uid = srv.layer.new_multipart_upload(
                 bucket, key, ol.PutObjectOptions(
-                    user_defined=user_defined, versioned=versioned))
+                    user_defined=user_defined, versioned=versioned,
+                    parity=self._storage_class_parity(user_defined)))
             root = ET.Element("InitiateMultipartUploadResult", xmlns=S3_NS)
             ET.SubElement(root, "Bucket").text = bucket
             ET.SubElement(root, "Key").text = key
@@ -1617,8 +1618,9 @@ def _make_handler(srv: S3Server):
                 payload = enc.encrypt(payload)
             oi = srv.layer.put_object(
                 bucket, key, payload,
-                ol.PutObjectOptions(user_defined=user_defined,
-                                    versioned=versioned))
+                ol.PutObjectOptions(
+                    user_defined=user_defined, versioned=versioned,
+                    parity=self._storage_class_parity(user_defined)))
             if tiered_ud is not None:
                 srv.transition.delete_tiered(tiered_ud)
             hdrs = {"ETag": f'"{oi.etag}"'}
@@ -1836,6 +1838,7 @@ def _make_handler(srv: S3Server):
             plain_size: int | None = None
             from .. import compress as mtc
             try:
+                oi_pre = None
                 if any(h in self.headers for h in
                        ("If-Match", "If-None-Match", "If-Modified-Since",
                         "If-Unmodified-Since")):
@@ -1857,7 +1860,8 @@ def _make_handler(srv: S3Server):
                     # metadata first: a range is in client (decompressed/
                     # decrypted) space — fetching stored bytes at those
                     # offsets would decode data that gets thrown away
-                    oi = srv.layer.get_object_info(bucket, key, opts)
+                    oi = oi_pre if oi_pre is not None else \
+                        srv.layer.get_object_info(bucket, key, opts)
                     data = None
                     from ..objectlayer import tiering as _tchk
                     if rng and not head and \
@@ -1965,6 +1969,10 @@ def _make_handler(srv: S3Server):
                 rh = _tr.restore_header(oi.user_defined)
                 if rh:
                     hdrs[_tr.RESTORE_HDR] = rh
+            elif oi.user_defined.get("x-amz-storage-class"):
+                # RRS objects report their class (AWS omits STANDARD)
+                hdrs["x-amz-storage-class"] = \
+                    oi.user_defined["x-amz-storage-class"]
             hdrs.update(sse_hdrs)
             if oi.version_id:
                 hdrs["x-amz-version-id"] = oi.version_id
@@ -1994,6 +2002,29 @@ def _make_handler(srv: S3Server):
                     f"bytes {start}-{start + len(data) - 1}/{entity_size}"
                 return self._send(206, data, content_type=ct, headers=hdrs)
             return self._send(200, data, content_type=ct, headers=hdrs)
+
+        def _storage_class_parity(self, user_defined: dict) -> int | None:
+            """x-amz-storage-class -> parity override via the
+            storage_class config subsystem (cmd/config/storageclass
+            applied at cmd/erasure-object.go:631).  Also records RRS in
+            metadata so HEAD reports it (AWS omits STANDARD)."""
+            sc = self.headers.get("x-amz-storage-class", "").upper()
+            if sc in ("", "STANDARD"):
+                value = srv.config.get("storage_class", "standard")
+            elif sc == "REDUCED_REDUNDANCY":
+                value = srv.config.get("storage_class", "rrs")
+                user_defined["x-amz-storage-class"] = sc
+            else:
+                raise S3Error("InvalidStorageClass")
+            n = getattr(srv.layer, "set_drive_count", 0) or \
+                len(getattr(srv.layer, "disks", []) or [])
+            if not value or not n:
+                return None
+            from ..utils.kvconfig import parse_storage_class
+            try:
+                return parse_storage_class(value, n)
+            except ValueError as e:
+                raise S3Error("InvalidStorageClass") from e
 
         def _display_etag(self, oi) -> str:
             """The etag clients see: archived stubs advertise the

@@ -197,6 +197,8 @@ def parse_storage_class(value: str, drive_count: int) -> int | None:
     if not value.startswith("EC:"):
         raise ValueError(f"invalid storage class {value!r}")
     parity = int(value[3:])
-    if parity < 0 or parity > drive_count // 2:
+    # parity 0 (no redundancy) is not a supported erasure geometry here:
+    # the write path stripes data assuming at least one parity shard
+    if parity < 1 or parity > drive_count // 2:
         raise ValueError(f"parity {parity} out of range")
     return parity

@@ -1,0 +1,148 @@
+"""Per-request storage-class parity (cmd/config/storageclass applied at
+cmd/erasure-object.go:631-642): REDUCED_REDUNDANCY selects the rrs EC
+config; geometry persists per version and drives reads/heal.
+"""
+
+import pytest
+
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.objectlayer.interface import PutObjectOptions
+from minio_tpu.s3.client import S3Client, S3ClientError
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl_storage import XLStorage
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("scdrives")
+    disks = []
+    for i in range(6):
+        d = tmp / f"disk{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, block_size=64 * 1024, backend="numpy")
+    assert layer.parity == 3            # default for 6 drives
+    srv = S3Server(layer, access_key="sck", secret_key="scs")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def client(server):
+    c = S3Client(server.endpoint, "sck", "scs")
+    if not c.head_bucket("scb"):
+        c.make_bucket("scb")
+    return c
+
+
+def test_layer_parity_override(server):
+    layer = server.layer
+    layer.make_bucket("lvl")
+    layer.put_object("lvl", "rrs", b"r" * 9000,
+                     PutObjectOptions(parity=2))
+    oi = layer.get_object_info("lvl", "rrs")
+    assert oi.parity == 2 and oi.data_blocks == 4
+    # default geometry untouched
+    layer.put_object("lvl", "std", b"s" * 9000)
+    oi = layer.get_object_info("lvl", "std")
+    assert oi.parity == 3 and oi.data_blocks == 3
+    # both decode after 2 drive losses (rrs tolerates exactly 2)
+    dead0, dead1 = layer.disks[0], layer.disks[1]
+    layer.disks[0] = layer.disks[1] = None
+    try:
+        assert layer.get_object("lvl", "rrs")[1] == b"r" * 9000
+        assert layer.get_object("lvl", "std")[1] == b"s" * 9000
+    finally:
+        layer.disks[0], layer.disks[1] = dead0, dead1
+
+
+def test_rrs_degraded_read_all_failure_pairs(server):
+    """Reconstruction must use the OBJECT's geometry: every two-disk
+    failure pair decodes an RRS (k=4,m=2) object on a default k=3,m=3
+    layer."""
+    import itertools
+    layer = server.layer
+    layer.make_bucket("pairs")
+    body = b"pairwise " * 800
+    layer.put_object("pairs", "rr", body, PutObjectOptions(parity=2))
+    saved = list(layer.disks)
+    try:
+        for a, b in itertools.combinations(range(6), 2):
+            layer.disks = list(saved)
+            layer.disks[a] = layer.disks[b] = None
+            got = layer.get_object("pairs", "rr")[1]
+            assert got == body, f"failed for dead pair ({a},{b})"
+    finally:
+        layer.disks = saved
+
+
+def test_rrs_object_heals(server):
+    import os
+    import shutil
+    layer = server.layer
+    layer.make_bucket("healsc")
+    body = b"heal me with custom parity " * 300
+    layer.put_object("healsc", "rr", body, PutObjectOptions(parity=2))
+    # wipe the object's files from one drive, then heal
+    root = layer.disks[2].root if hasattr(layer.disks[2], "root") else None
+    assert root is not None
+    shutil.rmtree(os.path.join(root, "healsc"), ignore_errors=True)
+    r = layer.heal_object("healsc", "rr")
+    assert r.after_ok == 6, r
+    assert layer.get_object("healsc", "rr")[1] == body
+
+
+def test_layer_parity_bounds(server):
+    layer = server.layer
+    layer.make_bucket("bnd")
+    with pytest.raises(ValueError, match="out of range"):
+        layer.put_object("bnd", "x", b"x", PutObjectOptions(parity=4))
+
+
+def test_rrs_over_api(server, client):
+    client.put_object("scb", "rr-obj", b"reduced " * 1000)
+    # standard PUT: no storage-class header in response
+    h = client.head_object("scb", "rr-obj")
+    assert "x-amz-storage-class" not in {k.lower() for k in h.headers}
+
+    r = client.request("PUT", "/scb/rr2", body=b"reduced " * 1000,
+                       headers={"x-amz-storage-class":
+                                "REDUCED_REDUNDANCY"})
+    assert r.status == 200
+    h = client.head_object("scb", "rr2")
+    hl = {k.lower(): v for k, v in h.headers.items()}
+    assert hl["x-amz-storage-class"] == "REDUCED_REDUNDANCY"
+    oi = server.layer.get_object_info("scb", "rr2")
+    assert oi.parity == 2               # rrs default EC:2
+    assert client.get_object("scb", "rr2").body == b"reduced " * 1000
+
+
+def test_invalid_storage_class_rejected(client):
+    with pytest.raises(S3ClientError) as ei:
+        client.request("PUT", "/scb/bad", body=b"x",
+                       headers={"x-amz-storage-class": "GLACIER_IR"})
+    assert ei.value.code == "InvalidStorageClass"
+
+
+def test_rrs_multipart(server, client):
+    uid = client.create_multipart_upload(
+        "scb", "mp-rrs",
+        headers={"x-amz-storage-class": "REDUCED_REDUNDANCY"})
+    e1 = client.upload_part("scb", "mp-rrs", uid, 1, b"P" * (5 << 20))
+    e2 = client.upload_part("scb", "mp-rrs", uid, 2, b"Q" * 2048)
+    client.complete_multipart_upload("scb", "mp-rrs", uid,
+                                     [(1, e1), (2, e2)])
+    oi = server.layer.get_object_info("scb", "mp-rrs")
+    assert oi.parity == 2
+    body = client.get_object("scb", "mp-rrs").body
+    assert len(body) == (5 << 20) + 2048 and body[-1:] == b"Q"
+
+
+def test_standard_config_override(server, client, monkeypatch):
+    """storage_class.standard=EC:2 changes the default parity for
+    unclassified PUTs (MINIO_STORAGE_CLASS_STANDARD)."""
+    monkeypatch.setenv("MT_STORAGE_CLASS_STANDARD", "EC:2")
+    client.put_object("scb", "std-ec2", b"z" * 4096)
+    oi = server.layer.get_object_info("scb", "std-ec2")
+    assert oi.parity == 2
