@@ -1,0 +1,188 @@
+"""Admin client SDK — the pkg/madmin analog.
+
+A typed wrapper over the admin REST API (`/minio-tpu/admin/v1/...`),
+SigV4-signed like every madmin call.  Operators and tooling use this
+instead of hand-building signed requests; the test suite doubles as its
+conformance suite.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from ..s3.client import S3Client, S3ClientError
+
+__all__ = ["AdminClient", "AdminError"]
+
+
+class AdminError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"{status}: {message}")
+        self.status = status
+
+
+class AdminClient:
+    """madmin.AdminClient equivalent over our S3Client transport."""
+
+    PREFIX = "/minio-tpu/admin/v1"
+
+    def __init__(self, endpoint: str, access_key: str, secret_key: str,
+                 region: str = "us-east-1"):
+        self._c = S3Client(endpoint, access_key, secret_key, region)
+
+    def _call(self, method: str, route: str, query: str = "",
+              body: bytes = b"", expect=(200,)) -> Any:
+        try:
+            r = self._c.request(method, f"{self.PREFIX}/{route}", query,
+                                body, expect=expect)
+        except S3ClientError as e:
+            raise AdminError(e.status, str(e)) from e
+        if not r.body:
+            return None
+        try:
+            return json.loads(r.body)
+        except json.JSONDecodeError:
+            return r.body
+
+    # -- server ------------------------------------------------------------
+
+    def server_info(self) -> dict:
+        return self._call("GET", "info")
+
+    def storage_info(self) -> dict:
+        return self._call("GET", "storageinfo")
+
+    def data_usage_info(self) -> dict:
+        return self._call("GET", "datausageinfo")
+
+    def health_info(self) -> dict:
+        return self._call("GET", "healthinfo")
+
+    def service_stop(self) -> dict:
+        return self._call("POST", "service", "action=stop")
+
+    def service_restart(self) -> dict:
+        return self._call("POST", "service", "action=restart")
+
+    def top_locks(self) -> list[dict]:
+        return self._call("GET", "top-locks")["locks"]
+
+    # -- config ------------------------------------------------------------
+
+    def get_config_kv(self, subsys: str) -> dict:
+        return self._call("GET", f"config/{subsys}")
+
+    def set_config_kv(self, subsys: str, key: str, value: str) -> None:
+        self._call("PUT", f"config/{subsys}/{key}", body=value.encode())
+
+    # -- identity ----------------------------------------------------------
+
+    def add_user(self, access_key: str, secret_key: str,
+                 policies: Optional[list[str]] = None) -> None:
+        self._call("POST", "add-user", body=json.dumps(
+            {"accessKey": access_key, "secretKey": secret_key,
+             "policies": policies or []}).encode())
+
+    def remove_user(self, access_key: str) -> None:
+        self._call("POST", "remove-user", f"accessKey={access_key}")
+
+    def list_users(self) -> dict:
+        return self._call("GET", "list-users")
+
+    def set_user_status(self, access_key: str, enabled: bool) -> None:
+        self._call("POST", "set-user-status",
+                   f"accessKey={access_key}&status="
+                   f"{'enabled' if enabled else 'disabled'}")
+
+    def set_user_policy(self, access_key: str,
+                        policies: list[str]) -> None:
+        self._call("POST", "set-user-policy",
+                   f"accessKey={access_key}&policies="
+                   f"{','.join(policies)}")
+
+    def add_service_account(self, parent: str,
+                            access_key: Optional[str] = None,
+                            secret_key: Optional[str] = None) -> dict:
+        doc = {"parent": parent}
+        if access_key:
+            doc["accessKey"] = access_key
+        if secret_key:
+            doc["secretKey"] = secret_key
+        return self._call("POST", "add-service-account",
+                          body=json.dumps(doc).encode())
+
+    def list_service_accounts(self,
+                              parent: Optional[str] = None) -> dict:
+        return self._call("GET", "list-service-accounts",
+                          f"parent={parent}" if parent else "")
+
+    def delete_service_account(self, access_key: str) -> None:
+        self._call("POST", "delete-service-account",
+                   f"accessKey={access_key}")
+
+    def list_groups(self) -> dict:
+        return self._call("GET", "list-groups")
+
+    def add_user_to_group(self, access_key: str, group: str) -> None:
+        self._call("POST", "add-user-to-group",
+                   f"accessKey={access_key}&group={group}")
+
+    def set_group_policy(self, group: str, policies: list[str]) -> None:
+        self._call("POST", "set-group-policy", body=json.dumps(
+            {"group": group, "policies": policies}).encode())
+
+    # -- policies ----------------------------------------------------------
+
+    def list_policies(self) -> Any:
+        return self._call("GET", "policy")
+
+    def get_policy(self, name: str) -> dict:
+        return self._call("GET", f"policy/{name}")
+
+    def add_policy(self, name: str, policy_doc: dict) -> None:
+        self._call("PUT", f"policy/{name}",
+                   body=json.dumps(policy_doc).encode())
+
+    def remove_policy(self, name: str) -> None:
+        self._call("DELETE", f"policy/{name}", expect=(200, 204))
+
+    # -- heal / replication / tiers ----------------------------------------
+
+    def heal(self, bucket: str, prefix: str = "", deep: bool = False,
+             remove: bool = False) -> dict:
+        q = []
+        if deep:
+            q.append("scan=deep")
+        if remove:
+            q.append("remove=true")
+        route = f"heal/{bucket}" + (f"/{prefix}" if prefix else "")
+        return self._call("POST", route, "&".join(q))
+
+    def heal_status(self) -> dict:
+        return self._call("GET", "heal-status")
+
+    def replication_stats(self) -> dict:
+        return self._call("GET", "replication-stats")
+
+    def set_bandwidth_limit(self, bucket: str, limit: int) -> None:
+        self._call("POST", "set-bandwidth-limit",
+                   f"bucket={bucket}&limit={limit}")
+
+    def list_tiers(self) -> list[dict]:
+        return self._call("GET", "tier")
+
+    def add_tier(self, config: dict) -> None:
+        self._call("PUT", "tier", body=json.dumps(config).encode())
+
+    def get_bucket_quota(self, bucket: str) -> dict:
+        return self._call("GET", "get-bucket-quota", f"bucket={bucket}")
+
+    def set_bucket_quota(self, bucket: str, quota: int,
+                         quota_type: str = "hard") -> None:
+        self._call("POST", "set-bucket-quota", f"bucket={bucket}",
+                   json.dumps({"quota": quota,
+                               "quotatype": quota_type}).encode())
+
+    def kms_key_status(self) -> dict:
+        return self._call("GET", "kms-key-status")

@@ -423,7 +423,18 @@ def _config(h, srv, route, q1, payload, send_json) -> bool:
     if h.command == "GET" and len(parts) == 2:
         return send_json(cfg.get_subsys(parts[1])) or True
     if h.command == "PUT" and len(parts) == 3:
-        cfg.set(parts[1], parts[2], payload.decode())
+        value = payload.decode()
+        if parts[1] == "storage_class" and value:
+            # validate EC:N against the deployment's set size NOW, not
+            # on every later PUT (a bad value would brick writes)
+            from ..s3.server import _layer_set_drive_count
+            from ..utils.kvconfig import parse_storage_class
+            n = _layer_set_drive_count(srv.layer)
+            try:
+                parse_storage_class(value, n or 16)
+            except ValueError as e:
+                return send_json({"error": str(e)}, 400) or True
+        cfg.set(parts[1], parts[2], value)
         return send_json({"status": "ok"}) or True
     from ..s3.server import S3Error
     raise S3Error("MethodNotAllowed")

@@ -12,6 +12,7 @@ this carries shard files, metadata, and lock traffic between hosts.
 from __future__ import annotations
 
 import hashlib
+import socket
 import hmac
 import threading
 import time
@@ -134,10 +135,58 @@ class RPCServer:
         return Handler
 
 
+class DynamicTimeout:
+    """Adaptive deadline from observed latencies
+    (cmd/dynamic-timeouts.go:35 dynamicTimeout): successes shrink the
+    timeout toward what the link actually needs, timeouts grow it, both
+    bounded — slow-but-alive peers stop flapping offline while dead
+    peers are detected quickly."""
+
+    def __init__(self, initial: float = 30.0, minimum: float = 1.0,
+                 maximum: float = 120.0, window: int = 16):
+        self.minimum = minimum
+        self.maximum = maximum
+        self.window = window
+        self._timeout = initial
+        self._samples: list[float] = []
+        self._mu = threading.Lock()
+
+    def timeout(self) -> float:
+        with self._mu:
+            return self._timeout
+
+    def log_success(self, duration: float) -> None:
+        with self._mu:
+            self._samples.append(duration)
+            if len(self._samples) < self.window:
+                return
+            # size the deadline at 4x the worst recent success, decayed
+            # toward it (the reference adjusts by percentile per window)
+            target = max(self.minimum, 4.0 * max(self._samples))
+            self._timeout = min(self.maximum,
+                                0.5 * self._timeout + 0.5 * target)
+            self._samples.clear()
+
+    def log_failure(self) -> None:
+        with self._mu:
+            # a timeout means the deadline was too tight (or the peer is
+            # gone): back off multiplicatively, bounded
+            self._timeout = min(self.maximum, self._timeout * 1.5)
+            self._samples.clear()
+
+
 class RPCClient:
     """Health-checked client to one peer node
     (cmd/storage-rest-client.go:651 pattern: a failed call marks the peer
-    offline; a background or next-use probe brings it back)."""
+    offline; a background or next-use probe brings it back).  Deadlines
+    adapt to observed latencies via DynamicTimeout."""
+
+    # per-service deadline floors: bulk storage transfers legitimately
+    # run seconds while lock/ping calls are milliseconds — one shared
+    # tracker would let fast calls starve slow ones (the reference keys
+    # dynamicTimeout per operation class for the same reason)
+    _SERVICE_MIN = {"storage": 10.0}
+    _DEFAULT_MIN = 1.0
 
     def __init__(self, endpoint: str, secret: str, timeout: float = 30.0):
         u = urllib.parse.urlsplit(endpoint)
@@ -145,9 +194,19 @@ class RPCClient:
         self.endpoint = endpoint
         self.secret = secret
         self.timeout = timeout
+        self._dyn: dict[str, DynamicTimeout] = {}
         self._online = True
         self._last_failure = 0.0
         self._retry_after = 3.0
+
+    def _dyn_for(self, service: str) -> DynamicTimeout:
+        dt = self._dyn.get(service)
+        if dt is None:
+            dt = DynamicTimeout(
+                initial=self.timeout,
+                minimum=self._SERVICE_MIN.get(service, self._DEFAULT_MIN))
+            self._dyn[service] = dt
+        return dt
 
     def is_online(self) -> bool:
         if not self._online and \
@@ -160,20 +219,30 @@ class RPCClient:
             raise RPCError("PeerOffline", self.endpoint)
         path = f"/rpc/{service}/{method}"
         body = msgpack.packb(kwargs, use_bin_type=True)
-        conn = http.client.HTTPConnection(self.host, self.port,
-                                          timeout=self.timeout)
+        dyn = self._dyn_for(service)
+        conn = http.client.HTTPConnection(
+            self.host, self.port, timeout=dyn.timeout())
+        start = time.monotonic()
         try:
             conn.request("POST", path, body=body, headers={
                 "Authorization": f"Bearer {mint_token(self.secret, path)}",
                 "Content-Type": "application/msgpack"})
             resp = conn.getresponse()
             doc = msgpack.unpackb(resp.read(), raw=False)
+        except socket.timeout as e:
+            # only an actual deadline expiry carries a latency signal;
+            # instant errors (refused/reset) must not inflate deadlines
+            self._online = False
+            self._last_failure = time.time()
+            dyn.log_failure()
+            raise RPCError("ConnectionError", str(e)) from e
         except (OSError, http.client.HTTPException) as e:
             self._online = False
             self._last_failure = time.time()
             raise RPCError("ConnectionError", str(e)) from e
         finally:
             conn.close()
+        dyn.log_success(time.monotonic() - start)
         if not doc.get("ok"):
             raise RPCError(doc.get("error_type", "Unknown"),
                            doc.get("message", ""))

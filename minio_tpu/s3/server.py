@@ -199,6 +199,18 @@ class S3Server:
             self.replication.queue(bucket, oi, delete=delete)
 
 
+def _layer_set_drive_count(layer) -> int:
+    """Drives per erasure set for any topology shape (storage-class
+    parity is bounded by the SET size, not total drives)."""
+    n = getattr(layer, "set_drive_count", 0)
+    if n:
+        return n
+    pools = getattr(layer, "pools", None)
+    if pools:
+        return getattr(pools[0], "set_drive_count", 0)
+    return len(getattr(layer, "disks", []) or [])
+
+
 def _api_name(method: str, bucket: str, key: str, q1: dict) -> str:
     """Best-effort S3 API name for traces/audit (the reference names come
     from mux route registration, cmd/api-router.go)."""
@@ -1745,8 +1757,9 @@ def _make_handler(srv: S3Server):
             versioned = srv.bucket_meta.versioning_enabled(bucket)
             oi = srv.layer.put_object(
                 bucket, key, data,
-                ol.PutObjectOptions(user_defined=user_defined,
-                                    versioned=versioned))
+                ol.PutObjectOptions(
+                    user_defined=user_defined, versioned=versioned,
+                    parity=self._storage_class_parity(user_defined)))
             root = ET.Element("CopyObjectResult", xmlns=S3_NS)
             ET.SubElement(root, "ETag").text = f'"{oi.etag}"'
             ET.SubElement(root, "LastModified").text = _iso_date(oi.mod_time)
@@ -2009,22 +2022,28 @@ def _make_handler(srv: S3Server):
             applied at cmd/erasure-object.go:631).  Also records RRS in
             metadata so HEAD reports it (AWS omits STANDARD)."""
             sc = self.headers.get("x-amz-storage-class", "").upper()
-            if sc in ("", "STANDARD"):
+            explicit = sc not in ("", "STANDARD")
+            if not explicit:
                 value = srv.config.get("storage_class", "standard")
             elif sc == "REDUCED_REDUNDANCY":
                 value = srv.config.get("storage_class", "rrs")
-                user_defined["x-amz-storage-class"] = sc
             else:
                 raise S3Error("InvalidStorageClass")
-            n = getattr(srv.layer, "set_drive_count", 0) or \
-                len(getattr(srv.layer, "disks", []) or [])
+            n = _layer_set_drive_count(srv.layer)
             if not value or not n:
                 return None
             from ..utils.kvconfig import parse_storage_class
             try:
-                return parse_storage_class(value, n)
+                parity = parse_storage_class(value, n)
             except ValueError as e:
-                raise S3Error("InvalidStorageClass") from e
+                if explicit:
+                    # the client asked for this class: tell them
+                    raise S3Error("InvalidStorageClass") from e
+                # bad *config* must not fail clients who sent no header
+                return None
+            if explicit:
+                user_defined["x-amz-storage-class"] = sc
+            return parity
 
         def _display_etag(self, oi) -> str:
             """The etag clients see: archived stubs advertise the

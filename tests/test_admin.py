@@ -219,3 +219,62 @@ def test_service_accounts_admin(client):
 def test_service_action_validation(client):
     r = _admin(client, "POST", "service", "action=bogus", expect=(400,))
     assert b"unknown action" in r.body
+
+
+def test_admin_client_sdk(server, tmp_path):
+    """pkg/madmin analog: the typed AdminClient drives the same routes."""
+    from minio_tpu.admin.client import AdminClient, AdminError
+    adm = AdminClient(server.endpoint, "admin", "adminpw")
+
+    info = adm.server_info()
+    assert info["mode"] == "distributed-erasure-tpu"
+    st = adm.storage_info()
+    assert len(st["disks"]) == 4
+
+    adm.add_user("sdkuser", "sdkusersecret")
+    assert "sdkuser" in adm.list_users()
+    adm.set_user_policy("sdkuser", ["readonly"])
+    adm.set_user_status("sdkuser", False)
+    assert adm.list_users()["sdkuser"]["status"] == "disabled"
+
+    sa = adm.add_service_account("sdkuser")
+    assert sa["accessKey"] in adm.list_service_accounts()
+    adm.delete_service_account(sa["accessKey"])
+    adm.remove_user("sdkuser")
+
+    adm.set_group_policy("sdkgrp", ["readwrite"])
+    assert adm.list_groups()["sdkgrp"] == ["readwrite"]
+
+    adm.add_policy("sdk-pol", {
+        "Version": "2012-10-17",
+        "Statement": [{"Effect": "Allow", "Action": ["s3:GetObject"],
+                       "Resource": ["arn:aws:s3:::*"]}]})
+    assert "sdk-pol" in adm.list_policies()["policies"]
+    assert adm.get_policy("sdk-pol")["Statement"]
+    adm.remove_policy("sdk-pol")
+
+    adm.set_config_kv("scanner", "delay", "20")
+    assert adm.get_config_kv("scanner")["delay"] == "20"
+
+    adm.add_tier({"type": "dir", "name": "SDKTIER",
+                  "path": str(tmp_path / "sdktier")})
+    assert any(t["name"] == "SDKTIER" for t in adm.list_tiers())
+    with pytest.raises(AdminError) as ei:
+        adm.add_tier({"type": "dir", "name": "SDKTIER",
+                      "path": str(tmp_path / "sdktier")})
+    assert ei.value.status == 409
+
+    assert adm.kms_key_status()["encryption_ok"]
+    assert adm.top_locks() == []
+    assert adm.heal_status() is not None
+
+
+def test_admin_client_heal(server):
+    from minio_tpu.admin.client import AdminClient
+    adm = AdminClient(server.endpoint, "admin", "adminpw")
+    c = S3Client(server.endpoint, "admin", "adminpw")
+    if not c.head_bucket("sdkheal"):
+        c.make_bucket("sdkheal")
+    c.put_object("sdkheal", "obj", b"heal sdk")
+    rep = adm.heal("sdkheal")
+    assert rep["objects"][0]["after_ok"] == 4

@@ -195,3 +195,30 @@ def test_cluster_distributed_lock_exclusion(cluster):
     l0.unlock()
     l1.lock(write=True, timeout=2.0)
     l1.unlock()
+
+
+def test_dynamic_timeout_adapts():
+    """cmd/dynamic-timeouts.go analog: successes shrink the deadline
+    toward observed latency, failures grow it, both bounded."""
+    from minio_tpu.parallel.rpc import DynamicTimeout, RPCClient
+    dt = DynamicTimeout(initial=30.0, minimum=1.0, maximum=120.0,
+                        window=4)
+    for _ in range(16):                      # fast link: 50ms calls
+        dt.log_success(0.05)
+    assert dt.timeout() < 10.0               # shrank toward 4x observed
+    fast = dt.timeout()
+    for _ in range(20):
+        dt.log_failure()
+    assert dt.timeout() == 120.0             # grew to the bound
+    for _ in range(64):
+        dt.log_success(0.05)
+    assert dt.timeout() < 10.0               # recovers after failures
+    assert dt.timeout() >= 1.0
+    del fast
+    # per-service trackers: storage keeps a higher floor than lock/ping
+    c = RPCClient("http://127.0.0.1:1", "s")
+    for _ in range(64):
+        c._dyn_for("storage").log_success(0.01)
+        c._dyn_for("lock").log_success(0.01)
+    assert c._dyn_for("storage").timeout() >= 10.0
+    assert c._dyn_for("lock").timeout() < 10.0
