@@ -1088,6 +1088,19 @@ def _make_handler(srv: S3Server):
                 except (BrokenPipeError, ConnectionResetError):
                     pass
 
+        def _encoding_type(self, q1):
+            """encoding-type handling shared by every listing API:
+            returns (escape_fn, enabled).  Keys may contain characters
+            XML 1.0 cannot carry; url encoding (the awscli/boto3
+            default) percent-encodes them in responses."""
+            enc = q1.get("encoding-type", "")
+            if enc and enc != "url":
+                raise S3Error("InvalidArgument")
+            if enc:
+                return (lambda s: urllib.parse.quote(s or "", safe="/"),
+                        True)
+            return (lambda s: s), False
+
         def _list_objects(self, bucket, query):
             q1 = {k: v[0] for k, v in query.items()}
             v2 = q1.get("list-type") == "2"
@@ -1096,49 +1109,71 @@ def _make_handler(srv: S3Server):
             max_keys = min(int(q1.get("max-keys", 1000) or 1000), 1000)
             marker = q1.get("continuation-token" if v2 else "marker", "") \
                 or q1.get("start-after", "")
+            esc, enc = self._encoding_type(q1)
             res = srv.layer.list_objects(bucket, prefix, marker, delimiter,
                                          max_keys)
             name = "ListBucketResult"
             root = ET.Element(name, xmlns=S3_NS)
             ET.SubElement(root, "Name").text = bucket
-            ET.SubElement(root, "Prefix").text = prefix
+            ET.SubElement(root, "Prefix").text = esc(prefix)
             if delimiter:
-                ET.SubElement(root, "Delimiter").text = delimiter
+                ET.SubElement(root, "Delimiter").text = esc(delimiter)
+            if enc:
+                ET.SubElement(root, "EncodingType").text = "url"
             ET.SubElement(root, "MaxKeys").text = str(max_keys)
             ET.SubElement(root, "IsTruncated").text = \
                 "true" if res.is_truncated else "false"
             if v2:
                 ET.SubElement(root, "KeyCount").text = \
                     str(len(res.objects) + len(res.prefixes))
+                if q1.get("continuation-token"):
+                    # the token IS a key name here: encode like one
+                    ET.SubElement(root, "ContinuationToken").text = \
+                        esc(q1["continuation-token"])
+                if q1.get("start-after"):
+                    ET.SubElement(root, "StartAfter").text = \
+                        esc(q1["start-after"])
                 if res.is_truncated:
                     ET.SubElement(root, "NextContinuationToken").text = \
-                        res.next_marker
-            elif res.is_truncated:
-                ET.SubElement(root, "NextMarker").text = res.next_marker
+                        esc(res.next_marker)
+            else:
+                ET.SubElement(root, "Marker").text = esc(marker)
+                if res.is_truncated:
+                    ET.SubElement(root, "NextMarker").text = \
+                        esc(res.next_marker)
+            fetch_owner = (not v2) or q1.get("fetch-owner") == "true"
             for o in res.objects:
                 c = ET.SubElement(root, "Contents")
-                ET.SubElement(c, "Key").text = o.name
+                ET.SubElement(c, "Key").text = esc(o.name)
                 ET.SubElement(c, "LastModified").text = _iso_date(o.mod_time)
                 ET.SubElement(c, "ETag").text = f'"{o.etag}"'
                 ET.SubElement(c, "Size").text = str(_actual_size(o))
-                ET.SubElement(c, "StorageClass").text = "STANDARD"
+                ET.SubElement(c, "StorageClass").text = \
+                    o.user_defined.get("x-amz-storage-class", "STANDARD")
+                if fetch_owner:
+                    owner = ET.SubElement(c, "Owner")
+                    ET.SubElement(owner, "ID").text = "minio-tpu"
+                    ET.SubElement(owner, "DisplayName").text = "minio-tpu"
             for p in res.prefixes:
                 cp = ET.SubElement(root, "CommonPrefixes")
-                ET.SubElement(cp, "Prefix").text = p
+                ET.SubElement(cp, "Prefix").text = esc(p)
             self._send(200, _xml(root))
 
         def _list_object_versions(self, bucket, query):
             q1 = {k: v[0] for k, v in query.items()}
             prefix = q1.get("prefix", "")
+            esc, enc = self._encoding_type(q1)
             versions = srv.layer.list_object_versions(bucket, prefix)
             root = ET.Element("ListVersionsResult", xmlns=S3_NS)
             ET.SubElement(root, "Name").text = bucket
-            ET.SubElement(root, "Prefix").text = prefix
+            ET.SubElement(root, "Prefix").text = esc(prefix)
+            if enc:
+                ET.SubElement(root, "EncodingType").text = "url"
             ET.SubElement(root, "IsTruncated").text = "false"
             for o in versions:
                 tag = "DeleteMarker" if o.delete_marker else "Version"
                 v = ET.SubElement(root, tag)
-                ET.SubElement(v, "Key").text = o.name
+                ET.SubElement(v, "Key").text = esc(o.name)
                 ET.SubElement(v, "VersionId").text = o.version_id or "null"
                 ET.SubElement(v, "IsLatest").text = \
                     "true" if o.is_latest else "false"
@@ -1151,14 +1186,17 @@ def _make_handler(srv: S3Server):
 
         def _list_uploads(self, bucket, query):
             q1 = {k: v[0] for k, v in query.items()}
+            esc, enc = self._encoding_type(q1)
             uploads = srv.layer.list_multipart_uploads(
                 bucket, q1.get("prefix", ""))
             root = ET.Element("ListMultipartUploadsResult", xmlns=S3_NS)
             ET.SubElement(root, "Bucket").text = bucket
+            if enc:
+                ET.SubElement(root, "EncodingType").text = "url"
             ET.SubElement(root, "IsTruncated").text = "false"
             for u in uploads:
                 ue = ET.SubElement(root, "Upload")
-                ET.SubElement(ue, "Key").text = u.object_name
+                ET.SubElement(ue, "Key").text = esc(u.object_name)
                 ET.SubElement(ue, "UploadId").text = u.upload_id
             self._send(200, _xml(root))
 

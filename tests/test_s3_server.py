@@ -295,3 +295,74 @@ def test_conditional_get_preconditions(client):
     # invalid dates are ignored (RFC: a recipient MUST ignore them)
     client.request("GET", "/condb/o",
                    headers={"If-Modified-Since": "not-a-date"})
+
+
+def test_list_objects_encoding_type_and_owner(client):
+    client.make_bucket("encb")
+    weird = "dir/key with spaces+and&xml<chars>"
+    client.put_object("encb", weird, b"v")
+    client.put_object("encb", "plain", b"v")
+
+    # encoding-type=url percent-encodes keys (awscli default behavior)
+    r = client.request("GET", "/encb", "list-type=2&encoding-type=url")
+    root = r.xml()
+    ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+    keys = [c.findtext(f"{ns}Key") for c in root.iter(f"{ns}Contents")]
+    import urllib.parse
+    assert urllib.parse.quote(weird, safe="/") in keys
+    assert root.findtext(f"{ns}EncodingType") == "url"
+    assert [urllib.parse.unquote(k) for k in keys] == \
+        sorted([weird, "plain"])
+
+    # V2 omits Owner unless fetch-owner=true
+    r = client.request("GET", "/encb", "list-type=2")
+    assert b"<Owner>" not in r.body
+    r = client.request("GET", "/encb", "list-type=2&fetch-owner=true")
+    assert b"<Owner>" in r.body
+    # V1 always carries Owner
+    r = client.request("GET", "/encb")
+    assert b"<Owner>" in r.body
+
+    # bogus encoding type is rejected
+    with pytest.raises(S3ClientError) as ei:
+        client.request("GET", "/encb", "list-type=2&encoding-type=gzip")
+    assert ei.value.code == "InvalidArgument"
+
+
+def test_list_reports_storage_class(client):
+    client.make_bucket("sclist")
+    client.request("PUT", "/sclist/rr", body=b"r" * 5000,
+                   headers={"x-amz-storage-class": "REDUCED_REDUNDANCY"})
+    client.put_object("sclist", "std", b"s")
+    r = client.request("GET", "/sclist", "list-type=2")
+    root = r.xml()
+    ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+    classes = {c.findtext(f"{ns}Key"): c.findtext(f"{ns}StorageClass")
+               for c in root.iter(f"{ns}Contents")}
+    assert classes["std"] == "STANDARD"
+    assert classes["rr"] == "REDUCED_REDUNDANCY"
+
+
+def test_versions_and_uploads_listing_encoding(client):
+    client.make_bucket("encv")
+    client.set_versioning("encv", True)
+    key = "v key&with<specials>"
+    client.put_object("encv", key, b"1")
+    import urllib.parse
+    quoted = urllib.parse.quote(key, safe="/")
+    r = client.request("GET", "/encv", "versions&encoding-type=url")
+    ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+    root = r.xml()
+    assert root.findtext(f"{ns}EncodingType") == "url"
+    assert [v.findtext(f"{ns}Key")
+            for v in root.iter(f"{ns}Version")] == [quoted]
+    # multipart-uploads listing honors it too
+    uid = client.create_multipart_upload("encv", key)
+    r = client.request("GET", "/encv", "uploads&encoding-type=url")
+    root = r.xml()
+    assert [u.findtext(f"{ns}Key")
+            for u in root.iter(f"{ns}Upload")] == [quoted]
+    client.abort_multipart_upload("encv", key, uid)
+    # V1 echoes Marker
+    r = client.request("GET", "/encv", "marker=a")
+    assert r.xml().findtext(f"{ns}Marker") == "a"
