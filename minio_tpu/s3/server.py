@@ -1127,15 +1127,17 @@ def _make_handler(srv: S3Server):
                 ET.SubElement(root, "KeyCount").text = \
                     str(len(res.objects) + len(res.prefixes))
                 if q1.get("continuation-token"):
-                    # the token IS a key name here: encode like one
+                    # tokens are OPAQUE to clients: AWS excludes them
+                    # from encoding-type, and clients echo them verbatim
+                    # — encoding here would corrupt pagination
                     ET.SubElement(root, "ContinuationToken").text = \
-                        esc(q1["continuation-token"])
+                        q1["continuation-token"]
                 if q1.get("start-after"):
                     ET.SubElement(root, "StartAfter").text = \
                         esc(q1["start-after"])
                 if res.is_truncated:
                     ET.SubElement(root, "NextContinuationToken").text = \
-                        esc(res.next_marker)
+                        res.next_marker
             else:
                 ET.SubElement(root, "Marker").text = esc(marker)
                 if res.is_truncated:

@@ -1,0 +1,77 @@
+"""Resource-leak detection (cmd/leak-detect_test.go tier): repeated
+server/cluster start-stop cycles must not accumulate threads or leave
+sockets listening.
+"""
+
+import socket
+import threading
+import time
+
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl_storage import XLStorage
+
+
+def _settled_thread_count(deadline_s: float = 5.0) -> int:
+    """Thread count after letting daemon workers wind down."""
+    end = time.monotonic() + deadline_s
+    last = threading.active_count()
+    while time.monotonic() < end:
+        time.sleep(0.1)
+        cur = threading.active_count()
+        if cur == last:
+            return cur
+        last = cur
+    return last
+
+
+def test_server_start_stop_does_not_leak_threads(tmp_path):
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"d{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                           backend="numpy")
+    # warm the shared layer pool (its worker threads spawn lazily on the
+    # first drive fan-out and persist with the layer — not a leak)
+    layer.make_bucket("warmup")
+    layer.put_object("warmup", "o", b"w")
+    baseline = _settled_thread_count()
+    ports = []
+    for cycle in range(3):
+        srv = S3Server(layer, access_key="lk", secret_key="ls")
+        srv.start()
+        ports.append(srv.port)
+        c = S3Client(srv.endpoint, "lk", "ls")
+        c.make_bucket(f"leak{cycle}")
+        c.put_object(f"leak{cycle}", "o", b"x" * 1024)
+        assert c.get_object(f"leak{cycle}", "o").body == b"x" * 1024
+        srv.stop()
+    after = _settled_thread_count()
+    # the shared layer's pool persists; per-server threads must not pile
+    # up across cycles (allow a small slack for lazy singletons)
+    assert after <= baseline + 3, (baseline, after)
+    # every listener actually closed
+    for p in ports:
+        s = socket.socket()
+        try:
+            assert s.connect_ex(("127.0.0.1", p)) != 0, f"port {p} open"
+        finally:
+            s.close()
+
+
+def test_rpc_server_stop_closes_listener(tmp_path):
+    from minio_tpu.parallel.rpc import RPCClient, RPCError, RPCServer
+    srv = RPCServer("leaksecret")
+    srv.start()
+    port = srv.port
+    assert RPCClient(srv.endpoint, "leaksecret").call("sys", "ping") == \
+        "pong"
+    srv.stop()
+    s = socket.socket()
+    try:
+        assert s.connect_ex(("127.0.0.1", port)) != 0
+    finally:
+        s.close()
