@@ -20,6 +20,7 @@ IAMSys.IsAllowed (web-handlers.go authenticateRequest + IsAllowed).
 
 from __future__ import annotations
 
+import hmac
 import json
 import time
 import urllib.parse
@@ -71,7 +72,11 @@ def _verify(srv, token: str) -> str:
 
 def _allowed(srv, access_key: str, action: str, bucket: str,
              obj: str = "") -> None:
-    if not srv.iam.is_allowed(access_key, action, bucket, obj):
+    # same resource convention as the S3 path (server.py _allow):
+    # "bucket" or "bucket/key" — IAMSys.is_allowed's 4th arg is the
+    # Condition context dict, never the object key
+    resource = f"{bucket}/{obj}" if obj else bucket
+    if not srv.iam.is_allowed(access_key, action, resource):
         raise AuthError("access denied")
 
 
@@ -97,8 +102,16 @@ class WebRPC:
     def rpc_Login(self, _ak, p: dict) -> dict:
         user = p.get("username", "")
         password = p.get("password", "")
+        if not isinstance(user, str) or not isinstance(password, str):
+            raise AuthError("Invalid credentials")
         secret = self.srv.iam.lookup_secret(user)
-        if secret is None or secret != password:
+        if secret is None or not hmac.compare_digest(secret.encode(),
+                                                     password.encode()):
+            raise AuthError("Invalid credentials")
+        u = self.srv.iam.get_user(user)   # exists: lookup_secret succeeded
+        # STS temp credentials need their session token, not a password
+        # login (web-handlers.go rejects them too)
+        if getattr(u, "parent_user", "") and getattr(u, "expiration", 0):
             raise AuthError("Invalid credentials")
         return {"token": _mint(self.srv, user), "uiVersion": UI_VERSION}
 
@@ -262,6 +275,10 @@ def _handle_rpc(h, srv, payload: bytes) -> None:
         return _reply_json(h, 400, {"jsonrpc": "2.0", "id": None,
                                     "error": {"code": -32700,
                                               "message": "parse error"}})
+    if not isinstance(req, dict):
+        return _reply_json(h, 400, {"jsonrpc": "2.0", "id": None,
+                                    "error": {"code": -32600,
+                                              "message": "invalid request"}})
     rid = req.get("id")
     token = ""
     auth = h.headers.get("Authorization", "")
@@ -353,6 +370,7 @@ def _handle_zip(h, srv, query: dict, payload: bytes) -> None:
     """DownloadZip (web-handlers.go DownloadZipHandler): stream the
     requested objects/prefixes as one zip archive — one object resident
     at a time, archive bytes written straight to the socket."""
+    headers_sent = False
     try:
         ak = _verify(srv, _token_of(h, query))
         req = json.loads(payload or b"{}")
@@ -376,6 +394,7 @@ def _handle_zip(h, srv, query: dict, payload: bytes) -> None:
         # length unknown up front: delimit by closing the connection
         h.send_header("Connection", "close")
         h.end_headers()
+        headers_sent = True
         with zipfile.ZipFile(_CountingWriter(h.wfile), "w",
                              zipfile.ZIP_DEFLATED) as zf:
             for name in names:
@@ -383,5 +402,11 @@ def _handle_zip(h, srv, query: dict, payload: bytes) -> None:
                 zf.writestr(name[len(prefix):] or name, data)
         h.close_connection = True
     except (WebError, oli.ObjectLayerError) as e:
+        if headers_sent:
+            # zip bytes already on the wire: a JSON reply here would
+            # corrupt the stream — just drop the connection, the
+            # Connection: close delimiting signals truncation
+            h.close_connection = True
+            return
         _reply_json(h, 401 if isinstance(e, AuthError) else 400,
                     {"ok": False, "error": str(e)})

@@ -154,6 +154,92 @@ def test_remove_objects(server, token):
     assert objs == []
 
 
+def test_rpc_rejects_non_object_envelope(server):
+    """Valid JSON that isn't an object must yield -32600, not a 500."""
+    for payload in (b"[]", b'"hello"', b"42"):
+        req = urllib.request.Request(
+            f"{server.endpoint}/minio-tpu/webrpc", data=payload,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req) as resp:
+                doc = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            doc = json.loads(e.read())
+        assert doc["error"]["code"] == -32600, doc
+
+
+def test_prefix_scoped_policy_on_web_object_ops(server, token):
+    """Web object ops must authorize against bucket/key (the S3 resource
+    convention), so prefix-scoped grants work — the round-1 bug passed the
+    key as the Condition context and authorized against the bucket only."""
+    from minio_tpu.iam import policy as iampolicy
+    pol = iampolicy.Policy.from_json(json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [
+            {"Effect": "Allow", "Action": ["s3:GetObject", "s3:PutObject"],
+             "Resource": ["arn:aws:s3:::webbkt/dir/*"]},
+            {"Effect": "Allow", "Action": ["s3:ListBucket"],
+             "Resource": ["arn:aws:s3:::webbkt"]},
+        ]}))
+    server.iam.set_policy("dir-only", pol)
+    server.iam.add_user("prefixuser", "prefixsecret1")
+    server.iam.attach_policy("prefixuser", ["dir-only"])
+    ptoken = rpc(server, "web.Login",
+                 {"username": "prefixuser",
+                  "password": "prefixsecret1"})["token"]
+
+    # in-prefix upload allowed
+    req = urllib.request.Request(
+        f"{server.endpoint}/minio-tpu/upload/webbkt/dir/granted.txt",
+        data=b"ok", method="PUT",
+        headers={"Authorization": f"Bearer {ptoken}"})
+    with urllib.request.urlopen(req) as resp:
+        assert resp.status == 200
+
+    # in-prefix download allowed
+    with urllib.request.urlopen(
+            f"{server.endpoint}/minio-tpu/download/webbkt/dir/granted.txt"
+            f"?token={ptoken}") as resp:
+        assert resp.read() == b"ok"
+
+    # outside the prefix: denied, not 500
+    req = urllib.request.Request(
+        f"{server.endpoint}/minio-tpu/upload/webbkt/outside.txt",
+        data=b"no", method="PUT",
+        headers={"Authorization": f"Bearer {ptoken}"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req)
+    assert ei.value.code == 401
+
+    # a policy with a Condition block must evaluate, not crash
+    cond_pol = iampolicy.Policy.from_json(json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [
+            {"Effect": "Allow", "Action": ["s3:GetObject"],
+             "Resource": ["arn:aws:s3:::webbkt/*"],
+             "Condition": {"StringEquals": {"aws:username": ["nobody"]}}},
+        ]}))
+    server.iam.set_policy("cond-pol", cond_pol)
+    server.iam.attach_policy("prefixuser", ["cond-pol"])
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            f"{server.endpoint}/minio-tpu/download/webbkt/dir/granted.txt"
+            f"?token={ptoken}")
+    assert ei.value.code == 401    # denied by unmet condition, not a 500
+
+
+def test_login_rejects_temp_credentials(server, token):
+    """STS temp credentials must not password-login to the web UI."""
+    from minio_tpu.iam.sys import UserIdentity
+    server.iam._users["tempcred"] = UserIdentity(
+        "tempcred", "tempsecret111", parent_user="webkey",
+        expiration=int(__import__("time").time()) + 3600)
+    err = rpc(server, "web.Login", {"username": "tempcred",
+                                    "password": "tempsecret111"},
+              expect_error=True)
+    assert "Invalid credentials" in err["message"]
+
+
 def test_non_root_user_policy_enforced(server, token):
     """A user with a read-only policy can list but not upload via web."""
     server.iam.add_user("webuser", "webusersecret1")
