@@ -10,9 +10,12 @@ Layout per drive root:
 Write path is stage-then-commit: shard files land in tmp, ``rename_data``
 atomically renames the data dir into place and rewrites xl.meta via
 tmp+rename (the reference's CreateFile + RenameData contract,
-cmd/xl-storage.go:1568,1965).  Durability uses fsync on commit instead of
-the reference's O_DIRECT; the batched TPU pipeline writes whole shard files
-at once so page-cache writeback, not alignment, is the governing factor.
+cmd/xl-storage.go:1568,1965).  Durability: every commit path fsyncs the
+file contents before the rename and fsyncs the parent directory after it
+(the reference fdatasyncs CreateFile, cmd/xl-storage.go:1568, and relies
+on O_DIRECT; the batched TPU pipeline writes whole shard files at once so
+page-cache writeback, not alignment, is the governing factor).  Set
+``MT_FSYNC=0`` to trade durability for throughput (benchmarks only).
 """
 
 from __future__ import annotations
@@ -33,6 +36,32 @@ SYS_DIR = ".mt.sys"
 TMP_DIR = os.path.join(SYS_DIR, "tmp")
 META_FILE = "xl.meta"
 _RESERVED = {SYS_DIR}
+
+# acknowledged writes must survive a crash; MT_FSYNC=0 is for benchmarks
+_FSYNC = os.environ.get("MT_FSYNC", "1") != "0"
+
+
+def _fsync_fileobj(f) -> None:
+    if _FSYNC:
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _fsync_dir(path: str) -> None:
+    """Persist directory entries (renames/creates) the way the reference's
+    commit contract requires (cmd/xl-storage.go:1965 RenameData)."""
+    if not _FSYNC:
+        return
+    try:
+        fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def _is_valid_volname(volume: str) -> bool:
@@ -173,7 +202,9 @@ class XLStorage(StorageAPI):
         tmp = full + f".tmp.{uuid.uuid4().hex[:8]}"
         with open(tmp, "wb") as f:
             f.write(data)
+            _fsync_fileobj(f)
         os.replace(tmp, full)
+        _fsync_dir(os.path.dirname(full))
 
     def create_file(self, volume: str, path: str, data: bytes,
                     file_size: int = -1) -> None:
@@ -191,6 +222,7 @@ class XLStorage(StorageAPI):
         os.makedirs(os.path.dirname(full), exist_ok=True)
         with open(full, "ab") as f:
             f.write(data)
+            _fsync_fileobj(f)
 
     def read_file_stream(self, volume: str, path: str, offset: int,
                          length: int) -> bytes:
@@ -219,6 +251,7 @@ class XLStorage(StorageAPI):
             os.replace(src, dst)
         except FileNotFoundError:
             raise errors.FileNotFound(src_path) from None
+        _fsync_dir(os.path.dirname(dst))
 
     def delete(self, volume: str, path: str, recursive: bool = False) -> None:
         full = self._file_path(volume, path)
@@ -298,9 +331,13 @@ class XLStorage(StorageAPI):
             if os.path.isdir(dst_data_dir):
                 shutil.rmtree(dst_data_dir)
             os.replace(src_dir, dst_data_dir)
+            _fsync_dir(dst_obj_dir)
         else:
             os.makedirs(dst_obj_dir, exist_ok=True)
+        # xl.meta write fsyncs itself + the object dir (write_all); the
+        # parent entry for a freshly created object dir needs one more
         self._write_meta(dst_volume, dst_path, meta)
+        _fsync_dir(os.path.dirname(dst_obj_dir))
         if old_ddir and old_ddir != fi.data_dir \
                 and meta.shared_data_dir_count(fi.version_id, old_ddir) == 0:
             shutil.rmtree(os.path.join(dst_obj_dir, old_ddir),
