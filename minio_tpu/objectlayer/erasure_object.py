@@ -47,6 +47,26 @@ from .multipart import MultipartOps
 DEFAULT_BLOCK_SIZE = 10 * 1024 * 1024   # blockSizeV1 (cmd/object-api-common.go:32)
 INLINE_THRESHOLD = 128 * 1024           # small-object inline into xl.meta
 ETAG_KEY = "etag"
+# streaming pipeline batch: stripes are encoded/decoded this many bytes at
+# a time so memory is O(batch * n/k) regardless of object size, while each
+# device dispatch still carries enough stripes to fill the MXU
+# (cmd/erasure-encode.go:80-107 block loop, widened for TPU batching)
+STREAM_BATCH_BYTES = int(os.environ.get("MT_STREAM_BATCH",
+                                        64 * 1024 * 1024))
+
+
+def _read_full(source, n: int) -> bytes:
+    """Read exactly n bytes from a file-like source unless EOF comes
+    first (sockets and chunked decoders return short reads)."""
+    chunks = []
+    remaining = n
+    while remaining > 0:
+        c = source.read(remaining)
+        if not c:
+            break
+        chunks.append(c)
+        remaining -= len(c)
+    return chunks[0] if len(chunks) == 1 else b"".join(chunks)
 
 
 def default_parity_count(drive_count: int) -> int:
@@ -108,21 +128,31 @@ class ErasureObjects(MultipartOps, ObjectLayer):
 
     # -- drive fan-out helpers --------------------------------------------
 
+    def _fanout_items(self, fn, items):
+        """Run fn(item) concurrently over arbitrary items; returns
+        (results, errs) aligned with items (parallelWriter/Reader
+        analog, cmd/erasure-encode.go:36)."""
+
+        def run(x):
+            try:
+                return fn(x), None
+            except Exception as e:  # noqa: BLE001 — per-item isolation
+                return None, e
+
+        out = list(self._pool.map(run, items))
+        return [r for r, _ in out], [e for _, e in out]
+
     def _fanout(self, fn, disks=None):
-        """Run fn(disk) on every drive concurrently; returns (results, errs)
-        aligned with the disk list (the parallelWriter/Reader analog)."""
-        disks = self.disks if disks is None else disks
+        """fn(disk) on every drive concurrently; offline (None) drives
+        report DiskNotFound in the aligned error list."""
 
         def run(d):
             if d is None:
-                return None, serrors.DiskNotFound("offline")
-            try:
-                return fn(d), None
-            except Exception as e:  # noqa: BLE001 — per-drive fault isolation
-                return None, e
+                raise serrors.DiskNotFound("offline")
+            return fn(d)
 
-        out = list(self._pool.map(run, disks))
-        return [r for r, _ in out], [e for _, e in out]
+        return self._fanout_items(run,
+                                  self.disks if disks is None else disks)
 
     def _fanout_indexed(self, fn, shuffled_disks):
         """fn((shard_idx, disk)) per drive, aligned errors; offline drives
@@ -215,10 +245,37 @@ class ErasureObjects(MultipartOps, ObjectLayer):
 
     # -- PUT (cmd/erasure-object.go:614 putObject) ------------------------
 
-    def put_object(self, bucket: str, object_name: str, data: bytes,
+    def put_object(self, bucket: str, object_name: str, data,
                    opts: Optional[PutObjectOptions] = None) -> ObjectInfo:
+        """PUT from bytes or a file-like reader.  Anything larger than one
+        stream batch goes through the block-batched pipeline so memory
+        stays O(batch) (cmd/erasure-encode.go:80-107); smaller bodies take
+        the single-dispatch fast path."""
+        opts = opts or PutObjectOptions()
+        if hasattr(data, "read"):
+            return self.put_object_stream(bucket, object_name, data, opts)
+        data = bytes(data) if not isinstance(data, bytes) else data
+        if len(data) > STREAM_BATCH_BYTES:
+            import io
+            return self.put_object_stream(bucket, object_name,
+                                          io.BytesIO(data), opts)
+        return self._put_object_bytes(bucket, object_name, data, opts)
+
+    def put_object_stream(self, bucket: str, object_name: str, reader,
+                          opts: Optional[PutObjectOptions] = None
+                          ) -> ObjectInfo:
         opts = opts or PutObjectOptions()
         self._check_bucket(bucket)
+        batch = max(1, STREAM_BATCH_BYTES // self.block_size) \
+            * self.block_size
+        first = _read_full(reader, batch)
+        if len(first) < batch:     # whole object fits one batch
+            return self._put_object_bytes(bucket, object_name, first, opts)
+        return self._put_object_streaming(bucket, object_name, first,
+                                          reader, batch, opts)
+
+    def _put_object_bytes(self, bucket: str, object_name: str, data: bytes,
+                          opts: PutObjectOptions) -> ObjectInfo:
         n = len(self.disks)
         k, m = self._geometry(opts.parity)
         etag = hashlib.md5(data).hexdigest()
@@ -294,6 +351,113 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         self.metacache.invalidate(bucket)
         return self._to_object_info(fi)
 
+    def _put_object_streaming(self, bucket: str, object_name: str,
+                              first: bytes, reader, batch: int,
+                              opts: PutObjectOptions) -> ObjectInfo:
+        """Block-batched streaming PUT: each batch of full stripes is one
+        device dispatch appended to per-drive staged shard files; commit
+        is a single quorum rename_data at EOF (cmd/erasure-encode.go
+        block loop + cmd/erasure-object.go:772-779 commit)."""
+        n = len(self.disks)
+        k, m = self._geometry(opts.parity)
+        mod_time = opts.mod_time or now_ns()
+        version_id = opts.version_id or (
+            str(uuid.uuid4()) if opts.versioned else "")
+        distribution = meta.hash_order(f"{bucket}/{object_name}", n)
+        fi = FileInfo(
+            volume=bucket, name=object_name, version_id=version_id,
+            data_dir=str(uuid.uuid4()), mod_time=mod_time, size=0,
+            metadata={**opts.user_defined},
+            erasure=ErasureInfo(
+                data_blocks=k, parity_blocks=m, block_size=self.block_size,
+                distribution=distribution,
+                checksums=[ChecksumInfo(1, self.bitrot_algo)]),
+            fresh=True)
+        codec = self._codec_for(m) if m > 0 else None
+        ssize = fi.erasure.shard_size()
+        shuffled = meta.shuffle_disks(self.disks, distribution)
+        wq = self._write_quorum(fi)
+        tmps: list[str | None] = [None] * n
+        errs: list[Exception | None] = [None] * n
+        md5 = hashlib.md5()
+        total = 0
+
+        lk = self.ns_lock.new_lock(bucket, object_name)
+        lk.lock(write=True)
+        try:
+            chunk = first
+            while True:
+                md5.update(chunk)
+                total += len(chunk)
+                if m > 0:
+                    shards = codec.encode_object(chunk)
+                else:
+                    shards = [np.frombuffer(chunk, dtype=np.uint8)]
+                framed = bitrot.streaming_encode_batch(
+                    shards, ssize, self.bitrot_algo,
+                    use_device=(m > 0 and codec.backend == "tpu"))
+
+                def write_batch(idx_disk):
+                    idx, disk = idx_disk
+                    if disk is None or errs[idx] is not None:
+                        return  # dead drive: a later append would corrupt
+                    if tmps[idx] is None:
+                        tmps[idx] = disk.tmp_dir()
+                        disk.create_file(SYS_DIR, f"{tmps[idx]}/part.1",
+                                         framed[idx])
+                    else:
+                        disk.append_file(SYS_DIR, f"{tmps[idx]}/part.1",
+                                         framed[idx])
+
+                _, werrs = self._fanout_indexed(write_batch, shuffled)
+                for i, e in enumerate(werrs):
+                    if e is not None and errs[i] is None:
+                        errs[i] = e
+                alive = sum(1 for i, d in enumerate(shuffled)
+                            if d is not None and errs[i] is None)
+                if alive < wq:
+                    raise WriteQuorumError(
+                        f"{alive} of {n} drives writable, need {wq}")
+                if len(chunk) < batch:
+                    break
+                chunk = _read_full(reader, batch)
+                if not chunk:
+                    break
+            etag = md5.hexdigest()
+            fi.size = total
+            fi.metadata = {ETAG_KEY: etag, **opts.user_defined}
+            fi.parts = [ObjectPartInfo(1, total, total, etag, mod_time)]
+
+            def commit_one(idx_disk):
+                idx, disk = idx_disk
+                if disk is None:
+                    raise serrors.DiskNotFound("offline")
+                if errs[idx] is not None:
+                    raise errs[idx]
+                dfi = FileInfo(**{**fi.__dict__})
+                dfi.erasure = ErasureInfo(**{**fi.erasure.__dict__})
+                dfi.erasure.index = idx + 1
+                disk.rename_data(SYS_DIR, tmps[idx], dfi, bucket,
+                                 object_name)
+
+            _, cerrs = self._fanout_indexed(commit_one, shuffled)
+            try:
+                meta.reduce_errs(cerrs, wq, WriteQuorumError)
+            except serrors.StorageError as e:
+                raise WriteQuorumError(str(e)) from e
+            if self.mrf is not None and any(e is not None for e in cerrs):
+                self.mrf.add(bucket, object_name, fi.version_id)
+            self.metacache.invalidate(bucket)
+            return self._to_object_info(fi)
+        finally:
+            lk.unlock()
+            for idx, disk in enumerate(shuffled):
+                if disk is not None and tmps[idx] is not None:
+                    try:
+                        disk.clean_tmp(tmps[idx])
+                    except Exception:  # noqa: BLE001 — best-effort cleanup
+                        pass
+
     # -- GET (cmd/erasure-object.go:242 getObjectWithFileInfo) -------------
 
     def _read_quorum_fileinfo(self, bucket: str, object_name: str,
@@ -325,6 +489,18 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                    length: int = -1,
                    opts: Optional[ObjectOptions] = None
                    ) -> tuple[ObjectInfo, bytes]:
+        info, gen = self.get_object_reader(bucket, object_name, offset,
+                                           length, opts)
+        return info, b"".join(gen)
+
+    def get_object_reader(self, bucket: str, object_name: str,
+                          offset: int = 0, length: int = -1,
+                          opts: Optional[ObjectOptions] = None):
+        """Range GET as (info, chunk iterator): reads ONLY the shard byte
+        ranges covering the requested blocks (ShardFileOffset math,
+        cmd/erasure-coding.go:134 + cmd/erasure-decode.go:229-246) and
+        decodes batch-of-blocks at a time, so a 1 MiB range of a 100 GiB
+        object touches one block per shard and memory stays O(batch)."""
         opts = opts or ObjectOptions()
         self._check_bucket(bucket)
         fi, fis = self._read_quorum_fileinfo(bucket, object_name,
@@ -345,68 +521,108 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             raise InvalidRange(f"{offset}+{length} vs {size}")
         length = min(length, size - offset)
         info = self._to_object_info(fi)
-        if fi.size == 0:
-            return info, b""
-        data = self._read_and_decode(bucket, object_name, fi, fis)
-        return info, bytes(data[offset:offset + length])
+        if size == 0 or length == 0:
+            return info, iter(())
+        return info, self._stream_range(bucket, object_name, fi, fis,
+                                        offset, length)
 
-    def _read_and_decode(self, bucket: str, object_name: str, fi: FileInfo,
-                         fis: list[FileInfo | None]) -> np.ndarray:
-        """Read k-of-n shard files, verify bitrot, reconstruct missing
-        stripes in one batched device call, reassemble the object."""
+    def _stream_range(self, bucket: str, object_name: str, fi: FileInfo,
+                      fis: list[FileInfo | None], offset: int, length: int):
+        """Generator over the requested byte range, block-batch at a time.
+        Shard-read failures extend into parity shards (parallelReader,
+        cmd/erasure-decode.go:120-188); a failed shard stays dead for the
+        remainder of the stream."""
         k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
-        n = k + m
-        shuffled = meta.shuffle_disks(self.disks, fi.erasure.distribution)
-        shuffled_fis = meta.shuffle_parts_metadata(fis,
-                                                   fi.erasure.distribution)
+        nsh = k + m
+        bs = fi.erasure.block_size
         ssize = fi.erasure.shard_size()
-        out = np.empty(fi.size, dtype=np.uint8)
-        out_pos = 0
+        algo = self.bitrot_algo
+        hlen = bitrot.digest_size(algo) if bitrot.is_streaming(algo) else 0
+        shuffled = meta.shuffle_disks(self.disks, fi.erasure.distribution)
+        sfis = meta.shuffle_parts_metadata(fis, fi.erasure.distribution)
+        batch_blocks = max(1, STREAM_BATCH_BYTES // bs)
+        dead: set[int] = set(
+            j for j in range(nsh) if shuffled[j] is None)
+        end = offset + length
+        part_start = 0
         for part in fi.parts:
+            if part_start + part.size <= offset:
+                part_start += part.size
+                continue
+            if part_start >= end:
+                break
+            p0 = max(0, offset - part_start)
+            p1 = min(part.size, end - part_start)
             sfsize = fi.erasure.shard_file_size(part.size)
+            b0 = p0 // bs
+            bend = -(-p1 // bs)
+            for bb0 in range(b0, bend, batch_blocks):
+                bb1 = min(bb0 + batch_blocks, bend)
+                logical_off = bb0 * ssize
+                logical_end = min(bb1 * ssize, sfsize)
+                seg_len = logical_end - logical_off
+                framed_off = logical_off + bb0 * hlen
+                framed_len = seg_len + (bb1 - bb0) * hlen
+                covered = min(bb1 * bs, part.size) - bb0 * bs
+                shards = self._read_shard_segments(
+                    bucket, object_name, fi, part, shuffled, sfis, dead,
+                    framed_off, framed_len, seg_len, ssize, algo)
+                part_bytes = self._assemble(shards, fi, covered)
+                lo = max(p0 - bb0 * bs, 0)
+                hi = min(p1 - bb0 * bs, covered)
+                yield part_bytes[lo:hi].tobytes()
+            part_start += part.size
+        # shards that failed mid-stream are heal candidates
+        # (on-read heal trigger, cmd/erasure-object.go:330-342)
+        if self.mrf is not None and \
+                any(shuffled[j] is not None for j in dead):
+            self.mrf.add(bucket, object_name, fi.version_id)
 
-            def read_shard(j):
-                disk = shuffled[j]
-                dfi = shuffled_fis[j]
-                if disk is None:
-                    raise serrors.DiskNotFound("offline")
-                if dfi is not None and dfi.inline_data is not None:
-                    framed = dfi.inline_data
+    def _read_shard_segments(self, bucket, object_name, fi, part, shuffled,
+                             sfis, dead: set[int], framed_off: int,
+                             framed_len: int, seg_len: int, ssize: int,
+                             algo: str) -> list:
+        """Read one block-batch's byte range from k healthy shards,
+        extending into parity on failure; returns a length-n list with
+        np arrays at the indices read."""
+        k, m = fi.erasure.data_blocks, fi.erasure.parity_blocks
+        nsh = k + m
+
+        def read_one(j):
+            disk = shuffled[j]
+            dfi = sfis[j]
+            if disk is None:
+                raise serrors.DiskNotFound("offline")
+            if dfi is not None and dfi.inline_data is not None:
+                framed = dfi.inline_data[framed_off:framed_off + framed_len]
+                if len(framed) < framed_len:
+                    raise serrors.FileCorrupt("short inline data")
+            else:
+                framed = disk.read_file_stream(
+                    bucket,
+                    f"{object_name}/{fi.data_dir}/part.{part.number}",
+                    framed_off, framed_len)
+            r = bitrot.StreamingBitrotReader(framed, ssize, algo)
+            try:
+                return np.frombuffer(r.read_at(0, seg_len), dtype=np.uint8)
+            except bitrot.BitrotError as e:
+                raise serrors.FileCorrupt(str(e)) from e
+
+        shards: list[np.ndarray | None] = [None] * nsh
+        got = 0
+        candidates = [j for j in range(nsh) if j not in dead]
+        while got < k and candidates:
+            batch, candidates = candidates[:k - got], candidates[k - got:]
+            res, errs = self._fanout_items(read_one, batch)
+            for j, r, e in zip(batch, res, errs):
+                if e is None:
+                    shards[j] = r
+                    got += 1
                 else:
-                    framed = disk.read_all(
-                        bucket,
-                        f"{object_name}/{fi.data_dir}/part.{part.number}")
-                r = bitrot.StreamingBitrotReader(framed, ssize,
-                                                 self.bitrot_algo)
-                try:
-                    return np.frombuffer(r.read_at(0, sfsize), dtype=np.uint8)
-                except bitrot.BitrotError as e:
-                    raise serrors.FileCorrupt(str(e)) from e
-
-            # parallelReader: start with the k data shards, extend into
-            # parity on failure (cmd/erasure-decode.go:120-188)
-            shards: list[np.ndarray | None] = [None] * n
-            got = 0
-            next_idx = 0
-            while got < k and next_idx < n:
-                batch = []
-                while len(batch) + got < k and next_idx < n:
-                    batch.append(next_idx)
-                    next_idx += 1
-                res, errs = self._fanout(
-                    lambda j: read_shard(j),
-                    disks=batch)  # _fanout passes disk=j via disks list
-                for j, (r, e) in zip(batch, zip(res, errs)):
-                    if e is None:
-                        shards[j] = r
-                        got += 1
-            if got < k:
-                raise ReadQuorumError(
-                    f"only {got} of {k} shards readable")
-            part_data = self._assemble(shards, fi, part.size)
-            out[out_pos:out_pos + part.size] = part_data
-            out_pos += part.size
-        return out
+                    dead.add(j)
+        if got < k:
+            raise ReadQuorumError(f"only {got} of {k} shards readable")
+        return shards
 
     def _assemble(self, shards: list[np.ndarray | None], fi: FileInfo,
                   part_size: int) -> np.ndarray:

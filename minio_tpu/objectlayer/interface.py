@@ -182,3 +182,25 @@ class ObjectLayer(abc.ABC):
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
                      delimiter: str = "", max_keys: int = 1000
                      ) -> ListObjectsInfo: ...
+
+    # -- streaming entry points (cmd/object-api-interface.go GetObjectNInfo
+    # reader pipeline / PutObject with hash.Reader).  Backends that can
+    # stream override these; the defaults buffer through the bytes paths
+    # so FS/gateway layers keep working unchanged. ------------------------
+
+    def put_object_stream(self, bucket: str, object_name: str, reader,
+                          opts: Optional[PutObjectOptions] = None
+                          ) -> ObjectInfo:
+        """PUT from a file-like ``reader`` (has .read(n)).  Default
+        buffers; ErasureObjects overrides with O(batch) memory."""
+        return self.put_object(bucket, object_name, reader.read(), opts)
+
+    def get_object_reader(self, bucket: str, object_name: str,
+                          offset: int = 0, length: int = -1,
+                          opts: Optional[ObjectOptions] = None):
+        """Range GET as (ObjectInfo, iterator-of-chunks).  Default wraps
+        the buffered get_object; ErasureObjects streams covering blocks
+        only (cmd/erasure-decode.go:229-246)."""
+        info, data = self.get_object(bucket, object_name, offset, length,
+                                     opts)
+        return info, iter((data,) if data else ())

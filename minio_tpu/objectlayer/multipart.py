@@ -100,42 +100,115 @@ class MultipartOps:
         return fi, fis
 
     def put_object_part(self, bucket: str, object_name: str, upload_id: str,
-                        part_number: int, data: bytes) -> PartInfo:
+                        part_number: int, data) -> PartInfo:
+        """Erasure-encode one part (PutObjectPart,
+        cmd/erasure-multipart.go:342).  ``data`` is bytes or a file-like
+        reader; large parts stream through the block-batched pipeline so
+        memory stays O(batch) — a 5 GiB part never materializes."""
         if not 1 <= part_number <= MAX_PARTS:
             raise InvalidPart(f"part number {part_number}")
         self._check_bucket(bucket)
         fi, _ = self._mp_fileinfo(bucket, object_name, upload_id)
         mp = self._mp_dir(bucket, object_name, upload_id)
-        etag = hashlib.md5(data).hexdigest()
-        size = len(data)
-
-        # the upload's persisted geometry wins: a storage-class parity
-        # chosen at initiate applies to every part
-        if fi.erasure.parity_blocks > 0:
-            shards = self._codec_for(
-                fi.erasure.parity_blocks).encode_object(data)
+        from .erasure_object import STREAM_BATCH_BYTES, _read_full
+        batch = max(1, STREAM_BATCH_BYTES // self.block_size) \
+            * self.block_size
+        if hasattr(data, "read"):
+            reader = data
         else:
-            import numpy as np
-            shards = [np.frombuffer(data, dtype=np.uint8)]
+            import io
+            reader = io.BytesIO(bytes(data) if not isinstance(data, bytes)
+                                else data)
         ssize = fi.erasure.shard_size()
-        framed = [bitrot.streaming_encode(s.tobytes(), ssize,
-                                          self.bitrot_algo) for s in shards]
         shuffled = meta.shuffle_disks(self.disks, fi.erasure.distribution)
-
-        def write_one(idx_disk):
-            idx, disk = idx_disk
-            disk.create_file(SYS_DIR, f"{mp}/part.{part_number}",
-                             framed[idx])
-            # per-part sidecar so complete() can verify etag/size
-            disk.write_all(SYS_DIR, f"{mp}/part.{part_number}.meta",
-                           f"{etag}:{size}".encode())
-
-        _, errs = self._fanout_indexed(write_one, shuffled)
+        wq = self._write_quorum(fi)
+        n = len(self.disks)
+        errs: list[Exception | None] = [None] * n
+        started = [False] * n
+        # stage under a unique name, promote atomically at the end: a
+        # retried or concurrent upload of the same part number must never
+        # truncate a part that already verified (the reference writes
+        # whole part files via tmp+rename, cmd/erasure-multipart.go:342)
+        staging = f"part.{part_number}.in.{uuid.uuid4().hex[:8]}"
+        md5 = hashlib.md5()
+        size = 0
         try:
-            meta.reduce_errs(errs, self._write_quorum(fi), WriteQuorumError)
-        except serrors.StorageError as e:
-            raise WriteQuorumError(str(e)) from e
-        return PartInfo(part_number, etag, size, size, now_ns())
+            chunk = _read_full(reader, batch)
+            while True:
+                md5.update(chunk)
+                size += len(chunk)
+                # the upload's persisted geometry wins: a storage-class
+                # parity chosen at initiate applies to every part
+                if fi.erasure.parity_blocks > 0:
+                    codec = self._codec_for(fi.erasure.parity_blocks)
+                    shards = codec.encode_object(chunk)
+                    use_device = codec.backend == "tpu"
+                else:
+                    import numpy as np
+                    shards = [np.frombuffer(chunk, dtype=np.uint8)]
+                    use_device = False
+                framed = bitrot.streaming_encode_batch(
+                    shards, ssize, self.bitrot_algo, use_device=use_device)
+
+                def write_batch(idx_disk):
+                    idx, disk = idx_disk
+                    if disk is None or errs[idx] is not None:
+                        return
+                    if not started[idx]:
+                        started[idx] = True
+                        disk.create_file(SYS_DIR, f"{mp}/{staging}",
+                                         framed[idx])
+                    else:
+                        disk.append_file(SYS_DIR, f"{mp}/{staging}",
+                                         framed[idx])
+
+                _, werrs = self._fanout_indexed(write_batch, shuffled)
+                for i, e in enumerate(werrs):
+                    if e is not None and errs[i] is None:
+                        errs[i] = e
+                alive = sum(1 for i, d in enumerate(shuffled)
+                            if d is not None and errs[i] is None)
+                if alive < wq:
+                    raise WriteQuorumError(
+                        f"{alive} of {n} drives writable, need {wq}")
+                if len(chunk) < batch:
+                    break
+                chunk = _read_full(reader, batch)
+                if not chunk:
+                    break
+            etag = md5.hexdigest()
+
+            def promote(idx_disk):
+                idx, disk = idx_disk
+                if disk is None:
+                    raise serrors.DiskNotFound("offline")
+                if errs[idx] is not None:
+                    raise errs[idx]
+                # atomic promote, then the sidecar complete() verifies with
+                disk.rename_file(SYS_DIR, f"{mp}/{staging}",
+                                 SYS_DIR, f"{mp}/part.{part_number}")
+                disk.write_all(SYS_DIR, f"{mp}/part.{part_number}.meta",
+                               f"{etag}:{size}".encode())
+
+            _, perrs = self._fanout_indexed(promote, shuffled)
+            try:
+                meta.reduce_errs(perrs, wq, WriteQuorumError)
+            except serrors.StorageError as e:
+                raise WriteQuorumError(str(e)) from e
+            return PartInfo(part_number, etag, size, size, now_ns())
+        finally:
+            # drop any staging file that wasn't promoted (stream abort,
+            # failed drive, lost quorum): a later retry must never see it
+            def cleanup(idx_disk):
+                idx, disk = idx_disk
+                if disk is None or not started[idx]:
+                    return
+                try:
+                    disk.delete(SYS_DIR, f"{mp}/{staging}")
+                except Exception:  # noqa: BLE001 — already promoted/gone
+                    pass
+
+            self._fanout_indexed(cleanup, shuffled)
 
     def get_multipart_info(self, bucket: str, object_name: str,
                            upload_id: str) -> MultipartInfo:

@@ -87,10 +87,23 @@ class ErasureServerPools(ObjectLayer):
         idx = self.get_pool_idx(bucket, object_name)
         return self.pools[idx].put_object(bucket, object_name, data, opts)
 
+    def put_object_stream(self, bucket, object_name, reader,
+                          opts=None) -> ObjectInfo:
+        idx = self.get_pool_idx(bucket, object_name)
+        return self.pools[idx].put_object_stream(bucket, object_name,
+                                                 reader, opts)
+
     def get_object(self, bucket, object_name, offset=0, length=-1,
                    opts=None):
         self.get_bucket_info(bucket)
         return self._find_pool(bucket, object_name, opts).get_object(
+            bucket, object_name, offset, length, opts)
+
+    def get_object_reader(self, bucket, object_name, offset=0, length=-1,
+                          opts=None):
+        self.get_bucket_info(bucket)
+        return self._find_pool(bucket, object_name,
+                               opts).get_object_reader(
             bucket, object_name, offset, length, opts)
 
     def get_object_info(self, bucket, object_name, opts=None) -> ObjectInfo:

@@ -93,9 +93,19 @@ class ErasureSets(ObjectLayer):
         return self.get_hashed_set(object_name).put_object(
             bucket, object_name, data, opts)
 
+    def put_object_stream(self, bucket, object_name, reader,
+                          opts=None) -> ObjectInfo:
+        return self.get_hashed_set(object_name).put_object_stream(
+            bucket, object_name, reader, opts)
+
     def get_object(self, bucket, object_name, offset=0, length=-1,
                    opts=None):
         return self.get_hashed_set(object_name).get_object(
+            bucket, object_name, offset, length, opts)
+
+    def get_object_reader(self, bucket, object_name, offset=0, length=-1,
+                          opts=None):
+        return self.get_hashed_set(object_name).get_object_reader(
             bucket, object_name, offset, length, opts)
 
     def get_object_info(self, bucket, object_name, opts=None) -> ObjectInfo:

@@ -27,9 +27,94 @@ from . import errors as s3err
 from . import sigv4
 
 MAX_OBJECT_SIZE = 5 * 1024 * 1024 * 1024 * 1024  # 5 TiB (docs/minio-limits.md)
+MAX_PUT_SIZE = 5 * 1024 * 1024 * 1024   # single PUT / part (minio-limits:28)
+# bodies above this stream straight into the object layer (O(batch) RSS);
+# smaller ones take the simpler buffered path
+STREAM_PUT_THRESHOLD = 8 * 1024 * 1024
 S3_NS = "http://s3.amazonaws.com/doc/2006-03-01/"
 
 _BUCKET_RE = re.compile(r"^[a-z0-9][a-z0-9.\-]{1,61}[a-z0-9]$")
+
+
+class _BodyReader:
+    """Bounded socket-body reader with optional integrity checks: caps
+    reads at the declared Content-Length, raises IncompleteBody when the
+    peer hangs up early, and verifies sha256/md5 digests at EOF — the
+    hash.Reader analog (pkg/hash) that lets PUTs stream while keeping
+    the commit gated on body integrity."""
+
+    def __init__(self, raw, total: int, sha256_hex: str | None = None,
+                 md5_digest: bytes | None = None):
+        self.raw = raw
+        self.remaining = total
+        self._sha = hashlib.sha256() if sha256_hex else None
+        self._want_sha = sha256_hex
+        self._md5 = hashlib.md5() if md5_digest else None
+        self._want_md5 = md5_digest
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            n = self.remaining
+        n = min(n, self.remaining)
+        if n <= 0:
+            return b""
+        chunks = []
+        while n > 0:
+            c = self.raw.read(n)
+            if not c:
+                raise S3Error("IncompleteBody")
+            chunks.append(c)
+            n -= len(c)
+            self.remaining -= len(c)
+        data = chunks[0] if len(chunks) == 1 else b"".join(chunks)
+        if self._sha is not None:
+            self._sha.update(data)
+        if self._md5 is not None:
+            self._md5.update(data)
+        if self.remaining == 0:
+            if self._sha is not None and \
+                    self._sha.hexdigest() != self._want_sha:
+                raise S3Error("BadDigest")
+            if self._md5 is not None and \
+                    self._md5.digest() != self._want_md5:
+                raise S3Error("BadDigest")
+        return data
+
+    def readline(self, limit: int = 8192) -> bytes:
+        """Bounded readline for aws-chunked frame headers."""
+        out = bytearray()
+        while len(out) < limit and self.remaining > 0:
+            c = self.raw.read(1)
+            if not c:
+                raise S3Error("IncompleteBody")
+            self.remaining -= 1
+            out += c
+            if out.endswith(b"\r\n"):
+                break
+        return bytes(out)
+
+
+class _MD5Reader:
+    """Content-MD5 verification over an already-decoded stream (the
+    aws-chunked plain view), checked at EOF before the commit."""
+
+    def __init__(self, inner, want_md5: bytes):
+        self.inner = inner
+        self._md5 = hashlib.md5()
+        self._want = want_md5
+        self._checked = False
+
+    def read(self, n: int = -1) -> bytes:
+        data = self.inner.read(n)
+        if data:
+            self._md5.update(data)
+        elif not self._checked:
+            self._checked = True
+            if self._md5.digest() != self._want:
+                raise S3Error("BadDigest")
+        return data
+
+
 
 
 class S3Error(Exception):
@@ -374,19 +459,20 @@ def _make_handler(srv: S3Server):
                                            resource):
                 raise S3Error("AccessDenied")
 
-        def _send(self, status: int, body: bytes = b"",
-                  content_type: str = "application/xml",
-                  headers: dict | None = None,
-                  content_length: int | None = None):
-            """content_length: explicit value for HEAD responses (body is
-            not sent but the header must describe the entity)."""
+        def _send_prologue(self, status: int, sent_bytes: int,
+                           entity_len: int, content_type: str,
+                           headers: dict | None):
+            """Shared response plumbing (metrics, trace bookkeeping,
+            status line + common headers) for _send and _send_stream.
+            sent_bytes feeds metrics (0 for HEAD); entity_len is the
+            Content-Length header value."""
             from ..admin.metrics import GLOBAL as mtr
             mtr.inc("mt_s3_requests_total",
                     {"method": self.command, "status": str(status)})
-            mtr.inc("mt_s3_tx_bytes_total", value=len(body))
+            mtr.inc("mt_s3_tx_bytes_total", value=sent_bytes)
             self._resp_status = status
             self._resp_headers = dict(headers or {})
-            self._resp_bytes = getattr(self, "_resp_bytes", 0) + len(body)
+            self._resp_bytes = getattr(self, "_resp_bytes", 0) + sent_bytes
             if not getattr(self, "_ttfb_ns", 0) and \
                     getattr(self, "_t0_ns", 0):
                 import time as _time
@@ -399,19 +485,54 @@ def _make_handler(srv: S3Server):
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.send_header("Content-Type", content_type)
-            if content_length is not None:
-                self.send_header("Content-Length", str(content_length))
-            else:
-                self.send_header("Content-Length", str(len(body)))
+            self.send_header("Content-Length", str(entity_len))
             self.end_headers()
+
+        def _send(self, status: int, body: bytes = b"",
+                  content_type: str = "application/xml",
+                  headers: dict | None = None,
+                  content_length: int | None = None):
+            """content_length: explicit value for HEAD responses (body is
+            not sent but the header must describe the entity)."""
+            self._send_prologue(
+                status, len(body),
+                len(body) if content_length is None else content_length,
+                content_type, headers)
             if body and self.command != "HEAD":
                 self.wfile.write(body)
+
+        def _send_stream(self, status: int, gen, total: int,
+                         content_type: str, headers: dict | None = None):
+            """Stream a known-length body chunk by chunk (the
+            NewGetObjectReader pipeline end, cmd/object-api-utils.go:586).
+            On a mid-stream failure the connection is dropped — the
+            short body vs Content-Length signals truncation."""
+            # pull the first chunk BEFORE committing the status line so
+            # an immediately-failing read still yields a proper XML error
+            it = iter(gen)
+            first = b""
+            if self.command != "HEAD" and total:
+                try:
+                    first = next(it)
+                except StopIteration:
+                    first = b""
+            self._send_prologue(status, total, total, content_type,
+                                headers)
+            try:
+                if first:
+                    self.wfile.write(first)
+                for chunk in it:
+                    if chunk:
+                        self.wfile.write(chunk)
+            except Exception:   # noqa: BLE001 — headers are gone; a
+                # second response would corrupt the stream
+                self.close_connection = True
 
         def _fail(self, e: Exception, resource: str = ""):
             from ..crypto.sse import SSEError
             if isinstance(e, S3Error):
                 api = e.api
-            elif isinstance(e, SSEError):
+            elif isinstance(e, (SSEError, sigv4.SigV4Error)):
                 api = s3err.get(e.code)
             elif isinstance(e, ol.ObjectLayerError):
                 api = s3err.from_object_error(e)
@@ -489,6 +610,8 @@ def _make_handler(srv: S3Server):
                     if web_handlers.handle(self, srv, path, query,
                                            self._body):
                         return
+                if self._try_stream_put(path, bucket, key, query):
+                    return
                 payload = self._body()
                 self._rx_bytes = len(payload)
                 mtr.inc("mt_s3_rx_bytes_total", value=len(payload))
@@ -1623,6 +1746,203 @@ def _make_handler(srv: S3Server):
                 ET.SubElement(pe, "Size").text = str(p.size)
             self._send(200, _xml(root))
 
+        # -- streaming PUT (cmd/erasure-encode.go block pipeline over the
+        # socket: body is never buffered; 5 GiB single PUT works in
+        # O(batch) memory) ------------------------------------------------
+
+        def _try_stream_put(self, path, bucket, key, query) -> bool:
+            """Route large plain object PUTs / part uploads through the
+            streaming pipeline.  Returns True when the request was fully
+            handled (success or error); False falls back to the buffered
+            path WITHOUT having consumed any body bytes."""
+            if self.command != "PUT" or not bucket or not key:
+                return False
+            if path.startswith("/minio-tpu/") or bucket == "minio-tpu" \
+                    or not _BUCKET_RE.match(bucket):
+                return False
+            if any(q in query for q in ("tagging", "retention",
+                                        "legal-hold", "acl")):
+                return False
+            if "x-amz-copy-source" in self.headers:
+                return False
+            cl_hdr = self.headers.get("Content-Length")
+            if cl_hdr is None:
+                return False
+            try:
+                cl = int(cl_hdr)
+            except ValueError:
+                return False
+            if cl <= STREAM_PUT_THRESHOLD:
+                return False
+            try:
+                if cl > MAX_PUT_SIZE:
+                    raise S3Error("EntityTooLarge")
+                # SSE and transparent compression transform the body and
+                # are not streamed yet: those bodies take the buffered
+                # path (bounded by max_body_size)
+                from ..crypto import sse as csse
+                if "uploadId" in query:
+                    try:
+                        mp = srv.layer.get_multipart_info(
+                            bucket, key, query["uploadId"][0])
+                        transforming = csse.is_encrypted(mp.user_defined)
+                    except Exception:  # noqa: BLE001 — invalid upload id
+                        return False   # buffered path raises it properly
+                else:
+                    transforming = bool(csse.requested_sse(
+                        self.headers, self._bucket_sse_algo(bucket))) \
+                        or self._compression_eligible(key, cl)
+                if transforming:
+                    if cl > srv.max_body_size:
+                        raise S3Error("EntityTooLarge")
+                    return False
+            except S3Error as e:
+                self._fail(e, path)
+                self.close_connection = True
+                return True
+            # committed to streaming from here: any failure must be
+            # answered in-line and the (half-read) connection dropped
+            try:
+                reader = self._auth_stream(path, query)
+                self._rx_bytes = cl
+                from ..admin.metrics import GLOBAL as mtr
+                mtr.inc("mt_s3_rx_bytes_total", value=cl)
+                if "uploadId" in query:
+                    self._stream_upload_part(bucket, key, query, reader,
+                                             cl)
+                else:
+                    self._stream_put_object(bucket, key, reader, cl)
+            except Exception as e:  # noqa: BLE001 — XML like dispatch
+                self._fail(e, path)
+                self.close_connection = True
+            return True
+
+        def _compression_eligible(self, key: str, size: int) -> bool:
+            from .. import compress as mtc
+            if srv.config.get("compression", "enable") != "on":
+                return False
+            exts = [e for e in srv.config.get(
+                "compression", "extensions").split(",") if e]
+            types = [t for t in srv.config.get(
+                "compression", "mime_types").split(",") if t]
+            ct = self.headers.get("Content-Type", "")
+            return mtc.is_compressible(key, ct, size, exts, types)
+
+        def _auth_stream(self, path, query):
+            """Authenticate a PUT without buffering its body; returns the
+            verified body reader (signature first, digests checked at
+            EOF before the object layer commits)."""
+            self._query_token = query.get("X-Amz-Security-Token", [""])[0]
+            cl = int(self.headers["Content-Length"])
+            hdrs = {k: v for k, v in self.headers.items()}
+            lookup = srv.iam.lookup_secret
+            md5_hdr = self.headers.get("Content-MD5")
+            want_md5 = None
+            if md5_hdr:
+                import base64
+                try:
+                    want_md5 = base64.b64decode(md5_hdr)
+                except Exception as e:
+                    raise S3Error("InvalidDigest") from e
+            sha = self.headers.get("x-amz-content-sha256")
+            try:
+                if "Authorization" not in hdrs and \
+                        "X-Amz-Signature" not in query and \
+                        not ("Signature" in query and
+                             "AWSAccessKeyId" in query):
+                    self.access_key = ""
+                    body = _BodyReader(
+                        self.rfile, cl,
+                        sha256_hex=(sha if sha and
+                                    sha != sigv4.UNSIGNED_PAYLOAD
+                                    else None),
+                        md5_digest=want_md5)
+                elif hdrs.get("Authorization", "").startswith("AWS "):
+                    from . import sigv2
+                    self.access_key = sigv2.verify_request(
+                        lookup, self.command, path, query, hdrs)
+                    body = _BodyReader(self.rfile, cl,
+                                       md5_digest=want_md5)
+                elif "Signature" in query and "AWSAccessKeyId" in query:
+                    from . import sigv2
+                    self.access_key = sigv2.verify_presigned(
+                        lookup, self.command, path, query, hdrs)
+                    body = _BodyReader(self.rfile, cl,
+                                       md5_digest=want_md5)
+                elif "X-Amz-Signature" in query:
+                    self.access_key = sigv4.verify_presigned(
+                        lookup, self.command, path, query, hdrs,
+                        region=srv.region)
+                    body = _BodyReader(self.rfile, cl,
+                                       md5_digest=want_md5)
+                elif sha == sigv4.STREAMING_PAYLOAD:
+                    self.access_key, key, seed, amz_date, scope = \
+                        sigv4.verify_request_streaming(
+                            lookup, self.command, path, query, hdrs,
+                            region=srv.region)
+                    framed = _BodyReader(self.rfile, cl)
+                    body = sigv4.ChunkedStreamReader(framed, key, seed,
+                                                     amz_date, scope)
+                    if want_md5 is not None:
+                        body = _MD5Reader(body, want_md5)
+                else:
+                    sha_eff = sha or sigv4.UNSIGNED_PAYLOAD
+                    self.access_key = sigv4.verify_request(
+                        lookup, self.command, path, query, hdrs, sha_eff,
+                        region=srv.region)
+                    body = _BodyReader(
+                        self.rfile, cl,
+                        sha256_hex=(sha_eff
+                                    if sha_eff != sigv4.UNSIGNED_PAYLOAD
+                                    else None),
+                        md5_digest=want_md5)
+            except sigv4.SigV4Error as e:
+                raise S3Error(e.code) from e
+            self._check_session_token()
+            return body
+
+        def _stream_put_object(self, bucket, key, reader, cl: int):
+            self._allow(iampol.PUT_OBJECT, f"{bucket}/{key}")
+            user_defined = {}
+            ct = self.headers.get("Content-Type")
+            if ct:
+                user_defined["content-type"] = ct
+            for h, v in self.headers.items():
+                if h.lower().startswith("x-amz-meta-"):
+                    user_defined[h.lower()] = v
+            user_defined.update(self._tagging_header_meta())
+            user_defined.update(self._lock_headers(bucket, key))
+            self._check_quota(bucket, cl)
+            versioned = srv.bucket_meta.versioning_enabled(bucket)
+            tiered_ud = None if versioned else \
+                self._tiered_meta_of(bucket, key, "", False)
+            oi = srv.layer.put_object_stream(
+                bucket, key, reader,
+                ol.PutObjectOptions(
+                    user_defined=user_defined, versioned=versioned,
+                    parity=self._storage_class_parity(user_defined)))
+            if tiered_ud is not None:
+                srv.transition.delete_tiered(tiered_ud)
+            hdrs = {"ETag": f'"{oi.etag}"'}
+            if oi.version_id:
+                hdrs["x-amz-version-id"] = oi.version_id
+            srv.notify("s3:ObjectCreated:Put", bucket, oi)
+            srv.replicate(bucket, oi)
+            self._send(200, headers=hdrs)
+
+        def _stream_upload_part(self, bucket, key, query, reader,
+                                cl: int):
+            self._allow(iampol.PUT_OBJECT, f"{bucket}/{key}")
+            uid = query["uploadId"][0]
+            try:
+                part_num = int(query["partNumber"][0])
+            except (KeyError, ValueError) as e:
+                raise S3Error("InvalidArgument") from e
+            self._check_quota(bucket, cl)
+            pi = srv.layer.put_object_part(bucket, key, uid, part_num,
+                                           reader)
+            self._send(200, headers={"ETag": f'"{pi.etag}"'})
+
         def _put_object(self, bucket, key, query, payload):
             if "Content-Length" not in self.headers:
                 raise S3Error("MissingContentLength")
@@ -1907,6 +2227,7 @@ def _make_handler(srv: S3Server):
                                      "Last-Modified":
                                      _http_date(oi_pre.mod_time)},
                             content_length=0)
+                body_gen = None    # streaming plain-object body
                 if rng:
                     offset, length = _parse_range(rng)
                 if head or rng:
@@ -1926,13 +2247,16 @@ def _make_handler(srv: S3Server):
                     if rng and not oi.delete_marker and \
                             mtc.META_COMPRESSION not in oi.user_defined \
                             and not csse.is_encrypted(oi.user_defined):
-                        oi, data = srv.layer.get_object(
+                        # plain ranged GET: only covering blocks are read
+                        # and the body streams (erasure-decode.go:229-246)
+                        oi, body_gen = srv.layer.get_object_reader(
                             bucket, key, offset, length, opts)
                 else:
-                    # full GET: one read returns metadata + data for every
-                    # object class (the stored stream is decoded below)
-                    oi, data = srv.layer.get_object(bucket, key, 0, -1,
-                                                    opts)
+                    # full GET: reader returns metadata + a body stream;
+                    # transform paths (SSE/compression) materialize below
+                    oi, body_gen = srv.layer.get_object_reader(
+                        bucket, key, 0, -1, opts)
+                    data = None
                 if not head and oi.delete_marker:
                     raise ol.MethodNotAllowed(key)
                 from ..objectlayer import tiering
@@ -1947,6 +2271,10 @@ def _make_handler(srv: S3Server):
                     not oi.delete_marker and not stubbed
                 compressed = mtc.META_COMPRESSION in oi.user_defined and \
                     not oi.delete_marker and not stubbed
+                if body_gen is not None and (encrypted or compressed):
+                    # transform paths need the stored bytes in hand
+                    data = b"".join(body_gen)
+                    body_gen = None
                 if stubbed:
                     # HEAD of the stub reports the archived identity
                     plain_size = int(oi.user_defined.get(
@@ -2050,10 +2378,22 @@ def _make_handler(srv: S3Server):
                 return self._send(200, b"", content_type=ct, headers=hdrs,
                                   content_length=entity_size)
             if rng:
+                if body_gen is not None:
+                    start = max(0, entity_size + offset) if offset < 0 \
+                        else offset
+                    sent = entity_size - start if length < 0 \
+                        else min(length, entity_size - start)
+                    hdrs["Content-Range"] = \
+                        f"bytes {start}-{start + sent - 1}/{entity_size}"
+                    return self._send_stream(206, body_gen, sent, ct,
+                                             hdrs)
                 start = entity_size - len(data) if offset < 0 else offset
                 hdrs["Content-Range"] = \
                     f"bytes {start}-{start + len(data) - 1}/{entity_size}"
                 return self._send(206, data, content_type=ct, headers=hdrs)
+            if body_gen is not None:
+                return self._send_stream(200, body_gen, entity_size, ct,
+                                         hdrs)
             return self._send(200, data, content_type=ct, headers=hdrs)
 
         def _storage_class_parity(self, user_defined: dict) -> int | None:

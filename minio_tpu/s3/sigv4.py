@@ -330,6 +330,78 @@ def decode_chunked_payload(body: bytes, key: bytes, seed_signature: str,
     return bytes(out)
 
 
+class ChunkedStreamReader:
+    """Incremental STREAMING-AWS4-HMAC-SHA256-PAYLOAD decoder
+    (cmd/streaming-signature-v4.go:156 newSignV4ChunkedReader): reads the
+    framed body from ``raw`` (file-like with .readline/.read), verifies
+    each chunk's signature chain, and exposes plain .read(n) so a 5 GiB
+    aws-chunked PUT streams without buffering."""
+
+    MAX_CHUNK_SIZE = 16 * 1024 * 1024   # maxChunkSize guard: one declared
+    # chunk must never force a multi-GiB buffer before its signature check
+
+    def __init__(self, raw, key: bytes, seed_signature: str,
+                 amz_date: str, scope: str):
+        self.raw = raw
+        self.key = key
+        self.prev = seed_signature
+        self.amz_date = amz_date
+        self.scope = scope
+        self.buf = bytearray()
+        self.done = False
+
+    def _next_chunk(self) -> bytes:
+        line = self.raw.readline(8192)
+        if not line.endswith(b"\r\n"):
+            raise SigV4Error("IncompleteBody", "missing chunk header")
+        header = line[:-2].decode("ascii", "replace")
+        if ";chunk-signature=" not in header:
+            raise SigV4Error("SignatureDoesNotMatch", "bad chunk header")
+        size_hex, sig = header.split(";chunk-signature=", 1)
+        try:
+            size = int(size_hex, 16)
+        except ValueError as e:
+            raise SigV4Error("IncompleteBody", "bad chunk size") from e
+        if size > self.MAX_CHUNK_SIZE:
+            raise SigV4Error("InvalidRequest",
+                             f"chunk size {size} exceeds maximum")
+        chunks = []
+        remaining = size
+        while remaining > 0:
+            c = self.raw.read(remaining)
+            if not c:
+                raise SigV4Error("IncompleteBody", "short chunk")
+            chunks.append(c)
+            remaining -= len(c)
+        data = b"".join(chunks)
+        if self.raw.read(2) != b"\r\n":
+            raise SigV4Error("IncompleteBody", "missing chunk trailer")
+        sts = "\n".join([
+            "AWS4-HMAC-SHA256-PAYLOAD", self.amz_date, self.scope,
+            self.prev, EMPTY_SHA256, hashlib.sha256(data).hexdigest()])
+        want = hmac.new(self.key, sts.encode(), hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want, sig):
+            raise SigV4Error("SignatureDoesNotMatch",
+                             "chunk signature mismatch")
+        self.prev = want
+        if size == 0:
+            self.done = True
+        return data
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            while not self.done:
+                self.buf += self._next_chunk()
+            out = bytes(self.buf)
+            self.buf = bytearray()
+            return out
+        while len(self.buf) < n and not self.done:
+            self.buf += self._next_chunk()
+        out = bytes(self.buf[:n])
+        del self.buf[:n]
+        return out
+
+
 def verify_presigned(lookup_secret, method: str, path: str,
                      query: dict[str, list[str]], headers: dict[str, str],
                      region: str = "us-east-1",
