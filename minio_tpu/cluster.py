@@ -75,13 +75,23 @@ class Node:
         sdc = self._set_drive_count or n
         assert n % sdc == 0
         fmt = load_or_init_format(disks, n // sdc, sdc)
+        # drive lifecycle wrappers + reconnect monitor: offline drives
+        # fail fast, returned drives are identity-verified, wiped drives
+        # are reformatted and the owning set healed
+        # (cmd/erasure-sets.go:196-332)
+        from .storage import health as health_mod
+        disks, bind = health_mod.wrap_with_heal(disks, fmt, sdc)
         self.layer = ErasureSets(
             disks, n // sdc, sdc, deployment_id=fmt.id,
             distribution_algo=fmt.distribution_algo,
             ns_lock=NamespaceLock(lockers), **self._set_kwargs)
+        bind(self.layer)
+        self.monitor = self.layer.start_drive_monitor()
         return self.layer
 
     def stop(self) -> None:
+        if getattr(self, "monitor", None) is not None:
+            self.monitor.stop()
         self.rpc.stop()
 
 

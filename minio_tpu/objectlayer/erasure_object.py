@@ -276,6 +276,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
 
     def _put_object_bytes(self, bucket: str, object_name: str, data: bytes,
                           opts: PutObjectOptions) -> ObjectInfo:
+        self._check_bucket(bucket)
         n = len(self.disks)
         k, m = self._geometry(opts.parity)
         etag = hashlib.md5(data).hexdigest()
@@ -427,6 +428,11 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             fi.size = total
             fi.metadata = {ETAG_KEY: etag, **opts.user_defined}
             fi.parts = [ObjectPartInfo(1, total, total, etag, mod_time)]
+            # the lock was held across the whole body stream; if its
+            # grants fell below quorum meanwhile, committing would race
+            # a new writer (drwmutex refresh-loss semantics)
+            if hasattr(lk, "ensure_valid"):
+                lk.ensure_valid()
 
             def commit_one(idx_disk):
                 idx, disk = idx_disk

@@ -46,13 +46,42 @@ class ErasureSets(ObjectLayer):
 
     @classmethod
     def from_dirs(cls, dirs: list[str], set_count: int,
-                  set_drive_count: int, **set_kwargs) -> "ErasureSets":
-        """Format-aware constructor (waitForFormatErasure analog)."""
+                  set_drive_count: int, health: bool = True,
+                  **set_kwargs) -> "ErasureSets":
+        """Format-aware constructor (waitForFormatErasure analog).  With
+        ``health`` each drive gets the lifecycle wrapper: offline
+        detection, identity-verified reconnect, heal-on-return
+        (cmd/erasure-sets.go:196-332)."""
         disks = [XLStorage(d) for d in dirs]
         fmt = load_or_init_format(disks, set_count, set_drive_count)
-        return cls(disks, set_count, set_drive_count,
-                   deployment_id=fmt.id,
-                   distribution_algo=fmt.distribution_algo, **set_kwargs)
+        bind = None
+        if health:
+            from ..storage import health as health_mod
+            disks, bind = health_mod.wrap_with_heal(disks, fmt,
+                                                    set_drive_count)
+        obj = cls(disks, set_count, set_drive_count,
+                  deployment_id=fmt.id,
+                  distribution_algo=fmt.distribution_algo, **set_kwargs)
+        if bind is not None:
+            bind(obj)
+        return obj
+
+    def set_for_disk(self, disk) -> "ErasureObjects | None":
+        """The erasure set owning a given drive (identity match)."""
+        for s in self.sets:
+            if any(d is disk for d in s.disks):
+                return s
+        return None
+
+    def start_drive_monitor(self, interval_s: float = 5.0):
+        """Background reconnect monitor over every health-wrapped drive
+        (monitorAndConnectEndpoints, cmd/erasure-sets.go:269)."""
+        from ..storage.health import DriveMonitor, HealthDisk
+        all_disks = [d for s in self.sets for d in s.disks
+                     if isinstance(d, HealthDisk)]
+        self.monitor = DriveMonitor(all_disks, interval_s=interval_s)
+        self.monitor.start()
+        return self.monitor
 
     # -- distribution (cmd/erasure-sets.go:629-661) ------------------------
 

@@ -1777,6 +1777,14 @@ def _make_handler(srv: S3Server):
             try:
                 if cl > MAX_PUT_SIZE:
                     raise S3Error("EntityTooLarge")
+                # only layers with a REAL streaming override may take
+                # this route — the ObjectLayer default would buffer the
+                # whole body, bypassing max_body_size
+                if type(srv.layer).put_object_stream \
+                        is ol.ObjectLayer.put_object_stream:
+                    if cl > srv.max_body_size:
+                        raise S3Error("EntityTooLarge")
+                    return False
                 # SSE and transparent compression transform the body and
                 # are not streamed yet: those bodies take the buffered
                 # path (bounded by max_body_size)

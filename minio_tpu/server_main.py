@@ -58,6 +58,7 @@ def build_server(dirs: list[str], address: str = "127.0.0.1:9000",
     if block_size:
         kwargs["block_size"] = block_size
     layer = ErasureSets.from_dirs(dirs, len(dirs) // sdc, sdc, **kwargs)
+    layer.start_drive_monitor()
     host, _, port = address.rpartition(":")
     srv = S3Server(layer, access_key=access_key, secret_key=secret_key,
                    region=region, host=host or "0.0.0.0", port=int(port))

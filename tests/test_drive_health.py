@@ -1,0 +1,174 @@
+"""Drive lifecycle state machine tests (cmd/erasure-sets.go:196-332
+connectDisks/monitorAndConnectEndpoints, cmd/xl-storage-disk-id-check.go,
+cmd/background-newdisks-heal-ops.go).
+
+Scenario coverage: offline detection + fail-fast circuit breaking,
+half-open probing, identity-verified reconnect, wiped-drive reformat +
+automatic heal-on-return, swapped-drive rejection.
+"""
+
+import os
+import shutil
+import time
+
+import pytest
+
+from minio_tpu.objectlayer.sets import ErasureSets
+from minio_tpu.storage import errors as serrors
+from minio_tpu.storage.format import (FORMAT_FILE, FormatErasure,
+                                      read_format)
+from minio_tpu.storage.health import DriveMonitor, HealthDisk
+from minio_tpu.storage.xl_storage import SYS_DIR, XLStorage
+
+
+@pytest.fixture()
+def sets_layer(tmp_path):
+    dirs = [str(tmp_path / f"hd{i}") for i in range(4)]
+    for d in dirs:
+        os.makedirs(d)
+    lay = ErasureSets.from_dirs(dirs, 1, 4, parity=2,
+                                block_size=64 * 1024, backend="numpy")
+    lay.make_bucket("healthbkt")
+    return lay, dirs
+
+
+def test_disks_are_health_wrapped(sets_layer):
+    lay, _ = sets_layer
+    assert all(isinstance(d, HealthDisk) for d in lay.sets[0].disks)
+    assert all(d.expected_format is not None for d in lay.sets[0].disks)
+
+
+def test_offline_detection_and_fail_fast(sets_layer):
+    lay, dirs = sets_layer
+    set0 = lay.sets[0]
+    lay.put_object("healthbkt", "obj", b"x" * 50_000)
+
+    # kill drive 0's directory: first touch marks it offline
+    shutil.rmtree(dirs[0])
+    hd = set0.disks[0]
+    with pytest.raises(serrors.StorageError):
+        hd.stat_vol("healthbkt")
+    assert hd.offline
+
+    # circuit open: fail-fast without touching the filesystem
+    with pytest.raises(serrors.DiskNotFound):
+        hd.read_all("healthbkt", "nope/xl.meta")
+
+    # reads still serve from the remaining 3 drives (k=2, m=2)
+    assert lay.get_object("healthbkt", "obj")[1] == b"x" * 50_000
+
+    # writes still meet quorum (wq=2... write quorum k=2)
+    lay.put_object("healthbkt", "obj2", b"y" * 10_000)
+
+
+def test_wiped_drive_reformat_and_heal_on_return(sets_layer):
+    lay, dirs = sets_layer
+    set0 = lay.sets[0]
+    body = os.urandom(120_000)
+    lay.put_object("healthbkt", "healme", body)
+
+    hd = set0.disks[0]
+    want_id = hd.expected_format.this
+
+    # wipe + trip the breaker
+    shutil.rmtree(dirs[0])
+    with pytest.raises(serrors.StorageError):
+        hd.stat_vol("healthbkt")
+    assert hd.offline
+
+    # restore an EMPTY directory (fresh replacement drive)
+    os.makedirs(dirs[0])
+    monitor = DriveMonitor(set0.disks, interval_s=0.1)
+    monitor.start()
+    try:
+        deadline = time.monotonic() + 10
+        while hd.offline and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not hd.offline, "monitor never re-admitted the drive"
+        # identity restamped (background-newdisks-heal-ops analog)
+        fmt = read_format(XLStorage(dirs[0]))
+        assert fmt.this == want_id
+
+        # heal-on-return repopulates shard files WITHOUT a manual heal
+        deadline = time.monotonic() + 15
+        healed = False
+        while time.monotonic() < deadline:
+            shard_files = []
+            for root, _d, files in os.walk(os.path.join(dirs[0],
+                                                        "healthbkt")):
+                shard_files += [f for f in files
+                                if f.startswith("part.")
+                                or f == "xl.meta"]
+            if shard_files:
+                healed = True
+                break
+            time.sleep(0.1)
+        assert healed, "heal-on-return never repopulated the drive"
+    finally:
+        monitor.stop()
+    assert lay.get_object("healthbkt", "healme")[1] == body
+
+
+def test_swapped_drive_stays_offline(sets_layer):
+    lay, dirs = sets_layer
+    set0 = lay.sets[0]
+    hd = set0.disks[0]
+
+    shutil.rmtree(dirs[0])
+    with pytest.raises(serrors.StorageError):
+        hd.stat_vol("healthbkt")
+    assert hd.offline
+
+    # a FOREIGN formatted drive appears at the same path
+    os.makedirs(dirs[0])
+    foreign = XLStorage(dirs[0])
+    foreign.write_all(SYS_DIR, FORMAT_FILE, FormatErasure(
+        id="ffffffff-0000-0000-0000-000000000000",
+        this="eeeeeeee-0000-0000-0000-000000000000",
+        sets=[["eeeeeeee-0000-0000-0000-000000000000"]]).to_json()
+        .encode())
+    assert hd.probe() is None
+    assert hd.offline, "swapped drive must not be re-admitted"
+
+
+def test_half_open_probe_readmits_without_monitor(sets_layer):
+    """Even with no monitor, the cooldown half-open probe re-admits a
+    healthy drive on the next call (storage-rest-client optimistic
+    reconnect analog)."""
+    lay, dirs = sets_layer
+    set0 = lay.sets[0]
+    hd = set0.disks[0]
+    hd.cooldown_s = 0.1
+    fmt_backup = open(os.path.join(dirs[0], SYS_DIR, FORMAT_FILE),
+                      "rb").read()
+
+    shutil.rmtree(dirs[0])
+    with pytest.raises(serrors.StorageError):
+        hd.stat_vol("healthbkt")
+    assert hd.offline
+
+    # drive comes back intact (remount) — with its format
+    os.makedirs(os.path.join(dirs[0], SYS_DIR, "tmp"))
+    with open(os.path.join(dirs[0], SYS_DIR, FORMAT_FILE), "wb") as f:
+        f.write(fmt_backup)
+    time.sleep(0.15)
+    hd.make_vol("healthbkt")    # half-open probe runs, drive re-admitted
+    assert not hd.offline
+
+
+def test_monitor_detects_identity_swap_of_online_drive(sets_layer):
+    """The periodic identity revalidation (disk-id check analog) takes a
+    silently swapped drive offline."""
+    lay, dirs = sets_layer
+    set0 = lay.sets[0]
+    hd = set0.disks[0]
+    assert not hd.offline
+    # overwrite format.json with a foreign identity in place
+    foreign = FormatErasure(
+        id=hd.expected_format.id, sets=hd.expected_format.sets,
+        this="dddddddd-0000-0000-0000-000000000000")
+    with open(os.path.join(dirs[0], SYS_DIR, FORMAT_FILE), "w") as f:
+        f.write(foreign.to_json())
+    mon = DriveMonitor(set0.disks, interval_s=0.05, verify_every=1)
+    mon.poll_once()
+    assert hd.offline
