@@ -2,10 +2,17 @@
 local locker (cmd/local-locker.go:50) + namespace map
 (cmd/namespace-lock.go:67).
 
-A DRWMutex acquires a named resource on ALL locker nodes concurrently;
-the lock is held when >= quorum grants arrive (write: n/2+1, read: n/2);
-on a failed round every grant is released and the acquire retries with
-jitter until timeout (drwmutex.go:143-321).  Lockers are in-process
+A DRWMutex acquires a named resource on ALL locker nodes CONCURRENTLY
+(drwmutex.go:207-297 fans out with per-locker timeouts); the lock is held
+when >= quorum grants arrive (write: n/2+1, read: n/2); on a failed round
+every grant is released and the acquire retries with growing jittered
+backoff until timeout (drwmutex.go:299-321).
+
+Lifecycle: every grant carries a TTL.  A held DRWMutex refreshes its
+grants in the background (drwmutex.go startContinousLockRefresh analog);
+a holder that crashes stops refreshing and its grants expire, so another
+node acquires within one TTL — no leaked lock wedges an object forever
+(cmd/local-locker.go expireOldLocks).  Lockers are in-process
 (LocalLocker) or remote over the internode RPC (RemoteLocker) — any mix.
 """
 
@@ -19,34 +26,88 @@ from dataclasses import dataclass, field
 
 from .rpc import RPCClient, RPCError, RPCServer
 
+# grant lifetime (reference: 1 min refresh loop, 2x expiry window —
+# scaled down for snappier failover); holders refresh every ttl/3
+DEFAULT_TTL_S = 30.0
+# per-locker acquire timeout (drwmutex.go:207 fan-out context deadline)
+ACQUIRE_TIMEOUT_S = 3.0
+
 
 class LockTimeout(Exception):
     pass
 
 
+class LockLost(Exception):
+    """The holder's grants fell below quorum (refresh failed after a
+    pause/partition): the critical section is no longer protected."""
+
+
+@dataclass
+class _Grant:
+    refcount: int = 1
+    deadline: float = 0.0
+
+
 @dataclass
 class _LockEntry:
     writer: bool
-    owners: dict[str, int] = field(default_factory=dict)  # uid -> refcount
+    owners: dict[str, _Grant] = field(default_factory=dict)
 
 
 class LocalLocker:
-    """In-process lock table for one node (cmd/local-locker.go)."""
+    """In-process lock table for one node (cmd/local-locker.go) with
+    per-grant TTLs and expiry."""
 
-    def __init__(self):
+    def __init__(self, default_ttl_s: float = DEFAULT_TTL_S):
         self._mu = threading.Lock()
         self._map: dict[str, _LockEntry] = {}
+        self.default_ttl_s = default_ttl_s
 
-    def lock(self, resource: str, uid: str, write: bool) -> bool:
+    def _purge_expired(self, resource: str, now: float) -> None:
+        """Drop expired grants for one resource; caller holds _mu."""
+        e = self._map.get(resource)
+        if e is None:
+            return
+        dead = [uid for uid, g in e.owners.items() if g.deadline <= now]
+        for uid in dead:
+            del e.owners[uid]
+        if not e.owners:
+            self._map.pop(resource, None)
+
+    def lock(self, resource: str, uid: str, write: bool,
+             ttl_s: float | None = None) -> bool:
+        ttl = ttl_s or self.default_ttl_s
+        now = time.monotonic()
         with self._mu:
+            self._purge_expired(resource, now)
             e = self._map.get(resource)
             if e is None:
                 self._map[resource] = _LockEntry(
-                    writer=write, owners={uid: 1})
+                    writer=write,
+                    owners={uid: _Grant(1, now + ttl)})
                 return True
             if write or e.writer:
                 return False                      # exclusive conflict
-            e.owners[uid] = e.owners.get(uid, 0) + 1
+            g = e.owners.get(uid)
+            if g is None:
+                e.owners[uid] = _Grant(1, now + ttl)
+            else:
+                g.refcount += 1
+                g.deadline = max(g.deadline, now + ttl)
+            return True
+
+    def refresh(self, resource: str, uid: str,
+                ttl_s: float | None = None) -> bool:
+        """Extend a held grant (lock-rest RefreshHandler analog);
+        False tells the holder its lock is gone."""
+        ttl = ttl_s or self.default_ttl_s
+        now = time.monotonic()
+        with self._mu:
+            self._purge_expired(resource, now)
+            e = self._map.get(resource)
+            if e is None or uid not in e.owners:
+                return False
+            e.owners[uid].deadline = now + ttl
             return True
 
     def unlock(self, resource: str, uid: str) -> bool:
@@ -54,8 +115,9 @@ class LocalLocker:
             e = self._map.get(resource)
             if e is None or uid not in e.owners:
                 return False
-            e.owners[uid] -= 1
-            if e.owners[uid] <= 0:
+            g = e.owners[uid]
+            g.refcount -= 1
+            if g.refcount <= 0:
                 del e.owners[uid]
             if not e.owners:
                 del self._map[resource]
@@ -67,34 +129,76 @@ class LocalLocker:
 
     def is_locked(self, resource: str) -> bool:
         with self._mu:
+            self._purge_expired(resource, time.monotonic())
             return resource in self._map
+
+    def expire_old_locks(self) -> int:
+        """Full-table expiry sweep (cmd/local-locker.go expireOldLocks);
+        returns grants dropped."""
+        now = time.monotonic()
+        dropped = 0
+        with self._mu:
+            for resource in list(self._map):
+                before = len(self._map[resource].owners)
+                self._purge_expired(resource, now)
+                after = len(self._map[resource].owners) \
+                    if resource in self._map else 0
+                dropped += before - after
+        return dropped
 
     def held(self) -> list[dict]:
         """Currently-held locks (madmin TopLocks introspection)."""
         with self._mu:
+            now = time.monotonic()
+            for resource in list(self._map):
+                self._purge_expired(resource, now)
             return [{"resource": r, "writer": e.writer,
-                     "owners": dict(e.owners)}
+                     "owners": {u: g.refcount
+                                for u, g in e.owners.items()}}
                     for r, e in self._map.items()]
 
 
-def register_lock_service(rpc: RPCServer, locker: LocalLocker) -> None:
-    """Expose a node's locker over RPC (cmd/lock-rest-server.go:383)."""
+def register_lock_service(rpc: RPCServer, locker: LocalLocker,
+                          sweep_interval_s: float = 10.0) -> None:
+    """Expose a node's locker over RPC (cmd/lock-rest-server.go:383) and
+    run its expiry sweep (lockMaintenance loop)."""
     rpc.register("lock", {
-        "lock": lambda resource, uid, write:
-            locker.lock(resource, uid, write),
+        "lock": lambda resource, uid, write, ttl_s=None:
+            locker.lock(resource, uid, write, ttl_s),
+        "refresh": lambda resource, uid, ttl_s=None:
+            locker.refresh(resource, uid, ttl_s),
         "unlock": lambda resource, uid: locker.unlock(resource, uid),
         "force_unlock": lambda resource: locker.force_unlock(resource),
     })
+
+    def sweeper():
+        while True:
+            time.sleep(sweep_interval_s)
+            try:
+                locker.expire_old_locks()
+            except Exception:  # noqa: BLE001
+                pass
+
+    threading.Thread(target=sweeper, daemon=True).start()
 
 
 class RemoteLocker:
     def __init__(self, client: RPCClient):
         self._c = client
 
-    def lock(self, resource: str, uid: str, write: bool) -> bool:
+    def lock(self, resource: str, uid: str, write: bool,
+             ttl_s: float | None = None) -> bool:
         try:
             return bool(self._c.call("lock", "lock", resource=resource,
-                                     uid=uid, write=write))
+                                     uid=uid, write=write, ttl_s=ttl_s))
+        except RPCError:
+            return False
+
+    def refresh(self, resource: str, uid: str,
+                ttl_s: float | None = None) -> bool:
+        try:
+            return bool(self._c.call("lock", "refresh", resource=resource,
+                                     uid=uid, ttl_s=ttl_s))
         except RPCError:
             return False
 
@@ -116,11 +220,18 @@ class RemoteLocker:
 class DRWMutex:
     """Quorum read-write lock over n lockers (pkg/dsync/drwmutex.go)."""
 
-    def __init__(self, lockers: list, resource: str):
+    def __init__(self, lockers: list, resource: str,
+                 ttl_s: float = DEFAULT_TTL_S,
+                 acquire_timeout_s: float = ACQUIRE_TIMEOUT_S):
         self.lockers = lockers
         self.resource = resource
         self.uid = str(uuid.uuid4())
+        self.ttl_s = ttl_s
+        self.acquire_timeout_s = acquire_timeout_s
         self._granted: list[bool] = [False] * len(lockers)
+        self._refresh_stop: threading.Event | None = None
+        self._write = False
+        self.lost = threading.Event()
 
     def _quorum(self, write: bool) -> int:
         n = len(self.lockers)
@@ -131,16 +242,44 @@ class DRWMutex:
         return q
 
     def _try_acquire(self, write: bool) -> bool:
-        granted = []
-        for i, lk in enumerate(self.lockers):
-            ok = False
+        """Fan out Lock to ALL lockers concurrently with a per-locker
+        timeout (drwmutex.go:207-297): one slow/dead locker costs at most
+        acquire_timeout_s, not a serial wait.  One short-lived thread per
+        locker — no shared pool whose exhaustion could fake timeouts."""
+        mu = threading.Lock()
+        state = {"accepting": True}
+        self._granted = [False] * len(self.lockers)
+
+        def one(i, lk):
             try:
-                ok = lk.lock(self.resource, self.uid, write)
-            except Exception:  # noqa: BLE001 — locker down == not granted
+                ok = bool(lk.lock(self.resource, self.uid, write,
+                                  self.ttl_s))
+            except Exception:  # noqa: BLE001 — locker down: not granted
                 ok = False
-            self._granted[i] = ok
-            granted.append(ok)
-        if sum(granted) >= self._quorum(write):
+            with mu:
+                if state["accepting"]:
+                    self._granted[i] = ok
+                    return
+            # straggler granting after the deadline was not counted
+            # toward quorum — release immediately so nothing leaks
+            # (drwmutex.go releases stragglers the same way)
+            if ok:
+                try:
+                    lk.unlock(self.resource, self.uid)
+                except Exception:  # noqa: BLE001
+                    pass
+
+        threads = [threading.Thread(target=one, args=(i, lk), daemon=True)
+                   for i, lk in enumerate(self.lockers)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + self.acquire_timeout_s
+        for t in threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+        with mu:
+            state["accepting"] = False
+            got = sum(self._granted)
+        if got >= self._quorum(write):
             return True
         self._release_all()
         return False
@@ -156,14 +295,57 @@ class DRWMutex:
 
     def lock(self, write: bool = True, timeout: float = 10.0) -> None:
         deadline = time.monotonic() + timeout
+        backoff = 0.002
+        self._write = write
+        self.lost.clear()
         while True:
             if self._try_acquire(write):
+                self._start_refresh()
                 return
             if time.monotonic() >= deadline:
                 raise LockTimeout(self.resource)
-            time.sleep(random.uniform(0.002, 0.02))   # retry jitter :299-321
+            # growing jittered backoff (drwmutex.go:299-321): contention
+            # across nodes must not hammer the lockers at a fixed rate
+            time.sleep(random.uniform(backoff / 2, backoff))
+            backoff = min(backoff * 2, 0.25)
+
+    def _start_refresh(self) -> None:
+        """Holder-side keepalive (startContinousLockRefresh): refresh
+        granted lockers every ttl/3 so long operations outlive the TTL;
+        a crashed holder stops refreshing and the grants expire."""
+        stop = threading.Event()
+        self._refresh_stop = stop
+
+        def loop():
+            while not stop.wait(self.ttl_s / 3):
+                for i, lk in enumerate(self.lockers):
+                    if not self._granted[i]:
+                        continue
+                    try:
+                        if not lk.refresh(self.resource, self.uid,
+                                          self.ttl_s):
+                            self._granted[i] = False
+                    except Exception:  # noqa: BLE001 — locker down:
+                        pass           # transient; grant may still hold
+                # grants below quorum: the holder is no longer protected
+                # (the reference cancels the op context on lost refresh
+                # quorum, drwmutex.go startContinousLockRefresh)
+                if sum(self._granted) < self._quorum(self._write):
+                    self.lost.set()
+
+        threading.Thread(target=loop, daemon=True).start()
+
+    def ensure_valid(self) -> None:
+        """Commit-point guard: raise LockLost if the refresh loop saw
+        the grants fall below quorum — callers must abort rather than
+        commit an unprotected write."""
+        if self.lost.is_set():
+            raise LockLost(self.resource)
 
     def unlock(self) -> None:
+        if self._refresh_stop is not None:
+            self._refresh_stop.set()
+            self._refresh_stop = None
         self._release_all()
 
     def __enter__(self):

@@ -4,6 +4,7 @@ storage RPC loopback + dsync against live lock servers +
 verify-healing.sh-style kill-a-node flows, in-process)."""
 
 import threading
+import time
 
 import pytest
 
@@ -119,6 +120,88 @@ def test_drw_mutex_quorum_with_dead_locker():
     m = DRWMutex(lockers, "res")
     m.lock(write=True, timeout=1.0)     # 2-of-3 quorum holds
     m.unlock()
+
+
+def test_lock_ttl_expiry_frees_crashed_holder():
+    """A holder that stops refreshing (crash analog) loses its grants
+    after one TTL; another client acquires (drwmutex refresh +
+    local-locker expiry, pkg/dsync/drwmutex.go:143-321)."""
+    lockers = [LocalLocker(default_ttl_s=0.3) for _ in range(3)]
+    crashed = DRWMutex(lockers, "res", ttl_s=0.3)
+    crashed.lock(write=True)
+    # simulate kill -9: the refresh loop dies with the process
+    crashed._refresh_stop.set()
+
+    waiter = DRWMutex(lockers, "res", ttl_s=0.3)
+    t0 = time.monotonic()
+    waiter.lock(write=True, timeout=5.0)   # steals after expiry
+    took = time.monotonic() - t0
+    assert took < 2.0, f"stole only after {took:.2f}s"
+    waiter.unlock()
+
+
+def test_lock_refresh_keeps_long_holders_alive():
+    """An alive holder's refresh thread extends the TTL indefinitely —
+    long operations are never stolen from."""
+    lockers = [LocalLocker(default_ttl_s=0.3) for _ in range(3)]
+    holder = DRWMutex(lockers, "res", ttl_s=0.3)
+    holder.lock(write=True)
+    time.sleep(1.0)      # several TTLs pass while refreshing
+    thief = DRWMutex(lockers, "res", ttl_s=0.3)
+    with pytest.raises(LockTimeout):
+        thief.lock(write=True, timeout=0.2)
+    holder.unlock()
+    thief.lock(write=True, timeout=1.0)
+    thief.unlock()
+
+
+def test_lock_acquisition_is_concurrent_not_serial():
+    """Fan-out is concurrent with per-locker timeouts: two slow lockers
+    cost max(delay), not sum (drwmutex.go:207-297)."""
+    class SlowLocker(LocalLocker):
+        def lock(self, *a, **kw):
+            time.sleep(0.4)
+            return super().lock(*a, **kw)
+
+    lockers = [SlowLocker(), SlowLocker(), LocalLocker()]
+    m = DRWMutex(lockers, "res")
+    t0 = time.monotonic()
+    m.lock(write=True, timeout=5.0)
+    took = time.monotonic() - t0
+    m.unlock()
+    assert took < 0.75, f"serial fan-out suspected: {took:.2f}s"
+
+
+def test_lock_lost_surfaces_to_holder():
+    """A holder whose grants expire under it (pause > TTL) must see
+    LockLost at the commit point instead of silently double-writing."""
+    from minio_tpu.parallel.dsync import LockLost
+    lockers = [LocalLocker(default_ttl_s=0.2) for _ in range(3)]
+    holder = DRWMutex(lockers, "res", ttl_s=0.2)
+    holder.lock(write=True)
+    # simulate a long GC/VM pause: stop refreshing, let grants expire,
+    # let a competitor take the lock
+    holder._refresh_stop.set()
+    thief = DRWMutex(lockers, "res", ttl_s=0.2)
+    thief.lock(write=True, timeout=5.0)
+    # resume the holder's refresh loop: one round sees < quorum grants
+    holder._start_refresh()
+    deadline = time.monotonic() + 2.0
+    while not holder.lost.is_set() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    with pytest.raises(LockLost):
+        holder.ensure_valid()
+    thief.unlock()
+    holder.unlock()
+
+
+def test_locker_expiry_sweep():
+    lk = LocalLocker(default_ttl_s=0.1)
+    assert lk.lock("a", "uid1", True)
+    assert lk.lock("b", "uid2", False)
+    time.sleep(0.15)
+    assert lk.expire_old_locks() == 2
+    assert not lk.is_locked("a") and not lk.is_locked("b")
 
 
 # -- full cluster ----------------------------------------------------------
