@@ -291,12 +291,17 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
                                    int(q1.get("limit", "0")))
             return send_json({"status": "ok"}) or True
         if route == "trace" and h.command == "GET":
+            if srv.peers is not None and q1.get("local") != "true":
+                return _stream_with_peer_traces(h, srv, q1)
             return _stream(h, srv.trace_hub, q1)
         if route == "log" and h.command == "GET":
             if q1.get("follow") == "true":
                 return _stream(h, srv.logger.pubsub, q1)
-            return send_json(srv.logger.recent(
-                int(q1.get("n", "100")))) or True
+            entries = srv.logger.recent(int(q1.get("n", "100")))
+            if srv.peers is not None and q1.get("local") != "true":
+                entries = entries + srv.peers.log_recent_all(
+                    int(q1.get("n", "100")))
+            return send_json(entries) or True
         if route == "audit-recent" and h.command == "GET":
             return send_json(
                 srv.audit.recent[-int(q1.get("n", "50")):]) or True
@@ -348,6 +353,39 @@ def _drive_paths(srv) -> list:
 
     walk(layer)
     return paths
+
+
+def _stream_with_peer_traces(h, srv, q1) -> bool:
+    """Cluster-wide trace stream: local hub subscription merged with a
+    background poller pulling every peer's trace ring
+    (cmd/admin-handlers.go:1082 TraceHandler + peerRESTMethodTrace)."""
+    import threading
+
+    from ..utils.pubsub import PubSub
+    merged = PubSub(max_queue=8000)
+    stop = threading.Event()
+
+    def local_pump():
+        with srv.trace_hub.subscribe() as sub:
+            while not stop.is_set():
+                item = sub.get(timeout=0.25)
+                if item is not None:
+                    merged.publish(item)
+
+    def peer_pump():
+        cursors: dict[str, int] = {}   # trace_tails self-primes peers
+        while not stop.wait(0.5):
+            for item in srv.peers.trace_tails(cursors):
+                merged.publish(item)
+
+    threads = [threading.Thread(target=local_pump, daemon=True),
+               threading.Thread(target=peer_pump, daemon=True)]
+    for t in threads:
+        t.start()
+    try:
+        return _stream(h, merged, q1)
+    finally:
+        stop.set()
 
 
 def _stream(h, hub, q1) -> bool:

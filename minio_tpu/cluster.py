@@ -194,6 +194,15 @@ def run_node(self_id: str, specs: list[NodeSpec], secret: str,
     shost, _, sport = s3_address.rpartition(":")
     srv = S3Server(layer, access_key=access_key, secret_key=secret_key,
                    host=shost or "127.0.0.1", port=int(sport))
+    srv.node_name = self_id     # traces/logs name the serving node
     srv.iam.load()
+    # peer control-plane service: IAM/bucket-metadata changes propagate
+    # to every node immediately; trace/log streams aggregate cluster-wide
+    # (cmd/peer-rest-common.go:27-61)
+    from .parallel.peer import PeerNotifier, register_peer_service
+    register_peer_service(node.rpc, srv)
+    srv.attach_peers(PeerNotifier(
+        [RPCClient(s.endpoint, secret) for s in specs
+         if s.node_id != self_id]))
     srv.start()
     return node, srv

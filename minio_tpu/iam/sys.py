@@ -73,6 +73,9 @@ class IAMSys:
         self._mu = threading.RLock()
         self._save_mu = threading.Lock()  # serializes snapshot+write pairs
         self._loaded = False
+        # peer fan-out hook (peerRESTMethodLoadUser/LoadPolicy analogs):
+        # set by attach_peers; fired after every persisted mutation
+        self.on_change = None
 
     # -- persistence (IAMObjectStore analog) -------------------------------
 
@@ -93,6 +96,8 @@ class IAMSys:
             blob = json.dumps(doc).encode()
             self._layer._fanout(
                 lambda d: d.write_all(SYS_DIR, "config/iam.json", blob))
+        if self.on_change is not None:
+            self.on_change()
 
     def load(self) -> None:
         res, _ = self._layer._fanout(

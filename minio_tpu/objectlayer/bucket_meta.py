@@ -25,6 +25,17 @@ class BucketMetadataSys:
         self._cache: dict[str, dict] = {}
         self._parsed_cache: dict[tuple[str, str], tuple[str, Any]] = {}
         self._mu = threading.Lock()
+        # peer fan-out hook: set by attach_peers so config changes reload
+        # on every node immediately (peerRESTMethodLoadBucketMetadata)
+        self.on_change = None
+
+    def invalidate(self, bucket: str) -> None:
+        """Drop the in-memory caches for one bucket (peer reload path):
+        the next access re-reads the quorum document from the drives."""
+        with self._mu:
+            self._cache.pop(bucket, None)
+            for key in [k for k in self._parsed_cache if k[0] == bucket]:
+                self._parsed_cache.pop(key, None)
 
     def _path(self, bucket: str) -> str:
         return f"buckets/{bucket}/bucket-meta.json"
@@ -70,6 +81,8 @@ class BucketMetadataSys:
                 f"bucket metadata write reached only {ok} drives")
         with self._mu:
             self._cache[bucket] = doc
+        if self.on_change is not None:
+            self.on_change(bucket)
 
     def drop(self, bucket: str) -> None:
         self._er._fanout(

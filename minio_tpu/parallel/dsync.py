@@ -196,11 +196,11 @@ class RemoteLocker:
 
     def refresh(self, resource: str, uid: str,
                 ttl_s: float | None = None) -> bool:
-        try:
-            return bool(self._c.call("lock", "refresh", resource=resource,
-                                     uid=uid, ttl_s=ttl_s))
-        except RPCError:
-            return False
+        # transport failure must RAISE, not return False: False is the
+        # locker authoritatively saying "your grant is gone", which the
+        # holder treats as a lost lock — a network blip is not that
+        return bool(self._c.call("lock", "refresh", resource=resource,
+                                 uid=uid, ttl_s=ttl_s))
 
     def unlock(self, resource: str, uid: str) -> bool:
         try:

@@ -1,0 +1,102 @@
+"""Peer control-plane RPC service (cmd/peer-rest-{client,server,common}.go).
+
+Cross-node coherence for the control plane: when one node mutates IAM or
+a bucket's metadata, it fans the change notification to every peer so
+their in-memory caches reload IMMEDIATELY instead of serving stale
+policy until a cache happens to expire (peerRESTMethodLoadBucketMetadata
+/ LoadUser / LoadPolicy, cmd/peer-rest-common.go:27-61).  The service
+also exposes trace/log tails so one admin endpoint can aggregate
+observability streams across the cluster (peerRESTMethodTrace :54,
+peerRESTMethodLog :56).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .rpc import RPCClient, RPCServer
+
+
+def register_peer_service(rpc: RPCServer, srv) -> None:
+    """Export a node's control-plane reload + observability hooks
+    (peer-rest-server.go handler table).  ``srv`` is the node's
+    S3Server."""
+
+    def reload_bucket_meta(bucket: str) -> bool:
+        srv.bucket_meta.invalidate(bucket)
+        return True
+
+    def reload_iam() -> bool:
+        srv.iam.load()
+        return True
+
+    def trace_since(seq: int, limit: int = 500):
+        latest, items = srv.trace_hub.since(seq, limit)
+        return {"seq": latest, "items": items}
+
+    def log_recent(n: int = 100):
+        return srv.logger.recent(n)
+
+    rpc.register("peer", {
+        "reload_bucket_meta": reload_bucket_meta,
+        "reload_iam": reload_iam,
+        "trace_since": trace_since,
+        "log_recent": log_recent,
+    })
+
+
+class PeerNotifier:
+    """Client side: best-effort async fan-out of control-plane change
+    notifications to every other node (NotificationSys peer calls,
+    cmd/notification.go)."""
+
+    def __init__(self, clients: list[RPCClient]):
+        self.clients = clients
+
+    def _fanout(self, method: str, **kwargs) -> None:
+        def one(c):
+            try:
+                c.call("peer", method, **kwargs)
+            except Exception:  # noqa: BLE001 — peer down: it reloads on
+                pass           # restart; coherence is best-effort
+
+        for c in self.clients:
+            threading.Thread(target=one, args=(c,), daemon=True).start()
+
+    def bucket_meta_changed(self, bucket: str) -> None:
+        self._fanout("reload_bucket_meta", bucket=bucket)
+
+    def iam_changed(self) -> None:
+        self._fanout("reload_iam")
+
+    # -- observability aggregation ----------------------------------------
+
+    def trace_tails(self, cursors: dict[str, int],
+                    limit: int = 500) -> list:
+        """Poll every peer's trace ring once; ``cursors`` maps endpoint →
+        last-seen seq and is updated in place.  A peer first seen (or
+        seen again after being unreachable at prime time) is primed at
+        its CURRENT seq — a live stream never replays its history."""
+        merged: list = []
+        for c in self.clients:
+            try:
+                if c.endpoint not in cursors:
+                    out = c.call("peer", "trace_since", seq=0, limit=0)
+                    cursors[c.endpoint] = out["seq"]
+                    continue
+                out = c.call("peer", "trace_since",
+                             seq=cursors[c.endpoint], limit=limit)
+                cursors[c.endpoint] = out["seq"]
+                merged.extend(out["items"])
+            except Exception:  # noqa: BLE001 — peer down: re-primed on
+                pass           # its next successful poll
+        return merged
+
+    def log_recent_all(self, n: int = 100) -> list:
+        out: list = []
+        for c in self.clients:
+            try:
+                out.extend(c.call("peer", "log_recent", n=n))
+            except Exception:  # noqa: BLE001
+                pass
+        return out

@@ -12,6 +12,7 @@ from __future__ import annotations
 import datetime
 import email.utils
 import hashlib
+import os
 import re
 import socket
 import threading
@@ -136,6 +137,21 @@ def _xml(root: ET.Element) -> bytes:
     return (b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root))
 
 
+def _parse_duration(s: str) -> float:
+    """'10s' / '2m' / '500ms' -> seconds (cmd/config duration keys)."""
+    s = s.strip()
+    try:
+        if s.endswith("ms"):
+            return float(s[:-2]) / 1000.0
+        if s.endswith("s"):
+            return float(s[:-1])
+        if s.endswith("m"):
+            return float(s[:-1]) * 60.0
+        return float(s)
+    except ValueError:
+        return 10.0
+
+
 def _try(fn):
     """Run a config parser, translating its ValueError into an S3Error
     (carrying the parser's .code when present)."""
@@ -254,6 +270,30 @@ class S3Server:
         # set by admin service?action=stop so a node-mode main thread
         # parked on it can finish shutdown (RPC plane + process exit)
         self.shutdown = threading.Event()
+        # peer control-plane notifier (cluster mode; parallel/peer.py)
+        self.peers = None
+        # request admission throttle (cmd/handler-api.go:29-40
+        # requestsPool/requestsDeadline; config keys cmd/config/api):
+        # bounds concurrent S3 requests; excess waits up to the deadline
+        # then gets 503 SlowDown instead of piling up threads
+        try:
+            req_max = int(self.config.get("api", "requests_max") or 0)
+        except ValueError:
+            req_max = 0
+        if req_max <= 0:
+            req_max = 16 * (os.cpu_count() or 8)   # auto sizing
+        self.requests_deadline_s = _parse_duration(
+            self.config.get("api", "requests_deadline") or "10s")
+        self._req_sem = threading.BoundedSemaphore(req_max)
+
+    def attach_peers(self, notifier) -> None:
+        """Wire the peer fan-out: IAM/bucket-metadata mutations reload on
+        every node immediately (cmd/peer-rest-common.go:27-61), and the
+        trace hub keeps a pollable ring for cross-node aggregation."""
+        self.peers = notifier
+        self.bucket_meta.on_change = notifier.bucket_meta_changed
+        self.iam.on_change = notifier.iam_changed
+        self.trace_hub.enable_ring()
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
@@ -551,9 +591,23 @@ def _make_handler(srv: S3Server):
             self._resp_bytes = 0
             self._ttfb_ns = 0
             self._rx_bytes = 0
+            # request-pool admission (cmd/handler-api.go:29 maxClients):
+            # S3 traffic only — admin/metrics/health stay reachable when
+            # the data plane is saturated
+            throttled = not urllib.parse.urlsplit(self.path).path \
+                .startswith("/minio-tpu/")
+            if throttled and not srv._req_sem.acquire(
+                    timeout=srv.requests_deadline_s):
+                try:
+                    self._fail(S3Error("SlowDown"))
+                finally:
+                    self.close_connection = True
+                return
             try:
                 self._dispatch_inner()
             finally:
+                if throttled:
+                    srv._req_sem.release()
                 try:
                     self._record_request()
                 except Exception:   # noqa: BLE001 — never fail a request
@@ -565,7 +619,8 @@ def _make_handler(srv: S3Server):
             path, bucket, key, query = self._split()
             q1 = {k: v[0] for k, v in query.items()}
             api_name = _api_name(self.command, bucket, key, q1)
-            if srv.trace_hub.num_subscribers > 0:
+            if srv.trace_hub.num_subscribers > 0 or \
+                    srv.trace_hub.ring_active:
                 srv.trace_hub.publish(_trace.make_trace(
                     srv.node_name, api_name,
                     method=self.command, path=path,

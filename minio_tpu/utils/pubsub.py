@@ -18,10 +18,62 @@ class PubSub:
         self._subs: list[tuple[queue.Queue, Optional[Callable]]] = []
         self._mu = threading.Lock()
         self._max_queue = max_queue
+        self._ring = None                 # seq-numbered tail for peer polls
+        self._seq = 0
+
+    def enable_ring(self, size: int = 2000) -> None:
+        """Keep a sequence-numbered tail of published items so remote
+        peers can poll increments (peerRESTMethodTrace aggregation).
+        The ring only captures while a poller is ACTIVE (a since() call
+        in the last 10s) so idle clusters pay nothing on the hot path."""
+        from collections import deque
+        with self._mu:
+            if self._ring is None:
+                self._ring = deque(maxlen=size)
+                self._ring_until = 0.0
+
+    @property
+    def ring_enabled(self) -> bool:
+        return self._ring is not None
+
+    @property
+    def ring_active(self) -> bool:
+        import time
+        return self._ring is not None and \
+            time.monotonic() < self._ring_until
+
+    def since(self, seq: int, limit: int = 500) -> tuple[int, list]:
+        """Items published after ``seq``; returns (cursor, items) where
+        cursor is the seq of the LAST RETURNED item (not the global
+        latest — a truncated read must not skip buffered items).
+        limit=0 returns the current latest seq with no items (cursor
+        priming for live streams).  Calling this keeps the ring
+        capturing for another 10s."""
+        import time
+        with self._mu:
+            if self._ring is None:
+                return self._seq, []
+            self._ring_until = time.monotonic() + 10.0
+            if limit == 0:
+                return self._seq, []
+            out = []
+            last = seq
+            for s, i in self._ring:
+                if s > seq:
+                    out.append(i)
+                    last = s
+                    if len(out) >= limit:
+                        break
+            return last, out
 
     def publish(self, item: Any) -> None:
+        import time
         with self._mu:
             subs = list(self._subs)
+            if self._ring is not None and \
+                    time.monotonic() < self._ring_until:
+                self._seq += 1
+                self._ring.append((self._seq, item))
         for q, flt in subs:
             if flt is not None and not flt(item):
                 continue

@@ -228,6 +228,30 @@ def test_oversized_content_length_rejected(server, client):
     assert r.status == 400
 
 
+def test_request_admission_throttle(server, client):
+    """requests-pool admission (cmd/handler-api.go:29): when the pool is
+    exhausted past the deadline, S3 requests get 503 SlowDown while the
+    admin/metrics plane stays reachable."""
+    import threading
+    import urllib.request
+    old_sem, old_dl = server._req_sem, server.requests_deadline_s
+    server._req_sem = threading.BoundedSemaphore(1)
+    server.requests_deadline_s = 0.2
+    server._req_sem.acquire()       # saturate the pool
+    try:
+        r = client.request("GET", "/", expect=())
+        assert r.status == 503, r.status
+        assert b"SlowDown" in r.body
+        # control plane is NOT throttled
+        with urllib.request.urlopen(
+                f"{server.endpoint}/minio-tpu/metrics", timeout=5) as resp:
+            assert resp.status == 200
+    finally:
+        server._req_sem.release()
+        server._req_sem, server.requests_deadline_s = old_sem, old_dl
+    assert client.request("GET", "/").status == 200
+
+
 def test_parse_range_unit():
     # size-independent form: suffix = negative offset, -1 length = to-end
     assert _parse_range("bytes=0-9") == (0, 10)
