@@ -297,10 +297,13 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
         if route == "log" and h.command == "GET":
             if q1.get("follow") == "true":
                 return _stream(h, srv.logger.pubsub, q1)
-            entries = srv.logger.recent(int(q1.get("n", "100")))
+            n_want = int(q1.get("n", "100"))
+            entries = srv.logger.recent(n_want)
             if srv.peers is not None and q1.get("local") != "true":
-                entries = entries + srv.peers.log_recent_all(
-                    int(q1.get("n", "100")))
+                # merge cluster-wide by time and honor the n contract
+                entries = sorted(
+                    entries + srv.peers.log_recent_all(n_want),
+                    key=lambda e: e.get("time", ""))[-n_want:]
             return send_json(entries) or True
         if route == "audit-recent" and h.command == "GET":
             return send_json(

@@ -803,45 +803,70 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         from .metacache import paginate
         return paginate(mc.entries, prefix, marker, delimiter, max_keys)
 
+    def _walk_resolve(self, bucket: str, prefix: str,
+                      versions: bool) -> dict[str, list]:
+        """One walk stream per drive carries names AND xl.meta metadata
+        (cmd/metacache-walk.go); merge into name -> per-drive FileInfo
+        lists.  O(drives) streams total — never a per-key quorum read
+        (the round-1 O(keys x drives) resolve, cmd/metacache-set.go:544)."""
+        # confine the walk to the prefix's directory subtree so listing
+        # one tenant of a huge bucket doesn't stream the whole namespace
+        base_dir = prefix.rsplit("/", 1)[0] if "/" in prefix else ""
+        res, _ = self._fanout(
+            lambda d: list(d.walk_entries(bucket, base_dir,
+                                          versions=versions)))
+        merged: dict[str, list] = {}
+        for drive_entries in res:
+            if not drive_entries:
+                continue
+            for e in drive_entries:
+                name = e["name"]
+                if prefix and not name.startswith(prefix):
+                    continue
+                merged.setdefault(name, []).append(
+                    [FileInfo.from_dict(f) if isinstance(f, dict) else f
+                     for f in e["fis"]])
+        return merged
+
     def _gather_listing(self, bucket: str, prefix: str
                         ) -> list[ObjectInfo]:
-        """Walk all drives, union names, resolve each through quorum
-        metadata (cmd/metacache-set.go listPath + entries resolve)."""
-        names: set[str] = set()
-        res, _ = self._fanout(lambda d: list(d.walk_dir(bucket)))
-        for lst in res:
-            if lst:
-                names.update(lst)
+        """Walk all drives once, resolve each entry from the walked
+        metadata by quorum agreement (cmd/metacache-set.go listPath +
+        metacache-entries resolve)."""
+        merged = self._walk_resolve(bucket, prefix, versions=False)
+        quorum = max(1, len(self.disks) // 2)
         entries: list[ObjectInfo] = []
-        for name in sorted(names):
-            if prefix and not name.startswith(prefix):
-                continue
+        for name in sorted(merged):
+            fis = [drive_fis[0] for drive_fis in merged[name]]
             try:
-                oi = self.get_object_info(bucket, name)
-            except (ObjectNotFound, ReadQuorumError):
+                fi = meta.find_file_info_in_quorum(fis, quorum)
+            except ReadQuorumError:
+                continue        # disagreement below quorum: skip entry
+            if fi.deleted:
                 continue
-            if oi.delete_marker:
-                continue
-            entries.append(oi)
+            entries.append(self._to_object_info(fi))
         return entries
 
     def list_object_versions(self, bucket: str, prefix: str = ""):
-        """All versions of all objects (ListObjectVersions core)."""
+        """All versions of all objects (ListObjectVersions core) — same
+        walked-metadata resolve, all versions per entry."""
         self._check_bucket(bucket)
-        names: set[str] = set()
-        res, _ = self._fanout(lambda d: list(d.walk_dir(bucket)))
-        for lst in res:
-            if lst:
-                names.update(lst)
+        merged = self._walk_resolve(bucket, prefix, versions=True)
+        quorum = max(1, len(self.disks) // 2)
         out: list[ObjectInfo] = []
-        for name in sorted(names):
-            if prefix and not name.startswith(prefix):
+        for name in sorted(merged):
+            per_drive = merged[name]
+            # resolve the version SET from the drive agreeing with the
+            # quorum pick of the latest version (findFileInfoInQuorum)
+            latest = [fis[0] for fis in per_drive if fis]
+            try:
+                fi = meta.find_file_info_in_quorum(latest, quorum)
+            except ReadQuorumError:
                 continue
-            versions, _ = self._fanout(
-                lambda d: d.list_versions(bucket, name))
-            for vlist in versions:
-                if vlist:
-                    out.extend(self._to_object_info(fi) for fi in vlist)
+            for fis in per_drive:
+                if fis and fis[0].mod_time == fi.mod_time \
+                        and fis[0].version_id == fi.version_id:
+                    out.extend(self._to_object_info(v) for v in fis)
                     break
         return out
 

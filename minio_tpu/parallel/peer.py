@@ -52,16 +52,38 @@ class PeerNotifier:
 
     def __init__(self, clients: list[RPCClient]):
         self.clients = clients
+        # one long-lived worker + bounded queue per peer: control-plane
+        # churn against a dead peer must not pile up threads; dropped
+        # notifications are safe (reloads are idempotent full reloads)
+        self._queues: dict = {}
+        self._mu = threading.Lock()
+
+    def _queue_for(self, c: RPCClient):
+        import queue as _q
+        with self._mu:
+            q = self._queues.get(c.endpoint)
+            if q is None:
+                q = _q.Queue(maxsize=64)
+                self._queues[c.endpoint] = q
+
+                def worker():
+                    while True:
+                        method, kwargs = q.get()
+                        try:
+                            c.call("peer", method, **kwargs)
+                        except Exception:  # noqa: BLE001 — peer down:
+                            pass           # it reloads fully on restart
+
+                threading.Thread(target=worker, daemon=True).start()
+            return q
 
     def _fanout(self, method: str, **kwargs) -> None:
-        def one(c):
-            try:
-                c.call("peer", method, **kwargs)
-            except Exception:  # noqa: BLE001 — peer down: it reloads on
-                pass           # restart; coherence is best-effort
-
+        import queue as _q
         for c in self.clients:
-            threading.Thread(target=one, args=(c,), daemon=True).start()
+            try:
+                self._queue_for(c).put_nowait((method, kwargs))
+            except _q.Full:
+                pass    # backlogged peer: a later reload covers it
 
     def bucket_meta_changed(self, bucket: str) -> None:
         self._fanout("reload_bucket_meta", bucket=bucket)
@@ -86,6 +108,11 @@ class PeerNotifier:
                     continue
                 out = c.call("peer", "trace_since",
                              seq=cursors[c.endpoint], limit=limit)
+                if out["seq"] < cursors[c.endpoint] and not out["items"]:
+                    # peer restarted: its seq space reset below our
+                    # cursor — re-prime at its current head
+                    cursors[c.endpoint] = out["seq"]
+                    continue
                 cursors[c.endpoint] = out["seq"]
                 merged.extend(out["items"])
             except Exception:  # noqa: BLE001 — peer down: re-primed on

@@ -602,6 +602,10 @@ def _make_handler(srv: S3Server):
                     self._fail(S3Error("SlowDown"))
                 finally:
                     self.close_connection = True
+                    try:    # 503s must show up in trace/audit streams
+                        self._record_request()
+                    except Exception:  # noqa: BLE001
+                        pass
                 return
             try:
                 self._dispatch_inner()

@@ -160,3 +160,11 @@ class StorageAPI(abc.ABC):
     def walk_dir(self, volume: str, base_dir: str = "",
                  recursive: bool = True) -> Iterable[str]:
         """Yield object meta paths under a prefix (cmd/metacache-walk.go)."""
+
+    def walk_entries(self, volume: str, base_dir: str = "",
+                     recursive: bool = True,
+                     versions: bool = False) -> Iterable[dict]:
+        """Walked objects with xl.meta-derived metadata in one pass:
+        {"name", "fis": [FileInfo dicts]} per object — the listing
+        resolve source (cmd/metacache-walk.go streams raw xl.meta)."""
+        raise NotImplementedError

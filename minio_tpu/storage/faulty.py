@@ -21,7 +21,7 @@ _METHODS = [
     "read_file_stream", "rename_file", "delete", "stat_info_file",
     "rename_data", "write_metadata", "update_metadata", "read_version",
     "list_versions", "delete_version", "verify_file", "check_parts",
-    "walk_dir",
+    "walk_dir", "walk_entries",
 ]
 
 

@@ -39,7 +39,7 @@ _GUARDED = {
     "read_file_stream", "rename_file", "delete", "stat_info_file",
     "rename_data", "write_metadata", "update_metadata", "read_version",
     "list_versions", "delete_version", "verify_file", "check_parts",
-    "walk_dir", "tmp_dir", "clean_tmp", "disk_info",
+    "walk_dir", "walk_entries", "tmp_dir", "clean_tmp", "disk_info",
 }
 
 

@@ -97,6 +97,9 @@ def register_storage_service(rpc: RPCServer,
                                         FileInfo.from_dict(fi)),
         "walk_dir": lambda drive_id, volume, base_dir, recursive:
             list(drive(drive_id).walk_dir(volume, base_dir, recursive)),
+        "walk_entries": lambda drive_id, volume, base_dir, recursive,
+            versions: list(drive(drive_id).walk_entries(
+                volume, base_dir, recursive, versions)),
         "tmp_dir": lambda drive_id: drive(drive_id).tmp_dir(),
         "clean_tmp": lambda drive_id, rel_dir:
             drive(drive_id).clean_tmp(rel_dir),
@@ -236,6 +239,12 @@ class RemoteStorage(StorageAPI):
     def walk_dir(self, volume, base_dir="", recursive=True) -> Iterable[str]:
         return iter(self._call("walk_dir", volume=volume, base_dir=base_dir,
                                recursive=recursive))
+
+    def walk_entries(self, volume, base_dir="", recursive=True,
+                     versions=False) -> Iterable[dict]:
+        return iter(self._call("walk_entries", volume=volume,
+                               base_dir=base_dir, recursive=recursive,
+                               versions=versions))
 
     # staging
     def tmp_dir(self) -> str:

@@ -456,6 +456,26 @@ class XLStorage(StorageAPI):
 
         yield from walk(base)
 
+    def walk_entries(self, volume: str, base_dir: str = "",
+                     recursive: bool = True,
+                     versions: bool = False) -> Iterable[dict]:
+        """Walk objects AND their xl.meta-derived metadata in one pass
+        (cmd/metacache-walk.go WalkDir streams raw xl.meta per entry):
+        yields {"name", "fis": [FileInfo dicts]} — latest version only,
+        or every version with ``versions``.  Listing resolve consumes
+        these walked streams instead of issuing a quorum read per key
+        (cmd/metacache-set.go:544,834)."""
+        for name in self.walk_dir(volume, base_dir, recursive):
+            try:
+                meta = self._read_meta(volume, name)
+                if versions:
+                    fis = meta.list_versions(volume, name)
+                else:
+                    fis = [meta.to_fileinfo(volume, name, None)]
+            except errors.StorageError:
+                continue            # torn/missing meta: other drives win
+            yield {"name": name, "fis": [fi.to_dict() for fi in fis]}
+
     # -- staging helpers (used by the erasure object layer) ---------------
 
     def tmp_dir(self) -> str:

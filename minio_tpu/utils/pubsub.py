@@ -54,7 +54,10 @@ class PubSub:
             if self._ring is None:
                 return self._seq, []
             self._ring_until = time.monotonic() + 10.0
-            if limit == 0:
+            if limit == 0 or seq > self._seq:
+                # limit=0 primes; seq ahead of the stream means the
+                # caller's cursor is from a previous process life —
+                # report the current head so it re-primes
                 return self._seq, []
             out = []
             last = seq

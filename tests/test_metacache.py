@@ -113,6 +113,100 @@ def test_delimiter_pagination_no_duplicate_prefixes(er):
     assert seen_keys == ["c"]
 
 
+class _OpCountingDisk:
+    """StorageAPI proxy counting listing-relevant calls."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.counts: dict = {}
+
+    def _bump(self, name):
+        self.counts[name] = self.counts.get(name, 0) + 1
+
+    def walk_entries(self, *a, **kw):
+        self._bump("walk_entries")
+        return self._inner.walk_entries(*a, **kw)
+
+    def walk_dir(self, *a, **kw):
+        self._bump("walk_dir")
+        return self._inner.walk_dir(*a, **kw)
+
+    def read_version(self, *a, **kw):
+        self._bump("read_version")
+        return self._inner.read_version(*a, **kw)
+
+    def read_all(self, *a, **kw):
+        self._bump("read_all")
+        return self._inner.read_all(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_listing_is_o_drives_not_o_keys(tmp_path):
+    """Listing resolves from the walked xl.meta streams
+    (cmd/metacache-set.go:544, metacache-walk.go:56): a bucket of N
+    objects costs one walk stream per drive, with ZERO per-key quorum
+    read_version calls — the round-1 resolve did N x drives reads."""
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"cd{i}"
+        d.mkdir()
+        disks.append(_OpCountingDisk(XLStorage(str(d))))
+    lay = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                         backend="numpy")
+    lay.make_bucket("bigbkt")
+    n_objects = 120
+    for i in range(n_objects):
+        lay.put_object("bigbkt", f"pfx/obj-{i:04d}", b"x" * 64)
+    for d in disks:
+        d.counts = {}
+    res = lay.list_objects("bigbkt", prefix="pfx/", max_keys=1000)
+    assert len(res.objects) == n_objects
+    walks = sum(d.counts.get("walk_entries", 0) for d in disks)
+    reads = sum(d.counts.get("read_version", 0) for d in disks)
+    raw_reads = sum(d.counts.get("read_all", 0) for d in disks)
+    assert walks == len(disks), d.counts
+    assert reads == 0, f"per-key reads crept back: {reads}"
+    # read_all is only the metacache persistence probe, not per-key
+    assert raw_reads <= len(disks), raw_reads
+
+    # version listing rides the same walked streams
+    for d in disks:
+        d.counts = {}
+    vers = lay.list_object_versions("bigbkt", prefix="pfx/")
+    assert len(vers) == n_objects
+    assert sum(d.counts.get("read_version", 0) for d in disks) == 0
+    assert sum(d.counts.get("list_versions", 0) for d in disks) == 0
+
+
+def test_listing_survives_disagreeing_drive(tmp_path):
+    """An entry missing from one drive still lists (quorum agreement on
+    walked metadata), and an entry below quorum is skipped."""
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"qd{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    lay = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                         backend="numpy")
+    lay.make_bucket("qbkt")
+    lay.put_object("qbkt", "ok-entry", b"d" * 50)
+    import os
+    import shutil
+    # wipe the object dir from ONE drive: 3 of 4 still agree
+    shutil.rmtree(os.path.join(disks[0].root, "qbkt", "ok-entry"))
+    lay.metacache.invalidate("qbkt")
+    res = lay.list_objects("qbkt")
+    assert [o.name for o in res.objects] == ["ok-entry"]
+    # wipe from 3 drives: below quorum (2), entry disappears
+    for d in disks[1:3]:
+        shutil.rmtree(os.path.join(d.root, "qbkt", "ok-entry"))
+    lay.metacache.invalidate("qbkt")
+    res = lay.list_objects("qbkt")
+    assert res.objects == []
+
+
 def test_paginate_unit():
     entries = [ObjectInfo(name=n) for n in
                ["a/x", "a/y", "b", "c/z", "d"]]
