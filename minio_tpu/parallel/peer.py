@@ -68,9 +68,13 @@ class PeerNotifier:
 
                 def worker():
                     while True:
-                        method, kwargs = q.get()
+                        item = q.get()
+                        if item is None:        # close() sentinel
+                            return
+                        method, kwargs = item
                         try:
-                            c.call("peer", method, **kwargs)
+                            c.call("peer", method, _idempotent=True,
+                                   **kwargs)
                         except Exception:  # noqa: BLE001 — peer down:
                             pass           # it reloads fully on restart
 
@@ -84,6 +88,16 @@ class PeerNotifier:
                 self._queue_for(c).put_nowait((method, kwargs))
             except _q.Full:
                 pass    # backlogged peer: a later reload covers it
+
+    def close(self) -> None:
+        """Stop the notify workers (sentinel per queue)."""
+        with self._mu:
+            queues = list(self._queues.values())
+        for q in queues:
+            try:
+                q.put_nowait(None)
+            except Exception:  # noqa: BLE001 — full queue: worker will
+                pass           # drain and exit on the next sentinel
 
     def bucket_meta_changed(self, bucket: str) -> None:
         self._fanout("reload_bucket_meta", bucket=bucket)

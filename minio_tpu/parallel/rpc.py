@@ -59,6 +59,7 @@ class RPCServer:
     def __init__(self, secret: str, host: str = "127.0.0.1", port: int = 0):
         self.secret = secret
         self._services: dict[str, dict[str, callable]] = {}
+        self._raw: dict[str, callable] = {}
         handler = self._make_handler()
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
@@ -67,6 +68,14 @@ class RPCServer:
         self._thread: threading.Thread | None = None
         # bootstrap liveness probe (cmd/bootstrap-peer-server.go role)
         self.register("sys", {"ping": lambda: "pong"})
+
+    def register_raw(self, name: str, fn) -> None:
+        """Raw-body endpoint at POST /raw/<name>: ``fn(params: dict,
+        data: bytes) -> bytes`` — bulk shard bytes ride the HTTP body
+        directly instead of inside a msgpack document, so a transfer
+        materializes once per side (storage-rest chunked streams,
+        cmd/storage-rest-server.go)."""
+        self._raw[name] = fn
 
     @property
     def endpoint(self) -> str:
@@ -86,6 +95,7 @@ class RPCServer:
 
     def _make_handler(srv_self):
         services = srv_self._services
+        raw = srv_self._raw
         secret = srv_self.secret
 
         class Handler(BaseHTTPRequestHandler):
@@ -102,21 +112,36 @@ class RPCServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _reply_raw(self, data: bytes):
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.end_headers()
+                self.wfile.write(data)
+
             def do_POST(self):
                 path = urllib.parse.urlsplit(self.path).path
                 auth = self.headers.get("Authorization", "")
                 if not (auth.startswith("Bearer ") and
                         check_token(secret, path, auth[7:])):
+                    # body not consumed: keep-alive would desync — the
+                    # unread bytes would parse as the next request line
+                    self.close_connection = True
                     return self._reply(403, {"ok": False,
                                              "error_type": "AuthError",
                                              "message": "bad token"})
                 parts = path.strip("/").split("/")
+                if len(parts) >= 2 and parts[0] == "raw":
+                    return self._do_raw(parts[1])
                 if len(parts) != 3 or parts[0] != "rpc":
+                    self.close_connection = True
                     return self._reply(404, {"ok": False,
                                              "error_type": "NotFound",
                                              "message": path})
                 fn = services.get(parts[1], {}).get(parts[2])
                 if fn is None:
+                    self.close_connection = True
                     return self._reply(404, {"ok": False,
                                              "error_type": "NoSuchMethod",
                                              "message": path})
@@ -128,6 +153,31 @@ class RPCServer:
                     self._reply(200, {"ok": True, "result": result})
                 except Exception as e:  # noqa: BLE001 — typed over the wire
                     self._reply(200, {
+                        "ok": False,
+                        "error_type": type(e).__name__,
+                        "message": str(e)})
+
+            def _do_raw(self, name: str):
+                """Bulk endpoint: params ride the X-RPC-Params header
+                (msgpack+hex), the body is raw bytes.  A raw response is
+                status 200; errors come back as status 400 + the usual
+                msgpack error doc.  The body is drained BEFORE any
+                handler work so error replies never leave unread bytes
+                poisoning the keep-alive connection."""
+                n = int(self.headers.get("Content-Length") or 0)
+                data = self.rfile.read(n) if n else b""
+                fn = raw.get(name)
+                if fn is None:
+                    return self._reply(404, {"ok": False,
+                                             "error_type": "NoSuchMethod",
+                                             "message": name})
+                try:
+                    params = msgpack.unpackb(bytes.fromhex(
+                        self.headers.get("X-RPC-Params", "")), raw=False)
+                    out = fn(params, data)
+                    self._reply_raw(out if out is not None else b"")
+                except Exception as e:  # noqa: BLE001
+                    self._reply(400, {
                         "ok": False,
                         "error_type": type(e).__name__,
                         "message": str(e)})
@@ -188,6 +238,9 @@ class RPCClient:
     _SERVICE_MIN = {"storage": 10.0}
     _DEFAULT_MIN = 1.0
 
+    POOL_MAX = 8    # idle keep-alive connections kept per peer
+    # (cmd/rest/client.go:114 shared persistent transport)
+
     def __init__(self, endpoint: str, secret: str, timeout: float = 30.0):
         u = urllib.parse.urlsplit(endpoint)
         self.host, self.port = u.hostname, u.port
@@ -198,6 +251,28 @@ class RPCClient:
         self._online = True
         self._last_failure = 0.0
         self._retry_after = 3.0
+        self._pool: list[http.client.HTTPConnection] = []
+        self._pool_mu = threading.Lock()
+
+    def _get_conn(self, timeout: float
+                  ) -> tuple[http.client.HTTPConnection, bool]:
+        """(connection, pooled): pooled connections may be stale (peer
+        restarted); the caller retries once on a fresh one."""
+        with self._pool_mu:
+            conn = self._pool.pop() if self._pool else None
+        if conn is not None:
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
+            return conn, True
+        return http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout), False
+
+    def _put_conn(self, conn: http.client.HTTPConnection) -> None:
+        with self._pool_mu:
+            if len(self._pool) < self.POOL_MAX:
+                self._pool.append(conn)
+                return
+        conn.close()
 
     def _dyn_for(self, service: str) -> DynamicTimeout:
         dt = self._dyn.get(service)
@@ -214,36 +289,89 @@ class RPCClient:
             self._online = True  # optimistic reconnect on next call
         return self._online
 
-    def call(self, service: str, method: str, **kwargs):
+    def _roundtrip(self, path: str, body: bytes, service: str,
+                   extra_headers: dict | None = None,
+                   raw_response: bool = False,
+                   idempotent: bool = False):
+        """One pooled request/response.  Keep-alive: a fully-drained
+        success returns the connection to the pool; any error closes it.
+
+        Stale-connection retry policy: a failure while SENDING on a
+        pooled connection is always retried once on a fresh connection
+        (the request never reached the peer); a failure while reading
+        the RESPONSE is retried only for ``idempotent`` calls — the
+        request may already have executed, and a replayed append must
+        never run twice."""
         if not self.is_online():
             raise RPCError("PeerOffline", self.endpoint)
-        path = f"/rpc/{service}/{method}"
-        body = msgpack.packb(kwargs, use_bin_type=True)
         dyn = self._dyn_for(service)
-        conn = http.client.HTTPConnection(
-            self.host, self.port, timeout=dyn.timeout())
+        headers = {
+            "Authorization": f"Bearer {mint_token(self.secret, path)}",
+            "Content-Type": "application/msgpack",
+            **(extra_headers or {})}
         start = time.monotonic()
-        try:
-            conn.request("POST", path, body=body, headers={
-                "Authorization": f"Bearer {mint_token(self.secret, path)}",
-                "Content-Type": "application/msgpack"})
-            resp = conn.getresponse()
-            doc = msgpack.unpackb(resp.read(), raw=False)
-        except socket.timeout as e:
-            # only an actual deadline expiry carries a latency signal;
-            # instant errors (refused/reset) must not inflate deadlines
-            self._online = False
-            self._last_failure = time.time()
-            dyn.log_failure()
-            raise RPCError("ConnectionError", str(e)) from e
-        except (OSError, http.client.HTTPException) as e:
-            self._online = False
-            self._last_failure = time.time()
-            raise RPCError("ConnectionError", str(e)) from e
-        finally:
+
+        def fail(conn, e, is_timeout=False):
             conn.close()
+            self._online = False
+            self._last_failure = time.time()
+            if is_timeout:
+                dyn.log_failure()
+            raise RPCError("ConnectionError", str(e)) from e
+
+        for attempt in (0, 1):
+            conn, pooled = self._get_conn(dyn.timeout())
+            retryable = pooled and attempt == 0
+            try:
+                conn.request("POST", path, body=body, headers=headers)
+            except socket.timeout as e:
+                fail(conn, e, is_timeout=True)
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                if retryable:
+                    continue    # send failed: request never processed
+                fail(conn, e)
+            try:
+                resp = conn.getresponse()
+                status = resp.status
+                payload = resp.read()
+                break
+            except socket.timeout as e:
+                # only an actual deadline expiry carries a latency
+                # signal; instant errors must not inflate deadlines
+                fail(conn, e, is_timeout=True)
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                stale = isinstance(e, (http.client.RemoteDisconnected,
+                                       ConnectionResetError,
+                                       BrokenPipeError))
+                if retryable and stale and idempotent:
+                    continue
+                fail(conn, e)
+        self._put_conn(conn)
         dyn.log_success(time.monotonic() - start)
+        if raw_response and status == 200:
+            return payload
+        doc = msgpack.unpackb(payload, raw=False)
         if not doc.get("ok"):
             raise RPCError(doc.get("error_type", "Unknown"),
                            doc.get("message", ""))
         return doc.get("result")
+
+    def call(self, service: str, method: str, _idempotent: bool = False,
+             **kwargs):
+        path = f"/rpc/{service}/{method}"
+        return self._roundtrip(path, msgpack.packb(kwargs,
+                                                   use_bin_type=True),
+                               service, idempotent=_idempotent)
+
+    def raw_call(self, name: str, params: dict, body: bytes = b"",
+                 idempotent: bool = False) -> bytes:
+        """Bulk transfer (POST /raw/<name>): params in a header, raw
+        bytes in the body, raw bytes back — shard files never get a
+        second msgpack copy on either side."""
+        path = f"/raw/{name}"
+        hdr = msgpack.packb(params, use_bin_type=True).hex()
+        return self._roundtrip(path, body, "storage",
+                               extra_headers={"X-RPC-Params": hdr},
+                               raw_response=True, idempotent=idempotent)

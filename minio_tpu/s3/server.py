@@ -304,6 +304,8 @@ class S3Server:
         self.httpd.shutdown()
         self.httpd.server_close()
         self.events.close()
+        if self.peers is not None:
+            self.peers.close()
 
     @property
     def endpoint(self) -> str:

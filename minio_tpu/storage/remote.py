@@ -109,6 +109,25 @@ def register_storage_service(rpc: RPCServer,
     }
     rpc.register("storage", methods)
 
+    # bulk shard transfer endpoints: raw HTTP bodies, one materialization
+    # per side (storage-rest chunked streams, cmd/storage-rest-server.go)
+    def raw_write(params, data):
+        d = drive(params["drive_id"])
+        if params.get("op") == "append":
+            d.append_file(params["volume"], params["path"], data)
+        else:
+            d.create_file(params["volume"], params["path"], data,
+                          params.get("file_size", -1))
+        return b""
+
+    def raw_read(params, data):
+        d = drive(params["drive_id"])
+        return d.read_file_stream(params["volume"], params["path"],
+                                  params["offset"], params["length"])
+
+    rpc.register_raw("storage-write", raw_write)
+    rpc.register_raw("storage-read", raw_read)
+
 
 class RemoteStorage(StorageAPI):
     """StorageAPI over RPC to a peer node's drive
@@ -118,16 +137,37 @@ class RemoteStorage(StorageAPI):
         self._c = client
         self.drive_id = drive_id
 
+    # read-only methods may retry transparently on a stale pooled
+    # connection; mutations must never execute twice
+    _IDEMPOTENT = {
+        "disk_info", "list_vols", "stat_vol", "list_dir", "read_all",
+        "read_file_stream", "stat_info_file", "read_version",
+        "list_versions", "verify_file", "check_parts", "walk_dir",
+        "walk_entries", "get_disk_id",
+    }
+
     def _call(self, method: str, **kwargs):
         try:
             return self._c.call("storage", method, drive_id=self.drive_id,
+                                _idempotent=method in self._IDEMPOTENT,
                                 **kwargs)
         except RPCError as e:
-            cls = _ERR_TYPES.get(e.error_type)
-            if cls is not None:
-                raise cls(e.message) from e
-            raise serrors.DiskNotFound(
-                f"{self._c.endpoint}/{self.drive_id}: {e}") from e
+            raise self._map_err(e) from e
+
+    def _raw(self, name: str, params: dict, body: bytes = b"") -> bytes:
+        try:
+            return self._c.raw_call(
+                name, {"drive_id": self.drive_id, **params}, body,
+                idempotent=(name == "storage-read"))
+        except RPCError as e:
+            raise self._map_err(e) from e
+
+    def _map_err(self, e: RPCError) -> Exception:
+        cls = _ERR_TYPES.get(e.error_type)
+        if cls is not None:
+            return cls(e.message)
+        return serrors.DiskNotFound(
+            f"{self._c.endpoint}/{self.drive_id}: {e}")
 
     # identity / health
     def is_online(self) -> bool:
@@ -178,16 +218,19 @@ class RemoteStorage(StorageAPI):
         self._call("write_all", volume=volume, path=path, data=bytes(data))
 
     def create_file(self, volume, path, data, file_size=-1):
-        self._call("create_file", volume=volume, path=path,
-                   data=bytes(data), file_size=file_size)
+        self._raw("storage-write",
+                  {"volume": volume, "path": path, "op": "create",
+                   "file_size": file_size}, bytes(data))
 
     def append_file(self, volume, path, data):
-        self._call("append_file", volume=volume, path=path,
-                   data=bytes(data))
+        self._raw("storage-write",
+                  {"volume": volume, "path": path, "op": "append"},
+                  bytes(data))
 
     def read_file_stream(self, volume, path, offset, length):
-        return self._call("read_file_stream", volume=volume, path=path,
-                          offset=offset, length=length)
+        return self._raw("storage-read",
+                         {"volume": volume, "path": path,
+                          "offset": offset, "length": length})
 
     def rename_file(self, src_volume, src_path, dst_volume, dst_path):
         self._call("rename_file", src_volume=src_volume, src_path=src_path,
