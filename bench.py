@@ -15,7 +15,7 @@ Methodology (honest-measurement rules):
     peak — a number over 100% means the harness is lying, not the chip.
   * the end-to-end number (BASELINE config 5: 256 x 4 MiB batched PUT)
     runs through the REAL put_object path — md5, erasure encode, bitrot
-    framing, fsync'd drive writes — on the host codec, because this
+    framing, staged drive writes — on the host codec, because this
     harness's TPU sits behind a tunnel whose H2D tops out at ~10 MiB/s
     (it would measure the tunnel, not the pipeline).  Device kernel
     numbers exclude host transfers for the same reason; on real TPU
@@ -36,8 +36,10 @@ from functools import partial
 import numpy as np
 
 # the e2e leg measures the pipeline, not this VM's single ext4 disk: the
-# reference's benchmarks don't fsync either (go test -bench has no sync)
+# reference's benchmarks don't fsync either (go test -bench has no sync).
+# The metric key records whether fsync was actually on for the run.
 os.environ.setdefault("MT_FSYNC", "0")
+_FSYNC_ON = os.environ["MT_FSYNC"] not in ("0", "off", "false")
 
 AVX2_BASELINE_GIBPS = 6.0
 
@@ -117,15 +119,29 @@ def main() -> None:
         assert checksum != 0, "device produced all-zero output"
         return best
 
+    def marginal(t1, t2, iters, label):
+        # never clamp: a non-positive marginal time means foreign load
+        # or a harness artifact — clamping would report impossible
+        # throughput, exactly what this harness exists to prevent
+        dt = (t2 - t1) / iters
+        if dt <= 0:
+            raise RuntimeError(
+                f"{label}: non-positive marginal time ({t2:.4f}s for "
+                f"2x iters vs {t1:.4f}s) — rerun on a quiet chip")
+        return dt
+
     def bench(mat, iters=100, trials=3):
         # warm/compile both shapes, then time iters and 2*iters runs;
         # the MARGINAL time per step cancels dispatch + readback
         # overhead and any constant tunnel latency
         int(jnp.sum(chained(mat, data, iters).astype(jnp.uint32)))
         int(jnp.sum(chained(mat, data, 2 * iters).astype(jnp.uint32)))
-        t1 = timed(mat, iters, trials)
-        t2 = timed(mat, 2 * iters, trials)
-        per_step = max((t2 - t1) / iters, 1e-9)
+        for attempt in range(3):
+            t1 = timed(mat, iters, trials + attempt)
+            t2 = timed(mat, 2 * iters, trials + attempt)
+            if t2 > t1:
+                break
+        per_step = marginal(t1, t2, iters, f"bench(r={mat.shape[0]//8})")
         r = mat.shape[0] // 8
         macs = r * 8 * k * 8 * B * ss_pad          # int8 MACs per step
         tops = 2 * macs / per_step / 1e12
@@ -133,17 +149,21 @@ def main() -> None:
 
     encode_gibps, enc_tops = bench(enc_mat)
     decode_gibps, dec_tops = bench(dec_mat)
-    heal_gibps, _ = bench(heal_mat)
+    heal_gibps, heal_tops = bench(heal_mat)
     # heal rate in shards/s: 3 shards rebuilt per stripe per step
     heal_shards_s = heal_gibps * 2**30 / block_size * 3
 
     dev = jax.devices()[0]
     peak = _device_peak_tops(dev)
     roofline_pct = round(100 * enc_tops / peak, 1) if peak else None
-    # the harness's own credibility gate: >100% of chip peak = broken
-    assert roofline_pct is None or roofline_pct <= 100.0, (
-        f"measured {enc_tops:.1f} TOPS exceeds {peak} TOPS peak — "
-        "harness artifact")
+    # the harness's own credibility gate: >100% of chip peak = broken.
+    # Every measured leg is gated, not just encode.
+    if peak:
+        for label, tops in [("encode", enc_tops), ("decode", dec_tops),
+                            ("heal", heal_tops)]:
+            assert tops <= peak, (
+                f"{label}: measured {tops:.1f} TOPS exceeds {peak} TOPS "
+                "peak — harness artifact")
 
     # fused encode + on-device HighwayHash (bit-identical digests):
     # one pipeline emits parity AND per-shard bitrot digests
@@ -158,8 +178,11 @@ def main() -> None:
             h = hh_kernels.hh256_batch(full.reshape(B * (k + m), ss_pad))
             reps = -(-k // m)
             mix = jnp.tile(par, (1, reps, 1))[:, :k, :]
-            # digest folds into the carry so the hash work is live
-            return d ^ mix, hacc ^ h[0]
+            # XOR-reduce ALL digests into the carry: every one of the
+            # B*(k+m) hashes is live, none can be narrowed away by XLA
+            hall = jax.lax.reduce(h, jnp.uint8(0),
+                                  jax.lax.bitwise_xor, (0,))
+            return d ^ mix, hacc ^ hall
 
         return jax.lax.fori_loop(0, iters, body,
                                  (d0, jnp.zeros(32, jnp.uint8)))
@@ -177,10 +200,18 @@ def main() -> None:
     fiters = 4
     fused_chained(data, fiters)[1].block_until_ready()       # compile
     fused_chained(data, 2 * fiters)[1].block_until_ready()
-    ft1 = fused_timed(fiters)
-    ft2 = fused_timed(2 * fiters)
-    fdt = max((ft2 - ft1) / fiters, 1e-9)
+    for attempt in range(3):
+        ft1 = fused_timed(fiters, trials=3 + attempt)
+        ft2 = fused_timed(2 * fiters, trials=3 + attempt)
+        if ft2 > ft1:
+            break
+    fdt = marginal(ft1, ft2, fiters, "fused")
     fused_gibps = (B * block_size) / fdt / 2**30
+    if peak:   # fused leg contains the encode matmul — same gate
+        fused_tops = 2 * (m * 8 * k * 8 * B * ss_pad) / fdt / 1e12
+        assert fused_tops <= peak, (
+            f"fused: {fused_tops:.1f} TOPS exceeds {peak} peak — "
+            "harness artifact")
 
     e2e_gibps = _bench_end_to_end_put()
 
@@ -196,7 +227,8 @@ def main() -> None:
             "heal3_GiBps": round(heal_gibps, 2),
             "heal_shards_per_s": round(heal_shards_s, 1),
             "fused_encode_hh256_GiBps": round(fused_gibps, 2),
-            "e2e_put_256x4MiB_nofsync_GiBps": e2e_gibps,
+            ("e2e_put_256x4MiB_fsync_GiBps" if _FSYNC_ON
+             else "e2e_put_256x4MiB_nofsync_GiBps"): e2e_gibps,
             "achieved_int8_TOPS": round(enc_tops, 1),
             "decode_int8_TOPS": round(dec_tops, 1),
             "roofline_pct_of_peak": roofline_pct,
@@ -210,14 +242,17 @@ def main() -> None:
 
 def _bench_end_to_end_put() -> float | None:
     """BASELINE config 5 end to end: 256 x 4 MiB PUTs through the REAL
-    put_object pipeline (md5 + erasure encode + bitrot framing + fsync'd
-    staged writes + quorum commit), 8 concurrent clients, host codec
+    put_object pipeline (md5 + erasure encode + bitrot framing + staged
+    writes + quorum commit; fsync per MT_FSYNC, default off to match
+    go test -bench semantics), 8 concurrent clients, host codec
     (see module docstring for why the device codec is excluded here)."""
     import os
     import shutil
+    import sys
     import tempfile
     from concurrent.futures import ThreadPoolExecutor
 
+    tmp = None
     try:
         from minio_tpu.objectlayer.erasure_object import ErasureObjects
         from minio_tpu.storage.xl_storage import XLStorage
@@ -242,10 +277,13 @@ def _bench_end_to_end_put() -> float | None:
             t0 = time.perf_counter()
             list(pool.map(put, range(n_obj)))
             dt = time.perf_counter() - t0
-        shutil.rmtree(tmp, ignore_errors=True)
         return round(n_obj * obj_size / dt / 2**30, 3)
-    except Exception:  # noqa: BLE001 — e2e leg must not sink the bench
+    except Exception as e:  # noqa: BLE001 — e2e leg must not sink the bench
+        print(f"e2e leg failed: {e!r}", file=sys.stderr)
         return None
+    finally:
+        if tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
 
 
 if __name__ == "__main__":

@@ -598,7 +598,11 @@ def _make_handler(srv: S3Server):
             # the data plane is saturated
             throttled = not urllib.parse.urlsplit(self.path).path \
                 .startswith("/minio-tpu/")
-            if throttled and not srv._req_sem.acquire(
+            # capture the pool object: admin SetConfigKV can swap
+            # srv._req_sem mid-flight, and acquire/release must pair on
+            # the same semaphore
+            sem = srv._req_sem if throttled else None
+            if sem is not None and not sem.acquire(
                     timeout=srv.requests_deadline_s):
                 try:
                     self._fail(S3Error("SlowDown"))
@@ -612,8 +616,8 @@ def _make_handler(srv: S3Server):
             try:
                 self._dispatch_inner()
             finally:
-                if throttled:
-                    srv._req_sem.release()
+                if sem is not None:
+                    sem.release()
                 try:
                     self._record_request()
                 except Exception:   # noqa: BLE001 — never fail a request
