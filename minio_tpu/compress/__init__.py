@@ -19,9 +19,9 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
-import subprocess
 import threading
 
+from ..utils import nativelib
 from .snappy_py import (compress_block_py, crc32c_py,
                         decompress_block_py, uncompressed_length_py)
 
@@ -47,38 +47,28 @@ def _load_native():
     with _lock:
         if _lib_tried:
             return _lib
+        lib = nativelib.load(_NATIVE_SRC, _NATIVE_SO)
+        if lib is not None:
+            try:
+                lib.mt_snappy_max_compressed.restype = ctypes.c_size_t
+                lib.mt_snappy_max_compressed.argtypes = [ctypes.c_size_t]
+                lib.mt_snappy_compress.restype = ctypes.c_size_t
+                lib.mt_snappy_compress.argtypes = [
+                    ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
+                lib.mt_snappy_uncompress.restype = ctypes.c_longlong
+                lib.mt_snappy_uncompress.argtypes = [
+                    ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+                    ctypes.c_size_t]
+                lib.mt_snappy_uncompressed_length.restype = \
+                    ctypes.c_longlong
+                lib.mt_snappy_uncompressed_length.argtypes = [
+                    ctypes.c_char_p, ctypes.c_size_t]
+                lib.mt_crc32c.restype = ctypes.c_uint32
+                lib.mt_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+            except Exception:  # noqa: BLE001
+                lib = None
+        _lib = lib
         _lib_tried = True
-        if os.environ.get("MT_NATIVE", "1") == "0":
-            return None
-        try:
-            if not os.path.exists(_NATIVE_SO) or (
-                    os.path.getmtime(_NATIVE_SO) <
-                    os.path.getmtime(_NATIVE_SRC)):
-                os.makedirs(os.path.dirname(_NATIVE_SO), exist_ok=True)
-                tmp = _NATIVE_SO + f".tmp{os.getpid()}"
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-o", tmp,
-                     _NATIVE_SRC],
-                    check=True, capture_output=True, timeout=120)
-                os.replace(tmp, _NATIVE_SO)
-            lib = ctypes.CDLL(_NATIVE_SO)
-            lib.mt_snappy_max_compressed.restype = ctypes.c_size_t
-            lib.mt_snappy_max_compressed.argtypes = [ctypes.c_size_t]
-            lib.mt_snappy_compress.restype = ctypes.c_size_t
-            lib.mt_snappy_compress.argtypes = [
-                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p]
-            lib.mt_snappy_uncompress.restype = ctypes.c_longlong
-            lib.mt_snappy_uncompress.argtypes = [
-                ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
-                ctypes.c_size_t]
-            lib.mt_snappy_uncompressed_length.restype = ctypes.c_longlong
-            lib.mt_snappy_uncompressed_length.argtypes = [
-                ctypes.c_char_p, ctypes.c_size_t]
-            lib.mt_crc32c.restype = ctypes.c_uint32
-            lib.mt_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
-            _lib = lib
-        except Exception:
-            _lib = None
         return _lib
 
 

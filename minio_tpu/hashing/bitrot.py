@@ -18,7 +18,7 @@ import hashlib
 from dataclasses import dataclass
 from typing import BinaryIO
 
-from .highwayhash import MAGIC_KEY, HighwayHash256, hh256, hh256_blocks
+from .highwayhash import MAGIC_KEY, HighwayHash256, hh256
 from ..ops.gf8 import ceil_frac
 
 # algorithm ids follow the reference's iota order (cmd/bitrot-whole.go deps):
@@ -85,12 +85,13 @@ def bitrot_shard_file_offset(offset: int, shard_size: int, algo: str) -> int:
 def streaming_encode(data: bytes, shard_size: int,
                      algo: str = DEFAULT_BITROT_ALGORITHM) -> bytes:
     """Frame a whole shard file: hash || block per shard_size block."""
-    if not is_streaming(algo):
+    if not is_streaming(algo):     # only highwayhash256S streams
         return data
     if len(data) == 0:
         return b""
-    hashes = hh256_blocks(data, shard_size)
-    return _interleave(data, shard_size, hashes)
+    # one GIL-free native pass: hash + interleave together
+    from .highwayhash import hh256_frame
+    return hh256_frame(data, shard_size)
 
 
 def _interleave(data: bytes, shard_size: int, hashes) -> bytes:
@@ -117,8 +118,9 @@ def streaming_encode_batch(shards, shard_size: int,
             return _streaming_encode_batch_device(shards, shard_size)
         except Exception:  # noqa: BLE001 — host path is always correct
             pass
-    return [streaming_encode(bytes(bytearray(s)), shard_size, algo)
-            for s in shards]
+    # streaming_encode takes any contiguous buffer zero-copy (numpy
+    # shard rows included) — don't round-trip through bytes()
+    return [streaming_encode(s, shard_size, algo) for s in shards]
 
 
 def _streaming_encode_batch_device(shards, shard_size: int) -> list[bytes]:

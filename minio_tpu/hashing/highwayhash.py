@@ -17,8 +17,6 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
-import subprocess
-import tempfile
 
 # cmd/bitrot.go:31 — magic HH-256 key
 MAGIC_KEY = (b"\x4b\xe7\x34\xfa\x8e\x23\x8a\xcd\x26\x3e\x83\xe6\xbb\x96\x85"
@@ -30,57 +28,38 @@ _LIB = None
 _LIB_TRIED = False
 
 
-def _build_lib() -> str | None:
-    src = os.path.join(_NATIVE_DIR, "highwayhash.c")
-    out = os.path.join(_NATIVE_DIR, "libmt_hash.so")
-    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
-        return out
-    tmppath = None
-    try:
-        with tempfile.NamedTemporaryFile(
-                suffix=".so", dir=_NATIVE_DIR, delete=False) as tmp:
-            tmppath = tmp.name
-        cc = os.environ.get("CC", "cc")
-        subprocess.run(
-            [cc, "-O3", "-shared", "-fPIC", "-o", tmppath, src],
-            check=True, capture_output=True)
-        os.replace(tmppath, out)  # atomic: safe under concurrent builds
-        return out
-    except Exception:
-        if tmppath is not None:
-            try:
-                os.unlink(tmppath)
-            except OSError:
-                pass
-        return None
-
-
 def _get_lib():
     global _LIB, _LIB_TRIED
     if _LIB_TRIED:
         return _LIB
+    from ..utils import nativelib
+    src = os.path.join(_NATIVE_DIR, "highwayhash.c")
+    so = os.path.join(_NATIVE_DIR, "libmt_hash.so")
+    lib = nativelib.load(src, so)
+    if lib is not None:
+        try:
+            lib.mt_hh256.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                     ctypes.c_size_t, ctypes.c_char_p]
+            lib.mt_hh64.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                    ctypes.c_size_t]
+            lib.mt_hh64.restype = ctypes.c_uint64
+            lib.mt_hh256_blocks.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_size_t, ctypes.c_char_p]
+            lib.mt_hh256_frame.argtypes = [
+                ctypes.c_char_p, ctypes.c_void_p, ctypes.c_size_t,
+                ctypes.c_size_t, ctypes.c_char_p]
+            lib.mt_hh_stream_size.restype = ctypes.c_size_t
+            lib.mt_hh_stream_init.argtypes = [ctypes.c_char_p,
+                                              ctypes.c_char_p]
+            lib.mt_hh_stream_update.argtypes = [
+                ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t]
+            lib.mt_hh_stream_final256.argtypes = [ctypes.c_char_p,
+                                                  ctypes.c_char_p]
+        except Exception:  # noqa: BLE001
+            lib = None
+    _LIB = lib
     _LIB_TRIED = True
-    path = _build_lib()
-    if path is None:
-        return None
-    try:
-        lib = ctypes.CDLL(path)
-        lib.mt_hh256.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
-                                 ctypes.c_size_t, ctypes.c_char_p]
-        lib.mt_hh64.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
-                                ctypes.c_size_t]
-        lib.mt_hh64.restype = ctypes.c_uint64
-        lib.mt_hh256_blocks.argtypes = [
-            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
-            ctypes.c_size_t, ctypes.c_char_p]
-        lib.mt_hh_stream_size.restype = ctypes.c_size_t
-        lib.mt_hh_stream_init.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
-        lib.mt_hh_stream_update.argtypes = [
-            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t]
-        lib.mt_hh_stream_final256.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
-        _LIB = lib
-    except OSError:
-        _LIB = None
     return _LIB
 
 
@@ -252,6 +231,38 @@ def hh256_blocks(data, block_size: int, key: bytes = MAGIC_KEY) -> list[bytes]:
         return [out.raw[i * 32:(i + 1) * 32] for i in range(count)]
     return [hh256(data[i * block_size:(i + 1) * block_size], key)
             for i in range(count)]
+
+
+def hh256_frame(data, block_size: int, key: bytes = MAGIC_KEY) -> bytes:
+    """Frame a shard file (hash || block per block) in ONE native pass.
+
+    The bitrot writer's hot path (cmd/bitrot-streaming.go:46-58): hash
+    and interleave happen inside a single GIL-releasing C call, so
+    concurrent PUTs scale.  Accepts any contiguous buffer (bytes,
+    numpy, memoryview) without copying on the native path."""
+    if block_size <= 0:
+        raise ValueError("block_size must be positive")
+    mv = memoryview(data).cast("B")
+    size = len(mv)
+    if size == 0:
+        return b""
+    count = (size + block_size - 1) // block_size
+    lib = _get_lib()
+    if lib is not None:
+        import numpy as np
+        arr = np.frombuffer(mv, dtype=np.uint8)     # zero-copy view
+        out = ctypes.create_string_buffer(size + 32 * count)
+        lib.mt_hh256_frame(key, arr.ctypes.data_as(ctypes.c_void_p),
+                           size, block_size, out)
+        return out.raw
+    # pure-python fallback: identical framing
+    b = mv.tobytes()
+    parts = []
+    for i in range(count):
+        blk = b[i * block_size:(i + 1) * block_size]
+        parts.append(hh256(blk, key))
+        parts.append(blk)
+    return b"".join(parts)
 
 
 class HighwayHash256:

@@ -170,6 +170,22 @@ void mt_hh256_blocks(const uint64_t key[4], const uint8_t* data, size_t size,
   }
 }
 
+/* One-pass bitrot shard framing (cmd/bitrot-streaming.go:46-58): emit
+ * hash || block for every block_size block.  Doing hash + copy in one
+ * GIL-free call is what lets concurrent PUT threads scale on the host
+ * path.  `out` must hold size + ceil(size/block_size)*32 bytes. */
+void mt_hh256_frame(const uint64_t key[4], const uint8_t* data, size_t size,
+                    size_t block_size, uint8_t* out) {
+  size_t off = 0;
+  while (off < size) {
+    size_t n = size - off < block_size ? size - off : block_size;
+    mt_hh256(key, data + off, n, out);
+    memcpy(out + 32, data + off, n);
+    off += n;
+    out += 32 + n;
+  }
+}
+
 /* streaming (whole-file bitrot): caller allocates an opaque state buffer */
 typedef struct {
   HHState s;

@@ -110,7 +110,11 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             from ..parallel.dsync import NamespaceLock
             ns_lock = NamespaceLock()
         self.ns_lock = ns_lock
-        self._pool = ThreadPoolExecutor(max_workers=max(4, n))
+        # sized for REQUEST concurrency x drive fan-out: the reference
+        # runs a goroutine per disk per request (parallelWriter,
+        # cmd/erasure-encode.go:36); a pool of exactly n workers would
+        # serialize concurrent PUTs behind one request's drive writes
+        self._pool = ThreadPoolExecutor(max_workers=min(4 * max(4, n), 64))
         self._codec = Erasure(self.data_blocks, self.parity, block_size,
                               backend=backend) if self.parity > 0 else None
         # per-storage-class codecs (x-amz-storage-class picks parity per

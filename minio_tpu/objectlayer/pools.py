@@ -16,9 +16,12 @@ from .sets import ErasureSets
 
 
 class ErasureServerPools(ObjectLayer):
+    FREE_SPACE_TTL_S = 5.0
+
     def __init__(self, pools: list[ErasureSets]):
         assert pools
         self.pools = pools
+        self._free_cache: tuple[float, list[int]] | None = None
 
     # -- placement ---------------------------------------------------------
 
@@ -33,9 +36,25 @@ class ErasureServerPools(ObjectLayer):
                         pass
         return total
 
+    def _free_spaces(self) -> list[int]:
+        """Per-pool free bytes, cached briefly: the reference batches and
+        caches capacity probes rather than statvfs-ing every drive on
+        every PUT (cmd/erasure-server-pool.go:182 getAvailablePoolIdx
+        over cached StorageInfo)."""
+        import time
+        now = time.monotonic()
+        if self._free_cache and now - self._free_cache[0] < \
+                self.FREE_SPACE_TTL_S:
+            return self._free_cache[1]
+        frees = [self._free_space(p) for p in self.pools]
+        self._free_cache = (now, frees)
+        return frees
+
     def get_pool_idx(self, bucket: str, object_name: str) -> int:
         """Existing location wins; else most free space
         (cmd/erasure-server-pool.go:255,182)."""
+        if len(self.pools) == 1:
+            return 0        # nothing to place: skip the existence probe
         for i, p in enumerate(self.pools):
             try:
                 p.get_object_info(bucket, object_name)
@@ -45,9 +64,7 @@ class ErasureServerPools(ObjectLayer):
             # quorum/transport errors propagate: routing a PUT of an
             # existing object elsewhere would shadow it with stale data
             # once the pool recovers (getPoolIdx semantics)
-        if len(self.pools) == 1:
-            return 0
-        frees = [self._free_space(p) for p in self.pools]
+        frees = self._free_spaces()
         return max(range(len(frees)), key=frees.__getitem__)
 
     def _find_pool(self, bucket: str, object_name: str,

@@ -73,8 +73,9 @@ def gf_exp(a: int, n: int) -> int:
     return int(GF_EXP[(int(GF_LOG[a]) * n) % 255])
 
 
-def gf_matmul(A: np.ndarray, B: np.ndarray) -> np.ndarray:
-    """GF matrix multiply: (r,k) x (k,c) -> (r,c), XOR-accumulated."""
+def gf_matmul_numpy(A: np.ndarray, B: np.ndarray) -> np.ndarray:
+    """Pure-numpy GF matmul — the conformance oracle the native and TPU
+    paths are validated against (tables built in _build_tables above)."""
     A = np.asarray(A, dtype=np.uint8)
     B = np.asarray(B, dtype=np.uint8)
     r, k = A.shape
@@ -85,6 +86,22 @@ def gf_matmul(A: np.ndarray, B: np.ndarray) -> np.ndarray:
         prod = GF_MUL[A[:, i][:, None], B[i][None, :]]
         out ^= prod
     return out
+
+
+def gf_matmul(A: np.ndarray, B: np.ndarray) -> np.ndarray:
+    """GF matrix multiply: (r,k) x (k,c) -> (r,c), XOR-accumulated.
+
+    Dispatches to the native AVX2 kernel (native/gf8.cc — the host
+    equivalent of klauspost/reedsolomon's assembly) for real shard
+    widths; numpy handles tiny inputs and environments without g++.
+    ctypes releases the GIL inside the native call, so concurrent PUT
+    threads scale."""
+    B = np.asarray(B)
+    if B.ndim == 2 and B.shape[1] >= 1024:
+        from . import gf8_native
+        if gf8_native.available():
+            return gf8_native.matmul(A, B)
+    return gf_matmul_numpy(A, B)
 
 
 def gf_mat_inv(M: np.ndarray) -> np.ndarray:

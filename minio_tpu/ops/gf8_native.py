@@ -1,0 +1,80 @@
+"""Native (C++/AVX2) GF(2^8) matmul binding — host codec fast path.
+
+The reference's erasure hot loop runs klauspost/reedsolomon's VPSHUFB
+split-nibble assembly (go.mod:41).  `native/gf8.cc` is that kernel for
+this framework's host path; the TPU device kernels (rs_kernels.py)
+remain the headline compute plane.  The GF multiplication table is
+handed to the library from gf8.py at init, so native and numpy results
+are identical by construction (and asserted in tests/test_gf8_native.py).
+
+Built on demand with g++ (same pattern as minio_tpu/compress);
+``available()`` returns False and callers fall back to numpy when no
+compiler is present or MT_NATIVE=0.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+
+import numpy as np
+
+from ..utils import nativelib
+
+_NATIVE_SRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native", "gf8.cc")
+_NATIVE_SO = os.path.join(os.path.dirname(_NATIVE_SRC), "build",
+                          "libmtgf8.so")
+
+_lock = threading.Lock()
+_lib = None
+_lib_tried = False
+
+
+def _load():
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    with _lock:
+        if _lib_tried:
+            return _lib
+        lib = nativelib.load(_NATIVE_SRC, _NATIVE_SO)
+        if lib is not None:
+            try:
+                lib.mt_gf8_init.argtypes = [ctypes.c_char_p]
+                lib.mt_gf8_matmul.argtypes = [
+                    ctypes.c_char_p, ctypes.c_size_t, ctypes.c_size_t,
+                    ctypes.c_void_p, ctypes.c_size_t,
+                    ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t]
+                from . import gf8
+                lib.mt_gf8_init(np.ascontiguousarray(gf8.GF_MUL).tobytes())
+            except Exception:  # noqa: BLE001 — fall back to numpy
+                lib = None
+        _lib = lib
+        # publish AFTER init completes: a concurrent caller must never
+        # observe tried=True with a half-initialized library
+        _lib_tried = True
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def matmul(A: np.ndarray, B: np.ndarray) -> np.ndarray:
+    """GF (r,k) x (k,len) -> (r,len); ctypes releases the GIL for the
+    duration of the C call, so concurrent PUTs scale across threads."""
+    lib = _load()
+    assert lib is not None
+    A = np.ascontiguousarray(A, dtype=np.uint8)
+    B = np.ascontiguousarray(B, dtype=np.uint8)
+    r, k = A.shape
+    k2, n = B.shape
+    assert k == k2
+    out = np.empty((r, n), dtype=np.uint8)
+    lib.mt_gf8_matmul(A.tobytes(), r, k,
+                      B.ctypes.data_as(ctypes.c_void_p), B.strides[0],
+                      out.ctypes.data_as(ctypes.c_void_p), out.strides[0],
+                      n)
+    return out

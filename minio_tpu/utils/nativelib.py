@@ -1,0 +1,56 @@
+"""Shared build-on-demand loader for the native (C/C++) helper libraries.
+
+Three subsystems carry native kernels — snappy compression
+(native/snappy.cc), HighwayHash (hashing/native/highwayhash.c) and the
+GF(2^8) erasure matmul (native/gf8.cc) — the roles the reference fills
+with assembly-accelerated Go modules (SURVEY.md §2.4).  They all share
+one loading discipline, implemented once here:
+
+* rebuild when the .so is missing or older than the source;
+* compile to a temp file and os.replace it (atomic under concurrent
+  processes);
+* honor MT_NATIVE=0 (force the pure-Python fallbacks) and CC;
+* never raise: a missing compiler returns None and callers fall back.
+
+Thread-safe: a per-path lock guarantees a library is built and loaded
+exactly once, and concurrent first callers WAIT for the build instead of
+silently taking the slow path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_cache: dict[str, ctypes.CDLL | None] = {}
+
+
+def load(src: str, so: str, timeout: int = 120) -> ctypes.CDLL | None:
+    """Build (if stale) and load `src` into `so`; None when unavailable.
+
+    Idempotent per `so` path; concurrent callers block until the first
+    build finishes rather than observing a half-initialized state."""
+    with _lock:
+        if so in _cache:
+            return _cache[so]
+        lib = None
+        if os.environ.get("MT_NATIVE", "1") != "0":
+            try:
+                if not os.path.exists(so) or (
+                        os.path.getmtime(so) < os.path.getmtime(src)):
+                    os.makedirs(os.path.dirname(so), exist_ok=True)
+                    tmp = so + f".tmp{os.getpid()}"
+                    cc = os.environ.get("CC", "g++" if src.endswith(
+                        (".cc", ".cpp")) else "cc")
+                    subprocess.run(
+                        [cc, "-O3", "-shared", "-fPIC", "-o", tmp, src],
+                        check=True, capture_output=True, timeout=timeout)
+                    os.replace(tmp, so)
+                lib = ctypes.CDLL(so)
+            except Exception:  # noqa: BLE001 — fallback path is Python
+                lib = None
+        _cache[so] = lib
+        return lib

@@ -1,0 +1,122 @@
+// GF(2^8) matrix multiply for the host erasure-codec path.
+//
+// Role: the reference's hot path is klauspost/reedsolomon's assembly
+// (AVX2 VPSHUFB split-nibble multiply, go.mod:41, used from
+// cmd/erasure-coding.go:70-107).  On TPU hosts the device codec
+// (minio_tpu/ops/rs_kernels.py) carries the bulk work; this library is
+// the CPU-side equivalent for paths where a device dispatch is not
+// worthwhile (small stripes, numpy backend, environments without an
+// accelerator).
+//
+// The multiplication table is injected from Python (mt_gf8_init) so the
+// field semantics are identical to minio_tpu/ops/gf8.py by construction
+// — no second implementation of the polynomial to drift.
+//
+// Kernel: per coefficient c, two 16-entry tables L[x]=mul(c,x) and
+// H[x]=mul(c,x<<4); mul(c,b) = L[b&15] ^ H[b>>4].  With AVX2 this is two
+// VPSHUFB per 32 bytes — the exact trick the reference's assembly uses.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+#if defined(__x86_64__) || defined(__i386__)
+#include <immintrin.h>
+#define MT_X86 1
+#endif
+
+static uint8_t MUL[256][256];
+static bool g_have_avx2 = false;
+
+extern "C" void mt_gf8_init(const uint8_t* mul_table) {
+    std::memcpy(MUL, mul_table, sizeof(MUL));
+#if MT_X86
+    g_have_avx2 = __builtin_cpu_supports("avx2");
+#endif
+}
+
+// out[n] ^= mul(c, src[n]) — scalar split-nibble path
+static void mul_xor_scalar(uint8_t c, const uint8_t* src, uint8_t* dst,
+                           size_t n) {
+    const uint8_t* row = MUL[c];
+    uint8_t lo[16], hi[16];
+    for (int x = 0; x < 16; x++) {
+        lo[x] = row[x];
+        hi[x] = row[x << 4];
+    }
+    for (size_t i = 0; i < n; i++) {
+        uint8_t b = src[i];
+        dst[i] ^= (uint8_t)(lo[b & 15] ^ hi[b >> 4]);
+    }
+}
+
+#if MT_X86
+__attribute__((target("avx2")))
+static void mul_xor_avx2(uint8_t c, const uint8_t* src, uint8_t* dst,
+                         size_t n) {
+    const uint8_t* row = MUL[c];
+    alignas(32) uint8_t lo[32], hi[32];
+    for (int x = 0; x < 16; x++) {
+        lo[x] = lo[x + 16] = row[x];
+        hi[x] = hi[x + 16] = row[x << 4];
+    }
+    const __m256i vlo = _mm256_load_si256((const __m256i*)lo);
+    const __m256i vhi = _mm256_load_si256((const __m256i*)hi);
+    const __m256i mask = _mm256_set1_epi8(0x0f);
+    size_t i = 0;
+    for (; i + 32 <= n; i += 32) {
+        __m256i v = _mm256_loadu_si256((const __m256i*)(src + i));
+        __m256i l = _mm256_and_si256(v, mask);
+        __m256i h = _mm256_and_si256(_mm256_srli_epi64(v, 4), mask);
+        __m256i p = _mm256_xor_si256(_mm256_shuffle_epi8(vlo, l),
+                                     _mm256_shuffle_epi8(vhi, h));
+        __m256i d = _mm256_loadu_si256((const __m256i*)(dst + i));
+        _mm256_storeu_si256((__m256i*)(dst + i),
+                            _mm256_xor_si256(d, p));
+    }
+    if (i < n) mul_xor_scalar(c, src + i, dst + i, n - i);
+}
+#endif
+
+static inline void mul_xor(uint8_t c, const uint8_t* src, uint8_t* dst,
+                           size_t n) {
+    if (c == 0) return;
+#if MT_X86
+    if (g_have_avx2) { mul_xor_avx2(c, src, dst, n); return; }
+#endif
+    mul_xor_scalar(c, src, dst, n);
+}
+
+// dst[n] ^= src[n] — word-wise; the c==1 fast path (identity-heavy
+// decode matrices) and XOR-only callers share it
+extern "C" void mt_gf8_xor(const uint8_t* src, uint8_t* dst, size_t n) {
+    size_t i = 0;
+    for (; i + 8 <= n; i += 8) {
+        uint64_t a, b;
+        std::memcpy(&a, src + i, 8);
+        std::memcpy(&b, dst + i, 8);
+        b ^= a;
+        std::memcpy(dst + i, &b, 8);
+    }
+    for (; i < n; i++) dst[i] ^= src[i];
+}
+
+// out (r, len) = A (r, k)  x  B (k, len)  over GF(2^8), XOR-accumulate.
+// B rows and out rows are contiguous with the given strides (in bytes),
+// so callers can point straight into a (k, shard) numpy array.
+extern "C" void mt_gf8_matmul(const uint8_t* A, size_t r, size_t k,
+                              const uint8_t* B, size_t b_stride,
+                              uint8_t* out, size_t o_stride, size_t len) {
+    for (size_t j = 0; j < r; j++) {
+        uint8_t* dst = out + j * o_stride;
+        std::memset(dst, 0, len);
+        for (size_t i = 0; i < k; i++) {
+            uint8_t c = A[j * k + i];
+            if (c == 1) {  // common in systematic/decode matrices
+                mt_gf8_xor(B + i * b_stride, dst, len);
+                continue;
+            }
+            mul_xor(c, B + i * b_stride, dst, len);
+        }
+    }
+}

@@ -34,10 +34,13 @@ def test_server_start_stop_does_not_leak_threads(tmp_path):
         disks.append(XLStorage(str(d)))
     layer = ErasureObjects(disks, parity=2, block_size=64 * 1024,
                            backend="numpy")
-    # warm the shared layer pool (its worker threads spawn lazily on the
-    # first drive fan-out and persist with the layer — not a leak)
+    # warm the shared layer pool to FULL size (ThreadPoolExecutor spawns
+    # workers on demand up to max_workers and keeps them — growth during
+    # the cycles below would read as a leak when it's just lazy ramp-up)
     layer.make_bucket("warmup")
     layer.put_object("warmup", "o", b"w")
+    list(layer._pool.map(time.sleep,
+                         [0.05] * layer._pool._max_workers))
     baseline = _settled_thread_count()
     ports = []
     for cycle in range(3):
