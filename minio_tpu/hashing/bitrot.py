@@ -86,7 +86,11 @@ def streaming_encode(data: bytes, shard_size: int,
                      algo: str = DEFAULT_BITROT_ALGORITHM) -> bytes:
     """Frame a whole shard file: hash || block per shard_size block."""
     if not is_streaming(algo):     # only highwayhash256S streams
-        return data
+        # whole-file algos store the shard unframed — coerce to bytes so
+        # downstream consumers (msgpack inline_data, RPC bodies) never
+        # see a numpy row
+        return data if isinstance(data, bytes) else \
+            bytes(memoryview(data).cast("B"))
     if len(data) == 0:
         return b""
     # one GIL-free native pass: hash + interleave together

@@ -103,6 +103,10 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             raise ValueError("parity too large for drive count")
         self.block_size = block_size
         self.backend = backend
+        if not bitrot.available(bitrot_algo):
+            # fail at construction, not on the first read: an unknown
+            # algo would write shards that can never be verified back
+            raise ValueError(f"unknown bitrot algorithm {bitrot_algo!r}")
         self.bitrot_algo = bitrot_algo
         self.inline_threshold = inline_threshold
         self.enforce_min_part_size = enforce_min_part_size

@@ -669,12 +669,27 @@ def _make_handler(srv: S3Server):
                 from . import web as web_handlers
                 if path == web_handlers.WEBRPC_PATH or \
                         path == web_handlers.ZIP_PATH or \
-                        path.startswith((web_handlers.UPLOAD_PREFIX,
+                        path.startswith((web_handlers.BROWSER_PATH,
+                                         web_handlers.UPLOAD_PREFIX,
                                          web_handlers.DOWNLOAD_PREFIX)):
                     # web endpoints authenticate with their own JWT
                     if web_handlers.handle(self, srv, path, query,
                                            self._body):
                         return
+                # browser redirect (cmd/generic-handlers.go
+                # setBrowserRedirectHandler): an unauthenticated GET /
+                # from a web browser lands on the UI, S3 clients (signed
+                # or anonymous API calls) are never redirected
+                if path == "/" and self.command == "GET" and \
+                        "Mozilla" in self.headers.get("User-Agent", "") \
+                        and "Authorization" not in self.headers and \
+                        "X-Amz-Credential" not in (query or {}):
+                    self._body()
+                    self.send_response(303)
+                    self.send_header("Location", web_handlers.BROWSER_PATH)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
                 if self._try_stream_put(path, bucket, key, query):
                     return
                 payload = self._body()

@@ -11,6 +11,9 @@ zip endpoints drive the browser UI.  Routes here:
   PUT  /minio-tpu/upload/<bucket>/<key>       Bearer JWT
   GET  /minio-tpu/download/<bucket>/<key>?token=JWT
   POST /minio-tpu/zip?token=JWT               {"bucketName","prefix","objects"}
+  GET  /minio-tpu/browser                     single-file SPA (browser.html
+                                              — the React app's role,
+                                              browser/app/js)
 
 Authorization mirrors the reference: Login validates credentials via
 IAM, the JWT (HS256, signed with the root secret, cmd/jwt.go) carries
@@ -33,6 +36,7 @@ WEBRPC_PATH = "/minio-tpu/webrpc"
 UPLOAD_PREFIX = "/minio-tpu/upload/"
 DOWNLOAD_PREFIX = "/minio-tpu/download/"
 ZIP_PATH = "/minio-tpu/zip"
+BROWSER_PATH = "/minio-tpu/browser"
 TOKEN_TTL_S = 24 * 3600            # cmd/jwt.go defaultJWTExpiry
 UI_VERSION = "minio-tpu-web/1"
 
@@ -239,9 +243,27 @@ def _iso(ns: int) -> str:
 # HTTP glue — called from the server's dispatch before SigV4 auth
 # ---------------------------------------------------------------------------
 
+def _serve_browser(h) -> None:
+    import os
+    page = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "browser.html")
+    with open(page, "rb") as f:
+        body = f.read()
+    h.send_response(200)
+    h.send_header("Content-Type", "text/html; charset=utf-8")
+    h.send_header("Content-Length", str(len(body)))
+    # the SPA is self-contained; never let a stale cache survive upgrades
+    h.send_header("Cache-Control", "no-cache")
+    h.end_headers()
+    h.wfile.write(body)
+
+
 def handle(h, srv, path: str, query: dict, read_body) -> bool:
     """Route web endpoints; True when handled.  `read_body` is a thunk so
     the RPC path can bound the read while uploads stream."""
+    if path in (BROWSER_PATH, BROWSER_PATH + "/") and h.command == "GET":
+        _serve_browser(h)
+        return True
     if path == WEBRPC_PATH and h.command == "POST":
         _handle_rpc(h, srv, read_body())
         return True

@@ -24,16 +24,21 @@ import os
 import subprocess
 import threading
 
-_lock = threading.Lock()
+_meta_lock = threading.Lock()
+_locks: dict[str, threading.Lock] = {}
 _cache: dict[str, ctypes.CDLL | None] = {}
 
 
 def load(src: str, so: str, timeout: int = 120) -> ctypes.CDLL | None:
     """Build (if stale) and load `src` into `so`; None when unavailable.
 
-    Idempotent per `so` path; concurrent callers block until the first
-    build finishes rather than observing a half-initialized state."""
-    with _lock:
+    Idempotent per `so` path; concurrent callers of the SAME library
+    block until the first build finishes rather than observing a
+    half-initialized state — a slow compile of one library never stalls
+    loads of the others."""
+    with _meta_lock:
+        lock = _locks.setdefault(so, threading.Lock())
+    with lock:
         if so in _cache:
             return _cache[so]
         lib = None

@@ -1,0 +1,142 @@
+"""Browser SPA serving tests (role of the reference's React app,
+browser/app/js served via cmd/web-router.go go-bindata assets).
+
+The app itself is exercised end to end by a real-browser smoke drive
+during development; these tests pin the serving contract: the page is
+served at /minio-tpu/browser, unauthenticated browser GETs of / are
+redirected to it, S3 clients are NOT redirected, and every RPC/endpoint
+the page's JavaScript calls exists on the backend.
+"""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.s3.server import S3Server
+from minio_tpu.s3.web import BROWSER_PATH
+from minio_tpu.storage.xl_storage import XLStorage
+
+UA_BROWSER = ("Mozilla/5.0 (X11; Linux x86_64) AppleWebKit/537.36 "
+              "(KHTML, like Gecko) Chrome/126.0 Safari/537.36")
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("uidrives")
+    disks = []
+    for i in range(4):
+        d = tmp / f"disk{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=128 * 1024,
+                           backend="numpy")
+    srv = S3Server(layer, access_key="uikey", secret_key="uisecret")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _get(server, path, headers=None, follow=True):
+    class NoRedirect(urllib.request.HTTPRedirectHandler):
+        def redirect_request(self, *a, **k):
+            return None
+
+    req = urllib.request.Request(server.endpoint + path,
+                                 headers=headers or {})
+    opener = urllib.request.build_opener() if follow else \
+        urllib.request.build_opener(NoRedirect)
+    try:
+        return opener.open(req, timeout=10)
+    except urllib.error.HTTPError as e:
+        return e
+
+
+def test_spa_served(server):
+    r = _get(server, BROWSER_PATH)
+    assert r.status == 200
+    assert r.headers["Content-Type"].startswith("text/html")
+    body = r.read().decode()
+    # the page is self-contained: login form, RPC client, upload wiring
+    for marker in ["minio-tpu browser", "/minio-tpu/webrpc",
+                   '"web." + method', "/minio-tpu/upload/",
+                   "PresignedGet"]:
+        assert marker in body, marker
+    # no external assets — the zero-egress single-file contract
+    assert not re.search(r'(src|href)\s*=\s*"https?://', body)
+
+
+def test_browser_redirect_from_root(server):
+    r = _get(server, "/", headers={"User-Agent": UA_BROWSER}, follow=False)
+    assert r.status == 303
+    assert r.headers["Location"] == BROWSER_PATH
+    # following the redirect lands on the app
+    r = _get(server, "/", headers={"User-Agent": UA_BROWSER})
+    assert r.status == 200 and b"minio-tpu browser" in r.read()
+
+
+def test_s3_clients_not_redirected(server):
+    # non-browser UA: anonymous ListBuckets XML error, not a redirect
+    r = _get(server, "/", headers={"User-Agent": "aws-cli/2.0"},
+             follow=False)
+    assert r.status != 303
+    # browser UA but SIGNED request: S3 semantics preserved
+    from minio_tpu.s3.client import S3Client
+    c = S3Client(server.endpoint, "uikey", "uisecret")
+    resp = c.request("GET", "/", headers={"User-Agent": UA_BROWSER})
+    assert resp.status == 200
+    assert b"ListAllMyBucketsResult" in resp.body
+
+
+def test_every_rpc_the_page_calls_exists(server):
+    page = _get(server, BROWSER_PATH).read().decode()
+    called = set(re.findall(r'rpc\("([A-Za-z]+)"', page))
+    assert called, "no RPC calls found in page"
+    from minio_tpu.s3.web import WebRPC
+    backend = {m[len("rpc_"):] for m in dir(WebRPC)
+               if m.startswith("rpc_")}
+    missing = called - backend
+    assert not missing, f"page calls missing RPCs: {missing}"
+
+
+def test_ui_flow_over_http(server):
+    """The exact request sequence the page's JS issues: login ->
+    make bucket -> upload -> list -> presigned share -> download."""
+    def rpc(method, params=None, token=""):
+        body = json.dumps({"jsonrpc": "2.0", "id": 1,
+                           "method": f"web.{method}",
+                           "params": params or {}}).encode()
+        req = urllib.request.Request(
+            f"{server.endpoint}/minio-tpu/webrpc", data=body,
+            headers={"Content-Type": "application/json",
+                     **({"Authorization": f"Bearer {token}"}
+                        if token else {})})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            doc = json.loads(resp.read())
+        assert "error" not in doc, doc
+        return doc["result"]
+
+    tok = rpc("Login", {"username": "uikey", "password": "uisecret"})["token"]
+    rpc("MakeBucket", {"bucketName": "uibucket"}, tok)
+    req = urllib.request.Request(
+        f"{server.endpoint}/minio-tpu/upload/uibucket/docs/hello.txt",
+        data=b"hello from the browser", method="PUT",
+        headers={"Authorization": f"Bearer {tok}",
+                 "Content-Type": "text/plain"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert json.loads(resp.read())["ok"] is True
+    objs = rpc("ListObjects", {"bucketName": "uibucket", "prefix": ""},
+               tok)["objects"]
+    assert any(o["name"] == "docs/" for o in objs)
+    share = rpc("PresignedGet", {"bucketName": "uibucket",
+                                 "objectName": "docs/hello.txt",
+                                 "host": f"127.0.0.1:{server.port}"}, tok)
+    with urllib.request.urlopen(share["url"], timeout=10) as resp:
+        assert resp.read() == b"hello from the browser"
+    dl = rpc("CreateURLToken", {}, tok)["token"]
+    with urllib.request.urlopen(
+            f"{server.endpoint}/minio-tpu/download/uibucket/docs/hello.txt"
+            f"?token={dl}", timeout=10) as resp:
+        assert resp.read() == b"hello from the browser"
