@@ -127,10 +127,19 @@ def streaming_encode_batch(shards, shard_size: int,
     return [streaming_encode(s, shard_size, algo) for s in shards]
 
 
+def _device_hh256_batch(blocks):
+    """Best device formulation: single fused pallas kernel on TPU,
+    lax.scan packet loop elsewhere (both bit-identical)."""
+    import jax
+    if jax.default_backend() == "tpu":
+        from ..ops import hh_pallas
+        return hh_pallas.hh256_batch(blocks)
+    from ..ops import hh_kernels
+    return hh_kernels.hh256_batch(blocks)
+
+
 def _streaming_encode_batch_device(shards, shard_size: int) -> list[bytes]:
     import numpy as np
-
-    from ..ops import hh_kernels
     arrs = [np.asarray(bytearray(s), dtype=np.uint8) for s in shards]
     L = len(arrs[0])
     if L == 0:
@@ -143,13 +152,13 @@ def _streaming_encode_batch_device(shards, shard_size: int) -> list[bytes]:
     digests: list[list[bytes]] = [[] for _ in arrs]
     if full:
         blocks = stacked[:, :full * shard_size].reshape(-1, shard_size)
-        hs = np.asarray(hh_kernels.hh256_batch(blocks))
+        hs = np.asarray(_device_hh256_batch(blocks))
         hs = hs.reshape(len(arrs), full, 32)
         for si in range(len(arrs)):
             digests[si] = [hs[si, b].tobytes() for b in range(full)]
     if rem:
         tails = stacked[:, full * shard_size:]
-        hs = np.asarray(hh_kernels.hh256_batch(tails))
+        hs = np.asarray(_device_hh256_batch(tails))
         for si in range(len(arrs)):
             digests[si].append(hs[si].tobytes())
     assert all(len(d) == nblocks for d in digests)

@@ -683,7 +683,8 @@ def _make_handler(srv: S3Server):
                 if path == "/" and self.command == "GET" and \
                         "Mozilla" in self.headers.get("User-Agent", "") \
                         and "Authorization" not in self.headers and \
-                        "X-Amz-Credential" not in (query or {}):
+                        "X-Amz-Credential" not in (query or {}) and \
+                        "AWSAccessKeyId" not in (query or {}):
                     self._body()
                     self.send_response(303)
                     self.send_header("Location", web_handlers.BROWSER_PATH)

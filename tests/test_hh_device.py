@@ -58,3 +58,17 @@ def test_zero_length_blocks():
     want = np.frombuffer(hh.hh256(b""), np.uint8)
     assert np.array_equal(got[0], want)
     assert np.array_equal(got[1], want)
+
+
+@pytest.mark.parametrize("B,n", [(2, 96), (3, 87), (1, 32), (5, 1000)])
+def test_pallas_kernel_matches_reference(B, n):
+    """The single-kernel pallas formulation (ops/hh_pallas.py) must be
+    bit-identical to the host C HighwayHash-256; on CPU it runs in the
+    pallas interpreter (same program, no Mosaic)."""
+    from minio_tpu.ops import hh_pallas
+    rng = np.random.default_rng(17)
+    blocks = rng.integers(0, 256, (B, n), dtype=np.uint8)
+    got = np.asarray(hh_pallas.hh256_batch(blocks))
+    want = np.stack([np.frombuffer(hh.hh256(blocks[i].tobytes()), np.uint8)
+                     for i in range(B)])
+    assert np.array_equal(got, want)
