@@ -391,11 +391,28 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         md5 = hashlib.md5()
         total = 0
 
+        # readahead on the body: the network read of batch N+1 overlaps
+        # batch N's encode + drive writes (klauspost/readahead role,
+        # cmd/xl-storage.go:1544-1546)
+        from ..utils.readahead import readahead
+
+        def _chunks():
+            c = first
+            while c:
+                yield c
+                if len(c) < batch:
+                    return
+                c = _read_full(reader, batch)
+
+        chunks = None
         lk = self.ns_lock.new_lock(bucket, object_name)
         lk.lock(write=True)
         try:
-            chunk = first
-            while True:
+            # started only after the lock is held and inside the try:
+            # a lock failure must not leave a thread draining the body
+            # socket with no close()
+            chunks = readahead(_chunks(), depth=1)
+            for chunk in chunks:
                 md5.update(chunk)
                 total += len(chunk)
                 if m > 0:
@@ -427,11 +444,6 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 if alive < wq:
                     raise WriteQuorumError(
                         f"{alive} of {n} drives writable, need {wq}")
-                if len(chunk) < batch:
-                    break
-                chunk = _read_full(reader, batch)
-                if not chunk:
-                    break
             etag = md5.hexdigest()
             fi.size = total
             fi.metadata = {ETAG_KEY: etag, **opts.user_defined}
@@ -464,6 +476,9 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             self.metacache.invalidate(bucket)
             return self._to_object_info(fi)
         finally:
+            if chunks is not None:
+                chunks.close()  # stop + JOIN the readahead thread: the
+                                # handler reuses the body socket next
             lk.unlock()
             for idx, disk in enumerate(shuffled):
                 if disk is not None and tmps[idx] is not None:
@@ -503,13 +518,16 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                    length: int = -1,
                    opts: Optional[ObjectOptions] = None
                    ) -> tuple[ObjectInfo, bytes]:
+        # fully-buffered read: joins immediately, so the readahead
+        # thread would add overhead with zero overlap to exploit
         info, gen = self.get_object_reader(bucket, object_name, offset,
-                                           length, opts)
+                                           length, opts, _readahead=False)
         return info, b"".join(gen)
 
     def get_object_reader(self, bucket: str, object_name: str,
                           offset: int = 0, length: int = -1,
-                          opts: Optional[ObjectOptions] = None):
+                          opts: Optional[ObjectOptions] = None,
+                          _readahead: bool = True):
         """Range GET as (info, chunk iterator): reads ONLY the shard byte
         ranges covering the requested blocks (ShardFileOffset math,
         cmd/erasure-coding.go:134 + cmd/erasure-decode.go:229-246) and
@@ -537,8 +555,17 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         info = self._to_object_info(fi)
         if size == 0 or length == 0:
             return info, iter(())
-        return info, self._stream_range(bucket, object_name, fi, fis,
-                                        offset, length)
+        gen = self._stream_range(bucket, object_name, fi, fis, offset,
+                                 length)
+        if not _readahead:
+            return info, gen
+        # readahead: block batch N+1's shard reads + decode overlap the
+        # consumer sending batch N (klauspost/readahead role, go.mod:39;
+        # pipeline overlap of cmd/bitrot-streaming.go:74-89).  depth=1
+        # is full double-buffering at half the buffered memory — the
+        # RSS gate in test_streaming bounds the whole pipeline
+        from ..utils.readahead import readahead
+        return info, readahead(gen, depth=1)
 
     def _stream_range(self, bucket: str, object_name: str, fi: FileInfo,
                       fis: list[FileInfo | None], offset: int, length: int):

@@ -1,0 +1,108 @@
+"""Bounded readahead over an iterator — the async-I/O overlap layer.
+
+Role of klauspost/readahead in the reference (go.mod:39, used at
+cmd/xl-storage.go:1544-1546 for big CreateFile streams) and of the
+io.Pipe overlap in the bitrot writers (cmd/bitrot-streaming.go:74-89):
+production (disk reads + erasure decode, or network reads) runs in a
+background thread up to `depth` items ahead of the consumer, so block
+batch N+1's I/O overlaps block N's send — the double-buffered pipeline
+of SURVEY.md §2.3 on the host side.
+
+Semantics:
+  * order-preserving, exceptions re-raised at the consumer's position;
+  * bounded queue: the producer blocks once `depth` items are pending
+    (memory stays O(depth x item));
+  * close() (or GC, or generator .close() from an abandoned for-loop)
+    stops the producer promptly — a disconnected HTTP client must not
+    leave a thread streaming a 5 TiB object into a queue.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator
+
+_SENTINEL = object()
+
+
+class Readahead:
+    def __init__(self, it: Iterable, depth: int = 2):
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._produce, args=(iter(it),), daemon=True)
+        self._thread.start()
+
+    def _produce(self, it: Iterator) -> None:
+        try:
+            for item in it:
+                while not self._closed.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._closed.is_set():
+                    break
+            else:
+                self._put_forever((_SENTINEL, None))
+                return
+        except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
+            self._put_forever((_SENTINEL, e))
+            return
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _put_forever(self, item) -> None:
+        while not self._closed.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._closed.is_set():
+            raise StopIteration
+        item = self._q.get()
+        if isinstance(item, tuple) and len(item) == 2 and \
+                item[0] is _SENTINEL:
+            self._closed.set()
+            if item[1] is not None:
+                raise item[1]
+            raise StopIteration
+        return item
+
+    def close(self, _empty=queue.Empty) -> None:
+        # _empty bound at def time: __del__ may run during interpreter
+        # shutdown after module globals are cleared
+        self._closed.set()
+        # drain so a blocked producer sees the flag promptly
+        try:
+            while True:
+                self._q.get_nowait()
+        except _empty:
+            pass
+        # JOIN before returning: the producer may be mid-read on a
+        # shared source (the HTTP body socket) — the caller must not
+        # resume using that source while our thread still reads it.
+        # Bounded: after the in-flight read the flag stops the loop.
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=60)
+
+    def __del__(self):  # abandoned mid-stream (client disconnect)
+        self.close()
+
+
+def readahead(it: Iterable, depth: int = 2) -> Readahead:
+    """Wrap `it` so it is produced `depth` items ahead in a thread."""
+    return Readahead(it, depth)

@@ -72,3 +72,21 @@ def test_pallas_kernel_matches_reference(B, n):
     want = np.stack([np.frombuffer(hh.hh256(blocks[i].tobytes()), np.uint8)
                      for i in range(B)])
     assert np.array_equal(got, want)
+
+
+def test_pallas_kernel_multi_chunk_grid_carry():
+    """Production shapes span MANY packet chunks (ssize ~87 KiB -> ~2732
+    packets vs _PC=128): the VMEM state carried across the packet-chunk
+    grid dimension, S>1 shard tiling, and the masked tail chunk must all
+    agree with the reference — a bug there corrupts every stored shard's
+    digests.  B=256 -> S=2 tiles; n=8808 -> 275 packets -> 3 chunks with
+    19 valid packets in the last, plus an 8-byte remainder."""
+    from minio_tpu.ops import hh_pallas
+    rng = np.random.default_rng(23)
+    B, n = 256, 8808
+    blocks = rng.integers(0, 256, (B, n), dtype=np.uint8)
+    got = np.asarray(hh_pallas.hh256_batch(blocks))
+    idx = [0, 1, 127, 128, 255]          # spot-check across both tiles
+    for i in idx:
+        want = np.frombuffer(hh.hh256(blocks[i].tobytes()), np.uint8)
+        assert np.array_equal(got[i], want), i
