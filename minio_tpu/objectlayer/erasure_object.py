@@ -55,6 +55,42 @@ STREAM_BATCH_BYTES = int(os.environ.get("MT_STREAM_BATCH",
                                         64 * 1024 * 1024))
 
 
+class _LockedStream:
+    """Iterator holding a DRWMutex until exhausted/closed/GC'd; the
+    unlock runs exactly once (see _locked_stream)."""
+
+    def __init__(self, lk, inner):
+        self._lk = lk
+        self._inner = inner
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        try:
+            return next(self._inner)
+        except BaseException:
+            self.close()
+            raise
+
+    def close(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        try:
+            close = getattr(self._inner, "close", None)
+            if close is not None:
+                close()
+        finally:
+            self._lk.unlock()
+
+    def __del__(self):
+        self.close()
+
+
 def _read_full(source, n: int) -> bytes:
     """Read exactly n bytes from a file-like source unless EOF comes
     first (sockets and chunked decoders return short reads)."""
@@ -510,9 +546,14 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                         opts: Optional[ObjectOptions] = None) -> ObjectInfo:
         opts = opts or ObjectOptions()
         self._check_bucket(bucket)
-        fi, _ = self._read_quorum_fileinfo(bucket, object_name,
-                                           opts.version_id)
-        return self._to_object_info(fi)
+        lk = self.ns_lock.new_lock(bucket, object_name)
+        lk.lock(write=False)   # rlock, as GetObjectInfo does
+        try:
+            fi, _ = self._read_quorum_fileinfo(bucket, object_name,
+                                               opts.version_id)
+            return self._to_object_info(fi)
+        finally:
+            lk.unlock()
 
     def get_object(self, bucket: str, object_name: str, offset: int = 0,
                    length: int = -1,
@@ -535,28 +576,38 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         object touches one block per shard and memory stays O(batch)."""
         opts = opts or ObjectOptions()
         self._check_bucket(bucket)
-        fi, fis = self._read_quorum_fileinfo(bucket, object_name,
-                                             opts.version_id)
-        if fi.deleted:
-            raise MethodNotAllowed(f"{bucket}/{object_name} is a delete "
-                                   "marker")
-        # HTTP range semantics in one pass (cmd/httprange.go): negative
-        # offset = suffix (last -offset bytes); length < 0 = to end;
-        # overlong ranges clamp; start past EOF is invalid
-        size = fi.size
-        if offset < 0:
-            offset = max(0, size + offset)
-        if length < 0:
-            length = size - offset
-        if offset > size or (size > 0 and offset == size):
-            from .interface import InvalidRange
-            raise InvalidRange(f"{offset}+{length} vs {size}")
-        length = min(length, size - offset)
-        info = self._to_object_info(fi)
+        # read lock for the duration of the stream (GetObjectNInfo takes
+        # the nsLock RLock, cmd/erasure-object.go:136): a reader racing a
+        # PUT/DELETE commit must never observe a half-renamed version set
+        lk = self.ns_lock.new_lock(bucket, object_name)
+        lk.lock(write=False)
+        try:
+            fi, fis = self._read_quorum_fileinfo(bucket, object_name,
+                                                 opts.version_id)
+            if fi.deleted:
+                raise MethodNotAllowed(f"{bucket}/{object_name} is a "
+                                       "delete marker")
+            # HTTP range semantics in one pass (cmd/httprange.go):
+            # negative offset = suffix (last -offset bytes); length < 0 =
+            # to end; overlong ranges clamp; start past EOF is invalid
+            size = fi.size
+            if offset < 0:
+                offset = max(0, size + offset)
+            if length < 0:
+                length = size - offset
+            if offset > size or (size > 0 and offset == size):
+                from .interface import InvalidRange
+                raise InvalidRange(f"{offset}+{length} vs {size}")
+            length = min(length, size - offset)
+            info = self._to_object_info(fi)
+        except BaseException:
+            lk.unlock()
+            raise
         if size == 0 or length == 0:
+            lk.unlock()
             return info, iter(())
-        gen = self._stream_range(bucket, object_name, fi, fis, offset,
-                                 length)
+        gen = self._locked_stream(lk, self._stream_range(
+            bucket, object_name, fi, fis, offset, length))
         if not _readahead:
             return info, gen
         # readahead: block batch N+1's shard reads + decode overlap the
@@ -566,6 +617,18 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         # RSS gate in test_streaming bounds the whole pipeline
         from ..utils.readahead import readahead
         return info, readahead(gen, depth=1)
+
+    @staticmethod
+    def _locked_stream(lk, inner):
+        """Hold a lock until the stream is exhausted or abandoned.
+
+        NOT a generator on purpose: per PEP 342, closing/GC-ing a
+        generator that was never advanced does not run its body, so a
+        try/finally inside one never executes and the lock would leak
+        forever (the refresh keepalive keeps the grant alive).  This
+        wrapper unlocks exactly once on exhaustion, error, close(), or
+        GC — advanced or not."""
+        return _LockedStream(lk, inner)
 
     def _stream_range(self, bucket: str, object_name: str, fi: FileInfo,
                       fis: list[FileInfo | None], offset: int, length: int):
@@ -739,42 +802,53 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         opts = opts or ObjectOptions()
         self._check_bucket(bucket)
         mod_time = opts.mod_time or now_ns()
-        if opts.versioned and opts.version_id is None:
-            # versioned delete without a version: write a delete marker
-            dm = FileInfo(volume=bucket, name=object_name,
-                          version_id=str(uuid.uuid4()), deleted=True,
-                          data_dir="", mod_time=mod_time)
+        # write lock (DeleteObject takes the nsLock, cmd/erasure-object.go
+        # delete path): a delete racing a PUT commit must not interleave
+        # per-drive version mutations
+        lk = self.ns_lock.new_lock(bucket, object_name)
+        lk.lock(write=True)
+        try:
+            if opts.versioned and opts.version_id is None:
+                # versioned delete without a version: write a delete marker
+                dm = FileInfo(volume=bucket, name=object_name,
+                              version_id=str(uuid.uuid4()), deleted=True,
+                              data_dir="", mod_time=mod_time)
+                _, errs = self._fanout(
+                    lambda d: d.delete_version(bucket, object_name, dm,
+                                               force_del_marker=True))
+                try:
+                    meta.reduce_errs(errs, self._write_quorum(),
+                                     WriteQuorumError)
+                except serrors.StorageError as e:
+                    raise WriteQuorumError(str(e)) from e
+                oi = ObjectInfo(bucket=bucket, name=object_name,
+                                version_id=dm.version_id,
+                                delete_marker=True, mod_time=mod_time)
+                self.metacache.invalidate(bucket)
+                return oi
+            # delete a concrete version (or the null version)
+            vid = opts.version_id or ""
+            fi = FileInfo(volume=bucket, name=object_name, version_id=vid,
+                          mod_time=mod_time)
             _, errs = self._fanout(
-                lambda d: d.delete_version(bucket, object_name, dm,
-                                           force_del_marker=True))
+                lambda d: d.delete_version(bucket, object_name, fi))
+            nf = sum(1 for e in errs
+                     if isinstance(e, (serrors.FileNotFound,
+                                       serrors.FileVersionNotFound)))
+            if nf > len(self.disks) // 2:
+                # object absent: S3 DELETE is idempotent; return quietly
+                return ObjectInfo(bucket=bucket, name=object_name,
+                                  version_id=vid)
             try:
-                meta.reduce_errs(errs, self._write_quorum(), WriteQuorumError)
+                meta.reduce_errs(errs, self._write_quorum(),
+                                 WriteQuorumError)
             except serrors.StorageError as e:
                 raise WriteQuorumError(str(e)) from e
-            oi = ObjectInfo(bucket=bucket, name=object_name,
-                            version_id=dm.version_id, delete_marker=True,
-                            mod_time=mod_time)
             self.metacache.invalidate(bucket)
-            return oi
-        # delete a concrete version (or the null version)
-        vid = opts.version_id or ""
-        fi = FileInfo(volume=bucket, name=object_name, version_id=vid,
-                      mod_time=mod_time)
-        _, errs = self._fanout(
-            lambda d: d.delete_version(bucket, object_name, fi))
-        nf = sum(1 for e in errs
-                 if isinstance(e, (serrors.FileNotFound,
-                                   serrors.FileVersionNotFound)))
-        if nf > len(self.disks) // 2:
-            # object absent: S3 DELETE is idempotent; return quietly
             return ObjectInfo(bucket=bucket, name=object_name,
                               version_id=vid)
-        try:
-            meta.reduce_errs(errs, self._write_quorum(), WriteQuorumError)
-        except serrors.StorageError as e:
-            raise WriteQuorumError(str(e)) from e
-        self.metacache.invalidate(bucket)
-        return ObjectInfo(bucket=bucket, name=object_name, version_id=vid)
+        finally:
+            lk.unlock()
 
     def put_object_metadata(self, bucket: str, object_name: str,
                             version_id: Optional[str],

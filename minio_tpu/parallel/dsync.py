@@ -56,11 +56,21 @@ class _LockEntry:
 
 class LocalLocker:
     """In-process lock table for one node (cmd/local-locker.go) with
-    per-grant TTLs and expiry."""
+    per-grant TTLs and expiry.
+
+    Write-preferring: while a writer is waiting on a resource (it tried
+    and found readers), NEW read grants are refused so the readers drain
+    and the writer lands — a hot object with overlapping readers must
+    not starve PUT/DELETE forever.  The pending mark self-expires, so a
+    writer that gives up (timeout/crash) unblocks readers within
+    WRITER_WAIT_TTL_S."""
+
+    WRITER_WAIT_TTL_S = 1.0
 
     def __init__(self, default_ttl_s: float = DEFAULT_TTL_S):
         self._mu = threading.Lock()
         self._map: dict[str, _LockEntry] = {}
+        self._writer_waiting: dict[str, float] = {}   # resource -> expiry
         self.default_ttl_s = default_ttl_s
 
     def _purge_expired(self, resource: str, now: float) -> None:
@@ -82,12 +92,23 @@ class LocalLocker:
             self._purge_expired(resource, now)
             e = self._map.get(resource)
             if e is None:
+                pending = self._writer_waiting.get(resource, 0.0)
+                if not write and pending > now:
+                    return False       # let the waiting writer in first
                 self._map[resource] = _LockEntry(
                     writer=write,
                     owners={uid: _Grant(1, now + ttl)})
+                if write:
+                    self._writer_waiting.pop(resource, None)
                 return True
             if write or e.writer:
+                if write:
+                    # mark intent (refreshed on every retry attempt)
+                    self._writer_waiting[resource] = \
+                        now + self.WRITER_WAIT_TTL_S
                 return False                      # exclusive conflict
+            if self._writer_waiting.get(resource, 0.0) > now:
+                return False           # writer pending: no new readers
             g = e.owners.get(uid)
             if g is None:
                 e.owners[uid] = _Grant(1, now + ttl)
@@ -217,6 +238,53 @@ class RemoteLocker:
             return False
 
 
+class _Refresher:
+    """ONE shared keepalive thread for every held DRWMutex (the
+    reference's startContinousLockRefresh also refreshes all held locks
+    from one loop).  Per-acquire threads would put a thread create on
+    every GET/HEAD/DELETE — the hottest paths."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._items: dict[int, "DRWMutex"] = {}
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def add(self, m: "DRWMutex") -> None:
+        with self._mu:
+            self._items[id(m)] = m
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True)
+                self._thread.start()
+        self._wake.set()
+
+    def remove(self, m: "DRWMutex") -> None:
+        with self._mu:
+            self._items.pop(id(m), None)
+
+    def _loop(self):
+        while True:
+            with self._mu:
+                items = list(self._items.values())
+            now = time.monotonic()
+            nxt = now + 1.0
+            for m in items:
+                try:
+                    if m._next_refresh <= now:
+                        m._do_refresh()
+                        m._next_refresh = \
+                            time.monotonic() + m.ttl_s / 3
+                    nxt = min(nxt, m._next_refresh)
+                except Exception:  # noqa: BLE001 — never kill the loop
+                    pass
+            self._wake.wait(max(0.05, nxt - time.monotonic()))
+            self._wake.clear()
+
+
+_REFRESHER = _Refresher()
+
+
 class DRWMutex:
     """Quorum read-write lock over n lockers (pkg/dsync/drwmutex.go)."""
 
@@ -229,7 +297,8 @@ class DRWMutex:
         self.ttl_s = ttl_s
         self.acquire_timeout_s = acquire_timeout_s
         self._granted: list[bool] = [False] * len(lockers)
-        self._refresh_stop: threading.Event | None = None
+        self._registered = False
+        self._next_refresh = 0.0
         self._write = False
         self.lost = threading.Event()
 
@@ -310,30 +379,28 @@ class DRWMutex:
             backoff = min(backoff * 2, 0.25)
 
     def _start_refresh(self) -> None:
-        """Holder-side keepalive (startContinousLockRefresh): refresh
-        granted lockers every ttl/3 so long operations outlive the TTL;
-        a crashed holder stops refreshing and the grants expire."""
-        stop = threading.Event()
-        self._refresh_stop = stop
+        """Holder-side keepalive (startContinousLockRefresh): register
+        with the SHARED refresher, which renews grants every ttl/3 so
+        long operations outlive the TTL; a crashed holder stops
+        refreshing and the grants expire."""
+        self._next_refresh = time.monotonic() + self.ttl_s / 3
+        self._registered = True
+        _REFRESHER.add(self)
 
-        def loop():
-            while not stop.wait(self.ttl_s / 3):
-                for i, lk in enumerate(self.lockers):
-                    if not self._granted[i]:
-                        continue
-                    try:
-                        if not lk.refresh(self.resource, self.uid,
-                                          self.ttl_s):
-                            self._granted[i] = False
-                    except Exception:  # noqa: BLE001 — locker down:
-                        pass           # transient; grant may still hold
-                # grants below quorum: the holder is no longer protected
-                # (the reference cancels the op context on lost refresh
-                # quorum, drwmutex.go startContinousLockRefresh)
-                if sum(self._granted) < self._quorum(self._write):
-                    self.lost.set()
-
-        threading.Thread(target=loop, daemon=True).start()
+    def _do_refresh(self) -> None:
+        for i, lk in enumerate(self.lockers):
+            if not self._granted[i]:
+                continue
+            try:
+                if not lk.refresh(self.resource, self.uid, self.ttl_s):
+                    self._granted[i] = False
+            except Exception:  # noqa: BLE001 — locker down:
+                pass           # transient; grant may still hold
+        # grants below quorum: the holder is no longer protected
+        # (the reference cancels the op context on lost refresh
+        # quorum, drwmutex.go startContinousLockRefresh)
+        if sum(self._granted) < self._quorum(self._write):
+            self.lost.set()
 
     def ensure_valid(self) -> None:
         """Commit-point guard: raise LockLost if the refresh loop saw
@@ -343,9 +410,9 @@ class DRWMutex:
             raise LockLost(self.resource)
 
     def unlock(self) -> None:
-        if self._refresh_stop is not None:
-            self._refresh_stop.set()
-            self._refresh_stop = None
+        if self._registered:
+            self._registered = False
+            _REFRESHER.remove(self)
         self._release_all()
 
     def __enter__(self):

@@ -572,12 +572,17 @@ def _make_handler(srv: S3Server):
 
         def _fail(self, e: Exception, resource: str = ""):
             from ..crypto.sse import SSEError
+            from ..parallel.dsync import LockLost, LockTimeout
             if isinstance(e, S3Error):
                 api = e.api
             elif isinstance(e, (SSEError, sigv4.SigV4Error)):
                 api = s3err.get(e.code)
             elif isinstance(e, ol.ObjectLayerError):
                 api = s3err.from_object_error(e)
+            elif isinstance(e, (LockTimeout, LockLost)):
+                # lock contention is congestion, not a server fault
+                # (the reference maps operation timeouts to 503)
+                api = s3err.get("SlowDown")
             else:
                 api = s3err.get("InternalError")
             self._send(api.http_status, s3err.to_xml(api, resource))

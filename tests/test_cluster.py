@@ -200,8 +200,9 @@ def test_lock_ttl_expiry_frees_crashed_holder():
     lockers = [LocalLocker(default_ttl_s=0.3) for _ in range(3)]
     crashed = DRWMutex(lockers, "res", ttl_s=0.3)
     crashed.lock(write=True)
-    # simulate kill -9: the refresh loop dies with the process
-    crashed._refresh_stop.set()
+    # simulate kill -9: the shared refresher forgets this holder
+    from minio_tpu.parallel.dsync import _REFRESHER
+    _REFRESHER.remove(crashed)
 
     waiter = DRWMutex(lockers, "res", ttl_s=0.3)
     t0 = time.monotonic()
@@ -252,11 +253,12 @@ def test_lock_lost_surfaces_to_holder():
     holder.lock(write=True)
     # simulate a long GC/VM pause: stop refreshing, let grants expire,
     # let a competitor take the lock
-    holder._refresh_stop.set()
+    from minio_tpu.parallel.dsync import _REFRESHER
+    _REFRESHER.remove(holder)
     thief = DRWMutex(lockers, "res", ttl_s=0.2)
     thief.lock(write=True, timeout=5.0)
-    # resume the holder's refresh loop: one round sees < quorum grants
-    holder._start_refresh()
+    # resume the holder's refresh: the next round sees < quorum grants
+    holder._do_refresh()
     deadline = time.monotonic() + 2.0
     while not holder.lost.is_set() and time.monotonic() < deadline:
         time.sleep(0.02)

@@ -1,0 +1,287 @@
+"""Concurrency stress tier — the role of `go test -race` over the suite
+(buildscripts/race.sh): hammer one erasure layer from many threads with
+mixed PUT/GET/DELETE/list/heal and assert linearizable-ish outcomes:
+
+  * a GET returns the COMPLETE body of SOME successfully committed PUT
+    (never a torn mix of two writers — the tmp+rename commit contract);
+  * racing deletes surface only ObjectNotFound/VersionNotFound;
+  * a drive dying and returning mid-traffic never corrupts reads
+    (quorum + heal absorb it);
+  * the fan-out pool and readahead threads don't leak.
+
+Python's GIL is not a race detector, but torn commits, lock bugs, and
+shared-state corruption (metacache, MRF, health monitor) surface here
+deterministically enough to gate regressions.
+"""
+
+import hashlib
+import random
+import threading
+
+import pytest
+
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.objectlayer.interface import (MethodNotAllowed,
+                                             ObjectNotFound,
+                                             ReadQuorumError,
+                                             VersionNotFound)
+from minio_tpu.storage.xl_storage import XLStorage
+
+BENIGN = (ObjectNotFound, VersionNotFound, MethodNotAllowed)
+
+
+def _payload(key: str, writer: int, seq: int) -> bytes:
+    rng = random.Random(hash((key, writer, seq)) & 0xFFFFFFFF)
+    body = bytes(rng.getrandbits(8) for _ in range(rng.randrange(1, 8192)))
+    tag = hashlib.md5(body).hexdigest().encode()
+    return tag + b"|" + body      # self-validating: md5(body) prefix
+
+
+def _intact(data: bytes) -> bool:
+    tag, _, body = data.partition(b"|")
+    return hashlib.md5(body).hexdigest().encode() == tag
+
+
+@pytest.fixture
+def layer(tmp_path):
+    disks = []
+    for i in range(6):
+        d = tmp_path / f"d{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    lay = ErasureObjects(disks, parity=2, block_size=32 * 1024,
+                         backend="numpy", inline_threshold=1024)
+    lay.make_bucket("stress")
+    return lay
+
+
+def test_mixed_ops_no_torn_reads(layer):
+    keys = [f"obj-{i}" for i in range(8)]
+    stop = threading.Event()
+    failures: list[str] = []
+
+    def writer(wid):
+        seq = 0
+        while not stop.is_set():
+            key = random.choice(keys)
+            try:
+                layer.put_object("stress", key, _payload(key, wid, seq))
+            except BENIGN:
+                pass
+            except Exception as e:  # noqa: BLE001
+                failures.append(f"writer {wid}: {e!r}")
+                return
+            seq += 1
+
+    def reader(rid):
+        while not stop.is_set():
+            key = random.choice(keys)
+            try:
+                _, data = layer.get_object("stress", key)
+                if not _intact(bytes(data)):
+                    failures.append(f"reader {rid}: TORN read of {key}")
+                    return
+            except BENIGN:
+                pass
+            except Exception as e:  # noqa: BLE001
+                failures.append(f"reader {rid}: {e!r}")
+                return
+
+    def deleter():
+        while not stop.is_set():
+            try:
+                layer.delete_object("stress", random.choice(keys))
+            except BENIGN:
+                pass
+            except Exception as e:  # noqa: BLE001
+                failures.append(f"deleter: {e!r}")
+                return
+
+    def lister():
+        while not stop.is_set():
+            try:
+                layer.list_objects("stress", max_keys=100)
+            except Exception as e:  # noqa: BLE001
+                failures.append(f"lister: {e!r}")
+                return
+
+    threads = [threading.Thread(target=writer, args=(w,)) for w in range(3)]
+    threads += [threading.Thread(target=reader, args=(r,)) for r in range(3)]
+    threads += [threading.Thread(target=deleter),
+                threading.Thread(target=lister)]
+    for t in threads:
+        t.start()
+    stop_timer = threading.Timer(6.0, stop.set)
+    stop_timer.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "stress thread wedged"
+    stop_timer.cancel()
+    assert not failures, failures[:5]
+    # everything that survived is complete
+    res = layer.list_objects("stress", max_keys=100)
+    for oi in res.objects:
+        _, data = layer.get_object("stress", oi.name)
+        assert _intact(bytes(data)), oi.name
+
+
+def test_drive_flap_under_traffic(layer, tmp_path):
+    """Kill a drive dir mid-traffic, restore it: reads keep succeeding on
+    quorum; nothing torn after the flap."""
+    import shutil
+
+    key_count = 6
+    for i in range(key_count):
+        layer.put_object("stress", f"flap-{i}", _payload(f"flap-{i}", 9, 0))
+    stop = threading.Event()
+    failures: list[str] = []
+
+    def reader():
+        while not stop.is_set():
+            i = random.randrange(key_count)
+            try:
+                _, data = layer.get_object("stress", f"flap-{i}")
+                if not _intact(bytes(data)):
+                    failures.append(f"TORN flap-{i}")
+                    return
+            except (ReadQuorumError, *BENIGN):
+                pass
+            except Exception as e:  # noqa: BLE001
+                failures.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    victim = tmp_path / "d3"
+    backup = tmp_path / "d3.bak"
+    try:
+        shutil.move(str(victim), str(backup))     # drive dies
+        threading.Event().wait(1.0)
+        shutil.move(str(backup), str(victim))     # drive returns
+        threading.Event().wait(1.0)
+    finally:
+        stop.set()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    assert not failures, failures[:5]
+    for i in range(key_count):
+        _, data = layer.get_object("stress", f"flap-{i}")
+        assert _intact(bytes(data))
+
+
+def test_no_thread_leak_after_stress(layer):
+    import time
+
+    def settled():
+        last = threading.active_count()
+        for _ in range(30):
+            time.sleep(0.1)
+            cur = threading.active_count()
+            if cur == last:
+                return cur
+            last = cur
+        return last
+
+    # warm the fan-out pool fully, then stress streaming readers
+    list(layer._pool.map(time.sleep, [0.05] * layer._pool._max_workers))
+    data = _payload("leak", 0, 0) * 64
+    layer.put_object("stress", "leak-obj", data)
+    before = settled()
+    for _ in range(20):
+        info, gen = layer.get_object_reader("stress", "leak-obj")
+        next(iter(gen))
+        gen.close()       # abandoned streams must reap their producer
+    for _ in range(20):
+        info, gen = layer.get_object_reader("stress", "leak-obj")
+        assert b"".join(gen) == data
+    after = settled()
+    assert after <= before + 2, (before, after)
+
+
+def test_writer_not_starved_by_reader_stream(layer):
+    """Write-preferring locking: a PUT must land while overlapping
+    readers hold the object's read lock stream after stream."""
+    layer.put_object("stress", "hot", _payload("hot", 0, 0))
+    stop = threading.Event()
+    failures: list[str] = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                info, gen = layer.get_object_reader("stress", "hot")
+                for _ in gen:
+                    pass
+            except BENIGN:
+                pass
+            except Exception as e:  # noqa: BLE001
+                failures.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        # writes must land promptly despite continuous read pressure
+        for seq in range(5):
+            layer.put_object("stress", "hot", _payload("hot", 1, seq))
+        layer.delete_object("stress", "hot")
+    finally:
+        stop.set()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    assert not failures, failures[:3]
+
+
+def test_unstarted_reader_does_not_leak_lock(layer):
+    """A get_object_reader that is dropped without ever being advanced
+    must release its read lock (PEP 342: a never-started generator's
+    finally does not run — the wrapper must unlock anyway)."""
+    import gc
+
+    layer.put_object("stress", "dropme", _payload("dropme", 0, 0))
+    info, gen = layer.get_object_reader("stress", "dropme",
+                                        _readahead=False)
+    del gen           # never advanced
+    gc.collect()
+    # the write lock must be acquirable immediately (no 10s timeout)
+    import time
+    t0 = time.monotonic()
+    layer.put_object("stress", "dropme", _payload("dropme", 0, 1))
+    assert time.monotonic() - t0 < 5.0, "read lock leaked"
+
+
+def test_lock_contention_maps_to_503(layer):
+    """LockTimeout surfaces as 503 SlowDown, not 500 InternalError."""
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+    srv = S3Server(layer, access_key="sk1", secret_key="ss1")
+    srv.start()
+    try:
+        c = S3Client(srv.endpoint, "sk1", "ss1")
+        c.put_object("stress", "locked-obj", b"x" * 100)
+        lk = layer.ns_lock.new_lock("stress", "locked-obj")
+        lk.lock(write=True)
+        try:
+            # cut client patience via a tiny server-side lock timeout:
+            # monkeypatch the layer's lock factory timeout by calling
+            # with the real path — the GET blocks then times out
+            import minio_tpu.parallel.dsync as dsync_mod
+            orig = dsync_mod.DRWMutex.lock
+
+            def fast_lock(self, write=True, timeout=10.0):
+                return orig(self, write=write, timeout=0.3)
+
+            dsync_mod.DRWMutex.lock = fast_lock
+            try:
+                r = c.request("GET", "/stress/locked-obj", expect=())
+            finally:
+                dsync_mod.DRWMutex.lock = orig
+            assert r.status == 503, (r.status, r.body[:200])
+            assert b"SlowDown" in r.body
+        finally:
+            lk.unlock()
+    finally:
+        srv.stop()
