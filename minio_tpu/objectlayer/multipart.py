@@ -357,11 +357,21 @@ class MultipartOps:
                 disk.clean_tmp(tmp)
             disk.delete(SYS_DIR, mp, recursive=True)
 
-        _, errs = self._fanout_indexed(commit_one, shuffled)
+        # the commit mutates the object's version set across drives:
+        # same ns write lock as PUT/DELETE (the reference's
+        # CompleteMultipartUpload takes the nsLock on the object), so a
+        # racing GET can never observe a half-renamed version set
+        lk = self.ns_lock.new_lock(bucket, object_name)
+        lk.lock(write=True)
         try:
-            meta.reduce_errs(errs, self._write_quorum(fi), WriteQuorumError)
-        except serrors.StorageError as e:
-            raise WriteQuorumError(str(e)) from e
+            _, errs = self._fanout_indexed(commit_one, shuffled)
+            try:
+                meta.reduce_errs(errs, self._write_quorum(fi),
+                                 WriteQuorumError)
+            except serrors.StorageError as e:
+                raise WriteQuorumError(str(e)) from e
+        finally:
+            lk.unlock()
         fi.is_latest = True
         self.metacache.invalidate(bucket)
         return self._to_object_info(fi)

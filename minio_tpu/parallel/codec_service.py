@@ -1,0 +1,157 @@
+"""Erasure-codec sidecar service — ship shard blocks over RPC to a
+TPU-equipped peer.
+
+This is the BASELINE.json north-star topology made literal: "a
+pluggable encoder whose 'tpu' impl ships shard blocks over cgo/gRPC to
+a persistent JAX sidecar".  A node without an accelerator (or a process
+that must not own the TPU runtime) registers a `RemoteCodec` whose
+encode/reconstruct round-trips raw shard bytes to a peer that runs the
+device kernels (ops/rs_kernels.py) — the same role storage REST plays
+for remote drives (cmd/storage-rest-*), applied to the compute plane.
+
+Wire format (POST /raw/codec-*): params ride the msgpack header, shard
+bytes ride the HTTP body RAW (one copy per side, same discipline as the
+shard-transfer endpoints).  Responses are length-framed concatenated
+shard files.  Bit-identicality is inherited: the sidecar runs the same
+Erasure codec, so every conformance guarantee transfers.
+"""
+
+from __future__ import annotations
+
+import functools
+import struct
+
+import numpy as np
+
+from ..ops.codec import Erasure, ErasureError
+
+
+@functools.lru_cache(maxsize=64)
+def _codec(k: int, m: int, block_size: int, backend: str) -> Erasure:
+    return Erasure(k, m, block_size, backend=backend)
+
+
+def _frame(shards: list[np.ndarray]) -> bytes:
+    """u32 count || u64 len each || bodies (shard files are equal-length
+    per geometry, but reconstruct replies carry a subset)."""
+    parts = [struct.pack("<I", len(shards))]
+    parts += [struct.pack("<Q", s.nbytes) for s in shards]
+    parts += [s.tobytes() for s in shards]
+    return b"".join(parts)
+
+
+def _unframe(data: bytes) -> list[np.ndarray]:
+    (n,) = struct.unpack_from("<I", data, 0)
+    off = 4
+    lens = []
+    for _ in range(n):
+        (ln,) = struct.unpack_from("<Q", data, off)
+        lens.append(ln)
+        off += 8
+    out = []
+    for ln in lens:
+        out.append(np.frombuffer(data, dtype=np.uint8, count=ln,
+                                 offset=off))
+        off += ln
+    return out
+
+
+def register_codec_service(rpc, backend: str = "auto") -> None:
+    """Expose this node's codec over RPC (the sidecar side)."""
+
+    def encode(params: dict, body: bytes) -> bytes:
+        c = _codec(int(params["k"]), int(params["m"]),
+                   int(params["block_size"]), backend)
+        return _frame(c.encode_object(body))
+
+    def reconstruct(params: dict, body: bytes) -> bytes:
+        c = _codec(int(params["k"]), int(params["m"]),
+                   int(params["block_size"]), backend)
+        present = list(params["present"])
+        want = list(params["want"])
+        got = _unframe(body)
+        if len(got) != len(present):
+            raise ErasureError("present/body mismatch")
+        n = c.data_blocks + c.parity_blocks
+        shards: list[np.ndarray | None] = [None] * n
+        for idx, s in zip(present, got):
+            shards[idx] = s
+        full = c.decode_data_and_parity_blocks(shards)
+        return _frame([full[i] for i in want])
+
+    rpc.register_raw("codec-encode", encode)
+    rpc.register_raw("codec-reconstruct", reconstruct)
+
+
+class RemoteCodec:
+    """Client-side codec with the Erasure surface the object layer uses,
+    executing on a sidecar.  Shard math stays local (pure arithmetic);
+    only the compute-heavy encode/reconstruct cross the wire."""
+
+    def __init__(self, client, data_blocks: int, parity_blocks: int,
+                 block_size: int):
+        self._c = client
+        self.data_blocks = data_blocks
+        self.parity_blocks = parity_blocks
+        self.block_size = int(block_size)
+        self.backend = "remote"
+        self._local = Erasure(data_blocks, parity_blocks, block_size,
+                              backend="numpy")   # shard math + fallback
+
+    # -- shard math (local, pure) -----------------------------------------
+
+    def shard_size(self) -> int:
+        return self._local.shard_size()
+
+    def shard_file_size(self, total_length: int) -> int:
+        return self._local.shard_file_size(total_length)
+
+    def shard_file_offset(self, start_offset: int, length: int,
+                          total_length: int) -> int:
+        return self._local.shard_file_offset(start_offset, length,
+                                             total_length)
+
+    # -- remote compute ----------------------------------------------------
+
+    def _params(self) -> dict:
+        return {"k": self.data_blocks, "m": self.parity_blocks,
+                "block_size": self.block_size}
+
+    def encode_object(self, data) -> list[np.ndarray]:
+        body = bytes(data) if not isinstance(data, (bytes, bytearray)) \
+            else data
+        try:
+            out = self._c.raw_call("codec-encode", self._params(),
+                                   body=bytes(body), idempotent=True)
+        except Exception:  # noqa: BLE001 — sidecar down: local fallback
+            return self._local.encode_object(body)
+        return _unframe(out)
+
+    def decode_data_and_parity_blocks(self, shards) -> list[np.ndarray]:
+        present = [i for i, s in enumerate(shards)
+                   if s is not None and len(s) > 0]
+        want = [i for i in range(len(shards)) if i not in present]
+        if not want:
+            return [np.asarray(s, dtype=np.uint8) for s in shards]
+        try:
+            out = self._c.raw_call(
+                "codec-reconstruct",
+                {**self._params(), "present": present, "want": want},
+                body=_frame([np.asarray(shards[i], dtype=np.uint8)
+                             for i in present]),
+                idempotent=True)
+        except Exception:  # noqa: BLE001
+            return self._local.decode_data_and_parity_blocks(shards)
+        rebuilt = _unframe(out)
+        full = [np.asarray(s, dtype=np.uint8) if s is not None and
+                len(s) > 0 else None for s in shards]
+        for idx, s in zip(want, rebuilt):
+            full[idx] = s
+        return full
+
+    def decode_data_blocks(self, shards) -> list[np.ndarray]:
+        n_zero = sum(1 for s in shards if s is None or len(s) == 0)
+        if n_zero == 0 or n_zero == len(shards):
+            return list(shards)
+        full = self.decode_data_and_parity_blocks(shards)
+        return full

@@ -58,23 +58,32 @@ class LocalLocker:
     """In-process lock table for one node (cmd/local-locker.go) with
     per-grant TTLs and expiry.
 
-    Write-preferring: while a writer is waiting on a resource (it tried
-    and found readers), NEW read grants are refused so the readers drain
-    and the writer lands — a hot object with overlapping readers must
-    not starve PUT/DELETE forever.  The pending mark self-expires, so a
-    writer that gives up (timeout/crash) unblocks readers within
+    Write-preferring, bounded: while a writer is waiting on a resource
+    (it tried and found readers), NEW read grants are refused so the
+    readers drain and the writer lands — a hot object with overlapping
+    readers must not starve PUT/DELETE.  The preference is BOUNDED: if
+    the writer still hasn't landed after WRITER_PREF_MAX_S (a reader
+    stream outliving the writer's patience), new readers are admitted
+    again — one slow streaming GET plus one retrying PUT must not turn
+    into a sustained read outage.  Marks self-expire, so a writer that
+    gives up (timeout/crash) unblocks readers within
     WRITER_WAIT_TTL_S."""
 
     WRITER_WAIT_TTL_S = 1.0
+    WRITER_PREF_MAX_S = 3.0
 
     def __init__(self, default_ttl_s: float = DEFAULT_TTL_S):
         self._mu = threading.Lock()
         self._map: dict[str, _LockEntry] = {}
-        self._writer_waiting: dict[str, float] = {}   # resource -> expiry
+        # resource -> (first_marked, expiry)
+        self._writer_waiting: dict[str, tuple[float, float]] = {}
         self.default_ttl_s = default_ttl_s
 
     def _purge_expired(self, resource: str, now: float) -> None:
         """Drop expired grants for one resource; caller holds _mu."""
+        ww = self._writer_waiting.get(resource)
+        if ww is not None and ww[1] <= now:
+            del self._writer_waiting[resource]
         e = self._map.get(resource)
         if e is None:
             return
@@ -84,6 +93,15 @@ class LocalLocker:
         if not e.owners:
             self._map.pop(resource, None)
 
+    def _writer_pref_active(self, resource: str, now: float) -> bool:
+        """True while new readers should yield to a waiting writer;
+        caller holds _mu."""
+        ww = self._writer_waiting.get(resource)
+        if ww is None:
+            return False
+        first, expiry = ww
+        return expiry > now and now - first < self.WRITER_PREF_MAX_S
+
     def lock(self, resource: str, uid: str, write: bool,
              ttl_s: float | None = None) -> bool:
         ttl = ttl_s or self.default_ttl_s
@@ -92,8 +110,7 @@ class LocalLocker:
             self._purge_expired(resource, now)
             e = self._map.get(resource)
             if e is None:
-                pending = self._writer_waiting.get(resource, 0.0)
-                if not write and pending > now:
+                if not write and self._writer_pref_active(resource, now):
                     return False       # let the waiting writer in first
                 self._map[resource] = _LockEntry(
                     writer=write,
@@ -103,11 +120,16 @@ class LocalLocker:
                 return True
             if write or e.writer:
                 if write:
-                    # mark intent (refreshed on every retry attempt)
-                    self._writer_waiting[resource] = \
-                        now + self.WRITER_WAIT_TTL_S
+                    # mark intent (expiry refreshed on every retry;
+                    # first-marked timestamp preserved so the bounded
+                    # preference window is measured from the first wait)
+                    prev = self._writer_waiting.get(resource)
+                    first = prev[0] if prev is not None and \
+                        prev[1] > now else now
+                    self._writer_waiting[resource] = (
+                        first, now + self.WRITER_WAIT_TTL_S)
                 return False                      # exclusive conflict
-            if self._writer_waiting.get(resource, 0.0) > now:
+            if self._writer_pref_active(resource, now):
                 return False           # writer pending: no new readers
             g = e.owners.get(uid)
             if g is None:
@@ -165,6 +187,11 @@ class LocalLocker:
                 after = len(self._map[resource].owners) \
                     if resource in self._map else 0
                 dropped += before - after
+            # writer-intent marks for resources with no live entry would
+            # otherwise accumulate forever (one per contended key)
+            for resource in list(self._writer_waiting):
+                if self._writer_waiting[resource][1] <= now:
+                    del self._writer_waiting[resource]
         return dropped
 
     def held(self) -> list[dict]:
@@ -264,20 +291,34 @@ class _Refresher:
             self._items.pop(id(m), None)
 
     def _loop(self):
+        # refreshes DISPATCH to a small pool: one stalled remote locker
+        # RPC must delay only its own mutex's keepalive, never starve
+        # every other held lock past its TTL
+        from concurrent.futures import ThreadPoolExecutor
+        pool = ThreadPoolExecutor(max_workers=8)
+
+        def run_one(m):
+            try:
+                m._do_refresh()
+                m._next_refresh = time.monotonic() + m.ttl_s / 3
+            except Exception:  # noqa: BLE001 — never kill the loop
+                m._next_refresh = time.monotonic() + m.ttl_s / 3
+            finally:
+                m._refreshing = False
+
         while True:
             with self._mu:
                 items = list(self._items.values())
             now = time.monotonic()
             nxt = now + 1.0
             for m in items:
-                try:
-                    if m._next_refresh <= now:
-                        m._do_refresh()
-                        m._next_refresh = \
-                            time.monotonic() + m.ttl_s / 3
+                if m._next_refresh <= now:
+                    if not getattr(m, "_refreshing", False):
+                        m._refreshing = True
+                        pool.submit(run_one, m)
+                    nxt = min(nxt, now + 0.25)   # re-check soon
+                else:
                     nxt = min(nxt, m._next_refresh)
-                except Exception:  # noqa: BLE001 — never kill the loop
-                    pass
             self._wake.wait(max(0.05, nxt - time.monotonic()))
             self._wake.clear()
 
@@ -298,6 +339,7 @@ class DRWMutex:
         self.acquire_timeout_s = acquire_timeout_s
         self._granted: list[bool] = [False] * len(lockers)
         self._registered = False
+        self._refreshing = False
         self._next_refresh = 0.0
         self._write = False
         self.lost = threading.Event()
