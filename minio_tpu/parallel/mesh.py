@@ -162,3 +162,56 @@ def ring_reconstruct(mesh: Mesh, data_blocks: int, parity_blocks: int,
                      jnp.int8)
     fn = _ring_apply(mesh, M2.shape[0], surviving.shape[1])
     return fn(M2, jnp.asarray(surviving, dtype=jnp.uint8))
+
+
+# -- fused encode + bitrot hash (BASELINE config 5, multi-chip form) --------
+
+@functools.lru_cache(maxsize=32)
+def _fused_encode_hash(mesh: Mesh, n_rows: int, k: int):
+    """Parity AND per-shard HighwayHash-256 digests from one sharded
+    pipeline: each device encodes its partial parity (psum XOR fan-in
+    over ICI), hashes its OWN k/S data-shard slice locally, and the data
+    digests ride an all_gather over the shard axis — the multi-chip form
+    of the fused single-chip path (ops/hh_pallas.py).  Parity is
+    replicated post-psum, so its digests are computed in place."""
+    from minio_tpu.ops import hh_kernels
+
+    def local(mat, data):
+        # data: (B/T, k/S, n) uint8 — this device's shard slice
+        b, kl, n = data.shape
+        encode = _local_gf2_kernel(
+            n_rows, lambda acc: jax.lax.psum(acc, "shard"))
+        parity = encode(mat, data)                   # (B/T, r, n) replicated
+        d_dig = hh_kernels.hh256_batch(
+            data.reshape(b * kl, n)).reshape(b, kl, 32)
+        d_dig = jax.lax.all_gather(
+            d_dig, "shard", axis=1, tiled=True)      # (B/T, k, 32)
+        r = parity.shape[1]
+        p_dig = hh_kernels.hh256_batch(
+            parity.reshape(b * r, n)).reshape(b, r, 32)
+        return parity, jnp.concatenate([d_dig, p_dig], axis=1)
+
+    specs = dict(in_specs=(P(None, "shard"), P("stripe", "shard", None)),
+                 out_specs=(P("stripe", None, None),
+                            P("stripe", None, None)))
+    try:
+        fn = jax.shard_map(local, mesh=mesh, check_vma=False, **specs)
+    except TypeError:
+        fn = jax.shard_map(local, mesh=mesh, check_rep=False, **specs)
+    return jax.jit(fn)
+
+
+def distributed_encode_with_bitrot(mesh: Mesh, data_blocks: int,
+                                   parity_blocks: int,
+                                   shards: np.ndarray):
+    """(parity, digests) for a stripe batch, sharded over the mesh.
+
+    shards: (B, k, n) uint8.  Returns parity (B, m, n) and digests
+    (B, k+m, 32) — data-shard digests first, parity digests after,
+    bit-identical to the host HighwayHash-256 with the bitrot key.
+    """
+    M = gf8.rs_matrix(data_blocks, data_blocks + parity_blocks)
+    M2 = jnp.asarray(
+        gf8.gf2_expand(np.asarray(M)[data_blocks:]), jnp.int8)
+    fn = _fused_encode_hash(mesh, M2.shape[0], shards.shape[1])
+    return fn(M2, jnp.asarray(shards, dtype=jnp.uint8))
