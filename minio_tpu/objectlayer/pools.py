@@ -69,6 +69,10 @@ class ErasureServerPools(ObjectLayer):
 
     def _find_pool(self, bucket: str, object_name: str,
                    opts=None) -> ErasureSets:
+        if len(self.pools) == 1:
+            # the op itself surfaces not-found; probing first would
+            # double the lock + quorum-read work of every single-pool GET
+            return self.pools[0]
         last: Exception = ObjectNotFound(f"{bucket}/{object_name}")
         for p in self.pools:
             try:
