@@ -144,6 +144,9 @@ _ERRORS = {
         "tag key or value was invalid.", 400),
     "MalformedPolicy": APIError(
         "MalformedPolicy", "Policy has invalid resource.", 400),
+    "NoSuchWebsiteConfiguration": APIError(
+        "NoSuchWebsiteConfiguration",
+        "The specified bucket does not have a website configuration", 404),
     "NoSuchCORSConfiguration": APIError(
         "NoSuchCORSConfiguration",
         "The CORS configuration does not exist", 404),

@@ -906,7 +906,8 @@ def _make_handler(srv: S3Server):
             cmd = self.command
             if not ({"policy", "lifecycle", "encryption", "replication",
                      "notification", "object-lock", "tagging", "quota",
-                     "acl", "cors"} & set(query)):
+                     "acl", "cors", "website", "accelerate",
+                     "requestPayment", "logging"} & set(query)):
                 return False
 
             def exists():
@@ -946,6 +947,42 @@ def _make_handler(srv: S3Server):
                     self._send(204)
                 else:
                     raise S3Error("MethodNotAllowed")
+                return True
+
+            # dummy sub-resources (cmd/dummy-handlers.go): authorize with
+            # the bucket-policy action, validate existence, then return
+            # the fixed default (or the documented error); DELETE website
+            # succeeds as a no-op
+            _DUMMY = {
+                "accelerate": (
+                    b'<?xml version="1.0" encoding="UTF-8"?>'
+                    b'<AccelerateConfiguration xmlns="http://s3.amazonaws'
+                    b'.com/doc/2006-03-01/"/>'),
+                "requestPayment": (
+                    b'<?xml version="1.0" encoding="UTF-8"?>'
+                    b'<RequestPaymentConfiguration xmlns="http://s3.'
+                    b'amazonaws.com/doc/2006-03-01/"><Payer>BucketOwner'
+                    b'</Payer></RequestPaymentConfiguration>'),
+                "logging": (
+                    b'<?xml version="1.0" encoding="UTF-8"?>'
+                    b'<BucketLoggingStatus xmlns="http://s3.amazonaws.com'
+                    b'/doc/2006-03-01/"></BucketLoggingStatus>'),
+                "website": None,     # GET -> NoSuchWebsiteConfiguration
+            }
+            for param, body in _DUMMY.items():
+                if param not in query:
+                    continue
+                self._allow(iampol.GET_BUCKET_POLICY, bucket)
+                exists()
+                if param == "website" and cmd == "DELETE":
+                    self._send(204)
+                elif cmd == "GET":
+                    if body is None:
+                        raise S3Error("NoSuchWebsiteConfiguration")
+                    self._send(200, body,
+                               content_type="application/xml")
+                else:
+                    raise S3Error("NotImplemented")
                 return True
 
             if crud("policy", iampol.GET_BUCKET_POLICY,

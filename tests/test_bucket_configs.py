@@ -368,3 +368,24 @@ def test_retention_check_helpers():
     expired = {olock.AMZ_OBJECT_LOCK_MODE: "COMPLIANCE",
                olock.AMZ_OBJECT_LOCK_RETAIN_UNTIL: "2001-01-01T00:00:00Z"}
     assert olock.check_delete_allowed(expired)
+
+
+def test_dummy_subresources(client):
+    """cmd/dummy-handlers.go parity: accelerate/requestPayment/logging
+    return fixed defaults, website GET is NoSuchWebsiteConfiguration and
+    DELETE a success no-op; all validate bucket existence first."""
+    client.make_bucket("dummycfg")
+    r = client.request("GET", "/dummycfg", "accelerate")
+    assert b"AccelerateConfiguration" in r.body
+    r = client.request("GET", "/dummycfg", "requestPayment")
+    assert b"<Payer>BucketOwner</Payer>" in r.body
+    r = client.request("GET", "/dummycfg", "logging")
+    assert b"BucketLoggingStatus" in r.body
+    r = client.request("GET", "/dummycfg", "website", expect=())
+    assert r.status == 404 and b"NoSuchWebsiteConfiguration" in r.body
+    r = client.request("DELETE", "/dummycfg", "website")
+    assert r.status == 204
+    # nonexistent bucket surfaces NoSuchBucket, not the dummy default
+    r = client.request("GET", "/nosuchbkt-dummy", "accelerate", expect=())
+    assert r.status == 404 and b"NoSuchBucket" in r.body
+    client.delete_bucket("dummycfg")
