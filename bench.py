@@ -173,9 +173,12 @@ def main() -> None:
 
     from minio_tpu.ops import hh_pallas
 
-    # wider batch for the fused leg: the pallas hash kernel's grid
-    # parallelism wants >= 4 shard blocks of 1024 (BF * (k+m) = 4096)
-    BF = 256
+    # fused batch: 192 stripes -> 3072 shards (3 grid blocks of 1024).
+    # Empirically the stable sweet spot on v5e — 4096 shards makes the
+    # marginal-time measurement swing wildly, and the barrier stops XLA
+    # from fusing the concat into the hash kernel's limb transpose
+    # (which re-creates the strided-access pathology)
+    BF = 192
     fdata = jax.random.randint(jax.random.PRNGKey(1), (BF, k, ss_pad),
                                0, 256, dtype=jnp.uint8)
     fdata.block_until_ready()
@@ -185,8 +188,10 @@ def main() -> None:
         def body(_, carry):
             d, hacc = carry
             par = rs_kernels._gf2_apply(enc_mat, d)
-            full = jnp.concatenate([d, par], axis=1)
-            h = hh_pallas.hh256_batch(full.reshape(BF * (k + m), ss_pad))
+            full = jnp.concatenate([d, par], axis=1) \
+                .reshape(BF * (k + m), ss_pad)
+            full = jax.lax.optimization_barrier(full)
+            h = hh_pallas.hh256_batch(full)
             reps = -(-k // m)
             mix = jnp.tile(par, (1, reps, 1))[:, :k, :]
             # XOR-reduce ALL digests into the carry: every one of the
