@@ -24,6 +24,7 @@ device dispatch, keeping the MXU fed (SURVEY.md section 7).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -78,16 +79,32 @@ def _put_matrix(M: np.ndarray) -> jax.Array:
     return _device_matrix(M.tobytes(), M.shape[0], M.shape[1])
 
 
+def _use_pallas() -> bool:
+    """Fused pallas kernel on real TPU (bit planes never touch HBM);
+    the XLA formulation elsewhere.  MT_RS_PALLAS=0 forces XLA on TPU,
+    =1 forces the pallas kernel (interpreter off-TPU) for testing."""
+    env = os.environ.get("MT_RS_PALLAS", "auto")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    return jax.default_backend() == "tpu"
+
+
 def apply_matrix(M: np.ndarray, shards: np.ndarray | jax.Array) -> np.ndarray:
     """out[b] = M (GF) @ shards[b] for a batch of stripes.
 
     M: (r, k) uint8 GF coefficients;  shards: (B, k, n) uint8.
     Returns (B, r, n) uint8 (numpy, host).
     """
-    mb = _put_matrix(M)
     squeeze = getattr(shards, "ndim", 3) == 2
     if squeeze:
         shards = shards[None]
+    pallas = _use_pallas()
+    if pallas:
+        from . import rs_pallas
+    else:
+        mb = _put_matrix(M)
     on_device = isinstance(shards, jax.Array)
     if not on_device:
         shards = np.asarray(shards, dtype=np.uint8)
@@ -97,7 +114,8 @@ def apply_matrix(M: np.ndarray, shards: np.ndarray | jax.Array) -> np.ndarray:
     # _MAX_BATCH and padded to the next power of two.  Device-resident
     # input stays on device (no host round trip); all chunks are
     # dispatched before any result is pulled back, so XLA overlaps MXU
-    # work with D2H transfer.
+    # work with D2H transfer.  Both properties hold for the pallas and
+    # XLA kernels alike.
     xp = jnp if on_device else np
     pad_n = (-n) % _LANES
     if pad_n:
@@ -109,7 +127,10 @@ def apply_matrix(M: np.ndarray, shards: np.ndarray | jax.Array) -> np.ndarray:
         bb = 1 << (b - 1).bit_length()  # next power of two
         if bb != b:
             chunk = xp.pad(chunk, ((0, bb - b), (0, 0), (0, 0)))
-        handles.append((_gf2_apply(mb, jnp.asarray(chunk)), b))
+        if pallas:
+            handles.append((rs_pallas.apply_matrix(M, chunk), b))
+        else:
+            handles.append((_gf2_apply(mb, jnp.asarray(chunk)), b))
     chunks = [np.asarray(out[:b]) for out, b in handles]
     res = chunks[0] if len(chunks) == 1 else np.concatenate(chunks)
     if pad_n:
