@@ -179,12 +179,9 @@ def main() -> None:
 
     from minio_tpu.ops import hh_pallas
 
-    # fused batch: 192 stripes -> 3072 shards (3 grid blocks of 1024).
-    # Empirically the stable sweet spot on v5e — 4096 shards makes the
-    # marginal-time measurement swing wildly, and the barrier stops XLA
-    # from fusing the concat into the hash kernel's limb transpose
-    # (which re-creates the strided-access pathology)
-    BF = 192
+    # fused batch: 256 stripes -> data (3072 shards) and parity (1024)
+    # are exact 1024-shard tile multiples, so neither hash leg pads
+    BF = 256
     fdata = jax.random.randint(jax.random.PRNGKey(1), (BF, k, ss_pad),
                                0, 256, dtype=jnp.uint8)
     fdata.block_until_ready()
@@ -194,17 +191,23 @@ def main() -> None:
         def body(_, carry):
             d, hacc = carry
             par = rs_pallas._gf2_apply_bm(enc_mat, d, gs=GS)
-            full = jnp.concatenate([d, par], axis=1) \
-                .reshape(BF * (k + m), ss_pad)
-            full = jax.lax.optimization_barrier(full)
-            h = hh_pallas.hh256_batch(full)
-            reps = -(-k // m)
-            mix = jnp.tile(par, (1, reps, 1))[:, :k, :]
+            # hash data and parity as separate batches: digests are
+            # per-shard, so materializing a concatenated (BF*16, n)
+            # array first would cost a full extra HBM round trip
+            hd = hh_pallas.hh256_batch(d.reshape(BF * k, ss_pad))
+            hp_ = hh_pallas.hh256_batch(par.reshape(BF * m, ss_pad))
             # XOR-reduce ALL digests into the carry: every one of the
             # BF*(k+m) hashes is live, none can be narrowed away by XLA
-            hall = jax.lax.reduce(h, jnp.uint8(0),
-                                  jax.lax.bitwise_xor, (0,))
-            return d ^ mix, hacc ^ hall
+            hall = jax.lax.reduce(hd, jnp.uint8(0),
+                                  jax.lax.bitwise_xor, (0,)) ^ \
+                jax.lax.reduce(hp_, jnp.uint8(0),
+                               jax.lax.bitwise_xor, (0,))
+            # chain: next input folds the digest XOR into every packet
+            # of d — step i+1 depends on EVERY byte of step i's data,
+            # parity and digests (stronger than mixing parity tiles,
+            # and one full HBM round trip cheaper)
+            mixed = d.reshape(BF, k, ss_pad // 32, 32) ^ hall
+            return mixed.reshape(BF, k, ss_pad), hacc ^ hall
 
         return jax.lax.fori_loop(0, iters, body,
                                  (d0, jnp.zeros(32, jnp.uint8)))
@@ -219,16 +222,27 @@ def main() -> None:
         assert s != 0
         return best
 
-    fiters = 4
+    fiters = 12
     fused_chained(fdata, fiters)[1].block_until_ready()      # compile
     fused_chained(fdata, 2 * fiters)[1].block_until_ready()
-    for attempt in range(3):
+    for attempt in range(5):
         ft1 = fused_timed(fiters, trials=3 + attempt)
         ft2 = fused_timed(2 * fiters, trials=3 + attempt)
-        if ft2 > ft1:
+        fdt = (ft2 - ft1) / fiters
+        fused_gibps = (BF * block_size) / fdt / 2**30 if fdt > 0 else -1
+        # physical gate: the fused step is a superset of the encode
+        # step (same matmul + two hash kernels), so it cannot beat the
+        # encode-only rate.  A reading above it is marginal-time noise
+        # (fiters=4 once reported an impossible 610 GiB/s) — retry.
+        if 0 < fused_gibps <= encode_gibps * 1.05:
             break
-    fdt = marginal(ft1, ft2, fiters, "fused")
-    fused_gibps = (BF * block_size) / fdt / 2**30
+    else:
+        reason = ("non-positive marginal time (elided dispatch or "
+                  "foreign load)" if fdt <= 0 else
+                  f"{fused_gibps:.1f} GiB/s exceeds the encode-only "
+                  f"rate {encode_gibps:.1f}")
+        raise RuntimeError(f"fused: unstable marginal — {reason}; "
+                           "rerun on a quiet chip")
     if peak:   # fused leg contains the encode matmul — same gate
         fused_tops = 2 * (m * 8 * k * 8 * BF * ss_pad) / fdt / 1e12
         assert fused_tops <= peak, (
@@ -248,9 +262,11 @@ def main() -> None:
             "decode2_GiBps": round(decode_gibps, 2),
             "heal3_GiBps": round(heal_gibps, 2),
             "heal_shards_per_s": round(heal_shards_s, 1),
-            # fused = encode + concat + limb-transpose prep + pallas
-            # hash; the hash kernel alone sustains ~23 GiB/s (chained),
-            # the AoS->SoA limb transpose is the current fused-path tax
+            # fused = pallas encode -> pallas u8-transpose -> pallas
+            # byte-plane hash, kernel-to-kernel (an XLA op producing
+            # the hash operand costs a ~45 GB/s layout copy; the hash
+            # update itself sustains ~140 GiB/s once the per-packet
+            # tail masks were replaced by a dynamic loop bound)
             "fused_encode_hh256_GiBps": round(fused_gibps, 2),
             ("e2e_put_256x4MiB_fsync_GiBps" if _FSYNC_ON
              else "e2e_put_256x4MiB_nofsync_GiBps"): e2e_gibps,

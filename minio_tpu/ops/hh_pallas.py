@@ -45,7 +45,6 @@ _U32 = jnp.uint32
 # packets per grid step: VMEM block is 8 limb planes x PC packets x
 # (S x 128) shards x 4 B; with S=8 and PC=128 that's 4 MiB
 _PC = 128
-_TB = 1024          # shards per grid block (S = 8 sublane tiles)
 
 
 def _update_lanes(state, lanes):
@@ -108,6 +107,15 @@ def _unflatten(flat):
 
 
 def _kernel(in_ref, out_ref, st, *, S, n_packets, init_consts):
+    """Grid step: _PC packets x (S, 128) shards, byte-plane input.
+
+    in_ref: (_PC*32, S, 128) uint8 — TRANSPOSED shard bytes: row r is
+    byte r of every shard in the tile.  The u32 limb assembly happens
+    here in VMEM: a u8->u32 bitcast+transpose at the XLA level measured
+    31 GiB/s (catastrophic fused gather) while the plain u8 transpose
+    runs at ~306 GiB/s, so the kernel takes bytes and builds words with
+    shifts (3 ops per word) on full (S, 128) tiles.
+    """
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -118,15 +126,24 @@ def _kernel(in_ref, out_ref, st, *, S, n_packets, init_consts):
     carry0 = tuple(st[idx] for idx in range(32))
 
     def body(p, carry):
-        gp = j * _PC + p
-        lanes = [(in_ref[p, 2 * lane + 1], in_ref[p, 2 * lane])
-                 for lane in range(4)]
-        new = _flatten(_update_lanes(_unflatten(list(carry)), lanes))
-        keep = gp < n_packets
-        return tuple(jnp.where(keep, nw, old)
-                     for nw, old in zip(new, carry))
+        x = in_ref[pl.ds(p * 32, 32)].astype(_U32)   # (32, S, 128)
+        lanes = []
+        for lane in range(4):
+            b = 8 * lane
+            lo = (x[b] | (x[b + 1] << 8) | (x[b + 2] << 16)
+                  | (x[b + 3] << 24))
+            hi = (x[b + 4] | (x[b + 5] << 8) | (x[b + 6] << 16)
+                  | (x[b + 7] << 24))
+            lanes.append((hi, lo))
+        return tuple(_flatten(_update_lanes(_unflatten(list(carry)),
+                                            lanes)))
 
-    final = jax.lax.fori_loop(0, _PC, body, carry0)
+    # tail handling via the loop BOUND, not per-packet selects: masking
+    # each of the 32 carried limb planes with jnp.where cost 8.5x the
+    # whole update (measured 16 -> 136 GiB/s when removed).  Packets
+    # past n_packets in the final chunk are simply never executed.
+    valid = jnp.minimum(_PC, n_packets - j * _PC)
+    final = jax.lax.fori_loop(0, valid, body, carry0)
     for idx in range(32):
         st[idx] = final[idx]
 
@@ -136,22 +153,51 @@ def _kernel(in_ref, out_ref, st, *, S, n_packets, init_consts):
             out_ref[0, idx] = st[idx]
 
 
+_TT = 2048       # byte columns per transpose grid step (VMEM-bounded)
+
+
+def _tkern(in_ref, out_ref, *, S):
+    x = in_ref[:]                              # (S*128, _TT) u8
+    out_ref[:] = jnp.swapaxes(x, 0, 1).reshape(_TT, S, 128)
+
+
+def _transpose(blocks, S, interpret):
+    """(B, n) u8 -> (n, B//128, 128) byte planes, as a pallas kernel.
+
+    This MUST be a kernel, not an XLA transpose: any XLA-op-produced
+    3-D u8 operand reaches a pallas call through a layout-conversion
+    copy that measures ~45 GB/s on v5e (the custom call constrains
+    operand layouts; XLA's preferred layout for the transpose output
+    differs).  Kernel-to-kernel handoff keeps the canonical layout end
+    to end: the in-VMEM swapaxes sustains ~157 GiB/s and the downstream
+    hash kernel then runs at its full ~140 GiB/s instead of 34.
+    """
+    B, n = blocks.shape
+    return pl.pallas_call(
+        functools.partial(_tkern, S=S),
+        grid=(B // (S * 128), n // _TT),
+        in_specs=[pl.BlockSpec((S * 128, _TT), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((_TT, S, 128), lambda i, j: (j, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, B // 128, 128), jnp.uint8),
+        interpret=interpret,
+    )(blocks)
+
+
 @functools.partial(jax.jit, static_argnames=("n_packets", "S"))
-def _run(limbs, n_packets, S):
-    """limbs: (P_pad, 8, NB*S, 128) u32 — packet-major so the host prep
-    is ONE 2-D transpose (the (8, P, B) limb-major layout cost a second
-    relayout that doubled prep time).  Returns (NB, 32, S, 128)."""
-    p_pad, _, rows, _ = limbs.shape
-    nb = rows // S
-    npc = p_pad // _PC
+def _run(t8, n_packets, S):
+    """t8: (P_pad*32, NB*S, 128) uint8 transposed shard bytes (row-major
+    byte planes).  Returns (NB, 32, S, 128) u32 state planes."""
+    rows, tiles, _ = t8.shape
+    nb = tiles // S
+    npc = rows // (32 * _PC)
     init = _init_consts()
     kernel = functools.partial(_kernel, S=S, n_packets=n_packets,
                                init_consts=init)
     return pl.pallas_call(
         kernel,
         grid=(nb, npc),
-        in_specs=[pl.BlockSpec((_PC, 8, S, 128),
-                               lambda i, j: (j, 0, i, 0))],
+        in_specs=[pl.BlockSpec((_PC * 32, S, 128),
+                               lambda i, j: (j, i, 0))],
         out_specs=pl.BlockSpec((1, 32, S, 128),
                                lambda i, j: (i, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((nb, 32, S, 128), _U32),
@@ -159,7 +205,7 @@ def _run(limbs, n_packets, S):
         # CPU (tests / virtual meshes): run the kernel in the pallas
         # interpreter — same program, no Mosaic
         interpret=jax.default_backend() != "tpu",
-    )(limbs)
+    )(t8)
 
 
 @functools.lru_cache(maxsize=1)
@@ -187,27 +233,30 @@ def hh256_batch(blocks, key: bytes = MAGIC_KEY):
     blocks = jnp.asarray(blocks, jnp.uint8)
     B, n = blocks.shape
     P, rem = n // 32, n % 32
-    if P == 0:
+    if P == 0 or B == 0:
         return hk.hh256_batch(blocks, key)
 
     # adapt the shard tile to the batch: a 16-shard tail call must not
-    # pad (and hash) 1008 garbage rows — shrink S to cover B instead
-    tb = min(_TB, -(-B // 128) * 128)
-    S = tb // 128
+    # pad (and hash) 1008 garbage rows.  Mosaic requires the 2nd-minor
+    # block dim to be 8-divisible or equal to the whole array dim, so:
+    # small batches use S=G (one tile block), larger ones S=8 + padding
+    G = -(-B // 128)
+    S = G if G < 8 else 8
+    tb = S * 128
     b_pad = -B % tb
     p_pad = -P % _PC
-    # (B, P*8) u32 words -> ONE 2-D transpose -> (P, 8, B) packet-major
-    # limb planes (XLA runs the plain 2-D transpose at ~2x the speed of
-    # the (B,P,8)->(8,P,B) axis permutation)
-    words = jax.lax.bitcast_convert_type(
-        blocks[:, :P * 32].reshape(B, P, 8, 4), _U32).reshape(B, P * 8)
-    limbs = words.T.reshape(P, 8, B)
+    # pad in 2-D BYTE layout (safe: 2-D u8 operands reach pallas in
+    # canonical layout), then kernel-to-kernel: pallas transpose ->
+    # pallas hash.  See _transpose for why no XLA op may produce the
+    # 3-D byte planes.
+    x = blocks[:, :P * 32]
     if b_pad or p_pad:
-        limbs = jnp.pad(limbs, ((0, p_pad), (0, 0), (0, b_pad)))
+        x = jnp.pad(x, ((0, b_pad), (0, p_pad * 32)))
     bt = B + b_pad
-    limbs = limbs.reshape(P + p_pad, 8, bt // 128, 128)
+    interp = jax.default_backend() != "tpu"
+    t8 = _transpose(x, S, interp)                # ((P+pad)*32, bt//128, 128)
 
-    planes = _run(limbs, P, S)                   # (NB, 32, S, 128)
+    planes = _run(t8, P, S)                      # (NB, 32, S, 128)
     flat = [planes[:, idx].reshape(bt)[:B] for idx in range(32)]
     state = _unflatten(flat)
     # reassemble (B, 4) limb arrays for the existing finalize path
