@@ -249,7 +249,7 @@ def main() -> None:
             f"fused: {fused_tops:.1f} TOPS exceeds {peak} peak — "
             "harness artifact")
 
-    e2e_gibps = _bench_end_to_end_put()
+    e2e = _bench_end_to_end_put()
 
     value = round(min(encode_gibps, decode_gibps), 2)
     result = {
@@ -268,8 +268,8 @@ def main() -> None:
             # update itself sustains ~140 GiB/s once the per-packet
             # tail masks were replaced by a dynamic loop bound)
             "fused_encode_hh256_GiBps": round(fused_gibps, 2),
-            ("e2e_put_256x4MiB_fsync_GiBps" if _FSYNC_ON
-             else "e2e_put_256x4MiB_nofsync_GiBps"): e2e_gibps,
+            ("e2e_put_256x4MiB_fsync" if _FSYNC_ON
+             else "e2e_put_256x4MiB_nofsync"): e2e,
             "achieved_int8_TOPS": round(enc_tops, 1),
             "decode_int8_TOPS": round(dec_tops, 1),
             "roofline_pct_of_peak": roofline_pct,
@@ -294,12 +294,16 @@ def main() -> None:
     print(json.dumps(result))
 
 
-def _bench_end_to_end_put() -> float | None:
+def _bench_end_to_end_put() -> dict | None:
     """BASELINE config 5 end to end: 256 x 4 MiB PUTs through the REAL
-    put_object pipeline (md5 + erasure encode + bitrot framing + staged
+    put_object pipeline (erasure encode + bitrot framing + staged
     writes + quorum commit; fsync per MT_FSYNC, default off to match
-    go test -bench semantics), 8 concurrent clients, host codec
-    (see module docstring for why the device codec is excluded here)."""
+    go test -bench semantics), host codec (see module docstring for why
+    the device codec is excluded here).  Two legs matching the
+    reference's two modes: strict compat (md5 ETag, the default) and
+    --no-compat (md5 skipped, random ETag — the reference's own
+    perf-testing mode, cmd/common-main.go:208).  Plus a per-stage
+    breakdown so the remaining cost is attributable."""
     import os
     import shutil
     import sys
@@ -308,33 +312,170 @@ def _bench_end_to_end_put() -> float | None:
 
     tmp = None
     try:
+        import hashlib
+
+        from minio_tpu.hashing import bitrot as hbitrot
         from minio_tpu.objectlayer.erasure_object import ErasureObjects
         from minio_tpu.storage.xl_storage import XLStorage
 
-        tmp = tempfile.mkdtemp(prefix="bench-e2e-")
-        disks = []
-        for i in range(16):
-            d = os.path.join(tmp, f"d{i}")
-            os.makedirs(d)
-            disks.append(XLStorage(d))
-        layer = ErasureObjects(disks, parity=4, block_size=1 << 20,
-                               backend="numpy")
-        layer.make_bucket("benchbkt")
+        def mk_layer(base_dir=None):
+            root = tempfile.mkdtemp(prefix="bench-e2e-", dir=base_dir)
+            ds = []
+            for i in range(16):
+                d = os.path.join(root, f"d{i}")
+                os.makedirs(d)
+                ds.append(XLStorage(d))
+            lay = ErasureObjects(ds, parity=4, block_size=1 << 20,
+                                 backend="numpy")
+            lay.make_bucket("benchbkt")
+            return root, lay
+
+        tmp, layer = mk_layer()
         n_obj, obj_size = 256, 4 * (1 << 20)
         body = os.urandom(obj_size)
+        gib = n_obj * obj_size / 2**30
 
-        def put(i):
-            layer.put_object("benchbkt", f"obj-{i:04d}", body)
+        def drain():
+            # writeback of a previous leg's ~1.4 GiB steals the one
+            # vCPU mid-run (run-to-run swings of 2-4x measured) — flush
+            # and WAIT until dirty pages are actually gone before timing
+            import re
+            os.sync()
+            for _ in range(90):
+                try:
+                    with open("/proc/meminfo") as f:
+                        mi = f.read()
+                    dirty = int(re.search(r"Dirty:\s+(\d+)",
+                                          mi).group(1))
+                    wb = int(re.search(r"Writeback:\s+(\d+)",
+                                       mi).group(1))
+                except (OSError, AttributeError):  # non-Linux host
+                    return
+                if dirty + wb < 200 * 1024:        # kB
+                    break
+                time.sleep(1)
 
-        # concurrency matched to the host: oversubscribing a 1-vCPU VM
-        # with 8 clients measures GIL thrash, not the pipeline
-        workers = max(2, min(8, os.cpu_count() or 8))
-        with ThreadPoolExecutor(max_workers=workers) as pool:
-            list(pool.map(put, range(4)))          # warm path
+        # ---- stage table (single-thread, per-stage, same code paths the
+        # put pipeline calls) -------------------------------------------
+        codec = layer._codec_for(4)
+        reps = 12
+
+        def stage(fn):
+            fn()                                   # warm
             t0 = time.perf_counter()
-            list(pool.map(put, range(n_obj)))
-            dt = time.perf_counter() - t0
-        return round(n_obj * obj_size / dt / 2**30, 3)
+            for _ in range(reps):
+                fn()
+            return (time.perf_counter() - t0) / reps * 1000  # ms/obj
+
+        ss = codec.shard_size()
+        t_md5 = stage(lambda: hashlib.md5(body))
+        framed2d = codec.encode_object_framed(body)
+        t_encode = stage(lambda: codec.encode_object_framed(body))
+        t_hash = stage(lambda: hbitrot.fill_framed(framed2d, ss))
+        kept = [0]
+
+        def commit_only():
+            layer._commit_put(
+                "benchbkt", f"stage-{kept[0]}", _stage_fi(layer, body),
+                list(framed2d), False,
+                layer.disks)
+            kept[0] += 1
+
+        def _stage_fi(lay, data):
+            from minio_tpu.objectlayer import metadata as meta
+            from minio_tpu.storage.datatypes import (
+                ChecksumInfo, ErasureInfo, FileInfo, ObjectPartInfo)
+            import uuid as _uuid
+            dist = meta.hash_order("benchbkt/stage", len(lay.disks))
+            return FileInfo(
+                volume="benchbkt", name=f"stage-{kept[0]}",
+                version_id="", data_dir=str(_uuid.uuid4()),
+                mod_time=1, size=len(data),
+                metadata={"etag": "0" * 32},
+                parts=[ObjectPartInfo(1, len(data), len(data),
+                                      "0" * 32, 1)],
+                erasure=ErasureInfo(
+                    data_blocks=12, parity_blocks=4,
+                    block_size=1 << 20, distribution=dist,
+                    checksums=[ChecksumInfo(1, lay.bitrot_algo)]),
+                fresh=True)
+
+        t_commit = stage(commit_only)
+
+        # ---- throughput legs -------------------------------------------
+        def run_leg(lay=None):
+            lay = lay or layer
+
+            def put(i):
+                lay.put_object("benchbkt", f"obj-{i:04d}", body)
+            # one client per core: oversubscribing a 1-vCPU VM measures
+            # GIL thrash, not the pipeline (2 workers tested 0.22 vs
+            # 0.43 GiB/s serial)
+            workers = min(8, os.cpu_count() or 8)
+            if workers <= 1:
+                put(0)                             # warm path
+                t0 = time.perf_counter()
+                for i in range(n_obj):
+                    put(i)
+                return gib / (time.perf_counter() - t0)
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                list(pool.map(put, range(4)))      # warm path
+                t0 = time.perf_counter()
+                list(pool.map(put, range(n_obj)))
+                return gib / (time.perf_counter() - t0)
+
+        def best_leg(lay=None):
+            best = 0.0
+            for _ in range(2):
+                drain()
+                best = max(best, run_leg(lay))
+            return best
+
+        prev = os.environ.get("MT_NO_COMPAT")
+        shm_gibps, shm_strict = None, None
+        try:
+            os.environ["MT_NO_COMPAT"] = "0"
+            strict_gibps = best_leg()
+            os.environ["MT_NO_COMPAT"] = "1"
+            nocompat_gibps = best_leg()
+
+            # tmpfs drives: the full real code path with the shared
+            # virtio disk taken out of the picture (its latency swings
+            # 3x with host weather) — the pipeline's own sustained rate.
+            # Optional: a failure here (tiny /dev/shm) must not discard
+            # the disk legs already measured.
+            try:
+                if os.path.isdir("/dev/shm") and \
+                        os.access("/dev/shm", os.W_OK):
+                    shm_root, shm_layer = mk_layer("/dev/shm")
+                    try:
+                        shm_gibps = best_leg(shm_layer)
+                        os.environ["MT_NO_COMPAT"] = "0"
+                        shm_strict = best_leg(shm_layer)
+                    finally:
+                        shutil.rmtree(shm_root, ignore_errors=True)
+            except Exception as e:  # noqa: BLE001 — optional leg
+                print(f"tmpfs leg failed: {e!r}", file=sys.stderr)
+        finally:
+            if prev is None:
+                os.environ.pop("MT_NO_COMPAT", None)
+            else:
+                os.environ["MT_NO_COMPAT"] = prev
+
+        return {
+            "disk_strict_GiBps": round(strict_gibps, 3),
+            "disk_nocompat_GiBps": round(nocompat_gibps, 3),
+            "tmpfs_nocompat_GiBps": (round(shm_gibps, 3)
+                                     if shm_gibps else None),
+            "tmpfs_strict_GiBps": (round(shm_strict, 3)
+                                   if shm_strict else None),
+            "stages_ms_per_4MiB": {
+                "md5_etag(strict only)": round(t_md5, 2),
+                "erasure_encode_into_frames": round(t_encode, 2),
+                "bitrot_hh256_fill": round(t_hash, 2),
+                "drive_fanout_commit": round(t_commit, 2),
+            },
+        }
     except Exception as e:  # noqa: BLE001 — e2e leg must not sink the bench
         print(f"e2e leg failed: {e!r}", file=sys.stderr)
         return None

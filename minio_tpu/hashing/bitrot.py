@@ -127,6 +127,23 @@ def streaming_encode_batch(shards, shard_size: int,
     return [streaming_encode(s, shard_size, algo) for s in shards]
 
 
+def fill_framed(framed2d, shard_size: int,
+                algo: str = DEFAULT_BITROT_ALGORITHM) -> bool:
+    """Fill digest slots of pre-framed shard rows IN PLACE.
+
+    framed2d: (n_shards, framed_len) uint8 laid out by
+    Erasure.encode_object_framed ([32B zeroed digest][block] frames).
+    Returns False when the native hash library is unavailable — the
+    caller then uses the copying streaming_encode_batch path instead."""
+    if algo != HIGHWAYHASH256S:
+        return False
+    from .highwayhash import hh256_fill
+    for row in framed2d:
+        if not hh256_fill(row, shard_size):
+            return False
+    return True
+
+
 def _device_hh256_batch(blocks):
     """Best device formulation: single fused pallas kernel on TPU,
     lax.scan packet loop elsewhere (both bit-identical)."""

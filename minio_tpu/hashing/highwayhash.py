@@ -49,6 +49,9 @@ def _get_lib():
             lib.mt_hh256_frame.argtypes = [
                 ctypes.c_char_p, ctypes.c_void_p, ctypes.c_size_t,
                 ctypes.c_size_t, ctypes.c_char_p]
+            lib.mt_hh256_fill.argtypes = [
+                ctypes.c_char_p, ctypes.c_void_p, ctypes.c_size_t,
+                ctypes.c_size_t]
             lib.mt_hh_stream_size.restype = ctypes.c_size_t
             lib.mt_hh_stream_init.argtypes = [ctypes.c_char_p,
                                               ctypes.c_char_p]
@@ -231,6 +234,27 @@ def hh256_blocks(data, block_size: int, key: bytes = MAGIC_KEY) -> list[bytes]:
         return [out.raw[i * 32:(i + 1) * 32] for i in range(count)]
     return [hh256(data[i * block_size:(i + 1) * block_size], key)
             for i in range(count)]
+
+
+def hh256_fill(framed, block_size: int, key: bytes = MAGIC_KEY) -> bool:
+    """Fill digest slots of an already-framed [32B hash][block] buffer
+    IN PLACE (one GIL-free native pass over a writable numpy row /
+    memoryview).  The zero-copy PUT pipeline lays shard bytes straight
+    into frame payloads and then calls this.  Returns False when the
+    native library is unavailable (caller falls back to hh256_frame)."""
+    if block_size <= 0:
+        raise ValueError("block_size must be positive")
+    lib = _get_lib()
+    if lib is None:
+        return False
+    import numpy as np
+    arr = np.frombuffer(framed, dtype=np.uint8) \
+        if not isinstance(framed, np.ndarray) else framed
+    if not (arr.flags["C_CONTIGUOUS"] and arr.flags["WRITEABLE"]):
+        raise ValueError("hh256_fill needs a writable contiguous buffer")
+    lib.mt_hh256_fill(key, arr.ctypes.data_as(ctypes.c_void_p),
+                      arr.size, block_size)
+    return True
 
 
 def hh256_frame(data, block_size: int, key: bytes = MAGIC_KEY) -> bytes:

@@ -44,6 +44,22 @@ from .interface import (BucketExists, BucketInfo, BucketNotEmpty,
                         VersionNotFound, WriteQuorumError)
 from .multipart import MultipartOps
 
+# local drive fan-out runs serially on single-core hosts (the pool only
+# adds queue/lock churn there); MT_FORCE_POOL=1 restores the pool.
+# Remote drives always keep the pool: their RPCs overlap network waits
+# regardless of core count (see _serial_fanout in __init__).
+_SINGLE_CORE = (os.cpu_count() or 2) <= 1 and \
+    os.environ.get("MT_FORCE_POOL", "0") == "0"
+
+
+def _strict_compat() -> bool:
+    """True unless the reference's hidden --no-compat perf mode is on
+    (cmd/common-main.go:208-210).  Empty/whitespace/cased values of
+    MT_NO_COMPAT mean OFF — only an explicit truthy value disables
+    strict S3 compatibility."""
+    return os.environ.get("MT_NO_COMPAT", "0").strip().lower() in (
+        "", "0", "off", "false", "no")
+
 DEFAULT_BLOCK_SIZE = 10 * 1024 * 1024   # blockSizeV1 (cmd/object-api-common.go:32)
 INLINE_THRESHOLD = 128 * 1024           # small-object inline into xl.meta
 ETAG_KEY = "etag"
@@ -163,6 +179,11 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         # MRF hook (cmd/erasure-object.go:1141 addPartial): a background
         # MRFQueue attaches here; post-quorum partial writes are enqueued
         self.mrf = None
+        # serial fan-out only when single-core AND all drives are local:
+        # remote RPCs overlap network waits in threads on any core count
+        self._serial_fanout = _SINGLE_CORE and all(
+            d is None or getattr(d, "is_local", lambda: True)()
+            for d in self.disks)
         # listing cache (cmd/metacache-manager.go): snapshots persist
         # through the drives' system volume; local writes invalidate
         from .metacache import MetacacheManager
@@ -175,7 +196,9 @@ class ErasureObjects(MultipartOps, ObjectLayer):
     def _fanout_items(self, fn, items):
         """Run fn(item) concurrently over arbitrary items; returns
         (results, errs) aligned with items (parallelWriter/Reader
-        analog, cmd/erasure-encode.go:36)."""
+        analog, cmd/erasure-encode.go:36).  On a single-core host the
+        thread pool buys nothing (local drive ops barely release the
+        GIL) and costs queue/lock churn per item — run serially there."""
 
         def run(x):
             try:
@@ -183,7 +206,10 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             except Exception as e:  # noqa: BLE001 — per-item isolation
                 return None, e
 
-        out = list(self._pool.map(run, items))
+        if self._serial_fanout:
+            out = [run(x) for x in items]
+        else:
+            out = list(self._pool.map(run, items))
         return [r for r, _ in out], [e for _, e in out]
 
     def _fanout(self, fn, disks=None):
@@ -210,7 +236,10 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             except Exception as e:  # noqa: BLE001
                 return None, e
 
-        out = list(self._pool.map(run, enumerate(shuffled_disks)))
+        if self._serial_fanout:
+            out = [run(p) for p in enumerate(shuffled_disks)]
+        else:
+            out = list(self._pool.map(run, enumerate(shuffled_disks)))
         return [r for r, _ in out], [e for _, e in out]
 
     def _geometry(self, parity_override: int | None) -> tuple[int, int]:
@@ -323,7 +352,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         self._check_bucket(bucket)
         n = len(self.disks)
         k, m = self._geometry(opts.parity)
-        etag = hashlib.md5(data).hexdigest()
+        etag = self._etag_for(data, opts)
         mod_time = opts.mod_time or now_ns()
         version_id = opts.version_id or (
             str(uuid.uuid4()) if opts.versioned else "")
@@ -341,16 +370,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 checksums=[ChecksumInfo(1, self.bitrot_algo)]),
             fresh=True)
 
-        if m > 0:
-            codec = self._codec_for(m)
-            shards = codec.encode_object(data)      # ONE device dispatch
-        else:
-            shards = [np.frombuffer(data, dtype=np.uint8)]
-        # bitrot digests fuse onto the device when the codec runs there:
-        # parity + per-block HighwayHash from one pipeline (ops/hh_kernels)
-        framed = bitrot.streaming_encode_batch(
-            shards, fi.erasure.shard_size(), self.bitrot_algo,
-            use_device=(m > 0 and codec.backend == "tpu"))
+        framed = self._encode_and_frame(data, m, fi)
 
         inline = size <= self.inline_threshold
         shuffled = meta.shuffle_disks(self.disks, distribution)
@@ -362,6 +382,47 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         finally:
             lk.unlock()
 
+    def _etag_for(self, data: bytes, opts: PutObjectOptions) -> str:
+        """ETag per the reference's hash.Reader semantics: md5 when the
+        client sent Content-MD5 (verified) or in strict-compat mode
+        (the default, cmd/common-main.go:208); random-with-hyphen under
+        --no-compat (MT_NO_COMPAT=1), skipping the md5 pass entirely
+        (pkg/hash/reader.go:186, cmd/object-api-utils.go:843-855)."""
+        if opts.content_md5 or _strict_compat():
+            etag = hashlib.md5(data).hexdigest()
+            if opts.content_md5 and etag != opts.content_md5.lower():
+                raise serrors.StorageError(
+                    "Content-MD5 mismatch (BadDigest)")
+            return etag
+        return uuid.uuid4().hex[:32] + "-1"
+
+    def _encode_and_frame(self, data: bytes, m: int, fi: FileInfo):
+        """Erasure-encode + bitrot-frame one batch of blocks.
+
+        Fast host path: parity and shard bytes land DIRECTLY in the
+        framed on-disk layout (one copy total), digests filled in place
+        by a GIL-free native pass.  Device codecs keep the fused
+        TPU encode+hash pipeline; other fallbacks take the copying
+        encode_object + streaming_encode_batch route."""
+        ss = fi.erasure.shard_size()
+        if m > 0:
+            codec = self._codec_for(m)
+            if (codec.backend != "tpu"
+                    and self.bitrot_algo == bitrot.HIGHWAYHASH256S):
+                from ..ops import gf8_native
+                if gf8_native.available():
+                    framed2d = codec.encode_object_framed(data)
+                    if bitrot.fill_framed(framed2d, ss, self.bitrot_algo):
+                        return list(framed2d)
+            shards = codec.encode_object(data)      # ONE device dispatch
+        else:
+            shards = [np.frombuffer(data, dtype=np.uint8)]
+        # bitrot digests fuse onto the device when the codec runs there:
+        # parity + per-block HighwayHash from one pipeline (ops/hh_kernels)
+        return bitrot.streaming_encode_batch(
+            shards, ss, self.bitrot_algo,
+            use_device=(m > 0 and codec.backend == "tpu"))
+
     def _commit_put(self, bucket, object_name, fi, framed, inline,
                     shuffled) -> ObjectInfo:
 
@@ -371,16 +432,16 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             dfi.erasure = ErasureInfo(**{**fi.erasure.__dict__})
             dfi.erasure.index = idx + 1
             if inline:
-                dfi.inline_data = framed[idx]
+                blob = framed[idx]
+                dfi.inline_data = blob if isinstance(blob, bytes) \
+                    else bytes(memoryview(blob).cast("B"))
                 dfi.data_dir = ""
                 disk.write_metadata(bucket, object_name, dfi)
             else:
-                tmp = disk.tmp_dir()
-                try:
-                    disk.create_file(SYS_DIR, f"{tmp}/part.1", framed[idx])
-                    disk.rename_data(SYS_DIR, tmp, dfi, bucket, object_name)
-                finally:
-                    disk.clean_tmp(tmp)
+                # composite commit: one storage call (one RPC on remote
+                # drives), direct final-location write on local ones
+                disk.write_data_commit(bucket, object_name, dfi,
+                                       framed[idx])
             return idx
 
         _, errs = self._fanout_indexed(write_one, shuffled)
@@ -424,7 +485,10 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         wq = self._write_quorum(fi)
         tmps: list[str | None] = [None] * n
         errs: list[Exception | None] = [None] * n
-        md5 = hashlib.md5()
+        # md5 only when the client sent Content-MD5 or in strict-compat
+        # mode — same policy as _etag_for (pkg/hash/reader.go:186)
+        md5 = hashlib.md5() if (opts.content_md5 or _strict_compat()) \
+            else None
         total = 0
 
         # readahead on the body: the network read of batch N+1 overlaps
@@ -449,15 +513,10 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             # socket with no close()
             chunks = readahead(_chunks(), depth=1)
             for chunk in chunks:
-                md5.update(chunk)
+                if md5 is not None:
+                    md5.update(chunk)
                 total += len(chunk)
-                if m > 0:
-                    shards = codec.encode_object(chunk)
-                else:
-                    shards = [np.frombuffer(chunk, dtype=np.uint8)]
-                framed = bitrot.streaming_encode_batch(
-                    shards, ssize, self.bitrot_algo,
-                    use_device=(m > 0 and codec.backend == "tpu"))
+                framed = self._encode_and_frame(chunk, m, fi)
 
                 def write_batch(idx_disk):
                     idx, disk = idx_disk
@@ -480,7 +539,13 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 if alive < wq:
                     raise WriteQuorumError(
                         f"{alive} of {n} drives writable, need {wq}")
-            etag = md5.hexdigest()
+            if md5 is not None:
+                etag = md5.hexdigest()
+                if opts.content_md5 and etag != opts.content_md5.lower():
+                    raise serrors.StorageError(
+                        "Content-MD5 mismatch (BadDigest)")
+            else:
+                etag = uuid.uuid4().hex[:32] + "-1"
             fi.size = total
             fi.metadata = {ETAG_KEY: etag, **opts.user_defined}
             fi.parts = [ObjectPartInfo(1, total, total, etag, mod_time)]

@@ -132,6 +132,12 @@ class PutObjectOptions:
     # per-request parity from x-amz-storage-class (cmd/erasure-object.go:631
     # applying cmd/config/storageclass); None = the layer's default
     parity: Optional[int] = None
+    # client-sent Content-MD5 as hex; when set the body MUST hash to it
+    # and the ETag is that md5 (pkg/hash/reader.go:186).  When unset and
+    # the server runs in no-compat mode (the reference's hidden
+    # --no-compat perf flag, cmd/common-main.go:208-210), md5 is skipped
+    # and the ETag is random-with-hyphen (cmd/object-api-utils.go:843)
+    content_md5: Optional[str] = None
 
 
 @dataclass

@@ -172,3 +172,58 @@ class Erasure:
                 outs[i].append(s)
         return [np.concatenate(chunks) if len(chunks) != 1 else chunks[0]
                 for chunks in outs]
+
+    def encode_object_framed(self, data, digest: int = 32) -> np.ndarray:
+        """Encode a whole object straight into bitrot-framed shard files.
+
+        Returns (k+m, framed_len) uint8 where each row is the final
+        on-disk layout [digest-slot][block] per erasure block
+        (cmd/bitrot-streaming.go framing around cmd/erasure-encode.go
+        blocks).  Digest slots are left ZEROED for the caller to fill
+        in place (hashing.highwayhash.hh256_fill).  One copy total:
+        data bytes land once in their final frame position; parity is
+        computed by the native kernel directly into its frame payloads.
+        Requires the native GF8 library (callers fall back to
+        encode_object + streaming framing)."""
+        from . import gf8_native
+        assert gf8_native.available()
+        buf = np.frombuffer(bytes(data), dtype=np.uint8) \
+            if not isinstance(data, np.ndarray) \
+            else np.asarray(data, np.uint8).ravel()
+        total = buf.size
+        k, m = self.data_blocks, self.parity_blocks
+        bs = self.block_size
+        ssize = self.shard_size()
+        nfull, tail_len = divmod(total, bs)
+        tail_ss = gf8.ceil_frac(tail_len, k)
+        F = digest + ssize
+        flen = nfull * F + ((digest + tail_ss) if tail_len else 0)
+        # calloc-backed: digest slots and short-row padding start zero
+        out = np.zeros((k + m, flen), dtype=np.uint8)
+        parity_rows = np.asarray(self.matrix)[k:]
+        if nfull:
+            src = buf[:nfull * bs].reshape(nfull, bs)
+            dview = out[:, :nfull * F].reshape(k + m, nfull, F)
+            for i in range(k):
+                lo = i * ssize
+                ln = min(ssize, bs - lo)
+                dview[i, :, digest:digest + ln] = src[:, lo:lo + ln]
+            if m:
+                for b in range(nfull):
+                    base = b * F + digest
+                    gf8_native.matmul_into(
+                        parity_rows, out[:k, base:base + ssize],
+                        out[k:, base:base + ssize])
+        if tail_len:
+            base = nfull * F + digest
+            tsrc = buf[nfull * bs:]
+            for i in range(k):
+                lo = i * tail_ss
+                ln = max(0, min(tail_ss, tail_len - lo))
+                if ln:
+                    out[i, base:base + ln] = tsrc[lo:lo + ln]
+            if m and tail_ss:
+                gf8_native.matmul_into(
+                    parity_rows, out[:k, base:base + tail_ss],
+                    out[k:, base:base + tail_ss])
+        return out

@@ -62,6 +62,28 @@ def available() -> bool:
     return _load() is not None
 
 
+def matmul_into(A: np.ndarray, B_view: np.ndarray,
+                out_view: np.ndarray) -> None:
+    """GF (r,k) x B_view (k,len) -> out_view (r,len), writing IN PLACE.
+
+    Rows of both views must be contiguous (stride 1 on the last axis)
+    but the row stride is arbitrary — the zero-copy PUT pipeline points
+    this straight at the payload slots of bitrot-framed shard buffers,
+    so parity lands in its final on-disk position with no intermediate
+    array.  GIL released for the duration (ctypes)."""
+    lib = _load()
+    assert lib is not None
+    r, k = A.shape
+    k2, n = B_view.shape
+    assert k == k2 and out_view.shape == (r, n)
+    assert B_view.strides[1] == 1 and out_view.strides[1] == 1
+    lib.mt_gf8_matmul(
+        np.ascontiguousarray(A, dtype=np.uint8).tobytes(), r, k,
+        B_view.ctypes.data_as(ctypes.c_void_p), B_view.strides[0],
+        out_view.ctypes.data_as(ctypes.c_void_p), out_view.strides[0],
+        n)
+
+
 def matmul(A: np.ndarray, B: np.ndarray) -> np.ndarray:
     """GF (r,k) x (k,len) -> (r,len); ctypes releases the GIL for the
     duration of the C call, so concurrent PUTs scale across threads."""

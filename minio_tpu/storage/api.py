@@ -125,6 +125,23 @@ class StorageAPI(abc.ABC):
         """Atomic commit: move tmp data dir + merge version into xl.meta
         (cmd/xl-storage.go:1965 RenameData)."""
 
+    def write_data_commit(self, volume: str, path: str, fi: FileInfo,
+                          data) -> None:
+        """One-shot single-part PUT commit: part bytes + version merge.
+
+        Default composition stages through tmp + rename_data (correct on
+        any backend); local drives override with a direct write into the
+        final data dir — safe because fi.data_dir is a fresh uuid and the
+        version only becomes visible when xl.meta is atomically replaced,
+        the same invariant rename_data relies on."""
+        from .xl_storage import SYS_DIR as sys_vol
+        tmp = self.tmp_dir()
+        try:
+            self.create_file(sys_vol, f"{tmp}/part.1", data)
+            self.rename_data(sys_vol, tmp, fi, volume, path)
+        finally:
+            self.clean_tmp(tmp)
+
     @abc.abstractmethod
     def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None: ...
 

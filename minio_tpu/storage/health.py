@@ -37,7 +37,8 @@ _GUARDED = {
     "make_vol", "list_vols", "stat_vol", "delete_vol", "list_dir",
     "read_all", "write_all", "create_file", "append_file",
     "read_file_stream", "rename_file", "delete", "stat_info_file",
-    "rename_data", "write_metadata", "update_metadata", "read_version",
+    "rename_data", "write_data_commit", "write_metadata",
+    "update_metadata", "read_version",
     "list_versions", "delete_version", "verify_file", "check_parts",
     "walk_dir", "walk_entries", "tmp_dir", "clean_tmp", "disk_info",
 }

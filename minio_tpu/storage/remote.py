@@ -115,6 +115,11 @@ def register_storage_service(rpc: RPCServer,
         d = drive(params["drive_id"])
         if params.get("op") == "append":
             d.append_file(params["volume"], params["path"], data)
+        elif params.get("op") == "commit":
+            # single-RPC PUT commit: part bytes + version merge in one
+            # round trip (vs tmp_dir + create_file + rename_data = 3)
+            d.write_data_commit(params["volume"], params["path"],
+                                FileInfo.from_dict(params["fi"]), data)
         else:
             d.create_file(params["volume"], params["path"], data,
                           params.get("file_size", -1))
@@ -241,6 +246,11 @@ class RemoteStorage(StorageAPI):
 
     def stat_info_file(self, volume, path):
         return self._call("stat_info_file", volume=volume, path=path)
+
+    def write_data_commit(self, volume, path, fi, data):
+        self._raw("storage-write",
+                  {"volume": volume, "path": path, "op": "commit",
+                   "fi": fi.to_dict()}, bytes(data))
 
     # metadata
     def rename_data(self, src_volume, src_path, fi, dst_volume, dst_path):

@@ -79,6 +79,11 @@ class XLStorage(StorageAPI):
         if not os.path.isdir(self.root):
             raise errors.DiskNotFound(self.root)
         os.makedirs(os.path.join(self.root, TMP_DIR), exist_ok=True)
+        # volumes seen to exist: spares one stat per storage op on the
+        # PUT hot path (invalidated on delete_vol; an externally wiped
+        # drive surfaces as FileNotFound from the op itself, and the
+        # DriveMonitor reformat path recreates volumes via make_vol)
+        self._vols_seen: set[str] = set()
 
     # -- identity / health -------------------------------------------------
 
@@ -124,8 +129,11 @@ class XLStorage(StorageAPI):
 
     def _check_vol(self, volume: str) -> str:
         p = self._vol_path(volume)
+        if volume in self._vols_seen:
+            return p
         if not os.path.isdir(p):
             raise errors.VolumeNotFound(volume)
+        self._vols_seen.add(volume)
         return p
 
     # -- volume ops --------------------------------------------------------
@@ -151,16 +159,26 @@ class XLStorage(StorageAPI):
 
     def stat_vol(self, volume: str) -> VolInfo:
         p = self._check_vol(volume)
-        st = os.stat(p)
+        try:
+            st = os.stat(p)
+        except FileNotFoundError:
+            self._vols_seen.discard(volume)   # wiped under the cache
+            raise errors.VolumeNotFound(volume) from None
         return VolInfo(volume, int(st.st_ctime * 1e9))
 
     def delete_vol(self, volume: str, force: bool = False) -> None:
         p = self._check_vol(volume)
+        self._vols_seen.discard(volume)
         if force:
-            shutil.rmtree(p)
+            try:
+                shutil.rmtree(p)
+            except FileNotFoundError:
+                raise errors.VolumeNotFound(volume) from None
             return
         try:
             os.rmdir(p)
+        except FileNotFoundError:      # wiped under the cache
+            raise errors.VolumeNotFound(volume) from None
         except OSError as e:
             raise errors.VolumeNotEmpty(volume) from e
 
@@ -195,12 +213,26 @@ class XLStorage(StorageAPI):
         except PermissionError as e:
             raise errors.FileAccessDenied(path) from e
 
+    def _open_create(self, volume: str, full: str):
+        """Open for write, creating parents on the rare miss — but a
+        missing VOLUME (wiped drive) must surface as VolumeNotFound,
+        never be silently recreated (drive-death detection relies on
+        writes failing, storage/health.py DriveMonitor)."""
+        try:
+            return open(full, "wb")
+        except FileNotFoundError:
+            if not os.path.isdir(self._vol_path(volume)):
+                self._vols_seen.discard(volume)
+                raise errors.VolumeNotFound(volume) from None
+            os.makedirs(os.path.dirname(full), exist_ok=True)
+            return open(full, "wb")
+
     def write_all(self, volume: str, path: str, data: bytes) -> None:
         full = self._file_path(volume, path)
         self._check_vol(volume)
-        os.makedirs(os.path.dirname(full), exist_ok=True)
         tmp = full + f".tmp.{uuid.uuid4().hex[:8]}"
-        with open(tmp, "wb") as f:
+        f = self._open_create(volume, tmp)
+        with f:
             f.write(data)
             _fsync_fileobj(f)
         os.replace(tmp, full)
@@ -210,11 +242,18 @@ class XLStorage(StorageAPI):
                     file_size: int = -1) -> None:
         """Whole shard-file write (batched pipeline hands us the complete
         framed file; the reference streams through O_DIRECT,
-        cmd/xl-storage.go:1568)."""
+        cmd/xl-storage.go:1568).  Writes DIRECTLY (no tmp+replace):
+        every caller targets a staging path that rename_data later
+        moves as a unit, so the inner rename would be a second level of
+        the same atomicity."""
         if file_size >= 0 and len(data) != file_size:
             raise errors.FileCorrupt(
                 f"size mismatch: {len(data)} != {file_size}")
-        self.write_all(volume, path, data)
+        full = self._file_path(volume, path)
+        self._check_vol(volume)
+        with self._open_create(volume, full) as f:
+            f.write(data)
+            _fsync_fileobj(f)
 
     def append_file(self, volume: str, path: str, data: bytes) -> None:
         full = self._file_path(volume, path)
@@ -341,6 +380,56 @@ class XLStorage(StorageAPI):
         if old_ddir and old_ddir != fi.data_dir \
                 and meta.shared_data_dir_count(fi.version_id, old_ddir) == 0:
             shutil.rmtree(os.path.join(dst_obj_dir, old_ddir),
+                          ignore_errors=True)
+
+    def write_data_commit(self, volume: str, path: str, fi: FileInfo,
+                          data) -> None:
+        """Direct single-part PUT commit (hot path): part file written
+        straight into its final data-dir location, version merged into
+        xl.meta last.  Crash mid-write leaves an orphan uuid data dir the
+        scanner purges as dangling — the object version is only visible
+        once the xl.meta replace lands (same contract as rename_data,
+        minus one tmp mkdir + rename round per drive)."""
+        self._check_vol(volume)
+        dst_obj = self._file_path(volume, path)
+        try:
+            os.mkdir(dst_obj)
+            fresh = True
+        except FileExistsError:
+            fresh = False
+        except FileNotFoundError:
+            # parent missing: wiped volume must NOT be resurrected
+            if not os.path.isdir(self._vol_path(volume)):
+                self._vols_seen.discard(volume)
+                raise errors.VolumeNotFound(volume) from None
+            os.makedirs(dst_obj, exist_ok=True)   # nested object name
+            fresh = True
+        meta = XLMeta()
+        old_ddir = ""
+        if not fresh:
+            try:
+                meta = self._read_meta(volume, path)
+                try:
+                    old_ddir = meta.find(fi.version_id).get("ddir", "")
+                except errors.FileVersionNotFound:
+                    pass
+            except (errors.FileNotFound, errors.FileCorrupt):
+                pass
+        meta.add_version(fi)
+        if fi.data_dir:
+            ddir = os.path.join(dst_obj, fi.data_dir)
+            os.mkdir(ddir)
+            with open(os.path.join(ddir, "part.1"), "wb") as f:
+                f.write(data)
+                _fsync_fileobj(f)
+            _fsync_dir(ddir)
+        self._write_meta(volume, path, meta)    # atomic tmp+replace
+        _fsync_dir(dst_obj)
+        if fresh:
+            _fsync_dir(os.path.dirname(dst_obj))
+        if old_ddir and old_ddir != fi.data_dir \
+                and meta.shared_data_dir_count(fi.version_id, old_ddir) == 0:
+            shutil.rmtree(os.path.join(dst_obj, old_ddir),
                           ignore_errors=True)
 
     def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
@@ -482,7 +571,12 @@ class XLStorage(StorageAPI):
         """New unique staging dir; returned path is relative to the SYS_DIR
         volume (use with volume=SYS_DIR in create_file/rename_data)."""
         d = os.path.join("tmp", uuid.uuid4().hex)
-        os.makedirs(os.path.join(self.root, SYS_DIR, d), exist_ok=True)
+        leaf = os.path.join(self.root, SYS_DIR, d)
+        try:                       # tmp root exists since __init__ —
+            os.mkdir(leaf)         # one syscall, not a makedirs walk
+        except FileNotFoundError:  # SYS_DIR gone = drive wiped under us;
+            # recreating it would mask drive death from the monitor
+            raise errors.DiskNotFound(self.root) from None
         return d
 
     def clean_tmp(self, rel_dir: str) -> None:
