@@ -75,9 +75,15 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
             srv.iam.set_user_status(q1["accessKey"], status == "enabled")
             return send_json({"status": "ok"}) or True
         if route == "set-user-policy" and h.command == "POST":
-            srv.iam.attach_policy(
-                q1["accessKey"],
-                [p for p in q1.get("policies", "").split(",") if p])
+            target = q1["accessKey"]
+            pols = [p for p in q1.get("policies", "").split(",") if p]
+            if "=" in target and getattr(srv, "ldap", None) is not None:
+                # an LDAP DN with LDAP configured: map policies for the
+                # LDAP sys type (cmd/admin-handlers-users.go routes DNs
+                # to the LDAP mappedPolicy store only under LDAP mode)
+                srv.iam.set_ldap_policy(target, pols)
+            else:
+                srv.iam.attach_policy(target, pols)
             return send_json({"status": "ok"}) or True
         if route == "add-service-account" and h.command == "POST":
             doc = json.loads(payload) if payload else {}

@@ -1,0 +1,397 @@
+"""LDAP identity — the reference's AD/LDAP IAM mode.
+
+Mirrors cmd/config/identity/ldap/ (config keys, lookup-bind flow) and
+the identity resolution the reference performs for
+AssumeRoleWithLDAPIdentity (cmd/sts-handlers.go:436): bind as the
+lookup user, search the user DN, verify the user's password with a
+second bind, then collect group DNs.
+
+The environment ships no LDAP library, so this module carries its own
+minimal LDAPv3 client: BER encoding for LDAPMessage / BindRequest /
+SearchRequest and decoding for the responses — the protocol subset
+every directory server (OpenLDAP, AD) answers.  The same codec drives
+the in-process stub directory server in tests/ldap_stub.py (this env
+has no egress; the OIDC subsystem is validated the same way).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from dataclasses import dataclass, field
+
+# ---------------------------------------------------------------------------
+# BER (subset: definite lengths, the types LDAPv3 messages use)
+# ---------------------------------------------------------------------------
+
+SEQUENCE = 0x30
+SET = 0x31
+INTEGER = 0x02
+OCTET_STRING = 0x04
+ENUMERATED = 0x0A
+BOOLEAN = 0x01
+
+APP_BIND_REQUEST = 0x60
+APP_BIND_RESPONSE = 0x61
+APP_UNBIND_REQUEST = 0x42
+APP_SEARCH_REQUEST = 0x63
+APP_SEARCH_ENTRY = 0x64
+APP_SEARCH_DONE = 0x65
+
+CTX_SIMPLE_AUTH = 0x80          # [0] primitive inside BindRequest
+FILTER_AND = 0xA0
+FILTER_OR = 0xA1
+FILTER_NOT = 0xA2
+FILTER_EQ = 0xA3
+FILTER_PRESENT = 0x87
+
+SCOPE_BASE = 0
+SCOPE_ONE = 1
+SCOPE_SUB = 2
+
+
+def ber_len(n: int) -> bytes:
+    if n < 0x80:
+        return bytes([n])
+    out = b""
+    while n:
+        out = bytes([n & 0xFF]) + out
+        n >>= 8
+    return bytes([0x80 | len(out)]) + out
+
+
+def ber(tag: int, content: bytes) -> bytes:
+    return bytes([tag]) + ber_len(len(content)) + content
+
+
+def ber_int(v: int, tag: int = INTEGER) -> bytes:
+    out = b""
+    if v == 0:
+        out = b"\x00"
+    while v:
+        out = bytes([v & 0xFF]) + out
+        v >>= 8
+    if out[0] & 0x80:               # keep it non-negative
+        out = b"\x00" + out
+    return ber(tag, out)
+
+
+def ber_str(s: str | bytes, tag: int = OCTET_STRING) -> bytes:
+    if isinstance(s, str):
+        s = s.encode()
+    return ber(tag, s)
+
+
+class BERReader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def eof(self) -> bool:
+        return self.pos >= len(self.buf)
+
+    def read_tlv(self) -> tuple[int, bytes]:
+        tag = self.buf[self.pos]
+        self.pos += 1
+        first = self.buf[self.pos]
+        self.pos += 1
+        if first < 0x80:
+            length = first
+        else:
+            nb = first & 0x7F
+            length = int.from_bytes(self.buf[self.pos:self.pos + nb],
+                                    "big")
+            self.pos += nb
+        val = self.buf[self.pos:self.pos + length]
+        if len(val) != length:
+            raise ValueError("truncated BER value")
+        self.pos += length
+        return tag, val
+
+
+def decode_int(content: bytes) -> int:
+    return int.from_bytes(content, "big")
+
+
+# ---------------------------------------------------------------------------
+# LDAP filter: parse "(uid=%s)" style strings into BER
+# ---------------------------------------------------------------------------
+
+def parse_filter(expr: str) -> bytes:
+    """RFC 4515 filter subset: equality, presence, and/or/not."""
+    expr = expr.strip()
+    out, rest = _parse_one(expr)
+    if rest.strip():
+        raise ValueError(f"trailing filter content: {rest!r}")
+    return out
+
+
+def _parse_one(expr: str) -> tuple[bytes, str]:
+    if not expr.startswith("("):
+        raise ValueError(f"filter must start with '(': {expr!r}")
+    inner = expr[1:]
+    if inner[0] in "&|!":
+        op = inner[0]
+        tag = {"&": FILTER_AND, "|": FILTER_OR, "!": FILTER_NOT}[op]
+        rest = inner[1:]
+        parts = []
+        while rest.startswith("("):
+            part, rest = _parse_one(rest)
+            parts.append(part)
+        if not rest.startswith(")"):
+            raise ValueError("unterminated composite filter")
+        return ber(tag, b"".join(parts)), rest[1:]
+    end = inner.index(")")
+    body, rest = inner[:end], inner[end + 1:]
+    attr, _, value = body.partition("=")
+    if not _:
+        raise ValueError(f"no '=' in filter component {body!r}")
+    if value == "*":
+        return ber_str(attr, FILTER_PRESENT), rest
+    return ber(FILTER_EQ, ber_str(attr) + ber_str(value)), rest
+
+
+# ---------------------------------------------------------------------------
+# LDAP client (simple bind + search, lookup-bind mode needs no more)
+# ---------------------------------------------------------------------------
+
+class LDAPError(Exception):
+    pass
+
+
+class LDAPClient:
+    """Minimal LDAPv3 client over TCP (no TLS — the stub/test directory
+    runs in-process; real deployments front LDAP with a tunnel).
+    """
+
+    def __init__(self, addr: str, timeout: float = 10.0):
+        host, _, port = addr.rpartition(":")
+        self._sock = socket.create_connection(
+            (host or "127.0.0.1", int(port or 389)), timeout=timeout)
+        self._msgid = 0
+        self._mu = threading.Lock()
+
+    def close(self) -> None:
+        try:
+            self._sock.sendall(ber(SEQUENCE, ber_int(self._msgid + 1)
+                                   + ber(APP_UNBIND_REQUEST, b"")))
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _send(self, op: bytes) -> int:
+        self._msgid += 1
+        self._sock.sendall(ber(SEQUENCE, ber_int(self._msgid) + op))
+        return self._msgid
+
+    def _recv_msg(self) -> tuple[int, int, bytes]:
+        head = self._recv_exact(2)
+        first = head[1]
+        if first < 0x80:
+            length = first
+            body = self._recv_exact(length)
+        else:
+            nb = first & 0x7F
+            lenb = self._recv_exact(nb)
+            length = int.from_bytes(lenb, "big")
+            body = self._recv_exact(length)
+        r = BERReader(body)
+        tag, mid = r.read_tlv()
+        opts, opv = r.read_tlv()
+        return decode_int(mid), opts, opv
+
+    def _recv_exact(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = self._sock.recv(n - len(out))
+            if not chunk:
+                raise LDAPError("connection closed by directory server")
+            out += chunk
+        return out
+
+    def simple_bind(self, dn: str, password: str) -> bool:
+        """True on success, False on invalidCredentials (code 49)."""
+        with self._mu:
+            op = ber(APP_BIND_REQUEST,
+                     ber_int(3) + ber_str(dn)
+                     + ber_str(password, CTX_SIMPLE_AUTH))
+            mid = self._send(op)
+            rmid, optag, opv = self._recv_msg()
+            if rmid != mid or optag != APP_BIND_RESPONSE:
+                raise LDAPError("unexpected bind response")
+            r = BERReader(opv)
+            _, code = r.read_tlv()
+            result = decode_int(code)
+            if result == 0:
+                return True
+            if result == 49:        # invalidCredentials
+                return False
+            raise LDAPError(f"bind failed: resultCode={result}")
+
+    def search(self, base_dn: str, filter_expr: str,
+               attributes: list[str] | None = None,
+               scope: int = SCOPE_SUB) -> list[tuple[str, dict]]:
+        """Returns [(dn, {attr: [values]})]."""
+        attrs = b"".join(ber_str(a) for a in (attributes or []))
+        with self._mu:
+            op = ber(APP_SEARCH_REQUEST,
+                     ber_str(base_dn)
+                     + ber_int(scope, ENUMERATED)
+                     + ber_int(0, ENUMERATED)      # derefAliases: never
+                     + ber_int(0) + ber_int(0)     # no size/time limit
+                     + ber(BOOLEAN, b"\x00")       # typesOnly: false
+                     + parse_filter(filter_expr)
+                     + ber(SEQUENCE, attrs))
+            mid = self._send(op)
+            out = []
+            while True:
+                rmid, optag, opv = self._recv_msg()
+                if rmid != mid:
+                    raise LDAPError("interleaved response")
+                if optag == APP_SEARCH_ENTRY:
+                    r = BERReader(opv)
+                    _, dn = r.read_tlv()
+                    _, attrseq = r.read_tlv()
+                    attrs_out: dict[str, list[str]] = {}
+                    ar = BERReader(attrseq)
+                    while not ar.eof():
+                        _, one = ar.read_tlv()
+                        er = BERReader(one)
+                        _, name = er.read_tlv()
+                        _, vals = er.read_tlv()
+                        vr = BERReader(vals)
+                        vlist = []
+                        while not vr.eof():
+                            _, v = vr.read_tlv()
+                            vlist.append(v.decode())
+                        attrs_out[name.decode()] = vlist
+                    out.append((dn.decode(), attrs_out))
+                elif optag == APP_SEARCH_DONE:
+                    r = BERReader(opv)
+                    _, code = r.read_tlv()
+                    if decode_int(code) not in (0, 32):  # 32: noSuchObject
+                        raise LDAPError(
+                            f"search failed: {decode_int(code)}")
+                    return out
+                else:
+                    raise LDAPError(f"unexpected op 0x{optag:x}")
+
+
+# ---------------------------------------------------------------------------
+# Config + identity resolution (lookup-bind mode)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class LDAPConfig:
+    """cmd/config/identity/ldap/config.go keys, 1:1."""
+    server_addr: str = ""
+    lookup_bind_dn: str = ""
+    lookup_bind_password: str = ""
+    user_dn_search_base_dn: str = ""
+    user_dn_search_filter: str = ""          # %s -> username
+    group_search_filter: str = ""            # %s -> username, %d -> DN
+    group_search_base_dn: str = ""
+    sts_expiry_s: int = 3600
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.server_addr)
+
+    @classmethod
+    def from_config(cls, cfg) -> "LDAPConfig":
+        """Read the identity_ldap config subsystem (utils/kvconfig)."""
+        def get(key, default=""):
+            return cfg.get("identity_ldap", key) or default
+        expiry = get("sts_expiry", "1h")
+        return cls(
+            server_addr=get("server_addr"),
+            lookup_bind_dn=get("lookup_bind_dn"),
+            lookup_bind_password=get("lookup_bind_password"),
+            user_dn_search_base_dn=get("user_dn_search_base_dn"),
+            user_dn_search_filter=get("user_dn_search_filter"),
+            group_search_filter=get("group_search_filter"),
+            group_search_base_dn=get("group_search_base_dn"),
+            sts_expiry_s=_parse_duration(expiry),
+        )
+
+
+def _parse_duration(s: str) -> int:
+    s = s.strip().lower()
+    mult = 1
+    for suffix, m in (("h", 3600), ("m", 60), ("s", 1)):
+        if s.endswith(suffix):
+            s, mult = s[:-1], m
+            break
+    try:
+        return int(float(s) * mult)
+    except ValueError:
+        return 3600
+
+
+@dataclass
+class LDAPIdentity:
+    """Bind-and-resolve against the configured directory
+    (cmd/config/identity/ldap/ldap.go Bind, lookup-bind mode)."""
+    config: LDAPConfig
+    _policy_note: str = field(default="", repr=False)
+
+    def bind(self, username: str, password: str) -> tuple[str, list[str]]:
+        """Verify the user's password; return (user_dn, group_dns).
+
+        Flow per the reference: (1) bind as the lookup user, (2) search
+        the user's DN with user_dn_search_filter, (3) verify the
+        password with a bind AS the user on a fresh connection, (4)
+        collect group DNs with group_search_filter.
+        """
+        cfg = self.config
+        if not cfg.enabled:
+            raise LDAPError("LDAP is not configured")
+        if not username or not password:
+            raise LDAPError("empty LDAP credentials")
+        lookup = LDAPClient(cfg.server_addr)
+        try:
+            if not lookup.simple_bind(cfg.lookup_bind_dn,
+                                      cfg.lookup_bind_password):
+                raise LDAPError("lookup bind rejected")
+            filt = cfg.user_dn_search_filter.replace(
+                "%s", _escape_filter(username))
+            entries = lookup.search(cfg.user_dn_search_base_dn, filt,
+                                    attributes=[])
+            if len(entries) != 1:
+                raise LDAPError(
+                    f"user search matched {len(entries)} entries")
+            user_dn = entries[0][0]
+            # verify password on a separate connection: a failed bind
+            # poisons the session
+            verify = LDAPClient(cfg.server_addr)
+            try:
+                if not verify.simple_bind(user_dn, password):
+                    raise LDAPError("invalid credentials")
+            finally:
+                verify.close()
+            groups: list[str] = []
+            if cfg.group_search_filter:
+                gfilt = cfg.group_search_filter \
+                    .replace("%d", _escape_filter(user_dn)) \
+                    .replace("%s", _escape_filter(username))
+                base = cfg.group_search_base_dn \
+                    or cfg.user_dn_search_base_dn
+                groups = [dn for dn, _ in lookup.search(base, gfilt,
+                                                        attributes=[])]
+            return user_dn, groups
+        finally:
+            lookup.close()
+
+
+def _escape_filter(s: str) -> str:
+    """RFC 4515 escaping for filter assertion values."""
+    out = []
+    for ch in s:
+        if ch in ("*", "(", ")", "\\", "\x00"):
+            out.append(f"\\{ord(ch):02x}")
+        else:
+            out.append(ch)
+    return "".join(out)

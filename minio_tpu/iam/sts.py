@@ -73,9 +73,12 @@ def verify_token(token: str, secret: str) -> dict:
 
 def mint(parent_access_key: str, root_secret: str,
          duration_s: int = DEFAULT_DURATION_S,
-         session_policy: str | None = None) -> TempCredentials:
+         session_policy: str | None = None,
+         extra_claims: dict | None = None) -> TempCredentials:
     """Create the credential triple (cmd/auth-handler.go GetNewCredentials
-    analog: access keys are 20 chars, secrets 40)."""
+    analog: access keys are 20 chars, secrets 40).  extra_claims lets
+    identity providers stamp their own token claims (e.g. ldapUser /
+    ldapUsername per cmd/sts-handlers.go:502)."""
     if not MIN_DURATION_S <= duration_s <= MAX_DURATION_S:
         raise STSError("InvalidParameterValue",
                        f"DurationSeconds must be in "
@@ -86,6 +89,7 @@ def mint(parent_access_key: str, root_secret: str,
     # the session policy is stored server-side (UserIdentity.session_policy)
     # and is deliberately NOT a token claim: clients resend the token on
     # every request, so the token carries only identity + expiry
-    claims = {"accessKey": ak, "parent": parent_access_key, "exp": exp}
+    claims = {"accessKey": ak, "parent": parent_access_key, "exp": exp,
+              **(extra_claims or {})}
     token = sign_token(claims, root_secret)
     return TempCredentials(ak, sk, token, exp, parent_access_key)

@@ -70,6 +70,9 @@ class IAMSys:
         self._users: dict[str, UserIdentity] = {}
         self._policies: dict[str, iampolicy.Policy] = dict(iampolicy.CANNED)
         self._group_policies: dict[str, list[str]] = {}
+        # LDAP mapped policies: user DN or group DN -> policy names
+        # (cmd/iam.go mappedPolicy for the LDAPUsersSysType)
+        self._ldap_policies: dict[str, list[str]] = {}
         self._mu = threading.RLock()
         self._save_mu = threading.Lock()  # serializes snapshot+write pairs
         self._loaded = False
@@ -92,6 +95,7 @@ class IAMSys:
                         for name, p in self._policies.items()
                         if name not in iampolicy.CANNED},
                     "groups": self._group_policies,
+                    "ldap_policies": self._ldap_policies,
                 }
             blob = json.dumps(doc).encode()
             self._layer._fanout(
@@ -118,6 +122,7 @@ class IAMSys:
                     self._policies[name] = iampolicy.Policy.from_json(
                         json.dumps(pd))
                 self._group_policies = doc.get("groups", {})
+                self._ldap_policies = doc.get("ldap_policies", {})
             self._loaded = True
 
     # -- users -------------------------------------------------------------
@@ -239,15 +244,75 @@ class IAMSys:
         creds = sts.mint(
             f"oidc:{subject}", self.root.secret_key,
             sts.DEFAULT_DURATION_S if duration_s is None else duration_s)
+        self._register_temp_identity(creds, list(policy_names),
+                                     f"oidc:{subject}")
+        return creds
+
+    def _register_temp_identity(self, creds, policies: list[str],
+                                parent: str, groups: list[str] = (),
+                                session_policy: str = "") -> None:
+        """Sweep expired temp creds + register a freshly minted one —
+        the shared tail of every federated-identity STS path."""
         with self._mu:
             for k in [k for k, u in self._users.items() if u.expired()]:
                 del self._users[k]
             self._users[creds.access_key] = UserIdentity(
                 creds.access_key, creds.secret_key,
-                policies=list(policy_names),
-                parent_user=f"oidc:{subject}",
-                expiration=creds.expiration)
+                policies=policies,
+                parent_user=parent,
+                groups=list(groups),
+                expiration=creds.expiration,
+                session_policy=session_policy)
         self._save()
+
+    def set_ldap_policy(self, dn: str, policy_names: list[str]) -> None:
+        """Map an LDAP user or group DN to policies (the reference's
+        `mc admin policy set ... user=<DN>` for LDAP sys type)."""
+        self._check_policies(policy_names)
+        with self._mu:
+            if policy_names:
+                self._ldap_policies[dn] = list(policy_names)
+            else:
+                self._ldap_policies.pop(dn, None)
+        self._save()
+
+    def list_ldap_policies(self) -> dict[str, list[str]]:
+        with self._mu:
+            return {k: list(v) for k, v in self._ldap_policies.items()}
+
+    def assume_role_ldap_identity(self, user_dn: str, username: str,
+                                  groups: list[str],
+                                  duration_s: int | None = None,
+                                  session_policy: str | None = None):
+        """Temp credentials for an LDAP-verified identity
+        (cmd/sts-handlers.go:436 AssumeRoleWithLDAPIdentity): policy is
+        the union of mapped policies for the user DN and every group DN
+        at mint time; the session token carries ldapUser/ldapUsername
+        claims like the reference's (cmd/sts-handlers.go:502)."""
+        from . import sts
+        with self._mu:
+            pols: list[str] = []
+            for dn in [user_dn, *groups]:
+                for p in self._ldap_policies.get(dn, []):
+                    if p not in pols:
+                        pols.append(p)
+        if not pols:
+            raise IAMError(
+                f"no policy mapped for LDAP identity {user_dn} "
+                "or its groups")
+        if session_policy:
+            try:
+                iampolicy.Policy.from_json(session_policy)
+            except Exception as e:  # noqa: BLE001 — same code as
+                raise sts.STSError(  # assume_role's session policy path
+                    "MalformedPolicyDocument", str(e)) from e
+        creds = sts.mint(
+            f"ldap:{user_dn}", self.root.secret_key,
+            sts.DEFAULT_DURATION_S if duration_s is None else duration_s,
+            session_policy=session_policy,
+            extra_claims={"ldapUser": user_dn, "ldapUsername": username})
+        self._register_temp_identity(creds, pols, f"ldap:{user_dn}",
+                                     groups, session_policy or "")
         return creds
 
     def purge_expired(self) -> int:

@@ -382,6 +382,33 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         finally:
             lk.unlock()
 
+    def health(self, maintenance: bool = False) -> dict:
+        """Cluster-health heuristic (cmd/erasure-server-pool.go:1462):
+        healthy iff every erasure set keeps write quorum, counting only
+        online drives; under maintenance=True, LOCAL drives are
+        excluded — the answer to "can this node be taken down safely".
+        healing_drives counts drives mid-heal (orchestrators must not
+        pull a node while its drives are being rebuilt)."""
+        wq = self._write_quorum()
+        up = 0
+        healing = 0
+        for d in self.disks:
+            if d is None:
+                continue
+            try:
+                if not d.is_online():
+                    continue
+            except Exception:  # noqa: BLE001 — dead drive is offline
+                continue
+            if getattr(d, "healing", False):
+                healing += 1
+            if maintenance and d.is_local():
+                continue
+            up += 1
+        return {"healthy": up >= wq and (not maintenance or healing == 0),
+                "write_quorum": wq, "healing_drives": healing,
+                "online_drives": up}
+
     def _etag_for(self, data: bytes, opts: PutObjectOptions) -> str:
         """ETag per the reference's hash.Reader semantics: md5 when the
         client sent Content-MD5 (verified) or in strict-compat mode

@@ -153,6 +153,27 @@ class ObjectLayer(abc.ABC):
     """The namespace facade every topology implements
     (cmd/object-api-interface.go:84): single set, sets, server pools."""
 
+    def health(self, maintenance: bool = False) -> dict:
+        """Cluster-health heuristic (cmd/object-api-interface.go Health,
+        cmd/erasure-server-pool.go:1462).  Erasure topologies override
+        with per-set quorum accounting; single-backend layers (FS,
+        gateways) are healthy while reachable."""
+        return {"healthy": True, "write_quorum": 0,
+                "healing_drives": 0, "online_drives": 1}
+
+    @staticmethod
+    def aggregate_health(children: list["ObjectLayer"],
+                         maintenance: bool) -> dict:
+        """Shared set/pool aggregation: healthy only if EVERY child
+        keeps write quorum (cmd/erasure-server-pool.go:1509)."""
+        results = [c.health(maintenance) for c in children]
+        return {
+            "healthy": all(r["healthy"] for r in results),
+            "write_quorum": max(r["write_quorum"] for r in results),
+            "healing_drives": sum(r["healing_drives"] for r in results),
+            "online_drives": sum(r["online_drives"] for r in results),
+        }
+
     @abc.abstractmethod
     def make_bucket(self, bucket: str) -> None: ...
 

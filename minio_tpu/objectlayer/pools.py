@@ -98,6 +98,9 @@ class ErasureServerPools(ObjectLayer):
     def list_buckets(self) -> list[BucketInfo]:
         return self.pools[0].list_buckets()
 
+    def health(self, maintenance: bool = False) -> dict:
+        return self.aggregate_health(self.pools, maintenance)
+
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
         for p in self.pools:
             p.delete_bucket(bucket, force)

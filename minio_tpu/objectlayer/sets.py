@@ -112,6 +112,9 @@ class ErasureSets(ObjectLayer):
     def list_buckets(self) -> list[BucketInfo]:
         return self.sets[0].list_buckets()
 
+    def health(self, maintenance: bool = False) -> dict:
+        return self.aggregate_health(self.sets, maintenance)
+
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
         for s in self.sets:
             s.delete_bucket(bucket, force)

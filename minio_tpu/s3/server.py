@@ -221,6 +221,9 @@ class S3Server:
         self.kms = LocalKMS.from_env_or_store(object_layer)
         from ..iam.openid import OpenIDProvider
         self.openid = OpenIDProvider.from_config(self.config)
+        from ..iam.ldap import LDAPConfig, LDAPIdentity
+        _lcfg = LDAPConfig.from_config(self.config)
+        self.ldap = LDAPIdentity(_lcfg) if _lcfg.enabled else None
         # ILM tiering (cmd/bucket-lifecycle.go transitionObject): tier
         # registry persisted in the system volume
         from ..objectlayer.tiering import TransitionSys
@@ -301,6 +304,7 @@ class S3Server:
         self._thread.start()
 
     def stop(self) -> None:
+        self._stopping = True          # health probes report offline
         self.httpd.shutdown()
         self.httpd.server_close()
         self.events.close()
@@ -666,6 +670,12 @@ def _make_handler(srv: S3Server):
             from ..admin import handlers as admin_handlers
             from ..admin.metrics import GLOBAL as mtr
             try:
+                if path.startswith("/minio-tpu/health/"):
+                    # healthcheck router (cmd/healthcheck-router.go:40):
+                    # unauthenticated, throttle-exempt — k8s probes must
+                    # reach it when the server is saturated or keyless
+                    self._body()
+                    return self._health_api(path, query)
                 if path == admin_handlers.METRICS_PATH:
                     self._body()  # drain keep-alive body before replying
                     if self.command != "GET":
@@ -763,13 +773,9 @@ def _make_handler(srv: S3Server):
             if action in ("AssumeRoleWithWebIdentity",
                           "AssumeRoleWithClientGrants"):
                 return self._sts_web_identity(form, action)
+            if action == "AssumeRoleWithLDAPIdentity":
+                return self._sts_ldap_identity(form)
             if action != "AssumeRole":
-                if action == "AssumeRoleWithLDAPIdentity":
-                    # LDAP client library not in this build (cmd/iam.go
-                    # LDAP mode): gated, never silently accepted
-                    return self._sts_fail(
-                        "NotImplemented",
-                        f"{action} requires an LDAP identity provider")
                 return self._sts_fail("InvalidAction", action)
             if not self.access_key:
                 return self._sts_fail("AccessDenied",
@@ -788,6 +794,64 @@ def _make_handler(srv: S3Server):
                 return self._sts_fail(e.code, str(e))
             root = ET.Element("AssumeRoleResponse", xmlns=self.STS_NS)
             result = ET.SubElement(root, "AssumeRoleResult")
+            ce = ET.SubElement(result, "Credentials")
+            ET.SubElement(ce, "AccessKeyId").text = creds.access_key
+            ET.SubElement(ce, "SecretAccessKey").text = creds.secret_key
+            ET.SubElement(ce, "SessionToken").text = creds.session_token
+            ET.SubElement(ce, "Expiration").text = \
+                datetime.datetime.fromtimestamp(
+                    creds.expiration, datetime.timezone.utc).strftime(
+                        "%Y-%m-%dT%H:%M:%SZ")
+            meta = ET.SubElement(root, "ResponseMetadata")
+            ET.SubElement(meta, "RequestId").text = uuid.uuid4().hex[:16]
+            self._send(200, _xml(root))
+
+        def _sts_ldap_identity(self, form: dict):
+            """AssumeRoleWithLDAPIdentity (cmd/sts-handlers.go:436):
+            verify the username/password against the configured
+            directory, mint temp creds carrying the LDAP-mapped
+            policies.  Unsigned by design — the password is the
+            credential."""
+            from ..iam import ldap as _ldap
+            from ..iam import sts as _sts
+            if srv.ldap is None or not srv.ldap.config.enabled:
+                return self._sts_fail(
+                    "NotImplemented",
+                    "no LDAP provider configured (identity_ldap)")
+            username = form.get("LDAPUsername", "")
+            password = form.get("LDAPPassword", "")
+            if not username or not password:
+                return self._sts_fail(
+                    "MissingParameter",
+                    "LDAPUsername and LDAPPassword cannot be empty")
+            policy = form.get("Policy") or None
+            if policy and len(policy) > 2048:
+                return self._sts_fail(
+                    "InvalidParameterValue",
+                    "session policy exceeds 2048 characters")
+            try:
+                duration = int(form.get(
+                    "DurationSeconds", str(srv.ldap.config.sts_expiry_s)))
+            except ValueError:
+                return self._sts_fail("InvalidParameterValue",
+                                      "DurationSeconds")
+            try:
+                user_dn, groups = srv.ldap.bind(username, password)
+            except _ldap.LDAPError as e:
+                return self._sts_fail("InvalidParameterValue",
+                                      f"LDAP server error: {e}")
+            try:
+                creds = srv.iam.assume_role_ldap_identity(
+                    user_dn, username, groups, duration,
+                    session_policy=policy)
+            except _sts.STSError as e:
+                return self._sts_fail(e.code, str(e))
+            except Exception as e:  # noqa: BLE001 — surface as STS error
+                return self._sts_fail("InvalidParameterValue", str(e))
+            root = ET.Element("AssumeRoleWithLDAPIdentityResponse",
+                              xmlns=self.STS_NS)
+            result = ET.SubElement(
+                root, "AssumeRoleWithLDAPIdentityResult")
             ce = ET.SubElement(result, "Credentials")
             ET.SubElement(ce, "AccessKeyId").text = creds.access_key
             ET.SubElement(ce, "SecretAccessKey").text = creds.secret_key
@@ -877,6 +941,42 @@ def _make_handler(srv: S3Server):
                               else "AccessDenied") from e
             if claims.get("accessKey") != self.access_key:
                 raise S3Error("AccessDenied")
+
+        # -- healthcheck router (cmd/healthcheck-router.go:40) ------------
+
+        def _health_api(self, path, query):
+            if self.command not in ("GET", "HEAD"):
+                raise S3Error("MethodNotAllowed")
+            leaf = path[len("/minio-tpu/health/"):]
+            status = 200
+            headers = {}
+            if leaf == "cluster":
+                # readiness for traffic incl. maintenance pre-check
+                # (cmd/healthcheck-handler.go:28-66 ClusterCheckHandler)
+                maint = (query or {}).get("maintenance",
+                                          [""])[0] == "true"
+                h = srv.layer.health(maintenance=maint)
+                if h["write_quorum"]:
+                    headers["X-Minio-Write-Quorum"] = \
+                        str(h["write_quorum"])
+                if not h["healthy"]:
+                    if h["healing_drives"]:
+                        headers["X-Minio-Healing-Drives"] = \
+                            str(h["healing_drives"])
+                    # maintenance probe: 412 tells the orchestrator the
+                    # node can NOT be safely taken down
+                    status = 412 if maint else 503
+            elif leaf in ("live", "ready"):
+                # process-level probes: always 200 while the process
+                # serves, exactly like the reference
+                # (cmd/healthcheck-handler.go:69-84 returns success
+                # unconditionally); a stopping server only annotates
+                # the informational offline header
+                if getattr(srv, "_stopping", False):
+                    headers["X-Minio-Server-Status"] = "offline"
+            else:
+                raise S3Error("NoSuchKey")
+            self._send(status, b"", headers=headers)
 
         # -- service / bucket APIs ----------------------------------------
 

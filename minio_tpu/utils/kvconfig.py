@@ -77,6 +77,17 @@ register_subsys("federation", {
     "dns_file": "",                 # FileDNSStore path (etcd stand-in)
     "advertise": "",                # routable host:port in DNS records
 })
+register_subsys("identity_ldap", {
+    # cmd/config/identity/ldap/config.go keys, 1:1
+    "server_addr": "",
+    "sts_expiry": "1h",
+    "lookup_bind_dn": "",
+    "lookup_bind_password": "",
+    "user_dn_search_base_dn": "",
+    "user_dn_search_filter": "",        # %s -> username
+    "group_search_filter": "",          # %s -> username, %d -> user DN
+    "group_search_base_dn": "",
+})
 register_subsys("identity_openid", {
     "enable": "off",
     "issuer": "",                   # expected iss claim

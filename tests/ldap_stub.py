@@ -1,0 +1,197 @@
+"""In-process stub LDAP directory server for tests.
+
+Speaks the LDAPv3 subset the framework's client uses (simple bind,
+search with eq/and/or/present filters) over real TCP — the LDAP analog
+of the in-process OIDC provider in test_openid.py.  Entirely original
+test scaffolding; the BER codec is shared with minio_tpu.iam.ldap.
+"""
+
+from __future__ import annotations
+
+import socketserver
+import threading
+
+from minio_tpu.iam import ldap as L
+
+
+class Directory:
+    """dn -> {attr: [values]}; passwords in the userPassword attr."""
+
+    def __init__(self):
+        self.entries: dict[str, dict[str, list[str]]] = {}
+
+    def add(self, dn: str, **attrs):
+        self.entries[dn] = {k: (v if isinstance(v, list) else [v])
+                            for k, v in attrs.items()}
+
+    def bind_ok(self, dn: str, password: str) -> bool:
+        e = self.entries.get(dn)
+        return bool(e) and password in e.get("userPassword", [])
+
+    def search(self, base: str, filt) -> list[tuple[str, dict]]:
+        out = []
+        for dn, attrs in self.entries.items():
+            if not dn.endswith(base):
+                continue
+            if _match(filt, dn, attrs):
+                out.append((dn, attrs))
+        return out
+
+
+def _match(filt, dn, attrs) -> bool:
+    tag, content = filt
+    if tag == L.FILTER_AND:
+        return all(_match(f, dn, attrs) for f in _children(content))
+    if tag == L.FILTER_OR:
+        return any(_match(f, dn, attrs) for f in _children(content))
+    if tag == L.FILTER_NOT:
+        return not _match(_children(content)[0], dn, attrs)
+    if tag == L.FILTER_PRESENT:
+        return content.decode() in attrs
+    if tag == L.FILTER_EQ:
+        r = L.BERReader(content)
+        _, attr = r.read_tlv()
+        _, value = r.read_tlv()
+        want = _unescape(value.decode())
+        return want in attrs.get(attr.decode(), [])
+    return False
+
+
+def _children(content: bytes):
+    r = L.BERReader(content)
+    out = []
+    while not r.eof():
+        out.append(r.read_tlv())
+    return out
+
+
+def _unescape(s: str) -> str:
+    out, i = [], 0
+    while i < len(s):
+        if s[i] == "\\" and i + 2 < len(s) + 1:
+            out.append(chr(int(s[i + 1:i + 3], 16)))
+            i += 3
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        d: Directory = self.server.directory  # type: ignore[attr-defined]
+        sock = self.request
+        buf = b""
+        while True:
+            # read one LDAPMessage
+            try:
+                msg, buf = _read_message(sock, buf)
+            except (ConnectionError, ValueError, OSError):
+                return
+            if msg is None:
+                return
+            r = L.BERReader(msg)
+            _, midv = r.read_tlv()
+            mid = L.decode_int(midv)
+            optag, opv = r.read_tlv()
+            if optag == L.APP_UNBIND_REQUEST:
+                return
+            if optag == L.APP_BIND_REQUEST:
+                br = L.BERReader(opv)
+                br.read_tlv()                        # version
+                _, dn = br.read_tlv()
+                _, pw = br.read_tlv()
+                ok = d.bind_ok(dn.decode(), pw.decode())
+                code = 0 if ok else 49
+                resp = L.ber(L.APP_BIND_RESPONSE,
+                             L.ber_int(code, L.ENUMERATED)
+                             + L.ber_str("") + L.ber_str(""))
+                sock.sendall(L.ber(L.SEQUENCE, L.ber_int(mid) + resp))
+            elif optag == L.APP_SEARCH_REQUEST:
+                sr = L.BERReader(opv)
+                _, base = sr.read_tlv()
+                sr.read_tlv()                        # scope
+                sr.read_tlv()                        # deref
+                sr.read_tlv()                        # sizeLimit
+                sr.read_tlv()                        # timeLimit
+                sr.read_tlv()                        # typesOnly
+                filt = sr.read_tlv()
+                for dn, attrs in d.search(base.decode(), filt):
+                    battrs = b"".join(
+                        L.ber(L.SEQUENCE,
+                              L.ber_str(k)
+                              + L.ber(L.SET, b"".join(
+                                  L.ber_str(v) for v in vs)))
+                        for k, vs in attrs.items()
+                        if k != "userPassword")
+                    entry = L.ber(L.APP_SEARCH_ENTRY,
+                                  L.ber_str(dn)
+                                  + L.ber(L.SEQUENCE, battrs))
+                    sock.sendall(L.ber(L.SEQUENCE,
+                                       L.ber_int(mid) + entry))
+                done = L.ber(L.APP_SEARCH_DONE,
+                             L.ber_int(0, L.ENUMERATED)
+                             + L.ber_str("") + L.ber_str(""))
+                sock.sendall(L.ber(L.SEQUENCE, L.ber_int(mid) + done))
+            else:                                    # unsupported op
+                return
+
+
+def _read_message(sock, buf: bytes):
+    while True:
+        if len(buf) >= 2:
+            first = buf[1]
+            if first < 0x80:
+                hdr, length = 2, first
+            else:
+                nb = first & 0x7F
+                if len(buf) >= 2 + nb:
+                    hdr = 2 + nb
+                    length = int.from_bytes(buf[2:2 + nb], "big")
+                else:
+                    hdr = None
+            if hdr is not None and len(buf) >= hdr + length:
+                return buf[hdr:hdr + length], buf[hdr + length:]
+        chunk = sock.recv(4096)
+        if not chunk:
+            return None, b""
+        buf += chunk
+
+
+class StubLDAPServer:
+    def __init__(self, directory: Directory):
+        self._srv = socketserver.ThreadingTCPServer(
+            ("127.0.0.1", 0), _Handler)
+        self._srv.daemon_threads = True
+        self._srv.directory = directory  # type: ignore[attr-defined]
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+
+    def start(self) -> str:
+        self._thread.start()
+        host, port = self._srv.server_address
+        return f"{host}:{port}"
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def standard_directory() -> Directory:
+    """Small test org: 2 users, 2 groups."""
+    d = Directory()
+    d.add("cn=lookup,dc=example,dc=org", userPassword="lookup-secret")
+    d.add("uid=svc-alice,ou=users,dc=example,dc=org",
+          uid="svc-alice", userPassword="alice-pass",
+          objectClass=["person"])
+    d.add("uid=svc-bob,ou=users,dc=example,dc=org",
+          uid="svc-bob", userPassword="bob-pass",
+          objectClass=["person"])
+    d.add("cn=readers,ou=groups,dc=example,dc=org",
+          objectClass="groupOfNames",
+          member=["uid=svc-alice,ou=users,dc=example,dc=org",
+                  "uid=svc-bob,ou=users,dc=example,dc=org"])
+    d.add("cn=admins,ou=groups,dc=example,dc=org",
+          objectClass="groupOfNames",
+          member=["uid=svc-alice,ou=users,dc=example,dc=org"])
+    return d
