@@ -77,13 +77,19 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
         if route == "set-user-policy" and h.command == "POST":
             target = q1["accessKey"]
             pols = [p for p in q1.get("policies", "").split(",") if p]
-            if "=" in target and getattr(srv, "ldap", None) is not None:
-                # an LDAP DN with LDAP configured: map policies for the
-                # LDAP sys type (cmd/admin-handlers-users.go routes DNs
-                # to the LDAP mappedPolicy store only under LDAP mode)
-                srv.iam.set_ldap_policy(target, pols)
-            else:
+            try:
                 srv.iam.attach_policy(target, pols)
+            except Exception as e:     # noqa: BLE001 — NoSuchUser path
+                from ..iam.sys import NoSuchUser
+                # only an UNKNOWN access key that looks like a DN, with
+                # LDAP configured, routes to the LDAP mappedPolicy
+                # store (cmd/admin-handlers-users.go LDAP sys type); a
+                # real user whose key contains '=' is never misrouted
+                if isinstance(e, NoSuchUser) and "=" in target \
+                        and getattr(srv, "ldap", None) is not None:
+                    srv.iam.set_ldap_policy(target, pols)
+                else:
+                    raise
             return send_json({"status": "ok"}) or True
         if route == "add-service-account" and h.command == "POST":
             doc = json.loads(payload) if payload else {}

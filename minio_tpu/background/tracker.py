@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 
 from ..hashing.xxhash import xxh64
 
@@ -60,6 +61,13 @@ class DataUpdateTracker:
         self.cycle = 1
         self._current = _Bloom(bits)
         self._history: list[tuple[int, _Bloom]] = []
+        # precise per-bucket last-change timestamps alongside the
+        # blooms: the metacache consults this (exact, no false
+        # positives) to decide listing-cache validity without waiting
+        # out a TTL — the role the bloom consult plays in
+        # cmd/metacache-bucket.go.  Wall-clock so the ordering holds
+        # across processes sharing drives (seq spaces would not).
+        self._bucket_time: dict[str, float] = {}
         if layer is not None:
             self._load()
 
@@ -71,6 +79,12 @@ class DataUpdateTracker:
             # bucket (dataUpdateTracker path-prefix marking)
             self._current.add(bucket.encode())
             self._current.add(f"{bucket}/{object_name}".encode())
+            self._bucket_time[bucket] = time.time()
+
+    def bucket_changed_at(self, bucket: str) -> float:
+        """Wall time of the bucket's most recent change (0 = never)."""
+        with self._mu:
+            return self._bucket_time.get(bucket, 0.0)
 
     def changed_since(self, cycle: int, bucket: str,
                       object_name: str = "") -> bool:

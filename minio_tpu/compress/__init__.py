@@ -112,6 +112,15 @@ def crc32c(data: bytes) -> int:
 # -- framing format (snappy framing / S2-compatible container) --------------
 
 _STREAM_IDENT = b"\xff\x06\x00\x00sNaPpY"
+# klauspost/s2 streams carry their own identifier chunk.  The S2 BLOCK
+# format adds opcodes (repeat offsets, >64 KiB blocks) whose byte-level
+# spec is not available in this offline environment and for which no
+# oracle encoder exists here — a guessed decoder validated only by its
+# own round trip would be self-confirming and could silently corrupt
+# data, so S2-extended blocks are rejected LOUDLY instead (see
+# decompress_stream / snappy_py error paths).  Reference: go.mod:37,
+# decompress call at cmd/object-api-utils.go:676.
+_S2_IDENT = b"\xff\x06\x00\x00S2sTwO"
 _CHUNK_COMPRESSED = 0x00
 _CHUNK_UNCOMPRESSED = 0x01
 _FRAME_MAX = 65536                  # max uncompressed bytes per chunk
@@ -143,7 +152,8 @@ def compress_stream(data: bytes) -> bytes:
 
 
 def decompress_stream(data: bytes) -> bytes:
-    if not data.startswith(_STREAM_IDENT):
+    s2 = data.startswith(_S2_IDENT)
+    if not (data.startswith(_STREAM_IDENT) or s2):
         raise CompressionError("missing snappy stream identifier")
     out = bytearray()
     i = len(_STREAM_IDENT)
@@ -162,8 +172,18 @@ def decompress_stream(data: bytes) -> bytes:
                 raise CompressionError("short chunk")
             crc = struct.unpack("<I", body[:4])[0]
             payload = body[4:]
-            plain = decompress_block(payload) \
-                if kind == _CHUNK_COMPRESSED else payload
+            try:
+                plain = decompress_block(payload) \
+                    if kind == _CHUNK_COMPRESSED else payload
+            except (CompressionError, ValueError) as e:
+                if s2:
+                    # see _S2_IDENT comment: refuse loudly, never guess
+                    raise CompressionError(
+                        "S2-extended block opcodes (repeat offsets / "
+                        "large blocks) are not supported by this "
+                        "decoder; re-write the object with snappy-"
+                        "compatible compression") from e
+                raise
             if _masked_crc(plain) != crc:
                 raise CompressionError("chunk CRC mismatch")
             out += plain

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import socket
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 # ---------------------------------------------------------------------------
 # BER (subset: definite lengths, the types LDAPv3 messages use)
@@ -148,7 +148,24 @@ def _parse_one(expr: str) -> tuple[bytes, str]:
         raise ValueError(f"no '=' in filter component {body!r}")
     if value == "*":
         return ber_str(attr, FILTER_PRESENT), rest
-    return ber(FILTER_EQ, ber_str(attr) + ber_str(value)), rest
+    # RFC 4515 escapes (\2a etc.) decode to RAW bytes in the BER
+    # assertion value — the escaping exists only at the string-filter
+    # layer; sending the backslash-hex text literally would make real
+    # directory servers match nothing
+    return ber(FILTER_EQ,
+               ber_str(attr) + ber_str(_unescape_filter(value))), rest
+
+
+def _unescape_filter(s: str) -> str:
+    out, i = [], 0
+    while i < len(s):
+        if s[i] == "\\" and i + 2 < len(s) + 1 and i + 3 <= len(s):
+            out.append(chr(int(s[i + 1:i + 3], 16)))
+            i += 3
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
 
 
 # ---------------------------------------------------------------------------
@@ -165,7 +182,10 @@ class LDAPClient:
     """
 
     def __init__(self, addr: str, timeout: float = 10.0):
-        host, _, port = addr.rpartition(":")
+        if ":" in addr:
+            host, _, port = addr.rpartition(":")
+        else:
+            host, port = addr, ""       # bare hostname -> default 389
         self._sock = socket.create_connection(
             (host or "127.0.0.1", int(port or 389)), timeout=timeout)
         self._msgid = 0
@@ -336,7 +356,6 @@ class LDAPIdentity:
     """Bind-and-resolve against the configured directory
     (cmd/config/identity/ldap/ldap.go Bind, lookup-bind mode)."""
     config: LDAPConfig
-    _policy_note: str = field(default="", repr=False)
 
     def bind(self, username: str, password: str) -> tuple[str, list[str]]:
         """Verify the user's password; return (user_dn, group_dns).

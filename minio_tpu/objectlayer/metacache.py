@@ -113,6 +113,18 @@ def _deserialize(data: bytes) -> Metacache:
                      entries=entries)
 
 
+def managers_of(layer) -> list["MetacacheManager"]:
+    """Every MetacacheManager under an object-layer topology (a pools
+    layer nests sets which nest single-set layers; invalidation and
+    tracker wiring must reach them all)."""
+    if hasattr(layer, "pools"):
+        return [m for p in layer.pools for m in managers_of(p)]
+    if hasattr(layer, "sets"):
+        return [m for s in layer.sets for m in managers_of(s)]
+    mc = getattr(layer, "metacache", None)
+    return [mc] if mc is not None else []
+
+
 class MetacacheManager:
     """Per-object-layer cache registry (cmd/metacache-manager.go).
 
@@ -133,6 +145,21 @@ class MetacacheManager:
         self._sys_volume = sys_volume
         self.hits = 0
         self.misses = 0
+        # optional DataUpdateTracker: when attached, cache hits consult
+        # the change bloom filter so a peer's write invalidates listings
+        # immediately instead of after the TTL (the reference's
+        # metacache<->data-update-tracker coupling)
+        self.tracker = None
+
+    def _stale(self, mc: Metacache) -> bool:
+        """Update-tracker consult (cmd/metacache-bucket.go coupling):
+        the cache is stale once the bucket changed at-or-after the
+        snapshot's creation.  ``created`` is captured BEFORE the walk,
+        so a write landing mid-walk marks a later time and the next
+        lookup re-walks; >= makes the same-instant race err toward an
+        extra walk, never a stale listing."""
+        return self.tracker is not None and \
+            self.tracker.bucket_changed_at(mc.bucket) >= mc.created
 
     # -- persistence -----------------------------------------------------
 
@@ -179,11 +206,12 @@ class MetacacheManager:
         now = time.time()
         with self._mu:
             mc = self._caches.get(key)
-            if mc is not None and not mc.expired(self._ttl, now):
+            if mc is not None and not mc.expired(self._ttl, now) \
+                    and not self._stale(mc):
                 self.hits += 1
                 return mc
         mc = self._load(bucket, prefix)
-        if mc is not None:
+        if mc is not None and not self._stale(mc):
             self.hits += 1
             with self._mu:
                 self._caches[key] = mc

@@ -37,11 +37,25 @@ def register_peer_service(rpc: RPCServer, srv) -> None:
     def log_recent(n: int = 100):
         return srv.logger.recent(n)
 
+    def mark_change(bucket: str, object_name: str = "") -> bool:
+        """A peer's write happened: mark this node's update tracker so
+        cached listings for the bucket go stale immediately instead of
+        after the metacache TTL (cmd/data-update-tracker.go fan-in +
+        cmd/metacache-bucket.go consult)."""
+        if srv.tracker is not None:
+            srv.tracker.mark(bucket, object_name)
+        else:
+            from ..objectlayer.metacache import managers_of
+            for mc in managers_of(srv.layer):
+                mc.invalidate(bucket)  # no tracker: hard-drop instead
+        return True
+
     rpc.register("peer", {
         "reload_bucket_meta": reload_bucket_meta,
         "reload_iam": reload_iam,
         "trace_since": trace_since,
         "log_recent": log_recent,
+        "mark_change": mark_change,
     })
 
 
@@ -101,6 +115,12 @@ class PeerNotifier:
 
     def bucket_meta_changed(self, bucket: str) -> None:
         self._fanout("reload_bucket_meta", bucket=bucket)
+
+    def object_changed(self, bucket: str, object_name: str = "") -> None:
+        """Async per-write fan-out feeding every peer's update tracker
+        (keeps their listing caches honest without a TTL wait)."""
+        self._fanout("mark_change", bucket=bucket,
+                     object_name=object_name)
 
     def iam_changed(self) -> None:
         self._fanout("reload_iam")

@@ -289,6 +289,15 @@ class S3Server:
             self.config.get("api", "requests_deadline") or "10s")
         self._req_sem = threading.BoundedSemaphore(req_max)
 
+    def attach_tracker(self, tracker) -> None:
+        """Wire the data-update tracker into event marking AND listing-
+        cache validity (the metacache consults it instead of waiting
+        out its TTL — cmd/metacache-bucket.go coupling)."""
+        self.tracker = tracker
+        from ..objectlayer.metacache import managers_of
+        for mc in managers_of(self.layer):
+            mc.tracker = tracker
+
     def attach_peers(self, notifier) -> None:
         """Wire the peer fan-out: IAM/bucket-metadata mutations reload on
         every node immediately (cmd/peer-rest-common.go:27-61), and the
@@ -321,6 +330,10 @@ class S3Server:
         if self.tracker is not None and oi is not None:
             # feed the crawler's change bloom filter on every mutation
             self.tracker.mark(bucket, getattr(oi, "name", ""))
+        if self.peers is not None and oi is not None:
+            # feed every PEER's tracker too: their cached listings for
+            # this bucket go stale now, not after the metacache TTL
+            self.peers.object_changed(bucket, getattr(oi, "name", ""))
         self.events.send(event_name, bucket, oi, req_params or {})
 
     def replicate(self, bucket: str, oi, delete: bool = False) -> None:

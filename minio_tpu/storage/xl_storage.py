@@ -40,6 +40,51 @@ _RESERVED = {SYS_DIR}
 # acknowledged writes must survive a crash; MT_FSYNC=0 is for benchmarks
 _FSYNC = os.environ.get("MT_FSYNC", "1") != "0"
 
+# O_DIRECT on the drive hot path (cmd/xl-storage.go:1400-1568
+# odirectReader / aligned writes): bypasses the page cache so bench
+# numbers measure the drives, not RAM, and large objects are not
+# double-buffered.  Env-gated (default off): requires 4 KiB-aligned
+# buffers (mmap allocations) and falls back to buffered IO on
+# filesystems without support (tmpfs returns EINVAL).
+_ODIRECT = os.environ.get("MT_ODIRECT", "0") not in ("0", "", "off")
+_ALIGN = 4096
+
+
+def _read_odirect(full: str, offset: int, length: int) -> bytes | None:
+    """Aligned O_DIRECT read; None = unsupported here (caller falls
+    back to buffered)."""
+    import mmap
+    flags = os.O_RDONLY | getattr(os, "O_DIRECT", 0)
+    try:
+        fd = os.open(full, flags)
+    except OSError as e:
+        if e.errno == 22:           # EINVAL: fs without O_DIRECT
+            return None
+        raise
+    try:
+        a_off = offset - (offset % _ALIGN)
+        a_len = ((offset + length + _ALIGN - 1) // _ALIGN) * _ALIGN \
+            - a_off
+        buf = mmap.mmap(-1, a_len)   # page-aligned, O_DIRECT-safe
+        try:
+            got = 0
+            while got < a_len:
+                n = os.preadv(fd, [memoryview(buf)[got:]], a_off + got)
+                if n <= 0:
+                    break            # EOF (tail block short is fine)
+                got += n
+            lo = offset - a_off
+            return bytes(buf[lo:lo + length]) \
+                if got >= lo + length else bytes(buf[lo:got])
+        finally:
+            buf.close()
+    except OSError as e:
+        if e.errno == 22:
+            return None
+        raise
+    finally:
+        os.close(fd)
+
 
 def _fsync_fileobj(f) -> None:
     if _FSYNC:
@@ -251,6 +296,13 @@ class XLStorage(StorageAPI):
                 f"size mismatch: {len(data)} != {file_size}")
         full = self._file_path(volume, path)
         self._check_vol(volume)
+        if _ODIRECT:
+            try:
+                if self._create_file_odirect(full, data):
+                    return
+            except FileNotFoundError:
+                pass                 # parent missing: buffered path
+                                     # below creates it and retries
         with self._open_create(volume, full) as f:
             f.write(data)
             _fsync_fileobj(f)
@@ -263,13 +315,58 @@ class XLStorage(StorageAPI):
             f.write(data)
             _fsync_fileobj(f)
 
+    def _create_file_odirect(self, full: str, data) -> bool:
+        """Aligned O_DIRECT shard-file write (pad to 4 KiB, truncate to
+        the real size — the reference's aligned writer does the same);
+        False = unsupported filesystem, caller falls back."""
+        import mmap
+        flags = os.O_WRONLY | os.O_CREAT | os.O_TRUNC \
+            | getattr(os, "O_DIRECT", 0)
+        try:
+            fd = os.open(full, flags, 0o644)
+        except OSError as e:
+            if e.errno == 22:
+                return False
+            raise
+        buf = None
+        try:
+            mv = memoryview(data).cast("B")
+            n = len(mv)
+            a_len = max(((n + _ALIGN - 1) // _ALIGN) * _ALIGN, _ALIGN)
+            buf = mmap.mmap(-1, a_len)
+            buf[:n] = mv
+            written = 0
+            while written < a_len:
+                w = os.pwritev(fd, [memoryview(buf)[written:a_len]],
+                               written)
+                if w <= 0:
+                    raise OSError("short O_DIRECT write")
+                written += w
+            if a_len != n:
+                os.ftruncate(fd, n)
+            if _FSYNC:
+                os.fsync(fd)
+            return True
+        except OSError as e:
+            if getattr(e, "errno", None) == 22:
+                return False
+            raise
+        finally:
+            if buf is not None:
+                buf.close()
+            os.close(fd)
+
     def read_file_stream(self, volume: str, path: str, offset: int,
                          length: int) -> bytes:
         full = self._file_path(volume, path)
         try:
-            with open(full, "rb") as f:
-                f.seek(offset)
-                data = f.read(length)
+            data = None
+            if _ODIRECT:
+                data = _read_odirect(full, offset, length)
+            if data is None:        # buffered path / O_DIRECT fallback
+                with open(full, "rb") as f:
+                    f.seek(offset)
+                    data = f.read(length)
         except FileNotFoundError:
             raise errors.FileNotFound(path) from None
         except PermissionError as e:
@@ -419,9 +516,11 @@ class XLStorage(StorageAPI):
         if fi.data_dir:
             ddir = os.path.join(dst_obj, fi.data_dir)
             os.mkdir(ddir)
-            with open(os.path.join(ddir, "part.1"), "wb") as f:
-                f.write(data)
-                _fsync_fileobj(f)
+            part = os.path.join(ddir, "part.1")
+            if not (_ODIRECT and self._create_file_odirect(part, data)):
+                with open(part, "wb") as f:
+                    f.write(data)
+                    _fsync_fileobj(f)
             _fsync_dir(ddir)
         self._write_meta(volume, path, meta)    # atomic tmp+replace
         _fsync_dir(dst_obj)

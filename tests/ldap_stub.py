@@ -52,8 +52,9 @@ def _match(filt, dn, attrs) -> bool:
         r = L.BERReader(content)
         _, attr = r.read_tlv()
         _, value = r.read_tlv()
-        want = _unescape(value.decode())
-        return want in attrs.get(attr.decode(), [])
+        # assertion values arrive as RAW bytes (the client decodes
+        # RFC 4515 escapes before BER-encoding)
+        return value.decode() in attrs.get(attr.decode(), [])
     return False
 
 
@@ -63,18 +64,6 @@ def _children(content: bytes):
     while not r.eof():
         out.append(r.read_tlv())
     return out
-
-
-def _unescape(s: str) -> str:
-    out, i = [], 0
-    while i < len(s):
-        if s[i] == "\\" and i + 2 < len(s) + 1:
-            out.append(chr(int(s[i + 1:i + 3], 16)))
-            i += 3
-        else:
-            out.append(s[i])
-            i += 1
-    return "".join(out)
 
 
 class _Handler(socketserver.BaseRequestHandler):

@@ -198,3 +198,26 @@ def test_compress_plus_sse(client, server):
                             "customer-key-md5": base64.b64encode(
                                 hashlib.md5(key).digest()).decode()})
     assert client.get_object("comp", "combo-copy.txt").body == data
+
+
+def test_s2_stream_identifier_accepted():
+    """An S2-identified stream whose chunks use only snappy opcodes
+    (klauspost S2 snappy-compat mode) decodes; S2-extended opcodes are
+    rejected with a loud, specific error — never silently corrupted."""
+    from minio_tpu import compress as C
+    body = b"hello s2 world " * 100
+    snap = C.compress_stream(body)
+    s2 = C._S2_IDENT + snap[len(C._STREAM_IDENT):]
+    assert C.decompress_stream(s2) == body
+
+    # a block whose copy has offset 0 — an S2 repeat-offset opcode,
+    # invalid in plain snappy: uvarint(8) preamble, literal "abcd"
+    # (tag 0x0c), copy1 len=4 offset=0 (tag 0x01, offset byte 0x00)
+    bad_block = b"\x08" + b"\x0cabcd" + b"\x01\x00"
+    import struct as _s
+    crc = C._masked_crc(b"abcdabcd")
+    chunk = bytes([0x00]) + _s.pack("<I", 4 + len(bad_block))[:3] + \
+        _s.pack("<I", crc) + bad_block
+    with pytest.raises(C.CompressionError) as ei:
+        C.decompress_stream(C._S2_IDENT + chunk)
+    assert "S2-extended" in str(ei.value)
