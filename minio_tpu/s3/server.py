@@ -993,1823 +993,17 @@ def _make_handler(srv: S3Server):
 
         # -- service / bucket APIs ----------------------------------------
 
-        def _list_buckets(self):
-            if self.command != "GET":
-                raise S3Error("MethodNotAllowed")
-            self._allow(iampol.LIST_ALL_MY_BUCKETS)
-            root = ET.Element("ListAllMyBucketsResult", xmlns=S3_NS)
-            owner = ET.SubElement(root, "Owner")
-            ET.SubElement(owner, "ID").text = "minio-tpu"
-            ET.SubElement(owner, "DisplayName").text = "minio-tpu"
-            buckets = ET.SubElement(root, "Buckets")
-            for b in srv.layer.list_buckets():
-                be = ET.SubElement(buckets, "Bucket")
-                ET.SubElement(be, "Name").text = b.name
-                ET.SubElement(be, "CreationDate").text = _iso_date(b.created)
-            self._send(200, _xml(root))
-
-        # config subresources: query-param -> (module handler); each stores
-        # the raw document in BucketMetadataSys and round-trips it on GET
-        # (cmd/bucket-handlers.go, cmd/bucket-lifecycle-handlers.go, ...)
-
-        def _config_api(self, bucket, query, payload) -> bool:
-            from ..bucket import (encryption, lifecycle, notification,
-                                  objectlock, replication, tags)
-            from ..bucket import policy as bpolicy
-            cmd = self.command
-            if not ({"policy", "lifecycle", "encryption", "replication",
-                     "notification", "object-lock", "tagging", "quota",
-                     "acl", "cors", "website", "accelerate",
-                     "requestPayment", "logging"} & set(query)):
-                return False
-
-            def exists():
-                # authorization happens BEFORE the existence check so an
-                # unauthenticated caller cannot enumerate bucket names by
-                # distinguishing 404 from 403 (cmd/auth-handler.go order)
-                srv.layer.get_bucket_info(bucket)
-
-            def crud(param, get_act, put_act, parse, not_found,
-                     store_key=None, deletable=True, parse_err="MalformedXML"):
-                if param not in query:
-                    return False
-                store_key = store_key or param
-                if cmd == "PUT":
-                    self._allow(put_act, bucket)
-                    exists()
-                    try:
-                        doc = parse(payload)
-                    except (ValueError, KeyError) as e:
-                        code = getattr(e, "code", parse_err)
-                        raise S3Error(code) from e
-                    srv.bucket_meta.set_config(bucket, store_key, doc)
-                    self._send(200)
-                elif cmd == "GET":
-                    self._allow(get_act, bucket)
-                    exists()
-                    raw = srv.bucket_meta.get_config(bucket, store_key)
-                    if raw is None:
-                        raise S3Error(not_found)
-                    ctype = "application/json" \
-                        if store_key == "policy" else "application/xml"
-                    self._send(200, raw.encode(), content_type=ctype)
-                elif cmd == "DELETE" and deletable:
-                    self._allow(put_act, bucket)
-                    exists()
-                    srv.bucket_meta.set_config(bucket, store_key, None)
-                    self._send(204)
-                else:
-                    raise S3Error("MethodNotAllowed")
-                return True
-
-            # dummy sub-resources (cmd/dummy-handlers.go): authorize with
-            # the bucket-policy action, validate existence, then return
-            # the fixed default (or the documented error); DELETE website
-            # succeeds as a no-op
-            _DUMMY = {
-                "accelerate": (
-                    b'<?xml version="1.0" encoding="UTF-8"?>'
-                    b'<AccelerateConfiguration xmlns="http://s3.amazonaws'
-                    b'.com/doc/2006-03-01/"/>'),
-                "requestPayment": (
-                    b'<?xml version="1.0" encoding="UTF-8"?>'
-                    b'<RequestPaymentConfiguration xmlns="http://s3.'
-                    b'amazonaws.com/doc/2006-03-01/"><Payer>BucketOwner'
-                    b'</Payer></RequestPaymentConfiguration>'),
-                "logging": (
-                    b'<?xml version="1.0" encoding="UTF-8"?>'
-                    b'<BucketLoggingStatus xmlns="http://s3.amazonaws.com'
-                    b'/doc/2006-03-01/"></BucketLoggingStatus>'),
-                "website": None,     # GET -> NoSuchWebsiteConfiguration
-            }
-            for param, body in _DUMMY.items():
-                if param not in query:
-                    continue
-                self._allow(iampol.GET_BUCKET_POLICY, bucket)
-                exists()
-                if param == "website" and cmd == "DELETE":
-                    self._send(204)
-                elif cmd == "GET":
-                    if body is None:
-                        raise S3Error("NoSuchWebsiteConfiguration")
-                    self._send(200, body,
-                               content_type="application/xml")
-                else:
-                    raise S3Error("NotImplemented")
-                return True
-
-            if crud("policy", iampol.GET_BUCKET_POLICY,
-                    iampol.PUT_BUCKET_POLICY,
-                    lambda p: bpolicy.BucketPolicy.parse(p, bucket)
-                    .to_json().decode(),
-                    "NoSuchBucketPolicy", parse_err="MalformedPolicy"):
-                return True
-            if crud("lifecycle", iampol.GET_LIFECYCLE, iampol.PUT_LIFECYCLE,
-                    lambda p: lifecycle.Lifecycle.parse(p).to_xml().decode(),
-                    "NoSuchLifecycleConfiguration"):
-                return True
-            if crud("encryption", iampol.GET_BUCKET_ENCRYPTION,
-                    iampol.PUT_BUCKET_ENCRYPTION,
-                    lambda p: encryption.SSEConfig.parse(p)
-                    .to_xml().decode(),
-                    "ServerSideEncryptionConfigurationNotFoundError"):
-                return True
-            if "replication" in query and cmd == "PUT":
-                # destination ARN must name a registered remote target
-                self._allow(iampol.PUT_REPLICATION, bucket)
-                exists()
-                cfg = _try(lambda: replication.Config.parse(payload))
-                if not srv.bucket_meta.versioning_enabled(bucket):
-                    raise S3Error("InvalidRequest")
-                if srv.replication is not None:
-                    for r in cfg.rules:
-                        if not srv.replication.arn_exists(
-                                r.destination_arn):
-                            raise S3Error(
-                                "ReplicationDestinationNotFoundError")
-                srv.bucket_meta.set_config(bucket, "replication",
-                                           cfg.to_xml().decode())
-                return self._send(200) or True
-            if crud("replication", iampol.GET_REPLICATION,
-                    iampol.PUT_REPLICATION,
-                    lambda p: replication.Config.parse(p).to_xml().decode(),
-                    "ReplicationConfigurationNotFoundError"):
-                return True
-            if "notification" in query:
-                if cmd == "PUT":
-                    self._allow(iampol.PUT_BUCKET_NOTIFICATION, bucket)
-                    exists()
-                    cfg = _try(lambda: notification.Config.parse(
-                        payload, valid_arns=srv.events.valid_arns()))
-                    srv.bucket_meta.set_config(
-                        bucket, "notification",
-                        cfg.to_xml().decode() if cfg.targets else None)
-                    return self._send(200) or True
-                if cmd == "GET":
-                    self._allow(iampol.GET_BUCKET_NOTIFICATION, bucket)
-                    exists()
-                    raw = srv.bucket_meta.get_config(bucket, "notification")
-                    if raw is None:
-                        raw = notification.Config().to_xml().decode()
-                    return self._send(200, raw.encode()) or True
-                raise S3Error("MethodNotAllowed")
-            if "object-lock" in query:
-                if cmd == "PUT":
-                    self._allow(iampol.PUT_BUCKET_OBJECT_LOCK, bucket)
-                    exists()
-                    cfg = _try(lambda: objectlock.LockConfig.parse(payload))
-                    if srv.bucket_meta.get_config(bucket,
-                                                  "object-lock") is None:
-                        # can only be set at creation in S3; MinIO allows
-                        # updating the default rule iff lock was enabled
-                        raise S3Error(
-                            "InvalidBucketObjectLockConfiguration")
-                    srv.bucket_meta.set_config(bucket, "object-lock",
-                                               cfg.to_xml().decode())
-                    return self._send(200) or True
-                if cmd == "GET":
-                    self._allow(iampol.GET_BUCKET_OBJECT_LOCK, bucket)
-                    exists()
-                    raw = srv.bucket_meta.get_config(bucket, "object-lock")
-                    if raw is None:
-                        raise S3Error(
-                            "ObjectLockConfigurationNotFoundError")
-                    return self._send(200, raw.encode()) or True
-                raise S3Error("MethodNotAllowed")
-            if "tagging" in query:
-                if cmd == "PUT":
-                    self._allow(iampol.PUT_BUCKET_TAGGING, bucket)
-                    exists()
-                    t = _try(lambda: tags.parse_xml(payload,
-                                                    is_object=False))
-                    srv.bucket_meta.set_config(bucket, "tagging",
-                                               tags.to_xml(t).decode())
-                    return self._send(200) or True
-                if cmd == "GET":
-                    self._allow(iampol.GET_BUCKET_TAGGING, bucket)
-                    exists()
-                    raw = srv.bucket_meta.get_config(bucket, "tagging")
-                    if raw is None:
-                        raise S3Error("NoSuchTagSet")
-                    return self._send(200, raw.encode()) or True
-                if cmd == "DELETE":
-                    self._allow(iampol.PUT_BUCKET_TAGGING, bucket)
-                    exists()
-                    srv.bucket_meta.set_config(bucket, "tagging", None)
-                    return self._send(204) or True
-                raise S3Error("MethodNotAllowed")
-            if "quota" in query:  # admin-style; also exposed here
-                from ..bucket.quota import Quota
-                if cmd == "PUT":
-                    self._allow(iampol.ADMIN_ALL, bucket)
-                    exists()
-                    q = _try(lambda: Quota.parse(payload))
-                    srv.bucket_meta.set_config(bucket, "quota",
-                                               q.to_json().decode())
-                    return self._send(200) or True
-                if cmd == "GET":
-                    self._allow(iampol.ADMIN_ALL, bucket)
-                    exists()
-                    raw = srv.bucket_meta.get_config(bucket, "quota") \
-                        or '{"quota": 0, "quotatype": "hard"}'
-                    return self._send(200, raw.encode(),
-                                      content_type="application/json") \
-                        or True
-                raise S3Error("MethodNotAllowed")
-            if "acl" in query:
-                if cmd == "GET":
-                    self._allow(iampol.GET_BUCKET_ACL, bucket)
-                    exists()
-                    return self._send(200, _canned_acl_xml()) or True
-                if cmd == "PUT":
-                    # only the private canned ACL is accepted
-                    self._allow(iampol.PUT_BUCKET_ACL, bucket)
-                    exists()
-                    acl = self.headers.get("x-amz-acl", "private")
-                    if acl != "private" or (payload and
-                                            b"FULL_CONTROL" not in payload):
-                        raise S3Error("NotImplemented")
-                    return self._send(200) or True
-                raise S3Error("MethodNotAllowed")
-            if "cors" in query:
-                self._allow(iampol.GET_BUCKET_LOCATION, bucket)
-                exists()
-                if cmd == "GET":
-                    raise S3Error("NoSuchCORSConfiguration")
-                raise S3Error("NotImplemented")
-            return False
-
-        def _bucket_api(self, bucket, query, payload):
-            cmd = self.command
-            if self._config_api(bucket, query, payload):
-                return
-            if cmd == "PUT" and "versioning" in query:
-                self._allow(iampol.PUT_BUCKET_VERSIONING, bucket)
-                return self._put_versioning(bucket, payload)
-            if cmd == "GET" and "versioning" in query:
-                self._allow(iampol.GET_BUCKET_VERSIONING, bucket)
-                return self._get_versioning(bucket)
-            if cmd == "GET" and "location" in query:
-                self._allow(iampol.GET_BUCKET_LOCATION, bucket)
-                root = ET.Element("LocationConstraint", xmlns=S3_NS)
-                root.text = srv.region
-                srv.layer.get_bucket_info(bucket)
-                return self._send(200, _xml(root))
-            if cmd == "GET" and "versions" in query:
-                self._allow(iampol.LIST_BUCKET_VERSIONS, bucket)
-                return self._list_object_versions(bucket, query)
-            if cmd == "GET" and "events" in query:
-                self._allow(iampol.LISTEN_NOTIFICATION, bucket)
-                return self._listen_notification(bucket, query)
-            if cmd == "POST" and "delete" in query:
-                return self._delete_objects(bucket, payload)
-            if cmd == "POST" and (self.headers.get("Content-Type") or ""
-                                  ).startswith("multipart/form-data"):
-                return self._post_policy_upload(bucket, payload)
-            if cmd == "GET" and "uploads" in query:
-                self._allow(iampol.LIST_MULTIPART_UPLOADS, bucket)
-                return self._list_uploads(bucket, query)
-            if cmd == "PUT":
-                self._allow(iampol.CREATE_BUCKET, bucket)
-                fresh_rec = False
-                if srv.federation is not None:
-                    from ..utils.fed_dns import BucketTaken
-                    try:
-                        fresh_rec = srv.federation.register(bucket)
-                    except BucketTaken:
-                        raise S3Error("BucketAlreadyExists") from None
-                try:
-                    srv.layer.make_bucket(bucket)
-                except Exception:
-                    if srv.federation is not None and fresh_rec:
-                        srv.federation.unregister(bucket)
-                    raise
-                if self.headers.get("x-amz-bucket-object-lock-enabled",
-                                    "").lower() == "true":
-                    # lock implies versioning (cmd/bucket-handlers.go
-                    # PutBucketHandler: object-lock buckets are versioned)
-                    from ..bucket.objectlock import LockConfig
-                    srv.bucket_meta.set_versioning(bucket, True)
-                    srv.bucket_meta.set_config(
-                        bucket, "object-lock",
-                        LockConfig(enabled=True).to_xml().decode())
-                return self._send(200, headers={"Location": f"/{bucket}"})
-            if cmd == "HEAD":
-                self._allow(iampol.LIST_BUCKET, bucket)
-                srv.layer.get_bucket_info(bucket)
-                return self._send(200)
-            if cmd == "DELETE":
-                self._allow(iampol.DELETE_BUCKET, bucket)
-                srv.layer.delete_bucket(bucket)
-                srv.bucket_meta.drop(bucket)
-                if srv.federation is not None:
-                    srv.federation.unregister(bucket)
-                return self._send(204)
-            if cmd == "GET":
-                self._allow(iampol.LIST_BUCKET, bucket)
-                return self._list_objects(bucket, query)
-            raise S3Error("MethodNotAllowed")
-
-        def _post_policy_upload(self, bucket, payload):
-            """Browser POST upload (cmd/object-handlers.go
-            PostPolicyBucketHandler): authenticate via the policy
-            signature in the form, validate conditions, store the file
-            field as the object."""
-            from . import postpolicy
-            try:
-                fields, file_data, filename = postpolicy.parse_form(
-                    payload, self.headers.get("Content-Type", ""))
-                key = fields.get("key", "")
-                if not key:
-                    raise S3Error("InvalidArgument")
-                key = key.replace("${filename}", filename)
-                self.access_key = postpolicy.verify_signature(
-                    srv.iam.lookup_secret, fields, srv.region)
-                postpolicy.check_policy(
-                    fields.get("policy", ""),
-                    {**fields, "key": key, "bucket": bucket},
-                    len(file_data))
-            except sigv4.SigV4Error as e:
-                raise S3Error(e.code if s3err.has(e.code)
-                              else "AccessDenied") from e
-            self._allow(iampol.PUT_OBJECT, f"{bucket}/{key}")
-            if len(file_data) > MAX_OBJECT_SIZE:
-                raise S3Error("EntityTooLarge")
-            user_defined = {}
-            if fields.get("content-type"):
-                user_defined["content-type"] = fields["content-type"]
-            for k, v in fields.items():
-                if k.startswith("x-amz-meta-"):
-                    user_defined[k] = v
-            if fields.get("tagging"):
-                from ..bucket import tags as btags
-                try:
-                    user_defined["x-amz-tagging"] = btags.to_header(
-                        btags.parse_xml(fields["tagging"].encode()))
-                except btags.TagError as e:
-                    raise S3Error("InvalidTag") from e
-            oi, hdrs = self._store_object(bucket, key, file_data,
-                                          user_defined,
-                                          "s3:ObjectCreated:Post")
-            hdrs["Location"] = f"/{bucket}/{urllib.parse.quote(key)}"
-            redirect = fields.get("success_action_redirect", "")
-            if redirect:
-                sep = "&" if "?" in redirect else "?"
-                hdrs["Location"] = redirect + sep + urllib.parse.urlencode(
-                    {"bucket": bucket, "key": key, "etag": f'"{oi.etag}"'})
-                return self._send(303, headers=hdrs)
-            status = fields.get("success_action_status", "204")
-            if status == "201":
-                root = ET.Element("PostResponse")
-                ET.SubElement(root, "Location").text = hdrs["Location"]
-                ET.SubElement(root, "Bucket").text = bucket
-                ET.SubElement(root, "Key").text = key
-                ET.SubElement(root, "ETag").text = hdrs["ETag"]
-                return self._send(201, _xml(root), headers=hdrs)
-            return self._send(200 if status == "200" else 204,
-                              headers=hdrs)
-
-        def _put_versioning(self, bucket, payload):
-            srv.layer.get_bucket_info(bucket)
-            try:
-                root = ET.fromstring(payload)
-                status = root.findtext(f"{{{S3_NS}}}Status") or \
-                    root.findtext("Status") or ""
-            except ET.ParseError as e:
-                raise S3Error("MalformedXML") from e
-            if status != "Enabled" and \
-                    srv.bucket_meta.get_config(bucket,
-                                               "object-lock") is not None:
-                # object-lock buckets must stay versioned (AWS
-                # InvalidBucketState)
-                raise S3Error("InvalidBucketState")
-            srv.bucket_meta.set_versioning(bucket, status == "Enabled")
-            self._send(200)
-
-        def _get_versioning(self, bucket):
-            srv.layer.get_bucket_info(bucket)
-            root = ET.Element("VersioningConfiguration", xmlns=S3_NS)
-            doc = srv.bucket_meta.get(bucket).get("versioning")
-            if doc:
-                ET.SubElement(root, "Status").text = doc["status"]
-            self._send(200, _xml(root))
-
-        def _listen_notification(self, bucket, query):
-            """Live event stream (cmd/listen-notification-handlers.go):
-            newline-delimited JSON records, chunked; filters by prefix/
-            suffix/event-name glob.  `timeout` bounds the stream so HTTP
-            clients without explicit cancel (and tests) can use it."""
-            import json as _json
-
-            from ..bucket.notification import match_pattern
-            srv.layer.get_bucket_info(bucket)
-            q1 = {k: v[0] for k, v in query.items()}
-            prefix = q1.get("prefix", "")
-            suffix = q1.get("suffix", "")
-            names = query.get("events", []) or ["*"]
-            try:
-                timeout = min(float(q1.get("timeout", 10) or 10), 300.0)
-                max_events = int(q1.get("max-events", 1000) or 1000)
-            except ValueError as e:
-                raise S3Error("InvalidArgument") from e
-
-            def want(item):
-                if item["bucket"] != bucket:
-                    return False
-                key = item["key"]
-                if prefix and not key.startswith(prefix):
-                    return False
-                if suffix and not key.endswith(suffix):
-                    return False
-                return any(n == "*" or match_pattern(n, item["name"])
-                           for n in names)
-
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Transfer-Encoding", "chunked")
-            self.end_headers()
-
-            def write_chunk(data: bytes):
-                self.wfile.write(f"{len(data):x}\r\n".encode())
-                self.wfile.write(data + b"\r\n")
-                self.wfile.flush()
-
-            with srv.events.pubsub.subscribe(want) as sub:
-                try:
-                    for item in sub.drain(max_events, timeout):
-                        line = _json.dumps(
-                            {"Records": [item["record"]]}).encode() + b"\n"
-                        write_chunk(line)
-                except (BrokenPipeError, ConnectionResetError):
-                    pass
-                try:
-                    self.wfile.write(b"0\r\n\r\n")
-                except (BrokenPipeError, ConnectionResetError):
-                    pass
-
-        def _encoding_type(self, q1):
-            """encoding-type handling shared by every listing API:
-            returns (escape_fn, enabled).  Keys may contain characters
-            XML 1.0 cannot carry; url encoding (the awscli/boto3
-            default) percent-encodes them in responses."""
-            enc = q1.get("encoding-type", "")
-            if enc and enc != "url":
-                raise S3Error("InvalidArgument")
-            if enc:
-                return (lambda s: urllib.parse.quote(s or "", safe="/"),
-                        True)
-            return (lambda s: s), False
-
-        def _list_objects(self, bucket, query):
-            q1 = {k: v[0] for k, v in query.items()}
-            v2 = q1.get("list-type") == "2"
-            prefix = q1.get("prefix", "")
-            delimiter = q1.get("delimiter", "")
-            max_keys = min(int(q1.get("max-keys", 1000) or 1000), 1000)
-            marker = q1.get("continuation-token" if v2 else "marker", "") \
-                or q1.get("start-after", "")
-            esc, enc = self._encoding_type(q1)
-            res = srv.layer.list_objects(bucket, prefix, marker, delimiter,
-                                         max_keys)
-            name = "ListBucketResult"
-            root = ET.Element(name, xmlns=S3_NS)
-            ET.SubElement(root, "Name").text = bucket
-            ET.SubElement(root, "Prefix").text = esc(prefix)
-            if delimiter:
-                ET.SubElement(root, "Delimiter").text = esc(delimiter)
-            if enc:
-                ET.SubElement(root, "EncodingType").text = "url"
-            ET.SubElement(root, "MaxKeys").text = str(max_keys)
-            ET.SubElement(root, "IsTruncated").text = \
-                "true" if res.is_truncated else "false"
-            if v2:
-                ET.SubElement(root, "KeyCount").text = \
-                    str(len(res.objects) + len(res.prefixes))
-                if q1.get("continuation-token"):
-                    # tokens are OPAQUE to clients: AWS excludes them
-                    # from encoding-type, and clients echo them verbatim
-                    # — encoding here would corrupt pagination
-                    ET.SubElement(root, "ContinuationToken").text = \
-                        q1["continuation-token"]
-                if q1.get("start-after"):
-                    ET.SubElement(root, "StartAfter").text = \
-                        esc(q1["start-after"])
-                if res.is_truncated:
-                    ET.SubElement(root, "NextContinuationToken").text = \
-                        res.next_marker
-            else:
-                ET.SubElement(root, "Marker").text = esc(marker)
-                if res.is_truncated:
-                    ET.SubElement(root, "NextMarker").text = \
-                        esc(res.next_marker)
-            fetch_owner = (not v2) or q1.get("fetch-owner") == "true"
-            for o in res.objects:
-                c = ET.SubElement(root, "Contents")
-                ET.SubElement(c, "Key").text = esc(o.name)
-                ET.SubElement(c, "LastModified").text = _iso_date(o.mod_time)
-                ET.SubElement(c, "ETag").text = f'"{o.etag}"'
-                ET.SubElement(c, "Size").text = str(_actual_size(o))
-                ET.SubElement(c, "StorageClass").text = \
-                    o.user_defined.get("x-amz-storage-class", "STANDARD")
-                if fetch_owner:
-                    owner = ET.SubElement(c, "Owner")
-                    ET.SubElement(owner, "ID").text = "minio-tpu"
-                    ET.SubElement(owner, "DisplayName").text = "minio-tpu"
-            for p in res.prefixes:
-                cp = ET.SubElement(root, "CommonPrefixes")
-                ET.SubElement(cp, "Prefix").text = esc(p)
-            self._send(200, _xml(root))
-
-        def _list_object_versions(self, bucket, query):
-            q1 = {k: v[0] for k, v in query.items()}
-            prefix = q1.get("prefix", "")
-            esc, enc = self._encoding_type(q1)
-            versions = srv.layer.list_object_versions(bucket, prefix)
-            root = ET.Element("ListVersionsResult", xmlns=S3_NS)
-            ET.SubElement(root, "Name").text = bucket
-            ET.SubElement(root, "Prefix").text = esc(prefix)
-            if enc:
-                ET.SubElement(root, "EncodingType").text = "url"
-            ET.SubElement(root, "IsTruncated").text = "false"
-            for o in versions:
-                tag = "DeleteMarker" if o.delete_marker else "Version"
-                v = ET.SubElement(root, tag)
-                ET.SubElement(v, "Key").text = esc(o.name)
-                ET.SubElement(v, "VersionId").text = o.version_id or "null"
-                ET.SubElement(v, "IsLatest").text = \
-                    "true" if o.is_latest else "false"
-                ET.SubElement(v, "LastModified").text = _iso_date(o.mod_time)
-                if not o.delete_marker:
-                    ET.SubElement(v, "ETag").text = f'"{o.etag}"'
-                    ET.SubElement(v, "Size").text = str(_actual_size(o))
-                    ET.SubElement(v, "StorageClass").text = "STANDARD"
-            self._send(200, _xml(root))
-
-        def _list_uploads(self, bucket, query):
-            q1 = {k: v[0] for k, v in query.items()}
-            esc, enc = self._encoding_type(q1)
-            uploads = srv.layer.list_multipart_uploads(
-                bucket, q1.get("prefix", ""))
-            root = ET.Element("ListMultipartUploadsResult", xmlns=S3_NS)
-            ET.SubElement(root, "Bucket").text = bucket
-            if enc:
-                ET.SubElement(root, "EncodingType").text = "url"
-            ET.SubElement(root, "IsTruncated").text = "false"
-            for u in uploads:
-                ue = ET.SubElement(root, "Upload")
-                ET.SubElement(ue, "Key").text = esc(u.object_name)
-                ET.SubElement(ue, "UploadId").text = u.upload_id
-            self._send(200, _xml(root))
-
-        def _delete_objects(self, bucket, payload):
-            try:
-                root = ET.fromstring(payload)
-            except ET.ParseError as e:
-                raise S3Error("MalformedXML") from e
-            ns = f"{{{S3_NS}}}"
-            quiet = (root.findtext(f"{ns}Quiet") or
-                     root.findtext("Quiet") or "") == "true"
-            out = ET.Element("DeleteResult", xmlns=S3_NS)
-            versioned = srv.bucket_meta.versioning_enabled(bucket)
-            for obj in (root.findall(f"{ns}Object") +
-                        root.findall("Object")):
-                key = obj.findtext(f"{ns}Key") or obj.findtext("Key")
-                vid = obj.findtext(f"{ns}VersionId") or \
-                    obj.findtext("VersionId")
-                try:
-                    self._allow(iampol.DELETE_OBJECT, f"{bucket}/{key}")
-                    self._check_retention(bucket, key, vid)
-                    tiered_ud = self._tiered_meta_of(bucket, key, vid,
-                                                     versioned)
-                    res = srv.layer.delete_object(
-                        bucket, key,
-                        ol.ObjectOptions(version_id=vid,
-                                         versioned=versioned))
-                    if tiered_ud is not None:
-                        srv.transition.delete_tiered(tiered_ud)
-                    if not quiet:
-                        d = ET.SubElement(out, "Deleted")
-                        ET.SubElement(d, "Key").text = key
-                        if res.delete_marker:
-                            ET.SubElement(d, "DeleteMarker").text = "true"
-                            ET.SubElement(d,
-                                          "DeleteMarkerVersionId").text = \
-                                res.version_id
-                except Exception as e:  # noqa: BLE001
-                    if isinstance(e, S3Error):
-                        api = e.api
-                    elif isinstance(e, ol.ObjectLayerError):
-                        api = s3err.from_object_error(e)
-                    else:
-                        api = s3err.get("InternalError")
-                    err = ET.SubElement(out, "Error")
-                    ET.SubElement(err, "Key").text = key
-                    ET.SubElement(err, "Code").text = api.code
-                    ET.SubElement(err, "Message").text = api.description
-            self._send(200, _xml(out))
-
-        # -- object APIs ---------------------------------------------------
-
-        def _object_api(self, bucket, key, query, payload):
-            cmd = self.command
-            resource = f"{bucket}/{key}"
-            if "tagging" in query:
-                return self._object_tagging(bucket, key, query, payload)
-            if "retention" in query:
-                return self._object_retention(bucket, key, query, payload)
-            if "legal-hold" in query:
-                return self._object_legal_hold(bucket, key, query, payload)
-            if "acl" in query:
-                if cmd == "GET":
-                    self._allow(iampol.GET_OBJECT_ACL, resource)
-                    srv.layer.get_object_info(bucket, key)
-                    return self._send(200, _canned_acl_xml())
-                if cmd == "PUT":
-                    self._allow(iampol.PUT_OBJECT_ACL, resource)
-                    if self.headers.get("x-amz-acl", "private") != "private":
-                        raise S3Error("NotImplemented")
-                    return self._send(200)
-                raise S3Error("MethodNotAllowed")
-            if cmd == "POST" and "select" in query and \
-                    query.get("select-type") == ["2"]:
-                self._allow(iampol.GET_OBJECT, resource)
-                return self._select_object(bucket, key, payload)
-            if cmd == "POST" and "uploads" in query:
-                self._allow(iampol.PUT_OBJECT, resource)
-                return self._create_multipart(bucket, key)
-            if cmd == "POST" and "uploadId" in query:
-                self._allow(iampol.PUT_OBJECT, resource)
-                return self._complete_multipart(bucket, key, query, payload)
-            if cmd == "PUT" and "uploadId" in query and \
-                    "x-amz-copy-source" in self.headers:
-                self._allow(iampol.PUT_OBJECT, resource)
-                return self._upload_part_copy(bucket, key, query)
-            if cmd == "PUT" and "uploadId" in query:
-                self._allow(iampol.PUT_OBJECT, resource)
-                return self._upload_part(bucket, key, query, payload)
-            if cmd == "PUT" and "x-amz-copy-source" in self.headers:
-                self._allow(iampol.PUT_OBJECT, resource)
-                return self._copy_object(bucket, key, query)
-            if cmd == "DELETE" and "uploadId" in query:
-                self._allow(iampol.ABORT_MULTIPART, resource)
-                srv.layer.abort_multipart_upload(bucket, key,
-                                                 query["uploadId"][0])
-                return self._send(204)
-            if cmd == "GET" and "uploadId" in query:
-                self._allow(iampol.LIST_PARTS, resource)
-                return self._list_parts(bucket, key, query)
-            if cmd == "POST" and "restore" in query:
-                self._allow("s3:RestoreObject", resource)
-                return self._restore_object(bucket, key, query, payload)
-            if cmd == "PUT":
-                self._allow(iampol.PUT_OBJECT, resource)
-                return self._put_object(bucket, key, query, payload)
-            if cmd in ("GET", "HEAD"):
-                self._allow(
-                    iampol.GET_OBJECT_VERSION if query.get("versionId")
-                    else iampol.GET_OBJECT, resource)
-                return self._get_object(bucket, key, query,
-                                        head=(cmd == "HEAD"))
-            if cmd == "DELETE":
-                self._allow(
-                    iampol.DELETE_OBJECT_VERSION if query.get("versionId")
-                    else iampol.DELETE_OBJECT, resource)
-                return self._delete_object(bucket, key, query)
-            raise S3Error("MethodNotAllowed")
-
-        # -- object subresources (tagging/retention/legal-hold) ------------
-
-        TAG_KEY = "x-amz-tagging"  # metadata key holding url-encoded tags
-
-        def _vid(self, query) -> str | None:
-            vid = query.get("versionId", [None])[0]
-            return "" if vid == "null" else vid
-
-        def _object_tagging(self, bucket, key, query, payload):
-            from ..bucket import tags as btags
-            resource = f"{bucket}/{key}"
-            vid = self._vid(query)
-            if self.command == "PUT":
-                self._allow(iampol.PUT_OBJECT_TAGGING, resource)
-                t = _try(lambda: btags.parse_xml(payload))
-                oi = srv.layer.put_object_metadata(
-                    bucket, key, vid, {self.TAG_KEY: btags.to_header(t)})
-                srv.notify("s3:ObjectCreated:PutTagging", bucket, oi)
-                return self._send(200)
-            if self.command == "GET":
-                self._allow(iampol.GET_OBJECT_TAGGING, resource)
-                oi = srv.layer.get_object_info(
-                    bucket, key, ol.ObjectOptions(version_id=vid))
-                t = btags.parse_header(
-                    oi.user_defined.get(self.TAG_KEY, ""))
-                return self._send(200, btags.to_xml(t))
-            if self.command == "DELETE":
-                self._allow(iampol.DELETE_OBJECT_TAGGING, resource)
-                oi = srv.layer.put_object_metadata(
-                    bucket, key, vid, {}, removes=(self.TAG_KEY,))
-                srv.notify("s3:ObjectCreated:DeleteTagging", bucket, oi)
-                return self._send(204)
-            raise S3Error("MethodNotAllowed")
-
-        def _object_retention(self, bucket, key, query, payload):
-            from ..bucket import objectlock as olock
-            resource = f"{bucket}/{key}"
-            vid = self._vid(query)
-            if self.command == "PUT":
-                self._allow(iampol.PUT_OBJECT_RETENTION, resource)
-                if srv.bucket_meta.get_config(bucket, "object-lock") is None:
-                    raise S3Error("InvalidRequest")
-                ret = _try(lambda: olock.Retention.parse(payload))
-                # tightening is always allowed; loosening COMPLIANCE is not
-                oi = srv.layer.get_object_info(
-                    bucket, key, ol.ObjectOptions(version_id=vid))
-                cur = olock.Retention.from_metadata(oi.user_defined)
-                if cur.active() and cur.mode == olock.COMPLIANCE and (
-                        ret.retain_until < cur.retain_until or
-                        ret.mode != olock.COMPLIANCE):
-                    raise S3Error("ObjectLocked")
-                if cur.active() and cur.mode == olock.GOVERNANCE and \
-                        not self._governance_bypass(resource):
-                    if ret.retain_until < cur.retain_until or \
-                            ret.mode != cur.mode:
-                        raise S3Error("ObjectLocked")
-                oi = srv.layer.put_object_metadata(bucket, key, vid, {
-                    olock.AMZ_OBJECT_LOCK_MODE: ret.mode,
-                    olock.AMZ_OBJECT_LOCK_RETAIN_UNTIL:
-                        ret.retain_until.astimezone(
-                            datetime.timezone.utc).strftime(
-                                "%Y-%m-%dT%H:%M:%SZ"),
-                })
-                srv.notify("s3:ObjectCreated:PutRetention", bucket, oi)
-                return self._send(200)
-            if self.command == "GET":
-                self._allow(iampol.GET_OBJECT_RETENTION, resource)
-                oi = srv.layer.get_object_info(
-                    bucket, key, ol.ObjectOptions(version_id=vid))
-                ret = olock.Retention.from_metadata(oi.user_defined)
-                if not ret.mode:
-                    raise S3Error("NoSuchObjectLockConfiguration")
-                return self._send(200, ret.to_xml())
-            raise S3Error("MethodNotAllowed")
-
-        def _object_legal_hold(self, bucket, key, query, payload):
-            from ..bucket import objectlock as olock
-            resource = f"{bucket}/{key}"
-            vid = self._vid(query)
-            if self.command == "PUT":
-                self._allow(iampol.PUT_OBJECT_LEGAL_HOLD, resource)
-                if srv.bucket_meta.get_config(bucket, "object-lock") is None:
-                    raise S3Error("InvalidRequest")
-                status = _try(lambda: olock.legal_hold_from_xml(payload))
-                oi = srv.layer.put_object_metadata(
-                    bucket, key, vid,
-                    {olock.AMZ_OBJECT_LOCK_LEGAL_HOLD: status})
-                srv.notify("s3:ObjectCreated:PutLegalHold", bucket, oi)
-                return self._send(200)
-            if self.command == "GET":
-                self._allow(iampol.GET_OBJECT_LEGAL_HOLD, resource)
-                oi = srv.layer.get_object_info(
-                    bucket, key, ol.ObjectOptions(version_id=vid))
-                status = oi.user_defined.get(
-                    olock.AMZ_OBJECT_LOCK_LEGAL_HOLD, "OFF")
-                return self._send(200, olock.legal_hold_to_xml(status))
-            raise S3Error("MethodNotAllowed")
-
-        def _governance_bypass(self, resource: str) -> bool:
-            if self.headers.get("x-amz-bypass-governance-retention",
-                                "").lower() != "true":
-                return False
-            try:
-                self._allow(iampol.BYPASS_GOVERNANCE, resource)
-                return True
-            except S3Error:
-                return False
-
-        def _select_object(self, bucket, key, payload):
-            from . import select as s3select
-            _, data = self._fetch_plain(bucket, key)
-            try:
-                out = s3select.run(payload, data)
-            except s3select.SelectError as e:
-                raise S3Error(e.code) from e
-            self._send(200, out,
-                       content_type="application/octet-stream")
-
-        def _fetch_plain(self, bucket, key):
-            """Full object bytes after decryption (honoring SSE-C request
-            headers) and decompression — the decoded-object fetch shared
-            by Select and other whole-object consumers."""
-            from .. import compress as mtc
-            from ..crypto import sse as csse
-            oi = srv.layer.get_object_info(bucket, key)
-            if csse.is_encrypted(oi.user_defined):
-                enc = csse.ObjectEncryption.open(
-                    oi.user_defined, bucket, key, self.headers, srv.kms)
-                data = csse.decrypt_object_range(
-                    enc, oi.user_defined, oi.size,
-                    lambda o, n: srv.layer.get_object(
-                        bucket, key, o, n)[1], 0, -1, oi.parts)
-            else:
-                _, data = srv.layer.get_object(bucket, key)
-            if mtc.META_COMPRESSION in oi.user_defined:
-                data = mtc.decompress_stream(data)
-            return oi, data
-
-        def _check_quota(self, bucket: str, nbytes: int) -> None:
-            """Hard-quota admission (cmd/bucket-quota.go); needs the
-            crawler's usage cache to be attached."""
-            if srv.usage is None:
-                return
-            from ..bucket.quota import Quota
-            raw = srv.bucket_meta.get_config(bucket, "quota")
-            if raw and not Quota.parse(raw.encode()).allows(
-                    srv.usage.bucket_size(bucket), nbytes):
-                raise S3Error("AdminBucketQuotaExceeded")
-
-        # -- SSE helpers (cmd/encryption-v1.go) ----------------------------
-
-        def _bucket_sse_algo(self, bucket: str) -> str:
-            """Bucket default-encryption algorithm, '' when unset."""
-            from ..bucket.encryption import SSEConfig
-            raw = srv.bucket_meta.get_config(bucket, "encryption")
-            if not raw:
-                return ""
-            try:
-                return SSEConfig.parse(raw.encode()).algorithm
-            except ValueError:
-                return ""
-
-        def _sse_for_put(self, bucket: str, key: str,
-                         user_defined: dict) -> "object | None":
-            """EncryptRequest analog: decide whether this PUT is SSE and
-            mint the sealed object key into user_defined."""
-            from ..crypto import sse as csse
-            kind = csse.requested_sse(self.headers,
-                                      self._bucket_sse_algo(bucket))
-            if not kind:
-                return None
-            enc = csse.ObjectEncryption.new(kind, bucket, key,
-                                            self.headers, srv.kms)
-            user_defined.update(enc.meta)
-            return enc
-
-        def _compress_for_put(self, key: str, user_defined: dict,
-                              payload: bytes) -> bytes:
-            """Transparent compression (newS2CompressReader analog):
-            applied BEFORE encryption, recorded via internal metadata with
-            the original size for listings/HEAD."""
-            from .. import compress as mtc
-            from ..crypto import sse as csse
-            if srv.config.get("compression", "enable") != "on":
-                return payload
-            exts = [e for e in srv.config.get(
-                "compression", "extensions").split(",") if e]
-            types = [t for t in srv.config.get(
-                "compression", "mime_types").split(",") if t]
-            ct = user_defined.get("content-type", "")
-            if not mtc.is_compressible(key, ct, len(payload), exts, types):
-                return payload
-            user_defined[mtc.META_COMPRESSION] = mtc.COMPRESSION_ALGO
-            user_defined[csse.META_ACTUAL_SIZE] = str(len(payload))
-            return mtc.compress_stream(payload)
-
-        def _tagging_header_meta(self) -> dict[str, str]:
-            """Validated x-amz-tagging header as metadata entries."""
-            tag_hdr = self.headers.get("x-amz-tagging")
-            if not tag_hdr:
-                return {}
-            from ..bucket import tags as btags
-            _try(lambda: btags.parse_header(tag_hdr))
-            return {self.TAG_KEY: tag_hdr}
-
-        def _create_multipart(self, bucket, key):
-            user_defined = {}
-            ct = self.headers.get("Content-Type")
-            if ct:
-                user_defined["content-type"] = ct
-            for h, v in self.headers.items():
-                if h.lower().startswith("x-amz-meta-"):
-                    user_defined[h.lower()] = v
-            # same admission rules as PutObject: tagging header + object
-            # lock defaults (a multipart upload must not dodge WORM)
-            user_defined.update(self._tagging_header_meta())
-            user_defined.update(self._lock_headers(bucket, key))
-            from ..crypto import sse as csse
-            self._sse_for_put(bucket, key, user_defined)
-            versioned = srv.bucket_meta.versioning_enabled(bucket)
-            uid = srv.layer.new_multipart_upload(
-                bucket, key, ol.PutObjectOptions(
-                    user_defined=user_defined, versioned=versioned,
-                    parity=self._storage_class_parity(user_defined)))
-            root = ET.Element("InitiateMultipartUploadResult", xmlns=S3_NS)
-            ET.SubElement(root, "Bucket").text = bucket
-            ET.SubElement(root, "Key").text = key
-            ET.SubElement(root, "UploadId").text = uid
-            self._send(200, _xml(root),
-                       headers=csse.response_headers(user_defined))
-
-        def _upload_part(self, bucket, key, query, payload):
-            uid = query["uploadId"][0]
-            try:
-                part_num = int(query["partNumber"][0])
-            except (KeyError, ValueError) as e:
-                raise S3Error("InvalidArgument") from e
-            self._check_quota(bucket, len(payload))
-            payload, sse_hdrs = self._encrypt_part(bucket, key, uid,
-                                                   payload)
-            pi = srv.layer.put_object_part(bucket, key, uid, part_num,
-                                           payload)
-            self._send(200, headers={"ETag": f'"{pi.etag}"', **sse_hdrs})
-
-        def _encrypt_part(self, bucket, key, uid,
-                          payload) -> tuple[bytes, dict]:
-            """Encrypt one part under the upload's sealed OEK as its own
-            DARE stream (SSE-C requires the key headers on every part)."""
-            from ..crypto import sse as csse
-            mp = srv.layer.get_multipart_info(bucket, key, uid)
-            if not csse.is_encrypted(mp.user_defined):
-                return payload, {}
-            enc = csse.ObjectEncryption.open(mp.user_defined, bucket, key,
-                                             self.headers, srv.kms)
-            return enc.encrypt(payload), \
-                csse.response_headers(mp.user_defined)
-
-        def _complete_multipart(self, bucket, key, query, payload):
-            uid = query["uploadId"][0]
-            try:
-                root = ET.fromstring(payload)
-            except ET.ParseError as e:
-                raise S3Error("MalformedXML") from e
-            ns = f"{{{S3_NS}}}"
-            parts = []
-            for p in root.findall(f"{ns}Part") + root.findall("Part"):
-                num = p.findtext(f"{ns}PartNumber") or \
-                    p.findtext("PartNumber")
-                etag = p.findtext(f"{ns}ETag") or p.findtext("ETag") or ""
-                if num is None or not num.isdigit():
-                    raise S3Error("MalformedXML")
-                parts.append((int(num), etag.strip('"')))
-            # SSE needs no extra bookkeeping here: the part table committed
-            # atomically with xl.meta carries per-part ciphertext sizes
-            # (each part is its own DARE stream; ObjectInfo.parts)
-            oi = srv.layer.complete_multipart_upload(bucket, key, uid, parts)
-            out = ET.Element("CompleteMultipartUploadResult", xmlns=S3_NS)
-            ET.SubElement(out, "Location").text = \
-                f"{srv.endpoint}/{bucket}/{key}"
-            ET.SubElement(out, "Bucket").text = bucket
-            ET.SubElement(out, "Key").text = key
-            ET.SubElement(out, "ETag").text = f'"{oi.etag}"'
-            hdrs = {}
-            if oi.version_id:
-                hdrs["x-amz-version-id"] = oi.version_id
-            srv.notify("s3:ObjectCreated:CompleteMultipartUpload", bucket,
-                       oi)
-            srv.replicate(bucket, oi)
-            self._send(200, _xml(out), headers=hdrs)
-
-        def _list_parts(self, bucket, key, query):
-            uid = query["uploadId"][0]
-            parts = srv.layer.list_object_parts(bucket, key, uid)
-            root = ET.Element("ListPartsResult", xmlns=S3_NS)
-            ET.SubElement(root, "Bucket").text = bucket
-            ET.SubElement(root, "Key").text = key
-            ET.SubElement(root, "UploadId").text = uid
-            ET.SubElement(root, "IsTruncated").text = "false"
-            for p in parts:
-                pe = ET.SubElement(root, "Part")
-                ET.SubElement(pe, "PartNumber").text = str(p.part_number)
-                ET.SubElement(pe, "ETag").text = f'"{p.etag}"'
-                ET.SubElement(pe, "Size").text = str(p.size)
-            self._send(200, _xml(root))
-
-        # -- streaming PUT (cmd/erasure-encode.go block pipeline over the
-        # socket: body is never buffered; 5 GiB single PUT works in
-        # O(batch) memory) ------------------------------------------------
-
-        def _try_stream_put(self, path, bucket, key, query) -> bool:
-            """Route large plain object PUTs / part uploads through the
-            streaming pipeline.  Returns True when the request was fully
-            handled (success or error); False falls back to the buffered
-            path WITHOUT having consumed any body bytes."""
-            if self.command != "PUT" or not bucket or not key:
-                return False
-            if path.startswith("/minio-tpu/") or bucket == "minio-tpu" \
-                    or not _BUCKET_RE.match(bucket):
-                return False
-            if any(q in query for q in ("tagging", "retention",
-                                        "legal-hold", "acl")):
-                return False
-            if "x-amz-copy-source" in self.headers:
-                return False
-            cl_hdr = self.headers.get("Content-Length")
-            if cl_hdr is None:
-                return False
-            try:
-                cl = int(cl_hdr)
-            except ValueError:
-                return False
-            if cl <= STREAM_PUT_THRESHOLD:
-                return False
-            try:
-                if cl > MAX_PUT_SIZE:
-                    raise S3Error("EntityTooLarge")
-                # only layers with a REAL streaming override may take
-                # this route — the ObjectLayer default would buffer the
-                # whole body, bypassing max_body_size
-                if type(srv.layer).put_object_stream \
-                        is ol.ObjectLayer.put_object_stream:
-                    if cl > srv.max_body_size:
-                        raise S3Error("EntityTooLarge")
-                    return False
-                # SSE and transparent compression transform the body and
-                # are not streamed yet: those bodies take the buffered
-                # path (bounded by max_body_size)
-                from ..crypto import sse as csse
-                if "uploadId" in query:
-                    try:
-                        mp = srv.layer.get_multipart_info(
-                            bucket, key, query["uploadId"][0])
-                        transforming = csse.is_encrypted(mp.user_defined)
-                    except Exception:  # noqa: BLE001 — invalid upload id
-                        return False   # buffered path raises it properly
-                else:
-                    transforming = bool(csse.requested_sse(
-                        self.headers, self._bucket_sse_algo(bucket))) \
-                        or self._compression_eligible(key, cl)
-                if transforming:
-                    if cl > srv.max_body_size:
-                        raise S3Error("EntityTooLarge")
-                    return False
-            except S3Error as e:
-                self._fail(e, path)
-                self.close_connection = True
-                return True
-            # committed to streaming from here: any failure must be
-            # answered in-line and the (half-read) connection dropped
-            try:
-                reader = self._auth_stream(path, query)
-                self._rx_bytes = cl
-                from ..admin.metrics import GLOBAL as mtr
-                mtr.inc("mt_s3_rx_bytes_total", value=cl)
-                if "uploadId" in query:
-                    self._stream_upload_part(bucket, key, query, reader,
-                                             cl)
-                else:
-                    self._stream_put_object(bucket, key, reader, cl)
-            except Exception as e:  # noqa: BLE001 — XML like dispatch
-                self._fail(e, path)
-                self.close_connection = True
-            return True
-
-        def _compression_eligible(self, key: str, size: int) -> bool:
-            from .. import compress as mtc
-            if srv.config.get("compression", "enable") != "on":
-                return False
-            exts = [e for e in srv.config.get(
-                "compression", "extensions").split(",") if e]
-            types = [t for t in srv.config.get(
-                "compression", "mime_types").split(",") if t]
-            ct = self.headers.get("Content-Type", "")
-            return mtc.is_compressible(key, ct, size, exts, types)
-
-        def _auth_stream(self, path, query):
-            """Authenticate a PUT without buffering its body; returns the
-            verified body reader (signature first, digests checked at
-            EOF before the object layer commits)."""
-            self._query_token = query.get("X-Amz-Security-Token", [""])[0]
-            cl = int(self.headers["Content-Length"])
-            hdrs = {k: v for k, v in self.headers.items()}
-            lookup = srv.iam.lookup_secret
-            md5_hdr = self.headers.get("Content-MD5")
-            want_md5 = None
-            if md5_hdr:
-                import base64
-                try:
-                    want_md5 = base64.b64decode(md5_hdr)
-                except Exception as e:
-                    raise S3Error("InvalidDigest") from e
-            sha = self.headers.get("x-amz-content-sha256")
-            try:
-                if "Authorization" not in hdrs and \
-                        "X-Amz-Signature" not in query and \
-                        not ("Signature" in query and
-                             "AWSAccessKeyId" in query):
-                    self.access_key = ""
-                    body = _BodyReader(
-                        self.rfile, cl,
-                        sha256_hex=(sha if sha and
-                                    sha != sigv4.UNSIGNED_PAYLOAD
-                                    else None),
-                        md5_digest=want_md5)
-                elif hdrs.get("Authorization", "").startswith("AWS "):
-                    from . import sigv2
-                    self.access_key = sigv2.verify_request(
-                        lookup, self.command, path, query, hdrs)
-                    body = _BodyReader(self.rfile, cl,
-                                       md5_digest=want_md5)
-                elif "Signature" in query and "AWSAccessKeyId" in query:
-                    from . import sigv2
-                    self.access_key = sigv2.verify_presigned(
-                        lookup, self.command, path, query, hdrs)
-                    body = _BodyReader(self.rfile, cl,
-                                       md5_digest=want_md5)
-                elif "X-Amz-Signature" in query:
-                    self.access_key = sigv4.verify_presigned(
-                        lookup, self.command, path, query, hdrs,
-                        region=srv.region)
-                    body = _BodyReader(self.rfile, cl,
-                                       md5_digest=want_md5)
-                elif sha == sigv4.STREAMING_PAYLOAD:
-                    self.access_key, key, seed, amz_date, scope = \
-                        sigv4.verify_request_streaming(
-                            lookup, self.command, path, query, hdrs,
-                            region=srv.region)
-                    framed = _BodyReader(self.rfile, cl)
-                    body = sigv4.ChunkedStreamReader(framed, key, seed,
-                                                     amz_date, scope)
-                    if want_md5 is not None:
-                        body = _MD5Reader(body, want_md5)
-                else:
-                    sha_eff = sha or sigv4.UNSIGNED_PAYLOAD
-                    self.access_key = sigv4.verify_request(
-                        lookup, self.command, path, query, hdrs, sha_eff,
-                        region=srv.region)
-                    body = _BodyReader(
-                        self.rfile, cl,
-                        sha256_hex=(sha_eff
-                                    if sha_eff != sigv4.UNSIGNED_PAYLOAD
-                                    else None),
-                        md5_digest=want_md5)
-            except sigv4.SigV4Error as e:
-                raise S3Error(e.code) from e
-            self._check_session_token()
-            return body
-
-        def _stream_put_object(self, bucket, key, reader, cl: int):
-            self._allow(iampol.PUT_OBJECT, f"{bucket}/{key}")
-            user_defined = {}
-            ct = self.headers.get("Content-Type")
-            if ct:
-                user_defined["content-type"] = ct
-            for h, v in self.headers.items():
-                if h.lower().startswith("x-amz-meta-"):
-                    user_defined[h.lower()] = v
-            user_defined.update(self._tagging_header_meta())
-            user_defined.update(self._lock_headers(bucket, key))
-            self._check_quota(bucket, cl)
-            versioned = srv.bucket_meta.versioning_enabled(bucket)
-            tiered_ud = None if versioned else \
-                self._tiered_meta_of(bucket, key, "", False)
-            oi = srv.layer.put_object_stream(
-                bucket, key, reader,
-                ol.PutObjectOptions(
-                    user_defined=user_defined, versioned=versioned,
-                    parity=self._storage_class_parity(user_defined)))
-            if tiered_ud is not None:
-                srv.transition.delete_tiered(tiered_ud)
-            hdrs = {"ETag": f'"{oi.etag}"'}
-            if oi.version_id:
-                hdrs["x-amz-version-id"] = oi.version_id
-            srv.notify("s3:ObjectCreated:Put", bucket, oi)
-            srv.replicate(bucket, oi)
-            self._send(200, headers=hdrs)
-
-        def _stream_upload_part(self, bucket, key, query, reader,
-                                cl: int):
-            self._allow(iampol.PUT_OBJECT, f"{bucket}/{key}")
-            uid = query["uploadId"][0]
-            try:
-                part_num = int(query["partNumber"][0])
-            except (KeyError, ValueError) as e:
-                raise S3Error("InvalidArgument") from e
-            self._check_quota(bucket, cl)
-            pi = srv.layer.put_object_part(bucket, key, uid, part_num,
-                                           reader)
-            self._send(200, headers={"ETag": f'"{pi.etag}"'})
-
-        def _put_object(self, bucket, key, query, payload):
-            if "Content-Length" not in self.headers:
-                raise S3Error("MissingContentLength")
-            if len(payload) > MAX_OBJECT_SIZE:
-                raise S3Error("EntityTooLarge")
-            md5_hdr = self.headers.get("Content-MD5")
-            if md5_hdr:
-                import base64
-                try:
-                    want = base64.b64decode(md5_hdr)
-                except Exception as e:
-                    raise S3Error("InvalidDigest") from e
-                if hashlib.md5(payload).digest() != want:
-                    raise S3Error("BadDigest")
-            user_defined = {}
-            ct = self.headers.get("Content-Type")
-            if ct:
-                user_defined["content-type"] = ct
-            for h, v in self.headers.items():
-                if h.lower().startswith("x-amz-meta-"):
-                    user_defined[h.lower()] = v
-            user_defined.update(self._tagging_header_meta())
-            oi, hdrs = self._store_object(bucket, key, payload,
-                                          user_defined,
-                                          "s3:ObjectCreated:Put")
-            self._send(200, headers=hdrs)
-
-        def _store_object(self, bucket, key, payload, user_defined,
-                          event_name):
-            """Shared tail of every simple write path (PUT and POST
-            policy): quota, compression, SSE, lock defaults, store,
-            notify, replicate.  Returns (oi, response_headers)."""
-            user_defined.update(self._lock_headers(bucket, key))
-            self._check_quota(bucket, len(payload))
-            versioned = srv.bucket_meta.versioning_enabled(bucket)
-            # unversioned overwrite replaces the null version: remember
-            # its tiered bytes, freed only AFTER the new write commits
-            # (an early free would destroy data if this PUT fails)
-            tiered_ud = None if versioned else \
-                self._tiered_meta_of(bucket, key, "", False)
-            from ..crypto import sse as csse
-            payload = self._compress_for_put(key, user_defined, payload)
-            enc = self._sse_for_put(bucket, key, user_defined)
-            if enc is not None:
-                payload = enc.encrypt(payload)
-            oi = srv.layer.put_object(
-                bucket, key, payload,
-                ol.PutObjectOptions(
-                    user_defined=user_defined, versioned=versioned,
-                    parity=self._storage_class_parity(user_defined)))
-            if tiered_ud is not None:
-                srv.transition.delete_tiered(tiered_ud)
-            hdrs = {"ETag": f'"{oi.etag}"'}
-            hdrs.update(csse.response_headers(user_defined))
-            if oi.version_id:
-                hdrs["x-amz-version-id"] = oi.version_id
-            srv.notify(event_name, bucket, oi)
-            srv.replicate(bucket, oi)
-            return oi, hdrs
-
-        # -- CopyObject / UploadPartCopy (cmd/object-handlers.go:886,
-        # cmd/object-multipart-handlers.go CopyObjectPartHandler) ----------
-
-        def _parse_copy_source(self) -> tuple[str, str, str | None]:
-            """x-amz-copy-source -> (bucket, key, version_id).  The
-            versionId qualifier is split off the RAW header first — a
-            percent-encoded '?' inside the key must stay part of the key."""
-            raw = self.headers.get("x-amz-copy-source", "")
-            vid = None
-            if "?versionId=" in raw:
-                raw, vid = raw.split("?versionId=", 1)
-                if vid == "null":
-                    vid = ""
-            src = urllib.parse.unquote(raw).lstrip("/")
-            if "/" not in src:
-                raise S3Error("InvalidCopySource")
-            sbucket, skey = src.split("/", 1)
-            if not sbucket or not skey:
-                raise S3Error("InvalidCopySource")
-            return sbucket, skey, vid
-
-        def _read_copy_source(self, offset: int = 0, length: int = -1
-                              ) -> tuple["ol.ObjectInfo", bytes, int]:
-            """Fetch (and decrypt, honoring copy-source SSE-C headers) the
-            copy source; returns (info, plaintext, plaintext_size)."""
-            from ..crypto import sse as csse
-            sbucket, skey, svid = self._parse_copy_source()
-            self._allow(iampol.GET_OBJECT, f"{sbucket}/{skey}")
-            opts = ol.ObjectOptions(version_id=svid)
-            soi = srv.layer.get_object_info(sbucket, skey, opts)
-            from ..objectlayer import tiering as _tr
-            if _tr.is_transitioned(soi.user_defined) and \
-                    not _tr.restore_valid(soi.user_defined):
-                # archived source: copying the stub would silently write
-                # a 0-byte destination
-                raise S3Error("InvalidObjectState")
-            # conditional copy headers (checkCopyObjectPreconditions) —
-            # checked on metadata alone, BEFORE any data is read
-            if_match = self.headers.get("x-amz-copy-source-if-match")
-            if_none = self.headers.get("x-amz-copy-source-if-none-match")
-            if if_match and if_match.strip('"') != soi.etag:
-                raise S3Error("PreconditionFailed")
-            if if_none and if_none.strip('"') == soi.etag:
-                raise S3Error("PreconditionFailed")
-            from .. import compress as mtc
-            compressed = mtc.META_COMPRESSION in soi.user_defined
-            if csse.is_encrypted(soi.user_defined):
-                enc = csse.ObjectEncryption.open(
-                    soi.user_defined, sbucket, skey, self.headers,
-                    srv.kms, copy_source=True)
-                if not compressed:
-                    size = csse.decrypted_size(soi.user_defined, soi.size,
-                                               soi.parts)
-                    data = csse.decrypt_object_range(
-                        enc, soi.user_defined, soi.size,
-                        lambda o, n: srv.layer.get_object(
-                            sbucket, skey, o, n, opts)[1], offset, length,
-                        soi.parts)
-                    return soi, data, size
-                inner = csse.decrypt_object_range(
-                    enc, soi.user_defined, soi.size,
-                    lambda o, n: srv.layer.get_object(
-                        sbucket, skey, o, n, opts)[1], 0, -1, soi.parts)
-            elif not compressed:
-                size = soi.size
-                _, data = srv.layer.get_object(sbucket, skey, offset,
-                                               length, opts)
-                return soi, data, size
-            else:
-                _, inner = srv.layer.get_object(sbucket, skey, 0, -1,
-                                                opts)
-            full = mtc.decompress_stream(inner)
-            data = full[offset:] if length < 0 \
-                else full[offset:offset + length]
-            return soi, data, len(full)
-
-        def _copy_object(self, bucket, key, query):
-            from ..crypto import sse as csse
-            sbucket, skey, svid = self._parse_copy_source()
-            soi, data, _ = self._read_copy_source()
-            directive = self.headers.get("x-amz-metadata-directive",
-                                         "COPY")
-            user_defined: dict[str, str] = {}
-            if directive == "REPLACE":
-                ct = self.headers.get("Content-Type")
-                if ct:
-                    user_defined["content-type"] = ct
-                for h, v in self.headers.items():
-                    if h.lower().startswith("x-amz-meta-"):
-                        user_defined[h.lower()] = v
-            else:
-                user_defined = {
-                    k: v for k, v in soi.user_defined.items()
-                    if k.startswith("x-amz-meta-") or k == "content-type"}
-            tag_directive = self.headers.get("x-amz-tagging-directive",
-                                             "COPY")
-            if tag_directive == "REPLACE":
-                user_defined.update(self._tagging_header_meta())
-            elif soi.user_defined.get(self.TAG_KEY):
-                user_defined[self.TAG_KEY] = soi.user_defined[self.TAG_KEY]
-            user_defined.update(self._lock_headers(bucket, key))
-            data = self._compress_for_put(key, user_defined, data)
-            enc = self._sse_for_put(bucket, key, user_defined)
-            sse_changed = enc is not None or \
-                csse.is_encrypted(soi.user_defined)
-            if sbucket == bucket and skey == key and svid is None and \
-                    directive != "REPLACE" and not sse_changed:
-                raise S3Error("InvalidCopyDest")
-            self._check_quota(bucket, len(data))
-            if enc is not None:
-                data = enc.encrypt(data)
-            versioned = srv.bucket_meta.versioning_enabled(bucket)
-            oi = srv.layer.put_object(
-                bucket, key, data,
-                ol.PutObjectOptions(
-                    user_defined=user_defined, versioned=versioned,
-                    parity=self._storage_class_parity(user_defined)))
-            root = ET.Element("CopyObjectResult", xmlns=S3_NS)
-            ET.SubElement(root, "ETag").text = f'"{oi.etag}"'
-            ET.SubElement(root, "LastModified").text = _iso_date(oi.mod_time)
-            hdrs = dict(csse.response_headers(user_defined))
-            if oi.version_id:
-                hdrs["x-amz-version-id"] = oi.version_id
-            if svid is not None:
-                hdrs["x-amz-copy-source-version-id"] = svid or "null"
-            srv.notify("s3:ObjectCreated:Copy", bucket, oi)
-            srv.replicate(bucket, oi)
-            self._send(200, _xml(root), headers=hdrs)
-
-        def _upload_part_copy(self, bucket, key, query):
-            uid = query["uploadId"][0]
-            try:
-                part_num = int(query["partNumber"][0])
-            except (KeyError, ValueError) as e:
-                raise S3Error("InvalidArgument") from e
-            offset, length = 0, -1
-            crng = self.headers.get("x-amz-copy-source-range")
-            if crng:
-                offset, length = _parse_range(crng)
-                if offset < 0:
-                    raise S3Error("InvalidRange")
-            _, data, _ = self._read_copy_source(offset, length)
-            self._check_quota(bucket, len(data))
-            data, _ = self._encrypt_part(bucket, key, uid, data)
-            pi = srv.layer.put_object_part(bucket, key, uid, part_num,
-                                           data)
-            root = ET.Element("CopyPartResult", xmlns=S3_NS)
-            ET.SubElement(root, "ETag").text = f'"{pi.etag}"'
-            ET.SubElement(root, "LastModified").text = \
-                _iso_date(pi.mod_time or 0)
-            self._send(200, _xml(root))
-
-        def _lock_headers(self, bucket: str, key: str) -> dict[str, str]:
-            """Explicit x-amz-object-lock-* headers, else the bucket's
-            default retention (cmd/bucket-object-lock.go)."""
-            from ..bucket import objectlock as olock
-            raw = srv.bucket_meta.get_config(bucket, "object-lock")
-            out: dict[str, str] = {}
-            mode = self.headers.get(olock.AMZ_OBJECT_LOCK_MODE)
-            until = self.headers.get(olock.AMZ_OBJECT_LOCK_RETAIN_UNTIL)
-            hold = self.headers.get(olock.AMZ_OBJECT_LOCK_LEGAL_HOLD)
-            if mode or until or hold:
-                if raw is None:
-                    raise S3Error("InvalidRequest")
-                if (mode is None) != (until is None):
-                    raise S3Error("InvalidRequest")
-                if mode:
-                    if mode not in (olock.GOVERNANCE, olock.COMPLIANCE):
-                        raise S3Error("InvalidRequest")
-                    # the retain-until header must be a valid, future
-                    # timestamp — storing garbage would mint an object the
-                    # client believes is WORM but that active() never locks
-                    try:
-                        dt = datetime.datetime.fromisoformat(
-                            until.replace("Z", "+00:00"))
-                        if dt.tzinfo is None:
-                            dt = dt.replace(tzinfo=datetime.timezone.utc)
-                    except ValueError as e:
-                        raise S3Error("InvalidRequest") from e
-                    if dt <= datetime.datetime.now(datetime.timezone.utc):
-                        raise S3Error("InvalidRequest")
-                    out[olock.AMZ_OBJECT_LOCK_MODE] = mode
-                    out[olock.AMZ_OBJECT_LOCK_RETAIN_UNTIL] = \
-                        dt.astimezone(datetime.timezone.utc).strftime(
-                            "%Y-%m-%dT%H:%M:%SZ")
-                if hold:
-                    if hold not in ("ON", "OFF"):
-                        raise S3Error("InvalidRequest")
-                    out[olock.AMZ_OBJECT_LOCK_LEGAL_HOLD] = hold
-                return out
-            if raw is not None:
-                cfg = _try(lambda: olock.LockConfig.parse(raw.encode()))
-                out.update(cfg.default_retention_headers())
-            return out
-
-        def _get_object(self, bucket, key, query, head: bool):
-            q1 = {k: v[0] for k, v in query.items()}
-            vid = q1.get("versionId")
-            if vid == "null":
-                vid = ""
-            opts = ol.ObjectOptions(version_id=vid)
-            from ..crypto import sse as csse
-            rng = self.headers.get("Range")
-            offset, length = 0, -1
-            sse_hdrs: dict[str, str] = {}
-            plain_size: int | None = None
-            from .. import compress as mtc
-            try:
-                oi_pre = None
-                if any(h in self.headers for h in
-                       ("If-Match", "If-None-Match", "If-Modified-Since",
-                        "If-Unmodified-Since")):
-                    # preconditions run on metadata BEFORE any data read
-                    # — a 304 revalidation must not decode the object
-                    oi_pre = srv.layer.get_object_info(bucket, key, opts)
-                    if not oi_pre.delete_marker and \
-                            self._preconditions_304(oi_pre):
-                        return self._send(
-                            304, b"",
-                            headers={"ETag":
-                                     f'"{self._display_etag(oi_pre)}"',
-                                     "Last-Modified":
-                                     _http_date(oi_pre.mod_time)},
-                            content_length=0)
-                body_gen = None    # streaming plain-object body
-                if rng:
-                    offset, length = _parse_range(rng)
-                if head or rng:
-                    # metadata first: a range is in client (decompressed/
-                    # decrypted) space — fetching stored bytes at those
-                    # offsets would decode data that gets thrown away
-                    oi = oi_pre if oi_pre is not None else \
-                        srv.layer.get_object_info(bucket, key, opts)
-                    data = None
-                    from ..objectlayer import tiering as _tchk
-                    if rng and not head and \
-                            _tchk.is_transitioned(oi.user_defined) and \
-                            not _tchk.restore_valid(oi.user_defined):
-                        # archived stub: 403 before the size-0 range
-                        # fetch can 416
-                        raise S3Error("InvalidObjectState")
-                    if rng and not oi.delete_marker and \
-                            mtc.META_COMPRESSION not in oi.user_defined \
-                            and not csse.is_encrypted(oi.user_defined):
-                        # plain ranged GET: only covering blocks are read
-                        # and the body streams (erasure-decode.go:229-246)
-                        oi, body_gen = srv.layer.get_object_reader(
-                            bucket, key, offset, length, opts)
-                else:
-                    # full GET: reader returns metadata + a body stream;
-                    # transform paths (SSE/compression) materialize below
-                    oi, body_gen = srv.layer.get_object_reader(
-                        bucket, key, 0, -1, opts)
-                    data = None
-                if not head and oi.delete_marker:
-                    raise ol.MethodNotAllowed(key)
-                from ..objectlayer import tiering
-                archived = tiering.is_transitioned(oi.user_defined)
-                stubbed = archived and \
-                    not tiering.restore_valid(oi.user_defined)
-                if stubbed and not head:
-                    # data lives in the tier: GET needs a restore first
-                    # (cmd/object-handlers.go InvalidObjectState)
-                    raise S3Error("InvalidObjectState")
-                encrypted = csse.is_encrypted(oi.user_defined) and \
-                    not oi.delete_marker and not stubbed
-                compressed = mtc.META_COMPRESSION in oi.user_defined and \
-                    not oi.delete_marker and not stubbed
-                if body_gen is not None and (encrypted or compressed):
-                    # transform paths need the stored bytes in hand
-                    data = b"".join(body_gen)
-                    body_gen = None
-                if stubbed:
-                    # HEAD of the stub reports the archived identity
-                    plain_size = int(oi.user_defined.get(
-                        tiering.META_SIZE, "0"))
-                inner: bytes | None = None
-                if encrypted:
-                    # DecryptObjectInfo: the data path reads only covering
-                    # DARE packages (full stream when also compressed)
-                    enc = csse.ObjectEncryption.open(
-                        oi.user_defined, bucket, key, self.headers,
-                        srv.kms)
-                    inner_size = csse.decrypted_size(
-                        oi.user_defined, oi.size, oi.parts)
-                    sse_hdrs = csse.response_headers(oi.user_defined)
-                    if not compressed:
-                        plain_size = inner_size
-                        if rng and offset >= plain_size:
-                            raise S3Error("InvalidRange")
-                    if not head:
-                        if data is not None and not rng and \
-                                len(data) == oi.size:
-                            blob = data       # full ciphertext in hand
-
-                            def read(o, n, _b=blob):
-                                return _b[o:o + n]
-                        else:
-                            def read(o, n):
-                                return srv.layer.get_object(
-                                    bucket, key, o, n, opts)[1]
-                        if compressed:
-                            inner = csse.decrypt_object_range(
-                                enc, oi.user_defined, oi.size, read,
-                                0, -1, oi.parts)
-                        else:
-                            data = csse.decrypt_object_range(
-                                enc, oi.user_defined, oi.size, read,
-                                offset, length, oi.parts)
-                if compressed:
-                    if head:
-                        plain_size = int(
-                            oi.user_defined[csse.META_ACTUAL_SIZE])
-                    else:
-                        if inner is None:
-                            if data is not None and not rng and \
-                                    len(data) == oi.size:
-                                inner = data
-                            else:
-                                _, inner = srv.layer.get_object(
-                                    bucket, key, 0, -1, opts)
-                        full = mtc.decompress_stream(inner)
-                        plain_size = len(full)
-                        if rng and offset >= plain_size:
-                            raise S3Error("InvalidRange")
-                        data = full[offset:] if length < 0 \
-                            else full[offset:offset + length]
-            except ol.MethodNotAllowed:
-                # delete marker (cmd/object-handlers.go: 405 + header)
-                return self._send(
-                    405, s3err.to_xml(s3err.get("MethodNotAllowed")),
-                    headers={"x-amz-delete-marker": "true"})
-            entity_size = plain_size if plain_size is not None else oi.size
-            hdrs = {
-                "ETag": f'"{oi.etag}"',
-                "Last-Modified": _http_date(oi.mod_time),
-                "Accept-Ranges": "bytes",
-            }
-            if archived:
-                from ..objectlayer import tiering as _tr
-                hdrs["ETag"] = \
-                    f'"{oi.user_defined.get(_tr.META_ETAG, oi.etag)}"'
-                hdrs[_tr.STORAGE_CLASS_HDR] = oi.user_defined.get(
-                    _tr.STORAGE_CLASS_HDR, "")
-                rh = _tr.restore_header(oi.user_defined)
-                if rh:
-                    hdrs[_tr.RESTORE_HDR] = rh
-            elif oi.user_defined.get("x-amz-storage-class"):
-                # RRS objects report their class (AWS omits STANDARD)
-                hdrs["x-amz-storage-class"] = \
-                    oi.user_defined["x-amz-storage-class"]
-            hdrs.update(sse_hdrs)
-            if oi.version_id:
-                hdrs["x-amz-version-id"] = oi.version_id
-            for k2, v in oi.user_defined.items():
-                if k2.startswith("x-amz-meta-"):
-                    hdrs[k2] = v
-            ct = oi.content_type or "binary/octet-stream"
-            tag_hdr = oi.user_defined.get(self.TAG_KEY)
-            if tag_hdr:
-                hdrs["x-amz-tagging-count"] = str(
-                    len(urllib.parse.parse_qsl(tag_hdr,
-                                               keep_blank_values=True)))
-            srv.notify("s3:ObjectAccessed:Head" if head
-                       else "s3:ObjectAccessed:Get", bucket, oi)
-            if head:
-                if oi.delete_marker:
-                    hdrs = {"x-amz-delete-marker": "true"}
-                    if oi.version_id:
-                        hdrs["x-amz-version-id"] = oi.version_id
-                    return self._send(405, b"", headers=hdrs,
-                                      content_length=0)
-                return self._send(200, b"", content_type=ct, headers=hdrs,
-                                  content_length=entity_size)
-            if rng:
-                if body_gen is not None:
-                    start = max(0, entity_size + offset) if offset < 0 \
-                        else offset
-                    sent = entity_size - start if length < 0 \
-                        else min(length, entity_size - start)
-                    hdrs["Content-Range"] = \
-                        f"bytes {start}-{start + sent - 1}/{entity_size}"
-                    return self._send_stream(206, body_gen, sent, ct,
-                                             hdrs)
-                start = entity_size - len(data) if offset < 0 else offset
-                hdrs["Content-Range"] = \
-                    f"bytes {start}-{start + len(data) - 1}/{entity_size}"
-                return self._send(206, data, content_type=ct, headers=hdrs)
-            if body_gen is not None:
-                return self._send_stream(200, body_gen, entity_size, ct,
-                                         hdrs)
-            return self._send(200, data, content_type=ct, headers=hdrs)
-
-        def _storage_class_parity(self, user_defined: dict) -> int | None:
-            """x-amz-storage-class -> parity override via the
-            storage_class config subsystem (cmd/config/storageclass
-            applied at cmd/erasure-object.go:631).  Also records RRS in
-            metadata so HEAD reports it (AWS omits STANDARD)."""
-            sc = self.headers.get("x-amz-storage-class", "").upper()
-            explicit = sc not in ("", "STANDARD")
-            if not explicit:
-                value = srv.config.get("storage_class", "standard")
-            elif sc == "REDUCED_REDUNDANCY":
-                value = srv.config.get("storage_class", "rrs")
-            else:
-                raise S3Error("InvalidStorageClass")
-            n = _layer_set_drive_count(srv.layer)
-            if not value or not n:
-                return None
-            from ..utils.kvconfig import parse_storage_class
-            try:
-                parity = parse_storage_class(value, n)
-            except ValueError as e:
-                if explicit:
-                    # the client asked for this class: tell them
-                    raise S3Error("InvalidStorageClass") from e
-                # bad *config* must not fail clients who sent no header
-                return None
-            if explicit:
-                user_defined["x-amz-storage-class"] = sc
-            return parity
-
-        def _display_etag(self, oi) -> str:
-            """The etag clients see: archived stubs advertise the
-            original object's etag (META_ETAG), not the stub's."""
-            from ..objectlayer import tiering as _tr
-            if _tr.is_transitioned(oi.user_defined):
-                return oi.user_defined.get(_tr.META_ETAG, oi.etag)
-            return oi.etag
-
-        def _preconditions_304(self, oi) -> bool:
-            """Evaluate GET/HEAD preconditions (checkPreconditions,
-            cmd/object-handlers-common.go).  Raises 412 for failed
-            If-Match/If-Unmodified-Since; returns True when the response
-            must be 304 Not Modified."""
-            if_match = self.headers.get("If-Match")
-            if_none = self.headers.get("If-None-Match")
-            if_mod = self.headers.get("If-Modified-Since")
-            if_unmod = self.headers.get("If-Unmodified-Since")
-            etag = self._display_etag(oi)
-            # Last-Modified is second-granularity: compare truncated
-            # seconds or an echoed header spuriously fails
-            mod_s = oi.mod_time // 10 ** 9
-
-            def etag_in(header: str) -> bool:
-                tags = [t.strip().strip('"') for t in header.split(",")]
-                return "*" in tags or etag in tags
-
-            def parse_date(v: str) -> float | None:
-                try:
-                    return email.utils.parsedate_to_datetime(v).timestamp()
-                except (TypeError, ValueError):
-                    return None         # invalid dates are ignored
-
-            if if_match is not None and not etag_in(if_match):
-                raise S3Error("PreconditionFailed")
-            if if_match is None and if_unmod is not None:
-                t = parse_date(if_unmod)
-                if t is not None and mod_s > t:
-                    raise S3Error("PreconditionFailed")
-            if if_none is not None and etag_in(if_none):
-                return True
-            if if_none is None and if_mod is not None:
-                t = parse_date(if_mod)
-                if t is not None and mod_s <= t:
-                    return True
-            return False
-
-        def _restore_object(self, bucket, key, query, payload):
-            """PostRestoreObjectHandler: <RestoreRequest><Days>N</Days>
-            </RestoreRequest> copies tiered bytes back for N days."""
-            from ..objectlayer import tiering
-            days = 1
-            if payload:
-                try:
-                    root = ET.fromstring(payload)
-                    for el in root.iter():
-                        if el.tag.split("}")[-1] == "Days":
-                            days = int(el.text or 1)
-                except (ET.ParseError, ValueError) as e:
-                    raise S3Error("MalformedXML") from e
-            if days < 1:
-                raise S3Error("InvalidArgument")
-            vid = query.get("versionId", [None])[0]
-            if vid == "null":
-                vid = ""                # explicit null version
-            ts = srv.transition
-            try:
-                fresh = ts.restore(bucket, key, days, version_id=vid)
-            except tiering.TierError as e:
-                # only "not archived" is the client's mistake; a tier
-                # backend failure is a server-side problem, not a 403
-                if "archived state" in str(e):
-                    raise S3Error("InvalidObjectState") from e
-                raise S3Error("InternalError") from e
-            oi = srv.layer.get_object_info(
-                bucket, key, ol.ObjectOptions(version_id=vid))
-            srv.notify("s3:ObjectRestore:Completed", bucket, oi)
-            # 202 while "in progress" (fresh copy), 200 when it already
-            # held a valid restored copy (object-handlers.go semantics)
-            return self._send(202 if fresh else 200, b"")
-
-        def _tiered_meta_of(self, bucket, key, vid, versioned):
-            """Metadata of the version about to be removed/replaced, for
-            freeing its tier bytes AFTER the destructive op commits.
-            None when nothing tiered is at stake.  vid semantics follow
-            the layer: None = latest, "" = null version."""
-            if not srv.transition.tiers:
-                return None
-            if versioned and vid is None:
-                return None         # delete-marker write keeps the data
-            try:
-                old = srv.layer.get_object_info(
-                    bucket, key, ol.ObjectOptions(version_id=vid))
-            except ol.ObjectLayerError:
-                return None
-            from ..objectlayer import tiering as _tr
-            return old.user_defined \
-                if _tr.is_transitioned(old.user_defined) else None
-
-        def _delete_object(self, bucket, key, query):
-            q1 = {k: v[0] for k, v in query.items()}
-            vid = q1.get("versionId")
-            if vid == "null":
-                vid = ""
-            self._check_retention(bucket, key, vid)
-            versioned = srv.bucket_meta.versioning_enabled(bucket)
-            tiered_ud = self._tiered_meta_of(bucket, key, vid, versioned)
-            res = srv.layer.delete_object(
-                bucket, key, ol.ObjectOptions(version_id=vid,
-                                              versioned=versioned))
-            if tiered_ud is not None:   # freed only after the commit
-                srv.transition.delete_tiered(tiered_ud)
-            hdrs = {}
-            if res.delete_marker:
-                hdrs["x-amz-delete-marker"] = "true"
-            if res.version_id:
-                hdrs["x-amz-version-id"] = res.version_id
-            srv.notify("s3:ObjectRemoved:DeleteMarkerCreated"
-                       if res.delete_marker else "s3:ObjectRemoved:Delete",
-                       bucket, res)
-            srv.replicate(bucket, res, delete=True)
-            self._send(204, headers=hdrs)
-
-        def _check_retention(self, bucket, key, vid) -> None:
-            """WORM enforcement: deleting a *specific version* under
-            retention/legal hold is refused (a versioned delete that only
-            writes a delete marker is always allowed)."""
-            from ..bucket import objectlock as olock
-            if vid is None:
-                if srv.bucket_meta.versioning_enabled(bucket):
-                    return      # becomes a delete marker, data retained
-            if srv.bucket_meta.get_config(bucket, "object-lock") is None:
-                return
-            try:
-                oi = srv.layer.get_object_info(
-                    bucket, key, ol.ObjectOptions(version_id=vid))
-            except ol.ObjectLayerError:
-                return
-            bypass = self._governance_bypass(f"{bucket}/{key}")
-            if not olock.check_delete_allowed(oi.user_defined,
-                                              governance_bypass=bypass):
-                raise S3Error("ObjectLocked")
+        # bucket/object handler families live in handlers_bucket.py /
+        # handlers_object.py (split from this file, attached below)
+
+    # handler-family modules (split from this file): plain functions
+    # taking the handler instance; srv rides on the class
+    from . import handlers_bucket, handlers_object
+    Handler.srv = srv
+    Handler.TAG_KEY = handlers_object.TAG_KEY
+    for _mod in (handlers_bucket, handlers_object):
+        for _name in _mod.HANDLERS:
+            setattr(Handler, _name, getattr(_mod, _name))
 
     return Handler
 
