@@ -1,0 +1,317 @@
+"""In-memory "cloud" gateway — proves the cloud-backend Gateway seam.
+
+The azure/gcs/hdfs gateways stay gated (their SDKs and any egress are
+absent from this image, gateway/cloud.py), but the ADAPTER pattern they
+would use — translate ObjectLayer calls onto a foreign blob-service
+client with block-based multipart — is exercised end to end here
+against a faithful in-memory blob service with Azure-block-blob-style
+semantics (containers, blobs with etags/metadata, staged block lists).
+Role model: cmd/gateway/azure/gateway-azure.go (azureObjects over the
+azblob SDK); the S3Server/IAM/admin frontend runs unchanged on top.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from ..objectlayer.interface import (BucketExists, BucketInfo,
+                                     BucketNotEmpty, BucketNotFound,
+                                     InvalidPart, ListObjectsInfo,
+                                     ObjectInfo, ObjectLayer,
+                                     ObjectNotFound, ObjectOptions,
+                                     PutObjectOptions)
+from . import Gateway, GatewayUnsupported, register
+
+
+def _now_ns() -> int:
+    return time.time_ns()
+
+
+@dataclass
+class _Blob:
+    data: bytes
+    etag: str
+    mod_time: int
+    metadata: dict = field(default_factory=dict)
+    content_type: str = ""
+
+
+class FakeBlobService:
+    """The foreign 'cloud SDK': containers + block blobs.
+
+    Mirrors the call surface an azure-style SDK exposes (create/delete
+    container, upload/download/delete blob, staged blocks committed by
+    a block list) so the gateway adapter above it has the same job
+    gateway-azure.go does."""
+
+    def __init__(self):
+        self._mu = threading.RLock()
+        self._containers: dict[str, dict[str, _Blob]] = {}
+        self._ctimes: dict[str, int] = {}
+        self._blocks: dict[tuple[str, str, str], dict[str, bytes]] = {}
+
+    # containers
+    def create_container(self, name: str) -> None:
+        with self._mu:
+            if name in self._containers:
+                raise KeyError("ContainerAlreadyExists")
+            self._containers[name] = {}
+            self._ctimes[name] = _now_ns()
+
+    def delete_container(self, name: str, force: bool = False) -> None:
+        with self._mu:
+            blobs = self._container(name)
+            if blobs and not force:
+                raise ValueError("ContainerNotEmpty")
+            del self._containers[name]
+            del self._ctimes[name]
+
+    def list_containers(self) -> list[tuple[str, int]]:
+        with self._mu:
+            return sorted((n, self._ctimes[n])
+                          for n in self._containers)
+
+    def _container(self, name: str) -> dict[str, _Blob]:
+        try:
+            return self._containers[name]
+        except KeyError:
+            raise KeyError("ContainerNotFound") from None
+
+    # blobs
+    def upload_blob(self, container: str, name: str, data: bytes,
+                    metadata: dict | None = None,
+                    content_type: str = "") -> str:
+        etag = hashlib.md5(data).hexdigest()
+        with self._mu:
+            self._container(container)[name] = _Blob(
+                bytes(data), etag, _now_ns(), dict(metadata or {}),
+                content_type)
+        return etag
+
+    def get_blob(self, container: str, name: str) -> _Blob:
+        with self._mu:
+            blobs = self._container(container)
+            try:
+                return blobs[name]
+            except KeyError:
+                raise KeyError("BlobNotFound") from None
+
+    def delete_blob(self, container: str, name: str) -> None:
+        with self._mu:
+            blobs = self._container(container)
+            if name not in blobs:
+                raise KeyError("BlobNotFound")
+            del blobs[name]
+
+    def list_blobs(self, container: str, prefix: str = "") -> list[str]:
+        with self._mu:
+            return sorted(n for n in self._container(container)
+                          if n.startswith(prefix))
+
+    # staged blocks (azure block-blob multipart model)
+    def stage_block(self, container: str, name: str, upload: str,
+                    block_id: str, data: bytes) -> None:
+        with self._mu:
+            self._container(container)
+            self._blocks.setdefault((container, name, upload),
+                                    {})[block_id] = bytes(data)
+
+    def commit_block_list(self, container: str, name: str, upload: str,
+                          block_ids: list[str],
+                          metadata: dict | None = None) -> str:
+        with self._mu:
+            staged = self._blocks.pop((container, name, upload), {})
+            try:
+                body = b"".join(staged[b] for b in block_ids)
+            except KeyError:
+                raise KeyError("InvalidBlockList") from None
+            return self.upload_blob(container, name, body, metadata)
+
+    def abort_blocks(self, container: str, name: str,
+                     upload: str) -> None:
+        self._blocks.pop((container, name, upload), None)
+
+    def staged_uploads(self, container: str) -> list[tuple[str, str]]:
+        with self._mu:
+            return sorted({(n, u) for (c, n, u) in self._blocks
+                           if c == container})
+
+    def staged_blocks(self, container: str, name: str,
+                      upload: str) -> dict[str, bytes]:
+        with self._mu:
+            return dict(self._blocks.get((container, name, upload), {}))
+
+
+def _oi(bucket: str, name: str, blob: _Blob) -> ObjectInfo:
+    return ObjectInfo(bucket=bucket, name=name, size=len(blob.data),
+                      etag=blob.etag, mod_time=blob.mod_time,
+                      content_type=blob.content_type or
+                      "application/octet-stream",
+                      user_defined=dict(blob.metadata))
+
+
+class MemoryObjects(GatewayUnsupported, ObjectLayer):
+    """ObjectLayer over FakeBlobService — the gateway-azure.go role."""
+
+    def __init__(self, svc: FakeBlobService | None = None):
+        self.svc = svc or FakeBlobService()
+
+    # buckets -> containers
+    def make_bucket(self, bucket: str) -> None:
+        try:
+            self.svc.create_container(bucket)
+        except KeyError:
+            raise BucketExists(bucket) from None
+
+    def get_bucket_info(self, bucket: str) -> BucketInfo:
+        for name, created in self.svc.list_containers():
+            if name == bucket:
+                return BucketInfo(name=name, created=created)
+        raise BucketNotFound(bucket)
+
+    def list_buckets(self) -> list[BucketInfo]:
+        return [BucketInfo(name=n, created=c)
+                for n, c in self.svc.list_containers()]
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        try:
+            self.svc.delete_container(bucket, force)
+        except KeyError:
+            raise BucketNotFound(bucket) from None
+        except ValueError:
+            raise BucketNotEmpty(bucket) from None
+
+    # objects -> blobs
+    def put_object(self, bucket: str, object_name: str, data,
+                   opts: PutObjectOptions | None = None) -> ObjectInfo:
+        opts = opts or PutObjectOptions()
+        body = bytes(data) if not isinstance(data, bytes) else data
+        try:
+            self.svc.upload_blob(bucket, object_name, body,
+                                 metadata=opts.user_defined)
+        except KeyError:
+            raise BucketNotFound(bucket) from None
+        return self.get_object_info(bucket, object_name)
+
+    def get_object(self, bucket: str, object_name: str, offset: int = 0,
+                   length: int = -1,
+                   opts: ObjectOptions | None = None):
+        info = self.get_object_info(bucket, object_name, opts)
+        blob = self.svc.get_blob(bucket, object_name)
+        end = len(blob.data) if length < 0 else offset + length
+        return info, blob.data[offset:end]
+
+    def get_object_info(self, bucket: str, object_name: str,
+                        opts: ObjectOptions | None = None) -> ObjectInfo:
+        try:
+            blob = self.svc.get_blob(bucket, object_name)
+        except KeyError as e:
+            if "Container" in str(e):
+                raise BucketNotFound(bucket) from None
+            raise ObjectNotFound(f"{bucket}/{object_name}") from None
+        return _oi(bucket, object_name, blob)
+
+    def delete_object(self, bucket: str, object_name: str,
+                      opts: ObjectOptions | None = None) -> ObjectInfo:
+        try:
+            self.svc.delete_blob(bucket, object_name)
+        except KeyError as e:
+            if "Container" in str(e):
+                raise BucketNotFound(bucket) from None
+            raise ObjectNotFound(f"{bucket}/{object_name}") from None
+        return ObjectInfo(bucket=bucket, name=object_name)
+
+    def list_objects(self, bucket: str, prefix: str = "",
+                     marker: str = "", delimiter: str = "",
+                     max_keys: int = 1000) -> ListObjectsInfo:
+        from ..objectlayer.metacache import paginate
+        try:
+            names = self.svc.list_blobs(bucket, prefix)
+        except KeyError:
+            raise BucketNotFound(bucket) from None
+        infos = [_oi(bucket, n, self.svc.get_blob(bucket, n))
+                 for n in names]
+        return paginate(infos, prefix, marker, delimiter, max_keys)
+
+    # multipart -> staged block lists (azure block-blob model)
+    def new_multipart_upload(self, bucket: str, object_name: str,
+                             opts: PutObjectOptions | None = None) -> str:
+        self.get_bucket_info(bucket)
+        uid = uuid.uuid4().hex
+        meta = (opts or PutObjectOptions()).user_defined
+        self.svc.stage_block(bucket, object_name, uid, "__meta__",
+                             repr(sorted(meta.items())).encode())
+        self._metas = getattr(self, "_metas", {})
+        self._metas[uid] = dict(meta)
+        return uid
+
+    def put_object_part(self, bucket: str, object_name: str,
+                        upload_id: str, part_number: int, data) -> str:
+        body = bytes(data) if not isinstance(data, bytes) else data
+        try:
+            self.svc.stage_block(bucket, object_name, upload_id,
+                                 f"{part_number:06d}", body)
+        except KeyError:
+            raise BucketNotFound(bucket) from None
+        return hashlib.md5(body).hexdigest()
+
+    def get_multipart_info(self, bucket: str, object_name: str,
+                           upload_id: str) -> dict:
+        blocks = self.svc.staged_blocks(bucket, object_name, upload_id)
+        if not blocks:
+            raise ObjectNotFound(f"upload {upload_id}")
+        return {"uploadId": upload_id, "bucket": bucket,
+                "object": object_name}
+
+    def list_object_parts(self, bucket: str, object_name: str,
+                          upload_id: str):
+        blocks = self.svc.staged_blocks(bucket, object_name, upload_id)
+        return [(int(b), hashlib.md5(d).hexdigest(), len(d))
+                for b, d in sorted(blocks.items())
+                if b != "__meta__"]
+
+    def abort_multipart_upload(self, bucket: str, object_name: str,
+                               upload_id: str) -> None:
+        self.svc.abort_blocks(bucket, object_name, upload_id)
+
+    def list_multipart_uploads(self, bucket: str, prefix: str = ""):
+        return [(n, u) for n, u in self.svc.staged_uploads(bucket)
+                if n.startswith(prefix)]
+
+    def complete_multipart_upload(self, bucket: str, object_name: str,
+                                  upload_id: str,
+                                  parts: list[tuple[int, str]]
+                                  ) -> ObjectInfo:
+        meta = getattr(self, "_metas", {}).pop(upload_id, {})
+        try:
+            self.svc.commit_block_list(
+                bucket, object_name, upload_id,
+                [f"{n:06d}" for n, _ in parts], metadata=meta)
+        except KeyError as e:
+            if "Container" in str(e):
+                raise BucketNotFound(bucket) from None
+            raise InvalidPart(
+                f"upload {upload_id}: part never uploaded") from None
+        return self.get_object_info(bucket, object_name)
+
+
+@register("memory")
+class MemoryGateway(Gateway):
+    """`minio gateway memory` analog: volatile cloud-shaped backend —
+    the seam-prover for azure/gcs-style adapters."""
+
+    def __init__(self, svc: FakeBlobService | None = None):
+        self._svc = svc
+
+    def name(self) -> str:
+        return "memory"
+
+    def production(self) -> bool:
+        return False                    # volatile by design
+
+    def new_gateway_layer(self) -> MemoryObjects:
+        return MemoryObjects(self._svc)

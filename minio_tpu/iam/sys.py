@@ -79,6 +79,73 @@ class IAMSys:
         # peer fan-out hook (peerRESTMethodLoadUser/LoadPolicy analogs):
         # set by attach_peers; fired after every persisted mutation
         self.on_change = None
+        # optional etcd backend (cmd/iam-etcd-store.go): when attached,
+        # IAM state persists as per-entity etcd keys instead of the
+        # drive-replicated json doc — every cluster sharing the etcd
+        # sees the same identities
+        self._etcd = None
+        self._etcd_prefix = "config/iam/"
+
+    def attach_etcd(self, client, path_prefix: str = "") -> None:
+        """Switch persistence to etcd (cmd/iam-etcd-store.go layout:
+        per-user and per-policy keys under config/iam/)."""
+        self._etcd = client
+        self._etcd_prefix = (path_prefix.rstrip("/") + "/"
+                             if path_prefix else "") + "config/iam/"
+        self._loaded = False
+        self.load()
+
+    def _etcd_save(self, doc: dict) -> None:
+        """Write only CHANGED entities (cmd/iam-etcd-store.go writes the
+        mutated entity, not the world).  Deletions are diffed against
+        what THIS process previously wrote — never against the whole
+        prefix, which would wipe entities other clusters created since
+        our last load."""
+        pfx = self._etcd_prefix
+        now: dict[str, bytes] = {}
+        for k, u in doc["users"].items():
+            now[f"{pfx}users/{k}"] = json.dumps(u).encode()
+        for name, p in doc["policies"].items():
+            now[f"{pfx}policies/{name}"] = json.dumps(p).encode()
+        now[f"{pfx}groups.json"] = json.dumps(doc["groups"]).encode()
+        now[f"{pfx}ldap-policies.json"] = \
+            json.dumps(doc["ldap_policies"]).encode()
+        prev = getattr(self, "_etcd_written", {})
+        for key, blob in now.items():
+            if prev.get(key) != blob:
+                self._etcd.put(key, blob)
+        for key in prev:
+            if key not in now:          # entity THIS process deleted
+                self._etcd.delete(key)
+        self._etcd_written = now
+
+    def _etcd_load(self) -> dict | None:
+        pfx = self._etcd_prefix
+        kvs = self._etcd.get_prefix(pfx)
+        if not kvs:
+            self._etcd_written = {}
+            return None
+        # the loaded state is the diff baseline for the next save: a
+        # local deletion must translate to an etcd delete of exactly
+        # that entity
+        self._etcd_written = {k.decode(): bytes(v) for k, v in kvs}
+        doc: dict = {"users": {}, "policies": {}, "groups": {},
+                     "ldap_policies": {}}
+        for key, val in kvs:
+            k = key.decode()[len(pfx):]
+            try:
+                parsed = json.loads(val)
+            except json.JSONDecodeError:
+                continue
+            if k.startswith("users/"):
+                doc["users"][k[len("users/"):]] = parsed
+            elif k.startswith("policies/"):
+                doc["policies"][k[len("policies/"):]] = parsed
+            elif k == "groups.json":
+                doc["groups"] = parsed
+            elif k == "ldap-policies.json":
+                doc["ldap_policies"] = parsed
+        return doc
 
     # -- persistence (IAMObjectStore analog) -------------------------------
 
@@ -97,23 +164,30 @@ class IAMSys:
                     "groups": self._group_policies,
                     "ldap_policies": self._ldap_policies,
                 }
-            blob = json.dumps(doc).encode()
-            self._layer._fanout(
-                lambda d: d.write_all(SYS_DIR, "config/iam.json", blob))
+            if self._etcd is not None:
+                self._etcd_save(doc)
+            else:
+                blob = json.dumps(doc).encode()
+                self._layer._fanout(
+                    lambda d: d.write_all(SYS_DIR, "config/iam.json",
+                                          blob))
         if self.on_change is not None:
             self.on_change()
 
     def load(self) -> None:
-        res, _ = self._layer._fanout(
-            lambda d: d.read_all(SYS_DIR, "config/iam.json"))
         doc = None
-        for r in res:
-            if r is not None:
-                try:
-                    doc = json.loads(r)
-                    break
-                except json.JSONDecodeError:
-                    continue
+        if self._etcd is not None:
+            doc = self._etcd_load()
+        else:
+            res, _ = self._layer._fanout(
+                lambda d: d.read_all(SYS_DIR, "config/iam.json"))
+            for r in res:
+                if r is not None:
+                    try:
+                        doc = json.loads(r)
+                        break
+                    except json.JSONDecodeError:
+                        continue
         with self._mu:
             if doc:
                 self._users = {k: UserIdentity.from_dict(u)

@@ -67,6 +67,79 @@ def drive_usage(path: str) -> Dict[str, Any]:
         return {"path": path, "error": str(e)}
 
 
+def _read(path: str) -> str:
+    try:
+        with open(path) as f:
+            return f.read().strip()
+    except OSError:
+        return ""
+
+
+def smart_info(drive_path: str) -> Dict[str, Any]:
+    """Per-drive hardware identity + IO counters (pkg/smart/smart.go
+    analog).  The reference issues raw NVMe/SCSI ioctls; inside VMs and
+    containers those fail on virtio disks, so this reads the same
+    facts the kernel already exports: sysfs identity (model, serial,
+    rotational, size) and /proc/diskstats IO/error-adjacent counters.
+    Degrades to partial info exactly like the reference does when the
+    passthrough is unsupported."""
+    out: Dict[str, Any] = {"path": drive_path}
+    try:
+        st = os.stat(drive_path)
+        major, minor = os.major(st.st_dev), os.minor(st.st_dev)
+    except OSError:
+        return out
+    out["device_major_minor"] = f"{major}:{minor}"
+    # resolve the owning block device via sysfs dev numbers
+    base = None
+    try:
+        for name in os.listdir("/sys/block"):
+            if _read(f"/sys/block/{name}/dev") == f"{major}:{minor}":
+                base = name
+                break
+            # partition of this block device?
+            pdir = f"/sys/block/{name}/{name}"
+            for sub in os.listdir(f"/sys/block/{name}"):
+                if sub.startswith(name) and _read(
+                        f"/sys/block/{name}/{sub}/dev") \
+                        == f"{major}:{minor}":
+                    base = name
+                    break
+            if base:
+                break
+    except OSError:
+        pass
+    if base is None:
+        return out
+    sys = f"/sys/block/{base}"
+    out["device"] = f"/dev/{base}"
+    out["model"] = _read(f"{sys}/device/model")
+    out["serial"] = _read(f"{sys}/device/serial") or \
+        _read(f"{sys}/device/wwid")
+    out["firmware"] = _read(f"{sys}/device/firmware_rev") or \
+        _read(f"{sys}/device/rev")
+    out["rotational"] = _read(f"{sys}/queue/rotational") == "1"
+    try:
+        out["size_bytes"] = int(_read(f"{sys}/size") or 0) * 512
+    except ValueError:
+        pass
+    # IO counters (reads/writes completed, sectors, ms, in-flight) —
+    # the health signal SMART attributes proxy for
+    stats = _read(f"{sys}/stat").split()
+    if len(stats) >= 11:
+        out["io"] = {
+            "reads_completed": int(stats[0]),
+            "read_sectors": int(stats[2]),
+            "read_ms": int(stats[3]),
+            "writes_completed": int(stats[4]),
+            "write_sectors": int(stats[6]),
+            "write_ms": int(stats[7]),
+            "in_flight": int(stats[8]),
+            "io_ms": int(stats[9]),
+        }
+    return out
+
+
 def accelerators() -> List[Dict[str, Any]]:
     """TPU/accelerator inventory — the build's analog of SMART/NVMe info."""
     try:
@@ -97,6 +170,7 @@ def collect(drive_paths: List[str] | None = None,
     }
     if drive_paths:
         info["drives"] = [drive_usage(p) for p in drive_paths]
+        info["smart"] = [smart_info(p) for p in drive_paths]
         if perf:
             info["drivePerf"] = []
             for p in drive_paths:

@@ -195,6 +195,14 @@ class S3Server:
         self.bucket_meta = BucketMetadataSys(object_layer)
         from ..utils.kvconfig import Config
         self.config = Config(object_layer)
+        # etcd coordination backend (cmd/etcd.go): when configured, IAM
+        # persists to etcd (cmd/iam-etcd-store.go) and federation DNS
+        # records use the CoreDNS/skydns layout
+        from ..utils import etcd as etcd_mod
+        self.etcd = etcd_mod.from_config(self.config)
+        if self.etcd is not None:
+            self.iam.attach_etcd(self.etcd,
+                                 self.config.get("etcd", "path_prefix"))
         from ..events import NotificationSys, WebhookTarget
         self.events = NotificationSys(self.bucket_meta, region=region)
         if self.config.get("notify_webhook", "enable") == "on":

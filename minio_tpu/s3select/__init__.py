@@ -105,6 +105,43 @@ class SelectRequest:
         return cls(expr, fmt, opts, ofmt, oopts, compression)
 
 
+def _try_json_fast_path(query, data: bytes, input_opts: dict):
+    """Reader over only the rows the C scanner kept, or None when the
+    WHERE isn't the simple comparison shape the scanner handles."""
+    w = query.where
+    if not isinstance(w, sql.Binary) or w.op not in records._OPS:
+        return None
+    col, lit, op = None, None, w.op
+    if isinstance(w.left, sql.Column) and isinstance(w.right, sql.Literal):
+        col, lit = w.left, w.right
+    elif isinstance(w.left, sql.Literal) and isinstance(w.right,
+                                                       sql.Column):
+        col, lit = w.right, w.left
+        op = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}.get(op, op)
+    else:
+        return None
+    path = list(col.path)
+    if path and path[0] == query.table_alias:
+        path = path[1:]
+    if len(path) != 1:                 # nested fields: full reader
+        return None
+    import re as _re
+    if _re.fullmatch(r"_\d+", path[0]):
+        return None                    # positional column: evaluator
+                                       # resolves by index, not by key
+    spans = records.ndjson_prefilter(data, path[0], op, lit.value)
+    if spans is None:
+        return None
+
+    def rows():
+        for lo, hi in spans:
+            line = data[lo:hi].strip()
+            if line:
+                yield records._wrap(records._json.loads(
+                    line.decode("utf-8", errors="replace")))
+    return rows()
+
+
 def run_select(payload: bytes, data: bytes) -> bytes:
     """Execute a SelectObjectContentRequest against object bytes; returns
     the framed event-stream response body."""
@@ -129,6 +166,14 @@ def run_select(payload: bytes, data: bytes) -> bytes:
             raise SelectError("InvalidDataSource", str(e)) from e
     else:
         reader = records.json_records(data, req.input_opts)
+        # simdjson-role fast path (native/jsonscan.cc): a WHERE of the
+        # form <top-level field> <op> <literal> over JSON LINES scans
+        # the raw bytes in C and parses only candidate rows; the full
+        # WHERE still runs on survivors, so semantics are unchanged
+        if req.input_opts.get("type", "LINES") == "LINES":
+            fast = _try_json_fast_path(query, data, req.input_opts)
+            if fast is not None:
+                reader = fast
 
     bytes_processed = len(data)      # bytes after decompression
     out_payload = bytearray()

@@ -51,6 +51,65 @@ def csv_records(data: bytes, opts: dict) -> Iterator[dict]:
         yield row
 
 
+_SCAN_LIB = None
+_SCAN_TRIED = False
+_OPS = {"=": 0, "!=": 1, "<>": 1, "<": 2, "<=": 3, ">": 4, ">=": 5}
+
+
+def _scan_lib():
+    global _SCAN_LIB, _SCAN_TRIED
+    if _SCAN_TRIED:
+        return _SCAN_LIB
+    import ctypes
+    import os as _os
+
+    from ..utils import nativelib
+    src = _os.path.join(_os.path.dirname(_os.path.dirname(
+        _os.path.dirname(_os.path.abspath(__file__)))), "native",
+        "jsonscan.cc")
+    so = _os.path.join(_os.path.dirname(src), "build", "libmtjscan.so")
+    lib = nativelib.load(src, so)
+    if lib is not None:
+        lib.mt_ndjson_filter.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p,
+            ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
+            ctypes.c_double, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t), ctypes.c_long]
+        lib.mt_ndjson_filter.restype = ctypes.c_long
+    _SCAN_LIB = lib
+    _SCAN_TRIED = True
+    return lib
+
+
+def ndjson_prefilter(data: bytes, field: str, op: str,
+                     value) -> list[tuple[int, int]] | None:
+    """Byte ranges of NDJSON rows that MIGHT satisfy `field op value`
+    (native/jsonscan.cc — the simdjson-role scanner): conservative-
+    exact, so callers re-evaluate the full WHERE on survivors.  None =
+    fast path unavailable (no native lib / unsupported op or type)."""
+    import ctypes
+    lib = _scan_lib()
+    if lib is None or op not in _OPS:
+        return None
+    if isinstance(value, bool) or value is None:
+        return None
+    if isinstance(value, (int, float)):
+        kind, num, sval = 0, float(value), b""
+    elif isinstance(value, str):
+        kind, num, sval = 1, 0.0, value.encode()
+    else:
+        return None
+    cap = max(1024, data.count(b"\n") + 2)
+    while True:
+        out = (ctypes.c_size_t * (2 * cap))()
+        got = lib.mt_ndjson_filter(
+            data, len(data), field.encode(), len(field.encode()),
+            _OPS[op], kind, num, sval, len(sval), out, cap)
+        if got >= 0:
+            return [(out[2 * i], out[2 * i + 1]) for i in range(got)]
+        cap *= 2
+
+
 def json_records(data: bytes, opts: dict) -> Iterator[dict]:
     jtype = opts.get("type", "LINES")
     text = data.decode("utf-8", errors="replace")

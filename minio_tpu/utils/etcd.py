@@ -1,0 +1,133 @@
+"""etcd v3 client over the grpc-gateway JSON API (cmd/etcd.go role).
+
+The reference links the etcd3 gRPC client (go.mod) for config/IAM
+storage and CoreDNS federation records.  This image has no gRPC stack,
+but every etcd v3 server also exposes the SAME KV API through its
+grpc-gateway: plain HTTP POSTs of JSON bodies with base64 keys/values
+(/v3/kv/put, /v3/kv/range, /v3/kv/deleterange) — full fidelity for the
+put/get/prefix/delete surface the framework needs.  Tested against an
+in-process stub speaking the identical wire protocol
+(tests/etcd_stub.py), the same pattern the OIDC and LDAP subsystems
+use in this zero-egress environment.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.error
+import urllib.request
+
+
+class EtcdError(Exception):
+    pass
+
+
+def _b64(data: bytes | str) -> str:
+    if isinstance(data, str):
+        data = data.encode()
+    return base64.b64encode(data).decode()
+
+
+def _unb64(s: str) -> bytes:
+    return base64.b64decode(s)
+
+
+def prefix_range_end(prefix: bytes) -> bytes:
+    """etcd prefix query: range_end = prefix with last byte + 1."""
+    p = bytearray(prefix)
+    for i in reversed(range(len(p))):
+        if p[i] < 0xFF:
+            p[i] += 1
+            return bytes(p[:i + 1])
+    return b"\x00"                     # whole keyspace
+
+
+class EtcdClient:
+    """Minimal KV client: put / get / get_prefix / delete(_prefix)."""
+
+    def __init__(self, endpoints: list[str] | str, timeout: float = 10.0):
+        if isinstance(endpoints, str):
+            endpoints = [e.strip() for e in endpoints.split(",")
+                         if e.strip()]
+        if not endpoints:
+            raise EtcdError("no etcd endpoints configured")
+        self._eps = [e.rstrip("/") if e.startswith("http")
+                     else f"http://{e.rstrip('/')}" for e in endpoints]
+        self._timeout = timeout
+
+    def _call(self, path: str, body: dict) -> dict:
+        payload = json.dumps(body).encode()
+        last: Exception | None = None
+        for ep in self._eps:           # failover across endpoints
+            try:
+                req = urllib.request.Request(
+                    ep + path, data=payload,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(
+                        req, timeout=self._timeout) as resp:
+                    return json.loads(resp.read() or b"{}")
+            except (urllib.error.URLError, OSError,
+                    json.JSONDecodeError) as e:
+                last = e
+                continue
+        raise EtcdError(f"all etcd endpoints failed: {last}")
+
+    def put(self, key: str | bytes, value: bytes | str) -> None:
+        self._call("/v3/kv/put", {"key": _b64(key), "value": _b64(value)})
+
+    def put_if_absent(self, key: str | bytes,
+                      value: bytes | str) -> bool:
+        """Atomic create (etcd txn with a create-revision guard): True
+        when this call created the key, False when it already existed —
+        the primitive federated MakeBucket races on (the reference uses
+        the same etcd transaction)."""
+        out = self._call("/v3/kv/txn", {
+            "compare": [{"key": _b64(key), "target": "CREATE",
+                         "result": "EQUAL", "create_revision": "0"}],
+            "success": [{"request_put": {"key": _b64(key),
+                                         "value": _b64(value)}}],
+            "failure": [],
+        })
+        return bool(out.get("succeeded"))
+
+    def get(self, key: str | bytes) -> bytes | None:
+        out = self._call("/v3/kv/range", {"key": _b64(key)})
+        kvs = out.get("kvs") or []
+        return _unb64(kvs[0]["value"]) if kvs else None
+
+    def get_prefix(self, prefix: str | bytes) -> list[tuple[bytes, bytes]]:
+        p = prefix.encode() if isinstance(prefix, str) else prefix
+        out = self._call("/v3/kv/range", {
+            "key": _b64(p), "range_end": _b64(prefix_range_end(p))})
+        return [(_unb64(kv["key"]), _unb64(kv["value"]))
+                for kv in out.get("kvs") or []]
+
+    def delete(self, key: str | bytes) -> int:
+        out = self._call("/v3/kv/deleterange", {"key": _b64(key)})
+        return int(out.get("deleted", 0))
+
+    def delete_prefix(self, prefix: str | bytes) -> int:
+        p = prefix.encode() if isinstance(prefix, str) else prefix
+        out = self._call("/v3/kv/deleterange", {
+            "key": _b64(p), "range_end": _b64(prefix_range_end(p))})
+        return int(out.get("deleted", 0))
+
+    def status(self) -> bool:
+        try:
+            self._call("/v3/kv/range", {"key": _b64(b"\x00")})
+            return True
+        except EtcdError:
+            return False
+
+
+def from_config(cfg) -> EtcdClient | None:
+    """Build a client from the `etcd` config subsystem (None when the
+    subsystem is unconfigured — callers fall back to drive storage)."""
+    try:
+        eps = cfg.get("etcd", "endpoints")
+    except KeyError:
+        return None
+    if not eps:
+        return None
+    return EtcdClient(eps)

@@ -9,8 +9,9 @@ through DNS.  Here the record store is pluggable:
     zero-egress stand-in for etcd that still coordinates multiple
     server processes on one host/NFS mount (tests and local
     federations use this);
-  * EtcdDNSStore — gated on the etcd3 client library, which is not in
-    this image.
+  * EtcdDNSStore — real etcd records through the v3 JSON gateway
+    (utils/etcd.py) in the CoreDNS/skydns key layout, with an atomic
+    create transaction guarding bucket-name races.
 
 FederationSys wires a store to a server: register/unregister on bucket
 create/delete, and `lookup_other` drives a 307 redirect for buckets
@@ -111,18 +112,60 @@ class FileDNSStore:
 
 
 class EtcdDNSStore:
-    """etcd-backed store (pkg/dns/etcd_dns.go) — gated: the etcd3
-    client library is not in this image."""
+    """etcd-backed store (pkg/dns/etcd_dns.go) with the CoreDNS/skydns
+    key layout: a record for bucket `b` under domain `example.com`
+    lives at /skydns/com/example/b — the exact keys CoreDNS's etcd
+    plugin serves SRV/A answers from, so a real CoreDNS pointed at the
+    same etcd resolves the federation without any extra glue."""
 
-    def __init__(self, endpoints: list[str], domain: str):
-        try:
-            import etcd3  # noqa: F401
-        except ImportError:
-            raise DNSError(
-                "etcd federation requires the etcd3 client library "
-                "(not installed in this build)") from None
-        raise DNSError("etcd federation backend not implemented "
-                       "in this build")
+    def __init__(self, endpoints, domain: str):
+        from .etcd import EtcdClient
+        self._c = EtcdClient(endpoints)
+        parts = [p for p in domain.strip(".").split(".") if p]
+        self._base = "/skydns/" + "/".join(reversed(parts))
+
+    def _key(self, bucket: str) -> str:
+        return f"{self._base}/{bucket}"
+
+    def put(self, rec: DNSRecord, replace: bool = False) -> None:
+        # skydns record shape (pkg/dns/etcd_dns.go SrvRecord)
+        blob = json.dumps({
+            "host": rec.host, "port": rec.port, "ttl": 30,
+            "creationDate": rec.created_ns}).encode()
+        if replace:
+            self._c.put(self._key(rec.bucket), blob)
+            return
+        # ATOMIC create via etcd txn: two clusters racing MakeBucket on
+        # the same name must see exactly one winner (a get-then-put
+        # would let both succeed; the reference guards with the same
+        # create-revision transaction)
+        if self._c.put_if_absent(self._key(rec.bucket), blob):
+            return
+        existing = self.get(rec.bucket)
+        if existing is not None and \
+                (existing.host, existing.port) == (rec.host, rec.port):
+            return                      # already ours: idempotent
+        raise BucketTaken(rec.bucket)
+
+    def get(self, bucket: str) -> Optional[DNSRecord]:
+        blob = self._c.get(self._key(bucket))
+        if blob is None:
+            return None
+        d = json.loads(blob)
+        return DNSRecord(bucket, d["host"], int(d["port"]),
+                         int(d.get("creationDate", 0)))
+
+    def delete(self, bucket: str) -> None:
+        self._c.delete(self._key(bucket))
+
+    def list(self) -> list[DNSRecord]:
+        out = []
+        for k, v in self._c.get_prefix(self._base + "/"):
+            bucket = k.decode().rsplit("/", 1)[-1]
+            d = json.loads(v)
+            out.append(DNSRecord(bucket, d["host"], int(d["port"]),
+                                 int(d.get("creationDate", 0))))
+        return out
 
 
 class FederationSys:
@@ -140,9 +183,6 @@ class FederationSys:
                     port: int) -> "FederationSys | None":
         if cfg.get("federation", "enable") != "on":
             return None
-        path = cfg.get("federation", "dns_file")
-        if not path:
-            raise DNSError("federation.dns_file required")
         # DNS records must carry a ROUTABLE owner address: a wildcard
         # bind would make every cluster look like the owner of every
         # bucket and emit http://0.0.0.0 redirects
@@ -154,8 +194,22 @@ class FederationSys:
             raise DNSError(
                 "federation with a wildcard bind requires "
                 "federation.advertise=<host:port>")
-        return cls(FileDNSStore(path), cfg.get("federation", "domain"),
-                   host, port)
+        domain = cfg.get("federation", "domain")
+        # etcd-backed records (cmd/etcd.go + pkg/dns/etcd_dns.go) when
+        # the etcd subsystem is configured; shared-file store otherwise
+        try:
+            etcd_eps = cfg.get("etcd", "endpoints")
+        except KeyError:
+            etcd_eps = ""
+        if etcd_eps:
+            return cls(EtcdDNSStore(etcd_eps, domain), domain,
+                       host, port)
+        path = cfg.get("federation", "dns_file")
+        if not path:
+            raise DNSError(
+                "federation requires etcd.endpoints or "
+                "federation.dns_file")
+        return cls(FileDNSStore(path), domain, host, port)
 
     def _is_self(self, rec: DNSRecord) -> bool:
         return (rec.host, rec.port) == (self.self_host, self.self_port)

@@ -77,6 +77,12 @@ register_subsys("federation", {
     "dns_file": "",                 # FileDNSStore path (etcd stand-in)
     "advertise": "",                # routable host:port in DNS records
 })
+register_subsys("etcd", {
+    # cmd/config/etcd/etcd.go keys: the coordination backend for
+    # config/IAM storage and CoreDNS federation records
+    "endpoints": "",                # comma-separated http://host:port
+    "path_prefix": "",              # namespace all keys (multi-tenant)
+})
 register_subsys("identity_ldap", {
     # cmd/config/identity/ldap/config.go keys, 1:1
     "server_addr": "",

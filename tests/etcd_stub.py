@@ -1,0 +1,100 @@
+"""In-process stub etcd server: the v3 grpc-gateway JSON KV surface.
+
+Implements /v3/kv/put, /v3/kv/range (point + range_end prefix), and
+/v3/kv/deleterange with base64 keys/values — byte-compatible with what
+a real etcd answers on those routes, so minio_tpu.utils.etcd is tested
+against the actual wire shapes (zero-egress analog of a real cluster,
+like the OIDC/LDAP stubs)."""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class StubEtcd:
+    def __init__(self):
+        self.kv: dict[bytes, bytes] = {}
+        self._mu = threading.Lock()
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(n) or b"{}")
+                key = base64.b64decode(body.get("key", ""))
+                range_end = base64.b64decode(body["range_end"]) \
+                    if "range_end" in body else None
+                out: dict = {}
+                with stub._mu:
+                    if self.path == "/v3/kv/put":
+                        stub.kv[key] = base64.b64decode(
+                            body.get("value", ""))
+                    elif self.path == "/v3/kv/range":
+                        kvs = []
+                        for k in sorted(stub.kv):
+                            if (range_end is None and k == key) or \
+                                    (range_end is not None
+                                     and key <= k < range_end):
+                                kvs.append({
+                                    "key":
+                                        base64.b64encode(k).decode(),
+                                    "value": base64.b64encode(
+                                        stub.kv[k]).decode()})
+                        out = {"kvs": kvs, "count": str(len(kvs))}
+                    elif self.path == "/v3/kv/txn":
+                        # the create-revision-guard transaction shape
+                        # put_if_absent sends (compare CREATE == 0)
+                        cmp = (body.get("compare") or [{}])[0]
+                        ckey = base64.b64decode(cmp.get("key", ""))
+                        absent = ckey not in stub.kv
+                        if absent:
+                            for req in body.get("success") or []:
+                                rp = req.get("request_put") or {}
+                                stub.kv[base64.b64decode(rp["key"])] = \
+                                    base64.b64decode(
+                                        rp.get("value", ""))
+                        out = {"succeeded": absent}
+                    elif self.path == "/v3/kv/deleterange":
+                        if range_end is None:
+                            deleted = 1 if stub.kv.pop(key, None) \
+                                is not None else 0
+                        else:
+                            dead = [k for k in stub.kv
+                                    if key <= k < range_end]
+                            for k in dead:
+                                del stub.kv[k]
+                            deleted = len(dead)
+                        out = {"deleted": str(deleted)}
+                    else:
+                        self.send_response(404)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
+                blob = json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._srv.daemon_threads = True
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+
+    def start(self) -> str:
+        self._thread.start()
+        host, port = self._srv.server_address
+        return f"http://{host}:{port}"
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
