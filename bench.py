@@ -335,6 +335,33 @@ def _bench_end_to_end_put() -> dict | None:
         body = os.urandom(obj_size)
         gib = n_obj * obj_size / 2**30
 
+        # hardware control: raw sequential buffered write + sync on the
+        # SAME filesystem, one plain file, no pipeline at all.  This VM's
+        # virtio disk is cgroup-throttled: the kernel's dirty throttling
+        # clamps sustained buffered writers to the device rate almost
+        # immediately, so the disk legs below are bounded by this number
+        # x (data/(data+parity)) no matter how fast the pipeline is.  It
+        # also explains the r3 strict>nocompat inversion: the FASTER
+        # writer hits balance_dirty_pages sooner and harder.
+        def raw_disk_gibps() -> float:
+            import tempfile as _tf
+            blk = body[:4 * (1 << 20)]
+            os.sync()
+            fd, path = _tf.mkstemp(prefix="bench-raw-", dir=tmp)
+            n = 0
+            t0 = time.perf_counter()
+            try:
+                while n < 512 * (1 << 20):
+                    os.write(fd, blk)
+                    n += len(blk)
+                os.close(fd)
+                os.sync()                       # include the flush
+                return n / (time.perf_counter() - t0) / 2**30
+            finally:
+                os.unlink(path)
+
+        raw_gibps = raw_disk_gibps()
+
         def drain():
             # writeback of a previous leg's ~1.4 GiB steals the one
             # vCPU mid-run (run-to-run swings of 2-4x measured) — flush
@@ -462,6 +489,8 @@ def _bench_end_to_end_put() -> dict | None:
             else:
                 os.environ["MT_NO_COMPAT"] = prev
 
+        # amplification: 4 MiB of data fans out to k+m/k framed bytes
+        amp = 16 / 12
         return {
             "disk_strict_GiBps": round(strict_gibps, 3),
             "disk_nocompat_GiBps": round(nocompat_gibps, 3),
@@ -469,6 +498,20 @@ def _bench_end_to_end_put() -> dict | None:
                                      if shm_gibps else None),
             "tmpfs_strict_GiBps": (round(shm_strict, 3)
                                    if shm_strict else None),
+            # hardware roofline for the disk legs: raw one-file
+            # sequential buffered write+sync on the same fs.  Data-rate
+            # bound for the pipeline = raw / (16/12 write amplification).
+            "disk_raw_seq_write_GiBps": round(raw_gibps, 3),
+            "disk_data_rate_bound_GiBps": round(raw_gibps / amp, 3),
+            # single-core strict bound: the md5 ETag is one sequential
+            # stream per object (S3 compat pins the algorithm); on this
+            # 1-vCPU VM nothing can overlap it, so strict <=
+            # obj_size/t_md5 even with a zero-cost pipeline.  The
+            # md5-in-parallel-with-encode overlap IS implemented
+            # (erasure_object._put_object_bytes) and engages when
+            # os.cpu_count() > 1.
+            "strict_md5_bound_GiBps": round(
+                obj_size / (t_md5 / 1000) / 2**30, 3),
             "stages_ms_per_4MiB": {
                 "md5_etag(strict only)": round(t_md5, 2),
                 "erasure_encode_into_frames": round(t_encode, 2),

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import time
 import uuid
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
@@ -190,6 +191,13 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         self.metacache = MetacacheManager(
             disks=[d for d in self.disks if d is not None],
             sys_volume=SYS_DIR)
+        # bucket-existence cache (bucketMetadataSys role for the hot
+        # path): a 16-drive stat fan-out per request re-verifies a fact
+        # that changes only through make/delete_bucket.  TTL-bounded for
+        # out-of-band wipes; a majority VolumeNotFound at commit time
+        # also evicts and surfaces BucketNotFound (see _commit_put).
+        self._bucket_ttl = 3.0
+        self._buckets_seen: dict[str, float] = {}
 
     # -- drive fan-out helpers --------------------------------------------
 
@@ -307,6 +315,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         return sorted(seen.values(), key=lambda b: b.name)
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        self._buckets_seen.pop(bucket, None)
         self.get_bucket_info(bucket)
         _, errs = self._fanout(lambda d: d.delete_vol(bucket, force))
         if any(isinstance(e, serrors.VolumeNotEmpty) for e in errs) \
@@ -314,7 +323,11 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             raise BucketNotEmpty(bucket)
 
     def _check_bucket(self, bucket: str) -> None:
+        exp = self._buckets_seen.get(bucket)
+        if exp is not None and time.monotonic() < exp:
+            return
         self.get_bucket_info(bucket)
+        self._buckets_seen[bucket] = time.monotonic() + self._bucket_ttl
 
     # -- PUT (cmd/erasure-object.go:614 putObject) ------------------------
 
@@ -352,7 +365,18 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         self._check_bucket(bucket)
         n = len(self.disks)
         k, m = self._geometry(opts.parity)
-        etag = self._etag_for(data, opts)
+        # Overlap the ETag md5 with erasure encode + bitrot framing:
+        # hashlib releases the GIL for large buffers, and so does the
+        # native gf8 matmul, so on multi-core hosts the two truly run
+        # in parallel (the reference overlaps its hash.Reader with the
+        # erasure goroutines the same way, pkg/hash/reader.go).  On a
+        # single-core host the handoff is pure overhead — skip it.
+        etag_future = None
+        if (not _SINGLE_CORE and len(data) >= (1 << 20)
+                and (opts.content_md5 or _strict_compat()) and m > 0):
+            etag_future = self._pool.submit(hashlib.md5, data)
+        etag = None if etag_future is not None \
+            else self._etag_for(data, opts)
         mod_time = opts.mod_time or now_ns()
         version_id = opts.version_id or (
             str(uuid.uuid4()) if opts.versioned else "")
@@ -371,6 +395,12 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             fresh=True)
 
         framed = self._encode_and_frame(data, m, fi)
+        if etag_future is not None:
+            etag = etag_future.result().hexdigest()
+            if opts.content_md5 and etag != opts.content_md5.lower():
+                raise serrors.StorageError("Content-MD5 mismatch (BadDigest)")
+            fi.metadata = {ETAG_KEY: etag, **opts.user_defined}
+            fi.parts = [ObjectPartInfo(1, size, size, etag, mod_time)]
 
         inline = size <= self.inline_threshold
         shuffled = meta.shuffle_disks(self.disks, distribution)
@@ -474,6 +504,11 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         _, errs = self._fanout_indexed(write_one, shuffled)
         try:
             meta.reduce_errs(errs, self._write_quorum(fi), WriteQuorumError)
+        except serrors.VolumeNotFound:
+            # bucket wiped out-of-band while the existence cache was
+            # warm: evict and report what a fresh stat would have said
+            self._buckets_seen.pop(bucket, None)
+            raise BucketNotFound(bucket) from None
         except serrors.StorageError as e:
             raise WriteQuorumError(str(e)) from e
         # failed writes become heal candidates (MRF analog,

@@ -43,12 +43,21 @@ _SYS_PREFIX = "metacache"       # under the drive SYS volume
 
 @dataclass
 class Metacache:
-    """One cached listing (cmd/metacache.go metacache struct)."""
+    """One cached listing (cmd/metacache.go metacache struct).
+
+    ``mgr``/``gen`` stamp WHICH manager wrote the snapshot at WHICH
+    bucket mutation generation: a loader that recognises its own mgr
+    uuid rejects any snapshot from an older generation outright, so a
+    stale file that slipped past the best-effort drop logic can never
+    serve a stale listing locally.  Foreign snapshots (other node /
+    restarted process) keep the TTL + update-tracker staleness rules."""
     id: str
     bucket: str
     prefix: str
     created: float
     entries: List[ObjectInfo] = field(default_factory=list)
+    mgr: str = ""
+    gen: int = -1
 
     def expired(self, ttl: float, now: float | None = None) -> bool:
         return ((now if now is not None else time.time())
@@ -97,7 +106,7 @@ def _cache_path(bucket: str, prefix: str) -> str:
 
 def _serialize(mc: Metacache) -> bytes:
     doc = {"id": mc.id, "bucket": mc.bucket, "prefix": mc.prefix,
-           "created": mc.created,
+           "created": mc.created, "mgr": mc.mgr, "gen": mc.gen,
            "entries": [asdict(e) for e in mc.entries]}
     return json.dumps(doc).encode()
 
@@ -110,7 +119,8 @@ def _deserialize(data: bytes) -> Metacache:
         entries.append(ObjectInfo(**e))
     return Metacache(id=doc["id"], bucket=doc["bucket"],
                      prefix=doc["prefix"], created=doc["created"],
-                     entries=entries)
+                     entries=entries, mgr=doc.get("mgr", ""),
+                     gen=doc.get("gen", -1))
 
 
 def managers_of(layer) -> list["MetacacheManager"]:
@@ -145,6 +155,25 @@ class MetacacheManager:
         self._sys_volume = sys_volume
         self.hits = 0
         self.misses = 0
+        # buckets whose on-disk snapshots are KNOWN absent: a PUT-heavy
+        # workload invalidates per write, and without this set each
+        # invalidate pays a per-drive recursive delete (16 ENOENT
+        # syscall rounds per PUT measured on the e2e bench).  A bucket
+        # leaves the set when a snapshot is persisted; it (re)enters
+        # after a disk-wide drop.  Snapshots written by an EARLIER
+        # process are handled by the first invalidate (bucket not yet
+        # in the set -> full drop runs once).
+        self._clean_buckets: set = set()
+        # per-bucket mutation generation: a walk that OVERLAPS a
+        # mutation must not install its (possibly stale) snapshot after
+        # the mutator's invalidate ran — the lost-invalidate race.  The
+        # walk captures the generation first and the snapshot is cached
+        # or persisted only if the bucket is untouched since.  The
+        # manager uuid + gen are also stamped INTO persisted snapshots
+        # so _load rejects this manager's own stale files even when the
+        # best-effort drop lost a race (see Metacache docstring).
+        self._gen: dict = {}
+        self._uuid = uuid.uuid4().hex
         # optional DataUpdateTracker: when attached, cache hits consult
         # the change bloom filter so a peer's write invalidates listings
         # immediately instead of after the TTL (the reference's
@@ -163,17 +192,34 @@ class MetacacheManager:
 
     # -- persistence -----------------------------------------------------
 
-    def _persist(self, mc: Metacache) -> None:
+    def _persist(self, mc: Metacache, gen0: int = -1) -> None:
         if not self._disks or not self._sys_volume:
             return
         blob = _serialize(mc)
+        with self._mu:
+            if gen0 >= 0 and self._gen.get(mc.bucket, 0) != gen0:
+                return              # bucket mutated since the walk
+            self._clean_buckets.discard(mc.bucket)
+        written = None
         for d in self._disks:
             try:
                 d.write_all(self._sys_volume,
                             _cache_path(mc.bucket, mc.prefix), blob)
-                return              # one copy is enough; it's a cache
+                written = d
+                break               # one copy is enough; it's a cache
             except Exception:       # noqa: BLE001 — next drive
                 continue
+        if written is not None and gen0 >= 0:
+            with self._mu:
+                fresh = self._gen.get(mc.bucket, 0) == gen0
+            if not fresh:
+                # invalidate raced the write and may have skipped its
+                # drop (clean-set fast path) — undo our own snapshot
+                try:
+                    written.delete(self._sys_volume,
+                                   _cache_path(mc.bucket, mc.prefix))
+                except Exception:   # noqa: BLE001 — best effort
+                    pass
 
     def _load(self, bucket: str, prefix: str) -> Optional[Metacache]:
         for d in self._disks:
@@ -181,6 +227,12 @@ class MetacacheManager:
                 blob = d.read_all(self._sys_volume,
                                   _cache_path(bucket, prefix))
                 mc = _deserialize(blob)
+                if mc.mgr == self._uuid:
+                    # our own snapshot: exact generation check beats
+                    # any TTL heuristic
+                    with self._mu:
+                        if mc.gen != self._gen.get(bucket, 0):
+                            return None
                 if not mc.expired(self._ttl):
                     return mc
                 return None
@@ -210,32 +262,52 @@ class MetacacheManager:
                     and not self._stale(mc):
                 self.hits += 1
                 return mc
+        with self._mu:
+            gen_at_load = self._gen.get(bucket, 0)
         mc = self._load(bucket, prefix)
         if mc is not None and not self._stale(mc):
             self.hits += 1
             with self._mu:
-                self._caches[key] = mc
+                # install only if the bucket is untouched since before
+                # the disk read — an invalidate racing this load must
+                # not have its cache clear overwritten by a snapshot it
+                # could not see (same guard as the walk path below)
+                if self._gen.get(bucket, 0) == gen_at_load:
+                    self._caches[key] = mc
             return mc
         self.misses += 1
+        with self._mu:
+            gen0 = self._gen.get(bucket, 0)
         entries = sorted(loader(), key=lambda o: o.name)
         mc = Metacache(id=uuid.uuid4().hex, bucket=bucket, prefix=prefix,
-                       created=now, entries=entries)
+                       created=now, entries=entries, mgr=self._uuid,
+                       gen=gen0)
         with self._mu:
+            if self._gen.get(bucket, 0) != gen0:
+                # bucket mutated mid-walk: serve the snapshot to THIS
+                # caller (S3 listings are eventually consistent) but do
+                # not install it — the next lookup re-walks
+                return mc
             if len(self._caches) >= self._max:
                 # evict oldest (manager keeps a bounded registry)
                 oldest = min(self._caches, key=lambda k:
                              self._caches[k].created)
                 del self._caches[oldest]
             self._caches[key] = mc
-        self._persist(mc)
+        self._persist(mc, gen0)
         return mc
 
     def invalidate(self, bucket: str) -> None:
         """Drop every cache for the bucket (local mutation hook)."""
         with self._mu:
+            self._gen[bucket] = self._gen.get(bucket, 0) + 1
             for key in [k for k in self._caches if k[0] == bucket]:
                 del self._caches[key]
+            if bucket in self._clean_buckets:
+                return              # nothing persisted since last drop
         self._drop_persisted(bucket)
+        with self._mu:
+            self._clean_buckets.add(bucket)
 
     def stats(self) -> dict:
         with self._mu:

@@ -198,8 +198,22 @@ class Erasure:
         tail_ss = gf8.ceil_frac(tail_len, k)
         F = digest + ssize
         flen = nfull * F + ((digest + tail_ss) if tail_len else 0)
-        # calloc-backed: digest slots and short-row padding start zero
-        out = np.zeros((k + m, flen), dtype=np.uint8)
+        # np.empty + targeted clears: every payload byte is overwritten
+        # below (data copy / native parity matmul), so a full calloc
+        # would memset ~6 MB per 4 MiB object only to overwrite it.
+        # Only the digest slots and the short-row padding gaps need
+        # zeroing (framing contract: digest filled later in place,
+        # padding must be zero for bit-identical shard math).
+        out = np.empty((k + m, flen), dtype=np.uint8)
+        if nfull:
+            fview = out[:, :nfull * F].reshape(k + m, nfull, F)
+            fview[:, :, :digest] = 0                  # digest slots
+            for i in range(k):                        # short data rows
+                ln = min(ssize, max(0, bs - i * ssize))
+                if ln < ssize:
+                    fview[i, :, digest + ln:] = 0
+        if tail_len:
+            out[:, nfull * F:] = 0                    # whole tail frame
         parity_rows = np.asarray(self.matrix)[k:]
         if nfull:
             src = buf[:nfull * bs].reshape(nfull, bs)

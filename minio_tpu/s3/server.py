@@ -691,10 +691,14 @@ def _make_handler(srv: S3Server):
             from ..admin import handlers as admin_handlers
             from ..admin.metrics import GLOBAL as mtr
             try:
-                if path.startswith("/minio-tpu/health/"):
+                if path.startswith(("/minio-tpu/health/",
+                                    "/minio/health/")):
                     # healthcheck router (cmd/healthcheck-router.go:40):
                     # unauthenticated, throttle-exempt — k8s probes must
-                    # reach it when the server is saturated or keyless
+                    # reach it when the server is saturated or keyless.
+                    # "/minio/health/*" is the reference's well-known
+                    # probe path — existing deployment manifests keep
+                    # working unchanged.
                     self._body()
                     return self._health_api(path, query)
                 if path == admin_handlers.METRICS_PATH:
@@ -737,9 +741,13 @@ def _make_handler(srv: S3Server):
                     if admin_handlers.handle(self, srv, path, query,
                                              payload):
                         return
-                if bucket == "minio-tpu":
-                    # reserved namespace (isMinioReservedBucket analog):
-                    # admin/metrics own this prefix; never an S3 bucket
+                if bucket in ("minio-tpu", "minio"):
+                    # reserved namespaces (isMinioReservedBucket,
+                    # cmd/generic-handlers.go): admin/metrics own
+                    # "minio-tpu"; "minio" is reserved exactly like the
+                    # reference reserves it, so the unauthenticated
+                    # /minio/health/* probe router can never shadow a
+                    # real bucket's objects
                     raise S3Error("AccessDenied")
                 if not bucket:
                     if self.command == "POST":
@@ -968,7 +976,7 @@ def _make_handler(srv: S3Server):
         def _health_api(self, path, query):
             if self.command not in ("GET", "HEAD"):
                 raise S3Error("MethodNotAllowed")
-            leaf = path[len("/minio-tpu/health/"):]
+            leaf = path.split("/health/", 1)[1]
             status = 200
             headers = {}
             if leaf == "cluster":

@@ -27,11 +27,37 @@
 
 static uint8_t MUL[256][256];
 static bool g_have_avx2 = false;
+static bool g_have_gfni = false;
+
+// GFNI path: multiply-by-c is a linear map over GF(2), so it is one
+// VGF2P8AFFINEQB against an 8x8 bit matrix.  AFF[c] packs that matrix
+// in the instruction's layout, derived from the injected MUL table (so
+// any field table Python hands us stays authoritative).  Convention:
+// out_bit[i] = parity(matrix.byte[7-i] & in_byte), hence byte 7-i of
+// the qword holds, at bit j, bit i of MUL[c][1<<j].
+static uint64_t AFF[256];
+
+static void build_affine_tables() {
+    for (int c = 0; c < 256; c++) {
+        uint8_t bytes[8];
+        for (int i = 0; i < 8; i++) {
+            uint8_t row = 0;
+            for (int j = 0; j < 8; j++)
+                row |= (uint8_t)(((MUL[c][1u << j] >> i) & 1) << j);
+            bytes[7 - i] = row;
+        }
+        std::memcpy(&AFF[c], bytes, 8);
+    }
+}
 
 extern "C" void mt_gf8_init(const uint8_t* mul_table) {
     std::memcpy(MUL, mul_table, sizeof(MUL));
+    build_affine_tables();
 #if MT_X86
     g_have_avx2 = __builtin_cpu_supports("avx2");
+    g_have_gfni = __builtin_cpu_supports("gfni")
+        && __builtin_cpu_supports("avx512f")
+        && __builtin_cpu_supports("avx512bw");
 #endif
 }
 
@@ -101,12 +127,82 @@ extern "C" void mt_gf8_xor(const uint8_t* src, uint8_t* dst, size_t n) {
     for (; i < n; i++) dst[i] ^= src[i];
 }
 
+#if MT_X86
+// GFNI kernel: JN output rows fused per pass so each 64-byte source
+// vector is loaded once and feeds JN accumulators held in zmm regs —
+// source and destination bytes move exactly once per row group.
+// Instruction economy: one VGF2P8AFFINEQB + one VPXORQ per (i, j)
+// coefficient per 64 bytes (the klauspost GFNI design point,
+// reedsolomon galois_amd64.s mulGFNI_*).
+template <int JN>
+__attribute__((target("gfni,avx512f,avx512bw")))
+static void matmul_gfni_rows(const uint8_t* A, size_t r, size_t k,
+                             const uint8_t* B, size_t b_stride,
+                             uint8_t* out, size_t o_stride,
+                             size_t len, size_t j0) {
+    size_t pos = 0;
+    for (; pos + 64 <= len; pos += 64) {
+        __m512i acc[JN];
+        for (int j = 0; j < JN; j++) acc[j] = _mm512_setzero_si512();
+        for (size_t i = 0; i < k; i++) {
+            __m512i v = _mm512_loadu_si512(
+                (const void*)(B + i * b_stride + pos));
+            for (int j = 0; j < JN; j++) {
+                __m512i m = _mm512_set1_epi64(
+                    (long long)AFF[A[(j0 + j) * k + i]]);
+                acc[j] = _mm512_xor_si512(
+                    acc[j], _mm512_gf2p8affine_epi64_epi8(v, m, 0));
+            }
+        }
+        for (int j = 0; j < JN; j++)
+            _mm512_storeu_si512((void*)(out + (j0 + j) * o_stride + pos),
+                                acc[j]);
+    }
+    if (pos < len) {                     // scalar tail, < 64 bytes
+        for (int j = 0; j < JN; j++) {
+            uint8_t* dst = out + (j0 + j) * o_stride + pos;
+            std::memset(dst, 0, len - pos);
+            for (size_t i = 0; i < k; i++) {
+                uint8_t c = A[(j0 + j) * k + i];
+                if (c == 1) mt_gf8_xor(B + i * b_stride + pos, dst,
+                                       len - pos);
+                else mul_xor(c, B + i * b_stride + pos, dst, len - pos);
+            }
+        }
+    }
+}
+
+__attribute__((target("gfni,avx512f,avx512bw")))
+static void matmul_gfni(const uint8_t* A, size_t r, size_t k,
+                        const uint8_t* B, size_t b_stride,
+                        uint8_t* out, size_t o_stride, size_t len) {
+    size_t j0 = 0;
+    for (; j0 + 4 <= r; j0 += 4)
+        matmul_gfni_rows<4>(A, r, k, B, b_stride, out, o_stride, len, j0);
+    switch (r - j0) {
+        case 3: matmul_gfni_rows<3>(A, r, k, B, b_stride, out, o_stride,
+                                    len, j0); break;
+        case 2: matmul_gfni_rows<2>(A, r, k, B, b_stride, out, o_stride,
+                                    len, j0); break;
+        case 1: matmul_gfni_rows<1>(A, r, k, B, b_stride, out, o_stride,
+                                    len, j0); break;
+        default: break;
+    }
+}
+#endif
+
 // out (r, len) = A (r, k)  x  B (k, len)  over GF(2^8), XOR-accumulate.
 // B rows and out rows are contiguous with the given strides (in bytes),
 // so callers can point straight into a (k, shard) numpy array.
 extern "C" void mt_gf8_matmul(const uint8_t* A, size_t r, size_t k,
                               const uint8_t* B, size_t b_stride,
                               uint8_t* out, size_t o_stride, size_t len) {
+#if MT_X86
+    if (g_have_gfni && r > 0) {
+        matmul_gfni(A, r, k, B, b_stride, out, o_stride, len);
+        return;
+    }
+#endif
     for (size_t j = 0; j < r; j++) {
         uint8_t* dst = out + j * o_stride;
         std::memset(dst, 0, len);
