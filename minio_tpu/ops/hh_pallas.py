@@ -42,9 +42,12 @@ from . import hh_kernels as hk
 
 _U32 = jnp.uint32
 
-# packets per grid step: VMEM block is 8 limb planes x PC packets x
-# (S x 128) shards x 4 B; with S=8 and PC=128 that's 4 MiB
-_PC = 128
+# packets per grid step.  The kernel holds the input block AND its
+# in-VMEM byte-plane transpose simultaneously (plus double-buffered
+# prefetch), so the chunk is sized to keep the working set well under
+# the 16 MiB scoped-vmem limit: 64 packets -> 2 MiB block,
+# 2+2+2 MiB resident (a 128-packet chunk measured 17 MiB > limit)
+_PC_NAT = 64
 
 
 def _update_lanes(state, lanes):
@@ -106,16 +109,21 @@ def _unflatten(flat):
     return state
 
 
-def _kernel(in_ref, out_ref, st, *, S, n_packets, init_consts):
-    """Grid step: _PC packets x (S, 128) shards, byte-plane input.
+def _kernel_nat(in_ref, out_ref, st, tbuf, *, S, n_packets, init_consts):
+    """Grid step over NATURAL-layout shard bytes: in_ref is
+    (S*128, _PC_NAT*32) uint8 — rows are shards, columns byte offsets.
 
-    in_ref: (_PC*32, S, 128) uint8 — TRANSPOSED shard bytes: row r is
-    byte r of every shard in the tile.  The u32 limb assembly happens
-    here in VMEM: a u8->u32 bitcast+transpose at the XLA level measured
-    31 GiB/s (catastrophic fused gather) while the plain u8 transpose
-    runs at ~306 GiB/s, so the kernel takes bytes and builds words with
-    shifts (3 ops per word) on full (S, 128) tiles.
-    """
+    The byte-plane transpose happens HERE, in VMEM, as the kernel
+    prologue (swapaxes into the ``tbuf`` scratch), instead of as a
+    separate pallas transpose kernel: the standalone transpose costs a
+    full extra HBM round trip of the entire operand (~2 ms per 340 MiB
+    step measured on v5e), which was the single largest serial stage
+    left in the fused encode+bitrot pipeline (BENCH_r03 detail).  The
+    packet loop is the standard revisiting-accumulator pattern: state
+    lives in the ``st`` scratch, carried across the packet-chunk grid
+    dimension; the tail chunk is handled by the loop BOUND, not
+    per-packet selects (masking the 32 carried limb planes measured
+    8.5x the whole update)."""
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -123,10 +131,12 @@ def _kernel(in_ref, out_ref, st, *, S, n_packets, init_consts):
         for idx, c in enumerate(init_consts):
             st[idx] = jnp.full((S, 128), np.uint32(c), _U32)
 
+    tbuf[:] = jnp.swapaxes(in_ref[:], 0, 1).reshape(_PC_NAT * 32, S, 128)
+
     carry0 = tuple(st[idx] for idx in range(32))
 
     def body(p, carry):
-        x = in_ref[pl.ds(p * 32, 32)].astype(_U32)   # (32, S, 128)
+        x = tbuf[pl.ds(p * 32, 32)].astype(_U32)     # (32, S, 128)
         lanes = []
         for lane in range(4):
             b = 8 * lane
@@ -138,11 +148,7 @@ def _kernel(in_ref, out_ref, st, *, S, n_packets, init_consts):
         return tuple(_flatten(_update_lanes(_unflatten(list(carry)),
                                             lanes)))
 
-    # tail handling via the loop BOUND, not per-packet selects: masking
-    # each of the 32 carried limb planes with jnp.where cost 8.5x the
-    # whole update (measured 16 -> 136 GiB/s when removed).  Packets
-    # past n_packets in the final chunk are simply never executed.
-    valid = jnp.minimum(_PC, n_packets - j * _PC)
+    valid = jnp.minimum(_PC_NAT, n_packets - j * _PC_NAT)
     final = jax.lax.fori_loop(0, valid, body, carry0)
     for idx in range(32):
         st[idx] = final[idx]
@@ -153,59 +159,30 @@ def _kernel(in_ref, out_ref, st, *, S, n_packets, init_consts):
             out_ref[0, idx] = st[idx]
 
 
-_TT = 2048       # byte columns per transpose grid step (VMEM-bounded)
-
-
-def _tkern(in_ref, out_ref, *, S):
-    x = in_ref[:]                              # (S*128, _TT) u8
-    out_ref[:] = jnp.swapaxes(x, 0, 1).reshape(_TT, S, 128)
-
-
-def _transpose(blocks, S, interpret):
-    """(B, n) u8 -> (n, B//128, 128) byte planes, as a pallas kernel.
-
-    This MUST be a kernel, not an XLA transpose: any XLA-op-produced
-    3-D u8 operand reaches a pallas call through a layout-conversion
-    copy that measures ~45 GB/s on v5e (the custom call constrains
-    operand layouts; XLA's preferred layout for the transpose output
-    differs).  Kernel-to-kernel handoff keeps the canonical layout end
-    to end: the in-VMEM swapaxes sustains ~157 GiB/s and the downstream
-    hash kernel then runs at its full ~140 GiB/s instead of 34.
-    """
-    B, n = blocks.shape
-    return pl.pallas_call(
-        functools.partial(_tkern, S=S),
-        grid=(B // (S * 128), n // _TT),
-        in_specs=[pl.BlockSpec((S * 128, _TT), lambda i, j: (i, j))],
-        out_specs=pl.BlockSpec((_TT, S, 128), lambda i, j: (j, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, B // 128, 128), jnp.uint8),
-        interpret=interpret,
-    )(blocks)
-
-
 @functools.partial(jax.jit, static_argnames=("n_packets", "S"))
-def _run(t8, n_packets, S):
-    """t8: (P_pad*32, NB*S, 128) uint8 transposed shard bytes (row-major
-    byte planes).  Returns (NB, 32, S, 128) u32 state planes."""
-    rows, tiles, _ = t8.shape
-    nb = tiles // S
-    npc = rows // (32 * _PC)
+def _run_nat(x2d, n_packets, S):
+    """x2d: (B_pad, P_pad*32) uint8 natural-layout shard bytes (row =
+    one shard).  Returns (NB, 32, S, 128) u32 state planes.  2-D u8
+    operands reach pallas in canonical layout, so no XLA layout copy
+    sits between the producer kernel and this one."""
+    bt, cols = x2d.shape
+    nb = bt // (S * 128)
+    npc = cols // (32 * _PC_NAT)
     init = _init_consts()
-    kernel = functools.partial(_kernel, S=S, n_packets=n_packets,
+    kernel = functools.partial(_kernel_nat, S=S, n_packets=n_packets,
                                init_consts=init)
     return pl.pallas_call(
         kernel,
         grid=(nb, npc),
-        in_specs=[pl.BlockSpec((_PC * 32, S, 128),
-                               lambda i, j: (j, i, 0))],
+        in_specs=[pl.BlockSpec((S * 128, _PC_NAT * 32),
+                               lambda i, j: (i, j))],
         out_specs=pl.BlockSpec((1, 32, S, 128),
                                lambda i, j: (i, 0, 0, 0)),
         out_shape=jax.ShapeDtypeStruct((nb, 32, S, 128), _U32),
-        scratch_shapes=[pltpu.VMEM((32, S, 128), _U32)],
-        # CPU (tests / virtual meshes): run the kernel in the pallas
-        # interpreter — same program, no Mosaic
+        scratch_shapes=[pltpu.VMEM((32, S, 128), _U32),
+                        pltpu.VMEM((_PC_NAT * 32, S, 128), jnp.uint8)],
         interpret=jax.default_backend() != "tpu",
-    )(t8)
+    )(x2d)
 
 
 @functools.lru_cache(maxsize=1)
@@ -244,19 +221,21 @@ def hh256_batch(blocks, key: bytes = MAGIC_KEY):
     S = G if G < 8 else 8
     tb = S * 128
     b_pad = -B % tb
-    p_pad = -P % _PC
+    p_pad = -P % _PC_NAT
     # pad in 2-D BYTE layout (safe: 2-D u8 operands reach pallas in
-    # canonical layout), then kernel-to-kernel: pallas transpose ->
-    # pallas hash.  See _transpose for why no XLA op may produce the
-    # 3-D byte planes.
+    # canonical layout), then ONE kernel: the byte-plane transpose is
+    # the hash kernel's in-VMEM prologue (_kernel_nat), so the operand
+    # crosses HBM exactly once.  Two designs this replaced, both
+    # measured: a standalone pallas transpose kernel costs an extra
+    # full HBM read+write of the operand (capped the fused pipeline at
+    # 20.65 GiB/s, r3); an XLA-op-produced 3-D u8 operand reaches a
+    # pallas call through a ~45 GB/s layout-conversion copy (r2).
     x = blocks[:, :P * 32]
     if b_pad or p_pad:
         x = jnp.pad(x, ((0, b_pad), (0, p_pad * 32)))
     bt = B + b_pad
-    interp = jax.default_backend() != "tpu"
-    t8 = _transpose(x, S, interp)                # ((P+pad)*32, bt//128, 128)
 
-    planes = _run(t8, P, S)                      # (NB, 32, S, 128)
+    planes = _run_nat(x, P, S)                   # (NB, 32, S, 128)
     flat = [planes[:, idx].reshape(bt)[:B] for idx in range(32)]
     state = _unflatten(flat)
     # reassemble (B, 4) limb arrays for the existing finalize path
