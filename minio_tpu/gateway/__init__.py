@@ -112,7 +112,9 @@ def lookup(kind: str) -> type:
             f"{', '.join(sorted(_REGISTRY))}") from None
 
 
-from . import nas, s3, cloud, memory  # noqa: E402  (populate the registry)
+from . import (nas, s3, cloud, memory,  # noqa: E402  (populate registry)
+               azure, gcs)
 
 __all__ = ["Gateway", "GatewayError", "GatewayNotAvailable",
-           "GatewayUnsupported", "register", "lookup", "nas", "s3", "cloud", "memory"]
+           "GatewayUnsupported", "register", "lookup", "nas", "s3",
+           "cloud", "memory", "azure", "gcs"]

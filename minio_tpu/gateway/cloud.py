@@ -1,13 +1,12 @@
-"""Gated cloud gateways — azure / gcs / hdfs.
+"""Gated cloud gateways — hdfs.
 
-Reference implementations: cmd/gateway/azure/gateway-azure.go,
-cmd/gateway/gcs/gateway-gcs.go, cmd/gateway/hdfs/gateway-hdfs.go.
-Their client SDKs (azure-storage-blob, google-cloud-storage, pyarrow
-HDFS) are not in this image and the environment has zero egress, so
-these register as gated: `new_gateway_layer` probes for the SDK and
-raises GatewayNotAvailable with the requirement, keeping the CLI
-surface (`minio gateway azure ...`) and registry parity with the
-reference while failing loudly instead of pretending.
+azure and gcs graduated to real wire-protocol clients in round 4
+(gateway/azure.py, gateway/gcs.py — the LDAP/etcd own-client pattern);
+hdfs remains gated: its wire protocol is Hadoop RPC over SASL with
+protobuf framing plus a DataNode streaming protocol — a full client is
+out of scope and pyarrow's bindings are not in this image, so it
+registers as gated and fails loudly with the requirement instead of
+pretending (reference: cmd/gateway/hdfs/gateway-hdfs.go:1).
 """
 
 from __future__ import annotations
@@ -44,20 +43,6 @@ class _GatedGateway(Gateway):
         self._sdk()
         raise GatewayNotAvailable(
             f"{self.KIND} gateway backend not implemented in this build")
-
-
-@register("azure")
-class AzureGateway(_GatedGateway):
-    KIND = "azure"
-    SDK_MODULE = "azure.storage.blob"
-    SDK_HINT = "the azure-storage-blob SDK"
-
-
-@register("gcs")
-class GCSGateway(_GatedGateway):
-    KIND = "gcs"
-    SDK_MODULE = "google.cloud.storage"
-    SDK_HINT = "the google-cloud-storage SDK"
 
 
 @register("hdfs")

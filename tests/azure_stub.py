@@ -1,0 +1,339 @@
+"""In-process Azure Blob service stub — wire-protocol test double.
+
+The LDAP/etcd stub pattern applied to the Blob service: a real HTTP
+server on a localhost socket that implements the subset of the Blob
+REST surface the azure gateway uses (containers, block blobs, staged
+blocks + block lists, ranges, server-side copy, XML listings) over the
+FakeBlobService semantics from gateway/memory.py, and — critically —
+VERIFIES SharedKey authorization by recomputing the signature from the
+raw request, so the client's canonicalization is conformance-tested on
+every call (a wrong string-to-sign fails the whole suite, not just a
+unit check).
+"""
+
+from __future__ import annotations
+
+import base64
+import email.utils
+import hashlib
+import hmac
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlsplit
+from xml.sax.saxutils import escape
+
+from minio_tpu.gateway.memory import FakeBlobService
+
+ACCOUNT = "devstoreaccount1"
+KEY_B64 = base64.b64encode(b"stub-shared-key-32-bytes-exactly!").decode()
+
+
+def _httpdate(ns: int) -> str:
+    return email.utils.formatdate(ns / 1e9, usegmt=True)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "AzureBlobStub/1.0"
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    # -- auth -------------------------------------------------------------
+
+    def _verify_auth(self, body: bytes) -> bool:
+        auth = self.headers.get("Authorization", "")
+        if not auth.startswith("SharedKey "):
+            return False
+        acct, _, sig = auth[len("SharedKey "):].partition(":")
+        if acct != ACCOUNT:
+            return False
+        u = urlsplit(self.path)
+        # path arrives as /<account>/<resource...>
+        path = unquote(u.path)
+        prefix = f"/{ACCOUNT}"
+        res_path = path[len(prefix):] if path.startswith(prefix) else path
+        q = {k: ",".join(v)
+             for k, v in parse_qs(u.query, keep_blank_values=True).items()}
+        std = {k.lower(): v for k, v in self.headers.items()}
+        ms = sorted((k.lower(), v) for k, v in self.headers.items()
+                    if k.lower().startswith("x-ms-"))
+        canon_headers = "".join(f"{k}:{v}\n" for k, v in ms)
+        res = f"/{ACCOUNT}{prefix}{res_path}"
+        for k in sorted(q):
+            res += f"\n{k.lower()}:{q[k]}"
+        sts = "\n".join([
+            self.command,
+            std.get("content-encoding", ""),
+            std.get("content-language", ""),
+            str(len(body)) if body else "",
+            std.get("content-md5", ""),
+            std.get("content-type", ""),
+            "",
+            std.get("if-modified-since", ""),
+            std.get("if-match", ""),
+            std.get("if-none-match", ""),
+            std.get("if-unmodified-since", ""),
+            std.get("range", ""),
+        ]) + "\n" + canon_headers + res
+        want = base64.b64encode(
+            hmac.new(base64.b64decode(KEY_B64), sts.encode(),
+                     hashlib.sha256).digest()).decode()
+        return hmac.compare_digest(want, sig)
+
+    # -- plumbing ---------------------------------------------------------
+
+    def _reply(self, status: int, body: bytes = b"",
+               headers: dict | None = None):
+        self.send_response(status)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body and self.command != "HEAD":
+            self.wfile.write(body)
+
+    def _error(self, status: int, code: str, msg: str = ""):
+        body = (f'<?xml version="1.0" encoding="utf-8"?>'
+                f"<Error><Code>{code}</Code>"
+                f"<Message>{escape(msg or code)}</Message>"
+                f"</Error>").encode()
+        self._reply(status, body, {"Content-Type": "application/xml"})
+
+    def _dispatch(self):
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        body = self.rfile.read(length) if length else b""
+        if not self._verify_auth(body):
+            return self._error(403, "AuthenticationFailed",
+                               "signature mismatch")
+        svc: FakeBlobService = self.server.svc  # type: ignore
+        u = urlsplit(self.path)
+        path = unquote(u.path)
+        prefix = f"/{ACCOUNT}"
+        if not path.startswith(prefix):
+            return self._error(400, "InvalidUri", path)
+        rel = path[len(prefix):].lstrip("/")
+        q = {k: v[0] for k, v in
+             parse_qs(u.query, keep_blank_values=True).items()}
+        container, _, blob = rel.partition("/")
+        try:
+            if not container:
+                return self._account_ops(svc, q)
+            if not blob:
+                return self._container_ops(svc, container, q)
+            return self._blob_ops(svc, container, blob, q, body)
+        except KeyError as e:
+            kind = str(e.args[0]) if e.args else "NotFound"
+            status = 404 if "NotFound" in kind else 400
+            return self._error(status, kind.strip("'"))
+        except ValueError as e:
+            return self._error(409, str(e))
+
+    # -- account ----------------------------------------------------------
+
+    def _account_ops(self, svc, q):
+        if q.get("comp") == "list" and self.command == "GET":
+            items = "".join(
+                f"<Container><Name>{escape(n)}</Name><Properties>"
+                f"<Last-Modified>{_httpdate(c)}</Last-Modified>"
+                f"</Properties></Container>"
+                for n, c in svc.list_containers())
+            xml = ('<?xml version="1.0" encoding="utf-8"?>'
+                   f"<EnumerationResults><Containers>{items}"
+                   "</Containers></EnumerationResults>").encode()
+            return self._reply(200, xml,
+                               {"Content-Type": "application/xml"})
+        return self._error(400, "InvalidQueryParameterValue")
+
+    # -- container --------------------------------------------------------
+
+    def _container_ops(self, svc, container, q):
+        if q.get("restype") != "container":
+            return self._error(400, "InvalidQueryParameterValue")
+        if self.command == "PUT":
+            try:
+                svc.create_container(container)
+            except KeyError:
+                return self._error(409, "ContainerAlreadyExists")
+            return self._reply(201)
+        if self.command == "DELETE":
+            try:
+                svc.delete_container(container)
+            except ValueError:
+                return self._error(409, "ContainerNotEmpty")
+            return self._reply(202)
+        if self.command == "HEAD":
+            svc._container(container)          # raises if absent
+            created = dict(svc.list_containers())[container]
+            return self._reply(200, headers={
+                "Last-Modified": _httpdate(created)})
+        if self.command == "GET" and q.get("comp") == "list":
+            return self._list_blobs(svc, container, q)
+        return self._error(405, "UnsupportedHttpVerb")
+
+    def _list_blobs(self, svc, container, q):
+        prefix = q.get("prefix", "")
+        delim = q.get("delimiter", "")
+        names = svc.list_blobs(container, prefix)
+        marker = q.get("marker", "")
+        maxres = int(q.get("maxresults", "5000"))
+        blobs, prefixes = [], set()
+        next_marker = ""
+        for n in names:
+            if marker and n <= marker:
+                continue
+            if delim:
+                rest = n[len(prefix):]
+                if delim in rest:
+                    prefixes.add(prefix + rest.split(delim, 1)[0]
+                                 + delim)
+                    continue
+            if len(blobs) + len(prefixes) >= maxres:
+                next_marker = n
+                break
+            blobs.append(n)
+        items = []
+        for n in blobs:
+            b = svc.get_blob(container, n)
+            meta = "".join(f"<{k}>{escape(v)}</{k}>"
+                           for k, v in sorted(b.metadata.items()))
+            items.append(
+                f"<Blob><Name>{escape(n)}</Name><Properties>"
+                f"<Content-Length>{len(b.data)}</Content-Length>"
+                f"<Etag>{b.etag}</Etag>"
+                f"<Content-Type>{escape(b.content_type or '')}"
+                f"</Content-Type>"
+                f"<Last-Modified>{_httpdate(b.mod_time)}</Last-Modified>"
+                f"</Properties><Metadata>{meta}</Metadata></Blob>")
+        pitems = "".join(f"<BlobPrefix><Name>{escape(p)}</Name>"
+                         "</BlobPrefix>" for p in sorted(prefixes))
+        xml = ('<?xml version="1.0" encoding="utf-8"?>'
+               "<EnumerationResults><Blobs>"
+               + "".join(items) + pitems + "</Blobs>"
+               f"<NextMarker>{escape(next_marker)}</NextMarker>"
+               "</EnumerationResults>").encode()
+        return self._reply(200, xml, {"Content-Type": "application/xml"})
+
+    # -- blob -------------------------------------------------------------
+
+    def _meta_from_headers(self) -> dict:
+        return {k[len("x-ms-meta-"):]: v for k, v in self.headers.items()
+                if k.lower().startswith("x-ms-meta-")}
+
+    def _blob_ops(self, svc, container, blob, q, body):
+        comp = q.get("comp", "")
+        if self.command == "PUT" and comp == "block":
+            bid = base64.b64decode(q["blockid"]).decode()
+            # staged under a per-upload key parsed from the block id
+            # scheme NNNNN.upload (the gateway's scheme); foreign ids
+            # stage under ""
+            upload = bid.split(".", 1)[1] if "." in bid else ""
+            svc.stage_block(container, blob, upload, bid, body)
+            return self._reply(201)
+        if self.command == "PUT" and comp == "blocklist":
+            import xml.etree.ElementTree as ET
+            root = ET.fromstring(body)
+            ids = [e.text or "" for e in root
+                   if e.tag in ("Uncommitted", "Latest", "Committed")]
+            decoded = [base64.b64decode(i).decode() for i in ids]
+            uploads = {i.split(".", 1)[1] for i in decoded
+                       if "." in i} or {""}
+            if len(uploads) != 1:
+                return self._error(400, "InvalidBlockList",
+                                   "blocks from mixed uploads")
+            upload = uploads.pop()
+            try:
+                etag = svc.commit_block_list(
+                    container, blob, upload, decoded,
+                    metadata=self._meta_from_headers())
+            except KeyError:
+                return self._error(400, "InvalidBlockList")
+            return self._reply(201, headers={"ETag": f'"{etag}"'})
+        if self.command == "GET" and comp == "blocklist":
+            out = []
+            for (c, n, u), blocks in list(svc._blocks.items()):
+                if c == container and n == blob:
+                    for bid, data in sorted(blocks.items()):
+                        out.append(
+                            "<Block><Name>"
+                            + base64.b64encode(bid.encode()).decode()
+                            + f"</Name><Size>{len(data)}</Size></Block>")
+            xml = ('<?xml version="1.0" encoding="utf-8"?>'
+                   "<BlockList><UncommittedBlocks>"
+                   + "".join(out) +
+                   "</UncommittedBlocks></BlockList>").encode()
+            return self._reply(200, xml,
+                               {"Content-Type": "application/xml"})
+        if self.command == "PUT" and "x-ms-copy-source" in self.headers:
+            src = unquote(self.headers["x-ms-copy-source"])
+            parts = src.lstrip("/").split("/", 2)
+            if len(parts) != 3 or parts[0] != ACCOUNT:
+                return self._error(400, "InvalidHeaderValue", src)
+            sblob = svc.get_blob(parts[1], parts[2])
+            meta = self._meta_from_headers() or dict(sblob.metadata)
+            etag = svc.upload_blob(container, blob, sblob.data, meta,
+                                   sblob.content_type)
+            return self._reply(202, headers={
+                "ETag": f'"{etag}"', "x-ms-copy-status": "success"})
+        if self.command == "PUT":
+            if self.headers.get("x-ms-blob-type") != "BlockBlob":
+                return self._error(400, "InvalidHeaderValue",
+                                   "only BlockBlob supported")
+            etag = svc.upload_blob(
+                container, blob, body, self._meta_from_headers(),
+                self.headers.get("Content-Type", ""))
+            return self._reply(201, headers={"ETag": f'"{etag}"'})
+        if self.command in ("GET", "HEAD"):
+            b = svc.get_blob(container, blob)
+            hdrs = {
+                "ETag": f'"{b.etag}"',
+                "Last-Modified": _httpdate(b.mod_time),
+                "Content-Type": b.content_type
+                or "application/octet-stream",
+                "x-ms-blob-type": "BlockBlob",
+            }
+            for k, v in b.metadata.items():
+                hdrs[f"x-ms-meta-{k}"] = v
+            rng = self.headers.get("x-ms-range") \
+                or self.headers.get("Range")
+            data = b.data
+            if rng and rng.startswith("bytes="):
+                lo_s, _, hi_s = rng[len("bytes="):].partition("-")
+                lo = int(lo_s)
+                hi = int(hi_s) if hi_s else len(data) - 1
+                hdrs["Content-Range"] = \
+                    f"bytes {lo}-{min(hi, len(data) - 1)}/{len(data)}"
+                data = data[lo:hi + 1]
+                return self._reply(206, data, hdrs)
+            return self._reply(200, data, hdrs)
+        if self.command == "DELETE":
+            svc.delete_blob(container, blob)
+            return self._reply(202)
+        return self._error(405, "UnsupportedHttpVerb")
+
+    do_GET = do_PUT = do_DELETE = do_HEAD = _dispatch
+
+
+class AzureStubServer:
+    """Threaded stub service bound to 127.0.0.1:0."""
+
+    def __init__(self, svc: FakeBlobService | None = None):
+        self.svc = svc or FakeBlobService()
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self._httpd.svc = self.svc          # type: ignore
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}/{ACCOUNT}"
+
+    def start(self) -> "AzureStubServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

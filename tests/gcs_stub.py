@@ -1,0 +1,305 @@
+"""In-process GCS JSON-API stub — wire-protocol test double.
+
+Implements the storage/v1 subset the gcs gateway uses: bucket CRUD,
+multipart/related uploads (the metadata-bearing uploadType=multipart
+body is actually PARSED, boundary and all), alt=media downloads with
+Range, JSON listings with prefix/delimiter/pageToken, rewriteTo and
+compose.  Bearer-token auth is verified on every request.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import re
+import threading
+import time
+from datetime import datetime, timezone
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlsplit
+
+TOKEN = "stub-oauth-token-1"
+PROJECT = "stub-project"
+
+
+def _rfc3339(ns: int) -> str:
+    return datetime.fromtimestamp(ns / 1e9, tz=timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.%f")[:-3] + "Z"
+
+
+class _Store:
+    def __init__(self):
+        self.mu = threading.RLock()
+        # bucket -> {name: (data, metadata, content_type, mtime_ns)}
+        self.buckets: dict[str, dict] = {}
+        self.ctimes: dict[str, int] = {}
+
+    def resource(self, bucket: str, name: str) -> dict:
+        data, meta, ctype, mtime = self.buckets[bucket][name]
+        return {
+            "kind": "storage#object", "name": name, "bucket": bucket,
+            "size": str(len(data)),
+            "md5Hash": base64.b64encode(
+                hashlib.md5(data).digest()).decode(),
+            "etag": hashlib.md5(data).hexdigest(),
+            "contentType": ctype or "application/octet-stream",
+            "metadata": dict(meta),
+            "updated": _rfc3339(mtime),
+            "timeCreated": _rfc3339(mtime),
+        }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "GCSStub/1.0"
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, status: int, doc=None, raw: bytes | None = None,
+               headers: dict | None = None):
+        body = raw if raw is not None else (
+            json.dumps(doc).encode() if doc is not None else b"")
+        self.send_response(status)
+        ct = "application/octet-stream" if raw is not None \
+            else "application/json"
+        self.send_header("Content-Type",
+                         (headers or {}).pop("Content-Type", ct))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body and self.command != "HEAD":
+            self.wfile.write(body)
+
+    def _error(self, status: int, message: str):
+        self._reply(status, {"error": {"code": status,
+                                       "message": message}})
+
+    def _dispatch(self):
+        if self.headers.get("Authorization") != f"Bearer {TOKEN}":
+            return self._error(401, "invalid bearer token")
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        body = self.rfile.read(length) if length else b""
+        st: _Store = self.server.store  # type: ignore
+        u = urlsplit(self.path)
+        path = unquote(u.path)
+        q = {k: v[0] for k, v in
+             parse_qs(u.query, keep_blank_values=True).items()}
+        try:
+            with st.mu:
+                return self._route(st, path, q, body, u)
+        except KeyError as e:
+            return self._error(404, f"Not Found: {e}")
+
+    def _route(self, st, path, q, body, u):
+        # upload
+        m = re.fullmatch(r"/upload/storage/v1/b/([^/]+)/o", path)
+        if m and self.command == "POST":
+            return self._upload(st, m.group(1), q, body)
+        # download
+        m = re.fullmatch(r"/download/storage/v1/b/([^/]+)/o/(.+)", path)
+        if m and self.command == "GET":
+            return self._download(st, m.group(1), unquote(m.group(2)))
+        # compose
+        m = re.fullmatch(r"/storage/v1/b/([^/]+)/o/(.+)/compose", path)
+        if m and self.command == "POST":
+            return self._compose(st, m.group(1), unquote(m.group(2)),
+                                 json.loads(body))
+        # rewrite
+        m = re.fullmatch(
+            r"/storage/v1/b/([^/]+)/o/(.+)/rewriteTo/b/([^/]+)/o/(.+)",
+            path)
+        if m and self.command == "POST":
+            return self._rewrite(st, m.group(1), unquote(m.group(2)),
+                                 m.group(3), unquote(m.group(4)),
+                                 body)
+        # object metadata / delete
+        m = re.fullmatch(r"/storage/v1/b/([^/]+)/o/(.+)", path)
+        if m:
+            bucket, name = m.group(1), unquote(m.group(2))
+            if self.command == "GET":
+                if name not in st.buckets[bucket]:
+                    return self._error(404, f"object {name}")
+                return self._reply(200, st.resource(bucket, name))
+            if self.command == "DELETE":
+                if name not in st.buckets[bucket]:
+                    return self._error(404, f"object {name}")
+                del st.buckets[bucket][name]
+                return self._reply(204)
+        # object list
+        m = re.fullmatch(r"/storage/v1/b/([^/]+)/o", path)
+        if m and self.command == "GET":
+            return self._list(st, m.group(1), q)
+        # bucket CRUD
+        m = re.fullmatch(r"/storage/v1/b/([^/]+)", path)
+        if m:
+            bucket = m.group(1)
+            if self.command == "GET":
+                if bucket not in st.buckets:
+                    return self._error(404, f"bucket {bucket}")
+                return self._reply(200, {
+                    "kind": "storage#bucket", "name": bucket,
+                    "timeCreated": _rfc3339(st.ctimes[bucket])})
+            if self.command == "DELETE":
+                if bucket not in st.buckets:
+                    return self._error(404, f"bucket {bucket}")
+                if st.buckets[bucket]:
+                    return self._error(409, "bucket not empty")
+                del st.buckets[bucket]
+                del st.ctimes[bucket]
+                return self._reply(204)
+        if path == "/storage/v1/b":
+            if self.command == "POST":
+                doc = json.loads(body)
+                name = doc["name"]
+                if name in st.buckets:
+                    return self._error(409,
+                                       "you already own this bucket")
+                st.buckets[name] = {}
+                st.ctimes[name] = time.time_ns()
+                return self._reply(200, {
+                    "kind": "storage#bucket", "name": name,
+                    "timeCreated": _rfc3339(st.ctimes[name])})
+            if self.command == "GET":
+                if q.get("project") != PROJECT:
+                    return self._error(400, "bad project")
+                return self._reply(200, {"items": [
+                    {"name": b, "timeCreated": _rfc3339(st.ctimes[b])}
+                    for b in sorted(st.buckets)]})
+        return self._error(400, f"unhandled {self.command} {path}")
+
+    # -- op bodies --------------------------------------------------------
+
+    def _upload(self, st, bucket, q, body):
+        if bucket not in st.buckets:
+            return self._error(404, f"bucket {bucket}")
+        if q.get("uploadType") != "multipart":
+            return self._error(400, "only uploadType=multipart")
+        ctype_hdr = self.headers.get("Content-Type", "")
+        m = re.search(r'boundary="?([^";]+)"?', ctype_hdr)
+        if not m:
+            return self._error(400, "missing multipart boundary")
+        boundary = m.group(1).encode()
+        parts = body.split(b"--" + boundary)
+        # parts[0] empty, [1] json resource, [2] media, [3] trailing --
+        if len(parts) < 4:
+            return self._error(400, "malformed multipart/related body")
+        def split_part(p):
+            p = p.lstrip(b"\r\n")
+            hdr, _, payload = p.partition(b"\r\n\r\n")
+            return hdr.decode("utf-8", "replace"), \
+                payload[:-2] if payload.endswith(b"\r\n") else payload
+        _, res_raw = split_part(parts[1])
+        media_hdr, media = split_part(parts[2])
+        resource = json.loads(res_raw)
+        name = resource["name"]
+        cm = re.search(r"(?im)^content-type:\s*(.+)$", media_hdr)
+        ctype = resource.get("contentType") \
+            or (cm.group(1).strip() if cm else "")
+        st.buckets[bucket][name] = (media,
+                                    resource.get("metadata") or {},
+                                    ctype, time.time_ns())
+        return self._reply(200, st.resource(bucket, name))
+
+    def _download(self, st, bucket, name):
+        if name not in st.buckets[bucket]:
+            return self._error(404, f"object {name}")
+        data = st.buckets[bucket][name][0]
+        rng = self.headers.get("Range", "")
+        if rng.startswith("bytes="):
+            lo_s, _, hi_s = rng[len("bytes="):].partition("-")
+            lo = int(lo_s)
+            hi = int(hi_s) if hi_s else len(data) - 1
+            part = data[lo:hi + 1]
+            return self._reply(206, raw=part, headers={
+                "Content-Range":
+                f"bytes {lo}-{min(hi, len(data)-1)}/{len(data)}"})
+        return self._reply(200, raw=data)
+
+    def _compose(self, st, bucket, dest, doc):
+        objs = st.buckets[bucket]
+        srcs = [s["name"] for s in doc.get("sourceObjects", [])]
+        if not srcs or len(srcs) > 32:
+            return self._error(400, "1..32 source objects required")
+        missing = [s for s in srcs if s not in objs]
+        if missing:
+            return self._error(404, f"source {missing[0]}")
+        data = b"".join(objs[s][0] for s in srcs)
+        dst = doc.get("destination", {})
+        st.buckets[bucket][dest] = (data, dst.get("metadata") or {},
+                                    dst.get("contentType", ""),
+                                    time.time_ns())
+        return self._reply(200, st.resource(bucket, dest))
+
+    def _rewrite(self, st, sb, so, db, do, body):
+        if sb not in st.buckets:
+            return self._error(404, f"bucket {sb}")
+        if so not in st.buckets[sb]:
+            return self._error(404, f"object {so}")
+        if db not in st.buckets:
+            return self._error(404, f"bucket {db}")
+        data, meta, ctype, _ = st.buckets[sb][so]
+        if body:
+            new_meta = json.loads(body).get("metadata")
+            if new_meta is not None:
+                meta = new_meta
+        st.buckets[db][do] = (data, dict(meta), ctype, time.time_ns())
+        return self._reply(200, {
+            "kind": "storage#rewriteResponse", "done": True,
+            "resource": st.resource(db, do)})
+
+    def _list(self, st, bucket, q):
+        if bucket not in st.buckets:
+            return self._error(404, f"bucket {bucket}")
+        prefix = q.get("prefix", "")
+        delim = q.get("delimiter", "")
+        token = q.get("pageToken", "")
+        maxr = int(q.get("maxResults", "1000"))
+        items, prefixes = [], set()
+        next_token = ""
+        for name in sorted(st.buckets[bucket]):
+            if not name.startswith(prefix):
+                continue
+            if token and name <= token:
+                continue
+            if delim:
+                rest = name[len(prefix):]
+                if delim in rest:
+                    prefixes.add(prefix + rest.split(delim, 1)[0]
+                                 + delim)
+                    continue
+            if len(items) >= maxr:
+                next_token = items[-1]["name"]
+                break
+            items.append(st.resource(bucket, name))
+        doc = {"kind": "storage#objects", "items": items,
+               "prefixes": sorted(prefixes)}
+        if next_token:
+            doc["nextPageToken"] = next_token
+        return self._reply(200, doc)
+
+    do_GET = do_POST = do_PUT = do_DELETE = _dispatch
+
+
+class GCSStubServer:
+    def __init__(self):
+        self.store = _Store()
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self._httpd.store = self.store      # type: ignore
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "GCSStubServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

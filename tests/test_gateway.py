@@ -47,12 +47,23 @@ def test_registry_kinds():
         gw.lookup("bogus")
 
 
-def test_gated_cloud_gateways():
-    for kind in ("azure", "gcs", "hdfs"):
-        g = gw.lookup(kind)("some-target")
-        assert not g.production()
-        with pytest.raises(gw.GatewayNotAvailable):
-            g.new_gateway_layer()
+def test_gated_hdfs_gateway():
+    g = gw.lookup("hdfs")("some-target")
+    assert not g.production()
+    with pytest.raises(gw.GatewayNotAvailable):
+        g.new_gateway_layer()
+
+
+def test_cloud_gateways_need_credentials(monkeypatch):
+    """azure/gcs are real wire gateways now; constructing a layer
+    without credentials fails loudly with what is needed."""
+    for var in ("AZURE_STORAGE_ENDPOINT", "AZURE_STORAGE_ACCOUNT",
+                "AZURE_STORAGE_KEY", "GOOGLE_OAUTH_TOKEN"):
+        monkeypatch.delenv(var, raising=False)
+    with pytest.raises(gw.GatewayNotAvailable, match="AZURE_STORAGE"):
+        gw.lookup("azure")().new_gateway_layer()
+    with pytest.raises(gw.GatewayNotAvailable, match="GOOGLE_OAUTH"):
+        gw.lookup("gcs")().new_gateway_layer()
 
 
 # -- NAS gateway --------------------------------------------------------------

@@ -1,0 +1,175 @@
+"""Azure Blob gateway over the wire — stub service with SharedKey
+signature verification on every request (tests/azure_stub.py).
+
+Covers the full surface VERDICT r3 asked for: CRUD, multipart via
+staged blocks + Put Block List, server-side copy with metadata
+preservation, ranged reads, listings with delimiters, plus the
+round-2 gateway-test asymmetries (multipart abort semantics,
+metadata preservation on copy, ranges through the seam).
+"""
+
+import os
+
+import pytest
+
+from minio_tpu import gateway as gw
+from minio_tpu.gateway.azure import (AzureBlobClient, AzureError,
+                                     AzureObjects)
+from minio_tpu.objectlayer.interface import (BucketExists, BucketNotFound,
+                                             InvalidPart, ObjectNotFound,
+                                             PutObjectOptions)
+
+from .azure_stub import ACCOUNT, KEY_B64, AzureStubServer
+
+
+@pytest.fixture(scope="module")
+def stub():
+    srv = AzureStubServer().start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def layer(stub):
+    return AzureObjects(AzureBlobClient(stub.endpoint, ACCOUNT, KEY_B64))
+
+
+def test_bad_key_rejected(stub):
+    import base64
+    bad = base64.b64encode(b"wrong-key").decode()
+    client = AzureBlobClient(stub.endpoint, ACCOUNT, bad)
+    with pytest.raises(AzureError) as ei:
+        client.create_container("nope")
+    assert ei.value.status == 403
+    assert ei.value.code == "AuthenticationFailed"
+
+
+def test_bucket_lifecycle(layer):
+    layer.make_bucket("azb")
+    assert layer.get_bucket_info("azb").name == "azb"
+    with pytest.raises(BucketExists):
+        layer.make_bucket("azb")
+    assert any(b.name == "azb" for b in layer.list_buckets())
+    layer.delete_bucket("azb")
+    with pytest.raises(BucketNotFound):
+        layer.get_bucket_info("azb")
+
+
+def test_object_crud_and_ranges(layer):
+    layer.make_bucket("azo")
+    body = os.urandom(64 * 1024)
+    info = layer.put_object(
+        "azo", "dir/obj.bin", body,
+        PutObjectOptions(user_defined={
+            "content-type": "application/x-test",
+            "x-amz-meta-color": "mauve"}))
+    assert info.size == len(body) and info.etag
+    got, data = layer.get_object("azo", "dir/obj.bin")
+    assert data == body
+    assert got.content_type == "application/x-test"
+    assert got.user_defined.get("x-amz-meta-color") == "mauve"
+    # ranged read reports the FULL size via Content-Range
+    got2, part = layer.get_object("azo", "dir/obj.bin",
+                                  offset=100, length=50)
+    assert part == body[100:150] and got2.size == len(body)
+    head = layer.get_object_info("azo", "dir/obj.bin")
+    assert head.size == len(body) and head.mod_time > 0
+    layer.delete_object("azo", "dir/obj.bin")
+    with pytest.raises(ObjectNotFound):
+        layer.get_object_info("azo", "dir/obj.bin")
+
+
+def test_listing_with_delimiter(layer):
+    layer.make_bucket("azl")
+    for k in ("a/1", "a/2", "b/1", "top"):
+        layer.put_object("azl", k, b"x")
+    lst = layer.list_objects("azl", delimiter="/")
+    assert [o.name for o in lst.objects] == ["top"]
+    assert lst.prefixes == ["a/", "b/"]
+    lst2 = layer.list_objects("azl", prefix="a/")
+    assert [o.name for o in lst2.objects] == ["a/1", "a/2"]
+
+
+def test_multipart_block_flow(layer):
+    layer.make_bucket("azmp")
+    uid = layer.new_multipart_upload(
+        "azmp", "big",
+        PutObjectOptions(user_defined={"x-amz-meta-job": "42"}))
+    e1 = layer.put_object_part("azmp", "big", uid, 1, b"a" * 1000)
+    e2 = layer.put_object_part("azmp", "big", uid, 2, b"b" * 500)
+    parts = layer.list_object_parts("azmp", "big", uid)
+    assert [(n, s) for n, _, s in parts] == [(1, 1000), (2, 500)]
+    # completing with a never-uploaded part is InvalidPart
+    with pytest.raises(InvalidPart):
+        layer.complete_multipart_upload("azmp", "big", uid,
+                                        [(1, e1), (7, "zz")])
+    oi = layer.complete_multipart_upload("azmp", "big", uid,
+                                         [(1, e1), (2, e2)])
+    assert oi.size == 1500
+    assert oi.user_defined.get("x-amz-meta-job") == "42"
+    _, data = layer.get_object("azmp", "big")
+    assert data == b"a" * 1000 + b"b" * 500
+
+
+def test_multipart_abort_then_get_fails(layer):
+    layer.make_bucket("azab")
+    uid = layer.new_multipart_upload("azab", "gone")
+    layer.put_object_part("azab", "gone", uid, 1, b"data")
+    layer.abort_multipart_upload("azab", "gone", uid)
+    # blob was never committed
+    with pytest.raises(ObjectNotFound):
+        layer.get_object_info("azab", "gone")
+
+
+def test_copy_preserves_metadata(layer):
+    layer.make_bucket("azc")
+    layer.put_object(
+        "azc", "src", b"copy me",
+        PutObjectOptions(user_defined={"x-amz-meta-tier": "gold"}))
+    info = layer.copy_object("azc", "src", "azc", "dst")
+    assert info.size == 7
+    got, data = layer.get_object("azc", "dst")
+    assert data == b"copy me"
+    assert got.user_defined.get("x-amz-meta-tier") == "gold"
+    # copy with replaced metadata
+    layer.copy_object("azc", "src", "azc", "dst2",
+                      PutObjectOptions(user_defined={
+                          "x-amz-meta-tier": "silver"}))
+    got2 = layer.get_object_info("azc", "dst2")
+    assert got2.user_defined.get("x-amz-meta-tier") == "silver"
+
+
+def test_registered_production_gateway(stub, monkeypatch):
+    monkeypatch.setenv("AZURE_STORAGE_ENDPOINT", stub.endpoint)
+    monkeypatch.setenv("AZURE_STORAGE_ACCOUNT", ACCOUNT)
+    monkeypatch.setenv("AZURE_STORAGE_KEY", KEY_B64)
+    g = gw.lookup("azure")()
+    assert g.name() == "azure" and g.production()
+    layer = g.new_gateway_layer()
+    layer.make_bucket("azreg")
+    layer.put_object("azreg", "k", b"v")
+    assert layer.get_object("azreg", "k")[1] == b"v"
+
+
+def test_full_s3_frontend_over_azure_gateway(stub):
+    """S3Server + SigV4 -> AzureObjects -> wire protocol -> stub: the
+    deployment shape `minio gateway azure` serves."""
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+
+    layer = AzureObjects(AzureBlobClient(stub.endpoint, ACCOUNT,
+                                         KEY_B64))
+    srv = S3Server(layer, access_key="gk", secret_key="gs")
+    srv.start()
+    try:
+        c = S3Client(srv.endpoint, "gk", "gs")
+        c.make_bucket("azfront")
+        body = os.urandom(200 * 1024)
+        c.put_object("azfront", "x/y.bin", body)
+        assert c.get_object("azfront", "x/y.bin").body == body
+        assert c.get_object("azfront", "x/y.bin",
+                            byte_range=(10, 99)).body == body[10:100]
+        objs, prefixes = c.list_objects("azfront", delimiter="/")
+        assert prefixes == ["x/"]
+    finally:
+        srv.stop()
