@@ -31,7 +31,9 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
     ``srv`` the S3Server (gives layer/iam/config).
     """
     if path == METRICS_PATH:
-        body = metrics.render(srv.layer).encode()
+        body = metrics.render(srv.layer,
+                              healer=getattr(srv, "healer", None)
+                              ).encode()
         h._send(200, body, content_type="text/plain; version=0.0.4")
         return True
     if not path.startswith(ADMIN_PREFIX + "/"):

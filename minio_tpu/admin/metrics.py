@@ -1,9 +1,20 @@
-"""Prometheus metrics (cmd/metrics-v2.go namespaces minio_{s3,node,cluster}).
+"""Prometheus metrics — the metrics-v2 catalog
+(cmd/metrics-v2.go:42-48 namespaces minio_{s3,bucket,cluster,heal,node}).
 
-A process-wide registry of counters/gauges rendered in Prometheus text
-exposition format at /minio-tpu/metrics.  The S3 frontend increments
-request/byte counters per API; the object layer contributes capacity and
-healing gauges on scrape.
+A process-wide registry of counters and histograms rendered in
+Prometheus text exposition format at /minio-tpu/metrics, plus gauge
+families computed at scrape time from live subsystems:
+
+  mt_s3_*       per-API request counters, rx/tx bytes, TTFB histogram
+                (minio_s3_requests_total / minio_s3_ttfb_seconds role)
+  mt_bucket_*   per-bucket usage/object/version gauges and the object
+                size-distribution histogram, from the data crawler's
+                persisted usage cache (cmd/metrics-v2.go bucket usage
+                family — the crawler computes it, the scrape exports it)
+  mt_cluster_*  capacity and drive-count gauges
+  mt_heal_*     background-heal progress counters (BgHealState)
+  mt_node_*     inter-node RPC call/byte/error counters (internode
+                family, cmd/metrics-v2.go getInterNodeMetrics)
 """
 
 from __future__ import annotations
@@ -14,11 +25,17 @@ from collections import defaultdict
 
 _START = time.time()
 
+# reference TTFB buckets (cmd/metrics-v2.go:69 defaultHistogramBuckets)
+TTFB_BUCKETS = (0.001, 0.003, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
 
 class Metrics:
     def __init__(self):
         self._mu = threading.Lock()
         self._counters: dict[tuple, float] = defaultdict(float)
+        # histogram key -> [bucket counts..., +Inf count, sum]
+        self._hists: dict[tuple, list] = {}
 
     def inc(self, name: str, labels: dict[str, str] | None = None,
             value: float = 1.0) -> None:
@@ -26,23 +43,41 @@ class Metrics:
         with self._mu:
             self._counters[key] += value
 
+    def observe(self, name: str, labels: dict[str, str] | None = None,
+                value: float = 0.0,
+                buckets: tuple = TTFB_BUCKETS) -> None:
+        key = (name, tuple(sorted((labels or {}).items())), buckets)
+        with self._mu:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = [0] * (len(buckets) + 1) + [0.0]
+            for i, ub in enumerate(buckets):
+                if value <= ub:
+                    h[i] += 1
+            h[len(buckets)] += 1          # +Inf / _count
+            h[-1] += value                # _sum
+
     def snapshot(self) -> dict[tuple, float]:
         with self._mu:
             return dict(self._counters)
+
+    def hist_snapshot(self) -> dict[tuple, list]:
+        with self._mu:
+            return {k: list(v) for k, v in self._hists.items()}
 
 
 GLOBAL = Metrics()
 
 
-def _fmt_labels(labels: tuple) -> str:
-    if not labels:
-        return ""
+def _fmt_labels(labels: tuple, extra: str = "") -> str:
     inner = ",".join(f'{k}="{v}"' for k, v in labels)
-    return "{" + inner + "}"
+    if extra:
+        inner = f"{inner},{extra}" if inner else extra
+    return "{" + inner + "}" if inner else ""
 
 
-def render(layer=None) -> str:
-    """Prometheus text format: counters + live gauges from the layer."""
+def render(layer=None, healer=None) -> str:
+    """Prometheus text format: counters + histograms + live gauges."""
     lines = [
         "# HELP mt_up Server is up.",
         "# TYPE mt_up gauge",
@@ -58,35 +93,120 @@ def render(layer=None) -> str:
             lines.append(f"# TYPE {name} counter")
             seen_names.add(name)
         lines.append(f"{name}{_fmt_labels(labels)} {value:g}")
+    for (name, labels, buckets), h in sorted(GLOBAL.hist_snapshot()
+                                             .items()):
+        if name not in seen_names:
+            lines.append(f"# TYPE {name} histogram")
+            seen_names.add(name)
+        for i, ub in enumerate(buckets):
+            lines.append(
+                f"{name}_bucket"
+                f"{_fmt_labels(labels, f'le=\"{ub:g}\"')} {h[i]}")
+        lines.append(f"{name}_bucket"
+                     f"{_fmt_labels(labels, 'le=\"+Inf\"')}"
+                     f" {h[len(buckets)]}")
+        lines.append(f"{name}_sum{_fmt_labels(labels)} {h[-1]:g}")
+        lines.append(f"{name}_count{_fmt_labels(labels)}"
+                     f" {h[len(buckets)]}")
     if layer is not None:
         try:
-            disks = _collect_disks(layer)
-            online = sum(1 for d in disks if d is not None)
-            lines += [
-                "# TYPE mt_cluster_disk_online_total gauge",
-                f"mt_cluster_disk_online_total {online}",
-                "# TYPE mt_cluster_disk_offline_total gauge",
-                f"mt_cluster_disk_offline_total {len(disks) - online}",
-            ]
-            total = free = 0
-            for d in disks:
-                if d is None:
-                    continue
-                try:
-                    info = d.disk_info()
-                    total += info.total
-                    free += info.free
-                except Exception:  # noqa: BLE001
-                    continue
-            lines += [
-                "# TYPE mt_cluster_capacity_raw_total_bytes gauge",
-                f"mt_cluster_capacity_raw_total_bytes {total}",
-                "# TYPE mt_cluster_capacity_raw_free_bytes gauge",
-                f"mt_cluster_capacity_raw_free_bytes {free}",
-            ]
+            lines += _cluster_gauges(layer)
         except Exception:  # noqa: BLE001 — metrics must never fail a scrape
             pass
+        try:
+            lines += _bucket_usage_gauges(layer)
+        except Exception:  # noqa: BLE001
+            pass
+    if healer is not None:
+        try:
+            lines += _heal_counters(healer)
+        except Exception:  # noqa: BLE001
+            pass
     return "\n".join(lines) + "\n"
+
+
+def _cluster_gauges(layer) -> list[str]:
+    disks = _collect_disks(layer)
+    online = sum(1 for d in disks if d is not None)
+    lines = [
+        "# TYPE mt_cluster_disk_online_total gauge",
+        f"mt_cluster_disk_online_total {online}",
+        "# TYPE mt_cluster_disk_offline_total gauge",
+        f"mt_cluster_disk_offline_total {len(disks) - online}",
+    ]
+    total = free = 0
+    for d in disks:
+        if d is None:
+            continue
+        try:
+            info = d.disk_info()
+            total += info.total
+            free += info.free
+        except Exception:  # noqa: BLE001
+            continue
+    lines += [
+        "# TYPE mt_cluster_capacity_raw_total_bytes gauge",
+        f"mt_cluster_capacity_raw_total_bytes {total}",
+        "# TYPE mt_cluster_capacity_raw_free_bytes gauge",
+        f"mt_cluster_capacity_raw_free_bytes {free}",
+    ]
+    return lines
+
+
+def _bucket_usage_gauges(layer) -> list[str]:
+    """Per-bucket usage from the crawler's persisted cache (the
+    reference exports bucketUsageTotalBytes / bucketUsageObjectsTotal /
+    bucketObjectSizeDistribution the same way: the scanner computes,
+    the scrape reads)."""
+    from ..background.crawler import load_usage
+    usage = load_usage(layer)
+    if usage is None:
+        return []
+    lines = [
+        "# TYPE mt_cluster_usage_last_update_timestamp_seconds gauge",
+        "mt_cluster_usage_last_update_timestamp_seconds "
+        f"{usage.last_update_ns / 1e9:.3f}",
+        "# TYPE mt_cluster_usage_object_total gauge",
+        f"mt_cluster_usage_object_total {usage.objects_total_count}",
+        "# TYPE mt_cluster_usage_total_bytes gauge",
+        f"mt_cluster_usage_total_bytes {usage.objects_total_size}",
+        "# TYPE mt_bucket_usage_total_bytes gauge",
+        "# TYPE mt_bucket_usage_object_total gauge",
+        "# TYPE mt_bucket_usage_version_total gauge",
+        "# TYPE mt_bucket_objects_size_distribution gauge",
+    ]
+    # emit after the TYPE block so each family groups correctly
+    for b in sorted(usage.bucket_usage):
+        u = usage.bucket_usage[b]
+        lines.append(f'mt_bucket_usage_total_bytes{{bucket="{b}"}}'
+                     f" {u.size}")
+        lines.append(f'mt_bucket_usage_object_total{{bucket="{b}"}}'
+                     f" {u.objects_count}")
+        lines.append(f'mt_bucket_usage_version_total{{bucket="{b}"}}'
+                     f" {u.versions_count}")
+        for rng in sorted(u.histogram):
+            lines.append(
+                "mt_bucket_objects_size_distribution"
+                f'{{bucket="{b}",range="{rng}"}} {u.histogram[rng]}')
+    return lines
+
+
+def _heal_counters(healer) -> list[str]:
+    st = healer.stats
+    return [
+        "# TYPE mt_heal_objects_scanned_total counter",
+        f"mt_heal_objects_scanned_total {st.objects_scanned}",
+        "# TYPE mt_heal_objects_healed_total counter",
+        f"mt_heal_objects_healed_total {st.objects_healed}",
+        "# TYPE mt_heal_objects_failed_total counter",
+        f"mt_heal_objects_failed_total {st.objects_failed}",
+        "# TYPE mt_heal_mrf_queued_total counter",
+        f"mt_heal_mrf_queued_total {st.mrf_queued}",
+        "# TYPE mt_heal_mrf_healed_total counter",
+        f"mt_heal_mrf_healed_total {st.mrf_healed}",
+        "# TYPE mt_heal_cycles_total counter",
+        f"mt_heal_cycles_total {st.cycles}",
+    ]
 
 
 def _collect_disks_with_set(layer):

@@ -317,6 +317,8 @@ class RPCClient:
             self._last_failure = time.time()
             if is_timeout:
                 dyn.log_failure()
+            from ..admin.metrics import GLOBAL as _mtr
+            _mtr.inc("mt_node_rpc_errors_total", {"service": service})
             raise RPCError("ConnectionError", str(e)) from e
 
         for attempt in (0, 1):
@@ -350,6 +352,12 @@ class RPCClient:
                 fail(conn, e)
         self._put_conn(conn)
         dyn.log_success(time.monotonic() - start)
+        # inter-node family (cmd/metrics-v2.go getInterNodeMetrics):
+        # traffic and call counts per RPC service
+        from ..admin.metrics import GLOBAL as _mtr
+        _mtr.inc("mt_node_rpc_calls_total", {"service": service})
+        _mtr.inc("mt_node_rpc_tx_bytes_total", value=len(body))
+        _mtr.inc("mt_node_rpc_rx_bytes_total", value=len(payload))
         if raw_response and status == 200:
             return payload
         doc = msgpack.unpackb(payload, raw=False)

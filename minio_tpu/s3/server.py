@@ -659,6 +659,17 @@ def _make_handler(srv: S3Server):
             path, bucket, key, query = self._split()
             q1 = {k: v[0] for k, v in query.items()}
             api_name = _api_name(self.command, bucket, key, q1)
+            # metrics-v2 per-API families (cmd/metrics-v2.go
+            # getS3RequestsTotalMD / getS3TTFBMetric): request count by
+            # api name and the TTFB distribution
+            from ..admin.metrics import GLOBAL as _mtr
+            _mtr.inc("mt_s3_requests_api_total", {"api": api_name})
+            if self._resp_status >= 400:
+                _mtr.inc("mt_s3_requests_errors_total",
+                         {"api": api_name,
+                          "status": str(self._resp_status)})
+            ttfb = (self._ttfb_ns or dur) / 1e9
+            _mtr.observe("mt_s3_ttfb_seconds", {"api": api_name}, ttfb)
             if srv.trace_hub.num_subscribers > 0 or \
                     srv.trace_hub.ring_active:
                 srv.trace_hub.publish(_trace.make_trace(
