@@ -1,0 +1,287 @@
+"""In-process broker stubs — raw-socket AMQP 0-9-1 and Kafka acceptors.
+
+Each stub really parses the wire bytes (frames, handshakes, CRCs), so
+the clients in minio_tpu/events/wire.py are conformance-tested per
+call.  `stop()`/restart cycles exercise store-and-forward replay.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import zlib
+
+_FRAME_END = 0xCE
+
+
+def _shortstr(s: str) -> bytes:
+    b = s.encode()
+    return bytes([len(b)]) + b
+
+
+def _longstr(b: bytes) -> bytes:
+    return struct.pack(">I", len(b)) + b
+
+
+class AMQPStubBroker:
+    """Accepts connections, walks the 0-9-1 handshake, records declared
+    exchanges and published messages."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self.exchanges: dict[str, str] = {}
+        self.published: list[tuple[str, str, bytes, str]] = []
+        self.auth: list[tuple[str, str, str]] = []   # (user, pass, vhost)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+
+    def start(self) -> "AMQPStubBroker":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    # -- per-connection protocol walk ------------------------------------
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._session, args=(conn,),
+                             daemon=True).start()
+
+    def _session(self, conn: socket.socket):
+        try:
+            conn.settimeout(10)
+            buf = b""
+
+            def recv_exact(n):
+                nonlocal buf
+                while len(buf) < n:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        raise ConnectionError("eof")
+                    buf += chunk
+                out, rest = buf[:n], buf[n:]
+                buf = rest
+                return out
+
+            def recv_frame():
+                ftype, ch, size = struct.unpack(">BHI", recv_exact(7))
+                payload = recv_exact(size)
+                assert recv_exact(1)[0] == _FRAME_END, "bad frame end"
+                return ftype, ch, payload
+
+            def send_method(ch, cid, mid, args=b""):
+                payload = struct.pack(">HH", cid, mid) + args
+                conn.sendall(struct.pack(">BHI", 1, ch, len(payload))
+                             + payload + bytes([_FRAME_END]))
+
+            hdr = recv_exact(8)
+            assert hdr == b"AMQP\x00\x00\x09\x01", hdr
+            # Start: version 0.9, empty server props, PLAIN, en_US
+            send_method(0, 10, 10,
+                        b"\x00\x09" + _longstr(b"")
+                        + _longstr(b"PLAIN") + _longstr(b"en_US"))
+            ftype, _, p = recv_frame()                  # Start-Ok
+            assert struct.unpack(">HH", p[:4]) == (10, 11)
+            off = 4
+            plen = struct.unpack(">I", p[off:off + 4])[0]
+            off += 4 + plen                             # client props
+            mlen = p[off]
+            mech = p[off + 1:off + 1 + mlen].decode()
+            off += 1 + mlen
+            rlen = struct.unpack(">I", p[off:off + 4])[0]
+            sasl = p[off + 4:off + 4 + rlen]
+            assert mech == "PLAIN", mech
+            _, user, password = sasl.decode().split("\x00")
+            send_method(0, 10, 30,                      # Tune
+                        struct.pack(">HIH", 0, 131072, 0))
+            ftype, _, p = recv_frame()                  # Tune-Ok
+            assert struct.unpack(">HH", p[:4]) == (10, 31)
+            ftype, _, p = recv_frame()                  # Open
+            assert struct.unpack(">HH", p[:4]) == (10, 40)
+            vlen = p[4]
+            vhost = p[5:5 + vlen].decode()
+            self.auth.append((user, password, vhost))
+            send_method(0, 10, 41, _shortstr(""))       # Open-Ok
+            ftype, ch, p = recv_frame()                 # Channel.Open
+            assert struct.unpack(">HH", p[:4]) == (20, 10)
+            send_method(ch, 20, 11, _longstr(b""))      # Open-Ok
+
+            while True:
+                ftype, ch, p = recv_frame()
+                if ftype != 1:
+                    continue
+                cid, mid = struct.unpack(">HH", p[:4])
+                if (cid, mid) == (40, 10):              # Exchange.Declare
+                    off = 6                              # skip reserved
+                    elen = p[off]
+                    exch = p[off + 1:off + 1 + elen].decode()
+                    off += 1 + elen
+                    tlen = p[off]
+                    ex_type = p[off + 1:off + 1 + tlen].decode()
+                    self.exchanges[exch] = ex_type
+                    send_method(ch, 40, 11)             # Declare-Ok
+                elif (cid, mid) == (60, 40):            # Basic.Publish
+                    off = 6
+                    elen = p[off]
+                    exch = p[off + 1:off + 1 + elen].decode()
+                    off += 1 + elen
+                    klen = p[off]
+                    rkey = p[off + 1:off + 1 + klen].decode()
+                    # content header
+                    htype, _, hp = recv_frame()
+                    assert htype == 2
+                    _cls, _w, body_size, flags = struct.unpack(
+                        ">HHQH", hp[:14])
+                    ctype = ""
+                    if flags & 0x8000:
+                        clen = hp[14]
+                        ctype = hp[15:15 + clen].decode()
+                    body = b""
+                    while len(body) < body_size:
+                        btype, _, bp = recv_frame()
+                        assert btype == 3
+                        body += bp
+                    self.published.append((exch, rkey, body, ctype))
+                elif (cid, mid) == (10, 50):            # Connection.Close
+                    send_method(0, 10, 51)
+                    return
+        except (ConnectionError, AssertionError, socket.timeout,
+                OSError):
+            pass
+        finally:
+            conn.close()
+
+
+class KafkaStubBroker:
+    """Accepts length-prefixed Kafka requests; parses Produce v0 incl.
+    the message-set CRC check; records (topic, key, value)."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self.produced: list[tuple[str, bytes, bytes]] = []
+        self._offset = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+
+    def start(self) -> "KafkaStubBroker":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._session, args=(conn,),
+                             daemon=True).start()
+
+    def _session(self, conn: socket.socket):
+        try:
+            conn.settimeout(10)
+            buf = b""
+
+            def recv_exact(n):
+                nonlocal buf
+                while len(buf) < n:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        raise ConnectionError("eof")
+                    buf += chunk
+                out, rest = buf[:n], buf[n:]
+                buf = rest
+                return out
+
+            while True:
+                size = struct.unpack(">i", recv_exact(4))[0]
+                req = recv_exact(size)
+                api_key, api_ver, corr = struct.unpack(">hhi", req[:8])
+                off = 8
+                cidlen = struct.unpack(">h", req[off:off + 2])[0]
+                off += 2 + max(0, cidlen)
+                if api_key != 0 or api_ver != 0:
+                    # error_code NOT_IMPLEMENTED via closing
+                    raise ConnectionError(f"unsupported api {api_key}")
+                _acks, _timeout = struct.unpack(">hi", req[off:off + 6])
+                off += 6
+                ntopics = struct.unpack(">i", req[off:off + 4])[0]
+                off += 4
+                resp_topics = []
+                for _ in range(ntopics):
+                    tlen = struct.unpack(">h", req[off:off + 2])[0]
+                    topic = req[off + 2:off + 2 + tlen].decode()
+                    off += 2 + tlen
+                    nparts = struct.unpack(">i", req[off:off + 4])[0]
+                    off += 4
+                    parts = []
+                    for _ in range(nparts):
+                        pid, mset_size = struct.unpack(
+                            ">ii", req[off:off + 8])
+                        off += 8
+                        mset = req[off:off + mset_size]
+                        off += mset_size
+                        self._parse_message_set(topic, mset)
+                        parts.append((pid, 0, self._offset))
+                        self._offset += 1
+                    resp_topics.append((topic, parts))
+                resp = struct.pack(">i", corr)
+                resp += struct.pack(">i", len(resp_topics))
+                for topic, parts in resp_topics:
+                    tb = topic.encode()
+                    resp += struct.pack(">h", len(tb)) + tb
+                    resp += struct.pack(">i", len(parts))
+                    for pid, err, offset in parts:
+                        resp += struct.pack(">ihq", pid, err, offset)
+                conn.sendall(struct.pack(">i", len(resp)) + resp)
+        except (ConnectionError, struct.error, socket.timeout, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _parse_message_set(self, topic: str, mset: bytes):
+        off = 0
+        while off < len(mset):
+            _off0, msize = struct.unpack(">qi", mset[off:off + 12])
+            msg = mset[off + 12:off + 12 + msize]
+            off += 12 + msize
+            crc = struct.unpack(">I", msg[:4])[0]
+            content = msg[4:]
+            assert (zlib.crc32(content) & 0xFFFFFFFF) == crc, \
+                "message CRC mismatch"
+            magic, _attrs = content[0], content[1]
+            assert magic == 0, f"unexpected magic {magic}"
+            p = 2
+            klen = struct.unpack(">i", content[p:p + 4])[0]
+            p += 4
+            key = content[p:p + klen] if klen >= 0 else b""
+            p += max(0, klen)
+            vlen = struct.unpack(">i", content[p:p + 4])[0]
+            p += 4
+            value = content[p:p + vlen] if vlen >= 0 else b""
+            self.produced.append((topic, key, value))

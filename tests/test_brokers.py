@@ -93,9 +93,9 @@ def test_invalid_formats_rejected():
         brokers.ElasticsearchTarget("a", "u", "i", fmt="bogus")
 
 
-def test_client_gate_without_store_raises():
-    t = brokers.KafkaTarget("arn:t", ["b1:9092"], "events")
-    with pytest.raises(TargetError, match="kafka-python"):
+def test_unreachable_broker_without_store_raises():
+    t = brokers.KafkaTarget("arn:t", ["127.0.0.1:1"], "events")
+    with pytest.raises(TargetError, match="kafka delivery failed"):
         t.send(RECORD)
 
 
@@ -151,5 +151,8 @@ def test_all_kinds_constructible_from_config(monkeypatch):
         t = brokers.target_from_config(kind, cfg)
         assert t is not None, kind
         assert t.arn.endswith(f":{kind}")
+        if kind in ("amqp", "kafka"):
+            continue        # real wire clients — tested over sockets
+                            # in test_broker_wire.py
         with pytest.raises(TargetError):     # gated: no SDK in the image
             t._deliver(RECORD)
