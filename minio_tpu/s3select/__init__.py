@@ -45,7 +45,7 @@ class SelectRequest:
         self.input_opts = input_opts
         self.output_format = output_format    # "CSV" | "JSON"
         self.output_opts = output_opts
-        self.compression = compression        # "NONE" | "GZIP"
+        self.compression = compression    # "NONE" | "GZIP" | "BZIP2"
 
     @classmethod
     def parse(cls, payload: bytes) -> "SelectRequest":
@@ -66,7 +66,7 @@ class SelectRequest:
             raise SelectError("InvalidRequestParameter",
                               "InputSerialization required")
         compression = _text(inser, "CompressionType", "NONE").upper()
-        if compression not in ("NONE", "GZIP"):
+        if compression not in ("NONE", "GZIP", "BZIP2"):
             raise SelectError("InvalidCompressionFormat")
         csv_el, json_el = inser.find("CSV"), inser.find("JSON")
         parquet_el = inser.find("Parquet")
@@ -151,6 +151,13 @@ def run_select(payload: bytes, data: bytes) -> bytes:
         try:
             data = gzip.decompress(data)
         except (OSError, EOFError) as e:   # EOFError: truncated stream
+            raise SelectError("InvalidCompressionFormat") from e
+    elif req.compression == "BZIP2":
+        # pkg/s3select/select.go:310 accepts bzip2Type the same way
+        import bz2
+        try:
+            data = bz2.decompress(data)
+        except (OSError, ValueError, EOFError) as e:
             raise SelectError("InvalidCompressionFormat") from e
     try:
         query = sql.parse_query(req.expression)

@@ -168,4 +168,7 @@ def to_csv_record(row: dict, opts: dict) -> bytes:
 def to_json_record(row: dict, opts: dict) -> bytes:
     rd = opts.get("record_delim", "\n")
     clean = {k: v for k, v in row.items()}
-    return (_json.dumps(clean, default=str) + rd).encode()
+    # compact separators: the service emits no whitespace in JSON
+    # output records (observable AWS behavior; select.go json writer)
+    return (_json.dumps(clean, default=str, separators=(",", ":"))
+            + rd).encode()

@@ -35,7 +35,7 @@ _TOKEN_RE = re.compile(r"""
   | (?P<number>\d+(\.\d*)?([eE][+-]?\d+)?|\.\d+)
   | (?P<qident>"(?:[^"]|"")*")
   | (?P<string>'(?:[^']|'')*')
-  | (?P<op><>|!=|<=|>=|=|<|>|\+|-|\*|/|%|\(|\)|,|\.)
+  | (?P<op><>|!=|<=|>=|=|<|>|\+|-|\*|/|%|\(|\)|,|\.|\[|\])
   | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
 """, re.VERBOSE)
 
@@ -233,6 +233,9 @@ class Parser:
         name = self._ident_name()
         if name.lower() not in ("s3object", "s3objects"):
             raise SQLError("FROM must reference S3Object")
+        if self.accept("op", "["):      # FROM S3Object[*] — the JSON
+            self.expect("op", "*")      # document-array form
+            self.expect("op", "]")
         while self.accept("op", "."):   # S3Object.path — path ignored for
             self._ident_name()          # flat records (JMESPath-ish)
         if self.accept("kw", "AS"):

@@ -206,7 +206,7 @@ def test_select_parquet_over_api(client):
     recs = _select(client, "people.parquet",
                    "SELECT COUNT(*) AS n FROM S3Object", "<Parquet/>",
                    "<JSON/>")
-    assert recs == b'{"n": 3}\n'
+    assert recs == b'{"n":3}\n'
 
 
 def test_select_parquet_rejects_compression(client):

@@ -295,7 +295,7 @@ def test_select_json_output(client):
         "SELECT COUNT(*) AS total FROM S3Object",
         '<CSV><FileHeaderInfo>USE</FileHeaderInfo></CSV>',
         "<JSON/>")
-    assert recs == b'{"total": 4}\n'
+    assert recs == b'{"total":4}\n'
 
 
 def test_select_jsonl_over_api(client):
