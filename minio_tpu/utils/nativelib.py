@@ -36,6 +36,15 @@ def load(src: str, so: str, timeout: int = 120) -> ctypes.CDLL | None:
     block until the first build finishes rather than observing a
     half-initialized state — a slow compile of one library never stalls
     loads of the others."""
+    # sanitizer/CI hook: MT_NATIVE_BUILD_DIR redirects the compiled .so
+    # (so an instrumented build never clobbers the production cache)
+    # and MT_NATIVE_CFLAGS appends flags, e.g.
+    # "-fsanitize=address,undefined" (tests/test_sanitizers.py tier,
+    # the buildscripts/race.sh role)
+    build_dir = os.environ.get("MT_NATIVE_BUILD_DIR", "")
+    if build_dir:
+        so = os.path.join(build_dir, os.path.basename(so))
+    extra = os.environ.get("MT_NATIVE_CFLAGS", "").split()
     with _meta_lock:
         lock = _locks.setdefault(so, threading.Lock())
     with lock:
@@ -51,7 +60,8 @@ def load(src: str, so: str, timeout: int = 120) -> ctypes.CDLL | None:
                     cc = os.environ.get("CC", "g++" if src.endswith(
                         (".cc", ".cpp")) else "cc")
                     subprocess.run(
-                        [cc, "-O3", "-shared", "-fPIC", "-o", tmp, src],
+                        [cc, "-O3", "-shared", "-fPIC", *extra,
+                         "-o", tmp, src],
                         check=True, capture_output=True, timeout=timeout)
                     os.replace(tmp, so)
                 lib = ctypes.CDLL(so)

@@ -1,0 +1,167 @@
+"""Sanitizer tier for the native libraries (buildscripts/race.sh role).
+
+All four C/C++ libraries (native/gf8.cc, native/snappy.cc,
+native/jsonscan.cc, hashing/native/highwayhash.c) are rebuilt with
+``-fsanitize=address,undefined`` into a scratch build dir
+(MT_NATIVE_BUILD_DIR) and exercised — through their normal Python
+bindings, under concurrent load — in a subprocess running with libasan
+preloaded.  Any ASan/UBSan report fails the run.
+
+A canary proves the harness has teeth: a deliberately buggy library
+built and driven the same way MUST be caught.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _libasan() -> str | None:
+    try:
+        out = subprocess.run(["g++", "-print-file-name=libasan.so"],
+                             capture_output=True, text=True, timeout=30)
+        path = out.stdout.strip()
+        return path if path and os.path.exists(path) else None
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+
+
+asan = pytest.mark.skipif(_libasan() is None,
+                          reason="libasan not available")
+
+
+def _run_sanitized(code: str, tmp_path) -> subprocess.CompletedProcess:
+    env = dict(os.environ)
+    env.update({
+        "LD_PRELOAD": _libasan(),
+        "MT_NATIVE_BUILD_DIR": str(tmp_path / "san-build"),
+        "MT_NATIVE_CFLAGS":
+            "-fsanitize=address,undefined -fno-sanitize-recover=all -g",
+        # python itself leaks by design at exit; halt_on_error keeps
+        # real findings fatal
+        "ASAN_OPTIONS": "detect_leaks=0:halt_on_error=1:abort_on_error=1",
+        "UBSAN_OPTIONS": "halt_on_error=1:abort_on_error=1",
+        "JAX_PLATFORMS": "cpu",
+    })
+    return subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                          env=env, capture_output=True, text=True,
+                          timeout=600)
+
+
+WORKLOAD = textwrap.dedent("""
+    import os, threading
+    import numpy as np
+
+    errors = []
+
+    def gf8_work():
+        from minio_tpu.ops import gf8_native, gf8
+        assert gf8_native.available(), "gf8 sanitized build failed"
+        M = np.asarray(gf8.rs_matrix(8, 12))[8:]
+        rng = np.random.default_rng(0)
+        for n in (1, 31, 64, 4096, 87382):      # incl. GFNI tail sizes
+            B = rng.integers(0, 256, (8, n), dtype=np.uint8)
+            out = np.empty((4, n), dtype=np.uint8)
+            gf8_native.matmul_into(M, B, out)
+            exp = gf8_native.matmul(M, B)
+            assert np.array_equal(out, exp)
+
+    def snappy_work():
+        from minio_tpu import compress
+        if not compress.native_available():
+            return
+        for size in (0, 1, 100, 70000):
+            blob = os.urandom(size // 2) * 2
+            assert compress.decompress_block(
+                compress.compress_block(blob)) == blob
+            assert compress.decompress_stream(
+                compress.compress_stream(blob)) == blob
+
+    def hh_work():
+        from minio_tpu.hashing import highwayhash as hh
+        for size in (0, 1, 31, 32, 33, 1024, 87382):
+            hh.hh256(os.urandom(size))
+
+    def jsonscan_work():
+        from minio_tpu.s3select import records
+        data = b'\\n'.join(
+            b'{"k":"v%d","n":%d}' % (i, i) for i in range(200)) + b'\\n'
+        records.ndjson_prefilter(data, "k", "=", "v7")
+        records.ndjson_prefilter(data, "n", ">", 100)
+
+    def run(fn):
+        try:
+            for _ in range(5):
+                fn()
+        except Exception as e:      # noqa: BLE001
+            errors.append(f"{fn.__name__}: {e!r}")
+
+    threads = [threading.Thread(target=run, args=(f,))
+               for f in (gf8_work, snappy_work, hh_work, jsonscan_work)
+               for _ in range(3)]
+    for t in threads: t.start()
+    for t in threads: t.join()
+    assert not errors, errors
+    print("SANITIZED-WORKLOAD-OK")
+""")
+
+
+@asan
+def test_native_libs_clean_under_asan_ubsan(tmp_path):
+    res = _run_sanitized(WORKLOAD, tmp_path)
+    assert "SANITIZED-WORKLOAD-OK" in res.stdout, \
+        f"stdout={res.stdout[-2000:]}\nstderr={res.stderr[-4000:]}"
+    for marker in ("AddressSanitizer", "runtime error:",
+                   "SUMMARY: UndefinedBehaviorSanitizer"):
+        assert marker not in res.stderr, res.stderr[-4000:]
+    assert res.returncode == 0
+
+
+CANARY_SRC = textwrap.dedent("""
+    #include <cstring>
+    extern "C" int mt_canary(const unsigned char* src, int n) {
+        unsigned char buf[8];
+        std::memcpy(buf, src, n);     // n > 8 overflows the stack buf
+        return buf[0];
+    }
+""")
+
+CANARY_DRIVER = textwrap.dedent("""
+    import ctypes, os
+    from minio_tpu.utils import nativelib
+    src = os.environ["CANARY_SRC"]
+    so = os.path.join(os.environ["MT_NATIVE_BUILD_DIR"], "libcanary.so")
+    lib = nativelib.load(src, so)
+    assert lib is not None, "canary build failed"
+    lib.mt_canary(b"x" * 64, 64)      # overflow -> ASan must abort
+    print("CANARY-SURVIVED")          # must never print
+""")
+
+
+@asan
+def test_harness_catches_injected_overflow(tmp_path):
+    """The tier is only evidence if it FAILS on a real bug."""
+    src = tmp_path / "canary.cc"
+    src.write_text(CANARY_SRC)
+    env_extra = {"CANARY_SRC": str(src)}
+    code = CANARY_DRIVER
+    env = dict(os.environ)
+    env.update({
+        "LD_PRELOAD": _libasan(),
+        "MT_NATIVE_BUILD_DIR": str(tmp_path / "san-build"),
+        "MT_NATIVE_CFLAGS":
+            "-fsanitize=address,undefined -fno-sanitize-recover=all -g",
+        "ASAN_OPTIONS": "detect_leaks=0:halt_on_error=1:abort_on_error=1",
+        **env_extra,
+    })
+    res = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         env=env, capture_output=True, text=True,
+                         timeout=300)
+    assert res.returncode != 0, "injected overflow was NOT caught"
+    assert "CANARY-SURVIVED" not in res.stdout
+    assert "AddressSanitizer" in res.stderr, res.stderr[-2000:]
