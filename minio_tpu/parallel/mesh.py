@@ -84,12 +84,27 @@ def distributed_apply(mesh: Mesh, M: np.ndarray,
                       shards: np.ndarray) -> jax.Array:
     """out[b] = M (GF) @ shards[b], sharded over the mesh.
 
-    M: (r, k) GF coefficients;  shards: (B, k, n) uint8 with B divisible by
-    the stripe axis and k by the shard axis.  Returns device array (B, r, n).
+    M: (r, k) GF coefficients;  shards: (B, k, n) uint8 with B divisible
+    by the stripe axis.  k NEED NOT divide the shard axis: zero shards
+    (and matching zero matrix columns) pad k up to the next multiple —
+    a zero operand contributes nothing to the XOR fan-in, so the padded
+    kernel is bit-identical (the k=12-over-4 exactness of the headline
+    geometry is not load-bearing).
     """
-    M2 = jnp.asarray(gf8.gf2_expand(np.asarray(M, dtype=np.uint8)), jnp.int8)
+    M = np.asarray(M, dtype=np.uint8)
+    shards = np.asarray(shards, dtype=np.uint8)
+    S = mesh.shape["shard"]
+    k = shards.shape[1]
+    pad = (-k) % S
+    if pad:
+        shards = np.concatenate(
+            [shards, np.zeros((shards.shape[0], pad, shards.shape[2]),
+                              np.uint8)], axis=1)
+        M = np.concatenate(
+            [M, np.zeros((M.shape[0], pad), np.uint8)], axis=1)
+    M2 = jnp.asarray(gf8.gf2_expand(M), jnp.int8)
     fn = _sharded_apply(mesh, M2.shape[0], shards.shape[1])
-    return fn(M2, jnp.asarray(shards, dtype=jnp.uint8))
+    return fn(M2, jnp.asarray(shards))
 
 
 def distributed_encode(mesh: Mesh, data_blocks: int, parity_blocks: int,
@@ -162,6 +177,58 @@ def ring_reconstruct(mesh: Mesh, data_blocks: int, parity_blocks: int,
                      jnp.int8)
     fn = _ring_apply(mesh, M2.shape[0], surviving.shape[1])
     return fn(M2, jnp.asarray(surviving, dtype=jnp.uint8))
+
+
+# -- per-device-different survivor patterns ---------------------------------
+
+@functools.lru_cache(maxsize=32)
+def _grouped_apply(mesh: Mesh, n_rows: int, k: int):
+    """Like _sharded_apply but the decode matrix VARIES along the
+    stripe axis: each stripe group (one row of devices) applies its own
+    matrix.  This is the real degraded-cluster shape — different erasure
+    sets lose different drives, so each device group reconstructs with
+    its own survivor pattern in the SAME sharded step
+    (cmd/erasure-healing.go heals per-set patterns independently)."""
+    inner = _local_gf2_kernel(
+        n_rows, lambda acc: jax.lax.psum(acc, "shard"))
+
+    def local(mats, data):
+        # mats: (1, 8r, 8k/S) — this stripe group's matrix slice
+        return inner(mats[0], data)
+
+    specs = dict(in_specs=(P("stripe", None, "shard"),
+                           P("stripe", "shard", None)),
+                 out_specs=P("stripe", None, None))
+    return jax.jit(jax.shard_map(local, mesh=mesh, **specs))
+
+
+def distributed_reconstruct_mixed(
+        mesh: Mesh, data_blocks: int, parity_blocks: int,
+        surviving: np.ndarray,
+        patterns: list[tuple[list[int], list[int]]]) -> jax.Array:
+    """Rebuild shards where EACH stripe group has its own survivor
+    pattern.
+
+    surviving: (B, k, n) with B divisible by the stripe axis; stripe
+    group g's rows are ordered by ``patterns[g][0]`` (its present
+    list).  patterns: one (present, wanted) per stripe-axis group; all
+    groups must want the same COUNT of shards (their identities may
+    differ freely).  Returns (B, r, n): group g's rows are its own
+    ``patterns[g][1]`` reconstruction.
+    """
+    T = mesh.shape["stripe"]
+    if len(patterns) != T:
+        raise ValueError(f"need {T} patterns, got {len(patterns)}")
+    r = len(patterns[0][1])
+    if any(len(w) != r for _, w in patterns):
+        raise ValueError("all groups must reconstruct the same count")
+    mats = np.stack([
+        gf8.gf2_expand(np.asarray(_reconstruct_rows(
+            data_blocks, parity_blocks, list(p), list(w)), np.uint8))
+        for p, w in patterns]).astype(np.int8)         # (T, 8r, 8k)
+    fn = _grouped_apply(mesh, mats.shape[1], surviving.shape[1])
+    return fn(jnp.asarray(mats),
+              jnp.asarray(surviving, dtype=jnp.uint8))
 
 
 # -- fused encode + bitrot hash (BASELINE config 5, multi-chip form) --------

@@ -97,3 +97,58 @@ def test_fused_encode_with_bitrot_multichip(devices):
         for s in range(k + m):
             want = np.frombuffer(hh256(full[s].tobytes()), np.uint8)
             assert np.array_equal(digests[b, s], want), (b, s)
+
+
+def test_uneven_k_over_shard_axis():
+    """k not divisible by the shard axis: zero-pad semantics must be
+    bit-identical (r4 hardening, cmd/erasure-decode.go generality)."""
+    devices = jax.devices()[:8]
+    mesh = pmesh.make_mesh(devices, stripe=2, shard=4)
+    k, m = 10, 3                      # 10 % 4 != 0
+    B, n = 4, 96
+    rng = np.random.default_rng(7)
+    shards = rng.integers(0, 256, (B, k, n), dtype=np.uint8)
+    parity = np.asarray(pmesh.distributed_encode(mesh, k, m, shards))
+    for b in range(B):
+        assert np.array_equal(parity[b],
+                              gf8_ref.encode_parity(shards[b], m)), b
+    full = np.concatenate([shards, parity], axis=1)
+    present = [0, 1, 3, 4, 5, 6, 7, 8, 10, 11]
+    wanted = [2, 9, 12]
+    out = np.asarray(pmesh.distributed_reconstruct(
+        mesh, k, m, full[:, present, :], present, wanted))
+    assert np.array_equal(out, full[:, wanted, :])
+
+
+def test_mixed_survivor_patterns_one_step():
+    """Each stripe group reconstructs with its OWN survivor pattern in
+    one sharded step (per-device-different degraded state)."""
+    devices = jax.devices()[:8]
+    mesh = pmesh.make_mesh(devices, stripe=2, shard=4)
+    k, m = 4, 2
+    B, n = 4, 96                      # 2 stripes per group
+    rng = np.random.default_rng(13)
+    shards = rng.integers(0, 256, (B, k, n), dtype=np.uint8)
+    parity = np.asarray(pmesh.distributed_encode(mesh, k, m, shards))
+    full = np.concatenate([shards, parity], axis=1)
+    patterns = [([0, 2, 3, 4], [1, 5]),      # group 0 lost shards 1, 5
+                ([1, 2, 4, 5], [0, 3])]      # group 1 lost shards 0, 3
+    surv = np.stack([full[b][patterns[b // 2][0], :] for b in range(B)])
+    out = np.asarray(pmesh.distributed_reconstruct_mixed(
+        mesh, k, m, surv, patterns))
+    for b in range(B):
+        _, lost = patterns[b // 2]
+        assert np.array_equal(out[b], full[b][lost, :]), b
+
+
+def test_mixed_patterns_validation():
+    devices = jax.devices()[:8]
+    mesh = pmesh.make_mesh(devices, stripe=2, shard=4)
+    surv = np.zeros((2, 4, 96), np.uint8)
+    with pytest.raises(ValueError, match="patterns"):
+        pmesh.distributed_reconstruct_mixed(
+            mesh, 4, 2, surv, [([0, 1, 2, 3], [4, 5])])   # 1 != T
+    with pytest.raises(ValueError, match="same count"):
+        pmesh.distributed_reconstruct_mixed(
+            mesh, 4, 2, surv, [([0, 1, 2, 3], [4, 5]),
+                               ([0, 1, 2, 3], [4])])
