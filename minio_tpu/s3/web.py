@@ -219,6 +219,87 @@ class WebRPC:
             expiry, self.srv.region)
         return {"url": url, "uiVersion": UI_VERSION}
 
+    # -- bucket policy kinds (web-handlers.go SetBucketPolicy /
+    # GetBucketPolicy / ListAllBucketPolicies: the UI works in canned
+    # kinds per bucket/prefix — none | readonly | writeonly | readwrite
+    # — which expand to real bucket-policy statements) -------------------
+
+    _KIND_ACTIONS = {
+        "readonly": ("s3:GetObject",),
+        "writeonly": ("s3:AbortMultipartUpload", "s3:DeleteObject",
+                      "s3:PutObject"),
+        "readwrite": ("s3:AbortMultipartUpload", "s3:DeleteObject",
+                      "s3:GetObject", "s3:PutObject"),
+    }
+
+    def _policy_doc(self, bucket: str) -> dict:
+        raw = self.srv.bucket_meta.get_config(bucket, "policy")
+        if not raw:
+            return {"Version": "2012-10-17", "Statement": []}
+        return json.loads(raw)
+
+    @staticmethod
+    def _prefix_arn(bucket: str, prefix: str) -> str:
+        return f"arn:aws:s3:::{bucket}/{prefix}*"
+
+    def _kind_of(self, stmt: dict) -> str:
+        acts = set(stmt.get("Action") or [])
+        for kind, kacts in self._KIND_ACTIONS.items():
+            if acts == set(kacts):
+                return kind
+        return "none" if not acts else "custom"
+
+    def rpc_SetBucketPolicy(self, ak, p: dict) -> dict:
+        bucket = p.get("bucketName", "")
+        prefix = p.get("prefix", "")
+        kind = p.get("policy", "none")
+        if kind not in ("none", *self._KIND_ACTIONS):
+            raise WebError(f"invalid policy kind {kind!r}")
+        _allowed(self.srv, ak, "s3:PutBucketPolicy", bucket)
+        self.srv.layer.get_bucket_info(bucket)
+        doc = self._policy_doc(bucket)
+        arn = self._prefix_arn(bucket, prefix)
+        doc["Statement"] = [s for s in doc.get("Statement", [])
+                            if s.get("Resource") != [arn]]
+        if kind != "none":
+            doc["Statement"].append({
+                "Effect": "Allow",
+                "Principal": {"AWS": ["*"]},
+                "Action": sorted(self._KIND_ACTIONS[kind]),
+                "Resource": [arn],
+            })
+        self.srv.bucket_meta.set_config(
+            bucket, "policy",
+            json.dumps(doc) if doc["Statement"] else None)
+        return {"uiVersion": UI_VERSION}
+
+    def rpc_GetBucketPolicy(self, ak, p: dict) -> dict:
+        bucket = p.get("bucketName", "")
+        prefix = p.get("prefix", "")
+        _allowed(self.srv, ak, "s3:GetBucketPolicy", bucket)
+        self.srv.layer.get_bucket_info(bucket)
+        arn = self._prefix_arn(bucket, prefix)
+        kind = "none"
+        for s in self._policy_doc(bucket).get("Statement", []):
+            if s.get("Resource") == [arn]:
+                kind = self._kind_of(s)
+        return {"policy": kind, "uiVersion": UI_VERSION}
+
+    def rpc_ListAllBucketPolicies(self, ak, p: dict) -> dict:
+        bucket = p.get("bucketName", "")
+        _allowed(self.srv, ak, "s3:GetBucketPolicy", bucket)
+        self.srv.layer.get_bucket_info(bucket)
+        out = []
+        want = f"arn:aws:s3:::{bucket}/"
+        for s in self._policy_doc(bucket).get("Statement", []):
+            for res in s.get("Resource") or []:
+                if res.startswith(want) and res.endswith("*"):
+                    out.append({
+                        "bucket": bucket,
+                        "prefix": res[len(want):-1],
+                        "policy": self._kind_of(s)})
+        return {"policies": out, "uiVersion": UI_VERSION}
+
     # -- credentials -------------------------------------------------------
 
     def rpc_GetAuth(self, ak, _p) -> dict:

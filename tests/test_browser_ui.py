@@ -140,3 +140,116 @@ def test_ui_flow_over_http(server):
             f"{server.endpoint}/minio-tpu/download/uibucket/docs/hello.txt"
             f"?token={dl}", timeout=10) as resp:
         assert resp.read() == b"hello from the browser"
+
+
+def _rpc(server, method, params=None, token=""):
+    body = json.dumps({"jsonrpc": "2.0", "id": 1,
+                       "method": f"web.{method}",
+                       "params": params or {}}).encode()
+    req = urllib.request.Request(
+        f"{server.endpoint}/minio-tpu/webrpc", data=body,
+        headers={"Content-Type": "application/json",
+                 **({"Authorization": f"Bearer {token}"}
+                    if token else {})})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        doc = json.loads(resp.read())
+    assert "error" not in doc, doc
+    return doc["result"]
+
+
+def test_new_ui_flows_present(server):
+    """r4 breadth: the page carries policy management, share expiry,
+    multi-select delete, and upload progress wiring."""
+    page = _get(server, BROWSER_PATH).read().decode()
+    for marker in ["polselect", "SetBucketPolicy", "GetBucketPolicy",
+                   "delselected", "selectedObjects", "parseExpiry",
+                   "upload.onprogress", "progwrap"]:
+        assert marker in page, marker
+
+
+def test_policy_management_flow(server):
+    """Set readonly on a prefix via the web RPC, verify it round-trips,
+    is listed, and actually grants ANONYMOUS reads — then revoke."""
+    tok = _rpc(server, "Login", {"username": "uikey",
+                                 "password": "uisecret"})["token"]
+    _rpc(server, "MakeBucket", {"bucketName": "polbkt"}, tok)
+    req = urllib.request.Request(
+        f"{server.endpoint}/minio-tpu/upload/polbkt/pub/doc.txt",
+        data=b"public document", method="PUT",
+        headers={"Authorization": f"Bearer {tok}",
+                 "Content-Type": "text/plain"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert json.loads(resp.read())["ok"] is True
+
+    # anonymous read denied before a policy exists
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            f"{server.endpoint}/polbkt/pub/doc.txt", timeout=10)
+    assert ei.value.status == 403
+
+    _rpc(server, "SetBucketPolicy",
+         {"bucketName": "polbkt", "prefix": "pub/",
+          "policy": "readonly"}, tok)
+    got = _rpc(server, "GetBucketPolicy",
+               {"bucketName": "polbkt", "prefix": "pub/"}, tok)
+    assert got["policy"] == "readonly"
+    lst = _rpc(server, "ListAllBucketPolicies",
+               {"bucketName": "polbkt"}, tok)
+    assert {"bucket": "polbkt", "prefix": "pub/",
+            "policy": "readonly"} in lst["policies"]
+
+    # the canned policy is ENFORCED: anonymous read now succeeds
+    with urllib.request.urlopen(
+            f"{server.endpoint}/polbkt/pub/doc.txt", timeout=10) as r:
+        assert r.read() == b"public document"
+
+    # revoke -> anonymous denied again
+    _rpc(server, "SetBucketPolicy",
+         {"bucketName": "polbkt", "prefix": "pub/",
+          "policy": "none"}, tok)
+    assert _rpc(server, "GetBucketPolicy",
+                {"bucketName": "polbkt", "prefix": "pub/"},
+                tok)["policy"] == "none"
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            f"{server.endpoint}/polbkt/pub/doc.txt", timeout=10)
+    assert ei.value.status == 403
+
+
+def test_invalid_policy_kind_rejected(server):
+    tok = _rpc(server, "Login", {"username": "uikey",
+                                 "password": "uisecret"})["token"]
+    _rpc(server, "MakeBucket", {"bucketName": "polbad"}, tok)
+    body = json.dumps({"jsonrpc": "2.0", "id": 1,
+                       "method": "web.SetBucketPolicy",
+                       "params": {"bucketName": "polbad",
+                                  "policy": "everything"}}).encode()
+    req = urllib.request.Request(
+        f"{server.endpoint}/minio-tpu/webrpc", data=body,
+        headers={"Content-Type": "application/json",
+                 "Authorization": f"Bearer {tok}"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        doc = json.loads(resp.read())
+    assert "error" in doc and "invalid policy kind" in \
+        doc["error"]["message"]
+
+
+def test_multi_object_delete_flow(server):
+    """The Delete-selected UI path: one RemoveObject RPC with many
+    keys removes exactly those keys."""
+    tok = _rpc(server, "Login", {"username": "uikey",
+                                 "password": "uisecret"})["token"]
+    _rpc(server, "MakeBucket", {"bucketName": "multidel"}, tok)
+    for name in ("a.txt", "b.txt", "keep.txt"):
+        req = urllib.request.Request(
+            f"{server.endpoint}/minio-tpu/upload/multidel/{name}",
+            data=b"x", method="PUT",
+            headers={"Authorization": f"Bearer {tok}"})
+        urllib.request.urlopen(req, timeout=10).read()
+    res = _rpc(server, "RemoveObject",
+               {"bucketName": "multidel",
+                "objects": ["a.txt", "b.txt"]}, tok)
+    assert sorted(res["removed"]) == ["a.txt", "b.txt"]
+    objs = _rpc(server, "ListObjects",
+                {"bucketName": "multidel", "prefix": ""}, tok)["objects"]
+    assert [o["name"] for o in objs] == ["keep.txt"]
