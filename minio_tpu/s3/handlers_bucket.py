@@ -278,7 +278,10 @@ def _bucket_api(self, bucket, query, payload):
     if cmd == "GET" and "location" in query:
         self._allow(iampol.GET_BUCKET_LOCATION, bucket)
         root = ET.Element("LocationConstraint", xmlns=S3_NS)
-        root.text = self.srv.region
+        # us-east-1 is the EMPTY constraint on the wire (AWS contract;
+        # cmd/api-response.go LocationResponse) — clients special-case it
+        root.text = "" if self.srv.region == "us-east-1" \
+            else self.srv.region
         self.srv.layer.get_bucket_info(bucket)
         return self._send(200, _xml(root))
     if cmd == "GET" and "versions" in query:

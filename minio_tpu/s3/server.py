@@ -610,7 +610,9 @@ def _make_handler(srv: S3Server):
                 api = s3err.get("SlowDown")
             else:
                 api = s3err.get("InternalError")
-            self._send(api.http_status, s3err.to_xml(api, resource))
+            self._send(api.http_status,
+                       s3err.to_xml(api, resource,
+                                    getattr(self, "_req_id", "") or ""))
 
         def _dispatch(self):
             """Trace/audit wrapper around the real dispatcher

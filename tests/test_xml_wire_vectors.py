@@ -1,0 +1,198 @@
+"""Wire-level XML response vectors for the high-traffic S3 APIs — the
+mint-analog conformance slice (mint/README.md role; no boto3/egress in
+this image, so the expected documents are vendored here).
+
+Each vector pins the EXACT response body (element order, namespace,
+empty-element style) with dynamic values (timestamps, etags, ids,
+ports) normalized by regex.  A field rename, element reorder, or
+namespace change — the kind of drift S3 SDK XML decoders break on —
+trips these before any client does.
+"""
+
+import json
+import re
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl_storage import XLStorage
+
+NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+@pytest.fixture(scope="module")
+def ctx(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("wire")
+    disks = []
+    for i in range(4):
+        d = tmp / f"d{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                           backend="numpy")
+    srv = S3Server(layer, access_key="vk", secret_key="vs")
+    srv.start()
+    c = S3Client(srv.endpoint, "vk", "vs")
+    c.make_bucket("wvb")
+    c.put_object("wvb", "a/x.txt", b"hello")
+    c.put_object("wvb", "b.bin", b"12345678")
+    yield srv, c
+    srv.stop()
+
+
+def norm(body: bytes) -> str:
+    """Normalize dynamic values: ISO timestamps, hex ids/etags, ports."""
+    s = body.decode()
+    s = re.sub(r"\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{3}Z", "@TIME@", s)
+    s = re.sub(r"[0-9a-f]{32}(-\d+)?", "@HEX@", s)
+    s = re.sub(r"[0-9a-f]{16}", "@RID@", s)
+    s = re.sub(r"127\.0\.0\.1:\d+", "@HOST@", s)
+    return s
+
+
+def test_list_buckets_vector(ctx):
+    _, c = ctx
+    assert norm(c.request("GET", "/").body) == (
+        '<?xml version="1.0" encoding="UTF-8"?>'
+        '<ListAllMyBucketsResult '
+        'xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+        '<Owner><ID>minio-tpu</ID><DisplayName>minio-tpu</DisplayName>'
+        '</Owner><Buckets><Bucket><Name>wvb</Name>'
+        '<CreationDate>@TIME@</CreationDate></Bucket></Buckets>'
+        '</ListAllMyBucketsResult>')
+
+
+def test_list_objects_v2_vector(ctx):
+    _, c = ctx
+    assert norm(c.request("GET", "/wvb",
+                          query="list-type=2&delimiter=%2F").body) == (
+        '<?xml version="1.0" encoding="UTF-8"?>'
+        '<ListBucketResult '
+        'xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+        '<Name>wvb</Name><Prefix /><Delimiter>/</Delimiter>'
+        '<MaxKeys>1000</MaxKeys><IsTruncated>false</IsTruncated>'
+        '<KeyCount>2</KeyCount>'
+        '<Contents><Key>b.bin</Key><LastModified>@TIME@</LastModified>'
+        '<ETag>"@HEX@"</ETag><Size>8</Size>'
+        '<StorageClass>STANDARD</StorageClass></Contents>'
+        '<CommonPrefixes><Prefix>a/</Prefix></CommonPrefixes>'
+        '</ListBucketResult>')
+
+
+def test_list_objects_v1_vector(ctx):
+    """V1 carries Marker and per-entry Owner (the V1/V2 split clients
+    depend on)."""
+    _, c = ctx
+    body = norm(c.request("GET", "/wvb", query="prefix=a%2F").body)
+    assert body == (
+        '<?xml version="1.0" encoding="UTF-8"?>'
+        '<ListBucketResult '
+        'xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+        '<Name>wvb</Name><Prefix>a/</Prefix><MaxKeys>1000</MaxKeys>'
+        '<IsTruncated>false</IsTruncated><Marker />'
+        '<Contents><Key>a/x.txt</Key><LastModified>@TIME@</LastModified>'
+        '<ETag>"@HEX@"</ETag><Size>5</Size>'
+        '<StorageClass>STANDARD</StorageClass>'
+        '<Owner><ID>minio-tpu</ID><DisplayName>minio-tpu</DisplayName>'
+        '</Owner></Contents></ListBucketResult>')
+
+
+def test_multipart_vectors(ctx):
+    _, c = ctx
+    r = c.request("POST", "/wvb/mp.bin", query="uploads")
+    assert norm(r.body) == (
+        '<?xml version="1.0" encoding="UTF-8"?>'
+        '<InitiateMultipartUploadResult '
+        'xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+        '<Bucket>wvb</Bucket><Key>mp.bin</Key>'
+        '<UploadId>@HEX@</UploadId></InitiateMultipartUploadResult>')
+    uid = ET.fromstring(r.body).findtext(f"{NS}UploadId")
+    r = c.request("PUT", "/wvb/mp.bin",
+                  query=f"partNumber=1&uploadId={uid}", body=b"p" * 64)
+    etag = r.headers.get("ETag")
+    assert re.fullmatch(r'"[0-9a-f]{32}"', etag)
+    done = (f"<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>"
+            f"<ETag>{etag}</ETag></Part></CompleteMultipartUpload>"
+            ).encode()
+    r = c.request("POST", "/wvb/mp.bin", query=f"uploadId={uid}",
+                  body=done)
+    assert norm(r.body) == (
+        '<?xml version="1.0" encoding="UTF-8"?>'
+        '<CompleteMultipartUploadResult '
+        'xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+        '<Location>http://@HOST@/wvb/mp.bin</Location>'
+        '<Bucket>wvb</Bucket><Key>mp.bin</Key><ETag>"@HEX@"</ETag>'
+        '</CompleteMultipartUploadResult>')
+    # multipart ETag carries the part-count suffix on the wire
+    assert re.search(r'"[0-9a-f]{32}-1"', r.body.decode())
+
+
+def test_copy_object_vector(ctx):
+    _, c = ctx
+    r = c.request("PUT", "/wvb/copy.txt",
+                  headers={"x-amz-copy-source": "/wvb/a/x.txt"})
+    assert norm(r.body) == (
+        '<?xml version="1.0" encoding="UTF-8"?>'
+        '<CopyObjectResult '
+        'xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+        '<ETag>"@HEX@"</ETag><LastModified>@TIME@</LastModified>'
+        '</CopyObjectResult>')
+
+
+def test_delete_multiple_vector(ctx):
+    """Missing keys still report Deleted — S3's idempotent contract."""
+    _, c = ctx
+    c.put_object("wvb", "dm.txt", b"x")
+    body = (b"<Delete><Object><Key>dm.txt</Key></Object>"
+            b"<Object><Key>never-existed.txt</Key></Object></Delete>")
+    r = c.request("POST", "/wvb", query="delete", body=body)
+    assert norm(r.body) == (
+        '<?xml version="1.0" encoding="UTF-8"?>'
+        '<DeleteResult xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+        '<Deleted><Key>dm.txt</Key></Deleted>'
+        '<Deleted><Key>never-existed.txt</Key></Deleted>'
+        '</DeleteResult>')
+
+
+def test_error_document_vector(ctx):
+    """Error XML: NO namespace (the AWS error schema), Code/Message/
+    Resource/RequestId — and RequestId is filled, matching the
+    x-amz-request-id header."""
+    srv, c = ctx
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+    conn.request("GET", "/wvb/definitely-missing")
+    resp = conn.getresponse()
+    body = resp.read()
+    rid = resp.getheader("x-amz-request-id")
+    conn.close()
+    assert resp.status == 403              # anonymous: AccessDenied
+    assert rid and re.fullmatch(r"[0-9a-f]{16}", rid)
+    assert body.decode() == (
+        '<?xml version="1.0" encoding="UTF-8"?>'
+        '<Error><Code>AccessDenied</Code>'
+        '<Message>Access Denied.</Message>'
+        '<Resource>/wvb/definitely-missing</Resource>'
+        f'<RequestId>{rid}</RequestId></Error>')
+
+
+def test_no_such_key_vector(ctx):
+    from minio_tpu.s3.client import S3ClientError
+    _, c = ctx
+    with pytest.raises(S3ClientError) as ei:
+        c.get_object("wvb", "missing-object")
+    assert ei.value.status == 404
+    assert ei.value.code == "NoSuchKey"
+
+
+def test_location_vector(ctx):
+    """us-east-1 is the EMPTY LocationConstraint on the wire — clients
+    special-case it (AWS contract)."""
+    _, c = ctx
+    assert c.request("GET", "/wvb", query="location").body.decode() == (
+        '<?xml version="1.0" encoding="UTF-8"?>'
+        '<LocationConstraint '
+        'xmlns="http://s3.amazonaws.com/doc/2006-03-01/" />')
