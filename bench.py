@@ -470,8 +470,25 @@ def _bench_end_to_end_put() -> dict | None:
                 best = max(best, run_leg(lay))
             return best
 
+        def get_leg(lay):
+            """Sustained GET over objects the PUT legs wrote: k-shard
+            read + bitrot verify + stripe assemble (the full
+            get_object_reader pipeline, page-cache warm)."""
+            def rd(i):
+                _, body2 = lay.get_object("benchbkt", f"obj-{i:04d}")
+                return len(body2)
+            rd(0)                                      # warm path
+            best = 0.0
+            for _ in range(2):
+                t0 = time.perf_counter()
+                total = sum(rd(i) for i in range(n_obj))
+                assert total == n_obj * obj_size
+                best = max(best,
+                           total / (time.perf_counter() - t0) / 2**30)
+            return best
+
         prev = os.environ.get("MT_NO_COMPAT")
-        shm_gibps, shm_strict = None, None
+        shm_gibps, shm_strict, shm_get = None, None, None
         try:
             os.environ["MT_NO_COMPAT"] = "0"
             strict_gibps = best_leg()
@@ -491,6 +508,7 @@ def _bench_end_to_end_put() -> dict | None:
                         shm_gibps = best_leg(shm_layer)
                         os.environ["MT_NO_COMPAT"] = "0"
                         shm_strict = best_leg(shm_layer)
+                        shm_get = get_leg(shm_layer)
                     finally:
                         shutil.rmtree(shm_root, ignore_errors=True)
             except Exception as e:  # noqa: BLE001 — optional leg
@@ -510,6 +528,7 @@ def _bench_end_to_end_put() -> dict | None:
                                      if shm_gibps else None),
             "tmpfs_strict_GiBps": (round(shm_strict, 3)
                                    if shm_strict else None),
+            "tmpfs_get_GiBps": (round(shm_get, 3) if shm_get else None),
             # hardware roofline for the disk legs: raw one-file
             # sequential buffered write+sync on the same fs.  The
             # SUSTAINED pipeline bound = raw / (16/12 write

@@ -242,6 +242,41 @@ class StreamingBitrotReader:
         return bytes(out)
 
 
+def verify_extract(framed, shard_size: int, length: int,
+                   algo: str = DEFAULT_BITROT_ALGORITHM):
+    """Verify a whole framed shard and extract its payload — the GET
+    hot path (cmd/bitrot-streaming.go ReadAt, whole-shard case).
+
+    One GIL-free native digest pass over the frame plus one strided
+    numpy copy for the payload, instead of per-block Python hashing
+    with three intermediate copies.  Returns a uint8 array of
+    ``length`` payload bytes, or None when the fast path does not
+    apply (non-HH256S algo / native lib missing) — caller falls back
+    to StreamingBitrotReader.
+    """
+    if algo != HIGHWAYHASH256S:
+        return None
+    from .highwayhash import hh256_verify_framed
+    import numpy as np
+    arr = np.frombuffer(framed, dtype=np.uint8) \
+        if not isinstance(framed, np.ndarray) else framed
+    bad = hh256_verify_framed(arr, shard_size)
+    if bad is None:
+        return None
+    if bad:
+        raise BitrotError(f"content hash mismatch (block {bad})")
+    F = 32 + shard_size
+    nfull = arr.size // F
+    head = arr[:nfull * F].reshape(nfull, F)[:, 32:]   # strided view
+    if nfull * shard_size >= length:
+        return head.reshape(-1)[:length].copy()
+    out = np.empty(length, dtype=np.uint8)
+    out[:nfull * shard_size] = head.reshape(-1)
+    tail = arr[nfull * F + 32:]                        # short last block
+    out[nfull * shard_size:] = tail[:length - nfull * shard_size]
+    return out
+
+
 @dataclass
 class BitrotVerifier:
     """Whole-file verifier (cmd/bitrot.go:77-85)."""

@@ -52,6 +52,10 @@ def _get_lib():
             lib.mt_hh256_fill.argtypes = [
                 ctypes.c_char_p, ctypes.c_void_p, ctypes.c_size_t,
                 ctypes.c_size_t]
+            lib.mt_hh256_verify_framed.argtypes = [
+                ctypes.c_char_p, ctypes.c_void_p, ctypes.c_size_t,
+                ctypes.c_size_t]
+            lib.mt_hh256_verify_framed.restype = ctypes.c_int
             lib.mt_hh_stream_size.restype = ctypes.c_size_t
             lib.mt_hh_stream_init.argtypes = [ctypes.c_char_p,
                                               ctypes.c_char_p]
@@ -255,6 +259,28 @@ def hh256_fill(framed, block_size: int, key: bytes = MAGIC_KEY) -> bool:
     lib.mt_hh256_fill(key, arr.ctypes.data_as(ctypes.c_void_p),
                       arr.size, block_size)
     return True
+
+
+def hh256_verify_framed(framed, block_size: int,
+                        key: bytes = MAGIC_KEY) -> int | None:
+    """Verify every block digest of a framed [32B hash][block] buffer
+    in ONE GIL-free native pass (the GET-side dual of hh256_fill).
+
+    Returns 0 when all blocks verify, the 1-based index of the first
+    corrupt block otherwise, or None when the native library is
+    unavailable (caller falls back to the per-block Python reader)."""
+    if block_size <= 0:
+        raise ValueError("block_size must be positive")
+    lib = _get_lib()
+    if lib is None:
+        return None
+    import numpy as np
+    arr = np.frombuffer(framed, dtype=np.uint8) \
+        if not isinstance(framed, np.ndarray) else framed
+    if not arr.flags["C_CONTIGUOUS"]:
+        arr = np.ascontiguousarray(arr)
+    return int(lib.mt_hh256_verify_framed(
+        key, arr.ctypes.data_as(ctypes.c_void_p), arr.size, block_size))
 
 
 def hh256_frame(data, block_size: int, key: bytes = MAGIC_KEY) -> bytes:

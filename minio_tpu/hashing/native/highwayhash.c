@@ -244,6 +244,27 @@ void mt_hh256_fill(const uint64_t key[4], uint8_t* framed, size_t size,
   }
 }
 
+/* One-pass framed-shard VERIFY (the GET-side dual of mt_hh256_fill,
+ * cmd/bitrot-streaming.go:92-158 ReadAt verification): recompute every
+ * block digest and compare.  Returns 0 when all blocks verify, else
+ * the 1-based index of the first corrupt block.  GIL-free, no copies —
+ * the caller extracts payloads with one strided pass afterwards. */
+int mt_hh256_verify_framed(const uint64_t key[4], const uint8_t* framed,
+                           size_t size, size_t block_size) {
+  size_t off = 0;
+  int idx = 1;
+  uint8_t digest[32];
+  while (off + 32 < size) {
+    size_t n = size - off - 32 < block_size ? size - off - 32 : block_size;
+    mt_hh256(key, framed + off + 32, n, digest);
+    for (int i = 0; i < 32; i++)
+      if (digest[i] != framed[off + i]) return idx;
+    off += 32 + n;
+    idx++;
+  }
+  return 0;
+}
+
 /* One-pass bitrot shard framing (cmd/bitrot-streaming.go:46-58): emit
  * hash || block for every block_size block.  Doing hash + copy in one
  * GIL-free call is what lets concurrent PUT threads scale on the host

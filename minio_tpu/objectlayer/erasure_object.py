@@ -833,8 +833,12 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                     bucket,
                     f"{object_name}/{fi.data_dir}/part.{part.number}",
                     framed_off, framed_len)
-            r = bitrot.StreamingBitrotReader(framed, ssize, algo)
             try:
+                # one native verify pass + one strided payload copy
+                fast = bitrot.verify_extract(framed, ssize, seg_len, algo)
+                if fast is not None:
+                    return fast
+                r = bitrot.StreamingBitrotReader(framed, ssize, algo)
                 return np.frombuffer(r.read_at(0, seg_len), dtype=np.uint8)
             except bitrot.BitrotError as e:
                 raise serrors.FileCorrupt(str(e)) from e
@@ -906,20 +910,29 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 if rebuilt_tail is not None:
                     full[nfull * ssize:] = rebuilt_tail[j]
                 shards[i] = full
-        # concatenate data blocks, trimming per-block padding
+        # concatenate data blocks, trimming per-block padding: one
+        # strided copy per shard over ALL blocks (the mirror of
+        # encode_object_framed's placement loop) — a per-block
+        # np.concatenate costs a second full pass over the data
         out = np.empty(part_size, dtype=np.uint8)
-        pos = 0
-        for b in range(nfull):
-            stripe = np.concatenate(
-                [shards[i][b * ssize:(b + 1) * ssize] for i in range(k)])
-            out[pos:pos + bs] = stripe[:bs]
-            pos += bs
+        if nfull:
+            dview = out[:nfull * bs].reshape(nfull, bs)
+            for i in range(k):
+                lo = i * ssize
+                ln = min(ssize, max(0, bs - lo))
+                if ln:
+                    dview[:, lo:lo + ln] = \
+                        shards[i][:nfull * ssize].reshape(
+                            nfull, ssize)[:, :ln]
         if tail:
             t_ssize = gf8.ceil_frac(tail, k)
-            stripe = np.concatenate(
-                [shards[i][nfull * ssize: nfull * ssize + t_ssize]
-                 for i in range(k)])
-            out[pos:] = stripe[:tail]
+            pos = nfull * bs
+            for i in range(k):
+                lo = i * t_ssize
+                ln = min(t_ssize, max(0, tail - lo))
+                if ln:
+                    out[pos + lo:pos + lo + ln] = shards[i][
+                        nfull * ssize: nfull * ssize + ln]
         return out
 
     # -- DELETE (cmd/erasure-object.go:803-1139) ---------------------------
