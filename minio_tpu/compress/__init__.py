@@ -88,6 +88,21 @@ def compress_block(data: bytes) -> bytes:
     return out.raw[:n]
 
 
+# a snappy op emits at most 64 bytes from at most 1 tag byte, so a
+# valid block can never expand beyond ~64x its compressed size (+ the
+# length header).  A declared length past this bound is a corrupt or
+# MALICIOUS header — allocating it would let a tiny request commit
+# gigabytes (decompression-bomb DoS, found by the fuzz tier).
+_MAX_EXPANSION = 64
+
+
+def _check_declared(want: int, compressed_len: int) -> None:
+    if want > max(1 << 16, compressed_len * _MAX_EXPANSION):
+        raise CompressionError(
+            f"declared length {want} implausible for "
+            f"{compressed_len} compressed bytes")
+
+
 def decompress_block(data: bytes) -> bytes:
     lib = _load_native()
     if lib is None:
@@ -95,6 +110,7 @@ def decompress_block(data: bytes) -> bytes:
     want = lib.mt_snappy_uncompressed_length(data, len(data))
     if want < 0:
         raise CompressionError("corrupt snappy block")
+    _check_declared(int(want), len(data))
     out = ctypes.create_string_buffer(max(int(want), 1))
     n = lib.mt_snappy_uncompress(data, len(data), out, int(want))
     if n < 0:

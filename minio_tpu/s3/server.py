@@ -789,8 +789,11 @@ def _make_handler(srv: S3Server):
             except Exception as e:  # noqa: BLE001 — every error becomes XML
                 self._fail(e, path)
 
-        do_GET = do_PUT = do_HEAD = do_DELETE = do_POST = \
-            lambda self: self._dispatch()
+        # PATCH/OPTIONS etc. flow through the same dispatcher and come
+        # back as the S3 MethodNotAllowed XML error — the stdlib's raw
+        # 501 would leak a non-S3 error shape to clients
+        do_GET = do_PUT = do_HEAD = do_DELETE = do_POST = do_PATCH = \
+            do_OPTIONS = lambda self: self._dispatch()
 
         # -- STS (cmd/sts-handlers.go) -------------------------------------
 

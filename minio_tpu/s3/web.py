@@ -374,7 +374,8 @@ def _handle_rpc(h, srv, payload: bytes) -> None:
         srv._webrpc = WebRPC(srv)
     try:
         req = json.loads(payload or b"{}")
-    except json.JSONDecodeError:
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        # invalid UTF-8 raises UnicodeDecodeError, not JSONDecodeError
         return _reply_json(h, 400, {"jsonrpc": "2.0", "id": None,
                                     "error": {"code": -32700,
                                               "message": "parse error"}})
@@ -383,18 +384,31 @@ def _handle_rpc(h, srv, payload: bytes) -> None:
                                     "error": {"code": -32600,
                                               "message": "invalid request"}})
     rid = req.get("id")
+    method = req.get("method", "")
+    params = req.get("params") or {}
+    if not isinstance(method, str) or not isinstance(params, dict):
+        return _reply_json(h, 400, {"jsonrpc": "2.0", "id": rid,
+                                    "error": {"code": -32600,
+                                              "message":
+                                              "invalid request"}})
     token = ""
     auth = h.headers.get("Authorization", "")
     if auth.startswith("Bearer "):
         token = auth[len("Bearer "):]
     try:
-        result = srv._webrpc.dispatch(req.get("method", ""),
-                                      req.get("params") or {}, token)
+        result = srv._webrpc.dispatch(method, params, token)
         _reply_json(h, 200, {"jsonrpc": "2.0", "id": rid, "result": result})
     except WebError as e:
         _reply_json(h, 401 if isinstance(e, AuthError) else 200,
                     {"jsonrpc": "2.0", "id": rid,
                      "error": {"code": e.code, "message": str(e)}})
+    except Exception as e:  # noqa: BLE001 — malformed params must come
+        # back as a JSON-RPC error, never a 500 (go's web handlers
+        # return ErrInvalidRequest the same way)
+        _reply_json(h, 200, {"jsonrpc": "2.0", "id": rid,
+                             "error": {"code": -32603,
+                                       "message":
+                                       f"internal error: {e}"}})
     except oli.ObjectLayerError as e:
         _reply_json(h, 200, {"jsonrpc": "2.0", "id": rid,
                              "error": {"code": -32000,
