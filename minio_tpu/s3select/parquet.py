@@ -308,9 +308,20 @@ def _decompress(body: bytes, codec: int, want: int) -> bytes:
     raise ParquetError(f"unsupported codec {codec}")
 
 
+# in-memory materialization cap: a 4-byte RLE run (or a forged
+# num_rows) may legally DECLARE billions of values; materializing them
+# from a small Select input is a decompression bomb, not a query
+# (fuzz-tier finding).  64M values per chunk is far beyond any sane
+# Select payload.
+_MAX_VALUES = 1 << 26
+
+
 def _read_rle_hybrid(buf: bytes, pos: int, end: int, bit_width: int,
                      count: int) -> list[int]:
     """RLE/bit-packed hybrid runs until `count` values are produced."""
+    if count > _MAX_VALUES:
+        raise ParquetError(f"value count {count} exceeds the in-memory "
+                           f"reader limit")
     out: list[int] = []
     byte_width = (bit_width + 7) // 8
     while len(out) < count and pos < end:
@@ -327,10 +338,14 @@ def _read_rle_hybrid(buf: bytes, pos: int, end: int, bit_width: int,
             groups = header >> 1
             nbits = groups * 8 * bit_width
             nbytes = (nbits + 7) // 8
-            bits = int.from_bytes(buf[pos:pos + nbytes], "little")
+            avail = max(0, min(nbytes, end - pos))
+            bits = int.from_bytes(buf[pos:pos + avail], "little")
             pos += nbytes
             mask = (1 << bit_width) - 1
-            for i in range(groups * 8):
+            # iterate only over bits the buffer actually holds: a
+            # forged group count must not spin past the data
+            have = (avail * 8) // bit_width if bit_width else 0
+            for i in range(min(groups * 8, have)):
                 if len(out) >= count:
                     break
                 out.append((bits >> (i * bit_width)) & mask)
@@ -382,9 +397,13 @@ class ParquetReader:
             raise ParquetError("not a parquet file (bad magic)")
         try:
             self._parse_footer(data)
-        except (struct.error, IndexError) as e:
+        except ParquetError:
+            raise
+        except (struct.error, IndexError, UnicodeDecodeError,
+                OverflowError, MemoryError, ValueError) as e:
             # truncated/corrupt metadata must surface as a parse error
-            # (400), not an unhandled 500
+            # (400), not an unhandled 500 — including non-UTF8 schema
+            # names and absurd varint sizes (fuzz-tier findings)
             raise ParquetError(f"corrupt parquet metadata: {e}") from e
 
     def _parse_footer(self, data: bytes) -> None:
@@ -471,7 +490,10 @@ class ParquetReader:
     def rows(self) -> Iterator[dict[str, Any]]:
         try:
             yield from self._rows_inner()
-        except (struct.error, IndexError) as e:
+        except ParquetError:
+            raise
+        except (struct.error, IndexError, UnicodeDecodeError,
+                OverflowError, MemoryError, ValueError) as e:
             raise ParquetError(f"corrupt parquet data: {e}") from e
 
     def _rows_inner(self) -> Iterator[dict[str, Any]]:

@@ -224,3 +224,97 @@ def test_fuzz_webrpc(live):
             assert resp.status < 500, resp.status
         finally:
             conn.close()
+
+
+def test_fuzz_parquet_reader():
+    """The own thrift-compact Parquet reader: structured garbage and
+    mutated valid files must raise ParquetError only."""
+    from minio_tpu.s3select.parquet import (BYTE_ARRAY, CT_UTF8, INT64,
+                                            Column, ParquetError,
+                                            parquet_records,
+                                            write_parquet)
+    rng = random.Random(9)
+    valid = write_parquet(
+        [Column("s", BYTE_ARRAY, converted=CT_UTF8),
+         Column("n", INT64)],
+        [{"s": "row%d" % i, "n": i} for i in range(20)])
+    for i in range(300):
+        if i % 3 == 0:
+            blob = _garbage(rng, rng.randrange(0, 200))
+        elif i % 3 == 1:
+            blob = b"PAR1" + _garbage(rng, rng.randrange(8, 120)) + b"PAR1"
+        else:
+            blob = _mutate(rng, valid)
+        try:
+            list(parquet_records(blob))
+        except ParquetError:
+            pass
+
+
+def test_fuzz_bucket_config_xml():
+    from minio_tpu.bucket.lifecycle import Lifecycle, LifecycleError
+    from minio_tpu.bucket.notification import Config as NotifConfig
+    from minio_tpu.bucket.notification import NotificationError
+    from minio_tpu.bucket.tags import TagError, parse_xml
+    rng = random.Random(10)
+    valid_lc = (b"<LifecycleConfiguration><Rule><ID>r</ID>"
+                b"<Status>Enabled</Status><Filter><Prefix>p/</Prefix>"
+                b"</Filter><Expiration><Days>30</Days></Expiration>"
+                b"</Rule></LifecycleConfiguration>")
+    valid_tag = (b"<Tagging><TagSet><Tag><Key>k</Key><Value>v</Value>"
+                 b"</Tag></TagSet></Tagging>")
+    valid_nt = (b"<NotificationConfiguration><QueueConfiguration>"
+                b"<Id>1</Id><Queue>arn:minio:sqs::1:webhook</Queue>"
+                b"<Event>s3:ObjectCreated:*</Event>"
+                b"</QueueConfiguration></NotificationConfiguration>")
+    for i in range(300):
+        blob = _garbage(rng, rng.randrange(0, 150)) if i % 2 \
+            else _mutate(rng, rng.choice([valid_lc, valid_tag, valid_nt]))
+        try:
+            Lifecycle.parse(blob)
+        except LifecycleError:
+            pass
+        try:
+            parse_xml(blob)
+        except TagError:
+            pass
+        try:
+            NotifConfig.parse(blob)
+        except NotificationError:
+            pass
+
+
+def test_fuzz_post_policy_form():
+    from minio_tpu.s3 import postpolicy
+    rng = random.Random(11)
+    boundary = "fuzzbound"
+    valid = (
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="key"\r\n\r\nobj\r\n'
+        f"--{boundary}\r\n"
+        'Content-Disposition: form-data; name="file"; filename="f"\r\n'
+        "\r\ndata\r\n"
+        f"--{boundary}--\r\n").encode()
+    ctype = f"multipart/form-data; boundary={boundary}"
+    for i in range(200):
+        blob = _garbage(rng, rng.randrange(0, 150)) if i % 2 \
+            else _mutate(rng, valid)
+        try:
+            postpolicy.parse_form(blob, ctype)
+        except postpolicy.SigError:
+            pass
+
+
+def test_fuzz_ldap_ber():
+    """The own LDAPv3 BER reader: truncated/garbage TLVs must raise
+    clean errors (IndexError/ValueError wrapped), never hang."""
+    from minio_tpu.iam import ldap
+    rng = random.Random(12)
+    for i in range(300):
+        blob = _garbage(rng, rng.randrange(0, 60))
+        r = ldap.BERReader(blob)
+        try:
+            while not r.eof():
+                r.read_tlv()
+        except (ldap.LDAPError, ValueError, IndexError):
+            pass
