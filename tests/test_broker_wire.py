@@ -70,12 +70,12 @@ def test_amqp_down_raises_without_store():
 
 def test_amqp_store_and_forward_replay(tmp_path):
     """Events queued while the broker is down are replayed — through
-    the full wire path — once it is back."""
-    broker = AMQPStubBroker().start()
-    port = broker.port
-    broker.stop()                                  # broker down
+    the full wire path — once it is back.  The down phase points at
+    port 1 (never listening): connecting to a RECENTLY-CLOSED port can
+    briefly succeed via the kernel backlog, which made a stopped-stub
+    formulation flaky."""
     t = AMQPTarget("arn:minio:sqs::1:amqp",
-                   f"amqp://127.0.0.1:{port}/",
+                   "amqp://127.0.0.1:1/",
                    exchange="ex", store_dir=str(tmp_path / "q"))
     t.send(_record(key="a"))
     t.send(_record(key="b"))
@@ -123,10 +123,9 @@ def test_kafka_broker_list_failover():
 
 
 def test_kafka_store_and_forward_replay(tmp_path):
-    broker = KafkaStubBroker().start()
-    port = broker.port
-    broker.stop()
-    t = KafkaTarget("arn:minio:sqs::1:kafka", [f"127.0.0.1:{port}"],
+    # down phase on port 1, never listening (see the amqp replay test
+    # for why a stopped stub's port is not reliably refused)
+    t = KafkaTarget("arn:minio:sqs::1:kafka", ["127.0.0.1:1"],
                     "minio-events", store_dir=str(tmp_path / "kq"))
     for i in range(3):
         t.send(_record(key=f"k{i}"))
