@@ -15,7 +15,6 @@ import time
 
 from ..iam import policy as iampol
 from ..iam.sys import IAMError, NoSuchPolicy, NoSuchUser
-from ..objectlayer import healing
 from . import metrics
 
 ADMIN_PREFIX = "/minio-tpu/admin/v1"

@@ -18,7 +18,7 @@ import json
 import queue
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from ..bucket.replication import Config as ReplConfig
 

@@ -12,7 +12,7 @@ node's locker.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from .objectlayer.sets import ErasureSets
 from .parallel.dsync import (LocalLocker, NamespaceLock, RemoteLocker,

@@ -23,7 +23,7 @@ import threading
 
 from ..utils import nativelib
 from .snappy_py import (compress_block_py, crc32c_py,
-                        decompress_block_py, uncompressed_length_py)
+                        decompress_block_py)
 
 _NATIVE_SRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native", "snappy.cc")

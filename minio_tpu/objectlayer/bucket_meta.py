@@ -16,7 +16,6 @@ from typing import Any, Optional
 
 from ..storage import errors as serrors
 from ..storage.xl_storage import SYS_DIR
-from .interface import BucketNotFound
 
 
 class BucketMetadataSys:

@@ -13,16 +13,14 @@ import zlib
 from typing import Optional
 
 from ..hashing.siphash import sip_hash_mod
-from ..storage import errors as serrors
 from ..storage.api import StorageAPI
-from ..storage.format import (DISTRIBUTION_ALGO_V3, FormatErasure,
+from ..storage.format import (DISTRIBUTION_ALGO_V3,
                               load_or_init_format)
 from ..storage.xl_storage import XLStorage
 from . import healing
-from .erasure_object import DEFAULT_BLOCK_SIZE, ErasureObjects
+from .erasure_object import ErasureObjects
 from .interface import (BucketInfo, BucketNotFound, ListObjectsInfo,
-                        ObjectInfo, ObjectLayer, ObjectNotFound,
-                        ObjectOptions, PutObjectOptions)
+                        ObjectInfo, ObjectLayer)
 
 DISTRIBUTION_ALGO_CRC = "CRCMOD"
 

@@ -23,7 +23,7 @@ import threading
 import time
 import urllib.request
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 from ..utils.pubsub import PubSub
 

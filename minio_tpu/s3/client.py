@@ -8,7 +8,7 @@ from __future__ import annotations
 import http.client
 import urllib.parse
 import xml.etree.ElementTree as ET
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from .sigv4 import Credentials, presign_url, sign_request
 

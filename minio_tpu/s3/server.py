@@ -14,14 +14,12 @@ import email.utils
 import hashlib
 import os
 import re
-import socket
 import threading
 import urllib.parse
 import uuid
 import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from ..iam import policy as iampol
 from ..objectlayer import interface as ol
 from ..objectlayer.bucket_meta import BucketMetadataSys
 from . import errors as s3err

@@ -12,7 +12,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import threading
 
 from .objectlayer.sets import ErasureSets
 from .s3.server import S3Server

@@ -9,7 +9,6 @@ says it should be (waitForFormatErasure, cmd/prepare-storage.go:348).
 from __future__ import annotations
 
 import json
-import os
 import uuid
 from dataclasses import dataclass, field
 

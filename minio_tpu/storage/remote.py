@@ -9,7 +9,7 @@ exceptions so quorum reduction works unchanged across the node boundary.
 
 from __future__ import annotations
 
-from typing import Iterable, Optional
+from typing import Iterable
 
 from ..parallel.rpc import RPCClient, RPCError, RPCServer
 from . import errors as serrors

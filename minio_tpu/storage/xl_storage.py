@@ -23,12 +23,11 @@ from __future__ import annotations
 import os
 import shutil
 import stat as stat_mod
-import time
 import uuid
 from typing import Iterable
 
 from . import errors
-from .api import DiskInfo, FilesInfo, StorageAPI, VolInfo
+from .api import DiskInfo, StorageAPI, VolInfo
 from .datatypes import FileInfo
 from .xl_meta import XLMeta
 
