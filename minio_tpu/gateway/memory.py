@@ -190,9 +190,16 @@ class MemoryObjects(GatewayUnsupported, ObjectLayer):
                    opts: PutObjectOptions | None = None) -> ObjectInfo:
         opts = opts or PutObjectOptions()
         body = bytes(data) if not isinstance(data, bytes) else data
+        meta = dict(opts.user_defined or {})
+        # content type rides the blob property, not the metadata map
+        # (the same split gateway-azure.go does)
+        ctype = ""
+        for k in list(meta):
+            if k.lower() == "content-type":
+                ctype = meta.pop(k)
         try:
             self.svc.upload_blob(bucket, object_name, body,
-                                 metadata=opts.user_defined)
+                                 metadata=meta, content_type=ctype)
         except KeyError:
             raise BucketNotFound(bucket) from None
         return self.get_object_info(bucket, object_name)

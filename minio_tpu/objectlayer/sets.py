@@ -114,8 +114,23 @@ class ErasureSets(ObjectLayer):
         return self.aggregate_health(self.sets, maintenance)
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        """Delete across every set; if ANY set refuses (not empty), the
+        sets already deleted are RESTORED so the bucket never ends up
+        half-existing (cmd/erasure-sets.go DeleteBucket undo loop —
+        without it a later delete reports BucketNotFound on the sets
+        that went first)."""
+        done = []
         for s in self.sets:
-            s.delete_bucket(bucket, force)
+            try:
+                s.delete_bucket(bucket, force)
+            except Exception:
+                for prev in done:
+                    try:
+                        prev.make_bucket(bucket)
+                    except Exception:  # noqa: BLE001 — best-effort undo
+                        pass
+                raise
+            done.append(s)
 
     # -- object ops: route to the hashed set ------------------------------
 

@@ -181,7 +181,9 @@ class _Handler(BaseHTTPRequestHandler):
         blobs, prefixes = [], set()
         next_marker = ""
         for n in names:
-            if marker and n <= marker:
+            # NextMarker is the name to CONTINUE WITH (inclusive) —
+            # skipping <= marker dropped the boundary blob on resume
+            if marker and n < marker:
                 continue
             if delim:
                 rest = n[len(prefix):]
