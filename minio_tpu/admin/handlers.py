@@ -339,6 +339,20 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
             from ..obs import healthinfo
             return send_json(healthinfo.collect(
                 _drive_paths(srv), perf=q1.get("perf") == "true")) or True
+        if route == "netperf" and h.command == "POST":
+            # madmin NetPerf analog (peerRESTMethodNetInfo): throughput
+            # to every peer over the real authed internode transport
+            from ..parallel.peer import measure_netperf
+            probe = int(q1.get("bytes", str(4 << 20)))
+            clients = getattr(getattr(srv, "peers", None), "clients", [])
+            out = []
+            for c in clients:
+                try:
+                    out.append(measure_netperf(c, probe))
+                except Exception as e:  # noqa: BLE001 — peer down
+                    out.append({"endpoint": c.endpoint,
+                                "error": str(e)})
+            return send_json({"peers": out}) or True
     except (KeyError, json.JSONDecodeError) as e:
         return send_json({"error": f"bad request: {e}"}, 400) or True
     except (NoSuchUser, NoSuchPolicy) as e:

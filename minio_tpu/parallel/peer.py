@@ -50,13 +50,47 @@ def register_peer_service(rpc: RPCServer, srv) -> None:
                 mc.invalidate(bucket)  # no tracker: hard-drop instead
         return True
 
+    # inter-node throughput probes (peerRESTMethodNetInfo role,
+    # cmd/peer-rest-common.go:29-36): the caller times pushing bytes
+    # up and pulling bytes back over the REAL authed RPC transport
+    def netperf_upload(data: bytes = b"") -> int:
+        return len(data)
+
+    def netperf_download(n: int = 0) -> bytes:
+        return b"\xa5" * min(int(n), 8 << 20)
+
     rpc.register("peer", {
         "reload_bucket_meta": reload_bucket_meta,
         "reload_iam": reload_iam,
         "trace_since": trace_since,
         "log_recent": log_recent,
         "mark_change": mark_change,
+        "netperf_upload": netperf_upload,
+        "netperf_download": netperf_download,
     })
+
+
+def measure_netperf(client: RPCClient,
+                    probe_bytes: int = 4 << 20) -> dict:
+    """Measured inter-node throughput to one peer over the real authed
+    RPC transport (madmin NetPerf analog).  Returns MB/s both ways."""
+    import time as _time
+    blob = b"\x5a" * probe_bytes
+    t0 = _time.perf_counter()
+    n = client.call("peer", "netperf_upload", _idempotent=True,
+                    data=blob)
+    up_s = _time.perf_counter() - t0
+    t0 = _time.perf_counter()
+    got = client.call("peer", "netperf_download", _idempotent=True,
+                      n=probe_bytes)
+    down_s = _time.perf_counter() - t0
+    return {
+        "endpoint": client.endpoint,
+        "tx_MBps": round(n / up_s / 1e6, 1) if up_s > 0 else None,
+        "rx_MBps": round(len(got) / down_s / 1e6, 1)
+        if down_s > 0 else None,
+        "probe_bytes": probe_bytes,
+    }
 
 
 class PeerNotifier:

@@ -220,3 +220,35 @@ def test_smart_info_sysfs():
         assert info["io"]["reads_completed"] >= 0
     out = healthinfo.collect(drive_paths=["/tmp"])
     assert "smart" in out and out["smart"][0]["path"] == "/tmp"
+
+
+def test_netperf_probe_over_rpc():
+    """Inter-node throughput probe rides the real authed RPC transport
+    (peerRESTMethodNetInfo role)."""
+    from minio_tpu.parallel.peer import measure_netperf, register_peer_service
+    from minio_tpu.parallel.rpc import RPCClient, RPCServer
+
+    class _Hub:
+        def since(self, seq, limit):
+            return seq, []
+
+    class _Srv:
+        bucket_meta = type("B", (), {"invalidate": staticmethod(
+            lambda b: None)})()
+        iam = type("I", (), {"load": staticmethod(lambda: None)})()
+        trace_hub = _Hub()
+        logger = type("L", (), {"recent": staticmethod(lambda n: [])})()
+        tracker = None
+        layer = type("Y", (), {})()
+
+    srv = RPCServer(secret="np-secret")
+    register_peer_service(srv, _Srv())
+    srv.start()
+    try:
+        client = RPCClient(srv.endpoint, secret="np-secret")
+        res = measure_netperf(client, probe_bytes=1 << 20)
+        assert res["tx_MBps"] and res["tx_MBps"] > 0
+        assert res["rx_MBps"] and res["rx_MBps"] > 0
+        assert res["probe_bytes"] == 1 << 20
+    finally:
+        srv.stop()
