@@ -313,13 +313,27 @@ class S3Server:
         self.iam.on_change = notifier.iam_changed
         self.trace_hub.enable_ring()
 
+    def attach_background(self, *services) -> None:
+        """Register background loops (crawler, healer) whose lifecycle
+        follows the server's: started on start(), stopped on stop()
+        (initDataCrawler / initBackgroundHealing, cmd/server-main.go)."""
+        self._background = getattr(self, "_background", [])
+        self._background.extend(services)
+
     def start(self) -> None:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        for svc in getattr(self, "_background", []):
+            svc.start()
 
     def stop(self) -> None:
         self._stopping = True          # health probes report offline
+        for svc in getattr(self, "_background", []):
+            try:
+                svc.stop()
+            except Exception:  # noqa: BLE001 — shutdown must proceed
+                pass
         self.httpd.shutdown()
         self.httpd.server_close()
         self.events.close()

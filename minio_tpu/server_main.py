@@ -62,6 +62,28 @@ def build_server(dirs: list[str], address: str = "127.0.0.1:9000",
     srv = S3Server(layer, access_key=access_key, secret_key=secret_key,
                    region=region, host=host or "0.0.0.0", port=int(port))
     srv.iam.load()
+    # background services whose lifecycle follows the server's
+    # (cmd/server-main.go initDataCrawler + initBackgroundHealing):
+    # the data crawler feeds usage metrics/ILM, the heal sweep repairs
+    # drift; both intervals are env-tunable and the crawler shares the
+    # server's update tracker so listings invalidate on writes
+    from .background.crawler import Crawler
+    from .background.heal import BackgroundHealer
+    from .background.tracker import DataUpdateTracker
+    from .objectlayer.tiering import transition_fn
+    tracker = DataUpdateTracker()
+    srv.attach_tracker(tracker)
+    crawler = Crawler(
+        layer, bucket_meta=srv.bucket_meta,
+        interval_s=float(os.environ.get("MT_CRAWL_INTERVAL_S", "60")),
+        transition_fn=transition_fn(srv.transition), tracker=tracker)
+    healer = BackgroundHealer(
+        layer,
+        interval_s=float(os.environ.get("MT_HEAL_INTERVAL_S", "3600")),
+        deep_every=int(os.environ.get("MT_HEAL_DEEP_EVERY", "8")))
+    srv.healer = healer            # mt_heal_* metrics + admin heal state
+    srv.crawler = crawler
+    srv.attach_background(crawler, healer)
     return srv
 
 

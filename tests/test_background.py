@@ -283,3 +283,41 @@ def test_admin_background_endpoints(tmp_path):
         assert doc["mrf"]["mrfQueued"] == 0
     finally:
         srv.stop()
+
+
+def test_build_server_wires_background_services(tmp_path):
+    """A served deployment must run the crawler + heal sweep
+    (cmd/server-main.go initDataCrawler/initBackgroundHealing) — and
+    their state must surface through metrics and the admin API."""
+    import re
+    import urllib.request
+
+    from minio_tpu.server_main import build_server
+
+    dirs = [str(tmp_path / f"d{i}") for i in range(4)]
+    import os as _os
+    _os.environ["MT_CRAWL_INTERVAL_S"] = "3600"   # no mid-test cycles
+    try:
+        srv = build_server(dirs, address="127.0.0.1:0")
+    finally:
+        _os.environ.pop("MT_CRAWL_INTERVAL_S", None)
+    assert srv.crawler is not None and srv.healer is not None
+    assert srv.tracker is not None
+    srv.start()
+    try:
+        from minio_tpu.s3.client import S3Client
+        c = S3Client(srv.endpoint, "minioadmin", "minioadmin")
+        c.make_bucket("bgbkt")
+        c.put_object("bgbkt", "o", b"x" * 2048)
+        srv.crawler.run_cycle()               # deterministic scan
+        srv.healer.sweep()
+        with urllib.request.urlopen(
+                f"{srv.endpoint}/minio-tpu/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert re.search(
+            r'mt_bucket_usage_object_total\{bucket="bgbkt"\} 1', text)
+        assert "mt_heal_objects_scanned_total" in text
+        m = re.search(r"mt_heal_objects_scanned_total (\d+)", text)
+        assert m and int(m.group(1)) >= 1
+    finally:
+        srv.stop()
