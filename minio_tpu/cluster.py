@@ -208,5 +208,30 @@ def run_node(self_id: str, specs: list[NodeSpec], secret: str,
     srv.attach_peers(PeerNotifier(
         [RPCClient(s.endpoint, secret) for s in specs
          if s.node_id != self_id]))
+    # every node tracks updates (peer mark_change lands here); the
+    # LEADER runs the global crawler + heal sweep — this build's walks
+    # cover the whole layer, so per-node copies would duplicate scans
+    # (the reference crawls per-local-drive instead,
+    # cmd/server-main.go:499)
+    from .background.tracker import DataUpdateTracker
+    srv.attach_tracker(DataUpdateTracker())
+    if specs[0].node_id == self_id:
+        import os as _os
+
+        from .background.crawler import Crawler
+        from .background.heal import BackgroundHealer
+        from .objectlayer.tiering import transition_fn
+        srv.crawler = Crawler(
+            layer, bucket_meta=srv.bucket_meta,
+            interval_s=float(_os.environ.get("MT_CRAWL_INTERVAL_S",
+                                             "60")),
+            transition_fn=transition_fn(srv.transition),
+            tracker=srv.tracker)
+        srv.healer = BackgroundHealer(
+            layer,
+            interval_s=float(_os.environ.get("MT_HEAL_INTERVAL_S",
+                                             "3600")),
+            deep_every=int(_os.environ.get("MT_HEAL_DEEP_EVERY", "8")))
+        srv.attach_background(srv.crawler, srv.healer)
     srv.start()
     return node, srv
