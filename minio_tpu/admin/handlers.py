@@ -343,7 +343,12 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
             # madmin NetPerf analog (peerRESTMethodNetInfo): throughput
             # to every peer over the real authed internode transport
             from ..parallel.peer import measure_netperf
-            probe = int(q1.get("bytes", str(4 << 20)))
+            try:
+                probe = int(q1.get("bytes", str(4 << 20)))
+            except ValueError:
+                return send_json({"error": "bytes must be an integer"},
+                                 400) or True
+            probe = max(1, min(probe, 8 << 20))   # cap the probe blob
             clients = getattr(getattr(srv, "peers", None), "clients", [])
             out = []
             for c in clients:

@@ -328,8 +328,7 @@ class AzureObjects(GatewayUnsupported, ObjectLayer):
     def put_object(self, bucket: str, object_name: str, data,
                    opts: PutObjectOptions | None = None) -> ObjectInfo:
         opts = opts or PutObjectOptions()
-        body = bytes(data) if not isinstance(data, (bytes, bytearray)) \
-            else bytes(data)
+        body = data if isinstance(data, bytes) else bytes(data)
         meta, ctype = _split_meta(opts.user_defined)
         try:
             self.client.put_blob(bucket, object_name, body,

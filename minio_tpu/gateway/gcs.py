@@ -404,7 +404,9 @@ class GCSObjects(GatewayUnsupported, ObjectLayer):
         out = []
         for item in res.get("items", []):
             leaf = item["name"].rsplit("/", 1)[1]
-            if leaf == "meta.json":
+            if not leaf.isdigit():
+                # meta.json and compose-<round>-<i> intermediates from
+                # a partially-failed staged compose share the prefix
                 continue
             out.append((int(leaf),
                         (item.get("md5Hash") or "").strip('"'),

@@ -402,18 +402,19 @@ def _handle_rpc(h, srv, payload: bytes) -> None:
         _reply_json(h, 401 if isinstance(e, AuthError) else 200,
                     {"jsonrpc": "2.0", "id": rid,
                      "error": {"code": e.code, "message": str(e)}})
-    except Exception as e:  # noqa: BLE001 — malformed params must come
-        # back as a JSON-RPC error, never a 500 (go's web handlers
-        # return ErrInvalidRequest the same way)
-        _reply_json(h, 200, {"jsonrpc": "2.0", "id": rid,
-                             "error": {"code": -32603,
-                                       "message":
-                                       f"internal error: {e}"}})
     except oli.ObjectLayerError as e:
         _reply_json(h, 200, {"jsonrpc": "2.0", "id": rid,
                              "error": {"code": -32000,
                                        "message": f"{type(e).__name__}: "
                                                   f"{e}"}})
+    except Exception as e:  # noqa: BLE001 — malformed params must come
+        # back as a JSON-RPC error, never a 500 (go's web handlers
+        # return ErrInvalidRequest the same way).  LAST: the narrower
+        # handlers above must keep their error codes.
+        _reply_json(h, 200, {"jsonrpc": "2.0", "id": rid,
+                             "error": {"code": -32603,
+                                       "message":
+                                       f"internal error: {e}"}})
 
 
 def _token_of(h, query: dict) -> str:
