@@ -13,10 +13,11 @@ from email.utils import parsedate_to_datetime
 from typing import Optional
 
 from ..objectlayer.interface import (BucketExists, BucketInfo,
-                                     BucketNotFound, InvalidUploadID,
-                                     ListObjectsInfo, ObjectInfo,
-                                     ObjectLayer, ObjectNotFound,
-                                     ObjectOptions, PutObjectOptions)
+                                     BucketNotEmpty, BucketNotFound,
+                                     InvalidUploadID, ListObjectsInfo,
+                                     ObjectInfo, ObjectLayer,
+                                     ObjectNotFound, ObjectOptions,
+                                     PutObjectOptions)
 from ..objectlayer.multipart import MultipartInfo, PartInfo
 from ..s3.client import S3Client, S3ClientError
 from . import Gateway, GatewayUnsupported, register
@@ -27,6 +28,7 @@ _ERR_MAP = {
     "NoSuchVersion": ObjectNotFound,
     "BucketAlreadyOwnedByYou": BucketExists,
     "BucketAlreadyExists": BucketExists,
+    "BucketNotEmpty": BucketNotEmpty,
     "NoSuchUpload": InvalidUploadID,
 }
 

@@ -68,11 +68,20 @@ def _make_layer(kind, tmp):
         stub = GCSStubServer().start()
         return GCSObjects(GCSClient(stub.endpoint, TOKEN,
                                     PROJECT)), stub.stop
+    if kind == "s3-gw":
+        from minio_tpu.gateway.s3 import S3GatewayLayer
+        from minio_tpu.s3.client import S3Client
+        from minio_tpu.s3.server import S3Server
+        upstream = S3Server(_erasure(tmp, 4, 2), access_key="upk",
+                            secret_key="ups")
+        upstream.start()
+        return S3GatewayLayer(S3Client(upstream.endpoint, "upk",
+                                       "ups")), upstream.stop
     raise AssertionError(kind)
 
 
 KINDS = ["fs", "erasure4", "erasure16", "sets32", "memory-gw",
-         "azure-gw", "gcs-gw"]
+         "azure-gw", "gcs-gw", "s3-gw"]
 
 
 @pytest.fixture(params=KINDS)
