@@ -68,6 +68,22 @@ def _make_layer(kind, tmp):
         stub = GCSStubServer().start()
         return GCSObjects(GCSClient(stub.endpoint, TOKEN,
                                     PROJECT)), stub.stop
+    if kind == "pools":
+        from minio_tpu.objectlayer.pools import ErasureServerPools
+        from minio_tpu.objectlayer.sets import ErasureSets
+        from minio_tpu.storage.xl_storage import XLStorage
+
+        def mk_sets(prefix, n):
+            disks = []
+            for i in range(n):
+                d = tmp / f"{prefix}{i}"
+                d.mkdir()
+                disks.append(XLStorage(str(d)))
+            return ErasureSets(disks, set_count=1, set_drive_count=n,
+                               parity=2, block_size=128 * 1024,
+                               backend="numpy")
+        return ErasureServerPools([mk_sets("p0-", 4),
+                                   mk_sets("p1-", 4)]), None
     if kind == "s3-gw":
         from minio_tpu.gateway.s3 import S3GatewayLayer
         from minio_tpu.s3.client import S3Client
@@ -80,7 +96,7 @@ def _make_layer(kind, tmp):
     raise AssertionError(kind)
 
 
-KINDS = ["fs", "erasure4", "erasure16", "sets32", "memory-gw",
+KINDS = ["fs", "erasure4", "erasure16", "sets32", "pools", "memory-gw",
          "azure-gw", "gcs-gw", "s3-gw"]
 
 
