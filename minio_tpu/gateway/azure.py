@@ -29,6 +29,7 @@ import email.utils
 import hashlib
 import hmac
 import http.client
+import json
 import uuid
 import xml.etree.ElementTree as ET
 from urllib.parse import quote, urlsplit
@@ -105,7 +106,11 @@ class AzureBlobClient:
         headers = dict(headers or {})
         headers["x-ms-date"] = email.utils.formatdate(usegmt=True)
         headers["x-ms-version"] = _API_VERSION
-        sts = self._string_to_sign(verb, path, query, headers, len(body))
+        # Azure signs the percent-encoded URI path exactly as it goes on
+        # the wire (query values are signed decoded); blob names with
+        # spaces/unicode/'#' would 403 if we signed the raw path.
+        epath = quote(path)
+        sts = self._string_to_sign(verb, epath, query, headers, len(body))
         sig = base64.b64encode(
             hmac.new(self.key, sts.encode(), hashlib.sha256).digest()
         ).decode()
@@ -114,7 +119,7 @@ class AzureBlobClient:
             headers["Content-Length"] = str(len(body))
         qs = "&".join(f"{quote(k, safe='')}={quote(str(v), safe='')}"
                       for k, v in query.items())
-        url = self.base_path + quote(path) + (f"?{qs}" if qs else "")
+        url = self.base_path + epath + (f"?{qs}" if qs else "")
         cls = http.client.HTTPSConnection if self.scheme == "https" \
             else http.client.HTTPConnection
         conn = cls(self.host, timeout=self.timeout)
@@ -249,15 +254,21 @@ class AzureBlobClient:
 
     def put_block_list(self, container: str, blob: str,
                        block_ids: list[str],
-                       metadata: dict | None = None) -> str:
+                       metadata: dict | None = None,
+                       content_type: str = "") -> str:
         items = "".join(
             f"<Uncommitted>{base64.b64encode(b.encode()).decode()}"
             "</Uncommitted>" for b in block_ids)
         xml = ('<?xml version="1.0" encoding="utf-8"?>'
                f"<BlockList>{items}</BlockList>").encode()
+        hdrs = self._meta_headers(metadata)
+        if content_type:
+            # Content-Type on a Put Block List describes the XML body;
+            # the committed blob's type rides x-ms-blob-content-type.
+            hdrs["x-ms-blob-content-type"] = content_type
         _, rh, _ = self.request(
             "PUT", f"/{container}/{blob}", {"comp": "blocklist"},
-            headers=self._meta_headers(metadata), body=xml)
+            headers=hdrs, body=xml)
         return rh.get("ETag", "").strip('"')
 
     def get_block_list(self, container: str, blob: str) -> list[dict]:
@@ -397,21 +408,55 @@ class AzureObjects(GatewayUnsupported, ObjectLayer):
                        user_defined={
                            "x-amz-meta-" + k.lower().replace("_", "-"):
                            v for k, v in b["metadata"].items()})
-            for b in res["blobs"]]
-        out.prefixes = sorted(res["prefixes"])
+            for b in res["blobs"]
+            if not b["name"].startswith(".minio-tpu.sys/")]
+        out.prefixes = sorted(p for p in res["prefixes"]
+                              if not p.startswith(".minio-tpu.sys/"))
         out.is_truncated = bool(res["next_marker"])
         out.next_marker = res["next_marker"]
         return out
 
     # multipart -> staged blocks
+    #
+    # Per-upload metadata is persisted as a temp blob in the container
+    # (gateway-azure.go azureMultipartMetadata pattern) so a complete
+    # that runs after a restart or on another node still applies the
+    # metadata and content type.
+    @staticmethod
+    def _mp_meta_blob(upload_id: str) -> str:
+        return f".minio-tpu.sys/multipart/{upload_id}/azure.json"
+
     def new_multipart_upload(self, bucket: str, object_name: str,
                              opts: PutObjectOptions | None = None) -> str:
         self.get_bucket_info(bucket)
         uid = uuid.uuid4().hex
-        meta, _ = _split_meta((opts or PutObjectOptions()).user_defined)
-        self._mp_meta = getattr(self, "_mp_meta", {})
-        self._mp_meta[uid] = meta
+        meta, ctype = _split_meta((opts or PutObjectOptions()).user_defined)
+        self.client.put_blob(
+            bucket, self._mp_meta_blob(uid),
+            json.dumps({"meta": meta, "ctype": ctype,
+                        "object": object_name}).encode())
         return uid
+
+    def _mp_meta_load(self, bucket: str, upload_id: str
+                      ) -> tuple[dict, str]:
+        try:
+            _, data = self.client.get_blob(
+                bucket, self._mp_meta_blob(upload_id))
+        except AzureError as e:
+            if e.status == 404:
+                # stash gone = upload never started or was aborted; the
+                # reference errors when azureMultipartMetadata is
+                # missing rather than committing metadata-stripped
+                raise ObjectNotFound(f"upload {upload_id}") from None
+            raise     # transient failures must NOT strip metadata
+        doc = json.loads(data)
+        return dict(doc.get("meta") or {}), doc.get("ctype") or ""
+
+    def _mp_meta_drop(self, bucket: str, upload_id: str) -> None:
+        try:
+            self.client.delete_blob(bucket, self._mp_meta_blob(upload_id))
+        except AzureError:
+            pass
 
     def put_object_part(self, bucket: str, object_name: str,
                         upload_id: str, part_number: int, data) -> str:
@@ -452,8 +497,8 @@ class AzureObjects(GatewayUnsupported, ObjectLayer):
                                upload_id: str) -> None:
         # Azure has no abort: uncommitted blocks expire after 7 days
         # (gateway-azure.go AbortMultipartUpload is a no-op for the
-        # same reason).  Drop our metadata stash only.
-        getattr(self, "_mp_meta", {}).pop(upload_id, None)
+        # same reason).  Drop the persisted metadata blob only.
+        self._mp_meta_drop(bucket, upload_id)
 
     def list_multipart_uploads(self, bucket: str, prefix: str = ""):
         return []          # uncommitted block lists are not enumerable
@@ -470,14 +515,16 @@ class AzureObjects(GatewayUnsupported, ObjectLayer):
         if missing:
             raise InvalidPart(f"upload {upload_id}: part never "
                               f"uploaded: {missing[0]}")
-        meta = getattr(self, "_mp_meta", {}).pop(upload_id, {})
+        meta, ctype = self._mp_meta_load(bucket, upload_id)
         try:
             self.client.put_block_list(bucket, object_name, ids,
-                                       metadata=meta)
+                                       metadata=meta,
+                                       content_type=ctype)
         except AzureError as e:
             if e.code == "InvalidBlockList":
                 raise InvalidPart(f"upload {upload_id}") from None
             raise
+        self._mp_meta_drop(bucket, upload_id)
         return self.get_object_info(bucket, object_name)
 
 

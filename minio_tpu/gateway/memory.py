@@ -122,14 +122,16 @@ class FakeBlobService:
 
     def commit_block_list(self, container: str, name: str, upload: str,
                           block_ids: list[str],
-                          metadata: dict | None = None) -> str:
+                          metadata: dict | None = None,
+                          content_type: str = "") -> str:
         with self._mu:
             staged = self._blocks.pop((container, name, upload), {})
             try:
                 body = b"".join(staged[b] for b in block_ids)
             except KeyError:
                 raise KeyError("InvalidBlockList") from None
-            return self.upload_blob(container, name, body, metadata)
+            return self.upload_blob(container, name, body, metadata,
+                                    content_type)
 
     def abort_blocks(self, container: str, name: str,
                      upload: str) -> None:

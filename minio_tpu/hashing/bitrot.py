@@ -270,9 +270,17 @@ def verify_extract(framed, shard_size: int, length: int,
     head = arr[:nfull * F].reshape(nfull, F)[:, 32:]   # strided view
     if nfull * shard_size >= length:
         return head.reshape(-1)[:length].copy()
+    # Caller-declared length comes from xl.meta — never trust it past
+    # what the digest-verified frame actually holds, or the tail copy
+    # below raises a broadcast ValueError that escapes the caller's
+    # BitrotError handling and surfaces as a 500 instead of FileCorrupt.
+    tail = arr[nfull * F + 32:]                        # short last block
+    if nfull * shard_size + tail.size < length:
+        raise BitrotError(
+            f"truncated frame: {nfull * shard_size + tail.size} payload "
+            f"bytes present, {length} declared")
     out = np.empty(length, dtype=np.uint8)
     out[:nfull * shard_size] = head.reshape(-1)
-    tail = arr[nfull * F + 32:]                        # short last block
     out[nfull * shard_size:] = tail[:length - nfull * shard_size]
     return out
 

@@ -123,16 +123,25 @@ def _deserialize(data: bytes) -> Metacache:
                      gen=doc.get("gen", -1))
 
 
-def managers_of(layer) -> list["MetacacheManager"]:
-    """Every MetacacheManager under an object-layer topology (a pools
-    layer nests sets which nest single-set layers; invalidation and
-    tracker wiring must reach them all)."""
+def leaf_layers_of(layer) -> list:
+    """Every leaf object layer under a topology (a pools layer nests
+    sets which nest single-set layers) — the one traversal shared by
+    cache invalidation, tracker wiring, and peer eviction."""
     if hasattr(layer, "pools"):
-        return [m for p in layer.pools for m in managers_of(p)]
+        return [x for p in layer.pools for x in leaf_layers_of(p)]
     if hasattr(layer, "sets"):
-        return [m for s in layer.sets for m in managers_of(s)]
-    mc = getattr(layer, "metacache", None)
-    return [mc] if mc is not None else []
+        return [x for s in layer.sets for x in leaf_layers_of(s)]
+    return [layer]
+
+
+def managers_of(layer) -> list["MetacacheManager"]:
+    """Every MetacacheManager under an object-layer topology."""
+    out = []
+    for leaf in leaf_layers_of(layer):
+        mc = getattr(leaf, "metacache", None)
+        if mc is not None:
+            out.append(mc)
+    return out
 
 
 class MetacacheManager:

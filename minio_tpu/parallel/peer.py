@@ -22,8 +22,17 @@ def register_peer_service(rpc: RPCServer, srv) -> None:
     (peer-rest-server.go handler table).  ``srv`` is the node's
     S3Server."""
 
+    def _evict_bucket_seen(layer, bucket: str) -> None:
+        """Drop a bucket from every nested layer's existence cache so a
+        peer's delete_bucket is visible here immediately rather than
+        after the 3 s TTL."""
+        from ..objectlayer.metacache import leaf_layers_of
+        for leaf in leaf_layers_of(layer):
+            getattr(leaf, "_buckets_seen", {}).pop(bucket, None)
+
     def reload_bucket_meta(bucket: str) -> bool:
         srv.bucket_meta.invalidate(bucket)
+        _evict_bucket_seen(srv.layer, bucket)
         return True
 
     def reload_iam() -> bool:
@@ -48,6 +57,9 @@ def register_peer_service(rpc: RPCServer, srv) -> None:
             from ..objectlayer.metacache import managers_of
             for mc in managers_of(srv.layer):
                 mc.invalidate(bucket)  # no tracker: hard-drop instead
+        if not object_name:
+            # bucket-level change (create/delete): existence cache too
+            _evict_bucket_seen(srv.layer, bucket)
         return True
 
     # inter-node throughput probes (peerRESTMethodNetInfo role,

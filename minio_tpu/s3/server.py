@@ -639,9 +639,10 @@ def _make_handler(srv: S3Server):
             self._rx_bytes = 0
             # request-pool admission (cmd/handler-api.go:29 maxClients):
             # S3 traffic only — admin/metrics/health stay reachable when
-            # the data plane is saturated
+            # the data plane is saturated (both reserved namespaces:
+            # /minio/health/* is the reference-compatible probe alias)
             throttled = not urllib.parse.urlsplit(self.path).path \
-                .startswith("/minio-tpu/")
+                .startswith(("/minio-tpu/", "/minio/"))
             # capture the pool object: admin SetConfigKV can swap
             # srv._req_sem mid-flight, and acquire/release must pair on
             # the same semaphore
@@ -675,15 +676,20 @@ def _make_handler(srv: S3Server):
             api_name = _api_name(self.command, bucket, key, q1)
             # metrics-v2 per-API families (cmd/metrics-v2.go
             # getS3RequestsTotalMD / getS3TTFBMetric): request count by
-            # api name and the TTFB distribution
-            from ..admin.metrics import GLOBAL as _mtr
-            _mtr.inc("mt_s3_requests_api_total", {"api": api_name})
-            if self._resp_status >= 400:
-                _mtr.inc("mt_s3_requests_errors_total",
-                         {"api": api_name,
-                          "status": str(self._resp_status)})
-            ttfb = (self._ttfb_ns or dur) / 1e9
-            _mtr.observe("mt_s3_ttfb_seconds", {"api": api_name}, ttfb)
+            # api name and the TTFB distribution.  S3 APIs only — the
+            # reference scopes these to the S3 router, so health-probe
+            # polling and metrics scrapes (reserved /minio-tpu/ and
+            # /minio/ namespaces) must not dominate the per-API
+            # families; they still ride trace/audit below.
+            if not path.startswith(("/minio-tpu/", "/minio/")):
+                from ..admin.metrics import GLOBAL as _mtr
+                _mtr.inc("mt_s3_requests_api_total", {"api": api_name})
+                if self._resp_status >= 400:
+                    _mtr.inc("mt_s3_requests_errors_total",
+                             {"api": api_name,
+                              "status": str(self._resp_status)})
+                ttfb = (self._ttfb_ns or dur) / 1e9
+                _mtr.observe("mt_s3_ttfb_seconds", {"api": api_name}, ttfb)
             if srv.trace_hub.num_subscribers > 0 or \
                     srv.trace_hub.ring_active:
                 srv.trace_hub.publish(_trace.make_trace(

@@ -50,17 +50,17 @@ class _Handler(BaseHTTPRequestHandler):
         if acct != ACCOUNT:
             return False
         u = urlsplit(self.path)
-        # path arrives as /<account>/<resource...>
-        path = unquote(u.path)
-        prefix = f"/{ACCOUNT}"
-        res_path = path[len(prefix):] if path.startswith(prefix) else path
+        # Azure signs the percent-encoded URI path exactly as it is on
+        # the wire (query values are signed decoded) — recompute from
+        # the raw request line, NOT an unquoted copy, so a client that
+        # signs the decoded path fails here the way real Azure would.
         q = {k: ",".join(v)
              for k, v in parse_qs(u.query, keep_blank_values=True).items()}
         std = {k.lower(): v for k, v in self.headers.items()}
         ms = sorted((k.lower(), v) for k, v in self.headers.items()
                     if k.lower().startswith("x-ms-"))
         canon_headers = "".join(f"{k}:{v}\n" for k, v in ms)
-        res = f"/{ACCOUNT}{prefix}{res_path}"
+        res = f"/{ACCOUNT}{u.path}"
         for k in sorted(q):
             res += f"\n{k.lower()}:{q[k]}"
         sts = "\n".join([
@@ -248,7 +248,9 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 etag = svc.commit_block_list(
                     container, blob, upload, decoded,
-                    metadata=self._meta_from_headers())
+                    metadata=self._meta_from_headers(),
+                    content_type=self.headers.get(
+                        "x-ms-blob-content-type", ""))
             except KeyError:
                 return self._error(400, "InvalidBlockList")
             return self._reply(201, headers={"ETag": f'"{etag}"'})

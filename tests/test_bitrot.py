@@ -155,3 +155,19 @@ def test_magic_key_value():
     # cmd/bitrot.go:31 — first bytes of the magic key
     assert hh.MAGIC_KEY[:4] == b"\x4b\xe7\x34\xfa"
     assert len(hh.MAGIC_KEY) == 32
+
+
+def test_verify_extract_overdeclared_length_is_bitrot_error():
+    # xl.meta claiming more payload than the digest-valid frame holds
+    # must surface as BitrotError (-> FileCorrupt upstream), never a
+    # numpy broadcast ValueError that becomes a 500 (ADVICE r4).
+    import numpy as np
+    data = b"y" * 1000
+    framed = np.frombuffer(bitrot.streaming_encode(data, 512),
+                           dtype=np.uint8)
+    ok = bitrot.verify_extract(framed, 512, 1000)
+    if ok is None:
+        pytest.skip("native hh256 framed verify unavailable")
+    assert bytes(ok) == data
+    with pytest.raises(bitrot.BitrotError):
+        bitrot.verify_extract(framed, 512, 1500)

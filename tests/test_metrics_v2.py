@@ -143,3 +143,27 @@ def test_node_rpc_family(tmp_path):
         assert re.search(r"mt_node_rpc_rx_bytes_total [0-9.e+]+", text)
     finally:
         srv.stop()
+
+
+def test_reserved_paths_do_not_count_as_s3_apis(served):
+    """Health probes and metrics scrapes must not pollute the per-API
+    S3 request families (reference scopes them to the S3 router);
+    ADVICE r4: k8s liveness polling would otherwise dominate."""
+    import http.client
+    srv, layer = served
+    host, port = srv.endpoint.replace("http://", "").split(":")
+    for probe in ("/minio-tpu/health/live", "/minio/health/live",
+                  "/minio-tpu/metrics"):
+        conn = http.client.HTTPConnection(host, int(port), timeout=10)
+        conn.request("GET", probe)
+        conn.getresponse().read()
+        conn.close()
+    # one real S3 call so the family exists at all
+    cl = S3Client(srv.endpoint, "mk", "ms")
+    cl.make_bucket("mreserved")
+    text = _scrape_until(srv, "MakeBucket")
+    # every labeled series of the family belongs to a real S3 api
+    for m in re.finditer(
+            r'^mt_s3_requests_api_total\{api="([^"]+)"\}', text, re.M):
+        assert "health" not in m.group(1).lower()
+        assert "metrics" not in m.group(1).lower()
