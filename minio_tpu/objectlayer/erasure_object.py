@@ -464,7 +464,15 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         ss = fi.erasure.shard_size()
         if m > 0:
             codec = self._codec_for(m)
-            if (codec.backend != "tpu"
+            if (codec.backend == "mesh"
+                    and self.bitrot_algo == bitrot.HIGHWAYHASH256S):
+                # multi-chip fused pipeline: parity via ICI psum XOR
+                # fan-in, per-shard digests all_gathered — one sharded
+                # dispatch per block batch (SURVEY §2.3 contract)
+                from ..ops import rs_mesh
+                return list(rs_mesh.encode_object_framed_fused(
+                    codec.data_blocks, m, codec.block_size, data))
+            if (codec.backend == "numpy"
                     and self.bitrot_algo == bitrot.HIGHWAYHASH256S):
                 from ..ops import gf8_native
                 if gf8_native.available():
@@ -478,7 +486,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         # parity + per-block HighwayHash from one pipeline (ops/hh_kernels)
         return bitrot.streaming_encode_batch(
             shards, ss, self.bitrot_algo,
-            use_device=(m > 0 and codec.backend == "tpu"))
+            use_device=(m > 0 and codec.is_device))
 
     def _commit_put(self, bucket, object_name, fi, framed, inline,
                     shuffled) -> ObjectInfo:
@@ -888,8 +896,8 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 surv = np.stack([shards[i][: nfull * ssize]
                                  .reshape(nfull, ssize) for i in present],
                                 axis=1)  # (nfull, k, ssize)
-                if codec.backend == "tpu":
-                    rebuilt_full = rs_kernels.apply_matrix(rows, surv)
+                if codec.is_device:
+                    rebuilt_full = codec.apply_matrix(rows, surv)
                 else:
                     rebuilt_full = np.stack(
                         [gf8.gf_matmul(rows, surv[b]) for b in range(nfull)])
@@ -899,8 +907,8 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 surv_t = np.stack(
                     [shards[i][nfull * ssize: nfull * ssize + t_ssize]
                      for i in present])  # (k, t_ssize)
-                if codec.backend == "tpu":
-                    rebuilt_tail = rs_kernels.apply_matrix(rows, surv_t)
+                if codec.is_device:
+                    rebuilt_tail = codec.apply_matrix(rows, surv_t)
                 else:
                     rebuilt_tail = gf8.gf_matmul(rows, surv_t)
             for j, i in enumerate(missing_data):

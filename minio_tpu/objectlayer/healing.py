@@ -239,8 +239,8 @@ def _reconstruct_shards(er: ErasureObjects, fi: FileInfo, present: list[int],
     if nfull:
         surv = np.stack([s[: nfull * ssize].reshape(nfull, ssize)
                          for s in surviving], axis=1)
-        if codec.backend == "tpu":
-            reb = rs_kernels.apply_matrix(rows, surv)
+        if codec.is_device:
+            reb = codec.apply_matrix(rows, surv)
         else:
             reb = np.stack([gf8.gf_matmul(rows, surv[b])
                             for b in range(nfull)])
@@ -250,8 +250,8 @@ def _reconstruct_shards(er: ErasureObjects, fi: FileInfo, present: list[int],
         t_ssize = gf8.ceil_frac(tail, k)
         surv_t = np.stack([s[nfull * ssize: nfull * ssize + t_ssize]
                            for s in surviving])
-        reb_t = gf8.gf_matmul(rows, surv_t) if codec.backend != "tpu" \
-            else rs_kernels.apply_matrix(rows, surv_t)
+        reb_t = codec.apply_matrix(rows, surv_t) if codec.is_device \
+            else gf8.gf_matmul(rows, surv_t)
         for j in range(len(wanted)):
             outs[j][nfull * ssize:] = reb_t[j]
     return outs

@@ -5,7 +5,9 @@ DecodeDataBlocks/DecodeDataAndParityBlocks/ShardSize/ShardFileSize/
 ShardFileOffset) with a pluggable backend:
 
   * ``numpy`` — pure-host reference path (always available, conformance oracle)
-  * ``tpu``   — batched bitplane MXU matmuls (rs_kernels.py)
+  * ``tpu``   — batched bitplane MXU matmuls (rs_kernels.py), one chip
+  * ``mesh``  — matmuls sharded over the active jax.sharding.Mesh with
+                ICI XOR fan-in (rs_mesh.py); 1-device mesh = single chip
   * ``auto``  — tpu when an accelerator backend is initialized, else numpy
 
 Shard layout, padding, and matrix construction are bit-identical between
@@ -50,20 +52,42 @@ class Erasure:
         self.block_size = int(block_size)
         if backend == "auto":
             backend = "tpu" if _accelerator_present() else "numpy"
-        if backend not in ("numpy", "tpu"):
+        if backend not in ("numpy", "tpu", "mesh"):
             raise ErasureError(f"unknown backend {backend!r}")
         self.backend = backend
-        # resolve the compute impl once; both modules expose the same
+        # resolve the compute impl once; all modules expose the same
         # encode_parity/reconstruct surface
         if backend == "tpu":
             try:
                 from . import rs_kernels as impl
             except ImportError as e:
                 raise ErasureError(f"tpu backend unavailable: {e}") from e
+        elif backend == "mesh":
+            try:
+                from . import rs_mesh as impl
+            except ImportError as e:
+                raise ErasureError(f"mesh backend unavailable: {e}") from e
         else:
             impl = gf8_ref
         self._impl = impl
         self.matrix = gf8.rs_matrix(data_blocks, data_blocks + parity_blocks)
+
+    @property
+    def is_device(self) -> bool:
+        """True when the matmul engine dispatches to accelerator(s) and
+        accepts batched (B, k, n) operands (tpu and mesh backends)."""
+        return self.backend in ("tpu", "mesh")
+
+    def apply_matrix(self, rows: np.ndarray, shards) -> np.ndarray:
+        """rows (GF) @ shards through this codec's engine; accepts
+        (k, n) or batched (B, k, n) on device backends."""
+        impl_apply = getattr(self._impl, "apply_matrix", None)
+        if impl_apply is not None:
+            return impl_apply(rows, shards)
+        shards = np.asarray(shards, dtype=np.uint8)
+        if shards.ndim == 3:
+            return np.stack([gf8.gf_matmul(rows, s) for s in shards])
+        return gf8.gf_matmul(rows, shards)
 
     # -- coding ------------------------------------------------------------
 
@@ -157,7 +181,7 @@ class Erasure:
                 blocks = np.zeros((nfull, k, ssize), dtype=np.uint8)
                 flat = buf[: nfull * bs].reshape(nfull, bs)
                 blocks.reshape(nfull, k * ssize)[:, :bs] = flat
-            if self.backend == "tpu":
+            if self.is_device:
                 par = self._impl.encode_parity(blocks, m, self.matrix)
             else:
                 par = np.stack([self._impl.encode_parity(b, m, self.matrix)

@@ -174,8 +174,15 @@ def decode_rows(matrix: np.ndarray, data_blocks: int,
 
 def reconstruct(shards: list[np.ndarray | None], data_blocks: int,
                 parity_blocks: int, data_only: bool = False,
-                matrix: np.ndarray | None = None) -> list[np.ndarray]:
-    """TPU-backed equivalent of gf8_ref.reconstruct (one stripe)."""
+                matrix: np.ndarray | None = None,
+                apply=None) -> list[np.ndarray]:
+    """TPU-backed equivalent of gf8_ref.reconstruct (one stripe).
+
+    ``apply`` swaps the matmul engine — rs_mesh passes its sharded
+    distributed_apply so the same survivor/solve logic serves both the
+    single-chip and the mesh backend."""
+    if apply is None:
+        apply = apply_matrix
     total = data_blocks + parity_blocks
     if len(shards) != total:
         raise ValueError("wrong shard count")
@@ -196,9 +203,9 @@ def reconstruct(shards: list[np.ndarray | None], data_blocks: int,
     use = present[:data_blocks]
     rows = decode_rows(matrix, data_blocks, use, missing)
     stack = np.stack([np.asarray(shards[i], dtype=np.uint8) for i in use])
-    rebuilt = apply_matrix(rows, stack[None])[0]
+    rebuilt = apply(rows, stack[None])[0]
     for j, i in enumerate(missing):
-        out[i] = rebuilt[j]
+        out[i] = np.asarray(rebuilt[j], dtype=np.uint8)
     return out
 
 

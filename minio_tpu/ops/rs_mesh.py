@@ -1,0 +1,159 @@
+"""Mesh codec backend — erasure matmuls sharded over the active device
+mesh (parallel/mesh.py) behind the same impl surface as rs_kernels /
+gf8_ref, so ``Erasure(backend="mesh")`` drops into the object layer's
+existing PUT/GET/heal paths unchanged.
+
+This is the multi-chip data plane the blueprint contracts (SURVEY.md
+§2.3): encode fans the k shard blocks and GF(2) matrix columns across
+the mesh's ``shard`` axis, partial products XOR-combine via one ICI
+psum, stripes batch over the ``stripe`` axis — the device-native form
+of the reference's goroutine-per-drive fan-out
+(cmd/erasure-encode.go:36-70).  A 1-device mesh is the degenerate
+single-chip case, so the backend is valid on any topology.
+
+Shard math is bit-identical to the other backends: distributed_apply
+zero-pads k up to the shard axis (a zero operand adds nothing to an
+XOR fan-in) and this module zero-pads the stripe batch the same way.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from minio_tpu.parallel import mesh as mesh_mod
+from . import gf8, rs_kernels
+
+
+def apply_matrix(rows: np.ndarray, shards) -> np.ndarray:
+    """out[b] = rows (GF) @ shards[b] over the active mesh.
+
+    shards: (B, k, n) or (k, n) uint8.  B is zero-padded up to the
+    stripe axis (zero stripes produce zero rows we slice off), so any
+    batch size is valid on any mesh shape.
+    """
+    shards = np.asarray(shards, dtype=np.uint8)
+    squeeze = shards.ndim == 2
+    if squeeze:
+        shards = shards[None]
+    m = mesh_mod.get_active_mesh()
+    T = m.shape["stripe"]
+    B = shards.shape[0]
+    pad = (-B) % T
+    if pad:
+        shards = np.concatenate(
+            [shards, np.zeros((pad,) + shards.shape[1:], np.uint8)])
+    out = np.asarray(mesh_mod.distributed_apply(m, rows, shards))[:B]
+    return out[0] if squeeze else out
+
+
+def encode_parity(data_shards: np.ndarray, parity: int,
+                  matrix: np.ndarray | None = None) -> np.ndarray:
+    """(B, k, n) or (k, n) data -> (B, m, n) / (m, n) parity, sharded."""
+    data_shards = np.asarray(data_shards, dtype=np.uint8)
+    k = data_shards.shape[-2]
+    if matrix is None:
+        matrix = gf8.rs_matrix(k, k + parity)
+    return apply_matrix(np.asarray(matrix)[k:], data_shards)
+
+
+def reconstruct(shards, data_blocks: int, parity_blocks: int,
+                data_only: bool = False,
+                matrix: np.ndarray | None = None):
+    """Single-stripe reconstruct; survivor/solve logic is shared with
+    rs_kernels, only the matmul engine is mesh-sharded."""
+    return rs_kernels.reconstruct(shards, data_blocks, parity_blocks,
+                                  data_only=data_only, matrix=matrix,
+                                  apply=apply_matrix)
+
+
+def reconstruct_batch(shards: np.ndarray, present: list[int],
+                      wanted: list[int], data_blocks: int,
+                      parity_blocks: int,
+                      matrix: np.ndarray | None = None) -> np.ndarray:
+    """Batched same-pattern reconstruction over the mesh."""
+    if matrix is None:
+        matrix = gf8.rs_matrix(data_blocks, data_blocks + parity_blocks)
+    rows = rs_kernels.decode_rows(matrix, data_blocks, list(present),
+                                  list(wanted))
+    return apply_matrix(rows, shards)
+
+
+def encode_with_bitrot(data_blocks: int, parity_blocks: int,
+                       blocks: np.ndarray):
+    """(parity, digests) for a (B, k, n) stripe batch through the FUSED
+    sharded pipeline (mesh.distributed_encode_with_bitrot): each device
+    encodes its partial parity and hashes its own shard slice; digests
+    ride an all_gather, parity an XOR psum.
+
+    Pads B up to the stripe axis and k up to the shard axis (padded
+    shards are zero; their digests are computed but sliced off).
+    Returns (parity (B, m, n) uint8, digests (B, k+m, 32) uint8).
+    """
+    m = mesh_mod.get_active_mesh()
+    T, S = m.shape["stripe"], m.shape["shard"]
+    blocks = np.asarray(blocks, dtype=np.uint8)
+    B, k, n = blocks.shape
+    padB, padK = (-B) % T, (-k) % S
+    if padB or padK:
+        padded = np.zeros((B + padB, k + padK, n), np.uint8)
+        padded[:B, :k] = blocks
+        blocks = padded
+    M = gf8.rs_matrix(data_blocks, data_blocks + parity_blocks)
+    Mp = np.asarray(M)[data_blocks:]              # (m, k)
+    if padK:
+        Mp = np.concatenate(
+            [Mp, np.zeros((Mp.shape[0], padK), np.uint8)], axis=1)
+    import jax.numpy as jnp
+    M2 = jnp.asarray(gf8.gf2_expand(Mp), jnp.int8)
+    fn = mesh_mod._fused_encode_hash(m, M2.shape[0], blocks.shape[1])
+    parity, digests = fn(M2, jnp.asarray(blocks))
+    parity = np.asarray(parity)[:B]
+    digests = np.asarray(digests)
+    # digest rows: [k+padK data slots][m parity slots] — drop the pads
+    digests = np.concatenate([digests[:B, :k], digests[:B, k + padK:]],
+                             axis=1)
+    return parity, digests
+
+
+def encode_object_framed_fused(data_blocks: int, parity_blocks: int,
+                               block_size: int, data,
+                               digest: int = 32) -> np.ndarray:
+    """Whole object -> bitrot-framed shard files with parity AND digests
+    from the fused mesh pipeline (the multi-chip form of
+    Erasure.encode_object_framed + fill_framed).
+
+    Returns (k+m, framed_len) uint8: per erasure block a
+    [32B HighwayHash-256 digest][shard payload] frame, bit-identical to
+    the host streaming-bitrot layout (cmd/bitrot-streaming.go framing
+    around cmd/erasure-encode.go blocks).
+    """
+    k, m_par = data_blocks, parity_blocks
+    buf = np.frombuffer(bytes(data), dtype=np.uint8) \
+        if not isinstance(data, np.ndarray) \
+        else np.asarray(data, np.uint8).ravel()
+    total = buf.size
+    bs = block_size
+    ssize = gf8.shard_size(bs, k)
+    nfull, tail_len = divmod(total, bs)
+    tail_ss = gf8.ceil_frac(tail_len, k)
+    F = digest + ssize
+    flen = nfull * F + ((digest + tail_ss) if tail_len else 0)
+    out = np.zeros((k + m_par, flen), dtype=np.uint8)
+    if nfull:
+        blocks = np.zeros((nfull, k, ssize), dtype=np.uint8)
+        blocks.reshape(nfull, k * ssize)[:, :bs] = \
+            buf[:nfull * bs].reshape(nfull, bs)
+        parity, digs = encode_with_bitrot(k, m_par, blocks)
+        fview = out[:, :nfull * F].reshape(k + m_par, nfull, F)
+        fview[:k, :, digest:] = blocks.transpose(1, 0, 2)
+        fview[k:, :, digest:] = parity.transpose(1, 0, 2)
+        fview[:, :, :digest] = digs.transpose(1, 0, 2)
+    if tail_len:
+        tblock = np.zeros((1, k, tail_ss), dtype=np.uint8)
+        tblock.reshape(1, k * tail_ss)[0, :tail_len] = buf[nfull * bs:]
+        parity_t, digs_t = encode_with_bitrot(k, m_par, tblock)
+        base = nfull * F
+        out[:k, base + digest:] = tblock[0]
+        out[k:, base + digest:] = parity_t[0]
+        out[:, base:base + digest] = digs_t[0]
+    return out

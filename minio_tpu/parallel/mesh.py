@@ -41,6 +41,34 @@ def make_mesh(devices=None, stripe: int | None = None,
     return Mesh(dev, axis_names=("stripe", "shard"))
 
 
+# -- active mesh (the data plane's handle onto the chips) -------------------
+#
+# The object layer reaches the ICI collectives through here: an
+# ErasureObjects built with backend="mesh" routes encode/reconstruct/
+# heal matmuls through the active mesh (ops/rs_mesh.py), the way the
+# reference's erasureObjects fans shards over drive goroutines
+# (cmd/erasure-encode.go:36-70).  A 1-device mesh is the degenerate
+# single-chip case, so the same code path serves both.
+
+_ACTIVE: Mesh | None = None
+
+
+def set_active_mesh(mesh: Mesh | None) -> None:
+    """Install (or with None, reset) the process-wide data-plane mesh."""
+    global _ACTIVE
+    _ACTIVE = mesh
+
+
+def get_active_mesh() -> Mesh:
+    """The data-plane mesh; defaults to shard-axis parallelism over all
+    visible devices (the TP analog — shard blocks split across chips,
+    XOR fan-in rides one ICI psum)."""
+    global _ACTIVE
+    if _ACTIVE is None:
+        _ACTIVE = make_mesh(stripe=1)
+    return _ACTIVE
+
+
 def _local_gf2_kernel(n_rows: int, reduce_fn):
     """Per-device GF(2) bitplane kernel shared by the psum and ring
     paths; `reduce_fn` folds the (8r, B/T, n) int32 partial products

@@ -99,3 +99,36 @@ def test_cluster_nodes_expose_codec(tmp_path):
             assert np.array_equal(w, g)
     finally:
         node.rpc.stop()
+
+
+def test_sidecar_with_mesh_backend():
+    """The codec sidecar can serve the MESH backend: a node without
+    chips ships blocks to a peer whose codec shards the matmul over
+    its device mesh (SURVEY §2.3 — the ICI data plane reachable
+    through the RPC seam too)."""
+    from minio_tpu.parallel import mesh as mesh_mod
+    prev = mesh_mod._ACTIVE
+    mesh_mod.set_active_mesh(mesh_mod.make_mesh(stripe=2))
+    srv = RPCServer(SECRET)
+    register_codec_service(srv, backend="mesh")
+    srv.start()
+    try:
+        client = RPCClient(srv.endpoint, SECRET)
+        rc = RemoteCodec(client, 4, 2, 64 * 1024)
+        local = Erasure(4, 2, 64 * 1024, backend="numpy")
+        data = _data(3 * 64 * 1024 + 11, seed=9)
+        want = local.encode_object(data)
+        got = rc.encode_object(data)
+        assert len(want) == len(got)
+        for a, b in zip(want, got):
+            assert np.array_equal(a, b)
+        # degraded reconstruct through the mesh sidecar
+        shards = [s.copy() for s in want]
+        shards[1] = None
+        shards[4] = np.zeros(0, np.uint8)
+        out = rc.decode_data_and_parity_blocks(shards)
+        for i in range(6):
+            assert np.array_equal(out[i], want[i]), i
+    finally:
+        srv.stop()
+        mesh_mod.set_active_mesh(prev)

@@ -40,6 +40,23 @@ def _make_layer(kind, tmp):
         return _erasure(tmp, 4, 2), None
     if kind == "erasure16":
         return _erasure(tmp, 16, 4), None
+    if kind == "mesh8":
+        # erasure set whose codec matmuls are SHARDED over the virtual
+        # 8-device (2x4) mesh — PUT/GET(degraded)/heal run through
+        # parallel/mesh.distributed_* via ops/rs_mesh (SURVEY §2.3)
+        from minio_tpu.objectlayer.erasure_object import ErasureObjects
+        from minio_tpu.parallel import mesh as mesh_mod
+        from minio_tpu.storage.xl_storage import XLStorage
+        prev = mesh_mod._ACTIVE
+        mesh_mod.set_active_mesh(mesh_mod.make_mesh(stripe=2))
+        disks = []
+        for i in range(8):
+            d = tmp / f"m{i}"
+            d.mkdir()
+            disks.append(XLStorage(str(d)))
+        lay = ErasureObjects(disks, parity=3, block_size=128 * 1024,
+                             backend="mesh")
+        return lay, lambda: mesh_mod.set_active_mesh(prev)
     if kind == "sets32":
         from minio_tpu.objectlayer.sets import ErasureSets
         from minio_tpu.storage.xl_storage import XLStorage
@@ -96,8 +113,8 @@ def _make_layer(kind, tmp):
     raise AssertionError(kind)
 
 
-KINDS = ["fs", "erasure4", "erasure16", "sets32", "pools", "memory-gw",
-         "azure-gw", "gcs-gw", "s3-gw"]
+KINDS = ["fs", "erasure4", "erasure16", "mesh8", "sets32", "pools",
+         "memory-gw", "azure-gw", "gcs-gw", "s3-gw"]
 
 
 @pytest.fixture(params=KINDS)
