@@ -1,13 +1,16 @@
 """Broker notification targets (pkg/event/target/{amqp,kafka,mqtt,nats,
 nsq,redis,mysql,postgresql,elasticsearch}.go).
 
-Every kind formats payloads exactly as the reference does (unit-tested),
-rides the same disk-backed QueueStore store-and-forward when the broker
-is unreachable, and *gates* on its client library: none of the broker
-SDKs exist in this image, so `_deliver` raises TargetError with the
-requirement and — when a queue_dir is configured — events persist for
-replay once connectivity exists, mirroring the reference's queueStore
-behavior for offline brokers (pkg/event/target/queuestore.go).
+Every kind formats payloads exactly as the reference does (unit-tested)
+and rides the same disk-backed QueueStore store-and-forward when the
+broker is unreachable (pkg/event/target/queuestore.go).  SEVEN of nine
+kinds deliver over OWN wire clients (events/wire.py): AMQP 0-9-1,
+Kafka Produce v0, MQTT 3.1.1, NATS text, nsqd TCP-V2, Redis RESP2, and
+Elasticsearch REST — conformance-tested against frame-parsing stubs
+(tests/broker_stubs.py).  MySQL and PostgreSQL remain format-only and
+*gate* on their client libraries (their wire protocols carry auth/TLS
+handshakes with no in-image oracle); `_deliver` raises TargetError with
+the requirement, and queued events persist for replay.
 
 Two payload shapes recur across the reference targets:
   * event list:   {"EventName", "Key", "Records":[record]}   (kafka,
@@ -140,12 +143,23 @@ class KafkaTarget(BrokeredTarget):
         raise TargetError(f"kafka delivery failed: {last}")
 
 
+def _host_port(addr: str, default_port: int) -> tuple[str, int]:
+    """'host:port', 'tcp://host:port', or bare host -> (host, port)."""
+    if "://" in addr:
+        from urllib.parse import urlsplit
+        u = urlsplit(addr)
+        return u.hostname or "127.0.0.1", u.port or default_port
+    host, _, port = addr.partition(":")
+    return host or "127.0.0.1", int(port or default_port)
+
+
 class MQTTTarget(BrokeredTarget):
-    """pkg/event/target/mqtt.go: publish to topic at QoS."""
+    """pkg/event/target/mqtt.go: publish to topic at QoS.
+
+    Delivery rides the OWN MQTT 3.1.1 wire client (events/wire.py:
+    CONNECT/CONNACK + PUBLISH with the QoS 0-2 ack ladder) — no paho."""
 
     KIND = "mqtt"
-    CLIENT_MODULE = "paho.mqtt.client"
-    CLIENT_HINT = "paho-mqtt"
 
     def __init__(self, arn: str, broker: str, topic: str, qos: int = 0,
                  store_dir: Optional[str] = None):
@@ -157,30 +171,62 @@ class MQTTTarget(BrokeredTarget):
     def format_payload(self, record: dict) -> bytes:
         return json.dumps(event_payload(record)).encode()
 
+    def _deliver(self, record: dict) -> None:
+        from .wire import MQTTWireClient, WireError
+        host, port = _host_port(self.broker, 1883)
+        try:
+            client = MQTTWireClient(host, port)
+            try:
+                client.publish(self.topic, self.format_payload(record),
+                               qos=self.qos)
+            finally:
+                client.close()
+        except (OSError, WireError) as e:
+            raise TargetError(f"mqtt delivery failed: {e}") from e
+
 
 class NATSTarget(BrokeredTarget):
-    """pkg/event/target/nats.go: publish to subject (+streaming opt)."""
+    """pkg/event/target/nats.go: publish to subject.
+
+    Delivery rides the OWN NATS text-protocol client (events/wire.py:
+    INFO/CONNECT + PUB with a PING/PONG flush) — no nats-py."""
 
     KIND = "nats"
-    CLIENT_MODULE = "nats"
-    CLIENT_HINT = "nats-py"
 
     def __init__(self, arn: str, address: str, subject: str,
+                 user: str = "", password: str = "",
                  store_dir: Optional[str] = None):
         super().__init__(arn, store_dir)
         self.address = address
         self.subject = subject
+        self.user = user
+        self.password = password
 
     def format_payload(self, record: dict) -> bytes:
         return json.dumps(event_payload(record)).encode()
 
+    def _deliver(self, record: dict) -> None:
+        from .wire import NATSWireClient, WireError
+        host, port = _host_port(self.address, 4222)
+        try:
+            client = NATSWireClient(host, port, user=self.user,
+                                    password=self.password)
+            try:
+                client.publish(self.subject,
+                               self.format_payload(record))
+            finally:
+                client.close()
+        except (OSError, WireError) as e:
+            raise TargetError(f"nats delivery failed: {e}") from e
+
 
 class NSQTarget(BrokeredTarget):
-    """pkg/event/target/nsq.go: publish to topic on nsqd."""
+    """pkg/event/target/nsq.go: publish to topic on nsqd.
+
+    Delivery rides the OWN nsqd TCP-V2 client (events/wire.py: '  V2'
+    magic + PUB frames with heartbeat handling) — no go-nsq analog."""
 
     KIND = "nsq"
-    CLIENT_MODULE = "gnsq"
-    CLIENT_HINT = "a NSQ client (gnsq)"
 
     def __init__(self, arn: str, nsqd_address: str, topic: str,
                  store_dir: Optional[str] = None):
@@ -191,17 +237,29 @@ class NSQTarget(BrokeredTarget):
     def format_payload(self, record: dict) -> bytes:
         return json.dumps(event_payload(record)).encode()
 
+    def _deliver(self, record: dict) -> None:
+        from .wire import NSQWireClient, WireError
+        host, port = _host_port(self.nsqd_address, 4150)
+        try:
+            client = NSQWireClient(host, port)
+            try:
+                client.publish(self.topic, self.format_payload(record))
+            finally:
+                client.close()
+        except (OSError, WireError) as e:
+            raise TargetError(f"nsq delivery failed: {e}") from e
+
 
 class RedisTarget(BrokeredTarget):
     """pkg/event/target/redis.go: namespace -> HSET key field; access ->
-    RPUSH list of [timestamp, event]."""
+    RPUSH list of [timestamp, event].
+
+    Delivery rides the OWN RESP2 client (events/wire.py) — no redis-py."""
 
     KIND = "redis"
-    CLIENT_MODULE = "redis"
-    CLIENT_HINT = "redis-py"
 
     def __init__(self, arn: str, address: str, key: str,
-                 fmt: str = FORMAT_NAMESPACE,
+                 fmt: str = FORMAT_NAMESPACE, password: str = "",
                  store_dir: Optional[str] = None):
         if fmt not in (FORMAT_NAMESPACE, FORMAT_ACCESS):
             raise ValueError(f"invalid redis format {fmt!r}")
@@ -209,6 +267,20 @@ class RedisTarget(BrokeredTarget):
         self.address = address
         self.key = key
         self.fmt = fmt
+        self.password = password
+
+    def _deliver(self, record: dict) -> None:
+        from .wire import RedisWireClient, WireError
+        host, port = _host_port(self.address, 6379)
+        try:
+            client = RedisWireClient(host, port,
+                                     password=self.password)
+            try:
+                client.command(*self.format_command(record))
+            finally:
+                client.close()
+        except (OSError, WireError) as e:
+            raise TargetError(f"redis delivery failed: {e}") from e
 
     def format_command(self, record: dict) -> tuple:
         """The redis command the reference would issue (redis.go send)."""
@@ -280,11 +352,12 @@ class PostgreSQLTarget(SQLTarget):
 
 class ElasticsearchTarget(BrokeredTarget):
     """pkg/event/target/elasticsearch.go: namespace -> doc id per key;
-    access -> append with generated ids."""
+    access -> append with generated ids.
+
+    Delivery rides the OWN minimal ES REST client over plain HTTP
+    (events/wire.py) — no elasticsearch-py."""
 
     KIND = "elasticsearch"
-    CLIENT_MODULE = "elasticsearch"
-    CLIENT_HINT = "elasticsearch-py"
 
     def __init__(self, arn: str, url: str, index: str,
                  fmt: str = FORMAT_NAMESPACE,
@@ -302,6 +375,21 @@ class ElasticsearchTarget(BrokeredTarget):
             return (entry_key(record), {"Records": [record]})
         return (None, {"timestamp": record.get("eventTime", ""),
                        "Records": [record]})
+
+    def _deliver(self, record: dict) -> None:
+        from .wire import ESWireClient, WireError
+        try:
+            client = ESWireClient(self.url)
+            client.ensure_index(self.index)
+            doc_id, body = self.format_document(record)
+            if self.fmt == FORMAT_NAMESPACE and is_delete(record):
+                client.delete_doc(self.index, entry_key(record))
+            else:
+                client.index_doc(self.index, doc_id,
+                                 json.dumps(body).encode())
+        except (OSError, WireError) as e:
+            raise TargetError(
+                f"elasticsearch delivery failed: {e}") from e
 
 
 # kind -> (target class, config subsystem name)
@@ -350,7 +438,9 @@ def target_from_config(kind: str, cfg, target_id: str = "1"):
     if kind == "redis":
         return RedisTarget(arn, cfg.get(sub, "address"),
                            cfg.get(sub, "key"),
-                           cfg.get(sub, "format"), store_dir=store)
+                           cfg.get(sub, "format"),
+                           password=cfg.get(sub, "password") or "",
+                           store_dir=store)
     if kind == "mysql":
         return MySQLTarget(arn, cfg.get(sub, "dsn_string"),
                            cfg.get(sub, "table"),

@@ -242,3 +242,359 @@ class KafkaWireClient:
 
     def close(self) -> None:
         self.sock.close()
+
+
+# -- Redis (RESP2) ----------------------------------------------------------
+
+class RedisWireClient:
+    """RESP2 command client (HSET/HDEL/RPUSH — the redis.go surface).
+
+    Requests are arrays of bulk strings; replies are parsed for all
+    five RESP types so -ERR surfaces as WireError (RESP2 spec; the
+    reference rides go-redis, pkg/event/target/redis.go:1).
+    """
+
+    def __init__(self, host: str, port: int, password: str = "",
+                 timeout: float = 5.0):
+        self.sock = socket.create_connection((host, port),
+                                             timeout=timeout)
+        self._buf = b""
+        if password:
+            self.command("AUTH", password)
+
+    def _recv_line(self) -> bytes:
+        while b"\r\n" not in self._buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise WireError("connection closed by redis")
+            self._buf += chunk
+        line, _, self._buf = self._buf.partition(b"\r\n")
+        return line
+
+    def _recv_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise WireError("connection closed by redis")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _read_reply(self):
+        line = self._recv_line()
+        t, rest = line[:1], line[1:]
+        if t == b"+":
+            return rest.decode()
+        if t == b"-":
+            raise WireError(f"redis error: {rest.decode()}")
+        if t == b":":
+            return int(rest)
+        if t == b"$":
+            n = int(rest)
+            if n < 0:
+                return None
+            data = self._recv_exact(n)
+            self._recv_exact(2)                     # trailing \r\n
+            return data
+        if t == b"*":
+            n = int(rest)
+            if n < 0:
+                return None
+            return [self._read_reply() for _ in range(n)]
+        raise WireError(f"bad RESP type byte {t!r}")
+
+    def command(self, *args):
+        parts = [f"*{len(args)}\r\n".encode()]
+        for a in args:
+            b = a if isinstance(a, bytes) else str(a).encode()
+            parts.append(f"${len(b)}\r\n".encode() + b + b"\r\n")
+        self.sock.sendall(b"".join(parts))
+        return self._read_reply()
+
+    def close(self) -> None:
+        try:
+            self.sock.sendall(b"*1\r\n$4\r\nQUIT\r\n")
+        except OSError:
+            pass
+        self.sock.close()
+
+
+# -- NATS (text protocol) ---------------------------------------------------
+
+class NATSWireClient:
+    """Publisher-only NATS core client: INFO/CONNECT handshake, PUB,
+    and a PING/PONG flush so delivery is confirmed before returning
+    (NATS client protocol docs; reference rides nats.go,
+    pkg/event/target/nats.go:1)."""
+
+    def __init__(self, host: str, port: int, user: str = "",
+                 password: str = "", timeout: float = 5.0):
+        self.sock = socket.create_connection((host, port),
+                                             timeout=timeout)
+        self._buf = b""
+        info = self._recv_line()
+        if not info.startswith(b"INFO "):
+            raise WireError(f"expected INFO, got {info[:40]!r}")
+        opts = {"verbose": False, "pedantic": False,
+                "name": "minio-tpu", "lang": "python", "version": "1",
+                "protocol": 0}
+        if user:
+            opts["user"] = user
+            opts["pass"] = password
+        import json as _json
+        self.sock.sendall(b"CONNECT " + _json.dumps(opts).encode()
+                          + b"\r\n")
+        self._flush()
+
+    def _recv_line(self) -> bytes:
+        while b"\r\n" not in self._buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise WireError("connection closed by nats")
+            self._buf += chunk
+        line, _, self._buf = self._buf.partition(b"\r\n")
+        return line
+
+    def _recv_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise WireError("connection closed by nats")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _flush(self) -> None:
+        self.sock.sendall(b"PING\r\n")
+        while True:
+            line = self._recv_line()
+            if line == b"PONG":
+                return
+            if line.startswith(b"-ERR"):
+                raise WireError(f"nats: {line.decode()}")
+            if line.startswith(b"PING"):
+                self.sock.sendall(b"PONG\r\n")
+            # +OK / INFO updates are skipped
+
+    def publish(self, subject: str, payload: bytes) -> None:
+        self.sock.sendall(f"PUB {subject} {len(payload)}\r\n".encode()
+                          + payload + b"\r\n")
+        self._flush()                               # confirms acceptance
+
+    def close(self) -> None:
+        self.sock.close()
+
+
+# -- NSQ (TCP V2) -----------------------------------------------------------
+
+_NSQ_FRAME_RESPONSE = 0
+_NSQ_FRAME_ERROR = 1
+
+
+class NSQWireClient:
+    """Producer-only nsqd client: '  V2' magic then PUB frames
+    (nsq.io TCP protocol spec; reference rides go-nsq,
+    pkg/event/target/nsq.go:1)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 5.0):
+        self.sock = socket.create_connection((host, port),
+                                             timeout=timeout)
+        self._buf = b""
+        self.sock.sendall(b"  V2")
+
+    def _recv_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise WireError("connection closed by nsqd")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _read_frame(self) -> tuple[int, bytes]:
+        size = struct.unpack(">i", self._recv_exact(4))[0]
+        data = self._recv_exact(size)
+        ftype = struct.unpack(">i", data[:4])[0]
+        return ftype, data[4:]
+
+    def publish(self, topic: str, body: bytes) -> None:
+        self.sock.sendall(f"PUB {topic}\n".encode()
+                          + struct.pack(">I", len(body)) + body)
+        while True:
+            ftype, data = self._read_frame()
+            if ftype == _NSQ_FRAME_ERROR:
+                raise WireError(f"nsqd error: {data.decode()}")
+            if ftype == _NSQ_FRAME_RESPONSE:
+                if data == b"_heartbeat_":
+                    self.sock.sendall(b"NOP\n")
+                    continue
+                if data != b"OK":
+                    raise WireError(f"unexpected nsqd response {data!r}")
+                return
+
+    def close(self) -> None:
+        try:
+            self.sock.sendall(b"CLS\n")
+        except OSError:
+            pass
+        self.sock.close()
+
+
+# -- MQTT 3.1.1 -------------------------------------------------------------
+
+def _mqtt_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        d, n = n & 0x7F, n >> 7
+        out.append(d | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _mqtt_str(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">H", len(b)) + b
+
+
+class MQTTWireClient:
+    """Publisher-only MQTT 3.1.1 client: CONNECT/CONNACK, PUBLISH at
+    QoS 0-2 with the full acknowledgement ladder, DISCONNECT
+    (MQTT 3.1.1 OASIS spec §3; reference rides paho,
+    pkg/event/target/mqtt.go:1)."""
+
+    def __init__(self, host: str, port: int, client_id: str = "minio-tpu",
+                 user: str = "", password: str = "",
+                 timeout: float = 5.0):
+        self.sock = socket.create_connection((host, port),
+                                             timeout=timeout)
+        self._buf = b""
+        self._pid = 0
+        flags = 0x02                                # clean session
+        payload = _mqtt_str(client_id)
+        if user:
+            flags |= 0x80
+            payload += _mqtt_str(user)
+            if password:
+                flags |= 0x40
+                payload += _mqtt_str(password)
+        var = (_mqtt_str("MQTT") + bytes([0x04, flags])
+               + struct.pack(">H", 30))             # keepalive 30s
+        self._send_packet(0x10, var + payload)
+        ptype, body = self._read_packet()
+        if ptype != 0x20 or len(body) != 2:
+            raise WireError("expected CONNACK")
+        if body[1] != 0:
+            raise WireError(f"MQTT connect refused: code {body[1]}")
+
+    def _send_packet(self, hdr: int, body: bytes) -> None:
+        self.sock.sendall(bytes([hdr]) + _mqtt_varint(len(body)) + body)
+
+    def _recv_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise WireError("connection closed by mqtt broker")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _read_packet(self) -> tuple[int, bytes]:
+        hdr = self._recv_exact(1)[0]
+        mult, length = 1, 0
+        while True:
+            d = self._recv_exact(1)[0]
+            length += (d & 0x7F) * mult
+            if not d & 0x80:
+                break
+            mult *= 128
+            if mult > 128 ** 3:
+                raise WireError("malformed remaining length")
+        return hdr & 0xF0, self._recv_exact(length)
+
+    def publish(self, topic: str, payload: bytes, qos: int = 0) -> None:
+        var = _mqtt_str(topic)
+        if qos:
+            self._pid = (self._pid % 0xFFFF) + 1
+            var += struct.pack(">H", self._pid)
+        self._send_packet(0x30 | (qos << 1), var + payload)
+        if qos == 1:
+            ptype, body = self._read_packet()
+            if ptype != 0x40 or struct.unpack(">H", body[:2])[0] != \
+                    self._pid:
+                raise WireError("expected PUBACK")
+        elif qos == 2:
+            ptype, body = self._read_packet()
+            if ptype != 0x50 or struct.unpack(">H", body[:2])[0] != \
+                    self._pid:
+                raise WireError("expected PUBREC")
+            self._send_packet(0x62, struct.pack(">H", self._pid))
+            ptype, body = self._read_packet()
+            if ptype != 0x70:
+                raise WireError("expected PUBCOMP")
+
+    def close(self) -> None:
+        try:
+            self._send_packet(0xE0, b"")
+        except OSError:
+            pass
+        self.sock.close()
+
+
+# -- Elasticsearch (plain HTTP) ---------------------------------------------
+
+class ESWireClient:
+    """Minimal Elasticsearch document client over plain HTTP — index
+    create, doc index (explicit or auto id), doc delete.  The reference
+    rides the official client, but the API is just REST
+    (pkg/event/target/elasticsearch.go:1)."""
+
+    def __init__(self, url: str, timeout: float = 5.0):
+        from urllib.parse import urlsplit
+        import http.client
+        u = urlsplit(url)
+        self._host = u.hostname or "127.0.0.1"
+        self._port = u.port or (443 if u.scheme == "https" else 9200)
+        self._cls = http.client.HTTPSConnection \
+            if u.scheme == "https" else http.client.HTTPConnection
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str, body: bytes = b"",
+                 ok=(200, 201)) -> tuple[int, bytes]:
+        conn = self._cls(self._host, self._port, timeout=self.timeout)
+        try:
+            hdrs = {"Content-Type": "application/json"} if body else {}
+            conn.request(method, path, body=body or None, headers=hdrs)
+            resp = conn.getresponse()
+            data = resp.read()
+            if ok and resp.status not in ok:
+                raise WireError(
+                    f"elasticsearch {method} {path}: {resp.status} "
+                    f"{data[:200]!r}")
+            return resp.status, data
+        except OSError as e:
+            raise WireError(f"elasticsearch unreachable: {e}") from e
+        finally:
+            conn.close()
+
+    def ensure_index(self, index: str) -> None:
+        status, _ = self._request("HEAD", f"/{index}", ok=())
+        if status == 200:
+            return
+        status, data = self._request("PUT", f"/{index}", b"{}", ok=())
+        if status not in (200, 201) and b"already_exists" not in data:
+            raise WireError(f"create index {index}: {status}")
+
+    def index_doc(self, index: str, doc_id, body: bytes) -> None:
+        if doc_id is None:
+            self._request("POST", f"/{index}/_doc", body)
+        else:
+            from urllib.parse import quote
+            self._request("PUT", f"/{index}/_doc/{quote(doc_id, safe='')}",
+                          body)
+
+    def delete_doc(self, index: str, doc_id: str) -> None:
+        from urllib.parse import quote
+        status, _ = self._request(
+            "DELETE", f"/{index}/_doc/{quote(doc_id, safe='')}", ok=())
+        if status not in (200, 404):
+            raise WireError(f"delete {doc_id}: {status}")

@@ -118,7 +118,7 @@ register_subsys("notify_nsq", {"enable": "off", "nsqd_address": "",
                                "topic": "", "queue_dir": ""})
 register_subsys("notify_redis", {"enable": "off", "address": "",
                                  "key": "", "format": "namespace",
-                                 "queue_dir": ""})
+                                 "password": "", "queue_dir": ""})
 register_subsys("notify_mysql", {"enable": "off", "dsn_string": "",
                                  "table": "", "format": "namespace",
                                  "queue_dir": ""})

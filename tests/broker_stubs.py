@@ -285,3 +285,348 @@ class KafkaStubBroker:
             p += 4
             value = content[p:p + vlen] if vlen >= 0 else b""
             self.produced.append((topic, key, value))
+
+
+class _TCPStub:
+    """Shared accept-loop scaffolding for the single-protocol stubs."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._guarded, args=(conn,),
+                             daemon=True).start()
+
+    def _guarded(self, conn: socket.socket):
+        try:
+            conn.settimeout(10)
+            self._session(conn)
+        except (ConnectionError, AssertionError, socket.timeout,
+                OSError, struct.error):
+            pass
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _reader(conn: socket.socket):
+        state = {"buf": b""}
+
+        def recv_exact(n):
+            while len(state["buf"]) < n:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise ConnectionError("eof")
+                state["buf"] += chunk
+            out, state["buf"] = state["buf"][:n], state["buf"][n:]
+            return out
+
+        def recv_line():
+            while b"\r\n" not in state["buf"]:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise ConnectionError("eof")
+                state["buf"] += chunk
+            line, _, rest = state["buf"].partition(b"\r\n")
+            state["buf"] = rest
+            return line
+
+        return recv_exact, recv_line
+
+
+class RedisStubBroker(_TCPStub):
+    """Parses RESP2 arrays, applies HSET/HDEL/RPUSH/AUTH/QUIT to real
+    dict/list state so namespace semantics are testable."""
+
+    def __init__(self, password: str = ""):
+        super().__init__()
+        self.password = password
+        self.hashes: dict[str, dict[str, str]] = {}
+        self.lists: dict[str, list[str]] = {}
+        self.commands: list[tuple] = []
+
+    def _session(self, conn):
+        recv_exact, recv_line = self._reader(conn)
+        authed = not self.password
+
+        def read_value():
+            line = recv_line()
+            t, rest = line[:1], line[1:]
+            assert t == b"$", f"client must send bulk strings, got {t!r}"
+            n = int(rest)
+            data = recv_exact(n)
+            assert recv_exact(2) == b"\r\n"
+            return data.decode()
+
+        while True:
+            line = recv_line()
+            assert line[:1] == b"*", f"expected array, got {line!r}"
+            args = [read_value() for _ in range(int(line[1:]))]
+            cmd = args[0].upper()
+            self.commands.append(tuple(args))
+            if cmd == "AUTH":
+                if args[1] == self.password:
+                    authed = True
+                    conn.sendall(b"+OK\r\n")
+                else:
+                    conn.sendall(b"-ERR invalid password\r\n")
+                continue
+            if not authed:
+                conn.sendall(b"-NOAUTH Authentication required.\r\n")
+                continue
+            if cmd == "HSET":
+                h = self.hashes.setdefault(args[1], {})
+                added = int(args[2] not in h)
+                h[args[2]] = args[3]
+                conn.sendall(f":{added}\r\n".encode())
+            elif cmd == "HDEL":
+                h = self.hashes.get(args[1], {})
+                removed = int(args[2] in h)
+                h.pop(args[2], None)
+                conn.sendall(f":{removed}\r\n".encode())
+            elif cmd == "RPUSH":
+                lst = self.lists.setdefault(args[1], [])
+                lst.append(args[2])
+                conn.sendall(f":{len(lst)}\r\n".encode())
+            elif cmd == "QUIT":
+                conn.sendall(b"+OK\r\n")
+                return
+            else:
+                conn.sendall(b"-ERR unknown command\r\n")
+
+
+class NATSStubBroker(_TCPStub):
+    """Speaks the NATS text protocol: INFO banner, CONNECT parse, PUB
+    with payload, PING->PONG."""
+
+    def __init__(self):
+        super().__init__()
+        self.published: list[tuple[str, bytes]] = []
+        self.connects: list[dict] = []
+
+    def _session(self, conn):
+        import json as _json
+        recv_exact, recv_line = self._reader(conn)
+        conn.sendall(b'INFO {"server_id":"stub","version":"2.0.0",'
+                     b'"max_payload":1048576}\r\n')
+        while True:
+            line = recv_line()
+            if line.startswith(b"CONNECT "):
+                self.connects.append(_json.loads(line[8:]))
+            elif line.startswith(b"PUB "):
+                parts = line.decode().split(" ")
+                assert len(parts) == 3, parts   # no reply-to from us
+                _, subject, size = parts
+                payload = recv_exact(int(size))
+                assert recv_exact(2) == b"\r\n"
+                self.published.append((subject, payload))
+            elif line == b"PING":
+                conn.sendall(b"PONG\r\n")
+            elif line == b"PONG":
+                pass
+            else:
+                conn.sendall(b"-ERR 'Unknown Protocol Operation'\r\n")
+                return
+
+
+class NSQStubBroker(_TCPStub):
+    """Parses the nsqd TCP-V2 protocol: '  V2' magic, PUB frames with
+    4-byte size prefix; answers with framed OK responses."""
+
+    def __init__(self):
+        super().__init__()
+        self.published: list[tuple[str, bytes]] = []
+
+    @staticmethod
+    def _frame(conn, ftype: int, data: bytes):
+        body = struct.pack(">i", ftype) + data
+        conn.sendall(struct.pack(">i", len(body)) + body)
+
+    def _session(self, conn):
+        recv_exact, _ = self._reader(conn)
+        assert recv_exact(4) == b"  V2", "bad magic"
+        line = b""
+        while True:
+            c = recv_exact(1)
+            if c != b"\n":
+                line += c
+                continue
+            cmd = line.decode()
+            line = b""
+            if cmd.startswith("PUB "):
+                topic = cmd[4:]
+                size = struct.unpack(">I", recv_exact(4))[0]
+                body = recv_exact(size)
+                self.published.append((topic, body))
+                self._frame(conn, 0, b"OK")
+            elif cmd == "NOP":
+                pass
+            elif cmd == "CLS":
+                self._frame(conn, 0, b"CLOSE_WAIT")
+                return
+            else:
+                self._frame(conn, 1, b"E_INVALID")
+                return
+
+
+class MQTTStubBroker(_TCPStub):
+    """Parses MQTT 3.1.1 control packets: CONNECT (protocol name/level
+    check), PUBLISH at QoS 0/1/2 with the full ack ladder, DISCONNECT."""
+
+    def __init__(self):
+        super().__init__()
+        self.published: list[tuple[str, bytes, int]] = []
+        self.clients: list[str] = []
+
+    def _session(self, conn):
+        recv_exact, _ = self._reader(conn)
+
+        def read_packet():
+            hdr = recv_exact(1)[0]
+            mult, length = 1, 0
+            while True:
+                d = recv_exact(1)[0]
+                length += (d & 0x7F) * mult
+                if not d & 0x80:
+                    break
+                mult *= 128
+            return hdr, recv_exact(length)
+
+        hdr, body = read_packet()
+        assert hdr & 0xF0 == 0x10, "expected CONNECT"
+        plen = struct.unpack(">H", body[:2])[0]
+        assert body[2:2 + plen] == b"MQTT", body[:10]
+        assert body[2 + plen] == 4, "protocol level must be 3.1.1"
+        off = 2 + plen + 1 + 1 + 2          # flags + keepalive
+        cidlen = struct.unpack(">H", body[off:off + 2])[0]
+        self.clients.append(body[off + 2:off + 2 + cidlen].decode())
+        conn.sendall(b"\x20\x02\x00\x00")   # CONNACK accepted
+        while True:
+            hdr, body = read_packet()
+            ptype = hdr & 0xF0
+            if ptype == 0x30:               # PUBLISH
+                qos = (hdr >> 1) & 0x03
+                tlen = struct.unpack(">H", body[:2])[0]
+                topic = body[2:2 + tlen].decode()
+                off = 2 + tlen
+                pid = 0
+                if qos:
+                    pid = struct.unpack(">H", body[off:off + 2])[0]
+                    off += 2
+                self.published.append((topic, body[off:], qos))
+                if qos == 1:
+                    conn.sendall(b"\x40\x02" + struct.pack(">H", pid))
+                elif qos == 2:
+                    conn.sendall(b"\x50\x02" + struct.pack(">H", pid))
+            elif ptype == 0x60:             # PUBREL
+                pid = struct.unpack(">H", body[:2])[0]
+                conn.sendall(b"\x70\x02" + struct.pack(">H", pid))
+            elif ptype == 0xE0:             # DISCONNECT
+                return
+            elif ptype == 0xC0:             # PINGREQ
+                conn.sendall(b"\xd0\x00")
+            else:
+                return
+
+
+class ESStubServer:
+    """Minimal Elasticsearch REST stub: index create/HEAD, _doc PUT/
+    POST/DELETE against an in-memory store (http.server based)."""
+
+    def __init__(self):
+        import http.server
+        import json as _json
+        from urllib.parse import unquote as _unquote
+        stub = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, status, doc=None):
+                body = _json.dumps(doc or {}).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(body)
+
+            def _route(self):
+                # split the RAW path, then unquote each segment: doc
+                # ids contain %2F which must not become a separator
+                parts = [_unquote(p) for p in
+                         self.path.split("/") if p]
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                body = self.rfile.read(length) if length else b""
+                if len(parts) == 1:
+                    index = parts[0]
+                    if self.command == "HEAD":
+                        return self._reply(
+                            200 if index in stub.indices else 404)
+                    if self.command == "PUT":
+                        if index in stub.indices:
+                            return self._reply(400, {
+                                "error": {"type":
+                                          "resource_already_exists"
+                                          "_exception"}})
+                        stub.indices[index] = {}
+                        return self._reply(200, {"acknowledged": True})
+                if len(parts) >= 2 and parts[1] == "_doc":
+                    index = parts[0]
+                    if index not in stub.indices:
+                        return self._reply(404)
+                    if self.command == "POST" and len(parts) == 2:
+                        stub._auto += 1
+                        did = f"auto-{stub._auto}"
+                        stub.indices[index][did] = _json.loads(body)
+                        return self._reply(201, {"_id": did})
+                    if len(parts) == 3:
+                        did = parts[2]
+                        if self.command == "PUT":
+                            stub.indices[index][did] = _json.loads(body)
+                            return self._reply(201, {"_id": did})
+                        if self.command == "DELETE":
+                            existed = did in stub.indices[index]
+                            stub.indices[index].pop(did, None)
+                            return self._reply(200 if existed else 404)
+                return self._reply(400)
+
+            do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _route
+
+        self._http = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), Handler)
+        self.port = self._http.server_address[1]
+        self.indices: dict[str, dict] = {}
+        self._auto = 0
+        self._thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()

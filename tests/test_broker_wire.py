@@ -138,3 +138,231 @@ def test_kafka_store_and_forward_replay(tmp_path):
             [b"evb/k0", b"evb/k1", b"evb/k2"]
     finally:
         broker2.stop()
+
+
+# -- Redis (RESP2) ---------------------------------------------------------
+
+def test_redis_namespace_hset_hdel_over_wire():
+    from minio_tpu.events.brokers import RedisTarget
+    from .broker_stubs import RedisStubBroker
+    broker = RedisStubBroker().start()
+    try:
+        t = RedisTarget("arn:minio:sqs::1:redis",
+                        f"127.0.0.1:{broker.port}", "minio_events")
+        t.send(_record(key="a/b.txt"))
+        assert "evb/a/b.txt" in broker.hashes["minio_events"]
+        doc = json.loads(broker.hashes["minio_events"]["evb/a/b.txt"])
+        assert doc["Records"][0]["s3"]["object"]["key"] == "a/b.txt"
+        # namespace delete -> HDEL removes the entry
+        t.send(_record(key="a/b.txt", event="ObjectRemoved:Delete"))
+        assert "evb/a/b.txt" not in broker.hashes["minio_events"]
+    finally:
+        broker.stop()
+
+
+def test_redis_access_rpush_and_auth():
+    from minio_tpu.events.brokers import FORMAT_ACCESS, RedisTarget
+    from .broker_stubs import RedisStubBroker
+    broker = RedisStubBroker(password="hunter2").start()
+    try:
+        t = RedisTarget("arn:minio:sqs::1:redis",
+                        f"127.0.0.1:{broker.port}", "log",
+                        fmt=FORMAT_ACCESS, password="hunter2")
+        t.send(_record(key="x"))
+        t.send(_record(key="y"))
+        assert len(broker.lists["log"]) == 2
+        assert ("AUTH", "hunter2") in broker.commands
+        # wrong password is a TargetError, not silent success
+        bad = RedisTarget("arn:minio:sqs::1:redis",
+                          f"127.0.0.1:{broker.port}", "log",
+                          fmt=FORMAT_ACCESS, password="wrong")
+        with pytest.raises(TargetError):
+            bad.send(_record())
+    finally:
+        broker.stop()
+
+
+def test_redis_store_and_forward_replay(tmp_path):
+    from minio_tpu.events.brokers import RedisTarget
+    from .broker_stubs import RedisStubBroker
+    t = RedisTarget("arn:minio:sqs::1:redis", "127.0.0.1:1",
+                    "minio_events", store_dir=str(tmp_path / "rq"))
+    t.send(_record(key="r1"))
+    t.send(_record(key="r2"))
+    assert len(t.store) == 2
+    broker = RedisStubBroker().start()
+    try:
+        t.address = f"127.0.0.1:{broker.port}"
+        assert t.replay() == 2
+        assert set(broker.hashes["minio_events"]) == {"evb/r1", "evb/r2"}
+    finally:
+        broker.stop()
+
+
+# -- NATS ------------------------------------------------------------------
+
+def test_nats_publish_over_wire():
+    from minio_tpu.events.brokers import NATSTarget
+    from .broker_stubs import NATSStubBroker
+    broker = NATSStubBroker().start()
+    try:
+        t = NATSTarget("arn:minio:sqs::1:nats",
+                       f"127.0.0.1:{broker.port}", "bucketevents")
+        t.send(_record())
+        assert len(broker.published) == 1
+        subject, payload = broker.published[0]
+        assert subject == "bucketevents"
+        assert json.loads(payload)["EventName"] == "s3:ObjectCreated:Put"
+        assert broker.connects[0]["name"] == "minio-tpu"
+    finally:
+        broker.stop()
+
+
+def test_nats_store_and_forward_replay(tmp_path):
+    from minio_tpu.events.brokers import NATSTarget
+    from .broker_stubs import NATSStubBroker
+    t = NATSTarget("arn:minio:sqs::1:nats", "127.0.0.1:1", "subj",
+                   store_dir=str(tmp_path / "nq"))
+    for i in range(3):
+        t.send(_record(key=f"n{i}"))
+    assert len(t.store) == 3
+    broker = NATSStubBroker().start()
+    try:
+        t.address = f"127.0.0.1:{broker.port}"
+        assert t.replay() == 3
+        keys = [json.loads(p)["Key"] for _, p in broker.published]
+        assert keys == ["evb/n0", "evb/n1", "evb/n2"]
+    finally:
+        broker.stop()
+
+
+# -- NSQ -------------------------------------------------------------------
+
+def test_nsq_publish_over_wire():
+    from minio_tpu.events.brokers import NSQTarget
+    from .broker_stubs import NSQStubBroker
+    broker = NSQStubBroker().start()
+    try:
+        t = NSQTarget("arn:minio:sqs::1:nsq",
+                      f"127.0.0.1:{broker.port}", "minio-topic")
+        t.send(_record())
+        assert len(broker.published) == 1
+        topic, body = broker.published[0]
+        assert topic == "minio-topic"
+        assert json.loads(body)["Key"] == "evb/dir/file.bin"
+    finally:
+        broker.stop()
+
+
+def test_nsq_store_and_forward_replay(tmp_path):
+    from minio_tpu.events.brokers import NSQTarget
+    from .broker_stubs import NSQStubBroker
+    t = NSQTarget("arn:minio:sqs::1:nsq", "127.0.0.1:1", "top",
+                  store_dir=str(tmp_path / "sq"))
+    t.send(_record(key="q1"))
+    assert len(t.store) == 1
+    broker = NSQStubBroker().start()
+    try:
+        t.nsqd_address = f"127.0.0.1:{broker.port}"
+        assert t.replay() == 1
+        assert json.loads(broker.published[0][1])["Key"] == "evb/q1"
+    finally:
+        broker.stop()
+
+
+# -- MQTT ------------------------------------------------------------------
+
+@pytest.mark.parametrize("qos", [0, 1, 2])
+def test_mqtt_publish_all_qos(qos):
+    from minio_tpu.events.brokers import MQTTTarget
+    from .broker_stubs import MQTTStubBroker
+    broker = MQTTStubBroker().start()
+    try:
+        t = MQTTTarget("arn:minio:sqs::1:mqtt",
+                       f"tcp://127.0.0.1:{broker.port}",
+                       "minio/events", qos=qos)
+        t.send(_record(key=f"m{qos}"))
+        import time
+        for _ in range(100):          # qos0 has no ack to wait on
+            if broker.published:
+                break
+            time.sleep(0.02)
+        assert len(broker.published) == 1
+        topic, payload, got_qos = broker.published[0]
+        assert topic == "minio/events" and got_qos == qos
+        assert json.loads(payload)["Key"] == f"evb/m{qos}"
+        assert broker.clients == ["minio-tpu"]
+    finally:
+        broker.stop()
+
+
+def test_mqtt_store_and_forward_replay(tmp_path):
+    from minio_tpu.events.brokers import MQTTTarget
+    from .broker_stubs import MQTTStubBroker
+    t = MQTTTarget("arn:minio:sqs::1:mqtt", "127.0.0.1:1", "t/e",
+                   qos=1, store_dir=str(tmp_path / "mq"))
+    t.send(_record(key="mm"))
+    assert len(t.store) == 1
+    broker = MQTTStubBroker().start()
+    try:
+        t.broker = f"127.0.0.1:{broker.port}"
+        assert t.replay() == 1
+        assert json.loads(broker.published[0][1])["Key"] == "evb/mm"
+    finally:
+        broker.stop()
+
+
+# -- Elasticsearch ---------------------------------------------------------
+
+def test_elasticsearch_namespace_over_http():
+    from minio_tpu.events.brokers import ElasticsearchTarget
+    from .broker_stubs import ESStubServer
+    stub = ESStubServer().start()
+    try:
+        t = ElasticsearchTarget("arn:minio:sqs::1:elasticsearch",
+                                f"http://127.0.0.1:{stub.port}",
+                                "minio-ix")
+        t.send(_record(key="e/doc.bin"))
+        assert "evb/e/doc.bin" in stub.indices["minio-ix"]
+        doc = stub.indices["minio-ix"]["evb/e/doc.bin"]
+        assert doc["Records"][0]["s3"]["object"]["key"] == "e/doc.bin"
+        # overwrite in place (namespace semantics), then delete
+        t.send(_record(key="e/doc.bin"))
+        assert len(stub.indices["minio-ix"]) == 1
+        t.send(_record(key="e/doc.bin", event="ObjectRemoved:Delete"))
+        assert "evb/e/doc.bin" not in stub.indices["minio-ix"]
+    finally:
+        stub.stop()
+
+
+def test_elasticsearch_access_appends_auto_ids():
+    from minio_tpu.events.brokers import (FORMAT_ACCESS,
+                                          ElasticsearchTarget)
+    from .broker_stubs import ESStubServer
+    stub = ESStubServer().start()
+    try:
+        t = ElasticsearchTarget("arn:minio:sqs::1:elasticsearch",
+                                f"http://127.0.0.1:{stub.port}",
+                                "logix", fmt=FORMAT_ACCESS)
+        t.send(_record(key="a"))
+        t.send(_record(key="a"))
+        assert len(stub.indices["logix"]) == 2    # append, not upsert
+    finally:
+        stub.stop()
+
+
+def test_elasticsearch_store_and_forward_replay(tmp_path):
+    from minio_tpu.events.brokers import ElasticsearchTarget
+    from .broker_stubs import ESStubServer
+    t = ElasticsearchTarget("arn:minio:sqs::1:elasticsearch",
+                            "http://127.0.0.1:1", "rix",
+                            store_dir=str(tmp_path / "eq"))
+    t.send(_record(key="e1"))
+    assert len(t.store) == 1
+    stub = ESStubServer().start()
+    try:
+        t.url = f"http://127.0.0.1:{stub.port}"
+        assert t.replay() == 1
+        assert "evb/e1" in stub.indices["rix"]
+    finally:
+        stub.stop()
