@@ -1,0 +1,125 @@
+"""KES KMS backend — own REST wire client (cmd/crypto/kes.go:1).
+
+KES (the reference's key-encryption service) exposes a small HTTP API:
+``/v1/key/create/<name>``, ``/v1/key/generate/<name>`` (returns a fresh
+data key as plaintext + ciphertext sealed by the named master key), and
+``/v1/key/decrypt/<name>``.  The reference client authenticates with
+mTLS client certificates or an API key; this client sends the API key
+as a bearer token (KES's enclave API-key mode).  Conformance runs
+against an in-process stub that implements real sealing with context
+binding (tests/kes_stub.py).
+
+The class satisfies the LocalKMS surface (key_id / generate_key /
+unseal_key), so SSE-S3/SSE-KMS route through it unchanged
+(crypto/sse.py ObjectEncryption).
+"""
+
+from __future__ import annotations
+
+import base64
+import http.client
+import json
+from urllib.parse import quote, urlsplit
+
+from .kms import KMSError
+
+
+class KESClient:
+    """Minimal KES REST client: create/generate/decrypt key ops."""
+
+    def __init__(self, endpoint: str, api_key: str = "",
+                 timeout: float = 10.0):
+        u = urlsplit(endpoint)
+        self._host = u.hostname or "127.0.0.1"
+        self._port = u.port or (443 if u.scheme == "https" else 7373)
+        self._cls = http.client.HTTPSConnection \
+            if u.scheme == "https" else http.client.HTTPConnection
+        self.api_key = api_key
+        self.timeout = timeout
+
+    def _request(self, method: str, path: str, doc: dict | None = None,
+                 ok=(200,)) -> dict:
+        conn = self._cls(self._host, self._port, timeout=self.timeout)
+        try:
+            body = json.dumps(doc).encode() if doc is not None else b""
+            hdrs = {"Content-Type": "application/json"} if body else {}
+            if self.api_key:
+                hdrs["Authorization"] = f"Bearer {self.api_key}"
+            conn.request(method, path, body=body or None, headers=hdrs)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status not in ok:
+                msg = ""
+                try:
+                    msg = json.loads(data).get("message", "")
+                except (ValueError, UnicodeDecodeError):
+                    pass
+                raise KMSError(
+                    f"kes {method} {path}: {resp.status} {msg}")
+            return json.loads(data) if data else {}
+        except OSError as e:
+            raise KMSError(f"kes unreachable: {e}") from e
+        finally:
+            conn.close()
+
+    def create_key(self, name: str) -> None:
+        """Idempotent master-key creation (kes key create)."""
+        try:
+            self._request("POST", f"/v1/key/create/{quote(name)}",
+                          ok=(200, 201))
+        except KMSError as e:
+            if "already exists" not in str(e):
+                raise
+
+    def generate_key(self, name: str, context: bytes
+                     ) -> tuple[bytes, bytes]:
+        """(plaintext data key, opaque ciphertext)."""
+        doc = self._request(
+            "POST", f"/v1/key/generate/{quote(name)}",
+            {"context": base64.b64encode(context).decode()})
+        return (base64.b64decode(doc["plaintext"]),
+                base64.b64decode(doc["ciphertext"]))
+
+    def decrypt_key(self, name: str, ciphertext: bytes,
+                    context: bytes) -> bytes:
+        doc = self._request(
+            "POST", f"/v1/key/decrypt/{quote(name)}",
+            {"ciphertext": base64.b64encode(ciphertext).decode(),
+             "context": base64.b64encode(context).decode()})
+        return base64.b64decode(doc["plaintext"])
+
+
+class KESKMS:
+    """LocalKMS-compatible KMS whose master key lives inside KES: data
+    keys are generated and unsealed remotely, so the key-encryption key
+    is NEVER in this process (cmd/crypto/kes.go kesService role)."""
+
+    def __init__(self, endpoint: str, key_name: str, api_key: str = "",
+                 create: bool = True):
+        self.client = KESClient(endpoint, api_key)
+        self.key_id = key_name
+        if create:
+            self.client.create_key(key_name)
+
+    @staticmethod
+    def _context_bytes(context: dict[str, str]) -> bytes:
+        return json.dumps(context, sort_keys=True,
+                          separators=(",", ":")).encode()
+
+    def generate_key(self, context: dict[str, str]
+                     ) -> tuple[bytes, str]:
+        plain, sealed = self.client.generate_key(
+            self.key_id, self._context_bytes(context))
+        blob = base64.b64encode(
+            self.key_id.encode() + b"\x00" + sealed).decode()
+        return plain, blob
+
+    def unseal_key(self, sealed_b64: str,
+                   context: dict[str, str]) -> bytes:
+        try:
+            raw = base64.b64decode(sealed_b64)
+            key_id, sealed = raw.split(b"\x00", 1)
+        except Exception as e:
+            raise KMSError("malformed sealed key") from e
+        return self.client.decrypt_key(
+            key_id.decode(), sealed, self._context_bytes(context))

@@ -19,6 +19,16 @@ from . import dare
 
 MASTER_KEY_ENV = "MINIO_TPU_KMS_SECRET_KEY"   # "<key-id>:<base64-32-bytes>"
 
+# external backends (cmd/crypto/{kes,vault}.go config envs)
+KES_ENDPOINT_ENV = "MINIO_TPU_KMS_KES_ENDPOINT"
+KES_KEY_ENV = "MINIO_TPU_KMS_KES_KEY_NAME"
+KES_APIKEY_ENV = "MINIO_TPU_KMS_KES_API_KEY"
+VAULT_ENDPOINT_ENV = "MINIO_TPU_KMS_VAULT_ENDPOINT"
+VAULT_KEY_ENV = "MINIO_TPU_KMS_VAULT_KEY_NAME"
+VAULT_TOKEN_ENV = "MINIO_TPU_KMS_VAULT_TOKEN"
+VAULT_ROLE_ID_ENV = "MINIO_TPU_KMS_VAULT_APPROLE_ID"
+VAULT_SECRET_ID_ENV = "MINIO_TPU_KMS_VAULT_APPROLE_SECRET"
+
 
 class KMSError(Exception):
     pass
@@ -34,6 +44,30 @@ def default_kms() -> "LocalKMS":
     if _default is None:
         _default = LocalKMS()
     return _default
+
+
+def kms_from_env(layer):
+    """Server KMS bootstrap: KES endpoint wins, then Vault, then the
+    local master-key KMS (cmd/crypto/kms.go NewKMS precedence —
+    external key services before the static master key).  KES/Vault
+    failures at boot are LOUD: silently downgrading to a local key
+    would seal new objects under a key the operator never configured."""
+    kes_ep = os.environ.get(KES_ENDPOINT_ENV, "")
+    if kes_ep:
+        from .kes import KESKMS
+        return KESKMS(kes_ep,
+                      os.environ.get(KES_KEY_ENV, "minio-tpu-sse"),
+                      api_key=os.environ.get(KES_APIKEY_ENV, ""))
+    vault_ep = os.environ.get(VAULT_ENDPOINT_ENV, "")
+    if vault_ep:
+        from .vault import VaultKMS
+        return VaultKMS(vault_ep,
+                        os.environ.get(VAULT_KEY_ENV, "minio-tpu-sse"),
+                        token=os.environ.get(VAULT_TOKEN_ENV, ""),
+                        role_id=os.environ.get(VAULT_ROLE_ID_ENV, ""),
+                        secret_id=os.environ.get(VAULT_SECRET_ID_ENV,
+                                                 ""))
+    return LocalKMS.from_env_or_store(layer)
 
 
 class LocalKMS:

@@ -1,0 +1,138 @@
+"""Vault KMS backend — own HTTP JSON wire client (cmd/crypto/vault.go:1).
+
+HashiCorp Vault's transit engine as the KMS: data keys come from
+``/v1/transit/datakey/plaintext/<name>`` and unseal via
+``/v1/transit/decrypt/<name>``, with the (bucket, object) context bound
+into the ciphertext the same way the reference passes kmsContext.
+Auth is a static token (X-Vault-Token) or an AppRole login
+(``/v1/auth/approle/login`` -> client token), the two modes vault.go
+supports.  Conformance runs against an in-process stub implementing a
+real transit engine with context binding (tests/vault_stub.py).
+
+The class satisfies the LocalKMS surface (key_id / generate_key /
+unseal_key), so SSE-S3/SSE-KMS route through it unchanged.
+"""
+
+from __future__ import annotations
+
+import base64
+import http.client
+import json
+from urllib.parse import quote, urlsplit
+
+from .kms import KMSError
+
+
+class VaultClient:
+    """Minimal Vault API client: token or AppRole auth + transit ops."""
+
+    def __init__(self, endpoint: str, token: str = "",
+                 role_id: str = "", secret_id: str = "",
+                 timeout: float = 10.0):
+        u = urlsplit(endpoint)
+        self._host = u.hostname or "127.0.0.1"
+        self._port = u.port or (443 if u.scheme == "https" else 8200)
+        self._cls = http.client.HTTPSConnection \
+            if u.scheme == "https" else http.client.HTTPConnection
+        self.timeout = timeout
+        self.token = token
+        if not token:
+            if not role_id:
+                raise KMSError("vault: need a token or approle role_id")
+            self.token = self._approle_login(role_id, secret_id)
+
+    def _request(self, method: str, path: str, doc: dict | None = None,
+                 auth: bool = True, ok=(200, 204)) -> dict:
+        conn = self._cls(self._host, self._port, timeout=self.timeout)
+        try:
+            body = json.dumps(doc).encode() if doc is not None else b""
+            hdrs = {}
+            if body:
+                hdrs["Content-Type"] = "application/json"
+            if auth:
+                hdrs["X-Vault-Token"] = self.token
+            conn.request(method, path, body=body or None, headers=hdrs)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status not in ok:
+                errs = ""
+                try:
+                    errs = ",".join(json.loads(data).get("errors", []))
+                except (ValueError, UnicodeDecodeError):
+                    pass
+                raise KMSError(
+                    f"vault {method} {path}: {resp.status} {errs}")
+            return json.loads(data) if data else {}
+        except OSError as e:
+            raise KMSError(f"vault unreachable: {e}") from e
+        finally:
+            conn.close()
+
+    def _approle_login(self, role_id: str, secret_id: str) -> str:
+        doc = self._request("POST", "/v1/auth/approle/login",
+                            {"role_id": role_id, "secret_id": secret_id},
+                            auth=False)
+        token = doc.get("auth", {}).get("client_token", "")
+        if not token:
+            raise KMSError("vault approle login returned no token")
+        return token
+
+    # -- transit engine ----------------------------------------------------
+
+    def create_transit_key(self, name: str) -> None:
+        """Idempotent (vault returns 204 for create, including when the
+        key already exists)."""
+        self._request("POST", f"/v1/transit/keys/{quote(name)}", {})
+
+    def generate_data_key(self, name: str, context: bytes
+                          ) -> tuple[bytes, str]:
+        doc = self._request(
+            "POST", f"/v1/transit/datakey/plaintext/{quote(name)}",
+            {"context": base64.b64encode(context).decode()})
+        d = doc.get("data", {})
+        return base64.b64decode(d["plaintext"]), d["ciphertext"]
+
+    def decrypt(self, name: str, ciphertext: str,
+                context: bytes) -> bytes:
+        doc = self._request(
+            "POST", f"/v1/transit/decrypt/{quote(name)}",
+            {"ciphertext": ciphertext,
+             "context": base64.b64encode(context).decode()})
+        return base64.b64decode(doc["data"]["plaintext"])
+
+
+class VaultKMS:
+    """LocalKMS-compatible KMS over Vault transit: the master key never
+    leaves Vault (cmd/crypto/vault.go vaultService role)."""
+
+    def __init__(self, endpoint: str, key_name: str, token: str = "",
+                 role_id: str = "", secret_id: str = "",
+                 create: bool = True):
+        self.client = VaultClient(endpoint, token=token,
+                                  role_id=role_id, secret_id=secret_id)
+        self.key_id = key_name
+        if create:
+            self.client.create_transit_key(key_name)
+
+    @staticmethod
+    def _context_bytes(context: dict[str, str]) -> bytes:
+        return json.dumps(context, sort_keys=True,
+                          separators=(",", ":")).encode()
+
+    def generate_key(self, context: dict[str, str]
+                     ) -> tuple[bytes, str]:
+        plain, ct = self.client.generate_data_key(
+            self.key_id, self._context_bytes(context))
+        blob = base64.b64encode(
+            self.key_id.encode() + b"\x00" + ct.encode()).decode()
+        return plain, blob
+
+    def unseal_key(self, sealed_b64: str,
+                   context: dict[str, str]) -> bytes:
+        try:
+            raw = base64.b64decode(sealed_b64)
+            key_id, ct = raw.split(b"\x00", 1)
+        except Exception as e:
+            raise KMSError("malformed sealed key") from e
+        return self.client.decrypt(key_id.decode(), ct.decode(),
+                                   self._context_bytes(context))

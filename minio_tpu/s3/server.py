@@ -223,8 +223,8 @@ class S3Server:
         self.healer = None       # BackgroundHealer sweep
         self.mrf = None          # MRFQueue
         self.tracker = None      # DataUpdateTracker (crawler bloom filter)
-        from ..crypto.kms import LocalKMS
-        self.kms = LocalKMS.from_env_or_store(object_layer)
+        from ..crypto.kms import kms_from_env
+        self.kms = kms_from_env(object_layer)
         from ..iam.openid import OpenIDProvider
         self.openid = OpenIDProvider.from_config(self.config)
         from ..iam.ldap import LDAPConfig, LDAPIdentity

@@ -1,0 +1,120 @@
+"""In-process KES stub — implements the /v1/key/{create,generate,
+decrypt} REST API with REAL sealing: per-key random 256-bit secrets, a
+keystream cipher with an HMAC tag, and the request context bound into
+both, so a ciphertext replayed under a different (bucket, object)
+context fails to decrypt exactly as real KES enforces.  Bearer API-key
+auth is verified on every call.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import http.server
+import json
+import os
+import threading
+
+API_KEY = "kes:v1:stub-api-key"
+
+
+def _seal(secret: bytes, context: bytes, plain: bytes) -> bytes:
+    nonce = os.urandom(16)
+    stream = hashlib.sha256(secret + nonce + context).digest()
+    ct = bytes(a ^ b for a, b in zip(plain, stream))
+    tag = hmac.new(secret, nonce + context + ct,
+                   hashlib.sha256).digest()[:16]
+    return nonce + ct + tag
+
+
+def _unseal(secret: bytes, context: bytes, sealed: bytes) -> bytes:
+    if len(sealed) < 32:
+        raise ValueError("short ciphertext")
+    nonce, ct, tag = sealed[:16], sealed[16:-16], sealed[-16:]
+    want = hmac.new(secret, nonce + context + ct,
+                    hashlib.sha256).digest()[:16]
+    if not hmac.compare_digest(want, tag):
+        raise ValueError("decryption failed: context or key mismatch")
+    stream = hashlib.sha256(secret + nonce + context).digest()
+    return bytes(a ^ b for a, b in zip(ct, stream))
+
+
+class KESStubServer:
+    def __init__(self):
+        stub = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, status: int, doc: dict | None = None):
+                body = json.dumps(doc or {}).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.headers.get("Authorization", "") != \
+                        f"Bearer {API_KEY}":
+                    return self._reply(401,
+                                       {"message": "not authorized"})
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                doc = json.loads(self.rfile.read(length) or b"{}")
+                parts = [p for p in self.path.split("/") if p]
+                if len(parts) != 4 or parts[:2] != ["v1", "key"]:
+                    return self._reply(404, {"message": "unknown route"})
+                op, name = parts[2], parts[3]
+                if op == "create":
+                    if name in stub.keys:
+                        return self._reply(
+                            400, {"message": f"key {name} already "
+                                  f"exists"})
+                    stub.keys[name] = os.urandom(32)
+                    return self._reply(200)
+                if name not in stub.keys:
+                    return self._reply(404,
+                                       {"message": f"key {name} does "
+                                        f"not exist"})
+                ctx = base64.b64decode(doc.get("context", ""))
+                if op == "generate":
+                    plain = os.urandom(32)
+                    sealed = _seal(stub.keys[name], ctx, plain)
+                    stub.generated += 1
+                    return self._reply(200, {
+                        "plaintext":
+                            base64.b64encode(plain).decode(),
+                        "ciphertext":
+                            base64.b64encode(sealed).decode()})
+                if op == "decrypt":
+                    try:
+                        plain = _unseal(
+                            stub.keys[name], ctx,
+                            base64.b64decode(doc["ciphertext"]))
+                    except (ValueError, KeyError) as e:
+                        return self._reply(400, {"message": str(e)})
+                    stub.decrypted += 1
+                    return self._reply(
+                        200, {"plaintext":
+                              base64.b64encode(plain).decode()})
+                return self._reply(404, {"message": "unknown op"})
+
+        self._http = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), Handler)
+        self.port = self._http.server_address[1]
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+        self.keys: dict[str, bytes] = {}
+        self.generated = 0
+        self.decrypted = 0
+        self._thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True)
+
+    def start(self) -> "KESStubServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()

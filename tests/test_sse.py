@@ -7,6 +7,7 @@ decryption math, and full HTTP round trips with customer keys.
 
 import base64
 import hashlib
+import os
 
 import pytest
 
@@ -387,3 +388,158 @@ def test_upload_part_copy(client):
             f'</CompleteMultipartUpload>').encode()
     client.request("POST", "/enc/pc.bin", f"uploadId={uid}", body)
     assert client.get_object("enc", "pc.bin").body == src
+
+
+# -- external KMS backends: KES + Vault wire clients (VERDICT r4 #4) -------
+
+def test_kes_kms_roundtrip_and_context_binding():
+    from minio_tpu.crypto.kes import KESKMS
+    from .kes_stub import API_KEY, KESStubServer
+    stub = KESStubServer().start()
+    try:
+        k = KESKMS(stub.endpoint, "sse-key", api_key=API_KEY)
+        ctx = {"bucket": "b", "object": "o"}
+        plain, sealed = k.generate_key(ctx)
+        assert len(plain) == 32
+        # the KEK never exists in this process: the sealed blob holds
+        # no plaintext and only the stub can unseal it
+        assert plain not in base64.b64decode(sealed)
+        assert k.unseal_key(sealed, ctx) == plain
+        with pytest.raises(kms.KMSError):
+            k.unseal_key(sealed, {"bucket": "b", "object": "other"})
+        assert stub.generated == 1 and stub.decrypted == 1
+    finally:
+        stub.stop()
+
+
+def test_kes_bad_api_key_rejected():
+    from minio_tpu.crypto.kes import KESKMS
+    from .kes_stub import KESStubServer
+    stub = KESStubServer().start()
+    try:
+        with pytest.raises(kms.KMSError):
+            KESKMS(stub.endpoint, "k2", api_key="wrong")
+    finally:
+        stub.stop()
+
+
+def test_kes_create_key_idempotent():
+    from minio_tpu.crypto.kes import KESKMS
+    from .kes_stub import API_KEY, KESStubServer
+    stub = KESStubServer().start()
+    try:
+        KESKMS(stub.endpoint, "samekey", api_key=API_KEY)
+        KESKMS(stub.endpoint, "samekey", api_key=API_KEY)  # no raise
+        assert list(stub.keys) == ["samekey"]
+    finally:
+        stub.stop()
+
+
+def test_vault_kms_token_and_approle():
+    from minio_tpu.crypto.vault import VaultKMS
+    from .vault_stub import ROLE_ID, ROOT_TOKEN, SECRET_ID, \
+        VaultStubServer
+    stub = VaultStubServer().start()
+    try:
+        kt = VaultKMS(stub.endpoint, "vkey", token=ROOT_TOKEN)
+        ctx = {"bucket": "vb", "object": "vo"}
+        plain, sealed = kt.generate_key(ctx)
+        assert kt.unseal_key(sealed, ctx) == plain
+        # ciphertext carries the transit prefix
+        _, ct = base64.b64decode(sealed).split(b"\x00", 1)
+        assert ct.startswith(b"vault:v1:")
+        # approle login mints a usable token; context binding holds
+        ka = VaultKMS(stub.endpoint, "vkey", role_id=ROLE_ID,
+                      secret_id=SECRET_ID)
+        assert ka.unseal_key(sealed, ctx) == plain
+        with pytest.raises(kms.KMSError):
+            ka.unseal_key(sealed, {"bucket": "vb", "object": "x"})
+        with pytest.raises(kms.KMSError):
+            VaultKMS(stub.endpoint, "vkey", role_id=ROLE_ID,
+                     secret_id="wrong")
+        with pytest.raises(kms.KMSError):
+            VaultKMS(stub.endpoint, "vkey", token="s.bogus")
+    finally:
+        stub.stop()
+
+
+@pytest.fixture
+def kes_served(tmp_path, monkeypatch):
+    """A full S3 server whose KMS is the stub KES (selected via env,
+    the MT_KMS_KES_ENDPOINT-style config path)."""
+    from .kes_stub import API_KEY, KESStubServer
+    stub = KESStubServer().start()
+    monkeypatch.setenv(kms.KES_ENDPOINT_ENV, stub.endpoint)
+    monkeypatch.setenv(kms.KES_KEY_ENV, "srv-sse")
+    monkeypatch.setenv(kms.KES_APIKEY_ENV, API_KEY)
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"kd{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=128 * 1024,
+                           backend="numpy")
+    srv = S3Server(layer, access_key="testkey", secret_key="testsecret")
+    srv.start()
+    yield srv, stub, tmp_path
+    srv.stop()
+    stub.stop()
+
+
+def test_sse_kms_through_stub_kes_end_to_end(kes_served):
+    srv, stub, root = kes_served
+    from minio_tpu.crypto.kes import KESKMS
+    assert isinstance(srv.kms, KESKMS)          # env selected KES
+    c = S3Client(srv.endpoint, "testkey", "testsecret")
+    c.make_bucket("kesb")
+    data = os.urandom(200_000)
+    c.request("PUT", "/kesb/doc.bin", body=data,
+              headers={"x-amz-server-side-encryption": "aws:kms"})
+    gen_before = stub.generated
+    r = c.get_object("kesb", "doc.bin")
+    assert r.status == 200 and r.body == data
+    assert stub.decrypted >= 1                  # GET unseals VIA KES
+    assert stub.generated == gen_before         # no spurious keygen
+    # key never plaintext at rest: neither the object key nor the KES
+    # data key appears in any on-disk byte; ciphertext != plaintext
+    on_disk = b"".join(
+        p.read_bytes() for p in root.rglob("kd*/**/*") if p.is_file())
+    assert data[:4096] not in on_disk
+    for secret in stub.keys.values():
+        assert secret not in on_disk
+    # losing the KES key makes the object unreadable (the proof the
+    # KEK lives in KES, not in process or on disk)
+    stub.keys.clear()
+    r2 = c.request("GET", "/kesb/doc.bin", expect=())
+    assert r2.status >= 400
+
+
+def test_sse_kms_through_vault_end_to_end(tmp_path, monkeypatch):
+    from minio_tpu.crypto.vault import VaultKMS
+    from .vault_stub import ROLE_ID, SECRET_ID, VaultStubServer
+    stub = VaultStubServer().start()
+    monkeypatch.setenv(kms.VAULT_ENDPOINT_ENV, stub.endpoint)
+    monkeypatch.setenv(kms.VAULT_KEY_ENV, "srv-vault-sse")
+    monkeypatch.setenv(kms.VAULT_ROLE_ID_ENV, ROLE_ID)
+    monkeypatch.setenv(kms.VAULT_SECRET_ID_ENV, SECRET_ID)
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"vd{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=128 * 1024,
+                           backend="numpy")
+    srv = S3Server(layer, access_key="testkey", secret_key="testsecret")
+    srv.start()
+    try:
+        assert isinstance(srv.kms, VaultKMS)
+        c = S3Client(srv.endpoint, "testkey", "testsecret")
+        c.make_bucket("vltb")
+        data = os.urandom(64 * 1024)
+        c.request("PUT", "/vltb/v.bin", body=data,
+                  headers={"x-amz-server-side-encryption": "aws:kms"})
+        r = c.get_object("vltb", "v.bin")
+        assert r.status == 200 and r.body == data
+    finally:
+        srv.stop()
+        stub.stop()

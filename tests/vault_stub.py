@@ -1,0 +1,114 @@
+"""In-process Vault stub — transit engine with real context-bound
+sealing (same cipher discipline as tests/kes_stub.py) plus both auth
+modes the reference's vault.go uses: static token (X-Vault-Token) and
+AppRole login minting a client token.  Ciphertexts carry the
+``vault:v1:`` prefix like the real transit engine.
+"""
+
+from __future__ import annotations
+
+import base64
+import http.server
+import json
+import os
+import secrets
+import threading
+
+from .kes_stub import _seal, _unseal
+
+ROOT_TOKEN = "s.stub-root-token"
+ROLE_ID = "stub-role-id"
+SECRET_ID = "stub-secret-id"
+
+
+class VaultStubServer:
+    def __init__(self):
+        stub = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, status: int, doc: dict | None = None):
+                body = json.dumps(doc or {}).encode() \
+                    if doc is not None else b""
+                self.send_response(status)
+                if body:
+                    self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                doc = json.loads(self.rfile.read(length) or b"{}")
+                parts = [p for p in self.path.split("/") if p]
+                if parts == ["v1", "auth", "approle", "login"]:
+                    if (doc.get("role_id") == ROLE_ID
+                            and doc.get("secret_id") == SECRET_ID):
+                        tok = "s." + secrets.token_hex(12)
+                        stub.tokens.add(tok)
+                        return self._reply(
+                            200, {"auth": {"client_token": tok}})
+                    return self._reply(
+                        400, {"errors": ["invalid role or secret id"]})
+                tok = self.headers.get("X-Vault-Token", "")
+                if tok != ROOT_TOKEN and tok not in stub.tokens:
+                    return self._reply(403,
+                                       {"errors": ["permission denied"]})
+                if len(parts) == 4 and parts[:3] == \
+                        ["v1", "transit", "keys"]:
+                    stub.keys.setdefault(parts[3], os.urandom(32))
+                    return self._reply(204)
+                if len(parts) == 5 and parts[1] == "transit" and \
+                        parts[2] == "datakey" and parts[3] == "plaintext":
+                    name = parts[4]
+                    if name not in stub.keys:
+                        return self._reply(
+                            400, {"errors": ["unknown key"]})
+                    ctx = base64.b64decode(doc.get("context", ""))
+                    plain = os.urandom(32)
+                    sealed = _seal(stub.keys[name], ctx, plain)
+                    return self._reply(200, {"data": {
+                        "plaintext": base64.b64encode(plain).decode(),
+                        "ciphertext": "vault:v1:"
+                        + base64.b64encode(sealed).decode()}})
+                if len(parts) == 4 and parts[:3] == \
+                        ["v1", "transit", "decrypt"]:
+                    name = parts[3]
+                    if name not in stub.keys:
+                        return self._reply(
+                            400, {"errors": ["unknown key"]})
+                    ct = doc.get("ciphertext", "")
+                    if not ct.startswith("vault:v1:"):
+                        return self._reply(
+                            400, {"errors": ["bad ciphertext prefix"]})
+                    ctx = base64.b64decode(doc.get("context", ""))
+                    try:
+                        plain = _unseal(
+                            stub.keys[name], ctx,
+                            base64.b64decode(ct[len("vault:v1:"):]))
+                    except ValueError as e:
+                        return self._reply(400, {"errors": [str(e)]})
+                    return self._reply(200, {"data": {
+                        "plaintext":
+                            base64.b64encode(plain).decode()}})
+                return self._reply(404, {"errors": ["unknown route"]})
+
+        self._http = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), Handler)
+        self.port = self._http.server_address[1]
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+        self.keys: dict[str, bytes] = {}
+        self.tokens: set[str] = set()
+        self._thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True)
+
+    def start(self) -> "VaultStubServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()
