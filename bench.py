@@ -253,6 +253,7 @@ def main() -> None:
             "harness artifact")
 
     e2e = _bench_end_to_end_put()
+    cfg12 = _bench_baseline_configs()
 
     value = round(min(encode_gibps, decode_gibps), 2)
     result = {
@@ -275,6 +276,9 @@ def main() -> None:
             "fused_encode_hh256_GiBps": round(fused_gibps, 2),
             ("e2e_put_256x4MiB_fsync" if _FSYNC_ON
              else "e2e_put_256x4MiB_nofsync"): e2e,
+            # driver BASELINE configs 1 + 2, measured end to end
+            # through the real object layer (r4 verdict #2)
+            "baseline_configs_1_2": cfg12,
             "achieved_int8_TOPS": round(enc_tops, 1),
             "decode_int8_TOPS": round(dec_tops, 1),
             "roofline_pct_of_peak": roofline_pct,
@@ -304,6 +308,124 @@ def main() -> None:
         },
     }
     print(json.dumps(result))
+
+
+def _bench_baseline_configs() -> dict | None:
+    """Driver BASELINE configs 1 and 2, end to end through the real
+    object layer on tmpfs drives (pipeline rate without the throttled
+    virtio disk; see _bench_end_to_end_put's hardware controls):
+
+      1. 4+2 set, 1 MiB blockSize, single 64 MiB object PUT
+         (cmd/erasure-encode_test.go:209-248's geometry driven through
+         putObject, cmd/erasure-object.go:614)
+      2. 8+4 set, 1 MiB blocks, 1 GiB multipart PutObject —
+         NewMultipartUpload -> 64 x 16 MiB PutObjectPart ->
+         CompleteMultipartUpload (cmd/erasure-multipart.go:342)
+
+    Methodology: strict-compat mode (md5 ETag, the client default),
+    fresh object names per iteration (no page recycling), and a host
+    md5 GET round-trip check on the final object of each leg.
+    """
+    import hashlib
+    import os
+    import shutil
+    import sys
+    import tempfile
+    import time
+
+    from minio_tpu.objectlayer.erasure_object import ErasureObjects
+    from minio_tpu.storage.xl_storage import XLStorage
+
+    if not (os.path.isdir("/dev/shm") and os.access("/dev/shm", os.W_OK)):
+        return None
+    prev = os.environ.get("MT_NO_COMPAT")
+    os.environ["MT_NO_COMPAT"] = "0"                # strict compat
+    root = None
+    try:
+        root = tempfile.mkdtemp(prefix="bench-cfg-", dir="/dev/shm")
+
+        def mk(n, parity, sub):
+            ds = []
+            for i in range(n):
+                d = os.path.join(root, sub, f"d{i}")
+                os.makedirs(d)
+                ds.append(XLStorage(d))
+            lay = ErasureObjects(ds, parity=parity, block_size=1 << 20,
+                                 backend="numpy")
+            lay.make_bucket("cfgbkt")
+            return lay
+
+        out = {}
+
+        # best-of-N policy: the 1-vCPU VM shares its core with the
+        # harness; a single timing can land in a contention window
+        # (observed 4x swings run to run)
+        # -- config 1: 4+2, single 64 MiB PUT ----------------------------
+        lay1 = mk(6, 2, "c1")
+        body = os.urandom(64 * (1 << 20))
+        lay1.put_object("cfgbkt", "warm", body)     # warm the code path
+        best1 = 0.0
+        for r in range(3):
+            t0 = time.perf_counter()
+            for i in range(4):
+                lay1.put_object("cfgbkt", f"o{r}-{i}", body)
+            dt = (time.perf_counter() - t0) / 4
+            best1 = max(best1, len(body) / dt / 2**30)
+            if r == 0:
+                got = lay1.get_object("cfgbkt", "o0-3")[1]
+                assert hashlib.md5(bytes(got)).digest() == \
+                    hashlib.md5(body).digest(), \
+                    "config1 round-trip mismatch"
+            # bound tmpfs usage: delete each round's objects after
+            # timing (fresh names keep page allocation honest; the
+            # deletes are outside the timed window)
+            for i in range(4):
+                lay1.delete_object("cfgbkt", f"o{r}-{i}")
+        out["config1_4+2_put_64MiB_GiBps"] = round(best1, 3)
+        shutil.rmtree(os.path.join(root, "c1"), ignore_errors=True)
+
+        # -- config 2: 8+4, 1 GiB multipart ------------------------------
+        lay2 = mk(12, 4, "c2")
+        part = os.urandom(16 * (1 << 20))           # 64 parts x 16 MiB
+        nparts = 64
+
+        def one_multipart(name):
+            uid = lay2.new_multipart_upload("cfgbkt", name)
+            etags = []
+            for pn in range(1, nparts + 1):
+                pi = lay2.put_object_part("cfgbkt", name, uid, pn, part)
+                etags.append((pn, pi.etag))
+            return lay2.complete_multipart_upload("cfgbkt", name, uid,
+                                                  etags)
+
+        one_multipart("mpwarm")                     # warm
+        lay2.delete_object("cfgbkt", "mpwarm")      # bound tmpfs usage
+        best2 = 0.0
+        for r in range(2):
+            t0 = time.perf_counter()
+            oi = one_multipart(f"mpbig{r}")
+            dt = time.perf_counter() - t0
+            assert oi.size == nparts * len(part)
+            best2 = max(best2, nparts * len(part) / dt / 2**30)
+            got0 = lay2.get_object("cfgbkt", f"mpbig{r}", offset=0,
+                                   length=len(part))[1]
+            assert hashlib.md5(bytes(got0)).digest() == \
+                hashlib.md5(part).digest(), "config2 round-trip mismatch"
+            lay2.delete_object("cfgbkt", f"mpbig{r}")
+        out["config2_8+4_multipart_1GiB_GiBps"] = round(best2, 3)
+        out["methodology"] = ("strict compat (md5 ETag), tmpfs drives, "
+                              "fresh names, host-md5 round-trip check")
+        return out
+    except Exception as e:  # noqa: BLE001 — optional leg
+        print(f"baseline-config leg failed: {e!r}", file=sys.stderr)
+        return None
+    finally:
+        if prev is None:
+            os.environ.pop("MT_NO_COMPAT", None)
+        else:
+            os.environ["MT_NO_COMPAT"] = prev
+        if root:
+            shutil.rmtree(root, ignore_errors=True)
 
 
 def _bench_end_to_end_put() -> dict | None:
@@ -487,8 +609,40 @@ def _bench_end_to_end_put() -> dict | None:
                            total / (time.perf_counter() - t0) / 2**30)
             return best
 
+        def fresh_write_floor_ms(root) -> float:
+            """Hardware control for the commit fan-out: 16 FRESH shard
+            files (2 mkdirs + open/write/close each), zero Python
+            framework.  On tmpfs this is dominated by first-touch page
+            allocation — recycled pages measure ~2.5x faster, a rate no
+            real PUT of a new object can reach.  strict PUT's honest
+            single-core ceiling = obj / (t_md5 + this floor)."""
+            dirs = [os.path.join(root, f"floor{i}") for i in range(16)]
+            for d in dirs:
+                os.makedirs(d, exist_ok=True)
+            rows = list(framed2d)
+            seq = [0]
+
+            def one():
+                j = seq[0]
+                seq[0] += 1
+                for i, d in enumerate(dirs):
+                    od = os.path.join(d, f"o{j}", "ddir")
+                    os.makedirs(od)
+                    fd = os.open(os.path.join(od, "part.1"),
+                                 os.O_WRONLY | os.O_CREAT)
+                    try:
+                        os.write(fd, rows[i])
+                    finally:
+                        os.close(fd)
+            one()
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                one()
+            return (time.perf_counter() - t0) / reps * 1000
+
         prev = os.environ.get("MT_NO_COMPAT")
         shm_gibps, shm_strict, shm_get = None, None, None
+        shm_floor_ms = None
         try:
             os.environ["MT_NO_COMPAT"] = "0"
             strict_gibps = best_leg()
@@ -509,6 +663,7 @@ def _bench_end_to_end_put() -> dict | None:
                         os.environ["MT_NO_COMPAT"] = "0"
                         shm_strict = best_leg(shm_layer)
                         shm_get = get_leg(shm_layer)
+                        shm_floor_ms = fresh_write_floor_ms(shm_root)
                     finally:
                         shutil.rmtree(shm_root, ignore_errors=True)
             except Exception as e:  # noqa: BLE001 — optional leg
@@ -549,6 +704,15 @@ def _bench_end_to_end_put() -> dict | None:
             # os.cpu_count() > 1.
             "strict_md5_bound_GiBps": round(
                 obj_size / (t_md5 / 1000) / 2**30, 3),
+            # the tighter honest ceiling: md5 (compat-pinned, serial)
+            # + the fresh-file write floor measured above — both
+            # irreducible on 1 vCPU; everything else (encode, hash,
+            # meta) is the optimizable residue
+            "tmpfs_fresh_write_floor_ms": (round(shm_floor_ms, 2)
+                                           if shm_floor_ms else None),
+            "tmpfs_strict_floor_GiBps": (round(
+                obj_size / ((t_md5 + shm_floor_ms) / 1000) / 2**30, 3)
+                if shm_floor_ms else None),
             "stages_ms_per_4MiB": {
                 "md5_etag(strict only)": round(t_md5, 2),
                 "erasure_encode_into_frames": round(t_encode, 2),

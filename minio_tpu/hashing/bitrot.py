@@ -138,6 +138,13 @@ def fill_framed(framed2d, shard_size: int,
     if algo != HIGHWAYHASH256S:
         return False
     from .highwayhash import hh256_fill
+    F = 32 + shard_size
+    if getattr(framed2d, "ndim", 1) == 2 and framed2d.shape[1] % F == 0 \
+            and framed2d.flags["C_CONTIGUOUS"]:
+        # no short tail frame: row boundaries fall on frame boundaries,
+        # so the whole 2D buffer is one valid frame sequence — hash all
+        # k+m rows in a single GIL-free native pass
+        return hh256_fill(framed2d.reshape(-1), shard_size)
     for row in framed2d:
         if not hh256_fill(row, shard_size):
             return False

@@ -490,13 +490,17 @@ class ErasureObjects(MultipartOps, ObjectLayer):
 
     def _commit_put(self, bucket, object_name, fi, framed, inline,
                     shuffled) -> ObjectInfo:
+        # serialize the version ONCE; each drive patches only its shard
+        # index (the fan-out previously deep-cloned FileInfo+ErasureInfo
+        # per drive — pure Python overhead on the PUT hot path)
+        vdict = None if inline else fi.to_dict()
 
         def write_one(idx_disk):
             idx, disk = idx_disk
-            dfi = FileInfo(**{**fi.__dict__})
-            dfi.erasure = ErasureInfo(**{**fi.erasure.__dict__})
-            dfi.erasure.index = idx + 1
             if inline:
+                dfi = FileInfo(**{**fi.__dict__})
+                dfi.erasure = ErasureInfo(**{**fi.erasure.__dict__})
+                dfi.erasure.index = idx + 1
                 blob = framed[idx]
                 dfi.inline_data = blob if isinstance(blob, bytes) \
                     else bytes(memoryview(blob).cast("B"))
@@ -505,8 +509,10 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             else:
                 # composite commit: one storage call (one RPC on remote
                 # drives), direct final-location write on local ones
-                disk.write_data_commit(bucket, object_name, dfi,
-                                       framed[idx])
+                disk.write_data_commit(bucket, object_name, fi,
+                                       framed[idx],
+                                       shard_index=idx + 1,
+                                       version_dict=vdict)
             return idx
 
         _, errs = self._fanout_indexed(write_one, shuffled)

@@ -126,15 +126,23 @@ class StorageAPI(abc.ABC):
         (cmd/xl-storage.go:1965 RenameData)."""
 
     def write_data_commit(self, volume: str, path: str, fi: FileInfo,
-                          data) -> None:
+                          data, shard_index: int | None = None,
+                          version_dict: dict | None = None) -> None:
         """One-shot single-part PUT commit: part bytes + version merge.
 
         Default composition stages through tmp + rename_data (correct on
         any backend); local drives override with a direct write into the
         final data dir — safe because fi.data_dir is a fresh uuid and the
         version only becomes visible when xl.meta is atomically replaced,
-        the same invariant rename_data relies on."""
+        the same invariant rename_data relies on.  ``shard_index``
+        overrides fi.erasure.index for this drive (the fan-out shares
+        one FileInfo; see XLStorage.write_data_commit)."""
+        from .datatypes import ErasureInfo
         from .xl_storage import SYS_DIR as sys_vol
+        if shard_index is not None and fi.erasure.index != shard_index:
+            fi = FileInfo(**{**fi.__dict__})
+            fi.erasure = ErasureInfo(**{**fi.erasure.__dict__})
+            fi.erasure.index = shard_index
         tmp = self.tmp_dir()
         try:
             self.create_file(sys_vol, f"{tmp}/part.1", data)

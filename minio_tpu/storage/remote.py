@@ -247,10 +247,15 @@ class RemoteStorage(StorageAPI):
     def stat_info_file(self, volume, path):
         return self._call("stat_info_file", volume=volume, path=path)
 
-    def write_data_commit(self, volume, path, fi, data):
+    def write_data_commit(self, volume, path, fi, data,
+                          shard_index=None, version_dict=None):
+        d = dict(version_dict) if version_dict is not None \
+            else fi.to_dict()
+        if shard_index is not None:
+            d["ec"] = dict(d["ec"], index=shard_index)
         self._raw("storage-write",
                   {"volume": volume, "path": path, "op": "commit",
-                   "fi": fi.to_dict()}, bytes(data))
+                   "fi": d}, bytes(data))
 
     # metadata
     def rename_data(self, src_volume, src_path, fi, dst_volume, dst_path):

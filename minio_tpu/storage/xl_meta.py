@@ -52,9 +52,16 @@ class XLMeta:
 
     def add_version(self, fi: FileInfo) -> None:
         """Insert or replace the version ``fi.version_id``; newest first."""
+        self.add_version_dict(fi.to_dict())
+
+    def add_version_dict(self, vd: dict) -> None:
+        """add_version from an already-serialized version dict — the
+        commit fan-out serializes the FileInfo once and patches the
+        per-drive shard index instead of cloning dataclasses 16 times."""
+        vid = vd.get("vid", "")
         self.versions = [v for v in self.versions
-                         if v.get("vid", "") != fi.version_id]
-        self.versions.append(fi.to_dict())
+                         if v.get("vid", "") != vid]
+        self.versions.append(vd)
         self.versions.sort(key=lambda v: v.get("mt", 0), reverse=True)
 
     def delete_version(self, version_id: str) -> str:

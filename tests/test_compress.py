@@ -220,4 +220,9 @@ def test_s2_stream_identifier_accepted():
         _s.pack("<I", crc) + bad_block
     with pytest.raises(C.CompressionError) as ei:
         C.decompress_stream(C._S2_IDENT + chunk)
-    assert "S2-extended" in str(ei.value)
+    # docs/ADR-001-s2-extended-decode.md pins this exact user-visible
+    # message; a reworded gate must update the ADR too
+    assert str(ei.value) == (
+        "S2-extended block opcodes (repeat offsets / large blocks) "
+        "are not supported by this decoder; re-write the object with "
+        "snappy-compatible compression")
