@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import hmac
 import json
+import re
 import time
 import urllib.parse
 import zipfile
@@ -351,7 +352,7 @@ def handle(h, srv, path: str, query: dict, read_body) -> bool:
     if path.startswith(UPLOAD_PREFIX) and h.command == "PUT":
         _handle_upload(h, srv, path, read_body())
         return True
-    if path.startswith(DOWNLOAD_PREFIX) and h.command == "GET":
+    if path.startswith(DOWNLOAD_PREFIX) and h.command in ("GET", "HEAD"):
         _handle_download(h, srv, path, query)
         return True
     if path == ZIP_PATH and h.command == "POST":
@@ -446,15 +447,40 @@ def _handle_download(h, srv, path: str, query: dict) -> None:
     try:
         ak = _verify(srv, _token_of(h, query))
         _allowed(srv, ak, "s3:GetObject", bucket, key)
+        if h.command == "HEAD":
+            # preview probes content type/size without pulling bytes
+            info = srv.layer.get_object_info(bucket, key)
+            h.send_response(200)
+            h.send_header("Content-Type",
+                          info.content_type or "application/octet-stream")
+            h.send_header("Content-Length", str(info.size))
+            h.send_header("Accept-Ranges", "bytes")
+            h.end_headers()
+            return
         info, data = srv.layer.get_object(bucket, key)
+        total = len(data)
+        status = 200
+        rng = h.headers.get("Range", "")
+        m = re.fullmatch(r"bytes=(\d+)-(\d*)", rng.strip()) if rng \
+            else None
+        if m:
+            lo = int(m.group(1))
+            hi = min(int(m.group(2)) if m.group(2) else total - 1,
+                     total - 1)
+            if lo <= hi:
+                data = data[lo:hi + 1]
+                status = 206
         # header values must never carry CR/LF/quotes from an attacker-
         # chosen object key (response-splitting via percent-encoded keys)
         fname = "".join(c for c in key.rpartition("/")[2]
                         if c.isprintable() and c not in '"\\;')
-        h.send_response(200)
+        h.send_response(status)
         h.send_header("Content-Type",
                       info.content_type or "application/octet-stream")
         h.send_header("Content-Length", str(len(data)))
+        if status == 206:
+            h.send_header("Content-Range",
+                          f"bytes {lo}-{hi}/{total}")
         h.send_header("Content-Disposition",
                       f'attachment; filename="{fname or "download"}"')
         h.end_headers()
