@@ -3,14 +3,13 @@ nsq,redis,mysql,postgresql,elasticsearch}.go).
 
 Every kind formats payloads exactly as the reference does (unit-tested)
 and rides the same disk-backed QueueStore store-and-forward when the
-broker is unreachable (pkg/event/target/queuestore.go).  SEVEN of nine
-kinds deliver over OWN wire clients (events/wire.py): AMQP 0-9-1,
-Kafka Produce v0, MQTT 3.1.1, NATS text, nsqd TCP-V2, Redis RESP2, and
-Elasticsearch REST — conformance-tested against frame-parsing stubs
-(tests/broker_stubs.py).  MySQL and PostgreSQL remain format-only and
-*gate* on their client libraries (their wire protocols carry auth/TLS
-handshakes with no in-image oracle); `_deliver` raises TargetError with
-the requirement, and queued events persist for replay.
+broker is unreachable (pkg/event/target/queuestore.go).  ALL NINE kinds
+deliver over OWN wire clients, no SDKs: AMQP 0-9-1, Kafka Produce v0,
+MQTT 3.1.1, NATS text, nsqd TCP-V2, Redis RESP2, Elasticsearch REST
+(events/wire.py), and MySQL protocol v10 + PostgreSQL 3.0
+(events/sqlwire.py) — each conformance-tested against a frame-parsing
+stub that verifies auth (PLAIN / mysql_native_password scramble /
+pg MD5) and applies real state (tests/broker_stubs.py).
 
 Two payload shapes recur across the reference targets:
   * event list:   {"EventName", "Key", "Records":[record]}   (kafka,
@@ -22,7 +21,6 @@ Two payload shapes recur across the reference targets:
 
 from __future__ import annotations
 
-import importlib
 import json
 from typing import Optional
 
@@ -43,24 +41,17 @@ def is_delete(record: dict) -> bool:
 
 
 class BrokeredTarget(StoreForwardTarget):
-    """Broker target base: StoreForwardTarget + the client-library gate."""
+    """Broker target base: StoreForwardTarget over a wire client.
+
+    Every kind overrides _deliver with its own wire client
+    (events/wire.py, events/sqlwire.py); the base raises so a future
+    kind without one fails loudly instead of dropping events."""
 
     KIND = ""
-    CLIENT_MODULE = ""           # import gate
-    CLIENT_HINT = ""
-
-    def _client_lib(self):
-        try:
-            return importlib.import_module(self.CLIENT_MODULE)
-        except ImportError:
-            raise TargetError(
-                f"{self.KIND} target requires {self.CLIENT_HINT} "
-                f"(module {self.CLIENT_MODULE!r} not installed)") from None
 
     def _deliver(self, record: dict) -> None:
-        self._client_lib()
         raise TargetError(
-            f"{self.KIND} broker delivery not available in this build")
+            f"{self.KIND} broker delivery not implemented")
 
 
 class AMQPTarget(BrokeredTarget):
@@ -330,15 +321,44 @@ class SQLTarget(BrokeredTarget):
 
 
 class MySQLTarget(SQLTarget):
+    """Delivery rides the OWN MySQL protocol-v10 client
+    (events/sqlwire.py: handshake + mysql_native_password scramble +
+    COM_QUERY) — no PyMySQL."""
+
     KIND = "mysql"
-    CLIENT_MODULE = "pymysql"
-    CLIENT_HINT = "PyMySQL"
+
+    def _deliver(self, record: dict) -> None:
+        from .sqlwire import (MySQLWireClient, interpolate,
+                              parse_mysql_dsn)
+        from .wire import WireError
+        cfg = parse_mysql_dsn(self.dsn)
+        try:
+            client = MySQLWireClient(**cfg)
+            try:
+                self._ensure_table(client, WireError)
+                sql, params = self.format_statement(record)
+                client.query(interpolate(sql, params))
+            finally:
+                client.close()
+        except (OSError, WireError) as e:
+            raise TargetError(f"mysql delivery failed: {e}") from e
+
+    def _ensure_table(self, client, WireError) -> None:
+        ddl = (self.TABLE_DDL_NAMESPACE if self.fmt == FORMAT_NAMESPACE
+               else self.TABLE_DDL_ACCESS).format(table=self.table)
+        try:
+            client.query(ddl)
+        except WireError as e:
+            if "exist" not in str(e).lower():
+                raise
 
 
 class PostgreSQLTarget(SQLTarget):
+    """Delivery rides the OWN PostgreSQL frontend/backend 3.0 client
+    (events/sqlwire.py: startup + cleartext/MD5 auth + simple Query)
+    — no psycopg2."""
+
     KIND = "postgresql"
-    CLIENT_MODULE = "psycopg2"
-    CLIENT_HINT = "psycopg2"
 
     def format_statement(self, record: dict) -> tuple[str, tuple]:
         sql, params = super().format_statement(record)
@@ -348,6 +368,30 @@ class PostgreSQLTarget(SQLTarget):
                    f"VALUES (%s, %s) ON CONFLICT (key_name) "
                    f"DO UPDATE SET value = EXCLUDED.value")
         return sql, params
+
+    def _deliver(self, record: dict) -> None:
+        from .sqlwire import (PostgresWireClient, interpolate,
+                              parse_pg_conninfo)
+        from .wire import WireError
+        cfg = parse_pg_conninfo(self.dsn)
+        try:
+            client = PostgresWireClient(**cfg)
+            try:
+                ddl = (self.TABLE_DDL_NAMESPACE
+                       if self.fmt == FORMAT_NAMESPACE
+                       else self.TABLE_DDL_ACCESS
+                       ).format(table=self.table)
+                try:
+                    client.query(ddl)
+                except WireError as e:
+                    if "exist" not in str(e).lower():
+                        raise
+                sql, params = self.format_statement(record)
+                client.query(interpolate(sql, params))
+            finally:
+                client.close()
+        except (OSError, WireError) as e:
+            raise TargetError(f"postgresql delivery failed: {e}") from e
 
 
 class ElasticsearchTarget(BrokeredTarget):

@@ -1,0 +1,292 @@
+"""SQL broker wire protocols — own minimal MySQL and PostgreSQL
+clients, no drivers (the events/wire.py pattern applied to the last two
+broker kinds; reference rides go-sql-driver/mysql and lib/pq,
+pkg/event/target/{mysql,postgresql}.go:1).
+
+* ``MySQLWireClient`` — client/server protocol v10: handshake parse,
+  HandshakeResponse41 with ``mysql_native_password`` scramble
+  (SHA1(pass) XOR SHA1(salt+SHA1(SHA1(pass)))), COM_QUERY, OK/ERR
+  packet parse (MySQL internals manual, client/server protocol).
+* ``PostgresWireClient`` — frontend/backend protocol 3.0: startup
+  message, cleartext + MD5 password auth
+  ("md5" + md5hex(md5hex(password+user)+salt)), simple Query,
+  CommandComplete/ErrorResponse/ReadyForQuery walk.
+
+Statements arrive as (sql, params) with %s placeholders (the targets'
+format_statement output); parameters are interpolated client-side with
+string escaping — the only values we ever send are object keys and
+JSON documents, and the conformance stubs parse the final SQL text.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+
+from .wire import WireError
+
+
+def interpolate(sql: str, params: tuple) -> str:
+    """%s placeholders -> quoted, escaped literals."""
+    out = []
+    vals = list(params)
+    for part in sql.split("%s"):
+        out.append(part)
+        if vals:
+            v = str(vals.pop(0))
+            out.append("'" + v.replace("\\", "\\\\")
+                       .replace("'", "''") + "'")
+    if vals:
+        raise WireError("more params than placeholders")
+    return "".join(out)
+
+
+# -- MySQL ------------------------------------------------------------------
+
+_CLIENT_LONG_PASSWORD = 0x1
+_CLIENT_PROTOCOL_41 = 0x200
+_CLIENT_SECURE_CONNECTION = 0x8000
+_CLIENT_PLUGIN_AUTH = 0x80000
+_CLIENT_CONNECT_WITH_DB = 0x8
+
+
+def mysql_native_scramble(password: str, salt: bytes) -> bytes:
+    if not password:
+        return b""
+    h1 = hashlib.sha1(password.encode()).digest()
+    h2 = hashlib.sha1(h1).digest()
+    h3 = hashlib.sha1(salt + h2).digest()
+    return bytes(a ^ b for a, b in zip(h1, h3))
+
+
+class MySQLWireClient:
+    def __init__(self, host: str, port: int, user: str, password: str,
+                 database: str = "", timeout: float = 5.0):
+        self.sock = socket.create_connection((host, port),
+                                             timeout=timeout)
+        self._buf = b""
+        self._seq = 0
+        self._handshake(user, password, database)
+
+    def _recv_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise WireError("connection closed by mysql")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _read_packet(self) -> bytes:
+        hdr = self._recv_exact(4)
+        ln = hdr[0] | (hdr[1] << 8) | (hdr[2] << 16)
+        self._seq = (hdr[3] + 1) & 0xFF
+        return self._recv_exact(ln)
+
+    def _send_packet(self, payload: bytes) -> None:
+        ln = len(payload)
+        self.sock.sendall(bytes([ln & 0xFF, (ln >> 8) & 0xFF,
+                                 (ln >> 16) & 0xFF, self._seq])
+                          + payload)
+        self._seq = (self._seq + 1) & 0xFF
+
+    @staticmethod
+    def _check_err(pkt: bytes) -> None:
+        if pkt and pkt[0] == 0xFF:
+            code = struct.unpack("<H", pkt[1:3])[0]
+            msg = pkt[3:].decode(errors="replace")
+            if msg.startswith("#"):
+                msg = msg[6:]                     # strip SQL state
+            raise WireError(f"mysql error {code}: {msg}")
+
+    def _handshake(self, user: str, password: str, db: str) -> None:
+        pkt = self._read_packet()
+        self._check_err(pkt)
+        if pkt[0] != 10:
+            raise WireError(f"unsupported mysql protocol {pkt[0]}")
+        i = 1
+        i = pkt.index(b"\x00", i) + 1             # server version
+        i += 4                                     # thread id
+        salt = pkt[i:i + 8]
+        i += 8 + 1                                 # filler
+        i += 2 + 1 + 2 + 2 + 1 + 10                # caps/charset/status
+        # auth-plugin-data part 2: documented as max 13 bytes with a
+        # trailing NUL; the scramble is 20 bytes total
+        rest = pkt[i:]
+        salt += rest.split(b"\x00", 1)[0][:12]
+        caps = (_CLIENT_LONG_PASSWORD | _CLIENT_PROTOCOL_41
+                | _CLIENT_SECURE_CONNECTION | _CLIENT_PLUGIN_AUTH)
+        if db:
+            caps |= _CLIENT_CONNECT_WITH_DB
+        token = mysql_native_scramble(password, salt)
+        payload = (struct.pack("<IIB", caps, 1 << 24, 33)
+                   + b"\x00" * 23 + user.encode() + b"\x00"
+                   + bytes([len(token)]) + token
+                   + ((db.encode() + b"\x00") if db else b"")
+                   + b"mysql_native_password\x00")
+        self._send_packet(payload)
+        resp = self._read_packet()
+        self._check_err(resp)
+        if resp[0] != 0x00:
+            raise WireError(f"unexpected auth response {resp[0]:#x}")
+
+    def query(self, sql: str) -> int:
+        """Execute a statement; returns affected rows (OK packet)."""
+        self._seq = 0
+        self._send_packet(b"\x03" + sql.encode())
+        resp = self._read_packet()
+        self._check_err(resp)
+        if resp[0] != 0x00:
+            raise WireError("statement returned a result set "
+                            "(only OK expected)")
+        # affected rows: length-encoded int right after the 0x00 header
+        v = resp[1]
+        if v < 0xFB:
+            return v
+        if v == 0xFC:
+            return struct.unpack("<H", resp[2:4])[0]
+        return 0
+
+    def close(self) -> None:
+        try:
+            self._seq = 0
+            self._send_packet(b"\x01")            # COM_QUIT
+        except OSError:
+            pass
+        self.sock.close()
+
+
+# -- PostgreSQL -------------------------------------------------------------
+
+class PostgresWireClient:
+    def __init__(self, host: str, port: int, user: str, password: str,
+                 database: str = "", timeout: float = 5.0):
+        self.sock = socket.create_connection((host, port),
+                                             timeout=timeout)
+        self._buf = b""
+        self.user = user
+        self._startup(user, password, database or user)
+
+    def _recv_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise WireError("connection closed by postgres")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _read_msg(self) -> tuple[bytes, bytes]:
+        t = self._recv_exact(1)
+        ln = struct.unpack(">I", self._recv_exact(4))[0]
+        return t, self._recv_exact(ln - 4)
+
+    def _send_msg(self, t: bytes, body: bytes) -> None:
+        self.sock.sendall(t + struct.pack(">I", len(body) + 4) + body)
+
+    @staticmethod
+    def _err_text(body: bytes) -> str:
+        fields = {}
+        for part in body.split(b"\x00"):
+            if part:
+                fields[chr(part[0])] = part[1:].decode(errors="replace")
+        return fields.get("M", "unknown error")
+
+    def _startup(self, user: str, password: str, db: str) -> None:
+        body = (struct.pack(">I", 196608)          # protocol 3.0
+                + b"user\x00" + user.encode() + b"\x00"
+                + b"database\x00" + db.encode() + b"\x00\x00")
+        self.sock.sendall(struct.pack(">I", len(body) + 4) + body)
+        while True:
+            t, payload = self._read_msg()
+            if t == b"E":
+                raise WireError(
+                    f"postgres error: {self._err_text(payload)}")
+            if t == b"R":
+                kind = struct.unpack(">I", payload[:4])[0]
+                if kind == 0:                      # AuthenticationOk
+                    continue
+                if kind == 3:                      # cleartext
+                    self._send_msg(b"p", password.encode() + b"\x00")
+                    continue
+                if kind == 5:                      # md5
+                    salt = payload[4:8]
+                    inner = hashlib.md5(
+                        password.encode() + user.encode()).hexdigest()
+                    outer = hashlib.md5(
+                        inner.encode() + salt).hexdigest()
+                    self._send_msg(b"p", b"md5" + outer.encode()
+                                   + b"\x00")
+                    continue
+                raise WireError(f"unsupported pg auth kind {kind}")
+            if t == b"Z":                          # ReadyForQuery
+                return
+            # ParameterStatus (S), BackendKeyData (K): skip
+
+    def query(self, sql: str) -> str:
+        """Simple-protocol statement; returns the command tag."""
+        self._send_msg(b"Q", sql.encode() + b"\x00")
+        tag, err = "", None
+        while True:
+            t, payload = self._read_msg()
+            if t == b"C":
+                tag = payload.rstrip(b"\x00").decode()
+            elif t == b"E":
+                err = self._err_text(payload)
+            elif t == b"Z":
+                if err:
+                    raise WireError(f"postgres error: {err}")
+                return tag
+            # row data (T/D) is skipped: write-only client
+
+    def close(self) -> None:
+        try:
+            self._send_msg(b"X", b"")              # Terminate
+        except OSError:
+            pass
+        self.sock.close()
+
+
+# -- DSN parsing ------------------------------------------------------------
+
+def parse_mysql_dsn(dsn: str) -> dict:
+    """go-sql-driver form: user:pass@tcp(host:port)/dbname."""
+    creds, _, rest = dsn.rpartition("@")
+    user, _, password = creds.partition(":")
+    host, port, db = "127.0.0.1", 3306, ""
+    if rest.startswith("tcp("):
+        addr, _, tail = rest[4:].partition(")")
+        h, _, p = addr.partition(":")
+        host = h or host
+        port = int(p or port)
+        db = tail.lstrip("/")
+    else:
+        h, _, db = rest.partition("/")
+        if h:
+            hh, _, p = h.partition(":")
+            host = hh or host
+            port = int(p or port)
+    return {"host": host, "port": port, "user": user,
+            "password": password, "database": db}
+
+
+def parse_pg_conninfo(conninfo: str) -> dict:
+    """libpq keyword form: host=.. port=.. user=.. password=.. dbname=..
+    (URL form postgres://u:p@h:p/db also accepted)."""
+    if conninfo.startswith(("postgres://", "postgresql://")):
+        from urllib.parse import urlsplit
+        u = urlsplit(conninfo)
+        return {"host": u.hostname or "127.0.0.1",
+                "port": u.port or 5432, "user": u.username or "",
+                "password": u.password or "",
+                "database": u.path.lstrip("/")}
+    kv = {}
+    for part in conninfo.split():
+        k, _, v = part.partition("=")
+        kv[k] = v
+    return {"host": kv.get("host", "127.0.0.1"),
+            "port": int(kv.get("port", 5432)),
+            "user": kv.get("user", ""),
+            "password": kv.get("password", ""),
+            "database": kv.get("dbname", kv.get("user", ""))}

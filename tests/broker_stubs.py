@@ -630,3 +630,212 @@ class ESStubServer:
     def stop(self) -> None:
         self._http.shutdown()
         self._http.server_close()
+
+
+# -- SQL stubs (MySQL protocol v10 / PostgreSQL 3.0) -----------------------
+
+import hashlib as _hashlib
+import re as _re
+
+
+class _SQLState:
+    """Shared statement applier: parses the targets' three fixed
+    statement shapes into real dict/list state (namespace upsert/
+    delete, access append)."""
+
+    def __init__(self):
+        self.tables: dict[str, dict] = {}     # namespace: key -> value
+        self.logs: dict[str, list] = {}       # access: [(ts, doc)]
+        self.statements: list[str] = []
+
+    @staticmethod
+    def _unq(s: str) -> str:
+        return s.replace("''", "'").replace("\\\\", "\\")
+
+    def apply(self, sql: str) -> str:
+        """Returns a command tag; raises ValueError on bad SQL."""
+        self.statements.append(sql)
+        s = sql.strip().rstrip(";")
+        m = _re.match(r"CREATE TABLE (\w+) ", s)
+        if m:
+            t = m.group(1)
+            if t in self.tables or t in self.logs:
+                raise ValueError(f'table "{t}" already exists')
+            if "key_name" in s:
+                self.tables[t] = {}
+            else:
+                self.logs[t] = []
+            return "CREATE TABLE"
+        m = _re.match(r"(?:REPLACE INTO|INSERT INTO) (\w+) "
+                      r"\(key_name, value\) VALUES "
+                      r"\('((?:[^']|'')*)', '((?:[^']|'')*)'\)"
+                      r"(?: ON CONFLICT .*)?$", s, _re.S)
+        if m:
+            t, k, v = m.group(1), self._unq(m.group(2)), \
+                self._unq(m.group(3))
+            if t not in self.tables:
+                raise ValueError(f'table "{t}" does not exist')
+            self.tables[t][k] = v
+            return "INSERT 0 1"
+        m = _re.match(r"DELETE FROM (\w+) WHERE key_name = "
+                      r"'((?:[^']|'')*)'$", s)
+        if m:
+            t, k = m.group(1), self._unq(m.group(2))
+            if t not in self.tables:
+                raise ValueError(f'table "{t}" does not exist')
+            existed = k in self.tables[t]
+            self.tables[t].pop(k, None)
+            return f"DELETE {int(existed)}"
+        m = _re.match(r"INSERT INTO (\w+) \(event_time, event_data\) "
+                      r"VALUES \('((?:[^']|'')*)', '((?:[^']|'')*)'\)$",
+                      s, _re.S)
+        if m:
+            t = m.group(1)
+            if t not in self.logs:
+                raise ValueError(f'table "{t}" does not exist')
+            self.logs[t].append((self._unq(m.group(2)),
+                                 self._unq(m.group(3))))
+            return "INSERT 0 1"
+        raise ValueError(f"unparseable statement: {s[:80]}")
+
+
+class MySQLStubBroker(_TCPStub):
+    """Speaks MySQL client/server protocol v10: HandshakeV10 with a
+    real 20-byte salt, verifies the mysql_native_password scramble,
+    answers COM_QUERY with OK/ERR packets."""
+
+    def __init__(self, user: str = "evuser", password: str = "evpass"):
+        super().__init__()
+        self.user = user
+        self.password = password
+        self.sql = _SQLState()
+        self.auth_failures = 0
+
+    def _session(self, conn):
+        import os as _os
+        from minio_tpu.events.sqlwire import mysql_native_scramble
+        recv_exact, _ = self._reader(conn)
+        seq = [0]
+
+        def send_pkt(payload):
+            ln = len(payload)
+            conn.sendall(bytes([ln & 255, (ln >> 8) & 255,
+                                (ln >> 16) & 255, seq[0]]) + payload)
+            seq[0] = (seq[0] + 1) & 255
+
+        def read_pkt():
+            hdr = recv_exact(4)
+            seq[0] = (hdr[3] + 1) & 255
+            return recv_exact(hdr[0] | (hdr[1] << 8) | (hdr[2] << 16))
+
+        def ok(affected=0):
+            send_pkt(b"\x00" + bytes([affected]) + b"\x00"
+                     + struct.pack("<HH", 2, 0))
+
+        def err(code, msg):
+            send_pkt(b"\xff" + struct.pack("<H", code) + b"#42000"
+                     + msg.encode())
+
+        # real MySQL servers generate NUL-free scramble bytes (clients
+        # NUL-terminate-parse auth-plugin-data part 2) — a stray \x00
+        # here would make the client truncate the salt and fail auth
+        salt = bytes(b % 255 + 1 for b in _os.urandom(20))
+        send_pkt(b"\x0a" + b"8.0-stub\x00" + struct.pack("<I", 7)
+                 + salt[:8] + b"\x00" + struct.pack("<H", 0xFFFF)
+                 + b"\x21" + struct.pack("<H", 2)
+                 + struct.pack("<H", 0xFFFF) + bytes([21])
+                 + b"\x00" * 10 + salt[8:] + b"\x00"
+                 + b"mysql_native_password\x00")
+        resp = read_pkt()
+        i = 4 + 4 + 1 + 23
+        user_end = resp.index(b"\x00", i)
+        user = resp[i:user_end].decode()
+        i = user_end + 1
+        tlen = resp[i]
+        token = resp[i + 1:i + 1 + tlen]
+        want = mysql_native_scramble(self.password, salt)
+        if user != self.user or token != want:
+            self.auth_failures += 1
+            err(1045, f"Access denied for user '{user}'")
+            return
+        ok()
+        while True:
+            pkt = read_pkt()
+            if not pkt or pkt[0] == 0x01:          # COM_QUIT
+                return
+            if pkt[0] != 0x03:                     # COM_QUERY only
+                err(1047, "unknown command")
+                continue
+            try:
+                tag = self.sql.apply(pkt[1:].decode())
+                n = 1 if tag.startswith(("INSERT", "DELETE 1")) else 0
+                ok(n)
+            except ValueError as e:
+                code = 1050 if "already exists" in str(e) else 1064
+                err(code, str(e))
+
+
+class PostgresStubBroker(_TCPStub):
+    """Speaks PostgreSQL frontend/backend 3.0: startup parse, MD5
+    password auth with a real salt, simple Query with CommandComplete/
+    ErrorResponse/ReadyForQuery."""
+
+    def __init__(self, user: str = "evuser", password: str = "evpass"):
+        super().__init__()
+        self.user = user
+        self.password = password
+        self.sql = _SQLState()
+        self.auth_failures = 0
+
+    def _session(self, conn):
+        import os as _os
+        recv_exact, _ = self._reader(conn)
+
+        def send(t, body):
+            conn.sendall(t + struct.pack(">I", len(body) + 4) + body)
+
+        def read_msg():
+            t = recv_exact(1)
+            ln = struct.unpack(">I", recv_exact(4))[0]
+            return t, recv_exact(ln - 4)
+
+        def send_err(msg):
+            send(b"E", b"SERROR\x00C42601\x00M" + msg.encode()
+                 + b"\x00\x00")
+
+        ln = struct.unpack(">I", recv_exact(4))[0]
+        startup = recv_exact(ln - 4)
+        proto = struct.unpack(">I", startup[:4])[0]
+        assert proto == 196608, f"bad protocol {proto:#x}"
+        kv = startup[4:].split(b"\x00")
+        params = {kv[i].decode(): kv[i + 1].decode()
+                  for i in range(0, len(kv) - 1, 2) if kv[i]}
+        salt = _os.urandom(4)
+        send(b"R", struct.pack(">I", 5) + salt)    # MD5 auth request
+        t, body = read_msg()
+        assert t == b"p", t
+        got = body.rstrip(b"\x00").decode()
+        inner = _hashlib.md5((self.password + self.user)
+                             .encode()).hexdigest()
+        want = "md5" + _hashlib.md5(inner.encode() + salt).hexdigest()
+        if params.get("user") != self.user or got != want:
+            self.auth_failures += 1
+            send_err("password authentication failed")
+            return
+        send(b"R", struct.pack(">I", 0))           # AuthenticationOk
+        send(b"S", b"server_version\x0016.0-stub\x00")
+        send(b"Z", b"I")
+        while True:
+            t, body = read_msg()
+            if t == b"X":
+                return
+            if t != b"Q":
+                send_err(f"unsupported message {t!r}")
+                send(b"Z", b"I")
+                continue
+            try:
+                tag = self.sql.apply(body.rstrip(b"\x00").decode())
+                send(b"C", tag.encode() + b"\x00")
+            except ValueError as e:
+                send_err(str(e))
+            send(b"Z", b"I")
