@@ -10,7 +10,8 @@ IAM, and admin frontend run unchanged on top of it, and the disk cache
 (objectlayer/diskcache.py) can wrap it exactly as the reference deploys
 cacheObjects in front of gateway backends (cmd/disk-cache.go:88).
 
-Backends whose client SDKs are not in this image (azure, gcs) register
+Every backend speaks its own wire protocol (azure SharedKey, gcs
+JSON/upload, hdfs WebHDFS) — no SDKs; backends register
 as *gated*: constructing them raises GatewayNotAvailable with the
 reason, mirroring how the reference compiles them in but fails at
 startup without credentials/connectivity.
@@ -112,9 +113,9 @@ def lookup(kind: str) -> type:
             f"{', '.join(sorted(_REGISTRY))}") from None
 
 
-from . import (nas, s3, cloud, memory,  # noqa: E402  (populate registry)
-               azure, gcs)
+from . import (nas, s3, memory,  # noqa: E402  (populate registry)
+               azure, gcs, hdfs)
 
 __all__ = ["Gateway", "GatewayError", "GatewayNotAvailable",
            "GatewayUnsupported", "register", "lookup", "nas", "s3",
-           "cloud", "memory", "azure", "gcs"]
+           "memory", "azure", "gcs", "hdfs"]

@@ -1,0 +1,200 @@
+"""In-process WebHDFS stub — a namenode+datanode pair in one HTTP
+server with a REAL filesystem tree and the protocol's two-step
+redirect: CREATE/OPEN/APPEND against the namenode role answer 307 to a
+datanode URL (same server, ``datanode=true`` marker); only the
+datanode role accepts/serves bytes, so a client that skips the
+redirect dance fails.  RemoteException error bodies match the real
+wire shape.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import threading
+from urllib.parse import parse_qs, unquote, urlsplit
+
+
+class _Node:
+    def __init__(self, is_dir: bool, data: bytes = b""):
+        self.is_dir = is_dir
+        self.data = data
+        self.mtime = 1722400000000        # ms, fixed-ish for tests
+        self.children: dict[str, _Node] = {} if is_dir else None
+
+
+class HDFSStubServer:
+    def __init__(self):
+        stub = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, status, doc=None, raw: bytes | None = None,
+                       location: str | None = None):
+                body = raw if raw is not None else (
+                    json.dumps(doc).encode() if doc is not None else b"")
+                self.send_response(status)
+                if location:
+                    self.send_header("Location", location)
+                if doc is not None:
+                    self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def _exc(self, status, exception, message):
+                self._reply(status, {"RemoteException": {
+                    "exception": exception,
+                    "javaClassName": f"org.apache.hadoop.{exception}",
+                    "message": message}})
+
+            def _route(self):
+                u = urlsplit(self.path)
+                if not u.path.startswith("/webhdfs/v1"):
+                    return self._exc(404, "FileNotFoundException",
+                                     u.path)
+                path = unquote(u.path[len("/webhdfs/v1"):]) or "/"
+                q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                op = q.get("op", "").upper()
+                if "user.name" not in q:
+                    return self._exc(401, "SecurityException",
+                                     "authentication required")
+                ln = int(self.headers.get("Content-Length", 0) or 0)
+                body = self.rfile.read(ln) if ln else b""
+                is_dn = q.get("datanode") == "true"
+                try:
+                    return stub._op(self, op, path, q, body, is_dn)
+                except KeyError:
+                    return self._exc(404, "FileNotFoundException",
+                                     f"File does not exist: {path}")
+
+            do_GET = do_PUT = do_POST = do_DELETE = _route
+
+        self._http = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), Handler)
+        self.port = self._http.server_address[1]
+        self.endpoint = f"http://127.0.0.1:{self.port}"
+        self.root = _Node(True)
+        self.redirects = 0            # proves the two-step dance ran
+        self._thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True)
+
+    # -- tree helpers -----------------------------------------------------
+
+    def _resolve(self, path: str) -> _Node:
+        node = self.root
+        for part in [p for p in path.split("/") if p]:
+            if not node.is_dir:
+                raise KeyError(path)
+            node = node.children[part]
+        return node
+
+    def _parent(self, path: str, create: bool = False):
+        parts = [p for p in path.split("/") if p]
+        node = self.root
+        for part in parts[:-1]:
+            if part not in node.children:
+                if not create:
+                    raise KeyError(path)
+                node.children[part] = _Node(True)
+            node = node.children[part]
+            if not node.is_dir:
+                raise KeyError(path)
+        return node, (parts[-1] if parts else "")
+
+    @staticmethod
+    def _status_doc(name: str, node: _Node) -> dict:
+        return {"pathSuffix": name,
+                "type": "DIRECTORY" if node.is_dir else "FILE",
+                "length": 0 if node.is_dir else len(node.data),
+                "modificationTime": node.mtime,
+                "replication": 1, "blockSize": 134217728,
+                "owner": "minio-tpu", "group": "supergroup",
+                "permission": "755"}
+
+    # -- op dispatch ------------------------------------------------------
+
+    def _op(self, h, op, path, q, body, is_dn):
+        if op == "MKDIRS":
+            parent, leaf = self._parent(path, create=True)
+            if leaf:
+                parent.children.setdefault(leaf, _Node(True))
+            return h._reply(200, {"boolean": True})
+        if op == "GETFILESTATUS":
+            node = self._resolve(path)
+            return h._reply(200, {"FileStatus":
+                                  self._status_doc("", node)})
+        if op == "LISTSTATUS":
+            node = self._resolve(path)
+            if not node.is_dir:
+                docs = [self._status_doc("", node)]
+            else:
+                docs = [self._status_doc(n, c)
+                        for n, c in sorted(node.children.items())]
+            return h._reply(200, {"FileStatuses": {"FileStatus": docs}})
+        if op == "DELETE":
+            parent, leaf = self._parent(path)
+            node = parent.children.get(leaf)
+            if node is None:
+                return h._reply(200, {"boolean": False})
+            if node.is_dir and node.children and \
+                    q.get("recursive") != "true":
+                return self._exc_of(h, 403, "PathIsNotEmptyDirectory",
+                                    path)
+            del parent.children[leaf]
+            return h._reply(200, {"boolean": True})
+        if op == "RENAME":
+            parent, leaf = self._parent(path)
+            node = parent.children.pop(leaf)
+            dparent, dleaf = self._parent(q["destination"], create=True)
+            dparent.children[dleaf] = node
+            return h._reply(200, {"boolean": True})
+        if op in ("CREATE", "APPEND", "OPEN"):
+            if not is_dn:
+                # namenode role: redirect to the "datanode" (us)
+                self.redirects += 1
+                sep = "&" if h.path.find("?") >= 0 else "?"
+                return h._reply(307, location=self.endpoint + h.path
+                                + sep + "datanode=true")
+            if op == "CREATE":
+                parent, leaf = self._parent(path, create=True)
+                if leaf in parent.children and \
+                        q.get("overwrite") != "true":
+                    return self._exc_of(
+                        h, 403, "FileAlreadyExistsException", path)
+                parent.children[leaf] = _Node(False, body)
+                return h._reply(201)
+            if op == "APPEND":
+                node = self._resolve(path)
+                if node.is_dir:
+                    raise KeyError(path)
+                node.data += body
+                return h._reply(200)
+            node = self._resolve(path)
+            if node.is_dir:
+                raise KeyError(path)
+            off = int(q.get("offset", 0) or 0)
+            ln = q.get("length")
+            data = node.data[off:off + int(ln)] if ln else \
+                node.data[off:]
+            return h._reply(200, raw=data)
+        return self._exc_of(h, 400, "IllegalArgumentException",
+                            f"unknown op {op}")
+
+    @staticmethod
+    def _exc_of(h, status, exception, message):
+        return h._reply(status, {"RemoteException": {
+            "exception": exception, "message": str(message)}})
+
+    def start(self) -> "HDFSStubServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()

@@ -47,19 +47,15 @@ def test_registry_kinds():
         gw.lookup("bogus")
 
 
-def test_gated_hdfs_gateway():
-    g = gw.lookup("hdfs")("some-target")
-    assert not g.production()
-    with pytest.raises(gw.GatewayNotAvailable):
-        g.new_gateway_layer()
-
-
 def test_cloud_gateways_need_credentials(monkeypatch):
-    """azure/gcs are real wire gateways now; constructing a layer
-    without credentials fails loudly with what is needed."""
+    """azure/gcs/hdfs are real wire gateways; constructing a layer
+    without credentials/endpoint fails loudly with what is needed."""
     for var in ("AZURE_STORAGE_ENDPOINT", "AZURE_STORAGE_ACCOUNT",
-                "AZURE_STORAGE_KEY", "GOOGLE_OAUTH_TOKEN"):
+                "AZURE_STORAGE_KEY", "GOOGLE_OAUTH_TOKEN",
+                "HDFS_NAMENODE_URL"):
         monkeypatch.delenv(var, raising=False)
+    with pytest.raises(gw.GatewayNotAvailable, match="HDFS_NAMENODE"):
+        gw.lookup("hdfs")().new_gateway_layer()
     with pytest.raises(gw.GatewayNotAvailable, match="AZURE_STORAGE"):
         gw.lookup("azure")().new_gateway_layer()
     with pytest.raises(gw.GatewayNotAvailable, match="GOOGLE_OAUTH"):
