@@ -173,9 +173,18 @@ static void hh_update_many_avx2(HHState* s, const uint8_t* data,
 }
 
 static int hh_have_avx2(void) {
+  /* relaxed atomics: the lazy `static int have = -1; if (have < 0)`
+     formulation is a C data race (ThreadSanitizer tier caught it —
+     concurrent first calls from the GIL-released drive fan-out);
+     the value is idempotent, so racing initializers are fine as long
+     as the accesses themselves are atomic */
   static int have = -1;
-  if (have < 0) have = __builtin_cpu_supports("avx2") ? 1 : 0;
-  return have;
+  int v = __atomic_load_n(&have, __ATOMIC_RELAXED);
+  if (v < 0) {
+    v = __builtin_cpu_supports("avx2") ? 1 : 0;
+    __atomic_store_n(&have, v, __ATOMIC_RELAXED);
+  }
+  return v;
 }
 #endif
 
