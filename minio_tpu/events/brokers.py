@@ -387,7 +387,8 @@ class PostgreSQLTarget(SQLTarget):
                     if "exist" not in str(e).lower():
                         raise
                 sql, params = self.format_statement(record)
-                client.query(interpolate(sql, params))
+                client.query(interpolate(sql, params,
+                                         backslash_escapes=False))
             finally:
                 client.close()
         except (OSError, WireError) as e:

@@ -27,16 +27,24 @@ import struct
 from .wire import WireError
 
 
-def interpolate(sql: str, params: tuple) -> str:
-    """%s placeholders -> quoted, escaped literals."""
+def interpolate(sql: str, params: tuple,
+                backslash_escapes: bool = True) -> str:
+    """%s placeholders -> quoted, escaped literals.
+
+    ``backslash_escapes``: MySQL treats backslash as an escape inside
+    string literals by default, so it must be doubled; PostgreSQL with
+    standard_conforming_strings=on (the default since 9.1) treats it
+    literally — doubling there would corrupt every JSON payload
+    containing \\" or \\uXXXX escapes."""
     out = []
     vals = list(params)
     for part in sql.split("%s"):
         out.append(part)
         if vals:
             v = str(vals.pop(0))
-            out.append("'" + v.replace("\\", "\\\\")
-                       .replace("'", "''") + "'")
+            if backslash_escapes:
+                v = v.replace("\\", "\\\\")
+            out.append("'" + v.replace("'", "''") + "'")
     if vals:
         raise WireError("more params than placeholders")
     return "".join(out)
@@ -128,6 +136,24 @@ class MySQLWireClient:
         self._send_packet(payload)
         resp = self._read_packet()
         self._check_err(resp)
+        if resp and resp[0] == 0xFE:
+            # AuthSwitchRequest: plugin name NUL, then new auth data.
+            # mysql_native_password switches are answerable (MySQL 8
+            # sends one when the account plugin differs from ours);
+            # anything else (caching_sha2_password needs RSA/TLS) is
+            # named in the error so the operator knows the fix.
+            rest2 = resp[1:]
+            plugin, _, authdata = rest2.partition(b"\x00")
+            pname = plugin.decode(errors="replace")
+            if pname != "mysql_native_password":
+                raise WireError(
+                    f"server requires auth plugin {pname!r}; only "
+                    f"mysql_native_password is supported — alter the "
+                    f"account to use it")
+            self._send_packet(mysql_native_scramble(
+                password, authdata.rstrip(b"\x00")[:20]))
+            resp = self._read_packet()
+            self._check_err(resp)
         if resp[0] != 0x00:
             raise WireError(f"unexpected auth response {resp[0]:#x}")
 
@@ -251,7 +277,8 @@ class PostgresWireClient:
 # -- DSN parsing ------------------------------------------------------------
 
 def parse_mysql_dsn(dsn: str) -> dict:
-    """go-sql-driver form: user:pass@tcp(host:port)/dbname."""
+    """go-sql-driver form: user:pass@tcp(host:port)/dbname[?params]."""
+    dsn = dsn.partition("?")[0]          # driver params are not schema
     creds, _, rest = dsn.rpartition("@")
     user, _, password = creds.partition(":")
     host, port, db = "127.0.0.1", 3306, ""

@@ -69,14 +69,19 @@ class WebHDFSClient:
         return ("/webhdfs/v1" + quote(path) + "?" + urlencode(q))
 
     def _request(self, method: str, url: str, body: bytes | None = None,
-                 netloc: str | None = None,
-                 follow: bool = True) -> tuple[int, dict, bytes]:
+                 netloc: str | None = None, follow: bool = True,
+                 defer_body: bool = False) -> tuple[int, dict, bytes]:
+        """``defer_body``: the WebHDFS two-step flow sends step 1 to
+        the namenode WITHOUT the data (it answers 307 without reading
+        a body — shipping bytes there risks EPIPE and doubles the
+        upload); only the datanode replay carries the payload."""
         conn = self._conn(netloc or self.host)
+        send_body = None if defer_body else body
         try:
-            conn.request(method, url, body=body,
+            conn.request(method, url, body=send_body,
                          headers={"Content-Type":
                                   "application/octet-stream"}
-                         if body is not None else {})
+                         if send_body is not None else {})
             resp = conn.getresponse()
             data = resp.read()
             headers = dict(resp.getheaders())
@@ -88,6 +93,11 @@ class WebHDFSClient:
                     method, loc.path + ("?" + loc.query
                                         if loc.query else ""),
                     body=body, netloc=loc.netloc, follow=False)
+            if defer_body and body is not None and resp.status < 300:
+                raise HDFSError(
+                    resp.status, "ProtocolError",
+                    "namenode accepted a write op without the "
+                    "datanode redirect — data was never sent")
             if resp.status >= 400:
                 exc, msg = "", ""
                 try:
@@ -109,13 +119,15 @@ class WebHDFSClient:
 
     def create(self, path: str, body: bytes,
                overwrite: bool = True) -> None:
-        # two-step: namenode 307 -> datanode PUT with the bytes
+        # two-step: empty PUT to the namenode, 307 -> datanode PUT
+        # with the bytes
         self._request("PUT", self._url(
             path, "CREATE", overwrite=str(bool(overwrite)).lower()),
-            body=body)
+            body=body, defer_body=True)
 
     def append(self, path: str, body: bytes) -> None:
-        self._request("POST", self._url(path, "APPEND"), body=body)
+        self._request("POST", self._url(path, "APPEND"), body=body,
+                      defer_body=True)
 
     def open(self, path: str, offset: int = 0,
              length: int | None = None) -> bytes:
@@ -253,6 +265,19 @@ class HDFSObjects(GatewayUnsupported, ObjectLayer):
                       opts: ObjectOptions | None = None) -> ObjectInfo:
         self._stat_object(bucket, object_name)
         self.client.delete(self._o(bucket, object_name))
+        # prune now-empty parent dirs up to the bucket root so deleted
+        # prefixes don't linger as phantom common prefixes (the
+        # reference hdfs gateway deletes empty parents the same way)
+        parts = object_name.split("/")[:-1]
+        while parts:
+            pdir = self._o(bucket, "/".join(parts))
+            try:
+                if self.client.list_status(pdir):
+                    break
+                self.client.delete(pdir)
+            except HDFSError:
+                break
+            parts.pop()
         return ObjectInfo(bucket=bucket, name=object_name)
 
     def copy_object(self, src_bucket: str, src_object: str,
@@ -288,7 +313,7 @@ class HDFSObjects(GatewayUnsupported, ObjectLayer):
         out = ListObjectsInfo()
         if delimiter == "/":
             # one level: LISTSTATUS of the prefix directory
-            pdir, _, tail = prefix.rpartition("/")
+            pdir = prefix.rpartition("/")[0]
             try:
                 entries = self.client.list_status(
                     base + ("/" + pdir if pdir else ""))
@@ -305,7 +330,6 @@ class HDFSObjects(GatewayUnsupported, ObjectLayer):
                     prefixes.append(name + "/")
                 else:
                     files.append((name, e))
-            _ = tail
             files.sort()
             out.prefixes = sorted(prefixes)
         else:

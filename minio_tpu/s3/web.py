@@ -457,19 +457,28 @@ def _handle_download(h, srv, path: str, query: dict) -> None:
             h.send_header("Accept-Ranges", "bytes")
             h.end_headers()
             return
-        info, data = srv.layer.get_object(bucket, key)
-        total = len(data)
         status = 200
         rng = h.headers.get("Range", "")
         m = re.fullmatch(r"bytes=(\d+)-(\d*)", rng.strip()) if rng \
             else None
         if m:
+            # ranged read through the LAYER (offset/length), not a
+            # full materialize-then-slice: preview of a multi-GiB
+            # object must read only the requested window
+            total = srv.layer.get_object_info(bucket, key).size
             lo = int(m.group(1))
             hi = min(int(m.group(2)) if m.group(2) else total - 1,
                      total - 1)
             if lo <= hi:
-                data = data[lo:hi + 1]
+                info, data = srv.layer.get_object(
+                    bucket, key, offset=lo, length=hi - lo + 1)
                 status = 206
+            else:
+                info, data = srv.layer.get_object(bucket, key)
+                total = len(data)
+        else:
+            info, data = srv.layer.get_object(bucket, key)
+            total = len(data)
         # header values must never carry CR/LF/quotes from an attacker-
         # chosen object key (response-splitting via percent-encoded keys)
         fname = "".join(c for c in key.rpartition("/")[2]
@@ -486,8 +495,15 @@ def _handle_download(h, srv, path: str, query: dict) -> None:
         h.end_headers()
         h.wfile.write(data)
     except (WebError, oli.ObjectLayerError) as e:
-        _reply_json(h, 401 if isinstance(e, AuthError) else 404,
-                    {"ok": False, "error": str(e)})
+        status = 401 if isinstance(e, AuthError) else 404
+        if h.command == "HEAD":
+            # RFC 9110: no body on HEAD responses — a JSON error body
+            # would desync the HTTP/1.1 keep-alive connection
+            h.send_response(status)
+            h.send_header("Content-Length", "0")
+            h.end_headers()
+        else:
+            _reply_json(h, status, {"ok": False, "error": str(e)})
 
 
 class _CountingWriter:

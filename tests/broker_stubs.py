@@ -641,16 +641,21 @@ import re as _re
 class _SQLState:
     """Shared statement applier: parses the targets' three fixed
     statement shapes into real dict/list state (namespace upsert/
-    delete, access append)."""
+    delete, access append).  ``backslash_escapes`` mirrors the
+    dialect: MySQL unescapes doubled backslashes, PostgreSQL with
+    standard_conforming_strings=on treats them literally."""
 
-    def __init__(self):
+    def __init__(self, backslash_escapes: bool = True):
+        self.backslash_escapes = backslash_escapes
         self.tables: dict[str, dict] = {}     # namespace: key -> value
         self.logs: dict[str, list] = {}       # access: [(ts, doc)]
         self.statements: list[str] = []
 
-    @staticmethod
-    def _unq(s: str) -> str:
-        return s.replace("''", "'").replace("\\\\", "\\")
+    def _unq(self, s: str) -> str:
+        s = s.replace("''", "'")
+        if self.backslash_escapes:
+            s = s.replace("\\\\", "\\")
+        return s
 
     def apply(self, sql: str) -> str:
         """Returns a command tag; raises ValueError on bad SQL."""
@@ -704,10 +709,12 @@ class MySQLStubBroker(_TCPStub):
     real 20-byte salt, verifies the mysql_native_password scramble,
     answers COM_QUERY with OK/ERR packets."""
 
-    def __init__(self, user: str = "evuser", password: str = "evpass"):
+    def __init__(self, user: str = "evuser", password: str = "evpass",
+                 auth_switch: bool = False):
         super().__init__()
         self.user = user
         self.password = password
+        self.auth_switch = auth_switch   # MySQL-8 style plugin switch
         self.sql = _SQLState()
         self.auth_failures = 0
 
@@ -753,6 +760,14 @@ class MySQLStubBroker(_TCPStub):
         i = user_end + 1
         tlen = resp[i]
         token = resp[i + 1:i + 1 + tlen]
+        if self.auth_switch:
+            # MySQL 8 behavior when the account plugin differs: send
+            # AuthSwitchRequest with a FRESH salt; the client must
+            # re-scramble against it
+            salt = bytes(b % 255 + 1 for b in _os.urandom(20))
+            send_pkt(b"\xfe" + b"mysql_native_password\x00"
+                     + salt + b"\x00")
+            token = read_pkt()
         want = mysql_native_scramble(self.password, salt)
         if user != self.user or token != want:
             self.auth_failures += 1
@@ -784,7 +799,7 @@ class PostgresStubBroker(_TCPStub):
         super().__init__()
         self.user = user
         self.password = password
-        self.sql = _SQLState()
+        self.sql = _SQLState(backslash_escapes=False)
         self.auth_failures = 0
 
     def _session(self, conn):

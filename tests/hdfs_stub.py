@@ -156,7 +156,15 @@ class HDFSStubServer:
             return h._reply(200, {"boolean": True})
         if op in ("CREATE", "APPEND", "OPEN"):
             if not is_dn:
-                # namenode role: redirect to the "datanode" (us)
+                # namenode role: redirect to the "datanode" (us).
+                # Real namenodes never read a write body in step 1 —
+                # reject one outright so a client that ships bytes
+                # early (doubling every upload) fails conformance.
+                if body:
+                    return self._exc_of(
+                        h, 400, "IllegalArgumentException",
+                        "data sent to namenode; expected empty "
+                        "request before redirect")
                 self.redirects += 1
                 sep = "&" if h.path.find("?") >= 0 else "?"
                 return h._reply(307, location=self.endpoint + h.path

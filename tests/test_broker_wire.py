@@ -471,3 +471,45 @@ def test_postgresql_bad_password_and_url_dsn():
         assert "evb/u" in broker.sql.tables["urltb"]
     finally:
         broker.stop()
+
+
+def test_mysql_auth_switch_and_dsn_params():
+    """MySQL 8 sends AuthSwitchRequest when the account plugin
+    differs; the client re-scrambles against the fresh salt.  The DSN
+    may carry go-sql-driver query params which are not schema name."""
+    from minio_tpu.events.brokers import MySQLTarget
+    from .broker_stubs import MySQLStubBroker
+    broker = MySQLStubBroker(auth_switch=True).start()
+    try:
+        t = MySQLTarget(
+            "arn:minio:sqs::1:mysql",
+            f"evuser:evpass@tcp(127.0.0.1:{broker.port})/minio"
+            f"?parseTime=true&loc=UTC", "swtb")
+        t.send(_record(key="sw"))
+        assert "evb/sw" in broker.sql.tables["swtb"]
+        assert broker.auth_failures == 0
+    finally:
+        broker.stop()
+
+
+def test_postgresql_backslashes_survive():
+    """standard_conforming_strings semantics: backslashes in the JSON
+    payload (json.dumps emits \\" and \\uXXXX) must arrive VERBATIM —
+    MySQL-style backslash doubling would corrupt them (review r5)."""
+    from minio_tpu.events.brokers import PostgreSQLTarget
+    from .broker_stubs import PostgresStubBroker
+    broker = PostgresStubBroker().start()
+    try:
+        t = PostgreSQLTarget(
+            "arn:minio:sqs::1:postgresql",
+            f"host=127.0.0.1 port={broker.port} user=evuser "
+            f"password=evpass dbname=m", "bs_tb")
+        rec = _record(key='q"uoted\\pathé')
+        t.send(rec)
+        key = 'evb/q"uoted\\pathé'
+        stored = broker.sql.tables["bs_tb"][key]
+        doc = json.loads(stored)       # corrupt escapes would fail here
+        assert doc["Records"][0]["s3"]["object"]["key"] == \
+            'q"uoted\\pathé'
+    finally:
+        broker.stop()

@@ -329,3 +329,21 @@ def test_object_preview_flow(server):
     for marker in ["preview(", "prevtext", "previmg", "PREVIEW_MAX",
                    "prevclose", "Preview"]:
         assert marker in page, marker
+
+
+def test_download_head_error_has_no_body(server):
+    """RFC 9110: HEAD responses carry no body even on errors — a JSON
+    body would desync the keep-alive connection (review r5)."""
+    tok = _rpc(server, "Login", {"username": "uikey",
+                                 "password": "uisecret"})["token"]
+    url_tok = _rpc(server, "CreateURLToken", {}, tok)["token"]
+    req = urllib.request.Request(
+        f"{server.endpoint}/minio-tpu/download/prevb/ghost.bin"
+        f"?token={url_tok}", method="HEAD")
+    try:
+        resp = urllib.request.urlopen(req, timeout=10)
+    except urllib.error.HTTPError as e:
+        resp = e
+    assert resp.status == 404
+    assert resp.read() == b""
+    assert resp.headers["Content-Length"] == "0"

@@ -192,3 +192,16 @@ def test_hdfs_error_shape(stub):
         c.status("/no/such/path")
     assert ei.value.status == 404
     assert "FileNotFoundException" in ei.value.exception
+
+
+def test_delete_prunes_empty_parent_dirs(layer):
+    layer.make_bucket("hprune")
+    layer.put_object("hprune", "deep/a/b/only.bin", b"x")
+    layer.put_object("hprune", "deep/keep.bin", b"y")
+    layer.delete_object("hprune", "deep/a/b/only.bin")
+    lst = layer.list_objects("hprune", delimiter="/")
+    # 'deep/' survives (keep.bin inside); 'deep/a/' pruned entirely
+    assert lst.prefixes == ["deep/"]
+    sub = layer.list_objects("hprune", prefix="deep/", delimiter="/")
+    assert sub.prefixes == []
+    assert [o.name for o in sub.objects] == ["deep/keep.bin"]
