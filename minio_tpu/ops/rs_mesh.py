@@ -18,10 +18,114 @@ XOR fan-in) and this module zero-pads the stripe batch the same way.
 
 from __future__ import annotations
 
+import functools
+import os
+
 import numpy as np
 
 from minio_tpu.parallel import mesh as mesh_mod
 from . import gf8, rs_kernels
+
+
+def _use_pallas() -> bool:
+    """On TPU the per-device compute runs the fused pallas bitplane
+    kernel (ops/rs_pallas.py, ~50 GiB/s/chip) with a ppermute ring
+    XOR-combining the PACKED parity bytes — per-chip pallas speed,
+    (S-1) x r x n bytes of ICI traffic (ring-allreduce optimal).  The
+    XLA psum formulation stays as the portable path (CPU virtual mesh,
+    and anywhere Mosaic is unavailable); MT_MESH_PALLAS=1/0 overrides
+    for tests."""
+    env = os.environ.get("MT_MESH_PALLAS", "")
+    if env in ("0", "1"):
+        return env == "1"
+    try:
+        import jax
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+@functools.lru_cache(maxsize=64)
+def _sharded_apply_pallas(mesh, r: int, kl: int, gs: int, tn: int,
+                          interpret: bool):
+    """shard_map'd per-device pallas matmul + packed-byte ring XOR.
+
+    GF(2) addition of packed parity bytes IS XOR, so partial parities
+    combine bitwise after each single-hop ppermute — no int32
+    accumulator ever crosses ICI (a psum of the pre-packed accumulator
+    would carry 32x the bytes and erase the kernel's HBM advantage).
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from . import rs_pallas
+
+    S = mesh.shape["shard"]
+    perm = [(j, (j + 1) % S) for j in range(S)]
+
+    def local(mats, data):
+        # mats: (1, gs*8r, gs*8kl) int8 — this device's column slice;
+        # data: (B/T, kl, n) uint8
+        part = rs_pallas._gf2_apply_bm(mats[0], data,
+                                       interpret=interpret,
+                                       gs=gs, tn=tn)
+        if S == 1:
+            return part
+
+        def step(_, acc):
+            return jax.lax.ppermute(acc, "shard", perm) ^ part
+
+        return jax.lax.fori_loop(0, S - 1, step, part)
+
+    specs = dict(in_specs=(P("shard", None, None),
+                           P("stripe", "shard", None)),
+                 out_specs=P("stripe", None, None))
+    try:
+        fn = jax.shard_map(local, mesh=mesh, check_vma=False, **specs)
+    except TypeError:                      # older JAX spells it check_rep
+        fn = jax.shard_map(local, mesh=mesh, check_rep=False, **specs)
+    return jax.jit(fn)
+
+
+def _apply_pallas(m, rows: np.ndarray, shards: np.ndarray) -> np.ndarray:
+    """Mesh apply with the pallas per-device engine; pads B to the
+    stripe x gs grid, k to the shard axis, n to the lane tile."""
+    import jax
+    import jax.numpy as jnp
+    from . import rs_pallas
+
+    T, S = m.shape["stripe"], m.shape["shard"]
+    B, k, n = shards.shape
+    r = rows.shape[0]
+    padK = (-k) % S
+    if padK:
+        shards = np.concatenate(
+            [shards, np.zeros((B, padK, n), np.uint8)], axis=1)
+        rows = np.concatenate(
+            [rows, np.zeros((r, padK), np.uint8)], axis=1)
+    kl = (k + padK) // S
+    gs = rs_pallas._GS
+    padB = (-B) % (T * gs)
+    if padB:
+        shards = np.concatenate(
+            [shards, np.zeros((padB, k + padK, n), np.uint8)])
+    # same lane-tile heuristic as rs_pallas.apply_matrix
+    q = max(n // 4, 1)
+    tn = rs_pallas._LANES
+    while tn * 2 <= q and tn < rs_pallas._TN:
+        tn *= 2
+    padN = (-n) % tn
+    if padN:
+        shards = np.pad(shards, ((0, 0), (0, 0), (0, padN)))
+    rows = np.ascontiguousarray(rows, dtype=np.uint8)
+    mats = jnp.stack([
+        rs_pallas._device_matrix_bd(
+            np.ascontiguousarray(rows[:, j * kl:(j + 1) * kl])
+            .tobytes(), r, kl, gs)
+        for j in range(S)])
+    interpret = jax.default_backend() != "tpu"
+    fn = _sharded_apply_pallas(m, r, kl, gs, tn, interpret)
+    out = np.asarray(fn(mats, jnp.asarray(shards)))
+    return out[:B, :, :n]
 
 
 def apply_matrix(rows: np.ndarray, shards) -> np.ndarray:
@@ -36,6 +140,10 @@ def apply_matrix(rows: np.ndarray, shards) -> np.ndarray:
     if squeeze:
         shards = shards[None]
     m = mesh_mod.get_active_mesh()
+    if _use_pallas():
+        rows8 = np.asarray(rows, dtype=np.uint8)
+        out = _apply_pallas(m, rows8, shards)
+        return out[0] if squeeze else out
     T = m.shape["stripe"]
     B = shards.shape[0]
     pad = (-B) % T

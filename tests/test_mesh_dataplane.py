@@ -38,20 +38,27 @@ def meshed(tmp_path):
 
 @pytest.fixture
 def counting(monkeypatch):
-    """Count dispatches that reach the sharded mesh kernels."""
+    """Count dispatches that reach the sharded mesh kernels — either
+    engine (XLA psum path or the pallas+ppermute-ring path)."""
     calls = {"apply": 0, "fused": 0}
     real_apply = mesh_mod.distributed_apply
+    real_pallas = rs_mesh._apply_pallas
     real_fused = mesh_mod._fused_encode_hash
 
     def apply_spy(*a, **kw):
         calls["apply"] += 1
         return real_apply(*a, **kw)
 
+    def pallas_spy(*a, **kw):
+        calls["apply"] += 1
+        return real_pallas(*a, **kw)
+
     def fused_spy(*a, **kw):
         calls["fused"] += 1
         return real_fused(*a, **kw)
 
     monkeypatch.setattr(mesh_mod, "distributed_apply", apply_spy)
+    monkeypatch.setattr(rs_mesh, "_apply_pallas", pallas_spy)
     monkeypatch.setattr(mesh_mod, "_fused_encode_hash", fused_spy)
     # rs_mesh binds the module, not the function, so the spy is seen
     return calls
@@ -194,6 +201,38 @@ def test_rs_mesh_oracle_grid():
             present = [i for i in range(k + m) if i not in dead][:k]
             reb = rs_mesh.reconstruct_batch(
                 full[:, present], present, dead, k, m)
+            for j, w in enumerate(dead):
+                assert np.array_equal(reb[:, j], full[:, w]), (k, m, w)
+    finally:
+        mesh_mod.set_active_mesh(prev)
+
+
+def test_pallas_ring_engine_bit_identical(monkeypatch):
+    """The TPU-default mesh engine: per-device fused pallas kernel +
+    packed-byte XOR over a ppermute ring (GF(2) addition of packed
+    parity IS XOR, so no int32 accumulator crosses ICI).  Forced on
+    here (MT_MESH_PALLAS=1, interpret mode on CPU) and asserted
+    bit-identical with the numpy oracle across geometries including
+    ragged k/B/n."""
+    from minio_tpu.ops import gf8_ref
+    monkeypatch.setenv("MT_MESH_PALLAS", "1")
+    prev = mesh_mod._ACTIVE
+    mesh_mod.set_active_mesh(mesh_mod.make_mesh(stripe=2))
+    try:
+        rng = np.random.default_rng(11)
+        for k, m, B, n in ((12, 4, 5, 1024), (10, 3, 2, 257),
+                           (4, 2, 1, 640)):
+            blocks = rng.integers(0, 256, (B, k, n), dtype=np.uint8)
+            want = np.stack([gf8_ref.encode_parity(b, m)
+                             for b in blocks])
+            got = rs_mesh.encode_parity(blocks, m)
+            assert np.array_equal(want, got), (k, m, B, n)
+            full = np.concatenate([blocks, want], axis=1)
+            dead = [0, 2, k][:m]
+            present = [i for i in range(k + m)
+                       if i not in dead][:k]
+            reb = rs_mesh.reconstruct_batch(full[:, present], present,
+                                            dead, k, m)
             for j, w in enumerate(dead):
                 assert np.array_equal(reb[:, j], full[:, w]), (k, m, w)
     finally:
