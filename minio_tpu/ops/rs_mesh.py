@@ -193,6 +193,14 @@ def encode_with_bitrot(data_blocks: int, parity_blocks: int,
     encodes its partial parity and hashes its own shard slice; digests
     ride an all_gather, parity an XOR psum.
 
+    Known upgrade path (not yet taken): the per-device encode+hash here
+    is the XLA formulation; swapping in the pallas matmul + hh256
+    kernels with the packed-byte ring combine (the apply_matrix
+    _use_pallas engine) would give mesh PUT per-chip pallas speed too.
+    GET/heal already ride it; PUT keeps the XLA form because digest
+    hashing must see UNPADDED shard widths inside the same shard_map
+    body, which needs careful slicing around the lane-tile padding.
+
     Pads B up to the stripe axis and k up to the shard axis (padded
     shards are zero; their digests are computed but sliced off).
     Returns (parity (B, m, n) uint8, digests (B, k+m, 32) uint8).
