@@ -186,28 +186,124 @@ def reconstruct_batch(shards: np.ndarray, present: list[int],
     return apply_matrix(rows, shards)
 
 
+@functools.lru_cache(maxsize=64)
+def _fused_pallas(mesh, r: int, kl: int, gs: int, tn: int,
+                  n_real: int, interpret: bool):
+    """Fused encode+bitrot, pallas per-chip form: local pallas matmul
+    on this device's k-slice, packed-byte ring XOR for the parity, and
+    the pallas HighwayHash kernel over the UNPADDED shard widths
+    (digests must never cover lane-tile padding); data digests ride an
+    all_gather, parity digests compute post-ring on the replicated
+    parity."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from . import hh_pallas, rs_pallas
+
+    S = mesh.shape["shard"]
+    perm = [(j, (j + 1) % S) for j in range(S)]
+
+    def local(mats, data):
+        b = data.shape[0]
+        part = rs_pallas._gf2_apply_bm(mats[0], data,
+                                       interpret=interpret,
+                                       gs=gs, tn=tn)
+        if S > 1:
+            def step(_, acc):
+                return jax.lax.ppermute(acc, "shard", perm) ^ part
+            parity = jax.lax.fori_loop(0, S - 1, step, part)
+        else:
+            parity = part
+        d_dig = hh_pallas.hh256_batch(
+            data[:, :, :n_real].reshape(b * kl, n_real)
+        ).reshape(b, kl, 32)
+        if S > 1:
+            d_dig = jax.lax.all_gather(d_dig, "shard", axis=1,
+                                       tiled=True)
+        rr = parity.shape[1]
+        p_dig = hh_pallas.hh256_batch(
+            parity[:, :, :n_real].reshape(b * rr, n_real)
+        ).reshape(b, rr, 32)
+        import jax.numpy as jnp
+        return parity, jnp.concatenate([d_dig, p_dig], axis=1)
+
+    specs = dict(in_specs=(P("shard", None, None),
+                           P("stripe", "shard", None)),
+                 out_specs=(P("stripe", None, None),
+                            P("stripe", None, None)))
+    try:
+        fn = jax.shard_map(local, mesh=mesh, check_vma=False, **specs)
+    except TypeError:
+        fn = jax.shard_map(local, mesh=mesh, check_rep=False, **specs)
+    return jax.jit(fn)
+
+
+def _encode_with_bitrot_pallas(m, data_blocks: int, parity_blocks: int,
+                               blocks: np.ndarray):
+    import jax
+    import jax.numpy as jnp
+    from . import rs_pallas
+
+    T, S = m.shape["stripe"], m.shape["shard"]
+    B, k, n = blocks.shape
+    r = parity_blocks
+    M = np.asarray(gf8.rs_matrix(data_blocks,
+                                 data_blocks + parity_blocks))[k:]
+    padK = (-k) % S
+    if padK:
+        blocks = np.concatenate(
+            [blocks, np.zeros((B, padK, n), np.uint8)], axis=1)
+        M = np.concatenate([M, np.zeros((r, padK), np.uint8)], axis=1)
+    kl = (k + padK) // S
+    gs = rs_pallas._GS
+    padB = (-B) % (T * gs)
+    if padB:
+        blocks = np.concatenate(
+            [blocks, np.zeros((padB, k + padK, n), np.uint8)])
+    q = max(n // 4, 1)
+    tn = rs_pallas._LANES
+    while tn * 2 <= q and tn < rs_pallas._TN:
+        tn *= 2
+    padN = (-n) % tn
+    if padN:
+        blocks = np.pad(blocks, ((0, 0), (0, 0), (0, padN)))
+    M = np.ascontiguousarray(M, dtype=np.uint8)
+    mats = jnp.stack([
+        rs_pallas._device_matrix_bd(
+            np.ascontiguousarray(M[:, j * kl:(j + 1) * kl]).tobytes(),
+            r, kl, gs)
+        for j in range(S)])
+    interpret = jax.default_backend() != "tpu"
+    fn = _fused_pallas(m, r, kl, gs, tn, n, interpret)
+    parity, digests = fn(mats, jnp.asarray(blocks))
+    parity = np.asarray(parity)[:B, :, :n]
+    digests = np.asarray(digests)
+    # digest rows: [k+padK data slots][r parity slots] — drop the pads
+    digests = np.concatenate(
+        [digests[:B, :k], digests[:B, k + padK:]], axis=1)
+    return parity, digests
+
+
 def encode_with_bitrot(data_blocks: int, parity_blocks: int,
                        blocks: np.ndarray):
     """(parity, digests) for a (B, k, n) stripe batch through the FUSED
-    sharded pipeline (mesh.distributed_encode_with_bitrot): each device
-    encodes its partial parity and hashes its own shard slice; digests
-    ride an all_gather, parity an XOR psum.
+    sharded pipeline: each device encodes its partial parity and hashes
+    its own shard slice; digests ride an all_gather.
 
-    Known upgrade path (not yet taken): the per-device encode+hash here
-    is the XLA formulation; swapping in the pallas matmul + hh256
-    kernels with the packed-byte ring combine (the apply_matrix
-    _use_pallas engine) would give mesh PUT per-chip pallas speed too.
-    GET/heal already ride it; PUT keeps the XLA form because digest
-    hashing must see UNPADDED shard widths inside the same shard_map
-    body, which needs careful slicing around the lane-tile padding.
+    Two engines, same contract as apply_matrix: on TPU (or
+    MT_MESH_PALLAS=1) the per-device compute is the pallas matmul +
+    pallas HighwayHash with a packed-byte ppermute-ring XOR; elsewhere
+    the XLA psum formulation (mesh.distributed_encode_with_bitrot).
 
     Pads B up to the stripe axis and k up to the shard axis (padded
     shards are zero; their digests are computed but sliced off).
     Returns (parity (B, m, n) uint8, digests (B, k+m, 32) uint8).
     """
     m = mesh_mod.get_active_mesh()
-    T, S = m.shape["stripe"], m.shape["shard"]
     blocks = np.asarray(blocks, dtype=np.uint8)
+    if _use_pallas():
+        return _encode_with_bitrot_pallas(
+            m, data_blocks, parity_blocks, blocks)
+    T, S = m.shape["stripe"], m.shape["shard"]
     B, k, n = blocks.shape
     padB, padK = (-B) % T, (-k) % S
     if padB or padK:

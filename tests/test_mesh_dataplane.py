@@ -57,9 +57,17 @@ def counting(monkeypatch):
         calls["fused"] += 1
         return real_fused(*a, **kw)
 
+    real_fused_pallas = rs_mesh._encode_with_bitrot_pallas
+
+    def fused_pallas_spy(*a, **kw):
+        calls["fused"] += 1
+        return real_fused_pallas(*a, **kw)
+
     monkeypatch.setattr(mesh_mod, "distributed_apply", apply_spy)
     monkeypatch.setattr(rs_mesh, "_apply_pallas", pallas_spy)
     monkeypatch.setattr(mesh_mod, "_fused_encode_hash", fused_spy)
+    monkeypatch.setattr(rs_mesh, "_encode_with_bitrot_pallas",
+                        fused_pallas_spy)
     # rs_mesh binds the module, not the function, so the spy is seen
     return calls
 
@@ -235,5 +243,14 @@ def test_pallas_ring_engine_bit_identical(monkeypatch):
                                             dead, k, m)
             for j, w in enumerate(dead):
                 assert np.array_equal(reb[:, j], full[:, w]), (k, m, w)
+        # fused engine: framed output vs the host oracle, bit for bit
+        from minio_tpu.hashing import bitrot
+        from minio_tpu.ops.codec import Erasure
+        data = bytes(rng.integers(0, 256, BS + 4567, dtype=np.uint8))
+        cod = Erasure(4, 2, BS, backend="numpy")
+        host = cod.encode_object_framed(data)
+        assert bitrot.fill_framed(host, cod.shard_size())
+        got = rs_mesh.encode_object_framed_fused(4, 2, BS, data)
+        assert np.array_equal(host, got), "fused pallas framed mismatch"
     finally:
         mesh_mod.set_active_mesh(prev)
