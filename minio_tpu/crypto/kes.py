@@ -56,7 +56,11 @@ class KESClient:
                     pass
                 raise KMSError(
                     f"kes {method} {path}: {resp.status} {msg}")
-            return json.loads(data) if data else {}
+            try:
+                return json.loads(data) if data else {}
+            except ValueError as e:
+                raise KMSError(f"kes returned malformed JSON: "
+                               f"{e}") from e
         except OSError as e:
             raise KMSError(f"kes unreachable: {e}") from e
         finally:

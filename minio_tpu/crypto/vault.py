@@ -62,7 +62,11 @@ class VaultClient:
                     pass
                 raise KMSError(
                     f"vault {method} {path}: {resp.status} {errs}")
-            return json.loads(data) if data else {}
+            try:
+                return json.loads(data) if data else {}
+            except ValueError as e:
+                raise KMSError(f"vault returned malformed JSON: "
+                               f"{e}") from e
         except OSError as e:
             raise KMSError(f"vault unreachable: {e}") from e
         finally:

@@ -102,6 +102,8 @@ class MySQLWireClient:
     @staticmethod
     def _check_err(pkt: bytes) -> None:
         if pkt and pkt[0] == 0xFF:
+            if len(pkt) < 3:
+                raise WireError("malformed mysql error packet")
             code = struct.unpack("<H", pkt[1:3])[0]
             msg = pkt[3:].decode(errors="replace")
             if msg.startswith("#"):
@@ -109,9 +111,19 @@ class MySQLWireClient:
             raise WireError(f"mysql error {code}: {msg}")
 
     def _handshake(self, user: str, password: str, db: str) -> None:
+        try:
+            self._handshake_inner(user, password, db)
+        except (IndexError, ValueError, struct.error,
+                UnicodeDecodeError) as e:
+            # malformed server bytes must surface as a wire error, not
+            # a stray parser exception (fuzz-tier contract)
+            raise WireError(f"malformed mysql handshake: {e!r}") from e
+
+    def _handshake_inner(self, user: str, password: str,
+                         db: str) -> None:
         pkt = self._read_packet()
         self._check_err(pkt)
-        if pkt[0] != 10:
+        if not pkt or pkt[0] != 10:
             raise WireError(f"unsupported mysql protocol {pkt[0]}")
         i = 1
         i = pkt.index(b"\x00", i) + 1             # server version
@@ -163,15 +175,18 @@ class MySQLWireClient:
         self._send_packet(b"\x03" + sql.encode())
         resp = self._read_packet()
         self._check_err(resp)
-        if resp[0] != 0x00:
+        if not resp or resp[0] != 0x00:
             raise WireError("statement returned a result set "
                             "(only OK expected)")
         # affected rows: length-encoded int right after the 0x00 header
-        v = resp[1]
-        if v < 0xFB:
-            return v
-        if v == 0xFC:
-            return struct.unpack("<H", resp[2:4])[0]
+        try:
+            v = resp[1]
+            if v < 0xFB:
+                return v
+            if v == 0xFC:
+                return struct.unpack("<H", resp[2:4])[0]
+        except (IndexError, struct.error) as e:
+            raise WireError(f"malformed OK packet: {e!r}") from e
         return 0
 
     def close(self) -> None:
@@ -206,6 +221,8 @@ class PostgresWireClient:
     def _read_msg(self) -> tuple[bytes, bytes]:
         t = self._recv_exact(1)
         ln = struct.unpack(">I", self._recv_exact(4))[0]
+        if not 4 <= ln <= 64 << 20:      # length includes itself
+            raise WireError(f"bad postgres message length {ln}")
         return t, self._recv_exact(ln - 4)
 
     def _send_msg(self, t: bytes, body: bytes) -> None:
@@ -230,6 +247,8 @@ class PostgresWireClient:
                 raise WireError(
                     f"postgres error: {self._err_text(payload)}")
             if t == b"R":
+                if len(payload) < 4:
+                    raise WireError("malformed auth request")
                 kind = struct.unpack(">I", payload[:4])[0]
                 if kind == 0:                      # AuthenticationOk
                     continue
@@ -237,6 +256,8 @@ class PostgresWireClient:
                     self._send_msg(b"p", password.encode() + b"\x00")
                     continue
                 if kind == 5:                      # md5
+                    if len(payload) < 8:
+                        raise WireError("malformed md5 auth request")
                     salt = payload[4:8]
                     inner = hashlib.md5(
                         password.encode() + user.encode()).hexdigest()

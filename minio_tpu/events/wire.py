@@ -283,24 +283,28 @@ class RedisWireClient:
     def _read_reply(self):
         line = self._recv_line()
         t, rest = line[:1], line[1:]
-        if t == b"+":
-            return rest.decode()
-        if t == b"-":
-            raise WireError(f"redis error: {rest.decode()}")
-        if t == b":":
-            return int(rest)
-        if t == b"$":
-            n = int(rest)
-            if n < 0:
-                return None
-            data = self._recv_exact(n)
-            self._recv_exact(2)                     # trailing \r\n
-            return data
-        if t == b"*":
-            n = int(rest)
-            if n < 0:
-                return None
-            return [self._read_reply() for _ in range(n)]
+        try:
+            if t == b"+":
+                return rest.decode(errors="replace")
+            if t == b"-":
+                raise WireError(
+                    f"redis error: {rest.decode(errors='replace')}")
+            if t == b":":
+                return int(rest)
+            if t == b"$":
+                n = int(rest)
+                if n < 0:
+                    return None
+                data = self._recv_exact(n)
+                self._recv_exact(2)                 # trailing \r\n
+                return data
+            if t == b"*":
+                n = int(rest)
+                if n < 0:
+                    return None
+                return [self._read_reply() for _ in range(n)]
+        except ValueError as e:    # malformed int field from the wire
+            raise WireError(f"malformed RESP reply: {e}") from e
         raise WireError(f"bad RESP type byte {t!r}")
 
     def command(self, *args):
@@ -371,7 +375,8 @@ class NATSWireClient:
             if line == b"PONG":
                 return
             if line.startswith(b"-ERR"):
-                raise WireError(f"nats: {line.decode()}")
+                raise WireError(
+                    f"nats: {line.decode(errors='replace')}")
             if line.startswith(b"PING"):
                 self.sock.sendall(b"PONG\r\n")
             # +OK / INFO updates are skipped
@@ -413,6 +418,8 @@ class NSQWireClient:
 
     def _read_frame(self) -> tuple[int, bytes]:
         size = struct.unpack(">i", self._recv_exact(4))[0]
+        if not 4 <= size <= 16 << 20:    # frame = type + data; sane cap
+            raise WireError(f"bad nsqd frame size {size}")
         data = self._recv_exact(size)
         ftype = struct.unpack(">i", data[:4])[0]
         return ftype, data[4:]
@@ -423,7 +430,8 @@ class NSQWireClient:
         while True:
             ftype, data = self._read_frame()
             if ftype == _NSQ_FRAME_ERROR:
-                raise WireError(f"nsqd error: {data.decode()}")
+                raise WireError(
+                    f"nsqd error: {data.decode(errors='replace')}")
             if ftype == _NSQ_FRAME_RESPONSE:
                 if data == b"_heartbeat_":
                     self.sock.sendall(b"NOP\n")

@@ -111,11 +111,19 @@ class WebHDFSClient:
         finally:
             conn.close()
 
+    @staticmethod
+    def _json(data: bytes) -> dict:
+        try:
+            return json.loads(data)
+        except ValueError as e:
+            raise HDFSError(502, "MalformedResponse",
+                            f"non-JSON namenode reply: {e}") from e
+
     # -- filesystem ops ---------------------------------------------------
 
     def mkdirs(self, path: str) -> bool:
         _, _, data = self._request("PUT", self._url(path, "MKDIRS"))
-        return json.loads(data).get("boolean", False)
+        return self._json(data).get("boolean", False)
 
     def create(self, path: str, body: bytes,
                overwrite: bool = True) -> None:
@@ -138,21 +146,27 @@ class WebHDFSClient:
     def status(self, path: str) -> dict:
         _, _, data = self._request("GET",
                                    self._url(path, "GETFILESTATUS"))
-        return json.loads(data)["FileStatus"]
+        try:
+            return self._json(data)["FileStatus"]
+        except KeyError as e:
+            raise HDFSError(502, "MalformedResponse", str(e)) from e
 
     def list_status(self, path: str) -> list[dict]:
         _, _, data = self._request("GET", self._url(path, "LISTSTATUS"))
-        return json.loads(data)["FileStatuses"]["FileStatus"]
+        try:
+            return self._json(data)["FileStatuses"]["FileStatus"]
+        except KeyError as e:
+            raise HDFSError(502, "MalformedResponse", str(e)) from e
 
     def delete(self, path: str, recursive: bool = False) -> bool:
         _, _, data = self._request("DELETE", self._url(
             path, "DELETE", recursive=str(bool(recursive)).lower()))
-        return json.loads(data).get("boolean", False)
+        return self._json(data).get("boolean", False)
 
     def rename(self, path: str, dest: str) -> bool:
         _, _, data = self._request("PUT", self._url(
             path, "RENAME", destination=dest))
-        return json.loads(data).get("boolean", False)
+        return self._json(data).get("boolean", False)
 
 
 _SYS = ".minio-tpu.sys"

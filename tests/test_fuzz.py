@@ -318,3 +318,81 @@ def test_fuzz_ldap_ber():
                 r.read_tlv()
         except (ldap.LDAPError, ValueError, IndexError):
             pass
+
+
+class _FakeSock:
+    """Feeds a fixed byte stream to a wire client, then EOF."""
+
+    def __init__(self, data: bytes, chunk: int = 7):
+        self._data = data
+        self._chunk = chunk
+
+    def recv(self, n):
+        out = self._data[:min(n, self._chunk)]
+        self._data = self._data[len(out):]
+        return out
+
+    def sendall(self, data):
+        pass
+
+    def close(self):
+        pass
+
+
+def test_fuzz_broker_wire_parsers():
+    """Every raw-socket broker client parser fed garbage AND mutations
+    of valid server bytes: only WireError may escape (a malicious or
+    broken broker must produce a clean TargetError, not a stray
+    struct.error/ValueError/IndexError/MemoryError)."""
+    from minio_tpu.events import sqlwire, wire
+
+    rng = random.Random(77)
+    valid_seeds = [
+        b"+OK\r\n", b":12\r\n", b"$3\r\nabc\r\n", b"-ERR boom\r\n",
+        b"*2\r\n$1\r\na\r\n$1\r\nb\r\n",                       # RESP
+        b'INFO {"server_id":"x"}\r\nPONG\r\n',                  # NATS
+        b"\x00\x00\x00\x06\x00\x00\x00\x00OK",                  # NSQ
+        b"\x20\x02\x00\x00", b"\x40\x02\x00\x01",               # MQTT
+        bytes.fromhex("0a00000a") + b"8.0\x00" + b"\x00" * 40,  # MySQL
+        b"R" + (8).to_bytes(4, "big") + (0).to_bytes(4, "big")
+        + b"Z" + (5).to_bytes(4, "big") + b"I",                 # PG
+    ]
+
+    def streams():
+        for seed in valid_seeds:
+            yield seed
+            for _ in range(40):
+                yield _mutate(rng, seed)
+        for _ in range(150):
+            yield _garbage(rng, rng.randrange(0, 40))
+
+    def drive(make, step):
+        for blob in streams():
+            obj = make()
+            obj.sock = _FakeSock(blob)
+            obj._buf = b""
+            try:
+                step(obj)
+            except wire.WireError:
+                pass
+
+    drive(lambda: wire.RedisWireClient.__new__(wire.RedisWireClient),
+          lambda o: o._read_reply())
+    drive(lambda: wire.NATSWireClient.__new__(wire.NATSWireClient),
+          lambda o: o._flush())
+    drive(lambda: wire.NSQWireClient.__new__(wire.NSQWireClient),
+          lambda o: o._read_frame())
+    drive(lambda: wire.MQTTWireClient.__new__(wire.MQTTWireClient),
+          lambda o: o._read_packet())
+
+    def mysql_handshake(o):
+        o._seq = 0
+        o._handshake("u", "p", "db")
+    drive(lambda: sqlwire.MySQLWireClient.__new__(
+        sqlwire.MySQLWireClient), mysql_handshake)
+
+    def pg_startup(o):
+        o.user = "u"
+        o._startup("u", "p", "db")
+    drive(lambda: sqlwire.PostgresWireClient.__new__(
+        sqlwire.PostgresWireClient), pg_startup)
