@@ -19,24 +19,27 @@
 extern "C" {
 
 // ---------------------------------------------------------------- crc32c --
-static uint32_t crc32c_table[256];
-static bool crc32c_init_done = false;
-
-static void crc32c_init() {
-    for (uint32_t i = 0; i < 256; i++) {
-        uint32_t c = i;
-        for (int k = 0; k < 8; k++)
-            c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
-        crc32c_table[i] = c;
+// Table built under C++11 magic-statics init (thread-safe once-only by
+// the standard).  The previous lazy 'static bool done' flag was a data
+// race between concurrent first callers — found by the TSan tier, the
+// same class of bug as the highwayhash feature-cache race.
+struct Crc32cTable {
+    uint32_t t[256];
+    Crc32cTable() {
+        for (uint32_t i = 0; i < 256; i++) {
+            uint32_t c = i;
+            for (int k = 0; k < 8; k++)
+                c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+            t[i] = c;
+        }
     }
-    crc32c_init_done = true;
-}
+};
 
 uint32_t mt_crc32c(const uint8_t* data, size_t n) {
-    if (!crc32c_init_done) crc32c_init();
+    static const Crc32cTable table;
     uint32_t c = 0xFFFFFFFFu;
     for (size_t i = 0; i < n; i++)
-        c = crc32c_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+        c = table.t[(c ^ data[i]) & 0xFF] ^ (c >> 8);
     return c ^ 0xFFFFFFFFu;
 }
 
