@@ -124,7 +124,8 @@ class MySQLWireClient:
         pkt = self._read_packet()
         self._check_err(pkt)
         if not pkt or pkt[0] != 10:
-            raise WireError(f"unsupported mysql protocol {pkt[0]}")
+            raise WireError(
+                f"unsupported mysql protocol {pkt[0] if pkt else '<empty>'}")
         i = 1
         i = pkt.index(b"\x00", i) + 1             # server version
         i += 4                                     # thread id

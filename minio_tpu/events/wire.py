@@ -280,7 +280,13 @@ class RedisWireClient:
         out, self._buf = self._buf[:n], self._buf[n:]
         return out
 
-    def _read_reply(self):
+    _MAX_BULK = 64 << 20       # fuzz contract: bounded, WireError only
+    _MAX_ARRAY = 1 << 20
+    _MAX_DEPTH = 32
+
+    def _read_reply(self, depth: int = 0):
+        if depth > self._MAX_DEPTH:
+            raise WireError("RESP nesting too deep")
         line = self._recv_line()
         t, rest = line[:1], line[1:]
         try:
@@ -295,6 +301,8 @@ class RedisWireClient:
                 n = int(rest)
                 if n < 0:
                     return None
+                if n > self._MAX_BULK:
+                    raise WireError(f"bulk string too large: {n}")
                 data = self._recv_exact(n)
                 self._recv_exact(2)                 # trailing \r\n
                 return data
@@ -302,7 +310,9 @@ class RedisWireClient:
                 n = int(rest)
                 if n < 0:
                     return None
-                return [self._read_reply() for _ in range(n)]
+                if n > self._MAX_ARRAY:
+                    raise WireError(f"array too large: {n}")
+                return [self._read_reply(depth + 1) for _ in range(n)]
         except ValueError as e:    # malformed int field from the wire
             raise WireError(f"malformed RESP reply: {e}") from e
         raise WireError(f"bad RESP type byte {t!r}")

@@ -114,10 +114,15 @@ class WebHDFSClient:
     @staticmethod
     def _json(data: bytes) -> dict:
         try:
-            return json.loads(data)
+            doc = json.loads(data)
         except ValueError as e:
             raise HDFSError(502, "MalformedResponse",
                             f"non-JSON namenode reply: {e}") from e
+        if not isinstance(doc, dict):
+            raise HDFSError(502, "MalformedResponse",
+                            f"non-object namenode reply: "
+                            f"{type(doc).__name__}")
+        return doc
 
     # -- filesystem ops ---------------------------------------------------
 
@@ -148,15 +153,19 @@ class WebHDFSClient:
                                    self._url(path, "GETFILESTATUS"))
         try:
             return self._json(data)["FileStatus"]
-        except KeyError as e:
-            raise HDFSError(502, "MalformedResponse", str(e)) from e
+        except (KeyError, TypeError) as e:
+            raise HDFSError(502, "MalformedResponse", repr(e)) from e
 
     def list_status(self, path: str) -> list[dict]:
         _, _, data = self._request("GET", self._url(path, "LISTSTATUS"))
         try:
-            return self._json(data)["FileStatuses"]["FileStatus"]
-        except KeyError as e:
-            raise HDFSError(502, "MalformedResponse", str(e)) from e
+            out = self._json(data)["FileStatuses"]["FileStatus"]
+        except (KeyError, TypeError) as e:
+            raise HDFSError(502, "MalformedResponse", repr(e)) from e
+        if not isinstance(out, list):
+            raise HDFSError(502, "MalformedResponse",
+                            "FileStatus is not a list")
+        return out
 
     def delete(self, path: str, recursive: bool = False) -> bool:
         _, _, data = self._request("DELETE", self._url(

@@ -353,7 +353,12 @@ def test_fuzz_broker_wire_parsers():
         b'INFO {"server_id":"x"}\r\nPONG\r\n',                  # NATS
         b"\x00\x00\x00\x06\x00\x00\x00\x00OK",                  # NSQ
         b"\x20\x02\x00\x00", b"\x40\x02\x00\x01",               # MQTT
-        bytes.fromhex("0a00000a") + b"8.0\x00" + b"\x00" * 40,  # MySQL
+        # MySQL HandshakeV10: header(len=46,seq=0) + proto 0x0a +
+        # version NUL + thread id + salt1 + filler + caps/etc + salt2
+        (46).to_bytes(3, "little") + b"\x00" + b"\x0a"
+        + b"8.0\x00" + b"\x01\x00\x00\x00" + b"A" * 8 + b"\x00"
+        + b"\xff\xff" + b"\x21" + b"\x02\x00" + b"\xff\xff"
+        + b"\x15" + b"\x00" * 10 + b"B" * 12 + b"\x00",          # MySQL
         b"R" + (8).to_bytes(4, "big") + (0).to_bytes(4, "big")
         + b"Z" + (5).to_bytes(4, "big") + b"I",                 # PG
     ]
