@@ -508,6 +508,10 @@ def _config(h, srv, route, q1, payload, send_json) -> bool:
             except ValueError as e:
                 return send_json({"error": str(e)}, 400) or True
         cfg.set(parts[1], parts[2], value)
+        if parts[1] == "api":
+            # retune the live request plane (deadlines, pool size,
+            # shed queue) without a restart
+            srv.reload_api_config()
         return send_json({"status": "ok"}) or True
     from ..s3.server import S3Error
     raise S3Error("MethodNotAllowed")

@@ -99,11 +99,13 @@ def render(layer=None, healer=None) -> str:
             lines.append(f"# TYPE {name} histogram")
             seen_names.add(name)
         for i, ub in enumerate(buckets):
+            le = 'le="%g"' % ub
             lines.append(
                 f"{name}_bucket"
-                f"{_fmt_labels(labels, f'le=\"{ub:g}\"')} {h[i]}")
+                f"{_fmt_labels(labels, le)} {h[i]}")
+        le_inf = 'le="+Inf"'
         lines.append(f"{name}_bucket"
-                     f"{_fmt_labels(labels, 'le=\"+Inf\"')}"
+                     f"{_fmt_labels(labels, le_inf)}"
                      f" {h[len(buckets)]}")
         lines.append(f"{name}_sum{_fmt_labels(labels)} {h[-1]:g}")
         lines.append(f"{name}_count{_fmt_labels(labels)}"

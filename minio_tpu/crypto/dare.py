@@ -23,7 +23,19 @@ import os
 import struct
 from typing import Callable
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+# gated dependency: the container may lack the `cryptography` wheel.
+# Importing this module must stay cheap and safe (the S3 server pulls
+# the crypto package in unconditionally); only USING SSE requires the
+# AES-GCM backend.
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:              # pragma: no cover - env dependent
+    AESGCM = None
+try:
+    from cryptography.exceptions import InvalidTag
+except ImportError:              # pragma: no cover - env dependent
+    class InvalidTag(Exception):
+        pass
 
 VERSION_20 = 0x20
 AES_256_GCM = 0x00
@@ -39,6 +51,15 @@ _FINAL = 0x80                                 # final-package marker (nonce[0])
 
 class DAREError(Exception):
     """Tampered / malformed / truncated ciphertext."""
+
+
+def _aead(key: bytes):
+    """AES-GCM instance or a loud failure when the backend is absent."""
+    if AESGCM is None:
+        raise DAREError(
+            "SSE unavailable: the 'cryptography' AES-GCM backend is not "
+            "installed")
+    return AESGCM(key)
 
 
 def ciphertext_size(plain_size: int) -> int:
@@ -79,7 +100,7 @@ def encrypt(key: bytes, plaintext: bytes) -> bytes:
     """Encrypt a whole stream into DARE packages."""
     if len(key) != KEY_SIZE:
         raise ValueError("DARE needs a 32-byte key")
-    aead = AESGCM(key)
+    aead = _aead(key)
     base_nonce = bytearray(os.urandom(12))
     base_nonce[0] &= 0x7F          # reserve the final-marker bit
     base_nonce = bytes(base_nonce)
@@ -127,7 +148,6 @@ def _decrypt_package(aead: AESGCM, pkg: bytes, seq: int, final: bool,
     base = bytes(base)
     if expect_base is not None and base != expect_base:
         raise DAREError("package out of sequence")
-    from cryptography.exceptions import InvalidTag
     try:
         plain = aead.decrypt(nonce, body, header)
     except InvalidTag as e:
@@ -139,7 +159,7 @@ def _decrypt_package(aead: AESGCM, pkg: bytes, seq: int, final: bool,
 
 def decrypt(key: bytes, ciphertext: bytes) -> bytes:
     """Decrypt a whole DARE stream, verifying order and final marker."""
-    aead = AESGCM(key)
+    aead = _aead(key)
     out = bytearray()
     off, seq = 0, 0
     ref_nonce: bytes | None = None
@@ -194,7 +214,7 @@ def decrypt_range(key: bytes,
     blob = read_cipher(c_off, c_end - c_off)
     if len(blob) != c_end - c_off:
         raise DAREError("short ciphertext read")
-    aead = AESGCM(key)
+    aead = _aead(key)
     out = bytearray()
     off = 0
     ref_nonce: bytes | None = None

@@ -476,7 +476,10 @@ def target_from_config(kind: str, cfg, target_id: str = "1"):
                           int(cfg.get(sub, "qos") or 0), store_dir=store)
     if kind == "nats":
         return NATSTarget(arn, cfg.get(sub, "address"),
-                          cfg.get(sub, "subject"), store_dir=store)
+                          cfg.get(sub, "subject"),
+                          user=cfg.get(sub, "username"),
+                          password=cfg.get(sub, "password"),
+                          store_dir=store)
     if kind == "nsq":
         return NSQTarget(arn, cfg.get(sub, "nsqd_address"),
                          cfg.get(sub, "topic"), store_dir=store)

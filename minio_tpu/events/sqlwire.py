@@ -238,9 +238,15 @@ class PostgresWireClient:
         return fields.get("M", "unknown error")
 
     def _startup(self, user: str, password: str, db: str) -> None:
+        # standard_conforming_strings is PINNED per session in the
+        # startup packet: interpolate() relies on backslashes being
+        # literal in PG string literals, and a server configured with
+        # the pre-9.1 default (off) would otherwise let a backslash in
+        # an attacker-controlled object key escape the quoted literal
         body = (struct.pack(">I", 196608)          # protocol 3.0
                 + b"user\x00" + user.encode() + b"\x00"
-                + b"database\x00" + db.encode() + b"\x00\x00")
+                + b"database\x00" + db.encode() + b"\x00"
+                + b"standard_conforming_strings\x00on\x00\x00")
         self.sock.sendall(struct.pack(">I", len(body) + 4) + body)
         while True:
             t, payload = self._read_msg()

@@ -38,8 +38,8 @@ from ..objectlayer.interface import (BucketExists, BucketInfo,
                                      BucketNotEmpty, BucketNotFound,
                                      InvalidPart, ListObjectsInfo,
                                      ObjectInfo, ObjectLayer,
-                                     ObjectNotFound, ObjectOptions,
-                                     PutObjectOptions)
+                                     ObjectNameInvalid, ObjectNotFound,
+                                     ObjectOptions, PutObjectOptions)
 from . import Gateway, GatewayError, GatewayUnsupported, register
 
 _API_VERSION = "2019-12-12"
@@ -295,6 +295,19 @@ def _part_block_id(upload_id: str, part_number: int) -> str:
     return f"{part_number:05d}.{upload_id}"
 
 
+_SYS_PREFIX = ".minio-tpu.sys"
+
+
+def _check_key(object_name: str) -> None:
+    """Reserved-namespace guard at the object-op ENTRY points: clients
+    must not read or corrupt the pending-multipart metadata stashes
+    under .minio-tpu.sys/ (list filtering alone only hides them —
+    direct GET/PUT/DELETE/COPY by name would still reach them)."""
+    if object_name == _SYS_PREFIX or \
+            object_name.startswith(_SYS_PREFIX + "/"):
+        raise ObjectNameInvalid(object_name)
+
+
 class AzureObjects(GatewayUnsupported, ObjectLayer):
     """ObjectLayer over the Blob wire client (azureObjects role,
     cmd/gateway/azure/gateway-azure.go:566 onward)."""
@@ -338,6 +351,7 @@ class AzureObjects(GatewayUnsupported, ObjectLayer):
     # objects
     def put_object(self, bucket: str, object_name: str, data,
                    opts: PutObjectOptions | None = None) -> ObjectInfo:
+        _check_key(object_name)
         opts = opts or PutObjectOptions()
         body = data if isinstance(data, bytes) else bytes(data)
         meta, ctype = _split_meta(opts.user_defined)
@@ -352,6 +366,7 @@ class AzureObjects(GatewayUnsupported, ObjectLayer):
 
     def get_object(self, bucket: str, object_name: str, offset: int = 0,
                    length: int = -1, opts: ObjectOptions | None = None):
+        _check_key(object_name)
         try:
             hdrs, data = self.client.get_blob(bucket, object_name,
                                               offset, length)
@@ -361,6 +376,7 @@ class AzureObjects(GatewayUnsupported, ObjectLayer):
 
     def get_object_info(self, bucket: str, object_name: str,
                         opts: ObjectOptions | None = None) -> ObjectInfo:
+        _check_key(object_name)
         try:
             hdrs = self.client.get_blob_properties(bucket, object_name)
         except AzureError as e:
@@ -369,6 +385,7 @@ class AzureObjects(GatewayUnsupported, ObjectLayer):
 
     def delete_object(self, bucket: str, object_name: str,
                       opts: ObjectOptions | None = None) -> ObjectInfo:
+        _check_key(object_name)
         try:
             self.client.delete_blob(bucket, object_name)
         except AzureError as e:
@@ -378,6 +395,8 @@ class AzureObjects(GatewayUnsupported, ObjectLayer):
     def copy_object(self, src_bucket: str, src_object: str,
                     dst_bucket: str, dst_object: str,
                     opts: PutObjectOptions | None = None) -> ObjectInfo:
+        _check_key(src_object)
+        _check_key(dst_object)
         opts = opts or PutObjectOptions()
         meta, _ = _split_meta(opts.user_defined)
         try:
@@ -428,6 +447,7 @@ class AzureObjects(GatewayUnsupported, ObjectLayer):
 
     def new_multipart_upload(self, bucket: str, object_name: str,
                              opts: PutObjectOptions | None = None) -> str:
+        _check_key(object_name)
         self.get_bucket_info(bucket)
         uid = uuid.uuid4().hex
         meta, ctype = _split_meta((opts or PutObjectOptions()).user_defined)
@@ -460,6 +480,7 @@ class AzureObjects(GatewayUnsupported, ObjectLayer):
 
     def put_object_part(self, bucket: str, object_name: str,
                         upload_id: str, part_number: int, data) -> str:
+        _check_key(object_name)
         body = bytes(data) if not isinstance(data, bytes) else data
         try:
             self.client.put_block(
@@ -473,6 +494,7 @@ class AzureObjects(GatewayUnsupported, ObjectLayer):
 
     def get_multipart_info(self, bucket: str, object_name: str,
                            upload_id: str) -> dict:
+        _check_key(object_name)
         if not self._staged(bucket, object_name, upload_id):
             raise ObjectNotFound(f"upload {upload_id}")
         return {"uploadId": upload_id, "bucket": bucket,
@@ -488,6 +510,7 @@ class AzureObjects(GatewayUnsupported, ObjectLayer):
 
     def list_object_parts(self, bucket: str, object_name: str,
                           upload_id: str):
+        _check_key(object_name)
         return [(int(b["id"].split(".", 1)[0]), "", b["size"])
                 for b in sorted(self._staged(bucket, object_name,
                                              upload_id),
@@ -508,6 +531,10 @@ class AzureObjects(GatewayUnsupported, ObjectLayer):
                                   upload_id: str,
                                   parts: list[tuple[int, str]]
                                   ) -> ObjectInfo:
+        # guarded too: a complete with an empty part list would commit
+        # an empty block list ON the stash blob — exactly the
+        # truncation _check_key exists to prevent
+        _check_key(object_name)
         staged = {b["id"] for b in self._staged(bucket, object_name,
                                                 upload_id)}
         ids = [_part_block_id(upload_id, n) for n, _ in parts]

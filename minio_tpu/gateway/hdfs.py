@@ -450,14 +450,34 @@ class HDFSObjects(GatewayUnsupported, ObjectLayer):
             raise InvalidPart(
                 f"upload {upload_id}: part never uploaded: {missing[0]}")
         dst = self._o(bucket, object_name)
+        # assemble under the upload's staging dir, then RENAME into
+        # place: a crash mid-assembly leaves only the staging file, so
+        # the destination is never a truncated object that looks
+        # complete (HDFS rename is atomic within one namespace)
+        assembly = self._mp(upload_id) + "/assembly"
         first = True
         for n, _ in parts:
             body = self.client.open(self._mp(upload_id) + f"/part.{n}")
             if first:
-                self.client.create(dst, body)      # CREATE, then APPEND
+                self.client.create(assembly, body)  # CREATE, then APPEND
                 first = False
             else:
-                self.client.append(dst, body)
+                self.client.append(assembly, body)
+        if first:
+            self.client.create(assembly, b"")
+        # RENAME does not create destination parents (unlike CREATE):
+        # a nested key needs its directory chain first
+        parent = dst.rsplit("/", 1)[0]
+        if parent:
+            self.client.mkdirs(parent)
+        if not self.client.rename(assembly, dst):
+            # HDFS rename refuses to replace an existing file: clear
+            # the old object and promote again — the destination is
+            # only ever absent or whole, never partial
+            self.client.delete(dst)
+            if not self.client.rename(assembly, dst):
+                raise HDFSError(500, "RenameFailed",
+                                f"could not promote {assembly} to {dst}")
         self.client.delete(self._mp(upload_id), recursive=True)
         return self.get_object_info(bucket, object_name)
 

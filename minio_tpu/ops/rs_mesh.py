@@ -27,6 +27,10 @@ from minio_tpu.parallel import mesh as mesh_mod
 from . import gf8, rs_kernels
 
 
+# version-compat shard_map resolution lives in parallel/mesh.py
+_shard_map_fn = mesh_mod._shard_map
+
+
 def _use_pallas() -> bool:
     """On TPU the per-device compute runs the fused pallas bitplane
     kernel (ops/rs_pallas.py, ~50 GiB/s/chip) with a ppermute ring
@@ -79,10 +83,11 @@ def _sharded_apply_pallas(mesh, r: int, kl: int, gs: int, tn: int,
     specs = dict(in_specs=(P("shard", None, None),
                            P("stripe", "shard", None)),
                  out_specs=P("stripe", None, None))
+    smap = _shard_map_fn()
     try:
-        fn = jax.shard_map(local, mesh=mesh, check_vma=False, **specs)
+        fn = smap(local, mesh=mesh, check_vma=False, **specs)
     except TypeError:                      # older JAX spells it check_rep
-        fn = jax.shard_map(local, mesh=mesh, check_rep=False, **specs)
+        fn = smap(local, mesh=mesh, check_rep=False, **specs)
     return jax.jit(fn)
 
 
@@ -230,10 +235,11 @@ def _fused_pallas(mesh, r: int, kl: int, gs: int, tn: int,
                            P("stripe", "shard", None)),
                  out_specs=(P("stripe", None, None),
                             P("stripe", None, None)))
+    smap = _shard_map_fn()
     try:
-        fn = jax.shard_map(local, mesh=mesh, check_vma=False, **specs)
+        fn = smap(local, mesh=mesh, check_vma=False, **specs)
     except TypeError:
-        fn = jax.shard_map(local, mesh=mesh, check_rep=False, **specs)
+        fn = smap(local, mesh=mesh, check_rep=False, **specs)
     return jax.jit(fn)
 
 

@@ -338,6 +338,7 @@ class DRWMutex:
         self.ttl_s = ttl_s
         self.acquire_timeout_s = acquire_timeout_s
         self._granted: list[bool] = [False] * len(lockers)
+        self._refresh_fails: list[int] = [0] * len(lockers)
         self._registered = False
         self._refreshing = False
         self._next_refresh = 0.0
@@ -423,6 +424,7 @@ class DRWMutex:
         deadline = time.monotonic() + timeout
         backoff = 0.002
         self._write = write
+        self._refresh_fails = [0] * len(self.lockers)
         self.lost.clear()
         while True:
             if self._try_acquire(write):
@@ -444,6 +446,13 @@ class DRWMutex:
         self._registered = True
         _REFRESHER.add(self)
 
+    # consecutive failed refresh rounds before a grant is presumed
+    # expired: refreshes run every ttl/3, so after 3 straight transport
+    # failures a full TTL has passed since the locker last heard from
+    # us — ITS copy of the grant has expired and another holder may
+    # already own the resource
+    REFRESH_FAILS_MAX = 3
+
     def _do_refresh(self) -> None:
         for i, lk in enumerate(self.lockers):
             if not self._granted[i]:
@@ -451,8 +460,15 @@ class DRWMutex:
             try:
                 if not lk.refresh(self.resource, self.uid, self.ttl_s):
                     self._granted[i] = False
-            except Exception:  # noqa: BLE001 — locker down:
-                pass           # transient; grant may still hold
+                self._refresh_fails[i] = 0
+            except Exception:  # noqa: BLE001 — locker unreachable: one
+                # blip is transient (the grant may still hold), but a
+                # PARTITION must not let the holder believe it is
+                # protected past the locker-side TTL (drwmutex refresh
+                # quorum loss under partition)
+                self._refresh_fails[i] += 1
+                if self._refresh_fails[i] >= self.REFRESH_FAILS_MAX:
+                    self._granted[i] = False
         # grants below quorum: the holder is no longer protected
         # (the reference cancels the op context on lost refresh
         # quorum, drwmutex.go startContinousLockRefresh)

@@ -1,0 +1,250 @@
+"""Network fault injection — the NaughtyDisk analog for the wire
+(storage/faulty.py's programmed-error pattern applied to TCP).
+
+``FaultyProxy`` sits between a client and an upstream HTTP/TCP server
+(RPC or S3 — both speak HTTP/1.1 over TCP here) and injects faults per
+ACCEPTED-CONNECTION NUMBER (1-based), exactly like NaughtyDisk programs
+errors per call number: deterministic, no wall-clock coin flips.
+Unprogrammed connections follow the ``default`` fault (pass-through
+when None).
+
+Fault kinds:
+
+* ``Fault.passthrough()``   — forward both directions untouched;
+* ``Fault.delay(s)``        — hold the connection for ``s`` seconds
+  before forwarding (tail-latency injection);
+* ``Fault.reset(after_bytes=n)`` — forward, then hard-RST the client
+  side after ``n`` upstream→client bytes (mid-body connection reset);
+* ``Fault.blackhole()``     — accept, swallow client bytes, never
+  answer (the peer that is "up" at TCP but dead above it — exercises
+  client deadlines, not error paths);
+* ``Fault.http_503(n=1)``   — answer ``n`` requests on the connection
+  with a canned 503 burst without contacting upstream, then close.
+
+Lives in the main package (not tests/) so chaos CLIs can drive it,
+mirroring storage/faulty.py's placement.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Fault:
+    kind: str                   # pass | delay | reset | blackhole | 503
+    delay_s: float = 0.0
+    after_bytes: int = 0
+
+    @classmethod
+    def passthrough(cls) -> "Fault":
+        return cls("pass")
+
+    @classmethod
+    def delay(cls, seconds: float) -> "Fault":
+        return cls("delay", delay_s=seconds)
+
+    @classmethod
+    def reset(cls, after_bytes: int = 0) -> "Fault":
+        return cls("reset", after_bytes=after_bytes)
+
+    @classmethod
+    def blackhole(cls) -> "Fault":
+        return cls("blackhole")
+
+    @classmethod
+    def http_503(cls) -> "Fault":
+        return cls("503")
+
+
+_CANNED_503 = (b"HTTP/1.1 503 Service Unavailable\r\n"
+               b"Content-Length: 0\r\nConnection: close\r\n\r\n")
+
+
+class FaultyProxy:
+    """Deterministic TCP fault proxy in front of one upstream."""
+
+    def __init__(self, upstream_host: str, upstream_port: int,
+                 plan: dict[int, Fault] | None = None,
+                 default: Fault | None = None,
+                 host: str = "127.0.0.1"):
+        self.upstream = (upstream_host, upstream_port)
+        self._plan = dict(plan or {})
+        self._default = default or Fault.passthrough()
+        self._mu = threading.Lock()
+        self._conn_nr = 0
+        self._stop = threading.Event()
+        self._live: set[socket.socket] = set()
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, 0))
+        self._lsock.listen(64)
+        self.host = host
+        self.port = self._lsock.getsockname()[1]
+        self._thread: threading.Thread | None = None
+
+    # -- programming -------------------------------------------------------
+
+    def program(self, conn_nr: int, fault: Fault) -> None:
+        """Program connection number ``conn_nr`` (1-based accept
+        order)."""
+        with self._mu:
+            self._plan[conn_nr] = fault
+
+    def set_default(self, fault: Fault | None) -> None:
+        """Fault applied to every unprogrammed connection (None =
+        pass-through); flipping this mid-test partitions / heals the
+        link for all NEW connections."""
+        with self._mu:
+            self._default = fault or Fault.passthrough()
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def connections_seen(self) -> int:
+        with self._mu:
+            return self._conn_nr
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "FaultyProxy":
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        with self._mu:
+            live = list(self._live)
+        for s in live:
+            try:
+                s.close()
+            except OSError:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _track(self, s: socket.socket) -> None:
+        with self._mu:
+            self._live.add(s)
+
+    def _untrack(self, s: socket.socket) -> None:
+        with self._mu:
+            self._live.discard(s)
+        try:
+            s.close()
+        except OSError:
+            pass
+
+    # -- serving -----------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._lsock.accept()
+            except OSError:
+                return
+            with self._mu:
+                self._conn_nr += 1
+                fault = self._plan.get(self._conn_nr, self._default)
+            self._track(client)
+            threading.Thread(target=self._serve, args=(client, fault),
+                             daemon=True).start()
+
+    def _serve(self, client: socket.socket, fault: Fault) -> None:
+        try:
+            if fault.kind == "blackhole":
+                # swallow everything, answer nothing: the client's own
+                # deadline is the only way out
+                while not self._stop.is_set():
+                    try:
+                        if not client.recv(65536):
+                            return
+                    except OSError:
+                        return
+            if fault.kind == "503":
+                # drain one request's worth of bytes then answer the
+                # canned burst; Connection: close keeps it one-shot
+                try:
+                    client.settimeout(5.0)
+                    client.recv(65536)
+                    client.sendall(_CANNED_503)
+                except OSError:
+                    pass
+                return
+            if fault.kind == "delay" and fault.delay_s > 0:
+                # programmed, fixed hold — not a random jitter
+                waited = 0.0
+                while waited < fault.delay_s and not self._stop.is_set():
+                    step = min(0.05, fault.delay_s - waited)
+                    time.sleep(step)
+                    waited += step
+            up = socket.create_connection(self.upstream, timeout=10.0)
+            self._track(up)
+            try:
+                t1 = threading.Thread(
+                    target=self._pipe, args=(client, up, None),
+                    daemon=True)
+                t1.start()
+                # upstream -> client carries the reset budget: a
+                # mid-BODY reset needs the response underway first
+                limit = fault.after_bytes if fault.kind == "reset" \
+                    else None
+                self._pipe(up, client, limit)
+                if fault.kind == "reset":
+                    # RST, not FIN: SO_LINGER(1, 0) makes close() send a
+                    # reset so the client sees ECONNRESET mid-body
+                    try:
+                        client.setsockopt(
+                            socket.SOL_SOCKET, socket.SO_LINGER,
+                            struct.pack("ii", 1, 0))
+                    except OSError:
+                        pass
+                t1.join(timeout=1.0)
+            finally:
+                self._untrack(up)
+        finally:
+            self._untrack(client)
+
+    def _pipe(self, src: socket.socket, dst: socket.socket,
+              byte_limit: int | None) -> None:
+        """Forward src→dst until EOF/error; with ``byte_limit``, stop
+        after that many bytes (the reset point)."""
+        forwarded = 0
+        while not self._stop.is_set():
+            try:
+                data = src.recv(65536)
+            except OSError:
+                return
+            if not data:
+                try:
+                    dst.shutdown(socket.SHUT_WR)
+                except OSError:
+                    pass
+                return
+            if byte_limit is not None:
+                room = byte_limit - forwarded
+                data = data[:max(room, 0)]
+                if room <= 0 or not data:
+                    return
+            try:
+                dst.sendall(data)
+            except OSError:
+                return
+            forwarded += len(data)
+            if byte_limit is not None and forwarded >= byte_limit:
+                return
+
+
+# convenience alias matching the issue's naming
+FaultyTransport = FaultyProxy

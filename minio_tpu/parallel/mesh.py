@@ -27,6 +27,18 @@ from jax.sharding import Mesh, PartitionSpec as P
 from minio_tpu.ops import gf8
 
 
+def _shard_map():
+    """jax.shard_map moved to the top level in newer JAX; this image's
+    0.4.x still exports it from jax.experimental.shard_map — resolve
+    whichever exists (gated dependency, no pinned jax upgrade)."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    from jax.experimental.shard_map import shard_map as fn2
+    return fn2
+
+
+
 def make_mesh(devices=None, stripe: int | None = None,
               shard: int | None = None) -> Mesh:
     """Build a ('stripe', 'shard') mesh over the given (or all) devices."""
@@ -105,7 +117,7 @@ def _sharded_apply(mesh: Mesh, n_rows: int, k: int):
     stripes over ``stripe``; partial products XOR-reduce via psum."""
     local = _local_gf2_kernel(
         n_rows, lambda acc: jax.lax.psum(acc, "shard"))
-    return jax.jit(jax.shard_map(local, mesh=mesh, **_SPECS))
+    return jax.jit(_shard_map()(local, mesh=mesh, **_SPECS))
 
 
 def distributed_apply(mesh: Mesh, M: np.ndarray,
@@ -181,9 +193,9 @@ def _ring_apply(mesh: Mesh, n_rows: int, k: int):
     # full sum) but not statically inferable through ppermute/fori_loop,
     # so replication checking is disabled for this kernel
     try:
-        fn = jax.shard_map(local, mesh=mesh, check_vma=False, **_SPECS)
+        fn = _shard_map()(local, mesh=mesh, check_vma=False, **_SPECS)
     except TypeError:                      # older JAX spells it check_rep
-        fn = jax.shard_map(local, mesh=mesh, check_rep=False, **_SPECS)
+        fn = _shard_map()(local, mesh=mesh, check_rep=False, **_SPECS)
     return jax.jit(fn)
 
 
@@ -227,7 +239,7 @@ def _grouped_apply(mesh: Mesh, n_rows: int, k: int):
     specs = dict(in_specs=(P("stripe", None, "shard"),
                            P("stripe", "shard", None)),
                  out_specs=P("stripe", None, None))
-    return jax.jit(jax.shard_map(local, mesh=mesh, **specs))
+    return jax.jit(_shard_map()(local, mesh=mesh, **specs))
 
 
 def distributed_reconstruct_mixed(
@@ -290,9 +302,9 @@ def _fused_encode_hash(mesh: Mesh, n_rows: int, k: int):
                  out_specs=(P("stripe", None, None),
                             P("stripe", None, None)))
     try:
-        fn = jax.shard_map(local, mesh=mesh, check_vma=False, **specs)
+        fn = _shard_map()(local, mesh=mesh, check_vma=False, **specs)
     except TypeError:
-        fn = jax.shard_map(local, mesh=mesh, check_rep=False, **specs)
+        fn = _shard_map()(local, mesh=mesh, check_rep=False, **specs)
     return jax.jit(fn)
 
 

@@ -25,11 +25,107 @@ import msgpack
 TOKEN_WINDOW_S = 15 * 60
 
 
+def sever_connections(conns) -> None:
+    """Hard-close a set of server-side sockets (shared by the RPC and
+    S3 servers' stop paths).  shutdown, not close — handler-held
+    rfile/wfile io-refs keep the fd open past close(), while SHUT_RDWR
+    cuts the TCP stream immediately so parked keep-alive handler
+    threads exit instead of serving a \"dead\" server."""
+    for c in conns:
+        try:
+            c.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            c.close()
+        except OSError:
+            pass
+
+
 class RPCError(Exception):
     def __init__(self, error_type: str, message: str):
         super().__init__(f"{error_type}: {message}")
         self.error_type = error_type
         self.message = message
+
+
+class CircuitBreaker:
+    """Node-level circuit breaker (the peer analog of the per-drive
+    breaker in storage/health.py; cmd/rest/client.go HealthCheckFn
+    role): ``fail_max`` CONSECUTIVE transport failures open the
+    circuit; while open every call fails fast (no timeout stacking);
+    after ``cooldown_s`` exactly ONE caller is admitted as the
+    half-open probe — its success closes the circuit, its failure
+    re-opens it for another cooldown.
+
+    Application-level errors (a typed FileNotFound from the peer) must
+    NOT be recorded — only transport failures say anything about the
+    peer's health.  ``clock`` is injectable so the chaos tier can step
+    time deterministically.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+    def __init__(self, fail_max: int = 3, cooldown_s: float = 3.0,
+                 clock=time.monotonic):
+        self.fail_max = max(1, int(fail_max))
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    @property
+    def state(self) -> str:
+        with self._mu:
+            return self._state
+
+    def ready(self) -> bool:
+        """True when a call could proceed (closed, half-open, or open
+        past cooldown).  Does NOT reserve the half-open probe — health
+        checks must not consume it."""
+        with self._mu:
+            if self._state != self.OPEN:
+                return True
+            return self._clock() - self._opened_at >= self.cooldown_s
+
+    def allow(self) -> bool:
+        """Admission check for one call.  In half-open, only the first
+        caller is admitted (as the probe); everyone else fails fast
+        until the probe reports."""
+        with self._mu:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN and \
+                    self._clock() - self._opened_at >= self.cooldown_s:
+                self._state = self.HALF_OPEN
+                self._probing = False
+            if self._state == self.HALF_OPEN and not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._mu:
+            self._state = self.CLOSED
+            self._failures = 0
+            self._probing = False
+
+    def record_failure(self) -> None:
+        with self._mu:
+            if self._state == self.HALF_OPEN:
+                # failed probe: straight back to open, fresh cooldown
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+                self._probing = False
+                return
+            self._failures += 1
+            if self._state == self.CLOSED and \
+                    self._failures >= self.fail_max:
+                self._state = self.OPEN
+                self._opened_at = self._clock()
 
 
 def mint_token(secret: str, path: str, now: float | None = None) -> str:
@@ -56,10 +152,21 @@ def check_token(secret: str, path: str, token: str,
 class RPCServer:
     """Registry + HTTP server for node-local services."""
 
+    # idle keep-alive deadline per connection: a peer that stops
+    # talking mid-stream cannot park a handler thread forever
+    # (cmd/http/server.go:185 read/idle deadlines, RPC plane)
+    IDLE_TIMEOUT_S = 60.0
+
     def __init__(self, secret: str, host: str = "127.0.0.1", port: int = 0):
         self.secret = secret
         self._services: dict[str, dict[str, callable]] = {}
         self._raw: dict[str, callable] = {}
+        # live connections, so stop() can sever them: without this a
+        # "stopped" server keeps answering on established keep-alive
+        # connections through parked handler threads — a killed peer
+        # that is not actually dead
+        self._conns: set = set()
+        self._conns_mu = threading.Lock()
         handler = self._make_handler()
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
@@ -91,6 +198,9 @@ class RPCServer:
 
     def stop(self) -> None:
         self.httpd.shutdown()
+        with self._conns_mu:
+            conns = list(self._conns)
+        sever_connections(conns)
         self.httpd.server_close()
 
     def _make_handler(srv_self):
@@ -100,6 +210,19 @@ class RPCServer:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            timeout = srv_self.IDLE_TIMEOUT_S
+
+            def setup(self):
+                super().setup()
+                with srv_self._conns_mu:
+                    srv_self._conns.add(self.connection)
+
+            def finish(self):
+                try:
+                    super().finish()
+                finally:
+                    with srv_self._conns_mu:
+                        srv_self._conns.discard(self.connection)
 
             def log_message(self, fmt, *args):
                 pass
@@ -225,10 +348,52 @@ class DynamicTimeout:
             self._samples.clear()
 
 
+class _StaleConn(Exception):
+    """A pooled keep-alive connection died under us (peer restarted
+    between calls).  ``sent`` records whether the request had already
+    left: a send-phase death provably never executed and is always
+    replayable; a response-phase death may have executed and is
+    replayable only for idempotent methods."""
+
+    def __init__(self, sent: bool):
+        super().__init__("stale pooled connection")
+        self.sent = sent
+
+
+def _policy_from_config():
+    """Resolve the shared breaker/retry knobs from the ``rpc`` kvconfig
+    subsystem (env-overridable: MT_RPC_BREAKER_FAILURES etc.).  Returns
+    (breaker_kwargs, retry_policy)."""
+    from ..utils.kvconfig import Config, parse_duration
+    from ..utils.retry import RetryBudget, RetryPolicy
+    cfg = Config()
+
+    def _int(subsys, key, default):
+        try:
+            return int(cfg.get(subsys, key))
+        except (KeyError, ValueError):
+            return default
+
+    breaker_kwargs = {
+        "fail_max": _int("rpc", "breaker_failures", 3),
+        "cooldown_s": parse_duration(cfg.get("rpc", "breaker_cooldown"),
+                                     3.0),
+    }
+    budget_cap = _int("rpc", "retry_budget", 10)
+    retry = RetryPolicy(
+        attempts=_int("rpc", "retry_attempts", 3),
+        base_s=parse_duration(cfg.get("rpc", "retry_base"), 0.05),
+        cap_s=parse_duration(cfg.get("rpc", "retry_cap"), 2.0),
+        budget=RetryBudget(budget_cap) if budget_cap > 0 else None)
+    return breaker_kwargs, retry
+
+
 class RPCClient:
     """Health-checked client to one peer node
-    (cmd/storage-rest-client.go:651 pattern: a failed call marks the peer
-    offline; a background or next-use probe brings it back).  Deadlines
+    (cmd/storage-rest-client.go:651 pattern, hardened): a node-level
+    CircuitBreaker fails calls to a dead peer fast and re-admits it via
+    a half-open probe; transient transport failures on idempotent calls
+    retry under the shared jittered-backoff RetryPolicy.  Deadlines
     adapt to observed latencies via DynamicTimeout."""
 
     # per-service deadline floors: bulk storage transfers legitimately
@@ -241,16 +406,20 @@ class RPCClient:
     POOL_MAX = 8    # idle keep-alive connections kept per peer
     # (cmd/rest/client.go:114 shared persistent transport)
 
-    def __init__(self, endpoint: str, secret: str, timeout: float = 30.0):
+    def __init__(self, endpoint: str, secret: str, timeout: float = 30.0,
+                 breaker: CircuitBreaker | None = None, retry=None):
         u = urllib.parse.urlsplit(endpoint)
         self.host, self.port = u.hostname, u.port
         self.endpoint = endpoint
         self.secret = secret
         self.timeout = timeout
         self._dyn: dict[str, DynamicTimeout] = {}
-        self._online = True
-        self._last_failure = 0.0
-        self._retry_after = 3.0
+        if breaker is None or retry is None:
+            bk, rp = _policy_from_config()
+            breaker = breaker or CircuitBreaker(**bk)
+            retry = retry or rp
+        self.breaker = breaker
+        self.retry = retry
         self._pool: list[http.client.HTTPConnection] = []
         self._pool_mu = threading.Lock()
 
@@ -284,83 +453,146 @@ class RPCClient:
         return dt
 
     def is_online(self) -> bool:
-        if not self._online and \
-                time.time() - self._last_failure > self._retry_after:
-            self._online = True  # optimistic reconnect on next call
-        return self._online
+        """Breaker view: False only while the circuit is open and still
+        cooling down (callers would fail fast); half-open (probe-ready)
+        reads as online so the next use doubles as the probe."""
+        return self.breaker.ready()
+
+    def _attempt(self, path: str, body: bytes, headers: dict, dyn
+                 ) -> tuple[int, bytes]:
+        """One request/response on one connection.  Raises _StaleConn
+        when a pooled keep-alive connection turned out dead in a phase
+        where a free replay is sound; any other transport failure is a
+        real peer failure (closes the connection, feeds the dynamic
+        deadline on timeouts)."""
+        conn, pooled = self._get_conn(dyn.timeout())
+        try:
+            conn.request("POST", path, body=body, headers=headers)
+        except socket.timeout as e:
+            conn.close()
+            dyn.log_failure()
+            raise RPCError("ConnectionError", str(e)) from e
+        except (OSError, http.client.HTTPException) as e:
+            conn.close()
+            if pooled:
+                raise _StaleConn(sent=False) from e
+            raise RPCError("ConnectionError", str(e)) from e
+        try:
+            resp = conn.getresponse()
+            status = resp.status
+            payload = resp.read()
+        except socket.timeout as e:
+            # only an actual deadline expiry carries a latency signal;
+            # instant errors must not inflate deadlines
+            conn.close()
+            dyn.log_failure()
+            raise RPCError("ConnectionError", str(e)) from e
+        except (OSError, http.client.HTTPException) as e:
+            conn.close()
+            if pooled and isinstance(e, (http.client.RemoteDisconnected,
+                                         ConnectionResetError,
+                                         BrokenPipeError)):
+                # the request may already have executed; the caller
+                # replays only if the method is idempotent
+                raise _StaleConn(sent=True) from e
+            raise RPCError("ConnectionError", str(e)) from e
+        self._put_conn(conn)
+        return status, payload
 
     def _roundtrip(self, path: str, body: bytes, service: str,
                    extra_headers: dict | None = None,
                    raw_response: bool = False,
                    idempotent: bool = False):
-        """One pooled request/response.  Keep-alive: a fully-drained
-        success returns the connection to the pool; any error closes it.
+        """Pooled request/response under the breaker + retry policy.
 
-        Stale-connection retry policy: a failure while SENDING on a
-        pooled connection is always retried once on a fresh connection
-        (the request never reached the peer); a failure while reading
-        the RESPONSE is retried only for ``idempotent`` calls — the
-        request may already have executed, and a replayed append must
-        never run twice."""
-        if not self.is_online():
+        Failure handling, in order: calls against an OPEN breaker fail
+        fast (PeerOffline, no connection attempt); a stale pooled
+        connection is replayed free on a fresh one (send-phase always —
+        the request never reached the peer — response-phase only for
+        ``idempotent`` calls, a replayed append must never run twice);
+        real transport failures feed the breaker and retry under the
+        shared jittered-backoff policy (idempotent-only, budget-capped).
+        """
+        if not self.breaker.allow():
             raise RPCError("PeerOffline", self.endpoint)
         dyn = self._dyn_for(service)
         headers = {
             "Authorization": f"Bearer {mint_token(self.secret, path)}",
             "Content-Type": "application/msgpack",
             **(extra_headers or {})}
+        from ..admin.metrics import GLOBAL as _mtr
         start = time.monotonic()
+        state = {"attempt": 0, "stale": 0}
 
-        def fail(conn, e, is_timeout=False):
-            conn.close()
-            self._online = False
-            self._last_failure = time.time()
-            if is_timeout:
-                dyn.log_failure()
-            from ..admin.metrics import GLOBAL as _mtr
+        def transport_failure(e: Exception) -> bool:
+            """Breaker + retry bookkeeping for one failed attempt;
+            True = retry now, False = the caller must raise.
+
+            Order matters: the breaker gates BEFORE the budget check —
+            a refused retry must not spend a budget token or sleep the
+            backoff (that would drain the anti-storm budget exactly
+            when every call is failing), and allow() runs before the
+            sleep so a half-open probe reservation is held across it."""
+            self.breaker.record_failure()
             _mtr.inc("mt_node_rpc_errors_total", {"service": service})
-            raise RPCError("ConnectionError", str(e)) from e
+            if not self.breaker.ready():
+                return False
+            if not self.retry.may_retry(state["attempt"], idempotent):
+                return False
+            if not self.breaker.allow():
+                return False
+            self.retry.wait(state["attempt"])
+            state["attempt"] += 1
+            return True
 
-        for attempt in (0, 1):
-            conn, pooled = self._get_conn(dyn.timeout())
-            retryable = pooled and attempt == 0
+        while True:
             try:
-                conn.request("POST", path, body=body, headers=headers)
-            except socket.timeout as e:
-                fail(conn, e, is_timeout=True)
-            except (OSError, http.client.HTTPException) as e:
-                conn.close()
-                if retryable:
-                    continue    # send failed: request never processed
-                fail(conn, e)
-            try:
-                resp = conn.getresponse()
-                status = resp.status
-                payload = resp.read()
-                break
-            except socket.timeout as e:
-                # only an actual deadline expiry carries a latency
-                # signal; instant errors must not inflate deadlines
-                fail(conn, e, is_timeout=True)
-            except (OSError, http.client.HTTPException) as e:
-                conn.close()
-                stale = isinstance(e, (http.client.RemoteDisconnected,
-                                       ConnectionResetError,
-                                       BrokenPipeError))
-                if retryable and stale and idempotent:
+                status, payload = self._attempt(path, body, headers, dyn)
+            except _StaleConn as e:
+                # bounded by pool depth: every replay pops one stale
+                # pooled connection; a fresh connection never raises this
+                if state["stale"] < self.POOL_MAX and \
+                        (not e.sent or idempotent):
+                    state["stale"] += 1
                     continue
-                fail(conn, e)
-        self._put_conn(conn)
+                if transport_failure(e):
+                    continue
+                raise RPCError("ConnectionError",
+                               str(e.__cause__ or e)) from e
+            except RPCError as e:
+                if transport_failure(e):
+                    continue
+                raise
+            if raw_response and status == 200:
+                doc = None
+                break
+            # decode INSIDE the retry loop: an undecodable reply (an
+            # intermediary's canned 5xx burst, a half-written response)
+            # is a transport failure to retry/trip the breaker on, not
+            # a crash in the unpacker
+            try:
+                doc = msgpack.unpackb(payload, raw=False)
+                if not isinstance(doc, dict):
+                    raise ValueError("non-document RPC reply")
+                break
+            except Exception as e:  # noqa: BLE001 — garbage bytes
+                if transport_failure(e):
+                    continue
+                raise RPCError(
+                    "BadResponse",
+                    f"HTTP {status}: undecodable RPC reply") from e
+        # transport success: the peer is alive even if it answers with a
+        # typed application error below
+        self.breaker.record_success()
+        self.retry.on_success()
         dyn.log_success(time.monotonic() - start)
         # inter-node family (cmd/metrics-v2.go getInterNodeMetrics):
         # traffic and call counts per RPC service
-        from ..admin.metrics import GLOBAL as _mtr
         _mtr.inc("mt_node_rpc_calls_total", {"service": service})
         _mtr.inc("mt_node_rpc_tx_bytes_total", value=len(body))
         _mtr.inc("mt_node_rpc_rx_bytes_total", value=len(payload))
-        if raw_response and status == 200:
+        if doc is None:
             return payload
-        doc = msgpack.unpackb(payload, raw=False)
         if not doc.get("ok"):
             raise RPCError(doc.get("error_type", "Unknown"),
                            doc.get("message", ""))

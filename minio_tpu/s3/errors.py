@@ -110,6 +110,9 @@ _ERRORS = {
     "SlowDown": APIError(
         "SlowDown", "Resource requested is unreadable, please reduce your "
         "request rate", 503),
+    "RequestTimeout": APIError(
+        "RequestTimeout", "Your socket connection to the server was not "
+        "read from or written to within the timeout period.", 408),
     "XMinioServerNotInitialized": APIError(
         "XMinioServerNotInitialized", "Server not initialized yet, please "
         "try again.", 503),

@@ -15,6 +15,7 @@ import hashlib
 import os
 import re
 import threading
+import time
 import urllib.parse
 import uuid
 import xml.etree.ElementTree as ET
@@ -137,17 +138,86 @@ def _xml(root: ET.Element) -> bytes:
 
 def _parse_duration(s: str) -> float:
     """'10s' / '2m' / '500ms' -> seconds (cmd/config duration keys)."""
-    s = s.strip()
-    try:
-        if s.endswith("ms"):
-            return float(s[:-2]) / 1000.0
-        if s.endswith("s"):
-            return float(s[:-1])
-        if s.endswith("m"):
-            return float(s[:-1]) * 60.0
-        return float(s)
-    except ValueError:
-        return 10.0
+    from ..utils.kvconfig import parse_duration
+    return parse_duration(s, 10.0)
+
+
+class _DeadlineRFile:
+    """Per-connection read deadline plumbing (cmd/http/server.go:185
+    setCtx read deadlines rebuilt for a blocking rfile).
+
+    Two regimes share one socket timeout: between requests and while
+    parsing the request line/headers, a flat ``header_timeout`` applies
+    (idle + slowloris-header cutoff).  While a handler reads a BODY the
+    wrapper is armed with an ABSOLUTE deadline: every read re-arms the
+    socket timeout to ``min(remaining, header_timeout)``, so a client
+    trickling one byte per interval cannot extend its total budget —
+    the per-recv timeout shrinks to whatever of the body deadline is
+    left (the slow-body watchdog)."""
+
+    def __init__(self, raw, sock, header_timeout: float):
+        self._raw = raw
+        self._sock = sock
+        self._header_timeout = header_timeout
+        self._deadline: float | None = None
+
+    def arm(self, budget_s: float) -> None:
+        self._deadline = time.monotonic() + budget_s
+
+    def disarm(self) -> None:
+        self._deadline = None
+        try:
+            self._sock.settimeout(self._header_timeout)
+        except OSError:
+            pass    # connection already torn down
+
+    def _tick(self) -> None:
+        if self._deadline is None:
+            return
+        remaining = self._deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError("request body deadline exceeded")
+        try:
+            self._sock.settimeout(min(remaining, self._header_timeout))
+        except OSError:
+            pass
+
+    def read(self, n: int = -1) -> bytes:
+        if self._deadline is None:
+            return self._raw.read(n)
+        # armed: one plain read(n) would loop on recv INSIDE the
+        # buffered reader — a client trickling bytes under the per-recv
+        # timeout would never surface the absolute deadline.  read1
+        # issues at most one syscall, so every recv is preceded by a
+        # deadline check and capped at the remaining budget.
+        out = bytearray()
+        want = n if n is not None and n >= 0 else None
+        while want is None or len(out) < want:
+            self._tick()
+            chunk = self._raw.read1(
+                65536 if want is None else want - len(out))
+            if not chunk:
+                break
+            out += chunk
+        return bytes(out)
+
+    def readline(self, limit: int = -1) -> bytes:
+        self._tick()
+        return self._raw.readline(limit)
+
+    def readinto(self, b) -> int:
+        self._tick()
+        return self._raw.readinto(b)
+
+    def close(self) -> None:
+        self._raw.close()
+
+    @property
+    def closed(self):
+        return self._raw.closed
+
+    def __getattr__(self, name):
+        return getattr(self._raw, name)
 
 
 def _try(fn):
@@ -266,6 +336,11 @@ class S3Server:
                 logging.getLogger("minio_tpu").warning(
                     "native snappy codec unavailable; using the pure-"
                     "Python fallback (slow)")
+        # live connections, so stop() can sever parked keep-alive
+        # handlers instead of leaving zombie threads serving a
+        # "stopped" server
+        self._conns: set = set()
+        self._conns_mu = threading.Lock()
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
@@ -285,6 +360,15 @@ class S3Server:
         # requestsPool/requestsDeadline; config keys cmd/config/api):
         # bounds concurrent S3 requests; excess waits up to the deadline
         # then gets 503 SlowDown instead of piling up threads
+        self._req_waiters = 0
+        self._req_waiters_mu = threading.Lock()
+        self._req_max = 0
+        self.reload_api_config()
+
+    def reload_api_config(self) -> None:
+        """(Re)derive the request-plane knobs from the ``api`` kvconfig
+        subsystem — called at boot and after admin SetConfigKV so an
+        operator can retune deadlines/limits on a live server."""
         try:
             req_max = int(self.config.get("api", "requests_max") or 0)
         except ValueError:
@@ -293,7 +377,43 @@ class S3Server:
             req_max = 16 * (os.cpu_count() or 8)   # auto sizing
         self.requests_deadline_s = _parse_duration(
             self.config.get("api", "requests_deadline") or "10s")
-        self._req_sem = threading.BoundedSemaphore(req_max)
+        if req_max != self._req_max:
+            # swap, never resize: in-flight requests release to the
+            # semaphore they acquired (dispatch captures the object)
+            self._req_max = req_max
+            self._req_sem = threading.BoundedSemaphore(req_max)
+        # load shedding (cmd/handler-api.go maxClients 503 path): bound
+        # the WAITING line too — when the queue is full a request is
+        # shed immediately with 503 + Retry-After instead of parking
+        # one more worker thread behind the semaphore
+        try:
+            req_queue = int(self.config.get("api", "requests_queue")
+                            or 0)
+        except ValueError:
+            req_queue = 0
+        self.requests_queue_max = req_queue if req_queue > 0 \
+            else 2 * req_max
+        # per-connection deadlines (cmd/http/server.go:185): header/idle
+        # socket timeout + slow-body budget per request (scaled by the
+        # declared size over the floor rate, so a large upload making
+        # progress is never cut while a trickler cannot stall forever)
+        self.read_header_timeout_s = _parse_duration(
+            self.config.get("api", "read_header_timeout") or "30s")
+        self.body_deadline_s = _parse_duration(
+            self.config.get("api", "body_deadline") or "2m")
+        try:
+            self.body_min_rate_bps = int(
+                self.config.get("api", "body_min_rate") or 0)
+        except ValueError:
+            self.body_min_rate_bps = 1 << 20
+
+    def body_budget_s(self, content_length: int) -> float:
+        """Read budget for one request body: the flat deadline plus
+        declared-size / floor-rate headroom."""
+        budget = self.body_deadline_s
+        if content_length > 0 and self.body_min_rate_bps > 0:
+            budget += content_length / self.body_min_rate_bps
+        return budget
 
     def attach_tracker(self, tracker) -> None:
         """Wire the data-update tracker into event marking AND listing-
@@ -335,6 +455,11 @@ class S3Server:
             except Exception:  # noqa: BLE001 — shutdown must proceed
                 pass
         self.httpd.shutdown()
+        # parked keep-alive handlers must die with the server
+        from ..parallel.rpc import sever_connections
+        with self._conns_mu:
+            conns = list(self._conns)
+        sever_connections(conns)
         self.httpd.server_close()
         self.events.close()
         if self.peers is not None:
@@ -424,6 +549,27 @@ def _make_handler(srv: S3Server):
         server_version = "MinioTPU"
 
         # -- plumbing ------------------------------------------------------
+
+        def setup(self):
+            # per-connection deadlines (cmd/http/server.go:185): the
+            # socket timeout covers request-line/header reads and
+            # keep-alive idle; the rfile wrapper adds the absolute
+            # slow-body budget, armed per request in _dispatch.
+            # (header SIZE is already bounded by http.server: 64 KiB
+            # per line, 100 headers max)
+            self.timeout = getattr(srv, "read_header_timeout_s", None)
+            super().setup()
+            self.rfile = _DeadlineRFile(self.rfile, self.connection,
+                                        self.timeout or 30.0)
+            with srv._conns_mu:
+                srv._conns.add(self.connection)
+
+        def finish(self):
+            try:
+                super().finish()
+            finally:
+                with srv._conns_mu:
+                    srv._conns.discard(self.connection)
 
         def log_message(self, fmt, *args):  # quiet; tracing hooks later
             pass
@@ -620,6 +766,13 @@ def _make_handler(srv: S3Server):
                 # lock contention is congestion, not a server fault
                 # (the reference maps operation timeouts to 503)
                 api = s3err.get("SlowDown")
+            elif isinstance(e, TimeoutError):
+                # read deadline fired mid-body (slowloris cutoff):
+                # 408, and the connection must drop — the unread body
+                # bytes would desync keep-alive (socket.timeout is a
+                # TimeoutError alias since 3.10)
+                api = s3err.get("RequestTimeout")
+                self.close_connection = True
             else:
                 api = s3err.get("InternalError")
             self._send(api.http_status,
@@ -647,10 +800,14 @@ def _make_handler(srv: S3Server):
             # srv._req_sem mid-flight, and acquire/release must pair on
             # the same semaphore
             sem = srv._req_sem if throttled else None
-            if sem is not None and not sem.acquire(
-                    timeout=srv.requests_deadline_s):
+            if sem is not None and not self._admit(sem):
+                retry_after = max(1, int(srv.requests_deadline_s))
                 try:
-                    self._fail(S3Error("SlowDown"))
+                    api = s3err.get("SlowDown")
+                    self._send(api.http_status,
+                               s3err.to_xml(api, self.path,
+                                            self._req_id),
+                               headers={"Retry-After": str(retry_after)})
                 finally:
                     self.close_connection = True
                     try:    # 503s must show up in trace/audit streams
@@ -658,15 +815,40 @@ def _make_handler(srv: S3Server):
                     except Exception:  # noqa: BLE001
                         pass
                 return
+            # slow-body watchdog: absolute per-request budget for
+            # reading the body (size-scaled), armed for everything
+            # _dispatch_inner pulls off the wire
+            try:
+                cl = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                cl = 0
+            self.rfile.arm(srv.body_budget_s(cl))
             try:
                 self._dispatch_inner()
             finally:
+                self.rfile.disarm()
                 if sem is not None:
                     sem.release()
                 try:
                     self._record_request()
                 except Exception:   # noqa: BLE001 — never fail a request
                     pass            # on account of observability
+
+        def _admit(self, sem) -> bool:
+            """Request-pool admission: wait up to the deadline for a
+            slot, but only while the waiting line is short — a full
+            queue sheds IMMEDIATELY (503 + Retry-After) instead of
+            parking yet another thread (requestsPool deadline,
+            cmd/handler-api.go:29-40)."""
+            with srv._req_waiters_mu:
+                if srv._req_waiters >= srv.requests_queue_max:
+                    return False
+                srv._req_waiters += 1
+            try:
+                return sem.acquire(timeout=srv.requests_deadline_s)
+            finally:
+                with srv._req_waiters_mu:
+                    srv._req_waiters -= 1
 
         def _record_request(self):
             from ..obs import trace as _trace

@@ -461,6 +461,12 @@ def _handle_download(h, srv, path: str, query: dict) -> None:
         rng = h.headers.get("Range", "")
         m = re.fullmatch(r"bytes=(\d+)-(\d*)", rng.strip()) if rng \
             else None
+        if m and m.group(2) and int(m.group(2)) < int(m.group(1)):
+            # an EXPLICIT last < first is a syntactically invalid
+            # range — RFC 9110 §14.1.1 says ignore the header entirely
+            # (an open-ended 'bytes=N-' stays subject to the
+            # satisfiability check below)
+            m = None
         if m:
             # ranged read through the LAYER (offset/length), not a
             # full materialize-then-slice: preview of a multi-GiB
@@ -469,13 +475,18 @@ def _handle_download(h, srv, path: str, query: dict) -> None:
             lo = int(m.group(1))
             hi = min(int(m.group(2)) if m.group(2) else total - 1,
                      total - 1)
-            if lo <= hi:
-                info, data = srv.layer.get_object(
-                    bucket, key, offset=lo, length=hi - lo + 1)
-                status = 206
-            else:
-                info, data = srv.layer.get_object(bucket, key)
-                total = len(data)
+            if lo >= total:
+                # valid but unsatisfiable: 416 + the total the client
+                # needs to re-range (RFC 9110 §14.4), never a silent
+                # 200 with the whole object
+                h.send_response(416)
+                h.send_header("Content-Range", f"bytes */{total}")
+                h.send_header("Content-Length", "0")
+                h.end_headers()
+                return
+            info, data = srv.layer.get_object(
+                bucket, key, offset=lo, length=hi - lo + 1)
+            status = 206
         else:
             info, data = srv.layer.get_object(bucket, key)
             total = len(data)

@@ -41,11 +41,55 @@ def register_subsys(name: str, defaults: dict[str, str],
     _REGISTRY[name] = SubsysSpec(name, dict(defaults), help_kvs or [])
 
 
+def parse_duration(s: str, default: float = 10.0) -> float:
+    """'10s' / '2m' / '500ms' -> seconds (cmd/config duration keys)."""
+    s = (s or "").strip()
+    try:
+        if s.endswith("ms"):
+            return float(s[:-2]) / 1000.0
+        if s.endswith("s"):
+            return float(s[:-1])
+        if s.endswith("m"):
+            return float(s[:-1]) * 60.0
+        return float(s)
+    except ValueError:
+        return default
+
+
 # built-in subsystems (subset of the reference's 15+, grows with features)
 register_subsys("api", {
     "requests_max": "0",            # 0 = auto
     "requests_deadline": "10s",
+    # load shedding: waiters beyond this queue depth are shed with
+    # 503 + Retry-After immediately instead of parking a thread
+    # (0 = auto: 2x requests_max)
+    "requests_queue": "0",
+    # per-connection deadlines (cmd/http/server.go:185 analog): socket
+    # timeout while reading the request line/headers and between
+    # keep-alive requests, and the budget for reading one request
+    # body — a slowloris trickling bytes resets per-recv timeouts but
+    # cannot outlive the body budget.  The budget scales with the
+    # declared size so large legitimate uploads are never cut while
+    # making progress: body_deadline + Content-Length / body_min_rate
+    # (bytes/sec; 0 disables the scaling term)
+    "read_header_timeout": "30s",
+    "body_deadline": "2m",
+    "body_min_rate": "1048576",     # 1 MiB/s floor rate
     "cors_allow_origin": "*",
+})
+register_subsys("rpc", {
+    # node-level circuit breaker (parallel/rpc.py CircuitBreaker):
+    # consecutive transport failures before the peer opens, and how
+    # long it stays open before a half-open probe is admitted
+    "breaker_failures": "3",
+    "breaker_cooldown": "3s",
+    # shared jittered-exponential retry policy (utils/retry.py):
+    # total attempts (first try included), backoff base/cap, and the
+    # retry-budget bucket capacity (0 disables the budget)
+    "retry_attempts": "3",
+    "retry_base": "50ms",
+    "retry_cap": "2s",
+    "retry_budget": "10",
 })
 register_subsys("storage_class", {
     "standard": "",                 # e.g. EC:4
@@ -113,7 +157,8 @@ register_subsys("notify_kafka", {"enable": "off", "brokers": "",
 register_subsys("notify_mqtt", {"enable": "off", "broker": "",
                                 "topic": "", "qos": "0", "queue_dir": ""})
 register_subsys("notify_nats", {"enable": "off", "address": "",
-                                "subject": "", "queue_dir": ""})
+                                "subject": "", "username": "",
+                                "password": "", "queue_dir": ""})
 register_subsys("notify_nsq", {"enable": "off", "nsqd_address": "",
                                "topic": "", "queue_dir": ""})
 register_subsys("notify_redis", {"enable": "off", "address": "",

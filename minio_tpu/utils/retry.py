@@ -1,0 +1,93 @@
+"""Shared retry policy — jittered exponential backoff with a retry
+budget (cmd/rest retry + the gRPC retry-throttling token bucket).
+
+One policy object is shared by every caller on a transport (an RPC
+client, a gateway wire client): the *budget* is what keeps a cluster-
+wide incident from turning into a retry storm — when most requests are
+failing, the bucket drains and retries stop, so the recovering peer
+sees offered load, not offered load times attempts.
+
+Everything nondeterministic is injectable (``rng``, ``sleep``) so the
+chaos tier can drive the policy with a seeded generator and a recording
+sleep — no wall-clock races in tests.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+
+class RetryBudget:
+    """Token-bucket retry throttle (the gRPC retryThrottling analog):
+    each retry spends one token, each SUCCESS refunds ``refund`` tokens
+    (capped).  When the bucket cannot cover a whole token, retries are
+    refused — first-attempt traffic always passes, only the multiplier
+    is shed."""
+
+    def __init__(self, capacity: float = 10.0, refund: float = 0.5):
+        self.capacity = float(capacity)
+        self.refund = float(refund)
+        self._tokens = float(capacity)
+        self._mu = threading.Lock()
+
+    def try_spend(self) -> bool:
+        with self._mu:
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def credit(self) -> None:
+        with self._mu:
+            self._tokens = min(self.capacity, self._tokens + self.refund)
+
+    @property
+    def tokens(self) -> float:
+        with self._mu:
+            return self._tokens
+
+
+class RetryPolicy:
+    """Jittered exponential backoff, idempotent-only, budget-capped.
+
+    ``attempts`` counts the FIRST try: attempts=3 means at most two
+    retries.  Backoff uses full jitter (uniform over [0, min(cap,
+    base * 2^retry)]) so synchronized clients spread out instead of
+    retrying in lockstep against a struggling peer.
+    """
+
+    def __init__(self, attempts: int = 3, base_s: float = 0.05,
+                 cap_s: float = 2.0, budget: RetryBudget | None = None,
+                 rng: random.Random | None = None, sleep=time.sleep):
+        self.attempts = max(1, int(attempts))
+        self.base_s = base_s
+        self.cap_s = cap_s
+        self.budget = budget
+        self.rng = rng or random.Random()
+        self.sleep = sleep
+
+    def backoff_s(self, retry_nr: int) -> float:
+        """Jittered delay before retry number ``retry_nr`` (0-based)."""
+        return self.rng.uniform(
+            0.0, min(self.cap_s, self.base_s * (2 ** retry_nr)))
+
+    def may_retry(self, attempt: int, idempotent: bool) -> bool:
+        """attempt is 0-based (0 = the first try just failed).  Only
+        idempotent work may be replayed — the request may already have
+        executed on the far side — and only while the budget holds."""
+        if attempt + 1 >= self.attempts:
+            return False
+        if not idempotent:
+            return False
+        if self.budget is not None and not self.budget.try_spend():
+            return False
+        return True
+
+    def wait(self, attempt: int) -> None:
+        self.sleep(self.backoff_s(attempt))
+
+    def on_success(self) -> None:
+        if self.budget is not None:
+            self.budget.credit()

@@ -801,6 +801,7 @@ class PostgresStubBroker(_TCPStub):
         self.password = password
         self.sql = _SQLState(backslash_escapes=False)
         self.auth_failures = 0
+        self.startup_params: dict = {}
 
     def _session(self, conn):
         import os as _os
@@ -825,6 +826,7 @@ class PostgresStubBroker(_TCPStub):
         kv = startup[4:].split(b"\x00")
         params = {kv[i].decode(): kv[i + 1].decode()
                   for i in range(0, len(kv) - 1, 2) if kv[i]}
+        self.startup_params = params
         salt = _os.urandom(4)
         send(b"R", struct.pack(">I", 5) + salt)    # MD5 auth request
         t, body = read_msg()

@@ -196,6 +196,9 @@ def test_bucket_quota_admin(client):
     assert doc["quota"] == 1048576
 
 
+@pytest.mark.skipif(
+    __import__("minio_tpu.crypto.dare", fromlist=["AESGCM"]).AESGCM is None,
+    reason="cryptography (AES-GCM backend) not installed")
 def test_kms_key_status(client):
     doc = json.loads(_admin(client, "GET", "kms-key-status").body)
     assert doc["encryption_ok"] and doc["decryption_ok"]
@@ -221,6 +224,9 @@ def test_service_action_validation(client):
     assert b"unknown action" in r.body
 
 
+@pytest.mark.skipif(
+    __import__("minio_tpu.crypto.dare", fromlist=["AESGCM"]).AESGCM is None,
+    reason="cryptography (AES-GCM backend) not installed")
 def test_admin_client_sdk(server, tmp_path):
     """pkg/madmin analog: the typed AdminClient drives the same routes."""
     from minio_tpu.admin.client import AdminClient, AdminError

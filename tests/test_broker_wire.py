@@ -513,3 +513,45 @@ def test_postgresql_backslashes_survive():
             'q"uoted\\pathé'
     finally:
         broker.stop()
+
+
+def test_postgresql_pins_standard_conforming_strings():
+    """The startup packet pins standard_conforming_strings=on per
+    session: interpolate() sends backslashes literally for PG, and a
+    server configured with the pre-9.1 default (off) would otherwise
+    let a backslash in an attacker-controlled key escape the literal
+    (ADVICE round 5)."""
+    from minio_tpu.events.brokers import PostgreSQLTarget
+    from .broker_stubs import PostgresStubBroker
+    broker = PostgresStubBroker().start()
+    try:
+        t = PostgreSQLTarget(
+            "arn:minio:sqs::1:postgresql",
+            f"host=127.0.0.1 port={broker.port} user=evuser "
+            f"password=evpass dbname=minio", "events_scs")
+        t.send(_record(key='w\\"eird\\u00e9.bin'))
+        assert broker.startup_params.get(
+            "standard_conforming_strings") == "on"
+        # the backslashes in the key survive the round trip verbatim
+        assert 'evb/w\\"eird\\u00e9.bin' in broker.sql.tables["events_scs"]
+    finally:
+        broker.stop()
+
+
+def test_nats_credentials_ride_connect():
+    """username/password from the notify_nats config must reach the
+    CONNECT frame so an authenticated NATS server admits the target
+    (ADVICE round 5)."""
+    from minio_tpu.events.brokers import NATSTarget
+    from .broker_stubs import NATSStubBroker
+    broker = NATSStubBroker().start()
+    try:
+        t = NATSTarget("arn:minio:sqs::1:nats",
+                       f"127.0.0.1:{broker.port}", "authevents",
+                       user="evuser", password="evpass")
+        t.send(_record())
+        assert broker.connects[0]["user"] == "evuser"
+        assert broker.connects[0]["pass"] == "evpass"
+        assert len(broker.published) == 1
+    finally:
+        broker.stop()

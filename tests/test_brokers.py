@@ -156,3 +156,18 @@ def test_all_kinds_constructible_from_config(monkeypatch):
                             # in test_broker_wire.py
         with pytest.raises(TargetError):     # gated: no SDK in the image
             t._deliver(RECORD)
+
+
+def test_nats_auth_threads_through_config(monkeypatch):
+    """notify_nats username/password keys flow end to end into the
+    target (ADVICE round 5)."""
+    monkeypatch.setenv("MT_NOTIFY_NATS_ENABLE", "on")
+    monkeypatch.setenv("MT_NOTIFY_NATS_ADDRESS", "nats.example:4222")
+    monkeypatch.setenv("MT_NOTIFY_NATS_SUBJECT", "events")
+    monkeypatch.setenv("MT_NOTIFY_NATS_USERNAME", "evuser")
+    monkeypatch.setenv("MT_NOTIFY_NATS_PASSWORD", "evpass")
+    cfg = Config()
+    t = brokers.target_from_config("nats", cfg)
+    assert isinstance(t, brokers.NATSTarget)
+    assert t.user == "evuser"
+    assert t.password == "evpass"

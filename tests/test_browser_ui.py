@@ -347,3 +347,44 @@ def test_download_head_error_has_no_body(server):
     assert resp.status == 404
     assert resp.read() == b""
     assert resp.headers["Content-Length"] == "0"
+
+
+def test_download_unsatisfiable_range_gets_416(server):
+    """An unsatisfiable Range must answer 416 + 'Content-Range:
+    bytes */total', not a silent 200 with the whole object (ADVICE
+    round 5)."""
+    tok = _rpc(server, "Login", {"username": "uikey",
+                                 "password": "uisecret"})["token"]
+    _rpc(server, "MakeBucket", {"bucketName": "rngb"}, tok)
+    body = b"0123456789" * 10
+    req = urllib.request.Request(
+        f"{server.endpoint}/minio-tpu/upload/rngb/r.bin",
+        data=body, method="PUT",
+        headers={"Authorization": f"Bearer {tok}"})
+    urllib.request.urlopen(req, timeout=10).read()
+    url_tok = _rpc(server, "CreateURLToken", {}, tok)["token"]
+    dl = f"/minio-tpu/download/rngb/r.bin?token={url_tok}"
+    for spec in [f"bytes={len(body)}-", "bytes=500-600"]:
+        req = urllib.request.Request(server.endpoint + dl,
+                                     headers={"Range": spec})
+        try:
+            resp = urllib.request.urlopen(req, timeout=10)
+            raise AssertionError(
+                f"{spec}: got {resp.status}, wanted 416")
+        except urllib.error.HTTPError as e:
+            assert e.code == 416, spec
+            assert e.headers["Content-Range"] == f"bytes */{len(body)}"
+            assert e.read() == b""
+    # a syntactically INVALID range (last < first) is IGNORED, not
+    # 416'd (RFC 9110 §14.1.1): full object, 200
+    req = urllib.request.Request(server.endpoint + dl,
+                                 headers={"Range": "bytes=9-2"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.status == 200
+        assert resp.read() == body
+    # a satisfiable range still works
+    req = urllib.request.Request(server.endpoint + dl,
+                                 headers={"Range": "bytes=0-9"})
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.status == 206
+        assert resp.read() == body[:10]

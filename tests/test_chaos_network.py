@@ -1,0 +1,409 @@
+"""Network chaos tier — deterministic fault injection against the
+request plane (the wire analog of the NaughtyDisk storage tests).
+
+Covers the acceptance scenarios of the resilience layer:
+  * slowloris on the S3 port is cut off at the configured deadline
+    while concurrent PUT/GET traffic completes unimpeded;
+  * saturated request pool sheds with 503 + Retry-After;
+  * killing one peer mid-PUT yields a quorum-committed object, the
+    node breaker opens within N failures, and the restarted peer is
+    re-admitted via a half-open probe;
+  * lock refresh under partition surfaces LockLost instead of letting
+    the holder believe it is protected past the locker-side TTL;
+  * FaultyProxy programs (503 burst, mid-body reset, black-hole) by
+    connection number — programmed faults, no wall-clock coin flips.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from minio_tpu.parallel.dsync import (DRWMutex, LocalLocker, LockLost,
+                                      RemoteLocker,
+                                      register_lock_service)
+from minio_tpu.parallel.faulty import Fault, FaultyProxy
+from minio_tpu.parallel.rpc import (CircuitBreaker, RPCClient, RPCError,
+                                    RPCServer)
+from minio_tpu.utils.retry import RetryPolicy
+
+
+def _no_retry_client(endpoint, fail_max=100, cooldown_s=60.0,
+                     timeout=5.0):
+    return RPCClient(endpoint, "testsecret", timeout=timeout,
+                     breaker=CircuitBreaker(fail_max=fail_max,
+                                            cooldown_s=cooldown_s),
+                     retry=RetryPolicy(attempts=1))
+
+
+# -- FaultyProxy programs ---------------------------------------------------
+
+@pytest.fixture
+def upstream():
+    srv = RPCServer("testsecret")
+    srv.register("t", {"echo": lambda x: x})
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_proxy_passthrough_and_programmed_503(upstream):
+    proxy = FaultyProxy("127.0.0.1", upstream.port,
+                        plan={2: Fault.http_503()}).start()
+    try:
+        c = _no_retry_client(proxy.endpoint)
+        assert c.call("t", "echo", x=1) == 1        # conn 1: clean
+        c2 = _no_retry_client(proxy.endpoint)       # fresh pool ->
+        with pytest.raises(RPCError):               # conn 2: 503 burst
+            c2.call("t", "echo", x=2)
+        c3 = _no_retry_client(proxy.endpoint)
+        assert c3.call("t", "echo", x=3) == 3       # conn 3: clean again
+    finally:
+        proxy.stop()
+
+
+def test_proxy_mid_body_reset_detected(upstream):
+    """A connection RST mid-response must surface as a transport error
+    (and a breaker failure), never as a short read treated as truth."""
+    proxy = FaultyProxy("127.0.0.1", upstream.port,
+                        plan={1: Fault.reset(after_bytes=5)}).start()
+    try:
+        c = _no_retry_client(proxy.endpoint, fail_max=1)
+        with pytest.raises(RPCError):
+            c.call("t", "echo", x="Z" * 4096)
+        assert c.breaker.state == CircuitBreaker.OPEN
+    finally:
+        proxy.stop()
+
+
+def test_proxy_blackhole_hits_client_deadline(upstream):
+    """A peer that accepts but never answers is bounded by the client
+    deadline, not forever."""
+    proxy = FaultyProxy("127.0.0.1", upstream.port,
+                        default=Fault.blackhole()).start()
+    try:
+        c = _no_retry_client(proxy.endpoint, timeout=1.0)
+        c._dyn_for("t")._timeout = 1.0      # pin the adaptive deadline
+        t0 = time.monotonic()
+        with pytest.raises(RPCError):
+            c.call("t", "echo", x=1)
+        assert time.monotonic() - t0 < 10.0
+    finally:
+        proxy.stop()
+
+
+def test_proxy_503_burst_trips_breaker_then_heals(upstream):
+    """A 5xx-bursting intermediary opens the node breaker (fail fast);
+    healing the link re-admits the peer via the half-open probe."""
+    clock = [0.0]
+    proxy = FaultyProxy("127.0.0.1", upstream.port,
+                        default=Fault.http_503()).start()
+    try:
+        c = RPCClient(proxy.endpoint, "testsecret",
+                      breaker=CircuitBreaker(fail_max=2, cooldown_s=5.0,
+                                             clock=lambda: clock[0]),
+                      retry=RetryPolicy(attempts=1))
+        for _ in range(2):
+            with pytest.raises(RPCError):
+                c.call("t", "echo", x=1)
+        assert c.breaker.state == CircuitBreaker.OPEN
+        with pytest.raises(RPCError) as ei:
+            c.call("t", "echo", x=1)
+        assert ei.value.error_type == "PeerOffline"
+        proxy.set_default(Fault.passthrough())      # heal the link
+        clock[0] = 6.0                              # cooldown elapses
+        assert c.call("t", "echo", x=1) == 1        # probe re-admits
+        assert c.breaker.state == CircuitBreaker.CLOSED
+    finally:
+        proxy.stop()
+
+
+# -- S3 frontend: slowloris + shed ------------------------------------------
+
+@pytest.fixture
+def s3_server(tmp_path, monkeypatch):
+    monkeypatch.setenv("MT_API_READ_HEADER_TIMEOUT", "500ms")
+    monkeypatch.setenv("MT_API_BODY_DEADLINE", "1s")
+    # pin the budget to exactly the deadline (no size-scaled headroom)
+    monkeypatch.setenv("MT_API_BODY_MIN_RATE", "0")
+    from minio_tpu.objectlayer.erasure_object import ErasureObjects
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.storage.xl_storage import XLStorage
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"disk{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=256 * 1024,
+                           backend="numpy")
+    srv = S3Server(layer, access_key="testkey", secret_key="testsecret")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_slowloris_header_cut_at_deadline(s3_server):
+    s = socket.create_connection(("127.0.0.1", s3_server.port))
+    try:
+        s.settimeout(10.0)
+        s.sendall(b"GET / HT")                  # header never finishes
+        t0 = time.monotonic()
+        assert s.recv(4096) == b""              # server closed on us
+        assert time.monotonic() - t0 < 5.0      # at ~the 0.5 s deadline
+    finally:
+        s.close()
+
+
+def test_slow_body_cut_with_408_while_traffic_flows(s3_server):
+    """The acceptance scenario: a trickling body is cut at the absolute
+    body deadline with 408 RequestTimeout, while concurrent PUT/GET on
+    other connections completes unimpeded."""
+    from minio_tpu.s3.client import S3Client
+    cli = S3Client(s3_server.endpoint, "testkey", "testsecret")
+    cli.make_bucket("chaos")
+
+    s = socket.create_connection(("127.0.0.1", s3_server.port))
+    s.settimeout(10.0)
+    s.sendall(b"PUT /chaos/slow HTTP/1.1\r\nHost: h\r\n"
+              b"Content-Length: 1000000\r\n\r\n")
+    stop = threading.Event()
+
+    def trickle():
+        try:
+            while not stop.is_set():
+                s.sendall(b"a")
+                time.sleep(0.05)
+        except OSError:
+            pass
+
+    t = threading.Thread(target=trickle, daemon=True)
+    t.start()
+    try:
+        # concurrent traffic while the slowloris is parked
+        data = os.urandom(512 * 1024)
+        cli.put_object("chaos", "ok", data)
+        assert cli.get_object("chaos", "ok").body == data
+
+        resp = b""
+        while True:
+            try:
+                chunk = s.recv(4096)
+            except OSError:
+                break
+            if not chunk:
+                break
+            resp += chunk
+        assert b"408" in resp.split(b"\r\n")[0]
+        assert b"RequestTimeout" in resp
+    finally:
+        stop.set()
+        s.close()
+    # the slow client never produced an object
+    from minio_tpu.s3.client import S3ClientError
+    with pytest.raises(S3ClientError):
+        cli.get_object("chaos", "slow")
+
+
+def test_saturated_pool_sheds_503_with_retry_after(tmp_path,
+                                                   monkeypatch):
+    monkeypatch.setenv("MT_API_REQUESTS_MAX", "1")
+    monkeypatch.setenv("MT_API_REQUESTS_DEADLINE", "200ms")
+    monkeypatch.setenv("MT_API_BODY_DEADLINE", "2s")
+    from minio_tpu.objectlayer.erasure_object import ErasureObjects
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.storage.xl_storage import XLStorage
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"disk{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=256 * 1024,
+                           backend="numpy")
+    srv = S3Server(layer, access_key="testkey", secret_key="testsecret")
+    srv.start()
+    try:
+        # park a slow-bodied request in the ONLY slot
+        hold = socket.create_connection(("127.0.0.1", srv.port))
+        hold.sendall(b"PUT /chaos/hold HTTP/1.1\r\nHost: h\r\n"
+                     b"Content-Length: 100\r\n\r\n")
+        time.sleep(0.1)
+        # second request: waits up to the 200 ms deadline, then shed
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        s.settimeout(10.0)
+        s.sendall(b"GET /chaos/x HTTP/1.1\r\nHost: h\r\n\r\n")
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            resp += chunk
+        head = resp.split(b"\r\n\r\n")[0]
+        assert b"503" in head.split(b"\r\n")[0]
+        assert b"Retry-After:" in head
+        s.close()
+        hold.close()
+        # slot frees once the held connection dies: traffic resumes
+        from minio_tpu.s3.client import S3Client
+        cli = S3Client(srv.endpoint, "testkey", "testsecret")
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                cli.make_bucket("after")
+                break
+            except Exception:  # noqa: BLE001 — held slot still draining
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+        assert cli.head_bucket("after")
+    finally:
+        srv.stop()
+
+
+# -- peer kill/flap mid-PUT with quorum preserved ---------------------------
+
+@pytest.fixture
+def chaos_cluster(tmp_path, monkeypatch):
+    """3 in-process nodes x 2 drives, one 6-drive erasure set, with
+    snappy breaker settings so peer death is detected in a couple of
+    calls and re-admission probes come fast."""
+    monkeypatch.setenv("MT_RPC_BREAKER_FAILURES", "2")
+    monkeypatch.setenv("MT_RPC_BREAKER_COOLDOWN", "200ms")
+    monkeypatch.setenv("MT_RPC_RETRY_ATTEMPTS", "1")
+    from minio_tpu.cluster import NodeSpec, start_cluster
+    specs = []
+    for n in range(3):
+        dirs = []
+        for d in range(2):
+            p = tmp_path / f"n{n}d{d}"
+            p.mkdir()
+            dirs.append(str(p))
+        specs.append(NodeSpec(node_id=f"node{n}", drive_dirs=dirs))
+    nodes = start_cluster(specs, "testsecret", set_drive_count=6)
+    yield nodes
+    for node in nodes:
+        try:
+            node.stop()
+        except Exception:  # noqa: BLE001 — some tests stop nodes
+            pass
+
+
+def test_peer_kill_mid_put_quorum_commit_and_breaker(chaos_cluster):
+    nodes = chaos_cluster
+    layer0 = nodes[0].layer
+    layer0.make_bucket("chaos")
+    data0 = os.urandom(128 * 1024)
+    layer0.put_object("chaos", "before", data0)
+
+    # kill node2 (its 2 drives + locker vanish mid-workload)
+    victim_port = nodes[2].rpc.port
+    nodes[2].rpc.stop()
+
+    # PUT with the peer dead: 4/6 drives reach write quorum
+    data1 = os.urandom(256 * 1024)
+    layer0.put_object("chaos", "during", data1)
+    _, got = layer0.get_object("chaos", "during")
+    assert got == data1
+    _, got0 = layer0.get_object("chaos", "before")
+    assert got0 == data0
+
+    # the remote-drive breakers for node2 opened within 2 failures:
+    # further calls fail FAST (no timeout stacking)
+    from minio_tpu.storage import errors as serrors
+    all_disks = [d for s in layer0.sets for d in s.disks]
+    victims = [d for d in all_disks
+               if f":{victim_port}/" in d.endpoint()]
+    assert len(victims) == 2
+    t0 = time.monotonic()
+    for d in victims:
+        with pytest.raises(serrors.StorageError):
+            d.read_all("chaos-probe-vol", "nope")
+    assert time.monotonic() - t0 < 2.0
+
+    # peer returns on the SAME port with the same drives; after the
+    # breaker cooldown the half-open probe re-admits it
+    from minio_tpu.parallel.dsync import register_lock_service
+    from minio_tpu.storage.remote import register_storage_service
+    srv2 = RPCServer("testsecret", port=victim_port)
+    register_storage_service(srv2, nodes[2].drives)
+    register_lock_service(srv2, nodes[2].locker)
+    srv2.start()
+    try:
+        time.sleep(0.3)     # > breaker cooldown (200 ms)
+        deadline = time.monotonic() + 10.0
+        while True:
+            try:
+                # any data call doubles as the half-open probe
+                from minio_tpu.storage.xl_storage import SYS_DIR
+                victims[0].inner.read_all(SYS_DIR, "format.json")
+                break
+            except Exception:  # noqa: BLE001 — next probe window
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+        # full-strength PUT/GET once re-admitted
+        data2 = os.urandom(64 * 1024)
+        layer0.put_object("chaos", "after", data2)
+        _, got2 = layer0.get_object("chaos", "after")
+        assert got2 == data2
+    finally:
+        srv2.stop()
+
+
+# -- lock refresh under partition -------------------------------------------
+
+def test_lock_refresh_partition_raises_lock_lost():
+    """A held DRWMutex whose lockers become unreachable must see its
+    grants presumed-expired after one TTL of failed refreshes — the
+    holder aborts at the commit point instead of writing unprotected."""
+    local = LocalLocker()
+    servers = []
+    lockers = [local]
+    for _ in range(2):
+        srv = RPCServer("testsecret")
+        lk = LocalLocker()
+        register_lock_service(srv, lk)
+        srv.start()
+        servers.append(srv)
+        lockers.append(RemoteLocker(_no_retry_client(srv.endpoint)))
+
+    m = DRWMutex(lockers, "chaos/partition", ttl_s=0.6)
+    m.lock(write=True, timeout=5.0)
+    try:
+        m.ensure_valid()                    # healthy: still protected
+        for srv in servers:                 # partition: both peers gone
+            srv.stop()
+        # refreshes run every ttl/3; after REFRESH_FAILS_MAX consecutive
+        # transport failures the grants are presumed expired -> below
+        # write quorum (needs 2/3) -> lost fires
+        assert m.lost.wait(timeout=10.0)
+        with pytest.raises(LockLost):
+            m.ensure_valid()
+    finally:
+        m.unlock()
+
+
+def test_lock_refresh_survives_single_blip():
+    """One locker briefly unreachable is NOT a lost lock: quorum holds
+    via the remaining lockers and the blip resets on recovery."""
+    local = LocalLocker()
+    srv = RPCServer("testsecret")
+    lk = LocalLocker()
+    register_lock_service(srv, lk)
+    srv.start()
+    lockers = [local, RemoteLocker(_no_retry_client(srv.endpoint))]
+    m = DRWMutex(lockers, "chaos/blip", ttl_s=0.6)
+    m.lock(write=True, timeout=5.0)
+    try:
+        # 2 lockers, write quorum 2: losing the remote would lose the
+        # lock, but a single failed round (< REFRESH_FAILS_MAX) is a
+        # blip, not a partition
+        m._refresh_fails[1] = 1
+        m._do_refresh()                     # succeeds: counter resets
+        assert m._refresh_fails[1] == 0
+        assert not m.lost.is_set()
+        m.ensure_valid()
+    finally:
+        m.unlock()
+        srv.stop()

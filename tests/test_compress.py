@@ -163,6 +163,9 @@ def test_incompressible_not_compressed(client, server):
     assert client.get_object("comp", "rand.jpg").body == data
 
 
+@pytest.mark.skipif(
+    __import__("minio_tpu.crypto.dare", fromlist=["AESGCM"]).AESGCM is None,
+    reason="cryptography (AES-GCM backend) not installed")
 def test_compress_plus_sse(client, server):
     import base64
     import hashlib

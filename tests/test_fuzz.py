@@ -113,6 +113,9 @@ def test_fuzz_xl_meta_load():
             pass
 
 
+@pytest.mark.skipif(
+    __import__("minio_tpu.crypto.dare", fromlist=["AESGCM"]).AESGCM is None,
+    reason="cryptography (AES-GCM backend) not installed")
 def test_fuzz_dare_decrypt():
     from minio_tpu.crypto import dare
     rng = random.Random(5)

@@ -208,3 +208,32 @@ def test_full_s3_frontend_over_azure_gateway(stub):
         assert prefixes == ["x/"]
     finally:
         srv.stop()
+
+
+def test_reserved_sys_namespace_rejected_at_object_ops(layer):
+    """Object-op entry points refuse keys under .minio-tpu.sys/ — list
+    filtering alone only HIDES the multipart metadata stashes; direct
+    reads/writes by name must be rejected too (ADVICE round 5)."""
+    from minio_tpu.objectlayer.interface import ObjectNameInvalid
+    layer.make_bucket("azsys")
+    uid = layer.new_multipart_upload("azsys", "real-obj")
+    stash = f".minio-tpu.sys/multipart/{uid}/azure.json"
+    with pytest.raises(ObjectNameInvalid):
+        layer.get_object("azsys", stash)
+    with pytest.raises(ObjectNameInvalid):
+        layer.get_object_info("azsys", stash)
+    with pytest.raises(ObjectNameInvalid):
+        layer.put_object("azsys", stash, b"{}")       # corrupt attempt
+    with pytest.raises(ObjectNameInvalid):
+        layer.delete_object("azsys", stash)
+    with pytest.raises(ObjectNameInvalid):
+        layer.copy_object("azsys", stash, "azsys", "leak.json")
+    with pytest.raises(ObjectNameInvalid):
+        layer.copy_object("azsys", "real-obj", "azsys", stash)
+    with pytest.raises(ObjectNameInvalid):
+        layer.new_multipart_upload("azsys", ".minio-tpu.sys/evil")
+    # the stash itself is untouched: the upload still completes
+    e1 = layer.put_object_part("azsys", "real-obj", uid, 1, b"z" * 64)
+    oi = layer.complete_multipart_upload("azsys", "real-obj", uid,
+                                         [(1, e1)])
+    assert oi.size == 64

@@ -17,6 +17,11 @@ from minio_tpu.ops import rs_mesh
 from minio_tpu.parallel import mesh as mesh_mod
 from minio_tpu.storage.xl_storage import XLStorage
 
+# slow: the full mesh dataplane (pallas interpret mode on a virtual
+# 8-device CPU mesh) costs minutes of wall clock — fast-tier mesh
+# coverage lives in test_mesh.py
+pytestmark = pytest.mark.slow
+
 K, M = 5, 3          # 8 drives: 5 data + 3 parity
 BS = 128 * 1024
 

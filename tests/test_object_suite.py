@@ -113,7 +113,12 @@ def _make_layer(kind, tmp):
     raise AssertionError(kind)
 
 
-KINDS = ["fs", "erasure4", "erasure16", "mesh8", "sets32", "pools",
+# mesh8 runs every codec matmul through the 8-device virtual mesh in
+# interpret mode — minutes of wall clock on CPU, so it rides the slow
+# tier (test_mesh.py keeps fast-tier mesh coverage)
+KINDS = ["fs", "erasure4", "erasure16",
+         pytest.param("mesh8", marks=pytest.mark.slow),
+         "sets32", "pools",
          "memory-gw", "azure-gw", "gcs-gw", "s3-gw"]
 
 

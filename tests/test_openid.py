@@ -76,6 +76,9 @@ def test_policy_claim_forms(provider):
 
 
 def test_rs256_validation():
+    pytest.importorskip(
+        "cryptography",
+        reason="cryptography (RSA backend) not installed")
     from cryptography.hazmat.primitives.asymmetric import padding, rsa
     from cryptography.hazmat.primitives import hashes
     key = rsa.generate_private_key(public_exponent=65537, key_size=2048)

@@ -1,0 +1,233 @@
+"""Resilience primitives — circuit breaker, retry policy/budget, and
+their integration into the RPC client (the unit tier under the chaos
+tests in test_chaos_network.py).
+
+Everything here is deterministic: breakers run on injected fake clocks,
+retry policies on seeded RNGs with recording sleeps — no wall-clock
+races (the NaughtyDisk discipline applied to the wire layer).
+"""
+
+import random
+
+import pytest
+
+from minio_tpu.parallel.rpc import (CircuitBreaker, RPCClient, RPCError,
+                                    RPCServer)
+from minio_tpu.utils.retry import RetryBudget, RetryPolicy
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- CircuitBreaker ---------------------------------------------------------
+
+def test_breaker_opens_after_consecutive_failures():
+    clk = FakeClock()
+    br = CircuitBreaker(fail_max=3, cooldown_s=5.0, clock=clk)
+    assert br.state == CircuitBreaker.CLOSED
+    for _ in range(2):
+        assert br.allow()
+        br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED    # below threshold
+    assert br.allow()
+    br.record_failure()                          # third consecutive
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()                        # fail fast while open
+    assert not br.ready()
+
+
+def test_breaker_success_resets_consecutive_count():
+    br = CircuitBreaker(fail_max=2, cooldown_s=5.0, clock=FakeClock())
+    br.record_failure()
+    br.record_success()                          # streak broken
+    br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED     # 1 consecutive, not 2
+
+
+def test_breaker_half_open_single_probe():
+    clk = FakeClock()
+    br = CircuitBreaker(fail_max=1, cooldown_s=5.0, clock=clk)
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    clk.advance(5.0)
+    assert br.ready()
+    assert br.allow()                # first caller becomes the probe
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert not br.allow()            # everyone else still fails fast
+    br.record_success()
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.allow()
+
+
+def test_breaker_failed_probe_reopens_fresh_cooldown():
+    clk = FakeClock()
+    br = CircuitBreaker(fail_max=1, cooldown_s=5.0, clock=clk)
+    br.record_failure()
+    clk.advance(5.0)
+    assert br.allow()                # probe admitted
+    br.record_failure()              # probe failed
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()            # cooldown restarted
+    clk.advance(4.9)
+    assert not br.allow()
+    clk.advance(0.2)
+    assert br.allow()                # next probe window
+
+
+# -- RetryPolicy / RetryBudget ----------------------------------------------
+
+def test_retry_backoff_is_jittered_exponential_and_capped():
+    rp = RetryPolicy(attempts=10, base_s=0.1, cap_s=0.4,
+                     rng=random.Random(7))
+    for retry_nr, ceiling in [(0, 0.1), (1, 0.2), (2, 0.4), (5, 0.4)]:
+        samples = [rp.backoff_s(retry_nr) for _ in range(50)]
+        assert all(0.0 <= s <= ceiling for s in samples)
+    # jitter: not constant
+    assert len({round(rp.backoff_s(3), 9) for _ in range(10)}) > 1
+
+
+def test_retry_idempotent_only_and_attempt_cap():
+    rp = RetryPolicy(attempts=3)
+    assert not rp.may_retry(0, idempotent=False)   # mutations never
+    assert rp.may_retry(0, idempotent=True)
+    assert rp.may_retry(1, idempotent=True)
+    assert not rp.may_retry(2, idempotent=True)    # 3 attempts total
+
+
+def test_retry_budget_caps_retry_storms():
+    budget = RetryBudget(capacity=2.0, refund=0.5)
+    rp = RetryPolicy(attempts=10, budget=budget)
+    assert rp.may_retry(0, True)                   # spends 1
+    assert rp.may_retry(0, True)                   # spends 1 -> empty
+    assert not rp.may_retry(0, True)               # bucket dry: shed
+    rp.on_success()
+    rp.on_success()                                # refunds 2 * 0.5
+    assert rp.may_retry(0, True)
+
+
+def test_retry_budget_caps_at_capacity():
+    budget = RetryBudget(capacity=1.0, refund=0.5)
+    for _ in range(10):
+        budget.credit()
+    assert budget.tokens == 1.0
+
+
+# -- RPCClient integration --------------------------------------------------
+
+@pytest.fixture
+def rpc_server():
+    srv = RPCServer("testsecret")
+    srv.start()
+    yield srv
+    try:
+        srv.stop()
+    except Exception:  # noqa: BLE001 — some tests stop it themselves
+        pass
+
+
+def _client(endpoint, fail_max=3, cooldown_s=60.0, clock=None,
+            attempts=1, sleeps=None):
+    return RPCClient(
+        endpoint, "testsecret",
+        breaker=CircuitBreaker(fail_max=fail_max, cooldown_s=cooldown_s,
+                               clock=clock or FakeClock()),
+        retry=RetryPolicy(attempts=attempts, base_s=0.001,
+                          rng=random.Random(1),
+                          sleep=(sleeps.append if sleeps is not None
+                                 else (lambda s: None))))
+
+
+def test_rpc_breaker_opens_on_dead_peer_and_fails_fast(rpc_server):
+    port = rpc_server.port
+    rpc_server.stop()
+    clk = FakeClock()
+    c = _client(f"http://127.0.0.1:{port}", fail_max=3, clock=clk)
+    for _ in range(3):
+        with pytest.raises(RPCError) as ei:
+            c.call("sys", "ping")
+        assert ei.value.error_type == "ConnectionError"
+    assert c.breaker.state == CircuitBreaker.OPEN
+    assert not c.is_online()
+    # while open: PeerOffline without touching the socket
+    with pytest.raises(RPCError) as ei:
+        c.call("sys", "ping")
+    assert ei.value.error_type == "PeerOffline"
+
+
+def test_rpc_half_open_probe_readmits_restarted_peer(rpc_server):
+    port = rpc_server.port
+    rpc_server.stop()
+    clk = FakeClock()
+    c = _client(f"http://127.0.0.1:{port}", fail_max=1, cooldown_s=5.0,
+                clock=clk)
+    with pytest.raises(RPCError):
+        c.call("sys", "ping")
+    assert c.breaker.state == CircuitBreaker.OPEN
+    # peer comes back on the SAME port; cooldown elapses -> next call
+    # doubles as the half-open probe and closes the breaker
+    srv2 = RPCServer("testsecret", port=port)
+    srv2.start()
+    try:
+        clk.advance(5.0)
+        assert c.is_online()
+        assert c.call("sys", "ping") == "pong"
+        assert c.breaker.state == CircuitBreaker.CLOSED
+    finally:
+        srv2.stop()
+
+
+def test_rpc_retries_idempotent_with_recorded_backoff():
+    sleeps = []
+    c = _client("http://127.0.0.1:1", fail_max=100, attempts=3,
+                sleeps=sleeps)
+    with pytest.raises(RPCError):
+        c.call("sys", "ping", _idempotent=True)
+    assert len(sleeps) == 2                       # two retries
+    assert all(s >= 0.0 for s in sleeps)
+
+
+def test_rpc_never_retries_mutations():
+    sleeps = []
+    c = _client("http://127.0.0.1:1", fail_max=100, attempts=3,
+                sleeps=sleeps)
+    with pytest.raises(RPCError):
+        c.call("sys", "ping")                     # not idempotent
+    assert sleeps == []
+
+
+def test_rpc_app_errors_do_not_trip_breaker(rpc_server):
+    rpc_server.register("t", {"boom": lambda: 1 / 0})
+    c = _client(rpc_server.endpoint, fail_max=1)
+    for _ in range(3):
+        with pytest.raises(RPCError) as ei:
+            c.call("t", "boom")
+        assert ei.value.error_type == "ZeroDivisionError"
+    # the peer answered every time: transport is healthy
+    assert c.breaker.state == CircuitBreaker.CLOSED
+
+
+def test_rpc_stale_pooled_connection_replay(rpc_server):
+    """A peer restart between calls leaves stale pooled connections;
+    the next call must replay transparently on a fresh one."""
+    port = rpc_server.port
+    c = _client(f"http://127.0.0.1:{port}", fail_max=3)
+    assert c.call("sys", "ping") == "pong"        # pools the connection
+    rpc_server.stop()
+    srv2 = RPCServer("testsecret", port=port)
+    srv2.start()
+    try:
+        # idempotent: replayable whether the stale connection dies in
+        # the send phase or the response phase (the race is real — a
+        # non-idempotent call may legitimately fail here)
+        assert c.call("sys", "ping", _idempotent=True) == "pong"
+        assert c.breaker.state == CircuitBreaker.CLOSED
+    finally:
+        srv2.stop()

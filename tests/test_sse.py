@@ -12,6 +12,12 @@ import os
 import pytest
 
 from minio_tpu.crypto import dare, kms, sse
+
+# the AES-GCM backend is a gated dependency: without the
+# `cryptography` wheel every SSE path raises DAREError at use
+pytestmark = pytest.mark.skipif(
+    dare.AESGCM is None,
+    reason="cryptography (AES-GCM backend) not installed")
 from minio_tpu.objectlayer.erasure_object import ErasureObjects
 from minio_tpu.s3.client import S3Client, S3ClientError
 from minio_tpu.s3.server import S3Server

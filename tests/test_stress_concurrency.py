@@ -27,6 +27,10 @@ from minio_tpu.objectlayer.interface import (MethodNotAllowed,
                                              VersionNotFound)
 from minio_tpu.storage.xl_storage import XLStorage
 
+# slow: sustained many-thread stress loops — runs in the full tier,
+# not the tier-1 `-m 'not slow'` budget (VERDICT weak #5)
+pytestmark = pytest.mark.slow
+
 BENIGN = (ObjectNotFound, VersionNotFound, MethodNotAllowed)
 
 

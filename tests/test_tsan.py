@@ -21,6 +21,10 @@ import textwrap
 
 import pytest
 
+# slow: TSan rebuilds + multi-minute race-hunting subprocesses — runs
+# in the full tier, not the tier-1 `-m 'not slow'` budget
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
