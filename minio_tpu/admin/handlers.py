@@ -31,7 +31,9 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
     """
     if path == METRICS_PATH:
         body = metrics.render(srv.layer,
-                              healer=getattr(srv, "healer", None)
+                              healer=getattr(srv, "healer", None),
+                              config=getattr(srv, "config", None),
+                              api_stats=getattr(srv, "api_stats", None)
                               ).encode()
         h._send(200, body, content_type="text/plain; version=0.0.4")
         return True
@@ -177,6 +179,11 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
         if route == "storageinfo" and h.command == "GET":
             # madmin StorageInfo: per-drive capacity + online state —
             # same topology traversal as the metrics scrape
+            from ..storage.health import (slow_drive_knobs,
+                                          slow_drives_for_layer)
+            mult, mins = slow_drive_knobs(getattr(srv, "config", None))
+            verdicts = slow_drives_for_layer(srv.layer, multiple=mult,
+                                             min_samples=mins)
             disks = []
             for si, d in metrics._collect_disks_with_set(srv.layer):
                 if d is None:
@@ -184,10 +191,17 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
                     continue
                 try:
                     info = d.disk_info()
-                    disks.append({
+                    entry = {
                         "set": si, "endpoint": d.endpoint(),
                         "state": "ok", "total": info.total,
-                        "used": info.used, "free": info.free})
+                        "used": info.used, "free": info.free}
+                    v = verdicts.get(d.endpoint())
+                    if v is not None:
+                        # verdicts exist only for drives this node
+                        # measures (local windows); a remote drive gets
+                        # NO flag rather than a silently-false one
+                        entry["slow"] = bool(v["slow"])
+                    disks.append(entry)
                 except Exception as e:  # noqa: BLE001
                     disks.append({"set": si, "endpoint": d.endpoint(),
                                   "state": "offline", "error": str(e)})
@@ -304,9 +318,35 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
                                    int(q1.get("limit", "0")))
             return send_json({"status": "ok"}) or True
         if route == "trace" and h.command == "GET":
-            if srv.peers is not None and q1.get("local") != "true":
-                return _stream_with_peer_traces(h, srv, q1)
-            return _stream(h, srv.trace_hub, q1)
+            # per-type filtering (`mc admin trace -a` analog): default
+            # http-only so existing consumers see no new record shapes
+            # OR new costs — an http-only stream registers an opt-out
+            # so subsystem spans are never built for it, locally
+            # (obs/trace.py http_only_consumer) or on peers (the wanted
+            # types ride the trace_since poll).  ?type=storage,
+            # internode,tpu (or type=all) opts into the deep spans.
+            import contextlib as _ctxlib
+
+            from ..obs import trace as _obs_trace
+            flt, want = _trace_type_filter(q1)
+            unknown = (want or set()) - set(_obs_trace.TRACE_TYPES)
+            if unknown:
+                # a typo'd type would stream nothing forever with a
+                # 200 — indistinguishable from a healthy idle system
+                return send_json(
+                    {"error": f"unknown trace type(s) "
+                              f"{sorted(unknown)}; valid: "
+                              f"{list(_obs_trace.TRACE_TYPES)} or all"},
+                    400) or True
+            ctx = _obs_trace.http_only_consumer() \
+                if want == {"http"} else _ctxlib.nullcontext()
+            with ctx:
+                if srv.peers is not None and q1.get("local") != "true":
+                    return _stream_with_peer_traces(h, srv, q1, flt,
+                                                    want)
+                return _stream(h, srv.trace_hub, q1, flt)
+        if route == "top" and h.command == "GET":
+            return send_json(_top(srv)) or True
         if route == "log" and h.command == "GET":
             if q1.get("follow") == "true":
                 return _stream(h, srv.logger.pubsub, q1)
@@ -319,8 +359,10 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
                     key=lambda e: e.get("time", ""))[-n_want:]
             return send_json(entries) or True
         if route == "audit-recent" and h.command == "GET":
+            # tail() arms the in-memory tail — entry construction is
+            # gated on an actual consumer (obs/audit.py enabled)
             return send_json(
-                srv.audit.recent[-int(q1.get("n", "50")):]) or True
+                srv.audit.tail(int(q1.get("n", "50")))) or True
         if route == "profile" and h.command == "POST":
             from ..obs import profiling
             try:
@@ -390,18 +432,72 @@ def _drive_paths(srv) -> list:
     return paths
 
 
-def _stream_with_peer_traces(h, srv, q1) -> bool:
+def _trace_type_filter(q1):
+    """(predicate, wanted-set) from ?type= (comma-separated; default
+    http-only — the pre-deep-tracing contract).  ``type=all`` streams
+    every span type (predicate and set both None)."""
+    want = {t for t in (q1.get("type") or "http").replace(" ", "")
+            .lower().split(",") if t}
+    if not want:
+        want = {"http"}     # "type=," / "type= ": the default, not a
+                            # match-nothing stream
+    if "all" in want:
+        return None, None
+    return (lambda item: item.get("type", "http") in want), want
+
+
+def _top(srv) -> dict:
+    """madmin TopAPIs/TopDrives analog: hottest S3 APIs and slowest
+    drives over the last-minute windows, slow-drive verdicts included."""
+    from ..obs.lastminute import drive_windows, top_entries
+    from ..storage.health import slow_drive_knobs, slow_drives_for_layer
+    apis = top_entries(getattr(srv, "api_stats", None)) \
+        if getattr(srv, "api_stats", None) is not None else []
+    disks = metrics._collect_disks(srv.layer)
+    multiple, min_samples = slow_drive_knobs(getattr(srv, "config", None))
+    verdicts = slow_drives_for_layer(srv.layer, multiple=multiple,
+                                     min_samples=min_samples)
+    drives = []
+    for endpoint, w in drive_windows(disks).items():
+        totals = w.totals()
+        count = sum(c for c, _, _ in totals.values())
+        if not count:
+            continue
+        total_ns = sum(t for _, t, _ in totals.values())
+        v = verdicts.get(endpoint, {})
+        drives.append({
+            "drive": endpoint, "count": count,
+            "avg_ns": total_ns // max(count, 1),
+            # the verdict already merged+sorted this drive's sample
+            # rings; only recompute when it has no entry
+            "p50_ns": v["p50_ns"] if v else w.p50_all(),
+            "slow": bool(v.get("slow")),
+            "ops": {op: {"count": c, "avg_ns": t // max(c, 1),
+                         "bytes": b}
+                    for op, (c, t, b) in sorted(totals.items())},
+        })
+    drives.sort(key=lambda d: d["p50_ns"], reverse=True)
+    return {"apis": apis, "drives": drives,
+            "knobs": {"slow_latency_multiple": multiple,
+                      "slow_min_samples": min_samples}}
+
+
+def _stream_with_peer_traces(h, srv, q1, flt=None, want=None) -> bool:
     """Cluster-wide trace stream: local hub subscription merged with a
     background poller pulling every peer's trace ring
-    (cmd/admin-handlers.go:1082 TraceHandler + peerRESTMethodTrace)."""
+    (cmd/admin-handlers.go:1082 TraceHandler + peerRESTMethodTrace).
+    The type filter is applied at the earliest point on each leg: the
+    local subscription drops unwanted items at publish, and peers are
+    told the wanted types so their rings only capture/ship those."""
     import threading
 
     from ..utils.pubsub import PubSub
     merged = PubSub(max_queue=8000)
     stop = threading.Event()
+    want_list = sorted(want) if want is not None else None
 
     def local_pump():
-        with srv.trace_hub.subscribe() as sub:
+        with srv.trace_hub.subscribe(flt) as sub:
             while not stop.is_set():
                 item = sub.get(timeout=0.25)
                 if item is not None:
@@ -410,7 +506,8 @@ def _stream_with_peer_traces(h, srv, q1) -> bool:
     def peer_pump():
         cursors: dict[str, int] = {}   # trace_tails self-primes peers
         while not stop.wait(0.5):
-            for item in srv.peers.trace_tails(cursors):
+            for item in srv.peers.trace_tails(cursors,
+                                              types=want_list):
                 merged.publish(item)
 
     threads = [threading.Thread(target=local_pump, daemon=True),
@@ -418,15 +515,16 @@ def _stream_with_peer_traces(h, srv, q1) -> bool:
     for t in threads:
         t.start()
     try:
-        return _stream(h, merged, q1)
+        return _stream(h, merged, q1, flt)
     finally:
         stop.set()
 
 
-def _stream(h, hub, q1) -> bool:
+def _stream(h, hub, q1, flt=None) -> bool:
     """Chunked newline-JSON live stream from a PubSub hub — serves
     `mc admin trace` / `mc admin logs --follow`
-    (cmd/admin-handlers.go:1082 TraceHandler)."""
+    (cmd/admin-handlers.go:1082 TraceHandler).  ``flt`` drops items
+    before they count against max-items (trace-type filtering)."""
     import json as _json
     try:
         timeout = min(float(q1.get("timeout", 10) or 10), 300.0)
@@ -443,7 +541,7 @@ def _stream(h, hub, q1) -> bool:
         h.wfile.write(data + b"\r\n")
         h.wfile.flush()
 
-    with hub.subscribe() as sub:
+    with hub.subscribe(flt) as sub:
         try:
             for item in sub.drain(max_items, timeout):
                 write_chunk(_json.dumps(item).encode() + b"\n")

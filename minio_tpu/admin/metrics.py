@@ -29,6 +29,16 @@ _START = time.time()
 TTFB_BUCKETS = (0.001, 0.003, 0.005, 0.01, 0.025, 0.05, 0.1,
                 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
+# erasure-kernel wall-time buckets (mt_tpu_kernel_seconds): kernels run
+# sub-ms on device and tens of ms on the host fallback
+KERNEL_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                  0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+# batched-dispatch size buckets (mt_tpu_batch_blocks): erasure blocks
+# per device dispatch, the BENCH trajectory's batching axis
+BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                 512.0, 1024.0)
+
 
 class Metrics:
     def __init__(self):
@@ -69,15 +79,34 @@ class Metrics:
 GLOBAL = Metrics()
 
 
+def _escape_label(v) -> str:
+    """Label-value escaping per the text-format spec: backslash, double
+    quote, and newline must be escaped or the scrape is unparseable."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _fmt_value(v: float) -> str:
+    """Full-precision sample rendering: ``%g`` keeps only 6 significant
+    digits, which quantizes fast-growing byte counters (a 1 TB
+    mt_tpu_bytes_total would move in ~10 MB steps and flatline
+    rate())."""
+    return str(int(v)) if v == int(v) else repr(v)
+
+
 def _fmt_labels(labels: tuple, extra: str = "") -> str:
-    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in labels)
     if extra:
         inner = f"{inner},{extra}" if inner else extra
     return "{" + inner + "}" if inner else ""
 
 
-def render(layer=None, healer=None) -> str:
-    """Prometheus text format: counters + histograms + live gauges."""
+def render(layer=None, healer=None, config=None, api_stats=None) -> str:
+    """Prometheus text format: counters + histograms + live gauges.
+
+    ``config`` (a kvconfig Config) supplies the slow-drive knobs at
+    scrape time — admin SetConfigKV retunes detection live; ``api_stats``
+    is the server's last-minute per-API OpWindows."""
     lines = [
         "# HELP mt_up Server is up.",
         "# TYPE mt_up gauge",
@@ -87,17 +116,30 @@ def render(layer=None, healer=None) -> str:
         f"mt_uptime_seconds {time.time() - _START:.1f}",
     ]
     counters = GLOBAL.snapshot()
+    hists = GLOBAL.hist_snapshot()
+    # a histogram family owns its base name AND the derived sample
+    # names; a counter colliding with any of them is DROPPED from the
+    # scrape — emitting it would either mint a second # TYPE line or
+    # inject a duplicate/mis-shaped sample into the histogram family,
+    # both of which strict text-format parsers reject (a collision is
+    # a programming error; a valid scrape beats a corrupt one)
     seen_names = set()
+    reserved = set()
+    for (hname, _, _) in hists:
+        reserved.update((hname, f"{hname}_bucket", f"{hname}_sum",
+                         f"{hname}_count"))
     for (name, labels), value in sorted(counters.items()):
+        if name in reserved:
+            continue
         if name not in seen_names:
             lines.append(f"# TYPE {name} counter")
             seen_names.add(name)
-        lines.append(f"{name}{_fmt_labels(labels)} {value:g}")
-    for (name, labels, buckets), h in sorted(GLOBAL.hist_snapshot()
-                                             .items()):
+        lines.append(f"{name}{_fmt_labels(labels)} {_fmt_value(value)}")
+    for (name, labels, buckets), h in sorted(hists.items()):
         if name not in seen_names:
             lines.append(f"# TYPE {name} histogram")
-            seen_names.add(name)
+            seen_names.update((name, f"{name}_bucket", f"{name}_sum",
+                               f"{name}_count"))
         for i, ub in enumerate(buckets):
             le = 'le="%g"' % ub
             lines.append(
@@ -107,7 +149,8 @@ def render(layer=None, healer=None) -> str:
         lines.append(f"{name}_bucket"
                      f"{_fmt_labels(labels, le_inf)}"
                      f" {h[len(buckets)]}")
-        lines.append(f"{name}_sum{_fmt_labels(labels)} {h[-1]:g}")
+        lines.append(f"{name}_sum{_fmt_labels(labels)}"
+                     f" {_fmt_value(h[-1])}")
         lines.append(f"{name}_count{_fmt_labels(labels)}"
                      f" {h[len(buckets)]}")
     if layer is not None:
@@ -117,6 +160,15 @@ def render(layer=None, healer=None) -> str:
             pass
         try:
             lines += _bucket_usage_gauges(layer)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            lines += _disk_lastminute_gauges(layer, config)
+        except Exception:  # noqa: BLE001
+            pass
+    if api_stats is not None:
+        try:
+            lines += _s3_lastminute_gauges(api_stats)
         except Exception:  # noqa: BLE001
             pass
     if healer is not None:
@@ -211,20 +263,76 @@ def _heal_counters(healer) -> list[str]:
     ]
 
 
+def _disk_lastminute_gauges(layer, config=None) -> list[str]:
+    """Per-drive last-minute latency families from the drives' rolling
+    windows (cmd/last-minute.go role), plus the slow-drive flag —
+    computed at scrape time, a slow drive is FLAGGED never ejected."""
+    from ..obs.lastminute import drive_windows
+    from ..storage.health import slow_drive_knobs, slow_drives_for_layer
+    disks = _collect_disks(layer)
+    wins = drive_windows(disks)
+    if not wins:
+        return []
+    lines = [
+        "# TYPE mt_node_disk_latency_ops gauge",
+        "# TYPE mt_node_disk_latency_ns gauge",
+        "# TYPE mt_node_disk_latency_avg_ns gauge",
+        "# TYPE mt_node_disk_latency_bytes gauge",
+    ]
+    for drive in sorted(wins):
+        for op, (c, t, b) in sorted(wins[drive].totals().items()):
+            lbl = _fmt_labels((("drive", drive), ("op", op)))
+            lines.append(f"mt_node_disk_latency_ops{lbl} {c}")
+            lines.append(f"mt_node_disk_latency_ns{lbl} {t}")
+            lines.append(f"mt_node_disk_latency_avg_ns{lbl}"
+                         f" {t // max(c, 1)}")
+            lines.append(f"mt_node_disk_latency_bytes{lbl} {b}")
+    multiple, min_samples = slow_drive_knobs(config)
+    verdicts = slow_drives_for_layer(layer, multiple=multiple,
+                                     min_samples=min_samples)
+    if verdicts:
+        lines += ["# TYPE mt_node_disk_latency_p50_ns gauge",
+                  "# TYPE mt_node_disk_slow gauge"]
+        for drive in sorted(verdicts):
+            v = verdicts[drive]
+            dl = _fmt_labels((("drive", drive),))
+            lines.append(f"mt_node_disk_latency_p50_ns{dl}"
+                         f" {v['p50_ns']}")
+            lines.append(f"mt_node_disk_slow{dl}"
+                         f" {1 if v['slow'] else 0}")
+    return lines
+
+
+def _s3_lastminute_gauges(api_stats) -> list[str]:
+    """Per-S3-API last-minute families from the server's rolling
+    windows (minio_s3_requests 1m rate role)."""
+    totals = api_stats.totals()
+    if not totals:
+        return []
+    lines = [
+        "# TYPE mt_s3_api_last_minute_requests gauge",
+        "# TYPE mt_s3_api_last_minute_avg_ns gauge",
+        "# TYPE mt_s3_api_last_minute_bytes gauge",
+    ]
+    for api in sorted(totals):
+        c, t, b = totals[api]
+        al = _fmt_labels((("api", api),))
+        lines.append(f"mt_s3_api_last_minute_requests{al} {c}")
+        lines.append(f"mt_s3_api_last_minute_avg_ns{al}"
+                     f" {t // max(c, 1)}")
+        lines.append(f"mt_s3_api_last_minute_bytes{al} {b}")
+    return lines
+
+
 def _collect_disks_with_set(layer):
     """(set_index, disk) pairs across every topology shape; the set
-    index is global across pools."""
-    if hasattr(layer, "pools"):
-        out, si = [], 0
-        for p in layer.pools:
-            for s in p.sets:
-                out += [(si, d) for d in s.disks]
-                si += 1
-        return out
-    if hasattr(layer, "sets"):
-        return [(si, d) for si, s in enumerate(layer.sets)
-                for d in s.disks]
-    return [(0, d) for d in layer.disks]
+    index is global across pools.  The traversal itself lives with the
+    storage layer (health.disks_by_set) — one walk, shared by the
+    scrape and slow-drive detection, so they can never disagree about
+    which drives exist."""
+    from ..storage.health import disks_by_set
+    return [(si, d) for si, dlist in enumerate(disks_by_set(layer))
+            for d in dlist]
 
 
 def _collect_disks(layer):

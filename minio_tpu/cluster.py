@@ -199,6 +199,9 @@ def run_node(self_id: str, specs: list[NodeSpec], secret: str,
     srv = S3Server(layer, access_key=access_key, secret_key=secret_key,
                    host=shost or "127.0.0.1", port=int(sport))
     srv.node_name = self_id     # traces/logs name the serving node
+    srv.api_stats.label = self_id
+    from .obs import trace as _obs_trace
+    _obs_trace.set_node_name(self_id)   # subsystem spans too
     srv.iam.load()
     # peer control-plane service: IAM/bucket-metadata changes propagate
     # to every node immediately; trace/log streams aggregate cluster-wide

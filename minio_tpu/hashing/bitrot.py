@@ -119,7 +119,33 @@ def streaming_encode_batch(shards, shard_size: int,
         return [bytes(bytearray(s)) for s in shards]
     if use_device and algo == HIGHWAYHASH256S and shards:
         try:
-            return _streaming_encode_batch_device(shards, shard_size)
+            import time as _time
+
+            from ..obs import trace as _trace
+            if not _trace.active():
+                return _streaming_encode_batch_device(shards, shard_size)
+            # fused-hash span (trace type ``tpu``): the device-side
+            # HighwayHash leg of the fused encode+hash pipeline.
+            # Monotonic duration, wall clock only for the timestamp.
+            t0 = _time.monotonic_ns()
+            out = _streaming_encode_batch_device(shards, shard_size)
+            try:
+                # span bookkeeping must never reroute the data path:
+                # an observability error here would otherwise be
+                # swallowed by the DEVICE-failure fallback below and
+                # throw away a completed device result
+                dt = _time.monotonic_ns() - t0
+                nbytes = sum(getattr(s, "nbytes", len(s))
+                             for s in shards)
+                _trace.publish_span(_trace.make_span(
+                    "tpu", "tpu.fused-hash",
+                    start_ns=_trace.now_ns() - dt,
+                    duration_ns=dt, input_bytes=nbytes,
+                    detail={"op": "fused-hash", "shards": len(shards),
+                            "shardSize": shard_size}))
+            except Exception:  # noqa: BLE001
+                pass
+            return out
         except Exception:  # noqa: BLE001 — host path is always correct
             pass
     # streaming_encode takes any contiguous buffer zero-copy (numpy

@@ -30,6 +30,7 @@ from typing import Optional
 import numpy as np
 
 from ..hashing import bitrot
+from ..obs import trace as _trace
 from ..ops import gf8
 from ..ops.codec import Erasure
 from ..storage import errors as serrors
@@ -217,8 +218,22 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         if self._serial_fanout:
             out = [run(x) for x in items]
         else:
-            out = list(self._pool.map(run, items))
+            out = list(self._pool.map(self._with_request_id(run), items))
         return [r for r, _ in out], [e for _, e in out]
+
+    @staticmethod
+    def _with_request_id(run):
+        """Carry the caller's request ID into pool threads: contextvars
+        do not cross thread boundaries, and pool workers are REUSED —
+        setting unconditionally (even to "") also clears a previous
+        request's ID, so per-drive spans never mislabel."""
+        rid = _trace.get_request_id()
+
+        def run_ctx(x):
+            _trace.set_request_id(rid)
+            return run(x)
+
+        return run_ctx
 
     def _fanout(self, fn, disks=None):
         """fn(disk) on every drive concurrently; offline (None) drives
@@ -247,7 +262,8 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         if self._serial_fanout:
             out = [run(p) for p in enumerate(shuffled_disks)]
         else:
-            out = list(self._pool.map(run, enumerate(shuffled_disks)))
+            out = list(self._pool.map(self._with_request_id(run),
+                                      enumerate(shuffled_disks)))
         return [r for r, _ in out], [e for _, e in out]
 
     def _geometry(self, parity_override: int | None) -> tuple[int, int]:

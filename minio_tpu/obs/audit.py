@@ -26,13 +26,35 @@ class AuditLog:
         self.targets: List[HTTPLogTarget] = []
         self._mu = threading.Lock()
         # in-memory tail so tests and the admin API can inspect entries
-        # without an HTTP target
+        # without an HTTP target; DISARMED until someone actually reads
+        # it (tail()), so a target-less server never builds audit dicts
+        # per request just to fill a list nobody consumes.  Arming is a
+        # LEASE, not a latch (the trace ring's _ring_until pattern): a
+        # consumer that stops polling stops the per-request cost too.
         self.recent: List[Dict[str, Any]] = []
         self._recent_max = 256
+        self._tail_until = 0.0
+
+    TAIL_LEASE_S = 60.0
 
     @property
     def enabled(self) -> bool:
-        return bool(self.targets) or self._recent_max > 0
+        """Entry construction is gated on this: a webhook target exists
+        or the in-memory tail was read within the lease window."""
+        if self.targets:
+            return True
+        until = self._tail_until
+        return bool(until) and self._recent_max > 0 and \
+            time.monotonic() < until
+
+    def tail(self, n: int = 0) -> List[Dict[str, Any]]:
+        """Read (and lease-arm) the in-memory tail — the admin
+        ``audit-recent`` route and tests consume entries through this.
+        The first call may return [] (nothing was recorded while
+        disarmed); each call renews the lease."""
+        self._tail_until = time.monotonic() + self.TAIL_LEASE_S
+        with self._mu:
+            return self.recent[-n:] if n > 0 else list(self.recent)
 
     def entry(self, *, api_name: str, bucket: str, obj: str,
               status_code: int, rx: int, tx: int, duration_ns: int,

@@ -1,4 +1,4 @@
-"""HTTP request tracing (pkg/trace/trace.go:26-40, cmd/http-tracer.go:164).
+"""Request tracing (pkg/trace/trace.go:26-40, cmd/http-tracer.go:164).
 
 Every S3/admin request is summarised as a ``trace.Info``-shaped dict and
 published to the global :data:`HTTP_TRACE` pub/sub.  ``mc admin trace``
@@ -6,12 +6,30 @@ equivalents subscribe via the admin ``trace`` route and stream JSON lines;
 on a cluster the admin node aggregates peer streams over the internode RPC
 (peerRESTMethodTrace, cmd/peer-rest-common.go:54).
 
+Beyond the HTTP frontend, the deep-tracing plane publishes SUBSYSTEM
+spans to the same hub (``mc admin trace -a`` analog, trace types per
+pkg/trace.Type):
+
+  ``storage``    per-drive-call spans (storage/xl_storage.py + remote.py)
+  ``internode``  RPC client/server spans (parallel/rpc.py)
+  ``tpu``        erasure-kernel spans: encode/decode/matmul/fused-hash
+                 with shard geometry and bytes (ops/codec.py + friends)
+
+Every span carries the originating request ID (Dapper-style correlation,
+Sigelman et al. 2010): the S3 frontend mints one per request into a
+contextvar; internode RPC forwards it in an ``X-Request-ID`` header so
+spans emitted on a *peer* node still name the frontend request.
+
 Publishing is skipped entirely when nobody is subscribed, mirroring the
-reference's ``globalHTTPTrace.NumSubscribers() > 0`` guard.
+reference's ``globalHTTPTrace.NumSubscribers() > 0`` guard — the hot
+path pays a single predicate (:func:`active`), no dict construction.
 """
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
+import threading
 import time
 from typing import Any, Dict
 
@@ -20,10 +38,94 @@ from ..utils.pubsub import PubSub
 # global trace hub (reference: globalHTTPTrace)
 HTTP_TRACE = PubSub(max_queue=4000)
 
-# headers never to leak into traces (cmd/http-tracer.go redacts these)
+# subsystem trace types (pkg/trace.Type); "http" stays the default so
+# existing `admin trace` consumers see no change without ?type=
+TRACE_TYPES = ("http", "storage", "internode", "tpu")
+
+# headers never to leak into traces (cmd/http-tracer.go redacts these;
+# the reference strips ALL SSE-C key material — including the key MD5 —
+# and browser cookies)
 _REDACTED_HEADERS = {"authorization", "x-amz-security-token",
+                     "cookie", "set-cookie",
                      "x-amz-server-side-encryption-customer-key",
-                     "x-amz-copy-source-server-side-encryption-customer-key"}
+                     "x-amz-server-side-encryption-customer-key-md5",
+                     "x-amz-copy-source-server-side-encryption-customer-key",
+                     "x-amz-copy-source-server-side-encryption-customer"
+                     "-key-md5"}
+
+# the request ID minted at the S3 frontend, visible to every subsystem
+# call made on behalf of that request (threads started per-request see
+# it via explicit propagation: erasure fan-out and RPC header)
+_REQUEST_ID: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "mt_request_id", default="")
+
+# this process's node name for span attribution (set once at server
+# boot; cluster nodes use their node_id).  Process-global by design —
+# one process IS one node in every real deployment, exactly like the
+# reference's globalHTTPTrace; embedded multi-server tests that share a
+# process disambiguate spans by their detail payload (drive path /
+# endpoint), not nodeName.
+NODE_NAME = ""
+
+
+def set_node_name(name: str) -> None:
+    global NODE_NAME
+    NODE_NAME = name
+
+
+def set_request_id(request_id: str) -> None:
+    _REQUEST_ID.set(request_id)
+
+
+def get_request_id() -> str:
+    return _REQUEST_ID.get()
+
+
+# deep-span activation bookkeeping: a default (http-only) `admin trace`
+# stream must not light up subsystem-span construction — locally or on
+# peers — just to have the filter drop everything.  Consumers that only
+# want http records register an opt-out; peer ring polls declare their
+# wanted types and only lease deep capture when they include one.
+_DEEP_OPT_OUT = 0
+_deep_mu = threading.Lock()
+_deep_ring_until = 0.0
+
+DEEP_RING_LEASE_S = 10.0
+
+
+@contextlib.contextmanager
+def http_only_consumer():
+    """Mark one hub subscriber as http-only for its lifetime: it keeps
+    http traces flowing (PubSub.active) without paying for subsystem
+    spans it would filter out anyway."""
+    global _DEEP_OPT_OUT
+    with _deep_mu:
+        _DEEP_OPT_OUT += 1
+    try:
+        yield
+    finally:
+        with _deep_mu:
+            _DEEP_OPT_OUT -= 1
+
+
+def lease_deep_ring(seconds: float = DEEP_RING_LEASE_S) -> None:
+    """A peer poll wants subsystem spans: capture them for a while
+    (the trace ring's lease pattern, utils/pubsub.py since())."""
+    global _deep_ring_until
+    _deep_ring_until = time.monotonic() + seconds
+
+
+def active() -> bool:
+    """Single-predicate guard for SUBSYSTEM span emission: True only
+    when a consumer that wants deep spans exists — a hub subscriber
+    that did not opt out, or a recent peer poll that asked for deep
+    types.  HTTP traces gate on PubSub.active instead (any consumer)."""
+    if HTTP_TRACE._n_subs > _DEEP_OPT_OUT:
+        return True
+    until = _deep_ring_until
+    if not until:
+        return False
+    return time.monotonic() < until
 
 
 def redact_headers(headers: Dict[str, str]) -> Dict[str, str]:
@@ -36,13 +138,15 @@ def make_trace(node_name: str, func_name: str, *, method: str, path: str,
                status_code: int, resp_headers: Dict[str, str],
                input_bytes: int, output_bytes: int,
                start_ns: int, ttfb_ns: int, duration_ns: int,
-               trace_type: str = "http", error: str = "") -> Dict[str, Any]:
+               trace_type: str = "http", error: str = "",
+               request_id: str = "") -> Dict[str, Any]:
     """Build a trace.Info-shaped record (pkg/trace/trace.go:26-40)."""
     return {
         "type": trace_type,
         "nodeName": node_name,
         "funcName": func_name,
         "time": start_ns,
+        "requestID": request_id or get_request_id(),
         "reqInfo": {
             "time": start_ns,
             "method": method,
@@ -66,8 +170,36 @@ def make_trace(node_name: str, func_name: str, *, method: str, path: str,
     }
 
 
+def make_span(trace_type: str, func_name: str, *, start_ns: int,
+              duration_ns: int, input_bytes: int = 0,
+              output_bytes: int = 0, error: str = "",
+              detail: Dict[str, Any] | None = None) -> Dict[str, Any]:
+    """Subsystem span (the ``mc admin trace -a`` record shape):
+    smaller than an HTTP trace.Info but keyed the same so one consumer
+    handles both.  ``detail`` lands under the trace-type key, e.g.
+    ``{"storage": {"drive": ..., "volume": ..., "path": ...}}``."""
+    return {
+        "type": trace_type,
+        "nodeName": NODE_NAME,
+        "funcName": func_name,
+        "time": start_ns,
+        "requestID": get_request_id(),
+        "callStats": {
+            "inputBytes": input_bytes,
+            "outputBytes": output_bytes,
+            "latency_ns": duration_ns,
+        },
+        **({trace_type: detail} if detail else {}),
+        **({"error": error} if error else {}),
+    }
+
+
 def publish(info: Dict[str, Any]) -> None:
     HTTP_TRACE.publish(info)
+
+
+def publish_span(span: Dict[str, Any]) -> None:
+    HTTP_TRACE.publish(span)
 
 
 def subscribers() -> int:

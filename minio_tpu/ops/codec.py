@@ -17,12 +17,24 @@ backends (and with klauspost/reedsolomon's defaults).
 from __future__ import annotations
 
 import functools
+import time
 
 import numpy as np
 
+from ..obs import trace as _obstrace
 from . import gf8, gf8_ref
 
 MAX_SHARDS = 256  # data+parity <= 256 (cmd/erasure-coding.go:41)
+
+
+def _nbytes(x) -> int:
+    n = getattr(x, "nbytes", None)
+    if n is not None:
+        return int(n)
+    try:
+        return len(x)
+    except TypeError:
+        return 0
 
 
 class ErasureError(ValueError):
@@ -78,9 +90,56 @@ class Erasure:
         accepts batched (B, k, n) operands (tpu and mesh backends)."""
         return self.backend in ("tpu", "mesh")
 
+    # -- kernel observability ----------------------------------------------
+
+    def _observe(self, op: str, nbytes: int, t0_ns: int,
+                 blocks: int = 0, error: str = "") -> None:
+        """One erasure-kernel dispatch: always counted into the
+        mt_tpu_* metric families (encode GiB/s falls out of
+        bytes_total / kernel_seconds_sum — the BENCH trajectory numbers
+        become scrapeable), and published as a ``tpu``-type span when a
+        trace consumer is active.  Cost is three counter bumps against
+        megabytes of GF(2^8) math — noise on this path."""
+        # lazy import: the compute-kernel layer must not pull the admin
+        # package in at import time (layering; a future admin->ops
+        # import must not cycle)
+        from ..admin import metrics as _metrics
+        dt = time.monotonic_ns() - t0_ns
+        labels = {"op": op, "backend": self.backend}
+        m = _metrics.GLOBAL
+        m.inc("mt_tpu_ops_total", labels)
+        m.inc("mt_tpu_bytes_total", labels, float(nbytes))
+        m.observe("mt_tpu_kernel_seconds", labels, dt / 1e9,
+                  buckets=_metrics.KERNEL_BUCKETS)
+        if blocks:
+            m.observe("mt_tpu_batch_blocks", {"op": op}, float(blocks),
+                      buckets=_metrics.BATCH_BUCKETS)
+        if error:
+            m.inc("mt_tpu_errors_total", labels)
+        if _obstrace.active():
+            _obstrace.publish_span(_obstrace.make_span(
+                "tpu", f"tpu.{op}", start_ns=time.time_ns() - dt,
+                duration_ns=dt,
+                input_bytes=int(nbytes), error=error,
+                detail={"op": op, "backend": self.backend,
+                        "k": self.data_blocks, "m": self.parity_blocks,
+                        "blockSize": self.block_size,
+                        "blocks": blocks}))
+
     def apply_matrix(self, rows: np.ndarray, shards) -> np.ndarray:
         """rows (GF) @ shards through this codec's engine; accepts
         (k, n) or batched (B, k, n) on device backends."""
+        t0 = time.monotonic_ns()
+        err = ""
+        try:
+            return self._apply_matrix(rows, shards)
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            self._observe("matmul", _nbytes(shards), t0, error=err)
+
+    def _apply_matrix(self, rows: np.ndarray, shards) -> np.ndarray:
         impl_apply = getattr(self._impl, "apply_matrix", None)
         if impl_apply is not None:
             return impl_apply(rows, shards)
@@ -111,9 +170,18 @@ class Erasure:
         lens = {len(s) for s in shards if s is not None and len(s) > 0}
         if len(lens) > 1:
             raise ErasureError("shard size mismatch")
-        return self._impl.reconstruct(
-            shards, self.data_blocks, self.parity_blocks,
-            data_only=data_only, matrix=self.matrix)
+        t0 = time.monotonic_ns()
+        err = ""
+        try:
+            return self._impl.reconstruct(
+                shards, self.data_blocks, self.parity_blocks,
+                data_only=data_only, matrix=self.matrix)
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            present = sum(_nbytes(s) for s in shards if s is not None)
+            self._observe("decode", present, t0, error=err)
 
     def decode_data_blocks(self, shards) -> list[np.ndarray]:
         """DecodeDataBlocks (cmd/erasure-coding.go:89): rebuild data only.
@@ -162,6 +230,20 @@ class Erasure:
         dispatch for the tail block.  Returns k+m shard-file byte arrays whose
         concatenated per-block layout matches block-by-block encode_data.
         """
+        t0 = time.monotonic_ns()
+        err = ""
+        total = _nbytes(data)
+        try:
+            return self._encode_object(data)
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            self._observe("encode", total, t0,
+                          blocks=-(-total // self.block_size)
+                          if total else 0, error=err)
+
+    def _encode_object(self, data) -> list[np.ndarray]:
         buf = np.frombuffer(bytes(data), dtype=np.uint8) \
             if not isinstance(data, np.ndarray) \
             else np.asarray(data, np.uint8).ravel()
@@ -209,6 +291,20 @@ class Erasure:
         computed by the native kernel directly into its frame payloads.
         Requires the native GF8 library (callers fall back to
         encode_object + streaming framing)."""
+        t0 = time.monotonic_ns()
+        err = ""
+        total = _nbytes(data)
+        try:
+            return self._encode_object_framed(data, digest)
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            self._observe("encode-framed", total, t0,
+                          blocks=-(-total // self.block_size)
+                          if total else 0, error=err)
+
+    def _encode_object_framed(self, data, digest: int = 32) -> np.ndarray:
         from . import gf8_native
         assert gf8_native.available()
         buf = np.frombuffer(bytes(data), dtype=np.uint8) \

@@ -20,9 +20,11 @@ from __future__ import annotations
 
 import functools
 import struct
+import time
 
 import numpy as np
 
+from ..obs import trace as _trace
 from ..ops.codec import Erasure, ErasureError
 
 
@@ -57,27 +59,61 @@ def _unframe(data: bytes) -> list[np.ndarray]:
 
 
 def register_codec_service(rpc, backend: str = "auto") -> None:
-    """Expose this node's codec over RPC (the sidecar side)."""
+    """Expose this node's codec over RPC (the sidecar side).  Each
+    service call publishes a ``tpu``-type span (shard geometry + bytes)
+    when tracing is active — the sidecar twin of the codec's own kernel
+    spans, carrying the request ID forwarded by the RPC server."""
+
+    def _spanned(func_name, params, body, fn):
+        if not _trace.active():
+            return fn()
+        # detail built BEFORE the try: malformed params must raise once,
+        # cleanly, from here — a raise inside the finally would mask the
+        # handler's real exception and lose the error span
+        detail = {"k": int(params["k"]), "m": int(params["m"]),
+                  "blockSize": int(params["block_size"]),
+                  "backend": backend, "sidecar": True}
+        t0 = time.monotonic_ns()
+        err = ""
+        out = None
+        try:
+            out = fn()
+            return out
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            dt = time.monotonic_ns() - t0
+            _trace.publish_span(_trace.make_span(
+                "tpu", func_name, start_ns=_trace.now_ns() - dt,
+                duration_ns=dt,
+                input_bytes=len(body),
+                output_bytes=len(out) if out else 0, error=err,
+                detail=detail))
 
     def encode(params: dict, body: bytes) -> bytes:
-        c = _codec(int(params["k"]), int(params["m"]),
-                   int(params["block_size"]), backend)
-        return _frame(c.encode_object(body))
+        def run():
+            c = _codec(int(params["k"]), int(params["m"]),
+                       int(params["block_size"]), backend)
+            return _frame(c.encode_object(body))
+        return _spanned("codec-encode", params, body, run)
 
     def reconstruct(params: dict, body: bytes) -> bytes:
-        c = _codec(int(params["k"]), int(params["m"]),
-                   int(params["block_size"]), backend)
-        present = list(params["present"])
-        want = list(params["want"])
-        got = _unframe(body)
-        if len(got) != len(present):
-            raise ErasureError("present/body mismatch")
-        n = c.data_blocks + c.parity_blocks
-        shards: list[np.ndarray | None] = [None] * n
-        for idx, s in zip(present, got):
-            shards[idx] = s
-        full = c.decode_data_and_parity_blocks(shards)
-        return _frame([full[i] for i in want])
+        def run():
+            c = _codec(int(params["k"]), int(params["m"]),
+                       int(params["block_size"]), backend)
+            present = list(params["present"])
+            want = list(params["want"])
+            got = _unframe(body)
+            if len(got) != len(present):
+                raise ErasureError("present/body mismatch")
+            n = c.data_blocks + c.parity_blocks
+            shards: list[np.ndarray | None] = [None] * n
+            for idx, s in zip(present, got):
+                shards[idx] = s
+            full = c.decode_data_and_parity_blocks(shards)
+            return _frame([full[i] for i in want])
+        return _spanned("codec-reconstruct", params, body, run)
 
     rpc.register_raw("codec-encode", encode)
     rpc.register_raw("codec-reconstruct", reconstruct)

@@ -39,8 +39,25 @@ def register_peer_service(rpc: RPCServer, srv) -> None:
         srv.iam.load()
         return True
 
-    def trace_since(seq: int, limit: int = 500):
+    def trace_since(seq: int, limit: int = 500, types=None):
+        """Trace-ring poll; ``types`` is the aggregator's wanted trace
+        types — subsystem-span capture is only leased when a deep type
+        is wanted, items are filtered server-side so http-only
+        aggregation never ships deep spans over the wire.  An ABSENT
+        ``types`` is a pre-deep-tracing caller (rolling upgrade): it
+        gets exactly the old behavior — http records only, no deep
+        lease.  The explicit sentinel ``["all"]`` streams everything."""
+        from ..obs import trace as _trace
+        want = set(types) if types is not None else {"http"}
+        if "all" in want:
+            _trace.lease_deep_ring()
+            want = None
+        elif want - {"http"}:
+            _trace.lease_deep_ring()
         latest, items = srv.trace_hub.since(seq, limit)
+        if want is not None:
+            items = [i for i in items
+                     if i.get("type", "http") in want]
         return {"seq": latest, "items": items}
 
     def log_recent(n: int = 100):
@@ -174,20 +191,27 @@ class PeerNotifier:
     # -- observability aggregation ----------------------------------------
 
     def trace_tails(self, cursors: dict[str, int],
-                    limit: int = 500) -> list:
+                    limit: int = 500, types=None) -> list:
         """Poll every peer's trace ring once; ``cursors`` maps endpoint →
         last-seen seq and is updated in place.  A peer first seen (or
         seen again after being unreachable at prime time) is primed at
-        its CURRENT seq — a live stream never replays its history."""
+        its CURRENT seq — a live stream never replays its history.
+        ``types`` (a list of trace types, None = all) is forwarded so
+        peers only capture/ship what the aggregating stream wants; the
+        wire encodes "all" explicitly because an ABSENT types means a
+        legacy (http-only) caller on the peer side."""
+        wire_types = list(types) if types is not None else ["all"]
         merged: list = []
         for c in self.clients:
             try:
                 if c.endpoint not in cursors:
-                    out = c.call("peer", "trace_since", seq=0, limit=0)
+                    out = c.call("peer", "trace_since", seq=0, limit=0,
+                                 types=wire_types)
                     cursors[c.endpoint] = out["seq"]
                     continue
                 out = c.call("peer", "trace_since",
-                             seq=cursors[c.endpoint], limit=limit)
+                             seq=cursors[c.endpoint], limit=limit,
+                             types=wire_types)
                 if out["seq"] < cursors[c.endpoint] and not out["items"]:
                     # peer restarted: its seq space reset below our
                     # cursor — re-prime at its current head

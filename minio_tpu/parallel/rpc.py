@@ -22,7 +22,20 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import msgpack
 
+from ..obs import trace as _trace
+
 TOKEN_WINDOW_S = 15 * 60
+
+# internode request-correlation header: carries the originating S3
+# frontend's request ID so spans emitted on a PEER node still name the
+# request (Dapper-style context propagation over peerREST)
+REQUEST_ID_HEADER = "X-Request-ID"
+
+# the observability plane must not observe itself: the trace-ring poll
+# would otherwise emit client+server internode spans per 0.5s poll that
+# feed back into the very stream being aggregated (the reference
+# likewise exempts peerRESTMethodTrace from tracing)
+UNTRACED_PATHS = frozenset({"/rpc/peer/trace_since"})
 
 
 def sever_connections(conns) -> None:
@@ -254,6 +267,12 @@ class RPCServer:
                     return self._reply(403, {"ok": False,
                                              "error_type": "AuthError",
                                              "message": "bad token"})
+                # adopt the caller's request ID for every span this
+                # handler thread emits (drive ops, codec calls); set
+                # unconditionally so keep-alive reuse never leaks a
+                # previous request's ID into the next one
+                _trace.set_request_id(
+                    self.headers.get(REQUEST_ID_HEADER, "") or "")
                 parts = path.strip("/").split("/")
                 if len(parts) >= 2 and parts[0] == "raw":
                     return self._do_raw(parts[1])
@@ -269,16 +288,35 @@ class RPCServer:
                                              "error_type": "NoSuchMethod",
                                              "message": path})
                 n = int(self.headers.get("Content-Length") or 0)
+                # monotonic duration: a wall-clock step mid-RPC must
+                # not emit garbage latency_ns (same pattern as the
+                # storage/kernel instrumentation)
+                t0 = time.monotonic_ns() \
+                    if _trace.active() and path not in UNTRACED_PATHS \
+                    else 0
+                err = ""
                 try:
                     kwargs = msgpack.unpackb(self.rfile.read(n), raw=False) \
                         if n else {}
                     result = fn(**kwargs)
                     self._reply(200, {"ok": True, "result": result})
                 except Exception as e:  # noqa: BLE001 — typed over the wire
+                    err = f"{type(e).__name__}: {e}"
                     self._reply(200, {
                         "ok": False,
                         "error_type": type(e).__name__,
                         "message": str(e)})
+                finally:
+                    if t0:
+                        dt = time.monotonic_ns() - t0
+                        _trace.publish_span(_trace.make_span(
+                            "internode", f"internode{path}",
+                            start_ns=_trace.now_ns() - dt,
+                            duration_ns=dt,
+                            input_bytes=n, error=err,
+                            detail={"service": parts[1],
+                                    "method": parts[2],
+                                    "side": "server"}))
 
             def _do_raw(self, name: str):
                 """Bulk endpoint: params ride the X-RPC-Params header
@@ -294,16 +332,32 @@ class RPCServer:
                     return self._reply(404, {"ok": False,
                                              "error_type": "NoSuchMethod",
                                              "message": name})
+                t0 = time.monotonic_ns() if _trace.active() else 0
+                err = ""
+                out = None
                 try:
                     params = msgpack.unpackb(bytes.fromhex(
                         self.headers.get("X-RPC-Params", "")), raw=False)
                     out = fn(params, data)
                     self._reply_raw(out if out is not None else b"")
                 except Exception as e:  # noqa: BLE001
+                    err = f"{type(e).__name__}: {e}"
                     self._reply(400, {
                         "ok": False,
                         "error_type": type(e).__name__,
                         "message": str(e)})
+                finally:
+                    if t0:
+                        dt = time.monotonic_ns() - t0
+                        _trace.publish_span(_trace.make_span(
+                            "internode", f"internode/raw/{name}",
+                            start_ns=_trace.now_ns() - dt,
+                            duration_ns=dt,
+                            input_bytes=n,
+                            output_bytes=len(out) if out else 0,
+                            error=err,
+                            detail={"service": "raw", "method": name,
+                                    "side": "server"}))
 
         return Handler
 
@@ -520,6 +574,9 @@ class RPCClient:
             "Authorization": f"Bearer {mint_token(self.secret, path)}",
             "Content-Type": "application/msgpack",
             **(extra_headers or {})}
+        rid = _trace.get_request_id()
+        if rid:
+            headers[REQUEST_ID_HEADER] = rid
         from ..admin.metrics import GLOBAL as _mtr
         start = time.monotonic()
         state = {"attempt": 0, "stale": 0}
@@ -601,9 +658,12 @@ class RPCClient:
     def call(self, service: str, method: str, _idempotent: bool = False,
              **kwargs):
         path = f"/rpc/{service}/{method}"
-        return self._roundtrip(path, msgpack.packb(kwargs,
-                                                   use_bin_type=True),
-                               service, idempotent=_idempotent)
+        body = msgpack.packb(kwargs, use_bin_type=True)
+        if path in UNTRACED_PATHS or not _trace.active():
+            return self._roundtrip(path, body, service,
+                                   idempotent=_idempotent)
+        return self._traced_roundtrip(
+            path, body, service, dict(idempotent=_idempotent))
 
     def raw_call(self, name: str, params: dict, body: bytes = b"",
                  idempotent: bool = False) -> bytes:
@@ -612,6 +672,33 @@ class RPCClient:
         second msgpack copy on either side."""
         path = f"/raw/{name}"
         hdr = msgpack.packb(params, use_bin_type=True).hex()
-        return self._roundtrip(path, body, "storage",
-                               extra_headers={"X-RPC-Params": hdr},
-                               raw_response=True, idempotent=idempotent)
+        kw = dict(extra_headers={"X-RPC-Params": hdr},
+                  raw_response=True, idempotent=idempotent)
+        if not _trace.active():
+            return self._roundtrip(path, body, "storage", **kw)
+        return self._traced_roundtrip(path, body, "storage", kw)
+
+    def _traced_roundtrip(self, path: str, body: bytes, service: str,
+                          kw: dict):
+        """Client-side internode span around one RPC (trace type
+        ``internode``, cmd/peer-rest-client.go trace wrappers)."""
+        t0 = time.monotonic_ns()
+        err = ""
+        out = None
+        try:
+            out = self._roundtrip(path, body, service, **kw)
+            return out
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            dt = time.monotonic_ns() - t0
+            _trace.publish_span(_trace.make_span(
+                "internode", f"internode{path}",
+                start_ns=_trace.now_ns() - dt, duration_ns=dt,
+                input_bytes=len(body),
+                output_bytes=len(out)
+                if isinstance(out, (bytes, bytearray)) else 0,
+                error=err,
+                detail={"endpoint": self.endpoint, "service": service,
+                        "side": "client"}))

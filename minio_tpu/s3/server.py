@@ -313,12 +313,17 @@ class S3Server:
         # trace hub is process-global (mirrors globalHTTPTrace); audit
         # log is per-server so deployments keep entries separate
         from ..obs import audit as _obs_audit
+        from ..obs import lastminute as _obs_lastminute
         from ..obs import logger as _obs_logger
         from ..obs import trace as _obs_trace
         self.trace_hub = _obs_trace.HTTP_TRACE
         self.audit = _obs_audit.AuditLog()
         self.logger = _obs_logger.GLOBAL
         self.node_name = f"{host}:{port}"
+        # last-minute per-API stats (cmd/last-minute.go role): feeds the
+        # mt_s3_api_last_minute_* scrape families and the admin `top`
+        # endpoint (hottest APIs)
+        self.api_stats = _obs_lastminute.OpWindows(self.node_name)
         if self.config.get("audit_webhook", "enable") == "on":
             self.audit.targets.append(_obs_logger.HTTPLogTarget(
                 self.config.get("audit_webhook", "endpoint"),
@@ -345,6 +350,11 @@ class S3Server:
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
         self.port = self.httpd.server_address[1]
+        # span attribution names the BOUND port (ephemeral binds resolve
+        # only now); run_node overrides both with the cluster node_id
+        self.node_name = f"{host}:{self.port}"
+        self.api_stats.label = self.node_name
+        _obs_trace.set_node_name(self.node_name)
         # federation binds the *actual* port (ephemeral binds resolve
         # only once the listener exists)
         from ..utils.fed_dns import FederationSys
@@ -784,7 +794,14 @@ def _make_handler(srv: S3Server):
             (cmd/http-tracer.go httpTraceAll + cmd/logger/audit.go)."""
             from ..obs import trace as _trace
             self._t0_ns = _trace.now_ns()
+            # monotonic twin for durations fed into latency windows (a
+            # wall-clock step must not record garbage into api_stats)
+            self._t0m_ns = time.monotonic_ns()
             self._req_id = uuid.uuid4().hex[:16]
+            # correlation root (Dapper-style): every subsystem span this
+            # request causes — storage calls, internode RPCs, TPU
+            # kernels, even on peer nodes — carries this ID
+            _trace.set_request_id(self._req_id)
             self._resp_status = 0
             self._resp_headers = {}
             self._resp_bytes = 0
@@ -833,6 +850,9 @@ def _make_handler(srv: S3Server):
                     self._record_request()
                 except Exception:   # noqa: BLE001 — never fail a request
                     pass            # on account of observability
+                # keep-alive reuses this thread for the next request —
+                # its spans must not inherit this request's ID
+                _trace.set_request_id("")
 
         def _admit(self, sem) -> bool:
             """Request-pool admission: wait up to the deadline for a
@@ -872,8 +892,14 @@ def _make_handler(srv: S3Server):
                               "status": str(self._resp_status)})
                 ttfb = (self._ttfb_ns or dur) / 1e9
                 _mtr.observe("mt_s3_ttfb_seconds", {"api": api_name}, ttfb)
-            if srv.trace_hub.num_subscribers > 0 or \
-                    srv.trace_hub.ring_active:
+                # last-minute per-API window (mt_s3_api_last_minute_*
+                # gauges + admin `top`): S3 APIs only, same scoping as
+                # the per-API counter families above; monotonic delta,
+                # unlike the wall-clock trace timestamps
+                srv.api_stats.record(
+                    api_name, time.monotonic_ns() - self._t0m_ns,
+                    self._rx_bytes + self._resp_bytes)
+            if srv.trace_hub.active:
                 srv.trace_hub.publish(_trace.make_trace(
                     srv.node_name, api_name,
                     method=self.command, path=path,
@@ -885,8 +911,8 @@ def _make_handler(srv: S3Server):
                     input_bytes=self._rx_bytes,
                     output_bytes=self._resp_bytes,
                     start_ns=self._t0_ns, ttfb_ns=self._ttfb_ns,
-                    duration_ns=dur))
-            if srv.audit.targets or srv.audit.recent is not None:
+                    duration_ns=dur, request_id=self._req_id))
+            if srv.audit.enabled:
                 srv.audit.publish(srv.audit.entry(
                     api_name=api_name, bucket=bucket, obj=key,
                     status_code=self._resp_status, rx=self._rx_bytes,

@@ -44,6 +44,87 @@ _GUARDED = {
 }
 
 
+def slow_drive_knobs(config=None) -> tuple[float, int]:
+    """(multiple, min_samples) from the ``drive`` kvconfig subsystem —
+    resolved per call, so admin SetConfigKV retunes detection live.
+    With no Config handed in, a fresh one still honors env overrides
+    (MT_DRIVE_SLOW_LATENCY_MULTIPLE / MT_DRIVE_SLOW_MIN_SAMPLES)."""
+    if config is None:
+        from ..utils.kvconfig import Config
+        config = Config()
+    try:
+        multiple = float(config.get("drive", "slow_latency_multiple"))
+    except (KeyError, ValueError):
+        multiple = 4.0
+    try:
+        min_samples = int(config.get("drive", "slow_min_samples"))
+    except (KeyError, ValueError):
+        min_samples = 10
+    return max(multiple, 1.0), max(min_samples, 1)
+
+
+def slow_drives(disks, multiple: float = 4.0, min_samples: int = 10
+                ) -> dict[str, dict]:
+    """Slow-drive detection over ONE erasure set's last-minute latency
+    windows: a drive whose p50 exceeds ``multiple`` x the median p50 of
+    the OTHER drives in the set is flagged (tail-at-scale hedging
+    signal, Dean & Barroso 2013) — flagged in health/metrics output,
+    never ejected; ejection stays the circuit breaker's job and needs
+    hard failures, not latency.
+
+    Leave-one-out median: comparing a drive against a median that
+    includes itself lets a single outlier in a small set DRAG the
+    median up to its own p50 and never trip (2 drives: median == the
+    slow drive).  Callers with a multi-set layer group per set first
+    (slow_drives_for_layer) so an HDD pool never masks a failing NVMe.
+
+    Returns {endpoint: {"p50_ns", "samples", "median_ns", "slow"}} for
+    drives with any last-minute traffic."""
+    from ..obs.lastminute import drive_windows
+    wins = drive_windows(disks)
+    stats = {}
+    for endpoint, w in wins.items():
+        samples = sum(c for c, _, _ in w.totals().values())
+        if not samples:
+            continue
+        stats[endpoint] = {"p50_ns": w.p50_all(), "samples": samples}
+    if not stats:
+        return {}
+    for endpoint, v in stats.items():
+        others = sorted(o["p50_ns"] for e, o in stats.items()
+                        if e != endpoint)
+        median = others[len(others) // 2] if others else 0
+        v["median_ns"] = median
+        v["slow"] = bool(
+            median > 0 and v["samples"] >= min_samples
+            and v["p50_ns"] > multiple * median)
+    return stats
+
+
+def disks_by_set(layer) -> list[list]:
+    """Per-erasure-set drive lists for every topology shape (flat /
+    sets / pools-of-sets) — the storage layer's own traversal, shared
+    with the admin scrape so neither depends on the other's internals."""
+    if hasattr(layer, "pools"):
+        return [list(s.disks) for p in layer.pools for s in p.sets]
+    if hasattr(layer, "sets"):
+        return [list(s.disks) for s in layer.sets]
+    disks = getattr(layer, "disks", None)   # FS/gateway layers: none
+    return [list(disks)] if disks else []
+
+
+def slow_drives_for_layer(layer, multiple: float = 4.0,
+                          min_samples: int = 10) -> dict[str, dict]:
+    """slow_drives() grouped PER ERASURE SET across any topology shape
+    — the detection contract compares a drive against its set peers
+    (same workload, same shard fan-out), never against other pools."""
+    out: dict[str, dict] = {}
+    for dlist in disks_by_set(layer):
+        out.update(slow_drives(dlist, multiple=multiple,
+                               min_samples=min_samples))
+    return out
+
+
 class HealthDisk:
     """Circuit-breaking StorageAPI proxy with identity verification."""
 

@@ -9,8 +9,10 @@ exceptions so quorum reduction works unchanged across the node boundary.
 
 from __future__ import annotations
 
+import time
 from typing import Iterable
 
+from ..obs import trace as _trace
 from ..parallel.rpc import RPCClient, RPCError, RPCServer
 from . import errors as serrors
 from .api import DiskInfo, StorageAPI, VolInfo
@@ -152,20 +154,48 @@ class RemoteStorage(StorageAPI):
     }
 
     def _call(self, method: str, **kwargs):
+        # client-observed storage span (drive latency incl. the wire);
+        # the owning node's XLStorage emits the drive-local twin.  The
+        # last-minute window stays on the owning node — remote drives
+        # must not be double-counted in disk latency stats.
+        t0 = time.monotonic_ns() if _trace.active() else 0
+        err = ""
         try:
             return self._c.call("storage", method, drive_id=self.drive_id,
                                 _idempotent=method in self._IDEMPOTENT,
                                 **kwargs)
         except RPCError as e:
+            err = f"{e.error_type}: {e.message}"
             raise self._map_err(e) from e
+        finally:
+            if t0:
+                self._span(method, t0, err, kwargs)
 
     def _raw(self, name: str, params: dict, body: bytes = b"") -> bytes:
+        t0 = time.monotonic_ns() if _trace.active() else 0
+        err = ""
         try:
             return self._c.raw_call(
                 name, {"drive_id": self.drive_id, **params}, body,
                 idempotent=(name == "storage-read"))
         except RPCError as e:
+            err = f"{e.error_type}: {e.message}"
             raise self._map_err(e) from e
+        finally:
+            if t0:
+                self._span(name, t0, err, params, nbytes=len(body))
+
+    def _span(self, method: str, t0: int, err: str, params: dict,
+              nbytes: int = 0) -> None:
+        dt = time.monotonic_ns() - t0   # t0 is monotonic; wall clock
+        _trace.publish_span(_trace.make_span(  # only for the timestamp
+            "storage", f"storage.{method}",
+            start_ns=_trace.now_ns() - dt,
+            duration_ns=dt, input_bytes=nbytes,
+            error=err,
+            detail={"drive": self.endpoint(), "remote": True,
+                    "volume": params.get("volume", ""),
+                    "path": params.get("path", "")}))
 
     def _map_err(self, e: RPCError) -> Exception:
         cls = _ERR_TYPES.get(e.error_type)

@@ -24,9 +24,13 @@ import itertools
 import os
 import shutil
 import stat as stat_mod
+import threading
+import time
 import uuid
 from typing import Iterable
 
+from ..obs import lastminute as _lastminute
+from ..obs import trace as _trace
 from . import errors
 from .api import DiskInfo, StorageAPI, VolInfo
 from .datatypes import FileInfo
@@ -151,6 +155,10 @@ class XLStorage(StorageAPI):
         self.root = os.path.abspath(root)
         self._endpoint = endpoint or self.root
         self._disk_id = ""
+        # last-minute latency windows (obs/lastminute.py): every traced
+        # storage op records here; slow-drive detection and the
+        # mt_node_disk_latency_* scrape read them
+        self.latency = _lastminute.OpWindows(self._endpoint)
         if not os.path.isdir(self.root):
             raise errors.DiskNotFound(self.root)
         os.makedirs(os.path.join(self.root, TMP_DIR), exist_ok=True)
@@ -734,3 +742,88 @@ class XLStorage(StorageAPI):
     def clean_tmp(self, rel_dir: str) -> None:
         shutil.rmtree(os.path.join(self.root, SYS_DIR, rel_dir),
                       ignore_errors=True)
+
+
+# -- per-op instrumentation (deep tracing plane) ---------------------------
+# Every data-plane method records into the drive's last-minute latency
+# window (always on — slow-drive detection and mt_node_disk_latency_*
+# need it) and, only when a trace consumer is active, publishes a
+# ``storage``-type span to the HTTP_TRACE hub (`mc admin trace -a`
+# storage calls, cmd/xl-storage-disk-id-check.go trace wrappers).  With
+# zero subscribers and an idle peer ring the per-op cost beyond the
+# window update is a single predicate — no dict is ever built.
+
+_TRACED_OPS = ("read_all", "read_file_stream", "write_all",
+               "create_file", "append_file", "write_data_commit",
+               "rename_data", "rename_file", "write_metadata",
+               "update_metadata", "read_version", "list_versions",
+               "delete_version", "delete", "stat_info_file", "list_dir",
+               "verify_file", "check_parts")
+# payload position in the post-self positional args for write-side ops;
+# read-side ops report the returned byte count instead
+_OP_IN_ARG = {"write_all": 2, "create_file": 2, "append_file": 2,
+              "write_data_commit": 3}
+
+# re-entrancy guard: traced ops call each other internally (verify_file
+# reads parts via read_all, delete_version rewrites xl.meta via
+# write_metadata, every meta op goes through read_all/write_all) — only
+# the OUTERMOST call records, like the reference's disk-id-check proxy
+# where inner self-calls bypass the wrapper; otherwise one logical op
+# double-counts latency and emits nested duplicate spans
+_IN_TRACED_OP = threading.local()
+
+
+def _traced_op(op: str, fn, in_arg: int | None):
+    def traced(self, *a, **kw):
+        if getattr(_IN_TRACED_OP, "depth", 0):
+            return fn(self, *a, **kw)
+        _IN_TRACED_OP.depth = 1
+        # monotonic for the duration (an NTP step must not corrupt the
+        # latency windows feeding slow-drive detection); the wall clock
+        # is read only when a span is actually published
+        t0 = time.monotonic_ns()
+        err = ""
+        out = None
+        try:
+            out = fn(self, *a, **kw)
+            return out
+        except Exception as e:
+            err = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            _IN_TRACED_OP.depth = 0
+            dt = time.monotonic_ns() - t0
+            nbytes = 0
+            if in_arg is not None:
+                data = a[in_arg] if len(a) > in_arg \
+                    else kw.get("data")
+                try:
+                    nbytes = len(data) if data is not None else 0
+                except TypeError:
+                    nbytes = 0
+            elif isinstance(out, (bytes, bytearray)):
+                nbytes = len(out)
+            self.latency.record(op, dt, nbytes)
+            if _trace.active():
+                vol = a[0] if a and isinstance(a[0], str) \
+                    else kw.get("volume", "")
+                path = a[1] if len(a) > 1 and isinstance(a[1], str) \
+                    else kw.get("path", "")
+                _trace.publish_span(_trace.make_span(
+                    "storage", f"storage.{op}",
+                    start_ns=time.time_ns() - dt, duration_ns=dt,
+                    input_bytes=nbytes if in_arg is not None else 0,
+                    output_bytes=0 if in_arg is not None else nbytes,
+                    error=err,
+                    detail={"drive": self._endpoint, "volume": vol,
+                            "path": path}))
+    traced.__name__ = op
+    traced.__qualname__ = f"XLStorage.{op}"
+    traced.__wrapped__ = fn
+    return traced
+
+
+for _op in _TRACED_OPS:
+    setattr(XLStorage, _op,
+            _traced_op(_op, getattr(XLStorage, _op),
+                       _OP_IN_ARG.get(_op)))

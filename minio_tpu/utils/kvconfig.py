@@ -91,6 +91,15 @@ register_subsys("rpc", {
     "retry_cap": "2s",
     "retry_budget": "10",
 })
+register_subsys("drive", {
+    # slow-drive detection over the last-minute latency windows
+    # (obs/lastminute.py + storage/health.py slow_drives): a drive
+    # whose p50 exceeds multiple x the set median is flagged in
+    # health/metrics (mt_node_disk_slow), never ejected.  Read at
+    # scrape time, so admin SetConfigKV retunes detection live.
+    "slow_latency_multiple": "4",
+    "slow_min_samples": "10",
+})
 register_subsys("storage_class", {
     "standard": "",                 # e.g. EC:4
     "rrs": "EC:2",

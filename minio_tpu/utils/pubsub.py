@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterator, Optional
 
 
@@ -19,7 +20,12 @@ class PubSub:
         self._mu = threading.Lock()
         self._max_queue = max_queue
         self._ring = None                 # seq-numbered tail for peer polls
+        self._ring_until = 0.0
         self._seq = 0
+        # plain-int mirror of len(self._subs): hot paths gate span
+        # construction on ``active`` and must not take the lock (or
+        # allocate) just to learn nobody is listening
+        self._n_subs = 0
 
     def enable_ring(self, size: int = 2000) -> None:
         """Keep a sequence-numbered tail of published items so remote
@@ -38,9 +44,20 @@ class PubSub:
 
     @property
     def ring_active(self) -> bool:
-        import time
         return self._ring is not None and \
             time.monotonic() < self._ring_until
+
+    @property
+    def active(self) -> bool:
+        """True when publishing could reach anyone: a live subscriber or
+        a recently-polled ring.  Lock-free single predicate — THE guard
+        instrumented hot paths check before building a span dict."""
+        if self._n_subs:
+            return True
+        until = self._ring_until
+        if not until:
+            return False
+        return time.monotonic() < until
 
     def since(self, seq: int, limit: int = 500) -> tuple[int, list]:
         """Items published after ``seq``; returns (cursor, items) where
@@ -49,7 +66,6 @@ class PubSub:
         limit=0 returns the current latest seq with no items (cursor
         priming for live streams).  Calling this keeps the ring
         capturing for another 10s."""
-        import time
         with self._mu:
             if self._ring is None:
                 return self._seq, []
@@ -70,7 +86,6 @@ class PubSub:
             return last, out
 
     def publish(self, item: Any) -> None:
-        import time
         with self._mu:
             subs = list(self._subs)
             if self._ring is not None and \
@@ -78,8 +93,14 @@ class PubSub:
                 self._seq += 1
                 self._ring.append((self._seq, item))
         for q, flt in subs:
-            if flt is not None and not flt(item):
-                continue
+            if flt is not None:
+                try:
+                    if not flt(item):
+                        continue
+                except Exception:  # noqa: BLE001 — a broken subscriber
+                    continue       # filter must never fail the
+                                   # publisher (publish now runs inside
+                                   # storage/RPC data-path finallys)
             try:
                 q.put_nowait(item)
             except queue.Full:
@@ -91,11 +112,13 @@ class PubSub:
         sub = Subscription(self, q)
         with self._mu:
             self._subs.append((q, filter_fn))
+            self._n_subs = len(self._subs)
         return sub
 
     def _unsubscribe(self, q: queue.Queue) -> None:
         with self._mu:
             self._subs = [(qq, f) for qq, f in self._subs if qq is not q]
+            self._n_subs = len(self._subs)
 
     @property
     def num_subscribers(self) -> int:
@@ -117,7 +140,6 @@ class Subscription:
             return None
 
     def drain(self, max_items: int, timeout: float) -> Iterator[Any]:
-        import time
         deadline = time.monotonic() + timeout
         n = 0
         while n < max_items:

@@ -1,0 +1,189 @@
+"""Strict Prometheus text-exposition checker, run against a live scrape
+of a server under traffic (satellite of the deep-tracing PR): TYPE-line
+uniqueness, histogram bucket monotonicity + le="+Inf" == _count, and
+label-value escaping round trips."""
+
+import math
+import re
+
+import pytest
+
+from minio_tpu.admin.metrics import GLOBAL, render
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl_storage import XLStorage
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})? (-?(?:[0-9.eE+-]+|\+Inf|NaN))$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+_UNESCAPE_RE = re.compile(r"\\(.)")
+
+
+def unescape(v: str) -> str:
+    # single left-to-right pass per the spec: sequential .replace()
+    # would turn the two literal chars backslash+n (escaped \\n) into
+    # a newline
+    return _UNESCAPE_RE.sub(
+        lambda m: "\n" if m.group(1) == "n" else m.group(1), v)
+
+
+def parse_exposition(text: str):
+    """(types, samples): types = {family: type}, asserting TYPE
+    uniqueness; samples = [(name, {label: value}, float)]."""
+    types = {}
+    samples = []
+    for ln in text.splitlines():
+        if not ln.strip():
+            continue
+        if ln.startswith("# TYPE "):
+            parts = ln.split()
+            assert len(parts) == 4, f"malformed TYPE line: {ln!r}"
+            name, typ = parts[2], parts[3]
+            assert name not in types, f"duplicate # TYPE for {name}"
+            assert typ in ("counter", "gauge", "histogram", "summary",
+                           "untyped"), ln
+            types[name] = typ
+            continue
+        if ln.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(ln)
+        assert m, f"unparseable sample line: {ln!r}"
+        name, _, raw_labels, value = m.groups()
+        labels = {}
+        if raw_labels:
+            consumed = ",".join(
+                f'{k}="{v}"'
+                for k, v in _LABEL_RE.findall(raw_labels))
+            assert consumed == raw_labels, \
+                f"label block not fully parseable: {raw_labels!r}"
+            labels = {k: unescape(v)
+                      for k, v in _LABEL_RE.findall(raw_labels)}
+        samples.append((name, labels,
+                        math.inf if value == "+Inf" else float(value)))
+    return types, samples
+
+
+def check_histograms(types, samples):
+    """Per histogram family + label set: cumulative buckets are
+    monotonically nondecreasing in le, and le="+Inf" == _count."""
+    hist_families = [n for n, t in types.items() if t == "histogram"]
+    assert hist_families, "no histogram family in the scrape"
+    for fam in hist_families:
+        buckets = {}
+        counts = {}
+        for name, labels, value in samples:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            if name == f"{fam}_bucket":
+                le = labels["le"]
+                buckets.setdefault(key, []).append(
+                    (math.inf if le == "+Inf" else float(le), value))
+            elif name == f"{fam}_count":
+                counts[key] = value
+        assert buckets, f"histogram {fam} has no buckets"
+        for key, series in buckets.items():
+            series.sort()
+            values = [v for _, v in series]
+            assert values == sorted(values), \
+                f"{fam}{dict(key)} buckets not monotonic: {values}"
+            assert series[-1][0] == math.inf, f"{fam} missing +Inf"
+            assert key in counts, f"{fam} missing _count for {dict(key)}"
+            assert series[-1][1] == counts[key], \
+                f"{fam} le=+Inf {series[-1][1]} != _count {counts[key]}"
+
+
+@pytest.fixture
+def served(tmp_path):
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"d{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                           backend="numpy")
+    srv = S3Server(layer, access_key="ek", secret_key="es")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _scrape(srv) -> str:
+    import http.client
+    host, port = srv.endpoint.replace("http://", "").split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=10)
+    conn.request("GET", "/minio-tpu/metrics")
+    resp = conn.getresponse()
+    body = resp.read().decode()
+    conn.close()
+    assert resp.status == 200
+    return body
+
+
+def test_live_scrape_is_strictly_well_formed(served):
+    c = S3Client(served.endpoint, "ek", "es")
+    c.make_bucket("expbkt")
+    c.put_object("expbkt", "a", b"x" * (1 << 20))   # histogram traffic
+    c.get_object("expbkt", "a")
+    c.put_object("expbkt", "b", b"y" * 512)
+    import time
+    text = ""
+    for _ in range(40):   # counters land after the response flush
+        text = _scrape(served)
+        if "mt_s3_ttfb_seconds_bucket" in text:
+            break
+        time.sleep(0.05)
+    types, samples = parse_exposition(text)
+    check_histograms(types, samples)
+    # the deep-tracing families ride the same scrape
+    assert any(n.startswith("mt_tpu_") for n, _, _ in samples)
+    assert any(n == "mt_node_disk_latency_ops"
+               for n, _, _ in samples)
+
+
+def test_counter_values_keep_full_precision():
+    """%g would quantize big byte counters to 6 significant digits —
+    scrape deltas below the quantum would read as zero."""
+    GLOBAL.inc("mt_precision_probe_total", value=1_234_567_891_234.0)
+    GLOBAL.inc("mt_precision_probe_total", value=1.0)
+    types, samples = parse_exposition(render())
+    got = [v for n, _, v in samples if n == "mt_precision_probe_total"]
+    assert got and got[-1] == 1_234_567_891_235.0
+
+
+def test_label_escaping_round_trips():
+    nasty = 'a"b\\c\nd'
+    GLOBAL.inc("mt_escape_probe_total", {"path": nasty})
+    types, samples = parse_exposition(render())
+    got = [v for n, labels, v in samples
+           if n == "mt_escape_probe_total"
+           and labels.get("path") == nasty]
+    assert got and got[-1] >= 1.0, \
+        "escaped label value did not round-trip"
+
+
+def test_no_second_type_line_for_shared_names():
+    """A counter and histogram sharing a name (or a histogram-derived
+    name like <fam>_count) must not mint two # TYPE lines — the
+    colliding counter is dropped so the family stays well-formed."""
+    GLOBAL.inc("mt_dup_probe")
+    GLOBAL.observe("mt_dup_probe", value=0.5)
+    text = render()
+    assert len(re.findall(r"^# TYPE mt_dup_probe(?: |$)", text,
+                          re.M)) == 1
+    # the bare counter sample would be a mis-shaped member of the
+    # histogram family — it must not appear at all
+    assert not re.search(r"^mt_dup_probe \d", text, re.M)
+    # derived histogram sample names are reserved too
+    GLOBAL.observe("mt_dup_probe2", value=0.5)
+    GLOBAL.inc("mt_dup_probe2_count")
+    text = render()
+    assert len(re.findall(r"^# TYPE mt_dup_probe2_count ", text,
+                          re.M)) == 0
+    # exactly ONE _count sample survives: the histogram's own
+    assert len(re.findall(r"^mt_dup_probe2_count ", text, re.M)) == 1
+    types, samples = parse_exposition(text)  # still parseable + valid
+    check_histograms(types, samples)
+    assert types["mt_dup_probe2"] == "histogram"

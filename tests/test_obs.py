@@ -33,7 +33,10 @@ def client(server):
 
 
 def test_trace_published_on_request(server, client):
-    with server.trace_hub.subscribe() as sub:
+    # a raw hub subscription sees every span type; filter to the http
+    # records this test is about (the admin route filters the same way)
+    with server.trace_hub.subscribe(
+            lambda i: i.get("type", "http") == "http") as sub:
         client.make_bucket("tracebkt")
         client.put_object("tracebkt", "o1", b"hello")
         infos = list(sub.drain(10, timeout=2.0))
@@ -44,6 +47,7 @@ def test_trace_published_on_request(server, client):
     assert put["respInfo"]["statusCode"] == 200
     assert put["callStats"]["inputBytes"] >= 5
     assert put["callStats"]["latency_ns"] > 0
+    assert put["requestID"]
     # credentials must never leak into a trace
     assert put["reqInfo"]["headers"].get("Authorization") == "*REDACTED*"
 
@@ -56,6 +60,13 @@ def test_trace_skipped_without_subscribers(server, client):
 
 
 def test_audit_entries(server, client):
+    # entry construction is gated on an actual consumer: arm the
+    # in-memory tail BEFORE generating traffic (obs/audit.py enabled;
+    # the disarmed-by-default contract is unit-tested on a fresh
+    # AuditLog in test_audit_disabled_builds_no_entries — the module
+    # fixture's log may already be armed by another test)
+    server.audit.tail()
+    assert server.audit.enabled
     if not client.head_bucket("tracebkt"):
         client.make_bucket("tracebkt")
     client.put_object("tracebkt", "o3", b"abc")
@@ -106,8 +117,57 @@ def test_admin_log_and_audit_routes(server, client):
     r = client.request("GET", "/minio-tpu/admin/v1/log", "n=50")
     entries = json.loads(r.body)
     assert any("unit-test log line" == e["message"] for e in entries)
-    r = client.request("GET", "/minio-tpu/admin/v1/audit-recent", "n=10")
-    assert json.loads(r.body)
+    # the audit-recent route arms the tail on first read (it may
+    # return [] right after boot); traffic after that is recorded —
+    # self-contained so the test passes standalone, in any order
+    client.request("GET", "/minio-tpu/admin/v1/audit-recent", "n=10")
+    if not client.head_bucket("tracebkt"):
+        client.make_bucket("tracebkt")
+    client.put_object("tracebkt", "oaudit", b"audited")
+    import time
+    entries = []
+    for _ in range(100):
+        r = client.request("GET", "/minio-tpu/admin/v1/audit-recent",
+                           "n=10")
+        entries = json.loads(r.body)
+        if entries:
+            break
+        time.sleep(0.02)
+    assert entries
+
+
+def test_redaction_covers_cookies_and_ssec_key_md5():
+    """The reference redacts ALL SSE-C key material (key MD5 included)
+    and browser cookies — not just the Authorization header."""
+    from minio_tpu.obs.trace import redact_headers
+    redacted = redact_headers({
+        "Authorization": "AWS4 secret",
+        "Cookie": "session=abc",
+        "Set-Cookie": "token=def",
+        "X-Amz-Server-Side-Encryption-Customer-Key": "k",
+        "X-Amz-Server-Side-Encryption-Customer-Key-MD5": "md5",
+        "X-Amz-Copy-Source-Server-Side-Encryption-Customer-Key": "ck",
+        "X-Amz-Copy-Source-Server-Side-Encryption-Customer-Key-MD5":
+            "cmd5",
+        "Content-Type": "text/plain",
+    })
+    for k, v in redacted.items():
+        if k == "Content-Type":
+            assert v == "text/plain"
+        else:
+            assert v == "*REDACTED*", f"{k} leaked: {v}"
+
+
+def test_audit_disabled_builds_no_entries():
+    """A target-less, unconsumed AuditLog must not cost a dict build
+    per request; arming the tail (one consumer) enables it."""
+    alog = obs_audit.AuditLog()
+    assert not alog.enabled
+    assert alog.tail() == []          # first read arms, returns empty
+    assert alog.enabled
+    alog2 = obs_audit.AuditLog()
+    alog2.targets.append(object())    # any webhook target enables too
+    assert alog2.enabled
 
 
 def test_logger_once_and_webhook():
