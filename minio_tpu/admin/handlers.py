@@ -30,11 +30,12 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
     ``srv`` the S3Server (gives layer/iam/config).
     """
     if path == METRICS_PATH:
-        body = metrics.render(srv.layer,
-                              healer=getattr(srv, "healer", None),
-                              config=getattr(srv, "config", None),
-                              api_stats=getattr(srv, "api_stats", None)
-                              ).encode()
+        qm = {k: v[0] for k, v in query.items()}
+        if qm.get("scope") == "cluster":
+            # federated scrape: this node + every peer, one document
+            body = _metrics_cluster(srv, qm).encode()
+        else:
+            body = _render_local(srv).encode()
         h._send(200, body, content_type="text/plain; version=0.0.4")
         return True
     if not path.startswith(ADMIN_PREFIX + "/"):
@@ -364,26 +365,72 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
             return send_json(
                 srv.audit.tail(int(q1.get("n", "50")))) or True
         if route == "profile" and h.command == "POST":
+            # cluster-wide by default (StartProfilingHandler fans the
+            # start to every peer; ?local=true keeps it node-local)
             from ..obs import profiling
+            kinds_csv = q1.get("profilerType", "cpu")
             try:
-                kinds = profiling.start(q1.get("profilerType", "cpu"))
+                kinds = profiling.start(kinds_csv)
             except ValueError as e:
                 return send_json({"error": str(e)}, 400) or True
-            return send_json({"started": kinds}) or True
+            out = {"started": kinds}
+            if srv.peers is not None and q1.get("local") != "true":
+                out["peers"] = [
+                    {"endpoint": ep, "error": err} if err
+                    else {"endpoint": ep, "started": r}
+                    for ep, r, err in srv.peers.call_all(
+                        "profile_start", timeout_s=10.0,
+                        kinds=kinds_csv)]
+            return send_json(out) or True
         if route == "profile-download" and h.command == "GET":
+            # one zip for the whole cluster: every node's dumps renamed
+            # profile-cpu.<endpoint>.txt etc. (cmd/utils.go:286
+            # getProfileData per-node file naming)
             from ..obs import profiling
-            data = profiling.stop_zip()
-            h._send(200, data, content_type="application/zip",
+            dumps = profiling.stop_dumps()
+            if srv.peers is not None and q1.get("local") != "true":
+                # per-node names only when the zip holds >1 node's
+                # dumps; a standalone server keeps the plain names
+                dumps = {_node_dump_name(n, srv.node_name): d
+                         for n, d in dumps.items()}
+                for ep, r, err in srv.peers.call_all(
+                        "profile_stop", timeout_s=15.0,
+                        idempotent=False):
+                    if err or not isinstance(r, dict):
+                        dumps[_node_dump_name("profile-error.txt", ep)] \
+                            = (err or "malformed peer reply").encode()
+                        continue
+                    for n, d in r.items():
+                        dumps[_node_dump_name(n, ep)] = d
+            h._send(200, profiling.zip_dumps(dumps),
+                    content_type="application/zip",
                     headers={"Content-Disposition":
                              "attachment; filename=profile.zip"})
             return True
+        if route == "background-status" and h.command == "GET":
+            out = background_status(srv)
+            out["node"] = srv.node_name
+            if srv.peers is not None and q1.get("local") != "true":
+                out["peers"] = [
+                    {"node": ep, "error": err} if err else r
+                    for ep, r, err in srv.peers.call_all(
+                        "background_status", timeout_s=5.0)]
+            return send_json(out) or True
+        if route in ("speedtest", "speedtest-drive", "speedtest-tpu") \
+                and h.command == "POST":
+            return _speedtest(h, srv, route, q1)
         if route == "healthinfo" and h.command == "GET":
             from ..obs import healthinfo
             return send_json(healthinfo.collect(
                 _drive_paths(srv), perf=q1.get("perf") == "true")) or True
         if route == "netperf" and h.command == "POST":
             # madmin NetPerf analog (peerRESTMethodNetInfo): throughput
-            # to every peer over the real authed internode transport
+            # to every peer over the real authed internode transport.
+            # Probes run CONCURRENTLY — sequential probing made N peers
+            # cost N× wall time, and each probe's reply includes its
+            # own duration_ms so skew between peers is visible.
+            import threading as _threading
+
             from ..parallel.peer import measure_netperf
             try:
                 probe = int(q1.get("bytes", str(4 << 20)))
@@ -392,14 +439,28 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
                                  400) or True
             probe = max(1, min(probe, 8 << 20))   # cap the probe blob
             clients = getattr(getattr(srv, "peers", None), "clients", [])
-            out = []
-            for c in clients:
+            out = [None] * len(clients)
+
+            def _probe_one(i, c):
+                t0 = time.perf_counter()
                 try:
-                    out.append(measure_netperf(c, probe))
+                    out[i] = measure_netperf(c, probe)
                 except Exception as e:  # noqa: BLE001 — peer down
-                    out.append({"endpoint": c.endpoint,
-                                "error": str(e)})
-            return send_json({"peers": out}) or True
+                    out[i] = {"endpoint": c.endpoint, "error": str(e),
+                              "duration_ms": round(
+                                  (time.perf_counter() - t0) * 1e3, 2)}
+
+            threads = [_threading.Thread(target=_probe_one,
+                                         args=(i, c), daemon=True)
+                       for i, c in enumerate(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60.0)
+            return send_json({"peers": [
+                r if r is not None
+                else {"endpoint": c.endpoint, "error": "timeout"}
+                for r, c in zip(out, clients)]}) or True
     except (KeyError, json.JSONDecodeError) as e:
         return send_json({"error": f"bad request: {e}"}, 400) or True
     except (NoSuchUser, NoSuchPolicy) as e:
@@ -411,25 +472,262 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
 
 
 def _drive_paths(srv) -> list:
-    """Local drive roots across pools/sets (for healthinfo probes)."""
-    paths = []
-    layer = srv.layer
+    """Local drive roots across pools/sets (for healthinfo probes);
+    the traversal lives with the selftest probes that share it."""
+    from ..obs.selftest import local_drive_paths
+    return local_drive_paths(srv.layer)
 
-    def walk(node):
-        for pool in getattr(node, "pools", []) or []:
-            walk(pool)
-        for s in getattr(node, "sets", []) or []:
-            walk(s)
-        for d in getattr(node, "disks", []) or []:
-            root = getattr(d, "root", None)
-            if root:
-                paths.append(root)
-        root = getattr(node, "root", None)      # FS backend / bare drive
-        if root and not getattr(node, "disks", None):
-            paths.append(root)
 
-    walk(layer)
-    return paths
+def _render_local(srv, node=None) -> str:
+    """One node's scrape with every live subsystem attached — THE
+    render call (plain scrape, federated local leg, and the peer RPC
+    all go through here, so a newly scraped subsystem can never be
+    present in one document shape and missing from another)."""
+    return metrics.render(
+        srv.layer, healer=getattr(srv, "healer", None),
+        config=getattr(srv, "config", None),
+        api_stats=getattr(srv, "api_stats", None),
+        replication=getattr(srv, "replication", None),
+        crawler=getattr(srv, "crawler", None), node=node)
+
+
+_CLUSTER_SCRAPE_TTL_S = 2.0
+
+
+def _metrics_cluster(srv, q1) -> str:
+    """``metrics?scope=cluster``: scrape every peer in parallel
+    (bounded timeout), merge into one exposition document.  Every
+    sample carries a ``server`` label; a downed peer increments
+    ``mt_node_scrape_errors_total`` and is marked
+    ``mt_node_scrape_ok 0`` instead of failing (or silently thinning)
+    the scrape — Prometheus federation's honor-the-source-labels
+    contract.
+
+    The metrics listener is unauthenticated (Prometheus convention),
+    so the cluster fan-out is SINGLE-FLIGHT with a short cache: an
+    anonymous request loop costs the cluster at most one fan-out per
+    TTL instead of N RPC threads per request (amplification guard)."""
+    cache = getattr(srv, "_cluster_scrape_cache", None)
+    if cache is None:
+        import threading as _threading
+        cache = srv._cluster_scrape_cache = {
+            "mu": _threading.Lock(), "ts": 0.0, "text": ""}
+    with cache["mu"]:       # single-flight: concurrent scrapes queue
+        now = time.monotonic()
+        if cache["text"] and now - cache["ts"] < _CLUSTER_SCRAPE_TTL_S:
+            return cache["text"]
+        try:
+            # floor too: a near-zero caller timeout would fail every
+            # peer call on this unauthenticated route by construction
+            timeout_s = min(max(float(q1.get("timeout", 10) or 10),
+                                1.0), 15.0)
+        except ValueError:
+            timeout_s = 10.0
+        peers = getattr(srv, "peers", None)
+        peer_docs = []
+        status = []                   # (server, ok) for scrape marks
+        if peers is not None and peers.clients:
+            for ep, reply, err in peers.call_all("metrics_render",
+                                                 timeout_s=timeout_s):
+                doc, name = None, ep
+                if isinstance(reply, dict):
+                    doc, name = reply.get("doc"), reply.get("node", ep)
+                elif isinstance(reply, str):    # pre-PR peer shape
+                    doc = reply
+                if err or not isinstance(doc, str):
+                    # counted BEFORE the local render so the error
+                    # shows up in the scrape that observed the failure
+                    metrics.GLOBAL.inc("mt_node_scrape_errors_total",
+                                       {"peer": ep})
+                    status.append((name, 0))
+                else:
+                    peer_docs.append(doc)
+                    status.append((name, 1))
+        local = _render_local(srv, node=srv.node_name)
+        doc = metrics.merge_expositions([local] + peer_docs)
+        lines = ["# TYPE mt_node_scrape_ok gauge"]
+        for server, ok in [(srv.node_name, 1)] + status:
+            esc = metrics._escape_label(server)
+            lines.append(f'mt_node_scrape_ok{{server="{esc}"}} {ok}')
+        text = doc + "\n".join(lines) + "\n"
+        cache["ts"], cache["text"] = time.monotonic(), text
+        return text
+
+
+def background_status(srv) -> dict:
+    """Live progress of the autonomous planes (madmin BgHealState /
+    `mc admin scanner status` role): per-plane current bucket/object,
+    objects/s + bytes/s, and ETA from the last cycle's rates.  Shared
+    by the admin ``background-status`` route and the peer RPC."""
+    healer = getattr(srv, "healer", None)
+    crawler = getattr(srv, "crawler", None)
+    repl = getattr(srv, "replication", None)
+    mrf = getattr(srv, "mrf", None)
+    return {
+        "healing": {"progress": healer.progress.snapshot(),
+                    "stats": healer.stats.to_dict()}
+        if healer is not None else None,
+        "scanner": {"progress": crawler.progress.snapshot(),
+                    "cycles": crawler.cycles}
+        if crawler is not None else None,
+        "replication": {"progress": repl.progress.snapshot(),
+                        "stats": repl.stats.to_dict(),
+                        "bandwidth": repl.monitor.report()}
+        if repl is not None else None,
+        "mrf": {"progress": mrf.progress.snapshot(),
+                "stats": mrf.stats.to_dict()}
+        if mrf is not None else None,
+    }
+
+
+def _write_chunk(h, data: bytes) -> None:
+    """One HTTP/1.1 chunked-encoding frame (shared by every streaming
+    admin route: trace/log streams and the speedtests)."""
+    h.wfile.write(f"{len(data):x}\r\n".encode())
+    h.wfile.write(data + b"\r\n")
+    h.wfile.flush()
+
+
+def _end_chunks(h) -> None:
+    try:
+        h.wfile.write(b"0\r\n\r\n")
+    except (BrokenPipeError, ConnectionResetError):
+        pass
+
+
+def _node_dump_name(filename: str, node: str) -> str:
+    """``profile-cpu.txt`` + node -> ``profile-cpu.<node>.txt`` — the
+    reference's per-node profile naming inside the cluster zip."""
+    node = node.removeprefix("http://").removeprefix("https://") \
+        .replace("/", "_")
+    stem, dot, ext = filename.rpartition(".")
+    if not dot:
+        return f"{filename}.{node}"
+    return f"{stem}.{node}.{ext}"
+
+
+def _speedtest(h, srv, route, q1) -> bool:
+    """The three cluster speedtests (cmd/admin-handlers.go
+    SpeedtestHandler / DriveSpeedtestHandler): run the local probe,
+    fan the same probe to every peer in parallel, and STREAM one JSON
+    line per node as results land, closing with a BENCH_*.json-shaped
+    aggregate record ({metric, value, unit, detail}) so admin-API and
+    bench-harness numbers are directly comparable."""
+    import json as _json
+
+    from ..obs import selftest
+
+    def _num(key, default, lo, hi, cast=int):
+        try:
+            v = cast(q1.get(key, default))
+        except (TypeError, ValueError):
+            v = default
+        return max(lo, min(v, hi))
+
+    h.send_response(200)
+    h.send_header("Content-Type", "application/json")
+    h.send_header("Transfer-Encoding", "chunked")
+    h.end_headers()
+    results = []
+
+    def emit(doc):
+        results.append(doc)
+        try:
+            _write_chunk(h, _json.dumps(doc).encode() + b"\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass        # keep measuring; the caller went away
+
+    def fan(method: str, timeout_s: float, **kwargs):
+        if srv.peers is None or q1.get("local") == "true":
+            return
+        # non-idempotent: a replayed probe re-runs the whole measured
+        # load on the peer, mid-measurement
+        for ep, r, err in srv.peers.call_all_iter(
+                method, timeout_s=timeout_s, idempotent=False,
+                **kwargs):
+            emit({"node": ep, "error": err} if err or r is None else r)
+
+    def ok_results():
+        return [r for r in results if "error" not in r]
+
+    try:
+        if route == "speedtest":
+            size = _num("size", 1 << 20, 4096, 64 << 20)
+            duration = _num("duration", 1.0, 0.05, 30.0, cast=float)
+            concurrency = _num("concurrency", 0, 0, 64)
+            local = selftest.object_speedtest(
+                srv.layer, size=size, duration_s=duration,
+                concurrency=concurrency)
+            local["node"] = srv.node_name
+            emit(local)
+            # autotune runs up to 6 doubling rounds of 2 phases each
+            fan("speedtest_object", max(30.0, duration * 16),
+                size=size, duration_s=duration,
+                concurrency=concurrency)
+            ok = ok_results()
+            agg = selftest.aggregate(ok, ("putGiBps", "getGiBps"))
+            emit(selftest.bench_record(
+                "object_put_get_GiBps", agg["putGiBps"], {
+                    "putGiBps": agg["putGiBps"],
+                    "getGiBps": agg["getGiBps"],
+                    "objectSize": size,
+                    "durationSeconds": duration,
+                    "concurrency": max(
+                        (r.get("concurrency", 0) for r in ok),
+                        default=0),
+                    "autotuned": any(r.get("autotuned") for r in ok),
+                    "nodes": ok,
+                    "errors": [r for r in results if "error" in r],
+                }))
+        elif route == "speedtest-drive":
+            file_size = _num("size", 4 << 20, 1 << 16, 256 << 20)
+            local = {"node": srv.node_name,
+                     "drives": selftest.drive_speedtest(
+                         selftest.local_drive_paths(srv.layer),
+                         file_size=file_size)}
+            emit(local)
+            fan("speedtest_drive", 60.0, file_size=file_size)
+            drives = [d for r in ok_results()
+                      for d in r.get("drives", [])]
+            agg = selftest.aggregate(drives,
+                                     ("writeGiBps", "readGiBps"))
+            emit(selftest.bench_record(
+                "drive_seq_write_GiBps", agg["writeGiBps"], {
+                    "writeGiBps": agg["writeGiBps"],
+                    "readGiBps": agg["readGiBps"],
+                    "fileSize": file_size,
+                    "driveCount": len(drives),
+                    "nodes": ok_results(),
+                    "errors": [r for r in results if "error" in r],
+                }))
+        else:   # speedtest-tpu
+            size = _num("size", 4 << 20, 1 << 16, 256 << 20)
+            k = _num("k", 4, 1, 128)
+            m = _num("m", 2, 1, 128)
+            block_size = _num("blocksize", 1 << 20, 1 << 12, 16 << 20)
+            local = selftest.tpu_codec_speedtest(
+                size=size, k=k, m=m, block_size=block_size)
+            local["node"] = srv.node_name
+            emit(local)
+            fan("speedtest_tpu", 60.0, size=size, k=k, m=m,
+                block_size=block_size)
+            ok = ok_results()
+            agg = selftest.aggregate(ok, ("encodeGiBps", "decodeGiBps"))
+            emit(selftest.bench_record(
+                f"tpu_codec_encode_decode_GiBps_{k}+{m}",
+                min(agg["encodeGiBps"], agg["decodeGiBps"]), {
+                    "encode_GiBps": agg["encodeGiBps"],
+                    "decode_GiBps": agg["decodeGiBps"],
+                    "k": k, "m": m, "blockSize": block_size,
+                    "bytes": size,
+                    "nodes": ok,
+                    "errors": [r for r in results if "error" in r],
+                }))
+    except Exception as e:  # noqa: BLE001 — surface inside the stream;
+        # the 200 + chunked header is already committed
+        emit({"error": f"{type(e).__name__}: {e}"})
+    _end_chunks(h)
+    return True
 
 
 def _trace_type_filter(q1):
@@ -535,22 +833,13 @@ def _stream(h, hub, q1, flt=None) -> bool:
     h.send_header("Content-Type", "application/json")
     h.send_header("Transfer-Encoding", "chunked")
     h.end_headers()
-
-    def write_chunk(data: bytes):
-        h.wfile.write(f"{len(data):x}\r\n".encode())
-        h.wfile.write(data + b"\r\n")
-        h.wfile.flush()
-
     with hub.subscribe(flt) as sub:
         try:
             for item in sub.drain(max_items, timeout):
-                write_chunk(_json.dumps(item).encode() + b"\n")
+                _write_chunk(h, _json.dumps(item).encode() + b"\n")
         except (BrokenPipeError, ConnectionResetError):
             pass
-        try:
-            h.wfile.write(b"0\r\n\r\n")
-        except (BrokenPipeError, ConnectionResetError):
-            pass
+        _end_chunks(h)
     return True
 
 

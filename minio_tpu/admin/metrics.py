@@ -101,12 +101,19 @@ def _fmt_labels(labels: tuple, extra: str = "") -> str:
     return "{" + inner + "}" if inner else ""
 
 
-def render(layer=None, healer=None, config=None, api_stats=None) -> str:
+def render(layer=None, healer=None, config=None, api_stats=None,
+           replication=None, crawler=None, node=None) -> str:
     """Prometheus text format: counters + histograms + live gauges.
 
     ``config`` (a kvconfig Config) supplies the slow-drive knobs at
     scrape time — admin SetConfigKV retunes detection live; ``api_stats``
-    is the server's last-minute per-API OpWindows."""
+    is the server's last-minute per-API OpWindows; ``replication`` /
+    ``crawler`` export the background planes (ReplicationSys + Crawler).
+
+    ``node`` names this server for federation: every sample gains a
+    ``server`` label so one merged cluster document keeps per-node
+    series apart (the Prometheus federation convention — honor the
+    source's identity labels when aggregating)."""
     lines = [
         "# HELP mt_up Server is up.",
         "# TYPE mt_up gauge",
@@ -176,7 +183,90 @@ def render(layer=None, healer=None, config=None, api_stats=None) -> str:
             lines += _heal_counters(healer)
         except Exception:  # noqa: BLE001
             pass
-    return "\n".join(lines) + "\n"
+        try:
+            lines += _progress_gauges("mt_heal", healer.progress)
+        except Exception:  # noqa: BLE001
+            pass
+    if crawler is not None:
+        try:
+            lines += _scanner_gauges(crawler)
+        except Exception:  # noqa: BLE001
+            pass
+    if replication is not None:
+        try:
+            lines += _replication_gauges(replication)
+        except Exception:  # noqa: BLE001
+            pass
+    text = "\n".join(lines) + "\n"
+    if node:
+        text = _with_server_label(text, node)
+    return text
+
+
+def _with_server_label(text: str, node: str) -> str:
+    """Stamp ``server="<node>"`` onto every sample line of an already
+    rendered exposition document (comment lines untouched).  Values
+    never contain spaces, so the last space splits sample from value
+    even when a label value embeds one."""
+    esc = _escape_label(node)
+    out = []
+    for ln in text.splitlines():
+        if not ln or ln.startswith("#"):
+            out.append(ln)
+            continue
+        sp = ln.rfind(" ")
+        head, value = ln[:sp], ln[sp + 1:]
+        if head.endswith("}"):
+            head = f'{head[:-1]},server="{esc}"}}'
+        else:
+            head = f'{head}{{server="{esc}"}}'
+        out.append(f"{head} {value}")
+    return "\n".join(out) + "\n"
+
+
+def merge_expositions(docs: list) -> str:
+    """Merge per-node exposition documents into one cluster document:
+    exactly one ``# TYPE``/``# HELP`` per family, samples regrouped
+    under their family (the text format requires a family's samples to
+    be contiguous — a naive concatenation would interleave them)."""
+    order: list = []
+    meta: dict = {}         # family -> comment lines (one per kind)
+    samples: dict = {}      # family -> sample lines
+
+    def ensure(fam: str) -> None:
+        if fam not in meta:
+            meta[fam] = []
+            samples[fam] = []
+            order.append(fam)
+
+    for doc in docs:
+        current = None
+        for ln in doc.splitlines():
+            if not ln.strip():
+                continue
+            if ln.startswith(("# TYPE ", "# HELP ")):
+                parts = ln.split(None, 3)
+                fam, kind = parts[2], parts[1]
+                ensure(fam)
+                if not any(m.split(None, 3)[1] == kind
+                           for m in meta[fam]):
+                    meta[fam].append(ln)
+                current = fam
+                continue
+            if ln.startswith("#"):
+                continue
+            name = ln.split("{", 1)[0].split(" ", 1)[0]
+            # histogram-derived names (_bucket/_sum/_count) group with
+            # the declaring family; anything else starts its own
+            if current is None or not name.startswith(current):
+                current = name
+                ensure(current)
+            samples[current].append(ln)
+    out = []
+    for fam in order:
+        out.extend(meta[fam])
+        out.extend(samples[fam])
+    return "\n".join(out) + "\n"
 
 
 def _cluster_gauges(layer) -> list[str]:
@@ -261,6 +351,76 @@ def _heal_counters(healer) -> list[str]:
         "# TYPE mt_heal_cycles_total counter",
         f"mt_heal_cycles_total {st.cycles}",
     ]
+
+
+def _fmt_rate(v: float) -> str:
+    return f"{v:.3f}".rstrip("0").rstrip(".") or "0"
+
+
+def _progress_gauges(prefix: str, progress) -> list[str]:
+    """Rate gauges for one background plane's CycleProgress: live
+    objects/s + bytes/s (last completed cycle's when idle) and an
+    in-cycle flag — the `mc admin scanner status` rate columns."""
+    ops, bps = progress.rates()
+    return [
+        f"# TYPE {prefix}_objects_per_second gauge",
+        f"{prefix}_objects_per_second {_fmt_rate(ops)}",
+        f"# TYPE {prefix}_bytes_per_second gauge",
+        f"{prefix}_bytes_per_second {_fmt_rate(bps)}",
+        f"# TYPE {prefix}_cycle_active gauge",
+        f"{prefix}_cycle_active {1 if progress.active else 0}",
+    ]
+
+
+def _scanner_gauges(crawler) -> list[str]:
+    prog = crawler.progress
+    n_objects = prog.objects if prog.active \
+        else prog.last.get("objects", 0)
+    lines = [
+        "# TYPE mt_scanner_cycles_total counter",
+        f"mt_scanner_cycles_total {crawler.cycles}",
+        "# TYPE mt_scanner_cycle_objects gauge",
+        f"mt_scanner_cycle_objects {n_objects}",
+    ]
+    lines += _progress_gauges("mt_scanner", crawler.progress)
+    return lines
+
+
+def _replication_gauges(replication) -> list[str]:
+    """ReplStats + BandwidthMonitor, scrape-visible (the stats existed
+    since the replication PR but only the JSON admin routes saw them)."""
+    st = replication.stats
+    lines = [
+        "# TYPE mt_replication_queued_total counter",
+        f"mt_replication_queued_total {st.queued}",
+        "# TYPE mt_replication_objects_total counter",
+        f"mt_replication_objects_total {st.replicated}",
+        "# TYPE mt_replication_bytes_total counter",
+        f"mt_replication_bytes_total {st.replica_bytes}",
+        "# TYPE mt_replication_failed_total counter",
+        f"mt_replication_failed_total {st.failed}",
+        "# TYPE mt_replication_deletes_total counter",
+        f"mt_replication_deletes_total {st.deletes_replicated}",
+        "# TYPE mt_replication_pending gauge",
+        f"mt_replication_pending {replication._q.qsize()}",
+    ]
+    lines += _progress_gauges("mt_replication", replication.progress)
+    report = replication.monitor.report()
+    if report:
+        lines += [
+            "# TYPE mt_bucket_bandwidth_limit_bytes_per_second gauge",
+            "# TYPE mt_bucket_bandwidth_moved_bytes_total counter",
+        ]
+        for b in sorted(report):
+            r = report[b]
+            bl = _fmt_labels((("bucket", b),))
+            lines.append(
+                "mt_bucket_bandwidth_limit_bytes_per_second"
+                f"{bl} {r['limitInBytesPerSecond']}")
+            lines.append(
+                "mt_bucket_bandwidth_moved_bytes_total"
+                f"{bl} {r['totalBytesMoved']}")
+    return lines
 
 
 def _disk_lastminute_gauges(layer, config=None) -> list[str]:

@@ -21,7 +21,9 @@ from dataclasses import dataclass, field
 
 from ..bucket.lifecycle import Action, Lifecycle, ObjectOpts
 from ..objectlayer import interface as ol
+from ..obs import trace as _trace
 from ..storage.datatypes import now_ns
+from .progress import CycleProgress
 from .tracker import DataUpdateTracker
 
 USAGE_PATH = "datausage/usage.json"
@@ -98,16 +100,21 @@ class ScanResult:
 
 def scan_usage(layer, bucket_meta=None, apply_lifecycle: bool = True,
                transition_fn=None, tracker: DataUpdateTracker | None = None,
-               since_cycle: int | None = None) -> ScanResult:
+               since_cycle: int | None = None,
+               progress: CycleProgress | None = None) -> ScanResult:
     """One full scan cycle: usage accounting + ILM enforcement.
 
     With a tracker and since_cycle, buckets with no recorded change since
     that cycle reuse nothing but are skipped for ILM work (usage is still
     recomputed — listing is the source of truth, as in the reference's
-    shouldUpdate logic)."""
+    shouldUpdate logic).  ``progress`` (the crawler's CycleProgress) is
+    advanced per bucket for the background-status API; a ``scanner``
+    span per bucket goes to the trace hub when anyone listens."""
     res = ScanResult(DataUsageInfo(last_update_ns=now_ns()))
     info = res.usage
     for b in layer.list_buckets():
+        traced = _trace.active()
+        tb0 = time.monotonic_ns()
         bu = BucketUsage()
         info.bucket_usage[b.name] = bu
         lc = None
@@ -164,6 +171,19 @@ def scan_usage(layer, bucket_meta=None, apply_lifecycle: bool = True,
         info.buckets_count += 1
         info.objects_total_count += bu.objects_count
         info.objects_total_size += bu.size
+        if progress is not None:
+            progress.update(b.name, objects=bu.versions_count,
+                            nbytes=bu.size)
+        if traced:
+            dt = time.monotonic_ns() - tb0
+            _trace.publish_span(_trace.make_span(
+                "scanner", "scanner.bucket",
+                start_ns=_trace.now_ns() - dt, duration_ns=dt,
+                input_bytes=bu.size,
+                detail={"bucket": b.name,
+                        "objects": bu.objects_count,
+                        "versions": bu.versions_count,
+                        "ilmSkipped": skip_ilm}))
     return res
 
 
@@ -229,14 +249,24 @@ class Crawler:
         self.tracker = tracker or DataUpdateTracker()
         self.last: ScanResult | None = None
         self.cycles = 0
+        self.progress = CycleProgress("scanner")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     def run_cycle(self) -> ScanResult:
         since = self.tracker.cycle - 1 if self.cycles else None
-        res = scan_usage(self.layer, self.bucket_meta,
-                         transition_fn=self.transition_fn,
-                         tracker=self.tracker, since_cycle=since)
+        self.progress.begin()
+        try:
+            res = scan_usage(self.layer, self.bucket_meta,
+                             transition_fn=self.transition_fn,
+                             tracker=self.tracker, since_cycle=since,
+                             progress=self.progress)
+        except BaseException:
+            # a failed partial walk must not record itself as a
+            # completed cycle with lying rates
+            self.progress.abort()
+            raise
+        self.progress.end()
         persist_usage(self.layer, res.usage)
         self.tracker.advance()
         self.last = res

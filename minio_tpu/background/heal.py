@@ -16,6 +16,21 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ..obs import trace as _trace
+from .progress import CycleProgress
+
+
+def _heal_span(bucket: str, obj: str, t0_ns: int, healed: int,
+               source: str, error: str = "") -> None:
+    """One per-object ``healing`` span (TraceHealing analog) — callers
+    gate on trace.active() so the idle sweep builds nothing."""
+    dt = time.monotonic_ns() - t0_ns
+    _trace.publish_span(_trace.make_span(
+        "healing", f"healing.{source}", start_ns=_trace.now_ns() - dt,
+        duration_ns=dt, error=error,
+        detail={"bucket": bucket, "object": obj, "healedDisks": healed,
+                "source": source}))
+
 
 @dataclass
 class HealStats:
@@ -49,6 +64,7 @@ class MRFQueue:
     def __init__(self, layer, maxsize: int = 10_000):
         self.layer = layer
         self.stats = HealStats()
+        self.progress = CycleProgress("mrf")
         self._q: queue.Queue = queue.Queue(maxsize)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -68,14 +84,25 @@ class MRFQueue:
                     bucket, obj, vid = self._q.get(timeout=0.2)
                 except queue.Empty:
                     continue
+                traced = _trace.active()
+                t0 = time.monotonic_ns()
+                err, healed = "", 0
                 try:
-                    self.layer.heal_object(bucket, obj,
-                                           version_id=vid or None)
+                    r = self.layer.heal_object(bucket, obj,
+                                               version_id=vid or None)
+                    healed = getattr(r, "healed_disks", 0) or 0
                     self.stats.mrf_healed += 1
-                except Exception:  # noqa: BLE001 — sweep retries later
-                    pass
+                except Exception as e:  # noqa: BLE001 — sweep retries
+                    err = f"{type(e).__name__}: {e}"
                 finally:
                     self._q.task_done()
+                self.progress.update(bucket, obj)
+                if traced:
+                    _heal_span(bucket, obj, t0, healed, "mrf", err)
+        # the MRF queue is a continuous plane, not a cyclic one: one
+        # "cycle" spans the worker's lifetime, so rates read as
+        # objects-since-start over time-since-start
+        self.progress.begin()
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
 
@@ -107,32 +134,67 @@ class BackgroundHealer:
     def __post_init__(self):
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self.progress = CycleProgress("healing")
 
     def sweep(self) -> HealStats:
+        """One full-namespace pass.  ``stop()`` is honored between
+        buckets, between listing pages, and between objects: a stop
+        request during a large namespace walk bails within one
+        heal_object call instead of blocking for the whole sweep —
+        stats already counted for the partial cycle are kept, but the
+        cycle itself is not counted as completed."""
         deep = bool(self.deep_every) and \
             (self.stats.cycles + 1) % self.deep_every == 0
-        for b in self.layer.list_buckets():
-            if hasattr(self.layer, "heal_bucket"):
-                try:
-                    self.layer.heal_bucket(b.name)
-                except Exception:  # noqa: BLE001
-                    pass
-            marker = ""
-            while True:
-                out = self.layer.list_objects(b.name, marker=marker,
-                                              max_keys=1000)
-                for oi in out.objects:
-                    self.stats.objects_scanned += 1
+        self.progress.begin()
+        completed = False
+        try:
+            for b in self.layer.list_buckets():
+                if self._stop.is_set():
+                    return self.stats
+                if hasattr(self.layer, "heal_bucket"):
                     try:
-                        r = self.layer.heal_object(b.name, oi.name,
-                                                   deep=deep)
-                        if r is not None and getattr(r, "healed_disks", 0):
-                            self.stats.objects_healed += 1
+                        self.layer.heal_bucket(b.name)
                     except Exception:  # noqa: BLE001
-                        self.stats.objects_failed += 1
-                if not out.is_truncated:
-                    break
-                marker = out.next_marker
+                        pass
+                marker = ""
+                while True:
+                    if self._stop.is_set():
+                        return self.stats
+                    out = self.layer.list_objects(b.name, marker=marker,
+                                                  max_keys=1000)
+                    for oi in out.objects:
+                        if self._stop.is_set():
+                            return self.stats
+                        self.stats.objects_scanned += 1
+                        self.progress.update(b.name, oi.name,
+                                             nbytes=oi.size)
+                        traced = _trace.active()
+                        t0 = time.monotonic_ns()
+                        err, healed = "", 0
+                        try:
+                            r = self.layer.heal_object(b.name, oi.name,
+                                                       deep=deep)
+                            healed = getattr(r, "healed_disks", 0) or 0 \
+                                if r is not None else 0
+                            if healed:
+                                self.stats.objects_healed += 1
+                        except Exception as e:  # noqa: BLE001
+                            err = f"{type(e).__name__}: {e}"
+                            self.stats.objects_failed += 1
+                        if traced:
+                            _heal_span(b.name, oi.name, t0, healed,
+                                       "sweep", err)
+                    if not out.is_truncated:
+                        break
+                    marker = out.next_marker
+            completed = True
+        finally:
+            # a stopped/failed partial cycle must not leak an eternal
+            # active flag or record lying last-cycle rates
+            if completed:
+                self.progress.end()
+            else:
+                self.progress.abort()
         self.stats.cycles += 1
         self.stats.last_cycle_ns = time.time_ns()
         return self.stats

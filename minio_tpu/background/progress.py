@@ -1,0 +1,122 @@
+"""Per-cycle progress accounting for the autonomous planes (heal sweep,
+data crawler, replication) — the madmin BgHealState/DataUsageInfo
+"currentObject"/"objectsHealed" role, generalised.
+
+Each background loop owns one :class:`CycleProgress` and calls
+``begin()`` / ``update()`` / ``end()`` around its work.  ``snapshot()``
+is read by the admin ``background-status`` route and the
+``mt_scanner_*`` / ``mt_heal_*`` / ``mt_replication_*`` rate gauges at
+scrape time: current bucket/object, live objects/s and bytes/s for the
+running cycle, the last completed cycle's rates, and an ETA derived
+from the last cycle's totals (this cycle's remaining work at last
+cycle's pace — the only honest estimate before the walk finishes).
+
+Updates are plain attribute writes under one small lock; the background
+loops call update() once per object, so the cost is noise next to the
+heal/replicate work itself.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class CycleProgress:
+    """Progress of one background loop across cycles."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._mu = threading.Lock()
+        self.active = False
+        self.bucket = ""
+        self.object = ""
+        self.objects = 0
+        self.nbytes = 0
+        self.started_ns = 0
+        self.cycles = 0
+        # last COMPLETED cycle: totals + rates (ETA source)
+        self.last: dict = {}
+
+    def begin(self) -> None:
+        with self._mu:
+            self.active = True
+            self.bucket = ""
+            self.object = ""
+            self.objects = 0
+            self.nbytes = 0
+            self.started_ns = time.monotonic_ns()
+
+    def update(self, bucket: str, object_name: str = "",
+               nbytes: int = 0, objects: int = 1) -> None:
+        with self._mu:
+            self.bucket = bucket
+            self.object = object_name
+            self.objects += objects
+            self.nbytes += nbytes
+
+    def abort(self) -> None:
+        """A cycle stopped early (stop() mid-walk, a listing error):
+        clear the in-cycle state WITHOUT recording last-cycle rates or
+        counting the cycle — a partial walk's rates would lie, and a
+        leaked ``active`` flag would scrape as an eternal cycle."""
+        with self._mu:
+            self.active = False
+            self.bucket = ""
+            self.object = ""
+
+    def end(self) -> None:
+        with self._mu:
+            dur_ns = time.monotonic_ns() - self.started_ns
+            secs = max(dur_ns / 1e9, 1e-9)
+            self.last = {
+                "durationNs": dur_ns,
+                "objects": self.objects,
+                "bytes": self.nbytes,
+                "objectsPerSecond": round(self.objects / secs, 3),
+                "bytesPerSecond": round(self.nbytes / secs, 1),
+            }
+            self.cycles += 1
+            self.active = False
+            self.bucket = ""
+            self.object = ""
+
+    def rates(self) -> tuple[float, float]:
+        """(objects/s, bytes/s): live rates while a cycle runs, else
+        the last completed cycle's — what the scrape gauges export."""
+        with self._mu:
+            if self.active and self.started_ns:
+                secs = max(
+                    (time.monotonic_ns() - self.started_ns) / 1e9, 1e-9)
+                return (self.objects / secs, self.nbytes / secs)
+            if self.last:
+                return (self.last["objectsPerSecond"],
+                        self.last["bytesPerSecond"])
+            return (0.0, 0.0)
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            out = {
+                "name": self.name,
+                "active": self.active,
+                "cycles": self.cycles,
+                "currentBucket": self.bucket,
+                "currentObject": self.object,
+                "objects": self.objects,
+                "bytes": self.nbytes,
+                "lastCycle": dict(self.last),
+            }
+            if self.active and self.started_ns:
+                secs = max(
+                    (time.monotonic_ns() - self.started_ns) / 1e9, 1e-9)
+                out["elapsedSeconds"] = round(secs, 3)
+                out["objectsPerSecond"] = round(self.objects / secs, 3)
+                out["bytesPerSecond"] = round(self.nbytes / secs, 1)
+                # ETA at last cycle's pace: how much of last cycle's
+                # object count remains, over last cycle's rate
+                rate = self.last.get("objectsPerSecond", 0)
+                total = self.last.get("objects", 0)
+                if rate > 0 and total > self.objects:
+                    out["etaSeconds"] = round(
+                        (total - self.objects) / rate, 1)
+            return out

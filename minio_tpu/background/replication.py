@@ -21,6 +21,8 @@ import time
 from dataclasses import dataclass
 
 from ..bucket.replication import Config as ReplConfig
+from ..obs import trace as _trace
+from .progress import CycleProgress
 
 STATUS_KEY = "x-amz-replication-status"   # xhttp.AmzBucketReplicationStatus
 TARGETS_PATH = "replication/targets.json"
@@ -106,6 +108,7 @@ class ReplicationSys:
         self.bucket_meta = bucket_meta
         self.monitor = monitor or BandwidthMonitor()
         self.stats = ReplStats()
+        self.progress = CycleProgress("replication")
         self._targets: dict[str, ReplicationTarget] = {}   # bucket -> tgt
         self._q: queue.Queue = queue.Queue()
         self._stop = threading.Event()
@@ -181,17 +184,20 @@ class ReplicationSys:
     # -- worker -------------------------------------------------------------
 
     def _replicate_one(self, bucket: str, name: str, version_id: str,
-                       delete: bool) -> None:
+                       delete: bool) -> int:
+        """Returns the bytes moved for THIS task (progress/span
+        accounting must not diff the shared stats counter — concurrent
+        workers would see each other's increments)."""
         from ..s3.client import S3Client
         tgt = self._targets.get(bucket)
         if tgt is None:
-            return
+            return 0
         client = S3Client(tgt.endpoint, tgt.access_key, tgt.secret_key,
                           region=tgt.region)
         if delete:
             client.delete_object(tgt.target_bucket, name)
             self.stats.deletes_replicated += 1
-            return
+            return 0
         oi, data = self.layer.get_object(bucket, name)
         self.monitor.throttle(bucket, len(data))
         headers = {STATUS_KEY: "REPLICA"}
@@ -207,6 +213,7 @@ class ReplicationSys:
                                        {STATUS_KEY: "COMPLETED"})
         self.stats.replicated += 1
         self.stats.replica_bytes += len(data)
+        return len(data)
 
     def _worker(self) -> None:
         while not self._stop.is_set():
@@ -214,9 +221,14 @@ class ReplicationSys:
                 bucket, name, vid, delete = self._q.get(timeout=0.2)
             except queue.Empty:
                 continue
+            traced = _trace.active()
+            t0 = time.monotonic_ns()
+            err = ""
+            moved = 0
             try:
-                self._replicate_one(bucket, name, vid, delete)
-            except Exception:  # noqa: BLE001
+                moved = self._replicate_one(bucket, name, vid, delete)
+            except Exception as e:  # noqa: BLE001
+                err = f"{type(e).__name__}: {e}"
                 self.stats.failed += 1
                 if not delete:
                     try:
@@ -224,8 +236,23 @@ class ReplicationSys:
                             bucket, name, None, {STATUS_KEY: "FAILED"})
                     except Exception:  # noqa: BLE001
                         pass
+            self.progress.update(bucket, name, nbytes=moved)
+            if traced:
+                dt = time.monotonic_ns() - t0
+                _trace.publish_span(_trace.make_span(
+                    "replication",
+                    "replication.delete" if delete
+                    else "replication.object",
+                    start_ns=_trace.now_ns() - dt, duration_ns=dt,
+                    input_bytes=moved, error=err,
+                    detail={"bucket": bucket, "object": name,
+                            "delete": delete,
+                            "status": "FAILED" if err else "COMPLETED"}))
 
     def start(self) -> None:
+        # continuous plane: one "cycle" spans the worker pool's
+        # lifetime (rates = work-since-start over time-since-start)
+        self.progress.begin()
         for _ in range(self._nworkers):
             t = threading.Thread(target=self._worker, daemon=True)
             t.start()

@@ -5,9 +5,19 @@ The reference starts pprof CPU/heap/block/mutex/goroutine profilers on
 every node via peer RPC and later downloads a zip of the dumps.  The
 Python-host equivalents:
 
-* ``cpu``    -> cProfile (pstats dump)
+* ``cpu``    -> cProfile (pstats dump; calling thread only) PLUS a
+              sampling profiler over ``sys._current_frames()`` that
+              covers EVERY thread — S3 workers, RPC handlers, the
+              background planes — and emits a collapsed-stack
+              (flamegraph-ready) ``profile-cpu-sampled.txt``
 * ``mem``    -> tracemalloc snapshot (top allocations, text)
 * ``threads``-> live stack dump of all threads (goroutine-profile analog)
+
+cProfile hooks only the thread that enables it (the admin handler
+thread), so a pstats dump alone shows an idle server no matter how hot
+the worker pool runs; the wall-clock sampler is what sees the real
+process, at a fixed ~5 ms stride whose cost is bounded by thread count,
+not by request rate.
 
 A profile session is process-global, like the reference's globalProfiler
 map; starting a new session stops the previous one.
@@ -17,6 +27,7 @@ from __future__ import annotations
 
 import cProfile
 import io
+import os.path
 import pstats
 import sys
 import threading
@@ -26,11 +37,72 @@ from typing import Dict, Optional
 
 PROFILER_TYPES = ("cpu", "mem", "threads")
 
+SAMPLE_INTERVAL_S = 0.005
+
+
+class _Sampler:
+    """Wall-clock stack sampler over every live thread.
+
+    Walks ``sys._current_frames()`` at a fixed interval and accumulates
+    collapsed stacks (``frame;frame;frame count`` — the flamegraph.pl /
+    speedscope input format).  Sampling is statistical: a thread parked
+    in a C call (socket recv, device dispatch) is attributed to the
+    Python frame that issued it, which is exactly the "where is the
+    process spending wall time" answer cProfile cannot give for threads
+    it never hooked."""
+
+    def __init__(self, interval_s: float = SAMPLE_INTERVAL_S):
+        self.interval_s = interval_s
+        self.counts: Dict[str, int] = {}
+        self.samples = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop,
+                                        name="mt-profile-sampler",
+                                        daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        own = threading.get_ident()
+        names = {}      # thread ident -> name, refreshed per pass
+        while not self._stop.wait(self.interval_s):
+            names = {t.ident: t.name for t in threading.enumerate()}
+            for tid, frame in list(sys._current_frames().items()):
+                if tid == own:
+                    continue
+                stack = []
+                f = frame
+                while f is not None and len(stack) < 128:
+                    code = f.f_code
+                    stack.append(
+                        f"{os.path.basename(code.co_filename)}:"
+                        f"{code.co_name}")
+                    f = f.f_back
+                stack.append(names.get(tid, f"thread-{tid}"))
+                key = ";".join(reversed(stack))
+                self.counts[key] = self.counts.get(key, 0) + 1
+                self.samples += 1
+
+    def stop(self) -> bytes:
+        """Stop sampling, return the collapsed-stack dump."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        lines = [f"# {self.samples} samples @ {self.interval_s * 1e3:g}"
+                 f" ms interval (collapsed stacks; feed to flamegraph)"]
+        for stack in sorted(self.counts,
+                            key=self.counts.get, reverse=True):
+            lines.append(f"{stack} {self.counts[stack]}")
+        return ("\n".join(lines) + "\n").encode()
+
 
 class _Session:
     def __init__(self, kinds):
         self.kinds = kinds
         self.cpu: Optional[cProfile.Profile] = None
+        self.sampler: Optional[_Sampler] = None
         self.mem_started = False
 
 
@@ -52,6 +124,8 @@ def start(kinds_csv: str = "cpu") -> list:
         if "cpu" in kinds:
             sess.cpu = cProfile.Profile()
             sess.cpu.enable()
+            sess.sampler = _Sampler()
+            sess.sampler.start()
         if "mem" in kinds:
             import tracemalloc
             if not tracemalloc.is_tracing():
@@ -91,6 +165,9 @@ def _stop_locked() -> Dict[str, bytes]:
         import marshal
         marshal.dump(sess.cpu.stats, raw)
         dumps["profile-cpu.pstats"] = raw.getvalue()
+    if sess.sampler is not None:
+        # all-thread coverage: S3 workers / RPC / background planes
+        dumps["profile-cpu-sampled.txt"] = sess.sampler.stop()
     if sess.mem_started:
         import tracemalloc
         snap = tracemalloc.take_snapshot()
@@ -102,16 +179,27 @@ def _stop_locked() -> Dict[str, bytes]:
     return dumps
 
 
-def stop_zip() -> bytes:
-    """Stop the session, return a zip of all dumps (cmd/utils.go:318
-    builds the same shape: one file per node per profiler type)."""
+def stop_dumps() -> Dict[str, bytes]:
+    """Stop the session, return {filename: dump} — the peer-RPC shape:
+    the aggregating node renames each file ``<base>.<node>.<ext>`` and
+    zips the whole cluster's dumps together (cmd/utils.go:286
+    getProfileData)."""
     with _mu:
-        dumps = _stop_locked()
+        return _stop_locked()
+
+
+def zip_dumps(dumps: Dict[str, bytes]) -> bytes:
     buf = io.BytesIO()
     with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
         for name, data in dumps.items():
             z.writestr(name, data)
     return buf.getvalue()
+
+
+def stop_zip() -> bytes:
+    """Stop the session, return a zip of all dumps (cmd/utils.go:318
+    builds the same shape: one file per node per profiler type)."""
+    return zip_dumps(stop_dumps())
 
 
 def running() -> list:

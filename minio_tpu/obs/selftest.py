@@ -1,0 +1,258 @@
+"""Cluster self-measurement probes (cmd/admin-handlers.go
+SpeedtestHandler / DriveSpeedtestHandler, cmd/speedtest.go autotune
+loop).
+
+Three probes, each runnable on one node and fanned to every peer by the
+admin ``speedtest*`` routes so one call measures the whole cluster:
+
+* :func:`drive_speedtest`   — per-drive sequential write/read against
+  every local drive root (madmin DriveSpeedtest role; buffered I/O, so
+  read numbers on a warm cache read as memory bandwidth — the WRITE leg
+  is the honest drive figure, same caveat the reference documents for
+  filesystems without O_DIRECT).
+* :func:`object_speedtest`  — end-to-end PUT/GET through the object
+  layer with concurrency autotune: ramp workers geometrically while
+  throughput still improves, keep the best round (the reference's
+  speedTestOnce doubling loop).
+* :func:`tpu_codec_speedtest` — erasure-codec encode/reconstruct rates
+  via ops/codec.py's normal dispatch paths (Erasure.speedtest), so the
+  BENCH trajectory numbers become an admin API instead of a hand-run
+  script.
+
+:func:`bench_record` folds per-node results into the same
+``{metric, value, unit, detail}`` shape as the repo's ``BENCH_*.json``
+records, so bench.py output and the admin API report comparable
+numbers.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+GiB = 1 << 30
+
+# autotune knobs (cmd/speedtest.go: double while the uplift clears the
+# noise floor, stop at the first non-improving round)
+AUTOTUNE_MAX_CONCURRENCY = 32
+AUTOTUNE_MIN_UPLIFT = 0.025
+
+
+def local_drive_paths(layer) -> list:
+    """Local drive roots across every topology shape (pools/sets/flat);
+    remote drives have no ``root`` and are measured by their owning
+    node — shared by healthinfo and the drive speedtest."""
+    paths = []
+
+    def walk(node):
+        for pool in getattr(node, "pools", []) or []:
+            walk(pool)
+        for s in getattr(node, "sets", []) or []:
+            walk(s)
+        for d in getattr(node, "disks", []) or []:
+            root = getattr(d, "root", None)
+            if root:
+                paths.append(root)
+        root = getattr(node, "root", None)      # FS backend / bare drive
+        if root and not getattr(node, "disks", None):
+            paths.append(root)
+
+    walk(layer)
+    return paths
+
+
+def drive_speedtest(paths: list, file_size: int = 4 << 20,
+                    block_size: int = 1 << 20) -> list[dict]:
+    """Sequential write+read probe per drive root.  The probe file
+    lives under the drive's system dir and is always removed; write is
+    fsync'd once at the end so the figure includes the flush the data
+    plane pays on commit."""
+    from ..storage.xl_storage import SYS_DIR
+    block = os.urandom(min(block_size, file_size))
+    out = []
+    for root in paths:
+        probe_dir = os.path.join(root, SYS_DIR, "speedtest")
+        probe = os.path.join(probe_dir, f"probe-{os.getpid()}")
+        entry = {"drive": root, "bytes": file_size}
+        try:
+            os.makedirs(probe_dir, exist_ok=True)
+            t0 = time.monotonic()
+            written = 0
+            with open(probe, "wb") as f:
+                while written < file_size:
+                    written += f.write(block[:file_size - written])
+                f.flush()
+                os.fsync(f.fileno())
+            entry["writeGiBps"] = round(
+                written / max(time.monotonic() - t0, 1e-9) / GiB, 3)
+            t0 = time.monotonic()
+            got = 0
+            with open(probe, "rb") as f:
+                while True:
+                    c = f.read(block_size)
+                    if not c:
+                        break
+                    got += len(c)
+            entry["readGiBps"] = round(
+                got / max(time.monotonic() - t0, 1e-9) / GiB, 3)
+        except OSError as e:
+            entry["error"] = str(e)
+        finally:
+            try:
+                os.unlink(probe)
+            except OSError:
+                pass
+        out.append(entry)
+    return out
+
+
+def _put_get_round(layer, bucket: str, size: int, duration_s: float,
+                   concurrency: int) -> dict:
+    """One timed round at fixed concurrency: all workers PUT distinct
+    objects until the deadline, then GET the written set round-robin
+    until the deadline."""
+    payload = os.urandom(size)
+    written: list[list[str]] = [[] for _ in range(concurrency)]
+    errors = [0]
+
+    def put_worker(wi: int):
+        i = 0
+        deadline = time.monotonic() + duration_s
+        while time.monotonic() < deadline:
+            name = f"st-{wi}-{i}"
+            try:
+                layer.put_object(bucket, name, payload)
+                written[wi].append(name)
+            except Exception:  # noqa: BLE001 — counted, probe goes on
+                errors[0] += 1
+            i += 1
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=put_worker, args=(wi,),
+                                daemon=True)
+               for wi in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    put_s = max(time.monotonic() - t0, 1e-9)
+    put_ops = sum(len(w) for w in written)
+
+    names = [n for w in written for n in w]
+    got = [0] * concurrency
+
+    def get_worker(wi: int):
+        i = wi
+        deadline = time.monotonic() + duration_s
+        while names and time.monotonic() < deadline:
+            try:
+                layer.get_object(bucket, names[i % len(names)])
+                got[wi] += 1
+            except Exception:  # noqa: BLE001
+                errors[0] += 1
+            i += concurrency
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=get_worker, args=(wi,),
+                                daemon=True)
+               for wi in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    get_s = max(time.monotonic() - t0, 1e-9)
+    get_ops = sum(got)
+    return {
+        "concurrency": concurrency,
+        "putGiBps": round(put_ops * size / put_s / GiB, 6),
+        "getGiBps": round(get_ops * size / get_s / GiB, 6),
+        "putOps": put_ops,
+        "getOps": get_ops,
+        "errors": errors[0],
+        "objectSize": size,
+        "durationSeconds": duration_s,
+    }
+
+
+def object_speedtest(layer, size: int = 1 << 20,
+                     duration_s: float = 1.0,
+                     concurrency: int = 0) -> dict:
+    """End-to-end object PUT/GET speedtest against ``layer``.
+
+    ``concurrency`` 0 means autotune: run rounds at 1, 2, 4, ...
+    workers while PUT throughput still improves by at least
+    ``AUTOTUNE_MIN_UPLIFT`` and report the best round — the plateau
+    finder from the reference's speedtest loop.  A fixed concurrency
+    runs exactly one round.  The probe bucket and every object are
+    deleted before returning."""
+    bucket = f"mt-speedtest-{os.urandom(4).hex()}"
+    layer.make_bucket(bucket)
+    try:
+        if concurrency > 0:
+            best = _put_get_round(layer, bucket, size, duration_s,
+                                  concurrency)
+            best["autotuned"] = False
+            return best
+        best = None
+        c = 1
+        while c <= AUTOTUNE_MAX_CONCURRENCY:
+            r = _put_get_round(layer, bucket, size, duration_s, c)
+            if best is not None:
+                uplift = (r["putGiBps"] - best["putGiBps"]) \
+                    / max(best["putGiBps"], 1e-9)
+                if r["putGiBps"] > best["putGiBps"]:
+                    best = r
+                if uplift < AUTOTUNE_MIN_UPLIFT:
+                    break       # plateau: more workers stopped helping
+            else:
+                best = r
+            c *= 2
+        best["autotuned"] = True
+        return best
+    finally:
+        _cleanup_bucket(layer, bucket)
+
+
+def _cleanup_bucket(layer, bucket: str) -> None:
+    try:
+        out = layer.list_objects(bucket, max_keys=100000)
+        for oi in out.objects:
+            try:
+                layer.delete_object(bucket, oi.name)
+            except Exception:  # noqa: BLE001
+                pass
+        layer.delete_bucket(bucket, force=True)
+    except Exception:  # noqa: BLE001 — a leftover probe bucket must
+        pass           # never fail the measurement it served
+
+
+def tpu_codec_speedtest(size: int = 4 << 20, k: int = 4, m: int = 2,
+                        block_size: int = 1 << 20,
+                        backend: str = "auto") -> dict:
+    """Erasure-codec throughput via the production dispatch paths."""
+    from ..ops.codec import Erasure
+    codec = Erasure(k, m, block_size, backend=backend)
+    return codec.speedtest(size=size)
+
+
+def aggregate(results: list[dict], keys: tuple[str, ...]) -> dict:
+    """Sum the per-node GiB/s figures (each node drove its own load, so
+    cluster throughput is the sum — same shape as the reference's
+    aggregated speedTestResult)."""
+    out = {}
+    for key in keys:
+        out[key] = round(sum(r.get(key) or 0 for r in results
+                             if isinstance(r, dict)), 6)
+    return out
+
+
+def bench_record(metric: str, value: float, detail: dict) -> dict:
+    """The repo's BENCH_*.json record shape (bench.py result dict) so
+    admin-API numbers and bench-harness numbers diff cleanly."""
+    return {
+        "metric": metric,
+        "value": round(value, 6),
+        "unit": "GiB/s",
+        "detail": detail,
+    }

@@ -14,6 +14,10 @@ pkg/trace.Type):
   ``internode``  RPC client/server spans (parallel/rpc.py)
   ``tpu``        erasure-kernel spans: encode/decode/matmul/fused-hash
                  with shard geometry and bytes (ops/codec.py + friends)
+  ``scanner``    data-crawler per-bucket spans (background/crawler.py)
+  ``healing``    heal-sweep / MRF per-object spans (background/heal.py)
+  ``replication``  per-object replication spans
+                 (background/replication.py)
 
 Every span carries the originating request ID (Dapper-style correlation,
 Sigelman et al. 2010): the S3 frontend mints one per request into a
@@ -39,8 +43,12 @@ from ..utils.pubsub import PubSub
 HTTP_TRACE = PubSub(max_queue=4000)
 
 # subsystem trace types (pkg/trace.Type); "http" stays the default so
-# existing `admin trace` consumers see no change without ?type=
-TRACE_TYPES = ("http", "storage", "internode", "tpu")
+# existing `admin trace` consumers see no change without ?type=.
+# scanner/healing/replication are the background planes (pkg/trace
+# TraceScanner/TraceHealing/TraceReplication) — per-object spans from
+# the autonomous loops, same zero-subscriber idle contract as the rest.
+TRACE_TYPES = ("http", "storage", "internode", "tpu",
+               "scanner", "healing", "replication")
 
 # headers never to leak into traces (cmd/http-tracer.go redacts these;
 # the reference strips ALL SSE-C key material — including the key MD5 —

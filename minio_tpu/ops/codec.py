@@ -279,6 +279,51 @@ class Erasure:
         return [np.concatenate(chunks) if len(chunks) != 1 else chunks[0]
                 for chunks in outs]
 
+    def speedtest(self, size: int = 8 << 20, iters: int = 3) -> dict:
+        """Timed probe of this codec's hot paths (the admin
+        ``speedtest-tpu`` leg): whole-object batched encode and
+        worst-case reconstruction (all m parity shards consumed to
+        rebuild m lost data shards), after one untimed warmup so
+        device backends measure steady-state, not compile time.
+
+        Dispatches ride the normal encode/decode paths, so the probe
+        itself lands in mt_tpu_* metrics and ``tpu`` spans like any
+        production traffic."""
+        import os as _os
+        iters = max(1, int(iters))
+        size = max(1, int(size))
+        data = np.frombuffer(_os.urandom(size), dtype=np.uint8)
+        self.encode_object(data)                      # warmup/compile
+        t0 = time.monotonic()
+        for _ in range(iters):
+            shards = self.encode_object(data)
+        encode_s = max(time.monotonic() - t0, 1e-9)
+        # per-block decode with the first m data shards lost
+        block = data[:min(self.block_size, size)]
+        block_shards = self.encode_data(block)
+        lost = list(block_shards)
+        for i in range(min(self.parity_blocks, self.data_blocks)):
+            lost[i] = None
+        nblocks = max(1, size // max(len(block), 1))
+        self.decode_data_blocks(list(lost))           # warmup
+        t0 = time.monotonic()
+        for _ in range(iters * nblocks):
+            self.decode_data_blocks(list(lost))
+        decode_s = max(time.monotonic() - t0, 1e-9)
+        del shards
+        gib = 1 << 30
+        return {
+            "encodeGiBps": round(size * iters / encode_s / gib, 3),
+            "decodeGiBps": round(
+                len(block) * iters * nblocks / decode_s / gib, 3),
+            "bytes": size,
+            "iters": iters,
+            "k": self.data_blocks,
+            "m": self.parity_blocks,
+            "blockSize": self.block_size,
+            "backend": self.backend,
+        }
+
     def encode_object_framed(self, data, digest: int = 32) -> np.ndarray:
         """Encode a whole object straight into bitrot-framed shard files.
 

@@ -13,6 +13,7 @@ peerRESTMethodLog :56).
 from __future__ import annotations
 
 import threading
+import time
 
 from .rpc import RPCClient, RPCServer
 
@@ -88,6 +89,58 @@ def register_peer_service(rpc: RPCServer, srv) -> None:
     def netperf_download(n: int = 0) -> bytes:
         return b"\xa5" * min(int(n), 8 << 20)
 
+    # -- cluster self-measurement (peerRESTMethodSpeedtest /
+    # peerRESTMethodDriveSpeedtest / peerRESTMethodMetrics /
+    # peerRESTMethodStartProfiling + cmd/utils.go getProfileData) -----
+
+    def metrics_render() -> dict:
+        """This node's full exposition document, server-labelled, plus
+        the node name so the aggregator's health marks
+        (mt_node_scrape_ok) join against the document's ``server``
+        label instead of the RPC endpoint."""
+        from ..admin.handlers import _render_local
+        return {"node": srv.node_name,
+                "doc": _render_local(srv, node=srv.node_name)}
+
+    def profile_start(kinds: str = "cpu"):
+        from ..obs import profiling
+        return profiling.start(kinds)
+
+    def profile_stop():
+        """{filename: dump bytes} — the aggregator renames per node."""
+        from ..obs import profiling
+        return profiling.stop_dumps()
+
+    def speedtest_object(size: int = 1 << 20, duration_s: float = 1.0,
+                         concurrency: int = 0):
+        from ..obs import selftest
+        out = selftest.object_speedtest(srv.layer, size=size,
+                                        duration_s=duration_s,
+                                        concurrency=concurrency)
+        out["node"] = srv.node_name
+        return out
+
+    def speedtest_drive(file_size: int = 4 << 20):
+        from ..obs import selftest
+        return {"node": srv.node_name,
+                "drives": selftest.drive_speedtest(
+                    selftest.local_drive_paths(srv.layer),
+                    file_size=file_size)}
+
+    def speedtest_tpu(size: int = 4 << 20, k: int = 4, m: int = 2,
+                      block_size: int = 1 << 20):
+        from ..obs import selftest
+        out = selftest.tpu_codec_speedtest(size=size, k=k, m=m,
+                                           block_size=block_size)
+        out["node"] = srv.node_name
+        return out
+
+    def background_status():
+        from ..admin.handlers import background_status as _bg
+        out = _bg(srv)
+        out["node"] = srv.node_name
+        return out
+
     rpc.register("peer", {
         "reload_bucket_meta": reload_bucket_meta,
         "reload_iam": reload_iam,
@@ -96,6 +149,13 @@ def register_peer_service(rpc: RPCServer, srv) -> None:
         "mark_change": mark_change,
         "netperf_upload": netperf_upload,
         "netperf_download": netperf_download,
+        "metrics_render": metrics_render,
+        "profile_start": profile_start,
+        "profile_stop": profile_stop,
+        "speedtest_object": speedtest_object,
+        "speedtest_drive": speedtest_drive,
+        "speedtest_tpu": speedtest_tpu,
+        "background_status": background_status,
     })
 
 
@@ -119,6 +179,7 @@ def measure_netperf(client: RPCClient,
         "rx_MBps": round(len(got) / down_s / 1e6, 1)
         if down_s > 0 else None,
         "probe_bytes": probe_bytes,
+        "duration_ms": round((up_s + down_s) * 1e3, 2),
     }
 
 
@@ -231,3 +292,54 @@ class PeerNotifier:
             except Exception:  # noqa: BLE001
                 pass
         return out
+
+    # -- parallel control-plane fan-out (self-measurement) -----------------
+
+    def call_all_iter(self, method: str, timeout_s: float = 30.0,
+                      idempotent: bool = True, **kwargs):
+        """Call ``peer.<method>`` on every peer CONCURRENTLY, yielding
+        ``(endpoint, result, error)`` as replies land (streaming
+        speedtest lines).  One slow peer cannot serialize the others,
+        and a peer that misses the deadline yields a ``timeout`` error
+        instead of stalling the aggregate — its thread is left to die
+        with the daemon flag (the RPC deadline bounds it).
+
+        ``idempotent=False`` for one-shot methods (profile_stop: a
+        replay after a half-dead keep-alive finds the session already
+        stopped and would silently drop that node's dumps; peer
+        speedtests: a replay re-runs the whole measured load)."""
+        import queue as _q
+        done: _q.Queue = _q.Queue()
+
+        def one(c: RPCClient):
+            try:
+                done.put((c.endpoint,
+                          c.call("peer", method,
+                                 _idempotent=idempotent,
+                                 _timeout=timeout_s, **kwargs), ""))
+            except Exception as e:  # noqa: BLE001 — peer down/slow
+                done.put((c.endpoint, None,
+                          f"{type(e).__name__}: {e}"))
+
+        for c in self.clients:
+            threading.Thread(target=one, args=(c,), daemon=True).start()
+        deadline = time.monotonic() + timeout_s
+        pending = {c.endpoint for c in self.clients}
+        while pending:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                for ep in sorted(pending):
+                    yield ep, None, "timeout"
+                return
+            try:
+                ep, result, err = done.get(timeout=remaining)
+            except _q.Empty:
+                continue
+            pending.discard(ep)
+            yield ep, result, err
+
+    def call_all(self, method: str, timeout_s: float = 30.0,
+                 idempotent: bool = True, **kwargs) -> list:
+        """Blocking form of :meth:`call_all_iter`."""
+        return list(self.call_all_iter(method, timeout_s=timeout_s,
+                                       idempotent=idempotent, **kwargs))

@@ -512,19 +512,24 @@ class RPCClient:
         reads as online so the next use doubles as the probe."""
         return self.breaker.ready()
 
-    def _attempt(self, path: str, body: bytes, headers: dict, dyn
-                 ) -> tuple[int, bytes]:
+    def _attempt(self, path: str, body: bytes, headers: dict, dyn,
+                 timeout: float | None = None) -> tuple[int, bytes]:
         """One request/response on one connection.  Raises _StaleConn
         when a pooled keep-alive connection turned out dead in a phase
         where a free replay is sound; any other transport failure is a
         real peer failure (closes the connection, feeds the dynamic
         deadline on timeouts)."""
-        conn, pooled = self._get_conn(dyn.timeout())
+        conn, pooled = self._get_conn(
+            dyn.timeout() if timeout is None else timeout)
         try:
             conn.request("POST", path, body=body, headers=headers)
         except socket.timeout as e:
             conn.close()
-            dyn.log_failure()
+            if timeout is None:
+                # overridden deadlines (observability fan-outs) carry
+                # no signal about the service's normal latencies —
+                # they must not swing the shared adaptive deadline
+                dyn.log_failure()
             raise RPCError("ConnectionError", str(e)) from e
         except (OSError, http.client.HTTPException) as e:
             conn.close()
@@ -537,9 +542,12 @@ class RPCClient:
             payload = resp.read()
         except socket.timeout as e:
             # only an actual deadline expiry carries a latency signal;
-            # instant errors must not inflate deadlines
+            # instant errors must not inflate deadlines — and expiry
+            # of a caller-OVERRIDDEN deadline says nothing about the
+            # service's normal latency either
             conn.close()
-            dyn.log_failure()
+            if timeout is None:
+                dyn.log_failure()
             raise RPCError("ConnectionError", str(e)) from e
         except (OSError, http.client.HTTPException) as e:
             conn.close()
@@ -556,7 +564,8 @@ class RPCClient:
     def _roundtrip(self, path: str, body: bytes, service: str,
                    extra_headers: dict | None = None,
                    raw_response: bool = False,
-                   idempotent: bool = False):
+                   idempotent: bool = False,
+                   timeout: float | None = None):
         """Pooled request/response under the breaker + retry policy.
 
         Failure handling, in order: calls against an OPEN breaker fail
@@ -590,6 +599,15 @@ class RPCClient:
             backoff (that would drain the anti-storm budget exactly
             when every call is failing), and allow() runs before the
             sleep so a half-open probe reservation is held across it."""
+            if timeout is not None:
+                # caller-bounded observability call: one attempt, no
+                # breaker/retry feedback — an anonymous cluster scrape
+                # with a tiny deadline must not open (or half-open
+                # re-fail) the control-plane breaker real traffic
+                # shares, nor spend the shared retry budget
+                _mtr.inc("mt_node_rpc_errors_total",
+                         {"service": service})
+                return False
             self.breaker.record_failure()
             _mtr.inc("mt_node_rpc_errors_total", {"service": service})
             if not self.breaker.ready():
@@ -604,7 +622,8 @@ class RPCClient:
 
         while True:
             try:
-                status, payload = self._attempt(path, body, headers, dyn)
+                status, payload = self._attempt(path, body, headers,
+                                                dyn, timeout)
             except _StaleConn as e:
                 # bounded by pool depth: every replay pops one stale
                 # pooled connection; a fresh connection never raises this
@@ -642,7 +661,11 @@ class RPCClient:
         # typed application error below
         self.breaker.record_success()
         self.retry.on_success()
-        dyn.log_success(time.monotonic() - start)
+        if timeout is None:
+            # a long-running overridden call (peer speedtest, bounded
+            # scrape) must not inflate the adaptive deadline every
+            # NORMAL call on this service then inherits
+            dyn.log_success(time.monotonic() - start)
         # inter-node family (cmd/metrics-v2.go getInterNodeMetrics):
         # traffic and call counts per RPC service
         _mtr.inc("mt_node_rpc_calls_total", {"service": service})
@@ -656,14 +679,20 @@ class RPCClient:
         return doc.get("result")
 
     def call(self, service: str, method: str, _idempotent: bool = False,
-             **kwargs):
+             _timeout: float | None = None, **kwargs):
+        """``_timeout`` overrides the dynamic per-attempt deadline for
+        this call only — observability fan-outs (cluster metrics
+        scrape, speedtest) bound their own wait instead of inheriting
+        the storage plane's adaptive deadlines."""
         path = f"/rpc/{service}/{method}"
         body = msgpack.packb(kwargs, use_bin_type=True)
         if path in UNTRACED_PATHS or not _trace.active():
             return self._roundtrip(path, body, service,
-                                   idempotent=_idempotent)
+                                   idempotent=_idempotent,
+                                   timeout=_timeout)
         return self._traced_roundtrip(
-            path, body, service, dict(idempotent=_idempotent))
+            path, body, service,
+            dict(idempotent=_idempotent, timeout=_timeout))
 
     def raw_call(self, name: str, params: dict, body: bytes = b"",
                  idempotent: bool = False) -> bytes:

@@ -291,6 +291,7 @@ class S3Server:
         self.replication = None  # ReplicationSys (minio_tpu/background)
         self.usage = None        # data-usage cache (crawler)
         self.healer = None       # BackgroundHealer sweep
+        self.crawler = None      # Crawler (scanner plane)
         self.mrf = None          # MRFQueue
         self.tracker = None      # DataUpdateTracker (crawler bloom filter)
         from ..crypto.kms import kms_from_env

@@ -1,0 +1,201 @@
+"""Cluster-wide self-measurement over real peer RPC (2 in-process
+nodes): federated metrics scrape (one scrape, whole cluster; downed
+peers marked, never dropped silently), cluster speedtest fan-out with
+the BENCH-comparable aggregate, and cluster profiling.
+
+Reference tier: cmd/admin-handlers.go SpeedtestHandler +
+peerRESTMethodMetrics-style federation + cmd/utils.go:286
+getProfileData.
+"""
+
+import io
+import json
+import re
+import zipfile
+
+import pytest
+
+from minio_tpu.background.tracker import DataUpdateTracker
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.parallel.peer import PeerNotifier, register_peer_service
+from minio_tpu.parallel.rpc import RPCClient, RPCServer
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl_storage import XLStorage
+
+from tests.test_metrics_exposition import (check_histograms,
+                                           parse_exposition)
+
+
+@pytest.fixture
+def duo(tmp_path):
+    """Two S3 nodes over shared drives; A's peer notifier dials B's
+    peer RPC service (the test_metacache cross-node pattern)."""
+    for i in range(4):
+        (tmp_path / f"d{i}").mkdir()
+
+    def mk_node():
+        disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+        layer = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                               backend="numpy")
+        return S3Server(layer, access_key="ck", secret_key="cs")
+
+    node_a, node_b = mk_node(), mk_node()
+    node_a.start()
+    node_b.start()
+    node_b.attach_tracker(DataUpdateTracker())
+    rpc_b = RPCServer("obs-peer-secret")
+    register_peer_service(rpc_b, node_b)
+    rpc_b.start()
+    node_a.attach_peers(PeerNotifier(
+        [RPCClient(rpc_b.endpoint, "obs-peer-secret")]))
+    yield node_a, node_b, rpc_b
+    node_a.stop()
+    node_b.stop()
+    try:
+        rpc_b.stop()
+    except Exception:  # noqa: BLE001 — a test may have stopped it
+        pass
+
+
+def _scrape(srv, query="") -> str:
+    import http.client
+    host, port = srv.endpoint.replace("http://", "").split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=30)
+    conn.request("GET", "/minio-tpu/metrics"
+                 + (f"?{query}" if query else ""))
+    resp = conn.getresponse()
+    body = resp.read().decode()
+    conn.close()
+    assert resp.status == 200
+    return body
+
+
+def test_cluster_scrape_is_strict_and_server_labelled(duo):
+    node_a, node_b, _ = duo
+    c = S3Client(node_a.endpoint, "ck", "cs")
+    c.make_bucket("fedbkt")
+    c.put_object("fedbkt", "obj", b"f" * (1 << 18))   # histogram traffic
+    c.get_object("fedbkt", "obj")
+    text = _scrape(node_a, "scope=cluster")
+    types, samples = parse_exposition(text)
+    check_histograms(types, samples)
+    # EVERY sample in the federated document names its node
+    assert samples
+    assert all("server" in labels for _, labels, _ in samples), \
+        "a per-node family lost its server label in the merge"
+    servers = {labels["server"] for _, labels, _ in samples}
+    assert node_a.node_name in servers and node_b.node_name in servers
+    # both nodes marked healthy, keyed by the SAME server value their
+    # samples carry (so mt_node_scrape_ok joins per-node families)
+    oks = {labels["server"]: v for n, labels, v in samples
+           if n == "mt_node_scrape_ok"}
+    assert oks == {node_a.node_name: 1, node_b.node_name: 1}
+
+
+def test_downed_peer_marks_scrape_errors_not_failure(duo):
+    node_a, node_b, rpc_b = duo
+    peer_ep = rpc_b.endpoint
+    rpc_b.stop()
+    text = _scrape(node_a, "scope=cluster&timeout=5")
+    types, samples = parse_exposition(text)     # still a valid scrape
+    errs = [v for n, labels, v in samples
+            if n == "mt_node_scrape_errors_total"
+            and labels.get("peer") == peer_ep]
+    assert errs and errs[0] > 0
+    oks = {labels["server"]: v for n, labels, v in samples
+           if n == "mt_node_scrape_ok"}
+    assert oks[peer_ep] == 0, "downed peer silently dropped"
+    assert oks[node_a.node_name] == 1
+
+
+def test_cluster_object_speedtest_per_node_and_aggregate(duo):
+    node_a, node_b, _ = duo
+    c = S3Client(node_a.endpoint, "ck", "cs")
+    r = c.request("POST", "/minio-tpu/admin/v1/speedtest",
+                  "size=8192&duration=0.08")
+    lines = [json.loads(x) for x in r.body.decode().splitlines() if x]
+    final = lines[-1]
+    per_node = [ln for ln in lines[:-1] if "error" not in ln]
+    assert len(per_node) == 2, f"expected both nodes, got {lines}"
+    names = {ln["node"] for ln in per_node}
+    assert names == {node_a.node_name, node_b.node_name}
+    for ln in per_node:
+        assert ln["putGiBps"] > 0 and ln["getGiBps"] > 0
+        assert ln["concurrency"] >= 1 and ln["autotuned"] is True
+    # BENCH_*.json-comparable aggregate record
+    assert set(final) == {"metric", "value", "unit", "detail"}
+    assert final["unit"] == "GiB/s"
+    agg_put = final["detail"]["putGiBps"]
+    assert agg_put == pytest.approx(
+        sum(ln["putGiBps"] for ln in per_node), rel=1e-6)
+    assert final["detail"]["getGiBps"] == pytest.approx(
+        sum(ln["getGiBps"] for ln in per_node), rel=1e-6)
+    assert final["detail"]["concurrency"] >= 1
+    assert final["value"] == pytest.approx(agg_put, rel=1e-6)
+
+
+def test_cluster_tpu_speedtest_bench_record(duo):
+    node_a, node_b, _ = duo
+    c = S3Client(node_a.endpoint, "ck", "cs")
+    r = c.request("POST", "/minio-tpu/admin/v1/speedtest-tpu",
+                  "size=131072&blocksize=32768&k=4&m=2")
+    lines = [json.loads(x) for x in r.body.decode().splitlines() if x]
+    per_node = [ln for ln in lines[:-1] if "error" not in ln]
+    assert {ln["node"] for ln in per_node} == \
+        {node_a.node_name, node_b.node_name}
+    final = lines[-1]
+    assert final["metric"] == "tpu_codec_encode_decode_GiBps_4+2"
+    assert final["value"] > 0 and final["unit"] == "GiB/s"
+    assert final["detail"]["encode_GiBps"] > 0
+    assert final["detail"]["decode_GiBps"] > 0
+
+
+def test_cluster_profile_zip_names_nodes(duo):
+    node_a, node_b, _ = duo
+    c = S3Client(node_a.endpoint, "ck", "cs")
+    r = c.request("POST", "/minio-tpu/admin/v1/profile",
+                  "profilerType=threads")
+    doc = json.loads(r.body)
+    assert doc["started"] == ["threads"]
+    assert doc["peers"] and "error" not in doc["peers"][0]
+    r = c.request("GET", "/minio-tpu/admin/v1/profile-download")
+    names = zipfile.ZipFile(io.BytesIO(r.body)).namelist()
+    # per-node naming: profile-threads.<node>.txt (in-process peers
+    # share the process-global profiler, so one node's dump carries
+    # the session — the NAMES prove the per-node fan-out shape)
+    assert any(re.match(r"profile-threads\..+\.txt$", n)
+               for n in names), names
+
+
+def test_caller_bounded_rpc_failure_skips_breaker_feedback():
+    """A caller-overridden deadline (cluster scrape / speedtest
+    fan-out) failing must NOT feed the peer circuit breaker shared
+    with real control-plane traffic — otherwise an anonymous metrics
+    loop against a slow peer opens the breaker for everyone."""
+    from minio_tpu.parallel.rpc import RPCError
+
+    client = RPCClient("http://127.0.0.1:9", "nosuch")  # discard port
+    for _ in range(6):                  # > any breaker fail_max
+        with pytest.raises(RPCError):
+            client.call("peer", "metrics_render", _timeout=0.5)
+    assert client.is_online(), \
+        "bounded observability failures opened the shared breaker"
+
+
+def test_cluster_background_status_aggregates_peers(duo):
+    node_a, node_b, _ = duo
+    from minio_tpu.background.heal import BackgroundHealer
+    node_b.healer = BackgroundHealer(node_b.layer)
+    c = S3Client(node_a.endpoint, "ck", "cs")
+    c.make_bucket("bgc")
+    c.put_object("bgc", "o", b"q" * 128)
+    node_b.healer.sweep()
+    doc = json.loads(c.request(
+        "GET", "/minio-tpu/admin/v1/background-status", "").body)
+    assert doc["node"] == node_a.node_name
+    assert doc["healing"] is None               # A runs no healer
+    peers = doc["peers"]
+    assert len(peers) == 1 and "error" not in peers[0]
+    assert peers[0]["node"] == node_b.node_name
+    assert peers[0]["healing"]["stats"]["objectsScanned"] >= 1

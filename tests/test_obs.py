@@ -310,5 +310,8 @@ def test_netperf_probe_over_rpc():
         assert res["tx_MBps"] and res["tx_MBps"] > 0
         assert res["rx_MBps"] and res["rx_MBps"] > 0
         assert res["probe_bytes"] == 1 << 20
+        # per-peer wall time rides the reply so the admin netperf
+        # route (now probing peers concurrently) can expose skew
+        assert res["duration_ms"] > 0
     finally:
         srv.stop()

@@ -1,0 +1,376 @@
+"""Cluster self-measurement (tentpole of the observability PR):
+speedtest probes + admin routes, sampling profiler thread coverage,
+heal-sweep stop latency, background-status, and the background-plane
+trace types' idle contract.
+
+Reference tier: cmd/admin-handlers.go SpeedtestHandler /
+DriveSpeedtestHandler + cmd/speedtest.go autotune, cmd/utils.go:286
+getProfileData, madmin BgHealState.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from minio_tpu.background.crawler import Crawler, scan_usage
+from minio_tpu.background.heal import BackgroundHealer
+from minio_tpu.obs import selftest, trace
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl_storage import XLStorage
+
+
+def _mk_layer(tmp_path, n=4, parity=2):
+    disks = []
+    for i in range(n):
+        d = tmp_path / f"d{i}"
+        d.mkdir(exist_ok=True)
+        disks.append(XLStorage(str(d)))
+    return ErasureObjects(disks, parity=parity, block_size=64 * 1024,
+                          backend="numpy")
+
+
+# -- probes ------------------------------------------------------------------
+
+def test_drive_speedtest_measures_and_cleans_up(tmp_path):
+    layer = _mk_layer(tmp_path)
+    paths = selftest.local_drive_paths(layer)
+    assert len(paths) == 4
+    rows = selftest.drive_speedtest(paths, file_size=1 << 20)
+    assert len(rows) == 4
+    for r in rows:
+        assert r["writeGiBps"] > 0 and r["readGiBps"] > 0
+        assert r["bytes"] == 1 << 20
+    # the probe file is gone from every drive
+    for root in paths:
+        st = os.path.join(root, ".mt.sys", "speedtest")
+        assert not os.path.exists(st) or not os.listdir(st)
+
+
+def test_object_speedtest_autotunes_and_removes_probe_bucket(tmp_path):
+    layer = _mk_layer(tmp_path)
+    r = selftest.object_speedtest(layer, size=16384, duration_s=0.15)
+    assert r["autotuned"] is True
+    assert r["concurrency"] >= 1
+    assert r["putOps"] >= 1 and r["getOps"] >= 1
+    assert r["putGiBps"] > 0 and r["getGiBps"] > 0
+    # probe bucket + objects fully cleaned up
+    assert not [b for b in layer.list_buckets()
+                if b.name.startswith("mt-speedtest-")]
+
+
+def test_object_speedtest_fixed_concurrency_runs_one_round(tmp_path):
+    layer = _mk_layer(tmp_path)
+    r = selftest.object_speedtest(layer, size=8192, duration_s=0.1,
+                                  concurrency=2)
+    assert r["concurrency"] == 2 and r["autotuned"] is False
+
+
+def test_tpu_codec_speedtest_reports_both_directions():
+    r = selftest.tpu_codec_speedtest(size=1 << 20, k=4, m=2,
+                                     block_size=256 * 1024,
+                                     backend="numpy")
+    assert r["encodeGiBps"] > 0 and r["decodeGiBps"] > 0
+    assert (r["k"], r["m"], r["backend"]) == (4, 2, "numpy")
+
+
+def test_bench_record_shape_matches_bench_json():
+    rec = selftest.bench_record("probe_metric_GiBps", 1.5,
+                                {"encode_GiBps": 1.5})
+    # the BENCH_*.json contract: bench.py emits exactly these keys
+    assert set(rec) == {"metric", "value", "unit", "detail"}
+    assert rec["unit"] == "GiB/s"
+
+
+# -- sampling profiler (satellite) ------------------------------------------
+
+def test_sampling_profiler_sees_other_threads():
+    """cProfile only hooks the enabling thread; the sampler must catch
+    a busy WORKER thread by walking sys._current_frames()."""
+    from minio_tpu.obs import profiling
+
+    stop = threading.Event()
+
+    def busy_worker_fn():
+        while not stop.is_set():
+            sum(i * i for i in range(500))
+
+    t = threading.Thread(target=busy_worker_fn, name="busy-worker",
+                         daemon=True)
+    profiling.start("cpu")
+    t.start()
+    try:
+        time.sleep(0.25)
+    finally:
+        stop.set()
+        t.join()
+    dumps = profiling.stop_dumps()
+    assert "profile-cpu.txt" in dumps          # pstats path kept
+    sampled = dumps["profile-cpu-sampled.txt"].decode()
+    assert "busy_worker_fn" in sampled, \
+        "sampler never saw the worker thread's stack"
+    # collapsed-stack lines: "frame;frame;... count"
+    body = [ln for ln in sampled.splitlines()
+            if ln and not ln.startswith("#")]
+    assert body and all(ln.rsplit(" ", 1)[1].isdigit() for ln in body)
+
+
+# -- heal sweep stop latency (satellite) ------------------------------------
+
+def test_heal_sweep_stop_bails_mid_walk(tmp_path, monkeypatch):
+    layer = _mk_layer(tmp_path)
+    layer.make_bucket("healbkt")
+    for i in range(40):
+        layer.put_object("healbkt", f"o{i:03d}", b"x" * 128)
+    healer = BackgroundHealer(layer)
+
+    real_heal = layer.heal_object
+
+    def slow_heal(*a, **k):
+        time.sleep(0.05)
+        return real_heal(*a, **k)
+
+    monkeypatch.setattr(layer, "heal_object", slow_heal)
+    done = threading.Event()
+
+    def run():
+        healer.sweep()
+        done.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    # let a few objects heal, then stop: the sweep must bail within
+    # ~one object's heal time, not walk all 40 (2+ seconds)
+    deadline = time.monotonic() + 5.0
+    while healer.stats.objects_scanned < 2 and \
+            time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert healer.stats.objects_scanned >= 2, "sweep never started"
+    t0 = time.monotonic()
+    healer._stop.set()
+    assert done.wait(timeout=1.0), "sweep ignored stop mid-walk"
+    assert time.monotonic() - t0 < 0.5
+    # partial-cycle stats kept, cycle not counted as completed
+    assert 0 < healer.stats.objects_scanned < 40
+    assert healer.stats.cycles == 0
+    # the aborted cycle must not leak an eternal active flag or
+    # record lying last-cycle rates
+    assert healer.progress.active is False
+    assert healer.progress.last == {}
+    assert healer.progress.cycles == 0
+
+
+# -- background-plane spans: idle contract + types --------------------------
+
+def test_background_spans_follow_idle_contract(tmp_path, monkeypatch):
+    assert not trace.active(), "leaked subscriber/ring from another test"
+    layer = _mk_layer(tmp_path)
+    layer.make_bucket("bgbkt")
+    for i in range(3):
+        layer.put_object("bgbkt", f"o{i}", b"y" * 256)
+    calls = {"make": 0}
+    real_make = trace.make_span
+    monkeypatch.setattr(
+        trace, "make_span",
+        lambda *a, **k: (calls.__setitem__("make", calls["make"] + 1),
+                         real_make(*a, **k))[1])
+    healer = BackgroundHealer(layer)
+    healer.sweep()
+    scan_usage(layer, apply_lifecycle=False)
+    assert calls["make"] == 0, \
+        "background spans built with zero subscribers"
+    with trace.HTTP_TRACE.subscribe() as sub:
+        healer.sweep()
+        scan_usage(layer, apply_lifecycle=False)
+        spans = list(sub.drain(500, timeout=1.0))
+    kinds = {s["type"] for s in spans}
+    assert "healing" in kinds and "scanner" in kinds
+    heal_spans = [s for s in spans if s["type"] == "healing"]
+    assert all(s["funcName"] == "healing.sweep" for s in heal_spans)
+    assert any(s["healing"]["bucket"] == "bgbkt" for s in heal_spans)
+    scans = [s for s in spans if s["type"] == "scanner"]
+    assert any(s["scanner"]["bucket"] == "bgbkt"
+               and s["scanner"]["objects"] == 3 for s in scans)
+
+
+def test_replication_spans_follow_idle_contract(tmp_path, monkeypatch):
+    from minio_tpu.background.replication import ReplicationSys
+    from minio_tpu.objectlayer.bucket_meta import BucketMetadataSys
+    assert not trace.active()
+    layer = _mk_layer(tmp_path)
+    rs = ReplicationSys(layer, BucketMetadataSys(layer), workers=1)
+    calls = {"make": 0}
+    real_make = trace.make_span
+    monkeypatch.setattr(
+        trace, "make_span",
+        lambda *a, **k: (calls.__setitem__("make", calls["make"] + 1),
+                         real_make(*a, **k))[1])
+    rs.start()
+    try:
+        rs._q.put(("rbkt", "robj", "", False))   # no target: no-op task
+        rs.drain(timeout=2.0)
+        assert calls["make"] == 0
+        with trace.HTTP_TRACE.subscribe() as sub:
+            rs._q.put(("rbkt", "robj2", "", False))
+            spans = list(sub.drain(5, timeout=2.0))
+        repl = [s for s in spans if s["type"] == "replication"]
+        assert repl and repl[0]["replication"]["object"] == "robj2"
+    finally:
+        rs.stop()
+
+
+def test_new_trace_types_accepted_by_filter():
+    from minio_tpu.admin.handlers import _trace_type_filter
+    flt, want = _trace_type_filter(
+        {"type": "scanner,healing,replication"})
+    assert want == {"scanner", "healing", "replication"}
+    assert flt({"type": "healing"}) and not flt({"type": "http"})
+    assert set(trace.TRACE_TYPES) >= want
+
+
+# -- served admin surface ----------------------------------------------------
+
+@pytest.fixture
+def served(tmp_path):
+    layer = _mk_layer(tmp_path)
+    srv = S3Server(layer, access_key="stk", secret_key="sts")
+    srv.healer = BackgroundHealer(layer)
+    srv.crawler = Crawler(layer)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _lines(body: bytes) -> list:
+    return [json.loads(x) for x in body.decode().splitlines() if x]
+
+
+def test_admin_speedtest_tpu_streams_bench_record(served):
+    c = S3Client(served.endpoint, "stk", "sts")
+    r = c.request("POST", "/minio-tpu/admin/v1/speedtest-tpu",
+                  "size=262144&blocksize=65536&k=4&m=2")
+    lines = _lines(r.body)
+    assert len(lines) == 2                      # local node + final
+    node = lines[0]
+    assert node["node"] == served.node_name
+    assert node["encodeGiBps"] > 0 and node["decodeGiBps"] > 0
+    final = lines[-1]
+    assert set(final) == {"metric", "value", "unit", "detail"}
+    assert final["metric"] == "tpu_codec_encode_decode_GiBps_4+2"
+    assert final["unit"] == "GiB/s" and final["value"] > 0
+    assert final["detail"]["encode_GiBps"] > 0
+    assert final["detail"]["decode_GiBps"] > 0
+
+
+def test_admin_speedtest_drive_reports_every_drive(served):
+    c = S3Client(served.endpoint, "stk", "sts")
+    r = c.request("POST", "/minio-tpu/admin/v1/speedtest-drive",
+                  "size=131072")
+    lines = _lines(r.body)
+    assert len(lines[0]["drives"]) == 4
+    assert all(d["writeGiBps"] > 0 for d in lines[0]["drives"])
+    final = lines[-1]
+    assert final["metric"] == "drive_seq_write_GiBps"
+    assert final["detail"]["driveCount"] == 4
+
+
+def test_admin_object_speedtest_single_node(served):
+    c = S3Client(served.endpoint, "stk", "sts")
+    r = c.request("POST", "/minio-tpu/admin/v1/speedtest",
+                  "size=16384&duration=0.1&concurrency=2")
+    lines = _lines(r.body)
+    node = lines[0]
+    assert node["putGiBps"] > 0 and node["getGiBps"] > 0
+    final = lines[-1]
+    assert final["detail"]["putGiBps"] == pytest.approx(
+        node["putGiBps"], rel=1e-6)
+    assert final["detail"]["concurrency"] == 2
+
+
+def test_admin_trace_streams_healing_type(served):
+    """`?type=healing` on the admin trace route delivers the heal
+    sweep's spans — the background planes ride the same type-filter
+    machinery as the PR-2 subsystem types."""
+    served.layer.make_bucket("htrbkt")
+    served.layer.put_object("htrbkt", "o1", b"h" * 256)
+    c = S3Client(served.endpoint, "stk", "sts")
+    got = {}
+
+    def consume():
+        r = c.request("GET", "/minio-tpu/admin/v1/trace",
+                      "timeout=5&max-items=1&type=healing")
+        got["lines"] = [json.loads(x)
+                        for x in r.body.decode().splitlines() if x]
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 3
+    while served.trace_hub.num_subscribers < 1 and \
+            time.monotonic() < deadline:
+        time.sleep(0.02)
+    served.healer.sweep()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert got["lines"], "no healing span reached the typed stream"
+    span = got["lines"][0]
+    assert span["type"] == "healing"
+    assert span["healing"]["bucket"] == "htrbkt"
+
+
+def test_background_status_route(served):
+    served.layer.make_bucket("bgsbkt")
+    served.layer.put_object("bgsbkt", "o1", b"z" * 512)
+    served.healer.sweep()
+    served.crawler.run_cycle()
+    c = S3Client(served.endpoint, "stk", "sts")
+    doc = json.loads(c.request(
+        "GET", "/minio-tpu/admin/v1/background-status", "").body)
+    assert doc["node"] == served.node_name
+    heal = doc["healing"]
+    assert heal["stats"]["objectsScanned"] >= 1
+    assert heal["progress"]["cycles"] == 1
+    last = heal["progress"]["lastCycle"]
+    assert last["objects"] >= 1 and last["objectsPerSecond"] > 0
+    scan = doc["scanner"]
+    assert scan["cycles"] == 1
+    assert scan["progress"]["lastCycle"]["objects"] >= 1
+    assert doc["replication"] is None           # not enabled here
+
+
+def test_scrape_exports_background_rate_gauges(served):
+    served.layer.make_bucket("ratebkt")
+    served.layer.put_object("ratebkt", "o1", b"r" * 2048)
+    served.healer.sweep()
+    served.crawler.run_cycle()
+    from minio_tpu.admin import metrics
+    text = metrics.render(served.layer, healer=served.healer,
+                          crawler=served.crawler)
+    assert "mt_heal_objects_per_second " in text
+    assert "mt_scanner_objects_per_second " in text
+    assert "mt_scanner_cycles_total 1" in text
+    assert "mt_heal_cycle_active 0" in text
+
+
+def test_replication_and_bandwidth_gauges_exported(tmp_path):
+    from minio_tpu.admin import metrics
+    from minio_tpu.background.replication import ReplicationSys
+    from minio_tpu.objectlayer.bucket_meta import BucketMetadataSys
+    layer = _mk_layer(tmp_path)
+    rs = ReplicationSys(layer, BucketMetadataSys(layer))
+    rs.stats.queued = 5
+    rs.stats.replicated = 3
+    rs.stats.replica_bytes = 4096
+    rs.monitor.set_limit("bwbkt", 1 << 20)
+    rs.monitor.throttle("bwbkt", 100)
+    text = metrics.render(layer, replication=rs)
+    assert "mt_replication_queued_total 5" in text
+    assert "mt_replication_objects_total 3" in text
+    assert "mt_replication_bytes_total 4096" in text
+    assert ('mt_bucket_bandwidth_limit_bytes_per_second'
+            '{bucket="bwbkt"} 1048576') in text
+    assert ('mt_bucket_bandwidth_moved_bytes_total'
+            '{bucket="bwbkt"} 100') in text
