@@ -346,6 +346,33 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
                     return _stream_with_peer_traces(h, srv, q1, flt,
                                                     want)
                 return _stream(h, srv.trace_hub, q1, flt)
+        if route == "targets" and h.command == "GET":
+            # delivery-target status across the cluster (`mc admin
+            # info` target-status analog): state machine, backlog,
+            # last error/success per target, peer-aggregated like
+            # background-status
+            out = {"node": srv.node_name,
+                   "targets": srv.egress.status()}
+            if srv.peers is not None and q1.get("local") != "true":
+                out["peers"] = [
+                    {"node": ep, "error": err} if err else r
+                    for ep, r, err in srv.peers.call_all(
+                        "target_status", timeout_s=5.0)]
+            return send_json(out) or True
+        if route == "targets/replay" and h.command == "POST":
+            # kick a synchronous replay of every store-backed target,
+            # here and (unless ?local=true) on every peer.  Non-
+            # idempotent on the wire: a replayed RPC would re-deliver
+            # records the first pass already drained.
+            out = {"node": srv.node_name,
+                   "replayed": srv.egress.replay_all()}
+            if srv.peers is not None and q1.get("local") != "true":
+                out["peers"] = [
+                    {"node": ep, "error": err} if err else r
+                    for ep, r, err in srv.peers.call_all(
+                        "target_replay", timeout_s=30.0,
+                        idempotent=False)]
+            return send_json(out) or True
         if route == "top" and h.command == "GET":
             return send_json(_top(srv)) or True
         if route == "log" and h.command == "GET":
@@ -488,7 +515,8 @@ def _render_local(srv, node=None) -> str:
         config=getattr(srv, "config", None),
         api_stats=getattr(srv, "api_stats", None),
         replication=getattr(srv, "replication", None),
-        crawler=getattr(srv, "crawler", None), node=node)
+        crawler=getattr(srv, "crawler", None), node=node,
+        egress=getattr(srv, "egress", None))
 
 
 _CLUSTER_SCRAPE_TTL_S = 2.0
@@ -899,6 +927,12 @@ def _config(h, srv, route, q1, payload, send_json) -> bool:
             # retune the live request plane (deadlines, pool size,
             # shed queue) without a restart
             srv.reload_api_config()
+        if parts[1] in ("logger_webhook", "audit_webhook") \
+                or parts[1].startswith("notify_"):
+            # rebuild the egress targets live: repointed endpoints and
+            # queue knobs apply without a restart (replaced targets
+            # close; their queued records spill to their stores)
+            srv.reload_egress_config()
         return send_json({"status": "ok"}) or True
     from ..s3.server import S3Error
     raise S3Error("MethodNotAllowed")

@@ -102,7 +102,8 @@ def _fmt_labels(labels: tuple, extra: str = "") -> str:
 
 
 def render(layer=None, healer=None, config=None, api_stats=None,
-           replication=None, crawler=None, node=None) -> str:
+           replication=None, crawler=None, node=None,
+           egress=None) -> str:
     """Prometheus text format: counters + histograms + live gauges.
 
     ``config`` (a kvconfig Config) supplies the slow-drive knobs at
@@ -113,7 +114,12 @@ def render(layer=None, healer=None, config=None, api_stats=None,
     ``node`` names this server for federation: every sample gains a
     ``server`` label so one merged cluster document keeps per-node
     series apart (the Prometheus federation convention — honor the
-    source's identity labels when aggregating)."""
+    source's identity labels when aggregating).
+
+    ``egress`` is the server's EgressRegistry (obs/egress.py): the
+    ``mt_target_*`` delivery families are computed at scrape time from
+    the live targets' own counters, so a server with zero configured
+    targets emits NO target families at all (the idle contract)."""
     lines = [
         "# HELP mt_up Server is up.",
         "# TYPE mt_up gauge",
@@ -195,6 +201,11 @@ def render(layer=None, healer=None, config=None, api_stats=None,
     if replication is not None:
         try:
             lines += _replication_gauges(replication)
+        except Exception:  # noqa: BLE001
+            pass
+    if egress is not None:
+        try:
+            lines += _egress_metrics(egress)
         except Exception:  # noqa: BLE001
             pass
     text = "\n".join(lines) + "\n"
@@ -420,6 +431,52 @@ def _replication_gauges(replication) -> list[str]:
             lines.append(
                 "mt_bucket_bandwidth_moved_bytes_total"
                 f"{bl} {r['totalBytesMoved']}")
+    return lines
+
+
+def _egress_metrics(egress) -> list[str]:
+    """Telemetry-egress delivery families from the live targets'
+    counters + state machines (obs/egress.py).  Everything is labelled
+    ``{target_type, target}``; an empty registry emits nothing, so the
+    scrape of an egress-less server carries no ``mt_target_*`` family
+    at all."""
+    targets = egress.targets()
+    if not targets:
+        return []
+    stats = [(t, t.status()) for t in targets]
+
+    def lbl(st) -> tuple:
+        return (("target", st["target"]), ("target_type", st["type"]))
+
+    lines: list[str] = []
+    for fam, key, kind in (
+            ("mt_target_sent_total", "sent", "counter"),
+            ("mt_target_failed_total", "failed", "counter"),
+            ("mt_target_dropped_total", "dropped", "counter"),
+            ("mt_target_dead_letter_total", "deadLettered", "counter"),
+            ("mt_target_queue_length", "queued", "gauge"),
+            ("mt_target_store_length", "stored", "gauge"),
+            ("mt_target_online", "online", "gauge")):
+        lines.append(f"# TYPE {fam} {kind}")
+        for _, st in stats:
+            v = int(st[key]) if key == "online" else st[key]
+            lines.append(f"{fam}{_fmt_labels(lbl(st))} {v}")
+    lines.append("# TYPE mt_target_delivery_seconds histogram")
+    for t, st in stats:
+        buckets, counts, total = t.delivery_hist()
+        labels = lbl(st)
+        for i, ub in enumerate(buckets):
+            le = 'le="%g"' % ub
+            lines.append("mt_target_delivery_seconds_bucket"
+                         f"{_fmt_labels(labels, le)} {counts[i]}")
+        le_inf = 'le="+Inf"'
+        lines.append("mt_target_delivery_seconds_bucket"
+                     f"{_fmt_labels(labels, le_inf)}"
+                     f" {counts[len(buckets)]}")
+        lines.append("mt_target_delivery_seconds_sum"
+                     f"{_fmt_labels(labels)} {_fmt_value(total)}")
+        lines.append("mt_target_delivery_seconds_count"
+                     f"{_fmt_labels(labels)} {counts[len(buckets)]}")
     return lines
 
 

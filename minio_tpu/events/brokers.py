@@ -64,8 +64,9 @@ class AMQPTarget(BrokeredTarget):
 
     def __init__(self, arn: str, url: str, exchange: str = "",
                  routing_key: str = "", exchange_type: str = "direct",
-                 durable: bool = False, store_dir: Optional[str] = None):
-        super().__init__(arn, store_dir)
+                 durable: bool = False, store_dir: Optional[str] = None,
+                 **engine):
+        super().__init__(arn, store_dir, **engine)
         self.url = url
         self.exchange = exchange
         self.routing_key = routing_key
@@ -107,8 +108,8 @@ class KafkaTarget(BrokeredTarget):
     KIND = "kafka"
 
     def __init__(self, arn: str, brokers: list[str], topic: str,
-                 store_dir: Optional[str] = None):
-        super().__init__(arn, store_dir)
+                 store_dir: Optional[str] = None, **engine):
+        super().__init__(arn, store_dir, **engine)
         self.brokers = brokers
         self.topic = topic
 
@@ -153,8 +154,8 @@ class MQTTTarget(BrokeredTarget):
     KIND = "mqtt"
 
     def __init__(self, arn: str, broker: str, topic: str, qos: int = 0,
-                 store_dir: Optional[str] = None):
-        super().__init__(arn, store_dir)
+                 store_dir: Optional[str] = None, **engine):
+        super().__init__(arn, store_dir, **engine)
         self.broker = broker
         self.topic = topic
         self.qos = qos
@@ -186,8 +187,8 @@ class NATSTarget(BrokeredTarget):
 
     def __init__(self, arn: str, address: str, subject: str,
                  user: str = "", password: str = "",
-                 store_dir: Optional[str] = None):
-        super().__init__(arn, store_dir)
+                 store_dir: Optional[str] = None, **engine):
+        super().__init__(arn, store_dir, **engine)
         self.address = address
         self.subject = subject
         self.user = user
@@ -220,8 +221,8 @@ class NSQTarget(BrokeredTarget):
     KIND = "nsq"
 
     def __init__(self, arn: str, nsqd_address: str, topic: str,
-                 store_dir: Optional[str] = None):
-        super().__init__(arn, store_dir)
+                 store_dir: Optional[str] = None, **engine):
+        super().__init__(arn, store_dir, **engine)
         self.nsqd_address = nsqd_address
         self.topic = topic
 
@@ -251,10 +252,10 @@ class RedisTarget(BrokeredTarget):
 
     def __init__(self, arn: str, address: str, key: str,
                  fmt: str = FORMAT_NAMESPACE, password: str = "",
-                 store_dir: Optional[str] = None):
+                 store_dir: Optional[str] = None, **engine):
         if fmt not in (FORMAT_NAMESPACE, FORMAT_ACCESS):
             raise ValueError(f"invalid redis format {fmt!r}")
-        super().__init__(arn, store_dir)
+        super().__init__(arn, store_dir, **engine)
         self.address = address
         self.key = key
         self.fmt = fmt
@@ -296,10 +297,10 @@ class SQLTarget(BrokeredTarget):
 
     def __init__(self, arn: str, dsn: str, table: str,
                  fmt: str = FORMAT_NAMESPACE,
-                 store_dir: Optional[str] = None):
+                 store_dir: Optional[str] = None, **engine):
         if fmt not in (FORMAT_NAMESPACE, FORMAT_ACCESS):
             raise ValueError(f"invalid sql format {fmt!r}")
-        super().__init__(arn, store_dir)
+        super().__init__(arn, store_dir, **engine)
         self.dsn = dsn
         self.table = table
         self.fmt = fmt
@@ -406,10 +407,10 @@ class ElasticsearchTarget(BrokeredTarget):
 
     def __init__(self, arn: str, url: str, index: str,
                  fmt: str = FORMAT_NAMESPACE,
-                 store_dir: Optional[str] = None):
+                 store_dir: Optional[str] = None, **engine):
         if fmt not in (FORMAT_NAMESPACE, FORMAT_ACCESS):
             raise ValueError(f"invalid elasticsearch format {fmt!r}")
-        super().__init__(arn, store_dir)
+        super().__init__(arn, store_dir, **engine)
         self.url = url
         self.index = index
         self.fmt = fmt
@@ -459,46 +460,51 @@ def target_from_config(kind: str, cfg, target_id: str = "1"):
     if cfg.get(sub, "enable") != "on":
         return None
     arn = f"arn:minio:sqs::{target_id}:{kind}"
+    from ..obs.egress import config_queue_limit
     store = cfg.get(sub, "queue_dir") or None
+    # the notify_<kind> queue knob bounds both tiers of the target's
+    # store-and-forward pipeline (memory queue + disk store)
+    limit = config_queue_limit(cfg, sub, "queue_limit")
+    eng = {"queue_limit": limit, "store_limit": limit}
     if kind == "amqp":
         return AMQPTarget(arn, cfg.get(sub, "url"),
                           cfg.get(sub, "exchange"),
                           cfg.get(sub, "routing_key"),
-                          store_dir=store)
+                          store_dir=store, **eng)
     if kind == "kafka":
         brokers = [b.strip() for b in cfg.get(sub, "brokers").split(",")
                    if b.strip()]
         return KafkaTarget(arn, brokers, cfg.get(sub, "topic"),
-                           store_dir=store)
+                           store_dir=store, **eng)
     if kind == "mqtt":
         return MQTTTarget(arn, cfg.get(sub, "broker"),
                           cfg.get(sub, "topic"),
-                          int(cfg.get(sub, "qos") or 0), store_dir=store)
+                          int(cfg.get(sub, "qos") or 0), store_dir=store, **eng)
     if kind == "nats":
         return NATSTarget(arn, cfg.get(sub, "address"),
                           cfg.get(sub, "subject"),
                           user=cfg.get(sub, "username"),
                           password=cfg.get(sub, "password"),
-                          store_dir=store)
+                          store_dir=store, **eng)
     if kind == "nsq":
         return NSQTarget(arn, cfg.get(sub, "nsqd_address"),
-                         cfg.get(sub, "topic"), store_dir=store)
+                         cfg.get(sub, "topic"), store_dir=store, **eng)
     if kind == "redis":
         return RedisTarget(arn, cfg.get(sub, "address"),
                            cfg.get(sub, "key"),
                            cfg.get(sub, "format"),
                            password=cfg.get(sub, "password") or "",
-                           store_dir=store)
+                           store_dir=store, **eng)
     if kind == "mysql":
         return MySQLTarget(arn, cfg.get(sub, "dsn_string"),
                            cfg.get(sub, "table"),
-                           cfg.get(sub, "format"), store_dir=store)
+                           cfg.get(sub, "format"), store_dir=store, **eng)
     if kind == "postgresql":
         return PostgreSQLTarget(arn, cfg.get(sub, "connection_string"),
                                 cfg.get(sub, "table"),
-                                cfg.get(sub, "format"), store_dir=store)
+                                cfg.get(sub, "format"), store_dir=store, **eng)
     if kind == "elasticsearch":
         return ElasticsearchTarget(arn, cfg.get(sub, "url"),
                                    cfg.get(sub, "index"),
-                                   cfg.get(sub, "format"), store_dir=store)
+                                   cfg.get(sub, "format"), store_dir=store, **eng)
     raise ValueError(f"unknown broker kind {kind!r}")

@@ -84,3 +84,12 @@ class NotificationSys:
 
     def close(self) -> None:
         self._pool.shutdown(wait=False)
+        # stop every target's sender thread; store-backed targets spill
+        # their queued records to disk on the way down (obs/egress.py)
+        with self._mu:
+            targets = list(self._targets.values())
+        for t in targets:
+            try:
+                t.close()
+            except Exception:  # noqa: BLE001 — shutdown must proceed
+                pass

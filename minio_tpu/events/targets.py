@@ -1,23 +1,31 @@
 """Notification targets (pkg/event/target/*).
 
 A Target delivers event records to an external system.  Implemented:
-webhook (HTTP POST, pkg/event/target/webhook.go) with a store-and-forward
-QueueStore (pkg/event/target/queuestore.go) that persists undeliverable
-events to disk and replays them, and an in-memory target for tests and
-the admin API.  Other reference targets (kafka/amqp/mqtt/nats/redis/
-postgres/mysql/nsq/elasticsearch) follow the same Target interface; their
-client libraries are not in this image, so they are registry-gated.
+webhook (HTTP POST, pkg/event/target/webhook.go) and an in-memory
+target for tests and the admin API.  Other reference targets (kafka/
+amqp/mqtt/nats/redis/postgres/mysql/nsq/elasticsearch) follow the same
+Target interface over own wire clients (events/brokers.py).
+
+Every network-backed target rides the shared store-and-forward egress
+engine (obs/egress.py): ``send`` is a bounded non-blocking enqueue; a
+background sender retries with jittered backoff; an unreachable
+endpoint takes the target offline (records persist to the bounded disk
+``QueueStore`` — pkg/event/target/queuestore.go) and a half-open probe
+brings it back, replaying the store automatically.  Records that can
+be neither delivered nor stored are dead-lettered: counted, never
+raised into the request path.
 """
 
 from __future__ import annotations
 
 import json
-import os
 import threading
-import time
 import urllib.request
-import uuid
 from typing import Optional
+
+# QueueStore moved to the egress engine; re-exported here because the
+# public events API (minio_tpu.events.QueueStore) predates the move
+from ..obs.egress import DeliveryTarget, QueueStore  # noqa: F401
 
 
 class TargetError(Exception):
@@ -35,46 +43,8 @@ class Target:
     def close(self) -> None:
         pass
 
-
-class QueueStore:
-    """Disk-backed event queue (pkg/event/target/queuestore.go): one JSON
-    file per undelivered event, replayed in order, bounded count."""
-
-    def __init__(self, directory: str, limit: int = 10000):
-        self.dir = directory
-        self.limit = limit
-        self._mu = threading.Lock()
-        os.makedirs(directory, exist_ok=True)
-
-    def put(self, record: dict) -> str:
-        with self._mu:
-            names = sorted(os.listdir(self.dir))
-            if len(names) >= self.limit:
-                raise TargetError("queue store full")
-            key = f"{time.time_ns():020d}-{uuid.uuid4().hex[:8]}.json"
-            tmp = os.path.join(self.dir, f".{key}.tmp")
-            with open(tmp, "w") as f:
-                json.dump(record, f)
-            os.replace(tmp, os.path.join(self.dir, key))
-            return key
-
-    def list(self) -> list[str]:
-        with self._mu:
-            return sorted(n for n in os.listdir(self.dir)
-                          if not n.startswith("."))
-
-    def get(self, key: str) -> dict:
-        with open(os.path.join(self.dir, key)) as f:
-            return json.load(f)
-
-    def delete(self, key: str) -> None:
-        try:
-            os.remove(os.path.join(self.dir, key))
-        except FileNotFoundError:
-            pass
-
-    def __len__(self) -> int:
-        return len(self.list())
+    def replay(self) -> int:
+        return 0
 
 
 def event_payload(record: dict) -> dict:
@@ -88,40 +58,23 @@ def event_payload(record: dict) -> dict:
     }
 
 
-class StoreForwardTarget(Target):
-    """Deliver-or-queue base shared by webhook and every broker target:
-    failed sends persist to the QueueStore and drain via replay()
-    (pkg/event/target/queuestore.go semantics)."""
+class StoreForwardTarget(DeliveryTarget):
+    """Deliver-or-queue base shared by webhook and every broker target,
+    now the egress engine with an ARN identity: async queue + disk
+    store + state machine + auto replay (the old synchronous
+    deliver-or-store semantics live on in ``replay()``, which drains
+    the store inline for the admin action and tests, and in
+    ``sync=True`` inline mode)."""
 
-    def __init__(self, arn: str, store_dir: Optional[str] = None):
+    ERROR_CLS = TargetError
+
+    def __init__(self, arn: str, store_dir: Optional[str] = None,
+                 **engine):
+        super().__init__("notify", arn, store_dir=store_dir, **engine)
         self.arn = arn
-        self.store = QueueStore(store_dir) if store_dir else None
 
     def _deliver(self, record: dict) -> None:  # pragma: no cover - iface
         raise NotImplementedError
-
-    def send(self, record: dict) -> None:
-        try:
-            self._deliver(record)
-        except Exception as e:
-            if self.store is not None:
-                self.store.put(record)      # retry later via replay()
-            else:
-                raise TargetError(str(e)) from e
-
-    def replay(self) -> int:
-        """Redeliver queued events; returns how many got through."""
-        if self.store is None:
-            return 0
-        ok = 0
-        for key in self.store.list():
-            try:
-                self._deliver(self.store.get(key))
-            except Exception:
-                break                       # endpoint still down: stop
-            self.store.delete(key)
-            ok += 1
-        return ok
 
 
 class WebhookTarget(StoreForwardTarget):
@@ -131,8 +84,8 @@ class WebhookTarget(StoreForwardTarget):
     def __init__(self, arn: str, endpoint: str,
                  auth_token: str = "",
                  store_dir: Optional[str] = None,
-                 timeout: float = 5.0):
-        super().__init__(arn, store_dir)
+                 timeout: float = 5.0, **engine):
+        super().__init__(arn, store_dir, **engine)
         self.endpoint = endpoint
         self.auth_token = auth_token
         self.timeout = timeout

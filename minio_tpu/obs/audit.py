@@ -15,7 +15,7 @@ import time
 from typing import Any, Dict, List
 
 from .logger import HTTPLogTarget
-from .trace import redact_headers
+from .trace import redact_headers, redact_query
 
 VERSION = "1"
 
@@ -81,7 +81,9 @@ class AuditLog:
             "requestID": request_id,
             "userAgent": user_agent,
             "accessKey": access_key,
-            "requestQuery": dict(query),
+            # presigned-URL credentials ride the query string — an
+            # audit sink must never see a replayable signature
+            "requestQuery": redact_query(query),
             "requestHeader": redact_headers(req_headers),
             "responseHeader": dict(resp_headers),
         }

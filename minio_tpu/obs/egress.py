@@ -1,0 +1,642 @@
+"""Telemetry egress plane — the store-and-forward delivery core shared
+by log, audit, and bucket-event targets (cmd/logger/target/http +
+pkg/event/target/queuestore.go unified).
+
+A :class:`DeliveryTarget` owns one destination (a webhook endpoint, a
+broker) and guarantees the request path never waits on it:
+
+* ``send()`` is a bounded in-memory enqueue (``put_nowait``) — a full
+  queue spills to the disk store when one is configured, else the
+  record is counted dropped;
+* ONE background sender thread per target drains the queue, retrying
+  each record with the shared jittered-exponential backoff from
+  ``utils/retry.py``;
+* an online → offline → probing state machine (the RPC circuit
+  breaker's shape, parallel/rpc.py): ``offline_after`` CONSECUTIVE
+  failures take the target offline — further records go straight to
+  the disk store without touching the network; after ``cooldown_s``
+  exactly one delivery is admitted as the half-open probe, whose
+  success flips the target back online and triggers background replay
+  of the store;
+* records that exhaust ``max_attempts`` (or arrive while offline)
+  persist to the bounded disk :class:`QueueStore`; with no store — or
+  a full one — they are DEAD-LETTERED: counted, never blocking, never
+  raising into the caller;
+* offline/online transitions go through ``Logger.log_once`` so a
+  flapping endpoint shows up in the logs without storming them.
+
+Every target keeps its own delivery counters and latency histogram;
+the scrape-time exporter (admin/metrics.py ``_egress_metrics``) reads
+them through the :class:`EgressRegistry`, so a server with ZERO
+configured targets has no sender threads, no queues, and no
+``mt_target_*`` families in its scrape — the hot path stays free when
+egress is unconfigured.
+
+Everything nondeterministic is injectable (``rng``, ``sleep``,
+``clock``) so tests drive the state machine without wall-clock races.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import random
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ..utils.retry import RetryPolicy
+
+ONLINE = "online"
+OFFLINE = "offline"
+PROBING = "probing"
+
+# delivery is a network round trip: ms-scale when healthy, the target
+# timeout when not
+DELIVERY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+_CLOSE = object()       # sender-thread shutdown sentinel
+
+
+class QueueStoreFull(Exception):
+    """The bounded disk queue is at its limit (dead-letter trigger)."""
+
+
+def config_queue_limit(cfg, subsys: str, key: str,
+                       default: int = 10000) -> int:
+    """Parse a queue/store bound from a kvconfig subsystem, clamped to
+    >= 1 — the ONE parser for every egress queue knob (logger/audit
+    ``queue_size``, notify ``queue_limit``), so the planes can never
+    drift on defaults or clamping."""
+    try:
+        return max(1, int(cfg.get(subsys, key) or default))
+    except (KeyError, ValueError):
+        return default
+
+
+class QueueStore:
+    """Disk-backed record queue (pkg/event/target/queuestore.go): one
+    JSON file per undelivered record, replayed in timestamp order,
+    bounded count."""
+
+    def __init__(self, directory: str, limit: int = 10000):
+        self.dir = directory
+        self.limit = limit
+        self._mu = threading.Lock()
+        os.makedirs(directory, exist_ok=True)
+        # cached entry count: the sender polls the backlog every loop
+        # pass and status()/the scrape read it under the send-path lock
+        # — neither may cost a directory scan
+        self._count = sum(1 for n in os.listdir(directory)
+                          if not n.startswith("."))
+
+    def put(self, record: dict) -> str:
+        with self._mu:
+            if self._count >= self.limit:
+                raise QueueStoreFull("queue store full")
+            key = f"{time.time_ns():020d}-{uuid.uuid4().hex[:8]}.json"
+            tmp = os.path.join(self.dir, f".{key}.tmp")
+            with open(tmp, "w") as f:
+                json.dump(record, f)
+            os.replace(tmp, os.path.join(self.dir, key))
+            self._count += 1
+            return key
+
+    def list(self) -> list[str]:
+        with self._mu:
+            return sorted(n for n in os.listdir(self.dir)
+                          if not n.startswith("."))
+
+    def get(self, key: str) -> dict:
+        with open(os.path.join(self.dir, key)) as f:
+            return json.load(f)
+
+    def delete(self, key: str) -> None:
+        with self._mu:
+            try:
+                os.remove(os.path.join(self.dir, key))
+            except FileNotFoundError:
+                return
+            self._count -= 1
+
+    def __len__(self) -> int:
+        with self._mu:
+            return self._count
+
+
+class DeliveryTarget:
+    """Store-and-forward delivery engine for ONE egress destination.
+
+    Subclasses implement ``_deliver(record)`` (raise on failure);
+    construction wires the knobs.  ``target_type`` names the plane
+    (``logger`` / ``audit`` / ``notify``), ``name`` the destination
+    (endpoint or ARN) — together they label every metric and status
+    row."""
+
+    QUEUE_SIZE = 10000
+
+    # inline-mode failures with nowhere to store are wrapped in this
+    # (events targets set it to TargetError — the type their callers
+    # historically caught)
+    ERROR_CLS = Exception
+
+    def __init__(self, target_type: str, name: str, *,
+                 queue_limit: int | None = None,
+                 store_dir: Optional[str] = None,
+                 store_limit: int = 10000,
+                 max_attempts: int = 3, offline_after: int = 3,
+                 cooldown_s: float = 3.0,
+                 backoff_base_s: float = 0.05,
+                 backoff_cap_s: float = 2.0,
+                 sync: bool = False,
+                 rng: random.Random | None = None,
+                 sleep=time.sleep, clock=time.monotonic, log=None):
+        self.target_type = target_type
+        self.name = name
+        self.store = QueueStore(store_dir, limit=store_limit) \
+            if store_dir else None
+        self.max_attempts = max(1, int(max_attempts))
+        self.offline_after = max(1, int(offline_after))
+        self.cooldown_s = cooldown_s
+        self._policy = RetryPolicy(attempts=self.max_attempts,
+                                   base_s=backoff_base_s,
+                                   cap_s=backoff_cap_s, rng=rng,
+                                   sleep=sleep)
+        self._sync = sync            # tests: deliver inline, raise through
+        self._clock = clock
+        self._log = log              # log_once-shaped callable or None
+        self._q: "queue.Queue" = queue.Queue(queue_limit
+                                             or self.QUEUE_SIZE)
+        self._mu = threading.Lock()
+        # serializes every delivery attempt (worker loop, auto-replay,
+        # and the admin-triggered sync replay()) so one record is never
+        # delivered twice by two drains racing over the store
+        self._deliver_mu = threading.Lock()
+        self._state = ONLINE
+        self._consecutive = 0
+        self._opened_at = 0.0
+        # records accepted into the queue but not yet fully processed
+        # (delivered/spilled/dead-lettered) — counted at ENQUEUE time so
+        # flush() can never observe the dequeued-but-unmarked window
+        self._pending = 0
+        self._closed = False
+        self._worker: threading.Thread | None = None
+        self.sent = 0
+        self.failed = 0              # failed delivery ATTEMPTS
+        self.dropped = 0             # discarded before any attempt
+        self.dead_letter = 0         # abandoned after attempts/store-full
+        self.store_errors = 0        # store I/O faults (NOT deliveries)
+        self.last_error = ""
+        self.last_error_at = 0.0     # wall clock, status reporting
+        self.last_success_at = 0.0
+        self._hist = [0] * (len(DELIVERY_BUCKETS) + 1) + [0.0]
+
+    # -- the one method subclasses provide -----------------------------
+
+    def _deliver(self, record: dict) -> None:  # pragma: no cover - iface
+        raise NotImplementedError
+
+    # -- request-path entry --------------------------------------------
+
+    def send(self, record: Dict[str, Any]) -> None:
+        """Non-blocking enqueue; never raises into the caller (except
+        in sync mode, which exists for tests only)."""
+        if self._sync:
+            self._send_inline(record)
+            return
+        # closed-check + worker-start + enqueue are one atomic decision:
+        # a send racing close() must either land before the drain (the
+        # worker spills it) or be counted dropped — never sit uncounted
+        # in a queue nothing will ever empty
+        with self._mu:
+            if self._closed:
+                self.dropped += 1
+                return
+            self._ensure_worker_locked()
+            self._pending += 1
+            try:
+                self._q.put_nowait(record)
+                return
+            except queue.Full:
+                self._pending -= 1
+        # bounded spill straight to disk keeps the record; only a
+        # storeless (or store-full) target drops under overload
+        if not self._spill(record):
+            with self._mu:
+                self.dropped += 1
+
+    def _send_inline(self, record: Dict[str, Any]) -> None:
+        """Sync mode (tests + wire-conformance tiers): the pre-engine
+        StoreForwardTarget semantics — deliver now on the caller's
+        thread, store on failure, raise when there is nowhere to keep
+        the record."""
+        t0 = time.perf_counter()
+        try:
+            self._deliver(record)
+        except Exception as e:  # noqa: BLE001 — any failure is a miss
+            self._on_failure(e)
+            if self._spill(record):
+                return
+            if isinstance(e, self.ERROR_CLS):
+                raise
+            raise self.ERROR_CLS(str(e)) from e
+        self._observe(time.perf_counter() - t0)
+        self._on_success()
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self) -> None:
+        """Registration-time hook (EgressRegistry.register): a disk
+        backlog left by a previous process starts replaying without
+        waiting for new traffic to wake a sender.  Deliberately NOT
+        called from __init__ — the sender must not race a subclass
+        constructor still wiring its endpoint fields."""
+        if self.store is not None and len(self.store):
+            self._ensure_worker()
+
+    def _ensure_worker(self) -> None:
+        with self._mu:
+            self._ensure_worker_locked()
+
+    def _ensure_worker_locked(self) -> None:
+        if self._worker is not None or self._closed:
+            return
+        self._worker = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"mt-egress-{self.target_type}")
+        self._worker.start()
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the sender (sentinel + join); queued records spill to
+        the store when one exists so shutdown never silently loses a
+        store-backed record."""
+        with self._mu:
+            if self._closed:
+                return
+            self._closed = True
+            w = self._worker
+        if w is None:
+            return
+        try:
+            self._q.put_nowait(_CLOSE)
+        except queue.Full:
+            pass        # worker is draining; it checks _closed per loop
+        w.join(timeout=timeout)
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Best-effort wait for the in-memory queue (and the in-flight
+        record) to finish processing — delivered, spilled, or
+        dead-lettered."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._mu:
+                idle = self._pending == 0
+            if idle:
+                return
+            time.sleep(0.01)
+
+    # -- sender loop -----------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            if self._closed:
+                self._drain_close()
+                return
+            try:
+                timeout = self._idle_timeout()
+                try:
+                    item = self._q.get(timeout=timeout) \
+                        if timeout is not None else self._q.get()
+                except queue.Empty:
+                    item = None
+                if item is _CLOSE:
+                    self._drain_close()
+                    return
+                if item is not None:
+                    try:
+                        self._process(item)
+                    finally:
+                        with self._mu:
+                            self._pending -= 1
+                self._replay_ready()
+            except Exception as e:  # noqa: BLE001 — a store I/O surprise
+                # (deleted queue_dir, ENOSPC) must not silently kill the
+                # sender forever.  Delivery catches its own errors, so
+                # this only sees store/bookkeeping faults — counted and
+                # logged SEPARATELY, never fed into the delivery state
+                # machine (the endpoint may be perfectly healthy)
+                self._note_store_error(e)
+                time.sleep(0.25)
+
+    def _idle_timeout(self) -> float | None:
+        """How long the worker may park on the queue: forever when
+        online with an empty store; briefly when a probe window or a
+        replay backlog needs servicing without new traffic."""
+        with self._mu:
+            state = self._state
+            opened = self._opened_at
+        backlog = self.store is not None and len(self.store) > 0
+        if state == ONLINE:
+            return 0.05 if backlog else None
+        remaining = self.cooldown_s - (self._clock() - opened)
+        if remaining <= 0 and not backlog:
+            # cooled down with nothing to replay: park — the next
+            # record to arrive is the half-open probe (an offline
+            # storeless target must not spin at the poll floor forever)
+            return None
+        return max(0.01, min(remaining, 0.25))
+
+    def _process(self, record: dict) -> None:
+        with self._deliver_mu:
+            if not self._may_attempt():
+                self._spill_or_dead_letter(record)
+                return
+            attempt = 0
+            while True:
+                if self._try_deliver(record):
+                    return
+                attempt += 1
+                with self._mu:
+                    still_online = self._state == ONLINE
+                    closing = self._closed
+                # a close() mid-retry bounds shutdown to the attempt in
+                # flight: the record spills NOW instead of burning the
+                # remaining attempts/backoffs past the close timeout
+                if closing or not still_online \
+                        or attempt >= self.max_attempts:
+                    break
+                self._policy.wait(attempt - 1)
+            self._spill_or_dead_letter(record)
+
+    def _may_attempt(self) -> bool:
+        """Online always; offline only once the cooldown elapsed — that
+        one admitted delivery IS the half-open probe.
+
+        Deliberately NOT parallel/rpc.py's CircuitBreaker: that one
+        latches its half-open probe because RPC callers race for it;
+        here ``_deliver_mu`` makes delivery single-flight already, and
+        a latch would wedge the machine whenever an admitted probe
+        reports nothing (e.g. a store drain that dead-letters every
+        corrupt record without a delivery attempt)."""
+        with self._mu:
+            if self._state == ONLINE:
+                return True
+            if self._clock() - self._opened_at >= self.cooldown_s:
+                self._state = PROBING
+                return True
+            return False
+
+    def _try_deliver(self, record: dict) -> bool:
+        t0 = time.perf_counter()
+        try:
+            self._deliver(record)
+        except Exception as e:  # noqa: BLE001 — any failure is a miss
+            self._on_failure(e)
+            return False
+        self._observe(time.perf_counter() - t0)
+        self._on_success()
+        return True
+
+    def _on_failure(self, e: Exception) -> None:
+        with self._mu:
+            self.failed += 1
+            self._consecutive += 1
+            self.last_error = f"{type(e).__name__}: {e}"
+            self.last_error_at = time.time()
+            went_offline = False
+            if self._state == PROBING or (
+                    self._state == ONLINE
+                    and self._consecutive >= self.offline_after):
+                went_offline = self._state == ONLINE
+                self._state = OFFLINE
+                self._opened_at = self._clock()
+        if went_offline:
+            self._log_transition(offline=True)
+
+    def _on_success(self) -> None:
+        with self._mu:
+            self.sent += 1
+            self._consecutive = 0
+            self.last_success_at = time.time()
+            recovered = self._state != ONLINE
+            self._state = ONLINE
+        if recovered:
+            self._log_transition(offline=False)
+
+    def _observe(self, seconds: float) -> None:
+        with self._mu:
+            for i, ub in enumerate(DELIVERY_BUCKETS):
+                if seconds <= ub:
+                    self._hist[i] += 1
+            self._hist[len(DELIVERY_BUCKETS)] += 1
+            self._hist[-1] += seconds
+
+    def _spill(self, record: dict) -> bool:
+        """Persist a record to the disk store; True when it got there.
+        A full store is the expected dead-letter path; any OTHER put
+        failure is a store I/O fault — counted and logged so a climbing
+        dead-letter count is diagnosable (overflow vs broken store)."""
+        if self.store is None:
+            return False
+        try:
+            self.store.put(record)
+            return True
+        except QueueStoreFull:
+            return False
+        except Exception as e:  # noqa: BLE001 — unwritable store
+            self._note_store_error(e)
+            return False
+
+    def _note_store_error(self, e: Exception) -> None:
+        with self._mu:
+            self.store_errors += 1
+            self.last_error = f"{type(e).__name__}: {e}"
+            self.last_error_at = time.time()
+        self._log_once("ERROR",
+                       f"egress target {self.target_type}/{self.name} "
+                       f"store error: {e}",
+                       f"egress-store-{self.target_type}-{self.name}")
+
+    def _spill_or_dead_letter(self, record: dict) -> None:
+        """A record that exhausted its attempts (or arrived offline):
+        keep it in the store, else dead-letter it — counted, never
+        blocking, never raised."""
+        if not self._spill(record):
+            with self._mu:
+                self.dead_letter += 1
+
+    # -- replay -----------------------------------------------------------
+
+    def _replay_ready(self) -> None:
+        """Background replay: drain the store while deliveries succeed.
+        When offline, the first attempt is the half-open probe; fresh
+        queue traffic preempts the drain (the store resumes next
+        round)."""
+        if self.store is None or self._closed:
+            return
+        if not len(self.store):
+            return
+        with self._deliver_mu:
+            if not self._may_attempt():
+                return
+            self._drain_store(preempt_on_traffic=True)
+
+    def replay(self) -> int:
+        """Synchronous drain of the disk store (the admin
+        ``targets/replay`` action and tests); returns how many records
+        got through, stopping at the first failure."""
+        if self.store is None:
+            return 0
+        with self._deliver_mu:
+            return self._drain_store(preempt_on_traffic=False)
+
+    def _drain_store(self, preempt_on_traffic: bool) -> int:
+        """Deliver stored records in order until one fails; corrupt
+        entries dead-letter.  Caller holds ``_deliver_mu`` (the listing
+        must not race another drain).  With ``preempt_on_traffic``,
+        fresh queue records interrupt the drain — live telemetry beats
+        backlog; the store resumes next round."""
+        n = 0
+        for key in self.store.list():
+            try:
+                rec = self.store.get(key)
+            except Exception:  # noqa: BLE001 — corrupt store entry
+                self.store.delete(key)
+                with self._mu:
+                    self.dead_letter += 1
+                continue
+            if not self._try_deliver(rec):
+                break
+            self.store.delete(key)
+            n += 1
+            if preempt_on_traffic and self._q.qsize():
+                break
+        return n
+
+    def _drain_close(self) -> None:
+        """Shutdown drain: move queued records to the store (counted
+        dropped when there is none)."""
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if item is _CLOSE:
+                continue
+            try:
+                if not self._spill(item):
+                    with self._mu:
+                        self.dropped += 1
+            finally:
+                with self._mu:
+                    self._pending -= 1
+
+    # -- introspection ----------------------------------------------------
+
+    def _log_once(self, level: str, message: str, key: str) -> None:
+        log = self._log
+        if log is None:
+            from .logger import GLOBAL as _lg
+            log = _lg.log_once
+        try:
+            log(level, message, dedup_key=key)
+        except Exception:  # noqa: BLE001 — logging never breaks delivery
+            pass
+
+    def _log_transition(self, offline: bool) -> None:
+        ident = f"{self.target_type}/{self.name}"
+        if offline:
+            self._log_once("ERROR",
+                           f"egress target {ident} is offline: "
+                           f"{self.last_error}",
+                           f"egress-offline-{ident}")
+        else:
+            self._log_once("INFO",
+                           f"egress target {ident} is back online",
+                           f"egress-online-{ident}")
+
+    @property
+    def online(self) -> bool:
+        with self._mu:
+            return self._state == ONLINE
+
+    @property
+    def state(self) -> str:
+        with self._mu:
+            return self._state
+
+    def delivery_hist(self) -> tuple:
+        """(buckets, cumulative counts + count, sum) for the scrape."""
+        with self._mu:
+            return DELIVERY_BUCKETS, list(self._hist[:-1]), self._hist[-1]
+
+    def status(self) -> Dict[str, Any]:
+        """One row of the admin ``targets`` route (`mc admin info`
+        target-status analog)."""
+
+        def iso(ts: float) -> str:
+            if not ts:
+                return ""
+            return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
+
+        with self._mu:
+            return {
+                "type": self.target_type,
+                "target": self.name,
+                "state": self._state,
+                "online": self._state == ONLINE,
+                "queued": self._q.qsize(),
+                "stored": len(self.store) if self.store is not None else 0,
+                "sent": self.sent,
+                "failed": self.failed,
+                "dropped": self.dropped,
+                "deadLettered": self.dead_letter,
+                "storeErrors": self.store_errors,
+                "lastError": self.last_error,
+                "lastErrorTime": iso(self.last_error_at),
+                "lastSuccessTime": iso(self.last_success_at),
+            }
+
+
+class EgressRegistry:
+    """The server's directory of live delivery targets — what the
+    scrape exports and the admin ``targets``/``targets/replay`` routes
+    walk.  Empty registry ⇒ zero egress cost and zero ``mt_target_*``
+    families (the idle contract)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._targets: Dict[tuple, DeliveryTarget] = {}
+
+    def register(self, target: DeliveryTarget) -> DeliveryTarget:
+        with self._mu:
+            self._targets[(target.target_type, target.name)] = target
+        target.start()      # boot-time disk backlog replays immediately
+        return target
+
+    def remove(self, target: DeliveryTarget) -> None:
+        with self._mu:
+            self._targets.pop((target.target_type, target.name), None)
+
+    def targets(self) -> List[DeliveryTarget]:
+        with self._mu:
+            return [self._targets[k] for k in sorted(self._targets)]
+
+    def status(self) -> List[Dict[str, Any]]:
+        return [t.status() for t in self.targets()]
+
+    def replay_all(self) -> Dict[str, int]:
+        """Kick a synchronous replay on every store-backed target;
+        {"type/name": records delivered}."""
+        return {f"{t.target_type}/{t.name}": t.replay()
+                for t in self.targets() if t.store is not None}
+
+    def close_all(self) -> None:
+        for t in self.targets():
+            try:
+                t.close()
+            except Exception:  # noqa: BLE001 — shutdown must proceed
+                pass

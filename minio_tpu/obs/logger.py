@@ -8,16 +8,19 @@ A process-global :class:`Logger` fans structured entries out to targets:
   stream (cmd/consolelogger.go keeps the last N entries and doubles as a
   pub/sub for live log streaming);
 * HTTP webhook targets (cmd/logger/target/http) delivering each entry as
-  one JSON document.
+  one JSON document over the store-and-forward egress engine
+  (obs/egress.py): bounded queue, optional disk store, backoff, and the
+  online/offline/probing state machine.
 
 ``log_once`` deduplicates repeated errors per (message, dedup-key), the
-way cmd/logger/logonce.go rate-limits identical drive errors.
+way cmd/logger/logonce.go rate-limits identical drive errors — and like
+logonce.go it FORGETS: expired dedup entries are swept so the map stays
+bounded no matter how many distinct keys a long-lived process sees.
 """
 
 from __future__ import annotations
 
 import json
-import queue
 import sys
 import threading
 import time
@@ -26,6 +29,7 @@ from collections import deque
 from typing import Any, Dict, List
 
 from ..utils.pubsub import PubSub
+from .egress import DeliveryTarget
 
 FATAL = "FATAL"
 ERROR = "ERROR"
@@ -33,26 +37,22 @@ WARNING = "WARNING"
 INFO = "INFO"
 
 
-class HTTPLogTarget:
-    """cmd/logger/target/http: entries go into a bounded in-memory queue
-    drained by one background sender thread (the reference buffers 10000
-    entries in a channel); a full queue or failed POST drops the entry —
-    log/audit delivery must never add latency to the request path."""
-
-    QUEUE_SIZE = 10000
+class HTTPLogTarget(DeliveryTarget):
+    """cmd/logger/target/http on the shared egress engine: entries ride
+    a bounded in-memory queue drained by one background sender; failed
+    or offline-time entries spill to the optional disk store and replay
+    on recovery — log/audit delivery must never add latency to the
+    request path."""
 
     def __init__(self, endpoint: str, auth_token: str = "",
-                 timeout: float = 3.0, sync: bool = False):
+                 timeout: float = 3.0, sync: bool = False,
+                 target_type: str = "logger", **engine):
+        super().__init__(target_type, endpoint, sync=sync, **engine)
         self.endpoint = endpoint
         self.auth_token = auth_token
         self.timeout = timeout
-        self.dropped = 0
-        self._sync = sync            # tests: deliver inline
-        self._q: "queue.Queue[Dict[str, Any]]" = queue.Queue(
-            self.QUEUE_SIZE)
-        self._worker: threading.Thread | None = None
 
-    def _post(self, entry: Dict[str, Any]) -> None:
+    def _deliver(self, entry: Dict[str, Any]) -> None:
         req = urllib.request.Request(
             self.endpoint, data=json.dumps(entry).encode(),
             headers={"Content-Type": "application/json",
@@ -61,38 +61,15 @@ class HTTPLogTarget:
         with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             resp.read()
 
-    def _drain(self) -> None:
-        while True:
-            entry = self._q.get()
-            try:
-                self._post(entry)
-            except Exception:   # noqa: BLE001 — drop, never propagate
-                self.dropped += 1
-
-    def send(self, entry: Dict[str, Any]) -> None:
-        if self._sync:
-            self._post(entry)
-            return
-        if self._worker is None:
-            self._worker = threading.Thread(target=self._drain,
-                                            daemon=True)
-            self._worker.start()
-        try:
-            self._q.put_nowait(entry)
-        except queue.Full:
-            self.dropped += 1
-
-    def flush(self, timeout: float = 5.0) -> None:
-        """Best-effort wait for the queue to empty (tests/shutdown)."""
-        import time as _time
-        deadline = _time.monotonic() + timeout
-        while not self._q.empty() and _time.monotonic() < deadline:
-            _time.sleep(0.01)
-        _time.sleep(0.05)   # let the in-flight POST (already dequeued)
-        # finish; flush is best-effort by contract
-
 
 class Logger:
+    # log_once dedup map bound: a sweep runs when the map outgrows this
+    # (or on the periodic timer), dropping entries whose interval has
+    # already lapsed — they would emit again anyway, so forgetting them
+    # is semantically free (cmd/logger/logonce.go periodic forget)
+    ONCE_MAX = 1024
+    ONCE_SWEEP_S = 300.0
+
     def __init__(self, node_name: str = "", ring_size: int = 1000,
                  json_console: bool = False, quiet: bool = False):
         self.node_name = node_name
@@ -101,7 +78,12 @@ class Logger:
         self.ring: deque = deque(maxlen=ring_size)
         self.pubsub = PubSub(max_queue=2000)   # live `mc admin logs` stream
         self.targets: List[HTTPLogTarget] = []
-        self._once: Dict[str, float] = {}
+        # dedup key -> (last emit, interval); injectable clock so tests
+        # drive expiry without sleeping
+        self._once: Dict[str, tuple] = {}
+        self._once_sweep_at = 0.0
+        self._once_sweep_size = self.ONCE_MAX
+        self._clock = time.monotonic
         self._mu = threading.Lock()
 
     # -- emit ----------------------------------------------------------
@@ -148,14 +130,30 @@ class Logger:
         """Emit unless the same (key) fired within interval_s
         (cmd/logger/logonce.go).  Returns True when emitted."""
         key = dedup_key or message
-        now = time.monotonic()
+        now = self._clock()
         with self._mu:
-            last = self._once.get(key, 0.0)
-            if now - last < interval_s:
+            ent = self._once.get(key)
+            if ent is not None and now - ent[0] < ent[1]:
                 return False
-            self._once[key] = now
+            self._once[key] = (now, interval_s)
+            self._sweep_once(now)
         self.log(level, message, **kv)
         return True
+
+    def _sweep_once(self, now: float) -> None:
+        """Forget expired dedup entries (size- or time-triggered) so
+        ``_once`` never grows one entry per distinct key forever.
+        Caller holds ``_mu``.  The size trigger re-arms at 2x whatever
+        survived the sweep: a map of mostly-LIVE keys cannot re-fire an
+        O(n) rebuild on every insert — the map stays within 2x the live
+        set, amortized O(1) per call."""
+        if len(self._once) < self._once_sweep_size \
+                and now < self._once_sweep_at:
+            return
+        self._once = {k: (t, iv) for k, (t, iv) in self._once.items()
+                      if now - t < iv}
+        self._once_sweep_at = now + self.ONCE_SWEEP_S
+        self._once_sweep_size = max(self.ONCE_MAX, 2 * len(self._once))
 
     # -- read back -----------------------------------------------------
 

@@ -136,9 +136,35 @@ def active() -> bool:
     return time.monotonic() < until
 
 
+# query parameters never to leak into traces/audit: presigned-URL
+# credentials (SigV4 X-Amz-Signature/X-Amz-Credential + the session
+# token, SigV2 Signature) are replayable until they expire — the same
+# contract as the header redaction above, applied to the query string
+_REDACTED_QUERY = {"x-amz-signature", "x-amz-credential",
+                   "x-amz-security-token", "signature"}
+
+
 def redact_headers(headers: Dict[str, str]) -> Dict[str, str]:
     return {k: ("*REDACTED*" if k.lower() in _REDACTED_HEADERS else v)
             for k, v in headers.items()}
+
+
+def redact_query(query: Dict[str, str]) -> Dict[str, str]:
+    return {k: ("*REDACTED*" if k.lower() in _REDACTED_QUERY else v)
+            for k, v in query.items()}
+
+
+def redact_query_string(raw: str) -> str:
+    """``k=v&k=v`` form of :func:`redact_query` (trace rawQuery)."""
+    if not raw:
+        return raw
+    out = []
+    for kv in raw.split("&"):
+        k, sep, v = kv.partition("=")
+        if sep and k.lower() in _REDACTED_QUERY:
+            v = "*REDACTED*"
+        out.append(f"{k}{sep}{v}")
+    return "&".join(out)
 
 
 def make_trace(node_name: str, func_name: str, *, method: str, path: str,
@@ -159,7 +185,7 @@ def make_trace(node_name: str, func_name: str, *, method: str, path: str,
             "time": start_ns,
             "method": method,
             "path": path,
-            "rawQuery": raw_query,
+            "rawQuery": redact_query_string(raw_query),
             "client": client,
             "headers": redact_headers(req_headers),
         },

@@ -141,6 +141,16 @@ def register_peer_service(rpc: RPCServer, srv) -> None:
         out["node"] = srv.node_name
         return out
 
+    # telemetry-egress plane (admin `targets` / `targets/replay`
+    # aggregation): this node's delivery-target state machine rows, and
+    # the synchronous store replay kick (obs/egress.py)
+    def target_status():
+        return {"node": srv.node_name, "targets": srv.egress.status()}
+
+    def target_replay():
+        return {"node": srv.node_name,
+                "replayed": srv.egress.replay_all()}
+
     rpc.register("peer", {
         "reload_bucket_meta": reload_bucket_meta,
         "reload_iam": reload_iam,
@@ -156,6 +166,8 @@ def register_peer_service(rpc: RPCServer, srv) -> None:
         "speedtest_drive": speedtest_drive,
         "speedtest_tpu": speedtest_tpu,
         "background_status": background_status,
+        "target_status": target_status,
+        "target_replay": target_replay,
     })
 
 

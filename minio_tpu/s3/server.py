@@ -271,22 +271,8 @@ class S3Server:
         if self.etcd is not None:
             self.iam.attach_etcd(self.etcd,
                                  self.config.get("etcd", "path_prefix"))
-        from ..events import NotificationSys, WebhookTarget
+        from ..events import NotificationSys
         self.events = NotificationSys(self.bucket_meta, region=region)
-        if self.config.get("notify_webhook", "enable") == "on":
-            # config-driven target registration (cmd/config/notify): the
-            # ARN a PUT-notification config may reference
-            self.events.register_target(WebhookTarget(
-                "arn:minio:sqs::1:webhook",
-                self.config.get("notify_webhook", "endpoint"),
-                auth_token=self.config.get("notify_webhook", "auth_token"),
-                store_dir=self.config.get("notify_webhook", "queue_dir")
-                or None))
-        from ..events.brokers import BROKER_KINDS, target_from_config
-        for kind in BROKER_KINDS:
-            t = target_from_config(kind, self.config)
-            if t is not None:
-                self.events.register_target(t)
         # wired in by server_main / tests when those subsystems are enabled
         self.replication = None  # ReplicationSys (minio_tpu/background)
         self.usage = None        # data-usage cache (crawler)
@@ -325,14 +311,18 @@ class S3Server:
         # mt_s3_api_last_minute_* scrape families and the admin `top`
         # endpoint (hottest APIs)
         self.api_stats = _obs_lastminute.OpWindows(self.node_name)
-        if self.config.get("audit_webhook", "enable") == "on":
-            self.audit.targets.append(_obs_logger.HTTPLogTarget(
-                self.config.get("audit_webhook", "endpoint"),
-                self.config.get("audit_webhook", "auth_token")))
-        if self.config.get("logger_webhook", "enable") == "on":
-            self.logger.targets.append(_obs_logger.HTTPLogTarget(
-                self.config.get("logger_webhook", "endpoint"),
-                self.config.get("logger_webhook", "auth_token")))
+        # telemetry egress plane (obs/egress.py): every config-driven
+        # delivery target — logger/audit webhooks, the notify webhook,
+        # broker targets — lives in this registry so the scrape, the
+        # admin `targets` routes, and shutdown all see the same set
+        from ..obs.egress import EgressRegistry
+        self.egress = EgressRegistry()
+        self._egress_owned = []
+        # serializes reloads: two concurrent admin SetConfigKV calls
+        # must not both tear down / rebuild the same target set
+        # (duplicate registrations would leak unreachable senders)
+        self._egress_reload_mu = threading.Lock()
+        self.reload_egress_config()
         if self.config.get("compression", "enable") == "on":
             # build/load the native codec BEFORE serving so the first
             # request never blocks on a compile, and say which engine runs
@@ -418,6 +408,83 @@ class S3Server:
         except ValueError:
             self.body_min_rate_bps = 1 << 20
 
+    def reload_egress_config(self) -> None:
+        """(Re)build every config-driven egress target from the
+        ``logger_webhook`` / ``audit_webhook`` / ``notify_*`` kvconfig
+        subsystems — called at boot and after admin SetConfigKV so an
+        operator can repoint endpoints or retune queue knobs on a live
+        server.  Replaced targets are closed (their queued records
+        spill to their disk stores).  One bad subsystem config must not
+        take the others' telemetry down: each target builds under its
+        own guard, and a failure is logged and skipped."""
+        with self._egress_reload_mu:
+            self._reload_egress_locked()
+
+    def _reload_egress_locked(self) -> None:
+        from ..events import WebhookTarget
+        from ..events.brokers import BROKER_KINDS, target_from_config
+        from ..obs import logger as _obs_logger
+        from ..obs.egress import config_queue_limit
+        for t in getattr(self, "_egress_owned", []):
+            try:
+                if t in self.logger.targets:
+                    self.logger.targets.remove(t)
+                if t in self.audit.targets:
+                    self.audit.targets.remove(t)
+                if getattr(t, "arn", ""):
+                    self.events.remove_target(t.arn)
+                self.egress.remove(t)
+                t.close()
+            except Exception:  # noqa: BLE001 — a broken old target
+                pass           # must not block the reload
+        self._egress_owned = []
+        cfg = self.config
+
+        def _own(t):
+            self.egress.register(t)
+            self._egress_owned.append(t)
+            return t
+
+        for sub, sink in (("logger_webhook", self.logger.targets),
+                          ("audit_webhook", self.audit.targets)):
+            try:
+                if cfg.get(sub, "enable") != "on":
+                    continue
+                size = config_queue_limit(cfg, sub, "queue_size")
+                sink.append(_own(_obs_logger.HTTPLogTarget(
+                    cfg.get(sub, "endpoint"), cfg.get(sub, "auth_token"),
+                    target_type=sub.split("_", 1)[0],
+                    queue_limit=size, store_limit=size,
+                    store_dir=cfg.get(sub, "queue_dir") or None)))
+            except Exception as e:  # noqa: BLE001 — bad subsystem config
+                self.logger.error(f"egress: building {sub} target "
+                                  f"failed: {e}")
+        try:
+            if cfg.get("notify_webhook", "enable") == "on":
+                # config-driven target registration (cmd/config/notify):
+                # the ARN a PUT-notification config may reference
+                lim = config_queue_limit(cfg, "notify_webhook",
+                                         "queue_limit")
+                self.events.register_target(_own(WebhookTarget(
+                    "arn:minio:sqs::1:webhook",
+                    cfg.get("notify_webhook", "endpoint"),
+                    auth_token=cfg.get("notify_webhook", "auth_token"),
+                    store_dir=cfg.get("notify_webhook", "queue_dir")
+                    or None,
+                    queue_limit=lim, store_limit=lim)))
+        except Exception as e:  # noqa: BLE001 — bad subsystem config
+            self.logger.error(f"egress: building notify_webhook target "
+                              f"failed: {e}")
+        for kind in BROKER_KINDS:
+            try:
+                t = target_from_config(kind, cfg)
+            except Exception as e:  # noqa: BLE001 — bad subsystem config
+                self.logger.error(f"egress: building notify_{kind} "
+                                  f"target failed: {e}")
+                continue
+            if t is not None:
+                self.events.register_target(_own(t))
+
     def body_budget_s(self, content_length: int) -> float:
         """Read budget for one request body: the flat deadline plus
         declared-size / floor-rate headroom."""
@@ -473,6 +540,15 @@ class S3Server:
         sever_connections(conns)
         self.httpd.server_close()
         self.events.close()
+        # egress plane down WITH the server: sender threads join, queued
+        # records spill to their disk stores, and this server's targets
+        # leave the process-global logger so a later server (or test)
+        # never delivers through a dead target
+        for t in getattr(self, "_egress_owned", []):
+            if t in self.logger.targets:
+                self.logger.targets.remove(t)
+        if getattr(self, "egress", None) is not None:
+            self.egress.close_all()
         if self.peers is not None:
             self.peers.close()
 

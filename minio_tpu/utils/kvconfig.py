@@ -118,12 +118,21 @@ register_subsys("compression", {
     "extensions": ".txt,.log,.csv,.json,.tar,.xml,.bin",
     "mime_types": "text/*,application/json,application/xml",
 })
+# log/audit webhook egress (cmd/logger/target/http QueueSize/QueueDir):
+# queue_size bounds the in-memory sender queue, queue_dir enables the
+# disk store behind it (store-and-forward, obs/egress.py) — both
+# live-reloadable via admin SetConfigKV (reload_egress_config)
 register_subsys("logger_webhook", {"enable": "off", "endpoint": "",
-                                   "auth_token": ""})
+                                   "auth_token": "",
+                                   "queue_size": "10000",
+                                   "queue_dir": ""})
 register_subsys("audit_webhook", {"enable": "off", "endpoint": "",
-                                  "auth_token": ""})
+                                  "auth_token": "",
+                                  "queue_size": "10000",
+                                  "queue_dir": ""})
 register_subsys("notify_webhook", {"enable": "off", "endpoint": "",
-                                   "auth_token": "", "queue_dir": ""})
+                                   "auth_token": "", "queue_dir": "",
+                                   "queue_limit": "10000"})
 register_subsys("federation", {
     "enable": "off",
     "domain": "",                   # bucket.<domain> DNS zone
@@ -160,30 +169,39 @@ register_subsys("identity_openid", {
 # reference's per-target config structs
 register_subsys("notify_amqp", {"enable": "off", "url": "",
                                 "exchange": "", "routing_key": "",
-                                "queue_dir": ""})
+                                "queue_dir": "",
+                                "queue_limit": "10000"})
 register_subsys("notify_kafka", {"enable": "off", "brokers": "",
-                                 "topic": "", "queue_dir": ""})
+                                 "topic": "", "queue_dir": "",
+                                 "queue_limit": "10000"})
 register_subsys("notify_mqtt", {"enable": "off", "broker": "",
-                                "topic": "", "qos": "0", "queue_dir": ""})
+                                "topic": "", "qos": "0", "queue_dir": "",
+                                "queue_limit": "10000"})
 register_subsys("notify_nats", {"enable": "off", "address": "",
                                 "subject": "", "username": "",
-                                "password": "", "queue_dir": ""})
+                                "password": "", "queue_dir": "",
+                                "queue_limit": "10000"})
 register_subsys("notify_nsq", {"enable": "off", "nsqd_address": "",
-                               "topic": "", "queue_dir": ""})
+                               "topic": "", "queue_dir": "",
+                               "queue_limit": "10000"})
 register_subsys("notify_redis", {"enable": "off", "address": "",
                                  "key": "", "format": "namespace",
-                                 "password": "", "queue_dir": ""})
+                                 "password": "", "queue_dir": "",
+                                 "queue_limit": "10000"})
 register_subsys("notify_mysql", {"enable": "off", "dsn_string": "",
                                  "table": "", "format": "namespace",
-                                 "queue_dir": ""})
+                                 "queue_dir": "",
+                                 "queue_limit": "10000"})
 register_subsys("notify_postgresql", {"enable": "off",
                                       "connection_string": "",
                                       "table": "", "format": "namespace",
-                                      "queue_dir": ""})
+                                      "queue_dir": "",
+                                      "queue_limit": "10000"})
 register_subsys("notify_elasticsearch", {"enable": "off", "url": "",
                                          "index": "",
                                          "format": "namespace",
-                                         "queue_dir": ""})
+                                         "queue_dir": "",
+                                         "queue_limit": "10000"})
 
 
 class Config:

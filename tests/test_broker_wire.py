@@ -12,6 +12,23 @@ from minio_tpu.events.targets import TargetError
 from .broker_stubs import AMQPStubBroker, KafkaStubBroker
 
 
+@pytest.fixture(autouse=True)
+def _inline_delivery(monkeypatch):
+    """This file asserts WIRE conformance (frames, auth, payload
+    shapes); the asynchronous delivery pipeline is test_egress.py's
+    concern.  Targets here run in inline mode — deliver on the
+    caller's thread, store on failure, raise without a store — the
+    pre-engine StoreForwardTarget semantics."""
+    from minio_tpu.obs.egress import DeliveryTarget
+    orig = DeliveryTarget.__init__
+
+    def init(self, *args, **kw):
+        kw["sync"] = True
+        orig(self, *args, **kw)
+
+    monkeypatch.setattr(DeliveryTarget, "__init__", init)
+
+
 def _record(key="dir/file.bin", event="ObjectCreated:Put"):
     return {
         "eventVersion": "2.0", "eventSource": "minio:s3",

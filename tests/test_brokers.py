@@ -93,17 +93,29 @@ def test_invalid_formats_rejected():
         brokers.ElasticsearchTarget("a", "u", "i", fmt="bogus")
 
 
-def test_unreachable_broker_without_store_raises():
-    t = brokers.KafkaTarget("arn:t", ["127.0.0.1:1"], "events")
-    with pytest.raises(TargetError, match="kafka delivery failed"):
+def test_unreachable_broker_without_store_dead_letters():
+    # no store: a record that exhausts its attempts is dead-lettered —
+    # counted, never raised into the request path (obs/egress.py)
+    t = brokers.KafkaTarget("arn:t", ["127.0.0.1:1"], "events",
+                            max_attempts=1, offline_after=1)
+    try:
         t.send(RECORD)
+        t.flush()
+        assert t.dead_letter == 1
+        assert "kafka delivery failed" in t.last_error
+        assert not t.online
+    finally:
+        t.close()
 
 
 def test_store_and_forward_queue_and_replay(tmp_path, monkeypatch):
     t = brokers.NATSTarget("arn:t", "nats://h:4222", "subj",
-                           store_dir=str(tmp_path / "q"))
+                           store_dir=str(tmp_path / "q"),
+                           max_attempts=1, offline_after=1,
+                           cooldown_s=60.0)
     t.send(RECORD)
     t.send(DELETE_RECORD)
+    t.flush()
     assert len(t.store) == 2                # queued while broker is gone
     assert t.replay() == 0                  # still gone: nothing drains
 
@@ -113,6 +125,7 @@ def test_store_and_forward_queue_and_replay(tmp_path, monkeypatch):
     assert len(t.store) == 0
     assert delivered[0]["eventName"] == "ObjectCreated:Put"
     assert delivered[1]["eventName"] == "ObjectRemoved:Delete"
+    t.close()
 
 
 def test_target_from_config(tmp_path, monkeypatch):

@@ -1,0 +1,492 @@
+"""Telemetry egress plane (obs/egress.py): the store-and-forward
+delivery engine's state machine and accounting, the network outage
+drill (FaultyProxy blackhole: log/audit/event records spill to the
+bounded disk store, the scrape reports the backlog and offline state,
+background replay drains everything on recovery), and the
+peer-aggregated admin ``targets`` / ``targets/replay`` routes.
+
+Reference tier: cmd/logger/target/http buffering +
+pkg/event/target/queuestore.go + `mc admin info` target status.
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from minio_tpu.events import WebhookTarget
+from minio_tpu.obs import egress
+from minio_tpu.obs.logger import HTTPLogTarget
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.parallel.faulty import Fault, FaultyProxy
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl_storage import XLStorage
+
+from tests.test_metrics_exposition import parse_exposition
+
+S3NS = 'xmlns="http://s3.amazonaws.com/doc/2006-03-01/"'
+
+
+def _until(pred, timeout=10.0, step=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+# -- engine units ----------------------------------------------------------
+
+
+class _Probe(egress.DeliveryTarget):
+    """Engine test double: scriptable delivery outcome."""
+
+    def __init__(self, **kw):
+        kw.setdefault("sleep", lambda s: None)   # skip real backoffs
+        super().__init__("test", "t1", **kw)
+        self.ok = True
+        self.delivered = []
+        self.gate = None            # optional: block deliveries
+
+    def _deliver(self, rec):
+        if self.gate is not None:
+            self.gate.wait(5.0)
+        if not self.ok:
+            raise RuntimeError("endpoint down")
+        self.delivered.append(rec)
+
+
+def test_engine_delivers_counts_and_reports():
+    t = _Probe()
+    try:
+        t.send({"n": 1})
+        t.send({"n": 2})
+        t.flush()
+        assert t.delivered == [{"n": 1}, {"n": 2}]
+        st = t.status()
+        assert st["sent"] == 2 and st["failed"] == 0
+        assert st["online"] and st["state"] == "online"
+        assert st["queued"] == 0 and st["stored"] == 0
+        assert st["lastSuccessTime"]
+        buckets, counts, total = t.delivery_hist()
+        assert counts[len(buckets)] == 2        # +Inf == _count
+        assert total >= 0.0
+    finally:
+        t.close()
+
+
+def test_offline_spill_probe_and_auto_replay(tmp_path):
+    transitions = []
+
+    def log_once(level, msg, dedup_key="", interval_s=30.0, **kv):
+        transitions.append((level, msg))
+        return True
+
+    t = _Probe(store_dir=str(tmp_path / "q"), max_attempts=1,
+               offline_after=2, cooldown_s=0.05, log=log_once)
+    try:
+        t.ok = False
+        for i in range(3):
+            t.send({"n": i})
+        t.flush()
+        # two failed attempts opened the circuit; the third record went
+        # straight to the store without touching the "network"
+        assert not t.online
+        assert len(t.store) == 3
+        assert t.failed >= 2
+        assert t.dead_letter == 0
+        assert any("offline" in m for _, m in transitions)
+        # recovery: the half-open probe (a stored record) succeeds and
+        # background replay drains the store — no new traffic needed
+        t.ok = True
+        assert _until(lambda: len(t.store) == 0 and t.online)
+        assert sorted(r["n"] for r in t.delivered) == [0, 1, 2]
+        assert any("back online" in m for _, m in transitions)
+    finally:
+        t.close()
+
+
+def test_failed_probe_reopens_with_single_attempt(tmp_path):
+    t = _Probe(store_dir=str(tmp_path / "q"), max_attempts=3,
+               offline_after=1, cooldown_s=0.05)
+    try:
+        t.ok = False
+        t.send({"n": 0})
+        t.flush()
+        assert not t.online
+        failures_before = t.failed
+        # cooldown elapses; the worker's next pass probes with ONE
+        # attempt (not a full retry burst) and re-opens on failure
+        assert _until(lambda: t.failed > failures_before)
+        time.sleep(0.1)
+        assert not t.online
+        assert len(t.store) == 1
+    finally:
+        t.close()
+
+
+def test_dead_letter_without_store():
+    t = _Probe(max_attempts=2, offline_after=10)
+    try:
+        t.ok = False
+        t.send({"n": 0})
+        t.flush()
+        assert t.dead_letter == 1
+        assert t.failed == 2            # both attempts counted
+        assert "endpoint down" in t.last_error
+    finally:
+        t.close()
+
+
+def test_dead_letter_on_store_full(tmp_path):
+    t = _Probe(store_dir=str(tmp_path / "q"), store_limit=1,
+               max_attempts=1, offline_after=1, cooldown_s=60.0)
+    try:
+        t.ok = False
+        t.send({"n": 0})
+        t.send({"n": 1})
+        t.flush()
+        assert len(t.store) == 1
+        assert t.dead_letter == 1
+    finally:
+        t.close()
+
+
+def test_queue_overflow_without_store_drops():
+    t = _Probe(queue_limit=1)
+    t.gate = threading.Event()
+    started = threading.Event()
+    orig = t._deliver
+
+    def deliver(rec):
+        started.set()
+        orig(rec)
+
+    t._deliver = deliver
+    try:
+        t.send({"n": 0})
+        assert started.wait(5.0)        # worker holds record 0 in-flight
+        t.send({"n": 1})                # fills the 1-slot queue
+        t.send({"n": 2})                # overflow: counted drop
+        assert t.dropped == 1
+    finally:
+        t.gate.set()
+        t.flush()
+        t.close()
+    assert [r["n"] for r in t.delivered] == [0, 1]
+
+
+def test_close_spills_queued_records_to_store(tmp_path):
+    t = _Probe(store_dir=str(tmp_path / "q"))
+    t.gate = threading.Event()
+    started = threading.Event()
+    orig = t._deliver
+
+    def deliver(rec):
+        started.set()
+        orig(rec)
+
+    t._deliver = deliver
+    t.send({"n": 0})
+    assert started.wait(5.0)
+    t.send({"n": 1})
+    t.send({"n": 2})
+    closer = threading.Thread(target=t.close, daemon=True)
+    closer.start()
+    t.gate.set()
+    closer.join(timeout=5.0)
+    # the in-flight record finished; the queued ones went to the store
+    # instead of vanishing with the thread
+    assert [r["n"] for r in t.delivered] == [0]
+    assert len(t.store) == 2
+    # a closed target never blocks a caller — the record is counted
+    t.send({"n": 3})
+    assert t.dropped == 1
+
+
+def test_boot_time_backlog_replays_without_new_traffic(tmp_path):
+    store = egress.QueueStore(str(tmp_path / "q"))
+    store.put({"n": 41})
+    store.put({"n": 42})
+    t = _Probe(store_dir=str(tmp_path / "q"), cooldown_s=0.05)
+    egress.EgressRegistry().register(t)     # registration starts replay
+    try:
+        assert _until(lambda: len(t.store) == 0)
+        assert sorted(r["n"] for r in t.delivered) == [41, 42]
+    finally:
+        t.close()
+
+
+# -- the outage drill over a real server -----------------------------------
+
+
+class _Sink(BaseHTTPRequestHandler):
+    received: list = []
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length") or 0)
+        type(self).received.append(json.loads(self.rfile.read(n)))
+        self.send_response(200)
+        self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture
+def sink():
+    class Sink(_Sink):
+        received = []
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Sink)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield Sink, httpd.server_address[1]
+    httpd.shutdown()
+    httpd.server_close()
+
+
+@pytest.fixture
+def served(tmp_path):
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"d{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                           backend="numpy")
+    srv = S3Server(layer, access_key="gk", secret_key="gs")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _scrape(srv) -> str:
+    import http.client
+    conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=10)
+    conn.request("GET", "/minio-tpu/metrics")
+    resp = conn.getresponse()
+    body = resp.read().decode()
+    conn.close()
+    assert resp.status == 200
+    return body
+
+
+def _notify_cfg(arn):
+    return (f'<NotificationConfiguration {S3NS}>'
+            f'<QueueConfiguration><Queue>{arn}</Queue>'
+            f'<Event>s3:ObjectCreated:*</Event>'
+            f'</QueueConfiguration></NotificationConfiguration>').encode()
+
+
+def test_outage_drill_spill_scrape_and_replay(tmp_path, sink, served):
+    """The acceptance drill: store-backed webhook targets behind a
+    blackholed proxy — telemetry spills to disk (requests unaffected),
+    the live scrape shows the backlog + offline state, the admin
+    ``targets`` route reports the transition, and recovery replays
+    every store-backed record."""
+    Sink, sink_port = sink
+    srv = served
+    proxy = FaultyProxy("127.0.0.1", sink_port).start()
+    url = f"http://127.0.0.1:{proxy.port}/hook"
+    knobs = dict(store_dir=None, timeout=0.5, max_attempts=1,
+                 offline_after=1, cooldown_s=0.25)
+    ev_t = WebhookTarget("arn:minio:sqs::drill:webhook", url,
+                         **{**knobs, "store_dir": str(tmp_path / "ev")})
+    log_t = HTTPLogTarget(url, target_type="logger",
+                          **{**knobs, "store_dir": str(tmp_path / "lg")})
+    au_t = HTTPLogTarget(url, target_type="audit",
+                         **{**knobs, "store_dir": str(tmp_path / "au")})
+    srv.events.register_target(ev_t)
+    srv.logger.targets.append(log_t)
+    srv.audit.targets.append(au_t)
+    for t in (ev_t, log_t, au_t):
+        srv.egress.register(t)
+    c = S3Client(srv.endpoint, "gk", "gs")
+    try:
+        c.make_bucket("drill")
+        c.request("PUT", "/drill", "notification", _notify_cfg(ev_t.arn))
+        # healthy leg: the pipe works end to end
+        c.put_object("drill", "warm.bin", b"w")
+        assert _until(lambda: any("EventName" in r
+                                  for r in Sink.received))
+        # ---- outage: TCP accepts, nothing ever answers ----
+        proxy.set_default(Fault.blackhole())
+        t0 = time.monotonic()
+        for i in range(5):
+            c.put_object("drill", f"o{i}.bin", b"x" * 1024)
+        srv.logger.error("drill log entry one")
+        srv.logger.error("drill log entry two")
+        # the request path never waited on the dead endpoint (5 PUTs
+        # against a 0.5 s-per-POST blackhole would cost seconds if
+        # delivery were inline)
+        assert time.monotonic() - t0 < 3.0
+        assert _until(lambda: len(ev_t.store) >= 5 and not ev_t.online)
+        assert _until(lambda: len(log_t.store) >= 2 and len(au_t.store) >= 1)
+        # live scrape reflects the backlog and the offline state
+        types, samples = parse_exposition(_scrape(srv))
+        online = {(l["target_type"], l["target"]): v
+                  for n, l, v in samples if n == "mt_target_online"}
+        assert online[("notify", ev_t.arn)] == 0
+        stored = {l["target_type"]: v for n, l, v in samples
+                  if n == "mt_target_store_length"}
+        assert stored["notify"] >= 5
+        assert any(n == "mt_target_queue_length" for n, _, _ in samples)
+        # admin route reports the state machine
+        doc = json.loads(c.request(
+            "GET", "/minio-tpu/admin/v1/targets").body)
+        rows = {(r["type"], r["target"]): r for r in doc["targets"]}
+        # the query may land mid-probe: an in-flight half-open probe
+        # reports "probing" — either way the target is not online
+        assert not rows[("notify", ev_t.arn)]["online"]
+        assert rows[("notify", ev_t.arn)]["state"] in ("offline",
+                                                       "probing")
+        assert rows[("notify", ev_t.arn)]["lastError"]
+        ev_stored = len(ev_t.store)
+        # ---- recovery: heal the proxy; background replay drains ----
+        proxy.set_default(None)
+        assert _until(lambda: len(ev_t.store) == 0 and ev_t.online,
+                      timeout=15.0)
+        assert _until(lambda: len(log_t.store) == 0 and
+                      len(au_t.store) == 0, timeout=15.0)
+        # received-count equality: every store-backed event record got
+        # through exactly once (warm + 5 outage PUTs)
+        assert _until(lambda: sum(
+            1 for r in Sink.received if "EventName" in r) == 6)
+        assert ev_stored == 5
+        doc = json.loads(c.request(
+            "GET", "/minio-tpu/admin/v1/targets").body)
+        rows = {(r["type"], r["target"]): r for r in doc["targets"]}
+        assert rows[("notify", ev_t.arn)]["online"]
+        assert rows[("notify", ev_t.arn)]["lastSuccessTime"]
+        # the replay action is idempotent once drained
+        doc = json.loads(c.request(
+            "POST", "/minio-tpu/admin/v1/targets/replay").body)
+        assert doc["replayed"] == {f"notify/{ev_t.arn}": 0,
+                                   f"logger/{url}": 0,
+                                   f"audit/{url}": 0}
+    finally:
+        if log_t in srv.logger.targets:
+            srv.logger.targets.remove(log_t)
+        if au_t in srv.audit.targets:
+            srv.audit.targets.remove(au_t)
+        for t in (ev_t, log_t, au_t):
+            srv.egress.remove(t)
+            t.close()
+        proxy.stop()
+
+
+def test_admin_replay_action_drains_store(tmp_path, sink, served):
+    """targets/replay kicks a synchronous drain: records stored while
+    the endpoint was down deliver on demand, without waiting for the
+    background probe."""
+    Sink, sink_port = sink
+    srv = served
+    url = f"http://127.0.0.1:{sink_port}/hook"
+    # cooldown far in the future: only the admin action may drain
+    t = HTTPLogTarget(url, target_type="logger", timeout=2.0,
+                      store_dir=str(tmp_path / "q"), max_attempts=1,
+                      offline_after=1, cooldown_s=600.0)
+    srv.egress.register(t)
+    try:
+        t.store.put({"level": "ERROR", "message": "stored-while-down"})
+        with t._mu:     # simulate a target parked offline mid-cooldown
+            t._state = egress.OFFLINE
+            t._opened_at = t._clock()
+        c = S3Client(srv.endpoint, "gk", "gs")
+        doc = json.loads(c.request(
+            "POST", "/minio-tpu/admin/v1/targets/replay").body)
+        assert doc["replayed"] == {f"logger/{url}": 1}
+        assert len(t.store) == 0
+        assert t.online
+        assert any(r.get("message") == "stored-while-down"
+                   for r in Sink.received)
+    finally:
+        srv.egress.remove(t)
+        t.close()
+
+
+def test_config_reload_rebuilds_targets(tmp_path, sink, served):
+    """SetConfigKV on an egress subsystem rebuilds the targets live:
+    enable wires a store-backed webhook in, disable closes it and the
+    scrape goes back to zero mt_target_* families."""
+    Sink, sink_port = sink
+    srv = served
+    c = S3Client(srv.endpoint, "gk", "gs")
+    url = f"http://127.0.0.1:{sink_port}/log"
+    assert srv.egress.targets() == []
+    c.request("PUT", "/minio-tpu/admin/v1/config/logger_webhook/endpoint",
+              body=url.encode())
+    c.request("PUT",
+              "/minio-tpu/admin/v1/config/logger_webhook/queue_dir",
+              body=str(tmp_path / "q").encode())
+    c.request("PUT", "/minio-tpu/admin/v1/config/logger_webhook/enable",
+              body=b"on")
+    targets = srv.egress.targets()
+    assert [t.target_type for t in targets] == ["logger"]
+    assert targets[0].store is not None
+    srv.logger.error("after enable")
+    assert _until(lambda: any(
+        r.get("message") == "after enable" for r in Sink.received))
+    assert "mt_target_sent_total" in _scrape(srv)
+    c.request("PUT", "/minio-tpu/admin/v1/config/logger_webhook/enable",
+              body=b"off")
+    assert srv.egress.targets() == []
+    assert targets[0] not in srv.logger.targets
+    assert "mt_target_" not in _scrape(srv)
+
+
+# -- cluster: peer-aggregated target status --------------------------------
+
+
+def test_targets_route_aggregates_peers(tmp_path, sink):
+    from minio_tpu.parallel.peer import PeerNotifier, register_peer_service
+    from minio_tpu.parallel.rpc import RPCClient, RPCServer
+    for i in range(4):
+        (tmp_path / f"d{i}").mkdir()
+
+    def mk_node():
+        disks = [XLStorage(str(tmp_path / f"d{i}")) for i in range(4)]
+        layer = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                               backend="numpy")
+        return S3Server(layer, access_key="ck", secret_key="cs")
+
+    Sink, sink_port = sink
+    node_a, node_b = mk_node(), mk_node()
+    node_a.start()
+    node_b.start()
+    rpc_b = RPCServer("egress-peer-secret")
+    register_peer_service(rpc_b, node_b)
+    rpc_b.start()
+    node_a.attach_peers(PeerNotifier(
+        [RPCClient(rpc_b.endpoint, "egress-peer-secret")]))
+    url = f"http://127.0.0.1:{sink_port}/hook"
+    t_b = HTTPLogTarget(url, target_type="logger",
+                        store_dir=str(tmp_path / "bq"))
+    node_b.egress.register(t_b)
+    try:
+        t_b.store.put({"level": "INFO", "message": "peer-stored"})
+        c = S3Client(node_a.endpoint, "ck", "cs")
+        doc = json.loads(c.request(
+            "GET", "/minio-tpu/admin/v1/targets").body)
+        assert doc["targets"] == []             # nothing local on A
+        (peer,) = doc["peers"]
+        assert peer["node"] == node_b.node_name
+        (row,) = peer["targets"]
+        assert row["type"] == "logger" and row["target"] == url
+        assert row["stored"] == 1
+        # replay fans out over the same authed RPC
+        doc = json.loads(c.request(
+            "POST", "/minio-tpu/admin/v1/targets/replay").body)
+        (peer,) = doc["peers"]
+        assert peer["replayed"] == {f"logger/{url}": 1}
+        assert any(r.get("message") == "peer-stored"
+                   for r in Sink.received)
+    finally:
+        node_b.egress.remove(t_b)
+        t_b.close()
+        node_a.stop()
+        node_b.stop()
+        rpc_b.stop()

@@ -156,23 +156,35 @@ def test_unknown_arn_rejected(client, server):
 
 def test_webhook_delivery_and_store_forward(tmp_path, webhook_sink):
     Sink, url = webhook_sink
+    # long cooldown: the outage phase below must stay deterministic —
+    # no background half-open probe may race the explicit replay()
     tgt = WebhookTarget("arn:minio:sqs::wh:webhook", url,
-                        store_dir=str(tmp_path / "whq"))
+                        store_dir=str(tmp_path / "whq"),
+                        max_attempts=1, offline_after=1,
+                        cooldown_s=60.0)
     record = {"eventName": "ObjectCreated:Put",
               "s3": {"bucket": {"name": "b"}, "object": {"key": "k"}}}
-    tgt.send(record)
-    assert len(Sink.received) == 1
-    assert Sink.received[0]["EventName"] == "s3:ObjectCreated:Put"
-    assert Sink.received[0]["Key"] == "b/k"
-    # endpoint down: events persist, then replay
-    Sink.fail = True
-    tgt.send(record)
-    tgt.send(record)
-    assert len(tgt.store) == 2
-    Sink.fail = False
-    assert tgt.replay() == 2
-    assert len(tgt.store) == 0
-    assert len(Sink.received) == 3
+    try:
+        tgt.send(record)
+        tgt.flush()
+        assert len(Sink.received) == 1
+        assert Sink.received[0]["EventName"] == "s3:ObjectCreated:Put"
+        assert Sink.received[0]["Key"] == "b/k"
+        # endpoint down: the first failed attempt takes the target
+        # offline; both events persist to the disk store, then replay
+        Sink.fail = True
+        tgt.send(record)
+        tgt.send(record)
+        tgt.flush()
+        assert len(tgt.store) == 2
+        assert not tgt.online
+        Sink.fail = False
+        assert tgt.replay() == 2
+        assert len(tgt.store) == 0
+        assert len(Sink.received) == 3
+        assert tgt.online
+    finally:
+        tgt.close()
 
 
 def test_listen_notification_stream(client, server):

@@ -65,6 +65,54 @@ def test_server_start_stop_does_not_leak_threads(tmp_path):
             s.close()
 
 
+def test_egress_workers_stop_with_server(tmp_path, monkeypatch):
+    """Config-built egress targets (logger/audit webhooks) get close()d
+    on server stop: sender threads join and the process-global logger
+    no longer fans entries into the dead server's targets."""
+    # port 1 refuses instantly — failures are fast, records spill to
+    # the disk store, and the workers exist long enough to observe
+    monkeypatch.setenv("MT_LOGGER_WEBHOOK_ENABLE", "on")
+    monkeypatch.setenv("MT_LOGGER_WEBHOOK_ENDPOINT",
+                       "http://127.0.0.1:1/log")
+    monkeypatch.setenv("MT_LOGGER_WEBHOOK_QUEUE_DIR",
+                       str(tmp_path / "lq"))
+    monkeypatch.setenv("MT_AUDIT_WEBHOOK_ENABLE", "on")
+    monkeypatch.setenv("MT_AUDIT_WEBHOOK_ENDPOINT",
+                       "http://127.0.0.1:1/audit")
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"d{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                           backend="numpy")
+    srv = S3Server(layer, access_key="ek", secret_key="es")
+    srv.start()
+    owned = list(srv._egress_owned)
+    assert [t.target_type for t in owned] == ["logger", "audit"]
+    c = S3Client(srv.endpoint, "ek", "es")
+    c.make_bucket("egleak")             # audit entries flow
+    srv.logger.error("egress leak probe")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not any(
+            t.name.startswith("mt-egress")
+            for t in threading.enumerate()):
+        time.sleep(0.02)
+    assert any(t.name.startswith("mt-egress")
+               for t in threading.enumerate())
+    srv.stop()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline and any(
+            t.is_alive() and t.name.startswith("mt-egress")
+            for t in threading.enumerate()):
+        time.sleep(0.05)
+    leftover = [t.name for t in threading.enumerate()
+                if t.is_alive() and t.name.startswith("mt-egress")]
+    assert not leftover, leftover
+    from minio_tpu.obs.logger import GLOBAL as global_logger
+    assert not any(t in global_logger.targets for t in owned)
+
+
 def test_rpc_server_stop_closes_listener(tmp_path):
     from minio_tpu.parallel.rpc import RPCClient, RPCError, RPCServer
     srv = RPCServer("leaksecret")

@@ -143,6 +143,78 @@ def test_live_scrape_is_strictly_well_formed(served):
                for n, _, _ in samples)
 
 
+def test_zero_target_idle_contract(served):
+    """With no egress target configured there must be NO sender
+    threads, NO queue allocations, and NO ``mt_target_*`` family in
+    the scrape — the hot path stays free when egress is off."""
+    import threading
+
+    assert served.egress.targets() == []
+    assert not [t for t in threading.enumerate()
+                if t.is_alive() and t.name.startswith("mt-egress")]
+    text = _scrape(served)
+    assert "mt_target_" not in text
+
+
+def test_scrape_with_two_targets_stays_strict(served, tmp_path):
+    """≥2 configured targets: per-target labels on every family, ONE
+    # TYPE per family (incl. the delivery histogram), and the strict
+    checker stays green on the live scrape."""
+    import http.server
+    import json as _json
+    import threading
+
+    from minio_tpu.events import WebhookTarget
+    from minio_tpu.obs.logger import HTTPLogTarget
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            _json.loads(self.rfile.read(n))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}/hook"
+    arn = "arn:minio:sqs::exp:webhook"
+    t1 = HTTPLogTarget(url, target_type="logger",
+                       store_dir=str(tmp_path / "lq"))
+    t2 = WebhookTarget(arn, url, store_dir=str(tmp_path / "wq"))
+    served.egress.register(t1)
+    served.egress.register(t2)
+    try:
+        t1.send({"level": "INFO", "message": "exp"})
+        t1.flush()
+        t2.send({"eventName": "ObjectCreated:Put",
+                 "s3": {"bucket": {"name": "b"},
+                        "object": {"key": "k"}}})
+        t2.flush()
+        types, samples = parse_exposition(_scrape(served))
+        check_histograms(types, samples)
+        assert types["mt_target_delivery_seconds"] == "histogram"
+        assert types["mt_target_sent_total"] == "counter"
+        assert types["mt_target_online"] == "gauge"
+        sent = {(lb["target_type"], lb["target"]): v
+                for n, lb, v in samples if n == "mt_target_sent_total"}
+        assert sent == {("logger", url): 1.0, ("notify", arn): 1.0}
+        online = [v for n, _, v in samples if n == "mt_target_online"]
+        assert online == [1.0, 1.0]
+        counts = {lb["target_type"]: v for n, lb, v in samples
+                  if n == "mt_target_delivery_seconds_count"}
+        assert counts == {"logger": 1.0, "notify": 1.0}
+    finally:
+        served.egress.remove(t1)
+        served.egress.remove(t2)
+        t1.close()
+        t2.close()
+        httpd.shutdown()
+        httpd.server_close()
+
+
 def test_counter_values_keep_full_precision():
     """%g would quantize big byte counters to 6 significant digits —
     scrape deltas below the quantum would read as zero."""

@@ -192,6 +192,7 @@ def test_logger_once_and_webhook():
     assert not lg.log_once(logger.ERROR, "disk offline", dedup_key="d1")
     assert lg.log_once(logger.ERROR, "disk offline", dedup_key="d2")
     lg.targets[0].flush()
+    lg.targets[0].close()       # sender thread must not outlive the test
     httpd.shutdown()
     assert len(received) == 2
     assert received[0]["message"] == "disk offline"
@@ -224,10 +225,63 @@ def test_audit_webhook_delivery():
         query={}, req_headers={"Authorization": "secret"},
         resp_headers={}))
     alog.targets[0].flush()
+    alog.targets[0].close()     # sender thread must not outlive the test
     httpd.shutdown()
     assert received[0]["api"]["name"] == "GetObject"
     assert received[0]["deploymentid"] == "dep-1"
     assert received[0]["requestHeader"]["Authorization"] == "*REDACTED*"
+
+
+def test_log_once_dedup_map_stays_bounded():
+    """The log_once dedup map forgets expired entries (logonce.go
+    periodic sweep): a long-lived process seeing endlessly distinct
+    keys must not grow one map entry per key forever."""
+    lg = logger.Logger(quiet=True)
+    now = [0.0]
+    lg._clock = lambda: now[0]
+    for i in range(8192):
+        assert lg.log_once(logger.ERROR, "m", dedup_key=f"k{i}",
+                           interval_s=30.0)
+        now[0] += 1.0
+    assert len(lg._once) <= logger.Logger.ONCE_MAX
+    # live keys still deduplicate — forgetting only hits expired ones
+    assert not lg.log_once(logger.ERROR, "m", dedup_key="k8191",
+                           interval_s=30.0)
+    # and an expired key emits again
+    assert lg.log_once(logger.ERROR, "m", dedup_key="k0",
+                       interval_s=30.0)
+
+
+def test_presigned_credentials_redacted_from_trace_and_audit():
+    """X-Amz-Signature / X-Amz-Credential (any case) and the SigV2
+    Signature never leak into trace rawQuery or audit requestQuery —
+    a presigned URL is a replayable credential until it expires."""
+    from minio_tpu.obs import trace as obs_trace
+    info = obs_trace.make_trace(
+        "n1", "GetObject", method="GET", path="/b/o",
+        raw_query="X-Amz-Credential=AKIA%2F20260803&"
+                  "X-Amz-Signature=deadbeef&prefix=keep",
+        client="1.2.3.4", req_headers={}, status_code=200,
+        resp_headers={}, input_bytes=0, output_bytes=0,
+        start_ns=0, ttfb_ns=0, duration_ns=1)
+    rq = info["reqInfo"]["rawQuery"]
+    assert "deadbeef" not in rq and "AKIA" not in rq
+    assert "X-Amz-Signature=*REDACTED*" in rq
+    assert "prefix=keep" in rq
+    alog = obs_audit.AuditLog()
+    entry = alog.entry(
+        api_name="GetObject", bucket="b", obj="o", status_code=200,
+        rx=0, tx=0, duration_ns=1, remote_host="h", request_id="r",
+        user_agent="ua", access_key="ak",
+        query={"X-Amz-Signature": "s3cr3t",
+               "x-amz-credential": "cred",
+               "Signature": "v2sig", "prefix": "keep"},
+        req_headers={}, resp_headers={})
+    q = entry["requestQuery"]
+    assert q["X-Amz-Signature"] == "*REDACTED*"
+    assert q["x-amz-credential"] == "*REDACTED*"
+    assert q["Signature"] == "*REDACTED*"
+    assert q["prefix"] == "keep"
 
 
 def test_profiling_cycle(client):
