@@ -643,6 +643,73 @@ def _bench_end_to_end_put() -> dict | None:
 
         t_commit = stage(commit_only)
 
+        # ---- streaming-pipeline overlap (tmpfs, 4 MiB batches) ---------
+        # wall per batch, pipelined vs serial, against the stage table:
+        # perfect overlap drives per-batch wall to ~max(stage); serial
+        # is the sum.  overlap_efficiency = max(stage) / pipelined wall
+        # (1.0 = nothing but the slowest stage remains on the wall).
+        def put_pipeline_leg() -> dict | None:
+            if not (os.path.isdir("/dev/shm")
+                    and os.access("/dev/shm", os.W_OK)):
+                return None
+            import io
+
+            from minio_tpu.objectlayer import erasure_object as eo
+            prev_compat = os.environ.get("MT_NO_COMPAT")
+            prev_batch = eo.STREAM_BATCH_BYTES
+            shm_root = None
+            try:
+                os.environ["MT_NO_COMPAT"] = "0"      # strict md5 ETag
+                eo.STREAM_BATCH_BYTES = 4 * (1 << 20)
+                shm_root, lay = mk_layer("/dev/shm")
+                nbatch = 16
+                sbody = os.urandom(nbatch * 4 * (1 << 20))
+
+                def run(depth, tag):
+                    lay._pipe_depth = depth
+                    best = float("inf")
+                    for r in range(3):
+                        t0 = time.perf_counter()
+                        lay.put_object_stream(
+                            "benchbkt", f"pl-{tag}-{r}",
+                            io.BytesIO(sbody))
+                        best = min(best,
+                                   time.perf_counter() - t0)
+                        lay.delete_object("benchbkt", f"pl-{tag}-{r}")
+                    return best / nbatch * 1000.0      # ms per batch
+
+                run(0, "warm")                          # warm the path
+                serial_ms = run(0, "ser")
+                pipe_ms = run(2, "pipe")
+                enc = t_encode + t_hash
+                fanout = max(serial_ms - t_md5 - enc, 0.0)
+                max_stage = max(t_md5, enc, fanout)
+                return {
+                    "serial_wall_ms_per_batch": round(serial_ms, 2),
+                    "pipelined_wall_ms_per_batch": round(pipe_ms, 2),
+                    "pipelined_vs_serial": round(serial_ms / pipe_ms, 2)
+                    if pipe_ms > 0 else None,
+                    "max_stage_ms": round(max_stage, 2),
+                    "overlap_efficiency": round(max_stage / pipe_ms, 2)
+                    if pipe_ms > 0 else None,
+                    "layer_reported": {
+                        k: round(v, 4) if isinstance(v, float) else v
+                        for k, v in lay._pipe_stats.items()},
+                }
+            except Exception as e:  # noqa: BLE001 — optional leg
+                print(f"put-pipeline leg failed: {e!r}", file=sys.stderr)
+                return None
+            finally:
+                eo.STREAM_BATCH_BYTES = prev_batch
+                if prev_compat is None:
+                    os.environ.pop("MT_NO_COMPAT", None)
+                else:
+                    os.environ["MT_NO_COMPAT"] = prev_compat
+                if shm_root:
+                    shutil.rmtree(shm_root, ignore_errors=True)
+
+        pipeline_stats = put_pipeline_leg()
+
         # ---- throughput legs -------------------------------------------
         def run_leg(lay=None):
             lay = lay or layer
@@ -863,6 +930,10 @@ def _bench_end_to_end_put() -> dict | None:
                 "erasure_encode_into_frames": round(t_encode, 2),
                 "bitrot_hh256_fill": round(t_hash, 2),
                 "drive_fanout_commit": round(t_commit, 2),
+                # streaming-pipeline overlap: per-4MiB-batch wall with
+                # the writer plane on vs off, and how close the
+                # pipelined wall gets to the slowest single stage
+                "put_pipeline": pipeline_stats,
             },
         }
     except Exception as e:  # noqa: BLE001 — e2e leg must not sink the bench

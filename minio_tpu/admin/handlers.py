@@ -927,6 +927,10 @@ def _config(h, srv, route, q1, payload, send_json) -> bool:
             # retune the live request plane (deadlines, pool size,
             # shed queue) without a restart
             srv.reload_api_config()
+        if parts[1] == "pipeline":
+            # retune the PUT data plane (pipeline depth, per-drive
+            # writer queue depth) on the live layer
+            srv.reload_pipeline_config()
         if parts[1] in ("logger_webhook", "audit_webhook") \
                 or parts[1].startswith("notify_"):
             # rebuild the egress targets live: repointed endpoints and

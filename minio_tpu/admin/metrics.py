@@ -179,6 +179,10 @@ def render(layer=None, healer=None, config=None, api_stats=None,
             lines += _disk_lastminute_gauges(layer, config)
         except Exception:  # noqa: BLE001
             pass
+        try:
+            lines += _put_pipeline_gauges(layer)
+        except Exception:  # noqa: BLE001
+            pass
     if api_stats is not None:
         try:
             lines += _s3_lastminute_gauges(api_stats)
@@ -517,6 +521,51 @@ def _disk_lastminute_gauges(layer, config=None) -> list[str]:
                          f" {v['p50_ns']}")
             lines.append(f"mt_node_disk_slow{dl}"
                          f" {1 if v['slow'] else 0}")
+    return lines
+
+
+def _put_pipeline_gauges(layer) -> list[str]:
+    """Pipelined-PUT plane families (storage/writers.py): per-drive
+    writer queue depth, enqueue stalls and completed ops, plus the
+    last streaming PUT's overlap efficiency — critical-path seconds /
+    wall seconds, so 1.0 means the pipeline hid everything but the
+    slowest stage and ~max(stage)/sum(stages) means it degenerated to
+    serial.  Computed at scrape time from the live plane; a layer
+    whose plane never carried an op emits nothing (idle contract)."""
+    from ..objectlayer.metacache import leaf_layers_of
+    drives: list[tuple[str, dict]] = []
+    effs: list[tuple[int, dict]] = []
+    for si, leaf in enumerate(leaf_layers_of(layer)):
+        plane = getattr(leaf, "_write_plane", None)
+        if plane is None or not plane.used:
+            continue
+        drives += sorted(plane.stats().items())
+        ps = getattr(leaf, "_pipe_stats", None)
+        if ps and ps.get("wall_s"):
+            effs.append((si, ps))
+    lines: list[str] = []
+    if drives:
+        lines += ["# TYPE mt_put_pipeline_queue_depth gauge",
+                  "# TYPE mt_put_pipeline_enqueue_stalls_total counter",
+                  "# TYPE mt_put_pipeline_writes_total counter"]
+        for ep, st in drives:
+            lbl = _fmt_labels((("drive", ep),))
+            lines.append(f"mt_put_pipeline_queue_depth{lbl}"
+                         f" {st['queue_depth']}")
+            lines.append(f"mt_put_pipeline_enqueue_stalls_total{lbl}"
+                         f" {st['stalls']}")
+            lines.append(f"mt_put_pipeline_writes_total{lbl}"
+                         f" {st['ops']}")
+    if effs:
+        lines += ["# TYPE mt_put_pipeline_overlap_efficiency gauge",
+                  "# TYPE mt_put_pipeline_batch_wall_seconds gauge"]
+        for si, ps in effs:
+            lbl = _fmt_labels((("set", str(si)),))
+            lines.append(f"mt_put_pipeline_overlap_efficiency{lbl}"
+                         f" {_fmt_value(ps['overlap_efficiency'])}")
+            batches = max(1, ps.get("batches", 1))
+            lines.append(f"mt_put_pipeline_batch_wall_seconds{lbl}"
+                         f" {_fmt_value(ps['wall_s'] / batches)}")
     return lines
 
 

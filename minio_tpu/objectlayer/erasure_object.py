@@ -20,6 +20,7 @@ cmd/erasure-encode.go:36 parallelWriter) with quorum error reduction.
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import os
 import time
@@ -35,6 +36,8 @@ from ..ops import gf8
 from ..ops.codec import Erasure
 from ..storage import errors as serrors
 from ..storage.api import StorageAPI
+from ..storage.writers import WriterPlane
+from ..utils import bufpool
 from ..storage.datatypes import (ChecksumInfo, ErasureInfo, FileInfo,
                                  ObjectPartInfo, now_ns)
 from ..storage.xl_storage import SYS_DIR
@@ -199,6 +202,46 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         # also evicts and surfaces BucketNotFound (see _commit_put).
         self._bucket_ttl = 3.0
         self._buckets_seen: dict[str, float] = {}
+        # pipelined PUT data plane (storage/writers.py): one persistent
+        # writer thread per drive with a bounded in-order queue, shared
+        # by streaming PUT, the overlapped bytes commit, multipart part
+        # uploads and heal writes.  Knobs come from the ``pipeline``
+        # kvconfig subsystem (env-overridable at construction; the
+        # server re-reads them on admin SetConfigKV) and are consulted
+        # live — the queue bound is a callable into this layer.
+        self._pipe_depth = 2
+        self._pipe_queue_depth = 2
+        try:
+            from ..utils.kvconfig import Config as _KVConfig
+            self.reload_pipeline_config(_KVConfig())
+        except Exception:  # noqa: BLE001 — defaults above already set
+            self._pipe_depth = 0 if self._serial_fanout else 2
+        self._write_plane = WriterPlane(
+            queue_depth=lambda: self._pipe_queue_depth)
+        # last streaming PUT's overlap numbers (mt_put_pipeline_* scrape
+        # + bench.py's pipelined leg read these)
+        self._pipe_stats: dict = {}
+
+    def reload_pipeline_config(self, config) -> None:
+        """(Re)read the ``pipeline`` kvconfig knobs — at construction
+        (env > defaults) and from the server after admin SetConfigKV so
+        depth changes retune a live layer.  Single-core all-local hosts
+        keep the serial fan-out (same reasoning as _serial_fanout: the
+        threads only add churn there); tests force the pipeline by
+        assigning _pipe_depth directly."""
+        try:
+            depth = int(config.get("pipeline", "depth"))
+        except (KeyError, ValueError):
+            depth = 2
+        try:
+            qd = int(config.get("pipeline", "queue_depth"))
+        except (KeyError, ValueError):
+            qd = 2
+        self._pipe_depth = 0 if self._serial_fanout else max(0, depth)
+        self._pipe_queue_depth = max(1, qd)
+
+    def _pipeline_on(self) -> bool:
+        return self._pipe_depth > 0
 
     # -- drive fan-out helpers --------------------------------------------
 
@@ -358,23 +401,48 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             return self.put_object_stream(bucket, object_name, data, opts)
         data = bytes(data) if not isinstance(data, bytes) else data
         if len(data) > STREAM_BATCH_BYTES:
-            import io
-            return self.put_object_stream(bucket, object_name,
-                                          io.BytesIO(data), opts)
+            # zero-copy hand-off: feed the streaming pipeline memoryview
+            # slices of the body instead of re-buffering the whole
+            # object through io.BytesIO (one full-object copy saved)
+            batch = self._stream_batch_size()
+            mv = memoryview(data)
+            chunks = (mv[o:o + batch] for o in range(0, len(mv), batch))
+            return self._put_object_streaming(bucket, object_name,
+                                              chunks, opts,
+                                              readahead_body=False)
         return self._put_object_bytes(bucket, object_name, data, opts)
+
+    def _stream_batch_size(self) -> int:
+        """Whole-stripe stream batch (cmd/erasure-encode.go block loop,
+        widened for TPU batching): a multiple of block_size so framing
+        stays batch-invariant."""
+        return max(1, STREAM_BATCH_BYTES // self.block_size) \
+            * self.block_size
 
     def put_object_stream(self, bucket: str, object_name: str, reader,
                           opts: Optional[PutObjectOptions] = None
                           ) -> ObjectInfo:
         opts = opts or PutObjectOptions()
+        # fail BEFORE touching the body: without this a PUT to a dead
+        # bucket drains a full stream batch first (the re-check inside
+        # either branch below rides the TTL cache, so this costs one
+        # stat fan-out per TTL, not per PUT)
         self._check_bucket(bucket)
-        batch = max(1, STREAM_BATCH_BYTES // self.block_size) \
-            * self.block_size
+        batch = self._stream_batch_size()
         first = _read_full(reader, batch)
         if len(first) < batch:     # whole object fits one batch
             return self._put_object_bytes(bucket, object_name, first, opts)
-        return self._put_object_streaming(bucket, object_name, first,
-                                          reader, batch, opts)
+
+        def _chunks():
+            c = first
+            while c:
+                yield c
+                if len(c) < batch:
+                    return
+                c = _read_full(reader, batch)
+
+        return self._put_object_streaming(bucket, object_name, _chunks(),
+                                          opts, readahead_body=True)
 
     def _put_object_bytes(self, bucket: str, object_name: str, data: bytes,
                           opts: PutObjectOptions) -> ObjectInfo:
@@ -411,22 +479,104 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             fresh=True)
 
         framed = self._encode_and_frame(data, m, fi)
-        if etag_future is not None:
-            etag = etag_future.result().hexdigest()
-            if opts.content_md5 and etag != opts.content_md5.lower():
-                raise serrors.StorageError("Content-MD5 mismatch (BadDigest)")
-            fi.metadata = {ETAG_KEY: etag, **opts.user_defined}
-            fi.parts = [ObjectPartInfo(1, size, size, etag, mod_time)]
-
         inline = size <= self.inline_threshold
         shuffled = meta.shuffle_disks(self.disks, distribution)
         lk = self.ns_lock.new_lock(bucket, object_name)
         lk.lock(write=True)  # cmd/erasure-object.go:729-735 nsLock
         try:
+            if etag_future is not None and not inline \
+                    and self._pipeline_on():
+                # overlapped commit: the writer plane lands the part
+                # bytes in their final data dirs WHILE the md5 still
+                # runs; only the xl.meta version merge waits
+                # for the digest.  Without this the hash overlapped
+                # encode alone and the whole drive fan-out trailed it
+                # serially — the dominant serial residue of BENCH_r05.
+                return self._commit_put_overlapped(
+                    bucket, object_name, fi, framed, shuffled,
+                    etag_future, opts, mod_time, size)
+            if etag_future is not None:
+                self._stamp_etag(fi, etag_future.result(), opts, size,
+                                 mod_time)
             return self._commit_put(bucket, object_name, fi, framed, inline,
                                     shuffled)
         finally:
             lk.unlock()
+
+    def _commit_put_overlapped(self, bucket, object_name, fi, framed,
+                               shuffled, etag_future, opts, mod_time,
+                               size) -> ObjectInfo:
+        """Overlapped single-part commit: the usual one-call-per-drive
+        write_data_commit fan-out, but each drive writes its part bytes
+        FIRST and parks on an etag gate before the xl.meta merge — so
+        the md5's tail runs beside the whole drive fan-out instead of
+        serializing ahead of it (pkg/hash/reader.go overlap carried
+        through the commit).  A pool task resolves the gate the moment
+        the digest lands; by the time a drive finishes its part bytes
+        the gate is normally already open.  On BadDigest every gate
+        aborts before any version became visible and the orphan data
+        dirs are purged — the failed PUT leaves the same nothing the
+        serial path leaves."""
+        import threading as _threading
+        wq = self._write_quorum(fi)
+        gate = _threading.Event()
+        state: dict = {}
+        committed = False
+
+        def meta_gate() -> dict:
+            gate.wait()
+            vd = state.get("vdict")
+            if vd is None:          # digest failed: leave no version
+                raise serrors.StorageError("commit aborted (BadDigest)")
+            return vd
+
+        def resolve():
+            try:
+                self._stamp_etag(fi, etag_future.result(), opts, size,
+                                 mod_time)
+                state["vdict"] = fi.to_dict()
+            finally:
+                gate.set()
+
+        def write_one(idx_disk):
+            idx, disk = idx_disk
+            disk.write_data_commit(bucket, object_name, fi, framed[idx],
+                                   shard_index=idx + 1,
+                                   meta_gate=meta_gate)
+
+        # the resolver is SUBMITTED AFTER the md5 task and BEFORE the
+        # fan-out tasks: FIFO start order guarantees it runs even with
+        # every fan-out worker parked on the gate
+        resolver = self._pool.submit(resolve)
+        try:
+            _, errs = self._fanout_indexed(write_one, shuffled)
+            resolver.result()       # BadDigest outranks quorum errors
+            try:
+                meta.reduce_errs(errs, wq, WriteQuorumError)
+            except serrors.VolumeNotFound:
+                self._buckets_seen.pop(bucket, None)
+                raise BucketNotFound(bucket) from None
+            except serrors.StorageError as e:
+                raise WriteQuorumError(str(e)) from e
+            committed = True
+            if self.mrf is not None and any(e is not None for e in errs):
+                self.mrf.add(bucket, object_name, fi.version_id)
+            self.metacache.invalidate(bucket)
+            return self._to_object_info(fi)
+        finally:
+            gate.set()              # parked workers must never outlive us
+            if not committed and state.get("vdict") is None:
+                # no xl.meta anywhere: purge the orphan data dirs (a
+                # failed digest check must leave no trace; partial
+                # metadata failures belong to the scanner/heal, as
+                # with the non-gated path)
+                ddir = f"{object_name}/{fi.data_dir}"
+
+                def _purge(d):
+                    if d is not None:
+                        d.delete(bucket, ddir, recursive=True)
+
+                self._fanout_items(_purge, shuffled)
 
     def health(self, maintenance: bool = False) -> dict:
         """Cluster-health heuristic (cmd/erasure-server-pool.go:1462):
@@ -468,6 +618,25 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                     "Content-MD5 mismatch (BadDigest)")
             return etag
         return uuid.uuid4().hex[:32] + "-1"
+
+    def _stamp_etag(self, fi: FileInfo, md5obj, opts: PutObjectOptions,
+                    size: int, mod_time: int) -> None:
+        """Resolve the single-part ETag from a finished md5 (random-
+        with-hyphen under --no-compat when ``md5obj`` is None), enforce
+        Content-MD5 (BadDigest on mismatch), and stamp fi's size/
+        metadata/parts — the ONE definition of commit-time digest
+        semantics shared by the serial bytes path, the overlapped
+        commit resolver, and both streaming loops."""
+        if md5obj is not None:
+            etag = md5obj.hexdigest()
+            if opts.content_md5 and etag != opts.content_md5.lower():
+                raise serrors.StorageError(
+                    "Content-MD5 mismatch (BadDigest)")
+        else:
+            etag = uuid.uuid4().hex[:32] + "-1"
+        fi.size = size
+        fi.metadata = {ETAG_KEY: etag, **opts.user_defined}
+        fi.parts = [ObjectPartInfo(1, size, size, etag, mod_time)]
 
     def _encode_and_frame(self, data: bytes, m: int, fi: FileInfo):
         """Erasure-encode + bitrot-frame one batch of blocks.
@@ -550,12 +719,26 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         return self._to_object_info(fi)
 
     def _put_object_streaming(self, bucket: str, object_name: str,
-                              first: bytes, reader, batch: int,
-                              opts: PutObjectOptions) -> ObjectInfo:
-        """Block-batched streaming PUT: each batch of full stripes is one
-        device dispatch appended to per-drive staged shard files; commit
-        is a single quorum rename_data at EOF (cmd/erasure-encode.go
-        block loop + cmd/erasure-object.go:772-779 commit)."""
+                              chunks, opts: PutObjectOptions,
+                              readahead_body: bool = True) -> ObjectInfo:
+        """Block-batched streaming PUT over an iterator of body chunks
+        (each chunk one stream batch; only the final chunk may be
+        short).  Two data planes with bit-identical on-disk results
+        (tests/test_put_pipeline.py pins the contract):
+
+          * pipelined (default): per-drive writer queues overlap batch
+            N+1's encode with batch N's create/append fan-out, the ETag
+            md5 runs as a chained pool task beside both, and framed
+            buffers recycle through utils/bufpool — the reference's
+            hash.Reader-beside-erasure-goroutines overlap
+            (pkg/hash/reader.go + cmd/erasure-encode.go:80-107
+            parallelWriter), batched the TPU way;
+          * serial (pipeline.depth=0, single-core all-local hosts):
+            the original per-batch fan-out round-trips.
+
+        Commit stays a single quorum rename_data at EOF
+        (cmd/erasure-object.go:772-779)."""
+        self._check_bucket(bucket)
         n = len(self.disks)
         k, m = self._geometry(opts.parity)
         mod_time = opts.mod_time or now_ns()
@@ -571,10 +754,221 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 distribution=distribution,
                 checksums=[ChecksumInfo(1, self.bitrot_algo)]),
             fresh=True)
-        codec = self._codec_for(m) if m > 0 else None
-        ssize = fi.erasure.shard_size()
         shuffled = meta.shuffle_disks(self.disks, distribution)
         wq = self._write_quorum(fi)
+        if self._pipeline_on():
+            return self._stream_put_pipelined(
+                bucket, object_name, chunks, opts, fi, m, shuffled, wq,
+                mod_time, readahead_body)
+        return self._stream_put_serial(
+            bucket, object_name, chunks, opts, fi, m, shuffled, wq,
+            mod_time, readahead_body)
+
+    @staticmethod
+    def _md5_link(prev, h, chunk, stats) -> None:
+        """One chained md5 update on the pool: waits for the previous
+        link (updates are order-dependent), then hashes its chunk.
+        hashlib releases the GIL for large buffers, so the chain truly
+        runs beside encode and the writer queues.  The chain never
+        deadlocks the pool: each link waits only on an EARLIER
+        submission, and the executor starts tasks FIFO."""
+        if prev is not None:
+            prev.result()
+        t0 = time.perf_counter()
+        h.update(chunk)
+        stats["md5_s"] += time.perf_counter() - t0
+
+    def _framed_fast_path(self, m: int) -> bool:
+        """True when _encode_and_frame takes the host one-copy framed
+        route (the only path worth recycling output buffers for)."""
+        if m <= 0 or self.bitrot_algo != bitrot.HIGHWAYHASH256S:
+            return False
+        if self._codec_for(m).backend != "numpy":
+            return False
+        from ..hashing.highwayhash import _get_lib
+        from ..ops import gf8_native
+        # both natives must be present: without hh256_fill the framed
+        # encode would be thrown away and re-done by the fallback
+        return gf8_native.available() and _get_lib() is not None
+
+    def _encode_framed_pooled(self, chunk, m: int, fi: FileInfo, stats):
+        """Encode + frame one batch, recycling the framed 2-D buffer
+        through utils/bufpool when the host fast path runs.  Returns
+        (framed_rows, release_cb) — release fires once every drive
+        wrote the batch (memory stays O(depth x batch))."""
+        t0 = time.perf_counter()
+        try:
+            if len(chunk) and self._framed_fast_path(m):
+                codec = self._codec_for(m)
+                buf = bufpool.GLOBAL.acquire(
+                    codec.framed_shape(len(chunk)))
+                framed2d = codec.encode_object_framed(chunk, out=buf)
+                if bitrot.fill_framed(framed2d, fi.erasure.shard_size(),
+                                      self.bitrot_algo):
+                    return list(framed2d), \
+                        (lambda b=buf: bufpool.GLOBAL.release(b))
+                bufpool.GLOBAL.release(buf)   # native hash missing
+            return self._encode_and_frame(chunk, m, fi), None
+        finally:
+            stats["encode_s"] += time.perf_counter() - t0
+
+    def _pump_put_pipeline(self, chunks, sw, m, fi, md5, stats,
+                           write_batch_for, wq) -> tuple[int, int]:
+        """The shared stage driver of every pipelined upload (streaming
+        PUT and multipart parts): chained md5 on the pool, encode into
+        a recycled buffer, per-drive writer queues — batches in flight
+        bounded to ``pipeline.depth`` (O(depth x batch) memory) and
+        quorum re-checked as completions drain, so latched errors end
+        the stream early instead of encoding the rest of a doomed body.
+        ``write_batch_for(framed)`` returns the per-drive write for one
+        batch's framed rows.  Returns (total_bytes, batches)."""
+        n = len(self.disks)
+        depth = max(1, self._pipe_depth)
+        md5_links: collections.deque = collections.deque()
+        inflight: collections.deque = collections.deque()
+        total = batches = 0
+        for chunk in chunks:
+            total += len(chunk)
+            batches += 1
+            if md5 is not None:
+                md5_links.append(self._pool.submit(
+                    self._md5_link,
+                    md5_links[-1] if md5_links else None,
+                    md5, chunk, stats))
+                while len(md5_links) > depth:
+                    md5_links.popleft().result()
+            framed, release = self._encode_framed_pooled(
+                chunk, m, fi, stats)
+            inflight.append(sw.submit_batch(write_batch_for(framed),
+                                            release=release))
+            while len(inflight) > depth:
+                inflight.popleft().done.wait()
+            alive = sw.alive()
+            if alive < wq:
+                sw.abort()
+                raise WriteQuorumError(
+                    f"{alive} of {n} drives writable, need {wq}")
+        for f in md5_links:
+            f.result()
+        return total, batches
+
+    def _stream_put_pipelined(self, bucket, object_name, chunks, opts,
+                              fi, m, shuffled, wq, mod_time,
+                              readahead_body) -> ObjectInfo:
+        """The pipelined loop: body readahead -> chained md5 -> encode
+        into a recycled buffer -> per-drive writer queues.  Per drive
+        the op order is strictly create, then appends, then rename_data
+        (single writer thread per drive, FIFO queue); errors latch per
+        drive and quorum is re-checked as completions drain."""
+        from ..utils.readahead import readahead
+        n = len(self.disks)
+        tmps: list[str | None] = [None] * n
+        md5 = hashlib.md5() if (opts.content_md5 or _strict_compat()) \
+            else None
+        stats = {"md5_s": 0.0, "encode_s": 0.0}
+        depth = max(1, self._pipe_depth)
+        sw = self._write_plane.stream(shuffled)
+        src = None
+        t_wall0 = time.perf_counter()
+        lk = self.ns_lock.new_lock(bucket, object_name)
+        lk.lock(write=True)
+        try:
+            # started only after the lock is held and inside the try: a
+            # lock failure must not leave a thread draining the body
+            # socket with no close().  depth-1 queued + one in hand =
+            # ``pipeline.depth`` batches of body in flight.
+            src = readahead(chunks, depth=max(1, depth - 1)) \
+                if readahead_body else chunks
+
+            def write_batch_for(framed):
+                def write_batch(idx, disk):
+                    if tmps[idx] is None:
+                        # tmp_dir here, ON the drive's writer (an RPC
+                        # on remote drives): only this worker touches
+                        # tmps[idx] until the stream drains
+                        tmps[idx] = disk.tmp_dir()
+                        disk.create_file(SYS_DIR, f"{tmps[idx]}/part.1",
+                                         framed[idx])
+                    else:
+                        disk.append_file(SYS_DIR, f"{tmps[idx]}/part.1",
+                                         framed[idx])
+                return write_batch
+
+            total, batches = self._pump_put_pipeline(
+                src, sw, m, fi, md5, stats, write_batch_for, wq)
+            self._stamp_etag(fi, md5, opts, total, mod_time)
+            sw.drain()
+            alive = sw.alive()
+            if alive < wq:
+                raise WriteQuorumError(
+                    f"{alive} of {n} drives writable, need {wq}")
+            # queues are DRAINED here: a lock whose grants lapsed while
+            # the body streamed must abort before any commit op is
+            # queued (drwmutex refresh-loss semantics)
+            if hasattr(lk, "ensure_valid"):
+                lk.ensure_valid()
+
+            def commit_one(idx, disk):
+                dfi = FileInfo(**{**fi.__dict__})
+                dfi.erasure = ErasureInfo(**{**fi.erasure.__dict__})
+                dfi.erasure.index = idx + 1
+                disk.rename_data(SYS_DIR, tmps[idx], dfi, bucket,
+                                 object_name)
+
+            sw.submit_batch(commit_one)
+            sw.drain()
+            cerrs = list(sw.errs)
+            try:
+                meta.reduce_errs(cerrs, wq, WriteQuorumError)
+            except serrors.StorageError as e:
+                raise WriteQuorumError(str(e)) from e
+            if self.mrf is not None and any(e is not None for e in cerrs):
+                self.mrf.add(bucket, object_name, fi.version_id)
+            self.metacache.invalidate(bucket)
+            wall = time.perf_counter() - t_wall0
+            write_s = sw.max_busy_s()
+            crit = max(stats["md5_s"], stats["encode_s"], write_s)
+            self._pipe_stats = {
+                "wall_s": wall, "md5_s": stats["md5_s"],
+                "encode_s": stats["encode_s"], "write_s": write_s,
+                "batches": batches, "bytes": total,
+                "overlap_efficiency": crit / wall if wall > 0 else 0.0,
+            }
+            return self._to_object_info(fi)
+        finally:
+            if src is not None and readahead_body:
+                src.close()  # stop + JOIN the readahead thread: the
+                             # handler reuses the body socket next
+            sw.abort()
+            # settle the queues before tmp cleanup — a worker must not
+            # append into a dir being removed (bounded wait: a hung
+            # drive op must not wedge the handler thread forever)
+            sw.drain(timeout=10.0)
+            lk.unlock()
+            # when_drive_idle: immediate for settled drives; a drive
+            # hung past the drain timeout cleans at op settlement, so
+            # its resumed append (makedirs exist_ok) cannot resurrect
+            # the tmp dir after the rmtree.  tmps[idx] is read at FIRE
+            # time: a first-batch op still stuck inside tmp_dir() has
+            # not assigned it yet — eager binding would skip the drive
+            # and leak whatever the resumed op stages
+            def _clean_tmp_cb(d, i):
+                if tmps[i] is not None:
+                    d.clean_tmp(tmps[i])
+
+            for idx, disk in enumerate(shuffled):
+                if disk is not None:
+                    sw.when_drive_idle(
+                        idx, lambda d=disk, i=idx: _clean_tmp_cb(d, i))
+
+    def _stream_put_serial(self, bucket, object_name, chunks, opts, fi,
+                           m, shuffled, wq, mod_time,
+                           readahead_body) -> ObjectInfo:
+        """The original serial loop: one synchronous fan-out round per
+        batch.  Kept verbatim as the reference semantics (the pipelined
+        plane must match it byte for byte) and as the single-core
+        fallback."""
+        n = len(self.disks)
         tmps: list[str | None] = [None] * n
         errs: list[Exception | None] = [None] * n
         # md5 only when the client sent Content-MD5 or in strict-compat
@@ -588,23 +982,15 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         # cmd/xl-storage.go:1544-1546)
         from ..utils.readahead import readahead
 
-        def _chunks():
-            c = first
-            while c:
-                yield c
-                if len(c) < batch:
-                    return
-                c = _read_full(reader, batch)
-
-        chunks = None
+        src = None
         lk = self.ns_lock.new_lock(bucket, object_name)
         lk.lock(write=True)
         try:
             # started only after the lock is held and inside the try:
             # a lock failure must not leave a thread draining the body
             # socket with no close()
-            chunks = readahead(_chunks(), depth=1)
-            for chunk in chunks:
+            src = readahead(chunks, depth=1) if readahead_body else chunks
+            for chunk in src:
                 if md5 is not None:
                     md5.update(chunk)
                 total += len(chunk)
@@ -631,16 +1017,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 if alive < wq:
                     raise WriteQuorumError(
                         f"{alive} of {n} drives writable, need {wq}")
-            if md5 is not None:
-                etag = md5.hexdigest()
-                if opts.content_md5 and etag != opts.content_md5.lower():
-                    raise serrors.StorageError(
-                        "Content-MD5 mismatch (BadDigest)")
-            else:
-                etag = uuid.uuid4().hex[:32] + "-1"
-            fi.size = total
-            fi.metadata = {ETAG_KEY: etag, **opts.user_defined}
-            fi.parts = [ObjectPartInfo(1, total, total, etag, mod_time)]
+            self._stamp_etag(fi, md5, opts, total, mod_time)
             # the lock was held across the whole body stream; if its
             # grants fell below quorum meanwhile, committing would race
             # a new writer (drwmutex refresh-loss semantics)
@@ -669,9 +1046,9 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             self.metacache.invalidate(bucket)
             return self._to_object_info(fi)
         finally:
-            if chunks is not None:
-                chunks.close()  # stop + JOIN the readahead thread: the
-                                # handler reuses the body socket next
+            if src is not None and readahead_body:
+                src.close()  # stop + JOIN the readahead thread: the
+                             # handler reuses the body socket next
             lk.unlock()
             for idx, disk in enumerate(shuffled):
                 if disk is not None and tmps[idx] is not None:
@@ -769,11 +1146,14 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             return info, gen
         # readahead: block batch N+1's shard reads + decode overlap the
         # consumer sending batch N (klauspost/readahead role, go.mod:39;
-        # pipeline overlap of cmd/bitrot-streaming.go:74-89).  depth=1
-        # is full double-buffering at half the buffered memory — the
-        # RSS gate in test_streaming bounds the whole pipeline
+        # pipeline overlap of cmd/bitrot-streaming.go:74-89).  Depth
+        # follows the ``pipeline.depth`` knob minus the batch in the
+        # consumer's hand, so PUT and GET share one memory bound
+        # (default depth 2 -> queue 1, full double-buffering at half
+        # the buffered memory — the RSS gate in test_streaming bounds
+        # the whole pipeline)
         from ..utils.readahead import readahead
-        return info, readahead(gen, depth=1)
+        return info, readahead(gen, depth=max(1, self._pipe_depth - 1))
 
     @staticmethod
     def _locked_stream(lk, inner):

@@ -188,6 +188,7 @@ def heal_object(er: ErasureObjects, bucket: str, object_name: str,
         rebuilt = _reconstruct_shards(er, fi, present,
                                       [shards[i] for i in present],
                                       wanted, part.size)
+        writes = []          # (disk, dfi, framed) for staged shard writes
         for j, i in enumerate(wanted):
             framed = bitrot.streaming_encode(rebuilt[j].tobytes(), ssize,
                                              er.bitrot_algo)
@@ -197,18 +198,60 @@ def heal_object(er: ErasureObjects, bucket: str, object_name: str,
                 dfi.inline_data = framed
                 dfi.data_dir = ""
                 disk.write_metadata(bucket, object_name, dfi)
+                if disk.endpoint() not in res.healed_disks:
+                    res.healed_disks.append(disk.endpoint())
             else:
-                tmp = disk.tmp_dir()
-                try:
-                    disk.create_file(SYS_DIR, f"{tmp}/part.{part.number}",
-                                     framed)
-                    disk.rename_data(SYS_DIR, tmp, dfi, bucket, object_name)
-                finally:
-                    disk.clean_tmp(tmp)
-            if disk.endpoint() not in res.healed_disks:
-                res.healed_disks.append(disk.endpoint())
+                writes.append((disk, dfi, framed))
+        _write_healed_shards(er, writes, part.number, bucket,
+                             object_name, res)
     res.after_ok = res.before_ok + len(healable)
     return res
+
+
+def _write_healed_shards(er: ErasureObjects, writes: list,
+                         part_number: int, bucket: str, object_name: str,
+                         res) -> None:
+    """Stage + commit rebuilt shard files on the stale drives.  Rides
+    the shared per-drive writer plane when the pipeline is on, so the
+    stale drives heal in parallel (remote RPC waits overlap) instead of
+    one after another; falls back to the serial loop otherwise.  The
+    first failure aborts the heal (as the serial loop always did) —
+    but only after every drive's write settled, and drives that DID
+    succeed are still recorded as healed."""
+    if not writes:
+        return
+
+    def heal_one(disk, dfi, framed) -> None:
+        tmp = disk.tmp_dir()
+        try:
+            disk.create_file(SYS_DIR, f"{tmp}/part.{part_number}",
+                             framed)
+            disk.rename_data(SYS_DIR, tmp, dfi, bucket, object_name)
+        finally:
+            disk.clean_tmp(tmp)
+
+    if er._pipeline_on() and len(writes) > 1:
+        sw = er._write_plane.stream([d for d, _, _ in writes])
+        for pos, (disk, dfi, framed) in enumerate(writes):
+            # the plane hands fn its (idx, disk); the heal write is
+            # already bound to ITS target drive, so ignore them
+            sw.submit(pos, lambda *_, d=disk, i=dfi, f=framed:
+                      heal_one(d, i, f))
+        sw.drain()
+        first_err = None
+        for pos, (disk, _, _) in enumerate(writes):
+            if sw.errs[pos] is None:
+                if disk.endpoint() not in res.healed_disks:
+                    res.healed_disks.append(disk.endpoint())
+            elif first_err is None:
+                first_err = sw.errs[pos]
+        if first_err is not None:
+            raise first_err
+        return
+    for disk, dfi, framed in writes:
+        heal_one(disk, dfi, framed)
+        if disk.endpoint() not in res.healed_disks:
+            res.healed_disks.append(disk.endpoint())
 
 
 def _disk_fileinfo(fi: FileInfo, shard_idx: int) -> FileInfo:

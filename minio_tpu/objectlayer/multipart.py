@@ -103,36 +103,57 @@ class MultipartOps:
         """Erasure-encode one part (PutObjectPart,
         cmd/erasure-multipart.go:342).  ``data`` is bytes or a file-like
         reader; large parts stream through the block-batched pipeline so
-        memory stays O(batch) — a 5 GiB part never materializes."""
+        memory stays O(batch) — a 5 GiB part never materializes.  Parts
+        ride the same per-drive writer plane as streaming PUT (part
+        md5 + encode + drive appends overlap); bytes bodies feed the
+        loop zero-copy memoryview slices."""
         if not 1 <= part_number <= MAX_PARTS:
             raise InvalidPart(f"part number {part_number}")
         self._check_bucket(bucket)
         fi, _ = self._mp_fileinfo(bucket, object_name, upload_id)
         mp = self._mp_dir(bucket, object_name, upload_id)
-        from .erasure_object import STREAM_BATCH_BYTES, _read_full
-        batch = max(1, STREAM_BATCH_BYTES // self.block_size) \
-            * self.block_size
+        from .erasure_object import _read_full
+        batch = self._stream_batch_size()
         if hasattr(data, "read"):
-            reader = data
+            def _chunks(reader=data):
+                first = True
+                while True:
+                    c = _read_full(reader, batch)
+                    if c or first:     # empty body still stages a part
+                        yield c
+                    first = False
+                    if len(c) < batch:
+                        return
+            chunks = _chunks()
         else:
-            import io
-            reader = io.BytesIO(bytes(data) if not isinstance(data, bytes)
-                                else data)
+            body = data if isinstance(data, bytes) else bytes(data)
+            mv = memoryview(body)
+            chunks = (mv[o:o + batch]
+                      for o in range(0, max(1, len(mv)), batch))
         shuffled = meta.shuffle_disks(self.disks, fi.erasure.distribution)
         wq = self._write_quorum(fi)
-        n = len(self.disks)
-        errs: list[Exception | None] = [None] * n
-        started = [False] * n
         # stage under a unique name, promote atomically at the end: a
         # retried or concurrent upload of the same part number must never
         # truncate a part that already verified (the reference writes
         # whole part files via tmp+rename, cmd/erasure-multipart.go:342)
         staging = f"part.{part_number}.in.{uuid.uuid4().hex[:8]}"
+        if self._pipeline_on():
+            return self._put_part_pipelined(
+                bucket, object_name, fi, mp, staging, part_number,
+                chunks, shuffled, wq)
+        return self._put_part_serial(
+            bucket, object_name, fi, mp, staging, part_number, chunks,
+            shuffled, wq)
+
+    def _put_part_serial(self, bucket, object_name, fi, mp, staging,
+                         part_number, chunks, shuffled, wq) -> PartInfo:
+        n = len(self.disks)
+        errs: list[Exception | None] = [None] * n
+        started = [False] * n
         md5 = hashlib.md5()
         size = 0
         try:
-            chunk = _read_full(reader, batch)
-            while True:
+            for chunk in chunks:
                 md5.update(chunk)
                 size += len(chunk)
                 # the upload's persisted geometry wins: a storage-class
@@ -165,11 +186,6 @@ class MultipartOps:
                 if alive < wq:
                     raise WriteQuorumError(
                         f"{alive} of {n} drives writable, need {wq}")
-                if len(chunk) < batch:
-                    break
-                chunk = _read_full(reader, batch)
-                if not chunk:
-                    break
             etag = md5.hexdigest()
 
             def promote(idx_disk):
@@ -201,6 +217,73 @@ class MultipartOps:
                     disk.delete(SYS_DIR, f"{mp}/{staging}")
                 except Exception:  # noqa: BLE001 — already promoted/gone
                     pass
+
+            self._fanout_indexed(cleanup, shuffled)
+
+    def _put_part_pipelined(self, bucket, object_name, fi, mp, staging,
+                            part_number, chunks, shuffled, wq) -> PartInfo:
+        """Part upload on the per-drive writer plane: the shared stage
+        driver (_pump_put_pipeline) overlaps chained md5, encode, and
+        per-drive appends exactly like streaming PUT; the staged-name
+        promote and cleanup contracts match the serial path bit for
+        bit."""
+        n = len(self.disks)
+        m = fi.erasure.parity_blocks
+        sw = self._write_plane.stream(shuffled)
+        started = [False] * n
+        md5 = hashlib.md5()
+        stats = {"md5_s": 0.0, "encode_s": 0.0}
+        try:
+            def write_batch_for(framed):
+                def write_batch(idx, disk):
+                    if not started[idx]:
+                        started[idx] = True
+                        disk.create_file(SYS_DIR, f"{mp}/{staging}",
+                                         framed[idx])
+                    else:
+                        disk.append_file(SYS_DIR, f"{mp}/{staging}",
+                                         framed[idx])
+                return write_batch
+
+            size, _ = self._pump_put_pipeline(
+                chunks, sw, m, fi, md5, stats, write_batch_for, wq)
+            etag = md5.hexdigest()
+            sw.drain()
+            alive = sw.alive()
+            if alive < wq:
+                raise WriteQuorumError(
+                    f"{alive} of {n} drives writable, need {wq}")
+
+            def promote(idx, disk):
+                # atomic promote, then the sidecar complete() verifies
+                # with; per-drive FIFO guarantees every append landed
+                disk.rename_file(SYS_DIR, f"{mp}/{staging}",
+                                 SYS_DIR, f"{mp}/part.{part_number}")
+                disk.write_all(SYS_DIR, f"{mp}/part.{part_number}.meta",
+                               f"{etag}:{size}".encode())
+
+            sw.submit_batch(promote)
+            sw.drain()
+            try:
+                meta.reduce_errs(list(sw.errs), wq, WriteQuorumError)
+            except serrors.StorageError as e:
+                raise WriteQuorumError(str(e)) from e
+            return PartInfo(part_number, etag, size, size, now_ns())
+        finally:
+            sw.abort()
+            sw.drain(timeout=10.0)   # settle queues before cleanup
+
+            def cleanup(idx_disk):
+                idx, disk = idx_disk
+                if disk is None or not started[idx]:
+                    return
+                # settled drives delete inline (still on the parallel
+                # fan-out); a drive hung past the drain timeout defers
+                # to op settlement so its resumed append cannot
+                # resurrect the staging file after this delete
+                sw.when_drive_idle(
+                    idx,
+                    lambda d=disk: d.delete(SYS_DIR, f"{mp}/{staging}"))
 
             self._fanout_indexed(cleanup, shuffled)
 

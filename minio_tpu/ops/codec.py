@@ -324,7 +324,21 @@ class Erasure:
             "backend": self.backend,
         }
 
-    def encode_object_framed(self, data, digest: int = 32) -> np.ndarray:
+    def framed_shape(self, total: int, digest: int = 32) -> tuple[int, int]:
+        """Shape of encode_object_framed's output for a ``total``-byte
+        object — lets the put pipeline acquire a recycled buffer
+        (utils/bufpool.py) before encoding."""
+        k, m = self.data_blocks, self.parity_blocks
+        bs = self.block_size
+        ssize = self.shard_size()
+        nfull, tail_len = divmod(total, bs)
+        tail_ss = gf8.ceil_frac(tail_len, k)
+        F = digest + ssize
+        flen = nfull * F + ((digest + tail_ss) if tail_len else 0)
+        return (k + m, flen)
+
+    def encode_object_framed(self, data, digest: int = 32,
+                             out: np.ndarray | None = None) -> np.ndarray:
         """Encode a whole object straight into bitrot-framed shard files.
 
         Returns (k+m, framed_len) uint8 where each row is the final
@@ -340,7 +354,7 @@ class Erasure:
         err = ""
         total = _nbytes(data)
         try:
-            return self._encode_object_framed(data, digest)
+            return self._encode_object_framed(data, digest, out)
         except Exception as e:
             err = f"{type(e).__name__}: {e}"
             raise
@@ -349,10 +363,13 @@ class Erasure:
                           blocks=-(-total // self.block_size)
                           if total else 0, error=err)
 
-    def _encode_object_framed(self, data, digest: int = 32) -> np.ndarray:
+    def _encode_object_framed(self, data, digest: int = 32,
+                              out: np.ndarray | None = None) -> np.ndarray:
         from . import gf8_native
         assert gf8_native.available()
-        buf = np.frombuffer(bytes(data), dtype=np.uint8) \
+        # zero-copy view: bytes AND memoryview slices (the put path
+        # feeds whole-body memoryviews) frame without materializing
+        buf = np.frombuffer(data, dtype=np.uint8) \
             if not isinstance(data, np.ndarray) \
             else np.asarray(data, np.uint8).ravel()
         total = buf.size
@@ -368,8 +385,12 @@ class Erasure:
         # would memset ~6 MB per 4 MiB object only to overwrite it.
         # Only the digest slots and the short-row padding gaps need
         # zeroing (framing contract: digest filled later in place,
-        # padding must be zero for bit-identical shard math).
-        out = np.empty((k + m, flen), dtype=np.uint8)
+        # padding must be zero for bit-identical shard math).  A
+        # recycled ``out`` (bufpool) relies on the same targeted
+        # clears, so stale bytes from the previous batch never leak.
+        if out is None or out.shape != (k + m, flen) \
+                or out.dtype != np.uint8:
+            out = np.empty((k + m, flen), dtype=np.uint8)
         if nfull:
             fview = out[:, :nfull * F].reshape(k + m, nfull, F)
             fview[:, :, :digest] = 0                  # digest slots

@@ -365,6 +365,9 @@ class S3Server:
         self._req_waiters_mu = threading.Lock()
         self._req_max = 0
         self.reload_api_config()
+        # apply persisted ``pipeline`` knobs to the layer (it booted
+        # with env/defaults before this server's config existed)
+        self.reload_pipeline_config()
 
     def reload_api_config(self) -> None:
         """(Re)derive the request-plane knobs from the ``api`` kvconfig
@@ -407,6 +410,20 @@ class S3Server:
                 self.config.get("api", "body_min_rate") or 0)
         except ValueError:
             self.body_min_rate_bps = 1 << 20
+
+    def reload_pipeline_config(self) -> None:
+        """Push the ``pipeline`` kvconfig knobs (PUT pipeline depth,
+        per-drive writer queue depth) into every leaf erasure layer —
+        at boot and after admin SetConfigKV, so the live data plane
+        retunes without a restart."""
+        from ..objectlayer.metacache import leaf_layers_of
+        for leaf in leaf_layers_of(self.layer):
+            reload = getattr(leaf, "reload_pipeline_config", None)
+            if reload is not None:
+                try:
+                    reload(self.config)
+                except Exception:  # noqa: BLE001 — bad knob value must
+                    pass           # not take the server down
 
     def reload_egress_config(self) -> None:
         """(Re)build every config-driven egress target from the
@@ -549,6 +566,12 @@ class S3Server:
                 self.logger.targets.remove(t)
         if getattr(self, "egress", None) is not None:
             self.egress.close_all()
+        # writer plane down WITH the server: per-drive writer threads
+        # join, queued ops fail with PlaneClosed (in-flight PUTs abort
+        # and clean their tmp files), blocked enqueuers wake.  The
+        # plane reopens lazily if a shared layer serves again later.
+        from ..storage.writers import close_write_planes
+        close_write_planes(self.layer)
         if self.peers is not None:
             self.peers.close()
 

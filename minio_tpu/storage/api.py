@@ -127,7 +127,8 @@ class StorageAPI(abc.ABC):
 
     def write_data_commit(self, volume: str, path: str, fi: FileInfo,
                           data, shard_index: int | None = None,
-                          version_dict: dict | None = None) -> None:
+                          version_dict: dict | None = None,
+                          meta_gate=None) -> None:
         """One-shot single-part PUT commit: part bytes + version merge.
 
         Default composition stages through tmp + rename_data (correct on
@@ -136,9 +137,19 @@ class StorageAPI(abc.ABC):
         version only becomes visible when xl.meta is atomically replaced,
         the same invariant rename_data relies on.  ``shard_index``
         overrides fi.erasure.index for this drive (the fan-out shares
-        one FileInfo; see XLStorage.write_data_commit)."""
+        one FileInfo; see XLStorage.write_data_commit).
+
+        ``meta_gate`` is the overlapped-PUT hook: a callable that blocks
+        until the object's ETag md5 resolved and returns the FINAL
+        version dict (or raises to abort before any version becomes
+        visible).  Backends that can, write the part bytes first and
+        gate only the metadata merge — the hash runs beside the data
+        fan-out (pkg/hash/reader.go overlap); this default resolves the
+        gate up front (no overlap, always correct)."""
         from .datatypes import ErasureInfo
         from .xl_storage import SYS_DIR as sys_vol
+        if meta_gate is not None:
+            version_dict = meta_gate()
         if shard_index is not None and fi.erasure.index != shard_index:
             fi = FileInfo(**{**fi.__dict__})
             fi.erasure = ErasureInfo(**{**fi.erasure.__dict__})

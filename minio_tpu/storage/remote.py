@@ -278,7 +278,13 @@ class RemoteStorage(StorageAPI):
         return self._call("stat_info_file", volume=volume, path=path)
 
     def write_data_commit(self, volume, path, fi, data,
-                          shard_index=None, version_dict=None):
+                          shard_index=None, version_dict=None,
+                          meta_gate=None):
+        # one RPC carries part bytes + final version dict, so the gate
+        # must resolve before the wire write; the md5 still overlaps
+        # the local drives' gated writes running in the same fan-out
+        if meta_gate is not None:
+            version_dict = meta_gate()
         d = dict(version_dict) if version_dict is not None \
             else fi.to_dict()
         if shard_index is not None:

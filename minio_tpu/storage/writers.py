@@ -1,0 +1,403 @@
+"""Per-drive writer plane — the I/O stage of the pipelined PUT path.
+
+The reference overlaps erasure encode with drive writes by giving every
+drive its own goroutine + io.Pipe pair for the lifetime of a stream
+(cmd/erasure-encode.go:80-107 parallelWriter, cmd/bitrot-streaming.go
+newStreamingBitrotWriter).  The Python analog here is ONE persistent
+writer thread per drive with a bounded in-order queue:
+
+  * enqueue is non-blocking until the per-drive depth bound (the
+    ``pipeline.queue_depth`` kvconfig knob, read live per enqueue), so
+    batch N+1's encode overlaps batch N's create/append fan-out;
+  * per-drive ordering is strict FIFO — one thread per drive consumes
+    one queue, so a stream's create always lands before its appends and
+    its appends before its commit, locally and across an RPC (the
+    remote client's calls are synchronous, storage/remote.py);
+  * errors latch per (stream, drive): once a drive fails a stream's op,
+    the stream's later ops for that drive are skipped (a later append
+    after a failed one would corrupt the staged file) and quorum is
+    re-checked as completions drain;
+  * the plane is shared by streaming PUT, the overlapped bytes-PUT
+    commit, multipart part uploads, and heal writes — concurrent
+    streams interleave on the per-drive queues without ordering
+    hazards because each stream only ever appends to its own files.
+
+Shutdown: ``close()`` wakes blocked enqueuers (they see PlaneClosed and
+abort their PUT, which cleans its tmp files), fails every queued op so
+stream ``drain()`` calls return, and joins the worker threads.  The
+plane restarts lazily on the next enqueue, so a layer shared across
+server start/stop cycles (tests, embedded use) keeps working.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+
+from ..obs import trace as _trace
+from . import errors as serrors
+
+
+class PlaneClosed(serrors.StorageError):
+    """The writer plane shut down while ops were queued or submitting."""
+
+
+class _Batch:
+    """Refcount across one batch's per-drive ops; fires ``release``
+    exactly once when the last op settles (the framed-buffer recycle
+    hook) and exposes an event the put loop bounds its depth on."""
+
+    __slots__ = ("_n", "_release", "_mu", "done")
+
+    def __init__(self, n: int, release=None):
+        self._n = n
+        self._release = release
+        self._mu = threading.Lock()
+        self.done = threading.Event()
+        if n <= 0:
+            self._fire()
+
+    def _fire(self) -> None:
+        rel, self._release = self._release, None
+        if rel is not None:
+            try:
+                rel()
+            except Exception:  # noqa: BLE001 — recycle is best-effort
+                pass
+        self.done.set()
+
+    def done_one(self) -> None:
+        with self._mu:
+            self._n -= 1
+            if self._n > 0:
+                return
+        self._fire()
+
+
+class _Op:
+    __slots__ = ("stream", "idx", "fn", "batch", "rid")
+
+    def __init__(self, stream, idx, fn, batch, rid):
+        self.stream = stream
+        self.idx = idx
+        self.fn = fn
+        self.batch = batch
+        self.rid = rid
+
+    def run(self, disk) -> None:
+        st = self.stream
+        if st.cancelled or st.errs[self.idx] is not None:
+            st._op_done(self.idx, None, self.batch, 0.0)
+            return
+        # per-drive spans must carry the originating request ID even
+        # though the worker thread outlives any one request
+        _trace.set_request_id(self.rid)
+        t0 = time.perf_counter()
+        try:
+            self.fn(self.idx, disk)
+            st._op_done(self.idx, None, self.batch,
+                        time.perf_counter() - t0)
+        except Exception as e:  # noqa: BLE001 — latched, quorum decides
+            st._op_done(self.idx, e, self.batch,
+                        time.perf_counter() - t0)
+
+    def fail(self, err: Exception) -> None:
+        self.stream._op_done(self.idx, err, self.batch, 0.0)
+
+
+class _DriveWriter:
+    """One persistent thread + bounded FIFO queue for one drive."""
+
+    def __init__(self, disk, name: str):
+        self.disk = disk
+        self._q: list[_Op] = []
+        self._cv = threading.Condition()
+        self._closed = False
+        self.stalls = 0          # enqueues that hit the depth bound
+        self.ops = 0             # ops completed (incl. skipped/failed)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=name)
+        self._thread.start()
+
+    def depth(self) -> int:
+        return len(self._q)
+
+    def put(self, op: _Op, bound: int) -> None:
+        with self._cv:
+            if len(self._q) >= bound and not self._closed:
+                self.stalls += 1
+                while len(self._q) >= bound and not self._closed:
+                    self._cv.wait()
+            if self._closed:
+                raise PlaneClosed("writer plane closed")
+            self._q.append(op)
+            self._cv.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._closed:
+                    self._cv.wait()
+                if not self._q:          # closed and drained
+                    return
+                op = self._q.pop(0)
+                self._cv.notify_all()    # wake a putter at the bound
+            if self._closed:
+                op.fail(PlaneClosed("writer plane closed"))
+            else:
+                op.run(self.disk)
+            self.ops += 1
+
+    def close(self, timeout: float) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout)
+        # a worker stuck inside a hung drive op cannot drain its queue;
+        # fail the leftovers here so stream drain()s return (popping is
+        # lock-safe against the stuck worker resuming later)
+        while True:
+            with self._cv:
+                if not self._q:
+                    return
+                op = self._q.pop(0)
+                self._cv.notify_all()
+            op.fail(PlaneClosed("writer plane closed"))
+            self.ops += 1
+
+    def is_alive(self) -> bool:
+        return self._thread.is_alive()
+
+
+class StreamWriter:
+    """One stream's view of the plane: positional drives (the PUT's
+    shuffled order), per-drive latched errors, pending-op accounting."""
+
+    def __init__(self, plane: "WriterPlane", disks: list,
+                 gen: int = 0):
+        self._plane = plane
+        self._gen = gen          # plane generation at stream birth
+        self.disks = list(disks)
+        self.errs: list[Exception | None] = [
+            None if d is not None else serrors.DiskNotFound("offline")
+            for d in self.disks]
+        self.drive_busy = [0.0] * len(self.disks)   # seconds in drive ops
+        self.cancelled = False
+        self._pending = 0
+        self._drive_pending = [0] * len(self.disks)
+        self._on_idle: dict[int, list] = {}
+        self._cv = threading.Condition()
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, idx: int, fn, batch: _Batch | None = None) -> bool:
+        """Queue ``fn(idx, disk)`` on drive idx's writer (in-order per
+        drive).  Returns False (settling ``batch``) for drives already
+        dead for this stream.  Blocks only at the queue-depth bound;
+        raises PlaneClosed if the plane shuts down meanwhile."""
+        disk = self.disks[idx]
+        if disk is None or self.errs[idx] is not None or self.cancelled:
+            if batch is not None:
+                batch.done_one()
+            return False
+        op = _Op(self, idx, fn, batch, _trace.get_request_id())
+        with self._cv:
+            self._pending += 1
+            self._drive_pending[idx] += 1
+        try:
+            self._plane._enqueue(disk, op)
+        except BaseException:
+            with self._cv:
+                self._pending -= 1
+                self._drive_pending[idx] -= 1
+                cbs = (self._on_idle.pop(idx, [])
+                       if self._drive_pending[idx] == 0 else [])
+                self._cv.notify_all()
+            self._run_idle_cbs(cbs)
+            if batch is not None:
+                batch.done_one()
+            raise
+        return True
+
+    def submit_batch(self, fn, release=None) -> _Batch:
+        """Queue one batch of ``fn(idx, disk)`` across all live drives;
+        ``release`` fires once every drive's op settled (framed-buffer
+        recycle).  Dead drives settle immediately."""
+        idxs = [i for i in range(len(self.disks))
+                if self.disks[i] is not None and self.errs[i] is None
+                and not self.cancelled]
+        batch = _Batch(len(idxs), release)
+        done = 0
+        try:
+            for i in idxs:
+                self.submit(i, fn, batch)
+                done += 1
+        except BaseException:
+            for _ in range(len(idxs) - done - 1):
+                batch.done_one()   # never-submitted ops settle here
+            raise
+        return batch
+
+    # -- progress / settlement --------------------------------------------
+
+    def _op_done(self, idx: int, err: Exception | None,
+                 batch: _Batch | None, busy_s: float) -> None:
+        with self._cv:
+            if err is not None and self.errs[idx] is None:
+                self.errs[idx] = err
+            self.drive_busy[idx] += busy_s
+            self._pending -= 1
+            self._drive_pending[idx] -= 1
+            cbs = (self._on_idle.pop(idx, [])
+                   if self._drive_pending[idx] == 0 else [])
+            self._cv.notify_all()
+        self._run_idle_cbs(cbs)
+        if batch is not None:
+            batch.done_one()
+
+    @staticmethod
+    def _run_idle_cbs(cbs) -> None:
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — cleanup is best-effort
+                pass
+
+    def when_drive_idle(self, idx: int, fn) -> None:
+        """Run ``fn()`` once drive idx has no unsettled ops from this
+        stream — immediately when already idle, otherwise on the
+        settling thread (the drive's writer after a hung op completes,
+        or whatever thread fails the queue at plane close).  Tmp-dir
+        cleanup after a timed-out ``drain`` rides this: removing a
+        staging dir while a stuck append could still resume would let
+        its makedirs(exist_ok=True) resurrect the dir as an orphan."""
+        with self._cv:
+            if self._drive_pending[idx] > 0:
+                self._on_idle.setdefault(idx, []).append(fn)
+                return
+        self._run_idle_cbs([fn])
+
+    def alive(self) -> int:
+        return sum(1 for i, d in enumerate(self.disks)
+                   if d is not None and self.errs[i] is None)
+
+    def abort(self) -> None:
+        """Cancel this stream: queued ops become no-ops (their slots
+        still drain, so per-drive FIFO order is preserved for other
+        streams sharing the queues)."""
+        self.cancelled = True
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Wait for every submitted op to settle; True when idle."""
+        end = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._pending:
+                if end is None:
+                    self._cv.wait()
+                else:
+                    left = end - time.monotonic()
+                    if left <= 0:
+                        return False
+                    self._cv.wait(left)
+        return True
+
+    def max_busy_s(self) -> float:
+        return max(self.drive_busy, default=0.0)
+
+
+class WriterPlane:
+    """The per-layer registry of drive writers (lazily started)."""
+
+    _NAMES = itertools.count()
+
+    def __init__(self, queue_depth=2):
+        # int or zero-arg callable: the kvconfig knob is read per
+        # enqueue so admin SetConfigKV retunes a live plane
+        self._depth = queue_depth
+        self._writers: dict[int, _DriveWriter] = {}
+        self._mu = threading.Lock()
+        self._closed = False
+        self._gen = 0            # bumped by close(); stale streams die
+        self.used = False        # ever carried an op (metrics idle gate)
+
+    def stream(self, disks: list) -> StreamWriter:
+        with self._mu:
+            gen = self._gen
+        return StreamWriter(self, disks, gen)
+
+    def queue_bound(self) -> int:
+        d = self._depth() if callable(self._depth) else self._depth
+        try:
+            return max(1, int(d))
+        except (TypeError, ValueError):
+            return 2
+
+    def _enqueue(self, disk, op: _Op) -> None:
+        key = id(disk)
+        with self._mu:
+            if self._closed or op.stream._gen != self._gen:
+                # a stream born before the last close() must not respawn
+                # writers after server stop — its PUT aborts instead
+                raise PlaneClosed("writer plane closed")
+            w = self._writers.get(key)
+            if w is None or not w.is_alive():
+                w = _DriveWriter(
+                    disk, f"mt-putw-{next(WriterPlane._NAMES)}")
+                self._writers[key] = w
+            self.used = True
+        w.put(op, self.queue_bound())
+
+    def stats(self) -> dict[str, dict]:
+        """Per-drive {endpoint: {queue_depth, stalls, ops}} snapshot."""
+        with self._mu:
+            writers = list(self._writers.values())
+        out: dict[str, dict] = {}
+        for w in writers:
+            try:
+                ep = w.disk.endpoint()
+            except Exception:  # noqa: BLE001 — dead drive still counts
+                ep = f"drive-{id(w.disk):x}"
+            out[ep] = {"queue_depth": w.depth(), "stalls": w.stalls,
+                       "ops": w.ops}
+        return out
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop every writer: wake blocked enqueuers with PlaneClosed,
+        fail queued ops so drains return, join the threads.  The plane
+        reopens lazily for streams created AFTER the close (shared
+        layers outlive one server's lifecycle); streams already in
+        flight get PlaneClosed on their next enqueue — mid-stream PUTs
+        abort rather than respawning writers past server stop."""
+        with self._mu:
+            self._closed = True
+            self._gen += 1
+            writers = list(self._writers.values())
+            self._writers.clear()
+        per = timeout / max(1, len(writers))
+        for w in writers:
+            w.close(per)
+        with self._mu:
+            self._closed = False
+
+
+def planes_of(layer) -> list[WriterPlane]:
+    """Every writer plane under an object-layer topology."""
+    from ..objectlayer.metacache import leaf_layers_of
+    out = []
+    for leaf in leaf_layers_of(layer):
+        p = getattr(leaf, "_write_plane", None)
+        if p is not None:
+            out.append(p)
+    return out
+
+
+def close_write_planes(layer, timeout: float = 10.0) -> None:
+    """Server-stop hook: join every writer thread under ``layer`` (the
+    test_leaks contract — no mt-putw-* thread survives stop, even with
+    a blocked queue mid-stream)."""
+    for p in planes_of(layer):
+        try:
+            p.close(timeout)
+        except Exception:  # noqa: BLE001 — shutdown must proceed
+            pass

@@ -523,7 +523,8 @@ class XLStorage(StorageAPI):
 
     def write_data_commit(self, volume: str, path: str, fi: FileInfo,
                           data, shard_index: int | None = None,
-                          version_dict: dict | None = None) -> None:
+                          version_dict: dict | None = None,
+                          meta_gate=None) -> None:
         """Direct single-part PUT commit (hot path): part file written
         straight into its final data-dir location, version merged into
         xl.meta last.  Crash mid-write leaves an orphan uuid data dir the
@@ -535,7 +536,14 @@ class XLStorage(StorageAPI):
         the FileInfo ONCE and patches only the per-drive erasure index
         here, instead of deep-cloning two dataclasses per drive
         (cmd/erasure-object.go:614 writes a per-disk FileInfo the same
-        way, varying Erasure.Index only)."""
+        way, varying Erasure.Index only).
+
+        ``meta_gate`` (overlapped PUT): the part bytes — the GIL-free
+        bulk of this call — land FIRST, then the gate blocks until the
+        object's md5 resolved and yields the final version dict; the
+        merge below uses it.  A gate abort (BadDigest) raises before
+        any version becomes visible, leaving only an orphan data dir
+        the caller purges."""
         self._check_vol(volume)
         dst_obj = self._file_path(volume, path)
         try:
@@ -550,22 +558,6 @@ class XLStorage(StorageAPI):
                 raise errors.VolumeNotFound(volume) from None
             os.makedirs(dst_obj, exist_ok=True)   # nested object name
             fresh = True
-        meta = XLMeta()
-        old_ddir = ""
-        if not fresh:
-            try:
-                meta = self._read_meta(volume, path)
-                try:
-                    old_ddir = meta.find(fi.version_id).get("ddir", "")
-                except errors.FileVersionNotFound:
-                    pass
-            except (errors.FileNotFound, errors.FileCorrupt):
-                pass
-        vd = dict(version_dict) if version_dict is not None \
-            else fi.to_dict()
-        if shard_index is not None:
-            vd["ec"] = dict(vd["ec"], index=shard_index)
-        meta.add_version_dict(vd)
         if fi.data_dir:
             ddir = dst_obj + "/" + fi.data_dir
             os.mkdir(ddir)
@@ -583,6 +575,31 @@ class XLStorage(StorageAPI):
                 finally:
                     os.close(fd)
             _fsync_dir(ddir)
+        if meta_gate is not None:
+            # md5 beside the write above; the park is caller-side work,
+            # not drive time — keep it out of the latency windows that
+            # feed slow-drive detection (_traced_op subtracts it)
+            t_gate = time.monotonic_ns()
+            version_dict = meta_gate()
+            _IN_TRACED_OP.exclude_ns = getattr(
+                _IN_TRACED_OP, "exclude_ns", 0) \
+                + (time.monotonic_ns() - t_gate)
+        meta = XLMeta()
+        old_ddir = ""
+        if not fresh:
+            try:
+                meta = self._read_meta(volume, path)
+                try:
+                    old_ddir = meta.find(fi.version_id).get("ddir", "")
+                except errors.FileVersionNotFound:
+                    pass
+            except (errors.FileNotFound, errors.FileCorrupt):
+                pass
+        vd = dict(version_dict) if version_dict is not None \
+            else fi.to_dict()
+        if shard_index is not None:
+            vd["ec"] = dict(vd["ec"], index=shard_index)
+        meta.add_version_dict(vd)
         _write_file_atomic(dst_obj + "/" + META_FILE, meta.dump())
         _fsync_dir(dst_obj)
         if fresh:
@@ -778,6 +795,7 @@ def _traced_op(op: str, fn, in_arg: int | None):
         if getattr(_IN_TRACED_OP, "depth", 0):
             return fn(self, *a, **kw)
         _IN_TRACED_OP.depth = 1
+        _IN_TRACED_OP.exclude_ns = 0
         # monotonic for the duration (an NTP step must not corrupt the
         # latency windows feeding slow-drive detection); the wall clock
         # is read only when a span is actually published
@@ -792,7 +810,11 @@ def _traced_op(op: str, fn, in_arg: int | None):
             raise
         finally:
             _IN_TRACED_OP.depth = 0
-            dt = time.monotonic_ns() - t0
+            # an op may park on caller-side work mid-call (the
+            # overlapped commit's etag gate in write_data_commit);
+            # that wait is not drive time
+            dt = max(0, time.monotonic_ns() - t0
+                     - getattr(_IN_TRACED_OP, "exclude_ns", 0))
             nbytes = 0
             if in_arg is not None:
                 data = a[in_arg] if len(a) > in_arg \

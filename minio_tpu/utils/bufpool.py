@@ -1,0 +1,76 @@
+"""Reusable framed-buffer pool for the PUT pipeline.
+
+Every streaming batch erasure-encodes into a (k+m, framed_len) uint8
+array that is written out and thrown away.  On this class of host the
+allocation itself is not the cost — the FIRST TOUCH is: a fresh 6 MB
+numpy buffer page-faults ~1.5k times while the encode fills it, the
+same first-touch tax the bench measures as ``tmpfs_fresh_write_floor``
+for shard files.  Recycling the arrays keeps the pages hot, so batch
+N+1 encodes into memory batch N already faulted in.
+
+The pool is keyed by exact array shape (streaming batches are
+constant-size, so all but a stream's tail batch hit), bounded in total
+bytes, and thread-safe.  ``acquire`` never blocks: a miss allocates
+fresh and the bound only limits what ``release`` keeps.  Memory for
+the whole pipeline therefore stays O(pipeline_depth x batch): buffers
+are released back as each batch's drive writes complete, and the
+put loop bounds batches in flight to the ``pipeline.depth`` knob.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+# total bytes the GLOBAL pool may retain; with 64 MiB stream batches a
+# framed buffer is ~85 MiB, so this keeps a handful of batches across
+# concurrent streams without growing into a cache of dead shapes
+DEFAULT_MAX_BYTES = 512 * (1 << 20)
+
+
+class BufPool:
+    def __init__(self, max_bytes: int = DEFAULT_MAX_BYTES):
+        self._mu = threading.Lock()
+        self._free: dict[tuple, list[np.ndarray]] = {}
+        self._held = 0
+        self.max_bytes = max_bytes
+        self.hits = 0
+        self.misses = 0
+
+    def acquire(self, shape: tuple) -> np.ndarray:
+        """A uint8 array of ``shape`` — recycled when one is free,
+        freshly allocated otherwise (never blocks)."""
+        with self._mu:
+            lst = self._free.get(shape)
+            if lst:
+                arr = lst.pop()
+                self._held -= arr.nbytes
+                self.hits += 1
+                return arr
+            self.misses += 1
+        return np.empty(shape, dtype=np.uint8)
+
+    def release(self, arr: np.ndarray) -> None:
+        """Return an array for reuse; silently dropped once the pool
+        holds ``max_bytes`` (the GC then reclaims it as before)."""
+        if arr is None or arr.dtype != np.uint8 or not arr.flags.owndata:
+            return
+        with self._mu:
+            if self._held + arr.nbytes > self.max_bytes:
+                return
+            self._free.setdefault(arr.shape, []).append(arr)
+            self._held += arr.nbytes
+
+    def held_bytes(self) -> int:
+        with self._mu:
+            return self._held
+
+    def clear(self) -> None:
+        with self._mu:
+            self._free.clear()
+            self._held = 0
+
+
+# process-wide pool shared by every erasure layer's put pipeline
+GLOBAL = BufPool()

@@ -100,6 +100,17 @@ register_subsys("drive", {
     "slow_latency_multiple": "4",
     "slow_min_samples": "10",
 })
+register_subsys("pipeline", {
+    # pipelined PUT data plane (storage/writers.py + the put loops in
+    # objectlayer/erasure_object.py): ``depth`` bounds encoded batches
+    # in flight per stream (framed buffers + md5 chain + readahead —
+    # memory stays O(depth x batch); 0 disables the pipeline and
+    # restores the serial per-batch fan-out), ``queue_depth`` bounds
+    # each drive's writer queue (enqueue blocks at the bound).  Both
+    # are read live: admin SetConfigKV retunes a running server.
+    "depth": "2",
+    "queue_depth": "2",
+})
 register_subsys("storage_class", {
     "standard": "",                 # e.g. EC:4
     "rrs": "EC:2",

@@ -351,6 +351,57 @@ def test_peer_kill_mid_put_quorum_commit_and_breaker(chaos_cluster):
         srv2.stop()
 
 
+def test_peer_kill_mid_stream_with_writer_queues(chaos_cluster,
+                                                 monkeypatch):
+    """The peer-kill drill on the PIPELINED path: a streaming PUT with
+    per-drive writer queues in flight loses a 2-drive peer between
+    batches.  The queued ops for the dead drives fail (breaker-fast),
+    errors latch, the 4 surviving drives hold write quorum, and the
+    commit lands byte-correct."""
+    import io
+
+    import minio_tpu.objectlayer.erasure_object as eo
+    nodes = chaos_cluster
+    layer0 = nodes[0].layer
+    for s in layer0.sets:
+        s._pipe_depth = 2           # force the plane on any host
+        s._pipe_queue_depth = 2
+    # small stream batches so one PUT spans several writer rounds
+    monkeypatch.setattr(eo, "STREAM_BATCH_BYTES", 256 * 1024)
+    es = layer0.sets[0]
+    batch = es._stream_batch_size()
+    layer0.make_bucket("chaosq")
+    body = os.urandom(4 * batch + 1234)
+
+    killed = threading.Event()
+
+    class KillerReader:
+        """Kills node2's RPC plane after the second batch is served —
+        its two drives die with creates already queued/landed."""
+
+        def __init__(self, data):
+            self._f = io.BytesIO(data)
+            self._served = 0
+
+        def read(self, n=-1):
+            c = self._f.read(n)
+            self._served += len(c)
+            if self._served >= 2 * batch and not killed.is_set():
+                killed.set()
+                nodes[2].rpc.stop()
+            return c
+
+    layer0.put_object_stream("chaosq", "queued", KillerReader(body))
+    assert killed.is_set()
+    _, got = layer0.get_object("chaosq", "queued")
+    assert got == body
+    # quorum math held: exactly the peer's drives are object-less
+    fis, errs = es._fanout(
+        lambda d: d.read_version("chaosq", "queued", None))
+    assert sum(1 for f in fis if f is not None) == 4
+    assert sum(1 for e in errs if e is not None) == 2
+
+
 # -- lock refresh under partition -------------------------------------------
 
 def test_lock_refresh_partition_raises_lock_lost():

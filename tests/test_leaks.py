@@ -113,6 +113,115 @@ def test_egress_workers_stop_with_server(tmp_path, monkeypatch):
     assert not any(t in global_logger.targets for t in owned)
 
 
+def test_writer_plane_threads_stop_with_server(tmp_path):
+    """Per-drive writer threads (mt-putw-*) die with the server — even
+    when stop() lands mid-stream with a writer queue BLOCKED on a hung
+    drive op and the PUT loop stalled at the enqueue bound.  The md5
+    chain rides the layer's shared pool (no threads of its own), so
+    nothing md5-shaped can leak either."""
+    import io
+
+    from minio_tpu.objectlayer import erasure_object as eo
+
+    # earlier suites in the same process may hold idle writer threads on
+    # layers they never stopped; this test's contract is scoped to the
+    # threads THIS server's plane starts
+    preexisting = {id(th) for th in threading.enumerate()
+                   if th.name.startswith("mt-putw")}
+    release = threading.Event()
+
+    class BlockingDisk:
+        """First append parks until released (a hung drive)."""
+
+        def __init__(self, inner):
+            self._inner = inner
+            self.blocked = threading.Event()
+
+        @property
+        def root(self):
+            return self._inner.root
+
+        def append_file(self, volume, path, data):
+            self.blocked.set()
+            release.wait(20)
+            return self._inner.append_file(volume, path, data)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"wd{i}"
+        d.mkdir()
+        inner = XLStorage(str(d))
+        disks.append(BlockingDisk(inner) if i == 0 else inner)
+    layer = ErasureObjects(disks, parity=2, block_size=4096,
+                           backend="numpy")
+    layer._pipe_depth = 2
+    layer._pipe_queue_depth = 1
+    old_batch = eo.STREAM_BATCH_BYTES
+    eo.STREAM_BATCH_BYTES = 2 * 4096
+    srv = S3Server(layer, access_key="wp", secret_key="wp")
+    layer._pipe_depth = 2              # server reload may have reset it
+    layer._pipe_queue_depth = 1
+    srv.start()
+    try:
+        layer.make_bucket("wpbkt")
+        body = b"z" * (40 * 4096)
+        put_err: list = []
+
+        def put():
+            try:
+                layer.put_object_stream("wpbkt", "obj", io.BytesIO(body))
+            except Exception as e:  # noqa: BLE001 — asserted below
+                put_err.append(e)
+
+        t = threading.Thread(target=put, daemon=True)
+        t.start()
+        # wait until the hung drive blocks and its queue backs up
+        assert disks[0].blocked.wait(10)
+        def plane_threads():
+            return [th for th in threading.enumerate()
+                    if th.name.startswith("mt-putw")
+                    and id(th) not in preexisting]
+
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not plane_threads():
+            time.sleep(0.02)
+        assert plane_threads()
+        # unblock the hung op shortly AFTER stop starts joining
+        threading.Timer(0.4, release.set).start()
+        srv.stop()                      # closes the writer plane
+        t.join(15)
+        assert not t.is_alive()
+        # the aborted PUT surfaced an error (PlaneClosed directly, or
+        # quorum loss once every drive's queued ops failed with it)
+        assert put_err, "mid-stream PUT survived server stop"
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and any(
+                th.is_alive() for th in plane_threads()):
+            time.sleep(0.05)
+        leftover = [th.name for th in plane_threads() if th.is_alive()]
+        assert not leftover, leftover
+        # no tmp staging left behind by the aborted stream
+        for d in disks:
+            root = d.root if hasattr(d, "root") else d._inner.root
+            import glob as _glob
+            import os as _os
+            tmps = [p for p in _glob.glob(
+                _os.path.join(root, ".mt.sys", "tmp", "*"))
+                if _os.path.isdir(p)]
+            assert not tmps, tmps
+        # the plane reopens lazily: the layer keeps working afterwards
+        layer.put_object_stream("wpbkt", "after", io.BytesIO(body))
+        assert layer.get_object("wpbkt", "after")[1] == body
+    finally:
+        release.set()
+        eo.STREAM_BATCH_BYTES = old_batch
+        from minio_tpu.storage.writers import close_write_planes
+        close_write_planes(layer)
+
+
 def test_rpc_server_stop_closes_listener(tmp_path):
     from minio_tpu.parallel.rpc import RPCClient, RPCError, RPCServer
     srv = RPCServer("leaksecret")

@@ -1,0 +1,523 @@
+"""Pipelined PUT data plane (storage/writers.py + the pipelined loops in
+objectlayer/erasure_object.py).
+
+Contracts pinned here:
+  * bit-identity — the pipelined streaming PUT and the overlapped bytes
+    commit produce byte-identical xl.meta + part files vs the serial
+    path (same FileInfo, same framed bytes, same on-disk layout);
+  * per-drive ordering — create then appends then commit, strictly
+    in-order on each drive's writer queue;
+  * failure semantics — mid-stream drive death latches and quorum
+    commits with the survivors; quorum loss aborts with tmp cleanup;
+    BadDigest on the overlapped bytes path leaves no trace; lock loss
+    (ensure_valid) aborts before any commit op is queued;
+  * observability — mt_put_pipeline_* families appear once the plane
+    carried ops (queue depth, enqueue stalls, overlap efficiency).
+"""
+
+import glob
+import hashlib
+import io
+import itertools
+import os
+import threading
+import uuid
+
+import pytest
+
+from minio_tpu.objectlayer import erasure_object as eo
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.objectlayer.interface import (ObjectNotFound,
+                                             PutObjectOptions,
+                                             WriteQuorumError)
+from minio_tpu.storage import errors as serrors
+from minio_tpu.storage.writers import close_write_planes
+from minio_tpu.storage.xl_storage import XLStorage
+
+BS = 4096
+
+
+def pattern(n: int) -> bytes:
+    return (b"0123456789abcdef" * (n // 16 + 1))[:n]
+
+
+def mk_layer(root, n=6, parity=2, depth=2, qd=2, wrap=None):
+    disks = []
+    for i in range(n):
+        d = root / f"d{i}"
+        d.mkdir(parents=True)
+        disk = XLStorage(str(d))
+        disks.append(wrap(i, disk) if wrap else disk)
+    lay = ErasureObjects(disks, parity=parity, block_size=BS,
+                         backend="numpy", inline_threshold=512)
+    lay._pipe_depth = depth          # force regardless of core count
+    lay._pipe_queue_depth = qd
+    lay.make_bucket("pbkt")
+    return lay
+
+
+def det_uuids(monkeypatch):
+    """Deterministic uuid4 sequence so two PUT runs mint identical
+    version/data-dir ids (the bit-identity comparisons need it)."""
+    ctr = itertools.count(1)
+    monkeypatch.setattr(uuid, "uuid4",
+                        lambda: uuid.UUID(int=next(ctr)))
+
+
+def disk_state(lay, obj):
+    """{drive_index: (xl.meta bytes, [part bytes...])} for an object."""
+    out = {}
+    for i, d in enumerate(lay.disks):
+        root = d.root if hasattr(d, "root") else d._inner.root
+        base = os.path.join(root, "pbkt", obj)
+        meta_b = b""
+        mp = os.path.join(base, "xl.meta")
+        if os.path.exists(mp):
+            meta_b = open(mp, "rb").read()
+        parts = [open(f, "rb").read() for f in
+                 sorted(glob.glob(os.path.join(base, "*", "part.*")))]
+        out[i] = (meta_b, parts)
+    return out
+
+
+@pytest.fixture()
+def small_batches(monkeypatch):
+    monkeypatch.setattr(eo, "STREAM_BATCH_BYTES", 2 * BS)
+
+
+# -- bit-identity ------------------------------------------------------------
+
+def test_pipelined_stream_bit_identical_to_serial(tmp_path, monkeypatch,
+                                                  small_batches):
+    body = pattern(23 * BS + 321)
+    opts = dict(mod_time=1_234_567_890)
+    states = {}
+    for mode, depth in (("serial", 0), ("pipe", 2)):
+        det_uuids(monkeypatch)
+        lay = mk_layer(tmp_path / mode, depth=depth)
+        oi = lay.put_object_stream("pbkt", "obj", io.BytesIO(body),
+                                   PutObjectOptions(**opts))
+        assert oi.etag == hashlib.md5(body).hexdigest()
+        states[mode] = disk_state(lay, "obj")
+        close_write_planes(lay)
+    assert states["serial"] == states["pipe"]
+    # sanity: the comparison actually saw shard files + metadata
+    assert all(meta and parts for meta, parts in states["pipe"].values())
+
+
+def test_overlapped_bytes_commit_bit_identical(tmp_path, monkeypatch):
+    """The gated bytes commit (part bytes land while md5 runs, the
+    xl.meta merge waits on the etag gate) must leave exactly what the
+    ungated write_data_commit path leaves."""
+    monkeypatch.setattr(eo, "_SINGLE_CORE", False)  # engage etag future
+    body = os.urandom(2 * (1 << 20))
+    states = {}
+    for mode, depth in (("serial", 0), ("pipe", 2)):
+        det_uuids(monkeypatch)
+        lay = mk_layer(tmp_path / mode, depth=depth)
+        oi = lay.put_object("pbkt", "obj", body,
+                            PutObjectOptions(mod_time=1_234_567_890))
+        assert oi.etag == hashlib.md5(body).hexdigest()
+        states[mode] = disk_state(lay, "obj")
+        close_write_planes(lay)
+    assert states["serial"] == states["pipe"]
+    assert all(meta and parts for meta, parts in states["pipe"].values())
+
+
+def test_overwrite_purges_replaced_data_dir(tmp_path, monkeypatch):
+    """The gated commit must purge the version's replaced data dir like
+    the ungated path does — an overwrite may not leak shard files."""
+    monkeypatch.setattr(eo, "_SINGLE_CORE", False)
+    lay = mk_layer(tmp_path)
+    for _ in range(3):
+        lay.put_object("pbkt", "ow", os.urandom(2 * (1 << 20)))
+    for d in lay.disks:
+        ddirs = [p for p in glob.glob(os.path.join(d.root, "pbkt", "ow",
+                                                   "*"))
+                 if os.path.isdir(p)]
+        assert len(ddirs) == 1, ddirs
+
+
+def test_bad_digest_overlapped_leaves_no_trace(tmp_path, monkeypatch):
+    monkeypatch.setattr(eo, "_SINGLE_CORE", False)
+    lay = mk_layer(tmp_path)
+    body = os.urandom(2 * (1 << 20))
+    with pytest.raises(serrors.StorageError, match="BadDigest"):
+        lay.put_object("pbkt", "bad", body,
+                       PutObjectOptions(content_md5="0" * 32))
+    with pytest.raises(ObjectNotFound):
+        lay.get_object_info("pbkt", "bad")
+    for d in lay.disks:
+        assert not glob.glob(os.path.join(d.root, "pbkt", "bad", "**",
+                                          "part.*"), recursive=True)
+
+
+# -- zero-copy bytes satellite ----------------------------------------------
+
+def test_large_bytes_body_streams_zero_copy(tmp_path, monkeypatch,
+                                            small_batches):
+    """bytes bodies above the stream batch ride the streaming pipeline
+    via memoryview slices — byte-identical to the reader path."""
+    body = pattern(17 * BS + 99)
+    calls = []
+    orig = ErasureObjects._put_object_streaming
+
+    def spy(self, bucket, object_name, chunks, opts, readahead_body=True):
+        calls.append(readahead_body)
+        return orig(self, bucket, object_name, chunks, opts,
+                    readahead_body)
+
+    monkeypatch.setattr(ErasureObjects, "_put_object_streaming", spy)
+    states = {}
+    for mode, feed in (("reader", io.BytesIO(body)), ("bytes", body)):
+        det_uuids(monkeypatch)
+        lay = mk_layer(tmp_path / mode)
+        oi = lay.put_object("pbkt", "obj", feed,
+                            PutObjectOptions(mod_time=1_234_567_890))
+        assert oi.etag == hashlib.md5(body).hexdigest()
+        assert lay.get_object("pbkt", "obj")[1] == body
+        states[mode] = disk_state(lay, "obj")
+        close_write_planes(lay)
+    # bytes body took the no-readahead (memoryview) streaming feed
+    assert calls == [True, False]
+    assert states["reader"] == states["bytes"]
+
+
+# -- failure semantics -------------------------------------------------------
+
+class DyingDisk:
+    """Fails every write op after ``fail_after`` append calls."""
+
+    def __init__(self, inner, fail_after=10**9):
+        self._inner = inner
+        self.fail_after = fail_after
+        self.appends = 0
+
+    @property
+    def root(self):
+        return self._inner.root
+
+    def append_file(self, volume, path, data):
+        self.appends += 1
+        if self.appends > self.fail_after:
+            raise serrors.FaultyDisk("died mid-stream")
+        return self._inner.append_file(volume, path, data)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_drive_death_mid_stream_quorum_commit(tmp_path, small_batches):
+    """One drive dying mid-stream (writer queues in flight) latches; the
+    survivors reach quorum and the object commits correctly."""
+    lay = mk_layer(tmp_path, wrap=lambda i, d:
+                   DyingDisk(d, fail_after=2 if i == 0 else 10**9))
+    body = pattern(30 * BS + 11)
+    oi = lay.put_object_stream("pbkt", "obj", io.BytesIO(body))
+    assert oi.etag == hashlib.md5(body).hexdigest()
+    assert lay.get_object("pbkt", "obj")[1] == body
+    # the dead drive was skipped after its first failure (no futile
+    # appends kept hitting it) and holds no committed object
+    dead = lay.disks[0]
+    assert dead.appends <= 4
+    assert not os.path.exists(os.path.join(dead.root, "pbkt", "obj",
+                                           "xl.meta"))
+    close_write_planes(lay)
+
+
+def test_quorum_loss_mid_stream_aborts_and_cleans(tmp_path, small_batches):
+    """Three of six drives dying (parity 2, wq 4 -> 3 alive) aborts the
+    stream; staged tmp files are cleaned and nothing is committed."""
+    lay = mk_layer(tmp_path, wrap=lambda i, d:
+                   DyingDisk(d, fail_after=2 if i < 3 else 10**9))
+    body = pattern(30 * BS)
+    with pytest.raises(WriteQuorumError):
+        lay.put_object_stream("pbkt", "obj", io.BytesIO(body))
+    for d in lay.disks:
+        assert not os.path.exists(os.path.join(d.root, "pbkt", "obj"))
+        tmps = [p for p in glob.glob(os.path.join(
+            d.root, ".mt.sys", "tmp", "*")) if os.path.isdir(p)]
+        assert not tmps, tmps
+    close_write_planes(lay)
+
+
+class LostLock:
+    def __init__(self):
+        self.locked = False
+
+    def lock(self, write=True):
+        self.locked = True
+
+    def unlock(self):
+        self.locked = False
+
+    def ensure_valid(self):
+        raise serrors.StorageError("lock lost (grants expired)")
+
+
+def test_lock_loss_aborts_before_commit_queues_drained(tmp_path,
+                                                       small_batches):
+    lay = mk_layer(tmp_path)
+    lk = LostLock()
+    lay.ns_lock = type("NS", (), {
+        "new_lock": lambda self, b, o: lk})()
+    with pytest.raises(serrors.StorageError, match="lock lost"):
+        lay.put_object_stream("pbkt", "obj",
+                              io.BytesIO(pattern(10 * BS)))
+    assert not lk.locked                   # released on the abort path
+    for d in lay.disks:
+        # commit never ran: no version anywhere, tmps cleaned
+        assert not os.path.exists(os.path.join(d.root, "pbkt", "obj"))
+        assert not [p for p in glob.glob(os.path.join(
+            d.root, ".mt.sys", "tmp", "*")) if os.path.isdir(p)]
+    close_write_planes(lay)
+
+
+def test_multipart_part_pipelined_matches_serial(tmp_path, monkeypatch,
+                                                 small_batches):
+    body = pattern(9 * BS + 7)
+    etags = {}
+    for mode, depth in (("serial", 0), ("pipe", 2)):
+        lay = mk_layer(tmp_path / mode, depth=depth)
+        lay.enforce_min_part_size = False
+        uid = lay.new_multipart_upload("pbkt", "mp")
+        pi = lay.put_object_part("pbkt", "mp", uid, 1, io.BytesIO(body))
+        pi2 = lay.put_object_part("pbkt", "mp", uid, 2, body)
+        assert pi.etag == pi2.etag == hashlib.md5(body).hexdigest()
+        lay.complete_multipart_upload("pbkt", "mp", uid,
+                                      [(1, pi.etag), (2, pi2.etag)])
+        oi, got = lay.get_object("pbkt", "mp")
+        assert got == body + body
+        etags[mode] = oi.etag
+        close_write_planes(lay)
+    assert etags["serial"] == etags["pipe"]
+
+
+# -- remote drives: queued writers across an RPC -----------------------------
+
+def test_remote_peer_kill_mid_stream_queued_writers(tmp_path,
+                                                    small_batches):
+    """Two of six drives live behind an RPC peer that dies between
+    batches: queued-writer errors latch, quorum (4/6) holds, and the
+    commit lands — the chaos peer-kill drill on the pipelined path."""
+    from minio_tpu.parallel.rpc import RPCClient, RPCServer
+    from minio_tpu.storage.remote import (RemoteStorage,
+                                          register_storage_service)
+    remote_drives = {}
+    for i in range(2):
+        d = tmp_path / f"r{i}"
+        d.mkdir()
+        remote_drives[f"r{i}"] = XLStorage(str(d))
+    rpc = RPCServer("pipesecret")
+    register_storage_service(rpc, remote_drives)
+    rpc.start()
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"l{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    for i in range(2):
+        disks.append(RemoteStorage(
+            RPCClient(rpc.endpoint, "pipesecret"), f"r{i}"))
+    lay = ErasureObjects(disks, parity=2, block_size=BS,
+                         backend="numpy", inline_threshold=512)
+    lay._pipe_depth = 2
+    lay.make_bucket("pbkt")
+    body = pattern(40 * BS)
+
+    killed = threading.Event()
+
+    class KillerReader:
+        """Body source that kills the peer after the second batch."""
+
+        def __init__(self, data):
+            self._f = io.BytesIO(data)
+            self._served = 0
+
+        def read(self, n=-1):
+            c = self._f.read(n)
+            self._served += len(c)
+            if self._served >= 4 * 2 * BS and not killed.is_set():
+                killed.set()
+                rpc.stop()
+            return c
+
+    oi = lay.put_object_stream("pbkt", "obj", KillerReader(body))
+    assert killed.is_set()
+    assert oi.etag == hashlib.md5(body).hexdigest()
+    assert lay.get_object("pbkt", "obj")[1] == body
+    # the remote drives never saw the commit
+    for i in range(2):
+        assert not os.path.exists(
+            os.path.join(str(tmp_path / f"r{i}"), "pbkt", "obj",
+                         "xl.meta"))
+    close_write_planes(lay)
+
+
+# -- observability -----------------------------------------------------------
+
+class SlowDisk:
+    def __init__(self, inner, delay=0.004):
+        self._inner = inner
+        self._delay = delay
+
+    @property
+    def root(self):
+        return self._inner.root
+
+    def append_file(self, volume, path, data):
+        import time
+        time.sleep(self._delay)
+        return self._inner.append_file(volume, path, data)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_pipeline_metrics_families_and_stalls(tmp_path, small_batches):
+    from minio_tpu.admin import metrics
+    lay = mk_layer(tmp_path, qd=1,
+                   wrap=lambda i, d: SlowDisk(d) if i == 0 else d)
+    # idle contract: plane unused -> no families
+    assert "mt_put_pipeline" not in metrics.render(lay)
+    body = pattern(20 * BS)
+    lay.put_object_stream("pbkt", "obj", io.BytesIO(body))
+    text = metrics.render(lay)
+    for fam in ("mt_put_pipeline_queue_depth",
+                "mt_put_pipeline_enqueue_stalls_total",
+                "mt_put_pipeline_writes_total",
+                "mt_put_pipeline_overlap_efficiency",
+                "mt_put_pipeline_batch_wall_seconds"):
+        assert f"# TYPE {fam} " in text, fam
+    stats = lay._write_plane.stats()
+    assert sum(s["stalls"] for s in stats.values()) > 0
+    assert 0 < lay._pipe_stats["overlap_efficiency"] <= 1.5
+    close_write_planes(lay)
+
+
+def test_bufpool_recycles_framed_buffers(tmp_path, small_batches):
+    from minio_tpu.utils import bufpool
+    lay = mk_layer(tmp_path)
+    h0, m0 = bufpool.GLOBAL.hits, bufpool.GLOBAL.misses
+    body = pattern(20 * BS)            # 10 equal batches
+    lay.put_object_stream("pbkt", "obj", io.BytesIO(body))
+    assert lay.get_object("pbkt", "obj")[1] == body
+    # all but the first (and any raced) full batch reuse a buffer
+    assert bufpool.GLOBAL.hits - h0 >= 5
+    assert bufpool.GLOBAL.misses - m0 <= 4
+    close_write_planes(lay)
+
+
+def test_meta_gate_wait_excluded_from_drive_latency(tmp_path):
+    """The etag-gate park inside write_data_commit is caller-side md5
+    time, not drive time — it must not inflate the drive's latency
+    windows feeding slow-drive detection."""
+    import time
+
+    from minio_tpu.storage.datatypes import ErasureInfo, FileInfo, now_ns
+    root = tmp_path / "lat"
+    root.mkdir()
+    d = XLStorage(str(root))
+    d.make_vol("bkt")
+    fi = FileInfo(volume="bkt", name="o", version_id="", data_dir="dd",
+                  mod_time=now_ns(), size=8,
+                  erasure=ErasureInfo(data_blocks=2, parity_blocks=1,
+                                      block_size=1024, index=1,
+                                      distribution=[1, 2, 3]))
+    recorded = []
+
+    class _RecWindows:
+        def record(self, op, dt, nbytes=0, now_s=None):
+            recorded.append((op, dt))
+
+    d.latency = _RecWindows()
+
+    def gate():
+        time.sleep(0.2)
+        return fi.to_dict()
+
+    t0 = time.monotonic_ns()
+    d.write_data_commit("bkt", "o", fi, b"12345678", meta_gate=gate)
+    wall = time.monotonic_ns() - t0
+    assert d.read_version("bkt", "o", "") is not None
+    dts = [dt for op, dt in recorded if op == "write_data_commit"]
+    # recorded drive time must be the call wall minus (at least most of)
+    # the 200ms gate park — i.e. the park was subtracted, whatever the
+    # actual I/O weather
+    assert dts and dts[0] <= wall - int(0.18 * 1e9), (dts, wall)
+
+
+def test_plane_close_fences_inflight_streams(tmp_path):
+    """close() must not let a stream born BEFORE the close respawn
+    writer threads afterwards (a PUT between enqueues at server-stop
+    time aborts with PlaneClosed), while streams created after the
+    close lazily reopen the plane (shared layers outlive one server)."""
+    from minio_tpu.storage.writers import PlaneClosed, WriterPlane
+
+    root = tmp_path / "fence"
+    root.mkdir()
+    disk = XLStorage(str(root))
+    # earlier suites may hold idle writer threads on planes they never
+    # closed; this test's contract covers only THIS plane's threads
+    preexisting = {id(t) for t in threading.enumerate()
+                   if t.name.startswith("mt-putw")}
+    plane = WriterPlane(queue_depth=2)
+
+    old = plane.stream([disk])
+    ran = []
+    old.submit(0, lambda i, d: ran.append(i))
+    assert old.drain(5) and ran == [0]
+
+    mine = [t for t in threading.enumerate()
+            if t.name.startswith("mt-putw")
+            and id(t) not in preexisting]
+    assert mine
+    plane.close()
+    # the pre-close stream is fenced: no lazy respawn past server stop
+    with pytest.raises(PlaneClosed):
+        old.submit(0, lambda i, d: ran.append(i))
+    assert not [t for t in mine if t.is_alive()]
+    # a stream minted after the close reopens the plane
+    fresh = plane.stream([disk])
+    fresh.submit(0, lambda i, d: ran.append(99))
+    assert fresh.drain(5) and ran == [0, 99]
+    plane.close()
+
+
+def test_when_drive_idle_defers_past_hung_op(tmp_path):
+    """Cleanup scheduled while a drive op is still running must wait
+    for that op to settle (the drain-timeout case: rmtree racing a
+    stuck append's makedirs would resurrect the tmp dir), and must run
+    immediately on an already-idle drive."""
+    from minio_tpu.storage.writers import WriterPlane
+
+    root = tmp_path / "idle"
+    root.mkdir()
+    disk = XLStorage(str(root))
+    plane = WriterPlane(queue_depth=2)
+    sw = plane.stream([disk])
+
+    entered = threading.Event()
+    release = threading.Event()
+    order = []
+
+    def hung(i, d):
+        entered.set()
+        release.wait(10)
+        order.append("op")
+
+    sw.submit(0, hung)
+    assert entered.wait(5)
+    sw.when_drive_idle(0, lambda: order.append("cleanup"))
+    assert order == []              # deferred: the op still runs
+    release.set()
+    assert sw.drain(5)
+    deadline = 50
+    while order != ["op", "cleanup"] and deadline:
+        threading.Event().wait(0.02)
+        deadline -= 1
+    assert order == ["op", "cleanup"]
+    # idle drive: immediate, on the calling thread
+    sw.when_drive_idle(0, lambda: order.append("now"))
+    assert order == ["op", "cleanup", "now"]
+    plane.close()
