@@ -508,6 +508,111 @@ def _bench_baseline_configs() -> dict | None:
             shutil.rmtree(root, ignore_errors=True)
 
 
+def _bench_md5_lanes(body: bytes) -> dict | None:
+    """Native multi-lane MD5 sweep (ISSUE 6): single-stream native rate
+    plus aggregate throughput of N concurrent streams sharing the lane
+    scheduler at ``pipeline.md5_lanes`` = N — the new strict-ETag
+    ceiling for concurrent PUTs/multipart parts.  Returns
+    {md5_native_GiBps, md5_hashlib_GiBps, lanes: {N: aggregate}}."""
+    import threading
+
+    from minio_tpu.hashing import md5fast
+    if not md5fast.available():
+        return None
+    obj_size = len(body)
+
+    def rate(fn, streams=1, reps=6) -> float:
+        fn()                                        # warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            ts = [threading.Thread(target=fn) for _ in range(streams)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+        dt = time.perf_counter() - t0
+        return reps * streams * obj_size / dt / 2**30
+
+    import hashlib as _hl
+    out = {
+        "md5_hashlib_GiBps": round(
+            rate(lambda: _hl.md5(body)), 3),
+        "md5_native_GiBps": round(
+            rate(lambda: md5fast.MD5Fast(body)), 3),
+        "lanes_aggregate_GiBps": {},
+    }
+
+    def one_sched():
+        h = md5fast.md5()
+        mv = memoryview(body)
+        for off in range(0, obj_size, md5fast.ONESHOT_SLICE):
+            md5fast.SCHED.update(h, mv[off:off + md5fast.ONESHOT_SLICE])
+
+    try:
+        for lanes in (1, 2, 4, 8):
+            md5fast.SCHED.set_lanes(lanes)
+            out["lanes_aggregate_GiBps"][str(lanes)] = round(
+                rate(one_sched, streams=lanes, reps=4), 3)
+    finally:
+        md5fast.SCHED.set_lanes(4)
+    return out
+
+
+def _bench_stream_chunks(body: bytes, base_dir: str | None) -> dict | None:
+    """Internode streaming sweep (ISSUE 6): one remote drive behind a
+    real loopback RPC, whole-shard create_file at each
+    ``rpc.stream_chunk_bytes`` setting (off = the materialized raw
+    call) — makes the frame-size knob's cost/benefit driver-visible."""
+    import shutil
+    import tempfile
+
+    from minio_tpu.parallel.rpc import STREAM, RPCClient, RPCServer
+    from minio_tpu.storage.remote import (RemoteStorage,
+                                          register_storage_service)
+    from minio_tpu.storage.xl_storage import XLStorage
+    root = tempfile.mkdtemp(prefix="bench-stream-", dir=base_dir)
+    rpc = None
+    prev = (STREAM.enable, STREAM.chunk_bytes, STREAM._loaded)
+    try:
+        dpath = os.path.join(root, "rd")
+        os.makedirs(dpath)
+        drive = XLStorage(dpath)
+        drive.make_vol("benchvol")
+        rpc = RPCServer("benchsecret")
+        register_storage_service(rpc, {"rd": drive})
+        rpc.start()
+        r = RemoteStorage(RPCClient(rpc.endpoint, "benchsecret"), "rd")
+        out = {}
+        seq = [0]
+        for label, chunk in (("off", 0), ("2MiB", 2 << 20),
+                             ("1MiB", 1 << 20), ("256KiB", 256 << 10)):
+            STREAM.enable = chunk > 0
+            STREAM.chunk_bytes = chunk or (1 << 20)
+            STREAM._loaded = True
+            reps = 8
+
+            def put():
+                seq[0] += 1
+                r.create_file("benchvol", f"s-{seq[0]}", body,
+                              file_size=len(body))
+            put()                                    # warm
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                put()
+            dt = time.perf_counter() - t0
+            out[label] = round(reps * len(body) / dt / 2**30, 3)
+        return out
+    except Exception as e:  # noqa: BLE001 — optional leg
+        import sys
+        print(f"stream-chunk leg failed: {e!r}", file=sys.stderr)
+        return None
+    finally:
+        STREAM.enable, STREAM.chunk_bytes, STREAM._loaded = prev
+        if rpc is not None:
+            rpc.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _bench_end_to_end_put() -> dict | None:
     """BASELINE config 5 end to end: 256 x 4 MiB PUTs through the REAL
     put_object pipeline (erasure encode + bitrot framing + staged
@@ -709,6 +814,11 @@ def _bench_end_to_end_put() -> dict | None:
                     shutil.rmtree(shm_root, ignore_errors=True)
 
         pipeline_stats = put_pipeline_leg()
+        md5_lane_stats = _bench_md5_lanes(body)
+        stream_chunk_stats = _bench_stream_chunks(
+            body, "/dev/shm" if (os.path.isdir("/dev/shm")
+                                 and os.access("/dev/shm", os.W_OK))
+            else None)
 
         # ---- throughput legs -------------------------------------------
         def run_leg(lay=None):
@@ -916,6 +1026,14 @@ def _bench_end_to_end_put() -> dict | None:
             # os.cpu_count() > 1.
             "strict_md5_bound_GiBps": round(
                 obj_size / (t_md5 / 1000) / 2**30, 3),
+            # the NEW ceilings (ISSUE 6): the native single-stream core
+            # raises the per-stream md5 bound, and the lane sweep shows
+            # the aggregate rate N concurrent strict streams share;
+            # the chunk sweep prices the internode framed mode
+            "md5_native_GiBps": (md5_lane_stats or {}).get(
+                "md5_native_GiBps"),
+            "md5_lane_sweep": md5_lane_stats,
+            "internode_stream_chunk_GiBps": stream_chunk_stats,
             # the tighter honest ceiling: md5 (compat-pinned, serial)
             # + the fresh-file write floor measured above — both
             # irreducible on 1 vCPU; everything else (encode, hash,
@@ -927,6 +1045,10 @@ def _bench_end_to_end_put() -> dict | None:
                 if shm_floor_ms else None),
             "stages_ms_per_4MiB": {
                 "md5_etag(strict only)": round(t_md5, 2),
+                "md5_etag_native": (round(
+                    obj_size / (md5_lane_stats["md5_native_GiBps"]
+                                * 2**30) * 1000, 2)
+                    if md5_lane_stats else None),
                 "erasure_encode_into_frames": round(t_encode, 2),
                 "bitrot_hh256_fill": round(t_hash, 2),
                 "drive_fanout_commit": round(t_commit, 2),

@@ -929,8 +929,12 @@ def _config(h, srv, route, q1, payload, send_json) -> bool:
             srv.reload_api_config()
         if parts[1] == "pipeline":
             # retune the PUT data plane (pipeline depth, per-drive
-            # writer queue depth) on the live layer
+            # writer queue depth, md5 lanes) on the live layer
             srv.reload_pipeline_config()
+        if parts[1] == "rpc":
+            # retune internode chunked streaming (stream_enable,
+            # stream_chunk_bytes) on the live RPC plane
+            srv.reload_rpc_config()
         if parts[1] in ("logger_webhook", "audit_webhook") \
                 or parts[1].startswith("notify_"):
             # rebuild the egress targets live: repointed endpoints and

@@ -30,7 +30,7 @@ from typing import Optional
 
 import numpy as np
 
-from ..hashing import bitrot
+from ..hashing import bitrot, md5fast
 from ..obs import trace as _trace
 from ..ops import gf8
 from ..ops.codec import Erasure
@@ -239,6 +239,11 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             qd = 2
         self._pipe_depth = 0 if self._serial_fanout else max(0, depth)
         self._pipe_queue_depth = max(1, qd)
+        try:
+            md5fast.SCHED.set_lanes(int(config.get("pipeline",
+                                                   "md5_lanes")))
+        except (KeyError, ValueError):
+            pass
 
     def _pipeline_on(self) -> bool:
         return self._pipe_depth > 0
@@ -458,7 +463,10 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         etag_future = None
         if (not _SINGLE_CORE and len(data) >= (1 << 20)
                 and (opts.content_md5 or _strict_compat()) and m > 0):
-            etag_future = self._pool.submit(hashlib.md5, data)
+            # md5_of routes through the lane scheduler in 1 MiB slices:
+            # concurrent PUTs' ETag passes coalesce into one multi-lane
+            # native call instead of running two full serial chains
+            etag_future = self._pool.submit(md5fast.md5_of, data)
         etag = None if etag_future is not None \
             else self._etag_for(data, opts)
         mod_time = opts.mod_time or now_ns()
@@ -612,7 +620,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         --no-compat (MT_NO_COMPAT=1), skipping the md5 pass entirely
         (pkg/hash/reader.go:186, cmd/object-api-utils.go:843-855)."""
         if opts.content_md5 or _strict_compat():
-            etag = hashlib.md5(data).hexdigest()
+            etag = md5fast.md5(data).hexdigest()
             if opts.content_md5 and etag != opts.content_md5.lower():
                 raise serrors.StorageError(
                     "Content-MD5 mismatch (BadDigest)")
@@ -767,15 +775,25 @@ class ErasureObjects(MultipartOps, ObjectLayer):
     @staticmethod
     def _md5_link(prev, h, chunk, stats) -> None:
         """One chained md5 update on the pool: waits for the previous
-        link (updates are order-dependent), then hashes its chunk.
-        hashlib releases the GIL for large buffers, so the chain truly
-        runs beside encode and the writer queues.  The chain never
-        deadlocks the pool: each link waits only on an EARLIER
-        submission, and the executor starts tasks FIFO."""
+        link (updates are order-dependent), then hashes its chunk
+        through the shared lane scheduler — concurrent streams'/parts'
+        links coalesce into one multi-lane native call
+        (hashing/md5fast.py; a lone stream degenerates to the plain
+        fast core).  Native and hashlib updates both release the GIL,
+        so the chain truly runs beside encode and the writer queues.
+        The chain never deadlocks the pool: each link waits only on an
+        EARLIER submission, and the executor starts tasks FIFO.
+
+        ``md5_s`` is the link's WALL time: under concurrent streams it
+        includes lane-scheduler sharing (parking while another stream's
+        combiner hashes this chunk, or combining other streams'
+        chunks), so per-PUT md5_s is a utilization view, not a pure
+        hash cost — single-stream runs (the bench's pipelined leg) are
+        unaffected."""
         if prev is not None:
             prev.result()
         t0 = time.perf_counter()
-        h.update(chunk)
+        md5fast.SCHED.update(h, chunk)
         stats["md5_s"] += time.perf_counter() - t0
 
     def _framed_fast_path(self, m: int) -> bool:
@@ -863,7 +881,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         from ..utils.readahead import readahead
         n = len(self.disks)
         tmps: list[str | None] = [None] * n
-        md5 = hashlib.md5() if (opts.content_md5 or _strict_compat()) \
+        md5 = md5fast.md5() if (opts.content_md5 or _strict_compat()) \
             else None
         stats = {"md5_s": 0.0, "encode_s": 0.0}
         depth = max(1, self._pipe_depth)
@@ -973,7 +991,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         errs: list[Exception | None] = [None] * n
         # md5 only when the client sent Content-MD5 or in strict-compat
         # mode — same policy as _etag_for (pkg/hash/reader.go:186)
-        md5 = hashlib.md5() if (opts.content_md5 or _strict_compat()) \
+        md5 = md5fast.md5() if (opts.content_md5 or _strict_compat()) \
             else None
         total = 0
 

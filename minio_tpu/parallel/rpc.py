@@ -14,6 +14,7 @@ from __future__ import annotations
 import hashlib
 import socket
 import hmac
+import struct
 import threading
 import time
 import urllib.parse
@@ -25,6 +26,188 @@ import msgpack
 from ..obs import trace as _trace
 
 TOKEN_WINDOW_S = 15 * 60
+
+# -- chunked internode streaming (cmd/storage-rest-server.go chunked
+# streams analog) ---------------------------------------------------------
+#
+# Bulk raw bodies larger than ``rpc.stream_chunk_bytes`` ride one POST as
+# length-prefixed frames the peer applies to the drive AS THEY LAND, so
+# per-connection memory is O(chunk) instead of O(shard) and the remote
+# leg of a PUT's fan-out overlaps the sender's encode chunk-by-chunk.
+#
+# Wire format (request body, header ``X-RPC-Stream: frames[+trailer]``,
+# no Content-Length — the framing is self-delimiting):
+#
+#     frame   := u32be length | payload        (length >= 1)
+#     end     := u32be 0                       (data frames done)
+#     trailer := u32be length | payload        (only in +trailer mode:
+#                                               one msgpack document
+#                                               AFTER the end marker —
+#                                               the commit's gated
+#                                               version dict)
+#     abort   := u32be 0xFFFFFFFF              (in place of end/trailer:
+#                                               sender gave up; receiver
+#                                               discards partial state)
+#
+# Streamed raw RESPONSES need no framing: the total length is known up
+# front (read_file_stream carries it), so the server keeps the ordinary
+# Content-Length reply and just writes it chunk-by-chunk from the drive
+# (header ``X-RPC-Stream: resp`` marks it for the byte accounting).
+
+_F_END = struct.pack(">I", 0)
+_F_ABORT = struct.pack(">I", 0xFFFFFFFF)
+_F_ABORT_N = 0xFFFFFFFF
+# sanity bound against a corrupt peer: one frame may never force the
+# receiver to materialize more than this (honest senders frame at
+# rpc.stream_chunk_bytes, orders of magnitude below)
+MAX_FRAME_BYTES = 64 << 20
+
+
+class StreamConfig:
+    """Live-reloadable streaming knobs (``rpc`` kvconfig subsystem:
+    ``stream_enable``, ``stream_chunk_bytes``).  Reads env/defaults
+    lazily on first use; the server pushes admin SetConfigKV values via
+    S3Server.reload_rpc_config (a fresh kvconfig.Config cannot see
+    another instance's dynamic layer)."""
+
+    def __init__(self):
+        self.enable = True
+        self.chunk_bytes = 1 << 20
+        self._loaded = False
+
+    def load(self, cfg=None) -> None:
+        try:
+            if cfg is None:
+                from ..utils.kvconfig import Config
+                cfg = Config()
+            self.enable = str(cfg.get("rpc", "stream_enable")
+                              ).strip().lower() not in ("off", "0",
+                                                        "false", "")
+            self.chunk_bytes = max(
+                4096, int(cfg.get("rpc", "stream_chunk_bytes")))
+        except (KeyError, ValueError):
+            pass
+        self._loaded = True
+
+    def chunk(self) -> int:
+        """Streaming threshold/slice size; 0 when streaming is off."""
+        if not self._loaded:
+            self.load()
+        return self.chunk_bytes if self.enable else 0
+
+
+STREAM = StreamConfig()
+
+
+class StreamBody:
+    """A framed streaming request body for RPCClient.raw_call.
+
+    ``chunks_fn`` returns a FRESH iterator of buffers per call (so a
+    breaker retry or stale-connection replay can resend the stream);
+    ``trailer_fn``, when set, is called after the last data frame went
+    out and yields the msgpack trailer bytes — the commit path resolves
+    its etag gate here, so the part bytes cross the wire WHILE the
+    digest still runs.  A trailer_fn exception aborts the stream (the
+    receiver discards partial state) and propagates to the caller.
+    ``sent`` records wire bytes of the last attempt (RPC accounting)."""
+
+    __slots__ = ("chunks_fn", "trailer_fn", "sent", "frames")
+
+    def __init__(self, chunks_fn, trailer_fn=None):
+        self.chunks_fn = chunks_fn
+        self.trailer_fn = trailer_fn
+        self.sent = 0
+        self.frames = 0
+
+
+class StreamAborted(Exception):
+    """The sender aborted a framed stream (abort marker on the wire)."""
+
+
+class _GateAbort(Exception):
+    """A trailer_fn raised AFTER the abort marker went out: carries the
+    gate's own exception past the transport-error triage (storage
+    errors subclass OSError, so type checks can't tell them apart from
+    socket failures)."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(str(cause))
+        self.cause_exc = cause
+
+
+def _read_exact(rfile, n: int) -> bytes:
+    buf = rfile.read(n)
+    if len(buf) != n:
+        raise ConnectionError(
+            f"truncated stream frame ({len(buf)}/{n} bytes)")
+    return buf
+
+
+class FrameReader:
+    """Server-side view of a framed request body: iterate the data
+    frames, then (in +trailer mode) ``read_trailer()``.  Exhausts the
+    wire exactly — after the terminator (and trailer) the connection is
+    back in sync for keep-alive reuse.  A mid-stream abort marker
+    raises StreamAborted from whichever read observes it."""
+
+    def __init__(self, rfile, trailer: bool = False):
+        self._rfile = rfile
+        self._trailer = trailer
+        self._trailer_done = not trailer
+        self._ended = False
+        self.aborted = False
+        self.frames = 0
+        self.bytes = 0
+
+    def _next_len(self) -> int:
+        n = struct.unpack(">I", _read_exact(self._rfile, 4))[0]
+        if n == _F_ABORT_N:
+            self.aborted = True
+            self._ended = True
+            raise StreamAborted("stream aborted by sender")
+        if n > MAX_FRAME_BYTES:
+            raise ConnectionError(f"oversized stream frame ({n} bytes)")
+        return n
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> bytes:
+        if self._ended:
+            raise StopIteration
+        n = self._next_len()
+        if n == 0:
+            self._ended = True
+            raise StopIteration
+        self.frames += 1
+        self.bytes += n
+        return _read_exact(self._rfile, n)
+
+    def read_trailer(self) -> bytes:
+        """The msgpack trailer document (only after the data frames
+        ended; drains them first if the handler didn't)."""
+        for _ in self:         # drain leftovers: trailer follows end
+            pass
+        self._trailer_done = True
+        n = self._next_len()
+        return _read_exact(self._rfile, n)
+
+    def drain(self) -> None:
+        """Consume whatever the sender still has in flight so the
+        connection stays usable for the (error) reply."""
+        try:
+            for _ in self:
+                pass
+            if not self._trailer_done and not self.aborted:
+                self._trailer_done = True
+                _read_exact(self._rfile, self._next_len())
+        except StreamAborted:
+            pass
+
+    def in_sync(self) -> bool:
+        """True when the wire is fully consumed (safe to reply and keep
+        the connection alive)."""
+        return self._ended and (self._trailer_done or self.aborted)
 
 # internode request-correlation header: carries the originating S3
 # frontend's request ID so spans emitted on a PEER node still name the
@@ -174,6 +357,7 @@ class RPCServer:
         self.secret = secret
         self._services: dict[str, dict[str, callable]] = {}
         self._raw: dict[str, callable] = {}
+        self._raw_stream: dict[str, callable] = {}
         # live connections, so stop() can sever them: without this a
         # "stopped" server keeps answering on established keep-alive
         # connections through parked handler threads — a killed peer
@@ -194,8 +378,18 @@ class RPCServer:
         data: bytes) -> bytes`` — bulk shard bytes ride the HTTP body
         directly instead of inside a msgpack document, so a transfer
         materializes once per side (storage-rest chunked streams,
-        cmd/storage-rest-server.go)."""
+        cmd/storage-rest-server.go).  ``fn`` may return ``(total,
+        iterator)`` instead of bytes: the reply carries Content-Length
+        ``total`` and is written chunk-by-chunk as the iterator yields
+        (a streamed GET never materializes the shard server-side)."""
         self._raw[name] = fn
+
+    def register_raw_stream(self, name: str, fn) -> None:
+        """Framed-streaming twin of a raw endpoint (``X-RPC-Stream``
+        requests land here): ``fn(params: dict, frames: FrameReader) ->
+        bytes | (total, iterator)`` — the handler applies each frame as
+        it arrives instead of materializing the body."""
+        self._raw_stream[name] = fn
 
     @property
     def endpoint(self) -> str:
@@ -219,6 +413,7 @@ class RPCServer:
     def _make_handler(srv_self):
         services = srv_self._services
         raw = srv_self._raw
+        raw_stream = srv_self._raw_stream
         secret = srv_self.secret
 
         class Handler(BaseHTTPRequestHandler):
@@ -248,13 +443,45 @@ class RPCServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _reply_raw(self, data: bytes):
+            def _reply_raw(self, data):
+                if isinstance(data, tuple):
+                    return self._reply_raw_streamed(*data)
                 self.send_response(200)
                 self.send_header("Content-Length", str(len(data)))
                 self.send_header("Content-Type",
                                  "application/octet-stream")
                 self.end_headers()
                 self.wfile.write(data)
+
+            def _reply_raw_streamed(self, total: int, it):
+                """Chunk-by-chunk raw reply with a known Content-Length
+                (the wire is identical to a materialized reply; only the
+                server's memory profile changes).  A source failing
+                mid-body cannot honor the declared length and the 200
+                is already on the wire — nothing sane can be sent (an
+                error doc would land INSIDE the expected body), so the
+                error is swallowed here (stream_err carries it to the
+                span) and the connection closes: the short body is a
+                clean transport error client-side (idempotent reads
+                retry)."""
+                self.send_response(200)
+                self.send_header("Content-Length", str(total))
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("X-RPC-Stream", "resp")
+                self.end_headers()
+                sent = 0
+                self.stream_err = ""
+                try:
+                    for chunk in it:
+                        self.wfile.write(chunk)
+                        sent += len(chunk)
+                except Exception as e:  # noqa: BLE001 — see docstring
+                    self.stream_err = f"{type(e).__name__}: {e}"
+                finally:
+                    if sent != total:
+                        self.close_connection = True
+                return sent
 
             def do_POST(self):
                 path = urllib.parse.urlsplit(self.path).path
@@ -325,6 +552,9 @@ class RPCServer:
                 msgpack error doc.  The body is drained BEFORE any
                 handler work so error replies never leave unread bytes
                 poisoning the keep-alive connection."""
+                mode = self.headers.get("X-RPC-Stream", "")
+                if mode:
+                    return self._do_raw_stream(name, mode)
                 n = int(self.headers.get("Content-Length") or 0)
                 data = self.rfile.read(n) if n else b""
                 fn = raw.get(name)
@@ -335,11 +565,17 @@ class RPCServer:
                 t0 = time.monotonic_ns() if _trace.active() else 0
                 err = ""
                 out = None
+                out_n = 0
                 try:
                     params = msgpack.unpackb(bytes.fromhex(
                         self.headers.get("X-RPC-Params", "")), raw=False)
                     out = fn(params, data)
-                    self._reply_raw(out if out is not None else b"")
+                    if isinstance(out, tuple):
+                        out_n = self._reply_raw(out)
+                        err = getattr(self, "stream_err", "")
+                    else:
+                        out_n = len(out) if out else 0
+                        self._reply_raw(out if out is not None else b"")
                 except Exception as e:  # noqa: BLE001
                     err = f"{type(e).__name__}: {e}"
                     self._reply(400, {
@@ -354,10 +590,77 @@ class RPCServer:
                             start_ns=_trace.now_ns() - dt,
                             duration_ns=dt,
                             input_bytes=n,
-                            output_bytes=len(out) if out else 0,
+                            output_bytes=out_n,
                             error=err,
                             detail={"service": "raw", "method": name,
                                     "side": "server"}))
+
+            def _do_raw_stream(self, name: str, mode: str):
+                """Framed-streaming request (``X-RPC-Stream: frames``):
+                the handler consumes a FrameReader — each frame lands on
+                the drive as it arrives, memory stays O(frame).  On a
+                handler error the remaining frames are drained so the
+                typed error reply leaves the keep-alive connection in
+                sync; a TRANSPORT death mid-frame (reset, truncated
+                stream) can't be replied to at all — the connection just
+                closes and the partial state is the handler's to have
+                discarded."""
+                fn = raw_stream.get(name)
+                frames = FrameReader(self.rfile,
+                                     trailer="trailer" in mode)
+                if fn is None:
+                    frames.drain()
+                    return self._reply(404, {"ok": False,
+                                             "error_type": "NoSuchMethod",
+                                             "message": name})
+                t0 = time.monotonic_ns() if _trace.active() else 0
+                err = ""
+                out_n = 0
+                try:
+                    params = msgpack.unpackb(bytes.fromhex(
+                        self.headers.get("X-RPC-Params", "")), raw=False)
+                    out = fn(params, frames)
+                    if not frames.in_sync():
+                        frames.drain()
+                    if isinstance(out, tuple):
+                        out_n = self._reply_raw(out)
+                        err = getattr(self, "stream_err", "")
+                    else:
+                        out_n = len(out) if out else 0
+                        self._reply_raw(out if out is not None else b"")
+                except (ConnectionError, socket.timeout) as e:
+                    # the stream itself died: nothing sane to reply on
+                    err = f"{type(e).__name__}: {e}"
+                    self.close_connection = True
+                except Exception as e:  # noqa: BLE001 — typed error
+                    err = f"{type(e).__name__}: {e}"
+                    try:
+                        frames.drain()
+                    except (ConnectionError, OSError):
+                        # connection died during the drain: the typed
+                        # reply has no socket to ride — just close
+                        self.close_connection = True
+                        return
+                    try:
+                        self._reply(400, {
+                            "ok": False,
+                            "error_type": type(e).__name__,
+                            "message": str(e)})
+                    except OSError:
+                        self.close_connection = True
+                finally:
+                    if t0:
+                        dt = time.monotonic_ns() - t0
+                        _trace.publish_span(_trace.make_span(
+                            "internode", f"internode/raw/{name}",
+                            start_ns=_trace.now_ns() - dt,
+                            duration_ns=dt,
+                            input_bytes=frames.bytes,
+                            output_bytes=out_n,
+                            error=err,
+                            detail={"service": "raw", "method": name,
+                                    "side": "server", "streamed": True,
+                                    "frames": frames.frames}))
 
         return Handler
 
@@ -512,17 +815,75 @@ class RPCClient:
         reads as online so the next use doubles as the probe."""
         return self.breaker.ready()
 
-    def _attempt(self, path: str, body: bytes, headers: dict, dyn,
-                 timeout: float | None = None) -> tuple[int, bytes]:
+    def _send_stream(self, conn, path: str, headers: dict,
+                     body: StreamBody) -> None:
+        """Send one framed streaming request: headers, then each chunk
+        as a length-prefixed frame, then the end marker (and the gated
+        trailer, when the body carries one).  A trailer_fn exception —
+        the commit's BadDigest abort — sends the abort marker instead
+        and re-raises: the peer discards its partial state and replies
+        a typed error the caller reads before surfacing the abort."""
+        conn.putrequest("POST", path, skip_accept_encoding=True)
+        for k, v in headers.items():
+            conn.putheader(k, v)
+        conn.putheader("X-RPC-Stream",
+                       "frames+trailer" if body.trailer_fn else "frames")
+        conn.endheaders()
+        body.sent = 0
+        body.frames = 0
+        for chunk in body.chunks_fn():
+            mv = memoryview(chunk).cast("B")
+            if not len(mv):
+                continue
+            conn.send(struct.pack(">I", len(mv)))
+            conn.send(mv)
+            body.sent += len(mv) + 4
+            body.frames += 1
+        if body.trailer_fn is None:
+            conn.send(_F_END)
+            body.sent += 4
+            return
+        try:
+            trailer = body.trailer_fn()
+        except BaseException as e:
+            conn.send(_F_END + _F_ABORT)
+            body.sent += 8
+            raise _GateAbort(e) from e
+        conn.send(_F_END + struct.pack(">I", len(trailer)) + trailer)
+        body.sent += 8 + len(trailer)
+
+    def _attempt(self, path: str, body, headers: dict, dyn,
+                 timeout: float | None = None) -> tuple[int, bytes, bool]:
         """One request/response on one connection.  Raises _StaleConn
         when a pooled keep-alive connection turned out dead in a phase
         where a free replay is sound; any other transport failure is a
         real peer failure (closes the connection, feeds the dynamic
-        deadline on timeouts)."""
+        deadline on timeouts).  ``body`` is bytes or a StreamBody (the
+        framed streaming mode; chunks_fn re-iterates per attempt, so
+        replays are sound whenever they are for a bytes body).  Returns
+        (status, payload, streamed_resp)."""
         conn, pooled = self._get_conn(
             dyn.timeout() if timeout is None else timeout)
+        aborting = None
         try:
-            conn.request("POST", path, body=body, headers=headers)
+            if isinstance(body, StreamBody):
+                try:
+                    self._send_stream(conn, path, headers, body)
+                except _GateAbort as e:
+                    # trailer abort: the request completed on the wire
+                    # (abort marker sent) — fall through to read the
+                    # peer's typed reply, then surface the gate's error
+                    aborting = e.cause_exc
+                except (OSError, http.client.HTTPException):
+                    raise
+                except BaseException:
+                    # the chunk SOURCE died mid-stream (not the wire):
+                    # the frame sequence is truncated — close the
+                    # socket so the peer discards its partial state
+                    conn.close()
+                    raise
+            else:
+                conn.request("POST", path, body=body, headers=headers)
         except socket.timeout as e:
             conn.close()
             if timeout is None:
@@ -533,12 +894,14 @@ class RPCClient:
             raise RPCError("ConnectionError", str(e)) from e
         except (OSError, http.client.HTTPException) as e:
             conn.close()
-            if pooled:
+            if pooled and not (isinstance(body, StreamBody)
+                               and body.sent):
                 raise _StaleConn(sent=False) from e
             raise RPCError("ConnectionError", str(e)) from e
         try:
             resp = conn.getresponse()
             status = resp.status
+            streamed_resp = resp.getheader("X-RPC-Stream") == "resp"
             payload = resp.read()
         except socket.timeout as e:
             # only an actual deadline expiry carries a latency signal;
@@ -551,15 +914,22 @@ class RPCClient:
             raise RPCError("ConnectionError", str(e)) from e
         except (OSError, http.client.HTTPException) as e:
             conn.close()
+            if aborting is not None:
+                raise aborting from e
             if pooled and isinstance(e, (http.client.RemoteDisconnected,
                                          ConnectionResetError,
-                                         BrokenPipeError)):
+                                         BrokenPipeError)) \
+                    and not (isinstance(body, StreamBody) and body.sent):
                 # the request may already have executed; the caller
                 # replays only if the method is idempotent
                 raise _StaleConn(sent=True) from e
             raise RPCError("ConnectionError", str(e)) from e
         self._put_conn(conn)
-        return status, payload
+        if aborting is not None:
+            # the peer's reply (a typed abort error) is intentionally
+            # discarded: the gate's own exception is the caller's truth
+            raise aborting
+        return status, payload, streamed_resp
 
     def _roundtrip(self, path: str, body: bytes, service: str,
                    extra_headers: dict | None = None,
@@ -622,8 +992,8 @@ class RPCClient:
 
         while True:
             try:
-                status, payload = self._attempt(path, body, headers,
-                                                dyn, timeout)
+                status, payload, streamed_resp = self._attempt(
+                    path, body, headers, dyn, timeout)
             except _StaleConn as e:
                 # bounded by pool depth: every replay pops one stale
                 # pooled connection; a fresh connection never raises this
@@ -667,10 +1037,24 @@ class RPCClient:
             # NORMAL call on this service then inherits
             dyn.log_success(time.monotonic() - start)
         # inter-node family (cmd/metrics-v2.go getInterNodeMetrics):
-        # traffic and call counts per RPC service
+        # traffic and call counts per RPC service.  Streamed bodies
+        # count their actual wire bytes (frame payloads + prefixes) —
+        # without this the framed mode would vanish from the RPC byte
+        # accounting — plus the mt_node_rpc_stream_* families so lane
+        # occupancy of the streaming plane is scrapeable on its own.
         _mtr.inc("mt_node_rpc_calls_total", {"service": service})
-        _mtr.inc("mt_node_rpc_tx_bytes_total", value=len(body))
+        if isinstance(body, StreamBody):
+            _mtr.inc("mt_node_rpc_tx_bytes_total", value=float(body.sent))
+            _mtr.inc("mt_node_rpc_stream_bytes_total", {"dir": "tx"},
+                     value=float(body.sent))
+            _mtr.inc("mt_node_rpc_stream_frames_total", {"dir": "tx"},
+                     value=float(body.frames))
+        else:
+            _mtr.inc("mt_node_rpc_tx_bytes_total", value=len(body))
         _mtr.inc("mt_node_rpc_rx_bytes_total", value=len(payload))
+        if streamed_resp:
+            _mtr.inc("mt_node_rpc_stream_bytes_total", {"dir": "rx"},
+                     value=float(len(payload)))
         if doc is None:
             return payload
         if not doc.get("ok"):
@@ -694,11 +1078,13 @@ class RPCClient:
             path, body, service,
             dict(idempotent=_idempotent, timeout=_timeout))
 
-    def raw_call(self, name: str, params: dict, body: bytes = b"",
+    def raw_call(self, name: str, params: dict, body=b"",
                  idempotent: bool = False) -> bytes:
         """Bulk transfer (POST /raw/<name>): params in a header, raw
         bytes in the body, raw bytes back — shard files never get a
-        second msgpack copy on either side."""
+        second msgpack copy on either side.  ``body`` may be a
+        StreamBody: the request rides the framed streaming mode
+        (length-prefixed chunks the peer applies as they land)."""
         path = f"/raw/{name}"
         hdr = msgpack.packb(params, use_bin_type=True).hex()
         kw = dict(extra_headers={"X-RPC-Params": hdr},
@@ -725,7 +1111,8 @@ class RPCClient:
             _trace.publish_span(_trace.make_span(
                 "internode", f"internode{path}",
                 start_ns=_trace.now_ns() - dt, duration_ns=dt,
-                input_bytes=len(body),
+                input_bytes=body.sent if isinstance(body, StreamBody)
+                else len(body),
                 output_bytes=len(out)
                 if isinstance(out, (bytes, bytearray)) else 0,
                 error=err,

@@ -368,6 +368,8 @@ class S3Server:
         # apply persisted ``pipeline`` knobs to the layer (it booted
         # with env/defaults before this server's config existed)
         self.reload_pipeline_config()
+        # push ``rpc`` streaming knobs into the shared internode plane
+        self.reload_rpc_config()
 
     def reload_api_config(self) -> None:
         """(Re)derive the request-plane knobs from the ``api`` kvconfig
@@ -424,6 +426,18 @@ class S3Server:
                     reload(self.config)
                 except Exception:  # noqa: BLE001 — bad knob value must
                     pass           # not take the server down
+
+    def reload_rpc_config(self) -> None:
+        """Push the ``rpc`` streaming knobs (stream_enable,
+        stream_chunk_bytes) into the process-wide internode streaming
+        config — at boot and after admin SetConfigKV, so chunked shard
+        streaming retunes on a live cluster (a fresh kvconfig.Config
+        cannot see this server's dynamic layer)."""
+        from ..parallel import rpc as _rpc
+        try:
+            _rpc.STREAM.load(self.config)
+        except Exception:  # noqa: BLE001 — bad knob must not kill boot
+            pass
 
     def reload_egress_config(self) -> None:
         """(Re)build every config-driven egress target from the

@@ -12,8 +12,11 @@ from __future__ import annotations
 import time
 from typing import Iterable
 
+import msgpack
+
 from ..obs import trace as _trace
-from ..parallel.rpc import RPCClient, RPCError, RPCServer
+from ..parallel.rpc import (STREAM, RPCClient, RPCError, RPCServer,
+                            StreamBody)
 from . import errors as serrors
 from .api import DiskInfo, StorageAPI, VolInfo
 from .datatypes import FileInfo
@@ -129,11 +132,54 @@ def register_storage_service(rpc: RPCServer,
 
     def raw_read(params, data):
         d = drive(params["drive_id"])
-        return d.read_file_stream(params["volume"], params["path"],
-                                  params["offset"], params["length"])
+        volume, path = params["volume"], params["path"]
+        offset, length = params["offset"], params["length"]
+        chunk = int(params.get("resp_stream") or 0)
+        if not chunk or length <= chunk:
+            return d.read_file_stream(volume, path, offset, length)
+        # streamed reply: the shard leaves the drive chunk-by-chunk —
+        # never materialized server-side, ONE open for the window
+        # (read_stream).  The FIRST chunk is pulled EAGERLY so
+        # FileNotFound/FileCorrupt stay typed errors (after the 200
+        # goes out, a failure can only close the connection).
+        it = d.read_stream(volume, path, offset, length, chunk)
+        first = next(it)
+
+        def rest():
+            yield first
+            yield from it
+
+        return (length, rest())
+
+    def stream_write(params, frames):
+        """Framed-streaming twin of raw_write (parallel/rpc.py wire
+        format): every frame lands on the drive as it arrives.  The
+        gated commit reads its final version dict from the TRAILER
+        frame — the client resolves its etag gate only after the part
+        bytes crossed the wire, so the md5 overlaps the remote leg of
+        the fan-out exactly as it overlaps the local one."""
+        d = drive(params["drive_id"])
+        volume, path = params["volume"], params["path"]
+        op = params.get("op")
+        if op == "append":
+            d.write_stream(volume, path, frames, op="append")
+        elif op == "commit":
+            gate = None
+            if params.get("trailer"):
+                def gate():
+                    return msgpack.unpackb(frames.read_trailer(),
+                                           raw=False)
+            d.write_data_commit(volume, path,
+                                FileInfo.from_dict(params["fi"]),
+                                frames, meta_gate=gate)
+        else:
+            d.write_stream(volume, path, frames, op="create",
+                           file_size=params.get("file_size", -1))
+        return b""
 
     rpc.register_raw("storage-write", raw_write)
     rpc.register_raw("storage-read", raw_read)
+    rpc.register_raw_stream("storage-write", stream_write)
 
 
 class RemoteStorage(StorageAPI):
@@ -171,7 +217,7 @@ class RemoteStorage(StorageAPI):
             if t0:
                 self._span(method, t0, err, kwargs)
 
-    def _raw(self, name: str, params: dict, body: bytes = b"") -> bytes:
+    def _raw(self, name: str, params: dict, body=b"") -> bytes:
         t0 = time.monotonic_ns() if _trace.active() else 0
         err = ""
         try:
@@ -183,7 +229,32 @@ class RemoteStorage(StorageAPI):
             raise self._map_err(e) from e
         finally:
             if t0:
-                self._span(name, t0, err, params, nbytes=len(body))
+                self._span(name, t0, err, params,
+                           nbytes=body.sent
+                           if isinstance(body, StreamBody)
+                           else len(body))
+
+    def _stream_body(self, data, chunk: int,
+                     trailer_fn=None) -> StreamBody | None:
+        """Framed streaming body over ``chunk``-sized slices of
+        ``data`` — zero-copy memoryview slices, re-iterable so breaker
+        retries can replay.  None when the body is too small to be
+        worth a stream (or not a flat buffer): callers fall back to the
+        materialized raw call."""
+        if not chunk:
+            return None
+        try:
+            mv = memoryview(data).cast("B")
+        except (TypeError, ValueError):
+            return None
+        if len(mv) <= chunk and trailer_fn is None:
+            return None
+
+        def chunks():
+            for off in range(0, len(mv), chunk):
+                yield mv[off:off + chunk]
+
+        return StreamBody(chunks, trailer_fn)
 
     def _span(self, method: str, t0: int, err: str, params: dict,
               nbytes: int = 0) -> None:
@@ -253,19 +324,28 @@ class RemoteStorage(StorageAPI):
         self._call("write_all", volume=volume, path=path, data=bytes(data))
 
     def create_file(self, volume, path, data, file_size=-1):
+        body = self._stream_body(data, STREAM.chunk())
         self._raw("storage-write",
                   {"volume": volume, "path": path, "op": "create",
-                   "file_size": file_size}, bytes(data))
+                   "file_size": file_size},
+                  bytes(data) if body is None else body)
 
     def append_file(self, volume, path, data):
+        body = self._stream_body(data, STREAM.chunk())
         self._raw("storage-write",
                   {"volume": volume, "path": path, "op": "append"},
-                  bytes(data))
+                  bytes(data) if body is None else body)
 
     def read_file_stream(self, volume, path, offset, length):
-        return self._raw("storage-read",
-                         {"volume": volume, "path": path,
-                          "offset": offset, "length": length})
+        params = {"volume": volume, "path": path,
+                  "offset": offset, "length": length}
+        chunk = STREAM.chunk()
+        if chunk and length > chunk:
+            # streamed reply: the peer reads the shard off its drive
+            # chunk-by-chunk instead of materializing it (the wire is
+            # identical — Content-Length is known up front)
+            params["resp_stream"] = chunk
+        return self._raw("storage-read", params)
 
     def rename_file(self, src_volume, src_path, dst_volume, dst_path):
         self._call("rename_file", src_volume=src_volume, src_path=src_path,
@@ -280,18 +360,42 @@ class RemoteStorage(StorageAPI):
     def write_data_commit(self, volume, path, fi, data,
                           shard_index=None, version_dict=None,
                           meta_gate=None):
-        # one RPC carries part bytes + final version dict, so the gate
-        # must resolve before the wire write; the md5 still overlaps
-        # the local drives' gated writes running in the same fan-out
+        def _patched(base: dict) -> dict:
+            d = dict(base)
+            if shard_index is not None:
+                d["ec"] = dict(d["ec"], index=shard_index)
+            return d
+
+        chunk = STREAM.chunk()
+        if meta_gate is not None and chunk:
+            # gated streamed commit: part frames cross the wire FIRST,
+            # the gate resolves into the TRAILER frame — the md5 tail
+            # overlaps the remote write exactly as it overlaps local
+            # drives.  A gate abort (BadDigest) sends the abort marker;
+            # the peer discards the partial data dir and no version is
+            # ever visible.
+            body = self._stream_body(
+                data, chunk,
+                trailer_fn=lambda: msgpack.packb(_patched(meta_gate()),
+                                                 use_bin_type=True))
+            if body is not None:
+                self._raw("storage-write",
+                          {"volume": volume, "path": path,
+                           "op": "commit", "fi": _patched(fi.to_dict()),
+                           "trailer": True}, body)
+                return
         if meta_gate is not None:
+            # materialized fallback: one RPC carries part bytes + final
+            # version dict, so the gate must resolve before the wire
+            # write; the md5 still overlaps the local drives' gated
+            # writes running in the same fan-out
             version_dict = meta_gate()
-        d = dict(version_dict) if version_dict is not None \
-            else fi.to_dict()
-        if shard_index is not None:
-            d["ec"] = dict(d["ec"], index=shard_index)
+        d = _patched(version_dict if version_dict is not None
+                     else fi.to_dict())
+        body = self._stream_body(data, chunk)
         self._raw("storage-write",
                   {"volume": volume, "path": path, "op": "commit",
-                   "fi": d}, bytes(data))
+                   "fi": d}, bytes(data) if body is None else body)
 
     # metadata
     def rename_data(self, src_volume, src_path, fi, dst_volume, dst_path):

@@ -357,6 +357,47 @@ class XLStorage(StorageAPI):
             f.write(data)
             _fsync_fileobj(f)
 
+    def write_stream(self, volume: str, path: str, chunks,
+                     op: str = "create", file_size: int = -1) -> int:
+        """Incremental create/append from an iterator of chunks — the
+        landing side of the framed internode streaming mode
+        (parallel/rpc.py): each chunk hits the file AS IT ARRIVES, one
+        fsync at the end, so a streamed shard never materializes and
+        the whole transfer is byte-identical to the equivalent
+        create_file/append_file of the concatenation.  A mid-stream
+        source failure (truncated frame, peer reset) removes a
+        partially CREATED file — a later retry must never observe a
+        half-written shard — while a partial APPEND leaves the file for
+        the caller's staging-dir cleanup (the writer plane latches the
+        drive error and the stream's tmp dir is dropped at settlement).
+        Returns the byte count written."""
+        full = self._file_path(volume, path)
+        self._check_vol(volume)
+        total = 0
+        created = op != "append"
+        try:
+            if created:
+                f = self._open_create(volume, full)
+            else:
+                os.makedirs(os.path.dirname(full), exist_ok=True)
+                f = open(full, "ab")
+            with f:
+                for chunk in chunks:
+                    f.write(chunk)
+                    total += len(chunk)
+                if file_size >= 0 and total != file_size:
+                    raise errors.FileCorrupt(
+                        f"size mismatch: {total} != {file_size}")
+                _fsync_fileobj(f)
+        except BaseException:
+            if created:
+                try:
+                    os.remove(full)
+                except OSError:
+                    pass
+            raise
+        return total
+
     def _create_file_odirect(self, full: str, data) -> bool:
         """Aligned O_DIRECT shard-file write (pad to 4 KiB, truncate to
         the real size — the reference's aligned writer does the same);
@@ -417,6 +458,37 @@ class XLStorage(StorageAPI):
             raise errors.FileCorrupt(
                 f"short read {len(data)} < {length} at {path}")
         return data
+
+    def read_stream(self, volume: str, path: str, offset: int,
+                    length: int, chunk: int):
+        """Generator over ``[offset, offset+length)`` in ``chunk``-sized
+        slices — ONE open/seek for the whole window (the serving side
+        of a streamed raw GET reply; per-chunk read_file_stream calls
+        would reopen the shard file for every frame).  The file is
+        opened — and typed open errors raised — EAGERLY; short files
+        surface as FileCorrupt from whichever slice hits EOF."""
+        full = self._file_path(volume, path)
+        try:
+            f = open(full, "rb")
+        except FileNotFoundError:
+            raise errors.FileNotFound(path) from None
+        except PermissionError as e:
+            raise errors.FileAccessDenied(path) from e
+
+        def gen():
+            with f:
+                f.seek(offset)
+                left = length
+                while left > 0:
+                    b = f.read(min(chunk, left))
+                    if not b:
+                        raise errors.FileCorrupt(
+                            f"short read {length - left} < {length} "
+                            f"at {path}")
+                    left -= len(b)
+                    yield b
+
+        return gen()
 
     def rename_file(self, src_volume: str, src_path: str,
                     dst_volume: str, dst_path: str) -> None:
@@ -558,29 +630,62 @@ class XLStorage(StorageAPI):
                 raise errors.VolumeNotFound(volume) from None
             os.makedirs(dst_obj, exist_ok=True)   # nested object name
             fresh = True
+        stream_ddir = None
         if fi.data_dir:
             ddir = dst_obj + "/" + fi.data_dir
             os.mkdir(ddir)
             part = ddir + "/part.1"
-            if not (_ODIRECT and self._create_file_odirect(part, data)):
-                # raw fd write: the 16-drive commit fan-out runs this 32
-                # times per object; BufferedWriter setup costs more than
-                # the write for one-shot whole-file dumps
-                fd = os.open(part, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
-                             0o644)
-                try:
-                    _write_full(fd, data)
-                    if _FSYNC:
-                        os.fsync(fd)
-                finally:
-                    os.close(fd)
-            _fsync_dir(ddir)
+            streaming = hasattr(data, "__next__")
+            try:
+                if streaming:
+                    # framed internode streaming: part bytes land chunk
+                    # by chunk as the frames arrive (O(chunk) memory);
+                    # a mid-stream death removes the partial data dir
+                    # below so no half-written shard survives
+                    stream_ddir = ddir
+                    fd = os.open(part,
+                                 os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                                 0o644)
+                    try:
+                        for chunk in data:
+                            _write_full(fd, chunk)
+                        if _FSYNC:
+                            os.fsync(fd)
+                    finally:
+                        os.close(fd)
+                elif not (_ODIRECT
+                          and self._create_file_odirect(part, data)):
+                    # raw fd write: the 16-drive commit fan-out runs
+                    # this 32 times per object; BufferedWriter setup
+                    # costs more than the write for one-shot dumps
+                    fd = os.open(part,
+                                 os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                                 0o644)
+                    try:
+                        _write_full(fd, data)
+                        if _FSYNC:
+                            os.fsync(fd)
+                    finally:
+                        os.close(fd)
+                _fsync_dir(ddir)
+            except BaseException:
+                if stream_ddir is not None:
+                    shutil.rmtree(stream_ddir, ignore_errors=True)
+                raise
         if meta_gate is not None:
             # md5 beside the write above; the park is caller-side work,
             # not drive time — keep it out of the latency windows that
             # feed slow-drive detection (_traced_op subtracts it)
             t_gate = time.monotonic_ns()
-            version_dict = meta_gate()
+            try:
+                version_dict = meta_gate()
+            except BaseException:
+                if stream_ddir is not None:
+                    # streamed gate abort (BadDigest trailer): discard
+                    # the part NOW — the aborting client may be gone
+                    # before its purge fan-out reaches this drive
+                    shutil.rmtree(stream_ddir, ignore_errors=True)
+                raise
             _IN_TRACED_OP.exclude_ns = getattr(
                 _IN_TRACED_OP, "exclude_ns", 0) \
                 + (time.monotonic_ns() - t_gate)

@@ -90,6 +90,16 @@ register_subsys("rpc", {
     "retry_base": "50ms",
     "retry_cap": "2s",
     "retry_budget": "10",
+    # chunked internode streaming (parallel/rpc.py framed raw mode +
+    # storage/remote.py): bulk shard bodies larger than
+    # ``stream_chunk_bytes`` ride one POST as length-prefixed frames
+    # the peer applies to the drive AS THEY LAND (and streamed raw
+    # responses are read chunk-at-a-time), so per-connection memory is
+    # O(chunk) instead of O(shard).  ``stream_enable=off`` restores
+    # whole-body raw calls.  Live-reloadable (S3Server.reload_rpc_config
+    # on admin SetConfigKV).
+    "stream_enable": "on",
+    "stream_chunk_bytes": "1048576",
 })
 register_subsys("drive", {
     # slow-drive detection over the last-minute latency windows
@@ -108,8 +118,13 @@ register_subsys("pipeline", {
     # restores the serial per-batch fan-out), ``queue_depth`` bounds
     # each drive's writer queue (enqueue blocks at the bound).  Both
     # are read live: admin SetConfigKV retunes a running server.
+    # ``md5_lanes`` bounds the native multi-lane MD5 scheduler
+    # (hashing/md5fast.py): concurrent streams'/parts' ETag updates
+    # coalesce into one N-lane multi-buffer call; 1 pins every stream
+    # to the plain single-stream core.
     "depth": "2",
     "queue_depth": "2",
+    "md5_lanes": "4",
 })
 register_subsys("storage_class", {
     "standard": "",                 # e.g. EC:4

@@ -1,0 +1,333 @@
+// Multi-lane MD5 — the strict-compat ETag hot loop off the Python thread.
+//
+// The reference's PUT hot path rides assembly-accelerated hash modules
+// (SURVEY §2.4, md5-simd's AVX512 16-lane server); this is the host-native
+// analog for minio_tpu: an ILP-tuned single-stream core (the one ETag every
+// strict PUT must compute is an irreducible serial chain) plus an N-lane
+// multi-buffer entry point that advances INDEPENDENT digests in one
+// GIL-free call.  MD5 is latency-bound — each step depends on the last —
+// so one stream leaves most of a superscalar core idle; interleaving 2-8
+// independent lanes fills those issue slots (the md5-simd trick without
+// the SIMD: the compiler schedules the independent chains).
+//
+// Contract (pinned by tests/test_md5fast.py): digests are bit-identical
+// to RFC 1321 / hashlib for every lane count, tail length and update
+// split.  State layout is opaque to Python (mt_md5_state_size).
+
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+typedef struct {
+    uint32_t h[4];
+    uint64_t n;          // total message bytes so far
+    uint32_t buflen;     // pending tail bytes in buf
+    uint8_t buf[64];
+} MD5State;
+
+static const uint32_t K[64] = {
+    0xd76aa478, 0xe8c7b756, 0x242070db, 0xc1bdceee,
+    0xf57c0faf, 0x4787c62a, 0xa8304613, 0xfd469501,
+    0x698098d8, 0x8b44f7af, 0xffff5bb1, 0x895cd7be,
+    0x6b901122, 0xfd987193, 0xa679438e, 0x49b40821,
+    0xf61e2562, 0xc040b340, 0x265e5a51, 0xe9b6c7aa,
+    0xd62f105d, 0x02441453, 0xd8a1e681, 0xe7d3fbc8,
+    0x21e1cde6, 0xc33707d6, 0xf4d50d87, 0x455a14ed,
+    0xa9e3e905, 0xfcefa3f8, 0x676f02d9, 0x8d2a4c8a,
+    0xfffa3942, 0x8771f681, 0x6d9d6122, 0xfde5380c,
+    0xa4beea44, 0x4bdecfa9, 0xf6bb4b60, 0xbebfbc70,
+    0x289b7ec6, 0xeaa127fa, 0xd4ef3085, 0x04881d05,
+    0xd9d4d039, 0xe6db99e5, 0x1fa27cf8, 0xc4ac5665,
+    0xf4292244, 0x432aff97, 0xab9423a7, 0xfc93a039,
+    0x655b59c3, 0x8f0ccc92, 0xffeff47d, 0x85845dd1,
+    0x6fa87e4f, 0xfe2ce6e0, 0xa3014314, 0x4e0811a1,
+    0xf7537e82, 0xbd3af235, 0x2ad7d2bb, 0xeb86d391,
+};
+
+static const uint8_t S[64] = {
+    7, 12, 17, 22, 7, 12, 17, 22, 7, 12, 17, 22, 7, 12, 17, 22,
+    5, 9, 14, 20, 5, 9, 14, 20, 5, 9, 14, 20, 5, 9, 14, 20,
+    4, 11, 16, 23, 4, 11, 16, 23, 4, 11, 16, 23, 4, 11, 16, 23,
+    6, 10, 15, 21, 6, 10, 15, 21, 6, 10, 15, 21, 6, 10, 15, 21,
+};
+
+static inline uint32_t rotl(uint32_t x, int s) {
+    return (x << s) | (x >> (32 - s));
+}
+
+static inline uint32_t le32(const uint8_t* p) {
+    return (uint32_t)p[0] | ((uint32_t)p[1] << 8) |
+           ((uint32_t)p[2] << 16) | ((uint32_t)p[3] << 24);
+}
+
+#define FF(a, b, c, d, m, k, s) \
+    a += (((b) & (c)) | (~(b) & (d))) + (m) + (k); a = rotl(a, s) + (b);
+#define GG(a, b, c, d, m, k, s) \
+    a += (((b) & (d)) | ((c) & ~(d))) + (m) + (k); a = rotl(a, s) + (b);
+#define HH(a, b, c, d, m, k, s) \
+    a += ((b) ^ (c) ^ (d)) + (m) + (k); a = rotl(a, s) + (b);
+#define II(a, b, c, d, m, k, s) \
+    a += ((c) ^ ((b) | ~(d))) + (m) + (k); a = rotl(a, s) + (b);
+
+// Fully unrolled single-block compress: the serial-chain core, tuned
+// for the shortest dependency path per step (the ETag's irreducible
+// cost when only one stream is in flight).
+static void compress1(uint32_t h[4], const uint8_t* p) {
+    uint32_t m[16];
+    for (int i = 0; i < 16; i++) m[i] = le32(p + 4 * i);
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3];
+
+    FF(a, b, c, d, m[0],  K[0],  7)  FF(d, a, b, c, m[1],  K[1],  12)
+    FF(c, d, a, b, m[2],  K[2],  17) FF(b, c, d, a, m[3],  K[3],  22)
+    FF(a, b, c, d, m[4],  K[4],  7)  FF(d, a, b, c, m[5],  K[5],  12)
+    FF(c, d, a, b, m[6],  K[6],  17) FF(b, c, d, a, m[7],  K[7],  22)
+    FF(a, b, c, d, m[8],  K[8],  7)  FF(d, a, b, c, m[9],  K[9],  12)
+    FF(c, d, a, b, m[10], K[10], 17) FF(b, c, d, a, m[11], K[11], 22)
+    FF(a, b, c, d, m[12], K[12], 7)  FF(d, a, b, c, m[13], K[13], 12)
+    FF(c, d, a, b, m[14], K[14], 17) FF(b, c, d, a, m[15], K[15], 22)
+
+    GG(a, b, c, d, m[1],  K[16], 5)  GG(d, a, b, c, m[6],  K[17], 9)
+    GG(c, d, a, b, m[11], K[18], 14) GG(b, c, d, a, m[0],  K[19], 20)
+    GG(a, b, c, d, m[5],  K[20], 5)  GG(d, a, b, c, m[10], K[21], 9)
+    GG(c, d, a, b, m[15], K[22], 14) GG(b, c, d, a, m[4],  K[23], 20)
+    GG(a, b, c, d, m[9],  K[24], 5)  GG(d, a, b, c, m[14], K[25], 9)
+    GG(c, d, a, b, m[3],  K[26], 14) GG(b, c, d, a, m[8],  K[27], 20)
+    GG(a, b, c, d, m[13], K[28], 5)  GG(d, a, b, c, m[2],  K[29], 9)
+    GG(c, d, a, b, m[7],  K[30], 14) GG(b, c, d, a, m[12], K[31], 20)
+
+    HH(a, b, c, d, m[5],  K[32], 4)  HH(d, a, b, c, m[8],  K[33], 11)
+    HH(c, d, a, b, m[11], K[34], 16) HH(b, c, d, a, m[14], K[35], 23)
+    HH(a, b, c, d, m[1],  K[36], 4)  HH(d, a, b, c, m[4],  K[37], 11)
+    HH(c, d, a, b, m[7],  K[38], 16) HH(b, c, d, a, m[10], K[39], 23)
+    HH(a, b, c, d, m[13], K[40], 4)  HH(d, a, b, c, m[0],  K[41], 11)
+    HH(c, d, a, b, m[3],  K[42], 16) HH(b, c, d, a, m[6],  K[43], 23)
+    HH(a, b, c, d, m[9],  K[44], 4)  HH(d, a, b, c, m[12], K[45], 11)
+    HH(c, d, a, b, m[15], K[46], 16) HH(b, c, d, a, m[2],  K[47], 23)
+
+    II(a, b, c, d, m[0],  K[48], 6)  II(d, a, b, c, m[7],  K[49], 10)
+    II(c, d, a, b, m[14], K[50], 15) II(b, c, d, a, m[5],  K[51], 21)
+    II(a, b, c, d, m[12], K[52], 6)  II(d, a, b, c, m[3],  K[53], 10)
+    II(c, d, a, b, m[10], K[54], 15) II(b, c, d, a, m[1],  K[55], 21)
+    II(a, b, c, d, m[8],  K[56], 6)  II(d, a, b, c, m[15], K[57], 10)
+    II(c, d, a, b, m[6],  K[58], 15) II(b, c, d, a, m[13], K[59], 21)
+    II(a, b, c, d, m[4],  K[60], 6)  II(d, a, b, c, m[11], K[61], 10)
+    II(c, d, a, b, m[2],  K[62], 15) II(b, c, d, a, m[9],  K[63], 21)
+
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+}
+
+// L-lane lock-step compress: the SAME fully-unrolled 64-step schedule
+// as compress1, but each step's op runs for all L lanes (the inner
+// lane loop unrolls — L is a compile-time constant).  Every lane's
+// chain is independent, and the message schedule is stored WORD-MAJOR
+// (m[word][lane]) so each step's per-lane loads are contiguous — that
+// is what lets the compiler auto-vectorize the lane loop into SIMD
+// (lane-major m[lane][word] needs a strided gather and measured
+// SLOWER than single-stream; transposing measured 4-lane ~2x and
+// 8-lane ~2.5x the single-stream rate on the 2-core dev box).
+#define STEP_L(OP, A, B, C, D, g, i)                                   \
+    for (int l = 0; l < L; l++) {                                      \
+        A[l] += OP(B[l], C[l], D[l]) + m[g][l] + K[i];                 \
+        A[l] = rotl(A[l], S[i]) + B[l];                                \
+    }
+#define OPF(x, y, z) (((x) & (y)) | (~(x) & (z)))
+#define OPG(x, y, z) (((x) & (z)) | ((y) & ~(z)))
+#define OPH(x, y, z) ((x) ^ (y) ^ (z))
+#define OPI(x, y, z) ((y) ^ ((x) | ~(z)))
+
+template <int L>
+static void compressL(MD5State* const* st, const uint8_t* const* blk) {
+    uint32_t a[L], b[L], c[L], d[L], m[16][L];
+    for (int l = 0; l < L; l++) {
+        a[l] = st[l]->h[0]; b[l] = st[l]->h[1];
+        c[l] = st[l]->h[2]; d[l] = st[l]->h[3];
+        for (int i = 0; i < 16; i++) m[i][l] = le32(blk[l] + 4 * i);
+    }
+    STEP_L(OPF, a, b, c, d, 0, 0)   STEP_L(OPF, d, a, b, c, 1, 1)
+    STEP_L(OPF, c, d, a, b, 2, 2)   STEP_L(OPF, b, c, d, a, 3, 3)
+    STEP_L(OPF, a, b, c, d, 4, 4)   STEP_L(OPF, d, a, b, c, 5, 5)
+    STEP_L(OPF, c, d, a, b, 6, 6)   STEP_L(OPF, b, c, d, a, 7, 7)
+    STEP_L(OPF, a, b, c, d, 8, 8)   STEP_L(OPF, d, a, b, c, 9, 9)
+    STEP_L(OPF, c, d, a, b, 10, 10) STEP_L(OPF, b, c, d, a, 11, 11)
+    STEP_L(OPF, a, b, c, d, 12, 12) STEP_L(OPF, d, a, b, c, 13, 13)
+    STEP_L(OPF, c, d, a, b, 14, 14) STEP_L(OPF, b, c, d, a, 15, 15)
+
+    STEP_L(OPG, a, b, c, d, 1, 16)  STEP_L(OPG, d, a, b, c, 6, 17)
+    STEP_L(OPG, c, d, a, b, 11, 18) STEP_L(OPG, b, c, d, a, 0, 19)
+    STEP_L(OPG, a, b, c, d, 5, 20)  STEP_L(OPG, d, a, b, c, 10, 21)
+    STEP_L(OPG, c, d, a, b, 15, 22) STEP_L(OPG, b, c, d, a, 4, 23)
+    STEP_L(OPG, a, b, c, d, 9, 24)  STEP_L(OPG, d, a, b, c, 14, 25)
+    STEP_L(OPG, c, d, a, b, 3, 26)  STEP_L(OPG, b, c, d, a, 8, 27)
+    STEP_L(OPG, a, b, c, d, 13, 28) STEP_L(OPG, d, a, b, c, 2, 29)
+    STEP_L(OPG, c, d, a, b, 7, 30)  STEP_L(OPG, b, c, d, a, 12, 31)
+
+    STEP_L(OPH, a, b, c, d, 5, 32)  STEP_L(OPH, d, a, b, c, 8, 33)
+    STEP_L(OPH, c, d, a, b, 11, 34) STEP_L(OPH, b, c, d, a, 14, 35)
+    STEP_L(OPH, a, b, c, d, 1, 36)  STEP_L(OPH, d, a, b, c, 4, 37)
+    STEP_L(OPH, c, d, a, b, 7, 38)  STEP_L(OPH, b, c, d, a, 10, 39)
+    STEP_L(OPH, a, b, c, d, 13, 40) STEP_L(OPH, d, a, b, c, 0, 41)
+    STEP_L(OPH, c, d, a, b, 3, 42)  STEP_L(OPH, b, c, d, a, 6, 43)
+    STEP_L(OPH, a, b, c, d, 9, 44)  STEP_L(OPH, d, a, b, c, 12, 45)
+    STEP_L(OPH, c, d, a, b, 15, 46) STEP_L(OPH, b, c, d, a, 2, 47)
+
+    STEP_L(OPI, a, b, c, d, 0, 48)  STEP_L(OPI, d, a, b, c, 7, 49)
+    STEP_L(OPI, c, d, a, b, 14, 50) STEP_L(OPI, b, c, d, a, 5, 51)
+    STEP_L(OPI, a, b, c, d, 12, 52) STEP_L(OPI, d, a, b, c, 3, 53)
+    STEP_L(OPI, c, d, a, b, 10, 54) STEP_L(OPI, b, c, d, a, 1, 55)
+    STEP_L(OPI, a, b, c, d, 8, 56)  STEP_L(OPI, d, a, b, c, 15, 57)
+    STEP_L(OPI, c, d, a, b, 6, 58)  STEP_L(OPI, b, c, d, a, 13, 59)
+    STEP_L(OPI, a, b, c, d, 4, 60)  STEP_L(OPI, d, a, b, c, 11, 61)
+    STEP_L(OPI, c, d, a, b, 2, 62)  STEP_L(OPI, b, c, d, a, 9, 63)
+
+    for (int l = 0; l < L; l++) {
+        st[l]->h[0] += a[l]; st[l]->h[1] += b[l];
+        st[l]->h[2] += c[l]; st[l]->h[3] += d[l];
+    }
+}
+
+extern "C" {
+
+size_t mt_md5_state_size(void) { return sizeof(MD5State); }
+
+void mt_md5_init(void* vst) {
+    MD5State* st = (MD5State*)vst;
+    st->h[0] = 0x67452301u; st->h[1] = 0xefcdab89u;
+    st->h[2] = 0x98badcfeu; st->h[3] = 0x10325476u;
+    st->n = 0;
+    st->buflen = 0;
+}
+
+void mt_md5_update(void* vst, const uint8_t* p, size_t n) {
+    MD5State* st = (MD5State*)vst;
+    st->n += n;
+    if (st->buflen) {            // drain the buffered tail first
+        size_t want = 64 - st->buflen;
+        size_t take = n < want ? n : want;
+        memcpy(st->buf + st->buflen, p, take);
+        st->buflen += (uint32_t)take;
+        p += take; n -= take;
+        if (st->buflen < 64) return;
+        compress1(st->h, st->buf);
+        st->buflen = 0;
+    }
+    while (n >= 64) {
+        compress1(st->h, p);
+        p += 64; n -= 64;
+    }
+    if (n) {
+        memcpy(st->buf, p, n);
+        st->buflen = (uint32_t)n;
+    }
+}
+
+void mt_md5_final(void* vst, uint8_t out[16]) {
+    MD5State* st = (MD5State*)vst;
+    uint64_t bits = st->n * 8;
+    uint8_t pad[72];
+    size_t padlen = (st->buflen < 56) ? (56 - st->buflen)
+                                      : (120 - st->buflen);
+    memset(pad, 0, sizeof(pad));
+    pad[0] = 0x80;
+    for (int i = 0; i < 8; i++) pad[padlen + i] = (uint8_t)(bits >> (8 * i));
+    mt_md5_update(st, pad, padlen + 8);
+    for (int i = 0; i < 4; i++) {
+        out[4 * i + 0] = (uint8_t)(st->h[i]);
+        out[4 * i + 1] = (uint8_t)(st->h[i] >> 8);
+        out[4 * i + 2] = (uint8_t)(st->h[i] >> 16);
+        out[4 * i + 3] = (uint8_t)(st->h[i] >> 24);
+    }
+}
+
+void mt_md5_oneshot(const uint8_t* p, size_t n, uint8_t out[16]) {
+    MD5State st;
+    mt_md5_init(&st);
+    mt_md5_update(&st, p, n);
+    mt_md5_final(&st, out);
+}
+
+// Multi-buffer update: advance ``nlanes`` independent streams, each by
+// its own (ptr, len).  Whole 64-byte blocks run lock-step through the
+// widest compressL the still-active lane set fills (8/4/2); odd lanes
+// and sub-block tails ride the single-stream core / state buffer, so
+// ANY mix of lengths is legal and bit-identical to per-lane updates.
+void mt_md5mb_update(int nlanes, void* const* vstates,
+                     const uint8_t* const* ptrs, const size_t* lens) {
+    enum { MAXL = 64 };
+    if (nlanes <= 0) return;
+    if (nlanes == 1) {
+        mt_md5_update(vstates[0], ptrs[0], lens[0]);
+        return;
+    }
+    if (nlanes > MAXL) {         // split oversized batches
+        mt_md5mb_update(MAXL, vstates, ptrs, lens);
+        mt_md5mb_update(nlanes - MAXL, vstates + MAXL, ptrs + MAXL,
+                        lens + MAXL);
+        return;
+    }
+    const uint8_t* p[MAXL];
+    size_t nblk[MAXL];
+    for (int l = 0; l < nlanes; l++) {
+        MD5State* st = (MD5State*)vstates[l];
+        const uint8_t* q = ptrs[l];
+        size_t n = lens[l];
+        st->n += n;
+        if (st->buflen) {
+            size_t want = 64 - st->buflen;
+            size_t take = n < want ? n : want;
+            memcpy(st->buf + st->buflen, q, take);
+            st->buflen += (uint32_t)take;
+            q += take; n -= take;
+            if (st->buflen == 64) {
+                compress1(st->h, st->buf);
+                st->buflen = 0;
+            }
+        }
+        p[l] = q;
+        nblk[l] = n / 64;
+        // stash the tail now; the block loop below never touches it
+        size_t tail = n - nblk[l] * 64;
+        if (tail) {
+            memcpy(st->buf, q + nblk[l] * 64, tail);
+            st->buflen = (uint32_t)tail;
+        }
+    }
+    for (;;) {
+        MD5State* act_st[MAXL];
+        const uint8_t* act_p[MAXL];
+        int act_idx[MAXL];
+        int na = 0;
+        for (int l = 0; l < nlanes; l++) {
+            if (nblk[l]) {
+                act_st[na] = (MD5State*)vstates[l];
+                act_p[na] = p[l];
+                act_idx[na] = l;
+                na++;
+            }
+        }
+        if (na == 0) break;
+        int done = 0;
+        while (na - done >= 8) {
+            compressL<8>(act_st + done, act_p + done);
+            done += 8;
+        }
+        while (na - done >= 4) {
+            compressL<4>(act_st + done, act_p + done);
+            done += 4;
+        }
+        while (na - done >= 2) {
+            compressL<2>(act_st + done, act_p + done);
+            done += 2;
+        }
+        while (done < na) {
+            compress1(act_st[done]->h, act_p[done]);
+            done++;
+        }
+        for (int i = 0; i < na; i++) {
+            int l = act_idx[i];
+            p[l] += 64;
+            nblk[l]--;
+        }
+    }
+}
+
+}  // extern "C"

@@ -458,3 +458,133 @@ def test_lock_refresh_survives_single_blip():
     finally:
         m.unlock()
         srv.stop()
+
+
+# -- chunked-streaming faults (ISSUE 6: reset/blackhole mid-frame) ----------
+
+def _stream_remote_layer(tmp_path, monkeypatch, secret="streamchaos"):
+    """4 local + 2 remote drives, remotes behind a FaultyProxy, with
+    internode streaming forced down to tiny frames so every shard
+    append/commit rides the framed mode."""
+    from minio_tpu.objectlayer import erasure_object as eo
+    from minio_tpu.objectlayer.erasure_object import ErasureObjects
+    from minio_tpu.parallel.rpc import STREAM, RPCServer
+    from minio_tpu.storage.remote import (RemoteStorage,
+                                          register_storage_service)
+    from minio_tpu.storage.xl_storage import XLStorage
+    monkeypatch.setattr(STREAM, "enable", True)
+    monkeypatch.setattr(STREAM, "chunk_bytes", 1024)
+    monkeypatch.setattr(STREAM, "_loaded", True)
+    monkeypatch.setattr(eo, "STREAM_BATCH_BYTES", 2 * 4096)
+    rpc = RPCServer(secret)
+    remote_drives = {}
+    for i in range(2):
+        d = tmp_path / f"r{i}"
+        d.mkdir()
+        remote_drives[f"r{i}"] = XLStorage(str(d))
+    register_storage_service(rpc, remote_drives)
+    rpc.start()
+    proxy = FaultyProxy("127.0.0.1", rpc.port).start()
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"l{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    remotes = [_no_retry_client(proxy.endpoint, timeout=2.0)
+               for _ in range(2)]
+    for i, c in enumerate(remotes):
+        c.secret = secret
+        disks.append(RemoteStorage(c, f"r{i}"))
+    lay = ErasureObjects(disks, parity=2, block_size=4096,
+                         backend="numpy", inline_threshold=512)
+    lay._pipe_depth = 2
+    lay.make_bucket("cbkt")
+    return lay, proxy, rpc, remotes
+
+
+def _drop_pools(clients):
+    for c in clients:
+        with c._pool_mu:
+            for conn in c._pool:
+                conn.close()
+            c._pool.clear()
+
+
+def test_stream_reset_mid_frame_latches_and_quorum_commits(
+        tmp_path, monkeypatch):
+    """The proxy RSTs every new connection carrying streamed frames:
+    the half-streamed appends surface as TRANSPORT failures (RPCError,
+    breaker fed), latch in the per-drive writer plane, and the PUT
+    commits on the 4/6 local quorum — with NO partial shard visible on
+    the faulted remotes."""
+    import hashlib as _hashlib
+    import io as _io
+
+    from minio_tpu.storage.writers import close_write_planes
+    lay, proxy, rpc, remotes = _stream_remote_layer(tmp_path, monkeypatch)
+    try:
+        body = (b"frame-chaos!" * 4096)[: 10 * 4096]
+        # healthy pass first: streamed appends reach the remotes
+        oi = lay.put_object_stream("cbkt", "ok", _io.BytesIO(body))
+        assert oi.etag == _hashlib.md5(body).hexdigest()
+        # now cut every NEW connection mid-stream and drop the pools so
+        # the next PUT's streamed appends must ride faulted connections
+        proxy.set_default(Fault.reset(after_bytes=0))
+        _drop_pools(remotes)
+        from minio_tpu.admin.metrics import GLOBAL
+        errs0 = sum(v for k, v in GLOBAL.snapshot().items()
+                    if k[0] == "mt_node_rpc_errors_total")
+        oi = lay.put_object_stream("cbkt", "cut", _io.BytesIO(body))
+        assert oi.etag == _hashlib.md5(body).hexdigest()
+        assert lay.get_object("cbkt", "cut")[1] == body
+        # transport failures were recorded (breaker/retry path), and
+        # no partial shard of the faulted PUT is visible remotely
+        errs1 = sum(v for k, v in GLOBAL.snapshot().items()
+                    if k[0] == "mt_node_rpc_errors_total")
+        assert errs1 > errs0
+        for i in range(2):
+            assert not os.path.exists(
+                os.path.join(str(tmp_path / f"r{i}"), "cbkt", "cut",
+                             "xl.meta"))
+    finally:
+        close_write_planes(lay)
+        proxy.stop()
+        rpc.stop()
+
+
+def test_stream_blackhole_mid_frame_is_transport_failure(
+        tmp_path, monkeypatch):
+    """A blackholed peer swallows streamed frames and never answers:
+    the sender's deadline converts it into a typed transport RPCError
+    (never a hang, never a half-applied op on the real peer)."""
+    from minio_tpu.parallel.rpc import STREAM, RPCServer
+    from minio_tpu.storage import errors as serrors
+    from minio_tpu.storage.remote import (RemoteStorage,
+                                          register_storage_service)
+    from minio_tpu.storage.xl_storage import XLStorage
+    monkeypatch.setattr(STREAM, "enable", True)
+    monkeypatch.setattr(STREAM, "chunk_bytes", 1024)
+    monkeypatch.setattr(STREAM, "_loaded", True)
+    d = tmp_path / "bh"
+    d.mkdir()
+    drive = XLStorage(str(d))
+    drive.make_vol("vol1")
+    rpc = RPCServer("testsecret")
+    register_storage_service(rpc, {"bh": drive})
+    rpc.start()
+    proxy = FaultyProxy("127.0.0.1", rpc.port,
+                        default=Fault.blackhole()).start()
+    client = _no_retry_client(proxy.endpoint, timeout=1.0)
+    r = RemoteStorage(client, "bh")
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(serrors.StorageError):
+            r.append_file("vol1", "f", b"x" * 50_000)
+        assert time.monotonic() - t0 < 10.0       # deadline, not a hang
+        assert client.breaker._failures > 0       # fed the breaker
+        # the real peer never applied anything
+        with pytest.raises(serrors.FileNotFound):
+            drive.read_file_stream("vol1", "f", 0, 1)
+    finally:
+        proxy.stop()
+        rpc.stop()

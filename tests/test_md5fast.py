@@ -1,0 +1,188 @@
+"""Native multi-lane MD5 conformance (native/md5mb.cc via
+hashing/md5fast.py).
+
+The ETag contract is absolute: every digest the native core produces —
+single-stream, any lane count, any tail length, any update split — must
+be bit-identical to hashlib/RFC 1321.  Also pinned: the hashlib
+fallback paths (MT_MD5 override, absent .so) and the lane scheduler's
+coalescing behavior under concurrency.
+"""
+
+import hashlib
+import random
+import threading
+
+import pytest
+
+from minio_tpu.hashing import md5fast
+
+NATIVE = md5fast.available()
+
+# message lengths around every boundary the padding/tail logic cares
+# about: empty, sub-block, block +/- 1, multi-block, 4 MiB +/- 1
+LENGTHS = [0, 1, 55, 56, 63, 64, 65, 127, 128, 1000,
+           (4 << 20) - 1, 4 << 20, (4 << 20) + 1]
+
+
+def _msg(n: int, seed: int = 7) -> bytes:
+    return random.Random(seed + n).randbytes(n)
+
+
+@pytest.mark.skipif(not NATIVE, reason="no native md5 (g++ missing?)")
+class TestSingleStream:
+    @pytest.mark.parametrize("n", LENGTHS)
+    def test_oneshot_matches_hashlib(self, n):
+        m = _msg(n)
+        assert md5fast.MD5Fast(m).hexdigest() == \
+            hashlib.md5(m).hexdigest()
+
+    def test_split_updates_match(self):
+        m = _msg(100_000)
+        h = md5fast.MD5Fast()
+        ref = hashlib.md5()
+        rng = random.Random(3)
+        off = 0
+        while off < len(m):
+            step = rng.randrange(1, 5000)
+            h.update(m[off:off + step])
+            ref.update(m[off:off + step])
+            off += step
+        assert h.hexdigest() == ref.hexdigest()
+
+    def test_digest_keeps_stream_usable(self):
+        # digest() finalizes a COPY of the state (stdlib contract)
+        m = _msg(1000)
+        h = md5fast.MD5Fast(m[:500])
+        assert h.hexdigest() == hashlib.md5(m[:500]).hexdigest()
+        h.update(m[500:])
+        assert h.hexdigest() == hashlib.md5(m).hexdigest()
+
+    def test_copy_forks_the_state(self):
+        m = _msg(999)
+        h = md5fast.MD5Fast(m)
+        c = h.copy()
+        c.update(b"extra")
+        assert h.hexdigest() == hashlib.md5(m).hexdigest()
+        assert c.hexdigest() == hashlib.md5(m + b"extra").hexdigest()
+
+    def test_memoryview_and_bytearray_inputs(self):
+        m = _msg(70_000)
+        for view in (memoryview(m), bytearray(m), memoryview(m)[17:]):
+            want = hashlib.md5(bytes(view)).hexdigest()
+            h = md5fast.MD5Fast()
+            h.update(view)
+            assert h.hexdigest() == want
+
+
+@pytest.mark.skipif(not NATIVE, reason="no native md5 (g++ missing?)")
+class TestMultiLane:
+    @pytest.mark.parametrize("lanes", [2, 3, 4, 5, 8, 9, 16])
+    def test_lane_batches_bit_identical(self, lanes):
+        """Drive mt_md5mb_update directly at every batch width the
+        dispatcher uses (8/4/2/1 mixes), with per-lane lengths crossing
+        all tail classes."""
+        import ctypes
+        lib = md5fast._get_lib()
+        rng = random.Random(lanes)
+        msgs = [_msg(rng.choice(LENGTHS), seed=lanes * 100 + i)
+                for i in range(lanes)]
+        hs = [md5fast.MD5Fast() for _ in msgs]
+        # feed in unequal slices so lanes run ragged mid-call
+        offs = [0] * lanes
+        while any(offs[i] < len(msgs[i]) for i in range(lanes)):
+            states = (ctypes.c_void_p * lanes)()
+            ptrs = (ctypes.c_void_p * lanes)()
+            lens = (ctypes.c_size_t * lanes)()
+            keep = []
+            for i in range(lanes):
+                step = rng.randrange(0, 40_000)
+                chunk = msgs[i][offs[i]:offs[i] + step]
+                offs[i] += len(chunk)
+                states[i] = ctypes.addressof(hs[i]._st)
+                addr, ln, ka = md5fast._buf_addr(chunk)
+                ptrs[i], lens[i] = addr, ln
+                keep.append(ka)
+            lib.mt_md5mb_update(lanes, states, ptrs, lens)
+        for h, m in zip(hs, msgs):
+            assert h.hexdigest() == hashlib.md5(m).hexdigest()
+
+    @pytest.mark.parametrize("lanes", [1, 2, 4, 8])
+    def test_scheduler_concurrent_streams(self, lanes):
+        md5fast.SCHED.set_lanes(lanes)
+        try:
+            msgs = [_msg(random.Random(i).randrange(0, 300_000),
+                         seed=50 + i) for i in range(3 * lanes + 1)]
+            hs = [md5fast.md5() for _ in msgs]
+            errs = []
+
+            def run(h, m):
+                try:
+                    mv = memoryview(m)
+                    for off in range(0, len(m), 8192):
+                        md5fast.SCHED.update(h, mv[off:off + 8192])
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ts = [threading.Thread(target=run, args=(h, m))
+                  for h, m in zip(hs, msgs)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errs
+            for h, m in zip(hs, msgs):
+                assert h.hexdigest() == hashlib.md5(m).hexdigest()
+        finally:
+            md5fast.SCHED.set_lanes(4)
+
+    def test_combiner_sees_its_own_batch_failure(self, monkeypatch):
+        """A failed batch must surface on EVERY caller — including the
+        combiner itself, whose own chunk rode the batch.  Silently
+        skipping it would serve a wrong ETag."""
+        sched = md5fast.LaneScheduler(lanes=4)
+        boom = RuntimeError("native batch died")
+
+        def bad_batch(self, batch):
+            for it in batch:
+                it[3] = boom
+                it[2].set()
+
+        monkeypatch.setattr(md5fast.LaneScheduler, "_run_batch",
+                            bad_batch)
+        h = md5fast.MD5Fast()
+        with pytest.raises(RuntimeError, match="native batch died"):
+            sched.update(h, b"x" * 1000)
+
+    def test_md5_of_slices_through_scheduler(self):
+        m = _msg(3 * md5fast.ONESHOT_SLICE + 12345)
+        assert md5fast.md5_of(m).hexdigest() == \
+            hashlib.md5(m).hexdigest()
+        assert md5fast.md5_of(b"").hexdigest() == \
+            hashlib.md5(b"").hexdigest()
+
+
+class TestFallback:
+    def test_mt_md5_hashlib_override(self, monkeypatch):
+        monkeypatch.setenv("MT_MD5", "hashlib")
+        assert not md5fast.available()
+        h = md5fast.md5(b"abc")
+        assert isinstance(h, type(hashlib.md5()))
+        assert h.hexdigest() == hashlib.md5(b"abc").hexdigest()
+        assert md5fast.md5_of(b"x" * 100).hexdigest() == \
+            hashlib.md5(b"x" * 100).hexdigest()
+
+    def test_absent_so_falls_back(self, monkeypatch):
+        # simulate a host with no compiler: the loader yielded None
+        monkeypatch.setattr(md5fast, "_LIB", None)
+        monkeypatch.setattr(md5fast, "_LIB_TRIED", True)
+        assert not md5fast.available()
+        h = md5fast.md5(b"hello")
+        assert h.hexdigest() == hashlib.md5(b"hello").hexdigest()
+
+    def test_scheduler_passthrough_for_hashlib_objects(self):
+        # a hashlib digest riding SCHED.update (native absent mid-way)
+        # must hash identically
+        h = hashlib.md5()
+        md5fast.SCHED.update(h, b"abc")
+        md5fast.SCHED.update(h, b"def")
+        assert h.hexdigest() == hashlib.md5(b"abcdef").hexdigest()

@@ -354,6 +354,81 @@ def test_remote_peer_kill_mid_stream_queued_writers(tmp_path,
     close_write_planes(lay)
 
 
+def test_streamed_native_md5_put_matches_serial_reference(
+        tmp_path, monkeypatch, small_batches):
+    """The full PR-6 stack — pipelined loop + native multi-lane md5 +
+    chunked internode streaming over remote drives — must land the
+    exact bytes the serial hashlib whole-body reference lands: same
+    xl.meta, same part files, same ETags, for both the streaming PUT
+    and the gated bytes commit."""
+    from minio_tpu.parallel.rpc import STREAM, RPCClient, RPCServer
+    from minio_tpu.storage.remote import (RemoteStorage,
+                                          register_storage_service)
+    monkeypatch.setattr(eo, "_SINGLE_CORE", False)
+    stream_body = pattern(23 * BS + 321)
+    bytes_body = os.urandom(2 * (1 << 20))
+    opts = dict(mod_time=1_234_567_890)
+    states = {}
+    rpcs = []
+    try:
+        for mode in ("serial", "full"):
+            det_uuids(monkeypatch)
+            roots = [tmp_path / mode / f"d{i}" for i in range(6)]
+            for r in roots:
+                r.mkdir(parents=True)
+            if mode == "serial":
+                monkeypatch.setenv("MT_MD5", "hashlib")
+                monkeypatch.setattr(STREAM, "enable", False)
+                monkeypatch.setattr(STREAM, "_loaded", True)
+                disks = [XLStorage(str(r)) for r in roots]
+                depth = 0
+            else:
+                monkeypatch.delenv("MT_MD5", raising=False)
+                monkeypatch.setattr(STREAM, "enable", True)
+                monkeypatch.setattr(STREAM, "chunk_bytes", 4096)
+                monkeypatch.setattr(STREAM, "_loaded", True)
+                rpc = RPCServer("paritysecret")
+                register_storage_service(
+                    rpc, {f"r{i}": XLStorage(str(roots[4 + i]))
+                          for i in range(2)})
+                rpc.start()
+                rpcs.append(rpc)
+                disks = [XLStorage(str(r)) for r in roots[:4]] + [
+                    RemoteStorage(RPCClient(rpc.endpoint,
+                                            "paritysecret"), f"r{i}")
+                    for i in range(2)]
+                depth = 2
+            lay = ErasureObjects(disks, parity=2, block_size=BS,
+                                 backend="numpy", inline_threshold=512)
+            lay._pipe_depth = depth
+            lay.make_bucket("pbkt")
+            oi_s = lay.put_object_stream("pbkt", "sobj",
+                                         io.BytesIO(stream_body),
+                                         PutObjectOptions(**opts))
+            oi_b = lay.put_object("pbkt", "bobj", bytes_body,
+                                  PutObjectOptions(**opts))
+            assert oi_s.etag == hashlib.md5(stream_body).hexdigest()
+            assert oi_b.etag == hashlib.md5(bytes_body).hexdigest()
+            st = {}
+            for i, root in enumerate(roots):
+                for obj in ("sobj", "bobj"):
+                    base = os.path.join(str(root), "pbkt", obj)
+                    mp = os.path.join(base, "xl.meta")
+                    meta_b = open(mp, "rb").read() \
+                        if os.path.exists(mp) else b""
+                    parts = [open(f, "rb").read() for f in sorted(
+                        glob.glob(os.path.join(base, "*", "part.*")))]
+                    st[(i, obj)] = (meta_b, parts)
+            states[mode] = st
+            close_write_planes(lay)
+        assert states["serial"] == states["full"]
+        assert all(meta and parts
+                   for meta, parts in states["full"].values())
+    finally:
+        for rpc in rpcs:
+            rpc.stop()
+
+
 # -- observability -----------------------------------------------------------
 
 class SlowDisk:
