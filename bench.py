@@ -1066,5 +1066,48 @@ def _bench_end_to_end_put() -> dict | None:
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+def soak_main(argv: list[str]) -> None:
+    """``bench.py soak [duration_s] [out.json]`` — run the soak
+    scenario matrix (minio_tpu/soak): every production workload mix
+    under the full concurrent chaos timeline on a 3-node cluster, with
+    SLO assertions (last-minute p50/p99 per S3 API, error-rate
+    ceiling, zero telemetry dead-letters, heal convergence, thread
+    hygiene).  Writes one {scenario, metric, value, unit, detail} row
+    per scenario x assertion to SOAK_r01.json (BENCH_* shape) and
+    prints ONE summary JSON line."""
+    import sys as _sys
+
+    from minio_tpu.soak.report import default_matrix, run_matrix
+
+    duration_s = float(argv[0]) if argv else 12.0
+    out_path = argv[1] if len(argv) > 1 else "SOAK_r01.json"
+    report = run_matrix(default_matrix(duration_s=duration_s),
+                        out_path=out_path)
+    failed = [r for r in report["rows"] if not r["passed"]]
+    print(json.dumps({
+        "metric": "soak_scenarios_passed",
+        "value": len(report["scenarios"]) - len(
+            {r["scenario"] for r in failed}),
+        "unit": "scenarios",
+        "detail": {
+            "scenarios": report["scenarios"],
+            "assertions_passed": report["passed"],
+            "assertions_failed": report["failed"],
+            "out": out_path,
+            "failed": [
+                {"scenario": r["scenario"], "metric": r["metric"],
+                 "value": r["value"]} for r in failed],
+        },
+    }))
+    if failed:
+        print(f"soak: {len(failed)} SLO assertion(s) failed",
+              file=_sys.stderr)
+        _sys.exit(1)
+
+
 if __name__ == "__main__":
-    main()
+    import sys as _sys
+    if len(_sys.argv) > 1 and _sys.argv[1] == "soak":
+        soak_main(_sys.argv[2:])
+    else:
+        main()

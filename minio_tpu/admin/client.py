@@ -162,6 +162,11 @@ class AdminClient:
     def heal_status(self) -> dict:
         return self._call("GET", "heal-status")
 
+    def soak_status(self) -> dict | None:
+        """Live soak-scenario status (minio_tpu/soak conductor), null
+        when no soak run is attached to the server."""
+        return self._call("GET", "soak-status")
+
     def replication_stats(self) -> dict:
         return self._call("GET", "replication-stats")
 

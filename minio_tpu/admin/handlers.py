@@ -287,6 +287,12 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
             return send_json({
                 "sweep": healer.stats.to_dict() if healer else None,
                 "mrf": mrf.stats.to_dict() if mrf else None}) or True
+        if route == "soak-status" and h.command == "GET":
+            # soak-plane visibility (minio_tpu/soak): the live scenario
+            # a conductor attached to this server, or null when idle
+            soak = getattr(srv, "soak", None)
+            return send_json(
+                soak.snapshot() if soak is not None else None) or True
         if route == "replication-stats" and h.command == "GET":
             repl = srv.replication
             return send_json(
@@ -516,7 +522,8 @@ def _render_local(srv, node=None) -> str:
         api_stats=getattr(srv, "api_stats", None),
         replication=getattr(srv, "replication", None),
         crawler=getattr(srv, "crawler", None), node=node,
-        egress=getattr(srv, "egress", None))
+        egress=getattr(srv, "egress", None),
+        mrf=getattr(srv, "mrf", None))
 
 
 _CLUSTER_SCRAPE_TTL_S = 2.0

@@ -103,13 +103,16 @@ def _fmt_labels(labels: tuple, extra: str = "") -> str:
 
 def render(layer=None, healer=None, config=None, api_stats=None,
            replication=None, crawler=None, node=None,
-           egress=None) -> str:
+           egress=None, mrf=None) -> str:
     """Prometheus text format: counters + histograms + live gauges.
 
     ``config`` (a kvconfig Config) supplies the slow-drive knobs at
     scrape time — admin SetConfigKV retunes detection live; ``api_stats``
     is the server's last-minute per-API OpWindows; ``replication`` /
-    ``crawler`` export the background planes (ReplicationSys + Crawler).
+    ``crawler`` export the background planes (ReplicationSys + Crawler);
+    ``mrf`` is the server's MRFQueue, whose own stats feed the
+    ``mt_heal_mrf_*`` counters (the sweep healer's stats keep those
+    fields for renders that only hand in ``healer``).
 
     ``node`` names this server for federation: every sample gains a
     ``server`` label so one merged cluster document keeps per-node
@@ -188,11 +191,12 @@ def render(layer=None, healer=None, config=None, api_stats=None,
             lines += _s3_lastminute_gauges(api_stats)
         except Exception:  # noqa: BLE001
             pass
-    if healer is not None:
+    if healer is not None or mrf is not None:
         try:
-            lines += _heal_counters(healer)
+            lines += _heal_counters(healer, mrf)
         except Exception:  # noqa: BLE001
             pass
+    if healer is not None:
         try:
             lines += _progress_gauges("mt_heal", healer.progress)
         except Exception:  # noqa: BLE001
@@ -350,22 +354,35 @@ def _bucket_usage_gauges(layer) -> list[str]:
     return lines
 
 
-def _heal_counters(healer) -> list[str]:
-    st = healer.stats
-    return [
-        "# TYPE mt_heal_objects_scanned_total counter",
-        f"mt_heal_objects_scanned_total {st.objects_scanned}",
-        "# TYPE mt_heal_objects_healed_total counter",
-        f"mt_heal_objects_healed_total {st.objects_healed}",
-        "# TYPE mt_heal_objects_failed_total counter",
-        f"mt_heal_objects_failed_total {st.objects_failed}",
-        "# TYPE mt_heal_mrf_queued_total counter",
-        f"mt_heal_mrf_queued_total {st.mrf_queued}",
-        "# TYPE mt_heal_mrf_healed_total counter",
-        f"mt_heal_mrf_healed_total {st.mrf_healed}",
-        "# TYPE mt_heal_cycles_total counter",
-        f"mt_heal_cycles_total {st.cycles}",
-    ]
+def _heal_counters(healer, mrf=None) -> list[str]:
+    lines = []
+    if healer is not None:
+        st = healer.stats
+        lines += [
+            "# TYPE mt_heal_objects_scanned_total counter",
+            f"mt_heal_objects_scanned_total {st.objects_scanned}",
+            "# TYPE mt_heal_objects_healed_total counter",
+            f"mt_heal_objects_healed_total {st.objects_healed}",
+            "# TYPE mt_heal_objects_failed_total counter",
+            f"mt_heal_objects_failed_total {st.objects_failed}",
+            "# TYPE mt_heal_cycles_total counter",
+            f"mt_heal_cycles_total {st.cycles}",
+        ]
+    # the MRF queue keeps its own HealStats; fall back to the sweep's
+    # (always-zero mrf fields) so the families stay present for
+    # healer-only renders
+    mst = mrf.stats if mrf is not None else \
+        (healer.stats if healer is not None else None)
+    if mst is not None:
+        lines += [
+            "# TYPE mt_heal_mrf_queued_total counter",
+            f"mt_heal_mrf_queued_total {mst.mrf_queued}",
+            "# TYPE mt_heal_mrf_healed_total counter",
+            f"mt_heal_mrf_healed_total {mst.mrf_healed}",
+            "# TYPE mt_heal_mrf_dropped_total counter",
+            f"mt_heal_mrf_dropped_total {mst.mrf_dropped}",
+        ]
+    return lines
 
 
 def _fmt_rate(v: float) -> str:

@@ -41,6 +41,7 @@ class HealStats:
     objects_failed: int = 0
     mrf_queued: int = 0
     mrf_healed: int = 0
+    mrf_dropped: int = 0
     last_cycle_ns: int = 0
     cycles: int = 0
 
@@ -51,6 +52,7 @@ class HealStats:
             "objectsFailed": self.objects_failed,
             "mrfQueued": self.mrf_queued,
             "mrfHealed": self.mrf_healed,
+            "mrfDropped": self.mrf_dropped,
             "lastCycle": self.last_cycle_ns,
             "cycles": self.cycles,
         }
@@ -75,7 +77,11 @@ class MRFQueue:
             self._q.put_nowait((bucket, object_name, version_id))
             self.stats.mrf_queued += 1
         except queue.Full:
-            pass  # sweep picks it up (reference drops too; heal is lossy-ok)
+            # the sweep still picks it up (the reference drops too; heal
+            # is lossy-ok) — but a silent drop hides backpressure from
+            # operators, so the loss itself is counted
+            # (mt_heal_mrf_dropped_total + admin heal-status mrfDropped)
+            self.stats.mrf_dropped += 1
 
     def start(self) -> None:
         def worker():

@@ -159,84 +159,110 @@ def heal_object(er: ErasureObjects, bucket: str, object_name: str,
     inline = any(f is not None and f.inline_data is not None
                  for f in s_fis)
 
-    for part in fi.parts:
-        sfsize = fi.erasure.shard_file_size(part.size)
-        # read k healthy shard files (verified)
-        shards: dict[int, np.ndarray] = {}
-        for i in ok_idx:
-            if len(shards) == k:
-                break
+    # stage every part into ONE tmp dir per drive as it is rebuilt,
+    # commit with a single rename_data per drive at the end:
+    # rename_data REPLACES the object's data dir, so a per-part commit
+    # would clobber previously healed parts and leave a multipart
+    # object permanently CORRUPT on the target drive (only its last
+    # part present).  Staging goes straight to the drive, so heal
+    # memory stays O(one part's shards), not O(all parts).
+    staged: dict[int, str] = {}          # shard idx -> tmp dir
+    stage_errs: dict[int, Exception] = {}
+    try:
+        for part in fi.parts:
+            sfsize = fi.erasure.shard_file_size(part.size)
+            # read k healthy shard files (verified)
+            shards: dict[int, np.ndarray] = {}
+            for i in ok_idx:
+                if len(shards) == k:
+                    break
+                try:
+                    dfi = s_fis[i]
+                    if dfi is not None and dfi.inline_data is not None:
+                        framed = dfi.inline_data
+                    else:
+                        framed = shuffled[i].read_all(
+                            bucket,
+                            f"{object_name}/{fi.data_dir}"
+                            f"/part.{part.number}")
+                    r = bitrot.StreamingBitrotReader(framed, ssize,
+                                                     er.bitrot_algo)
+                    shards[i] = np.frombuffer(r.read_at(0, sfsize),
+                                              dtype=np.uint8)
+                except (serrors.StorageError, bitrot.BitrotError):
+                    continue
+            if len(shards) < k:
+                res.after_ok = res.before_ok
+                return res
+            present = sorted(shards)[:k]
+            wanted = healable
+            rebuilt = _reconstruct_shards(er, fi, present,
+                                          [shards[i] for i in present],
+                                          wanted, part.size)
+            for j, i in enumerate(wanted):
+                if i in stage_errs:
+                    continue            # drive already failed staging
+                framed = bitrot.streaming_encode(rebuilt[j].tobytes(),
+                                                 ssize, er.bitrot_algo)
+                disk = shuffled[i]
+                if inline or fi.size <= er.inline_threshold:
+                    dfi = _disk_fileinfo(fi, i)
+                    dfi.inline_data = framed
+                    dfi.data_dir = ""
+                    disk.write_metadata(bucket, object_name, dfi)
+                    if disk.endpoint() not in res.healed_disks:
+                        res.healed_disks.append(disk.endpoint())
+                    continue
+                try:
+                    tmp = staged.get(i)
+                    if tmp is None:
+                        tmp = staged[i] = disk.tmp_dir()
+                    disk.create_file(SYS_DIR,
+                                     f"{tmp}/part.{part.number}", framed)
+                except (serrors.StorageError, OSError) as e:
+                    # one drive failing to stage must not sink the
+                    # others' heal; its error surfaces after commit
+                    stage_errs[i] = e
+        writes = [(shuffled[i], _disk_fileinfo(fi, i), staged[i])
+                  for i in healable
+                  if i in staged and i not in stage_errs]
+        _commit_healed_shards(er, writes, bucket, object_name, res)
+        if stage_errs:
+            raise next(iter(stage_errs.values()))
+    finally:
+        for i, tmp in staged.items():
             try:
-                dfi = s_fis[i]
-                if dfi is not None and dfi.inline_data is not None:
-                    framed = dfi.inline_data
-                else:
-                    framed = shuffled[i].read_all(
-                        bucket,
-                        f"{object_name}/{fi.data_dir}/part.{part.number}")
-                r = bitrot.StreamingBitrotReader(framed, ssize,
-                                                 er.bitrot_algo)
-                shards[i] = np.frombuffer(r.read_at(0, sfsize),
-                                          dtype=np.uint8)
-            except (serrors.StorageError, bitrot.BitrotError):
-                continue
-        if len(shards) < k:
-            res.after_ok = res.before_ok
-            return res
-        present = sorted(shards)[:k]
-        wanted = healable
-        rebuilt = _reconstruct_shards(er, fi, present,
-                                      [shards[i] for i in present],
-                                      wanted, part.size)
-        writes = []          # (disk, dfi, framed) for staged shard writes
-        for j, i in enumerate(wanted):
-            framed = bitrot.streaming_encode(rebuilt[j].tobytes(), ssize,
-                                             er.bitrot_algo)
-            disk = shuffled[i]
-            dfi = _disk_fileinfo(fi, i)
-            if inline or fi.size <= er.inline_threshold:
-                dfi.inline_data = framed
-                dfi.data_dir = ""
-                disk.write_metadata(bucket, object_name, dfi)
-                if disk.endpoint() not in res.healed_disks:
-                    res.healed_disks.append(disk.endpoint())
-            else:
-                writes.append((disk, dfi, framed))
-        _write_healed_shards(er, writes, part.number, bucket,
-                             object_name, res)
+                shuffled[i].clean_tmp(tmp)
+            except Exception:  # noqa: BLE001 — cleanup best-effort
+                pass
     res.after_ok = res.before_ok + len(healable)
     return res
 
 
-def _write_healed_shards(er: ErasureObjects, writes: list,
-                         part_number: int, bucket: str, object_name: str,
-                         res) -> None:
-    """Stage + commit rebuilt shard files on the stale drives.  Rides
-    the shared per-drive writer plane when the pipeline is on, so the
-    stale drives heal in parallel (remote RPC waits overlap) instead of
-    one after another; falls back to the serial loop otherwise.  The
-    first failure aborts the heal (as the serial loop always did) —
-    but only after every drive's write settled, and drives that DID
-    succeed are still recorded as healed."""
+def _commit_healed_shards(er: ErasureObjects, writes: list,
+                          bucket: str, object_name: str, res) -> None:
+    """Commit fully-staged shard tmp dirs on the stale drives: ONE
+    rename_data per drive swaps its data dir atomically (the parts
+    were already streamed into the tmp dir as they were rebuilt).
+    Rides the shared per-drive writer plane when the pipeline is on,
+    so remote drives' commit RPCs overlap; falls back to the serial
+    loop otherwise.  The first failure aborts the heal (as the serial
+    loop always did) — but only after every drive's commit settled,
+    and drives that DID succeed are still recorded as healed.
+    ``writes`` rows are (disk, dfi, tmp_dir)."""
     if not writes:
         return
 
-    def heal_one(disk, dfi, framed) -> None:
-        tmp = disk.tmp_dir()
-        try:
-            disk.create_file(SYS_DIR, f"{tmp}/part.{part_number}",
-                             framed)
-            disk.rename_data(SYS_DIR, tmp, dfi, bucket, object_name)
-        finally:
-            disk.clean_tmp(tmp)
+    def heal_one(disk, dfi, tmp) -> None:
+        disk.rename_data(SYS_DIR, tmp, dfi, bucket, object_name)
 
     if er._pipeline_on() and len(writes) > 1:
         sw = er._write_plane.stream([d for d, _, _ in writes])
-        for pos, (disk, dfi, framed) in enumerate(writes):
+        for pos, (disk, dfi, tmp) in enumerate(writes):
             # the plane hands fn its (idx, disk); the heal write is
             # already bound to ITS target drive, so ignore them
-            sw.submit(pos, lambda *_, d=disk, i=dfi, f=framed:
-                      heal_one(d, i, f))
+            sw.submit(pos, lambda *_, d=disk, i=dfi, t=tmp:
+                      heal_one(d, i, t))
         sw.drain()
         first_err = None
         for pos, (disk, _, _) in enumerate(writes):
@@ -248,8 +274,8 @@ def _write_healed_shards(er: ErasureObjects, writes: list,
         if first_err is not None:
             raise first_err
         return
-    for disk, dfi, framed in writes:
-        heal_one(disk, dfi, framed)
+    for disk, dfi, tmp in writes:
+        heal_one(disk, dfi, tmp)
         if disk.endpoint() not in res.healed_disks:
             res.healed_disks.append(disk.endpoint())
 

@@ -220,8 +220,16 @@ def register_lock_service(rpc: RPCServer, locker: LocalLocker,
     })
 
     def sweeper():
+        # dies WITH the server: a stopped node must not keep a sweep
+        # thread alive for the rest of the process (soak scenarios boot
+        # and tear down whole clusters and assert zero thread growth)
+        stopped = getattr(rpc, "stopped", None)
         while True:
-            time.sleep(sweep_interval_s)
+            if stopped is not None:
+                if stopped.wait(sweep_interval_s):
+                    return
+            else:
+                time.sleep(sweep_interval_s)
             try:
                 locker.expire_old_locks()
             except Exception:  # noqa: BLE001
@@ -281,7 +289,10 @@ class _Refresher:
         with self._mu:
             self._items[id(m)] = m
             if self._thread is None or not self._thread.is_alive():
+                # named so leak accounting can tell this process-global
+                # lazy singleton from per-scenario threads
                 self._thread = threading.Thread(target=self._loop,
+                                                name="mt-dsync-refresh",
                                                 daemon=True)
                 self._thread.start()
         self._wake.set()
@@ -295,7 +306,8 @@ class _Refresher:
         # RPC must delay only its own mutex's keepalive, never starve
         # every other held lock past its TTL
         from concurrent.futures import ThreadPoolExecutor
-        pool = ThreadPoolExecutor(max_workers=8)
+        pool = ThreadPoolExecutor(max_workers=8,
+                                  thread_name_prefix="mt-dsync-refresh")
 
         def run_one(m):
             try:

@@ -83,6 +83,10 @@ class FaultyProxy:
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._lsock.bind((host, 0))
         self._lsock.listen(64)
+        # a blocked accept() is not woken by close() on Linux; a short
+        # timeout lets the accept loop notice _stop and exit instead of
+        # leaking for the life of the process
+        self._lsock.settimeout(0.25)
         self.host = host
         self.port = self._lsock.getsockname()[1]
         self._thread: threading.Thread | None = None
@@ -110,6 +114,26 @@ class FaultyProxy:
         with self._mu:
             return self._conn_nr
 
+    def sever(self) -> None:
+        """Cut every LIVE proxied connection without stopping the
+        listener: established flows die NOW, so a fault flipped via
+        ``set_default`` (partition / 503 burst) applies to all traffic
+        instead of only to connections accepted afterwards — the chaos
+        conductor's link-flap primitive."""
+        with self._mu:
+            live = list(self._live)
+        for s in live:
+            # shutdown BEFORE close: close() alone never wakes a pipe
+            # thread blocked in recv() on the other side of the socket
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "FaultyProxy":
@@ -124,13 +148,7 @@ class FaultyProxy:
             self._lsock.close()
         except OSError:
             pass
-        with self._mu:
-            live = list(self._live)
-        for s in live:
-            try:
-                s.close()
-            except OSError:
-                pass
+        self.sever()
         if self._thread is not None:
             self._thread.join(timeout=5)
 
@@ -152,8 +170,11 @@ class FaultyProxy:
         while not self._stop.is_set():
             try:
                 client, _ = self._lsock.accept()
+            except TimeoutError:
+                continue            # poll tick: re-check _stop
             except OSError:
                 return
+            client.settimeout(None)     # accepted socks inherit none
             with self._mu:
                 self._conn_nr += 1
                 fault = self._plan.get(self._conn_nr, self._default)
@@ -208,6 +229,15 @@ class FaultyProxy:
                         client.setsockopt(
                             socket.SOL_SOCKET, socket.SO_LINGER,
                             struct.pack("ii", 1, 0))
+                    except OSError:
+                        pass
+                # wake the client→upstream pipe: a one-sided EOF would
+                # otherwise leave t1 parked in recv() forever (SHUT_RD
+                # sends nothing on the wire, so the reset path's RST
+                # close is unaffected)
+                for s in (client, up):
+                    try:
+                        s.shutdown(socket.SHUT_RD)
                     except OSError:
                         pass
                 t1.join(timeout=1.0)

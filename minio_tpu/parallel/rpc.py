@@ -221,6 +221,19 @@ REQUEST_ID_HEADER = "X-Request-ID"
 UNTRACED_PATHS = frozenset({"/rpc/peer/trace_since"})
 
 
+def _quiet_connection_errors(fallback):
+    """handle_error wrapper for ThreadingHTTPServer: transport-level
+    errors from severed or fault-injected connections are expected and
+    dropped; anything else keeps the stock traceback."""
+    def handle(request, client_address):
+        import sys
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ConnectionError, TimeoutError)):
+            return
+        fallback(request, client_address)
+    return handle
+
+
 def sever_connections(conns) -> None:
     """Hard-close a set of server-side sockets (shared by the RPC and
     S3 servers' stop paths).  shutdown, not close — handler-held
@@ -367,9 +380,18 @@ class RPCServer:
         handler = self._make_handler()
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
+        # severed/chaotic peers (RSTs, mid-body hangups) are routine on
+        # this plane — the stock handler prints a full traceback per
+        # connection error, which buries real failures under noise
+        self.httpd.handle_error = _quiet_connection_errors(
+            self.httpd.handle_error)
         self.host = host
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
+        # lifecycle flag for helper loops tied to this server (the lock
+        # sweeper, dsync maintenance): they exit when the server stops
+        # instead of running for the life of the process
+        self.stopped = threading.Event()
         # bootstrap liveness probe (cmd/bootstrap-peer-server.go role)
         self.register("sys", {"ping": lambda: "pong"})
 
@@ -404,6 +426,7 @@ class RPCServer:
         self._thread.start()
 
     def stop(self) -> None:
+        self.stopped.set()
         self.httpd.shutdown()
         with self._conns_mu:
             conns = list(self._conns)

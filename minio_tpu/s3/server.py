@@ -334,12 +334,24 @@ class S3Server:
                     "Python fallback (slow)")
         # live connections, so stop() can sever parked keep-alive
         # handlers instead of leaving zombie threads serving a
-        # "stopped" server
+        # "stopped" server; _active_conns is the subset currently
+        # INSIDE a request — the graceful drain lets those finish
+        # while idle keep-alive parkers are severed immediately
         self._conns: set = set()
+        self._active_conns: set = set()
         self._conns_mu = threading.Lock()
+        # soak-plane status (minio_tpu/soak/report.py SoakStatus):
+        # attached by a running soak conductor, read by admin soak-status
+        self.soak = None
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
+        # severed keep-alives (shutdown drain, chaos) raise transport
+        # errors in handler threads; drop them instead of printing a
+        # traceback per connection
+        from ..parallel.rpc import _quiet_connection_errors
+        self.httpd.handle_error = _quiet_connection_errors(
+            self.httpd.handle_error)
         self.port = self.httpd.server_address[1]
         # span attribution names the BOUND port (ephemeral binds resolve
         # only now); run_node overrides both with the cluster node_id
@@ -412,6 +424,11 @@ class S3Server:
                 self.config.get("api", "body_min_rate") or 0)
         except ValueError:
             self.body_min_rate_bps = 1 << 20
+        # graceful shutdown drain: how long stop() lets in-flight
+        # requests finish (after refusing new connections) before
+        # severing; 0 = sever immediately (the PR-1 behavior)
+        self.shutdown_drain_s = _parse_duration(
+            self.config.get("api", "shutdown_drain_s") or "5s")
 
     def reload_pipeline_config(self) -> None:
         """Push the ``pipeline`` kvconfig knobs (PUT pipeline depth,
@@ -564,12 +581,30 @@ class S3Server:
             except Exception:  # noqa: BLE001 — shutdown must proceed
                 pass
         self.httpd.shutdown()
-        # parked keep-alive handlers must die with the server
+        # graceful drain (cmd/http/server.go Shutdown analog): the
+        # listener closes FIRST, so new connections are refused while
+        # in-flight requests get the drain budget to finish.  Idle
+        # keep-alive handlers have no request in flight — severed
+        # immediately; handlers finishing a request during the drain
+        # close their connection themselves (_stopping gate).
+        self.httpd.server_close()
         from ..parallel.rpc import sever_connections
+        drain_s = getattr(self, "shutdown_drain_s", 0.0)
+        if drain_s > 0:
+            with self._conns_mu:
+                idle = [c for c in self._conns
+                        if c not in self._active_conns]
+            sever_connections(idle)
+            deadline = time.monotonic() + drain_s
+            while time.monotonic() < deadline:
+                with self._conns_mu:
+                    if not self._active_conns:
+                        break
+                time.sleep(0.02)
+        # whatever is still parked or past the drain budget dies now
         with self._conns_mu:
             conns = list(self._conns)
         sever_connections(conns)
-        self.httpd.server_close()
         self.events.close()
         # egress plane down WITH the server: sender threads join, queued
         # records spill to their disk stores, and this server's targets
@@ -1129,11 +1164,27 @@ def _make_handler(srv: S3Server):
             except Exception as e:  # noqa: BLE001 — every error becomes XML
                 self._fail(e, path)
 
+        def _handle(self):
+            """Active-request bookkeeping around _dispatch: the graceful
+            drain in stop() waits for connections in this window (and
+            only these) before severing; once the server is stopping, a
+            finishing request closes its connection instead of parking
+            for another keep-alive round."""
+            with srv._conns_mu:
+                srv._active_conns.add(self.connection)
+            try:
+                self._dispatch()
+            finally:
+                with srv._conns_mu:
+                    srv._active_conns.discard(self.connection)
+                if getattr(srv, "_stopping", False):
+                    self.close_connection = True
+
         # PATCH/OPTIONS etc. flow through the same dispatcher and come
         # back as the S3 MethodNotAllowed XML error — the stdlib's raw
         # 501 would leak a non-S3 error shape to clients
         do_GET = do_PUT = do_HEAD = do_DELETE = do_POST = do_PATCH = \
-            do_OPTIONS = lambda self: self._dispatch()
+            do_OPTIONS = lambda self: self._handle()
 
         # -- STS (cmd/sts-handlers.go) -------------------------------------
 

@@ -1,0 +1,30 @@
+"""Soak plane — the continuous-verification tier (ROADMAP item 5).
+
+PRs 1–6 built the instruments: FaultyProxy network faults, NaughtyDisk/
+SlowDisk drive faults, HealthDisk offline→probe→readmit, the MRF heal
+queue, last-minute latency stats, and egress dead-letter accounting.
+This package is the missing proof layer that *drives* a multi-node
+cluster like production and *asserts* it stays inside an SLO while
+faults land:
+
+  * :mod:`.workload` — seeded, deterministic closed-loop workers
+    producing the production mixes (GET-heavy small objects, multipart
+    uploads, listing-heavy, Select queries, versioned overwrite/delete
+    churn) with per-op latency/error recording;
+  * :mod:`.chaos` — the proxied multi-node harness (``SoakCluster``)
+    plus a declarative fault timeline conductor (at t=X inject Y, heal
+    at t=Z) over the existing primitives — reproducible from a seed,
+    no wall-clock coin flips;
+  * :mod:`.slo` — SLO budgets, last-minute p50/p99 assertions, the
+    heal-convergence helper (``assert_converged``), and thread-leak
+    accounting;
+  * :mod:`.report` — scenario runner + the ``BENCH_*``-shaped
+    ``SOAK_r*.json`` scenario-matrix report (``bench.py soak``).
+"""
+
+from .chaos import ChaosConductor, Event, SoakCluster  # noqa: F401
+from .report import (Scenario, SoakStatus, run_matrix,  # noqa: F401
+                     run_scenario)
+from .slo import (Budget, assert_converged,  # noqa: F401
+                  settled_thread_count)
+from .workload import MIXES, Mix, WorkloadGenerator  # noqa: F401

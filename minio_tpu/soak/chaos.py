@@ -1,0 +1,282 @@
+"""Chaos conductor — a declarative fault timeline over the existing
+primitives, scheduled against a proxied multi-node cluster.
+
+``SoakCluster`` assembles N in-process nodes (cluster.py Node) with one
+:class:`~minio_tpu.parallel.faulty.FaultyProxy` in front of EVERY
+node's RPC endpoint, so each internode link is independently
+partitionable / 503-burstable, and serves S3 from node0 with the MRF
+queue + background healer attached — the full production wiring the
+soak workload drives.
+
+``ChaosConductor`` replays a timeline of :class:`Event`\\ s (at t=X
+inject Y, heal at t=Z) over the cluster:
+
+  * ``drive_kill`` / ``drive_return`` — HealthDisk offline→probe→
+    readmit: the drive's inner StorageAPI is swapped for a BadDisk and
+    back, so every call fails deterministically and the return path
+    rides the identity-verified probe + heal-on-return sweep;
+  * ``drive_slow`` / ``drive_fast`` — SlowDisk latency injection that
+    the slow-drive detector (storage/health.py) actually sees;
+  * ``partition`` / ``blackhole`` / ``burst_503`` / ``heal_link`` —
+    FaultyProxy default-fault flips plus a live-connection sever, so
+    the fault applies to established flows too.
+
+Every event fires at a programmed offset from conductor start — no
+wall-clock coin flips; a scenario replays byte-for-byte from its seed.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..background.heal import BackgroundHealer, MRFQueue
+from ..cluster import Node, NodeSpec
+from ..parallel.faulty import Fault, FaultyProxy
+from ..s3.server import S3Server
+from ..storage.faulty import BadDisk, SlowDisk
+from ..storage.health import HealthDisk
+
+
+class SoakCluster:
+    """N nodes x d drives, one erasure set, internode links proxied."""
+
+    def __init__(self, base_dir: str, *, nodes: int = 3,
+                 drives_per_node: int = 2, parity: int = 2,
+                 secret: str = "soak-secret", access_key: str = "soakkey",
+                 secret_key: str = "soaksecret", block_size: int = 64 * 1024,
+                 backend: str = "numpy", mrf_maxsize: int = 10_000):
+        self.specs: list[NodeSpec] = []
+        self.nodes: list[Node] = []
+        self.proxies: list[FaultyProxy] = []
+        self.s3: S3Server | None = None
+        self._saved: dict[int, object] = {}
+        for n in range(nodes):
+            dirs = []
+            for d in range(drives_per_node):
+                p = os.path.join(base_dir, f"n{n}d{d}")
+                os.makedirs(p, exist_ok=True)
+                dirs.append(p)
+            self.specs.append(NodeSpec(node_id=f"node{n}",
+                                       drive_dirs=dirs))
+        sdc = nodes * drives_per_node
+        try:
+            # phase 1: boot every node's RPC plane on its real port
+            for s in self.specs:
+                self.nodes.append(Node(s, self.specs, secret, sdc,
+                                       parity=parity,
+                                       block_size=block_size,
+                                       backend=backend))
+            # phase 2: interpose one FaultyProxy per node and advertise
+            # the PROXY endpoint, so every cross-node client (storage +
+            # locks) dials through the injectable link
+            for spec in self.specs:
+                port = int(spec.endpoint.rsplit(":", 1)[1])
+                proxy = FaultyProxy("127.0.0.1", port).start()
+                spec.endpoint = proxy.endpoint
+                self.proxies.append(proxy)
+            # phase 3: assemble each node's layer over the proxied
+            # topology
+            for node in self.nodes:
+                node.assemble()
+            layer0 = self.nodes[0].layer
+            self.layer = layer0
+            # S3 frontend on node0 with the heal planes attached (the
+            # wiring run_node gives the leader)
+            self.s3 = S3Server(layer0, access_key=access_key,
+                               secret_key=secret_key)
+            self.mrf = MRFQueue(layer0, maxsize=mrf_maxsize)
+            for s in layer0.sets:
+                s.mrf = self.mrf
+            self.s3.mrf = self.mrf
+            self.healer = BackgroundHealer(layer0,
+                                           interval_s=24 * 3600.0)
+            self.s3.healer = self.healer
+            self.s3.attach_background(self.mrf, self.healer)
+            self.s3.start()
+        except Exception:
+            # a half-built cluster must not leak accept loops / server
+            # threads into the process (the thread-hygiene SLO every
+            # later scenario in this process asserts against)
+            self._teardown()
+            raise
+        # node0's local drives, as their HealthDisk wrappers in the
+        # layer — chaos swaps .inner under them
+        self.local_disks: list[HealthDisk] = [
+            d for s in layer0.sets for d in s.disks
+            if isinstance(d, HealthDisk) and d.inner.is_local()]
+
+    @property
+    def endpoint(self) -> str:
+        return self.s3.endpoint
+
+    # -- drive faults (HealthDisk offline/return, SlowDisk) ----------------
+
+    def drive_kill(self, idx: int) -> None:
+        """Deterministic drive death: every call fails, the breaker
+        marks it offline, writes queue MRF entries."""
+        hd = self.local_disks[idx]
+        if idx not in self._saved:
+            self._saved[idx] = hd.inner
+        hd.inner = BadDisk(self._saved[idx])
+        hd._mark_offline()
+
+    def drive_return(self, idx: int) -> None:
+        """The drive comes back with whatever it missed; the probe
+        re-admits it and heal-on-return sweeps its set."""
+        hd = self.local_disks[idx]
+        saved = self._saved.pop(idx, None)
+        if saved is not None:
+            hd.inner = saved
+        hd.probe()
+
+    def drive_slow(self, idx: int, delay_s: float = 0.05) -> None:
+        hd = self.local_disks[idx]
+        if idx not in self._saved:
+            self._saved[idx] = hd.inner
+        hd.inner = SlowDisk(self._saved[idx], delay_s=delay_s)
+
+    def drive_fast(self, idx: int) -> None:
+        hd = self.local_disks[idx]
+        saved = self._saved.pop(idx, None)
+        if saved is not None:
+            hd.inner = saved
+
+    # -- link faults (FaultyProxy per node) --------------------------------
+
+    def partition(self, node: int, fault: Fault | None = None) -> None:
+        """Cut the node's internode link: new connections get the
+        fault (default: immediate RST), established ones are severed."""
+        p = self.proxies[node]
+        p.set_default(fault or Fault.reset(after_bytes=0))
+        p.sever()
+
+    def blackhole(self, node: int) -> None:
+        self.partition(node, Fault.blackhole())
+
+    def burst_503(self, node: int) -> None:
+        self.partition(node, Fault.http_503())
+
+    def heal_link(self, node: int) -> None:
+        self.proxies[node].set_default(Fault.passthrough())
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def restore_all(self) -> None:
+        """Undo every live fault (scenario teardown must converge from
+        a healthy substrate)."""
+        for idx in list(self._saved):
+            hd = self.local_disks[idx]
+            hd.inner = self._saved.pop(idx)
+            hd.probe()
+        for i in range(len(self.proxies)):
+            self.heal_link(i)
+
+    def stop(self) -> None:
+        self.restore_all()
+        self._teardown()
+
+    def _teardown(self) -> None:
+        """Best-effort stop of every started component (shared by
+        normal stop and mid-constructor failure cleanup)."""
+        from ..storage.writers import close_write_planes
+        if self.s3 is not None:
+            try:
+                self.s3.stop()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                pass
+        for node in self.nodes:
+            try:
+                node.stop()
+            except Exception:  # noqa: BLE001 — teardown must finish
+                pass
+            # the scenario OWNS its layers: their fan-out pools and
+            # writer planes die with the cluster (a long soak process
+            # must not accumulate one executor per scenario)
+            lay = node.layer
+            if lay is None:
+                continue
+            try:
+                close_write_planes(lay)
+            except Exception:  # noqa: BLE001
+                pass
+            for s in getattr(lay, "sets", []):
+                pool = getattr(s, "_pool", None)
+                if pool is not None:
+                    pool.shutdown(wait=False)
+        for p in self.proxies:
+            try:
+                p.stop()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+@dataclass(frozen=True)
+class Event:
+    """One timeline entry: at ``at_s`` seconds from conductor start,
+    apply ``action`` (a SoakCluster method name) to ``node``/``drive``."""
+    at_s: float
+    action: str              # drive_kill|drive_return|drive_slow|
+    #                          drive_fast|partition|blackhole|
+    #                          burst_503|heal_link
+    node: int = 1
+    drive: int = 0
+    delay_s: float = 0.05
+
+    def apply(self, cluster: SoakCluster) -> None:
+        if self.action in ("drive_kill", "drive_return", "drive_fast"):
+            getattr(cluster, self.action)(self.drive)
+        elif self.action == "drive_slow":
+            cluster.drive_slow(self.drive, self.delay_s)
+        elif self.action in ("partition", "blackhole", "burst_503",
+                             "heal_link"):
+            getattr(cluster, self.action)(self.node)
+        else:
+            raise ValueError(f"unknown chaos action {self.action!r}")
+
+
+@dataclass
+class ChaosConductor:
+    """Replays a sorted fault timeline against a SoakCluster."""
+
+    cluster: SoakCluster
+    timeline: list[Event]
+    applied: list[dict] = field(default_factory=list)
+    errors: list[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "ChaosConductor":
+        def run():
+            t0 = time.monotonic()
+            for ev in sorted(self.timeline, key=lambda e: e.at_s):
+                wait = ev.at_s - (time.monotonic() - t0)
+                if wait > 0 and self._stop.wait(wait):
+                    return
+                try:
+                    ev.apply(self.cluster)
+                    self.applied.append({
+                        "at_s": round(time.monotonic() - t0, 3),
+                        "action": ev.action, "node": ev.node,
+                        "drive": ev.drive})
+                except Exception as e:  # noqa: BLE001 — a failed
+                    # injection must surface in the report, not kill
+                    # the conductor mid-timeline
+                    self.errors.append(f"{ev.action}@{ev.at_s}: "
+                                       f"{type(e).__name__}: {e}")
+        self._thread = threading.Thread(target=run, name="mt-soak-chaos",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def join(self, timeout: float = 60.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.join(timeout=5.0)

@@ -1,0 +1,212 @@
+"""Scenario runner + the ``BENCH_*``-shaped ``SOAK_r*.json`` report.
+
+One :class:`Scenario` = (workload mix, chaos timeline, duration, SLO
+budget).  :func:`run_scenario` boots a fresh proxied cluster, drives
+the mix while the conductor replays the timeline, then runs the full
+SLO assertion sweep (last-minute p50/p99 per API, error-rate ceiling,
+zero telemetry dead-letters, heal convergence, thread hygiene) and
+returns one ``{scenario, metric, value, unit, detail, passed}`` row
+per assertion.  :func:`run_matrix` sequences scenarios and writes the
+matrix report — the ``bench.py soak`` leg.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+
+from . import chaos as _chaos
+from . import slo as _slo
+from .workload import MIXES, Mix, WorkloadGenerator
+
+
+class SoakStatus:
+    """Live status a running conductor attaches to the S3 server
+    (read by the admin ``soak-status`` route)."""
+
+    def __init__(self, scenario: str):
+        self.scenario = scenario
+        self.state = "running"
+        self.started_ns = time.time_ns()
+        self._mu = threading.Lock()
+        self._rows: list[dict] = []
+
+    def finish(self, rows: list[dict]) -> None:
+        with self._mu:
+            self._rows = rows
+            self.state = "done"
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            rows = list(self._rows)
+        return {
+            "scenario": self.scenario,
+            "state": self.state,
+            "startedNs": self.started_ns,
+            "assertions": len(rows),
+            "failed": sum(1 for r in rows if not r.get("passed")),
+        }
+
+
+@dataclass
+class Scenario:
+    name: str
+    mix: Mix
+    timeline: list[_chaos.Event]
+    duration_s: float = 12.0
+    budget: _slo.Budget = field(default_factory=_slo.Budget)
+    workers: int = 2
+    nodes: int = 3
+    drives_per_node: int = 2
+
+
+# chaos knobs every scenario runs under: snappy breakers so fault
+# detection and re-admission fit the scenario window (the same env the
+# chaos drills pin), applied around the run and restored after
+_SOAK_ENV = {
+    "MT_RPC_BREAKER_FAILURES": "2",
+    "MT_RPC_BREAKER_COOLDOWN": "200ms",
+    "MT_RPC_RETRY_ATTEMPTS": "1",
+    "MT_API_SHUTDOWN_DRAIN_S": "5s",
+}
+
+
+def _chaos_timeline(t: float) -> list[_chaos.Event]:
+    """The standard non-overlapping fault sequence scaled to a
+    ``t``-second scenario: drive death mid-churn → return, slow drive
+    → recover, peer partition → heal, 503 burst → heal.  Faults never
+    overlap in a way that loses write quorum (6 drives, parity 2)."""
+    E = _chaos.Event
+    return [
+        E(0.08 * t, "drive_kill", drive=0),
+        E(0.28 * t, "drive_return", drive=0),
+        E(0.34 * t, "drive_slow", drive=1, delay_s=0.04),
+        E(0.52 * t, "drive_fast", drive=1),
+        E(0.58 * t, "partition", node=2),
+        E(0.74 * t, "heal_link", node=2),
+        E(0.80 * t, "burst_503", node=1),
+        E(0.90 * t, "heal_link", node=1),
+    ]
+
+
+def default_matrix(duration_s: float = 15.0) -> list[Scenario]:
+    """The acceptance matrix: every production mix under the full
+    concurrent chaos timeline.  The error budget is 10%: two of the
+    timeline's windows hold the set at EXACTLY write quorum, where the
+    first write per faulted drive-client must fail before its breaker
+    opens — bounded, expected shedding, not an SLO miss."""
+    budget = _slo.Budget(max_error_rate=0.10)
+    return [Scenario(name=mix.name, mix=mix,
+                     timeline=_chaos_timeline(duration_s),
+                     duration_s=duration_s, budget=budget)
+            for mix in MIXES.values()]
+
+
+def smoke_scenario(duration_s: float = 4.0) -> Scenario:
+    """The tier-1 miniature: small GET-heavy mix + one drive death +
+    return — same contract as the matrix, sized for CI."""
+    E = _chaos.Event
+    return Scenario(
+        name="smoke_get_heavy",
+        mix=MIXES["get_heavy_small"],
+        timeline=[E(0.2 * duration_s, "drive_kill", drive=0),
+                  E(0.6 * duration_s, "drive_return", drive=0)],
+        duration_s=duration_s,
+        budget=_slo.Budget(converge_timeout_s=30.0))
+
+
+def run_scenario(scenario: Scenario, base_dir: str,
+                 seed: int = 1) -> list[dict]:
+    """One scenario end to end on a fresh cluster; returns the SLO
+    assertion rows (never raises on an SLO miss — the rows carry
+    pass/fail so the matrix completes)."""
+    env_prev = {k: os.environ.get(k) for k in _SOAK_ENV}
+    os.environ.update(_SOAK_ENV)
+    threads_before = _slo.settled_thread_count(deadline_s=2.0)
+    thread_ids = {id(t) for t in threading.enumerate()}
+    try:
+        cluster = _chaos.SoakCluster(
+            base_dir, nodes=scenario.nodes,
+            drives_per_node=scenario.drives_per_node)
+        status = SoakStatus(scenario.name)
+        cluster.s3.soak = status
+        conv: dict | None = None
+        conv_err = ""
+        try:
+            gen = WorkloadGenerator(
+                cluster.endpoint, cluster.s3.iam.root.access_key,
+                cluster.s3.iam.root.secret_key, scenario.mix,
+                workers=scenario.workers, seed=seed)
+            conductor = _chaos.ChaosConductor(
+                cluster, scenario.timeline).start()
+            gen.run_for(scenario.duration_s)
+            conductor.join(timeout=scenario.duration_s + 30.0)
+            # snapshot the last-minute plane NOW: its 60s window +
+            # 64-sample rings would age the fault-window latencies out
+            # during convergence/teardown, hollowing the p99 assertion
+            api_pcts = _slo.api_percentiles(cluster.s3.api_stats)
+            cluster.restore_all()
+            try:
+                conv = _slo.assert_converged(
+                    cluster.layer,
+                    timeout_s=scenario.budget.converge_timeout_s,
+                    mrf=cluster.mrf)
+            except AssertionError as e:
+                conv_err = str(e)
+            scrape_text = _slo.scrape(cluster.endpoint)
+            recorder = gen.recorder
+            chaos_log = {"applied": conductor.applied,
+                         "errors": conductor.errors}
+        finally:
+            cluster.stop()
+        threads_after = _slo.settled_thread_count()
+        leaked = _slo.leaked_thread_names(thread_ids)
+        rows = _slo.evaluate(
+            scenario.name, api_pcts=api_pcts, recorder=recorder,
+            budget=scenario.budget, scrape_text=scrape_text,
+            convergence=conv, convergence_error=conv_err,
+            threads_before=threads_before, threads_after=threads_after,
+            leaked=leaked)
+        # context rows: what actually ran (not assertions; always pass)
+        rows.append({"scenario": scenario.name, "metric": "ops_total",
+                     "value": recorder.ops(), "unit": "ops",
+                     "passed": True,
+                     "detail": {"per_api": recorder.summary(),
+                                "chaos": chaos_log,
+                                "duration_s": scenario.duration_s,
+                                "seed": seed}})
+        status.finish(rows)
+        return rows
+    finally:
+        for k, v in env_prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def run_matrix(scenarios: list[Scenario] | None = None,
+               out_path: str = "SOAK_r01.json",
+               base_dir: str | None = None, seed: int = 1) -> dict:
+    """Run the scenario matrix sequentially and write the report."""
+    scenarios = scenarios if scenarios is not None else default_matrix()
+    rows: list[dict] = []
+    root = base_dir or tempfile.mkdtemp(prefix="soak-")
+    for i, sc in enumerate(scenarios):
+        rows.extend(run_scenario(sc, os.path.join(root, f"s{i}"),
+                                 seed=seed))
+    report = {
+        "report": "soak",
+        "scenarios": [sc.name for sc in scenarios],
+        "passed": sum(1 for r in rows if r["passed"]),
+        "failed": sum(1 for r in rows if not r["passed"]),
+        "rows": rows,
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+    return report

@@ -1,0 +1,341 @@
+"""SLO assertion engine: latency budgets over the last-minute stats
+plane, telemetry/thread hygiene, and heal convergence.
+
+The heal-convergence contract (the one the chaos drills and the soak
+matrix share): the MRF queue is DRAINED, a sweep completes, and
+``classify_disks`` reports every drive of every listed object's
+quorum version as OK on every erasure set — the cluster healed itself
+back to full redundancy after the faults, not merely "requests work".
+"""
+
+from __future__ import annotations
+
+import http.client
+import re
+import threading
+import time
+import urllib.parse
+from dataclasses import dataclass, field
+
+
+# -- percentiles over the last-minute plane ---------------------------------
+
+def percentile(samples: list[int], q: float) -> int:
+    """Nearest-rank percentile (0 on empty) over raw ns samples."""
+    if not samples:
+        return 0
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))]
+
+
+def api_percentiles(api_stats) -> dict[str, dict]:
+    """{api: {count, p50_ns, p99_ns}} from a server's last-minute
+    OpWindows (obs/lastminute.py) — the SERVER-observed latency the
+    SLO budgets are asserted against."""
+    out = {}
+    for api, w in list(api_stats.windows.items()):
+        live = w.live_samples()
+        if not live:
+            continue
+        out[api] = {"count": len(live),
+                    "p50_ns": percentile(live, 0.50),
+                    "p99_ns": percentile(live, 0.99)}
+    return out
+
+
+# -- budgets ----------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Budget:
+    """Per-scenario SLO budget.  Defaults are sized for a shared-CPU CI
+    box under active fault injection — generous in absolute terms, but
+    the assertions still catch the failure modes that matter: a hung
+    path (p99 blowout), an error storm, dropped telemetry, leaked
+    threads, and a cluster that never heals back."""
+    p50_ms: float = 2_500.0
+    p99_ms: float = 30_000.0
+    max_error_rate: float = 0.05
+    per_api_ms: dict = field(default_factory=dict)   # api -> (p50, p99)
+    converge_timeout_s: float = 45.0
+    thread_slack: int = 3
+
+    def limits_for(self, api: str) -> tuple[float, float]:
+        return self.per_api_ms.get(api, (self.p50_ms, self.p99_ms))
+
+
+# -- scrape helpers ---------------------------------------------------------
+
+_SAMPLE_RE = re.compile(r"^(\w+)(?:\{[^}]*\})? ([0-9eE.+-]+)$", re.M)
+
+
+def scrape(endpoint: str, timeout: float = 10.0) -> str:
+    """One live /minio-tpu/metrics scrape (unauthenticated, like
+    Prometheus)."""
+    u = urllib.parse.urlsplit(endpoint)
+    conn = http.client.HTTPConnection(u.hostname, u.port,
+                                      timeout=timeout)
+    try:
+        conn.request("GET", "/minio-tpu/metrics")
+        resp = conn.getresponse()
+        return resp.read().decode("utf-8", "replace")
+    finally:
+        conn.close()
+
+
+def metric_total(text: str, family: str) -> float:
+    """Sum of every sample of one family in an exposition document
+    (0.0 when the family is absent — the idle contract)."""
+    total = 0.0
+    for name, value in _SAMPLE_RE.findall(text):
+        if name == family:
+            total += float(value)
+    return total
+
+
+# -- heal convergence -------------------------------------------------------
+
+def _leaf_sets(layer) -> list:
+    sets = getattr(layer, "sets", None)
+    if sets is not None:
+        return list(sets)
+    pools = getattr(layer, "pools", None)
+    if pools is not None:
+        return [s for p in pools for s in p.sets]
+    return [layer]
+
+
+def converged_once(layer) -> tuple[bool, dict]:
+    """One convergence check: every listed object's quorum version
+    classifies all-OK (classify_disks) on its erasure set.  Returns
+    (ok, detail); detail names the first divergent object and its
+    per-disk states when not converged."""
+    from ..objectlayer import metadata as meta
+    from ..objectlayer.healing import DiskState, classify_disks
+    checked = 0
+    for b in layer.list_buckets():
+        marker = ""
+        while True:
+            out = layer.list_objects(b.name, marker=marker, max_keys=1000)
+            for oi in out.objects:
+                er = layer.get_hashed_set(oi.name) \
+                    if hasattr(layer, "get_hashed_set") else layer
+                fis, errs = er._fanout(
+                    lambda d, _b=b.name, _o=oi.name:
+                    d.read_version(_b, _o, None))
+                try:
+                    fi = meta.find_file_info_in_quorum(
+                        fis, max(1, len(er.disks) // 2))
+                except meta.ReadQuorumError:
+                    return False, {"bucket": b.name, "object": oi.name,
+                                   "reason": "below read quorum"}
+                states = classify_disks(er, b.name, oi.name, fi, fis,
+                                        errs)
+                checked += 1
+                if any(s != DiskState.OK for s in states):
+                    return False, {"bucket": b.name, "object": oi.name,
+                                   "states": states}
+            if not out.is_truncated:
+                break
+            marker = out.next_marker
+    return True, {"objects_checked": checked}
+
+
+def _repair_orphan_versions(layer, bucket: str, obj: str,
+                            states: list[str] | None = None) -> int:
+    """Purge sub-write-quorum orphan versions blocking convergence.
+
+    A write that FAILED client-side under faults leaves a version on a
+    minority of drives, in two shapes the sweep's latest-version heal
+    can never fix (the reference purges both via purgeObjectDangling,
+    cmd/erasure-healing.go:692):
+
+      * the orphan is NEWER than the quorum version on m < read-quorum
+        drives — those drives classify OUTDATED forever while the sweep
+        keeps healing the older quorum version;
+      * the orphan IS the quorum version (metadata on >= read-quorum
+        drives but intact shards on fewer than k) — heal_object
+        classifies it dangling and returns without healing OR purging.
+
+    Both get a targeted version heal with remove_dangling.  The
+    fi-purge is attempted only when no drive classifies OFFLINE —
+    purging because drives are temporarily unreachable would be data
+    loss, not repair."""
+    from ..objectlayer import metadata as meta
+    from ..objectlayer.healing import DiskState
+    er = layer.get_hashed_set(obj) if hasattr(layer, "get_hashed_set") \
+        else layer
+    fis, _errs = er._fanout(lambda d: d.read_version(bucket, obj, None))
+    try:
+        fi = meta.find_file_info_in_quorum(fis,
+                                           max(1, len(er.disks) // 2))
+    except meta.ReadQuorumError:
+        return 0
+    purged = 0
+    for dfi in fis:
+        if dfi is None or dfi.version_id == fi.version_id or \
+                dfi.mod_time <= fi.mod_time:
+            continue
+        try:
+            r = layer.heal_object(bucket, obj,
+                                  version_id=dfi.version_id or None,
+                                  remove_dangling=True)
+            if getattr(r, "dangling_purged", False):
+                purged += 1
+        except Exception:  # noqa: BLE001 — next sweep retries
+            pass
+    if purged == 0 and states and DiskState.OFFLINE not in states:
+        k = fi.erasure.data_blocks
+        if states.count(DiskState.OK) < k:
+            try:
+                r = layer.heal_object(bucket, obj,
+                                      version_id=fi.version_id or None,
+                                      remove_dangling=True)
+                if getattr(r, "dangling_purged", False):
+                    purged += 1
+            except Exception:  # noqa: BLE001 — next sweep retries
+                pass
+    return purged
+
+
+def assert_converged(layer, timeout_s: float = 30.0, mrf=None,
+                     poll_s: float = 0.25) -> dict:
+    """Drive the cluster to heal convergence and PROVE it: drain the
+    MRF queue, run sweeps, and require ``classify_disks`` clean on
+    every set — within ``timeout_s``.  The repeated sweep also doubles
+    as the half-open probe traffic that re-admits returned drives.
+
+    Returns {"sweeps", "objects_checked", "mrf_drained"}; raises
+    AssertionError naming the divergent object otherwise."""
+    from ..background.heal import BackgroundHealer
+    deadline = time.monotonic() + timeout_s
+    sweeps = 0
+    purged = 0
+    detail: dict = {}
+    healer = BackgroundHealer(layer=layer)
+    while True:
+        if mrf is not None:
+            mrf.drain(timeout=max(0.1, deadline - time.monotonic()))
+        healer.sweep()
+        sweeps += 1
+        ok, detail = converged_once(layer)
+        if not ok and "states" in detail:
+            # a sub-quorum orphan version (failed write under faults)
+            # blocks latest-version heal forever — purge it and retry
+            purged += _repair_orphan_versions(layer, detail["bucket"],
+                                              detail["object"],
+                                              detail.get("states"))
+        if ok:
+            mrf_drained = mrf is None or not mrf._q.unfinished_tasks
+            if mrf_drained:
+                return {"sweeps": sweeps,
+                        "objects_checked": detail.get("objects_checked",
+                                                      0),
+                        "orphan_versions_purged": purged,
+                        "mrf_drained": True}
+            detail = {"reason": "mrf not drained"}
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"heal did not converge within {timeout_s}s "
+                f"({sweeps} sweeps): {detail}")
+        time.sleep(poll_s)
+
+
+# -- thread hygiene ---------------------------------------------------------
+
+def settled_thread_count(deadline_s: float = 5.0) -> int:
+    """Thread count after letting daemon workers wind down — the
+    leak-detection primitive shared by tests/test_leaks.py and the
+    soak scenario teardown assertion."""
+    end = time.monotonic() + deadline_s
+    last = threading.active_count()
+    while time.monotonic() < end:
+        time.sleep(0.1)
+        cur = threading.active_count()
+        if cur == last:
+            return cur
+        last = cur
+    return last
+
+
+# process-global lazy singletons: started once per process on first
+# use, reused by every later server/cluster — their appearance during a
+# scenario is not a leak
+_SINGLETON_PREFIXES = ("mt-dsync-refresh",)
+
+
+def leaked_thread_names(before: set[int],
+                        exclude_prefixes: tuple[str, ...] =
+                        _SINGLETON_PREFIXES) -> list[str]:
+    """Names of live threads that did not exist in the ``before``
+    id-snapshot, minus known process-global singletons."""
+    return [t.name for t in threading.enumerate()
+            if t.is_alive() and id(t) not in before
+            and not t.name.startswith(exclude_prefixes)]
+
+
+# -- the per-scenario assertion sweep ---------------------------------------
+
+def evaluate(scenario: str, *, api_stats=None, api_pcts=None, recorder,
+             budget: Budget, scrape_text: str, convergence: dict | None,
+             convergence_error: str = "",
+             threads_before: int = 0, threads_after: int = 0,
+             leaked: list[str] | None = None) -> list[dict]:
+    """Every SLO assertion for one finished scenario, as
+    ``{scenario, metric, value, unit, detail, passed}`` rows (the
+    SOAK_r*.json shape).
+
+    ``api_pcts`` is an :func:`api_percentiles` snapshot taken AT
+    SCENARIO END — the last-minute plane is a 60s window with a
+    64-sample ring, so sampling it after a long convergence/teardown
+    would age fault-window latencies out and silently weaken the very
+    p99 assertion this engine exists for.  ``api_stats`` is accepted
+    as a convenience for callers evaluating immediately."""
+    rows = []
+    if api_pcts is None:
+        api_pcts = api_percentiles(api_stats) if api_stats is not None \
+            else {}
+
+    def row(metric, value, unit, passed, detail):
+        rows.append({"scenario": scenario, "metric": metric,
+                     "value": value, "unit": unit,
+                     "passed": bool(passed), "detail": detail})
+
+    # p50/p99 per S3 API from the server-side last-minute plane
+    for api, st in sorted(api_pcts.items()):
+        p50_ms = st["p50_ns"] / 1e6
+        p99_ms = st["p99_ns"] / 1e6
+        lim50, lim99 = budget.limits_for(api)
+        row(f"p50:{api}", round(p50_ms, 2), "ms", p50_ms <= lim50,
+            {"budget_ms": lim50, "samples": st["count"]})
+        row(f"p99:{api}", round(p99_ms, 2), "ms", p99_ms <= lim99,
+            {"budget_ms": lim99, "samples": st["count"]})
+
+    # client-observed error rate over the whole run
+    rate = recorder.error_rate()
+    row("error_rate", round(rate, 4), "ratio",
+        rate <= budget.max_error_rate,
+        {"budget": budget.max_error_rate, "ops": recorder.ops(),
+         "errors": recorder.error_count(),
+         "codes": dict(recorder.error_codes)})
+
+    # zero telemetry dead-letters (egress plane hygiene)
+    dead = metric_total(scrape_text, "mt_target_dead_letter_total")
+    row("telemetry_dead_letters", dead, "records", dead == 0,
+        {"family": "mt_target_dead_letter_total"})
+
+    # heal convergence: MRF drained + classify_disks clean on all sets
+    if convergence is not None:
+        row("heal_converged", 1, "bool", True, convergence)
+        row("mrf_drained", 1, "bool",
+            bool(convergence.get("mrf_drained", True)), {})
+    else:
+        row("heal_converged", 0, "bool", False,
+            {"error": convergence_error})
+
+    # no leaked threads after teardown (singleton-excluded name diff;
+    # the raw counts ride along as context)
+    grew = len(leaked or [])
+    row("thread_leak", grew, "threads", grew <= budget.thread_slack,
+        {"before": threads_before, "after": threads_after,
+         "slack": budget.thread_slack, "new": (leaked or [])[:8]})
+    return rows

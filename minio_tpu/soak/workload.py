@@ -1,0 +1,338 @@
+"""Mixed-workload load generator — seeded, deterministic, closed-loop.
+
+Each :class:`Worker` owns a disjoint key space and a private
+``random.Random`` seeded from ``(seed, mix, worker)``: the op sequence,
+object sizes, and payload bytes are all reproducible from the seed —
+the NaughtyDisk discipline applied to traffic instead of faults.
+Workers are closed-loop (one op in flight each), so offered load adapts
+to what the cluster sustains instead of piling an open-loop backlog
+onto a faulted system.
+
+Every op records its client-observed latency and outcome into an
+:class:`OpRecorder` (keyed by S3 API name, matching the server-side
+last-minute stats plane) and ticks the ``mt_soak_*`` counter families
+so a live scrape shows the generator's own view of the run.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+from ..admin.metrics import GLOBAL as _metrics
+from ..s3.client import S3Client, S3ClientError
+
+# CSV payload for the Select mix (pkg/s3select test corpus shape)
+_SELECT_CSV = (b"name,age,city\n" +
+               b"".join(f"user{i},{20 + i % 50},"
+                        f"{'paris' if i % 3 == 0 else 'tokyo'}\n"
+                        .encode() for i in range(64)))
+
+_SELECT_BODY = (
+    b'<?xml version="1.0" encoding="UTF-8"?>'
+    b'<SelectObjectContentRequest '
+    b'xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+    b"<Expression>SELECT name, age FROM S3Object WHERE city = 'paris'"
+    b"</Expression>"
+    b"<ExpressionType>SQL</ExpressionType>"
+    b"<InputSerialization><CSV><FileHeaderInfo>USE</FileHeaderInfo></CSV>"
+    b"</InputSerialization>"
+    b"<OutputSerialization><CSV/></OutputSerialization>"
+    b"</SelectObjectContentRequest>")
+
+
+@dataclass(frozen=True)
+class Mix:
+    """One production traffic mix: op weights + object-size palette.
+
+    ``weights`` keys are op tags understood by :class:`Worker`
+    (``put``/``get``/``head``/``list``/``select``/``multipart``/
+    ``churn``); sizes are drawn seeded from ``sizes_bytes``."""
+    name: str
+    weights: dict[str, float]
+    sizes_bytes: tuple[int, ...] = (4096, 16384, 65536)
+    versioned: bool = False
+    multipart_parts: int = 2
+    part_bytes: int = 5 * 1024 * 1024      # S3 minimum (last part exempt)
+    key_space: int = 8                     # object pool per worker
+
+
+# the production mixes from ROADMAP item 5
+MIXES: dict[str, Mix] = {m.name: m for m in (
+    Mix("get_heavy_small",
+        {"get": 0.60, "put": 0.20, "head": 0.10, "list": 0.10},
+        sizes_bytes=(2048, 8192, 32768)),
+    Mix("multipart_upload",
+        {"multipart": 0.20, "get": 0.40, "put": 0.30, "head": 0.10},
+        sizes_bytes=(65536, 262144)),
+    Mix("listing_heavy",
+        {"list": 0.55, "put": 0.25, "get": 0.15, "head": 0.05},
+        sizes_bytes=(1024, 4096), key_space=16),
+    Mix("select_queries",
+        {"select": 0.45, "get": 0.25, "put": 0.25, "list": 0.05},
+        sizes_bytes=(4096, 16384)),
+    Mix("versioned_churn",
+        {"churn": 0.45, "put": 0.25, "get": 0.25, "list": 0.05},
+        sizes_bytes=(2048, 16384), versioned=True),
+)}
+
+
+class OpRecorder:
+    """Per-op latency samples + error accounting, keyed by S3 API name
+    (the same names the server's last-minute plane uses)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.samples: dict[str, list[int]] = defaultdict(list)   # ns
+        self.errors: dict[str, int] = defaultdict(int)
+        self.error_codes: dict[str, int] = defaultdict(int)
+        self.bytes_tx = 0
+        self.bytes_rx = 0
+
+    def record(self, api: str, duration_ns: int, *, error: str = "",
+               tx: int = 0, rx: int = 0) -> None:
+        with self._mu:
+            self.samples[api].append(duration_ns)
+            self.bytes_tx += tx
+            self.bytes_rx += rx
+            if error:
+                self.errors[api] += 1
+                self.error_codes[error] += 1
+        _metrics.inc("mt_soak_ops_total", {"op": api})
+        if error:
+            _metrics.inc("mt_soak_errors_total", {"op": api})
+        if tx:
+            _metrics.inc("mt_soak_bytes_total", {"dir": "tx"}, tx)
+        if rx:
+            _metrics.inc("mt_soak_bytes_total", {"dir": "rx"}, rx)
+
+    # -- aggregation --------------------------------------------------------
+
+    def ops(self) -> int:
+        with self._mu:
+            return sum(len(v) for v in self.samples.values())
+
+    def error_count(self) -> int:
+        with self._mu:
+            return sum(self.errors.values())
+
+    def error_rate(self) -> float:
+        n = self.ops()
+        return self.error_count() / n if n else 0.0
+
+    def percentile(self, api: str, q: float) -> int:
+        from .slo import percentile
+        with self._mu:
+            live = list(self.samples.get(api, ()))
+        return percentile(live, q)
+
+    def summary(self) -> dict:
+        with self._mu:
+            apis = sorted(self.samples)
+        out = {}
+        for api in apis:
+            with self._mu:
+                n = len(self.samples[api])
+                errs = self.errors.get(api, 0)
+            out[api] = {
+                "count": n, "errors": errs,
+                "p50_ms": round(self.percentile(api, 0.50) / 1e6, 2),
+                "p99_ms": round(self.percentile(api, 0.99) / 1e6, 2),
+            }
+        return out
+
+
+class Worker(threading.Thread):
+    """One closed-loop traffic source over its own key space."""
+
+    def __init__(self, gen: "WorkloadGenerator", idx: int):
+        super().__init__(name=f"mt-soak-w{idx}", daemon=True)
+        self.gen = gen
+        self.idx = idx
+        self.rng = random.Random(f"{gen.seed}/{gen.mix.name}/{idx}")
+        self.client = S3Client(gen.endpoint, gen.access_key,
+                               gen.secret_key)
+        self.prefix = f"w{idx}"
+        # key -> expected size, the GET integrity oracle
+        self.sizes: dict[str, int] = {}
+        self._ops = []
+        self._weights = []
+        for op, w in sorted(gen.mix.weights.items()):
+            self._ops.append(op)
+            self._weights.append(w)
+
+    # -- op implementations -------------------------------------------------
+
+    def _key(self) -> str:
+        return f"{self.prefix}/o{self.rng.randrange(self.gen.mix.key_space)}"
+
+    def _body(self) -> bytes:
+        return self.rng.randbytes(
+            self.rng.choice(self.gen.mix.sizes_bytes))
+
+    def _op_put(self, c: S3Client) -> tuple[str, int, int]:
+        key = self._key()
+        body = self._body()
+        c.put_object(self.gen.bucket, key, body)
+        self.sizes[key] = len(body)
+        return "PutObject", len(body), 0
+
+    def _op_get(self, c: S3Client) -> tuple[str, int, int]:
+        key = self._key()
+        want = self.sizes.get(key)
+        r = c.get_object(self.gen.bucket, key)
+        if want is not None and len(r.body) != want:
+            raise S3ClientError(200, "IntegrityMismatch",
+                                f"{key}: {len(r.body)} != {want}")
+        return "GetObject", 0, len(r.body)
+
+    def _op_head(self, c: S3Client) -> tuple[str, int, int]:
+        c.head_object(self.gen.bucket, self._key())
+        return "HeadObject", 0, 0
+
+    def _op_list(self, c: S3Client) -> tuple[str, int, int]:
+        objs, _ = c.list_objects(self.gen.bucket,
+                                 prefix=f"{self.prefix}/")
+        return "ListObjectsV2", 0, sum(o["size"] for o in objs)
+
+    def _op_select(self, c: S3Client) -> tuple[str, int, int]:
+        r = c.request("POST", f"/{self.gen.bucket}/{self.prefix}/sel.csv",
+                      "select&select-type=2", _SELECT_BODY)
+        return "SelectObjectContent", len(_SELECT_BODY), len(r.body)
+
+    def _op_multipart(self, c: S3Client) -> tuple[str, int, int]:
+        key = f"{self.prefix}/mp{self.rng.randrange(2)}"
+        uid = c.create_multipart_upload(self.gen.bucket, key)
+        tx = 0
+        parts = []
+        for pn in range(1, self.gen.mix.multipart_parts + 1):
+            body = self.rng.randbytes(self.gen.mix.part_bytes)
+            parts.append((pn, c.upload_part(self.gen.bucket, key, uid,
+                                            pn, body)))
+            tx += len(body)
+        c.complete_multipart_upload(self.gen.bucket, key, uid, parts)
+        self.sizes[key] = tx
+        return "CompleteMultipartUpload", tx, 0
+
+    def _op_churn(self, c: S3Client) -> tuple[str, int, int]:
+        """Versioned overwrite/delete churn: overwrite, delete (a
+        marker on versioned buckets), immediately re-put — the key pool
+        stays GET-able while versions/markers accumulate."""
+        key = self._key()
+        body = self._body()
+        c.put_object(self.gen.bucket, key, body)
+        c.delete_object(self.gen.bucket, key)
+        c.put_object(self.gen.bucket, key, body)
+        self.sizes[key] = len(body)
+        return "DeleteObject", 2 * len(body), 0
+
+    # -- loop ---------------------------------------------------------------
+
+    _OPS = {"put": _op_put, "get": _op_get, "head": _op_head,
+            "list": _op_list, "select": _op_select,
+            "multipart": _op_multipart, "churn": _op_churn}
+
+    def preload(self) -> None:
+        """Seed the key space so GET/HEAD/LIST never miss by design
+        (counted like any other traffic)."""
+        c, rec = self.client, self.gen.recorder
+        for i in range(self.gen.mix.key_space):
+            key = f"{self.prefix}/o{i}"
+            body = self._body()
+            t0 = time.monotonic_ns()
+            err = ""
+            try:
+                c.put_object(self.gen.bucket, key, body)
+                self.sizes[key] = len(body)
+            except Exception as e:  # noqa: BLE001 — recorded below
+                err = getattr(e, "code", type(e).__name__)
+            rec.record("PutObject", time.monotonic_ns() - t0,
+                       error=err, tx=len(body))
+        if "select" in self.gen.mix.weights:
+            c.put_object(self.gen.bucket, f"{self.prefix}/sel.csv",
+                         _SELECT_CSV, content_type="text/csv")
+
+    def run(self) -> None:
+        rec = self.gen.recorder
+        while not self.gen._stop.is_set():
+            op = self.rng.choices(self._ops, weights=self._weights)[0]
+            fn = self._OPS[op]
+            t0 = time.monotonic_ns()
+            api, err, tx, rx = op, "", 0, 0
+            for backoff in (0.25, 0.6, None):
+                err = ""
+                try:
+                    api, tx, rx = fn(self, self.client)
+                    break
+                except S3ClientError as e:
+                    api = _API_OF.get(op, "PutObject")
+                    err = e.code
+                    # 503 SlowDown is the server asking for a retry
+                    # (transient quorum loss / shed under chaos) — real
+                    # S3 clients back off and retry; only exhausting
+                    # the retry schedule counts against the budget
+                    if err == "SlowDown" and backoff is not None and \
+                            not self.gen._stop.is_set():
+                        time.sleep(backoff)
+                        continue
+                    break
+                except Exception as e:  # noqa: BLE001 — transport
+                    api = _API_OF.get(op, "PutObject")  # faults are
+                    err = type(e).__name__              # part of the data
+                    break
+            rec.record(api, time.monotonic_ns() - t0, error=err,
+                       tx=tx, rx=rx)
+
+
+# op tag -> API name for error attribution (success paths return theirs)
+_API_OF = {"put": "PutObject", "get": "GetObject", "head": "HeadObject",
+           "list": "ListObjectsV2", "select": "SelectObjectContent",
+           "multipart": "CompleteMultipartUpload", "churn": "DeleteObject"}
+
+
+@dataclass
+class WorkloadGenerator:
+    """Seeded closed-loop workload over one bucket of one S3 endpoint."""
+
+    endpoint: str
+    access_key: str
+    secret_key: str
+    mix: Mix
+    workers: int = 2
+    seed: int = 1
+    bucket: str = ""
+    recorder: OpRecorder = field(default_factory=OpRecorder)
+
+    def __post_init__(self):
+        if not self.bucket:
+            self.bucket = f"soak-{self.mix.name.replace('_', '-')}"
+        self._stop = threading.Event()
+        self._workers: list[Worker] = []
+
+    def start(self) -> None:
+        c = S3Client(self.endpoint, self.access_key, self.secret_key)
+        if not c.head_bucket(self.bucket):
+            c.make_bucket(self.bucket)
+        if self.mix.versioned:
+            c.set_versioning(self.bucket, True)
+        self._workers = [Worker(self, i) for i in range(self.workers)]
+        for w in self._workers:
+            w.preload()
+        for w in self._workers:
+            w.start()
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        for w in self._workers:
+            w.join(timeout=timeout)
+
+    def run_for(self, seconds: float) -> OpRecorder:
+        self.start()
+        try:
+            time.sleep(seconds)
+        finally:
+            self.stop()
+        return self.recorder

@@ -3,13 +3,18 @@
 Mirrors the reference's deterministic fault injection:
   * naughtyDisk (cmd/naughty-disk_test.go:29-44): programmed error on the
     Nth StorageAPI call, pass-through otherwise;
-  * badDisk: every call fails (cmd/erasure-heal_test.go badDisk).
+  * badDisk: every call fails (cmd/erasure-heal_test.go badDisk);
+  * slowDisk: every call is delayed by a programmable amount, with
+    per-call-number overrides following naughtyDisk's discipline — the
+    latency injector the slow-drive detector (storage/health.py
+    slow_drives) can actually see.
 Lives in the main package (not tests/) so the heal/chaos CLIs can use it.
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 from . import errors
@@ -93,6 +98,57 @@ class BadDisk(StorageAPI):
         pass
 
 
+class SlowDisk(StorageAPI):
+    """Latency-injection wrapper: every data-plane call sleeps a
+    programmable delay before delegating, and the DELAY-INCLUSIVE
+    duration lands in this wrapper's own last-minute latency windows
+    (labelled with the wrapped drive's endpoint).  Chaos scenarios
+    interpose it under a HealthDisk, so ``drive_windows`` resolves to
+    THESE windows and the slow-drive detector flags the drive exactly
+    as it would a failing spindle.  ``delays`` programs per-call-number
+    overrides (1-based, NaughtyDisk's discipline); unprogrammed calls
+    use ``delay_s``."""
+
+    def __init__(self, disk: StorageAPI, delay_s: float = 0.05,
+                 delays: Optional[dict[int, float]] = None):
+        from ..obs.lastminute import OpWindows
+        self._disk = disk
+        self.delay_s = delay_s
+        self._delays = delays or {}
+        self._call_nr = 0
+        self._mu = threading.Lock()
+        self.latency = OpWindows(disk.endpoint())
+
+    def _next_delay(self) -> float:
+        with self._mu:
+            self._call_nr += 1
+            n = self._call_nr
+        return self._delays.get(n, self.delay_s)
+
+    def is_online(self) -> bool:
+        return self._disk.is_online()
+
+    def endpoint(self) -> str:
+        return self._disk.endpoint()
+
+    def is_local(self) -> bool:
+        return self._disk.is_local()
+
+    def get_disk_id(self) -> str:
+        return self._disk.get_disk_id()
+
+    def set_disk_id(self, disk_id: str) -> None:
+        self._disk.set_disk_id(disk_id)
+
+    def close(self) -> None:
+        self._disk.close()
+
+    def __getattr__(self, name):
+        # non-data-plane helpers (tmp_dir, clean_tmp, root, ...) pass
+        # through undelayed — only StorageAPI data calls carry latency
+        return getattr(self._disk, name)
+
+
 def _passthrough(name):
     def call(self, *a, **kw):
         self._maybe_fail()
@@ -108,10 +164,26 @@ def _alwaysfail(name):
     return call
 
 
+def _slowthrough(name):
+    def call(self, *a, **kw):
+        delay = self._next_delay()
+        t0 = time.monotonic_ns()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            return getattr(self._disk, name)(*a, **kw)
+        finally:
+            self.latency.record(name, time.monotonic_ns() - t0)
+    call.__name__ = name
+    return call
+
+
 for _m in _METHODS:
     setattr(NaughtyDisk, _m, _passthrough(_m))
     setattr(BadDisk, _m, _alwaysfail(_m))
+    setattr(SlowDisk, _m, _slowthrough(_m))
 del _m
 # generated methods satisfy the ABC contract; clear the frozen abstract set
 NaughtyDisk.__abstractmethods__ = frozenset()
 BadDisk.__abstractmethods__ = frozenset()
+SlowDisk.__abstractmethods__ = frozenset()

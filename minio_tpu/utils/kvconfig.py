@@ -75,6 +75,12 @@ register_subsys("api", {
     "read_header_timeout": "30s",
     "body_deadline": "2m",
     "body_min_rate": "1048576",     # 1 MiB/s floor rate
+    # graceful shutdown drain (s3/server.py stop): the listener closes
+    # first (new connections refused), then in-flight requests get this
+    # long to finish before remaining connections are severed; idle
+    # keep-alive connections are severed immediately.  0 restores the
+    # immediate-sever behavior.  Live-reloadable (reload_api_config).
+    "shutdown_drain_s": "5s",
     "cors_allow_origin": "*",
 })
 register_subsys("rpc", {

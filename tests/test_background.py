@@ -281,8 +281,71 @@ def test_admin_background_endpoints(tmp_path):
         doc = json.loads(r.body)
         assert doc["sweep"]["objectsScanned"] == 1
         assert doc["mrf"]["mrfQueued"] == 0
+        assert doc["mrf"]["mrfDropped"] == 0
     finally:
         srv.stop()
+
+
+def test_heal_multipart_object_restores_every_part(tmp_path):
+    """Regression (found by the soak matrix): rename_data REPLACES the
+    data dir, so the old per-part heal commit left only the LAST part
+    on the healed drive — a multipart object classified CORRUPT
+    forever.  All parts must stage into one tmp dir with a single
+    atomic commit per drive, leaving no tmp staging behind."""
+    import glob
+    import hashlib
+    import os
+    import shutil
+
+    from minio_tpu.objectlayer.erasure_object import ErasureObjects
+    from minio_tpu.storage.xl_storage import XLStorage
+    disks = []
+    for i in range(6):
+        d = tmp_path / f"hd{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    er = ErasureObjects(disks, parity=2, block_size=256 * 1024,
+                        backend="numpy")
+    er.make_bucket("mph")
+    uid = er.new_multipart_upload("mph", "obj")
+    part = os.urandom(5 * 1024 * 1024)
+    etags = [(pn, er.put_object_part("mph", "obj", uid, pn, part).etag)
+             for pn in (1, 2)]
+    er.complete_multipart_upload("mph", "obj", uid, etags)
+    shutil.rmtree(tmp_path / "hd0" / "mph" / "obj")
+    r = er.heal_object("mph", "obj")
+    assert len(r.healed_disks) == 1
+    # the healed drive classifies OK again — BOTH parts present
+    r2 = er.heal_object("mph", "obj", dry_run=True)
+    assert r2.before_ok == 6
+    _, got = er.get_object("mph", "obj")
+    assert hashlib.md5(bytes(got)).digest() == \
+        hashlib.md5(part + part).digest()
+    # staging cleaned up everywhere
+    leftover = [p for i in range(6) for p in glob.glob(
+        str(tmp_path / f"hd{i}" / ".mt.sys" / "tmp" / "*"))
+        if os.path.isdir(p)]
+    assert not leftover, leftover
+
+
+def test_mrf_queue_full_counts_drops(er):
+    """A full MRF queue must COUNT each dropped entry instead of
+    silently losing the signal: the admin heal-status payload carries
+    mrfDropped beside mrfQueued/mrfHealed and the scrape exports
+    mt_heal_mrf_dropped_total (ISSUE 8 satellite)."""
+    mrf = MRFQueue(er, maxsize=2)       # worker never started: entries sit
+    mrf.add("mdb", "o1")
+    mrf.add("mdb", "o2")
+    mrf.add("mdb", "o3")                # queue full: dropped, counted
+    mrf.add("mdb", "o4")
+    assert mrf.stats.mrf_queued == 2
+    assert mrf.stats.mrf_dropped == 2
+    d = mrf.stats.to_dict()
+    assert d["mrfQueued"] == 2 and d["mrfDropped"] == 2
+    from minio_tpu.admin import metrics
+    text = metrics.render(mrf=mrf)
+    assert "mt_heal_mrf_dropped_total 2" in text
+    assert "mt_heal_mrf_queued_total 2" in text
 
 
 def test_build_server_wires_background_services(tmp_path):

@@ -12,18 +12,10 @@ from minio_tpu.s3.client import S3Client
 from minio_tpu.s3.server import S3Server
 from minio_tpu.storage.xl_storage import XLStorage
 
-
-def _settled_thread_count(deadline_s: float = 5.0) -> int:
-    """Thread count after letting daemon workers wind down."""
-    end = time.monotonic() + deadline_s
-    last = threading.active_count()
-    while time.monotonic() < end:
-        time.sleep(0.1)
-        cur = threading.active_count()
-        if cur == last:
-            return cur
-        last = cur
-    return last
+# shared with the soak plane: every soak scenario runs this same
+# settle-then-count assertion after teardown (soak/slo.py)
+from minio_tpu.soak.slo import settled_thread_count as \
+    _settled_thread_count
 
 
 def test_server_start_stop_does_not_leak_threads(tmp_path):
@@ -189,8 +181,21 @@ def test_writer_plane_threads_stop_with_server(tmp_path):
         while time.monotonic() < deadline and not plane_threads():
             time.sleep(0.02)
         assert plane_threads()
-        # unblock the hung op shortly AFTER stop starts joining
-        threading.Timer(0.4, release.set).start()
+        # unblock the hung op only once the plane has actually BEGUN
+        # closing (generation bump) — a wall-clock timer races stop()'s
+        # serve_forever poll latency and can release the drive while
+        # the PUT could still complete
+        plane = layer._write_plane
+        gen0 = plane._gen
+
+        def release_when_closing():
+            end = time.monotonic() + 15.0
+            while time.monotonic() < end and plane._gen == gen0:
+                time.sleep(0.02)
+            release.set()
+
+        threading.Thread(target=release_when_closing,
+                         daemon=True).start()
         srv.stop()                      # closes the writer plane
         t.join(15)
         assert not t.is_alive()

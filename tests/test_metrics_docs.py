@@ -60,7 +60,7 @@ def test_every_emitted_family_is_documented(tmp_path):
 
     text = metrics.render(layer, healer=healer, config=None,
                           api_stats=api_stats, replication=repl,
-                          crawler=crawler)
+                          crawler=crawler, mrf=mrf)
     # the federated path adds the scrape-status families on top of a
     # merged per-node document
     fed = metrics.merge_expositions(

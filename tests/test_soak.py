@@ -1,0 +1,323 @@
+"""Soak plane (minio_tpu/soak): mixed-workload load generation, the
+chaos conductor, and SLO assertions with heal convergence.
+
+Tier-1 carries the smoke scenario — a miniature of the acceptance
+matrix (small GET-heavy mix + one drive death + return on a real
+3-node cluster, asserting p50/p99 budgets, error ceiling, zero
+dead-letters, heal convergence, and thread hygiene) — plus the unit
+tier for SlowDisk detection, deterministic workload seeding, SLO
+machinery, and the orphan-version convergence repair.  The full
+5-mix x full-timeline matrix (the ``bench.py soak`` leg) is
+slow-marked.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.soak import chaos as soak_chaos
+from minio_tpu.soak import report as soak_report
+from minio_tpu.soak import slo as soak_slo
+from minio_tpu.soak.workload import MIXES, OpRecorder, WorkloadGenerator
+from minio_tpu.storage.faulty import SlowDisk
+from minio_tpu.storage.xl_storage import XLStorage
+
+
+def _disks(tmp_path, n=4, slow_idx=None, delay_s=0.03):
+    disks = []
+    for i in range(n):
+        d = tmp_path / f"d{i}"
+        d.mkdir()
+        x = XLStorage(str(d))
+        disks.append(SlowDisk(x, delay_s=delay_s)
+                     if i == slow_idx else x)
+    return disks
+
+
+# -- SlowDisk: the latency injector the detector actually sees -------------
+
+def test_slowdisk_trips_slow_drive_detector(tmp_path):
+    from minio_tpu.storage import health
+    disks = _disks(tmp_path, slow_idx=0, delay_s=0.03)
+    er = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                        backend="numpy")
+    er.make_bucket("slow")
+    for i in range(6):
+        er.put_object("slow", f"o{i}", b"x" * 8192)
+        er.get_object("slow", f"o{i}")
+    out = health.slow_drives(er.disks, multiple=4.0, min_samples=10)
+    slow_ep = disks[0].endpoint()
+    assert out[slow_ep]["slow"] is True
+    assert all(not v["slow"] for ep, v in out.items() if ep != slow_ep)
+    # and the live scrape flags it (mt_node_disk_slow 1)
+    from minio_tpu.admin import metrics
+    text = metrics.render(er)
+    flagged = [ln for ln in text.splitlines()
+               if ln.startswith("mt_node_disk_slow") and slow_ep in ln]
+    assert flagged and flagged[0].endswith(" 1")
+
+
+def test_slowdisk_per_call_program_and_passthrough(tmp_path):
+    import time
+    d = tmp_path / "sd"
+    d.mkdir()
+    inner = XLStorage(str(d))
+    s = SlowDisk(inner, delay_s=0.0, delays={2: 0.05})
+    s.make_vol("vol1")                      # call 1: no delay
+    t0 = time.monotonic()
+    s.write_all("vol1", "f", b"abc")        # call 2: programmed 50 ms
+    assert time.monotonic() - t0 >= 0.05
+    assert s.read_all("vol1", "f") == b"abc"    # call 3: no delay
+    assert s.endpoint() == inner.endpoint()
+    assert s.latency.totals()               # delay-inclusive windows
+
+
+# -- workload generator: determinism + recording ---------------------------
+
+def test_workload_seeding_is_deterministic():
+    from minio_tpu.soak.workload import Worker
+
+    class _Gen:
+        seed = 7
+        mix = MIXES["get_heavy_small"]
+        endpoint = "http://127.0.0.1:1"
+        access_key = secret_key = "x"
+        bucket = "b"
+        recorder = OpRecorder()
+        _stop = threading.Event()
+
+    a, b = Worker(_Gen(), 0), Worker(_Gen(), 0)
+    seq_a = [a.rng.choices(a._ops, weights=a._weights)[0]
+             for _ in range(32)]
+    seq_b = [b.rng.choices(b._ops, weights=b._weights)[0]
+             for _ in range(32)]
+    assert seq_a == seq_b
+    assert a._body() == b._body()
+    c = Worker(_Gen(), 1)                   # different worker: new stream
+    assert [c.rng.choices(c._ops, weights=c._weights)[0]
+            for _ in range(32)] != seq_a
+
+
+def test_recorder_percentiles_and_error_rate():
+    rec = OpRecorder()
+    for i in range(100):
+        rec.record("GetObject", (i + 1) * 1_000_000)
+    rec.record("PutObject", 5_000_000, error="SlowDown")
+    assert rec.ops() == 101
+    assert rec.error_count() == 1
+    assert abs(rec.error_rate() - 1 / 101) < 1e-9
+    assert rec.percentile("GetObject", 0.50) == 51 * 1_000_000
+    assert rec.percentile("GetObject", 0.99) == 99 * 1_000_000
+    s = rec.summary()
+    assert s["PutObject"]["errors"] == 1
+
+
+# -- SLO engine units -------------------------------------------------------
+
+def test_metric_total_parses_exposition():
+    text = ("# TYPE mt_target_dead_letter_total counter\n"
+            'mt_target_dead_letter_total{target="a"} 2\n'
+            'mt_target_dead_letter_total{target="b"} 3\n'
+            "mt_other_total 9\n")
+    assert soak_slo.metric_total(text, "mt_target_dead_letter_total") == 5
+    assert soak_slo.metric_total(text, "mt_absent_total") == 0
+
+
+def test_evaluate_rows_shape_and_budgets():
+    from minio_tpu.obs.lastminute import OpWindows
+    stats = OpWindows("t")
+    for _ in range(20):
+        stats.record("GetObject", 2_000_000)        # 2 ms
+    rec = OpRecorder()
+    rec.record("GetObject", 2_000_000)
+    rows = soak_slo.evaluate(
+        "unit", api_stats=stats, recorder=rec,
+        budget=soak_slo.Budget(p50_ms=1000, p99_ms=2000),
+        scrape_text="", convergence={"sweeps": 1, "mrf_drained": True},
+        threads_before=5, threads_after=5, leaked=[])
+    by_metric = {r["metric"]: r for r in rows}
+    for key in ("p50:GetObject", "p99:GetObject", "error_rate",
+                "telemetry_dead_letters", "heal_converged",
+                "mrf_drained", "thread_leak"):
+        assert key in by_metric, key
+        r = by_metric[key]
+        assert set(r) >= {"scenario", "metric", "value", "unit",
+                          "detail", "passed"}
+    assert all(r["passed"] for r in rows)
+    # a blown budget flips exactly the budget rows
+    rows2 = soak_slo.evaluate(
+        "unit", api_stats=stats, recorder=rec,
+        budget=soak_slo.Budget(p50_ms=0.001, p99_ms=0.001),
+        scrape_text="", convergence={"sweeps": 1},
+        threads_before=5, threads_after=5, leaked=[])
+    bm2 = {r["metric"]: r for r in rows2}
+    assert not bm2["p50:GetObject"]["passed"]
+    assert not bm2["p99:GetObject"]["passed"]
+    assert bm2["error_rate"]["passed"]
+
+
+def test_assert_converged_heals_and_purges_orphan_version(tmp_path):
+    """The convergence helper drives a degraded layer back to clean
+    classify_disks — including purging a sub-write-quorum orphan
+    version that latest-version sweeps can never repair."""
+    import shutil
+    disks = _disks(tmp_path, n=6)
+    er = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                        backend="numpy")
+    er.make_bucket("conv")
+    er.put_object("conv", "obj", b"v1" * 4096)
+    # degrade: wipe one drive's copy entirely (missing shard)
+    shutil.rmtree(tmp_path / "d0" / "conv" / "obj")
+    ok, _ = soak_slo.converged_once(er)
+    assert not ok
+    out = soak_slo.assert_converged(er, timeout_s=20.0)
+    assert out["mrf_drained"]
+    ok, detail = soak_slo.converged_once(er)
+    assert ok and detail["objects_checked"] == 1
+    # orphan: a newer version present on 2 < write-quorum drives (a
+    # failed versioned write) — convergence repairs it
+    from minio_tpu.storage.datatypes import now_ns
+    fis, _ = er._fanout(lambda d: d.read_version("conv", "obj", None))
+    fi = next(f for f in fis if f is not None)
+    import copy
+    for i in (0, 1):
+        dfi = copy.deepcopy(fi)
+        dfi.version_id = "feedfeedfeedfeedfeedfeedfeedfeed"
+        dfi.mod_time = now_ns()
+        dfi.deleted = True
+        dfi.parts = []
+        dfi.size = 0
+        dfi.inline_data = None
+        dfi.data_dir = ""
+        er.disks[i].write_metadata("conv", "obj", dfi)
+    ok, detail = soak_slo.converged_once(er)
+    assert not ok
+    out = soak_slo.assert_converged(er, timeout_s=20.0)
+    assert out["orphan_versions_purged"] >= 1
+    ok, _ = soak_slo.converged_once(er)
+    assert ok
+
+
+# -- chaos timeline determinism --------------------------------------------
+
+def test_chaos_events_apply_and_unknown_action_rejected():
+    applied = []
+
+    class _FakeCluster:
+        def drive_kill(self, i):
+            applied.append(("kill", i))
+
+        def partition(self, n):
+            applied.append(("partition", n))
+
+    soak_chaos.Event(0, "drive_kill", drive=3).apply(_FakeCluster())
+    soak_chaos.Event(0, "partition", node=2).apply(_FakeCluster())
+    assert applied == [("kill", 3), ("partition", 2)]
+    with pytest.raises(ValueError):
+        soak_chaos.Event(0, "explode").apply(_FakeCluster())
+
+
+# -- the tier-1 smoke scenario ---------------------------------------------
+
+def test_smoke_scenario_meets_slo_and_converges(tmp_path):
+    """The miniature acceptance contract: a real 3-node proxied
+    cluster under a GET-heavy mix takes a drive death mid-traffic,
+    gets the drive back, and ends inside SLO with heal convergence,
+    zero dead-letters, and no leaked threads — the same rows the full
+    matrix emits, in tier-1 time."""
+    sc = soak_report.smoke_scenario(duration_s=3.0)
+    rows = soak_report.run_scenario(sc, str(tmp_path / "soak"))
+    by_metric = {r["metric"]: r for r in rows}
+    # the chaos actually landed
+    chaos = by_metric["ops_total"]["detail"]["chaos"]
+    assert [e["action"] for e in chaos["applied"]] == \
+        ["drive_kill", "drive_return"]
+    assert chaos["errors"] == []
+    # real traffic flowed and every assertion passed
+    assert by_metric["ops_total"]["value"] > 10
+    failed = [r for r in rows if not r["passed"]]
+    assert not failed, failed
+    assert by_metric["heal_converged"]["value"] == 1
+    assert by_metric["telemetry_dead_letters"]["value"] == 0
+    # rows carry the SOAK_r*.json shape
+    for r in rows:
+        assert set(r) >= {"scenario", "metric", "value", "unit",
+                          "detail", "passed"}
+
+
+def test_soak_status_admin_route(tmp_path):
+    """The admin plane surfaces a live soak run (and null when idle)."""
+    from minio_tpu.admin.client import AdminClient
+    from minio_tpu.s3.server import S3Server
+    disks = _disks(tmp_path)
+    layer = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                           backend="numpy")
+    srv = S3Server(layer, access_key="soakadm", secret_key="soakadmpw")
+    srv.start()
+    try:
+        adm = AdminClient(srv.endpoint, "soakadm", "soakadmpw")
+        assert adm.soak_status() is None
+        status = soak_report.SoakStatus("unit-scenario")
+        srv.soak = status
+        doc = adm.soak_status()
+        assert doc["scenario"] == "unit-scenario"
+        assert doc["state"] == "running"
+        status.finish([{"passed": True}, {"passed": False}])
+        doc = adm.soak_status()
+        assert doc["state"] == "done"
+        assert doc["assertions"] == 2 and doc["failed"] == 1
+        # heal-status carries the new drop counter field
+        hs = adm.heal_status()
+        assert hs == {"sweep": None, "mrf": None}
+    finally:
+        srv.stop()
+
+
+# -- the slow-marked full matrix (bench.py soak leg) -----------------------
+
+@pytest.mark.slow
+def test_full_matrix_all_mixes_pass_slo(tmp_path):
+    """Acceptance: >= 5 distinct workload mixes each under the full
+    concurrent chaos timeline (drive death mid-churn, slow drive, peer
+    partition, 503 burst, drive return) on a 3-node cluster — every
+    scenario passes its SLO assertions, and the matrix lands as a
+    BENCH_*-shaped SOAK report."""
+    out = tmp_path / "SOAK_r01.json"
+    report = soak_report.run_matrix(
+        soak_report.default_matrix(duration_s=10.0),
+        out_path=str(out), base_dir=str(tmp_path / "mx"))
+    assert len(report["scenarios"]) >= 5
+    assert report["scenarios"] == list(MIXES)
+    failed = [r for r in report["rows"] if not r["passed"]]
+    assert not failed, failed
+    doc = json.loads(out.read_text())
+    assert doc["report"] == "soak"
+    assert doc["failed"] == 0
+    for r in doc["rows"]:
+        assert set(r) >= {"scenario", "metric", "value", "unit",
+                          "detail"}
+    # the full fault vocabulary ran in every scenario
+    for name in report["scenarios"]:
+        ops = next(r for r in doc["rows"]
+                   if r["scenario"] == name and r["metric"] == "ops_total")
+        actions = [e["action"] for e in ops["detail"]["chaos"]["applied"]]
+        assert actions == ["drive_kill", "drive_return", "drive_slow",
+                           "drive_fast", "partition", "heal_link",
+                           "burst_503", "heal_link"]
+
+
+@pytest.mark.slow
+def test_workload_generator_under_clean_cluster_long(tmp_path):
+    """Longer clean-run soak (no faults): zero errors, all budgets met
+    — the control leg that prices the chaos scenarios' overhead."""
+    sc = soak_report.Scenario(
+        name="control_clean", mix=MIXES["get_heavy_small"],
+        timeline=[], duration_s=10.0)
+    rows = soak_report.run_scenario(sc, str(tmp_path / "ctl"))
+    failed = [r for r in rows if not r["passed"]]
+    assert not failed, failed
+    err = next(r for r in rows if r["metric"] == "error_rate")
+    assert err["value"] == 0
