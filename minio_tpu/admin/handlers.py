@@ -942,6 +942,10 @@ def _config(h, srv, route, q1, payload, send_json) -> bool:
             # retune internode chunked streaming (stream_enable,
             # stream_chunk_bytes) on the live RPC plane
             srv.reload_rpc_config()
+        if parts[1] == "codec":
+            # retune the cross-request codec batcher (combining
+            # window, batch bound, queue depth) on the live data plane
+            srv.reload_codec_config()
         if parts[1] in ("logger_webhook", "audit_webhook") \
                 or parts[1].startswith("notify_"):
             # rebuild the egress targets live: repointed endpoints and

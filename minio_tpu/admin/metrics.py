@@ -186,6 +186,10 @@ def render(layer=None, healer=None, config=None, api_stats=None,
             lines += _put_pipeline_gauges(layer)
         except Exception:  # noqa: BLE001
             pass
+    try:
+        lines += _codec_batch_gauges()
+    except Exception:  # noqa: BLE001
+        pass
     if api_stats is not None:
         try:
             lines += _s3_lastminute_gauges(api_stats)
@@ -583,6 +587,24 @@ def _put_pipeline_gauges(layer) -> list[str]:
             batches = max(1, ps.get("batches", 1))
             lines.append(f"mt_put_pipeline_batch_wall_seconds{lbl}"
                          f" {_fmt_value(ps['wall_s'] / batches)}")
+    return lines
+
+
+def _codec_batch_gauges() -> list[str]:
+    """Live queued-block depth of the cross-request codec batcher
+    (parallel/batcher.py), per op.  Idle contract: a process whose
+    batcher never dispatched (or shed) emits no family at all."""
+    from ..parallel import batcher
+    b = batcher.GLOBAL
+    if not b.started():
+        return []
+    depths = b.queue_depths()
+    lines = ["# TYPE mt_codec_batch_queue_depth gauge"]
+    for op in sorted(set(depths) | {"encode", "decode",
+                                    "reconstruct"}):
+        lbl = _fmt_labels((("op", op),))
+        lines.append(f"mt_codec_batch_queue_depth{lbl}"
+                     f" {depths.get(op, 0)}")
     return lines
 
 

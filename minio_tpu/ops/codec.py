@@ -50,6 +50,26 @@ def _accelerator_present() -> bool:
         return False
 
 
+def _batcher(codec: "Erasure"):
+    """The cross-request combining batcher (parallel/batcher.py) when
+    enabled AND the codec dispatches to a device (tpu/mesh) — batching
+    amortizes per-dispatch launch cost, which the numpy host path does
+    not have: its GIL-releasing native matmuls already run in parallel
+    across caller threads, and funneling them through one combiner
+    would serialize them for nothing.  Lazy import both ways: a bare
+    codec must not pull the parallel package at import time, and the
+    batcher's bucket executors call back into
+    ``Erasure._apply_matrix`` directly (the serial engine), so routing
+    here can never recurse."""
+    if not codec.is_device:
+        return None
+    try:
+        from ..parallel import batcher as _b
+    except Exception:  # pragma: no cover — parallel plane unavailable
+        return None
+    return _b.GLOBAL if _b.CONFIG.on() else None
+
+
 class Erasure:
     """Erasure coding details for one (k, m, blockSize) geometry."""
 
@@ -128,10 +148,17 @@ class Erasure:
 
     def apply_matrix(self, rows: np.ndarray, shards) -> np.ndarray:
         """rows (GF) @ shards through this codec's engine; accepts
-        (k, n) or batched (B, k, n) on device backends."""
+        (k, n) or batched (B, k, n) on device backends.  When the
+        cross-request batcher is enabled the dispatch rides its
+        combining queue (GET reconstruction and heal stripes from
+        concurrent requests coalesce); the observed wall time then
+        includes the combining window."""
         t0 = time.monotonic_ns()
         err = ""
         try:
+            b = _batcher(self)
+            if b is not None:
+                return b.apply(self, "reconstruct", rows, shards)
             return self._apply_matrix(rows, shards)
         except Exception as e:
             err = f"{type(e).__name__}: {e}"
@@ -150,6 +177,24 @@ class Erasure:
 
     # -- coding ------------------------------------------------------------
 
+    def _encode_parity_blocks(self, blocks: np.ndarray) -> np.ndarray:
+        """(B, k, n) stripes -> (B, m, n) parity.  Routes through the
+        shared cross-request batcher when enabled (concurrent PUTs'
+        stripe batches coalesce into one padded device dispatch),
+        otherwise the backend impl directly — bit-identical either way
+        (stripes are batch-axis independent)."""
+        b = _batcher(self)
+        if b is not None:
+            return b.apply(
+                self, "encode",
+                np.asarray(self.matrix)[self.data_blocks:], blocks)
+        if self.is_device:
+            return self._impl.encode_parity(
+                blocks, self.parity_blocks, self.matrix)
+        return np.stack([
+            self._impl.encode_parity(blk, self.parity_blocks,
+                                     self.matrix) for blk in blocks])
+
     def encode_data(self, data) -> list[np.ndarray]:
         """EncodeData (cmd/erasure-coding.go:70): split+encode one block.
 
@@ -161,8 +206,7 @@ class Erasure:
             return [np.zeros(0, dtype=np.uint8)
                     for _ in range(self.data_blocks + self.parity_blocks)]
         data_shards = gf8.split(buf, self.data_blocks)
-        par = self._impl.encode_parity(
-            data_shards, self.parity_blocks, self.matrix)
+        par = self._encode_parity_blocks(data_shards[None])[0]
         return [data_shards[i] for i in range(self.data_blocks)] + \
                [par[i] for i in range(self.parity_blocks)]
 
@@ -173,6 +217,25 @@ class Erasure:
         t0 = time.monotonic_ns()
         err = ""
         try:
+            b = _batcher(self)
+            if b is not None:
+                # shared survivor/solve logic (host) with the heavy
+                # matmul routed through the combining queue: concurrent
+                # decodes with the same missing pattern fuse into one
+                # dispatch.  rs_kernels.reconstruct with a numpy apply
+                # is bit-identical to gf8_ref.reconstruct (GF matrix
+                # algebra is exact, so composed decode rows produce the
+                # same bytes as decode-then-reencode).
+                try:
+                    from . import rs_kernels
+                except ImportError:
+                    b = None
+                if b is not None:
+                    return rs_kernels.reconstruct(
+                        shards, self.data_blocks, self.parity_blocks,
+                        data_only=data_only, matrix=self.matrix,
+                        apply=lambda rows, surv: b.apply(
+                            self, "decode", rows, surv))
             return self._impl.reconstruct(
                 shards, self.data_blocks, self.parity_blocks,
                 data_only=data_only, matrix=self.matrix)
@@ -263,11 +326,7 @@ class Erasure:
                 blocks = np.zeros((nfull, k, ssize), dtype=np.uint8)
                 flat = buf[: nfull * bs].reshape(nfull, bs)
                 blocks.reshape(nfull, k * ssize)[:, :bs] = flat
-            if self.is_device:
-                par = self._impl.encode_parity(blocks, m, self.matrix)
-            else:
-                par = np.stack([self._impl.encode_parity(b, m, self.matrix)
-                                for b in blocks])
+            par = self._encode_parity_blocks(blocks)
             for i in range(k):
                 outs[i].append(np.ascontiguousarray(blocks[:, i]).reshape(-1))
             for j in range(m):

@@ -333,6 +333,33 @@ def encode_with_bitrot(data_blocks: int, parity_blocks: int,
     return parity, digests
 
 
+def _encode_with_bitrot_batched(data_blocks: int, parity_blocks: int,
+                                block_size: int,
+                                blocks: np.ndarray):
+    """encode_with_bitrot through the cross-request batcher when it is
+    enabled: concurrent PUT streams' fused encode+digest dispatches
+    coalesce into one padded shard_map dispatch over the shared mesh
+    (the production mesh PUT path's ride onto parallel/batcher.py).
+    The executor is per-stripe independent along the batch axis —
+    parity rows and per-shard digests each depend only on their own
+    stripe — so concatenation is bit-identical to dispatching apart."""
+    try:
+        from minio_tpu.parallel import batcher as _bt
+        enabled = _bt.CONFIG.on()
+    except Exception:  # pragma: no cover — parallel plane unavailable
+        enabled = False
+    if not enabled:
+        return encode_with_bitrot(data_blocks, parity_blocks, blocks)
+    codec = _bt.codec_for(data_blocks, parity_blocks, block_size,
+                          "mesh")
+    rows = np.asarray(gf8.rs_matrix(
+        data_blocks, data_blocks + parity_blocks))[data_blocks:]
+    return _bt.GLOBAL.submit(
+        codec, "encode-bitrot", rows, blocks,
+        fn=lambda _rows, cat: encode_with_bitrot(
+            data_blocks, parity_blocks, cat))
+
+
 def encode_object_framed_fused(data_blocks: int, parity_blocks: int,
                                block_size: int, data,
                                digest: int = 32) -> np.ndarray:
@@ -361,7 +388,8 @@ def encode_object_framed_fused(data_blocks: int, parity_blocks: int,
         blocks = np.zeros((nfull, k, ssize), dtype=np.uint8)
         blocks.reshape(nfull, k * ssize)[:, :bs] = \
             buf[:nfull * bs].reshape(nfull, bs)
-        parity, digs = encode_with_bitrot(k, m_par, blocks)
+        parity, digs = _encode_with_bitrot_batched(k, m_par, block_size,
+                                                   blocks)
         fview = out[:, :nfull * F].reshape(k + m_par, nfull, F)
         fview[:k, :, digest:] = blocks.transpose(1, 0, 2)
         fview[k:, :, digest:] = parity.transpose(1, 0, 2)
@@ -369,7 +397,8 @@ def encode_object_framed_fused(data_blocks: int, parity_blocks: int,
     if tail_len:
         tblock = np.zeros((1, k, tail_ss), dtype=np.uint8)
         tblock.reshape(1, k * tail_ss)[0, :tail_len] = buf[nfull * bs:]
-        parity_t, digs_t = encode_with_bitrot(k, m_par, tblock)
+        parity_t, digs_t = _encode_with_bitrot_batched(
+            k, m_par, block_size, tblock)
         base = nfull * F
         out[:k, base + digest:] = tblock[0]
         out[k:, base + digest:] = parity_t[0]

@@ -1,0 +1,530 @@
+"""Cross-request batching codec service — coalesce concurrent
+encode/decode/reconstruct calls into one padded device dispatch.
+
+The kernel north star is met (~52 GiB/s encode) but every PUT/GET used
+to dispatch its OWN encode/decode, so under small-object traffic the
+device ran at a few percent of roofline: batch depth across requests
+was free and nothing claimed it.  This module is the continuous-
+batching layer from inference serving applied to the storage data
+plane — the same combining shape as the MD5 ``LaneScheduler``
+(hashing/md5fast.py), one level up:
+
+  * concurrent callers (the PUT writer plane, GET reconstruction,
+    heal, and the sidecar's ``/raw/codec-*`` handlers) submit
+    ``(rows, (B, k, n) stripes)`` work items;
+  * items are **bucketed** by geometry + operation — the full key is
+    ``(op, backend, k, m, block_size, n, rows-bytes)`` so everything
+    in one bucket is the same matmul over the same coefficient rows
+    (stripes are row-independent, so concatenating along the batch
+    axis is bit-identical to dispatching them apart);
+  * the first caller into an idle bucket becomes the **combiner**: it
+    waits up to ``codec.batch_window_us`` for followers (early-out at
+    ``codec.max_batch_blocks``), concatenates the batch, runs ONE
+    device dispatch through the bucket's shared codec, slices results
+    back per waiter, and repeats until the queue drains — followers
+    park on an event, their thread yielding to encode/writer work;
+  * a window that finds **one** caller takes the strict single-
+    dispatch fallback: the caller's own stripes through the exact
+    serial engine (``Erasure._apply_matrix``) — the serial path stays
+    the reference semantics, like ``pipeline.depth=0``;
+  * queues are **bounded** (``codec.queue_depth`` blocks per bucket):
+    an arrival past the bound sheds to the serial path immediately
+    (counted, latency stays bounded, the queue cannot grow without
+    limit), and a caller that dies mid-queue cancels its waiter so the
+    combiner never computes or delivers into freed state.
+
+The batcher owns no threads: combiners are borrowed caller threads
+(the ``LaneScheduler`` discipline), so there is nothing to leak on
+shutdown — tests pin the ``mt-codec-*`` naming rule for their own
+worker threads instead.
+
+On a mesh-backend codec the one fused dispatch rides the existing
+pjit/shard_map plumbing (parallel/mesh.py + ops/rs_mesh.py), so many
+frontend nodes — local callers and RemoteCodec sidecar clients alike —
+share one device mesh through one combining queue.
+
+Every dispatch lands in the ``mt_codec_batch_*`` metric families and,
+when tracing is active, publishes a ``tpu``-type span carrying the
+batch detail (occupancy, blocks, geometry).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ..obs import trace as _trace
+from ..ops.codec import Erasure
+
+# occupancy buckets: requests coalesced per dispatch (1 = the serial
+# fallback fired; weight above 1 is the cross-request win)
+OCCUPANCY_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
+
+# fused dispatches in flight per bucket: 2 = one executing on the
+# device while the next batch forms and launches (continuous-batching
+# pipelining).  Without the cap, every arrival during a dispatch
+# elects itself a fresh combiner and occupancy collapses to ~1 — the
+# serial dispatch pattern with extra steps; with it, load above the
+# pipeline depth accumulates into the next batch instead.
+_MAX_INFLIGHT = 2
+
+
+class CodecConfig:
+    """Live-reloadable knobs (``codec`` kvconfig subsystem).  Reads
+    env/defaults lazily on first use; the server pushes admin
+    SetConfigKV values via S3Server.reload_codec_config (a fresh
+    kvconfig.Config cannot see another instance's dynamic layer)."""
+
+    def __init__(self):
+        self.enable = True
+        self.window_s = 200e-6          # batch_window_us
+        self.max_blocks = 256           # max_batch_blocks per dispatch
+        self.queue_depth = 1024         # queued blocks per bucket
+        self._loaded = False
+
+    def load(self, cfg=None) -> None:
+        try:
+            if cfg is None:
+                from ..utils.kvconfig import Config
+                cfg = Config()
+            # parse ALL knobs first, assign atomically: a bad value in
+            # one key must not leave a silently half-applied config
+            enable = str(cfg.get("codec", "enable")
+                         ).strip().lower() not in ("off", "0",
+                                                   "false", "")
+            window_s = max(
+                0.0, int(cfg.get("codec", "batch_window_us")) / 1e6)
+            max_blocks = max(
+                1, int(cfg.get("codec", "max_batch_blocks")))
+            queue_depth = max(
+                max_blocks, int(cfg.get("codec", "queue_depth")))
+            self.enable = enable
+            self.window_s = window_s
+            self.max_blocks = max_blocks
+            self.queue_depth = queue_depth
+        except (KeyError, ValueError):
+            pass
+        self._loaded = True
+
+    def on(self) -> bool:
+        if not self._loaded:
+            self.load()
+        return self.enable
+
+
+CONFIG = CodecConfig()
+
+
+# -- shared per-geometry codec registry -------------------------------------
+#
+# One Erasure instance per (k, m, blockSize, backend) for the whole
+# process: the sidecar handlers, the batcher's bucket executors, and
+# any direct caller resolve here, so a geometry maps to ONE codec (and
+# one compiled-kernel cache line) instead of one per call site.  The
+# old per-module lru_cache in codec_service gave the sidecar its own
+# unbounded-lifetime copies.
+
+_CODEC_MU = threading.Lock()
+_CODECS: dict[tuple, Erasure] = {}
+_CODEC_CAP = 64
+
+
+def codec_for(data_blocks: int, parity_blocks: int, block_size: int,
+              backend: str = "auto") -> Erasure:
+    """The process-shared codec for one geometry (bounded registry: a
+    pathological parade of one-off geometries evicts oldest)."""
+    if backend == "auto":
+        # normalize BEFORE keying: 'auto' resolves inside Erasure, and
+        # keying on the unresolved name would cache a second instance
+        # (and a second compiled-kernel cache line) per geometry
+        from ..ops.codec import _accelerator_present
+        backend = "tpu" if _accelerator_present() else "numpy"
+    key = (int(data_blocks), int(parity_blocks), int(block_size),
+           backend)
+    with _CODEC_MU:
+        c = _CODECS.get(key)
+        if c is None:
+            c = Erasure(data_blocks, parity_blocks, block_size,
+                        backend=backend)
+            if len(_CODECS) >= _CODEC_CAP:
+                _CODECS.pop(next(iter(_CODECS)))
+            _CODECS[key] = c
+        return c
+
+
+class _Waiter:
+    """One caller's work item parked in a bucket queue."""
+
+    __slots__ = ("shards", "blocks", "event", "result", "exc", "done",
+                 "cancelled", "enq")
+
+    def __init__(self, shards: np.ndarray):
+        self.shards = shards
+        self.blocks = shards.shape[0]
+        self.event = threading.Event()
+        self.result = None
+        self.exc: BaseException | None = None
+        self.done = False
+        self.cancelled = False
+        self.enq = time.monotonic()
+
+
+class _Bucket:
+    """One geometry/op combining queue.  ``codec`` is the shared
+    executor instance; ``cond`` shares the batcher lock so enqueues
+    can wake a window-waiting combiner."""
+
+    __slots__ = ("rows", "codec", "q", "blocks", "combining", "cond",
+                 "op", "inflight", "fn")
+
+    def __init__(self, rows: np.ndarray, codec: Erasure, lock, op: str,
+                 fn):
+        self.rows = rows
+        self.codec = codec
+        self.q: deque[_Waiter] = deque()
+        self.blocks = 0
+        self.combining = False
+        self.cond = threading.Condition(lock)
+        self.op = op
+        self.inflight = 0
+        self.fn = fn
+
+
+class CodecBatcher:
+    """The process-wide combining queue set (``GLOBAL`` below)."""
+
+    def __init__(self, config: CodecConfig | None = None):
+        self._mu = threading.Lock()
+        self._buckets: dict[tuple, _Bucket] = {}
+        self.config = config or CONFIG
+        # lifetime totals (bench deltas + the scrape-gauge idle gate)
+        self.dispatches = 0
+        self.requests = 0
+        self.blocks = 0
+        self.shed = 0
+        self.cancelled = 0
+
+    # -- introspection ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {"dispatches": self.dispatches,
+                    "requests": self.requests,
+                    "blocks": self.blocks,
+                    "shed": self.shed,
+                    "cancelled": self.cancelled}
+
+    def started(self) -> bool:
+        return self.dispatches > 0 or self.shed > 0
+
+    def queue_depths(self) -> dict[str, int]:
+        """Queued blocks per op, summed over buckets (the
+        ``mt_codec_batch_queue_depth`` scrape gauge)."""
+        out: dict[str, int] = {}
+        with self._mu:
+            for b in self._buckets.values():
+                out[b.op] = out.get(b.op, 0) + b.blocks
+        return out
+
+    # -- submission ---------------------------------------------------------
+
+    def apply(self, codec: Erasure, op: str, rows: np.ndarray, shards,
+              timeout: float | None = None) -> np.ndarray:
+        """rows (GF) @ shards through the combining queue; bit-identical
+        to ``codec._apply_matrix(rows, shards)`` in every path.  Accepts
+        (k, n) or (B, k, n); ``timeout`` bounds the parked wait — on
+        expiry the waiter cancels out of the queue and the caller's own
+        stripes run the serial path (the caller-death escape hatch)."""
+        shards = np.asarray(shards, dtype=np.uint8)
+        squeeze = shards.ndim == 2
+        if squeeze:
+            shards = shards[None]
+        out = self.submit(codec, op, rows, shards, timeout=timeout)
+        return out[0] if squeeze else out
+
+    def submit(self, codec: Erasure, op: str, rows: np.ndarray, shards,
+               fn=None, timeout: float | None = None):
+        """General combining submission: ``fn(rows, (B, k, n))`` must
+        be per-stripe independent along the batch axis and return an
+        array — or a TUPLE of arrays (the fused encode+bitrot path
+        returns (parity, digests)) — each sliced back per waiter.
+        Default fn is the bucket codec's serial engine
+        (``Erasure._apply_matrix``).  Callers in one bucket share the
+        FIRST caller's fn; the bucket key (op + backend + geometry +
+        width + rows bytes) pins the dispatch identity, so equivalent
+        keys imply equivalent fns."""
+        shards = np.asarray(shards, dtype=np.uint8)
+        rows = np.ascontiguousarray(rows, dtype=np.uint8)
+        cfg = self.config
+        if shards.shape[0] >= cfg.max_blocks:
+            # already a full dispatch on its own: combining could only
+            # add latency.  Runs the same engine, counted as occupancy 1
+            return self._direct(codec, op, rows, shards, fn)
+        key = (op, codec.backend, codec.data_blocks,
+               codec.parity_blocks, codec.block_size, shards.shape[2],
+               rows.tobytes())
+        # resolve the shared executor codec outside the batcher lock
+        exec_codec = codec_for(codec.data_blocks, codec.parity_blocks,
+                               codec.block_size, codec.backend)
+        w = _Waiter(shards)
+        shed = False
+        lead = False
+        with self._mu:
+            bkt = self._buckets.get(key)
+            if bkt is None:
+                bkt = _Bucket(rows, exec_codec, self._mu, op,
+                              fn or exec_codec._apply_matrix)
+                self._buckets[key] = bkt
+            if bkt.blocks + w.blocks > cfg.queue_depth:
+                # per-bucket backpressure: the queue never grows past
+                # the bound — overflow sheds to the serial path, which
+                # is semantically identical and keeps latency bounded
+                self.shed += 1
+                shed = True
+            else:
+                bkt.q.append(w)
+                bkt.blocks += w.blocks
+                lead = not bkt.combining
+                if lead:
+                    bkt.combining = True
+                else:
+                    bkt.cond.notify_all()   # feed a waiting window
+        if shed:
+            from ..admin.metrics import GLOBAL as _mtr
+            _mtr.inc("mt_codec_batch_shed_total", {"op": op})
+            return self._direct(codec, op, rows, shards, fn)
+        if lead:
+            self._combine(key, bkt, own=w)
+            # our own waiter is normally in our first batch, but a
+            # backlog ahead of it plus a role handoff can leave it to
+            # ANOTHER combiner — park for the result, never read early
+            served = w.done or self._park(w, key, bkt, timeout)
+        else:
+            served = self._park(w, key, bkt, timeout)
+        if not served:
+            # cancelled out of the queue: serial fallback
+            return self._direct(codec, op, rows, shards, fn)
+        if w.exc is not None:
+            raise w.exc
+        return w.result
+
+    # -- the combiner role --------------------------------------------------
+
+    def _park(self, w: _Waiter, key: tuple, bkt: _Bucket,
+              timeout: float | None) -> bool:
+        """Wait for the combiner to serve ``w``.  Self-healing: if the
+        combiner died (its dispatch raised and unwound) with our item
+        still queued, claim the role.  Returns False when the wait
+        timed out and the waiter cancelled out of the queue."""
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        while not w.event.wait(0.05):
+            lead = False
+            with self._mu:
+                if w.done:
+                    return True
+                in_q = w in bkt.q
+                if not in_q:
+                    # a combiner holds us: the result is coming
+                    continue
+                if deadline is not None and \
+                        time.monotonic() >= deadline:
+                    bkt.q.remove(w)
+                    bkt.blocks -= w.blocks
+                    w.cancelled = True
+                    self.cancelled += 1
+                    break
+                if not bkt.combining:
+                    bkt.combining = True
+                    lead = True
+            if lead:
+                self._combine(key, bkt, own=w)
+                if w.done:
+                    return True
+        if w.cancelled:
+            from ..admin.metrics import GLOBAL as _mtr
+            _mtr.inc("mt_codec_batch_cancelled_total", {"op": bkt.op})
+            return False
+        return True
+
+    def _combine(self, key: tuple, bkt: _Bucket,
+                 own: _Waiter | None = None) -> None:
+        """One combining round as the bucket's combiner: window-wait,
+        pop a batch, then RELEASE the role before dispatching — a new
+        arrival elects a fresh combiner and forms the next batch while
+        this one is on the device, so batches pipeline instead of the
+        queue serializing behind compute (continuous batching, not
+        stop-and-wait).  After the dispatch, re-claim the role only
+        while ``own`` (this caller's waiter) is still unserved: once
+        our request is done we hand the queue to the next arrival (or
+        a parked waiter's self-heal claim) instead of combining other
+        requests' batches forever — under sustained load a caller's
+        own latency must stay bounded by its batch, not the storm."""
+        cfg = self.config
+        holding = True                       # we own bkt.combining
+        try:
+            while True:
+                with self._mu:
+                    if cfg.window_s > 0 and bkt.blocks < cfg.max_blocks:
+                        deadline = time.monotonic() + cfg.window_s
+                        while bkt.blocks < cfg.max_blocks:
+                            left = deadline - time.monotonic()
+                            if left <= 0:
+                                break
+                            bkt.cond.wait(left)
+                    # pipeline-depth gate: with _MAX_INFLIGHT batches
+                    # already dispatching, keep combining — arrivals
+                    # accumulate into THIS batch instead of racing the
+                    # device with another under-full dispatch
+                    while bkt.inflight >= _MAX_INFLIGHT and \
+                            bkt.blocks < cfg.max_blocks:
+                        bkt.cond.wait(0.05)
+                    batch: list[_Waiter] = []
+                    nblocks = 0
+                    while bkt.q:
+                        cand = bkt.q[0]
+                        if batch and \
+                                nblocks + cand.blocks > cfg.max_blocks:
+                            break
+                        bkt.q.popleft()
+                        bkt.blocks -= cand.blocks
+                        if cand.cancelled:      # belt and braces: a
+                            cand.event.set()    # cancel removes itself
+                            continue
+                        batch.append(cand)
+                        nblocks += cand.blocks
+                    bkt.combining = False
+                    holding = False
+                    if not batch:
+                        if not bkt.q and not bkt.inflight:
+                            self._buckets.pop(key, None)
+                        else:
+                            bkt.cond.notify_all()
+                        return
+                    bkt.inflight += 1
+                    bkt.cond.notify_all()
+                try:
+                    self._dispatch(bkt, batch, nblocks)
+                finally:
+                    with self._mu:
+                        bkt.inflight -= 1
+                        bkt.cond.notify_all()
+                with self._mu:
+                    if bkt.q and not bkt.combining and \
+                            own is not None and not own.done:
+                        bkt.combining = True
+                        holding = True
+                        continue
+                    if bkt.q and not bkt.combining:
+                        # backlog, but our own request is served: wake
+                        # a parked waiter to self-heal-claim the role
+                        bkt.cond.notify_all()
+                    if not bkt.q and not bkt.combining and \
+                            not bkt.inflight:
+                        self._buckets.pop(key, None)
+                    return
+        except BaseException:
+            # never strand parked waiters behind a dead combiner: the
+            # _park self-heal loop re-elects, but only once the role is
+            # released
+            if holding:
+                with self._mu:
+                    bkt.combining = False
+                    bkt.cond.notify_all()
+            raise
+
+    # -- execution ----------------------------------------------------------
+
+    @staticmethod
+    def _slice(out, off: int, n: int):
+        """Per-waiter view of a batch result (array or tuple of
+        batch-axis arrays, e.g. the fused path's (parity, digests))."""
+        if isinstance(out, tuple):
+            return tuple(o[off:off + n] for o in out)
+        return out[off:off + n]
+
+    def _direct(self, codec: Erasure, op: str, rows: np.ndarray,
+                shards: np.ndarray, fn=None):
+        """One caller, one dispatch — the strict serial fallback (and
+        the shed/cancel path).  Counted with occupancy 1 so the scrape
+        shows how much traffic is NOT coalescing."""
+        t0 = time.monotonic()
+        out = (fn or codec._apply_matrix)(rows, shards)
+        self._account(codec, op, nwaiters=1, nblocks=shards.shape[0],
+                      t0=t0, waits=(0.0,), err="")
+        return out
+
+    def _dispatch(self, bkt: _Bucket, batch: list[_Waiter],
+                  nblocks: int) -> None:
+        """One fused device dispatch for the whole batch; results are
+        views sliced back per waiter (padding — lane tiles, pow2 batch,
+        mesh axes — is the engine's own and stripped there)."""
+        t0 = time.monotonic()
+        err = ""
+        try:
+            if len(batch) == 1:
+                # the window found one caller: strict single-dispatch
+                # fallback, the serial reference semantics verbatim
+                batch[0].result = bkt.fn(bkt.rows, batch[0].shards)
+            else:
+                cat = np.concatenate([w.shards for w in batch], axis=0)
+                out = bkt.fn(bkt.rows, cat)
+                off = 0
+                for w in batch:
+                    w.result = self._slice(out, off, w.blocks)
+                    off += w.blocks
+        except BaseException as e:
+            err = f"{type(e).__name__}: {e}"
+            for w in batch:
+                w.exc = e
+            if not isinstance(e, Exception):
+                # KeyboardInterrupt/SystemExit must keep propagating in
+                # the thread it hit (the waiters above still fail fast
+                # instead of hanging); _combine releases the role on
+                # the way out
+                raise
+        finally:
+            for w in batch:
+                w.done = True
+                w.event.set()
+            self._account(bkt.codec, bkt.op, nwaiters=len(batch),
+                          nblocks=nblocks, t0=t0,
+                          waits=tuple(t0 - w.enq for w in batch),
+                          err=err)
+
+    def _account(self, codec: Erasure, op: str, *, nwaiters: int,
+                 nblocks: int, t0: float, waits: tuple,
+                 err: str) -> None:
+        from ..admin.metrics import BATCH_BUCKETS, KERNEL_BUCKETS
+        from ..admin.metrics import GLOBAL as _mtr
+        with self._mu:
+            self.dispatches += 1
+            self.requests += nwaiters
+            self.blocks += nblocks
+        labels = {"op": op}
+        _mtr.inc("mt_codec_batch_dispatches_total", labels)
+        _mtr.observe("mt_codec_batch_blocks", labels, float(nblocks),
+                     buckets=BATCH_BUCKETS)
+        _mtr.observe("mt_codec_batch_occupancy", labels,
+                     float(nwaiters), buckets=OCCUPANCY_BUCKETS)
+        for wt in waits:
+            _mtr.observe("mt_codec_batch_wait_seconds", labels,
+                         max(0.0, wt), buckets=KERNEL_BUCKETS)
+        if _trace.active():
+            dt = int((time.monotonic() - t0) * 1e9)
+            _trace.publish_span(_trace.make_span(
+                "tpu", f"tpu.batch-{op}",
+                start_ns=_trace.now_ns() - dt, duration_ns=dt,
+                error=err,
+                detail={"op": op, "backend": codec.backend,
+                        "k": codec.data_blocks,
+                        "m": codec.parity_blocks,
+                        "blockSize": codec.block_size,
+                        "blocks": nblocks, "occupancy": nwaiters,
+                        "batched": nwaiters > 1}))
+
+
+GLOBAL = CodecBatcher()

@@ -10,15 +10,23 @@ device kernels (ops/rs_kernels.py) — the same role storage REST plays
 for remote drives (cmd/storage-rest-*), applied to the compute plane.
 
 Wire format (POST /raw/codec-*): params ride the msgpack header, shard
-bytes ride the HTTP body RAW (one copy per side, same discipline as the
-shard-transfer endpoints).  Responses are length-framed concatenated
-shard files.  Bit-identicality is inherited: the sidecar runs the same
+bytes ride the HTTP body RAW.  Both directions are iovec-backed: the
+client sends [header || shard views] as an ``rpc.Iovecs`` body and the
+handler replies ``(total, buffer-iterator)`` through the streamed raw
+path — a shard crosses each side straight from its numpy buffer (one
+socket copy), never through a ``tobytes()`` staging copy.  Responses
+are length-framed concatenated shard files.
+
+The handlers resolve their codec through the process-shared geometry
+registry (parallel/batcher.codec_for) and their encode/decode rides the
+cross-request batcher like any local caller — concurrent sidecar
+clients and local PUT/GET traffic coalesce into the same padded device
+dispatches.  Bit-identicality is inherited: the sidecar runs the same
 Erasure codec, so every conformance guarantee transfers.
 """
 
 from __future__ import annotations
 
-import functools
 import struct
 import time
 
@@ -26,20 +34,45 @@ import numpy as np
 
 from ..obs import trace as _trace
 from ..ops.codec import Erasure, ErasureError
+from .rpc import Iovecs
 
 
-@functools.lru_cache(maxsize=64)
 def _codec(k: int, m: int, block_size: int, backend: str) -> Erasure:
-    return Erasure(k, m, block_size, backend=backend)
+    """One shared codec per geometry (the batcher's registry): sidecar
+    handlers and local callers of the same geometry use the SAME
+    Erasure instance, so compiled-kernel caches and batcher buckets are
+    never duplicated per entry point."""
+    from .batcher import codec_for
+    return codec_for(k, m, block_size, backend)
+
+
+def _as_view(s) -> memoryview:
+    """A C-contiguous byte view of one shard, copy-free for the arrays
+    the codec emits (1-D uint8, contiguous)."""
+    a = np.ascontiguousarray(np.asarray(s, dtype=np.uint8))
+    return memoryview(a).cast("B")
+
+
+def _frame_parts(shards: list[np.ndarray]) -> tuple[int, list]:
+    """Iovec form of the shard frame: u32 count || u64 len each ||
+    bodies.  One small header bytes object plus one memoryview per
+    shard — no per-shard ``tobytes()`` copies (shard files are
+    equal-length per geometry, but reconstruct replies carry a
+    subset).  Length headers are computed from the SAME byte views the
+    bodies ship, so a non-uint8 input (value-cast by _as_view) can
+    never produce a header/body length divergence."""
+    views = [_as_view(s) for s in shards]
+    head = [struct.pack("<I", len(views))]
+    head += [struct.pack("<Q", len(v)) for v in views]
+    bufs: list = [b"".join(head)] + views
+    total = len(bufs[0]) + sum(len(v) for v in views)
+    return total, bufs
 
 
 def _frame(shards: list[np.ndarray]) -> bytes:
-    """u32 count || u64 len each || bodies (shard files are equal-length
-    per geometry, but reconstruct replies carry a subset)."""
-    parts = [struct.pack("<I", len(shards))]
-    parts += [struct.pack("<Q", s.nbytes) for s in shards]
-    parts += [s.tobytes() for s in shards]
-    return b"".join(parts)
+    """Materialized frame (kept for callers that need one buffer)."""
+    _, bufs = _frame_parts(shards)
+    return b"".join(bufs)
 
 
 def _unframe(data: bytes) -> list[np.ndarray]:
@@ -56,6 +89,16 @@ def _unframe(data: bytes) -> list[np.ndarray]:
                                  offset=off))
         off += ln
     return out
+
+
+def _body_view(data) -> bytes | memoryview:
+    """Bytes-like request body without a staging copy when the input
+    already exposes a buffer."""
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        return data
+    if isinstance(data, np.ndarray):
+        return _as_view(data)
+    return bytes(data)
 
 
 def register_codec_service(rpc, backend: str = "auto") -> None:
@@ -84,21 +127,26 @@ def register_codec_service(rpc, backend: str = "auto") -> None:
             raise
         finally:
             dt = time.monotonic_ns() - t0
+            # streamed replies are (total, iterator); materialized ones
+            # are bytes
+            out_n = (int(out[0]) if isinstance(out, tuple)
+                     else len(out)) if out else 0
             _trace.publish_span(_trace.make_span(
                 "tpu", func_name, start_ns=_trace.now_ns() - dt,
                 duration_ns=dt,
                 input_bytes=len(body),
-                output_bytes=len(out) if out else 0, error=err,
+                output_bytes=out_n, error=err,
                 detail=detail))
 
-    def encode(params: dict, body: bytes) -> bytes:
+    def encode(params: dict, body: bytes):
         def run():
             c = _codec(int(params["k"]), int(params["m"]),
                        int(params["block_size"]), backend)
-            return _frame(c.encode_object(body))
+            total, bufs = _frame_parts(c.encode_object(body))
+            return total, iter(bufs)
         return _spanned("codec-encode", params, body, run)
 
-    def reconstruct(params: dict, body: bytes) -> bytes:
+    def reconstruct(params: dict, body: bytes):
         def run():
             c = _codec(int(params["k"]), int(params["m"]),
                        int(params["block_size"]), backend)
@@ -112,7 +160,8 @@ def register_codec_service(rpc, backend: str = "auto") -> None:
             for idx, s in zip(present, got):
                 shards[idx] = s
             full = c.decode_data_and_parity_blocks(shards)
-            return _frame([full[i] for i in want])
+            total, bufs = _frame_parts([full[i] for i in want])
+            return total, iter(bufs)
         return _spanned("codec-reconstruct", params, body, run)
 
     rpc.register_raw("codec-encode", encode)
@@ -154,11 +203,10 @@ class RemoteCodec:
                 "block_size": self.block_size}
 
     def encode_object(self, data) -> list[np.ndarray]:
-        body = bytes(data) if not isinstance(data, (bytes, bytearray)) \
-            else data
+        body = _body_view(data)
         try:
             out = self._c.raw_call("codec-encode", self._params(),
-                                   body=bytes(body), idempotent=True)
+                                   body=body, idempotent=True)
         except Exception:  # noqa: BLE001 — sidecar down: local fallback
             return self._local.encode_object(body)
         return _unframe(out)
@@ -169,12 +217,13 @@ class RemoteCodec:
         want = [i for i in range(len(shards)) if i not in present]
         if not want:
             return [np.asarray(s, dtype=np.uint8) for s in shards]
+        _, bufs = _frame_parts([np.asarray(shards[i], dtype=np.uint8)
+                                for i in present])
         try:
             out = self._c.raw_call(
                 "codec-reconstruct",
                 {**self._params(), "present": present, "want": want},
-                body=_frame([np.asarray(shards[i], dtype=np.uint8)
-                             for i in present]),
+                body=Iovecs(bufs),
                 idempotent=True)
         except Exception:  # noqa: BLE001
             return self._local.decode_data_and_parity_blocks(shards)

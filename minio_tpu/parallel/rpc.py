@@ -99,6 +99,34 @@ class StreamConfig:
 STREAM = StreamConfig()
 
 
+class Iovecs:
+    """Zero-copy multi-buffer request body for RPCClient.raw_call.
+
+    ``len()`` is the TOTAL byte count (the RPC byte accounting reads
+    it, and raw_call stamps it into an explicit Content-Length header —
+    http.client's own length sniffing only understands buffers and
+    files, and would otherwise fall back to chunked encoding the raw
+    server never dechunks); iteration yields the buffers, which
+    http.client sends one ``sendall`` each without joining.
+    Re-iterable, so stale-connection replays and breaker retries
+    resend the same bytes.  This is the sidecar framing discipline: a
+    shard crosses the wire straight from its numpy buffer, one copy
+    per side (the kernel's socket copy), not two."""
+
+    __slots__ = ("bufs", "total")
+
+    def __init__(self, bufs):
+        self.bufs = [b if isinstance(b, (bytes, bytearray))
+                     else memoryview(b).cast("B") for b in bufs]
+        self.total = sum(len(b) for b in self.bufs)
+
+    def __len__(self) -> int:
+        return self.total
+
+    def __iter__(self):
+        return iter(self.bufs)
+
+
 class StreamBody:
     """A framed streaming request body for RPCClient.raw_call.
 
@@ -1110,7 +1138,13 @@ class RPCClient:
         (length-prefixed chunks the peer applies as they land)."""
         path = f"/raw/{name}"
         hdr = msgpack.packb(params, use_bin_type=True).hex()
-        kw = dict(extra_headers={"X-RPC-Params": hdr},
+        headers = {"X-RPC-Params": hdr}
+        if isinstance(body, Iovecs):
+            # explicit length: http.client cannot sniff a multi-buffer
+            # body (no buffer protocol) and would send it chunked —
+            # which the raw server reads as a ZERO-length body
+            headers["Content-Length"] = str(len(body))
+        kw = dict(extra_headers=headers,
                   raw_response=True, idempotent=idempotent)
         if not _trace.active():
             return self._roundtrip(path, body, "storage", **kw)

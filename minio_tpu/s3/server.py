@@ -382,6 +382,8 @@ class S3Server:
         self.reload_pipeline_config()
         # push ``rpc`` streaming knobs into the shared internode plane
         self.reload_rpc_config()
+        # push ``codec`` batching knobs into the shared batcher
+        self.reload_codec_config()
 
     def reload_api_config(self) -> None:
         """(Re)derive the request-plane knobs from the ``api`` kvconfig
@@ -453,6 +455,19 @@ class S3Server:
         from ..parallel import rpc as _rpc
         try:
             _rpc.STREAM.load(self.config)
+        except Exception:  # noqa: BLE001 — bad knob must not kill boot
+            pass
+
+    def reload_codec_config(self) -> None:
+        """Push the ``codec`` batching knobs (enable, batch_window_us,
+        max_batch_blocks, queue_depth) into the process-wide
+        cross-request codec batcher — at boot and after admin
+        SetConfigKV, so the combining window retunes on a live server
+        (a fresh kvconfig.Config cannot see this server's dynamic
+        layer)."""
+        from ..parallel import batcher as _batcher
+        try:
+            _batcher.CONFIG.load(self.config)
         except Exception:  # noqa: BLE001 — bad knob must not kill boot
             pass
 

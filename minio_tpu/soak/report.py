@@ -62,6 +62,11 @@ class Scenario:
     workers: int = 2
     nodes: int = 3
     drives_per_node: int = 2
+    # codec backend for the cluster's erasure layers: the small-object
+    # storm runs "tpu" so its encode/decode dispatches ride the
+    # cross-request batcher (the numpy layer's native one-copy framed
+    # path never leaves the host)
+    backend: str = "numpy"
 
 
 # chaos knobs every scenario runs under: snappy breakers so fault
@@ -98,12 +103,25 @@ def default_matrix(duration_s: float = 15.0) -> list[Scenario]:
     concurrent chaos timeline.  The error budget is 10%: two of the
     timeline's windows hold the set at EXACTLY write quorum, where the
     first write per faulted drive-client must fail before its breaker
-    opens — bounded, expected shedding, not an SLO miss."""
-    budget = _slo.Budget(max_error_rate=0.10)
-    return [Scenario(name=mix.name, mix=mix,
-                     timeline=_chaos_timeline(duration_s),
-                     duration_s=duration_s, budget=budget)
-            for mix in MIXES.values()]
+    opens — bounded, expected shedding, not an SLO miss.
+
+    The small-object storm runs with doubled workers (it exists to
+    overlap tiny encode/decode dispatches) and additionally asserts a
+    non-zero ``mt_codec_batch_occupancy`` from the live scrape — the
+    batching codec service must actually engage under its target
+    load."""
+    out = []
+    for mix in MIXES.values():
+        storm = mix.name == "small_object_storm"
+        out.append(Scenario(
+            name=mix.name, mix=mix,
+            timeline=_chaos_timeline(duration_s),
+            duration_s=duration_s,
+            budget=_slo.Budget(max_error_rate=0.10,
+                               require_codec_occupancy=storm),
+            workers=4 if storm else 2,
+            backend="tpu" if storm else "numpy"))
+    return out
 
 
 def smoke_scenario(duration_s: float = 4.0) -> Scenario:
@@ -131,7 +149,8 @@ def run_scenario(scenario: Scenario, base_dir: str,
     try:
         cluster = _chaos.SoakCluster(
             base_dir, nodes=scenario.nodes,
-            drives_per_node=scenario.drives_per_node)
+            drives_per_node=scenario.drives_per_node,
+            backend=scenario.backend)
         status = SoakStatus(scenario.name)
         cluster.s3.soak = status
         conv: dict | None = None

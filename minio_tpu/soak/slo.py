@@ -58,6 +58,10 @@ class Budget:
     per_api_ms: dict = field(default_factory=dict)   # api -> (p50, p99)
     converge_timeout_s: float = 45.0
     thread_slack: int = 3
+    # scenarios whose traffic must exercise the cross-request codec
+    # batcher (the small-object storm) assert a non-zero
+    # mt_codec_batch_occupancy on the live scrape
+    require_codec_occupancy: bool = False
 
     def limits_for(self, api: str) -> tuple[float, float]:
         return self.per_api_ms.get(api, (self.p50_ms, self.p99_ms))
@@ -322,6 +326,19 @@ def evaluate(scenario: str, *, api_stats=None, api_pcts=None, recorder,
     dead = metric_total(scrape_text, "mt_target_dead_letter_total")
     row("telemetry_dead_letters", dead, "records", dead == 0,
         {"family": "mt_target_dead_letter_total"})
+
+    # cross-request codec batching engaged under small-object load:
+    # occupancy_sum counts requests coalesced into fused dispatches —
+    # zero means the batcher never ran (disabled, or the workload never
+    # touched the encode/decode plane it exists for)
+    if budget.require_codec_occupancy:
+        occ = metric_total(scrape_text,
+                           "mt_codec_batch_occupancy_sum")
+        disp = metric_total(scrape_text,
+                            "mt_codec_batch_dispatches_total")
+        row("codec_batch_occupancy", round(occ, 1), "requests",
+            occ > 0, {"family": "mt_codec_batch_occupancy",
+                      "dispatches": disp})
 
     # heal convergence: MRF drained + classify_disks clean on all sets
     if convergence is not None:

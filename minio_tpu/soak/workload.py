@@ -77,6 +77,14 @@ MIXES: dict[str, Mix] = {m.name: m for m in (
     Mix("versioned_churn",
         {"churn": 0.45, "put": 0.25, "get": 0.25, "list": 0.05},
         sizes_bytes=(2048, 16384), versioned=True),
+    # the cross-request batching codec service's target traffic
+    # (ROADMAP item 4): many concurrent tiny PUT/GET workers whose
+    # encode/decode dispatches coalesce in the shared batcher — the
+    # matrix runs it with extra workers and asserts non-zero
+    # mt_codec_batch_occupancy on a live scrape (soak/slo.py)
+    Mix("small_object_storm",
+        {"put": 0.45, "get": 0.45, "head": 0.10},
+        sizes_bytes=(512, 2048, 8192), key_space=16),
 )}
 
 

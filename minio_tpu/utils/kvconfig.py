@@ -132,6 +132,22 @@ register_subsys("pipeline", {
     "queue_depth": "2",
     "md5_lanes": "4",
 })
+register_subsys("codec", {
+    # cross-request batching codec service (parallel/batcher.py):
+    # concurrent encode/decode/reconstruct dispatches on DEVICE
+    # backends (tpu/mesh; the numpy host path has no launch cost to
+    # amortize and bypasses the batcher) bucket by geometry and
+    # coalesce within ``batch_window_us`` into one padded
+    # device dispatch (bounded by ``max_batch_blocks`` erasure blocks);
+    # ``queue_depth`` bounds queued blocks per bucket — overflow sheds
+    # to the serial path.  ``enable=off`` restores per-request
+    # dispatches (the serial reference semantics).  Live-reloadable
+    # (S3Server.reload_codec_config on admin SetConfigKV).
+    "enable": "on",
+    "batch_window_us": "200",
+    "max_batch_blocks": "256",
+    "queue_depth": "1024",
+})
 register_subsys("storage_class", {
     "standard": "",                 # e.g. EC:4
     "rrs": "EC:2",

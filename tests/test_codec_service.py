@@ -66,6 +66,28 @@ def test_remote_shard_math_is_local(remote):
         local.shard_file_offset(100, 200, 12345)
 
 
+def test_remote_reconstruct_executes_remotely_not_via_fallback(sidecar):
+    """The iovec request body must actually reach the sidecar: with the
+    local fallback codec removed, reconstruction still succeeds — a
+    wire regression (e.g. a chunked body the raw server reads as empty)
+    would otherwise hide behind the bit-identical local fallback
+    forever.  The second call pins keep-alive reuse after an iovec
+    body."""
+    rc = RemoteCodec(RPCClient(sidecar.endpoint, SECRET), 4, 2,
+                     64 * 1024)
+    rc._local = None                      # fallback would AttributeError
+    local = Erasure(4, 2, 64 * 1024, backend="numpy")
+    data = _data(2 * 64 * 1024 + 999, seed=23)
+    full = local.encode_object(data)
+    for lost in ((0, 5), (1,)):
+        shards = [s.copy() for s in full]
+        for i in lost:
+            shards[i] = None
+        out = rc.decode_data_and_parity_blocks(shards)
+        for i in range(6):
+            assert np.array_equal(out[i], full[i]), (lost, i)
+
+
 def test_dead_sidecar_falls_back_locally():
     client = RPCClient("http://127.0.0.1:1", SECRET)   # nothing there
     rc = RemoteCodec(client, 4, 2, 64 * 1024)

@@ -227,6 +227,56 @@ def test_writer_plane_threads_stop_with_server(tmp_path):
         close_write_planes(layer)
 
 
+def test_codec_batcher_leaves_no_threads_or_state(tmp_path):
+    """The cross-request codec batcher owns NO threads (combiners are
+    borrowed caller threads, the LaneScheduler discipline) — after a
+    burst of concurrent batched traffic, including a caller that died
+    mid-queue, nothing mt-codec-shaped survives and every combining
+    bucket has been drained and pruned."""
+    import numpy as np
+
+    from minio_tpu.ops.codec import Erasure
+    from minio_tpu.parallel import batcher
+
+    cfg = batcher.CONFIG
+    saved = (cfg.enable, cfg.window_s, cfg._loaded)
+    cfg.enable, cfg.window_s, cfg._loaded = True, 0.02, True
+    try:
+        body = np.random.default_rng(3).integers(
+            0, 256, 4 * 4096, dtype=np.uint8).tobytes()
+        c = Erasure(4, 2, 4096, backend="tpu")
+        rows = np.asarray(c.matrix)[4:]
+        blocks = np.frombuffer(body, np.uint8).reshape(4, 4, 1024)
+
+        def worker():
+            c.encode_object(body)
+
+        def dying_worker():
+            # a deadline'd caller: cancels out of the queue if parked
+            batcher.GLOBAL.apply(c, "encode", rows, blocks,
+                                 timeout=0.001)
+
+        ths = [threading.Thread(target=worker, name=f"mt-codec-l{i}")
+               for i in range(6)]
+        ths.append(threading.Thread(target=dying_worker,
+                                    name="mt-codec-dying"))
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(30)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and any(
+                t.is_alive() and t.name.startswith("mt-codec")
+                for t in threading.enumerate()):
+            time.sleep(0.05)
+        leftover = [t.name for t in threading.enumerate()
+                    if t.is_alive() and t.name.startswith("mt-codec")]
+        assert not leftover, leftover
+        assert not batcher.GLOBAL._buckets, "combining bucket leaked"
+    finally:
+        cfg.enable, cfg.window_s, cfg._loaded = saved
+
+
 def test_rpc_server_stop_closes_listener(tmp_path):
     from minio_tpu.parallel.rpc import RPCClient, RPCError, RPCServer
     srv = RPCServer("leaksecret")

@@ -217,9 +217,12 @@ def test_chunk_source_death_discards_partial_create(remote):
                         {"drive_id": "d0", "volume": "vol1",
                          "path": "partial", "op": "create"},
                         StreamBody(chunks))
-    # server observed a truncated stream: the partial file is discarded
+    # server observed a truncated stream: the partial file is discarded.
+    # Generous bound: the discard runs on the server's handler thread,
+    # which full-suite load (writeback from earlier suites' disk churn)
+    # can delay well past the work's own cost.
     deadline = threading.Event()
-    for _ in range(50):
+    for _ in range(200):
         if not os.path.exists(os.path.join(drive.root, "vol1",
                                            "partial")):
             break

@@ -276,6 +276,43 @@ def test_soak_status_admin_route(tmp_path):
         srv.stop()
 
 
+def test_small_object_storm_engages_codec_batcher(tmp_path):
+    """The batching codec service's target scenario in miniature: many
+    concurrent tiny PUT/GET workers on a real cluster, a drive death
+    riding along — SLO rows pass AND the live scrape proves the
+    cross-request batcher coalesced dispatches (non-zero
+    mt_codec_batch_occupancy)."""
+    from minio_tpu.parallel import batcher
+    from minio_tpu.soak.workload import MIXES as _mixes
+    cfg = batcher.CONFIG
+    saved = (cfg.enable, cfg.window_s, cfg._loaded)
+    cfg.enable, cfg.window_s, cfg._loaded = True, 500e-6, True
+    try:
+        d = 3.0
+        E = soak_chaos.Event
+        sc = soak_report.Scenario(
+            name="small_object_storm_smoke",
+            mix=_mixes["small_object_storm"],
+            timeline=[E(0.2 * d, "drive_kill", drive=0),
+                      E(0.6 * d, "drive_return", drive=0)],
+            duration_s=d, workers=4, backend="tpu",
+            budget=soak_slo.Budget(converge_timeout_s=30.0,
+                                   max_error_rate=0.10,
+                                   require_codec_occupancy=True))
+        rows = soak_report.run_scenario(sc, str(tmp_path / "storm"))
+        by_metric = {r["metric"]: r for r in rows}
+        failed = [r for r in rows if not r["passed"]]
+        assert not failed, failed
+        occ = by_metric["codec_batch_occupancy"]
+        assert occ["value"] > 0
+        assert occ["detail"]["dispatches"] > 0
+        # the storm actually stormed: p99 rows exist for the hot APIs
+        assert any(m.startswith("p99:PutObject") for m in by_metric)
+        assert any(m.startswith("p99:GetObject") for m in by_metric)
+    finally:
+        (cfg.enable, cfg.window_s, cfg._loaded) = saved
+
+
 # -- the slow-marked full matrix (bench.py soak leg) -----------------------
 
 @pytest.mark.slow
