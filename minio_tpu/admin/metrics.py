@@ -190,6 +190,10 @@ def render(layer=None, healer=None, config=None, api_stats=None,
         lines += _codec_batch_gauges()
     except Exception:  # noqa: BLE001
         pass
+    try:
+        lines += _memgov_gauges()
+    except Exception:  # noqa: BLE001
+        pass
     if api_stats is not None:
         try:
             lines += _s3_lastminute_gauges(api_stats)
@@ -605,6 +609,28 @@ def _codec_batch_gauges() -> list[str]:
         lbl = _fmt_labels((("op", op),))
         lines.append(f"mt_codec_batch_queue_depth{lbl}"
                      f" {depths.get(op, 0)}")
+    return lines
+
+
+def _memgov_gauges() -> list[str]:
+    """Node memory-governor families (utils/memgov.py): configured
+    watermark, outstanding charges per kind, and the process peak.
+    Idle contract: an unconfigured governor that never took a charge
+    (and never shed) emits no family at all.  ``mt_mem_shed_total``
+    is a plain process counter ticked at shed time."""
+    from ..utils.memgov import GOVERNOR
+    if not GOVERNOR.touched:
+        return []
+    st = GOVERNOR.stats()
+    lines = ["# TYPE mt_mem_limit_bytes gauge",
+             f"mt_mem_limit_bytes {st['limit_bytes']}",
+             "# TYPE mt_mem_peak_bytes gauge",
+             f"mt_mem_peak_bytes {st['peak_bytes']}",
+             "# TYPE mt_mem_inuse_bytes gauge"]
+    inuse = st["inuse"]
+    for kind in sorted(set(inuse) | {"select", "listing", "multipart"}):
+        lbl = _fmt_labels((("kind", kind),))
+        lines.append(f"mt_mem_inuse_bytes{lbl} {inuse.get(kind, 0)}")
     return lines
 
 

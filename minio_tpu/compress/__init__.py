@@ -168,48 +168,81 @@ def compress_stream(data: bytes) -> bytes:
 
 
 def decompress_stream(data: bytes) -> bytes:
-    s2 = data.startswith(_S2_IDENT)
-    if not (data.startswith(_STREAM_IDENT) or s2):
-        raise CompressionError("missing snappy stream identifier")
-    out = bytearray()
-    i = len(_STREAM_IDENT)
-    while i < len(data):
-        if i + 4 > len(data):
-            raise CompressionError("truncated chunk header")
-        kind = data[i]
-        ln = data[i + 1] | (data[i + 2] << 8) | (data[i + 3] << 16)
-        i += 4
-        if i + ln > len(data):
-            raise CompressionError("truncated chunk")
-        body = data[i:i + ln]
-        i += ln
-        if kind in (_CHUNK_COMPRESSED, _CHUNK_UNCOMPRESSED):
-            if ln < 4:
-                raise CompressionError("short chunk")
-            crc = struct.unpack("<I", body[:4])[0]
-            payload = body[4:]
-            try:
-                plain = decompress_block(payload) \
-                    if kind == _CHUNK_COMPRESSED else payload
-            except (CompressionError, ValueError) as e:
-                if s2:
-                    # see _S2_IDENT comment: refuse loudly, never guess
+    """Whole-buffer decode — one join over the incremental decoder, so
+    the framing/CRC/S2 rules have a single implementation."""
+    return b"".join(decompress_chunks((data,)))
+
+
+def decompress_chunks(chunks):
+    """Incremental :func:`decompress_stream` over an iterator of stream
+    slices: framing chunks are decoded AS THEY COMPLETE, so a consumer
+    (the streaming Select scanner, chunked GET transforms) holds one
+    ~64 KiB frame plus the undecoded remainder — never the whole
+    object.  Same validation and errors as the whole-buffer decoder;
+    a source that ends mid-frame raises ``truncated chunk``."""
+    buf = bytearray()
+    checked_ident = False
+    s2 = False
+    try:
+        for piece in chunks:
+            if piece:
+                buf += piece
+            if not checked_ident:
+                if len(buf) < len(_STREAM_IDENT):
+                    continue
+                s2 = bytes(buf[:len(_S2_IDENT)]) == _S2_IDENT
+                if not (bytes(buf[:len(_STREAM_IDENT)]) == _STREAM_IDENT
+                        or s2):
                     raise CompressionError(
-                        "S2-extended block opcodes (repeat offsets / "
-                        "large blocks) are not supported by this "
-                        "decoder; re-write the object with snappy-"
-                        "compatible compression") from e
-                raise
-            if _masked_crc(plain) != crc:
-                raise CompressionError("chunk CRC mismatch")
-            out += plain
-        elif kind == 0xFF:
-            continue                         # repeated stream identifier
-        elif 0x80 <= kind <= 0xFD:
-            continue                         # skippable chunk
-        else:
-            raise CompressionError(f"unknown chunk type {kind:#x}")
-    return bytes(out)
+                        "missing snappy stream identifier")
+                del buf[:len(_STREAM_IDENT)]
+                checked_ident = True
+            while len(buf) >= 4:
+                kind = buf[0]
+                ln = buf[1] | (buf[2] << 8) | (buf[3] << 16)
+                if len(buf) < 4 + ln:
+                    break
+                body = bytes(buf[4:4 + ln])
+                del buf[:4 + ln]
+                plain = _decode_frame(kind, ln, body, s2)
+                if plain:
+                    yield plain
+        if not checked_ident:
+            raise CompressionError("missing snappy stream identifier")
+        if buf:
+            raise CompressionError(
+                "truncated chunk header" if len(buf) < 4
+                else "truncated chunk")
+    finally:
+        from ..utils import close_quietly
+        close_quietly(chunks)
+
+
+def _decode_frame(kind: int, ln: int, body: bytes, s2: bool) -> bytes:
+    """Decode + CRC-check ONE framing chunk (shared by the whole-buffer
+    and incremental decoders); returns b'' for skippable chunks."""
+    if kind in (_CHUNK_COMPRESSED, _CHUNK_UNCOMPRESSED):
+        if ln < 4:
+            raise CompressionError("short chunk")
+        crc = struct.unpack("<I", body[:4])[0]
+        payload = body[4:]
+        try:
+            plain = decompress_block(payload) \
+                if kind == _CHUNK_COMPRESSED else payload
+        except (CompressionError, ValueError) as e:
+            if s2:
+                raise CompressionError(
+                    "S2-extended block opcodes (repeat offsets / "
+                    "large blocks) are not supported by this "
+                    "decoder; re-write the object with snappy-"
+                    "compatible compression") from e
+            raise
+        if _masked_crc(plain) != crc:
+            raise CompressionError("chunk CRC mismatch")
+        return plain
+    if kind == 0xFF or 0x80 <= kind <= 0xFD:
+        return b""                      # repeated ident / skippable
+    raise CompressionError(f"unknown chunk type {kind:#x}")
 
 
 # -- eligibility (cmd/object-api-utils.go:436-449) --------------------------

@@ -1471,14 +1471,28 @@ class ErasureObjects(MultipartOps, ObjectLayer):
     def list_objects(self, bucket: str, prefix: str = "", marker: str = "",
                      delimiter: str = "", max_keys: int = 1000
                      ) -> ListObjectsInfo:
-        """Serve from the metacache snapshot; the walk+resolve runs once
-        per (bucket, prefix) and continuation pages reuse it
-        (cmd/metacache-server-pool.go listPath)."""
+        """Serve from the streamed metacache blocks; the walk+resolve
+        runs once per (bucket, prefix), seals fixed-size blocks as it
+        resolves, and continuation pages bisect straight to their
+        covering block — one block in memory per page, never the
+        namespace (cmd/metacache-server-pool.go listPath +
+        cmd/metacache-set.go block persistence)."""
         self._check_bucket(bucket)
-        mc = self.metacache.list_path(
-            bucket, prefix, lambda: self._gather_listing(bucket, prefix))
-        from .metacache import paginate
-        return paginate(mc.entries, prefix, marker, delimiter, max_keys)
+        from .metacache import SnapshotGone, paginate
+        for _ in range(2):
+            snap = self.metacache.list_path_stream(
+                bucket, prefix,
+                lambda: self._gather_listing_iter(bucket, prefix))
+            try:
+                return paginate(snap.iter_from(marker), prefix, marker,
+                                delimiter, max_keys)
+            except SnapshotGone:
+                # a persisted block vanished under the snapshot
+                # (invalidate race / drive churn): drop it, re-walk
+                self.metacache.forget(bucket, prefix)
+        # twice unlucky: serve this page straight off a fresh walk
+        return paginate(self._gather_listing_iter(bucket, prefix),
+                        prefix, marker, delimiter, max_keys)
 
     def _walk_resolve(self, bucket: str, prefix: str,
                       versions: bool) -> dict[str, list]:
@@ -1505,24 +1519,46 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                      for f in e["fis"]])
         return merged
 
-    def _gather_listing(self, bucket: str, prefix: str
-                        ) -> list[ObjectInfo]:
-        """Walk all drives once, resolve each entry from the walked
-        metadata by quorum agreement (cmd/metacache-set.go listPath +
-        metacache-entries resolve)."""
-        merged = self._walk_resolve(bucket, prefix, versions=False)
+    def _gather_listing_iter(self, bucket: str, prefix: str):
+        """STREAMED walk+resolve: one lazy walk stream per drive
+        (flat key order — xl_storage.walk_dir's contract), k-way
+        merged and quorum-resolved entry by entry, so memory stays
+        O(drives), never O(namespace) (cmd/metacache-set.go listPath +
+        metacache-entries resolve, minus the round-2 full gather)."""
+        import heapq
+        from itertools import groupby
+
+        base_dir = prefix.rsplit("/", 1)[0] if "/" in prefix else ""
+
+        def drive_stream(d):
+            try:
+                yield from d.walk_entries(bucket, base_dir,
+                                          versions=False)
+            except Exception:  # noqa: BLE001 — a dead/unreachable
+                return         # drive's entries are simply missing;
+                               # quorum below decides per entry
+
+        streams = [drive_stream(d) for d in self.disks if d is not None]
+        merged = heapq.merge(*streams, key=lambda e: e["name"])
         quorum = max(1, len(self.disks) // 2)
-        entries: list[ObjectInfo] = []
-        for name in sorted(merged):
-            fis = [drive_fis[0] for drive_fis in merged[name]]
+        for name, group in groupby(merged, key=lambda e: e["name"]):
+            if prefix:
+                if name < prefix:
+                    continue
+                if not name.startswith(prefix):
+                    break       # sorted streams: nothing later matches
+            fis = []
+            for e in group:
+                f = e["fis"][0]
+                fis.append(FileInfo.from_dict(f)
+                           if isinstance(f, dict) else f)
             try:
                 fi = meta.find_file_info_in_quorum(fis, quorum)
             except ReadQuorumError:
                 continue        # disagreement below quorum: skip entry
             if fi.deleted:
                 continue
-            entries.append(self._to_object_info(fi))
-        return entries
+            yield self._to_object_info(fi)
 
     def list_object_versions(self, bucket: str, prefix: str = ""):
         """All versions of all objects (ListObjectVersions core) — same

@@ -1,35 +1,47 @@
-"""Metacache — listing cache (cmd/metacache.go, cmd/metacache-manager.go,
-cmd/metacache-bucket.go, cmd/metacache-set.go, cmd/metacache-entries.go).
+"""Metacache — streamed listing cache (cmd/metacache.go,
+cmd/metacache-manager.go, cmd/metacache-bucket.go, cmd/metacache-set.go,
+cmd/metacache-entries.go).
 
-The reference executes each listing once per erasure set (disks walked in
-agreement, entries resolved across drives), streams the result as msgp
-"metacache blocks" persisted as objects under ``.minio.sys``, and serves
-continuation requests from the cache instead of re-walking.  This build
-keeps the same shape, host-side:
+The reference executes each listing once per erasure set (disks walked
+in agreement, entries resolved across drives), streams the result as
+msgp "metacache blocks" persisted as objects under ``.minio.sys``, and
+serves continuation requests from the cache instead of re-walking
+(cmd/metacache-set.go:544,834).  This build keeps that shape:
 
-* a listing snapshot (sorted resolved ``ObjectInfo`` entries for one
-  (bucket, prefix)) is gathered once, paginated from memory for
-  continuation requests;
-* snapshots persist through the per-drive ``StorageAPI`` into the system
-  volume so a restarted process (or another process sharing the drives)
-  reuses a fresh listing instead of re-walking;
-* local mutations invalidate the bucket's caches immediately; everything
-  expires after a TTL (the reference bounds cache life the same way and
-  additionally consults the update-tracker bloom filter).
+* a walk streams resolved ``ObjectInfo`` entries in key order; the
+  manager seals them into fixed-size sorted BLOCKS as they arrive,
+  persisting each block through the per-drive ``StorageAPI`` and
+  keeping only a small LRU of blocks in memory — listing a
+  million-object bucket costs O(block), never the namespace;
+* a manifest (id, creation time, mgr/gen stamp, last key per block)
+  is written after the walk so a restarted process (or another process
+  sharing the drives) reuses the persisted blocks, and pagination
+  bisects the last-key index to load exactly the covering block;
+* local mutations invalidate the bucket's caches immediately;
+  everything expires after a TTL (the reference bounds cache life the
+  same way and additionally consults the update-tracker bloom filter).
 
-Pagination/delimiter roll-up lives here too (``paginate``), shared by the
-erasure object layer so set/pool merges stay consistent.
+Pagination/delimiter roll-up lives here too (``paginate``, now over
+any entry ITERABLE so a page streams out of one block), shared by the
+erasure object layer so set/pool merges stay consistent.  V2
+continuation tokens (``encode_list_token``/``decode_list_token``) are
+opaque, versioned wrappers over the resume key: malformed tokens are a
+clean client error, and a token that outlives its snapshot generation
+resumes from the key over a fresh walk instead of failing.
 """
 
 from __future__ import annotations
 
+import base64
+import bisect
 import hashlib
 import json
 import threading
 import time
 import uuid
+from collections import OrderedDict
 from dataclasses import asdict, dataclass, field
-from typing import Callable, List, Optional
+from typing import Callable, Iterable, List, Optional
 
 from .interface import ListObjectsInfo, ObjectInfo
 
@@ -40,10 +52,25 @@ from .interface import ListObjectsInfo, ObjectInfo
 DEFAULT_TTL = 15.0
 _SYS_PREFIX = "metacache"       # under the drive SYS volume
 
+# entries per persisted metacache block (the reference's
+# metacacheBlockSize role) and the per-snapshot in-memory block LRU
+BLOCK_ENTRIES = 1000
+CACHE_BLOCKS = 4
+# rough per-entry working-set estimate the walk charges to the memory
+# governor while building blocks
+_EST_ENTRY_BYTES = 512
+
+
+class SnapshotGone(Exception):
+    """A persisted block vanished under a live snapshot (invalidate
+    race, drive churn) — the caller drops the snapshot and re-walks."""
+
 
 @dataclass
 class Metacache:
-    """One cached listing (cmd/metacache.go metacache struct).
+    """Legacy single-file snapshot shape (cmd/metacache.go metacache
+    struct) — kept for serialization compatibility; the manager now
+    builds :class:`BlockedSnapshot` instead.
 
     ``mgr``/``gen`` stamp WHICH manager wrote the snapshot at WHICH
     bucket mutation generation: a loader that recognises its own mgr
@@ -64,12 +91,14 @@ class Metacache:
                 - self.created) > ttl
 
 
-def paginate(entries: List[ObjectInfo], prefix: str, marker: str,
+def paginate(entries: Iterable[ObjectInfo], prefix: str, marker: str,
              delimiter: str, max_keys: int) -> ListObjectsInfo:
-    """Delimiter roll-up + marker pagination over a sorted entry
-    snapshot (cmd/metacache-entries.go filterPrefix/forwardTo).  The
-    marker compares against the rolled-up item so resuming from a
-    CommonPrefix NextMarker skips the whole prefix."""
+    """Delimiter roll-up + marker pagination over sorted entries
+    (cmd/metacache-entries.go filterPrefix/forwardTo).  ``entries`` is
+    consumed ONCE and only far enough to fill the page — fed from a
+    blocked snapshot, one page touches one block.  The marker compares
+    against the rolled-up item so resuming from a CommonPrefix
+    NextMarker skips the whole prefix."""
     out = ListObjectsInfo()
     prefixes: set[str] = set()
     for oi in entries:
@@ -99,28 +128,76 @@ def paginate(entries: List[ObjectInfo], prefix: str, marker: str,
     return out
 
 
-def _cache_path(bucket: str, prefix: str) -> str:
+# -- opaque V2 continuation tokens ------------------------------------------
+
+_TOKEN_PREFIX = "mt1-"
+
+
+def encode_list_token(key: str, snap_id: str = "", gen: int = -1) -> str:
+    """Wrap the resume key (plus advisory snapshot id/generation) into
+    the opaque NextContinuationToken clients echo verbatim."""
+    doc: dict = {"k": key}
+    if snap_id:
+        doc["i"] = snap_id
+    if gen >= 0:
+        doc["g"] = gen
+    raw = base64.urlsafe_b64encode(
+        json.dumps(doc, separators=(",", ":")).encode()).decode()
+    return _TOKEN_PREFIX + raw.rstrip("=")
+
+
+def decode_list_token(token: str) -> str:
+    """Resume key of a continuation token.  A token our encoder did not
+    mint passes through as a raw key marker (legacy clients); a token
+    WITH our prefix that fails to decode raises ValueError — the S3
+    layer maps it to InvalidArgument, never a 500.  A stale snapshot
+    id/generation inside is advisory only: pagination restarts from
+    the key over a fresh walk."""
+    if not token.startswith(_TOKEN_PREFIX):
+        return token
+    raw = token[len(_TOKEN_PREFIX):]
+    try:
+        doc = json.loads(base64.urlsafe_b64decode(
+            raw + "=" * (-len(raw) % 4)))
+        key = doc["k"]
+        if not isinstance(key, str):
+            raise TypeError(key)
+    except Exception as e:  # noqa: BLE001 — any decode failure is the
+        # client's malformed token, reported as such
+        raise ValueError("invalid continuation token") from e
+    return key
+
+
+def _cache_dir(bucket: str, prefix: str) -> str:
     h = hashlib.sha256(f"{bucket}\x00{prefix}".encode()).hexdigest()[:24]
-    return f"{_SYS_PREFIX}/{bucket}/{h}.json"
+    return f"{_SYS_PREFIX}/{bucket}/{h}"
+
+
+def _entries_doc(entries: List[ObjectInfo]) -> list:
+    return [asdict(e) for e in entries]
+
+
+def _entries_from(doc: list) -> List[ObjectInfo]:
+    out = []
+    for e in doc:
+        e["parts"] = [tuple(p) for p in e.get("parts", [])]
+        out.append(ObjectInfo(**e))
+    return out
 
 
 def _serialize(mc: Metacache) -> bytes:
     doc = {"id": mc.id, "bucket": mc.bucket, "prefix": mc.prefix,
            "created": mc.created, "mgr": mc.mgr, "gen": mc.gen,
-           "entries": [asdict(e) for e in mc.entries]}
+           "entries": _entries_doc(mc.entries)}
     return json.dumps(doc).encode()
 
 
 def _deserialize(data: bytes) -> Metacache:
     doc = json.loads(data)
-    entries = []
-    for e in doc["entries"]:
-        e["parts"] = [tuple(p) for p in e.get("parts", [])]
-        entries.append(ObjectInfo(**e))
     return Metacache(id=doc["id"], bucket=doc["bucket"],
                      prefix=doc["prefix"], created=doc["created"],
-                     entries=entries, mgr=doc.get("mgr", ""),
-                     gen=doc.get("gen", -1))
+                     entries=_entries_from(doc["entries"]),
+                     mgr=doc.get("mgr", ""), gen=doc.get("gen", -1))
 
 
 def leaf_layers_of(layer) -> list:
@@ -144,24 +221,126 @@ def managers_of(layer) -> list["MetacacheManager"]:
     return out
 
 
+class BlockedSnapshot:
+    """One streamed listing snapshot: sorted entry blocks addressed by
+    a last-key index (the reference's metacache-block shape).  Blocks
+    live on a drive plus a small in-memory LRU; ``iter_from`` bisects
+    the index so pagination loads one covering block per page."""
+
+    def __init__(self, mgr: "MetacacheManager | None", bucket: str,
+                 prefix: str, *, id: str, created: float, mgr_id: str,
+                 gen: int):
+        self._mgr = mgr
+        self.bucket = bucket
+        self.prefix = prefix
+        self.id = id
+        self.created = created
+        self.mgr = mgr_id
+        self.gen = gen
+        self.block_keys: list[str] = []     # last key per sealed block
+        self._blocks: OrderedDict[int, List[ObjectInfo]] = OrderedDict()
+        self._pinned: set[int] = set()      # not on disk: never evicted
+        self._disk = None                   # drive holding the blocks
+        self._mu = threading.Lock()
+
+    def expired(self, ttl: float, now: float | None = None) -> bool:
+        return ((now if now is not None else time.time())
+                - self.created) > ttl
+
+    # -- block access ------------------------------------------------------
+
+    def _block_path(self, i: int) -> str:
+        return f"{_cache_dir(self.bucket, self.prefix)}/{self.id}" \
+               f"/b{i:06d}.json"
+
+    def _block(self, i: int) -> List[ObjectInfo]:
+        with self._mu:
+            blk = self._blocks.get(i)
+            if blk is not None:
+                self._blocks.move_to_end(i)
+                return blk
+        blk = self._load_block(i)
+        with self._mu:
+            self._blocks[i] = blk
+            self._blocks.move_to_end(i)
+            self._evict_locked()
+        return blk
+
+    def _evict_locked(self) -> None:
+        limit = self._mgr.cache_blocks if self._mgr is not None \
+            else CACHE_BLOCKS
+        evictable = [i for i in self._blocks if i not in self._pinned]
+        while evictable and len(self._blocks) > limit:
+            self._blocks.pop(evictable.pop(0), None)
+
+    def _load_block(self, i: int) -> List[ObjectInfo]:
+        mgr = self._mgr
+        if mgr is None or not mgr._disks or not mgr._sys_volume:
+            raise SnapshotGone(f"block {i} of {self.id} not in memory")
+        drives = [self._disk] if self._disk is not None else []
+        drives += [d for d in mgr._disks if d is not self._disk]
+        path = self._block_path(i)
+        for d in drives:
+            try:
+                doc = json.loads(d.read_all(mgr._sys_volume, path))
+                if doc.get("id") != self.id:
+                    continue
+                return _entries_from(doc["entries"])
+            except Exception:  # noqa: BLE001 — missing/corrupt: next
+                continue
+        raise SnapshotGone(f"block {i} of {self.id} unreadable")
+
+    # -- iteration ---------------------------------------------------------
+
+    def iter_from(self, marker: str = "") -> Iterable[ObjectInfo]:
+        """Entries in key order starting at the first BLOCK that can
+        contain keys past ``marker`` (bisect over the last-key index);
+        fine-grained marker filtering stays in :func:`paginate`."""
+        start = bisect.bisect_right(self.block_keys, marker) \
+            if marker else 0
+        for i in range(start, len(self.block_keys)):
+            yield from self._block(i)
+
+    @property
+    def entries(self) -> List[ObjectInfo]:
+        """Whole snapshot materialized — legacy callers/tests only."""
+        return list(self.iter_from(""))
+
+    def drop_persisted(self) -> None:
+        """Best-effort removal of this snapshot's block dir."""
+        mgr = self._mgr
+        if mgr is None or self._disk is None:
+            return
+        try:
+            self._disk.delete(
+                mgr._sys_volume,
+                f"{_cache_dir(self.bucket, self.prefix)}/{self.id}",
+                recursive=True)
+        except Exception:  # noqa: BLE001 — best effort
+            pass
+
+
 class MetacacheManager:
     """Per-object-layer cache registry (cmd/metacache-manager.go).
 
-    ``disks`` (optional) enables persistence: snapshots are written to
-    the first healthy drive's system volume and loaded from any drive on
-    a cold lookup, giving restart/cross-process reuse the way the
-    reference persists metacache blocks as objects.
+    ``disks`` (optional) enables persistence: blocks and the manifest
+    are written to the first healthy drive's system volume and loaded
+    from any drive on a cold lookup, giving restart/cross-process
+    reuse the way the reference persists metacache blocks as objects.
     """
 
     def __init__(self, disks: Optional[list] = None,
                  ttl: float = DEFAULT_TTL, max_caches: int = 128,
-                 sys_volume: str = ""):
-        self._caches: dict[tuple, Metacache] = {}
+                 sys_volume: str = "", block_entries: int = BLOCK_ENTRIES,
+                 cache_blocks: int = CACHE_BLOCKS):
+        self._caches: dict[tuple, BlockedSnapshot] = {}
         self._mu = threading.Lock()
         self._disks = disks or []
         self._ttl = ttl
         self._max = max_caches
         self._sys_volume = sys_volume
+        self.block_entries = max(1, block_entries)
+        self.cache_blocks = max(1, cache_blocks)
         self.hits = 0
         self.misses = 0
         # buckets whose on-disk snapshots are KNOWN absent: a PUT-heavy
@@ -178,9 +357,9 @@ class MetacacheManager:
         # the mutator's invalidate ran — the lost-invalidate race.  The
         # walk captures the generation first and the snapshot is cached
         # or persisted only if the bucket is untouched since.  The
-        # manager uuid + gen are also stamped INTO persisted snapshots
-        # so _load rejects this manager's own stale files even when the
-        # best-effort drop lost a race (see Metacache docstring).
+        # manager uuid + gen are also stamped INTO persisted manifests
+        # so _load_manifest rejects this manager's own stale files even
+        # when the best-effort drop lost a race (Metacache docstring).
         self._gen: dict = {}
         self._uuid = uuid.uuid4().hex
         # optional DataUpdateTracker: when attached, cache hits consult
@@ -189,7 +368,7 @@ class MetacacheManager:
         # metacache<->data-update-tracker coupling)
         self.tracker = None
 
-    def _stale(self, mc: Metacache) -> bool:
+    def _stale(self, snap) -> bool:
         """Update-tracker consult (cmd/metacache-bucket.go coupling):
         the cache is stale once the bucket changed at-or-after the
         snapshot's creation.  ``created`` is captured BEFORE the walk,
@@ -197,55 +376,90 @@ class MetacacheManager:
         lookup re-walks; >= makes the same-instant race err toward an
         extra walk, never a stale listing."""
         return self.tracker is not None and \
-            self.tracker.bucket_changed_at(mc.bucket) >= mc.created
+            self.tracker.bucket_changed_at(snap.bucket) >= snap.created
 
-    # -- persistence -----------------------------------------------------
+    # -- persistence -------------------------------------------------------
 
-    def _persist(self, mc: Metacache, gen0: int = -1) -> None:
+    def _manifest_path(self, bucket: str, prefix: str) -> str:
+        return f"{_cache_dir(bucket, prefix)}/manifest.json"
+
+    def _persist_block(self, snap: BlockedSnapshot, i: int,
+                       entries: List[ObjectInfo],
+                       was_clean: bool) -> bool:
         if not self._disks or not self._sys_volume:
-            return
-        blob = _serialize(mc)
-        with self._mu:
-            if gen0 >= 0 and self._gen.get(mc.bucket, 0) != gen0:
-                return              # bucket mutated since the walk
-            self._clean_buckets.discard(mc.bucket)
-        written = None
-        for d in self._disks:
+            return False
+        blob = json.dumps({"id": snap.id,
+                           "entries": _entries_doc(entries)}).encode()
+        if snap._disk is not None:
+            drives = [snap._disk]
+        else:
+            drives = self._disks
+        for d in drives:
             try:
-                d.write_all(self._sys_volume,
-                            _cache_path(mc.bucket, mc.prefix), blob)
-                written = d
-                break               # one copy is enough; it's a cache
-            except Exception:       # noqa: BLE001 — next drive
-                continue
-        if written is not None and gen0 >= 0:
-            with self._mu:
-                fresh = self._gen.get(mc.bucket, 0) == gen0
-            if not fresh:
-                # invalidate raced the write and may have skipped its
-                # drop (clean-set fast path) — undo our own snapshot
-                try:
-                    written.delete(self._sys_volume,
-                                   _cache_path(mc.bucket, mc.prefix))
-                except Exception:   # noqa: BLE001 — best effort
-                    pass
+                if snap._disk is None and not was_clean:
+                    # first write after a non-clean state: drop the
+                    # PREVIOUS snapshot's blocks so TTL-expiry rebuilds
+                    # don't accrete orphan block dirs (one manifest
+                    # read + recursive delete per walk, skipped on the
+                    # PUT-heavy invalidate path where the drop already
+                    # ran)
+                    try:
+                        old = json.loads(d.read_all(
+                            self._sys_volume,
+                            self._manifest_path(snap.bucket,
+                                                snap.prefix)))
+                        if old.get("id") and old["id"] != snap.id:
+                            d.delete(
+                                self._sys_volume,
+                                f"{_cache_dir(snap.bucket, snap.prefix)}"
+                                f"/{old['id']}", recursive=True)
+                    except Exception:  # noqa: BLE001 — no old manifest
+                        pass
+                d.write_all(self._sys_volume, snap._block_path(i), blob)
+                snap._disk = d
+                return True
+            except Exception:  # noqa: BLE001 — next drive (first block
+                continue       # only; afterwards the snapshot degrades)
+        return False
 
-    def _load(self, bucket: str, prefix: str) -> Optional[Metacache]:
+    def _write_manifest(self, snap: BlockedSnapshot) -> bool:
+        if snap._disk is None or not self._sys_volume:
+            return False
+        doc = {"id": snap.id, "bucket": snap.bucket,
+               "prefix": snap.prefix, "created": snap.created,
+               "mgr": snap.mgr, "gen": snap.gen,
+               "block_keys": snap.block_keys}
+        try:
+            snap._disk.write_all(
+                self._sys_volume,
+                self._manifest_path(snap.bucket, snap.prefix),
+                json.dumps(doc).encode())
+            return True
+        except Exception:  # noqa: BLE001 — cold reuse lost, cache fine
+            return False
+
+    def _load_manifest(self, bucket: str,
+                       prefix: str) -> Optional[BlockedSnapshot]:
+        path = self._manifest_path(bucket, prefix)
         for d in self._disks:
             try:
-                blob = d.read_all(self._sys_volume,
-                                  _cache_path(bucket, prefix))
-                mc = _deserialize(blob)
-                if mc.mgr == self._uuid:
+                doc = json.loads(d.read_all(self._sys_volume, path))
+                snap = BlockedSnapshot(
+                    self, bucket, prefix, id=doc["id"],
+                    created=doc["created"], mgr_id=doc.get("mgr", ""),
+                    gen=doc.get("gen", -1))
+                snap.block_keys = list(doc.get("block_keys", []))
+                snap._disk = d
+                if snap.mgr == self._uuid:
                     # our own snapshot: exact generation check beats
                     # any TTL heuristic
                     with self._mu:
-                        if mc.gen != self._gen.get(bucket, 0):
+                        if snap.gen != self._gen.get(bucket, 0):
                             return None
-                if not mc.expired(self._ttl):
-                    return mc
+                if not snap.expired(self._ttl):
+                    return snap
                 return None
-            except Exception:       # noqa: BLE001 — missing/corrupt: miss
+            except Exception:  # noqa: BLE001 — missing/corrupt: miss
                 continue
         return None
 
@@ -254,27 +468,39 @@ class MetacacheManager:
             try:
                 d.delete(self._sys_volume, f"{_SYS_PREFIX}/{bucket}",
                          recursive=True)
-            except Exception:       # noqa: BLE001 — best effort
+            except Exception:  # noqa: BLE001 — best effort
                 pass
 
-    # -- lookup / fill ---------------------------------------------------
+    # -- lookup / fill -----------------------------------------------------
 
     def list_path(self, bucket: str, prefix: str,
-                  loader: Callable[[], List[ObjectInfo]]) -> Metacache:
-        """Cached entries for (bucket, prefix); ``loader`` walks+resolves
-        on miss (cmd/metacache-server-pool.go listPath)."""
+                  loader: Callable[[], List[ObjectInfo]]
+                  ) -> BlockedSnapshot:
+        """Legacy list-loader entry point (kept for callers that gather
+        eagerly): sorts the loaded entries and rides the streamed
+        path."""
+        return self.list_path_stream(
+            bucket, prefix,
+            lambda: iter(sorted(loader(), key=lambda o: o.name)))
+
+    def list_path_stream(self, bucket: str, prefix: str,
+                         loader: Callable[[], Iterable[ObjectInfo]]
+                         ) -> BlockedSnapshot:
+        """Snapshot for (bucket, prefix); ``loader`` returns a SORTED
+        entry iterator consumed block-at-a-time on miss
+        (cmd/metacache-server-pool.go listPath)."""
         key = (bucket, prefix)
         now = time.time()
         with self._mu:
-            mc = self._caches.get(key)
-            if mc is not None and not mc.expired(self._ttl, now) \
-                    and not self._stale(mc):
+            snap = self._caches.get(key)
+            if snap is not None and not snap.expired(self._ttl, now) \
+                    and not self._stale(snap):
                 self.hits += 1
-                return mc
+                return snap
         with self._mu:
             gen_at_load = self._gen.get(bucket, 0)
-        mc = self._load(bucket, prefix)
-        if mc is not None and not self._stale(mc):
+        snap = self._load_manifest(bucket, prefix)
+        if snap is not None and not self._stale(snap):
             self.hits += 1
             with self._mu:
                 # install only if the bucket is untouched since before
@@ -282,29 +508,90 @@ class MetacacheManager:
                 # not have its cache clear overwritten by a snapshot it
                 # could not see (same guard as the walk path below)
                 if self._gen.get(bucket, 0) == gen_at_load:
-                    self._caches[key] = mc
-            return mc
+                    self._install_locked(key, snap)
+            return snap
         self.misses += 1
+        return self._build(bucket, prefix, loader, now)
+
+    def _install_locked(self, key: tuple, snap: BlockedSnapshot) -> None:
+        if len(self._caches) >= self._max and key not in self._caches:
+            # evict oldest (manager keeps a bounded registry)
+            oldest = min(self._caches,
+                         key=lambda k: self._caches[k].created)
+            del self._caches[oldest]
+        self._caches[key] = snap
+
+    def _build(self, bucket: str, prefix: str,
+               loader: Callable[[], Iterable[ObjectInfo]],
+               now: float) -> BlockedSnapshot:
+        from ..utils.memgov import GOVERNOR
         with self._mu:
             gen0 = self._gen.get(bucket, 0)
-        entries = sorted(loader(), key=lambda o: o.name)
-        mc = Metacache(id=uuid.uuid4().hex, bucket=bucket, prefix=prefix,
-                       created=now, entries=entries, mgr=self._uuid,
-                       gen=gen0)
+            was_clean = bucket in self._clean_buckets
+            self._clean_buckets.discard(bucket)
+        snap = BlockedSnapshot(self, bucket, prefix,
+                               id=uuid.uuid4().hex, created=now,
+                               mgr_id=self._uuid, gen=gen0)
+        # governor admission for the walk's working set: the build
+        # holds one filling block plus the in-memory LRU — a node past
+        # its watermark sheds the listing with 503 instead of walking
+        charge = GOVERNOR.charge(
+            (self.cache_blocks + 1) * self.block_entries
+            * _EST_ENTRY_BYTES, "listing")
+        persist_ok = True
+        try:
+            buf: List[ObjectInfo] = []
+            for oi in loader():
+                buf.append(oi)
+                if len(buf) >= self.block_entries:
+                    persist_ok = self._seal(snap, buf, was_clean,
+                                            persist_ok)
+                    buf = []
+            if buf:
+                persist_ok = self._seal(snap, buf, was_clean,
+                                        persist_ok)
+        finally:
+            charge.release()
         with self._mu:
-            if self._gen.get(bucket, 0) != gen0:
-                # bucket mutated mid-walk: serve the snapshot to THIS
-                # caller (S3 listings are eventually consistent) but do
-                # not install it — the next lookup re-walks
-                return mc
-            if len(self._caches) >= self._max:
-                # evict oldest (manager keeps a bounded registry)
-                oldest = min(self._caches, key=lambda k:
-                             self._caches[k].created)
-                del self._caches[oldest]
-            self._caches[key] = mc
-        self._persist(mc, gen0)
-        return mc
+            fresh = self._gen.get(bucket, 0) == gen0
+        if not fresh:
+            # bucket mutated mid-walk: serve the snapshot to THIS
+            # caller (S3 listings are eventually consistent) but do not
+            # install or keep its blocks — the next lookup re-walks.
+            # Pin everything still in memory so the caller can finish
+            # paging without the deleted on-disk blocks.
+            with snap._mu:
+                snap._pinned.update(snap._blocks)
+            snap.drop_persisted()
+            snap._disk = None
+            return snap
+        if persist_ok and snap._disk is not None:
+            self._write_manifest(snap)
+        with self._mu:
+            if self._gen.get(bucket, 0) == gen0:
+                self._install_locked((bucket, prefix), snap)
+        return snap
+
+    def _seal(self, snap: BlockedSnapshot, entries: List[ObjectInfo],
+              was_clean: bool, persist_ok: bool) -> bool:
+        """Seal one block: index it, persist it, keep it in the LRU.
+        A persist failure degrades the snapshot to memory-pinned from
+        that block on (it's a cache — never fail the listing)."""
+        i = len(snap.block_keys)
+        snap.block_keys.append(entries[-1].name)
+        persisted = persist_ok and self._persist_block(
+            snap, i, entries, was_clean)
+        with snap._mu:
+            snap._blocks[i] = entries
+            if not persisted:
+                snap._pinned.add(i)
+            snap._evict_locked()
+        return persisted
+
+    def forget(self, bucket: str, prefix: str) -> None:
+        """Drop one (bucket, prefix) snapshot (SnapshotGone recovery)."""
+        with self._mu:
+            self._caches.pop((bucket, prefix), None)
 
     def invalidate(self, bucket: str) -> None:
         """Drop every cache for the bucket (local mutation hook)."""

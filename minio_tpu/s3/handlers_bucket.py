@@ -490,13 +490,24 @@ def _encoding_type(self, q1):
     return (lambda s: s), False
 
 def _list_objects(self, bucket, query):
+    from ..objectlayer import metacache as mcache
     q1 = {k: v[0] for k, v in query.items()}
     v2 = q1.get("list-type") == "2"
     prefix = q1.get("prefix", "")
     delimiter = q1.get("delimiter", "")
     max_keys = min(int(q1.get("max-keys", 1000) or 1000), 1000)
-    marker = q1.get("continuation-token" if v2 else "marker", "") \
-        or q1.get("start-after", "")
+    if v2 and q1.get("continuation-token"):
+        # opaque V2 tokens decode to the resume key; a malformed token
+        # is the client's error (InvalidArgument), and one that
+        # outlived its snapshot generation simply resumes from the key
+        # over a fresh walk — never a 500 (metacache.decode_list_token)
+        try:
+            marker = mcache.decode_list_token(q1["continuation-token"])
+        except ValueError as e:
+            raise S3Error("InvalidArgument") from e
+    else:
+        marker = q1.get("marker", "") if not v2 else ""
+        marker = marker or q1.get("start-after", "")
     esc, enc = self._encoding_type(q1)
     res = self.srv.layer.list_objects(bucket, prefix, marker, delimiter,
                                  max_keys)
@@ -525,7 +536,7 @@ def _list_objects(self, bucket, query):
                 esc(q1["start-after"])
         if res.is_truncated:
             ET.SubElement(root, "NextContinuationToken").text = \
-                res.next_marker
+                mcache.encode_list_token(res.next_marker)
     else:
         ET.SubElement(root, "Marker").text = esc(marker)
         if res.is_truncated:

@@ -200,35 +200,189 @@ def _governance_bypass(self, resource: str) -> bool:
     except S3Error:
         return False
 
-def _select_object(self, bucket, key, payload):
-    from . import select as s3select
-    _, data = self._fetch_plain(bucket, key)
-    try:
-        out = s3select.run(payload, data)
-    except s3select.SelectError as e:
-        raise S3Error(e.code) from e
-    self._send(200, out,
-               content_type="application/octet-stream")
+# frames accumulated past this switch the Select response from one
+# buffered Content-Length body (small results — the wire shape every
+# S3 SDK handled before streaming existed) to chunked transfer
+# encoding written as the scan advances
+SELECT_FLUSH_BYTES = 2 << 20
+# working-set estimate one Select scanner charges to the memory
+# governor: a few decode blocks + the pre-flush frame accumulation
+SELECT_CHARGE_BLOCKS = 6
 
-def _fetch_plain(self, bucket, key):
-    """Full object bytes after decryption (honoring SSE-C request
-    headers) and decompression — the decoded-object fetch shared
-    by Select and other whole-object consumers."""
+def _select_object(self, bucket, key, payload):
+    from ..admin.metrics import GLOBAL as mtr
+    from ..s3select import (SelectError, SelectRequest, message,
+                            run_select_stream)
+    from ..utils import close_quietly
+    from ..utils.memgov import GOVERNOR
+    block = self.srv.select_block_bytes
+    # request shape first (malformed XML is the client's 400, never a
+    # shed), and the object's identity — both feed the charge estimate
+    try:
+        req = SelectRequest.parse(payload)
+    except SelectError as e:
+        raise S3Error(e.code) from e
+    oi = self.srv.layer.get_object_info(bucket, key)
+    est = SELECT_CHARGE_BLOCKS * block + SELECT_FLUSH_BYTES
+    if req.input_format == "PARQUET" or (
+            req.input_format == "JSON" and
+            req.input_opts.get("type", "LINES") != "LINES"):
+        # whole-value inputs MATERIALIZE the decoded object (the
+        # documented scanner fallback) — the charge must say so, or
+        # the governor admits the very OOM it exists to shed
+        est += 2 * self._plain_size_estimate(oi)
+    # admission BEFORE any data is pulled: under memory pressure the
+    # scan is shed with 503 + Retry-After, not started (memgov.py)
+    charge = GOVERNOR.charge(est, "select")
+    chunks = None
+    try:
+        mtr.inc("mt_select_requests_total")
+
+        def on_stats(scanned, processed, returned):
+            mtr.inc("mt_select_scanned_bytes_total", value=scanned)
+            mtr.inc("mt_select_processed_bytes_total", value=processed)
+            mtr.inc("mt_select_returned_bytes_total", value=returned)
+
+        _, chunks = self._fetch_plain_chunks(bucket, key, block, oi=oi)
+        try:
+            frames = run_select_stream(payload, chunks,
+                                       block_bytes=block,
+                                       on_stats=on_stats)
+        except SelectError as e:
+            raise S3Error(e.code) from e
+        # hybrid send: accumulate frames up to the flush threshold —
+        # small results (and every pre-streaming test vector) keep the
+        # exact buffered wire shape; past it, switch to chunked and
+        # write frames as the scanner emits them (O(block) memory for
+        # multi-GiB scans).  An error BEFORE the response commits is a
+        # clean 400; after, it becomes an in-stream error frame (the
+        # reference's mid-stream error message semantics).
+        it = iter(frames)
+        head = bytearray()
+        done = False
+        try:
+            while len(head) < SELECT_FLUSH_BYTES:
+                try:
+                    head += next(it)
+                except StopIteration:
+                    done = True
+                    break
+        except SelectError as e:
+            raise S3Error(e.code) from e
+        if done:
+            return self._send(200, bytes(head),
+                              content_type="application/octet-stream")
+
+        def tail():
+            try:
+                yield from it
+            except SelectError as e:
+                yield message.error_message(e.code, str(e))
+
+        self._send_chunked(200, tail(), "application/octet-stream",
+                           head=bytes(head))
+    finally:
+        charge.release()
+        close_quietly(chunks)
+
+def _plain_size_estimate(self, oi) -> int:
+    """Decoded-size estimate for governor charges: the recorded
+    pre-compression size when compressed, the DARE-plaintext size when
+    encrypted-only, else the stored size."""
+    from ..crypto import sse as csse
+    raw = oi.user_defined.get(csse.META_ACTUAL_SIZE)
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    if csse.is_encrypted(oi.user_defined):
+        try:
+            return csse.decrypted_size(oi.user_defined, oi.size,
+                                       oi.parts)
+        except Exception:  # noqa: BLE001 — corrupt meta: stored size
+            pass
+    return oi.size
+
+
+class _SeqCipherReader:
+    """read(offset, n) over ONE streaming layer reader for callers
+    whose offsets advance monotonically (the block-by-block SSE-C
+    decrypt): the namespace lock and quorum metadata are taken once
+    for the whole scan instead of once per block.  A backward request
+    (shouldn't happen — decrypt ranges advance) falls back to a
+    ranged layer read."""
+
+    def __init__(self, layer, bucket, key, chunks):
+        self._layer = layer
+        self._bucket = bucket
+        self._key = key
+        self._chunks = chunks
+        self._buf = bytearray()
+        self._start = 0                  # object offset of buf[0]
+
+    def read(self, offset: int, n: int) -> bytes:
+        if offset < self._start:
+            return self._layer.get_object(self._bucket, self._key,
+                                          offset, n)[1]
+        drop = offset - self._start
+        while len(self._buf) < drop + n:
+            try:
+                piece = next(self._chunks)
+            except StopIteration:
+                break
+            self._buf += piece
+        if drop:
+            del self._buf[:drop]
+            self._start = offset
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        self._start += len(out)
+        return out
+
+    def close(self) -> None:
+        from ..utils import close_quietly
+        close_quietly(self._chunks)
+
+
+def _fetch_plain_chunks(self, bucket, key, block: int, oi=None):
+    """Decoded object bytes as (info, chunk iterator): SSE-C decrypt
+    runs range-by-range (only covering DARE packages per block, fed
+    from one sequential ciphertext stream) and transparent
+    decompression streams frame-at-a-time, so a consumer holds
+    O(block) however large the object — the chunked successor of the
+    old whole-buffer _fetch_plain."""
     from .. import compress as mtc
     from ..crypto import sse as csse
-    oi = self.srv.layer.get_object_info(bucket, key)
+    if oi is None:
+        oi = self.srv.layer.get_object_info(bucket, key)
     if csse.is_encrypted(oi.user_defined):
         enc = csse.ObjectEncryption.open(
             oi.user_defined, bucket, key, self.headers, self.srv.kms)
-        data = csse.decrypt_object_range(
-            enc, oi.user_defined, oi.size,
-            lambda o, n: self.srv.layer.get_object(
-                bucket, key, o, n)[1], 0, -1, oi.parts)
+        plain_size = csse.decrypted_size(oi.user_defined, oi.size,
+                                         oi.parts)
+
+        def dec_chunks():
+            _, cipher = self.srv.layer.get_object_reader(bucket, key,
+                                                         0, -1)
+            seq = _SeqCipherReader(self.srv.layer, bucket, key,
+                                   iter(cipher))
+            try:
+                off = 0
+                while off < plain_size:
+                    n = min(block, plain_size - off)
+                    yield csse.decrypt_object_range(
+                        enc, oi.user_defined, oi.size, seq.read, off,
+                        n, oi.parts)
+                    off += n
+            finally:
+                seq.close()
+        chunks = dec_chunks()
     else:
-        _, data = self.srv.layer.get_object(bucket, key)
+        _, chunks = self.srv.layer.get_object_reader(bucket, key, 0, -1)
     if mtc.META_COMPRESSION in oi.user_defined:
-        data = mtc.decompress_stream(data)
-    return oi, data
+        chunks = mtc.decompress_chunks(chunks)
+    return oi, chunks
 
 def _check_quota(self, bucket: str, nbytes: int) -> None:
     """Hard-quota admission (cmd/bucket-quota.go); needs the
@@ -367,7 +521,20 @@ def _complete_multipart(self, bucket, key, query, payload):
     # SSE needs no extra bookkeeping here: the part table committed
     # atomically with xl.meta carries per-part ciphertext sizes
     # (each part is its own DARE stream; ObjectInfo.parts)
-    oi = self.srv.layer.complete_multipart_upload(bucket, key, uid, parts)
+    # memory-governor admission: assembly holds AT MOST ONE part in
+    # memory at a time (the erasure layer commits staged part files by
+    # rename; the FS/gateway layers read part-by-part) — charge the
+    # LARGEST part, never the object total, or a multipart object
+    # bigger than the watermark could never complete (memgov.py)
+    from ..utils.memgov import GOVERNOR
+    try:
+        staged = max((p.size for p in self.srv.layer.list_object_parts(
+            bucket, key, uid)), default=0)
+    except Exception:  # noqa: BLE001 — unknown upload: the layer call
+        staged = 0     # below raises the proper S3 error
+    with GOVERNOR.charge(staged, "multipart"):
+        oi = self.srv.layer.complete_multipart_upload(bucket, key, uid,
+                                                      parts)
     out = ET.Element("CompleteMultipartUploadResult", xmlns=S3_NS)
     ET.SubElement(out, "Location").text = \
         f"{self.srv.endpoint}/{bucket}/{key}"
@@ -1231,7 +1398,8 @@ def _check_retention(self, bucket, key, vid) -> None:
 HANDLERS = [
     "_object_api", "_vid", "_object_tagging", "_object_retention",
     "_object_legal_hold", "_governance_bypass", "_select_object",
-    "_fetch_plain", "_check_quota", "_bucket_sse_algo", "_sse_for_put",
+    "_fetch_plain_chunks", "_plain_size_estimate", "_check_quota",
+    "_bucket_sse_algo", "_sse_for_put",
     "_compress_for_put", "_tagging_header_meta", "_create_multipart",
     "_upload_part", "_encrypt_part", "_complete_multipart",
     "_list_parts", "_try_stream_put", "_compression_eligible",

@@ -431,6 +431,17 @@ class S3Server:
         # severing; 0 = sever immediately (the PR-1 behavior)
         self.shutdown_drain_s = _parse_duration(
             self.config.get("api", "shutdown_drain_s") or "5s")
+        # node memory governor (utils/memgov.py): watermark + retry
+        # hint, and the Select scanner block size — all live-reloadable
+        from ..utils import memgov as _memgov
+        _memgov.GOVERNOR.load(self.config)
+        try:
+            self.select_block_bytes = max(
+                64 * 1024,
+                int(self.config.get("api", "select_block_bytes")
+                    or 1 << 20))
+        except ValueError:
+            self.select_block_bytes = 1 << 20
 
     def reload_pipeline_config(self) -> None:
         """Push the ``pipeline`` kvconfig knobs (PUT pipeline depth,
@@ -927,9 +938,74 @@ def _make_handler(srv: S3Server):
                 # second response would corrupt the stream
                 self.close_connection = True
 
+        def _send_chunked(self, status: int, chunks, content_type: str,
+                          headers: dict | None = None,
+                          head: bytes = b""):
+            """Stream an UNKNOWN-length body via chunked transfer
+            encoding (SelectObjectContent event streams — the response
+            length is only known once the scan finishes, and buffering
+            it would defeat the O(block) scanner).  ``head`` is written
+            first (frames accumulated before the caller decided to
+            stream).  A mid-stream failure drops the connection: the
+            missing terminal 0-chunk signals truncation to the client,
+            the chunked-framing analog of the short-body signal in
+            _send_stream."""
+            from ..admin.metrics import GLOBAL as mtr
+            mtr.inc("mt_s3_requests_total",
+                    {"method": self.command, "status": str(status)})
+            self._resp_status = status
+            self._resp_headers = dict(headers or {})
+            if not getattr(self, "_ttfb_ns", 0) and \
+                    getattr(self, "_t0_ns", 0):
+                import time as _time
+                self._ttfb_ns = _time.time_ns() - self._t0_ns
+            self.send_response(status)
+            self.send_header("x-amz-request-id",
+                             getattr(self, "_req_id", None)
+                             or uuid.uuid4().hex[:16])
+            self.send_header("Server", "MinioTPU")
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def write_chunk(data: bytes):
+                self.wfile.write(f"{len(data):x}\r\n".encode())
+                self.wfile.write(data + b"\r\n")
+
+            sent = 0
+            try:
+                if head:
+                    write_chunk(head)
+                    sent += len(head)
+                for chunk in chunks:
+                    if chunk:
+                        write_chunk(chunk)
+                        sent += len(chunk)
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except Exception:   # noqa: BLE001 — headers are gone; drop
+                self.close_connection = True
+            finally:
+                mtr.inc("mt_s3_tx_bytes_total", value=sent)
+                self._resp_bytes = getattr(self, "_resp_bytes", 0) + sent
+
         def _fail(self, e: Exception, resource: str = ""):
             from ..crypto.sse import SSEError
             from ..parallel.dsync import LockLost, LockTimeout
+            from ..utils.memgov import MemoryPressure
+            if isinstance(e, MemoryPressure):
+                # governor shed: same 503 + Retry-After contract as the
+                # request-pool load-shed path — clients back off and
+                # retry instead of watching the node OOM
+                api = s3err.get("SlowDown")
+                return self._send(
+                    api.http_status,
+                    s3err.to_xml(api, resource,
+                                 getattr(self, "_req_id", "") or ""),
+                    headers={"Retry-After":
+                             str(max(1, int(e.retry_after_s)))})
             if isinstance(e, S3Error):
                 api = e.api
             elif isinstance(e, (SSEError, sigv4.SigV4Error)):

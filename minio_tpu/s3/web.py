@@ -487,9 +487,15 @@ def _handle_download(h, srv, path: str, query: dict) -> None:
             info, data = srv.layer.get_object(
                 bucket, key, offset=lo, length=hi - lo + 1)
             status = 206
+            body_gen = None
+            entity = len(data)
         else:
-            info, data = srv.layer.get_object(bucket, key)
-            total = len(data)
+            # full download streams chunk-by-chunk through the layer
+            # reader — a browser pulling a multi-GiB object costs
+            # O(batch), never a whole-object buffer
+            info, body_gen = srv.layer.get_object_reader(bucket, key)
+            data = b""
+            total = entity = info.size
         # header values must never carry CR/LF/quotes from an attacker-
         # chosen object key (response-splitting via percent-encoded keys)
         fname = "".join(c for c in key.rpartition("/")[2]
@@ -497,14 +503,23 @@ def _handle_download(h, srv, path: str, query: dict) -> None:
         h.send_response(status)
         h.send_header("Content-Type",
                       info.content_type or "application/octet-stream")
-        h.send_header("Content-Length", str(len(data)))
+        h.send_header("Content-Length", str(entity))
         if status == 206:
             h.send_header("Content-Range",
                           f"bytes {lo}-{hi}/{total}")
         h.send_header("Content-Disposition",
                       f'attachment; filename="{fname or "download"}"')
         h.end_headers()
-        h.wfile.write(data)
+        if body_gen is not None:
+            try:
+                for chunk in body_gen:
+                    if chunk:
+                        h.wfile.write(chunk)
+            except Exception:  # noqa: BLE001 — headers committed; the
+                # short body vs Content-Length signals truncation
+                h.close_connection = True
+        else:
+            h.wfile.write(data)
     except (WebError, oli.ObjectLayerError) as e:
         status = 401 if isinstance(e, AuthError) else 404
         if h.command == "HEAD":
@@ -569,8 +584,16 @@ def _handle_zip(h, srv, query: dict, payload: bytes) -> None:
         with zipfile.ZipFile(_CountingWriter(h.wfile), "w",
                              zipfile.ZIP_DEFLATED) as zf:
             for name in names:
-                _, data = srv.layer.get_object(bucket, name)
-                zf.writestr(name[len(prefix):] or name, data)
+                # stream each member through the layer reader into the
+                # archive — one CHUNK resident at a time, so zipping a
+                # prefix of multi-GiB objects stays O(batch)
+                _, body = srv.layer.get_object_reader(bucket, name)
+                zi = zipfile.ZipInfo(name[len(prefix):] or name,
+                                     date_time=time.localtime()[:6])
+                zi.compress_type = zipfile.ZIP_DEFLATED
+                with zf.open(zi, "w") as zb:
+                    for chunk in body:
+                        zb.write(chunk)
         h.close_connection = True
     except (WebError, oli.ObjectLayerError) as e:
         if headers_sent:

@@ -13,9 +13,9 @@ the compiled query, frame the output.
 
 from __future__ import annotations
 
-import gzip
 import xml.etree.ElementTree as ET
 
+from ..utils import close_quietly
 from . import message, records, sql
 
 
@@ -39,13 +39,16 @@ class SelectRequest:
 
     def __init__(self, expression: str, input_format: str,
                  input_opts: dict, output_format: str, output_opts: dict,
-                 compression: str):
+                 compression: str, progress: bool = False):
         self.expression = expression
         self.input_format = input_format      # "CSV" | "JSON"
         self.input_opts = input_opts
         self.output_format = output_format    # "CSV" | "JSON"
         self.output_opts = output_opts
         self.compression = compression    # "NONE" | "GZIP" | "BZIP2"
+        # RequestProgress.Enabled (select.go:114 parseRequestProgress):
+        # periodic Progress frames ride the stream only when asked
+        self.progress = progress
 
     @classmethod
     def parse(cls, payload: bytes) -> "SelectRequest":
@@ -102,12 +105,15 @@ class SelectRequest:
                 "record_delim": _text(ocsv, "RecordDelimiter", "\n"),
                 "quote": _text(ocsv, "QuoteCharacter", '"'),
             }
-        return cls(expr, fmt, opts, ofmt, oopts, compression)
+        prog = root.find("RequestProgress")
+        progress = _text(prog, "Enabled", "FALSE").upper() == "TRUE"
+        return cls(expr, fmt, opts, ofmt, oopts, compression, progress)
 
 
-def _try_json_fast_path(query, data: bytes, input_opts: dict):
-    """Reader over only the rows the C scanner kept, or None when the
-    WHERE isn't the simple comparison shape the scanner handles."""
+def _fast_filter_params(query) -> tuple[str, str, object] | None:
+    """(field, op, literal) for the native NDJSON prefilter, or None
+    when the WHERE isn't the simple comparison shape the C scanner
+    handles (native/jsonscan.cc)."""
     w = query.where
     if not isinstance(w, sql.Binary) or w.op not in records._OPS:
         return None
@@ -129,86 +135,264 @@ def _try_json_fast_path(query, data: bytes, input_opts: dict):
     if _re.fullmatch(r"_\d+", path[0]):
         return None                    # positional column: evaluator
                                        # resolves by index, not by key
-    spans = records.ndjson_prefilter(data, path[0], op, lit.value)
-    if spans is None:
-        return None
-
-    def rows():
-        for lo, hi in spans:
-            line = data[lo:hi].strip()
-            if line:
-                yield records._wrap(records._json.loads(
-                    line.decode("utf-8", errors="replace")))
-    return rows()
+    return path[0], op, lit.value
 
 
-def run_select(payload: bytes, data: bytes) -> bytes:
-    """Execute a SelectObjectContentRequest against object bytes; returns
-    the framed event-stream response body."""
+class _ScanState:
+    """Live byte counters the pipeline wrappers tick as blocks flow —
+    the source of Progress/Stats numbers."""
+
+    __slots__ = ("scanned", "processed", "returned")
+
+    def __init__(self):
+        self.scanned = 0      # stored (possibly compressed) bytes read
+        self.processed = 0    # bytes after object-level decompression
+        self.returned = 0     # output payload bytes emitted
+
+
+def _counted(chunks, st: _ScanState, attr: str):
+    """Pass chunks through, adding their lengths to one counter."""
+    try:
+        for c in chunks:
+            setattr(st, attr, getattr(st, attr) + len(c))
+            yield c
+    finally:
+        close_quietly(chunks)
+
+
+def _gunzip_chunks(chunks):
+    """Streaming multi-member gzip decode; empty/truncated/corrupt
+    input raises SelectError exactly where ``gzip.decompress`` would
+    have (the buffered reference semantics)."""
+    import zlib
+    d = zlib.decompressobj(31)
+    fed = False                 # current member has received bytes
+    members = 0
+    try:
+        for c in chunks:
+            data = c
+            while data:
+                try:
+                    out = d.decompress(data)
+                except zlib.error as e:
+                    raise SelectError("InvalidCompressionFormat") from e
+                fed = True
+                if out:
+                    yield out
+                if d.eof:
+                    members += 1
+                    data = d.unused_data
+                    d = zlib.decompressobj(31)
+                    fed = False
+                else:
+                    data = b""
+        if members == 0 or fed:
+            # no complete stream at all, or one ended mid-member —
+            # gzip.decompress raises EOFError for both
+            raise SelectError("InvalidCompressionFormat")
+    finally:
+        close_quietly(chunks)
+
+
+def _bunzip_chunks(chunks):
+    """Streaming (possibly concatenated) bzip2 decode, matching
+    ``bz2.decompress``: empty input is empty output, garbage AFTER a
+    complete stream is ignored, a stream ending mid-member errors."""
+    import bz2
+    d = bz2.BZ2Decompressor()
+    fed = False
+    members = 0
+    try:
+        for c in chunks:
+            data = c
+            while data:
+                try:
+                    out = d.decompress(data)
+                except (OSError, ValueError, EOFError) as e:
+                    if members:
+                        return      # trailing garbage: ignored
+                    raise SelectError("InvalidCompressionFormat") from e
+                fed = True
+                if out:
+                    yield out
+                if d.eof:
+                    members += 1
+                    data = d.unused_data
+                    d = bz2.BZ2Decompressor()
+                    fed = False
+                else:
+                    data = b""
+        if fed:
+            raise SelectError("InvalidCompressionFormat")
+    finally:
+        close_quietly(chunks)
+
+
+def _json_lines_rows(block: bytes, opts: dict, fastp):
+    """Rows of one JSON-Lines block: the C prefilter keeps candidate
+    lines when the WHERE fits its shape (the full WHERE still runs on
+    survivors downstream, so semantics are unchanged); otherwise every
+    line parses."""
+    if fastp is not None:
+        spans = records.ndjson_prefilter(block, *fastp)
+        if spans is not None:
+            for lo, hi in spans:
+                line = block[lo:hi].strip()
+                if line:
+                    yield records._wrap(records._json.loads(
+                        line.decode("utf-8", errors="replace")))
+            return
+    yield from records.json_records(block, opts)
+
+
+def _rechunk(chunks, n: int):
+    """Split oversized pieces so downstream blocks stay <= n bytes —
+    a non-streaming layer (the ObjectLayer default reader yields the
+    whole object as one chunk) must not defeat the record splitter's
+    memory bound."""
+    try:
+        for c in chunks:
+            if len(c) <= n:
+                yield c
+            else:
+                for off in range(0, len(c), n):
+                    yield bytes(c[off:off + n])
+    finally:
+        close_quietly(chunks)
+
+
+def _record_reader(req: SelectRequest, query, blocks):
+    """One continuous record stream over complete-record blocks —
+    what sql.execute consumes."""
+    if req.input_format == "CSV":
+        yield from records.csv_records_stream(blocks, req.input_opts)
+        return
+    fastp = _fast_filter_params(query)
+    for block in blocks:
+        yield from _json_lines_rows(block, req.input_opts, fastp)
+
+
+# Records event payload cap (message.go maxRecordSize): the buffered
+# reference chunked its whole output at these boundaries, and the
+# incremental framer reproduces them exactly — byte-identical streams
+RECORDS_CHUNK = 1 << 20
+# scanned-byte interval between periodic Progress frames (when the
+# request asked); each is preceded by a Cont keep-alive frame
+PROGRESS_INTERVAL = 8 << 20
+
+
+def run_select_stream(payload: bytes, chunks, *,
+                      block_bytes: int = 1 << 20,
+                      on_stats=None):
+    """Incremental SelectObjectContentRequest scanner: pulls decoded
+    object bytes from ``chunks`` block-at-a-time, feeds record
+    splitting and the query, and yields framed events as the scan
+    advances — peak memory O(block) regardless of object size
+    (select.go:398 Evaluate record loop).
+
+    Request/SQL parse errors raise :exc:`SelectError` eagerly, before
+    the first frame; reader errors surface as SelectError from the
+    generator mid-iteration (the handler turns them into a 400 when
+    nothing was sent yet, an error frame when the stream is live).
+    ``on_stats(scanned, processed, returned)`` fires before the Stats
+    frame.  JSON DOCUMENT and Parquet inputs need random access /
+    whole-value parses and fall back to materializing the object."""
     req = SelectRequest.parse(payload)
-    bytes_scanned = len(data)        # compressed bytes read from storage
-    if req.compression == "GZIP":
-        try:
-            data = gzip.decompress(data)
-        except (OSError, EOFError) as e:   # EOFError: truncated stream
-            raise SelectError("InvalidCompressionFormat") from e
-    elif req.compression == "BZIP2":
-        # pkg/s3select/select.go:310 accepts bzip2Type the same way
-        import bz2
-        try:
-            data = bz2.decompress(data)
-        except (OSError, ValueError, EOFError) as e:
-            raise SelectError("InvalidCompressionFormat") from e
     try:
         query = sql.parse_query(req.expression)
     except sql.SQLError as e:
         raise SelectError("ParseSelectFailure", str(e)) from e
-    if req.input_format == "CSV":
-        reader = records.csv_records(data, req.input_opts)
-    elif req.input_format == "PARQUET":
-        from . import parquet as pq
-        try:
-            reader = pq.parquet_records(data)
-        except pq.ParquetError as e:
-            raise SelectError("InvalidDataSource", str(e)) from e
+    return _frames(req, query, chunks, block_bytes, on_stats)
+
+
+def _frames(req: SelectRequest, query, chunks, block_bytes: int,
+            on_stats):
+    st = _ScanState()
+    src = _counted(chunks, st, "scanned")
+    if req.compression == "GZIP":
+        src = _gunzip_chunks(src)
+    elif req.compression == "BZIP2":
+        src = _bunzip_chunks(src)
+    src = _counted(src, st, "processed")
+    if block_bytes > 0:
+        src = _rechunk(src, block_bytes)
+
+    if req.input_format == "PARQUET" or (
+            req.input_format == "JSON" and
+            req.input_opts.get("type", "LINES") != "LINES"):
+        # whole-value inputs: Parquet needs footer-first random access,
+        # a JSON DOCUMENT is one value — materialize (the documented
+        # non-streaming fallback; CSV and JSON Lines stay O(block))
+        data = b"".join(src)
+        if req.input_format == "PARQUET":
+            from . import parquet as pq
+            try:
+                reader = pq.parquet_records(data)
+            except pq.ParquetError as e:
+                raise SelectError("InvalidDataSource", str(e)) from e
+        else:
+            reader = records.json_records(data, req.input_opts)
     else:
-        reader = records.json_records(data, req.input_opts)
-        # simdjson-role fast path (native/jsonscan.cc): a WHERE of the
-        # form <top-level field> <op> <literal> over JSON LINES scans
-        # the raw bytes in C and parses only candidate rows; the full
-        # WHERE still runs on survivors, so semantics are unchanged
-        if req.input_opts.get("type", "LINES") == "LINES":
-            fast = _try_json_fast_path(query, data, req.input_opts)
-            if fast is not None:
-                reader = fast
+        quote = None
+        delim = b"\n"
+        if req.input_format == "CSV":
+            delim = (req.input_opts.get("record_delim") or "\n").encode()
+            q = req.input_opts.get("quote", '"')
+            quote = q.encode() if q else None
+        fdelim = (req.input_opts.get("field_delim") or ",").encode() \
+            if req.input_format == "CSV" else b","
+        blocks = records.record_blocks(src, delim, quote, fdelim)
+        reader = _record_reader(req, query, blocks)
 
-    bytes_processed = len(data)      # bytes after decompression
-    out_payload = bytearray()
-    returned = 0
+    pending = bytearray()
+    last_progress = 0
     try:
-        rows = sql.execute(query, reader)
-        for row in rows:
-            if req.output_format == "JSON":
-                rec = records.to_json_record(row, req.output_opts)
-            else:
-                rec = records.to_csv_record(row, req.output_opts)
-            out_payload += rec
-            returned += len(rec)
-    except sql.SQLError as e:
-        raise SelectError("EvaluatorInvalidArguments", str(e)) from e
-    except (ValueError, TypeError, KeyError) as e:
-        # reader parse failures surface mid-iteration (generators):
-        # malformed input is a 400 parse error, never a 500
-        code = {"JSON": "JSONParsingError",
-                "PARQUET": "InvalidDataSource"}.get(
-            req.input_format, "CSVParsingError")
-        raise SelectError(code, str(e)) from e
+        try:
+            rows = sql.execute(query, reader)
+            for row in rows:
+                if req.output_format == "JSON":
+                    rec = records.to_json_record(row, req.output_opts)
+                else:
+                    rec = records.to_csv_record(row, req.output_opts)
+                pending += rec
+                st.returned += len(rec)
+                while len(pending) >= RECORDS_CHUNK:
+                    yield message.records_event(
+                        bytes(pending[:RECORDS_CHUNK]))
+                    del pending[:RECORDS_CHUNK]
+                if req.progress and \
+                        st.scanned - last_progress >= PROGRESS_INTERVAL:
+                    last_progress = st.scanned
+                    yield message.continuation_event()
+                    yield message.progress_event(
+                        st.scanned, st.processed, st.returned)
+        except sql.SQLError as e:
+            raise SelectError("EvaluatorInvalidArguments", str(e)) from e
+        except (ValueError, TypeError, KeyError) as e:
+            # reader parse failures surface mid-iteration (generators):
+            # malformed input is a 400 parse error, never a 500
+            code = {"JSON": "JSONParsingError",
+                    "PARQUET": "InvalidDataSource"}.get(
+                req.input_format, "CSVParsingError")
+            raise SelectError(code, str(e)) from e
+        if pending:
+            yield message.records_event(bytes(pending))
+        if req.progress:
+            yield message.progress_event(st.scanned, st.processed,
+                                         st.returned)
+        if on_stats is not None:
+            on_stats(st.scanned, st.processed, st.returned)
+        yield message.stats_event(st.scanned, st.processed, st.returned)
+        yield message.end_event()
+    finally:
+        close_quietly(src)
 
-    frames = bytearray()
-    # chunk Records payload into <=1 MiB events (message.go maxRecordSize)
-    CHUNK = 1 << 20
-    for off in range(0, len(out_payload), CHUNK):
-        frames += message.records_event(bytes(out_payload[off:off + CHUNK]))
-    frames += message.stats_event(bytes_scanned, bytes_processed, returned)
-    frames += message.end_event()
-    return bytes(frames)
+
+def run_select(payload: bytes, data: bytes) -> bytes:
+    """Execute a SelectObjectContentRequest against object bytes;
+    returns the framed event-stream response body.  One join over the
+    incremental scanner — the whole-buffer path and the streaming path
+    ARE the same code, so their outputs are byte-identical by
+    construction (pinned anyway by tests/test_select_stream.py).
+    block_bytes=0: the single whole-buffer chunk is not re-split."""
+    return b"".join(run_select_stream(payload, (data,), block_bytes=0))

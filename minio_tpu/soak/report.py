@@ -77,6 +77,11 @@ _SOAK_ENV = {
     "MT_RPC_BREAKER_COOLDOWN": "200ms",
     "MT_RPC_RETRY_ATTEMPTS": "1",
     "MT_API_SHUTDOWN_DRAIN_S": "5s",
+    # memory-governor watermark for the matrix: generous enough that
+    # the mixes run, low enough that a leak or an unbounded path would
+    # pile charges into visible sheds / a non-zero inuse residue the
+    # memory SLO rows catch (soak/slo.py require_mem_bounded)
+    "MT_API_MEM_LIMIT": "256MiB",
 }
 
 
@@ -113,13 +118,19 @@ def default_matrix(duration_s: float = 15.0) -> list[Scenario]:
     out = []
     for mix in MIXES.values():
         storm = mix.name == "small_object_storm"
+        # the bounded-memory storms (streaming Select over multi-block
+        # objects, listing over a wide namespace) run with doubled
+        # workers under the governor watermark and assert the memory
+        # SLO rows on the live scrape
+        membound = mix.name in ("select_storm", "listing_storm")
         out.append(Scenario(
             name=mix.name, mix=mix,
             timeline=_chaos_timeline(duration_s),
             duration_s=duration_s,
             budget=_slo.Budget(max_error_rate=0.10,
-                               require_codec_occupancy=storm),
-            workers=4 if storm else 2,
+                               require_codec_occupancy=storm,
+                               require_mem_bounded=membound),
+            workers=4 if storm or membound else 2,
             backend="tpu" if storm else "numpy"))
     return out
 

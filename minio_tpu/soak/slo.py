@@ -62,6 +62,12 @@ class Budget:
     # batcher (the small-object storm) assert a non-zero
     # mt_codec_batch_occupancy on the live scrape
     require_codec_occupancy: bool = False
+    # bounded-memory scenarios (Select/listing storms under a governor
+    # watermark) assert the memory SLO from the live scrape: every
+    # charge released (mt_mem_inuse_bytes back to zero) and governor
+    # sheds under the error-rate ceiling (shed 503s are retried by the
+    # client schedule, so the ceiling bounds pressure, not failures)
+    require_mem_bounded: bool = False
 
     def limits_for(self, api: str) -> tuple[float, float]:
         return self.per_api_ms.get(api, (self.p50_ms, self.p99_ms))
@@ -339,6 +345,20 @@ def evaluate(scenario: str, *, api_stats=None, api_pcts=None, recorder,
         row("codec_batch_occupancy", round(occ, 1), "requests",
             occ > 0, {"family": "mt_codec_batch_occupancy",
                       "dispatches": disp})
+
+    # bounded-memory SLO: the governor's outstanding charges settled
+    # back to zero (no leaked Select scanner / listing walk holds
+    # bytes) and shedding stayed under the ceiling relative to traffic
+    if budget.require_mem_bounded:
+        inuse = metric_total(scrape_text, "mt_mem_inuse_bytes")
+        row("mem_inuse_settled", inuse, "bytes", inuse == 0,
+            {"family": "mt_mem_inuse_bytes"})
+        shed = metric_total(scrape_text, "mt_mem_shed_total")
+        ops = max(1, recorder.ops())
+        row("mem_shed_rate", round(shed / ops, 4), "ratio",
+            shed / ops <= budget.max_error_rate,
+            {"shed": shed, "ops": ops,
+             "budget": budget.max_error_rate})
 
     # heal convergence: MRF drained + classify_disks clean on all sets
     if convergence is not None:

@@ -25,11 +25,17 @@ from dataclasses import dataclass, field
 from ..admin.metrics import GLOBAL as _metrics
 from ..s3.client import S3Client, S3ClientError
 
-# CSV payload for the Select mix (pkg/s3select test corpus shape)
-_SELECT_CSV = (b"name,age,city\n" +
-               b"".join(f"user{i},{20 + i % 50},"
-                        f"{'paris' if i % 3 == 0 else 'tokyo'}\n"
-                        .encode() for i in range(64)))
+# CSV payload for the Select mixes (pkg/s3select test corpus shape);
+# the storm mix scales the row count so the streaming scanner actually
+# streams (multiple scanner blocks per query)
+def _select_csv(rows: int) -> bytes:
+    return (b"name,age,city\n" +
+            b"".join(f"user{i},{20 + i % 50},"
+                     f"{'paris' if i % 3 == 0 else 'tokyo'}\n"
+                     .encode() for i in range(rows)))
+
+
+_SELECT_CSV = _select_csv(64)
 
 _SELECT_BODY = (
     b'<?xml version="1.0" encoding="UTF-8"?>'
@@ -58,6 +64,7 @@ class Mix:
     multipart_parts: int = 2
     part_bytes: int = 5 * 1024 * 1024      # S3 minimum (last part exempt)
     key_space: int = 8                     # object pool per worker
+    select_rows: int = 64                  # rows in the Select corpus
 
 
 # the production mixes from ROADMAP item 5
@@ -85,6 +92,19 @@ MIXES: dict[str, Mix] = {m.name: m for m in (
     Mix("small_object_storm",
         {"put": 0.45, "get": 0.45, "head": 0.10},
         sizes_bytes=(512, 2048, 8192), key_space=16),
+    # bounded-memory robustness mixes (the streaming-Select + streamed-
+    # metacache tentpole): the Select storm scans a multi-block CSV per
+    # query (the streaming scanner's target shape — "multi-GiB-class"
+    # behavior is fenced separately by the tier-1 tracemalloc test) and
+    # the listing storm pages a wide namespace; the matrix runs both
+    # under a memory-governor watermark and asserts the memory SLO
+    # (inuse settles to zero, sheds stay under the error ceiling)
+    Mix("select_storm",
+        {"select": 0.65, "put": 0.20, "get": 0.15},
+        sizes_bytes=(4096, 16384), select_rows=20000),
+    Mix("listing_storm",
+        {"list": 0.65, "put": 0.25, "head": 0.10},
+        sizes_bytes=(1024, 4096), key_space=48),
 )}
 
 
@@ -261,7 +281,8 @@ class Worker(threading.Thread):
                        error=err, tx=len(body))
         if "select" in self.gen.mix.weights:
             c.put_object(self.gen.bucket, f"{self.prefix}/sel.csv",
-                         _SELECT_CSV, content_type="text/csv")
+                         _select_csv(self.gen.mix.select_rows),
+                         content_type="text/csv")
 
     def run(self) -> None:
         rec = self.gen.recorder

@@ -807,8 +807,15 @@ class XLStorage(StorageAPI):
 
     def walk_dir(self, volume: str, base_dir: str = "",
                  recursive: bool = True) -> Iterable[str]:
-        """Yield object paths (dirs containing xl.meta) under base_dir,
-        lexically sorted (cmd/metacache-walk.go WalkDir)."""
+        """Yield object paths (dirs containing xl.meta) under base_dir
+        in FLAT key order — the UTF-8 binary order S3 listings promise
+        (cmd/metacache-walk.go WalkDir, which sorts dir entries with a
+        trailing-slash key for the same reason): a subtree "x" emits
+        keys "x/...", which must sort AFTER a sibling object "x-1"
+        ('-' < '/'), so siblings order by ``name + "/"`` for subtrees
+        and plain ``name`` for leaf objects.  Per-drive streams being
+        globally sorted is what lets the listing layer k-way-merge
+        them lazily instead of materializing the namespace."""
         vol = self._check_vol(volume)
         base = self._file_path(volume, base_dir) if base_dir else vol
 
@@ -821,9 +828,15 @@ class XLStorage(StorageAPI):
             if META_FILE in names:
                 yield os.path.relpath(d, vol).replace(os.sep, "/")
                 return
+            keyed = []
             for e in entries:
-                if e.is_dir() and recursive:
-                    yield from walk(e.path)
+                if not e.is_dir():
+                    continue
+                leaf = os.path.isfile(os.path.join(e.path, META_FILE))
+                keyed.append((e.name if leaf else e.name + "/", e.path))
+            for _, path in sorted(keyed):
+                if recursive:
+                    yield from walk(path)
 
         yield from walk(base)
 

@@ -1,0 +1,16 @@
+"""Shared small utilities."""
+
+from __future__ import annotations
+
+
+def close_quietly(it) -> None:
+    """Close an iterator/generator if it supports close(), swallowing
+    teardown errors — the one definition of the finally-block every
+    streaming pipeline stage (readers, decompressors, re-chunkers)
+    uses to propagate early termination to its source."""
+    close = getattr(it, "close", None)
+    if close is not None:
+        try:
+            close()
+        except Exception:  # noqa: BLE001 — source already failing
+            pass

@@ -82,6 +82,20 @@ register_subsys("api", {
     # immediate-sever behavior.  Live-reloadable (reload_api_config).
     "shutdown_drain_s": "5s",
     "cors_allow_origin": "*",
+    # node memory governor (utils/memgov.py): memory-hungry request
+    # paths (Select scanners, listing walks, multipart assembly) charge
+    # bounded working-set estimates; a charge pushing the node past
+    # ``mem_limit`` is shed with 503 + Retry-After (``mem_retry_after``)
+    # instead of allocating toward an OOM.  0 disables admission
+    # (charges stay accounted for the mt_mem_* scrape families).
+    # Sizes accept 268435456 / 256MiB / 1GiB.  Live-reloadable
+    # (reload_api_config on admin SetConfigKV).
+    "mem_limit": "0",
+    "mem_retry_after": "1s",
+    # streaming S3 Select scanner block (s3select/__init__.py): decoded
+    # object bytes are pulled and scanned this many bytes at a time;
+    # peak Select memory is O(a few blocks) regardless of object size
+    "select_block_bytes": "1048576",
 })
 register_subsys("rpc", {
     # node-level circuit breaker (parallel/rpc.py CircuitBreaker):

@@ -1,0 +1,183 @@
+"""Node memory governor — admission control for unbounded-ish work.
+
+The data plane bounds its own memory per request (PUT pipeline
+O(depth x batch), GET O(batch), RPC streaming O(chunk), Select
+O(block), listing O(block)), but a node serving MANY such requests at
+once can still exceed what the host has.  The governor is the
+cluster-facing admission layer on top: every memory-hungry request
+path (Select scanners, listing walks, multipart assembly) charges its
+bounded working-set estimate here before allocating, and a charge that
+would push the node past the configured watermark is refused with
+:class:`MemoryPressure` — the S3 frontend turns that into a 503 +
+``Retry-After`` through the PR-1 load-shed path, so pressure degrades
+into polite shedding instead of an OOM kill (the role maxClients +
+deadline play in cmd/handler-api.go, extended to bytes).
+
+Semantics:
+
+* ``limit_bytes == 0`` disables admission entirely (charges are still
+  accounted, so ``mt_mem_{inuse,peak}_bytes`` stay observable);
+* charges are cheap integer bookkeeping — the governor never measures
+  the heap, it trusts the bounded estimates the charging sites derive
+  from their own block/depth knobs;
+* every charge is a context manager / explicit release, so a dying
+  request (client disconnect, handler exception) always returns its
+  bytes — asserted by tests/test_leaks.py.
+
+Knobs live in the ``api`` kvconfig subsystem (``mem_limit``,
+``mem_retry_after``) and are pushed live by
+``S3Server.reload_api_config`` on admin SetConfigKV.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..admin.metrics import GLOBAL as _metrics
+
+
+class MemoryPressure(Exception):
+    """Raised when a charge would exceed the configured watermark; the
+    S3 layer maps it to 503 SlowDown + Retry-After."""
+
+    def __init__(self, kind: str, want: int, inuse: int, limit: int,
+                 retry_after_s: float = 1.0):
+        super().__init__(
+            f"memory governor: {kind} charge of {want} B refused "
+            f"({inuse} B in use, limit {limit} B)")
+        self.kind = kind
+        self.want = want
+        self.inuse = inuse
+        self.limit = limit
+        self.retry_after_s = retry_after_s
+
+
+def parse_size(s: str, default: int = 0) -> int:
+    """'268435456' / '256MiB' / '1GiB' -> bytes (config size keys)."""
+    s = (s or "").strip()
+    mult = 1
+    for suffix, m in (("KiB", 1 << 10), ("MiB", 1 << 20),
+                      ("GiB", 1 << 30), ("KB", 10 ** 3), ("MB", 10 ** 6),
+                      ("GB", 10 ** 9), ("K", 1 << 10), ("M", 1 << 20),
+                      ("G", 1 << 30), ("B", 1)):
+        if s.endswith(suffix):
+            s, mult = s[:-len(suffix)], m
+            break
+    try:
+        return int(float(s) * mult)
+    except ValueError:
+        return default
+
+
+class Charge:
+    """One request's outstanding reservation; release is idempotent."""
+
+    __slots__ = ("_gov", "kind", "nbytes", "_released")
+
+    def __init__(self, gov: "MemoryGovernor", kind: str, nbytes: int):
+        self._gov = gov
+        self.kind = kind
+        self.nbytes = nbytes
+        self._released = False
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._gov._release(self.kind, self.nbytes)
+
+    def __enter__(self) -> "Charge":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __del__(self):  # abandoned mid-request: never leak the bytes
+        self.release()
+
+
+class MemoryGovernor:
+    """Watermark-based byte accounting shared by every charging site."""
+
+    def __init__(self, limit_bytes: int = 0, retry_after_s: float = 1.0):
+        # REENTRANT: Charge.__del__ releases via this lock, and cyclic
+        # GC can fire inside a locked section on the same thread (an
+        # allocation under charge()/stats() collecting a leaked
+        # Charge) — a plain Lock would self-deadlock the request
+        # thread; RLock makes the nested release safe
+        self._mu = threading.RLock()
+        self.limit_bytes = limit_bytes
+        self.retry_after_s = retry_after_s
+        self._inuse: dict[str, int] = {}
+        self._peak = 0
+        self._shed: dict[str, int] = {}
+
+    def configure(self, limit_bytes: int,
+                  retry_after_s: float | None = None) -> None:
+        with self._mu:
+            self.limit_bytes = max(0, int(limit_bytes))
+            if retry_after_s is not None:
+                self.retry_after_s = max(0.0, float(retry_after_s))
+
+    # -- accounting --------------------------------------------------------
+
+    def charge(self, nbytes: int, kind: str = "other") -> Charge:
+        """Reserve ``nbytes`` for one request; raises MemoryPressure
+        when the node is past its watermark (shed, don't allocate)."""
+        nbytes = max(0, int(nbytes))
+        with self._mu:
+            inuse = sum(self._inuse.values())
+            if self.limit_bytes and inuse + nbytes > self.limit_bytes:
+                self._shed[kind] = self._shed.get(kind, 0) + 1
+                retry = self.retry_after_s
+                _metrics.inc("mt_mem_shed_total", {"kind": kind})
+                raise MemoryPressure(kind, nbytes, inuse,
+                                     self.limit_bytes, retry)
+            self._inuse[kind] = self._inuse.get(kind, 0) + nbytes
+            self._peak = max(self._peak, inuse + nbytes)
+        return Charge(self, kind, nbytes)
+
+    def _release(self, kind: str, nbytes: int) -> None:
+        with self._mu:
+            cur = self._inuse.get(kind, 0) - nbytes
+            if cur > 0:
+                self._inuse[kind] = cur
+            else:
+                self._inuse.pop(kind, None)
+
+    # -- observability -----------------------------------------------------
+
+    def inuse_bytes(self, kind: str | None = None) -> int:
+        with self._mu:
+            if kind is not None:
+                return self._inuse.get(kind, 0)
+            return sum(self._inuse.values())
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"limit_bytes": self.limit_bytes,
+                    "inuse": dict(self._inuse),
+                    "peak_bytes": self._peak,
+                    "shed": dict(self._shed)}
+
+    @property
+    def touched(self) -> bool:
+        """Whether the governor has anything worth scraping (the idle
+        contract: an unconfigured, uncharged governor emits nothing)."""
+        with self._mu:
+            return bool(self.limit_bytes or self._peak or self._shed)
+
+    def load(self, config) -> None:
+        """Pull the ``api`` kvconfig knobs (mem_limit, mem_retry_after)
+        — called from S3Server.reload_api_config so admin SetConfigKV
+        retunes the watermark on a live server."""
+        from .kvconfig import parse_duration
+        limit = parse_size(config.get("api", "mem_limit"), 0)
+        retry = parse_duration(config.get("api", "mem_retry_after")
+                               or "1s", 1.0)
+        self.configure(limit, retry)
+
+
+# process-global governor: one node = one memory budget, shared by
+# every server/layer in the process (exactly like the codec batcher
+# and the RPC streaming plane)
+GOVERNOR = MemoryGovernor()

@@ -156,6 +156,87 @@ def test_slowloris_header_cut_at_deadline(s3_server):
         s.close()
 
 
+def test_select_stream_proxy_reset_releases_scanner(tmp_path):
+    """FaultyProxy reset mid-Select-event-stream (the satellite drill):
+    the connection dies between Records frames; the server's scanner
+    stops and its memory-governor charge drains — the frontend twin of
+    the internode mid-frame reset drills below."""
+    from minio_tpu.objectlayer.erasure_object import ErasureObjects
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.s3.sigv4 import Credentials, sign_request
+    from minio_tpu.storage.xl_storage import XLStorage
+    from minio_tpu.utils.memgov import GOVERNOR
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"sxd{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=256 * 1024,
+                           backend="numpy")
+    srv = S3Server(layer, access_key="testkey", secret_key="testsecret")
+    srv.start()
+    proxy = FaultyProxy("127.0.0.1", srv.port).start()
+    try:
+        c = S3Client(srv.endpoint, "testkey", "testsecret")
+        c.make_bucket("chsel")
+        row = b"alpha,beta,gamma-some-padding-for-size\n"
+        data = row * ((6 << 20) // len(row))
+        c.put_object("chsel", "big.csv", data)
+        body = (
+            b'<?xml version="1.0"?><SelectObjectContentRequest '
+            b'xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+            b"<Expression>SELECT * FROM S3Object</Expression>"
+            b"<ExpressionType>SQL</ExpressionType>"
+            b"<InputSerialization><CSV/></InputSerialization>"
+            b"<OutputSerialization><CSV/></OutputSerialization>"
+            b"</SelectObjectContentRequest>")
+        path = "/chsel/big.csv?select&select-type=2"
+        # sign against the REAL endpoint; send through the proxy, which
+        # resets the wire after ~128 KiB of response crossed it
+        hdrs = sign_request(Credentials("testkey", "testsecret"),
+                            "POST", srv.endpoint + path, {}, body,
+                            "us-east-1")
+        proxy.program(proxy.connections_seen() + 1,
+                      Fault.reset(after_bytes=128 * 1024))
+        import http.client
+        conn = http.client.HTTPConnection("127.0.0.1", proxy.port,
+                                          timeout=30)
+        try:
+            conn.request("POST", path, body=body, headers=hdrs)
+            with pytest.raises((ConnectionError, http.client.HTTPException,
+                                TimeoutError, OSError)):
+                resp = conn.getresponse()
+                while resp.read(65536):
+                    pass
+                raise ConnectionResetError("stream ended short")
+        finally:
+            conn.close()
+        deadline = time.monotonic() + 15.0
+        while GOVERNOR.inuse_bytes("select") and \
+                time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert GOVERNOR.inuse_bytes("select") == 0, GOVERNOR.stats()
+        # the link heals: the same query completes through the proxy
+        hdrs2 = sign_request(Credentials("testkey", "testsecret"),
+                             "POST", srv.endpoint + path, {}, body,
+                             "us-east-1")
+        conn2 = http.client.HTTPConnection("127.0.0.1", proxy.port,
+                                           timeout=60)
+        try:
+            conn2.request("POST", path, body=body, headers=hdrs2)
+            resp2 = conn2.getresponse()
+            assert resp2.status == 200
+            out = resp2.read()
+        finally:
+            conn2.close()
+        from minio_tpu.s3select import message as sel_msg
+        assert sel_msg.parse_events(out)[-1][0] == "End"
+    finally:
+        proxy.stop()
+        srv.stop()
+
+
 def test_slow_body_cut_with_408_while_traffic_flows(s3_server):
     """The acceptance scenario: a trickling body is cut at the absolute
     body deadline with 408 RequestTimeout, while concurrent PUT/GET on

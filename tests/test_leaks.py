@@ -57,6 +57,66 @@ def test_server_start_stop_does_not_leak_threads(tmp_path):
             s.close()
 
 
+def test_select_disconnect_releases_governor_and_threads(tmp_path):
+    """Client disconnect mid-Select-stream (the satellite drill): the
+    scanner stops, its readahead plane winds down, and the memory
+    governor's charge is released — no surviving scanner threads, no
+    residual ``inuse_bytes``."""
+    import http.client
+
+    from minio_tpu.s3.sigv4 import Credentials, sign_request
+    from minio_tpu.utils.memgov import GOVERNOR
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"sd{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                           backend="numpy")
+    srv = S3Server(layer, access_key="lk", secret_key="ls")
+    srv.start()
+    try:
+        c = S3Client(srv.endpoint, "lk", "ls")
+        c.make_bucket("selleak")
+        row = b"col1,col2,col3-some-padding-bytes\n"
+        data = row * ((6 << 20) // len(row))     # output > flush bytes
+        c.put_object("selleak", "big.csv", data)
+        body = (
+            b'<?xml version="1.0"?><SelectObjectContentRequest '
+            b'xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+            b"<Expression>SELECT * FROM S3Object</Expression>"
+            b"<ExpressionType>SQL</ExpressionType>"
+            b"<InputSerialization><CSV/></InputSerialization>"
+            b"<OutputSerialization><CSV/></OutputSerialization>"
+            b"</SelectObjectContentRequest>")
+        baseline = _settled_thread_count()
+        assert GOVERNOR.inuse_bytes("select") == 0
+        path = "/selleak/big.csv?select&select-type=2"
+        hdrs = sign_request(Credentials("lk", "ls"), "POST",
+                            srv.endpoint + path, {}, body, "us-east-1")
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port,
+                                          timeout=30)
+        try:
+            conn.request("POST", path, body=body, headers=hdrs)
+            resp = conn.getresponse()
+            assert resp.status == 200
+            got = resp.read(1024)           # a slice of the stream...
+            assert got
+        finally:
+            conn.close()                    # ...then hang up mid-frame
+        # the dying handler must release its charge and its threads
+        deadline = time.monotonic() + 15.0
+        while GOVERNOR.inuse_bytes("select") and \
+                time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert GOVERNOR.inuse_bytes("select") == 0, GOVERNOR.stats()
+        after = _settled_thread_count()
+        assert after <= baseline + 2, (baseline, after)
+    finally:
+        srv.stop()
+    assert GOVERNOR.inuse_bytes() == 0
+
+
 def test_egress_workers_stop_with_server(tmp_path, monkeypatch):
     """Config-built egress targets (logger/audit webhooks) get close()d
     on server stop: sender threads join and the process-global logger

@@ -1,0 +1,379 @@
+"""Streaming S3 Select tier: byte-identity vs the whole-buffer
+reference path, record-chunker framing, RequestProgress frame order +
+CRC validation, opaque listing tokens, and governor shedding over the
+API (the bounded-memory PR's contract tests)."""
+
+import gzip
+import bz2
+
+import pytest
+
+from minio_tpu.s3select import (SelectError, message, records,
+                                run_select, run_select_stream)
+from minio_tpu.utils import memgov
+
+
+def _req(expression, input_xml, output_xml="<CSV/>", progress=False):
+    prog = ("<RequestProgress><Enabled>TRUE</Enabled></RequestProgress>"
+            if progress else "")
+    return (
+        '<?xml version="1.0" encoding="UTF-8"?>'
+        '<SelectObjectContentRequest '
+        'xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+        f"<Expression>{expression}</Expression>"
+        "<ExpressionType>SQL</ExpressionType>"
+        f"{prog}"
+        f"<InputSerialization>{input_xml}</InputSerialization>"
+        f"<OutputSerialization>{output_xml}</OutputSerialization>"
+        "</SelectObjectContentRequest>").encode()
+
+
+CSV = (b"name,age,city\n" +
+       b"".join(f"user{i},{20 + i % 60},"
+                f"{'paris' if i % 3 == 0 else 'tokyo'}\n".encode()
+                for i in range(5000)))
+JSONL = b"".join(
+    f'{{"name":"user{i}","age":{20 + i % 60}}}\n'.encode()
+    for i in range(5000))
+
+
+def _chunked(data, n):
+    return iter([data[i:i + n] for i in range(0, len(data), n)])
+
+
+@pytest.mark.parametrize("chunk", [17, 1024, 65536, 1 << 22])
+def test_stream_byte_identical_to_buffered_csv(chunk):
+    payload = _req("SELECT name, age FROM S3Object WHERE city = 'paris'",
+                   "<CSV><FileHeaderInfo>USE</FileHeaderInfo></CSV>")
+    ref = run_select(payload, CSV)
+    got = b"".join(run_select_stream(payload, _chunked(CSV, chunk),
+                                     block_bytes=8192))
+    assert got == ref
+
+
+@pytest.mark.parametrize("chunk", [63, 4096])
+def test_stream_byte_identical_jsonl_fast_path(chunk):
+    payload = _req("SELECT s.name FROM S3Object s WHERE s.age > 40",
+                   "<JSON><Type>LINES</Type></JSON>")
+    ref = run_select(payload, JSONL)
+    got = b"".join(run_select_stream(payload, _chunked(JSONL, chunk),
+                                     block_bytes=4096))
+    assert got == ref
+
+
+def test_stream_byte_identical_quoted_multiline_csv():
+    # a quoted field containing record delimiters and doubled quotes
+    # must never split across scanner blocks
+    rows = []
+    for i in range(500):
+        rows.append(f'r{i:04d},"multi\nline ""v{i}""\nfield",{i}\n')
+    data = ("h1,h2,h3\n" + "".join(rows)).encode()
+    payload = _req("SELECT h1, h3 FROM S3Object",
+                   "<CSV><FileHeaderInfo>USE</FileHeaderInfo></CSV>")
+    ref = run_select(payload, data)
+    for chunk in (7, 100, 4096):
+        got = b"".join(run_select_stream(payload, _chunked(data, chunk),
+                                         block_bytes=256))
+        assert got == ref
+
+
+@pytest.mark.parametrize("comp,codec", [("GZIP", gzip.compress),
+                                        ("BZIP2", bz2.compress)])
+def test_stream_byte_identical_compressed(comp, codec):
+    payload = _req("SELECT name FROM S3Object WHERE city = 'london'",
+                   f"<CompressionType>{comp}</CompressionType>"
+                   "<CSV><FileHeaderInfo>USE</FileHeaderInfo></CSV>")
+    blob = codec(CSV)
+    ref = run_select(payload, blob)
+    got = b"".join(run_select_stream(payload, _chunked(blob, 1000),
+                                     block_bytes=4096))
+    assert got == ref
+
+
+def test_truncated_gzip_is_clean_error_both_paths():
+    payload = _req("SELECT * FROM S3Object",
+                   "<CompressionType>GZIP</CompressionType><CSV/>")
+    blob = gzip.compress(CSV)[:-7]
+    with pytest.raises(SelectError) as e1:
+        run_select(payload, blob)
+    with pytest.raises(SelectError) as e2:
+        b"".join(run_select_stream(payload, _chunked(blob, 512)))
+    assert e1.value.code == e2.value.code == "InvalidCompressionFormat"
+
+
+def test_progress_frame_order_and_crc():
+    """Satellite contract: Progress frames only when the client asked,
+    monotonic byte counts, Cont preceding each periodic Progress, and
+    the stream always ends Progress(final) Stats End — all frames CRC-
+    valid (parse_events verifies every prelude + message CRC)."""
+    from minio_tpu import s3select as s3s
+    payload = _req("SELECT name FROM S3Object WHERE city = 'paris'",
+                   "<CSV><FileHeaderInfo>USE</FileHeaderInfo></CSV>",
+                   progress=True)
+    old = s3s.PROGRESS_INTERVAL
+    s3s.PROGRESS_INTERVAL = 32 * 1024      # force periodic frames
+    try:
+        out = b"".join(run_select_stream(payload, _chunked(CSV, 16384),
+                                         block_bytes=16384))
+    finally:
+        s3s.PROGRESS_INTERVAL = old
+    events = message.parse_events(out)     # CRC-validated decode
+    types = [t for t, _ in events]
+    assert types[-1] == "End" and types[-2] == "Stats"
+    assert types[-3] == "Progress", types[-6:]
+    assert types.count("Progress") >= 2, "periodic frames missing"
+    assert "Cont" in types
+    # every periodic Progress is preceded by a Cont keep-alive
+    for i, t in enumerate(types[:-3]):
+        if t == "Progress":
+            assert types[i - 1] == "Cont", types[max(0, i - 2):i + 1]
+    # monotonic BytesScanned across Progress frames
+    import re
+    scanned = [int(re.search(rb"<BytesScanned>(\d+)</BytesScanned>",
+                             p).group(1))
+               for t, p in events if t == "Progress"]
+    assert scanned == sorted(scanned)
+    assert scanned[-1] == len(CSV)
+    # and WITHOUT RequestProgress: no Progress/Cont frames at all
+    plain = b"".join(run_select_stream(
+        _req("SELECT name FROM S3Object WHERE city = 'paris'",
+             "<CSV><FileHeaderInfo>USE</FileHeaderInfo></CSV>"),
+        _chunked(CSV, 16384), block_bytes=16384))
+    ptypes = [t for t, _ in message.parse_events(plain)]
+    assert "Progress" not in ptypes and "Cont" not in ptypes
+
+
+def test_record_chunker_quote_state_across_feeds():
+    ck = records.RecordChunker(b"\n", b'"')
+    assert ck.feed(b'a,"open\n') == b""          # delim inside quotes
+    assert ck.feed(b'still open\n') == b""
+    out = ck.feed(b'closed",x\nnext,')
+    assert out == b'a,"open\nstill open\nclosed",x\n'
+    assert ck.flush() == b"next,"
+
+
+def test_record_chunker_doubled_quotes_and_custom_delim():
+    ck = records.RecordChunker(b";", b'"')
+    out = ck.feed(b'a,"he said ""hi;""",1;b,2;c,"open')
+    assert out == b'a,"he said ""hi;""",1;b,2;'
+    assert ck.feed(b'";tail') == b'c,"open";'
+    assert ck.flush() == b"tail"
+
+
+def test_stream_byte_identical_stray_quotes():
+    """csv treats a quote NOT at field start as a literal character —
+    the chunker must not let a stray quote invert its quoting state
+    and cut inside a genuinely quoted multi-line field (review
+    regression)."""
+    data = (b"h1,h2\n" +
+            b'a"b,c\n"multi\nline",x\n' * 50 +      # stray then quoted
+            b'plain,"tail""esc""\nmore",9\n' * 30)
+    payload = _req("SELECT * FROM S3Object",
+                   "<CSV><FileHeaderInfo>USE</FileHeaderInfo></CSV>")
+    ref = run_select(payload, data)
+    for chunk in (9, 64, 1024):
+        got = b"".join(run_select_stream(payload,
+                                         _chunked(data, chunk),
+                                         block_bytes=128))
+        assert got == ref, f"diverged at chunk={chunk}"
+
+
+def test_record_chunker_ambiguous_trailing_quote_defers():
+    """A quote pair straddling the feed boundary ('..""' at buffer
+    end) is close-vs-escape ambiguous — the chunker defers the cut
+    until more data disambiguates."""
+    ck = records.RecordChunker(b"\n", b'"')
+    assert ck.feed(b'"x""') == b""       # ambiguous: no cut yet
+    assert ck.feed(b'"y\nnext\n') == b'"x""' + b'"y\nnext\n'
+    ck2 = records.RecordChunker(b"\n", b'"')
+    assert ck2.feed(b'"x""') == b""
+    out = ck2.feed(b'\n"z",1\n')         # it WAS a close ("" = x")
+    assert out == b'"x""\n"z",1\n'
+
+
+def test_record_chunker_no_quote_mode():
+    ck = records.RecordChunker(b"\n", None)
+    assert ck.feed(b'{"a": "has \\" quote"}\n{"b"') == \
+        b'{"a": "has \\" quote"}\n'
+    assert ck.flush() == b'{"b"'
+
+
+# -- opaque V2 continuation tokens ------------------------------------------
+
+def test_list_token_roundtrip_and_errors():
+    from minio_tpu.objectlayer import metacache as mc
+    tok = mc.encode_list_token("bucket/key-42", "snap1", 7)
+    assert mc.decode_list_token(tok) == "bucket/key-42"
+    # legacy raw-key markers pass through untouched
+    assert mc.decode_list_token("plain/key") == "plain/key"
+    # OUR prefix with garbage inside is the client's malformed token
+    for bad in ("mt1-%%%not-base64%%%", "mt1-aGVsbG8",  # not json
+                mc._TOKEN_PREFIX + "e30"):               # no "k"
+        with pytest.raises(ValueError):
+            mc.decode_list_token(bad)
+
+
+# -- governor ---------------------------------------------------------------
+
+def test_governor_charge_release_and_shed():
+    gov = memgov.MemoryGovernor(limit_bytes=1000)
+    with gov.charge(600, "select"):
+        assert gov.inuse_bytes() == 600
+        with pytest.raises(memgov.MemoryPressure) as ei:
+            gov.charge(600, "listing")
+        assert ei.value.retry_after_s > 0
+        assert gov.stats()["shed"] == {"listing": 1}
+    assert gov.inuse_bytes() == 0
+    assert gov.stats()["peak_bytes"] == 600
+    # limit 0 disables admission but keeps accounting
+    gov2 = memgov.MemoryGovernor()
+    c = gov2.charge(1 << 30, "select")
+    assert gov2.inuse_bytes("select") == 1 << 30
+    c.release()
+    c.release()                       # idempotent
+    assert gov2.inuse_bytes() == 0
+
+
+def test_governor_charge_released_on_gc():
+    gov = memgov.MemoryGovernor(limit_bytes=100)
+    gov.charge(80, "select")          # dropped without release
+    import gc
+    gc.collect()
+    assert gov.inuse_bytes() == 0
+    with gov.charge(80, "select"):
+        pass
+
+
+def test_parse_size():
+    assert memgov.parse_size("0") == 0
+    assert memgov.parse_size("256MiB") == 256 << 20
+    assert memgov.parse_size("1GiB") == 1 << 30
+    assert memgov.parse_size("12345") == 12345
+    assert memgov.parse_size("2KB") == 2000
+    assert memgov.parse_size("junk", 7) == 7
+
+
+# -- over the S3 API --------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    from minio_tpu.objectlayer.erasure_object import ErasureObjects
+    from minio_tpu.s3.server import S3Server
+    from minio_tpu.storage.xl_storage import XLStorage
+    tmp = tmp_path_factory.mktemp("sstream")
+    disks = []
+    for i in range(4):
+        d = tmp / f"disk{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=256 * 1024,
+                           backend="numpy")
+    srv = S3Server(layer, access_key="sk", secret_key="ss")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture
+def client(server):
+    from minio_tpu.s3.client import S3Client
+    c = S3Client(server.endpoint, "sk", "ss")
+    if not c.head_bucket("selb"):
+        c.make_bucket("selb")
+    return c
+
+
+def test_malformed_continuation_token_is_invalid_argument(client):
+    from minio_tpu.s3.client import S3ClientError
+    client.put_object("selb", "t/a", b"x")
+    with pytest.raises(S3ClientError) as ei:
+        client.request("GET", "/selb",
+                       "list-type=2&continuation-token=mt1-%25garbage")
+    assert ei.value.status == 400
+    assert ei.value.code == "InvalidArgument"
+
+
+def test_stale_generation_token_restarts_not_500(client, server):
+    """A token minted against one snapshot generation must keep paging
+    after the bucket mutates (fresh walk, resume from the key) — never
+    a 500 (satellite contract)."""
+    import urllib.parse as up
+    import xml.etree.ElementTree as ET
+    ns = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+    for i in range(8):
+        client.put_object("selb", f"g/k{i}", b"d")
+    r = client.request("GET", "/selb",
+                       "list-type=2&max-keys=3&prefix=g/")
+    token = ET.fromstring(r.body).findtext(f"{ns}NextContinuationToken")
+    assert token and token.startswith("mt1-")
+    # mutate: the continuation outlives its snapshot generation
+    client.put_object("selb", "g/k0", b"mutated")
+    client.delete_object("selb", "g/k3")
+    r2 = client.request(
+        "GET", "/selb",
+        f"list-type=2&max-keys=100&prefix=g/&continuation-token="
+        f"{up.quote(token)}")
+    names = [e.findtext(f"{ns}Key")
+             for e in ET.fromstring(r2.body).iter(f"{ns}Contents")]
+    # resumed past the marker over the FRESH namespace (k3 deleted)
+    assert names == ["g/k4", "g/k5", "g/k6", "g/k7"]
+
+
+def test_large_select_streams_chunked_and_byte_identical(client):
+    """Output past the flush threshold switches the response to
+    chunked transfer encoding; the event payload stays byte-identical
+    to the whole-buffer reference run."""
+    data = CSV * 40          # ~3.6 MiB in, output > the 2 MiB threshold
+    client.put_object("selb", "big.csv", data, content_type="text/csv")
+    body = _req("SELECT * FROM S3Object", "<CSV/>")
+    r = client.request("POST", "/selb/big.csv", "select&select-type=2",
+                       body)
+    assert "Content-Length" not in r.headers, \
+        "large select should stream chunked"
+    ref = run_select(body, data)
+    assert r.body == ref
+    ev = message.parse_events(r.body)
+    assert [t for t, _ in ev][-1] == "End"
+
+
+def test_multipart_bigger_than_watermark_completes(client):
+    """A multipart object LARGER than the governor watermark must
+    still complete: assembly holds one part at a time, so the charge
+    is the LARGEST part, not the object total (review regression —
+    a sum-charge made big uploads permanently 503)."""
+    from minio_tpu.utils.memgov import GOVERNOR
+    GOVERNOR.configure(6 << 20)          # 6 MiB watermark
+    try:
+        uid = c_uid = client.create_multipart_upload("selb", "big.mp")
+        parts = []
+        for pn in (1, 2):                # 2 x 5 MiB = 10 MiB total
+            body = bytes([pn]) * (5 << 20)
+            parts.append((pn, client.upload_part("selb", "big.mp",
+                                                 c_uid, pn, body)))
+        client.complete_multipart_upload("selb", "big.mp", uid, parts)
+        assert len(client.get_object("selb", "big.mp").body) == 10 << 20
+    finally:
+        GOVERNOR.configure(0)
+    assert GOVERNOR.inuse_bytes() == 0
+
+
+def test_governor_sheds_select_with_503_retry_after(client, server):
+    from minio_tpu.s3.client import S3ClientError
+    from minio_tpu.utils.memgov import GOVERNOR
+    client.put_object("selb", "small.csv", CSV[:4096],
+                      content_type="text/csv")
+    GOVERNOR.configure(1024, retry_after_s=2.0)   # below one charge
+    try:
+        with pytest.raises(S3ClientError) as ei:
+            client.request("POST", "/selb/small.csv",
+                           "select&select-type=2",
+                           _req("SELECT * FROM S3Object", "<CSV/>"))
+        assert ei.value.status == 503
+        assert ei.value.code == "SlowDown"
+    finally:
+        GOVERNOR.configure(0)
+    assert GOVERNOR.inuse_bytes() == 0
+    # recovered: the same request succeeds once pressure clears
+    r = client.request("POST", "/selb/small.csv", "select&select-type=2",
+                       _req("SELECT * FROM S3Object", "<CSV/>"))
+    assert message.parse_events(r.body)[-1][0] == "End"

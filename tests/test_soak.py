@@ -313,6 +313,36 @@ def test_small_object_storm_engages_codec_batcher(tmp_path):
         (cfg.enable, cfg.window_s, cfg._loaded) = saved
 
 
+def test_select_storm_smoke_memory_slo(tmp_path, monkeypatch):
+    """The bounded-memory tentpole in miniature: streaming-Select storm
+    over multi-block CSV objects with a drive death riding along,
+    under a memory-governor watermark — all SLO rows pass INCLUDING
+    the memory rows (inuse settled to zero, sheds under the ceiling),
+    heal converges, no leaked scanner threads."""
+    from minio_tpu.soak.workload import MIXES as _mixes
+    monkeypatch.setenv("MT_API_MEM_LIMIT", "256MiB")
+    d = 3.0
+    E = soak_chaos.Event
+    sc = soak_report.Scenario(
+        name="select_storm_smoke",
+        mix=_mixes["select_storm"],
+        timeline=[E(0.2 * d, "drive_kill", drive=0),
+                  E(0.6 * d, "drive_return", drive=0)],
+        duration_s=d, workers=3,
+        budget=soak_slo.Budget(converge_timeout_s=30.0,
+                               max_error_rate=0.10,
+                               require_mem_bounded=True))
+    rows = soak_report.run_scenario(sc, str(tmp_path / "selstorm"))
+    by_metric = {r["metric"]: r for r in rows}
+    failed = [r for r in rows if not r["passed"]]
+    assert not failed, failed
+    assert by_metric["mem_inuse_settled"]["value"] == 0
+    assert "mem_shed_rate" in by_metric
+    # the storm actually selected
+    assert any(m.startswith("p99:SelectObjectContent")
+               for m in by_metric)
+
+
 # -- the slow-marked full matrix (bench.py soak leg) -----------------------
 
 @pytest.mark.slow

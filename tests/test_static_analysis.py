@@ -102,3 +102,57 @@ def test_no_unused_imports():
                     bad.append(f"{os.path.relpath(path, REPO)}:"
                                f"{lineno} {name}")
     assert not bad, f"unused imports: {bad}"
+
+
+# -- bounded-memory guard (the streaming-Select/metacache PR's fence) -------
+
+# the test/replication S3Client's whole-object API is its contract;
+# everything else in the request planes must read ranged or streamed
+_WHOLE_BODY_EXEMPT = {"client.py"}
+
+
+def test_no_whole_body_reads_in_request_planes():
+    """Whole-body patterns must not creep back into the S3 request
+    planes (``minio_tpu/s3/``, ``minio_tpu/s3select/``): a
+    ``get_object`` call without a range (no offset/length, under 3
+    positional args) rematerializes whole objects, and an argless
+    ``.read()`` on a request body/socket buffers unbounded client
+    bytes.  Bounded paths pass ranges explicitly (``0, -1`` marks a
+    deliberate full read on a TRANSFORM path — visible and greppable);
+    a line may carry ``# whole-body-ok`` with a reason if a future
+    exception is truly needed.  Fails with file:line."""
+    bad = []
+    for base in ("minio_tpu/s3", "minio_tpu/s3select"):
+        for root, _dirs, files in os.walk(os.path.join(REPO, base)):
+            for f in sorted(files):
+                if not f.endswith(".py") or f in _WHOLE_BODY_EXEMPT:
+                    continue
+                path = os.path.join(root, f)
+                rel = os.path.relpath(path, REPO)
+                src, tree = _parse(path)
+                lines = src.splitlines()
+                for node in ast.walk(tree):
+                    if not isinstance(node, ast.Call) or \
+                            not isinstance(node.func, ast.Attribute):
+                        continue
+                    line = lines[node.lineno - 1] \
+                        if node.lineno - 1 < len(lines) else ""
+                    if "whole-body-ok" in line:
+                        continue
+                    attr = node.func.attr
+                    if attr == "get_object":
+                        kw = {k.arg for k in node.keywords}
+                        if len(node.args) < 3 and \
+                                not ({"offset", "length"} & kw):
+                            bad.append(f"{rel}:{node.lineno} "
+                                       "whole-object get_object "
+                                       "(no range)")
+                    elif attr == "read" and not node.args and \
+                            not node.keywords:
+                        recv = ast.unparse(node.func.value)
+                        if "rfile" in recv or "body" in recv or \
+                                "reader" in recv:
+                            bad.append(f"{rel}:{node.lineno} "
+                                       "unbounded request-body read()")
+    assert not bad, ("unbounded-memory paths in the request planes "
+                     f"(see docs/performance.md): {bad}")
