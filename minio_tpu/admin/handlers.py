@@ -175,7 +175,8 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
                               *sys.argv[1:]])
                 srv.stop()
                 srv.shutdown.set()      # node-mode main thread waits here
-            threading.Thread(target=later, daemon=True).start()
+            threading.Thread(target=later, daemon=True,
+                             name="mt-admin-svcact").start()
             return send_json({"status": "ok", "action": action}) or True
         if route == "storageinfo" and h.command == "GET":
             # madmin StorageInfo: per-drive capacity + online state —
@@ -484,7 +485,8 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
                                   (time.perf_counter() - t0) * 1e3, 2)}
 
             threads = [_threading.Thread(target=_probe_one,
-                                         args=(i, c), daemon=True)
+                                         args=(i, c), daemon=True,
+                                         name=f"mt-admin-netperf-{i}")
                        for i, c in enumerate(clients)]
             for t in threads:
                 t.start()
@@ -843,8 +845,10 @@ def _stream_with_peer_traces(h, srv, q1, flt=None, want=None) -> bool:
                                               types=want_list):
                 merged.publish(item)
 
-    threads = [threading.Thread(target=local_pump, daemon=True),
-               threading.Thread(target=peer_pump, daemon=True)]
+    threads = [threading.Thread(target=local_pump, daemon=True,
+                                name="mt-admin-trace-local"),
+               threading.Thread(target=peer_pump, daemon=True,
+                                name="mt-admin-trace-peer")]
     for t in threads:
         t.start()
     try:
@@ -897,8 +901,8 @@ def _server_info(srv) -> dict:
     buckets = []
     try:
         buckets = [b.name for b in srv.layer.list_buckets()]
-    except Exception:  # noqa: BLE001
-        pass
+    except Exception:  # noqa: BLE001 — degraded layer: healthinfo
+        pass           # still reports the node sections
     return {
         "mode": "distributed-erasure-tpu",
         "region": srv.region,
@@ -946,6 +950,10 @@ def _config(h, srv, route, q1, payload, send_json) -> bool:
             # retune the cross-request codec batcher (combining
             # window, batch bound, queue depth) on the live data plane
             srv.reload_codec_config()
+        if parts[1] in ("heal", "scanner"):
+            # retune heal/scan IO self-pacing on the attached
+            # background planes
+            srv.reload_background_config()
         if parts[1] in ("logger_webhook", "audit_webhook") \
                 or parts[1].startswith("notify_"):
             # rebuild the egress targets live: repointed endpoints and

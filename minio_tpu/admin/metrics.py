@@ -176,53 +176,57 @@ def render(layer=None, healer=None, config=None, api_stats=None,
             pass
         try:
             lines += _bucket_usage_gauges(layer)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — a scrape must never fail
             pass
         try:
             lines += _disk_lastminute_gauges(layer, config)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — a scrape must never fail
             pass
         try:
             lines += _put_pipeline_gauges(layer)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — a scrape must never fail
             pass
     try:
         lines += _codec_batch_gauges()
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — a scrape must never fail
         pass
     try:
         lines += _memgov_gauges()
-    except Exception:  # noqa: BLE001
+    except Exception:  # noqa: BLE001 — a scrape must never fail
+        pass
+    try:
+        lines += _locktrace_gauges()
+    except Exception:  # noqa: BLE001 — a scrape must never fail
         pass
     if api_stats is not None:
         try:
             lines += _s3_lastminute_gauges(api_stats)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — a scrape must never fail
             pass
     if healer is not None or mrf is not None:
         try:
             lines += _heal_counters(healer, mrf)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — a scrape must never fail
             pass
     if healer is not None:
         try:
             lines += _progress_gauges("mt_heal", healer.progress)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — a scrape must never fail
             pass
     if crawler is not None:
         try:
             lines += _scanner_gauges(crawler)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — a scrape must never fail
             pass
     if replication is not None:
         try:
             lines += _replication_gauges(replication)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — a scrape must never fail
             pass
     if egress is not None:
         try:
             lines += _egress_metrics(egress)
-        except Exception:  # noqa: BLE001
+        except Exception:  # noqa: BLE001 — a scrape must never fail
             pass
     text = "\n".join(lines) + "\n"
     if node:
@@ -610,6 +614,15 @@ def _codec_batch_gauges() -> list[str]:
         lines.append(f"mt_codec_batch_queue_depth{lbl}"
                      f" {depths.get(op, 0)}")
     return lines
+
+
+def _locktrace_gauges() -> list[str]:
+    """Lock-order detector families (utils/locktrace.py): recorded
+    order-graph edges, detected cycles (potential AB/BA deadlocks),
+    and long holds under contention.  Idle contract: tracing off (the
+    default) or an empty graph emits no families at all."""
+    from ..utils import locktrace
+    return locktrace.render_metrics()
 
 
 def _memgov_gauges() -> list[str]:

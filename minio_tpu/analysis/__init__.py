@@ -1,0 +1,18 @@
+"""Concurrency analysis plane, static half: the pluggable AST lint
+framework (core.py) and its rule catalog (rules.py) — the repo's
+staticcheck/ruleguard stand-in, runnable three ways with identical
+findings:
+
+* ``python -m minio_tpu.analysis [--json]`` (CI gate; exit 1 on any
+  finding),
+* ``tests/test_static_analysis.py`` (the tier-1 shell),
+* :func:`run_tree` from code.
+
+The dynamic half — the runtime lock-order/deadlock detector — lives
+in ``minio_tpu/utils/locktrace.py``.  docs/static-analysis.md is the
+catalog: every rule id, the suppression grammar, and the locktrace
+model.
+"""
+
+from .core import Finding, Module, Rule, run_tree  # noqa: F401 — public API
+from .rules import ALL_RULES  # noqa: F401 — public API

@@ -1,0 +1,53 @@
+"""``python -m minio_tpu.analysis`` — the CI lint gate.
+
+Prints one ``path:line [rule] message`` per finding (or a machine-
+readable report with ``--json``) and exits non-zero when anything is
+flagged, so a pipeline can gate merges on it exactly like the
+reference gates on staticcheck.
+"""
+
+import argparse
+import json
+import sys
+
+from .core import default_repo_root, run_tree
+from .rules import ALL_RULES
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m minio_tpu.analysis",
+        description="AST lint over the minio_tpu tree "
+                    "(docs/static-analysis.md)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report on stdout")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: auto-detected)")
+    p.add_argument("--rule", action="append", default=None,
+                   help="run only these rule ids (repeatable)")
+    args = p.parse_args(argv)
+    rules = [cls() for cls in ALL_RULES]
+    if args.rule:
+        rules = [r for r in rules if r.id in set(args.rule)]
+        unknown = set(args.rule) - {r.id for r in rules}
+        if unknown:
+            p.error(f"unknown rule id(s): {sorted(unknown)}")
+    root = args.root or default_repo_root()
+    findings = run_tree(repo=root, rules=rules)
+    if args.json:
+        json.dump({"root": root,
+                   "rules": sorted(r.id for r in rules),
+                   "count": len(findings),
+                   "findings": [f.as_dict() for f in findings]},
+                  sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        for f in findings:
+            print(f)
+        print(f"{len(findings)} finding(s) over "
+              f"{len(rules)} rule(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

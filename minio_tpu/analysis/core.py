@@ -1,0 +1,187 @@
+"""Pluggable AST lint framework (ruleguard.rules.go / staticcheck.conf
+role, grown from tests/test_static_analysis.py's ad-hoc checks).
+
+Every rule is a class with an ``id``, a one-line ``description``, and
+a visit pass producing file:line :class:`Finding`s — either per module
+(:meth:`Rule.check_module`) or once over the whole tree
+(:meth:`Rule.check_tree`, for cross-file contracts like kvconfig/docs
+drift).  The runner (:func:`run_tree`) parses each file once, shares
+the AST across rules, applies inline suppressions, and returns the
+sorted findings; ``python -m minio_tpu.analysis`` and the tier-1 test
+are both thin shells over it.
+
+Suppression grammar (docs/static-analysis.md):
+
+    some_flagged_line()   # mt-lint: ok(<rule-id>) <reason>
+
+The reason is MANDATORY — a suppression without one is itself a
+finding (rule ``suppression``), as is one naming a rule id the runner
+does not know.  Two legacy markers predating the framework stay
+honored where they already applied: ``# noqa`` on an import line
+(side-effect/registry imports, rule ``unused-import``) and
+``# whole-body-ok`` (rule ``whole-body-read``); both also require
+trailing reason text.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    path: str                  # repo-relative, forward slashes
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line} [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return {"path": self.path, "line": self.line,
+                "rule": self.rule, "message": self.message}
+
+
+# one suppression per line; ids comma-separated: mt-lint: ok(a, b) why
+_SUPP_RE = re.compile(r"#\s*mt-lint:\s*ok\(([\w\-, ]*)\)\s*(.*)$")
+
+
+@dataclass
+class Suppression:
+    rules: set[str]
+    reason: str
+    line: int
+
+
+@dataclass
+class Module:
+    """One parsed source file, shared by every rule."""
+    path: str                  # absolute
+    rel: str                   # repo-relative
+    src: str
+    lines: list[str] = field(default_factory=list)
+    tree: ast.AST | None = None
+    suppressions: dict[int, Suppression] = field(default_factory=dict)
+    parents: dict[int, ast.AST] = field(default_factory=dict)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def parent_of(self, node: ast.AST) -> ast.AST | None:
+        return self.parents.get(id(node))
+
+    def ancestors(self, node: ast.AST):
+        cur = self.parents.get(id(node))
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(id(cur))
+
+
+class Rule:
+    """Base checker: subclass, set ``id``/``description``, implement
+    one of the two passes."""
+
+    id: str = ""
+    description: str = ""
+
+    def check_module(self, mod: Module):
+        return ()
+
+    def check_tree(self, mods: list[Module], repo: str):
+        return ()
+
+
+def _parse_suppressions(mod: Module) -> None:
+    for i, text in enumerate(mod.lines, start=1):
+        m = _SUPP_RE.search(text)
+        if m is None:
+            continue
+        ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+        mod.suppressions[i] = Suppression(ids, m.group(2).strip(), i)
+
+
+def load_module(path: str, repo: str) -> Module:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    rel = os.path.relpath(path, repo).replace(os.sep, "/")
+    mod = Module(path=path, rel=rel, src=src, lines=src.splitlines())
+    _parse_suppressions(mod)
+    mod.tree = ast.parse(src, filename=path)   # SyntaxError -> runner
+    for parent in ast.walk(mod.tree):
+        for child in ast.iter_child_nodes(parent):
+            mod.parents[id(child)] = parent
+    return mod
+
+
+def iter_py_files(root: str):
+    for dirpath, dirnames, files in os.walk(root):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d != "__pycache__"]
+        for f in sorted(files):
+            if f.endswith(".py"):
+                yield os.path.join(dirpath, f)
+
+
+def default_repo_root() -> str:
+    # minio_tpu/analysis/core.py -> repo root two levels above the pkg
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def run_tree(repo: str | None = None, rules=None,
+             subdir: str = "minio_tpu") -> list[Finding]:
+    """Parse every ``.py`` under ``repo/subdir`` once, run every rule,
+    apply suppressions, and return sorted findings.  A file that fails
+    to parse yields a ``parse`` finding and is skipped by the other
+    rules (its AST does not exist)."""
+    from .rules import ALL_RULES
+    repo = repo or default_repo_root()
+    if rules is None:
+        rules = [cls() for cls in ALL_RULES]
+    # suppressions are audited against the FULL catalog — a --rule
+    # subset run must not report other rules' markers as unknown
+    known_ids = {cls.id for cls in ALL_RULES} | \
+        {r.id for r in rules} | {"parse", "suppression"}
+    findings: list[Finding] = []
+    mods: list[Module] = []
+    for path in iter_py_files(os.path.join(repo, subdir)):
+        rel = os.path.relpath(path, repo).replace(os.sep, "/")
+        try:
+            mods.append(load_module(path, repo))
+        except SyntaxError as e:
+            findings.append(Finding(rel, e.lineno or 0, "parse",
+                                    f"does not parse: {e.msg}"))
+    raw: list[Finding] = list(findings)
+    for rule in rules:
+        for mod in mods:
+            raw.extend(rule.check_module(mod))
+        raw.extend(rule.check_tree(mods, repo))
+    by_rel = {m.rel: m for m in mods}
+    out: list[Finding] = []
+    for f in raw:
+        mod = by_rel.get(f.path)
+        supp = mod.suppressions.get(f.line) if mod else None
+        if supp is not None and f.rule in supp.rules:
+            continue                    # suppressed (reason audited below)
+        out.append(f)
+    # the suppression grammar is itself linted: every mt-lint marker
+    # must carry a reason and name only known rule ids
+    for mod in mods:
+        for supp in mod.suppressions.values():
+            if not supp.reason:
+                out.append(Finding(
+                    mod.rel, supp.line, "suppression",
+                    "suppression without a reason — say why"))
+            unknown = sorted(supp.rules - known_ids)
+            if unknown or not supp.rules:
+                what = ", ".join(unknown) if unknown else "<empty>"
+                out.append(Finding(
+                    mod.rel, supp.line, "suppression",
+                    f"suppression names unknown rule(s): {what}"))
+    return sorted(set(out))

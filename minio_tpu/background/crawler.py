@@ -252,9 +252,24 @@ class Crawler:
         self.progress = CycleProgress("scanner")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # ``scanner`` kvconfig pacing (reference scanner.delay /
+        # scanner.max_wait), pushed live by
+        # S3Server.reload_background_config: the loop backs off by
+        # delay x the last cycle's wall time (capped at max_wait) on
+        # top of interval_s, so an expensive namespace walk slows
+        # itself down instead of monopolizing the drives
+        self.delay_mult = 0.0
+        self.max_wait_s = 15.0
+        self._last_cycle_s = 0.0
+
+    def _wait_s(self) -> float:
+        return self.interval_s + min(self.max_wait_s,
+                                     self.delay_mult *
+                                     self._last_cycle_s)
 
     def run_cycle(self) -> ScanResult:
         since = self.tracker.cycle - 1 if self.cycles else None
+        t0 = time.monotonic()
         self.progress.begin()
         try:
             res = scan_usage(self.layer, self.bucket_meta,
@@ -271,16 +286,18 @@ class Crawler:
         self.tracker.advance()
         self.last = res
         self.cycles += 1
+        self._last_cycle_s = time.monotonic() - t0
         return res
 
     def start(self) -> None:
         def loop():
-            while not self._stop.wait(self.interval_s):
+            while not self._stop.wait(self._wait_s()):
                 try:
                     self.run_cycle()
                 except Exception:  # noqa: BLE001 — crawler must survive
                     time.sleep(1)
-        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="mt-crawler")
         self._thread.start()
 
     def stop(self) -> None:

@@ -109,7 +109,8 @@ class MRFQueue:
         # "cycle" spans the worker's lifetime, so rates read as
         # objects-since-start over time-since-start
         self.progress.begin()
-        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread = threading.Thread(target=worker, daemon=True,
+                                        name="mt-heal-mrf")
         self._thread.start()
 
     def drain(self, timeout: float = 5.0) -> None:
@@ -135,6 +136,12 @@ class BackgroundHealer:
     layer: object
     interval_s: float = 3600.0
     deep_every: int = 0          # 0: never deep-scan in the sweep
+    # IO self-pacing (the ``heal`` kvconfig subsystem, reference
+    # heal.max_sleep): after each heal_object the sweep sleeps as long
+    # as the op took, capped here — heal yields the drives to
+    # foreground traffic instead of saturating them.  0 disables.
+    # Pushed live by S3Server.reload_background_config.
+    pace_s: float = 0.0
     stats: HealStats = field(default_factory=HealStats)
 
     def __post_init__(self):
@@ -160,8 +167,8 @@ class BackgroundHealer:
                 if hasattr(self.layer, "heal_bucket"):
                     try:
                         self.layer.heal_bucket(b.name)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception:  # noqa: BLE001 — one bucket's
+                        pass           # failure must not end the sweep
                 marker = ""
                 while True:
                     if self._stop.is_set():
@@ -190,6 +197,9 @@ class BackgroundHealer:
                         if traced:
                             _heal_span(b.name, oi.name, t0, healed,
                                        "sweep", err)
+                        if self.pace_s > 0:
+                            took = (time.monotonic_ns() - t0) / 1e9
+                            time.sleep(min(self.pace_s, took))
                     if not out.is_truncated:
                         break
                     marker = out.next_marker
@@ -212,7 +222,8 @@ class BackgroundHealer:
                     self.sweep()
                 except Exception:  # noqa: BLE001 — healer must survive
                     time.sleep(1)
-        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="mt-heal-sweeper")
         self._thread.start()
 
     def stop(self) -> None:

@@ -175,8 +175,8 @@ class ReplicationSys:
             try:
                 self.layer.put_object_metadata(
                     bucket, oi.name, None, {STATUS_KEY: "PENDING"})
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — status stamp is advisory;
+                pass           # the queued work item is what matters
         self._q.put((bucket, oi.name, oi.version_id, delete))
         self.stats.queued += 1
         return True
@@ -234,8 +234,8 @@ class ReplicationSys:
                     try:
                         self.layer.put_object_metadata(
                             bucket, name, None, {STATUS_KEY: "FAILED"})
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception:  # noqa: BLE001 — FAILED stamp is
+                        pass           # best-effort; next cycle retries
             self.progress.update(bucket, name, nbytes=moved)
             if traced:
                 dt = time.monotonic_ns() - t0
@@ -253,8 +253,9 @@ class ReplicationSys:
         # continuous plane: one "cycle" spans the worker pool's
         # lifetime (rates = work-since-start over time-since-start)
         self.progress.begin()
-        for _ in range(self._nworkers):
-            t = threading.Thread(target=self._worker, daemon=True)
+        for wi in range(self._nworkers):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"mt-repl-worker-{wi}")
             t.start()
             self._threads.append(t)
 

@@ -6,7 +6,7 @@ configs (minio_tpu/bucket/notification.py) to registered targets
 for live ListenNotification streams.
 """
 
-from .event import Event, new_event          # noqa: F401
-from .notifier import NotificationSys        # noqa: F401
-from .targets import (                       # noqa: F401
+from .event import Event, new_event          # noqa: F401 — public API
+from .notifier import NotificationSys        # noqa: F401 — public API
+from .targets import (                       # noqa: F401 — public API
     MemoryTarget, QueueStore, Target, WebhookTarget)

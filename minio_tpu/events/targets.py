@@ -25,7 +25,7 @@ from typing import Optional
 
 # QueueStore moved to the egress engine; re-exported here because the
 # public events API (minio_tpu.events.QueueStore) predates the move
-from ..obs.egress import DeliveryTarget, QueueStore  # noqa: F401
+from ..obs.egress import DeliveryTarget, QueueStore  # noqa: F401 — re-export
 
 
 class TargetError(Exception):

@@ -143,8 +143,8 @@ def streaming_encode_batch(shards, shard_size: int,
                     duration_ns=dt, input_bytes=nbytes,
                     detail={"op": "fused-hash", "shards": len(shards),
                             "shardSize": shard_size}))
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — tracing must never
+                pass           # fail the hash path
             return out
         except Exception:  # noqa: BLE001 — host path is always correct
             pass

@@ -37,6 +37,7 @@ import hashlib
 import os
 import threading
 import time
+from ..utils.locktrace import mtlock
 
 _NATIVE_SRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "native", "md5mb.cc")
@@ -46,7 +47,7 @@ _NATIVE_SO = os.path.join(os.path.dirname(_NATIVE_SRC), "build",
 _LIB = None
 _LIB_TRIED = False
 _STATE_SIZE = 0
-_load_lock = threading.Lock()
+_load_lock = mtlock("md5.native-load")
 
 
 def _get_lib():
@@ -168,7 +169,7 @@ class LaneScheduler:
     a given digest never appears twice in one batch."""
 
     def __init__(self, lanes: int | None = None):
-        self._mu = threading.Lock()
+        self._mu = mtlock("md5.sched")
         self._q: list[list] = []        # [h, chunk, event, exc]
         self._combining = False
         self._lanes = lanes

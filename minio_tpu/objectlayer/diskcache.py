@@ -329,7 +329,8 @@ class CacheObjects:
     def _start_writeback(self) -> None:
         if self._wb_thread is None or not self._wb_thread.is_alive():
             self._wb_thread = threading.Thread(target=self._wb_loop,
-                                               daemon=True)
+                                               daemon=True,
+                                               name="mt-diskcache-wb")
             self._wb_thread.start()
 
     def _wb_loop(self) -> None:

@@ -36,7 +36,6 @@ import base64
 import bisect
 import hashlib
 import json
-import threading
 import time
 import uuid
 from collections import OrderedDict
@@ -44,6 +43,7 @@ from dataclasses import asdict, dataclass, field
 from typing import Callable, Iterable, List, Optional
 
 from .interface import ListObjectsInfo, ObjectInfo
+from ..utils.locktrace import mtlock
 
 # cache validity (seconds).  The reference keeps a metacache alive while
 # clients page through it and retires it after ~2 minutes idle; writes
@@ -241,7 +241,7 @@ class BlockedSnapshot:
         self._blocks: OrderedDict[int, List[ObjectInfo]] = OrderedDict()
         self._pinned: set[int] = set()      # not on disk: never evicted
         self._disk = None                   # drive holding the blocks
-        self._mu = threading.Lock()
+        self._mu = mtlock("metacache.snapshot")
 
     def expired(self, ttl: float, now: float | None = None) -> bool:
         return ((now if now is not None else time.time())
@@ -334,7 +334,7 @@ class MetacacheManager:
                  sys_volume: str = "", block_entries: int = BLOCK_ENTRIES,
                  cache_blocks: int = CACHE_BLOCKS):
         self._caches: dict[tuple, BlockedSnapshot] = {}
-        self._mu = threading.Lock()
+        self._mu = mtlock("metacache.manager")
         self._disks = disks or []
         self._ttl = ttl
         self._max = max_caches

@@ -32,8 +32,8 @@ class ErasureServerPools(ObjectLayer):
                 if d is not None:
                     try:
                         total += d.disk_info().free
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception:  # noqa: BLE001 — offline drive
+                        pass           # counts as zero free space
         return total
 
     def _free_spaces(self) -> list[int]:
@@ -89,8 +89,8 @@ class ErasureServerPools(ObjectLayer):
         for p in self.pools[1:]:
             try:
                 p.make_bucket(bucket)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — heal converges the
+                pass           # pool that missed the bucket create
 
     def get_bucket_info(self, bucket: str) -> BucketInfo:
         return self.pools[0].get_bucket_info(bucket)

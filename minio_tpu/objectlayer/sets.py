@@ -249,8 +249,8 @@ class ErasureSets(ObjectLayer):
                 try:
                     s.make_bucket(bucket)
                     healed += 1
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — set still down:
+                    pass           # the next heal sweep retries it
         return healed
 
     # internal fan-out used by BucketMetadataSys

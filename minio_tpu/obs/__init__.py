@@ -2,5 +2,5 @@
 health diagnostics (reference: pkg/trace, cmd/http-tracer.go, cmd/logger/,
 cmd/utils.go:286 profilers, cmd/healthinfo.go)."""
 
-from . import (audit, healthinfo, lastminute, logger,  # noqa: F401
+from . import (audit, healthinfo, lastminute, logger,  # noqa: F401 — public API
                profiling, trace)

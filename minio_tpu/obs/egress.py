@@ -48,6 +48,7 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from ..utils.retry import RetryPolicy
+from ..utils.locktrace import mtlock
 
 ONLINE = "online"
 OFFLINE = "offline"
@@ -85,7 +86,7 @@ class QueueStore:
     def __init__(self, directory: str, limit: int = 10000):
         self.dir = directory
         self.limit = limit
-        self._mu = threading.Lock()
+        self._mu = mtlock("egress.store")
         os.makedirs(directory, exist_ok=True)
         # cached entry count: the sender polls the backlog every loop
         # pass and status()/the scrape read it under the send-path lock
@@ -170,11 +171,11 @@ class DeliveryTarget:
         self._log = log              # log_once-shaped callable or None
         self._q: "queue.Queue" = queue.Queue(queue_limit
                                              or self.QUEUE_SIZE)
-        self._mu = threading.Lock()
+        self._mu = mtlock("egress.target")
         # serializes every delivery attempt (worker loop, auto-replay,
         # and the admin-triggered sync replay()) so one record is never
         # delivered twice by two drains racing over the store
-        self._deliver_mu = threading.Lock()
+        self._deliver_mu = mtlock("egress.deliver")
         self._state = ONLINE
         self._consecutive = 0
         self._opened_at = 0.0
@@ -350,25 +351,30 @@ class DeliveryTarget:
         return max(0.01, min(remaining, 0.25))
 
     def _process(self, record: dict) -> None:
-        with self._deliver_mu:
-            if not self._may_attempt():
-                self._spill_or_dead_letter(record)
-                return
-            attempt = 0
-            while True:
+        attempt = 0
+        while True:
+            with self._deliver_mu:
+                if attempt == 0 and not self._may_attempt():
+                    self._spill_or_dead_letter(record)
+                    return
                 if self._try_deliver(record):
                     return
-                attempt += 1
-                with self._mu:
-                    still_online = self._state == ONLINE
-                    closing = self._closed
-                # a close() mid-retry bounds shutdown to the attempt in
-                # flight: the record spills NOW instead of burning the
-                # remaining attempts/backoffs past the close timeout
-                if closing or not still_online \
-                        or attempt >= self.max_attempts:
-                    break
-                self._policy.wait(attempt - 1)
+            attempt += 1
+            with self._mu:
+                still_online = self._state == ONLINE
+                closing = self._closed
+            # a close() mid-retry bounds shutdown to the attempt in
+            # flight: the record spills NOW instead of burning the
+            # remaining attempts/backoffs past the close timeout
+            if closing or not still_online \
+                    or attempt >= self.max_attempts:
+                break
+            # backoff OUTSIDE the delivery mutex (lock-discipline):
+            # each attempt is still single-flight, but a synchronous
+            # replay()/admin drain no longer stalls behind this
+            # record's whole retry schedule
+            self._policy.wait(attempt - 1)
+        with self._deliver_mu:
             self._spill_or_dead_letter(record)
 
     def _may_attempt(self) -> bool:
@@ -608,7 +614,7 @@ class EgressRegistry:
     families (the idle contract)."""
 
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = mtlock("egress.registry")
         self._targets: Dict[tuple, DeliveryTarget] = {}
 
     def register(self, target: DeliveryTarget) -> DeliveryTarget:

@@ -130,7 +130,8 @@ def _put_get_round(layer, bucket: str, size: int, duration_s: float,
 
     t0 = time.monotonic()
     threads = [threading.Thread(target=put_worker, args=(wi,),
-                                daemon=True)
+                                daemon=True,
+                                name=f"mt-selftest-put-{wi}")
                for wi in range(concurrency)]
     for t in threads:
         t.start()
@@ -155,7 +156,8 @@ def _put_get_round(layer, bucket: str, size: int, duration_s: float,
 
     t0 = time.monotonic()
     threads = [threading.Thread(target=get_worker, args=(wi,),
-                                daemon=True)
+                                daemon=True,
+                                name=f"mt-selftest-get-{wi}")
                for wi in range(concurrency)]
     for t in threads:
         t.start()
@@ -220,8 +222,8 @@ def _cleanup_bucket(layer, bucket: str) -> None:
         for oi in out.objects:
             try:
                 layer.delete_object(bucket, oi.name)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — probe-object cleanup is
+                pass           # best-effort; force-delete follows
         layer.delete_bucket(bucket, force=True)
     except Exception:  # noqa: BLE001 — a leftover probe bucket must
         pass           # never fail the measurement it served

@@ -58,6 +58,7 @@ import numpy as np
 
 from ..obs import trace as _trace
 from ..ops.codec import Erasure
+from ..utils.locktrace import mtlock
 
 # occupancy buckets: requests coalesced per dispatch (1 = the serial
 # fallback fired; weight above 1 is the cross-request win)
@@ -127,7 +128,7 @@ CONFIG = CodecConfig()
 # old per-module lru_cache in codec_service gave the sidecar its own
 # unbounded-lifetime copies.
 
-_CODEC_MU = threading.Lock()
+_CODEC_MU = mtlock("codec.registry")
 _CODECS: dict[tuple, Erasure] = {}
 _CODEC_CAP = 64
 
@@ -197,7 +198,7 @@ class CodecBatcher:
     """The process-wide combining queue set (``GLOBAL`` below)."""
 
     def __init__(self, config: CodecConfig | None = None):
-        self._mu = threading.Lock()
+        self._mu = mtlock("codec.batcher")
         self._buckets: dict[tuple, _Bucket] = {}
         self.config = config or CONFIG
         # lifetime totals (bench deltas + the scrape-gauge idle gate)

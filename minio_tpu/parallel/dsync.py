@@ -25,6 +25,7 @@ import uuid
 from dataclasses import dataclass, field
 
 from .rpc import RPCClient, RPCError, RPCServer
+from ..utils.locktrace import mtlock
 
 # grant lifetime (reference: 1 min refresh loop, 2x expiry window —
 # scaled down for snappier failover); holders refresh every ttl/3
@@ -73,7 +74,7 @@ class LocalLocker:
     WRITER_PREF_MAX_S = 3.0
 
     def __init__(self, default_ttl_s: float = DEFAULT_TTL_S):
-        self._mu = threading.Lock()
+        self._mu = mtlock("dsync.local-table")
         self._map: dict[str, _LockEntry] = {}
         # resource -> (first_marked, expiry)
         self._writer_waiting: dict[str, tuple[float, float]] = {}
@@ -232,10 +233,11 @@ def register_lock_service(rpc: RPCServer, locker: LocalLocker,
                 time.sleep(sweep_interval_s)
             try:
                 locker.expire_old_locks()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — sweeper must outlive
+                pass           # any one locker's hiccup
 
-    threading.Thread(target=sweeper, daemon=True).start()
+    threading.Thread(target=sweeper, daemon=True,
+                     name="mt-dsync-expiry").start()
 
 
 class RemoteLocker:
@@ -280,7 +282,7 @@ class _Refresher:
     every GET/HEAD/DELETE — the hottest paths."""
 
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = mtlock("dsync.refresher")
         self._items: dict[int, "DRWMutex"] = {}
         self._wake = threading.Event()
         self._thread: threading.Thread | None = None
@@ -385,7 +387,7 @@ class DRWMutex:
             if ok:
                 return True
             return False
-        mu = threading.Lock()
+        mu = mtlock("dsync.acquire-fanout")
         state = {"accepting": True}
         self._granted = [False] * len(self.lockers)
 
@@ -405,10 +407,11 @@ class DRWMutex:
             if ok:
                 try:
                     lk.unlock(self.resource, self.uid)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — peer down: its
+                    pass           # grant expires by refresh timeout
 
-        threads = [threading.Thread(target=one, args=(i, lk), daemon=True)
+        threads = [threading.Thread(target=one, args=(i, lk), daemon=True,
+                                    name=f"mt-dsync-unlock-{i}")
                    for i, lk in enumerate(self.lockers)]
         for t in threads:
             t.start()
@@ -428,8 +431,8 @@ class DRWMutex:
             if self._granted[i]:
                 try:
                     lk.unlock(self.resource, self.uid)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — peer down: its
+                    pass           # grant expires by refresh timeout
                 self._granted[i] = False
 
     def lock(self, write: bool = True, timeout: float = 10.0) -> None:

@@ -138,7 +138,8 @@ class FaultyProxy:
 
     def start(self) -> "FaultyProxy":
         self._thread = threading.Thread(target=self._accept_loop,
-                                        daemon=True)
+                                        daemon=True,
+                                        name="mt-faulty-accept")
         self._thread.start()
         return self
 
@@ -180,7 +181,8 @@ class FaultyProxy:
                 fault = self._plan.get(self._conn_nr, self._default)
             self._track(client)
             threading.Thread(target=self._serve, args=(client, fault),
-                             daemon=True).start()
+                             daemon=True,
+                             name="mt-faulty-serve").start()
 
     def _serve(self, client: socket.socket, fault: Fault) -> None:
         try:
@@ -215,7 +217,7 @@ class FaultyProxy:
             try:
                 t1 = threading.Thread(
                     target=self._pipe, args=(client, up, None),
-                    daemon=True)
+                    daemon=True, name="mt-faulty-pipe")
                 t1.start()
                 # upstream -> client carries the reset budget: a
                 # mid-BODY reset needs the response underway first

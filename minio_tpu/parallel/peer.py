@@ -228,7 +228,8 @@ class PeerNotifier:
                         except Exception:  # noqa: BLE001 — peer down:
                             pass           # it reloads fully on restart
 
-                threading.Thread(target=worker, daemon=True).start()
+                threading.Thread(target=worker, daemon=True,
+                                 name="mt-peer-fanout").start()
             return q
 
     def _fanout(self, method: str, **kwargs) -> None:
@@ -301,8 +302,8 @@ class PeerNotifier:
         for c in self.clients:
             try:
                 out.extend(c.call("peer", "log_recent", n=n))
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — downed peer: the
+                pass           # aggregate serves who answered
         return out
 
     # -- parallel control-plane fan-out (self-measurement) -----------------
@@ -334,7 +335,8 @@ class PeerNotifier:
                           f"{type(e).__name__}: {e}"))
 
         for c in self.clients:
-            threading.Thread(target=one, args=(c,), daemon=True).start()
+            threading.Thread(target=one, args=(c,), daemon=True,
+                             name="mt-peer-call").start()
         deadline = time.monotonic() + timeout_s
         pending = {c.endpoint for c in self.clients}
         while pending:

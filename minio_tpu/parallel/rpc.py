@@ -24,6 +24,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import msgpack
 
 from ..obs import trace as _trace
+from ..utils.locktrace import mtlock
 
 TOKEN_WINDOW_S = 15 * 60
 
@@ -308,7 +309,7 @@ class CircuitBreaker:
         self.fail_max = max(1, int(fail_max))
         self.cooldown_s = cooldown_s
         self._clock = clock
-        self._mu = threading.Lock()
+        self._mu = mtlock("rpc.breaker")
         self._state = self.CLOSED
         self._failures = 0
         self._opened_at = 0.0
@@ -404,7 +405,7 @@ class RPCServer:
         # connections through parked handler threads — a killed peer
         # that is not actually dead
         self._conns: set = set()
-        self._conns_mu = threading.Lock()
+        self._conns_mu = mtlock("rpc.server-conns")
         handler = self._make_handler()
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
@@ -450,7 +451,8 @@ class RPCServer:
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
-                                        daemon=True)
+                                        daemon=True,
+                                        name="mt-rpc-server")
         self._thread.start()
 
     def stop(self) -> None:
@@ -730,7 +732,7 @@ class DynamicTimeout:
         self.window = window
         self._timeout = initial
         self._samples: list[float] = []
-        self._mu = threading.Lock()
+        self._mu = mtlock("rpc.timeout-window")
 
     def timeout(self) -> float:
         with self._mu:
@@ -829,7 +831,7 @@ class RPCClient:
         self.breaker = breaker
         self.retry = retry
         self._pool: list[http.client.HTTPConnection] = []
-        self._pool_mu = threading.Lock()
+        self._pool_mu = mtlock("rpc.conn-pool")
 
     def _get_conn(self, timeout: float
                   ) -> tuple[http.client.HTTPConnection, bool]:

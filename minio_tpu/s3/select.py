@@ -3,7 +3,7 @@
 
 from __future__ import annotations
 
-from ..s3select import SelectError, run_select  # noqa: F401
+from ..s3select import SelectError, run_select  # noqa: F401 — re-export
 
 
 def run(payload: bytes, data: bytes, content_type: str = "") -> bytes:

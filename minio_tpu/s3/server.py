@@ -384,6 +384,9 @@ class S3Server:
         self.reload_rpc_config()
         # push ``codec`` batching knobs into the shared batcher
         self.reload_codec_config()
+        # push ``heal``/``scanner`` pacing into attached background
+        # planes (they may also attach later via attach_background)
+        self.reload_background_config()
 
     def reload_api_config(self) -> None:
         """(Re)derive the request-plane knobs from the ``api`` kvconfig
@@ -481,6 +484,38 @@ class S3Server:
             _batcher.CONFIG.load(self.config)
         except Exception:  # noqa: BLE001 — bad knob must not kill boot
             pass
+
+    def reload_background_config(self) -> None:
+        """Push the ``heal``/``scanner`` pacing knobs into every
+        attached background plane (attach_background) — at boot and
+        after admin SetConfigKV, so heal/scan IO yielding retunes on a
+        live server.  Duck-typed on the pacing attributes: a healer
+        exposes ``pace_s``/``deep_every``, a crawler
+        ``delay_mult``/``max_wait_s``."""
+        cfg = self.config
+        try:
+            bitrot = cfg.get("heal", "bitrotscan") == "on"
+            pace = _parse_duration(cfg.get("heal", "max_sleep") or "1s")
+            delay = float(cfg.get("scanner", "delay") or 0)
+            max_wait = _parse_duration(
+                cfg.get("scanner", "max_wait") or "15s")
+        except (KeyError, ValueError):
+            return
+        for svc in getattr(self, "_background", []):
+            if hasattr(svc, "pace_s"):
+                svc.pace_s = pace
+                # bitrotscan=on forces deep sweeps; turning it back
+                # off must RESTORE the constructed cadence (the
+                # override is remembered so a live off actually lands)
+                if bitrot and not hasattr(svc, "_bitrot_prev"):
+                    svc._bitrot_prev = svc.deep_every
+                    svc.deep_every = 1       # deep-scan EVERY sweep
+                elif not bitrot and hasattr(svc, "_bitrot_prev"):
+                    svc.deep_every = svc._bitrot_prev
+                    del svc._bitrot_prev
+            if hasattr(svc, "delay_mult"):
+                svc.delay_mult = delay
+                svc.max_wait_s = max_wait
 
     def reload_egress_config(self) -> None:
         """(Re)build every config-driven egress target from the
@@ -591,10 +626,14 @@ class S3Server:
         (initDataCrawler / initBackgroundHealing, cmd/server-main.go)."""
         self._background = getattr(self, "_background", [])
         self._background.extend(services)
+        # late attachments pick up the ``heal``/``scanner`` pacing
+        # knobs the boot-time reload could not reach
+        self.reload_background_config()
 
     def start(self) -> None:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
-                                        daemon=True)
+                                        daemon=True,
+                                        name="mt-s3-server")
         self._thread.start()
         for svc in getattr(self, "_background", []):
             svc.start()
@@ -1069,8 +1108,8 @@ def _make_handler(srv: S3Server):
                     self.close_connection = True
                     try:    # 503s must show up in trace/audit streams
                         self._record_request()
-                    except Exception:  # noqa: BLE001
-                        pass
+                    except Exception:  # noqa: BLE001 — the 503 itself
+                        pass           # must still reach the client
                 return
             # slow-body watchdog: absolute per-request budget for
             # reading the body (size-scaled), armed for everything

@@ -323,7 +323,7 @@ def _frames(req: SelectRequest, query, chunks, block_bytes: int,
         # whole-value inputs: Parquet needs footer-first random access,
         # a JSON DOCUMENT is one value — materialize (the documented
         # non-streaming fallback; CSV and JSON Lines stay O(block))
-        data = b"".join(src)
+        data = b"".join(src)   # whole-body-ok — the documented materializing fallback (governor charges 2x the decoded estimate, docs/resilience.md)
         if req.input_format == "PARQUET":
             from . import parquet as pq
             try:
@@ -395,4 +395,5 @@ def run_select(payload: bytes, data: bytes) -> bytes:
     ARE the same code, so their outputs are byte-identical by
     construction (pinned anyway by tests/test_select_stream.py).
     block_bytes=0: the single whole-buffer chunk is not re-split."""
-    return b"".join(run_select_stream(payload, (data,), block_bytes=0))
+    return b"".join(   # whole-body-ok — the whole-buffer compat wrapper IS this join; callers with real streams use run_select_stream
+        run_select_stream(payload, (data,), block_bytes=0))

@@ -22,9 +22,9 @@ faults land:
     ``SOAK_r*.json`` scenario-matrix report (``bench.py soak``).
 """
 
-from .chaos import ChaosConductor, Event, SoakCluster  # noqa: F401
-from .report import (Scenario, SoakStatus, run_matrix,  # noqa: F401
+from .chaos import ChaosConductor, Event, SoakCluster  # noqa: F401 — public API
+from .report import (Scenario, SoakStatus, run_matrix,  # noqa: F401 — public API
                      run_scenario)
-from .slo import (Budget, assert_converged,  # noqa: F401
+from .slo import (Budget, assert_converged,  # noqa: F401 — public API
                   settled_thread_count)
-from .workload import MIXES, Mix, WorkloadGenerator  # noqa: F401
+from .workload import MIXES, Mix, WorkloadGenerator  # noqa: F401 — public API

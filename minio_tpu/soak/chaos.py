@@ -200,8 +200,8 @@ class SoakCluster:
                 continue
             try:
                 close_write_planes(lay)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — teardown continues past
+                pass           # a plane wedged by injected faults
             for s in getattr(lay, "sets", []):
                 pool = getattr(s, "_pool", None)
                 if pool is not None:
@@ -209,8 +209,8 @@ class SoakCluster:
         for p in self.proxies:
             try:
                 p.stop()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 — teardown continues past
+                pass           # a proxy that already died
 
 
 @dataclass(frozen=True)

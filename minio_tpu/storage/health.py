@@ -30,6 +30,7 @@ from typing import Callable, Optional
 from . import errors
 from .format import FORMAT_FILE, FormatErasure
 from .xl_storage import SYS_DIR
+from ..utils.locktrace import mtlock
 
 # data-plane methods gated by the circuit breaker; identity/health
 # accessors pass straight through
@@ -139,7 +140,7 @@ class HealthDisk:
         self._offline = False
         self._offline_since = 0.0
         self._next_probe = 0.0
-        self._mu = threading.Lock()
+        self._mu = mtlock("drive.health")
 
     # -- state -------------------------------------------------------------
 
@@ -181,7 +182,8 @@ class HealthDisk:
         if fire and self.on_return is not None:
             # heal kick must not block the call path
             threading.Thread(target=self.on_return, args=(self, how),
-                             daemon=True).start()
+                             daemon=True,
+                             name="mt-drive-heal-kick").start()
 
     # -- probe / reconnect (connectDisks, cmd/erasure-sets.go:196) ---------
 
@@ -327,7 +329,8 @@ class DriveMonitor:
         def loop():
             while not self._stop.wait(self.interval_s):
                 self.poll_once()
-        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="mt-drive-health-poll")
         self._thread.start()
 
     def stop(self) -> None:

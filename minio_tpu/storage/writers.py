@@ -37,6 +37,7 @@ import time
 
 from ..obs import trace as _trace
 from . import errors as serrors
+from ..utils.locktrace import mtlock, mtrlock
 
 
 class PlaneClosed(serrors.StorageError):
@@ -53,7 +54,7 @@ class _Batch:
     def __init__(self, n: int, release=None):
         self._n = n
         self._release = release
-        self._mu = threading.Lock()
+        self._mu = mtlock("putw.quorum-latch")
         self.done = threading.Event()
         if n <= 0:
             self._fire()
@@ -112,7 +113,7 @@ class _DriveWriter:
     def __init__(self, disk, name: str):
         self.disk = disk
         self._q: list[_Op] = []
-        self._cv = threading.Condition()
+        self._cv = threading.Condition(mtrlock("putw.drive-queue"))
         self._closed = False
         self.stalls = 0          # enqueues that hit the depth bound
         self.ops = 0             # ops completed (incl. skipped/failed)
@@ -187,7 +188,7 @@ class StreamWriter:
         self._pending = 0
         self._drive_pending = [0] * len(self.disks)
         self._on_idle: dict[int, list] = {}
-        self._cv = threading.Condition()
+        self._cv = threading.Condition(mtrlock("putw.stream"))
 
     # -- submission --------------------------------------------------------
 
@@ -316,7 +317,7 @@ class WriterPlane:
         # enqueue so admin SetConfigKV retunes a live plane
         self._depth = queue_depth
         self._writers: dict[int, _DriveWriter] = {}
-        self._mu = threading.Lock()
+        self._mu = mtlock("putw.plane")
         self._closed = False
         self._gen = 0            # bumped by close(); stale streams die
         self.used = False        # ever carried an op (metrics idle gate)

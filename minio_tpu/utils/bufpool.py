@@ -19,9 +19,9 @@ put loop bounds batches in flight to the ``pipeline.depth`` knob.
 
 from __future__ import annotations
 
-import threading
 
 import numpy as np
+from .locktrace import mtlock
 
 # total bytes the GLOBAL pool may retain; with 64 MiB stream batches a
 # framed buffer is ~85 MiB, so this keeps a handful of batches across
@@ -31,7 +31,7 @@ DEFAULT_MAX_BYTES = 512 * (1 << 20)
 
 class BufPool:
     def __init__(self, max_bytes: int = DEFAULT_MAX_BYTES):
-        self._mu = threading.Lock()
+        self._mu = mtlock("putw.bufpool")
         self._free: dict[tuple, list[np.ndarray]] = {}
         self._held = 0
         self.max_bytes = max_bytes

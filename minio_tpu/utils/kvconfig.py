@@ -12,8 +12,8 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 from dataclasses import dataclass, field
+from .locktrace import mtlock
 
 ENV_PREFIX = "MT"
 
@@ -121,7 +121,7 @@ register_subsys("rpc", {
     "stream_enable": "on",
     "stream_chunk_bytes": "1048576",
 })
-register_subsys("drive", {
+register_subsys("drive", {  # mt-lint: ok(kvconfig-drift) read per scrape (storage/health.py slow_drives) — SetConfigKV lands at the very next scrape, no reload hook needed
     # slow-drive detection over the last-minute latency windows
     # (obs/lastminute.py + storage/health.py slow_drives): a drive
     # whose p50 exceeds multiple x the set median is flagged in
@@ -162,7 +162,7 @@ register_subsys("codec", {
     "max_batch_blocks": "256",
     "queue_depth": "1024",
 })
-register_subsys("storage_class", {
+register_subsys("storage_class", {  # mt-lint: ok(kvconfig-drift) read per PUT (handlers_object.py) — validated at SetConfigKV time, applies to the next request
     "standard": "",                 # e.g. EC:4
     "rrs": "EC:2",
 })
@@ -175,7 +175,7 @@ register_subsys("scanner", {
     "delay": "10",
     "max_wait": "15s",
 })
-register_subsys("compression", {
+register_subsys("compression", {  # mt-lint: ok(kvconfig-drift) read per request (handlers_object.py) — applies to the next PUT/GET, no reload hook needed
     "enable": "off",
     "extensions": ".txt,.log,.csv,.json,.tar,.xml,.bin",
     "mime_types": "text/*,application/json,application/xml",
@@ -195,19 +195,19 @@ register_subsys("audit_webhook", {"enable": "off", "endpoint": "",
 register_subsys("notify_webhook", {"enable": "off", "endpoint": "",
                                    "auth_token": "", "queue_dir": "",
                                    "queue_limit": "10000"})
-register_subsys("federation", {
+register_subsys("federation", {  # mt-lint: ok(kvconfig-drift) construction-time (utils/fed_dns.py from_config at boot) — changing it requires a restart by design
     "enable": "off",
     "domain": "",                   # bucket.<domain> DNS zone
     "dns_file": "",                 # FileDNSStore path (etcd stand-in)
     "advertise": "",                # routable host:port in DNS records
 })
-register_subsys("etcd", {
+register_subsys("etcd", {  # mt-lint: ok(kvconfig-drift) construction-time (utils/etcd.py client boot) — the coordination backend cannot be swapped live
     # cmd/config/etcd/etcd.go keys: the coordination backend for
     # config/IAM storage and CoreDNS federation records
     "endpoints": "",                # comma-separated http://host:port
     "path_prefix": "",              # namespace all keys (multi-tenant)
 })
-register_subsys("identity_ldap", {
+register_subsys("identity_ldap", {  # mt-lint: ok(kvconfig-drift) read per STS/login call (iam/ldap.py) — each auth round reads the live values
     # cmd/config/identity/ldap/config.go keys, 1:1
     "server_addr": "",
     "sts_expiry": "1h",
@@ -218,7 +218,7 @@ register_subsys("identity_ldap", {
     "group_search_filter": "",          # %s -> username, %d -> user DN
     "group_search_base_dn": "",
 })
-register_subsys("identity_openid", {
+register_subsys("identity_openid", {  # mt-lint: ok(kvconfig-drift) read per STS validation (iam/openid.py from_config) — each token check reads the live values
     "enable": "off",
     "issuer": "",                   # expected iss claim
     "client_id": "",                # expected aud/azp
@@ -272,8 +272,8 @@ class Config:
     def __init__(self, layer=None):
         self._layer = layer
         self._dynamic: dict[str, dict[str, str]] = {}
-        self._mu = threading.Lock()
-        self._persist_mu = threading.Lock()
+        self._mu = mtlock("config.dynamic")
+        self._persist_mu = mtlock("config.persist")
         if layer is not None:
             self._load()
 

@@ -1,0 +1,406 @@
+"""Runtime lock-order / deadlock detector — the dynamic half of the
+concurrency analysis plane (docs/static-analysis.md).
+
+A GIL'd runtime has no -race flag, but the failure class the Go race
+detector guards the reference against still exists here one level up:
+*lock-order inversion*.  Two threads taking the same pair of locks in
+opposite order deadlock exactly once a year, in production, under
+load.  This module makes that ordering observable and assertable:
+
+* :func:`mtlock` / :func:`mtrlock` are drop-in factories the data
+  plane uses instead of ``threading.Lock()`` / ``RLock()``.  When
+  tracing is OFF (the default) they return the plain primitive — zero
+  wrapper, zero overhead on the hot path.  When tracing is ON
+  (``MT_LOCK_TRACE=1`` in the environment, or :func:`enable` before
+  the locks are constructed) they return a :class:`TracedLock`.
+
+* every traced acquisition records, per thread, the stack of locks
+  currently held; holding ``a`` while acquiring ``b`` adds the edge
+  ``a -> b`` to a process-global *lock-order graph* keyed by lock
+  NAME (instances aggregate — ``storage.writer-queue`` is one node no
+  matter how many drives own one).  Same-name nesting (two drives'
+  queues, dsync's per-resource locks) is recorded separately as a
+  ``self_nest`` count, not an edge: instance-level ordering is the
+  caller's contract and a name-level self-edge would report every
+  such pattern as a false cycle.
+
+* :func:`cycles` runs SCC detection over the graph — any strongly
+  connected component larger than one lock is a potential AB/BA
+  deadlock, reported with the witness edges and the first acquisition
+  site of each direction.  :func:`assert_acyclic` raises with that
+  report; the tier-1 soak smoke and the chaos drills call it after
+  driving real traffic through a fault timeline.
+
+* *long holds under contention*: a lock held longer than
+  ``long_hold_s`` (default 0.5s, env ``MT_LOCK_TRACE_LONG_HOLD_S``)
+  while at least one other thread was blocked waiting on it is
+  recorded — the slow-under-lock class the static ``lock-discipline``
+  rule hunts lexically, caught dynamically when it hides behind a
+  call boundary.
+
+Scrape families (admin/metrics.py, idle contract: tracing off or an
+empty graph emits nothing): ``mt_lock_order_edges_total``,
+``mt_lock_cycles_total``, ``mt_lock_long_holds_total``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+# guards enable/reset + graph writes.  RLock, NOT Lock: recording runs
+# inside arbitrary acquire/release paths, and an allocation under it
+# can trigger cyclic GC whose finalizers (memgov Charge.__del__ —
+# see MemoryGovernor._mu's comment) acquire a TracedLock on the SAME
+# thread, re-entering the recorder; a plain Lock would self-deadlock.
+_STATE_MU = threading.RLock()
+_enabled = os.environ.get("MT_LOCK_TRACE", "") not in ("", "0", "off")
+
+try:
+    LONG_HOLD_S = float(os.environ.get("MT_LOCK_TRACE_LONG_HOLD_S",
+                                       "0.5"))
+except ValueError:
+    LONG_HOLD_S = 0.5
+
+# name-keyed order graph: (held_name, acquired_name) -> count, plus a
+# witness site (thread name at first observation) per direction
+_edges: dict[tuple[str, str], int] = {}
+_edge_witness: dict[tuple[str, str], str] = {}
+_self_nests: dict[str, int] = {}
+# long holds: (name, seconds, thread) tuples, bounded
+_long_holds: list[tuple[str, float, str]] = []
+_MAX_LONG_HOLDS = 256
+# total traced acquisitions (proof the trace actually saw the plane —
+# an all-green acyclicity assertion over zero acquisitions is vacuous)
+_acquires = 0
+
+_local = threading.local()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable() -> None:
+    """Turn tracing on for locks constructed FROM NOW ON (factories
+    decide at construction; import-time singletons keep plain locks
+    unless ``MT_LOCK_TRACE`` was set at process start)."""
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def reset() -> None:
+    """Drop the recorded graph (between test scenarios)."""
+    global _acquires
+    with _STATE_MU:
+        _edges.clear()
+        _edge_witness.clear()
+        _self_nests.clear()
+        del _long_holds[:]
+        _acquires = 0
+
+
+def acquire_count() -> int:
+    return _acquires
+
+
+def _held_stack() -> list:
+    st = getattr(_local, "stack", None)
+    if st is None:
+        st = _local.stack = []
+    return st
+
+
+class TracedLock:
+    """A named Lock/RLock recording acquisition order and hold times.
+
+    Drop-in for ``threading.Lock``/``RLock`` (context manager,
+    ``acquire(blocking, timeout)``, ``release``, ``locked``) — also
+    accepted by ``threading.Condition(lock=...)``."""
+
+    __slots__ = ("name", "_inner", "_reentrant", "_waiters",
+                 "_acquired_at", "_contended")
+
+    def __init__(self, name: str, *, rlock: bool = False):
+        self.name = name
+        self._inner = threading.RLock() if rlock else threading.Lock()
+        self._reentrant = rlock
+        self._waiters = 0          # racy int under the GIL: a hint
+        self._acquired_at = 0.0
+        self._contended = False
+
+    # -- acquisition bookkeeping -------------------------------------------
+
+    def _note_acquired(self, reentry: bool) -> None:
+        global _acquires
+        _acquires += 1          # racy int under the GIL: a lower bound
+        stack = _held_stack()
+        if not reentry:
+            seen = set()
+            for held in stack:
+                hn = held.name
+                if hn in seen:
+                    continue
+                seen.add(hn)
+                if hn == self.name:
+                    with _STATE_MU:
+                        _self_nests[hn] = _self_nests.get(hn, 0) + 1
+                    continue
+                key = (hn, self.name)
+                with _STATE_MU:
+                    _edges[key] = _edges.get(key, 0) + 1
+                    if key not in _edge_witness:
+                        _edge_witness[key] = \
+                            threading.current_thread().name
+        stack.append(self)
+        self._acquired_at = time.monotonic()
+
+    def _note_released(self) -> None:
+        stack = _held_stack()
+        # pop the most recent entry for self (release order may not be
+        # strictly LIFO across locks)
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                break
+        held_for = time.monotonic() - self._acquired_at
+        if held_for >= LONG_HOLD_S and (self._contended or
+                                        self._waiters > 0):
+            with _STATE_MU:
+                if len(_long_holds) < _MAX_LONG_HOLDS:
+                    _long_holds.append(
+                        (self.name, held_for,
+                         threading.current_thread().name))
+        self._contended = False
+
+    # -- lock protocol ------------------------------------------------------
+
+    def _depths(self) -> dict:
+        d = getattr(_local, "depth", None)
+        if d is None:
+            d = _local.depth = {}
+        return d
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        depths = self._depths()
+        if self._reentrant and depths.get(id(self), 0) > 0:
+            # re-entry on a lock this thread already owns: no new
+            # ordering information, just deepen
+            got = self._inner.acquire(blocking, timeout)
+            if got:
+                depths[id(self)] += 1
+            return got
+        contended = self._waiters > 0 or self._inner_locked()
+        self._waiters += 1
+        try:
+            got = self._inner.acquire(blocking, timeout)
+        finally:
+            self._waiters -= 1
+        if got:
+            self._contended = contended
+            self._note_acquired(reentry=False)
+            if self._reentrant:
+                depths[id(self)] = 1
+        return got
+
+    def release(self) -> None:
+        if self._reentrant:
+            depths = self._depths()
+            d = depths.get(id(self), 0)
+            if d > 1:
+                depths[id(self)] = d - 1
+                self._inner.release()
+                return
+            depths.pop(id(self), None)
+        self._note_released()
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        return self._inner_locked()
+
+    def _inner_locked(self) -> bool:
+        inner = self._inner
+        if hasattr(inner, "locked"):
+            return inner.locked()
+        # RLock on 3.10 has no locked(); owned-by-anyone approximation
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    # Condition(lock=...) integration: delegate the save/restore hooks
+    # so cond.wait() keeps the order stack balanced
+    def _release_save(self):
+        self._note_released()
+        if hasattr(self._inner, "_release_save"):
+            return self._inner._release_save()
+        self._inner.release()
+        return None
+
+    def _acquire_restore(self, state) -> None:
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+        self._note_acquired(reentry=False)
+
+    def _is_owned(self) -> bool:
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        return any(l is self for l in _held_stack())
+
+    def __repr__(self) -> str:
+        return f"<TracedLock {self.name!r}>"
+
+
+def mtlock(name: str):
+    """A mutex for the threaded data plane: plain ``threading.Lock``
+    when tracing is off (zero overhead), a named :class:`TracedLock`
+    when on."""
+    if _enabled:
+        return TracedLock(name)
+    return threading.Lock()
+
+
+def mtrlock(name: str):
+    """Reentrant variant of :func:`mtlock`."""
+    if _enabled:
+        return TracedLock(name, rlock=True)
+    return threading.RLock()
+
+
+# -- graph queries -----------------------------------------------------------
+
+
+def snapshot() -> dict:
+    """{edges: {(a,b): count}, self_nests, long_holds} — a consistent
+    copy for assertions and the scrape."""
+    with _STATE_MU:
+        return {"edges": dict(_edges),
+                "witness": dict(_edge_witness),
+                "self_nests": dict(_self_nests),
+                "long_holds": list(_long_holds)}
+
+
+def cycles() -> list[list[str]]:
+    """Strongly connected components with more than one lock in the
+    recorded order graph — each is a potential AB/BA deadlock."""
+    with _STATE_MU:
+        adj: dict[str, set[str]] = {}
+        for (a, b) in _edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+    # iterative Tarjan SCC
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    out: list[list[str]] = []
+    counter = [0]
+    for root in adj:
+        if root in index:
+            continue
+        work = [(root, iter(sorted(adj[root])))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj[w]))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1:
+                    out.append(sorted(comp))
+    return out
+
+
+def long_holds() -> list[tuple[str, float, str]]:
+    with _STATE_MU:
+        return list(_long_holds)
+
+
+def assert_acyclic(allow_long_holds: bool = False) -> dict:
+    """Raise AssertionError naming each cycle's locks and witness
+    edges if the recorded order graph has one; returns the snapshot
+    (edge count, long holds) when clean."""
+    snap = snapshot()
+    cyc = cycles()
+    if cyc:
+        detail = []
+        for comp in cyc:
+            members = set(comp)
+            witnesses = [
+                f"{a}->{b} (x{n}, first by {snap['witness'][(a, b)]})"
+                for (a, b), n in sorted(snap["edges"].items())
+                if a in members and b in members]
+            detail.append(f"cycle {comp}: {'; '.join(witnesses)}")
+        raise AssertionError(
+            "lock-order cycles (potential AB/BA deadlock): "
+            + " | ".join(detail))
+    if not allow_long_holds and snap["long_holds"]:
+        worst = max(snap["long_holds"], key=lambda h: h[1])
+        raise AssertionError(
+            f"{len(snap['long_holds'])} long lock holds under "
+            f"contention (worst: {worst[0]} held {worst[1]:.3f}s by "
+            f"{worst[2]}; threshold {LONG_HOLD_S}s)")
+    return {"edges": len(snap["edges"]),
+            "self_nests": sum(snap["self_nests"].values()),
+            "long_holds": len(snap["long_holds"])}
+
+
+def render_metrics() -> list[str]:
+    """``mt_lock_*`` exposition lines (admin/metrics.py calls this at
+    scrape time).  Idle contract: tracing off AND nothing recorded =>
+    no families at all."""
+    snap = snapshot()
+    if not _enabled and not snap["edges"] and not snap["long_holds"]:
+        return []
+    if not snap["edges"] and not snap["long_holds"] and \
+            not snap["self_nests"]:
+        return []
+    return [
+        "# TYPE mt_lock_order_edges_total counter",
+        f"mt_lock_order_edges_total {len(snap['edges'])}",
+        "# TYPE mt_lock_cycles_total counter",
+        f"mt_lock_cycles_total {len(cycles())}",
+        "# TYPE mt_lock_long_holds_total counter",
+        f"mt_lock_long_holds_total {len(snap['long_holds'])}",
+    ]

@@ -31,9 +31,9 @@ Knobs live in the ``api`` kvconfig subsystem (``mem_limit``,
 
 from __future__ import annotations
 
-import threading
 
 from ..admin.metrics import GLOBAL as _metrics
+from .locktrace import mtrlock
 
 
 class MemoryPressure(Exception):
@@ -104,7 +104,7 @@ class MemoryGovernor:
         # allocation under charge()/stats() collecting a leaked
         # Charge) — a plain Lock would self-deadlock the request
         # thread; RLock makes the nested release safe
-        self._mu = threading.RLock()
+        self._mu = mtrlock("memgov.governor")
         self.limit_bytes = limit_bytes
         self.retry_after_s = retry_after_s
         self._inuse: dict[str, int] = {}

@@ -59,7 +59,7 @@ def load(src: str, so: str, timeout: int = 120) -> ctypes.CDLL | None:
                     tmp = so + f".tmp{os.getpid()}"
                     cc = os.environ.get("CC", "g++" if src.endswith(
                         (".cc", ".cpp")) else "cc")
-                    subprocess.run(
+                    subprocess.run(  # mt-lint: ok(lock-discipline) one-time lazy build: waiters NEED the .so this compile produces; double-checked via _cache so it runs once per process
                         [cc, "-O3", "-shared", "-fPIC", *extra,
                          "-o", tmp, src],
                         check=True, capture_output=True, timeout=timeout)

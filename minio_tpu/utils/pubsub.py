@@ -9,15 +9,15 @@ log ring.
 from __future__ import annotations
 
 import queue
-import threading
 import time
 from typing import Any, Callable, Iterator, Optional
+from .locktrace import mtlock
 
 
 class PubSub:
     def __init__(self, max_queue: int = 1000):
         self._subs: list[tuple[queue.Queue, Optional[Callable]]] = []
-        self._mu = threading.Lock()
+        self._mu = mtlock("obs.pubsub")
         self._max_queue = max_queue
         self._ring = None                 # seq-numbered tail for peer polls
         self._ring_until = 0.0

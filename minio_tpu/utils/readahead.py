@@ -31,7 +31,8 @@ class Readahead:
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._closed = threading.Event()
         self._thread = threading.Thread(
-            target=self._produce, args=(iter(it),), daemon=True)
+            target=self._produce, args=(iter(it),), daemon=True,
+            name="mt-readahead")
         self._thread.start()
 
     def _produce(self, it: Iterator) -> None:
@@ -56,8 +57,8 @@ class Readahead:
             if close is not None:
                 try:
                     close()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception:  # noqa: BLE001 — source close is
+                    pass           # best-effort on the way down
 
     def _put_forever(self, item) -> None:
         while not self._closed.is_set():

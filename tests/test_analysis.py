@@ -1,0 +1,328 @@
+"""Per-rule canaries for the AST lint framework (minio_tpu/analysis/).
+
+Every shipped rule must provably catch a seeded violation — a tiny bad
+module string it MUST flag — and pass its clean twin, or the tier-1
+lint gate is not evidence.  The CLI contract rides along: ``python -m
+minio_tpu.analysis --json`` exits non-zero with a machine-readable
+report on a seeded violation and exits 0 over the real tree.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+from minio_tpu.analysis import run_tree
+from minio_tpu.analysis.core import default_repo_root
+
+
+_case = [0]
+
+
+def _lint(tmp_path, files, docs=None):
+    """Write ``files`` under a FRESH <case>/minio_tpu root (the scoped
+    rules key off that prefix; isolation keeps one call's fixtures out
+    of the next call's findings) and run every rule over them."""
+    _case[0] += 1
+    root = tmp_path / f"case{_case[0]}"
+    for rel, src in files.items():
+        p = root / "minio_tpu" / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    for rel, text in (docs or {}).items():
+        p = root / "docs" / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(text)
+    return run_tree(repo=str(root))
+
+
+def _rules_hit(findings):
+    return {f.rule for f in findings}
+
+
+# -- absorbed rules ----------------------------------------------------------
+
+def test_parse_canary(tmp_path):
+    bad = _lint(tmp_path, {"m.py": "def broken(:\n"})
+    assert _rules_hit(bad) == {"parse"}
+    assert "does not parse" in bad[0].message
+
+
+def test_bare_except_canary(tmp_path):
+    bad = _lint(tmp_path, {"m.py": """
+        try:
+            x = 1
+        except:
+            pass
+        """})
+    assert any(f.rule == "bare-except" and f.line == 4 for f in bad), bad
+    clean = _lint(tmp_path, {"m.py": """
+        try:
+            x = 1
+        except ValueError:
+            x = 2
+        """})
+    assert not clean, clean
+
+
+def test_mutable_default_canary(tmp_path):
+    bad = _lint(tmp_path, {"m.py": "def f(a, b=[]):\n    return b\n"})
+    assert any(f.rule == "mutable-default" and "f" in f.message
+               for f in bad), bad
+    clean = _lint(tmp_path,
+                  {"m.py": "def f(a, b=None):\n    return b\n"})
+    assert not clean, clean
+
+
+def test_unused_import_canary(tmp_path):
+    bad = _lint(tmp_path, {"m.py": "import os\nimport sys\nprint(sys)\n"})
+    assert any(f.rule == "unused-import" and "os" in f.message
+               for f in bad), bad
+    # the historical noqa marker still exempts side-effect imports —
+    # but only WITH a reason (the suppression-grammar contract)
+    clean = _lint(tmp_path, {
+        "m.py": "import os  # noqa — registry side effect\n"})
+    assert not clean, clean
+    bare = _lint(tmp_path, {"m.py": "import os  # noqa: F401\n"})
+    assert any("needs a reason" in f.message for f in bare), bare
+
+
+def test_whole_body_read_canary(tmp_path):
+    bad = _lint(tmp_path, {"s3/h.py": """
+        def handler(layer, self):
+            data = layer.get_object("b", "k")
+            body = self.rfile.read()
+            return data, body
+        """})
+    msgs = [f.message for f in bad if f.rule == "whole-body-read"]
+    assert any("get_object" in m for m in msgs), bad
+    assert any("read()" in m for m in msgs), bad
+    # the s3select materialization shape + its documented-fallback marker
+    bad2 = _lint(tmp_path, {"s3select/m.py": """
+        def materialize(src):
+            return b"".join(src)
+        """})
+    assert any("join() materializes" in f.message for f in bad2), bad2
+    clean = _lint(tmp_path, {"s3select/m.py": """
+        def materialize(src):
+            return b"".join(src)   # whole-body-ok — documented fallback
+        """})
+    assert not clean, clean
+    # a reason-less legacy marker does not silently suppress
+    bare = _lint(tmp_path, {"s3select/m.py": """
+        def materialize(src):
+            return b"".join(src)   # whole-body-ok
+        """})
+    assert any("without a reason" in f.message for f in bare), bare
+    # ranged reads and the exempt client module stay unflagged
+    clean2 = _lint(tmp_path, {"s3/h.py": """
+        def handler(layer):
+            return layer.get_object("b", "k", 0, 1024)
+        """})
+    assert not clean2, clean2
+
+
+# -- concurrency rules -------------------------------------------------------
+
+def test_lock_discipline_bare_acquire_canary(tmp_path):
+    bad = _lint(tmp_path, {"m.py": """
+        def f(self):
+            self._mu.acquire()
+            self.n += 1
+            self._mu.release()
+        """})
+    assert any(f.rule == "lock-discipline" and "bare" in f.message
+               for f in bad), bad
+    clean = _lint(tmp_path, {"m.py": """
+        def f(self):
+            self._mu.acquire()
+            try:
+                self.n += 1
+            finally:
+                self._mu.release()
+        """})
+    assert not clean, clean
+
+
+def test_lock_discipline_blocking_call_canary(tmp_path):
+    bad = _lint(tmp_path, {"m.py": """
+        import time
+
+        def f(self, sock, th, fut):
+            with self._mu:
+                time.sleep(1.0)
+                sock.sendall(b"x")
+                th.join()
+                fut.result()
+        """})
+    msgs = [f.message for f in bad if f.rule == "lock-discipline"]
+    assert len(msgs) == 4, bad
+    assert all("inside a `with self._mu` body" in m for m in msgs)
+    # cond.wait on the held condition RELEASES it: not blocking;
+    # nested function bodies do not run under the lock
+    clean = _lint(tmp_path, {"m.py": """
+        import time
+
+        def f(self, items):
+            with self._cv:
+                self._cv.wait(0.1)
+                later = [x for x in items]
+
+                def cb():
+                    time.sleep(1.0)
+                return cb
+        """})
+    assert not clean, clean
+
+
+def test_thread_discipline_canary(tmp_path):
+    bad = _lint(tmp_path, {"m.py": """
+        import threading
+
+        def f(work):
+            threading.Thread(target=work).start()
+            threading.Thread(target=work, daemon=True).start()
+            threading.Thread(target=work, daemon=True,
+                             name="worker-1").start()
+        """})
+    msgs = [f.message for f in bad if f.rule == "thread-discipline"]
+    # site 1: no daemon AND no name; site 2: no name; site 3: bad prefix
+    assert len(msgs) == 4, bad
+    assert sum("daemon" in m for m in msgs) == 1
+    assert sum("anonymous" in m for m in msgs) == 2
+    assert sum("must start" in m for m in msgs) == 1
+    clean = _lint(tmp_path, {"m.py": """
+        import threading
+
+        def f(work, i):
+            threading.Thread(target=work, daemon=True,
+                             name=f"mt-canary-{i}").start()
+        """})
+    assert not clean, clean
+
+
+def test_swallowed_exception_canary(tmp_path):
+    bad = _lint(tmp_path, {"m.py": """
+        def f():
+            try:
+                risky()
+            except Exception:
+                pass
+        """})
+    assert any(f.rule == "swallowed-exception" for f in bad), bad
+    # narrow catches, handled bodies, and reasoned swallows all pass
+    clean = _lint(tmp_path, {"m.py": """
+        def f(log):
+            try:
+                risky()
+            except OSError:
+                pass
+            try:
+                risky()
+            except Exception as e:  # noqa: BLE001 — surfaced to caller
+                pass
+            try:
+                risky()
+            except Exception:  # mt-lint: ok(swallowed-exception) probe only
+                pass
+            try:
+                risky()
+            except Exception:
+                log.error("boom")
+        """})
+    assert not clean, clean
+
+
+def test_kvconfig_drift_canary(tmp_path):
+    files = {"utils/kvconfig.py": """
+        def register_subsys(name, defaults):
+            pass
+
+        register_subsys("canary", {"knob_a": "1", "knob_b": "2"})
+        register_subsys("wired", {"w": "1"})
+        """,
+             "srv.py": """
+        def reload_wired_config(cfg):
+            return cfg.get("wired", "w")
+        """}
+    bad = _lint(tmp_path, files,
+                docs={"config.md": "| `wired.w` | live |"})
+    msgs = [f.message for f in bad if f.rule == "kvconfig-drift"]
+    assert any("canary.knob_a" in m and "not documented" in m
+               for m in msgs), bad
+    assert any("canary.knob_b" in m for m in msgs)
+    assert any("'canary' is not read from any" in m for m in msgs)
+    assert not any("wired" in m for m in msgs), msgs
+    clean = _lint(tmp_path, {
+        "utils/kvconfig.py": """
+        def register_subsys(name, defaults):
+            pass
+
+        register_subsys(  # mt-lint: ok(kvconfig-drift) canary fixture
+            "canary", {"knob_a": "1", "knob_b": "2"})
+        register_subsys("wired", {"w": "1"})
+        """,
+        "srv.py": files["srv.py"]},
+        docs={"config.md": "| `wired.w` | `canary.knob_a` "
+                           "| `canary.knob_b` |"})
+    assert not clean, clean
+
+
+def test_suppression_grammar_is_itself_linted(tmp_path):
+    # reason-less suppression: the target finding is silenced but the
+    # marker itself fails the run
+    bad = _lint(tmp_path, {"m.py": """
+        def f():
+            try:
+                risky()
+            except Exception:  # mt-lint: ok(swallowed-exception)
+                pass
+        """})
+    assert _rules_hit(bad) == {"suppression"}, bad
+    assert "without a reason" in bad[0].message
+    # unknown rule id in a marker is a finding too
+    bad2 = _lint(tmp_path, {"m.py": """
+        x = 1  # mt-lint: ok(made-up-rule) because reasons
+        """})
+    assert any("unknown rule" in f.message for f in bad2), bad2
+
+
+# -- the CLI contract --------------------------------------------------------
+
+def test_cli_json_exits_nonzero_with_report(tmp_path):
+    pkg = tmp_path / "minio_tpu"
+    pkg.mkdir()
+    (pkg / "m.py").write_text("try:\n    x = 1\nexcept:\n    pass\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "minio_tpu.analysis", "--json",
+         "--root", str(tmp_path)],
+        capture_output=True, text=True, cwd=default_repo_root())
+    assert r.returncode == 1, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["count"] == 1
+    f = doc["findings"][0]
+    assert f["rule"] == "bare-except" and f["line"] == 3
+    assert f["path"] == "minio_tpu/m.py"
+
+
+def test_cli_clean_over_real_tree():
+    """The CI gate: the shipped tree lints clean through the exact
+    entry point a pipeline would call."""
+    r = subprocess.run(
+        [sys.executable, "-m", "minio_tpu.analysis"],
+        capture_output=True, text=True, cwd=default_repo_root())
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 finding(s)" in r.stdout
+
+
+def test_rule_subset_flag(tmp_path):
+    pkg = tmp_path / "minio_tpu"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(
+        "import os\ntry:\n    x = 1\nexcept:\n    pass\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "minio_tpu.analysis", "--json",
+         "--root", str(tmp_path), "--rule", "unused-import"],
+        capture_output=True, text=True, cwd=default_repo_root())
+    doc = json.loads(r.stdout)
+    assert [f["rule"] for f in doc["findings"]] == ["unused-import"]

@@ -34,6 +34,13 @@ def test_server_start_stop_does_not_leak_threads(tmp_path):
     list(layer._pool.map(time.sleep,
                          [0.05] * layer._pool._max_workers))
     baseline = _settled_thread_count()
+    # thread-discipline accounting: every thread the server planes
+    # start is named mt-* (lint-enforced); anonymous Thread-N threads
+    # appearing during the cycles and surviving a stop would be
+    # unattributable leaks.  Earlier suites' leftovers are excluded by
+    # id-snapshot.
+    anon_before = {id(t) for t in threading.enumerate()
+                   if t.name.startswith("Thread-")}
     ports = []
     for cycle in range(3):
         srv = S3Server(layer, access_key="lk", secret_key="ls")
@@ -48,6 +55,12 @@ def test_server_start_stop_does_not_leak_threads(tmp_path):
     # the shared layer's pool persists; per-server threads must not pile
     # up across cycles (allow a small slack for lazy singletons)
     assert after <= baseline + 3, (baseline, after)
+    anon_new = [t.name for t in threading.enumerate()
+                if t.is_alive() and t.name.startswith("Thread-")
+                and id(t) not in anon_before]
+    assert not anon_new, (
+        f"anonymous threads survived server stop: {anon_new} — "
+        f"name them mt-<subsystem>-... (thread-discipline rule)")
     # every listener actually closed
     for p in ports:
         s = socket.socket()

@@ -1,0 +1,243 @@
+"""Runtime lock-order/deadlock detector tier (utils/locktrace.py) —
+the dynamic half of the concurrency analysis plane.
+
+The canary contract mirrors the static rules': the cycle detector
+MUST catch a deliberately seeded AB/BA pair and the long-hold monitor
+MUST catch a seeded slow hold under contention, or the soak-time
+acyclicity assertion is not evidence.
+"""
+
+import threading
+import time
+
+import pytest
+
+from minio_tpu.utils import locktrace as lt
+
+
+@pytest.fixture
+def traced():
+    """Enable tracing with a clean graph; restore the off-state (and
+    drop the fixture's recordings) afterwards so other suites' scrape
+    idle contracts stay intact."""
+    was = lt.enabled()
+    lt.enable()
+    lt.reset()
+    yield lt
+    if not was:
+        lt.disable()
+    lt.reset()
+
+
+def test_factories_return_plain_primitives_when_disabled():
+    was = lt.enabled()
+    lt.disable()
+    try:
+        assert type(lt.mtlock("x")) is type(threading.Lock())
+        assert type(lt.mtrlock("x")) is type(threading.RLock())
+    finally:
+        if was:
+            lt.enable()
+
+
+def test_order_edges_recorded_per_thread(traced):
+    a, b, c = lt.mtlock("t.a"), lt.mtlock("t.b"), lt.mtlock("t.c")
+
+    def worker():
+        with a:
+            with b:
+                with c:
+                    pass
+
+    t = threading.Thread(target=worker, daemon=True,
+                         name="mt-test-order")
+    t.start()
+    t.join()
+    edges = lt.snapshot()["edges"]
+    assert edges[("t.a", "t.b")] == 1
+    assert edges[("t.a", "t.c")] == 1
+    assert edges[("t.b", "t.c")] == 1
+    assert not lt.cycles()
+    out = lt.assert_acyclic()
+    assert out["edges"] == 3 and out["long_holds"] == 0
+
+
+def test_same_name_nesting_is_not_a_cycle(traced):
+    """Two instances sharing a name (per-drive queues, per-resource
+    dsync locks) nested on one thread must not self-edge into a false
+    cycle — the pattern is recorded separately as a self-nest."""
+    q1, q2 = lt.mtlock("t.drive-queue"), lt.mtlock("t.drive-queue")
+    with q1:
+        with q2:
+            pass
+    snap = lt.snapshot()
+    assert not lt.cycles()
+    assert snap["self_nests"].get("t.drive-queue") == 1
+    assert ("t.drive-queue", "t.drive-queue") not in snap["edges"]
+
+
+def test_rlock_reentry_records_no_edges(traced):
+    r, b = lt.mtrlock("t.r"), lt.mtlock("t.b2")
+    with r:
+        with b:
+            with r:            # re-entry while holding b: NOT b->r
+                pass
+    edges = lt.snapshot()["edges"]
+    assert ("t.r", "t.b2") in edges
+    assert ("t.b2", "t.r") not in edges
+
+
+def test_abba_deadlock_canary_is_caught(traced):
+    """THE canary: a deliberate AB/BA pair (sequenced so it cannot
+    actually deadlock) must be reported as a cycle with witness
+    edges, and assert_acyclic must raise naming both locks."""
+    a, b = lt.mtlock("t.alpha"), lt.mtlock("t.beta")
+    step = threading.Event()
+
+    def one():
+        with a:
+            with b:
+                pass
+        step.set()
+
+    def two():
+        step.wait(5)
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=one, daemon=True, name="mt-test-ab")
+    t2 = threading.Thread(target=two, daemon=True, name="mt-test-ba")
+    t1.start()
+    t2.start()
+    t1.join(5)
+    t2.join(5)
+    assert lt.cycles() == [["t.alpha", "t.beta"]]
+    with pytest.raises(AssertionError) as ei:
+        lt.assert_acyclic()
+    msg = str(ei.value)
+    assert "t.alpha" in msg and "t.beta" in msg
+    assert "AB/BA" in msg
+    assert "mt-test-ab" in msg    # witness thread names survive
+
+
+def test_long_hold_under_contention_canary(traced, monkeypatch):
+    monkeypatch.setattr(lt, "LONG_HOLD_S", 0.2)
+    hot = lt.mtlock("t.hot")
+    entered = threading.Event()
+
+    def holder():
+        with hot:
+            entered.set()
+            time.sleep(0.35)
+
+    def waiter():
+        entered.wait(5)
+        with hot:
+            pass
+
+    h = threading.Thread(target=holder, daemon=True, name="mt-test-h")
+    w = threading.Thread(target=waiter, daemon=True, name="mt-test-w")
+    h.start()
+    w.start()
+    h.join(5)
+    w.join(5)
+    holds = lt.long_holds()
+    assert holds, "seeded long hold not recorded"
+    name, dur, thread = holds[0]
+    assert name == "t.hot" and dur >= 0.2 and thread == "mt-test-h"
+    with pytest.raises(AssertionError, match="long lock holds"):
+        lt.assert_acyclic()
+    # uncontended holds of the same length are NOT noise
+    lt.reset()
+    cold = lt.mtlock("t.cold")
+    with cold:
+        time.sleep(0.25)
+    assert not lt.long_holds()
+    lt.assert_acyclic()
+
+
+def test_condition_integration_keeps_stack_balanced(traced):
+    """threading.Condition(mtrlock(...)): wait() releases and
+    re-acquires through the save/restore hooks — the per-thread held
+    stack must stay balanced and record the re-acquire order."""
+    outer = lt.mtlock("t.outer")
+    cv = threading.Condition(lt.mtrlock("t.cv"))
+    woke = []
+
+    def waiter():
+        with cv:
+            cv.wait(5)
+            woke.append(1)
+            with outer:        # order recorded AFTER the re-acquire
+                pass
+
+    t = threading.Thread(target=waiter, daemon=True, name="mt-test-cv")
+    t.start()
+    time.sleep(0.2)
+    with cv:
+        cv.notify()
+    t.join(5)
+    assert woke
+    edges = lt.snapshot()["edges"]
+    assert ("t.cv", "t.outer") in edges
+    assert not lt.cycles()
+
+
+def test_metrics_idle_contract_and_families(traced):
+    """Untouched detector => no families; recorded graph => the three
+    mt_lock_* families with correct counts."""
+    lt.reset()
+    lt.disable()
+    assert lt.render_metrics() == []
+    lt.enable()
+    assert lt.render_metrics() == []       # enabled but empty: idle
+    a, b = lt.mtlock("t.m1"), lt.mtlock("t.m2")
+    with a:
+        with b:
+            pass
+    text = "\n".join(lt.render_metrics())
+    assert "# TYPE mt_lock_order_edges_total counter" in text
+    assert "mt_lock_order_edges_total 1" in text
+    assert "mt_lock_cycles_total 0" in text
+    assert "mt_lock_long_holds_total 0" in text
+
+
+def test_traced_lock_protocol_surface(traced):
+    """Drop-in surface: acquire(False) contention, locked(), context
+    manager, release-from-wrong-order tolerated."""
+    m = lt.mtlock("t.proto")
+    assert m.acquire(False)
+    assert m.locked()
+    got = []
+
+    def try_steal():
+        got.append(m.acquire(False))
+
+    t = threading.Thread(target=try_steal, daemon=True,
+                         name="mt-test-steal")
+    t.start()
+    t.join()
+    assert got == [False]
+    m.release()
+    assert not m.locked()
+    r = lt.mtrlock("t.proto-r")
+    with r:
+        with r:
+            assert r._is_owned()
+    assert not r.locked()
+
+
+def test_scrape_includes_lock_families_when_armed(traced):
+    """admin/metrics.render carries the mt_lock_* families once the
+    detector recorded anything (and nothing when idle — the exposition
+    suite's strict checks run with tracing off and must stay clean)."""
+    from minio_tpu.admin import metrics
+    a, b = lt.mtlock("t.scrape1"), lt.mtlock("t.scrape2")
+    with a:
+        with b:
+            pass
+    text = metrics.render()
+    assert "# TYPE mt_lock_order_edges_total counter" in text
+    assert "mt_lock_cycles_total 0" in text
+    assert "mt_lock_long_holds_total 0" in text

@@ -227,9 +227,30 @@ def test_smoke_scenario_meets_slo_and_converges(tmp_path):
     cluster under a GET-heavy mix takes a drive death mid-traffic,
     gets the drive back, and ends inside SLO with heal convergence,
     zero dead-letters, and no leaked threads — the same rows the full
-    matrix emits, in tier-1 time."""
-    sc = soak_report.smoke_scenario(duration_s=3.0)
-    rows = soak_report.run_scenario(sc, str(tmp_path / "soak"))
+    matrix emits, in tier-1 time.
+
+    Runs locktrace-enabled (the concurrency-analysis acceptance
+    drill): every mutex the cluster constructs is traced, and the
+    recorded lock-order graph must come out ACYCLIC with zero
+    long-hold violations after the full fault timeline."""
+    from minio_tpu.utils import locktrace
+    was = locktrace.enabled()
+    locktrace.enable()
+    locktrace.reset()
+    try:
+        sc = soak_report.smoke_scenario(duration_s=3.0)
+        rows = soak_report.run_scenario(sc, str(tmp_path / "soak"))
+        # the trace saw the real data plane (not a vacuous green)
+        assert locktrace.acquire_count() > 100, \
+            locktrace.acquire_count()
+        summary = locktrace.assert_acyclic()   # cycles/long holds raise
+        assert summary["long_holds"] == 0
+    finally:
+        if not was:
+            locktrace.disable()
+        # reset in the FINALLY: a failed assertion must not leak the
+        # graph into later suites' scrape idle contracts
+        locktrace.reset()
     by_metric = {r["metric"]: r for r in rows}
     # the chaos actually landed
     chaos = by_metric["ops_total"]["detail"]["chaos"]
