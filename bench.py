@@ -328,6 +328,78 @@ def main() -> None:
             f"fused: {fused_tops:.1f} TOPS exceeds {peak} peak — "
             "harness artifact")
 
+    # -- single-kernel fused formulation (ops/rs_fused.py): the hash
+    # prologue consumes encode's VMEM-resident tiles, so the operand
+    # crosses HBM once (D in + P out, the information-theoretic
+    # minimum) instead of twice.  Measured with the same chained
+    # dependent-iteration + marginal-time discipline; the two-kernel
+    # number above stays as the proven fallback and the HEADLINE
+    # fused_encode_hh256_GiBps takes the best VALID of the two.
+    def bench_fused_single() -> float | str:
+        try:
+            from minio_tpu.ops import rs_fused
+            p6 = rs_fused.plan(BF, k, m, ss_pad)
+            assert p6["B_pad"] == BF and p6["n_pad"] == ss_pad and \
+                p6["gs"] == GS, p6
+
+            @partial(jax.jit, static_argnums=(1,))
+            def single_chained(d0, iters):
+                def body(_, carry):
+                    d, hacc = carry
+                    par, planes = rs_fused._fused_call(
+                        enc_mat, d, k=k, ro=m, gs=GS, bs=p6["bs"],
+                        S=p6["S"], pc=p6["pc"],
+                        n_packets=ss_pad // 32, hash_parity=True,
+                        interpret=False)
+                    digs = rs_fused._digests_from_planes(
+                        planes, d, par, k=k, ro=m, bs=p6["bs"],
+                        S=p6["S"], B=BF, n_real=ss_pad,
+                        hash_parity=True)
+                    hall = jax.lax.reduce(
+                        digs.reshape(BF * (k + m), 32), jnp.uint8(0),
+                        jax.lax.bitwise_xor, (0,))
+                    mixed = d.reshape(BF, k, ss_pad // 32, 32) ^ hall
+                    return mixed.reshape(BF, k, ss_pad), hacc ^ hall
+
+                return jax.lax.fori_loop(
+                    0, iters, body, (d0, jnp.zeros(32, jnp.uint8)))
+
+            def single_timed(iters, trials=3):
+                best = float("inf")
+                for _ in range(trials):
+                    t0 = time.perf_counter()
+                    _, h_out = single_chained(fdata, iters)
+                    s = int(jnp.sum(h_out.astype(jnp.uint32)))
+                    best = min(best, time.perf_counter() - t0)
+                assert s != 0
+                return best
+
+            single_chained(fdata, fiters)[1].block_until_ready()
+            single_chained(fdata, 2 * fiters)[1].block_until_ready()
+            best = 0.0
+            for attempt in range(5):
+                t1 = single_timed(fiters, trials=3 + attempt)
+                t2 = single_timed(2 * fiters, trials=3 + attempt)
+                dt = (t2 - t1) / fiters
+                g = (BF * block_size) / dt / 2**30 if dt > 0 else -1
+                if 0 < g <= encode_gibps * 1.2:
+                    best = max(best, g)
+                    if best >= encode_gibps * 0.6 or attempt == 4:
+                        break
+            if best <= 0:
+                return "unstable marginal (see two-kernel leg)"
+            return best
+        except Exception as e:  # noqa: BLE001 — optional formulation
+            import sys as _sys
+            print(f"fused single-kernel leg failed: {e!r}",
+                  file=_sys.stderr)
+            return f"{type(e).__name__}: {e}"
+
+    fused_single = bench_fused_single()
+    fused_two_kernel = fused_gibps
+    if isinstance(fused_single, float) and fused_single > fused_gibps:
+        fused_gibps = fused_single
+
     e2e = _bench_end_to_end_put()
     cfg12 = _bench_baseline_configs()
     codec_batching = _bench_codec_batching()
@@ -351,14 +423,29 @@ def main() -> None:
             # step) and capped the pipeline at 20.65; removing it
             # measured 33.6 GiB/s (bar: >= 24).
             "fused_encode_hh256_GiBps": round(fused_gibps, 2),
+            # the roofline target (ISSUE 12): fused within ~15% of
+            # plain encode means ratio >= ~0.85
+            "fused_vs_plain_ratio": round(fused_gibps / encode_gibps, 3)
+            if encode_gibps > 0 else None,
+            "fused_two_kernel_GiBps": round(fused_two_kernel, 2),
+            "fused_single_kernel_GiBps": (
+                round(fused_single, 2)
+                if isinstance(fused_single, float) else fused_single),
             # the data-plane mesh engine (shard_map + pallas + ring
             # XOR) on a 1x1 mesh: per-chip cost of the multi-chip
             # wiring relative to encode_GiBps (the direct kernel)
             "mesh_1chip_pallas_GiBps": round(mesh_gibps, 2),
             ("e2e_put_256x4MiB_fsync" if _FSYNC_ON
              else "e2e_put_256x4MiB_nofsync"): e2e,
-            # driver BASELINE configs 1 + 2, measured end to end
-            # through the real object layer (r4 verdict #2)
+            # driver BASELINE configs 1 + 2 as FIRST-CLASS rows (the
+            # two weakest driver-tracked numbers must not hide in a
+            # nested dict), measured end to end through the real
+            # object layer (r4 verdict #2); the full sub-report with
+            # methodology keeps its slot below
+            "config1_4+2_put_64MiB_GiBps": (cfg12 or {}).get(
+                "config1_4+2_put_64MiB_GiBps"),
+            "config2_8+4_multipart_1GiB_GiBps": (cfg12 or {}).get(
+                "config2_8+4_multipart_1GiB_GiBps"),
             "baseline_configs_1_2": cfg12,
             # cross-request batching codec service (ISSUE 9): aggregate
             # GiB/s + occupancy at 1/4/16/64 concurrent streams vs the
@@ -560,6 +647,55 @@ def _bench_md5_lanes(body: bytes) -> dict | None:
                 rate(one_sched, streams=lanes, reps=4), 3)
     finally:
         md5fast.SCHED.set_lanes(4)
+
+    # device multi-buffer MD5 (hashing/md5_device.py): the probed
+    # end-to-end device rate (transfer included — the honest number on
+    # a tunnel-attached chip), the aggregate of 4 concurrent streams
+    # through the md5 combining bucket, and which rung ``auto``
+    # actually resolved to on THIS host — the calibration decision the
+    # pipeline.md5_backend ladder rides
+    try:
+        from minio_tpu.hashing import md5_device
+        from minio_tpu.parallel import batcher as _bt
+        if md5_device.available():
+            out["md5_device_probe_GiBps"] = round(
+                md5_device.device_rate_gibps(), 3)
+
+            def one_dev():
+                h = md5_device.MD5Device()
+                mv = memoryview(body)
+                for off in range(0, obj_size, md5fast.ONESHOT_SLICE):
+                    h.update(mv[off:off + md5fast.ONESHOT_SLICE])
+                h.digest()
+
+            s0 = _bt.MD5_GLOBAL.snapshot()
+            out["md5_device_4stream_GiBps"] = round(
+                rate(one_dev, streams=4, reps=2), 3)
+            s1 = _bt.MD5_GLOBAL.snapshot()
+            disp = s1["dispatches"] - s0["dispatches"]
+            reqs = s1["requests"] - s0["requests"]
+            out["md5_device_occupancy"] = round(reqs / disp, 1) \
+                if disp else None
+        else:
+            out["md5_device_probe_GiBps"] = None
+            out["md5_device_unavailable"] = \
+                md5_device.unavailable_reason()
+        # the auto probe runs on a background thread (first-PUT
+        # latency protection); the bench wants the SETTLED decision —
+        # but only an actual ``auto`` resolution has one to wait for
+        # (a pinned rung never starts a probe)
+        choice = md5fast._resolve_backend()
+        env_pin = (os.environ.get("MT_MD5") or "").strip().lower()
+        if md5fast._BACKEND == "auto" and \
+                env_pin not in ("device", "native", "hashlib"):
+            for _ in range(200):
+                if md5fast._AUTO_CHOICE is not None:
+                    break
+                time.sleep(0.05)
+        out["md5_backend_auto_choice"] = md5fast._AUTO_CHOICE or choice
+    except Exception as e:  # noqa: BLE001 — optional sub-leg
+        import sys as _sys
+        print(f"md5 device leg failed: {e!r}", file=_sys.stderr)
     return out
 
 
@@ -1166,6 +1302,13 @@ def _bench_end_to_end_put() -> dict | None:
                     obj_size / (md5_lane_stats["md5_native_GiBps"]
                                 * 2**30) * 1000, 2)
                     if md5_lane_stats else None),
+                # device multi-buffer MD5, probed end-to-end rate
+                # (transfer included); None when no device
+                "md5_etag_device": (round(
+                    obj_size / (md5_lane_stats[
+                        "md5_device_probe_GiBps"] * 2**30) * 1000, 2)
+                    if md5_lane_stats and md5_lane_stats.get(
+                        "md5_device_probe_GiBps") else None),
                 "erasure_encode_into_frames": round(t_encode, 2),
                 "bitrot_hh256_fill": round(t_hash, 2),
                 "drive_fanout_commit": round(t_commit, 2),
@@ -1181,6 +1324,34 @@ def _bench_end_to_end_put() -> dict | None:
     finally:
         if tmp:
             shutil.rmtree(tmp, ignore_errors=True)
+
+
+def host_main() -> None:
+    """``bench.py host`` — the host-measurable legs only (BASELINE
+    configs 1-2, the e2e PUT pipeline, md5 lanes/backends, codec
+    batching): everything that moves without a TPU attached.  Prints
+    ONE BENCH_*-shaped JSON line keyed on config 1 — the weakest
+    driver-tracked number and the one the host-path work targets."""
+    e2e = _bench_end_to_end_put()
+    cfg12 = _bench_baseline_configs()
+    codec_batching = _bench_codec_batching()
+    c1 = (cfg12 or {}).get("config1_4+2_put_64MiB_GiBps")
+    print(json.dumps({
+        "metric": "baseline_config1_4+2_put_64MiB_GiBps",
+        "value": c1,
+        "unit": "GiB/s",
+        "detail": {
+            "config1_4+2_put_64MiB_GiBps": c1,
+            "config2_8+4_multipart_1GiB_GiBps": (cfg12 or {}).get(
+                "config2_8+4_multipart_1GiB_GiBps"),
+            "baseline_configs_1_2": cfg12,
+            ("e2e_put_256x4MiB_fsync" if _FSYNC_ON
+             else "e2e_put_256x4MiB_nofsync"): e2e,
+            "codec_batching": codec_batching,
+            "methodology": "host legs only (bench.py host); device "
+                           "kernel legs need a TPU",
+        },
+    }))
 
 
 def soak_main(argv: list[str]) -> None:
@@ -1228,5 +1399,7 @@ if __name__ == "__main__":
         soak_main(_sys.argv[2:])
     elif len(_sys.argv) > 1 and _sys.argv[1] == "codec_batching":
         codec_batching_main()
+    elif len(_sys.argv) > 1 and _sys.argv[1] == "host":
+        host_main()
     else:
         main()

@@ -590,6 +590,103 @@ class KvconfigDriftRule(Rule):
                    for c in consts)
 
 
+# -- named skip --------------------------------------------------------------
+
+
+class NamedSkipRule(Rule):
+    id = "named-skip"
+    description = ("every pytest.skip()/pytest.mark.skipif() in "
+                   "tests/ must carry a non-empty reason — a path "
+                   "that degrades (no device, no compiler, no .so) "
+                   "must NAME why, or a silently-skipped tier reads "
+                   "as coverage it does not have")
+
+    def check_tree(self, mods: list[Module], repo: str):
+        """tests/ is outside the runner's ``minio_tpu`` walk, so this
+        rule parses it directly (the kvconfig-drift/docs discipline):
+        the degradation contract lives in the tests."""
+        import os
+        tdir = os.path.join(repo, "tests")
+        if not os.path.isdir(tdir):
+            return
+        for fname in sorted(os.listdir(tdir)):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(tdir, fname)
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    src = fh.read()
+                tree = ast.parse(src)
+            except (OSError, SyntaxError):
+                continue            # the parse rule owns broken files
+            lines = src.splitlines()
+            rel = f"tests/{fname}"
+            for node in ast.walk(tree):
+                # bare @pytest.mark.skip decorators (no call, so no
+                # reason is even possible) are the purest silent skip
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    for dec in node.decorator_list:
+                        if isinstance(dec, ast.Attribute) and \
+                                _safe_unparse(dec).endswith(
+                                    "mark.skip") and \
+                                not self._suppressed(lines,
+                                                     dec.lineno):
+                            yield Finding(
+                                rel, dec.lineno, self.id,
+                                "@pytest.mark.skip without a reason "
+                                "— name why this path degrades")
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                if self._suppressed(lines, node.lineno):
+                    continue
+                name = _safe_unparse(node.func)
+                if name.endswith("pytest.skip") or name == "skip" \
+                        or name.endswith("mark.skip"):
+                    if not self._has_reason(node, positional=True):
+                        yield Finding(
+                            rel, node.lineno, self.id,
+                            "pytest.skip() without a reason — name "
+                            "why this path degrades")
+                elif name.endswith(".skipif"):
+                    if not self._has_reason(node, positional=False):
+                        yield Finding(
+                            rel, node.lineno, self.id,
+                            "skipif without reason= — name why this "
+                            "path degrades")
+
+    @staticmethod
+    def _suppressed(lines: list[str], lineno: int) -> bool:
+        """tests/ sits outside the runner's suppression pass, so the
+        grammar is honored here: a reasoned ``# mt-lint:
+        ok(named-skip) why`` on the flagged line."""
+        line = lines[lineno - 1] if lineno <= len(lines) else ""
+        return bool(re.search(
+            r"#\s*mt-lint:\s*ok\([^)]*named-skip[^)]*\)\s*\S", line))
+
+    @staticmethod
+    def _has_reason(node: ast.Call, positional: bool) -> bool:
+        """True when a non-empty reason is present: a non-constant
+        expression counts (it evaluates to the reason at runtime, e.g.
+        ``md5_device.unavailable_reason()``); only a MISSING or
+        empty-literal reason is a finding."""
+        cands = []
+        if positional and node.args:
+            cands.append(node.args[0])
+        for kw in node.keywords:
+            if kw.arg == "reason":
+                cands.append(kw.value)
+        for c in cands:
+            if isinstance(c, ast.Constant):
+                if isinstance(c.value, str) and c.value.strip():
+                    return True
+            else:
+                return True
+        return False
+
+
 ALL_RULES = [
     BareExceptRule,
     MutableDefaultRule,
@@ -599,4 +696,5 @@ ALL_RULES = [
     ThreadDisciplineRule,
     SwallowedExceptionRule,
     KvconfigDriftRule,
+    NamedSkipRule,
 ]

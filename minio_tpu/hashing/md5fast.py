@@ -91,6 +91,118 @@ def available() -> bool:
     return _mode() != "hashlib" and _get_lib() is not None
 
 
+# -- backend ladder ---------------------------------------------------------
+#
+# ``pipeline.md5_backend`` (kvconfig, live-reloadable through
+# reload_pipeline_config) selects the strict-ETag engine:
+#
+#   device  -> hashing/md5_device.MD5Device: bulk blocks batched onto
+#              the accelerator through the md5 combining bucket
+#              (parallel/batcher.py); falls to the next rung (counted
+#              in mt_md5_device_fallback_total) when no device
+#   native  -> MD5Fast over native/md5mb.cc + the host LaneScheduler
+#   hashlib -> the stdlib (also forced by MT_MD5=hashlib, which
+#              outranks the knob — the operator kill switch)
+#   auto    -> MEASURED choice: the device rung only when its probed
+#              end-to-end rate (md5_device.device_rate_gibps, transfer
+#              included) beats the host core by a margin.  A TPU
+#              behind a slow tunnel must lose this race — the platform
+#              name alone says nothing about H2D bandwidth.
+
+_BACKEND = "auto"
+_AUTO_CHOICE: str | None = None
+_AUTO_MARGIN = 1.25
+
+
+def set_backend(name: str) -> None:
+    """Install the configured backend (reload_pipeline_config hook);
+    unknown names keep the current value.  Changing the backend resets
+    the cached auto decision."""
+    global _BACKEND, _AUTO_CHOICE
+    name = (name or "").strip().lower()
+    if name in ("auto", "device", "native", "hashlib") \
+            and name != _BACKEND:
+        # same-name reloads (every SetConfigKV of an unrelated
+        # pipeline knob, every layer construction) must NOT discard a
+        # settled measured auto decision — that would thrash strict
+        # ETags back to the host rung and respawn probe threads
+        _BACKEND = name
+        _AUTO_CHOICE = None
+
+
+def _host_rate_gibps() -> float:
+    """One-shot probe of the host single-stream rate (native core when
+    present, hashlib otherwise) — the bar the device must clear."""
+    import hashlib as _hl
+    buf = b"\0" * (1 << 20)
+    fn = (lambda: MD5Fast(buf)) if available() else \
+        (lambda: _hl.md5(buf))
+    fn()                                         # warm
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        fn()
+    return reps * len(buf) / (time.perf_counter() - t0) / 2**30
+
+
+def _resolve_backend() -> str:
+    """The effective rung for this digest: env override first, then
+    the knob, with ``auto`` resolved (and cached) by measurement."""
+    global _AUTO_CHOICE
+    env = os.environ.get("MT_MD5")
+    env = env.strip().lower() if env is not None else None
+    if env == "hashlib":
+        return "hashlib"
+    be = _BACKEND
+    if env in ("device", "native"):              # MT_MD5 pins a rung
+        be = env
+    if be != "auto":
+        return be
+    if _AUTO_CHOICE is None:
+        from . import md5_device
+        if not md5_device.available():
+            _AUTO_CHOICE = "native"
+        else:
+            # probe OFF the request path: device_rate_gibps pays an
+            # XLA compile plus ~20 MiB of benchmark hashing — charged
+            # to a background thread, not to the first strict PUT of
+            # the process.  Until the probe lands, auto serves the
+            # host rung (always correct, never slower than today).
+            _start_auto_probe()
+            return "native"
+    return _AUTO_CHOICE
+
+
+_probe_lock = mtlock("md5.auto-probe")
+_probe_started = False
+
+
+def _start_auto_probe() -> None:
+    global _probe_started
+    with _probe_lock:
+        if _probe_started:
+            return
+        _probe_started = True
+
+    def probe():
+        global _AUTO_CHOICE, _probe_started
+        try:
+            from . import md5_device
+            dev = md5_device.device_rate_gibps()
+            host = _host_rate_gibps()
+            choice = "device" if dev > host * _AUTO_MARGIN \
+                else "native"
+        except Exception:  # noqa: BLE001 — a broken probe means host
+            choice = "native"
+        with _probe_lock:
+            if _AUTO_CHOICE is None:
+                _AUTO_CHOICE = choice
+            _probe_started = False
+
+    threading.Thread(target=probe, daemon=True,
+                     name="mt-md5-calibrate").start()
+
+
 def _buf_addr(data) -> tuple[int, int, object]:
     """(address, length, keepalive) for any contiguous buffer without
     copying (bytes, bytearray, memoryview slices, numpy rows)."""
@@ -145,11 +257,19 @@ class MD5Fast:
 
 
 def md5(data=b""):
-    """Digest factory for the ETag hot path: the native core when
-    available, ``hashlib.md5`` otherwise (or under MT_MD5=hashlib)."""
-    if available():
+    """Digest factory for the ETag hot path, walking the backend
+    ladder (see ``set_backend``): device -> native -> hashlib, each
+    rung falling through with its fallback counted."""
+    be = _resolve_backend()
+    if be == "device":
+        from . import md5_device
+        if md5_device.available():
+            return md5_device.MD5Device(data)
+        from ..admin.metrics import GLOBAL as _mtr
+        _mtr.inc("mt_md5_device_fallback_total")
+    if be != "hashlib" and available():
         return MD5Fast(data)
-    if _mode() != "hashlib":
+    if be != "hashlib":
         from ..admin.metrics import GLOBAL as _mtr
         _mtr.inc("mt_md5_fallback_total")
     return hashlib.md5(bytes(data) if not isinstance(
@@ -293,6 +413,14 @@ def md5_of(data):
     share lanes (the overlapped bytes-PUT path submits this on the
     pool).  Returns the digest object (hexdigest() for the ETag)."""
     h = md5()
+    if type(h).__name__ == "MD5Device":
+        # device digests combine through the md5 bucket instead of the
+        # host lane scheduler; slicing still interleaves concurrent
+        # oneshots across batched dispatches
+        mv = memoryview(data).cast("B")
+        for off in range(0, len(mv), ONESHOT_SLICE):
+            h.update(mv[off:off + ONESHOT_SLICE])
+        return h
     if not isinstance(h, MD5Fast):
         h.update(bytes(data) if not isinstance(
             data, (bytes, bytearray, memoryview)) else data)

@@ -1,0 +1,289 @@
+"""Encode + HighwayHash-256 as ONE Pallas TPU kernel — the hash rides
+encode's VMEM tiles (ISSUE 12 tentpole a).
+
+The two-kernel fused pipeline (rs_pallas matmul, then hh_pallas over
+data AND parity) moves every data byte across HBM twice: once into the
+encode kernel, once into the hash kernel — 2D+2P of HBM traffic for an
+operation whose information-theoretic minimum is D in + P out.  That
+tax is the measured 38% gap between fused (32.12 GiB/s) and plain
+encode (51.95, BENCH_r05).
+
+This kernel closes the loop: per grid step the data tile is read from
+HBM ONCE, the parity tile is computed on the MXU (rs_pallas's
+unpack -> block-diagonal bit-matrix matmul -> pack, verbatim), and the
+HighwayHash prologue then consumes BOTH tiles while they are still
+VMEM-resident — the byte-plane transpose (hh_pallas's in-VMEM
+prologue) runs over the concatenated data+parity sublanes, and the
+packet chain updates a 32-limb state scratch carried across the
+lane-tile grid dimension.  HBM sees D in and P out, nothing else.
+
+Geometry: one grid row-block holds ``bs`` stripes x (k+ro) shards
+flattened into S x 128 hash lanes (data shards stripe-major first,
+then parity, then pad lanes whose garbage state is sliced off on the
+way out).  For the headline 12+4 config that is 64 stripes = 1024
+lanes = full (8, 128) VPU tiles — the same per-byte hash cost as the
+standalone hh_pallas kernel, so the win is pure HBM traffic.
+
+``hash_parity=False`` hashes only the data lanes: the mesh data plane
+needs this when k is sharded across chips (per-device parity is
+PARTIAL before the ring XOR — hashing it would digest garbage); the
+full-parity hash then runs post-ring on the small parity rows.
+
+Digests are bit-identical to the host HighwayHash-256 with the bitrot
+magic key (tests/test_fused_kernel.py pins ragged geometries, tails
+and the k/m matrix from the BASELINE configs).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import gf8, hh_pallas as hhp, hh_kernels as hk, rs_pallas
+
+_U32 = jnp.uint32
+# lane-tile ceiling: 2048 bytes = 64 packets per chunk, the same
+# packet-chunk size hh_pallas settled on (_PC_NAT) — large enough to
+# amortise the transpose, small enough that data tile + tbuf + parity
+# + state stay well under the 16 MiB scoped-vmem limit at bs=64
+_TN_MAX = 2048
+
+
+def plan(B: int, k: int, ro: int, n: int,
+         hash_parity: bool = True) -> dict:
+    """Tile plan for a (B, k, n) stripe batch: stripes per row-block
+    (bs, a gs multiple), hash-lane rows S, lane tile tn — sized so the
+    hash lanes fill (S, 128) tiles without padding a small batch up to
+    a huge one.  Raises ValueError when the geometry cannot fit (one
+    stripe's shards exceed 1024 lanes)."""
+    R = k + (ro if hash_parity else 0)
+    if B < 1 or n < 1:
+        raise ValueError(f"degenerate batch ({B}, {n})")
+    if R > 1024:
+        raise ValueError(f"{R} shards/stripe exceed one row-block")
+    stripes_cap = max(1, 1024 // R)
+    gs = rs_pallas._GS if min(B, stripes_cap) >= rs_pallas._GS else 1
+    bpad0 = -(-B // gs) * gs
+    bs = min(max(gs, (stripes_cap // gs) * gs), bpad0)
+    B_pad = -(-bpad0 // bs) * bs
+    S = -(-bs * R // 128)
+    tn = min(_TN_MAX, -(-n // 256) * 256)
+    n_pad = -(-n // tn) * tn
+    return {"R": R, "gs": gs, "bs": bs, "B_pad": B_pad, "S": S,
+            "tn": tn, "n_pad": n_pad, "pc": tn // 32}
+
+
+def _kernel(m_ref, in_ref, par_ref, dig_ref, st, tbuf, *, k: int,
+            ro: int, gs: int, bs: int, S: int, pc: int,
+            n_packets: int, hash_parity: bool, init_consts):
+    """One (stripe-block, lane-tile) grid step.
+
+    m_ref:  (gs*8*ro, gs*8*k) int8 block-diagonal bit-major matrix
+    in_ref: (bs, k, tn) uint8 data; par_ref: (bs, ro, tn) uint8 out
+    dig_ref:(1, 32, S, 128) u32 hash-state planes (written at last j)
+    st:     VMEM (32, S, 128) u32 carried state
+    tbuf:   VMEM (tn, S, 128) u8 byte-plane transpose staging
+    """
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        for idx, c in enumerate(init_consts):
+            st[idx] = jnp.full((S, 128), np.uint32(c), _U32)
+
+    # -- encode: rs_pallas._kernel verbatim, looped over gs-stripe
+    # sub-groups (the block-diagonal matrix packs gs stripes per MXU
+    # call; bs/gs calls cover the row-block)
+    par_vals = []
+    for g in range(bs // gs):
+        planes = []
+        for s in range(gs):
+            x = in_ref[g * gs + s].astype(jnp.int32)
+            planes.extend(x >> b for b in range(8))
+        bits = jnp.concatenate(planes, axis=0).astype(jnp.int8)
+        acc = jax.lax.dot_general(
+            m_ref[:], bits,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        acc = acc & 1
+        for s in range(gs):
+            base = s * 8 * ro
+            out = acc[base:base + ro]
+            for b in range(1, 8):
+                out = out | (acc[base + b * ro:base + (b + 1) * ro] << b)
+            out = out.astype(jnp.uint8)
+            par_ref[g * gs + s] = out
+            par_vals.append(out)
+
+    # -- hash prologue: byte-plane transpose of the VMEM-resident
+    # tiles (data, and the parity values just computed when
+    # hash_parity — the in-register copies, not a read-back of the
+    # output ref) — the operand never revisits HBM, which is the point
+    tn = pc * 32
+    parts = [in_ref[:].reshape(bs * k, tn)]
+    lanes_used = bs * k
+    if hash_parity:
+        parts.extend(par_vals)
+        lanes_used += bs * ro
+    if S * 128 - lanes_used:
+        parts.append(jnp.zeros((S * 128 - lanes_used, tn), jnp.uint8))
+    allb = parts[0] if len(parts) == 1 else \
+        jnp.concatenate(parts, axis=0)
+    tbuf[:] = jnp.swapaxes(allb, 0, 1).reshape(tn, S, 128)
+
+    carry0 = tuple(st[idx] for idx in range(32))
+
+    def body(p, carry):
+        x = tbuf[pl.ds(p * 32, 32)].astype(_U32)     # (32, S, 128)
+        lanes = []
+        for lane in range(4):
+            b = 8 * lane
+            lo = (x[b] | (x[b + 1] << 8) | (x[b + 2] << 16)
+                  | (x[b + 3] << 24))
+            hi = (x[b + 4] | (x[b + 5] << 8) | (x[b + 6] << 16)
+                  | (x[b + 7] << 24))
+            lanes.append((hi, lo))
+        return tuple(hhp._flatten(hhp._update_lanes(
+            hhp._unflatten(list(carry)), lanes)))
+
+    # tail lane-tiles may hold 0..pc whole packets of the real width;
+    # the loop BOUND masks them (hh_pallas discipline — masking the 32
+    # carried planes per packet measured 8.5x the update itself)
+    valid = jnp.maximum(0, jnp.minimum(pc, n_packets - j * pc))
+    final = jax.lax.fori_loop(0, valid, body, carry0)
+    for idx in range(32):
+        st[idx] = final[idx]
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        for idx in range(32):
+            dig_ref[0, idx] = st[idx]
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "k", "ro", "gs", "bs", "S", "pc", "n_packets", "hash_parity",
+    "interpret"))
+def _fused_call(mat_bd, data, *, k: int, ro: int, gs: int, bs: int,
+                S: int, pc: int, n_packets: int, hash_parity: bool,
+                interpret: bool):
+    """data: (B_pad, k, n_pad) uint8, B_pad % bs == 0, n_pad % tn == 0
+    (caller pads).  Returns (parity (B_pad, ro, n_pad) u8,
+    planes (B_pad//bs, 32, S, 128) u32 hash-state limbs)."""
+    Bp, _, npad = data.shape
+    tn = pc * 32
+    kernel = functools.partial(
+        _kernel, k=k, ro=ro, gs=gs, bs=bs, S=S, pc=pc,
+        n_packets=n_packets, hash_parity=hash_parity,
+        init_consts=hhp._init_consts())
+    return pl.pallas_call(
+        kernel,
+        grid=(Bp // bs, npad // tn),
+        in_specs=[
+            pl.BlockSpec((gs * 8 * ro, gs * 8 * k), lambda i, j: (0, 0)),
+            pl.BlockSpec((bs, k, tn), lambda i, j: (i, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bs, ro, tn), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 32, S, 128), lambda i, j: (i, 0, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Bp, ro, npad), jnp.uint8),
+            jax.ShapeDtypeStruct((Bp // bs, 32, S, 128), _U32),
+        ],
+        scratch_shapes=[pltpu.VMEM((32, S, 128), _U32),
+                        pltpu.VMEM((tn, S, 128), jnp.uint8)],
+        interpret=interpret,
+    )(mat_bd, data)
+
+
+def _digests_from_planes(planes, data, parity, *, k: int, ro: int,
+                         bs: int, S: int, B: int, n_real: int,
+                         hash_parity: bool):
+    """Hash-state planes -> per-shard digests (B, R, 32), pure jnp
+    (shard_map-traceable).  Lane order inside a row-block is data
+    stripe-major, then parity, then pad — undone here; the sub-packet
+    remainder and finalization reuse the hh_kernels host-formulation
+    (both are jnp over the (lanes, 4) limb state)."""
+    NB = planes.shape[0]
+    R = k + (ro if hash_parity else 0)
+    limbs = []
+    for idx in range(32):
+        lane_flat = planes[:, idx].reshape(NB, S * 128)
+        d = lane_flat[:, :bs * k].reshape(NB * bs, k)[:B]
+        if hash_parity:
+            p = lane_flat[:, bs * k:bs * R].reshape(NB * bs, ro)[:B]
+            d = jnp.concatenate([d, p], axis=1)
+        limbs.append(d.reshape(B * R))
+    state = hhp._unflatten(limbs)
+    st8 = []
+    for v in hhp._VARS:
+        for part in (0, 1):                      # hi then lo
+            st8.append(jnp.stack([state[v][lane][part]
+                                  for lane in range(4)], axis=-1))
+    state8 = tuple(st8)
+    rem = n_real % 32
+    if rem:
+        P = n_real // 32
+        tails = [data[:B, :, P * 32:n_real]]
+        if hash_parity:
+            tails.append(parity[:B, :, P * 32:n_real])
+        rb = (tails[0] if len(tails) == 1 else
+              jnp.concatenate(tails, axis=1)).reshape(B * R, rem)
+        state8 = hk._remainder_update(state8, rb, rem)
+    return hhp._finalize(state8).reshape(B, R, 32)
+
+
+def encode_hash_device(M: np.ndarray, shards, *, n_real: int | None
+                       = None, hash_parity: bool = True,
+                       interpret: bool | None = None):
+    """Single-kernel fused encode+hash; returns DEVICE arrays
+    (parity (B, ro, n), digests (B, R, 32)) so callers chain further
+    device work without a host round trip.
+
+    M: (ro, k) GF coefficients; shards: (B, k, n) uint8; digests cover
+    ``n_real`` bytes per shard (default n — callers whose width is
+    lane-padded pass the true shard width).
+    """
+    M = np.ascontiguousarray(M, dtype=np.uint8)
+    shards = jnp.asarray(shards, jnp.uint8)
+    B, k, n = shards.shape
+    ro = M.shape[0]
+    n_real = n if n_real is None else n_real
+    p = plan(B, k, ro, n, hash_parity)
+    if p["B_pad"] != B:
+        shards = jnp.pad(shards, ((0, p["B_pad"] - B), (0, 0), (0, 0)))
+    if p["n_pad"] != n:
+        shards = jnp.pad(shards, ((0, 0), (0, 0), (0, p["n_pad"] - n)))
+    mb = rs_pallas._device_matrix_bd(M.tobytes(), ro, k, p["gs"])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    parity, planes = _fused_call(
+        mb, shards, k=k, ro=ro, gs=p["gs"], bs=p["bs"], S=p["S"],
+        pc=p["pc"], n_packets=n_real // 32, hash_parity=hash_parity,
+        interpret=interpret)
+    digests = _digests_from_planes(
+        planes, shards, parity, k=k, ro=ro, bs=p["bs"], S=p["S"], B=B,
+        n_real=n_real, hash_parity=hash_parity)
+    return parity[:B, :, :n], digests
+
+
+def encode_with_bitrot_fused(data_blocks: int, parity_blocks: int,
+                             blocks: np.ndarray,
+                             matrix: np.ndarray | None = None,
+                             interpret: bool | None = None):
+    """rs_mesh.encode_with_bitrot's (parity, digests) contract through
+    the single fused kernel — host numpy in, host numpy out, digests
+    (B, k+m, 32) with data rows first."""
+    blocks = np.asarray(blocks, dtype=np.uint8)
+    if matrix is None:
+        matrix = gf8.rs_matrix(data_blocks,
+                               data_blocks + parity_blocks)
+    rows = np.asarray(matrix)[data_blocks:]
+    parity, digests = encode_hash_device(
+        rows, blocks, hash_parity=True, interpret=interpret)
+    return np.asarray(parity), np.asarray(digests)

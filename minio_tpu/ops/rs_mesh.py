@@ -192,6 +192,65 @@ def reconstruct_batch(shards: np.ndarray, present: list[int],
 
 
 @functools.lru_cache(maxsize=64)
+def _fused_pallas_single(mesh, r: int, kl: int, gs: int, bs: int,
+                         S_h: int, pc: int, n_real: int, hp: bool,
+                         interpret: bool):
+    """Fused encode+bitrot through the SINGLE-kernel formulation
+    (ops/rs_fused.py): per device the data tile crosses HBM once —
+    parity is computed and hashed from the VMEM-resident tiles.  When
+    k is sharded (S>1) the per-device parity is PARTIAL before the
+    ring XOR, so the kernel hashes only the data lanes (hp=False) and
+    the parity digests run post-ring on the small parity rows; a
+    1-wide shard axis hashes everything in-kernel (hp=True)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from . import hh_pallas, rs_fused
+
+    S = mesh.shape["shard"]
+    perm = [(j, (j + 1) % S) for j in range(S)]
+
+    def local(mats, data):
+        import jax.numpy as jnp
+        b = data.shape[0]
+        part, planes = rs_fused._fused_call(
+            mats[0], data, k=kl, ro=r, gs=gs, bs=bs, S=S_h, pc=pc,
+            n_packets=n_real // 32, hash_parity=hp,
+            interpret=interpret)
+        if S > 1:
+            def step(_, acc):
+                return jax.lax.ppermute(acc, "shard", perm) ^ part
+            parity = jax.lax.fori_loop(0, S - 1, step, part)
+        else:
+            parity = part
+        digs = rs_fused._digests_from_planes(
+            planes, data, part, k=kl, ro=r, bs=bs, S=S_h, B=b,
+            n_real=n_real, hash_parity=hp)
+        if hp:
+            d_dig, p_dig = digs[:, :kl], digs[:, kl:]
+        else:
+            d_dig = digs
+            rr = parity.shape[1]
+            p_dig = hh_pallas.hh256_batch(
+                parity[:, :, :n_real].reshape(b * rr, n_real)
+            ).reshape(b, rr, 32)
+        if S > 1:
+            d_dig = jax.lax.all_gather(d_dig, "shard", axis=1,
+                                       tiled=True)
+        return parity, jnp.concatenate([d_dig, p_dig], axis=1)
+
+    specs = dict(in_specs=(P("shard", None, None),
+                           P("stripe", "shard", None)),
+                 out_specs=(P("stripe", None, None),
+                            P("stripe", None, None)))
+    smap = _shard_map_fn()
+    try:
+        fn = smap(local, mesh=mesh, check_vma=False, **specs)
+    except TypeError:
+        fn = smap(local, mesh=mesh, check_rep=False, **specs)
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=64)
 def _fused_pallas(mesh, r: int, kl: int, gs: int, tn: int,
                   n_real: int, interpret: bool):
     """Fused encode+bitrot, pallas per-chip form: local pallas matmul
@@ -241,6 +300,67 @@ def _fused_pallas(mesh, r: int, kl: int, gs: int, tn: int,
     except TypeError:
         fn = smap(local, mesh=mesh, check_rep=False, **specs)
     return jax.jit(fn)
+
+
+# single-kernel formulation state: None = untried, False = failed once
+# (a Mosaic rejection must not re-pay compile latency per dispatch —
+# the two-kernel pipeline below stays the proven fallback)
+_SINGLE_STATE: dict = {"ok": None}
+
+
+def _use_single() -> bool:
+    env = os.environ.get("MT_FUSED_SINGLE", "")
+    if env in ("0", "1"):
+        return env == "1"
+    return _SINGLE_STATE["ok"] is not False
+
+
+def _encode_with_bitrot_single(m, data_blocks: int, parity_blocks: int,
+                               blocks: np.ndarray):
+    """encode_with_bitrot through ops/rs_fused.py: ONE kernel per
+    device reads the data tile from HBM once and emits parity AND
+    hash-state planes; padding mirrors _encode_with_bitrot_pallas
+    (k up to the shard axis, B up to stripe x row-block, n up to the
+    plan's lane tile)."""
+    import jax
+    import jax.numpy as jnp
+    from . import rs_fused, rs_pallas
+
+    T, S = m.shape["stripe"], m.shape["shard"]
+    B, k, n = blocks.shape
+    r = parity_blocks
+    M = np.asarray(gf8.rs_matrix(data_blocks,
+                                 data_blocks + parity_blocks))[k:]
+    padK = (-k) % S
+    if padK:
+        blocks = np.concatenate(
+            [blocks, np.zeros((B, padK, n), np.uint8)], axis=1)
+        M = np.concatenate([M, np.zeros((r, padK), np.uint8)], axis=1)
+    kl = (k + padK) // S
+    hp = S == 1                     # full parity only without k-sharding
+    p = rs_fused.plan(-(-B // T), kl, r, n, hash_parity=hp)
+    B_pad = T * p["B_pad"]
+    if B_pad != B:
+        blocks = np.concatenate(
+            [blocks, np.zeros((B_pad - B, k + padK, n), np.uint8)])
+    if p["n_pad"] != n:
+        blocks = np.pad(blocks, ((0, 0), (0, 0), (0, p["n_pad"] - n)))
+    M = np.ascontiguousarray(M, dtype=np.uint8)
+    mats = jnp.stack([
+        rs_pallas._device_matrix_bd(
+            np.ascontiguousarray(M[:, j * kl:(j + 1) * kl]).tobytes(),
+            r, kl, p["gs"])
+        for j in range(S)])
+    interpret = jax.default_backend() != "tpu"
+    fn = _fused_pallas_single(m, r, kl, p["gs"], p["bs"], p["S"],
+                              p["pc"], n, hp, interpret)
+    parity, digests = fn(mats, jnp.asarray(blocks))
+    parity = np.asarray(parity)[:B, :, :n]
+    digests = np.asarray(digests)
+    # digest rows: [k+padK data slots][r parity slots] — drop the pads
+    digests = np.concatenate(
+        [digests[:B, :k], digests[:B, k + padK:]], axis=1)
+    return parity, digests
 
 
 def _encode_with_bitrot_pallas(m, data_blocks: int, parity_blocks: int,
@@ -307,6 +427,19 @@ def encode_with_bitrot(data_blocks: int, parity_blocks: int,
     m = mesh_mod.get_active_mesh()
     blocks = np.asarray(blocks, dtype=np.uint8)
     if _use_pallas():
+        if _use_single():
+            try:
+                out = _encode_with_bitrot_single(
+                    m, data_blocks, parity_blocks, blocks)
+                _SINGLE_STATE["ok"] = True
+                return out
+            except Exception as e:  # noqa: BLE001 — two-kernel fallback
+                if _SINGLE_STATE["ok"] is None:
+                    import sys
+                    print(f"rs_mesh: single-kernel fused path failed "
+                          f"({type(e).__name__}: {e}); using the "
+                          f"two-kernel pipeline", file=sys.stderr)
+                _SINGLE_STATE["ok"] = False
         return _encode_with_bitrot_pallas(
             m, data_blocks, parity_blocks, blocks)
     T, S = m.shape["stripe"], m.shape["shard"]

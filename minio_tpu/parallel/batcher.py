@@ -529,3 +529,233 @@ class CodecBatcher:
 
 
 GLOBAL = CodecBatcher()
+
+
+# -- the md5 bucket ---------------------------------------------------------
+#
+# Device multi-buffer MD5 (hashing/md5_device.py) rides the SAME
+# combining discipline as the codec buckets, one queue for the whole
+# process: concurrent strict-ETag streams' block advances coalesce
+# into one batched device dispatch (states stacked on the batch axis,
+# ragged block counts masked in-kernel).  The codec refinements carry
+# over verbatim — the combiner releases its role before dispatching so
+# the next batch forms while this one is on the device, at most
+# _MAX_INFLIGHT dispatches run concurrently, and arrivals past the
+# queue bound shed to an uncombined single-lane dispatch (semantically
+# identical, latency bounded).  No owned threads: combiners are
+# borrowed caller threads, so there is nothing to leak at shutdown —
+# test_leaks pins that no md5 bucket state survives a burst.
+
+# widest single dispatch (native/md5mb.cc's MAXL): beyond this the
+# padding waste of ragged lane lengths outgrows the batching win
+_MD5_MAX_LANES = 64
+# queued 64-byte blocks across all waiters; overflow sheds to the
+# serial single-lane dispatch (4 MiB of pending message)
+_MD5_QUEUE_BLOCKS = 1 << 16
+
+
+class _MD5Waiter:
+    __slots__ = ("h", "words", "event", "result", "exc")
+
+    def __init__(self, h: np.ndarray, words: np.ndarray):
+        self.h = h
+        self.words = words
+        self.event = threading.Event()
+        self.result = None
+        self.exc: BaseException | None = None
+
+
+class MD5Batcher:
+    """The process-wide ``md5`` combining bucket (``MD5_GLOBAL``)."""
+
+    def __init__(self, config: CodecConfig | None = None):
+        self._mu = mtlock("codec.md5-batcher")
+        self._cond = threading.Condition(self._mu)
+        self._q: deque[_MD5Waiter] = deque()
+        self._qblocks = 0
+        self._combining = False
+        self._inflight = 0
+        self.config = config or CONFIG
+        # lifetime totals (bench deltas + the test_leaks idle gate)
+        self.dispatches = 0
+        self.requests = 0
+        self.blocks = 0
+        self.shed = 0
+
+    def idle(self) -> bool:
+        """True when no waiter, combiner or dispatch is outstanding —
+        the post-burst/server-stop contract (test_leaks)."""
+        with self._mu:
+            return (not self._q and not self._combining
+                    and self._inflight == 0)
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {"dispatches": self.dispatches,
+                    "requests": self.requests,
+                    "blocks": self.blocks,
+                    "shed": self.shed}
+
+    # -- submission ---------------------------------------------------------
+
+    def advance(self, h: np.ndarray, words: np.ndarray) -> np.ndarray:
+        """Advance one digest state by ``words`` (nb, 16) u32 blocks
+        through the combining queue; returns the new (4,) u32 state.
+        Bit-identical to a lone ``md5_device.advance`` call in every
+        path (lanes are independent; the batch is a pure stacking)."""
+        nb = int(words.shape[0])
+        if nb == 0:
+            return np.asarray(h, np.uint32)
+        w = _MD5Waiter(np.asarray(h, np.uint32), words)
+        with self._mu:
+            if self._qblocks + nb > _MD5_QUEUE_BLOCKS:
+                self.shed += 1
+                shed = True
+                lead = False
+            else:
+                shed = False
+                self._q.append(w)
+                self._qblocks += nb
+                lead = not self._combining
+                if lead:
+                    self._combining = True
+                else:
+                    self._cond.notify_all()      # feed a waiting window
+        if shed:
+            return self._direct(w)
+        if lead:
+            self._combine(own=w)
+        while not w.event.wait(0.05):
+            # self-heal: a combiner that died with our item queued
+            # released the role on the way out — claim it
+            claim = False
+            with self._mu:
+                if w.event.is_set():
+                    break
+                if w in self._q and not self._combining:
+                    self._combining = True
+                    claim = True
+            if claim:
+                self._combine(own=w)
+        if w.exc is not None:
+            raise w.exc
+        return w.result
+
+    # -- the combiner role --------------------------------------------------
+
+    def _combine(self, own: _MD5Waiter | None = None) -> None:
+        cfg = self.config
+        holding = True
+        try:
+            while True:
+                with self._mu:
+                    if cfg.window_s > 0 and \
+                            len(self._q) < _MD5_MAX_LANES:
+                        deadline = time.monotonic() + cfg.window_s
+                        while len(self._q) < _MD5_MAX_LANES:
+                            left = deadline - time.monotonic()
+                            if left <= 0:
+                                break
+                            self._cond.wait(left)
+                    while self._inflight >= _MAX_INFLIGHT and \
+                            len(self._q) < _MD5_MAX_LANES:
+                        self._cond.wait(0.05)
+                    batch = []
+                    while self._q and len(batch) < _MD5_MAX_LANES:
+                        cand = self._q.popleft()
+                        self._qblocks -= int(cand.words.shape[0])
+                        batch.append(cand)
+                    self._combining = False
+                    holding = False
+                    if not batch:
+                        self._cond.notify_all()
+                        return
+                    self._inflight += 1
+                    self._cond.notify_all()
+                try:
+                    self._dispatch(batch)
+                finally:
+                    with self._mu:
+                        self._inflight -= 1
+                        self._cond.notify_all()
+                with self._mu:
+                    # re-claim only while OUR request is unserved (the
+                    # CodecBatcher discipline): once it is done, hand
+                    # the queue to the next arrival or a parked
+                    # waiter's self-heal claim — a caller's latency
+                    # stays bounded by its batch, not the storm
+                    if self._q and not self._combining and \
+                            own is not None and not own.event.is_set():
+                        self._combining = True
+                        holding = True
+                        continue
+                    if self._q and not self._combining:
+                        self._cond.notify_all()
+                    return
+        except BaseException:
+            if holding:
+                with self._mu:
+                    self._combining = False
+                    self._cond.notify_all()
+            raise
+
+    # -- execution ----------------------------------------------------------
+
+    def _direct(self, w: _MD5Waiter) -> np.ndarray:
+        """Uncombined single-lane dispatch (the shed path) — the same
+        engine, occupancy 1."""
+        from ..hashing import md5_device
+        nb = int(w.words.shape[0])
+        out = md5_device.advance(
+            w.h[None], w.words[None], np.asarray([nb], np.int32))[0]
+        self._account(1, nb)
+        return out
+
+    def _dispatch(self, batch: list[_MD5Waiter]) -> None:
+        from ..hashing import md5_device
+        try:
+            # group by pow2 block-count bucket before padding: every
+            # lane in a dispatch pads to the group max, so one 1 MiB
+            # slice batched with 63 one-block tails would otherwise
+            # inflate the transfer 64x (zeros are still bytes on a
+            # slow H2D link).  Same-bucket lanes waste < 2x; equal
+            # slices (the md5_of / _md5_link common case) share one
+            # group exactly as before.
+            groups: dict[int, list[_MD5Waiter]] = {}
+            for w in batch:
+                nb = int(w.words.shape[0])
+                groups.setdefault(md5_device._pow2(nb), []).append(w)
+            for group in groups.values():
+                n = len(group)
+                nbs = [int(w.words.shape[0]) for w in group]
+                nb_max = max(nbs)
+                states = np.stack([w.h for w in group])
+                words = np.zeros((n, nb_max, 16), dtype=np.uint32)
+                for i, w in enumerate(group):
+                    words[i, :nbs[i]] = w.words
+                out = md5_device.advance(
+                    states, words, np.asarray(nbs, np.int32))
+                for i, w in enumerate(group):
+                    w.result = out[i]
+                self._account(n, sum(nbs))
+        except BaseException as e:
+            for w in batch:
+                if w.result is None:
+                    w.exc = e
+            if not isinstance(e, Exception):
+                raise
+        finally:
+            for w in batch:
+                w.event.set()
+
+    def _account(self, lanes: int, nblocks: int) -> None:
+        with self._mu:
+            self.dispatches += 1
+            self.requests += lanes
+            self.blocks += nblocks
+        from ..admin.metrics import GLOBAL as _mtr
+        _mtr.inc("mt_md5_device_batches_total", {"lanes": str(lanes)})
+        _mtr.inc("mt_md5_device_bytes_total", value=float(nblocks * 64))
+
+
+MD5_GLOBAL = MD5Batcher()

@@ -67,6 +67,12 @@ class Scenario:
     # cross-request batcher (the numpy layer's native one-copy framed
     # path never leaves the host)
     backend: str = "numpy"
+    # huge_put drill (ISSUE 12 tentpole c): when non-zero, a single
+    # object of this many bytes is PUT through the layer mid-chaos
+    # (0.3 x duration in — after the drive kill, during the slow-drive
+    # window) and read back byte-correct, while the mix keeps storming
+    # — one big mesh-sharded transfer must not wreck the small-op SLOs
+    huge_put_bytes: int = 0
 
 
 # chaos knobs every scenario runs under: snappy breakers so fault
@@ -132,7 +138,34 @@ def default_matrix(duration_s: float = 15.0) -> list[Scenario]:
                                require_mem_bounded=membound),
             workers=4 if storm or membound else 2,
             backend="tpu" if storm else "numpy"))
+    # huge_put: one mesh-sharded object (1 GiB on a TPU host,
+    # MT_SOAK_HUGE_BYTES overrides) PUT mid-chaos on the mesh-backend
+    # cluster while the GET-heavy mix storms — the byte-correct
+    # round-trip AND the small-op p99s are both assertion rows
+    out.append(Scenario(
+        name="huge_put", mix=MIXES["get_heavy_small"],
+        timeline=_chaos_timeline(duration_s),
+        duration_s=duration_s,
+        budget=_slo.Budget(max_error_rate=0.10),
+        workers=2, backend="mesh",
+        huge_put_bytes=_huge_bytes_default()))
     return out
+
+
+def _huge_bytes_default() -> int:
+    """1 GiB where the mesh actually has chips; a CPU-only harness
+    (virtual mesh, interpret-mode kernels) scales the drill down so
+    the matrix stays runnable everywhere."""
+    env = os.environ.get("MT_SOAK_HUGE_BYTES")
+    if env:
+        return int(env)
+    try:
+        import jax
+        if jax.default_backend() == "tpu":
+            return 1 << 30
+    except Exception:  # noqa: BLE001 — no jax means no mesh anyway
+        pass
+    return 32 << 20
 
 
 def smoke_scenario(duration_s: float = 4.0) -> Scenario:
@@ -171,10 +204,25 @@ def run_scenario(scenario: Scenario, base_dir: str,
                 cluster.endpoint, cluster.s3.iam.root.access_key,
                 cluster.s3.iam.root.secret_key, scenario.mix,
                 workers=scenario.workers, seed=seed)
+            huge: dict = {}
+            huge_thread = None
+            if scenario.huge_put_bytes:
+                cluster.layer.make_bucket("soak-huge")
+                huge_thread = threading.Thread(
+                    target=_run_huge_put,
+                    args=(cluster, scenario, seed, huge),
+                    daemon=True, name="mt-soak-huge")
             conductor = _chaos.ChaosConductor(
                 cluster, scenario.timeline).start()
+            if huge_thread is not None:
+                huge_thread.start()
             gen.run_for(scenario.duration_s)
             conductor.join(timeout=scenario.duration_s + 30.0)
+            if huge_thread is not None:
+                huge_thread.join(timeout=scenario.duration_s + 120.0)
+                if huge_thread.is_alive():
+                    huge.setdefault("error", "huge PUT still running "
+                                    "past the join deadline")
             # snapshot the last-minute plane NOW: its 60s window +
             # 64-sample rings would age the fault-window latencies out
             # during convergence/teardown, hollowing the p99 assertion
@@ -201,6 +249,12 @@ def run_scenario(scenario: Scenario, base_dir: str,
             convergence=conv, convergence_error=conv_err,
             threads_before=threads_before, threads_after=threads_after,
             leaked=leaked)
+        if scenario.huge_put_bytes:
+            rows.append({
+                "scenario": scenario.name,
+                "metric": "huge_put_byte_correct",
+                "value": 1 if huge.get("ok") else 0, "unit": "bool",
+                "passed": bool(huge.get("ok")), "detail": huge})
         # context rows: what actually ran (not assertions; always pass)
         rows.append({"scenario": scenario.name, "metric": "ops_total",
                      "value": recorder.ops(), "unit": "ops",
@@ -217,6 +271,73 @@ def run_scenario(scenario: Scenario, base_dir: str,
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+
+
+class _SeededBody:
+    """File-like deterministic body generator: chunks are produced
+    lazily from the seed and digested as they stream OUT, so the drill
+    holds O(chunk) of the object — the whole point of a 1 GiB drill in
+    the same plane other scenarios run under a 256 MiB watermark."""
+
+    def __init__(self, seed: int, nbytes: int):
+        import hashlib
+
+        import numpy as np
+        self._rng = np.random.default_rng(seed)
+        self._np = np
+        self.left = nbytes
+        self.md5 = hashlib.md5()
+
+    def read(self, n: int) -> bytes:
+        take = min(int(n), self.left)
+        if take <= 0:
+            return b""
+        b = self._rng.integers(0, 256, take,
+                               dtype=self._np.uint8).tobytes()
+        self.left -= take
+        self.md5.update(b)
+        return b
+
+
+def _run_huge_put(cluster, scenario: Scenario, seed: int,
+                  out: dict) -> None:
+    """The huge_put drill body (its own ``mt-soak-huge`` thread):
+    sleep to mid-chaos, stream one ``huge_put_bytes`` object into the
+    layer (mesh-sharded on a mesh-backend cluster — the scaled stream
+    batch spreads its stripes over the whole device axis), then read
+    it back range by range and compare digests.  Both legs hold
+    O(chunk) memory.  Results land in ``out`` for the huge_put
+    assertion row."""
+    import hashlib
+    time.sleep(0.3 * scenario.duration_s)
+    nbytes = scenario.huge_put_bytes
+    chunk = 8 << 20
+    try:
+        src = _SeededBody(seed, nbytes)
+        t0 = time.monotonic()
+        cluster.layer.put_object("soak-huge", "huge-object", src)
+        put_s = time.monotonic() - t0
+        want = src.md5.hexdigest()
+        got = hashlib.md5()
+        t1 = time.monotonic()
+        off = 0
+        while off < nbytes:
+            _, seg = cluster.layer.get_object(
+                "soak-huge", "huge-object", offset=off,
+                length=min(chunk, nbytes - off))
+            got.update(seg)
+            off += len(seg) or chunk
+        get_s = time.monotonic() - t1
+        ok = got.hexdigest() == want
+        out.update(ok=ok, bytes=nbytes, put_s=round(put_s, 3),
+                   get_s=round(get_s, 3),
+                   put_GiBps=round(nbytes / put_s / 2**30, 3)
+                   if put_s > 0 else None)
+        if not ok:
+            out["error"] = "GET bytes differ from PUT body"
+    except Exception as e:  # noqa: BLE001 — the row carries the failure
+        out.update(ok=False, bytes=nbytes,
+                   error=f"{type(e).__name__}: {e}")
 
 
 def run_matrix(scenarios: list[Scenario] | None = None,

@@ -142,9 +142,21 @@ register_subsys("pipeline", {
     # (hashing/md5fast.py): concurrent streams'/parts' ETag updates
     # coalesce into one N-lane multi-buffer call; 1 pins every stream
     # to the plain single-stream core.
+    # ``md5_backend`` picks the strict-ETag engine: auto (measured
+    # device-vs-host choice), device (batched accelerator MD5 via the
+    # md5 combining bucket, hashing/md5_device.py), native (md5mb.cc
+    # lanes), hashlib.  MT_MD5=hashlib still outranks everything (the
+    # operator kill switch).  Live-reloadable via SetConfigKV.
+    # ``mesh_batch_bytes`` caps the mesh-scaled stream batch: on a
+    # mesh codec one huge object's per-dispatch stripe batch grows
+    # with the device count (so a single 5 TiB PUT/GET saturates the
+    # whole stripe axis, not one chip) up to this many bytes — memory
+    # per stream stays O(depth x batch).
     "depth": "2",
     "queue_depth": "2",
     "md5_lanes": "4",
+    "md5_backend": "auto",
+    "mesh_batch_bytes": "268435456",
 })
 register_subsys("codec", {
     # cross-request batching codec service (parallel/batcher.py):

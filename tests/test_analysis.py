@@ -268,6 +268,56 @@ def test_kvconfig_drift_canary(tmp_path):
     assert not clean, clean
 
 
+def test_named_skip_canary(tmp_path):
+    """Skips without a named reason in tests/ are findings; a
+    positional message, a reason= kwarg, or a runtime expression
+    (e.g. ``md5_device.unavailable_reason()``) all count as named."""
+    from minio_tpu.analysis import run_tree as _run
+    root = tmp_path / "nsk"
+    (root / "minio_tpu").mkdir(parents=True)
+    t = root / "tests"
+    t.mkdir()
+    (t / "test_bad.py").write_text(textwrap.dedent("""
+        import pytest
+
+        @pytest.mark.skipif(True)
+        def test_a():
+            pytest.skip()
+
+        def test_b():
+            pytest.skip("")
+
+        @pytest.mark.skip
+        def test_c():
+            pass
+
+        @pytest.mark.skip()
+        def test_d():
+            pass
+        """))
+    (t / "test_clean.py").write_text(textwrap.dedent("""
+        import pytest
+        from somewhere import unavailable_reason
+
+        @pytest.mark.skipif(True, reason="no device on this host")
+        def test_a():
+            pytest.skip(unavailable_reason())
+
+        def test_b():
+            pytest.skip("no native engine")
+
+        def test_c():
+            pytest.skip()  # mt-lint: ok(named-skip) canary fixture
+
+        @pytest.mark.skip(reason="tier needs hardware")
+        def test_d():
+            pass
+        """))
+    ns = [f for f in _run(repo=str(root)) if f.rule == "named-skip"]
+    assert len(ns) == 5, ns
+    assert all(f.path == "tests/test_bad.py" for f in ns), ns
+
+
 def test_suppression_grammar_is_itself_linted(tmp_path):
     # reason-less suppression: the target finding is silenced but the
     # marker itself fails the run

@@ -350,6 +350,69 @@ def test_codec_batcher_leaves_no_threads_or_state(tmp_path):
         cfg.enable, cfg.window_s, cfg._loaded = saved
 
 
+def test_device_md5_state_does_not_survive_server_stop(tmp_path,
+                                                       monkeypatch):
+    """The device-MD5 plane owns NO threads (the md5 combining bucket
+    borrows caller threads exactly like the codec batcher): after a
+    server runs strict-ETag PUTs on the device backend and stops, the
+    bucket is idle — no waiter, combiner or in-flight dispatch — and
+    nothing md5-shaped is left running."""
+    import pytest
+
+    from minio_tpu.hashing import md5_device, md5fast
+    from minio_tpu.parallel import batcher
+
+    if not md5_device.available():
+        pytest.skip(md5_device.unavailable_reason())
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"md{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                           backend="numpy")
+    # the env override outranks the knob, so the server's own
+    # reload_pipeline_config at start cannot reset the rung under us
+    monkeypatch.setenv("MT_MD5", "device")
+    try:
+        srv = S3Server(layer, access_key="mk", secret_key="ms")
+        srv.start()
+        try:
+            c = S3Client(srv.endpoint, "mk", "ms")
+            c.make_bucket("devmd5")
+            body = b"\x5a" * 300_000
+
+            def put(i):
+                c.put_object("devmd5", f"o{i}", body)
+
+            ths = [threading.Thread(target=put, args=(i,),
+                                    daemon=True, name=f"mt-md5put-{i}")
+                   for i in range(4)]
+            for t in ths:
+                t.start()
+            for t in ths:
+                t.join(60)
+            got = c.get_object("devmd5", "o0")
+            assert got.body == body
+            import hashlib
+            etag = {k.lower(): v for k, v in
+                    got.headers.items()}.get("etag", "")
+            assert etag.strip('"') == \
+                hashlib.md5(body).hexdigest()   # device ETag, strict
+            assert batcher.MD5_GLOBAL.snapshot()["requests"] > 0, \
+                "PUTs never rode the device-MD5 bucket"
+        finally:
+            srv.stop()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and \
+                not batcher.MD5_GLOBAL.idle():
+            time.sleep(0.05)
+        assert batcher.MD5_GLOBAL.idle(), \
+            "device-MD5 bucket state survived server stop"
+    finally:
+        md5fast.set_backend("auto")
+
+
 def test_rpc_server_stop_closes_listener(tmp_path):
     from minio_tpu.parallel.rpc import RPCClient, RPCError, RPCServer
     srv = RPCServer("leaksecret")

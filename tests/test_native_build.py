@@ -72,6 +72,31 @@ def test_md5_core_digest_after_cold_build(tmp_path, monkeypatch):
     assert out.raw == hashlib.md5(msg).digest()
 
 
+def test_device_md5_degrades_with_named_reason():
+    """The device-MD5 rung is the top of the strict-ETag ladder
+    (pipeline.md5_backend): with no usable jax device it must degrade
+    with a NAMED reason — the same discipline this tier enforces for
+    a missing compiler — and with one it must agree with hashlib."""
+    import hashlib
+
+    import numpy as np
+
+    from minio_tpu.hashing import md5_device
+    if not md5_device.available():
+        reason = md5_device.unavailable_reason()
+        assert reason, "unavailability must carry a named reason"
+        pytest.skip(reason)
+
+    def direct(h, words):
+        return md5_device.advance(
+            h[None], words[None],
+            np.asarray([words.shape[0]]))[0]
+
+    msg = b"The quick brown fox jumps over the lazy dog" * 100
+    h = md5_device.MD5Device(msg, dispatch=direct)
+    assert h.hexdigest() == hashlib.md5(msg).hexdigest()
+
+
 def test_no_compiler_degrades_to_hashlib(monkeypatch):
     """MT_NATIVE=0 (the no-toolchain path): md5fast must hand back
     hashlib digests, never raise."""

@@ -366,6 +366,36 @@ def test_select_storm_smoke_memory_slo(tmp_path, monkeypatch):
 
 # -- the slow-marked full matrix (bench.py soak leg) -----------------------
 
+def test_huge_put_smoke_mesh_sharded_byte_correct(tmp_path):
+    """The huge_put drill, CI-sized: a mesh-backend cluster storms the
+    GET-heavy mix while one multi-batch object (4 MiB here, 1 GiB in
+    the matrix on a TPU host) is PUT through the layer mid-chaos —
+    its mesh-scaled stream batch spreads stripes over the whole
+    device axis — and read back byte-correct, with the small-op SLO
+    rows still green."""
+    from minio_tpu.soak import chaos as soak_chaos
+    from minio_tpu.soak import report as soak_report
+    from minio_tpu.soak.workload import MIXES
+
+    E = soak_chaos.Event
+    sc = soak_report.Scenario(
+        name="huge_put_smoke",
+        mix=MIXES["get_heavy_small"],
+        timeline=[E(0.6, "drive_kill", drive=0),
+                  E(2.4, "drive_return", drive=0)],
+        duration_s=4.0,
+        backend="mesh",
+        huge_put_bytes=4 * (1 << 20))
+    rows = soak_report.run_scenario(sc, str(tmp_path / "huge"))
+    by_metric = {r["metric"]: r for r in rows}
+    huge = by_metric["huge_put_byte_correct"]
+    assert huge["passed"], huge
+    assert huge["detail"]["bytes"] == 4 * (1 << 20)
+    assert huge["detail"]["put_s"] > 0
+    failed = [r for r in rows if not r["passed"]]
+    assert not failed, failed
+
+
 @pytest.mark.slow
 def test_full_matrix_all_mixes_pass_slo(tmp_path):
     """Acceptance: >= 5 distinct workload mixes each under the full
