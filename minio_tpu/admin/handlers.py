@@ -954,6 +954,11 @@ def _config(h, srv, route, q1, payload, send_json) -> bool:
             # retune heal/scan IO self-pacing on the attached
             # background planes
             srv.reload_background_config()
+        if parts[1] == "policy_opa":
+            # swap the external policy webhook under the live IAM
+            # plane (point at / away from an OPA endpoint, retune its
+            # timeout) without a restart
+            srv.reload_policy_config()
         if parts[1] in ("logger_webhook", "audit_webhook") \
                 or parts[1].startswith("notify_"):
             # rebuild the egress targets live: repointed endpoints and

@@ -198,6 +198,10 @@ def render(layer=None, healer=None, config=None, api_stats=None,
         lines += _locktrace_gauges()
     except Exception:  # noqa: BLE001 — a scrape must never fail
         pass
+    try:
+        lines += _tls_gauges()
+    except Exception:  # noqa: BLE001 — a scrape must never fail
+        pass
     if api_stats is not None:
         try:
             lines += _s3_lastminute_gauges(api_stats)
@@ -623,6 +627,16 @@ def _locktrace_gauges() -> list[str]:
     default) or an empty graph emits no families at all."""
     from ..utils import locktrace
     return locktrace.render_metrics()
+
+
+def _tls_gauges() -> list[str]:
+    """TLS plane families (secure/certs.py): per-certificate seconds
+    to expiry from every live CertManager.  The handshake and reload
+    counters are plain process counters ticked on the TLS paths.  Idle
+    contract: a process that never constructed a cert manager emits no
+    mt_tls_* family at all."""
+    from ..secure.certs import render_metrics
+    return render_metrics()
 
 
 def _memgov_gauges() -> list[str]:

@@ -590,6 +590,47 @@ class KvconfigDriftRule(Rule):
                    for c in consts)
 
 
+# -- tls discipline ----------------------------------------------------------
+
+
+class TlsDisciplineRule(Rule):
+    id = "tls-discipline"
+    description = ("TLS verification must never be weakened in the "
+                   "production tree: ``ssl._create_unverified_context``, "
+                   "``check_hostname = False`` assignments, and "
+                   "``ssl.CERT_NONE`` are flagged (the runner walks "
+                   "``minio_tpu`` only, so tests/ stays free to build "
+                   "negative fixtures; the suppression grammar is "
+                   "honored)")
+
+    def check_module(self, mod: Module):
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Attribute):
+                if node.attr == "_create_unverified_context":
+                    yield Finding(
+                        mod.rel, node.lineno, self.id,
+                        "ssl._create_unverified_context disables "
+                        "certificate verification — build a CA-pinned "
+                        "context (secure/certs.py) instead")
+                elif node.attr == "CERT_NONE":
+                    yield Finding(
+                        mod.rel, node.lineno, self.id,
+                        "ssl.CERT_NONE disables peer verification — "
+                        "pin the deployment CA instead")
+            elif isinstance(node, ast.Assign):
+                if not (isinstance(node.value, ast.Constant)
+                        and node.value.value is False):
+                    continue
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Attribute) and \
+                            tgt.attr == "check_hostname":
+                        yield Finding(
+                            mod.rel, node.lineno, self.id,
+                            "check_hostname = False defeats hostname "
+                            "verification — mint certs with the right "
+                            "SANs (secure/pki.py does) instead")
+
+
 # -- named skip --------------------------------------------------------------
 
 
@@ -696,5 +737,6 @@ ALL_RULES = [
     ThreadDisciplineRule,
     SwallowedExceptionRule,
     KvconfigDriftRule,
+    TlsDisciplineRule,
     NamedSkipRule,
 ]

@@ -37,13 +37,21 @@ class Node:
 
     def __init__(self, spec: NodeSpec, all_specs: list[NodeSpec],
                  secret: str, set_drive_count: int | None = None,
-                 host: str = "127.0.0.1", port: int = 0, **set_kwargs):
+                 host: str = "127.0.0.1", port: int = 0, tls=None,
+                 **set_kwargs):
         self.spec = spec
         self.secret = secret
+        self.tls = tls
+        if tls is not None:
+            # outbound internode clients (this node's RemoteStorage /
+            # RemoteLocker links) resolve their CA-pinned context +
+            # client identity through the process-global registry
+            from .secure import transport as _tls_transport
+            _tls_transport.configure(tls)
         self.drives = {f"drive{i}": XLStorage(d)
                        for i, d in enumerate(spec.drive_dirs)}
         self.locker = LocalLocker()
-        self.rpc = RPCServer(secret, host=host, port=port)
+        self.rpc = RPCServer(secret, host=host, port=port, tls=tls)
         register_storage_service(self.rpc, self.drives)
         register_lock_service(self.rpc, self.locker)
         # codec sidecar (BASELINE north star): peers without a chip can
@@ -100,11 +108,16 @@ class Node:
 
 
 def start_cluster(specs: list[NodeSpec], secret: str,
-                  set_drive_count: int | None = None,
+                  set_drive_count: int | None = None, tls=None,
                   **set_kwargs) -> list[Node]:
     """Boot all nodes, then assemble each node's layer (first node formats,
-    the rest adopt — waitForFormatErasure analog)."""
-    nodes = [Node(s, specs, secret, set_drive_count, **set_kwargs)
+    the rest adopt — waitForFormatErasure analog).  ``tls`` (a
+    secure.certs.CertManager) encrypts the whole internode plane:
+    every RPC listener serves the internode identity and requires
+    CA-signed client certificates, every internode client presents
+    one."""
+    nodes = [Node(s, specs, secret, set_drive_count, tls=tls,
+                  **set_kwargs)
              for s in specs]
     for node in nodes:
         node.assemble()
@@ -173,19 +186,29 @@ def run_node(self_id: str, specs: list[NodeSpec], secret: str,
              s3_address: str = "127.0.0.1:0",
              set_drive_count: int | None = None,
              access_key: str = "minioadmin",
-             secret_key: str = "minioadmin", **set_kwargs):
+             secret_key: str = "minioadmin", tls=None, **set_kwargs):
     """One real cluster member process: RPC services on the DECLARED
     endpoint (so peers can dial before rendezvous), wait for the
-    topology, assemble, serve S3.  Returns (node, s3_server)."""
+    topology, assemble, serve S3.  Returns (node, s3_server).
+
+    ``tls`` may be a CertManager; when omitted, the ``tls`` kvconfig
+    subsystem (env: MT_TLS_ENABLE / MT_TLS_CERTS_DIR) is consulted —
+    a declared ``https://`` topology then comes up fully encrypted on
+    both planes."""
     from .s3.server import S3Server
 
+    if tls is None:
+        from .secure.certs import CertManager
+        from .utils.kvconfig import Config
+        tls = CertManager.from_config(Config())
     spec = next(s for s in specs if s.node_id == self_id)
     if not spec.endpoint:
         raise ValueError(f"node {self_id} needs a declared endpoint")
-    u = spec.endpoint.removeprefix("http://")
+    u = spec.endpoint.removeprefix("https://").removeprefix("http://")
     rhost, _, rport = u.rpartition(":")
     node = Node(spec, specs, secret, set_drive_count,
-                host=rhost or "127.0.0.1", port=int(rport), **set_kwargs)
+                host=rhost or "127.0.0.1", port=int(rport), tls=tls,
+                **set_kwargs)
     # Node re-derives spec.endpoint from the bound socket; with a fixed
     # port they agree with what peers dialed
     wait_for_peers(specs, secret, self_id)
@@ -197,7 +220,7 @@ def run_node(self_id: str, specs: list[NodeSpec], secret: str,
     layer = node.assemble()
     shost, _, sport = s3_address.rpartition(":")
     srv = S3Server(layer, access_key=access_key, secret_key=secret_key,
-                   host=shost or "127.0.0.1", port=int(sport))
+                   host=shost or "127.0.0.1", port=int(sport), tls=tls)
     srv.node_name = self_id     # traces/logs name the serving node
     srv.api_stats.label = self_id
     from .obs import trace as _obs_trace

@@ -23,19 +23,32 @@ import os
 import struct
 from typing import Callable
 
-# gated dependency: the container may lack the `cryptography` wheel.
-# Importing this module must stay cheap and safe (the S3 server pulls
-# the crypto package in unconditionally); only USING SSE requires the
-# AES-GCM backend.
+# AES-GCM backend ladder: the `cryptography` wheel when installed,
+# else the ctypes binding of the libcrypto the stdlib `ssl` module
+# already links (crypto/libcrypto.py) — so SSE and encrypted
+# config/IAM work on the bare container image.  Importing this module
+# must stay cheap and safe (the S3 server pulls the crypto package in
+# unconditionally); only USING SSE requires a backend, and with
+# neither present every use raises DAREError with a named reason.
 try:
     from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-except ImportError:              # pragma: no cover - env dependent
-    AESGCM = None
-try:
     from cryptography.exceptions import InvalidTag
+    BACKEND = "cryptography"
 except ImportError:              # pragma: no cover - env dependent
-    class InvalidTag(Exception):
-        pass
+    from . import libcrypto as _libcrypto
+    from .libcrypto import InvalidTag
+    if _libcrypto.available():
+        AESGCM = _libcrypto.AESGCM
+        BACKEND = "libcrypto"
+    else:
+        AESGCM = None
+        BACKEND = ""
+
+
+def backend_available() -> bool:
+    """True when SOME AES-GCM engine is loadable (wheel or libcrypto);
+    encrypted-at-rest persistence and the SSE test tiers key off it."""
+    return AESGCM is not None
 
 VERSION_20 = 0x20
 AES_256_GCM = 0x00
@@ -54,11 +67,11 @@ class DAREError(Exception):
 
 
 def _aead(key: bytes):
-    """AES-GCM instance or a loud failure when the backend is absent."""
+    """AES-GCM instance or a loud failure when no backend is present."""
     if AESGCM is None:
         raise DAREError(
-            "SSE unavailable: the 'cryptography' AES-GCM backend is not "
-            "installed")
+            "SSE unavailable: no AES-GCM backend (neither the "
+            "'cryptography' wheel nor a loadable libcrypto)")
     return AESGCM(key)
 
 

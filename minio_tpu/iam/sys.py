@@ -79,6 +79,11 @@ class IAMSys:
         # peer fan-out hook (peerRESTMethodLoadUser/LoadPolicy analogs):
         # set by attach_peers; fired after every persisted mutation
         self.on_change = None
+        # external policy webhook (secure/opa.py OpaWebhook, the
+        # cmd/config/policy/opa hook): when set, is_allowed delegates
+        # every non-root decision to it and local policy documents are
+        # not evaluated; swapped live by S3Server.reload_policy_config
+        self.authorizer = None
         # optional etcd backend (cmd/iam-etcd-store.go): when attached,
         # IAM state persists as per-entity etcd keys instead of the
         # drive-replicated json doc — every cluster sharing the etcd
@@ -167,7 +172,12 @@ class IAMSys:
             if self._etcd is not None:
                 self._etcd_save(doc)
             else:
-                blob = json.dumps(doc).encode()
+                # identities/policies persist SEALED under the admin
+                # secret (cmd/config-encrypted.go role): a drive image
+                # must not leak every credential in the deployment
+                from ..secure import configcrypt
+                blob = configcrypt.encrypt_data(
+                    self.root.secret_key, json.dumps(doc).encode())
                 self._layer._fanout(
                     lambda d: d.write_all(SYS_DIR, "config/iam.json",
                                           blob))
@@ -175,19 +185,26 @@ class IAMSys:
             self.on_change()
 
     def load(self) -> None:
+        from ..secure import configcrypt
         doc = None
+        reseal = False
         if self._etcd is not None:
             doc = self._etcd_load()
         else:
+            olds = configcrypt.old_secrets_from_env()
             res, _ = self._layer._fanout(
                 lambda d: d.read_all(SYS_DIR, "config/iam.json"))
             for r in res:
-                if r is not None:
-                    try:
-                        doc = json.loads(r)
-                        break
-                    except json.JSONDecodeError:
-                        continue
+                if r is None:
+                    continue
+                try:
+                    blob, reseal = configcrypt.maybe_decrypt(
+                        self.root.secret_key, r, olds)
+                    doc = json.loads(blob)
+                    break
+                except (configcrypt.DecryptError,
+                        json.JSONDecodeError):
+                    continue        # replica sealed under unknown creds
         with self._mu:
             if doc:
                 self._users = {k: UserIdentity.from_dict(u)
@@ -198,6 +215,11 @@ class IAMSys:
                 self._group_policies = doc.get("groups", {})
                 self._ldap_policies = doc.get("ldap_policies", {})
             self._loaded = True
+        if doc and reseal:
+            # plaintext migration / credentials rotation: the state we
+            # just adopted goes straight back sealed under the CURRENT
+            # admin secret — rotation re-encrypts in place
+            self._save()
 
     # -- users -------------------------------------------------------------
 
@@ -490,10 +512,31 @@ class IAMSys:
     def is_allowed(self, access_key: str, action: str,
                    resource: str = "", context: dict | None = None) -> bool:
         """Policy evaluation over the user's + groups' attached policies
-        (IAMSys.IsAllowed, cmd/iam.go)."""
+        (IAMSys.IsAllowed, cmd/iam.go).  With an external authorizer
+        configured (``policy_opa``), the decision is the webhook's and
+        local policy documents are NOT consulted — except for the root
+        account, which bypasses the webhook exactly like the reference
+        (an unreachable policy engine must never lock the operator
+        out)."""
+        if access_key == self.root.access_key:
+            return True                 # root bypasses policy AND OPA
+        authorizer = self.authorizer
+        if authorizer is not None:
+            with self._mu:
+                u = self._users.get(access_key)
+                if u is None or u.status != "enabled" or u.expired():
+                    return False        # authN facts stay local
+            # an STS session policy is a HARD bound on the credential
+            # (the caller scoped it down at mint time) — the webhook
+            # can only narrow within it, never widen past it, exactly
+            # like the bucket-policy-Allow path intersects it
+            if not self.session_policy_allows(access_key, action,
+                                              resource, context):
+                return False
+            from ..secure.opa import auth_args
+            return authorizer.is_allowed(auth_args(
+                access_key, action, resource, context, owner=False))
         with self._mu:
-            if access_key == self.root.access_key:
-                return True             # root bypasses policy
             u = self._users.get(access_key)
             if u is None or u.status != "enabled" or u.expired():
                 return False

@@ -253,11 +253,17 @@ UNTRACED_PATHS = frozenset({"/rpc/peer/trace_since"})
 def _quiet_connection_errors(fallback):
     """handle_error wrapper for ThreadingHTTPServer: transport-level
     errors from severed or fault-injected connections are expected and
-    dropped; anything else keeps the stock traceback."""
+    dropped — including TLS handshake failures (a plaintext client on
+    a TLS port, a reset mid-handshake, an unverified peer), which the
+    handshake counters already record; anything else keeps the stock
+    traceback."""
+    import ssl as _ssl
+
     def handle(request, client_address):
         import sys
         exc = sys.exc_info()[1]
-        if isinstance(exc, (ConnectionError, TimeoutError)):
+        if isinstance(exc, (ConnectionError, TimeoutError,
+                            _ssl.SSLError)):
             return
         fallback(request, client_address)
     return handle
@@ -395,8 +401,16 @@ class RPCServer:
     # (cmd/http/server.go:185 read/idle deadlines, RPC plane)
     IDLE_TIMEOUT_S = 60.0
 
-    def __init__(self, secret: str, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, secret: str, host: str = "127.0.0.1", port: int = 0,
+                 tls=None):
         self.secret = secret
+        # internode TLS (secure/certs.py CertManager): every accepted
+        # connection is wrapped at accept time with the manager's
+        # CURRENT context (cert rotation re-keys the next connection)
+        # and the handshake completes in the handler thread under a
+        # deadline; the pinned CA makes it MUTUAL — peers without a
+        # CA-signed client identity never reach the token check
+        self.tls = tls
         self._services: dict[str, dict[str, callable]] = {}
         self._raw: dict[str, callable] = {}
         self._raw_stream: dict[str, callable] = {}
@@ -414,6 +428,9 @@ class RPCServer:
         # connection error, which buries real failures under noise
         self.httpd.handle_error = _quiet_connection_errors(
             self.httpd.handle_error)
+        if tls is not None:
+            from ..secure.certs import enable_server_tls
+            enable_server_tls(self.httpd, tls, "internode")
         self.host = host
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
@@ -444,7 +461,8 @@ class RPCServer:
 
     @property
     def endpoint(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        scheme = "https" if self.tls is not None else "http"
+        return f"{scheme}://{self.host}:{self.port}"
 
     def register(self, service: str, methods: dict[str, callable]) -> None:
         self._services.setdefault(service, {}).update(methods)
@@ -474,6 +492,14 @@ class RPCServer:
             timeout = srv_self.IDLE_TIMEOUT_S
 
             def setup(self):
+                if srv_self.tls is not None:
+                    # deferred server-side handshake, in THIS handler
+                    # thread and under a deadline — a peer stalling
+                    # mid-handshake can never park the accept loop,
+                    # and a failure (counted) tears down just this
+                    # connection (quiet_connection_errors drops it)
+                    srv_self.tls.handshake(self.request, "internode",
+                                           timeout=self.timeout)
                 super().setup()
                 with srv_self._conns_mu:
                     srv_self._conns.add(self.connection)
@@ -820,6 +846,13 @@ class RPCClient:
                  breaker: CircuitBreaker | None = None, retry=None):
         u = urllib.parse.urlsplit(endpoint)
         self.host, self.port = u.hostname, u.port
+        # an https:// endpoint rides TLS: the client context (CA pin +
+        # internode client identity for the peer's mTLS requirement)
+        # resolves through the process-global secure.transport
+        # registry, so the dozens of call sites minting clients from
+        # endpoint strings need no new plumbing — the scheme is the
+        # signal
+        self.scheme = u.scheme or "http"
         self.endpoint = endpoint
         self.secret = secret
         self.timeout = timeout
@@ -843,6 +876,10 @@ class RPCClient:
             if conn.sock is not None:
                 conn.sock.settimeout(timeout)
             return conn, True
+        if self.scheme == "https":
+            from ..secure import transport as _tls_transport
+            return _tls_transport.https_connection(
+                self.host, self.port, timeout, plane="internode"), False
         return http.client.HTTPConnection(self.host, self.port,
                                           timeout=timeout), False
 

@@ -34,14 +34,36 @@ class S3Response:
 
 @dataclass
 class S3Client:
-    endpoint: str                       # http://host:port
+    endpoint: str                       # http(s)://host:port
     access_key: str
     secret_key: str
     region: str = "us-east-1"
+    # https endpoints: CA bundle pinning the server (a deployment CA,
+    # not the public web's).  When unset, the process-global
+    # secure.transport registry answers (a cluster that armed TLS
+    # already pinned its CA there), else the system trust store.
+    ca_file: str | None = None
 
     @property
     def _creds(self) -> Credentials:
         return Credentials(self.access_key, self.secret_key)
+
+    def _connect(self, u) -> http.client.HTTPConnection:
+        if u.scheme == "https":
+            from ..secure import transport as _tls_transport
+            ctx = None
+            if self.ca_file:
+                # built once per client (a CA bundle parse per REQUEST
+                # would tax every soak worker), invalidated never —
+                # the pin is immutable for the client's lifetime
+                ctx = getattr(self, "_ctx_cache", None)
+                if ctx is None:
+                    import ssl
+                    ctx = ssl.create_default_context(cafile=self.ca_file)
+                    self._ctx_cache = ctx
+            return _tls_transport.https_connection(
+                u.hostname, u.port, 60, plane="s3", context=ctx)
+        return http.client.HTTPConnection(u.hostname, u.port, timeout=60)
 
     def request(self, method: str, path: str, query: str = "",
                 body: bytes = b"", headers: dict | None = None,
@@ -53,7 +75,7 @@ class S3Client:
             hdrs = sign_request(self._creds, method, url, hdrs, body,
                                 self.region)
         u = urllib.parse.urlsplit(url)
-        conn = http.client.HTTPConnection(u.hostname, u.port, timeout=60)
+        conn = self._connect(u)
         try:
             conn.request(method, u.path + (f"?{u.query}" if u.query else ""),
                          body=body, headers=hdrs)

@@ -176,6 +176,10 @@ _ERRORS = {
         "InvalidRequest", "The object was stored using a form of Server "
         "Side Encryption. The correct parameters must be provided to "
         "retrieve the object.", 400),
+    "InsecureSSECustomerRequest": APIError(
+        "InvalidRequest", "Requests specifying Server Side Encryption "
+        "with Customer provided keys must be made over a secure "
+        "connection.", 400),
     "KMSNotConfigured": APIError(
         "KMSNotConfigured", "Server side encryption specified but KMS is "
         "not configured", 400),

@@ -252,7 +252,7 @@ class S3Server:
     def __init__(self, object_layer, access_key: str = "minioadmin",
                  secret_key: str = "minioadmin", region: str = "us-east-1",
                  host: str = "127.0.0.1", port: int = 0,
-                 max_body_size: int = 1024 ** 3, iam=None):
+                 max_body_size: int = 1024 ** 3, iam=None, tls=None):
         self.layer = object_layer
         if iam is None:
             from ..iam.sys import IAMSys
@@ -262,7 +262,25 @@ class S3Server:
         self.max_body_size = max_body_size
         self.bucket_meta = BucketMetadataSys(object_layer)
         from ..utils.kvconfig import Config
-        self.config = Config(object_layer)
+        # config persists SEALED under the admin secret
+        # (cmd/config-encrypted.go; secure/configcrypt.py) — plaintext
+        # found on disk migrates at load, rotation re-seals in place
+        self.config = Config(object_layer, secret=secret_key)
+        # TLS front (secure/certs.py): an explicit CertManager wins;
+        # otherwise the ``tls`` kvconfig subsystem (certs_dir layout)
+        # arms it at boot.  Cert ROTATION is live via the manager's
+        # mtime watcher; the handshake completes per connection in the
+        # handler thread (never the accept loop).
+        if tls is None:
+            from ..secure.certs import CertManager
+            tls = CertManager.from_config(self.config)
+        self.tls = tls
+        if tls is not None:
+            # scheme-aware clients (S3Client/AdminClient on https
+            # endpoints, the soak scrape) resolve the CA pin through
+            # the process-global registry
+            from ..secure import transport as _tls_transport
+            _tls_transport.configure(tls)
         # etcd coordination backend (cmd/etcd.go): when configured, IAM
         # persists to etcd (cmd/iam-etcd-store.go) and federation DNS
         # records use the CoreDNS/skydns layout
@@ -352,6 +370,9 @@ class S3Server:
         from ..parallel.rpc import _quiet_connection_errors
         self.httpd.handle_error = _quiet_connection_errors(
             self.httpd.handle_error)
+        if self.tls is not None:
+            from ..secure.certs import enable_server_tls
+            enable_server_tls(self.httpd, self.tls, "s3")
         self.port = self.httpd.server_address[1]
         # span attribution names the BOUND port (ephemeral binds resolve
         # only now); run_node overrides both with the cluster node_id
@@ -387,6 +408,9 @@ class S3Server:
         # push ``heal``/``scanner`` pacing into attached background
         # planes (they may also attach later via attach_background)
         self.reload_background_config()
+        # arm the external policy webhook (``policy_opa``) on the IAM
+        # plane when configured
+        self.reload_policy_config()
 
     def reload_api_config(self) -> None:
         """(Re)derive the request-plane knobs from the ``api`` kvconfig
@@ -484,6 +508,19 @@ class S3Server:
             _batcher.CONFIG.load(self.config)
         except Exception:  # noqa: BLE001 — bad knob must not kill boot
             pass
+
+    def reload_policy_config(self) -> None:
+        """(Re)build the external policy webhook from the
+        ``policy_opa`` kvconfig subsystem and swap it under
+        ``IAMSys.is_allowed`` — at boot and after admin SetConfigKV,
+        so an operator can point the cluster at (or away from) an OPA
+        endpoint on a live server.  An empty url restores local policy
+        evaluation."""
+        from ..secure.opa import OpaWebhook
+        try:
+            self.iam.authorizer = OpaWebhook.from_config(self.config)
+        except Exception:  # noqa: BLE001 — a bad knob value must not
+            pass           # take the server (or the IAM plane) down
 
     def reload_background_config(self) -> None:
         """Push the ``heal``/``scanner`` pacing knobs into every
@@ -691,7 +728,8 @@ class S3Server:
 
     @property
     def endpoint(self) -> str:
-        return f"http://127.0.0.1:{self.port}"
+        scheme = "https" if self.tls is not None else "http"
+        return f"{scheme}://127.0.0.1:{self.port}"
 
     def notify(self, event_name: str, bucket: str, oi,
                req_params: dict | None = None) -> None:
@@ -782,6 +820,14 @@ def _make_handler(srv: S3Server):
             # (header SIZE is already bounded by http.server: 64 KiB
             # per line, 100 headers max)
             self.timeout = getattr(srv, "read_header_timeout_s", None)
+            if srv.tls is not None:
+                # deferred TLS handshake, in THIS handler thread under
+                # the header deadline (the accept loop never blocks on
+                # a slow client's handshake); failure counts into
+                # mt_tls_handshake_failed_total and tears down just
+                # this connection
+                srv.tls.handshake(self.request, "s3",
+                                  timeout=self.timeout or 30.0)
             super().setup()
             self.rfile = _DeadlineRFile(self.rfile, self.connection,
                                         self.timeout or 30.0)
@@ -1209,6 +1255,15 @@ def _make_handler(srv: S3Server):
             from ..admin import handlers as admin_handlers
             from ..admin.metrics import GLOBAL as mtr
             try:
+                # SSE-C requires TLS, exactly like AWS (the reference's
+                # ErrInsecureSSECustomerRequest gate): a client key in
+                # the headers of a plaintext request is already leaked
+                # — reject before auth, before anything touches it
+                from ..crypto import sse as _csse
+                if srv.tls is None and (
+                        _csse.SSEC_ALGO in self.headers or
+                        _csse.SSEC_COPY_ALGO in self.headers):
+                    raise S3Error("InsecureSSECustomerRequest")
                 if path.startswith(("/minio-tpu/health/",
                                     "/minio/health/")):
                     # healthcheck router (cmd/healthcheck-router.go:40):

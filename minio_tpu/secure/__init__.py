@@ -1,0 +1,22 @@
+"""The production trust boundary (ISSUE 13) — three planes:
+
+* :mod:`minio_tpu.secure.certs` + :mod:`minio_tpu.secure.transport` —
+  TLS everywhere: an auto-reloading certificate manager (mtime-watched
+  cert/key pairs, SNI, a separate internode client identity, CA-pinned
+  peer verification) wrapped around both listeners (S3 front, internode
+  RPC) and both client stacks, plus the process-global client-context
+  registry every scheme-aware client resolves through;
+* :mod:`minio_tpu.secure.configcrypt` — secrets at rest: DARE
+  encryption of ``.minio-tpu.sys/config`` and IAM state under a
+  credentials-derived key (``cmd/config-encrypted.go`` role), with
+  detect-plaintext migration and re-encrypt-on-rotation;
+* :mod:`minio_tpu.secure.opa` — external policy: the OPA-shaped
+  webhook authorizer ``IAMSys.is_allowed`` consults when the
+  ``policy_opa`` subsystem is configured (fail-closed, bounded
+  timeout, admin bypassed).
+
+:mod:`minio_tpu.secure.pki` mints an ephemeral deployment PKI by
+shelling to the system ``openssl`` — the dev/test analog of
+``minio certgen``, shared by the TLS test tiers and the full-TLS soak
+scenario.
+"""

@@ -1,0 +1,361 @@
+"""Auto-reloading certificate manager — pkg/certs/certs.go +
+cmd/common-main.go:360 rebuilt for per-connection context selection.
+
+The reference watches its certs dir with fsnotify and atomically swaps
+the parsed certificate under a RWMutex; every TLS handshake then reads
+the freshest pair via ``GetCertificate``.  Here the equivalent hot path
+is the per-accept context lookup: both listeners wrap each accepted
+socket with the context the manager currently holds, and the manager
+re-stats its cert/key files (throttled) before answering — so replacing
+the PEM files on disk re-keys the NEXT connection with no restart and
+no listener rebind.  SNI is served through
+``SSLContext.sni_callback`` (per-hostname pairs), the internode plane
+carries its own client identity and REQUIRES peer certificates from
+the pinned CA (mutual TLS), and every loaded certificate's expiry is
+exported at scrape time (``mt_tls_cert_expiry_seconds``).
+
+No threads: the watcher is a throttled stat on the accept path, the
+idiom the kvconfig env layer already uses.
+"""
+
+from __future__ import annotations
+
+import os
+import ssl
+import time
+import weakref
+
+from ..utils.locktrace import mtlock
+
+# every live manager, for the scrape-time gauge families; weak so a
+# stopped server's manager dies with it (idle contract: no manager
+# constructed in the process => no mt_tls_* gauge families at all)
+_MANAGERS: "weakref.WeakSet[CertManager]" = weakref.WeakSet()
+
+
+class TLSConfigError(Exception):
+    """Unusable cert/key material or layout."""
+
+
+def _not_after_epoch(cert_file: str) -> float | None:
+    """notAfter of a PEM certificate as epoch seconds, via the same
+    private decoder ``ssl`` uses for getpeercert (no ASN.1 parser in
+    the stdlib); None when undecodable — the gauge is skipped, never
+    wrong."""
+    try:
+        info = ssl._ssl._test_decode_cert(cert_file)
+        return float(ssl.cert_time_to_seconds(info["notAfter"]))
+    except Exception:  # noqa: BLE001 — absent/garbage cert file: no gauge
+        return None
+
+
+class CertManager:
+    """Cert/key pairs + CA pin with mtime-watched hot reload.
+
+    ``default`` serves the S3 front; ``internode`` (when given) is the
+    RPC plane's identity — served to internode peers AND presented as
+    the CLIENT certificate on outbound internode connections, so the
+    two trust domains can rotate independently.  ``ca_file`` pins peer
+    verification: internode servers REQUIRE a client certificate
+    chaining to it (mutual TLS), and every client context verifies
+    servers against it.  ``sni`` maps hostnames to extra pairs served
+    via the SNI callback.
+    """
+
+    HANDSHAKE_TIMEOUT_S = 10.0
+
+    def __init__(self, default: tuple[str, str],
+                 internode: tuple[str, str] | None = None,
+                 ca_file: str | None = None,
+                 sni: dict[str, tuple[str, str]] | None = None,
+                 check_interval_s: float = 1.0,
+                 clock=time.monotonic):
+        self._default = (str(default[0]), str(default[1]))
+        self._internode = (str(internode[0]), str(internode[1])) \
+            if internode else None
+        self.ca_file = str(ca_file) if ca_file else None
+        self._sni = {str(h): (str(c), str(k))
+                     for h, (c, k) in (sni or {}).items()}
+        self.check_interval_s = check_interval_s
+        self._clock = clock
+        self._mu = mtlock("secure.certs")
+        self._server_ctx: dict[str, ssl.SSLContext] = {}
+        self._client_ctx: dict[str, ssl.SSLContext] = {}
+        self._sni_ctx: dict[str, ssl.SSLContext] = {}
+        self._mtimes = self._stat_files()
+        self._last_check = self._clock()
+        self.reloads = 0
+        self._expiries = self._read_expiries()
+        # fail LOUD at construction: a server "with TLS" whose cert
+        # files are unreadable must not come up plaintext
+        for cert, key in self._pairs().values():
+            if not (os.path.exists(cert) and os.path.exists(key)):
+                raise TLSConfigError(
+                    f"missing cert/key material: {cert} / {key}")
+        _MANAGERS.add(self)
+
+    # -- file watching -----------------------------------------------------
+
+    def _pairs(self) -> dict[str, tuple[str, str]]:
+        out = {"s3": self._default}
+        if self._internode:
+            out["internode"] = self._internode
+        for host, pair in self._sni.items():
+            out[f"sni:{host}"] = pair
+        return out
+
+    def _watched(self) -> list[str]:
+        files = []
+        for cert, key in self._pairs().values():
+            files += [cert, key]
+        if self.ca_file:
+            files.append(self.ca_file)
+        return files
+
+    def _stat_files(self) -> dict[str, float]:
+        out = {}
+        for f in self._watched():
+            try:
+                out[f] = os.stat(f).st_mtime
+            except OSError:
+                out[f] = -1.0
+        return out
+
+    def _read_expiries(self) -> dict[str, float]:
+        out = {}
+        for label, (cert, _) in self._pairs().items():
+            exp = _not_after_epoch(cert)
+            if exp is not None:
+                out[label] = exp
+        return out
+
+    def maybe_reload(self, force: bool = False) -> bool:
+        """Re-stat the watched files (throttled to ``check_interval_s``)
+        and drop every cached context when any mtime moved — the next
+        handshake then loads the rotated material.  Returns True when a
+        reload happened."""
+        now = self._clock()
+        with self._mu:
+            if not force and \
+                    now - self._last_check < self.check_interval_s:
+                return False
+            self._last_check = now
+        mtimes = self._stat_files()
+        with self._mu:
+            if not force and mtimes == self._mtimes:
+                return False
+            self._mtimes = mtimes
+            self._server_ctx.clear()
+            self._client_ctx.clear()
+            self._sni_ctx.clear()
+            self.reloads += 1
+        self._expiries = self._read_expiries()
+        from ..admin.metrics import GLOBAL as mtr
+        mtr.inc("mt_tls_cert_reloads_total")
+        return True
+
+    # -- context construction ----------------------------------------------
+
+    def _load_chain(self, ctx: ssl.SSLContext,
+                    pair: tuple[str, str]) -> None:
+        try:
+            ctx.load_cert_chain(certfile=pair[0], keyfile=pair[1])
+        except (OSError, ssl.SSLError) as e:
+            raise TLSConfigError(
+                f"cannot load cert chain {pair[0]}: {e}") from e
+
+    def _build_server(self, plane: str) -> ssl.SSLContext:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+        if plane == "internode":
+            self._load_chain(ctx, self._internode or self._default)
+            if self.ca_file:
+                # mutual TLS: only holders of a CA-signed client
+                # identity may speak internode RPC (defense alongside
+                # the per-request HMAC bearer token)
+                ctx.load_verify_locations(cafile=self.ca_file)
+                ctx.verify_mode = ssl.CERT_REQUIRED
+        else:
+            self._load_chain(ctx, self._default)
+            if self._sni:
+                ctx.sni_callback = self._sni_select
+        return ctx
+
+    def _build_client(self, plane: str) -> ssl.SSLContext:
+        # create_default_context keeps secure defaults (CERT_REQUIRED,
+        # hostname checking, TLS>=1.2); the pin only REPLACES the trust
+        # roots — a deployment CA, not the public web's
+        ctx = ssl.create_default_context(cafile=self.ca_file)
+        if plane == "internode" and (self._internode or self._default):
+            # outbound internode identity: the peer's mTLS requirement
+            self._load_chain(ctx, self._internode or self._default)
+        return ctx
+
+    def _sni_select(self, sslobj, server_name, ctx) -> None:
+        """SNI callback on the S3 server context: a connection naming a
+        configured hostname handshakes with that pair instead of the
+        default (multi-domain deployments, bucket-DNS wildcards)."""
+        if not server_name:
+            return None
+        pair = self._sni.get(server_name)
+        if pair is None:
+            return None
+        with self._mu:
+            sctx = self._sni_ctx.get(server_name)
+        if sctx is None:
+            sctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            sctx.minimum_version = ssl.TLSVersion.TLSv1_2
+            self._load_chain(sctx, pair)
+            with self._mu:
+                self._sni_ctx[server_name] = sctx
+        sslobj.context = sctx
+        return None
+
+    def server_context(self, plane: str = "s3") -> ssl.SSLContext:
+        self.maybe_reload()
+        with self._mu:
+            ctx = self._server_ctx.get(plane)
+        if ctx is None:
+            ctx = self._build_server(plane)
+            with self._mu:
+                self._server_ctx[plane] = ctx
+        return ctx
+
+    def client_context(self, plane: str = "internode") -> ssl.SSLContext:
+        self.maybe_reload()
+        with self._mu:
+            ctx = self._client_ctx.get(plane)
+        if ctx is None:
+            ctx = self._build_client(plane)
+            with self._mu:
+                self._client_ctx[plane] = ctx
+        return ctx
+
+    # -- listener integration ----------------------------------------------
+
+    def wrap_accept(self, sock, plane: str):
+        """Wrap one just-accepted socket WITHOUT handshaking — called
+        from the accept loop, which must never block on a slow client's
+        handshake; the handler thread completes it via
+        :meth:`handshake`."""
+        return self.server_context(plane).wrap_socket(
+            sock, server_side=True, do_handshake_on_connect=False,
+            suppress_ragged_eofs=True)
+
+    def handshake(self, ssl_sock, plane: str,
+                  timeout: float | None = None) -> None:
+        """Complete the deferred server-side handshake under a deadline
+        (a blackholed or trickling client cannot park the handler
+        thread), counting the handshake families.  ``total`` includes
+        failures — ``failed_total / total`` is the failure rate."""
+        from ..admin.metrics import GLOBAL as mtr
+        try:
+            ssl_sock.settimeout(timeout or self.HANDSHAKE_TIMEOUT_S)
+            ssl_sock.do_handshake()
+        except BaseException:
+            mtr.inc("mt_tls_handshake_total", {"plane": plane})
+            mtr.inc("mt_tls_handshake_failed_total", {"plane": plane})
+            raise
+        mtr.inc("mt_tls_handshake_total", {"plane": plane})
+
+    def cert_expiries(self) -> dict[str, float]:
+        """label -> notAfter (epoch seconds) per loaded certificate."""
+        return dict(self._expiries)
+
+    # -- config boot --------------------------------------------------------
+
+    @classmethod
+    def from_config(cls, cfg) -> "CertManager | None":
+        """Build from the ``tls`` kvconfig subsystem (``enable`` +
+        ``certs_dir``); None when disabled.  Layout (docs/security.md):
+
+            <dir>/public.crt + private.key            S3 front pair
+            <dir>/internode/public.crt + private.key  internode identity
+            <dir>/CAs/*.crt                           pinned trust root
+            <dir>/sni/<hostname>/public.crt + private.key
+        """
+        try:
+            if cfg.get("tls", "enable") != "on":
+                return None
+            certs_dir = cfg.get("tls", "certs_dir")
+        except KeyError:
+            return None
+        if not certs_dir:
+            raise TLSConfigError("tls.enable=on but tls.certs_dir empty")
+        return cls.from_dir(certs_dir)
+
+    @classmethod
+    def from_dir(cls, certs_dir: str) -> "CertManager":
+        default = (os.path.join(certs_dir, "public.crt"),
+                   os.path.join(certs_dir, "private.key"))
+        inter_dir = os.path.join(certs_dir, "internode")
+        internode = None
+        if os.path.isdir(inter_dir):
+            internode = (os.path.join(inter_dir, "public.crt"),
+                         os.path.join(inter_dir, "private.key"))
+        ca_dir = os.path.join(certs_dir, "CAs")
+        ca_file = None
+        if os.path.isdir(ca_dir):
+            cas = sorted(f for f in os.listdir(ca_dir)
+                         if f.endswith((".crt", ".pem")))
+            if cas:
+                ca_file = os.path.join(ca_dir, cas[0])
+        sni = {}
+        sni_dir = os.path.join(certs_dir, "sni")
+        if os.path.isdir(sni_dir):
+            for host in sorted(os.listdir(sni_dir)):
+                pair = (os.path.join(sni_dir, host, "public.crt"),
+                        os.path.join(sni_dir, host, "private.key"))
+                if os.path.exists(pair[0]):
+                    sni[host] = pair
+        return cls(default, internode=internode, ca_file=ca_file,
+                   sni=sni or None)
+
+
+def enable_server_tls(httpd, manager: CertManager, plane: str) -> None:
+    """Interpose the manager on a ThreadingHTTPServer's accept path:
+    each accepted socket is wrapped (handshake deferred to the handler
+    thread) with the context the manager holds AT ACCEPT TIME — the
+    hot-reload point.  The wrapped socket IS the handler's ``request``,
+    so socketserver's shutdown_request closes the right fd.
+
+    A failure HERE (a non-atomic cert rotation left a half-written or
+    corrupt PEM on disk when the reload fired) must cost exactly ONE
+    connection, never the listener: socketserver's accept loop only
+    catches OSError around get_request, so the manager's
+    TLSConfigError is converted — each affected accept drops until the
+    rotation completes and the next mtime-triggered rebuild succeeds."""
+    base_get = httpd.get_request
+
+    def get_request():
+        sock, addr = base_get()
+        try:
+            return manager.wrap_accept(sock, plane), addr
+        except TLSConfigError as e:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise OSError(f"TLS accept ({plane}): {e}") from e
+
+    httpd.get_request = get_request
+
+
+def render_metrics() -> list[str]:
+    """Scrape-time TLS gauge families from every live manager
+    (admin/metrics.py calls this per render).  Idle contract: a
+    process that never constructed a CertManager emits nothing."""
+    managers = list(_MANAGERS)
+    expiries: dict[str, float] = {}
+    for m in managers:
+        for label, exp in m.cert_expiries().items():
+            expiries.setdefault(label, exp)
+    if not expiries:
+        return []
+    now = time.time()
+    lines = ["# TYPE mt_tls_cert_expiry_seconds gauge"]
+    for label in sorted(expiries):
+        lines.append(
+            f'mt_tls_cert_expiry_seconds{{cert="{label}"}}'
+            f" {expiries[label] - now:.0f}")
+    return lines

@@ -1,0 +1,111 @@
+"""Secrets at rest — cmd/config-encrypted.go / madmin.EncryptData role.
+
+Cluster config (``.minio-tpu.sys/config/config.json``) and IAM state
+(``config/iam.json``) persist through the object layer on every drive;
+plaintext there means any drive image leaks every credential and
+policy.  This module seals those blobs as::
+
+    MAGIC (8 bytes) || salt (16 bytes) || DARE 2.0 ciphertext
+
+under a key derived from the ADMIN SECRET with PBKDF2-HMAC-SHA256
+(stdlib; the reference uses argon2id via madmin — same shape, a
+credentials-derived KEK).  The magic prefix makes the format
+self-describing, which buys the two migration paths for free:
+
+* **detect-plaintext on load** — a pre-existing plaintext blob still
+  parses (no magic), and the caller re-persists it sealed;
+* **re-encrypt on rotation** — a blob sealed under retired credentials
+  decrypts via ``old_secrets`` (``MT_ADMIN_SECRET_OLD``, the
+  ``MINIO_SECRET_KEY_OLD`` analog) and the caller re-seals it under
+  the current secret, in place.
+
+With no AES-GCM backend at all (neither the wheel nor libcrypto)
+encryption degrades to plaintext persistence — a bare image must still
+boot — and :func:`encryption_available` lets callers and tests tell.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+from ..crypto import dare
+
+MAGIC = b"MTCFGE1\x00"
+SALT_SIZE = 16
+# sha256 PBKDF2 is C-speed in CPython; 10k iterations is ~5 ms per
+# derivation — IAM persists on every mutation, so this is the knee
+# between KDF hardness and write-path latency
+PBKDF2_ITERS = 10_000
+
+
+class DecryptError(Exception):
+    """Sealed blob that no offered credential opens."""
+
+
+def encryption_available() -> bool:
+    return dare.backend_available()
+
+
+def derive_key(secret: str, salt: bytes) -> bytes:
+    return hashlib.pbkdf2_hmac("sha256", secret.encode(), salt,
+                               PBKDF2_ITERS, dklen=dare.KEY_SIZE)
+
+
+def is_encrypted(blob: bytes) -> bool:
+    return bool(blob) and bytes(blob[:len(MAGIC)]) == MAGIC
+
+
+def encrypt_data(secret: str, plaintext: bytes) -> bytes:
+    """Seal; returns the plaintext unchanged when no backend exists
+    (callers persist what they get — the degradation is explicit in
+    encryption_available, never a silent crash)."""
+    if not encryption_available():
+        return plaintext
+    salt = os.urandom(SALT_SIZE)
+    return MAGIC + salt + dare.encrypt(derive_key(secret, salt),
+                                       plaintext)
+
+
+def decrypt_data(secret: str, blob: bytes) -> bytes:
+    if not is_encrypted(blob):
+        raise DecryptError("blob carries no encryption header")
+    salt = bytes(blob[len(MAGIC):len(MAGIC) + SALT_SIZE])
+    body = bytes(blob[len(MAGIC) + SALT_SIZE:])
+    if len(salt) != SALT_SIZE or not body:
+        raise DecryptError("truncated encrypted blob")
+    try:
+        return dare.decrypt(derive_key(secret, salt), body)
+    except dare.DAREError as e:
+        raise DecryptError(f"cannot decrypt: {e}") from e
+
+
+def old_secrets_from_env() -> tuple[str, ...]:
+    """Retired admin secrets offered at load time (rotation):
+    ``MT_ADMIN_SECRET_OLD`` may be comma-separated, newest first."""
+    raw = os.environ.get("MT_ADMIN_SECRET_OLD", "")
+    return tuple(s for s in (p.strip() for p in raw.split(","))
+                 if s)
+
+
+def maybe_decrypt(secret: str, blob: bytes,
+                  old_secrets: tuple[str, ...] = ()
+                  ) -> tuple[bytes, bool]:
+    """Open one persisted blob whatever its generation.
+
+    Returns ``(plaintext, needs_reencrypt)``: ``needs_reencrypt`` is
+    True for a plaintext blob (migrate on next save) and for one
+    sealed under a RETIRED secret (rotation: re-seal under the current
+    one).  Raises :class:`DecryptError` when the blob is sealed and no
+    offered credential opens it — the caller skips that replica.
+    """
+    if not is_encrypted(blob):
+        return bytes(blob), encryption_available() and bool(secret)
+    last: DecryptError | None = None
+    for cand, stale in ((secret, False),
+                        *((o, True) for o in old_secrets)):
+        try:
+            return decrypt_data(cand, blob), stale
+        except DecryptError as e:
+            last = e
+    raise last or DecryptError("no credential offered")

@@ -1,0 +1,131 @@
+"""External policy — the OPA-shaped webhook authorizer
+(cmd/config/policy/opa/config.go).
+
+When the ``policy_opa`` kvconfig subsystem names a URL,
+``IAMSys.is_allowed`` stops evaluating local policy documents and asks
+the webhook instead (the reference swaps its engine the same way),
+with two carve-outs that mirror it exactly: the ROOT/admin account
+bypasses the webhook (an unreachable authorizer must never lock the
+operator out of their own cluster), and authentication is untouched —
+the webhook authorizes, SigV4 still authenticates.
+
+Contract (docs/security.md): POST ``{"input": {...auth args...}}`` as
+JSON; the decision is the OPA response's ``result`` field (a bare
+boolean body is also accepted).  FAIL-CLOSED: a timeout, transport
+error, non-2xx status, or undecodable reply DENIES — an unreachable
+policy engine must never widen access.  The wait is bounded
+(``policy_opa.timeout`` per attempt) and transient failures retry
+under the shared jittered-backoff policy (utils/retry.py), so the
+authorization path can never hang a request-plane thread.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from ..utils.kvconfig import parse_duration
+from ..utils.retry import RetryPolicy
+
+
+class OpaWebhook:
+    """One configured authorizer endpoint; stateless and lock-free, so
+    a live reload just swaps the instance under the IAM hook."""
+
+    def __init__(self, url: str, auth_token: str = "",
+                 timeout_s: float = 2.0, attempts: int = 2,
+                 opener=urllib.request.urlopen):
+        self.url = url
+        self.auth_token = auth_token
+        self.timeout_s = max(0.05, float(timeout_s))
+        self.retry = RetryPolicy(attempts=attempts, base_s=0.05,
+                                 cap_s=0.5)
+        self._opener = opener
+
+    @classmethod
+    def from_config(cls, cfg) -> "OpaWebhook | None":
+        """None ONLY when no url is set (local policy evaluation stays
+        in charge).  With a url, the webhook is ALWAYS armed: a bad
+        auxiliary knob value falls back to its default rather than
+        silently disarming the authorizer — reverting to local policy
+        on a typo would be fail-OPEN, the one thing this subsystem
+        must never do."""
+        try:
+            url = (cfg.get("policy_opa", "url") or "").strip()
+        except KeyError:
+            return None
+        if not url:
+            return None
+
+        def knob(key, default):
+            try:
+                return cfg.get("policy_opa", key)
+            except KeyError:
+                return default
+
+        try:
+            attempts = max(1, int(knob("retry_attempts", "2")))
+        except ValueError:
+            attempts = 2
+        return cls(
+            url,
+            auth_token=knob("auth_token", "") or "",
+            timeout_s=parse_duration(knob("timeout", "2s"), 2.0),
+            attempts=attempts)
+
+    def _ask(self, body: bytes) -> bool:
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json",
+                     **({"Authorization": f"Bearer {self.auth_token}"}
+                        if self.auth_token else {})})
+        with self._opener(req, timeout=self.timeout_s) as resp:
+            doc = json.loads(resp.read() or b"false")
+        if isinstance(doc, dict):
+            # OPA data-API shape {"result": <decision>}; a decision
+            # document with an "allow" field also counts (rego policies
+            # often return objects)
+            result = doc.get("result", False)
+            if isinstance(result, dict):
+                result = result.get("allow", False)
+            return bool(result)
+        return bool(doc)
+
+    def is_allowed(self, args: dict) -> bool:
+        """One authorization decision; every failure path denies."""
+        from ..admin.metrics import GLOBAL as mtr
+        body = json.dumps({"input": args}).encode()
+        attempt = 0
+        while True:
+            try:
+                verdict = self._ask(body)
+                self.retry.on_success()
+                mtr.inc("mt_policy_webhook_total",
+                        {"verdict": "allow" if verdict else "deny"})
+                return verdict
+            except Exception:  # noqa: BLE001 — every failure class
+                # (timeout, refused, 5xx, garbage body) converges on
+                # the same fail-closed verdict below
+                if self.retry.may_retry(attempt, idempotent=True):
+                    self.retry.wait(attempt)
+                    attempt += 1
+                    continue
+                mtr.inc("mt_policy_webhook_total",
+                        {"verdict": "error"})
+                return False
+
+
+def auth_args(access_key: str, action: str, resource: str,
+              context: dict | None, owner: bool) -> dict:
+    """The PolicyArgs document the reference posts (opa/config.go
+    IsAllowed): who, what, on what, with which request conditions."""
+    bucket = resource.split("/", 1)[0] if resource else ""
+    return {
+        "account": access_key,
+        "action": action,
+        "bucket": bucket,
+        "object": resource[len(bucket) + 1:]
+        if bucket and "/" in resource else "",
+        "conditions": context or {},
+        "owner": owner,
+    }

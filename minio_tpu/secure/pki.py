@@ -1,0 +1,125 @@
+"""Ephemeral deployment PKI — mint a CA + leaf certs by shelling to
+the system ``openssl`` (the ``minio certgen`` / console-certgen role).
+
+Used by the TLS test tiers (via the ``tests/_pki.py`` fixture) and the
+full-TLS soak scenario: one CA, an S3 front leaf, and an internode
+leaf, all EC P-256 (fast to mint), SAN-covering ``localhost`` and
+``127.0.0.1`` so hostname verification stays STRICT even against
+loopback endpoints — nothing in the production tree ever disables
+``check_hostname`` (the ``tls-discipline`` lint enforces it).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from dataclasses import dataclass
+
+OPENSSL = "/usr/bin/openssl"
+
+_EC_KEY = ("-newkey", "ec", "-pkeyopt", "ec_paramgen_curve:prime256v1",
+           "-nodes")
+DEFAULT_SAN = "DNS:localhost,IP:127.0.0.1"
+
+
+class PKIError(Exception):
+    pass
+
+
+def available() -> bool:
+    return os.path.exists(OPENSSL)
+
+
+def _run(args: list[str]) -> None:
+    proc = subprocess.run([OPENSSL, *args], capture_output=True,
+                          text=True)
+    if proc.returncode != 0:
+        raise PKIError(f"openssl {args[0]} failed: "
+                       f"{proc.stderr.strip()[:500]}")
+
+
+@dataclass(frozen=True)
+class PKI:
+    """Minted material: the CA plus the two leaf identities the trust
+    boundary separates (S3 front vs internode)."""
+    dir: str
+    ca_cert: str
+    ca_key: str
+    s3_cert: str
+    s3_key: str
+    internode_cert: str
+    internode_key: str
+
+    def cert_manager(self, **kw):
+        from .certs import CertManager
+        return CertManager(
+            (self.s3_cert, self.s3_key),
+            internode=(self.internode_cert, self.internode_key),
+            ca_file=self.ca_cert, **kw)
+
+    def write_certs_dir(self, certs_dir: str) -> str:
+        """Lay the material out in the ``tls.certs_dir`` layout
+        (docs/security.md) so CertManager.from_dir/from_config and the
+        minted PKI agree on one shape."""
+        import shutil
+        os.makedirs(os.path.join(certs_dir, "internode"), exist_ok=True)
+        os.makedirs(os.path.join(certs_dir, "CAs"), exist_ok=True)
+        shutil.copy(self.s3_cert, os.path.join(certs_dir, "public.crt"))
+        shutil.copy(self.s3_key, os.path.join(certs_dir, "private.key"))
+        shutil.copy(self.internode_cert,
+                    os.path.join(certs_dir, "internode", "public.crt"))
+        shutil.copy(self.internode_key,
+                    os.path.join(certs_dir, "internode", "private.key"))
+        shutil.copy(self.ca_cert, os.path.join(certs_dir, "CAs", "ca.crt"))
+        return certs_dir
+
+
+def mint_ca(out_dir: str, cn: str = "minio-tpu ephemeral CA",
+            days: int = 3) -> tuple[str, str]:
+    os.makedirs(out_dir, exist_ok=True)
+    crt = os.path.join(out_dir, "ca.crt")
+    key = os.path.join(out_dir, "ca.key")
+    # `req -x509` already stamps basicConstraints=CA:TRUE; adding it
+    # again via -addext would DUPLICATE the extension and OpenSSL then
+    # rejects the whole CA at verification time
+    _run(["req", "-x509", *_EC_KEY, "-keyout", key, "-out", crt,
+          "-days", str(days), "-subj", f"/CN={cn}",
+          "-addext", "keyUsage=critical,keyCertSign,cRLSign"])
+    return crt, key
+
+
+def mint_leaf(out_dir: str, ca_cert: str, ca_key: str, name: str,
+              san: str = DEFAULT_SAN, days: int = 2) -> tuple[str, str]:
+    """One CA-signed leaf good for both server and client auth (the
+    internode identity is used in BOTH roles: served to peers and
+    presented as the mTLS client certificate)."""
+    os.makedirs(out_dir, exist_ok=True)
+    crt = os.path.join(out_dir, f"{name}.crt")
+    key = os.path.join(out_dir, f"{name}.key")
+    csr = os.path.join(out_dir, f"{name}.csr")
+    ext = os.path.join(out_dir, f"{name}.ext")
+    # `openssl x509 -req` (1.1.1) does not copy CSR extensions, so the
+    # SAN/EKU ride an explicit extfile at signing time
+    with open(ext, "w") as f:
+        f.write(f"subjectAltName={san}\n"
+                "extendedKeyUsage=serverAuth,clientAuth\n"
+                "basicConstraints=CA:FALSE\n"
+                "keyUsage=digitalSignature,keyEncipherment\n")
+    _run(["req", "-new", *_EC_KEY, "-keyout", key, "-out", csr,
+          "-subj", f"/CN={name}"])
+    _run(["x509", "-req", "-in", csr, "-CA", ca_cert, "-CAkey", ca_key,
+          "-CAcreateserial", "-out", crt, "-days", str(days),
+          "-extfile", ext])
+    return crt, key
+
+
+def mint_cluster_pki(out_dir: str, san: str = DEFAULT_SAN) -> PKI:
+    """CA + S3 leaf + internode leaf under ``out_dir`` — everything a
+    full-TLS cluster (both planes encrypted) needs."""
+    if not available():
+        raise PKIError(f"{OPENSSL} not present on this image")
+    ca_crt, ca_key = mint_ca(out_dir)
+    s3_crt, s3_key = mint_leaf(out_dir, ca_crt, ca_key, "s3", san=san)
+    in_crt, in_key = mint_leaf(out_dir, ca_crt, ca_key, "internode",
+                               san=san)
+    return PKI(out_dir, ca_crt, ca_key, s3_crt, s3_key, in_crt, in_key)

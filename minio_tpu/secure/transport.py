@@ -1,0 +1,63 @@
+"""Process-global TLS client-context registry.
+
+RPC clients are minted from endpoint strings all over the cluster
+plane (cluster assembly, peer notifiers, remote storage, metacache
+invalidation) — threading a cert manager through every constructor
+would touch dozens of call sites for no gain.  Instead the scheme IS
+the signal: an ``https://`` endpoint resolves its client context here,
+exactly like the process-global ``STREAM``/``CONFIG``/``GOVERNOR``
+knob singletons this codebase already runs on.  Whoever boots TLS
+(server_main, the cluster assembler, SoakCluster, a test) calls
+:func:`configure` with its :class:`~minio_tpu.secure.certs.CertManager`
+once; unconfigured processes fall back to the system trust store so a
+client can still talk to a publicly-certified endpoint.
+"""
+
+from __future__ import annotations
+
+import http.client
+import ssl
+
+from ..utils.locktrace import mtlock
+
+_mu = mtlock("secure.transport")
+_manager = None
+_default_ctx: dict[str, ssl.SSLContext] = {}
+
+
+def configure(manager) -> None:
+    """Install (or clear, with None) the process's cert manager."""
+    global _manager
+    with _mu:
+        _manager = manager
+
+
+def manager():
+    with _mu:
+        return _manager
+
+
+def client_context(plane: str = "internode") -> ssl.SSLContext:
+    """The freshest client context for one plane: CA-pinned (+ client
+    identity on the internode plane) when a manager is configured,
+    else the system default trust store."""
+    with _mu:
+        m = _manager
+    if m is not None:
+        return m.client_context(plane)
+    with _mu:
+        ctx = _default_ctx.get(plane)
+        if ctx is None:
+            ctx = _default_ctx[plane] = ssl.create_default_context()
+        return ctx
+
+
+def https_connection(host, port, timeout: float,
+                     plane: str = "internode",
+                     context: ssl.SSLContext | None = None
+                     ) -> http.client.HTTPSConnection:
+    """HTTPSConnection with the plane's (or an explicit) context — the
+    one constructor every scheme-aware client shares."""
+    return http.client.HTTPSConnection(
+        host, port, timeout=timeout,
+        context=context or client_context(plane))

@@ -47,11 +47,13 @@ class SoakCluster:
                  drives_per_node: int = 2, parity: int = 2,
                  secret: str = "soak-secret", access_key: str = "soakkey",
                  secret_key: str = "soaksecret", block_size: int = 64 * 1024,
-                 backend: str = "numpy", mrf_maxsize: int = 10_000):
+                 backend: str = "numpy", mrf_maxsize: int = 10_000,
+                 tls=None):
         self.specs: list[NodeSpec] = []
         self.nodes: list[Node] = []
         self.proxies: list[FaultyProxy] = []
         self.s3: S3Server | None = None
+        self.tls = tls
         self._saved: dict[int, object] = {}
         for n in range(nodes):
             dirs = []
@@ -64,18 +66,24 @@ class SoakCluster:
         sdc = nodes * drives_per_node
         try:
             # phase 1: boot every node's RPC plane on its real port
+            # (with ``tls`` — a secure.certs.CertManager — BOTH planes
+            # come up encrypted: internode mTLS here, the S3 front
+            # below; the FaultyProxy layer is a dumb TCP relay, so
+            # chaos faults land mid-handshake and mid-encrypted-frame
+            # exactly as they would on a real wire)
             for s in self.specs:
                 self.nodes.append(Node(s, self.specs, secret, sdc,
                                        parity=parity,
                                        block_size=block_size,
-                                       backend=backend))
+                                       backend=backend, tls=tls))
             # phase 2: interpose one FaultyProxy per node and advertise
             # the PROXY endpoint, so every cross-node client (storage +
             # locks) dials through the injectable link
+            scheme = "https" if tls is not None else "http"
             for spec in self.specs:
                 port = int(spec.endpoint.rsplit(":", 1)[1])
                 proxy = FaultyProxy("127.0.0.1", port).start()
-                spec.endpoint = proxy.endpoint
+                spec.endpoint = f"{scheme}://127.0.0.1:{proxy.port}"
                 self.proxies.append(proxy)
             # phase 3: assemble each node's layer over the proxied
             # topology
@@ -86,7 +94,7 @@ class SoakCluster:
             # S3 frontend on node0 with the heal planes attached (the
             # wiring run_node gives the leader)
             self.s3 = S3Server(layer0, access_key=access_key,
-                               secret_key=secret_key)
+                               secret_key=secret_key, tls=tls)
             self.mrf = MRFQueue(layer0, maxsize=mrf_maxsize)
             for s in layer0.sets:
                 s.mrf = self.mrf

@@ -73,6 +73,12 @@ class Scenario:
     # window) and read back byte-correct, while the mix keeps storming
     # — one big mesh-sharded transfer must not wreck the small-op SLOs
     huge_put_bytes: int = 0
+    # full-TLS cluster (ISSUE 13): an ephemeral PKI is minted into the
+    # scenario dir and BOTH planes come up encrypted — S3 front +
+    # internode mTLS — with the whole chaos timeline landing on
+    # encrypted links (mid-handshake resets, mid-encrypted-frame
+    # faults).  Same mix, same SLO budget: TLS must not cost SLO.
+    tls: bool = False
 
 
 # chaos knobs every scenario runs under: snappy breakers so fault
@@ -149,6 +155,19 @@ def default_matrix(duration_s: float = 15.0) -> list[Scenario]:
         budget=_slo.Budget(max_error_rate=0.10),
         workers=2, backend="mesh",
         huge_put_bytes=_huge_bytes_default()))
+    # tls_storm (ISSUE 13 acceptance): the GET-heavy mix under the
+    # FULL chaos timeline with S3 + internode both encrypted — the
+    # same SLO budget as the plaintext matrix, so any TLS-induced
+    # regression fails a row; skipped only where the image has no
+    # openssl binary to mint the ephemeral PKI with
+    from ..secure import pki as _pki
+    if _pki.available():
+        out.append(Scenario(
+            name="tls_storm", mix=MIXES["get_heavy_small"],
+            timeline=_chaos_timeline(duration_s),
+            duration_s=duration_s,
+            budget=_slo.Budget(max_error_rate=0.10),
+            workers=2, tls=True))
     return out
 
 
@@ -190,11 +209,16 @@ def run_scenario(scenario: Scenario, base_dir: str,
     os.environ.update(_SOAK_ENV)
     threads_before = _slo.settled_thread_count(deadline_s=2.0)
     thread_ids = {id(t) for t in threading.enumerate()}
+    tls_manager = None
+    if scenario.tls:
+        from ..secure import pki as _pki
+        tls_manager = _pki.mint_cluster_pki(
+            os.path.join(base_dir, "pki")).cert_manager()
     try:
         cluster = _chaos.SoakCluster(
             base_dir, nodes=scenario.nodes,
             drives_per_node=scenario.drives_per_node,
-            backend=scenario.backend)
+            backend=scenario.backend, tls=tls_manager)
         status = SoakStatus(scenario.name)
         cluster.s3.soak = status
         conv: dict | None = None
@@ -255,6 +279,18 @@ def run_scenario(scenario: Scenario, base_dir: str,
                 "metric": "huge_put_byte_correct",
                 "value": 1 if huge.get("ok") else 0, "unit": "bool",
                 "passed": bool(huge.get("ok")), "detail": huge})
+        if scenario.tls:
+            # the encrypted planes must actually have carried the
+            # storm: live handshakes on the scrape, or the scenario
+            # silently ran plaintext and proved nothing
+            shakes = _slo.metric_total(scrape_text,
+                                       "mt_tls_handshake_total")
+            rows.append({
+                "scenario": scenario.name, "metric": "tls_engaged",
+                "value": shakes, "unit": "handshakes",
+                "passed": shakes > 0,
+                "detail": {"failed": _slo.metric_total(
+                    scrape_text, "mt_tls_handshake_failed_total")}})
         # context rows: what actually ran (not assertions; always pass)
         rows.append({"scenario": scenario.name, "metric": "ops_total",
                      "value": recorder.ops(), "unit": "ops",

@@ -80,10 +80,15 @@ _SAMPLE_RE = re.compile(r"^(\w+)(?:\{[^}]*\})? ([0-9eE.+-]+)$", re.M)
 
 def scrape(endpoint: str, timeout: float = 10.0) -> str:
     """One live /minio-tpu/metrics scrape (unauthenticated, like
-    Prometheus)."""
+    Prometheus; CA-pinned over an https endpoint)."""
     u = urllib.parse.urlsplit(endpoint)
-    conn = http.client.HTTPConnection(u.hostname, u.port,
-                                      timeout=timeout)
+    if u.scheme == "https":
+        from ..secure import transport as _tls_transport
+        conn = _tls_transport.https_connection(u.hostname, u.port,
+                                               timeout, plane="s3")
+    else:
+        conn = http.client.HTTPConnection(u.hostname, u.port,
+                                          timeout=timeout)
     try:
         conn.request("GET", "/minio-tpu/metrics")
         resp = conn.getresponse()

@@ -178,6 +178,30 @@ register_subsys("storage_class", {  # mt-lint: ok(kvconfig-drift) read per PUT (
     "standard": "",                 # e.g. EC:4
     "rrs": "EC:2",
 })
+register_subsys("tls", {  # mt-lint: ok(kvconfig-drift) construction-time (secure/certs.py from_config at listener boot) — the PORT cannot switch schemes under a bound listener; the cert CONTENT itself hot-reloads via the manager's mtime watcher, no restart needed
+    # TLS everywhere (minio_tpu/secure/certs.py): enable=on wraps BOTH
+    # listeners (S3 front + internode RPC) and both client stacks with
+    # material from ``certs_dir`` (layout in docs/security.md:
+    # public.crt/private.key, internode/, CAs/, sni/<host>/).  Cert
+    # ROTATION is live — the manager re-stats the files and re-keys
+    # the next connection; only flipping enable needs a restart.
+    "enable": "off",
+    "certs_dir": "",
+})
+register_subsys("policy_opa", {
+    # external policy webhook (minio_tpu/secure/opa.py, the
+    # cmd/config/policy/opa analog): when ``url`` is set,
+    # IAMSys.is_allowed delegates every non-admin authorization
+    # decision to POST {"input": {...}} at that URL and local policy
+    # documents stop being evaluated.  FAIL-CLOSED: timeout/transport
+    # error/non-2xx all deny; ``timeout`` bounds each attempt and
+    # ``retry_attempts`` rides the shared jittered backoff.
+    # Live-reloadable (S3Server.reload_policy_config on SetConfigKV).
+    "url": "",
+    "auth_token": "",
+    "timeout": "2s",
+    "retry_attempts": "2",
+})
 register_subsys("heal", {
     "bitrotscan": "off",
     "max_sleep": "1s",
@@ -279,10 +303,20 @@ register_subsys("notify_elasticsearch", {"enable": "off", "url": "",
 
 
 class Config:
-    """Layered lookup: env > dynamic set > defaults."""
+    """Layered lookup: env > dynamic set > defaults.
 
-    def __init__(self, layer=None):
+    ``secret`` (the admin secret key) arms encrypted persistence
+    (cmd/config-encrypted.go role): the dynamic layer lands on disk as
+    a DARE blob under a credentials-derived key instead of plaintext
+    JSON.  A plaintext blob found at load is migrated (re-persisted
+    sealed), and one sealed under retired credentials
+    (``MT_ADMIN_SECRET_OLD``) is re-sealed under the current secret —
+    rotation re-encrypts in place.
+    """
+
+    def __init__(self, layer=None, secret: str | None = None):
         self._layer = layer
+        self._secret = secret or ""
         self._dynamic: dict[str, dict[str, str]] = {}
         self._mu = mtlock("config.dynamic")
         self._persist_mu = mtlock("config.persist")
@@ -332,25 +366,40 @@ class Config:
     def _persist(self) -> None:
         if self._layer is None:
             return
+        from ..secure import configcrypt
         from ..storage.xl_storage import SYS_DIR
         with self._persist_mu:  # snapshot+write atomic wrt other persists
             with self._mu:
                 blob = json.dumps(self._dynamic).encode()
+            if self._secret:
+                blob = configcrypt.encrypt_data(self._secret, blob)
             self._layer._fanout(
                 lambda d: d.write_all(SYS_DIR, "config/config.json", blob))
 
     def _load(self) -> None:
+        from ..secure import configcrypt
         from ..storage.xl_storage import SYS_DIR
         res, _ = self._layer._fanout(
             lambda d: d.read_all(SYS_DIR, "config/config.json"))
+        olds = configcrypt.old_secrets_from_env()
         for r in res:
-            if r is not None:
-                try:
-                    with self._mu:
-                        self._dynamic = json.loads(r)
-                    return
-                except json.JSONDecodeError:
-                    continue
+            if r is None:
+                continue
+            try:
+                blob, reseal = configcrypt.maybe_decrypt(
+                    self._secret, r, olds)
+            except configcrypt.DecryptError:
+                continue        # replica sealed under unknown creds
+            try:
+                with self._mu:
+                    self._dynamic = json.loads(blob)
+            except json.JSONDecodeError:
+                continue
+            if reseal and self._secret:
+                # plaintext migration / credentials rotation: what we
+                # just read goes back sealed under the CURRENT secret
+                self._persist()
+            return
 
 
 def parse_storage_class(value: str, drive_count: int) -> int | None:

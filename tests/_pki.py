@@ -1,0 +1,41 @@
+"""Shared test PKI: an ephemeral CA + S3/internode leaf certs minted
+by shelling to ``/usr/bin/openssl`` (via minio_tpu/secure/pki.py — the
+same minting the full-TLS soak scenario uses), cached once per test
+session so every TLS tier (SSE e2e, the TLS tier, chaos drills, the
+soak smoke) shares one trust root.
+
+Import and call :func:`require_openssl` (or just :func:`cluster_pki`)
+at the top of any TLS-dependent test or fixture — on an image without
+the openssl binary the tier skips with a named reason instead of
+failing to mint.
+"""
+
+import pytest
+
+from minio_tpu.secure import pki as _pki
+
+_CACHE: dict = {}
+
+
+def require_openssl() -> None:
+    if not _pki.available():
+        pytest.skip(f"{_pki.OPENSSL} not present on this image: "
+                    "cannot mint the ephemeral test PKI")
+
+
+def cluster_pki(tmp_path_factory) -> _pki.PKI:
+    """Session-cached CA + s3/internode leaves (one openssl run for
+    the whole session; SANs cover localhost + 127.0.0.1 so hostname
+    verification stays strict against loopback endpoints)."""
+    require_openssl()
+    p = _CACHE.get("pki")
+    if p is None:
+        p = _CACHE["pki"] = _pki.mint_cluster_pki(
+            str(tmp_path_factory.mktemp("pki")))
+    return p
+
+
+def cert_manager(tmp_path_factory, **kw):
+    """A fresh CertManager over the shared PKI (fresh, because tests
+    mutate manager state — reload throttles, injected clocks)."""
+    return cluster_pki(tmp_path_factory).cert_manager(**kw)

@@ -198,7 +198,7 @@ def test_bucket_quota_admin(client):
 
 @pytest.mark.skipif(
     __import__("minio_tpu.crypto.dare", fromlist=["AESGCM"]).AESGCM is None,
-    reason="cryptography (AES-GCM backend) not installed")
+    reason="no AES-GCM backend (neither the cryptography wheel nor a loadable libcrypto)")
 def test_kms_key_status(client):
     doc = json.loads(_admin(client, "GET", "kms-key-status").body)
     assert doc["encryption_ok"] and doc["decryption_ok"]
@@ -226,7 +226,7 @@ def test_service_action_validation(client):
 
 @pytest.mark.skipif(
     __import__("minio_tpu.crypto.dare", fromlist=["AESGCM"]).AESGCM is None,
-    reason="cryptography (AES-GCM backend) not installed")
+    reason="no AES-GCM backend (neither the cryptography wheel nor a loadable libcrypto)")
 def test_admin_client_sdk(server, tmp_path):
     """pkg/madmin analog: the typed AdminClient drives the same routes."""
     from minio_tpu.admin.client import AdminClient, AdminError

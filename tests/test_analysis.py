@@ -268,6 +268,43 @@ def test_kvconfig_drift_canary(tmp_path):
     assert not clean, clean
 
 
+def test_tls_discipline_canary(tmp_path):
+    bad = _lint(tmp_path, {"m.py": """
+        import ssl
+
+        def insecure(url, conn):
+            ctx = ssl._create_unverified_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            return ctx
+        """})
+    msgs = [f.message for f in bad if f.rule == "tls-discipline"]
+    assert any("_create_unverified_context" in m for m in msgs), bad
+    assert any("check_hostname" in m for m in msgs), bad
+    assert any("CERT_NONE" in m for m in msgs), bad
+    assert len(msgs) == 3, msgs
+    # the pinned-context idiom (what secure/certs.py builds) is clean,
+    # and check_hostname = True never trips the assignment check
+    clean = _lint(tmp_path, {"m.py": """
+        import ssl
+
+        def pinned(ca):
+            ctx = ssl.create_default_context(cafile=ca)
+            ctx.check_hostname = True
+            ctx.verify_mode = ssl.CERT_REQUIRED
+            return ctx
+        """})
+    assert not clean, clean
+    # the suppression grammar is honored (reason mandatory)
+    supp = _lint(tmp_path, {"m.py": """
+        import ssl
+
+        def probe():
+            return ssl.CERT_NONE  # mt-lint: ok(tls-discipline) scanner fixture needs the constant
+        """})
+    assert not supp, supp
+
+
 def test_named_skip_canary(tmp_path):
     """Skips without a named reason in tests/ are findings; a
     positional message, a reason= kwarg, or a runtime expression

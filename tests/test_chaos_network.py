@@ -829,3 +829,147 @@ def test_locktrace_drill_peer_kill_graph_stays_acyclic(tmp_path,
         # the recorded graph into later suites' scrape idle contracts
         locktrace.reset()
         assert stop_err is None, stop_err
+
+
+# -- TLS chaos drills (ISSUE 13: faults mid-handshake + mid-encrypted-frame)
+
+
+def _tls_manager(tmp_path_factory):
+    from tests._pki import cluster_pki
+    return cluster_pki(tmp_path_factory).cert_manager()
+
+
+def test_tls_reset_mid_handshake_is_transport_failure(
+        tmp_path_factory):
+    """The proxy RSTs the connection in the middle of the TLS
+    handshake: the client surfaces a typed transport RPCError (never a
+    hang, never a protocol-layer crash) and the breaker is fed."""
+    from minio_tpu.secure import transport as secure_transport
+    mgr = _tls_manager(tmp_path_factory)
+    srv = RPCServer("tls-chaos", tls=mgr)
+    srv.register("t", {"echo": lambda x: x})
+    srv.start()
+    secure_transport.configure(mgr)
+    # cut after 64 relayed bytes — inside the ClientHello/ServerHello
+    # exchange, long before any HTTP bytes exist
+    proxy = FaultyProxy("127.0.0.1", srv.port,
+                        default=Fault.reset(after_bytes=64)).start()
+    try:
+        c = _no_retry_client(proxy.endpoint.replace("http://",
+                                                    "https://"),
+                             fail_max=1)
+        c.secret = "tls-chaos"
+        with pytest.raises(RPCError):
+            c.call("t", "echo", x=1)
+        assert c.breaker.state == CircuitBreaker.OPEN
+    finally:
+        proxy.stop()
+        srv.stop()
+        secure_transport.configure(None)
+
+
+def test_tls_blackhole_mid_handshake_hits_deadline(tmp_path_factory):
+    """A blackholed peer swallows the ClientHello and never answers:
+    the client's deadline converts the stalled handshake into a typed
+    transport RPCError within the timeout, not a parked thread."""
+    from minio_tpu.secure import transport as secure_transport
+    mgr = _tls_manager(tmp_path_factory)
+    srv = RPCServer("tls-chaos-bh", tls=mgr)
+    srv.start()
+    secure_transport.configure(mgr)
+    proxy = FaultyProxy("127.0.0.1", srv.port,
+                        default=Fault.blackhole()).start()
+    try:
+        c = _no_retry_client(proxy.endpoint.replace("http://",
+                                                    "https://"),
+                             fail_max=1, timeout=1.0)
+        c.secret = "tls-chaos-bh"
+        t0 = time.monotonic()
+        with pytest.raises(RPCError):
+            c.call("t", "echo", x=1)
+        assert time.monotonic() - t0 < 10.0
+        assert c.breaker._failures > 0
+    finally:
+        proxy.stop()
+        srv.stop()
+        secure_transport.configure(None)
+
+
+def test_tls_stream_reset_mid_encrypted_frame_quorum_commits(
+        tmp_path, tmp_path_factory, monkeypatch):
+    """The mid-frame reset drill ON THE ENCRYPTED CHANNEL: 4 local +
+    2 remote TLS drives, the proxy RSTs every new connection carrying
+    streamed frames — the half-streamed appends latch as transport
+    failures in the writer plane, the PUT commits on the 4/6 local
+    quorum, and NO partial shard is visible on the faulted remotes.
+    Byte-for-byte the plaintext drill's contract, over mTLS."""
+    import hashlib as _hashlib
+    import io as _io
+
+    from minio_tpu.objectlayer import erasure_object as eo
+    from minio_tpu.objectlayer.erasure_object import ErasureObjects
+    from minio_tpu.parallel.rpc import STREAM
+    from minio_tpu.secure import transport as secure_transport
+    from minio_tpu.storage.remote import (RemoteStorage,
+                                          register_storage_service)
+    from minio_tpu.storage.writers import close_write_planes
+    from minio_tpu.storage.xl_storage import XLStorage
+    monkeypatch.setattr(STREAM, "enable", True)
+    monkeypatch.setattr(STREAM, "chunk_bytes", 1024)
+    monkeypatch.setattr(STREAM, "_loaded", True)
+    monkeypatch.setattr(eo, "STREAM_BATCH_BYTES", 2 * 4096)
+    mgr = _tls_manager(tmp_path_factory)
+    secure_transport.configure(mgr)
+    rpc = RPCServer("tls-stream-chaos", tls=mgr)
+    remote_drives = {}
+    for i in range(2):
+        d = tmp_path / f"tr{i}"
+        d.mkdir()
+        remote_drives[f"r{i}"] = XLStorage(str(d))
+    register_storage_service(rpc, remote_drives)
+    rpc.start()
+    proxy = FaultyProxy("127.0.0.1", rpc.port).start()
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"tl{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    remotes = [_no_retry_client(
+        proxy.endpoint.replace("http://", "https://"), timeout=2.0)
+        for _ in range(2)]
+    for i, c in enumerate(remotes):
+        c.secret = "tls-stream-chaos"
+        disks.append(RemoteStorage(c, f"r{i}"))
+    lay = ErasureObjects(disks, parity=2, block_size=4096,
+                         backend="numpy", inline_threshold=512)
+    lay._pipe_depth = 2
+    lay.make_bucket("tlsbkt")
+    try:
+        body = (b"tls-frame-chaos!" * 4096)[: 10 * 4096]
+        # healthy encrypted pass: streamed appends reach the remotes
+        oi = lay.put_object_stream("tlsbkt", "ok", _io.BytesIO(body))
+        assert oi.etag == _hashlib.md5(body).hexdigest()
+        assert remote_drives["r0"].read_all(
+            "tlsbkt", "ok/xl.meta") is not None
+        # now every NEW connection dies mid-stream (RST inside the
+        # encrypted frame sequence) and the pools are dropped
+        proxy.set_default(Fault.reset(after_bytes=0))
+        _drop_pools(remotes)
+        from minio_tpu.admin.metrics import GLOBAL
+        errs0 = sum(v for k, v in GLOBAL.snapshot().items()
+                    if k[0] == "mt_node_rpc_errors_total")
+        oi = lay.put_object_stream("tlsbkt", "cut", _io.BytesIO(body))
+        assert oi.etag == _hashlib.md5(body).hexdigest()
+        assert lay.get_object("tlsbkt", "cut")[1] == body
+        errs1 = sum(v for k, v in GLOBAL.snapshot().items()
+                    if k[0] == "mt_node_rpc_errors_total")
+        assert errs1 > errs0
+        for i in range(2):
+            assert not os.path.exists(
+                os.path.join(str(tmp_path / f"tr{i}"), "tlsbkt",
+                             "cut", "xl.meta"))
+    finally:
+        close_write_planes(lay)
+        proxy.stop()
+        rpc.stop()
+        secure_transport.configure(None)

@@ -163,12 +163,32 @@ def test_incompressible_not_compressed(client, server):
     assert client.get_object("comp", "rand.jpg").body == data
 
 
+@pytest.fixture
+def tls_server(server, tmp_path_factory):
+    """SSE-C requires TLS (the AWS gate): a second, ENCRYPTED front
+    over the same compression-enabled layer — the rest of the tier
+    stays plaintext and openssl-independent.  The shared layer means
+    the persisted compression config is already on."""
+    from minio_tpu.s3.server import S3Server
+    from tests._pki import cluster_pki
+    p = cluster_pki(tmp_path_factory)
+    srv = S3Server(server.layer, access_key="testkey",
+                   secret_key="testsecret", tls=p.cert_manager())
+    srv.start()
+    yield srv, p
+    srv.stop()
+
+
 @pytest.mark.skipif(
     __import__("minio_tpu.crypto.dare", fromlist=["AESGCM"]).AESGCM is None,
-    reason="cryptography (AES-GCM backend) not installed")
-def test_compress_plus_sse(client, server):
+    reason="no AES-GCM backend (neither the cryptography wheel nor a "
+    "loadable libcrypto)")
+def test_compress_plus_sse(client, server, tls_server):
     import base64
     import hashlib
+    tls_srv, p = tls_server
+    client = S3Client(tls_srv.endpoint, "testkey", "testsecret",
+                      ca_file=p.ca_cert)
     key = hashlib.sha256(b"combokey").digest()
     h = {"x-amz-server-side-encryption-customer-algorithm": "AES256",
          "x-amz-server-side-encryption-customer-key":

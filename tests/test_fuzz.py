@@ -115,7 +115,7 @@ def test_fuzz_xl_meta_load():
 
 @pytest.mark.skipif(
     __import__("minio_tpu.crypto.dare", fromlist=["AESGCM"]).AESGCM is None,
-    reason="cryptography (AES-GCM backend) not installed")
+    reason="no AES-GCM backend (neither the cryptography wheel nor a loadable libcrypto)")
 def test_fuzz_dare_decrypt():
     from minio_tpu.crypto import dare
     rng = random.Random(5)

@@ -439,3 +439,29 @@ def test_workload_generator_under_clean_cluster_long(tmp_path):
     assert not failed, failed
     err = next(r for r in rows if r["metric"] == "error_rate")
     assert err["value"] == 0
+
+
+def test_tls_smoke_scenario_meets_slo(tmp_path):
+    """The full-TLS miniature (ISSUE 13 acceptance): the same 3-node
+    smoke contract with S3 + internode BOTH encrypted — drive death
+    mid-traffic, heal convergence, every SLO row green, and the
+    tls_engaged row proves handshakes actually carried the storm
+    (chaos faults landed on encrypted links, not a silent plaintext
+    fallback)."""
+    from tests._pki import require_openssl
+    require_openssl()
+    import dataclasses
+    sc = dataclasses.replace(soak_report.smoke_scenario(duration_s=3.0),
+                             name="smoke_tls", tls=True)
+    rows = soak_report.run_scenario(sc, str(tmp_path / "tlssoak"))
+    by_metric = {r["metric"]: r for r in rows}
+    assert by_metric["ops_total"]["value"] > 10
+    failed = [r for r in rows if not r["passed"]]
+    assert not failed, failed
+    assert by_metric["heal_converged"]["value"] == 1
+    # the TLS plane demonstrably carried the traffic
+    assert by_metric["tls_engaged"]["passed"]
+    assert by_metric["tls_engaged"]["value"] > 0
+    # a TLS cluster must not linger in the process-global registry
+    from minio_tpu.secure import transport as secure_transport
+    secure_transport.configure(None)

@@ -13,11 +13,13 @@ import pytest
 
 from minio_tpu.crypto import dare, kms, sse
 
-# the AES-GCM backend is a gated dependency: without the
-# `cryptography` wheel every SSE path raises DAREError at use
+# the AES-GCM engine rides a backend ladder (the `cryptography` wheel,
+# else the ctypes libcrypto binding); only with NEITHER present does
+# SSE raise at use and this tier skip
 pytestmark = pytest.mark.skipif(
     dare.AESGCM is None,
-    reason="cryptography (AES-GCM backend) not installed")
+    reason="no AES-GCM backend (neither the cryptography wheel nor a "
+    "loadable libcrypto)")
 from minio_tpu.objectlayer.erasure_object import ErasureObjects
 from minio_tpu.s3.client import S3Client, S3ClientError
 from minio_tpu.s3.server import S3Server
@@ -160,6 +162,11 @@ def test_object_encryption_seal_unseal_ssec():
 
 @pytest.fixture(scope="module")
 def server(tmp_path_factory):
+    # SSE-C requires TLS (the AWS InsecureSSECustomerRequest gate in
+    # s3/server.py): the whole e2e tier runs over an encrypted front,
+    # minted from the session-shared test PKI
+    from tests._pki import cluster_pki
+    p = cluster_pki(tmp_path_factory)
     tmp = tmp_path_factory.mktemp("ssedrives")
     disks = []
     for i in range(4):
@@ -168,7 +175,8 @@ def server(tmp_path_factory):
         disks.append(XLStorage(str(d)))
     layer = ErasureObjects(disks, parity=2, block_size=256 * 1024,
                            backend="numpy")
-    srv = S3Server(layer, access_key="testkey", secret_key="testsecret")
+    srv = S3Server(layer, access_key="testkey", secret_key="testsecret",
+                   tls=p.cert_manager())
     srv.start()
     yield srv
     srv.stop()
