@@ -852,6 +852,139 @@ def _bench_codec_batching() -> dict | None:
         pmesh.set_active_mesh(prev_mesh)
 
 
+def _bench_hot_get() -> dict | None:
+    """Hot-read plane sweep (ISSUE 14): aggregate GET GiB/s of N
+    concurrent readers over a zipf-distributed key set through the
+    REAL erasure layer, single-flight+cache plane ON vs the
+    per-request path, bodies digest-checked bit-identical.  The
+    acceptance bar: >=3x aggregate at 64 concurrent readers of one
+    hot object."""
+    import hashlib as _hl
+    import random as _random
+    import shutil
+    import tempfile
+    import threading as _th
+
+    try:
+        from minio_tpu.objectlayer import hotread
+        from minio_tpu.objectlayer.erasure_object import ErasureObjects
+        from minio_tpu.storage.xl_storage import XLStorage
+    except Exception as e:  # noqa: BLE001 — optional leg
+        import sys as _sys
+        print(f"hot-get leg failed to import: {e!r}", file=_sys.stderr)
+        return None
+    cfg = hotread.CONFIG
+    saved = (cfg.enable, cfg.max_bytes, cfg.heat_threshold,
+             cfg.singleflight_queue, cfg.window_bytes, cfg._loaded)
+    root = "/dev/shm" if os.path.isdir("/dev/shm") and \
+        os.access("/dev/shm", os.W_OK) else None
+    tmp = tempfile.mkdtemp(prefix="hotget-", dir=root)
+    try:
+        disks = []
+        for i in range(6):
+            d = os.path.join(tmp, f"d{i}")
+            os.makedirs(d)
+            disks.append(XLStorage(d))
+        layer = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                               backend="numpy")
+        layer.make_bucket("hot")
+        key_space, zipf = 8, 1.2
+        obj_bytes = 1 << 20
+        rng = _random.Random(7)
+        digests = {}
+        for i in range(key_space):
+            body = rng.randbytes(obj_bytes)
+            layer.put_object("hot", f"o{i}", body)
+            digests[f"o{i}"] = _hl.md5(body).hexdigest()
+        weights = [1.0 / (i + 1) ** zipf for i in range(key_space)]
+        cfg.max_bytes, cfg.heat_threshold = 256 << 20, 1
+        cfg.singleflight_queue, cfg.window_bytes = 64, 8 << 20
+        cfg._loaded = True
+        layer.hotread.heat_fn = lambda: 1000
+
+        def leg(enabled: bool, streams: int) -> float:
+            cfg.enable = enabled
+            layer.hotread.clear()
+            reps = max(4, 96 // streams)    # ~constant total work
+            layer.get_object("hot", "o0")   # warm drives/codec
+            best = 0.0
+            for _ in range(2):              # best-of-2: thread jitter
+                barrier = _th.Barrier(streams + 1)
+                bad: list = []
+
+                def run(wid: int):
+                    r = _random.Random(100 + wid)
+                    barrier.wait()
+                    for _ in range(reps):
+                        k = f"o{r.choices(range(key_space), weights=weights)[0]}"
+                        _, data = layer.get_object("hot", k)
+                        if _hl.md5(data).hexdigest() != digests[k]:
+                            bad.append(k)   # bit-identity is the bar
+                            return
+
+                ths = [_th.Thread(target=run, args=(i,),
+                                  name=f"mt-hotget-bench{i}")
+                       for i in range(streams)]
+                for t in ths:
+                    t.start()
+                barrier.wait()
+                t0 = time.perf_counter()
+                for t in ths:
+                    t.join()
+                dt = max(time.perf_counter() - t0, 1e-9)
+                if bad:
+                    raise AssertionError(
+                        f"hot-get body mismatch on {bad[0]}")
+                best = max(best,
+                           streams * reps * obj_bytes / dt / 2**30)
+            return best
+
+        out = {"geometry": "4+2 x 64KiB blocks",
+               "object_bytes": obj_bytes, "key_space": key_space,
+               "zipf": zipf, "drives_root": root or "disk",
+               "streams": {}}
+        for streams in (1, 16, 64):
+            serial = leg(False, streams)
+            hot = leg(True, streams)
+            st = layer.hotread.stats()
+            out["streams"][str(streams)] = {
+                "per_request_GiBps": round(serial, 3),
+                "hot_plane_GiBps": round(hot, 3),
+                "speedup": round(hot / serial, 2) if serial > 0
+                else None,
+                "cache_hits": st["cache"]["hits"],
+                "coalesced": st["singleflight"]["coalesced"],
+            }
+        out["speedup_64"] = out["streams"]["64"]["speedup"]
+        return out
+    except AssertionError:
+        # a body digest mismatch is a CORRECTNESS regression, not an
+        # unavailable leg — fail the bench loudly
+        raise
+    except Exception as e:  # noqa: BLE001 — optional leg
+        import sys as _sys
+        print(f"hot-get leg failed: {e!r}", file=_sys.stderr)
+        return None
+    finally:
+        (cfg.enable, cfg.max_bytes, cfg.heat_threshold,
+         cfg.singleflight_queue, cfg.window_bytes, cfg._loaded) = saved
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def hot_get_main() -> None:
+    """``bench.py hot_get`` — run the hot-read plane sweep standalone
+    and print ONE BENCH_*-shaped JSON line."""
+    stats = _bench_hot_get()
+    if stats is None:
+        raise SystemExit("hot_get leg unavailable")
+    print(json.dumps({
+        "metric": "hot_get_speedup_64_readers",
+        "value": stats["speedup_64"],
+        "unit": "x vs per-request GET path",
+        "detail": stats,
+    }))
+
+
 def codec_batching_main() -> None:
     """``bench.py codec_batching`` — run the cross-request batching
     sweep standalone and print ONE BENCH_*-shaped JSON line."""
@@ -1335,6 +1468,7 @@ def host_main() -> None:
     e2e = _bench_end_to_end_put()
     cfg12 = _bench_baseline_configs()
     codec_batching = _bench_codec_batching()
+    hot_get = _bench_hot_get()
     c1 = (cfg12 or {}).get("config1_4+2_put_64MiB_GiBps")
     print(json.dumps({
         "metric": "baseline_config1_4+2_put_64MiB_GiBps",
@@ -1348,6 +1482,7 @@ def host_main() -> None:
             ("e2e_put_256x4MiB_fsync" if _FSYNC_ON
              else "e2e_put_256x4MiB_nofsync"): e2e,
             "codec_batching": codec_batching,
+            "hot_get": hot_get,
             "methodology": "host legs only (bench.py host); device "
                            "kernel legs need a TPU",
         },
@@ -1399,6 +1534,8 @@ if __name__ == "__main__":
         soak_main(_sys.argv[2:])
     elif len(_sys.argv) > 1 and _sys.argv[1] == "codec_batching":
         codec_batching_main()
+    elif len(_sys.argv) > 1 and _sys.argv[1] == "hot_get":
+        hot_get_main()
     elif len(_sys.argv) > 1 and _sys.argv[1] == "host":
         host_main()
     else:

@@ -950,6 +950,11 @@ def _config(h, srv, route, q1, payload, send_json) -> bool:
             # retune the cross-request codec batcher (combining
             # window, batch bound, queue depth) on the live data plane
             srv.reload_codec_config()
+        if parts[1] == "cache":
+            # retune the hot-read plane (single-flight coalescing +
+            # hot-object cache) on the live GET path; disabling
+            # releases every cached byte back to the governor
+            srv.reload_cache_config()
         if parts[1] in ("heal", "scanner"):
             # retune heal/scan IO self-pacing on the attached
             # background planes

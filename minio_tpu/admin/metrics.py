@@ -186,6 +186,10 @@ def render(layer=None, healer=None, config=None, api_stats=None,
             lines += _put_pipeline_gauges(layer)
         except Exception:  # noqa: BLE001 — a scrape must never fail
             pass
+        try:
+            lines += _hot_read_gauges(layer)
+        except Exception:  # noqa: BLE001 — a scrape must never fail
+            pass
     try:
         lines += _codec_batch_gauges()
     except Exception:  # noqa: BLE001 — a scrape must never fail
@@ -602,6 +606,32 @@ def _put_pipeline_gauges(layer) -> list[str]:
     return lines
 
 
+def _hot_read_gauges(layer) -> list[str]:
+    """Hot-read plane families (objectlayer/hotread.py): resident
+    cache bytes/entries summed over the layer's erasure sets at scrape
+    time.  The event counters (mt_cache_{hits,misses,...}_total,
+    mt_singleflight_*) are plain process counters ticked on the serve
+    path.  Idle contract: a layer whose planes never served a read
+    emits no family at all."""
+    from ..objectlayer.metacache import leaf_layers_of
+    entries = nbytes = 0
+    used = False
+    for leaf in leaf_layers_of(layer):
+        plane = getattr(leaf, "hotread", None)
+        if plane is None or not plane.used:
+            continue
+        used = True
+        st = plane.cache.stats()
+        entries += st["entries"]
+        nbytes += st["bytes"]
+    if not used:
+        return []
+    return ["# TYPE mt_cache_entries gauge",
+            f"mt_cache_entries {entries}",
+            "# TYPE mt_cache_bytes gauge",
+            f"mt_cache_bytes {nbytes}"]
+
+
 def _codec_batch_gauges() -> list[str]:
     """Live queued-block depth of the cross-request codec batcher
     (parallel/batcher.py), per op.  Idle contract: a process whose
@@ -655,7 +685,8 @@ def _memgov_gauges() -> list[str]:
              f"mt_mem_peak_bytes {st['peak_bytes']}",
              "# TYPE mt_mem_inuse_bytes gauge"]
     inuse = st["inuse"]
-    for kind in sorted(set(inuse) | {"select", "listing", "multipart"}):
+    for kind in sorted(set(inuse) | {"select", "listing", "multipart",
+                                     "cache", "pipeline"}):
         lbl = _fmt_labels((("kind", kind),))
         lines.append(f"mt_mem_inuse_bytes{lbl} {inuse.get(kind, 0)}")
     return lines

@@ -203,7 +203,8 @@ class CacheObjects:
 
     def __init__(self, inner, cache_dirs: list[str],
                  writeback: bool = False, max_object_size: int = 1 << 30,
-                 exclude: tuple[str, ...] = (), max_bytes_per_drive: int = 0):
+                 exclude: tuple[str, ...] = (), max_bytes_per_drive: int = 0,
+                 gc_interval_s: float = 0.0):
         self.inner = inner
         self.drives = [CacheDrive(d, max_bytes=max_bytes_per_drive)
                        for d in cache_dirs]
@@ -213,9 +214,25 @@ class CacheObjects:
         self.max_object_size = max_object_size
         self.exclude = exclude
         self.stats = CacheStats()
-        self._wb_q: "queue.Queue[tuple[str, str]]" = queue.Queue()
+        self._wb_q: "queue.Queue[tuple[str, str] | None]" = queue.Queue()
         self._wb_thread: Optional[threading.Thread] = None
-        self._closed = False
+        self._gc_thread: Optional[threading.Thread] = None
+        # event, not a bare bool: close() must WAKE a parked GC sweep
+        # immediately, and both background threads key off it
+        self._closed_ev = threading.Event()
+        # periodic background GC (the reference's diskCache purge
+        # loop, cmd/disk-cache-backend.go): 0 keeps the historical
+        # inline-after-fill GC only
+        self.gc_interval_s = gc_interval_s
+        if gc_interval_s > 0:
+            self._gc_thread = threading.Thread(
+                target=self._gc_loop, daemon=True,
+                name="mt-diskcache-gc")
+            self._gc_thread.start()
+
+    @property
+    def _closed(self) -> bool:
+        return self._closed_ev.is_set()
 
     # -- plumbing --------------------------------------------------------
 
@@ -336,9 +353,12 @@ class CacheObjects:
     def _wb_loop(self) -> None:
         while not self._closed:
             try:
-                bucket, key = self._wb_q.get(timeout=0.5)
+                item = self._wb_q.get(timeout=0.5)
             except queue.Empty:
                 continue
+            if item is None:        # close() sentinel: prompt exit
+                return
+            bucket, key = item
             drive = self._drive(bucket, key)
             cached = drive.get(bucket, key)
             if cached is None:
@@ -350,8 +370,18 @@ class CacheObjects:
                           dirty=False)
                 self.stats.writeback_pending -= 1
             except Exception:   # noqa: BLE001 — retry later
-                time.sleep(0.2)
+                self._closed_ev.wait(0.2)
                 self._wb_q.put((bucket, key))
+
+    def _gc_loop(self) -> None:
+        """Periodic watermark GC (mt-diskcache-gc): sweeps every cache
+        drive on the interval; close() wakes and joins it."""
+        while not self._closed_ev.wait(self.gc_interval_s):
+            for drive in self.drives:
+                try:
+                    drive.gc(self.stats)
+                except Exception:  # noqa: BLE001 — one drive's sweep
+                    pass           # failing must not kill the loop
 
     def flush_writeback(self, timeout: float = 10.0) -> None:
         deadline = time.monotonic() + timeout
@@ -365,5 +395,15 @@ class CacheObjects:
         self._drive(bucket, object_name).delete(bucket, object_name)
         return self.inner.delete_object(bucket, object_name, opts)
 
-    def close(self) -> None:
-        self._closed = True
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop and JOIN the background threads (the PR-10 thread
+        discipline: every mt-diskcache-* thread dies with its owner —
+        S3Server.stop walks wrapped layers and calls this)."""
+        self._closed_ev.set()
+        try:
+            self._wb_q.put_nowait(None)     # wake a parked get()
+        except Exception:  # noqa: BLE001 — full queue: the 0.5s poll
+            pass           # picks the closed flag up anyway
+        for t in (self._wb_thread, self._gc_thread):
+            if t is not None and t.is_alive():
+                t.join(timeout=timeout)

@@ -80,9 +80,10 @@ class _LockedStream:
     """Iterator holding a DRWMutex until exhausted/closed/GC'd; the
     unlock runs exactly once (see _locked_stream)."""
 
-    def __init__(self, lk, inner):
+    def __init__(self, lk, inner, on_close=None):
         self._lk = lk
         self._inner = inner
+        self._on_close = on_close
         self._done = False
 
     def __iter__(self):
@@ -106,7 +107,11 @@ class _LockedStream:
             if close is not None:
                 close()
         finally:
-            self._lk.unlock()
+            try:
+                self._lk.unlock()
+            finally:
+                if self._on_close is not None:
+                    self._on_close()
 
     def __del__(self):
         self.close()
@@ -221,6 +226,13 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         # last streaming PUT's overlap numbers (mt_put_pipeline_* scrape
         # + bench.py's pipelined leg read these)
         self._pipe_stats: dict = {}
+        # hot-read plane (objectlayer/hotread.py): single-flight GET
+        # coalescing + the hot-object cache.  Zero owned threads;
+        # knobs ride the process-global ``cache`` kvconfig subsystem
+        # (S3Server.reload_cache_config pushes admin SetConfigKV and
+        # wires the api_stats admission heat source)
+        from .hotread import HotReadPlane
+        self.hotread = HotReadPlane(self)
 
     def reload_pipeline_config(self, config) -> None:
         """(Re)read the ``pipeline`` kvconfig knobs — at construction
@@ -397,6 +409,12 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         if any(isinstance(e, serrors.VolumeNotEmpty) for e in errs) \
                 and not force:
             raise BucketNotEmpty(bucket)
+        # the whole namespace went away: fence + release every cached
+        # hot-read window of the bucket (hits were already safe — their
+        # quorum revalidation now raises — this frees the bytes)
+        plane = getattr(self, "hotread", None)
+        if plane is not None:
+            plane.invalidate_bucket(bucket)
 
     def _check_bucket(self, bucket: str) -> None:
         exp = self._buckets_seen.get(bucket)
@@ -599,6 +617,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             committed = True
             if self.mrf is not None and any(e is not None for e in errs):
                 self.mrf.add(bucket, object_name, fi.version_id)
+            self._hot_invalidate(bucket, object_name)
             self.metacache.invalidate(bucket)
             return self._to_object_info(fi)
         finally:
@@ -753,6 +772,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         # missed the write — queue a prompt re-heal
         if self.mrf is not None and any(e is not None for e in errs):
             self.mrf.add(bucket, object_name, fi.version_id)
+        self._hot_invalidate(bucket, object_name)
         self.metacache.invalidate(bucket)
         return self._to_object_info(fi)
 
@@ -794,13 +814,24 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             fresh=True)
         shuffled = meta.shuffle_disks(self.disks, distribution)
         wq = self._write_quorum(fi)
-        if self._pipeline_on():
-            return self._stream_put_pipelined(
+        # mesh-scaled encode batches charge the node memory governor
+        # for the stream's lifetime (the PR-11 deferred follow-up):
+        # ``pipeline.depth`` batches of body plus the one in hand are
+        # live at once, so a mesh-widened batch is pressure the
+        # watermark must admit BEFORE the body is drained (over it,
+        # the S3 front sheds 503 + Retry-After instead of OOMing)
+        charge = self._batch_charge(-1, slots=self._pipe_depth + 1)
+        try:
+            if self._pipeline_on():
+                return self._stream_put_pipelined(
+                    bucket, object_name, chunks, opts, fi, m, shuffled,
+                    wq, mod_time, readahead_body)
+            return self._stream_put_serial(
                 bucket, object_name, chunks, opts, fi, m, shuffled, wq,
                 mod_time, readahead_body)
-        return self._stream_put_serial(
-            bucket, object_name, chunks, opts, fi, m, shuffled, wq,
-            mod_time, readahead_body)
+        finally:
+            if charge is not None:
+                charge.release()
 
     @staticmethod
     def _md5_link(prev, h, chunk, stats) -> None:
@@ -972,6 +1003,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 raise WriteQuorumError(str(e)) from e
             if self.mrf is not None and any(e is not None for e in cerrs):
                 self.mrf.add(bucket, object_name, fi.version_id)
+            self._hot_invalidate(bucket, object_name)
             self.metacache.invalidate(bucket)
             wall = time.perf_counter() - t_wall0
             write_s = sw.max_busy_s()
@@ -1091,6 +1123,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 raise WriteQuorumError(str(e)) from e
             if self.mrf is not None and any(e is not None for e in cerrs):
                 self.mrf.add(bucket, object_name, fi.version_id)
+            self._hot_invalidate(bucket, object_name)
             self.metacache.invalidate(bucket)
             return self._to_object_info(fi)
         finally:
@@ -1157,6 +1190,17 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         decodes batch-of-blocks at a time, so a 1 MiB range of a 100 GiB
         object touches one block per shard and memory stays O(batch)."""
         opts = opts or ObjectOptions()
+        # hot-read plane first: concurrent readers of one window share
+        # ONE drive read + decode, and hot windows serve straight from
+        # the validated cache.  Every non-happy path returns None and
+        # falls through here, so the reference error semantics below
+        # stay the single source of truth.
+        plane = self.hotread
+        if plane is not None:
+            served = plane.serve(bucket, object_name, offset, length,
+                                 opts)
+            if served is not None:
+                return served
         self._check_bucket(bucket)
         # read lock for the duration of the stream (GetObjectNInfo takes
         # the nsLock RLock, cmd/erasure-object.go:136): a reader racing a
@@ -1188,8 +1232,19 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         if size == 0 or length == 0:
             lk.unlock()
             return info, iter(())
-        gen = self._locked_stream(lk, self._stream_range(
-            bucket, object_name, fi, fis, offset, length))
+        # mesh-scaled decode batches charge the node memory governor
+        # for the stream's lifetime (the PR-11 deferred follow-up): a
+        # GET whose batch the mesh widened past the base is real
+        # memory pressure the watermark must see (release on close)
+        try:
+            charge = self._batch_charge(length)
+        except BaseException:
+            lk.unlock()
+            raise
+        gen = self._locked_stream(
+            lk, self._stream_range(bucket, object_name, fi, fis,
+                                   offset, length),
+            on_close=(charge.release if charge is not None else None))
         if not _readahead:
             return info, gen
         # readahead: block batch N+1's shard reads + decode overlap the
@@ -1204,7 +1259,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         return info, readahead(gen, depth=max(1, self._pipe_depth - 1))
 
     @staticmethod
-    def _locked_stream(lk, inner):
+    def _locked_stream(lk, inner, on_close=None):
         """Hold a lock until the stream is exhausted or abandoned.
 
         NOT a generator on purpose: per PEP 342, closing/GC-ing a
@@ -1213,7 +1268,83 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         forever (the refresh keepalive keeps the grant alive).  This
         wrapper unlocks exactly once on exhaustion, error, close(), or
         GC — advanced or not."""
-        return _LockedStream(lk, inner)
+        return _LockedStream(lk, inner, on_close)
+
+    def _batch_charge(self, active_bytes: int, slots: int = 2):
+        """Governor charge for one stream's batch working set — only
+        when the MESH scaling widened the batch past the base
+        ``STREAM_BATCH_BYTES`` (the base bound predates the governor
+        and is fenced by the RSS tests; the scaled portion is the new
+        pressure ``pipeline.mesh_batch_bytes`` caps but nothing
+        previously accounted).  ``slots`` ≈ live copies of one batch
+        (framed shards + assembled payload for GET; queued encode
+        buffers for PUT).  Returns None when no charge applies; raises
+        MemoryPressure past the watermark (the S3 front sheds 503)."""
+        batch = self._stream_batch_size()
+        if batch <= STREAM_BATCH_BYTES:
+            return None
+        est = batch if active_bytes < 0 else min(batch, active_bytes)
+        if est <= STREAM_BATCH_BYTES:
+            return None
+        from ..utils.memgov import GOVERNOR
+        return GOVERNOR.charge(est * max(1, slots), "pipeline")
+
+    def _hot_fileinfo(self, bucket: str, object_name: str,
+                      version_id: Optional[str]):
+        """Hot-read plane validation read: one ns-read-locked quorum
+        metadata pass, returning ``(fi, info)`` — the identity a cache
+        hit compares before serving (diskcache.py ETag-validation
+        role, quorum-consistent so a committed overwrite on ANY node
+        is always seen)."""
+        self._check_bucket(bucket)
+        lk = self.ns_lock.new_lock(bucket, object_name)
+        lk.lock(write=False)
+        try:
+            fi, _ = self._read_quorum_fileinfo(bucket, object_name,
+                                               version_id)
+            return fi, self._to_object_info(fi)
+        finally:
+            lk.unlock()
+
+    def _hot_read_window(self, bucket: str, object_name: str,
+                         version_id: Optional[str], start: int,
+                         wlen: int):
+        """Hot-read plane leader fetch: ONE ns-read-locked pass
+        resolving quorum metadata and decoding the window's plain
+        bytes (inline-tiny objects serve straight from the metadata
+        quorum read — ``_stream_range`` reads ``inline_data`` without
+        any drive data fan-out).  Returns ``(fi, info, data)``; data
+        is None for delete markers and out-of-range starts (the
+        caller falls through to the reference error path)."""
+        self._check_bucket(bucket)
+        lk = self.ns_lock.new_lock(bucket, object_name)
+        lk.lock(write=False)
+        try:
+            fi, fis = self._read_quorum_fileinfo(bucket, object_name,
+                                                 version_id)
+            info = self._to_object_info(fi)
+            if fi.deleted:
+                return fi, info, None
+            size = fi.size
+            if size == 0:
+                return fi, info, b""
+            if start >= size:
+                return fi, info, None
+            n = min(wlen, size - start)
+            data = b"".join(self._stream_range(bucket, object_name,
+                                               fi, fis, start, n))
+            return fi, info, data
+        finally:
+            lk.unlock()
+
+    def _hot_invalidate(self, bucket: str, object_name: str) -> None:
+        """Write-path fence: called inside every ns-write-locked
+        commit section BEFORE the write is acknowledged, so cached
+        windows are gone and straddling fills are refused by the time
+        any client can observe the new version."""
+        plane = getattr(self, "hotread", None)
+        if plane is not None:
+            plane.invalidate(bucket, object_name)
 
     def _stream_range(self, bucket: str, object_name: str, fi: FileInfo,
                       fis: list[FileInfo | None], offset: int, length: int):
@@ -1425,6 +1556,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 oi = ObjectInfo(bucket=bucket, name=object_name,
                                 version_id=dm.version_id,
                                 delete_marker=True, mod_time=mod_time)
+                self._hot_invalidate(bucket, object_name)
                 self.metacache.invalidate(bucket)
                 return oi
             # delete a concrete version (or the null version)
@@ -1445,6 +1577,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                                  WriteQuorumError)
             except serrors.StorageError as e:
                 raise WriteQuorumError(str(e)) from e
+            self._hot_invalidate(bucket, object_name)
             self.metacache.invalidate(bucket)
             return ObjectInfo(bucket=bucket, name=object_name,
                               version_id=vid)
@@ -1494,6 +1627,7 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             for k in removes:
                 fi.metadata.pop(k, None)
             fi.metadata.update(updates)
+            self._hot_invalidate(bucket, object_name)
             self.metacache.invalidate(bucket)
             return self._to_object_info(fi)
         finally:

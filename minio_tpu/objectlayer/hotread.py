@@ -1,0 +1,688 @@
+"""Hot-read plane — single-flight GET coalescing + the hot-object
+cache (the read-side sibling of the PR-8 batching codec service).
+
+Production read traffic is zipfian: a thousand concurrent GETs of one
+hot object used to pay a thousand drive fan-outs and a thousand
+erasure decodes.  This module is the third application of the
+combining discipline that carried the md5 ``LaneScheduler`` (PR 6)
+and the ``CodecBatcher`` (PR 8), turned toward reads:
+
+  * **Single-flight coalescing** (:class:`SingleFlight`): concurrent
+    readers of one ``(bucket, object, version, range-window)`` share
+    ONE drive read + ONE erasure decode.  The first caller becomes the
+    leader and executes the real read through the layer's locked
+    quorum path; followers park on an event and receive zero-copy
+    ``memoryview`` slices of the leader's decoded buffer.  Queues are
+    bounded (``cache.singleflight_queue`` waiters per flight — an
+    arrival past the bound sheds to an independent read, latency stays
+    bounded), waiters can cancel out (deadline or caller death), and
+    the plane owns NO threads — leaders are borrowed caller threads,
+    so there is nothing to leak at shutdown.
+
+  * **Hot-object cache** (:class:`HotObjectCache`, the promoted
+    ``objectlayer/diskcache.py`` tier, memory-resident): windows a
+    flight decoded are admitted when the object is HOT — per-key reads
+    within the last minute reach ``cache.heat_threshold`` while the
+    server's last-minute GetObject rate (the PR-2 ``api_stats`` rings,
+    wired in by ``S3Server.reload_cache_config``) says the read plane
+    is actually busy — or immediately when readers coalesced (
+    concurrent demand is definitionally hot) or the object is
+    inline-tiny (its bytes already rode the metadata quorum read).
+    Cached bytes charge the PR-9 memory governor under the ``cache``
+    kind (``mt_mem_inuse_bytes{kind="cache"}``) via the non-shedding
+    :meth:`utils.memgov.MemoryGovernor.try_charge` — under node
+    pressure the cache stops growing instead of shedding requests.
+
+**Consistency.**  Every cache HIT revalidates against a quorum
+metadata read (itself single-flighted) — the reference disk-cache
+discipline (cmd/disk-cache.go GetObjectNInfo ETag validation) — so a
+hit can never serve bytes a committed overwrite replaced, on any
+node.  Writers additionally invalidate *before the write is
+acknowledged*: every commit path bumps the key's generation inside
+its ns-write-locked section, which (a) evicts cached windows, and
+(b) fences in-flight fills — a fill records the generation when its
+flight started and is refused if it changed, so a read that raced an
+overwrite can never insert stale bytes.  Joins are safe CROSS-NODE
+too, by lock serialization rather than the generation fence: a
+flight is joinable only while its leader's fetch is in progress, and
+the leader holds the (distributed) ns READ lock for the whole fetch
+— so a conflicting overwrite on any node cannot pass its ns-write-
+locked commit, let alone ack, until the leader released and the
+flight stopped accepting joiners.  A reader that arrives after a
+remote overwrite acked therefore always leads (or joins) a flight
+whose locked read observes the new version.  Peer nodes evict
+through the existing metacache-invalidate fan-out
+(``peer.mark_change``); their hits were never stale anyway (quorum
+validation), the eviction just frees the bytes promptly.
+
+Config lives in the ``cache`` kvconfig subsystem (enable, max_bytes,
+heat_threshold, singleflight_queue, window_bytes), live-reloadable via
+admin SetConfigKV → ``S3Server.reload_cache_config``.  Every event
+lands in the ``mt_singleflight_*`` / ``mt_cache_*`` metric families
+(admin/metrics.py; gauges keep the idle contract — an unused plane
+emits nothing).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from ..utils.locktrace import mtlock
+
+# per-key read-heat window (seconds): touches older than this stop
+# counting toward the admission threshold
+_HEAT_WINDOW_S = 60.0
+# generation entries older than this are prunable once the table is
+# over its soft bound — far longer than any in-flight GET lives, so a
+# pruned entry can never un-fence a straddling fill
+_GEN_TTL_S = 120.0
+_GEN_SOFT_CAP = 4096
+_HEAT_SOFT_CAP = 4096
+
+
+class CacheConfig:
+    """Live-reloadable knobs (``cache`` kvconfig subsystem).  Reads
+    env/defaults lazily on first use; the server pushes admin
+    SetConfigKV values via S3Server.reload_cache_config (a fresh
+    kvconfig.Config cannot see another instance's dynamic layer)."""
+
+    def __init__(self):
+        self.enable = True
+        self.max_bytes = 128 << 20
+        self.heat_threshold = 2
+        self.singleflight_queue = 64
+        self.window_bytes = 8 << 20
+        self._loaded = False
+
+    def load(self, cfg=None) -> None:
+        try:
+            if cfg is None:
+                from ..utils.kvconfig import Config
+                cfg = Config()
+            # parse ALL knobs first, assign atomically (the CodecConfig
+            # discipline): a bad value in one key must not leave a
+            # silently half-applied config
+            enable = str(cfg.get("cache", "enable")
+                         ).strip().lower() not in ("off", "0",
+                                                   "false", "")
+            max_bytes = max(0, int(cfg.get("cache", "max_bytes")))
+            heat = max(1, int(cfg.get("cache", "heat_threshold")))
+            queue = max(0, int(cfg.get("cache", "singleflight_queue")))
+            window = max(64 * 1024,
+                         int(cfg.get("cache", "window_bytes")))
+            self.enable = enable
+            self.max_bytes = max_bytes
+            self.heat_threshold = heat
+            self.singleflight_queue = queue
+            self.window_bytes = window
+        except (KeyError, ValueError):
+            pass
+        self._loaded = True
+
+    def on(self) -> bool:
+        if not self._loaded:
+            self.load()
+        return self.enable
+
+
+CONFIG = CacheConfig()
+
+# every live plane, weakly referenced: operational sweeps (and test
+# isolation) can release the whole process's cached bytes in one call
+# without owning the layers
+_PLANES: "weakref.WeakSet[HotReadPlane]" = weakref.WeakSet()
+
+
+def clear_all_planes() -> None:
+    """Release every plane's cached bytes back to the memory governor
+    (process-wide).  Used by server shutdown paths that cannot reach a
+    layer's plane directly and by the test harness between tests — a
+    cache is always safe to drop."""
+    for plane in list(_PLANES):
+        try:
+            plane.clear()
+        except Exception:  # noqa: BLE001 — a dying plane must not
+            pass           # block the sweep
+
+
+class _Flight:
+    """One in-flight leader read; waiters park on the event."""
+
+    __slots__ = ("event", "result", "exc", "gen", "waiters", "done")
+
+    def __init__(self, gen: int):
+        self.event = threading.Event()
+        self.result = None
+        self.exc: BaseException | None = None
+        self.gen = gen
+        self.waiters = 0
+        self.done = False
+
+
+class SingleFlight:
+    """Generic keyed single-flight executor with generation fencing.
+
+    ``do(group, sub, fetch)`` runs ``fetch()`` once per concurrent
+    ``(group, sub)`` key; followers share the leader's result (or its
+    exception).  ``gen_of(group)`` fences joins: a flight started
+    before ``group`` was invalidated is invisible to readers arriving
+    after — they lead a fresh flight instead of riding stale bytes.
+    Leaders are borrowed caller threads; the class owns none."""
+
+    def __init__(self, gen_of: Callable[[tuple], int]):
+        self._mu = mtlock("hotread.singleflight")
+        self._flights: dict[tuple, _Flight] = {}
+        self._gen_of = gen_of
+        # lifetime totals (scrape gauges + the test/bench deltas)
+        self.flights = 0
+        self.coalesced = 0
+        self.shed = 0
+        self.cancelled = 0
+
+    def do(self, group: tuple, sub, fetch: Callable,
+           max_waiters: int = 64,
+           timeout: float | None = None
+           ) -> tuple[str, object, int, int]:
+        """Returns ``(mode, result, gen0, followers)`` where mode is
+        ``lead`` / ``join`` / ``shed`` / ``cancelled``; result is only
+        valid for lead/join.  ``gen0`` is the group generation the
+        flight was fenced at — a cache fill must check it is still
+        current.  ``followers`` (leads only) counts the waiters the
+        flight served beside the leader — the coalescing signal the
+        cache admission reads as "definitionally hot"."""
+        from ..admin.metrics import GLOBAL as _mtr
+        key = (group, sub)
+        g0 = self._gen_of(group)
+        lead = False
+        with self._mu:
+            f = self._flights.get(key)
+            if f is not None and not f.done and f.gen == g0:
+                if f.waiters >= max_waiters:
+                    self.shed += 1
+                    f = None
+                else:
+                    f.waiters += 1
+            else:
+                f = _Flight(g0)
+                self._flights[key] = f
+                lead = True
+        if f is None:
+            _mtr.inc("mt_singleflight_shed_total")
+            return "shed", None, g0, 0
+        if lead:
+            try:
+                f.result = fetch()
+            except BaseException as e:
+                f.exc = e
+            finally:
+                f.done = True
+                with self._mu:
+                    if self._flights.get(key) is f:
+                        del self._flights[key]
+                    self.flights += 1
+                    followers = f.waiters
+                f.event.set()
+            _mtr.inc("mt_singleflight_flights_total")
+            if f.exc is not None:
+                raise f.exc
+            return "lead", f.result, g0, followers
+        # follower: park for the leader's result.  The leader sets the
+        # event in a finally, so a dead leader can never strand us; the
+        # poll slice keeps caller-death (async exception) responsive.
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        try:
+            while not f.event.wait(0.05):
+                if deadline is not None and \
+                        time.monotonic() >= deadline:
+                    with self._mu:
+                        f.waiters -= 1
+                        self.cancelled += 1
+                    _mtr.inc("mt_singleflight_cancelled_total")
+                    return "cancelled", None, g0, 0
+        except BaseException:
+            # caller death mid-park (KeyboardInterrupt, test harness
+            # timeout): cancel our seat so the shed bound stays honest,
+            # then keep propagating in the thread it hit
+            with self._mu:
+                f.waiters -= 1
+                self.cancelled += 1
+            _mtr.inc("mt_singleflight_cancelled_total")
+            raise
+        with self._mu:
+            self.coalesced += 1
+        _mtr.inc("mt_singleflight_coalesced_total")
+        if f.exc is not None:
+            raise f.exc
+        return "join", f.result, g0, 0
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {"flights": self.flights,
+                    "coalesced": self.coalesced,
+                    "shed": self.shed,
+                    "cancelled": self.cancelled,
+                    "in_flight": len(self._flights)}
+
+
+class _Entry:
+    """One cached window: decoded plain bytes + the identity triple
+    the hit validation compares against a fresh quorum read."""
+
+    __slots__ = ("info", "ident", "data", "charge", "size")
+
+    def __init__(self, info, ident: tuple, data: bytes, charge):
+        self.info = info
+        self.ident = ident
+        self.data = data
+        self.charge = charge
+        self.size = len(data)
+
+
+class HotObjectCache:
+    """Bounded LRU of decoded object windows (the memory-resident hot
+    tier the disk-cache module's gateway wrapper grew into).  Keys are
+    ``(bucket, object, version, window)``; bytes charge the memory
+    governor (kind ``cache``) while resident and release on evict."""
+
+    def __init__(self):
+        self._mu = mtlock("hotread.cache")
+        self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        self._by_key: dict[tuple, set] = {}     # (b, o) -> {full keys}
+        self.bytes = 0
+        # lifetime totals (scrape + tests)
+        self.hits = 0
+        self.misses = 0
+        self.fills = 0
+        self.evictions = 0
+        self.invalidations = 0
+
+    def get(self, ck: tuple) -> Optional[_Entry]:
+        with self._mu:
+            e = self._entries.get(ck)
+            if e is not None:
+                self._entries.move_to_end(ck)
+            return e
+
+    def record_hit(self) -> None:
+        from ..admin.metrics import GLOBAL as _mtr
+        with self._mu:
+            self.hits += 1
+        _mtr.inc("mt_cache_hits_total")
+
+    def record_miss(self) -> None:
+        from ..admin.metrics import GLOBAL as _mtr
+        with self._mu:
+            self.misses += 1
+        _mtr.inc("mt_cache_misses_total")
+
+    def record_invalidation(self) -> None:
+        with self._mu:
+            self.invalidations += 1
+
+    def put(self, ck: tuple, info, ident: tuple, data: bytes,
+            max_bytes: int) -> bool:
+        """Insert one window; LRU-evicts to fit ``max_bytes`` and
+        declines (False) when the governor is past its watermark or
+        the window alone exceeds the budget."""
+        from ..admin.metrics import GLOBAL as _mtr
+        from ..utils.memgov import GOVERNOR
+        n = len(data)
+        if max_bytes <= 0 or n > max_bytes:
+            return False
+        charge = GOVERNOR.try_charge(n, "cache")
+        if charge is None:
+            return False            # node under pressure: don't grow
+        entry = _Entry(info, ident, data, charge)
+        evicted: list[_Entry] = []
+        with self._mu:
+            old = self._entries.pop(ck, None)
+            if old is not None:
+                self.bytes -= old.size
+                evicted.append(old)
+            while self._entries and self.bytes + n > max_bytes:
+                k, e = self._entries.popitem(last=False)
+                self._by_key.get(k[:2], set()).discard(k)
+                self.bytes -= e.size
+                evicted.append(e)
+                self.evictions += 1
+            if self.bytes + n > max_bytes:
+                evicted.append(entry)
+                entry = None
+            else:
+                self._entries[ck] = entry
+                self._by_key.setdefault(ck[:2], set()).add(ck)
+                self.bytes += n
+                self.fills += 1
+        for e in evicted:
+            e.charge.release()
+        if entry is not None:
+            _mtr.inc("mt_cache_fills_total")
+        return entry is not None
+
+    def evict(self, ck: tuple) -> None:
+        with self._mu:
+            e = self._entries.pop(ck, None)
+            if e is None:
+                return
+            self._by_key.get(ck[:2], set()).discard(ck)
+            self.bytes -= e.size
+            self.evictions += 1
+        e.charge.release()
+
+    def evict_key(self, key: tuple) -> int:
+        """Drop every cached window of one ``(bucket, object)``."""
+        dropped: list[_Entry] = []
+        with self._mu:
+            for ck in list(self._by_key.pop(key, ())):
+                e = self._entries.pop(ck, None)
+                if e is not None:
+                    self.bytes -= e.size
+                    dropped.append(e)
+            self.evictions += len(dropped)
+        for e in dropped:
+            e.charge.release()
+        return len(dropped)
+
+    def evict_bucket(self, bucket: str) -> int:
+        with self._mu:
+            keys = [k for k in self._by_key if k[0] == bucket]
+        return sum(self.evict_key(k) for k in keys)
+
+    def clear(self) -> None:
+        with self._mu:
+            dropped = list(self._entries.values())
+            self._entries.clear()
+            self._by_key.clear()
+            self.bytes = 0
+            self.evictions += len(dropped)
+        for e in dropped:
+            e.charge.release()
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"entries": len(self._entries), "bytes": self.bytes,
+                    "hits": self.hits, "misses": self.misses,
+                    "fills": self.fills, "evictions": self.evictions,
+                    "invalidations": self.invalidations}
+
+
+class _HotBody:
+    """Streamed body over one zero-copy slice of a plane buffer.
+    Carries ``cache_status`` so the S3 handler can stamp the
+    ``x-minio-tpu-cache`` response header."""
+
+    __slots__ = ("_mv", "cache_status", "_done")
+
+    def __init__(self, mv, cache_status: str):
+        self._mv = mv
+        self.cache_status = cache_status
+        self._done = False
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            raise StopIteration
+        self._done = True
+        if not len(self._mv):
+            raise StopIteration
+        return self._mv
+
+    def close(self) -> None:
+        self._done = True
+
+
+class HotReadPlane:
+    """One erasure set's hot-read plane (constructed by
+    ``ErasureObjects.__init__``; config is process-global like the
+    codec batcher's).  ``serve`` returns ``(info, body)`` or ``None``
+    to fall through to the uncoalesced reader — every non-happy path
+    (delete markers, invalid ranges, window-spanning requests) falls
+    through so the reference error semantics stay in one place."""
+
+    def __init__(self, layer):
+        self._layer = layer
+        self._mu = mtlock("hotread.plane")
+        self._gen_counter = 0
+        self._gens: dict[tuple, tuple[int, float]] = {}
+        self._heat: dict[tuple, tuple[int, float]] = {}
+        # (b, o, vid) -> (size, monotonic): advisory routing hint so
+        # full GETs of known window-spanning objects skip the plane
+        # without a wasted window read
+        self._sizes: dict[tuple, tuple[int, float]] = {}
+        self.sf = SingleFlight(self.gen_of)
+        self.cache = HotObjectCache()
+        self.config = CONFIG
+        # the server's last-minute GetObject rate (PR-2 api_stats),
+        # injected by S3Server.reload_cache_config; None = standalone
+        # layer, per-key heat alone drives admission
+        self.heat_fn: Callable[[], int] | None = None
+        self.used = False
+        _PLANES.add(self)
+
+    # -- generations (invalidate-before-visible fencing) -------------------
+
+    def gen_of(self, key: tuple) -> int:
+        with self._mu:
+            return self._gens.get(key, (0, 0.0))[0]
+
+    def invalidate(self, bucket: str, object_name: str) -> None:
+        """Called by every write path inside its ns-write-locked
+        section (and by peer mark_change): bump the fence FIRST, then
+        evict — an in-flight fill that read pre-overwrite bytes is
+        refused by the fence, and anything already cached is gone
+        before the write is acknowledged."""
+        from ..admin.metrics import GLOBAL as _mtr
+        key = (bucket, object_name)
+        now = time.monotonic()
+        with self._mu:
+            self._gen_counter += 1
+            self._gens[key] = (self._gen_counter, now)
+            if len(self._gens) > _GEN_SOFT_CAP:
+                cut = now - _GEN_TTL_S
+                for k in [k for k, (_, t) in self._gens.items()
+                          if t < cut]:
+                    del self._gens[k]
+            for k in [k for k in self._sizes if k[:2] == key]:
+                del self._sizes[k]
+            touched = self.used
+        self.cache.evict_key(key)
+        self.cache.record_invalidation()
+        if touched:
+            _mtr.inc("mt_cache_invalidations_total")
+
+    def invalidate_bucket(self, bucket: str) -> None:
+        with self._mu:
+            self._gen_counter += 1
+            now = time.monotonic()
+            for key in [k for k in self._gens if k[0] == bucket]:
+                self._gens[key] = (self._gen_counter, now)
+            for k in [k for k in self._sizes if k[0] == bucket]:
+                del self._sizes[k]
+        self.cache.evict_bucket(bucket)
+
+    def clear(self) -> None:
+        """Release every cached byte (config disable / tests)."""
+        self.cache.clear()
+
+    # -- admission heat -----------------------------------------------------
+
+    def _touch(self, key: tuple) -> int:
+        """Record one read of ``key``; returns reads within the heat
+        window (a coarse per-key last-minute ring — the api_stats
+        discipline at per-object granularity)."""
+        now = time.monotonic()
+        with self._mu:
+            n, t0 = self._heat.get(key, (0, now))
+            if now - t0 > _HEAT_WINDOW_S:
+                n, t0 = 0, now
+            n += 1
+            self._heat[key] = (n, t0)
+            if len(self._heat) > _HEAT_SOFT_CAP:
+                cut = now - _HEAT_WINDOW_S
+                for k in [k for k, (_, t) in self._heat.items()
+                          if t < cut]:
+                    del self._heat[k]
+            return n
+
+    def _admit(self, touches: int, coalesced: bool,
+               tiny: bool) -> bool:
+        if tiny or coalesced:
+            # concurrent demand is definitionally hot; inline-tiny
+            # windows already rode the metadata quorum read
+            return True
+        if touches < self.config.heat_threshold:
+            return False
+        if self.heat_fn is not None:
+            # the stats-plane gate: a cold read plane (idle server)
+            # admits nothing on per-key counts alone
+            try:
+                return self.heat_fn() >= self.config.heat_threshold
+            except Exception:  # noqa: BLE001 — heat source is advisory
+                return True
+        return True
+
+    # -- the serve path -----------------------------------------------------
+
+    def serve(self, bucket: str, object_name: str, offset: int,
+              length: int, opts) -> tuple | None:
+        cfg = self.config
+        if not cfg.on():
+            return None
+        if offset < 0:
+            return None             # suffix ranges: uncoalesced path
+        vid = getattr(opts, "version_id", None)
+        key = (bucket, object_name)
+        kv = (bucket, object_name, vid)
+        W = cfg.window_bytes
+        wstart = (offset // W) * W
+        wend = wstart + W
+        if length >= 0 and offset + length > wend:
+            return None             # spans windows: uncoalesced path
+        hint = self._hint(kv)
+        if hint is not None:
+            size = hint
+            end = size if length < 0 else min(offset + length, size)
+            if offset > size or (size > 0 and offset == size) or \
+                    end > min(wend, size):
+                return None         # error/spanning: uncoalesced path
+        self.used = True
+        touches = self._touch(key)
+        # span = the region one flight fetches (and one cache entry
+        # covers).  A COLD ranged read fetches exactly what was asked
+        # — identical concurrent ranges still coalesce, with zero read
+        # amplification; once the key is hot (or on full GETs, where
+        # the window clamp IS the object), the fetch expands to the
+        # whole window so later ranges inside it become cache hits.
+        expand = length < 0 or touches >= cfg.heat_threshold
+        span_win = (wstart, W)
+        span_exact = (offset, length)
+        for span in (span_win, span_exact):
+            entry = self.cache.get((bucket, object_name, vid, span))
+            if entry is None:
+                continue
+            fi, info = self._validate(kv)
+            if fi is None or fi.deleted:
+                return None
+            if entry.ident != self._ident(fi):
+                # a committed overwrite replaced it: drop, refill below
+                self.cache.evict((bucket, object_name, vid, span))
+                continue
+            served = self._slice(entry.info, entry.data, span[0],
+                                 offset, length, "hit")
+            if served is not None:
+                self.cache.record_hit()
+                return served
+            return None
+        self.cache.record_miss()
+        span = span_win if expand else span_exact
+        start, wlen = (wstart, W) if expand else (offset, length)
+        mode, res, g0, followers = self.sf.do(
+            key, ("rd", vid, span),
+            lambda: self._layer._hot_read_window(
+                bucket, object_name, vid, start, wlen),
+            max_waiters=cfg.singleflight_queue)
+        if mode in ("shed", "cancelled") or res is None:
+            return None
+        fi, info, data = res
+        self._note_size(kv, fi)
+        if fi.deleted or data is None:
+            return None             # marker / out-of-range: real path
+        if length < 0 and fi.size > wend:
+            return None             # full GET of a window-spanner
+        served = self._slice(info, data, start, offset, length,
+                             "coalesced" if mode == "join" else "miss")
+        if served is None:
+            return None
+        if mode == "lead" and self._admit(
+                touches, coalesced=followers > 0,
+                tiny=fi.size <= getattr(self._layer,
+                                        "inline_threshold", 0)):
+            # fence check rides the recorded generation: only insert
+            # while no overwrite bumped the key since the flight
+            # started (invalidate-before-visible, the stale-fill gate)
+            if self.gen_of(key) == g0:
+                self.cache.put((bucket, object_name, vid, span), info,
+                               self._ident(fi), data, cfg.max_bytes)
+        return served
+
+    # -- internals -----------------------------------------------------------
+
+    @staticmethod
+    def _ident(fi) -> tuple:
+        return (fi.metadata.get("etag", ""), fi.version_id,
+                fi.mod_time)
+
+    def _hint(self, kv: tuple) -> int | None:
+        with self._mu:
+            h = self._sizes.get(kv)
+            return h[0] if h is not None else None
+
+    def _note_size(self, kv: tuple, fi) -> None:
+        with self._mu:
+            self._sizes[kv] = (fi.size, time.monotonic())
+            if len(self._sizes) > _HEAT_SOFT_CAP:
+                cut = time.monotonic() - _HEAT_WINDOW_S
+                for k in [k for k, (_, t) in self._sizes.items()
+                          if t < cut]:
+                    del self._sizes[k]
+
+    def _validate(self, kv: tuple):
+        """Quorum-read the key's current identity (single-flighted so
+        64 concurrent hits pay one metadata fan-out).  Layer errors
+        (ObjectNotFound, quorum loss) propagate exactly as the
+        uncoalesced path would raise them."""
+        bucket, object_name, vid = kv
+        mode, res, _, _ = self.sf.do(
+            (bucket, object_name), ("info", vid),
+            lambda: self._layer._hot_fileinfo(bucket, object_name,
+                                              vid),
+            max_waiters=self.config.singleflight_queue)
+        if mode in ("shed", "cancelled"):
+            res = self._layer._hot_fileinfo(bucket, object_name, vid)
+        self._note_size(kv, res[0])
+        return res
+
+    def _slice(self, info, data, wstart: int, offset: int,
+               length: int, status: str) -> tuple | None:
+        size = info.size
+        end = size if length < 0 else min(offset + length, size)
+        if offset > size or (size > 0 and offset == size):
+            return None
+        lo = offset - wstart
+        hi = end - wstart
+        if hi > len(data):
+            return None             # window didn't cover (stale hint)
+        mv = memoryview(data)[lo:hi]
+        return info, _HotBody(mv, status)
+
+    def stats(self) -> dict:
+        out = {"singleflight": self.sf.snapshot(),
+               "cache": self.cache.stats()}
+        return out

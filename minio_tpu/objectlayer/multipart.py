@@ -451,6 +451,9 @@ class MultipartOps:
                                  WriteQuorumError)
             except serrors.StorageError as e:
                 raise WriteQuorumError(str(e)) from e
+            # hot-read fence INSIDE the locked commit section, like
+            # every other write path (invalidate-before-visible)
+            self._hot_invalidate(bucket, object_name)
         finally:
             lk.unlock()
         fi.is_latest = True

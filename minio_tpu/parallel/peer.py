@@ -68,13 +68,25 @@ def register_peer_service(rpc: RPCServer, srv) -> None:
         """A peer's write happened: mark this node's update tracker so
         cached listings for the bucket go stale immediately instead of
         after the metacache TTL (cmd/data-update-tracker.go fan-in +
-        cmd/metacache-bucket.go consult)."""
+        cmd/metacache-bucket.go consult).  The hot-read plane rides
+        the same fan-out: an overwrite/delete on ANY node evicts this
+        node's cached windows and fences its in-flight fills — a hit
+        was never stale anyway (every hit revalidates against a quorum
+        metadata read), the eviction frees the bytes promptly."""
         if srv.tracker is not None:
             srv.tracker.mark(bucket, object_name)
         else:
             from ..objectlayer.metacache import managers_of
             for mc in managers_of(srv.layer):
                 mc.invalidate(bucket)  # no tracker: hard-drop instead
+        from ..objectlayer.metacache import leaf_layers_of
+        for leaf in leaf_layers_of(srv.layer):
+            plane = getattr(leaf, "hotread", None)
+            if plane is not None:
+                if object_name:
+                    plane.invalidate(bucket, object_name)
+                else:
+                    plane.invalidate_bucket(bucket)
         if not object_name:
             # bucket-level change (create/delete): existence cache too
             _evict_bucket_seen(srv.layer, bucket)

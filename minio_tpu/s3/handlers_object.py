@@ -1181,6 +1181,13 @@ def _get_object(self, bucket, key, query, head: bool):
         hdrs["x-amz-storage-class"] = \
             oi.user_defined["x-amz-storage-class"]
     hdrs.update(sse_hdrs)
+    # hot-read plane attribution (objectlayer/hotread.py): bodies the
+    # plane served carry how — ``hit`` (validated cache), ``coalesced``
+    # (shared another reader's in-flight decode) or ``miss`` (led the
+    # flight) — so clients and the bench can see coalescing work
+    cache_status = getattr(body_gen, "cache_status", "")
+    if cache_status:
+        hdrs["x-minio-tpu-cache"] = cache_status
     if oi.version_id:
         hdrs["x-amz-version-id"] = oi.version_id
     for k2, v in oi.user_defined.items():

@@ -405,6 +405,10 @@ class S3Server:
         self.reload_rpc_config()
         # push ``codec`` batching knobs into the shared batcher
         self.reload_codec_config()
+        # push ``cache`` hot-read knobs into every leaf layer's plane
+        # and wire the admission heat source to this server's
+        # last-minute API stats
+        self.reload_cache_config()
         # push ``heal``/``scanner`` pacing into attached background
         # planes (they may also attach later via attach_background)
         self.reload_background_config()
@@ -508,6 +512,33 @@ class S3Server:
             _batcher.CONFIG.load(self.config)
         except Exception:  # noqa: BLE001 — bad knob must not kill boot
             pass
+
+    def reload_cache_config(self) -> None:
+        """Push the ``cache`` kvconfig knobs (enable, max_bytes,
+        heat_threshold, singleflight_queue, window_bytes) into the
+        process-wide hot-read config and wire each leaf layer's plane
+        to THIS server's last-minute per-API stats as its admission
+        heat source — at boot and after admin SetConfigKV, so the
+        hot-object cache retunes on a live server.  Disabling releases
+        every cached byte back to the memory governor immediately."""
+        from ..objectlayer import hotread as _hotread
+        try:
+            _hotread.CONFIG.load(self.config)
+        except Exception:  # noqa: BLE001 — bad knob must not kill boot
+            pass
+        stats = self.api_stats
+
+        def _get_heat() -> int:
+            w = stats.windows.get("GetObject")
+            return w.total()[0] if w is not None else 0
+
+        from ..objectlayer.metacache import leaf_layers_of
+        for leaf in leaf_layers_of(self.layer):
+            plane = getattr(leaf, "hotread", None)
+            if plane is not None:
+                plane.heat_fn = _get_heat
+                if not _hotread.CONFIG.enable:
+                    plane.clear()
 
     def reload_policy_config(self) -> None:
         """(Re)build the external policy webhook from the
@@ -723,6 +754,23 @@ class S3Server:
         # plane reopens lazily if a shared layer serves again later.
         from ..storage.writers import close_write_planes
         close_write_planes(self.layer)
+        # disk-cache layers down WITH the server: writeback + GC
+        # threads (mt-diskcache-*) join so nothing outlives stop()
+        from ..objectlayer.diskcache import CacheObjects
+        lay, seen = self.layer, set()
+        while lay is not None and id(lay) not in seen:
+            seen.add(id(lay))
+            if isinstance(lay, CacheObjects):
+                lay.close()
+            lay = lay.__dict__.get("inner")
+        # hot-read plane: release every cached byte back to the memory
+        # governor — a stopped node holds no resident hot tier, and the
+        # process-wide inuse accounting must read zero at idle
+        from ..objectlayer.metacache import leaf_layers_of
+        for leaf in leaf_layers_of(self.layer):
+            plane = getattr(leaf, "hotread", None)
+            if plane is not None:
+                plane.clear()
         if self.peers is not None:
             self.peers.close()
 

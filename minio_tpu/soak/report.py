@@ -135,14 +135,21 @@ def default_matrix(duration_s: float = 15.0) -> list[Scenario]:
         # workers under the governor watermark and assert the memory
         # SLO rows on the live scrape
         membound = mix.name in ("select_storm", "listing_storm")
+        # the zipf hot-read storm runs with doubled workers so
+        # concurrent GETs of the hot keys actually overlap, and
+        # asserts the hot_read_engaged / cache_bytes_accounted /
+        # stale_reads rows — mid-storm overwrites ride the mix, so the
+        # digest oracle exercises invalidate-before-visible for real
+        hot = mix.name == "hot_get_storm"
         out.append(Scenario(
             name=mix.name, mix=mix,
             timeline=_chaos_timeline(duration_s),
             duration_s=duration_s,
             budget=_slo.Budget(max_error_rate=0.10,
                                require_codec_occupancy=storm,
-                               require_mem_bounded=membound),
-            workers=4 if storm or membound else 2,
+                               require_mem_bounded=membound,
+                               require_hot_read=hot),
+            workers=4 if storm or membound or hot else 2,
             backend="tpu" if storm else "numpy"))
     # huge_put: one mesh-sharded object (1 GiB on a TPU host,
     # MT_SOAK_HUGE_BYTES overrides) PUT mid-chaos on the mesh-backend

@@ -65,6 +65,14 @@ class Mix:
     part_bytes: int = 5 * 1024 * 1024      # S3 minimum (last part exempt)
     key_space: int = 8                     # object pool per worker
     select_rows: int = 64                  # rows in the Select corpus
+    # zipf > 0 skews key selection toward rank-0 keys with
+    # P(i) ∝ 1/(i+1)^zipf — the production hot-read shape the
+    # hot_get_storm mix drives against the single-flight/cache plane
+    zipf: float = 0.0
+    # strict read-your-write oracle: GETs compare the body's md5
+    # against the worker's last PUT of that key — a stale cached read
+    # after an overwrite is an IntegrityMismatch error, not a miss
+    verify_digest: bool = False
 
 
 # the production mixes from ROADMAP item 5
@@ -105,6 +113,18 @@ MIXES: dict[str, Mix] = {m.name: m for m in (
     Mix("listing_storm",
         {"list": 0.65, "put": 0.25, "head": 0.10},
         sizes_bytes=(1024, 4096), key_space=48),
+    # the hot-read plane's target traffic (ROADMAP item 4): zipf-
+    # distributed GET-heavy keys — most reads land on a handful of hot
+    # objects, whose concurrent decodes the single-flight layer fuses
+    # and whose windows the cache then serves — with enough overwrite
+    # churn that the strict read-your-write digest oracle
+    # (verify_digest) would catch any stale cached byte.  The matrix
+    # runs it with extra workers and asserts hot_read_engaged /
+    # cache_bytes_accounted / stale_reads==0 rows (soak/slo.py)
+    Mix("hot_get_storm",
+        {"get": 0.70, "put": 0.20, "head": 0.10},
+        sizes_bytes=(2048, 8192, 32768), key_space=12,
+        zipf=1.2, verify_digest=True),
 )}
 
 
@@ -186,15 +206,31 @@ class Worker(threading.Thread):
         self.prefix = f"w{idx}"
         # key -> expected size, the GET integrity oracle
         self.sizes: dict[str, int] = {}
+        # key -> md5 hex of the last body this worker PUT there (the
+        # strict read-your-write oracle hot-read scenarios arm via
+        # Mix.verify_digest: a stale cached body after an overwrite is
+        # an IntegrityMismatch, not a silently-smaller object)
+        self.digests: dict[str, str] = {}
         self._ops = []
         self._weights = []
         for op, w in sorted(gen.mix.weights.items()):
             self._ops.append(op)
             self._weights.append(w)
+        # zipf key ranks: P(i) ∝ 1/(i+1)^zipf — rank 0 is the hot key
+        # the single-flight/cache plane exists for
+        self._key_weights = None
+        if gen.mix.zipf > 0:
+            self._key_weights = [
+                1.0 / (i + 1) ** gen.mix.zipf
+                for i in range(gen.mix.key_space)]
 
     # -- op implementations -------------------------------------------------
 
     def _key(self) -> str:
+        if self._key_weights is not None:
+            i = self.rng.choices(range(self.gen.mix.key_space),
+                                 weights=self._key_weights)[0]
+            return f"{self.prefix}/o{i}"
         return f"{self.prefix}/o{self.rng.randrange(self.gen.mix.key_space)}"
 
     def _body(self) -> bytes:
@@ -206,6 +242,9 @@ class Worker(threading.Thread):
         body = self._body()
         c.put_object(self.gen.bucket, key, body)
         self.sizes[key] = len(body)
+        if self.gen.mix.verify_digest:
+            import hashlib
+            self.digests[key] = hashlib.md5(body).hexdigest()
         return "PutObject", len(body), 0
 
     def _op_get(self, c: S3Client) -> tuple[str, int, int]:
@@ -215,6 +254,17 @@ class Worker(threading.Thread):
         if want is not None and len(r.body) != want:
             raise S3ClientError(200, "IntegrityMismatch",
                                 f"{key}: {len(r.body)} != {want}")
+        want_md5 = self.digests.get(key) \
+            if self.gen.mix.verify_digest else None
+        if want_md5 is not None:
+            import hashlib
+            got = hashlib.md5(r.body).hexdigest()
+            if got != want_md5:
+                # a stale cached body after this worker's own
+                # overwrite — the exact failure the hot-read plane's
+                # invalidate-before-visible fence exists to prevent
+                raise S3ClientError(200, "IntegrityMismatch",
+                                    f"{key}: md5 {got} != {want_md5}")
         return "GetObject", 0, len(r.body)
 
     def _op_head(self, c: S3Client) -> tuple[str, int, int]:

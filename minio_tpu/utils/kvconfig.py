@@ -174,6 +174,26 @@ register_subsys("codec", {
     "max_batch_blocks": "256",
     "queue_depth": "1024",
 })
+register_subsys("cache", {
+    # hot-read plane (objectlayer/hotread.py): single-flight GET
+    # coalescing + the cluster-coherent hot-object cache.  ``enable``
+    # gates the whole plane; ``max_bytes`` bounds cached plain bytes
+    # per erasure set (charged to the memory governor under the
+    # ``cache`` kind); ``heat_threshold`` is the admission gate —
+    # per-key GETs within the last minute (and the server's last-minute
+    # GetObject rate) must reach it before a window is cached
+    # (coalesced and inline-tiny reads admit immediately);
+    # ``singleflight_queue`` bounds waiters parked on one in-flight
+    # read — arrivals past it shed to an independent read;
+    # ``window_bytes`` is the coalescing/cache granule: requests inside
+    # one window share one drive read + decode.  Live-reloadable
+    # (S3Server.reload_cache_config on admin SetConfigKV).
+    "enable": "on",
+    "max_bytes": "134217728",
+    "heat_threshold": "2",
+    "singleflight_queue": "64",
+    "window_bytes": "8388608",
+})
 register_subsys("storage_class", {  # mt-lint: ok(kvconfig-drift) read per PUT (handlers_object.py) — validated at SetConfigKV time, applies to the next request
     "standard": "",                 # e.g. EC:4
     "rrs": "EC:2",

@@ -36,6 +36,13 @@ from ..admin.metrics import GLOBAL as _metrics
 from .locktrace import mtrlock
 
 
+# kinds that legitimately stay charged between requests: bounded
+# resident tiers (the hot-object cache), bounded by their own knobs
+# and released on server stop — everything else is request-scoped and
+# must settle to zero at idle
+RESIDENT_KINDS = frozenset({"cache"})
+
+
 class MemoryPressure(Exception):
     """Raised when a charge would exceed the configured watermark; the
     S3 layer maps it to 503 SlowDown + Retry-After."""
@@ -120,13 +127,17 @@ class MemoryGovernor:
 
     # -- accounting --------------------------------------------------------
 
-    def charge(self, nbytes: int, kind: str = "other") -> Charge:
-        """Reserve ``nbytes`` for one request; raises MemoryPressure
-        when the node is past its watermark (shed, don't allocate)."""
+    def _admit(self, nbytes: int, kind: str, shed: bool
+               ) -> "Charge | None":
+        """The ONE admission core: watermark check + accounting.
+        ``shed=True`` refusals tick ``mt_mem_shed_total`` and raise
+        MemoryPressure; ``shed=False`` refusals quietly return None."""
         nbytes = max(0, int(nbytes))
         with self._mu:
             inuse = sum(self._inuse.values())
             if self.limit_bytes and inuse + nbytes > self.limit_bytes:
+                if not shed:
+                    return None
                 self._shed[kind] = self._shed.get(kind, 0) + 1
                 retry = self.retry_after_s
                 _metrics.inc("mt_mem_shed_total", {"kind": kind})
@@ -135,6 +146,20 @@ class MemoryGovernor:
             self._inuse[kind] = self._inuse.get(kind, 0) + nbytes
             self._peak = max(self._peak, inuse + nbytes)
         return Charge(self, kind, nbytes)
+
+    def charge(self, nbytes: int, kind: str = "other") -> Charge:
+        """Reserve ``nbytes`` for one request; raises MemoryPressure
+        when the node is past its watermark (shed, don't allocate)."""
+        return self._admit(nbytes, kind, shed=True)
+
+    def try_charge(self, nbytes: int, kind: str = "other"
+                   ) -> "Charge | None":
+        """Non-shedding admission for OPTIONAL allocations (the hot
+        cache filling a window): returns ``None`` instead of raising
+        when the node is past its watermark, and never ticks
+        ``mt_mem_shed_total`` — declining to cache is not a shed
+        request, just a cache that stops growing under pressure."""
+        return self._admit(nbytes, kind, shed=False)
 
     def _release(self, kind: str, nbytes: int) -> None:
         with self._mu:
@@ -151,6 +176,16 @@ class MemoryGovernor:
             if kind is not None:
                 return self._inuse.get(kind, 0)
             return sum(self._inuse.values())
+
+    def transient_bytes(self) -> int:
+        """Outstanding REQUEST-scoped charges: total inuse minus the
+        resident kinds (the hot-object cache's deliberately-held
+        tier).  This is the figure that must settle to zero at idle —
+        a non-zero residue here is a leaked request; resident bytes
+        are bounded by their own knobs and released on shutdown."""
+        with self._mu:
+            return sum(v for k, v in self._inuse.items()
+                       if k not in RESIDENT_KINDS)
 
     def stats(self) -> dict:
         with self._mu:

@@ -26,3 +26,19 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running subprocess tests (memory bounds, "
         "cluster harnesses)")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _release_hot_read_caches():
+    """Hot-read plane isolation: cached windows hold memory-governor
+    charges (kind="cache") for as long as their layer lives, and many
+    suites keep layers alive past their test (module fixtures, GC
+    cycles).  Releasing every plane's cache after each test keeps the
+    strict governor-settles-to-zero assertions sound without each
+    suite knowing the plane exists."""
+    yield
+    from minio_tpu.objectlayer import hotread
+    hotread.clear_all_planes()

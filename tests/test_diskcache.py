@@ -150,6 +150,57 @@ def test_gc_never_drops_dirty(tmp_path):
             "dirty (uncommitted) entries must survive GC"
 
 
+def test_background_gc_thread_sweeps_and_joins(tmp_path):
+    """gc_interval_s > 0 runs the watermark sweep on its own
+    mt-diskcache-gc thread (the reference's periodic purge loop);
+    close() wakes it from its interval wait and JOINS it (the PR-10
+    thread discipline)."""
+    import threading
+    import time
+    backend = FSObjects(str(tmp_path / "gcbg-backend"))
+    backend.make_bucket("gbkt")
+    cache = CacheObjects(backend, [str(tmp_path / "gcbg-cache")],
+                         max_bytes_per_drive=10_000,
+                         gc_interval_s=0.05)
+    # force the drive over its high watermark WITHOUT an inline GC
+    # (direct drive puts bypass CacheObjects' fill-time sweep)
+    drive = cache.drives[0]
+    for i in range(12):
+        drive.put("gbkt", f"k{i}", b"z" * 1000, {"etag": f"e{i}"})
+        time.sleep(0.002)
+    deadline = time.monotonic() + 5.0
+    while drive.usage_bytes() > 10_000 * 0.9 and \
+            time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert drive.usage_bytes() <= 10_000 * 0.9, \
+        "background GC never swept the drive under its watermark"
+    names = [t.name for t in threading.enumerate()
+             if t.name == "mt-diskcache-gc" and t.is_alive()]
+    assert names, "GC must run on a named mt-diskcache-gc thread"
+    cache.close()
+    assert not any(t.name == "mt-diskcache-gc" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+def test_close_joins_writeback_thread_promptly(tmp_path):
+    import threading
+    import time
+    backend = FSObjects(str(tmp_path / "cj-backend"))
+    backend.make_bucket("cbkt")
+    cache = CacheObjects(backend, [str(tmp_path / "cj-cache")],
+                         writeback=True)
+    cache.put_object("cbkt", "o", b"queued")
+    cache.flush_writeback()
+    assert cache._wb_thread is not None
+    t0 = time.monotonic()
+    cache.close()
+    # the sentinel wakes the parked queue.get immediately — no 0.5s
+    # poll-out, and nothing survives the join
+    assert time.monotonic() - t0 < 2.0
+    assert not any(t.name.startswith("mt-diskcache") and t.is_alive()
+                   for t in threading.enumerate())
+
+
 def test_exclude_patterns(tmp_path):
     backend = FSObjects(str(tmp_path / "ex-backend"))
     backend.make_bucket("ebkt")

@@ -1,0 +1,605 @@
+"""Hot-read plane (objectlayer/hotread.py): single-flight GET
+coalescing + the cluster-coherent hot-object cache.
+
+The contracts this tier pins:
+
+  * **bit-identity** — coalesced/cached GETs return byte-for-byte what
+    independent reads return, across plain ranges, SSE-C bodies and
+    ranges, and versioned keys;
+  * **stale-read impossibility** — a racing overwrite can never leave
+    a reader with pre-overwrite bytes once the overwrite acked
+    (invalidate-before-visible: the write path bumps the key's
+    generation inside its locked commit section, evicting cached
+    windows and fencing straddling fills; every cache hit additionally
+    revalidates against a quorum metadata read);
+  * **bounded combining** — waiters past ``cache.singleflight_queue``
+    shed to independent reads, parked waiters can cancel out (caller
+    death / deadline), and the plane owns zero threads;
+  * **governor accounting** — cached bytes appear under the ``cache``
+    kind while resident and release on invalidate/disable/stop, and
+    the mesh-scaled stream/decode batches charge the ``pipeline`` kind
+    (the PR-11 deferred follow-up).
+"""
+
+import base64
+import gc
+import hashlib
+import threading
+import time
+
+import pytest
+
+from minio_tpu.objectlayer import hotread
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.objectlayer.hotread import (CacheConfig, HotObjectCache,
+                                           SingleFlight)
+from minio_tpu.objectlayer.interface import ObjectOptions, PutObjectOptions
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl_storage import XLStorage
+from minio_tpu.utils.memgov import GOVERNOR, MemoryPressure
+
+
+def _layer(tmp_path, n=6, parity=2, sub="d"):
+    disks = []
+    for i in range(n):
+        d = tmp_path / f"{sub}{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    return ErasureObjects(disks, parity=parity, block_size=64 * 1024,
+                          backend="numpy")
+
+
+@pytest.fixture(autouse=True)
+def _collect_dead_layers():
+    """Dead layers from earlier tests hold their caches (and so their
+    governor charges) until cycle GC runs — collect first so the
+    byte-accounting assertions below see only THIS test's plane."""
+    gc.collect()
+    yield
+
+
+@pytest.fixture
+def hot_cfg():
+    """Force the plane on with immediate admission (heat 1) for the
+    duration of a test, restoring the live config after."""
+    cfg = hotread.CONFIG
+    saved = (cfg.enable, cfg.max_bytes, cfg.heat_threshold,
+             cfg.singleflight_queue, cfg.window_bytes, cfg._loaded)
+    cfg.enable, cfg.heat_threshold, cfg._loaded = True, 1, True
+    yield cfg
+    (cfg.enable, cfg.max_bytes, cfg.heat_threshold,
+     cfg.singleflight_queue, cfg.window_bytes, cfg._loaded) = saved
+
+
+# -- bit-identity -----------------------------------------------------------
+
+def test_coalesced_and_cached_ranges_bit_identical(tmp_path, hot_cfg):
+    """16 concurrent readers over a mixed range matrix: every body —
+    led, coalesced, or a validated cache hit — equals the independent
+    slice of the source bytes."""
+    er = _layer(tmp_path)
+    er.hotread.heat_fn = lambda: 100        # stats plane says "hot"
+    er.make_bucket("hot")
+    body = bytes((i * 131) % 256 for i in range(1 << 20))
+    er.put_object("hot", "obj", body)
+    ranges = [(0, -1), (0, 1), (17, 4096), (512 * 1024, 65536),
+              (len(body) - 3, 3), (65536, 64 * 1024 + 1)]
+    out: dict[int, list] = {}
+    errs: list = []
+    barrier = threading.Barrier(16)
+
+    def reader(i):
+        try:
+            barrier.wait()
+            got = []
+            for off, ln in ranges:
+                _, data = er.get_object("hot", "obj", off, ln)
+                got.append(bytes(data))
+            out[i] = got
+        except Exception as e:  # noqa: BLE001 — collected for assert
+            errs.append(e)
+
+    ths = [threading.Thread(target=reader, args=(i,))
+           for i in range(16)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    assert not errs, errs
+    want = [body[off:] if ln < 0 else body[off:off + ln]
+            for off, ln in ranges]
+    for i in range(16):
+        assert out[i] == want, f"reader {i} diverged"
+    st = er.hotread.stats()
+    assert st["singleflight"]["flights"] > 0
+    # hot traffic either coalesced or hit the cache (16 threads on a
+    # 2-core box may serialize; the sum proves the plane carried reads)
+    assert st["cache"]["hits"] + st["singleflight"]["coalesced"] > 0
+
+
+def test_versioned_keys_cache_and_serve_distinctly(tmp_path, hot_cfg):
+    er = _layer(tmp_path)
+    er.hotread.heat_fn = lambda: 100
+    er.make_bucket("ver")
+    b1 = b"v1" * 4096
+    b2 = b"v2-bytes" * 4096
+    oi1 = er.put_object("ver", "k", b1,
+                        PutObjectOptions(versioned=True))
+    oi2 = er.put_object("ver", "k", b2,
+                        PutObjectOptions(versioned=True))
+    for _ in range(3):      # repeat: later rounds serve from cache
+        _, latest = er.get_object("ver", "k")
+        assert latest == b2
+        _, got1 = er.get_object(
+            "ver", "k", opts=ObjectOptions(version_id=oi1.version_id))
+        assert got1 == b1
+        _, got2 = er.get_object(
+            "ver", "k", opts=ObjectOptions(version_id=oi2.version_id))
+        assert got2 == b2
+    assert er.hotread.cache.stats()["hits"] > 0
+
+
+def test_ssec_body_and_range_served_from_cache_bit_identical(
+        tmp_path, tmp_path_factory, hot_cfg):
+    from minio_tpu.crypto import dare
+    if dare.AESGCM is None:
+        pytest.skip("no AES-GCM backend (neither the cryptography "
+                    "wheel nor a loadable libcrypto)")
+    # SSE-C requires TLS (the AWS InsecureSSECustomerRequest gate):
+    # the drill runs over an encrypted front from the shared test PKI
+    from tests._pki import cluster_pki
+    p = cluster_pki(tmp_path_factory)
+    er = _layer(tmp_path)
+    srv = S3Server(er, access_key="hk", secret_key="hs",
+                   tls=p.cert_manager())
+    srv.start()
+    try:
+        hotread.CONFIG.heat_threshold = 1
+        for leaf in [er]:
+            leaf.hotread.heat_fn = lambda: 100
+        c = S3Client(srv.endpoint, "hk", "hs")
+        c.make_bucket("enc")
+        key = hashlib.sha256(b"hotkey").digest()
+        hdrs = {
+            "x-amz-server-side-encryption-customer-algorithm": "AES256",
+            "x-amz-server-side-encryption-customer-key":
+                base64.b64encode(key).decode(),
+            "x-amz-server-side-encryption-customer-key-md5":
+                base64.b64encode(hashlib.md5(key).digest()).decode(),
+        }
+        data = bytes((i * 13) % 256 for i in range(300_000))
+        c.request("PUT", "/enc/hot.bin", body=data, headers=hdrs)
+        st0 = er.hotread.cache.stats()
+        # full-body GETs: the DARE decrypt's ciphertext reads ride the
+        # plane; repeats serve the stored windows from cache
+        for _ in range(3):
+            r = c.request("GET", "/enc/hot.bin", headers=hdrs)
+            assert r.body == data
+        # SSE-C ranged GETs decrypt only covering packages — fed from
+        # the same cached windows, still bit-identical
+        for lo, hi in ((65_000, 131_999), (0, 9), (250_000, 299_999)):
+            r = c.request("GET", "/enc/hot.bin",
+                          headers={"Range": f"bytes={lo}-{hi}",
+                                   **hdrs}, expect=(206,))
+            assert r.body == data[lo:hi + 1]
+        st1 = er.hotread.cache.stats()
+        assert st1["hits"] > st0["hits"]
+    finally:
+        srv.stop()
+
+
+# -- combining mechanics ----------------------------------------------------
+
+def test_singleflight_coalesces_concurrent_fetches(hot_cfg):
+    sf = SingleFlight(lambda key: 0)
+    gate = threading.Event()
+    calls = []
+
+    def fetch():
+        calls.append(1)
+        gate.wait(5.0)
+        return b"payload"
+
+    results = []
+
+    def runner():
+        results.append(sf.do(("b", "o"), ("rd", None, (0, -1)), fetch,
+                             max_waiters=8))
+
+    ths = [threading.Thread(target=runner) for _ in range(4)]
+    ths[0].start()
+    time.sleep(0.1)                 # leader inside fetch
+    for t in ths[1:]:
+        t.start()
+    time.sleep(0.15)                # followers parked
+    gate.set()
+    for t in ths:
+        t.join()
+    assert len(calls) == 1, "fetch must run exactly once"
+    assert sorted(m for m, *_ in results) == \
+        ["join", "join", "join", "lead"]
+    assert all(r[1] == b"payload" for r in results)
+    lead = next(r for r in results if r[0] == "lead")
+    assert lead[3] == 3             # followers visible to admission
+
+
+def test_singleflight_sheds_past_queue_bound(hot_cfg):
+    sf = SingleFlight(lambda key: 0)
+    gate = threading.Event()
+    started = threading.Event()
+
+    def fetch():
+        started.set()
+        gate.wait(5.0)
+        return 1
+
+    out = []
+    lead_t = threading.Thread(
+        target=lambda: out.append(
+            sf.do(("b", "o"), "s", fetch, max_waiters=1)))
+    lead_t.start()
+    assert started.wait(5.0)
+    join_t = threading.Thread(
+        target=lambda: out.append(
+            sf.do(("b", "o"), "s", fetch, max_waiters=1)))
+    join_t.start()
+    time.sleep(0.1)                 # the single waiter seat is taken
+    mode, res, _, _ = sf.do(("b", "o"), "s", lambda: 2, max_waiters=1)
+    assert mode == "shed" and res is None
+    assert sf.snapshot()["shed"] == 1
+    gate.set()
+    lead_t.join()
+    join_t.join()
+    assert {m for m, *_ in out} == {"lead", "join"}
+
+
+def test_waiter_cancels_on_deadline_and_on_caller_death(hot_cfg):
+    sf = SingleFlight(lambda key: 0)
+    gate = threading.Event()
+    started = threading.Event()
+
+    def fetch():
+        started.set()
+        gate.wait(5.0)
+        return "slow"
+
+    lead_t = threading.Thread(
+        target=lambda: sf.do(("b", "o"), "c", fetch))
+    lead_t.start()
+    assert started.wait(5.0)
+    # deadline expiry: the waiter cancels OUT of the flight and the
+    # caller is told to read independently
+    mode, res, _, _ = sf.do(("b", "o"), "c", fetch, timeout=0.2)
+    assert mode == "cancelled" and res is None
+    assert sf.snapshot()["cancelled"] == 1
+    gate.set()
+    lead_t.join()
+    # no flight state survives the burst (zero owned threads, nothing
+    # to leak at shutdown — the batcher discipline)
+    assert sf.snapshot()["in_flight"] == 0
+
+
+def test_flight_exception_propagates_to_all_waiters(hot_cfg):
+    sf = SingleFlight(lambda key: 0)
+    gate = threading.Event()
+    started = threading.Event()
+
+    def fetch():
+        started.set()
+        gate.wait(5.0)
+        raise FileNotFoundError("gone")
+
+    outcomes = []
+
+    def run():
+        try:
+            sf.do(("b", "o"), "e", fetch)
+            outcomes.append("ok")
+        except FileNotFoundError:
+            outcomes.append("raised")
+
+    ths = [threading.Thread(target=run) for _ in range(3)]
+    ths[0].start()
+    assert started.wait(5.0)
+    for t in ths[1:]:
+        t.start()
+    time.sleep(0.1)
+    gate.set()
+    for t in ths:
+        t.join()
+    assert outcomes == ["raised"] * 3
+
+
+# -- stale-read impossibility ----------------------------------------------
+
+def test_no_stale_read_after_racing_overwrite(tmp_path, hot_cfg):
+    """The invalidate-before-visible drill: a writer loops monotonic
+    overwrites while readers hammer the same key through the plane —
+    any body read AFTER overwrite N acked must carry a sequence
+    >= the ack watermark at read start.  A cached window or a
+    straddling fill surviving an overwrite fails this immediately."""
+    er = _layer(tmp_path)
+    er.hotread.heat_fn = lambda: 100
+    er.make_bucket("race")
+    pad = b"x" * 2048
+
+    def body_for(seq: int) -> bytes:
+        return seq.to_bytes(8, "big") + pad
+
+    er.put_object("race", "k", body_for(0))
+    acked = [0]
+    stop = threading.Event()
+    errs: list = []
+
+    def writer():
+        try:
+            for seq in range(1, 200):
+                if stop.is_set():
+                    return
+                er.put_object("race", "k", body_for(seq))
+                acked[0] = seq      # published AFTER the PUT returned
+        except Exception as e:  # noqa: BLE001 — surfaces in assert
+            errs.append(e)
+        finally:
+            stop.set()
+
+    def reader():
+        try:
+            while not stop.is_set():
+                floor = acked[0]
+                _, data = er.get_object("race", "k")
+                got = int.from_bytes(data[:8], "big")
+                if got < floor:
+                    errs.append(AssertionError(
+                        f"stale read: saw {got} after {floor} acked"))
+                    stop.set()
+                    return
+        except Exception as e:  # noqa: BLE001 — surfaces in assert
+            errs.append(e)
+            stop.set()
+
+    ths = [threading.Thread(target=writer)] + \
+        [threading.Thread(target=reader) for _ in range(3)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=120)
+    assert not errs, errs
+    # the run actually exercised the plane
+    assert er.hotread.stats()["singleflight"]["flights"] > 0
+
+
+def test_overwrite_evicts_cached_windows_and_refills(tmp_path, hot_cfg):
+    er = _layer(tmp_path)
+    er.hotread.heat_fn = lambda: 100
+    er.make_bucket("evict")
+    er.put_object("evict", "k", b"old-body" * 512)
+    for _ in range(2):
+        er.get_object("evict", "k")     # fill + hit
+    assert er.hotread.cache.stats()["entries"] > 0
+    inv0 = er.hotread.cache.stats()["invalidations"]
+    er.put_object("evict", "k", b"new-body" * 512)
+    assert er.hotread.cache.stats()["invalidations"] > inv0
+    _, got = er.get_object("evict", "k")
+    assert got == b"new-body" * 512
+
+
+def test_delete_invalidates_and_marker_falls_through(tmp_path, hot_cfg):
+    from minio_tpu.objectlayer.interface import (MethodNotAllowed,
+                                                 ObjectNotFound)
+    er = _layer(tmp_path)
+    er.hotread.heat_fn = lambda: 100
+    er.make_bucket("del")
+    er.put_object("del", "k", b"doomed" * 1000)
+    er.get_object("del", "k")
+    er.get_object("del", "k")
+    er.delete_object("del", "k")
+    with pytest.raises(ObjectNotFound):
+        er.get_object("del", "k")
+    # versioned delete marker: the plane must fall through to the
+    # reference MethodNotAllowed path
+    er.put_object("del", "v", b"versioned" * 100,
+                  PutObjectOptions(versioned=True))
+    er.get_object("del", "v")
+    er.delete_object("del", "v",
+                     ObjectOptions(versioned=True))
+    with pytest.raises(MethodNotAllowed):
+        er.get_object("del", "v")
+
+
+# -- governor accounting ----------------------------------------------------
+
+def test_cache_bytes_charge_governor_and_release(tmp_path, hot_cfg):
+    er = _layer(tmp_path)
+    er.hotread.heat_fn = lambda: 100
+    er.make_bucket("gov")
+    base = GOVERNOR.inuse_bytes("cache")
+    er.put_object("gov", "k", b"z" * 8192)
+    er.get_object("gov", "k")
+    assert GOVERNOR.inuse_bytes("cache") > base
+    # disable via clear(): every cached byte returns to the governor
+    er.hotread.clear()
+    assert GOVERNOR.inuse_bytes("cache") == base
+    # refill, then an overwrite invalidation releases too
+    er.get_object("gov", "k")
+    assert GOVERNOR.inuse_bytes("cache") > base
+    er.put_object("gov", "k", b"w" * 8192)
+    assert GOVERNOR.inuse_bytes("cache") == base
+
+
+def test_cache_declines_fill_under_governor_pressure(tmp_path, hot_cfg):
+    er = _layer(tmp_path)
+    er.hotread.heat_fn = lambda: 100
+    er.make_bucket("full")
+    er.put_object("full", "k", b"q" * 16384)
+    limit0, retry0 = GOVERNOR.limit_bytes, GOVERNOR.retry_after_s
+    outer = GOVERNOR.charge(0, "test")
+    try:
+        GOVERNOR.configure(1024)
+        fills0 = er.hotread.cache.stats()["fills"]
+        _, data = er.get_object("full", "k")     # serves, no fill
+        assert data == b"q" * 16384
+        assert er.hotread.cache.stats()["fills"] == fills0
+        assert GOVERNOR.inuse_bytes("cache") == 0
+    finally:
+        GOVERNOR.configure(limit0, retry0)
+        outer.release()
+
+
+def test_lru_eviction_stays_under_max_bytes(tmp_path, hot_cfg):
+    er = _layer(tmp_path)
+    er.hotread.heat_fn = lambda: 100
+    hot_cfg.max_bytes = 64 * 1024
+    er.make_bucket("lru")
+    for i in range(8):
+        er.put_object("lru", f"k{i}", bytes([i]) * 16384)
+    for i in range(8):
+        er.get_object("lru", f"k{i}")
+    st = er.hotread.cache.stats()
+    assert st["bytes"] <= 64 * 1024
+    assert st["evictions"] > 0
+    assert GOVERNOR.inuse_bytes("cache") <= 64 * 1024
+    er.hotread.clear()
+
+
+def test_mesh_scaled_batch_charges_pipeline_kind(tmp_path, hot_cfg,
+                                                monkeypatch):
+    """The PR-11 deferred satellite: a stream batch the mesh widened
+    past the base charges the governor (kind=pipeline) for the
+    stream's lifetime, and past the watermark the read sheds with
+    MemoryPressure instead of allocating."""
+    from minio_tpu.objectlayer import erasure_object as eo
+    hot_cfg.enable = False          # pin the uncoalesced path
+    er = _layer(tmp_path)
+    er.make_bucket("mesh")
+    body = bytes(range(256)) * 256          # 64 KiB
+    er.put_object("mesh", "k", body)
+    monkeypatch.setattr(eo, "STREAM_BATCH_BYTES", 4096)
+    monkeypatch.setattr(er, "_stream_batch_size", lambda: 65536)
+    limit0, retry0 = GOVERNOR.limit_bytes, GOVERNOR.retry_after_s
+    try:
+        GOVERNOR.configure(0)               # accounting only
+        info, gen = er.get_object_reader("mesh", "k", 0, -1)
+        assert GOVERNOR.inuse_bytes("pipeline") > 0
+        data = b"".join(gen)
+        assert bytes(data) == body          # stream drained: released
+        assert GOVERNOR.inuse_bytes("pipeline") == 0
+        # an abandoned stream releases through close() too
+        _, gen2 = er.get_object_reader("mesh", "k", 0, -1)
+        assert GOVERNOR.inuse_bytes("pipeline") > 0
+        gen2.close()
+        assert GOVERNOR.inuse_bytes("pipeline") == 0
+        # past the watermark: shed, not allocate
+        GOVERNOR.configure(8192)
+        with pytest.raises(MemoryPressure):
+            er.get_object_reader("mesh", "k", 0, -1)
+        assert GOVERNOR.inuse_bytes("pipeline") == 0
+    finally:
+        GOVERNOR.configure(limit0, retry0)
+
+
+# -- config / live reload / observability -----------------------------------
+
+def test_cache_config_parses_and_rejects_bad_values():
+    class FakeCfg:
+        def __init__(self, kv):
+            self.kv = kv
+
+        def get(self, subsys, key):
+            return self.kv[key]
+
+    cfg = CacheConfig()
+    cfg.load(FakeCfg({"enable": "on", "max_bytes": "1048576",
+                      "heat_threshold": "5", "singleflight_queue": "9",
+                      "window_bytes": "2097152"}))
+    assert (cfg.enable, cfg.max_bytes, cfg.heat_threshold,
+            cfg.singleflight_queue, cfg.window_bytes) == \
+        (True, 1048576, 5, 9, 2097152)
+    # a bad value leaves the WHOLE config untouched (atomic parse)
+    cfg.load(FakeCfg({"enable": "off", "max_bytes": "not-a-number",
+                      "heat_threshold": "1", "singleflight_queue": "1",
+                      "window_bytes": "65536"}))
+    assert cfg.enable is True and cfg.max_bytes == 1048576
+
+
+def test_admin_reload_cache_config_live(tmp_path, hot_cfg):
+    from minio_tpu.admin.client import AdminClient
+    er = _layer(tmp_path)
+    srv = S3Server(er, access_key="ra", secret_key="rs")
+    srv.start()
+    try:
+        er.hotread.heat_fn = lambda: 100
+        hotread.CONFIG.heat_threshold = 1
+        c = S3Client(srv.endpoint, "ra", "rs")
+        c.make_bucket("live")
+        c.put_object("live", "k", b"hot" * 4096)
+        c.get_object("live", "k")
+        c.get_object("live", "k")
+        assert er.hotread.cache.stats()["entries"] > 0
+        adm = AdminClient(srv.endpoint, "ra", "rs")
+        adm.set_config_kv("cache", "enable", "off")
+        # disable released the cached bytes and stops serving from it
+        assert er.hotread.cache.stats()["entries"] == 0
+        assert GOVERNOR.inuse_bytes("cache") == 0
+        r = c.get_object("live", "k")
+        assert r.body == b"hot" * 4096
+        assert "x-minio-tpu-cache" not in r.headers
+        adm.set_config_kv("cache", "enable", "on")
+        c.get_object("live", "k")
+        r = c.get_object("live", "k")
+        assert r.headers.get("x-minio-tpu-cache") == "hit"
+    finally:
+        srv.stop()
+
+
+def test_cache_status_header_and_scrape_families(tmp_path, hot_cfg):
+    from minio_tpu.admin import metrics as admetrics
+    er = _layer(tmp_path)
+    srv = S3Server(er, access_key="mk", secret_key="ms")
+    srv.start()
+    try:
+        er.hotread.heat_fn = lambda: 100
+        hotread.CONFIG.heat_threshold = 1
+        c = S3Client(srv.endpoint, "mk", "ms")
+        c.make_bucket("obs")
+        c.put_object("obs", "k", b"scraped" * 1024)
+        r1 = c.get_object("obs", "k")
+        assert r1.headers.get("x-minio-tpu-cache") == "miss"
+        r2 = c.get_object("obs", "k")
+        assert r2.headers.get("x-minio-tpu-cache") == "hit"
+        text = admetrics.render(er, api_stats=srv.api_stats)
+        for fam in ("mt_cache_hits_total", "mt_cache_misses_total",
+                    "mt_cache_fills_total", "mt_singleflight_flights_total",
+                    "mt_cache_entries", "mt_cache_bytes"):
+            assert f"# TYPE {fam} " in text, fam
+    finally:
+        srv.stop()
+
+
+def test_idle_plane_emits_no_gauge_families(tmp_path):
+    from minio_tpu.admin import metrics as admetrics
+    er = _layer(tmp_path, n=4)
+    text = admetrics.render(er)
+    assert "mt_cache_entries" not in text
+    assert "mt_cache_bytes" not in text
+
+
+def test_full_get_of_window_spanner_falls_through(tmp_path, hot_cfg):
+    """A full GET of an object bigger than one window must come back
+    complete through the uncoalesced streaming path (one advisory
+    window probe at most, then the size hint routes around the
+    plane)."""
+    er = _layer(tmp_path)
+    er.hotread.heat_fn = lambda: 100
+    hot_cfg.window_bytes = 128 * 1024
+    er.make_bucket("span")
+    body = bytes((i * 31) % 256 for i in range(512 * 1024))
+    er.put_object("span", "big", body)
+    for _ in range(2):
+        _, got = er.get_object("span", "big")
+        assert got == body
+    # ranged reads INSIDE one window of the spanner still cache
+    _, part = er.get_object("span", "big", 130 * 1024, 1000)
+    _, part2 = er.get_object("span", "big", 130 * 1024, 1000)
+    assert part == part2 == body[130 * 1024:130 * 1024 + 1000]

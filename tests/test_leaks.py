@@ -127,7 +127,10 @@ def test_select_disconnect_releases_governor_and_threads(tmp_path):
         assert after <= baseline + 2, (baseline, after)
     finally:
         srv.stop()
-    assert GOVERNOR.inuse_bytes() == 0
+    # request-scoped charges settle; stop() also released any resident
+    # hot-read cache bytes, so the total is zero too
+    assert GOVERNOR.transient_bytes() == 0
+    assert GOVERNOR.inuse_bytes("cache") == 0
 
 
 def test_egress_workers_stop_with_server(tmp_path, monkeypatch):
@@ -411,6 +414,98 @@ def test_device_md5_state_does_not_survive_server_stop(tmp_path,
             "device-MD5 bucket state survived server stop"
     finally:
         md5fast.set_backend("auto")
+
+
+def test_diskcache_threads_join_on_close_and_server_stop(tmp_path):
+    """The mt-diskcache-* thread discipline (PR-10 rule, wired for
+    real this PR): the writeback sender and the periodic GC sweeper
+    are named, daemonized, and JOINED — by an explicit close() and by
+    S3Server.stop() walking wrapped layers."""
+    from minio_tpu.objectlayer.diskcache import CacheObjects
+
+    def diskcache_threads():
+        return [t.name for t in threading.enumerate()
+                if t.is_alive() and t.name.startswith("mt-diskcache")]
+
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"dc{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    inner = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                           backend="numpy")
+    # direct close(): wb thread woken from its queue park, gc thread
+    # woken from its interval wait, both joined
+    cache = CacheObjects(inner, [str(tmp_path / "cd0")],
+                         writeback=True, gc_interval_s=0.05)
+    cache.make_bucket("dcache")
+    cache.put_object("dcache", "o", b"wb-bytes")
+    cache.flush_writeback()
+    assert diskcache_threads(), "wb/gc threads never started"
+    cache.close()
+    deadline = time.monotonic() + 5.0
+    while diskcache_threads() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert not diskcache_threads(), diskcache_threads()
+    # server stop path: a CacheObjects-wrapped layer's threads die
+    # WITH the server (stop() walks .inner chains and closes)
+    cache2 = CacheObjects(inner, [str(tmp_path / "cd1")],
+                          gc_interval_s=0.05)
+    srv = S3Server(cache2, access_key="dk", secret_key="ds")
+    srv.start()
+    try:
+        assert diskcache_threads(), "gc sweeper never started"
+    finally:
+        srv.stop()
+    deadline = time.monotonic() + 5.0
+    while diskcache_threads() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert not diskcache_threads(), diskcache_threads()
+
+
+def test_hot_read_plane_owns_no_threads_and_releases_bytes(tmp_path):
+    """The hot-read plane's shutdown contract: leaders are borrowed
+    caller threads (nothing to join), and server stop releases every
+    cached byte back to the memory governor."""
+    from minio_tpu.objectlayer import hotread
+    from minio_tpu.utils.memgov import GOVERNOR
+    cfg = hotread.CONFIG
+    saved = (cfg.enable, cfg.heat_threshold, cfg._loaded)
+    cfg.enable, cfg.heat_threshold, cfg._loaded = True, 1, True
+    try:
+        disks = []
+        for i in range(4):
+            d = tmp_path / f"hr{i}"
+            d.mkdir()
+            disks.append(XLStorage(str(d)))
+        layer = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                               backend="numpy")
+        # warm the shared layer pool to FULL size first (lazy ramp-up
+        # during the GETs below would read as a leak)
+        layer.make_bucket("warm")
+        layer.put_object("warm", "o", b"w")
+        list(layer._pool.map(time.sleep,
+                             [0.05] * layer._pool._max_workers))
+        before = _settled_thread_count()
+        srv = S3Server(layer, access_key="hk", secret_key="hs")
+        srv.start()
+        try:
+            layer.hotread.heat_fn = lambda: 100
+            c = S3Client(srv.endpoint, "hk", "hs")
+            c.make_bucket("hotleak")
+            c.put_object("hotleak", "o", b"h" * 4096)
+            for _ in range(3):
+                assert c.get_object("hotleak", "o").body == b"h" * 4096
+            assert layer.hotread.cache.stats()["entries"] > 0
+            assert GOVERNOR.inuse_bytes("cache") > 0
+        finally:
+            srv.stop()
+        # cached bytes released with the server; no plane threads ever
+        assert GOVERNOR.inuse_bytes("cache") == 0
+        assert layer.hotread.cache.stats()["entries"] == 0
+        assert _settled_thread_count() <= before + 2
+    finally:
+        (cfg.enable, cfg.heat_threshold, cfg._loaded) = saved
 
 
 def test_rpc_server_stop_closes_listener(tmp_path):

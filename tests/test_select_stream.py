@@ -354,7 +354,9 @@ def test_multipart_bigger_than_watermark_completes(client):
         assert len(client.get_object("selb", "big.mp").body) == 10 << 20
     finally:
         GOVERNOR.configure(0)
-    assert GOVERNOR.inuse_bytes() == 0
+    # transient (request-scoped) charges settle; the hot-read cache's
+    # resident kind may legitimately hold warm windows here
+    assert GOVERNOR.transient_bytes() == 0
 
 
 def test_governor_sheds_select_with_503_retry_after(client, server):
@@ -372,7 +374,7 @@ def test_governor_sheds_select_with_503_retry_after(client, server):
         assert ei.value.code == "SlowDown"
     finally:
         GOVERNOR.configure(0)
-    assert GOVERNOR.inuse_bytes() == 0
+    assert GOVERNOR.transient_bytes() == 0
     # recovered: the same request succeeds once pressure clears
     r = client.request("POST", "/selb/small.csv", "select&select-type=2",
                        _req("SELECT * FROM S3Object", "<CSV/>"))

@@ -364,6 +364,45 @@ def test_select_storm_smoke_memory_slo(tmp_path, monkeypatch):
                for m in by_metric)
 
 
+def test_hot_get_storm_smoke_engages_hot_read_plane(tmp_path):
+    """The hot-read plane's target scenario in miniature: zipf-keyed
+    GET-heavy workers with overwrite churn on a real cluster, a drive
+    death riding along — SLO rows pass AND the live scrape proves the
+    plane engaged (cache hits / coalesced flights), the cached bytes
+    are visible to the memory governor, and the strict read-your-write
+    digest oracle saw ZERO stale reads across the mid-storm
+    overwrites."""
+    from minio_tpu.objectlayer import hotread
+    from minio_tpu.soak.workload import MIXES as _mixes
+    cfg = hotread.CONFIG
+    saved = (cfg.enable, cfg.heat_threshold, cfg._loaded)
+    cfg.enable, cfg.heat_threshold, cfg._loaded = True, 2, True
+    try:
+        d = 3.0
+        E = soak_chaos.Event
+        sc = soak_report.Scenario(
+            name="hot_get_storm_smoke",
+            mix=_mixes["hot_get_storm"],
+            timeline=[E(0.2 * d, "drive_kill", drive=0),
+                      E(0.6 * d, "drive_return", drive=0)],
+            duration_s=d, workers=4,
+            budget=soak_slo.Budget(converge_timeout_s=30.0,
+                                   max_error_rate=0.10,
+                                   require_hot_read=True))
+        rows = soak_report.run_scenario(sc, str(tmp_path / "hotstorm"))
+        by_metric = {r["metric"]: r for r in rows}
+        failed = [r for r in rows if not r["passed"]]
+        assert not failed, failed
+        engaged = by_metric["hot_read_engaged"]
+        assert engaged["value"] > 0, engaged
+        assert by_metric["cache_bytes_accounted"]["value"] > 0
+        assert by_metric["stale_reads"]["value"] == 0
+        # the storm actually stormed hot: GetObject dominated
+        assert any(m.startswith("p99:GetObject") for m in by_metric)
+    finally:
+        (cfg.enable, cfg.heat_threshold, cfg._loaded) = saved
+
+
 # -- the slow-marked full matrix (bench.py soak leg) -----------------------
 
 def test_huge_put_smoke_mesh_sharded_byte_correct(tmp_path):
