@@ -1459,6 +1459,113 @@ def _bench_end_to_end_put() -> dict | None:
             shutil.rmtree(tmp, ignore_errors=True)
 
 
+def _bench_xray() -> dict | None:
+    """``bench.py xray`` — ns/request overhead of the X-ray stage
+    clock + flight-recorder ring on the GET and PUT hot paths, through
+    the REAL S3 server (ISSUE 15 satellite).  A/B per round: the same
+    request loop with the plane armed (stages.ENABLED + flight ring)
+    vs disabled (no clock minted, ring append no-opped) — the target
+    is an overhead indistinguishable from run-to-run noise, reported
+    beside it."""
+    import shutil
+    import statistics
+    import tempfile
+
+    try:
+        from minio_tpu.obs import stages as _stages
+        from minio_tpu.objectlayer.erasure_object import ErasureObjects
+        from minio_tpu.s3.client import S3Client
+        from minio_tpu.s3.server import S3Server
+        from minio_tpu.storage.xl_storage import XLStorage
+    except Exception as e:  # noqa: BLE001 — optional leg
+        import sys as _sys
+        print(f"xray leg failed to import: {e!r}", file=_sys.stderr)
+        return None
+    root = "/dev/shm" if os.path.isdir("/dev/shm") and \
+        os.access("/dev/shm", os.W_OK) else None
+    tmp = tempfile.mkdtemp(prefix="xraybench-", dir=root)
+    saved_enabled = _stages.ENABLED
+    srv = None
+    try:
+        disks = []
+        for i in range(4):
+            d = os.path.join(tmp, f"d{i}")
+            os.makedirs(d)
+            disks.append(XLStorage(d))
+        layer = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                               backend="numpy")
+        srv = S3Server(layer, access_key="bk", secret_key="bs")
+        srv.start()
+        c = S3Client(srv.endpoint, "bk", "bs")
+        c.make_bucket("xbench")
+        body = os.urandom(64 * 1024)
+        c.put_object("xbench", "warm", body)
+        c.get_object("xbench", "warm")
+        real_record = srv.flightrec.record
+        reps, rounds = 60, 5
+
+        def one_round(op: str) -> float:
+            t0 = time.perf_counter()
+            for i in range(reps):
+                if op == "put":
+                    c.put_object("xbench", f"o{i % 8}", body)
+                else:
+                    c.get_object("xbench", "warm")
+            return (time.perf_counter() - t0) / reps * 1e9  # ns/req
+
+        out: dict = {"reps": reps, "rounds": rounds,
+                     "body_bytes": len(body),
+                     "drives_root": root or "disk"}
+        for op in ("get", "put"):
+            on: list[float] = []
+            off: list[float] = []
+            for _ in range(rounds):
+                _stages.ENABLED = True
+                srv.flightrec.record = real_record
+                on.append(one_round(op))
+                _stages.ENABLED = False
+                srv.flightrec.record = lambda *a, **k: None
+                off.append(one_round(op))
+            med_on = statistics.median(on)
+            med_off = statistics.median(off)
+            noise = max(off) - min(off)
+            overhead = med_on - med_off
+            out[op] = {
+                "ns_per_request_on": round(med_on),
+                "ns_per_request_off": round(med_off),
+                "overhead_ns": round(overhead),
+                "run_to_run_noise_ns": round(noise),
+                "unmeasurable": overhead <= noise,
+            }
+        return out
+    except Exception as e:  # noqa: BLE001 — optional leg
+        import sys as _sys
+        print(f"xray leg failed: {e!r}", file=_sys.stderr)
+        return None
+    finally:
+        _stages.ENABLED = saved_enabled
+        if srv is not None:
+            try:
+                srv.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def xray_main() -> None:
+    """``bench.py xray`` — run the X-ray overhead leg standalone and
+    print ONE BENCH_*-shaped JSON line."""
+    stats = _bench_xray()
+    if stats is None:
+        raise SystemExit("xray leg unavailable")
+    print(json.dumps({
+        "metric": "xray_overhead_ns_per_get",
+        "value": stats["get"]["overhead_ns"],
+        "unit": "ns/request",
+        "detail": stats,
+    }))
+
+
 def host_main() -> None:
     """``bench.py host`` — the host-measurable legs only (BASELINE
     configs 1-2, the e2e PUT pipeline, md5 lanes/backends, codec
@@ -1469,6 +1576,7 @@ def host_main() -> None:
     cfg12 = _bench_baseline_configs()
     codec_batching = _bench_codec_batching()
     hot_get = _bench_hot_get()
+    xray = _bench_xray()
     c1 = (cfg12 or {}).get("config1_4+2_put_64MiB_GiBps")
     print(json.dumps({
         "metric": "baseline_config1_4+2_put_64MiB_GiBps",
@@ -1483,6 +1591,7 @@ def host_main() -> None:
              else "e2e_put_256x4MiB_nofsync"): e2e,
             "codec_batching": codec_batching,
             "hot_get": hot_get,
+            "xray": xray,
             "methodology": "host legs only (bench.py host); device "
                            "kernel legs need a TPU",
         },
@@ -1536,6 +1645,8 @@ if __name__ == "__main__":
         codec_batching_main()
     elif len(_sys.argv) > 1 and _sys.argv[1] == "hot_get":
         hot_get_main()
+    elif len(_sys.argv) > 1 and _sys.argv[1] == "xray":
+        xray_main()
     elif len(_sys.argv) > 1 and _sys.argv[1] == "host":
         host_main()
     else:

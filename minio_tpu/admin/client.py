@@ -57,8 +57,41 @@ class AdminClient:
     def data_usage_info(self) -> dict:
         return self._call("GET", "datausageinfo")
 
-    def health_info(self) -> dict:
-        return self._call("GET", "healthinfo")
+    def health_info(self, scope: str = "") -> dict:
+        """Node health/OBD document; ``scope="cluster"`` fans out to
+        every peer and folds the per-node documents into one reply
+        (a downed peer is marked offline, never fails the call)."""
+        return self._call("GET", "healthinfo",
+                          "scope=cluster" if scope == "cluster" else "")
+
+    def xray(self, api: str = "", min_duration_ms: float = 0.0,
+             errors_only: bool = False, n: int = 100,
+             local: bool = False, snapshot: bool = False) -> dict:
+        """Flight-recorder query (request X-ray): recent per-request
+        records with their stage timelines, peer-aggregated unless
+        ``local``."""
+        q = [f"n={n}"]
+        if api:
+            q.append(f"api={api}")
+        if min_duration_ms:
+            q.append(f"min-duration-ms={min_duration_ms}")
+        if errors_only:
+            q.append("errors=true")
+        if local:
+            q.append("local=true")
+        if snapshot:
+            q.append("snapshot=true")
+        return self._call("GET", "xray", "&".join(q))
+
+    def list_forensics(self, local: bool = False) -> dict:
+        """Resident forensic bundles (name/size/trigger) per node."""
+        return self._call("GET", "forensics",
+                          "local=true" if local else "")
+
+    def trigger_forensics(self) -> dict:
+        """Manually write one forensic bundle on this node (the
+        on-demand `mc admin obd` support-bundle shape)."""
+        return self._call("POST", "forensics")
 
     def service_stop(self) -> dict:
         return self._call("POST", "service", "action=stop")

@@ -455,8 +455,62 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
             return _speedtest(h, srv, route, q1)
         if route == "healthinfo" and h.command == "GET":
             from ..obs import healthinfo
-            return send_json(healthinfo.collect(
-                _drive_paths(srv), perf=q1.get("perf") == "true")) or True
+            local = healthinfo.collect(
+                _drive_paths(srv), perf=q1.get("perf") == "true")
+            local["node"] = srv.node_name
+            local["system"] = _node_system_info(srv)
+            if q1.get("scope") != "cluster":
+                return send_json(local) or True
+            # cluster OBD document (cmd/healthinfo.go + `mc admin obd`
+            # fan-out): every peer's health section folded into one
+            # reply; a downed peer is MARKED (error + offline), never
+            # fails the call
+            nodes = [local]
+            if srv.peers is not None:
+                for ep, r, err in srv.peers.call_all(
+                        "healthinfo_collect", timeout_s=15.0,
+                        perf=q1.get("perf") == "true"):
+                    nodes.append(
+                        {"node": ep, "error": err, "offline": True}
+                        if err or not isinstance(r, dict) else r)
+            return send_json({"scope": "cluster", "version": "1",
+                              "nodes": nodes}) or True
+        if route == "xray" and h.command == "GET":
+            # request X-ray: flight-recorder query (filter by api /
+            # min-duration / errors-only), peer-aggregated like `top`.
+            # ?snapshot=true adds a fresh system snapshot per node.
+            params = _xray_params(q1)
+            out = xray_reply(srv, **params)
+            if srv.peers is not None and q1.get("local") != "true":
+                out["peers"] = [
+                    {"node": ep, "error": err} if err else r
+                    for ep, r, err in srv.peers.call_all(
+                        "xray_query", timeout_s=10.0, **params)]
+            return send_json(out) or True
+        if route == "forensics" and h.command == "GET":
+            # resident forensic bundles on this node (and, unless
+            # ?local=true, every peer): names/sizes/triggers — the
+            # support-bundle inventory an operator collects after a
+            # breach
+            out = forensic_inventory(srv)
+            if srv.peers is not None and q1.get("local") != "true":
+                out["peers"] = [
+                    {"node": ep, "error": err} if err else r
+                    for ep, r, err in srv.peers.call_all(
+                        "forensic_list", timeout_s=10.0)]
+            return send_json(out) or True
+        if route == "forensics" and h.command == "POST":
+            # manual bundle trigger (`mc admin obd` on demand): writes
+            # synchronously so the reply can name the bundle
+            fx = getattr(srv, "forensic", None)
+            if fx is None:
+                return send_json(
+                    {"error": "forensic engine disabled"}, 400) or True
+            fired = fx.fire("manual", {"by": h.access_key}, sync=True)
+            return send_json({
+                "fired": bool(fired),
+                "cooldown_s": fx.cooldown_s if not fired else 0,
+                "bundles": fx.bundles()}) or True
         if route == "netperf" and h.command == "POST":
             # madmin NetPerf analog (peerRESTMethodNetInfo): throughput
             # to every peer over the real authed internode transport.
@@ -513,6 +567,72 @@ def _drive_paths(srv) -> list:
     return local_drive_paths(srv.layer)
 
 
+def _node_system_info(srv) -> dict:
+    """The live-process section of a health/OBD document: flight-ring
+    stats, breaker/governor state, forensic inventory — shared by the
+    local healthinfo leg and the peer RPC so the merged cluster
+    document is shape-identical per node."""
+    from ..obs.flightrec import system_snapshot
+    fx = getattr(srv, "forensic", None)
+    rec = getattr(srv, "flightrec", None)
+    return {
+        **system_snapshot(brief=True),
+        "flightrec": rec.stats() if rec is not None else None,
+        "forensics": {"bundles": fx.bundles(), "dumped": fx.dumped}
+        if fx is not None else None,
+    }
+
+
+def _xray_params(q1) -> dict:
+    """Defensive query parsing for the xray filters — ONE parse shared
+    by the local leg and the peer fan-out, so a malformed ?n= can
+    never 500 only on clustered servers."""
+    try:
+        limit = max(1, min(int(q1.get("n", 100) or 100), 1000))
+    except (TypeError, ValueError):
+        limit = 100
+    try:
+        min_ms = float(q1.get("min-duration-ms", 0) or 0)
+    except (TypeError, ValueError):
+        min_ms = 0.0
+    return {"api": q1.get("api", ""), "min_duration_ms": min_ms,
+            "errors_only": q1.get("errors") == "true", "limit": limit,
+            "snapshot": q1.get("snapshot") == "true"}
+
+
+def xray_reply(srv, api: str = "", min_duration_ms: float = 0.0,
+               errors_only: bool = False, limit: int = 100,
+               snapshot: bool = False) -> dict:
+    """One node's xray reply — THE builder; the admin route and the
+    peer RPC both call it, so the per-node shapes can never drift
+    (the _node_system_info discipline)."""
+    rec = getattr(srv, "flightrec", None)
+    try:
+        limit = max(1, min(int(limit), 1000))
+    except (TypeError, ValueError):
+        limit = 100
+    out = {
+        "node": srv.node_name,
+        "stats": rec.stats() if rec is not None else None,
+        "records": rec.query(api=api, min_duration_ms=min_duration_ms,
+                             errors_only=errors_only, limit=limit)
+        if rec is not None else [],
+    }
+    if rec is not None and snapshot:
+        out["snapshot"] = rec.snapshot_now(brief=True)
+    return out
+
+
+def forensic_inventory(srv) -> dict:
+    """One node's forensic-bundle inventory — shared by the admin
+    ``forensics`` route and the peer RPC."""
+    fx = getattr(srv, "forensic", None)
+    return {"node": srv.node_name,
+            "dir": fx.dir if fx is not None else "",
+            "bundles": fx.bundles() if fx is not None else [],
+            "dumped": fx.dumped if fx is not None else 0}
+
+
 def _render_local(srv, node=None) -> str:
     """One node's scrape with every live subsystem attached — THE
     render call (plain scrape, federated local leg, and the peer RPC
@@ -525,7 +645,8 @@ def _render_local(srv, node=None) -> str:
         replication=getattr(srv, "replication", None),
         crawler=getattr(srv, "crawler", None), node=node,
         egress=getattr(srv, "egress", None),
-        mrf=getattr(srv, "mrf", None))
+        mrf=getattr(srv, "mrf", None),
+        flightrec=getattr(srv, "flightrec", None))
 
 
 _CLUSTER_SCRAPE_TTL_S = 2.0
@@ -964,6 +1085,10 @@ def _config(h, srv, route, q1, payload, send_json) -> bool:
             # plane (point at / away from an OPA endpoint, retune its
             # timeout) without a restart
             srv.reload_policy_config()
+        if parts[1] == "forensic":
+            # retune the forensic trigger engine (thresholds,
+            # cooldown, bundle-dir bounds) on the live server
+            srv.reload_forensic_config()
         if parts[1] in ("logger_webhook", "audit_webhook") \
                 or parts[1].startswith("notify_"):
             # rebuild the egress targets live: repointed endpoints and

@@ -103,7 +103,7 @@ def _fmt_labels(labels: tuple, extra: str = "") -> str:
 
 def render(layer=None, healer=None, config=None, api_stats=None,
            replication=None, crawler=None, node=None,
-           egress=None, mrf=None) -> str:
+           egress=None, mrf=None, flightrec=None) -> str:
     """Prometheus text format: counters + histograms + live gauges.
 
     ``config`` (a kvconfig Config) supplies the slow-drive knobs at
@@ -234,6 +234,11 @@ def render(layer=None, healer=None, config=None, api_stats=None,
     if egress is not None:
         try:
             lines += _egress_metrics(egress)
+        except Exception:  # noqa: BLE001 — a scrape must never fail
+            pass
+    if flightrec is not None:
+        try:
+            lines += _flight_gauges(flightrec)
         except Exception:  # noqa: BLE001 — a scrape must never fail
             pass
     text = "\n".join(lines) + "\n"
@@ -689,6 +694,29 @@ def _memgov_gauges() -> list[str]:
                                      "cache", "pipeline"}):
         lbl = _fmt_labels((("kind", kind),))
         lines.append(f"mt_mem_inuse_bytes{lbl} {inuse.get(kind, 0)}")
+    return lines
+
+
+def _flight_gauges(flightrec) -> list[str]:
+    """Flight-recorder families (obs/flightrec.py): ring depths and
+    lifetime record counters from the server's recorder, computed at
+    scrape time.  Idle contract: a recorder that never recorded a
+    request emits no family at all.  ``mt_forensic_dumps_total`` (the
+    bundle counter) is a plain process counter ticked at trigger
+    time."""
+    st = flightrec.stats()
+    if not st["recordsTotal"]:
+        return []
+    lines = ["# TYPE mt_flight_ring_depth gauge"]
+    for ring in ("requests", "errors", "snapshots"):
+        lbl = _fmt_labels((("ring", ring),))
+        lines.append(f"mt_flight_ring_depth{lbl} {st[ring]}")
+    lines += [
+        "# TYPE mt_flight_records_total counter",
+        f"mt_flight_records_total {st['recordsTotal']}",
+        "# TYPE mt_flight_errors_total counter",
+        f"mt_flight_errors_total {st['errorsTotal']}",
+    ]
     return lines
 
 

@@ -590,6 +590,70 @@ class KvconfigDriftRule(Rule):
                    for c in consts)
 
 
+# -- obs docs drift ----------------------------------------------------------
+
+
+class ObsDocsDriftRule(Rule):
+    id = "obs-docs-drift"
+    description = ("every X-ray stage name emitted in code "
+                   "(``_stages.stage/add/add_async`` call sites + the "
+                   "``STAGE_NAMES`` catalog) and every "
+                   "``mt_{s3_stage,forensic,flight}_*`` metric family "
+                   "literal must appear in docs/observability.md — an "
+                   "operator reading the stage/family catalog must be "
+                   "able to trust it is complete")
+
+    _FAMILY_RE = re.compile(r"^mt_(?:s3_stage|forensic|flight)_\w+$")
+
+    def check_tree(self, mods: list[Module], repo: str):
+        import os
+        doc_path = os.path.join(repo, "docs", "observability.md")
+        try:
+            with open(doc_path, encoding="utf-8") as fh:
+                doc = fh.read()
+        except OSError:
+            doc = ""
+        for mod in mods:
+            for lineno, kind, token in self._tokens(mod):
+                # anchored on the catalog's own rendering (a backticked
+                # token): plain substring membership would be vacuously
+                # satisfied by prose ('auth' inside 'authorization')
+                if f"`{token}" not in doc:
+                    yield Finding(
+                        mod.rel, lineno, self.id,
+                        f"{kind} {token!r} is emitted here but absent "
+                        f"from docs/observability.md (stage/metrics "
+                        f"catalog; list it as a backticked `{token}` "
+                        f"entry)")
+
+    @classmethod
+    def _tokens(cls, mod: Module):
+        """(lineno, kind, token) for stage names at ``_stages.stage/
+        add/add_async`` call sites, entries of a ``STAGE_NAMES``
+        tuple, and mt_{s3_stage,forensic,flight}_* family literals."""
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("stage", "add", "add_async") and \
+                    _last_segment(node.func.value).lstrip("_") \
+                    == "stages" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                yield node.lineno, "stage name", node.args[0].value
+            elif isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "STAGE_NAMES"
+                    for t in node.targets) and \
+                    isinstance(node.value, (ast.Tuple, ast.List)):
+                for el in node.value.elts:
+                    if isinstance(el, ast.Constant) and \
+                            isinstance(el.value, str):
+                        yield el.lineno, "stage name", el.value
+            elif isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    cls._FAMILY_RE.match(node.value):
+                yield node.lineno, "metric family", node.value
+
+
 # -- tls discipline ----------------------------------------------------------
 
 
@@ -737,6 +801,7 @@ ALL_RULES = [
     ThreadDisciplineRule,
     SwallowedExceptionRule,
     KvconfigDriftRule,
+    ObsDocsDriftRule,
     TlsDisciplineRule,
     NamedSkipRule,
 ]

@@ -295,14 +295,19 @@ class ErasureObjects(MultipartOps, ObjectLayer):
 
     @staticmethod
     def _with_request_id(run):
-        """Carry the caller's request ID into pool threads: contextvars
-        do not cross thread boundaries, and pool workers are REUSED —
-        setting unconditionally (even to "") also clears a previous
-        request's ID, so per-drive spans never mislabel."""
+        """Carry the caller's request ID (and its X-ray stage clock)
+        into pool threads: contextvars do not cross thread boundaries,
+        and pool workers are REUSED — setting unconditionally (even to
+        ""/None) also clears a previous request's context, so per-drive
+        spans never mislabel and stage detail never lands on the wrong
+        request."""
+        from ..obs import stages as _stages
         rid = _trace.get_request_id()
+        clock = _stages.current()
 
         def run_ctx(x):
             _trace.set_request_id(rid)
+            _stages.set_clock(clock)
             return run(x)
 
         return run_ctx
@@ -534,28 +539,33 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 checksums=[ChecksumInfo(1, self.bitrot_algo)]),
             fresh=True)
 
-        framed = self._encode_and_frame(data, m, fi)
+        from ..obs import stages as _stages
+        with _stages.stage("encode"):
+            framed = self._encode_and_frame(data, m, fi)
         inline = size <= self.inline_threshold
         shuffled = meta.shuffle_disks(self.disks, distribution)
         lk = self.ns_lock.new_lock(bucket, object_name)
-        lk.lock(write=True)  # cmd/erasure-object.go:729-735 nsLock
+        with _stages.stage("lock_wait"):
+            lk.lock(write=True)  # cmd/erasure-object.go:729-735 nsLock
         try:
-            if etag_future is not None and not inline \
-                    and self._pipeline_on():
-                # overlapped commit: the writer plane lands the part
-                # bytes in their final data dirs WHILE the md5 still
-                # runs; only the xl.meta version merge waits
-                # for the digest.  Without this the hash overlapped
-                # encode alone and the whole drive fan-out trailed it
-                # serially — the dominant serial residue of BENCH_r05.
-                return self._commit_put_overlapped(
-                    bucket, object_name, fi, framed, shuffled,
-                    etag_future, opts, mod_time, size)
-            if etag_future is not None:
-                self._stamp_etag(fi, etag_future.result(), opts, size,
-                                 mod_time)
-            return self._commit_put(bucket, object_name, fi, framed, inline,
-                                    shuffled)
+            with _stages.stage("drive_commit"):
+                if etag_future is not None and not inline \
+                        and self._pipeline_on():
+                    # overlapped commit: the writer plane lands the
+                    # part bytes in their final data dirs WHILE the
+                    # md5 still runs; only the xl.meta version merge
+                    # waits for the digest.  Without this the hash
+                    # overlapped encode alone and the whole drive
+                    # fan-out trailed it serially — the dominant
+                    # serial residue of BENCH_r05.
+                    return self._commit_put_overlapped(
+                        bucket, object_name, fi, framed, shuffled,
+                        etag_future, opts, mod_time, size)
+                if etag_future is not None:
+                    self._stamp_etag(fi, etag_future.result(), opts,
+                                     size, mod_time)
+                return self._commit_put(bucket, object_name, fi, framed,
+                                        inline, shuffled)
         finally:
             lk.unlock()
 
@@ -875,19 +885,27 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         through utils/bufpool when the host fast path runs.  Returns
         (framed_rows, release_cb) — release fires once every drive
         wrote the batch (memory stays O(depth x batch))."""
+        from ..obs import stages as _stages
         t0 = time.perf_counter()
         try:
-            if len(chunk) and self._framed_fast_path(m):
-                codec = self._codec_for(m)
-                buf = bufpool.GLOBAL.acquire(
-                    codec.framed_shape(len(chunk)))
-                framed2d = codec.encode_object_framed(chunk, out=buf)
-                if bitrot.fill_framed(framed2d, fi.erasure.shard_size(),
-                                      self.bitrot_algo):
-                    return list(framed2d), \
-                        (lambda b=buf: bufpool.GLOBAL.release(b))
-                bufpool.GLOBAL.release(buf)   # native hash missing
-            return self._encode_and_frame(chunk, m, fi), None
+            # a real stage frame (not a finally-add): time the codec
+            # batcher parks inside (batch_wait) is subtracted as child
+            # time, keeping the serial reconciliation exact on device
+            # backends too
+            with _stages.stage("encode"):
+                if len(chunk) and self._framed_fast_path(m):
+                    codec = self._codec_for(m)
+                    buf = bufpool.GLOBAL.acquire(
+                        codec.framed_shape(len(chunk)))
+                    framed2d = codec.encode_object_framed(chunk,
+                                                          out=buf)
+                    if bitrot.fill_framed(framed2d,
+                                          fi.erasure.shard_size(),
+                                          self.bitrot_algo):
+                        return list(framed2d), \
+                            (lambda b=buf: bufpool.GLOBAL.release(b))
+                    bufpool.GLOBAL.release(buf)   # native hash missing
+                return self._encode_and_frame(chunk, m, fi), None
         finally:
             stats["encode_s"] += time.perf_counter() - t0
 
@@ -921,7 +939,13 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             inflight.append(sw.submit_batch(write_batch_for(framed),
                                             release=release))
             while len(inflight) > depth:
+                # depth-bound backpressure: the pipeline is full, the
+                # request thread parks behind the writer plane
+                t0 = time.perf_counter()
                 inflight.popleft().done.wait()
+                from ..obs import stages as _stages
+                _stages.add("write_enqueue",
+                            int((time.perf_counter() - t0) * 1e9))
             alive = sw.alive()
             if alive < wq:
                 sw.abort()
@@ -947,10 +971,12 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         stats = {"md5_s": 0.0, "encode_s": 0.0}
         depth = max(1, self._pipe_depth)
         sw = self._write_plane.stream(shuffled)
+        from ..obs import stages as _stages
         src = None
         t_wall0 = time.perf_counter()
         lk = self.ns_lock.new_lock(bucket, object_name)
-        lk.lock(write=True)
+        with _stages.stage("lock_wait"):
+            lk.lock(write=True)
         try:
             # started only after the lock is held and inside the try: a
             # lock failure must not leave a thread draining the body
@@ -976,7 +1002,8 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             total, batches = self._pump_put_pipeline(
                 src, sw, m, fi, md5, stats, write_batch_for, wq)
             self._stamp_etag(fi, md5, opts, total, mod_time)
-            sw.drain()
+            with _stages.stage("write_drain"):
+                sw.drain()
             alive = sw.alive()
             if alive < wq:
                 raise WriteQuorumError(
@@ -994,8 +1021,9 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 disk.rename_data(SYS_DIR, tmps[idx], dfi, bucket,
                                  object_name)
 
-            sw.submit_batch(commit_one)
-            sw.drain()
+            with _stages.stage("drive_commit"):
+                sw.submit_batch(commit_one)
+                sw.drain()
             cerrs = list(sw.errs)
             try:
                 meta.reduce_errs(cerrs, wq, WriteQuorumError)
@@ -1062,9 +1090,11 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         # cmd/xl-storage.go:1544-1546)
         from ..utils.readahead import readahead
 
+        from ..obs import stages as _stages
         src = None
         lk = self.ns_lock.new_lock(bucket, object_name)
-        lk.lock(write=True)
+        with _stages.stage("lock_wait"):
+            lk.lock(write=True)
         try:
             # started only after the lock is held and inside the try:
             # a lock failure must not leave a thread draining the body
@@ -1074,7 +1104,8 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 if md5 is not None:
                     md5.update(chunk)
                 total += len(chunk)
-                framed = self._encode_and_frame(chunk, m, fi)
+                with _stages.stage("encode"):
+                    framed = self._encode_and_frame(chunk, m, fi)
 
                 def write_batch(idx_disk):
                     idx, disk = idx_disk
@@ -1088,7 +1119,9 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                         disk.append_file(SYS_DIR, f"{tmps[idx]}/part.1",
                                          framed[idx])
 
-                _, werrs = self._fanout_indexed(write_batch, shuffled)
+                with _stages.stage("drive_commit"):
+                    _, werrs = self._fanout_indexed(write_batch,
+                                                    shuffled)
                 for i, e in enumerate(werrs):
                     if e is not None and errs[i] is None:
                         errs[i] = e
@@ -1195,10 +1228,12 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         # the validated cache.  Every non-happy path returns None and
         # falls through here, so the reference error semantics below
         # stay the single source of truth.
+        from ..obs import stages as _stages
         plane = self.hotread
         if plane is not None:
-            served = plane.serve(bucket, object_name, offset, length,
-                                 opts)
+            with _stages.stage("cache"):
+                served = plane.serve(bucket, object_name, offset,
+                                     length, opts)
             if served is not None:
                 return served
         self._check_bucket(bucket)
@@ -1206,7 +1241,8 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         # the nsLock RLock, cmd/erasure-object.go:136): a reader racing a
         # PUT/DELETE commit must never observe a half-renamed version set
         lk = self.ns_lock.new_lock(bucket, object_name)
-        lk.lock(write=False)
+        with _stages.stage("lock_wait"):
+            lk.lock(write=False)
         try:
             fi, fis = self._read_quorum_fileinfo(bucket, object_name,
                                                  opts.version_id)
@@ -1296,9 +1332,11 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         hit compares before serving (diskcache.py ETag-validation
         role, quorum-consistent so a committed overwrite on ANY node
         is always seen)."""
+        from ..obs import stages as _stages
         self._check_bucket(bucket)
         lk = self.ns_lock.new_lock(bucket, object_name)
-        lk.lock(write=False)
+        with _stages.stage("lock_wait"):
+            lk.lock(write=False)
         try:
             fi, _ = self._read_quorum_fileinfo(bucket, object_name,
                                                version_id)
@@ -1316,9 +1354,11 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         any drive data fan-out).  Returns ``(fi, info, data)``; data
         is None for delete markers and out-of-range starts (the
         caller falls through to the reference error path)."""
+        from ..obs import stages as _stages
         self._check_bucket(bucket)
         lk = self.ns_lock.new_lock(bucket, object_name)
-        lk.lock(write=False)
+        with _stages.stage("lock_wait"):
+            lk.lock(write=False)
         try:
             fi, fis = self._read_quorum_fileinfo(bucket, object_name,
                                                  version_id)
@@ -1387,10 +1427,14 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 framed_off = logical_off + bb0 * hlen
                 framed_len = seg_len + (bb1 - bb0) * hlen
                 covered = min(bb1 * bs, part.size) - bb0 * bs
-                shards = self._read_shard_segments(
-                    bucket, object_name, fi, part, shuffled, sfis, dead,
-                    framed_off, framed_len, seg_len, ssize, algo)
-                part_bytes = self._assemble(shards, fi, covered)
+                from ..obs import stages as _stages
+                with _stages.stage("drive_read"):
+                    shards = self._read_shard_segments(
+                        bucket, object_name, fi, part, shuffled, sfis,
+                        dead, framed_off, framed_len, seg_len, ssize,
+                        algo)
+                with _stages.stage("decode"):
+                    part_bytes = self._assemble(shards, fi, covered)
                 lo = max(p0 - bb0 * bs, 0)
                 hi = min(p1 - bb0 * bs, covered)
                 yield part_bytes[lo:hi].tobytes()

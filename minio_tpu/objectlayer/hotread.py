@@ -96,6 +96,12 @@ class CacheConfig:
         self.heat_threshold = 2
         self.singleflight_queue = 64
         self.window_bytes = 8 << 20
+        # sequential hit-validation coalescing (ROADMAP item 4
+        # follow-up): a validation result is reused for this many ms —
+        # fenced by the key's generation, so ANY local invalidation
+        # (write-path commit, peer mark_change) voids it instantly.
+        # 0 disables (every hit pays its own quorum read).
+        self.validate_ttl_ms = 50
         self._loaded = False
 
     def load(self, cfg=None) -> None:
@@ -114,11 +120,18 @@ class CacheConfig:
             queue = max(0, int(cfg.get("cache", "singleflight_queue")))
             window = max(64 * 1024,
                          int(cfg.get("cache", "window_bytes")))
+            try:
+                ttl = max(0, int(cfg.get("cache", "validate_ttl_ms")))
+            except KeyError:
+                # pre-PR config shape (test fakes): keep the current
+                # value; a BAD value still aborts the whole load below
+                ttl = self.validate_ttl_ms
             self.enable = enable
             self.max_bytes = max_bytes
             self.heat_threshold = heat
             self.singleflight_queue = queue
             self.window_bytes = window
+            self.validate_ttl_ms = ttl
         except (KeyError, ValueError):
             pass
         self._loaded = True
@@ -452,6 +465,15 @@ class HotReadPlane:
         self._gen_counter = 0
         self._gens: dict[tuple, tuple[int, float]] = {}
         self._heat: dict[tuple, tuple[int, float]] = {}
+        # sequential hit-validation coalescing: kv -> (fi, info, gen,
+        # expires_monotonic).  An entry is usable only while BOTH the
+        # TTL holds and the key's generation is unchanged — a commit
+        # (or peer eviction) bumps the generation inside its write-
+        # locked section, so a validation cached before an overwrite
+        # can never vouch for bytes after it (the stale-read-
+        # impossibility regression test pins this)
+        self._val_cache: dict[tuple, tuple] = {}
+        self.validations_coalesced = 0
         # (b, o, vid) -> (size, monotonic): advisory routing hint so
         # full GETs of known window-spanning objects skip the plane
         # without a wasted window read
@@ -491,6 +513,8 @@ class HotReadPlane:
                     del self._gens[k]
             for k in [k for k in self._sizes if k[:2] == key]:
                 del self._sizes[k]
+            for k in [k for k in self._val_cache if k[:2] == key]:
+                del self._val_cache[k]
             touched = self.used
         self.cache.evict_key(key)
         self.cache.record_invalidation()
@@ -505,10 +529,14 @@ class HotReadPlane:
                 self._gens[key] = (self._gen_counter, now)
             for k in [k for k in self._sizes if k[0] == bucket]:
                 del self._sizes[k]
+            for k in [k for k in self._val_cache if k[0] == bucket]:
+                del self._val_cache[k]
         self.cache.evict_bucket(bucket)
 
     def clear(self) -> None:
         """Release every cached byte (config disable / tests)."""
+        with self._mu:
+            self._val_cache.clear()
         self.cache.clear()
 
     # -- admission heat -----------------------------------------------------
@@ -654,19 +682,48 @@ class HotReadPlane:
                     del self._sizes[k]
 
     def _validate(self, kv: tuple):
-        """Quorum-read the key's current identity (single-flighted so
-        64 concurrent hits pay one metadata fan-out).  Layer errors
-        (ObjectNotFound, quorum loss) propagate exactly as the
-        uncoalesced path would raise them."""
+        """Quorum-read the key's current identity.  CONCURRENT hits
+        share one fan-out through the single-flight; SEQUENTIAL hits
+        within ``cache.validate_ttl_ms`` reuse the last validation —
+        but only while the key's generation is unchanged, so any
+        committed local write or peer eviction (both bump the
+        generation before the new version is observable) voids the
+        reuse instantly and the next hit pays a fresh quorum read.
+        Layer errors (ObjectNotFound, quorum loss) propagate exactly
+        as the uncoalesced path would raise them."""
+        from ..admin.metrics import GLOBAL as _mtr
         bucket, object_name, vid = kv
+        key = (bucket, object_name)
+        ttl_s = self.config.validate_ttl_ms / 1000.0
+        if ttl_s > 0:
+            with self._mu:
+                e = self._val_cache.get(kv)
+                gen_now = self._gens.get(key, (0, 0.0))[0]
+            if e is not None and e[2] == gen_now and \
+                    time.monotonic() < e[3]:
+                with self._mu:
+                    self.validations_coalesced += 1
+                _mtr.inc("mt_cache_validations_coalesced_total")
+                return e[0], e[1]
+        g0 = self.gen_of(key)
         mode, res, _, _ = self.sf.do(
-            (bucket, object_name), ("info", vid),
+            key, ("info", vid),
             lambda: self._layer._hot_fileinfo(bucket, object_name,
                                               vid),
             max_waiters=self.config.singleflight_queue)
         if mode in ("shed", "cancelled"):
             res = self._layer._hot_fileinfo(bucket, object_name, vid)
         self._note_size(kv, res[0])
+        if ttl_s > 0 and self.gen_of(key) == g0:
+            # fence: only a validation no write raced is reusable
+            with self._mu:
+                self._val_cache[kv] = (res[0], res[1], g0,
+                                       time.monotonic() + ttl_s)
+                if len(self._val_cache) > _HEAT_SOFT_CAP:
+                    now = time.monotonic()
+                    for k in [k for k, v in self._val_cache.items()
+                              if v[3] < now]:
+                        del self._val_cache[k]
         return res
 
     def _slice(self, info, data, wstart: int, offset: int,
@@ -684,5 +741,6 @@ class HotReadPlane:
 
     def stats(self) -> dict:
         out = {"singleflight": self.sf.snapshot(),
-               "cache": self.cache.stats()}
+               "cache": self.cache.stats(),
+               "validations_coalesced": self.validations_coalesced}
         return out

@@ -1,0 +1,188 @@
+"""Cluster flight recorder — always-on bounded forensic rings.
+
+The black-box half of the request X-ray (obs/stages.py): when an SLO
+row trips mid-soak the evidence (what the last thousand requests were,
+which of them failed, what the threads/breakers/governor looked like)
+must already be on hand — a trace subscription started *after* the
+incident records the recovery, not the breach.  Each node keeps three
+bounded rings, appended on the request path and queryable live through
+the admin ``xray`` route (peer-aggregated like ``top``):
+
+  * **request ring** — the last N completed requests as compact tuples
+    (time, request-id, api, status, rx/tx bytes, duration, the serial
+    stage vector from the StageClock);
+  * **error ring** — the subset with status >= 400 (longer memory for
+    rare failures: a 0.1% error rate would otherwise age out of the
+    request ring in seconds under load);
+  * **snapshot ring** — periodic system snapshots: all-thread stacks
+    (the PR-3 sampler's dump primitive), memory-governor accounting,
+    RPC breaker states, codec-batcher queue depths, thread count.
+
+Idle contract: recording one request is two deque appends (bounded,
+O(1), preallocated ring slots) plus integer bookkeeping — no dict is
+built on the hot path; dict-shaped records are rendered at QUERY time.
+Snapshots are taken at most once per ``snap_interval_s`` and on a
+transient helper thread, so no request ever pays the stack walk and an
+idle node takes no snapshots at all.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+_REQ_RING = 1024
+_ERR_RING = 256
+_SNAP_RING = 16
+SNAP_INTERVAL_S = 60.0
+
+# record tuple layout (compact on purpose — the hot path appends, the
+# admin route renders):  (wall_ns, req_id, api, status, dur_ns, rx,
+# tx, stages, async_stages, error)
+_F_TIME, _F_RID, _F_API, _F_STATUS, _F_DUR, _F_RX, _F_TX, _F_STAGES, \
+    _F_ASYNC, _F_ERR = range(10)
+
+
+def system_snapshot(brief: bool = False) -> dict:
+    """One point-in-time system snapshot: the evidence a forensic
+    bundle or an OBD document wants about *this process right now*.
+    ``brief`` skips the thread-stack dump (the xray route's default —
+    stacks are big and usually only wanted inside bundles)."""
+    from ..parallel import batcher as _batcher
+    from ..parallel.rpc import breaker_states
+    from ..utils.locktrace import render_metrics as _lock_metrics
+    from ..utils.memgov import GOVERNOR
+    snap: dict = {
+        "time_ns": time.time_ns(),
+        "threads": threading.active_count(),
+        "memgov": GOVERNOR.stats(),
+        "breakers": breaker_states(),
+    }
+    try:
+        snap["codec_batch_depths"] = _batcher.GLOBAL.queue_depths() \
+            if _batcher.GLOBAL.started() else {}
+    except Exception:  # noqa: BLE001 — a snapshot must never fail
+        snap["codec_batch_depths"] = {}
+    try:
+        snap["lock_graph"] = bool(_lock_metrics())
+    except Exception:  # noqa: BLE001 — a snapshot must never fail
+        snap["lock_graph"] = False
+    if not brief:
+        from . import profiling
+        try:
+            snap["stacks"] = profiling._threads_dump().decode(
+                "utf-8", "replace")
+        except Exception:  # noqa: BLE001 — a snapshot must never fail
+            snap["stacks"] = ""
+    return snap
+
+
+class FlightRecorder:
+    """One node's always-on rings (constructed per S3Server so embedded
+    multi-server tests keep nodes apart, exactly like the audit log)."""
+
+    def __init__(self, req_ring: int = _REQ_RING,
+                 err_ring: int = _ERR_RING,
+                 snap_ring: int = _SNAP_RING,
+                 snap_interval_s: float = SNAP_INTERVAL_S):
+        self.requests: deque = deque(maxlen=req_ring)
+        self.errors: deque = deque(maxlen=err_ring)
+        self.snapshots: deque = deque(maxlen=snap_ring)
+        self.snap_interval_s = snap_interval_s
+        self.records_total = 0          # lifetime (scrape counter)
+        self.errors_total = 0
+        self._last_snap = 0.0           # monotonic; 0 = never
+        # held for the duration of one helper snapshot: the
+        # non-blocking acquire makes the interval check race-free
+        # (two requests crossing the interval spawn ONE helper)
+        self._snap_mu = threading.Lock()
+
+    # -- the hot path ---------------------------------------------------------
+
+    def record(self, req_id: str, api: str, status: int, dur_ns: int,
+               rx: int, tx: int, stages: tuple = (),
+               async_stages: tuple = (), error: str = "") -> None:
+        """Append one completed request (two bounded deque appends)."""
+        rec = (time.time_ns(), req_id, api, status, dur_ns, rx, tx,
+               stages, async_stages, error)
+        self.requests.append(rec)
+        self.records_total += 1
+        if status >= 400 or error:
+            self.errors.append(rec)
+            self.errors_total += 1
+        now = time.monotonic()
+        if now - self._last_snap >= self.snap_interval_s and \
+                self._snap_mu.acquire(blocking=False):
+            # at most one helper in flight (the lock is released by
+            # the helper); the request thread never walks stacks
+            self._last_snap = now
+            threading.Thread(target=self._take_snapshot, daemon=True,
+                             name="mt-flightrec-snap").start()
+
+    def _take_snapshot(self) -> None:
+        try:
+            self.snapshots.append(system_snapshot())
+        except Exception:  # noqa: BLE001 — never surface from a helper
+            pass
+        finally:
+            self._snap_mu.release()
+
+    def snapshot_now(self, brief: bool = False) -> dict:
+        """Synchronous snapshot (forensic bundles, xray ?snapshot=true):
+        captured fresh and appended to the ring."""
+        snap = system_snapshot(brief=brief)
+        self.snapshots.append(snap)
+        self._last_snap = time.monotonic()
+        return snap
+
+    # -- query ----------------------------------------------------------------
+
+    @staticmethod
+    def _render(rec: tuple) -> dict:
+        return {
+            "timeNs": rec[_F_TIME],
+            "requestID": rec[_F_RID],
+            "api": rec[_F_API],
+            "status": rec[_F_STATUS],
+            "durationNs": rec[_F_DUR],
+            "rxBytes": rec[_F_RX],
+            "txBytes": rec[_F_TX],
+            "stages": dict(rec[_F_STAGES]),
+            "asyncStages": dict(rec[_F_ASYNC]),
+            **({"error": rec[_F_ERR]} if rec[_F_ERR] else {}),
+        }
+
+    def query(self, api: str = "", min_duration_ms: float = 0.0,
+              errors_only: bool = False, limit: int = 100) -> list[dict]:
+        """Newest-first filtered records (the admin ``xray`` shape)."""
+        ring = self.errors if errors_only else self.requests
+        min_ns = int(min_duration_ms * 1e6)
+        out: list[dict] = []
+        for rec in reversed(ring):
+            if api and rec[_F_API] != api:
+                continue
+            if min_ns and rec[_F_DUR] < min_ns:
+                continue
+            out.append(self._render(rec))
+            if len(out) >= limit:
+                break
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "requests": len(self.requests),
+            "errors": len(self.errors),
+            "snapshots": len(self.snapshots),
+            "recordsTotal": self.records_total,
+            "errorsTotal": self.errors_total,
+        }
+
+    def dump(self) -> dict:
+        """Everything, rendered — the forensic-bundle payload."""
+        return {
+            "stats": self.stats(),
+            "requests": [self._render(r) for r in self.requests],
+            "errors": [self._render(r) for r in self.errors],
+            "snapshots": list(self.snapshots),
+        }

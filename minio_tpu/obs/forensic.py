@@ -1,0 +1,405 @@
+"""SLO-breach forensic bundles — the trigger engine + bundle writer.
+
+When the cluster misbehaves (a breaker storm, governor shedding, a
+flagged slow drive, an error-ceiling crossing, heal backlog growth)
+the evidence is perishable: the flight-recorder rings (obs/flightrec)
+hold the last N requests and the breakers/governor hold their state
+*now*, not when an operator gets paged.  This module watches for
+breach-shaped signals and snapshots everything into one support
+bundle — a zip of the rings, a live metrics scrape, a health document
+and the *redacted* config — the `mc admin obd` support-bundle story
+(cmd/healthinfo.go) made automatic.
+
+Design constraints:
+
+* **cheap when healthy** — the engine piggybacks on the request path
+  (``observe_request``): integer window bookkeeping per request, and a
+  full trigger evaluation at most once per second;
+* **bounded on disk** — the bundle dir is reaped oldest-first to
+  ``forensic.max_bundles`` / ``forensic.max_bytes``;
+* **storm-proof** — each trigger carries a cooldown
+  (``forensic.cooldown``): one breach window produces one bundle, not
+  one per failing request;
+* **secret-free** — the config section passes through
+  :func:`redact_config` (key-name fence) and nothing else in a bundle
+  ever held credentials (flight records carry no headers; the scrape
+  and healthinfo are public surfaces already).  Pinned by
+  tests/test_forensic.py grepping a real bundle for planted markers.
+
+Knobs live in the ``forensic`` kvconfig subsystem; thresholds are
+deliberately conservative so the ordinary chaos the soak matrix
+injects (brief 503 bursts, breaker flaps at exact quorum) never fires
+— only a genuine breach (sustained majority-5xx, a flagged drive with
+the trigger armed) does.  The soak drill lowers them via env to prove
+the path end to end (``require_no_forensics`` pins the clean-scenario
+zero).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import threading
+import time
+import zipfile
+from typing import Optional
+
+from ..admin.metrics import GLOBAL as _metrics
+
+# trigger names (the ``trigger`` label on mt_forensic_dumps_total)
+TRIGGERS = ("error_ceiling", "breaker_burst", "shed_burst",
+            "slow_drive", "heal_backlog", "manual")
+
+_CHECK_INTERVAL_S = 1.0
+
+# config keys whose VALUES are secret material; matched on the key
+# name so a future knob with a secret-shaped name is redacted by
+# default (fail closed) — the PR-2 header-redaction contract applied
+# to config dumps
+_SECRET_KEY_RE = re.compile(
+    r"secret|token|password|passwd|credential|dsn|private", re.I)
+
+REDACTED = "*REDACTED*"
+
+
+def redact_config(subsystems: dict) -> dict:
+    """{subsys: {key: value}} with secret-shaped keys blanked."""
+    out: dict = {}
+    for subsys, kv in subsystems.items():
+        out[subsys] = {
+            k: (REDACTED if _SECRET_KEY_RE.search(k) and v else v)
+            for k, v in kv.items()}
+    return out
+
+
+class ForensicSys:
+    """One node's trigger engine + bundle store."""
+
+    def __init__(self, srv, out_dir: str, *, max_bundles: int = 8,
+                 max_bytes: int = 64 << 20, cooldown_s: float = 60.0,
+                 triggers: tuple = ("error_ceiling",),
+                 error_rate: float = 0.5, error_min_samples: int = 100,
+                 window_s: float = 10.0, breaker_burst: int = 10,
+                 shed_burst: int = 50, backlog_growth: int = 500):
+        self.srv = srv
+        self.dir = out_dir
+        self.max_bundles = max(1, max_bundles)
+        self.max_bytes = max(1 << 20, max_bytes)
+        self.cooldown_s = cooldown_s
+        self.triggers = tuple(triggers)
+        self.error_rate = error_rate
+        self.error_min_samples = max(1, error_min_samples)
+        self.window_s = max(1.0, window_s)
+        self.breaker_burst = max(1, breaker_burst)
+        self.shed_burst = max(1, shed_burst)
+        self.backlog_growth = max(1, backlog_growth)
+        self._mu = threading.Lock()
+        # two-slot rotating request window: [epoch, total, errors] x2 —
+        # a full window plus the live one covers >= window_s of traffic
+        self._slots = [[0, 0, 0], [0, 0, 0]]
+        self._last_check = 0.0
+        self._fired: dict[str, float] = {}       # trigger -> monotonic
+        self.dumped = 0                           # lifetime bundles
+        self._writer: Optional[threading.Thread] = None
+        # deltas baseline for the cumulative sources
+        self._base_breaker_opens = self._breaker_opens()
+        self._base_sheds = self._shed_total()
+        self._seen_breaker_opens = self._base_breaker_opens
+        self._seen_sheds = self._base_sheds
+        self._mrf_baseline: Optional[int] = None
+
+    # -- config ---------------------------------------------------------------
+
+    @classmethod
+    def from_server(cls, srv) -> "Optional[ForensicSys]":
+        """Build from the server's ``forensic`` kvconfig subsystem;
+        None when disabled or no bundle dir is resolvable."""
+        from ..utils.kvconfig import parse_duration
+        from ..utils.memgov import parse_size
+        cfg = srv.config
+        try:
+            if (cfg.get("forensic", "enable") or "on") == "off":
+                return None
+            out_dir = cfg.get("forensic", "dir") or ""
+            if not out_dir:
+                from .selftest import local_drive_paths
+                roots = local_drive_paths(srv.layer)
+                if not roots:
+                    return None
+                out_dir = os.path.join(roots[0], ".minio-tpu.sys",
+                                       "forensics")
+            trig = tuple(
+                t for t in (cfg.get("forensic", "triggers")
+                            or "error_ceiling").replace(" ", "")
+                .split(",") if t)
+            return cls(
+                srv, out_dir,
+                max_bundles=int(cfg.get("forensic", "max_bundles")
+                                or 8),
+                max_bytes=parse_size(cfg.get("forensic", "max_bytes")
+                                     or "64MiB", 64 << 20),
+                cooldown_s=parse_duration(
+                    cfg.get("forensic", "cooldown") or "60s", 60.0),
+                triggers=trig,
+                error_rate=float(cfg.get("forensic", "error_rate")
+                                 or 0.5),
+                error_min_samples=int(
+                    cfg.get("forensic", "error_min_samples") or 100),
+                window_s=parse_duration(
+                    cfg.get("forensic", "window") or "10s", 10.0),
+                breaker_burst=int(cfg.get("forensic", "breaker_burst")
+                                  or 10),
+                shed_burst=int(cfg.get("forensic", "shed_burst")
+                               or 50),
+                backlog_growth=int(
+                    cfg.get("forensic", "backlog_growth") or 500))
+        except Exception:  # noqa: BLE001 — a bad knob or an exotic
+            return None    # layer shape must not take the server down
+
+    # -- cumulative sources ---------------------------------------------------
+
+    @staticmethod
+    def _breaker_opens() -> int:
+        from ..parallel import rpc as _rpc
+        return _rpc.BREAKER_OPEN_COUNT
+
+    @staticmethod
+    def _shed_total() -> int:
+        from ..utils.memgov import GOVERNOR
+        return sum(GOVERNOR.stats()["shed"].values())
+
+    # -- the request-path tap -------------------------------------------------
+
+    def observe_request(self, status: int,
+                        backpressure: bool = False) -> None:
+        """Called once per completed request (the flight-recorder
+        append site): window bookkeeping + an at-most-1/s check.
+
+        ``backpressure`` marks DELIBERATE shedding (503s carrying
+        Retry-After: request-pool admission, governor sheds) — bounded
+        self-protection the soak SLO budgets separately, not a breach;
+        the error ceiling counts only breach-shaped 5xx (quorum
+        failures, lock losses, internal errors)."""
+        now = time.monotonic()
+        half = self.window_s / 2.0
+        epoch = int(now / half)
+        slot = self._slots[epoch % 2]
+        if slot[0] != epoch:
+            slot[0], slot[1], slot[2] = epoch, 0, 0
+        slot[1] += 1
+        if status >= 500 and not backpressure:
+            slot[2] += 1
+        if now - self._last_check >= _CHECK_INTERVAL_S:
+            self._last_check = now
+            try:
+                self.check(now)
+            except Exception:  # noqa: BLE001 — the trigger engine must
+                pass           # never fail a request
+
+    def _window_counts(self, now: float) -> tuple[int, int]:
+        half = self.window_s / 2.0
+        epoch = int(now / half)
+        total = errors = 0
+        for slot in self._slots:
+            if slot[0] in (epoch, epoch - 1):
+                total += slot[1]
+                errors += slot[2]
+        return total, errors
+
+    # -- trigger evaluation ---------------------------------------------------
+
+    def check(self, now: float | None = None) -> Optional[str]:
+        """Evaluate every armed trigger; fires at most one bundle per
+        call.  Returns the fired trigger name (tests) or None."""
+        now = time.monotonic() if now is None else now
+        if "error_ceiling" in self.triggers:
+            total, errors = self._window_counts(now)
+            if total >= self.error_min_samples and \
+                    errors / total >= self.error_rate:
+                return self.fire("error_ceiling", {
+                    "windowSeconds": self.window_s,
+                    "requests": total, "errors5xx": errors,
+                    "rate": round(errors / total, 4),
+                    "threshold": self.error_rate})
+        if "breaker_burst" in self.triggers:
+            opens = self._breaker_opens()
+            if opens - self._seen_breaker_opens >= self.breaker_burst:
+                prev, self._seen_breaker_opens = \
+                    self._seen_breaker_opens, opens
+                return self.fire("breaker_burst", {
+                    "opens": opens - prev,
+                    "threshold": self.breaker_burst})
+        if "shed_burst" in self.triggers:
+            sheds = self._shed_total()
+            if sheds - self._seen_sheds >= self.shed_burst:
+                prev, self._seen_sheds = self._seen_sheds, sheds
+                return self.fire("shed_burst", {
+                    "sheds": sheds - prev,
+                    "threshold": self.shed_burst})
+        if "slow_drive" in self.triggers:
+            flagged = self._flagged_drives()
+            if flagged:
+                return self.fire("slow_drive", {"drives": flagged})
+        if "heal_backlog" in self.triggers:
+            mrf = getattr(self.srv, "mrf", None)
+            if mrf is not None:
+                depth = mrf._q.qsize()
+                if self._mrf_baseline is None:
+                    self._mrf_baseline = depth
+                elif depth - self._mrf_baseline >= self.backlog_growth:
+                    self._mrf_baseline = depth
+                    return self.fire("heal_backlog", {
+                        "queueDepth": depth,
+                        "threshold": self.backlog_growth})
+        return None
+
+    def _flagged_drives(self) -> list[str]:
+        from ..storage.health import (slow_drive_knobs,
+                                      slow_drives_for_layer)
+        mult, mins = slow_drive_knobs(getattr(self.srv, "config", None))
+        verdicts = slow_drives_for_layer(self.srv.layer, multiple=mult,
+                                         min_samples=mins)
+        return sorted(d for d, v in verdicts.items() if v.get("slow"))
+
+    # -- firing ---------------------------------------------------------------
+
+    def fire(self, trigger: str, detail: dict,
+             sync: bool = False) -> Optional[str]:
+        """Write one bundle for ``trigger`` unless it is cooling down.
+        Async by default (a request thread must not serialize a zip
+        write); ``sync=True`` for tests/admin-manual.  Returns the
+        trigger name when a bundle was scheduled, else None."""
+        now = time.monotonic()
+        with self._mu:
+            last = self._fired.get(trigger)
+            if last is not None and now - last < self.cooldown_s:
+                return None
+            self._fired[trigger] = now
+        if sync:
+            self._write_bundle(trigger, detail)
+            return trigger
+        t = threading.Thread(target=self._write_bundle,
+                             args=(trigger, detail), daemon=True,
+                             name="mt-forensic-dump")
+        self._writer = t
+        t.start()
+        return trigger
+
+    def join(self, timeout: float = 10.0) -> None:
+        """Wait for an in-flight bundle write (teardown/tests)."""
+        t = self._writer
+        if t is not None:
+            t.join(timeout=timeout)
+
+    # -- the bundle -----------------------------------------------------------
+
+    def _write_bundle(self, trigger: str, detail: dict) -> None:
+        try:
+            payload = self._bundle_bytes(trigger, detail)
+            os.makedirs(self.dir, exist_ok=True)
+            seq = self.dumped + 1
+            name = f"forensic-{time.strftime('%Y%m%dT%H%M%SZ', time.gmtime())}" \
+                   f"-{trigger}-{os.getpid()}-{seq}.zip"
+            tmp = os.path.join(self.dir, f".{name}.tmp")
+            with open(tmp, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, os.path.join(self.dir, name))
+            # counted only once the bundle is durably on disk — the
+            # metric must never claim evidence that was never written
+            self.dumped = seq
+            _metrics.inc("mt_forensic_dumps_total", {"trigger": trigger})
+            self._reap()
+        except Exception:  # noqa: BLE001 — a failing dump must never
+            # hurt the serving path it diagnoses; clearing the
+            # cooldown lets the NEXT trigger evaluation retry instead
+            # of going dark for cooldown_s with nothing on disk
+            with self._mu:
+                self._fired.pop(trigger, None)
+
+    def _bundle_bytes(self, trigger: str, detail: dict) -> bytes:
+        srv = self.srv
+        from . import healthinfo as _hi
+        from .flightrec import system_snapshot
+        docs: dict[str, bytes] = {}
+
+        def put(name: str, doc) -> None:
+            try:
+                docs[name] = json.dumps(doc, default=str,
+                                        indent=1).encode()
+            except Exception as e:  # noqa: BLE001 — one bad section
+                docs[name] = json.dumps(               # != no bundle
+                    {"error": str(e)}).encode()
+
+        put("trigger.json", {
+            "trigger": trigger, "detail": detail,
+            "node": getattr(srv, "node_name", ""),
+            "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())})
+        rec = getattr(srv, "flightrec", None)
+        if rec is not None:
+            try:
+                rec.snapshot_now()       # the breach-instant snapshot
+            except Exception:  # noqa: BLE001 — rings still dump below
+                pass
+            put("flightrec.json", rec.dump())
+        put("system.json", system_snapshot())
+        try:
+            from .selftest import local_drive_paths
+            put("healthinfo.json",
+                _hi.collect(local_drive_paths(srv.layer)))
+        except Exception as e:  # noqa: BLE001
+            put("healthinfo.json", {"error": str(e)})
+        cfg = getattr(srv, "config", None)
+        if cfg is not None:
+            try:
+                put("config.json", redact_config(
+                    {s: cfg.get_subsys(s) for s in cfg.subsystems()}))
+            except Exception as e:  # noqa: BLE001
+                put("config.json", {"error": str(e)})
+        try:
+            from ..admin.handlers import _render_local
+            docs["metrics.prom"] = _render_local(srv).encode()
+        except Exception as e:  # noqa: BLE001
+            docs["metrics.prom"] = f"# scrape failed: {e}\n".encode()
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            for name, data in docs.items():
+                z.writestr(name, data)
+        return buf.getvalue()
+
+    # -- the bounded store ----------------------------------------------------
+
+    def bundles(self) -> list[dict]:
+        """Resident bundles, oldest first (admin route + SLO rows)."""
+        try:
+            names = sorted(n for n in os.listdir(self.dir)
+                           if n.startswith("forensic-")
+                           and n.endswith(".zip"))
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            p = os.path.join(self.dir, n)
+            try:
+                out.append({"name": n, "bytes": os.path.getsize(p),
+                            "trigger": n.split("-")[2]
+                            if n.count("-") >= 2 else ""})
+            except OSError:
+                continue
+        return out
+
+    def _reap(self) -> None:
+        bundles = self.bundles()
+        total = sum(b["bytes"] for b in bundles)
+        # oldest-first, but the NEWEST bundle always survives — a
+        # single bundle larger than max_bytes is still the only copy
+        # of the breach evidence
+        while len(bundles) > 1 and (len(bundles) > self.max_bundles
+                                    or total > self.max_bytes):
+            victim = bundles.pop(0)
+            total -= victim["bytes"]
+            try:
+                os.remove(os.path.join(self.dir, victim["name"]))
+            except OSError:
+                pass

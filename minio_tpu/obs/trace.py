@@ -173,9 +173,14 @@ def make_trace(node_name: str, func_name: str, *, method: str, path: str,
                input_bytes: int, output_bytes: int,
                start_ns: int, ttfb_ns: int, duration_ns: int,
                trace_type: str = "http", error: str = "",
-               request_id: str = "") -> Dict[str, Any]:
-    """Build a trace.Info-shaped record (pkg/trace/trace.go:26-40)."""
+               request_id: str = "",
+               detail: Dict[str, Any] | None = None) -> Dict[str, Any]:
+    """Build a trace.Info-shaped record (pkg/trace/trace.go:26-40).
+    ``detail`` (when present) lands under the ``detail`` key — the
+    request X-ray publishes its per-stage timeline there
+    (``detail.stages``, obs/stages.py)."""
     return {
+        **({"detail": detail} if detail else {}),
         "type": trace_type,
         "nodeName": node_name,
         "funcName": func_name,

@@ -322,6 +322,17 @@ class CodecBatcher:
         timed out and the waiter cancelled out of the queue."""
         deadline = None if timeout is None else \
             time.monotonic() + timeout
+        # X-ray: the parked wait is the ``batch_wait`` stage — the
+        # price one request pays for riding a shared dispatch
+        from ..obs import stages as _stages
+        t0 = time.monotonic_ns()
+        try:
+            return self._park_inner(w, key, bkt, deadline)
+        finally:
+            _stages.add("batch_wait", time.monotonic_ns() - t0)
+
+    def _park_inner(self, w: _Waiter, key: tuple, bkt: _Bucket,
+                    deadline: float | None) -> bool:
         while not w.event.wait(0.05):
             lead = False
             with self._mu:

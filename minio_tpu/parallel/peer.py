@@ -163,6 +163,30 @@ def register_peer_service(rpc: RPCServer, srv) -> None:
         return {"node": srv.node_name,
                 "replayed": srv.egress.replay_all()}
 
+    # request X-ray + forensic planes (admin `xray` / `forensics` /
+    # `healthinfo?scope=cluster` aggregation — the OBD fan-out shape,
+    # cmd/healthinfo.go + peer drill-downs)
+    def xray_query(api: str = "", min_duration_ms: float = 0.0,
+                   errors_only: bool = False, limit: int = 100,
+                   snapshot: bool = False):
+        from ..admin.handlers import xray_reply
+        return xray_reply(srv, api=api,
+                          min_duration_ms=min_duration_ms,
+                          errors_only=errors_only, limit=limit,
+                          snapshot=snapshot)
+
+    def healthinfo_collect(perf: bool = False):
+        from ..admin.handlers import _drive_paths, _node_system_info
+        from ..obs import healthinfo as _hi
+        doc = _hi.collect(_drive_paths(srv), perf=perf)
+        doc["node"] = srv.node_name
+        doc["system"] = _node_system_info(srv)
+        return doc
+
+    def forensic_list():
+        from ..admin.handlers import forensic_inventory
+        return forensic_inventory(srv)
+
     rpc.register("peer", {
         "reload_bucket_meta": reload_bucket_meta,
         "reload_iam": reload_iam,
@@ -180,6 +204,9 @@ def register_peer_service(rpc: RPCServer, srv) -> None:
         "background_status": background_status,
         "target_status": target_status,
         "target_replay": target_replay,
+        "xray_query": xray_query,
+        "healthinfo_collect": healthinfo_collect,
+        "forensic_list": forensic_list,
     })
 
 

@@ -306,20 +306,29 @@ class CircuitBreaker:
     NOT be recorded — only transport failures say anything about the
     peer's health.  ``clock`` is injectable so the chaos tier can step
     time deterministically.
+
+    Every breaker registers in a process-wide weak set so the flight
+    recorder's system snapshots (obs/flightrec.py) can report live
+    breaker states, and every closed→open / probe-fail→open transition
+    ticks the process counter the forensic trigger engine
+    (obs/forensic.py ``breaker_burst``) watches.
     """
 
     CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
 
     def __init__(self, fail_max: int = 3, cooldown_s: float = 3.0,
-                 clock=time.monotonic):
+                 clock=time.monotonic, label: str = ""):
         self.fail_max = max(1, int(fail_max))
         self.cooldown_s = cooldown_s
+        self.label = label
         self._clock = clock
         self._mu = mtlock("rpc.breaker")
         self._state = self.CLOSED
         self._failures = 0
         self._opened_at = 0.0
         self._probing = False
+        self.opens = 0               # lifetime open transitions
+        _BREAKERS.add(self)
 
     @property
     def state(self) -> str:
@@ -358,18 +367,53 @@ class CircuitBreaker:
             self._probing = False
 
     def record_failure(self) -> None:
+        opened = False
         with self._mu:
             if self._state == self.HALF_OPEN:
                 # failed probe: straight back to open, fresh cooldown
                 self._state = self.OPEN
                 self._opened_at = self._clock()
                 self._probing = False
-                return
-            self._failures += 1
-            if self._state == self.CLOSED and \
-                    self._failures >= self.fail_max:
-                self._state = self.OPEN
-                self._opened_at = self._clock()
+                self.opens += 1
+                opened = True
+            else:
+                self._failures += 1
+                if self._state == self.CLOSED and \
+                        self._failures >= self.fail_max:
+                    self._state = self.OPEN
+                    self._opened_at = self._clock()
+                    self.opens += 1
+                    opened = True
+        if opened:
+            # outside the breaker lock: metrics/forensics must never
+            # serialize (or deadlock) the failure path
+            global BREAKER_OPEN_COUNT
+            BREAKER_OPEN_COUNT += 1
+            from ..admin.metrics import GLOBAL as _mtr
+            _mtr.inc("mt_node_rpc_breaker_opens_total")
+
+
+# process-wide breaker registry + open counter: the flight recorder
+# snapshots states from here; the forensic ``breaker_burst`` trigger
+# watches the (GIL-atomic) counter's delta
+import weakref as _weakref  # noqa: E402 — scoped to the registry below
+
+_BREAKERS: "_weakref.WeakSet[CircuitBreaker]" = _weakref.WeakSet()
+BREAKER_OPEN_COUNT = 0
+
+
+def breaker_states() -> list[dict]:
+    """Live breaker states, labelled by endpoint (system snapshots +
+    ``healthinfo`` OBD documents)."""
+    out = []
+    for b in list(_BREAKERS):
+        try:
+            out.append({"endpoint": b.label, "state": b.state,
+                        "opens": b.opens})
+        except Exception:  # noqa: BLE001 — a dying breaker must not
+            continue       # fail a snapshot
+    out.sort(key=lambda r: r["endpoint"])
+    return out
 
 
 def mint_token(secret: str, path: str, now: float | None = None) -> str:
@@ -859,8 +903,10 @@ class RPCClient:
         self._dyn: dict[str, DynamicTimeout] = {}
         if breaker is None or retry is None:
             bk, rp = _policy_from_config()
-            breaker = breaker or CircuitBreaker(**bk)
+            breaker = breaker or CircuitBreaker(label=endpoint, **bk)
             retry = retry or rp
+        if not breaker.label:
+            breaker.label = endpoint
         self.breaker = breaker
         self.retry = retry
         self._pool: list[http.client.HTTPConnection] = []
@@ -1160,13 +1206,21 @@ class RPCClient:
         the storage plane's adaptive deadlines."""
         path = f"/rpc/{service}/{method}"
         body = msgpack.packb(kwargs, use_bin_type=True)
-        if path in UNTRACED_PATHS or not _trace.active():
-            return self._roundtrip(path, body, service,
-                                   idempotent=_idempotent,
-                                   timeout=_timeout)
-        return self._traced_roundtrip(
-            path, body, service,
-            dict(idempotent=_idempotent, timeout=_timeout))
+        # X-ray: the internode leg's wall time, attributed to the
+        # request whose clock rode into this thread (async detail —
+        # fan-out legs overlap the request thread's serial stages)
+        from ..obs import stages as _stages
+        t0s = time.monotonic_ns()
+        try:
+            if path in UNTRACED_PATHS or not _trace.active():
+                return self._roundtrip(path, body, service,
+                                       idempotent=_idempotent,
+                                       timeout=_timeout)
+            return self._traced_roundtrip(
+                path, body, service,
+                dict(idempotent=_idempotent, timeout=_timeout))
+        finally:
+            _stages.add_async("rpc", time.monotonic_ns() - t0s)
 
     def raw_call(self, name: str, params: dict, body=b"",
                  idempotent: bool = False) -> bytes:
@@ -1185,9 +1239,14 @@ class RPCClient:
             headers["Content-Length"] = str(len(body))
         kw = dict(extra_headers=headers,
                   raw_response=True, idempotent=idempotent)
-        if not _trace.active():
-            return self._roundtrip(path, body, "storage", **kw)
-        return self._traced_roundtrip(path, body, "storage", kw)
+        from ..obs import stages as _stages
+        t0s = time.monotonic_ns()
+        try:
+            if not _trace.active():
+                return self._roundtrip(path, body, "storage", **kw)
+            return self._traced_roundtrip(path, body, "storage", kw)
+        finally:
+            _stages.add_async("rpc", time.monotonic_ns() - t0s)
 
     def _traced_roundtrip(self, path: str, body: bytes, service: str,
                           kw: dict):

@@ -415,6 +415,19 @@ class S3Server:
         # arm the external policy webhook (``policy_opa``) on the IAM
         # plane when configured
         self.reload_policy_config()
+        # request X-ray + flight recorder (obs/flightrec.py): always-on
+        # bounded rings of recent requests/errors/system snapshots,
+        # queried by the admin ``xray`` route and dumped into forensic
+        # bundles.  Per-server (like the audit log) so embedded
+        # multi-node tests keep nodes apart.
+        from ..obs.flightrec import FlightRecorder
+        self.flightrec = FlightRecorder()
+        # forensic trigger engine (obs/forensic.py): breach-shaped
+        # signals snapshot the rings into a bounded bundle dir under
+        # the first local drive (``forensic`` kvconfig subsystem);
+        # None when disabled or no local drive exists (gateway modes)
+        self.forensic = None
+        self.reload_forensic_config()
 
     def reload_api_config(self) -> None:
         """(Re)derive the request-plane knobs from the ``api`` kvconfig
@@ -552,6 +565,25 @@ class S3Server:
             self.iam.authorizer = OpaWebhook.from_config(self.config)
         except Exception:  # noqa: BLE001 — a bad knob value must not
             pass           # take the server (or the IAM plane) down
+
+    def reload_forensic_config(self) -> None:
+        """(Re)build the forensic trigger engine from the ``forensic``
+        kvconfig subsystem — at boot and after admin SetConfigKV, so
+        an operator can retune thresholds/cooldowns (or disable the
+        engine) on a live server.  Trigger cooldown history resets on
+        reload; the bundle dir is reaped by whichever engine writes
+        next."""
+        from ..obs.forensic import ForensicSys
+        old = getattr(self, "forensic", None)
+        if old is not None:
+            # the outgoing engine's in-flight bundle write finishes
+            # (bounded) before the swap — a dangling mt-forensic-dump
+            # thread must not write/reap the dir after a reload
+            old.join(timeout=5.0)
+        try:
+            self.forensic = ForensicSys.from_server(self)
+        except Exception:  # noqa: BLE001 — a bad knob value must not
+            self.forensic = None       # take the server down
 
     def reload_background_config(self) -> None:
         """Push the ``heal``/``scanner`` pacing knobs into every
@@ -771,6 +803,10 @@ class S3Server:
             plane = getattr(leaf, "hotread", None)
             if plane is not None:
                 plane.clear()
+        # an in-flight forensic bundle write finishes (bounded) so the
+        # thread-hygiene assertions never see a dangling dump worker
+        if getattr(self, "forensic", None) is not None:
+            self.forensic.join(timeout=10.0)
         if self.peers is not None:
             self.peers.close()
 
@@ -907,12 +943,18 @@ def _make_handler(srv: S3Server):
                 # reject before buffering: unauthenticated clients must not
                 # be able to force huge allocations
                 raise S3Error("EntityTooLarge")
-            return self.rfile.read(n) if n else b""
+            if not n:
+                return b""
+            from ..obs import stages as _stages
+            with _stages.stage("body_read"):
+                return self.rfile.read(n)
 
         def _auth(self, path, query, payload: bytes) -> bytes:
+            from ..obs import stages as _stages
             self._query_token = query.get("X-Amz-Security-Token", [""])[0]
-            out = self._auth_inner(path, query, payload)
-            self._check_session_token()
+            with _stages.stage("auth"):
+                out = self._auth_inner(path, query, payload)
+                self._check_session_token()
             return out
 
         def _auth_inner(self, path, query, payload: bytes) -> bytes:
@@ -976,6 +1018,11 @@ def _make_handler(srv: S3Server):
             """Authorize the authenticated key for an S3 action: bucket
             policy first (explicit Deny wins, Allow grants even anonymous),
             then IAM (checkRequestAuthType -> IAMSys.IsAllowed)."""
+            from ..obs import stages as _stages
+            with _stages.stage("policy"):
+                self._allow_inner(action, resource)
+
+        def _allow_inner(self, action: str, resource: str = "") -> None:
             bucket = resource.split("/", 1)[0]
             # bucket policy can only speak for s3: actions — admin:* must
             # never be grantable by a bucket document
@@ -1042,7 +1089,9 @@ def _make_handler(srv: S3Server):
                 len(body) if content_length is None else content_length,
                 content_type, headers)
             if body and self.command != "HEAD":
-                self.wfile.write(body)
+                from ..obs import stages as _stages
+                with _stages.stage("body_write"):
+                    self.wfile.write(body)
 
         def _send_stream(self, status: int, gen, total: int,
                          content_type: str, headers: dict | None = None):
@@ -1061,12 +1110,22 @@ def _make_handler(srv: S3Server):
                     first = b""
             self._send_prologue(status, total, total, content_type,
                                 headers)
+            from ..obs import stages as _stages
             try:
                 if first:
-                    self.wfile.write(first)
-                for chunk in it:
+                    with _stages.stage("body_write"):
+                        self.wfile.write(first)
+                # pull OUTSIDE the body_write stage: producing a chunk
+                # is drive_read/decode (attributed inside the
+                # generator), not socket time
+                while True:
+                    try:
+                        chunk = next(it)
+                    except StopIteration:
+                        break
                     if chunk:
-                        self.wfile.write(chunk)
+                        with _stages.stage("body_write"):
+                            self.wfile.write(chunk)
             except Exception:   # noqa: BLE001 — headers are gone; a
                 # second response would corrupt the stream
                 self.close_connection = True
@@ -1165,6 +1224,7 @@ def _make_handler(srv: S3Server):
         def _dispatch(self):
             """Trace/audit wrapper around the real dispatcher
             (cmd/http-tracer.go httpTraceAll + cmd/logger/audit.go)."""
+            from ..obs import stages as _stages
             from ..obs import trace as _trace
             self._t0_ns = _trace.now_ns()
             # monotonic twin for durations fed into latency windows (a
@@ -1175,6 +1235,10 @@ def _make_handler(srv: S3Server):
             # request causes — storage calls, internode RPCs, TPU
             # kernels, even on peer nodes — carries this ID
             _trace.set_request_id(self._req_id)
+            # X-ray stage clock, minted beside the request ID and torn
+            # down with it; the completion record lands in the flight
+            # ring whatever happens below
+            _stages.begin()
             self._resp_status = 0
             self._resp_headers = {}
             self._resp_bytes = 0
@@ -1190,7 +1254,12 @@ def _make_handler(srv: S3Server):
             # srv._req_sem mid-flight, and acquire/release must pair on
             # the same semaphore
             sem = srv._req_sem if throttled else None
-            if sem is not None and not self._admit(sem):
+            if sem is not None:
+                with _stages.stage("admission"):
+                    admitted = self._admit(sem)
+            else:
+                admitted = True
+            if not admitted:
                 retry_after = max(1, int(srv.requests_deadline_s))
                 try:
                     api = s3err.get("SlowDown")
@@ -1204,6 +1273,8 @@ def _make_handler(srv: S3Server):
                         self._record_request()
                     except Exception:  # noqa: BLE001 — the 503 itself
                         pass           # must still reach the client
+                    _trace.set_request_id("")
+                    _stages.clear()
                 return
             # slow-body watchdog: absolute per-request budget for
             # reading the body (size-scaled), armed for everything
@@ -1224,8 +1295,10 @@ def _make_handler(srv: S3Server):
                 except Exception:   # noqa: BLE001 — never fail a request
                     pass            # on account of observability
                 # keep-alive reuses this thread for the next request —
-                # its spans must not inherit this request's ID
+                # its spans must not inherit this request's ID (nor
+                # its stage clock)
                 _trace.set_request_id("")
+                _stages.clear()
 
         def _admit(self, sem) -> bool:
             """Request-pool admission: wait up to the deadline for a
@@ -1244,11 +1317,33 @@ def _make_handler(srv: S3Server):
                     srv._req_waiters -= 1
 
         def _record_request(self):
+            from ..obs import stages as _stages
             from ..obs import trace as _trace
             dur = _trace.now_ns() - self._t0_ns
+            dur_mono = time.monotonic_ns() - self._t0m_ns
             path, bucket, key, query = self._split()
             q1 = {k: v[0] for k, v in query.items()}
             api_name = _api_name(self.command, bucket, key, q1)
+            # X-ray completion: close the stage clock against the
+            # monotonic request total so the serial vector + ``other``
+            # reconciles with it exactly
+            clock = _stages.current()
+            if clock is not None:
+                stage_ns, async_ns, _unattr = clock.finish(dur_mono)
+            else:
+                stage_ns, async_ns = {}, {}
+            srv.flightrec.record(
+                self._req_id, api_name, self._resp_status, dur_mono,
+                self._rx_bytes, self._resp_bytes,
+                stages=tuple(stage_ns.items()),
+                async_stages=tuple(async_ns.items()))
+            if srv.forensic is not None:
+                # Retry-After marks deliberate backpressure (admission
+                # or governor sheds) — bounded self-protection, not the
+                # breach shape the error-ceiling trigger watches
+                srv.forensic.observe_request(
+                    self._resp_status,
+                    backpressure="Retry-After" in self._resp_headers)
             # metrics-v2 per-API families (cmd/metrics-v2.go
             # getS3RequestsTotalMD / getS3TTFBMetric): request count by
             # api name and the TTFB distribution.  S3 APIs only — the
@@ -1265,13 +1360,24 @@ def _make_handler(srv: S3Server):
                               "status": str(self._resp_status)})
                 ttfb = (self._ttfb_ns or dur) / 1e9
                 _mtr.observe("mt_s3_ttfb_seconds", {"api": api_name}, ttfb)
+                # per-stage latency attribution (the X-ray histogram
+                # family): S3 APIs only, same scoping as the per-API
+                # counters — ~a dozen stages per API, bounded by the
+                # STAGE_NAMES catalog
+                for sname, sns in stage_ns.items():
+                    _mtr.observe("mt_s3_stage_seconds",
+                                 {"api": api_name, "stage": sname},
+                                 sns / 1e9)
+                for sname, sns in async_ns.items():
+                    _mtr.observe("mt_s3_stage_seconds",
+                                 {"api": api_name, "stage": sname},
+                                 sns / 1e9)
                 # last-minute per-API window (mt_s3_api_last_minute_*
                 # gauges + admin `top`): S3 APIs only, same scoping as
                 # the per-API counter families above; monotonic delta,
                 # unlike the wall-clock trace timestamps
-                srv.api_stats.record(
-                    api_name, time.monotonic_ns() - self._t0m_ns,
-                    self._rx_bytes + self._resp_bytes)
+                srv.api_stats.record(api_name, dur_mono,
+                                     self._rx_bytes + self._resp_bytes)
             if srv.trace_hub.active:
                 srv.trace_hub.publish(_trace.make_trace(
                     srv.node_name, api_name,
@@ -1284,7 +1390,10 @@ def _make_handler(srv: S3Server):
                     input_bytes=self._rx_bytes,
                     output_bytes=self._resp_bytes,
                     start_ns=self._t0_ns, ttfb_ns=self._ttfb_ns,
-                    duration_ns=dur, request_id=self._req_id))
+                    duration_ns=dur, request_id=self._req_id,
+                    detail={"stages": stage_ns,
+                            "asyncStages": async_ns,
+                            "totalNs": dur_mono} if stage_ns else None))
             if srv.audit.enabled:
                 srv.audit.publish(srv.audit.entry(
                     api_name=api_name, bucket=bucket, obj=key,

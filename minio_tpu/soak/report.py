@@ -79,6 +79,10 @@ class Scenario:
     # encrypted links (mid-handshake resets, mid-encrypted-frame
     # faults).  Same mix, same SLO budget: TLS must not cost SLO.
     tls: bool = False
+    # per-scenario env overrides applied around the run (on top of
+    # _SOAK_ENV) — the forensic drill lowers the trigger thresholds
+    # through the kvconfig MT_* env layer
+    env: dict = field(default_factory=dict)
 
 
 # chaos knobs every scenario runs under: snappy breakers so fault
@@ -148,7 +152,11 @@ def default_matrix(duration_s: float = 15.0) -> list[Scenario]:
             budget=_slo.Budget(max_error_rate=0.10,
                                require_codec_occupancy=storm,
                                require_mem_bounded=membound,
-                               require_hot_read=hot),
+                               require_hot_read=hot,
+                               # ordinary chaos is not a breach: the
+                               # trigger engine (default thresholds)
+                               # must stay quiet through the matrix
+                               require_no_forensics=True),
             workers=4 if storm or membound or hot else 2,
             backend="tpu" if storm else "numpy"))
     # huge_put: one mesh-sharded object (1 GiB on a TPU host,
@@ -162,6 +170,13 @@ def default_matrix(duration_s: float = 15.0) -> list[Scenario]:
         budget=_slo.Budget(max_error_rate=0.10),
         workers=2, backend="mesh",
         huge_put_bytes=_huge_bytes_default()))
+    # forensic_drill (ISSUE 15 acceptance): induced SLO breach —
+    # burst_503 on BOTH peer links kills write/read quorum mid-storm
+    # while a drive runs slow, the error ceiling crosses, and exactly
+    # ONE forensic bundle must land with the breach window's request
+    # records inside (cooldown outlasts the scenario); clean scenarios
+    # above assert the engine stayed quiet
+    out.append(forensic_drill_scenario(duration_s))
     # tls_storm (ISSUE 13 acceptance): the GET-heavy mix under the
     # FULL chaos timeline with S3 + internode both encrypted — the
     # same SLO budget as the plaintext matrix, so any TLS-induced
@@ -194,6 +209,43 @@ def _huge_bytes_default() -> int:
     return 32 << 20
 
 
+def forensic_drill_scenario(duration_s: float = 12.0) -> Scenario:
+    """The induced-breach drill (burst_503 + drive_slow, then the
+    killing blow): drive 1 runs slow, then BOTH node0-local drives die
+    while node1's internode link 503-bursts — reads and writes lose
+    drive quorum and fail FAST (the dsync lock keeps its node0+node2
+    majority, so requests error instead of parking in lock_wait), a
+    genuine majority-5xx breach.  Trigger thresholds are lowered
+    through the kvconfig env layer so the error ceiling crosses within
+    the breach window; the cooldown outlasts the scenario, so exactly
+    one bundle can land."""
+    E = _chaos.Event
+    t = duration_s
+    return Scenario(
+        name="forensic_drill", mix=MIXES["get_heavy_small"],
+        timeline=[
+            E(0.08 * t, "drive_slow", drive=1, delay_s=0.02),
+            E(0.20 * t, "drive_kill", drive=0),
+            E(0.22 * t, "drive_kill", drive=1),
+            E(0.25 * t, "burst_503", node=1),
+            E(0.68 * t, "heal_link", node=1),
+            E(0.70 * t, "drive_return", drive=0),
+            E(0.72 * t, "drive_return", drive=1),
+        ],
+        duration_s=duration_s,
+        # the breach IS the point: no error-rate ceiling, no p99
+        # budget small enough to trip on the induced outage
+        budget=_slo.Budget(max_error_rate=1.0,
+                           p50_ms=60_000.0, p99_ms=120_000.0,
+                           expect_forensics=1,
+                           converge_timeout_s=60.0),
+        workers=2,
+        env={"MT_FORENSIC_ERROR_RATE": "0.2",
+             "MT_FORENSIC_ERROR_MIN_SAMPLES": "5",
+             "MT_FORENSIC_WINDOW": "4s",
+             "MT_FORENSIC_COOLDOWN": "10m"})
+
+
 def smoke_scenario(duration_s: float = 4.0) -> Scenario:
     """The tier-1 miniature: small GET-heavy mix + one drive death +
     return — same contract as the matrix, sized for CI."""
@@ -204,7 +256,8 @@ def smoke_scenario(duration_s: float = 4.0) -> Scenario:
         timeline=[E(0.2 * duration_s, "drive_kill", drive=0),
                   E(0.6 * duration_s, "drive_return", drive=0)],
         duration_s=duration_s,
-        budget=_slo.Budget(converge_timeout_s=30.0))
+        budget=_slo.Budget(converge_timeout_s=30.0,
+                           require_no_forensics=True))
 
 
 def run_scenario(scenario: Scenario, base_dir: str,
@@ -212,8 +265,9 @@ def run_scenario(scenario: Scenario, base_dir: str,
     """One scenario end to end on a fresh cluster; returns the SLO
     assertion rows (never raises on an SLO miss — the rows carry
     pass/fail so the matrix completes)."""
-    env_prev = {k: os.environ.get(k) for k in _SOAK_ENV}
-    os.environ.update(_SOAK_ENV)
+    env_all = {**_SOAK_ENV, **scenario.env}
+    env_prev = {k: os.environ.get(k) for k in env_all}
+    os.environ.update(env_all)
     threads_before = _slo.settled_thread_count(deadline_s=2.0)
     thread_ids = {id(t) for t in threading.enumerate()}
     tls_manager = None
@@ -270,6 +324,9 @@ def run_scenario(scenario: Scenario, base_dir: str,
             recorder = gen.recorder
             chaos_log = {"applied": conductor.applied,
                          "errors": conductor.errors}
+            forensics = _forensic_summary(
+                cluster, expect_breach=bool(
+                    scenario.budget.expect_forensics))
         finally:
             cluster.stop()
         threads_after = _slo.settled_thread_count()
@@ -279,7 +336,7 @@ def run_scenario(scenario: Scenario, base_dir: str,
             budget=scenario.budget, scrape_text=scrape_text,
             convergence=conv, convergence_error=conv_err,
             threads_before=threads_before, threads_after=threads_after,
-            leaked=leaked)
+            leaked=leaked, forensics=forensics)
         if scenario.huge_put_bytes:
             rows.append({
                 "scenario": scenario.name,
@@ -314,6 +371,43 @@ def run_scenario(scenario: Scenario, base_dir: str,
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+
+
+def _forensic_summary(cluster, expect_breach: bool = False) -> dict:
+    """The forensic-plane verdict for one finished scenario: bundle
+    count from the node's engine, and (for the drill) whether the
+    newest bundle actually holds the breach window's request records
+    — 5xx completions in the flight-recorder error ring."""
+    fx = getattr(cluster.s3, "forensic", None)
+    if fx is None:
+        return {"dumped": 0, "engine": "disabled"}
+    fx.join(timeout=15.0)        # an in-flight bundle write finishes
+    bundles = fx.bundles()
+    out = {"dumped": len(bundles), "dir": fx.dir,
+           "bundles": [b["name"] for b in bundles]}
+    if expect_breach and bundles:
+        import json as _json
+        import zipfile as _zip
+        try:
+            with _zip.ZipFile(os.path.join(
+                    fx.dir, bundles[-1]["name"])) as z:
+                doc = _json.loads(z.read("flightrec.json"))
+            breach = [r for r in doc.get("errors", [])
+                      if r.get("status", 0) >= 500]
+            out["breach_records_ok"] = len(breach) > 0
+            out["breach_records"] = len(breach)
+            # ISSUE 15 acceptance: every request on the live 3-node
+            # cluster carries a COMPLETE stage timeline — the serial
+            # vector (incl. ``other``) reconciles with the duration
+            recs = [r for r in doc.get("requests", [])
+                    if r.get("stages")]
+            out["stage_timeline_ok"] = bool(recs) and all(
+                sum(r["stages"].values()) == r["durationNs"]
+                for r in recs)
+        except Exception as e:  # noqa: BLE001 — verdict rides the row
+            out["breach_records_ok"] = False
+            out["error"] = f"{type(e).__name__}: {e}"
+    return out
 
 
 class _SeededBody:

@@ -75,6 +75,13 @@ class Budget:
     # read-your-write digest oracle turns a stale cached body after an
     # overwrite into an IntegrityMismatch error this row pins at 0
     require_hot_read: bool = False
+    # forensic-plane rows (obs/forensic.py): clean matrix scenarios
+    # assert the trigger engine stayed quiet (zero bundles — ordinary
+    # chaos is not a breach); the induced-breach drill asserts exactly
+    # ``expect_forensics`` bundles landed, with the breach window's
+    # request records inside
+    require_no_forensics: bool = False
+    expect_forensics: int = 0
 
     def limits_for(self, api: str) -> tuple[float, float]:
         return self.per_api_ms.get(api, (self.p50_ms, self.p99_ms))
@@ -310,7 +317,8 @@ def evaluate(scenario: str, *, api_stats=None, api_pcts=None, recorder,
              budget: Budget, scrape_text: str, convergence: dict | None,
              convergence_error: str = "",
              threads_before: int = 0, threads_after: int = 0,
-             leaked: list[str] | None = None) -> list[dict]:
+             leaked: list[str] | None = None,
+             forensics: dict | None = None) -> list[dict]:
     """Every SLO assertion for one finished scenario, as
     ``{scenario, metric, value, unit, detail, passed}`` rows (the
     SOAK_r*.json shape).
@@ -412,6 +420,30 @@ def evaluate(scenario: str, *, api_stats=None, api_pcts=None, recorder,
         stale = recorder.error_codes.get("IntegrityMismatch", 0)
         row("stale_reads", stale, "reads", stale == 0,
             {"oracle": "per-worker read-your-write md5"})
+
+    # forensic-plane rows: clean scenarios must produce ZERO bundles
+    # (ordinary chaos is not a breach); the induced-breach drill must
+    # produce exactly its expected count, with the breach window's
+    # request records inside the bundle (report.py checks content and
+    # passes the verdict through ``forensics``)
+    if budget.require_no_forensics:
+        dumped = (forensics or {}).get("dumped", 0)
+        row("forensic_bundles", dumped, "bundles", dumped == 0,
+            {"require": "none", **(forensics or {})})
+    if budget.expect_forensics:
+        f = forensics or {}
+        dumped = f.get("dumped", 0)
+        # the bundle must hold the breach window's request records AND
+        # those records' stage timelines must reconcile with their
+        # durations — the ISSUE 15 live-cluster acceptance, enforced,
+        # not just carried as detail
+        content_ok = bool(f.get("breach_records_ok")) and \
+            bool(f.get("stage_timeline_ok", True))
+        row("forensic_bundles", dumped, "bundles",
+            dumped == budget.expect_forensics,
+            {"require": budget.expect_forensics, **f})
+        row("forensic_bundle_content", 1 if content_ok else 0, "bool",
+            content_ok, f)
 
     # heal convergence: MRF drained + classify_disks clean on all sets
     if convergence is not None:

@@ -35,6 +35,7 @@ import itertools
 import threading
 import time
 
+from ..obs import stages as _stages
 from ..obs import trace as _trace
 from . import errors as serrors
 from ..utils.locktrace import mtlock, mtrlock
@@ -77,14 +78,15 @@ class _Batch:
 
 
 class _Op:
-    __slots__ = ("stream", "idx", "fn", "batch", "rid")
+    __slots__ = ("stream", "idx", "fn", "batch", "rid", "clock")
 
-    def __init__(self, stream, idx, fn, batch, rid):
+    def __init__(self, stream, idx, fn, batch, rid, clock=None):
         self.stream = stream
         self.idx = idx
         self.fn = fn
         self.batch = batch
         self.rid = rid
+        self.clock = clock
 
     def run(self, disk) -> None:
         st = self.stream
@@ -92,8 +94,11 @@ class _Op:
             st._op_done(self.idx, None, self.batch, 0.0)
             return
         # per-drive spans must carry the originating request ID even
-        # though the worker thread outlives any one request
+        # though the worker thread outlives any one request; the X-ray
+        # clock rides along so a remote drive's RPC leg is attributed
+        # (async detail) to the right request
         _trace.set_request_id(self.rid)
+        _stages.set_clock(self.clock)
         t0 = time.perf_counter()
         try:
             self.fn(self.idx, disk)
@@ -202,12 +207,19 @@ class StreamWriter:
             if batch is not None:
                 batch.done_one()
             return False
-        op = _Op(self, idx, fn, batch, _trace.get_request_id())
+        op = _Op(self, idx, fn, batch, _trace.get_request_id(),
+                 _stages.current())
         with self._cv:
             self._pending += 1
             self._drive_pending[idx] += 1
         try:
+            # the enqueue may park at the per-drive queue bound — that
+            # wait is the ``write_enqueue`` X-ray stage
+            t0 = time.perf_counter()
             self._plane._enqueue(disk, op)
+            dt = time.perf_counter() - t0
+            if dt > 0.0005:
+                _stages.add("write_enqueue", int(dt * 1e9))
         except BaseException:
             with self._cv:
                 self._pending -= 1

@@ -188,11 +188,40 @@ register_subsys("cache", {
     # ``window_bytes`` is the coalescing/cache granule: requests inside
     # one window share one drive read + decode.  Live-reloadable
     # (S3Server.reload_cache_config on admin SetConfigKV).
+    # ``validate_ttl_ms``: sequential cache hits within this window
+    # reuse the last quorum validation (generation-fenced: any write
+    # commit or peer eviction voids the reuse instantly); 0 = every
+    # hit pays its own quorum metadata read
     "enable": "on",
     "max_bytes": "134217728",
     "heat_threshold": "2",
     "singleflight_queue": "64",
     "window_bytes": "8388608",
+    "validate_ttl_ms": "50",
+})
+register_subsys("forensic", {
+    # SLO-breach forensic bundles (obs/forensic.py): the trigger
+    # engine watches breach-shaped signals and snapshots the flight-
+    # recorder rings + live scrape + redacted config into a zip under
+    # ``dir`` (default: <first local drive>/.minio-tpu.sys/forensics),
+    # reaped oldest-first to ``max_bundles``/``max_bytes``.
+    # ``triggers`` is a csv subset of error_ceiling, breaker_burst,
+    # shed_burst, slow_drive, heal_backlog; each trigger fires at most
+    # once per ``cooldown``.  The error ceiling crosses when 5xx
+    # responses reach ``error_rate`` of at least ``error_min_samples``
+    # requests inside ``window``.
+    "enable": "on",
+    "dir": "",
+    "max_bundles": "8",
+    "max_bytes": "64MiB",
+    "cooldown": "60s",
+    "triggers": "error_ceiling",
+    "error_rate": "0.5",
+    "error_min_samples": "100",
+    "window": "10s",
+    "breaker_burst": "10",
+    "shed_burst": "50",
+    "backlog_growth": "500",
 })
 register_subsys("storage_class", {  # mt-lint: ok(kvconfig-drift) read per PUT (handlers_object.py) — validated at SetConfigKV time, applies to the next request
     "standard": "",                 # e.g. EC:4

@@ -149,8 +149,18 @@ class MemoryGovernor:
 
     def charge(self, nbytes: int, kind: str = "other") -> Charge:
         """Reserve ``nbytes`` for one request; raises MemoryPressure
-        when the node is past its watermark (shed, don't allocate)."""
-        return self._admit(nbytes, kind, shed=True)
+        when the node is past its watermark (shed, don't allocate).
+        The admission's wall time lands in the ``memgov`` X-ray stage
+        (obs/stages.py) — cheap bookkeeping, but a contended governor
+        lock under pressure is exactly what the X-ray must surface."""
+        import time as _time
+
+        from ..obs import stages as _stages
+        t0 = _time.monotonic_ns()
+        try:
+            return self._admit(nbytes, kind, shed=True)
+        finally:
+            _stages.add("memgov", _time.monotonic_ns() - t0)
 
     def try_charge(self, nbytes: int, kind: str = "other"
                    ) -> "Charge | None":

@@ -30,12 +30,25 @@ class Readahead:
     def __init__(self, it: Iterable, depth: int = 2):
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._closed = threading.Event()
+        # carry the caller's request context into the producer thread
+        # (the erasure fan-out discipline): storage spans keep their
+        # request ID and the X-ray clock still receives drive_read/
+        # decode attribution (as async detail — production overlaps
+        # the consumer by design)
+        from ..obs import stages as _stages
+        from ..obs import trace as _trace
+        self._rid = _trace.get_request_id()
+        self._clock = _stages.current()
         self._thread = threading.Thread(
             target=self._produce, args=(iter(it),), daemon=True,
             name="mt-readahead")
         self._thread.start()
 
     def _produce(self, it: Iterator) -> None:
+        from ..obs import stages as _stages
+        from ..obs import trace as _trace
+        _trace.set_request_id(self._rid)
+        _stages.set_clock(self._clock)
         try:
             for item in it:
                 while not self._closed.is_set():

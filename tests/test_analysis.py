@@ -268,6 +268,31 @@ def test_kvconfig_drift_canary(tmp_path):
     assert not clean, clean
 
 
+def test_obs_docs_drift_canary(tmp_path):
+    src = {"m.py": """
+        from ..obs import stages as _stages
+
+        def serve():
+            with _stages.stage("bogus_stage_x"):
+                pass
+            _stages.add_async("rpc_leg_y", 1)
+
+        def scrape(mtr):
+            mtr.inc("mt_forensic_bogus_total")
+        """}
+    bad = _lint(tmp_path, src,
+                docs={"observability.md": "# obs\nnothing here\n"})
+    msgs = [f.message for f in bad if f.rule == "obs-docs-drift"]
+    assert any("bogus_stage_x" in m for m in msgs), bad
+    assert any("rpc_leg_y" in m for m in msgs), msgs
+    assert any("mt_forensic_bogus_total" in m for m in msgs), msgs
+    clean = _lint(tmp_path, src, docs={"observability.md":
+                                       "| `bogus_stage_x` | doc |\n"
+                                       "| `rpc_leg_y` | doc |\n"
+                                       "`mt_forensic_bogus_total`\n"})
+    assert "obs-docs-drift" not in _rules_hit(clean), clean
+
+
 def test_tls_discipline_canary(tmp_path):
     bad = _lint(tmp_path, {"m.py": """
         import ssl

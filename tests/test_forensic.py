@@ -1,0 +1,194 @@
+"""Forensic bundle plane (ISSUE 15): the trigger engine (thresholds,
+per-trigger cooldown), the bounded bundle store (oldest-first
+reaping), the redaction fence (a real bundle must never carry planted
+secret markers — and neither may the xray/healthinfo surfaces), and
+the induced-breach soak drill: exactly one bundle with the breach
+window's request records inside, while the clean smoke scenario
+yields zero.
+"""
+
+import io
+import json
+import os
+import zipfile
+
+import pytest
+
+from minio_tpu.obs import forensic as fx_mod
+from minio_tpu.obs.forensic import ForensicSys, redact_config
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.s3.client import S3Client
+from minio_tpu.s3.server import S3Server
+from minio_tpu.storage.xl_storage import XLStorage
+
+
+@pytest.fixture
+def served(tmp_path):
+    disks = []
+    for i in range(4):
+        d = tmp_path / f"d{i}"
+        d.mkdir()
+        disks.append(XLStorage(str(d)))
+    layer = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                           backend="numpy")
+    srv = S3Server(layer, access_key="fk", secret_key="fs")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _bundle_bytes(fx: ForensicSys, name: str) -> bytes:
+    with open(os.path.join(fx.dir, name), "rb") as f:
+        return f.read()
+
+
+# -- redaction fence ---------------------------------------------------------
+
+def test_redact_config_blanks_secret_shaped_keys():
+    doc = redact_config({
+        "audit_webhook": {"auth_token": "tok-123", "endpoint": "http://x"},
+        "notify_redis": {"password": "hunter2", "address": "y"},
+        "api": {"requests_max": "16"},
+        "policy_opa": {"auth_token": ""},        # empty stays empty
+    })
+    assert doc["audit_webhook"]["auth_token"] == fx_mod.REDACTED
+    assert doc["audit_webhook"]["endpoint"] == "http://x"
+    assert doc["notify_redis"]["password"] == fx_mod.REDACTED
+    assert doc["api"]["requests_max"] == "16"
+    assert doc["policy_opa"]["auth_token"] == ""
+
+
+def test_bundle_and_obs_surfaces_never_leak_planted_secrets(served):
+    """Plant secret markers in the config, write a real bundle, grep
+    its raw bytes — and the xray/healthinfo replies — for them."""
+    markers = {
+        ("audit_webhook", "auth_token"): "FORBIDDEN-MARKER-AUDIT-77",
+        ("policy_opa", "auth_token"): "FORBIDDEN-MARKER-OPA-88",
+        ("logger_webhook", "auth_token"): "FORBIDDEN-MARKER-LOG-99",
+    }
+    for (sub, key), val in markers.items():
+        served.config.set(sub, key, val)
+    c = S3Client(served.endpoint, "fk", "fs")
+    c.make_bucket("redbkt")
+    c.put_object("redbkt", "obj", b"r" * 2048)
+    fx = served.forensic
+    assert fx is not None
+    assert fx.fire("manual", {"by": "test"}, sync=True)
+    bundles = fx.bundles()
+    assert bundles, "manual trigger wrote no bundle"
+    blob = _bundle_bytes(fx, bundles[-1]["name"])
+    # the zip members hold the markers nowhere (config redacted)
+    with zipfile.ZipFile(io.BytesIO(blob)) as z:
+        names = set(z.namelist())
+        assert {"trigger.json", "flightrec.json", "system.json",
+                "healthinfo.json", "config.json",
+                "metrics.prom"} <= names
+        all_bytes = b"".join(z.read(n) for n in names)
+    for val in markers.values():
+        assert val.encode() not in all_bytes, f"{val} leaked in bundle"
+    assert fx_mod.REDACTED.encode() in all_bytes
+    for route, qs in (("xray", "n=50&snapshot=true"),
+                      ("healthinfo", ""), ("forensics", "")):
+        body = c.request("GET", f"/minio-tpu/admin/v1/{route}", qs).body
+        for val in markers.values():
+            assert val.encode() not in body, f"{val} leaked in {route}"
+
+
+# -- bundle store ------------------------------------------------------------
+
+def test_bundle_dir_reaps_oldest_first(served, tmp_path):
+    fx = ForensicSys(served, str(tmp_path / "fdir"), max_bundles=2,
+                     cooldown_s=0.0)
+    for i in range(4):
+        assert fx.fire("manual", {"i": i}, sync=True)
+    bundles = fx.bundles()
+    assert len(bundles) == 2, bundles
+    # the survivors are the two NEWEST (suffix carries the fire count)
+    assert bundles[-1]["name"].endswith("-4.zip")
+    assert bundles[0]["name"].endswith("-3.zip")
+    assert fx.dumped == 4
+
+
+def test_trigger_cooldown_and_per_trigger_independence(served,
+                                                      tmp_path):
+    fx = ForensicSys(served, str(tmp_path / "fdir"), cooldown_s=3600.0)
+    assert fx.fire("manual", {}, sync=True)
+    assert fx.fire("manual", {}, sync=True) is None, \
+        "cooldown did not hold"
+    # a different trigger has its own cooldown clock
+    assert fx.fire("error_ceiling", {}, sync=True)
+
+
+# -- trigger evaluation ------------------------------------------------------
+
+def test_error_ceiling_trigger_crosses_on_majority_5xx(served,
+                                                       tmp_path):
+    fx = ForensicSys(served, str(tmp_path / "fdir"),
+                     triggers=("error_ceiling",), error_rate=0.5,
+                     error_min_samples=10, window_s=60.0)
+    for _ in range(6):
+        fx.observe_request(200)
+    assert fx.check() is None           # under min samples / rate
+    for _ in range(14):
+        fx.observe_request(503)
+    assert fx.check() == "error_ceiling"
+    fx.join()
+    assert len(fx.bundles()) == 1
+
+
+def test_breaker_burst_trigger_watches_open_count(served, tmp_path,
+                                                  monkeypatch):
+    from minio_tpu.parallel import rpc as _rpc
+    fx = ForensicSys(served, str(tmp_path / "fdir"),
+                     triggers=("breaker_burst",), breaker_burst=5)
+    assert fx.check() is None
+    monkeypatch.setattr(_rpc, "BREAKER_OPEN_COUNT",
+                        _rpc.BREAKER_OPEN_COUNT + 7)
+    assert fx.check() == "breaker_burst"
+    fx.join()
+
+
+def test_shed_burst_trigger(served, tmp_path, monkeypatch):
+    fx = ForensicSys(served, str(tmp_path / "fdir"),
+                     triggers=("shed_burst",), shed_burst=3)
+    assert fx.check() is None
+    monkeypatch.setattr(ForensicSys, "_shed_total",
+                        staticmethod(lambda: 10_000))
+    assert fx.check() == "shed_burst"
+    fx.join()
+
+
+# -- the induced-breach soak drill -------------------------------------------
+
+def test_forensic_drill_yields_exactly_one_bundle(tmp_path):
+    """The ISSUE 15 acceptance drill: burst_503 on both peer links +
+    a slow drive mid-storm crosses the (drill-lowered) error ceiling;
+    exactly one redacted, size-bounded bundle lands, holding the
+    breach window's request records; the SLO rows assert it."""
+    from minio_tpu.soak.report import (forensic_drill_scenario,
+                                       run_scenario)
+    rows = run_scenario(forensic_drill_scenario(duration_s=6.0),
+                        str(tmp_path / "drill"), seed=3)
+    by_metric = {}
+    for r in rows:
+        by_metric.setdefault(r["metric"], r)
+    fb = by_metric.get("forensic_bundles")
+    assert fb is not None, [r["metric"] for r in rows]
+    assert fb["passed"], fb
+    assert fb["value"] == 1, fb
+    content = by_metric.get("forensic_bundle_content")
+    assert content is not None and content["passed"], content
+    assert content["detail"].get("breach_records", 0) > 0, content
+    # the 3-node cluster's request records carry complete, reconciled
+    # stage timelines inside the bundle (ISSUE 15 acceptance)
+    assert content["detail"].get("stage_timeline_ok"), content
+
+
+def test_clean_smoke_scenario_yields_zero_bundles(tmp_path):
+    """require_no_forensics: ordinary chaos (a drive death + return)
+    must not fire the default trigger set."""
+    from minio_tpu.soak.report import run_scenario, smoke_scenario
+    rows = run_scenario(smoke_scenario(duration_s=3.0),
+                        str(tmp_path / "smoke"), seed=5)
+    fb = [r for r in rows if r["metric"] == "forensic_bundles"]
+    assert fb and fb[0]["passed"] and fb[0]["value"] == 0, fb

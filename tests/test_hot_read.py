@@ -65,11 +65,13 @@ def hot_cfg():
     duration of a test, restoring the live config after."""
     cfg = hotread.CONFIG
     saved = (cfg.enable, cfg.max_bytes, cfg.heat_threshold,
-             cfg.singleflight_queue, cfg.window_bytes, cfg._loaded)
+             cfg.singleflight_queue, cfg.window_bytes,
+             cfg.validate_ttl_ms, cfg._loaded)
     cfg.enable, cfg.heat_threshold, cfg._loaded = True, 1, True
     yield cfg
     (cfg.enable, cfg.max_bytes, cfg.heat_threshold,
-     cfg.singleflight_queue, cfg.window_bytes, cfg._loaded) = saved
+     cfg.singleflight_queue, cfg.window_bytes,
+     cfg.validate_ttl_ms, cfg._loaded) = saved
 
 
 # -- bit-identity -----------------------------------------------------------
@@ -603,3 +605,125 @@ def test_full_get_of_window_spanner_falls_through(tmp_path, hot_cfg):
     _, part = er.get_object("span", "big", 130 * 1024, 1000)
     _, part2 = er.get_object("span", "big", 130 * 1024, 1000)
     assert part == part2 == body[130 * 1024:130 * 1024 + 1000]
+
+
+# -- sequential hit-validation coalescing (ISSUE 15 satellite) ---------------
+
+def test_sequential_hits_coalesce_validation_reads(tmp_path, hot_cfg):
+    """Within ``cache.validate_ttl_ms``, SEQUENTIAL cache hits reuse
+    one quorum validation instead of paying a metadata fan-out per
+    hit (previously only CONCURRENT hits shared one read)."""
+    er = _layer(tmp_path)
+    er.hotread.heat_fn = lambda: 100
+    hot_cfg.validate_ttl_ms = 5000
+    er.make_bucket("seqv")
+    body = b"v" * 4096
+    er.put_object("seqv", "k", body)
+    er.get_object("seqv", "k")          # fill
+    er.get_object("seqv", "k")          # first hit primes the validator
+    calls = [0]
+    real = er._hot_fileinfo
+
+    def counting(*a, **k):
+        calls[0] += 1
+        return real(*a, **k)
+
+    er._hot_fileinfo = counting
+    before = er.hotread.validations_coalesced
+    for _ in range(5):
+        _, got = er.get_object("seqv", "k")
+        assert got == body
+    assert calls[0] == 0, "sequential hits still paid quorum reads"
+    assert er.hotread.validations_coalesced >= before + 5
+    # after the overwrite fence, the next hit revalidates for real
+    er.put_object("seqv", "k", b"w" * 4096)
+    er.get_object("seqv", "k")
+    er.get_object("seqv", "k")
+    assert calls[0] > 0
+
+
+def test_overwrite_voids_validator_ttl_stale_read_impossible(
+        tmp_path, hot_cfg):
+    """Stale-read impossibility with the TTL validator armed: an acked
+    overwrite bumps the key's generation inside its write-locked
+    commit, which voids the cached validation INSTANTLY — a reader
+    arriving inside the TTL window must see the new bytes (never the
+    cached window the old validation vouched for)."""
+    er = _layer(tmp_path)
+    er.hotread.heat_fn = lambda: 100
+    hot_cfg.validate_ttl_ms = 60_000        # TTL alone would be stale
+    er.make_bucket("fence")
+    er.put_object("fence", "k", b"old" * 1000)
+    er.get_object("fence", "k")             # fill
+    _, got = er.get_object("fence", "k")    # hit + prime validator
+    assert got == b"old" * 1000
+    er.put_object("fence", "k", b"new" * 1000)   # acked overwrite
+    _, got = er.get_object("fence", "k")    # inside the TTL window
+    assert got == b"new" * 1000, "TTL validator served a stale body"
+    # the monotonic drill from above, with the TTL maxed: still no
+    # stale read, because the generation fence outranks the TTL
+    pad = b"y" * 1024
+    acked = [0]
+    stop = threading.Event()
+    errs: list = []
+
+    def writer():
+        try:
+            for seq in range(1, 60):
+                if stop.is_set():
+                    return
+                er.put_object("fence", "r",
+                              seq.to_bytes(8, "big") + pad)
+                acked[0] = seq
+        except Exception as e:  # noqa: BLE001 — surfaces in assert
+            errs.append(e)
+        finally:
+            stop.set()
+
+    def reader():
+        try:
+            while not stop.is_set():
+                floor = acked[0]
+                try:
+                    _, data = er.get_object("fence", "r")
+                except Exception:  # noqa: BLE001 — not yet written
+                    continue
+                got = int.from_bytes(data[:8], "big")
+                if got < floor:
+                    errs.append(AssertionError(
+                        f"stale read: saw {got} after {floor} acked"))
+                    stop.set()
+                    return
+        except Exception as e:  # noqa: BLE001 — surfaces in assert
+            errs.append(e)
+            stop.set()
+
+    er.put_object("fence", "r", (0).to_bytes(8, "big") + pad)
+    ths = [threading.Thread(target=writer)] + \
+        [threading.Thread(target=reader) for _ in range(2)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=120)
+    assert not errs, errs
+
+
+def test_validator_ttl_zero_restores_per_hit_validation(tmp_path,
+                                                        hot_cfg):
+    er = _layer(tmp_path)
+    er.hotread.heat_fn = lambda: 100
+    hot_cfg.validate_ttl_ms = 0
+    er.make_bucket("nottl")
+    er.put_object("nottl", "k", b"z" * 2048)
+    er.get_object("nottl", "k")             # fill
+    calls = [0]
+    real = er._hot_fileinfo
+
+    def counting(*a, **k):
+        calls[0] += 1
+        return real(*a, **k)
+
+    er._hot_fileinfo = counting
+    for _ in range(3):
+        er.get_object("nottl", "k")
+    assert calls[0] >= 3, "ttl=0 must validate every hit"

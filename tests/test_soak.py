@@ -447,7 +447,9 @@ def test_full_matrix_all_mixes_pass_slo(tmp_path):
         soak_report.default_matrix(duration_s=10.0),
         out_path=str(out), base_dir=str(tmp_path / "mx"))
     assert len(report["scenarios"]) >= 5
-    assert report["scenarios"] == list(MIXES)
+    # the matrix leads with every production mix; drill scenarios
+    # (huge_put, forensic_drill, tls_storm) ride behind them
+    assert report["scenarios"][:len(MIXES)] == list(MIXES)
     failed = [r for r in report["rows"] if not r["passed"]]
     assert not failed, failed
     doc = json.loads(out.read_text())
