@@ -204,6 +204,20 @@ class AdminClient:
     def replication_stats(self) -> dict:
         return self._call("GET", "replication-stats")
 
+    def set_remote_target(self, source_bucket: str, target: dict) -> None:
+        """Attach a bucket replication target (madmin SetRemoteTarget);
+        ``target`` holds the ReplicationTarget fields."""
+        self._call("POST", "set-remote-target", body=json.dumps(
+            {"sourceBucket": source_bucket, **target}).encode())
+
+    def list_remote_targets(self) -> dict:
+        return self._call("GET", "list-remote-targets")
+
+    def remove_remote_target(self, bucket: str) -> None:
+        """Detach a bucket's replication target (madmin
+        RemoveRemoteTarget); queued records for it stop replicating."""
+        self._call("POST", "remove-remote-target", f"bucket={bucket}")
+
     def set_bandwidth_limit(self, bucket: str, limit: int) -> None:
         self._call("POST", "set-bandwidth-limit",
                    f"bucket={bucket}&limit={limit}")
@@ -225,3 +239,35 @@ class AdminClient:
 
     def kms_key_status(self) -> dict:
         return self._call("GET", "kms-key-status")
+
+    # -- elastic topology ---------------------------------------------------
+
+    def pool_status(self) -> dict:
+        """Per-pool topology: index, id, status (active|draining),
+        geometry, free bytes, plus crawler usage when a scan ran."""
+        return self._call("GET", "pool-status")
+
+    def pool_add(self, dirs: list[str], set_count: int,
+                 set_drive_count: int, **kwargs) -> dict:
+        """Attach a new erasure-sets pool under live traffic; the pool
+        manifest is rewritten so the expansion survives restarts."""
+        doc = {"dirs": dirs, "setCount": set_count,
+               "setDriveCount": set_drive_count}
+        if kwargs:
+            doc["kwargs"] = kwargs
+        return self._call("POST", "pool-add",
+                          body=json.dumps(doc).encode())
+
+    def pool_decommission(self, pool) -> dict:
+        """Mark a pool draining (index or pool id): new writes route
+        elsewhere and the rebalancer moves everything off."""
+        return self._call("POST", "pool-decommission", f"pool={pool}")
+
+    def pool_decommission_abort(self, pool) -> dict:
+        return self._call("POST", "pool-decommission-abort",
+                          f"pool={pool}")
+
+    def rebalance_status(self) -> dict | None:
+        """Live rebalance plane: enabled flag, draining pools, moved
+        objects/bytes, bandwidth report, cycle progress."""
+        return self._call("GET", "rebalance-status")

@@ -207,8 +207,13 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
                 except Exception as e:  # noqa: BLE001
                     disks.append({"set": si, "endpoint": d.endpoint(),
                                   "state": "offline", "error": str(e)})
-            return send_json({"disks": disks,
-                              "backend": "erasure-tpu"}) or True
+            out = {"disks": disks, "backend": "erasure-tpu"}
+            ps = getattr(srv.layer, "pool_status", None)
+            if ps is not None:
+                pools = ps()
+                _merge_pool_usage(srv, pools)
+                out["pools"] = pools
+            return send_json(out) or True
         if route == "top-locks" and h.command == "GET":
             # madmin TopLocks: currently-held namespace locks
             out = []
@@ -298,6 +303,71 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
             repl = srv.replication
             return send_json(
                 repl.stats.to_dict() if repl else {}) or True
+        if route == "pool-status" and h.command == "GET":
+            ps = getattr(srv.layer, "pool_status", None)
+            if ps is None:
+                return send_json({"error": "not a pooled deployment"},
+                                 400) or True
+            pools = ps()
+            _merge_pool_usage(srv, pools)
+            return send_json({"pools": pools}) or True
+        if route == "pool-add" and h.command == "POST":
+            # elastic expansion: attach a new erasure-sets pool under
+            # live traffic; the manifest write makes it durable
+            layer = srv.layer
+            if not hasattr(layer, "attach_pool"):
+                return send_json({"error": "not a pooled deployment"},
+                                 400) or True
+            doc = json.loads(payload)
+            try:
+                idx = layer.attach_pool(
+                    doc["dirs"], int(doc["setCount"]),
+                    int(doc["setDriveCount"]), **doc.get("kwargs", {}))
+            except ValueError as e:
+                return send_json({"error": str(e)}, 400) or True
+            rb = _rebalancer(srv)
+            if rb is not None:
+                rb.kick()      # let the balancer spread toward it now
+            return send_json({"status": "ok", "pool": idx}) or True
+        if route == "pool-decommission" and h.command == "POST":
+            layer = srv.layer
+            if not hasattr(layer, "start_decommission"):
+                return send_json({"error": "not a pooled deployment"},
+                                 400) or True
+            try:
+                idx = layer.start_decommission(_pool_arg(q1))
+            except ValueError as e:
+                return send_json({"error": str(e)}, 400) or True
+            rb = _rebalancer(srv)
+            if rb is not None:
+                rb.kick()      # start draining without waiting a cycle
+            return send_json({"status": "draining", "pool": idx}) or True
+        if route == "pool-decommission-abort" and h.command == "POST":
+            layer = srv.layer
+            if not hasattr(layer, "abort_decommission"):
+                return send_json({"error": "not a pooled deployment"},
+                                 400) or True
+            try:
+                idx = layer.abort_decommission(_pool_arg(q1))
+            except ValueError as e:
+                return send_json({"error": str(e)}, 400) or True
+            return send_json({"status": "active", "pool": idx}) or True
+        if route == "rebalance-status" and h.command == "GET":
+            rb = _rebalancer(srv)
+            return send_json(
+                rb.status() if rb is not None else None) or True
+        if route == "remove-remote-target" and h.command == "POST":
+            repl = srv.replication
+            if repl is None:
+                return send_json({"error": "replication not enabled"},
+                                 400) or True
+            bucket = q1["bucket"]
+            if repl.get_target(bucket) is None:
+                return send_json(
+                    {"error": f"no remote target for {bucket!r}"},
+                    404) or True
+            repl.remove_target(bucket)
+            return send_json({"status": "ok"}) or True
         if route == "set-remote-target" and h.command == "POST":
             from ..background.replication import (ReplicationSys,
                                                   ReplicationTarget)
@@ -560,6 +630,45 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
     raise S3Error("MethodNotAllowed")
 
 
+def _rebalancer(srv):
+    """The attached rebalance plane, if any — duck-typed the same way
+    reload_background_config finds it: the background service carrying
+    a ``bandwidth_bps`` knob (an explicit ``srv.rebalancer`` wins)."""
+    rb = getattr(srv, "rebalancer", None)
+    if rb is not None:
+        return rb
+    for svc in getattr(srv, "_background", []):
+        if hasattr(svc, "bandwidth_bps"):
+            return svc
+    return None
+
+
+def _pool_arg(q1):
+    """?pool= accepts an index or a pool (deployment) id; indices are
+    all-digit strings, ids are uuids — never ambiguous."""
+    p = q1["pool"]
+    return int(p) if p.isdigit() else p
+
+
+def _merge_pool_usage(srv, pools: list) -> None:
+    """Fold the crawler's per-pool usage (bytes/objects) into
+    pool-status rows, matched by pool id.  Best-effort: a deployment
+    that never ran a scan just lacks the usage keys."""
+    try:
+        from ..background.crawler import load_usage
+        info = load_usage(srv.layer)
+    except Exception:   # noqa: BLE001 — degraded system volume
+        return
+    pu = getattr(info, "pools_usage", None) if info is not None else None
+    if not pu:
+        return
+    for row in pools:
+        u = pu.get(row.get("id", ""))
+        if u:
+            row["usedBytes"] = u.get("bytes", 0)
+            row["objects"] = u.get("objects", 0)
+
+
 def _drive_paths(srv) -> list:
     """Local drive roots across pools/sets (for healthinfo probes);
     the traversal lives with the selftest probes that share it."""
@@ -646,7 +755,8 @@ def _render_local(srv, node=None) -> str:
         crawler=getattr(srv, "crawler", None), node=node,
         egress=getattr(srv, "egress", None),
         mrf=getattr(srv, "mrf", None),
-        flightrec=getattr(srv, "flightrec", None))
+        flightrec=getattr(srv, "flightrec", None),
+        rebalancer=_rebalancer(srv))
 
 
 _CLUSTER_SCRAPE_TTL_S = 2.0
@@ -721,6 +831,7 @@ def background_status(srv) -> dict:
     crawler = getattr(srv, "crawler", None)
     repl = getattr(srv, "replication", None)
     mrf = getattr(srv, "mrf", None)
+    rb = _rebalancer(srv)
     return {
         "healing": {"progress": healer.progress.snapshot(),
                     "stats": healer.stats.to_dict()}
@@ -735,6 +846,7 @@ def background_status(srv) -> dict:
         "mrf": {"progress": mrf.progress.snapshot(),
                 "stats": mrf.stats.to_dict()}
         if mrf is not None else None,
+        "rebalance": rb.status() if rb is not None else None,
     }
 
 
@@ -1076,9 +1188,9 @@ def _config(h, srv, route, q1, payload, send_json) -> bool:
             # hot-object cache) on the live GET path; disabling
             # releases every cached byte back to the governor
             srv.reload_cache_config()
-        if parts[1] in ("heal", "scanner"):
-            # retune heal/scan IO self-pacing on the attached
-            # background planes
+        if parts[1] in ("heal", "scanner", "rebalance"):
+            # retune heal/scan/rebalance IO self-pacing on the
+            # attached background planes
             srv.reload_background_config()
         if parts[1] == "policy_opa":
             # swap the external policy webhook under the live IAM

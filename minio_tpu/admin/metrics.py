@@ -103,7 +103,8 @@ def _fmt_labels(labels: tuple, extra: str = "") -> str:
 
 def render(layer=None, healer=None, config=None, api_stats=None,
            replication=None, crawler=None, node=None,
-           egress=None, mrf=None, flightrec=None) -> str:
+           egress=None, mrf=None, flightrec=None,
+           rebalancer=None) -> str:
     """Prometheus text format: counters + histograms + live gauges.
 
     ``config`` (a kvconfig Config) supplies the slow-drive knobs at
@@ -229,6 +230,11 @@ def render(layer=None, healer=None, config=None, api_stats=None,
     if replication is not None:
         try:
             lines += _replication_gauges(replication)
+        except Exception:  # noqa: BLE001 — a scrape must never fail
+            pass
+    if rebalancer is not None:
+        try:
+            lines += _rebalance_metrics(rebalancer)
         except Exception:  # noqa: BLE001 — a scrape must never fail
             pass
     if egress is not None:
@@ -376,6 +382,20 @@ def _bucket_usage_gauges(layer) -> list[str]:
             lines.append(
                 "mt_bucket_objects_size_distribution"
                 f'{{bucket="{b}",range="{rng}"}} {u.histogram[rng]}')
+    if usage.pools_usage:
+        # elastic topology: per-pool residency from the same scan —
+        # skew between pools is what drives the rebalancer.  A
+        # non-pooled deployment's usage doc has no pools section, so
+        # the families stay absent (idle contract).
+        lines += ["# TYPE mt_pool_usage_bytes gauge",
+                  "# TYPE mt_pool_usage_objects gauge"]
+        for pid in sorted(usage.pools_usage):
+            u = usage.pools_usage[pid]
+            pl = _fmt_labels((("pool", pid),))
+            lines.append(f"mt_pool_usage_bytes{pl}"
+                         f" {u.get('bytes', 0)}")
+            lines.append(f"mt_pool_usage_objects{pl}"
+                         f" {u.get('objects', 0)}")
     return lines
 
 
@@ -477,6 +497,25 @@ def _replication_gauges(replication) -> list[str]:
             lines.append(
                 "mt_bucket_bandwidth_moved_bytes_total"
                 f"{bl} {r['totalBytesMoved']}")
+    return lines
+
+
+def _rebalance_metrics(rebalancer) -> list[str]:
+    """Rebalance-plane families (background/rebalance.py): lifetime
+    move counters plus the live cycle's rate gauges — the drain/expand
+    progress an operator watches during a topology change."""
+    st = rebalancer.stats
+    lines = [
+        "# TYPE mt_rebalance_moved_objects_total counter",
+        f"mt_rebalance_moved_objects_total {st.moved_objects}",
+        "# TYPE mt_rebalance_moved_bytes_total counter",
+        f"mt_rebalance_moved_bytes_total {st.moved_bytes}",
+        "# TYPE mt_rebalance_failed_total counter",
+        f"mt_rebalance_failed_total {st.failed}",
+        "# TYPE mt_rebalance_cycles_total counter",
+        f"mt_rebalance_cycles_total {st.cycles}",
+    ]
+    lines += _progress_gauges("mt_rebalance", rebalancer.progress)
     return lines
 
 

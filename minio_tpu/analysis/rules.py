@@ -792,6 +792,40 @@ class NamedSkipRule(Rule):
         return False
 
 
+class PoolRoutingRule(Rule):
+    id = "pool-routing"
+    description = ("``<x>.pools[<literal int>]`` outside "
+                   "objectlayer/pools.py hardwires a pool position — "
+                   "elastic topology (pool add/decommission) shifts "
+                   "indexes, so route through the pools layer "
+                   "(get_pool_idx/_find_pool) instead")
+
+    _EXEMPT = "minio_tpu/objectlayer/pools.py"
+
+    def check_module(self, mod: Module):
+        if mod.rel == self._EXEMPT:
+            # the pools layer OWNS placement: pool 0 is its documented
+            # system-volume anchor, every other index flows through it
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Subscript):
+                continue
+            if _last_segment(node.value) != "pools":
+                continue
+            idx = node.slice
+            if isinstance(idx, ast.UnaryOp) and \
+                    isinstance(idx.op, ast.USub):
+                idx = idx.operand
+            if not (isinstance(idx, ast.Constant)
+                    and isinstance(idx.value, int)):
+                continue             # computed indexes came FROM the router
+            yield Finding(
+                mod.rel, node.lineno, self.id,
+                f"direct pool indexing ({_safe_unparse(node)}) — "
+                "pool positions shift on add/decommission; go through "
+                "the pools layer's router instead")
+
+
 ALL_RULES = [
     BareExceptRule,
     MutableDefaultRule,
@@ -804,4 +838,5 @@ ALL_RULES = [
     ObsDocsDriftRule,
     TlsDisciplineRule,
     NamedSkipRule,
+    PoolRoutingRule,
 ]

@@ -55,9 +55,12 @@ class DataUsageInfo:
     objects_total_count: int = 0
     objects_total_size: int = 0
     bucket_usage: dict[str, BucketUsage] = field(default_factory=dict)
+    # pool_id -> {"bytes", "objects"} on pooled layers (elastic
+    # topology: the rebalancer and admin pool-status read skew here)
+    pools_usage: dict[str, dict] = field(default_factory=dict)
 
     def to_json(self) -> bytes:
-        return json.dumps({
+        doc = {
             "lastUpdate": self.last_update_ns,
             "bucketsCount": self.buckets_count,
             "objectsCount": self.objects_total_count,
@@ -68,7 +71,10 @@ class DataUsageInfo:
                     "size": u.size,
                     "objectsSizesHistogram": u.histogram}
                 for b, u in self.bucket_usage.items()},
-        }).encode()
+        }
+        if self.pools_usage:    # absent pre-pools shape stays identical
+            doc["poolsUsageInfo"] = self.pools_usage
+        return json.dumps(doc).encode()
 
     @classmethod
     def from_json(cls, blob: bytes) -> "DataUsageInfo":
@@ -81,7 +87,35 @@ class DataUsageInfo:
             out.bucket_usage[b] = BucketUsage(
                 u.get("objectsCount", 0), u.get("versionsCount", 0),
                 u.get("size", 0), u.get("objectsSizesHistogram", {}))
+        out.pools_usage = doc.get("poolsUsageInfo", {})
         return out
+
+
+def _list_versions_with_pools(layer, bucket: str):
+    """(merged versions, per-pool usage) in ONE listing pass.
+
+    On a pooled layer, listing each pool separately and merging here
+    keeps the usage scan at the same drive cost it always had while the
+    per-pool accounting rides the traversal for free — re-listing per
+    pool would double every cycle's IO.  Merge semantics match
+    ErasureServerPools.list_object_versions: first pool wins a
+    duplicated (name, version_id)."""
+    pools = getattr(layer, "pools", None)
+    specs = getattr(layer, "specs", None)
+    if not pools or not specs:
+        return layer.list_object_versions(bucket), None
+    per_pool: dict[str, dict] = {}
+    merged: dict[tuple, object] = {}
+    for pool, spec in zip(pools, specs):
+        acc = per_pool.setdefault(spec.pool_id,
+                                  {"bytes": 0, "objects": 0})
+        for oi in pool.list_object_versions(bucket):
+            if not oi.delete_marker:
+                acc["bytes"] += oi.size
+                acc["objects"] += 1
+            merged.setdefault((oi.name, oi.version_id), oi)
+    versions = sorted(merged.values(), key=lambda o: o.name)
+    return versions, per_pool
 
 
 def _histogram_bucket(size: int) -> str:
@@ -126,7 +160,13 @@ def scan_usage(layer, bucket_meta=None, apply_lifecycle: bool = True,
                 lc = None
         skip_ilm = (tracker is not None and since_cycle is not None
                     and not tracker.changed_since(since_cycle, b.name))
-        versions = layer.list_object_versions(b.name)
+        versions, per_pool = _list_versions_with_pools(layer, b.name)
+        if per_pool:
+            for pid, acc in per_pool.items():
+                pu = info.pools_usage.setdefault(
+                    pid, {"bytes": 0, "objects": 0})
+                pu["bytes"] += acc["bytes"]
+                pu["objects"] += acc["objects"]
         # a noncurrent version "became noncurrent" when the version that
         # directly superseded it was written — NOT when the latest version
         # was (cmd/bucket-lifecycle NoncurrentVersion* uses successor

@@ -1,0 +1,485 @@
+"""Rebalance/decommission drain plane (cmd/erasure-server-pool-rebalance.go,
+cmd/erasure-server-pool-decom.go).
+
+Drains object versions off a source pool — a draining pool during
+decommission, else the most over-filled pool when the per-pool free
+fractions spread past a threshold — toward the under-filled pools the
+free-space router already prefers.  Every move is an idempotent
+copy-verify-delete carrying the version's commit-time identity
+bit-identically (version id, mod time, ETag, user metadata, multipart
+part table); progress is driven by a persisted journal (per-bucket
+cursor, quorum-written next to the pool manifest), so a crash or
+restart resumes mid-namespace without re-listing finished buckets and
+without ever duplicating or losing a version.
+
+Pacing mirrors the healer: the ``rebalance`` kvconfig subsystem's
+bandwidth cap runs through the replication BandwidthMonitor token
+bucket, and ``pace_s`` yields the drives to foreground traffic after
+each move.  Move failures land flight-recorder rows so a support
+bundle explains a stuck drain.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from ..objectlayer.interface import (MethodNotAllowed, ObjectNotFound,
+                                     ObjectOptions, PutObjectOptions,
+                                     VersionNotFound)
+from .progress import CycleProgress
+from .replication import BandwidthMonitor
+
+JOURNAL_PATH = "rebalance/journal.json"
+# throttle bucket name in the BandwidthMonitor (not an S3 bucket)
+_BW_KEY = "rebalance"
+
+
+def _is_plain_md5(etag: str) -> bool:
+    if len(etag) != 32:
+        return False
+    try:
+        int(etag, 16)
+        return True
+    except ValueError:
+        return False
+
+
+@dataclass
+class RebalanceStats:
+    """madmin rebalance status counters."""
+    moved_objects: int = 0
+    moved_bytes: int = 0
+    failed: int = 0
+    skipped: int = 0
+    cycles: int = 0
+    last_cycle_ns: int = 0
+
+    def to_dict(self) -> dict:
+        return {
+            "movedObjects": self.moved_objects,
+            "movedBytes": self.moved_bytes,
+            "failed": self.failed,
+            "skipped": self.skipped,
+            "cycles": self.cycles,
+            "lastCycle": self.last_cycle_ns,
+        }
+
+
+def move_version(pools, src_idx: int, dst_idx: int, bucket: str,
+                 oi) -> int:
+    """Idempotent copy-verify-delete of ONE version from pool src_idx to
+    pool dst_idx.  Returns bytes copied (0 when the destination already
+    held the version — the crash-resume skip).
+
+    The destination commit happens behind the destination set's
+    ns-write lock (put_object / complete_multipart_upload take it), and
+    the source pool's hot-read generation and metacache are invalidated
+    BEFORE the source delete, so a read served mid-move sees either the
+    source version or its bit-identical destination copy — never a half
+    object, never neither.
+    """
+    src, dst = pools.pools[src_idx], pools.pools[dst_idx]
+    name, vid = oi.name, oi.version_id or ""
+    ropts = ObjectOptions(version_id=vid) if vid else None
+    copied = 0
+    if not _dest_has_version(dst, bucket, oi):
+        if oi.delete_marker:
+            _copy_delete_marker(dst, bucket, oi)
+        elif "-" in (oi.etag or "") and oi.parts:
+            copied = _copy_multipart(src, dst, bucket, oi, ropts)
+        else:
+            _, data = src.get_object(bucket, name, opts=ropts)
+            popts = PutObjectOptions(
+                user_defined=dict(oi.user_defined), versioned=bool(vid),
+                version_id=vid, mod_time=oi.mod_time,
+                preserve_etag=oi.etag)
+            if _is_plain_md5(oi.etag):
+                # the write path's Content-MD5 check IS the verify step:
+                # a corrupted read raises BadDigest before any dest
+                # version becomes visible
+                popts.content_md5 = oi.etag
+            dst.put_object(bucket, name, data, popts)
+            copied = oi.size
+    # hot-read generation bump + metacache invalidate on the SOURCE
+    # before its delete: a cached hot read must re-probe and find the
+    # destination copy instead of serving a deleted generation
+    leaf = src.get_hashed_set(name) if hasattr(src, "get_hashed_set") \
+        else src
+    try:
+        leaf._hot_invalidate(bucket, name)
+        leaf.metacache.invalidate(bucket)
+    except Exception:  # noqa: BLE001 — fence is best-effort extra
+        pass           # (delete_object repeats it under the ns lock)
+    src.delete_object(bucket, name, ObjectOptions(version_id=vid))
+    return copied
+
+
+def _dest_has_version(dst, bucket: str, oi) -> bool:
+    """Crash-resume probe: did a previous attempt already land this
+    version on the destination?"""
+    vid = oi.version_id or ""
+    try:
+        doi = dst.get_object_info(
+            bucket, oi.name, ObjectOptions(version_id=vid) if vid else None)
+    except (ObjectNotFound, VersionNotFound):
+        return False
+    except MethodNotAllowed:
+        # destination's version is a delete marker
+        return oi.delete_marker
+    if vid:
+        return True
+    # null-version case: the destination may hold a NEWER overwrite
+    # (routed there after the drain started) — treat equal-or-newer as
+    # moved; older means a racing stale copy we must overwrite
+    return doi.mod_time >= oi.mod_time
+
+
+def _copy_delete_marker(dst, bucket: str, oi) -> None:
+    """Re-create a delete-marker version bit-identically on the
+    destination's hashed set (markers carry no data; put_object can't
+    mint them with a chosen version id)."""
+    from ..objectlayer import metadata as meta
+    from ..objectlayer.interface import WriteQuorumError
+    from ..storage import errors as serrors
+    from ..storage.datatypes import FileInfo
+    leaf = dst.get_hashed_set(oi.name) if hasattr(dst, "get_hashed_set") \
+        else dst
+    dm = FileInfo(volume=bucket, name=oi.name, version_id=oi.version_id,
+                  deleted=True, data_dir="", mod_time=oi.mod_time)
+    lk = leaf.ns_lock.new_lock(bucket, oi.name)
+    lk.lock(write=True)
+    try:
+        _, errs = leaf._fanout(
+            lambda d: d.delete_version(bucket, oi.name, dm,
+                                       force_del_marker=True))
+        try:
+            meta.reduce_errs(errs, leaf._write_quorum(), WriteQuorumError)
+        except serrors.StorageError as e:
+            raise WriteQuorumError(str(e)) from e
+        leaf._hot_invalidate(bucket, oi.name)
+        leaf.metacache.invalidate(bucket)
+    finally:
+        lk.unlock()
+
+
+def _copy_multipart(src, dst, bucket: str, oi, ropts) -> int:
+    """Part-by-part move preserving the part table: ranged reads at the
+    source's recorded part boundaries re-upload through the destination
+    multipart path, so per-part files, part md5s, and therefore the
+    merged ``md5(concat)-N`` ETag all come out bit-identical."""
+    vid = oi.version_id or ""
+    uid = dst.new_multipart_upload(
+        bucket, oi.name,
+        PutObjectOptions(user_defined=dict(oi.user_defined),
+                         versioned=bool(vid)))
+    try:
+        done = []
+        offset = 0
+        for num, size in oi.parts:
+            _, data = src.get_object(bucket, oi.name, offset, size, ropts)
+            pi = dst.put_object_part(bucket, oi.name, uid, num, data)
+            done.append((num, pi.etag))
+            offset += size
+        noi = dst.complete_multipart_upload(
+            bucket, oi.name, uid, done,
+            PutObjectOptions(versioned=bool(vid), version_id=vid,
+                             mod_time=oi.mod_time))
+    except BaseException:
+        try:
+            dst.abort_multipart_upload(bucket, oi.name, uid)
+        except Exception:  # noqa: BLE001 — upload gc sweeps leftovers
+            pass
+        raise
+    if noi.etag != oi.etag:
+        # verify failed: remove the mismatched copy, keep the source
+        dst.delete_object(bucket, oi.name, ObjectOptions(version_id=vid))
+        raise ValueError(
+            f"multipart move etag mismatch: {noi.etag} != {oi.etag}")
+    return oi.size
+
+
+@dataclass
+class Rebalancer:
+    """Journal-driven drain loop, shaped like BackgroundHealer: a
+    daemon thread wakes every ``interval_s`` (or on ``kick()``), picks
+    a source pool — draining pools first, else the most over-filled
+    when the free-fraction spread exceeds ``threshold`` — and drains it
+    bucket by bucket, persisting the journal after every moved key."""
+
+    pools: object                      # ErasureServerPools
+    interval_s: float = 60.0
+    pace_s: float = 0.0                # heal-style IO self-pacing
+    bandwidth_bps: int = 0             # 0 = unthrottled
+    max_workers: int = 1
+    enabled: bool = True
+    threshold: float = 0.1             # free-fraction spread trigger
+    flightrec: object = None
+    stats: RebalanceStats = field(default_factory=RebalanceStats)
+
+    def __post_init__(self):
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._journal_seq = 0
+        self.progress = CycleProgress("rebalance")
+        self.monitor = BandwidthMonitor()
+
+    # -- journal (quorum-persisted beside the pool manifest) ---------------
+
+    def _save_journal(self, doc: dict) -> None:
+        from ..storage.xl_storage import SYS_DIR
+        self._journal_seq += 1
+        doc["seq"] = self._journal_seq
+        blob = json.dumps(doc).encode()
+        self.pools._fanout(lambda d: d.write_all(SYS_DIR, JOURNAL_PATH,
+                                                 blob))
+
+    def load_journal(self) -> dict | None:
+        """Highest-seq readable replica, like the pool manifest."""
+        from ..storage.xl_storage import SYS_DIR
+        res, _ = self.pools._fanout(
+            lambda d: d.read_all(SYS_DIR, JOURNAL_PATH))
+        best = None
+        for blob in res:
+            if blob is None:
+                continue
+            try:
+                doc = json.loads(blob)
+            except ValueError:
+                continue
+            if best is None or doc.get("seq", 0) > best.get("seq", 0):
+                best = doc
+        if best is not None:
+            self._journal_seq = max(self._journal_seq,
+                                    best.get("seq", 0))
+        return best
+
+    # -- source selection --------------------------------------------------
+
+    def _pool_free_fractions(self) -> list[float]:
+        out = []
+        for p in self.pools.pools:
+            free = total = 0
+            for s in p.sets:
+                for d in s.disks:
+                    if d is None:
+                        continue
+                    try:
+                        di = d.disk_info()
+                        free += di.free
+                        total += di.total
+                    except Exception:  # noqa: BLE001 — offline drive
+                        pass
+            out.append(free / total if total else 1.0)
+        return out
+
+    def pick_source(self) -> int | None:
+        """Draining pools drain unconditionally; otherwise rebalance
+        only when the free-fraction spread says the pools diverged."""
+        from ..objectlayer.pools import STATUS_DRAINING
+        specs = getattr(self.pools, "specs", [])
+        for i, sp in enumerate(specs):
+            if sp.status == STATUS_DRAINING:
+                return i
+        active = self.pools._active_idxs()
+        if len(active) < 2:
+            return None
+        fracs = self._pool_free_fractions()
+        lo = min(active, key=lambda i: fracs[i])
+        hi = max(active, key=lambda i: fracs[i])
+        if fracs[hi] - fracs[lo] <= self.threshold:
+            return None
+        return lo
+
+    def _pick_dest(self, src_idx: int) -> int | None:
+        active = [i for i in self.pools._active_idxs() if i != src_idx]
+        if not active:
+            return None
+        frees = self.pools._free_spaces()
+        return max(active, key=frees.__getitem__)
+
+    # -- the drain ---------------------------------------------------------
+
+    def _move_name(self, src_idx: int, bucket: str, name: str,
+                   versions: list) -> None:
+        """Move every version of one key, oldest first, as the journal's
+        unit of progress."""
+        dst_idx = self._pick_dest(src_idx)
+        if dst_idx is None:
+            raise RuntimeError("no active destination pool")
+        for oi in sorted(versions, key=lambda o: o.mod_time):
+            t0 = time.monotonic_ns()
+            err = ""
+            try:
+                nbytes = move_version(self.pools, src_idx, dst_idx,
+                                      bucket, oi)
+                if nbytes:
+                    self.stats.moved_objects += 1
+                    self.stats.moved_bytes += nbytes
+                else:
+                    self.stats.skipped += 1
+                self.progress.update(bucket, name, nbytes=nbytes)
+                if self.bandwidth_bps > 0 and nbytes:
+                    self.monitor.throttle(_BW_KEY, nbytes)
+            except Exception as e:  # noqa: BLE001 — journal retries it
+                self.stats.failed += 1
+                err = f"{type(e).__name__}: {e}"
+                raise
+            finally:
+                if err and self.flightrec is not None:
+                    self.flightrec.record(
+                        uuid.uuid4().hex[:16], "RebalanceMove", 500,
+                        time.monotonic_ns() - t0, 0, oi.size, error=err)
+                if self.pace_s > 0:
+                    took = (time.monotonic_ns() - t0) / 1e9
+                    time.sleep(min(self.pace_s, took))
+
+    def _move_chunk(self, src_idx: int, bucket: str, chunk: list[str],
+                    by_name: dict[str, list]) -> None:
+        """Move a batch of keys, ``max_workers`` at a time.  The journal
+        cursor only advances past a chunk that moved COMPLETELY; a
+        partial chunk raises and the idempotent per-version skip makes
+        the retry cheap."""
+        if len(chunk) == 1:
+            self._move_name(src_idx, bucket, chunk[0], by_name[chunk[0]])
+            return
+        errs: list[Exception] = []
+
+        def one(name):
+            try:
+                self._move_name(src_idx, bucket, name, by_name[name])
+            except Exception as e:  # noqa: BLE001 — re-raised below
+                errs.append(e)
+
+        threads = [threading.Thread(target=one, args=(n,), daemon=True,
+                                    name=f"mt-rebalance-mv{i}")
+                   for i, n in enumerate(chunk)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+
+    def rebalance_pool(self, src_idx: int) -> bool:
+        """Drain one source pool to completion (or until stop()).
+        Resumes from the persisted journal when one matches the source;
+        returns True when the drain finished the full namespace."""
+        self.monitor.set_limit(_BW_KEY, self.bandwidth_bps)
+        src_id = self.pools.specs[src_idx].pool_id
+        journal = self.load_journal()
+        if journal is None or journal.get("srcPool") != src_id or \
+                journal.get("state") != "running":
+            journal = {"version": 1, "id": uuid.uuid4().hex,
+                       "srcPool": src_id, "state": "running",
+                       "doneBuckets": [], "cursor": {}, "stats": {}}
+            self._save_journal(journal)
+        src = self.pools.pools[src_idx]
+        self.progress.begin()
+        completed = False
+        try:
+            for b in self.pools.list_buckets():
+                if self._stop.is_set():
+                    return False
+                if b.name in journal["doneBuckets"]:
+                    continue
+                cursor = journal.get("cursor", {})
+                after = cursor.get("key", "") \
+                    if cursor.get("bucket") == b.name else ""
+                by_name: dict[str, list] = {}
+                for oi in src.list_object_versions(b.name):
+                    by_name.setdefault(oi.name, []).append(oi)
+                # the cursor names the last FULLY moved key: every
+                # version of it is on the destination and deleted
+                # from the source, so resume strictly after it
+                names = [n for n in sorted(by_name)
+                         if not (after and n <= after)]
+                workers = max(1, int(self.max_workers))
+                i = 0
+                while i < len(names):
+                    if self._stop.is_set():
+                        return False
+                    chunk = names[i:i + workers]
+                    self._move_chunk(src_idx, b.name, chunk, by_name)
+                    journal["cursor"] = {"bucket": b.name,
+                                         "key": chunk[-1]}
+                    journal["stats"] = self.stats.to_dict()
+                    self._save_journal(journal)
+                    i += len(chunk)
+                journal["doneBuckets"].append(b.name)
+                journal["cursor"] = {}
+                self._save_journal(journal)
+            journal["state"] = "done"
+            journal["stats"] = self.stats.to_dict()
+            self._save_journal(journal)
+            completed = True
+            return True
+        finally:
+            if completed:
+                self.progress.end()
+                self.stats.cycles += 1
+                self.stats.last_cycle_ns = time.time_ns()
+            else:
+                self.progress.abort()
+
+    def run_once(self) -> bool:
+        """One scheduling decision: pick a source, drain it, and retire
+        a drained pool whose decommission emptied out.  Returns True
+        when any work was attempted."""
+        from ..objectlayer.pools import STATUS_DRAINING
+        src_idx = self.pick_source()
+        if src_idx is None:
+            return False
+        finished = self.rebalance_pool(src_idx)
+        if finished and \
+                self.pools.specs[src_idx].status == STATUS_DRAINING:
+            versions, uploads = self.pools.decommission_pending(src_idx)
+            if versions == 0 and uploads == 0:
+                self.pools.finish_decommission(src_idx)
+        return True
+
+    # -- lifecycle (BackgroundHealer shape) --------------------------------
+
+    def start(self) -> None:
+        def loop():
+            while True:
+                self._wake.wait(self.interval_s)
+                self._wake.clear()
+                if self._stop.is_set():
+                    return
+                if not self.enabled:
+                    continue
+                try:
+                    self.run_once()
+                except Exception:  # noqa: BLE001 — must survive; the
+                    time.sleep(1)  # journal resumes the failed drain
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="mt-rebalance")
+        self._thread.start()
+
+    def kick(self) -> None:
+        """Wake the loop now (admin rebalance-start / decommission)."""
+        self._wake.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def status(self) -> dict:
+        from ..objectlayer.pools import STATUS_DRAINING
+        specs = getattr(self.pools, "specs", [])
+        return {
+            "enabled": self.enabled,
+            "draining": [sp.pool_id for sp in specs
+                         if sp.status == STATUS_DRAINING],
+            "bandwidth": self.monitor.report().get(_BW_KEY, {}),
+            "stats": self.stats.to_dict(),
+            "progress": self.progress.snapshot(),
+        }

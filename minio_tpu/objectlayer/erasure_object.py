@@ -678,12 +678,16 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         (the default, cmd/common-main.go:208); random-with-hyphen under
         --no-compat (MT_NO_COMPAT=1), skipping the md5 pass entirely
         (pkg/hash/reader.go:186, cmd/object-api-utils.go:843-855)."""
-        if opts.content_md5 or _strict_compat():
+        if opts.content_md5 or (opts.preserve_etag is None
+                                and _strict_compat()):
             etag = md5fast.md5(data).hexdigest()
             if opts.content_md5 and etag != opts.content_md5.lower():
                 raise serrors.StorageError(
                     "Content-MD5 mismatch (BadDigest)")
-            return etag
+            if opts.preserve_etag is None:
+                return etag
+        if opts.preserve_etag is not None:
+            return opts.preserve_etag
         return uuid.uuid4().hex[:32] + "-1"
 
     def _stamp_etag(self, fi: FileInfo, md5obj, opts: PutObjectOptions,
@@ -701,6 +705,8 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                     "Content-MD5 mismatch (BadDigest)")
         else:
             etag = uuid.uuid4().hex[:32] + "-1"
+        if opts.preserve_etag is not None:
+            etag = opts.preserve_etag
         fi.size = size
         fi.metadata = {ETAG_KEY: etag, **opts.user_defined}
         fi.parts = [ObjectPartInfo(1, size, size, etag, mod_time)]

@@ -138,6 +138,11 @@ class PutObjectOptions:
     # --no-compat perf flag, cmd/common-main.go:208-210), md5 is skipped
     # and the ETag is random-with-hyphen (cmd/object-api-utils.go:843)
     content_md5: Optional[str] = None
+    # rebalance/decommission moves: stamp this ETag verbatim instead of
+    # minting one, so the destination copy carries the source version's
+    # commit-time identity bit-identically (Content-MD5 verification
+    # still applies when both are set — that IS the copy-verify step)
+    preserve_etag: Optional[str] = None
 
 
 @dataclass

@@ -379,9 +379,14 @@ class MultipartOps:
 
     def complete_multipart_upload(self, bucket: str, object_name: str,
                                   upload_id: str,
-                                  parts: list[tuple[int, str]]) -> ObjectInfo:
+                                  parts: list[tuple[int, str]],
+                                  opts: Optional[PutObjectOptions] = None
+                                  ) -> ObjectInfo:
         """parts: [(part_number, etag)] in client order; must be ascending
-        (CompleteMultipartUpload, cmd/erasure-multipart.go:678)."""
+        (CompleteMultipartUpload, cmd/erasure-multipart.go:678).  ``opts``
+        lets a rebalance/decommission move re-commit a multipart version
+        under its original version_id/mod_time; same part bytes give the
+        same part md5s, so the merged ETag is already bit-identical."""
         self._check_bucket(bucket)
         fi, _ = self._mp_fileinfo(bucket, object_name, upload_id)
         mp = self._mp_dir(bucket, object_name, upload_id)
@@ -409,8 +414,13 @@ class MultipartOps:
         etag = hashlib.md5(md5s).hexdigest() + f"-{len(parts)}"
 
         versioned = fi.metadata.pop("__versioned", "0") == "1"
+        if opts is not None and opts.versioned:
+            versioned = True
         version_id = str(uuid.uuid4()) if versioned else ""
         mod_time = now_ns()
+        if opts is not None:
+            version_id = opts.version_id or version_id
+            mod_time = opts.mod_time or mod_time
         fi.volume, fi.name = bucket, object_name
         fi.version_id = version_id
         fi.mod_time = mod_time

@@ -2,28 +2,284 @@
 (cmd/erasure-server-pool.go:41).
 
 Multiple pools (each an ErasureSets); placement: an object goes to the pool
-that already holds it, else the pool with the most free space
+that already holds it, else the active pool with the most free space
 (getPoolIdx :255, getAvailablePoolIdx :182).  Reads/deletes search pools in
 order; lists/heals fan out and merge.
+
+The topology is ELASTIC: a persisted pool manifest (DARE-sealed like
+config, versioned, quorum-written on pool 0's system volume) records
+every pool's identity (the format deployment id), dirs, geometry and
+lifecycle status, so every node agrees on topology across restarts
+(cmd/erasure-server-pool-decom.go poolMeta analog).  ``attach_pool``
+adds a pool under live traffic; ``start_decommission`` marks a pool
+draining — the router stops placing new writes there while reads and
+in-flight multipart uploads keep working — and ``finish_decommission``
+retires it from the manifest once the rebalancer has emptied it.
+Multipart uploads stay pinned to the pool that started them via a
+persisted upload→pool map, never recomputed.
 """
 
 from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
 
 from .interface import (BucketInfo, ListObjectsInfo, ObjectInfo,
                         ObjectLayer, ObjectNotFound, ReadQuorumError,
                         VersionNotFound)
 from .sets import ErasureSets
 
+MANIFEST_PATH = "pools/manifest.json"
+UPLOADS_PREFIX = "pools/uploads"
+
+STATUS_ACTIVE = "active"
+STATUS_DRAINING = "draining"
+
+
+@dataclass
+class PoolSpec:
+    """One manifest row: enough to re-attach the pool after a restart
+    (pool_id is the pool's format deployment id — stable, derivable
+    from the pool itself, so manifest rows match live pools without
+    extra bookkeeping)."""
+    pool_id: str
+    dirs: list[str] = field(default_factory=list)
+    set_count: int = 1
+    set_drive_count: int = 0
+    status: str = STATUS_ACTIVE
+    kwargs: dict = field(default_factory=dict)
+
+    def to_doc(self) -> dict:
+        return {"id": self.pool_id, "dirs": self.dirs,
+                "setCount": self.set_count,
+                "setDriveCount": self.set_drive_count,
+                "status": self.status, "kwargs": self.kwargs}
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "PoolSpec":
+        return cls(doc.get("id", ""), list(doc.get("dirs", [])),
+                   doc.get("setCount", 1), doc.get("setDriveCount", 0),
+                   doc.get("status", STATUS_ACTIVE),
+                   dict(doc.get("kwargs", {})))
+
 
 class ErasureServerPools(ObjectLayer):
     FREE_SPACE_TTL_S = 5.0
 
-    def __init__(self, pools: list[ErasureSets]):
+    def __init__(self, pools: list[ErasureSets],
+                 specs: list[PoolSpec] | None = None, secret: str = ""):
         assert pools
-        self.pools = pools
+        self.pools = list(pools)
+        if specs is None:
+            specs = [PoolSpec(
+                pool_id=getattr(p, "deployment_id", "") or f"pool-{i}",
+                set_count=getattr(p, "set_count", 1),
+                set_drive_count=getattr(p, "set_drive_count", 0))
+                for i, p in enumerate(self.pools)]
+        self.specs = specs
+        self._secret = secret
+        self._lock = threading.RLock()
+        self._manifest_version = 0
         self._free_cache: tuple[float, list[int]] | None = None
 
+    # -- pool manifest (persisted topology) --------------------------------
+
+    def _seal(self, blob: bytes) -> bytes:
+        if not self._secret:
+            return blob
+        from ..secure import configcrypt
+        return configcrypt.encrypt_data(self._secret, blob)
+
+    def _unseal(self, blob: bytes) -> bytes:
+        from ..secure import configcrypt
+        plain, _ = configcrypt.maybe_decrypt(
+            self._secret, blob, configcrypt.old_secrets_from_env())
+        return plain
+
+    def save_manifest(self) -> None:
+        """Quorum-write the manifest on pool 0's system volume — pool 0
+        is the cluster's system pool (config/IAM already live there via
+        ``_fanout``) and is never decommissionable, so the manifest
+        survives any legal topology change."""
+        with self._lock:
+            self._manifest_version += 1
+            doc = {"version": self._manifest_version,
+                   "pools": [sp.to_doc() for sp in self.specs]}
+            blob = self._seal(json.dumps(doc).encode())
+            from ..storage.xl_storage import SYS_DIR
+            self.pools[0]._fanout(
+                lambda d: d.write_all(SYS_DIR, MANIFEST_PATH, blob))
+
+    def load_manifest(self) -> bool:
+        """Adopt the persisted topology: highest-version readable
+        replica wins.  Pools recorded with dirs but missing locally are
+        re-attached via ``ErasureSets.from_dirs`` (crash/restart
+        resume); pools retired from the manifest are dropped; statuses
+        (draining) are re-applied.  Returns True when a manifest was
+        found."""
+        from ..storage.xl_storage import SYS_DIR
+        res, _ = self.pools[0]._fanout(
+            lambda d: d.read_all(SYS_DIR, MANIFEST_PATH))
+        best: dict | None = None
+        for blob in res:
+            if blob is None:
+                continue
+            try:
+                doc = json.loads(self._unseal(blob))
+            except Exception:  # noqa: BLE001 — torn/stale replica
+                continue
+            if best is None or doc.get("version", 0) > \
+                    best.get("version", 0):
+                best = doc
+        if best is None:
+            return False
+        with self._lock:
+            self._manifest_version = max(self._manifest_version,
+                                         best.get("version", 0))
+            by_id = {sp.pool_id: i for i, sp in enumerate(self.specs)}
+            listed = set()
+            for ent in best.get("pools", []):
+                spec = PoolSpec.from_doc(ent)
+                listed.add(spec.pool_id)
+                if spec.pool_id in by_id:
+                    i = by_id[spec.pool_id]
+                    self.specs[i].status = spec.status
+                    if spec.dirs:
+                        self.specs[i].dirs = spec.dirs
+                    continue
+                if not spec.dirs:
+                    continue    # remote pool: its host re-assembles it
+                pool = ErasureSets.from_dirs(
+                    spec.dirs, spec.set_count, spec.set_drive_count,
+                    **spec.kwargs)
+                self.pools.append(pool)
+                self.specs.append(spec)
+            # a pool absent from the winning manifest was retired by a
+            # completed decommission — drop it (pool 0 never retires)
+            for i in range(len(self.specs) - 1, 0, -1):
+                if self.specs[i].pool_id not in listed:
+                    self.pools.pop(i)
+                    self.specs.pop(i)
+        self._free_cache = None
+        return True
+
+    # -- elastic topology ---------------------------------------------------
+
+    def attach_pool(self, dirs: list[str], set_count: int,
+                    set_drive_count: int, **set_kwargs) -> int:
+        """Attach a new pool under live traffic.  Existing buckets are
+        created on it BEFORE it joins the router, so a write routed
+        there never sees BucketNotFound; new writes become eligible the
+        moment it lands in ``self.pools``."""
+        pool = ErasureSets.from_dirs(list(dirs), set_count,
+                                     set_drive_count, **set_kwargs)
+        for b in self.pools[0].list_buckets():
+            try:
+                pool.make_bucket(b.name)
+            except Exception:  # noqa: BLE001 — heal converges it
+                pass
+        with self._lock:
+            if any(sp.pool_id == pool.deployment_id for sp in self.specs):
+                raise ValueError(
+                    f"pool {pool.deployment_id} already attached")
+            self.pools.append(pool)
+            self.specs.append(PoolSpec(
+                pool.deployment_id, list(dirs), set_count,
+                set_drive_count, STATUS_ACTIVE, dict(set_kwargs)))
+            self.save_manifest()
+        self._free_cache = None
+        return len(self.pools) - 1
+
+    def _resolve_pool(self, pool) -> int:
+        """Index from an index or a pool id."""
+        if isinstance(pool, int):
+            if not 0 <= pool < len(self.pools):
+                raise ValueError(f"no pool {pool}")
+            return pool
+        for i, sp in enumerate(self.specs):
+            if sp.pool_id == pool:
+                return i
+        raise ValueError(f"no pool {pool!r}")
+
+    def start_decommission(self, pool) -> int:
+        """Mark a pool draining: the router stops placing new writes on
+        it immediately; reads/deletes and pinned multipart uploads keep
+        working while the rebalancer empties it."""
+        with self._lock:
+            idx = self._resolve_pool(pool)
+            if idx == 0:
+                raise ValueError(
+                    "pool 0 carries the system volume (config/IAM/"
+                    "manifest) and cannot be decommissioned")
+            if self.specs[idx].status == STATUS_DRAINING:
+                return idx
+            if not [i for i in self._active_idxs() if i != idx]:
+                raise ValueError("cannot drain the last active pool")
+            self.specs[idx].status = STATUS_DRAINING
+            self.save_manifest()
+        self._free_cache = None
+        return idx
+
+    def abort_decommission(self, pool) -> int:
+        with self._lock:
+            idx = self._resolve_pool(pool)
+            if self.specs[idx].status != STATUS_DRAINING:
+                raise ValueError(f"pool {idx} is not draining")
+            self.specs[idx].status = STATUS_ACTIVE
+            self.save_manifest()
+        self._free_cache = None
+        return idx
+
+    def decommission_pending(self, pool) -> tuple[int, int]:
+        """(versions, uploads) still on the pool — the verify-empty
+        probe ``finish_decommission`` gates on."""
+        idx = self._resolve_pool(pool)
+        p = self.pools[idx]
+        versions = 0
+        uploads = 0
+        for b in self.list_buckets():
+            versions += len(p.list_object_versions(b.name))
+            uploads += len(p.list_multipart_uploads(b.name))
+        return versions, uploads
+
+    def finish_decommission(self, pool) -> None:
+        """Retire a drained pool from the manifest.  Refuses while any
+        version or in-flight upload remains — crash-safe: until the
+        manifest write lands the pool is still draining and a restart
+        resumes the drain."""
+        with self._lock:
+            idx = self._resolve_pool(pool)
+            if self.specs[idx].status != STATUS_DRAINING:
+                raise ValueError(f"pool {idx} is not draining")
+            versions, uploads = self.decommission_pending(idx)
+            if versions or uploads:
+                raise ValueError(
+                    f"pool {idx} not empty: {versions} versions, "
+                    f"{uploads} uploads remain")
+            self.pools.pop(idx)
+            self.specs.pop(idx)
+            self.save_manifest()
+        self._free_cache = None
+
+    def pool_status(self) -> list[dict]:
+        frees = self._free_spaces()
+        out = []
+        for i, sp in enumerate(self.specs):
+            out.append({
+                "index": i, "id": sp.pool_id, "status": sp.status,
+                "setCount": getattr(self.pools[i], "set_count",
+                                    sp.set_count),
+                "setDriveCount": getattr(self.pools[i], "set_drive_count",
+                                         sp.set_drive_count),
+                "dirs": sp.dirs, "freeBytes": frees[i]})
+        return out
+
     # -- placement ---------------------------------------------------------
+
+    def _active_idxs(self) -> list[int]:
+        return [i for i, sp in enumerate(self.specs)
+                if sp.status == STATUS_ACTIVE]
 
     def _free_space(self, pool: ErasureSets) -> int:
         total = 0
@@ -44,28 +300,48 @@ class ErasureServerPools(ObjectLayer):
         import time
         now = time.monotonic()
         if self._free_cache and now - self._free_cache[0] < \
-                self.FREE_SPACE_TTL_S:
+                self.FREE_SPACE_TTL_S and \
+                len(self._free_cache[1]) == len(self.pools):
             return self._free_cache[1]
         frees = [self._free_space(p) for p in self.pools]
         self._free_cache = (now, frees)
         return frees
 
     def get_pool_idx(self, bucket: str, object_name: str) -> int:
-        """Existing location wins; else most free space
-        (cmd/erasure-server-pool.go:255,182)."""
+        """Existing location wins among ACTIVE pools; else spread new
+        names across active pools proportionally to free space
+        (cmd/erasure-server-pool.go:255 getPoolIdx, :182
+        getAvailablePoolIdx — the reference draws a random threshold
+        over total available bytes; we hash the object name instead so
+        placement is deterministic per name while converging to the
+        same free-space-weighted distribution).  An object living only
+        on a draining pool gets its overwrite placed on an active pool
+        — that IS the router refusing new writes during decommission."""
         if len(self.pools) == 1:
             return 0        # nothing to place: skip the existence probe
+        active = self._active_idxs()
         for i, p in enumerate(self.pools):
             try:
                 p.get_object_info(bucket, object_name)
-                return i
             except (ObjectNotFound, VersionNotFound):
                 continue
             # quorum/transport errors propagate: routing a PUT of an
             # existing object elsewhere would shadow it with stale data
             # once the pool recovers (getPoolIdx semantics)
+            if i in active:
+                return i
         frees = self._free_spaces()
-        return max(range(len(frees)), key=frees.__getitem__)
+        total = sum(frees[i] for i in active)
+        if total <= 0:
+            return active[0]
+        import zlib
+        frac = zlib.crc32(f"{bucket}/{object_name}".encode()) / 2**32
+        choose = int(frac * total)
+        for i in active:
+            if choose < frees[i]:
+                return i
+            choose -= frees[i]
+        return active[-1]
 
     def _find_pool(self, bucket: str, object_name: str,
                    opts=None) -> ErasureSets:
@@ -81,6 +357,19 @@ class ErasureServerPools(ObjectLayer):
             except (ObjectNotFound, VersionNotFound, ReadQuorumError) as e:
                 last = e
         raise last
+
+    def _find_pools(self, bucket: str, object_name: str,
+                    opts=None) -> list[int]:
+        """EVERY pool holding the object — deletes must reach all of
+        them or a rebalance copy in flight would resurrect the name."""
+        out = []
+        for i, p in enumerate(self.pools):
+            try:
+                p.get_object_info(bucket, object_name, opts)
+                out.append(i)
+            except (ObjectNotFound, VersionNotFound, ReadQuorumError):
+                continue
+        return out
 
     # -- bucket ops --------------------------------------------------------
 
@@ -102,8 +391,23 @@ class ErasureServerPools(ObjectLayer):
         return self.aggregate_health(self.pools, maintenance)
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        """Delete across every pool with the erasure-sets undo loop:
+        if ANY pool refuses (not empty), the pools already deleted are
+        restored so the bucket never half-exists — the router spreads
+        new objects across pools, so the non-empty pool is routinely
+        NOT the first one."""
+        done = []
         for p in self.pools:
-            p.delete_bucket(bucket, force)
+            try:
+                p.delete_bucket(bucket, force)
+            except Exception:
+                for prev in done:
+                    try:
+                        prev.make_bucket(bucket)
+                    except Exception:  # noqa: BLE001 — best-effort undo
+                        pass
+                raise
+            done.append(p)
 
     # -- object ops --------------------------------------------------------
 
@@ -137,11 +441,19 @@ class ErasureServerPools(ObjectLayer):
 
     def delete_object(self, bucket, object_name, opts=None) -> ObjectInfo:
         self.get_bucket_info(bucket)
-        try:
-            pool = self._find_pool(bucket, object_name)
-        except ObjectNotFound:
-            pool = self.pools[0]
-        return pool.delete_object(bucket, object_name, opts)
+        if len(self.pools) == 1:
+            return self.pools[0].delete_object(bucket, object_name, opts)
+        idxs = self._find_pools(bucket, object_name)
+        if not idxs:
+            return self.pools[0].delete_object(bucket, object_name, opts)
+        result = self.pools[idxs[0]].delete_object(bucket, object_name,
+                                                   opts)
+        for i in idxs[1:]:
+            try:
+                self.pools[i].delete_object(bucket, object_name, opts)
+            except (ObjectNotFound, VersionNotFound):
+                pass    # raced with the mover's own source delete
+        return result
 
     def put_object_metadata(self, bucket, object_name, version_id, updates,
                             removes=()) -> ObjectInfo:
@@ -176,18 +488,51 @@ class ErasureServerPools(ObjectLayer):
         out = []
         for p in self.pools:
             out.extend(p.list_object_versions(bucket, prefix))
-        return sorted(out, key=lambda o: o.name)
+        # a version mid-move exists on two pools between the dest commit
+        # and the source delete: merge by (name, version) so listings
+        # never show the duplicate
+        seen: set[tuple[str, str]] = set()
+        merged = []
+        for o in sorted(out, key=lambda o: o.name):
+            key = (o.name, o.version_id)
+            if key in seen:
+                continue
+            seen.add(key)
+            merged.append(o)
+        return merged
 
-    # -- multipart (upload routed to placement pool; the upload id is
-    #    looked up on every pool for the follow-up calls) ------------------
+    # -- multipart (upload pinned to its placement pool via a persisted
+    #    upload→pool record; legacy uploads fall back to probing) ----------
 
     def new_multipart_upload(self, bucket, object_name, opts=None):
         idx = self.get_pool_idx(bucket, object_name)
         uid = self.pools[idx].new_multipart_upload(bucket, object_name, opts)
+        if len(self.pools) > 1:
+            from ..storage.xl_storage import SYS_DIR
+            rec = json.dumps({"pool": self.specs[idx].pool_id,
+                              "bucket": bucket,
+                              "object": object_name}).encode()
+            self.pools[0]._fanout(lambda d: d.write_all(
+                SYS_DIR, f"{UPLOADS_PREFIX}/{uid}.json", rec))
         return uid
 
     def _upload_pool(self, bucket, object_name, upload_id) -> ErasureSets:
         from .interface import InvalidUploadID
+        if len(self.pools) > 1:
+            from ..storage.xl_storage import SYS_DIR
+            res, _ = self.pools[0]._fanout(lambda d: d.read_all(
+                SYS_DIR, f"{UPLOADS_PREFIX}/{upload_id}.json"))
+            for blob in res:
+                if blob is None:
+                    continue
+                try:
+                    pid = json.loads(blob).get("pool", "")
+                except ValueError:
+                    continue
+                for i, sp in enumerate(self.specs):
+                    if sp.pool_id == pid:
+                        return self.pools[i]
+                break   # pinned pool retired mid-upload: probe below
         for p in self.pools:
             try:
                 p.list_object_parts(bucket, object_name, upload_id)
@@ -195,6 +540,16 @@ class ErasureServerPools(ObjectLayer):
             except InvalidUploadID:
                 continue
         raise InvalidUploadID(upload_id)
+
+    def _drop_upload_record(self, upload_id) -> None:
+        if len(self.pools) <= 1:
+            return
+        from ..storage.xl_storage import SYS_DIR
+        try:
+            self.pools[0]._fanout(lambda d: d.delete(
+                SYS_DIR, f"{UPLOADS_PREFIX}/{upload_id}.json"))
+        except Exception:  # noqa: BLE001 — stale record is harmless
+            pass
 
     def put_object_part(self, bucket, object_name, upload_id, part_number,
                         data):
@@ -212,13 +567,18 @@ class ErasureServerPools(ObjectLayer):
             .list_object_parts(bucket, object_name, upload_id)
 
     def complete_multipart_upload(self, bucket, object_name, upload_id,
-                                  parts):
-        return self._upload_pool(bucket, object_name, upload_id) \
-            .complete_multipart_upload(bucket, object_name, upload_id, parts)
+                                  parts, opts=None):
+        oi = self._upload_pool(bucket, object_name, upload_id) \
+            .complete_multipart_upload(bucket, object_name, upload_id,
+                                       parts, opts)
+        self._drop_upload_record(upload_id)
+        return oi
 
     def abort_multipart_upload(self, bucket, object_name, upload_id):
-        return self._upload_pool(bucket, object_name, upload_id) \
+        res = self._upload_pool(bucket, object_name, upload_id) \
             .abort_multipart_upload(bucket, object_name, upload_id)
+        self._drop_upload_record(upload_id)
+        return res
 
     def list_multipart_uploads(self, bucket, prefix=""):
         out = []

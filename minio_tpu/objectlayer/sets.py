@@ -216,9 +216,9 @@ class ErasureSets(ObjectLayer):
             bucket, object_name, upload_id)
 
     def complete_multipart_upload(self, bucket, object_name, upload_id,
-                                  parts):
+                                  parts, opts=None):
         return self.get_hashed_set(object_name).complete_multipart_upload(
-            bucket, object_name, upload_id, parts)
+            bucket, object_name, upload_id, parts, opts)
 
     def abort_multipart_upload(self, bucket, object_name, upload_id):
         return self.get_hashed_set(object_name).abort_multipart_upload(

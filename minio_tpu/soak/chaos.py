@@ -41,19 +41,31 @@ from ..storage.health import HealthDisk
 
 
 class SoakCluster:
-    """N nodes x d drives, one erasure set, internode links proxied."""
+    """N nodes x d drives, one erasure set, internode links proxied.
+
+    With ``pools=True`` node0's layer is wrapped in an
+    :class:`~minio_tpu.objectlayer.pools.ErasureServerPools` and a
+    :class:`~minio_tpu.background.rebalance.Rebalancer` rides the
+    background plane — the elastic-topology wiring ``pool_add`` /
+    ``pool_decommission`` chaos events drive mid-storm."""
 
     def __init__(self, base_dir: str, *, nodes: int = 3,
                  drives_per_node: int = 2, parity: int = 2,
                  secret: str = "soak-secret", access_key: str = "soakkey",
                  secret_key: str = "soaksecret", block_size: int = 64 * 1024,
                  backend: str = "numpy", mrf_maxsize: int = 10_000,
-                 tls=None):
+                 tls=None, pools: bool = False):
         self.specs: list[NodeSpec] = []
         self.nodes: list[Node] = []
         self.proxies: list[FaultyProxy] = []
         self.s3: S3Server | None = None
         self.tls = tls
+        self.rebalancer = None
+        self._extra_pools: list = []
+        self._base_dir = base_dir
+        self._parity = parity
+        self._block_size = block_size
+        self._backend = backend
         self._saved: dict[int, object] = {}
         for n in range(nodes):
             dirs = []
@@ -90,19 +102,30 @@ class SoakCluster:
             for node in self.nodes:
                 node.assemble()
             layer0 = self.nodes[0].layer
+            if pools:
+                from ..objectlayer.pools import ErasureServerPools
+                layer0 = ErasureServerPools([layer0], secret=secret)
             self.layer = layer0
             # S3 frontend on node0 with the heal planes attached (the
             # wiring run_node gives the leader)
             self.s3 = S3Server(layer0, access_key=access_key,
                                secret_key=secret_key, tls=tls)
             self.mrf = MRFQueue(layer0, maxsize=mrf_maxsize)
-            for s in layer0.sets:
+            for s in self.nodes[0].layer.sets:
                 s.mrf = self.mrf
             self.s3.mrf = self.mrf
             self.healer = BackgroundHealer(layer0,
                                            interval_s=24 * 3600.0)
             self.s3.healer = self.healer
-            self.s3.attach_background(self.mrf, self.healer)
+            if pools:
+                from ..background.rebalance import Rebalancer
+                self.rebalancer = Rebalancer(layer0, interval_s=0.25,
+                                             threshold=0.05)
+                self.s3.rebalancer = self.rebalancer
+                self.s3.attach_background(self.mrf, self.healer,
+                                          self.rebalancer)
+            else:
+                self.s3.attach_background(self.mrf, self.healer)
             self.s3.start()
         except Exception:
             # a half-built cluster must not leak accept loops / server
@@ -110,10 +133,11 @@ class SoakCluster:
             # later scenario in this process asserts against)
             self._teardown()
             raise
-        # node0's local drives, as their HealthDisk wrappers in the
-        # layer — chaos swaps .inner under them
+        # node0's local drives, as their HealthDisk wrappers in POOL
+        # ZERO of the layer (indexes stay stable across pool_add) —
+        # chaos swaps .inner under them
         self.local_disks: list[HealthDisk] = [
-            d for s in layer0.sets for d in s.disks
+            d for s in self.nodes[0].layer.sets for d in s.disks
             if isinstance(d, HealthDisk) and d.inner.is_local()]
 
     @property
@@ -170,6 +194,37 @@ class SoakCluster:
     def heal_link(self, node: int) -> None:
         self.proxies[node].set_default(Fault.passthrough())
 
+    # -- elastic topology (pools mode) -------------------------------------
+
+    def pool_add(self, drives: int = 4) -> int:
+        """Elastic expansion mid-storm: attach a fresh single-set pool
+        (same parity/backend geometry) under whatever chaos is live,
+        and kick the rebalancer so spreading starts immediately."""
+        n = len(self.layer.pools)
+        dirs = []
+        for d in range(drives):
+            p = os.path.join(self._base_dir, f"pool{n}d{d}")
+            os.makedirs(p, exist_ok=True)
+            dirs.append(p)
+        idx = self.layer.attach_pool(dirs, 1, drives,
+                                     parity=self._parity,
+                                     block_size=self._block_size,
+                                     backend=self._backend)
+        pool = self.layer.pools[idx]
+        self._extra_pools.append(pool)
+        for s in pool.sets:
+            s.mrf = self.mrf
+        if self.rebalancer is not None:
+            self.rebalancer.kick()
+        return idx
+
+    def pool_decommission(self, pool: int = 1) -> None:
+        """Mark a pool draining mid-storm; the rebalancer empties it
+        and retires it from the manifest once verified empty."""
+        self.layer.start_decommission(pool)
+        if self.rebalancer is not None:
+            self.rebalancer.kick()
+
     # -- lifecycle ----------------------------------------------------------
 
     def restore_all(self) -> None:
@@ -195,15 +250,20 @@ class SoakCluster:
                 self.s3.stop()
             except Exception:  # noqa: BLE001 — teardown must finish
                 pass
+        layers = [node.layer for node in self.nodes]
+        # pools attached mid-run (pool_add) belong to no node — their
+        # planes die here too, even if a decommission already retired
+        # them from the live topology
+        layers.extend(self._extra_pools)
         for node in self.nodes:
             try:
                 node.stop()
             except Exception:  # noqa: BLE001 — teardown must finish
                 pass
+        for lay in layers:
             # the scenario OWNS its layers: their fan-out pools and
             # writer planes die with the cluster (a long soak process
             # must not accumulate one executor per scenario)
-            lay = node.layer
             if lay is None:
                 continue
             try:
@@ -228,10 +288,12 @@ class Event:
     at_s: float
     action: str              # drive_kill|drive_return|drive_slow|
     #                          drive_fast|partition|blackhole|
-    #                          burst_503|heal_link
+    #                          burst_503|heal_link|pool_add|
+    #                          pool_decommission
     node: int = 1
     drive: int = 0
     delay_s: float = 0.05
+    pool: int = 1            # pool index for pool_decommission
 
     def apply(self, cluster: SoakCluster) -> None:
         if self.action in ("drive_kill", "drive_return", "drive_fast"):
@@ -241,6 +303,10 @@ class Event:
         elif self.action in ("partition", "blackhole", "burst_503",
                              "heal_link"):
             getattr(cluster, self.action)(self.node)
+        elif self.action == "pool_add":
+            cluster.pool_add()
+        elif self.action == "pool_decommission":
+            cluster.pool_decommission(self.pool)
         else:
             raise ValueError(f"unknown chaos action {self.action!r}")
 

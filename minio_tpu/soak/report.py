@@ -83,6 +83,11 @@ class Scenario:
     # _SOAK_ENV) — the forensic drill lowers the trigger thresholds
     # through the kvconfig MT_* env layer
     env: dict = field(default_factory=dict)
+    # elastic-topology cluster (ISSUE 16): node0's layer is wrapped in
+    # ErasureServerPools with a Rebalancer on the background plane, so
+    # the timeline can fire ``pool_add`` / ``pool_decommission`` events
+    # mid-storm; pair with MT_REBALANCE_ENABLE=on in ``env``
+    pools: bool = False
 
 
 # chaos knobs every scenario runs under: snappy breakers so fault
@@ -246,6 +251,94 @@ def forensic_drill_scenario(duration_s: float = 12.0) -> Scenario:
              "MT_FORENSIC_COOLDOWN": "10m"})
 
 
+# the elastic-topology mix: churn (delete + re-put) keeps minting
+# "new" names after preload, which is what lets the free-space router
+# actually spread writes onto a pool added mid-storm (an overwrite of
+# an existing name sticks to the pool that already holds it); the
+# strict digest oracle turns any byte lost or changed by a rebalance
+# move into an IntegrityMismatch row
+_ELASTIC_MIX = Mix("elastic_churn",
+                   {"churn": 0.35, "put": 0.20, "get": 0.35,
+                    "head": 0.10},
+                   sizes_bytes=(2048, 16384), key_space=12,
+                   verify_digest=True)
+
+
+def expand_storm_scenario(duration_s: float = 15.0) -> Scenario:
+    """ISSUE 16 tentpole proof: a pool is attached at 0.22t — while a
+    drive is dead — and the full chaos sequence keeps firing; the SLO
+    sweep then asserts the expansion is live in the manifest, the
+    router actually spread new writes onto it, p99 held, heal
+    converged, and the digest oracle saw identical bytes."""
+    E = _chaos.Event
+    t = duration_s
+    return Scenario(
+        name="expand_storm", mix=_ELASTIC_MIX,
+        timeline=[
+            E(0.08 * t, "drive_kill", drive=0),
+            E(0.22 * t, "pool_add"),
+            E(0.30 * t, "drive_return", drive=0),
+            E(0.38 * t, "drive_slow", drive=1, delay_s=0.04),
+            E(0.52 * t, "drive_fast", drive=1),
+            E(0.58 * t, "partition", node=2),
+            E(0.74 * t, "heal_link", node=2),
+            E(0.80 * t, "burst_503", node=1),
+            E(0.90 * t, "heal_link", node=1),
+        ],
+        duration_s=duration_s,
+        budget=_slo.Budget(max_error_rate=0.10,
+                           require_pool_expanded=True,
+                           require_no_forensics=True,
+                           converge_timeout_s=60.0),
+        pools=True, env={"MT_REBALANCE_ENABLE": "on"})
+
+
+def decommission_storm_scenario(duration_s: float = 15.0) -> Scenario:
+    """The drain-under-storm variant: expand early so the churn mix
+    populates the second pool, decommission it mid-chaos, and require
+    the rebalancer to empty AND retire it (manifest shrinks back)
+    before teardown — with the digest oracle watching every moved
+    byte."""
+    E = _chaos.Event
+    t = duration_s
+    return Scenario(
+        name="decommission_storm", mix=_ELASTIC_MIX,
+        timeline=[
+            E(0.06 * t, "pool_add"),
+            E(0.12 * t, "drive_kill", drive=0),
+            E(0.30 * t, "drive_return", drive=0),
+            E(0.45 * t, "pool_decommission", pool=1),
+            E(0.58 * t, "partition", node=2),
+            E(0.74 * t, "heal_link", node=2),
+            E(0.80 * t, "burst_503", node=1),
+            E(0.90 * t, "heal_link", node=1),
+        ],
+        duration_s=duration_s,
+        budget=_slo.Budget(max_error_rate=0.10,
+                           require_pool_retired=True,
+                           require_no_forensics=True,
+                           converge_timeout_s=60.0),
+        pools=True, env={"MT_REBALANCE_ENABLE": "on"})
+
+
+def expand_smoke_scenario(duration_s: float = 5.0) -> Scenario:
+    """The tier-1 elastic miniature: drive dies, a pool is attached
+    mid-traffic, the drive returns — same expansion contract as
+    expand_storm, sized for CI."""
+    E = _chaos.Event
+    t = duration_s
+    return Scenario(
+        name="smoke_expand", mix=_ELASTIC_MIX,
+        timeline=[E(0.15 * t, "drive_kill", drive=0),
+                  E(0.30 * t, "pool_add"),
+                  E(0.55 * t, "drive_return", drive=0)],
+        duration_s=duration_s,
+        budget=_slo.Budget(converge_timeout_s=30.0,
+                           require_pool_expanded=True,
+                           require_no_forensics=True),
+        pools=True, env={"MT_REBALANCE_ENABLE": "on"})
+
+
 def smoke_scenario(duration_s: float = 4.0) -> Scenario:
     """The tier-1 miniature: small GET-heavy mix + one drive death +
     return — same contract as the matrix, sized for CI."""
@@ -279,7 +372,8 @@ def run_scenario(scenario: Scenario, base_dir: str,
         cluster = _chaos.SoakCluster(
             base_dir, nodes=scenario.nodes,
             drives_per_node=scenario.drives_per_node,
-            backend=scenario.backend, tls=tls_manager)
+            backend=scenario.backend, tls=tls_manager,
+            pools=scenario.pools)
         status = SoakStatus(scenario.name)
         cluster.s3.soak = status
         conv: dict | None = None
@@ -313,6 +407,12 @@ def run_scenario(scenario: Scenario, base_dir: str,
             # during convergence/teardown, hollowing the p99 assertion
             api_pcts = _slo.api_percentiles(cluster.s3.api_stats)
             cluster.restore_all()
+            topology = None
+            if scenario.pools:
+                topology = _topology_summary(
+                    cluster,
+                    wait_retire_s=scenario.budget.converge_timeout_s
+                    if scenario.budget.require_pool_retired else 0.0)
             try:
                 conv = _slo.assert_converged(
                     cluster.layer,
@@ -336,7 +436,7 @@ def run_scenario(scenario: Scenario, base_dir: str,
             budget=scenario.budget, scrape_text=scrape_text,
             convergence=conv, convergence_error=conv_err,
             threads_before=threads_before, threads_after=threads_after,
-            leaked=leaked, forensics=forensics)
+            leaked=leaked, forensics=forensics, topology=topology)
         if scenario.huge_put_bytes:
             rows.append({
                 "scenario": scenario.name,
@@ -408,6 +508,44 @@ def _forensic_summary(cluster, expect_breach: bool = False) -> dict:
             out["breach_records_ok"] = False
             out["error"] = f"{type(e).__name__}: {e}"
     return out
+
+
+def _topology_summary(cluster, wait_retire_s: float = 0.0) -> dict:
+    """Elastic-topology verdict for one finished pools-mode scenario:
+    live pool count, per-pool object residency, rebalance counters and
+    manifest version.  With ``wait_retire_s`` the summary first gives
+    the rebalancer (faults are healed by now) that long to finish
+    draining and retire decommissioned pools — kicked each poll so the
+    drain never sits out an interval."""
+    from ..objectlayer.pools import STATUS_DRAINING
+    layer = cluster.layer
+    rb = cluster.rebalancer
+    if wait_retire_s > 0:
+        deadline = time.monotonic() + wait_retire_s
+        while time.monotonic() < deadline and any(
+                sp.status == STATUS_DRAINING for sp in layer.specs):
+            if rb is not None:
+                rb.kick()
+            time.sleep(0.25)
+    per_pool = []
+    for p in layer.pools:
+        n = 0
+        for b in layer.list_buckets():
+            n += len(p.list_object_versions(b.name))
+        per_pool.append(n)
+    st = rb.stats if rb is not None else None
+    return {
+        "pools": len(layer.pools),
+        "statuses": [sp.status for sp in layer.specs],
+        "per_pool_objects": per_pool,
+        "new_pool_objects": per_pool[-1] if len(per_pool) > 1 else 0,
+        "retired": len(layer.pools) == 1 and not any(
+            sp.status == STATUS_DRAINING for sp in layer.specs),
+        "moved_objects": st.moved_objects if st else 0,
+        "moved_bytes": st.moved_bytes if st else 0,
+        "move_failures": st.failed if st else 0,
+        "manifest_version": layer._manifest_version,
+    }
 
 
 class _SeededBody:

@@ -82,6 +82,13 @@ class Budget:
     # request records inside
     require_no_forensics: bool = False
     expect_forensics: int = 0
+    # elastic-topology scenarios (pools mode): expansion asserts the
+    # pool added mid-storm is live in the manifest AND actually holds
+    # objects (the free-space router spread new writes onto it);
+    # decommission asserts the draining pool was emptied by the
+    # rebalancer and retired from the manifest before teardown
+    require_pool_expanded: bool = False
+    require_pool_retired: bool = False
 
     def limits_for(self, api: str) -> tuple[float, float]:
         return self.per_api_ms.get(api, (self.p50_ms, self.p99_ms))
@@ -142,6 +149,20 @@ def _leaf_sets(layer) -> list:
     return [layer]
 
 
+def _sets_for_object(layer, bucket: str, name: str) -> list:
+    """Every erasure set holding the object — ONE leaf on a flat layer,
+    possibly the source AND destination leaves on a pooled layer while
+    a rebalance move is in flight (both copies must classify clean)."""
+    pools = getattr(layer, "pools", None)
+    if pools is None:
+        return [layer.get_hashed_set(name)
+                if hasattr(layer, "get_hashed_set") else layer]
+    idxs = layer._find_pools(bucket, name) or [0]
+    return [pools[i].get_hashed_set(name)
+            if hasattr(pools[i], "get_hashed_set") else pools[i]
+            for i in idxs]
+
+
 def converged_once(layer) -> tuple[bool, dict]:
     """One convergence check: every listed object's quorum version
     classifies all-OK (classify_disks) on its erasure set.  Returns
@@ -155,23 +176,24 @@ def converged_once(layer) -> tuple[bool, dict]:
         while True:
             out = layer.list_objects(b.name, marker=marker, max_keys=1000)
             for oi in out.objects:
-                er = layer.get_hashed_set(oi.name) \
-                    if hasattr(layer, "get_hashed_set") else layer
-                fis, errs = er._fanout(
-                    lambda d, _b=b.name, _o=oi.name:
-                    d.read_version(_b, _o, None))
-                try:
-                    fi = meta.find_file_info_in_quorum(
-                        fis, max(1, len(er.disks) // 2))
-                except meta.ReadQuorumError:
-                    return False, {"bucket": b.name, "object": oi.name,
-                                   "reason": "below read quorum"}
-                states = classify_disks(er, b.name, oi.name, fi, fis,
-                                        errs)
-                checked += 1
-                if any(s != DiskState.OK for s in states):
-                    return False, {"bucket": b.name, "object": oi.name,
-                                   "states": states}
+                for er in _sets_for_object(layer, b.name, oi.name):
+                    fis, errs = er._fanout(
+                        lambda d, _b=b.name, _o=oi.name:
+                        d.read_version(_b, _o, None))
+                    try:
+                        fi = meta.find_file_info_in_quorum(
+                            fis, max(1, len(er.disks) // 2))
+                    except meta.ReadQuorumError:
+                        return False, {"bucket": b.name,
+                                       "object": oi.name,
+                                       "reason": "below read quorum"}
+                    states = classify_disks(er, b.name, oi.name, fi,
+                                            fis, errs)
+                    checked += 1
+                    if any(s != DiskState.OK for s in states):
+                        return False, {"bucket": b.name,
+                                       "object": oi.name,
+                                       "states": states}
             if not out.is_truncated:
                 break
             marker = out.next_marker
@@ -200,38 +222,38 @@ def _repair_orphan_versions(layer, bucket: str, obj: str,
     loss, not repair."""
     from ..objectlayer import metadata as meta
     from ..objectlayer.healing import DiskState
-    er = layer.get_hashed_set(obj) if hasattr(layer, "get_hashed_set") \
-        else layer
-    fis, _errs = er._fanout(lambda d: d.read_version(bucket, obj, None))
-    try:
-        fi = meta.find_file_info_in_quorum(fis,
-                                           max(1, len(er.disks) // 2))
-    except meta.ReadQuorumError:
-        return 0
     purged = 0
-    for dfi in fis:
-        if dfi is None or dfi.version_id == fi.version_id or \
-                dfi.mod_time <= fi.mod_time:
-            continue
+    for er in _sets_for_object(layer, bucket, obj):
+        fis, _errs = er._fanout(
+            lambda d: d.read_version(bucket, obj, None))
         try:
-            r = layer.heal_object(bucket, obj,
-                                  version_id=dfi.version_id or None,
-                                  remove_dangling=True)
-            if getattr(r, "dangling_purged", False):
-                purged += 1
-        except Exception:  # noqa: BLE001 — next sweep retries
-            pass
-    if purged == 0 and states and DiskState.OFFLINE not in states:
-        k = fi.erasure.data_blocks
-        if states.count(DiskState.OK) < k:
+            fi = meta.find_file_info_in_quorum(
+                fis, max(1, len(er.disks) // 2))
+        except meta.ReadQuorumError:
+            continue
+        for dfi in fis:
+            if dfi is None or dfi.version_id == fi.version_id or \
+                    dfi.mod_time <= fi.mod_time:
+                continue
             try:
                 r = layer.heal_object(bucket, obj,
-                                      version_id=fi.version_id or None,
+                                      version_id=dfi.version_id or None,
                                       remove_dangling=True)
                 if getattr(r, "dangling_purged", False):
                     purged += 1
             except Exception:  # noqa: BLE001 — next sweep retries
                 pass
+        if purged == 0 and states and DiskState.OFFLINE not in states:
+            k = fi.erasure.data_blocks
+            if states.count(DiskState.OK) < k:
+                try:
+                    r = layer.heal_object(
+                        bucket, obj, version_id=fi.version_id or None,
+                        remove_dangling=True)
+                    if getattr(r, "dangling_purged", False):
+                        purged += 1
+                except Exception:  # noqa: BLE001 — next sweep retries
+                    pass
     return purged
 
 
@@ -318,7 +340,8 @@ def evaluate(scenario: str, *, api_stats=None, api_pcts=None, recorder,
              convergence_error: str = "",
              threads_before: int = 0, threads_after: int = 0,
              leaked: list[str] | None = None,
-             forensics: dict | None = None) -> list[dict]:
+             forensics: dict | None = None,
+             topology: dict | None = None) -> list[dict]:
     """Every SLO assertion for one finished scenario, as
     ``{scenario, metric, value, unit, detail, passed}`` rows (the
     SOAK_r*.json shape).
@@ -444,6 +467,26 @@ def evaluate(scenario: str, *, api_stats=None, api_pcts=None, recorder,
             {"require": budget.expect_forensics, **f})
         row("forensic_bundle_content", 1 if content_ok else 0, "bool",
             content_ok, f)
+
+    # elastic-topology rows (pools mode): report.py snapshots topology
+    # before teardown — pool count, per-pool object counts, rebalance
+    # stats and journal state — and passes the summary through
+    # ``topology``
+    if budget.require_pool_expanded:
+        t = topology or {}
+        row("pool_expanded", t.get("pools", 0), "pools",
+            t.get("pools", 0) >= 2, t)
+        row("new_pool_objects", t.get("new_pool_objects", 0),
+            "objects", t.get("new_pool_objects", 0) > 0,
+            {"router": "free-space spread routed writes to the "
+                       "pool added mid-storm"})
+    if budget.require_pool_retired:
+        t = topology or {}
+        row("pool_retired", 1 if t.get("retired") else 0, "bool",
+            bool(t.get("retired")), t)
+        row("rebalance_moved", t.get("moved_objects", 0), "objects",
+            t.get("moved_objects", 0) > 0,
+            {"bytes": t.get("moved_bytes", 0)})
 
     # heal convergence: MRF drained + classify_disks clean on all sets
     if convergence is not None:

@@ -305,6 +305,9 @@ class Worker(threading.Thread):
         c.delete_object(self.gen.bucket, key)
         c.put_object(self.gen.bucket, key, body)
         self.sizes[key] = len(body)
+        if self.gen.mix.verify_digest:
+            import hashlib
+            self.digests[key] = hashlib.md5(body).hexdigest()
         return "DeleteObject", 2 * len(body), 0
 
     # -- loop ---------------------------------------------------------------

@@ -260,6 +260,18 @@ register_subsys("scanner", {
     "delay": "10",
     "max_wait": "15s",
 })
+register_subsys("rebalance", {
+    # pool drain/rebalance plane (background/rebalance.py): ``enable``
+    # gates the background loop (admin pool-decommission still drains —
+    # the route kicks the loop explicitly); ``max_workers`` bounds
+    # concurrent key moves; ``bandwidth`` caps drain bytes/sec through
+    # the replication token bucket (0 = unthrottled).  The healer's
+    # heal.max_sleep pacing applies to moves too.  Live-reloadable
+    # (S3Server.reload_background_config on admin SetConfigKV).
+    "enable": "off",
+    "max_workers": "1",
+    "bandwidth": "0",
+})
 register_subsys("compression", {  # mt-lint: ok(kvconfig-drift) read per request (handlers_object.py) — applies to the next PUT/GET, no reload hook needed
     "enable": "off",
     "extensions": ".txt,.log,.csv,.json,.tar,.xml,.bin",

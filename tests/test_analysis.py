@@ -438,3 +438,37 @@ def test_rule_subset_flag(tmp_path):
         capture_output=True, text=True, cwd=default_repo_root())
     doc = json.loads(r.stdout)
     assert [f["rule"] for f in doc["findings"]] == ["unused-import"]
+
+
+def test_pool_routing_canary(tmp_path):
+    bad = _lint(tmp_path, {"s3/h.py": """
+        def shape(layer):
+            return layer.pools[0].set_drive_count
+        """})
+    assert any(f.rule == "pool-routing" and "pools[0]" in f.message
+               for f in bad), bad
+    # negative literals hardwire a position just the same
+    bad2 = _lint(tmp_path, {"s3/h.py": """
+        def last(layer):
+            return layer.pools[-1]
+        """})
+    assert any(f.rule == "pool-routing" for f in bad2), bad2
+    # a computed index came FROM the router — clean
+    clean = _lint(tmp_path, {"s3/h.py": """
+        def route(layer, bucket, name):
+            i = layer.get_pool_idx(bucket, name)
+            return layer.pools[i]
+        """})
+    assert not clean, clean
+    # the pools layer itself owns placement — exempt
+    clean2 = _lint(tmp_path, {"objectlayer/pools.py": """
+        def sysvol(self):
+            return self.pools[0]
+        """})
+    assert not clean2, clean2
+    # reasoned suppression honored (the server.py shape probe idiom)
+    clean3 = _lint(tmp_path, {"s3/h.py": """
+        def shape(layer):
+            return layer.pools[0]  # mt-lint: ok(pool-routing) shape probe
+        """})
+    assert not clean3, clean3

@@ -152,7 +152,13 @@ def test_half_open_probe_readmits_without_monitor(sets_layer):
     with open(os.path.join(dirs[0], SYS_DIR, FORMAT_FILE), "wb") as f:
         f.write(fmt_backup)
     time.sleep(0.15)
-    hd.make_vol("healthbkt")    # half-open probe runs, drive re-admitted
+    try:
+        hd.make_vol("healthbkt")   # half-open probe runs, re-admitted
+    except serrors.VolumeExists:
+        # the heal-on-return sweep raced us and already recreated the
+        # bucket — the probe readmitted the drive either way, which is
+        # the contract under test
+        pass
     assert not hd.offline
 
 

@@ -53,8 +53,14 @@ def _check(blocks, par, dig, k, m):
 class TestFusedKernel:
     # the BASELINE-config k/m matrix: config 1 (4+2), config 2 (8+4),
     # the 12+4 headline, plus odd non-dividing geometries
-    @pytest.mark.parametrize("k,m", [(4, 2), (8, 4), (12, 4), (3, 2),
-                                     (6, 3), (5, 1)])
+    @pytest.mark.parametrize("k,m", [
+        (4, 2), (3, 2), (6, 3), (5, 1),
+        # the wide configs compile ~15s each on CPU interpret mode;
+        # the slow tier keeps them, tier-1 keeps the 4+2 baseline and
+        # the odd non-dividing geometries that catch tiling bugs
+        pytest.param(8, 4, marks=pytest.mark.slow),
+        pytest.param(12, 4, marks=pytest.mark.slow),
+    ])
     def test_bit_identity_ragged_geometry(self, k, m):
         blocks = RNG.integers(0, 256, (3, k, 997), dtype=np.uint8)
         par, dig = rs_fused.encode_with_bitrot_fused(k, m, blocks)
@@ -70,7 +76,13 @@ class TestFusedKernel:
         par, dig = rs_fused.encode_with_bitrot_fused(k, m, blocks)
         _check(blocks, par, dig, k, m)
 
-    @pytest.mark.parametrize("B", [1, 2, 5, 9])
+    @pytest.mark.parametrize("B", [
+        1, 2,
+        # B=5/9 re-prove the same pad-to-batch rule at larger sizes
+        # (~10s each); slow tier keeps them
+        pytest.param(5, marks=pytest.mark.slow),
+        pytest.param(9, marks=pytest.mark.slow),
+    ])
     def test_batch_padding_boundaries(self, B):
         blocks = RNG.integers(0, 256, (B, 6, 300), dtype=np.uint8)
         par, dig = rs_fused.encode_with_bitrot_fused(6, 2, blocks)
@@ -97,6 +109,9 @@ class TestFusedKernel:
         with pytest.raises(ValueError):
             rs_fused.plan(4, 1000, 100, 4096)
 
+    @pytest.mark.slow    # ~108s of interpret-mode mesh compiles;
+    # test_mesh.py keeps the fast-tier mesh data-plane coverage and
+    # the slow tier still runs this full single-vs-two-kernel proof
     def test_mesh_single_kernel_matches_two_kernel(self, monkeypatch):
         """The mesh data plane's single-kernel path vs the proven
         two-kernel pipeline: byte-identical parity AND digests on a
@@ -126,6 +141,9 @@ class TestFusedKernel:
         finally:
             pmesh.set_active_mesh(prev)
 
+    @pytest.mark.slow    # ~77s mesh compile; the batcher-engagement
+    # contract stays covered fast-tier by test_batcher.py, and the
+    # slow tier runs this full framed production path
     def test_framed_fused_rides_encode_bitrot_bucket(self, monkeypatch):
         """The production mesh PUT path through the batcher's
         ``encode-bitrot`` bucket, single-kernel engine on: coalesced

@@ -297,6 +297,9 @@ def test_soak_status_admin_route(tmp_path):
         srv.stop()
 
 
+@pytest.mark.slow    # ~78s; the slow-tier full matrix runs the same
+# mix with the same batcher-engagement assertion — tier-1 keeps the
+# generic smoke + the topology smoke inside the 870s budget
 def test_small_object_storm_engages_codec_batcher(tmp_path):
     """The batching codec service's target scenario in miniature: many
     concurrent tiny PUT/GET workers on a real cluster, a drive death
@@ -405,6 +408,9 @@ def test_hot_get_storm_smoke_engages_hot_read_plane(tmp_path):
 
 # -- the slow-marked full matrix (bench.py soak leg) -----------------------
 
+@pytest.mark.slow    # ~127s and p99-sensitive under CI load; the
+# slow-tier matrix carries the full huge_put drill with the same
+# byte-correctness row
 def test_huge_put_smoke_mesh_sharded_byte_correct(tmp_path):
     """The huge_put drill, CI-sized: a mesh-backend cluster storms the
     GET-heavy mix while one multi-batch object (4 MiB here, 1 GiB in
@@ -506,3 +512,53 @@ def test_tls_smoke_scenario_meets_slo(tmp_path):
     # a TLS cluster must not linger in the process-global registry
     from minio_tpu.secure import transport as secure_transport
     secure_transport.configure(None)
+
+
+# -- elastic topology: pools mode (ISSUE 16) --------------------------------
+
+def test_expand_smoke_pool_added_mid_traffic_meets_slo(tmp_path):
+    """The tier-1 elastic miniature: a 3-node POOLED cluster takes a
+    drive death, attaches a second pool mid-traffic (while the drive
+    is still dead), gets the drive back — every SLO row passes, the
+    manifest carries the expansion, and the free-space router provably
+    spread new writes onto the pool added mid-storm."""
+    sc = soak_report.expand_smoke_scenario()
+    rows = soak_report.run_scenario(sc, str(tmp_path / "soak"))
+    by_metric = {r["metric"]: r for r in rows}
+    chaos = by_metric["ops_total"]["detail"]["chaos"]
+    assert [e["action"] for e in chaos["applied"]] == \
+        ["drive_kill", "pool_add", "drive_return"]
+    assert chaos["errors"] == []
+    failed = [r for r in rows if not r["passed"]]
+    assert not failed, failed
+    assert by_metric["pool_expanded"]["value"] == 2
+    assert by_metric["new_pool_objects"]["value"] > 0
+    assert by_metric["heal_converged"]["value"] == 1
+
+
+@pytest.mark.slow
+def test_expand_storm_full_slo(tmp_path):
+    """expand_storm acceptance: pool attached under the full chaos
+    sequence (drive dead at attach time, partition + 503 burst later)
+    — p99 SLO holds, heal converges, the new pool holds data, and the
+    digest oracle saw identical bytes throughout."""
+    rows = soak_report.run_scenario(
+        soak_report.expand_storm_scenario(), str(tmp_path / "soak"))
+    failed = [r for r in rows if not r["passed"]]
+    assert not failed, failed
+
+
+@pytest.mark.slow
+def test_decommission_storm_drains_and_retires(tmp_path):
+    """decommission_storm acceptance: a pool populated mid-run is
+    marked draining under chaos; the rebalancer must move every
+    version off (copy-verify-delete, digest oracle watching) and
+    retire the pool from the manifest before teardown."""
+    rows = soak_report.run_scenario(
+        soak_report.decommission_storm_scenario(),
+        str(tmp_path / "soak"))
+    by_metric = {r["metric"]: r for r in rows}
+    failed = [r for r in rows if not r["passed"]]
+    assert not failed, failed
+    assert by_metric["pool_retired"]["value"] == 1
+    assert by_metric["rebalance_moved"]["value"] > 0

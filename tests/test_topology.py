@@ -135,3 +135,302 @@ def test_pools_multipart(tmp_path):
     assert oi.size == 8
     _, got = pools.get_object("bkt", "big")
     assert got == b"part-one"
+
+
+# -- elastic topology (ISSUE 16): manifest, router, rebalance, decommission -
+
+def _pools2(tmp_path, tag="e", secret=""):
+    p0 = make_sets(tmp_path, f"{tag}0", set_count=1)
+    p1 = make_sets(tmp_path, f"{tag}1", set_count=1)
+    pools = ErasureServerPools([p0, p1], secret=secret)
+    pools.make_bucket("bkt")
+    return pools
+
+
+def _names_on(pool, bucket="bkt"):
+    return sorted(o.name for o in pool.list_object_versions(bucket))
+
+
+def test_attach_pool_persists_manifest_and_survives_restart(tmp_path):
+    from minio_tpu.objectlayer.pools import STATUS_DRAINING
+    p0 = make_sets(tmp_path, "r0", set_count=1)
+    pools = ErasureServerPools([p0], secret="topo-secret")
+    pools.make_bucket("bkt")
+    pools.put_object("bkt", "pre", b"before-expansion")
+    dirs = []
+    for i in range(4):
+        d = tmp_path / f"r1-disk{i}"
+        d.mkdir()
+        dirs.append(str(d))
+    idx = pools.attach_pool(dirs, 1, 4, parity=2, block_size=BS,
+                            backend="numpy")
+    assert idx == 1
+    # duplicate attach refused (same deployment id)
+    with pytest.raises(ValueError):
+        pools.attach_pool(dirs, 1, 4, parity=2, block_size=BS,
+                          backend="numpy")
+    # the attached pool already has every existing bucket
+    pools.pools[1].get_bucket_info("bkt")
+    pools.start_decommission(1)
+    # "restart": a fresh layer over pool 0's dirs adopts the manifest,
+    # re-attaches pool 1 from its recorded dirs, re-applies draining
+    p0b = make_sets(tmp_path, "r0", set_count=1)
+    reborn = ErasureServerPools([p0b], secret="topo-secret")
+    assert reborn.load_manifest()
+    assert len(reborn.pools) == 2
+    assert reborn.specs[1].status == STATUS_DRAINING
+    assert reborn.specs[1].pool_id == pools.specs[1].pool_id
+    _, got = reborn.get_object("bkt", "pre")
+    assert got == b"before-expansion"
+
+
+def test_router_skips_draining_pool_and_delete_reaches_all(tmp_path):
+    from minio_tpu.objectlayer.interface import ObjectOptions
+    pools = _pools2(tmp_path, "d")
+    pools.start_decommission(1)
+    # new writes never land on the draining pool
+    for i in range(12):
+        pools.put_object("bkt", f"fresh-{i}", b"x")
+    assert _names_on(pools.pools[1]) == []
+    pools.abort_decommission(1)
+    # a name living on BOTH pools (mid-move shape) is deleted from all
+    pools.pools[0].put_object("bkt", "both", b"v0")
+    pools.pools[1].put_object("bkt", "both", b"v1")
+    pools.delete_object("bkt", "both", ObjectOptions())
+    assert "both" not in _names_on(pools.pools[0])
+    assert "both" not in _names_on(pools.pools[1])
+
+
+def test_decommission_guards(tmp_path):
+    pools = _pools2(tmp_path, "g")
+    with pytest.raises(ValueError):       # pool 0 = system volume
+        pools.start_decommission(0)
+    with pytest.raises(ValueError):       # unknown pool
+        pools.start_decommission(7)
+    with pytest.raises(ValueError):       # not draining
+        pools.abort_decommission(1)
+    pools.pools[1].put_object("bkt", "resident", b"x")
+    pools.start_decommission(1)
+    with pytest.raises(ValueError):       # last active pool
+        pools.start_decommission(0)
+    with pytest.raises(ValueError):       # not empty yet
+        pools.finish_decommission(1)
+    assert pools.decommission_pending(1) == (1, 0)
+
+
+def test_multipart_pinned_to_starting_pool(tmp_path):
+    pools = _pools2(tmp_path, "mp")
+    uid = pools.new_multipart_upload("bkt", "pinned")
+    home = pools._upload_pool("bkt", "pinned", uid)
+    other = 1 if home is pools.pools[0] else 0
+    # draining the OTHER pool must not disturb the pinned upload; and
+    # even a drain of the HOME pool keeps in-flight uploads working
+    if other != 0:
+        pools.start_decommission(other)
+    e1 = pools.put_object_part("bkt", "pinned", uid, 1, b"p" * 512)
+    oi = pools.complete_multipart_upload("bkt", "pinned", uid,
+                                         [(1, e1.etag)])
+    assert oi.size == 512
+    assert "pinned" in _names_on(home)
+    # the pin record is dropped on complete
+    from minio_tpu.storage.xl_storage import SYS_DIR
+    res, _ = pools.pools[0]._fanout(
+        lambda d: d.read_all(SYS_DIR, f"pools/uploads/{uid}.json"))
+    assert all(b is None for b in res)
+
+
+def test_move_version_preserves_identity_bit_identical(tmp_path):
+    from minio_tpu.background.rebalance import move_version
+    from minio_tpu.objectlayer.interface import ObjectOptions
+    pools = _pools2(tmp_path, "mv")
+    src, dst = pools.pools[1], pools.pools[0]
+    # versioned object + a delete marker on top + a multipart object
+    v1 = src.put_object("bkt", "ver", b"A" * 100,
+                        PutObjectOptions(versioned=True,
+                                         user_defined={"x-amz-meta-k":
+                                                       "v"}))
+    src.delete_object("bkt", "ver", ObjectOptions(versioned=True))
+    uid = src.new_multipart_upload("bkt", "multi")
+    e1 = src.put_object_part("bkt", "multi", uid, 1, b"B" * 1000)
+    moi = src.complete_multipart_upload("bkt", "multi", uid,
+                                        [(1, e1.etag)])
+    before = {(o.name, o.version_id, o.etag, o.mod_time,
+               o.delete_marker, o.size)
+              for o in pools.list_object_versions("bkt")}
+    for oi in list(src.list_object_versions("bkt")):
+        move_version(pools, 1, 0, "bkt", oi)
+    # source fully emptied; identities carried bit-identically
+    assert _names_on(src) == []
+    after = {(o.name, o.version_id, o.etag, o.mod_time,
+              o.delete_marker, o.size)
+             for o in pools.list_object_versions("bkt")}
+    assert after == before
+    got = dst.get_object_info("bkt", "ver",
+                              ObjectOptions(version_id=v1.version_id))
+    assert got.user_defined.get("x-amz-meta-k") == "v"
+    assert got.etag == v1.etag
+    mgot = dst.get_object_info("bkt", "multi")
+    assert mgot.etag == moi.etag and "-" in mgot.etag
+    assert mgot.parts == moi.parts
+    _, body = dst.get_object("bkt", "multi")
+    assert body == b"B" * 1000
+    # idempotency: a repeated move (crash between copy and source
+    # delete) is a no-op skip, not a duplicate
+    dst2 = pools.pools[1]
+    assert _names_on(dst2) == []
+
+
+def test_rebalance_journal_crash_resume_no_lost_or_dup_versions(tmp_path):
+    """The crash-resume pin: kill the rebalancer mid-drain (after the
+    journal committed a partial cursor), resume with a FRESH
+    rebalancer — the drain completes with zero lost and zero
+    duplicated versions and the pool retires."""
+    from minio_tpu.background import rebalance as rb_mod
+    pools = _pools2(tmp_path, "cr")
+    bodies = {}
+    for i in range(6):
+        name = f"obj-{i}"
+        bodies[name] = f"payload-{i}".encode() * 20
+        pools.pools[1].put_object("bkt", name, bodies[name])
+    pools.start_decommission(1)
+    rb1 = rb_mod.Rebalancer(pools, interval_s=3600.0)
+    moves = {"n": 0}
+    real_move = rb_mod.move_version
+
+    def dying_move(*a, **kw):
+        if moves["n"] >= 3:
+            raise RuntimeError("simulated crash mid-drain")
+        moves["n"] += 1
+        return real_move(*a, **kw)
+
+    rb_mod.move_version = dying_move
+    try:
+        with pytest.raises(RuntimeError):
+            rb1.rebalance_pool(1)
+    finally:
+        rb_mod.move_version = real_move
+    # the journal recorded partial progress
+    j = rb1.load_journal()
+    assert j is not None and j["state"] == "running"
+    assert j["cursor"] or j["doneBuckets"]
+    # "restart": a fresh rebalancer resumes from the journal
+    rb2 = rb_mod.Rebalancer(pools, interval_s=3600.0)
+    assert rb2.run_once()
+    # pool retired; every object exactly once, bytes intact
+    assert len(pools.pools) == 1
+    assert _names_on(pools.pools[0]) == sorted(bodies)
+    for name, body in bodies.items():
+        _, got = pools.get_object("bkt", name)
+        assert got == body
+    assert rb2.load_journal()["state"] == "done"
+    # no version appears twice
+    vers = [(o.name, o.version_id)
+            for o in pools.list_object_versions("bkt")]
+    assert len(vers) == len(set(vers))
+
+
+# -- admin surface conformance (ISSUE 16): topology routes, remote-target
+# removal, per-pool usage exposition ----------------------------------------
+
+
+def test_admin_topology_routes_and_pool_usage_scrape(tmp_path, monkeypatch):
+    """One live server over a pools layer: every topology admin route,
+    remote-target set/list/remove round-trip, crawler per-pool usage,
+    and the ``mt_pool_usage_*{pool=...}`` / ``mt_rebalance_*`` metric
+    families on a real 2-pool scrape."""
+    import json
+
+    from minio_tpu.admin.client import AdminClient, AdminError
+    from minio_tpu.background.crawler import Crawler, load_usage
+    from minio_tpu.background.rebalance import Rebalancer
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.server import S3Server
+
+    monkeypatch.setenv("MT_REBALANCE_ENABLE", "on")
+    pools = ErasureServerPools([make_sets(tmp_path, "adm0", set_count=1)])
+    srv = S3Server(pools, access_key="admin", secret_key="adminpw",
+                   host="127.0.0.1", port=0)
+    srv.iam.load()
+    rb = Rebalancer(pools, interval_s=3600.0)
+    crawler = Crawler(pools, bucket_meta=srv.bucket_meta,
+                      interval_s=3600.0)
+    srv.crawler = crawler
+    srv.attach_background(rb, crawler)
+    assert rb.enabled, "MT_REBALANCE_ENABLE=on must enable via kvconfig"
+    srv.start()
+    try:
+        s3 = S3Client(srv.endpoint, "admin", "adminpw")
+        adm = AdminClient(srv.endpoint, "admin", "adminpw")
+        s3.make_bucket("bkt")
+        for i in range(6):
+            s3.put_object("bkt", f"obj-{i}", bytes([i]) * 100)
+
+        st = adm.pool_status()
+        assert len(st["pools"]) == 1
+        assert st["pools"][0]["status"] == "active"
+
+        ndirs = []
+        for i in range(4):
+            d = tmp_path / f"adm1-disk{i}"
+            d.mkdir()
+            ndirs.append(str(d))
+        r = adm.pool_add(ndirs, 1, 4, backend="numpy", parity=2,
+                         block_size=BS)
+        assert r["pool"] == 1
+        assert len(adm.pool_status()["pools"]) == 2
+
+        # crawler cycle feeds per-pool usage into status + scrape
+        crawler.run_cycle()
+        info = load_usage(pools)
+        assert info.pools_usage
+        assert sum(u["objects"] for u in info.pools_usage.values()) == 6
+        st = adm.pool_status()
+        assert any("usedBytes" in row for row in st["pools"])
+
+        doc = s3.request("GET", "/minio-tpu/metrics", "", b"",
+                         expect=(200,)).body.decode()
+        assert 'mt_pool_usage_bytes{' in doc
+        assert 'mt_pool_usage_objects{' in doc
+        # the pool label is the stable pool_id (survives index shifts
+        # after a decommission) — one series per attached pool
+        for sp in pools.specs:
+            assert f'pool="{sp.pool_id}"' in doc
+        assert "mt_rebalance_moved_objects_total" in doc
+
+        # storageinfo carries the pools section (satellite 4 pin)
+        raw = s3.request("GET", "/minio-tpu/admin/v1/storageinfo", "",
+                         b"", expect=(200,))
+        si = json.loads(raw.body)
+        assert len(si.get("pools", [])) == 2
+
+        # decommission lifecycle over the wire: drain, abort, guard
+        assert adm.pool_decommission("1")["status"] == "draining"
+        assert adm.pool_status()["pools"][1]["status"] == "draining"
+        assert adm.pool_decommission_abort("1")["status"] == "active"
+        with pytest.raises(AdminError) as ei:
+            adm.pool_decommission("0")  # carries the system volume
+        assert ei.value.status == 400
+
+        rs = adm.rebalance_status()
+        assert rs["enabled"] is True and "stats" in rs
+
+        raw = s3.request("GET", "/minio-tpu/admin/v1/background-status",
+                         "", b"", expect=(200,))
+        assert json.loads(raw.body)["rebalance"] is not None
+
+        # remote-target removal round-trip (admin-parity row 49)
+        adm.set_remote_target("bkt", {
+            "arn": "arn:x", "endpoint": "127.0.0.1:1",
+            "target_bucket": "tb"})
+        assert "bkt" in adm.list_remote_targets()
+        adm.remove_remote_target("bkt")
+        assert "bkt" not in adm.list_remote_targets()
+        with pytest.raises(AdminError) as ei:
+            adm.remove_remote_target("no-such-bucket")
+        assert ei.value.status == 404
+
+        # live config write dispatches to the running rebalancer
+        adm.set_config_kv("rebalance", "max_workers", "3")
+        assert rb.max_workers == 3
+    finally:
+        srv.stop()
