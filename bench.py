@@ -1133,6 +1133,24 @@ def _bench_end_to_end_put() -> dict | None:
                 fresh=True)
 
         t_commit = stage(commit_only)
+        # per-op commit decomposition (ISSUE 17): the always-on drive
+        # micro-profiler recorded every create/fsync/rename/meta_merge
+        # of the commit_only runs above — aggregate across the 16
+        # drives and normalize to ms per object so the stage table
+        # decomposes drive_fanout_commit the way the table itself
+        # decomposes the request
+        commit_per_op_ms = {}
+        per_op: dict = {}
+        for d in layer.disks:
+            for op, (c, t_ns, b) in d.commit_profile.totals().items():
+                agg = per_op.setdefault(op, [0, 0])
+                agg[0] += c
+                agg[1] += t_ns
+        for op, (c, t_ns) in sorted(per_op.items()):
+            commit_per_op_ms[op] = {
+                "ms_per_object": round(t_ns / max(kept[0], 1) / 1e6, 3),
+                "calls_per_object": round(c / max(kept[0], 1), 2),
+            }
 
         # ---- streaming-pipeline overlap (tmpfs, 4 MiB batches) ---------
         # wall per batch, pipelined vs serial, against the stage table:
@@ -1445,6 +1463,9 @@ def _bench_end_to_end_put() -> dict | None:
                 "erasure_encode_into_frames": round(t_encode, 2),
                 "bitrot_hh256_fill": round(t_hash, 2),
                 "drive_fanout_commit": round(t_commit, 2),
+                # the micro-profiler's decomposition of the line above
+                # (sums can exceed it: 16 drives overlap on the wall)
+                "drive_fanout_commit_per_op": commit_per_op_ms,
                 # streaming-pipeline overlap: per-4MiB-batch wall with
                 # the writer plane on vs off, and how close the
                 # pipelined wall gets to the slowest single stage
@@ -1503,6 +1524,12 @@ def _bench_xray() -> dict | None:
         c.get_object("xbench", "warm")
         real_record = srv.flightrec.record
         reps, rounds = 60, 5
+        from minio_tpu.admin.metrics import GLOBAL as _gm
+        gate0 = {k: v for k, v in _gm.snapshot().items()
+                 if k[0] == "mt_quorum_gating_total"}
+        strag0 = {k: (v[-2], v[-1]) for k, v in
+                  _gm.hist_snapshot().items()
+                  if k[0] == "mt_quorum_straggler_seconds"}
 
         def one_round(op: str) -> float:
             t0 = time.perf_counter()
@@ -1537,6 +1564,32 @@ def _bench_xray() -> dict | None:
                 "run_to_run_noise_ns": round(noise),
                 "unmeasurable": overhead <= noise,
             }
+        # critical-path report (ISSUE 17): which drives gated quorum
+        # reductions over the run (counter deltas across the whole A/B
+        # loop), and the mean straggler trail per plane — the
+        # cluster-level "who is slow" readout the gating plane exists
+        # to answer
+        gates = []
+        for k, v in _gm.snapshot().items():
+            if k[0] != "mt_quorum_gating_total":
+                continue
+            d = v - gate0.get(k, 0)
+            if d > 0:
+                gates.append({**dict(k[1]), "count": int(d)})
+        gates.sort(key=lambda g: g["count"], reverse=True)
+        trails = {}
+        for k, v in _gm.hist_snapshot().items():
+            if k[0] != "mt_quorum_straggler_seconds":
+                continue
+            c0, s0 = strag0.get(k, (0, 0.0))
+            dc, ds = v[-2] - c0, v[-1] - s0
+            if dc > 0:
+                plane = dict(k[1]).get("plane", "")
+                trails[plane] = round(ds / dc * 1e6, 1)   # us mean
+        out["critical_path"] = {
+            "top_gating": gates[:8],
+            "mean_straggler_trail_us": trails,
+        }
         return out
     except Exception as e:  # noqa: BLE001 — optional leg
         import sys as _sys
@@ -1562,6 +1615,92 @@ def xray_main() -> None:
         "metric": "xray_overhead_ns_per_get",
         "value": stats["get"]["overhead_ns"],
         "unit": "ns/request",
+        "detail": stats,
+    }))
+
+
+def _bench_commit_profile() -> dict | None:
+    """``bench.py commit_profile`` — the always-on commit
+    micro-profiler read out as a per-op stage table (ISSUE 17): N real
+    PUTs through the erasure layer, then the per-drive
+    create/append/fsync/rename/meta_merge windows aggregated into
+    ms-per-object rows, the same decomposition the BENCH stage table
+    applies to the request."""
+    import shutil
+    import sys as _sys
+    import tempfile
+
+    try:
+        from minio_tpu.admin.metrics import GLOBAL as _gm
+        from minio_tpu.objectlayer.erasure_object import ErasureObjects
+        from minio_tpu.storage.xl_storage import XLStorage
+    except Exception as e:  # noqa: BLE001 — optional leg
+        print(f"commit_profile leg failed to import: {e!r}",
+              file=_sys.stderr)
+        return None
+    root = "/dev/shm" if os.path.isdir("/dev/shm") and \
+        os.access("/dev/shm", os.W_OK) else None
+    tmp = tempfile.mkdtemp(prefix="commitprof-", dir=root)
+    try:
+        disks = []
+        for i in range(8):
+            d = os.path.join(tmp, f"d{i}")
+            os.makedirs(d)
+            disks.append(XLStorage(d))
+        layer = ErasureObjects(disks, parity=2, block_size=1 << 20,
+                               backend="numpy")
+        layer.make_bucket("profbkt")
+        body = os.urandom(1 << 20)
+        n_obj = 64
+        hist0 = {k: (v[-2], v[-1]) for k, v in
+                 _gm.hist_snapshot().items()
+                 if k[0] == "mt_drive_op_seconds"}
+        layer.put_object("profbkt", "warm", body)   # warm the path
+        t0 = time.perf_counter()
+        for i in range(n_obj):
+            layer.put_object("profbkt", f"o{i:03d}", body)
+        wall_ms = (time.perf_counter() - t0) * 1000
+        per_op = {}
+        for k, v in _gm.hist_snapshot().items():
+            if k[0] != "mt_drive_op_seconds":
+                continue
+            c0, s0 = hist0.get(k, (0, 0.0))
+            dc, ds = v[-2] - c0, v[-1] - s0
+            if dc <= 0:
+                continue
+            op = dict(k[1]).get("op", "")
+            per_op[op] = {
+                "calls_per_object": round(dc / (n_obj + 1), 2),
+                "mean_us": round(ds / dc * 1e6, 1),
+                "ms_per_object": round(ds / (n_obj + 1) * 1000, 3),
+            }
+        total_ms = sum(r["ms_per_object"] for r in per_op.values())
+        return {
+            "objects": n_obj, "object_bytes": len(body),
+            "drives": len(disks), "drives_root": root or "disk",
+            "wall_ms_per_object": round(wall_ms / n_obj, 3),
+            # sum across 8 drives; overlapped on the wall, so the sum
+            # exceeding the per-object wall is expected, not an error
+            "drive_op_ms_per_object_sum": round(total_ms, 3),
+            "per_op": dict(sorted(per_op.items())),
+        }
+    except Exception as e:  # noqa: BLE001 — optional leg
+        print(f"commit_profile leg failed: {e!r}", file=_sys.stderr)
+        return None
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def commit_profile_main() -> None:
+    """``bench.py commit_profile`` — run the commit micro-profiler leg
+    standalone and print ONE BENCH_*-shaped JSON line."""
+    stats = _bench_commit_profile()
+    if stats is None:
+        raise SystemExit("commit_profile leg unavailable")
+    print(json.dumps({
+        "metric": "commit_profile_drive_op_ms_per_object",
+        "value": stats["drive_op_ms_per_object_sum"],
+        "unit": "ms/object",
         "detail": stats,
     }))
 
@@ -1647,6 +1786,8 @@ if __name__ == "__main__":
         hot_get_main()
     elif len(_sys.argv) > 1 and _sys.argv[1] == "xray":
         xray_main()
+    elif len(_sys.argv) > 1 and _sys.argv[1] == "commit_profile":
+        commit_profile_main()
     elif len(_sys.argv) > 1 and _sys.argv[1] == "host":
         host_main()
     else:

@@ -557,6 +557,45 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
                     for ep, r, err in srv.peers.call_all(
                         "xray_query", timeout_s=10.0, **params)]
             return send_json(out) or True
+        if route == "trace-tree" and h.command == "GET":
+            # causal trace trees: the span ring assembled into
+            # parent→children request trees, peer-merged so a
+            # frontend root adopts its peer-side children.  Filters
+            # mirror xray (?api/?min-duration-ms/?errors/?n) plus
+            # ?rid= for one complete tree and ?format=otlp /
+            # ?export=true for the OTLP egress shape.
+            from ..obs import tracetree as _tt
+            params = _trace_tree_params(q1)
+            fmt = q1.get("format", "")
+            export = q1.get("export") == "true"
+            local = _tt.tree_reply(srv, **params)
+            if srv.peers is not None and q1.get("local") != "true":
+                rids = tuple(t["requestID"]
+                             for t in local.get("trees", ()))
+                peers = srv.peers.call_all(
+                    "trace_tree_query", timeout_s=10.0,
+                    rids=rids, **params)
+                trees = _tt.merge_replies(
+                    local, [r for _, r, err in peers if not err],
+                    api=params["api"],
+                    min_duration_ms=params["min_duration_ms"],
+                    errors_only=params["errors_only"],
+                    limit=params["limit"])
+                out = {"node": srv.node_name, "scope": "cluster",
+                       "trees": trees,
+                       "peers": [{"node": ep, "error": err}
+                                 for ep, _, err in peers if err]}
+            else:
+                out = {"node": srv.node_name, "scope": "local",
+                       "trees": local["trees"]}
+            out["spanCount"] = sum(
+                _tt.span_count(t) for t in out["trees"])
+            if export:
+                out["exported"] = _tt.export_trees(srv, out["trees"])
+            if fmt == "otlp":
+                return send_json(_tt.to_otlp(
+                    out["trees"], node=srv.node_name)) or True
+            return send_json(out) or True
         if route == "forensics" and h.command == "GET":
             # resident forensic bundles on this node (and, unless
             # ?local=true, every peer): names/sizes/triggers — the
@@ -707,6 +746,24 @@ def _xray_params(q1) -> dict:
     return {"api": q1.get("api", ""), "min_duration_ms": min_ms,
             "errors_only": q1.get("errors") == "true", "limit": limit,
             "snapshot": q1.get("snapshot") == "true"}
+
+
+def _trace_tree_params(q1) -> dict:
+    """One parse shared by the local leg and the peer fan-out (the
+    _xray_params discipline)."""
+    from ..obs import tracetree as _tt
+    try:
+        limit = max(1, min(int(q1.get("n", _tt.DEFAULT_TREES)
+                               or _tt.DEFAULT_TREES), _tt.MAX_TREES))
+    except (TypeError, ValueError):
+        limit = _tt.DEFAULT_TREES
+    try:
+        min_ms = float(q1.get("min-duration-ms", 0) or 0)
+    except (TypeError, ValueError):
+        min_ms = 0.0
+    return {"rid": q1.get("rid", ""), "api": q1.get("api", ""),
+            "min_duration_ms": min_ms,
+            "errors_only": q1.get("errors") == "true", "limit": limit}
 
 
 def xray_reply(srv, api: str = "", min_duration_ms: float = 0.0,

@@ -598,12 +598,15 @@ class ObsDocsDriftRule(Rule):
     description = ("every X-ray stage name emitted in code "
                    "(``_stages.stage/add/add_async`` call sites + the "
                    "``STAGE_NAMES`` catalog) and every "
-                   "``mt_{s3_stage,forensic,flight}_*`` metric family "
-                   "literal must appear in docs/observability.md — an "
-                   "operator reading the stage/family catalog must be "
-                   "able to trust it is complete")
+                   "``mt_{s3_stage,forensic,flight,quorum,drive_op,"
+                   "trace_tree}_*`` metric family literal must appear "
+                   "in docs/observability.md — an operator reading "
+                   "the stage/family catalog must be able to trust it "
+                   "is complete")
 
-    _FAMILY_RE = re.compile(r"^mt_(?:s3_stage|forensic|flight)_\w+$")
+    _FAMILY_RE = re.compile(
+        r"^mt_(?:s3_stage|forensic|flight|quorum|drive_op|trace_tree)"
+        r"_\w+$")
 
     def check_tree(self, mods: list[Module], repo: str):
         import os
@@ -650,7 +653,8 @@ class ObsDocsDriftRule(Rule):
                         yield el.lineno, "stage name", el.value
             elif isinstance(node, ast.Constant) and \
                     isinstance(node.value, str) and \
-                    cls._FAMILY_RE.match(node.value):
+                    cls._FAMILY_RE.match(node.value) and \
+                    not mod.rel.startswith("minio_tpu/analysis/"):
                 yield node.lineno, "metric family", node.value
 
 
@@ -826,6 +830,81 @@ class PoolRoutingRule(Rule):
                 "the pools layer's router instead")
 
 
+# -- span discipline ---------------------------------------------------------
+
+_POOLISH_RE = re.compile(
+    r"(?:^|_)(pool|pools|executor|exec|tpe|workers)\d*$", re.I)
+_SPAWN_METHODS = {"submit", "map", "apply_async"}
+
+
+class SpanDisciplineRule(Rule):
+    id = "span-discipline"
+    description = ("a function in minio_tpu/{storage,parallel,"
+                   "objectlayer} that captures the request contextvar "
+                   "(get_request_id) AND hands work to another thread "
+                   "(threading.Thread / pool .submit/.map/.apply_async) "
+                   "must also propagate the span parent "
+                   "(get_span_parent / push_span_parent — the "
+                   "_with_request_id shape), or the child's spans "
+                   "detach from the causal tree")
+
+    _SCOPE = ("minio_tpu/storage/", "minio_tpu/parallel/",
+              "minio_tpu/objectlayer/")
+
+    def check_module(self, mod: Module):
+        if not mod.rel.startswith(self._SCOPE):
+            return
+        thread_names = ThreadDisciplineRule._thread_ctor_names(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            has_rid = has_parent = False
+            spawn_line = spawn_label = None
+            # lexical scan incl. nested closures: the capture usually
+            # lives in an inner runner while the submit is in the
+            # outer fan-out — either way, one function owns both and
+            # must carry the parent alongside the request id
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = sub.func.attr \
+                    if isinstance(sub.func, ast.Attribute) \
+                    else (sub.func.id
+                          if isinstance(sub.func, ast.Name) else "")
+                if name == "get_request_id":
+                    has_rid = True
+                elif name in ("get_span_parent", "push_span_parent"):
+                    has_parent = True
+                if spawn_line is None:
+                    label = self._spawn_label(sub, thread_names)
+                    if label:
+                        spawn_line, spawn_label = sub.lineno, label
+            if has_rid and spawn_line is not None and not has_parent:
+                yield Finding(
+                    mod.rel, spawn_line, self.id,
+                    f"{node.name} captures get_request_id() and "
+                    f"spawns work ({spawn_label}) without "
+                    f"propagating the span parent — carry "
+                    f"get_span_parent() into the child (the "
+                    f"_with_request_id shape) or its spans detach "
+                    f"from the causal tree")
+
+    @staticmethod
+    def _spawn_label(call: ast.Call,
+                     thread_names: set[str]) -> str | None:
+        if ThreadDisciplineRule._is_thread_ctor(call.func,
+                                                thread_names):
+            return "threading.Thread"
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr in _SPAWN_METHODS:
+            if call.func.attr == "apply_async" or \
+                    _POOLISH_RE.search(
+                        _last_segment(call.func.value)):
+                return f"{_safe_unparse(call.func)}"
+        return None
+
+
 ALL_RULES = [
     BareExceptRule,
     MutableDefaultRule,
@@ -839,4 +918,5 @@ ALL_RULES = [
     TlsDisciplineRule,
     NamedSkipRule,
     PoolRoutingRule,
+    SpanDisciplineRule,
 ]

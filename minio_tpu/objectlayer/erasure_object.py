@@ -31,6 +31,7 @@ from typing import Optional
 import numpy as np
 
 from ..hashing import bitrot, md5fast
+from ..obs import critpath as _critpath
 from ..obs import trace as _trace
 from ..ops import gf8
 from ..ops.codec import Erasure
@@ -274,12 +275,17 @@ class ErasureObjects(MultipartOps, ObjectLayer):
 
     # -- drive fan-out helpers --------------------------------------------
 
-    def _fanout_items(self, fn, items):
+    def _fanout_items(self, fn, items, ends=None):
         """Run fn(item) concurrently over arbitrary items; returns
         (results, errs) aligned with items (parallelWriter/Reader
         analog, cmd/erasure-encode.go:36).  On a single-core host the
         thread pool buys nothing (local drive ops barely release the
-        GIL) and costs queue/lock churn per item — run serially there."""
+        GIL) and costs queue/lock churn per item — run serially there.
+
+        ``ends`` (optional, pre-sized to ``len(items)``): each child's
+        completion time in monotonic ns lands at its item position —
+        the completion vector the quorum critical-path engine
+        (obs/critpath.py) reduces."""
 
         def run(x):
             try:
@@ -287,32 +293,45 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             except Exception as e:  # noqa: BLE001 — per-item isolation
                 return None, e
 
-        if self._serial_fanout:
-            out = [run(x) for x in items]
+        if ends is None:
+            runner, seq = run, items
         else:
-            out = list(self._pool.map(self._with_request_id(run), items))
+            def runner(pair):
+                out = run(pair[1])
+                ends[pair[0]] = time.monotonic_ns()
+                return out
+            seq = list(enumerate(items))
+        if self._serial_fanout:
+            out = [runner(x) for x in seq]
+        else:
+            out = list(self._pool.map(self._with_request_id(runner),
+                                      seq))
         return [r for r, _ in out], [e for _, e in out]
 
     @staticmethod
     def _with_request_id(run):
-        """Carry the caller's request ID (and its X-ray stage clock)
-        into pool threads: contextvars do not cross thread boundaries,
-        and pool workers are REUSED — setting unconditionally (even to
-        ""/None) also clears a previous request's context, so per-drive
-        spans never mislabel and stage detail never lands on the wrong
-        request."""
+        """Carry the caller's request ID (plus its X-ray stage clock
+        and causal span parent) into pool threads: contextvars do not
+        cross thread boundaries, and pool workers are REUSED — setting
+        unconditionally (even to ""/None) also clears a previous
+        request's context, so per-drive spans never mislabel, stage
+        detail never lands on the wrong request, and drive-op spans
+        parent under the submitting span in the request's tree (the
+        span-discipline lint pins this shape)."""
         from ..obs import stages as _stages
         rid = _trace.get_request_id()
+        parent = _trace.get_span_parent()
         clock = _stages.current()
 
         def run_ctx(x):
             _trace.set_request_id(rid)
+            _trace.set_span_parent(parent)
             _stages.set_clock(clock)
             return run(x)
 
         return run_ctx
 
-    def _fanout(self, fn, disks=None):
+    def _fanout(self, fn, disks=None, ends=None):
         """fn(disk) on every drive concurrently; offline (None) drives
         report DiskNotFound in the aligned error list."""
 
@@ -322,19 +341,23 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             return fn(d)
 
         return self._fanout_items(run,
-                                  self.disks if disks is None else disks)
+                                  self.disks if disks is None else disks,
+                                  ends=ends)
 
-    def _fanout_indexed(self, fn, shuffled_disks):
+    def _fanout_indexed(self, fn, shuffled_disks, ends=None):
         """fn((shard_idx, disk)) per drive, aligned errors; offline drives
-        report DiskNotFound."""
+        report DiskNotFound.  ``ends`` as in :meth:`_fanout_items`."""
 
         def run(pair):
             if pair[1] is None:
                 return None, serrors.DiskNotFound("offline")
             try:
-                return fn(pair), None
+                out = fn(pair), None
             except Exception as e:  # noqa: BLE001
-                return None, e
+                out = None, e
+            if ends is not None:
+                ends[pair[0]] = time.monotonic_ns()
+            return out
 
         if self._serial_fanout:
             out = [run(p) for p in enumerate(shuffled_disks)]
@@ -342,6 +365,11 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             out = list(self._pool.map(self._with_request_id(run),
                                       enumerate(shuffled_disks)))
         return [r for r, _ in out], [e for _, e in out]
+
+    @staticmethod
+    def _drive_labels(disks) -> list[str]:
+        return [_critpath.drive_label(d) if d is not None else "offline"
+                for d in disks]
 
     def _geometry(self, parity_override: int | None) -> tuple[int, int]:
         """(k, m) for a write: the layer default or a per-request parity
@@ -615,7 +643,12 @@ class ErasureObjects(MultipartOps, ObjectLayer):
         # every fan-out worker parked on the gate
         resolver = self._pool.submit(resolve)
         try:
-            _, errs = self._fanout_indexed(write_one, shuffled)
+            t0 = _critpath.now_ns()
+            ends = [0] * len(shuffled)
+            _, errs = self._fanout_indexed(write_one, shuffled,
+                                           ends=ends)
+            _critpath.record("write", wq, self._drive_labels(shuffled),
+                             ends, t0, errs=errs)
             resolver.result()       # BadDigest outranks quorum errors
             try:
                 meta.reduce_errs(errs, wq, WriteQuorumError)
@@ -773,7 +806,12 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                                        version_dict=vdict)
             return idx
 
-        _, errs = self._fanout_indexed(write_one, shuffled)
+        t0 = _critpath.now_ns()
+        ends = [0] * len(shuffled)
+        _, errs = self._fanout_indexed(write_one, shuffled, ends=ends)
+        _critpath.record("write", self._write_quorum(fi),
+                         self._drive_labels(shuffled), ends, t0,
+                         errs=errs)
         try:
             meta.reduce_errs(errs, self._write_quorum(fi), WriteQuorumError)
         except serrors.VolumeNotFound:
@@ -1009,7 +1047,9 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 src, sw, m, fi, md5, stats, write_batch_for, wq)
             self._stamp_etag(fi, md5, opts, total, mod_time)
             with _stages.stage("write_drain"):
+                t_drain = _critpath.now_ns()
                 sw.drain()
+                sw.record_gating("write_drain", wq, t_drain)
             alive = sw.alive()
             if alive < wq:
                 raise WriteQuorumError(
@@ -1028,8 +1068,10 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                                  object_name)
 
             with _stages.stage("drive_commit"):
+                t_commit = _critpath.now_ns()
                 sw.submit_batch(commit_one)
                 sw.drain()
+                sw.record_gating("commit", wq, t_commit)
             cerrs = list(sw.errs)
             try:
                 meta.reduce_errs(cerrs, wq, WriteQuorumError)
@@ -1155,7 +1197,12 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 disk.rename_data(SYS_DIR, tmps[idx], dfi, bucket,
                                  object_name)
 
-            _, cerrs = self._fanout_indexed(commit_one, shuffled)
+            t0 = _critpath.now_ns()
+            cends = [0] * len(shuffled)
+            _, cerrs = self._fanout_indexed(commit_one, shuffled,
+                                            ends=cends)
+            _critpath.record("commit", wq, self._drive_labels(shuffled),
+                             cends, t0, errs=cerrs)
             try:
                 meta.reduce_errs(cerrs, wq, WriteQuorumError)
             except serrors.StorageError as e:
@@ -1182,8 +1229,11 @@ class ErasureObjects(MultipartOps, ObjectLayer):
     def _read_quorum_fileinfo(self, bucket: str, object_name: str,
                               version_id: Optional[str] = None
                               ) -> tuple[FileInfo, list[FileInfo | None]]:
+        t0 = _critpath.now_ns()
+        ends = [0] * len(self.disks)
         fis, errs = self._fanout(
-            lambda d: d.read_version(bucket, object_name, version_id))
+            lambda d: d.read_version(bucket, object_name, version_id),
+            ends=ends)
         nf = sum(1 for e in errs
                  if isinstance(e, (serrors.FileNotFound,
                                    serrors.FileVersionNotFound)))
@@ -1194,6 +1244,9 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             raise ObjectNotFound(f"{bucket}/{object_name}")
         quorum = max(1, len(self.disks) // 2)
         fi = meta.find_file_info_in_quorum(fis, quorum)
+        _critpath.record("read_meta", quorum,
+                         self._drive_labels(self.disks), ends, t0,
+                         errs=errs)
         return fi, fis
 
     def get_object_info(self, bucket: str, object_name: str,
@@ -1487,11 +1540,15 @@ class ErasureObjects(MultipartOps, ObjectLayer):
 
         shards: list[np.ndarray | None] = [None] * nsh
         got = 0
+        t0 = _critpath.now_ns()
+        ends_all = [0] * nsh
         candidates = [j for j in range(nsh) if j not in dead]
         while got < k and candidates:
             batch, candidates = candidates[:k - got], candidates[k - got:]
-            res, errs = self._fanout_items(read_one, batch)
-            for j, r, e in zip(batch, res, errs):
+            bends = [0] * len(batch)
+            res, errs = self._fanout_items(read_one, batch, ends=bends)
+            for pos, (j, r, e) in enumerate(zip(batch, res, errs)):
+                ends_all[j] = bends[pos]
                 if e is None:
                     shards[j] = r
                     got += 1
@@ -1499,6 +1556,10 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                     dead.add(j)
         if got < k:
             raise ReadQuorumError(f"only {got} of {k} shards readable")
+        _critpath.record("read", k, self._drive_labels(shuffled),
+                         ends_all, t0,
+                         errs=[True if j in dead else None
+                               for j in range(nsh)])
         return shards
 
     def _assemble(self, shards: list[np.ndarray | None], fi: FileInfo,

@@ -39,9 +39,14 @@ SNAP_INTERVAL_S = 60.0
 
 # record tuple layout (compact on purpose — the hot path appends, the
 # admin route renders):  (wall_ns, req_id, api, status, dur_ns, rx,
-# tx, stages, async_stages, error)
+# tx, stages, async_stages, error, gating) where ``gating`` is the
+# request's quorum critical-path rows (obs/critpath.py compact tuples)
 _F_TIME, _F_RID, _F_API, _F_STATUS, _F_DUR, _F_RX, _F_TX, _F_STAGES, \
-    _F_ASYNC, _F_ERR = range(10)
+    _F_ASYNC, _F_ERR, _F_GATING = range(11)
+
+# a giant streaming request crosses one reduction per batch — cap what
+# one flight-recorder row renders so the xray reply stays bounded
+_GATING_RENDER_CAP = 16
 
 
 def system_snapshot(brief: bool = False) -> dict:
@@ -102,10 +107,11 @@ class FlightRecorder:
 
     def record(self, req_id: str, api: str, status: int, dur_ns: int,
                rx: int, tx: int, stages: tuple = (),
-               async_stages: tuple = (), error: str = "") -> None:
+               async_stages: tuple = (), error: str = "",
+               gating: tuple = ()) -> None:
         """Append one completed request (two bounded deque appends)."""
         rec = (time.time_ns(), req_id, api, status, dur_ns, rx, tx,
-               stages, async_stages, error)
+               stages, async_stages, error, gating)
         self.requests.append(rec)
         self.records_total += 1
         if status >= 400 or error:
@@ -140,7 +146,8 @@ class FlightRecorder:
 
     @staticmethod
     def _render(rec: tuple) -> dict:
-        return {
+        gating = rec[_F_GATING] if len(rec) > _F_GATING else ()
+        out = {
             "timeNs": rec[_F_TIME],
             "requestID": rec[_F_RID],
             "api": rec[_F_API],
@@ -152,6 +159,11 @@ class FlightRecorder:
             "asyncStages": dict(rec[_F_ASYNC]),
             **({"error": rec[_F_ERR]} if rec[_F_ERR] else {}),
         }
+        if gating:
+            from . import critpath as _critpath
+            out["gating"] = [_critpath.render_row(g)
+                             for g in gating[:_GATING_RENDER_CAP]]
+        return out
 
     def query(self, api: str = "", min_duration_ms: float = 0.0,
               errors_only: bool = False, limit: int = 100) -> list[dict]:

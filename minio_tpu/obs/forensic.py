@@ -54,6 +54,10 @@ TRIGGERS = ("error_ceiling", "breaker_burst", "shed_burst",
 
 _CHECK_INTERVAL_S = 1.0
 
+# breach-window trace trees attached per bundle (newest error rids
+# first) — bounds the tracetrees.json section, not the evidence rings
+_TREE_BUNDLE_CAP = 32
+
 # config keys whose VALUES are secret material; matched on the key
 # name so a future knob with a secret-shaped name is redacted by
 # default (fail closed) — the PR-2 header-redaction contract applied
@@ -343,6 +347,27 @@ class ForensicSys:
             except Exception:  # noqa: BLE001 — rings still dump below
                 pass
             put("flightrec.json", rec.dump())
+            try:
+                # assembled causal trees for the breach-window
+                # requests (the error ring's rids): the span ring is
+                # still resident at bundle time, so the trees capture
+                # exactly the requests the SLO row tripped over
+                from . import tracetree as _tt
+                from .flightrec import _F_RID
+                rids = []
+                for r in reversed(rec.errors):
+                    if r[_F_RID] and r[_F_RID] not in rids:
+                        rids.append(r[_F_RID])
+                    if len(rids) >= _TREE_BUNDLE_CAP:
+                        break
+                spans = _tt.local_spans(
+                    rids=tuple(rids),
+                    node=getattr(srv, "node_name", ""))
+                put("tracetrees.json", {
+                    "rids": rids,
+                    "trees": _tt.assemble(spans)})
+            except Exception as e:  # noqa: BLE001 — one bad section
+                put("tracetrees.json", {"error": str(e)})
         put("system.json", system_snapshot())
         try:
             from .selftest import local_drive_paths

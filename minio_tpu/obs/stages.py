@@ -88,7 +88,8 @@ class StageClock:
     never corrupt the serial reconciliation.
     """
 
-    __slots__ = ("t0_ns", "owner", "_stack", "serial", "async_detail")
+    __slots__ = ("t0_ns", "owner", "_stack", "serial", "async_detail",
+                 "gatings")
 
     def __init__(self):
         self.t0_ns = time.monotonic_ns()
@@ -97,6 +98,12 @@ class StageClock:
         self._stack: list = []
         self.serial: dict = {}
         self.async_detail: dict = {}
+        # quorum critical-path rows (obs/critpath.py): compact tuples
+        # (plane, k, n, gating_label, kth_label, kth_ns, wall_ns,
+        # trail_ns), appended at each quorum reduction the request
+        # crossed and rendered into its flight-recorder row — a list
+        # append per reduction, no dicts on the hot path
+        self.gatings: list = []
 
     # -- serial stages (owner thread only) -----------------------------------
 
@@ -232,3 +239,12 @@ def add_async(name: str, dur_ns: int) -> None:
     c = _CLOCK.get()
     if c is not None:
         c.add_async(name, dur_ns)
+
+
+def note_gating(row: tuple) -> None:
+    """Attach one quorum critical-path row to the armed clock, if any
+    (list append under the GIL — safe from writer/pool threads the
+    clock rode into, same discipline as add_async)."""
+    c = _CLOCK.get()
+    if c is not None:
+        c.gatings.append(row)

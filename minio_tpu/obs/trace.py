@@ -33,6 +33,8 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import itertools
+import os
 import threading
 import time
 from typing import Any, Dict
@@ -87,6 +89,115 @@ def set_request_id(request_id: str) -> None:
 
 def get_request_id() -> str:
     return _REQUEST_ID.get()
+
+
+# -- causal span trees --------------------------------------------------------
+#
+# Beyond flat request-ID correlation, every span carries a span_id and
+# a parent_id so a request's drive ops, kernel dispatches, batcher
+# waits, and peer-side twins assemble into ONE tree (Dapper's causal
+# model, not just its correlation model).  The parent rides beside the
+# request ID: explicitly into fan-out pool threads and writer-plane
+# queues (contextvars do not cross threads), and over the internode
+# wire in an X-Span-Parent header beside X-Request-ID.  The request
+# root's span id IS the request id, so a tree is addressable by the
+# same key as its flight-recorder row.
+_SPAN_PARENT: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "mt_span_parent", default="")
+
+# span-id mint: a per-process prefix + counter — two allocation-free
+# int ops per id, unique across the nodes of a test cluster sharing
+# one process (the NODE_NAME caveat does not bite: ids, not names)
+_SID_PREFIX = f"{(os.getpid() ^ time.time_ns()) & 0xffffffff:08x}"
+_SID_COUNTER = itertools.count(1)
+
+
+def new_span_id() -> str:
+    return f"{_SID_PREFIX}-{next(_SID_COUNTER):x}"
+
+
+def set_span_parent(span_id: str) -> None:
+    _SPAN_PARENT.set(span_id)
+
+
+def get_span_parent() -> str:
+    return _SPAN_PARENT.get()
+
+
+def push_span_parent(span_id: str):
+    """Make ``span_id`` the parent for spans emitted in this context;
+    returns a token for :func:`pop_span_parent` (the internode client
+    leg brackets its roundtrip with this so peer-side spans nest under
+    the client-side internode span)."""
+    return _SPAN_PARENT.set(span_id)
+
+
+def pop_span_parent(token) -> None:
+    _SPAN_PARENT.reset(token)
+
+
+# Always-on causal span ring: compact tuples, appended even with zero
+# subscribers (the flight-recorder discipline — the evidence for a
+# breach-window request must already be on hand when the forensic
+# trigger fires).  Slot layout:
+#   (start_ns, request_id, span_id, parent_id, type, name, dur_ns,
+#    error, label, extra)
+# ``label`` is the one attribution string worth paying for idle (drive
+# endpoint / peer endpoint / plane); ``extra`` is None except for
+# quorum-gating spans, which carry their compact gating tuple.
+SPAN_RING_CAP = 16384
+
+_R_START, _R_RID, _R_SID, _R_PARENT, _R_TYPE, _R_NAME, _R_DUR, \
+    _R_ERR, _R_LABEL, _R_EXTRA = range(10)
+
+
+class _SpanRing:
+    """Fixed-slot overwrite ring (the lastminute lock-cheap model):
+    appends are a list store + one int add under the GIL; a racing
+    pair of appends can overwrite one slot, which minute-granularity
+    tree assembly tolerates — span capture must never serialize the
+    drive hot path on an observability lock."""
+
+    __slots__ = ("_buf", "_cap", "_n")
+
+    def __init__(self, cap: int):
+        self._buf: list = [None] * cap
+        self._cap = cap
+        self._n = 0
+
+    def append(self, rec: tuple) -> None:
+        n = self._n
+        self._buf[n % self._cap] = rec
+        self._n = n + 1
+
+    def snapshot(self) -> list:
+        """Live records, oldest first (query time only)."""
+        n = self._n
+        if n <= self._cap:
+            out = self._buf[:n]
+        else:
+            i = n % self._cap
+            out = self._buf[i:] + self._buf[:i]
+        return [r for r in out if r is not None]
+
+    def appended_total(self) -> int:
+        return self._n
+
+    def clear(self) -> None:
+        self._buf = [None] * self._cap
+        self._n = 0
+
+
+SPANS = _SpanRing(SPAN_RING_CAP)
+
+
+def ring_append(rid: str, span_id: str, parent_id: str, trace_type: str,
+                name: str, start_ns: int, dur_ns: int, error: str = "",
+                label: str = "", extra=None) -> None:
+    """Append one compact causal-span tuple (the idle-path emit: span
+    dict construction stays behind :func:`active`)."""
+    SPANS.append((start_ns, rid, span_id, parent_id, trace_type, name,
+                  dur_ns, error, label, extra))
 
 
 # deep-span activation bookkeeping: a default (http-only) `admin trace`
@@ -212,17 +323,38 @@ def make_trace(node_name: str, func_name: str, *, method: str, path: str,
 def make_span(trace_type: str, func_name: str, *, start_ns: int,
               duration_ns: int, input_bytes: int = 0,
               output_bytes: int = 0, error: str = "",
-              detail: Dict[str, Any] | None = None) -> Dict[str, Any]:
+              detail: Dict[str, Any] | None = None,
+              span_id: str = "",
+              parent_id: str | None = None,
+              _ring: bool = True) -> Dict[str, Any]:
     """Subsystem span (the ``mc admin trace -a`` record shape):
     smaller than an HTTP trace.Info but keyed the same so one consumer
     handles both.  ``detail`` lands under the trace-type key, e.g.
-    ``{"storage": {"drive": ..., "volume": ..., "path": ...}}``."""
+    ``{"storage": {"drive": ..., "volume": ..., "path": ...}}``.
+
+    Every span is a causal-tree node: ``spanID`` (minted here unless
+    the caller pre-minted one to propagate, e.g. the internode client
+    leg) and ``parentID`` (the contextvar parent unless overridden).
+    The span is also appended to the always-on causal ring, so active
+    consumers and the ring see the same ids."""
+    rid = get_request_id()
+    sid = span_id or new_span_id()
+    par = get_span_parent() if parent_id is None else parent_id
+    if rid and _ring:
+        label = ""
+        if detail:
+            label = str(detail.get("drive") or detail.get("endpoint")
+                        or "")
+        SPANS.append((start_ns, rid, sid, par, trace_type, func_name,
+                      duration_ns, error, label, None))
     return {
         "type": trace_type,
         "nodeName": NODE_NAME,
         "funcName": func_name,
         "time": start_ns,
-        "requestID": get_request_id(),
+        "requestID": rid,
+        "spanID": sid,
+        "parentID": par,
         "callStats": {
             "inputBytes": input_bytes,
             "outputBytes": output_bytes,

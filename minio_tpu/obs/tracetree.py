@@ -1,0 +1,310 @@
+"""Causal trace trees — assembly, query, and OTLP export.
+
+The span ring (obs/trace.py) records flat compact tuples; every span
+carries ``span_id``/``parent_id``, so one request's drive ops, kernel
+dispatches, batcher waits, quorum reductions, and peer-side twins can
+be reassembled into ONE tree after the fact — the Dapper model, but
+always-on and bounded (the ring, not a sampler).  This module is the
+read side:
+
+  * :func:`local_spans` — render this node's ring into flat span dicts
+    (each stamped with the node name, so cross-node merges stay
+    attributable);
+  * :func:`assemble` — group flat spans (local or peer-fetched) by
+    request id and knit parent→children trees.  A span whose parent
+    was overwritten in the ring re-attaches under the root with an
+    ``orphan`` marker — a lossy ring must degrade to a shallower tree,
+    never to a dropped span;
+  * :func:`tree_reply` — one node's admin ``trace-tree`` reply (THE
+    builder: the route's local leg and the peer RPC both call it, the
+    xray_reply discipline);
+  * :func:`to_otlp` — the OTLP/JSON (resourceSpans→scopeSpans→spans)
+    shape for export through the egress plane.  IDs are derived
+    deterministically (md5 of the internal ids, truncated to OTLP's
+    16-byte trace / 8-byte span hex), so re-exports of the same tree
+    are idempotent at the collector.
+
+Aggregation protocol: the admin route merges the caller's local spans
+with every peer's ``trace_tree_query`` reply.  Peers return spans for
+(a) their OWN matching roots and (b) any ``rids`` the caller names —
+so trees rooted on the caller always arrive complete, and a specific
+``?rid=`` query is complete from any node.  (On ≥3-node clusters a
+peer-rooted tree's third-node children need the rid-scoped form; the
+one-round listing trades that corner for bounded fan-out.)
+
+Idle contract: nothing here runs on the request path — assembly and
+export are admin-route work over ring snapshots.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+
+from ..admin.metrics import GLOBAL as _metrics
+from . import critpath as _critpath
+from . import trace as _trace
+
+# bounds shared by the route, the peer RPC, and the forensic attach —
+# a tree query must never ship the whole 16k-slot ring per peer
+MAX_TREES = 100
+DEFAULT_TREES = 20
+
+
+# -- flat span rendering ------------------------------------------------------
+
+def render_span(rec: tuple, node: str = "") -> dict:
+    """One ring tuple → the wire/json span dict (flat; no children)."""
+    out = {
+        "requestID": rec[_trace._R_RID],
+        "spanID": rec[_trace._R_SID],
+        "parentID": rec[_trace._R_PARENT],
+        "type": rec[_trace._R_TYPE],
+        "name": rec[_trace._R_NAME],
+        "startNs": rec[_trace._R_START],
+        "durationNs": rec[_trace._R_DUR],
+    }
+    if node:
+        out["node"] = node
+    if rec[_trace._R_ERR]:
+        out["error"] = rec[_trace._R_ERR]
+    if rec[_trace._R_LABEL]:
+        out["label"] = rec[_trace._R_LABEL]
+    extra = rec[_trace._R_EXTRA]
+    if isinstance(extra, tuple):         # a quorum.* gating row
+        out["gating"] = _critpath.render_row(extra)
+    elif isinstance(extra, int) and extra:
+        out["status"] = extra            # the http root's status code
+    return out
+
+
+def local_spans(rid: str = "", rids: tuple = (),
+                node: str = "") -> list[dict]:
+    """This node's ring as flat span dicts, oldest first.  ``rid``
+    narrows to one request; ``rids`` to a named set (the peer-merge
+    protocol); both empty means everything resident."""
+    want = set(rids) if rids else None
+    out = []
+    for rec in _trace.SPANS.snapshot():
+        r = rec[_trace._R_RID]
+        if rid and r != rid:
+            continue
+        if want is not None and not rid and r not in want:
+            continue
+        out.append(render_span(rec, node=node))
+    return out
+
+
+# -- tree assembly ------------------------------------------------------------
+
+def assemble(spans: list[dict]) -> list[dict]:
+    """Flat spans (any mix of nodes) → one tree per request id,
+    oldest-root first.  The root is the span whose id equals the
+    request id (minted in s3/server._dispatch); a request whose root
+    aged out of every ring gets a synthetic ``partial`` root so its
+    surviving children remain queryable."""
+    by_rid: dict[str, dict[str, dict]] = {}
+    order: list[str] = []
+    for s in spans:
+        rid = s.get("requestID", "")
+        if not rid:
+            continue
+        nodes = by_rid.get(rid)
+        if nodes is None:
+            nodes = by_rid[rid] = {}
+            order.append(rid)
+        sid = s.get("spanID", "")
+        if sid in nodes:                 # ring overlap across peers
+            continue
+        nodes[sid] = dict(s, children=[])
+    trees = []
+    for rid in order:
+        nodes = by_rid[rid]
+        root = nodes.get(rid)
+        if root is None:
+            root = nodes[rid] = {
+                "requestID": rid, "spanID": rid, "parentID": "",
+                "type": "http", "name": "(root evicted)", "startNs": 0,
+                "durationNs": 0, "partial": True, "children": []}
+        for s in nodes.values():
+            if s is root:
+                continue
+            parent = nodes.get(s.get("parentID", ""))
+            if parent is None or parent is s:
+                s["orphan"] = True       # parent lost to ring overwrite
+                parent = root
+            parent["children"].append(s)
+        _sort_children(root)
+        trees.append(root)
+    return trees
+
+
+def _sort_children(node: dict, _depth: int = 0) -> None:
+    kids = node.get("children", ())
+    for k in kids:
+        if _depth < 64:                  # orphan rewires cap real depth
+            _sort_children(k, _depth + 1)
+    node["children"] = sorted(kids, key=lambda s: s.get("startNs", 0))
+
+
+def span_count(tree: dict) -> int:
+    return 1 + sum(span_count(c) for c in tree.get("children", ()))
+
+
+def _tree_error(tree: dict) -> bool:
+    if tree.get("error") or tree.get("status", 0) >= 400:
+        return True
+    return any(_tree_error(c) for c in tree.get("children", ()))
+
+
+def filter_trees(trees: list[dict], api: str = "",
+                 min_duration_ms: float = 0.0,
+                 errors_only: bool = False,
+                 limit: int = DEFAULT_TREES) -> list[dict]:
+    """Newest-root-first filtered trees (the xray filter vocabulary,
+    applied to roots)."""
+    min_ns = int(min_duration_ms * 1e6)
+    out = []
+    for tree in sorted(trees, key=lambda t: t.get("startNs", 0),
+                       reverse=True):
+        if api and tree.get("name") != api:
+            continue
+        if min_ns and tree.get("durationNs", 0) < min_ns:
+            continue
+        if errors_only and not _tree_error(tree):
+            continue
+        out.append(tree)
+        if len(out) >= limit:
+            break
+    return out
+
+
+# -- the admin reply builder --------------------------------------------------
+
+def tree_reply(srv, rid: str = "", api: str = "",
+               min_duration_ms: float = 0.0, errors_only: bool = False,
+               limit: int = DEFAULT_TREES, rids: tuple = ()) -> dict:
+    """One node's trace-tree reply — flat ``spans`` for the merge path
+    plus assembled local ``trees`` for the single-node / ?local=true
+    read.  ``rids`` is the peer-merge protocol: spans for the caller's
+    roots ride along so its trees assemble complete."""
+    try:
+        limit = max(1, min(int(limit), MAX_TREES))
+    except (TypeError, ValueError):
+        limit = DEFAULT_TREES
+    node = getattr(srv, "node_name", "")
+    _metrics.inc("mt_trace_tree_query_total", {}, 1)
+    if rid:
+        spans = local_spans(rid=rid, node=node)
+    else:
+        local = local_spans(node=node)
+        roots = filter_trees(
+            assemble(local), api=api, min_duration_ms=min_duration_ms,
+            errors_only=errors_only, limit=limit)
+        keep = {t["requestID"] for t in roots} | set(rids or ())
+        spans = [s for s in local if s.get("requestID") in keep]
+    return {
+        "node": node,
+        "spans": spans,
+        "trees": filter_trees(
+            assemble(spans), api=api, min_duration_ms=min_duration_ms,
+            errors_only=errors_only, limit=limit),
+    }
+
+
+def merge_replies(local_reply: dict, peer_replies: list,
+                  api: str = "", min_duration_ms: float = 0.0,
+                  errors_only: bool = False,
+                  limit: int = DEFAULT_TREES) -> list[dict]:
+    """Cluster view: every node's flat spans pooled, then assembled —
+    a frontend root adopts its peer-side children here."""
+    spans = list(local_reply.get("spans", ()))
+    for r in peer_replies:
+        if isinstance(r, dict):
+            spans.extend(r.get("spans", ()))
+    return filter_trees(assemble(spans), api=api,
+                        min_duration_ms=min_duration_ms,
+                        errors_only=errors_only, limit=limit)
+
+
+# -- OTLP export --------------------------------------------------------------
+
+def _otlp_trace_id(rid: str) -> str:
+    return hashlib.md5(rid.encode()).hexdigest()          # 16 bytes hex
+
+def _otlp_span_id(sid: str) -> str:
+    return hashlib.md5(sid.encode()).hexdigest()[:16]     # 8 bytes hex
+
+
+def _otlp_span(tree: dict, trace_id: str, out: list) -> None:
+    attrs = [{"key": "mt.type",
+              "value": {"stringValue": tree.get("type", "")}}]
+    for key, akey in (("node", "host.name"), ("label", "mt.label"),
+                      ("error", "mt.error")):
+        if tree.get(key):
+            attrs.append({"key": akey,
+                          "value": {"stringValue": str(tree[key])}})
+    if tree.get("status"):
+        attrs.append({"key": "http.status_code",
+                      "value": {"intValue": int(tree["status"])}})
+    if tree.get("gating"):
+        attrs.append({"key": "mt.gating",
+                      "value": {"stringValue": str(tree["gating"])}})
+    start = tree.get("startNs", 0)
+    span = {
+        "traceId": trace_id,
+        "spanId": _otlp_span_id(tree.get("spanID", "")),
+        "name": tree.get("name", ""),
+        "kind": 2 if tree.get("type") == "http" else 1,
+        "startTimeUnixNano": str(start),
+        "endTimeUnixNano": str(start + tree.get("durationNs", 0)),
+        "attributes": attrs,
+        "status": {"code": 2 if tree.get("error") else 0},
+    }
+    parent = tree.get("parentID", "")
+    if parent:
+        span["parentSpanId"] = _otlp_span_id(parent)
+    out.append(span)
+    for c in tree.get("children", ()):
+        _otlp_span(c, trace_id, out)
+
+
+def to_otlp(trees: list[dict], node: str = "") -> dict:
+    """Assembled trees → one OTLP/JSON ExportTraceServiceRequest-shaped
+    document (resourceSpans → scopeSpans → spans)."""
+    spans: list[dict] = []
+    for tree in trees:
+        _otlp_span(tree, _otlp_trace_id(tree.get("requestID", "")),
+                   spans)
+    return {"resourceSpans": [{
+        "resource": {"attributes": [
+            {"key": "service.name",
+             "value": {"stringValue": "minio-tpu"}},
+            {"key": "host.name", "value": {"stringValue": node}},
+        ]},
+        "scopeSpans": [{
+            "scope": {"name": "minio_tpu.tracetree", "version": "1"},
+            "spans": spans,
+        }],
+    }]}
+
+
+def export_trees(srv, trees: list[dict]) -> int:
+    """Push one OTLP document per tree through every ``logger``-type
+    egress target (store-and-forward, breaker-guarded — the audit
+    pipeline's delivery engine).  Returns documents handed off."""
+    egress = getattr(srv, "egress", None)
+    targets = [t for t in (egress.targets() if egress else ())
+               if t.target_type == "logger"]
+    if not targets or not trees:
+        return 0
+    node = getattr(srv, "node_name", "")
+    n = 0
+    for tree in trees:
+        doc = to_otlp([tree], node=node)
+        doc["time"] = time.time()        # queue-store replay ordering
+        for t in targets:
+            t.send(doc)
+        n += 1
+    _metrics.inc("mt_trace_tree_export_total", {}, n)
+    return n

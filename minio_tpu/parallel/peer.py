@@ -187,6 +187,16 @@ def register_peer_service(rpc: RPCServer, srv) -> None:
         from ..admin.handlers import forensic_inventory
         return forensic_inventory(srv)
 
+    def trace_tree_query(rid: str = "", api: str = "",
+                         min_duration_ms: float = 0.0,
+                         errors_only: bool = False, limit: int = 20,
+                         rids=()):
+        from ..obs import tracetree as _tt
+        return _tt.tree_reply(srv, rid=rid, api=api,
+                              min_duration_ms=min_duration_ms,
+                              errors_only=errors_only, limit=limit,
+                              rids=tuple(rids or ()))
+
     rpc.register("peer", {
         "reload_bucket_meta": reload_bucket_meta,
         "reload_iam": reload_iam,
@@ -207,6 +217,7 @@ def register_peer_service(rpc: RPCServer, srv) -> None:
         "xray_query": xray_query,
         "healthinfo_collect": healthinfo_collect,
         "forensic_list": forensic_list,
+        "trace_tree_query": trace_tree_query,
     })
 
 
@@ -361,26 +372,53 @@ class PeerNotifier:
         stopped and would silently drop that node's dumps; peer
         speedtests: a replay re-runs the whole measured load)."""
         import queue as _q
-        done: _q.Queue = _q.Queue()
 
-        def one(c: RPCClient):
+        from ..obs import critpath as _critpath
+        from ..obs import trace as _trace
+        done: _q.Queue = _q.Queue()
+        # propagate the causal identity into the fan-out threads so
+        # every peer leg's RPC span parents under the caller's span
+        # (and carry the span parent whenever the request id rides —
+        # the span-discipline contract)
+        rid = _trace.get_request_id()
+        parent = _trace.get_span_parent()
+        labels = [c.endpoint for c in self.clients]
+        ends = [0] * len(self.clients)
+        errs: list = [None] * len(self.clients)
+        t0 = _critpath.now_ns()
+
+        def one(i: int, c: RPCClient):
+            _trace.set_request_id(rid)
+            _trace.set_span_parent(parent)
             try:
-                done.put((c.endpoint,
-                          c.call("peer", method,
-                                 _idempotent=idempotent,
-                                 _timeout=timeout_s, **kwargs), ""))
+                r = c.call("peer", method, _idempotent=idempotent,
+                           _timeout=timeout_s, **kwargs)
+                ends[i] = _critpath.now_ns()
+                done.put((c.endpoint, r, ""))
             except Exception as e:  # noqa: BLE001 — peer down/slow
+                errs[i] = e
+                ends[i] = _critpath.now_ns()
                 done.put((c.endpoint, None,
                           f"{type(e).__name__}: {e}"))
 
-        for c in self.clients:
-            threading.Thread(target=one, args=(c,), daemon=True,
+        def record_gating():
+            # the aggregation gate is the LAST reply; k = n-1 makes
+            # the trail histogram read "how far the slowest peer
+            # trailed the rest" (an all-wait has no partial quorum)
+            n = len(self.clients)
+            if n > 1:
+                _critpath.record("rpc", max(1, n - 1), labels,
+                                 list(ends), t0, errs=errs)
+
+        for i, c in enumerate(self.clients):
+            threading.Thread(target=one, args=(i, c), daemon=True,
                              name="mt-peer-call").start()
         deadline = time.monotonic() + timeout_s
         pending = {c.endpoint for c in self.clients}
         while pending:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
+                record_gating()
                 for ep in sorted(pending):
                     yield ep, None, "timeout"
                 return
@@ -390,6 +428,7 @@ class PeerNotifier:
                 continue
             pending.discard(ep)
             yield ep, result, err
+        record_gating()
 
     def call_all(self, method: str, timeout_s: float = 30.0,
                  idempotent: bool = True, **kwargs) -> list:

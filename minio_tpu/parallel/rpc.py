@@ -243,6 +243,12 @@ class FrameReader:
 # request (Dapper-style context propagation over peerREST)
 REQUEST_ID_HEADER = "X-Request-ID"
 
+# causal-tree propagation (ISSUE 17): the CLIENT leg's span id rides
+# beside the request ID so the peer's server span — and every drive op
+# under it — parents into the caller's tree instead of floating as a
+# flat twin
+SPAN_PARENT_HEADER = "X-Span-Parent"
+
 # the observability plane must not observe itself: the trace-ring poll
 # would otherwise emit client+server internode spans per 0.5s poll that
 # feed back into the very stream being aggregated (the reference
@@ -606,6 +612,26 @@ class RPCServer:
                         self.close_connection = True
                 return sent
 
+            def _server_span(self, name, t0, err, in_b, out_b,
+                             detail):
+                """Settle one server-side internode span: a published
+                dict when a trace consumer is live (make_span also
+                rings it), else a compact ring tuple so the peer half
+                of the causal tree survives with zero subscribers."""
+                dt = time.monotonic_ns() - t0
+                if _trace.active():
+                    _trace.publish_span(_trace.make_span(
+                        "internode", name,
+                        start_ns=_trace.now_ns() - dt, duration_ns=dt,
+                        input_bytes=in_b, output_bytes=out_b,
+                        error=err, span_id=self._span_id,
+                        parent_id=self._span_parent, detail=detail))
+                elif self._span_id:
+                    _trace.ring_append(
+                        _trace.get_request_id(), self._span_id,
+                        self._span_parent, "internode", name,
+                        _trace.now_ns() - dt, dt, err)
+
             def do_POST(self):
                 path = urllib.parse.urlsplit(self.path).path
                 auth = self.headers.get("Authorization", "")
@@ -620,9 +646,18 @@ class RPCServer:
                 # adopt the caller's request ID for every span this
                 # handler thread emits (drive ops, codec calls); set
                 # unconditionally so keep-alive reuse never leaks a
-                # previous request's ID into the next one
-                _trace.set_request_id(
-                    self.headers.get(REQUEST_ID_HEADER, "") or "")
+                # previous request's ID into the next one.  Same
+                # discipline for the causal tree: the client leg's span
+                # id arrives in X-Span-Parent, this handler's server
+                # span nests under it, and the handler's own work nests
+                # under the server span (set even when empty so a
+                # reused connection never inherits a stale parent)
+                srid = self.headers.get(REQUEST_ID_HEADER, "") or ""
+                _trace.set_request_id(srid)
+                self._span_parent = \
+                    self.headers.get(SPAN_PARENT_HEADER, "") or ""
+                self._span_id = _trace.new_span_id() if srid else ""
+                _trace.set_span_parent(self._span_id)
                 parts = path.strip("/").split("/")
                 if len(parts) >= 2 and parts[0] == "raw":
                     return self._do_raw(parts[1])
@@ -642,8 +677,8 @@ class RPCServer:
                 # not emit garbage latency_ns (same pattern as the
                 # storage/kernel instrumentation)
                 t0 = time.monotonic_ns() \
-                    if _trace.active() and path not in UNTRACED_PATHS \
-                    else 0
+                    if (self._span_id or _trace.active()) \
+                    and path not in UNTRACED_PATHS else 0
                 err = ""
                 try:
                     kwargs = msgpack.unpackb(self.rfile.read(n), raw=False) \
@@ -658,15 +693,11 @@ class RPCServer:
                         "message": str(e)})
                 finally:
                     if t0:
-                        dt = time.monotonic_ns() - t0
-                        _trace.publish_span(_trace.make_span(
-                            "internode", f"internode{path}",
-                            start_ns=_trace.now_ns() - dt,
-                            duration_ns=dt,
-                            input_bytes=n, error=err,
-                            detail={"service": parts[1],
-                                    "method": parts[2],
-                                    "side": "server"}))
+                        self._server_span(f"internode{path}", t0, err,
+                                          n, 0,
+                                          {"service": parts[1],
+                                           "method": parts[2],
+                                           "side": "server"})
 
             def _do_raw(self, name: str):
                 """Bulk endpoint: params ride the X-RPC-Params header
@@ -685,7 +716,8 @@ class RPCServer:
                     return self._reply(404, {"ok": False,
                                              "error_type": "NoSuchMethod",
                                              "message": name})
-                t0 = time.monotonic_ns() if _trace.active() else 0
+                t0 = time.monotonic_ns() \
+                    if self._span_id or _trace.active() else 0
                 err = ""
                 out = None
                 out_n = 0
@@ -707,16 +739,11 @@ class RPCServer:
                         "message": str(e)})
                 finally:
                     if t0:
-                        dt = time.monotonic_ns() - t0
-                        _trace.publish_span(_trace.make_span(
-                            "internode", f"internode/raw/{name}",
-                            start_ns=_trace.now_ns() - dt,
-                            duration_ns=dt,
-                            input_bytes=n,
-                            output_bytes=out_n,
-                            error=err,
-                            detail={"service": "raw", "method": name,
-                                    "side": "server"}))
+                        self._server_span(f"internode/raw/{name}", t0,
+                                          err, n, out_n,
+                                          {"service": "raw",
+                                           "method": name,
+                                           "side": "server"})
 
             def _do_raw_stream(self, name: str, mode: str):
                 """Framed-streaming request (``X-RPC-Stream: frames``):
@@ -736,7 +763,8 @@ class RPCServer:
                     return self._reply(404, {"ok": False,
                                              "error_type": "NoSuchMethod",
                                              "message": name})
-                t0 = time.monotonic_ns() if _trace.active() else 0
+                t0 = time.monotonic_ns() \
+                    if self._span_id or _trace.active() else 0
                 err = ""
                 out_n = 0
                 try:
@@ -773,17 +801,13 @@ class RPCServer:
                         self.close_connection = True
                 finally:
                     if t0:
-                        dt = time.monotonic_ns() - t0
-                        _trace.publish_span(_trace.make_span(
-                            "internode", f"internode/raw/{name}",
-                            start_ns=_trace.now_ns() - dt,
-                            duration_ns=dt,
-                            input_bytes=frames.bytes,
-                            output_bytes=out_n,
-                            error=err,
-                            detail={"service": "raw", "method": name,
-                                    "side": "server", "streamed": True,
-                                    "frames": frames.frames}))
+                        self._server_span(f"internode/raw/{name}", t0,
+                                          err, frames.bytes, out_n,
+                                          {"service": "raw",
+                                           "method": name,
+                                           "side": "server",
+                                           "streamed": True,
+                                           "frames": frames.frames})
 
         return Handler
 
@@ -1092,6 +1116,9 @@ class RPCClient:
         rid = _trace.get_request_id()
         if rid:
             headers[REQUEST_ID_HEADER] = rid
+            sp = _trace.get_span_parent()
+            if sp:
+                headers[SPAN_PARENT_HEADER] = sp
         from ..admin.metrics import GLOBAL as _mtr
         start = time.monotonic()
         state = {"attempt": 0, "stale": 0}
@@ -1208,9 +1235,19 @@ class RPCClient:
         body = msgpack.packb(kwargs, use_bin_type=True)
         # X-ray: the internode leg's wall time, attributed to the
         # request whose clock rode into this thread (async detail —
-        # fan-out legs overlap the request thread's serial stages)
+        # fan-out legs overlap the request thread's serial stages).
+        # Causal tree: mint this leg's span id and push it as the span
+        # parent for the roundtrip, so the X-Span-Parent header carries
+        # it and the peer's twin nests underneath; the leg itself lands
+        # in the ring even with zero trace subscribers.
         from ..obs import stages as _stages
+        rid = _trace.get_request_id()
+        sid = _trace.new_span_id() \
+            if rid and path not in UNTRACED_PATHS else ""
+        par = _trace.get_span_parent()
+        tok = _trace.push_span_parent(sid) if sid else None
         t0s = time.monotonic_ns()
+        err = ""
         try:
             if path in UNTRACED_PATHS or not _trace.active():
                 return self._roundtrip(path, body, service,
@@ -1218,9 +1255,21 @@ class RPCClient:
                                        timeout=_timeout)
             return self._traced_roundtrip(
                 path, body, service,
-                dict(idempotent=_idempotent, timeout=_timeout))
+                dict(idempotent=_idempotent, timeout=_timeout),
+                span_id=sid, parent_id=par)
+        except Exception as e:  # noqa: BLE001 — re-raised below
+            err = f"{type(e).__name__}: {e}"
+            raise
         finally:
-            _stages.add_async("rpc", time.monotonic_ns() - t0s)
+            if tok is not None:
+                _trace.pop_span_parent(tok)
+            dt = time.monotonic_ns() - t0s
+            _stages.add_async("rpc", dt)
+            if sid and not _trace.active():
+                _trace.ring_append(rid, sid, par, "internode",
+                                   f"internode{path}",
+                                   _trace.now_ns() - dt, dt, err,
+                                   self.endpoint)
 
     def raw_call(self, name: str, params: dict, body=b"",
                  idempotent: bool = False) -> bytes:
@@ -1240,18 +1289,39 @@ class RPCClient:
         kw = dict(extra_headers=headers,
                   raw_response=True, idempotent=idempotent)
         from ..obs import stages as _stages
+        rid = _trace.get_request_id()
+        sid = _trace.new_span_id() if rid else ""
+        par = _trace.get_span_parent()
+        tok = _trace.push_span_parent(sid) if sid else None
         t0s = time.monotonic_ns()
+        err = ""
         try:
             if not _trace.active():
                 return self._roundtrip(path, body, "storage", **kw)
-            return self._traced_roundtrip(path, body, "storage", kw)
+            return self._traced_roundtrip(path, body, "storage", kw,
+                                          span_id=sid, parent_id=par)
+        except Exception as e:  # noqa: BLE001 — re-raised below
+            err = f"{type(e).__name__}: {e}"
+            raise
         finally:
-            _stages.add_async("rpc", time.monotonic_ns() - t0s)
+            if tok is not None:
+                _trace.pop_span_parent(tok)
+            dt = time.monotonic_ns() - t0s
+            _stages.add_async("rpc", dt)
+            if sid and not _trace.active():
+                _trace.ring_append(rid, sid, par, "internode",
+                                   f"internode{path}",
+                                   _trace.now_ns() - dt, dt, err,
+                                   self.endpoint)
 
     def _traced_roundtrip(self, path: str, body: bytes, service: str,
-                          kw: dict):
+                          kw: dict, span_id: str = "",
+                          parent_id=None):
         """Client-side internode span around one RPC (trace type
-        ``internode``, cmd/peer-rest-client.go trace wrappers)."""
+        ``internode``, cmd/peer-rest-client.go trace wrappers).
+        ``span_id``/``parent_id`` come from the caller that minted the
+        leg's id BEFORE pushing it as the span parent — reading the
+        contextvar here would parent the leg under itself."""
         t0 = time.monotonic_ns()
         err = ""
         out = None
@@ -1270,6 +1340,6 @@ class RPCClient:
                 else len(body),
                 output_bytes=len(out)
                 if isinstance(out, (bytes, bytearray)) else 0,
-                error=err,
+                error=err, span_id=span_id, parent_id=parent_id,
                 detail={"endpoint": self.endpoint, "service": service,
                         "side": "client"}))

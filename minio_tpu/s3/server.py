@@ -1139,6 +1139,13 @@ def _make_handler(srv: S3Server):
                     if chunk:
                         with _stages.stage("body_write"):
                             self.wfile.write(chunk)
+            except (ConnectionError, TimeoutError):
+                # the client died mid-body: propagate so the dispatch
+                # abort catch stamps the completion record with the
+                # ``aborted`` marker and the stage vector it already
+                # accumulated (tests/test_chaos_network.py reset drill)
+                self.close_connection = True
+                raise
             except Exception:   # noqa: BLE001 — headers are gone; a
                 # second response would corrupt the stream
                 self.close_connection = True
@@ -1190,6 +1197,11 @@ def _make_handler(srv: S3Server):
                         sent += len(chunk)
                 self.wfile.write(b"0\r\n\r\n")
                 self.wfile.flush()
+            except (ConnectionError, TimeoutError):
+                # client death mid-stream: same abort contract as
+                # _send_stream — the dispatch catch records the marker
+                self.close_connection = True
+                raise
             except Exception:   # noqa: BLE001 — headers are gone; drop
                 self.close_connection = True
             finally:
@@ -1246,8 +1258,12 @@ def _make_handler(srv: S3Server):
             self._req_id = uuid.uuid4().hex[:16]
             # correlation root (Dapper-style): every subsystem span this
             # request causes — storage calls, internode RPCs, TPU
-            # kernels, even on peer nodes — carries this ID
+            # kernels, even on peer nodes — carries this ID.  The causal
+            # tree roots at the request itself: root span id == request
+            # id, and every span minted on this thread parents under it
+            # until a deeper span pushes its own id
             _trace.set_request_id(self._req_id)
+            _trace.set_span_parent(self._req_id)
             # X-ray stage clock, minted beside the request ID and torn
             # down with it; the completion record lands in the flight
             # ring whatever happens below
@@ -1257,6 +1273,7 @@ def _make_handler(srv: S3Server):
             self._resp_bytes = 0
             self._ttfb_ns = 0
             self._rx_bytes = 0
+            self._abort_err = ""
             # request-pool admission (cmd/handler-api.go:29 maxClients):
             # S3 traffic only — admin/metrics/health stay reachable when
             # the data plane is saturated (both reserved namespaces:
@@ -1287,6 +1304,7 @@ def _make_handler(srv: S3Server):
                     except Exception:  # noqa: BLE001 — the 503 itself
                         pass           # must still reach the client
                     _trace.set_request_id("")
+                    _trace.set_span_parent("")
                     _stages.clear()
                 return
             # slow-body watchdog: absolute per-request budget for
@@ -1298,7 +1316,21 @@ def _make_handler(srv: S3Server):
                 cl = 0
             self.rfile.arm(srv.body_budget_s(cl))
             try:
-                self._dispatch_inner()
+                try:
+                    self._dispatch_inner()
+                except (ConnectionError, TimeoutError) as e:
+                    # the client died mid-body (reset, stalled socket)
+                    # or mid-response: the completion record must still
+                    # carry the stage vector and an ``aborted`` marker
+                    # instead of settling through the generic close
+                    # path with no trace of why (tests/
+                    # test_chaos_network.py reset drill).  499 is the
+                    # client-closed-request convention when no status
+                    # ever went out.
+                    self._abort_err = f"aborted: {type(e).__name__}"
+                    if not self._resp_status:
+                        self._resp_status = 499
+                    self.close_connection = True
             finally:
                 self.rfile.disarm()
                 if sem is not None:
@@ -1309,8 +1341,9 @@ def _make_handler(srv: S3Server):
                     pass            # on account of observability
                 # keep-alive reuses this thread for the next request —
                 # its spans must not inherit this request's ID (nor
-                # its stage clock)
+                # its stage clock, nor its span parent)
                 _trace.set_request_id("")
+                _trace.set_span_parent("")
                 _stages.clear()
 
         def _admit(self, sem) -> bool:
@@ -1343,13 +1376,25 @@ def _make_handler(srv: S3Server):
             clock = _stages.current()
             if clock is not None:
                 stage_ns, async_ns, _unattr = clock.finish(dur_mono)
+                gating = tuple(clock.gatings)
             else:
                 stage_ns, async_ns = {}, {}
+                gating = ()
+            abort_err = getattr(self, "_abort_err", "")
             srv.flightrec.record(
                 self._req_id, api_name, self._resp_status, dur_mono,
                 self._rx_bytes, self._resp_bytes,
                 stages=tuple(stage_ns.items()),
-                async_stages=tuple(async_ns.items()))
+                async_stages=tuple(async_ns.items()),
+                error=abort_err, gating=gating)
+            # causal-tree root: the request itself, span id == request
+            # id, so every child this request minted (drive ops, rpc
+            # legs, quorum gatings — here and on peers) assembles under
+            # one root at trace-tree query time.  A compact ring tuple,
+            # not a span dict — the idle contract holds.
+            _trace.ring_append(self._req_id, self._req_id, "", "http",
+                               api_name, self._t0_ns, dur, abort_err,
+                               extra=self._resp_status)
             if srv.forensic is not None:
                 # Retry-After marks deliberate backpressure (admission
                 # or governor sheds) — bounded self-protection, not the
@@ -1516,6 +1561,15 @@ def _make_handler(srv: S3Server):
                             return self._send(
                                 307, b"", headers={"Location": loc})
                     raise
+            except ConnectionError:
+                # connection death (client reset mid-body or
+                # mid-response): there is nobody to send XML to —
+                # propagate to the dispatch abort catch, which stamps
+                # the flight-recorder row ``aborted: <Exc>``.  A bare
+                # TimeoutError is NOT a death: the stalled-socket
+                # watchdog fires on a slow-but-alive client, whose
+                # socket still deserves the 408 XML below.
+                raise
             except Exception as e:  # noqa: BLE001 — every error becomes XML
                 self._fail(e, path)
 

@@ -161,7 +161,11 @@ def default_matrix(duration_s: float = 15.0) -> list[Scenario]:
                                # ordinary chaos is not a breach: the
                                # trigger engine (default thresholds)
                                # must stay quiet through the matrix
-                               require_no_forensics=True),
+                               require_no_forensics=True,
+                               # every storm must show quorum gating
+                               # attribution on the live scrape — the
+                               # critical-path engine rode the storm
+                               require_xray=True),
             workers=4 if storm or membound or hot else 2,
             backend="tpu" if storm else "numpy"))
     # huge_put: one mesh-sharded object (1 GiB on a TPU host,
@@ -172,7 +176,8 @@ def default_matrix(duration_s: float = 15.0) -> list[Scenario]:
         name="huge_put", mix=MIXES["get_heavy_small"],
         timeline=_chaos_timeline(duration_s),
         duration_s=duration_s,
-        budget=_slo.Budget(max_error_rate=0.10),
+        budget=_slo.Budget(max_error_rate=0.10,
+                           require_xray=True),
         workers=2, backend="mesh",
         huge_put_bytes=_huge_bytes_default()))
     # forensic_drill (ISSUE 15 acceptance): induced SLO breach —
@@ -193,7 +198,8 @@ def default_matrix(duration_s: float = 15.0) -> list[Scenario]:
             name="tls_storm", mix=MIXES["get_heavy_small"],
             timeline=_chaos_timeline(duration_s),
             duration_s=duration_s,
-            budget=_slo.Budget(max_error_rate=0.10),
+            budget=_slo.Budget(max_error_rate=0.10,
+                               require_xray=True),
             workers=2, tls=True))
     return out
 
@@ -243,7 +249,8 @@ def forensic_drill_scenario(duration_s: float = 12.0) -> Scenario:
         budget=_slo.Budget(max_error_rate=1.0,
                            p50_ms=60_000.0, p99_ms=120_000.0,
                            expect_forensics=1,
-                           converge_timeout_s=60.0),
+                           converge_timeout_s=60.0,
+                           require_xray=True),
         workers=2,
         env={"MT_FORENSIC_ERROR_RATE": "0.2",
              "MT_FORENSIC_ERROR_MIN_SAMPLES": "5",
@@ -289,7 +296,8 @@ def expand_storm_scenario(duration_s: float = 15.0) -> Scenario:
         budget=_slo.Budget(max_error_rate=0.10,
                            require_pool_expanded=True,
                            require_no_forensics=True,
-                           converge_timeout_s=60.0),
+                           converge_timeout_s=60.0,
+                           require_xray=True),
         pools=True, env={"MT_REBALANCE_ENABLE": "on"})
 
 
@@ -317,7 +325,8 @@ def decommission_storm_scenario(duration_s: float = 15.0) -> Scenario:
         budget=_slo.Budget(max_error_rate=0.10,
                            require_pool_retired=True,
                            require_no_forensics=True,
-                           converge_timeout_s=60.0),
+                           converge_timeout_s=60.0,
+                           require_xray=True),
         pools=True, env={"MT_REBALANCE_ENABLE": "on"})
 
 
@@ -350,7 +359,8 @@ def smoke_scenario(duration_s: float = 4.0) -> Scenario:
                   E(0.6 * duration_s, "drive_return", drive=0)],
         duration_s=duration_s,
         budget=_slo.Budget(converge_timeout_s=30.0,
-                           require_no_forensics=True))
+                           require_no_forensics=True,
+                           require_xray=True))
 
 
 def run_scenario(scenario: Scenario, base_dir: str,
@@ -504,6 +514,19 @@ def _forensic_summary(cluster, expect_breach: bool = False) -> dict:
             out["stage_timeline_ok"] = bool(recs) and all(
                 sum(r["stages"].values()) == r["durationNs"]
                 for r in recs)
+            # ISSUE 17: the bundle must also carry ASSEMBLED causal
+            # trees for the breach window's requests (tracetrees.json,
+            # obs/tracetree.py) — roots whose request IDs come from the
+            # same error ring the breach records do
+            with _zip.ZipFile(os.path.join(
+                    fx.dir, bundles[-1]["name"])) as z:
+                tdoc = _json.loads(z.read("tracetrees.json"))
+            trees = tdoc.get("trees", [])
+            breach_rids = {r.get("requestID") for r in breach}
+            tree_rids = {t.get("requestID") for t in trees}
+            out["trace_trees_ok"] = bool(trees) and \
+                bool(breach_rids & tree_rids)
+            out["trace_trees"] = len(trees)
         except Exception as e:  # noqa: BLE001 — verdict rides the row
             out["breach_records_ok"] = False
             out["error"] = f"{type(e).__name__}: {e}"

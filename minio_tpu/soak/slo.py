@@ -89,6 +89,13 @@ class Budget:
     # rebalancer and retired from the manifest before teardown
     require_pool_expanded: bool = False
     require_pool_retired: bool = False
+    # causal-trace rows (ISSUE 17): storm scenarios assert the X-ray
+    # plane was live under the storm — quorum gating attribution fired
+    # (mt_quorum_gating_total > 0 on the live scrape: every erasure
+    # fan-out records which child decided the k-th completion) and the
+    # commit micro-profiler saw drive ops.  Zero means the critical-path
+    # engine silently fell off the write path while tests stayed green.
+    require_xray: bool = False
 
     def limits_for(self, api: str) -> tuple[float, float]:
         return self.per_api_ms.get(api, (self.p50_ms, self.p99_ms))
@@ -444,6 +451,22 @@ def evaluate(scenario: str, *, api_stats=None, api_pcts=None, recorder,
         row("stale_reads", stale, "reads", stale == 0,
             {"oracle": "per-worker read-your-write md5"})
 
+    # causal-trace plane engaged under storm traffic: the quorum
+    # critical-path engine recorded gating decisions (every erasure
+    # write/read fan-out names its k-th completion) and the always-on
+    # commit micro-profiler observed drive ops — both from the live
+    # scrape, so a storm with zero gatings fails loudly instead of the
+    # X-ray plane silently detaching from the data path
+    if budget.require_xray:
+        gat = metric_total(scrape_text, "mt_quorum_gating_total")
+        row("xray_quorum_gating", gat, "gatings", gat > 0,
+            {"family": "mt_quorum_gating_total",
+             "straggler_s_sum": metric_total(
+                 scrape_text, "mt_quorum_straggler_seconds_sum")})
+        ops = metric_total(scrape_text, "mt_drive_op_seconds_count")
+        row("xray_drive_ops_profiled", ops, "ops", ops > 0,
+            {"family": "mt_drive_op_seconds"})
+
     # forensic-plane rows: clean scenarios must produce ZERO bundles
     # (ordinary chaos is not a breach); the induced-breach drill must
     # produce exactly its expected count, with the breach window's
@@ -461,7 +484,8 @@ def evaluate(scenario: str, *, api_stats=None, api_pcts=None, recorder,
         # durations — the ISSUE 15 live-cluster acceptance, enforced,
         # not just carried as detail
         content_ok = bool(f.get("breach_records_ok")) and \
-            bool(f.get("stage_timeline_ok", True))
+            bool(f.get("stage_timeline_ok", True)) and \
+            bool(f.get("trace_trees_ok", True))
         row("forensic_bundles", dumped, "bundles",
             dumped == budget.expect_forensics,
             {"require": budget.expect_forensics, **f})

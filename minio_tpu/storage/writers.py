@@ -35,6 +35,7 @@ import itertools
 import threading
 import time
 
+from ..obs import critpath as _critpath
 from ..obs import stages as _stages
 from ..obs import trace as _trace
 from . import errors as serrors
@@ -78,15 +79,18 @@ class _Batch:
 
 
 class _Op:
-    __slots__ = ("stream", "idx", "fn", "batch", "rid", "clock")
+    __slots__ = ("stream", "idx", "fn", "batch", "rid", "clock",
+                 "parent")
 
-    def __init__(self, stream, idx, fn, batch, rid, clock=None):
+    def __init__(self, stream, idx, fn, batch, rid, clock=None,
+                 parent=""):
         self.stream = stream
         self.idx = idx
         self.fn = fn
         self.batch = batch
         self.rid = rid
         self.clock = clock
+        self.parent = parent
 
     def run(self, disk) -> None:
         st = self.stream
@@ -96,8 +100,11 @@ class _Op:
         # per-drive spans must carry the originating request ID even
         # though the worker thread outlives any one request; the X-ray
         # clock rides along so a remote drive's RPC leg is attributed
-        # (async detail) to the right request
+        # (async detail) to the right request, and the span parent so
+        # this op's storage spans land under the submitting span in the
+        # request's causal tree
         _trace.set_request_id(self.rid)
+        _trace.set_span_parent(self.parent)
         _stages.set_clock(self.clock)
         t0 = time.perf_counter()
         try:
@@ -189,6 +196,10 @@ class StreamWriter:
             None if d is not None else serrors.DiskNotFound("offline")
             for d in self.disks]
         self.drive_busy = [0.0] * len(self.disks)   # seconds in drive ops
+        # monotonic ns of each drive's LAST op settlement — the
+        # completion vector the quorum critical-path engine reduces at
+        # drain (obs/critpath.py); 0 = never settled anything
+        self.settle_ns = [0] * len(self.disks)
         self.cancelled = False
         self._pending = 0
         self._drive_pending = [0] * len(self.disks)
@@ -208,7 +219,7 @@ class StreamWriter:
                 batch.done_one()
             return False
         op = _Op(self, idx, fn, batch, _trace.get_request_id(),
-                 _stages.current())
+                 _stages.current(), _trace.get_span_parent())
         with self._cv:
             self._pending += 1
             self._drive_pending[idx] += 1
@@ -260,6 +271,7 @@ class StreamWriter:
             if err is not None and self.errs[idx] is None:
                 self.errs[idx] = err
             self.drive_busy[idx] += busy_s
+            self.settle_ns[idx] = time.monotonic_ns()
             self._pending -= 1
             self._drive_pending[idx] -= 1
             cbs = (self._on_idle.pop(idx, [])
@@ -317,6 +329,19 @@ class StreamWriter:
 
     def max_busy_s(self) -> float:
         return max(self.drive_busy, default=0.0)
+
+    def record_gating(self, plane: str, k: int,
+                      t0_ns: int) -> tuple | None:
+        """One quorum critical-path row for this stream's fan-out (the
+        writer-plane reduction point, called by the PUT path right
+        after a successful ``drain``): each drive's child completion is
+        its last op settlement; drives that latched an error are
+        excluded — a failed drive cannot have been the quorum
+        decider."""
+        labels = [_critpath.drive_label(d) if d is not None
+                  else "offline" for d in self.disks]
+        return _critpath.record(plane, k, labels, list(self.settle_ns),
+                                t0_ns, errs=self.errs)
 
 
 class WriterPlane:

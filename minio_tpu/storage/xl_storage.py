@@ -29,6 +29,7 @@ import time
 import uuid
 from typing import Iterable
 
+from ..admin.metrics import GLOBAL as _metrics
 from ..obs import lastminute as _lastminute
 from ..obs import trace as _trace
 from . import errors
@@ -43,6 +44,16 @@ _RESERVED = {SYS_DIR}
 
 # acknowledged writes must survive a crash; MT_FSYNC=0 is for benchmarks
 _FSYNC = os.environ.get("MT_FSYNC", "1") != "0"
+
+# commit micro-profiler op catalog — the syscall phases that compose a
+# drive commit, decomposing ``drive_fanout_commit`` the way
+# mt_s3_stage_seconds decomposed the request (ISSUE 17; docs drift rule
+# checks each appears in docs/observability.md)
+DRIVE_OPS = ("create", "append", "fsync", "rename", "meta_merge")
+# tmpfs phases run single-digit microseconds; a sick spindle's fsync
+# runs hundreds of ms — the buckets must resolve both ends
+DRIVE_OP_BUCKETS = (0.000025, 0.00005, 0.0001, 0.00025, 0.0005, 0.001,
+                    0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25)
 
 # O_DIRECT on the drive hot path (cmd/xl-storage.go:1400-1568
 # odirectReader / aligned writes): bypasses the page cache so bench
@@ -159,6 +170,11 @@ class XLStorage(StorageAPI):
         # storage op records here; slow-drive detection and the
         # mt_node_disk_latency_* scrape read them
         self.latency = _lastminute.OpWindows(self._endpoint)
+        # commit micro-profiler (ISSUE 17): per-op last-minute windows
+        # for the syscall phases inside a commit (DRIVE_OPS) — always
+        # on, same discipline as self.latency; the scrape-side twin is
+        # the mt_drive_op_seconds{op} histogram
+        self.commit_profile = _lastminute.OpWindows(self._endpoint)
         if not os.path.isdir(self.root):
             raise errors.DiskNotFound(self.root)
         os.makedirs(os.path.join(self.root, TMP_DIR), exist_ok=True)
@@ -195,6 +211,16 @@ class XLStorage(StorageAPI):
 
     def close(self) -> None:
         pass
+
+    def _prof(self, op: str, t0_ns: int, nbytes: int = 0) -> int:
+        """One commit micro-profiler sample: charge the interval since
+        ``t0_ns`` (monotonic) to ``op`` and return a fresh timestamp so
+        callers chain phases: ``t = self._prof("create", t)``."""
+        t1 = time.monotonic_ns()
+        self.commit_profile.record(op, t1 - t0_ns, nbytes)
+        _metrics.observe("mt_drive_op_seconds", {"op": op},
+                         (t1 - t0_ns) / 1e9, buckets=DRIVE_OP_BUCKETS)
+        return t1
 
     # -- path helpers ------------------------------------------------------
 
@@ -338,24 +364,31 @@ class XLStorage(StorageAPI):
                 f"size mismatch: {len(data)} != {file_size}")
         full = self._file_path(volume, path)
         self._check_vol(volume)
+        t0 = time.monotonic_ns()
         if _ODIRECT:
             try:
                 if self._create_file_odirect(full, data):
+                    self._prof("create", t0, len(data))
                     return
             except FileNotFoundError:
                 pass                 # parent missing: buffered path
                                      # below creates it and retries
         with self._open_create(volume, full) as f:
             f.write(data)
+            t0 = self._prof("create", t0, len(data))
             _fsync_fileobj(f)
+            self._prof("fsync", t0)
 
     def append_file(self, volume: str, path: str, data: bytes) -> None:
         full = self._file_path(volume, path)
         self._check_vol(volume)
         os.makedirs(os.path.dirname(full), exist_ok=True)
+        t0 = time.monotonic_ns()
         with open(full, "ab") as f:
             f.write(data)
+            t0 = self._prof("append", t0, len(data))
             _fsync_fileobj(f)
+            self._prof("fsync", t0)
 
     def write_stream(self, volume: str, path: str, chunks,
                      op: str = "create", file_size: int = -1) -> int:
@@ -574,6 +607,7 @@ class XLStorage(StorageAPI):
             pass
         meta.add_version(fi)
         if fi.data_dir:
+            t_op = time.monotonic_ns()
             dst_data_dir = os.path.join(dst_obj_dir, fi.data_dir)
             if not os.path.isdir(src_dir):
                 raise errors.FileNotFound(src_path)
@@ -581,13 +615,17 @@ class XLStorage(StorageAPI):
             if os.path.isdir(dst_data_dir):
                 shutil.rmtree(dst_data_dir)
             os.replace(src_dir, dst_data_dir)
+            t_op = self._prof("rename", t_op)
             _fsync_dir(dst_obj_dir)
+            self._prof("fsync", t_op)
         else:
             os.makedirs(dst_obj_dir, exist_ok=True)
         # xl.meta write fsyncs itself + the object dir (write_all); the
         # parent entry for a freshly created object dir needs one more
+        t_meta = time.monotonic_ns()
         self._write_meta(dst_volume, dst_path, meta)
         _fsync_dir(os.path.dirname(dst_obj_dir))
+        self._prof("meta_merge", t_meta)
         if old_ddir and old_ddir != fi.data_dir \
                 and meta.shared_data_dir_count(fi.version_id, old_ddir) == 0:
             shutil.rmtree(os.path.join(dst_obj_dir, old_ddir),
@@ -636,6 +674,7 @@ class XLStorage(StorageAPI):
             os.mkdir(ddir)
             part = ddir + "/part.1"
             streaming = hasattr(data, "__next__")
+            t_op = time.monotonic_ns()
             try:
                 if streaming:
                     # framed internode streaming: part bytes land chunk
@@ -649,6 +688,7 @@ class XLStorage(StorageAPI):
                     try:
                         for chunk in data:
                             _write_full(fd, chunk)
+                        t_op = self._prof("create", t_op)
                         if _FSYNC:
                             os.fsync(fd)
                     finally:
@@ -663,11 +703,15 @@ class XLStorage(StorageAPI):
                                  0o644)
                     try:
                         _write_full(fd, data)
+                        t_op = self._prof("create", t_op, len(data))
                         if _FSYNC:
                             os.fsync(fd)
                     finally:
                         os.close(fd)
+                else:                # O_DIRECT landed the part whole
+                    t_op = self._prof("create", t_op, len(data))
                 _fsync_dir(ddir)
+                self._prof("fsync", t_op)
             except BaseException:
                 if stream_ddir is not None:
                     shutil.rmtree(stream_ddir, ignore_errors=True)
@@ -689,6 +733,7 @@ class XLStorage(StorageAPI):
             _IN_TRACED_OP.exclude_ns = getattr(
                 _IN_TRACED_OP, "exclude_ns", 0) \
                 + (time.monotonic_ns() - t_gate)
+        t_meta = time.monotonic_ns()   # gate park excluded: not drive time
         meta = XLMeta()
         old_ddir = ""
         if not fresh:
@@ -709,12 +754,17 @@ class XLStorage(StorageAPI):
         _fsync_dir(dst_obj)
         if fresh:
             _fsync_dir(os.path.dirname(dst_obj))
+        self._prof("meta_merge", t_meta)
         if old_ddir and old_ddir != fi.data_dir \
                 and meta.shared_data_dir_count(fi.version_id, old_ddir) == 0:
             shutil.rmtree(os.path.join(dst_obj, old_ddir),
                           ignore_errors=True)
 
     def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        # the INLINE-object commit path (erasure_object._commit_put for
+        # sizes under the inline threshold): this read-merge-write IS
+        # the whole drive-side commit, so it charges meta_merge
+        t0 = time.monotonic_ns()
         try:
             meta = self._read_meta(volume, path)
         except errors.FileNotFound:
@@ -722,12 +772,15 @@ class XLStorage(StorageAPI):
         meta.add_version(fi)
         os.makedirs(self._file_path(volume, path), exist_ok=True)
         self._write_meta(volume, path, meta)
+        self._prof("meta_merge", t0)
 
     def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
+        t0 = time.monotonic_ns()
         meta = self._read_meta(volume, path)
         meta.find(fi.version_id)  # must exist
         meta.add_version(fi)
         self._write_meta(volume, path, meta)
+        self._prof("meta_merge", t0)
 
     def read_version(self, volume: str, path: str,
                      version_id: str | None = None,
@@ -957,6 +1010,18 @@ def _traced_op(op: str, fn, in_arg: int | None):
                     error=err,
                     detail={"drive": self._endpoint, "volume": vol,
                             "path": path}))
+            else:
+                # idle causal ring (make_span rings on the active
+                # branch above): requests keep their drive-op children
+                # for trace-tree assembly with zero subscribers — one
+                # compact tuple, no dict (the PR-2 idle contract)
+                rid = _trace.get_request_id()
+                if rid:
+                    _trace.ring_append(
+                        rid, _trace.new_span_id(),
+                        _trace.get_span_parent(), "storage",
+                        f"storage.{op}", time.time_ns() - dt, dt, err,
+                        self._endpoint)
     traced.__name__ = op
     traced.__qualname__ = f"XLStorage.{op}"
     traced.__wrapped__ = fn

@@ -472,3 +472,65 @@ def test_pool_routing_canary(tmp_path):
             return layer.pools[0]  # mt-lint: ok(pool-routing) shape probe
         """})
     assert not clean3, clean3
+
+
+def test_span_discipline_canary(tmp_path):
+    # captures the request id into a pool fan-out without the parent
+    bad = _lint(tmp_path, {"objectlayer/fan.py": """
+        from ..obs import trace as _trace
+
+        def fanout(self, fn, items):
+            rid = _trace.get_request_id()
+
+            def run(item):
+                _trace.set_request_id(rid)
+                return fn(item)
+            return self._pool.map(run, items)
+        """})
+    assert any(f.rule == "span-discipline" and "fanout" in f.message
+               for f in bad), bad
+    # Thread spawn counts as a submission just the same
+    bad2 = _lint(tmp_path, {"parallel/fan.py": """
+        import threading
+        from ..obs import trace as _trace
+
+        def spawn(fn):
+            rid = _trace.get_request_id()
+
+            def run():
+                _trace.set_request_id(rid)
+                fn()
+            threading.Thread(target=run, daemon=True,
+                             name="mt-fan").start()
+        """})
+    assert any(f.rule == "span-discipline" for f in bad2), bad2
+    # the _with_request_id shape: parent rides beside the rid — clean
+    clean = _lint(tmp_path, {"objectlayer/fan.py": """
+        from ..obs import trace as _trace
+
+        def fanout(self, fn, items):
+            rid = _trace.get_request_id()
+            parent = _trace.get_span_parent()
+
+            def run(item):
+                _trace.set_request_id(rid)
+                _trace.set_span_parent(parent)
+                return fn(item)
+            return self._pool.map(run, items)
+        """})
+    assert not clean, clean
+    # no contextvar capture: plain parallelism stays unflagged
+    clean2 = _lint(tmp_path, {"storage/fan.py": """
+        def fanout(self, fn, items):
+            return self._pool.map(fn, items)
+        """})
+    assert not clean2, clean2
+    # outside the storage/parallel/objectlayer scope — unflagged
+    clean3 = _lint(tmp_path, {"s3/fan.py": """
+        from ..obs import trace as _trace
+
+        def fanout(self, fn, items):
+            rid = _trace.get_request_id()
+            return self._pool.map(lambda i: (rid, fn(i)), items)
+        """})
+    assert not clean3, clean3

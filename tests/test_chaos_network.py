@@ -973,3 +973,102 @@ def test_tls_stream_reset_mid_encrypted_frame_quorum_commits(
         proxy.stop()
         rpc.stop()
         secure_transport.configure(None)
+
+def test_aborted_request_keeps_stage_vector(s3_server):
+    """Satellite drill (ISSUE 17): a request that dies mid-body —
+    client disconnect / wire reset — must still complete its
+    flight-recorder record WITH the stage vector and an ``aborted``
+    marker, landing in the error ring where breach forensics look.
+    Two legs: a GET whose response is RST mid-body by FaultyProxy,
+    and a PUT whose client RSTs mid-request-body."""
+    import http.client
+    import struct
+
+    from minio_tpu.s3.client import S3Client
+    from minio_tpu.s3.sigv4 import Credentials, sign_request
+    srv = s3_server
+    c = S3Client(srv.endpoint, "testkey", "testsecret")
+    c.make_bucket("chab")
+    # big enough that the response cannot hide in kernel socket
+    # buffers: the proxy stops reading after the reset budget, so the
+    # server's body_write must block and then fail on the RST
+    data = os.urandom(32 << 20)
+    c.put_object("chab", "big", data)
+
+    def newest_abort(api):
+        for r in srv.flightrec.query(errors_only=True, limit=50):
+            if r["api"] == api and \
+                    r.get("error", "").startswith("aborted:"):
+                return r
+        return None
+
+    def wait_abort(api):
+        deadline = time.monotonic() + 10.0
+        rec = None
+        while rec is None and time.monotonic() < deadline:
+            rec = newest_abort(api)
+            if rec is None:
+                time.sleep(0.05)
+        return rec
+
+    # -- leg 1: response dies mid-body (FaultyProxy reset) ------------
+    proxy = FaultyProxy("127.0.0.1", srv.port).start()
+    try:
+        path = "/chab/big"
+        # sign against the REAL endpoint; send through the proxy,
+        # which RSTs the client after 64 KiB of response — the server
+        # hits a ConnectionError mid-body_write
+        hdrs = sign_request(Credentials("testkey", "testsecret"),
+                            "GET", srv.endpoint + path, {}, b"",
+                            "us-east-1")
+        proxy.program(proxy.connections_seen() + 1,
+                      Fault.reset(after_bytes=64 * 1024))
+        conn = http.client.HTTPConnection("127.0.0.1", proxy.port,
+                                          timeout=30)
+        try:
+            conn.request("GET", path, headers=hdrs)
+            with pytest.raises((ConnectionError,
+                                http.client.HTTPException,
+                                TimeoutError, OSError)):
+                resp = conn.getresponse()
+                while resp.read(65536):
+                    pass
+                raise ConnectionResetError("stream ended short")
+        finally:
+            conn.close()
+    finally:
+        proxy.stop()
+    rec = wait_abort("GetObject")
+    assert rec is not None, srv.flightrec.query(errors_only=True,
+                                                limit=10)
+    assert rec["stages"], rec          # stage vector survived
+
+    # -- leg 2: request dies mid-body (client RST) --------------------
+    body = os.urandom(1 << 20)
+    path2 = "/chab/dead"
+    hdrs2 = sign_request(Credentials("testkey", "testsecret"),
+                         "PUT", srv.endpoint + path2, {}, body,
+                         "us-east-1")
+    s = socket.create_connection(("127.0.0.1", srv.port))
+    try:
+        req = [f"PUT {path2} HTTP/1.1\r\n".encode(),
+               f"Host: 127.0.0.1:{srv.port}\r\n".encode(),
+               f"Content-Length: {len(body)}\r\n".encode()]
+        for k, v in hdrs2.items():
+            if k.lower() in ("host", "content-length"):
+                continue
+            req.append(f"{k}: {v}\r\n".encode())
+        req.append(b"\r\n")
+        s.sendall(b"".join(req))
+        s.sendall(body[: len(body) // 2])
+        # RST, not FIN: SO_LINGER(1, 0) makes close() send a reset so
+        # the server's body read raises ConnectionResetError
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                     struct.pack("ii", 1, 0))
+    finally:
+        s.close()
+    rec2 = wait_abort("PutObject")
+    assert rec2 is not None, srv.flightrec.query(errors_only=True,
+                                                 limit=10)
+    assert rec2["status"] == 499, rec2   # no status had been sent
+    assert rec2["stages"], rec2

@@ -182,6 +182,10 @@ def test_forensic_drill_yields_exactly_one_bundle(tmp_path):
     # the 3-node cluster's request records carry complete, reconciled
     # stage timelines inside the bundle (ISSUE 15 acceptance)
     assert content["detail"].get("stage_timeline_ok"), content
+    # ISSUE 17: the bundle also carries assembled causal trees for the
+    # breach window's requests (tracetrees.json)
+    assert content["detail"].get("trace_trees_ok"), content
+    assert content["detail"].get("trace_trees", 0) > 0, content
 
 
 def test_clean_smoke_scenario_yields_zero_bundles(tmp_path):

@@ -414,3 +414,90 @@ def test_peer_spans_carry_frontend_request_id(tmp_path):
         srv.stop()
         for node in nodes:
             node.stop()
+
+def test_cross_node_tree_assembles_idle_from_rings(tmp_path):
+    """ISSUE 17 e2e: a PUT served by node0 fans shards to node1 over
+    internode RPC with ZERO trace subscribers — yet the causal rings
+    alone reconstruct the full cross-node tree: node1's drive ops knit
+    under the internode client span via the X-Span-Parent header, the
+    quorum gating row rides the quorum.write span, and nothing in the
+    peer subtree is an orphan."""
+    from minio_tpu.cluster import NodeSpec, start_cluster
+    from minio_tpu.obs import tracetree
+    specs = []
+    for n in range(2):
+        dirs = []
+        for d in range(2):
+            p = tmp_path / f"node{n}-drive{d}"
+            p.mkdir()
+            dirs.append(str(p))
+        specs.append(NodeSpec(f"node{n}", dirs))
+    nodes = start_cluster(specs, "obs-secret", set_drive_count=4,
+                          parity=1, block_size=16 * 1024,
+                          backend="numpy")
+    srv = S3Server(nodes[0].layer, access_key="ck", secret_key="cs")
+    srv.start()
+    try:
+        assert not trace.active()
+        c = S3Client(srv.endpoint, "ck", "cs")
+        c.make_bucket("treebkt")
+        c.put_object("treebkt", "tobj", b"q" * 200_000)
+        # the handler stamps its completion record after flushing
+        rid = ""
+        for _ in range(50):
+            recs = [r for r in srv.flightrec.query(limit=50)
+                    if r.get("api") == "PutObject"]
+            if recs:
+                rid = recs[-1]["requestID"]
+                break
+            time.sleep(0.05)
+        assert rid, "PutObject never landed in the flight recorder"
+        trees = tracetree.assemble(tracetree.local_spans(rid=rid))
+        assert len(trees) == 1
+        root = trees[0]
+        assert root["spanID"] == rid and root["type"] == "http"
+        assert not root.get("partial")
+        # flatten with parent links intact
+        flat = []
+
+        def walk(node):
+            flat.append(node)
+            for ch in node.get("children", ()):
+                walk(ch)
+
+        walk(root)
+        names = [s["name"] for s in flat]
+        # the quorum critical-path span carries its gating row even
+        # though nobody subscribed during the request
+        gated = [s for s in flat if s["name"] == "quorum.write"]
+        assert gated and all("gating" in s for s in gated), names
+        g = gated[0]["gating"]
+        assert g["k"] >= 1 and g["wallNs"] >= g["kthNs"] >= 0
+        # internode client spans made it into the tree...
+        inode = [s for s in flat if s["type"] == "internode"]
+        assert inode, names
+        # ...and node1's drive-local ops (labels under its drive
+        # roots) rode the wire context: present AND knitted — their
+        # parentID resolved to a live span, never the orphan rewire
+        node1_roots = tuple(specs[1].drive_dirs)
+        peer_disk = [s for s in flat if s["type"] == "storage"
+                     and s.get("label", "").startswith(node1_roots)]
+        assert peer_disk, "no peer drive span in the assembled tree"
+        assert not any(s.get("orphan") for s in peer_disk), peer_disk
+        # every peer drive op's parent chain reaches the http root
+        by_sid = {s["spanID"]: s for s in flat}
+        parents = {}
+        for s in flat:
+            for ch in s.get("children", ()):
+                parents[ch["spanID"]] = s["spanID"]
+        for s in peer_disk:
+            sid, hops = s["spanID"], 0
+            while sid != rid and hops < 64:
+                sid = parents.get(sid, rid)
+                hops += 1
+            assert sid == rid
+        assert all(s["spanID"] in by_sid for s in peer_disk)
+    finally:
+        srv.stop()
+        for node in nodes:
+            node.stop()

@@ -263,6 +263,10 @@ def test_smoke_scenario_meets_slo_and_converges(tmp_path):
     assert not failed, failed
     assert by_metric["heal_converged"]["value"] == 1
     assert by_metric["telemetry_dead_letters"]["value"] == 0
+    # ISSUE 17: the critical-path engine rode the storm — quorum
+    # gating attribution and the commit micro-profiler both fired
+    assert by_metric["xray_quorum_gating"]["value"] > 0
+    assert by_metric["xray_drive_ops_profiled"]["value"] > 0
     # rows carry the SOAK_r*.json shape
     for r in rows:
         assert set(r) >= {"scenario", "metric", "value", "unit",
