@@ -1705,6 +1705,124 @@ def commit_profile_main() -> None:
     }))
 
 
+def _bench_watchdog() -> dict | None:
+    """``bench.py watchdog`` — ns/request cost of the SLO watchdog
+    plane on the GET hot path, through the REAL S3 server (ISSUE 18
+    acceptance: overhead within run-to-run noise).  A/B per round: the
+    same request loop with the plane live (mt-obs-history sampler
+    thread ticking every second + rule engine) vs disabled (the idle
+    contract: no thread, no rings).  The watchdog never touches the
+    request path, so anything measurable here is GIL pressure from the
+    sampler — the number the idle contract promises is noise."""
+    import shutil
+    import statistics
+    import sys as _sys
+    import tempfile
+
+    try:
+        from minio_tpu.objectlayer.erasure_object import ErasureObjects
+        from minio_tpu.s3.client import S3Client
+        from minio_tpu.s3.server import S3Server
+        from minio_tpu.storage.xl_storage import XLStorage
+    except Exception as e:  # noqa: BLE001 — optional leg
+        print(f"watchdog leg failed to import: {e!r}", file=_sys.stderr)
+        return None
+    root = "/dev/shm" if os.path.isdir("/dev/shm") and \
+        os.access("/dev/shm", os.W_OK) else None
+    tmp = tempfile.mkdtemp(prefix="wdbench-", dir=root)
+    srv = None
+    try:
+        disks = []
+        for i in range(4):
+            d = os.path.join(tmp, f"d{i}")
+            os.makedirs(d)
+            disks.append(XLStorage(d))
+        layer = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                               backend="numpy")
+        srv = S3Server(layer, access_key="wk", secret_key="ws")
+        srv.start()
+        c = S3Client(srv.endpoint, "wk", "ws")
+        c.make_bucket("wdbench")
+        body = os.urandom(64 * 1024)
+        c.put_object("wdbench", "warm", body)
+        c.get_object("wdbench", "warm")
+
+        def arm(on: bool) -> None:
+            srv.config.set("watchdog", "enable", "on" if on else "off")
+            srv.config.set("watchdog", "interval", "1s")
+            srv.reload_watchdog_config()
+
+        reps, rounds = 60, 5
+
+        def one_round() -> float:
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                c.get_object("wdbench", "warm")
+            return (time.perf_counter() - t0) / reps * 1e9  # ns/req
+
+        on: list[float] = []
+        off: list[float] = []
+        for _ in range(rounds):
+            arm(True)
+            on.append(one_round())
+            arm(False)
+            off.append(one_round())
+        med_on = statistics.median(on)
+        med_off = statistics.median(off)
+        noise = max(off) - min(off)
+        overhead = med_on - med_off
+        # the sampler's own tick cost (scrape + fold + rules), off the
+        # request path but worth pinning: it runs every interval
+        arm(True)
+        wd = srv.watchdog
+        ticks = []
+        for i in range(5):
+            t0 = time.perf_counter()
+            wd.sampler.tick(time.time() - (5 - i))
+            ticks.append((time.perf_counter() - t0) * 1000)
+        stats = wd.history.stats()
+        arm(False)
+        return {
+            "reps": reps, "rounds": rounds, "body_bytes": len(body),
+            "drives_root": root or "disk",
+            "get": {
+                "ns_per_request_on": round(med_on),
+                "ns_per_request_off": round(med_off),
+                "overhead_ns": round(overhead),
+                "run_to_run_noise_ns": round(noise),
+                "unmeasurable": overhead <= noise,
+            },
+            "sampler_tick_ms_median": round(
+                statistics.median(ticks), 3),
+            "history_series": stats["series"],
+            "history_samples": stats["samplesTotal"],
+        }
+    except Exception as e:  # noqa: BLE001 — optional leg
+        print(f"watchdog leg failed: {e!r}", file=_sys.stderr)
+        return None
+    finally:
+        if srv is not None:
+            try:
+                srv.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def watchdog_main() -> None:
+    """``bench.py watchdog`` — run the watchdog overhead leg
+    standalone and print ONE BENCH_*-shaped JSON line."""
+    stats = _bench_watchdog()
+    if stats is None:
+        raise SystemExit("watchdog leg unavailable")
+    print(json.dumps({
+        "metric": "watchdog_overhead_ns_per_get",
+        "value": stats["get"]["overhead_ns"],
+        "unit": "ns/request",
+        "detail": stats,
+    }))
+
+
 def host_main() -> None:
     """``bench.py host`` — the host-measurable legs only (BASELINE
     configs 1-2, the e2e PUT pipeline, md5 lanes/backends, codec
@@ -1716,6 +1834,7 @@ def host_main() -> None:
     codec_batching = _bench_codec_batching()
     hot_get = _bench_hot_get()
     xray = _bench_xray()
+    watchdog = _bench_watchdog()
     c1 = (cfg12 or {}).get("config1_4+2_put_64MiB_GiBps")
     print(json.dumps({
         "metric": "baseline_config1_4+2_put_64MiB_GiBps",
@@ -1731,6 +1850,7 @@ def host_main() -> None:
             "codec_batching": codec_batching,
             "hot_get": hot_get,
             "xray": xray,
+            "watchdog": watchdog,
             "methodology": "host legs only (bench.py host); device "
                            "kernel legs need a TPU",
         },
@@ -1788,6 +1908,8 @@ if __name__ == "__main__":
         xray_main()
     elif len(_sys.argv) > 1 and _sys.argv[1] == "commit_profile":
         commit_profile_main()
+    elif len(_sys.argv) > 1 and _sys.argv[1] == "watchdog":
+        watchdog_main()
     elif len(_sys.argv) > 1 and _sys.argv[1] == "host":
         host_main()
     else:

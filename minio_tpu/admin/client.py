@@ -36,9 +36,18 @@ class AdminClient:
               body: bytes = b"", expect=(200,)) -> Any:
         try:
             r = self._c.request(method, f"{self.PREFIX}/{route}", query,
-                                body, expect=expect)
+                                body, expect=())
         except S3ClientError as e:
             raise AdminError(e.status, str(e)) from e
+        if expect and r.status not in expect:
+            # admin errors are JSON ({"error": ...}), not S3 XML —
+            # surface the route's own message, not just the status
+            try:
+                msg = json.loads(r.body).get("error", "")
+            except (ValueError, AttributeError):
+                msg = r.body.decode("utf-8", "replace")[:200] \
+                    if r.body else ""
+            raise AdminError(r.status, msg or f"HTTP {r.status}")
         if not r.body:
             return None
         try:
@@ -86,6 +95,28 @@ class AdminClient:
     def list_forensics(self, local: bool = False) -> dict:
         """Resident forensic bundles (name/size/trigger) per node."""
         return self._call("GET", "forensics",
+                          "local=true" if local else "")
+
+    def metrics_history(self, family: str = "", window: str = "30m",
+                        step: str = "1m", agg: str = "",
+                        local: bool = False) -> str:
+        """Telemetry-history query (watchdog plane): one merged
+        ``server``-labelled exposition-style document with a ``ts``
+        label per point; peer-aggregated unless ``local``."""
+        q = [f"window={window}", f"step={step}"]
+        if family:
+            q.append(f"family={family}")
+        if agg:
+            q.append(f"agg={agg}")
+        if local:
+            q.append("local=true")
+        body = self._call("GET", "metrics-history", "&".join(q))
+        return body.decode() if isinstance(body, bytes) else body
+
+    def alerts(self, local: bool = False) -> dict:
+        """Watchdog alerts (active + recent) per node,
+        peer-aggregated unless ``local``."""
+        return self._call("GET", "alerts",
                           "local=true" if local else "")
 
     def trigger_forensics(self) -> dict:

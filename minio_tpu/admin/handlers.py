@@ -620,6 +620,44 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
                 "fired": bool(fired),
                 "cooldown_s": fx.cooldown_s if not fired else 0,
                 "bundles": fx.bundles()}) or True
+        if route == "metrics-history" and h.command == "GET":
+            # telemetry history (obs/history.py rings) as one
+            # exposition-style document — ?family=&window=&step=&agg=,
+            # peer-merged with ``server`` labels exactly like
+            # metrics?scope=cluster; a downed peer is marked
+            # ``mt_node_history_ok 0``, never failed
+            params = _history_params(q1)
+            docs = [history_doc(srv, node=srv.node_name, **params)]
+            status = [(srv.node_name, 1)]
+            if srv.peers is not None and q1.get("local") != "true":
+                for ep, r, err in srv.peers.call_all(
+                        "history_query", timeout_s=10.0, **params):
+                    if err or not isinstance(r, dict) \
+                            or not isinstance(r.get("doc"), str):
+                        status.append((ep, 0))
+                    else:
+                        docs.append(r["doc"])
+                        status.append((r.get("node", ep), 1))
+            marks = ["# TYPE mt_node_history_ok gauge"]
+            for server, ok in status:
+                esc = metrics._escape_label(server)
+                marks.append(
+                    f'mt_node_history_ok{{server="{esc}"}} {ok}')
+            text = metrics.merge_expositions(docs) \
+                + "\n".join(marks) + "\n"
+            h._send(200, text.encode(),
+                    content_type="text/plain; version=0.0.4")
+            return True
+        if route == "alerts" and h.command == "GET":
+            # watchdog alerts (active + recent), peer-aggregated like
+            # xray/forensics; ?local=true keeps it to this node
+            out = alerts_reply(srv)
+            if srv.peers is not None and q1.get("local") != "true":
+                out["peers"] = [
+                    {"node": ep, "error": err} if err else r
+                    for ep, r, err in srv.peers.call_all(
+                        "alerts_query", timeout_s=10.0)]
+            return send_json(out) or True
         if route == "netperf" and h.command == "POST":
             # madmin NetPerf analog (peerRESTMethodNetInfo): throughput
             # to every peer over the real authed internode transport.
@@ -813,7 +851,47 @@ def _render_local(srv, node=None) -> str:
         egress=getattr(srv, "egress", None),
         mrf=getattr(srv, "mrf", None),
         flightrec=getattr(srv, "flightrec", None),
-        rebalancer=_rebalancer(srv))
+        rebalancer=_rebalancer(srv),
+        watchdog=getattr(srv, "watchdog", None))
+
+
+def _history_params(q1) -> dict:
+    """metrics-history query knobs (shared by the route and the
+    parameters it forwards to every peer)."""
+    from ..utils.kvconfig import parse_duration
+    return {"family": q1.get("family", ""),
+            "window_s": parse_duration(q1.get("window") or "30m",
+                                       1800.0),
+            "step_s": parse_duration(q1.get("step") or "1m", 60.0),
+            "agg": q1.get("agg") or "last"}
+
+
+def history_doc(srv, family: str = "", window_s: float = 1800.0,
+                step_s: float = 60.0, agg: str = "last",
+                node=None) -> str:
+    """One node's history leg — shared by the local route and the
+    ``history_query`` peer RPC so the shapes can never drift.  A
+    disabled watchdog yields an empty document (the node still shows
+    up via its ``mt_node_history_ok`` mark)."""
+    from ..obs.history import render_history
+    wd = getattr(srv, "watchdog", None)
+    if wd is None:
+        return ""
+    text = render_history(wd.history, family=family,
+                          window_s=window_s, step_s=step_s, agg=agg)
+    if node and text:
+        text = metrics._with_server_label(text, node)
+    return text
+
+
+def alerts_reply(srv) -> dict:
+    """One node's alerts leg — shared by the admin route and the
+    ``alerts_query`` peer RPC."""
+    wd = getattr(srv, "watchdog", None)
+    out = {"node": srv.node_name, "enabled": wd is not None}
+    out.update(wd.alerts() if wd is not None
+               else {"active": [], "recent": [], "rules": []})
+    return out
 
 
 _CLUSTER_SCRAPE_TTL_S = 2.0
@@ -1258,7 +1336,12 @@ def _config(h, srv, route, q1, payload, send_json) -> bool:
             # retune the forensic trigger engine (thresholds,
             # cooldown, bundle-dir bounds) on the live server
             srv.reload_forensic_config()
-        if parts[1] in ("logger_webhook", "audit_webhook") \
+        if parts[1] == "watchdog":
+            # rebuild the SLO watchdog (sampler + rule engine) live —
+            # history rings reset, alert state starts clean
+            srv.reload_watchdog_config()
+        if parts[1] in ("logger_webhook", "audit_webhook",
+                        "alert_webhook") \
                 or parts[1].startswith("notify_"):
             # rebuild the egress targets live: repointed endpoints and
             # queue knobs apply without a restart (replaced targets

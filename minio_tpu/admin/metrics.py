@@ -104,7 +104,7 @@ def _fmt_labels(labels: tuple, extra: str = "") -> str:
 def render(layer=None, healer=None, config=None, api_stats=None,
            replication=None, crawler=None, node=None,
            egress=None, mrf=None, flightrec=None,
-           rebalancer=None) -> str:
+           rebalancer=None, watchdog=None) -> str:
     """Prometheus text format: counters + histograms + live gauges.
 
     ``config`` (a kvconfig Config) supplies the slow-drive knobs at
@@ -245,6 +245,11 @@ def render(layer=None, healer=None, config=None, api_stats=None,
     if flightrec is not None:
         try:
             lines += _flight_gauges(flightrec)
+        except Exception:  # noqa: BLE001 — a scrape must never fail
+            pass
+    if watchdog is not None:
+        try:
+            lines += _watchdog_metrics(watchdog)
         except Exception:  # noqa: BLE001 — a scrape must never fail
             pass
     text = "\n".join(lines) + "\n"
@@ -594,12 +599,15 @@ def _disk_lastminute_gauges(layer, config=None) -> list[str]:
                                      min_samples=min_samples)
     if verdicts:
         lines += ["# TYPE mt_node_disk_latency_p50_ns gauge",
+                  "# TYPE mt_node_disk_latency_p99_ns gauge",
                   "# TYPE mt_node_disk_slow gauge"]
         for drive in sorted(verdicts):
             v = verdicts[drive]
             dl = _fmt_labels((("drive", drive),))
             lines.append(f"mt_node_disk_latency_p50_ns{dl}"
                          f" {v['p50_ns']}")
+            lines.append(f"mt_node_disk_latency_p99_ns{dl}"
+                         f" {wins[drive].p99_all() if drive in wins else 0}")
             lines.append(f"mt_node_disk_slow{dl}"
                          f" {1 if v['slow'] else 0}")
     return lines
@@ -768,15 +776,54 @@ def _s3_lastminute_gauges(api_stats) -> list[str]:
     lines = [
         "# TYPE mt_s3_api_last_minute_requests gauge",
         "# TYPE mt_s3_api_last_minute_avg_ns gauge",
+        "# TYPE mt_s3_api_last_minute_p99_ns gauge",
         "# TYPE mt_s3_api_last_minute_bytes gauge",
     ]
     for api in sorted(totals):
         c, t, b = totals[api]
         al = _fmt_labels((("api", api),))
+        w = api_stats.windows.get(api)
         lines.append(f"mt_s3_api_last_minute_requests{al} {c}")
         lines.append(f"mt_s3_api_last_minute_avg_ns{al}"
                      f" {t // max(c, 1)}")
+        lines.append(f"mt_s3_api_last_minute_p99_ns{al}"
+                     f" {w.p99() if w is not None else 0}")
         lines.append(f"mt_s3_api_last_minute_bytes{al} {b}")
+    return lines
+
+
+def _watchdog_metrics(watchdog) -> list[str]:
+    """Watchdog alert + telemetry-history families, computed at scrape
+    time from the engine's own state (obs/watchdog.py).  A server with
+    watchdog.enable=off hands ``watchdog=None`` into render() and
+    emits NONE of these families (the idle contract)."""
+    st = watchdog.metrics_state()
+    hist = st.get("history") or {}
+    lines = [
+        "# TYPE mt_history_series gauge",
+        f"mt_history_series {hist.get('series', 0)}",
+        "# TYPE mt_history_samples_total counter",
+        f"mt_history_samples_total {hist.get('samplesTotal', 0)}",
+    ]
+    evals = st.get("evals") or {}
+    if evals:
+        lines.append("# TYPE mt_alert_evals_total counter")
+        for rule in sorted(evals):
+            rl = _fmt_labels((("rule", rule),))
+            lines.append(f"mt_alert_evals_total{rl} {evals[rule]}")
+    transitions = st.get("transitions") or {}
+    if transitions:
+        lines.append("# TYPE mt_alert_transitions_total counter")
+        for rule, to in sorted(transitions):
+            tl = _fmt_labels((("rule", rule), ("to", to)))
+            lines.append(f"mt_alert_transitions_total{tl}"
+                         f" {transitions[(rule, to)]}")
+    firing = st.get("firing") or []
+    if firing:
+        lines.append("# TYPE mt_alert_firing gauge")
+        for rule, subject in sorted(firing):
+            fl = _fmt_labels((("rule", rule), ("subject", subject)))
+            lines.append(f"mt_alert_firing{fl} 1")
     return lines
 
 

@@ -597,16 +597,17 @@ class ObsDocsDriftRule(Rule):
     id = "obs-docs-drift"
     description = ("every X-ray stage name emitted in code "
                    "(``_stages.stage/add/add_async`` call sites + the "
-                   "``STAGE_NAMES`` catalog) and every "
+                   "``STAGE_NAMES`` catalog), every watchdog rule "
+                   "name (the ``RULE_NAMES`` catalog), and every "
                    "``mt_{s3_stage,forensic,flight,quorum,drive_op,"
-                   "trace_tree}_*`` metric family literal must appear "
-                   "in docs/observability.md — an operator reading "
-                   "the stage/family catalog must be able to trust it "
-                   "is complete")
+                   "trace_tree,alert,history}_*`` metric family "
+                   "literal must appear in docs/observability.md — an "
+                   "operator reading the stage/rule/family catalog "
+                   "must be able to trust it is complete")
 
     _FAMILY_RE = re.compile(
-        r"^mt_(?:s3_stage|forensic|flight|quorum|drive_op|trace_tree)"
-        r"_\w+$")
+        r"^mt_(?:s3_stage|forensic|flight|quorum|drive_op|trace_tree"
+        r"|alert|history)_\w+$")
 
     def check_tree(self, mods: list[Module], repo: str):
         import os
@@ -632,8 +633,12 @@ class ObsDocsDriftRule(Rule):
     @classmethod
     def _tokens(cls, mod: Module):
         """(lineno, kind, token) for stage names at ``_stages.stage/
-        add/add_async`` call sites, entries of a ``STAGE_NAMES``
-        tuple, and mt_{s3_stage,forensic,flight}_* family literals."""
+        add/add_async`` call sites, entries of the ``STAGE_NAMES`` /
+        ``RULE_NAMES`` catalogs, and matching metric family literals
+        (bare strings, the constant head of an f-string sample line,
+        and ``# TYPE`` declarations)."""
+        catalogs = {"STAGE_NAMES": "stage name",
+                    "RULE_NAMES": "watchdog rule"}
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.Call) and \
                     isinstance(node.func, ast.Attribute) and \
@@ -644,18 +649,30 @@ class ObsDocsDriftRule(Rule):
                     isinstance(node.args[0].value, str):
                 yield node.lineno, "stage name", node.args[0].value
             elif isinstance(node, ast.Assign) and any(
-                    isinstance(t, ast.Name) and t.id == "STAGE_NAMES"
+                    isinstance(t, ast.Name) and t.id in catalogs
                     for t in node.targets) and \
                     isinstance(node.value, (ast.Tuple, ast.List)):
+                kind = next(catalogs[t.id] for t in node.targets
+                            if isinstance(t, ast.Name)
+                            and t.id in catalogs)
                 for el in node.value.elts:
                     if isinstance(el, ast.Constant) and \
                             isinstance(el.value, str):
-                        yield el.lineno, "stage name", el.value
+                        yield el.lineno, kind, el.value
             elif isinstance(node, ast.Constant) and \
                     isinstance(node.value, str) and \
-                    cls._FAMILY_RE.match(node.value) and \
                     not mod.rel.startswith("minio_tpu/analysis/"):
-                yield node.lineno, "metric family", node.value
+                s = node.value
+                if s.startswith("# TYPE "):
+                    # the family a scrape declares IS emitted — the
+                    # declaration line pins it even when the sample
+                    # line's name lives in an f-string head
+                    parts = s.split()
+                    s = parts[2] if len(parts) >= 3 else ""
+                else:
+                    s = s.split(" ", 1)[0].split("{", 1)[0]
+                if cls._FAMILY_RE.match(s):
+                    yield node.lineno, "metric family", s
 
 
 # -- tls discipline ----------------------------------------------------------

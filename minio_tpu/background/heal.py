@@ -148,6 +148,16 @@ class BackgroundHealer:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.progress = CycleProgress("healing")
+        self._deep_requested = False
+
+    def request_deep(self, drive: str = "") -> None:
+        """Escalate the NEXT sweep to a deep (bitrot-verify) scan —
+        the watchdog's ``drive_degrading`` alert calls this so a
+        drifting drive gets its integrity pass before it degrades into
+        a slow/failed drive.  One-shot: the flag clears when the sweep
+        that honored it starts; the sweep is namespace-wide (the heal
+        path verifies every shard set touching the drive anyway)."""
+        self._deep_requested = True
 
     def sweep(self) -> HealStats:
         """One full-namespace pass.  ``stop()`` is honored between
@@ -156,8 +166,10 @@ class BackgroundHealer:
         heal_object call instead of blocking for the whole sweep —
         stats already counted for the partial cycle are kept, but the
         cycle itself is not counted as completed."""
-        deep = bool(self.deep_every) and \
-            (self.stats.cycles + 1) % self.deep_every == 0
+        deep = (bool(self.deep_every) and
+                (self.stats.cycles + 1) % self.deep_every == 0) \
+            or self._deep_requested
+        self._deep_requested = False
         self.progress.begin()
         completed = False
         try:

@@ -383,6 +383,16 @@ class ForensicSys:
             except Exception as e:  # noqa: BLE001
                 put("config.json", {"error": str(e)})
         try:
+            # the watchdog's telemetry history: the 30 minutes BEFORE
+            # the breach, so the bundle shows the road to it, not just
+            # the instant ({"enabled": False} when no watchdog runs)
+            from .history import snapshot_dict
+            put("history.json", snapshot_dict(
+                getattr(getattr(srv, "watchdog", None), "history",
+                        None)))
+        except Exception as e:  # noqa: BLE001
+            put("history.json", {"error": str(e)})
+        try:
             from ..admin.handlers import _render_local
             docs["metrics.prom"] = _render_local(srv).encode()
         except Exception as e:  # noqa: BLE001

@@ -1,0 +1,367 @@
+"""Bounded multi-resolution telemetry history — the watchdog plane's
+memory (cmd/metrics.go keeps no history at all; the reference leans on
+an external Prometheus for "what did this look like ten minutes ago").
+
+A background sampler (:class:`HistorySampler`, one ``mt-obs-history``
+thread, ``watchdog`` kvconfig subsystem) snapshots selected ``mt_*``
+families out of the node's own exposition document into fixed-size
+downsampling rings:
+
+  ======  =====  ========
+  step    slots  coverage
+  ======  =====  ========
+  10 s    36     6 min
+  1 min   120    2 h
+  10 min  144    24 h
+  ======  =====  ========
+
+Counters are stored as **rates** (the delta between consecutive
+samples over their spacing — a reset clamps to zero and re-baselines),
+gauges as last/min/max/avg per bucket.  Everything is bounded:
+``max_series`` caps distinct series, the rings never grow, and a
+disabled watchdog subsystem means no sampler thread and no
+``mt_history_*`` family in the scrape (the idle contract).
+
+Three consumers share the same rings:
+
+* the admin ``metrics-history`` route (``?family=&window=&step=``),
+  peer-aggregated into one ``server``-labelled exposition document
+  exactly like ``metrics?scope=cluster``;
+* the rule engine (obs/watchdog.py), which evaluates burn rates and
+  drift over the rings each sampler tick;
+* forensic bundles (obs/forensic.py), which embed the last 30 minutes
+  as ``history.json`` — a bundle shows the road TO the breach, not
+  just the instant.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Callable, Dict, Iterable, Optional, Tuple
+
+from ..admin.metrics import _fmt_value
+
+# (step_s, slots): 6 minutes fine, 2 hours medium, 24 hours coarse
+RESOLUTIONS: Tuple[Tuple[int, int], ...] = ((10, 36), (60, 120),
+                                            (600, 144))
+
+# family prefixes sampled by default — the signals the rule catalog
+# (obs/watchdog.py) evaluates, plus the capacity/usage trends worth
+# remembering.  ``watchdog.families`` appends operator-chosen prefixes.
+DEFAULT_FAMILIES: Tuple[str, ...] = (
+    "mt_s3_requests_api_total",
+    "mt_s3_requests_errors_total",
+    "mt_s3_api_last_minute_requests",
+    "mt_s3_api_last_minute_avg_ns",
+    "mt_s3_api_last_minute_p99_ns",
+    "mt_node_disk_latency_p50_ns",
+    "mt_node_disk_latency_p99_ns",
+    "mt_node_disk_slow",
+    "mt_target_dead_letter_total",
+    "mt_target_queue_length",
+    "mt_rebalance_moved_bytes_total",
+    "mt_rebalance_cycle_active",
+    "mt_pool_usage_bytes",
+    "mt_cluster_capacity_raw_total_bytes",
+    "mt_cluster_capacity_raw_free_bytes",
+    "mt_heal_mrf_queued_total",
+    "mt_mem_inuse_bytes",
+    "mt_rpc_breaker_opens_total",
+)
+
+_TYPE_RE = re.compile(r"^# TYPE (\S+) (\S+)$")
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+                        r"(?:\{(.*)\})? (\S+)$")
+
+# bucket slot layout
+_B_MARK, _B_LAST, _B_MIN, _B_MAX, _B_SUM, _B_CNT = range(6)
+
+
+def select_samples(doc: str, prefixes: Iterable[str]
+                   ) -> Dict[Tuple[str, str], Tuple[float, str]]:
+    """Parse one exposition document into
+    ``{(family, raw_label_string): (value, kind)}`` keeping only
+    families matching a prefix.  Histogram families are skipped — the
+    rings store scalars; the lastminute gauges already carry the
+    percentiles worth remembering."""
+    pref = tuple(prefixes)
+    out: Dict[Tuple[str, str], Tuple[float, str]] = {}
+    kinds: Dict[str, str] = {}
+    for ln in doc.splitlines():
+        if ln.startswith("#"):
+            m = _TYPE_RE.match(ln)
+            if m:
+                kinds[m.group(1)] = m.group(2)
+            continue
+        if not ln:
+            continue
+        m = _SAMPLE_RE.match(ln)
+        if not m:
+            continue
+        name, labels, raw = m.group(1), m.group(2) or "", m.group(3)
+        if not name.startswith(pref):
+            continue
+        kind = kinds.get(name, "gauge")
+        if kind == "histogram":
+            continue
+        # histogram child samples (_bucket/_count/_sum) carry the
+        # BASE family's # TYPE — skip them too
+        base = name.rsplit("_", 1)[0]
+        if name.endswith(("_bucket", "_count", "_sum")) \
+                and kinds.get(base) == "histogram":
+            continue
+        try:
+            out[(name, labels)] = (float(raw), kind)
+        except ValueError:
+            continue
+    return out
+
+
+class _SeriesRings:
+    """One series' buckets across every resolution."""
+
+    __slots__ = ("rings",)
+
+    def __init__(self, resolutions: Tuple[Tuple[int, int], ...]):
+        self.rings = [[None] * slots for _, slots in resolutions]
+
+    def observe(self, resolutions, now_s: float, value: float) -> None:
+        for ri, (step, slots) in enumerate(resolutions):
+            mark = int(now_s) // step
+            ring = self.rings[ri]
+            slot = ring[mark % slots]
+            if slot is None or slot[_B_MARK] != mark:
+                ring[mark % slots] = [mark, value, value, value,
+                                      value, 1]
+            else:
+                slot[_B_LAST] = value
+                if value < slot[_B_MIN]:
+                    slot[_B_MIN] = value
+                if value > slot[_B_MAX]:
+                    slot[_B_MAX] = value
+                slot[_B_SUM] += value
+                slot[_B_CNT] += 1
+
+    def points(self, resolutions, ri: int, now_s: float,
+               window_s: float, agg: str) -> list:
+        """[(bucket_epoch_s, value)] oldest first for the live window."""
+        step, slots = resolutions[ri]
+        hi = int(now_s) // step
+        lo = max(hi - slots + 1, int(int(now_s - window_s) // step))
+        out = []
+        for mark in range(lo, hi + 1):
+            slot = self.rings[ri][mark % slots]
+            if slot is None or slot[_B_MARK] != mark:
+                continue
+            if agg == "min":
+                v = slot[_B_MIN]
+            elif agg == "max":
+                v = slot[_B_MAX]
+            elif agg == "avg":
+                v = slot[_B_SUM] / max(1, slot[_B_CNT])
+            elif agg == "sum":
+                v = slot[_B_SUM]
+            else:
+                v = slot[_B_LAST]
+            out.append((mark * step, v))
+        return out
+
+
+class TelemetryHistory:
+    """The bounded series store.  Writes come from ONE sampler thread;
+    reads (admin route, rule engine, bundle writer) take the same lock
+    the writer does — the write path is a handful of list mutations
+    per series every ``interval``, nowhere near the request path."""
+
+    def __init__(self, resolutions: Tuple[Tuple[int, int], ...]
+                 = RESOLUTIONS, max_series: int = 512):
+        self.resolutions = tuple(resolutions)
+        self.max_series = max(1, max_series)
+        self._mu = threading.Lock()
+        self._series: Dict[Tuple[str, str], _SeriesRings] = {}
+        # counter baselines: (value, t) per series for rate conversion
+        self._prev: Dict[Tuple[str, str], Tuple[float, float]] = {}
+        self.samples_total = 0
+        self.dropped_series = 0
+
+    def observe(self, now_s: float,
+                samples: Dict[Tuple[str, str], Tuple[float, str]]
+                ) -> None:
+        with self._mu:
+            for key, (value, kind) in samples.items():
+                if kind == "counter":
+                    prev = self._prev.get(key)
+                    self._prev[key] = (value, now_s)
+                    if prev is None:
+                        continue
+                    dv, dt = value - prev[0], now_s - prev[1]
+                    if dt <= 0:
+                        continue
+                    # a reset (restarted source) reads as a negative
+                    # delta: clamp and re-baseline instead of writing
+                    # a bogus huge negative rate
+                    value = max(0.0, dv) / dt
+                rings = self._series.get(key)
+                if rings is None:
+                    if len(self._series) >= self.max_series:
+                        self.dropped_series += 1
+                        continue
+                    rings = self._series[key] = _SeriesRings(
+                        self.resolutions)
+                rings.observe(self.resolutions, now_s, value)
+                self.samples_total += 1
+
+    # -- queries --------------------------------------------------------------
+
+    def _pick_resolution(self, window_s: float, step_s: float) -> int:
+        """Finest resolution that honors the requested step AND covers
+        the window; falls back to the coarsest ring."""
+        candidates = [ri for ri, (step, _) in enumerate(self.resolutions)
+                      if step >= step_s] or \
+            [len(self.resolutions) - 1]
+        for ri in candidates:
+            step, slots = self.resolutions[ri]
+            if step * slots >= window_s:
+                return ri
+        return candidates[-1]
+
+    def query(self, family: str = "", window_s: float = 1800.0,
+              step_s: float = 60.0, agg: str = "last",
+              now_s: float | None = None
+              ) -> Dict[Tuple[str, str], list]:
+        """{(family, raw_labels): [(epoch_s, value), ...]} for every
+        series whose family starts with ``family`` (all when empty)."""
+        now_s = time.time() if now_s is None else now_s
+        ri = self._pick_resolution(window_s, step_s)
+        with self._mu:
+            keys = [k for k in self._series if k[0].startswith(family)]
+            return {k: self._series[k].points(self.resolutions, ri,
+                                              now_s, window_s, agg)
+                    for k in sorted(keys)}
+
+    def series_count(self) -> int:
+        with self._mu:
+            return len(self._series)
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {"series": len(self._series),
+                    "samplesTotal": self.samples_total,
+                    "droppedSeries": self.dropped_series}
+
+
+def render_history(history: TelemetryHistory, family: str = "",
+                   window_s: float = 1800.0, step_s: float = 60.0,
+                   agg: str = "last", now_s: float | None = None) -> str:
+    """The ``metrics-history`` document: exposition-style text, one
+    ``# TYPE`` per family, each point a sample with a ``ts`` label
+    (epoch seconds of its bucket) — the strict text-format grammar has
+    no room for native timestamps on gauge points, and a label keeps
+    the cluster merge + ``server`` stamping machinery unchanged."""
+    data = history.query(family=family, window_s=window_s,
+                         step_s=step_s, agg=agg, now_s=now_s)
+    lines: list[str] = []
+    current = None
+    for (fam, labels), points in data.items():
+        if fam != current:
+            lines.append(f"# TYPE {fam} gauge")
+            current = fam
+        for t, v in points:
+            inner = f'{labels},ts="{int(t)}"' if labels \
+                else f'ts="{int(t)}"'
+            lines.append(f"{fam}{{{inner}}} {_fmt_value(v)}")
+    return "\n".join(lines) + "\n" if lines else "\n"
+
+
+def snapshot_dict(history: Optional[TelemetryHistory],
+                  window_s: float = 1800.0, step_s: float = 60.0,
+                  now_s: float | None = None) -> dict:
+    """The forensic-bundle ``history.json`` shape: every sampled
+    series' last ``window_s`` as [epoch_s, value] pairs — the road to
+    the breach, readable without a scraper."""
+    if history is None:
+        return {"enabled": False, "series": []}
+    data = history.query(window_s=window_s, step_s=step_s, now_s=now_s)
+    return {
+        "enabled": True,
+        "windowSeconds": window_s,
+        "stepSeconds": step_s,
+        "stats": history.stats(),
+        "series": [{"family": fam, "labels": labels,
+                    "points": [[t, v] for t, v in points]}
+                   for (fam, labels), points in data.items() if points],
+    }
+
+
+class HistorySampler:
+    """The ``mt-obs-history`` thread: every ``interval_s`` render the
+    node's own exposition document, fold the selected families into
+    the rings, then hand the tick to the registered listeners (the
+    rule engine).  Clock and collector are injectable so the watchdog
+    unit tier drives deterministic seeded series without sleeping."""
+
+    def __init__(self, collect: Callable[[], str],
+                 history: TelemetryHistory,
+                 interval_s: float = 10.0,
+                 families: Tuple[str, ...] = DEFAULT_FAMILIES,
+                 extra: Callable[[], dict] | None = None,
+                 clock: Callable[[], float] = time.time):
+        self.collect = collect
+        self.history = history
+        self.interval_s = max(1.0, interval_s)
+        self.families = tuple(families)
+        self.extra = extra
+        self.clock = clock
+        self.listeners: list[Callable[[float], None]] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def tick(self, now_s: float | None = None) -> None:
+        """One sample + evaluate round (the thread body's unit; tests
+        call it directly with a fake clock)."""
+        now_s = self.clock() if now_s is None else now_s
+        try:
+            samples = select_samples(self.collect(), self.families)
+        except Exception:  # noqa: BLE001 — a failing scrape loses one
+            samples = {}   # sample, never the sampler
+        if self.extra is not None:
+            try:
+                samples.update(self.extra())
+            except Exception:  # noqa: BLE001 — same contract
+                pass
+        self.history.observe(now_s, samples)
+        for listener in list(self.listeners):
+            try:
+                listener(now_s)
+            except Exception:  # noqa: BLE001 — a rule bug must not
+                pass           # stop the sampler
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.tick()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="mt-obs-history")
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            self._thread = None
+
+
+def breaker_sample() -> dict:
+    """Synthetic counter series for signals with no scrape family of
+    their own: the internode breaker's lifetime open count (the
+    breaker_flapping rule's source)."""
+    from ..parallel import rpc as _rpc
+    return {("mt_rpc_breaker_opens_total", ""):
+            (float(_rpc.BREAKER_OPEN_COUNT), "counter")}

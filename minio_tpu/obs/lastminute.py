@@ -90,6 +90,17 @@ class Window:
         live.sort()
         return live[len(live) // 2]
 
+    def p99(self, now_s: float | None = None) -> int:
+        """Nearest-rank tail estimate from the same 64-sample
+        reservoir as p50 (0 when idle) — an estimate by construction
+        (the reservoir overwrites), good enough for the burn-rate
+        rules that only need 'the tail moved'."""
+        live = self.live_samples(now_s)
+        if not live:
+            return 0
+        live.sort()
+        return live[min(len(live) - 1, int(0.99 * (len(live) - 1) + 0.5))]
+
 
 class OpWindows:
     """A labelled family of windows: one per operation/API name."""
@@ -128,6 +139,18 @@ class OpWindows:
             return 0
         merged.sort()
         return merged[len(merged) // 2]
+
+    def p99_all(self, now_s: float | None = None) -> int:
+        """Nearest-rank tail over every op's live samples combined —
+        the per-drive tail figure beside :meth:`p50_all`."""
+        merged: list[int] = []
+        for w in list(self.windows.values()):
+            merged.extend(w.live_samples(now_s))
+        if not merged:
+            return 0
+        merged.sort()
+        return merged[min(len(merged) - 1,
+                          int(0.99 * (len(merged) - 1) + 0.5))]
 
 
 def top_entries(stats: OpWindows, now_s: float | None = None

@@ -50,7 +50,7 @@ HTTP_TRACE = PubSub(max_queue=4000)
 # TraceScanner/TraceHealing/TraceReplication) — per-object spans from
 # the autonomous loops, same zero-subscriber idle contract as the rest.
 TRACE_TYPES = ("http", "storage", "internode", "tpu",
-               "scanner", "healing", "replication")
+               "scanner", "healing", "replication", "watchdog")
 
 # headers never to leak into traces (cmd/http-tracer.go redacts these;
 # the reference strips ALL SSE-C key material — including the key MD5 —

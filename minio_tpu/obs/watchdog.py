@@ -1,0 +1,561 @@
+"""SLO watchdog — the rule engine over the telemetry history rings.
+
+Where the forensic engine (obs/forensic.py) explains a breach after
+the fact, the watchdog predicts: declarative rules evaluated each
+sampler tick (obs/history.py) over the history rings, with a
+pending→firing→resolved alert state machine, per-rule cooldown/dedup,
+JSON alert events through the egress ``DeliveryTarget`` plane
+(``alert_webhook`` kvconfig target — store-and-forward and replay for
+free), and a ``firing→forensic`` bridge so a configured rule invokes
+the trigger engine with the rule name as trigger.
+
+The rule catalog (``RULE_NAMES``):
+
+* ``slo_burn_fast`` / ``slo_burn_slow`` — multi-window SLO burn rate
+  (Google-SRE style): per-API error rate over the 5m/1h window
+  divided by ``watchdog.slo_objective``; the fast window pages on a
+  sharp burn (factor 14 ≈ 2% of a 30-day budget in one hour), the
+  slow window on a sustained simmer (factor 6).
+* ``drive_degrading`` — per-drive latency drift: each drive's
+  last-minute p50 is smoothed with an EWMA and scored against the
+  drive population with a robust (median + MAD) z-score, so a
+  drifting-but-not-yet-slow drive raises an alert BEFORE the
+  leave-one-out ``slow_drives()`` multiple flags it.  Firing also
+  escalates the healer's bitrotscan scheduling (``request_deep``).
+* ``breaker_flapping`` — internode breaker opens in the fast window.
+* ``deadletter_growth`` — egress dead-letter growth per target.
+* ``rebalance_stall`` — a rebalance cycle active across the stall
+  window with zero byte progress.
+* ``pool_days_to_full`` — linear trend on ``mt_pool_usage_bytes``
+  against the pool's capacity share.
+
+Idle contract: ``watchdog.enable=off`` (the default) means no engine,
+no sampler thread, no ``mt_alert_*``/``mt_history_*`` family in the
+scrape, and no ``watchdog.*`` span.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Tuple
+
+from . import trace as _trace
+from .history import (DEFAULT_FAMILIES, HistorySampler, TelemetryHistory,
+                      breaker_sample)
+
+# the rule catalog (the ``rule`` label on every mt_alert_* family; the
+# obs-docs-drift analysis rule pins each name into docs/observability.md)
+RULE_NAMES = (
+    "slo_burn_fast",
+    "slo_burn_slow",
+    "drive_degrading",
+    "breaker_flapping",
+    "deadletter_growth",
+    "rebalance_stall",
+    "pool_days_to_full",
+)
+
+_RECENT_CAP = 64
+
+_API_RE = re.compile(r'api="((?:[^"\\]|\\.)*)"')
+_STATUS_RE = re.compile(r'status="(\d+)"')
+_DRIVE_RE = re.compile(r'drive="((?:[^"\\]|\\.)*)"')
+_TARGET_RE = re.compile(r'target="((?:[^"\\]|\\.)*)"')
+_POOL_RE = re.compile(r'pool="((?:[^"\\]|\\.)*)"')
+
+
+def _mean(points: list) -> float:
+    return sum(v for _, v in points) / len(points) if points else 0.0
+
+
+def _median(values: list) -> float:
+    if not values:
+        return 0.0
+    s = sorted(values)
+    return s[len(s) // 2]
+
+
+class WatchdogSys:
+    """One node's rule engine + alert store.  Owns the telemetry
+    history and its sampler thread; every hook (clock, collector,
+    delivery targets, forensic bridge, heal escalation) is injectable
+    so the unit tier drives seeded series with no sleeps."""
+
+    def __init__(self, *, history: TelemetryHistory | None = None,
+                 interval_s: float = 10.0,
+                 rules: Tuple[str, ...] = RULE_NAMES,
+                 slo_objective: float = 0.01,
+                 burn_fast_window_s: float = 300.0,
+                 burn_slow_window_s: float = 3600.0,
+                 burn_fast_factor: float = 14.0,
+                 burn_slow_factor: float = 6.0,
+                 burn_min_rps: float = 1.0,
+                 drift_z: float = 3.5,
+                 drift_alpha: float = 0.3,
+                 drift_floor_ns: float = 1e6,
+                 flap_threshold: float = 6.0,
+                 deadletter_growth: float = 10.0,
+                 stall_window_s: float = 300.0,
+                 days_to_full: float = 7.0,
+                 pending_for: int = 2,
+                 cooldown_s: float = 300.0,
+                 forensic_rules: Tuple[str, ...] = (),
+                 collect: Callable[[], str] | None = None,
+                 families: Tuple[str, ...] = DEFAULT_FAMILIES,
+                 targets_fn: Callable[[], list] | None = None,
+                 forensic_fn: Callable[[str, dict], object]
+                 | None = None,
+                 escalate_fn: Callable[[str], None] | None = None,
+                 node_name: str = "",
+                 clock: Callable[[], float] = time.time):
+        self.history = history if history is not None \
+            else TelemetryHistory()
+        self.rules = tuple(r for r in rules if r in RULE_NAMES)
+        self.slo_objective = max(1e-6, slo_objective)
+        self.burn_fast_window_s = burn_fast_window_s
+        self.burn_slow_window_s = burn_slow_window_s
+        self.burn_fast_factor = burn_fast_factor
+        self.burn_slow_factor = burn_slow_factor
+        self.burn_min_rps = burn_min_rps
+        self.drift_z = drift_z
+        self.drift_alpha = min(1.0, max(0.01, drift_alpha))
+        self.drift_floor_ns = max(1.0, drift_floor_ns)
+        self.flap_threshold = flap_threshold
+        self.deadletter_growth = deadletter_growth
+        self.stall_window_s = stall_window_s
+        self.days_to_full = days_to_full
+        self.pending_for = max(1, pending_for)
+        self.cooldown_s = cooldown_s
+        self.forensic_rules = tuple(forensic_rules)
+        self.targets_fn = targets_fn or (lambda: [])
+        self.forensic_fn = forensic_fn
+        self.escalate_fn = escalate_fn
+        self.node_name = node_name
+        self.clock = clock
+        self.sampler = HistorySampler(
+            collect or (lambda: ""), self.history,
+            interval_s=interval_s, families=families,
+            extra=breaker_sample, clock=clock)
+        self.sampler.listeners.append(self.evaluate)
+        self._mu = threading.Lock()
+        # (rule, subject) -> live alert dict (state pending|firing)
+        self._active: Dict[Tuple[str, str], dict] = {}
+        self._resolved_at: Dict[Tuple[str, str], float] = {}
+        self.recent: deque = deque(maxlen=_RECENT_CAP)
+        self.evals: Dict[str, int] = {}
+        self.transitions: Dict[Tuple[str, str], int] = {}
+        self._ewma: Dict[str, float] = {}
+
+    # -- construction ---------------------------------------------------------
+
+    @classmethod
+    def from_server(cls, srv) -> "WatchdogSys | None":
+        """Build from the ``watchdog`` kvconfig subsystem; None when
+        disabled (the idle contract) or on any bad knob."""
+        from ..utils.kvconfig import parse_duration
+        cfg = srv.config
+        try:
+            if (cfg.get("watchdog", "enable") or "off") != "on":
+                return None
+
+            def dur(key: str, default: str) -> float:
+                return parse_duration(cfg.get("watchdog", key)
+                                      or default,
+                                      parse_duration(default, 10.0))
+
+            def num(key: str, default: float) -> float:
+                return float(cfg.get("watchdog", key) or default)
+
+            rules = tuple(
+                r for r in (cfg.get("watchdog", "rules") or "")
+                .replace(" ", "").split(",") if r) or RULE_NAMES
+            fams = DEFAULT_FAMILIES + tuple(
+                f for f in (cfg.get("watchdog", "families") or "")
+                .replace(" ", "").split(",") if f)
+            forensic_rules = tuple(
+                r for r in (cfg.get("watchdog", "forensic_rules")
+                            or "").replace(" ", "").split(",") if r)
+            from ..admin.handlers import _render_local
+
+            def _targets() -> list:
+                eg = getattr(srv, "egress", None)
+                return [t for t in (eg.targets() if eg else [])
+                        if getattr(t, "target_type", "") == "alert"]
+
+            def _forensic(rule: str, detail: dict):
+                fx = getattr(srv, "forensic", None)
+                return fx.fire(rule, detail) if fx is not None else None
+
+            def _escalate(drive: str) -> None:
+                healer = getattr(srv, "healer", None)
+                candidates = [healer] if healer is not None else [
+                    s for s in getattr(srv, "_background", [])
+                    if hasattr(s, "request_deep")]
+                for h in candidates:
+                    req = getattr(h, "request_deep", None)
+                    if req is not None:
+                        req(drive)
+
+            return cls(
+                interval_s=dur("interval", "10s"),
+                rules=rules,
+                slo_objective=num("slo_objective", 0.01),
+                burn_fast_window_s=dur("burn_fast_window", "5m"),
+                burn_slow_window_s=dur("burn_slow_window", "1h"),
+                burn_fast_factor=num("burn_fast_factor", 14.0),
+                burn_slow_factor=num("burn_slow_factor", 6.0),
+                burn_min_rps=num("burn_min_rps", 1.0),
+                drift_z=num("drift_z", 3.5),
+                drift_alpha=num("drift_alpha", 0.3),
+                drift_floor_ns=dur("drift_floor", "1ms") * 1e9,
+                flap_threshold=num("flap_threshold", 6.0),
+                deadletter_growth=num("deadletter_growth", 10.0),
+                stall_window_s=dur("stall_window", "5m"),
+                days_to_full=num("days_to_full", 7.0),
+                pending_for=int(num("pending_for", 2)),
+                cooldown_s=dur("cooldown", "5m"),
+                forensic_rules=forensic_rules,
+                collect=lambda: _render_local(srv),
+                families=fams,
+                targets_fn=_targets,
+                forensic_fn=_forensic,
+                escalate_fn=_escalate,
+                node_name=getattr(srv, "node_name", ""))
+        except Exception:  # noqa: BLE001 — a bad knob must not take
+            return None    # the server down
+
+    def start(self) -> None:
+        self.sampler.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self.sampler.stop(timeout=timeout)
+
+    # -- evaluation -----------------------------------------------------------
+
+    def evaluate(self, now_s: float | None = None) -> list:
+        """One rule-engine pass over the rings; returns the state
+        transitions it produced as (rule, subject, to) tuples (tests).
+        Registered as a sampler tick listener."""
+        now_s = self.clock() if now_s is None else now_s
+        t0 = time.monotonic_ns()
+        breaches: Dict[Tuple[str, str], Tuple[float, dict]] = {}
+        for rule in self.rules:
+            self.evals[rule] = self.evals.get(rule, 0) + 1
+            fn = getattr(self, f"_rule_{rule}", None)
+            if fn is None:
+                continue
+            try:
+                for subject, value, detail in fn(now_s):
+                    breaches[(rule, subject)] = (value, detail)
+            except Exception:  # noqa: BLE001 — one rule's bug must not
+                continue       # starve the others
+        transitions = self._apply(now_s, breaches)
+        if _trace.active():
+            dur = time.monotonic_ns() - t0
+            _trace.publish_span(_trace.make_span(
+                "watchdog", "watchdog.evaluate",
+                start_ns=_trace.now_ns() - dur, duration_ns=dur,
+                detail={"rules": len(self.rules),
+                        "breaches": len(breaches),
+                        "transitions": len(transitions)}))
+        return transitions
+
+    def _apply(self, now_s: float, breaches) -> list:
+        """The pending→firing→resolved state machine + cooldown/dedup.
+        Delivery/bridging happens OUTSIDE the lock — a slow webhook
+        queue must not block the admin alerts route."""
+        fired: list[dict] = []
+        resolved: list[dict] = []
+        transitions: list[tuple] = []
+        with self._mu:
+            for key, (value, detail) in breaches.items():
+                rule, subject = key
+                alert = self._active.get(key)
+                if alert is not None:
+                    alert["value"] = value
+                    alert["detail"] = detail
+                    alert["lastSeen"] = now_s
+                    if alert["state"] == "pending":
+                        alert["ticks"] += 1
+                        if alert["ticks"] >= self.pending_for:
+                            alert["state"] = "firing"
+                            alert["firedAt"] = now_s
+                            self._count(rule, "firing")
+                            transitions.append((rule, subject,
+                                                "firing"))
+                            fired.append(dict(alert))
+                    continue
+                # dedup: a just-resolved alert re-breaching inside the
+                # cooldown stays silent (no pending churn either)
+                res = self._resolved_at.get(key)
+                if res is not None and now_s - res < self.cooldown_s:
+                    continue
+                alert = {"rule": rule, "subject": subject,
+                         "state": "pending", "ticks": 1,
+                         "value": value, "detail": detail,
+                         "since": now_s, "lastSeen": now_s,
+                         "firedAt": None}
+                self._active[key] = alert
+                self._count(rule, "pending")
+                transitions.append((rule, subject, "pending"))
+                if alert["ticks"] >= self.pending_for:
+                    alert["state"] = "firing"
+                    alert["firedAt"] = now_s
+                    self._count(rule, "firing")
+                    transitions.append((rule, subject, "firing"))
+                    fired.append(dict(alert))
+            for key in [k for k in self._active if k not in breaches]:
+                rule, subject = key
+                alert = self._active.pop(key)
+                if alert["state"] == "firing":
+                    alert["state"] = "resolved"
+                    alert["resolvedAt"] = now_s
+                    self._resolved_at[key] = now_s
+                    self._count(rule, "resolved")
+                    transitions.append((rule, subject, "resolved"))
+                    self.recent.append(alert)
+                    resolved.append(dict(alert))
+                # a pending alert that un-breached just evaporates
+        for alert in fired:
+            self._deliver("firing", alert)
+            if alert["rule"] in self.forensic_rules and \
+                    self.forensic_fn is not None:
+                try:
+                    self.forensic_fn(alert["rule"], alert["detail"])
+                except Exception:  # noqa: BLE001 — bridge is best-effort
+                    pass
+            if alert["rule"] == "drive_degrading" and \
+                    self.escalate_fn is not None:
+                try:
+                    self.escalate_fn(alert["subject"])
+                except Exception:  # noqa: BLE001 — same contract
+                    pass
+        for alert in resolved:
+            self._deliver("resolved", alert)
+        return transitions
+
+    def _count(self, rule: str, to: str) -> None:
+        self.transitions[(rule, to)] = \
+            self.transitions.get((rule, to), 0) + 1
+
+    def _deliver(self, state: str, alert: dict) -> None:
+        event = {"type": "alert", "state": state,
+                 "rule": alert["rule"], "subject": alert["subject"],
+                 "value": alert["value"], "detail": alert["detail"],
+                 "node": self.node_name,
+                 "time": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime())}
+        for t in self.targets_fn():
+            try:
+                t.send(event)
+            except Exception:  # noqa: BLE001 — alerting must never
+                pass           # throw into the sampler
+
+    # -- the rules ------------------------------------------------------------
+
+    def _burn(self, now_s: float, window_s: float
+              ) -> list[tuple[str, float, dict]]:
+        """Per-API burn rate over one window: total 5xx mass / total
+        request mass / objective.  Counters live in the rings as
+        rates sampled by the SAME thread at the same ticks, so the
+        ratio of window SUMs is the window's true error fraction —
+        and an error series younger than the window (a counter is
+        born on its first 5xx) implicitly contributes zeros for the
+        ticks before its birth instead of inflating a mean computed
+        over its own short support."""
+        errors = self.history.query("mt_s3_requests_errors_total",
+                                    window_s=window_s, step_s=1,
+                                    agg="sum", now_s=now_s)
+        totals = self.history.query("mt_s3_requests_api_total",
+                                    window_s=window_s, step_s=1,
+                                    agg="sum", now_s=now_s)
+        rates = self.history.query("mt_s3_requests_api_total",
+                                   window_s=window_s, step_s=1,
+                                   agg="avg", now_s=now_s)
+        err_by_api: Dict[str, float] = {}
+        for (_, labels), points in errors.items():
+            m = _STATUS_RE.search(labels)
+            if m is None or int(m.group(1)) < 500:
+                continue
+            am = _API_RE.search(labels)
+            api = am.group(1) if am else ""
+            err_by_api[api] = err_by_api.get(api, 0.0) + \
+                sum(v for _, v in points)
+        out = []
+        for key, points in totals.items():
+            am = _API_RE.search(key[1])
+            api = am.group(1) if am else ""
+            rps = _mean(rates.get(key, []))
+            mass = sum(v for _, v in points)
+            if rps < self.burn_min_rps or mass <= 0:
+                continue
+            ratio = err_by_api.get(api, 0.0) / mass
+            burn = ratio / self.slo_objective
+            out.append((api, burn, {
+                "windowSeconds": window_s, "requestsPerSecond": rps,
+                "errorRate": round(ratio, 5),
+                "objective": self.slo_objective,
+                "burnRate": round(burn, 2)}))
+        return out
+
+    def _rule_slo_burn_fast(self, now_s: float):
+        for api, burn, detail in self._burn(now_s,
+                                            self.burn_fast_window_s):
+            if burn >= self.burn_fast_factor:
+                detail["threshold"] = self.burn_fast_factor
+                yield api, burn, detail
+
+    def _rule_slo_burn_slow(self, now_s: float):
+        for api, burn, detail in self._burn(now_s,
+                                            self.burn_slow_window_s):
+            if burn >= self.burn_slow_factor:
+                detail["threshold"] = self.burn_slow_factor
+                yield api, burn, detail
+
+    def _rule_drive_degrading(self, now_s: float):
+        """EWMA-smoothed per-drive p50 scored against the population
+        with a robust z (median + MAD, normal-consistency 0.6745);
+        only the slower side alerts.  The MAD is floored by
+        ``drift_floor_ns`` so a healthy all-identical population
+        cannot turn measurement noise into infinite z."""
+        data = self.history.query("mt_node_disk_latency_p50_ns",
+                                  window_s=self.sampler.interval_s * 3,
+                                  step_s=1, agg="last", now_s=now_s)
+        latest: Dict[str, float] = {}
+        for (_, labels), points in data.items():
+            m = _DRIVE_RE.search(labels)
+            if m is None or not points:
+                continue
+            latest[m.group(1)] = points[-1][1]
+        for drive, v in latest.items():
+            prev = self._ewma.get(drive)
+            self._ewma[drive] = v if prev is None else \
+                prev + self.drift_alpha * (v - prev)
+        # drives that left the scrape stop contributing to the
+        # population (their windows idled out)
+        for drive in [d for d in self._ewma if d not in latest]:
+            del self._ewma[drive]
+        if len(self._ewma) < 3:
+            return
+        values = list(self._ewma.values())
+        med = _median(values)
+        mad = _median([abs(x - med) for x in values]) / 0.6745
+        scale = max(mad, self.drift_floor_ns)
+        for drive, x in sorted(self._ewma.items()):
+            z = (x - med) / scale
+            if x > med and z >= self.drift_z:
+                yield drive, round(z, 2), {
+                    "ewmaNs": int(x), "medianNs": int(med),
+                    "madNs": int(mad), "z": round(z, 2),
+                    "threshold": self.drift_z}
+
+    def _rule_breaker_flapping(self, now_s: float):
+        points_map = self.history.query(
+            "mt_rpc_breaker_opens_total",
+            window_s=self.burn_fast_window_s, step_s=1, agg="avg",
+            now_s=now_s)
+        opens = sum(_mean(p) for p in points_map.values()) \
+            * self.burn_fast_window_s
+        if opens >= self.flap_threshold:
+            yield "", round(opens, 1), {
+                "windowSeconds": self.burn_fast_window_s,
+                "opens": round(opens, 1),
+                "threshold": self.flap_threshold}
+
+    def _rule_deadletter_growth(self, now_s: float):
+        data = self.history.query("mt_target_dead_letter_total",
+                                  window_s=self.burn_fast_window_s,
+                                  step_s=1, agg="avg", now_s=now_s)
+        for (_, labels), points in data.items():
+            growth = _mean(points) * self.burn_fast_window_s
+            if growth >= self.deadletter_growth:
+                m = _TARGET_RE.search(labels)
+                yield (m.group(1) if m else ""), round(growth, 1), {
+                    "windowSeconds": self.burn_fast_window_s,
+                    "deadLettered": round(growth, 1),
+                    "threshold": self.deadletter_growth}
+
+    def _rule_rebalance_stall(self, now_s: float):
+        active = self.history.query("mt_rebalance_cycle_active",
+                                    window_s=self.stall_window_s,
+                                    step_s=1, agg="min", now_s=now_s)
+        act_points = [p for pts in active.values() for p in pts]
+        if len(act_points) < 3 or not all(v >= 1 for _, v in
+                                          act_points):
+            return
+        span = act_points[-1][0] - act_points[0][0]
+        if span < self.stall_window_s * 0.8:
+            return          # not yet observed across the whole window
+        moved = self.history.query("mt_rebalance_moved_bytes_total",
+                                   window_s=self.stall_window_s,
+                                   step_s=1, agg="avg", now_s=now_s)
+        rate = sum(_mean(p) for p in moved.values())
+        if rate <= 0:
+            yield "", 0.0, {"windowSeconds": self.stall_window_s,
+                            "bytesPerSecond": rate}
+
+    def _rule_pool_days_to_full(self, now_s: float):
+        """Least-squares slope over the coarse ring; capacity share is
+        the cluster raw total split across pools — an approximation,
+        but the alert is a trend warning, not an accountant."""
+        usage = self.history.query("mt_pool_usage_bytes",
+                                   window_s=86400.0, step_s=600,
+                                   agg="last", now_s=now_s)
+        usage = {k: v for k, v in usage.items() if len(v) >= 4}
+        if not usage:
+            return
+        cap = self.history.query("mt_cluster_capacity_raw_total_bytes",
+                                 window_s=3600.0, step_s=1, agg="last",
+                                 now_s=now_s)
+        cap_points = [p for pts in cap.values() for p in pts]
+        if not cap_points:
+            return
+        cap_share = cap_points[-1][1] / max(1, len(usage))
+        for (_, labels), points in usage.items():
+            n = len(points)
+            ts = [t for t, _ in points]
+            vs = [v for _, v in points]
+            tm, vm = sum(ts) / n, sum(vs) / n
+            denom = sum((t - tm) ** 2 for t in ts)
+            if denom <= 0:
+                continue
+            slope = sum((t - tm) * (v - vm)
+                        for t, v in points) / denom   # bytes/s
+            if slope <= 0:
+                continue
+            days = (cap_share - vs[-1]) / slope / 86400.0
+            if 0 <= days <= self.days_to_full:
+                m = _POOL_RE.search(labels)
+                yield (m.group(1) if m else ""), round(days, 2), {
+                    "daysToFull": round(days, 2),
+                    "bytesPerDay": int(slope * 86400),
+                    "capacityShareBytes": int(cap_share),
+                    "usedBytes": int(vs[-1]),
+                    "threshold": self.days_to_full}
+
+    # -- read back ------------------------------------------------------------
+
+    def alerts(self) -> dict:
+        """The admin ``alerts`` route body (active + recent), shared
+        by the local route and the peer RPC."""
+        with self._mu:
+            active = sorted((dict(a) for a in self._active.values()),
+                            key=lambda a: (a["rule"], a["subject"]))
+            recent = list(self.recent)
+        return {"active": active, "recent": recent,
+                "rules": list(self.rules)}
+
+    def metrics_state(self) -> dict:
+        """Scrape-time snapshot for the mt_alert_*/mt_history_*
+        families (admin/metrics.py _watchdog_metrics)."""
+        with self._mu:
+            firing = [(a["rule"], a["subject"])
+                      for a in self._active.values()
+                      if a["state"] == "firing"]
+            return {"firing": firing,
+                    "transitions": dict(self.transitions),
+                    "evals": dict(self.evals),
+                    "history": self.history.stats()}

@@ -197,6 +197,21 @@ def register_peer_service(rpc: RPCServer, srv) -> None:
                               errors_only=errors_only, limit=limit,
                               rids=tuple(rids or ()))
 
+    # SLO watchdog plane (admin `metrics-history` / `alerts`
+    # aggregation): same shared builders as the local routes, so the
+    # local leg and the peer leg can never drift apart in shape
+    def history_query(family: str = "", window_s: float = 1800.0,
+                      step_s: float = 60.0, agg: str = "last"):
+        from ..admin.handlers import history_doc
+        return {"node": srv.node_name,
+                "doc": history_doc(srv, family=family,
+                                   window_s=window_s, step_s=step_s,
+                                   agg=agg, node=srv.node_name)}
+
+    def alerts_query():
+        from ..admin.handlers import alerts_reply
+        return alerts_reply(srv)
+
     rpc.register("peer", {
         "reload_bucket_meta": reload_bucket_meta,
         "reload_iam": reload_iam,
@@ -218,6 +233,8 @@ def register_peer_service(rpc: RPCServer, srv) -> None:
         "healthinfo_collect": healthinfo_collect,
         "forensic_list": forensic_list,
         "trace_tree_query": trace_tree_query,
+        "history_query": history_query,
+        "alerts_query": alerts_query,
     })
 
 

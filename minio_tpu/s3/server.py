@@ -428,6 +428,13 @@ class S3Server:
         # None when disabled or no local drive exists (gateway modes)
         self.forensic = None
         self.reload_forensic_config()
+        # SLO watchdog plane (obs/watchdog.py): the telemetry-history
+        # sampler + burn-rate/drift rule engine over it (``watchdog``
+        # kvconfig subsystem); None when disabled — the idle contract
+        # means no mt-obs-history thread and no mt_alert_*/mt_history_*
+        # family in the scrape
+        self.watchdog = None
+        self.reload_watchdog_config()
 
     def reload_api_config(self) -> None:
         """(Re)derive the request-plane knobs from the ``api`` kvconfig
@@ -585,6 +592,24 @@ class S3Server:
         except Exception:  # noqa: BLE001 — a bad knob value must not
             self.forensic = None       # take the server down
 
+    def reload_watchdog_config(self) -> None:
+        """(Re)build the SLO watchdog from the ``watchdog`` kvconfig
+        subsystem — at boot and after admin SetConfigKV.  A reload
+        replaces the engine wholesale: history rings reset (documented
+        in the subsystem comment) and alert state starts clean."""
+        from ..obs.watchdog import WatchdogSys
+        old = getattr(self, "watchdog", None)
+        if old is not None:
+            # stop the outgoing sampler thread before the swap — two
+            # mt-obs-history threads must never tick concurrently
+            old.stop(timeout=5.0)
+        try:
+            self.watchdog = WatchdogSys.from_server(self)
+        except Exception:  # noqa: BLE001 — a bad knob value must not
+            self.watchdog = None       # take the server down
+        if self.watchdog is not None:
+            self.watchdog.start()
+
     def reload_background_config(self) -> None:
         """Push the ``heal``/``scanner`` pacing knobs into every
         attached background plane (attach_background) — at boot and
@@ -667,16 +692,22 @@ class S3Server:
             return t
 
         for sub, sink in (("logger_webhook", self.logger.targets),
-                          ("audit_webhook", self.audit.targets)):
+                          ("audit_webhook", self.audit.targets),
+                          ("alert_webhook", None)):
             try:
                 if cfg.get(sub, "enable") != "on":
                     continue
                 size = config_queue_limit(cfg, sub, "queue_size")
-                sink.append(_own(_obs_logger.HTTPLogTarget(
+                t = _own(_obs_logger.HTTPLogTarget(
                     cfg.get(sub, "endpoint"), cfg.get(sub, "auth_token"),
                     target_type=sub.split("_", 1)[0],
                     queue_limit=size, store_limit=size,
-                    store_dir=cfg.get(sub, "queue_dir") or None)))
+                    store_dir=cfg.get(sub, "queue_dir") or None))
+                if sink is not None:
+                    sink.append(t)
+                # alert targets have no log sink: the watchdog engine
+                # pushes alert events into them directly (it discovers
+                # them in the egress registry by target_type)
             except Exception as e:  # noqa: BLE001 — bad subsystem config
                 self.logger.error(f"egress: building {sub} target "
                                   f"failed: {e}")
@@ -782,6 +813,11 @@ class S3Server:
         with self._conns_mu:
             conns = list(self._conns)
         sever_connections(conns)
+        # watchdog down BEFORE the egress plane: the sampler thread
+        # (mt-obs-history) joins so no alert event is pushed into a
+        # target that is mid-close below
+        if getattr(self, "watchdog", None) is not None:
+            self.watchdog.stop(timeout=5.0)
         self.events.close()
         # egress plane down WITH the server: sender threads join, queued
         # records spill to their disk stores, and this server's targets

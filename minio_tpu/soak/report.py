@@ -88,6 +88,13 @@ class Scenario:
     # the timeline can fire ``pool_add`` / ``pool_decommission`` events
     # mid-storm; pair with MT_REBALANCE_ENABLE=on in ``env``
     pools: bool = False
+    # SLO watchdog scenario (ISSUE 18): the runner hosts a live HTTP
+    # alert sink and wires it as the ``alert_webhook`` egress endpoint
+    # before the cluster boots (the sink's port is only known at run
+    # time, so it cannot live in the scenario's env literal); pair
+    # with MT_WATCHDOG_ENABLE=on in ``env``.  The watchdog verdict
+    # (_watchdog_summary) feeds the Budget's alert rows.
+    watchdog: bool = False
 
 
 # chaos knobs every scenario runs under: snappy breakers so fault
@@ -258,6 +265,120 @@ def forensic_drill_scenario(duration_s: float = 12.0) -> Scenario:
              "MT_FORENSIC_COOLDOWN": "10m"})
 
 
+def watchdog_storm_scenario(duration_s: float = 24.0) -> Scenario:
+    """ISSUE 18 tentpole proof: a SlowDisk latency RAMP mid-storm —
+    drive 1's injected delay steps 8ms → 20ms → 45ms while the
+    GET-heavy mix keeps storming — and the watchdog's
+    ``drive_degrading`` rule (EWMA + robust z over the per-drive p50
+    history) must fire while every latency/error SLO row still passes
+    and no ``slo_burn_*`` alert exists: degradation predicted BEFORE
+    any user-visible breach.  After ``drive_fast`` heals the drive the
+    alert must resolve (EWMA decays back into the population).  The
+    node runs 4 local drives so the drift rule has a population
+    (it needs >= 3 reporting drives).  Seeded and deterministic: the
+    ramp offsets are programmed, the workload is seed-driven."""
+    E = _chaos.Event
+    t = duration_s
+    return Scenario(
+        name="watchdog_storm", mix=MIXES["get_heavy_small"],
+        timeline=[
+            E(0.17 * t, "drive_slow", drive=1, delay_s=0.008),
+            E(0.33 * t, "drive_slow", drive=1, delay_s=0.02),
+            E(0.50 * t, "drive_slow", drive=1, delay_s=0.045),
+            E(0.67 * t, "drive_fast", drive=1),
+        ],
+        duration_s=duration_s,
+        budget=_slo.Budget(
+            max_error_rate=0.10,
+            require_watchdog=True,
+            expect_alert_fired=("drive_degrading",),
+            expect_alert_resolved=("drive_degrading",),
+            expect_alert_quiet=("slo_burn_fast", "slo_burn_slow"),
+            require_predictive=True,
+            require_no_forensics=True,
+            require_xray=True),
+        workers=2, drives_per_node=4, watchdog=True,
+        env={"MT_WATCHDOG_ENABLE": "on",
+             "MT_WATCHDOG_INTERVAL": "1s"})
+
+
+def burn_drill_scenario(duration_s: float = 120.0) -> Scenario:
+    """The burn-rate drill: a long clean phase, then the
+    forensic-drill killing blow (both node0-local drives die while
+    node1's internode link 503-bursts — a genuine majority-5xx
+    outage) for ~14 seconds near the end.  The FAST burn window
+    (10s, compressed through the kvconfig env layer) sees a near-1.0
+    error rate and must fire; the SLOW window spans the whole
+    scenario, so the same burn is diluted by the clean phase to well
+    under its factor and must stay quiet — the multi-window split
+    working on live traffic, not seeded series.  The dilution holds
+    even though the 5xx counter (and so its history series) is only
+    BORN at the breach: the burn rule ratios window SUMs against the
+    request series' full support, so the pre-breach clean phase
+    counts as zero error mass rather than vanishing.  The firing
+    alert rides the live alert_webhook sink AND bridges into the
+    forensic engine (``forensic_rules=slo_burn_fast``), whose bundle
+    must carry ``history.json`` with the sampled road to the breach;
+    after the heal the fast window drains and the alert resolves."""
+    E = _chaos.Event
+    t = duration_s
+    return Scenario(
+        name="burn_drill", mix=MIXES["get_heavy_small"],
+        timeline=[
+            # the breach: ~14s of majority-5xx near the end
+            E(0.800 * t, "drive_kill", drive=0),
+            E(0.805 * t, "drive_kill", drive=1),
+            E(0.810 * t, "burst_503", node=1),
+            E(0.915 * t, "heal_link", node=1),
+            E(0.920 * t, "drive_return", drive=0),
+            E(0.925 * t, "drive_return", drive=1),
+        ],
+        duration_s=duration_s,
+        # the breach IS the point: no error ceiling, forensic bundles
+        # expected (the watchdog bridge + the engine's own trigger)
+        budget=_slo.Budget(
+            max_error_rate=1.0,
+            p50_ms=60_000.0, p99_ms=120_000.0,
+            converge_timeout_s=60.0,
+            require_watchdog=True,
+            expect_alert_fired=("slo_burn_fast",),
+            expect_alert_quiet=("slo_burn_slow",),
+            expect_alert_resolved=("slo_burn_fast",),
+            require_history_bundle=True,
+            require_xray=True),
+        workers=2, watchdog=True,
+        env={"MT_WATCHDOG_ENABLE": "on",
+             "MT_WATCHDOG_INTERVAL": "1s",
+             # compressed burn windows: the 10s fast window reads the
+             # fine ring, the 3m slow window spans the whole scenario
+             "MT_WATCHDOG_BURN_FAST_WINDOW": "10s",
+             "MT_WATCHDOG_BURN_SLOW_WINDOW": "3m",
+             "MT_WATCHDOG_SLO_OBJECTIVE": "0.035",
+             "MT_WATCHDOG_FORENSIC_RULES": "slo_burn_fast",
+             "MT_FORENSIC_COOLDOWN": "10m"})
+
+
+def watchdog_smoke_scenario(duration_s: float = 5.0) -> Scenario:
+    """The tier-1 watchdog miniature: the GET-heavy mix with the plane
+    ENABLED and no chaos — the sampler must tick, the
+    mt_alert_*/mt_history_* families must be on the live scrape, and
+    every rule must stay quiet on a healthy cluster (the
+    false-positive contract, the dual of the storms above)."""
+    return Scenario(
+        name="smoke_watchdog", mix=MIXES["get_heavy_small"],
+        timeline=[],
+        duration_s=duration_s,
+        budget=_slo.Budget(
+            converge_timeout_s=30.0,
+            require_watchdog=True,
+            expect_alert_quiet=("slo_burn_fast", "slo_burn_slow",
+                                "drive_degrading"),
+            require_no_forensics=True),
+        watchdog=True,
+        env={"MT_WATCHDOG_ENABLE": "on",
+             "MT_WATCHDOG_INTERVAL": "1s"})
+
+
 # the elastic-topology mix: churn (delete + re-put) keeps minting
 # "new" names after preload, which is what lets the free-space router
 # actually spread writes onto a pool added mid-storm (an overwrite of
@@ -369,6 +490,15 @@ def run_scenario(scenario: Scenario, base_dir: str,
     assertion rows (never raises on an SLO miss — the rows carry
     pass/fail so the matrix completes)."""
     env_all = {**_SOAK_ENV, **scenario.env}
+    sink = None
+    if scenario.watchdog:
+        # the alert plane needs a LIVE egress endpoint before the
+        # server boots; the sink's port exists only now, so it joins
+        # the env here (started before the thread snapshot so its
+        # accept loop never reads as a scenario leak)
+        sink = _AlertSink().start()
+        env_all.setdefault("MT_ALERT_WEBHOOK_ENABLE", "on")
+        env_all.setdefault("MT_ALERT_WEBHOOK_ENDPOINT", sink.url)
     env_prev = {k: os.environ.get(k) for k in env_all}
     os.environ.update(env_all)
     threads_before = _slo.settled_thread_count(deadline_s=2.0)
@@ -430,6 +560,14 @@ def run_scenario(scenario: Scenario, base_dir: str,
                     mrf=cluster.mrf)
             except AssertionError as e:
                 conv_err = str(e)
+            # the watchdog verdict BEFORE the scrape: the summary
+            # polls for expected resolutions (the sampler keeps
+            # ticking until teardown), so the scrape then reflects
+            # the settled alert state
+            wdsum = None
+            if scenario.watchdog:
+                wdsum = _watchdog_summary(cluster, sink,
+                                          scenario.budget)
             scrape_text = _slo.scrape(cluster.endpoint)
             recorder = gen.recorder
             chaos_log = {"applied": conductor.applied,
@@ -446,7 +584,8 @@ def run_scenario(scenario: Scenario, base_dir: str,
             budget=scenario.budget, scrape_text=scrape_text,
             convergence=conv, convergence_error=conv_err,
             threads_before=threads_before, threads_after=threads_after,
-            leaked=leaked, forensics=forensics, topology=topology)
+            leaked=leaked, forensics=forensics, topology=topology,
+            watchdog=wdsum)
         if scenario.huge_put_bytes:
             rows.append({
                 "scenario": scenario.name,
@@ -476,6 +615,8 @@ def run_scenario(scenario: Scenario, base_dir: str,
         status.finish(rows)
         return rows
     finally:
+        if sink is not None:
+            sink.stop()
         for k, v in env_prev.items():
             if v is None:
                 os.environ.pop(k, None)
@@ -531,6 +672,154 @@ def _forensic_summary(cluster, expect_breach: bool = False) -> dict:
             out["breach_records_ok"] = False
             out["error"] = f"{type(e).__name__}: {e}"
     return out
+
+
+class _AlertSink:
+    """Minimal live HTTP endpoint for the ``alert_webhook`` egress
+    target: the watchdog scenarios assert alert events actually rode
+    the store-and-forward plane onto a real wire, not just an
+    in-process callback.  One JSON body per POST (the HTTPLogTarget
+    shape)."""
+
+    def __init__(self):
+        import http.server
+        sink = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                body = self.rfile.read(n)
+                try:
+                    sink.events.append(json.loads(body))
+                except ValueError:
+                    sink.events.append(
+                        {"raw": body.decode("utf-8", "replace")})
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, *args):
+                pass
+
+        self.events: list[dict] = []
+        self._srv = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), _Handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self._srv.server_address[1]}"
+
+    def start(self) -> "_AlertSink":
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True,
+            name="mt-soak-alert-sink")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        try:
+            self._srv.shutdown()
+            self._srv.server_close()
+        except Exception:  # noqa: BLE001 — teardown must finish
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+def _watchdog_summary(cluster, sink: _AlertSink, budget) -> dict:
+    """The watchdog plane's verdict for one finished scenario: rule
+    transition counts, first-firing/last-resolution timestamps, live
+    sink deliveries, and (for bridge scenarios) the newest forensic
+    bundle's ``history.json``.  Polls briefly for expected
+    resolutions — the sampler keeps ticking until teardown, and EWMA
+    decay / window drain need a few intervals to un-breach."""
+    wd = getattr(cluster.s3, "watchdog", None)
+    if wd is None:
+        return {"enabled": False}
+    want_resolved = tuple(budget.expect_alert_resolved)
+    deadline = time.monotonic() + 45.0
+    while want_resolved and time.monotonic() < deadline:
+        live = {a["rule"] for a in wd.alerts()["active"]
+                if a["state"] == "firing"}
+        if not any(r in live for r in want_resolved):
+            break
+        time.sleep(0.25)
+    # alert events ride the egress sender thread — give the queue a
+    # moment to drain into the sink
+    deadline = time.monotonic() + 10.0
+    while budget.expect_alert_fired and not sink.events and \
+            time.monotonic() < deadline:
+        time.sleep(0.1)
+    doc = wd.alerts()
+    fired: dict = {}
+    resolved: dict = {}
+    for (rule, to), n in dict(wd.transitions).items():
+        if to == "firing":
+            fired[rule] = fired.get(rule, 0) + n
+        elif to == "resolved":
+            resolved[rule] = resolved.get(rule, 0) + n
+    fired_at: dict = {}
+    resolved_at: dict = {}
+    for a in list(doc["active"]) + list(doc["recent"]):
+        rule = a["rule"]
+        at = a.get("firedAt")
+        if at is not None and at < fired_at.get(rule, float("inf")):
+            fired_at[rule] = at
+        if a.get("resolvedAt") is not None:
+            resolved_at[rule] = a["resolvedAt"]
+    burn_at = min((at for rule, at in fired_at.items()
+                   if rule.startswith("slo_burn")), default=None)
+    drive_at = fired_at.get("drive_degrading")
+    by_state: dict = {}
+    by_rule: dict = {}
+    for ev in list(sink.events):
+        st, rl = ev.get("state", "?"), ev.get("rule", "?")
+        by_state[st] = by_state.get(st, 0) + 1
+        by_rule[rl] = by_rule.get(rl, 0) + 1
+    out = {
+        "enabled": True,
+        "evals": sum(wd.evals.values()),
+        "interval_s": wd.sampler.interval_s,
+        "fired": fired, "resolved": resolved,
+        "fired_at": fired_at, "resolved_at": resolved_at,
+        "predictive": drive_at is not None and
+        (burn_at is None or drive_at < burn_at),
+        "delivered": len(sink.events),
+        "delivered_by_state": by_state,
+        "delivered_by_rule": by_rule,
+        "active": [(a["rule"], a["subject"], a["state"])
+                   for a in doc["active"]],
+        "history": wd.history.stats(),
+    }
+    if budget.require_history_bundle:
+        out["history_bundle"] = _history_bundle_check(cluster)
+    return out
+
+
+def _history_bundle_check(cluster) -> dict:
+    """Open the newest forensic bundle and read ``history.json`` —
+    the firing→forensic bridge's acceptance: the bundle carries the
+    sampled road to the breach, not just the instant."""
+    import zipfile as _zip
+    fx = getattr(cluster.s3, "forensic", None)
+    if fx is None:
+        return {"enabled": False, "error": "no forensic engine"}
+    fx.join(timeout=15.0)
+    bundles = fx.bundles()
+    if not bundles:
+        return {"enabled": False, "bundles": 0}
+    try:
+        with _zip.ZipFile(os.path.join(fx.dir,
+                                       bundles[-1]["name"])) as z:
+            doc = json.loads(z.read("history.json"))
+        return {"enabled": bool(doc.get("enabled")),
+                "bundles": len(bundles),
+                "bundle": bundles[-1]["name"],
+                "series": len(doc.get("series", []))}
+    except Exception as e:  # noqa: BLE001 — verdict rides the row
+        return {"enabled": False, "bundles": len(bundles),
+                "error": f"{type(e).__name__}: {e}"}
 
 
 def _topology_summary(cluster, wait_retire_s: float = 0.0) -> dict:

@@ -94,8 +94,26 @@ class Budget:
     # (mt_quorum_gating_total > 0 on the live scrape: every erasure
     # fan-out records which child decided the k-th completion) and the
     # commit micro-profiler saw drive ops.  Zero means the critical-path
-    # engine silently fell off the write path while tests stayed green.
+    # engine silently fell off the data path while tests stayed green.
     require_xray: bool = False
+    # SLO watchdog rows (ISSUE 18): scenarios run with the watchdog
+    # plane enabled assert the rule engine actually rode the storm —
+    # the sampler ticked, the mt_alert_*/mt_history_* families are on
+    # the live scrape, alert events reached the LIVE egress target
+    # (alert_webhook, an HTTP sink the runner hosts), and the named
+    # rules fired / stayed quiet / resolved as the timeline dictates
+    require_watchdog: bool = False
+    expect_alert_fired: tuple = ()
+    expect_alert_quiet: tuple = ()
+    expect_alert_resolved: tuple = ()
+    # drive_degrading must be PREDICTIVE: it fires while every SLO row
+    # still passes and before any slo_burn_* alert — degradation
+    # caught ahead of user-visible breach, the rule's whole point
+    require_predictive: bool = False
+    # firing→forensic bridge: a bundle landed for the watchdog rule
+    # and carries history.json with sampled series (the road to the
+    # breach, not just the instant)
+    require_history_bundle: bool = False
 
     def limits_for(self, api: str) -> tuple[float, float]:
         return self.per_api_ms.get(api, (self.p50_ms, self.p99_ms))
@@ -348,7 +366,8 @@ def evaluate(scenario: str, *, api_stats=None, api_pcts=None, recorder,
              threads_before: int = 0, threads_after: int = 0,
              leaked: list[str] | None = None,
              forensics: dict | None = None,
-             topology: dict | None = None) -> list[dict]:
+             topology: dict | None = None,
+             watchdog: dict | None = None) -> list[dict]:
     """Every SLO assertion for one finished scenario, as
     ``{scenario, metric, value, unit, detail, passed}`` rows (the
     SOAK_r*.json shape).
@@ -466,6 +485,54 @@ def evaluate(scenario: str, *, api_stats=None, api_pcts=None, recorder,
         ops = metric_total(scrape_text, "mt_drive_op_seconds_count")
         row("xray_drive_ops_profiled", ops, "ops", ops > 0,
             {"family": "mt_drive_op_seconds"})
+
+    # SLO watchdog rows: report.py runs the scenario with the plane
+    # enabled (env), hosts a live alert_webhook sink, and passes the
+    # engine's verdict through ``watchdog`` (_watchdog_summary)
+    if budget.require_watchdog:
+        w = watchdog or {}
+        fired = w.get("fired", {})
+        resolved_counts = w.get("resolved", {})
+        ticks = w.get("evals", 0)
+        row("watchdog_ticks", ticks, "evals", ticks > 0,
+            {"interval_s": w.get("interval_s"),
+             "history": w.get("history", {})})
+        fams = "# TYPE mt_alert_" in scrape_text and \
+            "# TYPE mt_history_" in scrape_text
+        row("watchdog_families_exposed", 1 if fams else 0, "bool",
+            fams, {"families": "mt_alert_*, mt_history_*"})
+        if budget.expect_alert_fired:
+            # a firing alert must actually ride the live egress target
+            # (the runner's alert_webhook HTTP sink), not just flip
+            # in-process state
+            delivered = w.get("delivered", 0)
+            row("alert_delivered", delivered, "events", delivered > 0,
+                {"target": "alert_webhook (live HTTP sink)",
+                 "by_state": w.get("delivered_by_state", {}),
+                 "by_rule": w.get("delivered_by_rule", {})})
+        for rule in budget.expect_alert_fired:
+            n = fired.get(rule, 0)
+            row(f"alert_fired:{rule}", n, "firings", n > 0,
+                {"fired_at": w.get("fired_at", {}).get(rule)})
+        for rule in budget.expect_alert_quiet:
+            n = fired.get(rule, 0)
+            row(f"alert_quiet:{rule}", n, "firings", n == 0,
+                {"require": "never fired"})
+        for rule in budget.expect_alert_resolved:
+            n = resolved_counts.get(rule, 0)
+            row(f"alert_resolved:{rule}", n, "resolutions", n > 0,
+                {"resolved_at": w.get("resolved_at", {}).get(rule)})
+        if budget.require_predictive:
+            ok = bool(w.get("predictive"))
+            row("watchdog_predictive", 1 if ok else 0, "bool", ok,
+                {"contract": "drive_degrading fired before any "
+                             "slo_burn_* alert (or none fired at all)",
+                 "fired_at": w.get("fired_at", {})})
+        if budget.require_history_bundle:
+            hb = w.get("history_bundle") or {}
+            n = hb.get("series", 0)
+            row("history_in_bundle", n, "series",
+                hb.get("enabled", False) and n > 0, hb)
 
     # forensic-plane rows: clean scenarios must produce ZERO bundles
     # (ordinary chaos is not a breach); the induced-breach drill must

@@ -223,6 +223,46 @@ register_subsys("forensic", {
     "shed_burst": "50",
     "backlog_growth": "500",
 })
+register_subsys("watchdog", {
+    # SLO watchdog plane (obs/history.py sampler + obs/watchdog.py
+    # rules): ``enable=on`` starts the mt-obs-history sampler, which
+    # snapshots selected ``mt_*`` families into bounded multi-
+    # resolution rings every ``interval`` and evaluates the rule
+    # catalog (obs/watchdog.py RULE_NAMES) each tick.  ``rules`` is a
+    # csv subset of the catalog (empty = all); the burn-rate pair
+    # fires when the observed error rate burns the ``slo_objective``
+    # budget ``burn_{fast,slow}_factor`` times too fast over the
+    # matching window; ``drift_z`` is the robust (EWMA + MAD) z-score
+    # at which a drive raises drive_degrading.  An alert needs
+    # ``pending_for`` consecutive breached evaluations to fire and a
+    # re-fire of the same alert is suppressed for ``cooldown`` after
+    # it resolves.  ``forensic_rules`` names rules whose firing also
+    # invokes the forensic trigger engine (rule name as trigger);
+    # ``families`` adds extra sampled family prefixes beyond the
+    # built-in selection.  Live-reloadable
+    # (S3Server.reload_watchdog_config on admin SetConfigKV; a reload
+    # rebuilds the plane, so history rings reset).
+    "enable": "off",
+    "interval": "10s",
+    "families": "",
+    "rules": "",
+    "slo_objective": "0.01",
+    "burn_fast_window": "5m",
+    "burn_slow_window": "1h",
+    "burn_fast_factor": "14",
+    "burn_slow_factor": "6",
+    "burn_min_rps": "1",
+    "drift_z": "3.5",
+    "drift_alpha": "0.3",
+    "drift_floor": "1ms",
+    "flap_threshold": "6",
+    "deadletter_growth": "10",
+    "stall_window": "5m",
+    "days_to_full": "7",
+    "pending_for": "2",
+    "cooldown": "5m",
+    "forensic_rules": "",
+})
 register_subsys("storage_class", {  # mt-lint: ok(kvconfig-drift) read per PUT (handlers_object.py) — validated at SetConfigKV time, applies to the next request
     "standard": "",                 # e.g. EC:4
     "rrs": "EC:2",
@@ -286,6 +326,13 @@ register_subsys("logger_webhook", {"enable": "off", "endpoint": "",
                                    "queue_size": "10000",
                                    "queue_dir": ""})
 register_subsys("audit_webhook", {"enable": "off", "endpoint": "",
+                                  "auth_token": "",
+                                  "queue_size": "10000",
+                                  "queue_dir": ""})
+# watchdog alert delivery (obs/watchdog.py): firing/resolved alert
+# events ride the same store-and-forward egress engine as the
+# log/audit webhooks — bounded queue, optional disk store, replay
+register_subsys("alert_webhook", {"enable": "off", "endpoint": "",
                                   "auth_token": "",
                                   "queue_size": "10000",
                                   "queue_dir": ""})

@@ -293,6 +293,38 @@ def test_obs_docs_drift_canary(tmp_path):
     assert "obs-docs-drift" not in _rules_hit(clean), clean
 
 
+def test_obs_docs_drift_watchdog_canary(tmp_path):
+    """The watchdog extension of the drift rule: RULE_NAMES catalog
+    entries and mt_alert_*/mt_history_* family literals (including the
+    ``# TYPE`` declaration form scrapes emit through f-strings) must
+    be documented like stage names."""
+    src = {"obs/w.py": '''
+        RULE_NAMES = (
+            "bogus_rule_x",
+            "bogus_rule_y",
+        )
+
+        def scrape(n):
+            lines = ["# TYPE mt_alert_bogus_total counter"]
+            lines.append(f"mt_history_bogus_series {n}")
+            return lines
+        '''}
+    bad = _lint(tmp_path, src,
+                docs={"observability.md": "# obs\nnothing here\n"})
+    msgs = [f.message for f in bad if f.rule == "obs-docs-drift"]
+    assert any("watchdog rule" in m and "bogus_rule_x" in m
+               for m in msgs), bad
+    assert any("bogus_rule_y" in m for m in msgs), msgs
+    assert any("mt_alert_bogus_total" in m for m in msgs), msgs
+    assert any("mt_history_bogus_series" in m for m in msgs), msgs
+    clean = _lint(tmp_path, src, docs={"observability.md":
+                                       "| `bogus_rule_x` | doc |\n"
+                                       "| `bogus_rule_y` | doc |\n"
+                                       "`mt_alert_bogus_total`\n"
+                                       "`mt_history_bogus_series`\n"})
+    assert "obs-docs-drift" not in _rules_hit(clean), clean
+
+
 def test_tls_discipline_canary(tmp_path):
     bad = _lint(tmp_path, {"m.py": """
         import ssl

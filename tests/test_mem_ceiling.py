@@ -106,8 +106,11 @@ def test_listing_100k_keys_is_o_block(tmp_path):
     d.mkdir()
     disk = XLStorage(str(d))
     disk.make_vol(".minio-tpu.sys")
+    # ttl pinned high: the 100k build under tracemalloc can take longer
+    # than DEFAULT_TTL on a loaded machine, and an expired manifest
+    # makes the cold-manager check below legitimately re-walk
     mgr = MetacacheManager(disks=[disk], sys_volume=".minio-tpu.sys",
-                           block_entries=1000, cache_blocks=4)
+                           block_entries=1000, cache_blocks=4, ttl=300.0)
     n = 100_000
 
     def loader():
@@ -142,7 +145,7 @@ def test_listing_100k_keys_is_o_block(tmp_path):
 
     # a cold manager over the same drive serves from persisted blocks
     mgr2 = MetacacheManager(disks=[disk], sys_volume=".minio-tpu.sys",
-                            block_entries=1000, cache_blocks=4)
+                            block_entries=1000, cache_blocks=4, ttl=300.0)
     snap2 = mgr2.list_path_stream(
         "big", "", lambda: (_ for _ in ()).throw(
             AssertionError("cold lookup must not re-walk")))

@@ -273,6 +273,77 @@ def test_smoke_scenario_meets_slo_and_converges(tmp_path):
                           "detail", "passed"}
 
 
+def test_watchdog_smoke_scenario_quiet_and_exposed(tmp_path):
+    """The tier-1 watchdog miniature (ISSUE 18): a healthy cluster
+    with the plane ENABLED — the mt-obs-history sampler ticks, the
+    mt_alert_*/mt_history_* families are on the live scrape, every
+    rule stays quiet (the false-positive contract), and the standard
+    SLO rows still pass with the watchdog riding the scrape path."""
+    sc = soak_report.watchdog_smoke_scenario(duration_s=4.0)
+    rows = soak_report.run_scenario(sc, str(tmp_path / "wdsoak"))
+    by_metric = {r["metric"]: r for r in rows}
+    failed = [r for r in rows if not r["passed"]]
+    assert not failed, failed
+    assert by_metric["watchdog_ticks"]["value"] > 0
+    assert by_metric["watchdog_families_exposed"]["value"] == 1
+    # the history rings actually sampled series out of the scrape
+    assert by_metric["watchdog_ticks"]["detail"]["history"][
+        "series"] > 0
+    for rule in ("slo_burn_fast", "slo_burn_slow", "drive_degrading"):
+        assert by_metric[f"alert_quiet:{rule}"]["value"] == 0
+    assert by_metric["forensic_bundles"]["value"] == 0
+
+
+@pytest.mark.slow    # ~80s: drive-latency ramp + EWMA decay window
+def test_watchdog_storm_predicts_drive_degradation(tmp_path):
+    """ISSUE 18 acceptance: the SlowDisk latency ramp mid-storm.
+    ``drive_degrading`` fires while every latency/error SLO row still
+    passes and before any slo_burn alert exists (prediction, not
+    post-mortem), the firing event rides the LIVE alert_webhook sink,
+    and after ``drive_fast`` heals the drive the alert resolves."""
+    sc = soak_report.watchdog_storm_scenario()
+    rows = soak_report.run_scenario(sc, str(tmp_path / "wdstorm"))
+    by_metric = {r["metric"]: r for r in rows}
+    failed = [r for r in rows if not r["passed"]]
+    assert not failed, failed
+    assert by_metric["alert_fired:drive_degrading"]["value"] > 0
+    assert by_metric["alert_resolved:drive_degrading"]["value"] > 0
+    assert by_metric["alert_quiet:slo_burn_fast"]["value"] == 0
+    assert by_metric["alert_quiet:slo_burn_slow"]["value"] == 0
+    assert by_metric["watchdog_predictive"]["value"] == 1
+    # the alert actually crossed the wire to the live sink
+    dl = by_metric["alert_delivered"]
+    assert dl["value"] > 0
+    assert dl["detail"]["by_rule"].get("drive_degrading", 0) > 0
+    # prediction without breach: zero forensic bundles
+    assert by_metric["forensic_bundles"]["value"] == 0
+
+
+@pytest.mark.slow    # ~150s: the slow burn window needs a real clean
+# phase for its dilution — the whole point of the multi-window split
+def test_burn_drill_fast_fires_slow_quiet(tmp_path):
+    """ISSUE 18 acceptance: the burn-rate drill.  A majority-5xx
+    outage near the end of a long clean run — slo_burn_fast (10s
+    window) fires and resolves after the heal, slo_burn_slow (whole-
+    scenario window) stays quiet, the alert rides the live egress
+    sink, and the firing→forensic bridge lands a bundle carrying
+    history.json with the sampled road to the breach."""
+    sc = soak_report.burn_drill_scenario()
+    rows = soak_report.run_scenario(sc, str(tmp_path / "burndrill"))
+    by_metric = {r["metric"]: r for r in rows}
+    failed = [r for r in rows if not r["passed"]]
+    assert not failed, failed
+    assert by_metric["alert_fired:slo_burn_fast"]["value"] > 0
+    assert by_metric["alert_quiet:slo_burn_slow"]["value"] == 0
+    assert by_metric["alert_resolved:slo_burn_fast"]["value"] > 0
+    dl = by_metric["alert_delivered"]
+    assert dl["value"] > 0
+    assert dl["detail"]["by_rule"].get("slo_burn_fast", 0) > 0
+    hb = by_metric["history_in_bundle"]
+    assert hb["value"] > 0, hb
+    assert hb["detail"]["enabled"] is True
+
+
 def test_soak_status_admin_route(tmp_path):
     """The admin plane surfaces a live soak run (and null when idle)."""
     from minio_tpu.admin.client import AdminClient

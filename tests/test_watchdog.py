@@ -1,0 +1,761 @@
+"""SLO watchdog plane: telemetry history rings, the rule engine, the
+alert state machine, and the federated ``metrics-history`` route.
+
+The unit tier drives everything with injected clocks and seeded
+series — no sleeps, no threads (``HistorySampler.tick`` /
+``WatchdogSys.evaluate`` are called directly with explicit ``now_s``).
+The federated tier reuses the 2-node peer-RPC pattern from
+test_cluster_obs and the strict exposition checker from
+test_metrics_exposition.
+"""
+
+import threading
+
+import pytest
+
+from minio_tpu.obs.history import (DEFAULT_FAMILIES, HistorySampler,
+                                   TelemetryHistory, render_history,
+                                   select_samples, snapshot_dict)
+from minio_tpu.obs.lastminute import OpWindows, Window
+from minio_tpu.obs.watchdog import RULE_NAMES, WatchdogSys
+
+from tests.test_cluster_obs import _scrape, duo  # noqa: F401 (fixture)
+from tests.test_metrics_exposition import parse_exposition
+
+T0 = 1_700_000_000.0      # a fixed epoch anchor; nothing sleeps
+
+
+# -- history rings ---------------------------------------------------------
+
+SCRAPE_DOC = """\
+# TYPE mt_s3_requests_api_total counter
+mt_s3_requests_api_total{api="GetObject"} 120
+# TYPE mt_mem_inuse_bytes gauge
+mt_mem_inuse_bytes 4096
+# TYPE mt_s3_ttfb_seconds histogram
+mt_s3_ttfb_seconds_bucket{api="GetObject",le="+Inf"} 120
+mt_s3_ttfb_seconds_count{api="GetObject"} 120
+mt_s3_ttfb_seconds_sum{api="GetObject"} 1.5
+# TYPE mt_unrelated_total counter
+mt_unrelated_total 7
+"""
+
+
+def test_select_samples_filters_families_and_skips_histograms():
+    out = select_samples(SCRAPE_DOC, ("mt_s3_", "mt_mem_"))
+    assert out[("mt_s3_requests_api_total", 'api="GetObject"')] \
+        == (120.0, "counter")
+    assert out[("mt_mem_inuse_bytes", "")] == (4096.0, "gauge")
+    # histogram families never enter the rings (the lastminute gauges
+    # carry the percentiles worth remembering)
+    assert not any(k[0].startswith("mt_s3_ttfb") for k in out)
+    # and non-selected families are dropped
+    assert not any(k[0] == "mt_unrelated_total" for k in out)
+
+
+def test_counter_becomes_rate_and_needs_two_ticks():
+    h = TelemetryHistory()
+    key = ("mt_s3_requests_api_total", 'api="PutObject"')
+    h.observe(T0, {key: (100.0, "counter")})
+    # the first observation only baselines: no series yet
+    assert h.query(now_s=T0) == {}
+    h.observe(T0 + 10, {key: (150.0, "counter")})
+    pts = h.query(family="mt_s3_requests_api_total", window_s=60,
+                  step_s=10, now_s=T0 + 10)[key]
+    assert [v for _, v in pts] == [5.0]      # (150-100)/10s
+
+
+def test_counter_reset_clamps_to_zero_rate():
+    h = TelemetryHistory()
+    key = ("mt_s3_requests_api_total", "")
+    h.observe(T0, {key: (1000.0, "counter")})
+    h.observe(T0 + 10, {key: (5.0, "counter")})   # restarted source
+    pts = h.query(window_s=60, step_s=10, now_s=T0 + 10)[key]
+    assert [v for _, v in pts] == [0.0]
+    # and the new baseline works from here
+    h.observe(T0 + 20, {key: (25.0, "counter")})
+    pts = h.query(window_s=60, step_s=10, now_s=T0 + 20)[key]
+    assert [v for _, v in pts] == [0.0, 2.0]
+
+
+def test_gauge_aggregations_within_bucket():
+    h = TelemetryHistory()
+    key = ("mt_mem_inuse_bytes", "")
+    base = (int(T0) // 60) * 60.0      # align to one 60s bucket
+    for i, v in enumerate([10.0, 50.0, 30.0]):
+        h.observe(base + i * 10, {key: (v, "gauge")})
+    q = base + 29
+    for agg, want in [("last", 30.0), ("min", 10.0), ("max", 50.0),
+                      ("avg", 30.0)]:
+        pts = h.query(window_s=120, step_s=60, agg=agg, now_s=q)[key]
+        assert [v for _, v in pts] == [want], agg
+
+
+def test_resolution_picking_prefers_finest_covering_ring():
+    h = TelemetryHistory()        # rings: 10s×36, 60s×120, 600s×144
+    assert h._pick_resolution(300, 1) == 0     # 10s ring covers 360s
+    assert h._pick_resolution(3600, 1) == 1    # 60s ring covers 2h
+    assert h._pick_resolution(86400, 600) == 2
+    assert h._pick_resolution(10 ** 9, 1) == 2   # falls to coarsest
+
+
+def test_max_series_cap_drops_new_series_not_the_store():
+    h = TelemetryHistory(max_series=2)
+    h.observe(T0, {("mt_a", ""): (1.0, "gauge"),
+                   ("mt_b", ""): (2.0, "gauge")})
+    h.observe(T0 + 10, {("mt_c", ""): (3.0, "gauge")})
+    assert h.series_count() == 2
+    assert h.stats()["droppedSeries"] == 1
+
+
+def test_render_history_is_strict_exposition_with_ts_labels():
+    h = TelemetryHistory()
+    key = ("mt_mem_inuse_bytes", 'server="n1"')
+    for i in range(5):
+        h.observe(T0 + i * 60, {key: (float(i), "gauge")})
+    text = render_history(h, window_s=600, step_s=60,
+                          now_s=T0 + 4 * 60)
+    types, samples = parse_exposition(text)
+    assert types == {"mt_mem_inuse_bytes": "gauge"}
+    assert len(samples) == 5
+    # every point carries its bucket epoch as a ts label and keeps the
+    # original labels intact
+    for name, labels, _ in samples:
+        assert name == "mt_mem_inuse_bytes"
+        assert labels["server"] == "n1"
+        assert float(labels["ts"]) >= T0 - 60
+
+
+def test_snapshot_dict_shapes():
+    assert snapshot_dict(None) == {"enabled": False, "series": []}
+    h = TelemetryHistory()
+    h.observe(T0, {("mt_mem_inuse_bytes", ""): (7.0, "gauge")})
+    snap = snapshot_dict(h, now_s=T0)
+    assert snap["enabled"] is True
+    assert snap["series"] == [{"family": "mt_mem_inuse_bytes",
+                               "labels": "",
+                               "points": [[(int(T0) // 60) * 60, 7.0]]}]
+    assert snap["stats"]["series"] == 1
+
+
+def test_sampler_tick_is_deterministic_and_threadless():
+    docs = iter([SCRAPE_DOC,
+                 SCRAPE_DOC.replace(" 120", " 180", 1)])
+    h = TelemetryHistory()
+    ticks = []
+    s = HistorySampler(lambda: next(docs), h, interval_s=10,
+                       families=("mt_s3_", "mt_mem_"),
+                       clock=lambda: T0)
+    s.listeners.append(ticks.append)
+    s.tick(T0)
+    s.tick(T0 + 10)
+    assert ticks == [T0, T0 + 10]
+    assert s._thread is None      # never started a thread
+    key = ("mt_s3_requests_api_total", 'api="GetObject"')
+    pts = h.query(family="mt_s3_requests_api_total", window_s=60,
+                  step_s=10, now_s=T0 + 10)[key]
+    assert [v for _, v in pts] == [6.0]       # (180-120)/10
+
+
+def test_sampler_survives_collector_and_listener_failures():
+    h = TelemetryHistory()
+    s = HistorySampler(lambda: 1 / 0, h, clock=lambda: T0)
+    s.listeners.append(lambda now: 1 / 0)
+    s.tick(T0)      # must not raise
+    assert h.series_count() == 0
+
+
+# -- burn-rate rules -------------------------------------------------------
+
+def _seed_burn(h, clean_s=3600, burst_s=300, clean_err=1.0,
+               burst_err=50.0):
+    """One hour of 10 rps traffic at the SLO objective (1% 5xx), then
+    a ``burst_s`` tail where errors jump to ``burst_err`` per 10s
+    sample.  Returns the evaluation timestamp."""
+    tot = err = 0.0
+    n = clean_s // 10
+    for i in range(n + 1):
+        now = T0 + i * 10
+        tot += 100.0
+        err += burst_err if i > n - burst_s // 10 else clean_err
+        h.observe(now, {
+            ("mt_s3_requests_api_total", 'api="GetObject"'):
+                (tot, "counter"),
+            ("mt_s3_requests_errors_total",
+             'api="GetObject",status="503"'): (err, "counter"),
+        })
+    return T0 + clean_s
+
+
+def test_burn_fast_fires_slow_stays_quiet_on_a_burst():
+    """The burst_503 drill: a 5-minute 50% error burst burns the fast
+    window (burn 50 >= 14) while the 1h window still averages under
+    the slow factor — exactly the page-vs-ticket split multi-window
+    burn alerting exists for."""
+    h = TelemetryHistory()
+    now = _seed_burn(h)
+    wd = WatchdogSys(history=h, rules=("slo_burn_fast",
+                                       "slo_burn_slow"),
+                     pending_for=1, clock=lambda: now)
+    trans = wd.evaluate(now)
+    assert ("slo_burn_fast", "GetObject", "firing") in trans
+    assert not any(r == "slo_burn_slow" for r, _, _ in trans)
+    [alert] = wd.alerts()["active"]
+    assert alert["rule"] == "slo_burn_fast"
+    assert alert["detail"]["burnRate"] >= 14
+    assert alert["detail"]["threshold"] == 14.0
+
+
+def test_burn_slow_fires_on_a_sustained_simmer():
+    h = TelemetryHistory()
+    # a full hour at 8% errors: burn 8 clears the slow factor (6) but
+    # never the fast one (14) — the ticket-not-page quadrant
+    now = _seed_burn(h, clean_err=8.0, burst_err=8.0)
+    wd = WatchdogSys(history=h, rules=("slo_burn_fast",
+                                       "slo_burn_slow"),
+                     pending_for=1, clock=lambda: now)
+    trans = wd.evaluate(now)
+    assert ("slo_burn_slow", "GetObject", "firing") in trans
+    assert not any(r == "slo_burn_fast" for r, _, _ in trans)
+
+
+def test_burn_skips_low_traffic_apis():
+    h = TelemetryHistory()
+    tot = err = 0.0
+    for i in range(31):
+        now = T0 + i * 10
+        tot += 1.0        # 0.1 rps < burn_min_rps
+        err += 1.0
+        h.observe(now, {
+            ("mt_s3_requests_api_total", 'api="GetObject"'):
+                (tot, "counter"),
+            ("mt_s3_requests_errors_total",
+             'api="GetObject",status="503"'): (err, "counter"),
+        })
+    wd = WatchdogSys(history=h, rules=("slo_burn_fast",),
+                     pending_for=1, clock=lambda: T0 + 300)
+    assert wd.evaluate(T0 + 300) == []
+
+
+def test_burn_ignores_4xx_errors():
+    h = TelemetryHistory()
+    tot = err = 0.0
+    for i in range(31):
+        now = T0 + i * 10
+        tot += 100.0
+        err += 50.0
+        h.observe(now, {
+            ("mt_s3_requests_api_total", 'api="GetObject"'):
+                (tot, "counter"),
+            ("mt_s3_requests_errors_total",
+             'api="GetObject",status="404"'): (err, "counter"),
+        })
+    wd = WatchdogSys(history=h, rules=("slo_burn_fast",),
+                     pending_for=1, clock=lambda: T0 + 300)
+    assert wd.evaluate(T0 + 300) == []
+
+
+def test_burn_newborn_error_series_diluted_by_clean_history():
+    """A 5xx counter (and so its history series) is only BORN at the
+    first error — a breach late in a long clean run leaves the error
+    series with nothing but hot points.  The burn ratio is window
+    error MASS over request MASS, so the pre-birth clean phase counts
+    as zero errors: the fast window (mostly breach) fires while the
+    slow window (mostly clean) stays quiet.  A mean over the newborn
+    series' own support would read ~50% for both and page twice."""
+    h = TelemetryHistory()
+    tot = err = 0.0
+    n = 360                        # 1h of 10s ticks at 10 rps
+    for i in range(n + 1):
+        now = T0 + i * 10
+        tot += 100.0
+        samples = {("mt_s3_requests_api_total", 'api="GetObject"'):
+                   (tot, "counter")}
+        if i > n - 15:             # last 150s: 50% 5xx, counter born
+            err += 50.0
+            samples[("mt_s3_requests_errors_total",
+                     'api="GetObject",status="503"')] = \
+                (err, "counter")
+        h.observe(now, samples)
+    wd = WatchdogSys(history=h, rules=("slo_burn_fast",
+                                       "slo_burn_slow"),
+                     pending_for=1, clock=lambda: T0 + n * 10)
+    trans = wd.evaluate(T0 + n * 10)
+    assert ("slo_burn_fast", "GetObject", "firing") in trans
+    assert not any(r == "slo_burn_slow" for r, _, _ in trans)
+    [alert] = wd.alerts()["active"]
+    # the true window error fraction, not the hot-points-only mean
+    assert alert["detail"]["errorRate"] < 0.3
+
+
+# -- drive drift -----------------------------------------------------------
+
+def _seed_drives(h, now, lat):
+    h.observe(now, {("mt_node_disk_latency_p50_ns", f'drive="{d}"'):
+                    (float(v), "gauge") for d, v in lat.items()})
+
+
+def test_drive_drift_fires_before_slow_and_escalates_then_resolves():
+    h = TelemetryHistory()
+    escalated = []
+    wd = WatchdogSys(history=h, rules=("drive_degrading",),
+                     pending_for=2, escalate_fn=escalated.append,
+                     clock=lambda: T0)
+    lat = {"d0": 5e6, "d1": 5.2e6, "d2": 4.9e6, "d3": 5.1e6}
+    now = T0
+    for _ in range(3):        # healthy population: quiet
+        _seed_drives(h, now, lat)
+        assert wd.evaluate(now) == []
+        now += 10
+    lat["d2"] = 100e6         # d2 starts dragging
+    fired_at = None
+    for _ in range(6):
+        _seed_drives(h, now, lat)
+        trans = wd.evaluate(now)
+        if ("drive_degrading", "d2", "firing") in trans:
+            fired_at = now
+            break
+        now += 10
+    assert fired_at is not None, "drift never fired"
+    assert escalated == ["d2"]          # bitrotscan escalation
+    [alert] = wd.alerts()["active"]
+    assert alert["subject"] == "d2"
+    assert alert["detail"]["z"] >= 3.5
+    # heal: d2 returns to the population; the EWMA decays and the
+    # alert resolves (no flapping on the way down)
+    lat["d2"] = 5e6
+    resolved = False
+    for _ in range(30):
+        now += 10
+        _seed_drives(h, now, lat)
+        if ("drive_degrading", "d2", "resolved") in wd.evaluate(now):
+            resolved = True
+            break
+    assert resolved
+    assert wd.alerts()["active"] == []
+    assert wd.alerts()["recent"][-1]["rule"] == "drive_degrading"
+
+
+def test_drive_drift_needs_three_drives():
+    h = TelemetryHistory()
+    wd = WatchdogSys(history=h, rules=("drive_degrading",),
+                     pending_for=1, clock=lambda: T0)
+    now = T0
+    for _ in range(4):
+        _seed_drives(h, now, {"d0": 5e6, "d1": 500e6})
+        assert wd.evaluate(now) == []
+        now += 10
+
+
+def test_drive_drift_only_flags_the_slow_side():
+    h = TelemetryHistory()
+    wd = WatchdogSys(history=h, rules=("drive_degrading",),
+                     pending_for=1, clock=lambda: T0)
+    now = T0
+    for _ in range(6):       # one FAST outlier must not alert
+        _seed_drives(h, now, {"d0": 50e6, "d1": 51e6, "d2": 49e6,
+                              "d3": 1e6})
+        assert wd.evaluate(now) == []
+        now += 10
+
+
+# -- the other rules -------------------------------------------------------
+
+def test_breaker_flapping_rule():
+    h = TelemetryHistory()
+    opens = 0.0
+    for i in range(31):
+        opens += 1.0          # one open per 10s = 30 over the window
+        h.observe(T0 + i * 10, {("mt_rpc_breaker_opens_total", ""):
+                                (opens, "counter")})
+    wd = WatchdogSys(history=h, rules=("breaker_flapping",),
+                     pending_for=1, clock=lambda: T0 + 300)
+    trans = wd.evaluate(T0 + 300)
+    assert ("breaker_flapping", "", "firing") in trans
+    [alert] = wd.alerts()["active"]
+    assert alert["detail"]["opens"] >= 6
+
+
+def test_deadletter_growth_rule_is_per_target():
+    h = TelemetryHistory()
+    dead = 0.0
+    for i in range(31):
+        dead += 1.0
+        h.observe(T0 + i * 10, {
+            ("mt_target_dead_letter_total", 'target="hook1"'):
+                (dead, "counter"),
+            ("mt_target_dead_letter_total", 'target="hook2"'):
+                (0.0, "counter"),
+        })
+    wd = WatchdogSys(history=h, rules=("deadletter_growth",),
+                     pending_for=1, clock=lambda: T0 + 300)
+    trans = wd.evaluate(T0 + 300)
+    assert ("deadletter_growth", "hook1", "firing") in trans
+    assert not any(s == "hook2" for _, s, _ in trans)
+
+
+def test_rebalance_stall_rule():
+    h = TelemetryHistory()
+    moved = 0.0
+    for i in range(31):
+        h.observe(T0 + i * 10, {
+            ("mt_rebalance_cycle_active", ""): (1.0, "gauge"),
+            ("mt_rebalance_moved_bytes_total", ""):
+                (moved, "counter"),    # flat: zero progress
+        })
+    wd = WatchdogSys(history=h, rules=("rebalance_stall",),
+                     pending_for=1, clock=lambda: T0 + 300)
+    assert ("rebalance_stall", "", "firing") in wd.evaluate(T0 + 300)
+    # a moving rebalance is healthy
+    h2 = TelemetryHistory()
+    moved = 0.0
+    for i in range(31):
+        moved += 1 << 20
+        h2.observe(T0 + i * 10, {
+            ("mt_rebalance_cycle_active", ""): (1.0, "gauge"),
+            ("mt_rebalance_moved_bytes_total", ""):
+                (moved, "counter"),
+        })
+    wd2 = WatchdogSys(history=h2, rules=("rebalance_stall",),
+                      pending_for=1, clock=lambda: T0 + 300)
+    assert wd2.evaluate(T0 + 300) == []
+
+
+def test_pool_days_to_full_rule():
+    h = TelemetryHistory()
+    cap = 100e9
+    for i in range(24):                       # 4h of 600s samples
+        now = T0 + i * 600
+        h.observe(now, {
+            ("mt_pool_usage_bytes", 'pool="0"'):
+                (40e9 + i * 2e8, "gauge"),     # ~28.8 GB/day
+            ("mt_cluster_capacity_raw_total_bytes", ""):
+                (cap, "gauge"),
+        })
+    now = T0 + 23 * 600
+    wd = WatchdogSys(history=h, rules=("pool_days_to_full",),
+                     pending_for=1, days_to_full=7.0,
+                     clock=lambda: now)
+    trans = wd.evaluate(now)
+    assert ("pool_days_to_full", "0", "firing") in trans
+    [alert] = wd.alerts()["active"]
+    assert 0 < alert["detail"]["daysToFull"] <= 7
+    # a flat pool never projects full
+    h2 = TelemetryHistory()
+    for i in range(24):
+        h2.observe(T0 + i * 600, {
+            ("mt_pool_usage_bytes", 'pool="0"'): (40e9, "gauge"),
+            ("mt_cluster_capacity_raw_total_bytes", ""):
+                (cap, "gauge"),
+        })
+    wd2 = WatchdogSys(history=h2, rules=("pool_days_to_full",),
+                      pending_for=1, clock=lambda: now)
+    assert wd2.evaluate(now) == []
+
+
+# -- alert state machine ---------------------------------------------------
+
+class _Target:
+    target_type = "alert"
+
+    def __init__(self):
+        self.events = []
+
+    def send(self, event):
+        self.events.append(event)
+
+
+BREACH = {("slo_burn_fast", "GetObject"): (20.0, {"burnRate": 20.0})}
+
+
+def test_pending_firing_resolved_lifecycle_with_delivery():
+    tgt = _Target()
+    forensics = []
+    wd = WatchdogSys(pending_for=2, cooldown_s=300.0,
+                     targets_fn=lambda: [tgt],
+                     forensic_fn=lambda rule, d: forensics.append(rule),
+                     forensic_rules=("slo_burn_fast",),
+                     node_name="n1", clock=lambda: T0)
+    # tick 1: breach -> pending (nothing delivered yet)
+    assert wd._apply(T0, BREACH) == [("slo_burn_fast", "GetObject",
+                                      "pending")]
+    assert tgt.events == []
+    # tick 2: still breached -> firing; the event rides the egress
+    # target and the forensic bridge fires the rule-named trigger
+    assert wd._apply(T0 + 10, BREACH) == [("slo_burn_fast",
+                                           "GetObject", "firing")]
+    assert [e["state"] for e in tgt.events] == ["firing"]
+    assert tgt.events[0]["rule"] == "slo_burn_fast"
+    assert tgt.events[0]["subject"] == "GetObject"
+    assert tgt.events[0]["node"] == "n1"
+    assert forensics == ["slo_burn_fast"]
+    # tick 3: breach clears -> resolved (delivered, kept in recent)
+    assert wd._apply(T0 + 20, {}) == [("slo_burn_fast", "GetObject",
+                                       "resolved")]
+    assert [e["state"] for e in tgt.events] == ["firing", "resolved"]
+    assert wd.alerts()["active"] == []
+    assert wd.alerts()["recent"][0]["state"] == "resolved"
+    # counters saw each transition once
+    assert wd.transitions == {("slo_burn_fast", "pending"): 1,
+                              ("slo_burn_fast", "firing"): 1,
+                              ("slo_burn_fast", "resolved"): 1}
+
+
+def test_cooldown_dedups_rebreach_then_allows_a_new_cycle():
+    wd = WatchdogSys(pending_for=1, cooldown_s=300.0,
+                     clock=lambda: T0)
+    assert wd._apply(T0, BREACH) == [
+        ("slo_burn_fast", "GetObject", "pending"),
+        ("slo_burn_fast", "GetObject", "firing")]     # pending_for=1
+    assert wd._apply(T0 + 10, {}) == [("slo_burn_fast", "GetObject",
+                                       "resolved")]
+    # re-breach INSIDE the cooldown: silent (no pending churn either)
+    assert wd._apply(T0 + 20, BREACH) == []
+    assert wd.alerts()["active"] == []
+    # past the cooldown a fresh cycle starts
+    trans = wd._apply(T0 + 400, BREACH)
+    assert ("slo_burn_fast", "GetObject", "firing") in trans
+
+
+def test_pending_that_unbreaches_evaporates_silently():
+    tgt = _Target()
+    wd = WatchdogSys(pending_for=3, targets_fn=lambda: [tgt],
+                     clock=lambda: T0)
+    wd._apply(T0, BREACH)
+    wd._apply(T0 + 10, BREACH)
+    assert wd._apply(T0 + 20, {}) == []       # never fired
+    assert tgt.events == []
+    assert wd.alerts()["recent"] == []
+
+
+def test_failing_delivery_target_never_breaks_evaluation():
+    class _Boom:
+        target_type = "alert"
+
+        def send(self, event):
+            raise RuntimeError("webhook down")
+
+    wd = WatchdogSys(pending_for=1, targets_fn=lambda: [_Boom()],
+                     clock=lambda: T0)
+    trans = wd._apply(T0, BREACH)             # must not raise
+    assert ("slo_burn_fast", "GetObject", "firing") in trans
+
+
+def test_unknown_rules_are_dropped_and_evals_counted():
+    wd = WatchdogSys(rules=("drive_degrading", "not_a_rule"),
+                     clock=lambda: T0)
+    assert wd.rules == ("drive_degrading",)
+    wd.evaluate(T0)
+    wd.evaluate(T0 + 10)
+    assert wd.evals == {"drive_degrading": 2}
+    st = wd.metrics_state()
+    assert st["evals"]["drive_degrading"] == 2
+    assert st["firing"] == []
+    assert st["history"]["series"] == 0
+
+
+def test_watchdog_metric_families_render():
+    from minio_tpu.admin.metrics import _watchdog_metrics
+    wd = WatchdogSys(pending_for=1, clock=lambda: T0)
+    wd.history.observe(T0, {("mt_mem_inuse_bytes", ""):
+                            (1.0, "gauge")})
+    wd.evaluate(T0)
+    wd._apply(T0 + 10, BREACH)
+    text = "\n".join(_watchdog_metrics(wd)) + "\n"
+    types, samples = parse_exposition(text)
+    assert types["mt_alert_firing"] == "gauge"
+    assert types["mt_alert_transitions_total"] == "counter"
+    assert types["mt_alert_evals_total"] == "counter"
+    assert types["mt_history_series"] == "gauge"
+    firing = [(labels["rule"], labels["subject"])
+              for n, labels, v in samples
+              if n == "mt_alert_firing" and v == 1]
+    assert firing == [("slo_burn_fast", "GetObject")]
+    assert [v for n, _, v in samples
+            if n == "mt_history_series"] == [1.0]
+
+
+def test_from_server_honors_the_idle_contract():
+    class _Cfg:
+        def __init__(self, kv):
+            self.kv = kv
+
+        def get(self, sub, key):
+            return self.kv.get((sub, key), "")
+
+    class _Srv:
+        node_name = "n1"
+
+        def __init__(self, kv):
+            self.config = _Cfg(kv)
+
+    assert WatchdogSys.from_server(_Srv({})) is None
+    assert WatchdogSys.from_server(
+        _Srv({("watchdog", "enable"): "off"})) is None
+    # a bad knob must degrade to disabled, never raise
+    assert WatchdogSys.from_server(
+        _Srv({("watchdog", "enable"): "on",
+              ("watchdog", "slo_objective"): "bogus"})) is None
+
+
+# -- p99 satellites --------------------------------------------------------
+
+def test_window_p99_tracks_the_tail():
+    w = Window()
+    now = T0
+    for v in [10] * 50 + [1000]:
+        w.record(v, now_s=now)
+    assert w.p50(now_s=now) == 10
+    assert w.p99(now_s=now) == 1000
+    assert Window().p99(now_s=now) == 0       # idle reads 0
+
+
+def test_opwindows_p99_all_pools_every_op():
+    ow = OpWindows("drive")
+    now = T0
+    for v in [10] * 30:
+        ow.record("ReadFile", v, now_s=now)
+    for v in [10] * 28 + [5000, 5000]:    # 2/60 > the p99 rank
+        ow.record("WriteAll", v, now_s=now)
+    assert ow.p99_all(now_s=now) == 5000
+
+
+# -- heal escalation hook --------------------------------------------------
+
+def test_request_deep_escalates_exactly_one_sweep():
+    from types import SimpleNamespace
+
+    from minio_tpu.background.heal import BackgroundHealer
+
+    class _Layer:
+        def __init__(self):
+            self.deep_calls = []
+
+        def list_buckets(self):
+            return [SimpleNamespace(name="bkt")]
+
+        def list_objects(self, bucket, marker="", max_keys=1000):
+            return SimpleNamespace(
+                objects=[SimpleNamespace(name="o", size=1)],
+                is_truncated=False, next_marker="")
+
+        def heal_object(self, bucket, obj, deep=False):
+            self.deep_calls.append(deep)
+            return None
+
+    layer = _Layer()
+    healer = BackgroundHealer(layer, deep_every=0)   # never deep
+    healer.sweep()
+    healer.request_deep("d2")                        # watchdog escalation
+    healer.sweep()
+    healer.sweep()                                   # flag is one-shot
+    assert layer.deep_calls == [False, True, False]
+
+
+# -- federated metrics-history over real peer RPC --------------------------
+
+def _enable_watchdog(srv):
+    srv.config.set("watchdog", "enable", "on")
+    srv.config.set("watchdog", "interval", "1h")   # ticks are manual
+    srv.reload_watchdog_config()
+    assert srv.watchdog is not None
+    srv.watchdog.start()
+
+
+def _tick_twice(srv):
+    import time
+    t = time.time()
+    srv.watchdog.sampler.tick(t - 20)
+    srv.watchdog.sampler.tick(t - 10)
+
+
+def test_federated_metrics_history_and_alerts(duo):
+    from minio_tpu.admin.client import AdminClient
+    from minio_tpu.s3.client import S3Client
+
+    node_a, node_b, rpc_b = duo
+    adm = AdminClient(node_a.endpoint, "ck", "cs")
+
+    # idle contract first: watchdog off means no history thread and no
+    # mt_alert_*/mt_history_* family in the scrape
+    assert node_a.watchdog is None
+    assert not any(t.name == "mt-obs-history"
+                   for t in threading.enumerate())
+    text = _scrape(node_a)
+    assert "mt_alert_" not in text and "mt_history_" not in text
+    assert adm.metrics_history().strip().splitlines()[0] \
+        == "# TYPE mt_node_history_ok gauge"   # empty but well-formed
+
+    c = S3Client(node_a.endpoint, "ck", "cs")
+    c.make_bucket("wdbkt")
+    c.put_object("wdbkt", "obj", b"w" * (1 << 16))
+    _enable_watchdog(node_a)
+    _enable_watchdog(node_b)
+    assert any(t.name == "mt-obs-history"
+               for t in threading.enumerate())
+    _tick_twice(node_a)
+    _tick_twice(node_b)
+
+    # ONE merged document, strict exposition, server label everywhere
+    text = adm.metrics_history(window="30m", step="1m")
+    types, samples = parse_exposition(text)
+    assert samples
+    assert all("server" in labels for _, labels, _ in samples), \
+        "a history series lost its server label in the merge"
+    servers = {labels["server"] for _, labels, _ in samples}
+    assert node_a.node_name in servers and node_b.node_name in servers
+    oks = {labels["server"]: v for n, labels, v in samples
+           if n == "mt_node_history_ok"}
+    assert oks == {node_a.node_name: 1, node_b.node_name: 1}
+    # ts labels are bucket epochs (the history grammar)
+    assert any("ts" in labels for n, labels, _ in samples
+               if n != "mt_node_history_ok")
+    # family filter narrows the document
+    text = adm.metrics_history(family="mt_mem_inuse_bytes")
+    _, samples = parse_exposition(text)
+    assert all(n in ("mt_mem_inuse_bytes", "mt_node_history_ok")
+               for n, _, _ in samples)
+
+    # the enabled scrape now carries the watchdog families
+    live = _scrape(node_a)
+    assert "# TYPE mt_history_series gauge" in live
+    assert "mt_alert_evals_total" in live
+
+    # alerts route: local + peers, every rule in the catalog
+    out = adm.alerts()
+    assert out["enabled"] is True
+    assert out["rules"] == list(RULE_NAMES)
+    assert [p["node"] for p in out["peers"]] == [node_b.node_name]
+    assert out["peers"][0]["enabled"] is True
+
+    # downed peer: marked 0, the route still succeeds
+    peer_ep = rpc_b.endpoint
+    rpc_b.stop()
+    text = adm.metrics_history()
+    _, samples = parse_exposition(text)
+    oks = {labels["server"]: v for n, labels, v in samples
+           if n == "mt_node_history_ok"}
+    assert oks[peer_ep] == 0, "downed peer silently dropped"
+    assert oks[node_a.node_name] == 1
+
+
+@pytest.mark.slow
+def test_history_rings_age_out_old_buckets():
+    """Breadth: a series sampled for two hours keeps only what each
+    ring's span allows — the 10s ring forgets the first 100 minutes,
+    the 60s ring keeps them."""
+    h = TelemetryHistory()
+    key = ("mt_mem_inuse_bytes", "")
+    for i in range(720):              # 2h at 10s spacing
+        h.observe(T0 + i * 10, {key: (float(i), "gauge")})
+    now = T0 + 7190
+    # the fine ring serves short windows at 10s granularity...
+    fine = h.query(window_s=300, step_s=10, now_s=now)[key]
+    assert 30 <= len(fine) <= 31      # window bounds are inclusive
+    assert fine[0][0] >= now - 310
+    # ...but a 2h window falls through to the 60s ring (the 10s ring
+    # only spans 6 minutes), which still holds the session's start
+    coarse = h.query(window_s=7200, step_s=10, now_s=now)[key]
+    assert len(coarse) == 120
+    assert coarse[1][0] - coarse[0][0] == 60
+    assert coarse[0][0] <= T0 + 60
