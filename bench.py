@@ -1823,6 +1823,128 @@ def watchdog_main() -> None:
     }))
 
 
+def _bench_metering() -> dict | None:
+    """``bench.py metering`` — ns/request cost of the workload
+    attribution plane on the GET hot path, through the REAL S3 server
+    (ISSUE 19 acceptance: overhead unmeasurable against run-to-run
+    noise).  A/B per round: the same request loop with metering armed
+    (per-(bucket,api,tenant) accounting + count-min/space-saving
+    offers at completion-record time) vs disabled (the idle contract:
+    ``srv.metering is None``, zero work).  Rides along: the raw
+    ``charge()`` microbench — the exact per-request cost the sketches
+    add, measured off the socket path where noise can't hide it."""
+    import shutil
+    import statistics
+    import sys as _sys
+    import tempfile
+
+    try:
+        from minio_tpu.obs.metering import Metering
+        from minio_tpu.objectlayer.erasure_object import ErasureObjects
+        from minio_tpu.s3.client import S3Client
+        from minio_tpu.s3.server import S3Server
+        from minio_tpu.storage.xl_storage import XLStorage
+    except Exception as e:  # noqa: BLE001 — optional leg
+        print(f"metering leg failed to import: {e!r}", file=_sys.stderr)
+        return None
+    root = "/dev/shm" if os.path.isdir("/dev/shm") and \
+        os.access("/dev/shm", os.W_OK) else None
+    tmp = tempfile.mkdtemp(prefix="mtrbench-", dir=root)
+    srv = None
+    try:
+        disks = []
+        for i in range(4):
+            d = os.path.join(tmp, f"d{i}")
+            os.makedirs(d)
+            disks.append(XLStorage(d))
+        layer = ErasureObjects(disks, parity=2, block_size=64 * 1024,
+                               backend="numpy")
+        srv = S3Server(layer, access_key="mk", secret_key="ms")
+        srv.start()
+        c = S3Client(srv.endpoint, "mk", "ms")
+        c.make_bucket("mtrbench")
+        body = os.urandom(64 * 1024)
+        c.put_object("mtrbench", "warm", body)
+        c.get_object("mtrbench", "warm")
+
+        def arm(on: bool) -> None:
+            srv.config.set("metering", "enable", "on" if on else "off")
+            srv.reload_metering_config()
+
+        reps, rounds = 60, 5
+
+        def one_round() -> float:
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                c.get_object("mtrbench", "warm")
+            return (time.perf_counter() - t0) / reps * 1e9  # ns/req
+
+        on: list[float] = []
+        off: list[float] = []
+        for _ in range(rounds):
+            arm(True)
+            on.append(one_round())
+            arm(False)
+            off.append(one_round())
+        med_on = statistics.median(on)
+        med_off = statistics.median(off)
+        noise = max(off) - min(off)
+        overhead = med_on - med_off
+        # the charge path in isolation: one warm-table hit and one
+        # distinct-key miss (the worst case — every sketch evicts)
+        m = Metering(seed=1)
+        n = 20_000
+        t0 = time.perf_counter()
+        for i in range(n):
+            m.charge(bucket="mtrbench", api="GetObject", tenant="mk",
+                     key="warm", tx=65536, dur_ns=1000)
+        hot_ns = (time.perf_counter() - t0) / n * 1e9
+        t0 = time.perf_counter()
+        for i in range(n):
+            m.charge(bucket="mtrbench", api="GetObject",
+                     tenant=f"t{i}", key=f"k{i}", tx=65536,
+                     dur_ns=1000)
+        cold_ns = (time.perf_counter() - t0) / n * 1e9
+        return {
+            "reps": reps, "rounds": rounds, "body_bytes": len(body),
+            "drives_root": root or "disk",
+            "get": {
+                "ns_per_request_on": round(med_on),
+                "ns_per_request_off": round(med_off),
+                "overhead_ns": round(overhead),
+                "run_to_run_noise_ns": round(noise),
+                "unmeasurable": overhead <= noise,
+            },
+            "charge_ns_hot_key": round(hot_ns),
+            "charge_ns_distinct_key": round(cold_ns),
+            "sketch_memory_bytes": m.memory_bytes(),
+        }
+    except Exception as e:  # noqa: BLE001 — optional leg
+        print(f"metering leg failed: {e!r}", file=_sys.stderr)
+        return None
+    finally:
+        if srv is not None:
+            try:
+                srv.stop()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def metering_main() -> None:
+    """``bench.py metering`` — run the attribution-plane overhead leg
+    standalone and print ONE BENCH_*-shaped JSON line."""
+    stats = _bench_metering()
+    if stats is None:
+        raise SystemExit("metering leg unavailable")
+    print(json.dumps({
+        "metric": "metering_overhead_ns_per_get",
+        "value": stats["get"]["overhead_ns"],
+        "unit": "ns/request",
+        "detail": stats,
+    }))
+
+
 def host_main() -> None:
     """``bench.py host`` — the host-measurable legs only (BASELINE
     configs 1-2, the e2e PUT pipeline, md5 lanes/backends, codec
@@ -1835,6 +1957,7 @@ def host_main() -> None:
     hot_get = _bench_hot_get()
     xray = _bench_xray()
     watchdog = _bench_watchdog()
+    metering = _bench_metering()
     c1 = (cfg12 or {}).get("config1_4+2_put_64MiB_GiBps")
     print(json.dumps({
         "metric": "baseline_config1_4+2_put_64MiB_GiBps",
@@ -1851,6 +1974,7 @@ def host_main() -> None:
             "hot_get": hot_get,
             "xray": xray,
             "watchdog": watchdog,
+            "metering": metering,
             "methodology": "host legs only (bench.py host); device "
                            "kernel legs need a TPU",
         },
@@ -1910,6 +2034,8 @@ if __name__ == "__main__":
         commit_profile_main()
     elif len(_sys.argv) > 1 and _sys.argv[1] == "watchdog":
         watchdog_main()
+    elif len(_sys.argv) > 1 and _sys.argv[1] == "metering":
+        metering_main()
     elif len(_sys.argv) > 1 and _sys.argv[1] == "host":
         host_main()
     else:

@@ -66,6 +66,12 @@ class AdminClient:
     def data_usage_info(self) -> dict:
         return self._call("GET", "datausageinfo")
 
+    def data_usage(self) -> dict:
+        """Per-bucket usage accounting (workload attribution plane):
+        the persisted crawler snapshot plus the live quota cache (in-
+        flight byte deltas charged since the last crawl)."""
+        return self._call("GET", "data-usage")
+
     def health_info(self, scope: str = "") -> dict:
         """Node health/OBD document; ``scope="cluster"`` fans out to
         every peer and folds the per-node documents into one reply
@@ -132,6 +138,13 @@ class AdminClient:
 
     def top_locks(self) -> list[dict]:
         return self._call("GET", "top-locks")["locks"]
+
+    def top(self, local: bool = False) -> dict:
+        """Workload attribution ``top`` (v2 when metering is armed):
+        per-API stats plus top tenants by bytes, hot keys and hot
+        prefixes from the heavy-hitter sketches, peer-aggregated
+        unless ``local``."""
+        return self._call("GET", "top", "local=true" if local else "")
 
     # -- config ------------------------------------------------------------
 
@@ -267,6 +280,9 @@ class AdminClient:
         self._call("POST", "set-bucket-quota", f"bucket={bucket}",
                    json.dumps({"quota": quota,
                                "quotatype": quota_type}).encode())
+
+    def clear_bucket_quota(self, bucket: str) -> None:
+        self._call("POST", "clear-bucket-quota", f"bucket={bucket}")
 
     def kms_key_status(self) -> dict:
         return self._call("GET", "kms-key-status")

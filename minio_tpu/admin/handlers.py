@@ -113,6 +113,20 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
                 return send_json({"error": "no usage data yet"}, 404) \
                     or True
             return send_json(json.loads(info.to_json())) or True
+        if route == "data-usage" and h.command == "GET":
+            # the quota-aware sibling of datausageinfo: the persisted
+            # crawler snapshot PLUS this server's live enforcement view
+            # (in-flight byte deltas charged by committed writes since
+            # that snapshot) — what _check_quota actually sees
+            from ..background.crawler import load_usage
+            info = load_usage(srv.layer)
+            usage = getattr(srv, "usage", None)
+            return send_json({
+                "persisted": json.loads(info.to_json())
+                if info is not None else None,
+                "cache": usage.snapshot_doc()
+                if usage is not None else None,
+            }) or True
         if route == "tier" and h.command == "GET":
             # madmin ListTiers analog — credentials never leave the server
             return send_json(
@@ -253,6 +267,17 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
             except Exception as e:          # not on every later PUT
                 return send_json({"error": str(e)}, 400) or True
             srv.bucket_meta.set_config(bucket, "quota", payload.decode())
+            return send_json({"status": "ok"}) or True
+        if route == "clear-bucket-quota" and h.command == "POST":
+            # madmin SetBucketQuota with an empty doc clears; this
+            # build keeps clear explicit so a malformed set can never
+            # silently drop enforcement
+            bucket = q1.get("bucket", "")
+            try:
+                srv.layer.get_bucket_info(bucket)
+            except Exception as e:  # noqa: BLE001 — unknown bucket
+                return send_json({"error": str(e)}, 400) or True
+            srv.bucket_meta.set_config(bucket, "quota", None)
             return send_json({"status": "ok"}) or True
         if route == "kms-key-status" and h.command == "GET":
             # madmin KMSKeyStatus: round-trip an encryption probe
@@ -451,7 +476,35 @@ def handle(h, srv, path: str, query: dict, payload: bytes) -> bool:
                         idempotent=False)]
             return send_json(out) or True
         if route == "top" and h.command == "GET":
-            return send_json(_top(srv)) or True
+            out = _top(srv)
+            # top v2: the workload attribution sections (hot keys /
+            # prefixes, top tenants by bytes/errors/p99), aggregated
+            # across peers via the metering_top RPC (?local=true keeps
+            # it node-local).  Absent entirely when metering is off on
+            # this node and no peer reports — the v1 shape survives.
+            m = getattr(srv, "metering", None)
+            docs = [metering_top_reply(srv)] if m is not None else []
+            if srv.peers is not None and q1.get("local") != "true":
+                peer_errs = []
+                for ep, r, err in srv.peers.call_all(
+                        "metering_top", timeout_s=10.0):
+                    if err:
+                        peer_errs.append({"node": ep, "error": err})
+                    elif r:
+                        docs.append(r)
+                if peer_errs:
+                    out["peerErrors"] = peer_errs
+            if docs:
+                from ..obs.metering import merge_top_docs
+                agg = merge_top_docs([d for d in docs if d])
+                out["version"] = 2
+                out["tenants"] = agg["tenants"]
+                out["hotKeys"] = agg["hotKeys"]
+                out["hotPrefixes"] = agg["hotPrefixes"]
+                out["meteringNodes"] = agg["nodes"]
+                if m is not None and docs and docs[0]:
+                    out["sketch"] = docs[0].get("sketch")
+            return send_json(out) or True
         if route == "log" and h.command == "GET":
             if q1.get("follow") == "true":
                 return _stream(h, srv.logger.pubsub, q1)
@@ -852,7 +905,8 @@ def _render_local(srv, node=None) -> str:
         mrf=getattr(srv, "mrf", None),
         flightrec=getattr(srv, "flightrec", None),
         rebalancer=_rebalancer(srv),
-        watchdog=getattr(srv, "watchdog", None))
+        watchdog=getattr(srv, "watchdog", None),
+        metering=getattr(srv, "metering", None))
 
 
 def _history_params(q1) -> dict:
@@ -1149,6 +1203,14 @@ def _trace_type_filter(q1):
     return (lambda item: item.get("type", "http") in want), want
 
 
+def metering_top_reply(srv) -> dict:
+    """One node's ``top`` v2 attribution sections — shared by the
+    local route leg and the ``metering_top`` peer RPC so the shapes
+    can never drift.  {} when the plane is disabled on this node."""
+    m = getattr(srv, "metering", None)
+    return m.top_doc() if m is not None else {}
+
+
 def _top(srv) -> dict:
     """madmin TopAPIs/TopDrives analog: hottest S3 APIs and slowest
     drives over the last-minute windows, slow-drive verdicts included."""
@@ -1340,6 +1402,11 @@ def _config(h, srv, route, q1, payload, send_json) -> bool:
             # rebuild the SLO watchdog (sampler + rule engine) live —
             # history rings reset, alert state starts clean
             srv.reload_watchdog_config()
+        if parts[1] == "metering":
+            # arm/retune the workload attribution plane (sketch
+            # geometry, decay cadence) live; the hot-read per-key
+            # admission hook follows the new plane
+            srv.reload_metering_config()
         if parts[1] in ("logger_webhook", "audit_webhook",
                         "alert_webhook") \
                 or parts[1].startswith("notify_"):

@@ -104,7 +104,7 @@ def _fmt_labels(labels: tuple, extra: str = "") -> str:
 def render(layer=None, healer=None, config=None, api_stats=None,
            replication=None, crawler=None, node=None,
            egress=None, mrf=None, flightrec=None,
-           rebalancer=None, watchdog=None) -> str:
+           rebalancer=None, watchdog=None, metering=None) -> str:
     """Prometheus text format: counters + histograms + live gauges.
 
     ``config`` (a kvconfig Config) supplies the slow-drive knobs at
@@ -250,6 +250,11 @@ def render(layer=None, healer=None, config=None, api_stats=None,
     if watchdog is not None:
         try:
             lines += _watchdog_metrics(watchdog)
+        except Exception:  # noqa: BLE001 — a scrape must never fail
+            pass
+    if metering is not None:
+        try:
+            lines += _metering_gauges(metering)
         except Exception:  # noqa: BLE001 — a scrape must never fail
             pass
     text = "\n".join(lines) + "\n"
@@ -824,6 +829,56 @@ def _watchdog_metrics(watchdog) -> list[str]:
         for rule, subject in sorted(firing):
             fl = _fmt_labels((("rule", rule), ("subject", subject)))
             lines.append(f"mt_alert_firing{fl} 1")
+    return lines
+
+
+def _metering_gauges(metering) -> list[str]:
+    """Workload attribution families, computed at scrape time from the
+    bounded registry (obs/metering.py Metering.metrics_state).  A
+    server with metering.enable=off hands ``metering=None`` into
+    render() and emits NONE of these families (the idle contract).
+    Label cardinality is bounded BY the registry — at most max_buckets
+    bucket values and tenant_k tenant values plus the ``_other``
+    overflow row; object keys never appear as labels at all."""
+    st = metering.metrics_state()
+    lines: list[str] = []
+    brows = st.get("bucketRows") or []
+    if brows:
+        lines += ["# TYPE mt_bucket_requests_total counter",
+                  "# TYPE mt_bucket_errors_total counter",
+                  "# TYPE mt_bucket_rx_bytes_total counter",
+                  "# TYPE mt_bucket_tx_bytes_total counter"]
+        for bucket, api, requests, errors, rx, tx in brows:
+            bl = _fmt_labels((("bucket", bucket), ("api", api)))
+            lines.append(f"mt_bucket_requests_total{bl} {requests}")
+            if errors:
+                lines.append(f"mt_bucket_errors_total{bl} {errors}")
+            if rx:
+                lines.append(f"mt_bucket_rx_bytes_total{bl} {rx}")
+            if tx:
+                lines.append(f"mt_bucket_tx_bytes_total{bl} {tx}")
+    trows = st.get("tenantRows") or []
+    if trows:
+        lines += ["# TYPE mt_tenant_requests_total counter",
+                  "# TYPE mt_tenant_errors_total counter",
+                  "# TYPE mt_tenant_rx_bytes_total counter",
+                  "# TYPE mt_tenant_tx_bytes_total counter",
+                  "# TYPE mt_tenant_last_minute_p50_ns gauge",
+                  "# TYPE mt_tenant_last_minute_p99_ns gauge"]
+        for tenant, requests, errors, rx, tx, p50, p99 in trows:
+            tl = _fmt_labels((("tenant", tenant),))
+            lines.append(f"mt_tenant_requests_total{tl} {requests}")
+            lines.append(f"mt_tenant_errors_total{tl} {errors}")
+            lines.append(f"mt_tenant_rx_bytes_total{tl} {rx}")
+            lines.append(f"mt_tenant_tx_bytes_total{tl} {tx}")
+            lines.append(f"mt_tenant_last_minute_p50_ns{tl} {p50}")
+            lines.append(f"mt_tenant_last_minute_p99_ns{tl} {p99}")
+    lines += [
+        "# TYPE mt_metering_sketch_memory_bytes gauge",
+        f"mt_metering_sketch_memory_bytes {st.get('memoryBytes', 0)}",
+        "# TYPE mt_metering_decays_total counter",
+        f"mt_metering_decays_total {st.get('decays', 0)}",
+    ]
     return lines
 
 

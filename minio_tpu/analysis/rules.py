@@ -600,14 +600,15 @@ class ObsDocsDriftRule(Rule):
                    "``STAGE_NAMES`` catalog), every watchdog rule "
                    "name (the ``RULE_NAMES`` catalog), and every "
                    "``mt_{s3_stage,forensic,flight,quorum,drive_op,"
-                   "trace_tree,alert,history}_*`` metric family "
+                   "trace_tree,alert,history,bucket,tenant,metering}"
+                   "_*`` metric family "
                    "literal must appear in docs/observability.md — an "
                    "operator reading the stage/rule/family catalog "
                    "must be able to trust it is complete")
 
     _FAMILY_RE = re.compile(
         r"^mt_(?:s3_stage|forensic|flight|quorum|drive_op|trace_tree"
-        r"|alert|history)_\w+$")
+        r"|alert|history|bucket|tenant|metering)_\w+$")
 
     def check_tree(self, mods: list[Module], repo: str):
         import os
@@ -673,6 +674,82 @@ class ObsDocsDriftRule(Rule):
                     s = s.split(" ", 1)[0].split("{", 1)[0]
                 if cls._FAMILY_RE.match(s):
                     yield node.lineno, "metric family", s
+
+
+# -- label cardinality -------------------------------------------------------
+
+# request-derived label keys: their value space is controlled by
+# CLIENTS (bucket names, object keys, access keys), so a family
+# carrying one has unbounded cardinality unless something bounds it
+_REQUEST_LABELS = frozenset(
+    {"bucket", "key", "object", "access_key", "tenant", "prefix"})
+# the bounded emitters: the metering registry caps its tables at
+# top-K sketch membership + an ``_other`` overflow row, and the
+# renderer only echoes those bounded tables (incl. the crawler's
+# per-bucket usage gauges — buckets are operator-created, not
+# request-minted, and the bucket table itself is capped upstream)
+_LABEL_CARDINALITY_EXEMPT = (
+    "minio_tpu/obs/metering.py",
+    "minio_tpu/admin/metrics.py",
+)
+_LABEL_IN_SAMPLE_RE = re.compile(
+    r"[{,](?:" + "|".join(sorted(_REQUEST_LABELS)) + r')="')
+
+
+class LabelCardinalityRule(Rule):
+    id = "label-cardinality"
+    description = ("an ``mt_*`` metric emission carrying a request-"
+                   "derived label (bucket/key/object/access_key/"
+                   "tenant/prefix) outside the bounded metering "
+                   "registry grows one series per distinct client "
+                   "value — unbounded scrape memory; route it through "
+                   "obs/metering.py (top-K sketch gating + ``_other`` "
+                   "overflow) instead")
+
+    def check_module(self, mod: Module):
+        if mod.rel in _LABEL_CARDINALITY_EXEMPT:
+            return
+        for node in ast.walk(mod.tree):
+            # shape A: counter-registry calls —
+            # ``_metrics.inc("mt_x_total", {"bucket": b})``
+            if isinstance(node, ast.Call):
+                fam = next(
+                    (a.value for a in node.args
+                     if isinstance(a, ast.Constant)
+                     and isinstance(a.value, str)
+                     and a.value.startswith("mt_")), None)
+                if fam is None:
+                    continue
+                dicts = [a for a in node.args
+                         if isinstance(a, ast.Dict)] + \
+                        [k.value for k in node.keywords
+                         if isinstance(k.value, ast.Dict)]
+                for d in dicts:
+                    hot = sorted(
+                        k.value for k in d.keys
+                        if isinstance(k, ast.Constant)
+                        and k.value in _REQUEST_LABELS)
+                    if hot:
+                        yield Finding(
+                            mod.rel, node.lineno, self.id,
+                            f"family {fam} labelled by request-"
+                            f"derived {'/'.join(hot)} — unbounded "
+                            f"cardinality; go through the metering "
+                            f"registry (obs/metering.py)")
+            # shape B: hand-rendered sample lines —
+            # ``f'mt_x_total{{bucket="{b}"}} 1'`` (the constant head
+            # of an f-string carries both the family and the label)
+            elif isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    node.value.startswith("mt_") and \
+                    _LABEL_IN_SAMPLE_RE.search(node.value):
+                yield Finding(
+                    mod.rel, node.lineno, self.id,
+                    f"hand-rendered sample line for "
+                    f"{node.value.split('{', 1)[0]} carries a "
+                    f"request-derived label — unbounded cardinality; "
+                    f"go through the metering registry "
+                    f"(obs/metering.py)")
 
 
 # -- tls discipline ----------------------------------------------------------
@@ -932,6 +1009,7 @@ ALL_RULES = [
     SwallowedExceptionRule,
     KvconfigDriftRule,
     ObsDocsDriftRule,
+    LabelCardinalityRule,
     TlsDisciplineRule,
     NamedSkipRule,
     PoolRoutingRule,

@@ -256,6 +256,85 @@ def _expire(layer, bucket: str, oi, action: Action, res: ScanResult) -> None:
         pass  # raced with a client delete; next cycle reconciles
 
 
+class UsageCache:
+    """Quota's view of bucket usage: the last persisted crawler
+    snapshot plus a lock-cheap in-flight byte delta per bucket
+    (cmd/bucket-quota.go enforceBucketQuotaHard reads the data-usage
+    cache the same way).
+
+    The delta exists because the crawler is periodic: without it, a
+    client could blow far past a hard quota between two scan cycles.
+    Every committed write charges its stored size via
+    :meth:`add_pending`; a snapshot refresh clears the deltas (the
+    scan now accounts those bytes), so usage converges to the crawler
+    truth.  Charges are plain dict-int mutations under the GIL — the
+    PUT path must not serialize on an accounting lock, and a racing
+    lost charge under-counts one write until the next scan, which a
+    periodic-snapshot design already tolerates.
+
+    When no crawler runs (single-node tests, gateways), the cache
+    lazily re-reads the persisted snapshot at most every
+    ``reload_ttl_s`` — only buckets WITH a quota config pay that read.
+    """
+
+    def __init__(self, layer=None, reload_ttl_s: float = 30.0):
+        self.layer = layer
+        self.reload_ttl_s = reload_ttl_s
+        self.info: DataUsageInfo | None = None
+        self._pending: dict[str, int] = {}
+        self._loaded_at = float("-inf")
+        self._mu = threading.Lock()
+        if layer is not None:
+            try:
+                self.refresh(load_usage(layer))
+            except Exception:  # noqa: BLE001 — no snapshot yet is fine
+                pass
+
+    def refresh(self, info: DataUsageInfo | None) -> None:
+        """Swap in a fresh snapshot (crawler cycle end / lazy reload).
+        ``None`` (no usage.json yet) still stamps the clock so an
+        empty cluster does not re-read the system volume per PUT."""
+        with self._mu:
+            if info is not None:
+                self.info = info
+                self._pending = {}
+            self._loaded_at = time.monotonic()
+
+    def add_pending(self, bucket: str, nbytes: int) -> None:
+        if nbytes > 0:
+            self._pending[bucket] = \
+                self._pending.get(bucket, 0) + nbytes
+
+    def snapshot_doc(self) -> dict:
+        """The admin ``data-usage`` route's view of this cache."""
+        info = self.info
+        return {
+            "snapshotUpdateNs": info.last_update_ns
+            if info is not None else 0,
+            "pendingBytes": dict(self._pending),
+            "bucketSizes": {b: u.size for b, u in
+                            info.bucket_usage.items()}
+            if info is not None else {},
+        }
+
+    def bucket_size(self, bucket: str) -> int:
+        """Snapshot size + in-flight delta for one bucket — the
+        ``current_usage`` the hard-quota admission check charges."""
+        if self.layer is not None and time.monotonic() - \
+                self._loaded_at > self.reload_ttl_s:
+            try:
+                self.refresh(load_usage(self.layer))
+            except Exception:  # noqa: BLE001 — stale beats failing
+                pass
+        info = self.info
+        base = 0
+        if info is not None:
+            bu = info.bucket_usage.get(bucket)
+            if bu is not None:
+                base = bu.size
+        return base + self._pending.get(bucket, 0)
+
+
 def persist_usage(layer, info: DataUsageInfo) -> None:
     from ..storage.xl_storage import SYS_DIR
     blob = info.to_json()
@@ -301,6 +380,9 @@ class Crawler:
         self.delay_mult = 0.0
         self.max_wait_s = 15.0
         self._last_cycle_s = 0.0
+        # wired by S3Server.attach_background: each cycle's fresh
+        # usage snapshot refreshes the server's quota-enforcement view
+        self.usage_cache: UsageCache | None = None
 
     def _wait_s(self) -> float:
         return self.interval_s + min(self.max_wait_s,
@@ -323,6 +405,8 @@ class Crawler:
             raise
         self.progress.end()
         persist_usage(self.layer, res.usage)
+        if self.usage_cache is not None:
+            self.usage_cache.refresh(res.usage)
         self.tracker.advance()
         self.last = res
         self.cycles += 1

@@ -485,6 +485,10 @@ class HotReadPlane:
         # injected by S3Server.reload_cache_config; None = standalone
         # layer, per-key heat alone drives admission
         self.heat_fn: Callable[[], int] | None = None
+        # per-key heat from the metering plane's count-min sketch
+        # (obs/metering.py key_heat), injected by the same reload;
+        # None = metering disabled, the global rate above is the gate
+        self.heat_key_fn: Callable[[str, str], int] | None = None
         self.used = False
         _PLANES.add(self)
 
@@ -559,14 +563,23 @@ class HotReadPlane:
                     del self._heat[k]
             return n
 
-    def _admit(self, touches: int, coalesced: bool,
-               tiny: bool) -> bool:
+    def _admit(self, touches: int, coalesced: bool, tiny: bool,
+               key: tuple | None = None) -> bool:
         if tiny or coalesced:
             # concurrent demand is definitionally hot; inline-tiny
             # windows already rode the metadata quorum read
             return True
         if touches < self.config.heat_threshold:
             return False
+        if key is not None and self.heat_key_fn is not None:
+            # metering plane armed: THIS object's sketch heat is the
+            # gate — a single hot key admits even on a quiet server,
+            # and a cold key never rides another object's traffic
+            try:
+                return self.heat_key_fn(key[0], key[1]) >= \
+                    self.config.heat_threshold
+            except Exception:  # noqa: BLE001 — heat source is advisory
+                return True
         if self.heat_fn is not None:
             # the stats-plane gate: a cold read plane (idle server)
             # admits nothing on per-key counts alone
@@ -651,7 +664,8 @@ class HotReadPlane:
         if mode == "lead" and self._admit(
                 touches, coalesced=followers > 0,
                 tiny=fi.size <= getattr(self._layer,
-                                        "inline_threshold", 0)):
+                                        "inline_threshold", 0),
+                key=key):
             # fence check rides the recorded generation: only insert
             # while no overwrite bumped the key since the flight
             # started (invalidate-before-visible, the stale-fill gate)

@@ -393,6 +393,20 @@ class ForensicSys:
         except Exception as e:  # noqa: BLE001
             put("history.json", {"error": str(e)})
         try:
+            # per-bucket usage accounting at the breach instant: the
+            # crawler snapshot plus in-flight quota deltas, and the
+            # metering plane's tenant/key heavy hitters — WHO was
+            # doing WHAT when the trigger tripped
+            usage = getattr(srv, "usage", None)
+            metering = getattr(srv, "metering", None)
+            put("usage.json", {
+                "cache": usage.snapshot_doc()
+                if usage is not None else None,
+                "metering": metering.top_doc()
+                if metering is not None else None})
+        except Exception as e:  # noqa: BLE001
+            put("usage.json", {"error": str(e)})
+        try:
             from ..admin.handlers import _render_local
             docs["metrics.prom"] = _render_local(srv).encode()
         except Exception as e:  # noqa: BLE001

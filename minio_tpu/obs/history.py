@@ -69,6 +69,13 @@ DEFAULT_FAMILIES: Tuple[str, ...] = (
     "mt_heal_mrf_queued_total",
     "mt_mem_inuse_bytes",
     "mt_rpc_breaker_opens_total",
+    # workload attribution plane (obs/metering.py): per-tenant rates
+    # feed the tenant_burn / noisy_neighbor watchdog rules; label
+    # cardinality is bounded at the source (top-K sketch gating)
+    "mt_tenant_requests_total",
+    "mt_tenant_errors_total",
+    "mt_tenant_rx_bytes_total",
+    "mt_tenant_tx_bytes_total",
 )
 
 _TYPE_RE = re.compile(r"^# TYPE (\S+) (\S+)$")

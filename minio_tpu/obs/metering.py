@@ -1,0 +1,323 @@
+"""Workload attribution plane — who is the load?
+
+Per-(bucket, api, access-key) usage metering charged from
+``s3/server.py`` at completion-record time, with label cardinality
+bounded by construction:
+
+* the bucket x api table holds at most ``max_buckets`` distinct
+  buckets — overflow traffic folds into the ``_other`` row;
+* tenant (access-key) rows exist only while the key is tabled in a
+  seeded :class:`~minio_tpu.obs.sketch.SpaceSaving` top-K — an evicted
+  tenant's row folds into ``_other``, so the registry can never grow a
+  row per request-derived value;
+* object keys/prefixes never become metric labels at all: they live
+  only in a fixed-footprint count-min + space-saving pair feeding the
+  admin ``top`` v2 route and the hot-read cache's per-key heat
+  estimate (:meth:`Metering.key_heat`).
+
+Recording follows the obs/lastminute.py "lock-cheap" discipline: plain
+dict/int mutations under the GIL, no lock on the charge path — a
+concurrent race can lose a sample, which minute-granularity
+attribution tolerates; the S3 hot path must never serialize on an
+observability lock.  Sketches decay (halve) every ``decay_interval``
+so "heat" means *recent* heat; the bucket/tenant cells stay cumulative
+counters (the telemetry history rings store counters as rates).
+
+Idle contract: ``metering.enable=off`` (the default) means
+``srv.metering is None`` — no charge branch, no ``mt_bucket_*`` /
+``mt_tenant_*`` / ``mt_metering_*`` family in the scrape, no ``top``
+v2 sections, and the hot-read cache falls back to the PR-13 global
+GetObject rate.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Callable, Dict, List, Tuple
+
+from .lastminute import Window
+from .sketch import CountMin, SpaceSaving
+from ..utils.kvconfig import parse_duration, register_subsys
+
+OTHER = "_other"
+
+register_subsys("metering", {
+    # workload attribution (obs/metering.py): ``enable=on`` arms the
+    # per-(bucket, api, access-key) registry charged at completion-
+    # record time, the mt_bucket_*/mt_tenant_* scrape families, the
+    # admin ``top`` v2 sections (hot keys/prefixes, top tenants), and
+    # the hot-read cache's per-key heat signal.  Memory is strictly
+    # bounded: at most ``max_buckets`` bucket rows and ``tenant_k``
+    # tenant rows (overflow folds into ``_other``); object keys live
+    # only in a ``cm_width`` x ``cm_depth`` count-min grid plus
+    # ``key_k``/``prefix_k`` space-saving tables.  Sketches halve
+    # every ``decay_interval`` so heat is recent heat.  ``seed`` makes
+    # every sketch deterministic (tests, cross-node merge).
+    # Live-reloadable (S3Server.reload_metering_config on admin
+    # SetConfigKV; a reload rebuilds the plane, counters reset).
+    "enable": "off",
+    "max_buckets": "48",
+    "tenant_k": "24",
+    "key_k": "64",
+    "prefix_k": "32",
+    "cm_width": "2048",
+    "cm_depth": "4",
+    "seed": "1",
+    "decay_interval": "60s",
+})
+
+
+class _Cell:
+    """One bucket x api accounting row."""
+
+    __slots__ = ("requests", "errors", "rx", "tx")
+
+    def __init__(self):
+        self.requests = 0
+        self.errors = 0
+        self.rx = 0
+        self.tx = 0
+
+
+class _TenantCell(_Cell):
+    """One tenant row: the counters plus a last-minute latency ring."""
+
+    __slots__ = ("window",)
+
+    def __init__(self):
+        super().__init__()
+        self.window = Window()
+
+
+class Metering:
+    """One node's bounded attribution registry."""
+
+    def __init__(self, *, max_buckets: int = 48, tenant_k: int = 24,
+                 key_k: int = 64, prefix_k: int = 32,
+                 cm_width: int = 2048, cm_depth: int = 4,
+                 seed: int = 1, decay_interval_s: float = 60.0,
+                 node_name: str = "",
+                 clock: Callable[[], float] = time.time):
+        self.max_buckets = max(1, max_buckets)
+        self.node_name = node_name
+        self.clock = clock
+        self.decay_interval_s = max(1.0, decay_interval_s)
+        self._bapi: Dict[Tuple[str, str], _Cell] = {}
+        self._bucket_names: set = set()
+        self._tenants: Dict[str, _TenantCell] = {}
+        self._tenant_top = SpaceSaving(tenant_k, seed)
+        self._key_cm = CountMin(cm_width, cm_depth, seed)
+        self._key_top = SpaceSaving(key_k, seed + 1)
+        self._prefix_top = SpaceSaving(prefix_k, seed + 2)
+        self._last_decay = clock()
+        self.decays = 0
+
+    # -- the charge path (hot; lock-cheap) --------------------------------
+
+    def charge(self, *, bucket: str, api: str, tenant: str = "",
+               key: str = "", status: int = 200, rx: int = 0,
+               tx: int = 0, dur_ns: int = 0,
+               now_s: float | None = None) -> None:
+        now = self.clock() if now_s is None else now_s
+        if now - self._last_decay >= self.decay_interval_s:
+            self._decay(now)
+        err = 1 if status >= 500 else 0
+        # bucket x api row (bounded: overflow buckets fold to _other)
+        b = bucket or OTHER
+        if b not in self._bucket_names:
+            if len(self._bucket_names) >= self.max_buckets:
+                b = OTHER
+            else:
+                self._bucket_names.add(b)
+        cell = self._bapi.get((b, api))
+        if cell is None:
+            cell = self._bapi[(b, api)] = _Cell()
+        cell.requests += 1
+        cell.errors += err
+        cell.rx += rx
+        cell.tx += tx
+        # tenant row, gated by the space-saving table: only a current
+        # heavy hitter owns a named row
+        t = tenant or OTHER
+        if t != OTHER:
+            self._tenant_top.offer(t)
+            if t not in self._tenant_top:
+                t = OTHER
+            elif t not in self._tenants:
+                self._fold_evicted_tenants()
+        trow = self._tenants.get(t)
+        if trow is None:
+            trow = self._tenants[t] = _TenantCell()
+        trow.requests += 1
+        trow.errors += err
+        trow.rx += rx
+        trow.tx += tx
+        trow.window.record(dur_ns, rx + tx)
+        # object-key heat: sketches only, never labels
+        if key:
+            composite = b + "/" + key
+            self._key_cm.add(composite)
+            self._key_top.offer(composite)
+            seg = key.split("/", 1)[0]
+            self._prefix_top.offer(b + "/" + seg + "/")
+
+    def _fold_evicted_tenants(self) -> None:
+        """A new heavy hitter evicted someone from the sketch table —
+        fold the loser's row into ``_other`` so named rows and the
+        sketch stay in lockstep (rows are strictly <= tenant_k + 1)."""
+        dead = [t for t in self._tenants
+                if t != OTHER and t not in self._tenant_top]
+        if not dead:
+            return
+        other = self._tenants.get(OTHER)
+        if other is None:
+            other = self._tenants[OTHER] = _TenantCell()
+        for t in dead:
+            row = self._tenants.pop(t)
+            other.requests += row.requests
+            other.errors += row.errors
+            other.rx += row.rx
+            other.tx += row.tx
+
+    def _decay(self, now: float) -> None:
+        self._last_decay = now
+        self.decays += 1
+        self._tenant_top.decay()
+        self._key_cm.decay()
+        self._key_top.decay()
+        self._prefix_top.decay()
+
+    # -- read back --------------------------------------------------------
+
+    def key_heat(self, bucket: str, key: str) -> int:
+        """Overestimate-only recent-GET heat for one object — the
+        hot-read cache admission signal (decays with the sketches)."""
+        return self._key_cm.estimate((bucket or OTHER) + "/" + key)
+
+    def memory_bytes(self) -> int:
+        """Rough live footprint of the sketch grid + tables — a gauge,
+        and the number the memory-fence test holds under its ceiling."""
+        tables = (len(self._tenant_top._table)
+                  + len(self._key_top._table)
+                  + len(self._prefix_top._table))
+        return (self._key_cm.memory_bytes() + tables * 128
+                + len(self._bapi) * sys.getsizeof(_Cell())
+                + len(self._tenants) * 1024)
+
+    def metrics_state(self) -> dict:
+        """Scrape-time snapshot for admin/metrics.py
+        ``_metering_gauges`` (mt_bucket_*/mt_tenant_* families)."""
+        bucket_rows = [
+            (b, api, c.requests, c.errors, c.rx, c.tx)
+            for (b, api), c in sorted(self._bapi.items())]
+        tenant_rows = [
+            (t, c.requests, c.errors, c.rx, c.tx,
+             c.window.p50(), c.window.p99())
+            for t, c in sorted(self._tenants.items())]
+        return {"bucketRows": bucket_rows, "tenantRows": tenant_rows,
+                "memoryBytes": self.memory_bytes(),
+                "decays": self.decays}
+
+    def top_doc(self) -> dict:
+        """One node's ``top`` v2 sections, shared by the local admin
+        route and the ``metering_top`` peer RPC (peer aggregation
+        merges these docs with :func:`merge_top_docs`)."""
+        tenants = [
+            {"tenant": t, "requests": c.requests, "errors": c.errors,
+             "rxBytes": c.rx, "txBytes": c.tx,
+             "p50Ns": c.window.p50(), "p99Ns": c.window.p99()}
+            for t, c in sorted(self._tenants.items())]
+        tenants.sort(key=lambda r: -(r["rxBytes"] + r["txBytes"]))
+        hot_keys = [
+            {"key": k, "count": c, "error": e}
+            for k, c, e in self._key_top.top()]
+        hot_prefixes = [
+            {"prefix": k, "count": c, "error": e}
+            for k, c, e in self._prefix_top.top()]
+        return {
+            "node": self.node_name,
+            "tenants": tenants,
+            "hotKeys": hot_keys,
+            "hotPrefixes": hot_prefixes,
+            "sketch": {
+                "n": self._key_top.n,
+                "keyK": self._key_top.k,
+                "thresholdCount": round(self._key_top.threshold(), 1),
+                "epsilon": self._key_cm.epsilon(),
+                "memoryBytes": self.memory_bytes(),
+                "decays": self.decays,
+            },
+        }
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_server(cls, srv) -> "Metering | None":
+        """Build from the ``metering`` kvconfig subsystem; None when
+        disabled (the idle contract) or on any bad knob."""
+        cfg = srv.config
+        try:
+            if (cfg.get("metering", "enable") or "off") != "on":
+                return None
+
+            def num(key: str, default: int) -> int:
+                return int(cfg.get("metering", key) or default)
+
+            return cls(
+                max_buckets=num("max_buckets", 48),
+                tenant_k=num("tenant_k", 24),
+                key_k=num("key_k", 64),
+                prefix_k=num("prefix_k", 32),
+                cm_width=num("cm_width", 2048),
+                cm_depth=num("cm_depth", 4),
+                seed=num("seed", 1),
+                decay_interval_s=parse_duration(
+                    cfg.get("metering", "decay_interval") or "60s",
+                    60.0),
+                node_name=getattr(srv, "node_name", ""))
+        except Exception:  # noqa: BLE001 — a bad knob must not take
+            return None    # the server down
+
+
+def merge_top_docs(docs: List[dict]) -> dict:
+    """Aggregate per-node ``top_doc`` sections into one cluster view:
+    tenant counters sum (p99 takes the max — a tenant is as slow as
+    its slowest node), hot keys/prefixes sum per key and re-rank."""
+    tenants: Dict[str, dict] = {}
+    keys: Dict[str, dict] = {}
+    prefixes: Dict[str, dict] = {}
+    nodes = []
+    for doc in docs:
+        if not isinstance(doc, dict):
+            continue
+        if doc.get("node"):
+            nodes.append(doc["node"])
+        for row in doc.get("tenants") or []:
+            agg = tenants.setdefault(row["tenant"], {
+                "tenant": row["tenant"], "requests": 0, "errors": 0,
+                "rxBytes": 0, "txBytes": 0, "p50Ns": 0, "p99Ns": 0})
+            agg["requests"] += row.get("requests", 0)
+            agg["errors"] += row.get("errors", 0)
+            agg["rxBytes"] += row.get("rxBytes", 0)
+            agg["txBytes"] += row.get("txBytes", 0)
+            agg["p50Ns"] = max(agg["p50Ns"], row.get("p50Ns", 0))
+            agg["p99Ns"] = max(agg["p99Ns"], row.get("p99Ns", 0))
+        for row in doc.get("hotKeys") or []:
+            agg = keys.setdefault(row["key"], {
+                "key": row["key"], "count": 0, "error": 0})
+            agg["count"] += row.get("count", 0)
+            agg["error"] += row.get("error", 0)
+        for row in doc.get("hotPrefixes") or []:
+            agg = prefixes.setdefault(row["prefix"], {
+                "prefix": row["prefix"], "count": 0, "error": 0})
+            agg["count"] += row.get("count", 0)
+            agg["error"] += row.get("error", 0)
+    out_tenants = sorted(tenants.values(),
+                         key=lambda r: -(r["rxBytes"] + r["txBytes"]))
+    out_keys = sorted(keys.values(),
+                      key=lambda r: (-r["count"], r["key"]))
+    out_prefixes = sorted(prefixes.values(),
+                          key=lambda r: (-r["count"], r["prefix"]))
+    return {"nodes": nodes, "tenants": out_tenants,
+            "hotKeys": out_keys, "hotPrefixes": out_prefixes}

@@ -1,0 +1,186 @@
+"""Heavy-hitter sketches for the workload attribution plane.
+
+Two classic streaming summaries, both seeded and deterministic so the
+unit tier can pin their error bounds without statistical slack:
+
+* ``SpaceSaving`` — Metwally et al.'s top-K summary.  Memory is
+  strictly O(K).  The guarantee the tests pin: after N offers, any key
+  whose true count exceeds ``N / K`` is present in the table, and every
+  tabled estimate is an overestimate by at most its recorded ``error``
+  (``count - error <= true <= count``).
+* ``CountMin`` — Cormode/Muthukrishnan count-min sketch over a fixed
+  ``depth x width`` grid of counters (``array('q')`` rows, so the
+  memory footprint is a flat ``depth * width * 8`` bytes regardless of
+  how many distinct keys flow through).  Estimates are overestimate-
+  only: ``true <= estimate <= true + eps * N`` with
+  ``eps = e / width`` at probability ``1 - exp(-depth)``; the seeded
+  unit tier asserts the one-sided bound exactly and the epsilon bound
+  on a fixed stream.
+
+Both support ``decay`` (halving, so "heat" means *recent* heat) and
+``merge`` for peer aggregation of the admin ``top`` v2 route.  Hashing
+is ``zlib.crc32`` with per-row seed prefixes — Python's builtin
+``hash()`` is process-randomized and would break cross-node merge and
+seeded tests.
+"""
+
+from __future__ import annotations
+
+import zlib
+from array import array
+from typing import Dict, Iterable, List, Tuple
+
+
+def _h(seed: int, key: str) -> int:
+    """Deterministic 32-bit hash of ``key`` under ``seed``."""
+    return zlib.crc32(key.encode("utf-8", "surrogatepass"),
+                      seed & 0xFFFFFFFF)
+
+
+class SpaceSaving:
+    """Top-K heavy hitters with O(K) memory.
+
+    The table maps key -> [count, error]; ``count`` is an upper bound
+    on the key's true frequency and ``error`` the worst-case
+    overcharge it inherited when it evicted the previous minimum.
+    """
+
+    def __init__(self, k: int, seed: int = 0):
+        self.k = max(1, int(k))
+        self.seed = seed
+        self.n = 0                       # total offered mass
+        self._table: Dict[str, List[int]] = {}
+
+    def offer(self, key: str, inc: int = 1) -> None:
+        self.n += inc
+        cell = self._table.get(key)
+        if cell is not None:
+            cell[0] += inc
+            return
+        if len(self._table) < self.k:
+            self._table[key] = [inc, 0]
+            return
+        # replace the current minimum; the newcomer inherits its count
+        # as both estimate floor and error ceiling
+        mkey = min(self._table, key=lambda kk: self._table[kk][0])
+        mcount = self._table[mkey][0]
+        del self._table[mkey]
+        self._table[key] = [mcount + inc, mcount]
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._table
+
+    def __len__(self) -> int:
+        return len(self._table)
+
+    def estimate(self, key: str) -> Tuple[int, int]:
+        """(count, error) — count is an upper bound, count - error a
+        lower bound; (0, 0) for untabled keys."""
+        cell = self._table.get(key)
+        return (cell[0], cell[1]) if cell is not None else (0, 0)
+
+    def top(self, n: int | None = None) -> List[Tuple[str, int, int]]:
+        """(key, count, error) rows, largest count first; ties broken
+        by key so the order is deterministic."""
+        rows = sorted(((k, c, e) for k, (c, e) in self._table.items()),
+                      key=lambda r: (-r[1], r[0]))
+        return rows if n is None else rows[:n]
+
+    def threshold(self) -> float:
+        """Any key with true count above this is guaranteed tabled."""
+        return self.n / self.k
+
+    def decay(self, factor: float = 0.5) -> None:
+        """Scale every count/error (and N) down; zeroed keys drop out
+        so stale heavy hitters age away instead of squatting slots."""
+        self.n = int(self.n * factor)
+        dead = []
+        for key, cell in self._table.items():
+            cell[0] = int(cell[0] * factor)
+            cell[1] = int(cell[1] * factor)
+            if cell[0] <= 0:
+                dead.append(key)
+        for key in dead:
+            del self._table[key]
+
+    def merge(self, other: "SpaceSaving") -> None:
+        """Fold ``other`` in (peer aggregation).  Union the tables
+        summing counts/errors, keep the K largest.  Approximate — a
+        key absent from one table contributes nothing for that node —
+        but overestimate-only is preserved and any key heavy in the
+        combined stream stays tabled."""
+        for key, (c, e) in other._table.items():
+            cell = self._table.get(key)
+            if cell is not None:
+                cell[0] += c
+                cell[1] += e
+            else:
+                self._table[key] = [c, e]
+        self.n += other.n
+        if len(self._table) > self.k:
+            keep = self.top(self.k)
+            self._table = {k: [c, e] for k, c, e in keep}
+
+    def to_doc(self) -> dict:
+        return {"k": self.k, "n": self.n,
+                "table": {k: [c, e]
+                          for k, (c, e) in self._table.items()}}
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "SpaceSaving":
+        ss = cls(int(doc.get("k", 1)))
+        ss.n = int(doc.get("n", 0))
+        ss._table = {str(k): [int(v[0]), int(v[1])]
+                     for k, v in (doc.get("table") or {}).items()}
+        return ss
+
+
+class CountMin:
+    """Count-min sketch: fixed-size counter grid, overestimate-only
+    point queries, elementwise merge."""
+
+    def __init__(self, width: int = 2048, depth: int = 4,
+                 seed: int = 0):
+        self.width = max(8, int(width))
+        self.depth = max(1, int(depth))
+        self.seed = seed
+        self.n = 0
+        self._rows = [array("q", [0]) * self.width
+                      for _ in range(self.depth)]
+
+    def _slots(self, key: str) -> Iterable[Tuple[int, int]]:
+        for d in range(self.depth):
+            yield d, _h(self.seed * 0x9E3779B1 + d + 1, key) \
+                % self.width
+
+    def add(self, key: str, inc: int = 1) -> None:
+        self.n += inc
+        for d, slot in self._slots(key):
+            self._rows[d][slot] += inc
+
+    def estimate(self, key: str) -> int:
+        return min(self._rows[d][slot]
+                   for d, slot in self._slots(key))
+
+    def epsilon(self) -> float:
+        """est <= true + epsilon() * n with prob 1 - exp(-depth)."""
+        return 2.718281828459045 / self.width
+
+    def decay(self, factor: float = 0.5) -> None:
+        self.n = int(self.n * factor)
+        for row in self._rows:
+            for i in range(self.width):
+                row[i] = int(row[i] * factor)
+
+    def merge(self, other: "CountMin") -> None:
+        if (other.width, other.depth, other.seed) != \
+                (self.width, self.depth, self.seed):
+            raise ValueError("count-min dimensions/seed mismatch")
+        self.n += other.n
+        for d in range(self.depth):
+            mine, theirs = self._rows[d], other._rows[d]
+            for i in range(self.width):
+                mine[i] += theirs[i]
+
+    def memory_bytes(self) -> int:
+        return sum(row.itemsize * len(row) for row in self._rows)

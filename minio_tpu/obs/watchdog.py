@@ -28,6 +28,15 @@ The rule catalog (``RULE_NAMES``):
   window with zero byte progress.
 * ``pool_days_to_full`` — linear trend on ``mt_pool_usage_bytes``
   against the pool's capacity share.
+* ``tenant_burn`` — per-tenant SLO burn over the fast window: the
+  metering plane's ``mt_tenant_errors_total`` mass divided by its
+  ``mt_tenant_requests_total`` mass, against the same
+  ``slo_objective``; one misbehaving access key pages by NAME
+  instead of smearing its errors across the per-API burn rules.
+* ``noisy_neighbor`` — per-tenant byte-share over the fast window:
+  a tenant moving ≥ ``noisy_share`` of all metered bytes while at
+  least ``noisy_min_tenants`` tenants are active (a lone tenant owns
+  100% of the cluster by construction — that is not noise).
 
 Idle contract: ``watchdog.enable=off`` (the default) means no engine,
 no sampler thread, no ``mt_alert_*``/``mt_history_*`` family in the
@@ -56,6 +65,8 @@ RULE_NAMES = (
     "deadletter_growth",
     "rebalance_stall",
     "pool_days_to_full",
+    "tenant_burn",
+    "noisy_neighbor",
 )
 
 _RECENT_CAP = 64
@@ -65,6 +76,7 @@ _STATUS_RE = re.compile(r'status="(\d+)"')
 _DRIVE_RE = re.compile(r'drive="((?:[^"\\]|\\.)*)"')
 _TARGET_RE = re.compile(r'target="((?:[^"\\]|\\.)*)"')
 _POOL_RE = re.compile(r'pool="((?:[^"\\]|\\.)*)"')
+_TENANT_RE = re.compile(r'tenant="((?:[^"\\]|\\.)*)"')
 
 
 def _mean(points: list) -> float:
@@ -100,6 +112,11 @@ class WatchdogSys:
                  deadletter_growth: float = 10.0,
                  stall_window_s: float = 300.0,
                  days_to_full: float = 7.0,
+                 tenant_burn_factor: float = 6.0,
+                 tenant_min_rps: float = 1.0,
+                 noisy_share: float = 0.5,
+                 noisy_min_tenants: int = 2,
+                 noisy_min_bps: float = 1e6,
                  pending_for: int = 2,
                  cooldown_s: float = 300.0,
                  forensic_rules: Tuple[str, ...] = (),
@@ -127,6 +144,11 @@ class WatchdogSys:
         self.deadletter_growth = deadletter_growth
         self.stall_window_s = stall_window_s
         self.days_to_full = days_to_full
+        self.tenant_burn_factor = tenant_burn_factor
+        self.tenant_min_rps = tenant_min_rps
+        self.noisy_share = min(1.0, max(0.0, noisy_share))
+        self.noisy_min_tenants = max(2, noisy_min_tenants)
+        self.noisy_min_bps = max(0.0, noisy_min_bps)
         self.pending_for = max(1, pending_for)
         self.cooldown_s = cooldown_s
         self.forensic_rules = tuple(forensic_rules)
@@ -215,6 +237,11 @@ class WatchdogSys:
                 deadletter_growth=num("deadletter_growth", 10.0),
                 stall_window_s=dur("stall_window", "5m"),
                 days_to_full=num("days_to_full", 7.0),
+                tenant_burn_factor=num("tenant_burn_factor", 6.0),
+                tenant_min_rps=num("tenant_min_rps", 1.0),
+                noisy_share=num("noisy_share", 0.5),
+                noisy_min_tenants=int(num("noisy_min_tenants", 2)),
+                noisy_min_bps=num("noisy_min_bps", 1e6),
                 pending_for=int(num("pending_for", 2)),
                 cooldown_s=dur("cooldown", "5m"),
                 forensic_rules=forensic_rules,
@@ -535,6 +562,83 @@ class WatchdogSys:
                     "capacityShareBytes": int(cap_share),
                     "usedBytes": int(vs[-1]),
                     "threshold": self.days_to_full}
+
+    def _rule_tenant_burn(self, now_s: float):
+        """Per-tenant burn rate over the fast window, same algebra as
+        ``_burn`` but over the metering plane's tenant counters (which
+        only count 5xx, so no status filter).  The ``_other`` overflow
+        row is skipped — an alert naming nobody pages nobody."""
+        errors = self.history.query("mt_tenant_errors_total",
+                                    window_s=self.burn_fast_window_s,
+                                    step_s=1, agg="sum", now_s=now_s)
+        totals = self.history.query("mt_tenant_requests_total",
+                                    window_s=self.burn_fast_window_s,
+                                    step_s=1, agg="sum", now_s=now_s)
+        rates = self.history.query("mt_tenant_requests_total",
+                                   window_s=self.burn_fast_window_s,
+                                   step_s=1, agg="avg", now_s=now_s)
+        err_by_tenant: Dict[str, float] = {}
+        for (_, labels), points in errors.items():
+            m = _TENANT_RE.search(labels)
+            if m is None:
+                continue
+            err_by_tenant[m.group(1)] = \
+                err_by_tenant.get(m.group(1), 0.0) + \
+                sum(v for _, v in points)
+        for key, points in totals.items():
+            m = _TENANT_RE.search(key[1])
+            if m is None or m.group(1) == "_other":
+                continue
+            tenant = m.group(1)
+            rps = _mean(rates.get(key, []))
+            mass = sum(v for _, v in points)
+            if rps < self.tenant_min_rps or mass <= 0:
+                continue
+            ratio = err_by_tenant.get(tenant, 0.0) / mass
+            burn = ratio / self.slo_objective
+            if burn >= self.tenant_burn_factor:
+                yield tenant, round(burn, 2), {
+                    "windowSeconds": self.burn_fast_window_s,
+                    "requestsPerSecond": rps,
+                    "errorRate": round(ratio, 5),
+                    "objective": self.slo_objective,
+                    "burnRate": round(burn, 2),
+                    "threshold": self.tenant_burn_factor}
+
+    def _rule_noisy_neighbor(self, now_s: float):
+        """Per-tenant byte-share (rx+tx) over the fast window.  The
+        ``_other`` overflow row counts toward the denominator (it IS
+        traffic) but never alerts; a share only means anything once
+        ``noisy_min_tenants`` distinct tenants are moving bytes."""
+        bps_by_tenant: Dict[str, float] = {}
+        for fam in ("mt_tenant_rx_bytes_total",
+                    "mt_tenant_tx_bytes_total"):
+            data = self.history.query(fam,
+                                      window_s=self.burn_fast_window_s,
+                                      step_s=1, agg="avg", now_s=now_s)
+            for (_, labels), points in data.items():
+                m = _TENANT_RE.search(labels)
+                if m is None:
+                    continue
+                bps_by_tenant[m.group(1)] = \
+                    bps_by_tenant.get(m.group(1), 0.0) + _mean(points)
+        active = {t: b for t, b in bps_by_tenant.items() if b > 0}
+        total_bps = sum(active.values())
+        if len(active) < self.noisy_min_tenants or \
+                total_bps < self.noisy_min_bps:
+            return
+        for tenant, bps in sorted(active.items()):
+            if tenant == "_other":
+                continue
+            share = bps / total_bps
+            if share >= self.noisy_share:
+                yield tenant, round(share, 3), {
+                    "windowSeconds": self.burn_fast_window_s,
+                    "bytesPerSecond": int(bps),
+                    "totalBytesPerSecond": int(total_bps),
+                    "share": round(share, 3),
+                    "activeTenants": len(active),
+                    "threshold": self.noisy_share}
 
     # -- read back ------------------------------------------------------------
 

@@ -212,6 +212,13 @@ def register_peer_service(rpc: RPCServer, srv) -> None:
         from ..admin.handlers import alerts_reply
         return alerts_reply(srv)
 
+    # Workload attribution plane (admin `top` v2 aggregation): the
+    # same shared builder as the local route, so local and peer legs
+    # can never drift apart in shape
+    def metering_top():
+        from ..admin.handlers import metering_top_reply
+        return metering_top_reply(srv)
+
     rpc.register("peer", {
         "reload_bucket_meta": reload_bucket_meta,
         "reload_iam": reload_iam,
@@ -235,6 +242,7 @@ def register_peer_service(rpc: RPCServer, srv) -> None:
         "trace_tree_query": trace_tree_query,
         "history_query": history_query,
         "alerts_query": alerts_query,
+        "metering_top": metering_top,
     })
 
 

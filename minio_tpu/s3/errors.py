@@ -159,7 +159,7 @@ _ERRORS = {
         "state of the bucket.", 409),
     "AdminBucketQuotaExceeded": APIError(
         "XMinioAdminBucketQuotaExceeded",
-        "Bucket quota may be exceeded with this request.", 400),
+        "Bucket quota may be exceeded with this request.", 403),
     "ReplicationDestinationNotFoundError": APIError(
         "ReplicationDestinationNotFoundError",
         "The replication destination bucket does not exist", 404),

@@ -385,15 +385,30 @@ def _fetch_plain_chunks(self, bucket, key, block: int, oi=None):
     return oi, chunks
 
 def _check_quota(self, bucket: str, nbytes: int) -> None:
-    """Hard-quota admission (cmd/bucket-quota.go); needs the
-    crawler's usage cache to be attached."""
+    """Hard-quota admission (cmd/bucket-quota.go
+    enforceBucketQuotaHard): rejects BEFORE any drive fan-out,
+    charging the crawler snapshot + the in-flight byte delta
+    (background/crawler.py UsageCache).  The quota config is read
+    first so quota-free buckets pay nothing; ``quota.enable=off``
+    is the operator kill switch."""
     if self.srv.usage is None:
         return
     from ..bucket.quota import Quota
     raw = self.srv.bucket_meta.get_config(bucket, "quota")
-    if raw and not Quota.parse(raw.encode()).allows(
+    if not raw:
+        return
+    if self.srv.config.get("quota", "enable") != "on":
+        return
+    if not Quota.parse(raw.encode()).allows(
             self.srv.usage.bucket_size(bucket), nbytes):
         raise S3Error("AdminBucketQuotaExceeded")
+
+def _charge_quota_usage(self, bucket: str, nbytes: int) -> None:
+    """A committed write moves the in-flight usage delta so the NEXT
+    quota check sees these bytes (cleared when a crawler snapshot
+    lands — the scan accounts them from then on)."""
+    if self.srv.usage is not None and nbytes > 0:
+        self.srv.usage.add_pending(bucket, nbytes)
 
 # -- SSE helpers (cmd/encryption-v1.go) ----------------------------
 
@@ -488,6 +503,7 @@ def _upload_part(self, bucket, key, query, payload):
                                            payload)
     pi = self.srv.layer.put_object_part(bucket, key, uid, part_num,
                                    payload)
+    self._charge_quota_usage(bucket, pi.size)
     self._send(200, headers={"ETag": f'"{pi.etag}"', **sse_hdrs})
 
 def _encrypt_part(self, bucket, key, uid,
@@ -532,6 +548,12 @@ def _complete_multipart(self, bucket, key, query, payload):
             bucket, key, uid)), default=0)
     except Exception:  # noqa: BLE001 — unknown upload: the layer call
         staged = 0     # below raises the proper S3 error
+    # hard-quota gate BEFORE assembly fan-out: the staged parts were
+    # already charged to the in-flight delta at upload time, so the
+    # incoming size here is 0 — the check rejects a complete that
+    # would SEAL a bucket already past its quota, without double-
+    # counting the parts
+    self._check_quota(bucket, 0)
     with GOVERNOR.charge(staged, "multipart"):
         oi = self.srv.layer.complete_multipart_upload(bucket, key, uid,
                                                       parts)
@@ -749,6 +771,7 @@ def _stream_put_object(self, bucket, key, reader, cl: int):
             parity=self._storage_class_parity(user_defined)))
     if tiered_ud is not None:
         self.srv.transition.delete_tiered(tiered_ud)
+    self._charge_quota_usage(bucket, oi.size)
     hdrs = {"ETag": f'"{oi.etag}"'}
     if oi.version_id:
         hdrs["x-amz-version-id"] = oi.version_id
@@ -767,6 +790,7 @@ def _stream_upload_part(self, bucket, key, query, reader,
     self._check_quota(bucket, cl)
     pi = self.srv.layer.put_object_part(bucket, key, uid, part_num,
                                    reader)
+    self._charge_quota_usage(bucket, pi.size)
     self._send(200, headers={"ETag": f'"{pi.etag}"'})
 
 def _put_object(self, bucket, key, query, payload):
@@ -821,6 +845,7 @@ def _store_object(self, bucket, key, payload, user_defined,
             parity=self._storage_class_parity(user_defined)))
     if tiered_ud is not None:
         self.srv.transition.delete_tiered(tiered_ud)
+    self._charge_quota_usage(bucket, oi.size)
     hdrs = {"ETag": f'"{oi.etag}"'}
     hdrs.update(csse.response_headers(user_defined))
     if oi.version_id:
@@ -946,6 +971,7 @@ def _copy_object(self, bucket, key, query):
         ol.PutObjectOptions(
             user_defined=user_defined, versioned=versioned,
             parity=self._storage_class_parity(user_defined)))
+    self._charge_quota_usage(bucket, oi.size)
     root = ET.Element("CopyObjectResult", xmlns=S3_NS)
     ET.SubElement(root, "ETag").text = f'"{oi.etag}"'
     ET.SubElement(root, "LastModified").text = _iso_date(oi.mod_time)
@@ -975,6 +1001,7 @@ def _upload_part_copy(self, bucket, key, query):
     data, _ = self._encrypt_part(bucket, key, uid, data)
     pi = self.srv.layer.put_object_part(bucket, key, uid, part_num,
                                    data)
+    self._charge_quota_usage(bucket, pi.size)
     root = ET.Element("CopyPartResult", xmlns=S3_NS)
     ET.SubElement(root, "ETag").text = f'"{pi.etag}"'
     ET.SubElement(root, "LastModified").text = \
@@ -1406,6 +1433,7 @@ HANDLERS = [
     "_object_api", "_vid", "_object_tagging", "_object_retention",
     "_object_legal_hold", "_governance_bypass", "_select_object",
     "_fetch_plain_chunks", "_plain_size_estimate", "_check_quota",
+    "_charge_quota_usage",
     "_bucket_sse_algo", "_sse_for_put",
     "_compress_for_put", "_tagging_header_meta", "_create_multipart",
     "_upload_part", "_encrypt_part", "_complete_multipart",

@@ -293,7 +293,13 @@ class S3Server:
         self.events = NotificationSys(self.bucket_meta, region=region)
         # wired in by server_main / tests when those subsystems are enabled
         self.replication = None  # ReplicationSys (minio_tpu/background)
-        self.usage = None        # data-usage cache (crawler)
+        # quota's usage view (background/crawler.py UsageCache): the
+        # last persisted crawler snapshot + a lock-cheap in-flight byte
+        # delta.  Always attached, so hard bucket quotas enforce with
+        # or without a running crawler (the cache lazily re-reads the
+        # persisted usage.json when no cycle refreshes it).
+        from ..background.crawler import UsageCache
+        self.usage = UsageCache(object_layer)
         self.healer = None       # BackgroundHealer sweep
         self.crawler = None      # Crawler (scanner plane)
         self.mrf = None          # MRFQueue
@@ -435,6 +441,14 @@ class S3Server:
         # family in the scrape
         self.watchdog = None
         self.reload_watchdog_config()
+        # workload attribution plane (obs/metering.py): bounded
+        # per-(bucket, api, access-key) registry + heavy-hitter
+        # sketches (``metering`` kvconfig subsystem); None when
+        # disabled — the idle contract means no charge branch at
+        # completion-record time and no mt_bucket_*/mt_tenant_*
+        # family in the scrape
+        self.metering = None
+        self.reload_metering_config()
 
     def reload_api_config(self) -> None:
         """(Re)derive the request-plane knobs from the ``api`` kvconfig
@@ -552,11 +566,18 @@ class S3Server:
             w = stats.windows.get("GetObject")
             return w.total()[0] if w is not None else 0
 
+        # per-key admission heat: when the metering plane is armed its
+        # count-min estimate gates admission per OBJECT; otherwise the
+        # plane falls back to the global GetObject rate above
+        metering = getattr(self, "metering", None)
+        heat_key = metering.key_heat if metering is not None else None
+
         from ..objectlayer.metacache import leaf_layers_of
         for leaf in leaf_layers_of(self.layer):
             plane = getattr(leaf, "hotread", None)
             if plane is not None:
                 plane.heat_fn = _get_heat
+                plane.heat_key_fn = heat_key
                 if not _hotread.CONFIG.enable:
                     plane.clear()
 
@@ -609,6 +630,20 @@ class S3Server:
             self.watchdog = None       # take the server down
         if self.watchdog is not None:
             self.watchdog.start()
+
+    def reload_metering_config(self) -> None:
+        """(Re)build the workload attribution plane from the
+        ``metering`` kvconfig subsystem — at boot and after admin
+        SetConfigKV.  A reload replaces the registry wholesale
+        (counters and sketches reset, documented in the subsystem
+        comment), then re-runs the cache reload so every hot-read
+        plane's per-key heat source follows the swap."""
+        from ..obs.metering import Metering
+        try:
+            self.metering = Metering.from_server(self)
+        except Exception:  # noqa: BLE001 — a bad knob value must not
+            self.metering = None       # take the server down
+        self.reload_cache_config()
 
     def reload_background_config(self) -> None:
         """Push the ``heal``/``scanner`` pacing knobs into every
@@ -769,6 +804,12 @@ class S3Server:
         (initDataCrawler / initBackgroundHealing, cmd/server-main.go)."""
         self._background = getattr(self, "_background", [])
         self._background.extend(services)
+        for svc in services:
+            # a crawler refreshes this server's quota usage view at
+            # the end of every cycle (duck-typed on the attribute so
+            # test fakes without it still attach)
+            if hasattr(svc, "usage_cache"):
+                svc.usage_cache = self.usage
         # late attachments pick up the ``heal``/``scanner`` pacing
         # knobs the boot-time reload could not reach
         self.reload_background_config()
@@ -1472,6 +1513,17 @@ def _make_handler(srv: S3Server):
                 # unlike the wall-clock trace timestamps
                 srv.api_stats.record(api_name, dur_mono,
                                      self._rx_bytes + self._resp_bytes)
+                # workload attribution (obs/metering.py): same S3-only
+                # scoping as the per-API families; the registry bounds
+                # label cardinality internally (sketch-gated tenant
+                # rows, capped bucket table, keys never become labels)
+                if getattr(srv, "metering", None) is not None:
+                    srv.metering.charge(
+                        bucket=bucket, api=api_name,
+                        tenant=getattr(self, "access_key", ""),
+                        key=key, status=self._resp_status,
+                        rx=self._rx_bytes, tx=self._resp_bytes,
+                        dur_ns=dur_mono)
             if srv.trace_hub.active:
                 srv.trace_hub.publish(_trace.make_trace(
                     srv.node_name, api_name,

@@ -95,6 +95,18 @@ class Scenario:
     # with MT_WATCHDOG_ENABLE=on in ``env``.  The watchdog verdict
     # (_watchdog_summary) feeds the Budget's alert rows.
     watchdog: bool = False
+    # workload attribution scenario (ISSUE 19): extra per-tenant
+    # workloads beside the root generator's.  Each entry is
+    # (access_key, Mix, workers) — the runner mints the IAM user
+    # (readwrite policy), gives it its own bucket, and drives one
+    # WorkloadGenerator per tenant concurrently; per-tenant verdicts
+    # feed the Budget's noisy-neighbor / quota rows.  When
+    # ``quota_bytes`` is set, the FIRST tenant (the noisy one) gets a
+    # HARD quota on its bucket through the live admin surface before
+    # its workload starts, so its writes bounce mid-storm on the real
+    # enforcement path
+    tenants: tuple = ()
+    quota_bytes: int = 0
 
 
 # chaos knobs every scenario runs under: snappy breakers so fault
@@ -379,6 +391,90 @@ def watchdog_smoke_scenario(duration_s: float = 5.0) -> Scenario:
              "MT_WATCHDOG_INTERVAL": "1s"})
 
 
+# the noisy tenant's mix (ISSUE 19): zipf-skewed GET/PUT over objects
+# an order of magnitude larger than the well-behaved mixes — it moves
+# most of the cluster's bytes (the noisy_neighbor rule's byte-share
+# numerator) and its PUT churn marches the bucket into its hard quota
+_NOISY_MIX = Mix("tenant_noisy",
+                 {"get": 0.55, "put": 0.35, "head": 0.10},
+                 sizes_bytes=(65536, 262144), key_space=12, zipf=1.2)
+
+
+def tenant_storm_scenario(duration_s: float = 20.0) -> Scenario:
+    """ISSUE 19 acceptance: one zipf-heavy noisy tenant (large
+    objects, its bucket under a hard quota) storms beside two
+    well-behaved tenants and the root mix, with the metering plane
+    and the watchdog's tenant rules live.  The SLO sweep asserts the
+    ``noisy_neighbor`` alert fired naming EXACTLY the noisy tenant
+    (byte-share attribution from the metering counters riding the
+    history rings), the innocents' client-observed p99 stayed green,
+    the noisy tenant's writes were rejected with
+    ``XMinioAdminBucketQuotaExceeded`` (never an innocent's), and
+    rejections never dead-lettered telemetry.  No chaos timeline: the
+    only "fault" is the neighbor."""
+    return Scenario(
+        name="tenant_storm", mix=MIXES["get_heavy_small"],
+        timeline=[],
+        duration_s=duration_s,
+        budget=_slo.Budget(
+            require_watchdog=True,
+            require_metering=True,
+            expect_alert_fired=("noisy_neighbor",),
+            # quota 403s are 4xx — the 5xx-only tenant error counters
+            # stay flat, so the burn rules must hold their silence
+            expect_alert_quiet=("tenant_burn", "slo_burn_fast",
+                                "slo_burn_slow"),
+            expect_noisy_tenant="tenant-noisy",
+            expect_quota_rejections=True,
+            require_no_forensics=True),
+        workers=2, watchdog=True,
+        tenants=(("tenant-noisy", _NOISY_MIX, 3),
+                 ("tenant-a", MIXES["get_heavy_small"], 2),
+                 ("tenant-b", MIXES["get_heavy_small"], 2)),
+        # above the noisy preload (~5.8 MiB: 3 workers x 12 keys x
+        # ~160 KiB), crossed by its PUT churn mid-storm
+        quota_bytes=12 << 20,
+        env={"MT_METERING_ENABLE": "on",
+             "MT_WATCHDOG_ENABLE": "on",
+             "MT_WATCHDOG_INTERVAL": "1s",
+             # the byte-share window reads the fine ring so the share
+             # reflects the storm, not a cold start
+             "MT_WATCHDOG_BURN_FAST_WINDOW": "10s",
+             # CI boxes move fewer bytes than the 1 MB/s production
+             # floor — the rule must still see "real" traffic
+             "MT_WATCHDOG_NOISY_MIN_BPS": "200000"})
+
+
+def tenant_smoke_scenario(duration_s: float = 8.0) -> Scenario:
+    """The tier-1 workload-attribution miniature: one noisy tenant
+    (quota'd bucket, large zipf objects) beside one innocent, sized
+    for CI — same naming/quota/innocent contract as tenant_storm."""
+    return Scenario(
+        name="smoke_tenant", mix=MIXES["get_heavy_small"],
+        timeline=[],
+        duration_s=duration_s,
+        budget=_slo.Budget(
+            converge_timeout_s=30.0,
+            require_watchdog=True,
+            require_metering=True,
+            expect_alert_fired=("noisy_neighbor",),
+            expect_alert_quiet=("tenant_burn",),
+            expect_noisy_tenant="tenant-noisy",
+            expect_quota_rejections=True,
+            require_no_forensics=True),
+        workers=1, watchdog=True,
+        tenants=(("tenant-noisy", _NOISY_MIX, 2),
+                 ("tenant-a", MIXES["get_heavy_small"], 1)),
+        # just above the noisy preload (2 workers x 12 keys x
+        # ~160 KiB ~= 3.8 MiB) so the quota trips within seconds
+        quota_bytes=5 << 20,
+        env={"MT_METERING_ENABLE": "on",
+             "MT_WATCHDOG_ENABLE": "on",
+             "MT_WATCHDOG_INTERVAL": "1s",
+             "MT_WATCHDOG_BURN_FAST_WINDOW": "10s",
+             "MT_WATCHDOG_NOISY_MIN_BPS": "100000"})
+
+
 # the elastic-topology mix: churn (delete + re-put) keeps minting
 # "new" names after preload, which is what lets the free-space router
 # actually spread writes onto a pool added mid-storm (an overwrite of
@@ -523,6 +619,9 @@ def run_scenario(scenario: Scenario, base_dir: str,
                 cluster.endpoint, cluster.s3.iam.root.access_key,
                 cluster.s3.iam.root.secret_key, scenario.mix,
                 workers=scenario.workers, seed=seed)
+            tenant_gens: list[WorkloadGenerator] = []
+            if scenario.tenants:
+                tenant_gens = _start_tenants(cluster, scenario, seed)
             huge: dict = {}
             huge_thread = None
             if scenario.huge_put_bytes:
@@ -536,6 +635,8 @@ def run_scenario(scenario: Scenario, base_dir: str,
             if huge_thread is not None:
                 huge_thread.start()
             gen.run_for(scenario.duration_s)
+            for tg in tenant_gens:
+                tg.stop()
             conductor.join(timeout=scenario.duration_s + 30.0)
             if huge_thread is not None:
                 huge_thread.join(timeout=scenario.duration_s + 120.0)
@@ -568,6 +669,8 @@ def run_scenario(scenario: Scenario, base_dir: str,
             if scenario.watchdog:
                 wdsum = _watchdog_summary(cluster, sink,
                                           scenario.budget)
+            tenants_sum = _tenant_summary(scenario, tenant_gens) \
+                if scenario.tenants else None
             scrape_text = _slo.scrape(cluster.endpoint)
             recorder = gen.recorder
             chaos_log = {"applied": conductor.applied,
@@ -585,7 +688,7 @@ def run_scenario(scenario: Scenario, base_dir: str,
             convergence=conv, convergence_error=conv_err,
             threads_before=threads_before, threads_after=threads_after,
             leaked=leaked, forensics=forensics, topology=topology,
-            watchdog=wdsum)
+            watchdog=wdsum, tenants=tenants_sum)
         if scenario.huge_put_bytes:
             rows.append({
                 "scenario": scenario.name,
@@ -610,6 +713,7 @@ def run_scenario(scenario: Scenario, base_dir: str,
                      "passed": True,
                      "detail": {"per_api": recorder.summary(),
                                 "chaos": chaos_log,
+                                "tenants": tenants_sum,
                                 "duration_s": scenario.duration_s,
                                 "seed": seed}})
         status.finish(rows)
@@ -671,6 +775,55 @@ def _forensic_summary(cluster, expect_breach: bool = False) -> dict:
         except Exception as e:  # noqa: BLE001 — verdict rides the row
             out["breach_records_ok"] = False
             out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def _start_tenants(cluster, scenario: Scenario,
+                   seed: int) -> list[WorkloadGenerator]:
+    """Mint one IAM user + bucket + generator per scenario tenant and
+    start them.  The FIRST tenant is the noisy one: when
+    ``quota_bytes`` is set its bucket gets a HARD quota through the
+    live admin surface (the same signed route ``mc admin bucket quota``
+    uses), so enforcement under storm rides the real
+    kvconfig+bucket-metadata path, not a test double."""
+    from ..admin.client import AdminClient
+    admin = AdminClient(cluster.endpoint,
+                        cluster.s3.iam.root.access_key,
+                        cluster.s3.iam.root.secret_key)
+    gens: list[WorkloadGenerator] = []
+    for i, (name, mix, workers) in enumerate(scenario.tenants):
+        cluster.s3.iam.add_user(name, f"{name}-secret-key",
+                                policies=["readwrite"])
+        bucket = f"soak-t-{name.replace('_', '-')}"
+        cluster.layer.make_bucket(bucket)
+        if i == 0 and scenario.quota_bytes:
+            admin.set_bucket_quota(bucket, scenario.quota_bytes)
+        gens.append(WorkloadGenerator(
+            cluster.endpoint, name, f"{name}-secret-key", mix,
+            workers=workers, seed=seed + i + 1, bucket=bucket))
+    for g in gens:
+        g.start()
+    return gens
+
+
+def _tenant_summary(scenario: Scenario,
+                    gens: list[WorkloadGenerator]) -> dict:
+    """Per-tenant client-observed verdicts for the Budget's tenant
+    rows: op/error counts, error codes (the quota rows key on
+    ``XMinioAdminBucketQuotaExceeded``), and GET/PUT p99."""
+    out: dict = {}
+    for (name, _mix, _workers), g in zip(scenario.tenants, gens):
+        r = g.recorder
+        out[name] = {
+            "bucket": g.bucket,
+            "ops": r.ops(),
+            "errors": r.error_count(),
+            "error_codes": dict(r.error_codes),
+            "p99_get_ms": round(
+                r.percentile("GetObject", 0.99) / 1e6, 2),
+            "p99_put_ms": round(
+                r.percentile("PutObject", 0.99) / 1e6, 2),
+        }
     return out
 
 
@@ -761,8 +914,12 @@ def _watchdog_summary(cluster, sink: _AlertSink, budget) -> dict:
             resolved[rule] = resolved.get(rule, 0) + n
     fired_at: dict = {}
     resolved_at: dict = {}
+    # which SUBJECTS each rule fired for — the tenant rows assert
+    # noisy_neighbor named the right tenant, not just that it fired
+    subjects_by_rule: dict = {}
     for a in list(doc["active"]) + list(doc["recent"]):
         rule = a["rule"]
+        subjects_by_rule.setdefault(rule, []).append(a["subject"])
         at = a.get("firedAt")
         if at is not None and at < fired_at.get(rule, float("inf")):
             fired_at[rule] = at
@@ -783,6 +940,7 @@ def _watchdog_summary(cluster, sink: _AlertSink, budget) -> dict:
         "interval_s": wd.sampler.interval_s,
         "fired": fired, "resolved": resolved,
         "fired_at": fired_at, "resolved_at": resolved_at,
+        "subjects_by_rule": subjects_by_rule,
         "predictive": drive_at is not None and
         (burn_at is None or drive_at < burn_at),
         "delivered": len(sink.events),

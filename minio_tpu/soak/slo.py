@@ -114,6 +114,23 @@ class Budget:
     # and carries history.json with sampled series (the road to the
     # breach, not just the instant)
     require_history_bundle: bool = False
+    # workload attribution rows (ISSUE 19): the tenant storm runs the
+    # metering plane live and asserts the mt_tenant_* families are on
+    # the scrape with the heavy-hitter sketch memory bounded
+    require_metering: bool = False
+    # the noisy_neighbor rule must fire naming EXACTLY this tenant
+    # (the metering plane's byte-share attribution), and every OTHER
+    # scenario tenant's client-observed p99 must stay inside
+    # ``innocent_p99_ms`` (0 falls back to ``p99_ms``) — the whole
+    # point of the alert is that the innocents stayed green
+    expect_noisy_tenant: str = ""
+    innocent_p99_ms: float = 0.0
+    # hard bucket quota under storm: the noisy tenant's recorder must
+    # show XMinioAdminBucketQuotaExceeded rejections (enforced BEFORE
+    # drive fan-out) while innocent tenants show zero — and the
+    # standard dead-letter row already pins that rejections never
+    # dead-letter telemetry
+    expect_quota_rejections: bool = False
 
     def limits_for(self, api: str) -> tuple[float, float]:
         return self.per_api_ms.get(api, (self.p50_ms, self.p99_ms))
@@ -367,7 +384,8 @@ def evaluate(scenario: str, *, api_stats=None, api_pcts=None, recorder,
              leaked: list[str] | None = None,
              forensics: dict | None = None,
              topology: dict | None = None,
-             watchdog: dict | None = None) -> list[dict]:
+             watchdog: dict | None = None,
+             tenants: dict | None = None) -> list[dict]:
     """Every SLO assertion for one finished scenario, as
     ``{scenario, metric, value, unit, detail, passed}`` rows (the
     SOAK_r*.json shape).
@@ -533,6 +551,57 @@ def evaluate(scenario: str, *, api_stats=None, api_pcts=None, recorder,
             n = hb.get("series", 0)
             row("history_in_bundle", n, "series",
                 hb.get("enabled", False) and n > 0, hb)
+
+    # workload attribution rows (ISSUE 19): report.py runs one extra
+    # WorkloadGenerator per scenario tenant (own IAM user, own bucket)
+    # and passes per-tenant verdicts through ``tenants``; the watchdog
+    # summary carries the alert subjects so "fired naming the right
+    # tenant" is asserted against the metering plane's attribution,
+    # not just a rule-level count
+    if budget.require_metering:
+        fams = "# TYPE mt_tenant_requests_total" in scrape_text
+        row("metering_families_exposed", 1 if fams else 0, "bool",
+            fams, {"families": "mt_tenant_*, mt_bucket_*"})
+        mem = metric_total(scrape_text,
+                           "mt_metering_sketch_memory_bytes")
+        row("metering_memory_bounded", mem, "bytes",
+            0 < mem <= 8 << 20,
+            {"family": "mt_metering_sketch_memory_bytes",
+             "ceiling_bytes": 8 << 20})
+    if budget.expect_noisy_tenant:
+        w = watchdog or {}
+        subjects = sorted(set(
+            w.get("subjects_by_rule", {}).get("noisy_neighbor", ())))
+        named = subjects == [budget.expect_noisy_tenant]
+        row("noisy_neighbor_named", 1 if named else 0, "bool", named,
+            {"expected": budget.expect_noisy_tenant,
+             "subjects": subjects,
+             "require": "fired for exactly the noisy tenant — an "
+                        "alert naming an innocent pages the wrong "
+                        "team"})
+        lim = budget.innocent_p99_ms or budget.p99_ms
+        for name, t in sorted((tenants or {}).items()):
+            if name == budget.expect_noisy_tenant:
+                continue
+            p99 = max(t.get("p99_get_ms", 0.0),
+                      t.get("p99_put_ms", 0.0))
+            row(f"innocent_p99:{name}", p99, "ms", p99 <= lim,
+                {"budget_ms": lim, **t})
+    if budget.expect_quota_rejections:
+        rej = {name: t.get("error_codes", {}).get(
+                   "XMinioAdminBucketQuotaExceeded", 0)
+               for name, t in sorted((tenants or {}).items())}
+        noisy = rej.get(budget.expect_noisy_tenant, 0)
+        row("quota_rejections", noisy, "rejections", noisy > 0,
+            {"tenant": budget.expect_noisy_tenant,
+             "per_tenant": rej,
+             "code": "XMinioAdminBucketQuotaExceeded"})
+        innocent = sum(n for name, n in rej.items()
+                       if name != budget.expect_noisy_tenant)
+        row("quota_innocent_rejections", innocent, "rejections",
+            innocent == 0,
+            {"per_tenant": rej,
+             "require": "quota never touched an innocent request"})
 
     # forensic-plane rows: clean scenarios must produce ZERO bundles
     # (ordinary chaos is not a breach); the induced-breach drill must

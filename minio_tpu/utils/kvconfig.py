@@ -259,9 +259,35 @@ register_subsys("watchdog", {
     "deadletter_growth": "10",
     "stall_window": "5m",
     "days_to_full": "7",
+    # tenant rules (workload attribution plane, obs/metering.py):
+    # tenant_burn pages when one access key's error rate burns the
+    # slo_objective budget ``tenant_burn_factor`` times too fast over
+    # the fast window (given >= tenant_min_rps); noisy_neighbor pages
+    # the tenant moving >= ``noisy_share`` of all metered bytes while
+    # at least ``noisy_min_tenants`` tenants are active and total
+    # traffic exceeds ``noisy_min_bps`` bytes/s.  Both need
+    # metering.enable=on to see any mt_tenant_* series at all.
+    "tenant_burn_factor": "6",
+    "tenant_min_rps": "1",
+    "noisy_share": "0.5",
+    "noisy_min_tenants": "2",
+    "noisy_min_bps": "1000000",
     "pending_for": "2",
     "cooldown": "5m",
     "forensic_rules": "",
+})
+register_subsys("quota", {  # mt-lint: ok(kvconfig-drift) read per write admission (s3/handlers_object.py _check_quota) — SetConfigKV applies to the very next PUT, no reload hook needed
+    # hard bucket quotas (bucket/quota.py + handlers_object.py
+    # _check_quota): the per-bucket limit itself is set via the admin
+    # set-bucket-quota route; this subsystem is the cluster-wide
+    # enforcement switch.  With enable=on a PUT / part upload /
+    # multipart complete that would push a bucket past its configured
+    # hard quota is rejected with XMinioAdminBucketQuotaExceeded (403)
+    # BEFORE any drive fan-out, charged against the crawler usage
+    # snapshot plus the in-flight byte delta (background/crawler.py
+    # UsageCache).  enable=off keeps quota configs readable but stops
+    # enforcing them.
+    "enable": "on",
 })
 register_subsys("storage_class", {  # mt-lint: ok(kvconfig-drift) read per PUT (handlers_object.py) — validated at SetConfigKV time, applies to the next request
     "standard": "",                 # e.g. EC:4

@@ -95,3 +95,71 @@ def test_remote_target_set_list_remove_roundtrip(served, adm):
         adm.remove_remote_target("srcbkt")
     assert ei.value.status == 404
     assert "no remote target" in str(ei.value)
+
+
+# -- workload attribution plane (ISSUE 19): data-usage, bucket quota
+# round-trip + live enforcement, and the ``top`` v2 route — all
+# through the typed client, so the SDK and the routes stay conformant
+# together ------------------------------------------------------------
+
+
+def test_data_usage_reports_live_quota_cache(served, adm):
+    c = S3Client(served.endpoint, "ak", "as")
+    c.make_bucket("usage-bkt")
+    c.put_object("usage-bkt", "o1", b"x" * 4096)
+    doc = adm.data_usage()
+    # no crawler ran here: the persisted snapshot is absent but the
+    # in-flight quota cache already charged the PUT
+    assert doc["cache"]["pendingBytes"].get("usage-bkt", 0) >= 4096
+
+
+def test_bucket_quota_roundtrip_and_enforcement(served, adm):
+    from minio_tpu.s3.client import S3ClientError
+    c = S3Client(served.endpoint, "ak", "as")
+    c.make_bucket("quota-bkt")
+    assert adm.get_bucket_quota("quota-bkt") == {}
+    adm.set_bucket_quota("quota-bkt", 8192)
+    assert adm.get_bucket_quota("quota-bkt") == \
+        {"quota": 8192, "quotatype": "hard"}
+    c.put_object("quota-bkt", "a", b"x" * 4096)
+    # the next PUT would cross the hard quota: rejected BEFORE drive
+    # fan-out with the madmin error code, HTTP 403
+    with pytest.raises(S3ClientError) as ei:
+        c.put_object("quota-bkt", "b", b"y" * 8192)
+    assert ei.value.code == "XMinioAdminBucketQuotaExceeded"
+    assert ei.value.status == 403
+    # clearing the quota re-admits the same write
+    adm.clear_bucket_quota("quota-bkt")
+    c.put_object("quota-bkt", "b", b"y" * 8192)
+    assert adm.get_bucket_quota("quota-bkt") == {}
+
+
+def test_top_v1_without_metering(adm):
+    """With the metering plane disabled (the default), ``top`` serves
+    the v1 per-API document — no tenant/hot-key sections, the idle
+    contract on the wire."""
+    doc = adm.top()
+    assert doc.get("version", 1) == 1
+    assert "tenants" not in doc
+
+
+def test_top_v2_with_metering_armed(served, adm):
+    """Arming the metering subsystem live upgrades ``top`` to v2:
+    tenants, hot keys, and hot prefixes from the heavy-hitter
+    sketches, attributed to the calling access key."""
+    adm.set_config_kv("metering", "enable", "on")
+    try:
+        c = S3Client(served.endpoint, "ak", "as")
+        c.make_bucket("top-bkt")
+        for i in range(8):
+            c.put_object("top-bkt", f"logs/day{i}", b"z" * 1024)
+            c.get_object("top-bkt", f"logs/day{i}")
+        doc = adm.top()
+        assert doc["version"] == 2
+        assert any(t["tenant"] == "ak" for t in doc["tenants"])
+        assert any(k["key"].startswith("top-bkt/logs/")
+                   for k in doc["hotKeys"])
+        assert any(p["prefix"] == "top-bkt/logs/"
+                   for p in doc["hotPrefixes"])
+    finally:
+        adm.set_config_kv("metering", "enable", "off")

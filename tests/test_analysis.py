@@ -566,3 +566,42 @@ def test_span_discipline_canary(tmp_path):
             return self._pool.map(lambda i: (rid, fn(i)), items)
         """})
     assert not clean3, clean3
+
+
+def test_label_cardinality_canary(tmp_path):
+    # shape A: a counter-registry call labelling an mt_ family by a
+    # request-derived key outside the bounded metering registry
+    bad = _lint(tmp_path, {"s3/m.py": """
+        def record(metrics, bucket, tenant):
+            metrics.inc("mt_requests_total",
+                        {"bucket": bucket, "api": "GetObject"})
+            metrics.inc("mt_bytes_total", labels={"tenant": tenant})
+        """})
+    msgs = [f.message for f in bad if f.rule == "label-cardinality"]
+    assert len(msgs) == 2, bad
+    assert any("mt_requests_total" in m and "bucket" in m
+               for m in msgs), msgs
+    assert any("mt_bytes_total" in m and "tenant" in m
+               for m in msgs), msgs
+    # shape B: a hand-rendered sample line carrying the label in the
+    # constant head of an f-string
+    bad2 = _lint(tmp_path, {"obs/m.py": """
+        def render(key, n):
+            return f'mt_hot_total{{key="{key}"}} {n}'
+        """})
+    assert any(f.rule == "label-cardinality" and "hand-rendered" in
+               f.message for f in bad2), bad2
+    # bounded labels (api/node/pool) are fine anywhere, and the
+    # metering registry itself is exempt — it IS the bound
+    clean = _lint(tmp_path, {
+        "s3/m.py": """
+            def record(metrics):
+                metrics.inc("mt_requests_total", {"api": "GetObject"})
+            """,
+        "obs/metering.py": """
+            def render(bucket, n):
+                return f'mt_bucket_requests_total{{bucket="{bucket}"}} {n}'
+            """,
+    }, docs={"observability.md":
+             "`mt_requests_total` `mt_bucket_requests_total`"})
+    assert not clean, clean

@@ -363,6 +363,14 @@ def test_graceful_drain_completes_inflight_put(tmp_path, monkeypatch):
     assert srv.shutdown_drain_s == 8.0
     cli = S3Client(srv.endpoint, "drkey", "drsecret")
     cli.make_bucket("drain")
+    # a handler stays in _active_conns through its post-response
+    # bookkeeping (flight record, metrics) — wait for make_bucket's
+    # handler to fully retire so the active conn we poll for below
+    # can only be OUR mid-flight PUT, not its dying predecessor
+    deadline = time.monotonic() + 5.0
+    while srv._active_conns:
+        assert time.monotonic() < deadline, "make_bucket never retired"
+        time.sleep(0.01)
     url = cli.presign("PUT", "drain", "slowobj")
     path_q = url[len(srv.endpoint):]
     body = os.urandom(64 * 1024)

@@ -1,0 +1,326 @@
+"""Workload attribution plane unit tier (obs/sketch.py +
+obs/metering.py): the sketch error bounds pinned EXACTLY on seeded
+streams (no statistical slack), the bounded-cardinality registry
+semantics (bucket/tenant folds into ``_other``), the 100k-distinct-key
+memory fence under tracemalloc, and the hot-read admission hook's
+fallback regression (metering off => the PR-13 global-rate gate is
+unchanged).
+"""
+
+import random
+import tracemalloc
+from collections import Counter
+
+import pytest
+
+from minio_tpu.obs.metering import OTHER, Metering, merge_top_docs
+from minio_tpu.obs.sketch import CountMin, SpaceSaving
+
+# -- SpaceSaving ------------------------------------------------------------
+
+
+def _zipf_stream(n_ops: int, n_keys: int, seed: int) -> list[str]:
+    rng = random.Random(seed)
+    weights = [1.0 / (i + 1) ** 1.2 for i in range(n_keys)]
+    return rng.choices([f"k{i}" for i in range(n_keys)],
+                       weights=weights, k=n_ops)
+
+
+def test_space_saving_guarantee_and_bounds():
+    """The Metwally guarantee on a seeded zipf stream: any key whose
+    true count exceeds N/K is tabled, and every tabled estimate
+    brackets the truth (count - error <= true <= count)."""
+    ss = SpaceSaving(8, seed=3)
+    stream = _zipf_stream(5000, 200, seed=7)
+    truth = Counter(stream)
+    for key in stream:
+        ss.offer(key)
+    assert ss.n == 5000
+    assert len(ss) <= 8
+    thresh = ss.threshold()
+    for key, true_count in truth.items():
+        if true_count > thresh:
+            assert key in ss, (key, true_count, thresh)
+    for key, count, error in ss.top():
+        true_count = truth[key]
+        assert count - error <= true_count <= count, \
+            (key, count, error, true_count)
+
+
+def test_space_saving_top_is_deterministic_and_ranked():
+    ss = SpaceSaving(4, seed=1)
+    for key, n in (("a", 5), ("b", 3), ("c", 3), ("d", 1)):
+        ss.offer(key, n)
+    assert ss.top() == [("a", 5, 0), ("b", 3, 0), ("c", 3, 0),
+                        ("d", 1, 0)]
+    assert ss.top(2) == [("a", 5, 0), ("b", 3, 0)]
+    # eviction: the newcomer inherits the minimum's count as error
+    ss.offer("e")
+    assert "d" not in ss
+    assert ss.estimate("e") == (2, 1)
+    assert ss.estimate("zz") == (0, 0)
+
+
+def test_space_saving_decay_ages_out_stale_hitters():
+    ss = SpaceSaving(4, seed=0)
+    ss.offer("hot", 8)
+    ss.offer("warm", 2)
+    ss.offer("cold", 1)
+    ss.decay()                       # halve
+    assert ss.estimate("hot") == (4, 0)
+    assert ss.estimate("warm") == (1, 0)
+    assert "cold" not in ss          # 0 after halving: slot released
+    assert ss.n == 5
+
+
+def test_space_saving_merge_keeps_combined_heavies():
+    a, b = SpaceSaving(4, seed=2), SpaceSaving(4, seed=2)
+    for _ in range(10):
+        a.offer("x")
+    for _ in range(6):
+        a.offer("y")
+    for _ in range(9):
+        b.offer("x")
+    for _ in range(7):
+        b.offer("z")
+    a.merge(b)
+    assert a.n == 32
+    assert len(a) <= 4
+    # x heavy on both nodes: merged count is the exact sum
+    assert a.estimate("x") == (19, 0)
+    assert {k for k, _, _ in a.top(3)} == {"x", "z", "y"}
+
+
+def test_space_saving_doc_roundtrip():
+    ss = SpaceSaving(4, seed=5)
+    for key in ("p", "p", "q"):
+        ss.offer(key)
+    back = SpaceSaving.from_doc(ss.to_doc())
+    assert back.n == ss.n
+    assert back.top() == ss.top()
+
+
+# -- CountMin ---------------------------------------------------------------
+
+
+def test_count_min_overestimate_only_with_epsilon_bound():
+    """The one-sided CM bound on a seeded stream: estimates never
+    undercount, and (with depth 4) stay within eps*N of the truth."""
+    cm = CountMin(width=512, depth=4, seed=9)
+    stream = _zipf_stream(4000, 300, seed=11)
+    truth = Counter(stream)
+    for key in stream:
+        cm.add(key)
+    assert cm.n == 4000
+    slack = cm.epsilon() * cm.n
+    for key, true_count in truth.items():
+        est = cm.estimate(key)
+        assert est >= true_count, (key, est, true_count)
+        assert est <= true_count + slack, (key, est, true_count, slack)
+
+
+def test_count_min_merge_and_decay():
+    a = CountMin(width=64, depth=2, seed=1)
+    b = CountMin(width=64, depth=2, seed=1)
+    a.add("k", 6)
+    b.add("k", 4)
+    a.merge(b)
+    assert a.estimate("k") >= 10
+    assert a.n == 10
+    a.decay()
+    assert a.estimate("k") >= 5
+    assert a.n == 5
+    # dimension/seed mismatch must refuse, not silently mis-merge
+    with pytest.raises(ValueError):
+        a.merge(CountMin(width=64, depth=2, seed=2))
+    with pytest.raises(ValueError):
+        a.merge(CountMin(width=32, depth=2, seed=1))
+    assert a.memory_bytes() == 64 * 2 * 8
+
+
+def test_count_min_is_seeded_deterministic():
+    a = CountMin(width=128, depth=3, seed=4)
+    b = CountMin(width=128, depth=3, seed=4)
+    for key in _zipf_stream(500, 50, seed=2):
+        a.add(key)
+        b.add(key)
+    assert [list(r) for r in a._rows] == [list(r) for r in b._rows]
+
+
+# -- the bounded registry ---------------------------------------------------
+
+
+def _metering(**kw) -> Metering:
+    kw.setdefault("clock", lambda: 1000.0)
+    return Metering(**kw)
+
+
+def test_bucket_rows_fold_into_other_past_cap():
+    m = _metering(max_buckets=2)
+    for i in range(10):
+        m.charge(bucket=f"b{i}", api="GetObject", rx=1)
+    st = m.metrics_state()
+    buckets = {b for b, *_ in st["bucketRows"]}
+    assert buckets == {"b0", "b1", OTHER}
+    other = [r for r in st["bucketRows"] if r[0] == OTHER][0]
+    assert other[2] == 8              # requests folded, not dropped
+
+
+def test_tenant_rows_track_sketch_membership():
+    """Named tenant rows exist only while the access key is tabled in
+    the space-saving sketch; an evicted tenant's row folds into
+    ``_other`` — rows can never exceed tenant_k + 1."""
+    m = _metering(tenant_k=2)
+    for _ in range(5):
+        m.charge(bucket="b", api="GetObject", tenant="alice", tx=10)
+    for _ in range(4):
+        m.charge(bucket="b", api="GetObject", tenant="bob", tx=10)
+    assert {t for t, *_ in m.metrics_state()["tenantRows"]} == \
+        {"alice", "bob"}
+    # carol's burst evicts the sketch minimum; the loser's row folds
+    for _ in range(6):
+        m.charge(bucket="b", api="GetObject", tenant="carol", tx=10)
+    rows = {t: r for t, *r in m.metrics_state()["tenantRows"]}
+    assert len(rows) <= 3             # tenant_k + _other
+    assert "carol" in rows
+    assert OTHER in rows
+    total = sum(r[0] for r in rows.values())
+    assert total == 15                # every request accounted somewhere
+
+
+def test_errors_count_only_5xx():
+    m = _metering()
+    m.charge(bucket="b", api="PutObject", tenant="t", status=403)
+    m.charge(bucket="b", api="PutObject", tenant="t", status=503)
+    st = m.metrics_state()
+    assert st["bucketRows"][0][3] == 1
+    assert [r for r in st["tenantRows"] if r[0] == "t"][0][2] == 1
+
+
+def test_key_heat_and_top_doc_sections():
+    m = _metering(seed=1)
+    for _ in range(9):
+        m.charge(bucket="logs", api="GetObject", tenant="t",
+                 key="app/error.log", tx=100)
+    m.charge(bucket="logs", api="GetObject", tenant="t",
+             key="app/access.log", tx=10)
+    assert m.key_heat("logs", "app/error.log") >= 9
+    assert m.key_heat("logs", "nope") == 0
+    doc = m.top_doc()
+    assert doc["hotKeys"][0]["key"] == "logs/app/error.log"
+    assert doc["hotPrefixes"][0]["prefix"] == "logs/app/"
+    assert doc["tenants"][0]["tenant"] == "t"
+    assert doc["sketch"]["memoryBytes"] > 0
+
+
+def test_decay_fires_on_interval():
+    t = [1000.0]
+    m = Metering(decay_interval_s=60.0, clock=lambda: t[0])
+    m.charge(bucket="b", api="GetObject", key="k")
+    assert m.decays == 0
+    t[0] += 61.0
+    m.charge(bucket="b", api="GetObject", key="k")
+    assert m.decays == 1
+
+
+def test_memory_fence_100k_distinct_keys():
+    """The acceptance fence: a storm of 100k DISTINCT object keys and
+    tenants leaves the plane's footprint strictly bounded (sketch grid
+    + O(K) tables — no per-key state), measured by tracemalloc around
+    the charge loop, while the planted true heavy hitters still
+    surface in the top-K.  Seeded: same stream, same verdict."""
+    m = _metering(max_buckets=8, tenant_k=8, key_k=16, prefix_k=8,
+                  cm_width=1024, cm_depth=4, seed=1)
+    rng = random.Random(13)
+    tracemalloc.start()
+    before, _ = tracemalloc.get_traced_memory()
+    for i in range(100_000):
+        if i % 5 == 0:               # planted heavies: 20% of traffic
+            # (> N/tenant_k = 12.5%: the space-saving guarantee must
+            # keep them tabled through the spray)
+            key, tenant = "hot/object", "heavy-tenant"
+        else:
+            key = f"spray/{rng.randrange(10**9)}"
+            tenant = f"tenant-{rng.randrange(10**6)}"
+        m.charge(bucket="b", api="GetObject", tenant=tenant, key=key,
+                 tx=64)
+    after, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    grown = after - before
+    # sketch grid is 1024*4*8 = 32 KiB; tables are O(K).  A per-key
+    # or per-tenant leak would grow tens of MiB here.
+    assert grown < 4 << 20, f"metering grew {grown} bytes"
+    assert m.memory_bytes() < 1 << 20
+    rows = m.metrics_state()
+    assert len(rows["tenantRows"]) <= 8 + 1
+    assert len(rows["bucketRows"]) <= (8 + 1) * 1   # one api
+    # the true heavy hitters survived the spray
+    assert m.top_doc()["hotKeys"][0]["key"] == "b/hot/object"
+    tenants = [t for t, *_ in rows["tenantRows"]]
+    assert "heavy-tenant" in tenants
+    assert m.key_heat("b", "hot/object") >= 20_000
+
+
+def test_merge_top_docs_aggregates_and_ranks():
+    a = _metering(node_name="n1")
+    b = _metering(node_name="n2")
+    for _ in range(3):
+        a.charge(bucket="bk", api="GetObject", tenant="t1",
+                 key="x", tx=100)
+    for _ in range(5):
+        b.charge(bucket="bk", api="GetObject", tenant="t1",
+                 key="x", tx=200)
+    b.charge(bucket="bk", api="GetObject", tenant="t2", key="y", tx=1)
+    agg = merge_top_docs([a.top_doc(), b.top_doc(), {}, None])
+    assert agg["nodes"] == ["n1", "n2"]
+    assert agg["tenants"][0]["tenant"] == "t1"
+    assert agg["tenants"][0]["txBytes"] == 1300
+    assert agg["hotKeys"][0] == {"key": "bk/x", "count": 8, "error": 0}
+
+
+def test_from_server_idle_contract():
+    class _Cfg:
+        def get(self, subsys, key):
+            return {"enable": "off"}.get(key, "")
+
+    class _Srv:
+        config = _Cfg()
+
+    assert Metering.from_server(_Srv()) is None
+
+
+# -- hot-read admission hook ------------------------------------------------
+
+
+def test_hotread_admission_prefers_key_heat_and_falls_back():
+    """The per-key admission hook (ISSUE 19) and its regression
+    contract: with ``heat_key_fn`` wired (metering armed), THIS key's
+    sketch heat is the gate; with metering disabled (None, the
+    default) the PR-13 global-rate gate decides exactly as before."""
+    from minio_tpu.objectlayer.hotread import CacheConfig, HotReadPlane
+    plane = HotReadPlane(layer=None)
+    plane.config = CacheConfig()      # private config: threshold 2
+    key = ("bkt", "obj")
+    # concurrent demand and inline-tiny windows are always admitted
+    assert plane._admit(1, True, False, key=key)
+    assert plane._admit(1, False, True, key=key)
+    # below the per-key touch threshold: never admitted
+    assert not plane._admit(1, False, False, key=key)
+    # metering disabled (heat_key_fn None): the global gate decides
+    plane.heat_fn = lambda: 100
+    assert plane._admit(2, False, False, key=key)
+    plane.heat_fn = lambda: 0
+    assert not plane._admit(2, False, False, key=key)
+    # metering armed: the key's own sketch heat overrides the global
+    # rate in BOTH directions — hot key admits on a quiet server, cold
+    # key never rides another object's traffic
+    plane.heat_key_fn = lambda b, o: 100 if (b, o) == key else 0
+    assert plane._admit(2, False, False, key=key)
+    assert not plane._admit(2, False, False, key=("bkt", "cold"))
+    plane.heat_fn = lambda: 100       # global says hot; key gate wins
+    assert not plane._admit(2, False, False, key=("bkt", "cold"))
+    # a broken heat source is advisory, never an outage: admit
+    def _boom(b, o):
+        raise RuntimeError("sketch offline")
+    plane.heat_key_fn = _boom
+    assert plane._admit(2, False, False, key=key)

@@ -344,6 +344,57 @@ def test_burn_drill_fast_fires_slow_quiet(tmp_path):
     assert hb["detail"]["enabled"] is True
 
 
+def test_tenant_smoke_noisy_named_and_quota_enforced(tmp_path):
+    """The tier-1 workload-attribution miniature (ISSUE 19): one
+    zipf-heavy noisy tenant (large objects, hard-quota'd bucket)
+    beside one innocent — ``noisy_neighbor`` fires naming EXACTLY the
+    noisy tenant, the quota rejects only the noisy tenant's writes
+    (403 ``XMinioAdminBucketQuotaExceeded``, before drive fan-out),
+    the innocent's p99 stays green, the mt_tenant_* families ride the
+    live scrape with sketch memory bounded, and rejections never
+    dead-letter telemetry."""
+    sc = soak_report.tenant_smoke_scenario()
+    rows = soak_report.run_scenario(sc, str(tmp_path / "tsoak"))
+    by_metric = {r["metric"]: r for r in rows}
+    failed = [r for r in rows if not r["passed"]]
+    assert not failed, failed
+    assert by_metric["noisy_neighbor_named"]["value"] == 1
+    assert by_metric["alert_fired:noisy_neighbor"]["value"] > 0
+    assert by_metric["quota_rejections"]["value"] > 0
+    assert by_metric["quota_innocent_rejections"]["value"] == 0
+    assert by_metric["innocent_p99:tenant-a"]["passed"]
+    assert by_metric["metering_families_exposed"]["value"] == 1
+    assert by_metric["metering_memory_bounded"]["passed"]
+    # quota 403s are 4xx: the 5xx-only tenant burn rule held silence
+    assert by_metric["alert_quiet:tenant_burn"]["value"] == 0
+    assert by_metric["telemetry_dead_letters"]["value"] == 0
+    # the firing event crossed the wire to the live sink
+    dl = by_metric["alert_delivered"]
+    assert dl["detail"]["by_rule"].get("noisy_neighbor", 0) > 0
+
+
+@pytest.mark.slow    # ~45s: 20s three-tenant storm + convergence
+def test_tenant_storm_attribution_and_isolation(tmp_path):
+    """ISSUE 19 acceptance at storm scale: the noisy tenant beside
+    TWO well-behaved tenants and the root mix — attribution still
+    names only the noisy tenant, both innocents stay green, and the
+    slo_burn rules stay quiet (quota rejections are 4xx, not an
+    availability breach)."""
+    sc = soak_report.tenant_storm_scenario()
+    rows = soak_report.run_scenario(sc, str(tmp_path / "tstorm"))
+    by_metric = {r["metric"]: r for r in rows}
+    failed = [r for r in rows if not r["passed"]]
+    assert not failed, failed
+    assert by_metric["noisy_neighbor_named"]["value"] == 1
+    assert by_metric["quota_rejections"]["value"] > 0
+    assert by_metric["quota_innocent_rejections"]["value"] == 0
+    for t in ("tenant-a", "tenant-b"):
+        assert by_metric[f"innocent_p99:{t}"]["passed"]
+    for rule in ("tenant_burn", "slo_burn_fast", "slo_burn_slow"):
+        assert by_metric[f"alert_quiet:{rule}"]["value"] == 0
+    assert by_metric["metering_memory_bounded"]["passed"]
+
+
 def test_soak_status_admin_route(tmp_path):
     """The admin plane surfaces a live soak run (and null when idle)."""
     from minio_tpu.admin.client import AdminClient

@@ -267,3 +267,26 @@ def test_v2_stale_generation_token_resumes_from_key(ctx):
     assert [e.findtext(f"{NS}Key")
             for e in root.findall(f"{NS}Contents")] == ["g1", "g2"]
     assert root.findtext(f"{NS}IsTruncated") == "false"
+
+
+# -- hard bucket quota (workload attribution plane) -------------------------
+
+
+def test_quota_exceeded_vector(ctx):
+    """Hard-quota rejection wire shape, frozen: HTTP 403 with the
+    madmin error code — mc and the console key on the exact Code
+    string, and the check rejects BEFORE any drive fan-out so the
+    body shape must come from the standard error renderer."""
+    srv, c = ctx
+    c.make_bucket("wvq")
+    srv.bucket_meta.set_config(
+        "wvq", "quota", '{"quota": 4, "quotatype": "hard"}')
+    r = c.request("PUT", "/wvq/big.bin", body=b"x" * 64, expect=())
+    assert r.status == 403
+    assert norm(r.body) == (
+        '<?xml version="1.0" encoding="UTF-8"?>'
+        '<Error><Code>XMinioAdminBucketQuotaExceeded</Code>'
+        '<Message>Bucket quota may be exceeded with this request.'
+        '</Message>'
+        '<Resource>/wvq/big.bin</Resource>'
+        '<RequestId>@RID@</RequestId></Error>')

@@ -118,11 +118,24 @@ def _xray(c, qs="n=50"):
     return json.loads(r.body)
 
 
+def _settle(srv, want_total, timeout_s=2.0):
+    """Completion records land in the handler thread's ``finally``
+    AFTER the response bytes go out, and the client opens a fresh
+    connection per request — so the caller can outrun the last
+    append by a hair.  Wait for the ring to catch up before
+    asserting on it."""
+    deadline = time.monotonic() + timeout_s
+    while srv.flightrec.records_total < want_total and \
+            time.monotonic() < deadline:
+        time.sleep(0.005)
+
+
 def test_get_put_carry_complete_stage_timeline(served):
     c = S3Client(served.endpoint, "xk", "xs")
     c.make_bucket("xbkt")
     c.put_object("xbkt", "obj", b"z" * 300_000)
     c.get_object("xbkt", "obj")
+    _settle(served, 3)
     doc = _xray(c)
     recs = {r["api"]: r for r in doc["records"]}
     assert "PutObject" in recs and "GetObject" in recs
@@ -197,6 +210,7 @@ def test_always_on_idle_contract(served, monkeypatch):
         c.put_object("ibkt", f"o{i}", b"idle" * 256)
     assert calls == {"trace": 0, "span": 0}, \
         "trace records built with no consumer"
+    _settle(served, before + n)
     assert served.flightrec.records_total >= before + n
     newest = served.flightrec.requests[-1]
     assert isinstance(newest, tuple), "hot-path record is not compact"
