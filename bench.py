@@ -1705,6 +1705,188 @@ def commit_profile_main() -> None:
     }))
 
 
+def _bench_commit_plane() -> dict | None:
+    """``bench.py commit_plane`` — the per-drive group-commit plane
+    (ISSUE 20) A/B'd with durability ON.  Runs in a subprocess because
+    this module pins MT_FSYNC=0 at import (go test -bench semantics);
+    grouping only has something to coalesce when every commit actually
+    fsyncs.  Legs: grouped-vs-ungrouped commit fan-out wall at 16
+    concurrent 4 MiB streams, and the small-object PUT rate at
+    1/16/64 streams (packed segments vs per-object files), plus the
+    mt_commit_group_* counter deltas that prove the plane engaged."""
+    import subprocess
+    import sys as _sys
+    env = dict(os.environ)
+    env["MT_FSYNC"] = "1"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        out = subprocess.run(
+            [_sys.executable, os.path.abspath(__file__),
+             "commit_plane_child"],
+            capture_output=True, text=True, timeout=900, env=env)
+        if out.returncode != 0:
+            print("commit_plane child failed: "
+                  f"{out.stderr.strip()[-800:]}", file=_sys.stderr)
+            return None
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001 — optional leg
+        print(f"commit_plane leg failed: {e!r}", file=_sys.stderr)
+        return None
+
+
+def commit_plane_child_main() -> None:
+    """The in-process body of the commit_plane leg (MT_FSYNC=1 was set
+    by the parent BEFORE interpreter start, so the storage layer and
+    the commit plane both see durability on).  Prints one JSON dict."""
+    import shutil
+    import tempfile
+    from concurrent.futures import ThreadPoolExecutor
+
+    from minio_tpu.admin.metrics import GLOBAL as _gm
+    from minio_tpu.objectlayer import metadata as _ometa
+    from minio_tpu.objectlayer.erasure_object import ErasureObjects
+    from minio_tpu.storage import commit as _commit
+    from minio_tpu.storage.datatypes import (ChecksumInfo, ErasureInfo,
+                                             FileInfo, ObjectPartInfo)
+    from minio_tpu.storage.xl_storage import XLStorage
+    import uuid as _uuid
+
+    assert os.environ.get("MT_FSYNC") == "1", "child needs MT_FSYNC=1"
+    n_drives, parity = 8, 2
+    k = n_drives - parity
+    tmp = tempfile.mkdtemp(prefix="bench-commit-plane-")
+    try:
+        disks = []
+        for i in range(n_drives):
+            d = os.path.join(tmp, f"d{i}")
+            os.makedirs(d)
+            disks.append(XLStorage(d))
+        layer = ErasureObjects(disks, parity=parity, block_size=1 << 20,
+                               backend="numpy")
+        # single-core hosts default to the serial fan-out; the plane
+        # (and with it the group-commit drain) only lives on the
+        # per-drive writer threads, so force it the way tests do
+        layer._pipe_depth = 2
+        layer.make_bucket("cbkt")
+
+        # ---- leg 1: commit fan-out wall, 16 concurrent 4 MiB streams
+        body = os.urandom(4 << 20)
+        codec = layer._codec_for(parity)
+        rows = list(codec.encode_object_framed(body))
+        from minio_tpu.hashing import bitrot as _hbitrot
+        import numpy as _np
+        framed2d = _np.stack([_np.frombuffer(r, dtype=_np.uint8)
+                              for r in rows])
+        _hbitrot.fill_framed(framed2d, codec.shard_size())
+        rows = [bytes(r) for r in framed2d]
+        dist = _ometa.hash_order("cbkt/commit", n_drives)
+        seq = [0]
+
+        def mkfi(name: str) -> FileInfo:
+            return FileInfo(
+                volume="cbkt", name=name, version_id="",
+                data_dir=str(_uuid.uuid4()), mod_time=1, size=len(body),
+                metadata={"etag": "0" * 32},
+                parts=[ObjectPartInfo(1, len(body), len(body),
+                                      "0" * 32, 1)],
+                erasure=ErasureInfo(
+                    data_blocks=k, parity_blocks=parity,
+                    block_size=1 << 20, distribution=dist,
+                    checksums=[ChecksumInfo(1, layer.bitrot_algo)]),
+                fresh=True)
+
+        def commit_leg(grouped: bool, streams: int, n_obj: int) -> float:
+            _commit.CONFIG.enable = grouped
+            tag = f"{'g' if grouped else 'u'}{streams}-{seq[0]}"
+            seq[0] += 1
+
+            def one(j):
+                name = f"c{tag}-{j}"
+                layer._commit_put("cbkt", name, mkfi(name), rows,
+                                  False, layer.disks)
+            with ThreadPoolExecutor(max_workers=streams) as pool:
+                list(pool.map(one, range(streams)))       # warm
+                t0 = time.perf_counter()
+                list(pool.map(one, range(streams, streams + n_obj)))
+                return (time.perf_counter() - t0) / n_obj * 1000
+
+        n_obj = 32
+        commit_leg(True, 16, 4)                            # warm path
+        ungrouped_ms = min(commit_leg(False, 16, n_obj) for _ in range(2))
+        grouped_ms = min(commit_leg(True, 16, n_obj) for _ in range(2))
+
+        # ---- leg 2: small-object PUT rate at 1/16/64 streams --------
+        # 256 KiB sits mid packing band (inline 128 KiB < size, framed
+        # shard well under pack_threshold): ungrouped it is a per-
+        # object part file + its own fsyncs, grouped it folds into the
+        # drive's journaled segment + one covering fsync
+        sbody = os.urandom(256 << 10)
+        small = {}
+
+        def put_leg(grouped: bool, streams: int) -> float:
+            _commit.CONFIG.enable = grouped
+            tag = f"s{'g' if grouped else 'u'}{streams}-{seq[0]}"
+            seq[0] += 1
+            n_obj = max(16, 2 * streams)
+
+            def one(j):
+                layer.put_object("cbkt", f"{tag}-{j}", sbody)
+            with ThreadPoolExecutor(max_workers=streams) as pool:
+                list(pool.map(one, range(min(streams, 8))))  # warm
+                t0 = time.perf_counter()
+                list(pool.map(one, range(100, 100 + n_obj)))
+                return n_obj / (time.perf_counter() - t0)
+
+        c0 = {key: v for key, v in _gm.snapshot().items()
+              if key[0].startswith("mt_commit_group_")}
+        for streams in (1, 16, 64):
+            small[str(streams)] = {
+                "per_object_fsync_ops": round(put_leg(False, streams), 1),
+                "packed_group_ops": round(put_leg(True, streams), 1),
+            }
+        groups = {}
+        for key, v in _gm.snapshot().items():
+            if key[0].startswith("mt_commit_group_"):
+                groups[key[0]] = groups.get(key[0], 0) + v - c0.get(key, 0)
+
+        s1, s64 = small["1"], small["64"]
+        print(json.dumps({
+            "drives": n_drives, "parity": parity, "fsync": True,
+            "commit_16x4MiB_ungrouped_ms_per_object":
+                round(ungrouped_ms, 2),
+            "commit_16x4MiB_grouped_ms_per_object": round(grouped_ms, 2),
+            "grouped_vs_ungrouped": round(ungrouped_ms / grouped_ms, 2)
+            if grouped_ms > 0 else None,
+            "small_put_256KiB_ops_per_s": small,
+            # superlinear check: packed 64-stream rate vs 64x the
+            # packed single-stream rate, and vs the eager 64-stream
+            "small_put_64s_scaling_vs_1s": round(
+                s64["packed_group_ops"] / s1["packed_group_ops"], 2)
+            if s1["packed_group_ops"] > 0 else None,
+            "small_put_64s_packed_vs_eager": round(
+                s64["packed_group_ops"] / s64["per_object_fsync_ops"], 2)
+            if s64["per_object_fsync_ops"] > 0 else None,
+            "mt_commit_group_counters": {key: round(v, 1)
+                                         for key, v in groups.items()},
+        }))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def commit_plane_main() -> None:
+    """``bench.py commit_plane`` — run the group-commit A/B leg
+    standalone and print ONE BENCH_*-shaped JSON line."""
+    stats = _bench_commit_plane()
+    if stats is None:
+        raise SystemExit("commit_plane leg unavailable")
+    print(json.dumps({
+        "metric": "commit_plane_grouped_vs_ungrouped",
+        "value": stats.get("grouped_vs_ungrouped"),
+        "unit": "x",
+        "detail": stats,
+    }))
+
+
 def _bench_watchdog() -> dict | None:
     """``bench.py watchdog`` — ns/request cost of the SLO watchdog
     plane on the GET hot path, through the REAL S3 server (ISSUE 18
@@ -1958,6 +2140,7 @@ def host_main() -> None:
     xray = _bench_xray()
     watchdog = _bench_watchdog()
     metering = _bench_metering()
+    commit_plane = _bench_commit_plane()
     c1 = (cfg12 or {}).get("config1_4+2_put_64MiB_GiBps")
     print(json.dumps({
         "metric": "baseline_config1_4+2_put_64MiB_GiBps",
@@ -1975,6 +2158,7 @@ def host_main() -> None:
             "xray": xray,
             "watchdog": watchdog,
             "metering": metering,
+            "commit_plane": commit_plane,
             "methodology": "host legs only (bench.py host); device "
                            "kernel legs need a TPU",
         },
@@ -2032,6 +2216,10 @@ if __name__ == "__main__":
         xray_main()
     elif len(_sys.argv) > 1 and _sys.argv[1] == "commit_profile":
         commit_profile_main()
+    elif len(_sys.argv) > 1 and _sys.argv[1] == "commit_plane":
+        commit_plane_main()
+    elif len(_sys.argv) > 1 and _sys.argv[1] == "commit_plane_child":
+        commit_plane_child_main()
     elif len(_sys.argv) > 1 and _sys.argv[1] == "watchdog":
         watchdog_main()
     elif len(_sys.argv) > 1 and _sys.argv[1] == "metering":

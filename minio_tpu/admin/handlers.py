@@ -1385,6 +1385,11 @@ def _config(h, srv, route, q1, payload, send_json) -> bool:
             # hot-object cache) on the live GET path; disabling
             # releases every cached byte back to the governor
             srv.reload_cache_config()
+        if parts[1] == "commit":
+            # retune the per-drive group-commit plane (group window,
+            # batch bound, small-object packing threshold, segment
+            # rotation) on the live write path
+            srv.reload_commit_config()
         if parts[1] in ("heal", "scanner", "rebalance"):
             # retune heal/scan/rebalance IO self-pacing on the
             # attached background planes

@@ -32,6 +32,18 @@ def _heal_span(bucket: str, obj: str, t0_ns: int, healed: int,
                 "source": source}))
 
 
+def _all_disks(layer) -> list:
+    """Every drive under an object layer, whatever its shape
+    (ErasureObjects / ErasureSets / ServerPools)."""
+    if hasattr(layer, "disks"):
+        return [d for d in layer.disks if d is not None]
+    if hasattr(layer, "sets"):
+        return [d for s in layer.sets for d in _all_disks(s)]
+    if hasattr(layer, "pools"):
+        return [d for p in layer.pools for d in _all_disks(p)]
+    return []
+
+
 @dataclass
 class HealStats:
     """Progress counters surfaced by the admin API
@@ -215,6 +227,20 @@ class BackgroundHealer:
                     if not out.is_truncated:
                         break
                     marker = out.next_marker
+            # reclaim dead packed-segment space (storage/commit.py):
+            # sealed segments mostly freed by deletes/overwrites get
+            # their live extents re-appended and are unlinked.  Rides
+            # the sweep so compaction IO paces with heal IO.
+            for d in _all_disks(self.layer):
+                if self._stop.is_set():
+                    return self.stats
+                fn = getattr(d, "compact_segments", None)
+                if fn is None:
+                    continue
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001 — next sweep retries
+                    pass
             completed = True
         finally:
             # a stopped/failed partial cycle must not leak an eternal

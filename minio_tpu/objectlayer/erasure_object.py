@@ -632,23 +632,20 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             finally:
                 gate.set()
 
-        def write_one(idx_disk):
-            idx, disk = idx_disk
+        def write_one(idx, disk):
             disk.write_data_commit(bucket, object_name, fi, framed[idx],
                                    shard_index=idx + 1,
                                    meta_gate=meta_gate)
 
         # the resolver is SUBMITTED AFTER the md5 task and BEFORE the
-        # fan-out tasks: FIFO start order guarantees it runs even with
-        # every fan-out worker parked on the gate
+        # fan-out: FIFO start order guarantees it runs even with every
+        # fan-out worker parked on the gate (and on the writer plane
+        # the fan-out consumes no pool workers at all — the gate park
+        # happens on the drive writer threads, where batch-mates wait
+        # behind it while the resolver runs on the freed pool)
         resolver = self._pool.submit(resolve)
         try:
-            t0 = _critpath.now_ns()
-            ends = [0] * len(shuffled)
-            _, errs = self._fanout_indexed(write_one, shuffled,
-                                           ends=ends)
-            _critpath.record("write", wq, self._drive_labels(shuffled),
-                             ends, t0, errs=errs)
+            errs = self._commit_fanout(write_one, shuffled, wq, framed)
             resolver.result()       # BadDigest outranks quorum errors
             try:
                 meta.reduce_errs(errs, wq, WriteQuorumError)
@@ -779,15 +776,76 @@ class ErasureObjects(MultipartOps, ObjectLayer):
             shards, ss, self.bitrot_algo,
             use_device=(m > 0 and codec.is_device))
 
+    def _commit_fanout(self, write_one, shuffled, wq, framed) -> list:
+        """One commit-class fan-out (one storage call per drive) with
+        its quorum critical-path row.  With the pipeline on, the ops
+        ride the per-drive writer plane, where CONCURRENT streams'
+        commit ops coalesce into group commits — one fsync wall settles
+        many streams' writes (storage/commit.py) — and the queue bound
+        widens to the group batch size so one object's whole fan-out
+        enqueues without parking on itself.  The staged framed bytes
+        charge the memory governor (kind=commit) while queued: a burst
+        of tiny PUTs sheds 503 instead of growing every drive queue
+        unbounded, and the charge releases when the stream settles —
+        including death by drive error or PlaneClosed (the finally) or
+        an abandoned stream (Charge.__del__).  Serial/pool fan-out
+        otherwise (single-core all-local hosts)."""
+        if not self._pipeline_on():
+            t0 = _critpath.now_ns()
+            ends = [0] * len(shuffled)
+            _, errs = self._fanout_indexed(
+                lambda pair: write_one(pair[0], pair[1]), shuffled,
+                ends=ends)
+            _critpath.record("write", wq, self._drive_labels(shuffled),
+                             ends, t0, errs=errs)
+            return errs
+        from ..storage import commit as commitcfg
+        from ..utils.memgov import GOVERNOR
+        charge = GOVERNOR.charge(
+            sum(len(s) for s in framed) if framed is not None else 0,
+            "commit")
+        sw = self._write_plane.stream(shuffled)
+        bound = max(self._write_plane.queue_bound(),
+                    commitcfg.CONFIG.max_batch)
+        t0 = _critpath.now_ns()
+        try:
+            for i in range(len(shuffled)):
+                sw.submit(i, write_one, bound=bound)
+            sw.drain()
+        except BaseException:
+            sw.abort()
+            sw.drain(5.0)
+            raise
+        finally:
+            charge.release()
+        sw.record_gating("write", wq, t0)
+        return list(sw.errs)
+
     def _commit_put(self, bucket, object_name, fi, framed, inline,
                     shuffled) -> ObjectInfo:
+        from ..storage import commit as commitcfg
+        # packed band: past the inline threshold (below it xl.meta —
+        # written regardless — carries the payload for free) but small
+        # enough that the per-object data-dir mkdir + part-file
+        # create/fsync trio dominates the commit: the framed shard
+        # rides the drive's append-only segment instead, one batched
+        # fsync pair covering every packed batch-mate.  Keyed off the
+        # writer plane: grouping is a concurrency play — a lone stream
+        # on a serial-fanout host pays journal overhead with no group
+        # to amortize it (measured slower than eager), so packing only
+        # engages where batches can actually form
+        packed = (not inline and self._pipeline_on()
+                  and commitcfg.CONFIG.on()
+                  and 0 < fi.size <= commitcfg.CONFIG.pack_threshold
+                  and len(fi.parts) == 1 and bool(fi.data_dir))
+        if packed:
+            fi.data_dir = ""        # the segment extent replaces it
         # serialize the version ONCE; each drive patches only its shard
         # index (the fan-out previously deep-cloned FileInfo+ErasureInfo
         # per drive — pure Python overhead on the PUT hot path)
         vdict = None if inline else fi.to_dict()
 
-        def write_one(idx_disk):
-            idx, disk = idx_disk
+        def write_one(idx, disk):
             if inline:
                 dfi = FileInfo(**{**fi.__dict__})
                 dfi.erasure = ErasureInfo(**{**fi.erasure.__dict__})
@@ -797,6 +855,13 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                     else bytes(memoryview(blob).cast("B"))
                 dfi.data_dir = ""
                 disk.write_metadata(bucket, object_name, dfi)
+            elif packed:
+                blob = framed[idx]
+                blob = blob if isinstance(blob, bytes) \
+                    else bytes(memoryview(blob).cast("B"))
+                disk.write_packed(bucket, object_name, fi, blob,
+                                  shard_index=idx + 1,
+                                  version_dict=vdict)
             else:
                 # composite commit: one storage call (one RPC on remote
                 # drives), direct final-location write on local ones
@@ -806,14 +871,10 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                                        version_dict=vdict)
             return idx
 
-        t0 = _critpath.now_ns()
-        ends = [0] * len(shuffled)
-        _, errs = self._fanout_indexed(write_one, shuffled, ends=ends)
-        _critpath.record("write", self._write_quorum(fi),
-                         self._drive_labels(shuffled), ends, t0,
-                         errs=errs)
+        wq = self._write_quorum(fi)
+        errs = self._commit_fanout(write_one, shuffled, wq, framed)
         try:
-            meta.reduce_errs(errs, self._write_quorum(fi), WriteQuorumError)
+            meta.reduce_errs(errs, wq, WriteQuorumError)
         except serrors.VolumeNotFound:
             # bucket wiped out-of-band while the existence cache was
             # warm: evict and report what a fresh stat would have said
@@ -1523,6 +1584,13 @@ class ErasureObjects(MultipartOps, ObjectLayer):
                 framed = dfi.inline_data[framed_off:framed_off + framed_len]
                 if len(framed) < framed_len:
                     raise serrors.FileCorrupt("short inline data")
+            elif dfi is not None and getattr(dfi, "seg", None):
+                # packed object: the framed shard lives at an extent
+                # inside the drive's segment file (storage/commit.py);
+                # same window arithmetic, different backing file
+                framed = disk.read_segment(
+                    dfi.seg["sid"], dfi.seg["off"] + framed_off,
+                    framed_len)
             else:
                 framed = disk.read_file_stream(
                     bucket,

@@ -158,6 +158,11 @@ def heal_object(er: ErasureObjects, bucket: str, object_name: str,
     ok_idx = [i for i, s in enumerate(states) if s == DiskState.OK]
     inline = any(f is not None and f.inline_data is not None
                  for f in s_fis)
+    # packed small objects live in per-drive segment files; the healed
+    # shard re-packs on the TARGET drive (its own segment, its own
+    # extent) so the object stays uniformly packed across the set
+    packed = any(f is not None and getattr(f, "seg", None) is not None
+                 for f in s_fis)
 
     # stage every part into ONE tmp dir per drive as it is rebuilt,
     # commit with a single rename_data per drive at the end:
@@ -180,6 +185,10 @@ def heal_object(er: ErasureObjects, bucket: str, object_name: str,
                     dfi = s_fis[i]
                     if dfi is not None and dfi.inline_data is not None:
                         framed = dfi.inline_data
+                    elif dfi is not None and \
+                            getattr(dfi, "seg", None) is not None:
+                        framed = shuffled[i].read_segment(
+                            dfi.seg["sid"], dfi.seg["off"], dfi.seg["len"])
                     else:
                         framed = shuffled[i].read_all(
                             bucket,
@@ -210,6 +219,13 @@ def heal_object(er: ErasureObjects, bucket: str, object_name: str,
                     dfi.inline_data = framed
                     dfi.data_dir = ""
                     disk.write_metadata(bucket, object_name, dfi)
+                    if disk.endpoint() not in res.healed_disks:
+                        res.healed_disks.append(disk.endpoint())
+                    continue
+                if packed:
+                    dfi = _disk_fileinfo(fi, i)
+                    dfi.data_dir = ""
+                    disk.write_packed(bucket, object_name, dfi, framed)
                     if disk.endpoint() not in res.healed_disks:
                         res.healed_disks.append(disk.endpoint())
                     continue
@@ -285,6 +301,10 @@ def _disk_fileinfo(fi: FileInfo, shard_idx: int) -> FileInfo:
     dfi.erasure = ErasureInfo(**{**fi.erasure.__dict__})
     dfi.erasure.index = shard_idx + 1
     dfi.inline_data = None
+    # seg extents are per-drive: the quorum fi's extent points into the
+    # SOURCE drive's segment file; the target re-packs (write_packed
+    # assigns its own extent) or stages regular part files
+    dfi.seg = None
     return dfi
 
 

@@ -411,6 +411,9 @@ class S3Server:
         self.reload_rpc_config()
         # push ``codec`` batching knobs into the shared batcher
         self.reload_codec_config()
+        # push ``commit`` group-commit knobs into the shared commit
+        # plane (group window, packing threshold)
+        self.reload_commit_config()
         # push ``cache`` hot-read knobs into every leaf layer's plane
         # and wire the admission heat source to this server's
         # last-minute API stats
@@ -544,6 +547,19 @@ class S3Server:
         from ..parallel import batcher as _batcher
         try:
             _batcher.CONFIG.load(self.config)
+        except Exception:  # noqa: BLE001 — bad knob must not kill boot
+            pass
+
+    def reload_commit_config(self) -> None:
+        """Push the ``commit`` group-commit knobs (enable,
+        group_window_us, max_batch, pack_threshold, segment_max_bytes)
+        into the process-wide commit-plane config — at boot and after
+        admin SetConfigKV, so the group window and packing threshold
+        retune on a live server (a fresh kvconfig.Config cannot see
+        this server's dynamic layer)."""
+        from ..storage import commit as _commit
+        try:
+            _commit.CONFIG.load(self.config)
         except Exception:  # noqa: BLE001 — bad knob must not kill boot
             pass
 

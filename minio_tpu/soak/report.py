@@ -175,6 +175,12 @@ def default_matrix(duration_s: float = 15.0) -> list[Scenario]:
             duration_s=duration_s,
             budget=_slo.Budget(max_error_rate=0.10,
                                require_codec_occupancy=storm,
+                               # the storm's tiny concurrent PUTs are
+                               # the group-commit plane's target load:
+                               # assert batches formed, fsyncs were
+                               # saved and packed segments absorbed
+                               # bytes on the live scrape (ISSUE 20)
+                               require_group_commit=storm,
                                require_mem_bounded=membound,
                                require_hot_read=hot,
                                # ordinary chaos is not a breach: the

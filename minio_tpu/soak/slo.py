@@ -62,6 +62,13 @@ class Budget:
     # batcher (the small-object storm) assert a non-zero
     # mt_codec_batch_occupancy on the live scrape
     require_codec_occupancy: bool = False
+    # group-commit rows (ISSUE 20): the small-object storm asserts the
+    # per-drive commit plane engaged — batches with >1 stream formed
+    # and fsyncs were actually saved (mt_commit_group_* on the live
+    # scrape), and packed segments absorbed object bytes.  The strict
+    # per-worker digest oracle rides the standard error/stale rows, so
+    # a packing bug surfaces as IntegrityMismatch, not silence.
+    require_group_commit: bool = False
     # bounded-memory scenarios (Select/listing storms under a governor
     # watermark) assert the memory SLO from the live scrape: every
     # charge released (mt_mem_inuse_bytes back to zero) and governor
@@ -441,6 +448,33 @@ def evaluate(scenario: str, *, api_stats=None, api_pcts=None, recorder,
         row("codec_batch_occupancy", round(occ, 1), "requests",
             occ > 0, {"family": "mt_codec_batch_occupancy",
                       "dispatches": disp})
+
+    # group-commit plane engaged under the small-object storm: multi-
+    # stream batches formed on the per-drive writers, the coalesced
+    # flushes actually SAVED fsyncs (deferred minus issued > 0 — the
+    # whole point of the plane), and packed segments absorbed bytes.
+    # All from the live scrape: a storm of tiny PUTs with zero saved
+    # fsyncs means the plane silently fell off the write path.
+    if budget.require_group_commit:
+        saved = metric_total(scrape_text,
+                             "mt_commit_group_fsyncs_saved_total")
+        batches = metric_total(scrape_text,
+                               "mt_commit_group_batches_total")
+        streams = metric_total(scrape_text,
+                               "mt_commit_group_streams_total")
+        row("group_commit_fsyncs_saved", saved, "fsyncs", saved > 0,
+            {"family": "mt_commit_group_fsyncs_saved_total",
+             "batches": batches, "streams": streams})
+        row("group_commit_batches", batches, "batches",
+            batches > 0 and streams > batches,
+            {"require": "multi-stream batches formed "
+                        "(streams > batches)",
+             "streams_per_batch": round(streams / batches, 2)
+             if batches else None})
+        seg_bytes = metric_total(scrape_text,
+                                 "mt_commit_group_segment_bytes_total")
+        row("packed_segment_bytes", seg_bytes, "bytes", seg_bytes > 0,
+            {"family": "mt_commit_group_segment_bytes_total"})
 
     # bounded-memory SLO: the governor's outstanding charges settled
     # back to zero (no leaked Select scanner / listing walk holds

@@ -97,9 +97,17 @@ MIXES: dict[str, Mix] = {m.name: m for m in (
     # encode/decode dispatches coalesce in the shared batcher — the
     # matrix runs it with extra workers and asserts non-zero
     # mt_codec_batch_occupancy on a live scrape (soak/slo.py)
+    # 256 KiB sits past the inline band and inside the packing band:
+    # those PUTs fold into per-drive journaled segment files (ISSUE
+    # 20) and the matrix asserts mt_commit_group_fsyncs_saved > 0 on
+    # a live scrape; the digest oracle keeps packed reads honest
     Mix("small_object_storm",
         {"put": 0.45, "get": 0.45, "head": 0.10},
-        sizes_bytes=(512, 2048, 8192), key_space=16),
+        sizes_bytes=(512, 2048, 8192, 262144), key_space=16,
+        # strict read-your-write md5 oracle: a mis-packed segment
+        # extent (ISSUE 20 commit plane) surfaces as IntegrityMismatch
+        # instead of silently serving the wrong packed bytes
+        verify_digest=True),
     # bounded-memory robustness mixes (the streaming-Select + streamed-
     # metacache tentpole): the Select storm scans a multi-block CSV per
     # query (the streaming scanner's target shape — "multi-GiB-class"

@@ -161,6 +161,38 @@ class StorageAPI(abc.ABC):
         finally:
             self.clean_tmp(tmp)
 
+    def write_packed(self, volume: str, path: str, fi: FileInfo,
+                     data, shard_index: int | None = None,
+                     version_dict: dict | None = None) -> None:
+        """Packed small-object commit: the framed shard rides the
+        drive's append-only segment file and xl.meta's per-drive
+        ``seg`` field points at the extent (XLStorage.write_packed).
+        Default composition falls back to the inline-data precedent —
+        the shard lands INSIDE xl.meta — which is correct on any
+        backend (one metadata write, no orphanable files) and keeps
+        the cross-drive consistency hash identical, since both
+        ``inline`` and ``seg`` are per-drive payload fields."""
+        from .datatypes import ErasureInfo
+        if shard_index is not None and fi.erasure.index != shard_index:
+            fi = FileInfo(**{**fi.__dict__})
+            fi.erasure = ErasureInfo(**{**fi.erasure.__dict__})
+            fi.erasure.index = shard_index
+        if version_dict is not None:
+            vd = dict(version_dict)
+            vd["ec"] = dict(vd["ec"])
+            if shard_index is not None:
+                vd["ec"]["index"] = shard_index
+            fi = FileInfo.from_dict(vd)
+        fi.data_dir = ""
+        fi.inline_data = bytes(data) if not isinstance(data, bytes) \
+            else data
+        self.write_metadata(volume, path, fi)
+
+    def read_segment(self, sid: int, off: int, length: int) -> bytes:
+        """Read one packed extent; only backends that pack natively
+        (XLStorage, and RemoteStorage forwarding to one) serve this."""
+        raise NotImplementedError
+
     @abc.abstractmethod
     def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None: ...
 

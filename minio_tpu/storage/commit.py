@@ -1,0 +1,561 @@
+"""Per-drive group-commit plane + packed small-object segments.
+
+The fourth application of the combining discipline (md5 LaneScheduler →
+CodecBatcher → SingleFlight hot reads → commit plane): concurrent
+streams' create/append/fsync/rename ops queued on the same _DriveWriter
+(storage/writers.py) coalesce into batched group commits — one flush
+round of fsyncs (files + deduplicated parent dirs) settles many streams'
+writes, with durability acknowledged per stream only AFTER its covering
+fsync landed and quorum re-checked per stream as completions drain.
+
+Two pieces live here:
+
+  * :class:`GroupCollector` — the thread-local deferred-durability
+    ledger a drive writer arms around one batch of ops.  Drive op
+    bodies (xl_storage.py) register dup'd file descriptors and parent
+    dir paths instead of fsyncing eagerly, and defer their
+    visibility-flipping os.replace into an ``after_flush``
+    continuation; :meth:`GroupCollector.flush` then runs rounds of
+    fsync → continuations until quiescent.  The crash-atomicity
+    contract is preserved exactly: a version's xl.meta replace only
+    runs after every fsync registered before it (its part/segment
+    bytes and its meta tmp file) has landed — the same
+    tmp→fsync→rename visibility order the eager path enforces, just
+    batched.  Registering DUP'D fds (not paths) is load-bearing: the
+    op body closes its own fd and may rename the file before the
+    flush, and an fd fsync is immune to both.
+
+  * :class:`SegmentStore` — per-drive journaled append-only segment
+    files under ``<root>/.mt.sys/seg/`` that pack many small objects'
+    framed shards behind ONE fsync, with xl.meta pointing into the
+    segment (the ``seg`` version field — the inline-data precedent
+    extended past the single-object boundary).  The journal is
+    append-only add/free records with the owning object identity, so
+    recovery is a pure idempotent replay (a torn tail record is
+    truncated away, matching the manifest-written-last discipline of
+    metacache blocks) and the compactor can rewrite live extents'
+    owner metadata when reclaiming dead segment space.
+
+Knobs ride the live-reloadable ``commit`` kvconfig subsystem
+(S3Server.reload_commit_config pushes admin SetConfigKV into
+:data:`CONFIG`, same pattern as the codec batcher).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import msgpack
+
+from ..admin.metrics import GLOBAL as _metrics
+from ..utils.locktrace import mtlock
+from . import errors
+
+# mirrors xl_storage._FSYNC (import would be circular: xl_storage
+# imports this module for the collector hooks)
+_FSYNC = os.environ.get("MT_FSYNC", "1") != "0"
+
+
+class CommitConfig:
+    """Live-reloadable knobs (``commit`` kvconfig subsystem).  Reads
+    env/defaults lazily on first use; the server pushes admin
+    SetConfigKV values via S3Server.reload_commit_config (a fresh
+    kvconfig.Config cannot see another instance's dynamic layer)."""
+
+    def __init__(self):
+        self.enable = True
+        self.group_window_s = 0.0       # extra wait for batch-mates
+        self.max_batch = 16             # ops coalesced per group commit
+        self.pack_threshold = 1 << 20   # pack objects up to this size
+        self.segment_max_bytes = 64 << 20   # segment rotation point
+        self._loaded = False
+
+    def load(self, cfg=None) -> None:
+        try:
+            if cfg is None:
+                from ..utils.kvconfig import Config
+                cfg = Config()
+            # parse ALL knobs first, assign atomically: a bad value in
+            # one key must not leave a silently half-applied config
+            enable = str(cfg.get("commit", "enable")
+                         ).strip().lower() not in ("off", "0",
+                                                   "false", "")
+            window_s = max(
+                0.0, int(cfg.get("commit", "group_window_us")) / 1e6)
+            max_batch = max(1, int(cfg.get("commit", "max_batch")))
+            pack = max(0, int(cfg.get("commit", "pack_threshold")))
+            seg_max = max(1 << 20,
+                          int(cfg.get("commit", "segment_max_bytes")))
+            self.enable = enable
+            self.group_window_s = window_s
+            self.max_batch = max_batch
+            self.pack_threshold = pack
+            self.segment_max_bytes = seg_max
+        except (KeyError, ValueError):
+            pass
+        self._loaded = True
+
+    def on(self) -> bool:
+        if not self._loaded:
+            self.load()
+        return self.enable
+
+
+CONFIG = CommitConfig()
+
+
+# -- the per-batch collector ------------------------------------------------
+
+_TLS = threading.local()
+
+
+def collector() -> "GroupCollector | None":
+    """The GroupCollector armed on THIS thread (a drive writer running
+    a grouped batch), or None — drive op bodies branch on this to defer
+    durability work instead of fsyncing eagerly."""
+    return getattr(_TLS, "collector", None)
+
+
+def arm(col: "GroupCollector") -> None:
+    _TLS.collector = col
+
+
+def disarm() -> None:
+    _TLS.collector = None
+
+
+class GroupCollector:
+    """Deferred-durability ledger for ONE drive-writer batch.
+
+    Runs entirely on the drive's single writer thread — no lock needed.
+    Every registration is tagged with the op currently executing
+    (``current_op``) so a flush-time fsync failure latches onto exactly
+    the streams whose writes it covered, and per-stream quorum is
+    re-checked from those latched errors as completions drain."""
+
+    def __init__(self):
+        self.current_op = None      # the _Op whose body is running
+        # (fd, storage, [ops], dedup_key): fds are DUP'D — the op body
+        # already closed its own, and fd fsync survives a later rename
+        self._fds: list = []
+        self._dirs: dict[str, list] = {}    # path -> registering ops
+        self._after: list = []              # (fn, op) continuations
+        # read-after-deferred-write map: final_path -> bytes for
+        # xl.meta replaces still parked in ``_after`` — a batch-mate's
+        # read-merge-write of the SAME object (or a heal riding the
+        # plane, which takes no ns_lock) must see the pending content
+        self._pending: dict[str, bytes] = {}
+        self.deferred = 0           # eager fsyncs this batch replaced
+        self.synced = 0             # fsync syscalls actually issued
+        self.seg_bytes = 0          # bytes packed into segments
+        self.streams: set = set()
+
+    # -- registration (op bodies) ------------------------------------------
+
+    def _note_stream(self) -> None:
+        if self.current_op is not None:
+            self.streams.add(id(self.current_op.stream))
+
+    def defer_fd(self, fd: int, storage=None, key=None) -> None:
+        """Take ownership of dup'd ``fd``; fsync it at flush.  A
+        non-None ``key`` dedups — many packed writes in one batch
+        register the same segment fd once (that dedup IS the saved
+        fsync the mt_commit_group_fsyncs_saved_total family counts)."""
+        self.deferred += 1
+        self._note_stream()
+        if key is not None:
+            for rec in self._fds:
+                if rec[3] == key:
+                    os.close(fd)
+                    rec[2].append(self.current_op)
+                    return
+        self._fds.append((fd, storage, [self.current_op], key))
+
+    def defer_dir(self, path: str) -> None:
+        """Defer a parent-directory entry fsync; identical paths across
+        the batch (the shared bucket dir of a fresh-object fan-in)
+        collapse to one syscall."""
+        self.deferred += 1
+        self._note_stream()
+        self._dirs.setdefault(path, []).append(self.current_op)
+
+    def after_flush(self, fn) -> None:
+        """Run ``fn`` after every fsync registered so far has landed —
+        the slot for visibility flips (xl.meta os.replace) and for old
+        data-dir purges that must not precede the commit point."""
+        self._after.append((fn, self.current_op))
+
+    def pending_put(self, path: str, data: bytes) -> None:
+        self._pending[path] = data
+
+    def pending_get(self, path: str) -> bytes | None:
+        return self._pending.get(path)
+
+    # -- flush (the group commit) ------------------------------------------
+
+    @staticmethod
+    def _latch(ops, err: Exception) -> None:
+        for op in ops:
+            if op is not None:
+                try:
+                    op.stream._latch_err(op.idx, err)
+                except Exception:  # noqa: BLE001 — latch best-effort
+                    pass
+
+    def flush(self) -> None:
+        """Rounds until quiescent: fsync registered fds, fsync dedup'd
+        dirs, then run continuations (which may register more of both —
+        a deferred xl.meta replace re-registers its parent dir)."""
+        while self._fds or self._dirs or self._after:
+            fds, self._fds = self._fds, []
+            dirs, self._dirs = self._dirs, {}
+            # group per drive so the flush-time fsync wall is charged
+            # to each drive's commit micro-profiler, not lost
+            fds.sort(key=lambda rec: id(rec[1]))
+            run_storage, run_t0 = None, 0
+            for fd, storage, ops, _key in fds:
+                if storage is not run_storage:
+                    if run_storage is not None:
+                        run_storage._prof("fsync", run_t0)
+                    run_storage, run_t0 = storage, time.monotonic_ns()
+                try:
+                    os.fsync(fd)
+                except OSError as e:
+                    self._latch(ops, errors.FaultyDisk(str(e)))
+                finally:
+                    os.close(fd)
+                self.synced += 1
+            if run_storage is not None:
+                run_storage._prof("fsync", run_t0)
+            for path, ops in dirs.items():
+                self.synced += 1
+                try:
+                    dfd = os.open(path, os.O_RDONLY
+                                  | getattr(os, "O_DIRECTORY", 0))
+                except OSError:
+                    continue        # same tolerance as _fsync_dir
+                try:
+                    os.fsync(dfd)
+                except OSError:
+                    pass
+                finally:
+                    os.close(dfd)
+            after, self._after = self._after, []
+            for fn, op in after:
+                self.current_op = op
+                try:
+                    fn()
+                except Exception as e:  # noqa: BLE001 — latched per op
+                    self._latch([op], e)
+            self.current_op = None
+        self._pending.clear()
+
+    def publish(self, n_ops: int) -> None:
+        """Tick the mt_commit_group_* families for one flushed batch —
+        only when the plane actually engaged (grouped ops or deferred
+        durability work), so an idle or disabled plane emits nothing."""
+        if n_ops <= 1 and self.deferred == 0:
+            return
+        _metrics.inc("mt_commit_group_batches_total", {})
+        _metrics.inc("mt_commit_group_streams_total", {},
+                     max(1, len(self.streams)))
+        saved = self.deferred - self.synced
+        if saved > 0:
+            _metrics.inc("mt_commit_group_fsyncs_saved_total", {}, saved)
+        if self.seg_bytes:
+            _metrics.inc("mt_commit_group_segment_bytes_total", {},
+                         self.seg_bytes)
+
+
+# -- packed small-object segments -------------------------------------------
+
+SEG_DIR = "seg"                      # under <root>/.mt.sys/
+_JOURNAL = "journal"
+
+
+def _seg_name(sid: int) -> str:
+    return f"seg.{sid:08x}.dat"
+
+
+class SegmentStore:
+    """Journaled append-only segment files packing many small objects'
+    framed shards on one drive.
+
+    Layout under ``dir_path`` (= ``<root>/.mt.sys/seg``):
+
+        journal            msgpack add/free/seal/drop records, append-only
+        seg.<sid>.dat      framed shards back to back, append-only
+
+    Crash safety is manifest-written-last, twice over: the journal
+    record and segment bytes are fsynced in the same flush round BEFORE
+    the owner's xl.meta replace runs (GroupCollector ordering), so a
+    version never points at bytes that could vanish; and recovery is a
+    pure journal replay — duplicate adds and frees are idempotent, a
+    torn tail record is truncated away, and an extent whose owner
+    xl.meta never landed is reclaimed by the compactor's owner check.
+    """
+
+    def __init__(self, dir_path: str):
+        self.dir = dir_path
+        self._mu = mtlock("commit.segstore")
+        # sid -> {"size": int, "sealed": bool,
+        #         "live": {off: (length, vol, name, vid)}}
+        self._segs: dict[int, dict] = {}
+        self._cur = 0
+        self._cur_fd = -1
+        self._jfd = -1
+        self._loaded = False
+
+    # -- journal -----------------------------------------------------------
+
+    def _jpath(self) -> str:
+        return os.path.join(self.dir, _JOURNAL)
+
+    def _replay(self) -> None:
+        """Idempotent journal replay; truncates a torn tail record."""
+        try:
+            f = open(self._jpath(), "rb")
+        except FileNotFoundError:
+            return
+        good = 0
+        with f:
+            unp = msgpack.Unpacker(f, raw=False, strict_map_key=False)
+            try:
+                for rec in unp:
+                    self._apply(rec)
+                    good = unp.tell()
+            except Exception:  # noqa: BLE001 — torn tail ends replay
+                pass
+            end = f.seek(0, 2)
+        if good < end:
+            with open(self._jpath(), "r+b") as f:
+                f.truncate(good)
+
+    def _apply(self, rec: dict) -> None:
+        op = rec.get("op")
+        if op == "add":
+            s = self._segs.setdefault(
+                rec["sid"], {"size": 0, "sealed": False, "live": {}})
+            s["live"][rec["off"]] = (rec["len"], rec.get("vol", ""),
+                                     rec.get("name", ""),
+                                     rec.get("vid", ""))
+            s["size"] = max(s["size"], rec["off"] + rec["len"])
+        elif op == "free":
+            s = self._segs.get(rec["sid"])
+            if s is not None:
+                s["live"].pop(rec["off"], None)
+        elif op == "seal":
+            s = self._segs.get(rec["sid"])
+            if s is not None:
+                s["sealed"] = True
+        elif op == "drop":
+            self._segs.pop(rec["sid"], None)
+
+    def _journal(self, rec: dict) -> None:
+        os.write(self._jfd, msgpack.packb(rec, use_bin_type=True))
+
+    def _ensure(self) -> None:
+        if self._loaded:
+            return
+        os.makedirs(self.dir, exist_ok=True)
+        self._replay()
+        self._jfd = os.open(self._jpath(),
+                            os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        open_sids = [sid for sid, s in self._segs.items()
+                     if not s["sealed"]]
+        self._cur = max(open_sids) if open_sids \
+            else (max(self._segs) + 1 if self._segs else 1)
+        self._cur_fd = os.open(
+            os.path.join(self.dir, _seg_name(self._cur)),
+            os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        self._segs.setdefault(
+            self._cur, {"size": 0, "sealed": False, "live": {}})
+        # a crash may have left appended-but-unjournaled bytes at the
+        # segment tail; append past them (extents are journal-defined)
+        self._segs[self._cur]["size"] = max(
+            self._segs[self._cur]["size"],
+            os.fstat(self._cur_fd).st_size)
+        self._loaded = True
+
+    # -- extents -----------------------------------------------------------
+
+    def append(self, framed, vol: str, name: str,
+               vid: str) -> tuple[int, int]:
+        """Append one framed shard; returns (sid, off).  Durability is
+        the CALLER's job: fsync via :meth:`sync` (eager) or
+        :meth:`defer_sync` (grouped) before any xl.meta references the
+        extent."""
+        data = bytes(framed) if not isinstance(framed, bytes) else framed
+        with self._mu:
+            self._ensure()
+            s = self._segs[self._cur]
+            if s["size"] and s["size"] + len(data) \
+                    > CONFIG.segment_max_bytes:
+                self._rotate()
+                s = self._segs[self._cur]
+            sid, off = self._cur, s["size"]
+            from .xl_storage import _write_full
+            _write_full(self._cur_fd, data)
+            s["size"] = off + len(data)
+            s["live"][off] = (len(data), vol, name, vid)
+            self._journal({"op": "add", "sid": sid, "off": off,
+                           "len": len(data), "vol": vol, "name": name,
+                           "vid": vid})
+            return sid, off
+
+    def _rotate(self) -> None:
+        # caller holds self._mu
+        self._journal({"op": "seal", "sid": self._cur})
+        self._segs[self._cur]["sealed"] = True
+        os.close(self._cur_fd)
+        self._cur += 1
+        self._cur_fd = os.open(
+            os.path.join(self.dir, _seg_name(self._cur)),
+            os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        self._segs[self._cur] = {"size": 0, "sealed": False, "live": {}}
+
+    def sync(self) -> None:
+        """Eager durability (no collector armed): fsync the open
+        segment + journal now."""
+        if not _FSYNC:
+            return
+        with self._mu:
+            if self._cur_fd >= 0:
+                os.fsync(self._cur_fd)
+            if self._jfd >= 0:
+                os.fsync(self._jfd)
+
+    def defer_sync(self, col: GroupCollector, storage=None) -> None:
+        """Grouped durability: register dup'd segment + journal fds
+        with the batch collector, dedup'd per store — N packed writes
+        in one batch cost ONE segment fsync + ONE journal fsync."""
+        if not _FSYNC:
+            return
+        with self._mu:
+            if self._cur_fd >= 0:
+                col.defer_fd(os.dup(self._cur_fd), storage=storage,
+                             key=("seg", id(self), self._cur))
+            if self._jfd >= 0:
+                col.defer_fd(os.dup(self._jfd), storage=storage,
+                             key=("segj", id(self)))
+
+    def read(self, sid: int, off: int, length: int) -> bytes:
+        with self._mu:
+            self._ensure()
+        try:
+            fd = os.open(os.path.join(self.dir, _seg_name(sid)),
+                         os.O_RDONLY)
+        except FileNotFoundError:
+            raise errors.FileNotFound(f"segment {sid}") from None
+        try:
+            data = os.pread(fd, length, off)
+        finally:
+            os.close(fd)
+        if len(data) < length:
+            raise errors.FileCorrupt(
+                f"segment {sid}: short read {len(data)} < {length} "
+                f"at +{off}")
+        return data
+
+    def stat(self, sid: int, off: int, length: int) -> int:
+        """Extent length check (check_parts leg): FileNotFound when the
+        segment is gone, FileCorrupt when it is too short."""
+        with self._mu:
+            self._ensure()
+        try:
+            size = os.stat(
+                os.path.join(self.dir, _seg_name(sid))).st_size
+        except FileNotFoundError:
+            raise errors.FileNotFound(f"segment {sid}") from None
+        if size < off + length:
+            raise errors.FileCorrupt(
+                f"segment {sid}: {size} < {off + length}")
+        return length
+
+    def free(self, sid: int, off: int) -> None:
+        """Drop one extent; a sealed segment with zero live extents is
+        unlinked on the spot (the degenerate compaction)."""
+        unlink = False
+        with self._mu:
+            self._ensure()
+            s = self._segs.get(sid)
+            if s is None or off not in s["live"]:
+                return
+            s["live"].pop(off, None)
+            self._journal({"op": "free", "sid": sid, "off": off})
+            if s["sealed"] and not s["live"]:
+                self._journal({"op": "drop", "sid": sid})
+                self._segs.pop(sid, None)
+                unlink = True
+        if unlink:
+            try:
+                os.unlink(os.path.join(self.dir, _seg_name(sid)))
+            except OSError:
+                pass
+
+    # -- compaction --------------------------------------------------------
+
+    def compact(self, rewrite, min_dead_ratio: float = 0.5) -> dict:
+        """Reclaim dead segment space: for every SEALED segment whose
+        dead ratio crossed ``min_dead_ratio``, move each live extent
+        through ``rewrite(vol, name, vid, sid, off, length) -> bool``
+        (the drive rewrites the owner's xl.meta to a fresh extent and
+        returns True, or False when the owner no longer references the
+        extent — then it is simply freed).  Invariants: new bytes are
+        durable before any owner meta moves (rewrite appends + syncs),
+        an old extent is freed only once its owner stopped referencing
+        it, and a segment file is unlinked only at zero live extents.
+        Returns {"segments", "moved", "freed", "reclaimed_bytes"}."""
+        with self._mu:
+            self._ensure()
+            candidates = []
+            for sid, s in list(self._segs.items()):
+                if not s["sealed"] or not s["size"]:
+                    continue
+                live = sum(ln for ln, *_ in s["live"].values())
+                if not s["live"] or \
+                        (s["size"] - live) / s["size"] >= min_dead_ratio:
+                    candidates.append(
+                        (sid, dict(s["live"]), s["size"] - live))
+        moved = freed = segments = reclaimed = 0
+        for sid, live, dead_bytes in candidates:
+            for off, (length, vol, name, vid) in live.items():
+                ok = False
+                try:
+                    ok = rewrite(vol, name, vid, sid, off, length)
+                except Exception:  # noqa: BLE001 — next sweep retries
+                    continue
+                if ok:
+                    moved += 1
+                else:
+                    freed += 1
+                self.free(sid, off)
+            segments += 1
+            reclaimed += dead_bytes
+        return {"segments": segments, "moved": moved, "freed": freed,
+                "reclaimed_bytes": reclaimed}
+
+    def stats(self) -> dict:
+        with self._mu:
+            if not self._loaded:
+                return {"segments": 0, "live_bytes": 0, "dead_bytes": 0}
+            live = dead = 0
+            for s in self._segs.values():
+                lb = sum(ln for ln, *_ in s["live"].values())
+                live += lb
+                dead += s["size"] - lb
+            return {"segments": len(self._segs), "live_bytes": live,
+                    "dead_bytes": dead}
+
+    def close(self) -> None:
+        with self._mu:
+            if self._cur_fd >= 0:
+                os.close(self._cur_fd)
+                self._cur_fd = -1
+            if self._jfd >= 0:
+                os.close(self._jfd)
+                self._jfd = -1
+            self._loaded = False
+            self._segs.clear()

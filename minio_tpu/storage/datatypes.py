@@ -122,6 +122,12 @@ class FileInfo:
     erasure: ErasureInfo = field(default_factory=ErasureInfo)
     # small-object inline payload (storage REST v25 "small file optimization")
     inline_data: Optional[bytes] = None
+    # packed-segment extent {"sid", "off", "len"} — the framed shard
+    # lives inside this drive's append-only segment file instead of a
+    # part file (storage/commit.py SegmentStore; extends the inline
+    # precedent past the single-object boundary).  Per-drive, like
+    # inline_data: excluded from the cross-drive meta consistency hash.
+    seg: Optional[dict] = None
     fresh: bool = False           # first write of this object
     num_versions: int = 0
     successor_mod_time: int = 0
@@ -137,6 +143,8 @@ class FileInfo:
         }
         if self.inline_data is not None:
             d["inline"] = self.inline_data
+        if self.seg is not None:
+            d["seg"] = dict(self.seg)
         return d
 
     @classmethod
@@ -149,4 +157,4 @@ class FileInfo:
             metadata=dict(d.get("meta", {})),
             parts=[ObjectPartInfo.from_dict(p) for p in d.get("parts", [])],
             erasure=ErasureInfo.from_dict(d.get("ec", {})),
-            inline_data=d.get("inline"))
+            inline_data=d.get("inline"), seg=d.get("seg"))

@@ -68,6 +68,8 @@ def register_storage_service(rpc: RPCServer,
             drive(drive_id).append_file(volume, path, data),
         "read_file_stream": lambda drive_id, volume, path, offset, length:
             drive(drive_id).read_file_stream(volume, path, offset, length),
+        "read_segment": lambda drive_id, sid, off, length:
+            drive(drive_id).read_segment(sid, off, length),
         "rename_file": lambda drive_id, src_volume, src_path, dst_volume,
             dst_path: drive(drive_id).rename_file(
                 src_volume, src_path, dst_volume, dst_path),
@@ -125,6 +127,12 @@ def register_storage_service(rpc: RPCServer,
             # round trip (vs tmp_dir + create_file + rename_data = 3)
             d.write_data_commit(params["volume"], params["path"],
                                 FileInfo.from_dict(params["fi"]), data)
+        elif params.get("op") == "packed":
+            # packed small-object commit: the shard joins the owning
+            # node's segment file, grouping with that node's local
+            # traffic (the group-commit plane is per physical drive)
+            d.write_packed(params["volume"], params["path"],
+                           FileInfo.from_dict(params["fi"]), data)
         else:
             d.create_file(params["volume"], params["path"], data,
                           params.get("file_size", -1))
@@ -194,9 +202,9 @@ class RemoteStorage(StorageAPI):
     # connection; mutations must never execute twice
     _IDEMPOTENT = {
         "disk_info", "list_vols", "stat_vol", "list_dir", "read_all",
-        "read_file_stream", "stat_info_file", "read_version",
-        "list_versions", "verify_file", "check_parts", "walk_dir",
-        "walk_entries", "get_disk_id",
+        "read_file_stream", "read_segment", "stat_info_file",
+        "read_version", "list_versions", "verify_file", "check_parts",
+        "walk_dir", "walk_entries", "get_disk_id",
     }
 
     def _call(self, method: str, **kwargs):
@@ -396,6 +404,23 @@ class RemoteStorage(StorageAPI):
         self._raw("storage-write",
                   {"volume": volume, "path": path, "op": "commit",
                    "fi": d}, bytes(data) if body is None else body)
+
+    def write_packed(self, volume, path, fi, data,
+                     shard_index=None, version_dict=None):
+        # packed small-object commit: the shard joins the OWNING node's
+        # segment file, so it groups with that node's local traffic (the
+        # group-commit plane is per physical drive, not per caller)
+        d = dict(version_dict) if version_dict is not None \
+            else fi.to_dict()
+        if shard_index is not None:
+            d["ec"] = dict(d["ec"], index=shard_index)
+        body = self._stream_body(data, STREAM.chunk())
+        self._raw("storage-write",
+                  {"volume": volume, "path": path, "op": "packed",
+                   "fi": d}, bytes(data) if body is None else body)
+
+    def read_segment(self, sid, off, length):
+        return self._call("read_segment", sid=sid, off=off, length=length)
 
     # metadata
     def rename_data(self, src_volume, src_path, fi, dst_volume, dst_path):

@@ -20,7 +20,13 @@ writer thread per drive with a bounded in-order queue:
   * the plane is shared by streaming PUT, the overlapped bytes-PUT
     commit, multipart part uploads, and heal writes — concurrent
     streams interleave on the per-drive queues without ordering
-    hazards because each stream only ever appends to its own files.
+    hazards because each stream only ever appends to its own files;
+  * with the ``commit`` kvconfig subsystem on, each drive's drain is
+    GROUPED (storage/commit.py): up to commit.max_batch queued ops run
+    their bodies with a GroupCollector armed, ONE flush of deduplicated
+    file + parent-dir fsyncs settles the whole batch, and every
+    stream's durability is acknowledged (quorum re-checked) only after
+    its covering fsync landed.
 
 Shutdown: ``close()`` wakes blocked enqueuers (they see PlaneClosed and
 abort their PUT, which cleans its tmp files), fails every queued op so
@@ -38,6 +44,7 @@ import time
 from ..obs import critpath as _critpath
 from ..obs import stages as _stages
 from ..obs import trace as _trace
+from . import commit as _commit
 from . import errors as serrors
 from ..utils.locktrace import mtlock, mtrlock
 
@@ -92,11 +99,17 @@ class _Op:
         self.clock = clock
         self.parent = parent
 
-    def run(self, disk) -> None:
+    def run_body(self, disk) -> tuple:
+        """Execute the op body WITHOUT settling; returns ``(err, dt)``.
+        Group commit splits body from settlement so a whole batch's
+        bodies run before the shared flush, and every stream's quorum
+        is re-checked (via settle) only after its covering fsync
+        landed.  An error still latches into the stream's ``errs``
+        immediately — a same-stream batch-mate later in the batch must
+        skip, not append after a failure."""
         st = self.stream
         if st.cancelled or st.errs[self.idx] is not None:
-            st._op_done(self.idx, None, self.batch, 0.0)
-            return
+            return (None, 0.0)
         # per-drive spans must carry the originating request ID even
         # though the worker thread outlives any one request; the X-ray
         # clock rides along so a remote drive's RPC leg is attributed
@@ -109,11 +122,17 @@ class _Op:
         t0 = time.perf_counter()
         try:
             self.fn(self.idx, disk)
-            st._op_done(self.idx, None, self.batch,
-                        time.perf_counter() - t0)
+            return (None, time.perf_counter() - t0)
         except Exception as e:  # noqa: BLE001 — latched, quorum decides
-            st._op_done(self.idx, e, self.batch,
-                        time.perf_counter() - t0)
+            st._latch_err(self.idx, e)
+            return (e, time.perf_counter() - t0)
+
+    def settle(self, err: Exception | None, dt: float) -> None:
+        self.stream._op_done(self.idx, err, self.batch, dt)
+
+    def run(self, disk) -> None:
+        err, dt = self.run_body(disk)
+        self.settle(err, dt)
 
     def fail(self, err: Exception) -> None:
         self.stream._op_done(self.idx, err, self.batch, 0.0)
@@ -154,13 +173,60 @@ class _DriveWriter:
                     self._cv.wait()
                 if not self._q:          # closed and drained
                     return
-                op = self._q.pop(0)
-                self._cv.notify_all()    # wake a putter at the bound
+                grouped = not self._closed and _commit.CONFIG.on()
+                if grouped:
+                    limit = max(1, _commit.CONFIG.max_batch)
+                    window = _commit.CONFIG.group_window_s
+                    if window > 0 and len(self._q) < limit:
+                        # linger briefly for batch-mates still in
+                        # encode; already-queued ops coalesce for free
+                        self._cv.wait(window)
+                ops = [self._q.pop(0)]
+                if grouped:
+                    while self._q and len(ops) < limit:
+                        ops.append(self._q.pop(0))
+                self._cv.notify_all()    # wake putters at the bound
             if self._closed:
-                op.fail(PlaneClosed("writer plane closed"))
+                for op in ops:
+                    op.fail(PlaneClosed("writer plane closed"))
+                    self.ops += 1
+            elif not grouped:
+                ops[0].run(self.disk)
+                self.ops += 1
             else:
-                op.run(self.disk)
-            self.ops += 1
+                self._group_commit(ops)
+
+    def _group_commit(self, ops: list[_Op]) -> None:
+        """One group commit: run every op body with the collector armed
+        (bodies defer their fsyncs / visibility flips into it), flush
+        once — one fsync wall settles the whole batch — THEN settle
+        each op so per-stream quorum is re-checked only after its
+        covering fsync landed."""
+        col = _commit.GroupCollector()
+        _commit.arm(col)
+        settles: list[tuple] = []
+        try:
+            for op in ops:
+                col.current_op = op
+                settles.append(op.run_body(self.disk))
+            col.current_op = None
+            col.flush()
+        except Exception as e:  # noqa: BLE001 — flush must not kill us
+            for op in ops:
+                try:
+                    op.stream._latch_err(op.idx, e)
+                except Exception:  # noqa: BLE001 — stream already
+                    pass           # dead/settled; flush error stands
+        finally:
+            _commit.disarm()
+            col.publish(len(ops))
+            while len(settles) < len(ops):
+                settles.append((None, 0.0))
+            for op, (err, dt) in zip(ops, settles):
+                # flush-time failures latched into stream errs; settle
+                # re-reads nothing — _op_done only adds err if unset
+                op.settle(err, dt)
+                self.ops += 1
 
     def close(self, timeout: float) -> None:
         with self._cv:
@@ -208,10 +274,23 @@ class StreamWriter:
 
     # -- submission --------------------------------------------------------
 
-    def submit(self, idx: int, fn, batch: _Batch | None = None) -> bool:
+    def _latch_err(self, idx: int, err: Exception) -> None:
+        """Latch a drive error AHEAD of the op's settlement — group
+        commit needs it visible the moment a body or flush-time fsync
+        fails, so a same-stream batch-mate later in the batch skips
+        instead of appending after the failure.  ``_op_done``'s
+        only-if-unset guard makes the later settlement a no-op."""
+        with self._cv:
+            if self.errs[idx] is None:
+                self.errs[idx] = err
+
+    def submit(self, idx: int, fn, batch: _Batch | None = None,
+               bound: int | None = None) -> bool:
         """Queue ``fn(idx, disk)`` on drive idx's writer (in-order per
         drive).  Returns False (settling ``batch``) for drives already
-        dead for this stream.  Blocks only at the queue-depth bound;
+        dead for this stream.  Blocks only at the queue-depth bound
+        (``bound`` overrides the plane's — commit-class ops widen it to
+        the group-commit batch size so whole-object commits coalesce);
         raises PlaneClosed if the plane shuts down meanwhile."""
         disk = self.disks[idx]
         if disk is None or self.errs[idx] is not None or self.cancelled:
@@ -227,7 +306,7 @@ class StreamWriter:
             # the enqueue may park at the per-drive queue bound — that
             # wait is the ``write_enqueue`` X-ray stage
             t0 = time.perf_counter()
-            self._plane._enqueue(disk, op)
+            self._plane._enqueue(disk, op, bound)
             dt = time.perf_counter() - t0
             if dt > 0.0005:
                 _stages.add("write_enqueue", int(dt * 1e9))
@@ -371,7 +450,7 @@ class WriterPlane:
         except (TypeError, ValueError):
             return 2
 
-    def _enqueue(self, disk, op: _Op) -> None:
+    def _enqueue(self, disk, op: _Op, bound: int | None = None) -> None:
         key = id(disk)
         with self._mu:
             if self._closed or op.stream._gen != self._gen:
@@ -384,7 +463,7 @@ class WriterPlane:
                     disk, f"mt-putw-{next(WriterPlane._NAMES)}")
                 self._writers[key] = w
             self.used = True
-        w.put(op, self.queue_bound())
+        w.put(op, bound if bound is not None else self.queue_bound())
 
     def stats(self) -> dict[str, dict]:
         """Per-drive {endpoint: {queue_depth, stalls, ops}} snapshot."""

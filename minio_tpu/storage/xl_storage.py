@@ -32,6 +32,7 @@ from typing import Iterable
 from ..admin.metrics import GLOBAL as _metrics
 from ..obs import lastminute as _lastminute
 from ..obs import trace as _trace
+from . import commit as _commit
 from . import errors
 from .api import DiskInfo, StorageAPI, VolInfo
 from .datatypes import FileInfo
@@ -114,33 +115,71 @@ def _write_full(fd: int, data) -> None:
 _TMP_SEQ = itertools.count()
 
 
-def _write_file_atomic(final_path: str, data) -> None:
+def _write_file_atomic(final_path: str, data, storage=None) -> None:
     """THE tmp -> fsync -> os.replace atomic-visibility recipe,
     raw-fd flavor — shared by write_all and the commit hot path so the
     durability protocol lives in exactly one place.  Tmp names use a
     pid+counter (unique within the machine); uuid4 costs ~14us a call
-    and the 16-drive commit fan-out runs this per drive."""
+    and the 16-drive commit fan-out runs this per drive.
+
+    Under a group commit (a collector armed on this writer thread) the
+    SAME protocol runs batched: the tmp fd's fsync defers into the
+    batch flush, and the visibility-flipping os.replace parks as an
+    after-flush continuation — so the replace still happens only after
+    THIS file's bytes (and every batch-mate's) are durable, and the
+    parent-dir entry fsync re-registers behind the replace.  Pending
+    content is published so a batch-mate's read-merge-write of the
+    same path (two versions of one object in one batch) sees it."""
     tmp = final_path + f".tmp.{os.getpid():x}.{next(_TMP_SEQ):x}"
     fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    col = _commit.collector()
     try:
         _write_full(fd, data)
         if _FSYNC:
-            os.fsync(fd)
+            if col is not None:
+                col.defer_fd(os.dup(fd), storage=storage)
+            else:
+                os.fsync(fd)
     finally:
         os.close(fd)
-    os.replace(tmp, final_path)
+    if col is None:
+        os.replace(tmp, final_path)
+        return
+    col.pending_put(final_path,
+                    data if isinstance(data, bytes) else bytes(data))
+
+    def _flip():
+        os.replace(tmp, final_path)
+        # the rename's directory entry needs its own fsync AFTER the
+        # replace — re-register so the next flush round persists it
+        col.defer_dir(os.path.dirname(final_path))
+    col.after_flush(_flip)
 
 
-def _fsync_fileobj(f) -> None:
-    if _FSYNC:
-        f.flush()
+def _fsync_fileobj(f, storage=None) -> None:
+    if not _FSYNC:
+        return
+    f.flush()
+    col = _commit.collector()
+    if col is not None:
+        # dup: the caller closes its own fd right after, and an fd
+        # fsync at flush is immune to a rename in between
+        col.defer_fd(os.dup(f.fileno()), storage=storage)
+    else:
         os.fsync(f.fileno())
 
 
 def _fsync_dir(path: str) -> None:
     """Persist directory entries (renames/creates) the way the reference's
-    commit contract requires (cmd/xl-storage.go:1965 RenameData)."""
+    commit contract requires (cmd/xl-storage.go:1965 RenameData).  Under
+    a group commit the fsync defers into the batch flush, where
+    identical paths across the batch (the shared bucket dir of a
+    fresh-object fan-in) collapse to one syscall."""
     if not _FSYNC:
+        return
+    col = _commit.collector()
+    if col is not None:
+        col.defer_dir(path)
         return
     try:
         fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
@@ -183,6 +222,11 @@ class XLStorage(StorageAPI):
         # drive surfaces as FileNotFound from the op itself, and the
         # DriveMonitor reformat path recreates volumes via make_vol)
         self._vols_seen: set[str] = set()
+        # packed small-object segments (storage/commit.py): journaled
+        # append-only files under .mt.sys/seg — lazily opened, journal
+        # replayed on first packed op after a restart/crash
+        self.segments = _commit.SegmentStore(
+            os.path.join(self.root, SYS_DIR, _commit.SEG_DIR))
 
     # -- identity / health -------------------------------------------------
 
@@ -340,7 +384,7 @@ class XLStorage(StorageAPI):
         full = self._file_path(volume, path)
         self._check_vol(volume)
         try:
-            _write_file_atomic(full, data)
+            _write_file_atomic(full, data, storage=self)
         except FileNotFoundError:
             # parent missing: create it (never a silently-wiped volume,
             # same contract as _open_create)
@@ -348,7 +392,7 @@ class XLStorage(StorageAPI):
                 self._vols_seen.discard(volume)
                 raise errors.VolumeNotFound(volume) from None
             os.makedirs(os.path.dirname(full), exist_ok=True)
-            _write_file_atomic(full, data)
+            _write_file_atomic(full, data, storage=self)
         _fsync_dir(os.path.dirname(full))
 
     def create_file(self, volume: str, path: str, data: bytes,
@@ -376,7 +420,7 @@ class XLStorage(StorageAPI):
         with self._open_create(volume, full) as f:
             f.write(data)
             t0 = self._prof("create", t0, len(data))
-            _fsync_fileobj(f)
+            _fsync_fileobj(f, storage=self)
             self._prof("fsync", t0)
 
     def append_file(self, volume: str, path: str, data: bytes) -> None:
@@ -387,7 +431,7 @@ class XLStorage(StorageAPI):
         with open(full, "ab") as f:
             f.write(data)
             t0 = self._prof("append", t0, len(data))
-            _fsync_fileobj(f)
+            _fsync_fileobj(f, storage=self)
             self._prof("fsync", t0)
 
     def write_stream(self, volume: str, path: str, chunks,
@@ -421,7 +465,7 @@ class XLStorage(StorageAPI):
                 if file_size >= 0 and total != file_size:
                     raise errors.FileCorrupt(
                         f"size mismatch: {total} != {file_size}")
-                _fsync_fileobj(f)
+                _fsync_fileobj(f, storage=self)
         except BaseException:
             if created:
                 try:
@@ -577,6 +621,14 @@ class XLStorage(StorageAPI):
         return self._file_path(volume, os.path.join(path, META_FILE))
 
     def _read_meta(self, volume: str, path: str) -> XLMeta:
+        col = _commit.collector()
+        if col is not None:
+            # read-after-deferred-write: a batch-mate's xl.meta replace
+            # may still be parked behind the flush — merge against the
+            # pending content, not the stale on-disk file
+            pending = col.pending_get(self._meta_path(volume, path))
+            if pending is not None:
+                return XLMeta.load(pending)
         try:
             buf = self.read_all(volume, os.path.join(path, META_FILE))
         except errors.FileNotFound:
@@ -585,6 +637,21 @@ class XLStorage(StorageAPI):
 
     def _write_meta(self, volume: str, path: str, meta: XLMeta) -> None:
         self.write_all(volume, os.path.join(path, META_FILE), meta.dump())
+
+    @staticmethod
+    def _purge_later(path: str) -> None:
+        """Purge a replaced version's dead payload — but never before
+        the replacing xl.meta is DURABLE: under a group commit the
+        rmtree parks TWO continuation rounds out (past the deferred
+        meta replace, past the replace's re-registered dir fsync), so a
+        crash mid-flush can resurrect the old xl.meta yet still find
+        its data dir intact, exactly like the eager order."""
+        col = _commit.collector()
+        if col is None:
+            shutil.rmtree(path, ignore_errors=True)
+            return
+        col.after_flush(lambda: col.after_flush(
+            lambda: shutil.rmtree(path, ignore_errors=True)))
 
     def rename_data(self, src_volume: str, src_path: str, fi: FileInfo,
                     dst_volume: str, dst_path: str) -> None:
@@ -628,8 +695,7 @@ class XLStorage(StorageAPI):
         self._prof("meta_merge", t_meta)
         if old_ddir and old_ddir != fi.data_dir \
                 and meta.shared_data_dir_count(fi.version_id, old_ddir) == 0:
-            shutil.rmtree(os.path.join(dst_obj_dir, old_ddir),
-                          ignore_errors=True)
+            self._purge_later(os.path.join(dst_obj_dir, old_ddir))
 
     def write_data_commit(self, volume: str, path: str, fi: FileInfo,
                           data, shard_index: int | None = None,
@@ -669,6 +735,7 @@ class XLStorage(StorageAPI):
             os.makedirs(dst_obj, exist_ok=True)   # nested object name
             fresh = True
         stream_ddir = None
+        col = _commit.collector()
         if fi.data_dir:
             ddir = dst_obj + "/" + fi.data_dir
             os.mkdir(ddir)
@@ -690,7 +757,10 @@ class XLStorage(StorageAPI):
                             _write_full(fd, chunk)
                         t_op = self._prof("create", t_op)
                         if _FSYNC:
-                            os.fsync(fd)
+                            if col is not None:
+                                col.defer_fd(os.dup(fd), storage=self)
+                            else:
+                                os.fsync(fd)
                     finally:
                         os.close(fd)
                 elif not (_ODIRECT
@@ -705,7 +775,10 @@ class XLStorage(StorageAPI):
                         _write_full(fd, data)
                         t_op = self._prof("create", t_op, len(data))
                         if _FSYNC:
-                            os.fsync(fd)
+                            if col is not None:
+                                col.defer_fd(os.dup(fd), storage=self)
+                            else:
+                                os.fsync(fd)
                     finally:
                         os.close(fd)
                 else:                # O_DIRECT landed the part whole
@@ -750,15 +823,129 @@ class XLStorage(StorageAPI):
         if shard_index is not None:
             vd["ec"] = dict(vd["ec"], index=shard_index)
         meta.add_version_dict(vd)
-        _write_file_atomic(dst_obj + "/" + META_FILE, meta.dump())
+        _write_file_atomic(dst_obj + "/" + META_FILE, meta.dump(),
+                           storage=self)
         _fsync_dir(dst_obj)
         if fresh:
             _fsync_dir(os.path.dirname(dst_obj))
         self._prof("meta_merge", t_meta)
         if old_ddir and old_ddir != fi.data_dir \
                 and meta.shared_data_dir_count(fi.version_id, old_ddir) == 0:
-            shutil.rmtree(os.path.join(dst_obj, old_ddir),
-                          ignore_errors=True)
+            self._purge_later(os.path.join(dst_obj, old_ddir))
+
+    def write_packed(self, volume: str, path: str, fi: FileInfo,
+                     data, shard_index: int | None = None,
+                     version_dict: dict | None = None) -> None:
+        """Packed small-object commit: the framed shard appends into
+        this drive's open segment file (one journaled ``add`` record)
+        instead of its own part file, and xl.meta points into the
+        segment via the per-drive ``seg`` version field.  Under a group
+        commit, durability rides the batch flush where the segment and
+        journal fds DEDUPLICATE — N tiny commits on a drive fold into
+        one segment fsync + one journal fsync — and the xl.meta replace
+        parks behind those fsyncs (write-ahead: a version is never
+        visible before its extent is durable).  Saves the per-object
+        data-dir mkdir, part-file create+fsync, and data-dir fsync the
+        write_data_commit path pays."""
+        self._check_vol(volume)
+        dst_obj = self._file_path(volume, path)
+        try:
+            os.mkdir(dst_obj)
+            fresh = True
+        except FileExistsError:
+            fresh = False
+        except FileNotFoundError:
+            if not os.path.isdir(self._vol_path(volume)):
+                self._vols_seen.discard(volume)
+                raise errors.VolumeNotFound(volume) from None
+            os.makedirs(dst_obj, exist_ok=True)
+            fresh = True
+        col = _commit.collector()
+        nbytes = len(data)
+        t_op = time.monotonic_ns()
+        sid, off = self.segments.append(data, volume, path,
+                                        fi.version_id)
+        t_op = self._prof("create", t_op, nbytes)
+        if col is not None:
+            self.segments.defer_sync(col, storage=self)
+            col.seg_bytes += nbytes
+        else:
+            self.segments.sync()
+            self._prof("fsync", t_op)
+        t_meta = time.monotonic_ns()
+        meta = XLMeta()
+        old_ddir, old_seg = "", None
+        if not fresh:
+            try:
+                meta = self._read_meta(volume, path)
+                try:
+                    old = meta.find(fi.version_id)
+                    old_ddir = old.get("ddir", "")
+                    old_seg = old.get("seg")
+                except errors.FileVersionNotFound:
+                    pass
+            except (errors.FileNotFound, errors.FileCorrupt):
+                pass
+        vd = dict(version_dict) if version_dict is not None \
+            else fi.to_dict()
+        if shard_index is not None:
+            vd["ec"] = dict(vd["ec"], index=shard_index)
+        vd["ddir"] = ""
+        vd["seg"] = {"sid": sid, "off": off, "len": nbytes}
+        meta.add_version_dict(vd)
+        _write_file_atomic(dst_obj + "/" + META_FILE, meta.dump(),
+                           storage=self)
+        _fsync_dir(dst_obj)
+        if fresh:
+            _fsync_dir(os.path.dirname(dst_obj))
+        self._prof("meta_merge", t_meta)
+        # replaced version's payload released only after the new meta
+        # is durable (same two-rounds-out discipline as _purge_later)
+        if old_ddir \
+                and meta.shared_data_dir_count(fi.version_id,
+                                               old_ddir) == 0:
+            self._purge_later(os.path.join(dst_obj, old_ddir))
+        if old_seg:
+            osid, ooff = old_seg["sid"], old_seg["off"]
+            if col is None:
+                self.segments.free(osid, ooff)
+            else:
+                col.after_flush(lambda: col.after_flush(
+                    lambda: self.segments.free(osid, ooff)))
+
+    def read_segment(self, sid: int, off: int, length: int) -> bytes:
+        """Read one packed extent (the GET-side of the ``seg``
+        indirection)."""
+        return self.segments.read(sid, off, length)
+
+    def compact_segments(self, min_dead_ratio: float = 0.5) -> dict:
+        """Background segment compaction (ridden by the heal sweep):
+        live extents of mostly-dead SEALED segments are re-appended and
+        their owners' xl.meta rewritten to the fresh extent; extents
+        whose owner version is gone (or moved on) are simply freed.
+        Order per extent: new bytes durable first, then the owner meta
+        flip, then the old extent free — a crash anywhere leaves a
+        readable object plus at worst a leaked extent the next sweep
+        reclaims."""
+        def rewrite(vol: str, name: str, vid: str, sid: int, off: int,
+                    length: int) -> bool:
+            try:
+                meta = self._read_meta(vol, name)
+                v = meta.find(vid)
+            except errors.StorageError:
+                return False
+            seg = v.get("seg")
+            if not seg or seg["sid"] != sid or seg["off"] != off:
+                return False
+            data = self.segments.read(sid, off, length)
+            nsid, noff = self.segments.append(data, vol, name, vid)
+            self.segments.sync()
+            nv = dict(v)
+            nv["seg"] = {"sid": nsid, "off": noff, "len": length}
+            meta.add_version_dict(nv)
+            self._write_meta(vol, name, meta)
+            return True
+        return self.segments.compact(rewrite, min_dead_ratio)
 
     def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
         # the INLINE-object commit path (erasure_object._commit_put for
@@ -808,6 +995,11 @@ class XLStorage(StorageAPI):
             meta.add_version(fi)
             self._write_meta(volume, path, meta)
             return
+        old_seg = None
+        try:
+            old_seg = meta.find(fi.version_id).get("seg")
+        except errors.FileVersionNotFound:
+            pass
         ddir = meta.delete_version(fi.version_id)
         obj_dir = self._file_path(volume, path)
         if ddir and meta.shared_data_dir_count(fi.version_id, ddir) == 0:
@@ -817,16 +1009,30 @@ class XLStorage(StorageAPI):
         else:
             # last version gone: remove xl.meta and prune the object path
             self.delete(volume, os.path.join(path, META_FILE))
+        if old_seg:
+            # packed extent freed AFTER the meta stopped referencing it
+            # (journaled; a sealed segment at zero live extents unlinks)
+            self.segments.free(old_seg["sid"], old_seg["off"])
 
     # -- integrity ---------------------------------------------------------
 
     def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:
         from ..hashing import bitrot
         ec = fi.erasure
+        seg = getattr(fi, "seg", None)
         for part in fi.parts:
-            pf = os.path.join(path, fi.data_dir, f"part.{part.number}")
-            ck = ec.get_checksum_info(part.number)
-            data = self.read_all(volume, pf)
+            if seg:
+                # packed object (single part): the framed shard lives
+                # in the segment; bitrot framing verifies the same way
+                pf = f"seg.{seg['sid']:08x}+{seg['off']}"
+                data = self.segments.read(seg["sid"], seg["off"],
+                                          seg["len"])
+                ck = ec.get_checksum_info(part.number)
+            else:
+                pf = os.path.join(path, fi.data_dir,
+                                  f"part.{part.number}")
+                ck = ec.get_checksum_info(part.number)
+                data = self.read_all(volume, pf)
             shard_size = ec.shard_size()
             if bitrot.is_streaming(ck.algorithm):
                 want = bitrot.bitrot_shard_file_size(
@@ -847,9 +1053,16 @@ class XLStorage(StorageAPI):
     def check_parts(self, volume: str, path: str, fi: FileInfo) -> None:
         from ..hashing import bitrot
         ec = fi.erasure
+        seg = getattr(fi, "seg", None)
         for part in fi.parts:
-            pf = os.path.join(path, fi.data_dir, f"part.{part.number}")
-            size = self.stat_info_file(volume, pf)
+            if seg:
+                pf = f"seg.{seg['sid']:08x}+{seg['off']}"
+                size = self.segments.stat(seg["sid"], seg["off"],
+                                          seg["len"])
+            else:
+                pf = os.path.join(path, fi.data_dir,
+                                  f"part.{part.number}")
+                size = self.stat_info_file(volume, pf)
             ck = ec.get_checksum_info(part.number)
             want = bitrot.bitrot_shard_file_size(
                 ec.shard_file_size(part.size), ec.shard_size(), ck.algorithm)
@@ -943,6 +1156,7 @@ class XLStorage(StorageAPI):
 
 _TRACED_OPS = ("read_all", "read_file_stream", "write_all",
                "create_file", "append_file", "write_data_commit",
+               "write_packed", "read_segment",
                "rename_data", "rename_file", "write_metadata",
                "update_metadata", "read_version", "list_versions",
                "delete_version", "delete", "stat_info_file", "list_dir",
@@ -950,7 +1164,7 @@ _TRACED_OPS = ("read_all", "read_file_stream", "write_all",
 # payload position in the post-self positional args for write-side ops;
 # read-side ops report the returned byte count instead
 _OP_IN_ARG = {"write_all": 2, "create_file": 2, "append_file": 2,
-              "write_data_commit": 3}
+              "write_data_commit": 3, "write_packed": 3}
 
 # re-entrancy guard: traced ops call each other internally (verify_file
 # reads parts via read_all, delete_version rewrites xl.meta via

@@ -174,6 +174,27 @@ register_subsys("codec", {
     "max_batch_blocks": "256",
     "queue_depth": "1024",
 })
+register_subsys("commit", {
+    # per-drive group-commit plane (storage/commit.py): concurrent
+    # streams' create/fsync/rename ops on one _DriveWriter coalesce
+    # into batched group commits — one fsync (file + parent dir)
+    # settles many streams, durability still acked per stream only
+    # after its covering fsync.  ``group_window_us`` lets a drained
+    # writer linger for late joiners (0 = batch only what's already
+    # queued); ``max_batch`` caps ops per group.  ``pack_threshold``
+    # is the small-object packing ceiling: shards past the inline
+    # band but at most this many framed bytes append to the drive's
+    # journaled segment file instead of their own part file (one
+    # fsync covers many objects); ``segment_max_bytes`` rotates the
+    # segment.  ``enable=off`` restores the eager per-op fsync path
+    # byte-for-byte.  Live-reloadable (S3Server.reload_commit_config
+    # on admin SetConfigKV).
+    "enable": "on",
+    "group_window_us": "0",
+    "max_batch": "16",
+    "pack_threshold": "1048576",
+    "segment_max_bytes": "67108864",
+})
 register_subsys("cache", {
     # hot-read plane (objectlayer/hotread.py): single-flight GET
     # coalescing + the cluster-coherent hot-object cache.  ``enable``

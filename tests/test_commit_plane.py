@@ -1,0 +1,374 @@
+"""Per-drive group commit + packed small-object segments
+(storage/commit.py + the packed band in objectlayer/erasure_object.py).
+
+Contracts pinned here:
+  * bit-identity — with packing out of reach (object above the pack
+    threshold) the grouped commit leaves byte-identical xl.meta + part
+    files vs the ungrouped commit;
+  * packed round-trip — PUT/GET/range-GET/overwrite/delete through the
+    segment indirection, extents freed when versions stop referencing
+    them;
+  * crash matrix — a commit that dies between the segment append and
+    the xl.meta flip leaves NO visible version, only an orphan extent
+    the compactor reclaims; a torn journal tail truncates on replay and
+    the store keeps working; replay is idempotent across reopens;
+  * heal — a packed object heals onto a wiped drive as a packed object
+    (re-packed into the target's own segment), bytes intact;
+  * isolation — BadDigest aborts ONE stream of a group without
+    poisoning batch-mates; a dead drive mid-group still commits at
+    quorum;
+  * observability — mt_commit_group_* families tick when groups form.
+"""
+
+import glob
+import hashlib
+import os
+import shutil
+import threading
+
+import pytest
+
+from minio_tpu.admin.metrics import GLOBAL as metrics
+from minio_tpu.objectlayer import erasure_object as eo
+from minio_tpu.objectlayer import healing
+from minio_tpu.objectlayer.erasure_object import ErasureObjects
+from minio_tpu.objectlayer.interface import (ObjectNotFound,
+                                             PutObjectOptions,
+                                             WriteQuorumError)
+from minio_tpu.storage import commit
+from minio_tpu.storage import errors as serrors
+from minio_tpu.storage import xl_storage
+from minio_tpu.storage.writers import close_write_planes
+from minio_tpu.storage.xl_storage import XLStorage
+
+from tests.writer_plane import (BS, det_uuids, disk_state, mk_layer,
+                                pattern)
+
+
+@pytest.fixture(autouse=True)
+def commit_config():
+    """Snapshot/restore the live commit config; pin _loaded so on()
+    can't lazily reload env values over a test's knob settings."""
+    keys = ("enable", "group_window_s", "max_batch", "pack_threshold",
+            "segment_max_bytes", "_loaded")
+    saved = {k: getattr(commit.CONFIG, k) for k in keys}
+    commit.CONFIG._loaded = True
+    commit.CONFIG.enable = True
+    yield commit.CONFIG
+    for k, v in saved.items():
+        setattr(commit.CONFIG, k, v)
+
+
+def seg_refs(lay, obj):
+    """Per-drive seg extents for an object's latest version."""
+    refs = []
+    for d in lay.disks:
+        fi = d.read_version("pbkt", obj)
+        refs.append(getattr(fi, "seg", None))
+    return refs
+
+
+# -- bit-identity (regular objects, above the pack band) ---------------------
+
+def test_grouped_commit_bit_identical_for_regular_objects(tmp_path,
+                                                          monkeypatch):
+    """Group commit only changes WHEN durability happens, never what
+    lands: the same 2 MiB PUT with grouping off vs on must leave
+    byte-equal xl.meta and part files on every drive."""
+    body = os.urandom(2 * (1 << 20))        # above pack_threshold
+    states = {}
+    for mode, enable in (("eager", False), ("grouped", True)):
+        det_uuids(monkeypatch)
+        commit.CONFIG.enable = enable
+        lay = mk_layer(tmp_path / mode)
+        oi = lay.put_object("pbkt", "obj", body,
+                            PutObjectOptions(mod_time=1_234_567_890))
+        assert oi.etag == hashlib.md5(body).hexdigest()
+        states[mode] = disk_state(lay, "obj")
+        close_write_planes(lay)
+    assert states["eager"] == states["grouped"]
+    assert all(meta and parts for meta, parts in states["grouped"].values())
+
+
+# -- packed round-trip -------------------------------------------------------
+
+@pytest.mark.parametrize("size", [513, 8 * 1024, 100_000, 256 * 1024])
+def test_packed_put_get_roundtrip(tmp_path, size):
+    """Bodies in (inline_threshold, pack_threshold] commit through the
+    segment: every drive's version carries a seg extent and no data
+    dir, and GET decodes the original bytes."""
+    lay = mk_layer(tmp_path)
+    body = pattern(size)
+    lay.put_object("pbkt", "obj", body)
+    refs = seg_refs(lay, "obj")
+    assert all(r is not None and r["len"] > 0 for r in refs), refs
+    # packed objects own no per-object shard files
+    for d in lay.disks:
+        assert not glob.glob(os.path.join(d.root, "pbkt", "obj", "**",
+                                          "part.*"), recursive=True)
+    _, got = lay.get_object("pbkt", "obj")
+    assert got == body
+    close_write_planes(lay)
+
+
+def test_packed_range_get(tmp_path):
+    lay = mk_layer(tmp_path)
+    body = pattern(3 * BS + 100)
+    lay.put_object("pbkt", "obj", body)
+    assert seg_refs(lay, "obj")[0] is not None
+    for off, ln in [(0, 10), (BS - 5, 10), (BS, BS), (2 * BS + 7, 93),
+                    (0, len(body)), (len(body) - 1, 1)]:
+        _, got = lay.get_object("pbkt", "obj", offset=off, length=ln)
+        assert got == body[off:off + ln], (off, ln)
+    close_write_planes(lay)
+
+
+def test_packed_overwrite_frees_old_extent_and_delete_frees_last(tmp_path):
+    """Overwrite must retire the replaced extent (dead bytes grow, old
+    offset eventually unreferenced); deleting the last version frees
+    its extent too."""
+    lay = mk_layer(tmp_path)
+    lay.put_object("pbkt", "obj", pattern(64 * 1024))
+    first = seg_refs(lay, "obj")
+    lay.put_object("pbkt", "obj", pattern(64 * 1024 + 7))
+    second = seg_refs(lay, "obj")
+    assert all(a != b for a, b in zip(first, second))
+    close_write_planes(lay)   # settle deferred frees before inspecting
+    _, got = lay.get_object("pbkt", "obj")
+    assert got == pattern(64 * 1024 + 7)
+    stats = [d.segments.stats() for d in lay.disks]
+    assert all(s["dead_bytes"] > 0 for s in stats), stats
+    live_before = sum(s["live_bytes"] for s in stats)
+    lay.delete_object("pbkt", "obj")
+    with pytest.raises(ObjectNotFound):
+        lay.get_object("pbkt", "obj")
+    live_after = sum(d.segments.stats()["live_bytes"]
+                     for d in lay.disks)
+    assert live_after < live_before
+    close_write_planes(lay)
+
+
+# -- crash matrix ------------------------------------------------------------
+
+def test_crash_between_extent_and_meta_leaves_no_version(tmp_path,
+                                                         monkeypatch):
+    """Write-ahead discipline: if the commit dies after the segment
+    append but before the xl.meta flip, no version is visible — the
+    extent is an orphan, and the compactor's owner check reclaims it
+    once the segment seals."""
+    lay = mk_layer(tmp_path)
+    lay.put_object("pbkt", "keeper", pattern(32 * 1024))
+
+    def boom(*a, **kw):
+        raise serrors.FaultyDisk("crash before meta flip")
+    monkeypatch.setattr(xl_storage, "_write_file_atomic", boom)
+    with pytest.raises(WriteQuorumError):
+        lay.put_object("pbkt", "ghost", pattern(32 * 1024))
+    monkeypatch.undo()
+    close_write_planes(lay)
+    with pytest.raises(ObjectNotFound):
+        lay.get_object("pbkt", "ghost")
+
+    # seal the open segments (rotation point below the next append),
+    # then compact: ghost extents have no owning meta -> freed
+    commit.CONFIG.segment_max_bytes = 1
+    lay.put_object("pbkt", "sealer", pattern(16 * 1024))
+    reclaimed = sum(d.compact_segments(min_dead_ratio=0.0)["freed"]
+                    for d in lay.disks)
+    assert reclaimed > 0
+    # survivors stay intact through the reclaim
+    assert lay.get_object("pbkt", "keeper")[1] == pattern(32 * 1024)
+    assert lay.get_object("pbkt", "sealer")[1] == pattern(16 * 1024)
+    close_write_planes(lay)
+
+
+def test_torn_journal_tail_truncates_and_recovers(tmp_path):
+    """A torn write at the journal tail (crash mid-record) must not
+    poison replay: the good prefix loads, the tail is truncated, and
+    the store journals new records after it."""
+    lay = mk_layer(tmp_path)
+    body = pattern(48 * 1024)
+    lay.put_object("pbkt", "obj", body)
+    close_write_planes(lay)
+    roots = [d.root for d in lay.disks]
+    del lay
+    for root in roots:
+        jp = os.path.join(root, ".mt.sys", "seg", "journal")
+        with open(jp, "ab") as f:
+            f.write(b"\xc1\xff torn half-record \xc1")
+    lay2 = ErasureObjects([XLStorage(r) for r in roots], parity=2,
+                          block_size=BS, backend="numpy",
+                          inline_threshold=512)
+    lay2._pipe_depth = 2
+    assert lay2.get_object("pbkt", "obj")[1] == body
+    lay2.put_object("pbkt", "after", pattern(9000))
+    assert lay2.get_object("pbkt", "after")[1] == pattern(9000)
+    assert all(d.segments.stats()["live_bytes"] > 0 for d in lay2.disks)
+    close_write_planes(lay2)
+
+
+def test_journal_replay_idempotent_across_reopens(tmp_path):
+    lay = mk_layer(tmp_path)
+    for i in range(4):
+        lay.put_object("pbkt", f"o{i}", pattern(10_000 + i))
+    lay.put_object("pbkt", "o0", pattern(11_111))   # one overwrite
+    close_write_planes(lay)
+    roots = [d.root for d in lay.disks]
+    stats0 = [d.segments.stats() for d in lay.disks]
+    del lay
+    for _ in range(2):                               # reopen twice
+        disks = [XLStorage(r) for r in roots]
+        lay = ErasureObjects(disks, parity=2, block_size=BS,
+                             backend="numpy", inline_threshold=512)
+        lay._pipe_depth = 2
+        assert lay.get_object("pbkt", "o0")[1] == pattern(11_111)
+        assert lay.get_object("pbkt", "o3")[1] == pattern(10_003)
+        # replay is lazy: the GETs above forced it; the journal must
+        # reduce to the same live/dead map on every reopen
+        assert [d.segments.stats() for d in disks] == stats0
+        close_write_planes(lay)
+        del lay
+
+
+# -- heal --------------------------------------------------------------------
+
+def test_heal_packed_object_onto_fresh_drive(tmp_path):
+    """A wiped drive heals a packed object by RE-PACKING it into its
+    own segment (no mixed packed/part state), bytes intact."""
+    lay = mk_layer(tmp_path)
+    body = pattern(200 * 1024)
+    lay.put_object("pbkt", "obj", body)
+    close_write_planes(lay)
+    victim = lay.disks[2]
+    root = victim.root
+    shutil.rmtree(root)
+    os.makedirs(root)
+    lay.disks[2] = XLStorage(root)
+    res = healing.heal_object(lay, "pbkt", "obj")
+    assert lay.disks[2].endpoint() in res.healed_disks
+    fi = lay.disks[2].read_version("pbkt", "obj")
+    assert getattr(fi, "seg", None) is not None     # re-packed
+    assert lay.disks[2].segments.stats()["live_bytes"] > 0
+    assert lay.get_object("pbkt", "obj")[1] == body
+    close_write_planes(lay)
+
+
+# -- group isolation ---------------------------------------------------------
+
+def test_bad_digest_mid_group_spares_batch_mates(tmp_path, monkeypatch):
+    """One stream failing its digest aborts THAT stream with no trace;
+    concurrent batch-mates in the same group window commit intact."""
+    monkeypatch.setattr(eo, "_SINGLE_CORE", False)
+    commit.CONFIG.group_window_s = 0.02      # let groups actually form
+    lay = mk_layer(tmp_path)
+    bodies = {f"good{i}": pattern(40_000 + i) for i in range(4)}
+    errs = {}
+
+    def put(name, body, opts=None):
+        try:
+            lay.put_object("pbkt", name, body, opts)
+        except Exception as e:        # noqa: BLE001 — asserted below
+            errs[name] = e
+    ts = [threading.Thread(target=put, args=(n, b))
+          for n, b in bodies.items()]
+    ts.append(threading.Thread(
+        target=put, args=("bad", pattern(40_000),
+                          PutObjectOptions(content_md5="0" * 32))))
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert set(errs) == {"bad"}
+    assert "BadDigest" in str(errs["bad"])
+    with pytest.raises(ObjectNotFound):
+        lay.get_object_info("pbkt", "bad")
+    for d in lay.disks:
+        assert not os.path.exists(os.path.join(d.root, "pbkt", "bad",
+                                               "xl.meta"))
+    for name, body in bodies.items():
+        assert lay.get_object("pbkt", name)[1] == body
+    close_write_planes(lay)
+
+
+def test_drive_death_mid_group_commits_at_quorum(tmp_path):
+    """A drive failing its packed write latches only that drive; the
+    group flush settles the survivors and the PUT acks at quorum."""
+    class DeadPackDisk:
+        def __init__(self, inner):
+            self._inner = inner
+
+        @property
+        def root(self):
+            return self._inner.root
+
+        def write_packed(self, *a, **kw):
+            raise serrors.FaultyDisk("packed write died")
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    lay = mk_layer(tmp_path,
+                   wrap=lambda i, d: DeadPackDisk(d) if i == 1 else d)
+    body = pattern(50_000)
+    lay.put_object("pbkt", "obj", body)
+    assert lay.get_object("pbkt", "obj")[1] == body
+    assert not os.path.exists(os.path.join(lay.disks[1].root, "pbkt",
+                                           "obj", "xl.meta"))
+    alive = sum(os.path.exists(os.path.join(d.root, "pbkt", "obj",
+                                            "xl.meta"))
+                for d in lay.disks)
+    assert alive == 5
+    close_write_planes(lay)
+
+
+# -- observability -----------------------------------------------------------
+
+def test_group_metrics_tick_when_groups_form(tmp_path):
+    commit.CONFIG.group_window_s = 0.02
+    lay = mk_layer(tmp_path)
+    before = metrics.snapshot()
+
+    def put(i):
+        lay.put_object("pbkt", f"m{i}", pattern(30_000 + i))
+    ts = [threading.Thread(target=put, args=(i,)) for i in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    close_write_planes(lay)
+    after = metrics.snapshot()
+
+    def delta(name):
+        k = (name, ())
+        return after.get(k, 0) - before.get(k, 0)
+    assert delta("mt_commit_group_batches_total") > 0
+    assert delta("mt_commit_group_streams_total") > \
+        delta("mt_commit_group_batches_total")
+    assert delta("mt_commit_group_segment_bytes_total") > 0
+    assert delta("mt_commit_group_fsyncs_saved_total") > 0
+
+
+# -- compaction --------------------------------------------------------------
+
+def test_compaction_rewrites_live_extents(tmp_path):
+    """Sealed mostly-dead segments compact: live extents move to fresh
+    extents (owner metas flip), dead space is reclaimed, every object
+    still reads back."""
+    commit.CONFIG.segment_max_bytes = 1      # seal on every rotation
+    lay = mk_layer(tmp_path)
+    bodies = {}
+    for i in range(6):
+        bodies[f"c{i}"] = pattern(20_000 + 13 * i)
+        lay.put_object("pbkt", f"c{i}", bodies[f"c{i}"])
+    for i in range(0, 6, 2):                 # kill half -> dead extents
+        lay.delete_object("pbkt", f"c{i}")
+        bodies.pop(f"c{i}")
+    close_write_planes(lay)
+    moved = sum(d.compact_segments(min_dead_ratio=0.0)["moved"]
+                for d in lay.disks)
+    assert moved > 0
+    for name, body in bodies.items():
+        assert lay.get_object("pbkt", name)[1] == body
+    # compaction must not strand packed objects off the segment plane
+    assert all(r is not None for r in seg_refs(lay, "c1"))
+    close_write_planes(lay)

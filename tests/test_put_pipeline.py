@@ -18,10 +18,8 @@ Contracts pinned here:
 import glob
 import hashlib
 import io
-import itertools
 import os
 import threading
-import uuid
 
 import pytest
 
@@ -34,50 +32,8 @@ from minio_tpu.storage import errors as serrors
 from minio_tpu.storage.writers import close_write_planes
 from minio_tpu.storage.xl_storage import XLStorage
 
-BS = 4096
-
-
-def pattern(n: int) -> bytes:
-    return (b"0123456789abcdef" * (n // 16 + 1))[:n]
-
-
-def mk_layer(root, n=6, parity=2, depth=2, qd=2, wrap=None):
-    disks = []
-    for i in range(n):
-        d = root / f"d{i}"
-        d.mkdir(parents=True)
-        disk = XLStorage(str(d))
-        disks.append(wrap(i, disk) if wrap else disk)
-    lay = ErasureObjects(disks, parity=parity, block_size=BS,
-                         backend="numpy", inline_threshold=512)
-    lay._pipe_depth = depth          # force regardless of core count
-    lay._pipe_queue_depth = qd
-    lay.make_bucket("pbkt")
-    return lay
-
-
-def det_uuids(monkeypatch):
-    """Deterministic uuid4 sequence so two PUT runs mint identical
-    version/data-dir ids (the bit-identity comparisons need it)."""
-    ctr = itertools.count(1)
-    monkeypatch.setattr(uuid, "uuid4",
-                        lambda: uuid.UUID(int=next(ctr)))
-
-
-def disk_state(lay, obj):
-    """{drive_index: (xl.meta bytes, [part bytes...])} for an object."""
-    out = {}
-    for i, d in enumerate(lay.disks):
-        root = d.root if hasattr(d, "root") else d._inner.root
-        base = os.path.join(root, "pbkt", obj)
-        meta_b = b""
-        mp = os.path.join(base, "xl.meta")
-        if os.path.exists(mp):
-            meta_b = open(mp, "rb").read()
-        parts = [open(f, "rb").read() for f in
-                 sorted(glob.glob(os.path.join(base, "*", "part.*")))]
-        out[i] = (meta_b, parts)
-    return out
+from tests.writer_plane import (BS, det_uuids, disk_state, mk_layer,
+                                pattern)
 
 
 @pytest.fixture()
